@@ -1,0 +1,32 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch rebuild of the capabilities of Eclipse Deeplearning4j
+(reference: jaimemabasso/deeplearning4j) designed idiomatically for TPU:
+
+- jit-compiled functional train steps on XLA (replacing per-op JNI dispatch
+  into libnd4j; see reference ``MultiLayerNetwork.java:1268`` hot loop),
+- pjit/shard_map data parallelism over a ``jax.sharding.Mesh`` with ICI/DCN
+  collectives (replacing ParallelWrapper averaging and the Aeron parameter
+  server; reference ``parallelism/ParallelWrapper.java:326``,
+  ``networking/WiredEncodingHandler.java:96``),
+- Pallas kernels / custom ops only where XLA needs help.
+
+The user-facing surface mirrors DL4J: ``NeuralNetConfiguration`` builders →
+``MultiLayerConfiguration`` / ``ComputationGraphConfiguration`` →
+``MultiLayerNetwork`` / ``ComputationGraph`` with ``fit()`` / ``output()`` /
+``evaluate()``, a layer catalog, updaters, listeners, evaluation classes,
+early stopping, transfer learning and zip-format model serialization.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu import activations, initializers, losses, schedules, updaters
+
+__all__ = [
+    "activations",
+    "initializers",
+    "losses",
+    "schedules",
+    "updaters",
+    "__version__",
+]

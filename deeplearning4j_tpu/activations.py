@@ -1,0 +1,170 @@
+"""Activation function catalog.
+
+Mirrors the reference activation enum/impl set (reference:
+``nd4j-api org.nd4j.linalg.activations.Activation`` as consumed throughout
+``deeplearning4j-nn/src/main/java/org/deeplearning4j/nn/conf/layers/*``).
+Activations are referenced by name in layer configs so configurations stay
+JSON-serializable; each name maps to a pure jax function suitable for tracing
+inside a jitted train step (XLA fuses these into the surrounding matmuls, so
+there is no per-activation kernel dispatch as in the reference's libnd4j ops).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+ActivationFn = Callable[[Array], Array]
+
+
+def identity(x: Array) -> Array:
+    return x
+
+
+def relu(x: Array) -> Array:
+    return jax.nn.relu(x)
+
+
+def relu6(x: Array) -> Array:
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def leakyrelu(x: Array, alpha: float = 0.01) -> Array:
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x: Array, alpha: float = 1.0) -> Array:
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x: Array) -> Array:
+    return jax.nn.selu(x)
+
+
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x: Array) -> Array:
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x: Array) -> Array:
+    # tanh approximation: 1.7159 * tanh(2x/3) with rational inner approx
+    # (reference ActivationRationalTanh semantics).
+    a = 1.7159
+    y = a * _rational_tanh_inner(2.0 * x / 3.0)
+    return y
+
+
+def _rational_tanh_inner(x: Array) -> Array:
+    ax = jnp.abs(x)
+    approx = jnp.sign(x) * (1.0 - 1.0 / (1.0 + ax + x * x + 1.41645 * x**4))
+    return approx
+
+
+def rectifiedtanh(x: Array) -> Array:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x: Array) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+def logsoftmax(x: Array) -> Array:
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def swish(x: Array) -> Array:
+    return jax.nn.silu(x)
+
+
+def gelu(x: Array) -> Array:
+    return jax.nn.gelu(x)
+
+
+def mish(x: Array) -> Array:
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def thresholdedrelu(x: Array, theta: float = 1.0) -> Array:
+    return jnp.where(x > theta, x, 0.0)
+
+
+def rrelu(x: Array, lower: float = 1.0 / 8.0, upper: float = 1.0 / 3.0) -> Array:
+    """Randomized leaky ReLU; deterministic (mean slope) form.
+
+    The reference's RReLU samples a slope per element at train time; under a
+    jitted functional step we use the mean slope (its inference behavior) —
+    stochastic slope sampling belongs to a dropout-style noise layer instead.
+    """
+    alpha = (lower + upper) / 2.0
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+_REGISTRY: dict[str, ActivationFn] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "swish": swish,
+    "gelu": gelu,
+    "mish": mish,
+    "thresholdedrelu": thresholdedrelu,
+    "rrelu": rrelu,
+}
+
+
+def get(name_or_fn: Union[str, ActivationFn, None]) -> ActivationFn:
+    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    if name_or_fn is None:
+        return identity
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower().replace("_", "")
+    if key not in _REGISTRY:
+        raise ValueError(
+            f"Unknown activation '{name_or_fn}'. Known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[key]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,183 @@
+"""Weight initialization schemes.
+
+Parity with the reference's ``WeightInit`` enum and ``WeightInitUtil``
+(reference: ``deeplearning4j-nn/.../nn/weights/WeightInit.java``,
+``nn/weights/WeightInitUtil.java``): schemes are selected by name in layer
+configs, parameterized by fan-in/fan-out computed from the layer shape, and
+drawn with an explicit jax PRNG key (the functional replacement for the
+reference's global ND4J RNG).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class Distribution:
+    """JSON-serializable distribution for WeightInit.DISTRIBUTION.
+
+    Mirrors reference ``nn/conf/distribution/*`` (Normal, Uniform, Constant,
+    LogNormal, TruncatedNormal, Orthogonal, Binomial subset).
+    """
+
+    def __init__(self, kind: str, **kwargs):
+        self.kind = kind.lower()
+        self.kwargs = kwargs
+
+    def sample(self, rng: jax.Array, shape: Sequence[int], dtype=jnp.float32) -> Array:
+        k = self.kind
+        p = self.kwargs
+        if k == "normal" or k == "gaussian":
+            return p.get("mean", 0.0) + p.get("std", 1.0) * jax.random.normal(
+                rng, shape, dtype
+            )
+        if k == "uniform":
+            return jax.random.uniform(
+                rng, shape, dtype, minval=p.get("lower", -1.0), maxval=p.get("upper", 1.0)
+            )
+        if k == "constant":
+            return jnp.full(shape, p.get("value", 0.0), dtype)
+        if k == "lognormal":
+            return jnp.exp(
+                p.get("mean", 0.0)
+                + p.get("std", 1.0) * jax.random.normal(rng, shape, dtype)
+            )
+        if k == "truncated_normal":
+            return p.get("mean", 0.0) + p.get("std", 1.0) * jax.random.truncated_normal(
+                rng, -2.0, 2.0, shape, dtype
+            )
+        if k == "orthogonal":
+            return _orthogonal(rng, shape, gain=p.get("gain", 1.0), dtype=dtype)
+        raise ValueError(f"Unknown distribution kind '{self.kind}'")
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, **self.kwargs}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Distribution":
+        d = dict(d)
+        return Distribution(d.pop("kind"), **d)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Distribution)
+            and self.kind == other.kind
+            and self.kwargs == other.kwargs
+        )
+
+    def __repr__(self):
+        return f"Distribution({self.kind!r}, {self.kwargs})"
+
+
+def _orthogonal(rng, shape, gain=1.0, dtype=jnp.float32) -> Array:
+    if len(shape) < 2:
+        raise ValueError("orthogonal init needs >=2 dims")
+    rows = shape[0]
+    cols = int(math.prod(shape[1:]))
+    n = max(rows, cols)
+    a = jax.random.normal(rng, (n, n), dtype)
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diagonal(r))
+    return gain * q[:rows, :cols].reshape(shape)
+
+
+def init_weights(
+    rng: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    scheme: Union[str, Distribution] = "xavier",
+    distribution: Optional[Distribution] = None,
+    dtype=jnp.float32,
+) -> Array:
+    """Draw a weight tensor per the named scheme.
+
+    Scheme semantics follow reference ``WeightInitUtil.initWeights``:
+      - xavier: N(0, 2/(fanIn+fanOut))
+      - xavier_uniform: U(+-sqrt(6/(fanIn+fanOut)))
+      - xavier_fan_in: N(0, 1/fanIn)
+      - xavier_legacy: N(0, 1/(shape[0]*shape[1]))
+      - relu: N(0, 2/fanIn) (He)
+      - relu_uniform: U(+-sqrt(6/fanIn))
+      - lecun_normal: N(0, 1/fanIn)
+      - lecun_uniform: U(+-sqrt(3/fanIn))
+      - sigmoid_uniform: U(+-4*sqrt(6/(fanIn+fanOut)))
+      - uniform: U(+-1/sqrt(fanIn))  (legacy DL4J default uniform)
+      - normal: N(0, 1/sqrt(fanIn))
+      - zero / ones / identity / distribution / var_scaling_*
+    """
+    if isinstance(scheme, Distribution):
+        return scheme.sample(rng, shape, dtype)
+    s = str(scheme).lower()
+    fan_in = max(float(fan_in), 1.0)
+    fan_out = max(float(fan_out), 1.0)
+
+    if s == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a Distribution")
+        return distribution.sample(rng, shape, dtype)
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        if len(shape) == 2 and shape[0] == shape[1]:
+            return jnp.eye(shape[0], dtype=dtype)
+        raise ValueError("identity init requires a square 2-d shape")
+    if s == "xavier":
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+    if s in ("xavier_uniform", "xavieruniform"):
+        lim = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s in ("xavier_fan_in", "xavierfanin"):
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s in ("xavier_legacy", "xavierlegacy"):
+        std = math.sqrt(1.0 / (shape[0] * shape[1])) if len(shape) >= 2 else math.sqrt(1.0 / shape[0])
+        return std * jax.random.normal(rng, shape, dtype)
+    if s == "relu":
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s in ("relu_uniform", "reluuniform"):
+        lim = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s in ("lecun_normal", "lecunnormal"):
+        std = math.sqrt(1.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s in ("lecun_uniform", "lecununiform"):
+        lim = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s in ("sigmoid_uniform", "sigmoiduniform"):
+        lim = 4.0 * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s == "uniform":
+        lim = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s == "normal":
+        std = 1.0 / math.sqrt(fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+    if s in ("var_scaling_normal_fan_in", "varscalingnormalfanin"):
+        return math.sqrt(1.0 / fan_in) * jax.random.normal(rng, shape, dtype)
+    if s in ("var_scaling_normal_fan_out", "varscalingnormalfanout"):
+        return math.sqrt(1.0 / fan_out) * jax.random.normal(rng, shape, dtype)
+    if s in ("var_scaling_normal_fan_avg", "varscalingnormalfanavg"):
+        return math.sqrt(2.0 / (fan_in + fan_out)) * jax.random.normal(rng, shape, dtype)
+    if s in ("var_scaling_uniform_fan_in", "varscalinguniformfanin"):
+        lim = math.sqrt(3.0 / fan_in)
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s in ("var_scaling_uniform_fan_out", "varscalinguniformfanout"):
+        lim = math.sqrt(3.0 / fan_out)
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s in ("var_scaling_uniform_fan_avg", "varscalinguniformfanavg"):
+        lim = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -lim, lim)
+    if s == "orthogonal":
+        return _orthogonal(rng, shape, dtype=dtype)
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

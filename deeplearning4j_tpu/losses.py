@@ -1,0 +1,213 @@
+"""Loss function catalog.
+
+Parity with the reference's ``LossFunctions.LossFunction`` enum and the
+``ILossFunction`` implementations consumed by output layers (reference:
+``nd4j`` loss functions as used by
+``deeplearning4j-nn/.../nn/conf/layers/OutputLayer.java`` and
+``nn/layers/BaseOutputLayer``).
+
+Design: each loss is a pure function ``loss(labels, preout, activation, mask)
+-> per-example score vector``; gradients come from jax autodiff on the whole
+train step, so there is no hand-written ``computeGradient`` as in the
+reference. Softmax/sigmoid cross-entropies are computed from logits
+(numerically stable log-sum-exp form) — the activation is folded into the
+loss when it is the canonical pairing, mirroring what the reference does
+analytically in ``LossMCXENT.computeGradient`` (softmax-cancellation).
+
+Masking: ``mask`` has shape (batch,) or broadcastable to the per-element
+score; masked elements contribute zero and the mean divides by mask sum
+(reference per-output masking semantics, ``nn/api/Layer.java:288``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import activations as _act
+
+Array = jax.Array
+EPS = 1e-7
+
+
+def _apply_activation(preout: Array, activation: Optional[str]) -> Array:
+    return _act.get(activation)(preout)
+
+
+def _reduce_elementwise(per_elem: Array, mask: Optional[Array]) -> Array:
+    """Sum per-element scores over feature axes → per-example vector."""
+    if mask is not None:
+        per_elem = per_elem * mask
+    axes = tuple(range(1, per_elem.ndim))
+    return jnp.sum(per_elem, axis=axes) if axes else per_elem
+
+
+def mse(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    # Reference LossMSE: mean over output features of squared error.
+    n = labels.shape[-1]
+    return _reduce_elementwise((out - labels) ** 2, mask) / n
+
+
+def l2(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    return _reduce_elementwise((out - labels) ** 2, mask)
+
+
+def mae(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    n = labels.shape[-1]
+    return _reduce_elementwise(jnp.abs(out - labels), mask) / n
+
+
+def l1(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    return _reduce_elementwise(jnp.abs(out - labels), mask)
+
+
+def mape(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    n = labels.shape[-1]
+    per = jnp.abs((labels - out) / jnp.where(jnp.abs(labels) < EPS, EPS, labels)) * 100.0
+    return _reduce_elementwise(per, mask) / n
+
+
+def msle(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    n = labels.shape[-1]
+    per = (jnp.log1p(jnp.maximum(out, -1 + EPS)) - jnp.log1p(jnp.maximum(labels, -1 + EPS))) ** 2
+    return _reduce_elementwise(per, mask) / n
+
+
+def xent(labels, preout, activation="sigmoid", mask=None) -> Array:
+    """Binary cross-entropy. Stable from logits when activation == sigmoid."""
+    if activation in ("sigmoid", None):
+        # log(1+exp(-|x|)) formulation
+        per = jnp.maximum(preout, 0) - preout * labels + jnp.log1p(jnp.exp(-jnp.abs(preout)))
+    else:
+        out = jnp.clip(_apply_activation(preout, activation), EPS, 1 - EPS)
+        per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
+    return _reduce_elementwise(per, mask)
+
+
+def mcxent(labels, preout, activation="softmax", mask=None) -> Array:
+    """Multi-class cross-entropy with one-hot (or soft) labels."""
+    if activation in ("softmax", None):
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(_apply_activation(preout, activation), EPS, 1.0))
+    return _reduce_elementwise(-labels * logp, mask)
+
+
+def sparse_mcxent(labels, preout, activation="softmax", mask=None) -> Array:
+    """MCXENT with integer class-index labels (reference SPARSE_MCXENT)."""
+    labels = labels.astype(jnp.int32)
+    if labels.ndim == preout.ndim:  # (batch,1) style
+        labels = labels.squeeze(-1)
+    if activation in ("softmax", None):
+        logp = jax.nn.log_softmax(preout, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(_apply_activation(preout, activation), EPS, 1.0))
+    per = -jnp.take_along_axis(logp, labels[..., None], axis=-1).squeeze(-1)
+    if mask is not None:
+        m = mask
+        while m.ndim > per.ndim:
+            m = m.squeeze(-1)
+        per = per * m
+    axes = tuple(range(1, per.ndim))
+    return jnp.sum(per, axis=axes) if axes else per
+
+
+def negativeloglikelihood(labels, preout, activation="softmax", mask=None) -> Array:
+    # Reference LossNegativeLogLikelihood == MCXENT for one-hot labels.
+    return mcxent(labels, preout, activation, mask)
+
+
+def kl_divergence(labels, preout, activation="softmax", mask=None) -> Array:
+    out = jnp.clip(_apply_activation(preout, activation), EPS, 1.0)
+    lab = jnp.clip(labels, EPS, 1.0)
+    return _reduce_elementwise(labels * (jnp.log(lab) - jnp.log(out)), mask)
+
+
+def cosine_proximity(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    if mask is not None:
+        out = out * mask
+        labels = labels * mask
+    dot = jnp.sum(out * labels, axis=-1)
+    no = jnp.sqrt(jnp.sum(out * out, axis=-1) + EPS)
+    nl = jnp.sqrt(jnp.sum(labels * labels, axis=-1) + EPS)
+    per = -(dot / (no * nl))
+    axes = tuple(range(1, per.ndim))
+    return jnp.sum(per, axis=axes) if axes else per
+
+
+def hinge(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    # labels in {-1, +1} (reference LossHinge)
+    return _reduce_elementwise(jnp.maximum(0.0, 1.0 - labels * out), mask)
+
+
+def squared_hinge(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    return _reduce_elementwise(jnp.maximum(0.0, 1.0 - labels * out) ** 2, mask)
+
+
+def poisson(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    out = jnp.maximum(out, EPS)
+    return _reduce_elementwise(out - labels * jnp.log(out), mask)
+
+
+def reconstruction_crossentropy(labels, preout, activation="sigmoid", mask=None) -> Array:
+    out = jnp.clip(_apply_activation(preout, activation), EPS, 1 - EPS)
+    per = -(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out))
+    return _reduce_elementwise(per, mask)
+
+
+def wasserstein(labels, preout, activation=None, mask=None) -> Array:
+    out = _apply_activation(preout, activation)
+    return _reduce_elementwise(labels * out, mask)
+
+
+_REGISTRY: dict[str, Callable] = {
+    "mse": mse,
+    "squared_loss": mse,
+    "l2": l2,
+    "mae": mae,
+    "mean_absolute_error": mae,
+    "l1": l1,
+    "mape": mape,
+    "mean_absolute_percentage_error": mape,
+    "msle": msle,
+    "mean_squared_logarithmic_error": msle,
+    "xent": xent,
+    "mcxent": mcxent,
+    "sparse_mcxent": sparse_mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "kl_divergence": kl_divergence,
+    "kld": kl_divergence,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "poisson": poisson,
+    "reconstruction_crossentropy": reconstruction_crossentropy,
+    "wasserstein": wasserstein,
+}
+
+LossLike = Union[str, Callable]
+
+
+def get(name_or_fn: LossLike) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name_or_fn}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,226 @@
+"""Regularization, parameter constraints, and gradient normalization.
+
+Parity targets in the reference:
+- l1/l2/weight-decay per layer & per param-type (weights vs biases), applied
+  to gradients before the updater and to the score
+  (``nn/conf/NeuralNetConfiguration`` builder l1/l2/l1Bias/l2Bias,
+  score terms via ``BaseLayer.calcRegularizationScore``).
+- Gradient normalization modes applied in the updater "preApply"
+  (``nn/updater/BaseMultiLayerUpdater.java:322``,
+  ``nn/conf/GradientNormalization.java``).
+- Parameter constraints applied after each step
+  (``nn/conf/constraint/*`` — MaxNorm, MinMaxNorm, NonNegative, UnitNorm;
+  applied at ``optimize/solvers/BaseOptimizer applyConstraints``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# L1 / L2 / weight decay
+# ---------------------------------------------------------------------------
+
+class RegularizationConf:
+    """Per-layer regularization coefficients (weights vs biases)."""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, l1_bias: float = 0.0,
+                 l2_bias: float = 0.0, weight_decay: float = 0.0,
+                 weight_decay_bias: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        self.l1_bias = float(l1_bias)
+        self.l2_bias = float(l2_bias)
+        self.weight_decay = float(weight_decay)
+        self.weight_decay_bias = float(weight_decay_bias)
+
+    def coeffs_for(self, param_name: str) -> tuple[float, float, float]:
+        """(l1, l2, weight_decay) for a parameter by name ('b*' = bias)."""
+        if param_name.startswith("b") or "bias" in param_name.lower():
+            return self.l1_bias, self.l2_bias, self.weight_decay_bias
+        return self.l1, self.l2, self.weight_decay
+
+    def grad_term(self, param_name: str, param: Array) -> Optional[Array]:
+        """dReg/dParam to add to the raw gradient (reference applies l1/l2
+        into the gradient before the updater sees it)."""
+        l1, l2, wd = self.coeffs_for(param_name)
+        term = None
+        if l2:
+            term = l2 * param
+        if l1:
+            t = l1 * jnp.sign(param)
+            term = t if term is None else term + t
+        # weight decay is applied post-lr multiplication in some formulations;
+        # reference WeightDecay applies coeff * param into the update. We fold
+        # it into the gradient (equivalent for SGD; standard decoupled form is
+        # approximated — documented deviation).
+        if wd:
+            t = wd * param
+            term = t if term is None else term + t
+        return term
+
+    def score_term(self, param_name: str, param: Array) -> Array:
+        l1, l2, _wd = self.coeffs_for(param_name)
+        s = jnp.asarray(0.0, jnp.float32)
+        if l2:
+            s = s + 0.5 * l2 * jnp.sum(param.astype(jnp.float32) ** 2)
+        if l1:
+            s = s + l1 * jnp.sum(jnp.abs(param.astype(jnp.float32)))
+        return s
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_dict(d):
+        return RegularizationConf(**d)
+
+    def __eq__(self, other):
+        return isinstance(other, RegularizationConf) and self.__dict__ == other.__dict__
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (reference GradientNormalization enum)
+# ---------------------------------------------------------------------------
+
+GRADIENT_NORMALIZATIONS = (
+    "none",
+    "renormalize_l2_per_layer",
+    "renormalize_l2_per_param_type",
+    "clip_element_wise_absolute_value",
+    "clip_l2_per_layer",
+    "clip_l2_per_param_type",
+)
+
+
+def normalize_layer_gradients(
+    grads: Dict[str, Array],
+    mode: Optional[str],
+    threshold: float = 1.0,
+    eps: float = 1e-8,
+) -> Dict[str, Array]:
+    """Apply a gradient-normalization mode to one layer's gradient dict.
+
+    Mirrors ``BaseMultiLayerUpdater.preApply`` (reference
+    ``nn/updater/BaseMultiLayerUpdater.java:322``): normalization happens on
+    the raw gradients before the updater math.
+    """
+    if not mode or mode == "none" or not grads:
+        return grads
+    mode = mode.lower()
+    if mode == "renormalize_l2_per_layer":
+        sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values())
+        norm = jnp.sqrt(sq + eps)
+        return {k: g / norm for k, g in grads.items()}
+    if mode == "renormalize_l2_per_param_type":
+        return {
+            k: g / jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2) + eps)
+            for k, g in grads.items()
+        }
+    if mode == "clip_element_wise_absolute_value":
+        return {k: jnp.clip(g, -threshold, threshold) for k, g in grads.items()}
+    if mode == "clip_l2_per_layer":
+        sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads.values())
+        norm = jnp.sqrt(sq + eps)
+        scale = jnp.where(norm > threshold, threshold / norm, 1.0)
+        return {k: g * scale for k, g in grads.items()}
+    if mode == "clip_l2_per_param_type":
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2) + eps)
+            scale = jnp.where(norm > threshold, threshold / norm, 1.0)
+            out[k] = g * scale
+        return out
+    raise ValueError(f"Unknown gradient normalization '{mode}'")
+
+
+# ---------------------------------------------------------------------------
+# Constraints (applied to params after each update)
+# ---------------------------------------------------------------------------
+
+class Constraint:
+    """Base parameter constraint (reference ``nn/conf/constraint/BaseConstraint``).
+
+    ``dims``: axes over which norms are computed (reference defaults: for a
+    dense weight [nIn, nOut] the norm is per output unit → axis 0).
+    """
+
+    applies_to = ("W",)  # param names; reference default applies to weights only
+
+    def apply(self, param: Array) -> Array:
+        raise NotImplementedError
+
+    def to_dict(self):
+        return {"@class": type(self).__name__, **self.__dict__}
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        cls = _CONSTRAINTS[d.pop("@class")]
+        obj = cls.__new__(cls)
+        obj.__dict__.update(d)
+        return obj
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+
+def _reduce_axes(param: Array) -> tuple:
+    # All axes except the last ("per output unit" norms, matching reference
+    # dimension conventions for dense [in,out] and conv [kh,kw,in,out]).
+    return tuple(range(param.ndim - 1)) if param.ndim > 1 else (0,)
+
+
+class MaxNormConstraint(Constraint):
+    def __init__(self, max_norm: float = 1.0):
+        self.max_norm = float(max_norm)
+
+    def apply(self, param):
+        axes = _reduce_axes(param)
+        norm = jnp.sqrt(jnp.sum(param**2, axis=axes, keepdims=True) + 1e-12)
+        scale = jnp.minimum(1.0, self.max_norm / norm)
+        return param * scale
+
+
+class MinMaxNormConstraint(Constraint):
+    def __init__(self, min_norm: float = 0.0, max_norm: float = 1.0, rate: float = 1.0):
+        self.min_norm = float(min_norm)
+        self.max_norm = float(max_norm)
+        self.rate = float(rate)
+
+    def apply(self, param):
+        axes = _reduce_axes(param)
+        norm = jnp.sqrt(jnp.sum(param**2, axis=axes, keepdims=True) + 1e-12)
+        clipped = jnp.clip(norm, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1 - self.rate) * norm
+        return param * (target / norm)
+
+
+class NonNegativeConstraint(Constraint):
+    def __init__(self):
+        pass
+
+    def apply(self, param):
+        return jnp.maximum(param, 0.0)
+
+
+class UnitNormConstraint(Constraint):
+    def __init__(self):
+        pass
+
+    def apply(self, param):
+        axes = _reduce_axes(param)
+        norm = jnp.sqrt(jnp.sum(param**2, axis=axes, keepdims=True) + 1e-12)
+        return param / norm
+
+
+_CONSTRAINTS = {
+    c.__name__: c
+    for c in [MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint, UnitNormConstraint]
+}

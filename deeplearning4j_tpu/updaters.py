@@ -1,0 +1,287 @@
+"""Per-parameter gradient updaters (optimizers).
+
+Parity with the reference's ``IUpdater`` configs + nd4j ``GradientUpdater``
+kernels (reference: ``nn/api/Updater``-consumed configs — Sgd, Adam, AdaMax,
+AdaDelta, AdaGrad, AMSGrad, Nadam, Nesterovs, NoOp, RmsProp — applied by
+``nn/updater/UpdaterBlock.java:105``). Here each updater is a
+JSON-serializable config with two pure methods:
+
+- ``init_state(param)`` → pytree of state arrays (zeros, matching shapes)
+- ``apply(grad, state, t)`` → ``(update, new_state)`` where the train step
+  performs ``params = params - update`` (the functional equivalent of the
+  reference's in-place ``params.subi(update)``,
+  ``optimize/solvers/StochasticGradientDescent.java:78``).
+
+The step counter ``t`` is a traced int32 (1-based at first apply) so bias
+corrections (Adam family) compile into the jitted step. Learning-rate
+schedules evaluate inside the trace (see ``schedules.py``).
+
+Per-layer updater overrides, gradient normalization/clipping ("preApply",
+reference ``nn/updater/BaseMultiLayerUpdater.java:322``) and l1/l2/weight-
+decay application live at the network level in ``nn/updater_graph.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.schedules import FixedSchedule, Schedule, as_schedule
+
+Array = jax.Array
+State = Dict[str, Array]
+
+
+class Updater:
+    """Base updater config. Subclasses define DEFAULTS and the math."""
+
+    has_learning_rate = True
+
+    def __init__(self, learning_rate: Union[float, Schedule, None] = None):
+        if self.has_learning_rate:
+            default = getattr(self, "DEFAULT_LR", 1e-3)
+            self.learning_rate: Optional[Schedule] = as_schedule(
+                default if learning_rate is None else learning_rate
+            )
+        else:
+            self.learning_rate = None
+
+    # -- functional interface -------------------------------------------------
+    def init_state(self, param: Array) -> State:
+        return {}
+
+    def apply(self, grad: Array, state: State, t: Array, iteration: Array, epoch: Array) -> Tuple[Array, State]:
+        raise NotImplementedError
+
+    def lr(self, iteration, epoch) -> Array:
+        assert self.learning_rate is not None
+        return self.learning_rate.value_at(iteration, epoch)
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        d: Dict[str, Any] = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            if isinstance(v, Schedule):
+                d[k] = {"@schedule": True, **v.to_dict()}
+            else:
+                d[k] = v
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Updater":
+        d = dict(d)
+        cls = _UPDATERS[d.pop("@class")]
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            if isinstance(v, dict) and v.get("@schedule"):
+                v = dict(v)
+                v.pop("@schedule")
+                v = Schedule.from_dict(v)
+            setattr(obj, k, v)
+        return obj
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Sgd(Updater):
+    DEFAULT_LR = 1e-1
+
+    def apply(self, grad, state, t, iteration, epoch):
+        return self.lr(iteration, epoch) * grad, state
+
+
+class NoOp(Updater):
+    """Pass the raw gradient through unchanged (reference nd4j NoOp)."""
+
+    has_learning_rate = False
+
+    def __init__(self):
+        super().__init__()
+
+    def apply(self, grad, state, t, iteration, epoch):
+        return grad, state
+
+
+class Nesterovs(Updater):
+    """Nesterov accelerated gradient, reference NesterovsUpdater semantics:
+
+    v' = mu*v - lr*g ;  update = mu*v - (1+mu)*v'  (subtracted from params)
+    """
+
+    DEFAULT_LR = 0.1
+
+    def __init__(self, learning_rate=None, momentum: Union[float, Schedule] = 0.9):
+        super().__init__(learning_rate)
+        self.momentum = as_schedule(momentum)
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        mu = self.momentum.value_at(iteration, epoch)
+        v_prev = state["v"]
+        v = mu * v_prev - self.lr(iteration, epoch) * grad
+        update = mu * v_prev - (1.0 + mu) * v
+        return update, {"v": v}
+
+
+class Adam(Updater):
+    DEFAULT_LR = 1e-3
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        alpha = self.lr(iteration, epoch) * jnp.sqrt(1 - b2**tf) / (1 - b1**tf)
+        update = alpha * m / (jnp.sqrt(v) + self.epsilon)
+        return update, {"m": m, "v": v}
+
+
+class AdaMax(Updater):
+    DEFAULT_LR = 1e-3
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        b1 = self.beta1
+        m = b1 * state["m"] + (1 - b1) * grad
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        update = self.lr(iteration, epoch) / (1 - b1**tf) * m / (u + self.epsilon)
+        return update, {"m": m, "u": u}
+
+
+class Nadam(Updater):
+    DEFAULT_LR = 1e-3
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m_hat = m / (1 - b1 ** (tf + 1.0))
+        g_hat = grad / (1 - b1**tf)
+        v_hat = v / (1 - b2**tf)
+        update = (
+            self.lr(iteration, epoch)
+            * (b1 * m_hat + (1 - b1) * g_hat)
+            / (jnp.sqrt(v_hat) + self.epsilon)
+        )
+        return update, {"m": m, "v": v}
+
+
+class AMSGrad(Updater):
+    DEFAULT_LR = 1e-3
+
+    def __init__(self, learning_rate=None, beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def init_state(self, param):
+        return {
+            "m": jnp.zeros_like(param),
+            "v": jnp.zeros_like(param),
+            "v_hat": jnp.zeros_like(param),
+        }
+
+    def apply(self, grad, state, t, iteration, epoch):
+        b1, b2 = self.beta1, self.beta2
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        v_hat = jnp.maximum(state["v_hat"], v)
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        alpha = self.lr(iteration, epoch) * jnp.sqrt(1 - b2**tf) / (1 - b1**tf)
+        update = alpha * m / (jnp.sqrt(v_hat) + self.epsilon)
+        return update, {"m": m, "v": v, "v_hat": v_hat}
+
+
+class AdaGrad(Updater):
+    DEFAULT_LR = 1e-1
+
+    def __init__(self, learning_rate=None, epsilon: float = 1e-6):
+        super().__init__(learning_rate)
+        self.epsilon = float(epsilon)
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        h = state["h"] + grad * grad
+        update = self.lr(iteration, epoch) * grad / (jnp.sqrt(h) + self.epsilon)
+        return update, {"h": h}
+
+
+class AdaDelta(Updater):
+    has_learning_rate = False
+
+    def __init__(self, rho: float = 0.95, epsilon: float = 1e-6):
+        super().__init__()
+        self.rho, self.epsilon = float(rho), float(epsilon)
+
+    def init_state(self, param):
+        return {"msg": jnp.zeros_like(param), "msdx": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        rho, eps = self.rho, self.epsilon
+        msg = rho * state["msg"] + (1 - rho) * grad * grad
+        update = grad * jnp.sqrt(state["msdx"] + eps) / jnp.sqrt(msg + eps)
+        msdx = rho * state["msdx"] + (1 - rho) * update * update
+        return update, {"msg": msg, "msdx": msdx}
+
+
+class RmsProp(Updater):
+    DEFAULT_LR = 1e-1
+
+    def __init__(self, learning_rate=None, rms_decay: float = 0.95, epsilon: float = 1e-8):
+        super().__init__(learning_rate)
+        self.rms_decay, self.epsilon = float(rms_decay), float(epsilon)
+
+    def init_state(self, param):
+        return {"r": jnp.zeros_like(param)}
+
+    def apply(self, grad, state, t, iteration, epoch):
+        r = self.rms_decay * state["r"] + (1 - self.rms_decay) * grad * grad
+        update = self.lr(iteration, epoch) * grad / (jnp.sqrt(r + self.epsilon))
+        return update, {"r": r}
+
+
+_UPDATERS = {
+    c.__name__: c
+    for c in [Sgd, NoOp, Nesterovs, Adam, AdaMax, Nadam, AMSGrad, AdaGrad, AdaDelta, RmsProp]
+}
+
+
+def get(name_or_obj: Union[str, Updater]) -> Updater:
+    if isinstance(name_or_obj, Updater):
+        return name_or_obj
+    key = str(name_or_obj).lower()
+    for name, cls in _UPDATERS.items():
+        if name.lower() == key:
+            return cls()
+    raise ValueError(f"Unknown updater '{name_or_obj}'. Known: {sorted(_UPDATERS)}")

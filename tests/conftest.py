@@ -1,0 +1,40 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+exercised without TPU hardware (the driver separately dry-runs the
+multi-chip path; see ``__graft_entry__.dryrun_multichip``). Env vars must be
+set before jax initializes its backends, hence the top-of-file placement.
+
+This mirrors the reference's harness pattern of a strict base test class
+(``BaseDL4JTest`` setting SCOPE_PANIC profiling,
+``deeplearning4j-core/src/test/java/org/deeplearning4j/BaseDL4JTest.java:8``):
+here we enable jax's strongest always-on checks instead.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# NaN debugging is opt-in per test (jax.debug_nans breaks some valid ops);
+# keep x64 off to match TPU numerics, tests that need fp64 enable it locally.
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(12345)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,300 @@
+"""Unit tests for core primitives: activations, initializers, losses,
+schedules, updaters, regularization.
+
+Modeled on the reference's per-subsystem behavioral unit tests
+(e.g. ``nn/updater/TestUpdaters.java``, SURVEY.md §4.2).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import activations, initializers, losses, schedules, updaters
+from deeplearning4j_tpu.initializers import Distribution
+from deeplearning4j_tpu.regularization import (
+    MaxNormConstraint,
+    NonNegativeConstraint,
+    RegularizationConf,
+    UnitNormConstraint,
+    normalize_layer_gradients,
+)
+
+
+class TestActivations:
+    def test_all_names_resolve_and_run(self):
+        x = jnp.linspace(-3, 3, 13)
+        for name in activations.names():
+            y = activations.get(name)(x)
+            assert y.shape == x.shape, name
+            assert bool(jnp.all(jnp.isfinite(y))), name
+
+    def test_known_values(self):
+        x = jnp.array([-1.0, 0.0, 2.0])
+        np.testing.assert_allclose(activations.get("relu")(x), [0, 0, 2])
+        np.testing.assert_allclose(activations.get("hardtanh")(x), [-1, 0, 1])
+        np.testing.assert_allclose(
+            activations.get("sigmoid")(jnp.array([0.0])), [0.5], atol=1e-6
+        )
+        sm = activations.get("softmax")(jnp.array([[1.0, 1.0, 1.0]]))
+        np.testing.assert_allclose(sm, [[1 / 3] * 3], atol=1e-6)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            activations.get("nope")
+
+
+class TestInitializers:
+    def test_xavier_stats(self, rng):
+        w = initializers.init_weights(rng, (200, 300), 200, 300, "xavier")
+        assert abs(float(w.mean())) < 0.01
+        expected_std = np.sqrt(2.0 / 500)
+        assert abs(float(w.std()) - expected_std) < 0.005
+
+    def test_relu_uniform_bounds(self, rng):
+        w = initializers.init_weights(rng, (100, 100), 100, 100, "relu_uniform")
+        lim = np.sqrt(6.0 / 100)
+        assert float(w.min()) >= -lim and float(w.max()) <= lim
+
+    def test_zero_ones_identity(self, rng):
+        assert float(initializers.init_weights(rng, (3, 3), 3, 3, "zero").sum()) == 0
+        assert float(initializers.init_weights(rng, (3, 3), 3, 3, "ones").sum()) == 9
+        np.testing.assert_allclose(
+            initializers.init_weights(rng, (3, 3), 3, 3, "identity"), np.eye(3)
+        )
+
+    def test_distribution(self, rng):
+        d = Distribution("normal", mean=5.0, std=0.1)
+        w = initializers.init_weights(rng, (1000,), 1, 1, "distribution", distribution=d)
+        assert abs(float(w.mean()) - 5.0) < 0.05
+        rt = Distribution.from_dict(d.to_dict())
+        assert rt == d
+
+    def test_orthogonal(self, rng):
+        w = initializers.init_weights(rng, (16, 16), 16, 16, "orthogonal")
+        np.testing.assert_allclose(w.T @ w, np.eye(16), atol=1e-2)
+
+
+class TestLosses:
+    def test_mcxent_matches_manual(self):
+        logits = jnp.array([[2.0, 1.0, 0.1], [0.5, 2.5, -1.0]])
+        labels = jnp.array([[1.0, 0, 0], [0, 1.0, 0]])
+        per = losses.get("mcxent")(labels, logits, "softmax")
+        p = jax.nn.softmax(logits, axis=-1)
+        expected = -np.log(np.asarray(p)[[0, 1], [0, 1]])
+        np.testing.assert_allclose(per, expected, rtol=1e-4)
+
+    def test_sparse_mcxent_equals_dense(self):
+        logits = jnp.array([[2.0, 1.0, 0.1], [0.5, 2.5, -1.0]])
+        dense = jnp.array([[1.0, 0, 0], [0, 1.0, 0]])
+        sparse = jnp.array([0, 1])
+        np.testing.assert_allclose(
+            losses.get("mcxent")(dense, logits, "softmax"),
+            losses.get("sparse_mcxent")(sparse, logits, "softmax"),
+            rtol=1e-6,
+        )
+
+    def test_xent_stable_from_logits(self):
+        logits = jnp.array([[100.0, -100.0]])
+        labels = jnp.array([[1.0, 0.0]])
+        per = losses.get("xent")(labels, logits, "sigmoid")
+        assert bool(jnp.isfinite(per).all())
+        np.testing.assert_allclose(per, [0.0], atol=1e-3)
+
+    def test_mse(self):
+        out = jnp.array([[1.0, 2.0]])
+        lab = jnp.array([[0.0, 0.0]])
+        np.testing.assert_allclose(
+            losses.get("mse")(lab, out, "identity"), [(1 + 4) / 2], rtol=1e-6
+        )
+
+    def test_mask_zeroes_contributions(self):
+        logits = jnp.array([[2.0, 1.0], [3.0, -1.0]])
+        labels = jnp.array([[1.0, 0.0], [0.0, 1.0]])
+        mask = jnp.array([[1.0], [0.0]])
+        per = losses.get("mcxent")(labels, logits, "softmax", mask)
+        assert float(per[1]) == 0.0 and float(per[0]) > 0.0
+
+    def test_hinge_and_poisson_finite(self):
+        y = jnp.array([[1.0, -1.0]])
+        o = jnp.array([[0.3, 0.4]])
+        assert float(losses.get("hinge")(y, o, "identity")[0]) > 0
+        lab = jnp.array([[2.0]])
+        out = jnp.array([[1.5]])
+        assert np.isfinite(float(losses.get("poisson")(lab, out, "identity")[0]))
+
+
+class TestSchedules:
+    def test_fixed(self):
+        s = schedules.FixedSchedule(0.1)
+        assert float(s.value_at(0, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(1000, 5)) == pytest.approx(0.1)
+
+    def test_exponential(self):
+        s = schedules.ExponentialSchedule("iteration", 1.0, 0.5)
+        assert float(s.value_at(3, 0)) == pytest.approx(0.125)
+
+    def test_step(self):
+        s = schedules.StepSchedule("iteration", 1.0, 0.1, 10)
+        assert float(s.value_at(9, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(10, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(25, 0)) == pytest.approx(0.01, rel=1e-4)
+
+    def test_map_schedule(self):
+        s = schedules.MapSchedule("epoch", {0: 0.1, 5: 0.01, 10: 0.001})
+        assert float(s.value_at(0, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(0, 4)) == pytest.approx(0.1)
+        assert float(s.value_at(0, 5)) == pytest.approx(0.01)
+        assert float(s.value_at(0, 99)) == pytest.approx(0.001)
+
+    def test_poly(self):
+        s = schedules.PolySchedule("iteration", 1.0, 2.0, 100)
+        assert float(s.value_at(0, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(50, 0)) == pytest.approx(0.25)
+        assert float(s.value_at(100, 0)) == pytest.approx(0.0)
+
+    def test_serde_roundtrip(self):
+        for s in [
+            schedules.FixedSchedule(0.3),
+            schedules.ExponentialSchedule("epoch", 1.0, 0.9),
+            schedules.MapSchedule("iteration", {0: 1.0, 3: 0.5}),
+            schedules.StepSchedule("iteration", 1.0, 0.5, 7),
+        ]:
+            rt = schedules.Schedule.from_dict(s.to_dict())
+            assert rt == s
+
+    def test_traceable_under_jit(self):
+        s = schedules.StepSchedule("iteration", 1.0, 0.1, 10)
+
+        @jax.jit
+        def f(it):
+            return s.value_at(it, jnp.asarray(0))
+
+        assert float(f(jnp.asarray(15))) == pytest.approx(0.1)
+
+
+def _run_updater(u, grad, steps=3, param_shape=None):
+    param_shape = param_shape or grad.shape
+    state = u.init_state(jnp.zeros(param_shape))
+    upd = None
+    for t in range(1, steps + 1):
+        upd, state = u.apply(grad, state, jnp.asarray(t), jnp.asarray(t - 1), jnp.asarray(0))
+    return upd, state
+
+
+class TestUpdaters:
+    def test_sgd(self):
+        g = jnp.array([1.0, -2.0])
+        upd, _ = _run_updater(updaters.Sgd(0.5), g, steps=1)
+        np.testing.assert_allclose(upd, [0.5, -1.0])
+
+    def test_adam_first_step_magnitude(self):
+        # After one Adam step, update ≈ lr * sign(g) (bias-corrected).
+        g = jnp.array([0.3, -0.7, 1.5])
+        upd, _ = _run_updater(updaters.Adam(0.001), g, steps=1)
+        np.testing.assert_allclose(jnp.abs(upd), [0.001] * 3, rtol=1e-3)
+        np.testing.assert_allclose(jnp.sign(upd), jnp.sign(g))
+
+    def test_nesterov_momentum_accumulates(self):
+        g = jnp.array([1.0])
+        u1, _ = _run_updater(updaters.Nesterovs(0.1, momentum=0.9), g, steps=1)
+        u5, _ = _run_updater(updaters.Nesterovs(0.1, momentum=0.9), g, steps=5)
+        assert float(u5[0]) > float(u1[0]) > 0
+
+    def test_adagrad_decreases_effective_lr(self):
+        g = jnp.array([1.0])
+        u1, _ = _run_updater(updaters.AdaGrad(0.1), g, steps=1)
+        u10, _ = _run_updater(updaters.AdaGrad(0.1), g, steps=10)
+        assert float(u10[0]) < float(u1[0])
+
+    def test_adadelta_no_lr(self):
+        u = updaters.AdaDelta()
+        assert not u.has_learning_rate
+        g = jnp.array([0.5])
+        upd, st = _run_updater(u, g, steps=2)
+        assert np.isfinite(float(upd[0]))
+        assert set(st) == {"msg", "msdx"}
+
+    def test_noop_passthrough(self):
+        g = jnp.array([3.0])
+        upd, _ = _run_updater(updaters.NoOp(), g, steps=1)
+        np.testing.assert_allclose(upd, g)
+
+    def test_all_updaters_descend_quadratic(self):
+        # Minimise f(x) = x² from x=5 — every updater must reduce |x|.
+        for name in ["sgd", "adam", "adamax", "nadam", "amsgrad", "adagrad",
+                     "adadelta", "rmsprop", "nesterovs"]:
+            u = updaters.get(name)
+            x = jnp.array([5.0])
+            state = u.init_state(x)
+            for t in range(1, 201):
+                grad = 2 * x
+                upd, state = u.apply(grad, state, jnp.asarray(t), jnp.asarray(t - 1), jnp.asarray(0))
+                x = x - upd
+            assert abs(float(x[0])) < 5.0, name
+
+    def test_serde_roundtrip(self):
+        for u in [
+            updaters.Adam(0.01, beta1=0.8),
+            updaters.Nesterovs(0.1, momentum=schedules.StepSchedule("epoch", 0.9, 0.99, 2)),
+            updaters.AdaDelta(rho=0.9),
+            updaters.Sgd(schedules.ExponentialSchedule("iteration", 0.1, 0.999)),
+        ]:
+            rt = updaters.Updater.from_dict(u.to_dict())
+            assert rt == u
+
+    def test_lr_schedule_inside_updater(self):
+        u = updaters.Sgd(schedules.StepSchedule("iteration", 1.0, 0.1, 10))
+        g = jnp.array([1.0])
+        upd0, _ = u.apply(g, {}, jnp.asarray(1), jnp.asarray(0), jnp.asarray(0))
+        upd15, _ = u.apply(g, {}, jnp.asarray(16), jnp.asarray(15), jnp.asarray(0))
+        assert float(upd0[0]) == pytest.approx(1.0)
+        assert float(upd15[0]) == pytest.approx(0.1)
+
+
+class TestRegularization:
+    def test_l2_grad_term(self):
+        r = RegularizationConf(l2=0.1)
+        p = jnp.array([2.0, -4.0])
+        np.testing.assert_allclose(r.grad_term("W", p), [0.2, -0.4], rtol=1e-6)
+        assert r.grad_term("b", p) is None
+
+    def test_l1_score(self):
+        r = RegularizationConf(l1=0.5)
+        p = jnp.array([1.0, -3.0])
+        assert float(r.score_term("W", p)) == pytest.approx(2.0)
+
+    def test_clip_elementwise(self):
+        g = {"W": jnp.array([5.0, -0.5])}
+        out = normalize_layer_gradients(g, "clip_element_wise_absolute_value", 1.0)
+        np.testing.assert_allclose(out["W"], [1.0, -0.5])
+
+    def test_clip_l2_per_layer(self):
+        g = {"W": jnp.array([3.0, 4.0])}  # norm 5
+        out = normalize_layer_gradients(g, "clip_l2_per_layer", 1.0)
+        np.testing.assert_allclose(
+            np.sqrt(np.sum(np.asarray(out["W"]) ** 2)), 1.0, rtol=1e-4
+        )
+        # below threshold: unchanged
+        g2 = {"W": jnp.array([0.3, 0.4])}
+        out2 = normalize_layer_gradients(g2, "clip_l2_per_layer", 1.0)
+        np.testing.assert_allclose(out2["W"], g2["W"], rtol=1e-5)
+
+    def test_renormalize_per_param_type(self):
+        g = {"W": jnp.array([3.0, 4.0]), "b": jnp.array([0.0, 2.0])}
+        out = normalize_layer_gradients(g, "renormalize_l2_per_param_type")
+        np.testing.assert_allclose(np.linalg.norm(out["W"]), 1.0, rtol=1e-4)
+        np.testing.assert_allclose(np.linalg.norm(out["b"]), 1.0, rtol=1e-4)
+
+    def test_constraints(self):
+        w = jnp.array([[3.0, 0.1], [4.0, 0.1]])  # col norms: 5, ~0.14
+        c = MaxNormConstraint(1.0)
+        out = c.apply(w)
+        norms = np.linalg.norm(np.asarray(out), axis=0)
+        assert norms[0] == pytest.approx(1.0, rel=1e-4)
+        assert norms[1] == pytest.approx(np.linalg.norm([0.1, 0.1]), rel=1e-3)
+        np.testing.assert_allclose(
+            NonNegativeConstraint().apply(jnp.array([-1.0, 2.0])), [0.0, 2.0]
+        )
+        u = UnitNormConstraint().apply(w)
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(u), axis=0), [1, 1], rtol=1e-4)
