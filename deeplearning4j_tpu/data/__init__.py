@@ -1,0 +1,24 @@
+"""Data pipeline: DataSet containers, iterators, fetchers.
+
+Reference modules: deeplearning4j-data/* (SURVEY.md §2.2).
+"""
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    BenchmarkDataSetIterator,
+    DataSetIterator,
+    EarlyTerminationDataSetIterator,
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+    TestDataSetIterator,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "ExistingDataSetIterator", "AsyncDataSetIterator", "BenchmarkDataSetIterator",
+    "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
+    "SamplingDataSetIterator", "TestDataSetIterator",
+]

@@ -1,0 +1,105 @@
+"""DataSet / MultiDataSet containers.
+
+Reference: nd4j ``DataSet`` (features, labels, featuresMask, labelsMask) and
+``MultiDataSet`` as consumed by every fit loop. Host-side arrays are numpy;
+they cross to device inside the jitted step (single transfer per batch).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(
+        self,
+        features: np.ndarray,
+        labels: Optional[np.ndarray] = None,
+        features_mask: Optional[np.ndarray] = None,
+        labels_mask: Optional[np.ndarray] = None,
+    ):
+        self.features = np.asarray(features)
+        self.labels = None if labels is None else np.asarray(labels)
+        self.features_mask = None if features_mask is None else np.asarray(features_mask)
+        self.labels_mask = None if labels_mask is None else np.asarray(labels_mask)
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
+        def cut(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        n = self.num_examples()
+        return (
+            DataSet(self.features[:n_train], cut(self.labels, 0, n_train),
+                    cut(self.features_mask, 0, n_train), cut(self.labels_mask, 0, n_train)),
+            DataSet(self.features[n_train:], cut(self.labels, n_train, n),
+                    cut(self.features_mask, n_train, n), cut(self.labels_mask, n_train, n)),
+        )
+
+    def shuffle(self, seed: Optional[int] = None) -> None:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = self.features[idx]
+        if self.labels is not None:
+            self.labels = self.labels[idx]
+        if self.features_mask is not None:
+            self.features_mask = self.features_mask[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = self.labels_mask[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for lo in range(0, n, batch_size):
+            hi = min(lo + batch_size, n)
+            out.append(
+                DataSet(
+                    self.features[lo:hi],
+                    None if self.labels is None else self.labels[lo:hi],
+                    None if self.features_mask is None else self.features_mask[lo:hi],
+                    None if self.labels_mask is None else self.labels_mask[lo:hi],
+                )
+            )
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        def cat(xs):
+            if any(x is None for x in xs):
+                return None
+            return np.concatenate(xs, axis=0)
+
+        return DataSet(
+            cat([d.features for d in datasets]),
+            cat([d.labels for d in datasets]),
+            cat([d.features_mask for d in datasets]),
+            cat([d.labels_mask for d in datasets]),
+        )
+
+    def __repr__(self):
+        ls = None if self.labels is None else self.labels.shape
+        return f"DataSet(features={self.features.shape}, labels={ls})"
+
+
+class MultiDataSet:
+    """Multiple feature/label arrays (reference nd4j MultiDataSet), for
+    ComputationGraph multi-input/multi-output training."""
+
+    def __init__(
+        self,
+        features: Sequence[np.ndarray],
+        labels: Sequence[np.ndarray],
+        features_masks: Optional[Sequence[Optional[np.ndarray]]] = None,
+        labels_masks: Optional[Sequence[Optional[np.ndarray]]] = None,
+    ):
+        self.features = [np.asarray(f) for f in features]
+        self.labels = [np.asarray(l) for l in labels]
+        self.features_masks = list(features_masks) if features_masks else [None] * len(self.features)
+        self.labels_masks = list(labels_masks) if labels_masks else [None] * len(self.labels)
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,369 @@
+"""DataSetIterator protocol + combinators.
+
+Reference: ``deeplearning4j-data/deeplearning4j-utility-iterators`` —
+AsyncDataSetIterator (background prefetch, ``datasets/iterator/
+AsyncDataSetIterator.java``), BenchmarkDataSetIterator (synthetic replayed
+batch, ``BenchmarkDataSetIterator.java:20``), EarlyTermination,ExistingData,
+MultipleEpochs, Sampling, TestDataSetIterator mock (SURVEY.md §4 mocks).
+
+The protocol mirrors the reference's: ``has_next()/next()/reset()`` plus
+metadata. Python iteration (``__iter__``) is also supported everywhere.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base protocol (reference nd4j ``DataSetIterator``)."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        """Configured minibatch size (0 if unknown)."""
+        return 0
+
+    def reset_supported(self) -> bool:
+        return True
+
+    def async_supported(self) -> bool:
+        return True
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self_has = self.has_next
+        while self_has():
+            yield self.next()
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a host DataSet in minibatches (reference
+    ``ListDataSetIterator``)."""
+
+    def __init__(self, data: DataSet, batch_size: int = 32, drop_last: bool = False):
+        self._data = data
+        self._batch = int(batch_size)
+        self._drop_last = drop_last
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        remaining = self._data.num_examples() - self._pos
+        if remaining <= 0:
+            return False
+        if self._drop_last and remaining < self._batch:
+            return False
+        return True
+
+    def next(self) -> DataSet:
+        lo = self._pos
+        hi = min(lo + self._batch, self._data.num_examples())
+        self._pos = hi
+
+        def cut(a):
+            return None if a is None else a[lo:hi]
+
+        return DataSet(
+            self._data.features[lo:hi], cut(self._data.labels),
+            cut(self._data.features_mask), cut(self._data.labels_mask),
+        )
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._batch
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap a list of prepared DataSets (reference
+    ``ExistingDataSetIterator``)."""
+
+    def __init__(self, datasets: List[DataSet]):
+        self._ds = list(datasets)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._ds)
+
+    def next(self):
+        d = self._ds[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0
+
+    def batch(self):
+        return self._ds[0].num_examples() if self._ds else 0
+
+
+class TestDataSetIterator(DataSetIterator):
+    """Counting wrapper used by tests (reference
+    ``datasets/test/TestDataSetIterator.java``)."""
+
+    def __init__(self, inner: DataSetIterator):
+        self.inner = inner
+        self.next_count = 0
+        self.reset_count = 0
+
+    def has_next(self):
+        return self.inner.has_next()
+
+    def next(self):
+        self.next_count += 1
+        return self.inner.next()
+
+    def reset(self):
+        self.reset_count += 1
+        self.inner.reset()
+
+    def batch(self):
+        return self.inner.batch()
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches per epoch (reference
+    ``EarlyTerminationDataSetIterator``)."""
+
+    def __init__(self, inner: DataSetIterator, max_batches: int):
+        self.inner = inner
+        self.max_batches = int(max_batches)
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self.max_batches and self.inner.has_next()
+
+    def next(self):
+        self._count += 1
+        return self.inner.next()
+
+    def reset(self):
+        self._count = 0
+        self.inner.reset()
+
+    def batch(self):
+        return self.inner.batch()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the underlying iterator N times (reference
+    ``MultipleEpochsIterator``)."""
+
+    def __init__(self, inner: DataSetIterator, epochs: int):
+        self.inner = inner
+        self.epochs = int(epochs)
+        self._epoch = 0
+
+    def has_next(self):
+        if self.inner.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.inner.reset()
+            return self.inner.has_next()
+        return False
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.inner.next()
+
+    def reset(self):
+        self._epoch = 0
+        self.inner.reset()
+
+    def batch(self):
+        return self.inner.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement sampling from a source DataSet (reference
+    ``SamplingDataSetIterator``)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int, seed: int = 0):
+        self._data = data
+        self._batch = int(batch_size)
+        self._total = int(total_batches)
+        self._seed = seed
+        self._rng = np.random.default_rng(seed)
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        idx = self._rng.integers(0, self._data.num_examples(), size=self._batch)
+        self._count += 1
+
+        def cut(a):
+            return None if a is None else a[idx]
+
+        return DataSet(self._data.features[idx], cut(self._data.labels),
+                       cut(self._data.features_mask), cut(self._data.labels_mask))
+
+    def reset(self):
+        self._count = 0
+        self._rng = np.random.default_rng(self._seed)
+
+    def batch(self):
+        return self._batch
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Replays one synthetic batch N times: pure-compute throughput
+    measurement with zero ETL (reference
+    ``BenchmarkDataSetIterator.java:20``)."""
+
+    def __init__(self, example: DataSet, total_batches: int):
+        self._example = example
+        self._total = int(total_batches)
+        self._count = 0
+
+    @staticmethod
+    def from_shapes(feature_shape, label_shape, total_batches: int, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        f = rng.standard_normal(feature_shape).astype(np.float32)
+        n_classes = label_shape[-1]
+        cls = rng.integers(0, n_classes, size=label_shape[:-1])
+        l = np.eye(n_classes, dtype=np.float32)[cls]
+        return BenchmarkDataSetIterator(DataSet(f, l), total_batches)
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        self._count += 1
+        return self._example
+
+    def reset(self):
+        self._count = 0
+
+    def batch(self):
+        return self._example.num_examples()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference
+    ``AsyncDataSetIterator.java``: the fit loop wraps iterators in this,
+    ``MultiLayerNetwork.java:1273``). Queue depth = ``queue_size``.
+
+    Host ETL overlaps device compute: while the jitted step runs
+    asynchronously on the TPU, the worker thread prepares the next batches.
+    """
+
+    _END = object()
+
+    def __init__(self, inner: DataSetIterator, queue_size: int = 4):
+        self.inner = inner
+        self.queue_size = int(queue_size)
+        self._queue: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        self._thread: Optional[threading.Thread] = None
+        self._peek = None
+        self._exc: Optional[BaseException] = None
+        self._start()
+
+    def _start(self):
+        def work():
+            try:
+                while self.inner.has_next():
+                    self._queue.put(self.inner.next())
+            except BaseException as e:  # surfaced on next()
+                self._exc = e
+            finally:
+                self._queue.put(self._END)
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def has_next(self):
+        if self._peek is None:
+            self._peek = self._queue.get()
+        if self._peek is self._END and self._exc is not None:
+            # surface worker-thread failures instead of ending the epoch early
+            exc, self._exc = self._exc, None
+            raise exc
+        return self._peek is not self._END
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        d = self._peek
+        self._peek = None
+        return d
+
+    def shutdown(self):
+        """Drain + join the prefetch thread WITHOUT restarting or touching
+        the inner iterator (epoch teardown; the caller owns inner.reset())."""
+        if self._thread is not None:
+            while self._peek is not self._END:
+                self._peek = self._queue.get()
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def reset(self):
+        self.shutdown()
+        self.inner.reset()
+        self._peek = None
+        self._queue = queue.Queue(maxsize=self.queue_size)
+        self._start()
+
+    def batch(self):
+        return self.inner.batch()
+
+
+class GeneratorDataSetIterator(DataSetIterator):
+    """Wrap a factory producing a fresh python generator per epoch."""
+
+    def __init__(self, factory: Callable[[], Iterable[DataSet]], batch_size: int = 0):
+        self._factory = factory
+        self._batch = batch_size
+        self._gen = iter(factory())
+        self._peek = None
+        self._done = False
+
+    def has_next(self):
+        if self._done:
+            return False
+        if self._peek is None:
+            try:
+                self._peek = next(self._gen)
+            except StopIteration:
+                self._done = True
+                return False
+        return True
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        d = self._peek
+        self._peek = None
+        return d
+
+    def reset(self):
+        self._gen = iter(self._factory())
+        self._peek = None
+        self._done = False
+
+    def batch(self):
+        return self._batch

@@ -1,0 +1,161 @@
+"""Data normalizers.
+
+Reference: nd4j ``NormalizerStandardize`` / ``NormalizerMinMaxScaler`` /
+``ImagePreProcessingScaler`` as used throughout the reference's fit loops
+and persisted into model zips (``ModelSerializer`` optional normalizer
+entry, ``util/ModelSerializer.java:109-125``).
+
+Protocol: ``fit(iterator_or_dataset)`` accumulates statistics,
+``transform(dataset)`` normalizes in place, ``revert`` undoes. All are
+JSON-serializable for the checkpoint entry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class Normalizer:
+    def fit(self, data) -> None:
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def revert(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        return self.transform(ds)
+
+    def to_dict(self) -> dict:
+        d = {"@class": type(self).__name__}
+        for k, v in self.__dict__.items():
+            d[k] = v.tolist() if isinstance(v, np.ndarray) else v
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Normalizer":
+        d = dict(d)
+        cls = _NORMALIZERS[d.pop("@class")]
+        obj = cls.__new__(cls)
+        for k, v in d.items():
+            setattr(obj, k, np.asarray(v, np.float64) if isinstance(v, list) else v)
+        return obj
+
+    def _iter_features(self, data):
+        if isinstance(data, DataSet):
+            yield data.features
+        else:
+            for ds in data:
+                yield ds.features
+            data.reset()
+
+
+class NormalizerStandardize(Normalizer):
+    """Zero-mean unit-variance per feature (reference
+    ``NormalizerStandardize``); Welford-style streaming accumulation."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        n, s, ss = 0, None, None
+        for f in self._iter_features(data):
+            f2 = f.reshape(f.shape[0], -1).astype(np.float64)
+            if s is None:
+                s = f2.sum(axis=0)
+                ss = (f2**2).sum(axis=0)
+            else:
+                s += f2.sum(axis=0)
+                ss += (f2**2).sum(axis=0)
+            n += f2.shape[0]
+        self.mean = s / n
+        var = np.maximum(ss / n - self.mean**2, 0.0)
+        self.std = np.sqrt(var)
+        self.std[self.std < 1e-12] = 1.0
+
+    def transform(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        f = ds.features.reshape(shape[0], -1)
+        ds.features = ((f - self.mean) / self.std).astype(np.float32).reshape(shape)
+        return ds
+
+    def revert(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        f = ds.features.reshape(shape[0], -1)
+        ds.features = (f * self.std + self.mean).astype(np.float32).reshape(shape)
+        return ds
+
+
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale features into [min_range, max_range] (reference
+    ``NormalizerMinMaxScaler``)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def fit(self, data) -> None:
+        mn, mx = None, None
+        for f in self._iter_features(data):
+            f2 = f.reshape(f.shape[0], -1).astype(np.float64)
+            cur_mn, cur_mx = f2.min(axis=0), f2.max(axis=0)
+            mn = cur_mn if mn is None else np.minimum(mn, cur_mn)
+            mx = cur_mx if mx is None else np.maximum(mx, cur_mx)
+        self.data_min, self.data_max = mn, mx
+
+    def transform(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        f = ds.features.reshape(shape[0], -1)
+        rng = np.where(self.data_max - self.data_min < 1e-12, 1.0, self.data_max - self.data_min)
+        scaled = (f - self.data_min) / rng
+        out = scaled * (self.max_range - self.min_range) + self.min_range
+        ds.features = out.astype(np.float32).reshape(shape)
+        return ds
+
+    def revert(self, ds: DataSet) -> DataSet:
+        shape = ds.features.shape
+        f = ds.features.reshape(shape[0], -1)
+        rng = self.data_max - self.data_min
+        out = (f - self.min_range) / (self.max_range - self.min_range) * rng + self.data_min
+        ds.features = out.astype(np.float32).reshape(shape)
+        return ds
+
+
+class ImagePreProcessingScaler(Normalizer):
+    """Pixel [0, maxPixel] → [min, max] without fitting statistics
+    (reference ``ImagePreProcessingScaler``)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0, max_pixel: float = 255.0):
+        self.min_range = float(min_range)
+        self.max_range = float(max_range)
+        self.max_pixel = float(max_pixel)
+
+    def fit(self, data) -> None:
+        pass  # stateless
+
+    def transform(self, ds: DataSet) -> DataSet:
+        ds.features = (
+            ds.features / self.max_pixel * (self.max_range - self.min_range) + self.min_range
+        ).astype(np.float32)
+        return ds
+
+    def revert(self, ds: DataSet) -> DataSet:
+        ds.features = (
+            (ds.features - self.min_range) / (self.max_range - self.min_range) * self.max_pixel
+        ).astype(np.float32)
+        return ds
+
+
+_NORMALIZERS = {
+    c.__name__: c
+    for c in [NormalizerStandardize, NormalizerMinMaxScaler, ImagePreProcessingScaler]
+}

@@ -1,0 +1,12 @@
+"""Evaluation suite (reference ``deeplearning4j-nn eval/`` — 5,306 LoC)."""
+
+from deeplearning4j_tpu.evaluation.classification import ConfusionMatrix, Evaluation
+from deeplearning4j_tpu.evaluation.binary import EvaluationBinary
+from deeplearning4j_tpu.evaluation.calibration import EvaluationCalibration
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
+
+__all__ = [
+    "Evaluation", "ConfusionMatrix", "RegressionEvaluation", "ROC",
+    "ROCBinary", "ROCMultiClass", "EvaluationBinary", "EvaluationCalibration",
+]

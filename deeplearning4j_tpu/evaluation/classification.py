@@ -1,0 +1,166 @@
+"""Classification evaluation: accuracy/precision/recall/F1, confusion
+matrix, top-N accuracy — merge-able for distributed eval.
+
+Reference: ``eval/Evaluation.java`` (1,774 LoC), ``eval/ConfusionMatrix.java``.
+Accumulation is a (numClasses × numClasses) count matrix, so ``merge()`` is
+a sum — the property the reference relies on for distributed evaluation
+(``IEvaluateFlatMapFunction``) and we rely on for multi-host eval.
+
+Sequence labels (b, T, C) are flattened over time with the label mask
+applied, matching reference time-series evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: np.ndarray, predicted: np.ndarray) -> None:
+        np.add.at(self.matrix, (actual, predicted), 1)
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def merge(self, other: "ConfusionMatrix") -> None:
+        self.matrix += other.matrix
+
+    def __str__(self):
+        return str(self.matrix)
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[Sequence[str]] = None, top_n: int = 1):
+        self.num_classes = num_classes
+        self.label_names = list(labels) if labels else None
+        self.top_n = int(top_n)
+        self.confusion: Optional[ConfusionMatrix] = None
+        self.top_n_correct = 0
+        self.top_n_total = 0
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # (b, T, C) time series → flatten with mask
+            b, t, c = labels.shape
+            labels = labels.reshape(b * t, c)
+            predictions = predictions.reshape(b * t, c)
+            if mask is not None:
+                m = np.asarray(mask).reshape(b * t).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        elif mask is not None:
+            m = np.asarray(mask).reshape(-1).astype(bool)
+            labels, predictions = labels[m], predictions[m]
+        if labels.ndim == 2 and labels.shape[1] > 1:
+            actual = np.argmax(labels, axis=1)
+        else:
+            actual = labels.reshape(-1).astype(np.int64)
+        if predictions.ndim == 2 and predictions.shape[1] == 1:
+            # single sigmoid output: threshold at 0.5 (reference Evaluation
+            # single-column handling), confusion matrix is 2x2
+            pred_cls = (predictions[:, 0] >= 0.5).astype(np.int64)
+            self._ensure(2)
+        else:
+            pred_cls = np.argmax(predictions, axis=1)
+            self._ensure(predictions.shape[1])
+        self.confusion.add(actual, pred_cls)
+        if self.top_n > 1:
+            top = np.argsort(-predictions, axis=1)[:, : self.top_n]
+            self.top_n_correct += int(np.sum(top == actual[:, None]))
+            self.top_n_total += len(actual)
+
+    # -- metrics (reference Evaluation getters) -------------------------------
+    def _m(self) -> np.ndarray:
+        if self.confusion is None:
+            raise ValueError("No data evaluated")
+        return self.confusion.matrix
+
+    def accuracy(self) -> float:
+        m = self._m()
+        tot = m.sum()
+        return float(np.trace(m) / tot) if tot else 0.0
+
+    def top_n_accuracy(self) -> float:
+        if self.top_n_total == 0:
+            return self.accuracy()
+        return self.top_n_correct / self.top_n_total
+
+    def true_positives(self) -> np.ndarray:
+        return np.diag(self._m())
+
+    def false_positives(self) -> np.ndarray:
+        m = self._m()
+        return m.sum(axis=0) - np.diag(m)
+
+    def false_negatives(self) -> np.ndarray:
+        m = self._m()
+        return m.sum(axis=1) - np.diag(m)
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        tp, fp = self.true_positives(), self.false_positives()
+        if cls is not None:
+            d = tp[cls] + fp[cls]
+            return float(tp[cls] / d) if d else 0.0
+        # macro-average over classes that appear (reference default)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(tp + fp > 0, tp / (tp + fp), np.nan)
+        valid = ~np.isnan(per)
+        return float(np.nanmean(per)) if valid.any() else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        tp, fn = self.true_positives(), self.false_negatives()
+        if cls is not None:
+            d = tp[cls] + fn[cls]
+            return float(tp[cls] / d) if d else 0.0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            per = np.where(tp + fn > 0, tp / (tp + fn), np.nan)
+        valid = ~np.isnan(per)
+        return float(np.nanmean(per)) if valid.any() else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def merge(self, other: "Evaluation") -> None:
+        if other.confusion is None:
+            return
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.merge(other.confusion)
+        self.top_n_correct += other.top_n_correct
+        self.top_n_total += other.top_n_total
+
+    def stats(self) -> str:
+        m = self._m()
+        n = m.shape[0]
+        names = self.label_names or [str(i) for i in range(n)]
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {n}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+        ]
+        if self.top_n > 1:
+            lines.append(f" Top-{self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("=========================Confusion Matrix=========================")
+        header = "     " + " ".join(f"{i:>6}" for i in range(n))
+        lines.append(header)
+        for i in range(n):
+            lines.append(f"{names[i]:>4} " + " ".join(f"{m[i, j]:>6}" for j in range(n)))
+        return "\n".join(lines)

@@ -1,0 +1,1 @@
+"""Neural network configuration + runtime (the deeplearning4j-nn equivalent)."""

@@ -1,0 +1,9 @@
+"""Configuration system: InputType, layer configs, preprocessors, builders."""
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.builders import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+
+__all__ = ["InputType", "MultiLayerConfiguration", "NeuralNetConfiguration"]

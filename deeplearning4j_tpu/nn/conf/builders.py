@@ -1,0 +1,370 @@
+"""NeuralNetConfiguration builder → MultiLayerConfiguration.
+
+Reference: ``nn/conf/NeuralNetConfiguration.java:584`` (Builder), ``:744``
+(``list()``), ``nn/conf/MultiLayerConfiguration.java`` — the fluent,
+JSON-serializable configuration surface. Global hyperparameters set on the
+builder (updater, weight init, activation, l1/l2, gradient normalization,
+seed) propagate into layers that don't override them, exactly like the
+reference's builder-clone semantics.
+
+``set_input_type`` runs the reference's shape-inference pass: walks the
+layer list, auto-inserts input preprocessors between layer families
+(``InputTypeUtil`` behavior), and fills each layer's ``nIn``.
+
+Deviation (TPU-first): dense/activation layers applied to recurrent input
+operate per-timestep *without* Rnn↔FF preprocessors — XLA treats the time
+axis as a free batch dim, so the reshape round-trip the reference needs is
+pure overhead here.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Union
+
+from deeplearning4j_tpu import updaters as _upd
+from deeplearning4j_tpu.initializers import Distribution
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import GlobalConf, Layer
+from deeplearning4j_tpu.nn.conf.layers.conv import (
+    BaseConvLayer,
+    Convolution1DLayer,
+    SubsamplingLayer,
+    Upsampling2D,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor,
+    FeedForwardToCnnPreProcessor,
+    InputPreProcessor,
+    RnnToFeedForwardPreProcessor,
+)
+from deeplearning4j_tpu.regularization import RegularizationConf
+from deeplearning4j_tpu.schedules import Schedule
+from deeplearning4j_tpu.updaters import Updater
+
+CONF_FORMAT_VERSION = 1
+
+
+def _needs_cnn_input(layer: Layer) -> bool:
+    from deeplearning4j_tpu.nn.conf.layers.conv import (
+        Cropping2D,
+        SpaceToBatchLayer,
+        SpaceToDepthLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.layers.norm import LocalResponseNormalization
+
+    cnn_types = (
+        SubsamplingLayer,
+        Upsampling2D,
+        ZeroPaddingLayer,
+        Cropping2D,
+        SpaceToBatchLayer,
+        SpaceToDepthLayer,
+        LocalResponseNormalization,
+    )
+    if isinstance(layer, Convolution1DLayer):
+        return False
+    if isinstance(layer, BaseConvLayer):
+        return True
+    return isinstance(layer, cnn_types)
+
+
+def _needs_ff_input(layer: Layer) -> bool:
+    from deeplearning4j_tpu.nn.conf.layers.core import (
+        AutoEncoder,
+        BaseOutputLayer,
+        DenseLayer,
+        ElementWiseMultiplicationLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
+
+    return isinstance(
+        layer,
+        (DenseLayer, BaseOutputLayer, AutoEncoder, ElementWiseMultiplicationLayer,
+         CenterLossOutputLayer),
+    )
+
+
+def infer_preprocessor(input_type: InputType, layer: Layer) -> Optional[InputPreProcessor]:
+    """Auto preprocessor insertion (reference ``InputTypeUtil`` /
+    ``MultiLayerConfiguration.setInputType``)."""
+    kind = input_type.kind
+    if _needs_cnn_input(layer):
+        if kind == "convolutional_flat":
+            return FeedForwardToCnnPreProcessor(
+                input_type.height, input_type.width, input_type.channels
+            )
+        if kind == "feedforward":
+            raise ValueError(
+                f"Cannot feed feedforward input into CNN layer {layer}; "
+                "set an explicit preprocessor or input type"
+            )
+        return None
+    if _needs_ff_input(layer):
+        if kind == "convolutional":
+            return CnnToFeedForwardPreProcessor(
+                input_type.height, input_type.width, input_type.channels
+            )
+        if kind == "recurrent":
+            from deeplearning4j_tpu.nn.conf.layers.core import BaseOutputLayer
+
+            if isinstance(layer, BaseOutputLayer):
+                raise ValueError(
+                    "Recurrent input into OutputLayer: use RnnOutputLayer, "
+                    "LastTimeStep, or a GlobalPoolingLayer first"
+                )
+            return None  # dense-per-timestep, no preprocessor needed
+        return None
+    if isinstance(layer, (BaseRecurrentLayer, Convolution1DLayer)) or layer.is_recurrent:
+        if kind == "feedforward":
+            raise ValueError(
+                f"Cannot feed feedforward input into recurrent layer {layer}"
+            )
+        return None
+    return None
+
+
+@serde.register
+class MultiLayerConfiguration:
+    """Immutable network configuration (reference
+    ``nn/conf/MultiLayerConfiguration.java``)."""
+
+    def __init__(
+        self,
+        global_conf: GlobalConf,
+        layers: List[Layer],
+        preprocessors: Optional[Dict[int, InputPreProcessor]] = None,
+        input_type: Optional[InputType] = None,
+        backprop_type: str = "standard",
+        tbptt_fwd_length: int = 20,
+        tbptt_back_length: int = 20,
+    ):
+        self.global_conf = global_conf
+        self.layers = layers
+        self.preprocessors = dict(preprocessors or {})
+        self.input_type = input_type
+        self.backprop_type = backprop_type
+        self.tbptt_fwd_length = int(tbptt_fwd_length)
+        self.tbptt_back_length = int(tbptt_back_length)
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "@class": "MultiLayerConfiguration",
+            "format_version": CONF_FORMAT_VERSION,
+            "global_conf": serde.encode(self.global_conf),
+            "layers": [serde.encode(l) for l in self.layers],
+            "preprocessors": {str(k): serde.encode(v) for k, v in self.preprocessors.items()},
+            "input_type": None if self.input_type is None else self.input_type.to_dict(),
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "MultiLayerConfiguration":
+        return cls(
+            global_conf=serde.decode(d["global_conf"]),
+            layers=[serde.decode(l) for l in d["layers"]],
+            preprocessors={int(k): serde.decode(v) for k, v in d.get("preprocessors", {}).items()},
+            input_type=None if d.get("input_type") is None else InputType.from_dict(d["input_type"]),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "MultiLayerConfiguration":
+        return cls.from_dict(json.loads(s))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, MultiLayerConfiguration)
+            and self.to_dict() == other.to_dict()
+        )
+
+    # -- helpers -------------------------------------------------------------
+    def layer_types(self) -> List[InputType]:
+        """Input type seen by each layer (post-preprocessor), plus final output
+        type at the end; requires input_type set."""
+        if self.input_type is None:
+            raise ValueError("input_type not set")
+        types = []
+        ct = self.input_type
+        for i, layer in enumerate(self.layers):
+            if i in self.preprocessors:
+                ct = self.preprocessors[i].get_output_type(ct)
+            types.append(ct)
+            ct = layer.get_output_type(ct)
+        types.append(ct)
+        return types
+
+
+class NeuralNetConfiguration:
+    """Fluent builder entry point (reference
+    ``NeuralNetConfiguration.Builder``)."""
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    def __init__(self):
+        self._g = GlobalConf()
+        self._reg_kwargs: Dict[str, float] = {}
+
+    # global hyperparameters --------------------------------------------------
+    def seed(self, s: int) -> "NeuralNetConfiguration":
+        self._g.seed = int(s)
+        return self
+
+    def updater(self, u: Union[str, Updater]) -> "NeuralNetConfiguration":
+        self._g.updater = _upd.get(u)
+        return self
+
+    def weight_init(self, w: Union[str, Distribution]) -> "NeuralNetConfiguration":
+        self._g.weight_init = w
+        return self
+
+    def dist(self, d: Distribution) -> "NeuralNetConfiguration":
+        self._g.distribution = d
+        return self
+
+    def activation(self, a: str) -> "NeuralNetConfiguration":
+        self._g.activation = a
+        return self
+
+    def bias_init(self, b: float) -> "NeuralNetConfiguration":
+        self._g.bias_init = float(b)
+        return self
+
+    def l1(self, v: float) -> "NeuralNetConfiguration":
+        self._reg_kwargs["l1"] = float(v)
+        return self
+
+    def l2(self, v: float) -> "NeuralNetConfiguration":
+        self._reg_kwargs["l2"] = float(v)
+        return self
+
+    def l1_bias(self, v: float) -> "NeuralNetConfiguration":
+        self._reg_kwargs["l1_bias"] = float(v)
+        return self
+
+    def l2_bias(self, v: float) -> "NeuralNetConfiguration":
+        self._reg_kwargs["l2_bias"] = float(v)
+        return self
+
+    def weight_decay(self, v: float) -> "NeuralNetConfiguration":
+        self._reg_kwargs["weight_decay"] = float(v)
+        return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0) -> "NeuralNetConfiguration":
+        self._g.gradient_normalization = mode
+        self._g.gradient_normalization_threshold = float(threshold)
+        return self
+
+    def dtype(self, dt: str) -> "NeuralNetConfiguration":
+        self._g.dtype = dt
+        return self
+
+    # transition to layer list ------------------------------------------------
+    def list(self) -> "ListBuilder":
+        if self._reg_kwargs:
+            self._g.regularization = RegularizationConf(**self._reg_kwargs)
+        return ListBuilder(self._g)
+
+    def graph_builder(self):
+        """ComputationGraph configuration builder (reference ``:777``)."""
+        from deeplearning4j_tpu.nn.conf.graph_builder import GraphBuilder
+
+        if self._reg_kwargs:
+            self._g.regularization = RegularizationConf(**self._reg_kwargs)
+            self._reg_kwargs = {}
+        return GraphBuilder(self._g)
+
+
+class ListBuilder:
+    """(reference ``NeuralNetConfiguration.ListBuilder``)."""
+
+    def __init__(self, global_conf: GlobalConf):
+        self._g = global_conf
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+
+    def layer(self, *args) -> "ListBuilder":
+        """layer(conf) or layer(index, conf)."""
+        if len(args) == 1:
+            self._layers.append(args[0])
+        else:
+            idx, conf = args
+            while len(self._layers) <= idx:
+                self._layers.append(None)  # type: ignore
+            self._layers[idx] = conf
+        return self
+
+    def input_pre_processor(self, idx: int, p: InputPreProcessor) -> "ListBuilder":
+        self._preprocessors[int(idx)] = p
+        return self
+
+    def set_input_type(self, t: InputType) -> "ListBuilder":
+        self._input_type = t
+        return self
+
+    def backprop_type(self, t: str, fwd_length: int = 20, back_length: int = 20) -> "ListBuilder":
+        self._backprop_type = t.lower()
+        self._tbptt_fwd = int(fwd_length)
+        self._tbptt_back = int(back_length)
+        return self
+
+    def tbptt_fwd_length(self, n: int) -> "ListBuilder":
+        self._tbptt_fwd = int(n)
+        return self
+
+    def tbptt_back_length(self, n: int) -> "ListBuilder":
+        self._tbptt_back = int(n)
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        if any(l is None for l in self._layers):
+            raise ValueError("Layer list has gaps")
+        layers = self._layers
+        # propagate defaults
+        for l in layers:
+            l.inherit_defaults(self._g)
+        # shape inference + preprocessor auto-insertion
+        if self._input_type is not None:
+            ct = self._input_type
+            for i, l in enumerate(layers):
+                if i in self._preprocessors:
+                    ct = self._preprocessors[i].get_output_type(ct)
+                else:
+                    p = infer_preprocessor(ct, l)
+                    if p is not None:
+                        self._preprocessors[i] = p
+                        ct = p.get_output_type(ct)
+                l.initialize(ct)
+                ct = l.get_output_type(ct)
+        else:
+            for l in layers:
+                try:
+                    l.initialize(InputType.feed_forward(l.n_in) if getattr(l, "n_in", None) else None)  # type: ignore
+                except Exception:
+                    pass
+        return MultiLayerConfiguration(
+            global_conf=self._g,
+            layers=layers,
+            preprocessors=self._preprocessors,
+            input_type=self._input_type,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+        )

@@ -1,0 +1,18 @@
+"""ComputationGraph configuration builder (reference
+``NeuralNetConfiguration.java:777`` graphBuilder() →
+``ComputationGraphConfiguration.GraphBuilder``).
+
+Implementation lands with the ComputationGraph runtime; until then the
+builder raises a clear error instead of a ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+
+class GraphBuilder:
+    def __init__(self, global_conf):
+        raise NotImplementedError(
+            "ComputationGraph configuration is not implemented yet in this "
+            "build; use NeuralNetConfiguration.builder().list() for "
+            "sequential networks."
+        )

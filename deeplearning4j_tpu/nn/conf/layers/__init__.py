@@ -1,0 +1,73 @@
+"""Layer catalog (reference: ~45 configs under ``nn/conf/layers/``)."""
+
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    FeedForwardLayer,
+    GlobalConf,
+    Layer,
+)
+from deeplearning4j_tpu.nn.conf.layers.core import (
+    ActivationLayer,
+    AutoEncoder,
+    BaseOutputLayer,
+    DenseLayer,
+    DropoutLayer,
+    DummyLayer,
+    ElementWiseMultiplicationLayer,
+    EmbeddingLayer,
+    EmbeddingSequenceLayer,
+    LossLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.conv import (
+    Convolution1DLayer,
+    ConvolutionLayer,
+    Cropping2D,
+    Deconvolution2D,
+    DepthwiseConvolution2D,
+    SeparableConvolution2D,
+    SpaceToBatchLayer,
+    SpaceToDepthLayer,
+    Subsampling1DLayer,
+    SubsamplingLayer,
+    Upsampling1D,
+    Upsampling2D,
+    ZeroPadding1DLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.norm import (
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.conf.layers.pooling import GlobalPoolingLayer, MaskLayer
+from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+    Bidirectional,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LastTimeStep,
+    LSTM,
+    MaskZeroLayer,
+    RnnLossLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.nn.conf.layers.special import (
+    CenterLossOutputLayer,
+    FrozenLayer,
+)
+
+__all__ = [
+    "Layer", "FeedForwardLayer", "GlobalConf",
+    "DenseLayer", "OutputLayer", "BaseOutputLayer", "LossLayer",
+    "ActivationLayer", "DropoutLayer", "DummyLayer", "EmbeddingLayer",
+    "EmbeddingSequenceLayer", "ElementWiseMultiplicationLayer", "AutoEncoder",
+    "ConvolutionLayer", "Convolution1DLayer", "Deconvolution2D",
+    "DepthwiseConvolution2D", "SeparableConvolution2D", "SubsamplingLayer",
+    "Subsampling1DLayer", "Upsampling1D", "Upsampling2D", "ZeroPaddingLayer",
+    "ZeroPadding1DLayer", "Cropping2D", "SpaceToBatchLayer", "SpaceToDepthLayer",
+    "BatchNormalization", "LocalResponseNormalization",
+    "GlobalPoolingLayer", "MaskLayer",
+    "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn",
+    "Bidirectional", "LastTimeStep", "MaskZeroLayer", "RnnOutputLayer",
+    "RnnLossLayer",
+    "FrozenLayer", "CenterLossOutputLayer",
+]

@@ -1,0 +1,258 @@
+"""Layer config/runtime base classes.
+
+The reference splits layer *configuration* (``nn/conf/layers/*``) from layer
+*runtime* (``nn/layers/*``), wiring them via instantiate(). In a functional
+jax design the config object IS the runtime: it is an immutable,
+JSON-serializable hyperparameter holder with pure methods
+
+- ``initialize(input_type)``   — infer nIn etc. (reference setNIn)
+- ``get_output_type(input_type)``
+- ``init_params(rng, input_type, dtype)`` → dict of arrays
+- ``init_layer_state(input_type, dtype)`` → dict (e.g. BN running stats)
+- ``apply(params, x, ...)`` → (y, new_state) traced inside jitted steps
+
+Parameters live in a per-layer dict (e.g. ``{"W": ..., "b": ...}``),
+assembled by the network into one pytree — the functional analog of the
+reference's single flattened param vector (``MultiLayerNetwork.java:584-718``).
+
+Per-layer training hyperparameters mirror ``BaseLayer`` builder fields:
+activation, weightInit/dist, biasInit, updater override, l1/l2(+bias),
+dropout (applied to layer *input*, reference semantics), gradient
+normalization (+threshold), constraints.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import activations as _act
+from deeplearning4j_tpu import initializers as _init
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.regularization import Constraint, RegularizationConf
+from deeplearning4j_tpu.updaters import Updater
+
+Array = jax.Array
+Params = Dict[str, Array]
+LayerState = Dict[str, Array]
+
+# Sentinel: "not set here — inherit the network-level default at build()"
+INHERIT = None
+
+
+class Layer:
+    """Base for all layer configs."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        dropout: float = 0.0,
+        constraints: Optional[Sequence[Constraint]] = None,
+        gradient_normalization: Optional[str] = INHERIT,
+        gradient_normalization_threshold: float = 1.0,
+        updater: Optional[Updater] = INHERIT,
+        regularization: Optional[RegularizationConf] = INHERIT,
+    ):
+        self.name = name
+        self.dropout = float(dropout)
+        self.constraints = list(constraints) if constraints else []
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = float(gradient_normalization_threshold)
+        self.updater = updater
+        self.regularization = regularization
+
+    # -- configuration-time --------------------------------------------------
+    def initialize(self, input_type: InputType) -> None:
+        """Infer unset shape hyperparameters (nIn) from the incoming type."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def inherit_defaults(self, defaults: "GlobalConf") -> None:
+        """Fill INHERIT fields from network-level defaults (reference: the
+        builder clones global conf into each layer)."""
+        if self.updater is INHERIT:
+            self.updater = defaults.updater
+        if self.regularization is INHERIT:
+            self.regularization = defaults.regularization
+        if self.gradient_normalization is INHERIT:
+            self.gradient_normalization = defaults.gradient_normalization
+            self.gradient_normalization_threshold = defaults.gradient_normalization_threshold
+
+    # -- params --------------------------------------------------------------
+    def init_params(self, rng: Array, input_type: InputType, dtype=jnp.float32) -> Params:
+        return {}
+
+    def init_layer_state(self, input_type: InputType, dtype=jnp.float32) -> LayerState:
+        return {}
+
+    def n_params(self, input_type: InputType) -> int:
+        import numpy as np
+
+        rng = jax.random.PRNGKey(0)
+        p = self.init_params(rng, input_type)
+        return int(sum(np.prod(a.shape) for a in p.values()))
+
+    # -- runtime -------------------------------------------------------------
+    def apply(
+        self,
+        params: Params,
+        x: Array,
+        *,
+        state: Optional[LayerState] = None,
+        train: bool = False,
+        rng: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> Tuple[Array, LayerState]:
+        raise NotImplementedError
+
+    # Recurrent-layer extras (overridden by recurrent layers)
+    is_recurrent = False
+
+    # Does this layer consume per-example weights/labels? Output layers override.
+    is_output_layer = False
+    # Pretrainable (AutoEncoder/VAE) layers override.
+    is_pretrain_layer = False
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Layer":
+        actual = serde.lookup(data.get("@class", cls.__name__))
+        return serde.generic_from_dict(actual, data)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and serde.encode(self) == serde.encode(other)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items() if v is not None and v != []}
+        return f"{type(self).__name__}({fields})"
+
+    def clone(self) -> "Layer":
+        return Layer.from_dict(self.to_dict())
+
+
+class GlobalConf:
+    """Network-level defaults propagated into layers (reference
+    ``NeuralNetConfiguration.Builder`` global fields)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        updater: Optional[Updater] = None,
+        weight_init: Union[str, _init.Distribution] = "xavier",
+        distribution: Optional[_init.Distribution] = None,
+        activation: str = "sigmoid",
+        bias_init: float = 0.0,
+        regularization: Optional[RegularizationConf] = None,
+        gradient_normalization: Optional[str] = None,
+        gradient_normalization_threshold: float = 1.0,
+        dtype: str = "float32",
+        mini_batch: bool = True,
+        max_num_line_search_iterations: int = 5,
+        optimization_algo: str = "stochastic_gradient_descent",
+    ):
+        from deeplearning4j_tpu.updaters import Sgd
+
+        self.seed = int(seed)
+        self.updater = updater if updater is not None else Sgd(1e-1)
+        self.weight_init = weight_init
+        self.distribution = distribution
+        self.activation = activation
+        self.bias_init = float(bias_init)
+        self.regularization = regularization if regularization is not None else RegularizationConf()
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = float(gradient_normalization_threshold)
+        self.dtype = dtype
+        self.mini_batch = bool(mini_batch)
+        self.max_num_line_search_iterations = int(max_num_line_search_iterations)
+        self.optimization_algo = optimization_algo
+
+    def to_dict(self) -> dict:
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GlobalConf":
+        return serde.generic_from_dict(cls, data)
+
+    def __eq__(self, other):
+        return isinstance(other, GlobalConf) and serde.encode(self) == serde.encode(other)
+
+
+serde.register(GlobalConf)
+
+
+class FeedForwardLayer(Layer):
+    """Base for layers with explicit nIn/nOut and a dense-ish W/b param set
+    (reference ``nn/conf/layers/FeedForwardLayer``)."""
+
+    def __init__(
+        self,
+        n_out: Optional[int] = None,
+        n_in: Optional[int] = None,
+        activation: Optional[str] = INHERIT,
+        weight_init: Optional[Union[str, _init.Distribution]] = INHERIT,
+        distribution: Optional[_init.Distribution] = None,
+        bias_init: Optional[float] = INHERIT,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.n_in = None if n_in is None else int(n_in)
+        self.n_out = None if n_out is None else int(n_out)
+        self.activation = activation
+        self.weight_init = weight_init
+        self.distribution = distribution
+        self.bias_init = bias_init
+
+    def inherit_defaults(self, defaults: GlobalConf) -> None:
+        super().inherit_defaults(defaults)
+        if self.activation is INHERIT:
+            self.activation = defaults.activation
+        if self.weight_init is INHERIT:
+            self.weight_init = defaults.weight_init
+        if self.distribution is None:
+            self.distribution = defaults.distribution
+        if self.bias_init is INHERIT:
+            self.bias_init = defaults.bias_init
+
+    def initialize(self, input_type: InputType) -> None:
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        # Dense-family layers applied to recurrent input operate per-timestep
+        # (no Rnn↔FF preprocessor round-trip needed under XLA; see builders.py).
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def act_fn(self):
+        return _act.get(self.activation)
+
+    def _draw_weight(self, rng, shape, fan_in, fan_out, dtype):
+        return _init.init_weights(
+            rng, shape, fan_in, fan_out,
+            self.weight_init if self.weight_init is not None else "xavier",
+            distribution=self.distribution, dtype=dtype,
+        )
+
+    def _bias(self, shape, dtype):
+        b0 = self.bias_init if self.bias_init is not None else 0.0
+        return jnp.full(shape, b0, dtype)
+
+
+def apply_input_dropout(layer: Layer, x: Array, train: bool, rng: Optional[Array]) -> Array:
+    """DL4J applies a layer's dropout to its *input* during training
+    (reference ``BaseLayer.applyDropOutIfNecessary``); inverted dropout."""
+    if not train or layer.dropout <= 0.0:
+        return x
+    if rng is None:
+        raise ValueError(f"Layer {layer.name}: dropout requires an rng during training")
+    keep = 1.0 - layer.dropout
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

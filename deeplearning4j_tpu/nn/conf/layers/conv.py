@@ -1,0 +1,527 @@
+"""Convolution / pooling / spatial layer catalog — NHWC, MXU-first.
+
+Reference configs: ``nn/conf/layers/{ConvolutionLayer,Convolution1DLayer,
+Deconvolution2D,SeparableConvolution2D,DepthwiseConvolution2D,
+SubsamplingLayer,Subsampling1DLayer,Upsampling1D,Upsampling2D,
+ZeroPaddingLayer,ZeroPadding1DLayer,Cropping2D,SpaceToBatchLayer,
+SpaceToDepthLayer}.java`` + runtimes under ``nn/layers/convolution/``.
+
+Where the reference reaches im2col kernels in libnd4j or cuDNN helpers
+(``ConvolutionLayer.java:77-81``), here a single ``lax.conv_general_dilated``
+lowers straight onto the TPU MXU; layout is NHWC / HWIO (XLA's preferred TPU
+conv layout), stated in ``input_type.py``.
+
+ConvolutionMode parity (reference ``nn/conf/ConvolutionMode.java``):
+- "truncate": explicit padding, output floor((in + 2p - k)/s) + 1
+- "strict":   like truncate but (in + 2p - k) %% s must be 0 (config error)
+- "same":     XLA SAME padding, output ceil(in/s), explicit padding ignored
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer
+
+IntPair = Union[int, Sequence[int]]
+
+
+def _pair(v: IntPair) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1])
+    return int(v), int(v)
+
+
+def _conv_out(size: int, k: int, s: int, p: int, mode: str, dilation: int = 1) -> int:
+    eff_k = k + (k - 1) * (dilation - 1)
+    if mode == "same":
+        return math.ceil(size / s)
+    out = (size + 2 * p - eff_k) // s + 1
+    if mode == "strict" and (size + 2 * p - eff_k) % s != 0:
+        raise ValueError(
+            f"ConvolutionMode.Strict: (in={size} + 2*pad={p} - k={eff_k}) not divisible by stride={s}"
+        )
+    return out
+
+
+class BaseConvLayer(FeedForwardLayer):
+    """Shared kernel/stride/padding/mode handling."""
+
+    def __init__(
+        self,
+        kernel_size: IntPair = 3,
+        stride: IntPair = 1,
+        padding: IntPair = 0,
+        convolution_mode: str = "truncate",
+        dilation: IntPair = 1,
+        has_bias: bool = True,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.kernel_size = list(_pair(kernel_size))
+        self.stride = list(_pair(stride))
+        self.padding = list(_pair(padding))
+        self.convolution_mode = convolution_mode.lower()
+        self.dilation = list(_pair(dilation))
+        self.has_bias = bool(has_bias)
+
+    def _xla_padding(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def initialize(self, input_type: InputType) -> None:
+        if input_type.kind not in ("convolutional", "convolutional_flat"):
+            raise ValueError(f"{type(self).__name__} needs convolutional input, got {input_type}")
+        if self.n_in is None:
+            self.n_in = input_type.channels
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dh, dw = self.dilation
+        h = _conv_out(input_type.height, kh, sh, ph, self.convolution_mode, dh)
+        w = _conv_out(input_type.width, kw, sw, pw, self.convolution_mode, dw)
+        return InputType.convolutional(h, w, self.n_out)
+
+
+@serde.register
+class ConvolutionLayer(BaseConvLayer):
+    """2D convolution (reference ``ConvolutionLayer.java``).
+
+    W: (kh, kw, inC, outC) HWIO; fan_in = kh*kw*inC, fan_out = kh*kw*outC
+    (reference ``ConvolutionParamInitializer`` fan semantics).
+    """
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = kh * kw * self.n_in
+        fan_out = kh * kw * self.n_out
+        kr, _ = jax.random.split(rng)
+        p = {"W": self._draw_weight(kr, (kh, kw, self.n_in, self.n_out), fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._bias((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=tuple(self.stride),
+            padding=self._xla_padding(),
+            rhs_dilation=tuple(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class Deconvolution2D(BaseConvLayer):
+    """Transposed convolution (reference ``Deconvolution2D.java``)."""
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        if self.convolution_mode == "same":
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + kh - 2 * ph
+            w = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = kh * kw * self.n_in
+        fan_out = kh * kw * self.n_out
+        kr, _ = jax.random.split(rng)
+        p = {"W": self._draw_weight(kr, (kh, kw, self.n_out, self.n_in), fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._bias((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        ph, pw = self.padding
+        pad = "SAME" if self.convolution_mode == "same" else [(ph, ph), (pw, pw)]
+        y = lax.conv_transpose(
+            x, params["W"],
+            strides=tuple(self.stride),
+            padding=pad,
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            transpose_kernel=True,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class DepthwiseConvolution2D(BaseConvLayer):
+    """Depthwise conv (reference ``DepthwiseConvolution2D.java``):
+    feature_group_count = inC on the MXU."""
+
+    def __init__(self, depth_multiplier: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.depth_multiplier = int(depth_multiplier)
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        if self.n_out is None:
+            self.n_out = self.n_in * self.depth_multiplier
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = kh * kw
+        fan_out = kh * kw * self.depth_multiplier
+        kr, _ = jax.random.split(rng)
+        p = {"W": self._draw_weight(kr, (kh, kw, 1, self.n_in * self.depth_multiplier), fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._bias((self.n_in * self.depth_multiplier,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=tuple(self.stride),
+            padding=self._xla_padding(),
+            rhs_dilation=tuple(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class SeparableConvolution2D(BaseConvLayer):
+    """Depthwise + pointwise (reference ``SeparableConvolution2D.java``)."""
+
+    def __init__(self, depth_multiplier: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.depth_multiplier = int(depth_multiplier)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        k1, k2, _ = jax.random.split(rng, 3)
+        dw_out = self.n_in * self.depth_multiplier
+        p = {
+            "dW": self._draw_weight(k1, (kh, kw, 1, dw_out), kh * kw, kh * kw * self.depth_multiplier, dtype),
+            "pW": self._draw_weight(k2, (1, 1, dw_out, self.n_out), dw_out, self.n_out, dtype),
+        }
+        if self.has_bias:
+            p["b"] = self._bias((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = lax.conv_general_dilated(
+            x, params["dW"],
+            window_strides=tuple(self.stride),
+            padding=self._xla_padding(),
+            rhs_dilation=tuple(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in,
+        )
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class SubsamplingLayer(Layer):
+    """Spatial pooling: max / avg / pnorm (reference ``SubsamplingLayer.java``,
+    runtime ``nn/layers/convolution/subsampling/SubsamplingLayer.java``)."""
+
+    def __init__(
+        self,
+        pooling_type: str = "max",
+        kernel_size: IntPair = 2,
+        stride: IntPair = 2,
+        padding: IntPair = 0,
+        convolution_mode: str = "truncate",
+        pnorm: int = 2,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.pooling_type = pooling_type.lower()
+        self.kernel_size = list(_pair(kernel_size))
+        self.stride = list(_pair(stride))
+        self.padding = list(_pair(padding))
+        self.convolution_mode = convolution_mode.lower()
+        self.pnorm = int(pnorm)
+
+    def get_output_type(self, input_type):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = _conv_out(input_type.height, kh, sh, ph, self.convolution_mode)
+        w = _conv_out(input_type.width, kw, sw, pw, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _padding_spec(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        window = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pad = self._padding_spec()
+        if self.pooling_type == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        elif self.pooling_type in ("avg", "average"):
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pad)
+            y = s / cnt
+        elif self.pooling_type == "pnorm":
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, pad)
+            y = s ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type}")
+        return y, state or {}
+
+
+@serde.register
+class Upsampling2D(Layer):
+    """Nearest-neighbor upsampling (reference ``Upsampling2D.java``)."""
+
+    def __init__(self, size: IntPair = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.size = list(_pair(size))
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(
+            input_type.height * self.size[0], input_type.width * self.size[1], input_type.channels
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2)
+        return y, state or {}
+
+
+@serde.register
+class ZeroPaddingLayer(Layer):
+    """(reference ``ZeroPaddingLayer.java``) pad: (top, bottom, left, right)."""
+
+    def __init__(self, pad: Sequence[int] = (0, 0, 0, 0), **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(pad, int):
+            pad = (pad, pad, pad, pad)
+        elif len(pad) == 2:
+            pad = (pad[0], pad[0], pad[1], pad[1])
+        self.pad = [int(p) for p in pad]
+
+    def get_output_type(self, input_type):
+        t, b, l, r = self.pad
+        return InputType.convolutional(
+            input_type.height + t + b, input_type.width + l + r, input_type.channels
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        t, b, l, r = self.pad
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state or {}
+
+
+@serde.register
+class Cropping2D(Layer):
+    """(reference ``nn/conf/layers/convolutional/Cropping2D.java``)."""
+
+    def __init__(self, crop: Sequence[int] = (0, 0, 0, 0), **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(crop, int):
+            crop = (crop, crop, crop, crop)
+        elif len(crop) == 2:
+            crop = (crop[0], crop[0], crop[1], crop[1])
+        self.crop = [int(c) for c in crop]
+
+    def get_output_type(self, input_type):
+        t, b, l, r = self.crop
+        return InputType.convolutional(
+            input_type.height - t - b, input_type.width - l - r, input_type.channels
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        t, b, l, r = self.crop
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t : h - b, l : w - r, :], state or {}
+
+
+@serde.register
+class SpaceToDepthLayer(Layer):
+    """(reference ``SpaceToDepthLayer.java``)."""
+
+    def __init__(self, block_size: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.block_size = int(block_size)
+
+    def get_output_type(self, input_type):
+        bs = self.block_size
+        return InputType.convolutional(
+            input_type.height // bs, input_type.width // bs, input_type.channels * bs * bs
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        b, h, w, c = x.shape
+        bs = self.block_size
+        y = x.reshape(b, h // bs, bs, w // bs, bs, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // bs, w // bs, bs * bs * c)
+        return y, state or {}
+
+
+@serde.register
+class SpaceToBatchLayer(Layer):
+    """(reference ``SpaceToBatchLayer.java``)."""
+
+    def __init__(self, blocks: IntPair = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.blocks = list(_pair(blocks))
+
+    def get_output_type(self, input_type):
+        bh, bw = self.blocks
+        return InputType.convolutional(
+            input_type.height // bh, input_type.width // bw, input_type.channels
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        bh, bw = self.blocks
+        b, h, w, c = x.shape
+        y = x.reshape(b, h // bh, bh, w // bw, bw, c)
+        y = y.transpose(2, 4, 0, 1, 3, 5).reshape(b * bh * bw, h // bh, w // bw, c)
+        return y, state or {}
+
+
+# ---------------------------------------------------------------------------
+# 1D variants operate on recurrent-format (b, T, C) activations
+# ---------------------------------------------------------------------------
+
+
+@serde.register
+class Convolution1DLayer(BaseConvLayer):
+    """1D conv over time (reference ``Convolution1DLayer.java``)."""
+
+    def __init__(self, kernel_size: int = 3, stride: int = 1, padding: int = 0, **kwargs):
+        kwargs.setdefault("convolution_mode", "truncate")
+        super().__init__(kernel_size=(kernel_size, 1), stride=(stride, 1), padding=(padding, 1), **kwargs)
+        self.kernel_size = [int(kernel_size)]
+        self.stride = [int(stride)]
+        self.padding = [int(padding)]
+
+    def initialize(self, input_type):
+        if input_type.kind != "recurrent":
+            raise ValueError("Convolution1DLayer needs recurrent input")
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        ts = input_type.timesteps
+        out_ts = None
+        if ts is not None:
+            out_ts = _conv_out(ts, self.kernel_size[0], self.stride[0], self.padding[0], self.convolution_mode)
+        return InputType.recurrent(self.n_out, out_ts)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        k = self.kernel_size[0]
+        kr, _ = jax.random.split(rng)
+        p = {"W": self._draw_weight(kr, (k, self.n_in, self.n_out), k * self.n_in, k * self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._bias((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        pad = "SAME" if self.convolution_mode == "same" else [(self.padding[0], self.padding[0])]
+        y = lax.conv_general_dilated(
+            x, params["W"], window_strides=(self.stride[0],), padding=pad,
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class Subsampling1DLayer(Layer):
+    """1D pooling over time (reference ``Subsampling1DLayer.java``)."""
+
+    def __init__(self, pooling_type: str = "max", kernel_size: int = 2, stride: int = 2,
+                 padding: int = 0, convolution_mode: str = "truncate", **kwargs):
+        super().__init__(**kwargs)
+        self.pooling_type = pooling_type.lower()
+        self.kernel_size = int(kernel_size)
+        self.stride = int(stride)
+        self.padding = int(padding)
+        self.convolution_mode = convolution_mode.lower()
+
+    def get_output_type(self, input_type):
+        ts = input_type.timesteps
+        out_ts = None
+        if ts is not None:
+            out_ts = _conv_out(ts, self.kernel_size, self.stride, self.padding, self.convolution_mode)
+        return InputType.recurrent(input_type.size, out_ts)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        window = (1, self.kernel_size, 1)
+        strides = (1, self.stride, 1)
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = [(0, 0), (self.padding, self.padding), (0, 0)]
+        if self.pooling_type == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pad)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, pad)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, pad)
+            y = s / cnt
+        return y, state or {}
+
+
+@serde.register
+class Upsampling1D(Layer):
+    """(reference ``Upsampling1D.java``)."""
+
+    def __init__(self, size: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.size = int(size)
+
+    def get_output_type(self, input_type):
+        ts = input_type.timesteps
+        return InputType.recurrent(input_type.size, None if ts is None else ts * self.size)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state or {}
+
+
+@serde.register
+class ZeroPadding1DLayer(Layer):
+    """(reference ``ZeroPadding1DLayer.java``)."""
+
+    def __init__(self, pad: IntPair = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.pad = list(_pair(pad))
+
+    def get_output_type(self, input_type):
+        ts = input_type.timesteps
+        return InputType.recurrent(
+            input_type.size, None if ts is None else ts + self.pad[0] + self.pad[1]
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return jnp.pad(x, ((0, 0), (self.pad[0], self.pad[1]), (0, 0))), state or {}

@@ -1,0 +1,278 @@
+"""Core feed-forward layer catalog.
+
+Reference: ``nn/conf/layers/{DenseLayer,OutputLayer,LossLayer,ActivationLayer,
+DropoutLayer,EmbeddingLayer,EmbeddingSequenceLayer}.java``,
+``nn/conf/layers/misc/ElementWiseMultiplicationLayer.java``,
+``nn/conf/layers/AutoEncoder.java`` and their runtime counterparts under
+``nn/layers/``.
+
+Note on dropout semantics: the reference's ``dropOut(x)`` is a *retain*
+probability; here ``dropout=p`` is the *drop* probability (modern
+convention, matches Keras import). Documented deviation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import losses as _losses
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer
+
+
+@serde.register
+class DenseLayer(FeedForwardLayer):
+    """Fully connected layer: y = act(xW + b).
+
+    Reference ``nn/conf/layers/DenseLayer.java`` / ``nn/layers/feedforward
+    /dense/DenseLayer.java``. W: (nIn, nOut), the MXU-friendly orientation.
+    """
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out, f"{self} not initialized"
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.act_fn()(x @ params["W"] + params["b"])
+        return y, state or {}
+
+    def pre_output(self, params, x):
+        return x @ params["W"] + params["b"]
+
+
+@serde.register
+class ActivationLayer(Layer):
+    """Applies an activation only (reference ``ActivationLayer.java``)."""
+
+    def __init__(self, activation: str = "relu", **kwargs):
+        super().__init__(**kwargs)
+        self.activation = activation
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu import activations as _act
+
+        return _act.get(self.activation)(x), state or {}
+
+
+@serde.register
+class DropoutLayer(Layer):
+    """Standalone dropout layer (reference ``DropoutLayer.java``)."""
+
+    def __init__(self, dropout: float = 0.5, **kwargs):
+        kwargs["dropout"] = dropout
+        super().__init__(**kwargs)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        # input-dropout machinery in the network applies self.dropout already;
+        # the layer itself is identity.
+        return x, state or {}
+
+
+@serde.register
+class BaseOutputLayer(FeedForwardLayer):
+    """Dense layer + loss function head (reference ``BaseOutputLayer``/
+    ``OutputLayer.java``; runtime ``nn/layers/BaseOutputLayer``)."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out, f"{self} not initialized"
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.act_fn()(x @ params["W"] + params["b"])
+        return y, state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        """Per-example loss vector from this layer's *input* activations
+        (the canonical fused logits path — reference
+        ``BaseOutputLayer.computeScore``)."""
+        preout = x @ params["W"] + params["b"]
+        return _losses.get(self.loss)(labels, preout, self.activation, mask)
+
+
+@serde.register
+class OutputLayer(BaseOutputLayer):
+    pass
+
+
+@serde.register
+class LossLayer(Layer):
+    """Parameter-free loss head: applies activation + loss to its input
+    (reference ``LossLayer.java``)."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", activation: str = "identity", **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+        self.activation = activation
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu import activations as _act
+
+        return _act.get(self.activation)(x), state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        return _losses.get(self.loss)(labels, x, self.activation, mask)
+
+
+@serde.register
+class EmbeddingLayer(FeedForwardLayer):
+    """Index → embedding row lookup, single index per example
+    (reference ``EmbeddingLayer.java``: input (b, 1) of indices).
+
+    XLA lowers the gather efficiently; the backward scatter-add is the
+    reason the reference has a dedicated layer rather than a onehot-matmul.
+    """
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def initialize(self, input_type):
+        # n_in == vocab size must be user-set; cannot be inferred from shape.
+        if self.n_in is None:
+            raise ValueError("EmbeddingLayer requires explicit n_in (vocab size)")
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = params["W"][idx] + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class EmbeddingSequenceLayer(FeedForwardLayer):
+    """Sequence of indices → sequence of embeddings
+    (reference ``EmbeddingSequenceLayer.java``): (b, T) → (b, T, nOut)."""
+
+    def __init__(self, input_length: Optional[int] = None, has_bias: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.input_length = input_length
+        self.has_bias = bool(has_bias)
+
+    def initialize(self, input_type):
+        if self.n_in is None:
+            raise ValueError("EmbeddingSequenceLayer requires explicit n_in (vocab size)")
+
+    def get_output_type(self, input_type):
+        ts = self.input_length
+        if input_type.kind == "recurrent" and input_type.timesteps:
+            ts = input_type.timesteps
+        return InputType.recurrent(self.n_out, ts)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        p = {"W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._bias((self.n_out,), dtype)
+        return p
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = params["W"][idx]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@serde.register
+class ElementWiseMultiplicationLayer(FeedForwardLayer):
+    """y = act(x ∘ w + b), learned per-feature scaling
+    (reference ``nn/conf/layers/misc/ElementWiseMultiplicationLayer.java``)."""
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_in != self.n_out:
+            raise ValueError("ElementWiseMultiplicationLayer requires nIn == nOut")
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": jnp.ones((self.n_in,), dtype),
+            "b": self._bias((self.n_in,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self.act_fn()(x * params["W"] + params["b"]), state or {}
+
+
+@serde.register
+class AutoEncoder(FeedForwardLayer):
+    """Denoising autoencoder pretrain layer (reference ``AutoEncoder.java``;
+    runtime ``nn/layers/feedforward/autoencoder/AutoEncoder.java``).
+
+    Supervised forward = encoder only (like DenseLayer); ``pretrain_loss``
+    computes corrupt→encode→decode reconstruction loss.
+    """
+
+    is_pretrain_layer = True
+
+    def __init__(self, corruption_level: float = 0.3, sparsity: float = 0.0,
+                 loss: str = "mse", **kwargs):
+        super().__init__(**kwargs)
+        self.corruption_level = float(corruption_level)
+        self.sparsity = float(sparsity)
+        self.loss = loss
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),  # visible bias for decode
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.act_fn()(x @ params["W"] + params["b"])
+        return y, state or {}
+
+    def pretrain_loss(self, params, x, rng=None):
+        """Reconstruction loss with masking-noise corruption."""
+        corrupted = x
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        h = self.act_fn()(corrupted @ params["W"] + params["b"])
+        recon_pre = h @ params["W"].T + params["vb"]
+        per = _losses.get(self.loss)(x, recon_pre, self.activation)
+        return jnp.mean(per)
+
+
+@serde.register
+class DummyLayer(Layer):
+    """Identity layer, for tests."""
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return x, state or {}
+
+
+serde.register(Layer)
+serde.register(FeedForwardLayer)

@@ -1,0 +1,115 @@
+"""Normalization layers: BatchNormalization, LocalResponseNormalization.
+
+Reference: ``nn/conf/layers/BatchNormalization.java`` +
+``nn/layers/normalization/BatchNormalization.java`` (running-stat state,
+``decay`` EMA, gamma/beta optionally locked), and
+``nn/conf/layers/LocalResponseNormalization.java``.
+
+BatchNorm running statistics are *layer state*, threaded functionally
+through the jitted train step (the reference mutates them in the params
+view; here they live in the network's ``state`` pytree and are updated by
+returning new values).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+
+
+@serde.register
+class BatchNormalization(Layer):
+    def __init__(
+        self,
+        decay: float = 0.9,
+        eps: float = 1e-5,
+        gamma: float = 1.0,
+        beta: float = 0.0,
+        lock_gamma_beta: bool = False,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.decay = float(decay)
+        self.eps = float(eps)
+        self.gamma = float(gamma)
+        self.beta = float(beta)
+        self.lock_gamma_beta = bool(lock_gamma_beta)
+        self.n_feat: Optional[int] = None
+
+    def initialize(self, input_type: InputType) -> None:
+        if input_type.kind == "convolutional":
+            self.n_feat = input_type.channels
+        else:
+            self.n_feat = input_type.size
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_feat
+        if self.lock_gamma_beta:
+            return {}
+        return {
+            "gamma": jnp.full((self.n_feat,), self.gamma, dtype),
+            "beta": jnp.full((self.n_feat,), self.beta, dtype),
+        }
+
+    def init_layer_state(self, input_type, dtype=jnp.float32):
+        assert self.n_feat
+        return {
+            "mean": jnp.zeros((self.n_feat,), dtype),
+            "var": jnp.ones((self.n_feat,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        assert state is not None and "mean" in state, "BatchNormalization needs layer state"
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            # EMA update (reference decay semantics: new = decay*old + (1-decay)*batch)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.eps)
+        y = (x - mean) * inv
+        if self.lock_gamma_beta:
+            y = self.gamma * y + self.beta
+        else:
+            y = params["gamma"] * y + params["beta"]
+        return y, new_state
+
+
+@serde.register
+class LocalResponseNormalization(Layer):
+    """Across-channel LRN (AlexNet-era; reference defaults k=2, n=5,
+    alpha=1e-4, beta=0.75)."""
+
+    def __init__(self, k: float = 2.0, n: float = 5.0, alpha: float = 1e-4,
+                 beta: float = 0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.k = float(k)
+        self.n = float(n)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        half = int(self.n) // 2
+        sq = x * x
+        # sum over a window of `n` adjacent channels (NHWC last axis)
+        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+        window = sum(
+            padded[..., i : i + x.shape[-1]] for i in range(int(self.n))
+        )
+        denom = (self.k + self.alpha * window) ** self.beta
+        return x / denom, state or {}

@@ -1,0 +1,79 @@
+"""Global pooling + misc mask layers.
+
+Reference: ``nn/conf/layers/GlobalPoolingLayer.java`` (+ runtime
+``nn/layers/pooling/GlobalPoolingLayer.java``: mask-aware pooling over time
+for RNN input or over spatial dims for CNN input), ``nn/conf/layers/util/
+MaskLayer.java``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+
+
+@serde.register
+class GlobalPoolingLayer(Layer):
+    """Pooling types: max | avg | sum | pnorm. RNN input (b,T,d) pools over
+    time (mask-aware); CNN input (b,h,w,c) pools over space."""
+
+    def __init__(self, pooling_type: str = "max", pnorm: int = 2,
+                 collapse_dimensions: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.pooling_type = pooling_type.lower()
+        self.pnorm = int(pnorm)
+        self.collapse_dimensions = bool(collapse_dimensions)
+
+    def get_output_type(self, input_type):
+        if input_type.kind == "recurrent":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "convolutional":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def _pool(self, x, axes, mask_b=None):
+        pt = self.pooling_type
+        if pt == "max":
+            if mask_b is not None:
+                x = jnp.where(mask_b > 0, x, -jnp.inf)
+            return jnp.max(x, axis=axes)
+        if pt in ("avg", "average"):
+            if mask_b is not None:
+                s = jnp.sum(x * mask_b, axis=axes)
+                cnt = jnp.maximum(jnp.sum(mask_b, axis=axes), 1.0)
+                return s / cnt
+            return jnp.mean(x, axis=axes)
+        if pt == "sum":
+            if mask_b is not None:
+                x = x * mask_b
+            return jnp.sum(x, axis=axes)
+        if pt == "pnorm":
+            p = float(self.pnorm)
+            if mask_b is not None:
+                x = x * mask_b
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        raise ValueError(f"Unknown pooling type {pt}")
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if x.ndim == 3:  # (b, T, d): pool over time
+            mask_b = None if mask is None else mask[..., None]
+            y = self._pool(x, axes=(1,), mask_b=mask_b)
+        elif x.ndim == 4:  # (b, h, w, c): pool over space
+            y = self._pool(x, axes=(1, 2))
+        else:
+            raise ValueError(f"GlobalPooling expects 3d/4d input, got {x.shape}")
+        return y, state or {}
+
+
+@serde.register
+class MaskLayer(Layer):
+    """Applies the current mask to activations and stops mask propagation
+    (reference ``nn/conf/layers/util/MaskLayer.java``)."""
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if mask is not None and x.ndim == 3:
+            x = x * mask[..., None]
+        return x, state or {}

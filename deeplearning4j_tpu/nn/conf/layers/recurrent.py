@@ -1,0 +1,395 @@
+"""Recurrent layer catalog: LSTM family, SimpleRnn, Bidirectional and
+sequence wrappers, RNN output layers.
+
+Reference: ``nn/conf/layers/{LSTM,GravesLSTM,GravesBidirectionalLSTM,
+RnnOutputLayer,RnnLossLayer}.java``, ``nn/conf/layers/recurrent/
+{Bidirectional,SimpleRnn,LastTimeStep}.java``, ``nn/layers/recurrent/
+{LSTM,LSTMHelpers,SimpleRnn,MaskZeroLayer,LastTimeStepLayer}.java``.
+
+TPU-first design: the whole sequence loop is a single ``lax.scan`` compiled
+by XLA (the reference iterates timesteps in Java calling per-step gemms —
+``LSTMHelpers.activateHelper``). Layout is time-major inside the scan,
+(batch, time, size) at the API boundary. Gate order in the packed weight
+matrices is [i, f, o, g] (documented; Keras import reorders on load).
+
+Masking semantics (reference per-timestep masking,
+``nn/api/Layer.java:288``): at masked steps the carry is held and the
+output is zeroed — so variable-length sequences train identically to the
+reference's masked tBPTT.
+
+Stateful stepping (``rnnTimeStep``): every recurrent layer exposes
+``init_carry`` and ``apply_with_carry`` so the network can thread carries
+across calls — the functional replacement for the reference's stored-state
+``rnnActivateUsingStoredState`` (``MultiLayerNetwork.java:2378-2387``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu import activations as _act
+from deeplearning4j_tpu import losses as _losses
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer
+
+Array = jax.Array
+
+
+class BaseRecurrentLayer(FeedForwardLayer):
+    is_recurrent = True
+
+    def initialize(self, input_type: InputType) -> None:
+        if input_type.kind != "recurrent":
+            raise ValueError(f"{type(self).__name__} needs recurrent input, got {input_type}")
+        if self.n_in is None:
+            self.n_in = input_type.size
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_carry(self, batch: int, dtype=jnp.float32) -> Any:
+        raise NotImplementedError
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
+        raise NotImplementedError
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        carry = self.init_carry(x.shape[0], x.dtype)
+        y, _ = self.apply_with_carry(params, x, carry, mask=mask, train=train, rng=rng)
+        return y, state or {}
+
+
+def _masked_scan(step_fn, carry0, x, mask):
+    """Run a scan over time with carry-hold + output-zero masking.
+
+    x: (b, T, d) → scanned time-major; mask: (b, T) or None.
+    step_fn(carry, x_t) -> (new_carry, y_t)
+    """
+    xt = jnp.swapaxes(x, 0, 1)  # (T, b, d)
+
+    if mask is None:
+        def body(carry, x_t):
+            return step_fn(carry, x_t)
+        carry, ys = lax.scan(body, carry0, xt)
+    else:
+        mt = jnp.swapaxes(mask, 0, 1)[..., None]  # (T, b, 1)
+
+        def body(carry, inp):
+            x_t, m_t = inp
+            new_carry, y_t = step_fn(carry, x_t)
+            held = jax.tree_util.tree_map(
+                lambda new, old: m_t * new + (1.0 - m_t) * old, new_carry, carry
+            )
+            return held, y_t * m_t
+
+        carry, ys = lax.scan(body, carry0, (xt, mt))
+    return jnp.swapaxes(ys, 0, 1), carry
+
+
+@serde.register
+class LSTM(BaseRecurrentLayer):
+    """Standard LSTM, no peepholes (reference ``nn/conf/layers/LSTM.java``).
+
+    Packed weights: Wx (nIn, 4*nOut), Wh (nOut, 4*nOut), b (4*nOut,),
+    gates [i, f, o, g]. One fused gemm per step inside lax.scan keeps the
+    MXU busy; XLA unrolls/pipes the scan.
+    """
+
+    def __init__(self, forget_gate_bias_init: float = 1.0,
+                 gate_activation: str = "sigmoid", **kwargs):
+        super().__init__(**kwargs)
+        self.forget_gate_bias_init = float(forget_gate_bias_init)
+        self.gate_activation = gate_activation
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def inherit_defaults(self, defaults):
+        act_was_unset = self.activation is None
+        super().inherit_defaults(defaults)
+        if act_was_unset:
+            self.activation = "tanh"
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        k1, k2, _ = jax.random.split(rng, 3)
+        n_out = self.n_out
+        b = jnp.zeros((4 * n_out,), dtype)
+        b = b.at[n_out : 2 * n_out].set(self.forget_gate_bias_init)
+        return {
+            "Wx": self._draw_weight(k1, (self.n_in, 4 * n_out), self.n_in, n_out, dtype),
+            "Wh": self._draw_weight(k2, (n_out, 4 * n_out), n_out, n_out, dtype),
+            "b": b,
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype), jnp.zeros((batch, self.n_out), dtype))
+
+    def _step(self, params, carry, x_t):
+        h, c = carry
+        act = _act.get(self.activation)
+        gate = _act.get(self.gate_activation)
+        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        n = self.n_out
+        i = gate(z[:, :n])
+        f = gate(z[:, n : 2 * n])
+        o = gate(z[:, 2 * n : 3 * n])
+        g = act(z[:, 3 * n :])
+        c_new = f * c + i * g
+        h_new = o * act(c_new)
+        return (h_new, c_new), h_new
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
+        return _masked_scan(lambda c, xt: self._step(params, c, xt), carry, x, mask)
+
+
+@serde.register
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference ``GravesLSTM.java``,
+    ``LSTMHelpers`` peephole path): i/f see c_{t-1}, o sees c_t."""
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        p = super().init_params(rng, input_type, dtype)
+        p["pI"] = jnp.zeros((self.n_out,), dtype)
+        p["pF"] = jnp.zeros((self.n_out,), dtype)
+        p["pO"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def _step(self, params, carry, x_t):
+        h, c = carry
+        act = _act.get(self.activation)
+        gate = _act.get(self.gate_activation)
+        z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
+        n = self.n_out
+        i = gate(z[:, :n] + params["pI"] * c)
+        f = gate(z[:, n : 2 * n] + params["pF"] * c)
+        g = act(z[:, 3 * n :])
+        c_new = f * c + i * g
+        o = gate(z[:, 2 * n : 3 * n] + params["pO"] * c_new)
+        h_new = o * act(c_new)
+        return (h_new, c_new), h_new
+
+
+@serde.register
+class SimpleRnn(BaseRecurrentLayer):
+    """Elman RNN: h_t = act(x_t Wx + h_{t-1} Wh + b)
+    (reference ``nn/conf/layers/recurrent/SimpleRnn.java``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def inherit_defaults(self, defaults):
+        act_was_unset = self.activation is None
+        super().inherit_defaults(defaults)
+        if act_was_unset:
+            self.activation = "tanh"
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        k1, k2, _ = jax.random.split(rng, 3)
+        return {
+            "Wx": self._draw_weight(k1, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "Wh": self._draw_weight(k2, (self.n_out, self.n_out), self.n_out, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.n_out), dtype)
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
+        act = _act.get(self.activation)
+
+        def step(h, x_t):
+            h_new = act(x_t @ params["Wx"] + h @ params["Wh"] + params["b"])
+            return h_new, h_new
+
+        return _masked_scan(step, carry, x, mask)
+
+
+@serde.register
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference ``recurrent/Bidirectional.java``).
+
+    Modes: concat | add | mul | ave. Holds two copies of the wrapped
+    recurrent layer's params under "fwd"/"bwd".
+    """
+
+    is_recurrent = True
+
+    def __init__(self, layer: Optional[BaseRecurrentLayer] = None, mode: str = "concat", **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+        self.mode = mode.lower()
+
+    @property
+    def n_out(self):
+        return self.layer.n_out * (2 if self.mode == "concat" else 1)
+
+    def initialize(self, input_type):
+        self.layer.initialize(input_type)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        self.layer.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type):
+        inner = self.layer.get_output_type(input_type)
+        size = inner.size * 2 if self.mode == "concat" else inner.size
+        return InputType.recurrent(size, input_type.timesteps)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "fwd": self.layer.init_params(k1, input_type, dtype),
+            "bwd": self.layer.init_params(k2, input_type, dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        carry_f = self.layer.init_carry(x.shape[0], x.dtype)
+        carry_b = self.layer.init_carry(x.shape[0], x.dtype)
+        y_f, _ = self.layer.apply_with_carry(params["fwd"], x, carry_f, mask=mask, train=train, rng=rng)
+        x_rev = jnp.flip(x, axis=1)
+        mask_rev = None if mask is None else jnp.flip(mask, axis=1)
+        y_b, _ = self.layer.apply_with_carry(params["bwd"], x_rev, carry_b, mask=mask_rev, train=train, rng=rng)
+        y_b = jnp.flip(y_b, axis=1)
+        if self.mode == "concat":
+            return jnp.concatenate([y_f, y_b], axis=-1), state or {}
+        if self.mode == "add":
+            return y_f + y_b, state or {}
+        if self.mode == "mul":
+            return y_f * y_b, state or {}
+        if self.mode in ("ave", "average"):
+            return 0.5 * (y_f + y_b), state or {}
+        raise ValueError(f"Unknown Bidirectional mode {self.mode}")
+
+
+@serde.register
+class GravesBidirectionalLSTM(Bidirectional):
+    """Legacy config = Bidirectional(GravesLSTM, concat)
+    (reference ``GravesBidirectionalLSTM.java``)."""
+
+    def __init__(self, n_out: Optional[int] = None, n_in: Optional[int] = None,
+                 activation: Optional[str] = None, **kwargs):
+        inner = GravesLSTM(n_out=n_out, n_in=n_in, activation=activation)
+        super().__init__(layer=inner, mode="concat", **kwargs)
+
+
+@serde.register
+class LastTimeStep(Layer):
+    """Wraps a recurrent layer, emits only the last (unmasked) step
+    (reference ``recurrent/LastTimeStep.java``)."""
+
+    def __init__(self, layer: Optional[Layer] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+
+    def initialize(self, input_type):
+        self.layer.initialize(input_type)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        self.layer.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type):
+        inner = self.layer.get_output_type(input_type)
+        return InputType.feed_forward(inner.size)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        return self.layer.init_params(rng, input_type, dtype)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y, st = self.layer.apply(params, x, state=state, train=train, rng=rng, mask=mask)
+        if mask is None:
+            return y[:, -1, :], st
+        # last unmasked index per example
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return y[jnp.arange(y.shape[0]), idx, :], st
+
+
+@serde.register
+class MaskZeroLayer(Layer):
+    """Sets masked-timestep inputs to ``masking_value`` before the wrapped
+    layer (reference ``nn/layers/recurrent/MaskZeroLayer.java``)."""
+
+    def __init__(self, layer: Optional[Layer] = None, masking_value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+        self.masking_value = float(masking_value)
+
+    def initialize(self, input_type):
+        self.layer.initialize(input_type)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        self.layer.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type):
+        return self.layer.get_output_type(input_type)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        return self.layer.init_params(rng, input_type, dtype)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if mask is not None:
+            m = mask[..., None]
+            x = jnp.where(m > 0, x, self.masking_value)
+        return self.layer.apply(params, x, state=state, train=train, rng=rng, mask=mask)
+
+
+@serde.register
+class RnnOutputLayer(FeedForwardLayer):
+    """Per-timestep dense + loss head (reference ``RnnOutputLayer.java``)."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.act_fn()(x @ params["W"] + params["b"])
+        if mask is not None:
+            y = y * mask[..., None]
+        return y, state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        preout = x @ params["W"] + params["b"]  # (b, T, nOut)
+        m = None if mask is None else mask[..., None]
+        return _losses.get(self.loss)(labels, preout, self.activation, m)
+
+
+@serde.register
+class RnnLossLayer(Layer):
+    """Parameter-free per-timestep loss (reference ``RnnLossLayer.java``)."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", activation: str = "identity", **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+        self.activation = activation
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = _act.get(self.activation)(x)
+        if mask is not None:
+            y = y * mask[..., None]
+        return y, state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        m = None if mask is None else mask[..., None]
+        return _losses.get(self.loss)(labels, x, self.activation, m)

@@ -1,0 +1,118 @@
+"""FrozenLayer wrapper + CenterLossOutputLayer.
+
+Reference: ``nn/conf/layers/misc/FrozenLayer.java`` (+ runtime
+``nn/layers/FrozenLayer.java``: forward passes through, no param updates —
+the transfer-learning building block) and
+``nn/conf/layers/CenterLossOutputLayer.java`` (centers are non-gradient
+state updated with EMA toward class features, lambda-weighted center loss
+added to the classification loss).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import losses as _losses
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer
+
+
+@serde.register
+class FrozenLayer(Layer):
+    """Wraps any layer; the network updater skips its params
+    (checked via ``is_frozen``)."""
+
+    is_frozen = True
+
+    def __init__(self, layer: Optional[Layer] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.layer = layer
+
+    @property
+    def is_output_layer(self):
+        return self.layer.is_output_layer
+
+    @property
+    def is_recurrent(self):
+        return self.layer.is_recurrent
+
+    def initialize(self, input_type):
+        self.layer.initialize(input_type)
+
+    def inherit_defaults(self, defaults):
+        super().inherit_defaults(defaults)
+        self.layer.inherit_defaults(defaults)
+
+    def get_output_type(self, input_type):
+        return self.layer.get_output_type(input_type)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        return self.layer.init_params(rng, input_type, dtype)
+
+    def init_layer_state(self, input_type, dtype=jnp.float32):
+        return self.layer.init_layer_state(input_type, dtype)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        # train=False inside: frozen layers run in inference mode (reference
+        # FrozenLayer disables dropout etc. during training).
+        return self.layer.apply(params, x, state=state, train=False, rng=rng, mask=mask)
+
+    def compute_score(self, params, x, labels, mask=None):
+        return self.layer.compute_score(params, x, labels, mask)
+
+
+@serde.register
+class CenterLossOutputLayer(FeedForwardLayer):
+    """Softmax output + center loss (reference
+    ``CenterLossOutputLayer.java``): L = Lce + lambda/2 * ||f - c_y||²,
+    centers updated by EMA with rate alpha toward class means."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", alpha: float = 0.05,
+                 lambda_: float = 2e-4, **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+        self.alpha = float(alpha)
+        self.lambda_ = float(lambda_)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._bias((self.n_out,), dtype),
+        }
+
+    def init_layer_state(self, input_type, dtype=jnp.float32):
+        return {"centers": jnp.zeros((self.n_out, self.n_in), dtype)}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.act_fn()(x @ params["W"] + params["b"])
+        return y, state or {}
+
+    def compute_score(self, params, x, labels, mask=None, state=None):
+        preout = x @ params["W"] + params["b"]
+        ce = _losses.get(self.loss)(labels, preout, self.activation, mask)
+        if state is not None and "centers" in state:
+            cls = jnp.argmax(labels, axis=-1)
+            centers = state["centers"][cls]  # (b, n_in)
+            center_l = 0.5 * self.lambda_ * jnp.sum((x - centers) ** 2, axis=-1)
+            ce = ce + center_l
+        return ce
+
+    def update_centers(self, state, x, labels):
+        """EMA center update (non-gradient state transition, applied in the
+        train step alongside BN stats)."""
+        cls = jnp.argmax(labels, axis=-1)  # (b,)
+        centers = state["centers"]
+        onehot = jax.nn.one_hot(cls, self.n_out, dtype=x.dtype)  # (b, k)
+        counts = jnp.maximum(onehot.sum(axis=0), 1.0)[:, None]  # (k,1)
+        class_means = (onehot.T @ x) / counts
+        has = (onehot.sum(axis=0) > 0)[:, None]
+        new_centers = jnp.where(
+            has, (1 - self.alpha) * centers + self.alpha * class_means, centers
+        )
+        return {**state, "centers": new_centers}

@@ -1,0 +1,159 @@
+"""Input preprocessors: shape adapters between layer families.
+
+Reference: ``nn/conf/preprocessor/*.java`` (CnnToFeedForward,
+FeedForwardToCnn, RnnToFeedForward, FeedForwardToRnn, CnnToRnn, RnnToCnn)
++ auto-insertion logic in ``MultiLayerConfiguration`` ``setInputType``.
+
+Internal layouts (see ``input_type.py``): FF (b,s), RNN (b,T,s),
+CNN (b,h,w,c) NHWC. Flattening order is therefore HWC-major — this differs
+from the reference's NCHW flatten; the Keras/DL4J import path compensates
+when translating weights (documented there).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+
+class InputPreProcessor:
+    def pre_process(self, x, mask=None):
+        raise NotImplementedError
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask):
+        """Transform the mask alongside activations (None = unchanged)."""
+        return mask
+
+    def to_dict(self):
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data):
+        actual = serde.lookup(data.get("@class", cls.__name__))
+        return serde.generic_from_dict(actual, data)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and serde.encode(self) == serde.encode(other)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+@serde.register
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    def __init__(self, height: int = 0, width: int = 0, channels: int = 0):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.height * input_type.width * input_type.channels)
+
+
+@serde.register
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    def __init__(self, height: int, width: int, channels: int):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@serde.register
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(b,T,s) → (b*T, s): per-timestep dense processing
+    (reference ``RnnToFeedForwardPreProcessor``)."""
+
+    def pre_process(self, x, mask=None):
+        return x.reshape(-1, x.shape[-1])
+
+    def feed_forward_mask(self, mask):
+        return None if mask is None else mask.reshape(-1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+
+@serde.register
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """(b*T, s) → (b,T,s); needs the timestep count at build time."""
+
+    def __init__(self, timesteps: Optional[int] = None):
+        self.timesteps = timesteps
+
+    def pre_process(self, x, mask=None):
+        t = self.timesteps
+        if t is None:
+            raise ValueError("FeedForwardToRnnPreProcessor needs timesteps")
+        return x.reshape(-1, t, x.shape[-1])
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.size, self.timesteps)
+
+
+@serde.register
+class CnnToRnnPreProcessor(InputPreProcessor):
+    """(b*T, h, w, c) → (b, T, h*w*c): re-fold per-timestep CNN activations
+    back into a sequence (reference ``CnnToRnnPreProcessor``, the partner of
+    ``RnnToCnnPreProcessor`` for applying convolutions at each timestep)."""
+
+    def __init__(self, timesteps: Optional[int] = None):
+        self.timesteps = timesteps
+
+    def pre_process(self, x, mask=None):
+        t = self.timesteps
+        if t is None:
+            raise ValueError("CnnToRnnPreProcessor needs timesteps")
+        bt, h, w, c = x.shape
+        return x.reshape(bt // t, t, h * w * c)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(
+            input_type.height * input_type.width * input_type.channels, self.timesteps
+        )
+
+
+@serde.register
+class RnnToCnnPreProcessor(InputPreProcessor):
+    """(b, T, s) → (b*T, h, w, c): apply spatial layers per timestep
+    (reference ``RnnToCnnPreProcessor``)."""
+
+    def __init__(self, height: int, width: int, channels: int):
+        self.height, self.width, self.channels = int(height), int(width), int(channels)
+
+    def pre_process(self, x, mask=None):
+        b = x.shape[0]
+        return x.reshape(b * x.shape[1], self.height, self.width, self.channels)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@serde.register
+class ReshapePreprocessor(InputPreProcessor):
+    """Generic reshape (reference modelimport ``ReshapePreprocessor``)."""
+
+    def __init__(self, shape, output_type: Optional[dict] = None):
+        self.shape = list(shape)
+        self.output_type = output_type  # InputType dict
+
+    def pre_process(self, x, mask=None):
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def get_output_type(self, input_type):
+        if self.output_type:
+            return InputType.from_dict(self.output_type)
+        import math
+
+        return InputType.feed_forward(math.prod(self.shape))

@@ -1,0 +1,111 @@
+"""Generic JSON serde helpers for config objects.
+
+The reference serializes all configuration through Jackson with ``@class``
+polymorphic type info (``nn/conf/serde/*``); we mirror that: every config
+object becomes a dict with an ``@class`` tag, nested known config types
+(Updater, Schedule, Distribution, Constraint, layers, preprocessors) are
+encoded recursively. Keeps ``MultiLayerConfiguration.to_json`` round-trips
+exact — the regression-test backbone (SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+# Registry of config classes resolvable from @class tags. Populated by
+# register() calls at import time from each config module.
+_CLASSES: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    _CLASSES[cls.__name__] = cls
+    return cls
+
+
+def lookup(name: str) -> type:
+    if name not in _CLASSES:
+        raise KeyError(f"Unknown config class '{name}'. Registered: {sorted(_CLASSES)}")
+    return _CLASSES[name]
+
+
+def encode(obj: Any) -> Any:
+    """Recursively encode a config object graph into JSON-compatible data."""
+    from deeplearning4j_tpu.initializers import Distribution
+    from deeplearning4j_tpu.regularization import Constraint, RegularizationConf
+    from deeplearning4j_tpu.schedules import Schedule
+    from deeplearning4j_tpu.updaters import Updater
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return [encode(o) for o in obj]
+    if isinstance(obj, Schedule):
+        return {"@type": "schedule", **obj.to_dict()}
+    if isinstance(obj, Updater):
+        return {"@type": "updater", **obj.to_dict()}
+    if isinstance(obj, Distribution):
+        return {"@type": "distribution", **obj.to_dict()}
+    if isinstance(obj, Constraint):
+        return {"@type": "constraint", **obj.to_dict()}
+    if isinstance(obj, RegularizationConf):
+        return {"@type": "regularization", **obj.to_dict()}
+    if hasattr(obj, "to_dict") and type(obj).__name__ in _CLASSES:
+        d = obj.to_dict()
+        d.setdefault("@class", type(obj).__name__)
+        return d
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    raise TypeError(f"Cannot encode {type(obj)} into config JSON: {obj!r}")
+
+
+def decode(data: Any) -> Any:
+    """Inverse of encode."""
+    from deeplearning4j_tpu.initializers import Distribution
+    from deeplearning4j_tpu.regularization import Constraint, RegularizationConf
+    from deeplearning4j_tpu.schedules import Schedule
+    from deeplearning4j_tpu.updaters import Updater
+
+    if data is None or isinstance(data, (bool, int, float, str)):
+        return data
+    if isinstance(data, list):
+        return [decode(d) for d in data]
+    if isinstance(data, dict):
+        t = data.get("@type")
+        if t == "schedule":
+            d = {k: v for k, v in data.items() if k != "@type"}
+            return Schedule.from_dict(d)
+        if t == "updater":
+            d = {k: v for k, v in data.items() if k != "@type"}
+            return Updater.from_dict(d)
+        if t == "distribution":
+            d = {k: v for k, v in data.items() if k not in ("@type",)}
+            return Distribution.from_dict(d)
+        if t == "constraint":
+            d = {k: v for k, v in data.items() if k != "@type"}
+            return Constraint.from_dict(d)
+        if t == "regularization":
+            d = {k: v for k, v in data.items() if k != "@type"}
+            return RegularizationConf.from_dict(d)
+        if "@class" in data:
+            cls = lookup(data["@class"])
+            return cls.from_dict(data)
+        return {k: decode(v) for k, v in data.items()}
+    raise TypeError(f"Cannot decode config JSON fragment: {data!r}")
+
+
+def generic_to_dict(obj: Any) -> dict:
+    d: Dict[str, Any] = {"@class": type(obj).__name__}
+    for k, v in obj.__dict__.items():
+        if k.startswith("_"):
+            continue
+        d[k] = encode(v)
+    return d
+
+
+def generic_from_dict(cls: type, data: dict) -> Any:
+    obj = cls.__new__(cls)
+    for k, v in data.items():
+        if k.startswith("@"):
+            continue
+        setattr(obj, k, decode(v))
+    return obj

@@ -1,0 +1,98 @@
+"""Numerical gradient checking — the test-suite backbone.
+
+Reference: ``gradientcheck/GradientCheckUtil.java:109`` — perturb every
+parameter ±ε in fp64, compare relative error against the analytic gradient.
+The reference checks in double precision; jax's CPU backend runs fp32 by
+default, so the checker promotes the whole computation to float64 via
+``jax.enable_x64`` (SURVEY.md §7 hard-part 2: fp64-on-CPU reference for the
+checker). Tests call this on tiny nets where the O(P) forward passes are
+cheap.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+DEFAULT_EPS = 1e-6
+DEFAULT_MAX_REL_ERROR = 1e-3
+DEFAULT_MIN_ABS_ERROR = 1e-8
+
+
+def check_gradients(
+    net,
+    ds: DataSet,
+    eps: float = DEFAULT_EPS,
+    max_rel_error: float = DEFAULT_MAX_REL_ERROR,
+    min_abs_error: float = DEFAULT_MIN_ABS_ERROR,
+    print_results: bool = False,
+    rng_seed: int = 12345,
+) -> bool:
+    """Analytic vs numerical gradients for a MultiLayerNetwork.
+
+    Deterministic rng is reused for every evaluation so dropout/noise layers
+    see identical masks (the reference requires deterministic=true layers).
+    Returns True if all parameters pass.
+    """
+    with jax.enable_x64(True):
+        params64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.params_
+        )
+        state64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), net.state_
+        )
+        f = jnp.asarray(np.asarray(ds.features), jnp.float64)
+        l = None if ds.labels is None else jnp.asarray(np.asarray(ds.labels), jnp.float64)
+        fm = None if ds.features_mask is None else jnp.asarray(np.asarray(ds.features_mask), jnp.float64)
+        lm = None if ds.labels_mask is None else jnp.asarray(np.asarray(ds.labels_mask), jnp.float64)
+        rng = jax.random.PRNGKey(rng_seed)
+
+        def loss_fn(p):
+            loss, _ = net._loss_and_new_state(p, state64, f, l, fm, lm, rng, train=True)
+            return loss + net._reg_score(p)
+
+        analytic = jax.grad(loss_fn)(params64)
+        loss_fn_j = jax.jit(loss_fn)
+
+        total, failed = 0, 0
+        max_err_seen = 0.0
+        for i, layer_params in enumerate(params64):
+            for name, arr in layer_params.items():
+                flat = np.array(arr, np.float64).reshape(-1)  # writable copy
+                g_flat = np.asarray(analytic[i][name], np.float64).reshape(-1)
+                for j in range(flat.size):
+                    orig = flat[j]
+                    flat[j] = orig + eps
+                    p_plus = _with(params64, i, name, flat.reshape(arr.shape))
+                    s_plus = float(loss_fn_j(p_plus))
+                    flat[j] = orig - eps
+                    p_minus = _with(params64, i, name, flat.reshape(arr.shape))
+                    s_minus = float(loss_fn_j(p_minus))
+                    flat[j] = orig
+                    numeric = (s_plus - s_minus) / (2 * eps)
+                    analytic_g = g_flat[j]
+                    denom = abs(numeric) + abs(analytic_g)
+                    rel = abs(numeric - analytic_g) / denom if denom > 0 else 0.0
+                    total += 1
+                    if rel > max_rel_error and abs(numeric - analytic_g) > min_abs_error:
+                        failed += 1
+                        if print_results:
+                            print(
+                                f"FAIL layer {i} param {name}[{j}]: "
+                                f"analytic={analytic_g:.8g} numeric={numeric:.8g} rel={rel:.4g}"
+                            )
+                    max_err_seen = max(max_err_seen, rel if denom > 0 else 0.0)
+        if print_results:
+            print(f"Gradient check: {total - failed}/{total} passed; max rel err {max_err_seen:.3g}")
+        return failed == 0
+
+
+def _with(params, i, name, new_arr):
+    out = [dict(p) for p in params]
+    out[i][name] = jnp.asarray(new_arr, jnp.float64)
+    return out

@@ -1,0 +1,584 @@
+"""MultiLayerNetwork: sequential network runtime.
+
+Reference: ``nn/multilayer/MultiLayerNetwork.java`` (3,545 LoC) — init with
+flattened params (``:584-718``), training loop (``fit(DataSetIterator)
+:1268``), backprop (``:1363``), ``computeGradientAndScore():2360``,
+inference (``output:2031``), rnn stepping, tBPTT (``:1315-1317``).
+
+TPU-native design: the entire step — forward, backward, gradient
+normalization, regularization, updater math, parameter update, constraints
+— is ONE jit-compiled XLA program with donated buffers (the functional
+equivalent of the reference's in-place flattened-view update,
+``StochasticGradientDescent.java:78``). This removes the per-op JNI
+dispatch that defines the reference's hot loop (SURVEY.md §3.1) and lets
+XLA fuse elementwise work into the MXU matmuls.
+
+State layout:
+- ``self.params_``: list (per layer) of dicts name→array
+- ``self.state_``:  list of dicts (BN running stats, center-loss centers)
+- ``self.opt_state_``: list of dicts name→updater-state-dict
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers.base import Layer, apply_input_dropout
+from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer, FrozenLayer
+from deeplearning4j_tpu.regularization import normalize_layer_gradients
+from deeplearning4j_tpu.updaters import NoOp
+
+Array = jax.Array
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16,
+            "float64": jnp.float64}[name]
+
+
+def _apply_layer_updates(layers, params, grads, opt_state, t, iteration, epoch):
+    """Shared per-layer update pipeline (both train steps): gradient
+    normalization → l1/l2/weight-decay → updater → constraints.
+
+    Order matches the reference (``BaseMultiLayerUpdater.update``: preApply
+    normalization, then UpdaterBlock regularization + updater math, then
+    ``BaseOptimizer.applyConstraints``)."""
+    new_params, new_opt = [], []
+    for i, layer in enumerate(layers):
+        p_i, g_i, o_i = params[i], grads[i], opt_state[i]
+        if isinstance(layer, FrozenLayer) or not p_i:
+            new_params.append(p_i)
+            new_opt.append(o_i)
+            continue
+        g_i = normalize_layer_gradients(
+            g_i, layer.gradient_normalization, layer.gradient_normalization_threshold
+        )
+        reg = layer.regularization
+        if reg is not None:
+            out = {}
+            for k, g in g_i.items():
+                term = reg.grad_term(k, p_i[k])
+                out[k] = g if term is None else g + term
+            g_i = out
+        upd = layer.updater if layer.updater is not None else NoOp()
+        np_i, no_i = {}, {}
+        for name, g in g_i.items():
+            delta, new_slot = upd.apply(g, o_i[name], t, iteration, epoch)
+            np_i[name] = p_i[name] - delta
+            no_i[name] = new_slot
+        for c in layer.constraints:
+            for name in np_i:
+                if name in c.applies_to:
+                    np_i[name] = c.apply(np_i[name])
+        new_params.append(np_i)
+        new_opt.append(no_i)
+    return new_params, new_opt
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self.params_: Optional[List[Dict[str, Array]]] = None
+        self.state_: Optional[List[Dict[str, Array]]] = None
+        self.opt_state_: Optional[List[Dict[str, Any]]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.score_: Optional[Array] = None  # device scalar; float(score()) syncs
+        self.listeners: List[Any] = []
+        self._rng = jax.random.PRNGKey(conf.global_conf.seed)
+        self._rnn_carries: Optional[List[Any]] = None
+        self._jit_cache: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng: Optional[Array] = None) -> "MultiLayerNetwork":
+        """Allocate parameters (reference ``MultiLayerNetwork.init()``)."""
+        if self.conf.input_type is None:
+            raise ValueError("Configuration needs set_input_type(...) before init()")
+        rng = rng if rng is not None else jax.random.PRNGKey(self.conf.global_conf.seed)
+        dtype = _dtype_of(self.conf.global_conf.dtype)
+        types = self.conf.layer_types()
+        params, state, opt_state = [], [], []
+        keys = jax.random.split(rng, len(self.layers))
+        for i, layer in enumerate(self.layers):
+            p = layer.init_params(keys[i], types[i], dtype)
+            s = layer.init_layer_state(types[i], dtype)
+            params.append(p)
+            state.append(s)
+            upd = layer.updater if layer.updater is not None else NoOp()
+            opt_state.append({name: upd.init_state(arr) for name, arr in p.items()})
+        self.params_ = params
+        self.state_ = state
+        self.opt_state_ = opt_state
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    # ------------------------------------------------------------- forward fn
+    def _forward(
+        self,
+        params,
+        state,
+        x,
+        *,
+        train: bool,
+        rng: Optional[Array],
+        fmask=None,
+        stop_before: Optional[int] = None,
+        carries: Optional[List[Any]] = None,
+        collect: bool = False,
+    ):
+        """Pure forward pass.
+
+        Returns (x, mask, new_states, new_carries, activations) where x is
+        the activation *into* layer ``stop_before`` (after its preprocessor
+        and input-dropout) or the final output if stop_before is None.
+        """
+        n = len(self.layers)
+        stop = n if stop_before is None else stop_before
+        rngs = (
+            jax.random.split(rng, n) if rng is not None else [None] * n
+        )
+        mask = fmask
+        new_states: List[Dict[str, Array]] = []
+        new_carries: List[Any] = [None] * n
+        acts = []
+        for i in range(n):
+            layer = self.layers[i]
+            if i in self.conf.preprocessors:
+                prep = self.conf.preprocessors[i]
+                x = prep.pre_process(x, mask)
+                mask = prep.feed_forward_mask(mask)
+            x = apply_input_dropout(layer, x, train, rngs[i])
+            if i >= stop:
+                break
+            if (
+                carries is not None
+                and isinstance(layer, BaseRecurrentLayer)
+                and carries[i] is not None
+            ):
+                x, c = layer.apply_with_carry(
+                    params[i], x, carries[i], mask=mask, train=train, rng=rngs[i]
+                )
+                new_carries[i] = c
+                st = state[i]
+            else:
+                x, st = layer.apply(
+                    params[i], x, state=state[i], train=train, rng=rngs[i], mask=mask
+                )
+            new_states.append(st if st is not None else {})
+            if collect:
+                acts.append(x)
+            if layer.is_recurrent and mask is not None:
+                pass  # recurrent layers preserve (b, T) masks
+            elif x.ndim == 2 and mask is not None and mask.ndim > 1:
+                mask = None  # mask consumed by pooling/last-step layers
+        return x, mask, new_states, new_carries, acts
+
+    def _output_layer(self):
+        last = self.layers[-1]
+        if not last.is_output_layer:
+            raise ValueError(f"Last layer {last} is not an output layer")
+        return last
+
+    # ---------------------------------------------------------------- scoring
+    def _loss_and_new_state(self, params, state, features, labels, fmask, lmask, rng, train=True):
+        n = len(self.layers)
+        x, mask, new_states, _, _ = self._forward(
+            params, state, features, train=train, rng=rng, fmask=fmask, stop_before=n - 1
+        )
+        out_layer = self._output_layer()
+        label_mask = lmask if lmask is not None else mask
+        if isinstance(out_layer, CenterLossOutputLayer):
+            per_ex = out_layer.compute_score(params[-1], x, labels, label_mask, state=state[-1])
+            new_last_state = out_layer.update_centers(state[-1], x, labels) if train else state[-1]
+        else:
+            per_ex = out_layer.compute_score(params[-1], x, labels, label_mask)
+            new_last_state = state[-1]
+        new_states.append(new_last_state)
+        loss = jnp.mean(per_ex)
+        return loss, new_states
+
+    def _reg_score(self, params):
+        s = jnp.asarray(0.0, jnp.float32)
+        for i, layer in enumerate(self.layers):
+            reg = layer.regularization
+            if reg is None:
+                continue
+            for name, arr in params[i].items():
+                s = s + reg.score_term(name, arr)
+        return s
+
+    # ------------------------------------------------------------- train step
+    def _make_train_step(self):
+        layers = self.layers
+
+        def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
+            def loss_fn(p):
+                loss, new_states = self._loss_and_new_state(
+                    p, state, features, labels, fmask, lmask, rng, train=True
+                )
+                return loss, new_states
+
+            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            t = iteration + 1  # 1-based updater step for bias correction
+            new_params, new_opt = _apply_layer_updates(
+                layers, params, grads, opt_state, t, iteration, epoch
+            )
+            score = loss + self._reg_score(params)
+            return new_params, new_opt, new_states, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_jit(self, key, maker):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = maker()
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(
+        self,
+        data: Union[DataSet, DataSetIterator, np.ndarray],
+        labels: Optional[np.ndarray] = None,
+        epochs: int = 1,
+        batch_size: int = 32,
+    ) -> "MultiLayerNetwork":
+        """Train (reference ``fit(DataSetIterator):1268`` semantics incl.
+        async prefetch and the tBPTT branch)."""
+        if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
+            data = DataSet(np.asarray(data), None if labels is None else np.asarray(labels))
+        if isinstance(data, DataSet):
+            it: DataSetIterator = ListDataSetIterator(data, batch_size)
+        else:
+            it = data
+        for _ in range(epochs):
+            self._fit_one_epoch(it)
+        return self
+
+    def _fit_one_epoch(self, it: DataSetIterator):
+        for lst in self.listeners:
+            if hasattr(lst, "on_epoch_start"):
+                lst.on_epoch_start(self)
+        wrapped = AsyncDataSetIterator(it, queue_size=4) if it.async_supported() else it
+        step = self._get_jit("train", self._make_train_step)
+        use_tbptt = self.conf.backprop_type == "tbptt"
+        try:
+            for ds in wrapped:
+                if use_tbptt and ds.features.ndim == 3:
+                    self._fit_tbptt_batch(ds)
+                else:
+                    self._fit_batch(step, ds)
+        finally:
+            if wrapped is not it:
+                wrapped.shutdown()  # join prefetch thread; caller resets inner
+        it.reset()
+        self.epoch += 1
+        for lst in self.listeners:
+            if hasattr(lst, "on_epoch_end"):
+                lst.on_epoch_end(self)
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _fit_batch(self, step, ds: DataSet):
+        self.params_, self.opt_state_, self.state_, self.score_ = step(
+            self.params_, self.opt_state_, self.state_,
+            jnp.asarray(ds.features),
+            None if ds.labels is None else jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            self._next_rng(),
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(self.epoch, jnp.int32),
+        )
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # ----------------------------------------------------------------- tBPTT
+    def _make_tbptt_step(self):
+        layers = self.layers
+
+        def step(params, opt_state, state, carries, features, labels, fmask, lmask, rng, iteration, epoch):
+            n = len(layers)
+
+            def loss_fn(p):
+                x, mask, new_states, new_carries, _ = self._forward(
+                    p, state, features, train=True, rng=rng, fmask=fmask,
+                    stop_before=n - 1, carries=carries,
+                )
+                out_layer = self._output_layer()
+                label_mask = lmask if lmask is not None else mask
+                per_ex = out_layer.compute_score(p[-1], x, labels, label_mask)
+                new_states.append(state[-1])
+                return jnp.mean(per_ex), (new_states, new_carries)
+
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            t = iteration + 1
+            new_params, new_opt = _apply_layer_updates(
+                layers, params, grads, opt_state, t, iteration, epoch
+            )
+            # detach carries between chunks (reference tBPTT semantics)
+            new_carries = jax.lax.stop_gradient(new_carries)
+            score = loss + self._reg_score(params)
+            return new_params, new_opt, new_states, new_carries, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _init_carries(self, batch: int, dtype=jnp.float32) -> List[Any]:
+        carries: List[Any] = []
+        for layer in self.layers:
+            if isinstance(layer, BaseRecurrentLayer):
+                carries.append(layer.init_carry(batch, dtype))
+            else:
+                carries.append(None)
+        return carries
+
+    def _fit_tbptt_batch(self, ds: DataSet):
+        """Chunked truncated-BPTT over the time axis (reference
+        ``doTruncatedBPTT``, ``MultiLayerNetwork.java:1315-1317``): carries
+        thread across chunks, gradients stop at chunk boundaries."""
+        step = self._get_jit("tbptt", self._make_tbptt_step)
+        T = ds.features.shape[1]
+        L = self.conf.tbptt_fwd_length
+        if ds.labels is not None and ds.labels.ndim != 3:
+            raise ValueError(
+                "tBPTT requires per-timestep labels (batch, time, nOut); got "
+                f"labels shape {ds.labels.shape}. For per-sequence labels use "
+                "standard backprop (the reference has the same requirement)."
+            )
+        carries = self._init_carries(ds.features.shape[0])
+        for lo in range(0, T, L):
+            hi = min(lo + L, T)
+            f = jnp.asarray(ds.features[:, lo:hi])
+            l = None if ds.labels is None else jnp.asarray(ds.labels[:, lo:hi])
+            fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, lo:hi])
+            lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, lo:hi])
+            (self.params_, self.opt_state_, self.state_, carries, self.score_) = step(
+                self.params_, self.opt_state_, self.state_, carries, f, l, fm, lm,
+                self._next_rng(),
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # -------------------------------------------------------------- inference
+    def _make_output_fn(self):
+        def run(params, state, x, fmask):
+            y, _, _, _, _ = self._forward(params, state, x, train=False, rng=None, fmask=fmask)
+            return y
+
+        return jax.jit(run)
+
+    def output(self, x, mask=None) -> np.ndarray:
+        """Inference (reference ``output:2031``)."""
+        fn = self._get_jit("output", self._make_output_fn)
+        y = fn(self.params_, self.state_, jnp.asarray(x),
+               None if mask is None else jnp.asarray(mask))
+        return np.asarray(y)
+
+    def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:
+        """All layer activations (reference ``feedForward``); unjitted
+        introspection path (SURVEY.md §7 hard-part 1)."""
+        _, _, _, _, acts = self._forward(
+            self.params_, self.state_, jnp.asarray(x), train=train,
+            rng=self._next_rng() if train else None, collect=True,
+        )
+        return [np.asarray(a) for a in acts]
+
+    # -------------------------------------------------------------- rnn state
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_time_step(self, x) -> np.ndarray:
+        """Stateful streaming inference (reference ``rnnTimeStep``)."""
+        x = jnp.asarray(x)
+        squeeze = False
+        if x.ndim == 2:  # (b, size) → single step
+            x = x[:, None, :]
+            squeeze = True
+        if self._rnn_carries is None:
+            self._rnn_carries = self._init_carries(x.shape[0], x.dtype)
+
+        def run(params, state, x, carries):
+            y, _, _, new_carries, _ = self._forward(
+                params, state, x, train=False, rng=None, carries=carries
+            )
+            return y, new_carries
+
+        fn = self._get_jit("rnn_step", lambda: jax.jit(run))
+        y, self._rnn_carries = fn(self.params_, self.state_, x, self._rnn_carries)
+        y = np.asarray(y)
+        return y[:, -1, :] if squeeze else y
+
+    # ------------------------------------------------------------------ score
+    def score(self, ds: Optional[DataSet] = None) -> float:
+        """Loss incl. regularization terms (reference ``score()``)."""
+        if ds is None:
+            if self.score_ is None:
+                raise ValueError("No score available; fit() first or pass a DataSet")
+            return float(self.score_)
+
+        def run(params, state, f, l, fm, lm):
+            loss, _ = self._loss_and_new_state(params, state, f, l, fm, lm, None, train=False)
+            return loss + self._reg_score(params)
+
+        fn = self._get_jit("score", lambda: jax.jit(run))
+        return float(
+            fn(self.params_, self.state_, jnp.asarray(ds.features),
+               None if ds.labels is None else jnp.asarray(ds.labels),
+               None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+               None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+        )
+
+    def compute_gradient_and_score(self, ds: DataSet):
+        """Introspection API (reference ``computeGradientAndScore():2360``):
+        returns (gradients pytree, score) without updating params."""
+
+        def run(params, state, f, l, fm, lm, rng):
+            def loss_fn(p):
+                loss, _ = self._loss_and_new_state(p, state, f, l, fm, lm, rng, train=True)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return grads, loss + self._reg_score(params)
+
+        fn = self._get_jit("grad_score", lambda: jax.jit(run))
+        grads, score = fn(
+            self.params_, self.state_, jnp.asarray(ds.features),
+            None if ds.labels is None else jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            self._next_rng(),
+        )
+        return grads, float(score)
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, it: Union[DataSetIterator, DataSet]):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(it, DataSet):
+            it = ListDataSetIterator(it, 256)
+        for ds in it:
+            out = self.output(ds.features, mask=ds.features_mask)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        it.reset()
+        return ev
+
+    def evaluate_regression(self, it: Union[DataSetIterator, DataSet]):
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        ev = RegressionEvaluation()
+        if isinstance(it, DataSet):
+            it = ListDataSetIterator(it, 256)
+        for ds in it:
+            out = self.output(ds.features, mask=ds.features_mask)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        it.reset()
+        return ev
+
+    # ------------------------------------------------------- params utilities
+    def num_params(self) -> int:
+        assert self.params_ is not None
+        return int(sum(int(np.prod(a.shape)) for p in self.params_ for a in p.values()))
+
+    def params_flat(self) -> np.ndarray:
+        """Single flattened parameter vector (reference ``params()``; order:
+        layer index asc, param name sorted asc — deterministic for
+        checkpoint format)."""
+        assert self.params_ is not None
+        chunks = []
+        for p in self.params_:
+            for name in sorted(p):
+                chunks.append(np.asarray(p[name], np.float32).reshape(-1))
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_params_flat(self, vec: np.ndarray) -> None:
+        assert self.params_ is not None
+        vec = np.asarray(vec, np.float32)
+        expected = self.num_params()
+        if vec.size != expected:
+            raise ValueError(f"Param vector length {vec.size} != model size {expected}")
+        off = 0
+        new_params = []
+        for p in self.params_:
+            np_i = {}
+            for name in sorted(p):
+                n = int(np.prod(p[name].shape))
+                np_i[name] = jnp.asarray(
+                    vec[off : off + n].reshape(p[name].shape), p[name].dtype
+                )
+                off += n
+            new_params.append(np_i)
+        if off != vec.size:
+            raise ValueError(f"Param vector length {vec.size} != model size {off}")
+        self.params_ = new_params
+
+    def opt_state_flat(self) -> np.ndarray:
+        """Flattened updater state (order: layer, param name, slot name)."""
+        assert self.opt_state_ is not None
+        chunks = []
+        for o in self.opt_state_:
+            for name in sorted(o):
+                slots = o[name]
+                for slot in sorted(slots):
+                    chunks.append(np.asarray(slots[slot], np.float32).reshape(-1))
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_opt_state_flat(self, vec: np.ndarray) -> None:
+        assert self.opt_state_ is not None
+        vec = np.asarray(vec, np.float32)
+        off = 0
+        new_opt = []
+        for o in self.opt_state_:
+            no_i = {}
+            for name in sorted(o):
+                slots = {}
+                for slot in sorted(o[name]):
+                    arr = o[name][slot]
+                    n = int(np.prod(arr.shape))
+                    slots[slot] = jnp.asarray(vec[off : off + n].reshape(arr.shape), arr.dtype)
+                    off += n
+                no_i[name] = slots
+            new_opt.append(no_i)
+        self.opt_state_ = new_opt
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners) -> None:
+        self.listeners.extend(listeners)
+
+    def clone(self) -> "MultiLayerNetwork":
+        """Deep copy via config JSON + param copy (reference ``clone()``)."""
+        conf = MultiLayerConfiguration.from_json(self.conf.to_json())
+        net = MultiLayerNetwork(conf)
+        if self.params_ is not None:
+            net.init()
+            net.params_ = jax.tree_util.tree_map(lambda a: a, self.params_)
+            net.state_ = jax.tree_util.tree_map(lambda a: a, self.state_)
+            net.opt_state_ = jax.tree_util.tree_map(lambda a: a, self.opt_state_)
+        return net

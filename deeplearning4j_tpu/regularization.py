@@ -66,12 +66,16 @@ class RegularizationConf:
         return term
 
     def score_term(self, param_name: str, param: Array) -> Array:
+        # accumulate in >= fp32 (half-precision sums overflow/lose bits) but
+        # keep fp64 when the gradient checker runs the net in float64
         l1, l2, _wd = self.coeffs_for(param_name)
-        s = jnp.asarray(0.0, jnp.float32)
+        acc = jnp.promote_types(param.dtype, jnp.float32)
+        p = param.astype(acc)
+        s = jnp.zeros((), acc)
         if l2:
-            s = s + 0.5 * l2 * jnp.sum(param.astype(jnp.float32) ** 2)
+            s = s + 0.5 * l2 * jnp.sum(p**2)
         if l1:
-            s = s + l1 * jnp.sum(jnp.abs(param.astype(jnp.float32)))
+            s = s + l1 * jnp.sum(jnp.abs(p))
         return s
 
     def to_dict(self):

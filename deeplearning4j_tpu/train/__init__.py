@@ -1,0 +1,18 @@
+"""Training utilities: listeners, checkpointing, early stopping."""
+
+from deeplearning4j_tpu.train.listeners import (
+    CheckpointListener,
+    CollectScoresIterationListener,
+    EvaluativeListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    SleepyTrainingListener,
+    TimeIterationListener,
+    TrainingListener,
+)
+
+__all__ = [
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "CollectScoresIterationListener", "EvaluativeListener", "CheckpointListener",
+    "TimeIterationListener", "SleepyTrainingListener",
+]

@@ -1,0 +1,233 @@
+"""Training listeners.
+
+Reference: ``optimize/api/TrainingListener.java:23-71`` SPI +
+``optimize/listeners/{ScoreIterationListener,PerformanceListener,
+EvaluativeListener,CollectScoresIterationListener,TimeIterationListener,
+SleepyTrainingListener}.java`` and
+``optimize/listeners/checkpoint/CheckpointListener.java:72-85``.
+
+Note on async dispatch: the jitted train step returns the score as a device
+scalar without blocking; a listener that reads ``model.score()`` forces a
+sync. PerformanceListener therefore reports throughput based on wall time
+between iterations (ETL + compute overlap included), syncing only at its
+reporting frequency — keep ``frequency`` high for accurate TPU throughput.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Base listener; all hooks are no-ops (reference ``TrainingListener``)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:  # noqa: D401
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs/prints the score every N iterations (reference
+    ``ScoreIterationListener``)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Optional[Callable[[str], None]] = None):
+        self.print_iterations = max(1, int(print_iterations))
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {model.score():.6f}")
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collects (iteration, score) pairs (reference
+    ``CollectScoresIterationListener``)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))
+
+
+class PerformanceListener(TrainingListener):
+    """samples/sec + batches/sec (reference ``PerformanceListener.java:22-87``)."""
+
+    def __init__(self, frequency: int = 10, report_score: bool = False,
+                 printer: Optional[Callable[[str], None]] = None):
+        self.frequency = max(1, int(frequency))
+        self.report_score = report_score
+        self.printer = printer or (lambda s: log.info(s))
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self._samples = 0
+        self.last_samples_per_sec: Optional[float] = None
+        self.last_batches_per_sec: Optional[float] = None
+
+    def iteration_done(self, model, iteration, epoch):
+        # batch size from the model's most recent fit is unknown here; use
+        # tracked sample count when provided via model attribute if any.
+        bs = getattr(model, "last_batch_size", None)
+        if bs:
+            self._samples += bs
+        if self._last_time is None:
+            self._last_time = time.perf_counter()
+            self._last_iter = iteration
+            self._samples = 0
+            return
+        if (iteration - self._last_iter) >= self.frequency:
+            now = time.perf_counter()
+            dt = now - self._last_time
+            batches = iteration - self._last_iter
+            self.last_batches_per_sec = batches / dt
+            msg = f"iteration {iteration}: {self.last_batches_per_sec:.2f} batches/sec"
+            if bs:
+                self.last_samples_per_sec = self._samples / dt
+                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
+            if self.report_score:
+                msg += f", score {model.score():.6f}"
+            self.printer(msg)
+            self._last_time = now
+            self._last_iter = iteration
+            self._samples = 0
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (reference ``TimeIterationListener``)."""
+
+    def __init__(self, iteration_count: int, frequency: int = 100,
+                 printer: Optional[Callable[[str], None]] = None):
+        self.iteration_count = iteration_count
+        self.frequency = max(1, int(frequency))
+        self.printer = printer or (lambda s: log.info(s))
+        self.start = time.perf_counter()
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self.start
+            remaining = (self.iteration_count - iteration) * elapsed / iteration
+            self.printer(f"Remaining time estimate: {remaining:.1f}s ({iteration}/{self.iteration_count})")
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Injects latency for race/pipeline testing (reference
+    ``SleepyTrainingListener`` — SURVEY.md §4 mocks)."""
+
+    def __init__(self, timer_iteration_ms: float = 0.0, timer_epoch_ms: float = 0.0):
+        self.timer_iteration_ms = timer_iteration_ms
+        self.timer_epoch_ms = timer_epoch_ms
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.timer_iteration_ms > 0:
+            time.sleep(self.timer_iteration_ms / 1000.0)
+
+    def on_epoch_end(self, model):
+        if self.timer_epoch_ms > 0:
+            time.sleep(self.timer_epoch_ms / 1000.0)
+
+
+class EvaluativeListener(TrainingListener):
+    """Runs evaluation every N iterations/epochs (reference
+    ``EvaluativeListener``)."""
+
+    def __init__(self, iterator, frequency: int = 1, invocation: str = "epoch_end",
+                 printer: Optional[Callable[[str], None]] = None):
+        self.iterator = iterator
+        self.frequency = max(1, int(frequency))
+        self.invocation = invocation
+        self.printer = printer or (lambda s: log.info(s))
+        self.evaluations: List[object] = []
+
+    def _evaluate(self, model):
+        ev = model.evaluate(self.iterator)
+        self.evaluations.append(ev)
+        self.printer(f"Evaluation: accuracy={ev.accuracy():.4f} f1={ev.f1():.4f}")
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.invocation == "iteration_end" and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model):
+        if self.invocation == "epoch_end" and (model.epoch % self.frequency == 0):
+            self._evaluate(model)
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoints with retention (reference
+    ``CheckpointListener.java:72-85``: every N epochs/iterations/minutes,
+    keepLast/keepAll/keepLastAndEvery)."""
+
+    def __init__(
+        self,
+        directory: str,
+        save_every_n_epochs: Optional[int] = None,
+        save_every_n_iterations: Optional[int] = None,
+        save_every_minutes: Optional[float] = None,
+        keep_mode: str = "all",  # all | last | last_and_every
+        keep_last: int = 1,
+        keep_every: int = 0,
+    ):
+        import os
+
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.save_every_n_epochs = save_every_n_epochs
+        self.save_every_n_iterations = save_every_n_iterations
+        self.save_every_minutes = save_every_minutes
+        self.keep_mode = keep_mode
+        self.keep_last = keep_last
+        self.keep_every = keep_every
+        self._last_save_time = time.perf_counter()
+        self.checkpoints: List[str] = []
+        self._counter = 0
+
+    def _save(self, model, iteration, epoch):
+        import os
+
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        self._counter += 1
+        path = os.path.join(
+            self.directory, f"checkpoint_{self._counter}_iter_{iteration}_epoch_{epoch}.zip"
+        )
+        ModelSerializer.write_model(model, path, save_updater=True)
+        self.checkpoints.append(path)
+        self._apply_retention()
+
+    def _apply_retention(self):
+        import os
+
+        if self.keep_mode == "all":
+            return
+        keep = set(self.checkpoints[-self.keep_last:])
+        if self.keep_mode == "last_and_every" and self.keep_every > 0:
+            for i, p in enumerate(self.checkpoints, start=1):
+                if i % self.keep_every == 0:
+                    keep.add(p)
+        for p in list(self.checkpoints):
+            if p not in keep and os.path.exists(p):
+                os.remove(p)
+                self.checkpoints.remove(p)
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.save_every_n_iterations and iteration % self.save_every_n_iterations == 0:
+            self._save(model, iteration, epoch)
+        elif self.save_every_minutes:
+            if (time.perf_counter() - self._last_save_time) >= self.save_every_minutes * 60:
+                self._save(model, iteration, epoch)
+                self._last_save_time = time.perf_counter()
+
+    def on_epoch_end(self, model):
+        if self.save_every_n_epochs and model.epoch % self.save_every_n_epochs == 0:
+            self._save(model, model.iteration, model.epoch)

@@ -1,0 +1,130 @@
+"""Zip-format model checkpointing.
+
+Reference: ``util/ModelSerializer.java:39-125`` — entries
+``configuration.json`` + ``coefficients.bin`` + ``updaterState.bin``
+(+ optional normalizer). Same layout here (float32 little-endian flattened
+buffers; order documented in ``MultiLayerNetwork.params_flat``), plus two
+additions the functional design needs: ``state.bin`` (BN running stats /
+center-loss centers — the reference stores these inside params) and
+``meta.json`` (iteration/epoch counters so optimizers resume exactly,
+matching the reference's guarantee that updater state is part of the
+checkpoint, SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+CONFIG_ENTRY = "configuration.json"
+COEFFICIENTS_ENTRY = "coefficients.bin"
+UPDATER_ENTRY = "updaterState.bin"
+STATE_ENTRY = "state.bin"
+META_ENTRY = "meta.json"
+NORMALIZER_ENTRY = "normalizer.bin"
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(model, path: str, save_updater: bool = True, normalizer=None) -> None:
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr(CONFIG_ENTRY, model.conf.to_json())
+            z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
+            if save_updater and model.opt_state_ is not None:
+                z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
+            state_flat = _flatten_state(model.state_)
+            z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
+            z.writestr(
+                META_ENTRY,
+                json.dumps({
+                    "iteration": model.iteration,
+                    "epoch": model.epoch,
+                    "model_type": type(model).__name__,
+                    "framework": "deeplearning4j_tpu",
+                }),
+            )
+            if normalizer is not None:
+                z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
+
+    @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with zipfile.ZipFile(path, "r") as z:
+            conf = MultiLayerConfiguration.from_json(z.read(CONFIG_ENTRY).decode())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            coef = np.frombuffer(z.read(COEFFICIENTS_ENTRY), dtype="<f4")
+            net.set_params_flat(coef)
+            names = z.namelist()
+            if load_updater and UPDATER_ENTRY in names:
+                net.set_opt_state_flat(np.frombuffer(z.read(UPDATER_ENTRY), dtype="<f4"))
+            if STATE_ENTRY in names:
+                _unflatten_state(net, np.frombuffer(z.read(STATE_ENTRY), dtype="<f4"))
+            if META_ENTRY in names:
+                meta = json.loads(z.read(META_ENTRY).decode())
+                net.iteration = meta.get("iteration", 0)
+                net.epoch = meta.get("epoch", 0)
+        return net
+
+    @staticmethod
+    def restore_normalizer(path: str):
+        with zipfile.ZipFile(path, "r") as z:
+            if NORMALIZER_ENTRY not in z.namelist():
+                return None
+            from deeplearning4j_tpu.data.normalizers import Normalizer
+
+            return Normalizer.from_dict(json.loads(z.read(NORMALIZER_ENTRY).decode()))
+
+
+def _flatten_state(state) -> np.ndarray:
+    chunks = []
+    for s in state or []:
+        for name in sorted(s):
+            chunks.append(np.asarray(s[name], np.float32).reshape(-1))
+    return np.concatenate(chunks) if chunks else np.zeros((0,), np.float32)
+
+
+def _unflatten_state(net, vec: np.ndarray) -> None:
+    off = 0
+    new_state = []
+    for s in net.state_:
+        ns = {}
+        for name in sorted(s):
+            n = int(np.prod(s[name].shape))
+            ns[name] = jnp.asarray(vec[off : off + n].reshape(s[name].shape), s[name].dtype)
+            off += n
+        new_state.append(ns)
+    net.state_ = new_state
+
+
+class ModelGuesser:
+    """Sniff a saved file (reference ``util/ModelGuesser.java``)."""
+
+    @staticmethod
+    def load_model_guess(path: str):
+        with zipfile.ZipFile(path, "r") as z:
+            names = z.namelist()
+            if CONFIG_ENTRY in names:
+                meta = {}
+                if META_ENTRY in names:
+                    meta = json.loads(z.read(META_ENTRY).decode())
+                model_type = meta.get("model_type", "MultiLayerNetwork")
+                if model_type == "ComputationGraph":
+                    try:
+                        from deeplearning4j_tpu.nn.graph import ComputationGraph
+                    except ImportError as e:
+                        raise NotImplementedError(
+                            "ComputationGraph restore not available in this build"
+                        ) from e
+                    return ComputationGraph.restore(path)
+                return ModelSerializer.restore_multi_layer_network(path)
+        raise ValueError(f"Cannot identify model format for {path}")

@@ -13,7 +13,9 @@ here we enable jax's strongest always-on checks instead.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment points JAX_PLATFORMS at the remote TPU
+# ("axon"); tests must run on the virtual 8-device CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +23,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# The axon TPU plugin overrides JAX_PLATFORMS; the config update is the
+# authoritative switch to the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 # NaN debugging is opt-in per test (jax.debug_nans breaks some valid ops);
 # keep x64 off to match TPU numerics, tests that need fp64 enable it locally.

@@ -1,0 +1,173 @@
+"""Gradient checks — analytic (autodiff) vs numerical in fp64.
+
+Modeled on the reference backbone suites
+``gradientcheck/GradientCheckTestsComputationGraph.java`` /
+``CNNGradientCheckTest.java`` (SURVEY.md §4.1). Tiny nets, fp64.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    ElementWiseMultiplicationLayer,
+    GlobalPoolingLayer,
+    GravesLSTM,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.gradient_check import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.updaters import Sgd
+
+
+def _data(n=4, n_in=3, n_classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    y = np.eye(n_classes, dtype=np.float32)[rng.integers(0, n_classes, n)]
+    return DataSet(x, y)
+
+
+def _build(layers, input_type, l1=0.0, l2=0.0):
+    b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).weight_init("xavier")
+    if l1:
+        b = b.l1(l1)
+    if l2:
+        b = b.l2(l2)
+    lb = b.list()
+    for l in layers:
+        lb = lb.layer(l)
+    return MultiLayerNetwork(lb.set_input_type(input_type).build()).init()
+
+
+class TestGradientChecks:
+    def test_mlp_mcxent(self):
+        net = _build(
+            [DenseLayer(n_out=5, activation="tanh"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(3),
+        )
+        assert check_gradients(net, _data(), print_results=True)
+
+    def test_mlp_mse_identity(self):
+        rng = np.random.default_rng(1)
+        ds = DataSet(rng.standard_normal((4, 3)).astype(np.float32),
+                     rng.standard_normal((4, 2)).astype(np.float32))
+        net = _build(
+            [DenseLayer(n_out=4, activation="sigmoid"),
+             OutputLayer(n_out=2, activation="identity", loss="mse")],
+            InputType.feed_forward(3),
+        )
+        assert check_gradients(net, ds, print_results=True)
+
+    def test_mlp_with_l1_l2(self):
+        net = _build(
+            [DenseLayer(n_out=4, activation="relu"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(3), l1=0.01, l2=0.02,
+        )
+        assert check_gradients(net, _data(seed=3), print_results=True)
+
+    def test_elementwise_mult(self):
+        net = _build(
+            [DenseLayer(n_out=4, activation="tanh"),
+             ElementWiseMultiplicationLayer(activation="identity"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(3),
+        )
+        assert check_gradients(net, _data(), print_results=True)
+
+    def test_cnn(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 6, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        net = _build(
+            [ConvolutionLayer(n_out=2, kernel_size=3, activation="tanh"),
+             SubsamplingLayer(kernel_size=2, stride=2, pooling_type="max"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(6, 6, 1),
+        )
+        assert check_gradients(net, DataSet(x, y), print_results=True)
+
+    def test_cnn_avgpool_batchnorm(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 6, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        net = _build(
+            [ConvolutionLayer(n_out=2, kernel_size=3, activation="identity"),
+             BatchNormalization(),
+             SubsamplingLayer(kernel_size=2, stride=2, pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(6, 6, 1),
+        )
+        assert check_gradients(net, DataSet(x, y), print_results=True)
+
+    def test_lstm_global_pool(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 5, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        net = _build(
+            [LSTM(n_out=3),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(2, 5),
+        )
+        assert check_gradients(net, DataSet(x, y), print_results=True)
+
+    def test_graves_lstm_rnn_output(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 4))]
+        net = _build(
+            [GravesLSTM(n_out=3),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(2, 4),
+        )
+        assert check_gradients(net, DataSet(x, y), print_results=True)
+
+    def test_simple_rnn_masked(self):
+        rng = np.random.default_rng(0)
+        n, t = 3, 5
+        x = rng.standard_normal((n, t, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (n, t))]
+        mask = (np.arange(t)[None, :] < rng.integers(2, t + 1, n)[:, None]).astype(np.float32)
+        net = _build(
+            [SimpleRnn(n_out=3),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(2, t),
+        )
+        assert check_gradients(net, DataSet(x, y, features_mask=mask, labels_mask=mask),
+                               print_results=True)
+
+    @pytest.mark.parametrize("loss,act", [
+        ("xent", "sigmoid"),
+        ("l2", "identity"),
+        ("mae", "identity"),
+        ("kl_divergence", "softmax"),
+        ("poisson", "softplus"),
+        ("squared_hinge", "identity"),
+    ])
+    def test_loss_functions(self, loss, act):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        if loss in ("xent", "kl_divergence"):
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        elif loss == "poisson":
+            y = rng.poisson(2.0, (4, 2)).astype(np.float32)
+        elif loss == "squared_hinge":
+            y = (2 * rng.integers(0, 2, (4, 2)) - 1).astype(np.float32)
+        else:
+            y = rng.standard_normal((4, 2)).astype(np.float32)
+        net = _build(
+            [DenseLayer(n_out=4, activation="tanh"),
+             OutputLayer(n_out=2, activation=act, loss=loss)],
+            InputType.feed_forward(3),
+        )
+        assert check_gradients(net, DataSet(x, y), print_results=True), f"{loss}/{act}"

@@ -1,0 +1,377 @@
+"""MultiLayerNetwork behavioral tests.
+
+Modeled on reference ``nn/multilayer/MultiLayerTest.java`` (1,289 LoC) and
+config serde tests (SURVEY.md §4.2-4.3).
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    LSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.listeners import (
+    CollectScoresIterationListener,
+    ScoreIterationListener,
+)
+from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+from deeplearning4j_tpu.updaters import Adam, Sgd
+
+
+def small_classification_data(n=128, n_in=4, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    # separable blobs
+    centers = rng.standard_normal((n_classes, n_in)) * 3
+    cls = rng.integers(0, n_classes, n)
+    x = centers[cls] + rng.standard_normal((n, n_in)) * 0.5
+    y = np.eye(n_classes, dtype=np.float32)[cls]
+    return DataSet(x.astype(np.float32), y)
+
+
+def mlp_conf(n_in=4, n_classes=3, updater=None):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .updater(updater or Adam(0.01))
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(n_in))
+        .build()
+    )
+
+
+class TestBuild:
+    def test_shape_inference(self):
+        conf = mlp_conf()
+        assert conf.layers[0].n_in == 4
+        assert conf.layers[1].n_in == 16
+
+    def test_global_defaults_propagate(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .updater(Sgd(0.5))
+            .weight_init("relu")
+            .l2(1e-3)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3))
+            .build()
+        )
+        l0 = conf.layers[0]
+        assert isinstance(l0.updater, Sgd)
+        assert l0.weight_init == "relu"
+        assert l0.regularization.l2 == pytest.approx(1e-3)
+        assert l0.activation == "tanh"  # layer override wins
+
+    def test_cnn_preprocessor_auto_insert(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3, activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=2, stride=2))
+            .layer(DenseLayer(n_out=10, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional(12, 12, 1))
+            .build()
+        )
+        # CNN→FF preprocessor before the dense layer
+        assert 2 in conf.preprocessors
+        types = conf.layer_types()
+        # conv: 12-3+1=10, pool → 5; flatten 5*5*4=100
+        assert conf.layers[2].n_in == 100
+
+    def test_json_roundtrip(self):
+        conf = mlp_conf()
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf == conf2
+        net = MultiLayerNetwork(conf2).init()
+        assert net.num_params() == (4 * 16 + 16) + (16 * 3 + 3)
+
+
+class TestTraining:
+    def test_mlp_learns_blobs(self):
+        ds = small_classification_data()
+        net = MultiLayerNetwork(mlp_conf()).init()
+        s0 = None
+        for epoch in range(30):
+            net.fit(ds, batch_size=32)
+            if s0 is None:
+                s0 = net.score()
+        ev = net.evaluate(ds)
+        assert ev.accuracy() > 0.9, ev.stats()
+        assert net.score() < s0
+
+    def test_score_decreases_sgd(self):
+        ds = small_classification_data()
+        net = MultiLayerNetwork(mlp_conf(updater=Sgd(0.1))).init()
+        net.fit(ds, batch_size=128)
+        first = net.score()
+        for _ in range(20):
+            net.fit(ds, batch_size=128)
+        assert net.score() < first
+
+    def test_listeners_called(self):
+        ds = small_classification_data(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        collect = CollectScoresIterationListener(frequency=1)
+        printed = []
+        net.set_listeners(collect, ScoreIterationListener(1, printer=printed.append))
+        net.fit(ds, batch_size=32)  # 2 iterations
+        assert len(collect.scores) == 2
+        assert len(printed) == 2
+
+    def test_fit_ndarray_api(self):
+        ds = small_classification_data(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        net.fit(ds.features, ds.labels, epochs=2, batch_size=32)
+        assert net.iteration == 4
+
+    def test_output_shape_and_softmax(self):
+        ds = small_classification_data(n=16)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        out = net.output(ds.features)
+        assert out.shape == (16, 3)
+        np.testing.assert_allclose(out.sum(axis=1), np.ones(16), rtol=1e-4)
+
+    def test_l2_regularization_shrinks_weights(self):
+        ds = small_classification_data()
+        conf_reg = (
+            NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.1)).l2(0.5)
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        net_reg = MultiLayerNetwork(conf_reg).init()
+        net_plain = MultiLayerNetwork(mlp_conf(updater=Sgd(0.1))).init()
+        for _ in range(10):
+            net_reg.fit(ds, batch_size=128)
+            net_plain.fit(ds, batch_size=128)
+        w_reg = np.linalg.norm(np.asarray(net_reg.params_[0]["W"]))
+        w_plain = np.linalg.norm(np.asarray(net_plain.params_[0]["W"]))
+        assert w_reg < w_plain
+
+    def test_frozen_layer_params_fixed(self):
+        from deeplearning4j_tpu.nn.conf.layers import FrozenLayer
+
+        ds = small_classification_data(n=64)
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(42).updater(Sgd(0.5))
+            .list()
+            .layer(FrozenLayer(layer=DenseLayer(n_out=16, activation="relu")))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        w_before = np.asarray(net.params_[0]["W"]).copy()
+        out_w_before = np.asarray(net.params_[1]["W"]).copy()
+        net.fit(ds, batch_size=64)
+        np.testing.assert_array_equal(np.asarray(net.params_[0]["W"]), w_before)
+        assert not np.array_equal(np.asarray(net.params_[1]["W"]), out_w_before)
+
+
+class TestCnn:
+    def test_small_cnn_trains(self):
+        rng = np.random.default_rng(0)
+        n = 64
+        x = rng.standard_normal((n, 8, 8, 1)).astype(np.float32)
+        # class = whether center pixel is positive
+        cls = (x[:, 4, 4, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(0.01))
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3, activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=2, stride=2))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y)
+        for _ in range(30):
+            net.fit(ds, batch_size=64)
+        assert net.evaluate(ds).accuracy() > 0.85
+
+    def test_batchnorm_state_updates(self):
+        rng = np.random.default_rng(0)
+        x = (rng.standard_normal((32, 6)) * 5 + 2).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        conf = (
+            NeuralNetConfiguration.builder()
+            .updater(Sgd(0.01))
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(BatchNormalization())
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.feed_forward(6))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        mean_before = np.asarray(net.state_[1]["mean"]).copy()
+        net.fit(DataSet(x, y), batch_size=32)
+        mean_after = np.asarray(net.state_[1]["mean"])
+        assert not np.allclose(mean_before, mean_after)
+
+
+class TestRnn:
+    def _seq_data(self, n=32, t=10, d=3, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((n, t, d)).astype(np.float32)
+        cls = (x.mean(axis=(1, 2)) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        return DataSet(x, y)
+
+    def test_lstm_classifier_trains(self):
+        ds = self._seq_data()
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(0.02))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(3, 10))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(40):
+            net.fit(ds, batch_size=32)
+        assert net.evaluate(ds).accuracy() > 0.85
+
+    def test_rnn_output_layer_per_timestep(self):
+        rng = np.random.default_rng(0)
+        n, t, d = 16, 6, 4
+        x = rng.standard_normal((n, t, d)).astype(np.float32)
+        cls = (x.sum(axis=2) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]  # (n, t, 2)
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(0.02))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(d, t))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y)
+        for _ in range(10):
+            net.fit(ds, batch_size=16)
+        out = net.output(x)
+        assert out.shape == (n, t, 2)
+
+    def test_masked_sequences(self):
+        rng = np.random.default_rng(0)
+        n, t, d = 16, 8, 3
+        x = rng.standard_normal((n, t, d)).astype(np.float32)
+        lengths = rng.integers(2, t + 1, n)
+        mask = (np.arange(t)[None, :] < lengths[:, None]).astype(np.float32)
+        cls = np.array([
+            (x[i, : lengths[i]].mean() > 0) for i in range(n)
+        ]).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(0.02))
+            .list()
+            .layer(LSTM(n_out=8))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(d, t))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        ds = DataSet(x, y, features_mask=mask)
+        net.fit(ds, batch_size=16)  # must run without error
+        out = net.output(x, mask=mask)
+        assert out.shape == (n, 2)
+
+    def test_rnn_time_step_matches_full_forward(self):
+        ds = self._seq_data(n=4, t=6)
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(0.02))
+            .list()
+            .layer(LSTM(n_out=5))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+            .set_input_type(InputType.recurrent(3, 6))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        full = net.output(ds.features)
+        net.rnn_clear_previous_state()
+        stepped = []
+        for t in range(6):
+            stepped.append(net.rnn_time_step(ds.features[:, t, :]))
+        stepped = np.stack(stepped, axis=1)
+        np.testing.assert_allclose(full, stepped, atol=1e-5)
+
+    def test_tbptt_runs(self):
+        ds = self._seq_data(n=8, t=20)
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(0.02))
+            .list()
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax"))
+            .backprop_type("tbptt", fwd_length=5, back_length=5)
+            .set_input_type(InputType.recurrent(3, 20))
+            .build()
+        )
+        # per-timestep labels for tbptt chunking
+        rng = np.random.default_rng(0)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (8, 20))]
+        net = MultiLayerNetwork(conf).init()
+        net.fit(DataSet(ds.features, y), batch_size=8)
+        assert net.iteration == 1
+
+
+class TestSerialization:
+    def test_save_restore_roundtrip(self, tmp_path):
+        ds = small_classification_data(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        net.fit(ds, batch_size=32)
+        path = os.path.join(tmp_path, "model.zip")
+        ModelSerializer.write_model(net, path)
+        net2 = ModelSerializer.restore_multi_layer_network(path)
+        np.testing.assert_array_equal(net.params_flat(), net2.params_flat())
+        np.testing.assert_array_equal(net.opt_state_flat(), net2.opt_state_flat())
+        assert net2.iteration == net.iteration
+        out1 = net.output(ds.features)
+        out2 = net2.output(ds.features)
+        np.testing.assert_allclose(out1, out2, atol=1e-6)
+
+    def test_resume_training_continuity(self, tmp_path):
+        ds = small_classification_data(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        for _ in range(3):
+            net.fit(ds, batch_size=64)
+        path = os.path.join(tmp_path, "ckpt.zip")
+        ModelSerializer.write_model(net, path)
+        net2 = ModelSerializer.restore_multi_layer_network(path)
+        net2.fit(ds, batch_size=64)  # must continue without error
+        assert net2.iteration == net.iteration + 1
