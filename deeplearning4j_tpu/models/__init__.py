@@ -1,0 +1,8 @@
+"""Model zoo (reference ``deeplearning4j-zoo``: 13 architectures built
+programmatically, ``zoo/model/*.java``)."""
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.models.lenet import LeNet
+from deeplearning4j_tpu.models.simplecnn import SimpleCNN
+
+__all__ = ["ZooModel", "LeNet", "SimpleCNN"]

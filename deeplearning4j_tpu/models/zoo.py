@@ -1,0 +1,60 @@
+"""ZooModel base (reference ``zoo/ZooModel.java:23``; pretrained download
++ checksum at ``:40-62`` is gated here — no egress in this environment, so
+``init_pretrained`` looks only in the local cache dir)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+CACHE_DIR = os.environ.get(
+    "DL4J_TPU_DATA", os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu")
+)
+
+
+class ZooModel:
+    """Subclasses implement ``conf()`` returning a built configuration and
+    set ``input_shape`` / ``num_classes``."""
+
+    name: str = "zoo"
+
+    def __init__(self, num_classes: int = 1000, seed: int = 123, **kwargs):
+        self.num_classes = int(num_classes)
+        self.seed = int(seed)
+        self.kwargs = kwargs
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + init the network."""
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+
+        if isinstance(conf, MultiLayerConfiguration):
+            from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+            return MultiLayerNetwork(conf).init()
+        try:
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+        except ImportError as e:
+            raise NotImplementedError(
+                "ComputationGraph runtime not available in this build"
+            ) from e
+        return ComputationGraph(conf).init()
+
+    def pretrained_path(self, dataset: str = "imagenet") -> str:
+        return os.path.join(CACHE_DIR, "zoo", f"{self.name}_{dataset}.zip")
+
+    def init_pretrained(self, dataset: str = "imagenet"):
+        """Load pretrained weights from the local cache (reference
+        ``initPretrained``; download is impossible without egress)."""
+        path = self.pretrained_path(dataset)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"No pretrained weights at {path}. This environment has no "
+                "network egress; place a checkpoint there manually."
+            )
+        from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+        return ModelGuesser.load_model_guess(path)

@@ -223,7 +223,12 @@ class MultiLayerNetwork:
         return s
 
     # ------------------------------------------------------------- train step
-    def _make_train_step(self):
+    def train_step_fn(self):
+        """The raw (unjitted) pure train step — reused by the data-parallel
+        wrapper which jits it with mesh shardings (parallel/wrapper.py)."""
+        return self._make_train_step(jit=False)
+
+    def _make_train_step(self, jit: bool = True):
         layers = self.layers
 
         def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
@@ -241,7 +246,7 @@ class MultiLayerNetwork:
             score = loss + self._reg_score(params)
             return new_params, new_opt, new_states, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
 
     def _get_jit(self, key, maker):
         if key not in self._jit_cache:

@@ -1,0 +1,13 @@
+"""Parallelism: device meshes, data-parallel training, parallel inference.
+
+The reference's entire scaleout stack (SURVEY.md §2.5: ParallelWrapper
+threads + averaging, Spark masters, Aeron parameter server) collapses into
+one abstraction here: a ``jax.sharding.Mesh`` + sharded jit — XLA inserts
+the ICI collectives the reference implements in user space.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+__all__ = ["TrainingMesh", "ParallelWrapper", "ParallelInference"]

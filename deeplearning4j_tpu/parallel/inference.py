@@ -1,0 +1,154 @@
+"""ParallelInference: batched multi-device inference serving.
+
+Reference: ``parallelism/ParallelInference.java:35`` — per-device workers
+consume an observable queue; BATCHED mode coalesces concurrent requests up
+to ``batch_limit``. TPU-native version: one jitted forward sharded over the
+mesh data axis; a coalescing queue groups concurrent ``output`` calls into
+one device dispatch (microbatch coalescing on top of XLA's throughput).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+
+
+class _Request:
+    def __init__(self, x, mask):
+        self.x = x
+        self.mask = mask
+        self.event = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+
+class ParallelInference:
+    INFERENCE_MODE_SEQUENTIAL = "sequential"
+    INFERENCE_MODE_BATCHED = "batched"
+
+    class Builder:
+        def __init__(self, model):
+            self.model = model
+            self._mode = ParallelInference.INFERENCE_MODE_BATCHED
+            self._batch_limit = 32
+            self._queue_limit = 64
+            self._workers = None
+
+        def inference_mode(self, mode: str):
+            self._mode = mode
+            return self
+
+        def batch_limit(self, n: int):
+            self._batch_limit = int(n)
+            return self
+
+        def queue_limit(self, n: int):
+            self._queue_limit = int(n)
+            return self
+
+        def workers(self, n: int):
+            # accepted for reference API parity; a single sharded XLA program
+            # replaces per-device worker threads (device parallelism comes
+            # from the mesh, not from thread count) — documented no-op like
+            # ParallelWrapper.averaging_frequency
+            self._workers = int(n)
+            return self
+
+        def build(self) -> "ParallelInference":
+            return ParallelInference(
+                self.model, mode=self._mode, batch_limit=self._batch_limit,
+                queue_limit=self._queue_limit,
+            )
+
+    @staticmethod
+    def builder(model) -> "Builder":
+        return ParallelInference.Builder(model)
+
+    def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
+                 queue_limit: int = 64, mesh: Optional[TrainingMesh] = None):
+        self.model = model
+        self.mode = mode
+        self.batch_limit = batch_limit
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = False
+        self._worker = threading.Thread(target=self._serve, daemon=True)
+        self._worker.start()
+
+    def output(self, x, mask=None) -> np.ndarray:
+        """Thread-safe blocking inference call (reference
+        ``ParallelInference.output``)."""
+        if self.mode == self.INFERENCE_MODE_SEQUENTIAL:
+            return self.model.output(x, mask=mask)
+        if self._shutdown:
+            raise RuntimeError("ParallelInference is shut down")
+        req = _Request(np.asarray(x), None if mask is None else np.asarray(mask))
+        self._queue.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def _serve(self):
+        while not self._shutdown:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch: List[_Request] = [first]
+            # coalesce whatever is queued, up to batch_limit total examples
+            total = first.x.shape[0]
+            while total < self.batch_limit:
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                total += nxt.x.shape[0]
+            try:
+                compatible = (
+                    all(r.x.shape[1:] == batch[0].x.shape[1:] for r in batch)
+                    and all((r.mask is None) == (batch[0].mask is None) for r in batch)
+                )
+                if len(batch) > 1 and compatible:
+                    x = np.concatenate([r.x for r in batch], axis=0)
+                    mask = (
+                        None if batch[0].mask is None
+                        else np.concatenate([r.mask for r in batch], axis=0)
+                    )
+                    out = self.model.output(x, mask=mask)
+                    off = 0
+                    for r in batch:
+                        n = r.x.shape[0]
+                        r.result = out[off : off + n]
+                        off += n
+                        r.event.set()
+                else:
+                    for r in batch:
+                        r.result = self.model.output(r.x, mask=r.mask)
+                        r.event.set()
+            except BaseException as e:  # propagate to callers
+                for r in batch:
+                    if not r.event.is_set():
+                        r.error = e
+                        r.event.set()
+
+    def shutdown(self):
+        self._shutdown = True
+        self._worker.join(timeout=2)
+        # fail any requests still in flight rather than leaving callers
+        # blocked forever on their event
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.event.is_set():
+                req.error = RuntimeError("ParallelInference shut down before serving request")
+                req.event.set()

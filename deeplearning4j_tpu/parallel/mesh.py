@@ -1,0 +1,69 @@
+"""Device mesh construction.
+
+The single Mesh replaces the reference's three separate transports
+(SURVEY.md §5 'Distributed communication backend'): intra-node device
+averaging (``Nd4j.averageAndPropagate``, ``ParallelWrapper.java:326``),
+Spark tree-aggregation, and the Aeron VoidParameterServer — XLA emits
+all-reduce over ICI within a slice and DCN collectives across slices from
+the sharding annotations alone.
+
+Axis convention (the full 4-axis layout models shard over):
+- "data"     — batch (DP)
+- "model"    — tensor parallel (TP) within layers
+- "pipe"     — pipeline stages (PP)
+- "seq"      — sequence/context parallel (SP, ring attention)
+Unused axes are size 1 and cost nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class TrainingMesh:
+    def __init__(
+        self,
+        data: int = 0,
+        model: int = 1,
+        pipe: int = 1,
+        seq: int = 1,
+        devices: Optional[Sequence] = None,
+    ):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        if data == 0:
+            used = model * pipe * seq
+            if n % used:
+                raise ValueError(f"{n} devices not divisible by model*pipe*seq={used}")
+            data = n // used
+        total = data * model * pipe * seq
+        if total != n:
+            raise ValueError(f"mesh {data}x{model}x{pipe}x{seq}={total} != {n} devices")
+        arr = np.asarray(devices).reshape(data, model, pipe, seq)
+        self.mesh = Mesh(arr, ("data", "model", "pipe", "seq"))
+        self.shape: Dict[str, int] = dict(zip(self.mesh.axis_names, arr.shape))
+
+    # -- shardings -----------------------------------------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharded(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("data"))
+
+    def seq_sharded(self) -> NamedSharding:
+        """(batch, time, ...) sharded over both data and seq axes."""
+        return NamedSharding(self.mesh, P("data", "seq"))
+
+    def spec(self, *axes) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def n_data(self) -> int:
+        return self.shape["data"]
+
+    def __repr__(self):
+        return f"TrainingMesh({self.shape})"

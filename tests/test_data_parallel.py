@@ -1,0 +1,236 @@
+"""Data pipeline + parallelism tests.
+
+Parity model: reference ``ParallelWrapperMainTest`` / parameter-averaging
+vs single-machine comparison (``TestCompareParameterAveragingSparkVs
+SingleMachine.java``, SURVEY.md §4.5) — here DP-vs-single-device must agree
+because SPMD all-reduce of a mean IS the single-device gradient.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ExistingDataSetIterator, ListDataSetIterator
+from deeplearning4j_tpu.data.iterators import (
+    AsyncDataSetIterator,
+    BenchmarkDataSetIterator,
+    EarlyTerminationDataSetIterator,
+    GeneratorDataSetIterator,
+    MultipleEpochsIterator,
+    TestDataSetIterator,
+)
+from deeplearning4j_tpu.data.mnist import IrisDataSetIterator, MnistDataSetIterator
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ParallelInference, ParallelWrapper, TrainingMesh
+from deeplearning4j_tpu.updaters import Sgd
+
+
+def _net(seed=3):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed).updater(Sgd(0.1))
+        .list()
+        .layer(DenseLayer(n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _blobs(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((3, 4)) * 3
+    cls = rng.integers(0, 3, n)
+    x = (centers[cls] + rng.standard_normal((n, 4)) * 0.3).astype(np.float32)
+    return DataSet(x, np.eye(3, dtype=np.float32)[cls])
+
+
+class TestIterators:
+    def test_async_matches_sync(self):
+        ds = _blobs(50)
+        sync = ListDataSetIterator(ds, 16)
+        async_it = AsyncDataSetIterator(ListDataSetIterator(ds, 16), 2)
+        a = [d.features for d in sync]
+        b = [d.features for d in async_it]
+        assert len(a) == len(b) == 4
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_async_propagates_worker_errors(self):
+        class Bad(ListDataSetIterator):
+            def next(self):
+                if self._pos >= 32:
+                    raise RuntimeError("ETL failed")
+                return super().next()
+
+        it = AsyncDataSetIterator(Bad(_blobs(64), 16), 2)
+        seen = 0
+        with pytest.raises(RuntimeError, match="ETL failed"):
+            for _ in it:
+                seen += 1
+        assert seen == 2
+
+    def test_early_termination(self):
+        it = EarlyTerminationDataSetIterator(ListDataSetIterator(_blobs(64), 8), 3)
+        assert sum(1 for _ in it) == 3
+        it.reset()
+        assert sum(1 for _ in it) == 3
+
+    def test_multiple_epochs(self):
+        inner = TestDataSetIterator(ListDataSetIterator(_blobs(32), 16))
+        it = MultipleEpochsIterator(inner, 3)
+        assert sum(1 for _ in it) == 6
+        assert inner.reset_count == 2
+
+    def test_benchmark_iterator_replays(self):
+        it = BenchmarkDataSetIterator.from_shapes((4, 3), (4, 2), 5)
+        batches = list(it)
+        assert len(batches) == 5
+        np.testing.assert_array_equal(batches[0].features, batches[4].features)
+
+    def test_generator_iterator(self):
+        it = GeneratorDataSetIterator(lambda: (d for d in _blobs(32).batch_by(8)))
+        assert sum(1 for _ in it) == 4
+        it.reset()
+        assert sum(1 for _ in it) == 4
+
+
+class TestMnistIris:
+    def test_mnist_shapes_and_determinism(self):
+        a = MnistDataSetIterator(32, train=True, num_examples=64, seed=5)
+        b = MnistDataSetIterator(32, train=True, num_examples=64, seed=5)
+        da, db = a.next(), b.next()
+        np.testing.assert_array_equal(da.features, db.features)
+        assert da.features.shape == (32, 28, 28, 1)
+        assert da.labels.shape == (32, 10)
+        assert 0.0 <= da.features.min() and da.features.max() <= 1.0
+
+    def test_train_test_disjoint_generation(self):
+        tr = MnistDataSetIterator(64, train=True, num_examples=64, shuffle=False)
+        te = MnistDataSetIterator(64, train=False, num_examples=64, shuffle=False)
+        assert not np.array_equal(tr.next().features, te.next().features)
+
+    def test_iris(self):
+        it = IrisDataSetIterator(150)
+        ds = it.next()
+        assert ds.features.shape == (150, 4)
+        np.testing.assert_array_equal(ds.labels.sum(axis=0), [50, 50, 50])
+
+
+class TestNormalizers:
+    def test_standardize_roundtrip(self):
+        ds = _blobs(100)
+        orig = ds.features.copy()
+        n = NormalizerStandardize()
+        n.fit(ds)
+        n.transform(ds)
+        assert abs(ds.features.mean()) < 1e-5
+        assert abs(ds.features.std() - 1.0) < 0.05
+        n.revert(ds)
+        np.testing.assert_allclose(ds.features, orig, atol=1e-4)
+
+    def test_minmax(self):
+        ds = _blobs(50)
+        n = NormalizerMinMaxScaler(0, 1)
+        n.fit(ds)
+        n.transform(ds)
+        assert ds.features.min() >= -1e-6 and ds.features.max() <= 1 + 1e-6
+
+    def test_image_scaler(self):
+        ds = DataSet(np.full((2, 4, 4, 1), 255.0, np.float32))
+        ImagePreProcessingScaler().transform(ds)
+        np.testing.assert_allclose(ds.features, 1.0)
+
+    def test_serde(self):
+        ds = _blobs(50)
+        n = NormalizerStandardize()
+        n.fit(ds)
+        from deeplearning4j_tpu.data.normalizers import Normalizer
+
+        n2 = Normalizer.from_dict(n.to_dict())
+        np.testing.assert_allclose(n.mean, n2.mean)
+
+
+class TestParallel:
+    def test_dp_matches_single_device(self):
+        """SPMD all-reduce of the mean gradient == single-device training."""
+        ds = _blobs(64)
+        it1 = ListDataSetIterator(ds, 32)
+        it2 = ListDataSetIterator(ds, 32)
+        single = _net(seed=11)
+        dp = _net(seed=11)
+        single.fit(it1, epochs=3)
+        mesh = TrainingMesh(data=8)
+        ParallelWrapper(dp, mesh=mesh).fit(it2, epochs=3)
+        np.testing.assert_allclose(
+            single.params_flat(), dp.params_flat(), rtol=2e-4, atol=1e-5
+        )
+
+    def test_mesh_shapes(self):
+        mesh = TrainingMesh(data=4, model=2)
+        assert mesh.shape == {"data": 4, "model": 2, "pipe": 1, "seq": 1}
+        with pytest.raises(ValueError):
+            TrainingMesh(data=5)
+
+    def test_parallel_inference_coalesces(self):
+        net = _net()
+        ds = _blobs(64)
+        pi = ParallelInference.builder(net).batch_limit(64).build()
+        results = {}
+
+        def call(i):
+            results[i] = pi.output(ds.features[i * 8 : (i + 1) * 8])
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ref = net.output(ds.features)
+        for i in range(8):
+            np.testing.assert_allclose(results[i], ref[i * 8 : (i + 1) * 8], atol=1e-6)
+        pi.shutdown()
+        with pytest.raises(RuntimeError):
+            pi.output(ds.features[:8])
+
+    def test_wrapper_rejects_tbptt(self):
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .backprop_type("tbptt")
+            .set_input_type(InputType.feed_forward(3))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(NotImplementedError):
+            ParallelWrapper(net, mesh=TrainingMesh(data=8)).fit(
+                ListDataSetIterator(_blobs(16), 8)
+            )
+
+
+class TestZoo:
+    def test_lenet_instantiation(self):
+        from deeplearning4j_tpu.models import LeNet
+
+        net = LeNet(num_classes=10).init()
+        out = net.output(np.zeros((2, 28, 28, 1), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_simplecnn_instantiation(self):
+        from deeplearning4j_tpu.models import SimpleCNN
+
+        net = SimpleCNN(num_classes=5, height=48, width=48, channels=3).init()
+        out = net.output(np.zeros((2, 48, 48, 3), np.float32))
+        assert out.shape == (2, 5)
