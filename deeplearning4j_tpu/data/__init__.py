@@ -10,7 +10,9 @@ from deeplearning4j_tpu.data.iterators import (
     DataSetIterator,
     EarlyTerminationDataSetIterator,
     ExistingDataSetIterator,
+    ExistingMultiDataSetIterator,
     ListDataSetIterator,
+    MultiDataSetIterator,
     MultipleEpochsIterator,
     SamplingDataSetIterator,
     TestDataSetIterator,
@@ -21,4 +23,5 @@ __all__ = [
     "ExistingDataSetIterator", "AsyncDataSetIterator", "BenchmarkDataSetIterator",
     "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "TestDataSetIterator",
+    "MultiDataSetIterator", "ExistingMultiDataSetIterator",
 ]

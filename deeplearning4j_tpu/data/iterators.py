@@ -367,3 +367,48 @@ class GeneratorDataSetIterator(DataSetIterator):
 
     def batch(self):
         return self._batch
+
+
+class MultiDataSetIterator:
+    """Iterator over MultiDataSet minibatches for ComputationGraph training
+    (reference nd4j ``MultiDataSetIterator`` as consumed by
+    ``ComputationGraph.fit(MultiDataSetIterator):1015``)."""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self):
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    @staticmethod
+    def from_list(datasets) -> "ExistingMultiDataSetIterator":
+        return ExistingMultiDataSetIterator(list(datasets))
+
+
+class ExistingMultiDataSetIterator(MultiDataSetIterator):
+    def __init__(self, datasets):
+        self._data = list(datasets)
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._data)
+
+    def next(self):
+        d = self._data[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self):
+        self._pos = 0

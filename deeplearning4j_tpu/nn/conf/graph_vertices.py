@@ -1,0 +1,359 @@
+"""Graph vertex catalog for ComputationGraph.
+
+Reference: ``nn/conf/graph/*.java`` (14+3 config classes) +
+``nn/graph/vertex/impl/*.java`` runtimes — Merge, ElementWise, Subset,
+Stack/Unstack, L2/L2Normalize, Scale/Shift, Reshape, Preprocessor, and the
+rnn vertices (LastTimeStep, DuplicateToTimeSeries, ReverseTimeSeries).
+
+TPU-native design: as with layers, the config object IS the runtime — each
+vertex is a pure function over its input activations, traced inside the
+jitted train step. No params on any of these vertices (the reference's
+GraphVertex.numParams()==0 for all of them).
+
+Layout note: activations are NHWC / (b,t,size), so feature-axis merges are
+always ``axis=-1`` regardless of family (the reference needs per-family
+axis logic for NCHW / (b,size,t)).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+Array = jax.Array
+
+
+class GraphVertex:
+    """Base vertex config/runtime (reference ``nn/conf/graph/GraphVertex.java``)."""
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        if len(input_types) != 1:
+            raise ValueError(f"{type(self).__name__} expects 1 input")
+        return input_types[0]
+
+    def apply(self, inputs: List[Array], masks: List[Optional[Array]],
+              *, train: bool = False, rng: Optional[Array] = None) -> Array:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, masks: List[Optional[Array]]) -> Optional[Array]:
+        """Output mask given input masks; default: first non-None."""
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "GraphVertex":
+        actual = serde.lookup(data.get("@class", cls.__name__))
+        return serde.generic_from_dict(actual, data)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and serde.encode(self) == serde.encode(other)
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items() if v is not None}
+        return f"{type(self).__name__}({fields})"
+
+
+@serde.register
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis (reference ``MergeVertex.java``).
+    NHWC ⇒ channel concat and feature concat are both ``axis=-1``."""
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        if not input_types:
+            raise ValueError("MergeVertex needs >=1 input")
+        first = input_types[0]
+        if first.kind == "convolutional":
+            ch = sum(t.channels for t in input_types)
+            for t in input_types:
+                if (t.height, t.width) != (first.height, first.width):
+                    raise ValueError("MergeVertex: mismatched spatial dims")
+            return InputType.convolutional(first.height, first.width, ch)
+        if first.kind == "recurrent":
+            return InputType.recurrent(sum(t.size for t in input_types), first.timesteps)
+        return InputType.feed_forward(sum(t.size for t in input_types))
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        if len(inputs) == 1:
+            return inputs[0]
+        return jnp.concatenate(inputs, axis=-1)
+
+
+@serde.register
+class ElementWiseVertex(GraphVertex):
+    """Pointwise op over N same-shaped inputs (reference
+    ``ElementWiseVertex.java``; ops Add/Subtract/Product/Average/Max)."""
+
+    OPS = ("add", "subtract", "product", "average", "max")
+
+    def __init__(self, op: str = "add"):
+        op = op.lower()
+        if op not in self.OPS:
+            raise ValueError(f"ElementWiseVertex op must be one of {self.OPS}")
+        self.op = op
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        if self.op == "subtract":
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        out = inputs[0]
+        for x in inputs[1:]:
+            if self.op in ("add", "average"):
+                out = out + x
+            elif self.op == "product":
+                out = out * x
+            else:
+                out = jnp.maximum(out, x)
+        if self.op == "average":
+            out = out / len(inputs)
+        return out
+
+
+@serde.register
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive (reference ``SubsetVertex.java``)."""
+
+    def __init__(self, from_idx: int, to_idx: int):
+        self.from_idx = int(from_idx)
+        self.to_idx = int(to_idx)
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        t = input_types[0]
+        n = self.to_idx - self.from_idx + 1
+        if t.kind == "recurrent":
+            return InputType.recurrent(n, t.timesteps)
+        if t.kind == "convolutional":
+            return InputType.convolutional(t.height, t.width, n)
+        return InputType.feed_forward(n)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return inputs[0][..., self.from_idx : self.to_idx + 1]
+
+
+@serde.register
+class StackVertex(GraphVertex):
+    """Concatenate along the batch axis (reference ``StackVertex.java``)."""
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return jnp.concatenate(inputs, axis=0)
+
+    def feed_forward_mask(self, masks):
+        if all(m is None for m in masks):
+            return None
+        if any(m is None for m in masks):
+            raise ValueError("StackVertex: all-or-none masks required")
+        return jnp.concatenate(masks, axis=0)
+
+
+@serde.register
+class UnstackVertex(GraphVertex):
+    """Take slice ``from_idx`` of ``stack_size`` equal batch chunks
+    (reference ``UnstackVertex.java``)."""
+
+    def __init__(self, from_idx: int, stack_size: int):
+        self.from_idx = int(from_idx)
+        self.stack_size = int(stack_size)
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_idx * step : (self.from_idx + 1) * step]
+
+    def feed_forward_mask(self, masks):
+        m = masks[0]
+        if m is None:
+            return None
+        step = m.shape[0] // self.stack_size
+        return m[self.from_idx * step : (self.from_idx + 1) * step]
+
+
+@serde.register
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||₂ over the non-batch axes (reference ``L2NormalizeVertex.java``)."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = float(eps)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True))
+        return x / (norm + self.eps)
+
+
+@serde.register
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs → (batch, 1)
+    (reference ``L2Vertex.java``)."""
+
+    def __init__(self, eps: float = 1e-8):
+        self.eps = float(eps)
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return InputType.feed_forward(1)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(jnp.square(d), axis=1, keepdims=True) + self.eps)
+
+
+@serde.register
+class ScaleVertex(GraphVertex):
+    """x * scale (reference ``ScaleVertex.java``)."""
+
+    def __init__(self, scale: float):
+        self.scale = float(scale)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return inputs[0] * self.scale
+
+
+@serde.register
+class ShiftVertex(GraphVertex):
+    """x + shift (reference ``ShiftVertex.java``)."""
+
+    def __init__(self, shift: float):
+        self.shift = float(shift)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return inputs[0] + self.shift
+
+
+@serde.register
+class ReshapeVertex(GraphVertex):
+    """Reshape to ``new_shape`` (batch dim may be -1; reference
+    ``ReshapeVertex.java``)."""
+
+    def __init__(self, new_shape: Sequence[int], output_type: Optional[dict] = None):
+        self.new_shape = [int(s) for s in new_shape]
+        # explicit output InputType dict when shape inference can't derive it
+        self.output_type = output_type
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        if self.output_type is not None:
+            return InputType.from_dict(self.output_type)
+        shp = self.new_shape
+        if len(shp) == 2:
+            return InputType.feed_forward(shp[1])
+        if len(shp) == 3:
+            return InputType.recurrent(shp[2], shp[1])
+        if len(shp) == 4:
+            return InputType.convolutional(shp[1], shp[2], shp[3])
+        raise ValueError(f"Cannot infer InputType from shape {shp}")
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return jnp.reshape(inputs[0], self.new_shape)
+
+
+@serde.register
+class PreprocessorVertex(GraphVertex):
+    """Wrap an InputPreProcessor as a standalone vertex (reference
+    ``PreprocessorVertex.java``)."""
+
+    def __init__(self, preprocessor):
+        self.preprocessor = preprocessor
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        return self.preprocessor.get_output_type(input_types[0])
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return self.preprocessor.pre_process(inputs[0], masks[0])
+
+    def feed_forward_mask(self, masks):
+        return self.preprocessor.feed_forward_mask(masks[0])
+
+    def to_dict(self) -> dict:
+        return {"@class": "PreprocessorVertex", "preprocessor": serde.encode(self.preprocessor)}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PreprocessorVertex":
+        return cls(serde.decode(data["preprocessor"]))
+
+
+@serde.register
+class LastTimeStepVertex(GraphVertex):
+    """(b, T, s) → (b, s): last *valid* step per example using the mask of
+    the named network input (reference ``LastTimeStepVertex.java``)."""
+
+    def __init__(self, mask_input: Optional[str] = None):
+        self.mask_input = mask_input  # resolved by the graph runtime
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        t = input_types[0]
+        return InputType.feed_forward(t.size)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        x = inputs[0]
+        m = masks[0]
+        if m is None:
+            return x[:, -1, :]
+        lengths = jnp.sum(m.astype(jnp.int32), axis=1)
+        idx = jnp.clip(lengths - 1, 0, x.shape[1] - 1)
+        return jax.vmap(lambda row, i: row[i])(x, idx)
+
+    def feed_forward_mask(self, masks):
+        return None  # mask consumed
+
+
+@serde.register
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """(b, s) → (b, T, s), T taken from a reference activation supplied as a
+    second input by the runtime (reference ``DuplicateToTimeSeriesVertex.java``
+    uses a named network input)."""
+
+    def __init__(self, timesteps_input: str):
+        self.timesteps_input = timesteps_input
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        base = input_types[0]
+        ts = input_types[1].timesteps if len(input_types) > 1 else None
+        return InputType.recurrent(base.size, ts)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        x, ref = inputs[0], inputs[1]
+        T = ref.shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[1]))
+
+    def feed_forward_mask(self, masks):
+        return masks[1] if len(masks) > 1 else None
+
+
+@serde.register
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse the time axis; with a mask, only the valid prefix is reversed
+    (reference ``ReverseTimeSeriesVertex.java``)."""
+
+    def __init__(self, mask_input: Optional[str] = None):
+        self.mask_input = mask_input
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        x = inputs[0]
+        m = masks[0]
+        if m is None:
+            return jnp.flip(x, axis=1)
+        T = x.shape[1]
+        lengths = jnp.sum(m.astype(jnp.int32), axis=1)  # (b,)
+        t = jnp.arange(T)[None, :]  # (1, T)
+        idx = jnp.where(t < lengths[:, None], lengths[:, None] - 1 - t, t)  # (b, T)
+        return jnp.take_along_axis(x, idx[:, :, None], axis=1)

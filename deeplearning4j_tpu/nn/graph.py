@@ -1,0 +1,485 @@
+"""ComputationGraph: DAG network runtime.
+
+Reference: ``nn/graph/ComputationGraph.java`` (3,904 LoC) — topological
+sort (``:1216``), multi-input/multi-output fit (``fit(DataSet):862``,
+``fit(MultiDataSetIterator):1015``), ``computeGradientAndScore():1321``,
+``output:1759``, ``feedForward:1409-1489``.
+
+TPU-native design: like MultiLayerNetwork, the whole train step (forward
+over the topological order, backward, updater math, constraints) is ONE
+jit-compiled XLA program with donated buffers. The vertex walk is traced —
+the DAG becomes straight-line XLA HLO, so vertex dispatch overhead is zero
+at run time and XLA fuses across vertex boundaries.
+
+State layout (keyed by vertex name, only LayerVertex entries have params):
+- ``self.params_``:    dict name → dict pname → array
+- ``self.state_``:     dict name → dict (BN stats etc.)
+- ``self.opt_state_``: dict name → dict pname → updater slots
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    MultiDataSetIterator,
+)
+from deeplearning4j_tpu.nn.conf.graph_builder import (
+    ComputationGraphConfiguration,
+    LayerVertex,
+)
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    DuplicateToTimeSeriesVertex,
+    LastTimeStepVertex,
+    ReverseTimeSeriesVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers.base import apply_input_dropout
+from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.multilayer import _apply_layer_updates, _dtype_of
+from deeplearning4j_tpu.updaters import NoOp
+
+Array = jax.Array
+
+
+def _as_multi(ds: Union[DataSet, MultiDataSet]) -> MultiDataSet:
+    if isinstance(ds, MultiDataSet):
+        return ds
+    return MultiDataSet(
+        [ds.features], [] if ds.labels is None else [ds.labels],
+        [ds.features_mask], [ds.labels_mask],
+    )
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.topo = conf.topological_order
+        # deterministic list of layer-vertex names (topo order) — the
+        # canonical ordering for flattened params / updates
+        self.layer_names: List[str] = [
+            n for n in self.topo if isinstance(conf.vertices[n], LayerVertex)
+        ]
+        self.params_: Optional[Dict[str, Dict[str, Array]]] = None
+        self.state_: Optional[Dict[str, Dict[str, Array]]] = None
+        self.opt_state_: Optional[Dict[str, Any]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.score_: Optional[Array] = None
+        self.listeners: List[Any] = []
+        self._rng = jax.random.PRNGKey(conf.global_conf.seed)
+        self._jit_cache: Dict[str, Any] = {}
+        self._output_layers()  # fail fast with a clear message on misconfig
+
+    def _layer(self, name: str):
+        return self.conf.vertices[name].layer
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng: Optional[Array] = None) -> "ComputationGraph":
+        if self.conf.input_types is None:
+            raise ValueError("Configuration needs set_input_types(...) before init()")
+        rng = rng if rng is not None else jax.random.PRNGKey(self.conf.global_conf.seed)
+        dtype = _dtype_of(self.conf.global_conf.dtype)
+        lt = self.conf.layer_input_types()
+        params: Dict[str, Dict[str, Array]] = {}
+        state: Dict[str, Dict[str, Array]] = {}
+        opt_state: Dict[str, Any] = {}
+        keys = jax.random.split(rng, max(len(self.layer_names), 1))
+        for i, name in enumerate(self.layer_names):
+            layer = self._layer(name)
+            p = layer.init_params(keys[i], lt[name], dtype)
+            s = layer.init_layer_state(lt[name], dtype)
+            params[name] = p
+            state[name] = s
+            upd = layer.updater if layer.updater is not None else NoOp()
+            opt_state[name] = {pn: upd.init_state(arr) for pn, arr in p.items()}
+        self.params_ = params
+        self.state_ = state
+        self.opt_state_ = opt_state
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    # ------------------------------------------------------------- forward fn
+    def _forward(
+        self,
+        params,
+        state,
+        inputs: Sequence[Array],
+        *,
+        train: bool,
+        rng: Optional[Array],
+        fmasks: Optional[Sequence[Optional[Array]]] = None,
+        collect: bool = False,
+    ):
+        """Pure forward walk over the topological order.
+
+        Returns (activations dict, masks dict, output-layer-inputs dict,
+        new_state dict). ``output-layer-inputs`` holds, for each LayerVertex
+        whose layer is an output layer, the activation INTO that layer
+        (post-preprocessor) — needed by compute_score, mirroring the
+        reference's "forward to N-1 then score" structure
+        (``ComputationGraph.java:1321``).
+        """
+        conf = self.conf
+        acts: Dict[str, Array] = dict(zip(conf.network_inputs, inputs))
+        masks: Dict[str, Optional[Array]] = {n: None for n in conf.network_inputs}
+        if fmasks is not None:
+            for n, m in zip(conf.network_inputs, fmasks):
+                masks[n] = m
+        out_inputs: Dict[str, Tuple[Array, Optional[Array]]] = {}
+        new_state: Dict[str, Dict[str, Array]] = {}
+        n_l = max(len(self.layer_names), 1)
+        rngs = dict(zip(self.layer_names,
+                        jax.random.split(rng, n_l))) if rng is not None else {}
+        for name in self.topo:
+            v = conf.vertices[name]
+            srcs = conf.vertex_inputs[name]
+            in_acts = [acts[s] for s in srcs]
+            in_masks = [masks[s] for s in srcs]
+            if isinstance(v, LayerVertex):
+                layer = v.layer
+                x, m = in_acts[0], in_masks[0]
+                if v.preprocessor is not None:
+                    x = v.preprocessor.pre_process(x, m)
+                    m = v.preprocessor.feed_forward_mask(m)
+                r = rngs.get(name)
+                x = apply_input_dropout(layer, x, train, r)
+                if layer.is_output_layer:
+                    out_inputs[name] = (x, m)
+                y, st = layer.apply(
+                    params.get(name, {}), x, state=state.get(name, {}),
+                    train=train, rng=r, mask=m,
+                )
+                new_state[name] = st if st is not None else {}
+                acts[name] = y
+                if layer.is_recurrent and m is not None:
+                    masks[name] = m
+                elif y.ndim == 2 and m is not None and m.ndim > 1:
+                    masks[name] = None  # mask consumed (pooling/last-step)
+                else:
+                    masks[name] = m
+            else:
+                # rnn vertices that name a mask source resolve it here
+                if isinstance(v, (LastTimeStepVertex, ReverseTimeSeriesVertex)) and v.mask_input:
+                    in_masks = [masks.get(v.mask_input)] + in_masks[1:]
+                acts[name] = v.apply(in_acts, in_masks, train=train, rng=None)
+                masks[name] = v.feed_forward_mask(in_masks)
+        return acts, masks, out_inputs, new_state
+
+    def _output_layers(self) -> List[str]:
+        outs = []
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if not (isinstance(v, LayerVertex) and v.layer.is_output_layer):
+                raise ValueError(f"Network output '{name}' is not an output layer")
+            outs.append(name)
+        return outs
+
+    # ---------------------------------------------------------------- scoring
+    def _loss_and_new_state(self, params, state, features, labels, fmasks, lmasks,
+                            rng, train=True):
+        _, _, out_inputs, new_state = self._forward(
+            params, state, features, train=train, rng=rng, fmasks=fmasks
+        )
+        loss = jnp.asarray(0.0, jnp.float32)
+        for i, name in enumerate(self.conf.network_outputs):
+            layer = self._layer(name)
+            x, m = out_inputs[name]
+            lmask = None
+            if lmasks is not None and i < len(lmasks):
+                lmask = lmasks[i]
+            if lmask is None:
+                lmask = m
+            if isinstance(layer, CenterLossOutputLayer):
+                per_ex = layer.compute_score(params[name], x, labels[i], lmask,
+                                             state=state[name])
+                if train:
+                    new_state[name] = layer.update_centers(new_state[name], x, labels[i])
+            else:
+                per_ex = layer.compute_score(params[name], x, labels[i], lmask)
+            loss = loss + jnp.mean(per_ex)
+        return loss, new_state
+
+    def _reg_score(self, params):
+        s = jnp.asarray(0.0, jnp.float32)
+        for name in self.layer_names:
+            reg = self._layer(name).regularization
+            if reg is None:
+                continue
+            for pn, arr in params[name].items():
+                s = s + reg.score_term(pn, arr)
+        return s
+
+    # ------------------------------------------------------------- train step
+    def train_step_fn(self):
+        """Raw (unjitted) pure train step for the data-parallel wrapper."""
+        return self._make_train_step(jit=False)
+
+    def _make_train_step(self, jit: bool = True):
+        names = self.layer_names
+        layers = [self._layer(n) for n in names]
+
+        def step(params, opt_state, state, features, labels, fmasks, lmasks, rng,
+                 iteration, epoch):
+            def loss_fn(p):
+                loss, new_state = self._loss_and_new_state(
+                    p, state, features, labels, fmasks, lmasks, rng, train=True
+                )
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            t = iteration + 1
+            p_list = [params[n] for n in names]
+            g_list = [grads[n] for n in names]
+            o_list = [opt_state[n] for n in names]
+            np_list, no_list = _apply_layer_updates(
+                layers, p_list, g_list, o_list, t, iteration, epoch
+            )
+            new_params = dict(zip(names, np_list))
+            new_opt = dict(zip(names, no_list))
+            score = loss + self._reg_score(params)
+            return new_params, new_opt, new_state, score
+
+        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+
+    def _get_jit(self, key, maker):
+        if key not in self._jit_cache:
+            self._jit_cache[key] = maker()
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(
+        self,
+        data: Union[DataSet, MultiDataSet, DataSetIterator, MultiDataSetIterator],
+        epochs: int = 1,
+        batch_size: int = 32,
+    ) -> "ComputationGraph":
+        if isinstance(data, DataSet):
+            data = ListDataSetIterator(data, batch_size)
+        if isinstance(data, MultiDataSet):
+            data = MultiDataSetIterator.from_list([data])
+        for _ in range(epochs):
+            self._fit_one_epoch(data)
+        return self
+
+    def _fit_one_epoch(self, it):
+        for lst in self.listeners:
+            if hasattr(lst, "on_epoch_start"):
+                lst.on_epoch_start(self)
+        step = self._get_jit("train", self._make_train_step)
+        for ds in it:
+            self._fit_batch(step, _as_multi(ds))
+        it.reset()
+        self.epoch += 1
+        for lst in self.listeners:
+            if hasattr(lst, "on_epoch_end"):
+                lst.on_epoch_end(self)
+
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _fit_batch(self, step, mds: MultiDataSet):
+        feats = tuple(jnp.asarray(f) for f in mds.features)
+        labels = tuple(jnp.asarray(l) for l in mds.labels)
+        fmasks = tuple(None if m is None else jnp.asarray(m) for m in mds.features_masks)
+        lmasks = tuple(None if m is None else jnp.asarray(m) for m in mds.labels_masks)
+        self.params_, self.opt_state_, self.state_, self.score_ = step(
+            self.params_, self.opt_state_, self.state_, feats, labels, fmasks, lmasks,
+            self._next_rng(),
+            jnp.asarray(self.iteration, jnp.int32),
+            jnp.asarray(self.epoch, jnp.int32),
+        )
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # -------------------------------------------------------------- inference
+    def _make_output_fn(self):
+        out_names = list(self.conf.network_outputs)
+
+        def run(params, state, inputs, fmasks):
+            acts, _, _, _ = self._forward(
+                params, state, inputs, train=False, rng=None, fmasks=fmasks
+            )
+            return tuple(acts[n] for n in out_names)
+
+        return jax.jit(run)
+
+    def output(self, *inputs, masks: Optional[Sequence] = None) -> List[np.ndarray]:
+        """Multi-output inference (reference ``output:1759``). Returns a list
+        of arrays, one per network output."""
+        fn = self._get_jit("output", self._make_output_fn)
+        feats = tuple(jnp.asarray(x) for x in inputs)
+        fmasks = (
+            tuple(None if m is None else jnp.asarray(m) for m in masks)
+            if masks is not None else tuple(None for _ in feats)
+        )
+        ys = fn(self.params_, self.state_, feats, fmasks)
+        return [np.asarray(y) for y in ys]
+
+    def output_single(self, *inputs, masks=None) -> np.ndarray:
+        ys = self.output(*inputs, masks=masks)
+        if len(ys) != 1:
+            raise ValueError(f"Graph has {len(ys)} outputs; use output()")
+        return ys[0]
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, np.ndarray]:
+        """All vertex activations (reference ``feedForward:1409``)."""
+        acts, _, _, _ = self._forward(
+            self.params_, self.state_,
+            tuple(jnp.asarray(x) for x in inputs),
+            train=train, rng=self._next_rng() if train else None,
+        )
+        return {k: np.asarray(v) for k, v in acts.items()}
+
+    # ------------------------------------------------------------------ score
+    def score(self, ds: Optional[Union[DataSet, MultiDataSet]] = None) -> float:
+        if ds is None:
+            if self.score_ is None:
+                raise ValueError("No score available; fit() first or pass a DataSet")
+            return float(self.score_)
+        mds = _as_multi(ds)
+
+        def run(params, state, feats, labels, fmasks, lmasks):
+            loss, _ = self._loss_and_new_state(
+                params, state, feats, labels, fmasks, lmasks, None, train=False
+            )
+            return loss + self._reg_score(params)
+
+        fn = self._get_jit("score", lambda: jax.jit(run))
+        return float(fn(
+            self.params_, self.state_,
+            tuple(jnp.asarray(f) for f in mds.features),
+            tuple(jnp.asarray(l) for l in mds.labels),
+            tuple(None if m is None else jnp.asarray(m) for m in mds.features_masks),
+            tuple(None if m is None else jnp.asarray(m) for m in mds.labels_masks),
+        ))
+
+    def compute_gradient_and_score(self, ds: Union[DataSet, MultiDataSet]):
+        """(reference ``computeGradientAndScore():1321``)."""
+        mds = _as_multi(ds)
+
+        def run(params, state, feats, labels, fmasks, lmasks, rng):
+            def loss_fn(p):
+                loss, _ = self._loss_and_new_state(
+                    p, state, feats, labels, fmasks, lmasks, rng, train=True
+                )
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            return grads, loss + self._reg_score(params)
+
+        fn = self._get_jit("grad_score", lambda: jax.jit(run))
+        grads, score = fn(
+            self.params_, self.state_,
+            tuple(jnp.asarray(f) for f in mds.features),
+            tuple(jnp.asarray(l) for l in mds.labels),
+            tuple(None if m is None else jnp.asarray(m) for m in mds.features_masks),
+            tuple(None if m is None else jnp.asarray(m) for m in mds.labels_masks),
+            self._next_rng(),
+        )
+        return grads, float(score)
+
+    # ------------------------------------------------------------- evaluation
+    def evaluate(self, it: Union[DataSetIterator, DataSet]):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = Evaluation()
+        if isinstance(it, DataSet):
+            it = ListDataSetIterator(it, 256)
+        for ds in it:
+            out = self.output_single(ds.features, masks=[ds.features_mask])
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        it.reset()
+        return ev
+
+    # ------------------------------------------------------- params utilities
+    def num_params(self) -> int:
+        assert self.params_ is not None
+        return int(sum(int(np.prod(a.shape))
+                       for n in self.layer_names for a in self.params_[n].values()))
+
+    def params_flat(self) -> np.ndarray:
+        """Flattened parameter vector (order: topo layer order, param name
+        sorted — deterministic for checkpointing)."""
+        assert self.params_ is not None
+        chunks = []
+        for n in self.layer_names:
+            p = self.params_[n]
+            for pn in sorted(p):
+                chunks.append(np.asarray(p[pn], np.float32).reshape(-1))
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_params_flat(self, vec: np.ndarray) -> None:
+        assert self.params_ is not None
+        vec = np.asarray(vec, np.float32)
+        off = 0
+        new_params = dict(self.params_)
+        for n in self.layer_names:
+            p = self.params_[n]
+            np_i = {}
+            for pn in sorted(p):
+                cnt = int(np.prod(p[pn].shape))
+                np_i[pn] = jnp.asarray(vec[off:off + cnt].reshape(p[pn].shape), p[pn].dtype)
+                off += cnt
+            new_params[n] = np_i
+        if off != vec.size:
+            raise ValueError(f"Param vector length {vec.size} != model size {off}")
+        self.params_ = new_params
+
+    def opt_state_flat(self) -> np.ndarray:
+        assert self.opt_state_ is not None
+        chunks = []
+        for n in self.layer_names:
+            o = self.opt_state_[n]
+            for pn in sorted(o):
+                for slot in sorted(o[pn]):
+                    chunks.append(np.asarray(o[pn][slot], np.float32).reshape(-1))
+        if not chunks:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(chunks)
+
+    def set_opt_state_flat(self, vec: np.ndarray) -> None:
+        assert self.opt_state_ is not None
+        vec = np.asarray(vec, np.float32)
+        off = 0
+        new_opt = dict(self.opt_state_)
+        for n in self.layer_names:
+            o = self.opt_state_[n]
+            no_i = {}
+            for pn in sorted(o):
+                slots = {}
+                for slot in sorted(o[pn]):
+                    arr = o[pn][slot]
+                    cnt = int(np.prod(arr.shape))
+                    slots[slot] = jnp.asarray(vec[off:off + cnt].reshape(arr.shape), arr.dtype)
+                    off += cnt
+                no_i[pn] = slots
+            new_opt[n] = no_i
+        self.opt_state_ = new_opt
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners) -> None:
+        self.listeners.extend(listeners)
+
+    def clone(self) -> "ComputationGraph":
+        conf = ComputationGraphConfiguration.from_json(self.conf.to_json())
+        net = ComputationGraph(conf)
+        if self.params_ is not None:
+            net.init()
+            net.params_ = jax.tree_util.tree_map(lambda a: a, self.params_)
+            net.state_ = jax.tree_util.tree_map(lambda a: a, self.state_)
+            net.opt_state_ = jax.tree_util.tree_map(lambda a: a, self.opt_state_)
+        return net

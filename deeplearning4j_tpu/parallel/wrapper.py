@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator, DataSetIterator
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 
@@ -72,6 +72,8 @@ class ParallelWrapper:
         self.mesh = mesh
         self.prefetch = prefetch
         self._step = None
+        # ComputationGraph train steps take per-input tuples; MLN takes arrays
+        self._is_graph = hasattr(model.conf, "network_inputs")
 
     def _build_step(self):
         raw = self.model.train_step_fn()
@@ -100,19 +102,14 @@ class ParallelWrapper:
             for lst in m.listeners:
                 if hasattr(lst, "on_epoch_start"):
                     lst.on_epoch_start(m)
-            wrapped = AsyncDataSetIterator(it, self.prefetch) if it.async_supported() else it
+            async_ok = getattr(it, "async_supported", lambda: False)()
+            wrapped = AsyncDataSetIterator(it, self.prefetch) if async_ok else it
             try:
                 with self.mesh.mesh:
                     for ds in wrapped:
-                        b = ds.features.shape[0]
-                        if b % n_data:
-                            ds = _pad_batch(ds, n_data)
                         m.params_, m.opt_state_, m.state_, m.score_ = step(
                             m.params_, m.opt_state_, m.state_,
-                            jnp.asarray(ds.features),
-                            None if ds.labels is None else jnp.asarray(ds.labels),
-                            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-                            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+                            *self._pack_batch(ds, n_data),
                             m._next_rng(),
                             jnp.asarray(m.iteration, jnp.int32),
                             jnp.asarray(m.epoch, jnp.int32),
@@ -128,6 +125,31 @@ class ParallelWrapper:
             for lst in m.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(m)
+
+    def _pack_batch(self, ds, n_data: int):
+        """Device-bound (features, labels, fmasks, lmasks) in the layout the
+        model's train step expects: bare arrays for MultiLayerNetwork,
+        per-input tuples for ComputationGraph."""
+        if self._is_graph:
+            from deeplearning4j_tpu.nn.graph import _as_multi
+
+            mds = _as_multi(ds)
+            if mds.num_examples() % n_data:
+                mds = _pad_multi(mds, n_data)
+            return (
+                tuple(jnp.asarray(f) for f in mds.features),
+                tuple(jnp.asarray(l) for l in mds.labels),
+                tuple(None if x is None else jnp.asarray(x) for x in mds.features_masks),
+                tuple(None if x is None else jnp.asarray(x) for x in mds.labels_masks),
+            )
+        if ds.features.shape[0] % n_data:
+            ds = _pad_batch(ds, n_data)
+        return (
+            jnp.asarray(ds.features),
+            None if ds.labels is None else jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+        )
 
     def shutdown(self):  # API parity; nothing to tear down
         pass
@@ -147,3 +169,20 @@ def _pad_batch(ds: DataSet, multiple: int) -> DataSet:
         return reps
 
     return DataSet(p(ds.features), p(ds.labels), p(ds.features_mask), p(ds.labels_mask))
+
+
+def _pad_multi(mds: MultiDataSet, multiple: int) -> MultiDataSet:
+    b = mds.num_examples()
+    pad = (-b) % multiple
+
+    def p(a):
+        if a is None:
+            return None
+        return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+
+    return MultiDataSet(
+        [p(f) for f in mds.features],
+        [p(l) for l in mds.labels],
+        [p(m) for m in mds.features_masks],
+        [p(m) for m in mds.labels_masks],
+    )

@@ -54,16 +54,12 @@ class ModelSerializer:
                 z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
 
     @staticmethod
-    def restore_multi_layer_network(path: str, load_updater: bool = True):
-        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
-        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-
+    def _restore(path: str, conf_cls, net_cls, load_updater: bool):
         with zipfile.ZipFile(path, "r") as z:
-            conf = MultiLayerConfiguration.from_json(z.read(CONFIG_ENTRY).decode())
-            net = MultiLayerNetwork(conf)
+            conf = conf_cls.from_json(z.read(CONFIG_ENTRY).decode())
+            net = net_cls(conf)
             net.init()
-            coef = np.frombuffer(z.read(COEFFICIENTS_ENTRY), dtype="<f4")
-            net.set_params_flat(coef)
+            net.set_params_flat(np.frombuffer(z.read(COEFFICIENTS_ENTRY), dtype="<f4"))
             names = z.namelist()
             if load_updater and UPDATER_ENTRY in names:
                 net.set_opt_state_flat(np.frombuffer(z.read(UPDATER_ENTRY), dtype="<f4"))
@@ -76,6 +72,26 @@ class ModelSerializer:
         return net
 
     @staticmethod
+    def restore_multi_layer_network(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        return ModelSerializer._restore(
+            path, MultiLayerConfiguration, MultiLayerNetwork, load_updater
+        )
+
+    @staticmethod
+    def restore_computation_graph(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.graph_builder import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        return ModelSerializer._restore(
+            path, ComputationGraphConfiguration, ComputationGraph, load_updater
+        )
+
+    @staticmethod
     def restore_normalizer(path: str):
         with zipfile.ZipFile(path, "r") as z:
             if NORMALIZER_ENTRY not in z.namelist():
@@ -85,25 +101,31 @@ class ModelSerializer:
             return Normalizer.from_dict(json.loads(z.read(NORMALIZER_ENTRY).decode()))
 
 
-def _flatten_state(state) -> np.ndarray:
-    chunks = []
-    for s in state or []:
+def _state_items(state):
+    """Deterministic (container, key-path) walk over MLN (list-of-dict) and
+    CG (dict-of-dict, sorted by vertex name) state layouts."""
+    if isinstance(state, dict):
+        groups = [state[k] for k in sorted(state)]
+    else:
+        groups = list(state or [])
+    for s in groups:
         for name in sorted(s):
-            chunks.append(np.asarray(s[name], np.float32).reshape(-1))
+            yield s, name
+
+
+def _flatten_state(state) -> np.ndarray:
+    chunks = [
+        np.asarray(s[name], np.float32).reshape(-1) for s, name in _state_items(state)
+    ]
     return np.concatenate(chunks) if chunks else np.zeros((0,), np.float32)
 
 
 def _unflatten_state(net, vec: np.ndarray) -> None:
     off = 0
-    new_state = []
-    for s in net.state_:
-        ns = {}
-        for name in sorted(s):
-            n = int(np.prod(s[name].shape))
-            ns[name] = jnp.asarray(vec[off : off + n].reshape(s[name].shape), s[name].dtype)
-            off += n
-        new_state.append(ns)
-    net.state_ = new_state
+    for s, name in _state_items(net.state_):
+        n = int(np.prod(s[name].shape))
+        s[name] = jnp.asarray(vec[off : off + n].reshape(s[name].shape), s[name].dtype)
+        off += n
 
 
 class ModelGuesser:
@@ -119,12 +141,6 @@ class ModelGuesser:
                     meta = json.loads(z.read(META_ENTRY).decode())
                 model_type = meta.get("model_type", "MultiLayerNetwork")
                 if model_type == "ComputationGraph":
-                    try:
-                        from deeplearning4j_tpu.nn.graph import ComputationGraph
-                    except ImportError as e:
-                        raise NotImplementedError(
-                            "ComputationGraph restore not available in this build"
-                        ) from e
-                    return ComputationGraph.restore(path)
+                    return ModelSerializer.restore_computation_graph(path)
                 return ModelSerializer.restore_multi_layer_network(path)
         raise ValueError(f"Cannot identify model format for {path}")
