@@ -146,8 +146,11 @@ class ComputationGraphConfiguration:
             "global_conf": serde.encode(self.global_conf),
             "network_inputs": list(self.network_inputs),
             "network_outputs": list(self.network_outputs),
-            "vertices": {k: serde.encode(v) for k, v in self.vertices.items()},
-            "vertex_inputs": {k: list(v) for k, v in self.vertex_inputs.items()},
+            # list-of-pairs: JSON objects lose insertion order under
+            # sort_keys, and topo-sort tie-breaking (hence flattened-param
+            # order) depends on it
+            "vertices": [[k, serde.encode(v)] for k, v in self.vertices.items()],
+            "vertex_inputs": [[k, list(v)] for k, v in self.vertex_inputs.items()],
             "input_types": None if self.input_types is None
             else [t.to_dict() for t in self.input_types],
             "backprop_type": self.backprop_type,
@@ -161,8 +164,20 @@ class ComputationGraphConfiguration:
             global_conf=serde.decode(d["global_conf"]),
             network_inputs=d["network_inputs"],
             network_outputs=d["network_outputs"],
-            vertices={k: serde.decode(v) for k, v in d["vertices"].items()},
-            vertex_inputs=d["vertex_inputs"],
+            vertices=dict(
+                (k, serde.decode(v))
+                for k, v in (
+                    d["vertices"].items() if isinstance(d["vertices"], dict)
+                    else d["vertices"]
+                )
+            ),
+            vertex_inputs=dict(
+                (k, list(v))
+                for k, v in (
+                    d["vertex_inputs"].items() if isinstance(d["vertex_inputs"], dict)
+                    else d["vertex_inputs"]
+                )
+            ),
             input_types=None if d.get("input_types") is None
             else [InputType.from_dict(t) for t in d["input_types"]],
             backprop_type=d.get("backprop_type", "standard"),
@@ -219,6 +234,7 @@ class GraphBuilder:
     def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
         from deeplearning4j_tpu.nn.conf.graph_vertices import (
             DuplicateToTimeSeriesVertex,
+            MergeVertex,
         )
 
         if name in self._vertices or name in self._inputs:
@@ -226,6 +242,17 @@ class GraphBuilder:
         if not inputs:
             raise ValueError(f"Vertex '{name}' needs at least one input")
         inputs = list(inputs)
+        if isinstance(vertex, LayerVertex) and len(inputs) > 1:
+            # layers take one input; auto-insert a MergeVertex (reference
+            # GraphBuilder does the same for multi-input layers)
+            merge_name = f"{name}-merge"
+            if merge_name in self._vertices or merge_name in self._inputs:
+                raise ValueError(
+                    f"Implicit merge name '{merge_name}' collides; merge inputs explicitly"
+                )
+            self._vertices[merge_name] = MergeVertex()
+            self._vertex_inputs[merge_name] = inputs
+            inputs = [merge_name]
         # reference-style usage names the timestep source as a constructor
         # arg only; wire it as a real graph edge so type inference and the
         # runtime see it uniformly

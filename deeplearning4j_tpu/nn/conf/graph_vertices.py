@@ -176,6 +176,11 @@ class UnstackVertex(GraphVertex):
 
     def apply(self, inputs, masks, *, train=False, rng=None):
         x = inputs[0]
+        if x.shape[0] % self.stack_size != 0:
+            raise ValueError(
+                f"UnstackVertex: batch {x.shape[0]} not divisible by "
+                f"stackSize {self.stack_size} (reference throws here too)"
+            )
         step = x.shape[0] // self.stack_size
         return x[self.from_idx * step : (self.from_idx + 1) * step]
 
@@ -183,6 +188,11 @@ class UnstackVertex(GraphVertex):
         m = masks[0]
         if m is None:
             return None
+        if m.shape[0] % self.stack_size != 0:
+            raise ValueError(
+                f"UnstackVertex: mask batch {m.shape[0]} not divisible by "
+                f"stackSize {self.stack_size}"
+            )
         step = m.shape[0] // self.stack_size
         return m[self.from_idx * step : (self.from_idx + 1) * step]
 

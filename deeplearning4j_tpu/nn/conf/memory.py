@@ -1,0 +1,152 @@
+"""Analytic memory estimation before training.
+
+Reference: ``nn/conf/memory/{MemoryReport,LayerMemoryReport,
+NetworkMemoryReport}.java`` — per-layer breakdown of parameter, gradient,
+updater-state and activation memory for a given minibatch size, reported
+before any compilation/training happens.
+
+TPU-native notes: under jit there are no per-op workspaces (XLA plans
+buffers); the dominant trainable-state terms are params + grads +
+updater slots (all resident in HBM), plus activations saved for backprop
+(bounded above by the sum of layer outputs; XLA rematerialization /
+``jax.checkpoint`` can trade these for FLOPs). This report is the same
+"will it fit in device memory" answer the reference gives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "float64": 8}
+
+
+class LayerMemoryReport:
+    """(reference ``LayerMemoryReport.java``)."""
+
+    def __init__(self, layer_name: str, layer_type: str, input_type: InputType,
+                 output_type: InputType, n_params: int, updater_slots: int,
+                 activation_elems_per_example: int):
+        self.layer_name = layer_name
+        self.layer_type = layer_type
+        self.input_type = input_type
+        self.output_type = output_type
+        self.n_params = int(n_params)
+        self.updater_slots = int(updater_slots)
+        self.activation_elems_per_example = int(activation_elems_per_example)
+
+    def total_memory_bytes(self, batch_size: int, bytes_per_elem: int = 4,
+                           training: bool = True) -> int:
+        fixed = self.n_params * bytes_per_elem
+        if training:
+            fixed += self.n_params * bytes_per_elem  # gradients
+            fixed += self.n_params * self.updater_slots * bytes_per_elem
+        var = self.activation_elems_per_example * batch_size * bytes_per_elem
+        if training:
+            var *= 2  # activations retained for backprop + grad wrt input
+        return fixed + var
+
+
+class NetworkMemoryReport:
+    """(reference ``NetworkMemoryReport.java``)."""
+
+    def __init__(self, layer_reports: List[LayerMemoryReport], model_class: str,
+                 model_name: str, dtype: str = "float32"):
+        self.layer_reports = layer_reports
+        self.model_class = model_class
+        self.model_name = model_name
+        self.dtype = dtype
+
+    @property
+    def total_params(self) -> int:
+        return sum(r.n_params for r in self.layer_reports)
+
+    def total_memory_bytes(self, batch_size: int, training: bool = True,
+                           dtype: Optional[str] = None) -> int:
+        b = _DTYPE_BYTES[dtype or self.dtype]
+        return sum(
+            r.total_memory_bytes(batch_size, b, training) for r in self.layer_reports
+        )
+
+    def to_string(self, batch_size: int = 32) -> str:
+        lines = [
+            f"NetworkMemoryReport: {self.model_class} ({self.model_name})",
+            f"  dtype={self.dtype}  total params={self.total_params:,}",
+            f"  est. training memory @ batch {batch_size}: "
+            f"{self.total_memory_bytes(batch_size, True) / 2**20:.1f} MiB",
+            f"  est. inference memory @ batch {batch_size}: "
+            f"{self.total_memory_bytes(batch_size, False) / 2**20:.1f} MiB",
+            "  per-layer:",
+        ]
+        for r in self.layer_reports:
+            lines.append(
+                f"    {r.layer_name:24s} {r.layer_type:28s} params={r.n_params:>12,} "
+                f"act/ex={r.activation_elems_per_example:>10,}"
+            )
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return self.to_string()
+
+
+def _updater_slot_count(layer) -> int:
+    upd = getattr(layer, "updater", None)
+    if upd is None:
+        return 0
+    probe = np.zeros((1,), np.float32)
+    try:
+        return len(upd.init_state(probe))
+    except Exception:
+        return 2
+
+
+def memory_report_mln(conf, name: str = "MultiLayerNetwork") -> NetworkMemoryReport:
+    """Build the report from a MultiLayerConfiguration (reference
+    ``MultiLayerConfiguration.getMemoryReport``)."""
+    types = conf.layer_types()
+    reports = []
+    for i, layer in enumerate(conf.layers):
+        it, ot = types[i], types[i + 1]
+        reports.append(
+            LayerMemoryReport(
+                layer_name=layer.name or f"layer{i}",
+                layer_type=type(layer).__name__,
+                input_type=it,
+                output_type=ot,
+                n_params=layer.n_params(it),
+                updater_slots=_updater_slot_count(layer),
+                activation_elems_per_example=ot.arity(),
+            )
+        )
+    return NetworkMemoryReport(reports, "MultiLayerNetwork", name,
+                               conf.global_conf.dtype)
+
+
+def memory_report_graph(conf, name: str = "ComputationGraph") -> NetworkMemoryReport:
+    """(reference ``ComputationGraphConfiguration.getMemoryReport``)."""
+    from deeplearning4j_tpu.nn.conf.graph_builder import LayerVertex
+
+    lt = conf.layer_input_types()
+    vt = conf.vertex_types()
+    reports = []
+    for n in conf.topological_order:
+        v = conf.vertices[n]
+        if not isinstance(v, LayerVertex):
+            continue
+        it, ot = lt[n], vt[n]
+        reports.append(
+            LayerMemoryReport(
+                layer_name=n,
+                layer_type=type(v.layer).__name__,
+                input_type=it,
+                output_type=ot,
+                n_params=v.layer.n_params(it),
+                updater_slots=_updater_slot_count(v.layer),
+                activation_elems_per_example=ot.arity(),
+            )
+        )
+    return NetworkMemoryReport(reports, "ComputationGraph", name,
+                               conf.global_conf.dtype)

@@ -582,8 +582,11 @@ class MultiLayerNetwork:
         conf = MultiLayerConfiguration.from_json(self.conf.to_json())
         net = MultiLayerNetwork(conf)
         if self.params_ is not None:
-            net.init()
-            net.params_ = jax.tree_util.tree_map(lambda a: a, self.params_)
-            net.state_ = jax.tree_util.tree_map(lambda a: a, self.state_)
-            net.opt_state_ = jax.tree_util.tree_map(lambda a: a, self.opt_state_)
+            # deep copy, no init(): the source's train step donates its
+            # buffers to XLA, so shared arrays would be deleted under it
+            net.params_ = jax.tree_util.tree_map(jnp.copy, self.params_)
+            net.state_ = jax.tree_util.tree_map(jnp.copy, self.state_)
+            net.opt_state_ = jax.tree_util.tree_map(jnp.copy, self.opt_state_)
+            net.iteration = self.iteration
+            net.epoch = self.epoch
         return net

@@ -1,0 +1,515 @@
+"""Transfer learning: clone + surgically edit trained networks.
+
+Reference: ``nn/transferlearning/TransferLearning.java`` (Builder at ``:34``
+for MultiLayerNetwork, GraphBuilder at ``:447``),
+``FineTuneConfiguration.java`` (global hyperparameter override applied to
+all non-frozen layers), ``TransferLearningHelper.java`` (featurize inputs
+through the frozen portion once, then train only the unfrozen tail).
+
+Semantics preserved from the reference:
+- ``set_feature_extractor(n)`` freezes layers 0..n inclusive (wrapped in
+  ``FrozenLayer`` so the updater skips them and they run inference-mode).
+- ``nout_replace(i, n_out, weight_init)`` reinitializes layer i's params
+  with a new output size and fixes up layer i+1's nIn (its params are
+  reinitialized too — shape changed).
+- ``remove_output_layer()`` / ``remove_layers_from_output(k)`` then
+  ``add_layer(...)`` appends fresh layers.
+- Kept params are DEEP-copied from the source network: train steps donate
+  their input buffers to XLA (in-place update), so sharing arrays between
+  two live networks would let one network's fit() delete the other's
+  params.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.initializers import Distribution
+from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers.base import GlobalConf, Layer
+from deeplearning4j_tpu.nn.conf.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.regularization import RegularizationConf
+from deeplearning4j_tpu.updaters import NoOp, Updater
+from deeplearning4j_tpu.updaters import get as get_updater
+
+
+class FineTuneConfiguration:
+    """Global override applied to every trainable layer during transfer
+    (reference ``FineTuneConfiguration.java``): any field left None is
+    inherited from the original layer config."""
+
+    def __init__(
+        self,
+        updater: Optional[Union[str, Updater]] = None,
+        activation: Optional[str] = None,
+        weight_init: Optional[Union[str, Distribution]] = None,
+        bias_init: Optional[float] = None,
+        l1: Optional[float] = None,
+        l2: Optional[float] = None,
+        weight_decay: Optional[float] = None,
+        dropout: Optional[float] = None,
+        gradient_normalization: Optional[str] = None,
+        gradient_normalization_threshold: Optional[float] = None,
+        seed: Optional[int] = None,
+    ):
+        self.updater = None if updater is None else get_updater(updater)
+        self.activation = activation
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+        self.l1 = l1
+        self.l2 = l2
+        self.weight_decay = weight_decay
+        self.dropout = dropout
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = gradient_normalization_threshold
+        self.seed = seed
+
+    class Builder:
+        def __init__(self):
+            self._kw: Dict[str, Any] = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def activation(self, a):
+            self._kw["activation"] = a
+            return self
+
+        def weight_init(self, w):
+            self._kw["weight_init"] = w
+            return self
+
+        def bias_init(self, b):
+            self._kw["bias_init"] = b
+            return self
+
+        def l1(self, v):
+            self._kw["l1"] = v
+            return self
+
+        def l2(self, v):
+            self._kw["l2"] = v
+            return self
+
+        def weight_decay(self, v):
+            self._kw["weight_decay"] = v
+            return self
+
+        def dropout(self, v):
+            self._kw["dropout"] = v
+            return self
+
+        def gradient_normalization(self, mode, threshold=1.0):
+            self._kw["gradient_normalization"] = mode
+            self._kw["gradient_normalization_threshold"] = threshold
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self) -> "FineTuneConfiguration":
+            return FineTuneConfiguration(**self._kw)
+
+    def apply_to_layer(self, layer: Layer) -> None:
+        if self.updater is not None:
+            layer.updater = self.updater
+        if self.dropout is not None:
+            layer.dropout = float(self.dropout)
+        if self.gradient_normalization is not None:
+            layer.gradient_normalization = self.gradient_normalization
+            layer.gradient_normalization_threshold = float(
+                self.gradient_normalization_threshold
+                if self.gradient_normalization_threshold is not None
+                else 1.0
+            )
+        if self.l1 is not None or self.l2 is not None or self.weight_decay is not None:
+            layer.regularization = RegularizationConf(
+                l1=self.l1 or 0.0, l2=self.l2 or 0.0,
+                weight_decay=self.weight_decay or 0.0,
+            )
+        if self.activation is not None and hasattr(layer, "activation"):
+            layer.activation = self.activation
+        if self.weight_init is not None and hasattr(layer, "weight_init"):
+            layer.weight_init = self.weight_init
+        if self.bias_init is not None and hasattr(layer, "bias_init"):
+            layer.bias_init = float(self.bias_init)
+
+
+class TransferLearning:
+    """Namespace matching the reference's outer class."""
+
+    class Builder:
+        """Edit a trained MultiLayerNetwork (reference
+        ``TransferLearning.Builder``)."""
+
+        def __init__(self, source: MultiLayerNetwork):
+            if source.params_ is None:
+                raise ValueError("Source network must be initialized/trained")
+            self._source = source
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._n_removed = 0
+            self._nout_replacements: Dict[int, tuple] = {}
+            self._added: List[Layer] = []
+            self._input_type = source.conf.input_type
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration) -> "TransferLearning.Builder":
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, layer_index: int) -> "TransferLearning.Builder":
+            """Freeze layers [0, layer_index] inclusive."""
+            self._freeze_until = int(layer_index)
+            return self
+
+        def nout_replace(self, layer_index: int, n_out: int,
+                         weight_init: Optional[Union[str, Distribution]] = None
+                         ) -> "TransferLearning.Builder":
+            self._nout_replacements[int(layer_index)] = (int(n_out), weight_init)
+            return self
+
+        def remove_output_layer(self) -> "TransferLearning.Builder":
+            self._n_removed += 1
+            return self
+
+        def remove_layers_from_output(self, n: int) -> "TransferLearning.Builder":
+            self._n_removed += int(n)
+            return self
+
+        def add_layer(self, layer: Layer) -> "TransferLearning.Builder":
+            self._added.append(layer)
+            return self
+
+        def set_input_type(self, t) -> "TransferLearning.Builder":
+            self._input_type = t
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._source
+            n_src = len(src.layers)
+            n_keep = n_src - self._n_removed
+            if n_keep < 0:
+                raise ValueError(
+                    f"Removed {self._n_removed} layers but network has {n_src}"
+                )
+            # Clone kept layer configs (decouple from source)
+            kept = [src.layers[i].clone() for i in range(n_keep)]
+
+            reinit = set()  # layer indices (in kept list) needing fresh params
+            for idx, (n_out, w_init) in self._nout_replacements.items():
+                if idx >= n_keep:
+                    raise ValueError(f"nout_replace index {idx} out of kept range")
+                layer = kept[idx]
+                if not hasattr(layer, "n_out"):
+                    raise ValueError(f"Layer {idx} has no nOut to replace")
+                layer.n_out = n_out
+                if w_init is not None:
+                    layer.weight_init = w_init
+                reinit.add(idx)
+                # next layer's nIn changes → clear so shape inference refills,
+                # and its params must be reinitialized
+                if idx + 1 < n_keep:
+                    nxt = kept[idx + 1]
+                    if hasattr(nxt, "n_in"):
+                        nxt.n_in = None
+                    reinit.add(idx + 1)
+
+            if self._fine_tune is not None:
+                start = 0 if self._freeze_until is None else self._freeze_until + 1
+                for i in range(start, n_keep):
+                    self._fine_tune.apply_to_layer(kept[i])
+
+            new_layers = kept + list(self._added)
+            freeze_until = self._freeze_until
+            if freeze_until is not None:
+                for i in range(0, min(freeze_until + 1, len(new_layers))):
+                    if not isinstance(new_layers[i], FrozenLayer):
+                        new_layers[i] = FrozenLayer(layer=new_layers[i])
+
+            gc = copy.deepcopy(src.conf.global_conf)
+            if self._fine_tune is not None:
+                if self._fine_tune.seed is not None:
+                    gc.seed = self._fine_tune.seed
+                if self._fine_tune.updater is not None:
+                    gc.updater = self._fine_tune.updater
+
+            from deeplearning4j_tpu.nn.conf.builders import (
+                ListBuilder,
+                infer_preprocessor,
+            )
+
+            lb = ListBuilder(gc)
+            for l in new_layers:
+                lb.layer(l)
+            # keep explicit preprocessors from the source for kept layers
+            for i, p in src.conf.preprocessors.items():
+                if i < n_keep:
+                    lb.input_pre_processor(i, copy.deepcopy(p))
+            if self._input_type is not None:
+                lb.set_input_type(self._input_type)
+            lb.backprop_type(
+                src.conf.backprop_type,
+                src.conf.tbptt_fwd_length,
+                src.conf.tbptt_back_length,
+            )
+            conf = lb.build()
+            net = MultiLayerNetwork(conf).init()
+
+            # Copy source params/state/opt-state for kept, non-reinit layers
+            for i in range(n_keep):
+                if i in reinit:
+                    continue
+                net.params_[i] = {k: jnp.copy(v) for k, v in src.params_[i].items()}
+                net.state_[i] = {k: jnp.copy(v) for k, v in src.state_[i].items()}
+                if not isinstance(net.layers[i], FrozenLayer):
+                    # keep updater slots consistent with the (possibly new)
+                    # updater type: re-init slots but at source shapes
+                    upd = net.layers[i].updater if net.layers[i].updater is not None else NoOp()
+                    net.opt_state_[i] = {
+                        name: upd.init_state(arr) for name, arr in net.params_[i].items()
+                    }
+            return net
+
+    class GraphBuilder:
+        """Edit a trained ComputationGraph (reference
+        ``TransferLearning.GraphBuilder``): freeze up to a vertex,
+        fine-tune, replace layer nOut, remove+re-add vertices/layers."""
+
+        def __init__(self, source):
+            if source.params_ is None:
+                raise ValueError("Source graph must be initialized/trained")
+            self._source = source
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._frozen_until: Optional[str] = None
+            self._removed: List[str] = []
+            self._nout_replacements: Dict[str, tuple] = {}
+            self._added: List[tuple] = []  # (name, layer_or_vertex, inputs)
+            self._new_outputs: Optional[List[str]] = None
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+
+        def set_feature_extractor(self, vertex_name: str):
+            self._frozen_until = vertex_name
+            return self
+
+        def nout_replace(self, layer_name: str, n_out: int, weight_init=None):
+            self._nout_replacements[layer_name] = (int(n_out), weight_init)
+            return self
+
+        def remove_vertex_and_connections(self, name: str):
+            self._removed.append(name)
+            return self
+
+        def add_layer(self, name: str, layer: Layer, *inputs: str):
+            self._added.append((name, layer, list(inputs)))
+            return self
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            self._added.append((name, vertex, list(inputs)))
+            return self
+
+        def set_outputs(self, *names: str):
+            self._new_outputs = list(names)
+            return self
+
+        def build(self):
+            from deeplearning4j_tpu.nn.conf.graph_builder import (
+                GraphBuilder as CGB,
+                LayerVertex,
+            )
+            from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+            src = self._source
+            conf = src.conf
+            removed = set(self._removed)
+            # downstream of a removed vertex must be removed too (reference
+            # removeVertexAndConnections removes the edges; we require the
+            # user to re-add, so drop dependents transitively)
+            changed = True
+            while changed:
+                changed = False
+                for name, inputs in conf.vertex_inputs.items():
+                    if name in removed:
+                        continue
+                    if any(i in removed for i in inputs):
+                        removed.add(name)
+                        changed = True
+
+            # which vertices are frozen: all ancestors of _frozen_until + itself
+            frozen = set()
+            if self._frozen_until is not None:
+                stack = [self._frozen_until]
+                while stack:
+                    v = stack.pop()
+                    if v in frozen or v in conf.network_inputs:
+                        continue
+                    frozen.add(v)
+                    stack.extend(conf.vertex_inputs.get(v, []))
+
+            gc = copy.deepcopy(conf.global_conf)
+            if self._fine_tune is not None and self._fine_tune.updater is not None:
+                gc.updater = self._fine_tune.updater
+            gb = CGB(gc)
+            gb.add_inputs(*conf.network_inputs)
+            if conf.input_types:
+                gb.set_input_types(*conf.input_types)
+
+            # layers needing fresh params: nOut-replaced + their consumers
+            reinit = set(self._nout_replacements)
+            for name in self._nout_replacements:
+                for other, ins in conf.vertex_inputs.items():
+                    if name in ins and isinstance(conf.vertices.get(other), LayerVertex):
+                        reinit.add(other)
+
+            name_order = list(conf.topological_order)
+            for name in name_order:
+                if name in removed:
+                    continue
+                v = conf.vertices[name]
+                inputs = conf.vertex_inputs[name]
+                if isinstance(v, LayerVertex):
+                    layer = v.layer.clone()
+                    if name in self._nout_replacements:
+                        n_out, w_init = self._nout_replacements[name]
+                        layer.n_out = n_out
+                        if w_init is not None:
+                            layer.weight_init = w_init
+                    elif name in reinit and hasattr(layer, "n_in"):
+                        layer.n_in = None  # consumer of a replaced layer
+                    if self._fine_tune is not None and name not in frozen:
+                        self._fine_tune.apply_to_layer(layer)
+                    if name in frozen and not isinstance(layer, FrozenLayer):
+                        layer = FrozenLayer(layer=layer)
+                    gb.add_layer(name, layer, *inputs,
+                                 preprocessor=copy.deepcopy(v.preprocessor))
+                else:
+                    gb.add_vertex(name, copy.deepcopy(v), *inputs)
+
+            for name, obj, inputs in self._added:
+                if isinstance(obj, Layer):
+                    gb.add_layer(name, obj, *inputs)
+                else:
+                    gb.add_vertex(name, obj, *inputs)
+            outputs = self._new_outputs if self._new_outputs is not None else [
+                o for o in conf.network_outputs if o not in removed
+            ]
+            gb.set_outputs(*outputs)
+            new_conf = gb.build()
+            net = ComputationGraph(new_conf).init()
+
+            # copy params for kept, unmodified layers
+            for name in name_order:
+                if name in removed or name in reinit:
+                    continue
+                if name in net.params_ and name in src.params_:
+                    net.params_[name] = {k: jnp.copy(v) for k, v in src.params_[name].items()}
+                    if name in src.state_:
+                        net.state_[name] = {k: jnp.copy(v) for k, v in src.state_[name].items()}
+                    v = new_conf.vertices[name]
+                    layer = v.layer if isinstance(v, LayerVertex) else None
+                    if layer is not None and not isinstance(layer, FrozenLayer):
+                        upd = layer.updater if layer.updater is not None else NoOp()
+                        net.opt_state_[name] = {
+                            pn: upd.init_state(arr) for pn, arr in net.params_[name].items()
+                        }
+            return net
+
+
+class TransferLearningHelper:
+    """Featurize-then-train on the unfrozen tail (reference
+    ``TransferLearningHelper.java``): run inputs through the frozen front
+    once (inference mode), cache the activations, and fit only the
+    unfrozen subnetwork on them."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: Optional[int] = None):
+        """If frozen_until is None, the frozen boundary is inferred from
+        FrozenLayer wrappers already present."""
+        self.full_net = net
+        if frozen_until is None:
+            frozen_until = -1
+            for i, l in enumerate(net.layers):
+                if isinstance(l, FrozenLayer):
+                    frozen_until = i
+                else:
+                    break
+        self.frozen_until = frozen_until
+        if frozen_until < 0:
+            raise ValueError("No frozen layers found; nothing to featurize")
+        self._unfrozen = self._build_unfrozen()
+
+    def _build_unfrozen(self) -> MultiLayerNetwork:
+        src = self.full_net
+        start = self.frozen_until + 1
+        types = src.conf.layer_types()
+        from deeplearning4j_tpu.nn.conf.builders import ListBuilder
+
+        gc = copy.deepcopy(src.conf.global_conf)
+        lb = ListBuilder(gc)
+        for i in range(start, len(src.layers)):
+            l = src.layers[i]
+            lb.layer(l.layer.clone() if isinstance(l, FrozenLayer) else l.clone())
+        for i, p in src.conf.preprocessors.items():
+            if i >= start:
+                lb.input_pre_processor(i - start, copy.deepcopy(p))
+        lb.set_input_type(types[start])
+        conf = lb.build()
+        net = MultiLayerNetwork(conf).init()
+        for i in range(start, len(src.layers)):
+            net.params_[i - start] = {k: jnp.copy(v) for k, v in src.params_[i].items()}
+            net.state_[i - start] = {k: jnp.copy(v) for k, v in src.state_[i].items()}
+        return net
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self._unfrozen
+
+    def featurize(self, ds):
+        """Run features through the frozen front (reference
+        ``featurize``)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        src = self.full_net
+        x, mask, _, _, _ = src._forward(
+            src.params_, src.state_, jnp.asarray(ds.features), train=False,
+            rng=None,
+            fmask=None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            stop_before=self.frozen_until + 1,
+        )
+        return DataSet(
+            np.asarray(x), ds.labels,
+            features_mask=None if mask is None else np.asarray(mask),
+            labels_mask=ds.labels_mask,
+        )
+
+    def fit_featurized(self, ds_or_iter, epochs: int = 1):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        if isinstance(ds_or_iter, DataSet):
+            self._unfrozen.fit(ds_or_iter, epochs=epochs)
+        else:
+            self._unfrozen.fit(ds_or_iter, epochs=epochs)
+        self._sync_back()
+        return self
+
+    def output_from_featurized(self, features):
+        return self._unfrozen.output(features)
+
+    def _sync_back(self):
+        """Write trained tail params back into the full network."""
+        start = self.frozen_until + 1
+        for i in range(start, len(self.full_net.layers)):
+            self.full_net.params_[i] = {
+                k: jnp.copy(v) for k, v in self._unfrozen.params_[i - start].items()
+            }
+            self.full_net.state_[i] = {
+                k: jnp.copy(v) for k, v in self._unfrozen.state_[i - start].items()
+            }

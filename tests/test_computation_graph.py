@@ -415,3 +415,72 @@ class TestGraphParallel:
         )
         with pytest.raises(ValueError, match="not an output layer"):
             ComputationGraph(conf)
+
+
+class TestGraphSerdeOrdering:
+    def test_topo_order_survives_json_roundtrip(self):
+        """Non-alphabetical parallel branches: flattened-param order must be
+        identical after a JSON round-trip (regression: sort_keys used to
+        reorder vertex insertion order and corrupt restored params)."""
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.conf.graph_builder import (
+            ComputationGraphConfiguration,
+        )
+
+        gb = (
+            NeuralNetConfiguration.builder().seed(1).graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("z1", DenseLayer(n_out=5, activation="relu"), "in")
+            .add_layer("a2", DenseLayer(n_out=5, activation="relu"), "in")
+            .add_vertex("merge", MergeVertex(), "z1", "a2")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "merge")
+            .set_outputs("out")
+        )
+        conf = gb.build()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert conf2.topological_order == conf.topological_order
+        net = ComputationGraph(conf).init()
+        net2 = ComputationGraph(conf2).init()
+        assert net2.layer_names == net.layer_names
+
+    def test_multi_input_layer_auto_merges(self):
+        """A layer declared with two inputs gets an implicit MergeVertex
+        (reference GraphBuilder behavior) instead of silently dropping
+        the second input."""
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = (
+            NeuralNetConfiguration.builder().seed(1).graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("dA", DenseLayer(n_out=3, activation="relu"), "in")
+            .add_layer("dB", DenseLayer(n_out=5, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"),
+                       "dA", "dB")
+            .set_outputs("out")
+        )
+        net = ComputationGraph(gb.build()).init()
+        # out's weight matrix must see merged width 3+5=8
+        assert net.params_["out"]["W"].shape == (8, 2)
+        y = net.output_single(np.zeros((2, 4), np.float32))
+        assert y.shape == (2, 2)
+
+    def test_unstack_indivisible_batch_raises(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from deeplearning4j_tpu.nn.conf.graph_vertices import UnstackVertex
+
+        v = UnstackVertex(from_idx=0, stack_size=2)
+        with pytest.raises(ValueError, match="not divisible"):
+            v.apply([jnp.zeros((5, 3))], [None])

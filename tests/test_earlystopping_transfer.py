@@ -1,0 +1,277 @@
+"""Early stopping + transfer learning + memory report tests.
+
+Models the reference suites ``earlystopping/TestEarlyStopping.java`` and
+``nn/transferlearning/*`` tests (SURVEY.md §4.2).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.conf.memory import memory_report_mln
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+    TransferLearningHelper,
+)
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.updaters import Adam, Sgd
+
+
+def _toy_data(n=64, n_in=4, n_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, n_in)).astype(np.float32)
+    w = rng.standard_normal((n_in, n_out))
+    y = np.eye(n_out, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+    return DataSet(x, y)
+
+
+def _net(n_in=4, n_out=3, lr=0.1, seed=12345):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(lr))
+        .weight_init("xavier")
+        .list()
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=n_out, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(n_in))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        ds = _toy_data()
+        train_it = ListDataSetIterator(ds, 16)
+        val_it = ListDataSetIterator(_toy_data(seed=1), 16)
+        net = _net()
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .model_saver(InMemoryModelSaver())
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert "MaxEpochs" in result.termination_details
+        assert result.total_epochs == 5
+        assert len(result.score_vs_epoch) == 5
+        assert result.get_best_model() is not None
+        # best score should be one of the recorded scores
+        assert result.best_model_score in result.score_vs_epoch.values()
+
+    def test_score_improvement_patience(self):
+        ds = _toy_data()
+        train_it = ListDataSetIterator(ds, 16)
+        val_it = ListDataSetIterator(ds, 16)
+        # lr=0 → no improvement ever → patience trips quickly
+        net = _net(lr=0.0)
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val_it))
+            .epoch_termination_conditions(
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50),
+            )
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert "ScoreImprovement" in result.termination_details
+        assert result.total_epochs <= 5
+
+    def test_max_score_iteration_divergence_guard(self):
+        ds = _toy_data()
+        train_it = ListDataSetIterator(ds, 16)
+        val_it = ListDataSetIterator(ds, 16)
+        net = _net()
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(10))
+            .iteration_termination_conditions(
+                MaxScoreIterationTerminationCondition(1e-8)  # triggers at once
+            )
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+        assert "MaxScore" in result.termination_details
+
+    def test_max_time_termination(self):
+        ds = _toy_data()
+        train_it = ListDataSetIterator(ds, 8)
+        val_it = ListDataSetIterator(ds, 16)
+        net = _net()
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(100000))
+            .iteration_termination_conditions(
+                MaxTimeIterationTerminationCondition(0.0)
+            )
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
+
+    def test_training_actually_improves_and_best_model_kept(self):
+        ds = _toy_data(n=128)
+        train_it = ListDataSetIterator(ds, 32)
+        val_it = ListDataSetIterator(ds, 64)
+        net = _net(lr=0.05)
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(val_it))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, train_it).fit()
+        scores = [result.score_vs_epoch[e] for e in sorted(result.score_vs_epoch)]
+        assert scores[-1] < scores[0]  # learning happened
+        best = result.get_best_model()
+        # best model's val loss matches recorded best
+        got = DataSetLossCalculator(val_it).calculate_score(best)
+        assert got == pytest.approx(result.best_model_score, rel=1e-3)
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_output(self):
+        src = _net()
+        src.fit(_toy_data(), epochs=2)
+        frozen_w_before = np.asarray(src.params_[0]["W"]).copy()
+
+        net2 = (
+            TransferLearning.Builder(src)
+            .fine_tune_configuration(
+                FineTuneConfiguration.Builder().updater(Sgd(0.3)).build()
+            )
+            .set_feature_extractor(0)
+            .nout_replace(1, 5, weight_init="xavier")
+            .build()
+        )
+        assert isinstance(net2.layers[0], FrozenLayer)
+        assert net2.layers[1].n_out == 5
+        # frozen layer params copied from source
+        np.testing.assert_array_equal(np.asarray(net2.params_[0]["W"]), frozen_w_before)
+        # train on 5-class data; frozen layer must not move
+        ds5 = _toy_data(n_out=5, seed=3)
+        net2.fit(ds5, epochs=2)
+        np.testing.assert_array_equal(np.asarray(net2.params_[0]["W"]), frozen_w_before)
+        out = net2.output(ds5.features)
+        assert out.shape == (64, 5)
+
+    def test_remove_and_add_layers(self):
+        src = _net()
+        src.fit(_toy_data(), epochs=1)
+        net2 = (
+            TransferLearning.Builder(src)
+            .remove_output_layer()
+            .add_layer(DenseLayer(n_out=8, activation="relu"))
+            .add_layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .build()
+        )
+        assert len(net2.layers) == 3
+        # first layer params kept from source (not frozen — compare pre-fit)
+        np.testing.assert_array_equal(
+            np.asarray(net2.params_[0]["W"]), np.asarray(src.params_[0]["W"])
+        )
+        ds2 = _toy_data(n_out=2, seed=4)
+        net2.fit(ds2, epochs=1)
+        assert net2.output(ds2.features).shape == (64, 2)
+
+    def test_fine_tune_only(self):
+        src = _net()
+        src.fit(_toy_data(), epochs=1)
+        ftc = FineTuneConfiguration.Builder().updater(Sgd(0.01)).l2(1e-4).build()
+        net2 = TransferLearning.Builder(src).fine_tune_configuration(ftc).build()
+        # params preserved exactly
+        np.testing.assert_array_equal(
+            np.asarray(net2.params_[1]["W"]), np.asarray(src.params_[1]["W"])
+        )
+        # updater overridden
+        assert type(net2.layers[0].updater).__name__ == "Sgd"
+        net2.fit(_toy_data(), epochs=1)  # trains fine
+
+    def test_helper_featurize(self):
+        src = _net()
+        src.fit(_toy_data(), epochs=1)
+        net2 = (
+            TransferLearning.Builder(src).set_feature_extractor(0).build()
+        )
+        helper = TransferLearningHelper(net2)
+        ds = _toy_data(seed=5)
+        feat = helper.featurize(ds)
+        assert feat.features.shape == (64, 16)  # dense-16 output
+        helper.fit_featurized(feat, epochs=1)
+        # tail trained; full-net output consistent with tail output on features
+        full_out = net2.output(ds.features)
+        tail_out = helper.output_from_featurized(feat.features)
+        np.testing.assert_allclose(full_out, tail_out, rtol=1e-5, atol=1e-6)
+
+
+class TestTransferLearningGraph:
+    def test_graph_freeze_and_new_output(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = (
+            NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(4))
+            .add_layer("d1", DenseLayer(n_out=10, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "d1")
+            .set_outputs("out")
+        )
+        src = ComputationGraph(gb.build()).init()
+        ds = _toy_data()
+        src.fit(ds, epochs=1)
+        w_before = np.asarray(src.params_["d1"]["W"]).copy()
+
+        net2 = (
+            TransferLearning.GraphBuilder(src)
+            .set_feature_extractor("d1")
+            .nout_replace("out", 6)
+            .build()
+        )
+        np.testing.assert_array_equal(np.asarray(net2.params_["d1"]["W"]), w_before)
+        ds6 = _toy_data(n_out=6, seed=9)
+        net2.fit(ds6, epochs=2)
+        np.testing.assert_array_equal(np.asarray(net2.params_["d1"]["W"]), w_before)
+        out = net2.output_single(ds6.features)
+        assert out.shape == (64, 6)
+
+
+class TestMemoryReport:
+    def test_mln_report(self):
+        net = _net()
+        rep = memory_report_mln(net.conf)
+        assert rep.total_params == net.num_params()
+        b32 = rep.total_memory_bytes(32, training=True)
+        b1 = rep.total_memory_bytes(1, training=True)
+        assert b32 > b1  # activation term scales with batch
+        inf = rep.total_memory_bytes(32, training=False)
+        assert inf < b32  # no grads/updater state at inference
+        s = rep.to_string(32)
+        assert "total params" in s
