@@ -80,11 +80,12 @@ def _needs_ff_input(layer: Layer) -> bool:
         ElementWiseMultiplicationLayer,
     )
     from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
+    from deeplearning4j_tpu.nn.conf.layers.variational import VariationalAutoencoder
 
     return isinstance(
         layer,
         (DenseLayer, BaseOutputLayer, AutoEncoder, ElementWiseMultiplicationLayer,
-         CenterLossOutputLayer),
+         CenterLossOutputLayer, VariationalAutoencoder),
     )
 
 

@@ -50,9 +50,23 @@ from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     RnnOutputLayer,
     SimpleRnn,
 )
+from deeplearning4j_tpu.nn.conf.layers.objdetect import (
+    CnnLossLayer,
+    DetectedObject,
+    Yolo2OutputLayer,
+    non_max_suppression,
+)
 from deeplearning4j_tpu.nn.conf.layers.special import (
     CenterLossOutputLayer,
     FrozenLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.variational import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    VariationalAutoencoder,
 )
 
 __all__ = [
@@ -70,4 +84,8 @@ __all__ = [
     "Bidirectional", "LastTimeStep", "MaskZeroLayer", "RnnOutputLayer",
     "RnnLossLayer",
     "FrozenLayer", "CenterLossOutputLayer",
+    "VariationalAutoencoder", "BernoulliReconstructionDistribution",
+    "GaussianReconstructionDistribution", "ExponentialReconstructionDistribution",
+    "CompositeReconstructionDistribution", "LossFunctionWrapper",
+    "Yolo2OutputLayer", "CnnLossLayer", "DetectedObject", "non_max_suppression",
 ]

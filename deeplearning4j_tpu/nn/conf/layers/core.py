@@ -265,6 +265,14 @@ class AutoEncoder(FeedForwardLayer):
         per = _losses.get(self.loss)(x, recon_pre, self.activation)
         return jnp.mean(per)
 
+    def reconstruct(self, params, x):
+        """Uncorrupted encode→decode (used by AutoencoderScoreCalculator)."""
+        x = jnp.asarray(x)
+        h = self.act_fn()(x @ params["W"] + params["b"])
+        from deeplearning4j_tpu import activations as _act2
+
+        return _act2.get(self.activation)(h @ params["W"].T + params["vb"])
+
 
 @serde.register
 class DummyLayer(Layer):

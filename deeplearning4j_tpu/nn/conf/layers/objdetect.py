@@ -1,0 +1,269 @@
+"""Object detection: YOLOv2 output layer + per-pixel CNN loss layer.
+
+Reference: ``nn/conf/layers/objdetect/Yolo2OutputLayer.java:45`` (config:
+bounding-box priors, lambdaCoord/lambdaNoObj) and the runtime
+``nn/layers/objdetect/Yolo2OutputLayer.java:71`` (the YOLOv2 loss:
+position SSE on sigmoid(x,y), size SSE on sqrt(w,h), confidence = IOU
+target, per-cell class cross-entropy), ``nn/layers/objdetect/
+DetectedObject.java`` and ``YoloUtils.java`` (getPredictedObjects + NMS).
+``CnnLossLayer``: reference ``nn/conf/layers/CnnLossLayer.java`` —
+parameter-free per-spatial-position loss.
+
+Layouts (TPU-native NHWC; reference is NCHW):
+- network activations into this layer: (b, H, W, B*(5+C))
+  per box: [tx, ty, tw, th, tconf, class logits...]
+- labels: (b, H, W, 4+C): [x1, y1, x2, y2] in *grid units* + one-hot class,
+  where (x1,y1,x2,y2) is the ground-truth box for the cell that contains
+  its center; cells with no object have all-zero labels.
+  (reference label format is (mb, 4+C, H, W) with the same convention —
+  ``Yolo2OutputLayer.java`` label docs.)
+
+The whole loss is elementwise + small reductions over a dense (b,H,W,B)
+lattice — fuses into one XLA kernel; no per-box host loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import activations as _act
+from deeplearning4j_tpu import losses as _losses
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import Layer
+
+
+@serde.register
+class CnnLossLayer(Layer):
+    """Parameter-free per-position loss over CNN activations (reference
+    ``CnnLossLayer.java``): input (b, H, W, C), labels same shape; loss
+    applied at every spatial position. Mask (b, H, W) supported."""
+
+    is_output_layer = True
+
+    def __init__(self, loss: str = "mcxent", activation: str = "identity", **kwargs):
+        super().__init__(**kwargs)
+        self.loss = loss
+        self.activation = activation
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return _act.get(self.activation)(x), state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        b = x.shape[0]
+        xf = x.reshape(-1, x.shape[-1])
+        lf = labels.reshape(-1, labels.shape[-1])
+        mf = None
+        if mask is not None:
+            mf = mask.reshape(-1)[:, None]
+        per_pos = _losses.get(self.loss)(lf, xf, self.activation, mf)  # (b*H*W,)
+        return per_pos.reshape(b, -1).sum(axis=1)
+
+
+class DetectedObject:
+    """One predicted box (reference ``nn/layers/objdetect/DetectedObject.java``).
+    Coordinates are in grid units; ``top_left``/``bottom_right`` convert."""
+
+    def __init__(self, example: int, center_x: float, center_y: float,
+                 width: float, height: float, predicted_class: int,
+                 confidence: float, class_probs: Optional[np.ndarray] = None):
+        self.example = example
+        self.center_x = center_x
+        self.center_y = center_y
+        self.width = width
+        self.height = height
+        self.predicted_class = predicted_class
+        self.confidence = confidence
+        self.class_probs = class_probs
+
+    def top_left(self) -> Tuple[float, float]:
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    def bottom_right(self) -> Tuple[float, float]:
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+    def __repr__(self):
+        return (f"DetectedObject(ex={self.example}, c=({self.center_x:.2f},"
+                f"{self.center_y:.2f}), wh=({self.width:.2f},{self.height:.2f}), "
+                f"cls={self.predicted_class}, conf={self.confidence:.3f})")
+
+
+def iou(a: DetectedObject, b: DetectedObject) -> float:
+    """(reference ``YoloUtils.iou``)."""
+    ax1, ay1 = a.top_left()
+    ax2, ay2 = a.bottom_right()
+    bx1, by1 = b.top_left()
+    bx2, by2 = b.bottom_right()
+    iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = iw * ih
+    union = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / union if union > 0 else 0.0
+
+
+def non_max_suppression(objs: List[DetectedObject], iou_threshold: float = 0.45
+                        ) -> List[DetectedObject]:
+    """Greedy per-class NMS (reference ``YoloUtils.nms``)."""
+    out: List[DetectedObject] = []
+    by_cls: dict = {}
+    for o in objs:
+        by_cls.setdefault((o.example, o.predicted_class), []).append(o)
+    for group in by_cls.values():
+        group = sorted(group, key=lambda o: -o.confidence)
+        kept: List[DetectedObject] = []
+        for o in group:
+            if all(iou(o, k) < iou_threshold for k in kept):
+                kept.append(o)
+        out.extend(kept)
+    return out
+
+
+@serde.register
+class Yolo2OutputLayer(Layer):
+    """(reference config ``objdetect/Yolo2OutputLayer.java:45``, runtime
+    ``nn/layers/objdetect/Yolo2OutputLayer.java:71``).
+
+    ``bounding_box_priors``: (B, 2) array of (width, height) anchor priors
+    in grid units.
+    """
+
+    is_output_layer = True
+
+    def __init__(self, bounding_box_priors=None, lambda_coord: float = 5.0,
+                 lambda_no_obj: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        if bounding_box_priors is None:
+            raise ValueError("Yolo2OutputLayer requires boundingBoxPriors (B,2)")
+        self.bounding_box_priors = np.asarray(bounding_box_priors, np.float32).tolist()
+        self.lambda_coord = float(lambda_coord)
+        self.lambda_no_obj = float(lambda_no_obj)
+
+    @property
+    def n_boxes(self) -> int:
+        return len(self.bounding_box_priors)
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def _split_predictions(self, x):
+        """(b,H,W,B*(5+C)) → sigmoid xy (b,H,W,B,2), wh (b,H,W,B,2),
+        conf (b,H,W,B), class logits (b,H,W,B,C)."""
+        b, H, W, D = x.shape
+        B = self.n_boxes
+        per = D // B
+        C = per - 5
+        x5 = x.reshape(b, H, W, B, per)
+        xy = jax.nn.sigmoid(x5[..., 0:2])
+        priors = jnp.asarray(self.bounding_box_priors)  # (B,2)
+        wh = jnp.exp(x5[..., 2:4]) * priors  # grid units
+        conf = jax.nn.sigmoid(x5[..., 4])
+        cls_logits = x5[..., 5:]
+        return xy, wh, conf, cls_logits, C
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        """Activated predictions in the same packed layout (for
+        ``get_predicted_objects``)."""
+        b, H, W, D = x.shape
+        xy, wh, conf, cls_logits, C = self._split_predictions(x)
+        cls_p = jax.nn.softmax(cls_logits, axis=-1)
+        out = jnp.concatenate([xy, wh, conf[..., None], cls_p], axis=-1)
+        return out.reshape(b, H, W, D), state or {}
+
+    def compute_score(self, params, x, labels, mask=None):
+        """YOLOv2 loss per example (reference ``computeScore``/
+        ``calculateLoss``)."""
+        b, H, W, _ = x.shape
+        xy, wh, conf, cls_logits, C = self._split_predictions(x)  # grid units
+
+        # labels: (b,H,W,4+C)
+        gt_box = labels[..., :4]  # x1,y1,x2,y2 grid units
+        gt_cls = labels[..., 4:]  # one-hot
+        has_obj = (jnp.sum(gt_cls, axis=-1) > 0).astype(x.dtype)  # (b,H,W)
+
+        gt_cx = (gt_box[..., 0] + gt_box[..., 2]) / 2  # (b,H,W) grid units
+        gt_cy = (gt_box[..., 1] + gt_box[..., 3]) / 2
+        gt_w = jnp.maximum(gt_box[..., 2] - gt_box[..., 0], 1e-6)
+        gt_h = jnp.maximum(gt_box[..., 3] - gt_box[..., 1], 1e-6)
+
+        # offsets of gt center within its cell
+        cols = jnp.arange(W, dtype=x.dtype)[None, None, :]
+        rows = jnp.arange(H, dtype=x.dtype)[None, :, None]
+        gt_ox = gt_cx - cols  # ∈[0,1] for the containing cell
+        gt_oy = gt_cy - rows
+
+        # predicted absolute centers per box: cell corner + sigmoid offset
+        pred_cx = xy[..., 0] + cols[..., None]
+        pred_cy = xy[..., 1] + rows[..., None]
+        pred_w = wh[..., 0]
+        pred_h = wh[..., 1]
+
+        # IOU of each predicted box vs the cell's gt box (b,H,W,B)
+        px1, px2 = pred_cx - pred_w / 2, pred_cx + pred_w / 2
+        py1, py2 = pred_cy - pred_h / 2, pred_cy + pred_h / 2
+        gx1, gx2 = gt_cx[..., None] - gt_w[..., None] / 2, gt_cx[..., None] + gt_w[..., None] / 2
+        gy1, gy2 = gt_cy[..., None] - gt_h[..., None] / 2, gt_cy[..., None] + gt_h[..., None] / 2
+        iw = jnp.maximum(0.0, jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1))
+        ih = jnp.maximum(0.0, jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1))
+        inter = iw * ih
+        union = pred_w * pred_h + (gt_w * gt_h)[..., None] - inter
+        ious = inter / jnp.maximum(union, 1e-6)  # (b,H,W,B)
+
+        # responsible box = argmax IOU in each object cell (reference: the
+        # predictor with highest IOU "is responsible")
+        resp = jax.nn.one_hot(jnp.argmax(ious, axis=-1), self.n_boxes, dtype=x.dtype)
+        resp = resp * has_obj[..., None]  # (b,H,W,B)
+
+        # position loss: sigmoid-offset vs gt offset
+        pos = (xy[..., 0] - gt_ox[..., None]) ** 2 + (xy[..., 1] - gt_oy[..., None]) ** 2
+        # size loss on sqrt w/h (reference uses sqrt to downweight large boxes)
+        size = (jnp.sqrt(jnp.maximum(pred_w, 1e-6)) - jnp.sqrt(gt_w)[..., None]) ** 2 \
+             + (jnp.sqrt(jnp.maximum(pred_h, 1e-6)) - jnp.sqrt(gt_h)[..., None]) ** 2
+        coord_loss = self.lambda_coord * jnp.sum(resp * (pos + size), axis=(1, 2, 3))
+
+        # confidence: target = IOU for responsible, 0 for the rest
+        conf_obj = jnp.sum(resp * (conf - jax.lax.stop_gradient(ious)) ** 2, axis=(1, 2, 3))
+        conf_noobj = self.lambda_no_obj * jnp.sum(
+            (1.0 - resp) * conf**2, axis=(1, 2, 3)
+        )
+
+        # class loss: softmax cross-entropy at object cells
+        log_p = jax.nn.log_softmax(cls_logits, axis=-1)  # (b,H,W,B,C)
+        ce = -jnp.sum(gt_cls[..., None, :] * log_p, axis=-1)  # (b,H,W,B)
+        cls_loss = jnp.sum(resp * ce, axis=(1, 2, 3))
+
+        total = coord_loss + conf_obj + conf_noobj + cls_loss
+        if mask is not None:
+            total = total * mask.reshape(total.shape)
+        return total
+
+    # ---------------------------------------------------------- inference
+    def get_predicted_objects(self, activated: np.ndarray, threshold: float = 0.5
+                              ) -> List[DetectedObject]:
+        """Decode ``apply`` output into DetectedObjects (reference
+        ``YoloUtils.getPredictedObjects``). Host-side: detection decoding is
+        inherently sparse/dynamic, so it runs on CPU over the dense device
+        output."""
+        a = np.asarray(activated)
+        b, H, W, D = a.shape
+        B = self.n_boxes
+        per = D // B
+        a5 = a.reshape(b, H, W, B, per)
+        out: List[DetectedObject] = []
+        for ex in range(b):
+            conf = a5[ex, ..., 4]  # (H,W,B)
+            ys, xs, bs = np.where(conf > threshold)
+            for y, x_, bi in zip(ys, xs, bs):
+                box = a5[ex, y, x_, bi]
+                cx = box[0] + x_
+                cy = box[1] + y
+                w, h = box[2], box[3]
+                probs = box[5:]
+                out.append(DetectedObject(
+                    ex, float(cx), float(cy), float(w), float(h),
+                    int(np.argmax(probs)), float(conf[y, x_, bi]), probs.copy(),
+                ))
+        return out

@@ -1,0 +1,392 @@
+"""Variational autoencoder pretrain layer.
+
+Reference: ``nn/conf/layers/variational/VariationalAutoencoder.java``
+(config: encoderLayerSizes/decoderLayerSizes, pzxActivationFunction,
+reconstruction distribution, numSamples) and the runtime
+``nn/layers/variational/VariationalAutoencoder.java`` (1,171 LoC:
+ELBO pretraining with the reparameterization trick, supervised forward =
+mean of q(z|x), ``reconstructionProbability``/``reconstructionLogProbability``,
+``generateAtMeanGivenZ``/``generateRandomGivenZ``), plus the
+``ReconstructionDistribution`` hierarchy (Bernoulli/Gaussian/Exponential/
+Composite/LossFunctionWrapper).
+
+TPU-native: the whole ELBO (encoder MLP → μ,logσ² → K reparameterized
+samples → decoder MLP → log p(x|z) + KL) is one fused jit region; samples
+are drawn with ``jax.random`` keys threaded from the train step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import activations as _act
+from deeplearning4j_tpu import losses as _losses
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+
+_LOG2PI = math.log(2.0 * math.pi)
+
+
+# --------------------------------------------------------------------------
+# Reconstruction distributions (reference variational/*Distribution.java)
+# --------------------------------------------------------------------------
+class ReconstructionDistribution:
+    """p(x|z) family. ``params_per_feature`` distribution params per input
+    feature are produced by the decoder's output head."""
+
+    params_per_feature = 1
+
+    def log_probability(self, x, dist_params):
+        """Per-example log p(x|z); dist_params (b, nIn*params_per_feature)."""
+        raise NotImplementedError
+
+    def mean(self, dist_params):
+        raise NotImplementedError
+
+    def sample(self, rng, dist_params):
+        raise NotImplementedError
+
+    def to_dict(self):
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return serde.generic_from_dict(serde.lookup(d.get("@class", cls.__name__)), d)
+
+
+@serde.register
+class BernoulliReconstructionDistribution(ReconstructionDistribution):
+    """(reference ``BernoulliReconstructionDistribution.java``); decoder
+    emits logits, activation applied internally (sigmoid)."""
+
+    params_per_feature = 1
+
+    def __init__(self, activation: str = "sigmoid"):
+        self.activation = activation
+
+    def log_probability(self, x, dist_params):
+        logits = dist_params
+        if self.activation == "sigmoid":
+            # stable bernoulli log-lik from logits
+            ll = -jnp.maximum(logits, 0) + logits * x - jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        else:
+            p = jnp.clip(_act.get(self.activation)(logits), 1e-7, 1 - 1e-7)
+            ll = x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+        return jnp.sum(ll, axis=-1)
+
+    def mean(self, dist_params):
+        return _act.get(self.activation)(dist_params)
+
+    def sample(self, rng, dist_params):
+        p = self.mean(dist_params)
+        return jax.random.bernoulli(rng, p).astype(p.dtype)
+
+
+@serde.register
+class GaussianReconstructionDistribution(ReconstructionDistribution):
+    """(reference ``GaussianReconstructionDistribution.java``): decoder
+    emits [mean | log-variance] pairs (2 params/feature)."""
+
+    params_per_feature = 2
+
+    def __init__(self, activation: str = "identity"):
+        self.activation = activation
+
+    def _split(self, dist_params):
+        n = dist_params.shape[-1] // 2
+        mean = _act.get(self.activation)(dist_params[..., :n])
+        log_var = dist_params[..., n:]
+        return mean, log_var
+
+    def log_probability(self, x, dist_params):
+        mean, log_var = self._split(dist_params)
+        var = jnp.exp(log_var)
+        ll = -0.5 * (_LOG2PI + log_var + (x - mean) ** 2 / var)
+        return jnp.sum(ll, axis=-1)
+
+    def mean(self, dist_params):
+        return self._split(dist_params)[0]
+
+    def sample(self, rng, dist_params):
+        mean, log_var = self._split(dist_params)
+        return mean + jnp.exp(0.5 * log_var) * jax.random.normal(rng, mean.shape, mean.dtype)
+
+
+@serde.register
+class ExponentialReconstructionDistribution(ReconstructionDistribution):
+    """(reference ``ExponentialReconstructionDistribution.java``): decoder
+    emits gamma = log(lambda)."""
+
+    params_per_feature = 1
+
+    def __init__(self, activation: str = "identity"):
+        self.activation = activation
+
+    def log_probability(self, x, dist_params):
+        gamma = _act.get(self.activation)(dist_params)
+        lam = jnp.exp(gamma)
+        ll = gamma - lam * x
+        return jnp.sum(ll, axis=-1)
+
+    def mean(self, dist_params):
+        gamma = _act.get(self.activation)(dist_params)
+        return jnp.exp(-gamma)
+
+    def sample(self, rng, dist_params):
+        lam = 1.0 / self.mean(dist_params)
+        u = jax.random.uniform(rng, dist_params.shape, dist_params.dtype, 1e-7, 1.0)
+        return -jnp.log(u) / lam
+
+
+@serde.register
+class LossFunctionWrapper(ReconstructionDistribution):
+    """Use a standard loss as -log p(x|z) (reference
+    ``LossFunctionWrapper.java``); not a true probability."""
+
+    params_per_feature = 1
+
+    def __init__(self, loss: str = "mse", activation: str = "identity"):
+        self.loss = loss
+        self.activation = activation
+
+    def log_probability(self, x, dist_params):
+        return -_losses.get(self.loss)(x, dist_params, self.activation)
+
+    def mean(self, dist_params):
+        return _act.get(self.activation)(dist_params)
+
+    def sample(self, rng, dist_params):
+        return self.mean(dist_params)
+
+
+@serde.register
+class CompositeReconstructionDistribution(ReconstructionDistribution):
+    """Different distributions over feature ranges (reference
+    ``CompositeReconstructionDistribution.java``). ``parts`` is a list of
+    (n_features, distribution)."""
+
+    def __init__(self, parts: Optional[List] = None):
+        self.parts = list(parts or [])
+
+    def add(self, n_features: int, dist: ReconstructionDistribution):
+        self.parts.append((int(n_features), dist))
+        return self
+
+    @property
+    def params_per_feature(self):
+        raise AttributeError("Composite: use total_params(n_in)")
+
+    def total_params(self) -> int:
+        return sum(n * d.params_per_feature for n, d in self.parts)
+
+    def _iter_slices(self):
+        x_off, p_off = 0, 0
+        for n, d in self.parts:
+            np_ = n * d.params_per_feature
+            yield (x_off, n, p_off, np_, d)
+            x_off += n
+            p_off += np_
+
+    def log_probability(self, x, dist_params):
+        total = 0.0
+        for x_off, n, p_off, np_, d in self._iter_slices():
+            total = total + d.log_probability(
+                x[..., x_off:x_off + n], dist_params[..., p_off:p_off + np_]
+            )
+        return total
+
+    def mean(self, dist_params):
+        outs = [
+            d.mean(dist_params[..., p_off:p_off + np_])
+            for _, _, p_off, np_, d in self._iter_slices()
+        ]
+        return jnp.concatenate(outs, axis=-1)
+
+    def sample(self, rng, dist_params):
+        keys = jax.random.split(rng, max(len(self.parts), 1))
+        outs = [
+            d.sample(keys[i], dist_params[..., p_off:p_off + np_])
+            for i, (_, _, p_off, np_, d) in enumerate(self._iter_slices())
+        ]
+        return jnp.concatenate(outs, axis=-1)
+
+    def to_dict(self):
+        return {
+            "@class": "CompositeReconstructionDistribution",
+            "parts": [[n, serde.encode(d)] for n, d in self.parts],
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls([(n, serde.decode(e)) for n, e in d.get("parts", [])])
+
+
+# --------------------------------------------------------------------------
+# The VAE layer
+# --------------------------------------------------------------------------
+@serde.register
+class VariationalAutoencoder(FeedForwardLayer):
+    """(reference ``variational/VariationalAutoencoder.java``).
+
+    - encoder MLP (``encoder_layer_sizes``, shared ``activation``)
+    - heads: z mean + z log-variance (``pzx_activation`` on the mean,
+      reference pzxActivationFunction)
+    - decoder MLP (``decoder_layer_sizes``) → distribution params of
+      ``reconstruction_distribution``
+    - supervised forward = mean of q(z|x) (encoder side only)
+    - ``pretrain_loss`` = -ELBO averaged over ``num_samples``
+      reparameterized draws
+    """
+
+    is_pretrain_layer = True
+
+    def __init__(
+        self,
+        encoder_layer_sizes: Sequence[int] = (100,),
+        decoder_layer_sizes: Sequence[int] = (100,),
+        reconstruction_distribution: Optional[ReconstructionDistribution] = None,
+        pzx_activation: str = "identity",
+        num_samples: int = 1,
+        **kwargs,
+    ):
+        super().__init__(**kwargs)
+        self.encoder_layer_sizes = [int(s) for s in encoder_layer_sizes]
+        self.decoder_layer_sizes = [int(s) for s in decoder_layer_sizes]
+        self.reconstruction_distribution = (
+            reconstruction_distribution
+            if reconstruction_distribution is not None
+            else GaussianReconstructionDistribution("identity")
+        )
+        self.pzx_activation = pzx_activation
+        self.num_samples = int(num_samples)
+
+    # n_out == latent size (reference nOut semantics)
+    def _dist_param_count(self) -> int:
+        d = self.reconstruction_distribution
+        if isinstance(d, CompositeReconstructionDistribution):
+            return d.total_params()
+        return self.n_in * d.params_per_feature
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        sizes_e = [self.n_in] + self.encoder_layer_sizes
+        sizes_d = [self.n_out] + self.decoder_layer_sizes
+        params = {}
+        n_keys = len(sizes_e) + len(sizes_d) + 2
+        keys = jax.random.split(rng, n_keys)
+        k = 0
+        for i in range(len(self.encoder_layer_sizes)):
+            fi, fo = sizes_e[i], sizes_e[i + 1]
+            params[f"eW{i}"] = self._draw_weight(keys[k], (fi, fo), fi, fo, dtype)
+            params[f"eb{i}"] = self._bias((fo,), dtype)
+            k += 1
+        h = sizes_e[-1]
+        params["pZXMeanW"] = self._draw_weight(keys[k], (h, self.n_out), h, self.n_out, dtype)
+        params["pZXMeanb"] = self._bias((self.n_out,), dtype)
+        k += 1
+        params["pZXLogStd2W"] = self._draw_weight(keys[k], (h, self.n_out), h, self.n_out, dtype)
+        params["pZXLogStd2b"] = self._bias((self.n_out,), dtype)
+        k += 1
+        for i in range(len(self.decoder_layer_sizes)):
+            fi, fo = sizes_d[i], sizes_d[i + 1]
+            params[f"dW{i}"] = self._draw_weight(keys[k], (fi, fo), fi, fo, dtype)
+            params[f"db{i}"] = self._bias((fo,), dtype)
+            k += 1
+        hd = sizes_d[-1]
+        n_dist = self._dist_param_count()
+        params["pXZW"] = self._draw_weight(keys[k], (hd, n_dist), hd, n_dist, dtype)
+        params["pXZb"] = self._bias((n_dist,), dtype)
+        return params
+
+    # ----------------------------------------------------------- internals
+    def _encode_hidden(self, params, x):
+        act = self.act_fn()
+        h = x
+        for i in range(len(self.encoder_layer_sizes)):
+            h = act(h @ params[f"eW{i}"] + params[f"eb{i}"])
+        return h
+
+    def encode_mean_logvar(self, params, x) -> Tuple[jax.Array, jax.Array]:
+        h = self._encode_hidden(params, x)
+        mean = _act.get(self.pzx_activation)(h @ params["pZXMeanW"] + params["pZXMeanb"])
+        log_var = h @ params["pZXLogStd2W"] + params["pZXLogStd2b"]
+        return mean, log_var
+
+    def decode(self, params, z) -> jax.Array:
+        """z → distribution params of p(x|z)."""
+        act = self.act_fn()
+        h = z
+        for i in range(len(self.decoder_layer_sizes)):
+            h = act(h @ params[f"dW{i}"] + params[f"db{i}"])
+        return h @ params["pXZW"] + params["pXZb"]
+
+    # ------------------------------------------------------------- network
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        """Supervised forward: mean of q(z|x) (reference ``activate``)."""
+        mean, _ = self.encode_mean_logvar(params, x)
+        return mean, state or {}
+
+    def pretrain_loss(self, params, x, rng=None):
+        """-ELBO = KL(q(z|x)||N(0,I)) - E_q[log p(x|z)] (reference
+        ``computeGradientAndScore`` pretrain path)."""
+        mean, log_var = self.encode_mean_logvar(params, x)
+        kl = 0.5 * jnp.sum(jnp.exp(log_var) + mean**2 - 1.0 - log_var, axis=-1)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        keys = jax.random.split(rng, self.num_samples)
+        recon_ll = 0.0
+        for s in range(self.num_samples):
+            eps = jax.random.normal(keys[s], mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            dist_params = self.decode(params, z)
+            recon_ll = recon_ll + self.reconstruction_distribution.log_probability(x, dist_params)
+        recon_ll = recon_ll / self.num_samples
+        return jnp.mean(kl - recon_ll)
+
+    # --------------------------------------------------- user-facing extras
+    def reconstruct(self, params, x):
+        """x → mean reconstruction (deterministic: z = E[q(z|x)])."""
+        x = jnp.asarray(x)
+        mean, _ = self.encode_mean_logvar(params, x)
+        return self.reconstruction_distribution.mean(self.decode(params, mean))
+
+    def reconstruction_log_probability(self, params, x, num_samples: int = 1,
+                                       rng=None):
+        """Per-example importance-sampled log p(x) estimate (reference
+        ``reconstructionLogProbability``)."""
+        x = jnp.asarray(x)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        mean, log_var = self.encode_mean_logvar(params, x)
+        keys = jax.random.split(rng, num_samples)
+        lls = []
+        for s in range(num_samples):
+            eps = jax.random.normal(keys[s], mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * log_var) * eps
+            dist_params = self.decode(params, z)
+            log_pxz = self.reconstruction_distribution.log_probability(x, dist_params)
+            log_pz = -0.5 * jnp.sum(z**2 + _LOG2PI, axis=-1)
+            log_qzx = -0.5 * jnp.sum(
+                eps**2 + _LOG2PI + log_var, axis=-1
+            )
+            lls.append(log_pxz + log_pz - log_qzx)
+        stacked = jnp.stack(lls)  # (S, b)
+        return jax.scipy.special.logsumexp(stacked, axis=0) - math.log(num_samples)
+
+    def generate_at_mean_given_z(self, params, z):
+        """(reference ``generateAtMeanGivenZ``)."""
+        return self.reconstruction_distribution.mean(self.decode(params, jnp.asarray(z)))
+
+    def generate_random_given_z(self, params, z, rng=None):
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self.reconstruction_distribution.sample(rng, self.decode(params, jnp.asarray(z)))
+
+    def has_loss_function(self) -> bool:
+        return isinstance(self.reconstruction_distribution, LossFunctionWrapper)

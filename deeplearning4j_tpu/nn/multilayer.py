@@ -385,6 +385,80 @@ class MultiLayerNetwork:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
+    # --------------------------------------------------------------- pretrain
+    def pretrain(self, it: DataSetIterator, epochs: int = 1) -> "MultiLayerNetwork":
+        """Greedy layer-wise unsupervised pretraining of all pretrain-capable
+        layers (reference ``MultiLayerNetwork.pretrain(DataSetIterator)``)."""
+        for i, layer in enumerate(self.layers):
+            if layer.is_pretrain_layer:
+                self.pretrain_layer(i, it, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, layer_idx: int, it: DataSetIterator,
+                       epochs: int = 1) -> "MultiLayerNetwork":
+        """Unsupervised pretraining of one layer (reference
+        ``pretrainLayer``): features flow through layers [0, layer_idx) in
+        inference mode, then the layer's ``pretrain_loss`` (-ELBO /
+        reconstruction error) is minimized over its params only — one jitted
+        step per layer."""
+        layer = self.layers[layer_idx]
+        if not layer.is_pretrain_layer:
+            raise ValueError(f"Layer {layer_idx} ({layer}) is not pretrainable")
+
+        def step(layer_params, opt_i, all_params, state, features, rng, iteration, epoch):
+            k_fwd, k_loss = jax.random.split(rng)
+            x, _, _, _, _ = self._forward(
+                dict_to_list_params(all_params, layer_params, layer_idx),
+                state, features, train=False, rng=None, stop_before=layer_idx,
+            )
+
+            def loss_fn(p):
+                return layer.pretrain_loss(p, x, k_loss)
+
+            loss, grads = jax.value_and_grad(loss_fn)(layer_params)
+            g = normalize_layer_gradients(
+                grads, layer.gradient_normalization,
+                layer.gradient_normalization_threshold,
+            )
+            reg = layer.regularization
+            if reg is not None:
+                g = {
+                    k: (gv if (t := reg.grad_term(k, layer_params[k])) is None else gv + t)
+                    for k, gv in g.items()
+                }
+            upd = layer.updater if layer.updater is not None else NoOp()
+            new_p, new_o = {}, {}
+            for name, gv in g.items():
+                delta, slot = upd.apply(gv, opt_i[name], iteration + 1, iteration, epoch)
+                new_p[name] = layer_params[name] - delta
+                new_o[name] = slot
+            return new_p, new_o, loss
+
+        def dict_to_list_params(all_params, layer_params, idx):
+            return [layer_params if j == idx else all_params[j]
+                    for j in range(len(all_params))]
+
+        jit_step = self._get_jit(f"pretrain{layer_idx}", lambda: jax.jit(step))
+        for _ in range(epochs):
+            for ds in it:
+                new_p, new_o, loss = jit_step(
+                    self.params_[layer_idx], self.opt_state_[layer_idx],
+                    self.params_, self.state_, jnp.asarray(ds.features),
+                    self._next_rng(),
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                self.params_ = [
+                    new_p if j == layer_idx else p for j, p in enumerate(self.params_)
+                ]
+                self.opt_state_ = [
+                    new_o if j == layer_idx else o for j, o in enumerate(self.opt_state_)
+                ]
+                self.score_ = loss
+                self.iteration += 1
+            it.reset()
+        return self
+
     # -------------------------------------------------------------- inference
     def _make_output_fn(self):
         def run(params, state, x, fmask):

@@ -364,12 +364,22 @@ class TransferLearning:
             if conf.input_types:
                 gb.set_input_types(*conf.input_types)
 
-            # layers needing fresh params: nOut-replaced + their consumers
+            # layers needing fresh params: nOut-replaced + consumers, where
+            # "consumer" propagates through non-layer vertices (Merge etc.)
+            # until it reaches a LayerVertex whose input width changed
             reinit = set(self._nout_replacements)
-            for name in self._nout_replacements:
+            shape_changed = set(self._nout_replacements)
+            frontier = list(self._nout_replacements)
+            while frontier:
+                src_name = frontier.pop()
                 for other, ins in conf.vertex_inputs.items():
-                    if name in ins and isinstance(conf.vertices.get(other), LayerVertex):
+                    if src_name not in ins or other in shape_changed:
+                        continue
+                    if isinstance(conf.vertices.get(other), LayerVertex):
                         reinit.add(other)
+                    else:
+                        shape_changed.add(other)
+                        frontier.append(other)
 
             name_order = list(conf.topological_order)
             for name in name_order:
@@ -459,7 +469,10 @@ class TransferLearningHelper:
             l = src.layers[i]
             lb.layer(l.layer.clone() if isinstance(l, FrozenLayer) else l.clone())
         for i, p in src.conf.preprocessors.items():
-            if i >= start:
+            # i == start excluded: featurize() output is already
+            # post-preprocessor (the forward applies layer `start`'s
+            # preprocessor before stopping)
+            if i > start:
                 lb.input_pre_processor(i - start, copy.deepcopy(p))
         lb.set_input_type(types[start])
         conf = lb.build()

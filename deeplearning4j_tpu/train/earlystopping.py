@@ -84,9 +84,22 @@ class RegressionScoreCalculator(ScoreCalculator):
         self.metric = metric.lower()
         self.iterator = iterator
 
+    _METRIC_METHODS = {
+        "mse": "average_mean_squared_error",
+        "mae": "average_mean_absolute_error",
+        "mean_squared_error": "average_mean_squared_error",
+        "mean_absolute_error": "average_mean_absolute_error",
+    }
+
     def calculate_score(self, model) -> float:
         ev = model.evaluate_regression(self.iterator)
-        return float(getattr(ev, f"average_{self.metric}")())
+        method = self._METRIC_METHODS.get(self.metric)
+        if method is None:
+            raise ValueError(
+                f"Unknown regression metric '{self.metric}'; "
+                f"one of {sorted(self._METRIC_METHODS)}"
+            )
+        return float(getattr(ev, method)())
 
 
 class ROCScoreCalculator(ScoreCalculator):
@@ -108,12 +121,14 @@ class ROCScoreCalculator(ScoreCalculator):
                 out = out[0]
             roc.eval(ds.labels, out)
         self.iterator.reset()
-        return float(roc.auc() if self.metric == "auc" else roc.auprc())
+        return float(
+            roc.calculate_auc() if self.metric == "auc" else roc.calculate_auprc()
+        )
 
 
 class AutoencoderScoreCalculator(ScoreCalculator):
-    """Reconstruction error of a pretrain autoencoder layer (reference
-    ``AutoencoderScoreCalculator.java``)."""
+    """Reconstruction error of a pretrain layer — AutoEncoder or VAE, both
+    expose ``reconstruct`` (reference ``AutoencoderScoreCalculator.java``)."""
 
     minimize_score = True
 
@@ -140,34 +155,10 @@ class AutoencoderScoreCalculator(ScoreCalculator):
         return total / max(count, 1)
 
 
-class VAEReconErrorScoreCalculator(ScoreCalculator):
-    """VAE reconstruction error (reference
-    ``VAEReconErrorScoreCalculator.java``)."""
-
-    minimize_score = True
-
-    def __init__(self, metric: str, iterator, layer_index: int = 0):
-        self.metric = metric.lower()
-        self.iterator = iterator
-        self.layer_index = layer_index
-
-    def calculate_score(self, model) -> float:
-        total, count = 0.0, 0
-        layer = model.layers[self.layer_index]
-        for ds in self.iterator:
-            x = np.asarray(ds.features)
-            recon = np.asarray(
-                layer.reconstruct(model.params_[self.layer_index], x)
-            )
-            err = (
-                ((recon - x) ** 2).sum()
-                if self.metric == "mse"
-                else np.abs(recon - x).sum()
-            )
-            total += float(err)
-            count += x.shape[0]
-        self.iterator.reset()
-        return total / max(count, 1)
+class VAEReconErrorScoreCalculator(AutoencoderScoreCalculator):
+    """Alias with reference-parity name (reference
+    ``VAEReconErrorScoreCalculator.java``); same reconstruct-and-accumulate
+    loop as AutoencoderScoreCalculator."""
 
 
 class VAEReconProbScoreCalculator(ScoreCalculator):
@@ -532,6 +523,7 @@ class EarlyStoppingTrainer:
         iter_listener = _IterationConditionListener(cfg.iteration_termination_conditions)
         saved_listeners = list(self.model.listeners)
         self.model.add_listeners(iter_listener)
+        last_score = float("nan")
         try:
             while True:
                 try:
@@ -539,6 +531,9 @@ class EarlyStoppingTrainer:
                 except _IterationTerminated as t:
                     reason = "IterationTerminationCondition"
                     details = str(t.condition)
+                    # mid-epoch abort skips _fit_one_epoch's reset; leave the
+                    # iterator clean for reuse
+                    self.train_iterator.reset()
                     break
 
                 terminate = False
@@ -546,6 +541,7 @@ class EarlyStoppingTrainer:
                 details = ""
                 if epoch % cfg.evaluate_every_n_epochs == 0:
                     score = sc.calculate_score(self.model)
+                    last_score = score
                     score_vs_epoch[epoch] = score
                     improved = score < best_score if minimize else score > best_score
                     if improved:
@@ -556,12 +552,14 @@ class EarlyStoppingTrainer:
                         cfg.model_saver.save_latest_model(self.model, score)
                     if self.listener is not None and hasattr(self.listener, "on_epoch"):
                         self.listener.on_epoch(epoch, score, cfg, self.model)
-                    for c in cfg.epoch_termination_conditions:
-                        if c.terminate(epoch, score, minimize):
-                            terminate = True
-                            reason = "EpochTerminationCondition"
-                            details = str(c)
-                            break
+                # conditions run every epoch (with the latest score), so
+                # e.g. MaxEpochs cannot overshoot when evaluate_every_n > 1
+                for c in cfg.epoch_termination_conditions:
+                    if c.terminate(epoch, last_score, minimize):
+                        terminate = True
+                        reason = "EpochTerminationCondition"
+                        details = str(c)
+                        break
                 epoch += 1
                 if terminate:
                     break

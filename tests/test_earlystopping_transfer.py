@@ -275,3 +275,73 @@ class TestMemoryReport:
         assert inf < b32  # no grads/updater state at inference
         s = rep.to_string(32)
         assert "total params" in s
+
+
+class TestScoreCalculators:
+    """Regression tests for calculator/metric API wiring."""
+
+    def test_roc_classification_regression_autoencoder_calculators(self):
+        import jax
+
+        from deeplearning4j_tpu.nn.conf.layers import AutoEncoder
+        from deeplearning4j_tpu.train.earlystopping import (
+            AutoencoderScoreCalculator,
+            ClassificationScoreCalculator,
+            RegressionScoreCalculator,
+            ROCScoreCalculator,
+            VAEReconErrorScoreCalculator,
+        )
+
+        ds = _toy_data(n_out=2, seed=0)
+        it = ListDataSetIterator(ds, 32)
+        net = _net(n_out=2)
+        net.fit(ds, epochs=1)
+        assert 0.0 <= ClassificationScoreCalculator("accuracy", it).calculate_score(net) <= 1.0
+        assert 0.0 <= ROCScoreCalculator(it, "auc").calculate_score(net) <= 1.0
+        assert 0.0 <= ROCScoreCalculator(it, "auprc").calculate_score(net) <= 1.0
+
+        # regression net
+        rconf = (
+            NeuralNetConfiguration.builder().seed(1)
+            .list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork as MLN
+
+        rnet = MLN(rconf).init()
+        ds3 = _toy_data(seed=1)
+        rit = ListDataSetIterator(ds3, 32)
+        for m in ("mse", "mae"):
+            v = RegressionScoreCalculator(m, rit).calculate_score(rnet)
+            assert np.isfinite(v)
+
+        # autoencoder reconstruct path
+        aconf = (
+            NeuralNetConfiguration.builder().seed(1)
+            .list()
+            .layer(AutoEncoder(n_out=3, activation="sigmoid"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        anet = MLN(aconf).init()
+        for calc_cls in (AutoencoderScoreCalculator, VAEReconErrorScoreCalculator):
+            v = calc_cls("mse", ListDataSetIterator(ds, 32)).calculate_score(anet)
+            assert np.isfinite(v)
+
+    def test_max_epochs_exact_with_sparse_evaluation(self):
+        """MaxEpochs must not overshoot when evaluate_every_n_epochs > 1."""
+        ds = _toy_data()
+        net = _net()
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(ds, 64)))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+            .evaluate_every_n_epochs(2)
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(ds, 16)).fit()
+        assert result.total_epochs == 4
