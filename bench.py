@@ -2,17 +2,16 @@
 """Benchmark harness — prints ONE JSON line:
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Methodology mirrors the reference's benchmark machinery
+Flagship metric (BASELINE.md north star): ResNet-50 train throughput,
+images/sec/chip. Methodology mirrors the reference's benchmark machinery
 (``BenchmarkDataSetIterator`` replayed synthetic batch +
-``PerformanceListener`` samples/sec; SURVEY.md §6): train-step throughput
-on a replayed batch, compile excluded by warmup, steady-state timed.
+``PerformanceListener`` samples/sec; SURVEY.md §6): one synthetic batch
+replayed, compile excluded by warmup, steady-state timed. The full train
+step (fwd + bwd + SGD update) is one jitted XLA program with donated
+buffers.
 
 The reference publishes no numbers (BASELINE.json "published": {}), so
-vs_baseline is reported against the first recorded value of this metric in
-BASELINE.md's table when present, else 1.0.
-
-Flagship model: LeNet-class CNN train step (images/sec/chip) until the
-ResNet-50 graph model lands; then this switches to ResNet-50 (north star).
+vs_baseline is 1.0 (self-referential first recording).
 """
 
 import json
@@ -26,45 +25,46 @@ import numpy as np
 
 def main():
     import jax
-
-    from deeplearning4j_tpu.data.iterators import BenchmarkDataSetIterator
-    from deeplearning4j_tpu.models.lenet import LeNet
-
-    batch = 256
-    model = LeNet(num_classes=10).init()
-    it = BenchmarkDataSetIterator.from_shapes(
-        (batch, 28, 28, 1), (batch, 10), total_batches=1, seed=0
-    )
-    ds = it.next()
-
-    step = model._get_jit("train", model._make_train_step)
     import jax.numpy as jnp
 
+    from deeplearning4j_tpu.models.resnet50 import ResNet50
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    model = ResNet50(num_classes=1000).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)])
+
+    step = model._get_jit("train", model._make_train_step)
+
     def run_one():
-        model.params_, model.opt_state_, model.state_, model.score_ = step(
+        (model.params_, model.opt_state_, model.state_, model.score_) = step(
             model.params_, model.opt_state_, model.state_,
-            jnp.asarray(ds.features), jnp.asarray(ds.labels), None, None,
+            (x,), (y,), (None,), (None,),
             model._next_rng(), jnp.asarray(model.iteration, jnp.int32),
             jnp.asarray(model.epoch, jnp.int32),
         )
         model.iteration += 1
 
-    # warmup / compile
+    # warmup (compile + settle); sync via the score scalar — under the
+    # axon tunnel block_until_ready on device-resident outputs can return
+    # before the dispatch queue drains, a host round-trip cannot
     for _ in range(3):
         run_one()
-    jax.block_until_ready(model.params_)
+    float(model.score_)
 
-    iters = 50
+    iters = 20
     t0 = time.perf_counter()
     for _ in range(iters):
         run_one()
-    jax.block_until_ready(model.params_)
+    float(model.score_)
     dt = time.perf_counter() - t0
-    imgs_per_sec = batch * iters / dt
 
+    images_per_sec = batch * iters / dt
     print(json.dumps({
-        "metric": "lenet_train_images_per_sec_per_chip",
-        "value": round(imgs_per_sec, 1),
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": 1.0,
     }))

@@ -1,0 +1,47 @@
+"""ModelSelector + PretrainedType (reference ``zoo/ModelSelector.java``,
+``zoo/PretrainedType.java``): name-based zoo lookup."""
+
+from __future__ import annotations
+
+from typing import Dict, Type
+
+from deeplearning4j_tpu.models.alexnet import AlexNet
+from deeplearning4j_tpu.models.darknet import TinyYOLO, YOLO2, Darknet19
+from deeplearning4j_tpu.models.facenet import FaceNetNN4Small2, InceptionResNetV1
+from deeplearning4j_tpu.models.googlenet import GoogLeNet
+from deeplearning4j_tpu.models.lenet import LeNet
+from deeplearning4j_tpu.models.resnet50 import ResNet50
+from deeplearning4j_tpu.models.simplecnn import SimpleCNN
+from deeplearning4j_tpu.models.textgen_lstm import TextGenerationLSTM
+from deeplearning4j_tpu.models.vgg import VGG16, VGG19
+from deeplearning4j_tpu.models.zoo import ZooModel
+
+
+class PretrainedType:
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+ZOO: Dict[str, Type[ZooModel]] = {
+    m.name: m
+    for m in (
+        AlexNet, Darknet19, FaceNetNN4Small2, GoogLeNet, InceptionResNetV1,
+        LeNet, ResNet50, SimpleCNN, TextGenerationLSTM, TinyYOLO, VGG16,
+        VGG19, YOLO2,
+    )
+}
+
+
+class ModelSelector:
+    @staticmethod
+    def select(name: str, **kwargs) -> ZooModel:
+        key = name.lower()
+        if key not in ZOO:
+            raise KeyError(f"Unknown zoo model '{name}'; available: {sorted(ZOO)}")
+        return ZOO[key](**kwargs)
+
+    @staticmethod
+    def available() -> list:
+        return sorted(ZOO)

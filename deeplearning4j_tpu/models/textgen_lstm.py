@@ -1,0 +1,37 @@
+"""TextGenerationLSTM (reference ``zoo/model/TextGenerationLSTM.java``:
+char-level language model — two stacked (Graves)LSTM layers + per-timestep
+softmax output, trained with truncated BPTT)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.updaters import RmsProp
+
+
+class TextGenerationLSTM(ZooModel):
+    name = "textgenlstm"
+
+    def __init__(self, num_classes: int = 77, units: int = 256,
+                 max_length: int = 40, **kwargs):
+        # num_classes = vocabulary (character set) size
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.units = int(units)
+        self.max_length = int(max_length)
+
+    def conf(self):
+        return (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(self.kwargs.get("updater", RmsProp(1e-2)))
+            .weight_init("xavier")
+            .list()
+            .layer(GravesLSTM(n_out=self.units, activation="tanh"))
+            .layer(GravesLSTM(n_out=self.units, activation="tanh"))
+            .layer(RnnOutputLayer(n_out=self.num_classes, activation="softmax",
+                                  loss="mcxent"))
+            .backprop_type("tbptt", self.max_length, self.max_length)
+            .set_input_type(InputType.recurrent(self.num_classes))
+            .build()
+        )

@@ -1,0 +1,56 @@
+"""VGG16 / VGG19 (reference ``zoo/model/VGG16.java`` / ``VGG19.java``:
+3x3 conv blocks [64,128,256,512,512] + two 4096 dense + softmax)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.updaters import Nesterovs
+
+
+class _VGG(ZooModel):
+    block_convs = ()  # convs per block; channels fixed at (64,128,256,512,512)
+
+    def __init__(self, num_classes: int = 1000, height: int = 224,
+                 width: int = 224, channels: int = 3, **kwargs):
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.height, self.width, self.channels = height, width, channels
+
+    def conf(self):
+        b = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(self.kwargs.get("updater", Nesterovs(1e-2, 0.9)))
+            .weight_init("relu")
+            .list()
+        )
+        for n_out, reps in zip((64, 128, 256, 512, 512), self.block_convs):
+            for _ in range(reps):
+                b = b.layer(ConvolutionLayer(n_out=n_out, kernel_size=3,
+                                             convolution_mode="same",
+                                             activation="relu"))
+            b = b.layer(SubsamplingLayer(kernel_size=2, stride=2))
+        return (
+            b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+            .layer(OutputLayer(n_out=self.num_classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(self.height, self.width, self.channels))
+            .build()
+        )
+
+
+class VGG16(_VGG):
+    name = "vgg16"
+    block_convs = (2, 2, 3, 3, 3)
+
+
+class VGG19(_VGG):
+    name = "vgg19"
+    block_convs = (2, 2, 4, 4, 4)

@@ -25,6 +25,9 @@ from deeplearning4j_tpu.nn.conf.layers.base import Layer
 
 @serde.register
 class BatchNormalization(Layer):
+    activation = "identity"  # class-level default: configs saved before the
+    # fused-activation field existed deserialize without it
+
     def __init__(
         self,
         decay: float = 0.9,
@@ -32,6 +35,7 @@ class BatchNormalization(Layer):
         gamma: float = 1.0,
         beta: float = 0.0,
         lock_gamma_beta: bool = False,
+        activation: str = "identity",
         **kwargs,
     ):
         super().__init__(**kwargs)
@@ -40,6 +44,9 @@ class BatchNormalization(Layer):
         self.gamma = float(gamma)
         self.beta = float(beta)
         self.lock_gamma_beta = bool(lock_gamma_beta)
+        # fused post-BN activation (conv→BN→act is the dominant pattern;
+        # XLA fuses it into the conv epilogue)
+        self.activation = activation
         self.n_feat: Optional[int] = None
 
     def initialize(self, input_type: InputType) -> None:
@@ -87,6 +94,10 @@ class BatchNormalization(Layer):
             y = self.gamma * y + self.beta
         else:
             y = params["gamma"] * y + params["beta"]
+        if self.activation != "identity":
+            from deeplearning4j_tpu import activations as _act
+
+            y = _act.get(self.activation)(y)
         return y, new_state
 
 

@@ -406,14 +406,13 @@ class MultiLayerNetwork:
             raise ValueError(f"Layer {layer_idx} ({layer}) is not pretrainable")
 
         def step(layer_params, opt_i, all_params, state, features, rng, iteration, epoch):
-            k_fwd, k_loss = jax.random.split(rng)
             x, _, _, _, _ = self._forward(
                 dict_to_list_params(all_params, layer_params, layer_idx),
                 state, features, train=False, rng=None, stop_before=layer_idx,
             )
 
             def loss_fn(p):
-                return layer.pretrain_loss(p, x, k_loss)
+                return layer.pretrain_loss(p, x, rng)
 
             loss, grads = jax.value_and_grad(loss_fn)(layer_params)
             g = normalize_layer_gradients(

@@ -131,9 +131,14 @@ class FineTuneConfiguration:
                 else 1.0
             )
         if self.l1 is not None or self.l2 is not None or self.weight_decay is not None:
+            old = layer.regularization
             layer.regularization = RegularizationConf(
-                l1=self.l1 or 0.0, l2=self.l2 or 0.0,
-                weight_decay=self.weight_decay or 0.0,
+                l1=self.l1 if self.l1 is not None else getattr(old, "l1", 0.0),
+                l2=self.l2 if self.l2 is not None else getattr(old, "l2", 0.0),
+                weight_decay=(
+                    self.weight_decay if self.weight_decay is not None
+                    else getattr(old, "weight_decay", 0.0)
+                ),
             )
         if self.activation is not None and hasattr(layer, "activation"):
             layer.activation = self.activation
@@ -504,12 +509,7 @@ class TransferLearningHelper:
         )
 
     def fit_featurized(self, ds_or_iter, epochs: int = 1):
-        from deeplearning4j_tpu.data.dataset import DataSet
-
-        if isinstance(ds_or_iter, DataSet):
-            self._unfrozen.fit(ds_or_iter, epochs=epochs)
-        else:
-            self._unfrozen.fit(ds_or_iter, epochs=epochs)
+        self._unfrozen.fit(ds_or_iter, epochs=epochs)
         self._sync_back()
         return self
 

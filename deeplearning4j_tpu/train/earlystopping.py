@@ -195,6 +195,11 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
 # Termination conditions (reference termination/*)
 # --------------------------------------------------------------------------
 class EpochTerminationCondition:
+    # score-dependent conditions are only checked on epochs where the score
+    # calculator actually ran (reference BaseEarlyStoppingTrainer semantics);
+    # pure epoch-count conditions check every epoch
+    requires_score = True
+
     def initialize(self) -> None:
         pass
 
@@ -211,6 +216,8 @@ class IterationTerminationCondition:
 
 
 class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    requires_score = False
+
     def __init__(self, max_epochs: int):
         self.max_epochs = int(max_epochs)
 
@@ -472,7 +479,9 @@ class _IterationConditionListener:
         self.triggered: Optional[IterationTerminationCondition] = None
 
     def iteration_done(self, model, iteration, epoch):
-        if self.triggered is not None:
+        # float(score_) is a host sync per iteration — only pay it when
+        # there are conditions to check
+        if self.triggered is not None or not self.conditions:
             return
         score = float(model.score_) if model.score_ is not None else float("nan")
         for c in self.conditions:
@@ -520,9 +529,11 @@ class EarlyStoppingTrainer:
         best_epoch = -1
         epoch = 0
 
-        iter_listener = _IterationConditionListener(cfg.iteration_termination_conditions)
         saved_listeners = list(self.model.listeners)
-        self.model.add_listeners(iter_listener)
+        if cfg.iteration_termination_conditions:
+            self.model.add_listeners(
+                _IterationConditionListener(cfg.iteration_termination_conditions)
+            )
         last_score = float("nan")
         try:
             while True:
@@ -552,9 +563,14 @@ class EarlyStoppingTrainer:
                         cfg.model_saver.save_latest_model(self.model, score)
                     if self.listener is not None and hasattr(self.listener, "on_epoch"):
                         self.listener.on_epoch(epoch, score, cfg, self.model)
-                # conditions run every epoch (with the latest score), so
-                # e.g. MaxEpochs cannot overshoot when evaluate_every_n > 1
+                evaluated = epoch % cfg.evaluate_every_n_epochs == 0
+                # epoch-count conditions run every epoch (MaxEpochs cannot
+                # overshoot with sparse evaluation); score-dependent ones only
+                # when a fresh score exists — a stale score would count
+                # non-evaluation epochs as "no improvement"
                 for c in cfg.epoch_termination_conditions:
+                    if c.requires_score and not evaluated:
+                        continue
                     if c.terminate(epoch, last_score, minimize):
                         terminate = True
                         reason = "EpochTerminationCondition"

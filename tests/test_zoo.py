@@ -1,0 +1,152 @@
+"""Zoo instantiation smoke tests (reference
+``deeplearning4j-zoo/.../TestInstantiation.java``: build each architecture,
+fit one synthetic batch, check output shape).
+
+CPU-friendly sizes: reduced input resolution / class count where the
+architecture permits; full-size construction is covered by a conf() build
+check (shape inference walks the whole graph).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import (
+    ZOO,
+    AlexNet,
+    Darknet19,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ModelSelector,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    TinyYOLO,
+    VGG16,
+    VGG19,
+    YOLO2,
+)
+
+
+def _img(b, h, w, c, seed=0):
+    return np.random.default_rng(seed).standard_normal((b, h, w, c)).astype(np.float32)
+
+
+def _onehot(b, k, seed=0):
+    return np.eye(k, dtype=np.float32)[np.random.default_rng(seed).integers(0, k, b)]
+
+
+class TestZooRegistry:
+    def test_all_13_reference_architectures_present(self):
+        expected = {
+            "alexnet", "darknet19", "facenetnn4small2", "googlenet",
+            "inceptionresnetv1", "lenet", "resnet50", "simplecnn",
+            "textgenlstm", "tinyyolo", "vgg16", "vgg19", "yolo2",
+        }
+        assert set(ZOO) == expected
+
+    def test_selector(self):
+        m = ModelSelector.select("lenet", num_classes=10)
+        assert isinstance(m, LeNet)
+        with pytest.raises(KeyError):
+            ModelSelector.select("nope")
+
+    def test_full_size_confs_build(self):
+        """Shape inference must succeed at reference input sizes."""
+        for cls in (AlexNet, GoogLeNet, ResNet50, VGG16, VGG19, Darknet19):
+            cls(num_classes=1000).conf()
+        TinyYOLO(num_classes=20).conf()
+        YOLO2(num_classes=20).conf()
+        FaceNetNN4Small2(num_classes=100).conf()
+        InceptionResNetV1(num_classes=100).conf()
+
+
+class TestZooSmallInstantiation:
+    """Fit one tiny batch + check output shape (downscaled inputs)."""
+
+    def test_lenet(self):
+        net = LeNet(num_classes=10).init()
+        net.fit(DataSet(_img(4, 28, 28, 1), _onehot(4, 10)), epochs=1)
+        assert net.output(_img(2, 28, 28, 1)).shape == (2, 10)
+
+    def test_simplecnn(self):
+        net = SimpleCNN(num_classes=5, height=48, width=48).init()
+        net.fit(DataSet(_img(2, 48, 48, 3), _onehot(2, 5)), epochs=1)
+        assert net.output(_img(2, 48, 48, 3)).shape == (2, 5)
+
+    def test_alexnet_small(self):
+        net = AlexNet(num_classes=7, height=96, width=96).init()
+        net.fit(DataSet(_img(2, 96, 96, 3), _onehot(2, 7)), epochs=1)
+        assert net.output(_img(1, 96, 96, 3)).shape == (1, 7)
+
+    def test_vgg16_small(self):
+        net = VGG16(num_classes=4, height=64, width=64).init()
+        net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
+        assert net.output(_img(1, 64, 64, 3)).shape == (1, 4)
+
+    def test_resnet50_small(self):
+        net = ResNet50(num_classes=6, height=64, width=64).init()
+        net.fit(DataSet(_img(2, 64, 64, 3), _onehot(2, 6)), epochs=1)
+        out = net.output_single(_img(1, 64, 64, 3))
+        assert out.shape == (1, 6)
+        # 50 conv/dense layers in the residual graph (16 blocks x 3 + stem + fc)
+        n_convs = sum(
+            1 for n in net.layer_names if "conv" in n or n in ("output",)
+        )
+        assert n_convs >= 50
+
+    def test_googlenet_small(self):
+        net = GoogLeNet(num_classes=4, height=64, width=64).init()
+        net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
+        assert net.output_single(_img(1, 64, 64, 3)).shape == (1, 4)
+
+    def test_darknet19_small(self):
+        net = Darknet19(num_classes=4, height=64, width=64).init()
+        net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
+        assert net.output(_img(1, 64, 64, 3)).shape == (1, 4)
+
+    def test_tinyyolo_small(self):
+        net = TinyYOLO(num_classes=3, height=64, width=64).init()
+        # 64/32 = 2x2 grid, 5 priors, labels (b, 2, 2, 4+3)
+        lab = np.zeros((1, 2, 2, 7), np.float32)
+        lab[0, 0, 1, :4] = [1.2, 0.2, 1.8, 0.8]
+        lab[0, 0, 1, 4] = 1.0
+        net.fit(DataSet(_img(1, 64, 64, 3), lab), epochs=1)
+        out = net.output(_img(1, 64, 64, 3))
+        assert out.shape == (1, 2, 2, 5 * (5 + 3))
+
+    def test_yolo2_small(self):
+        net = YOLO2(num_classes=3, height=64, width=64).init()
+        lab = np.zeros((1, 2, 2, 7), np.float32)
+        lab[0, 0, 1, :4] = [1.2, 0.2, 1.8, 0.8]
+        lab[0, 0, 1, 4] = 1.0
+        net.fit(DataSet(_img(1, 64, 64, 3), lab), epochs=1)
+        out = net.output_single(_img(1, 64, 64, 3))
+        assert out.shape == (1, 2, 2, 5 * (5 + 3))
+
+    def test_facenet_small(self):
+        net = FaceNetNN4Small2(num_classes=5, height=64, width=64,
+                               embedding_size=32).init()
+        net.fit(DataSet(_img(2, 64, 64, 3), _onehot(2, 5)), epochs=1)
+        assert net.output_single(_img(1, 64, 64, 3)).shape == (1, 5)
+
+    def test_inception_resnet_v1_small(self):
+        net = InceptionResNetV1(num_classes=5, height=64, width=64,
+                                embedding_size=32).init()
+        net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 5)), epochs=1)
+        assert net.output_single(_img(1, 64, 64, 3)).shape == (1, 5)
+
+    def test_textgen_lstm(self):
+        V = 12
+        net = TextGenerationLSTM(num_classes=V, units=16, max_length=8).init()
+        rng = np.random.default_rng(0)
+        seq = np.eye(V, dtype=np.float32)[rng.integers(0, V, (2, 16))]
+        targets = np.eye(V, dtype=np.float32)[rng.integers(0, V, (2, 16))]
+        net.fit(DataSet(seq, targets), epochs=1)  # tbptt path (len 16 > 8)
+        out = net.output(seq)
+        assert out.shape == (2, 16, V)
+        # stateful stepping
+        step = net.rnn_time_step(seq[:, 0, :])
+        assert step.shape == (2, V)
