@@ -10,6 +10,7 @@ from deeplearning4j_tpu.models.resnet50 import ResNet50
 from deeplearning4j_tpu.models.selector import ZOO, ModelSelector, PretrainedType
 from deeplearning4j_tpu.models.simplecnn import SimpleCNN
 from deeplearning4j_tpu.models.textgen_lstm import TextGenerationLSTM
+from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 from deeplearning4j_tpu.models.vgg import VGG16, VGG19
 from deeplearning4j_tpu.models.zoo import ZooModel
 
@@ -18,4 +19,5 @@ __all__ = [
     "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TinyYOLO", "VGG16", "VGG19", "YOLO2",
+    "TransformerLM",
 ]

@@ -1,0 +1,220 @@
+"""TransformerLM: GPT-style causal language model — the flagship
+distributed-training model.
+
+No reference analog (the reference predates transformers; SURVEY.md §2.5);
+this is the mandated new long-context/distributed capability. The model is
+deliberately built on an explicit stacked-parameter pytree rather than the
+layer-list runtime:
+
+- blocks are IDENTICAL TransformerBlocks whose params are stacked along a
+  leading (n_layers,) axis → single-device forward is one ``lax.scan``
+  (compile time O(1) in depth), and the same stacked axis shards over the
+  mesh "pipe" axis for pipeline parallelism;
+- the time axis shards over "seq" (ring attention), batch over "data",
+  head/FFN dims over "model" (Megatron column→row split);
+- see parallel/transformer.py for the distributed step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.zoo import ZooModel
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    TransformerBlock,
+    _layer_norm,
+    dense_attention,
+)
+
+Array = jax.Array
+
+
+class TransformerLMConfig:
+    def __init__(self, vocab_size: int, d_model: int = 256, n_heads: int = 4,
+                 n_layers: int = 4, mlp_ratio: int = 4, max_length: int = 512,
+                 seed: int = 0):
+        if d_model % n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+        self.vocab_size = int(vocab_size)
+        self.d_model = int(d_model)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.mlp_ratio = int(mlp_ratio)
+        self.max_length = int(max_length)
+        self.seed = int(seed)
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**d)
+
+
+def init_params(cfg: TransformerLMConfig, rng: Optional[Array] = None,
+                dtype=jnp.float32) -> Dict[str, Array]:
+    """Stacked-parameter pytree: block params have leading (n_layers,)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(cfg.seed)
+    d, h = cfg.d_model, cfg.d_model * cfg.mlp_ratio
+    L, V = cfg.n_layers, cfg.vocab_size
+    ks = jax.random.split(rng, 9)
+
+    def w(key, shape, fan_in):
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+
+    return {
+        "embed": 0.02 * jax.random.normal(ks[0], (V, d), dtype),
+        "pos": 0.02 * jax.random.normal(ks[1], (cfg.max_length, d), dtype),
+        "blocks": {
+            "ln1_g": jnp.ones((L, d), dtype), "ln1_b": jnp.zeros((L, d), dtype),
+            "Wq": w(ks[2], (L, d, d), d), "Wk": w(ks[3], (L, d, d), d),
+            "Wv": w(ks[4], (L, d, d), d), "Wo": w(ks[5], (L, d, d), d),
+            "bo": jnp.zeros((L, d), dtype),
+            "ln2_g": jnp.ones((L, d), dtype), "ln2_b": jnp.zeros((L, d), dtype),
+            "W1": w(ks[6], (L, d, h), d), "b1": jnp.zeros((L, h), dtype),
+            "W2": w(ks[7], (L, h, d), h), "b2": jnp.zeros((L, d), dtype),
+        },
+        "lnf_g": jnp.ones((d,), dtype), "lnf_b": jnp.zeros((d,), dtype),
+        "head": w(ks[8], (d, V), d),
+    }
+
+
+def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
+                attn_fn=None) -> Array:
+    """One pre-LN block on (b, T, d); bp holds UNSTACKED (single-layer)
+    params. ``attn_fn`` defaults to dense attention (ring under SP)."""
+    b, T, d = x.shape
+    hn = cfg.n_heads
+    a_in = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+
+    def heads(W):
+        return (a_in @ W).reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
+    fn = attn_fn if attn_fn is not None else dense_attention
+    o = fn(q, k, v, causal=True, mask=None)
+    o = o.transpose(0, 2, 1, 3).reshape(b, T, d)
+    x = x + o @ bp["Wo"] + bp["bo"]
+    m_in = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+    h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
+    return x + h @ bp["W2"] + bp["b2"]
+
+
+def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
+            attn_fn=None, pos_offset: int = 0) -> Array:
+    """ids (b, T) int32 → logits (b, T, V). Single-device path: blocks via
+    lax.scan over the stacked layer axis."""
+    x = params["embed"][ids] + params["pos"][pos_offset:pos_offset + ids.shape[1]][None]
+
+    def body(x, bp):
+        return block_apply(cfg, bp, x, attn_fn=attn_fn), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+    return x @ params["head"]
+
+
+def lm_loss(cfg: TransformerLMConfig, params, ids, targets, attn_fn=None):
+    """Mean next-token cross-entropy. targets (b, T) int32 (-1 = ignore)."""
+    logits = forward(cfg, params, ids, attn_fn=attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = (targets >= 0).astype(logits.dtype)
+    tgt = jnp.maximum(targets, 0)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+class TransformerLM(ZooModel):
+    """Zoo wrapper with a simple single-device fit/generate surface; the
+    distributed path is parallel/transformer.py's DistributedLMTrainer."""
+
+    name = "transformerlm"
+
+    def __init__(self, vocab_size: int = 1000, d_model: int = 256,
+                 n_heads: int = 4, n_layers: int = 4, mlp_ratio: int = 4,
+                 max_length: int = 512, seed: int = 123, **kwargs):
+        super().__init__(num_classes=vocab_size, seed=seed, **kwargs)
+        self.cfg = TransformerLMConfig(
+            vocab_size, d_model, n_heads, n_layers, mlp_ratio, max_length,
+            seed=seed,
+        )
+        self.params_: Optional[Dict] = None
+        self.opt_state_: Optional[Dict] = None
+        self._jit_cache: Dict = {}
+        self.iteration = 0
+        self.score_ = None
+
+    def init(self):
+        self.params_ = init_params(self.cfg)
+        from deeplearning4j_tpu.updaters import Adam
+
+        self.updater = self.kwargs.get("updater", Adam(3e-4))
+        self.opt_state_ = jax.tree_util.tree_map(
+            lambda a: self.updater.init_state(a), self.params_
+        )
+        return self
+
+    def _make_step(self):
+        cfg, upd = self.cfg, self.updater
+
+        def step(params, opt_state, ids, targets, t):
+            loss, grads = jax.value_and_grad(
+                lambda p: lm_loss(cfg, p, ids, targets)
+            )(params)
+
+            flat_p, treedef = jax.tree_util.tree_flatten(params)
+            flat_g = treedef.flatten_up_to(grads)
+            flat_o = treedef.flatten_up_to(opt_state)
+            new_p, new_o = [], []
+            for p, g, o in zip(flat_p, flat_g, flat_o):
+                delta, o2 = upd.apply(g, o, t, t, 0)
+                new_p.append(p - delta)
+                new_o.append(o2)
+            return (jax.tree_util.tree_unflatten(treedef, new_p),
+                    jax.tree_util.tree_unflatten(treedef, new_o), loss)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
+        if "step" not in self._jit_cache:
+            self._jit_cache["step"] = self._make_step()
+        self.iteration += 1
+        self.params_, self.opt_state_, self.score_ = self._jit_cache["step"](
+            self.params_, self.opt_state_, jnp.asarray(ids, jnp.int32),
+            jnp.asarray(targets, jnp.int32),
+            jnp.asarray(self.iteration, jnp.int32),
+        )
+        return float(self.score_)
+
+    def logits(self, ids: np.ndarray) -> np.ndarray:
+        if "fwd" not in self._jit_cache:
+            self._jit_cache["fwd"] = jax.jit(
+                lambda p, i: forward(self.cfg, p, i)
+            )
+        return np.asarray(self._jit_cache["fwd"](self.params_,
+                                                 jnp.asarray(ids, jnp.int32)))
+
+    def generate(self, prompt_ids: np.ndarray, max_new: int = 20,
+                 temperature: float = 0.0, rng=None) -> np.ndarray:
+        """Greedy/temperature sampling continuation (host loop; each step
+        re-runs the jitted forward on the growing prefix)."""
+        ids = np.asarray(prompt_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        for _ in range(max_new):
+            logits = self.logits(ids)[:, -1]
+            if temperature <= 0:
+                nxt = logits.argmax(-1).astype(np.int32)
+            else:
+                rng, k = jax.random.split(rng)
+                nxt = np.asarray(
+                    jax.random.categorical(k, jnp.asarray(logits) / temperature)
+                ).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        return ids

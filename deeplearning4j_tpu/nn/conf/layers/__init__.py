@@ -50,6 +50,12 @@ from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     RnnOutputLayer,
     SimpleRnn,
 )
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    LayerNormalization,
+    PositionalEmbeddingLayer,
+    SelfAttentionLayer,
+    TransformerBlock,
+)
 from deeplearning4j_tpu.nn.conf.layers.objdetect import (
     CnnLossLayer,
     DetectedObject,
@@ -88,4 +94,6 @@ __all__ = [
     "GaussianReconstructionDistribution", "ExponentialReconstructionDistribution",
     "CompositeReconstructionDistribution", "LossFunctionWrapper",
     "Yolo2OutputLayer", "CnnLossLayer", "DetectedObject", "non_max_suppression",
+    "SelfAttentionLayer", "TransformerBlock", "LayerNormalization",
+    "PositionalEmbeddingLayer",
 ]

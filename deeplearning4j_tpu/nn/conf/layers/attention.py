@@ -1,0 +1,260 @@
+"""Attention / transformer layers.
+
+The reference predates transformers — it has NO attention layer at all
+(SURVEY.md §2.5 parallelism checklist: "SP/CP, ring attention ... ABSENT.
+The codebase predates transformers"). These are *new capabilities of the
+target stack* (SURVEY.md §5 long-context mandate), designed TPU-first:
+
+- dense attention computes as one fused (b, h, T, T) einsum chain on the
+  MXU, causal masking via a static triangular mask (no dynamic shapes);
+- the same layer transparently switches to ring attention
+  (parallel/ring_attention.py) when the time axis is sharded over the
+  mesh's "seq" axis — blockwise online-softmax with K/V rotating around
+  the ring via ppermute;
+- TP sharding rules for QKV/MLP projections live in
+  parallel/tensor_parallel.py (column/row parallel, the Megatron layout).
+
+Layers operate on recurrent-format activations (b, T, d) and compose with
+the existing catalog (EmbeddingSequenceLayer, RnnOutputLayer, ...).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer, Layer
+
+
+@serde.register
+class LayerNormalization(Layer):
+    """Per-feature layer norm (new capability; BatchNormalization is the
+    reference's only norm — LN is required by transformer blocks)."""
+
+    def __init__(self, eps: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.eps = float(eps)
+        self.n_feat: Optional[int] = None
+
+    def initialize(self, input_type):
+        self.n_feat = input_type.size if input_type.kind in ("feedforward", "recurrent") \
+            else input_type.channels
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_feat
+        return {
+            "gamma": jnp.ones((self.n_feat,), dtype),
+            "beta": jnp.zeros((self.n_feat,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.eps)
+        return y * params["gamma"] + params["beta"], state or {}
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+
+
+def dense_attention(q, k, v, *, causal: bool, mask=None):
+    """Reference dense softmax attention. q,k,v: (b, h, T, hd)."""
+    T = q.shape[2]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        scores = jnp.where(tri, scores, -1e30)
+    if mask is not None:  # (b, T) key padding mask
+        scores = jnp.where(mask[:, None, None, :] > 0, scores, -1e30)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@serde.register
+class SelfAttentionLayer(FeedForwardLayer):
+    """Multi-head self-attention over (b, T, d).
+
+    n_out = model width (defaults to n_in); ``n_heads`` must divide it.
+    ``causal`` applies an autoregressive mask. When the incoming activation
+    is sharded over the mesh "seq" axis (set by the distributed runner),
+    the runner substitutes the ring-attention kernel — the math is
+    identical (see tests).
+    """
+
+    is_recurrent = True  # preserves (b, T) masks
+
+    def __init__(self, n_heads: int = 4, causal: bool = False,
+                 attention_dropout: float = 0.0, **kwargs):
+        kwargs.setdefault("activation", "identity")
+        super().__init__(**kwargs)
+        self.n_heads = int(n_heads)
+        self.causal = bool(causal)
+        self.attention_dropout = float(attention_dropout)
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out {self.n_out} not divisible by n_heads {self.n_heads}")
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        kq, kk, kv, ko = jax.random.split(rng, 4)
+        d, m = self.n_in, self.n_out
+        return {
+            "Wq": self._draw_weight(kq, (d, m), d, m, dtype),
+            "Wk": self._draw_weight(kk, (d, m), d, m, dtype),
+            "Wv": self._draw_weight(kv, (d, m), d, m, dtype),
+            "Wo": self._draw_weight(ko, (m, m), m, m, dtype),
+            "bo": jnp.zeros((m,), dtype),
+        }
+
+    def _heads(self, x, W):
+        b, T, _ = x.shape
+        y = x @ W  # (b, T, m)
+        return y.reshape(b, T, self.n_heads, -1).transpose(0, 2, 1, 3)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        b, T, _ = x.shape
+        q = self._heads(x, params["Wq"])
+        k = self._heads(x, params["Wk"])
+        v = self._heads(x, params["Wv"])
+        o = dense_attention(q, k, v, causal=self.causal, mask=mask)
+        if train and self.attention_dropout > 0 and rng is not None:
+            keep = 1.0 - self.attention_dropout
+            o = jnp.where(jax.random.bernoulli(rng, keep, o.shape), o / keep, 0.0)
+        o = o.transpose(0, 2, 1, 3).reshape(b, T, self.n_out)
+        y = o @ params["Wo"] + params["bo"]
+        if mask is not None:
+            y = y * mask[..., None]
+        return y, state or {}
+
+
+@serde.register
+class TransformerBlock(FeedForwardLayer):
+    """Pre-LN transformer block: x + MHA(LN(x)), then x + MLP(LN(x)).
+
+    One layer config = one block; stack them in a list or use the
+    TransformerLM zoo model (which also stacks them along a pipeline axis
+    for PP). ``mlp_ratio`` sets the hidden width of the FFN.
+    """
+
+    is_recurrent = True
+
+    def __init__(self, n_heads: int = 4, causal: bool = True,
+                 mlp_ratio: int = 4, **kwargs):
+        kwargs.setdefault("activation", "gelu")
+        super().__init__(**kwargs)
+        self.n_heads = int(n_heads)
+        self.causal = bool(causal)
+        self.mlp_ratio = int(mlp_ratio)
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_out != self.n_in:
+            raise ValueError("TransformerBlock requires nIn == nOut (residual)")
+        if self.n_out % self.n_heads:
+            raise ValueError(f"n_out {self.n_out} not divisible by n_heads {self.n_heads}")
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        d = self.n_out
+        h = d * self.mlp_ratio
+        kq, kk, kv, ko, k1, k2 = jax.random.split(rng, 6)
+        return {
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "Wq": self._draw_weight(kq, (d, d), d, d, dtype),
+            "Wk": self._draw_weight(kk, (d, d), d, d, dtype),
+            "Wv": self._draw_weight(kv, (d, d), d, d, dtype),
+            "Wo": self._draw_weight(ko, (d, d), d, d, dtype),
+            "bo": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+            "W1": self._draw_weight(k1, (d, h), d, h, dtype),
+            "b1": jnp.zeros((h,), dtype),
+            "W2": self._draw_weight(k2, (h, d), h, d, dtype),
+            "b2": jnp.zeros((d,), dtype),
+        }
+
+    def attention(self, params, x, mask=None, attn_fn=None):
+        """MHA sublayer on pre-normed input; ``attn_fn`` overrides the
+        attention kernel (ring attention under seq sharding)."""
+        b, T, d = x.shape
+        hn = self.n_heads
+
+        def heads(W):
+            return (x @ W).reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(params["Wq"]), heads(params["Wk"]), heads(params["Wv"])
+        fn = attn_fn if attn_fn is not None else dense_attention
+        o = fn(q, k, v, causal=self.causal, mask=mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, T, d)
+        return o @ params["Wo"] + params["bo"]
+
+    def mlp(self, params, x):
+        h = self.act_fn()(x @ params["W1"] + params["b1"])
+        return h @ params["W2"] + params["b2"]
+
+    def block_apply(self, params, x, mask=None, attn_fn=None):
+        """Pure block fn, reused by the pipeline-parallel scan."""
+        a_in = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        x = x + self.attention(params, a_in, mask=mask, attn_fn=attn_fn)
+        m_in = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        return x + self.mlp(params, m_in)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = self.block_apply(params, x, mask=mask)
+        if mask is not None:
+            y = y * mask[..., None]
+        return y, state or {}
+
+
+@serde.register
+class PositionalEmbeddingLayer(Layer):
+    """Adds learned (default) or sinusoidal position encodings to (b,T,d).
+    ``max_length`` bounds learned tables; sinusoidal is length-agnostic."""
+
+    def __init__(self, max_length: int = 2048, mode: str = "learned", **kwargs):
+        super().__init__(**kwargs)
+        self.max_length = int(max_length)
+        self.mode = mode
+        self.n_feat: Optional[int] = None
+
+    def initialize(self, input_type):
+        self.n_feat = input_type.size
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        if self.mode != "learned":
+            return {}
+        return {
+            "pos": 0.02 * jax.random.normal(rng, (self.max_length, self.n_feat), dtype)
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        T = x.shape[1]
+        if self.mode == "learned":
+            return x + params["pos"][:T][None], state or {}
+        d = x.shape[-1]
+        pos = jnp.arange(T, dtype=x.dtype)[:, None]
+        dim = jnp.arange(d // 2, dtype=x.dtype)[None, :]
+        angle = pos / jnp.power(10000.0, 2 * dim / d)
+        enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+        return x + enc[None], state or {}

@@ -9,5 +9,10 @@ the ICI collectives the reference implements in user space.
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
+from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
 
-__all__ = ["TrainingMesh", "ParallelWrapper", "ParallelInference"]
+__all__ = [
+    "TrainingMesh", "ParallelWrapper", "ParallelInference",
+    "make_ring_attention", "DistributedLMTrainer",
+]

@@ -1,0 +1,250 @@
+"""Distributed training for TransformerLM over the 4-axis mesh.
+
+Replaces the reference's entire scaleout stack for the transformer era
+(SURVEY.md §2.5: the reference has DP only — ParallelWrapper threads,
+Spark parameter averaging, Aeron gradient sharing; TP/PP/SP are mandated
+new capabilities):
+
+- **DP**  batch over "data"           — GSPMD all-reduces gradients (ICI)
+- **TP**  d_model/FFN over "model"    — Megatron column→row split from
+  param shardings alone; GSPMD inserts the per-block all-reduces
+- **SP**  time over "seq"             — ring attention (explicit
+  ppermute ring, parallel/ring_attention.py) inside a shard_map manual
+  over {"seq"}
+- **PP**  layer stack over "pipe"     — GPipe microbatch schedule inside a
+  shard_map manual over {"pipe"} (and {"pipe","seq"} when both are on):
+  stage s computes microbatch m at tick t = s + m; activations hop
+  stage→stage via ppermute; outputs return to stage 0 on the ring wrap.
+  Backward pipelining falls out of autodiff (ppermute transposes to the
+  reverse ring).
+
+The train step is ONE jit: auto axes (data/model) partition via
+in_shardings; manual axes (pipe/seq) run under shard_map. This is the
+scaling-book recipe: pick a mesh, annotate, let XLA place collectives.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    TransformerLM,
+    TransformerLMConfig,
+    block_apply,
+)
+from deeplearning4j_tpu.nn.conf.layers.attention import _layer_norm
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.ring_attention import ring_attention_sharded
+
+Array = jax.Array
+
+
+def param_pspecs(cfg: TransformerLMConfig) -> Dict:
+    """PartitionSpecs: blocks stack over "pipe"; TP (Megatron) over
+    "model" — Wq/Wk/Wv/W1 column-parallel (output dim), Wo/W2
+    row-parallel (input dim); embeddings/head replicated."""
+    return {
+        "embed": P(), "pos": P(),
+        "blocks": {
+            "ln1_g": P("pipe"), "ln1_b": P("pipe"),
+            "Wq": P("pipe", None, "model"), "Wk": P("pipe", None, "model"),
+            "Wv": P("pipe", None, "model"),
+            "Wo": P("pipe", "model", None), "bo": P("pipe"),
+            "ln2_g": P("pipe"), "ln2_b": P("pipe"),
+            "W1": P("pipe", None, "model"), "b1": P("pipe", "model"),
+            "W2": P("pipe", "model", None), "b2": P("pipe"),
+        },
+        "lnf_g": P(), "lnf_b": P(), "head": P(),
+    }
+
+
+class DistributedLMTrainer:
+    """Jits the TransformerLM train step over a TrainingMesh with
+    dp/tp/pp/sp shardings; ``n_micro`` microbatches feed the pipeline."""
+
+    def __init__(self, model: TransformerLM, mesh: TrainingMesh,
+                 n_micro: Optional[int] = None):
+        self.model = model
+        self.mesh = mesh
+        self.cfg = model.cfg
+        pp = mesh.shape["pipe"]
+        if self.cfg.n_layers % pp:
+            raise ValueError(
+                f"n_layers {self.cfg.n_layers} not divisible by pipe axis {pp}"
+            )
+        self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
+        self._step = None
+
+    # ------------------------------------------------------------- forward
+    def _blocks_fn(self):
+        """(block_params, x (b,T,d)) → x, manual over pipe/seq as needed."""
+        cfg = self.cfg
+        mesh = self.mesh
+        pp = mesh.shape["pipe"]
+        sp = mesh.shape["seq"]
+        manual = set()
+        if pp > 1:
+            manual.add("pipe")
+        if sp > 1:
+            manual.add("seq")
+
+        attn_fn = None
+        if sp > 1:
+            def attn_fn(q, k, v, *, causal, mask=None):
+                return ring_attention_sharded(
+                    q, k, v, axis_name="seq", causal=causal, mask=mask
+                )
+
+        def stack_scan(bp_local, x):
+            def body(x, bp):
+                return block_apply(cfg, bp, x, attn_fn=attn_fn), None
+
+            x, _ = jax.lax.scan(body, x, bp_local)
+            return x
+
+        if pp == 1 and sp == 1:
+            return stack_scan
+
+        if pp == 1:  # SP only: manual over seq, blocks replicated
+            def blocks_fn(bp, x):
+                specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
+                return jax.shard_map(
+                    stack_scan, mesh=mesh.mesh, axis_names={"seq"},
+                    in_specs=(specs_b, P(None, "seq", None)),
+                    out_specs=P(None, "seq", None), check_vma=False,
+                )(bp, x)
+
+            return blocks_fn
+
+        # PP (optionally + SP): GPipe schedule
+        M = self.n_micro
+
+        def pipeline(bp_local, x):
+            """Manual over {"pipe"} (+"seq"): bp_local has L/pp stacked
+            layers; x is the full (replicated-over-pipe) batch."""
+            stage = jax.lax.axis_index("pipe")
+            B = x.shape[0]
+            mb = B // M
+            xs = x.reshape(M, mb, *x.shape[1:])
+            recv = jnp.zeros_like(xs[0])
+            outs = jnp.zeros_like(xs)
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            for t in range(M + pp):
+                if t >= pp:
+                    outs = outs.at[t - pp].set(recv)
+                if t <= M + pp - 2:
+                    sel = min(t, M - 1)
+                    x_in = jnp.where(stage == 0, xs[sel], recv)
+                    y = stack_scan(bp_local, x_in)
+                    recv = jax.lax.ppermute(y, "pipe", perm)
+            # final outputs live on stage 0; broadcast over the pipe axis
+            outs = jnp.where(stage == 0, outs, 0.0)
+            outs = jax.lax.psum(outs, "pipe")
+            return outs.reshape(B, *x.shape[1:])
+
+        x_spec = P(None, "seq", None) if sp > 1 else P()
+        bspec_leaf = lambda a: P("pipe", *([None] * (a.ndim - 1)))
+
+        def blocks_fn(bp, x):
+            specs_b = jax.tree_util.tree_map(bspec_leaf, bp)
+            return jax.shard_map(
+                pipeline, mesh=mesh.mesh, axis_names=manual,
+                in_specs=(specs_b, x_spec), out_specs=x_spec,
+                check_vma=False,
+            )(bp, x)
+
+        return blocks_fn
+
+    def _loss_fn(self):
+        cfg = self.cfg
+        blocks_fn = self._blocks_fn()
+
+        def loss(params, ids, targets):
+            x = params["embed"][ids] + params["pos"][: ids.shape[1]][None]
+            x = blocks_fn(params["blocks"], x)
+            x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
+            logits = x @ params["head"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            valid = (targets >= 0).astype(logits.dtype)
+            tgt = jnp.maximum(targets, 0)
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+            return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+        return loss
+
+    # ---------------------------------------------------------------- step
+    def build_step(self):
+        if self._step is not None:
+            return self._step
+        cfg = self.cfg
+        mesh = self.mesh
+        upd = self.model.updater
+        loss_fn = self._loss_fn()
+
+        def step(params, opt_state, ids, targets, t):
+            loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
+            flat_p, treedef = jax.tree_util.tree_flatten(params)
+            flat_g = treedef.flatten_up_to(grads)
+            flat_o = treedef.flatten_up_to(opt_state)
+            new_p, new_o = [], []
+            for p, g, o in zip(flat_p, flat_g, flat_o):
+                delta, o2 = upd.apply(g, o, t, t, 0)
+                new_p.append(p - delta)
+                new_o.append(o2)
+            return (jax.tree_util.tree_unflatten(treedef, new_p),
+                    jax.tree_util.tree_unflatten(treedef, new_o), loss)
+
+        pspecs = param_pspecs(cfg)
+        m = mesh.mesh
+        sh = lambda spec: NamedSharding(m, spec)
+        p_sh = jax.tree_util.tree_map(sh, pspecs,
+                                      is_leaf=lambda x: isinstance(x, P))
+        data_spec = sh(P("data", "seq")) if mesh.shape["seq"] > 1 else sh(P("data"))
+        # opt-state sharding (None) is inferred from param sharding by
+        # propagation — slot dicts mirror their param's layout
+        self._step = jax.jit(
+            step,
+            in_shardings=(p_sh, None, data_spec, data_spec, None),
+            out_shardings=(p_sh, None, None),
+            donate_argnums=(0, 1),
+        )
+        return self._step
+
+    def place(self):
+        """Device_put params/opt_state with their target shardings."""
+        m = self.mesh.mesh
+        pspecs = param_pspecs(self.cfg)
+        sh = lambda spec: NamedSharding(m, spec)
+
+        def put(tree, spec_tree):
+            flat_s, treedef = jax.tree_util.tree_flatten(
+                spec_tree, is_leaf=lambda x: isinstance(x, P)
+            )
+            flat_t = treedef.flatten_up_to(tree)
+            out = []
+            for sub, spec in zip(flat_t, flat_s):
+                out.append(jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, sh(spec)), sub))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        self.model.params_ = put(self.model.params_, pspecs)
+        self.model.opt_state_ = put(self.model.opt_state_, pspecs)
+        return self
+
+    def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
+        step = self.build_step()
+        self.model.iteration += 1
+        with self.mesh.mesh:
+            (self.model.params_, self.model.opt_state_,
+             self.model.score_) = step(
+                self.model.params_, self.model.opt_state_,
+                jnp.asarray(ids, jnp.int32), jnp.asarray(targets, jnp.int32),
+                jnp.asarray(self.model.iteration, jnp.int32),
+            )
+        return float(self.model.score_)

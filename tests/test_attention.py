@@ -1,0 +1,185 @@
+"""Attention + ring attention tests (new capability; no reference analog —
+SURVEY.md §5 long-context mandate). Ring attention is validated against
+dense attention on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import RnnOutputLayer
+from deeplearning4j_tpu.nn.conf.layers.attention import (
+    LayerNormalization,
+    PositionalEmbeddingLayer,
+    SelfAttentionLayer,
+    TransformerBlock,
+    dense_attention,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
+from deeplearning4j_tpu.updaters import Adam
+
+
+class TestDenseAttention:
+    def test_causal_masking(self):
+        rng = jax.random.PRNGKey(0)
+        q = jax.random.normal(rng, (2, 2, 6, 4))
+        out_full = dense_attention(q, q, q, causal=True)
+        # causal: output at position t must not change if future positions change
+        q2 = q.at[:, :, 4:, :].set(999.0)
+        out_pref = dense_attention(q2, q2, q2, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out_full[:, :, :4]), np.asarray(out_pref[:, :, :4]),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_key_padding_mask(self):
+        rng = jax.random.PRNGKey(1)
+        x = jax.random.normal(rng, (1, 1, 4, 4))
+        mask = jnp.asarray([[1.0, 1.0, 0.0, 0.0]])
+        out = dense_attention(x, x, x, causal=False, mask=mask)
+        # masked keys contribute nothing: recompute with only first 2 positions
+        out2 = dense_attention(x[:, :, :, :], x[:, :, :2, :], x[:, :, :2, :],
+                               causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSelfAttentionLayer:
+    def _net(self, causal=False, T=8, d=12):
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .list()
+            .layer(PositionalEmbeddingLayer(max_length=T))
+            .layer(SelfAttentionLayer(n_heads=3, causal=causal))
+            .layer(RnnOutputLayer(n_out=5, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(d))
+            .build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def test_shapes_and_training(self):
+        net = self._net()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8, 12)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, (4, 8))]
+        net.fit(DataSet(x, y), epochs=3)
+        out = net.output(x)
+        assert out.shape == (4, 8, 5)
+        assert np.isfinite(net.score(DataSet(x, y)))
+
+    def test_mask_zeroes_padded_positions(self):
+        net = self._net()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 12)).astype(np.float32)
+        fm = np.ones((2, 8), np.float32)
+        fm[:, 6:] = 0.0
+        # attention layer output at valid positions must ignore padded keys
+        out_m = net.output(x, mask=fm)
+        x2 = x.copy()
+        x2[:, 6:, :] = 123.0  # junk in padded region
+        out_m2 = net.output(x2, mask=fm)
+        np.testing.assert_allclose(out_m[:, :6], out_m2[:, :6], rtol=1e-4, atol=1e-5)
+
+
+class TestTransformerBlock:
+    def test_learns_copy_task(self):
+        """Tiny LM-style task: predict the token at the same position
+        (identity over a causal block → learnable)."""
+        V, T, d = 7, 6, 16
+        conf = (
+            NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+            .list()
+            .layer(PositionalEmbeddingLayer(max_length=T))
+            .layer(TransformerBlock(n_heads=4, causal=True))
+            .layer(TransformerBlock(n_heads=4, causal=True))
+            .layer(RnnOutputLayer(n_out=V, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(d))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, V, (64, T))
+        # input: one-hot in first V dims of d
+        x = np.zeros((64, T, d), np.float32)
+        x[np.arange(64)[:, None], np.arange(T)[None, :], ids] = 1.0
+        y = np.eye(V, dtype=np.float32)[ids]
+        s0 = net.score(DataSet(x, y))
+        net.fit(DataSet(x, y), epochs=30, batch_size=32)
+        s1 = net.score(DataSet(x, y))
+        assert s1 < s0 * 0.5, f"transformer should learn copy task: {s0} -> {s1}"
+
+    def test_serde(self):
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(1)
+            .list()
+            .layer(TransformerBlock(n_heads=2, causal=True, mlp_ratio=2))
+            .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.recurrent(8))
+            .build()
+        )
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        blk = conf2.layers[0]
+        assert isinstance(blk, TransformerBlock)
+        assert blk.n_heads == 2 and blk.causal and blk.mlp_ratio == 2
+
+
+class TestRingAttention:
+    """Ring == dense, on the 8-device CPU mesh (seq axis)."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("seq_devices", [2, 4, 8])
+    def test_matches_dense(self, causal, seq_devices):
+        mesh = TrainingMesh(data=1, seq=seq_devices,
+                            devices=jax.devices()[:seq_devices])
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        b, h, T, hd = 2, 3, 16, 8
+        q = jax.random.normal(kq, (b, h, T, hd))
+        k = jax.random.normal(kk, (b, h, T, hd))
+        v = jax.random.normal(kv, (b, h, T, hd))
+        ring = make_ring_attention(mesh)
+        out_ring = ring(q, k, v, causal=causal)
+        out_dense = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_matches_dense_with_mask(self):
+        mesh = TrainingMesh(data=1, seq=4, devices=jax.devices()[:4])
+        rng = jax.random.PRNGKey(7)
+        b, h, T, hd = 2, 2, 16, 4
+        q = jax.random.normal(rng, (b, h, T, hd))
+        mask = (jax.random.uniform(jax.random.PRNGKey(8), (b, T)) > 0.3).astype(
+            jnp.float32
+        )
+        mask = mask.at[:, 0].set(1.0)  # every example keeps >= 1 key
+        ring = make_ring_attention(mesh)
+        out_ring = ring(q, q, q, causal=False, mask=mask)
+        out_dense = dense_attention(q, q, q, causal=False, mask=mask)
+        np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_long_sequence_grad_flows(self):
+        """Gradients flow through the ring (autodiff over ppermute)."""
+        mesh = TrainingMesh(data=1, seq=4, devices=jax.devices()[:4])
+        ring = make_ring_attention(mesh)
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 8, 4))
+
+        def loss(q):
+            return jnp.sum(ring(q, q, q, causal=True) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert np.all(np.isfinite(np.asarray(g)))
+        # compare to dense gradient
+        def loss_d(q):
+            return jnp.sum(dense_attention(q, q, q, causal=True) ** 2)
+
+        gd = jax.grad(loss_d)(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gd), rtol=1e-3,
+                                   atol=1e-4)
