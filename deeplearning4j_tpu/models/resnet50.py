@@ -70,6 +70,7 @@ class ResNet50(ZooModel):
             .updater(self.kwargs.get("updater", Nesterovs(1e-1, 0.9)))
             .weight_init("relu")
             .l2(1e-4)
+            .compute_dtype(self.kwargs.get("compute_dtype"))
             .graph_builder()
             .add_inputs("input")
             .set_input_types(InputType.convolutional(self.height, self.width,

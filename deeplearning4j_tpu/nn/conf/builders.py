@@ -273,6 +273,13 @@ class NeuralNetConfiguration:
         self._g.dtype = dt
         return self
 
+    def compute_dtype(self, dt: Optional[str]) -> "NeuralNetConfiguration":
+        """Mixed precision: cast activations/conv/matmul operands to ``dt``
+        (normally "bfloat16") while params + updater state stay ``dtype``
+        fp32 master weights. None disables."""
+        self._g.compute_dtype = dt
+        return self
+
     # transition to layer list ------------------------------------------------
     def list(self) -> "ListBuilder":
         if self._reg_kwargs:

@@ -153,6 +153,7 @@ class GlobalConf:
         gradient_normalization: Optional[str] = None,
         gradient_normalization_threshold: float = 1.0,
         dtype: str = "float32",
+        compute_dtype: Optional[str] = None,
         mini_batch: bool = True,
         max_num_line_search_iterations: int = 5,
         optimization_algo: str = "stochastic_gradient_descent",
@@ -169,6 +170,11 @@ class GlobalConf:
         self.gradient_normalization = gradient_normalization
         self.gradient_normalization_threshold = float(gradient_normalization_threshold)
         self.dtype = dtype
+        # Mixed precision: params stay ``dtype`` (fp32 master weights, fp32
+        # updater math); activations + matmul/conv operands are cast to
+        # ``compute_dtype`` (normally "bfloat16" → MXU-native on TPU,
+        # halves HBM traffic). None = uniform ``dtype`` everywhere.
+        self.compute_dtype = compute_dtype
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

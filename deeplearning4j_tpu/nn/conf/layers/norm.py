@@ -77,6 +77,9 @@ class BatchNormalization(Layer):
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
         assert state is not None and "mean" in state, "BatchNormalization needs layer state"
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        in_dtype = x.dtype
+        if in_dtype in (jnp.bfloat16, jnp.float16):
+            x = x.astype(jnp.float32)  # stats in full precision under bf16 compute
         if train:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
@@ -98,7 +101,7 @@ class BatchNormalization(Layer):
             from deeplearning4j_tpu import activations as _act
 
             y = _act.get(self.activation)(y)
-        return y, new_state
+        return y.astype(in_dtype), new_state
 
 
 @serde.register

@@ -42,7 +42,11 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (
 )
 from deeplearning4j_tpu.nn.conf.layers.base import apply_input_dropout
 from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
-from deeplearning4j_tpu.nn.multilayer import _apply_layer_updates, _dtype_of
+from deeplearning4j_tpu.nn.multilayer import (
+    _apply_layer_updates,
+    _cast_layer_params_for_compute,
+    _dtype_of,
+)
 from deeplearning4j_tpu.updaters import NoOp
 
 Array = jax.Array
@@ -75,7 +79,21 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._jit_cache: Dict[str, Any] = {}
+        cd = getattr(conf.global_conf, "compute_dtype", None)
+        self._compute_dtype = None if cd is None else _dtype_of(cd)
         self._output_layers()  # fail fast with a clear message on misconfig
+
+    def _cast_for_compute(self, params):
+        cd = self._compute_dtype
+        if cd is None:
+            return params
+        out = dict(params)
+        for name in self.layer_names:
+            layer = self._layer(name)
+            out[name] = _cast_layer_params_for_compute(
+                layer, params[name], cd, is_output=layer.is_output_layer
+            )
+        return out
 
     def _layer(self, name: str):
         return self.conf.vertices[name].layer
@@ -128,6 +146,13 @@ class ComputationGraph:
         (``ComputationGraph.java:1321``).
         """
         conf = self.conf
+        if self._compute_dtype is not None:
+            params = self._cast_for_compute(params)
+            inputs = [
+                jnp.asarray(x).astype(self._compute_dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x
+                for x in inputs
+            ]
         acts: Dict[str, Array] = dict(zip(conf.network_inputs, inputs))
         masks: Dict[str, Optional[Array]] = {n: None for n in conf.network_inputs}
         if fmasks is not None:
@@ -192,6 +217,8 @@ class ComputationGraph:
         for i, name in enumerate(self.conf.network_outputs):
             layer = self._layer(name)
             x, m = out_inputs[name]
+            if self._compute_dtype is not None:
+                x = x.astype(jnp.float32)  # loss/softmax in full precision
             lmask = None
             if lmasks is not None and i < len(lmasks):
                 lmask = lmasks[i]

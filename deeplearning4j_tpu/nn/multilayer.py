@@ -49,6 +49,26 @@ def _dtype_of(name: str):
             "float64": jnp.float64}[name]
 
 
+def _cast_layer_params_for_compute(layer, p, cd, *, is_output: bool):
+    """Mixed-precision compute cast for one layer's param dict: float params
+    → ``cd``, except normalization layers (stats/scale stay fp32 for
+    stability) and output layers (loss/softmax in fp32). The cast happens
+    inside the differentiated function, so its transpose casts gradients
+    back to fp32 — master weights and updater math stay full precision.
+    Shared by MultiLayerNetwork and ComputationGraph."""
+    from deeplearning4j_tpu.nn.conf.layers.norm import (
+        BatchNormalization,
+        LocalResponseNormalization,
+    )
+
+    if isinstance(layer, (BatchNormalization, LocalResponseNormalization)) or is_output:
+        return p
+    return {
+        k: v.astype(cd) if jnp.issubdtype(v.dtype, jnp.floating) else v
+        for k, v in p.items()
+    }
+
+
 def _apply_layer_updates(layers, params, grads, opt_state, t, iteration, epoch):
     """Shared per-layer update pipeline (both train steps): gradient
     normalization → l1/l2/weight-decay → updater → constraints.
@@ -102,6 +122,20 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._rnn_carries: Optional[List[Any]] = None
         self._jit_cache: Dict[str, Any] = {}
+        cd = getattr(conf.global_conf, "compute_dtype", None)
+        self._compute_dtype = None if cd is None else _dtype_of(cd)
+
+    def _cast_for_compute(self, params):
+        cd = self._compute_dtype
+        if cd is None:
+            return params
+        n = len(self.layers)
+        return [
+            _cast_layer_params_for_compute(
+                layer, p, cd, is_output=(i == n - 1 and layer.is_output_layer)
+            )
+            for i, (layer, p) in enumerate(zip(self.layers, params))
+        ]
 
     # ------------------------------------------------------------------ init
     def init(self, rng: Optional[Array] = None) -> "MultiLayerNetwork":
@@ -149,6 +183,10 @@ class MultiLayerNetwork:
         """
         n = len(self.layers)
         stop = n if stop_before is None else stop_before
+        if self._compute_dtype is not None:
+            params = self._cast_for_compute(params)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                x = jnp.asarray(x).astype(self._compute_dtype)
         rngs = (
             jax.random.split(rng, n) if rng is not None else [None] * n
         )
@@ -200,6 +238,8 @@ class MultiLayerNetwork:
         x, mask, new_states, _, _ = self._forward(
             params, state, features, train=train, rng=rng, fmask=fmask, stop_before=n - 1
         )
+        if self._compute_dtype is not None:
+            x = x.astype(jnp.float32)  # loss/softmax in full precision
         out_layer = self._output_layer()
         label_mask = lmask if lmask is not None else mask
         if isinstance(out_layer, CenterLossOutputLayer):
@@ -326,6 +366,8 @@ class MultiLayerNetwork:
                     p, state, features, train=True, rng=rng, fmask=fmask,
                     stop_before=n - 1, carries=carries,
                 )
+                if self._compute_dtype is not None:
+                    x = x.astype(jnp.float32)
                 out_layer = self._output_layer()
                 label_mask = lmask if lmask is not None else mask
                 per_ex = out_layer.compute_score(p[-1], x, labels, label_mask)
