@@ -42,13 +42,33 @@ def _init_devices(max_tries: int = 5):
 
     Guards against the silent-CPU-fallback trap: a failed axon init can
     leave xla_bridge with only the cpu backend, and a bare retry would
-    then "succeed" on CPU and record a bogus number as the round artifact."""
+    then "succeed" on CPU and record a bogus number as the round artifact.
+
+    A single axon init attempt can BLOCK ~25 min before failing when the
+    tunnel is down, so retries run against a wall-clock budget
+    (BENCH_INIT_BUDGET_S, default 20 min) — a long first failure exits
+    immediately with the error JSON instead of retrying for hours.
+
+    BENCH_FORCE_CPU=1 pins the virtual-CPU path for script validation
+    (the axon plugin overrides the JAX_PLATFORMS env var, so only
+    jax.config.update reliably selects cpu)."""
+    import importlib.util
     import os
 
     import jax
     from jax.extend import backend as jex_backend
 
-    want_tpu = "axon" in os.environ.get("JAX_PLATFORMS", "")
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+    jp = os.environ.get("JAX_PLATFORMS", "")
+    # axon named explicitly, or unset with the axon plugin present (jax
+    # auto-discovery would pick it and silently fall back to cpu on failure)
+    want_tpu = "axon" in jp or (
+        jp == "" and importlib.util.find_spec("axon") is not None
+    )
+    deadline = time.monotonic() + float(os.environ.get("BENCH_INIT_BUDGET_S", "1200"))
     delay = 5.0
     last = None
     for attempt in range(max_tries):
@@ -63,10 +83,11 @@ def _init_devices(max_tries: int = 5):
                 jex_backend.clear_backends()
             except Exception:
                 pass
-            if attempt < max_tries - 1:
-                time.sleep(delay)
-                delay = min(delay * 2, 60.0)
-    raise RuntimeError(f"backend init failed after {max_tries} tries: {last}")
+            if attempt == max_tries - 1 or time.monotonic() > deadline:
+                break
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+    raise RuntimeError(f"backend init failed (tries={attempt + 1}): {last}")
 
 
 def _bench_resnet(batch: int, compute_dtype):

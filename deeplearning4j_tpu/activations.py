@@ -11,6 +11,7 @@ there is no per-activation kernel dispatch as in the reference's libnd4j ops).
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Union
 
 import jax
@@ -153,12 +154,21 @@ _REGISTRY: dict[str, ActivationFn] = {
 
 
 def get(name_or_fn: Union[str, ActivationFn, None]) -> ActivationFn:
-    """Resolve an activation by name (case-insensitive) or pass through a callable."""
+    """Resolve an activation by name (case-insensitive) or pass through a
+    callable. ``leakyrelu(alpha)`` / ``thresholdedrelu(theta)`` parse a
+    parameter from the name — keeps activation configs JSON-serializable
+    strings (reference: ``ActivationLReLU(alpha)`` objects)."""
     if name_or_fn is None:
         return identity
     if callable(name_or_fn):
         return name_or_fn
     key = str(name_or_fn).lower().replace("_", "")
+    m = re.fullmatch(r"(leakyrelu|thresholdedrelu)\(([-+0-9.e]+)\)", key)
+    if m:
+        p = float(m.group(2))
+        if m.group(1) == "leakyrelu":
+            return lambda x: jax.nn.leaky_relu(x, negative_slope=p)
+        return lambda x: jnp.where(x > p, x, 0.0)
     if key not in _REGISTRY:
         raise ValueError(
             f"Unknown activation '{name_or_fn}'. Known: {sorted(_REGISTRY)}"

@@ -1,0 +1,5 @@
+"""Model import (reference ``deeplearning4j-modelimport``)."""
+
+from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+__all__ = ["KerasModelImport"]

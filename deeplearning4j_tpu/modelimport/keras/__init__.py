@@ -1,0 +1,13 @@
+"""Keras HDF5 model import (reference ``keras/KerasModelImport.java:50-121``).
+
+TPU-native design: instead of the reference's JavaCPP-HDF5 archive +
+per-layer ``KerasLayer`` class hierarchy, this is an h5py reader + a flat
+mapper registry (keras class name → builder of this framework's layer /
+vertex + a weight translator). The imported model is an ordinary
+MultiLayerNetwork / ComputationGraph whose whole forward is one jitted XLA
+program — imported models get the same MXU/fusion treatment as native ones.
+"""
+
+from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+
+__all__ = ["KerasModelImport"]

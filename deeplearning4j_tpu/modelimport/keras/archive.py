@@ -1,0 +1,114 @@
+"""HDF5 archive reader (reference ``keras/Hdf5Archive.java:48-63``, which
+uses JavaCPP-HDF5; here h5py — SURVEY.md §2.9.3's prescribed replacement).
+
+Handles both layouts:
+- Keras 2.x: ``model_weights/<layer>/<layer>/<weight>:0`` datasets
+- Keras 3.x legacy h5: ``model_weights/<layer>/<model>/<layer>/<weight>``
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover - h5py is in the baked image
+    h5py = None
+
+
+def _decode(v):
+    return v.decode() if isinstance(v, bytes) else v
+
+
+class Hdf5Archive:
+    def __init__(self, path: str):
+        if h5py is None:
+            raise ImportError("h5py is required for Keras model import")
+        self.path = path
+        self._f = h5py.File(path, "r")
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # ------------------------------------------------------------- config
+    def model_config(self) -> dict:
+        raw = self._f.attrs.get("model_config")
+        if raw is None:
+            raise ValueError(
+                f"{self.path} has no 'model_config' attribute — not a Keras "
+                "full-model HDF5 (weights-only files are not importable "
+                "without the architecture; same restriction as the reference)"
+            )
+        return json.loads(_decode(raw))
+
+    def training_config(self) -> Optional[dict]:
+        raw = self._f.attrs.get("training_config")
+        return None if raw is None else json.loads(_decode(raw))
+
+    def keras_version(self) -> str:
+        for holder in (self._f.attrs, self._weights_group().attrs):
+            v = holder.get("keras_version")
+            if v is not None:
+                return _decode(v)
+        return "unknown"
+
+    # ------------------------------------------------------------ weights
+    def _weights_group(self):
+        if "model_weights" in self._f:
+            return self._f["model_weights"]
+        return self._f  # weights-only files store layers at the root
+
+    def layer_names(self) -> List[str]:
+        g = self._weights_group()
+        names = g.attrs.get("layer_names")
+        if names is not None:
+            return [_decode(n) for n in names]
+        return list(g.keys())
+
+    def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        """All datasets under the layer's group, keyed by their full path
+        relative to the group (slashes preserved, ':0' suffixes stripped).
+        Callers match on trailing path components (``kernel``, ``bias``,
+        ``forward_lstm/.../kernel`` …)."""
+        g = self._weights_group()
+        if layer_name not in g:
+            return {}
+        out: Dict[str, np.ndarray] = {}
+
+        def walk(group, prefix: str):
+            for k in group:
+                item = group[k]
+                key = f"{prefix}{k}"
+                if isinstance(item, h5py.Dataset):
+                    out[key.split(":")[0]] = np.asarray(item)
+                else:
+                    walk(item, key + "/")
+
+        walk(g[layer_name], "")
+        return out
+
+
+def pick(weights: Dict[str, np.ndarray], *suffixes: str,
+         contains: Optional[str] = None) -> Optional[np.ndarray]:
+    """Find the unique weight whose path ends with one of ``suffixes``
+    (optionally also containing ``contains``). None if absent."""
+    for suffix in suffixes:
+        hits = [
+            k for k in weights
+            if (k == suffix or k.endswith("/" + suffix))
+            and (contains is None or contains in k)
+        ]
+        if len(hits) == 1:
+            return weights[hits[0]]
+        if len(hits) > 1:
+            raise ValueError(f"Ambiguous weight '{suffix}' (contains={contains}): {hits}")
+    return None

@@ -1,0 +1,327 @@
+"""Keras model assembly + weight copy.
+
+Reference entry points: ``keras/KerasModelImport.java:50-121``
+(``importKerasSequentialModelAndWeights`` → MultiLayerNetwork,
+``importKerasModelAndWeights`` → ComputationGraph);
+assembly ``keras/KerasModel.java`` / ``KerasSequentialModel.java``;
+weight copy ``utils/KerasModelUtils.importWeights:170``.
+
+Handles Keras 2.x and Keras 3.x (legacy ``.h5``) full-model files.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras.archive import Hdf5Archive
+from deeplearning4j_tpu.modelimport.keras.mappers import (
+    Mapped,
+    UnsupportedKerasLayer,
+    map_keras_layer,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    LossLayer,
+    OutputLayer,
+    RnnOutputLayer,
+)
+
+_LOSS_BY_ACT = {"softmax": "mcxent", "sigmoid": "xent"}
+
+_KERAS_LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "l1", "mae": "l1",
+}
+
+
+def _input_type_for_shape(shape: Sequence[Optional[int]]) -> InputType:
+    dims = [d for d in shape[1:]]
+    if len(dims) == 3:
+        return InputType.convolutional(dims[0], dims[1], dims[2])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    raise UnsupportedKerasLayer(f"Unsupported Keras input shape {shape}")
+
+
+def _layer_input_shape(layer_cfg: dict) -> Optional[List[Optional[int]]]:
+    cfg = layer_cfg.get("config", {})
+    for key in ("batch_shape", "batch_input_shape"):
+        if cfg.get(key) is not None:
+            return list(cfg[key])
+    return None
+
+
+def _loss_from_training_config(tc: Optional[dict]) -> Optional[str]:
+    if not tc:
+        return None
+    loss = tc.get("loss")
+    if isinstance(loss, dict):  # per-output dict or serialized loss object
+        loss = loss.get("class_name", None) or next(iter(loss.values()), None)
+        if isinstance(loss, dict):
+            loss = loss.get("class_name")
+    if isinstance(loss, str):
+        key = loss.lower()
+        # Keras 3 serializes class names (CategoricalCrossentropy)
+        key = {
+            "categoricalcrossentropy": "categorical_crossentropy",
+            "sparsecategoricalcrossentropy": "sparse_categorical_crossentropy",
+            "binarycrossentropy": "binary_crossentropy",
+            "meansquarederror": "mean_squared_error",
+            "meanabsoluteerror": "mean_absolute_error",
+        }.get(key, key)
+        return _KERAS_LOSSES.get(key)
+    return None
+
+
+def _output_head(layer, loss_hint: Optional[str]):
+    """Convert a terminal mapped layer into this framework's output-layer
+    form (reference appends ``KerasLoss``): Dense → OutputLayer (fused
+    logits path), anything else → the layer + a parameter-free LossLayer."""
+    if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
+        loss = loss_hint or _LOSS_BY_ACT.get(layer.activation, "mse")
+        return OutputLayer(n_out=layer.n_out, activation=layer.activation, loss=loss), None
+    if getattr(layer, "is_output_layer", False):
+        return layer, None
+    return layer, LossLayer(loss=loss_hint or "mse", activation="identity")
+
+
+def _inbound_names(layer_cfg: dict) -> List[str]:
+    """Source vertex names from inbound_nodes — Keras 2 nested-list format
+    or Keras 3 keras_history format."""
+    nodes = layer_cfg.get("inbound_nodes") or []
+    if not nodes:
+        return []
+    node = nodes[0]
+    names: List[str] = []
+    if isinstance(node, dict):  # Keras 3: {"args": [...], "kwargs": {...}}
+        def scan(obj):
+            if isinstance(obj, dict):
+                if obj.get("class_name") == "__keras_tensor__":
+                    names.append(obj["config"]["keras_history"][0])
+                else:
+                    for v in obj.values():
+                        scan(v)
+            elif isinstance(obj, (list, tuple)):
+                for v in obj:
+                    scan(v)
+
+        scan(node.get("args", []))
+    else:  # Keras 2: [["src", node_idx, tensor_idx, {...}], ...]
+        for entry in node:
+            names.append(entry[0])
+    return names
+
+
+class KerasModelImport:
+    """Static entry points mirroring ``KerasModelImport.java:50-121``."""
+
+    # ------------------------------------------------------------ sequential
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path: str):
+        """→ MultiLayerNetwork with copied weights."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with Hdf5Archive(path) as ar:
+            cfg = ar.model_config()
+            if cfg["class_name"] != "Sequential":
+                raise ValueError(
+                    f"{path} holds a {cfg['class_name']} model; use "
+                    "import_keras_model_and_weights for functional models"
+                )
+            layer_cfgs = cfg["config"]["layers"]
+            tc_loss = _loss_from_training_config(ar.training_config())
+
+            input_shape = None
+            mapped: List[Tuple[str, Mapped]] = []
+            for lc in layer_cfgs:
+                cls, conf = lc["class_name"], lc.get("config", {})
+                shape = _layer_input_shape(lc)
+                if shape is not None and input_shape is None:
+                    input_shape = shape
+                if cls == "InputLayer":
+                    continue
+                mapped.append((conf.get("name", cls), map_keras_layer(cls, conf)))
+            if input_shape is None:
+                bis = cfg["config"].get("build_input_shape")
+                if bis is None:
+                    raise ValueError(f"{path}: no input shape recorded")
+                input_shape = list(bis)
+
+            # terminal → output head
+            names_layers = [(n, m) for n, m in mapped if m.layer is not None]
+            if not names_layers:
+                raise ValueError(f"{path}: no parameterizable layers found")
+            last_name, last_m = names_layers[-1]
+            head, extra_loss = _output_head(last_m.layer, tc_loss)
+            last_m.layer = head
+
+            lb = NeuralNetConfiguration.builder().seed(0).list()
+            index_of: Dict[str, int] = {}
+            idx = 0
+            for n, m in mapped:
+                if m.layer is None:
+                    if not m.is_flatten:
+                        raise UnsupportedKerasLayer(
+                            f"Layer '{n}' is graph-only; import this model "
+                            "via import_keras_model_and_weights"
+                        )
+                    continue  # Flatten: the builder infers the reshape
+                lb.layer(m.layer)
+                index_of[n] = idx
+                idx += 1
+            if extra_loss is not None:
+                lb.layer(extra_loss)
+            conf_built = (
+                lb.set_input_type(_input_type_for_shape(input_shape)).build()
+            )
+            net = MultiLayerNetwork(conf_built).init()
+
+            # ---- weight copy
+            new_params = list(net.params_)
+            new_state = list(net.state_)
+            for n, m in mapped:
+                if m.translator is None or n not in index_of:
+                    continue
+                w = ar.layer_weights(n)
+                if not w:
+                    continue
+                p, s = m.translator(w)
+                i = index_of[n]
+                new_params[i] = {
+                    k: _shaped(v, net.params_[i], k, n) for k, v in p.items()
+                }
+                if s:
+                    new_state[i] = {
+                        k: _shaped(v, net.state_[i], k, n) for k, v in s.items()
+                    }
+            net.params_ = new_params
+            net.state_ = new_state
+            return net
+
+    # ------------------------------------------------------------ functional
+    @staticmethod
+    def import_keras_model_and_weights(path: str):
+        """→ ComputationGraph (functional) or MultiLayerNetwork (sequential),
+        matching the reference's type dispatch."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        with Hdf5Archive(path) as ar:
+            cfg = ar.model_config()
+            if cfg["class_name"] == "Sequential":
+                return KerasModelImport.import_keras_sequential_model_and_weights(path)
+            tc_loss = _loss_from_training_config(ar.training_config())
+            gconf = cfg["config"]
+            layer_cfgs = gconf["layers"]
+
+            inputs: List[str] = []
+            input_types: List[InputType] = []
+            mapped: Dict[str, Mapped] = {}
+            inbound: Dict[str, List[str]] = {}
+            order: List[str] = []
+            for lc in layer_cfgs:
+                cls, conf = lc["class_name"], lc.get("config", {})
+                name = conf.get("name") or lc.get("name")
+                if cls == "InputLayer":
+                    inputs.append(name)
+                    shape = _layer_input_shape(lc)
+                    if shape is None:
+                        raise ValueError(f"InputLayer {name} without shape")
+                    input_types.append(_input_type_for_shape(shape))
+                    continue
+                mapped[name] = map_keras_layer(cls, conf)
+                inbound[name] = _inbound_names(lc)
+                order.append(name)
+
+            def norm_outputs(spec):
+                # [name,0,0] | [[name,0,0], ...]
+                if spec and isinstance(spec[0], (list, tuple)):
+                    return [s[0] for s in spec]
+                return [spec[0]]
+
+            out_names = norm_outputs(gconf["output_layers"])
+
+            gb = (
+                NeuralNetConfiguration.builder().seed(0).graph_builder()
+                .add_inputs(*inputs)
+                .set_input_types(*input_types)
+            )
+            for name in order:
+                m = mapped[name]
+                srcs = inbound[name]
+                if m.layer is not None:
+                    gb.add_layer(name, m.layer, *srcs)
+                elif m.vertex is not None:
+                    gb.add_vertex(name, m.vertex, *srcs)
+                else:
+                    raise UnsupportedKerasLayer(f"Layer {name} maps to nothing")
+
+            # ensure every network output is an output layer
+            final_outputs = []
+            for on in out_names:
+                m = mapped.get(on)
+                if m is not None and m.layer is not None and getattr(
+                    m.layer, "is_output_layer", False
+                ):
+                    final_outputs.append(on)
+                    continue
+                act = getattr(m.layer, "activation", "identity") if (
+                    m and m.layer is not None) else "identity"
+                loss = tc_loss or _LOSS_BY_ACT.get(act, "mse")
+                loss_name = f"{on}_loss"
+                gb.add_layer(loss_name, LossLayer(loss=loss, activation="identity"), on)
+                final_outputs.append(loss_name)
+            gb.set_outputs(*final_outputs)
+            net = ComputationGraph(gb.build()).init()
+
+            # ---- weight copy
+            new_params = dict(net.params_)
+            new_state = dict(net.state_)
+            for name in order:
+                m = mapped[name]
+                if m.translator is None:
+                    continue
+                w = ar.layer_weights(name)
+                if not w:
+                    continue
+                p, s = m.translator(w)
+                new_params[name] = {
+                    k: _shaped(v, net.params_[name], k, name) for k, v in p.items()
+                }
+                if s:
+                    new_state[name] = {
+                        k: _shaped(v, net.state_[name], k, name) for k, v in s.items()
+                    }
+            net.params_ = new_params
+            net.state_ = new_state
+            return net
+
+    # aliases matching the reference's overload names
+    importKerasModelAndWeights = import_keras_model_and_weights
+    importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+
+def _shaped(v, tgt: dict, key: str, layer_name: str):
+    import jax.numpy as jnp
+
+    if key not in tgt:
+        raise ValueError(
+            f"Imported weight '{key}' for layer '{layer_name}' has no "
+            f"destination (model has {sorted(tgt)})"
+        )
+    if isinstance(v, dict):  # nested params (Bidirectional fwd/bwd)
+        return {k: _shaped(sub, tgt[key], k, f"{layer_name}.{key}")
+                for k, sub in v.items()}
+    if tuple(v.shape) != tuple(tgt[key].shape):
+        raise ValueError(
+            f"Shape mismatch for {layer_name}.{key}: keras {v.shape} vs "
+            f"model {tuple(tgt[key].shape)}"
+        )
+    return jnp.asarray(v, tgt[key].dtype)

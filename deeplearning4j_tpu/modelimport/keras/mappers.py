@@ -1,0 +1,617 @@
+"""Keras layer-config → framework-layer mappers + weight translators.
+
+Reference: the ~35 ``KerasLayer`` subclasses under
+``keras/layers/{core,convolutional,pooling,recurrent,embeddings,
+normalization,noise,advanced/activations,wrappers}`` (SURVEY.md §2.6).
+Here each Keras class name maps to one function returning a ``Mapped``
+record: the equivalent layer/vertex of this framework plus a pure weight
+translator (numpy in → params/state dicts out).
+
+Weight-layout translation table (reference ``KerasModelUtils.importWeights``
+``:170``; silent-accuracy-bug territory, SURVEY §7 hard-part 4):
+- Dense kernel (in,out) → W (in,out): identity (both are right-multiply).
+- Conv2D kernel HWIO → W HWIO: identity (NHWC native on TPU; the
+  reference's NCHW permutation is *deleted*, not ported).
+- DepthwiseConv2D kernel (kh,kw,in,mult) → W (kh,kw,1,in*mult): reshape
+  (in-major interleave matches XLA's feature_group_count convention).
+- Conv2DTranspose kernel (kh,kw,out,in) → W (kh,kw,out,in): identity.
+- LSTM kernels (in,4u) gate order [i,f,g,o] → Wx gate order [i,f,o,g].
+- BatchNorm moving_mean/moving_variance → layer *state*, not params.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras.archive import pick
+from deeplearning4j_tpu.nn.conf.graph_vertices import (
+    ElementWiseVertex,
+    GraphVertex,
+    MergeVertex,
+    PreprocessorVertex,
+    ReshapeVertex,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNormalization,
+    Bidirectional,
+    Convolution1DLayer,
+    ConvolutionLayer,
+    Cropping2D,
+    Deconvolution2D,
+    DenseLayer,
+    DepthwiseConvolution2D,
+    DropoutLayer,
+    EmbeddingSequenceLayer,
+    GlobalPoolingLayer,
+    LastTimeStep,
+    Layer,
+    LSTM,
+    SeparableConvolution2D,
+    SimpleRnn,
+    Subsampling1DLayer,
+    SubsamplingLayer,
+    Upsampling1D,
+    Upsampling2D,
+    ZeroPadding1DLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.conf.preprocessors import CnnToFeedForwardPreProcessor
+
+WeightTranslator = Callable[[Dict[str, np.ndarray]], Tuple[dict, dict]]
+
+_ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6", "elu": "elu",
+    "selu": "selu", "gelu": "gelu", "tanh": "tanh", "sigmoid": "sigmoid",
+    "hard_sigmoid": "hardsigmoid", "softmax": "softmax", "softplus": "softplus",
+    "softsign": "softsign", "swish": "swish", "silu": "swish", "mish": "mish",
+    "leaky_relu": "leakyrelu", "exponential": None, "log_softmax": "logsoftmax",
+}
+
+
+class UnsupportedKerasLayer(ValueError):
+    pass
+
+
+def map_activation(name) -> str:
+    if name is None:
+        return "identity"
+    if isinstance(name, dict):  # serialized Activation object
+        name = name.get("class_name", "").lower()
+    mapped = _ACTIVATIONS.get(name)
+    if mapped is None and name not in _ACTIVATIONS:
+        raise UnsupportedKerasLayer(f"Unsupported Keras activation '{name}'")
+    if mapped is None:
+        raise UnsupportedKerasLayer(f"Keras activation '{name}' has no equivalent")
+    return mapped
+
+
+class Mapped:
+    """One Keras layer's translation: ``layer`` XOR ``vertex`` XOR skip."""
+
+    def __init__(
+        self,
+        layer: Optional[Layer] = None,
+        vertex: Optional[GraphVertex] = None,
+        skip: bool = False,
+        translator: Optional[WeightTranslator] = None,
+        is_flatten: bool = False,
+    ):
+        self.layer = layer
+        self.vertex = vertex
+        self.skip = skip
+        self.translator = translator
+        self.is_flatten = is_flatten
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return [int(v[0]), int(v[1] if len(v) > 1 else v[0])]
+    return [int(v), int(v)]
+
+
+def _check_channels_last(cfg: dict, name: str):
+    df = cfg.get("data_format", "channels_last")
+    if df != "channels_last":
+        raise UnsupportedKerasLayer(
+            f"Layer '{name}': data_format={df} not supported — this import "
+            "targets channels_last (NHWC is the TPU-native layout; convert "
+            "the model with Keras before exporting)"
+        )
+
+
+def _conv_mode(cfg: dict) -> str:
+    pad = cfg.get("padding", "valid")
+    if pad == "same":
+        return "same"
+    if pad in ("valid", "causal"):
+        if pad == "causal":
+            raise UnsupportedKerasLayer("causal conv padding not supported")
+        return "truncate"
+    raise UnsupportedKerasLayer(f"Unknown Keras padding {pad!r}")
+
+
+def _dense_tr(n_out: int) -> WeightTranslator:
+    def tr(w):
+        kernel = pick(w, "kernel")
+        bias = pick(w, "bias")
+        return {
+            "W": np.asarray(kernel, np.float32),
+            "b": np.zeros((n_out,), np.float32) if bias is None
+            else np.asarray(bias, np.float32),
+        }, {}
+
+    return tr
+
+
+# ------------------------------------------------------------------ core
+def _map_dense(cfg: dict) -> Mapped:
+    units = int(cfg["units"])
+    # use_bias=False imports as a zero bias (DenseLayer always carries b)
+    layer = DenseLayer(
+        n_out=units,
+        activation=map_activation(cfg.get("activation", "linear")),
+    )
+    return Mapped(layer=layer, translator=_dense_tr(units))
+
+
+def _map_activation_layer(cfg: dict) -> Mapped:
+    return Mapped(layer=ActivationLayer(activation=map_activation(cfg.get("activation"))))
+
+
+def _map_relu_layer(cfg: dict) -> Mapped:
+    # keras.layers.ReLU with optional max_value (ReLU6) / negative_slope
+    ns = float(cfg.get("negative_slope", 0.0) or 0.0)
+    th = float(cfg.get("threshold", 0.0) or 0.0)
+    mv = cfg.get("max_value")
+    if th != 0.0:
+        raise UnsupportedKerasLayer(f"ReLU threshold={th} unsupported")
+    if mv is not None and ns != 0.0:
+        raise UnsupportedKerasLayer("ReLU with both max_value and negative_slope")
+    if mv is not None:
+        if abs(float(mv) - 6.0) > 1e-6:
+            raise UnsupportedKerasLayer(f"ReLU max_value={mv} unsupported (only 6)")
+        return Mapped(layer=ActivationLayer(activation="relu6"))
+    if ns != 0.0:
+        return Mapped(layer=ActivationLayer(activation=f"leakyrelu({ns})"))
+    return Mapped(layer=ActivationLayer(activation="relu"))
+
+
+def _map_leaky_relu(cfg: dict) -> Mapped:
+    # Keras 2: alpha (default 0.3); Keras 3: negative_slope
+    alpha = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+    return Mapped(layer=ActivationLayer(activation=f"leakyrelu({float(alpha)})"))
+
+
+def _map_dropout(cfg: dict) -> Mapped:
+    return Mapped(layer=DropoutLayer(dropout=float(cfg.get("rate", 0.5))))
+
+
+def _map_flatten(cfg: dict) -> Mapped:
+    # NHWC C-order flatten == CnnToFeedForwardPreProcessor's reshape; in a
+    # sequential net the builder infers the preprocessor, in a graph a
+    # PreprocessorVertex carries it.
+    return Mapped(
+        vertex=PreprocessorVertex(CnnToFeedForwardPreProcessor()),
+        skip=True, is_flatten=True,
+    )
+
+
+def _map_reshape(cfg: dict) -> Mapped:
+    shape = [int(s) for s in cfg["target_shape"]]
+    return Mapped(vertex=ReshapeVertex([-1] + shape))
+
+
+# ------------------------------------------------------------- conv family
+def _map_conv2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "conv2d"))
+    filters = int(cfg["filters"])
+    layer = ConvolutionLayer(
+        n_out=filters,
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=map_activation(cfg.get("activation", "linear")),
+        has_bias=cfg.get("use_bias", True),
+    )
+
+    def tr(w):
+        p = {"W": np.asarray(pick(w, "kernel"), np.float32)}
+        if layer.has_bias:
+            b = pick(w, "bias")
+            p["b"] = (np.zeros((filters,), np.float32) if b is None
+                      else np.asarray(b, np.float32))
+        return p, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_conv1d(cfg: dict) -> Mapped:
+    filters = int(cfg["filters"])
+    layer = Convolution1DLayer(
+        n_out=filters,
+        kernel_size=int(_pair(cfg["kernel_size"])[0]),
+        stride=int(_pair(cfg.get("strides", 1))[0]),
+        convolution_mode=_conv_mode(cfg),
+        activation=map_activation(cfg.get("activation", "linear")),
+        has_bias=cfg.get("use_bias", True),
+    )
+
+    def tr(w):
+        kernel = np.asarray(pick(w, "kernel"), np.float32)  # (k, in, out)
+        p = {"W": kernel[:, None, :, :]}  # → (k, 1, in, out) HWIO
+        if layer.has_bias:
+            b = pick(w, "bias")
+            p["b"] = (np.zeros((filters,), np.float32) if b is None
+                      else np.asarray(b, np.float32))
+        return p, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_depthwise_conv2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "dw"))
+    mult = int(cfg.get("depth_multiplier", 1))
+    layer = DepthwiseConvolution2D(
+        depth_multiplier=mult,
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=map_activation(cfg.get("activation", "linear")),
+        has_bias=cfg.get("use_bias", True),
+    )
+
+    def tr(w):
+        k = np.asarray(
+            pick(w, "depthwise_kernel", "kernel"), np.float32
+        )  # (kh,kw,in,mult)
+        kh, kw, cin, m = k.shape
+        p = {"W": k.reshape(kh, kw, 1, cin * m)}
+        if layer.has_bias:
+            b = pick(w, "bias")
+            p["b"] = (np.zeros((cin * m,), np.float32) if b is None
+                      else np.asarray(b, np.float32))
+        return p, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_separable_conv2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "sep"))
+    filters = int(cfg["filters"])
+    layer = SeparableConvolution2D(
+        n_out=filters,
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=map_activation(cfg.get("activation", "linear")),
+        has_bias=cfg.get("use_bias", True),
+    )
+
+    def tr(w):
+        dk = np.asarray(pick(w, "depthwise_kernel"), np.float32)
+        pk = np.asarray(pick(w, "pointwise_kernel"), np.float32)
+        kh, kw, cin, m = dk.shape
+        p = {"dW": dk.reshape(kh, kw, 1, cin * m), "pW": pk}
+        if layer.has_bias:
+            b = pick(w, "bias")
+            p["b"] = (np.zeros((filters,), np.float32) if b is None
+                      else np.asarray(b, np.float32))
+        return p, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_conv2d_transpose(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "deconv"))
+    filters = int(cfg["filters"])
+    layer = Deconvolution2D(
+        n_out=filters,
+        kernel_size=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        convolution_mode=_conv_mode(cfg),
+        activation=map_activation(cfg.get("activation", "linear")),
+        has_bias=cfg.get("use_bias", True),
+    )
+
+    def tr(w):
+        p = {"W": np.asarray(pick(w, "kernel"), np.float32)}  # (kh,kw,out,in)
+        if layer.has_bias:
+            b = pick(w, "bias")
+            p["b"] = (np.zeros((filters,), np.float32) if b is None
+                      else np.asarray(b, np.float32))
+        return p, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+# ------------------------------------------------------------ pool family
+def _map_pool2d(cfg: dict, pooling_type: str) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "pool"))
+    return Mapped(layer=SubsamplingLayer(
+        pooling_type=pooling_type,
+        kernel_size=_pair(cfg.get("pool_size", 2)),
+        stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+        convolution_mode=_conv_mode(cfg),
+    ))
+
+
+def _map_pool1d(cfg: dict, pooling_type: str) -> Mapped:
+    size = cfg.get("pool_size", 2)
+    size = int(size[0] if isinstance(size, (list, tuple)) else size)
+    strides = cfg.get("strides") or size
+    strides = int(strides[0] if isinstance(strides, (list, tuple)) else strides)
+    return Mapped(layer=Subsampling1DLayer(
+        pooling_type=pooling_type, kernel_size=size, stride=strides,
+        convolution_mode=_conv_mode(cfg),
+    ))
+
+
+def _map_global_pool(cfg: dict, pooling_type: str) -> Mapped:
+    if cfg.get("keepdims"):
+        raise UnsupportedKerasLayer("GlobalPooling keepdims=True unsupported")
+    return Mapped(layer=GlobalPoolingLayer(pooling_type=pooling_type))
+
+
+# ----------------------------------------------------------------- norm
+def _map_batchnorm(cfg: dict) -> Mapped:
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0]
+    # framework BN always normalizes the trailing (channel) axis; -1 is the
+    # Keras 3 encoding, 3 the common Keras 2 channels_last rank-4 encoding
+    if axis not in (-1, 3):
+        raise UnsupportedKerasLayer(
+            f"BatchNormalization axis={axis} unsupported (channels-last only)"
+        )
+    layer = BatchNormalization(
+        eps=float(cfg.get("epsilon", 1e-3)),
+        decay=float(cfg.get("momentum", 0.99)),
+    )
+    scale = cfg.get("scale", True)
+    center = cfg.get("center", True)
+
+    def tr(w):
+        mean = pick(w, "moving_mean")
+        var = pick(w, "moving_variance")
+        n = mean.shape[0]
+        gamma = pick(w, "gamma") if scale else None
+        beta = pick(w, "beta") if center else None
+        params = {
+            "gamma": np.ones((n,), np.float32) if gamma is None
+            else np.asarray(gamma, np.float32),
+            "beta": np.zeros((n,), np.float32) if beta is None
+            else np.asarray(beta, np.float32),
+        }
+        state = {"mean": np.asarray(mean, np.float32),
+                 "var": np.asarray(var, np.float32)}
+        return params, state
+
+    return Mapped(layer=layer, translator=tr)
+
+
+# ------------------------------------------------------------- pad / crop
+def _map_zeropad2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "pad"))
+    p = cfg.get("padding", 1)
+    if isinstance(p, int):
+        pad = [p, p, p, p]
+    else:
+        (t, b), (l, r) = [_pair(q) for q in p]
+        pad = [t, b, l, r]
+    return Mapped(layer=ZeroPaddingLayer(pad=pad))
+
+
+def _map_zeropad1d(cfg: dict) -> Mapped:
+    p = cfg.get("padding", 1)
+    pad = _pair(p)
+    return Mapped(layer=ZeroPadding1DLayer(pad=pad))
+
+
+def _map_cropping2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "crop"))
+    c = cfg.get("cropping", 0)
+    if isinstance(c, int):
+        crop = [c, c, c, c]
+    else:
+        (t, b), (l, r) = [_pair(q) for q in c]
+        crop = [t, b, l, r]
+    return Mapped(layer=Cropping2D(crop=crop))
+
+
+def _map_upsampling2d(cfg: dict) -> Mapped:
+    _check_channels_last(cfg, cfg.get("name", "up"))
+    if cfg.get("interpolation", "nearest") != "nearest":
+        raise UnsupportedKerasLayer("UpSampling2D interpolation != nearest")
+    return Mapped(layer=Upsampling2D(size=_pair(cfg.get("size", 2))))
+
+
+def _map_upsampling1d(cfg: dict) -> Mapped:
+    size = cfg.get("size", 2)
+    return Mapped(layer=Upsampling1D(size=int(size)))
+
+
+# ------------------------------------------------------------- recurrent
+def _lstm_reorder(k: np.ndarray) -> np.ndarray:
+    """Keras gate order [i,f,g,o] → framework order [i,f,o,g] (last axis)."""
+    u = k.shape[-1] // 4
+    i, f, g, o = (k[..., j * u:(j + 1) * u] for j in range(4))
+    return np.concatenate([i, f, o, g], axis=-1)
+
+
+def _lstm_tr(prefix: Optional[str] = None) -> WeightTranslator:
+    def tr(w):
+        kernel = pick(w, "kernel", contains=prefix)
+        rec = pick(w, "recurrent_kernel", contains=prefix)
+        bias = pick(w, "bias", contains=prefix)
+        p = {
+            "Wx": _lstm_reorder(np.asarray(kernel, np.float32)),
+            "Wh": _lstm_reorder(np.asarray(rec, np.float32)),
+        }
+        p["b"] = (
+            np.zeros((kernel.shape[-1],), np.float32) if bias is None
+            else _lstm_reorder(np.asarray(bias, np.float32))
+        )
+        return p, {}
+
+    return tr
+
+
+def _build_lstm(cfg: dict) -> LSTM:
+    return LSTM(
+        n_out=int(cfg["units"]),
+        activation=map_activation(cfg.get("activation", "tanh")),
+        gate_activation=map_activation(cfg.get("recurrent_activation", "sigmoid")),
+    )
+
+
+def _map_lstm(cfg: dict) -> Mapped:
+    if cfg.get("go_backwards"):
+        raise UnsupportedKerasLayer("LSTM go_backwards=True unsupported")
+    inner = _build_lstm(cfg)
+    layer: Layer = inner
+    if not cfg.get("return_sequences", False):
+        layer = LastTimeStep(inner)
+    return Mapped(layer=layer, translator=_lstm_tr())
+
+
+def _map_simple_rnn(cfg: dict) -> Mapped:
+    if cfg.get("go_backwards"):
+        raise UnsupportedKerasLayer("SimpleRNN go_backwards=True unsupported")
+    inner = SimpleRnn(
+        n_out=int(cfg["units"]),
+        activation=map_activation(cfg.get("activation", "tanh")),
+    )
+    layer: Layer = inner
+    if not cfg.get("return_sequences", False):
+        layer = LastTimeStep(inner)
+
+    def tr(w):
+        return {
+            "Wx": np.asarray(pick(w, "kernel"), np.float32),
+            "Wh": np.asarray(pick(w, "recurrent_kernel"), np.float32),
+            "b": np.asarray(pick(w, "bias"), np.float32)
+            if pick(w, "bias") is not None
+            else np.zeros((int(cfg["units"]),), np.float32),
+        }, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_bidirectional(cfg: dict) -> Mapped:
+    inner_cfg = cfg["layer"]
+    inner_class = inner_cfg["class_name"]
+    ic = inner_cfg["config"]
+    if inner_class != "LSTM":
+        raise UnsupportedKerasLayer(f"Bidirectional({inner_class}) unsupported")
+    if not ic.get("return_sequences", False):
+        raise UnsupportedKerasLayer(
+            "Bidirectional(return_sequences=False) unsupported"
+        )
+    merge = {"concat": "concat", "sum": "add", "mul": "mul", "ave": "ave"}.get(
+        cfg.get("merge_mode", "concat")
+    )
+    if merge is None:
+        raise UnsupportedKerasLayer(f"merge_mode={cfg.get('merge_mode')} unsupported")
+    layer = Bidirectional(_build_lstm(ic), mode=merge)
+    fwd_tr, bwd_tr = _lstm_tr("forward"), _lstm_tr("backward")
+
+    def tr(w):
+        fp, _ = fwd_tr(w)
+        bp, _ = bwd_tr(w)
+        return {"fwd": fp, "bwd": bp}, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+def _map_embedding(cfg: dict) -> Mapped:
+    vocab, dim = int(cfg["input_dim"]), int(cfg["output_dim"])
+    layer = EmbeddingSequenceLayer(
+        n_in=vocab, n_out=dim, has_bias=False, activation="identity"
+    )
+
+    def tr(w):
+        emb = pick(w, "embeddings", "kernel")
+        return {"W": np.asarray(emb, np.float32)}, {}
+
+    return Mapped(layer=layer, translator=tr)
+
+
+# ----------------------------------------------------------------- merges
+def _map_merge_concat(cfg: dict) -> Mapped:
+    axis = cfg.get("axis", -1)
+    if axis not in (-1, None):
+        raise UnsupportedKerasLayer(f"Concatenate axis={axis} unsupported (only -1)")
+    return Mapped(vertex=MergeVertex())
+
+
+def _map_merge(op: str) -> Callable[[dict], Mapped]:
+    def f(cfg: dict) -> Mapped:
+        return Mapped(vertex=ElementWiseVertex(op))
+
+    return f
+
+
+MAPPERS: Dict[str, Callable[[dict], Mapped]] = {
+    "Dense": _map_dense,
+    "Activation": _map_activation_layer,
+    "ReLU": _map_relu_layer,
+    "LeakyReLU": _map_leaky_relu,
+    "ELU": lambda cfg: Mapped(layer=ActivationLayer(activation="elu")),
+    "Softmax": lambda cfg: Mapped(layer=ActivationLayer(activation="softmax")),
+    "ThresholdedReLU": lambda cfg: Mapped(
+        layer=ActivationLayer(activation="thresholdedrelu")),
+    "Dropout": _map_dropout,
+    "SpatialDropout1D": _map_dropout,
+    "SpatialDropout2D": _map_dropout,
+    "Flatten": _map_flatten,
+    "Reshape": _map_reshape,
+    "Conv1D": _map_conv1d,
+    "Convolution1D": _map_conv1d,
+    "Conv2D": _map_conv2d,
+    "Convolution2D": _map_conv2d,
+    "DepthwiseConv2D": _map_depthwise_conv2d,
+    "SeparableConv2D": _map_separable_conv2d,
+    "SeparableConvolution2D": _map_separable_conv2d,
+    "Conv2DTranspose": _map_conv2d_transpose,
+    "Deconvolution2D": _map_conv2d_transpose,
+    "MaxPooling2D": lambda cfg: _map_pool2d(cfg, "max"),
+    "AveragePooling2D": lambda cfg: _map_pool2d(cfg, "avg"),
+    "MaxPooling1D": lambda cfg: _map_pool1d(cfg, "max"),
+    "AveragePooling1D": lambda cfg: _map_pool1d(cfg, "avg"),
+    "GlobalMaxPooling2D": lambda cfg: _map_global_pool(cfg, "max"),
+    "GlobalAveragePooling2D": lambda cfg: _map_global_pool(cfg, "avg"),
+    "GlobalMaxPooling1D": lambda cfg: _map_global_pool(cfg, "max"),
+    "GlobalAveragePooling1D": lambda cfg: _map_global_pool(cfg, "avg"),
+    "BatchNormalization": _map_batchnorm,
+    "ZeroPadding2D": _map_zeropad2d,
+    "ZeroPadding1D": _map_zeropad1d,
+    "Cropping2D": _map_cropping2d,
+    "UpSampling2D": _map_upsampling2d,
+    "UpSampling1D": _map_upsampling1d,
+    "LSTM": _map_lstm,
+    "SimpleRNN": _map_simple_rnn,
+    "Bidirectional": _map_bidirectional,
+    "Embedding": _map_embedding,
+    "Add": _map_merge("add"),
+    "Subtract": _map_merge("subtract"),
+    "Multiply": _map_merge("product"),
+    "Average": _map_merge("average"),
+    "Maximum": _map_merge("max"),
+    "Concatenate": _map_merge_concat,
+    "Merge": _map_merge_concat,
+}
+
+
+def map_keras_layer(class_name: str, cfg: dict) -> Mapped:
+    fn = MAPPERS.get(class_name)
+    if fn is None:
+        raise UnsupportedKerasLayer(
+            f"No mapper for Keras layer class '{class_name}' "
+            f"(supported: {sorted(MAPPERS)})"
+        )
+    return fn(cfg)

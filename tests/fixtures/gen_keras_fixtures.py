@@ -1,0 +1,146 @@
+"""Generate Keras HDF5 golden fixtures for model-import tests.
+
+Mirrors the reference's fixture pattern: in-tree Python scripts produce
+HDF5 models + golden outputs that the import tests assert against
+(``deeplearning4j-modelimport/src/test/.../weights/scripts/``, the 11
+in-tree .py files; SURVEY.md §4.7).
+
+Run once (Keras 3 / TF backend, both baked in the image):
+    python tests/fixtures/gen_keras_fixtures.py
+Writes <name>.h5 + <name>_golden.npz (input, output) next to this file.
+Models are tiny (fixed seeds) so the fixtures stay a few hundred KB.
+"""
+
+import os
+import sys
+
+os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+os.environ.setdefault("KERAS_BACKEND", "tensorflow")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "keras")
+
+
+def main():
+    import numpy as np
+    import keras
+    from keras import layers
+
+    os.makedirs(OUT, exist_ok=True)
+    rng = np.random.default_rng(1234)
+
+    def save(name, model, x):
+        keras.utils.set_random_seed(0)
+        path = os.path.join(OUT, f"{name}.h5")
+        model.save(path)
+        y = model.predict(x, verbose=0)
+        np.savez(os.path.join(OUT, f"{name}_golden.npz"), x=x, y=y)
+        print(f"{name}: {path} ({os.path.getsize(path)//1024} KB), out {y.shape}")
+
+    keras.utils.set_random_seed(7)
+
+    # 1. Sequential MLP
+    m = keras.Sequential([
+        keras.Input((12,)),
+        layers.Dense(16, activation="relu"),
+        layers.Dense(8, activation="tanh"),
+        layers.Dense(4, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    save("mlp", m, rng.standard_normal((5, 12)).astype(np.float32))
+
+    # 2. Sequential CNN (conv/bn/pool/flatten/dense) — LeNet-ish
+    m = keras.Sequential([
+        keras.Input((12, 12, 3)),
+        layers.Conv2D(8, 3, activation="relu", padding="same"),
+        layers.BatchNormalization(),
+        layers.MaxPooling2D(2),
+        layers.Conv2D(12, 3, padding="valid", strides=2),
+        layers.ReLU(),
+        layers.Flatten(),
+        layers.Dense(6, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    # give BN non-trivial moving stats
+    m.fit(rng.standard_normal((32, 12, 12, 3)).astype(np.float32),
+          np.eye(6, dtype=np.float32)[rng.integers(0, 6, 32)],
+          epochs=1, verbose=0)
+    save("cnn", m, rng.standard_normal((4, 12, 12, 3)).astype(np.float32))
+
+    # 3. Sequential LSTM classifier (return_sequences False → last step)
+    m = keras.Sequential([
+        keras.Input((7, 5)),
+        layers.LSTM(9, return_sequences=True),
+        layers.LSTM(6, return_sequences=False),
+        layers.Dense(3, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    save("lstm", m, rng.standard_normal((4, 7, 5)).astype(np.float32))
+
+    # 4. Functional model with merge vertices (residual + concat)
+    inp = keras.Input((10,), name="in0")
+    a = layers.Dense(8, activation="relu", name="fa")(inp)
+    b = layers.Dense(8, activation="tanh", name="fb")(inp)
+    s = layers.Add(name="fadd")([a, b])
+    c = layers.Concatenate(name="fcat")([s, a])
+    out = layers.Dense(4, activation="softmax", name="fout")(c)
+    m = keras.Model(inp, out)
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    save("functional", m, rng.standard_normal((6, 10)).astype(np.float32))
+
+    # 5. MobileNet-flavored CNN: depthwise-separable stack + BN + relu6 +
+    #    global pool (BASELINE config #4's MobileNet import, miniaturized)
+    m = keras.Sequential([
+        keras.Input((16, 16, 3)),
+        layers.Conv2D(8, 3, strides=2, padding="same", use_bias=False),
+        layers.BatchNormalization(),
+        layers.ReLU(max_value=6.0),
+        layers.DepthwiseConv2D(3, padding="same", use_bias=False),
+        layers.BatchNormalization(),
+        layers.ReLU(max_value=6.0),
+        layers.Conv2D(16, 1, padding="same", use_bias=False),
+        layers.BatchNormalization(),
+        layers.ReLU(max_value=6.0),
+        layers.SeparableConv2D(16, 3, padding="same"),
+        layers.GlobalAveragePooling2D(),
+        layers.Dense(5, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    m.fit(rng.standard_normal((32, 16, 16, 3)).astype(np.float32),
+          np.eye(5, dtype=np.float32)[rng.integers(0, 5, 32)],
+          epochs=1, verbose=0)
+    save("mobilenet_mini", m, rng.standard_normal((4, 16, 16, 3)).astype(np.float32))
+
+    # 6. Inception-flavored functional CNN: parallel conv towers + concat
+    #    (BASELINE config #4's InceptionV3 import, miniaturized)
+    inp = keras.Input((14, 14, 4), name="img")
+    t1 = layers.Conv2D(6, 1, padding="same", activation="relu", name="t1c")(inp)
+    t2 = layers.Conv2D(4, 1, padding="same", activation="relu", name="t2a")(inp)
+    t2 = layers.Conv2D(6, 3, padding="same", activation="relu", name="t2b")(t2)
+    t3 = layers.MaxPooling2D(3, strides=1, padding="same", name="t3p")(inp)
+    t3 = layers.Conv2D(6, 1, padding="same", activation="relu", name="t3c")(t3)
+    cat = layers.Concatenate(name="cat")([t1, t2, t3])
+    bn = layers.BatchNormalization(name="bn")(cat)
+    gp = layers.GlobalAveragePooling2D(name="gap")(bn)
+    out = layers.Dense(3, activation="softmax", name="cls")(gp)
+    m = keras.Model(inp, out)
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    m.fit(rng.standard_normal((16, 14, 14, 4)).astype(np.float32),
+          np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)],
+          epochs=1, verbose=0)
+    save("inception_mini", m, rng.standard_normal((4, 14, 14, 4)).astype(np.float32))
+
+    # 7. Embedding + bidirectional LSTM text classifier
+    m = keras.Sequential([
+        keras.Input((9,)),
+        layers.Embedding(20, 6),
+        layers.Bidirectional(layers.LSTM(5, return_sequences=True)),
+        layers.GlobalMaxPooling1D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="sgd")
+    save("text_bilstm", m, rng.integers(0, 20, (4, 9)).astype(np.float32))
+
+
+if __name__ == "__main__":
+    main()
