@@ -134,8 +134,9 @@ def _bench_allreduce(devices, mb: float = 256.0):
     bandwidth = 2*(n-1)/n * bytes / time (ring allreduce convention)."""
     import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    shard_map = jax.shard_map
 
     n = len(devices)
     n_elem = int(mb * 1e6 / 4)
@@ -189,9 +190,22 @@ def main():
         "n_devices": len(devices),
         "platform": devices[0].platform,
     }
+    # MFU vs chip peak: ResNet-50 fwd ≈ 4.1 GFLOP/img @224, train ≈ 3×fwd.
+    # Peak default 197 TFLOP/s (v5e bf16); override via BENCH_PEAK_TFLOPS.
+    import os
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+    flops_per_img = 3 * 4.1e9
+    extra["mfu_pct"] = round(
+        100.0 * img_per_sec * flops_per_img / (peak_tflops * 1e12), 2
+    )
+    extra["mfu_assumed_peak_tflops"] = peak_tflops
     try:
         gbps, n = _bench_allreduce(devices)
         extra["allreduce_algbw_gbps"] = gbps
+        if n == 1:
+            # a 1-device psum measures HBM copy bandwidth, not ICI — flag
+            # so the number is never misread as an interconnect result
+            extra["allreduce_degenerate_single_device"] = True
     except Exception as e:
         extra["allreduce_error"] = f"{type(e).__name__}: {e}"
 

@@ -125,8 +125,12 @@ class KerasModelImport:
 
     # ------------------------------------------------------------ sequential
     @staticmethod
-    def import_keras_sequential_model_and_weights(path: str):
-        """→ MultiLayerNetwork with copied weights."""
+    def import_keras_sequential_model_and_weights(
+        path: str, compute_dtype: Optional[str] = None
+    ):
+        """→ MultiLayerNetwork with copied weights. ``compute_dtype``
+        ("bfloat16") enables mixed-precision inference/fine-tuning on the
+        imported net; weights stay fp32 master copies."""
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         with Hdf5Archive(path) as ar:
@@ -163,7 +167,10 @@ class KerasModelImport:
             head, extra_loss = _output_head(last_m.layer, tc_loss)
             last_m.layer = head
 
-            lb = NeuralNetConfiguration.builder().seed(0).list()
+            nb = NeuralNetConfiguration.builder().seed(0)
+            if compute_dtype is not None:
+                nb = nb.compute_dtype(compute_dtype)
+            lb = nb.list()
             index_of: Dict[str, int] = {}
             idx = 0
             for n, m in mapped:
@@ -208,7 +215,9 @@ class KerasModelImport:
 
     # ------------------------------------------------------------ functional
     @staticmethod
-    def import_keras_model_and_weights(path: str):
+    def import_keras_model_and_weights(
+        path: str, compute_dtype: Optional[str] = None
+    ):
         """→ ComputationGraph (functional) or MultiLayerNetwork (sequential),
         matching the reference's type dispatch."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -216,7 +225,9 @@ class KerasModelImport:
         with Hdf5Archive(path) as ar:
             cfg = ar.model_config()
             if cfg["class_name"] == "Sequential":
-                return KerasModelImport.import_keras_sequential_model_and_weights(path)
+                return KerasModelImport.import_keras_sequential_model_and_weights(
+                    path, compute_dtype=compute_dtype
+                )
             tc_loss = _loss_from_training_config(ar.training_config())
             gconf = cfg["config"]
             layer_cfgs = gconf["layers"]
@@ -248,8 +259,11 @@ class KerasModelImport:
 
             out_names = norm_outputs(gconf["output_layers"])
 
+            nb = NeuralNetConfiguration.builder().seed(0)
+            if compute_dtype is not None:
+                nb = nb.compute_dtype(compute_dtype)
             gb = (
-                NeuralNetConfiguration.builder().seed(0).graph_builder()
+                nb.graph_builder()
                 .add_inputs(*inputs)
                 .set_input_types(*input_types)
             )

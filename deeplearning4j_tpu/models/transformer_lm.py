@@ -202,13 +202,16 @@ class TransformerLM(ZooModel):
     def generate(self, prompt_ids: np.ndarray, max_new: int = 20,
                  temperature: float = 0.0, rng=None) -> np.ndarray:
         """Greedy/temperature sampling continuation (host loop; each step
-        re-runs the jitted forward on the growing prefix)."""
+        re-runs the jitted forward on the growing prefix). Contexts longer
+        than ``cfg.max_length`` are windowed to the most recent
+        ``max_length`` tokens — the positional table bounds the forward."""
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for _ in range(max_new):
-            logits = self.logits(ids)[:, -1]
+            window = ids[:, -self.cfg.max_length:]
+            logits = self.logits(window)[:, -1]
             if temperature <= 0:
                 nxt = logits.argmax(-1).astype(np.int32)
             else:

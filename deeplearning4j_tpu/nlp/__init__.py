@@ -1,0 +1,48 @@
+"""NLP embeddings suite — TPU-native rebuild of the reference's
+deeplearning4j-nlp module (SURVEY.md §2.7: SequenceVectors/Word2Vec/
+ParagraphVectors/GloVe + text pipeline, 26,216 LoC reference).
+
+Design (SURVEY.md §7 hard-part 6 — sparse/hash workloads are hostile to
+XLA's static shapes): all learning runs as FIXED-SIZE batched device steps
+— (batch,) center ids, (batch,) context ids, (batch, K) sampled negatives,
+(batch, L) padded Huffman code paths — with scatter-add parameter updates
+inside one jitted program. The reference's per-pair JNI "aggregate" kernels
+(``AggregateSkipGram``, ``SkipGram.java:156-187``) become one MXU-friendly
+gather→dot→scatter step; HogWild thread races are replaced by synchronous
+batch updates (duplicate indices accumulate correctly through scatter-add).
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.stopwords import StopWords
+from deeplearning4j_tpu.nlp.vocab import (
+    AbstractCache,
+    Huffman,
+    VocabConstructor,
+    VocabWord,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.bagofwords import (
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)
+
+__all__ = [
+    "CommonPreprocessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "BasicLineIterator", "CollectionSentenceIterator", "FileSentenceIterator",
+    "StopWords", "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
+    "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",
+    "WordVectorSerializer", "BagOfWordsVectorizer", "TfidfVectorizer",
+]

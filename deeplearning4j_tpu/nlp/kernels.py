@@ -1,0 +1,230 @@
+"""Jitted batched skip-gram / CBOW device steps.
+
+The reference trains word2vec through per-pair native "aggregate" kernels
+batched over JNI (``SkipGram.java:156-187``: ``AggregateSkipGram`` pushed
+to ``Nd4j.getExecutioner().exec(batches)``) with HogWild-racy updates
+across threads. The TPU-native shape (SURVEY.md §7 hard-part 6, §9 build
+plan "Pallas or XLA-scatter skip-gram kernel"):
+
+- training pairs are packed on host into FIXED-SIZE rectangular batches
+  (static shapes → one compiled program for the whole run);
+- negatives are sampled ON DEVICE from the unigram^0.75 table via inverse
+  CDF (searchsorted over a cumulative table — O(log V) vectorized lookup);
+- the classic word2vec SGD deltas are computed in closed form (no dense
+  (V, D) gradient is ever materialized) and applied with scatter-add —
+  duplicate indices within a batch accumulate, which replaces HogWild
+  with a deterministic equivalent;
+- everything (gather → MXU dots → scatter) is ONE jitted XLA program with
+  donated embedding buffers.
+
+All kernels take and return (syn0, syn1, syn1neg) so skip-gram/CBOW and
+hierarchical-softmax/negative-sampling compose freely, matching the
+reference's configuration matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# --------------------------------------------------------------------------
+# negative sampling
+# --------------------------------------------------------------------------
+def sample_negatives(rng: Array, cdf: Array, shape) -> Array:
+    """Draw word ids ~ unigram^0.75 via inverse-CDF (reference builds a
+    100M-slot resampled int table, ``InMemoryLookupTable.java``; the CDF
+    search is the compact TPU equivalent)."""
+    u = jax.random.uniform(rng, shape, minval=0.0, maxval=1.0)
+    return jnp.searchsorted(cdf, u).astype(jnp.int32)
+
+
+def make_unigram_cdf(counts) -> jnp.ndarray:
+    p = jnp.asarray(counts, jnp.float32) ** 0.75
+    p = p / jnp.sum(p)
+    return jnp.cumsum(p)
+
+
+# --------------------------------------------------------------------------
+# skip-gram
+# --------------------------------------------------------------------------
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(10,))
+def skipgram_step(
+    syn0: Array,          # (V, D) input embeddings
+    syn1: Array,          # (Vi, D) HS inner-node weights ((1,D) dummy if unused)
+    syn1neg: Array,       # (V, D) NS output weights ((1,D) dummy if unused)
+    centers: Array,       # (B,) int32
+    contexts: Array,      # (B,) int32
+    mask: Array,          # (B,) 1.0 valid / 0.0 pad
+    codes: Array,         # (B, L) int8 Huffman codes of the CONTEXT word
+    points: Array,        # (B, L) int32 inner-node ids
+    code_mask: Array,     # (B, L) float
+    cdf: Array,           # (V,) unigram^0.75 CDF
+    negative: int,        # static: number of negative samples (0 = HS only)
+    lr: Array,            # scalar learning rate
+    rng: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """One batched skip-gram update; returns new (syn0, syn1, syn1neg,
+    mean_loss). Matches word2vec semantics: predict CONTEXT from CENTER —
+    v = syn0[center] is pulled toward the context word's output vector."""
+    v = syn0[centers]                                     # (B, D)
+    d_v = jnp.zeros_like(v)
+    loss = jnp.zeros((), jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+
+    if negative > 0:
+        B = centers.shape[0]
+        negs = sample_negatives(rng, cdf, (B, negative))  # (B, K)
+        # reference resamples a colliding negative; masking it out is the
+        # batched equivalent (same expectation, static shape)
+        neg_valid = (negs != contexts[:, None]).astype(v.dtype) * mask[:, None]
+        u_pos = syn1neg[contexts]                         # (B, D)
+        u_neg = syn1neg[negs]                             # (B, K, D)
+        s_pos = sigmoid(jnp.sum(v * u_pos, -1))           # (B,)
+        s_neg = sigmoid(jnp.einsum("bd,bkd->bk", v, u_neg))
+        g_pos = (s_pos - 1.0) * mask                      # (B,)
+        g_neg = s_neg * neg_valid                         # (B, K)
+        d_v = d_v + g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+        d_u_pos = g_pos[:, None] * v                      # (B, D)
+        d_u_neg = g_neg[..., None] * v[:, None, :]        # (B, K, D)
+        syn1neg = syn1neg.at[contexts].add(-lr * d_u_pos)
+        syn1neg = syn1neg.at[negs.reshape(-1)].add(
+            -lr * d_u_neg.reshape(-1, v.shape[-1])
+        )
+        eps = 1e-7
+        loss = loss + jnp.sum(
+            -jnp.log(s_pos + eps) * mask
+            - jnp.sum(jnp.log(1.0 - s_neg + eps) * neg_valid, -1)
+        )
+
+    if codes.shape[1] > 0:  # hierarchical softmax branch (static)
+        u = syn1[points]                                  # (B, L, D)
+        s = sigmoid(jnp.einsum("bd,bld->bl", v, u))       # (B, L)
+        # word2vec: label = 1 - code
+        g = (s - (1.0 - codes.astype(s.dtype))) * code_mask * mask[:, None]
+        d_v = d_v + jnp.einsum("bl,bld->bd", g, u)
+        d_u = g[..., None] * v[:, None, :]                # (B, L, D)
+        syn1 = syn1.at[points.reshape(-1)].add(
+            -lr * d_u.reshape(-1, v.shape[-1])
+        )
+        eps = 1e-7
+        lbl = 1.0 - codes.astype(s.dtype)
+        p_correct = lbl * s + (1.0 - lbl) * (1.0 - s)
+        loss = loss + jnp.sum(-jnp.log(p_correct + eps) * code_mask * mask[:, None])
+
+    syn0 = syn0.at[centers].add(-lr * d_v * mask[:, None])
+    return syn0, syn1, syn1neg, loss / denom
+
+
+# --------------------------------------------------------------------------
+# CBOW
+# --------------------------------------------------------------------------
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(11,))
+def cbow_step(
+    syn0: Array,
+    syn1: Array,
+    syn1neg: Array,
+    contexts: Array,      # (B, W) int32 window word ids (0-padded)
+    ctx_mask: Array,      # (B, W) float
+    targets: Array,       # (B,) int32 center word to predict
+    mask: Array,          # (B,)
+    codes: Array,         # (B, L) Huffman codes of the TARGET word
+    points: Array,
+    code_mask: Array,
+    cdf: Array,
+    negative: int,
+    lr: Array,
+    rng: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """Batched CBOW: mean of context vectors predicts the center word
+    (reference ``CBOW.java`` aggregate). The input-side delta is
+    broadcast back to every (unpadded) context position."""
+    ctx_vecs = syn0[contexts]                              # (B, W, D)
+    n_ctx = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
+    h = jnp.sum(ctx_vecs * ctx_mask[..., None], 1) / n_ctx  # (B, D)
+    d_h = jnp.zeros_like(h)
+    loss = jnp.zeros((), jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    eps = 1e-7
+
+    if negative > 0:
+        B = targets.shape[0]
+        negs = sample_negatives(rng, cdf, (B, negative))
+        neg_valid = (negs != targets[:, None]).astype(h.dtype) * mask[:, None]
+        u_pos = syn1neg[targets]
+        u_neg = syn1neg[negs]
+        s_pos = sigmoid(jnp.sum(h * u_pos, -1))
+        s_neg = sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+        g_pos = (s_pos - 1.0) * mask
+        g_neg = s_neg * neg_valid
+        d_h = d_h + g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+        syn1neg = syn1neg.at[targets].add(-lr * g_pos[:, None] * h)
+        syn1neg = syn1neg.at[negs.reshape(-1)].add(
+            (-lr * g_neg[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+        )
+        loss = loss + jnp.sum(
+            -jnp.log(s_pos + eps) * mask
+            - jnp.sum(jnp.log(1.0 - s_neg + eps) * neg_valid, -1)
+        )
+
+    if codes.shape[1] > 0:
+        u = syn1[points]
+        s = sigmoid(jnp.einsum("bd,bld->bl", h, u))
+        g = (s - (1.0 - codes.astype(s.dtype))) * code_mask * mask[:, None]
+        d_h = d_h + jnp.einsum("bl,bld->bd", g, u)
+        syn1 = syn1.at[points.reshape(-1)].add(
+            (-lr * g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+        )
+        lbl = 1.0 - codes.astype(s.dtype)
+        p_correct = lbl * s + (1.0 - lbl) * (1.0 - s)
+        loss = loss + jnp.sum(-jnp.log(p_correct + eps) * code_mask * mask[:, None])
+
+    # distribute d_h to every context position (divided by window count,
+    # matching the mean in the forward)
+    d_ctx = (d_h / n_ctx)[:, None, :] * ctx_mask[..., None] * mask[:, None, None]
+    syn0 = syn0.at[contexts.reshape(-1)].add(
+        -lr * d_ctx.reshape(-1, h.shape[-1])
+    )
+    return syn0, syn1, syn1neg, loss / denom
+
+
+# --------------------------------------------------------------------------
+# inference step for ParagraphVectors.infer_vector: train ONLY a fresh doc
+# vector against frozen word weights
+# --------------------------------------------------------------------------
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
+def dbow_infer_step(
+    doc_vec: Array,       # (D,) the trainable document vector
+    syn1neg: Array,       # frozen
+    targets: Array,       # (B,) word ids in the document
+    mask: Array,
+    cdf: Array,
+    lr: Array,
+    rng: Array,
+    negative: int,
+) -> Tuple[Array, Array]:
+    B = targets.shape[0]
+    negs = sample_negatives(rng, cdf, (B, negative))
+    neg_valid = (negs != targets[:, None]).astype(doc_vec.dtype) * mask[:, None]
+    u_pos = syn1neg[targets]
+    u_neg = syn1neg[negs]
+    s_pos = sigmoid(u_pos @ doc_vec)
+    s_neg = sigmoid(jnp.einsum("d,bkd->bk", doc_vec, u_neg))
+    g_pos = (s_pos - 1.0) * mask
+    g_neg = s_neg * neg_valid
+    d_v = jnp.einsum("b,bd->d", g_pos, u_pos) + jnp.einsum("bk,bkd->d", g_neg, u_neg)
+    eps = 1e-7
+    loss = jnp.sum(
+        -jnp.log(s_pos + eps) * mask
+        - jnp.sum(jnp.log(1.0 - s_neg + eps) * neg_valid, -1)
+    ) / jnp.maximum(mask.sum(), 1.0)
+    return doc_vec - lr * d_v, loss

@@ -1,0 +1,159 @@
+"""Sentence/document iterators.
+
+Reference: ``text/sentenceiterator/*`` (BasicLineIterator,
+CollectionSentenceIterator, FileSentenceIterator) and the label-aware
+variants used by ParagraphVectors (``text/documentiterator/*``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Tuple
+
+
+class SentencePreProcessor:
+    def pre_process(self, sentence: str) -> str:
+        raise NotImplementedError
+
+
+class SentenceIterator:
+    """Reference ``SentenceIterator``: nextSentence/hasNext/reset, with an
+    optional sentence preprocessor. Python iteration is also supported."""
+
+    def __init__(self):
+        self.preprocessor: Optional[SentencePreProcessor] = None
+
+    def set_pre_processor(self, pre: SentencePreProcessor) -> None:
+        self.preprocessor = pre
+
+    def _apply(self, s: str) -> str:
+        return self.preprocessor.pre_process(s) if self.preprocessor else s
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        super().__init__()
+        self._sentences: List[str] = list(sentences)
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._sentences)
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._pos]
+        self._pos += 1
+        return self._apply(s)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file (reference
+    ``BasicLineIterator.java``); streams, does not hold the corpus in
+    memory."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._fh = None
+        self._next: Optional[str] = None
+        self.reset()
+
+    def _advance(self):
+        line = self._fh.readline()
+        self._next = None if line == "" else line.rstrip("\n")
+
+    def has_next(self) -> bool:
+        return self._next is not None
+
+    def next_sentence(self) -> str:
+        s = self._next
+        self._advance()
+        return self._apply(s)
+
+    def reset(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(self.path, "r", encoding="utf-8", errors="replace")
+        self._advance()
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All files under a directory, one sentence per line (reference
+    ``FileSentenceIterator.java``)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.files: List[str] = []
+        if os.path.isdir(root):
+            for dirpath, _, names in os.walk(root):
+                for n in sorted(names):
+                    self.files.append(os.path.join(dirpath, n))
+        else:
+            self.files = [root]
+        self._lines: List[str] = []
+        self._pos = 0
+        self.reset()
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._lines)
+
+    def next_sentence(self) -> str:
+        s = self._lines[self._pos]
+        self._pos += 1
+        return self._apply(s)
+
+    def reset(self) -> None:
+        self._lines = []
+        for f in self.files:
+            with open(f, "r", encoding="utf-8", errors="replace") as fh:
+                self._lines.extend(line.rstrip("\n") for line in fh)
+        self._pos = 0
+
+
+class LabelledDocument:
+    """(content, labels) pair (reference ``LabelledDocument``)."""
+
+    def __init__(self, content: str, labels: List[str]):
+        self.content = content
+        self.labels = list(labels)
+
+
+class LabelAwareIterator:
+    """Document iterator with labels, for ParagraphVectors (reference
+    ``LabelAwareIterator``)."""
+
+    def __init__(self, documents: Iterable[Tuple[str, List[str]]]):
+        self._docs = [LabelledDocument(c, l) for c, l in documents]
+        self._pos = 0
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_document()
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._docs)
+
+    def next_document(self) -> LabelledDocument:
+        d = self._docs[self._pos]
+        self._pos += 1
+        return d
+
+    def reset(self) -> None:
+        self._pos = 0

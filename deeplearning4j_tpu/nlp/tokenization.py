@@ -1,0 +1,100 @@
+"""Tokenization pipeline.
+
+Reference: ``text/tokenization/tokenizer/*`` +
+``tokenizerfactory/DefaultTokenizerFactory.java`` — a Tokenizer walks one
+sentence's tokens, a TokenizerFactory creates tokenizers and carries an
+optional TokenPreProcess applied to every token.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    """SPI: normalize a single token (reference ``TokenPreProcess``)."""
+
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (reference
+    ``CommonPreprocessor.java`` semantics)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token).lower()
+
+
+class LowCasePreProcessor(TokenPreProcess):
+    def pre_process(self, token: str) -> str:
+        return token.lower()
+
+
+class Tokenizer:
+    """One sentence's token stream (reference ``Tokenizer`` interface:
+    hasMoreTokens/nextToken/getTokens)."""
+
+    def __init__(self, tokens: List[str],
+                 preprocessor: Optional[TokenPreProcess] = None):
+        self._tokens = tokens
+        self._pre = preprocessor
+        self._pos = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._pos < len(self._tokens)
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._pos]
+        self._pos += 1
+        return self._pre.pre_process(t) if self._pre else t
+
+    def get_tokens(self) -> List[str]:
+        out = [self._pre.pre_process(t) if self._pre else t for t in self._tokens]
+        return [t for t in out if t]
+
+
+class TokenizerFactory:
+    def create(self, sentence: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def set_token_pre_processor(self, pre: TokenPreProcess) -> None:
+        self._preprocessor = pre
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenizer (reference ``DefaultTokenizerFactory`` wraps
+    Java's StreamTokenizer; whitespace split matches its observable output
+    for normal text)."""
+
+    def __init__(self):
+        self._preprocessor: Optional[TokenPreProcess] = None
+
+    def create(self, sentence: str) -> Tokenizer:
+        return Tokenizer(sentence.split(), self._preprocessor)
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """n-gram expansion over a base tokenizer (reference
+    ``NGramTokenizerFactory.java``): emits all n-grams with
+    min_n ≤ n ≤ max_n, joined by spaces."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        self.base = base
+        self.min_n = min_n
+        self.max_n = max_n
+        self._preprocessor: Optional[TokenPreProcess] = None
+
+    def create(self, sentence: str) -> Tokenizer:
+        toks = self.base.create(sentence).get_tokens()
+        grams: List[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(0, len(toks) - n + 1):
+                grams.append(" ".join(toks[i:i + n]))
+        return Tokenizer(grams, self._preprocessor)

@@ -1,0 +1,210 @@
+"""Vocabulary store, constructor, and Huffman coding.
+
+Reference: ``models/word2vec/VocabWord.java``,
+``wordstore/inmemory/AbstractCache.java`` (word↔index↔count store),
+``wordstore/VocabConstructor.java`` (corpus scan → counts → pruning →
+Huffman), ``wordstore/inmemory/Huffman.java`` (binary-tree code
+assignment used by hierarchical softmax).
+
+The Huffman artifacts are stored as PADDED numpy arrays — ``codes``
+(V, L) in {0,1} and ``points`` (V, L) inner-node ids with a length
+vector — because the device step needs rectangular tensors
+(SURVEY.md §7 hard-part 6).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class VocabWord:
+    """One vocabulary element (reference ``VocabWord.java``): surface
+    form, frequency, index, Huffman code path."""
+
+    def __init__(self, word: str, count: float = 1.0):
+        self.word = word
+        self.count = float(count)
+        self.index = -1
+        self.codes: List[int] = []
+        self.points: List[int] = []
+
+    def increment(self, by: float = 1.0):
+        self.count += by
+
+    def __repr__(self):
+        return f"VocabWord({self.word!r}, count={self.count}, idx={self.index})"
+
+
+class AbstractCache:
+    """In-memory vocab cache (reference ``AbstractCache.java``):
+    word↔VocabWord↔index maps plus corpus totals."""
+
+    def __init__(self):
+        self._by_word: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_occurrences = 0.0
+
+    # -- mutation -----------------------------------------------------------
+    def add_token(self, vw: VocabWord):
+        ex = self._by_word.get(vw.word)
+        if ex is None:
+            self._by_word[vw.word] = vw
+        else:
+            ex.increment(vw.count)
+
+    def increment_word_count(self, word: str, by: float = 1.0):
+        vw = self._by_word.get(word)
+        if vw is None:
+            self.add_token(VocabWord(word, by))
+        else:
+            vw.increment(by)
+        self.total_word_occurrences += by
+
+    def update_indices(self):
+        """Assign indices by descending frequency (word2vec convention —
+        frequent words first keeps the negative-sampling table compact)."""
+        self._by_index = sorted(
+            self._by_word.values(), key=lambda v: (-v.count, v.word)
+        )
+        for i, vw in enumerate(self._by_index):
+            vw.index = i
+
+    def remove_below(self, min_count: float):
+        kept = {w: v for w, v in self._by_word.items() if v.count >= min_count}
+        self._by_word = kept
+        self.update_indices()
+
+    # -- queries ------------------------------------------------------------
+    def contains_word(self, word: str) -> bool:
+        return word in self._by_word
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._by_word.get(word)
+
+    def word_frequency(self, word: str) -> float:
+        vw = self._by_word.get(word)
+        return vw.count if vw else 0.0
+
+    def index_of(self, word: str) -> int:
+        vw = self._by_word.get(word)
+        return vw.index if vw else -1
+
+    def word_at_index(self, index: int) -> Optional[str]:
+        if 0 <= index < len(self._by_index):
+            return self._by_index[index].word
+        return None
+
+    def element_at_index(self, index: int) -> Optional[VocabWord]:
+        if 0 <= index < len(self._by_index):
+            return self._by_index[index]
+        return None
+
+    def num_words(self) -> int:
+        return len(self._by_word)
+
+    def words(self) -> List[str]:
+        return [v.word for v in self._by_index]
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._by_index)
+
+    def counts(self) -> np.ndarray:
+        return np.asarray([v.count for v in self._by_index], np.float64)
+
+    def __len__(self):
+        return len(self._by_word)
+
+
+class Huffman:
+    """Huffman-tree code assignment over vocab frequencies (reference
+    ``Huffman.java``): frequent words get short codes; the path's inner
+    nodes are the hierarchical-softmax output rows."""
+
+    def __init__(self, vocab: AbstractCache):
+        self.vocab = vocab
+        self.max_code_length = 0
+
+    def build(self):
+        words = self.vocab.vocab_words()
+        V = len(words)
+        if V == 0:
+            return self
+        # heap of (count, tiebreak, node_id); leaves are 0..V-1, inner
+        # nodes V..2V-2
+        heap = [(w.count, i, i) for i, w in enumerate(words)]
+        heapq.heapify(heap)
+        parent = {}
+        binary = {}
+        next_id = V
+        while len(heap) > 1:
+            c1, _, n1 = heapq.heappop(heap)
+            c2, _, n2 = heapq.heappop(heap)
+            parent[n1] = next_id
+            parent[n2] = next_id
+            binary[n1] = 0
+            binary[n2] = 1
+            heapq.heappush(heap, (c1 + c2, next_id, next_id))
+            next_id += 1
+        root = heap[0][2] if heap else None
+        for i, w in enumerate(words):
+            code, points = [], []
+            node = i
+            while node != root:
+                code.append(binary[node])
+                node = parent[node]
+                # inner node id → syn1 row (root = 2V-2 maps to row V-2)
+                points.append(node - V)
+            code.reverse()
+            points.reverse()
+            w.codes = code
+            w.points = points
+            self.max_code_length = max(self.max_code_length, len(code))
+        return self
+
+    def padded_arrays(self):
+        """(codes (V,L) int8, points (V,L) int32, lengths (V,) int32) —
+        rectangular views for the device step; pad rows use point 0 with a
+        zero mask via lengths."""
+        words = self.vocab.vocab_words()
+        V, L = len(words), max(self.max_code_length, 1)
+        codes = np.zeros((V, L), np.int8)
+        points = np.zeros((V, L), np.int32)
+        lengths = np.zeros((V,), np.int32)
+        for i, w in enumerate(words):
+            n = len(w.codes)
+            lengths[i] = n
+            codes[i, :n] = w.codes
+            points[i, :n] = w.points
+        return codes, points, lengths
+
+
+class VocabConstructor:
+    """Corpus scan → counts → prune → indices → Huffman (reference
+    ``VocabConstructor.java`` single-source path)."""
+
+    def __init__(self, min_word_frequency: int = 1,
+                 stop_words: Optional[Iterable[str]] = None,
+                 limit_vocabulary_size: int = 0):
+        self.min_word_frequency = min_word_frequency
+        self.stop_words = set(stop_words or [])
+        self.limit = limit_vocabulary_size
+
+    def build_joint_vocabulary(self, token_streams: Iterable[List[str]],
+                               build_huffman: bool = True) -> AbstractCache:
+        cache = AbstractCache()
+        for tokens in token_streams:
+            for t in tokens:
+                if not t or t in self.stop_words:
+                    continue
+                cache.increment_word_count(t)
+        cache.remove_below(self.min_word_frequency)
+        if self.limit and cache.num_words() > self.limit:
+            keep = cache.vocab_words()[: self.limit]
+            cache._by_word = {w.word: w for w in keep}
+            cache.update_indices()
+        if build_huffman:
+            Huffman(cache).build()
+        return cache

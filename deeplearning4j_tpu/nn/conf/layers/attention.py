@@ -66,8 +66,13 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
 
 
-def dense_attention(q, k, v, *, causal: bool, mask=None):
-    """Reference dense softmax attention. q,k,v: (b, h, T, hd)."""
+def dense_attention(q, k, v, *, causal: bool, mask=None,
+                    dropout_rate: float = 0.0, dropout_rng=None):
+    """Reference dense softmax attention. q,k,v: (b, h, T, hd).
+
+    ``dropout_rate`` drops entries of the softmax probability matrix
+    (standard attention dropout), not the weighted sum.
+    """
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -77,6 +82,10 @@ def dense_attention(q, k, v, *, causal: bool, mask=None):
     if mask is not None:  # (b, T) key padding mask
         scores = jnp.where(mask[:, None, None, :] > 0, scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        p = jnp.where(jax.random.bernoulli(dropout_rng, keep, p.shape),
+                      p / keep, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
 
@@ -133,10 +142,9 @@ class SelfAttentionLayer(FeedForwardLayer):
         q = self._heads(x, params["Wq"])
         k = self._heads(x, params["Wk"])
         v = self._heads(x, params["Wv"])
-        o = dense_attention(q, k, v, causal=self.causal, mask=mask)
-        if train and self.attention_dropout > 0 and rng is not None:
-            keep = 1.0 - self.attention_dropout
-            o = jnp.where(jax.random.bernoulli(rng, keep, o.shape), o / keep, 0.0)
+        rate = self.attention_dropout if (train and rng is not None) else 0.0
+        o = dense_attention(q, k, v, causal=self.causal, mask=mask,
+                            dropout_rate=rate, dropout_rng=rng)
         o = o.transpose(0, 2, 1, 3).reshape(b, T, self.n_out)
         y = o @ params["Wo"] + params["bo"]
         if mask is not None:
@@ -253,8 +261,9 @@ class PositionalEmbeddingLayer(Layer):
         if self.mode == "learned":
             return x + params["pos"][:T][None], state or {}
         d = x.shape[-1]
+        half = (d + 1) // 2  # ceil so odd feature dims work; trimmed below
         pos = jnp.arange(T, dtype=x.dtype)[:, None]
-        dim = jnp.arange(d // 2, dtype=x.dtype)[None, :]
+        dim = jnp.arange(half, dtype=x.dtype)[None, :]
         angle = pos / jnp.power(10000.0, 2 * dim / d)
-        enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+        enc = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)[:, :d]
         return x + enc[None], state or {}

@@ -11,8 +11,20 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
 from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+from deeplearning4j_tpu.parallel.multihost import (
+    MultiHostContext,
+    MultiHostNetwork,
+    MultiHostDl4jMultiLayer,
+    MultiHostComputationGraph,
+    ParameterAveragingTrainingMaster,
+    ShardedDataSetIterator,
+    TrainingMaster,
+)
 
 __all__ = [
     "TrainingMesh", "ParallelWrapper", "ParallelInference",
     "make_ring_attention", "DistributedLMTrainer",
+    "MultiHostContext", "MultiHostNetwork", "MultiHostDl4jMultiLayer",
+    "MultiHostComputationGraph", "ParameterAveragingTrainingMaster",
+    "ShardedDataSetIterator", "TrainingMaster",
 ]

@@ -126,25 +126,36 @@ class ROCScoreCalculator(ScoreCalculator):
         )
 
 
+def _resolve_pretrain_layer(model, layer_index):
+    """(layer, params) for ``layer_index`` on an MLN (int index) or a
+    ComputationGraph (layer name str, or int index into layer_names) — the
+    reference has MLN- and CG-specific calculators
+    (``AutoencoderScoreCalculator.java`` handles both Model types)."""
+    if hasattr(model, "layer_names"):  # ComputationGraph
+        name = (model.layer_names[layer_index]
+                if isinstance(layer_index, int) else layer_index)
+        return model._layer(name), model.params_[name]
+    return model.layers[layer_index], model.params_[layer_index]
+
+
 class AutoencoderScoreCalculator(ScoreCalculator):
     """Reconstruction error of a pretrain layer — AutoEncoder or VAE, both
-    expose ``reconstruct`` (reference ``AutoencoderScoreCalculator.java``)."""
+    expose ``reconstruct`` (reference ``AutoencoderScoreCalculator.java``).
+    Works on MLN (int layer index) and CG (layer name or index)."""
 
     minimize_score = True
 
-    def __init__(self, metric: str, iterator, layer_index: int = 0):
+    def __init__(self, metric: str, iterator, layer_index=0):
         self.metric = metric.lower()
         self.iterator = iterator
         self.layer_index = layer_index
 
     def calculate_score(self, model) -> float:
         total, count = 0.0, 0
-        layer = model.layers[self.layer_index]
+        layer, lparams = _resolve_pretrain_layer(model, self.layer_index)
         for ds in self.iterator:
             x = np.asarray(ds.features)
-            recon = np.asarray(
-                layer.reconstruct(model.params_[self.layer_index], x)
-            )
+            recon = np.asarray(layer.reconstruct(lparams, x))
             if self.metric == "mse":
                 err = ((recon - x) ** 2).sum()
             else:  # mae
@@ -167,7 +178,7 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
 
     minimize_score = False
 
-    def __init__(self, iterator, layer_index: int = 0, num_samples: int = 1,
+    def __init__(self, iterator, layer_index=0, num_samples: int = 1,
                  log_prob: bool = True):
         self.iterator = iterator
         self.layer_index = layer_index
@@ -176,12 +187,12 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
 
     def calculate_score(self, model) -> float:
         total, count = 0.0, 0
-        layer = model.layers[self.layer_index]
+        layer, lparams = _resolve_pretrain_layer(model, self.layer_index)
         for ds in self.iterator:
             x = np.asarray(ds.features)
             lp = np.asarray(
                 layer.reconstruction_log_probability(
-                    model.params_[self.layer_index], x, self.num_samples
+                    lparams, x, self.num_samples
                 )
             )
             total += float(lp.sum())
@@ -545,6 +556,12 @@ class EarlyStoppingTrainer:
                     # mid-epoch abort skips _fit_one_epoch's reset; leave the
                     # iterator clean for reuse
                     self.train_iterator.reset()
+                    break
+                except Exception as e:  # noqa: BLE001 — reference returns
+                    # TerminationReason.Error instead of propagating
+                    # (BaseEarlyStoppingTrainer.java catch-all in fit())
+                    reason = "Error"
+                    details = f"{type(e).__name__}: {e}"
                     break
 
                 terminate = False

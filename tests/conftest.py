@@ -28,12 +28,39 @@ import jax  # noqa: E402
 # authoritative switch to the virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: repeat suite runs skip recompilation of
+# unchanged jitted programs (SURVEY §4 fast-tier mandate).
+_cache_dir = os.environ.get(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 # NaN debugging is opt-in per test (jax.debug_nans breaks some valid ops);
 # keep x64 off to match TPU numerics, tests that need fp64 enable it locally.
 jax.config.update("jax_threefry_partitionable", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run tests marked @pytest.mark.slow (heavy-integration tier)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite mirroring the reference's fast-unit vs
+    heavy-integration split (SURVEY §4): @slow tests only run with
+    --runslow or RUN_SLOW=1."""
+    if config.getoption("--runslow") or os.environ.get("RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow tier: pass --runslow or RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

@@ -1,0 +1,52 @@
+"""Shared model/data definitions for the multi-host parity test — imported
+by both the worker processes and the single-process reference run so both
+sides train the identical net on identical global batches."""
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    OutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.updaters import Sgd
+
+GLOBAL_BATCH = 16
+N_BATCHES = 4
+
+
+def build_net() -> MultiLayerNetwork:
+    """LeNet-style CNN (the parity test model; reference
+    ``TestCompareParameterAveragingSparkVsSingleMachine.java`` uses a
+    small deterministic net the same way). Plain SGD so the update is
+    bit-for-bit linear in the averaged gradient."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12345)
+        .updater(Sgd(0.1))
+        .weight_init("xavier")
+        .list()
+        .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.convolutional(16, 16, 1))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def global_batches() -> ListDataSetIterator:
+    """Deterministic synthetic MNIST-shaped stream; EVERY process
+    constructs the identical global batches (the ShardedDataSetIterator
+    contract)."""
+    rng = np.random.default_rng(777)
+    n = GLOBAL_BATCH * N_BATCHES
+    x = rng.standard_normal((n, 16, 16, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    return ListDataSetIterator(DataSet(x, y), GLOBAL_BATCH)

@@ -1,0 +1,65 @@
+"""Worker script for the multi-host parity test — launched as a separate
+process per "host" by tests/test_multihost.py.
+
+Usage: python multihost_worker.py <coordinator> <num_procs> <pid> <outdir>
+
+Trains LeNet-ish CNN on a deterministic synthetic stream via the
+MultiHostNetwork facade (2 local CPU devices per process → 4 global) and
+dumps final params + scores for the parent to compare against
+single-process training. Port of the reference parity test
+``TestCompareParameterAveragingSparkVsSingleMachine.java`` (SURVEY.md §4.5:
+distributed-vs-single-machine parameter equality).
+"""
+
+import os
+import sys
+
+coordinator, nprocs, pid, outdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostNetwork,
+    ParameterAveragingTrainingMaster,
+    ShardedDataSetIterator,
+    initialize,
+)
+from tests.multihost_model import build_net, global_batches  # noqa: E402
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+assert len(jax.devices()) == 2 * nprocs, jax.devices()
+
+net = build_net()
+master = ParameterAveragingTrainingMaster.Builder().collect_training_stats(True).build()
+facade = MultiHostNetwork(net, master, ctx)
+
+it = ShardedDataSetIterator(global_batches(), nprocs, pid)
+facade.fit(it, epochs=2)
+
+# checkpoint-restart exercise: chief saves, everyone restores, state intact
+ckpt = os.path.join(outdir, "mh_ckpt.zip")
+facade.save_checkpoint(ckpt)
+facade.restore_checkpoint(ckpt)
+it.reset()
+facade.fit(it, epochs=1)
+
+if pid == 0:
+    np.savez(
+        os.path.join(outdir, "multihost_result.npz"),
+        params=net.params_flat(),
+        score=float(net.score_),
+        iteration=net.iteration,
+        n_stats=len(master.stats),
+    )
+print(f"worker {pid}: done, iteration={net.iteration}", flush=True)

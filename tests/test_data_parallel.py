@@ -221,6 +221,7 @@ class TestParallel:
 
 
 class TestZoo:
+    @pytest.mark.slow
     def test_lenet_instantiation(self):
         from deeplearning4j_tpu.models import LeNet
 
@@ -228,6 +229,7 @@ class TestZoo:
         out = net.output(np.zeros((2, 28, 28, 1), np.float32))
         assert out.shape == (2, 10)
 
+    @pytest.mark.slow
     def test_simplecnn_instantiation(self):
         from deeplearning4j_tpu.models import SimpleCNN
 

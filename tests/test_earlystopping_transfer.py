@@ -345,3 +345,34 @@ class TestScoreCalculators:
         )
         result = EarlyStoppingTrainer(cfg, net, ListDataSetIterator(ds, 16)).fit()
         assert result.total_epochs == 4
+
+
+class TestErrorTermination:
+    def test_exception_during_fit_returns_error_reason(self):
+        """Reference parity: BaseEarlyStoppingTrainer catches training
+        exceptions and returns TerminationReason.Error instead of raising."""
+        net = _net()
+        ds = _toy_data()
+        it = ListDataSetIterator(ds, 16)
+
+        class ExplodingIterator:
+            def __iter__(self):
+                raise RuntimeError("boom: injected data failure")
+
+            def reset(self):
+                pass
+
+            def async_supported(self):
+                return False
+
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .score_calculator(DataSetLossCalculator(it))
+            .model_saver(InMemoryModelSaver())
+            .build()
+        )
+        trainer = EarlyStoppingTrainer(cfg, net, ExplodingIterator())
+        result = trainer.fit()
+        assert result.termination_reason == "Error"
+        assert "boom" in result.termination_details

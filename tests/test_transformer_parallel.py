@@ -48,8 +48,10 @@ class TestDistributedParity:
         ids, tgt = _data()
         return [m.fit_batch(ids, tgt) for _ in range(3)]
 
-    @pytest.mark.parametrize("name,mesh_kw,n_micro", MESHES,
-                             ids=[m[0] for m in MESHES])
+    @pytest.mark.parametrize("name,mesh_kw,n_micro", [
+        m if m[0] == "dp8" else pytest.param(*m, marks=pytest.mark.slow)
+        for m in MESHES
+    ], ids=[m[0] for m in MESHES])
     def test_matches_single_device(self, name, mesh_kw, n_micro,
                                    reference_losses):
         m = _model()
@@ -60,6 +62,7 @@ class TestDistributedParity:
         np.testing.assert_allclose(losses, reference_losses, rtol=2e-3,
                                    atol=1e-4)
 
+    @pytest.mark.slow
     def test_training_converges_distributed(self):
         """Full 3-axis mesh learns the next-token copy structure."""
         m = _model()

@@ -76,16 +76,19 @@ class TestZooSmallInstantiation:
         net.fit(DataSet(_img(2, 48, 48, 3), _onehot(2, 5)), epochs=1)
         assert net.output(_img(2, 48, 48, 3)).shape == (2, 5)
 
+    @pytest.mark.slow
     def test_alexnet_small(self):
         net = AlexNet(num_classes=7, height=96, width=96).init()
         net.fit(DataSet(_img(2, 96, 96, 3), _onehot(2, 7)), epochs=1)
         assert net.output(_img(1, 96, 96, 3)).shape == (1, 7)
 
+    @pytest.mark.slow
     def test_vgg16_small(self):
         net = VGG16(num_classes=4, height=64, width=64).init()
         net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
         assert net.output(_img(1, 64, 64, 3)).shape == (1, 4)
 
+    @pytest.mark.slow
     def test_resnet50_small(self):
         net = ResNet50(num_classes=6, height=64, width=64).init()
         net.fit(DataSet(_img(2, 64, 64, 3), _onehot(2, 6)), epochs=1)
@@ -97,16 +100,19 @@ class TestZooSmallInstantiation:
         )
         assert n_convs >= 50
 
+    @pytest.mark.slow
     def test_googlenet_small(self):
         net = GoogLeNet(num_classes=4, height=64, width=64).init()
         net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
         assert net.output_single(_img(1, 64, 64, 3)).shape == (1, 4)
 
+    @pytest.mark.slow
     def test_darknet19_small(self):
         net = Darknet19(num_classes=4, height=64, width=64).init()
         net.fit(DataSet(_img(1, 64, 64, 3), _onehot(1, 4)), epochs=1)
         assert net.output(_img(1, 64, 64, 3)).shape == (1, 4)
 
+    @pytest.mark.slow
     def test_tinyyolo_small(self):
         net = TinyYOLO(num_classes=3, height=64, width=64).init()
         # 64/32 = 2x2 grid, 5 priors, labels (b, 2, 2, 4+3)
@@ -117,6 +123,7 @@ class TestZooSmallInstantiation:
         out = net.output(_img(1, 64, 64, 3))
         assert out.shape == (1, 2, 2, 5 * (5 + 3))
 
+    @pytest.mark.slow
     def test_yolo2_small(self):
         net = YOLO2(num_classes=3, height=64, width=64).init()
         lab = np.zeros((1, 2, 2, 7), np.float32)
@@ -126,12 +133,14 @@ class TestZooSmallInstantiation:
         out = net.output_single(_img(1, 64, 64, 3))
         assert out.shape == (1, 2, 2, 5 * (5 + 3))
 
+    @pytest.mark.slow
     def test_facenet_small(self):
         net = FaceNetNN4Small2(num_classes=5, height=64, width=64,
                                embedding_size=32).init()
         net.fit(DataSet(_img(2, 64, 64, 3), _onehot(2, 5)), epochs=1)
         assert net.output_single(_img(1, 64, 64, 3)).shape == (1, 5)
 
+    @pytest.mark.slow
     def test_inception_resnet_v1_small(self):
         net = InceptionResNetV1(num_classes=5, height=64, width=64,
                                 embedding_size=32).init()
