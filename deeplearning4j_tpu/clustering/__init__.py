@@ -1,0 +1,27 @@
+"""Clustering / nearest-neighbour suite — TPU-native rebuild of the
+reference's nearestneighbor-core module (SURVEY.md §2.7: VPTree, KDTree,
+KMeans, RP-LSH; 7,438 LoC) and deeplearning4j-tsne.
+
+Design note: the reference's VP/KD trees are pointer-chasing host
+structures built to avoid O(N) scans on CPU. On TPU the economics invert —
+a batched (Q, N) distance matrix runs on the MXU at full tilt and beats
+tree traversal by orders of magnitude for any N that fits in HBM. The
+classes here keep the reference API names and EXACT results (verified
+against brute force in tests) but execute as one jitted gather→dot→top_k
+program. LSH keeps its sublinear character (hyperplane hashing on device,
+candidate re-rank via the same batched kernel).
+"""
+
+from deeplearning4j_tpu.clustering.distances import (
+    pairwise_distance,
+    batched_knn,
+)
+from deeplearning4j_tpu.clustering.vptree import VPTree, KDTree
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+
+__all__ = [
+    "pairwise_distance", "batched_knn", "VPTree", "KDTree",
+    "KMeansClustering", "RandomProjectionLSH", "BarnesHutTsne", "Tsne",
+]

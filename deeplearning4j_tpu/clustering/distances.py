@@ -1,0 +1,73 @@
+"""Batched distance kernels — the compute core of the KNN/clustering
+suite. One (Q, N) distance matrix per call, computed on the MXU via the
+expanded-norm identity for euclidean (‖q−x‖² = ‖q‖² + ‖x‖² − 2q·x — a
+single matmul), then ``lax.top_k`` for neighbours.
+
+Reference semantics: ``VPTree.java`` supports "euclidean", "cosine"
+(similarity), "manhattan", "dot" distance functions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_METRICS = ("euclidean", "cosinesimilarity", "cosinedistance", "manhattan",
+            "dot")
+
+
+def _dist(queries, points, metric: str):
+    if metric == "euclidean":
+        qn = jnp.sum(queries * queries, -1, keepdims=True)     # (Q, 1)
+        pn = jnp.sum(points * points, -1)                      # (N,)
+        d2 = qn + pn[None, :] - 2.0 * queries @ points.T
+        return jnp.sqrt(jnp.maximum(d2, 0.0))
+    if metric in ("cosinesimilarity", "cosinedistance"):
+        qn = jnp.linalg.norm(queries, axis=-1, keepdims=True)
+        pn = jnp.linalg.norm(points, axis=-1)
+        sim = (queries @ points.T) / jnp.maximum(qn * pn[None, :], 1e-12)
+        return 1.0 - sim  # distance form; monotone in both conventions
+    if metric == "manhattan":
+        # (Q, N, D) expansion — memory-heavy; chunked by caller for big N
+        return jnp.sum(jnp.abs(queries[:, None, :] - points[None, :, :]), -1)
+    if metric == "dot":
+        return -(queries @ points.T)  # larger dot = nearer
+    raise ValueError(f"Unknown metric {metric}; supported: {_METRICS}")
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _knn_kernel(queries, points, metric: str, k: int):
+    d = _dist(queries, points, metric)
+    neg_top, idx = jax.lax.top_k(-d, k)
+    return -neg_top, idx
+
+
+def pairwise_distance(queries, points, metric: str = "euclidean") -> np.ndarray:
+    """(Q, N) distance matrix."""
+    return np.asarray(
+        jax.jit(_dist, static_argnums=2)(
+            jnp.asarray(queries, jnp.float32), jnp.asarray(points, jnp.float32),
+            metric,
+        )
+    )
+
+
+def batched_knn(queries, points, k: int, metric: str = "euclidean",
+                chunk: int = 4096) -> Tuple[np.ndarray, np.ndarray]:
+    """(distances (Q, k), indices (Q, k)) nearest first. Queries are
+    chunked so the (chunk, N) matrix stays HBM-resident."""
+    queries = np.asarray(queries, np.float32)
+    points = jnp.asarray(points, jnp.float32)
+    if queries.ndim == 1:
+        queries = queries[None]
+    k = min(k, points.shape[0])
+    ds, idxs = [], []
+    for lo in range(0, queries.shape[0], chunk):
+        d, i = _knn_kernel(jnp.asarray(queries[lo:lo + chunk]), points, metric, k)
+        ds.append(np.asarray(d))
+        idxs.append(np.asarray(i))
+    return np.concatenate(ds), np.concatenate(idxs)

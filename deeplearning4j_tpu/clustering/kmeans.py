@@ -1,0 +1,120 @@
+"""KMeans (reference ``clustering/kmeans/KMeansClustering.java`` over the
+generic strategy framework ``clustering/strategy/*``).
+
+TPU-native Lloyd's: each iteration is one jitted program — (N, K)
+distance matrix on the MXU, argmin assignment, segment-sum centroid
+update. Empty clusters are re-seeded from the farthest points (the
+reference's strategy framework handles this via its "empty cluster"
+optimization phase).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distances import _dist
+
+
+class ClusterSet(NamedTuple):
+    """Result container (reference ``ClusterSet``/``ClusterSetInfo``)."""
+
+    centers: np.ndarray       # (K, D)
+    assignments: np.ndarray   # (N,)
+    distances: np.ndarray     # (N,) distance to own center
+    iterations: int
+    inertia: float
+
+    def get_centers(self):
+        return self.centers
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _lloyd_iter(points, centers, key, metric):
+    d = _dist(points, centers, metric)              # (N, K)
+    assign = jnp.argmin(d, -1)                      # (N,)
+    mind = jnp.take_along_axis(d, assign[:, None], 1)[:, 0]
+    K = centers.shape[0]
+    one_hot = jax.nn.one_hot(assign, K, dtype=points.dtype)   # (N, K)
+    sums = one_hot.T @ points                        # (K, D)
+    counts = one_hot.sum(0)                          # (K,)
+    new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+    # empty clusters: re-seed at the globally farthest points
+    far_order = jnp.argsort(-mind)
+    reseed = points[far_order[:K]]
+    empty = (counts < 0.5)[:, None]
+    new_centers = jnp.where(empty, reseed, new_centers)
+    inertia = jnp.sum(mind * mind)
+    return new_centers, assign, mind, inertia
+
+
+class KMeansClustering:
+    """Reference surface: ``KMeansClustering.setup(k, maxIterations,
+    distanceFunction)`` → ``applyTo(points)``."""
+
+    def __init__(self, k: int, max_iterations: int = 100,
+                 distance_function: str = "euclidean",
+                 min_distribution_variation_rate: float = 1e-4,
+                 seed: int = 42):
+        self.k = int(k)
+        self.max_iterations = int(max_iterations)
+        self.distance_function = distance_function.lower()
+        self.min_variation = float(min_distribution_variation_rate)
+        self.seed = seed
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100,
+              distance_function: str = "euclidean",
+              seed: int = 42) -> "KMeansClustering":
+        return KMeansClustering(k, max_iterations, distance_function, seed=seed)
+
+    def apply_to(self, points) -> ClusterSet:
+        pts_np = np.asarray(points, np.float32)
+        points = jnp.asarray(pts_np)
+        N = points.shape[0]
+        if N < self.k:
+            raise ValueError(f"{N} points < k={self.k}")
+        rng = np.random.default_rng(self.seed)
+        # k-means++ seeding (quality matters more than the reference's
+        # random init; avoids merged-blob local minima)
+        first = int(rng.integers(0, N))
+        chosen = [first]
+        d2 = np.sum((pts_np - pts_np[first]) ** 2, -1)
+        for _ in range(1, self.k):
+            p = d2 / max(d2.sum(), 1e-12)
+            nxt = int(rng.choice(N, p=p))
+            chosen.append(nxt)
+            d2 = np.minimum(d2, np.sum((pts_np - pts_np[nxt]) ** 2, -1))
+        centers = points[np.asarray(chosen)]
+        key = jax.random.PRNGKey(self.seed)
+        prev_inertia = None
+        it = 0
+        for it in range(1, self.max_iterations + 1):
+            key, k1 = jax.random.split(key)
+            centers, _, _, inertia = _lloyd_iter(
+                points, centers, k1, self.distance_function
+            )
+            inertia = float(inertia)
+            if prev_inertia is not None and \
+                    prev_inertia - inertia <= self.min_variation * max(prev_inertia, 1e-12):
+                prev_inertia = inertia
+                break
+            prev_inertia = inertia
+        # final assignment pass against the RETURNED centers (the loop's
+        # assign/mind predate the last centroid move/reseed)
+        d = _dist(points, centers, self.distance_function)
+        assign = jnp.argmin(d, -1)
+        mind = jnp.take_along_axis(d, assign[:, None], 1)[:, 0]
+        return ClusterSet(
+            centers=np.asarray(centers),
+            assignments=np.asarray(assign),
+            distances=np.asarray(mind),
+            iterations=it,
+            inertia=float(jnp.sum(mind * mind)),
+        )
+
+    applyTo = apply_to

@@ -1,0 +1,69 @@
+"""Random-projection LSH (reference
+``clustering/lsh/RandomProjectionLSH.java`` + ``randomprojection/*``):
+sign-of-hyperplane hashing for sublinear approximate NN, with exact
+re-ranking of bucket candidates through the batched distance kernel.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distances import batched_knn
+
+
+class RandomProjectionLSH:
+    def __init__(self, hash_length: int = 12, num_tables: int = 4,
+                 dim: int = None, seed: int = 42):
+        if dim is None:
+            raise ValueError("dim required")
+        self.hash_length = int(hash_length)
+        self.num_tables = int(num_tables)
+        self.dim = int(dim)
+        rng = np.random.default_rng(seed)
+        # (T, H, D) hyperplane normals
+        self.planes = rng.standard_normal(
+            (self.num_tables, self.hash_length, self.dim)
+        ).astype(np.float32)
+        self.tables: List[Dict[int, List[int]]] = [
+            defaultdict(list) for _ in range(self.num_tables)
+        ]
+        self.data: np.ndarray = np.zeros((0, self.dim), np.float32)
+
+    def _hashes(self, x: np.ndarray) -> np.ndarray:
+        """(Q, T) integer bucket keys from sign bits."""
+        # (T, Q, H) signs
+        bits = (np.einsum("thd,qd->tqh", self.planes, x) > 0).astype(np.int64)
+        weights = (1 << np.arange(self.hash_length, dtype=np.int64))
+        return (bits @ weights).T  # (Q, T)
+
+    def make_index(self, data) -> "RandomProjectionLSH":
+        self.data = np.asarray(data, np.float32)
+        keys = self._hashes(self.data)  # (N, T)
+        for i, row in enumerate(keys):
+            for t, key in enumerate(row):
+                self.tables[t][int(key)].append(i)
+        return self
+
+    def bucket(self, query) -> np.ndarray:
+        """Candidate indices from all tables (union)."""
+        q = np.asarray(query, np.float32).reshape(1, -1)
+        keys = self._hashes(q)[0]
+        cand = set()
+        for t, key in enumerate(keys):
+            cand.update(self.tables[t].get(int(key), []))
+        return np.asarray(sorted(cand), np.int64)
+
+    def search(self, query, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Approximate kNN: hash → candidate union → exact re-rank.
+        Falls back to full scan when the buckets are empty."""
+        cand = self.bucket(query)
+        pool = self.data if len(cand) == 0 else self.data[cand]
+        d, idx = batched_knn(query, pool, min(k, len(pool)))
+        if len(cand):
+            idx = cand[idx[0]]
+        else:
+            idx = idx[0]
+        return d[0], idx

@@ -1,0 +1,93 @@
+"""VPTree / KDTree — reference-API nearest-neighbour indexes
+(``clustering/vptree/VPTree.java``, ``clustering/kdtree/KDTree.java``).
+
+The reference builds vantage-point / k-d trees to prune CPU distance
+scans. On TPU the batched MXU distance matrix (distances.py) IS the fast
+path, so both classes are thin facades over it with the reference's
+query surface (``knn``/``search``/``getItems``); results are exact (tree
+pruning is also exact), verified against brute force in tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.distances import batched_knn
+
+
+class VPTree:
+    """Reference surface: ``VPTree(items, distanceFunction)`` then
+    ``search(target, k)`` → (items, distances)."""
+
+    def __init__(self, items, similarity_function: str = "euclidean",
+                 invert: bool = False, workers: int = 1):
+        self.items = np.asarray(items, np.float32)
+        if self.items.ndim != 2:
+            raise ValueError(f"items must be (N, D); got {self.items.shape}")
+        self.similarity_function = similarity_function.lower()
+        self.invert = invert
+        # tree construction is unnecessary on TPU; nothing to build
+
+    def get_items(self) -> np.ndarray:
+        return self.items
+
+    def search(self, target, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """k nearest items to ``target``: (items (k, D), distances (k,)),
+        nearest first (reference ``search(INDArray, int, List, List)``)."""
+        d, idx = self.knn(target, k)
+        return self.items[idx[0]], d[0]
+
+    def knn(self, targets, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched query: (distances (Q, k), indices (Q, k))."""
+        d, idx = batched_knn(targets, self.items, k, self.similarity_function)
+        if self.invert:
+            d, idx = d[:, ::-1], idx[:, ::-1]
+        return d, idx
+
+
+class KDTree:
+    """Reference surface (``KDTree.java``): insert points then ``knn(point,
+    distance_threshold)`` / ``nn(point)``. Euclidean metric (the
+    reference's HyperRect pruning is euclidean-only)."""
+
+    def __init__(self, dims: int):
+        self.dims = int(dims)
+        self._points: List[np.ndarray] = []
+        self._cache: Optional[np.ndarray] = None
+
+    def insert(self, point) -> None:
+        p = np.asarray(point, np.float32).reshape(-1)
+        if p.shape[0] != self.dims:
+            raise ValueError(f"point dim {p.shape[0]} != tree dims {self.dims}")
+        self._points.append(p)
+        self._cache = None
+
+    def size(self) -> int:
+        return len(self._points)
+
+    def _matrix(self) -> np.ndarray:
+        if self._cache is None:
+            self._cache = np.stack(self._points) if self._points else \
+                np.zeros((0, self.dims), np.float32)
+        return self._cache
+
+    def nn(self, point) -> Tuple[np.ndarray, float]:
+        if not self._points:
+            raise ValueError("KDTree is empty; insert points before nn()")
+        d, idx = batched_knn(point, self._matrix(), 1)
+        return self._matrix()[idx[0, 0]], float(d[0, 0])
+
+    def knn(self, point, distance_threshold: float) -> List[Tuple[float, np.ndarray]]:
+        """All points within ``distance_threshold``, nearest first
+        (reference ``knn`` returns a distance-sorted list)."""
+        m = self._matrix()
+        if len(m) == 0:
+            return []
+        d, idx = batched_knn(point, m, len(m))
+        out = []
+        for dist, i in zip(d[0], idx[0]):
+            if dist <= distance_threshold:
+                out.append((float(dist), m[i]))
+        return out

@@ -1,0 +1,15 @@
+"""Graph embeddings — rebuild of deeplearning4j-graph (SURVEY.md §2.7:
+in-memory graph, random-walk iterators, DeepWalk with hierarchical
+softmax via GraphHuffman; 2,283 LoC reference)."""
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import (
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+
+__all__ = [
+    "Graph", "RandomWalkIterator", "WeightedRandomWalkIterator",
+    "DeepWalk", "GraphVectors",
+]

@@ -1,0 +1,150 @@
+"""DeepWalk (reference ``graph/models/deepwalk/DeepWalk.java``): truncated
+random walks fed to skip-gram with hierarchical softmax
+(``GraphHuffman.java`` builds codes over vertex degree).
+
+TPU-native: walks are just integer sequences, so training reuses the
+batched skip-gram kernel via SequenceVectors directly — the reference's
+``GraphVectorLookupTable`` + per-pair HS updates collapse into the same
+jitted scatter step Word2Vec uses (SURVEY.md §9: DeepWalk reuses the
+skip-gram learner).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+
+
+def _degree_vocab(graph: Graph) -> AbstractCache:
+    """Vertex vocab with degree as 'frequency' (the reference's
+    GraphHuffman weights codes by degree); index i = vertex i."""
+    cache = AbstractCache()
+    for v in range(graph.num_vertices()):
+        vw = VocabWord(str(v), max(graph.degree(v), 1))
+        cache.add_token(vw)
+    # identity indexing: vertex id == row id (walks index rows directly)
+    cache._by_index = [cache._by_word[str(v)] for v in range(graph.num_vertices())]
+    for i, vw in enumerate(cache._by_index):
+        vw.index = i
+    return cache
+
+
+class GraphVectors:
+    """Query surface (reference ``GraphVectors``/``GraphVectorsImpl``)."""
+
+    def __init__(self, sv: SequenceVectors, graph: Graph):
+        self.sv = sv
+        self.graph = graph
+
+    def num_vertices(self) -> int:
+        return self.graph.num_vertices()
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self.sv.vector(v)
+
+    def similarity(self, a: int, b: int) -> float:
+        return self.sv.similarity_by_index(a, b)
+
+    def vertices_nearest(self, v: int, n: int = 10) -> List[int]:
+        return self.sv.nearest_by_index(v, n)
+
+
+class DeepWalk(GraphVectors):
+    class Builder:
+        def __init__(self):
+            self._vector_size = 100
+            self._window = 5
+            self._walk_length = 40
+            self._walks_per_vertex = 10
+            self._lr = 0.025
+            self._seed = 42
+            self._epochs = 1
+            self._negative = 0  # reference uses HS only
+            self._use_hs = True
+            self._batch_size = 512
+
+        def vector_size(self, n):
+            self._vector_size = int(n)
+            return self
+
+        def window_size(self, n):
+            self._window = int(n)
+            return self
+
+        def walk_length(self, n):
+            self._walk_length = int(n)
+            return self
+
+        def walks_per_vertex(self, n):
+            self._walks_per_vertex = int(n)
+            return self
+
+        def learning_rate(self, x):
+            self._lr = float(x)
+            return self
+
+        def seed(self, n):
+            self._seed = int(n)
+            return self
+
+        def epochs(self, n):
+            self._epochs = int(n)
+            return self
+
+        def negative_sample(self, n):
+            """Optional NS instead of/alongside HS (new capability; the
+            reference is HS-only)."""
+            self._negative = int(n)
+            return self
+
+        def use_hierarchic_softmax(self, b):
+            self._use_hs = bool(b)
+            return self
+
+        def batch_size(self, n):
+            self._batch_size = int(n)
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(self)
+
+    @staticmethod
+    def builder():
+        return DeepWalk.Builder()
+
+    def __init__(self, b: "DeepWalk.Builder"):
+        self._b = b
+        self.sv: Optional[SequenceVectors] = None
+        self.graph: Optional[Graph] = None
+
+    def fit(self, graph: Graph,
+            walk_iterator: Optional[RandomWalkIterator] = None) -> "DeepWalk":
+        b = self._b
+        self.graph = graph
+        vocab = _degree_vocab(graph)
+        self.sv = SequenceVectors(
+            vocab,
+            layer_size=b._vector_size,
+            window=b._window,
+            negative=b._negative,
+            use_hierarchic_softmax=b._use_hs,
+            learning_rate=b._lr,
+            min_learning_rate=1e-4,
+            epochs=b._epochs,
+            batch_size=b._batch_size,
+            seed=b._seed,
+            elements_algorithm="skipgram",
+        )
+        it = walk_iterator if walk_iterator is not None else RandomWalkIterator(
+            graph, b._walk_length, seed=b._seed,
+            walks_per_vertex=b._walks_per_vertex,
+        )
+        walks = list(it)
+        self.sv.fit_sequences(walks)
+        return self

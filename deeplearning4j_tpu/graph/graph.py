@@ -1,0 +1,40 @@
+"""In-memory graph (reference ``graph/graph/Graph.java`` implementing
+``api/IGraph.java``): vertices 0..N-1, directed or undirected weighted
+edges, adjacency lists."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class Graph:
+    def __init__(self, num_vertices: int, allow_multiple_edges: bool = False):
+        self.num_vertices_ = int(num_vertices)
+        self.allow_multiple_edges = allow_multiple_edges
+        self._adj: List[List[Tuple[int, float]]] = [
+            [] for _ in range(num_vertices)
+        ]
+
+    def num_vertices(self) -> int:
+        return self.num_vertices_
+
+    def add_edge(self, a: int, b: int, weight: float = 1.0,
+                 directed: bool = False) -> None:
+        if not (0 <= a < self.num_vertices_ and 0 <= b < self.num_vertices_):
+            raise ValueError(f"edge ({a},{b}) out of range")
+        if not self.allow_multiple_edges and any(v == b for v, _ in self._adj[a]):
+            return
+        self._adj[a].append((b, float(weight)))
+        if not directed:
+            self._adj[b].append((a, float(weight)))
+
+    def get_connected_vertices(self, v: int) -> List[int]:
+        return [u for u, _ in self._adj[v]]
+
+    def get_edge_weights(self, v: int) -> List[float]:
+        return [w for _, w in self._adj[v]]
+
+    def degree(self, v: int) -> int:
+        return len(self._adj[v])

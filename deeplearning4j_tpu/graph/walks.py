@@ -1,0 +1,51 @@
+"""Random-walk sequence generators (reference
+``graph/iterator/RandomWalkIterator.java`` and
+``WeightedRandomWalkIterator.java``): fixed-length walks from every
+vertex, uniform or weight-proportional next-step choice; NoEdgeHandling
+SELF_LOOP_ON_DISCONNECTED semantics."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class RandomWalkIterator:
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 42,
+                 walks_per_vertex: int = 1):
+        self.graph = graph
+        self.walk_length = int(walk_length)
+        self.seed = seed
+        self.walks_per_vertex = int(walks_per_vertex)
+
+    def _next_step(self, rng, v: int) -> int:
+        nbrs = self.graph.get_connected_vertices(v)
+        if not nbrs:
+            return v  # self-loop on disconnected vertex
+        return nbrs[rng.integers(0, len(nbrs))]
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        order = np.arange(self.graph.num_vertices())
+        for _ in range(self.walks_per_vertex):
+            rng.shuffle(order)
+            for start in order:
+                walk = [int(start)]
+                v = int(start)
+                for _ in range(self.walk_length - 1):
+                    v = self._next_step(rng, v)
+                    walk.append(v)
+                yield np.asarray(walk, np.int32)
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    def _next_step(self, rng, v: int) -> int:
+        nbrs = self.graph.get_connected_vertices(v)
+        if not nbrs:
+            return v
+        w = np.asarray(self.graph.get_edge_weights(v), np.float64)
+        p = w / w.sum() if w.sum() > 0 else None
+        return int(rng.choice(nbrs, p=p))

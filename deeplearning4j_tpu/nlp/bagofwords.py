@@ -1,0 +1,111 @@
+"""Bag-of-words / TF-IDF vectorizers (reference
+``bagofwords/vectorizer/{BagOfWordsVectorizer,TfidfVectorizer}.java``):
+sentence → sparse-count (dense here) feature vectors over the vocab."""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+class BagOfWordsVectorizer:
+    class Builder:
+        def __init__(self):
+            self._iter: Optional[SentenceIterator] = None
+            self._tok: Optional[TokenizerFactory] = None
+            self._min_word_frequency = 1
+            self._stop_words: List[str] = []
+
+        def iterate(self, it):
+            if isinstance(it, (list, tuple)):
+                it = CollectionSentenceIterator(it)
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def min_word_frequency(self, n):
+            self._min_word_frequency = int(n)
+            return self
+
+        def stop_words(self, ws):
+            self._stop_words = list(ws)
+            return self
+
+        def build(self):
+            return self._cls()(self)
+
+        def _cls(self):
+            return BagOfWordsVectorizer
+
+    @staticmethod
+    def builder():
+        return BagOfWordsVectorizer.Builder()
+
+    def __init__(self, b):
+        self._b = b
+        self._tok = b._tok or DefaultTokenizerFactory()
+        self.vocab: Optional[AbstractCache] = None
+        self._df: Optional[np.ndarray] = None
+        self._n_docs = 0
+
+    def fit(self):
+        b = self._b
+        assert b._iter is not None
+        streams = [self._tok.create(s).get_tokens() for s in b._iter]
+        self.vocab = VocabConstructor(
+            min_word_frequency=b._min_word_frequency, stop_words=b._stop_words
+        ).build_joint_vocabulary(streams, build_huffman=False)
+        V = self.vocab.num_words()
+        self._df = np.zeros((V,), np.float64)
+        self._n_docs = len(streams)
+        for toks in streams:
+            seen = {self.vocab.index_of(t) for t in toks}
+            for i in seen:
+                if i >= 0:
+                    self._df[i] += 1
+        return self
+
+    def transform(self, sentence: str) -> np.ndarray:
+        toks = self._tok.create(sentence).get_tokens()
+        v = np.zeros((self.vocab.num_words(),), np.float32)
+        for t in toks:
+            i = self.vocab.index_of(t)
+            if i >= 0:
+                v[i] += 1.0
+        return self._weight(v)
+
+    def transform_all(self, sentences: Iterable[str]) -> np.ndarray:
+        return np.stack([self.transform(s) for s in sentences])
+
+    def _weight(self, counts: np.ndarray) -> np.ndarray:
+        return counts
+
+
+class TfidfVectorizer(BagOfWordsVectorizer):
+    class Builder(BagOfWordsVectorizer.Builder):
+        def _cls(self):
+            return TfidfVectorizer
+
+    @staticmethod
+    def builder():
+        return TfidfVectorizer.Builder()
+
+    def _weight(self, counts: np.ndarray) -> np.ndarray:
+        tf = counts
+        idf = np.log((1.0 + self._n_docs) / (1.0 + self._df)) + 1.0
+        return (tf * idf).astype(np.float32)

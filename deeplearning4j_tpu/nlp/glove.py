@@ -1,0 +1,247 @@
+"""GloVe (reference ``models/glove/Glove.java`` + co-occurrence counting
+``glove/count/*``): weighted least-squares on the log co-occurrence
+matrix, AdaGrad updates.
+
+TPU-native: the co-occurrence table is counted on host (hash map — this
+is ETL, not math), then training runs as fixed-size batches of (i, j,
+X_ij) triples through one jitted AdaGrad scatter step. The reference
+shuffles co-occurrence pairs per epoch; we do the same with a numpy
+permutation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, ii, jj, xij, mask, lr, x_max, alpha):
+    """AdaGrad GloVe update on a batch of co-occurrence triples.
+
+    w/wc: main/context embeddings (V, D); b/bc biases (V,); hw/hwc/hb the
+    AdaGrad accumulators — SEPARATE tables per embedding ((V, D) each) and
+    (V, 2) for [main, context] biases, matching GloVe's per-parameter
+    accumulation.
+    """
+    vi = w[ii]
+    vj = wc[jj]
+    weight = jnp.minimum(1.0, (xij / x_max) ** alpha) * mask
+    diff = (jnp.sum(vi * vj, -1) + b[ii] + bc[jj] - jnp.log(jnp.maximum(xij, 1e-10)))
+    wdiff = weight * diff                       # (B,)
+    loss = 0.5 * jnp.sum(weight * diff * diff) / jnp.maximum(mask.sum(), 1.0)
+
+    g_vi = wdiff[:, None] * vj
+    g_vj = wdiff[:, None] * vi
+    g_bi = wdiff
+    g_bj = wdiff
+
+    # AdaGrad: accumulate squared grads, scale steps
+    hw_i = hw[ii] + g_vi * g_vi
+    hwc_j = hwc[jj] + g_vj * g_vj
+    w = w.at[ii].add(-lr * g_vi * jax.lax.rsqrt(hw_i + 1e-8))
+    wc = wc.at[jj].add(-lr * g_vj * jax.lax.rsqrt(hwc_j + 1e-8))
+    hw = hw.at[ii].add(g_vi * g_vi)
+    hwc = hwc.at[jj].add(g_vj * g_vj)
+
+    hb_i = hb[ii, 0] + g_bi * g_bi
+    hb_j = hb[jj, 1] + g_bj * g_bj
+    b = b.at[ii].add(-lr * g_bi * jax.lax.rsqrt(hb_i + 1e-8))
+    bc = bc.at[jj].add(-lr * g_bj * jax.lax.rsqrt(hb_j + 1e-8))
+    hb = hb.at[ii, 0].add(g_bi * g_bi)
+    hb = hb.at[jj, 1].add(g_bj * g_bj)
+    return w, wc, b, bc, hw, hwc, hb, loss
+
+
+class Glove:
+    class Builder:
+        def __init__(self):
+            self._iter: Optional[SentenceIterator] = None
+            self._tok: Optional[TokenizerFactory] = None
+            self._layer_size = 100
+            self._window = 5
+            self._min_word_frequency = 1
+            self._epochs = 5
+            self._seed = 42
+            self._lr = 0.05
+            self._x_max = 100.0
+            self._alpha = 0.75
+            self._batch_size = 1024
+            self._symmetric = True
+            self._shuffle = True
+
+        def iterate(self, it):
+            if isinstance(it, (list, tuple)):
+                it = CollectionSentenceIterator(it)
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def layer_size(self, n):
+            self._layer_size = int(n)
+            return self
+
+        def window_size(self, n):
+            self._window = int(n)
+            return self
+
+        def min_word_frequency(self, n):
+            self._min_word_frequency = int(n)
+            return self
+
+        def epochs(self, n):
+            self._epochs = int(n)
+            return self
+
+        def seed(self, n):
+            self._seed = int(n)
+            return self
+
+        def learning_rate(self, x):
+            self._lr = float(x)
+            return self
+
+        def x_max(self, x):
+            self._x_max = float(x)
+            return self
+
+        def alpha(self, x):
+            self._alpha = float(x)
+            return self
+
+        def batch_size(self, n):
+            self._batch_size = int(n)
+            return self
+
+        def symmetric(self, b):
+            self._symmetric = bool(b)
+            return self
+
+        def shuffle(self, b):
+            self._shuffle = bool(b)
+            return self
+
+        def build(self):
+            return Glove(self)
+
+    @staticmethod
+    def builder():
+        return Glove.Builder()
+
+    def __init__(self, b: "Glove.Builder"):
+        self._b = b
+        self._tok = b._tok or DefaultTokenizerFactory()
+        self.vocab: Optional[AbstractCache] = None
+        self.last_loss = float("nan")
+
+    def fit(self) -> "Glove":
+        b = self._b
+        assert b._iter is not None
+        streams = [self._tok.create(s).get_tokens() for s in b._iter]
+        self.vocab = VocabConstructor(
+            min_word_frequency=b._min_word_frequency
+        ).build_joint_vocabulary(streams, build_huffman=False)
+        V = self.vocab.num_words()
+
+        # ---- co-occurrence counting (host ETL; reference glove/count/*)
+        cooc: Dict[Tuple[int, int], float] = {}
+        for toks in streams:
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = [i for i in ids if i >= 0]
+            for p, i in enumerate(ids):
+                for q in range(max(0, p - b._window), p):
+                    j = ids[q]
+                    incr = 1.0 / (p - q)  # distance weighting (GloVe paper)
+                    cooc[(i, j)] = cooc.get((i, j), 0.0) + incr
+                    if b._symmetric:
+                        cooc[(j, i)] = cooc.get((j, i), 0.0) + incr
+
+        triples = np.asarray(
+            [(i, j, x) for (i, j), x in cooc.items()], np.float64
+        )
+        if len(triples) == 0:
+            raise ValueError("No co-occurrences found")
+
+        rng = np.random.default_rng(b._seed)
+        D = b._layer_size
+        scale = 0.5 / D
+        w = jnp.asarray(rng.uniform(-scale, scale, (V, D)), jnp.float32)
+        wc = jnp.asarray(rng.uniform(-scale, scale, (V, D)), jnp.float32)
+        bias = jnp.zeros((V,), jnp.float32)
+        biasc = jnp.zeros((V,), jnp.float32)
+        hw = jnp.full((V, D), 1e-8, jnp.float32)
+        hwc = jnp.full((V, D), 1e-8, jnp.float32)
+        hb = jnp.full((V, 2), 1e-8, jnp.float32)
+
+        B = b._batch_size
+        for _ in range(b._epochs):
+            order = rng.permutation(len(triples)) if b._shuffle else np.arange(len(triples))
+            for lo in range(0, len(order), B):
+                sel = triples[order[lo:lo + B]]
+                n = len(sel)
+                ii = np.zeros((B,), np.int32)
+                jj = np.zeros((B,), np.int32)
+                xx = np.ones((B,), np.float32)
+                mask = np.zeros((B,), np.float32)
+                ii[:n] = sel[:, 0]
+                jj[:n] = sel[:, 1]
+                xx[:n] = sel[:, 2]
+                mask[:n] = 1.0
+                w, wc, bias, biasc, hw, hwc, hb, loss = _glove_step(
+                    w, wc, bias, biasc, hw, hwc, hb,
+                    jnp.asarray(ii), jnp.asarray(jj), jnp.asarray(xx),
+                    jnp.asarray(mask), jnp.asarray(b._lr, jnp.float32),
+                    jnp.asarray(b._x_max, jnp.float32),
+                    jnp.asarray(b._alpha, jnp.float32),
+                )
+            self.last_loss = float(loss)
+        # GloVe convention: final vectors = main + context
+        self._matrix = np.asarray(w) + np.asarray(wc)
+        return self
+
+    # ------------------------------------------------- WordVectors interface
+    def has_word(self, w: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(w)
+
+    def get_word_vector(self, w: str):
+        if not self.has_word(w):
+            return None
+        return self._matrix[self.vocab.index_of(w)]
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self._matrix
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, c = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or c is None:
+            return float("nan")
+        na, nc = np.linalg.norm(a), np.linalg.norm(c)
+        if na == 0 or nc == 0:
+            return 0.0
+        return float(a @ c / (na * nc))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        from deeplearning4j_tpu.nlp.similarity import cosine_nearest
+
+        if not self.has_word(word):
+            return []
+        i = self.vocab.index_of(word)
+        idxs = cosine_nearest(self._matrix, self._matrix[i], n, exclude_index=i)
+        return [self.vocab.word_at_index(j) for j in idxs]

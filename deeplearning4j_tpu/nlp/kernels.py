@@ -54,6 +54,20 @@ def make_unigram_cdf(counts) -> jnp.ndarray:
     return jnp.cumsum(p)
 
 
+def _dup_scale(idx: Array, weight: Array, n_rows: int) -> Array:
+    """1/count-of-row-in-batch per element (weighted by validity).
+
+    The reference applies per-pair updates SEQUENTIALLY (each at current
+    params), which self-stabilizes via sigmoid saturation; a batched
+    scatter-add instead SUMS all duplicate-row deltas at stale params and
+    diverges when the vocab is small or a word is hot. Scaling each
+    contribution by 1/dup_count makes the batched step a per-row mean —
+    stable at any duplicate density, identical to the reference when
+    duplicates are rare (the common large-vocab case)."""
+    cnt = jnp.zeros((n_rows,), weight.dtype).at[idx].add(weight)
+    return 1.0 / jnp.maximum(cnt[idx], 1.0)
+
+
 # --------------------------------------------------------------------------
 # skip-gram
 # --------------------------------------------------------------------------
@@ -94,12 +108,14 @@ def skipgram_step(
         g_pos = (s_pos - 1.0) * mask                      # (B,)
         g_neg = s_neg * neg_valid                         # (B, K)
         d_v = d_v + g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
-        d_u_pos = g_pos[:, None] * v                      # (B, D)
-        d_u_neg = g_neg[..., None] * v[:, None, :]        # (B, K, D)
+        Vn = syn1neg.shape[0]
+        ctx_scale = _dup_scale(contexts, mask, Vn)        # (B,)
+        flat_negs = negs.reshape(-1)
+        neg_scale = _dup_scale(flat_negs, neg_valid.reshape(-1), Vn)
+        d_u_pos = g_pos[:, None] * v * ctx_scale[:, None]
+        d_u_neg = (g_neg[..., None] * v[:, None, :]).reshape(-1, v.shape[-1])
         syn1neg = syn1neg.at[contexts].add(-lr * d_u_pos)
-        syn1neg = syn1neg.at[negs.reshape(-1)].add(
-            -lr * d_u_neg.reshape(-1, v.shape[-1])
-        )
+        syn1neg = syn1neg.at[flat_negs].add(-lr * d_u_neg * neg_scale[:, None])
         eps = 1e-7
         loss = loss + jnp.sum(
             -jnp.log(s_pos + eps) * mask
@@ -112,16 +128,18 @@ def skipgram_step(
         # word2vec: label = 1 - code
         g = (s - (1.0 - codes.astype(s.dtype))) * code_mask * mask[:, None]
         d_v = d_v + jnp.einsum("bl,bld->bd", g, u)
-        d_u = g[..., None] * v[:, None, :]                # (B, L, D)
-        syn1 = syn1.at[points.reshape(-1)].add(
-            -lr * d_u.reshape(-1, v.shape[-1])
-        )
+        flat_pts = points.reshape(-1)
+        pt_scale = _dup_scale(flat_pts, (code_mask * mask[:, None]).reshape(-1),
+                              syn1.shape[0])
+        d_u = (g[..., None] * v[:, None, :]).reshape(-1, v.shape[-1])
+        syn1 = syn1.at[flat_pts].add(-lr * d_u * pt_scale[:, None])
         eps = 1e-7
         lbl = 1.0 - codes.astype(s.dtype)
         p_correct = lbl * s + (1.0 - lbl) * (1.0 - s)
         loss = loss + jnp.sum(-jnp.log(p_correct + eps) * code_mask * mask[:, None])
 
-    syn0 = syn0.at[centers].add(-lr * d_v * mask[:, None])
+    c_scale = _dup_scale(centers, mask, syn0.shape[0])
+    syn0 = syn0.at[centers].add(-lr * d_v * (mask * c_scale)[:, None])
     return syn0, syn1, syn1neg, loss / denom
 
 
@@ -167,9 +185,16 @@ def cbow_step(
         g_pos = (s_pos - 1.0) * mask
         g_neg = s_neg * neg_valid
         d_h = d_h + g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
-        syn1neg = syn1neg.at[targets].add(-lr * g_pos[:, None] * h)
-        syn1neg = syn1neg.at[negs.reshape(-1)].add(
+        Vn = syn1neg.shape[0]
+        t_scale = _dup_scale(targets, mask, Vn)
+        flat_negs = negs.reshape(-1)
+        n_scale = _dup_scale(flat_negs, neg_valid.reshape(-1), Vn)
+        syn1neg = syn1neg.at[targets].add(
+            -lr * (g_pos * t_scale)[:, None] * h
+        )
+        syn1neg = syn1neg.at[flat_negs].add(
             (-lr * g_neg[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+            * n_scale[:, None]
         )
         loss = loss + jnp.sum(
             -jnp.log(s_pos + eps) * mask
@@ -181,18 +206,26 @@ def cbow_step(
         s = sigmoid(jnp.einsum("bd,bld->bl", h, u))
         g = (s - (1.0 - codes.astype(s.dtype))) * code_mask * mask[:, None]
         d_h = d_h + jnp.einsum("bl,bld->bd", g, u)
-        syn1 = syn1.at[points.reshape(-1)].add(
+        flat_pts = points.reshape(-1)
+        pt_scale = _dup_scale(flat_pts, (code_mask * mask[:, None]).reshape(-1),
+                              syn1.shape[0])
+        syn1 = syn1.at[flat_pts].add(
             (-lr * g[..., None] * h[:, None, :]).reshape(-1, h.shape[-1])
+            * pt_scale[:, None]
         )
         lbl = 1.0 - codes.astype(s.dtype)
         p_correct = lbl * s + (1.0 - lbl) * (1.0 - s)
         loss = loss + jnp.sum(-jnp.log(p_correct + eps) * code_mask * mask[:, None])
 
     # distribute d_h to every context position (divided by window count,
-    # matching the mean in the forward)
+    # matching the mean in the forward), each row's total scaled by its
+    # duplicate count like the other tables
+    flat_ctx = contexts.reshape(-1)
+    ctx_valid = (ctx_mask * mask[:, None]).reshape(-1)
+    x_scale = _dup_scale(flat_ctx, ctx_valid, syn0.shape[0])
     d_ctx = (d_h / n_ctx)[:, None, :] * ctx_mask[..., None] * mask[:, None, None]
-    syn0 = syn0.at[contexts.reshape(-1)].add(
-        -lr * d_ctx.reshape(-1, h.shape[-1])
+    syn0 = syn0.at[flat_ctx].add(
+        -lr * d_ctx.reshape(-1, h.shape[-1]) * x_scale[:, None]
     )
     return syn0, syn1, syn1neg, loss / denom
 

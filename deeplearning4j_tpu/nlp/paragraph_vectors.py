@@ -1,0 +1,326 @@
+"""ParagraphVectors — doc2vec (reference
+``models/paragraphvectors/ParagraphVectors.java``, 1,457 LoC; learning
+algorithms ``DBOW.java``/``DM.java``).
+
+Design: document/label vectors live in the SAME embedding table as words
+(rows [V, V+num_labels)) — the reference does exactly this by inserting
+label elements into the vocab. PV-DBOW: the doc vector predicts each word
+of the document (skip-gram with the doc id as "center"). PV-DM: doc
+vector + context window average predicts the center word (CBOW with the
+doc id appended to every window). Both reuse the jitted kernels
+unchanged; ``infer_vector`` trains a fresh row against frozen weights
+(reference ``inferVector``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.kernels import dbow_infer_step
+from deeplearning4j_tpu.nlp.sentence_iterator import LabelAwareIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+class ParagraphVectors:
+    class Builder:
+        def __init__(self):
+            self._iter: Optional[LabelAwareIterator] = None
+            self._tok: Optional[TokenizerFactory] = None
+            self._layer_size = 100
+            self._window = 5
+            self._min_word_frequency = 1
+            self._epochs = 1
+            self._iterations = 1
+            self._seed = 42
+            self._lr = 0.025
+            self._min_lr = 1e-4
+            self._negative = 5
+            self._batch_size = 512
+            self._sequence_learning = "dbow"  # or "dm"
+            self._train_words = False
+
+        def iterate(self, it) -> "ParagraphVectors.Builder":
+            if isinstance(it, (list, tuple)):
+                it = LabelAwareIterator(it)
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def layer_size(self, n):
+            self._layer_size = int(n)
+            return self
+
+        def window_size(self, n):
+            self._window = int(n)
+            return self
+
+        def min_word_frequency(self, n):
+            self._min_word_frequency = int(n)
+            return self
+
+        def epochs(self, n):
+            self._epochs = int(n)
+            return self
+
+        def iterations(self, n):
+            self._iterations = int(n)
+            return self
+
+        def seed(self, n):
+            self._seed = int(n)
+            return self
+
+        def learning_rate(self, x):
+            self._lr = float(x)
+            return self
+
+        def min_learning_rate(self, x):
+            self._min_lr = float(x)
+            return self
+
+        def negative_sample(self, n):
+            self._negative = int(n)
+            return self
+
+        def batch_size(self, n):
+            self._batch_size = int(n)
+            return self
+
+        def sequence_learning_algorithm(self, name: str):
+            tail = name.rsplit(".", 1)[-1].lower()
+            self._sequence_learning = "dm" if tail == "dm" else "dbow"
+            return self
+
+        def train_words_vectors(self, b: bool):
+            self._train_words = bool(b)
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            return ParagraphVectors(self)
+
+    @staticmethod
+    def builder() -> "ParagraphVectors.Builder":
+        return ParagraphVectors.Builder()
+
+    def __init__(self, b: "ParagraphVectors.Builder"):
+        self._b = b
+        self._tok = b._tok or DefaultTokenizerFactory()
+        self.vocab: Optional[AbstractCache] = None
+        self.sv: Optional[SequenceVectors] = None
+        self.label_index: Dict[str, int] = {}
+        self._n_words = 0
+
+    # ------------------------------------------------------------------- fit
+    def fit(self) -> "ParagraphVectors":
+        b = self._b
+        assert b._iter is not None, "Builder.iterate(...) required"
+        docs = [(d.content, d.labels) for d in b._iter]
+        streams = [self._tok.create(c).get_tokens() for c, _ in docs]
+        self.vocab = VocabConstructor(
+            min_word_frequency=b._min_word_frequency
+        ).build_joint_vocabulary(streams, build_huffman=False)
+        V = self.vocab.num_words()
+        self._n_words = V
+
+        # label rows appended after word rows (reference inserts label
+        # elements into the same vocab/lookup table)
+        labels: List[str] = []
+        for _, ls in docs:
+            for l in ls:
+                if l not in self.label_index:
+                    self.label_index[l] = V + len(labels)
+                    labels.append(l)
+        # counts for the extended table: labels never get sampled as
+        # negatives (zero count ⇒ zero probability mass in the cdf)
+        ext_vocab = _ExtendedVocab(self.vocab, labels)
+
+        self.sv = SequenceVectors(
+            ext_vocab,
+            layer_size=b._layer_size,
+            window=b._window,
+            negative=b._negative,
+            use_hierarchic_softmax=False,
+            learning_rate=b._lr,
+            min_learning_rate=b._min_lr,
+            iterations=b._iterations,
+            epochs=b._epochs,
+            batch_size=b._batch_size,
+            seed=b._seed,
+            elements_algorithm="skipgram",
+        )
+
+        if b._sequence_learning == "dbow":
+            self._fit_dbow(docs, streams)
+        else:
+            self._fit_dm(docs, streams)
+        return self
+
+    def _doc_ids(self, streams):
+        out = []
+        for toks in streams:
+            ids = [self.vocab.index_of(t) for t in toks]
+            out.append(np.asarray([i for i in ids if i >= 0], np.int32))
+        return out
+
+    def _fit_dbow(self, docs, streams):
+        """PV-DBOW: (doc_id → each word) skip-gram pairs (reference
+        ``DBOW.java``); optionally plain word skip-gram too
+        (train_words)."""
+        sv = self.sv
+        id_seqs = self._doc_ids(streams)
+        total = sum(len(s) for s in id_seqs)
+        total_span = max(total * sv.epochs * sv.iterations, 1)
+        processed = 0
+        for _ in range(sv.epochs):
+            for _ in range(sv.iterations):
+                for (content, labels), ids in zip(docs, id_seqs):
+                    if len(ids) == 0:
+                        continue
+                    processed += len(ids)
+                    lr = sv._lr(processed, total_span)
+                    for label in labels:
+                        li = self.label_index[label]
+                        centers = np.full(len(ids), li, np.int32)
+                        sv._run_skipgram(centers, ids, lr)
+                    if self._b._train_words:
+                        c, x = sv._skipgram_pairs(ids)
+                        if len(c):
+                            sv._run_skipgram(c, x, lr)
+
+    def _fit_dm(self, docs, streams):
+        """PV-DM: CBOW windows with the doc id appended to every context
+        (reference ``DM.java``)."""
+        sv = self.sv
+        id_seqs = self._doc_ids(streams)
+        total = sum(len(s) for s in id_seqs)
+        total_span = max(total * sv.epochs * sv.iterations, 1)
+        processed = 0
+        for _ in range(sv.epochs):
+            for _ in range(sv.iterations):
+                for (content, labels), ids in zip(docs, id_seqs):
+                    if len(ids) < 2:
+                        continue
+                    processed += len(ids)
+                    lr = sv._lr(processed, total_span)
+                    ctx, cm, tg = sv._cbow_windows(ids)
+                    for label in labels:
+                        li = self.label_index[label]
+                        lcol = np.full((ctx.shape[0], 1), li, np.int32)
+                        mcol = np.ones((ctx.shape[0], 1), np.float32)
+                        sv._run_cbow_padded(
+                            np.concatenate([ctx, lcol], 1),
+                            np.concatenate([cm, mcol], 1),
+                            tg, lr,
+                        )
+
+    # --------------------------------------------------------------- queries
+    def get_paragraph_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.label_index.get(label)
+        return None if i is None else self.sv.vector(i)
+
+    def similarity(self, a: str, b: str) -> float:
+        ia = self.label_index.get(a, self.vocab.index_of(a) if self.vocab else -1)
+        ib = self.label_index.get(b, self.vocab.index_of(b) if self.vocab else -1)
+        if ia < 0 or ib < 0:
+            return float("nan")
+        return self.sv.similarity_by_index(ia, ib)
+
+    def infer_vector(self, text: str, steps: int = 10,
+                     lr: float = 0.025) -> np.ndarray:
+        """Train a FRESH vector for unseen text against frozen word
+        weights (reference ``inferVector``)."""
+        toks = self._tok.create(text).get_tokens()
+        ids = np.asarray(
+            [i for i in (self.vocab.index_of(t) for t in toks) if i >= 0],
+            np.int32,
+        )
+        sv = self.sv
+        rng = np.random.default_rng(0)
+        vec = jnp.asarray(
+            (rng.random(sv.layer_size) - 0.5) / sv.layer_size, jnp.float32
+        )
+        if len(ids) == 0:
+            return np.asarray(vec)
+        B = 256
+        # chunk long documents so EVERY token contributes each step
+        chunks = []
+        for lo in range(0, len(ids), B):
+            seg = ids[lo:lo + B]
+            tpad = np.zeros((B,), np.int32)
+            mask = np.zeros((B,), np.float32)
+            tpad[:len(seg)] = seg
+            mask[:len(seg)] = 1.0
+            chunks.append((jnp.asarray(tpad), jnp.asarray(mask)))
+        key = jax.random.PRNGKey(7)
+        for s in range(steps):
+            for tpad, mask in chunks:
+                key, k = jax.random.split(key)
+                vec, _ = dbow_infer_step(
+                    vec, sv.syn1neg, tpad, mask,
+                    sv.cdf, jnp.asarray(lr * (1 - s / steps), jnp.float32), k,
+                    max(sv.negative, 1),
+                )
+        return np.asarray(vec)
+
+    def nearest_labels(self, text: str, n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        labels = list(self.label_index)
+        vecs = np.stack([self.sv.vector(self.label_index[l]) for l in labels])
+        norms = np.linalg.norm(vecs, axis=1)
+        norms[norms == 0] = 1e-9
+        sims = (vecs @ v) / (norms * max(np.linalg.norm(v), 1e-9))
+        return [labels[i] for i in np.argsort(-sims)[:n]]
+
+
+class _ExtendedVocab(AbstractCache):
+    """Word vocab + appended label rows; labels carry zero count so they
+    never appear as sampled negatives."""
+
+    def __init__(self, base: AbstractCache, labels: List[str]):
+        super().__init__()
+        self._base = base
+        self._labels = labels
+
+    def num_words(self) -> int:
+        return self._base.num_words() + len(self._labels)
+
+    def counts(self) -> np.ndarray:
+        return np.concatenate([
+            self._base.counts(), np.zeros(len(self._labels), np.float64)
+        ])
+
+    def words(self):
+        return self._base.words() + list(self._labels)
+
+    def vocab_words(self):
+        return self._base.vocab_words()
+
+    def contains_word(self, w):
+        return self._base.contains_word(w) or w in self._labels
+
+    def index_of(self, w):
+        i = self._base.index_of(w)
+        if i >= 0:
+            return i
+        if w in self._labels:
+            return self._base.num_words() + self._labels.index(w)
+        return -1
+
+    def word_at_index(self, i):
+        V = self._base.num_words()
+        if i < V:
+            return self._base.word_at_index(i)
+        j = i - V
+        return self._labels[j] if j < len(self._labels) else None

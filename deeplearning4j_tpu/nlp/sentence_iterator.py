@@ -92,6 +92,24 @@ class BasicLineIterator(SentenceIterator):
         self._fh = open(self.path, "r", encoding="utf-8", errors="replace")
         self._advance()
 
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+            self._next = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 class FileSentenceIterator(SentenceIterator):
     """All files under a directory, one sentence per line (reference

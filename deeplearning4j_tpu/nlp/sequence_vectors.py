@@ -1,0 +1,302 @@
+"""SequenceVectors: the generic embedding trainer every NLP model builds
+on (reference ``models/sequencevectors/SequenceVectors.java:50`` —
+Word2Vec, ParagraphVectors and DeepWalk all subclass it; ``fit():193``).
+
+The reference fans sequences out to ``VectorCalculationsThread`` workers
+(``:295-297``) that push per-pair native aggregates. Here the host side
+only PACKS: sentences become fixed-size (batch,) index arrays and the
+jitted scatter-add step (nlp/kernels.py) does all math on device. One
+compiled program serves the entire run (static batch shape, padded tail).
+
+Learning-rate schedule matches word2vec: linear decay from
+``learning_rate`` to ``min_learning_rate`` over total expected samples.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.kernels import (
+    cbow_step,
+    make_unigram_cdf,
+    skipgram_step,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, Huffman
+
+
+class SequenceVectors:
+    """Trains element embeddings from sequences of vocab indices.
+
+    Subclasses (Word2Vec, DeepWalk, ParagraphVectors) provide the corpus
+    encoding; this class owns weights, the batch packer and the fit loop.
+    """
+
+    def __init__(
+        self,
+        vocab: AbstractCache,
+        layer_size: int = 100,
+        window: int = 5,
+        negative: int = 5,
+        use_hierarchic_softmax: bool = False,
+        sampling: float = 0.0,
+        learning_rate: float = 0.025,
+        min_learning_rate: float = 1e-4,
+        iterations: int = 1,
+        epochs: int = 1,
+        batch_size: int = 512,
+        seed: int = 42,
+        elements_algorithm: str = "skipgram",
+    ):
+        if negative <= 0 and not use_hierarchic_softmax:
+            raise ValueError(
+                "Need negative sampling (negative>0) and/or hierarchical "
+                "softmax (the reference has the same requirement)"
+            )
+        self.vocab = vocab
+        self.layer_size = int(layer_size)
+        self.window = int(window)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hierarchic_softmax)
+        self.sampling = float(sampling)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.iterations = int(iterations)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.algorithm = elements_algorithm.lower()
+        if self.algorithm not in ("skipgram", "cbow"):
+            raise ValueError(f"Unknown elements algorithm {elements_algorithm}")
+
+        V = vocab.num_words()
+        rng = np.random.default_rng(seed)
+        # word2vec init: syn0 uniform in [-0.5/D, 0.5/D), outputs zero
+        self.syn0 = jnp.asarray(
+            (rng.random((V, self.layer_size)) - 0.5) / self.layer_size,
+            jnp.float32,
+        )
+        if self.use_hs:
+            codes, points, lengths = Huffman(vocab).build().padded_arrays()
+            self._codes = codes
+            self._points = points
+            self._lengths = lengths
+            self.syn1 = jnp.zeros((max(V - 1, 1), self.layer_size), jnp.float32)
+            self._code_len = codes.shape[1]
+        else:
+            self._codes = np.zeros((V, 0), np.int8)
+            self._points = np.zeros((V, 0), np.int32)
+            self._lengths = np.zeros((V,), np.int32)
+            self.syn1 = jnp.zeros((1, self.layer_size), jnp.float32)
+            self._code_len = 0
+        self.syn1neg = (
+            jnp.zeros((V, self.layer_size), jnp.float32)
+            if self.negative > 0 else jnp.zeros((1, self.layer_size), jnp.float32)
+        )
+        self.cdf = make_unigram_cdf(vocab.counts())
+        self._keep_prob = self._subsample_probs()
+        self._host_rng = rng
+        self._key = jax.random.PRNGKey(seed)
+        self.last_loss: float = float("nan")
+        self.epoch_losses: List[float] = []  # mean batch loss per pass
+        self._pass_losses: List[float] = []
+
+    # ------------------------------------------------------------------ data
+    def _subsample_probs(self) -> Optional[np.ndarray]:
+        if self.sampling <= 0:
+            return None
+        counts = self.vocab.counts()
+        freq = counts / max(counts.sum(), 1.0)
+        t = self.sampling
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p = np.sqrt(t / freq) + t / freq
+        return np.clip(np.nan_to_num(p, posinf=1.0), 0.0, 1.0)
+
+    def _subsample(self, ids: np.ndarray) -> np.ndarray:
+        if self._keep_prob is None or len(ids) == 0:
+            return ids
+        keep = self._host_rng.random(len(ids)) < self._keep_prob[ids]
+        return ids[keep]
+
+    def _skipgram_pairs(self, ids: np.ndarray):
+        """(centers, contexts) with per-position random window shrink
+        (word2vec's b ~ U[1, window])."""
+        n = len(ids)
+        cs, xs = [], []
+        if n < 2:
+            return np.zeros(0, np.int32), np.zeros(0, np.int32)
+        bs = self._host_rng.integers(1, self.window + 1, n)
+        for i in range(n):
+            b = bs[i]
+            lo, hi = max(0, i - b), min(n, i + b + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    cs.append(ids[i])
+                    xs.append(ids[j])
+        return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+
+    def _cbow_windows(self, ids: np.ndarray):
+        """(contexts (n, 2*window), ctx_mask, targets) per position."""
+        n = len(ids)
+        W = 2 * self.window
+        if n < 2:
+            return (np.zeros((0, W), np.int32), np.zeros((0, W), np.float32),
+                    np.zeros(0, np.int32))
+        ctx = np.zeros((n, W), np.int32)
+        cm = np.zeros((n, W), np.float32)
+        bs = self._host_rng.integers(1, self.window + 1, n)
+        for i in range(n):
+            b = bs[i]
+            js = [j for j in range(max(0, i - b), min(n, i + b + 1)) if j != i]
+            ctx[i, :len(js)] = ids[js]
+            cm[i, :len(js)] = 1.0
+        return ctx, cm, np.asarray(ids, np.int32)
+
+    # ------------------------------------------------------------------- fit
+    def fit_sequences(self, sequences: Iterable[np.ndarray],
+                      total_words_hint: Optional[int] = None) -> "SequenceVectors":
+        """Train on an iterable of index arrays; re-iterated
+        ``epochs × iterations`` times (reference fit loop semantics)."""
+        seqs = [np.asarray(s, np.int32) for s in sequences]
+        total = total_words_hint or sum(len(s) for s in seqs)
+        total_span = max(total * self.epochs * self.iterations, 1)
+        processed = 0
+        B = self.batch_size
+        for _ in range(self.epochs):
+            for _ in range(self.iterations):
+                self._pass_losses = []
+                # buffers accumulate across sentences so every device step
+                # runs a (nearly) full batch regardless of sentence length
+                buf_c: List[np.ndarray] = []
+                buf_x: List[np.ndarray] = []
+                buf_m: List[np.ndarray] = []  # cbow ctx_mask rows
+                n_buf = 0
+                for ids in seqs:
+                    ids = self._subsample(ids)
+                    processed += len(ids)
+                    if self.algorithm == "skipgram":
+                        c, x = self._skipgram_pairs(ids)
+                    else:
+                        x, m, c = self._cbow_windows(ids)  # ctx, mask, targets
+                    if len(c) == 0:
+                        continue
+                    buf_c.append(c)
+                    buf_x.append(x)
+                    if self.algorithm == "cbow":
+                        buf_m.append(m)
+                    n_buf += len(c)
+                    while n_buf >= B:
+                        cc = np.concatenate(buf_c)
+                        xx = np.concatenate(buf_x)
+                        lr = self._lr(processed, total_span)
+                        if self.algorithm == "skipgram":
+                            self._run_skipgram(cc[:B], xx[:B], lr)
+                            buf_c, buf_x = [cc[B:]], [xx[B:]]
+                        else:
+                            mm = np.concatenate(buf_m)
+                            self._run_cbow_padded(xx[:B], mm[:B], cc[:B], lr)
+                            buf_c, buf_x, buf_m = [cc[B:]], [xx[B:]], [mm[B:]]
+                        n_buf = len(buf_c[0])
+                # flush tail (padded to B)
+                if n_buf:
+                    cc = np.concatenate(buf_c)
+                    xx = np.concatenate(buf_x)
+                    lr = self._lr(processed, total_span)
+                    if self.algorithm == "skipgram":
+                        self._run_skipgram(cc, xx, lr)
+                    else:
+                        self._run_cbow_padded(xx, np.concatenate(buf_m), cc, lr)
+                if self._pass_losses:
+                    self.epoch_losses.append(float(np.mean(self._pass_losses)))
+        return self
+
+    def _lr(self, processed: int, total: int) -> float:
+        frac = min(processed / total, 1.0)
+        return max(self.min_learning_rate,
+                   self.learning_rate * (1.0 - frac))
+
+    def _next_key(self):
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def _run_skipgram(self, centers: np.ndarray, contexts: np.ndarray, lr: float):
+        B = self.batch_size
+        # chunk oversized inputs (direct callers like PV-DBOW pass whole
+        # documents); every pair trains
+        for lo in range(B, len(centers), B):
+            self._run_skipgram(centers[lo:lo + B], contexts[lo:lo + B], lr)
+        n = min(len(centers), B)
+        mask = np.zeros((B,), np.float32)
+        mask[:n] = 1.0
+        c = np.zeros((B,), np.int32)
+        x = np.zeros((B,), np.int32)
+        c[:n] = centers[:B]
+        x[:n] = contexts[:B]
+        codes = self._codes[x].astype(np.int8)
+        points = self._points[x]
+        cm = (np.arange(self._code_len)[None, :] < self._lengths[x][:, None]
+              ).astype(np.float32) if self._code_len else np.zeros((B, 0), np.float32)
+        self.syn0, self.syn1, self.syn1neg, loss = skipgram_step(
+            self.syn0, self.syn1, self.syn1neg,
+            jnp.asarray(c), jnp.asarray(x), jnp.asarray(mask),
+            jnp.asarray(codes), jnp.asarray(points), jnp.asarray(cm),
+            self.cdf, self.negative, jnp.asarray(lr, jnp.float32),
+            self._next_key(),
+        )
+        self.last_loss = float(loss)
+        self._pass_losses.append(self.last_loss)
+
+    def _run_cbow_padded(self, ctx: np.ndarray, cm: np.ndarray, tg: np.ndarray,
+                         lr: float):
+        B = self.batch_size
+        for lo in range(0, len(tg), B):
+            ce = ctx[lo:lo + B]
+            me = cm[lo:lo + B]
+            te = tg[lo:lo + B]
+            n = len(te)
+            W = ctx.shape[1]
+            cpad = np.zeros((B, W), np.int32)
+            mpad = np.zeros((B, W), np.float32)
+            tpad = np.zeros((B,), np.int32)
+            mask = np.zeros((B,), np.float32)
+            cpad[:n] = ce
+            mpad[:n] = me
+            tpad[:n] = te
+            mask[:n] = 1.0
+            codes = self._codes[tpad].astype(np.int8)
+            points = self._points[tpad]
+            cmk = (np.arange(self._code_len)[None, :]
+                   < self._lengths[tpad][:, None]).astype(np.float32) \
+                if self._code_len else np.zeros((B, 0), np.float32)
+            self.syn0, self.syn1, self.syn1neg, loss = cbow_step(
+                self.syn0, self.syn1, self.syn1neg,
+                jnp.asarray(cpad), jnp.asarray(mpad), jnp.asarray(tpad),
+                jnp.asarray(mask), jnp.asarray(codes), jnp.asarray(points),
+                jnp.asarray(cmk), self.cdf, self.negative,
+                jnp.asarray(lr, jnp.float32), self._next_key(),
+            )
+            self.last_loss = float(loss)
+            self._pass_losses.append(self.last_loss)
+
+    # -------------------------------------------------------- vector queries
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def vector(self, index: int) -> np.ndarray:
+        return np.asarray(self.syn0[index])
+
+    def similarity_by_index(self, i: int, j: int) -> float:
+        a, b = np.asarray(self.syn0[i]), np.asarray(self.syn0[j])
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def nearest_by_index(self, i: int, n: int = 10) -> List[int]:
+        from deeplearning4j_tpu.nlp.similarity import cosine_nearest
+
+        m = self.get_word_vector_matrix()
+        return cosine_nearest(m, m[i], n, exclude_index=i)

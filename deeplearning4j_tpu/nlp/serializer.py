@@ -1,0 +1,132 @@
+"""Word-vector serialization (reference
+``models/embeddings/loader/WordVectorSerializer.java``): the word2vec C
+text and binary formats, readable by/from gensim & original word2vec.
+
+- text:   first line "V D", then "word v1 v2 ... vD" per line
+- binary: header "V D\\n", then per word: "word " + D float32 LE + "\\n"
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+
+
+class _StaticWordVectors:
+    """Read-only WordVectors view over a loaded (words, matrix) table —
+    what ``readWord2VecModel`` returns when no training state exists."""
+
+    def __init__(self, words: List[str], matrix: np.ndarray):
+        self._index = {w: i for i, w in enumerate(words)}
+        self._words = words
+        self._m = matrix
+
+    def has_word(self, w: str) -> bool:
+        return w in self._index
+
+    def get_word_vector(self, w: str):
+        i = self._index.get(w)
+        return None if i is None else self._m[i]
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self._m
+
+    def vocab_words(self) -> List[str]:
+        return list(self._words)
+
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(a @ b / (na * nb))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        from deeplearning4j_tpu.nlp.similarity import cosine_nearest
+
+        i = self._index.get(word)
+        if i is None:
+            return []
+        idxs = cosine_nearest(self._m, self._m[i], n, exclude_index=i)
+        return [self._words[j] for j in idxs]
+
+
+def _words_matrix(model) -> Tuple[List[str], np.ndarray]:
+    if hasattr(model, "vocab") and hasattr(model, "get_word_vector_matrix"):
+        return model.vocab.words(), model.get_word_vector_matrix()
+    if isinstance(model, _StaticWordVectors):
+        return model.vocab_words(), model.get_word_vector_matrix()
+    raise TypeError(f"Cannot serialize {type(model)}")
+
+
+class WordVectorSerializer:
+    # ------------------------------------------------------------------ text
+    @staticmethod
+    def write_word_vectors(model, path: str) -> None:
+        words, m = _words_matrix(model)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{len(words)} {m.shape[1]}\n")
+            for i, w in enumerate(words):
+                vec = " ".join(f"{x:.6f}" for x in m[i])
+                f.write(f"{w} {vec}\n")
+
+    @staticmethod
+    def read_word_vectors(path: str) -> _StaticWordVectors:
+        words: List[str] = []
+        rows: List[np.ndarray] = []
+        with open(path, "r", encoding="utf-8") as f:
+            header = f.readline().split()
+            V, D = int(header[0]), int(header[1])
+            for line in f:
+                # rsplit: the last D fields are the vector, everything
+                # before is the word (n-gram tokens contain spaces)
+                parts = line.rstrip("\n").rsplit(" ", D)
+                if len(parts) < D + 1:
+                    continue
+                words.append(parts[0])
+                rows.append(np.asarray(parts[1:], np.float32))
+        m = np.stack(rows) if rows else np.zeros((0, D), np.float32)
+        assert len(words) == V, f"header says {V} words, file has {len(words)}"
+        return _StaticWordVectors(words, m)
+
+    # ---------------------------------------------------------------- binary
+    @staticmethod
+    def write_word_vectors_binary(model, path: str) -> None:
+        words, m = _words_matrix(model)
+        m = np.asarray(m, "<f4")
+        with open(path, "wb") as f:
+            f.write(f"{len(words)} {m.shape[1]}\n".encode("utf-8"))
+            for i, w in enumerate(words):
+                f.write(w.encode("utf-8") + b" ")
+                f.write(m[i].tobytes())
+                f.write(b"\n")
+
+    @staticmethod
+    def read_word_vectors_binary(path: str) -> _StaticWordVectors:
+        with open(path, "rb") as f:
+            header = f.readline().decode("utf-8").split()
+            V, D = int(header[0]), int(header[1])
+            words: List[str] = []
+            m = np.zeros((V, D), np.float32)
+            for i in range(V):
+                chars = bytearray()
+                while True:
+                    c = f.read(1)
+                    if c == b" " or c == b"":
+                        break
+                    if c != b"\n":
+                        chars.extend(c)
+                words.append(chars.decode("utf-8"))
+                m[i] = np.frombuffer(f.read(4 * D), "<f4")
+                f.read(1)  # trailing newline
+        return _StaticWordVectors(words, m)
+
+    # --------------------------------------------- reference-parity aliases
+    writeWord2VecModel = write_word_vectors
+    readWord2VecModel = read_word_vectors

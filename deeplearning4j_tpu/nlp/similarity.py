@@ -1,0 +1,22 @@
+"""Shared cosine nearest-neighbour helper for all WordVectors-style
+query surfaces (Word2Vec, GloVe, SequenceVectors, serialized tables)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+def cosine_nearest(matrix: np.ndarray, vector: np.ndarray, n: int,
+                   exclude_index: Optional[int] = None) -> List[int]:
+    """Indices of the n rows of ``matrix`` most cosine-similar to
+    ``vector``, most similar first."""
+    m = np.asarray(matrix)
+    v = np.asarray(vector)
+    norms = np.linalg.norm(m, axis=1)
+    norms[norms == 0] = 1e-9
+    sims = (m @ v) / (norms * max(np.linalg.norm(v), 1e-9))
+    if exclude_index is not None:
+        sims[exclude_index] = -np.inf
+    return list(np.argsort(-sims)[:n])

@@ -40,8 +40,11 @@ class Tokenizer:
 
     def __init__(self, tokens: List[str],
                  preprocessor: Optional[TokenPreProcess] = None):
-        self._tokens = tokens
-        self._pre = preprocessor
+        # preprocess eagerly and drop tokens that normalize to "" so the
+        # streaming (next_token) and batch (get_tokens) paths agree
+        if preprocessor is not None:
+            tokens = [preprocessor.pre_process(t) for t in tokens]
+        self._tokens = [t for t in tokens if t]
         self._pos = 0
 
     def has_more_tokens(self) -> bool:
@@ -53,11 +56,10 @@ class Tokenizer:
     def next_token(self) -> str:
         t = self._tokens[self._pos]
         self._pos += 1
-        return self._pre.pre_process(t) if self._pre else t
+        return t
 
     def get_tokens(self) -> List[str]:
-        out = [self._pre.pre_process(t) if self._pre else t for t in self._tokens]
-        return [t for t in out if t]
+        return list(self._tokens)
 
 
 class TokenizerFactory:

@@ -1,0 +1,222 @@
+"""Word2Vec (reference ``models/word2vec/Word2Vec.java`` — Builder surface
+mirrored method-for-method) plus the WordVectors query interface
+(``wordsNearest``/``similarity``, reference ``ModelUtils``).
+
+Pipeline: SentenceIterator → TokenizerFactory → VocabConstructor →
+SequenceVectors (fixed-batch device training, nlp/sequence_vectors.py).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    CollectionSentenceIterator,
+    SentenceIterator,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabConstructor
+
+
+class Word2Vec:
+    """Facade with reference Builder parity; query methods implement the
+    WordVectors interface."""
+
+    class Builder:
+        def __init__(self):
+            self._iter: Optional[SentenceIterator] = None
+            self._tok: Optional[TokenizerFactory] = None
+            self._layer_size = 100
+            self._window = 5
+            self._min_word_frequency = 5
+            self._iterations = 1
+            self._epochs = 1
+            self._seed = 42
+            self._lr = 0.025
+            self._min_lr = 1e-4
+            self._negative = 5
+            self._use_hs = False
+            self._sampling = 0.0
+            self._batch_size = 512
+            self._stop_words: List[str] = []
+            self._limit_vocab = 0
+            self._algorithm = "skipgram"
+            self._workers = 1
+
+        def iterate(self, it) -> "Word2Vec.Builder":
+            if isinstance(it, (list, tuple)):
+                it = CollectionSentenceIterator(it)
+            self._iter = it
+            return self
+
+        def tokenizer_factory(self, tf: TokenizerFactory) -> "Word2Vec.Builder":
+            self._tok = tf
+            return self
+
+        def layer_size(self, n: int):
+            self._layer_size = int(n)
+            return self
+
+        def window_size(self, n: int):
+            self._window = int(n)
+            return self
+
+        def min_word_frequency(self, n: int):
+            self._min_word_frequency = int(n)
+            return self
+
+        def iterations(self, n: int):
+            self._iterations = int(n)
+            return self
+
+        def epochs(self, n: int):
+            self._epochs = int(n)
+            return self
+
+        def seed(self, n: int):
+            self._seed = int(n)
+            return self
+
+        def learning_rate(self, x: float):
+            self._lr = float(x)
+            return self
+
+        def min_learning_rate(self, x: float):
+            self._min_lr = float(x)
+            return self
+
+        def negative_sample(self, n: int):
+            self._negative = int(n)
+            return self
+
+        def use_hierarchic_softmax(self, b: bool):
+            self._use_hs = bool(b)
+            return self
+
+        def sampling(self, x: float):
+            self._sampling = float(x)
+            return self
+
+        def batch_size(self, n: int):
+            self._batch_size = int(n)
+            return self
+
+        def stop_words(self, words: Iterable[str]):
+            self._stop_words = list(words)
+            return self
+
+        def limit_vocabulary_size(self, n: int):
+            self._limit_vocab = int(n)
+            return self
+
+        def elements_learning_algorithm(self, name: str):
+            # reference takes class names like
+            # "org.deeplearning4j.models...SkipGram"; accept tail match
+            tail = name.rsplit(".", 1)[-1].lower()
+            self._algorithm = "cbow" if tail == "cbow" else "skipgram"
+            return self
+
+        def workers(self, n: int):
+            # host packing is single-threaded; device step is the hot path
+            self._workers = int(n)
+            return self
+
+        def windowSize(self, n: int):  # reference camelCase alias
+            return self.window_size(n)
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(self)
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    def __init__(self, b: "Word2Vec.Builder"):
+        self._b = b
+        self._tok = b._tok or DefaultTokenizerFactory()
+        self.vocab: Optional[AbstractCache] = None
+        self.sv: Optional[SequenceVectors] = None
+
+    # ------------------------------------------------------------------- fit
+    def _token_streams(self) -> List[List[str]]:
+        assert self._b._iter is not None, "Builder.iterate(...) required"
+        out = []
+        for sentence in self._b._iter:
+            out.append(self._tok.create(sentence).get_tokens())
+        return out
+
+    def fit(self) -> "Word2Vec":
+        """Build vocab then train (reference ``fit():193`` two-phase)."""
+        b = self._b
+        streams = self._token_streams()
+        self.vocab = VocabConstructor(
+            min_word_frequency=b._min_word_frequency,
+            stop_words=b._stop_words,
+            limit_vocabulary_size=b._limit_vocab,
+        ).build_joint_vocabulary(streams, build_huffman=b._use_hs)
+        if self.vocab.num_words() == 0:
+            raise ValueError("Empty vocabulary after pruning")
+        self.sv = SequenceVectors(
+            self.vocab,
+            layer_size=b._layer_size,
+            window=b._window,
+            negative=b._negative,
+            use_hierarchic_softmax=b._use_hs,
+            sampling=b._sampling,
+            learning_rate=b._lr,
+            min_learning_rate=b._min_lr,
+            iterations=b._iterations,
+            epochs=b._epochs,
+            batch_size=b._batch_size,
+            seed=b._seed,
+            elements_algorithm=b._algorithm,
+        )
+        seqs = []
+        for toks in streams:
+            ids = [self.vocab.index_of(t) for t in toks]
+            ids = np.asarray([i for i in ids if i >= 0], np.int32)
+            if len(ids):
+                seqs.append(ids)
+        self.sv.fit_sequences(seqs)
+        return self
+
+    # ------------------------------------------------- WordVectors interface
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and self.vocab.contains_word(word)
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        if not self.has_word(word):
+            return None
+        return self.sv.vector(self.vocab.index_of(word))
+
+    def get_word_vector_matrix(self) -> np.ndarray:
+        return self.sv.get_word_vector_matrix()
+
+    def similarity(self, w1: str, w2: str) -> float:
+        if not (self.has_word(w1) and self.has_word(w2)):
+            return float("nan")
+        return self.sv.similarity_by_index(
+            self.vocab.index_of(w1), self.vocab.index_of(w2)
+        )
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        if not self.has_word(word):
+            return []
+        idxs = self.sv.nearest_by_index(self.vocab.index_of(word), n)
+        return [self.vocab.word_at_index(i) for i in idxs]
+
+    def words_nearest_vec(self, vec: np.ndarray, n: int = 10) -> List[str]:
+        from deeplearning4j_tpu.nlp.similarity import cosine_nearest
+
+        idxs = cosine_nearest(self.get_word_vector_matrix(), vec, n)
+        return [self.vocab.word_at_index(i) for i in idxs]
+
+    @property
+    def last_loss(self) -> float:
+        return self.sv.last_loss if self.sv else float("nan")

@@ -141,23 +141,32 @@ class ShardedDataSetIterator(DataSetIterator):
         self.num_shards = num_shards
         self.shard_index = shard_index
 
+    def _shard(self, ds: DataSet) -> DataSet:
+        b = ds.features.shape[0]
+        if b % self.num_shards:
+            raise ValueError(
+                f"global batch {b} not divisible by {self.num_shards} hosts"
+            )
+        per = b // self.num_shards
+        lo = self.shard_index * per
+
+        def sl(a):
+            return None if a is None else a[lo:lo + per]
+
+        return DataSet(
+            sl(ds.features), sl(ds.labels),
+            sl(ds.features_mask), sl(ds.labels_mask),
+        )
+
+    def has_next(self) -> bool:
+        return self.base.has_next()
+
+    def next(self) -> DataSet:
+        return self._shard(self.base.next())
+
     def __iter__(self):
         for ds in self.base:
-            b = ds.features.shape[0]
-            if b % self.num_shards:
-                raise ValueError(
-                    f"global batch {b} not divisible by {self.num_shards} hosts"
-                )
-            per = b // self.num_shards
-            lo = self.shard_index * per
-
-            def sl(a):
-                return None if a is None else a[lo:lo + per]
-
-            yield DataSet(
-                sl(ds.features), sl(ds.labels),
-                sl(ds.features_mask), sl(ds.labels_mask),
-            )
+            yield self._shard(ds)
 
     def reset(self):
         self.base.reset()

@@ -562,6 +562,10 @@ class EarlyStoppingTrainer:
                     # (BaseEarlyStoppingTrainer.java catch-all in fit())
                     reason = "Error"
                     details = f"{type(e).__name__}: {e}"
+                    try:
+                        self.train_iterator.reset()  # clean for retry
+                    except Exception:
+                        pass
                     break
 
                 terminate = False

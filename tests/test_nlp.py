@@ -1,0 +1,355 @@
+"""NLP suite tests, mirroring the reference's word2vec sanity/similarity
+tests (``deeplearning4j-nlp/src/test`` — loss decreases on a real small
+corpus; words that share contexts end up similar; serialization
+round-trips; SURVEY.md §4.9).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    AbstractCache,
+    BagOfWordsVectorizer,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    Huffman,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    StopWords,
+    TfidfVectorizer,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+
+
+# --------------------------------------------------------------------------
+# synthetic two-topic corpus: animal words co-occur, tool words co-occur
+# --------------------------------------------------------------------------
+ANIMALS = ["cat", "dog", "horse", "cow", "sheep"]
+TOOLS = ["hammer", "wrench", "drill", "saw", "pliers"]
+
+
+def topic_corpus(n_sentences=400, seed=3):
+    rng = np.random.default_rng(seed)
+    sents = []
+    for _ in range(n_sentences):
+        group = ANIMALS if rng.random() < 0.5 else TOOLS
+        words = rng.choice(group, size=6, replace=True)
+        sents.append(" ".join(words))
+    return sents
+
+
+# --------------------------------------------------------------------------
+# pipeline pieces
+# --------------------------------------------------------------------------
+class TestTokenization:
+    def test_default_tokenizer(self):
+        tf = DefaultTokenizerFactory()
+        toks = tf.create("The quick brown fox").get_tokens()
+        assert toks == ["The", "quick", "brown", "fox"]
+
+    def test_common_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        toks = tf.create("Hello, World! 123 (test)").get_tokens()
+        assert toks == ["hello", "world", "test"]
+
+    def test_streaming_matches_batch(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        t = tf.create("Ab, 12 cd!")
+        streamed = []
+        while t.has_more_tokens():
+            streamed.append(t.next_token())
+        assert streamed == tf.create("Ab, 12 cd!").get_tokens()
+
+    def test_ngrams(self):
+        tf = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestIterators:
+    def test_collection_iterator_reset(self):
+        it = CollectionSentenceIterator(["one", "two"])
+        assert list(it) == ["one", "two"]
+        assert list(it) == ["one", "two"]  # reset via __iter__
+
+    def test_line_iterator(self, tmp_path):
+        p = tmp_path / "corpus.txt"
+        p.write_text("first line\nsecond line\n")
+        with BasicLineIterator(str(p)) as it:
+            assert list(it) == ["first line", "second line"]
+
+
+class TestVocab:
+    def test_counts_indices_pruning(self):
+        streams = [["a", "b", "a"], ["a", "c"]]
+        cache = VocabConstructor(min_word_frequency=2).build_joint_vocabulary(
+            streams
+        )
+        assert cache.contains_word("a")
+        assert not cache.contains_word("b")
+        assert cache.index_of("a") == 0  # most frequent first
+        assert cache.word_frequency("a") == 3
+
+    def test_stop_words_excluded(self):
+        streams = [["the", "cat", "the", "dog"]]
+        cache = VocabConstructor(
+            min_word_frequency=1, stop_words=StopWords.get_stop_words()
+        ).build_joint_vocabulary(streams)
+        assert not cache.contains_word("the")
+        assert cache.contains_word("cat")
+
+    def test_huffman_codes(self):
+        streams = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+        cache = VocabConstructor(min_word_frequency=1).build_joint_vocabulary(
+            streams
+        )
+        h = Huffman(cache).build()
+        words = {w.word: w for w in cache.vocab_words()}
+        # most frequent word gets the shortest code
+        assert len(words["a"].codes) <= len(words["d"].codes)
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in cache.vocab_words()]
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+        codes_arr, points_arr, lengths = h.padded_arrays()
+        assert codes_arr.shape == points_arr.shape
+        assert int(lengths.max()) == h.max_code_length
+        # inner-node ids are valid syn1 rows
+        assert points_arr.max() < cache.num_words() - 1
+
+
+# --------------------------------------------------------------------------
+# Word2Vec end-to-end
+# --------------------------------------------------------------------------
+class TestWord2Vec:
+    def _fit(self, **kw):
+        defaults = dict(
+            negative=5, hs=False, algorithm="skipgram", epochs=3, lr=0.05,
+        )
+        defaults.update(kw)
+        b = (
+            Word2Vec.builder()
+            .iterate(topic_corpus())
+            .layer_size(24)
+            .window_size(3)
+            .min_word_frequency(2)
+            .seed(11)
+            .learning_rate(defaults["lr"])
+            .epochs(defaults["epochs"])
+            .batch_size(256)
+            .negative_sample(defaults["negative"])
+            .use_hierarchic_softmax(defaults["hs"])
+            .elements_learning_algorithm(defaults["algorithm"])
+        )
+        return b.build().fit()
+
+    def _assert_topic_structure(self, w2v, margin=0.2):
+        within = np.mean([
+            w2v.similarity(a, b)
+            for a in ANIMALS for b in ANIMALS if a != b
+        ])
+        across = np.mean([
+            w2v.similarity(a, t) for a in ANIMALS for t in TOOLS
+        ])
+        assert within > across + margin, (
+            f"within-topic {within:.3f} not above cross-topic {across:.3f}"
+        )
+
+    def test_skipgram_negative_sampling_learns_topics(self):
+        w2v = self._fit()
+        assert np.isfinite(w2v.last_loss)
+        self._assert_topic_structure(w2v)
+        # nearest neighbours of an animal are mostly animals
+        near = w2v.words_nearest("cat", 3)
+        assert sum(w in ANIMALS for w in near) >= 2
+
+    def test_skipgram_hierarchical_softmax(self):
+        # HS on a 10-word vocab shares most of the Huffman path between
+        # words → separation is slower; more epochs, smaller margin
+        w2v = self._fit(negative=0, hs=True, epochs=10)
+        self._assert_topic_structure(w2v, margin=0.05)
+
+    def test_cbow(self):
+        # CBOW's per-row mean updates need more passes on a tiny vocab
+        w2v = self._fit(algorithm="CBOW", epochs=20, lr=0.1)
+        self._assert_topic_structure(w2v)
+
+    def test_loss_decreases(self):
+        w2v = (
+            Word2Vec.builder().iterate(topic_corpus()).layer_size(16)
+            .window_size(3).min_word_frequency(2).seed(5).learning_rate(0.05)
+            .epochs(5).batch_size(256).negative_sample(5).build().fit()
+        )
+        losses = w2v.sv.epoch_losses
+        assert len(losses) == 5
+        assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+    def test_unknown_word_handling(self):
+        w2v = self._fit()
+        assert w2v.get_word_vector("zebra") is None
+        assert np.isnan(w2v.similarity("zebra", "cat"))
+        assert w2v.words_nearest("zebra") == []
+
+
+class TestSerialization:
+    def _small_model(self):
+        return (
+            Word2Vec.builder().iterate(topic_corpus(100)).layer_size(8)
+            .window_size(2).min_word_frequency(2).seed(1).epochs(1)
+            .batch_size(128).negative_sample(3).build().fit()
+        )
+
+    def test_text_roundtrip(self, tmp_path):
+        w2v = self._small_model()
+        p = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.write_word_vectors(w2v, p)
+        loaded = WordVectorSerializer.read_word_vectors(p)
+        for w in w2v.vocab.words():
+            np.testing.assert_allclose(
+                loaded.get_word_vector(w), w2v.get_word_vector(w), atol=1e-5
+            )
+        # similarity structure preserved
+        assert loaded.similarity("cat", "dog") == pytest.approx(
+            w2v.similarity("cat", "dog"), abs=1e-4
+        )
+
+    def test_binary_roundtrip(self, tmp_path):
+        w2v = self._small_model()
+        p = str(tmp_path / "vecs.bin")
+        WordVectorSerializer.write_word_vectors_binary(w2v, p)
+        loaded = WordVectorSerializer.read_word_vectors_binary(p)
+        for w in w2v.vocab.words():
+            np.testing.assert_allclose(
+                loaded.get_word_vector(w), w2v.get_word_vector(w), atol=1e-6
+            )
+
+
+# --------------------------------------------------------------------------
+# ParagraphVectors
+# --------------------------------------------------------------------------
+class TestParagraphVectors:
+    def _docs(self, n=60, seed=9):
+        rng = np.random.default_rng(seed)
+        docs = []
+        for k in range(n):
+            topic = "animals" if k % 2 == 0 else "tools"
+            group = ANIMALS if topic == "animals" else TOOLS
+            words = rng.choice(group, size=8, replace=True)
+            docs.append((" ".join(words), [f"doc_{k}", topic]))
+        return docs
+
+    def test_dbow_label_vectors_cluster_by_topic(self):
+        pv = (
+            ParagraphVectors.builder().iterate(self._docs())
+            .layer_size(16).min_word_frequency(1).epochs(3)
+            .negative_sample(5).seed(4).learning_rate(0.05)
+            .batch_size(128).build().fit()
+        )
+        sim_same = pv.similarity("animals", "tools")
+        v_animals = pv.get_paragraph_vector("animals")
+        v_tools = pv.get_paragraph_vector("tools")
+        assert v_animals is not None and v_tools is not None
+        # an animal doc label should be closer to "animals" than "tools"
+        same = np.mean([pv.similarity("doc_0", "animals"),
+                        pv.similarity("doc_2", "animals")])
+        cross = np.mean([pv.similarity("doc_0", "tools"),
+                         pv.similarity("doc_2", "tools")])
+        assert same > cross
+
+    def test_dm_trains(self):
+        pv = (
+            ParagraphVectors.builder().iterate(self._docs(30))
+            .layer_size(12).epochs(2).negative_sample(3).seed(4)
+            .sequence_learning_algorithm("DM").batch_size(64).build().fit()
+        )
+        assert pv.get_paragraph_vector("animals") is not None
+
+    def test_infer_vector_nearest_label(self):
+        pv = (
+            ParagraphVectors.builder().iterate(self._docs())
+            .layer_size(16).epochs(3).negative_sample(5).seed(4)
+            .learning_rate(0.05).batch_size(128).build().fit()
+        )
+        v = pv.infer_vector("cat dog horse cow")
+        assert v.shape == (16,)
+        assert np.all(np.isfinite(v))
+        labels = pv.nearest_labels("cat dog horse cow sheep cat", n=4)
+        assert len(labels) == 4
+
+
+# --------------------------------------------------------------------------
+# GloVe
+# --------------------------------------------------------------------------
+class TestGlove:
+    def test_glove_learns_topics(self):
+        g = (
+            Glove.builder().iterate(topic_corpus(300)).layer_size(16)
+            .window_size(3).min_word_frequency(2).epochs(8)
+            .learning_rate(0.1).seed(2).batch_size(512).build().fit()
+        )
+        assert np.isfinite(g.last_loss)
+        within = np.mean([
+            g.similarity(a, b) for a in ANIMALS for b in ANIMALS if a != b
+        ])
+        across = np.mean([g.similarity(a, t) for a in ANIMALS for t in TOOLS])
+        assert within > across, f"within {within:.3f} <= across {across:.3f}"
+
+
+# --------------------------------------------------------------------------
+# Bag of words / TF-IDF
+# --------------------------------------------------------------------------
+class TestVectorizers:
+    def test_bow_counts(self):
+        v = (
+            BagOfWordsVectorizer.builder()
+            .iterate(["cat dog cat", "dog hammer"])
+            .min_word_frequency(1).build().fit()
+        )
+        x = v.transform("cat cat dog")
+        assert x[v.vocab.index_of("cat")] == 2.0
+        assert x[v.vocab.index_of("dog")] == 1.0
+
+    def test_tfidf_downweights_common_terms(self):
+        v = (
+            TfidfVectorizer.builder()
+            .iterate(["cat dog", "cat hammer", "cat wrench"])
+            .min_word_frequency(1).build().fit()
+        )
+        x = v.transform("cat hammer")
+        # "cat" appears in every doc → lower idf than "hammer"
+        assert x[v.vocab.index_of("hammer")] > x[v.vocab.index_of("cat")]
+
+    def test_transform_all_shape(self):
+        v = (
+            BagOfWordsVectorizer.builder().iterate(["a b", "b c"])
+            .min_word_frequency(1).build().fit()
+        )
+        m = v.transform_all(["a", "b c"])
+        assert m.shape == (2, v.vocab.num_words())
+
+    def test_text_roundtrip_with_spaced_ngram_tokens(self):
+        """Tokens containing spaces (n-grams) must survive the text
+        format (reader splits from the right)."""
+        from deeplearning4j_tpu.nlp.serializer import _StaticWordVectors
+        import tempfile, os
+        words = ["new york", "cat", "san francisco bay"]
+        m = np.arange(9, dtype=np.float32).reshape(3, 3)
+        sw = _StaticWordVectors(words, m)
+        p = os.path.join(tempfile.mkdtemp(), "ng.txt")
+        WordVectorSerializer.write_word_vectors(sw, p)
+        loaded = WordVectorSerializer.read_word_vectors(p)
+        for w in words:
+            np.testing.assert_allclose(loaded.get_word_vector(w),
+                                       sw.get_word_vector(w), atol=1e-5)
