@@ -18,10 +18,34 @@ from deeplearning4j_tpu.data.iterators import (
     TestDataSetIterator,
 )
 
+from deeplearning4j_tpu.data.records import (
+    ALIGN_END,
+    ALIGN_START,
+    CSVRecordReader,
+    CollectionRecordReader,
+    EQUAL_LENGTH,
+    ImageRecordReader,
+    RecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReader,
+    SequenceRecordReaderDataSetIterator,
+)
+from deeplearning4j_tpu.data.fetchers import (
+    SvhnDataSetIterator,
+    TinyImageNetDataSetIterator,
+    UciSequenceDataSetIterator,
+)
+
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "ExistingDataSetIterator", "AsyncDataSetIterator", "BenchmarkDataSetIterator",
     "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "TestDataSetIterator",
     "MultiDataSetIterator", "ExistingMultiDataSetIterator",
+    "RecordReader", "CollectionRecordReader", "CSVRecordReader",
+    "ImageRecordReader", "SequenceRecordReader",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "ALIGN_START", "ALIGN_END", "EQUAL_LENGTH",
+    "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
+    "UciSequenceDataSetIterator",
 ]

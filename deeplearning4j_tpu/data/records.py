@@ -1,0 +1,349 @@
+"""RecordReader data bridge — the DataVec-iterator equivalent
+(SURVEY.md §2.2: ``deeplearning4j-datavec-iterators``,
+``RecordReaderDataSetIterator.java`` 2,060 LoC; DataVec record readers are
+an external dependency of the reference — both halves are rebuilt here).
+
+Readers yield per-record value lists; the iterators assemble them into
+DataSet minibatches in the reference's three modes: classification
+(label index + one-hot), regression (label column range), and sequence
+(one reader per side with ALIGN_START/ALIGN_END/EQUAL_LENGTH alignment +
+masks). All array assembly is host-side numpy ETL; the device sees only
+finished, rectangular minibatches.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+
+
+# --------------------------------------------------------------------------
+# RecordReader SPI (DataVec ``RecordReader``)
+# --------------------------------------------------------------------------
+class RecordReader:
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_record(self) -> List:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_record()
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference ``CollectionRecordReader``)."""
+
+    def __init__(self, records: Iterable[Sequence]):
+        self._records = [list(r) for r in records]
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._records)
+
+    def next_record(self) -> List:
+        r = self._records[self._pos]
+        self._pos += 1
+        return list(r)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class CSVRecordReader(RecordReader):
+    """CSV file → one record per line (DataVec ``CSVRecordReader``:
+    skip-lines + delimiter)."""
+
+    def __init__(self, path: str, skip_num_lines: int = 0,
+                 delimiter: str = ","):
+        self.path = path
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._rows: List[List[str]] = []
+        self._pos = 0
+        self.reset()
+
+    def reset(self) -> None:
+        with open(self.path, "r", encoding="utf-8", newline="") as f:
+            rows = list(csv.reader(f, delimiter=self.delimiter))
+        self._rows = [r for r in rows[self.skip:] if r]
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._rows)
+
+    def next_record(self) -> List[str]:
+        r = self._rows[self._pos]
+        self._pos += 1
+        return list(r)
+
+
+class ImageRecordReader(RecordReader):
+    """Image directory → (flattened image..., label) records (DataVec
+    ``ImageRecordReader`` with ``ParentPathLabelGenerator``): images under
+    ``root/<label>/...``; resized to (height, width), channels-last,
+    scaled to [0, 1]."""
+
+    EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 root: Optional[str] = None):
+        self.height, self.width, self.channels = height, width, channels
+        self.labels: List[str] = []
+        self._files: List[tuple] = []
+        self._pos = 0
+        if root is not None:
+            self.initialize(root)
+
+    def initialize(self, root: str) -> "ImageRecordReader":
+        self.labels = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+        self._files = []
+        for li, label in enumerate(self.labels):
+            d = os.path.join(root, label)
+            for name in sorted(os.listdir(d)):
+                if name.lower().endswith(self.EXTS):
+                    self._files.append((os.path.join(d, name), li))
+        self._pos = 0
+        return self
+
+    def num_labels(self) -> int:
+        return len(self.labels)
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._files)
+
+    def next_record(self) -> List:
+        from PIL import Image
+
+        path, label = self._files[self._pos]
+        self._pos += 1
+        img = Image.open(path)
+        img = img.convert("RGB" if self.channels == 3 else "L")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, np.float32) / 255.0
+        if self.channels == 1 and arr.ndim == 2:
+            arr = arr[..., None]
+        return [arr, label]
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class SequenceRecordReader(RecordReader):
+    """One sequence per file: each file is a CSV whose rows are timesteps
+    (DataVec ``CSVSequenceRecordReader``). ``paths`` may be a directory
+    (all files, sorted) or an explicit list."""
+
+    def __init__(self, paths: Union[str, List[str]], skip_num_lines: int = 0,
+                 delimiter: str = ","):
+        if isinstance(paths, str):
+            self.paths = [
+                os.path.join(paths, f) for f in sorted(os.listdir(paths))
+                if os.path.isfile(os.path.join(paths, f))
+                and not f.startswith(".")
+            ]
+        else:
+            self.paths = list(paths)
+        self.skip = skip_num_lines
+        self.delimiter = delimiter
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.paths)
+
+    def next_record(self) -> List[List[str]]:
+        """One SEQUENCE: list of timestep value-lists."""
+        with open(self.paths[self._pos], "r", encoding="utf-8", newline="") as f:
+            rows = list(csv.reader(f, delimiter=self.delimiter))
+        self._pos += 1
+        return [r for r in rows[self.skip:] if r]
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+# --------------------------------------------------------------------------
+# iterators
+# --------------------------------------------------------------------------
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Reference ``RecordReaderDataSetIterator``:
+
+    - classification: ``label_index`` + ``num_possible_labels`` → one-hot
+    - regression: ``label_index_from``/``label_index_to`` (inclusive)
+    - no label args → features-only DataSets
+    - image records ([array, label]) are detected automatically
+    """
+
+    def __init__(self, record_reader: RecordReader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_from: Optional[int] = None,
+                 label_index_to: Optional[int] = None):
+        self.reader = record_reader
+        self.batch_size = int(batch_size)
+        self.label_index = label_index
+        self.num_labels = num_possible_labels
+        self.regression = regression
+        self.label_from = label_index_from
+        self.label_to = label_index_to
+        if regression and label_index is not None and label_index_from is None:
+            self.label_from = self.label_to = label_index
+
+    def has_next(self) -> bool:
+        return self.reader.has_next()
+
+    def next(self) -> DataSet:
+        feats, labels = [], []
+        n = 0
+        while self.reader.has_next() and n < self.batch_size:
+            rec = self.reader.next_record()
+            f, l = self._split(rec)
+            feats.append(f)
+            if l is not None:
+                labels.append(l)
+            n += 1
+        x = np.stack(feats).astype(np.float32)
+        y = np.stack(labels).astype(np.float32) if labels else None
+        return DataSet(x, y)
+
+    def _split(self, rec: List):
+        # image record: [ndarray, int label]
+        if len(rec) == 2 and isinstance(rec[0], np.ndarray):
+            f = rec[0]
+            if self.num_labels is None:
+                raise ValueError("num_possible_labels required for image records")
+            return f, np.eye(self.num_labels, dtype=np.float32)[int(rec[1])]
+        vals = np.asarray([float(v) for v in rec], np.float32)
+        if self.regression and self.label_from is not None:
+            lo, hi = self.label_from, self.label_to
+            y = vals[lo:hi + 1]
+            f = np.concatenate([vals[:lo], vals[hi + 1:]])
+            return f, y
+        if self.label_index is not None:
+            li = self.label_index % len(vals)  # python-style negative index
+            cls = int(vals[li])
+            f = np.concatenate([vals[:li], vals[li + 1:]])
+            if self.num_labels is None:
+                raise ValueError("num_possible_labels required for classification")
+            return f, np.eye(self.num_labels, dtype=np.float32)[cls]
+        return vals, None
+
+    def reset(self) -> None:
+        self.reader.reset()
+
+    def async_supported(self) -> bool:
+        return False
+
+
+ALIGN_START = "ALIGN_START"
+ALIGN_END = "ALIGN_END"
+EQUAL_LENGTH = "EQUAL_LENGTH"
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """Reference ``SequenceRecordReaderDataSetIterator``: separate feature
+    and label sequence readers (or a single reader with a label column),
+    padded to the batch max length with masks; ``alignment_mode`` places
+    shorter sequences at the start or end (ALIGN_END = the reference's
+    choice for seq-to-one labelling)."""
+
+    def __init__(self, features_reader: SequenceRecordReader,
+                 labels_reader: Optional[SequenceRecordReader] = None,
+                 batch_size: int = 8,
+                 num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 alignment_mode: str = EQUAL_LENGTH,
+                 label_index: Optional[int] = None):
+        self.freader = features_reader
+        self.lreader = labels_reader
+        self.batch_size = int(batch_size)
+        self.num_labels = num_possible_labels
+        self.regression = regression
+        self.alignment = alignment_mode
+        self.label_index = label_index
+
+    def has_next(self) -> bool:
+        return self.freader.has_next()
+
+    def _label_steps(self, rows: List[List[str]]) -> np.ndarray:
+        vals = np.asarray([[float(v) for v in r] for r in rows], np.float32)
+        if self.regression:
+            return vals
+        if vals.shape[1] != 1:
+            raise ValueError("classification label rows must be single-column")
+        return np.eye(self.num_labels, dtype=np.float32)[
+            vals[:, 0].astype(int)
+        ]
+
+    def next(self) -> DataSet:
+        fs, ls = [], []
+        n = 0
+        while self.freader.has_next() and n < self.batch_size:
+            frows = self.freader.next_record()
+            fvals = np.asarray([[float(v) for v in r] for r in frows],
+                               np.float32)
+            if self.lreader is not None:
+                ls.append(self._label_steps(self.lreader.next_record()))
+            elif self.label_index is not None:
+                li = self.label_index
+                ls.append(self._label_steps(
+                    [[r[li]] for r in frows]
+                ))
+                fvals = np.delete(fvals, li, axis=1)
+            fs.append(fvals)
+            n += 1
+
+        T = max(f.shape[0] for f in fs)
+        if ls:
+            T = max(T, max(l.shape[0] for l in ls))
+        if self.alignment == EQUAL_LENGTH:
+            if any(f.shape[0] != T for f in fs) or (
+                ls and any(l.shape[0] != T for l in ls)
+            ):
+                raise ValueError(
+                    "sequences differ in length (features and labels must "
+                    "all match); use ALIGN_START or ALIGN_END"
+                )
+        B = len(fs)
+        x = np.zeros((B, T, fs[0].shape[1]), np.float32)
+        fmask = np.zeros((B, T), np.float32)
+        y = lmask = None
+        if ls:
+            y = np.zeros((B, T, ls[0].shape[1]), np.float32)
+            lmask = np.zeros((B, T), np.float32)
+        for i, f in enumerate(fs):
+            t = f.shape[0]
+            off = T - t if self.alignment == ALIGN_END else 0
+            x[i, off:off + t] = f
+            fmask[i, off:off + t] = 1.0
+            if ls:
+                lt = ls[i].shape[0]
+                loff = T - lt if self.alignment == ALIGN_END else 0
+                y[i, loff:loff + lt] = ls[i]
+                lmask[i, loff:loff + lt] = 1.0
+        if self.alignment == EQUAL_LENGTH:
+            fmask = lmask = None
+        return DataSet(x, y, fmask, lmask)
+
+    def reset(self) -> None:
+        self.freader.reset()
+        if self.lreader is not None:
+            self.lreader.reset()
+
+    def async_supported(self) -> bool:
+        return False

@@ -1,0 +1,27 @@
+"""Observability / UI — rebuild of the reference's ui-parent stack
+(SURVEY.md §2.8, 23,629 LoC: StatsListener → SBE codecs → StatsStorage →
+Play dashboard).
+
+TPU-native shape: the listener collects the same per-iteration signals
+(score, timings, memory, per-layer parameter/update statistics and
+histograms at ``reportingFrequency``), the wire format is JSONL instead
+of SBE (human-greppable, append-only, trivially mergeable across hosts),
+storage is in-memory or file-backed, and the dashboard is one
+self-contained static HTML file with inline SVG charts — no web server,
+no JS dependencies, works over any file transfer (``TrainModule``'s
+overview/model/system pages collapse into sections of one report).
+"""
+
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.dashboard import UIServer, render_dashboard
+from deeplearning4j_tpu.ui.evaluation_tools import EvaluationTools
+
+__all__ = [
+    "StatsListener", "StatsStorage", "InMemoryStatsStorage",
+    "FileStatsStorage", "UIServer", "render_dashboard", "EvaluationTools",
+]

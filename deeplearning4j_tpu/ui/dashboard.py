@@ -1,0 +1,191 @@
+"""Static HTML dashboard (replaces the reference's Play-framework
+``TrainModule`` overview/model/system pages, ``ui/play/PlayUIServer.java``):
+one self-contained file with inline SVG charts — score vs iteration,
+update:parameter ratios per layer, throughput, memory — generated from a
+StatsStorage. ``UIServer.attach(storage)`` + ``render()`` mirrors the
+reference's attach-and-browse workflow without a web server.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+_PALETTE = ["#2563eb", "#dc2626", "#16a34a", "#9333ea", "#ea580c",
+            "#0891b2", "#ca8a04", "#db2777", "#4b5563", "#65a30d"]
+
+
+def _svg_line_chart(series: Dict[str, List[Tuple[float, float]]],
+                    title: str, w: int = 640, h: int = 260,
+                    log_y: bool = False) -> str:
+    """Multi-series line chart as inline SVG (no JS dependencies)."""
+    pad = 46
+    pts_all = [p for pts in series.values() for p in pts]
+    if not pts_all:
+        return f"<h3>{html.escape(title)}</h3><p>(no data)</p>"
+
+    def ty(v):
+        if log_y:
+            return math.log10(max(v, 1e-12))
+        return v
+
+    xs = [p[0] for p in pts_all]
+    ys = [ty(p[1]) for p in pts_all if math.isfinite(ty(p[1]))]
+    if not ys:
+        return f"<h3>{html.escape(title)}</h3><p>(no finite data)</p>"
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(ys), max(ys)
+    if x1 == x0:
+        x1 = x0 + 1
+    if y1 == y0:
+        y1 = y0 + 1
+
+    def sx(x):
+        return pad + (x - x0) / (x1 - x0) * (w - 2 * pad)
+
+    def sy(y):
+        return h - pad - (ty(y) - y0) / (y1 - y0) * (h - 2 * pad)
+
+    parts = [
+        f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}" '
+        'style="background:#fff;border:1px solid #e5e7eb;border-radius:6px">',
+        f'<text x="{w // 2}" y="18" text-anchor="middle" '
+        f'style="font:600 13px sans-serif">{html.escape(title)}</text>',
+    ]
+    # axes + gridlines with labels
+    for i in range(5):
+        gy = pad + i * (h - 2 * pad) / 4
+        val = y1 - i * (y1 - y0) / 4
+        label = f"1e{val:.1f}" if log_y else f"{val:.4g}"
+        parts.append(
+            f'<line x1="{pad}" y1="{gy:.1f}" x2="{w - pad}" y2="{gy:.1f}" '
+            'stroke="#f3f4f6"/>'
+            f'<text x="{pad - 4}" y="{gy + 4:.1f}" text-anchor="end" '
+            f'style="font:10px sans-serif" fill="#6b7280">{label}</text>'
+        )
+    for i in range(5):
+        gx = pad + i * (w - 2 * pad) / 4
+        val = x0 + i * (x1 - x0) / 4
+        parts.append(
+            f'<text x="{gx:.1f}" y="{h - pad + 14}" text-anchor="middle" '
+            f'style="font:10px sans-serif" fill="#6b7280">{val:.4g}</text>'
+        )
+    for idx, (name, pts) in enumerate(sorted(series.items())):
+        color = _PALETTE[idx % len(_PALETTE)]
+        d = " ".join(
+            f"{'M' if i == 0 else 'L'}{sx(x):.1f},{sy(y):.1f}"
+            for i, (x, y) in enumerate(pts)
+            if not (math.isnan(y) or math.isinf(y))
+        )
+        if d:
+            parts.append(f'<path d="{d}" fill="none" stroke="{color}" '
+                         'stroke-width="1.6"/>')
+        ly = 30 + 13 * idx
+        parts.append(
+            f'<rect x="{w - pad - 120}" y="{ly - 8}" width="9" height="9" '
+            f'fill="{color}"/>'
+            f'<text x="{w - pad - 107}" y="{ly}" '
+            f'style="font:10px sans-serif">{html.escape(str(name)[:22])}</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def render_dashboard(storage: StatsStorage, session_id: Optional[str] = None,
+                     path: Optional[str] = None) -> str:
+    """Build the HTML report; writes to ``path`` if given. Sections mirror
+    the reference TrainModule: Overview (score/throughput), Model
+    (update:param ratios, per-layer stats), System (memory)."""
+    sessions = storage.list_session_ids()
+    if session_id is None:
+        if not sessions:
+            raise ValueError("storage holds no sessions")
+        session_id = sessions[-1]
+    all_records = storage.get_records(session_id)
+    records = [r for r in all_records if r["kind"] == "update"]
+    init = next((r for r in all_records if r["kind"] == "init"), None)
+
+    score = {"score": [(r["iteration"], r["score"]) for r in records
+                       if r.get("score") is not None]}
+    rate = {"iter/sec": [(r["iteration"], r["iterations_per_sec"])
+                         for r in records if "iterations_per_sec" in r]}
+    mem = {"rss MB": [(r["iteration"], r["memory_rss_mb"]) for r in records]}
+    ratios: Dict[str, List[Tuple[float, float]]] = {}
+    pmeans: Dict[str, List[Tuple[float, float]]] = {}
+    for r in records:
+        for k, v in r.get("update_param_ratio", {}).items():
+            ratios.setdefault(k, []).append((r["iteration"], v))
+        for k, v in r.get("parameters", {}).items():
+            pmeans.setdefault(k, []).append((r["iteration"], v["stdev"]))
+
+    meta = ""
+    if init is not None:
+        meta = (
+            f"<p>{html.escape(init['model_class'])} — "
+            f"{init['num_params']:,} parameters — layers: "
+            f"{html.escape(', '.join(map(str, init['layer_names'])))}</p>"
+        )
+    doc = f"""<!doctype html>
+<html><head><meta charset="utf-8">
+<title>Training: {html.escape(session_id)}</title>
+<style>body{{font-family:sans-serif;max-width:1400px;margin:24px auto;
+padding:0 16px;color:#111827}} .row{{display:flex;flex-wrap:wrap;gap:16px}}
+h2{{border-bottom:2px solid #e5e7eb;padding-bottom:4px}}</style></head>
+<body>
+<h1>Training dashboard — {html.escape(session_id)}</h1>
+{meta}
+<h2>Overview</h2>
+<div class="row">
+{_svg_line_chart(score, "Score vs Iteration")}
+{_svg_line_chart(rate, "Iterations / sec")}
+</div>
+<h2>Model</h2>
+<div class="row">
+{_svg_line_chart(ratios, "Update : Parameter ratio (log10)", log_y=True)}
+{_svg_line_chart(pmeans, "Parameter stdev per layer")}
+</div>
+<h2>System</h2>
+<div class="row">
+{_svg_line_chart(mem, "Host memory (RSS, MB)")}
+</div>
+<p style="color:#6b7280">records: {len(records)} · generated by
+deeplearning4j_tpu</p>
+</body></html>"""
+    if path is not None:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(doc)
+    return doc
+
+
+class UIServer:
+    """Workflow-parity facade (reference ``UIServer.getInstance().attach``):
+    attach storages, then ``render(path)`` the static dashboard (instead of
+    serving HTTP)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self):
+        self.storages: List[StatsStorage] = []
+
+    @classmethod
+    def get_instance(cls) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer()
+        return cls._instance
+
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self.storages:
+            self.storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self.storages:
+            self.storages.remove(storage)
+
+    def render(self, path: str, session_id: Optional[str] = None) -> str:
+        if not self.storages:
+            raise ValueError("No storage attached")
+        return render_dashboard(self.storages[-1], session_id, path)

@@ -1,0 +1,54 @@
+"""EvaluationTools (reference
+``deeplearning4j-core/.../evaluation/EvaluationTools.java``): export ROC
+and calibration charts as standalone HTML."""
+
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+from deeplearning4j_tpu.ui.dashboard import _svg_line_chart
+
+
+class EvaluationTools:
+    @staticmethod
+    def roc_chart_html(roc, title: str = "ROC") -> str:
+        fpr, tpr = roc.get_roc_curve()
+        series = {
+            f"AUC={roc.calculate_auc():.4f}": list(zip(fpr.tolist(), tpr.tolist())),
+            "chance": [(0.0, 0.0), (1.0, 1.0)],
+        }
+        return _svg_line_chart(series, title)
+
+    @staticmethod
+    def export_roc_charts_to_html_file(roc, path: str,
+                                       title: str = "ROC") -> None:
+        """(reference ``exportRocChartsToHtmlFile``)"""
+        body = EvaluationTools.roc_chart_html(roc, title)
+        _write(path, title, body)
+
+    @staticmethod
+    def calibration_chart_html(cal, cls: int = 0,
+                               title: str = "Reliability") -> str:
+        mean_pred, frac_pos, _counts = cal.reliability_curve(cls)
+        series = {
+            f"class {cls} (ECE={cal.expected_calibration_error(cls):.4f})":
+                list(zip(mean_pred.tolist(), frac_pos.tolist())),
+            "perfect": [(0.0, 0.0), (1.0, 1.0)],
+        }
+        return _svg_line_chart(series, title)
+
+    @staticmethod
+    def export_calibration_to_html_file(cal, path: str, cls: int = 0,
+                                        title: str = "Calibration") -> None:
+        body = EvaluationTools.calibration_chart_html(cal, cls, title)
+        _write(path, title, body)
+
+
+def _write(path: str, title: str, body: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(
+            f"<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title></head>"
+            f"<body style='font-family:sans-serif'>{body}</body></html>"
+        )

@@ -1,0 +1,143 @@
+"""StatsListener (reference ``ui/stats/BaseStatsListener.java:43`` —
+collects score, timings, memory, per-layer parameter/gradient/update
+statistics and histograms at ``reportingFrequency``, ``:231-268``).
+
+TPU adaptation: the reference reads gradients mid-step via listener
+hooks inside its imperative loop; here the whole step is one XLA program,
+so update statistics are computed as the OBSERVED parameter delta between
+reporting iterations (update = lr·step actually applied — the quantity
+the update:parameter-ratio chart is meant to show). Collection cost is
+paid only at reporting iterations.
+"""
+
+from __future__ import annotations
+
+import resource
+import time
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+def _param_arrays(model) -> Dict[str, np.ndarray]:
+    """name → array over both model types (MLN list / CG dict layout)."""
+    out = {}
+    if isinstance(model.params_, dict):  # ComputationGraph
+        for lname, p in model.params_.items():
+            for k, v in p.items():
+                out[f"{lname}_{k}"] = np.asarray(v)
+    else:  # MultiLayerNetwork
+        for i, p in enumerate(model.params_):
+            for k, v in p.items():
+                out[f"{i}_{k}"] = np.asarray(v)
+    return out
+
+
+def _summary(arrs: Dict[str, np.ndarray], histograms: bool,
+             bins: int) -> Dict[str, dict]:
+    out = {}
+    for name, a in arrs.items():
+        flat = a.reshape(-1).astype(np.float64)
+        entry = {
+            "mean": float(flat.mean()) if flat.size else 0.0,
+            "stdev": float(flat.std()) if flat.size else 0.0,
+            "mean_magnitude": float(np.abs(flat).mean()) if flat.size else 0.0,
+        }
+        if histograms and flat.size:
+            counts, edges = np.histogram(flat, bins=bins)
+            entry["histogram"] = {
+                "min": float(edges[0]), "max": float(edges[-1]),
+                "counts": counts.tolist(),
+            }
+        out[name] = entry
+    return out
+
+
+class StatsListener(TrainingListener):
+    def __init__(self, storage: StatsStorage, reporting_frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "worker_0",
+                 collect_histograms: bool = True, histogram_bins: int = 20):
+        self.storage = storage
+        self.frequency = max(int(reporting_frequency), 1)
+        self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.bins = histogram_bins
+        self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._last_time: Optional[float] = None
+        self._last_iter_for_rate: Optional[int] = None
+        self._initialized = False
+
+    # ------------------------------------------------------------------ init
+    def _put_init(self, model):
+        layer_names: List[str]
+        if isinstance(model.params_, dict):
+            layer_names = list(model.layer_names)
+        else:
+            layer_names = [type(l).__name__ for l in model.layers]
+        self.storage.put_record({
+            "kind": "init",
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": time.time(),
+            "model_class": type(model).__name__,
+            "layer_names": layer_names,
+            "num_params": int(model.num_params()),
+        })
+        self._initialized = True
+
+    # ------------------------------------------------------------- iteration
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        if not self._initialized:
+            self._put_init(model)
+        if iteration != 1 and iteration % self.frequency != 0:
+            return
+        now = time.time()
+        params = _param_arrays(model)
+
+        record = {
+            "kind": "update",
+            "session_id": self.session_id,
+            "worker_id": self.worker_id,
+            "timestamp": now,
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": float(model.score_) if model.score_ is not None else None,
+            "memory_rss_mb": resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+        }
+        if self._last_time is not None and self._last_iter_for_rate is not None:
+            dt = now - self._last_time
+            di = iteration - self._last_iter_for_rate
+            if dt > 0 and di > 0:
+                record["iterations_per_sec"] = di / dt
+        self._last_time = now
+        self._last_iter_for_rate = iteration
+
+        record["parameters"] = _summary(params, self.collect_histograms, self.bins)
+        if self._prev_params is not None:
+            updates = {
+                k: params[k] - self._prev_params[k]
+                for k in params if k in self._prev_params
+            }
+            record["updates"] = _summary(updates, self.collect_histograms, self.bins)
+            # update:parameter mean-magnitude ratio — the canonical
+            # learning-health chart (reference TrainModule "Update:Param
+            # Ratios" page)
+            record["update_param_ratio"] = {
+                k: (record["updates"][k]["mean_magnitude"]
+                    / max(record["parameters"][k]["mean_magnitude"], 1e-12))
+                for k in updates
+            }
+        self._prev_params = params
+        self.storage.put_record(record)
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass

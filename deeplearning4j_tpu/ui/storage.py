@@ -1,0 +1,103 @@
+"""Stats storage (reference ``api/storage/StatsStorage.java`` SPI with
+MapDB-backed ``InMemoryStatsStorage``/``FileStatsStorage`` impls).
+
+Records are plain dicts with (session_id, worker_id, timestamp, iteration
+and a ``kind``: "init" | "update"); file persistence is append-only JSONL.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class StatsStorage:
+    """SPI: put/get records per (session, worker), plus change listeners
+    (reference ``StatsStorageRouter`` + ``StatsStorage`` merged — the
+    router indirection existed for the remote/UI split)."""
+
+    def put_record(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_records(self, session_id: str,
+                    worker_id: Optional[str] = None) -> List[dict]:
+        raise NotImplementedError
+
+    # -- listeners ----------------------------------------------------------
+    def register_stats_storage_listener(self, fn: Callable[[dict], None]):
+        if not hasattr(self, "_listeners"):
+            self._listeners = []
+        self._listeners.append(fn)
+
+    def _notify(self, record: dict):
+        for fn in getattr(self, "_listeners", []):
+            fn(record)
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._records: List[dict] = []
+        self._lock = threading.Lock()
+
+    def put_record(self, record: dict) -> None:
+        with self._lock:
+            self._records.append(record)
+        self._notify(record)
+
+    def list_session_ids(self) -> List[str]:
+        return sorted({r["session_id"] for r in self._records})
+
+    def get_records(self, session_id: str,
+                    worker_id: Optional[str] = None) -> List[dict]:
+        return [
+            r for r in self._records
+            if r["session_id"] == session_id
+            and (worker_id is None or r["worker_id"] == worker_id)
+        ]
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL file; readable while training (tail -f friendly),
+    safe to merge across hosts by concatenation."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        if not os.path.exists(path):
+            open(path, "w").close()
+
+    def put_record(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":"))
+        with self._lock, open(self.path, "a", encoding="utf-8") as f:
+            f.write(line + "\n")
+        self._notify(record)
+
+    def _read_all(self) -> List[dict]:
+        out = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail write
+        return out
+
+    def list_session_ids(self) -> List[str]:
+        return sorted({r["session_id"] for r in self._read_all()})
+
+    def get_records(self, session_id: str,
+                    worker_id: Optional[str] = None) -> List[dict]:
+        return [
+            r for r in self._read_all()
+            if r["session_id"] == session_id
+            and (worker_id is None or r["worker_id"] == worker_id)
+        ]

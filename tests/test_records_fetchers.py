@@ -1,0 +1,213 @@
+"""RecordReader bridge + fetcher tests (reference
+``RecordReaderDataSetiteratorTest.java`` 1,301 LoC patterns: CSV
+classification/regression, image directory, sequence alignment + masks,
+and a RecordReader-driven training run; SURVEY.md §4.4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (
+    ALIGN_END,
+    CSVRecordReader,
+    CollectionRecordReader,
+    ImageRecordReader,
+    RecordReaderDataSetIterator,
+    SequenceRecordReader,
+    SequenceRecordReaderDataSetIterator,
+    SvhnDataSetIterator,
+    TinyImageNetDataSetIterator,
+    UciSequenceDataSetIterator,
+)
+
+
+@pytest.fixture
+def csv_file(tmp_path):
+    p = tmp_path / "iris_like.csv"
+    rng = np.random.default_rng(0)
+    lines = ["a,b,c,label"]
+    for _ in range(40):
+        cls = rng.integers(0, 3)
+        vals = rng.standard_normal(3) + cls
+        lines.append(",".join(f"{v:.4f}" for v in vals) + f",{cls}")
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+class TestCSV:
+    def test_classification_mode(self, csv_file):
+        rr = CSVRecordReader(csv_file, skip_num_lines=1)
+        it = RecordReaderDataSetIterator(rr, 16, label_index=3,
+                                         num_possible_labels=3)
+        ds = it.next()
+        assert ds.features.shape == (16, 3)
+        assert ds.labels.shape == (16, 3)
+        assert np.all(ds.labels.sum(1) == 1)  # one-hot
+        total = 16
+        while it.has_next():
+            total += it.next().features.shape[0]
+        assert total == 40
+        it.reset()
+        assert it.has_next()
+
+    def test_regression_mode(self, csv_file):
+        rr = CSVRecordReader(csv_file, skip_num_lines=1)
+        it = RecordReaderDataSetIterator(rr, 8, regression=True,
+                                         label_index_from=1,
+                                         label_index_to=2)
+        ds = it.next()
+        assert ds.features.shape == (8, 2)  # cols a, label
+        assert ds.labels.shape == (8, 2)   # cols b, c
+
+    def test_collection_reader(self):
+        recs = [[0.1, 0.2, 1], [0.3, 0.4, 0]]
+        it = RecordReaderDataSetIterator(
+            CollectionRecordReader(recs), 2, label_index=2,
+            num_possible_labels=2,
+        )
+        ds = it.next()
+        np.testing.assert_allclose(ds.features,
+                                   [[0.1, 0.2], [0.3, 0.4]], atol=1e-6)
+        np.testing.assert_array_equal(ds.labels, [[0, 1], [1, 0]])
+
+
+class TestImages:
+    def test_image_directory(self, tmp_path):
+        from PIL import Image
+
+        rng = np.random.default_rng(1)
+        for label in ("cats", "dogs"):
+            d = tmp_path / label
+            d.mkdir()
+            for i in range(3):
+                arr = (rng.random((10, 12, 3)) * 255).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        rr = ImageRecordReader(8, 8, 3, str(tmp_path))
+        assert rr.labels == ["cats", "dogs"]
+        it = RecordReaderDataSetIterator(rr, 4, num_possible_labels=2)
+        ds = it.next()
+        assert ds.features.shape == (4, 8, 8, 3)
+        assert ds.features.max() <= 1.0
+        assert ds.labels.shape == (4, 2)
+
+
+class TestSequences:
+    def _write_seqs(self, tmp_path, lengths, cols=2, labels=True):
+        fdir = tmp_path / "feat"
+        ldir = tmp_path / "lab"
+        fdir.mkdir()
+        ldir.mkdir()
+        rng = np.random.default_rng(2)
+        for i, T in enumerate(lengths):
+            f = "\n".join(
+                ",".join(f"{v:.3f}" for v in rng.standard_normal(cols))
+                for _ in range(T)
+            )
+            (fdir / f"{i:02d}.csv").write_text(f + "\n")
+            l = "\n".join(str(rng.integers(0, 3)) for _ in range(T))
+            (ldir / f"{i:02d}.csv").write_text(l + "\n")
+        return str(fdir), str(ldir)
+
+    def test_equal_length(self, tmp_path):
+        fdir, ldir = self._write_seqs(tmp_path, [5, 5, 5])
+        it = SequenceRecordReaderDataSetIterator(
+            SequenceRecordReader(fdir), SequenceRecordReader(ldir),
+            batch_size=3, num_possible_labels=3,
+        )
+        ds = it.next()
+        assert ds.features.shape == (3, 5, 2)
+        assert ds.labels.shape == (3, 5, 3)
+        assert ds.features_mask is None
+
+    def test_align_end_masks(self, tmp_path):
+        fdir, ldir = self._write_seqs(tmp_path, [3, 5, 4])
+        it = SequenceRecordReaderDataSetIterator(
+            SequenceRecordReader(fdir), SequenceRecordReader(ldir),
+            batch_size=3, num_possible_labels=3, alignment_mode=ALIGN_END,
+        )
+        ds = it.next()
+        assert ds.features.shape == (3, 5, 2)
+        # shorter sequences are right-aligned: first rows masked out
+        np.testing.assert_array_equal(ds.features_mask[0], [0, 0, 1, 1, 1])
+        np.testing.assert_array_equal(ds.features_mask[1], [1, 1, 1, 1, 1])
+        np.testing.assert_array_equal(ds.features_mask[2], [0, 1, 1, 1, 1])
+        assert np.all(ds.features[0, :2] == 0)
+
+    def test_single_reader_label_column(self, tmp_path):
+        fdir = tmp_path / "joint"
+        fdir.mkdir()
+        (fdir / "a.csv").write_text("0.1,0.2,1\n0.3,0.4,2\n")
+        it = SequenceRecordReaderDataSetIterator(
+            SequenceRecordReader(str(fdir)), batch_size=1,
+            num_possible_labels=3, label_index=2,
+        )
+        ds = it.next()
+        assert ds.features.shape == (1, 2, 2)
+        np.testing.assert_array_equal(ds.labels[0, 0], [0, 1, 0])
+        np.testing.assert_array_equal(ds.labels[0, 1], [0, 0, 1])
+
+
+class TestTrainingThroughBridge:
+    def test_csv_driven_training(self, csv_file):
+        """End-to-end: CSV → RecordReaderDataSetIterator → fit (the
+        VERDICT done-criterion for this component)."""
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.updaters import Adam
+
+        rr = CSVRecordReader(csv_file, skip_num_lines=1)
+        it = RecordReaderDataSetIterator(rr, 16, label_index=3,
+                                         num_possible_labels=3)
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(3)).build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        first = None
+        for _ in range(15):
+            net._fit_one_epoch(it)
+            if first is None:
+                first = float(net.score_)
+        assert float(net.score_) < first
+
+
+class TestFetchers:
+    def test_svhn_shapes(self):
+        it = SvhnDataSetIterator(32, num_examples=64)
+        ds = it.next()
+        assert ds.features.shape == (32, 32, 32, 3)
+        assert ds.labels.shape == (32, 10)
+        assert 0 <= ds.features.min() and ds.features.max() <= 1
+
+    def test_tiny_imagenet_shapes(self):
+        it = TinyImageNetDataSetIterator(16, num_examples=32)
+        ds = it.next()
+        assert ds.features.shape == (16, 64, 64, 3)
+        assert ds.labels.shape == (16, 200)
+
+    def test_uci_sequences_learnable(self):
+        """Sequence classes are structurally distinct — a tiny readout on
+        summary stats must beat chance (sanity that the generator follows
+        the six control-chart processes)."""
+        from deeplearning4j_tpu.data.fetchers import load_uci_sequences
+
+        x, y = load_uci_sequences(train=True, num_examples=300)
+        assert x.shape == (300, 60, 1)
+        assert y.shape == (300, 60, 6)
+        cls = y[:, 0].argmax(1)
+        # trend classes separable by (end - start); shift classes by
+        # half-difference; cyclic by detrended variance
+        d_end = x[:, -10:, 0].mean(1) - x[:, :10, 0].mean(1)
+        assert d_end[cls == 2].mean() > d_end[cls == 0].mean() + 0.3
+        assert d_end[cls == 3].mean() < d_end[cls == 0].mean() - 0.3
+
+    def test_determinism(self):
+        a = SvhnDataSetIterator(16, num_examples=16).next()
+        b = SvhnDataSetIterator(16, num_examples=16).next()
+        np.testing.assert_array_equal(a.features, b.features)
