@@ -20,6 +20,15 @@ early stopping, transfer learning and zip-format model serialization.
 
 __version__ = "0.1.0"
 
+import jax as _jax
+
+# fp32 means fp32: TPUs default to bf16-pass matmuls/convs for float32
+# inputs, which breaks golden-output parity (Keras import ≤1e-4) and the
+# fp32-vs-bf16 validation story. Mixed precision is an EXPLICIT opt-in via
+# compute_dtype("bfloat16") — the benchmark path — so full precision is
+# the correct default for float32 math.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
 from deeplearning4j_tpu import activations, initializers, losses, schedules, updaters
 
 __all__ = [

@@ -235,6 +235,11 @@ class RecordReaderDataSetIterator(DataSetIterator):
             f = np.concatenate([vals[:lo], vals[hi + 1:]])
             return f, y
         if self.label_index is not None:
+            if not -len(vals) <= self.label_index < len(vals):
+                raise ValueError(
+                    f"label_index {self.label_index} out of range for "
+                    f"{len(vals)}-column record"
+                )
             li = self.label_index % len(vals)  # python-style negative index
             cls = int(vals[li])
             f = np.concatenate([vals[:li], vals[li + 1:]])

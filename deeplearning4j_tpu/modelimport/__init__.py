@@ -1,4 +1,24 @@
-"""Model import (reference ``deeplearning4j-modelimport``)."""
+"""Model import (reference ``deeplearning4j-modelimport``, SURVEY.md §2.6).
+
+Keras format-support matrix (round 3):
+
+| Format                                   | Status                     |
+|------------------------------------------|----------------------------|
+| Keras 2.x full-model ``.h5``             | yes (Hdf5Archive)          |
+| Keras 3.x legacy full-model ``.h5``      | yes (Hdf5Archive)          |
+| Keras 3.x native ``.keras`` zip          | yes (KerasZipArchive;      |
+|                                          | positional vars renamed)   |
+| weights-only ``.h5`` / ``.weights.h5``   | no — architecture absent   |
+|                                          | (same as reference)        |
+| architecture-JSON + weights pair         | no                         |
+| ``channels_first`` data format           | rejected with error (TPU-  |
+|                                          | first NHWC stance)         |
+| uncompiled model, non-inferable loss     | loud error; pass           |
+|                                          | ``default_loss=...``       |
+
+Layer coverage: 46 registered mappers (see keras/mappers.py); golden-
+output parity tests in tests/test_keras_import.py.
+"""
 
 from deeplearning4j_tpu.modelimport.keras import KerasModelImport
 

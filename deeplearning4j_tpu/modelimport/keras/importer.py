@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.modelimport.keras.archive import Hdf5Archive
+from deeplearning4j_tpu.modelimport.keras.zip_archive import KerasZipArchive
 from deeplearning4j_tpu.modelimport.keras.mappers import (
     Mapped,
     UnsupportedKerasLayer,
@@ -81,16 +82,47 @@ def _loss_from_training_config(tc: Optional[dict]) -> Optional[str]:
     return None
 
 
-def _output_head(layer, loss_hint: Optional[str]):
+def _resolve_loss(loss_hint: Optional[str], activation: Optional[str],
+                  default_loss: Optional[str], what: str) -> str:
+    """Loss for an output head: explicit training_config first, then the
+    canonical activation pairing, then the caller's default_loss —
+    otherwise FAIL LOUDLY (a silent mse default on an uncompiled model is
+    a training-correctness trap)."""
+    loss = loss_hint or _LOSS_BY_ACT.get(activation) or default_loss
+    if loss is None:
+        raise ValueError(
+            f"Cannot infer a loss for {what}: the file has no "
+            "training_config (model was saved uncompiled) and the output "
+            f"activation {activation!r} has no canonical loss pairing. "
+            "Pass default_loss=... (e.g. 'mse', 'mcxent') to choose one "
+            "explicitly."
+        )
+    return loss
+
+
+def _output_head(layer, loss_hint: Optional[str],
+                 default_loss: Optional[str] = None):
     """Convert a terminal mapped layer into this framework's output-layer
     form (reference appends ``KerasLoss``): Dense → OutputLayer (fused
     logits path), anything else → the layer + a parameter-free LossLayer."""
     if isinstance(layer, DenseLayer) and not isinstance(layer, OutputLayer):
-        loss = loss_hint or _LOSS_BY_ACT.get(layer.activation, "mse")
+        loss = _resolve_loss(loss_hint, layer.activation, default_loss,
+                             f"output layer '{layer.name}'")
         return OutputLayer(n_out=layer.n_out, activation=layer.activation, loss=loss), None
     if getattr(layer, "is_output_layer", False):
         return layer, None
-    return layer, LossLayer(loss=loss_hint or "mse", activation="identity")
+    loss = _resolve_loss(loss_hint, getattr(layer, "activation", None),
+                         default_loss, f"terminal layer '{layer.name}'")
+    return layer, LossLayer(loss=loss, activation="identity")
+
+
+def open_archive(path: str):
+    """Format dispatch: Keras 3 ``.keras`` zip vs HDF5 full-model file."""
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        return KerasZipArchive(path)
+    return Hdf5Archive(path)
 
 
 def _inbound_names(layer_cfg: dict) -> List[str]:
@@ -126,14 +158,17 @@ class KerasModelImport:
     # ------------------------------------------------------------ sequential
     @staticmethod
     def import_keras_sequential_model_and_weights(
-        path: str, compute_dtype: Optional[str] = None
+        path: str, compute_dtype: Optional[str] = None,
+        default_loss: Optional[str] = None,
     ):
         """→ MultiLayerNetwork with copied weights. ``compute_dtype``
         ("bfloat16") enables mixed-precision inference/fine-tuning on the
-        imported net; weights stay fp32 master copies."""
+        imported net; weights stay fp32 master copies. ``default_loss``
+        is used only when the file carries no training_config AND the
+        output activation has no canonical loss (otherwise errors)."""
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-        with Hdf5Archive(path) as ar:
+        with open_archive(path) as ar:
             cfg = ar.model_config()
             if cfg["class_name"] != "Sequential":
                 raise ValueError(
@@ -164,7 +199,7 @@ class KerasModelImport:
             if not names_layers:
                 raise ValueError(f"{path}: no parameterizable layers found")
             last_name, last_m = names_layers[-1]
-            head, extra_loss = _output_head(last_m.layer, tc_loss)
+            head, extra_loss = _output_head(last_m.layer, tc_loss, default_loss)
             last_m.layer = head
 
             nb = NeuralNetConfiguration.builder().seed(0)
@@ -216,17 +251,18 @@ class KerasModelImport:
     # ------------------------------------------------------------ functional
     @staticmethod
     def import_keras_model_and_weights(
-        path: str, compute_dtype: Optional[str] = None
+        path: str, compute_dtype: Optional[str] = None,
+        default_loss: Optional[str] = None,
     ):
         """→ ComputationGraph (functional) or MultiLayerNetwork (sequential),
         matching the reference's type dispatch."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-        with Hdf5Archive(path) as ar:
+        with open_archive(path) as ar:
             cfg = ar.model_config()
             if cfg["class_name"] == "Sequential":
                 return KerasModelImport.import_keras_sequential_model_and_weights(
-                    path, compute_dtype=compute_dtype
+                    path, compute_dtype=compute_dtype, default_loss=default_loss
                 )
             tc_loss = _loss_from_training_config(ar.training_config())
             gconf = cfg["config"]
@@ -288,7 +324,8 @@ class KerasModelImport:
                     continue
                 act = getattr(m.layer, "activation", "identity") if (
                     m and m.layer is not None) else "identity"
-                loss = tc_loss or _LOSS_BY_ACT.get(act, "mse")
+                loss = _resolve_loss(tc_loss, act, default_loss,
+                                     f"network output '{on}'")
                 loss_name = f"{on}_loss"
                 gb.add_layer(loss_name, LossLayer(loss=loss, activation="identity"), on)
                 final_outputs.append(loss_name)

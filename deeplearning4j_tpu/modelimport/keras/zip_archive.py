@@ -1,0 +1,209 @@
+"""Keras 3 ``.keras`` zip archive reader.
+
+Format (Keras 3 native): a zip holding ``config.json`` (architecture),
+``metadata.json`` (keras_version) and ``model.weights.h5`` whose datasets
+are POSITIONAL — ``layers/<name>/vars/<i>`` (and ``.../cell/vars/<i>``
+for RNNs, ``forward_layer``/``backward_layer`` under Bidirectional).
+
+This reader presents the same interface as ``Hdf5Archive`` and renames
+positional vars back to canonical weight names (``kernel``,
+``recurrent_kernel``, ``moving_variance`` …) so the existing name-based
+weight translators (mappers.py) work unchanged. Naming tables follow each
+layer's build order in Keras 3, adjusted by config flags (``use_bias``,
+``center``/``scale``) since absent weights shift the positions.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+import zipfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _to_snake_case(name: str) -> str:
+    """Keras 3's naming.to_snake_case (weights h5 groups are named from
+    the layer CLASS, not the config name)."""
+    name = re.sub(r"\W+", "", name)
+    name = re.sub("(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub("([a-z])([A-Z])", r"\1_\2", name).lower()
+
+try:
+    import h5py
+except ImportError:  # pragma: no cover
+    h5py = None
+
+
+def _var_names(class_name: str, cfg: dict) -> Optional[List[str]]:
+    """Build-order weight names for one (sub)layer, config-adjusted."""
+    use_bias = cfg.get("use_bias", True)
+
+    def with_bias(names):
+        return names + ["bias"] if use_bias else names
+
+    if class_name in ("Dense", "Conv1D", "Conv2D", "Conv3D",
+                      "Conv1DTranspose", "Conv2DTranspose", "EinsumDense"):
+        return with_bias(["kernel"])
+    if class_name == "DepthwiseConv2D":
+        return with_bias(["depthwise_kernel"])
+    if class_name == "SeparableConv2D":
+        return with_bias(["depthwise_kernel", "pointwise_kernel"])
+    if class_name == "Embedding":
+        return ["embeddings"]
+    if class_name == "BatchNormalization":
+        names = []
+        if cfg.get("scale", True):
+            names.append("gamma")
+        if cfg.get("center", True):
+            names.append("beta")
+        return names + ["moving_mean", "moving_variance"]
+    if class_name == "LayerNormalization":
+        names = []
+        if cfg.get("scale", True):
+            names.append("gamma")
+        if cfg.get("center", True):
+            names.append("beta")
+        return names
+    if class_name in ("LSTM", "GRU", "SimpleRNN", "LSTMCell", "GRUCell",
+                      "SimpleRNNCell"):
+        return with_bias(["kernel", "recurrent_kernel"])
+    if class_name == "PReLU":
+        return ["alpha"]
+    return None  # parameter-free or unknown: keep positional names
+
+
+class KerasZipArchive:
+    """Same surface as Hdf5Archive, over the ``.keras`` zip format."""
+
+    def __init__(self, path: str):
+        if h5py is None:
+            raise ImportError("h5py is required for Keras model import")
+        self.path = path
+        self._zf = zipfile.ZipFile(path, "r")
+        self._config = json.loads(self._zf.read("config.json"))
+        try:
+            self._meta = json.loads(self._zf.read("metadata.json"))
+        except KeyError:
+            self._meta = {}
+        self._h5 = h5py.File(io.BytesIO(self._zf.read("model.weights.h5")), "r")
+        # layer name → (class_name, config) for var naming
+        self._layer_info: Dict[str, tuple] = {}
+        self._index_layers(self._config)
+        # config layer name → h5 group name: the weights file names groups
+        # by object path (snake_case(class), uniquified per model in layer
+        # order), NOT by the config layer name
+        self._h5_name: Dict[str, str] = {}
+        layers_cfg = (self._config.get("config", {}) or {}).get("layers", [])
+        counts: Dict[str, int] = {}
+        for lc in layers_cfg:
+            cls = lc.get("class_name", "")
+            cname = (lc.get("config", {}) or {}).get("name")
+            if cls == "InputLayer" or cname is None:
+                continue
+            base = _to_snake_case(cls)
+            n = counts.get(base, 0)
+            counts[base] = n + 1
+            self._h5_name[cname] = base if n == 0 else f"{base}_{n}"
+
+    def _index_layers(self, cfg: dict):
+        if not isinstance(cfg, dict):
+            return
+        cls = cfg.get("class_name")
+        conf = cfg.get("config", {})
+        name = conf.get("name") if isinstance(conf, dict) else None
+        if cls and name:
+            self._layer_info[name] = (cls, conf)
+        if isinstance(conf, dict):
+            for key in ("layers",):
+                for sub in conf.get(key, []) or []:
+                    self._index_layers(sub)
+            for key in ("layer", "forward_layer", "backward_layer", "cell"):
+                if conf.get(key):
+                    self._index_layers(conf[key])
+
+    def close(self):
+        self._h5.close()
+        self._zf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    # ------------------------------------------------------------- config
+    def model_config(self) -> dict:
+        return self._config
+
+    def training_config(self) -> Optional[dict]:
+        # .keras stores compile config inside config.json
+        cc = self._config.get("compile_config")
+        if cc:
+            return cc
+        cfg = self._config.get("config", {})
+        return cfg.get("compile_config") if isinstance(cfg, dict) else None
+
+    def keras_version(self) -> str:
+        return self._meta.get("keras_version", "3")
+
+    # ------------------------------------------------------------ weights
+    def layer_names(self) -> List[str]:
+        g = self._h5.get("layers")
+        return list(g.keys()) if g is not None else []
+
+    def _rename(self, path_parts: List[str], idx: int) -> str:
+        """Replace the trailing vars/<idx> with the canonical weight name
+        for the owning (sub)layer."""
+        owner = None
+        # owning sublayer = last path component that names a known layer,
+        # or a cell/ level (RNN cells hold the recurrent weights)
+        for part in reversed(path_parts):
+            if part == "cell":
+                owner = ("LSTMCell", {})  # cell table: kernel/rec/bias
+                # bias presence: inherit the parent RNN layer's use_bias
+                for p2 in reversed(path_parts):
+                    if p2 in self._layer_info:
+                        owner = ("LSTMCell", self._layer_info[p2][1])
+                        break
+                break
+            if part in self._layer_info:
+                owner = self._layer_info[part]
+                break
+        names = _var_names(owner[0], owner[1]) if owner else None
+        if names is not None and idx < len(names):
+            return names[idx]
+        return f"var_{idx}"
+
+    def layer_weights(self, layer_name: str) -> Dict[str, np.ndarray]:
+        g = self._h5.get("layers")
+        if g is None:
+            return {}
+        h5_name = layer_name if layer_name in g else \
+            self._h5_name.get(layer_name)
+        if h5_name is None or h5_name not in g:
+            return {}
+        orig = layer_name
+        layer_name = h5_name
+        out: Dict[str, np.ndarray] = {}
+
+        def walk(group, parts: List[str]):
+            for k in group:
+                item = group[k]
+                if isinstance(item, h5py.Dataset):
+                    # path ...>/vars/<k>
+                    if parts and parts[-1] == "vars":
+                        # owner lookup uses the CONFIG name (layer_info key)
+                        name = self._rename([orig] + parts, int(k))
+                        prefix = "/".join(p for p in parts[:-1])
+                        key = f"{prefix}/{name}" if prefix else name
+                    else:
+                        key = "/".join(parts + [k])
+                    out[key] = np.asarray(item)
+                else:
+                    walk(item, parts + [k])
+
+        walk(g[layer_name], [])
+        return out

@@ -1,0 +1,172 @@
+"""Dropout variants and weight noise (reference
+``nn/conf/dropout/{Dropout,AlphaDropout,GaussianDropout,GaussianNoise}.java``
+and ``nn/conf/weightnoise/{DropConnect,WeightNoise}.java``).
+
+A layer's ``dropout`` argument accepts a float (plain inverted dropout on
+the layer input — drop probability, the package's existing convention) or
+one of the IDropout objects below. ``weight_noise`` accepts an
+IWeightNoise applied to the layer's parameters at forward time during
+training (reference applies it in ``BaseLayer.getParamsWithNoise``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+
+Array = jax.Array
+
+
+class IDropout:
+    """SPI (reference ``IDropout``): transform the layer input at train
+    time; identity at inference."""
+
+    def apply(self, x: Array, rng: Array) -> Array:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "IDropout":
+        actual = serde.lookup(data["@class"])
+        return serde.generic_from_dict(actual, data)
+
+
+@serde.register
+class Dropout(IDropout):
+    """Inverted dropout; ``p`` = DROP probability."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@serde.register
+class AlphaDropout(IDropout):
+    """SELU-compatible dropout (reference ``AlphaDropout.java``): dropped
+    units are set to alpha' and the result is affinely rescaled so mean
+    and variance are preserved (Klambauer et al. 2017)."""
+
+    _ALPHA = 1.6732632423543772
+    _SCALE = 1.0507009873554805
+
+    def __init__(self, p: float = 0.5):
+        self.p = float(p)
+
+    def apply(self, x, rng):
+        keep = 1.0 - self.p
+        alpha_p = -self._ALPHA * self._SCALE
+        a = (keep + alpha_p * alpha_p * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@serde.register
+class GaussianDropout(IDropout):
+    """Multiplicative gaussian noise ~ N(1, rate/(1-rate)) (reference
+    ``GaussianDropout.java``); mean-preserving, no inference rescale."""
+
+    def __init__(self, rate: float = 0.5):
+        self.rate = float(rate)
+
+    def apply(self, x, rng):
+        stdev = math.sqrt(self.rate / max(1.0 - self.rate, 1e-8))
+        noise = 1.0 + stdev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@serde.register
+class GaussianNoise(IDropout):
+    """Additive gaussian noise N(0, stddev²) (reference
+    ``GaussianNoise.java``)."""
+
+    def __init__(self, stddev: float = 0.1):
+        self.stddev = float(stddev)
+
+    def apply(self, x, rng):
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+# --------------------------------------------------------------------------
+# weight noise
+# --------------------------------------------------------------------------
+class IWeightNoise:
+    """SPI (reference ``IWeightNoise``): transform a layer's param dict at
+    forward time during training."""
+
+    def apply_to_params(self, params: Dict[str, Array], rng: Array) -> Dict[str, Array]:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        return serde.generic_to_dict(self)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "IWeightNoise":
+        actual = serde.lookup(data["@class"])
+        return serde.generic_from_dict(actual, data)
+
+    @staticmethod
+    def _is_weight(name: str) -> bool:
+        # bias conventions across the layer catalog: b, bo, b1, b2, beta
+        return not name.startswith(("b", "beta"))
+
+
+@serde.register
+class DropConnect(IWeightNoise):
+    """Drops individual WEIGHTS (not activations) with probability
+    ``1 - weight_retain_prob`` (reference ``DropConnect.java``)."""
+
+    def __init__(self, weight_retain_prob: float = 0.5,
+                 apply_to_biases: bool = False):
+        self.weight_retain_prob = float(weight_retain_prob)
+        self.apply_to_biases = bool(apply_to_biases)
+
+    def apply_to_params(self, params, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if (self.apply_to_biases or self._is_weight(k)) and \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                keep = self.weight_retain_prob
+                mask = jax.random.bernoulli(
+                    jax.random.fold_in(rng, i), keep, v.shape
+                )
+                out[k] = jnp.where(mask, v / keep, 0.0).astype(v.dtype)
+            else:
+                out[k] = v
+        return out
+
+
+@serde.register
+class WeightNoise(IWeightNoise):
+    """Additive (default) or multiplicative gaussian noise on weights
+    (reference ``WeightNoise.java`` with a normal distribution)."""
+
+    def __init__(self, stddev: float = 0.01, additive: bool = True,
+                 apply_to_biases: bool = False):
+        self.stddev = float(stddev)
+        self.additive = bool(additive)
+        self.apply_to_biases = bool(apply_to_biases)
+
+    def apply_to_params(self, params, rng):
+        out = {}
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if (self.apply_to_biases or self._is_weight(k)) and \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                noise = self.stddev * jax.random.normal(
+                    jax.random.fold_in(rng, i), v.shape, v.dtype
+                )
+                out[k] = v + noise if self.additive else v * (1.0 + noise)
+            else:
+                out[k] = v
+        return out

@@ -97,3 +97,19 @@ __all__ = [
     "SelfAttentionLayer", "TransformerBlock", "LayerNormalization",
     "PositionalEmbeddingLayer",
 ]
+
+from deeplearning4j_tpu.nn.conf.dropouts import (  # noqa: E402
+    AlphaDropout,
+    DropConnect,
+    Dropout,
+    GaussianDropout,
+    GaussianNoise,
+    IDropout,
+    IWeightNoise,
+    WeightNoise,
+)
+
+__all__ += [
+    "IDropout", "Dropout", "AlphaDropout", "GaussianDropout", "GaussianNoise",
+    "IWeightNoise", "DropConnect", "WeightNoise",
+]

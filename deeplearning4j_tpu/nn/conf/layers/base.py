@@ -49,7 +49,8 @@ class Layer:
     def __init__(
         self,
         name: Optional[str] = None,
-        dropout: float = 0.0,
+        dropout=0.0,
+        weight_noise=None,
         constraints: Optional[Sequence[Constraint]] = None,
         gradient_normalization: Optional[str] = INHERIT,
         gradient_normalization_threshold: float = 1.0,
@@ -57,7 +58,13 @@ class Layer:
         regularization: Optional[RegularizationConf] = INHERIT,
     ):
         self.name = name
-        self.dropout = float(dropout)
+        # float = plain inverted dropout (drop probability); or an
+        # IDropout variant (AlphaDropout/GaussianDropout/GaussianNoise)
+        self.dropout = dropout if not isinstance(dropout, (int, float)) \
+            else float(dropout)
+        # IWeightNoise (DropConnect/WeightNoise) applied to params at
+        # train-time forward (reference getParamsWithNoise)
+        self.weight_noise = weight_noise
         self.constraints = list(constraints) if constraints else []
         self.gradient_normalization = gradient_normalization
         self.gradient_normalization_threshold = float(gradient_normalization_threshold)
@@ -254,11 +261,33 @@ class FeedForwardLayer(Layer):
 
 def apply_input_dropout(layer: Layer, x: Array, train: bool, rng: Optional[Array]) -> Array:
     """DL4J applies a layer's dropout to its *input* during training
-    (reference ``BaseLayer.applyDropOutIfNecessary``); inverted dropout."""
-    if not train or layer.dropout <= 0.0:
+    (reference ``BaseLayer.applyDropOutIfNecessary``). ``layer.dropout``
+    may be a float (inverted dropout, drop probability) or an IDropout
+    variant object (``nn/conf/dropouts.py``)."""
+    d = layer.dropout
+    if not train or d is None:
+        return x
+    if not isinstance(d, (int, float)):
+        if rng is None:
+            raise ValueError(f"Layer {layer.name}: dropout requires an rng during training")
+        return d.apply(x, rng)
+    if d <= 0.0:
         return x
     if rng is None:
         raise ValueError(f"Layer {layer.name}: dropout requires an rng during training")
-    keep = 1.0 - layer.dropout
+    keep = 1.0 - d
     mask = jax.random.bernoulli(rng, keep, x.shape)
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def apply_weight_noise(layer: Layer, params: Params, train: bool,
+                       rng: Optional[Array]) -> Params:
+    """Apply the layer's IWeightNoise to its params at train time
+    (reference ``BaseLayer.getParamsWithNoise``). The rng is decorrelated
+    from the dropout stream via fold_in."""
+    wn = getattr(layer, "weight_noise", None)
+    if not train or wn is None or not params:
+        return params
+    if rng is None:
+        raise ValueError(f"Layer {layer.name}: weight noise requires an rng")
+    return wn.apply_to_params(params, jax.random.fold_in(rng, 0x5EED))

@@ -40,7 +40,10 @@ from deeplearning4j_tpu.nn.conf.graph_vertices import (
     LastTimeStepVertex,
     ReverseTimeSeriesVertex,
 )
-from deeplearning4j_tpu.nn.conf.layers.base import apply_input_dropout
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    apply_input_dropout,
+    apply_weight_noise,
+)
 from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.multilayer import (
     _apply_layer_updates,
@@ -135,17 +138,24 @@ class ComputationGraph:
         rng: Optional[Array],
         fmasks: Optional[Sequence[Optional[Array]]] = None,
         collect: bool = False,
+        carries: Optional[Dict[str, Any]] = None,
     ):
         """Pure forward walk over the topological order.
 
         Returns (activations dict, masks dict, output-layer-inputs dict,
-        new_state dict). ``output-layer-inputs`` holds, for each LayerVertex
-        whose layer is an output layer, the activation INTO that layer
+        new_state dict[, new_carries when ``carries`` is given]).
+        ``output-layer-inputs`` holds, for each LayerVertex whose layer is
+        an output layer, the activation INTO that layer
         (post-preprocessor) — needed by compute_score, mirroring the
         reference's "forward to N-1 then score" structure
-        (``ComputationGraph.java:1321``).
+        (``ComputationGraph.java:1321``). ``carries`` maps recurrent
+        layer-vertex names to hidden state threaded across tBPTT chunks /
+        rnnTimeStep calls (reference ``rnnActivateUsingStoredState``).
         """
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+
         conf = self.conf
+        new_carries: Dict[str, Any] = {}
         if self._compute_dtype is not None:
             params = self._cast_for_compute(params)
             inputs = [
@@ -178,10 +188,23 @@ class ComputationGraph:
                 x = apply_input_dropout(layer, x, train, r)
                 if layer.is_output_layer:
                     out_inputs[name] = (x, m)
-                y, st = layer.apply(
-                    params.get(name, {}), x, state=state.get(name, {}),
-                    train=train, rng=r, mask=m,
-                )
+                p_n = apply_weight_noise(layer, params.get(name, {}), train, r)
+                if (
+                    carries is not None
+                    and isinstance(layer, BaseRecurrentLayer)
+                    and carries.get(name) is not None
+                ):
+                    y, c = layer.apply_with_carry(
+                        p_n, x, carries[name],
+                        mask=m, train=train, rng=r,
+                    )
+                    new_carries[name] = c
+                    st = state.get(name, {})
+                else:
+                    y, st = layer.apply(
+                        p_n, x, state=state.get(name, {}),
+                        train=train, rng=r, mask=m,
+                    )
                 new_state[name] = st if st is not None else {}
                 acts[name] = y
                 if layer.is_recurrent and m is not None:
@@ -196,6 +219,8 @@ class ComputationGraph:
                     in_masks = [masks.get(v.mask_input)] + in_masks[1:]
                 acts[name] = v.apply(in_acts, in_masks, train=train, rng=None)
                 masks[name] = v.feed_forward_mask(in_masks)
+        if carries is not None:
+            return acts, masks, out_inputs, new_state, new_carries
         return acts, masks, out_inputs, new_state
 
     def _output_layers(self) -> List[str]:
@@ -301,8 +326,13 @@ class ComputationGraph:
             if hasattr(lst, "on_epoch_start"):
                 lst.on_epoch_start(self)
         step = self._get_jit("train", self._make_train_step)
+        use_tbptt = getattr(self.conf, "backprop_type", "standard") == "tbptt"
         for ds in it:
-            self._fit_batch(step, _as_multi(ds))
+            mds = _as_multi(ds)
+            if use_tbptt and mds.features[0].ndim == 3:
+                self._fit_tbptt_batch(mds)
+            else:
+                self._fit_batch(step, mds)
         it.reset()
         self.epoch += 1
         for lst in self.listeners:
@@ -327,6 +357,139 @@ class ComputationGraph:
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
+
+    # ----------------------------------------------------------------- tBPTT
+    def _init_carries(self, batch: int, dtype=jnp.float32) -> Dict[str, Any]:
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
+
+        carries: Dict[str, Any] = {}
+        for name in self.layer_names:
+            layer = self._layer(name)
+            if isinstance(layer, BaseRecurrentLayer):
+                carries[name] = layer.init_carry(batch, dtype)
+        return carries
+
+    def _make_tbptt_step(self):
+        names = self.layer_names
+        layers = [self._layer(n) for n in names]
+
+        def step(params, opt_state, state, carries, features, labels, fmasks,
+                 lmasks, rng, iteration, epoch):
+            def loss_fn(p):
+                _, _, out_inputs, new_state, new_carries = self._forward(
+                    p, state, features, train=True, rng=rng, fmasks=fmasks,
+                    carries=carries,
+                )
+                loss = jnp.asarray(0.0, jnp.float32)
+                for i, oname in enumerate(self.conf.network_outputs):
+                    layer = self._layer(oname)
+                    x, m = out_inputs[oname]
+                    if self._compute_dtype is not None:
+                        x = x.astype(jnp.float32)
+                    lmask = lmasks[i] if (lmasks is not None and i < len(lmasks)) else None
+                    if lmask is None:
+                        lmask = m
+                    per_ex = layer.compute_score(p[oname], x, labels[i], lmask)
+                    loss = loss + jnp.mean(per_ex)
+                return loss, (new_state, new_carries)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            t = iteration + 1
+            p_list = [params[n] for n in names]
+            g_list = [grads[n] for n in names]
+            o_list = [opt_state[n] for n in names]
+            np_list, no_list = _apply_layer_updates(
+                layers, p_list, g_list, o_list, t, iteration, epoch
+            )
+            # detach carries between chunks (reference tBPTT semantics,
+            # ComputationGraph.java:1947 tbptt flag)
+            new_carries = jax.lax.stop_gradient(new_carries)
+            score = loss + self._reg_score(params)
+            return (dict(zip(names, np_list)), dict(zip(names, no_list)),
+                    new_state, new_carries, score)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _fit_tbptt_batch(self, mds: MultiDataSet):
+        """Chunked truncated-BPTT over the time axis (reference
+        ``doTruncatedBPTT`` on ComputationGraph): every 3D feature/label/
+        mask is sliced by ``tbptt_fwd_length``; recurrent carries thread
+        across chunks with stop_gradient at boundaries."""
+        step = self._get_jit("tbptt", self._make_tbptt_step)
+        T = mds.features[0].shape[1]
+        L = self.conf.tbptt_fwd_length
+        for lab in mds.labels:
+            if lab is not None and lab.ndim != 3:
+                raise ValueError(
+                    "tBPTT requires per-timestep labels (batch, time, nOut); "
+                    f"got shape {lab.shape}"
+                )
+        carries = self._init_carries(mds.features[0].shape[0])
+
+        def sl(a, lo, hi, is_mask=False):
+            """Slice ONLY genuine time-series arrays: 3D (b, T, c) data or
+            2D (b, T) masks. Static 2D feature inputs pass through whole
+            even if their width coincides with T."""
+            if a is None:
+                return None
+            a = np.asarray(a)
+            seq = (a.ndim == 3 or (is_mask and a.ndim == 2)) and a.shape[1] == T
+            return jnp.asarray(a[:, lo:hi]) if seq else jnp.asarray(a)
+
+        for lo in range(0, T, L):
+            hi = min(lo + L, T)
+            feats = tuple(sl(f, lo, hi) for f in mds.features)
+            labels = tuple(sl(l, lo, hi) for l in mds.labels)
+            fmasks = tuple(sl(m, lo, hi, is_mask=True) for m in mds.features_masks)
+            lmasks = tuple(sl(m, lo, hi, is_mask=True) for m in mds.labels_masks)
+            (self.params_, self.opt_state_, self.state_, carries,
+             self.score_) = step(
+                self.params_, self.opt_state_, self.state_, carries,
+                feats, labels, fmasks, lmasks, self._next_rng(),
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch)
+
+    # -------------------------------------------------------------- rnn state
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = None
+
+    def rnn_time_step(self, *inputs) -> List[np.ndarray]:
+        """Stateful streaming inference (reference
+        ``ComputationGraph.rnnTimeStep``): hidden state persists across
+        calls; 2D inputs are treated as a single timestep."""
+        feats = []
+        squeeze = False
+        for x in inputs:
+            x = jnp.asarray(x)
+            if x.ndim == 2:
+                x = x[:, None, :]
+                squeeze = True
+            feats.append(x)
+        if getattr(self, "_rnn_carries", None) is None:
+            self._rnn_carries = self._init_carries(feats[0].shape[0],
+                                                   feats[0].dtype)
+        out_names = list(self.conf.network_outputs)
+
+        def run(params, state, inputs, carries):
+            acts, _, _, _, new_carries = self._forward(
+                params, state, inputs, train=False, rng=None, carries=carries
+            )
+            return tuple(acts[n] for n in out_names), new_carries
+
+        fn = self._get_jit("rnn_step", lambda: jax.jit(run))
+        ys, self._rnn_carries = fn(self.params_, self.state_, tuple(feats),
+                                   self._rnn_carries)
+        out = []
+        for y in ys:
+            y = np.asarray(y)
+            out.append(y[:, -1, :] if (squeeze and y.ndim == 3) else y)
+        return out
 
     # -------------------------------------------------------------- inference
     def _make_output_fn(self):

@@ -35,7 +35,11 @@ from deeplearning4j_tpu.data.iterators import (
     ListDataSetIterator,
 )
 from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
-from deeplearning4j_tpu.nn.conf.layers.base import Layer, apply_input_dropout
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    Layer,
+    apply_input_dropout,
+    apply_weight_noise,
+)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import BaseRecurrentLayer
 from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer, FrozenLayer
 from deeplearning4j_tpu.regularization import normalize_layer_gradients
@@ -203,19 +207,20 @@ class MultiLayerNetwork:
             x = apply_input_dropout(layer, x, train, rngs[i])
             if i >= stop:
                 break
+            p_i = apply_weight_noise(layer, params[i], train, rngs[i])
             if (
                 carries is not None
                 and isinstance(layer, BaseRecurrentLayer)
                 and carries[i] is not None
             ):
                 x, c = layer.apply_with_carry(
-                    params[i], x, carries[i], mask=mask, train=train, rng=rngs[i]
+                    p_i, x, carries[i], mask=mask, train=train, rng=rngs[i]
                 )
                 new_carries[i] = c
                 st = state[i]
             else:
                 x, st = layer.apply(
-                    params[i], x, state=state[i], train=train, rng=rngs[i], mask=mask
+                    p_i, x, state=state[i], train=train, rng=rngs[i], mask=mask
                 )
             new_states.append(st if st is not None else {})
             if collect:
@@ -355,7 +360,12 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self.iteration, self.epoch)
 
     # ----------------------------------------------------------------- tBPTT
-    def _make_tbptt_step(self):
+    def tbptt_step_fn(self):
+        """Raw (unjitted) tBPTT chunk step — jitted with mesh shardings by
+        the data-parallel wrapper."""
+        return self._make_tbptt_step(jit=False)
+
+    def _make_tbptt_step(self, jit: bool = True):
         layers = self.layers
 
         def step(params, opt_state, state, carries, features, labels, fmask, lmask, rng, iteration, epoch):
@@ -386,7 +396,7 @@ class MultiLayerNetwork:
             score = loss + self._reg_score(params)
             return new_params, new_opt, new_states, new_carries, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
 
     def _init_carries(self, batch: int, dtype=jnp.float32) -> List[Any]:
         carries: List[Any] = []

@@ -1,0 +1,15 @@
+"""Legacy full-batch optimizers (reference ``optimize/solvers/*``)."""
+
+from deeplearning4j_tpu.optimize.solvers import (
+    BackTrackLineSearch,
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    OptimizationAlgorithm,
+    Solver,
+)
+
+__all__ = [
+    "Solver", "OptimizationAlgorithm", "LBFGS", "ConjugateGradient",
+    "LineGradientDescent", "BackTrackLineSearch",
+]

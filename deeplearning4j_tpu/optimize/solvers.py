@@ -1,0 +1,255 @@
+"""Full-batch second-order-ish optimizers — the reference's legacy
+``OptimizationAlgorithm`` surface (``optimize/solvers/{LBFGS,
+ConjugateGradient,LineGradientDescent,BackTrackLineSearch}.java`` and the
+``Solver.Builder`` facade).
+
+TPU-native shape: the loss over a FIXED full batch is one jitted
+``value_and_grad`` on the flattened parameter vector
+(``jax.flatten_util.ravel_pytree`` gives the vec↔pytree bijection), so
+every line-search probe and curvature update is a single device program on
+one big vector — no per-layer dispatch. These methods exist for API
+parity and small-model/scientific use; minibatch SGD remains the training
+path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class OptimizationAlgorithm:
+    STOCHASTIC_GRADIENT_DESCENT = "STOCHASTIC_GRADIENT_DESCENT"
+    LBFGS = "LBFGS"
+    CONJUGATE_GRADIENT = "CONJUGATE_GRADIENT"
+    LINE_GRADIENT_DESCENT = "LINE_GRADIENT_DESCENT"
+
+
+def _flat_objective(model, ds: DataSet):
+    """(value_and_grad fn, value-only fn, x0, unravel) over the flat vec.
+    The objective is loss + regularization on the fixed batch (reference
+    ``BaseOptimizer.gradientAndScore``). Line-search probes use the
+    value-only program (no wasted backward passes)."""
+    from jax.flatten_util import ravel_pytree
+
+    x0, unravel = ravel_pytree(model.params_)
+    state = model.state_
+    f = jnp.asarray(ds.features)
+    l = None if ds.labels is None else jnp.asarray(ds.labels)
+    fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+    lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+
+    def loss(vec):
+        params = unravel(vec)
+        ls, _ = model._loss_and_new_state(params, state, f, l, fm, lm, None,
+                                          train=False)
+        return ls + model._reg_score(params)
+
+    return jax.jit(jax.value_and_grad(loss)), jax.jit(loss), x0, unravel
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking line search (reference
+    ``BackTrackLineSearch.java``): shrink the step until sufficient
+    decrease; returns the accepted step size."""
+
+    def __init__(self, c1: float = 1e-4, shrink: float = 0.5,
+                 max_iterations: int = 20, initial_step: float = 1.0):
+        self.c1 = c1
+        self.shrink = shrink
+        self.max_iterations = max_iterations
+        self.initial_step = initial_step
+
+    def optimize(self, vloss, x, fx, g, direction) -> Tuple[float, float, jnp.ndarray]:
+        """Returns (step, f_new, x_new); step 0.0 = failure. ``vloss`` is
+        the VALUE-ONLY objective — probes run no backward pass."""
+        slope = float(jnp.dot(g, direction))
+        if slope >= 0:  # not a descent direction
+            return 0.0, fx, x
+        step = self.initial_step
+        for _ in range(self.max_iterations):
+            x_new = x + step * direction
+            f_new = float(vloss(x_new))
+            if np.isfinite(f_new) and f_new <= fx + self.c1 * step * slope:
+                return step, f_new, x_new
+            step *= self.shrink
+        return 0.0, fx, x
+
+
+class _BaseFullBatchOptimizer:
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 line_search: Optional[BackTrackLineSearch] = None):
+        self.max_iterations = max_iterations
+        self.tolerance = tolerance
+        self.line_search = line_search or BackTrackLineSearch()
+        self.score_history: List[float] = []
+
+    def optimize(self, model, ds: DataSet) -> float:
+        """Minimize on the batch; writes optimized params back into the
+        model. Returns the final score."""
+        vg, vloss, x, unravel = _flat_objective(model, ds)
+        fx = self._run(vg, vloss, x, unravel, model)
+        return fx
+
+    def _commit(self, model, unravel, x):
+        model.params_ = unravel(x)
+        model.score_ = jnp.asarray(self.score_history[-1] if self.score_history
+                                   else np.nan)
+
+    def _run(self, vg, vloss, x, unravel, model) -> float:
+        raise NotImplementedError
+
+
+class LineGradientDescent(_BaseFullBatchOptimizer):
+    """Steepest descent + line search (reference
+    ``LineGradientDescent.java``)."""
+
+    def _run(self, vg, vloss, x, unravel, model) -> float:
+        fx, g = vg(x)
+        fx = float(fx)
+        for _ in range(self.max_iterations):
+            direction = -g
+            step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
+            if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
+                break
+            x, fx = x_new, f_new
+            _, g = vg(x)
+            self.score_history.append(fx)
+        self.score_history.append(fx)
+        self._commit(model, unravel, x)
+        return fx
+
+
+class ConjugateGradient(_BaseFullBatchOptimizer):
+    """Nonlinear CG, Polak-Ribière with automatic restart (reference
+    ``ConjugateGradient.java``)."""
+
+    def _run(self, vg, vloss, x, unravel, model) -> float:
+        fx, g = vg(x)
+        fx = float(fx)
+        direction = -g
+        for it in range(self.max_iterations):
+            step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
+            if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
+                break
+            _, g_new = vg(x_new)
+            # Polak-Ribière beta, restarted when non-positive or periodically
+            beta = float(jnp.dot(g_new, g_new - g) / jnp.maximum(jnp.dot(g, g), 1e-20))
+            if beta <= 0 or (it + 1) % 20 == 0:
+                direction = -g_new
+            else:
+                direction = -g_new + beta * direction
+            x, fx, g = x_new, f_new, g_new
+            self.score_history.append(fx)
+        self.score_history.append(fx)
+        self._commit(model, unravel, x)
+        return fx
+
+
+class LBFGS(_BaseFullBatchOptimizer):
+    """Limited-memory BFGS, two-loop recursion (reference ``LBFGS.java``,
+    default history m=10)."""
+
+    def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
+                 m: int = 10, line_search: Optional[BackTrackLineSearch] = None):
+        super().__init__(max_iterations, tolerance, line_search)
+        self.m = m
+
+    def _run(self, vg, vloss, x, unravel, model) -> float:
+        fx, g = vg(x)
+        fx = float(fx)
+        s_hist: List[jnp.ndarray] = []
+        y_hist: List[jnp.ndarray] = []
+        for _ in range(self.max_iterations):
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in zip(reversed(s_hist), reversed(y_hist)):
+                rho = 1.0 / jnp.maximum(jnp.dot(y, s), 1e-20)
+                a = rho * jnp.dot(s, q)
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if y_hist:
+                s, y = s_hist[-1], y_hist[-1]
+                gamma = jnp.dot(s, y) / jnp.maximum(jnp.dot(y, y), 1e-20)
+                q = gamma * q
+            for a, rho, s, y in reversed(alphas):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            direction = -q
+            step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
+            if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
+                break
+            _, g_new = vg(x_new)
+            s_hist.append(x_new - x)
+            y_hist.append(g_new - g)
+            if len(s_hist) > self.m:
+                s_hist.pop(0)
+                y_hist.pop(0)
+            x, fx, g = x_new, float(f_new), g_new
+            self.score_history.append(fx)
+        self.score_history.append(fx)
+        self._commit(model, unravel, x)
+        return fx
+
+
+class Solver:
+    """Reference ``Solver.Builder`` facade: pick the optimizer from the
+    OptimizationAlgorithm name."""
+
+    class Builder:
+        def __init__(self):
+            self._model = None
+            self._algo = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+            self._max_iter = 100
+            self._tol = 1e-5
+
+        def model(self, m):
+            self._model = m
+            return self
+
+        def optimization_algorithm(self, a: str):
+            self._algo = a
+            return self
+
+        def max_iterations(self, n: int):
+            self._max_iter = int(n)
+            return self
+
+        def tolerance(self, t: float):
+            self._tol = float(t)
+            return self
+
+        def build(self) -> "Solver":
+            return Solver(self._model, self._algo, self._max_iter, self._tol)
+
+    @staticmethod
+    def builder() -> "Solver.Builder":
+        return Solver.Builder()
+
+    def __init__(self, model, algorithm: str, max_iterations: int = 100,
+                 tolerance: float = 1e-5):
+        self.model = model
+        self.algorithm = algorithm
+        impl = {
+            OptimizationAlgorithm.LBFGS: LBFGS,
+            OptimizationAlgorithm.CONJUGATE_GRADIENT: ConjugateGradient,
+            OptimizationAlgorithm.LINE_GRADIENT_DESCENT: LineGradientDescent,
+        }
+        if algorithm == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+            self.optimizer = None  # model.fit IS the SGD path
+        elif algorithm in impl:
+            self.optimizer = impl[algorithm](max_iterations, tolerance)
+        else:
+            raise ValueError(f"Unknown optimization algorithm {algorithm}")
+
+    def optimize(self, ds: DataSet) -> float:
+        if self.optimizer is None:
+            self.model.fit(ds, epochs=1, batch_size=ds.features.shape[0])
+            return float(self.model.score_)
+        return self.optimizer.optimize(self.model, ds)

@@ -1,0 +1,190 @@
+"""Threshold-encoded (1-bit-style) gradient compression — the reference's
+flagship scaling mechanism (``optimize/solvers/accumulation/
+EncodingHandler.java:133-176`` threshold/bitmap encode with residual
+accumulation in ``EncodedGradientsAccumulator.java``), rebuilt for the
+DCN-bound regime: intra-slice gradients ride ICI all-reduce uncompressed
+(XLA collectives, free), but multi-slice / multi-pod training over DCN is
+bandwidth-bound — exactly where the reference used Aeron + threshold
+encoding.
+
+TPU-native encoding (static shapes, one jitted program):
+- elements with |g| ≥ threshold are transmitted as (index, sign·threshold)
+  pairs in a FIXED-capacity buffer (top-k by magnitude when over
+  capacity — XLA needs static message sizes; the reference's dynamic
+  Aeron messages become a fixed budget = explicit bandwidth cap);
+- everything untransmitted stays in a RESIDUAL that accumulates into the
+  next round (no gradient is ever dropped, only delayed — the
+  EncodedGradientsAccumulator semantics);
+- the threshold ADAPTS toward a target utilization of the capacity
+  (EncodingHandler's adaptive threshold algorithm, simplified to
+  multiplicative up/down);
+- ``make_compressed_allreduce`` wires encode → all_gather(indices, values)
+  → scatter-add decode under shard_map, so the exchanged bytes per device
+  are 8·capacity instead of 4·n — the full compressed collective as one
+  XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+class EncodedUpdate(NamedTuple):
+    """Fixed-size compressed message (the SilentUpdatesMessage payload
+    equivalent)."""
+
+    indices: Array   # (K,) int32; -1 = empty slot
+    values: Array    # (K,) float32 (±threshold, or residual-carried value)
+    count: Array     # () int32 — used slots
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def threshold_encode(grad: Array, threshold: Array, capacity: int
+                     ) -> Tuple[EncodedUpdate, Array]:
+    """Encode |g| ≥ threshold into a fixed-capacity message; returns
+    (message, residual). Transmitted entries carry sign·threshold (1-bit
+    semantics, reference ``thresholdEncode``); the untransmitted remainder
+    (including the |g|−threshold excess of transmitted entries) stays in
+    the residual."""
+    flat = grad.reshape(-1)
+    mag = jnp.abs(flat)
+    over = mag >= threshold
+    # top-k by magnitude, masked to |g| >= threshold: guarantees static K
+    score = jnp.where(over, mag, -1.0)
+    top_vals, top_idx = jax.lax.top_k(score, capacity)
+    valid = top_vals > 0
+    count = valid.sum().astype(jnp.int32)
+    sign = jnp.sign(flat[top_idx])
+    send = jnp.where(valid, sign * threshold, 0.0).astype(jnp.float32)
+    indices = jnp.where(valid, top_idx, -1).astype(jnp.int32)
+    # residual = grad - decode(message)
+    decoded = jnp.zeros_like(flat).at[jnp.maximum(top_idx, 0)].add(
+        jnp.where(valid, send, 0.0)
+    )
+    residual = (flat - decoded).reshape(grad.shape)
+    return EncodedUpdate(indices, send, count), residual
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def threshold_decode(msg: EncodedUpdate, size: int) -> Array:
+    """Message → dense flat vector (reference decode side of
+    ``SilentTrainingDriver``)."""
+    out = jnp.zeros((size,), jnp.float32)
+    idx = jnp.maximum(msg.indices, 0)
+    vals = jnp.where(msg.indices >= 0, msg.values, 0.0)
+    return out.at[idx].add(vals)
+
+
+@jax.jit
+def bitmap_encode(grad: Array, threshold: Array) -> Tuple[Array, Array]:
+    """Dense fallback (reference ``bitmapEncode``): 2-bit code per element
+    packed 16-per-uint32 — 0: skip, 1: +threshold, 2: −threshold. Used
+    when more than ~capacity elements exceed the threshold (dense update);
+    returns (packed (ceil(n/16),) uint32, residual)."""
+    flat = grad.reshape(-1)
+    code = jnp.where(flat >= threshold, 1,
+                     jnp.where(flat <= -threshold, 2, 0)).astype(jnp.uint32)
+    n = flat.shape[0]
+    pad = (-n) % 16
+    code_p = jnp.concatenate([code, jnp.zeros((pad,), jnp.uint32)])
+    lanes = code_p.reshape(-1, 16)
+    shifts = (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+    packed = jnp.sum(lanes << shifts, axis=1, dtype=jnp.uint32)
+    decoded = jnp.where(code == 1, threshold,
+                        jnp.where(code == 2, -threshold, 0.0))
+    residual = (flat - decoded).reshape(grad.shape)
+    return packed, residual
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def bitmap_decode(packed: Array, threshold: Array, size: int) -> Array:
+    lanes = packed[:, None] >> (2 * jnp.arange(16, dtype=jnp.uint32))[None, :]
+    code = (lanes & 0x3).reshape(-1)[:size]
+    return jnp.where(code == 1, threshold,
+                     jnp.where(code == 2, -threshold, 0.0)).astype(jnp.float32)
+
+
+class EncodingHandler:
+    """Stateful residual + adaptive threshold around the jitted kernels
+    (reference ``EncodingHandler`` + ``EncodedGradientsAccumulator``).
+
+    One instance per trainer; ``encode_update(grad)`` returns the message
+    to ship and keeps the residual; threshold adapts multiplicatively
+    toward ``target_utilization`` of the fixed capacity (the reference
+    adapts by boundary/stepTrigger percentages)."""
+
+    def __init__(self, size: int, threshold: float = 1e-3,
+                 capacity: int = 4096, target_utilization: float = 0.75,
+                 adapt_rate: float = 1.2, min_threshold: float = 1e-6):
+        self.size = size
+        self.threshold = float(threshold)
+        self.capacity = int(capacity)
+        self.target = float(target_utilization)
+        self.adapt = float(adapt_rate)
+        self.min_threshold = float(min_threshold)
+        self.residual = jnp.zeros((size,), jnp.float32)
+        self.last_utilization = 0.0
+
+    def encode_update(self, grad: Array) -> EncodedUpdate:
+        work = self.residual + grad.reshape(-1)
+        msg, residual = threshold_encode(
+            work, jnp.asarray(self.threshold, jnp.float32), self.capacity
+        )
+        self.residual = residual.reshape(-1)
+        used = float(msg.count) / self.capacity
+        self.last_utilization = used
+        # adapt: saturated capacity → raise threshold (send less);
+        # underused → lower threshold (send more, drain residual faster)
+        if used >= 0.999:
+            self.threshold *= self.adapt
+        elif used < self.target:
+            self.threshold = max(self.threshold / self.adapt,
+                                 self.min_threshold)
+        return msg
+
+    def apply_update(self, params_flat: Array, msg: EncodedUpdate) -> Array:
+        return params_flat + threshold_decode(msg, self.size)
+
+
+def make_compressed_allreduce(mesh, axis: str = "data",
+                              capacity: int = 4096):
+    """Compressed gradient exchange as ONE jitted shard_map program:
+    each device threshold-encodes its (local) gradient, all-gathers the
+    fixed-size messages over ``axis``, and scatter-adds every peer's
+    update into a dense buffer — 8·capacity bytes per device on the wire
+    versus 4·n for a dense all-reduce.
+
+    Returns ``fn(grads, residuals, threshold) -> (summed_update,
+    new_residuals)`` where ``grads``/``residuals`` are (n_devices, size) —
+    row d is device d's LOCAL gradient/residual — and ``summed_update``
+    (size,) is the replicated sum of all transmitted updates (divide by n
+    for the mean).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def body(grad, residual, threshold):
+        # local shards arrive as (1, size)
+        work = (residual + grad)[0]
+        msg, new_residual = threshold_encode(work, threshold, capacity)
+        all_idx = jax.lax.all_gather(msg.indices, axis)   # (n, K)
+        all_val = jax.lax.all_gather(msg.values, axis)
+        idx = jnp.maximum(all_idx.reshape(-1), 0)
+        val = jnp.where(all_idx.reshape(-1) >= 0, all_val.reshape(-1), 0.0)
+        summed = jnp.zeros_like(work).at[idx].add(val)
+        return summed, new_residual[None, :]
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh.mesh,
+            in_specs=(P(axis), P(axis), P()),
+            out_specs=(P(), P(axis)),
+            check_vma=False,
+        )
+    )

@@ -46,8 +46,18 @@ class ParallelWrapper:
             return self
 
         def averaging_frequency(self, n: int) -> "ParallelWrapper.Builder":
-            # accepted for API parity; synchronous SPMD all-reduces every step
+            # accepted for API parity; synchronous SPMD all-reduces every
+            # step, so there is no staleness for a frequency to amortize
             self._avg_freq = int(n)
+            if n != 1:
+                import warnings
+
+                warnings.warn(
+                    "averaging_frequency is subsumed by every-step SPMD "
+                    "all-reduce (gradients are always in sync); the value "
+                    f"{n} has no effect",
+                    stacklevel=2,
+                )
             return self
 
         def report_score_after_averaging(self, b: bool) -> "ParallelWrapper.Builder":
@@ -87,14 +97,30 @@ class ParallelWrapper:
         )
         return self._step
 
+    def _build_tbptt_step(self):
+        raw = self.model.tbptt_step_fn()
+        repl = self.mesh.replicated()
+        batch = self.mesh.batch_sharded()
+        # args: params, opt, state, carries, f, l, fm, lm, rng, it, ep
+        self._tbptt_step = jax.jit(
+            raw,
+            in_shardings=(repl, repl, repl, batch, batch, batch, batch, batch,
+                          repl, repl, repl),
+            out_shardings=(repl, repl, repl, batch, repl),
+            donate_argnums=(0, 1, 2),
+        )
+        return self._tbptt_step
+
     def fit(self, it: DataSetIterator, epochs: int = 1) -> None:
-        """Data-parallel fit; batch dim must be divisible by the data axis."""
+        """Data-parallel fit; final partial batches are padded with
+        repeated examples whose loss contribution is zeroed by a weighted
+        label mask (gradient-exact, no repeated-example bias)."""
         m = self.model
-        if m.conf.backprop_type == "tbptt":
+        use_tbptt = m.conf.backprop_type == "tbptt"
+        if use_tbptt and self._is_graph:
             raise NotImplementedError(
-                "ParallelWrapper does not yet support tBPTT configurations; "
-                "fit() the model directly, or use standard backprop_type for "
-                "data-parallel training"
+                "ParallelWrapper tBPTT is supported for MultiLayerNetwork; "
+                "fit the ComputationGraph directly"
             )
         step = self._step or self._build_step()
         n_data = self.mesh.n_data
@@ -107,6 +133,9 @@ class ParallelWrapper:
             try:
                 with self.mesh.mesh:
                     for ds in wrapped:
+                        if use_tbptt and ds.features.ndim == 3:
+                            self._fit_tbptt_sharded(ds, n_data)
+                            continue
                         m.params_, m.opt_state_, m.state_, m.score_ = step(
                             m.params_, m.opt_state_, m.state_,
                             *self._pack_batch(ds, n_data),
@@ -125,6 +154,37 @@ class ParallelWrapper:
             for lst in m.listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(m)
+
+    def _fit_tbptt_sharded(self, ds: DataSet, n_data: int):
+        """tBPTT chunks under the mesh: batch and carries sharded over the
+        data axis, params replicated (reference ParallelWrapper trains
+        tBPTT configs transparently; round-1/2 gap closed)."""
+        m = self.model
+        step = getattr(self, "_tbptt_step", None) or self._build_tbptt_step()
+        if ds.features.shape[0] % n_data:
+            ds = _pad_batch(ds, n_data)
+        if ds.labels is not None and ds.labels.ndim != 3:
+            raise ValueError(
+                "tBPTT requires per-timestep labels (batch, time, nOut)"
+            )
+        T = ds.features.shape[1]
+        L = m.conf.tbptt_fwd_length
+        carries = m._init_carries(ds.features.shape[0])
+        for lo in range(0, T, L):
+            hi = min(lo + L, T)
+            f = jnp.asarray(ds.features[:, lo:hi])
+            l = None if ds.labels is None else jnp.asarray(ds.labels[:, lo:hi])
+            fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, lo:hi])
+            lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, lo:hi])
+            (m.params_, m.opt_state_, m.state_, carries, m.score_) = step(
+                m.params_, m.opt_state_, m.state_, carries, f, l, fm, lm,
+                m._next_rng(),
+                jnp.asarray(m.iteration, jnp.int32),
+                jnp.asarray(m.epoch, jnp.int32),
+            )
+        m.iteration += 1
+        for lst in m.listeners:
+            lst.iteration_done(m, m.iteration, m.epoch)
 
     def _pack_batch(self, ds, n_data: int):
         """Device-bound (features, labels, fmasks, lmasks) in the layout the
@@ -156,33 +216,79 @@ class ParallelWrapper:
 
 
 def _pad_batch(ds: DataSet, multiple: int) -> DataSet:
-    """Pad the final partial batch by repeating the last example so the batch
-    splits evenly over the data axis. A weight-correct alternative (masking)
-    is used by evaluation; for training the bias is one repeated example."""
+    """Pad the final partial batch so it splits evenly over the data axis,
+    WITHOUT biasing the gradient: features/labels are padded by cycling
+    real examples (keeps BatchNorm batch statistics realistic), and a
+    weighted label mask zeroes the padded rows' loss while scaling valid
+    rows by B/valid — so mean-over-B equals mean-over-valid exactly
+    (fixes the round-1/2 repeated-example gradient bias)."""
     b = ds.features.shape[0]
     pad = (-b) % multiple
+    if pad == 0:
+        return ds
+    B = b + pad
+    idx = np.arange(pad) % b  # cycle real examples
 
     def p(a):
         if a is None:
             return None
-        reps = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
-        return reps
+        return np.concatenate([a, a[idx]], axis=0)
 
-    return DataSet(p(ds.features), p(ds.labels), p(ds.features_mask), p(ds.labels_mask))
+    scale = B / b
+    if ds.labels is not None and ds.labels.ndim == 3:
+        # time series: (B, T) mask; combine with any existing label mask
+        base = ds.labels_mask if ds.labels_mask is not None else \
+            np.ones(ds.labels.shape[:2], np.float32)
+        lmask = np.concatenate(
+            [np.asarray(base, np.float32) * scale,
+             np.zeros((pad,) + base.shape[1:], np.float32)], axis=0
+        )
+    else:
+        if ds.labels_mask is not None:
+            base = np.asarray(ds.labels_mask, np.float32).reshape(b, -1)
+        else:
+            base = np.ones((b, 1), np.float32)
+        lmask = np.concatenate(
+            [base * scale, np.zeros((pad,) + base.shape[1:], np.float32)],
+            axis=0,
+        )
+    return DataSet(p(ds.features), p(ds.labels), p(ds.features_mask), lmask)
 
 
 def _pad_multi(mds: MultiDataSet, multiple: int) -> MultiDataSet:
+    """MultiDataSet variant of _pad_batch: cycle examples + weighted label
+    masks per output (same gradient-exact scheme)."""
     b = mds.num_examples()
     pad = (-b) % multiple
+    if pad == 0:
+        return mds
+    B = b + pad
+    idx = np.arange(pad) % b
+    scale = B / b
 
     def p(a):
         if a is None:
             return None
-        return np.concatenate([a, np.repeat(a[-1:], pad, axis=0)], axis=0)
+        return np.concatenate([a, a[idx]], axis=0)
 
+    lmasks = []
+    for l, m in zip(mds.labels, list(mds.labels_masks) + [None] * len(mds.labels)):
+        if l is None:
+            lmasks.append(None)
+            continue
+        if l.ndim == 3:
+            base = m if m is not None else np.ones(l.shape[:2], np.float32)
+        else:
+            base = (np.asarray(m, np.float32).reshape(b, -1)
+                    if m is not None else np.ones((b, 1), np.float32))
+        lmasks.append(np.concatenate(
+            [np.asarray(base, np.float32) * scale,
+             np.zeros((pad,) + np.asarray(base).shape[1:], np.float32)],
+            axis=0,
+        ))
     return MultiDataSet(
         [p(f) for f in mds.features],
         [p(l) for l in mds.labels],
         [p(m) for m in mds.features_masks],
-        [p(m) for m in mds.labels_masks],
+        lmasks,
     )

@@ -23,6 +23,23 @@ from deeplearning4j_tpu.train.listeners import TrainingListener
 from deeplearning4j_tpu.ui.storage import StatsStorage
 
 
+def _current_rss_mb() -> float:
+    """CURRENT resident set size in MB (not ru_maxrss: that is the peak
+    high-water mark, and is bytes on macOS)."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os as _os
+
+        return pages * _os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, ValueError, IndexError):
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # macOS reports bytes, Linux kilobytes
+        return peak / 1e6 if sys.platform == "darwin" else peak / 1024.0
+
+
 def _param_arrays(model) -> Dict[str, np.ndarray]:
     """name → array over both model types (MLN list / CG dict layout)."""
     out = {}
@@ -107,8 +124,7 @@ class StatsListener(TrainingListener):
             "iteration": int(iteration),
             "epoch": int(epoch),
             "score": float(model.score_) if model.score_ is not None else None,
-            "memory_rss_mb": resource.getrusage(
-                resource.RUSAGE_SELF).ru_maxrss / 1024.0,
+            "memory_rss_mb": _current_rss_mb(),
         }
         if self._last_time is not None and self._last_iter_for_rate is not None:
             dt = now - self._last_time

@@ -203,21 +203,23 @@ class TestParallel:
         with pytest.raises(RuntimeError):
             pi.output(ds.features[:8])
 
-    def test_wrapper_rejects_tbptt(self):
+    def test_wrapper_tbptt_2d_data_falls_through_to_standard(self):
+        # tBPTT configs are supported since round 3 (tests/test_parity_tail
+        # covers the sharded chunk path); 2D batches just train normally
         conf = (
             NeuralNetConfiguration.builder()
             .list()
             .layer(DenseLayer(n_out=4, activation="tanh"))
-            .layer(OutputLayer(n_out=2, activation="softmax"))
+            .layer(OutputLayer(n_out=3, activation="softmax"))
             .backprop_type("tbptt")
-            .set_input_type(InputType.feed_forward(3))
+            .set_input_type(InputType.feed_forward(4))
             .build()
         )
         net = MultiLayerNetwork(conf).init()
-        with pytest.raises(NotImplementedError):
-            ParallelWrapper(net, mesh=TrainingMesh(data=8)).fit(
-                ListDataSetIterator(_blobs(16), 8)
-            )
+        ParallelWrapper(net, mesh=TrainingMesh(data=8)).fit(
+            ListDataSetIterator(_blobs(16), 8)
+        )
+        assert np.isfinite(float(net.score_))
 
 
 class TestZoo:

