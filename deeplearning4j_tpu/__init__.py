@@ -20,14 +20,20 @@ early stopping, transfer learning and zip-format model serialization.
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
 
 # fp32 means fp32: TPUs default to bf16-pass matmuls/convs for float32
 # inputs, which breaks golden-output parity (Keras import ≤1e-4) and the
 # fp32-vs-bf16 validation story. Mixed precision is an EXPLICIT opt-in via
 # compute_dtype("bfloat16") — the benchmark path — so full precision is
-# the correct default for float32 math.
-_jax.config.update("jax_default_matmul_precision", "highest")
+# the correct default for float32 math. An existing user/env setting wins
+# (we never clobber an explicit choice); opt out of the framework default
+# with DL4J_TPU_MATMUL_PRECISION=default.
+_pref = _os.environ.get("DL4J_TPU_MATMUL_PRECISION", "highest")
+if _pref != "default" and _jax.config.jax_default_matmul_precision is None:
+    _jax.config.update("jax_default_matmul_precision", _pref)
 
 from deeplearning4j_tpu import activations, initializers, losses, schedules, updaters
 

@@ -1,0 +1,114 @@
+"""Nearest-neighbours HTTP microservice + client (reference
+``deeplearning4j-nearestneighbor-server/NearestNeighborsServer.java`` and
+``-client/NearestNeighborsClient.java``: Play-based KNN service with
+base64 NDArray DTOs).
+
+TPU-native: the service wraps a VPTree (batched MXU distance kernel) in
+the stdlib http.server — no web-framework dependency. Wire format is
+JSON; arrays travel as base64-encoded little-endian fp32 (the reference's
+``Base64NDArrayBody`` convention) or plain JSON lists.
+
+Endpoints (reference parity):
+- POST /knn        {"ndarray": <b64 or list>, "k": int} → neighbours
+- POST /knnnew     {"arr": ..., "k": int} — alias the reference exposes
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib import request as _urlreq
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.vptree import VPTree
+
+
+def _encode_array(a: np.ndarray) -> dict:
+    a = np.asarray(a, "<f4")
+    return {"shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _decode_array(obj) -> np.ndarray:
+    if isinstance(obj, list):
+        return np.asarray(obj, np.float32)
+    if isinstance(obj, dict) and "data" in obj:
+        a = np.frombuffer(base64.b64decode(obj["data"]), "<f4")
+        return a.reshape(obj.get("shape", [-1]))
+    raise ValueError("Expected a JSON list or {shape,data} base64 array")
+
+
+class NearestNeighborsServer:
+    """Serve KNN queries over a fixed point set."""
+
+    def __init__(self, points, similarity_function: str = "euclidean",
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.tree = VPTree(points, similarity_function)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    if self.path not in ("/knn", "/knnnew"):
+                        self.send_error(404)
+                        return
+                    q = _decode_array(body.get("ndarray", body.get("arr")))
+                    k = int(body.get("k", 5))
+                    d, idx = server.tree.knn(q.reshape(1, -1), k)
+                    resp = {"results": [
+                        {"index": int(i), "distance": float(dist)}
+                        for i, dist in zip(idx[0], d[0])
+                    ]}
+                    payload = json.dumps(resp).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:  # noqa: BLE001 — service boundary
+                    self.send_error(400, str(e)[:200])
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "NearestNeighborsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class NearestNeighborsClient:
+    """Reference ``NearestNeighborsClient``: knn(index?, vector, k)."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+
+    def knn(self, vector, k: int = 5) -> List[dict]:
+        body = json.dumps({
+            "ndarray": _encode_array(np.asarray(vector, np.float32)),
+            "k": k,
+        }).encode()
+        req = _urlreq.Request(
+            self.url + "/knn", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with _urlreq.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())["results"]

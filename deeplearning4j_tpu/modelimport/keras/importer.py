@@ -91,10 +91,11 @@ def _resolve_loss(loss_hint: Optional[str], activation: Optional[str],
     loss = loss_hint or _LOSS_BY_ACT.get(activation) or default_loss
     if loss is None:
         raise ValueError(
-            f"Cannot infer a loss for {what}: the file has no "
-            "training_config (model was saved uncompiled) and the output "
-            f"activation {activation!r} has no canonical loss pairing. "
-            "Pass default_loss=... (e.g. 'mse', 'mcxent') to choose one "
+            f"Cannot infer a loss for {what}: the file's training_config "
+            "yielded no usable loss (saved uncompiled, or compiled with a "
+            "loss this importer does not map) and the output activation "
+            f"{activation!r} has no canonical loss pairing. Pass "
+            "default_loss=... (e.g. 'mse', 'mcxent') to choose one "
             "explicitly."
         )
     return loss

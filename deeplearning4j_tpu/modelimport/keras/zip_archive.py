@@ -181,8 +181,12 @@ class KerasZipArchive:
         g = self._h5.get("layers")
         if g is None:
             return {}
-        h5_name = layer_name if layer_name in g else \
-            self._h5_name.get(layer_name)
+        # the class-order mapping is authoritative: a config name like
+        # "dense_1" can COLLIDE with another layer's positional h5 group
+        # name, so a direct hit is only trusted when no mapping exists
+        h5_name = self._h5_name.get(layer_name)
+        if h5_name is None and layer_name in g:
+            h5_name = layer_name
         if h5_name is None or h5_name not in g:
             return {}
         orig = layer_name

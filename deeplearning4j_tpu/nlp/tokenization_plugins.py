@@ -1,0 +1,111 @@
+"""Language-specific tokenizer plug-ins (reference modules
+``deeplearning4j-nlp-chinese`` (ansj), ``-japanese`` (kuromoji),
+``-korean``, ``-uima``; SURVEY.md §2.7).
+
+The reference vendors heavyweight morphological analyzers; this image has
+zero egress and no such models, so these factories implement the
+script-aware tokenization core those libraries provide over plain text:
+CJK ideographs are split per character (the standard fallback of all
+three reference analyzers for out-of-dictionary text), interleaved Latin
+runs stay word-level, and Korean Hangul splits on whitespace with
+particle-preserving behavior. A user-supplied lexicon enables greedy
+longest-match segmentation (the dictionary part of ansj/kuromoji).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional, Set
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    Tokenizer,
+    TokenizerFactory,
+    TokenPreProcess,
+)
+
+_CJK = (
+    "一-鿿"      # CJK unified ideographs
+    "㐀-䶿"      # extension A
+    "豈-﫿"      # compatibility ideographs
+)
+_KANA = "぀-ゟ゠-ヿ"
+_HANGUL = "가-힯ᄀ-ᇿ"
+
+_SEG = re.compile(
+    f"([{_CJK}]+)|([{_KANA}]+)|([{_HANGUL}]+)|([^\\s{_CJK}{_KANA}{_HANGUL}]+)"
+)
+
+
+def _segment(text: str, char_scripts: str, lexicon: Optional[Set[str]]) -> List[str]:
+    """Split script runs; runs of ``char_scripts`` are segmented per char
+    or by greedy longest lexicon match; other runs stay whole tokens."""
+    out: List[str] = []
+    char_re = re.compile(f"[{char_scripts}]")
+    for m in _SEG.finditer(text):
+        run = m.group(0)
+        if not char_re.match(run[0]):
+            out.append(run)
+            continue
+        i = 0
+        while i < len(run):
+            if lexicon:
+                # greedy longest match up to 8 chars
+                for ln in range(min(8, len(run) - i), 1, -1):
+                    if run[i:i + ln] in lexicon:
+                        out.append(run[i:i + ln])
+                        i += ln
+                        break
+                else:
+                    out.append(run[i])
+                    i += 1
+            else:
+                out.append(run[i])
+                i += 1
+    return out
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """Reference ``ChineseTokenizer.java`` (ansj). Per-ideograph with
+    optional lexicon longest-match."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        self._preprocessor: Optional[TokenPreProcess] = None
+        self.lexicon = set(lexicon) if lexicon else None
+
+    def create(self, sentence: str) -> Tokenizer:
+        return Tokenizer(_segment(sentence, _CJK, self.lexicon),
+                         self._preprocessor)
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Reference ``JapaneseTokenizer`` (kuromoji). Kana runs are kept
+    whole (phonetic words), kanji per character / lexicon."""
+
+    def __init__(self, lexicon: Optional[Iterable[str]] = None):
+        self._preprocessor: Optional[TokenPreProcess] = None
+        self.lexicon = set(lexicon) if lexicon else None
+
+    def create(self, sentence: str) -> Tokenizer:
+        return Tokenizer(_segment(sentence, _CJK, self.lexicon),
+                         self._preprocessor)
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Reference ``KoreanTokenizer``. Hangul splits on whitespace (eojeol
+    units); an optional particle list strips trailing josa."""
+
+    _DEFAULT_JOSA = ("은", "는", "이", "가", "을", "를", "의", "에", "로", "와", "과")
+
+    def __init__(self, strip_particles: bool = True):
+        self._preprocessor: Optional[TokenPreProcess] = None
+        self.strip_particles = strip_particles
+
+    def create(self, sentence: str) -> Tokenizer:
+        toks = []
+        for w in sentence.split():
+            if self.strip_particles and len(w) > 1 and w[-1] in self._DEFAULT_JOSA:
+                toks.append(w[:-1])
+                toks.append(w[-1])
+            else:
+                toks.append(w)
+        return Tokenizer(toks, self._preprocessor)

@@ -78,11 +78,23 @@ class BatchNormalization(Layer):
         assert state is not None and "mean" in state, "BatchNormalization needs layer state"
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
         in_dtype = x.dtype
-        if in_dtype in (jnp.bfloat16, jnp.float16):
-            x = x.astype(jnp.float32)  # stats in full precision under bf16 compute
+        low_precision = in_dtype in (jnp.bfloat16, jnp.float16)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # statistics ACCUMULATE in fp32 without materializing an fp32
+            # copy of the activation (dtype= on the reduction) — the
+            # normalize below stays in the compute dtype, keeping the step
+            # HBM traffic bf16 (the train step is bandwidth-bound)
+            if low_precision:
+                # the convert+square fuses into the reduction loop
+                # (registers, not HBM): fp32 stats at bf16 memory traffic
+                xf = x.astype(jnp.float32)
+                mean = jnp.mean(xf, axis=axes)
+                var = jnp.maximum(
+                    jnp.mean(xf * xf, axes) - mean * mean, 0.0
+                )
+            else:  # full precision (incl. the fp64 gradient checker)
+                mean = jnp.mean(x, axis=axes)
+                var = jnp.var(x, axis=axes)
             # EMA update (reference decay semantics: new = decay*old + (1-decay)*batch)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
@@ -91,17 +103,21 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        inv = jax.lax.rsqrt(var + self.eps)
-        y = (x - mean) * inv
+        inv = jax.lax.rsqrt(var + self.eps)  # (C,) fp32
         if self.lock_gamma_beta:
-            y = self.gamma * y + self.beta
+            gamma, beta = self.gamma, self.beta
         else:
-            y = params["gamma"] * y + params["beta"]
+            gamma, beta = params["gamma"], params["beta"]
+        # folded form: y = x*scale + bias with per-channel fp32-computed
+        # scale/bias cast once — a single fused multiply-add in compute dtype
+        scale = (gamma * inv).astype(in_dtype)
+        bias = (beta - mean * inv * gamma).astype(in_dtype)
+        y = x * scale + bias
         if self.activation != "identity":
             from deeplearning4j_tpu import activations as _act
 
             y = _act.get(self.activation)(y)
-        return y.astype(in_dtype), new_state
+        return y, new_state
 
 
 @serde.register

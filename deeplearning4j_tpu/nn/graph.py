@@ -249,13 +249,19 @@ class ComputationGraph:
                 lmask = lmasks[i]
             if lmask is None:
                 lmask = m
+            # output-layer weight noise (the score path, not apply())
+            p_out = apply_weight_noise(
+                layer, params[name], train and rng is not None,
+                jax.random.fold_in(rng, hash(name) & 0x7FFFFFFF)
+                if rng is not None else None,
+            )
             if isinstance(layer, CenterLossOutputLayer):
-                per_ex = layer.compute_score(params[name], x, labels[i], lmask,
+                per_ex = layer.compute_score(p_out, x, labels[i], lmask,
                                              state=state[name])
                 if train:
                     new_state[name] = layer.update_centers(new_state[name], x, labels[i])
             else:
-                per_ex = layer.compute_score(params[name], x, labels[i], lmask)
+                per_ex = layer.compute_score(p_out, x, labels[i], lmask)
             loss = loss + jnp.mean(per_ex)
         return loss, new_state
 
@@ -403,9 +409,10 @@ class ComputationGraph:
             np_list, no_list = _apply_layer_updates(
                 layers, p_list, g_list, o_list, t, iteration, epoch
             )
-            # detach carries between chunks (reference tBPTT semantics,
-            # ComputationGraph.java:1947 tbptt flag)
-            new_carries = jax.lax.stop_gradient(new_carries)
+            # tBPTT truncation is inherent: carries cross chunks only as
+            # fresh step INPUTS (each chunk is its own jit call), so no
+            # gradient flows across the boundary (reference
+            # ComputationGraph.java:1947 semantics)
             score = loss + self._reg_score(params)
             return (dict(zip(names, np_list)), dict(zip(names, no_list)),
                     new_state, new_carries, score)

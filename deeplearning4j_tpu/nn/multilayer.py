@@ -247,11 +247,16 @@ class MultiLayerNetwork:
             x = x.astype(jnp.float32)  # loss/softmax in full precision
         out_layer = self._output_layer()
         label_mask = lmask if lmask is not None else mask
+        # weight noise on the output layer: the forward stops before it,
+        # so noise the params here (reference applies getParamsWithNoise
+        # to output layers too)
+        p_out = apply_weight_noise(out_layer, params[-1],
+                                   train and rng is not None, rng)
         if isinstance(out_layer, CenterLossOutputLayer):
-            per_ex = out_layer.compute_score(params[-1], x, labels, label_mask, state=state[-1])
+            per_ex = out_layer.compute_score(p_out, x, labels, label_mask, state=state[-1])
             new_last_state = out_layer.update_centers(state[-1], x, labels) if train else state[-1]
         else:
-            per_ex = out_layer.compute_score(params[-1], x, labels, label_mask)
+            per_ex = out_layer.compute_score(p_out, x, labels, label_mask)
             new_last_state = state[-1]
         new_states.append(new_last_state)
         loss = jnp.mean(per_ex)
@@ -391,8 +396,9 @@ class MultiLayerNetwork:
             new_params, new_opt = _apply_layer_updates(
                 layers, params, grads, opt_state, t, iteration, epoch
             )
-            # detach carries between chunks (reference tBPTT semantics)
-            new_carries = jax.lax.stop_gradient(new_carries)
+            # tBPTT truncation is inherent: carries cross chunks only as
+            # fresh step inputs (each chunk is its own jit call), so no
+            # gradient flows across the boundary (reference semantics)
             score = loss + self._reg_score(params)
             return new_params, new_opt, new_states, new_carries, score
 

@@ -125,7 +125,7 @@ class EncodingHandler:
                  adapt_rate: float = 1.2, min_threshold: float = 1e-6):
         self.size = size
         self.threshold = float(threshold)
-        self.capacity = int(capacity)
+        self.capacity = min(int(capacity), int(size))  # top_k needs k ≤ n
         self.target = float(target_utilization)
         self.adapt = float(adapt_rate)
         self.min_threshold = float(min_threshold)
@@ -172,7 +172,8 @@ def make_compressed_allreduce(mesh, axis: str = "data",
     def body(grad, residual, threshold):
         # local shards arrive as (1, size)
         work = (residual + grad)[0]
-        msg, new_residual = threshold_encode(work, threshold, capacity)
+        cap = min(capacity, work.shape[0])  # top_k needs k ≤ n
+        msg, new_residual = threshold_encode(work, threshold, cap)
         all_idx = jax.lax.all_gather(msg.indices, axis)   # (n, K)
         all_val = jax.lax.all_gather(msg.values, axis)
         idx = jnp.maximum(all_idx.reshape(-1), 0)

@@ -240,3 +240,46 @@ class TestDeepWalk:
         )
         assert np.isfinite(dw.sv.last_loss)
         assert dw.get_vertex_vector(0).shape == (8,)
+
+
+class TestKnnServer:
+    def test_http_knn_roundtrip(self):
+        from deeplearning4j_tpu.clustering.server import (
+            NearestNeighborsClient,
+            NearestNeighborsServer,
+        )
+
+        x, _ = blobs(n_per=30, centers=2, dim=6, seed=11)
+        srv = NearestNeighborsServer(x, port=0).start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{srv.port}")
+            res = client.knn(x[3] + 0.001, k=4)
+            assert len(res) == 4
+            assert res[0]["index"] == 3  # itself is nearest
+            dists = [r["distance"] for r in res]
+            assert dists == sorted(dists)
+            bd, bidx = brute_knn((x[3] + 0.001)[None], x, 4)
+            assert [r["index"] for r in res] == list(bidx[0])
+        finally:
+            srv.stop()
+
+    def test_bad_request_is_400(self):
+        import urllib.error
+        import urllib.request
+
+        from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+
+        x, _ = blobs(n_per=10, centers=1, dim=4)
+        srv = NearestNeighborsServer(x, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/knn", data=b"not json",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                assert False, "expected HTTPError"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.stop()

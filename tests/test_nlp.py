@@ -353,3 +353,40 @@ class TestVectorizers:
         for w in words:
             np.testing.assert_allclose(loaded.get_word_vector(w),
                                        sw.get_word_vector(w), atol=1e-5)
+
+
+class TestTokenizerPlugins:
+    def test_chinese_per_char_and_lexicon(self):
+        from deeplearning4j_tpu.nlp.tokenization_plugins import (
+            ChineseTokenizerFactory,
+        )
+
+        tf = ChineseTokenizerFactory()
+        assert tf.create("我爱北京").get_tokens() == ["我", "爱", "北", "京"]
+        tf2 = ChineseTokenizerFactory(lexicon={"北京"})
+        assert tf2.create("我爱北京").get_tokens() == ["我", "爱", "北京"]
+
+    def test_chinese_mixed_latin(self):
+        from deeplearning4j_tpu.nlp.tokenization_plugins import (
+            ChineseTokenizerFactory,
+        )
+
+        toks = ChineseTokenizerFactory().create("我用 jax 框架").get_tokens()
+        assert "jax" in toks and "我" in toks
+
+    def test_japanese_kana_runs_kept(self):
+        from deeplearning4j_tpu.nlp.tokenization_plugins import (
+            JapaneseTokenizerFactory,
+        )
+
+        toks = JapaneseTokenizerFactory().create("これは漢字です").get_tokens()
+        assert "これは" in toks  # kana run whole
+        assert "漢" in toks and "字" in toks  # kanji per char
+
+    def test_korean_particle_split(self):
+        from deeplearning4j_tpu.nlp.tokenization_plugins import (
+            KoreanTokenizerFactory,
+        )
+
+        toks = KoreanTokenizerFactory().create("고양이는 귀엽다").get_tokens()
+        assert toks[0] == "고양이" and toks[1] == "는"

@@ -361,3 +361,34 @@ class TestGradientCompression:
         dense = np.asarray(grads.sum(0))
         # transmitted mass approaches the dense sum within threshold*n slack
         np.testing.assert_allclose(total, dense, atol=0.05 * n + 1e-3)
+
+
+class TestOutputLayerWeightNoise:
+    def test_output_layer_weight_noise_affects_training_loss(self):
+        """Weight noise configured on the OUTPUT layer must reach the loss
+        path (review regression: the forward stops before the output
+        layer, so the score path applies the noise)."""
+        import jax as _jax
+        from deeplearning4j_tpu.nn.conf.layers import WeightNoise
+
+        def build(noise):
+            conf = (
+                NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.0))
+                .weight_init("xavier").list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent", weight_noise=noise))
+                .set_input_type(InputType.feed_forward(4)).build()
+            )
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        ds = DataSet(x, y)
+        clean = build(None)
+        noisy = build(WeightNoise(0.5))
+        clean.fit(ds, epochs=1, batch_size=32)
+        noisy.fit(ds, epochs=1, batch_size=32)
+        # lr=0 → params unchanged; only the noise can alter the score
+        assert float(clean.score_) != float(noisy.score_)
