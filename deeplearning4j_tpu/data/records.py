@@ -129,12 +129,16 @@ class ImageRecordReader(RecordReader):
     def next_record(self) -> List:
         from PIL import Image
 
+        from deeplearning4j_tpu import native_etl
+
         path, label = self._files[self._pos]
         self._pos += 1
         img = Image.open(path)
         img = img.convert("RGB" if self.channels == 3 else "L")
         img = img.resize((self.width, self.height))
-        arr = np.asarray(img, np.float32) / 255.0
+        # uint8 → fp32 scale runs in the native ETL kernel when built
+        # (numpy fallback otherwise) — the DataVec/libnd4j role
+        arr = native_etl.u8_to_f32(np.asarray(img, np.uint8))
         if self.channels == 1 and arr.ndim == 2:
             arr = arr[..., None]
         return [arr, label]

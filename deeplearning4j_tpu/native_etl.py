@@ -1,0 +1,137 @@
+"""ctypes bindings for the native ETL library (native/etl.cpp) — the
+C++ host-runtime half the reference gets from DataVec/libnd4j
+(SURVEY.md §2.9). Auto-builds with ``make -C native`` on first use when a
+toolchain is present; every entry point has a numpy fallback so the pure-
+Python install keeps working.
+
+API (all return numpy arrays; inputs are converted as needed):
+- ``u8_to_f32(arr_u8, scale=1/255, bias=0.0)``
+- ``standardize(arr_f32, mean, std)``          (in-place-free)
+- ``one_hot(ids_i32, num_classes)``
+- ``parse_float_line(line: str, delim=',')``
+- ``available()`` → bool — whether the native path is active
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdl4jtpu_etl.so")
+
+_lib = None
+_lock = threading.Lock()
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and os.path.exists(
+            os.path.join(_NATIVE_DIR, "Makefile")
+        ):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR], check=True,
+                    capture_output=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        if not os.path.exists(_SO_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        c_f32p = ctypes.POINTER(ctypes.c_float)
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.u8_to_f32_scale.argtypes = [c_u8p, c_f32p, ctypes.c_int64,
+                                        ctypes.c_float, ctypes.c_float]
+        lib.standardize_f32.argtypes = [c_f32p, ctypes.c_int64,
+                                        ctypes.c_float, ctypes.c_float]
+        lib.standardize_cols_f32.argtypes = [c_f32p, ctypes.c_int64,
+                                             ctypes.c_int64, c_f32p, c_f32p]
+        lib.one_hot_f32.argtypes = [c_i32p, ctypes.c_int64, ctypes.c_int64,
+                                    c_f32p]
+        lib.one_hot_f32.restype = ctypes.c_int64
+        lib.parse_floats.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_char, c_f32p, ctypes.c_int64]
+        lib.parse_floats.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def u8_to_f32(arr: np.ndarray, scale: float = 1.0 / 255.0,
+              bias: float = 0.0) -> np.ndarray:
+    arr = np.ascontiguousarray(arr, np.uint8)
+    lib = _load()
+    if lib is None:
+        return arr.astype(np.float32) * scale + bias
+    out = np.empty(arr.shape, np.float32)
+    lib.u8_to_f32_scale(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), _fptr(out),
+        arr.size, ctypes.c_float(scale), ctypes.c_float(bias),
+    )
+    return out
+
+
+def standardize(arr: np.ndarray, mean: float, std: float) -> np.ndarray:
+    out = np.ascontiguousarray(arr, np.float32).copy()
+    inv = 1.0 / max(float(std), 1e-12)
+    lib = _load()
+    if lib is None:
+        return (out - mean) * inv
+    lib.standardize_f32(_fptr(out), out.size, ctypes.c_float(mean),
+                        ctypes.c_float(inv))
+    return out
+
+
+def one_hot(ids: np.ndarray, num_classes: int) -> np.ndarray:
+    ids = np.ascontiguousarray(ids, np.int32)
+    lib = _load()
+    if lib is None:
+        out = np.zeros((ids.size, num_classes), np.float32)
+        valid = (ids >= 0) & (ids < num_classes)
+        out[np.arange(ids.size)[valid], ids[valid]] = 1.0
+        return out.reshape(*ids.shape, num_classes)
+    out = np.zeros((ids.size, num_classes), np.float32)
+    lib.one_hot_f32(
+        ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), ids.size,
+        num_classes, _fptr(out),
+    )
+    return out.reshape(*ids.shape, num_classes)
+
+
+def parse_float_line(line: str, delim: str = ",",
+                     max_values: int = 4096) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        return np.asarray(
+            [float(v) for v in line.split(delim) if v.strip()], np.float32
+        )
+    raw = line.encode("utf-8")
+    out = np.empty((max_values,), np.float32)
+    n = lib.parse_floats(raw, len(raw), ctypes.c_char(delim.encode()),
+                         _fptr(out), max_values)
+    return out[:n].copy()

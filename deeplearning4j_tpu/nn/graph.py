@@ -249,11 +249,12 @@ class ComputationGraph:
                 lmask = lmasks[i]
             if lmask is None:
                 lmask = m
-            # output-layer weight noise (the score path, not apply())
+            # output-layer weight noise (the score path, not apply());
+            # fold in the output INDEX — deterministic across processes
+            # (string hash() is PYTHONHASHSEED-randomized)
             p_out = apply_weight_noise(
                 layer, params[name], train and rng is not None,
-                jax.random.fold_in(rng, hash(name) & 0x7FFFFFFF)
-                if rng is not None else None,
+                jax.random.fold_in(rng, i) if rng is not None else None,
             )
             if isinstance(layer, CenterLossOutputLayer):
                 per_ex = layer.compute_score(p_out, x, labels[i], lmask,
@@ -395,7 +396,11 @@ class ComputationGraph:
                     lmask = lmasks[i] if (lmasks is not None and i < len(lmasks)) else None
                     if lmask is None:
                         lmask = m
-                    per_ex = layer.compute_score(p[oname], x, labels[i], lmask)
+                    p_out = apply_weight_noise(
+                        layer, p[oname], rng is not None,
+                        jax.random.fold_in(rng, i) if rng is not None else None,
+                    )
+                    per_ex = layer.compute_score(p_out, x, labels[i], lmask)
                     loss = loss + jnp.mean(per_ex)
                 return loss, (new_state, new_carries)
 

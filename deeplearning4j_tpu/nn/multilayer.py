@@ -385,7 +385,8 @@ class MultiLayerNetwork:
                     x = x.astype(jnp.float32)
                 out_layer = self._output_layer()
                 label_mask = lmask if lmask is not None else mask
-                per_ex = out_layer.compute_score(p[-1], x, labels, label_mask)
+                p_out = apply_weight_noise(out_layer, p[-1], rng is not None, rng)
+                per_ex = out_layer.compute_score(p_out, x, labels, label_mask)
                 new_states.append(state[-1])
                 return jnp.mean(per_ex), (new_states, new_carries)
 

@@ -122,7 +122,13 @@ class DistributedLMTrainer:
 
             return blocks_fn
 
-        # PP (optionally + SP): GPipe schedule
+        # PP (optionally + SP): GPipe schedule, SCAN-ROLLED — the tick
+        # loop is a lax.scan so the compiled program is O(1) in microbatch
+        # count (round-2 weakness: the Python-unrolled loop made compile
+        # time scale with M+pp). Stage s computes microbatch m at tick
+        # t = s + m; activations hop stages via ppermute; backward
+        # pipelining falls out of scan+ppermute autodiff (reverse ring,
+        # reverse tick order).
         M = self.n_micro
 
         def pipeline(bp_local, x):
@@ -132,17 +138,38 @@ class DistributedLMTrainer:
             B = x.shape[0]
             mb = B // M
             xs = x.reshape(M, mb, *x.shape[1:])
-            recv = jnp.zeros_like(xs[0])
-            outs = jnp.zeros_like(xs)
             perm = [(i, (i + 1) % pp) for i in range(pp)]
-            for t in range(M + pp):
-                if t >= pp:
-                    outs = outs.at[t - pp].set(recv)
-                if t <= M + pp - 2:
-                    sel = min(t, M - 1)
-                    x_in = jnp.where(stage == 0, xs[sel], recv)
-                    y = stack_scan(bp_local, x_in)
-                    recv = jax.lax.ppermute(y, "pipe", perm)
+
+            def tick(carry, t):
+                recv, outs = carry
+                # drain: from tick pp onward, recv holds a finished
+                # microbatch (wrapped around the ring from the last stage)
+                outs = jax.lax.cond(
+                    t >= pp,
+                    lambda o: jax.lax.dynamic_update_index_in_dim(
+                        o, recv, jnp.maximum(t - pp, 0), 0),
+                    lambda o: o,
+                    outs,
+                )
+                sel = jnp.clip(t, 0, M - 1)
+                x_in = jnp.where(
+                    stage == 0,
+                    jax.lax.dynamic_index_in_dim(xs, sel, 0, keepdims=False),
+                    recv,
+                )
+                y = stack_scan(bp_local, x_in)
+                recv = jax.lax.ppermute(y, "pipe", perm)
+                return (recv, outs), None
+
+            # M+pp-1 compute ticks; the LAST microbatch drains from recv
+            # after the scan (the old unrolled loop's final store-only
+            # tick) — no wasted stage compute
+            (recv, outs), _ = jax.lax.scan(
+                tick,
+                (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+                jnp.arange(M + pp - 1),
+            )
+            outs = outs.at[M - 1].set(recv)
             # final outputs live on stage 0; broadcast over the pipe axis
             outs = jnp.where(stage == 0, outs, 0.0)
             outs = jax.lax.psum(outs, "pipe")

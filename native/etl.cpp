@@ -1,0 +1,103 @@
+// Native ETL kernels — the host-side runtime role the reference
+// outsources to DataVec + libnd4j (SURVEY.md §2.9: record conversion and
+// buffer preparation happen in C++ there; here the hot host loops that
+// feed the TPU are C++ too, behind ctypes bindings in
+// deeplearning4j_tpu/native_etl.py with a pure-numpy fallback).
+//
+// Build: make -C native   (g++ -O3 -shared; auto-vectorized loops)
+//
+// All functions use C linkage and operate on caller-owned buffers; no
+// allocation, no exceptions, thread-safe (no shared state) — safe to call
+// from Python threads with the GIL released (ctypes does this).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// uint8 HWC image -> float32, scaled: dst = src * scale + bias.
+// The inner loop of every image fetcher/record reader.
+void u8_to_f32_scale(const uint8_t* src, float* dst, int64_t n,
+                     float scale, float bias) {
+    for (int64_t i = 0; i < n; ++i) {
+        dst[i] = static_cast<float>(src[i]) * scale + bias;
+    }
+}
+
+// In-place standardize: x = (x - mean) / std  (std pre-clamped by caller).
+void standardize_f32(float* x, int64_t n, float mean, float inv_std) {
+    for (int64_t i = 0; i < n; ++i) {
+        x[i] = (x[i] - mean) * inv_std;
+    }
+}
+
+// Per-column standardize over a (rows, cols) row-major matrix.
+void standardize_cols_f32(float* x, int64_t rows, int64_t cols,
+                          const float* mean, const float* inv_std) {
+    for (int64_t r = 0; r < rows; ++r) {
+        float* row = x + r * cols;
+        for (int64_t c = 0; c < cols; ++c) {
+            row[c] = (row[c] - mean[c]) * inv_std[c];
+        }
+    }
+}
+
+// One-hot encode int32 class ids into a zeroed (n, classes) fp32 buffer.
+// Returns the count of out-of-range ids (left as all-zero rows).
+int64_t one_hot_f32(const int32_t* ids, int64_t n, int64_t classes,
+                    float* out) {
+    std::memset(out, 0, sizeof(float) * n * classes);
+    int64_t bad = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t c = ids[i];
+        if (c >= 0 && c < classes) {
+            out[i * classes + c] = 1.0f;
+        } else {
+            ++bad;
+        }
+    }
+    return bad;
+}
+
+// Parse a delimiter-separated buffer of ASCII floats (one record).
+// Returns the number of values written (<= max_out). Handles leading
+// whitespace; stops at NUL or len.
+int64_t parse_floats(const char* buf, int64_t len, char delim,
+                     float* out, int64_t max_out) {
+    int64_t count = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end && count < max_out) {
+        char* next = nullptr;
+        float v = strtof(p, &next);
+        if (next == p) {  // no parse: skip one char (delimiter or junk)
+            ++p;
+            continue;
+        }
+        out[count++] = v;
+        p = next;
+        while (p < end && (*p == delim || *p == ' ' || *p == '\t' ||
+                           *p == '\r' || *p == '\n')) {
+            ++p;
+        }
+    }
+    return count;
+}
+
+// Interleave a uint8 grayscale image into NHWC float with per-channel
+// tint: dst[..., c] = bg[c] + src * (tint[c] - bg[c]) (synthetic-SVHN
+// style colorization; hot loop of the fetcher fallback path).
+void gray_tint_nhwc(const uint8_t* src, float* dst, int64_t hw,
+                    const float* tint, const float* bg, int channels) {
+    for (int64_t i = 0; i < hw; ++i) {
+        float g = static_cast<float>(src[i]) * (1.0f / 255.0f);
+        float* px = dst + i * channels;
+        for (int c = 0; c < channels; ++c) {
+            px[c] = bg[c] + g * (tint[c] - bg[c]);
+        }
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+"""User-defined custom layers — the SameDiff-layer-bridge equivalent
+(reference ``nn/conf/layers/samediff/AbstractSameDiffLayer.java`` +
+``nn/layers/samediff/SameDiffLayer.java``: users write a layer against
+the autodiff API and it participates in networks, serde and training).
+
+Here the story is simpler and fully supported: subclass ``Layer`` (or
+``FeedForwardLayer``), write ``init_params`` + ``apply`` in jax.numpy —
+autodiff and jit come for free — and ``@serde.register`` makes it
+JSON/checkpoint round-trippable. This test IS the documented recipe.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration, serde
+from deeplearning4j_tpu.nn.conf.layers import OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+from deeplearning4j_tpu.nn.gradient_check import check_gradients
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+from deeplearning4j_tpu.updaters import Adam
+
+
+# ---- the recipe: a custom gated-linear layer in ~20 lines ----------------
+@serde.register
+class GatedLinearLayer(FeedForwardLayer):
+    """y = (x @ W) * sigmoid(x @ G) — a user-defined layer. Everything a
+    built-in layer can do (autodiff, jit, serde, checkpoints, gradient
+    checking) works without further registration."""
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": self._draw_weight(k1, (self.n_in, self.n_out),
+                                   self.n_in, self.n_out, dtype),
+            "G": self._draw_weight(k2, (self.n_in, self.n_out),
+                                   self.n_in, self.n_out, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y = (x @ params["W"]) * jax.nn.sigmoid(x @ params["G"]) + params["b"]
+        return y, state or {}
+
+
+def _net(seed=3):
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.02))
+        .weight_init("xavier").list()
+        .layer(GatedLinearLayer(n_out=12))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(5)).build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] * x[:, 1] > 0).astype(int)]
+    return DataSet(x, y)
+
+
+class TestCustomLayer:
+    def test_trains(self):
+        net = _net()
+        ds = _data()
+        scores = []
+        for _ in range(20):
+            net.fit(ds, epochs=1, batch_size=32)
+            scores.append(float(net.score_))
+        assert scores[-1] < scores[0]
+
+    def test_gradient_check(self):
+        """The fp64 central-difference checker works on user layers
+        unchanged (the reference's custom-layer suites do the same,
+        ``nn/layers/samediff/testlayers/``)."""
+        net = _net()
+        assert check_gradients(net, _data(n=6), print_results=False)
+
+    def test_json_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+
+        net = _net()
+        restored = MultiLayerConfiguration.from_json(net.conf.to_json())
+        assert isinstance(restored.layers[0], GatedLinearLayer)
+        assert restored.layers[0].n_out == 12
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        net = _net()
+        ds = _data()
+        net.fit(ds, epochs=2, batch_size=32)
+        p = str(tmp_path / "custom.zip")
+        ModelSerializer.write_model(net, p)
+        net2 = ModelSerializer.restore_multi_layer_network(p)
+        np.testing.assert_allclose(net.output(ds.features),
+                                   net2.output(ds.features), atol=1e-6)

@@ -211,3 +211,48 @@ class TestFetchers:
         a = SvhnDataSetIterator(16, num_examples=16).next()
         b = SvhnDataSetIterator(16, num_examples=16).next()
         np.testing.assert_array_equal(a.features, b.features)
+
+
+class TestNativeEtl:
+    """Native C++ ETL kernels (native/etl.cpp via ctypes) must agree with
+    the numpy fallbacks bit-for-bit on the paths the data bridge uses."""
+
+    def test_available_and_parity(self):
+        from deeplearning4j_tpu import native_etl as ne
+
+        rng = np.random.default_rng(1)
+        u8 = rng.integers(0, 256, (3, 5, 4, 3)).astype(np.uint8)
+        np.testing.assert_allclose(
+            ne.u8_to_f32(u8), u8.astype(np.float32) / 255.0, atol=1e-6
+        )
+        x = rng.standard_normal(64).astype(np.float32)
+        np.testing.assert_allclose(
+            ne.standardize(x, 0.3, 1.7), (x - 0.3) / 1.7, atol=1e-5
+        )
+        ids = np.asarray([2, 0, 7, -1], np.int32)
+        oh = ne.one_hot(ids, 5)
+        assert oh.shape == (4, 5)
+        assert oh[2].sum() == 0 and oh[3].sum() == 0  # out of range → zero
+        assert oh[0, 2] == 1 and oh[1, 0] == 1
+        np.testing.assert_allclose(
+            ne.parse_float_line("1,2.5,-3e1"), [1.0, 2.5, -30.0], atol=1e-6
+        )
+
+    def test_image_reader_uses_native_scaling(self, tmp_path):
+        from PIL import Image
+
+        from deeplearning4j_tpu import native_etl as ne
+
+        d = tmp_path / "c"
+        d.mkdir()
+        rng = np.random.default_rng(2)
+        raw = (rng.random((6, 6, 3)) * 255).astype(np.uint8)
+        Image.fromarray(raw).save(d / "img.png")
+        rr = ImageRecordReader(6, 6, 3, str(tmp_path))
+        arr, label = rr.next_record()
+        assert arr.dtype == np.float32
+        # exact u8/255 scaling regardless of which path ran
+        np.testing.assert_allclose(arr, raw.astype(np.float32) / 255.0,
+                                   atol=1e-6)
+        if not ne.available():
+            pytest.skip("native ETL library not built in this environment")
