@@ -1,0 +1,112 @@
+"""Command-line training entry point (reference
+``parallelism/main/ParallelWrapperMain.java`` — the repo's only training
+CLI: model + data + workers → fit → save).
+
+Usage:
+    python -m deeplearning4j_tpu.cli --model lenet --dataset mnist \\
+        --epochs 2 --batch-size 64 --workers 8 --output /tmp/model.zip \\
+        --stats /tmp/stats.jsonl --dashboard /tmp/dash.html
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def build_dataset(name: str, batch_size: int, num_examples):
+    from deeplearning4j_tpu.data.fetchers import (
+        SvhnDataSetIterator,
+        TinyImageNetDataSetIterator,
+        UciSequenceDataSetIterator,
+    )
+    from deeplearning4j_tpu.data.mnist import (
+        IrisDataSetIterator,
+        MnistDataSetIterator,
+    )
+
+    name = name.lower()
+    if name == "mnist":
+        return MnistDataSetIterator(batch_size, train=True,
+                                    num_examples=num_examples), 10
+    if name == "iris":
+        return IrisDataSetIterator(batch_size), 3
+    if name == "svhn":
+        return SvhnDataSetIterator(batch_size, num_examples=num_examples), 10
+    if name == "tinyimagenet":
+        return TinyImageNetDataSetIterator(batch_size,
+                                           num_examples=num_examples), 200
+    if name == "uci":
+        return UciSequenceDataSetIterator(batch_size,
+                                          num_examples=num_examples), 6
+    raise SystemExit(f"Unknown dataset '{name}'")
+
+
+def build_model(name: str, num_classes: int):
+    from deeplearning4j_tpu.models.selector import ModelSelector
+
+    model = ModelSelector.select(name, num_classes=num_classes)
+    return model.init()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu",
+        description="Train a zoo model (ParallelWrapperMain equivalent)",
+    )
+    ap.add_argument("--model", required=True,
+                    help="zoo model name (lenet, simplecnn, resnet50, ...)")
+    ap.add_argument("--dataset", default="mnist",
+                    help="mnist | iris | svhn | tinyimagenet | uci")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=None)
+    ap.add_argument("--workers", type=int, default=1,
+                    help=">1 trains data-parallel over that many devices")
+    ap.add_argument("--output", default=None, help="checkpoint zip path")
+    ap.add_argument("--stats", default=None, help="JSONL stats path")
+    ap.add_argument("--dashboard", default=None, help="HTML dashboard path")
+    args = ap.parse_args(argv)
+
+    it, num_classes = build_dataset(args.dataset, args.batch_size,
+                                    args.num_examples)
+    model = build_model(args.model, num_classes)
+    print(f"model={args.model} ({model.num_params():,} params) "
+          f"dataset={args.dataset} epochs={args.epochs}", flush=True)
+
+    storage = None
+    if args.stats or args.dashboard:
+        from deeplearning4j_tpu.ui import FileStatsStorage, InMemoryStatsStorage, StatsListener
+
+        storage = (FileStatsStorage(args.stats) if args.stats
+                   else InMemoryStatsStorage())
+        model.add_listeners(StatsListener(storage, session_id="cli"))
+
+    t0 = time.time()
+    if args.workers > 1:
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        pw = ParallelWrapper.builder(model).workers(args.workers).build()
+        pw.fit(it, epochs=args.epochs)
+    else:
+        for _ in range(args.epochs):
+            model._fit_one_epoch(it)
+    print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
+          f"final score {float(model.score_):.4f}", flush=True)
+
+    if args.output:
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        ModelSerializer.write_model(model, args.output)
+        print(f"saved {args.output}", flush=True)
+    if args.dashboard and storage is not None:
+        from deeplearning4j_tpu.ui import render_dashboard
+
+        render_dashboard(storage, path=args.dashboard)
+        print(f"dashboard {args.dashboard}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

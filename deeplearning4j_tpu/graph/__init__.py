@@ -8,8 +8,9 @@ from deeplearning4j_tpu.graph.walks import (
     WeightedRandomWalkIterator,
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
+from deeplearning4j_tpu.graph.node2vec import BiasedRandomWalkIterator, Node2Vec
 
 __all__ = [
     "Graph", "RandomWalkIterator", "WeightedRandomWalkIterator",
-    "DeepWalk", "GraphVectors",
+    "DeepWalk", "GraphVectors", "Node2Vec", "BiasedRandomWalkIterator",
 ]

@@ -1,0 +1,103 @@
+"""Node2Vec (reference ``models/node2vec/Node2Vec.java``): DeepWalk with
+2nd-order biased random walks — return parameter ``p`` (likelihood of
+revisiting the previous node) and in-out parameter ``q`` (BFS-like q<1 vs
+DFS-like q>1), per Grover & Leskovec 2016. Training reuses the batched
+skip-gram kernels via SequenceVectors, exactly like DeepWalk."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors, _degree_vocab
+from deeplearning4j_tpu.graph.graph import Graph
+from deeplearning4j_tpu.graph.walks import RandomWalkIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+
+class BiasedRandomWalkIterator(RandomWalkIterator):
+    """node2vec 2nd-order walk: unnormalized next-step weight is 1/p to
+    return to the previous node, 1 for a neighbour of the previous node,
+    1/q otherwise."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 42, walks_per_vertex: int = 1):
+        super().__init__(graph, walk_length, seed, walks_per_vertex)
+        self.p = float(p)
+        self.q = float(q)
+        # neighbour sets for O(1) membership checks
+        self._nbr_sets = [
+            set(graph.get_connected_vertices(v))
+            for v in range(graph.num_vertices())
+        ]
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed)
+        order = np.arange(self.graph.num_vertices())
+        for _ in range(self.walks_per_vertex):
+            rng.shuffle(order)
+            for start in order:
+                walk = [int(start)]
+                prev = None
+                v = int(start)
+                for _ in range(self.walk_length - 1):
+                    nbrs = self.graph.get_connected_vertices(v)
+                    if not nbrs:
+                        walk.append(v)  # self-loop on disconnected
+                        continue
+                    if prev is None:
+                        nxt = nbrs[rng.integers(0, len(nbrs))]
+                    else:
+                        w = np.empty(len(nbrs))
+                        prev_nbrs = self._nbr_sets[prev]
+                        for i, u in enumerate(nbrs):
+                            if u == prev:
+                                w[i] = 1.0 / self.p
+                            elif u in prev_nbrs:
+                                w[i] = 1.0
+                            else:
+                                w[i] = 1.0 / self.q
+                        nxt = int(rng.choice(nbrs, p=w / w.sum()))
+                    walk.append(nxt)
+                    prev, v = v, nxt
+                yield np.asarray(walk, np.int32)
+
+
+class Node2Vec(DeepWalk):
+    """DeepWalk with the biased walk generator injected — the training
+    setup is DeepWalk's, unchanged (node2vec's published configuration
+    uses negative sampling, so the Builder defaults differ)."""
+
+    class Builder(DeepWalk.Builder):
+        def __init__(self):
+            super().__init__()
+            self._p = 1.0
+            self._q = 1.0
+            self._negative = 5       # node2vec's published setting is NS
+            self._use_hs = False
+
+        def p(self, v: float):
+            self._p = float(v)
+            return self
+
+        def q(self, v: float):
+            self._q = float(v)
+            return self
+
+        def build(self) -> "Node2Vec":
+            return Node2Vec(self)
+
+    @staticmethod
+    def builder():
+        return Node2Vec.Builder()
+
+    def fit(self, graph: Graph, walk_iterator=None) -> "Node2Vec":
+        b = self._b
+        if walk_iterator is None:
+            walk_iterator = BiasedRandomWalkIterator(
+                graph, b._walk_length, p=b._p, q=b._q, seed=b._seed,
+                walks_per_vertex=b._walks_per_vertex,
+            )
+        super().fit(graph, walk_iterator=walk_iterator)
+        return self

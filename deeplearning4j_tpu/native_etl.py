@@ -42,12 +42,29 @@ def _load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SO_PATH) and os.path.exists(
             os.path.join(_NATIVE_DIR, "Makefile")
         ):
+            import warnings
+
             try:
+                # one-time build; subsequent loads hit the cached .so.
+                # Build failures are REPORTED (the numpy fallback keeps
+                # things working, but silently-slow is a debugging trap).
                 subprocess.run(
                     ["make", "-C", _NATIVE_DIR], check=True,
-                    capture_output=True, timeout=120,
+                    capture_output=True, timeout=60,
                 )
-            except (OSError, subprocess.SubprocessError):
+            except subprocess.CalledProcessError as e:
+                warnings.warn(
+                    "native ETL build failed; using numpy fallbacks. "
+                    f"stderr: {e.stderr.decode(errors='replace')[-400:]}",
+                    stacklevel=3,
+                )
+                return None
+            except (OSError, subprocess.SubprocessError) as e:
+                warnings.warn(
+                    f"native ETL build unavailable ({e}); using numpy "
+                    "fallbacks",
+                    stacklevel=3,
+                )
                 return None
         if not os.path.exists(_SO_PATH):
             return None
@@ -62,8 +79,6 @@ def _load() -> Optional[ctypes.CDLL]:
                                         ctypes.c_float, ctypes.c_float]
         lib.standardize_f32.argtypes = [c_f32p, ctypes.c_int64,
                                         ctypes.c_float, ctypes.c_float]
-        lib.standardize_cols_f32.argtypes = [c_f32p, ctypes.c_int64,
-                                             ctypes.c_int64, c_f32p, c_f32p]
         lib.one_hot_f32.argtypes = [c_i32p, ctypes.c_int64, ctypes.c_int64,
                                     c_f32p]
         lib.one_hot_f32.restype = ctypes.c_int64
@@ -131,7 +146,12 @@ def parse_float_line(line: str, delim: str = ",",
             [float(v) for v in line.split(delim) if v.strip()], np.float32
         )
     raw = line.encode("utf-8")
-    out = np.empty((max_values,), np.float32)
-    n = lib.parse_floats(raw, len(raw), ctypes.c_char(delim.encode()),
-                         _fptr(out), max_values)
-    return out[:n].copy()
+    # grow the buffer when saturated: results must match the unbounded
+    # numpy fallback regardless of record width
+    while True:
+        out = np.empty((max_values,), np.float32)
+        n = lib.parse_floats(raw, len(raw), ctypes.c_char(delim.encode()),
+                             _fptr(out), max_values)
+        if n < max_values:
+            return out[:n].copy()
+        max_values *= 2

@@ -524,6 +524,10 @@ class EarlyStoppingTrainer:
         self.train_iterator = train_iterator
         self.listener = listener  # EarlyStoppingListener: on_start/on_epoch/on_completion
 
+    def _fit_epoch(self) -> None:
+        """One training epoch; overridden by the parallel trainer."""
+        self.model._fit_one_epoch(self.train_iterator)
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         sc = cfg.score_calculator
@@ -549,7 +553,7 @@ class EarlyStoppingTrainer:
         try:
             while True:
                 try:
-                    self.model._fit_one_epoch(self.train_iterator)
+                    self._fit_epoch()
                 except _IterationTerminated as t:
                     reason = "IterationTerminationCondition"
                     details = str(t.condition)
@@ -622,3 +626,22 @@ class EarlyStoppingTrainer:
 
 # Graph alias (reference has a separate class; surface parity)
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping driving data-parallel training (reference
+    ``EarlyStoppingParallelTrainer.java`` wraps ParallelWrapper): each
+    epoch runs through the mesh-sharded wrapper instead of the
+    single-device fit loop."""
+
+    def __init__(self, config, model, train_iterator, wrapper=None,
+                 listener=None):
+        super().__init__(config, model, train_iterator, listener)
+        if wrapper is None:
+            from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+            wrapper = ParallelWrapper(model)
+        self.wrapper = wrapper
+
+    def _fit_epoch(self) -> None:
+        self.wrapper.fit(self.train_iterator, epochs=1)

@@ -20,8 +20,13 @@ from deeplearning4j_tpu.ui.storage import (
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.dashboard import UIServer, render_dashboard
 from deeplearning4j_tpu.ui.evaluation_tools import EvaluationTools
+from deeplearning4j_tpu.ui.remote import (
+    RemoteStatsReceiver,
+    RemoteUIStatsStorageRouter,
+)
 
 __all__ = [
     "StatsListener", "StatsStorage", "InMemoryStatsStorage",
     "FileStatsStorage", "UIServer", "render_dashboard", "EvaluationTools",
+    "RemoteUIStatsStorageRouter", "RemoteStatsReceiver",
 ]

@@ -1,0 +1,139 @@
+"""Remote stats routing (reference
+``deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java`` — HTTP
+POST of stats records to a UI host — and the receiving side
+``ui/module/remote/RemoteReceiverModule.java``).
+
+Train on one machine, watch on another: attach a
+``RemoteUIStatsStorageRouter`` to the StatsListener on the trainer; run a
+``RemoteStatsReceiver`` (backed by any StatsStorage) where the dashboard
+is rendered.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib import request as _urlreq
+
+from deeplearning4j_tpu.ui.storage import StatsStorage
+
+
+class RemoteUIStatsStorageRouter(StatsStorage):
+    """StatsStorage facade that ships records to a remote receiver.
+
+    Async by default (a worker thread drains a queue — the reference
+    posts asynchronously too, with retry limits); falls back to dropping
+    records after ``max_retries`` like the reference's retry policy.
+    """
+
+    def __init__(self, url: str, async_post: bool = True,
+                 max_retries: int = 3, timeout: float = 10.0):
+        self.url = url.rstrip("/") + "/stats"
+        self.max_retries = max_retries
+        self.timeout = timeout
+        self.dropped = 0
+        self._q: Optional[queue.Queue] = queue.Queue() if async_post else None
+        if self._q is not None:
+            self._worker = threading.Thread(target=self._drain, daemon=True)
+            self._worker.start()
+
+    def _post(self, record: dict) -> bool:
+        body = json.dumps(record).encode()
+        for _ in range(self.max_retries):
+            try:
+                req = _urlreq.Request(
+                    self.url, data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with _urlreq.urlopen(req, timeout=self.timeout) as resp:
+                    if 200 <= resp.status < 300:
+                        return True
+            except OSError:
+                continue
+        self.dropped += 1
+        return False
+
+    def _drain(self):
+        while True:
+            rec = self._q.get()
+            try:
+                if rec is None:
+                    return
+                self._post(rec)
+            finally:
+                self._q.task_done()
+
+    # -- StatsStorage surface (write-only router; reads are remote-side)
+    def put_record(self, record: dict) -> None:
+        if self._q is not None:
+            self._q.put(record)
+        else:
+            self._post(record)
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until queued records are POSTED (not merely dequeued —
+        task_done fires after the post completes)."""
+        if self._q is not None:
+            import time
+
+            deadline = time.time() + timeout
+            while self._q.unfinished_tasks and time.time() < deadline:
+                time.sleep(0.01)
+
+    def shutdown(self):
+        if self._q is not None:
+            self._q.put(None)
+
+    def list_session_ids(self):
+        raise NotImplementedError("router is write-only; query the receiver")
+
+    def get_records(self, session_id, worker_id=None):
+        raise NotImplementedError("router is write-only; query the receiver")
+
+
+class RemoteStatsReceiver:
+    """HTTP endpoint writing posted records into a backing StatsStorage
+    (reference ``RemoteReceiverModule``). ``storage`` is then rendered
+    with the normal dashboard."""
+
+    def __init__(self, storage: StatsStorage, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.storage = storage
+        recv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                if self.path != "/stats":
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    record = json.loads(self.rfile.read(n))
+                    recv.storage.put_record(record)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                except Exception as e:  # noqa: BLE001 — service boundary
+                    self.send_error(400, str(e)[:200])
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RemoteStatsReceiver":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

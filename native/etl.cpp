@@ -33,17 +33,6 @@ void standardize_f32(float* x, int64_t n, float mean, float inv_std) {
     }
 }
 
-// Per-column standardize over a (rows, cols) row-major matrix.
-void standardize_cols_f32(float* x, int64_t rows, int64_t cols,
-                          const float* mean, const float* inv_std) {
-    for (int64_t r = 0; r < rows; ++r) {
-        float* row = x + r * cols;
-        for (int64_t c = 0; c < cols; ++c) {
-            row[c] = (row[c] - mean[c]) * inv_std[c];
-        }
-    }
-}
-
 // One-hot encode int32 class ids into a zeroed (n, classes) fp32 buffer.
 // Returns the count of out-of-range ids (left as all-zero rows).
 int64_t one_hot_f32(const int32_t* ids, int64_t n, int64_t classes,
@@ -84,20 +73,6 @@ int64_t parse_floats(const char* buf, int64_t len, char delim,
         }
     }
     return count;
-}
-
-// Interleave a uint8 grayscale image into NHWC float with per-channel
-// tint: dst[..., c] = bg[c] + src * (tint[c] - bg[c]) (synthetic-SVHN
-// style colorization; hot loop of the fetcher fallback path).
-void gray_tint_nhwc(const uint8_t* src, float* dst, int64_t hw,
-                    const float* tint, const float* bg, int channels) {
-    for (int64_t i = 0; i < hw; ++i) {
-        float g = static_cast<float>(src[i]) * (1.0f / 255.0f);
-        float* px = dst + i * channels;
-        for (int c = 0; c < channels; ++c) {
-            px[c] = bg[c] + g * (tint[c] - bg[c]);
-        }
-    }
 }
 
 }  // extern "C"

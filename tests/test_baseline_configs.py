@@ -1,0 +1,171 @@
+"""End-to-end tests for the BASELINE.json named configs that became
+runnable in round 3 — most notably config #3, "deeplearning4j-nlp:
+Word2Vec + LSTM sentiment (ComputationGraph)": pretrained word vectors
+feed an LSTM sentiment classifier built as a ComputationGraph.
+
+(Config #1 LeNet/MNIST and #4 Keras import are covered by
+tests/test_zoo.py and tests/test_keras_import.py; #2/#5 run in bench.py
+and the multichip dryrun.)
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nlp import Word2Vec
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import LSTM, OutputLayer
+from deeplearning4j_tpu.nn.conf.graph_vertices import LastTimeStepVertex
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.updaters import Adam
+
+POSITIVE = ["great", "excellent", "wonderful", "love", "amazing", "happy"]
+NEGATIVE = ["terrible", "awful", "horrible", "hate", "boring", "sad"]
+NEUTRAL = ["movie", "film", "plot", "acting", "scene", "story", "the", "was"]
+
+
+def sentiment_corpus(n=400, max_len=8, seed=13):
+    """Synthetic reviews: sentiment words + neutral filler."""
+    rng = np.random.default_rng(seed)
+    sents, labels = [], []
+    for _ in range(n):
+        pos = rng.random() < 0.5
+        opinion = rng.choice(POSITIVE if pos else NEGATIVE,
+                             rng.integers(1, 3))
+        filler = rng.choice(NEUTRAL, rng.integers(3, max_len - 2))
+        words = list(opinion) + list(filler)
+        rng.shuffle(words)
+        sents.append(" ".join(words))
+        labels.append(1 if pos else 0)
+    return sents, np.asarray(labels)
+
+
+class TestWord2VecLstmSentiment:
+    @pytest.mark.slow
+    def test_config3_end_to_end(self):
+        sents, labels = sentiment_corpus()
+        # ---- phase 1: unsupervised Word2Vec on the corpus
+        w2v = (
+            Word2Vec.builder().iterate(sents).layer_size(16).window_size(3)
+            .min_word_frequency(2).seed(7).learning_rate(0.05).epochs(5)
+            .batch_size(256).negative_sample(5).build().fit()
+        )
+        D = 16
+        T = 10
+
+        def embed(sentence):
+            vecs = [
+                w2v.get_word_vector(t)
+                for t in sentence.split() if w2v.has_word(t)
+            ]
+            out = np.zeros((T, D), np.float32)
+            msk = np.zeros((T,), np.float32)
+            for i, v in enumerate(vecs[:T]):
+                out[i] = v
+                msk[i] = 1.0
+            return out, msk
+
+        X = np.zeros((len(sents), T, D), np.float32)
+        M = np.zeros((len(sents), T), np.float32)
+        for i, s in enumerate(sents):
+            X[i], M[i] = embed(s)
+        Y = np.eye(2, dtype=np.float32)[labels]
+
+        # ---- phase 2: LSTM sentiment ComputationGraph on the embeddings
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .weight_init("xavier").graph_builder()
+            .add_inputs("tokens")
+            .add_layer("lstm", LSTM(n_out=16, activation="tanh"), "tokens")
+            .add_vertex("last", LastTimeStepVertex(mask_input="tokens"), "lstm")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "last")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(D, T))
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        tr = DataSet(X[:320], Y[:320], M[:320])
+        te = DataSet(X[320:], Y[320:], M[320:])
+        acc = 0.0
+        for _ in range(30):
+            net.fit(tr, batch_size=64)
+            acc = net.evaluate(te).accuracy()
+            if acc >= 0.9:
+                break
+        assert acc >= 0.9, f"sentiment accuracy {acc:.3f} < 0.9"
+
+
+class TestCliAndParallelEarlyStopping:
+    def test_cli_trains_and_saves(self, tmp_path):
+        """ParallelWrapperMain-equivalent CLI: train, checkpoint,
+        dashboard (reference parallelism/main/ParallelWrapperMain.java)."""
+        from deeplearning4j_tpu.cli import main
+
+        out = str(tmp_path / "m.zip")
+        dash = str(tmp_path / "d.html")
+        rc = main([
+            "--model", "lenet", "--dataset", "mnist", "--epochs", "1",
+            "--batch-size", "32", "--num-examples", "64",
+            "--output", out, "--dashboard", dash,
+        ])
+        assert rc == 0
+        import os
+
+        assert os.path.exists(out) and os.path.exists(dash)
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        net = ModelSerializer.restore_multi_layer_network(out)
+        assert net.iteration == 2
+
+    def test_cli_parallel_workers(self, tmp_path):
+        from deeplearning4j_tpu.cli import main
+
+        rc = main([
+            "--model", "lenet", "--dataset", "mnist", "--epochs", "1",
+            "--batch-size", "32", "--num-examples", "64", "--workers", "8",
+        ])
+        assert rc == 0
+
+    def test_early_stopping_parallel_trainer(self):
+        """EarlyStoppingParallelTrainer: early stopping over
+        data-parallel epochs (reference EarlyStoppingParallelTrainer)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train.earlystopping import (
+            DataSetLossCalculator,
+            EarlyStoppingConfiguration,
+            EarlyStoppingParallelTrainer,
+            InMemoryModelSaver,
+            MaxEpochsTerminationCondition,
+        )
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        ds = DataSet(x, y)
+        conf = (
+            NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        cfg = (
+            EarlyStoppingConfiguration.Builder()
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(5))
+            .score_calculator(DataSetLossCalculator(ListDataSetIterator(ds, 32)))
+            .model_saver(InMemoryModelSaver())
+            .build()
+        )
+        trainer = EarlyStoppingParallelTrainer(
+            cfg, net, ListDataSetIterator(ds, 32)
+        )
+        result = trainer.fit()
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.total_epochs == 5
+        assert np.isfinite(result.best_model_score)

@@ -283,3 +283,43 @@ class TestKnnServer:
                 assert e.code == 400
         finally:
             srv.stop()
+
+
+class TestNode2Vec:
+    def test_biased_walks_respect_pq(self):
+        """p≫1 suppresses immediate backtracking; tiny p forces it."""
+        from deeplearning4j_tpu.graph import BiasedRandomWalkIterator
+
+        g = Graph(4)  # path graph 0-1-2-3
+        for a, b in ((0, 1), (1, 2), (2, 3)):
+            g.add_edge(a, b)
+        returns = {}
+        for p in (0.01, 100.0):
+            it = BiasedRandomWalkIterator(g, walk_length=20, p=p, q=1.0,
+                                          seed=3, walks_per_vertex=20)
+            backtracks = total = 0
+            for w in it:
+                for i in range(2, len(w)):
+                    if w[i] == w[i - 2] and w[i] != w[i - 1]:
+                        backtracks += 1
+                    total += 1
+            returns[p] = backtracks / max(total, 1)
+        assert returns[0.01] > returns[100.0] + 0.2, returns
+
+    def test_node2vec_clique_structure(self):
+        from deeplearning4j_tpu.graph import Node2Vec
+
+        g = TestGraphWalks()._two_cliques()
+        nv = (
+            Node2Vec.builder().vector_size(16).window_size(3).walk_length(20)
+            .walks_per_vertex(20).learning_rate(0.05).seed(4).epochs(3)
+            .p(1.0).q(0.5).build().fit(g)
+        )
+        within = np.mean([
+            nv.similarity(i, j) for i in range(1, 6) for j in range(1, 6)
+            if i != j
+        ])
+        across = np.mean([
+            nv.similarity(i, j) for i in range(1, 6) for j in range(7, 12)
+        ])
+        assert within > across

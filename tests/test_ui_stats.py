@@ -168,3 +168,45 @@ class TestEvaluationTools:
         p = str(tmp_path / "cal.html")
         EvaluationTools.export_calibration_to_html_file(cal, p, cls=1)
         assert "ECE=" in open(p).read()
+
+
+class TestRemoteStats:
+    def test_train_posts_to_remote_receiver_and_dashboard_renders(self, tmp_path):
+        """Trainer → RemoteUIStatsStorageRouter → HTTP → receiver storage
+        → dashboard (reference RemoteUIStatsStorageRouter +
+        RemoteReceiverModule flow)."""
+        from deeplearning4j_tpu.ui import (
+            RemoteStatsReceiver,
+            RemoteUIStatsStorageRouter,
+        )
+
+        backing = InMemoryStatsStorage()
+        recv = RemoteStatsReceiver(backing, port=0).start()
+        try:
+            router = RemoteUIStatsStorageRouter(
+                f"http://127.0.0.1:{recv.port}"
+            )
+            net = _net()
+            net.add_listeners(StatsListener(router, session_id="remote1"))
+            net.fit(_data(), epochs=1, batch_size=24)
+            router.flush()
+            records = backing.get_records("remote1")
+            assert any(r["kind"] == "update" for r in records)
+            out = str(tmp_path / "remote.html")
+            doc = render_dashboard(backing, path=out)
+            assert "remote1" in doc
+            assert router.dropped == 0
+        finally:
+            router.shutdown()
+            recv.stop()
+
+    def test_router_counts_drops_when_receiver_down(self):
+        from deeplearning4j_tpu.ui import RemoteUIStatsStorageRouter
+
+        router = RemoteUIStatsStorageRouter(
+            "http://127.0.0.1:9", async_post=False, max_retries=1,
+            timeout=0.5,
+        )
+        router.put_record({"kind": "update", "session_id": "x",
+                           "worker_id": "w"})
+        assert router.dropped == 1
