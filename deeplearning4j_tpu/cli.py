@@ -90,8 +90,7 @@ def main(argv=None) -> int:
         pw = ParallelWrapper.builder(model).workers(args.workers).build()
         pw.fit(it, epochs=args.epochs)
     else:
-        for _ in range(args.epochs):
-            model._fit_one_epoch(it)
+        model.fit(it, epochs=args.epochs)
     print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
           f"final score {float(model.score_):.4f}", flush=True)
 

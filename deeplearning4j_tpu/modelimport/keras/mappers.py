@@ -544,9 +544,13 @@ def _map_embedding(cfg: dict) -> Mapped:
 # ----------------------------------------------------------------- merges
 def _map_merge_concat(cfg: dict) -> Mapped:
     axis = cfg.get("axis", -1)
-    if axis not in (-1, None):
+    # axis=3 on NHWC 4D tensors IS the channel (last) axis — InceptionV3
+    # and friends spell it explicitly. MergeVertex asserts rank 4 at
+    # apply time for this case so a rank-5 axis=3 concat fails loudly
+    # instead of silently merging the wrong axis.
+    if axis not in (-1, None, 3):
         raise UnsupportedKerasLayer(f"Concatenate axis={axis} unsupported (only -1)")
-    return Mapped(vertex=MergeVertex())
+    return Mapped(vertex=MergeVertex(require_rank=4 if axis == 3 else None))
 
 
 def _map_merge(op: str) -> Callable[[dict], Mapped]:

@@ -67,7 +67,15 @@ class GraphVertex:
 @serde.register
 class MergeVertex(GraphVertex):
     """Concatenate along the feature axis (reference ``MergeVertex.java``).
-    NHWC ⇒ channel concat and feature concat are both ``axis=-1``."""
+    NHWC ⇒ channel concat and feature concat are both ``axis=-1``.
+
+    ``require_rank`` (optional) asserts the input rank at apply time —
+    used by the Keras importer when an explicit axis (e.g. Concatenate
+    axis=3) is only last-axis-equivalent at a specific rank."""
+
+    def __init__(self, require_rank=None, **kwargs):
+        super().__init__(**kwargs)
+        self.require_rank = require_rank
 
     def get_output_type(self, *input_types: InputType) -> InputType:
         if not input_types:
@@ -84,6 +92,13 @@ class MergeVertex(GraphVertex):
         return InputType.feed_forward(sum(t.size for t in input_types))
 
     def apply(self, inputs, masks, *, train=False, rng=None):
+        rr = getattr(self, "require_rank", None)
+        if rr is not None and inputs and inputs[0].ndim != rr:
+            raise ValueError(
+                f"MergeVertex: expected rank-{rr} inputs (explicit concat "
+                f"axis is only last-axis at that rank); got rank "
+                f"{inputs[0].ndim}"
+            )
         if len(inputs) == 1:
             return inputs[0]
         return jnp.concatenate(inputs, axis=-1)

@@ -66,15 +66,49 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
 
 
+def _flash_attention_eligible(q, causal, mask, dropout_rate) -> bool:
+    """Route to the Pallas TPU flash-attention kernel when it applies:
+    TPU backend, no padding mask / attention dropout, and block-friendly
+    shapes (T multiple of 128, head dim ≥ 128 not required — the kernel
+    pads — but tiny toy shapes stay on the einsum path). Kill switch:
+    DL4J_TPU_FLASH_ATTENTION=0."""
+    import os
+
+    if os.environ.get("DL4J_TPU_FLASH_ATTENTION", "1") == "0":
+        return False
+    if mask is not None or dropout_rate > 0.0:
+        return False
+    try:
+        import jax as _j
+
+        if _j.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    T = q.shape[2]
+    return T >= 128 and T % 128 == 0
+
+
 def dense_attention(q, k, v, *, causal: bool, mask=None,
                     dropout_rate: float = 0.0, dropout_rng=None):
     """Reference dense softmax attention. q,k,v: (b, h, T, hd).
 
     ``dropout_rate`` drops entries of the softmax probability matrix
     (standard attention dropout), not the weighted sum.
+
+    On TPU with long block-aligned sequences the computation routes to
+    the Pallas flash-attention kernel (O(T) memory, no (T, T) scores
+    materialization) — same math, the SURVEY §7 "Pallas for the hot ops"
+    path.
     """
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
+    if _flash_attention_eligible(q, causal, mask, dropout_rate):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tri = jnp.tril(jnp.ones((T, T), bool))

@@ -231,3 +231,44 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, model):
         if self.save_every_n_epochs and model.epoch % self.save_every_n_epochs == 0:
             self._save(model, model.iteration, model.epoch)
+
+
+class ProfilerListener(TrainingListener):
+    """Captures an XLA/xprof trace for a window of training iterations
+    (the TPU-native replacement for ND4J's executioner profiling modes,
+    SURVEY.md §5 tracing: "XLA profiler/xprof traces replace (b)-(c)").
+
+    Starts ``jax.profiler.start_trace(log_dir)`` at ``start_iteration``
+    and stops after ``num_iterations``; the trace opens in TensorBoard's
+    profile plugin or Perfetto."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 num_iterations: int = 3):
+        self.log_dir = log_dir
+        self.start_iteration = int(start_iteration)
+        self.stop_iteration = int(start_iteration) + int(num_iterations)
+        self._active = False
+        self.completed = False
+
+    def iteration_done(self, model, iteration, epoch):
+        import jax
+
+        if self.completed:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.stop_iteration:
+            if model.score_ is not None:
+                jax.block_until_ready(model.score_)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.completed = True
+
+    def on_epoch_end(self, model):
+        if self._active:  # epoch ended inside the window: close cleanly
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.completed = True

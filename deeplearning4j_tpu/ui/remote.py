@@ -41,7 +41,13 @@ class RemoteUIStatsStorageRouter(StatsStorage):
             self._worker.start()
 
     def _post(self, record: dict) -> bool:
-        body = json.dumps(record).encode()
+        from urllib.error import HTTPError
+
+        try:
+            body = json.dumps(record).encode()
+        except (TypeError, ValueError):
+            self.dropped += 1
+            return False
         for _ in range(self.max_retries):
             try:
                 req = _urlreq.Request(
@@ -51,10 +57,25 @@ class RemoteUIStatsStorageRouter(StatsStorage):
                 with _urlreq.urlopen(req, timeout=self.timeout) as resp:
                     if 200 <= resp.status < 300:
                         return True
-            except OSError:
+            except HTTPError as e:
+                if 400 <= e.code < 500:  # non-retryable client error
+                    break
+                continue  # 5xx: retry
+            except (OSError, ValueError):
+                # transport error (retry) / malformed url ('unknown url
+                # type' — will never succeed, but bounded by max_retries)
                 continue
         self.dropped += 1
         return False
+
+    def _post_safe(self, record: dict) -> bool:
+        """Never lets an exception escape (the drain thread must outlive
+        any single bad record)."""
+        try:
+            return self._post(record)
+        except Exception:  # noqa: BLE001 — service boundary
+            self.dropped += 1
+            return False
 
     def _drain(self):
         while True:
@@ -62,7 +83,7 @@ class RemoteUIStatsStorageRouter(StatsStorage):
             try:
                 if rec is None:
                     return
-                self._post(rec)
+                self._post_safe(rec)
             finally:
                 self._q.task_done()
 
@@ -71,7 +92,7 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         if self._q is not None:
             self._q.put(record)
         else:
-            self._post(record)
+            self._post_safe(record)
 
     def flush(self, timeout: float = 30.0) -> None:
         """Block until queued records are POSTED (not merely dequeued —

@@ -133,3 +133,35 @@ def test_uncompiled_model_without_inferable_loss_errors_loudly():
     )
     x, y = _golden("k3_uncompiled")
     np.testing.assert_allclose(net.output(x), y, atol=1e-4, rtol=1e-3)
+
+
+# --------------------------------------------------------------------------
+# Full-size real-architecture import (BASELINE config #4: MobileNet /
+# InceptionV3). Pretrained weights are not obtainable offline (zero
+# egress), so keras.applications architectures are instantiated with
+# random weights at test time — the layer mapping, weight layouts and
+# graph assembly are identical to the pretrained case.
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("arch,shape,tol", [
+    ("MobileNetV2", (96, 96, 3), 1e-4),
+    ("InceptionV3", (96, 96, 3), 1e-4),
+])
+def test_full_size_application_import(arch, shape, tol, tmp_path):
+    keras = pytest.importorskip("keras")
+    import os as _os
+
+    _os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+    keras.utils.set_random_seed(5)
+    kwargs = dict(weights=None, input_shape=shape, classes=50)
+    model = getattr(keras.applications, arch)(**kwargs)
+    model.compile(loss="categorical_crossentropy")
+    path = str(tmp_path / f"{arch}.h5")
+    model.save(path)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2,) + shape).astype(np.float32)
+    y = model.predict(x, verbose=0)
+
+    net = KerasModelImport.import_keras_model_and_weights(path)
+    out = net.output_single(x)
+    np.testing.assert_allclose(out, y, atol=tol, rtol=1e-3)

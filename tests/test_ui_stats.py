@@ -210,3 +210,20 @@ class TestRemoteStats:
         router.put_record({"kind": "update", "session_id": "x",
                            "worker_id": "w"})
         assert router.dropped == 1
+
+
+class TestProfilerListener:
+    def test_trace_captured(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import ProfilerListener
+
+        log_dir = str(tmp_path / "trace")
+        net = _net()
+        lst = ProfilerListener(log_dir, start_iteration=2, num_iterations=2)
+        net.add_listeners(lst)
+        net.fit(_data(), epochs=2, batch_size=16)
+        assert lst.completed
+        # xprof writes plugins/profile/<run>/ under the log dir
+        found = []
+        for root, _dirs, files in os.walk(log_dir):
+            found.extend(files)
+        assert found, "no trace files written"
