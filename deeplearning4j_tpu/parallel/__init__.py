@@ -11,6 +11,7 @@ from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
 from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+from deeplearning4j_tpu.parallel.shared_training import SharedTrainingMaster
 from deeplearning4j_tpu.parallel.multihost import (
     MultiHostContext,
     MultiHostNetwork,
@@ -26,5 +27,5 @@ __all__ = [
     "make_ring_attention", "DistributedLMTrainer",
     "MultiHostContext", "MultiHostNetwork", "MultiHostDl4jMultiLayer",
     "MultiHostComputationGraph", "ParameterAveragingTrainingMaster",
-    "ShardedDataSetIterator", "TrainingMaster",
+    "ShardedDataSetIterator", "TrainingMaster", "SharedTrainingMaster",
 ]

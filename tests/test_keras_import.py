@@ -147,11 +147,9 @@ def test_uncompiled_model_without_inferable_loss_errors_loudly():
     ("MobileNetV2", (96, 96, 3), 1e-4),
     ("InceptionV3", (96, 96, 3), 1e-4),
 ])
-def test_full_size_application_import(arch, shape, tol, tmp_path):
+def test_full_size_application_import(arch, shape, tol, tmp_path, monkeypatch):
     keras = pytest.importorskip("keras")
-    import os as _os
-
-    _os.environ["CUDA_VISIBLE_DEVICES"] = "-1"
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "-1")
     keras.utils.set_random_seed(5)
     kwargs = dict(weights=None, input_shape=shape, classes=50)
     model = getattr(keras.applications, arch)(**kwargs)

@@ -392,3 +392,74 @@ class TestOutputLayerWeightNoise:
         noisy.fit(ds, epochs=1, batch_size=32)
         # lr=0 → params unchanged; only the noise can alter the score
         assert float(clean.score_) != float(noisy.score_)
+
+
+class TestSharedTrainingMaster:
+    def _net(self, seed=3, lr=0.1):
+        conf = (
+            NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(5)).build()
+        )
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=64, seed=0):
+        rng = np.random.default_rng(seed)
+        centers = rng.standard_normal((3, 5)) * 2
+        cls = rng.integers(0, 3, n)
+        x = (centers[cls] + rng.standard_normal((n, 5)) * 0.3).astype(np.float32)
+        return DataSet(x, np.eye(3, dtype=np.float32)[cls])
+
+    def test_compressed_dp_converges(self):
+        from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+        from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+        from deeplearning4j_tpu.parallel.shared_training import (
+            SharedTrainingMaster,
+        )
+
+        # 1-bit updates move each transmitted coordinate by lr*threshold
+        # per step — pick a quantum large enough to converge in test time
+        # (the reference's adaptive threshold serves the same purpose)
+        net = self._net(lr=1.0)
+        mesh = TrainingMesh(data=8, devices=jax.devices()[:8])
+        master = (SharedTrainingMaster.builder(threshold=0.02)
+                  .update_capacity(512).mesh(mesh).build())
+        ds = self._data()
+        scores = []
+        for _ in range(60):
+            master.fit(net, ExistingDataSetIterator([ds]), epochs=1)
+            scores.append(float(net.score_))
+        assert scores[-1] < 0.5 * scores[0], (scores[0], scores[-1])
+        assert np.isfinite(master.residual_magnitude())
+
+    def test_compressed_updates_track_exact_dp_direction(self):
+        """Per-step updates are sign-quantized (±threshold), so exact
+        per-step parity is impossible by design; the contract is that the
+        ACCUMULATED compressed update tracks the exact-DP update
+        direction (residual carry never loses mass)."""
+        from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+        from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+        from deeplearning4j_tpu.parallel.shared_training import (
+            SharedTrainingMaster,
+        )
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        ds = self._data(n=32, seed=5)
+        mesh = TrainingMesh(data=8, devices=jax.devices()[:8])
+
+        exact = self._net(seed=9, lr=0.05)
+        init = exact.params_flat().copy()
+        pw = ParallelWrapper(exact, mesh=mesh)
+        comp = self._net(seed=9, lr=0.05)
+        master = (SharedTrainingMaster.builder(threshold=0.005)
+                  .update_capacity(comp.num_params()).mesh(mesh).build())
+        for _ in range(20):
+            pw.fit(ExistingDataSetIterator([ds]), epochs=1)
+            master.fit(comp, ExistingDataSetIterator([ds]), epochs=1)
+        d_exact = exact.params_flat() - init
+        d_comp = comp.params_flat() - init
+        cos = float(d_exact @ d_comp /
+                    (np.linalg.norm(d_exact) * np.linalg.norm(d_comp) + 1e-12))
+        assert cos > 0.7, f"update-direction cosine {cos:.3f}"
