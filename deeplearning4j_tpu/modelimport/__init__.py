@@ -11,8 +11,10 @@ Keras format-support matrix (round 3):
 | weights-only ``.h5`` / ``.weights.h5``   | no — architecture absent   |
 |                                          | (same as reference)        |
 | architecture-JSON + weights pair         | no                         |
-| ``channels_first`` data format           | rejected with error (TPU-  |
-|                                          | first NHWC stance)         |
+| ``channels_first`` data format           | yes — imported into the    |
+|                                          | NHWC runtime (feed NHWC    |
+|                                          | inputs; Keras-1 flatten    |
+|                                          | row order auto-permuted)   |
 | uncompiled model, non-inferable loss     | loud error; pass           |
 |                                          | ``default_loss=...``       |
 

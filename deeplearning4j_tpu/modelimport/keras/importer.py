@@ -41,15 +41,48 @@ _KERAS_LOSSES = {
 }
 
 
-def _input_type_for_shape(shape: Sequence[Optional[int]]) -> InputType:
+def _input_type_for_shape(shape: Sequence[Optional[int]],
+                          channels_first: bool = False) -> InputType:
     dims = [d for d in shape[1:]]
     if len(dims) == 3:
+        if channels_first:  # (c, h, w) → NHWC type; user feeds NHWC
+            return InputType.convolutional(dims[1], dims[2], dims[0])
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:
         return InputType.recurrent(dims[1], dims[0])
     if len(dims) == 1:
         return InputType.feed_forward(dims[0])
     raise UnsupportedKerasLayer(f"Unsupported Keras input shape {shape}")
+
+
+def _detect_channels_first(layer_cfgs) -> bool:
+    return any(
+        (lc.get("config", {}) or {}).get("data_format") == "channels_first"
+        for lc in layer_cfgs
+    )
+
+
+def _to_channels_last_cfg(lc: dict) -> dict:
+    """Rewrite a layer config to channels_last for mapping: kernel/stride
+    semantics are layout-independent in the NHWC runtime. BN's
+    ``axis=1`` (the NCHW channel axis) becomes the last axis."""
+    conf = dict(lc.get("config", {}))
+    if conf.get("data_format") == "channels_first":
+        conf["data_format"] = "channels_last"
+    if lc.get("class_name") == "BatchNormalization":
+        ax = conf.get("axis")
+        if ax == 1 or ax == [1]:
+            conf["axis"] = -1
+    out = dict(lc)
+    out["config"] = conf
+    return out
+
+
+def _chw_to_hwc_perm(h: int, w: int, c: int) -> "np.ndarray":
+    """Row permutation taking a flatten-of-(c,h,w) ordered kernel to
+    flatten-of-(h,w,c) ordering (the NHWC runtime's Flatten)."""
+    idx = np.arange(c * h * w).reshape(c, h, w)     # keras NCHW flatten order
+    return idx.transpose(1, 2, 0).reshape(-1)       # our NHWC flatten order
 
 
 def _layer_input_shape(layer_cfg: dict) -> Optional[List[Optional[int]]]:
@@ -178,17 +211,33 @@ class KerasModelImport:
                 )
             layer_cfgs = cfg["config"]["layers"]
             tc_loss = _loss_from_training_config(ar.training_config())
+            channels_first = _detect_channels_first(layer_cfgs)
 
             input_shape = None
             mapped: List[Tuple[str, Mapped]] = []
+            # the first WEIGHTED layer after a Flatten needs its kernel
+            # rows permuted when the source model flattened NCHW order;
+            # parameterless layers (Dropout/Activation) in between don't
+            # consume the pending flag
+            flatten_feeds: Dict[str, bool] = {}
+            flatten_pending = False
             for lc in layer_cfgs:
+                if channels_first:
+                    lc = _to_channels_last_cfg(lc)
                 cls, conf = lc["class_name"], lc.get("config", {})
                 shape = _layer_input_shape(lc)
                 if shape is not None and input_shape is None:
                     input_shape = shape
                 if cls == "InputLayer":
                     continue
-                mapped.append((conf.get("name", cls), map_keras_layer(cls, conf)))
+                m = map_keras_layer(cls, conf)
+                name = conf.get("name", cls)
+                if m.is_flatten:
+                    flatten_pending = True
+                elif flatten_pending and m.translator is not None:
+                    flatten_feeds[name] = True
+                    flatten_pending = False
+                mapped.append((name, m))
             if input_shape is None:
                 bis = cfg["config"].get("build_input_shape")
                 if bis is None:
@@ -223,9 +272,12 @@ class KerasModelImport:
             if extra_loss is not None:
                 lb.layer(extra_loss)
             conf_built = (
-                lb.set_input_type(_input_type_for_shape(input_shape)).build()
+                lb.set_input_type(
+                    _input_type_for_shape(input_shape, channels_first)
+                ).build()
             )
             net = MultiLayerNetwork(conf_built).init()
+            types = conf_built.layer_types()
 
             # ---- weight copy
             new_params = list(net.params_)
@@ -238,6 +290,22 @@ class KerasModelImport:
                     continue
                 p, s = m.translator(w)
                 i = index_of[n]
+                # Keras 2/3 Flatten(data_format=channels_first) transposes
+                # to channels_last BEFORE flattening, so rows already come
+                # in (h, w, c) order; only Keras 1 / Theano-era files
+                # flattened raw row-major NCHW and need the permutation
+                # (verified empirically against keras 3 goldens).
+                needs_perm = (channels_first and flatten_feeds.get(n)
+                              and "W" in p
+                              and ar.keras_version().startswith("1"))
+                if needs_perm:
+                    prev_t = (conf_built.layers[i - 1].get_output_type(types[i - 1])
+                              if i > 0 else conf_built.input_type)
+                    if prev_t.kind == "convolutional":
+                        perm = _chw_to_hwc_perm(prev_t.height, prev_t.width,
+                                                prev_t.channels)
+                        p = dict(p)
+                        p["W"] = np.asarray(p["W"])[perm, :]
                 new_params[i] = {
                     k: _shaped(v, net.params_[i], k, n) for k, v in p.items()
                 }
@@ -247,6 +315,7 @@ class KerasModelImport:
                     }
             net.params_ = new_params
             net.state_ = new_state
+            net.channels_first_source = channels_first  # user feeds NHWC
             return net
 
     # ------------------------------------------------------------ functional
@@ -271,10 +340,18 @@ class KerasModelImport:
 
             inputs: List[str] = []
             input_types: List[InputType] = []
+            channels_first = _detect_channels_first(layer_cfgs)
             mapped: Dict[str, Mapped] = {}
             inbound: Dict[str, List[str]] = {}
             order: List[str] = []
             for lc in layer_cfgs:
+                if channels_first:
+                    # config rewrite only: Keras 2/3 Flatten already emits
+                    # channels_last row order, so graph imports need no
+                    # kernel permutation (Keras-1 functional NCHW models
+                    # would; none are generatable for fixtures — the
+                    # sequential path carries that logic)
+                    lc = _to_channels_last_cfg(lc)
                 cls, conf = lc["class_name"], lc.get("config", {})
                 name = conf.get("name") or lc.get("name")
                 if cls == "InputLayer":
@@ -282,7 +359,9 @@ class KerasModelImport:
                     shape = _layer_input_shape(lc)
                     if shape is None:
                         raise ValueError(f"InputLayer {name} without shape")
-                    input_types.append(_input_type_for_shape(shape))
+                    input_types.append(
+                        _input_type_for_shape(shape, channels_first)
+                    )
                     continue
                 mapped[name] = map_keras_layer(cls, conf)
                 inbound[name] = _inbound_names(lc)

@@ -128,5 +128,116 @@ class WordVectorSerializer:
         return _StaticWordVectors(words, m)
 
     # --------------------------------------------- reference-parity aliases
-    writeWord2VecModel = write_word_vectors
-    readWord2VecModel = read_word_vectors
+    # (bound below once the full-model zip functions exist: the Java
+    # writeWord2VecModel writes the resumable full-model flavour)
+
+
+# --------------------------------------------------------------------------
+# full-model zip (reference writeWord2VecModel ZIP flavour: config +
+# vocab with counts/huffman + syn0/syn1/syn1neg — RESUMABLE, unlike the
+# lookup-table text/binary formats above)
+# --------------------------------------------------------------------------
+def write_word2vec_model(w2v, path: str) -> None:
+    """Serialize a trained Word2Vec incl. vocab counts and all weight
+    tables so training can resume after load."""
+    import io
+    import json
+    import zipfile
+
+    sv = w2v.sv
+    cfg = {
+        "layer_size": sv.layer_size,
+        "window": sv.window,
+        "negative": sv.negative,
+        "use_hierarchic_softmax": sv.use_hs,
+        "sampling": sv.sampling,
+        "learning_rate": sv.learning_rate,
+        "min_learning_rate": sv.min_learning_rate,
+        "iterations": sv.iterations,
+        "epochs": sv.epochs,
+        "batch_size": sv.batch_size,
+        "seed": sv.seed,
+        "elements_algorithm": sv.algorithm,
+    }
+    vocab = [
+        {"word": vw.word, "count": vw.count}
+        for vw in w2v.vocab.vocab_words()
+    ]
+
+    def npy_bytes(a):
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(a, np.float32))
+        return buf.getvalue()
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(cfg))
+        z.writestr("vocab.json", json.dumps(vocab))
+        z.writestr("syn0.npy", npy_bytes(sv.syn0))
+        z.writestr("syn1.npy", npy_bytes(sv.syn1))
+        z.writestr("syn1neg.npy", npy_bytes(sv.syn1neg))
+
+
+def read_word2vec_model(path: str):
+    """Inverse of write_word2vec_model: a Word2Vec whose SequenceVectors
+    carries the saved weights — queries work and fit_sequences resumes."""
+    import io
+    import json
+    import zipfile
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    with zipfile.ZipFile(path, "r") as z:
+        cfg = json.loads(z.read("config.json"))
+        vocab_entries = json.loads(z.read("vocab.json"))
+        syn0 = np.load(io.BytesIO(z.read("syn0.npy")))
+        syn1 = np.load(io.BytesIO(z.read("syn1.npy")))
+        syn1neg = np.load(io.BytesIO(z.read("syn1neg.npy")))
+
+    cache = AbstractCache()
+    for e in vocab_entries:
+        cache.add_token(VocabWord(e["word"], e["count"]))
+        cache.total_word_occurrences += e["count"]
+    cache.update_indices()
+
+    sv = SequenceVectors(
+        cache,
+        layer_size=cfg["layer_size"], window=cfg["window"],
+        negative=cfg["negative"],
+        use_hierarchic_softmax=cfg["use_hierarchic_softmax"],
+        sampling=cfg["sampling"], learning_rate=cfg["learning_rate"],
+        min_learning_rate=cfg["min_learning_rate"],
+        iterations=cfg["iterations"], epochs=cfg["epochs"],
+        batch_size=cfg["batch_size"], seed=cfg["seed"],
+        elements_algorithm=cfg["elements_algorithm"],
+    )
+    sv.syn0 = jnp.asarray(syn0)
+    sv.syn1 = jnp.asarray(syn1)
+    sv.syn1neg = jnp.asarray(syn1neg)
+
+    w2v = Word2Vec(Word2Vec.builder())
+    w2v.vocab = cache
+    w2v.sv = sv
+    return w2v
+
+
+def read_word2vec_any(path: str):
+    """Format-sniffing reader (reference readWord2VecModel accepts both
+    its zip and table flavours): full-model zip → resumable Word2Vec;
+    otherwise the text lookup table."""
+    import zipfile
+
+    if zipfile.is_zipfile(path):
+        return read_word2vec_model(path)
+    return WordVectorSerializer.read_word_vectors(path)
+
+
+WordVectorSerializer.write_word2vec_model = staticmethod(write_word2vec_model)
+WordVectorSerializer.read_word2vec_model = staticmethod(read_word2vec_model)
+# reference-parity names: the Java writeWord2VecModel emits the resumable
+# full-model zip; the reader sniffs either flavour
+WordVectorSerializer.writeWord2VecModel = staticmethod(write_word2vec_model)
+WordVectorSerializer.readWord2VecModel = staticmethod(read_word2vec_any)

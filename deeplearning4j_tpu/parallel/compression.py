@@ -72,6 +72,18 @@ def threshold_encode(grad: Array, threshold: Array, capacity: int
     return EncodedUpdate(indices, send, count), residual
 
 
+def gather_and_decode(msg: EncodedUpdate, like: Array, axis_name: str) -> Array:
+    """Inside a shard_map body: all_gather every peer's fixed-size message
+    over ``axis_name`` and scatter-add into a dense buffer shaped like
+    ``like``. The single shared decode used by both compressed collectives
+    (make_compressed_allreduce, SharedTrainingMaster)."""
+    all_idx = jax.lax.all_gather(msg.indices, axis_name)   # (n, K)
+    all_val = jax.lax.all_gather(msg.values, axis_name)
+    idx = jnp.maximum(all_idx.reshape(-1), 0)
+    val = jnp.where(all_idx.reshape(-1) >= 0, all_val.reshape(-1), 0.0)
+    return jnp.zeros_like(like).at[idx].add(val)
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def threshold_decode(msg: EncodedUpdate, size: int) -> Array:
     """Message → dense flat vector (reference decode side of
@@ -174,11 +186,7 @@ def make_compressed_allreduce(mesh, axis: str = "data",
         work = (residual + grad)[0]
         cap = min(capacity, work.shape[0])  # top_k needs k ≤ n
         msg, new_residual = threshold_encode(work, threshold, cap)
-        all_idx = jax.lax.all_gather(msg.indices, axis)   # (n, K)
-        all_val = jax.lax.all_gather(msg.values, axis)
-        idx = jnp.maximum(all_idx.reshape(-1), 0)
-        val = jnp.where(all_idx.reshape(-1) >= 0, all_val.reshape(-1), 0.0)
-        summed = jnp.zeros_like(work).at[idx].add(val)
+        summed = gather_and_decode(msg, work, axis)
         return summed, new_residual[None, :]
 
     return jax.jit(

@@ -40,7 +40,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator
 from deeplearning4j_tpu.nn.multilayer import _apply_layer_updates
-from deeplearning4j_tpu.parallel.compression import threshold_encode
+from deeplearning4j_tpu.parallel.compression import (
+    gather_and_decode,
+    threshold_encode,
+)
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 
 
@@ -114,12 +117,7 @@ class SharedTrainingMaster:
             flat, _ = ravel_pytree(grads)
             work = residual[0] + flat
             msg, new_residual = threshold_encode(work, threshold, capacity)
-            all_idx = jax.lax.all_gather(msg.indices, "data")   # (n, K)
-            all_val = jax.lax.all_gather(msg.values, "data")
-            idx = jnp.maximum(all_idx.reshape(-1), 0)
-            val = jnp.where(all_idx.reshape(-1) >= 0,
-                            all_val.reshape(-1), 0.0)
-            summed = jnp.zeros_like(flat).at[idx].add(val) / n_data
+            summed = gather_and_decode(msg, flat, "data") / n_data
             mean_loss = jax.lax.pmean(loss, "data")
             return mean_loss, summed, new_residual[None, :]
 
@@ -146,6 +144,12 @@ class SharedTrainingMaster:
         """Compressed-DP training; batch must divide the data axis.
         (Reference ``SharedTrainingMaster.executeTraining``.)"""
         if self._step is None:
+            if any(bool(s) for s in model.state_):
+                raise ValueError(
+                    "SharedTrainingMaster does not propagate layer state "
+                    "(e.g. BatchNorm running statistics) — train stateful "
+                    "models with ParallelWrapper instead"
+                )
             self._step = self._build_step(model)
             self._n_params = model.num_params()
             self._residual = jnp.zeros((self.mesh.n_data, self._n_params),

@@ -390,3 +390,26 @@ class TestTokenizerPlugins:
 
         toks = KoreanTokenizerFactory().create("고양이는 귀엽다").get_tokens()
         assert toks[0] == "고양이" and toks[1] == "는"
+
+class TestFullModelZip:
+    def test_full_model_zip_roundtrip_and_resume(self, tmp_path):
+        """Full-model zip (reference writeWord2VecModel): queries match
+        after load AND training resumes on the restored tables."""
+        w2v = TestSerialization()._small_model()
+        p = str(tmp_path / "w2v_full.zip")
+        WordVectorSerializer.write_word2vec_model(w2v, p)
+        loaded = WordVectorSerializer.read_word2vec_model(p)
+        for w in w2v.vocab.words():
+            np.testing.assert_allclose(
+                loaded.get_word_vector(w), w2v.get_word_vector(w), atol=1e-6
+            )
+        assert loaded.similarity("cat", "dog") == pytest.approx(
+            w2v.similarity("cat", "dog"), abs=1e-5
+        )
+        # resume: further fitting moves the vectors (tables are live)
+        before = loaded.get_word_vector("cat").copy()
+        ids = np.asarray([loaded.vocab.index_of(w)
+                          for w in ("cat", "dog", "cat", "dog")], np.int32)
+        loaded.sv.fit_sequences([ids])
+        moved = np.abs(loaded.get_word_vector("cat") - before).max()
+        assert moved > 0, "restored tables did not train"
