@@ -49,6 +49,8 @@ def _input_type_for_shape(shape: Sequence[Optional[int]],
             return InputType.convolutional(dims[1], dims[2], dims[0])
         return InputType.convolutional(dims[0], dims[1], dims[2])
     if len(dims) == 2:
+        if channels_first:  # temporal NCW: (c, steps) → (steps, c) runtime
+            return InputType.recurrent(dims[0], dims[1])
         return InputType.recurrent(dims[1], dims[0])
     if len(dims) == 1:
         return InputType.feed_forward(dims[0])
@@ -235,8 +237,16 @@ class KerasModelImport:
                 if m.is_flatten:
                     flatten_pending = True
                 elif flatten_pending and m.translator is not None:
-                    flatten_feeds[name] = True
-                    flatten_pending = False
+                    if isinstance(m.layer, DenseLayer):
+                        flatten_feeds[name] = True
+                        flatten_pending = False
+                    elif channels_first:
+                        # a weighted non-Dense layer (e.g. BN) between
+                        # Flatten and Dense would ALSO need per-feature
+                        # reordering in Keras-1 NCHW files; defer loudly
+                        # (see needs_perm keras-1 gate) rather than
+                        # import silently wrong
+                        flatten_feeds[name] = "non_dense"
                 mapped.append((name, m))
             if input_shape is None:
                 bis = cfg["config"].get("build_input_shape")
@@ -295,9 +305,16 @@ class KerasModelImport:
                 # in (h, w, c) order; only Keras 1 / Theano-era files
                 # flattened raw row-major NCHW and need the permutation
                 # (verified empirically against keras 3 goldens).
-                needs_perm = (channels_first and flatten_feeds.get(n)
-                              and "W" in p
-                              and ar.keras_version().startswith("1"))
+                keras1 = ar.keras_version().startswith("1")
+                if (channels_first and keras1
+                        and flatten_feeds.get(n) == "non_dense"):
+                    raise UnsupportedKerasLayer(
+                        f"Keras-1 channels_first model has weighted layer "
+                        f"'{n}' between Flatten and Dense; its per-feature "
+                        "parameters would need NCHW reordering — unsupported"
+                    )
+                needs_perm = (channels_first and flatten_feeds.get(n) is True
+                              and "W" in p and keras1)
                 if needs_perm:
                     prev_t = (conf_built.layers[i - 1].get_output_type(types[i - 1])
                               if i > 0 else conf_built.input_type)

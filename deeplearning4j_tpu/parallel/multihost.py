@@ -120,6 +120,26 @@ def free_port() -> int:
 # --------------------------------------------------------------------------
 # per-host data sharding
 # --------------------------------------------------------------------------
+def host_local_to_global(a, mesh, spec=None):
+    """Host-local array → global jax.Array on ``mesh`` (single-process:
+    plain device array). ``spec`` defaults to batch-sharded over "data".
+    Shared by MultiHostNetwork and SharedTrainingMaster."""
+    if a is None:
+        return None
+    if jax.process_count() == 1:
+        import jax.numpy as _jnp
+
+        return _jnp.asarray(a)
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as _P
+
+    if spec is None:
+        spec = _P("data")
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(a), mesh, spec
+    )
+
+
 class ShardedDataSetIterator(DataSetIterator):
     """Slices every GLOBAL batch down to this host's shard.
 
@@ -272,17 +292,9 @@ class MultiHostNetwork:
 
     # -- data plumbing ------------------------------------------------------
     def _to_global(self, a, batch_like: bool):
-        """Host-local array → global jax.Array on the mesh (batch rows
-        concatenated across processes in process order)."""
-        from jax.experimental import multihost_utils
-
-        if a is None:
-            return None
-        spec = jax.sharding.PartitionSpec("data") if batch_like else \
-            jax.sharding.PartitionSpec()
-        return multihost_utils.host_local_array_to_global_array(
-            np.asarray(a), self.mesh.mesh, spec
-        )
+        spec = (jax.sharding.PartitionSpec("data") if batch_like
+                else jax.sharding.PartitionSpec())
+        return host_local_to_global(a, self.mesh.mesh, spec)
 
     def _pack_batch(self, ds: DataSet):
         if self._is_graph:

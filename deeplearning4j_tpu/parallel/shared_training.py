@@ -140,8 +140,19 @@ class SharedTrainingMaster:
         return jax.jit(step, donate_argnums=(0, 1, 7))
 
     # ------------------------------------------------------------------- fit
+    def _to_global(self, a, batch_like: bool = True):
+        from deeplearning4j_tpu.parallel.multihost import host_local_to_global
+
+        return host_local_to_global(
+            a, self.mesh.mesh, P("data") if batch_like else P()
+        )
+
     def fit(self, model, it: DataSetIterator, epochs: int = 1):
         """Compressed-DP training; batch must divide the data axis.
+        Multi-host ready: under ``jax.distributed`` each host feeds its
+        LOCAL batch rows and the threshold-encoded messages cross hosts
+        through the all_gather — the reference's SharedTraining scenario
+        (compressed updates over the slow interconnect).
         (Reference ``SharedTrainingMaster.executeTraining``.)"""
         if self._step is None:
             if any(bool(s) for s in model.state_):
@@ -152,8 +163,15 @@ class SharedTrainingMaster:
                 )
             self._step = self._build_step(model)
             self._n_params = model.num_params()
-            self._residual = jnp.zeros((self.mesh.n_data, self._n_params),
-                                       jnp.float32)
+            zeros = np.zeros((self.mesh.n_data, self._n_params), np.float32)
+            if jax.process_count() == 1:
+                self._residual = jnp.asarray(zeros)
+            else:
+                # every process passes identical zeros; device_put fills
+                # its addressable shards of the global (n_data, n) array
+                self._residual = jax.device_put(
+                    zeros, NamedSharding(self.mesh.mesh, P("data"))
+                )
             self._model_id = id(model)
         elif self._model_id != id(model):
             raise ValueError(
@@ -161,25 +179,26 @@ class SharedTrainingMaster:
                 "(cached step/residual); build a new master per model"
             )
         step = self._step
-        n_data = self.mesh.n_data
+        # local batch must split over this host's SHARE of the data axis
+        n_local = max(self.mesh.n_data // jax.process_count(), 1)
         for _ in range(epochs):
             for lst in model.listeners:
                 if hasattr(lst, "on_epoch_start"):
                     lst.on_epoch_start(model)
             for ds in it:
-                if ds.features.shape[0] % n_data:
+                if ds.features.shape[0] % n_local:
                     raise ValueError(
-                        f"batch {ds.features.shape[0]} not divisible by "
-                        f"data axis {n_data}"
+                        f"local batch {ds.features.shape[0]} not divisible "
+                        f"by local data-axis share {n_local}"
                     )
                 with self.mesh.mesh:
                     (model.params_, model.opt_state_, model.score_,
                      self._residual) = step(
                         model.params_, model.opt_state_, model.state_,
-                        jnp.asarray(ds.features),
-                        None if ds.labels is None else jnp.asarray(ds.labels),
-                        None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-                        None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+                        self._to_global(ds.features, True),
+                        self._to_global(ds.labels, True),
+                        self._to_global(ds.features_mask, True),
+                        self._to_global(ds.labels_mask, True),
                         self._residual,
                         model._next_rng(),
                         jnp.asarray(model.iteration, jnp.int32),

@@ -1,0 +1,64 @@
+"""Worker for the 2-process compressed-gradient (SharedTrainingMaster)
+test — the reference's core SharedTraining scenario: threshold-encoded
+updates crossing HOSTS. Launched by tests/test_multihost.py."""
+
+import os
+import sys
+
+coordinator, nprocs, pid, outdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: E402
+    ShardedDataSetIterator,
+    initialize,
+)
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh  # noqa: E402
+from deeplearning4j_tpu.parallel.shared_training import (  # noqa: E402
+    SharedTrainingMaster,
+)
+from deeplearning4j_tpu.data.dataset import DataSet  # noqa: E402
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator  # noqa: E402
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.updaters import Sgd  # noqa: E402
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+assert len(jax.devices()) == 2 * nprocs
+
+rng = np.random.default_rng(777)
+centers = rng.standard_normal((3, 5)) * 2
+cls = rng.integers(0, 3, 64)
+x = (centers[cls] + rng.standard_normal((64, 5)) * 0.3).astype(np.float32)
+ds = DataSet(x, np.eye(3, dtype=np.float32)[cls])
+
+conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(1.0))
+        .weight_init("xavier").list()
+        .layer(DenseLayer(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(5)).build())
+net = MultiLayerNetwork(conf).init()
+
+mesh = TrainingMesh(data=len(jax.devices()))
+master = (SharedTrainingMaster.builder(threshold=0.02)
+          .update_capacity(512).mesh(mesh).build())
+it = ShardedDataSetIterator(ListDataSetIterator(ds, 64), nprocs, pid)
+scores = []
+for _ in range(40):
+    master.fit(net, it, epochs=1)
+    scores.append(float(net.score_))
+
+params = net.params_flat()
+np.savez(os.path.join(outdir, f"shared_result_{pid}.npz"),
+         params=params, first=scores[0], last=scores[-1])
+print(f"worker {pid}: {scores[0]:.3f} -> {scores[-1]:.3f}", flush=True)

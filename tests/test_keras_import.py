@@ -176,3 +176,49 @@ def test_channels_first_model_imports_with_layout_translation():
     d = np.load(os.path.join(FIXTURES, "channels_first_golden.npz"))
     out = net.output(d["x_nhwc"])
     np.testing.assert_allclose(out, d["y"], atol=1e-4, rtol=1e-3)
+
+
+class TestKeras1FlattenPermutation:
+    def test_perm_math(self):
+        """flatten(x_chw)[perm] == flatten(x_hwc) — the defining identity
+        of the Keras-1 NCHW flatten translation."""
+        from deeplearning4j_tpu.modelimport.keras.importer import (
+            _chw_to_hwc_perm,
+        )
+
+        rng = np.random.default_rng(0)
+        h, w, c = 3, 4, 5
+        x_hwc = rng.standard_normal((h, w, c))
+        x_chw = np.transpose(x_hwc, (2, 0, 1))
+        perm = _chw_to_hwc_perm(h, w, c)
+        np.testing.assert_array_equal(x_chw.reshape(-1)[perm],
+                                      x_hwc.reshape(-1))
+
+    def test_keras1_version_triggers_permutation(self, tmp_path):
+        """A channels_first file whose keras_version reads 1.x gets its
+        first post-Flatten Dense kernel row-permuted (Keras 2/3 files do
+        not — covered by the golden-parity test)."""
+        import shutil
+
+        import h5py
+
+        src = os.path.join(FIXTURES, "channels_first.h5")
+        k1 = str(tmp_path / "cf_keras1.h5")
+        shutil.copy(src, k1)
+        with h5py.File(k1, "r+") as f:
+            f.attrs["keras_version"] = "1.2.2"
+            if "model_weights" in f:
+                f["model_weights"].attrs["keras_version"] = "1.2.2"
+
+        net3 = KerasModelImport.import_keras_sequential_model_and_weights(src)
+        net1 = KerasModelImport.import_keras_sequential_model_and_weights(k1)
+        from deeplearning4j_tpu.modelimport.keras.importer import (
+            _chw_to_hwc_perm,
+        )
+
+        # dense fed by flatten is layer index 2 (conv, pool, dense, dense)
+        W3 = np.asarray(net3.params_[2]["W"])
+        W1 = np.asarray(net1.params_[2]["W"])
+        perm = _chw_to_hwc_perm(4, 4, 4)  # pool output h,w,c
+        np.testing.assert_allclose(W1, W3[perm, :], atol=0)
+        assert not np.allclose(W1, W3)

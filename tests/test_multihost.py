@@ -67,3 +67,36 @@ def test_two_process_training_matches_single_process():
     # and training moved the params (not trivially passing on init state)
     init = build_net().params_flat()
     assert np.abs(single - init).max() > 1e-3
+
+
+@pytest.mark.slow
+def test_two_process_compressed_gradient_training():
+    """SharedTrainingMaster across 2 processes: threshold-encoded updates
+    cross hosts via the gathered messages; both processes converge and
+    END WITH IDENTICAL PARAMETERS (the decode is deterministic and
+    symmetric — the reference's SharedTraining consistency property)."""
+    from deeplearning4j_tpu.parallel.multihost import free_port
+
+    port = free_port()
+    outdir = tempfile.mkdtemp(prefix="mh_shared_")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_shared_worker.py"),
+             f"127.0.0.1:{port}", "2", str(pid), outdir],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        for pid in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0].decode(errors="replace")
+            for p in procs]
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+
+    r0 = np.load(os.path.join(outdir, "shared_result_0.npz"))
+    r1 = np.load(os.path.join(outdir, "shared_result_1.npz"))
+    assert r0["last"] < 0.6 * r0["first"], (r0["first"], r0["last"])
+    # bit-identical replicas across hosts
+    np.testing.assert_allclose(r0["params"], r1["params"], atol=0)
