@@ -8,9 +8,11 @@ Keras format-support matrix (round 3):
 | Keras 3.x legacy full-model ``.h5``      | yes (Hdf5Archive)          |
 | Keras 3.x native ``.keras`` zip          | yes (KerasZipArchive;      |
 |                                          | positional vars renamed)   |
-| weights-only ``.h5`` / ``.weights.h5``   | no — architecture absent   |
-|                                          | (same as reference)        |
-| architecture-JSON + weights pair         | no                         |
+| weights-only ``.h5`` / ``.weights.h5``   | only with an architecture  |
+|                                          | JSON (see next row)        |
+| architecture-JSON + weights pair         | yes — pass ``weights_path``|
+|                                          | (reference two-arg         |
+|                                          | importKerasModelAndWeights)|
 | ``channels_first`` data format           | yes — imported into the    |
 |                                          | NHWC runtime (feed NHWC    |
 |                                          | inputs; Keras-1 flatten    |

@@ -152,10 +152,17 @@ def _output_head(layer, loss_hint: Optional[str],
     return layer, LossLayer(loss=loss, activation="identity")
 
 
-def open_archive(path: str):
-    """Format dispatch: Keras 3 ``.keras`` zip vs HDF5 full-model file."""
+def open_archive(path: str, weights_path: Optional[str] = None):
+    """Format dispatch: architecture-JSON + weights pair, Keras 3
+    ``.keras`` zip, or HDF5 full-model file."""
     import zipfile
 
+    if weights_path is not None:
+        from deeplearning4j_tpu.modelimport.keras.zip_archive import (
+            JsonWeightsArchive,
+        )
+
+        return JsonWeightsArchive(path, weights_path)
     if zipfile.is_zipfile(path):
         return KerasZipArchive(path)
     return Hdf5Archive(path)
@@ -196,6 +203,7 @@ class KerasModelImport:
     def import_keras_sequential_model_and_weights(
         path: str, compute_dtype: Optional[str] = None,
         default_loss: Optional[str] = None,
+        weights_path: Optional[str] = None,
     ):
         """→ MultiLayerNetwork with copied weights. ``compute_dtype``
         ("bfloat16") enables mixed-precision inference/fine-tuning on the
@@ -204,7 +212,7 @@ class KerasModelImport:
         output activation has no canonical loss (otherwise errors)."""
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-        with open_archive(path) as ar:
+        with open_archive(path, weights_path) as ar:
             cfg = ar.model_config()
             if cfg["class_name"] != "Sequential":
                 raise ValueError(
@@ -340,16 +348,18 @@ class KerasModelImport:
     def import_keras_model_and_weights(
         path: str, compute_dtype: Optional[str] = None,
         default_loss: Optional[str] = None,
+        weights_path: Optional[str] = None,
     ):
         """→ ComputationGraph (functional) or MultiLayerNetwork (sequential),
         matching the reference's type dispatch."""
         from deeplearning4j_tpu.nn.graph import ComputationGraph
 
-        with open_archive(path) as ar:
+        with open_archive(path, weights_path) as ar:
             cfg = ar.model_config()
             if cfg["class_name"] == "Sequential":
                 return KerasModelImport.import_keras_sequential_model_and_weights(
-                    path, compute_dtype=compute_dtype, default_loss=default_loss
+                    path, compute_dtype=compute_dtype,
+                    default_loss=default_loss, weights_path=weights_path
                 )
             tc_loss = _loss_from_training_config(ar.training_config())
             gconf = cfg["config"]

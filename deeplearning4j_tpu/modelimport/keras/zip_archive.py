@@ -89,6 +89,9 @@ class KerasZipArchive:
         except KeyError:
             self._meta = {}
         self._h5 = h5py.File(io.BytesIO(self._zf.read("model.weights.h5")), "r")
+        self._finish_init()
+
+    def _finish_init(self):
         # layer name → (class_name, config) for var naming
         self._layer_info: Dict[str, tuple] = {}
         self._index_layers(self._config)
@@ -211,3 +214,35 @@ class KerasZipArchive:
 
         walk(g[layer_name], [])
         return out
+
+
+class JsonWeightsArchive(KerasZipArchive):
+    """Architecture-JSON + weights-only ``.weights.h5`` pair (reference
+    ``KerasModelImport.importKerasModelAndWeights(modelJson,
+    weightsHdf5)``). Keras 3 ``save_weights`` uses the same positional
+    ``layers/<name>/vars/<i>`` layout as the ``.keras`` zip, so all the
+    renaming machinery is inherited."""
+
+    def __init__(self, json_path: str, weights_path: str):
+        if h5py is None:
+            raise ImportError("h5py is required for Keras model import")
+        self.path = f"{json_path}+{weights_path}"
+        self._zf = None
+        with open(json_path, "r", encoding="utf-8") as f:
+            self._config = json.load(f)
+        self._meta = {}
+        self._h5 = h5py.File(weights_path, "r")
+        if "layers" not in self._h5:
+            # Keras 1/2 save_weights used a NAME-keyed root layout; only
+            # the Keras 3 positional layout is supported here — failing
+            # loudly beats importing a randomly-initialized net
+            self._h5.close()
+            raise ValueError(
+                f"{weights_path}: no 'layers' group — not a Keras 3 "
+                ".weights.h5 (Keras 1/2 weights-only files are not "
+                "supported; re-save with Keras 3 or use a full-model file)"
+            )
+        self._finish_init()
+
+    def close(self):
+        self._h5.close()

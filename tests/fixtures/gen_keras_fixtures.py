@@ -142,5 +142,31 @@ def main():
     save("text_bilstm", m, rng.integers(0, 20, (4, 9)).astype(np.float32))
 
 
+
+
+def gen_json_weights_pair():
+    """jw_arch.json + jw.weights.h5 + jw_golden.npz — the architecture-
+    JSON + weights-only pair fixture (test_architecture_json_plus_weights_pair)."""
+    import numpy as np
+    import keras
+    from keras import layers
+
+    keras.utils.set_random_seed(3)
+    m = keras.Sequential([
+        layers.Input((12,)),
+        layers.Dense(8, activation="relu"),
+        layers.Dense(4, activation="softmax"),
+    ], name="jw")
+    with open(os.path.join(OUT, "jw_arch.json"), "w") as f:
+        f.write(m.to_json())
+    m.save_weights(os.path.join(OUT, "jw.weights.h5"))
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((6, 12)).astype(np.float32)
+    y = m.predict(x, verbose=0)
+    np.savez(os.path.join(OUT, "jw_golden.npz"), x=x, y=y)
+    print("jw pair written")
+
+
 if __name__ == "__main__":
     main()
+    gen_json_weights_pair()

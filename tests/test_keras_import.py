@@ -222,3 +222,17 @@ class TestKeras1FlattenPermutation:
         perm = _chw_to_hwc_perm(4, 4, 4)  # pool output h,w,c
         np.testing.assert_allclose(W1, W3[perm, :], atol=0)
         assert not np.allclose(W1, W3)
+
+
+def test_architecture_json_plus_weights_pair():
+    """Reference overload importKerasModelAndWeights(modelJson,
+    weightsHdf5): architecture JSON + weights-only .weights.h5 (positional
+    layout, same as the .keras zip) import with golden parity."""
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        os.path.join(FIXTURES, "jw_arch.json"),
+        weights_path=os.path.join(FIXTURES, "jw.weights.h5"),
+        default_loss="mcxent",
+    )
+    d = np.load(os.path.join(FIXTURES, "jw_golden.npz"))
+    np.testing.assert_allclose(net.output(d["x"]), d["y"], atol=1e-4,
+                               rtol=1e-3)
