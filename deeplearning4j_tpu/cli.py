@@ -15,6 +15,16 @@ import sys
 import time
 
 
+# (height, width, channels) per image dataset; None = non-image
+DATASET_SHAPES = {
+    "mnist": (28, 28, 1),
+    "svhn": (32, 32, 3),
+    "tinyimagenet": (64, 64, 3),
+    "iris": None,
+    "uci": None,
+}
+
+
 def build_dataset(name: str, batch_size: int, num_examples):
     from deeplearning4j_tpu.data.fetchers import (
         SvhnDataSetIterator,
@@ -43,10 +53,22 @@ def build_dataset(name: str, batch_size: int, num_examples):
     raise SystemExit(f"Unknown dataset '{name}'")
 
 
-def build_model(name: str, num_classes: int):
+def build_model(name: str, num_classes: int, dataset: str):
     from deeplearning4j_tpu.models.selector import ModelSelector
 
-    model = ModelSelector.select(name, num_classes=num_classes)
+    kwargs = {"num_classes": num_classes}
+    shape = DATASET_SHAPES.get(dataset.lower())
+    if shape is not None:
+        # size the model's input to the dataset (zoo models accept
+        # height/width/channels) — otherwise the first step dies with an
+        # opaque XLA shape mismatch
+        kwargs.update(height=shape[0], width=shape[1], channels=shape[2])
+    try:
+        model = ModelSelector.select(name, **kwargs)
+    except TypeError:
+        # model without spatial kwargs (e.g. text models): fall back and
+        # let config validation report incompatibilities
+        model = ModelSelector.select(name, num_classes=num_classes)
     return model.init()
 
 
@@ -71,7 +93,7 @@ def main(argv=None) -> int:
 
     it, num_classes = build_dataset(args.dataset, args.batch_size,
                                     args.num_examples)
-    model = build_model(args.model, num_classes)
+    model = build_model(args.model, num_classes, args.dataset)
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
 

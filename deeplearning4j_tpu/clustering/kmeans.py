@@ -49,7 +49,8 @@ def _lloyd_iter(points, centers, key, metric):
     empty = (counts < 0.5)[:, None]
     new_centers = jnp.where(empty, reseed, new_centers)
     inertia = jnp.sum(mind * mind)
-    return new_centers, assign, mind, inertia
+    reseeded = jnp.any(counts < 0.5)
+    return new_centers, assign, mind, inertia, reseeded
 
 
 class KMeansClustering:
@@ -95,12 +96,14 @@ class KMeansClustering:
         it = 0
         for it in range(1, self.max_iterations + 1):
             key, k1 = jax.random.split(key)
-            centers, _, _, inertia = _lloyd_iter(
+            centers, _, _, inertia, reseeded = _lloyd_iter(
                 points, centers, k1, self.distance_function
             )
             inertia = float(inertia)
-            if prev_inertia is not None and \
-                    prev_inertia - inertia <= self.min_variation * max(prev_inertia, 1e-12):
+            # a reseed can RAISE inertia by design; never treat that
+            # iteration as converged — the new centers need refinement
+            if (not bool(reseeded) and prev_inertia is not None and
+                    prev_inertia - inertia <= self.min_variation * max(prev_inertia, 1e-12)):
                 prev_inertia = inertia
                 break
             prev_inertia = inertia

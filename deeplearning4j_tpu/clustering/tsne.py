@@ -115,10 +115,13 @@ class Tsne:
         for it in range(self.max_iter):
             lying = it < self.stop_lying_iter
             mom = self.momentum if it < self.switch_iter else self.final_momentum
-            grad, kl = _tsne_grad(Y, P * self.exaggeration if lying else P)
+            grad, _ = _tsne_grad(Y, P * self.exaggeration if lying else P)
             V = mom * V - self.learning_rate * grad
             Y = Y + V
             Y = Y - jnp.mean(Y, 0, keepdims=True)
+        # report KL against the TRUE (un-exaggerated) P — with short runs
+        # the loop may end while still lying
+        _, kl = _tsne_grad(Y, P)
         self.kl_divergence_ = float(kl)
         return np.asarray(Y)
 

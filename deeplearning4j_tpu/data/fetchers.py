@@ -169,12 +169,9 @@ class TinyImageNetDataSetIterator(DataSetIterator):
 # --------------------------------------------------------------------------
 # UCI synthetic control charts — 60-step sequences, 6 classes
 # --------------------------------------------------------------------------
-def load_uci_sequences(train: bool = True, num_examples: Optional[int] = None,
-                       seed: int = 7) -> Tuple[np.ndarray, np.ndarray]:
-    """The six Alcock & Manolopoulos control-chart processes: normal,
-    cyclic, increasing trend, decreasing trend, upward shift, downward
-    shift. Real cached file ``$CACHE/uci/synthetic_control.data`` (600×60
-    whitespace floats, 100 per class in order) is used when present."""
+def _uci_raw(train: bool, num_examples: Optional[int],
+             seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Unstandardized (x (N,60,1), labels (N,)) for one split."""
     path = os.path.join(CACHE_DIR, "uci", "synthetic_control.data")
     if os.path.exists(path):
         vals = np.loadtxt(path, dtype=np.float32)  # (600, 60)
@@ -211,8 +208,26 @@ def load_uci_sequences(train: bool = True, num_examples: Optional[int] = None,
         y = labels
     if num_examples:
         x, y = x[:num_examples], y[:num_examples]
-    # standardize per the reference's normalizer-ready convention
-    x = (x - x.mean()) / max(x.std(), 1e-6)
+    return x, y
+
+
+def load_uci_sequences(train: bool = True, num_examples: Optional[int] = None,
+                       seed: int = 7) -> Tuple[np.ndarray, np.ndarray]:
+    """The six Alcock & Manolopoulos control-chart processes: normal,
+    cyclic, increasing trend, decreasing trend, upward shift, downward
+    shift. Real cached file ``$CACHE/uci/synthetic_control.data`` (600×60
+    whitespace floats, 100 per class in order) is used when present.
+
+    Standardization uses TRAIN-split statistics for BOTH splits (the
+    fit-on-train / apply-to-test normalizer convention) so train and test
+    inputs share one affine transform."""
+    x, y = _uci_raw(train, num_examples, seed)
+    if train:
+        tx = x
+    else:
+        tx, _ = _uci_raw(True, None, seed)
+    mean, std = float(tx.mean()), max(float(tx.std()), 1e-6)
+    x = (x - mean) / std
     yoh = np.tile(np.eye(6, dtype=np.float32)[y][:, None, :], (1, 60, 1))
     return x.astype(np.float32), yoh
 

@@ -207,6 +207,13 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.label_to = label_index_to
         if regression and label_index is not None and label_index_from is None:
             self.label_from = self.label_to = label_index
+        if regression:
+            if self.label_from is None:
+                raise ValueError(
+                    "regression=True needs label_index or label_index_from"
+                )
+            if self.label_to is None:
+                self.label_to = self.label_from
 
     def has_next(self) -> bool:
         return self.reader.has_next()
@@ -221,6 +228,11 @@ class RecordReaderDataSetIterator(DataSetIterator):
             if l is not None:
                 labels.append(l)
             n += 1
+        if not feats:
+            raise ValueError(
+                "RecordReader exhausted: next() called with no records left "
+                "(check has_next(); reference throws NoSuchElementException)"
+            )
         x = np.stack(feats).astype(np.float32)
         y = np.stack(labels).astype(np.float32) if labels else None
         return DataSet(x, y)

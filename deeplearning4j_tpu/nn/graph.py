@@ -188,7 +188,11 @@ class ComputationGraph:
                 x = apply_input_dropout(layer, x, train, r)
                 if layer.is_output_layer:
                     out_inputs[name] = (x, m)
-                p_n = apply_weight_noise(layer, params.get(name, {}), train, r)
+                # output layers: weight noise applies in the SCORE path
+                # (compute_score) only — noising here too would draw a
+                # second, different mask for the same step
+                p_n = params.get(name, {}) if layer.is_output_layer else \
+                    apply_weight_noise(layer, params.get(name, {}), train, r)
                 if (
                     carries is not None
                     and isinstance(layer, BaseRecurrentLayer)

@@ -75,7 +75,7 @@ def _summary(arrs: Dict[str, np.ndarray], histograms: bool,
 
 
 class StatsListener(TrainingListener):
-    def __init__(self, storage: StatsStorage, reporting_frequency: int = 1,
+    def __init__(self, storage: StatsStorage, reporting_frequency: int = 10,
                  session_id: Optional[str] = None, worker_id: str = "worker_0",
                  collect_histograms: bool = True, histogram_bins: int = 20):
         self.storage = storage
