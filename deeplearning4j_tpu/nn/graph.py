@@ -595,10 +595,11 @@ class ComputationGraph:
         return grads, float(score)
 
     # ------------------------------------------------------------- evaluation
-    def evaluate(self, it: Union[DataSetIterator, DataSet]):
+    def evaluate(self, it: Union[DataSetIterator, DataSet], top_n: int = 1):
+        """(reference ``evaluate`` incl. the topN overload)"""
         from deeplearning4j_tpu.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if isinstance(it, DataSet):
             it = ListDataSetIterator(it, 256)
         for ds in it:

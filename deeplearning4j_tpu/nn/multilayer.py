@@ -609,10 +609,11 @@ class MultiLayerNetwork:
         return grads, float(score)
 
     # ------------------------------------------------------------- evaluation
-    def evaluate(self, it: Union[DataSetIterator, DataSet]):
+    def evaluate(self, it: Union[DataSetIterator, DataSet], top_n: int = 1):
+        """(reference ``evaluate(DataSetIterator)`` and the topN overload)"""
         from deeplearning4j_tpu.evaluation import Evaluation
 
-        ev = Evaluation()
+        ev = Evaluation(top_n=top_n)
         if isinstance(it, DataSet):
             it = ListDataSetIterator(it, 256)
         for ds in it:

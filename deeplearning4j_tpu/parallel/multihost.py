@@ -370,6 +370,46 @@ class MultiHostNetwork:
     def score(self) -> float:
         return float(self.model.score_)
 
+    def evaluate(self, it: DataSetIterator, top_n: int = 1):
+        """Distributed evaluation (reference
+        ``spark/impl/multilayer/evaluation/IEvaluateFlatMapFunction.java``
+        + ``IEvaluationReduceFunction``): each host evaluates its LOCAL
+        shard of the data, then the merge-able Evaluation states
+        (confusion counts, top-N tallies) are summed across processes —
+        every host returns the identical global Evaluation."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        local = self.model.evaluate(it, top_n=top_n)
+        if jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        n = local.num_classes or 0
+        # fixed-size payload: confusion matrix + topN counters (+ n so
+        # hosts that saw no data contribute zeros of the right shape)
+        n_global = int(np.max(multihost_utils.process_allgather(
+            np.asarray([n], np.int64))))
+        conf = np.zeros((n_global, n_global), np.int64)
+        if local.confusion is not None:
+            m = np.asarray(local.confusion.matrix, np.int64)
+            conf[: m.shape[0], : m.shape[1]] = m
+        payload = np.concatenate([
+            conf.reshape(-1),
+            np.asarray([local.top_n_correct, local.top_n_total], np.int64),
+        ])
+        gathered = multihost_utils.process_allgather(payload)  # (procs, L)
+        summed = np.asarray(gathered).sum(axis=0)
+        merged = Evaluation(num_classes=n_global,
+                            labels=local.label_names, top_n=local.top_n)
+        from deeplearning4j_tpu.evaluation.classification import ConfusionMatrix
+
+        cm = ConfusionMatrix(n_global)
+        cm.matrix = summed[:-2].reshape(n_global, n_global)
+        merged.confusion = cm
+        merged.top_n_correct = int(summed[-2])
+        merged.top_n_total = int(summed[-1])
+        return merged
+
     # -- checkpoint-restart (the recovery story, SURVEY.md §5) --------------
     def save_checkpoint(self, path: str) -> None:
         """Chief writes the standard ModelSerializer zip; other hosts

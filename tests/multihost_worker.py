@@ -54,6 +54,14 @@ facade.restore_checkpoint(ckpt)
 it.reset()
 facade.fit(it, epochs=1)
 
+# distributed evaluation: each host evaluates its local shard; merged
+# result must be identical on every host (reference IEvaluateFlatMap +
+# reduce semantics)
+eval_it = ShardedDataSetIterator(global_batches(), nprocs, pid)
+ev = facade.evaluate(eval_it)
+acc = ev.accuracy()
+total = int(np.asarray(ev.confusion.matrix).sum())
+
 if pid == 0:
     np.savez(
         os.path.join(outdir, "multihost_result.npz"),
@@ -61,5 +69,13 @@ if pid == 0:
         score=float(net.score_),
         iteration=net.iteration,
         n_stats=len(master.stats),
+        eval_accuracy=acc,
+        eval_total=total,
+    )
+else:
+    np.savez(
+        os.path.join(outdir, f"multihost_result_{pid}.npz"),
+        eval_accuracy=acc,
+        eval_total=total,
     )
 print(f"worker {pid}: done, iteration={net.iteration}", flush=True)

@@ -50,6 +50,12 @@ def test_two_process_training_matches_single_process():
     assert result["iteration"] == 12  # 3 epochs × 4 global batches
     assert result["n_stats"] > 0  # collect_training_stats plumbing
     assert np.isfinite(result["score"])
+    # distributed evaluation merged over BOTH hosts' shards: the count
+    # covers the full dataset and both hosts agree exactly
+    r1 = np.load(os.path.join(outdir, "multihost_result_1.npz"))
+    assert int(result["eval_total"]) == 64  # GLOBAL_BATCH * N_BATCHES
+    assert float(result["eval_accuracy"]) == float(r1["eval_accuracy"])
+    assert int(r1["eval_total"]) == 64
 
     # single-process reference: same net, same global batches, 3 epochs
     from tests.multihost_model import build_net, global_batches

@@ -375,3 +375,19 @@ class TestSerialization:
         net2 = ModelSerializer.restore_multi_layer_network(path)
         net2.fit(ds, batch_size=64)  # must continue without error
         assert net2.iteration == net.iteration + 1
+
+
+class TestTopNEvaluate:
+    def test_top_n_accuracy_at_least_top1(self):
+        """evaluate(it, top_n=3) (reference topN overload): top-3 accuracy
+        is >= top-1 and uses the merged counters."""
+        ds = small_classification_data()
+        conf = mlp_conf()
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ds, epochs=3, batch_size=32)
+        ev1 = net.evaluate(ds)
+        ev3 = net.evaluate(ds, top_n=3)
+        assert ev3.top_n_total == ds.features.shape[0]
+        top3 = ev3.top_n_correct / ev3.top_n_total
+        assert top3 >= ev1.accuracy() - 1e-9
+        assert top3 == 1.0  # 3 classes, top-3 always contains the label
