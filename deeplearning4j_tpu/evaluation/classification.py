@@ -78,7 +78,12 @@ class Evaluation:
             self._ensure(predictions.shape[1])
         self.confusion.add(actual, pred_cls)
         if self.top_n > 1:
-            top = np.argsort(-predictions, axis=1)[:, : self.top_n]
+            probs = predictions
+            if probs.ndim == 2 and probs.shape[1] == 1:
+                # single sigmoid column → explicit 2-class probabilities so
+                # the top-N ranking is over real classes, not one column
+                probs = np.concatenate([1.0 - probs, probs], axis=1)
+            top = np.argsort(-probs, axis=1)[:, : self.top_n]
             self.top_n_correct += int(np.sum(top == actual[:, None]))
             self.top_n_total += len(actual)
 

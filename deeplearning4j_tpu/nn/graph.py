@@ -139,6 +139,7 @@ class ComputationGraph:
         fmasks: Optional[Sequence[Optional[Array]]] = None,
         collect: bool = False,
         carries: Optional[Dict[str, Any]] = None,
+        stop_before_vertex: Optional[str] = None,
     ):
         """Pure forward walk over the topological order.
 
@@ -174,6 +175,8 @@ class ComputationGraph:
         rngs = dict(zip(self.layer_names,
                         jax.random.split(rng, n_l))) if rng is not None else {}
         for name in self.topo:
+            if stop_before_vertex is not None and name == stop_before_vertex:
+                break
             v = conf.vertices[name]
             srcs = conf.vertex_inputs[name]
             in_acts = [acts[s] for s in srcs]
@@ -368,6 +371,72 @@ class ComputationGraph:
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
+
+    # --------------------------------------------------------------- pretrain
+    def pretrain(self, it, epochs: int = 1) -> "ComputationGraph":
+        """Greedy unsupervised pretraining of every pretrain-capable layer
+        vertex (reference ``ComputationGraph.pretrain``)."""
+        for name in self.layer_names:
+            if self._layer(name).is_pretrain_layer:
+                self.pretrain_layer(name, it, epochs=epochs)
+        return self
+
+    def pretrain_layer(self, name: str, it, epochs: int = 1) -> "ComputationGraph":
+        """Unsupervised pretraining of one layer vertex (reference
+        ``ComputationGraph.pretrainLayer``): the DAG runs in inference
+        mode up to the vertex's input, then the layer's ``pretrain_loss``
+        (-ELBO / reconstruction error) is minimized over its params only."""
+        from deeplearning4j_tpu.regularization import normalize_layer_gradients
+
+        layer = self._layer(name)
+        if not layer.is_pretrain_layer:
+            raise ValueError(f"Layer vertex '{name}' is not pretrainable")
+        v = self.conf.vertices[name]
+        src = self.conf.vertex_inputs[name][0]
+
+        def step(layer_params, opt_n, all_params, state, features, rng,
+                 iteration, epoch):
+            params = dict(all_params)
+            params[name] = layer_params
+            # the walk stops at the pretrained vertex: downstream vertices
+            # are irrelevant to the unsupervised objective
+            acts, masks, _, _ = self._forward(
+                params, state, features, train=False, rng=None,
+                stop_before_vertex=name,
+            )
+            x = acts[src]
+            if v.preprocessor is not None:
+                x = v.preprocessor.pre_process(x, masks.get(src))
+
+            loss, grads = jax.value_and_grad(
+                lambda p: layer.pretrain_loss(p, x, rng)
+            )(layer_params)
+            # shared pipeline: normalization, regularization, updater AND
+            # constraints
+            (new_p,), (new_o,) = _apply_layer_updates(
+                [layer], [layer_params], [grads], [opt_n],
+                iteration + 1, iteration, epoch,
+            )
+            return new_p, new_o, loss
+
+        jit_step = self._get_jit(f"pretrain_{name}", lambda: jax.jit(step))
+        for _ in range(epochs):
+            for ds in it:
+                mds = _as_multi(ds)
+                new_p, new_o, loss = jit_step(
+                    self.params_[name], self.opt_state_[name],
+                    self.params_, self.state_,
+                    tuple(jnp.asarray(f) for f in mds.features),
+                    self._next_rng(),
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                self.params_ = {**self.params_, name: new_p}
+                self.opt_state_ = {**self.opt_state_, name: new_o}
+                self.score_ = loss
+                self.iteration += 1
+            it.reset()
+        return self
 
     # ----------------------------------------------------------------- tBPTT
     def _init_carries(self, batch: int, dtype=jnp.float32) -> Dict[str, Any]:

@@ -474,22 +474,13 @@ class MultiLayerNetwork:
                 return layer.pretrain_loss(p, x, rng)
 
             loss, grads = jax.value_and_grad(loss_fn)(layer_params)
-            g = normalize_layer_gradients(
-                grads, layer.gradient_normalization,
-                layer.gradient_normalization_threshold,
+            # the shared pipeline applies normalization, regularization,
+            # updater AND constraints (a hand-rolled copy here previously
+            # skipped constraints)
+            (new_p,), (new_o,) = _apply_layer_updates(
+                [layer], [layer_params], [grads], [opt_i],
+                iteration + 1, iteration, epoch,
             )
-            reg = layer.regularization
-            if reg is not None:
-                g = {
-                    k: (gv if (t := reg.grad_term(k, layer_params[k])) is None else gv + t)
-                    for k, gv in g.items()
-                }
-            upd = layer.updater if layer.updater is not None else NoOp()
-            new_p, new_o = {}, {}
-            for name, gv in g.items():
-                delta, slot = upd.apply(gv, opt_i[name], iteration + 1, iteration, epoch)
-                new_p[name] = layer_params[name] - delta
-                new_o[name] = slot
             return new_p, new_o, loss
 
         def dict_to_list_params(all_params, layer_params, idx):

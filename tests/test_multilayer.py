@@ -391,3 +391,17 @@ class TestTopNEvaluate:
         top3 = ev3.top_n_correct / ev3.top_n_total
         assert top3 >= ev1.accuracy() - 1e-9
         assert top3 == 1.0  # 3 classes, top-3 always contains the label
+
+    def test_top_n_with_single_sigmoid_column(self):
+        """top-N over a 1-column sigmoid output ranks the two implied
+        classes (review regression: argsort over one column counted only
+        class-0 rows)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 50)
+        probs = rng.random((50, 1)).astype(np.float32)
+        ev = Evaluation(top_n=2)
+        ev.eval(labels.reshape(-1, 1).astype(np.float32), probs)
+        assert ev.top_n_total == 50
+        assert ev.top_n_correct == 50  # top-2 of 2 classes always hits

@@ -247,3 +247,43 @@ class TestYolo2:
         kept = non_max_suppression([a, b, c], 0.45)
         assert len(kept) == 2
         assert a in kept and c in kept
+
+
+class TestGraphPretrain:
+    def test_vae_pretrain_in_computation_graph(self):
+        """ComputationGraph pretrain (reference ComputationGraph.pretrain):
+        greedy unsupervised VAE pretraining reduces -ELBO, leaving other
+        vertices untouched."""
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+            .weight_init("xavier").graph_builder()
+            .add_inputs("in")
+            .add_layer("vae", VariationalAutoencoder(
+                n_out=4, encoder_layer_sizes=[16], decoder_layer_sizes=[16],
+            ), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "vae")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(8))
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        ds = DataSet(x, np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)])
+        it = ListDataSetIterator(ds, 32)
+        out_before = {k: np.asarray(v) for k, v in net.params_["out"].items()}
+        losses = []
+        for _ in range(15):
+            net.pretrain(it, epochs=1)
+            losses.append(float(net.score_))
+        assert losses[-1] < losses[0], losses
+        # only the VAE vertex trained
+        for k, v in net.params_["out"].items():
+            np.testing.assert_array_equal(np.asarray(v), out_before[k])
+        # supervised fit still works afterwards
+        net.fit(ds, batch_size=32)
+        assert np.isfinite(float(net.score_))
