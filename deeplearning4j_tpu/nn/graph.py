@@ -386,8 +386,6 @@ class ComputationGraph:
         ``ComputationGraph.pretrainLayer``): the DAG runs in inference
         mode up to the vertex's input, then the layer's ``pretrain_loss``
         (-ELBO / reconstruction error) is minimized over its params only."""
-        from deeplearning4j_tpu.regularization import normalize_layer_gradients
-
         layer = self._layer(name)
         if not layer.is_pretrain_layer:
             raise ValueError(f"Layer vertex '{name}' is not pretrainable")
@@ -664,11 +662,8 @@ class ComputationGraph:
         return grads, float(score)
 
     # ------------------------------------------------------------- evaluation
-    def evaluate(self, it: Union[DataSetIterator, DataSet], top_n: int = 1):
-        """(reference ``evaluate`` incl. the topN overload)"""
-        from deeplearning4j_tpu.evaluation import Evaluation
-
-        ev = Evaluation(top_n=top_n)
+    def _evaluate_with(self, it, ev):
+        """Shared drive loop for the evaluate-family helpers."""
         if isinstance(it, DataSet):
             it = ListDataSetIterator(it, 256)
         for ds in it:
@@ -676,6 +671,30 @@ class ComputationGraph:
             ev.eval(ds.labels, out, mask=ds.labels_mask)
         it.reset()
         return ev
+
+    def evaluate_roc(self, it, threshold_steps: int = 0):
+        """Binary ROC (reference ``evaluateROC``)."""
+        from deeplearning4j_tpu.evaluation import ROC
+
+        return self._evaluate_with(it, ROC(threshold_steps))
+
+    def evaluate_roc_multi_class(self, it, threshold_steps: int = 0):
+        """One-vs-all ROC per class (reference ``evaluateROCMultiClass``)."""
+        from deeplearning4j_tpu.evaluation import ROCMultiClass
+
+        return self._evaluate_with(it, ROCMultiClass(threshold_steps))
+
+    def evaluate(self, it: Union[DataSetIterator, DataSet], top_n: int = 1):
+        """(reference ``evaluate`` incl. the topN overload)"""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        return self._evaluate_with(it, Evaluation(top_n=top_n))
+
+    def evaluate_regression(self, it: Union[DataSetIterator, DataSet]):
+        """(reference ``evaluateRegression``)"""
+        from deeplearning4j_tpu.evaluation import RegressionEvaluation
+
+        return self._evaluate_with(it, RegressionEvaluation())
 
     # ------------------------------------------------------- params utilities
     def num_params(self) -> int:

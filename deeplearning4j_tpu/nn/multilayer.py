@@ -604,7 +604,10 @@ class MultiLayerNetwork:
         """(reference ``evaluate(DataSetIterator)`` and the topN overload)"""
         from deeplearning4j_tpu.evaluation import Evaluation
 
-        ev = Evaluation(top_n=top_n)
+        return self._evaluate_with(it, Evaluation(top_n=top_n))
+
+    def _evaluate_with(self, it, ev):
+        """Shared drive loop for the evaluate-family helpers."""
         if isinstance(it, DataSet):
             it = ListDataSetIterator(it, 256)
         for ds in it:
@@ -612,18 +615,23 @@ class MultiLayerNetwork:
             ev.eval(ds.labels, out, mask=ds.labels_mask)
         it.reset()
         return ev
+
+    def evaluate_roc(self, it, threshold_steps: int = 0):
+        """Binary ROC over the iterator (reference ``evaluateROC``)."""
+        from deeplearning4j_tpu.evaluation import ROC
+
+        return self._evaluate_with(it, ROC(threshold_steps))
+
+    def evaluate_roc_multi_class(self, it, threshold_steps: int = 0):
+        """One-vs-all ROC per class (reference ``evaluateROCMultiClass``)."""
+        from deeplearning4j_tpu.evaluation import ROCMultiClass
+
+        return self._evaluate_with(it, ROCMultiClass(threshold_steps))
 
     def evaluate_regression(self, it: Union[DataSetIterator, DataSet]):
         from deeplearning4j_tpu.evaluation import RegressionEvaluation
 
-        ev = RegressionEvaluation()
-        if isinstance(it, DataSet):
-            it = ListDataSetIterator(it, 256)
-        for ds in it:
-            out = self.output(ds.features, mask=ds.features_mask)
-            ev.eval(ds.labels, out, mask=ds.labels_mask)
-        it.reset()
-        return ev
+        return self._evaluate_with(it, RegressionEvaluation())
 
     # ------------------------------------------------------- params utilities
     def num_params(self) -> int:

@@ -405,3 +405,31 @@ class TestTopNEvaluate:
         ev.eval(labels.reshape(-1, 1).astype(np.float32), probs)
         assert ev.top_n_total == 50
         assert ev.top_n_correct == 50  # top-2 of 2 classes always hits
+
+    def test_evaluate_roc_helpers(self):
+        """evaluateROC / evaluateROCMultiClass model helpers (reference
+        surface) on both model types."""
+        ds2 = small_classification_data(n_classes=2)
+        conf = mlp_conf(n_classes=2)
+        net = MultiLayerNetwork(conf).init()
+        net.fit(ds2, epochs=5, batch_size=32)
+        roc = net.evaluate_roc(ds2)
+        assert 0.5 <= roc.calculate_auc() <= 1.0
+        rocm = net.evaluate_roc_multi_class(ds2)
+        assert 0.0 <= rocm.calculate_average_auc() <= 1.0
+
+        from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration as NNC
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gconf = (
+            NNC.builder().seed(1).updater(Adam(0.02)).weight_init("xavier")
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("o", OutputLayer(n_out=2, activation="softmax",
+                                        loss="mcxent"), "d")
+            .set_outputs("o")
+            .set_input_types(InputType.feed_forward(4)).build()
+        )
+        g = ComputationGraph(gconf).init()
+        g.fit(ds2, batch_size=32)
+        assert 0.0 <= g.evaluate_roc(ds2).calculate_auc() <= 1.0
