@@ -91,11 +91,17 @@ def _init_devices(max_tries: int = 5):
 
 
 def _bench_resnet(batch: int, compute_dtype):
+    import os
+
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.resnet50 import ResNet50
 
-    model = ResNet50(num_classes=1000, compute_dtype=compute_dtype).init()
+    model = ResNet50(
+        num_classes=1000,
+        compute_dtype=compute_dtype,
+        stem_space_to_depth=os.environ.get("BENCH_S2D", "0") == "1",
+    ).init()
 
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)).astype(np.float32))

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     ConvolutionLayer,
     GlobalPoolingLayer,
     OutputLayer,
+    SpaceToDepthLayer,
     SubsamplingLayer,
 )
 from deeplearning4j_tpu.updaters import Nesterovs
@@ -76,7 +77,16 @@ class ResNet50(ZooModel):
             .set_input_types(InputType.convolutional(self.height, self.width,
                                                      self.channels))
         )
-        x = self._conv_bn(gb, "stem", "input", 64, 7, 2)
+        if self.kwargs.get("stem_space_to_depth"):
+            # MLPerf-style TPU stem: 2x2 space-to-depth moves the 3-channel
+            # input to 12 channels at half resolution, and the 7x7/2 conv
+            # becomes an equivalent-receptive-field 4x4/1 conv — far better
+            # MXU lane utilisation than C_in=3 (the 7x7 kernel zero-pads to
+            # 8x8 = 4x4 on the s2d grid). Same 112x112x64 stem output.
+            gb.add_layer("stem_s2d", SpaceToDepthLayer(block_size=2), "input")
+            x = self._conv_bn(gb, "stem", "stem_s2d", 64, 4, 1)
+        else:
+            x = self._conv_bn(gb, "stem", "input", 64, 7, 2)
         gb.add_layer("stem_pool",
                      SubsamplingLayer(kernel_size=3, stride=2,
                                       convolution_mode="same"), x)

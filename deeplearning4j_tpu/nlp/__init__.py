@@ -38,6 +38,7 @@ from deeplearning4j_tpu.nlp.bagofwords import (
     BagOfWordsVectorizer,
     TfidfVectorizer,
 )
+from deeplearning4j_tpu.nlp.inverted_index import InMemoryInvertedIndex
 
 __all__ = [
     "CommonPreprocessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
@@ -45,4 +46,5 @@ __all__ = [
     "StopWords", "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
     "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",
     "WordVectorSerializer", "BagOfWordsVectorizer", "TfidfVectorizer",
+    "InMemoryInvertedIndex",
 ]

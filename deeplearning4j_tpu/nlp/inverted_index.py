@@ -1,0 +1,93 @@
+"""Inverted document index (reference
+``text/invertedindex/InvertedIndex.java`` — the document store behind the
+bag-of-words vectorizers: word → documents mapping, document/label
+retrieval, minibatch iteration over documents).
+
+The reference's default impl was Lucene-backed; here it is an in-memory
+token-id index (consistent with the framework's host-side text pipeline —
+device work only starts once fixed-shape batches are drawn).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+
+class InMemoryInvertedIndex:
+    """word → sorted doc-id postings + full document store."""
+
+    def __init__(self):
+        self._docs: List[List[str]] = []
+        self._labels: List[Optional[str]] = []
+        self._postings: Dict[str, List[int]] = defaultdict(list)
+
+    # ------------------------------------------------------------- build
+    def add_document(self, tokens: Sequence[str],
+                     label: Optional[str] = None) -> int:
+        """Index a tokenized document; returns its doc id."""
+        doc_id = len(self._docs)
+        toks = [str(t) for t in tokens]
+        self._docs.append(toks)
+        self._labels.append(label)
+        for w in set(toks):
+            self._postings[w].append(doc_id)
+        return doc_id
+
+    # ----------------------------------------------------------- queries
+    def document(self, index: int) -> List[str]:
+        """(reference ``document(int)``)."""
+        return list(self._docs[index])
+
+    def document_with_label(self, index: int) -> Tuple[List[str], Optional[str]]:
+        """(reference ``documentWithLabel``)."""
+        return list(self._docs[index]), self._labels[index]
+
+    def documents(self, word: str) -> List[int]:
+        """Doc ids containing ``word`` (reference ``documents(T)``)."""
+        return list(self._postings.get(word, []))
+
+    def documents_containing_all(self, words: Sequence[str]) -> List[int]:
+        """Conjunctive query: docs containing every word (postings-list
+        intersection)."""
+        sets: List[Set[int]] = [set(self._postings.get(w, [])) for w in words]
+        if not sets:
+            return []
+        out = set.intersection(*sets)
+        return sorted(out)
+
+    def num_documents(self) -> int:
+        return len(self._docs)
+
+    def doc_frequency(self, word: str) -> int:
+        """Number of documents containing the word (the df in tf-idf)."""
+        return len(self._postings.get(word, []))
+
+    def term_frequency(self, word: str) -> int:
+        """Total occurrences across all documents."""
+        return sum(doc.count(word) for doc in self._docs)
+
+    def vocab(self) -> List[str]:
+        return sorted(self._postings.keys())
+
+    # --------------------------------------------------------- iteration
+    def docs(self) -> Iterator[List[str]]:
+        """(reference ``docs()``)."""
+        for d in self._docs:
+            yield list(d)
+
+    def batch_iter(self, batch_size: int) -> Iterator[List[List[str]]]:
+        """(reference ``batchIter(int)``)."""
+        batch: List[List[str]] = []
+        for d in self._docs:
+            batch.append(list(d))
+            if len(batch) == batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    def each_doc_with_label(self) -> Iterator[Tuple[List[str], Optional[str]]]:
+        """(reference ``eachDocWithLabel``)."""
+        for d, l in zip(self._docs, self._labels):
+            yield list(d), l

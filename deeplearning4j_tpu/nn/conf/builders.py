@@ -280,6 +280,15 @@ class NeuralNetConfiguration:
         self._g.compute_dtype = dt
         return self
 
+    def remat_policy(self, policy: Optional[str]) -> "NeuralNetConfiguration":
+        """Backward-pass rematerialization: "save_conv_outputs" stores only
+        conv outputs for backward and recomputes BN/activation epilogues
+        from them (cuts HBM traffic on bandwidth-bound train steps);
+        "dots"/"nothing" are the stock jax policies; None (default) lets
+        XLA store everything it keeps."""
+        self._g.remat_policy = policy
+        return self
+
     # transition to layer list ------------------------------------------------
     def list(self) -> "ListBuilder":
         if self._reg_kwargs:

@@ -56,6 +56,10 @@ from deeplearning4j_tpu.nn.conf.layers.attention import (
     SelfAttentionLayer,
     TransformerBlock,
 )
+from deeplearning4j_tpu.nn.conf.layers.moe import (
+    MixtureOfExpertsLayer,
+    MoETransformerBlock,
+)
 from deeplearning4j_tpu.nn.conf.layers.objdetect import (
     CnnLossLayer,
     DetectedObject,
@@ -96,6 +100,7 @@ __all__ = [
     "Yolo2OutputLayer", "CnnLossLayer", "DetectedObject", "non_max_suppression",
     "SelfAttentionLayer", "TransformerBlock", "LayerNormalization",
     "PositionalEmbeddingLayer",
+    "MixtureOfExpertsLayer", "MoETransformerBlock",
 ]
 
 from deeplearning4j_tpu.nn.conf.dropouts import (  # noqa: E402

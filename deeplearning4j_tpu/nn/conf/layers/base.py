@@ -164,6 +164,7 @@ class GlobalConf:
         mini_batch: bool = True,
         max_num_line_search_iterations: int = 5,
         optimization_algo: str = "stochastic_gradient_descent",
+        remat_policy: Optional[str] = None,
     ):
         from deeplearning4j_tpu.updaters import Sgd
 
@@ -182,6 +183,12 @@ class GlobalConf:
         # ``compute_dtype`` (normally "bfloat16" → MXU-native on TPU,
         # halves HBM traffic). None = uniform ``dtype`` everywhere.
         self.compute_dtype = compute_dtype
+        # Rematerialization policy for the train step's backward pass:
+        # None stores every intermediate XLA keeps; "save_conv_outputs"
+        # checkpoints only named conv outputs (BN/activation epilogues
+        # recompute from them — less HBM traffic on bandwidth-bound
+        # steps); "nothing" / "dots" map to the stock jax policies.
+        self.remat_policy = remat_policy
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

@@ -25,6 +25,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from deeplearning4j_tpu.nn.conf import serde
 from deeplearning4j_tpu.nn.conf.input_type import InputType
@@ -120,6 +121,11 @@ class ConvolutionLayer(BaseConvLayer):
             rhs_dilation=tuple(self.dilation),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
+        # identity outside jax.checkpoint; under the "save_conv_outputs"
+        # remat policy only these tensors are stored for backward — BN/act
+        # epilogues recompute from them instead of re-reading their own
+        # stored outputs (the train step is HBM-bandwidth-bound)
+        y = checkpoint_name(y, "conv_out")
         if self.has_bias:
             y = y + params["b"]
         return self.act_fn()(y), state or {}

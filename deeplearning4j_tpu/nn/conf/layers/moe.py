@@ -1,0 +1,228 @@
+"""Mixture-of-Experts layers — a NEW capability of this stack (the
+reference predates MoE; SURVEY.md §2.5 lists expert parallelism as
+ABSENT there and a required addition here, like TP/PP/SP).
+
+TPU-first design: GShard/Switch-style *dense dispatch* — routing is
+expressed as one-hot einsums with a static per-expert capacity, so the
+whole layer is three MXU matmul chains with fixed shapes (no gather/
+scatter, no dynamic shapes; XLA tiles it like any other matmul). Expert
+parallelism = shard the leading expert dim of the FFN params over the
+mesh "expert" axis (`parallel/moe.py`); GSPMD inserts the token
+all-to-all from the shardings alone.
+
+Load-balancing: the Switch-Transformer auxiliary loss
+``E * Σ_e f_e · P_e`` (f_e = fraction of tokens routed to expert e,
+P_e = mean router probability) is returned through the layer-state
+pytree under ``"aux_loss"`` and added to the training loss by the
+network (`nn/multilayer.py` / `nn/graph.py` `_loss_and_new_state`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf import serde
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.attention import TransformerBlock, _layer_norm
+from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
+
+
+def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
+    """Top-k dense dispatch (GShard): returns (dispatch [S,E,C] 0/1,
+    combine [S,E,C] gate-weighted, aux_loss scalar fp32).
+
+    Token order is assignment priority within each expert (tokens past
+    capacity are dropped for that expert — their residual path carries
+    them, the standard Switch behaviour). ``valid`` ([S] 0/1) excludes
+    padding tokens: they take no capacity slots and don't bias the
+    load-balancing statistics."""
+    S, E = probs.shape
+    f32 = probs.astype(jnp.float32)
+    if valid is not None:
+        valid = valid.reshape(S).astype(probs.dtype)
+
+    dispatch = jnp.zeros((S, E, capacity), probs.dtype)
+    gates = []
+    disps = []
+    remaining = probs
+    prev_count = jnp.zeros((E,), jnp.int32)
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)
+        oh = jax.nn.one_hot(idx, E, dtype=probs.dtype)
+        if valid is not None:
+            oh = oh * valid[:, None]
+        gates.append((remaining * oh).sum(-1))
+        remaining = remaining * (1.0 - oh)
+        oh_i = oh.astype(jnp.int32)
+        pos_in_e = jnp.cumsum(oh_i, axis=0) - oh_i + prev_count[None, :]
+        prev_count = prev_count + oh_i.sum(0)
+        keep = (pos_in_e < capacity).astype(probs.dtype) * oh
+        pos = (pos_in_e * oh_i).sum(-1)
+        disp = keep[:, :, None] * jax.nn.one_hot(pos, capacity, dtype=probs.dtype)[:, None, :]
+        disps.append(disp)
+        dispatch = dispatch + disp
+
+    # normalize the kept top-k gate values to sum to 1 per token
+    denom = sum(gates) + 1e-9
+    combine = sum(d * (g / denom)[:, None, None] for d, g in zip(disps, gates))
+
+    # Switch aux loss on the top-1 assignment, over valid tokens only
+    top1 = jax.nn.one_hot(jnp.argmax(f32, -1), E, dtype=jnp.float32)
+    if valid is None:
+        f_e = top1.mean(0)
+        p_e = f32.mean(0)
+    else:
+        v32 = valid.astype(jnp.float32)
+        n_valid = jnp.maximum(v32.sum(), 1.0)
+        f_e = (top1 * v32[:, None]).sum(0) / n_valid
+        p_e = (f32 * v32[:, None]).sum(0) / n_valid
+    aux = E * jnp.sum(f_e * p_e)
+    return dispatch, combine, aux
+
+
+def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
+    """Token-level MoE FFN: x2 [S, d] → (y [S, d], aux_loss)."""
+    probs = jax.nn.softmax(x2 @ params["Wg"], axis=-1)
+    dispatch, combine, aux = _moe_dispatch(probs, capacity, top_k, valid)
+    # [S,E,C]x[S,d] -> [E,C,d]: the tensor GSPMD all-to-alls under EP
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, x2)
+    h = act_fn(jnp.einsum("ecd,edh->ech", expert_in, params["W1"])
+               + params["b1"][:, None, :])
+    out = jnp.einsum("ech,ehd->ecd", h, params["W2"]) + params["b2"][:, None, :]
+    y = jnp.einsum("sec,ecd->sd", combine, out)
+    return y, aux
+
+
+class _MoEParamsMixin:
+    def _init_moe_params(self, rng, d: int, dtype):
+        E, h = self.n_experts, self.n_hidden
+        kg, k1, k2 = jax.random.split(rng, 3)
+        return {
+            "Wg": self._draw_weight(kg, (d, E), d, E, dtype),
+            "W1": self._draw_weight(k1, (E, d, h), d, h, dtype),
+            "b1": jnp.zeros((E, h), dtype),
+            "W2": self._draw_weight(k2, (E, h, d), h, d, dtype),
+            "b2": jnp.zeros((E, d), dtype),
+        }
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, math.ceil(n_tokens * self.capacity_factor * self.top_k
+                                / self.n_experts))
+
+
+@serde.register
+class MixtureOfExpertsLayer(FeedForwardLayer, _MoEParamsMixin):
+    """Standalone MoE FFN over tokens; accepts [B, d] or [B, T, d] input
+    (output type mirrors the input). ``n_out`` must equal ``n_in`` when a
+    residual wrapper is used; here it is the FFN output width d."""
+
+    def __init__(self, n_experts: int = 4, top_k: int = 2,
+                 capacity_factor: float = 1.25, hidden_ratio: int = 4,
+                 aux_loss_weight: float = 1e-2, **kwargs):
+        kwargs.setdefault("activation", "relu")
+        super().__init__(**kwargs)
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.hidden_ratio = int(hidden_ratio)
+        self.aux_loss_weight = float(aux_loss_weight)
+        self.n_hidden: Optional[int] = None
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        if self.n_out is None:
+            self.n_out = self.n_in
+        if self.n_out != self.n_in:
+            raise ValueError("MixtureOfExpertsLayer requires n_in == n_out "
+                             f"(got {self.n_in} != {self.n_out})")
+        self.n_hidden = self.n_in * self.hidden_ratio
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in
+        return self._init_moe_params(rng, self.n_in, dtype)
+
+    def init_layer_state(self, input_type, dtype=jnp.float32):
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        shape = x.shape
+        x2 = x.reshape(-1, shape[-1])
+        valid = None
+        if mask is not None and x.ndim == 3:
+            valid = mask.reshape(-1)
+        y2, aux = _moe_ffn(params, x2, self.act_fn(),
+                           self._capacity(x2.shape[0]), self.top_k, valid)
+        y = y2.reshape(shape)
+        if mask is not None and y.ndim == 3:
+            y = y * mask[..., None]
+        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(jnp.float32)
+                     if train else jnp.zeros((), jnp.float32)}
+        return y, new_state
+
+
+@serde.register
+class MoETransformerBlock(TransformerBlock, _MoEParamsMixin):
+    """Pre-LN transformer block whose FFN sublayer is a mixture of
+    experts: x + MHA(LN(x)), then x + MoE(LN(x))."""
+
+    def __init__(self, n_experts: int = 4, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 aux_loss_weight: float = 1e-2, **kwargs):
+        super().__init__(**kwargs)
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_loss_weight = float(aux_loss_weight)
+        self.n_hidden: Optional[int] = None
+
+    def initialize(self, input_type):
+        super().initialize(input_type)
+        self.n_hidden = self.n_out * self.mlp_ratio
+
+    def init_params(self, rng, input_type, dtype=jnp.float32):
+        assert self.n_in and self.n_out
+        d = self.n_out
+        base = TransformerBlock.init_params(self, rng, input_type, dtype)
+        for k in ("W1", "b1", "W2", "b2"):
+            del base[k]
+        base.update(self._init_moe_params(jax.random.fold_in(rng, 17), d, dtype))
+        return base
+
+    def init_layer_state(self, input_type, dtype=jnp.float32):
+        return {"aux_loss": jnp.zeros((), jnp.float32)}
+
+    def mlp(self, params, x):
+        raise NotImplementedError(
+            "MoETransformerBlock has no dense FFN; its expert FFN needs the "
+            "aux-loss return — use apply()"
+        )
+
+    def block_apply(self, params, x, mask=None, attn_fn=None):
+        raise NotImplementedError(
+            "MoETransformerBlock is not supported by the pipeline-parallel "
+            "block scan (block_apply cannot carry the MoE aux loss and the "
+            "expert params are not stackable with dense blocks) — use "
+            "apply(), or expert-shard via parallel.moe.ExpertParallelWrapper"
+        )
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        a_in = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        x = x + self.attention(params, a_in, mask=mask)
+        m_in = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        b, T, d = m_in.shape
+        valid = mask.reshape(-1) if mask is not None else None
+        y2, aux = _moe_ffn(params, m_in.reshape(-1, d), self.act_fn(),
+                           self._capacity(b * T), self.top_k, valid)
+        y = x + y2.reshape(b, T, d)
+        if mask is not None:
+            y = y * mask[..., None]
+        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(jnp.float32)
+                     if train else jnp.zeros((), jnp.float32)}
+        return y, new_state

@@ -49,6 +49,7 @@ from deeplearning4j_tpu.nn.multilayer import (
     _apply_layer_updates,
     _cast_layer_params_for_compute,
     _dtype_of,
+    _resolve_remat_policy,
 )
 from deeplearning4j_tpu.updaters import NoOp
 
@@ -271,6 +272,10 @@ class ComputationGraph:
             else:
                 per_ex = layer.compute_score(p_out, x, labels[i], lmask)
             loss = loss + jnp.mean(per_ex)
+        # auxiliary layer losses (MoE load-balancing) ride the state pytree
+        for st in new_state.values():
+            if isinstance(st, dict) and "aux_loss" in st:
+                loss = loss + st["aux_loss"]
         return loss, new_state
 
     def _reg_score(self, params):
@@ -292,6 +297,10 @@ class ComputationGraph:
         names = self.layer_names
         layers = [self._layer(n) for n in names]
 
+        remat_policy = _resolve_remat_policy(
+            getattr(self.conf.global_conf, "remat_policy", None)
+        )
+
         def step(params, opt_state, state, features, labels, fmasks, lmasks, rng,
                  iteration, epoch):
             def loss_fn(p):
@@ -300,6 +309,8 @@ class ComputationGraph:
                 )
                 return loss, new_state
 
+            if remat_policy is not None:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             t = iteration + 1
             p_list = [params[n] for n in names]
@@ -450,6 +461,9 @@ class ComputationGraph:
     def _make_tbptt_step(self):
         names = self.layer_names
         layers = [self._layer(n) for n in names]
+        remat_policy = _resolve_remat_policy(
+            getattr(self.conf.global_conf, "remat_policy", None)
+        )
 
         def step(params, opt_state, state, carries, features, labels, fmasks,
                  lmasks, rng, iteration, epoch):
@@ -473,8 +487,15 @@ class ComputationGraph:
                     )
                     per_ex = layer.compute_score(p_out, x, labels[i], lmask)
                     loss = loss + jnp.mean(per_ex)
+                # auxiliary layer losses (MoE load-balancing), as in
+                # _loss_and_new_state
+                for st in new_state.values():
+                    if isinstance(st, dict) and "aux_loss" in st:
+                        loss = loss + st["aux_loss"]
                 return loss, (new_state, new_carries)
 
+            if remat_policy is not None:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)

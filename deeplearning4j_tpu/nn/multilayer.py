@@ -73,6 +73,25 @@ def _cast_layer_params_for_compute(layer, p, cd, *, is_output: bool):
     }
 
 
+def _resolve_remat_policy(name):
+    """GlobalConf.remat_policy (or DL4J_TPU_REMAT env override) → a
+    jax.checkpoint policy, or None for no rematerialization."""
+    import os
+
+    name = os.environ.get("DL4J_TPU_REMAT") or name
+    if not name or name == "none":
+        return None
+    from jax import checkpoint_policies as cp
+
+    if name == "save_conv_outputs":
+        return cp.save_only_these_names("conv_out")
+    if name == "dots":
+        return cp.dots_saveable
+    if name == "nothing":
+        return cp.nothing_saveable
+    raise ValueError(f"unknown remat_policy: {name!r}")
+
+
 def _apply_layer_updates(layers, params, grads, opt_state, t, iteration, epoch):
     """Shared per-layer update pipeline (both train steps): gradient
     normalization → l1/l2/weight-decay → updater → constraints.
@@ -260,6 +279,10 @@ class MultiLayerNetwork:
             new_last_state = state[-1]
         new_states.append(new_last_state)
         loss = jnp.mean(per_ex)
+        # auxiliary layer losses (MoE load-balancing) ride the state pytree
+        for st in new_states:
+            if isinstance(st, dict) and "aux_loss" in st:
+                loss = loss + st["aux_loss"]
         return loss, new_states
 
     def _reg_score(self, params):
@@ -281,6 +304,10 @@ class MultiLayerNetwork:
     def _make_train_step(self, jit: bool = True):
         layers = self.layers
 
+        remat_policy = _resolve_remat_policy(
+            getattr(self.conf.global_conf, "remat_policy", None)
+        )
+
         def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
             def loss_fn(p):
                 loss, new_states = self._loss_and_new_state(
@@ -288,6 +315,8 @@ class MultiLayerNetwork:
                 )
                 return loss, new_states
 
+            if remat_policy is not None:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
             t = iteration + 1  # 1-based updater step for bias correction
             new_params, new_opt = _apply_layer_updates(
@@ -372,6 +401,9 @@ class MultiLayerNetwork:
 
     def _make_tbptt_step(self, jit: bool = True):
         layers = self.layers
+        remat_policy = _resolve_remat_policy(
+            getattr(self.conf.global_conf, "remat_policy", None)
+        )
 
         def step(params, opt_state, state, carries, features, labels, fmask, lmask, rng, iteration, epoch):
             n = len(layers)
@@ -388,8 +420,16 @@ class MultiLayerNetwork:
                 p_out = apply_weight_noise(out_layer, p[-1], rng is not None, rng)
                 per_ex = out_layer.compute_score(p_out, x, labels, label_mask)
                 new_states.append(state[-1])
-                return jnp.mean(per_ex), (new_states, new_carries)
+                loss = jnp.mean(per_ex)
+                # auxiliary layer losses (MoE load-balancing), as in
+                # _loss_and_new_state
+                for st in new_states:
+                    if isinstance(st, dict) and "aux_loss" in st:
+                        loss = loss + st["aux_loss"]
+                return loss, (new_states, new_carries)
 
+            if remat_policy is not None:
+                loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)

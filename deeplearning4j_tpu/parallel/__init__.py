@@ -12,6 +12,7 @@ from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
 from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
 from deeplearning4j_tpu.parallel.shared_training import SharedTrainingMaster
+from deeplearning4j_tpu.parallel.moe import ExpertParallelWrapper
 from deeplearning4j_tpu.parallel.multihost import (
     MultiHostContext,
     MultiHostNetwork,
@@ -28,4 +29,5 @@ __all__ = [
     "MultiHostContext", "MultiHostNetwork", "MultiHostDl4jMultiLayer",
     "MultiHostComputationGraph", "ParameterAveragingTrainingMaster",
     "ShardedDataSetIterator", "TrainingMaster", "SharedTrainingMaster",
+    "ExpertParallelWrapper",
 ]

@@ -7,11 +7,13 @@ Spark tree-aggregation, and the Aeron VoidParameterServer — XLA emits
 all-reduce over ICI within a slice and DCN collectives across slices from
 the sharding annotations alone.
 
-Axis convention (the full 4-axis layout models shard over):
+Axis convention (the full 5-axis layout models shard over):
 - "data"     — batch (DP)
 - "model"    — tensor parallel (TP) within layers
 - "pipe"     — pipeline stages (PP)
 - "seq"      — sequence/context parallel (SP, ring attention)
+- "expert"   — expert parallel (EP, MoE layers; GSPMD inserts the
+               token all-to-all from the expert-dim shardings)
 Unused axes are size 1 and cost nothing.
 """
 
@@ -31,20 +33,25 @@ class TrainingMesh:
         model: int = 1,
         pipe: int = 1,
         seq: int = 1,
+        expert: int = 1,
         devices: Optional[Sequence] = None,
     ):
         devices = list(devices if devices is not None else jax.devices())
         n = len(devices)
         if data == 0:
-            used = model * pipe * seq
+            used = model * pipe * seq * expert
             if n % used:
-                raise ValueError(f"{n} devices not divisible by model*pipe*seq={used}")
+                raise ValueError(
+                    f"{n} devices not divisible by model*pipe*seq*expert={used}"
+                )
             data = n // used
-        total = data * model * pipe * seq
+        total = data * model * pipe * seq * expert
         if total != n:
-            raise ValueError(f"mesh {data}x{model}x{pipe}x{seq}={total} != {n} devices")
-        arr = np.asarray(devices).reshape(data, model, pipe, seq)
-        self.mesh = Mesh(arr, ("data", "model", "pipe", "seq"))
+            raise ValueError(
+                f"mesh {data}x{model}x{pipe}x{seq}x{expert}={total} != {n} devices"
+            )
+        arr = np.asarray(devices).reshape(data, model, pipe, seq, expert)
+        self.mesh = Mesh(arr, ("data", "model", "pipe", "seq", "expert"))
         self.shape: Dict[str, int] = dict(zip(self.mesh.axis_names, arr.shape))
 
     # -- shardings -----------------------------------------------------------

@@ -24,9 +24,40 @@ from deeplearning4j_tpu.ui.remote import (
     RemoteStatsReceiver,
     RemoteUIStatsStorageRouter,
 )
+from deeplearning4j_tpu.ui.convolutional import (
+    ConvolutionalIterationListener,
+    activation_grid,
+    write_png_gray,
+)
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartHorizontalBar,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    ChartTimeline,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    DecoratorAccordion,
+    StyleAccordion,
+    StyleChart,
+    StyleDiv,
+    StyleTable,
+    StyleText,
+    render_page,
+    save_page,
+)
 
 __all__ = [
     "StatsListener", "StatsStorage", "InMemoryStatsStorage",
     "FileStatsStorage", "UIServer", "render_dashboard", "EvaluationTools",
     "RemoteUIStatsStorageRouter", "RemoteStatsReceiver",
+    "Component", "ChartLine", "ChartScatter", "ChartHistogram",
+    "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline",
+    "ComponentTable", "ComponentText", "ComponentDiv", "DecoratorAccordion",
+    "StyleChart", "StyleTable", "StyleText", "StyleDiv", "StyleAccordion",
+    "render_page", "save_page",
+    "ConvolutionalIterationListener", "activation_grid", "write_png_gray",
 ]

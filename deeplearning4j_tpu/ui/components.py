@@ -1,0 +1,618 @@
+"""UI component DSL (reference ``deeplearning4j-ui-components``,
+``ui/components/chart/Chart.java`` + subclasses, ``table/ComponentTable.java``,
+``text/ComponentText.java``, ``component/ComponentDiv.java``,
+``decorator/DecoratorAccordion.java``, style classes under
+``*/style/*.java``).
+
+The reference emits JSON consumed by packaged d3 assets (114 JS files).
+TPU-rebuild shape: the same component tree + JSON wire format, but
+rendering is a self-contained static HTML page with inline SVG — no JS
+assets to ship, the output opens anywhere (consistent with
+``ui/dashboard.py``).
+
+Every component serializes with an ``@type`` tag so a page can be stored,
+merged (e.g. per-host fragments in multi-host training) and re-rendered.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import json
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_PALETTE = ["#2563eb", "#dc2626", "#16a34a", "#9333ea", "#ea580c",
+            "#0891b2", "#ca8a04", "#db2777", "#4b5563", "#65a30d"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def _register(cls):
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+# ------------------------------------------------------------------ styles
+class Style:
+    """Base style (reference ``ui/api/Style.java``): sizing + margins."""
+
+    def __init__(self, width: float = 640, height: float = 260,
+                 margin_top: float = 28, margin_bottom: float = 34,
+                 margin_left: float = 46, margin_right: float = 12,
+                 background_color: str = "#ffffff"):
+        self.width = float(width)
+        self.height = float(height)
+        self.margin_top = float(margin_top)
+        self.margin_bottom = float(margin_bottom)
+        self.margin_left = float(margin_left)
+        self.margin_right = float(margin_right)
+        self.background_color = background_color
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items()}
+        d["@type"] = type(self).__name__
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["Style"]:
+        if d is None:
+            return None
+        d = dict(d)
+        name = d.pop("@type", cls.__name__)
+        klass = _REGISTRY.get(name, cls)
+        obj = klass.__new__(klass)
+        obj.__dict__.update(d)
+        return obj
+
+
+@_register
+class StyleChart(Style):
+    """(reference ``chart/style/StyleChart.java``)."""
+
+    def __init__(self, stroke_width: float = 1.6, point_size: float = 3.0,
+                 series_colors: Optional[Sequence[str]] = None,
+                 axis_stroke_width: float = 1.0,
+                 title_style: Optional[dict] = None, **kw):
+        super().__init__(**kw)
+        self.stroke_width = float(stroke_width)
+        self.point_size = float(point_size)
+        self.series_colors = list(series_colors) if series_colors else list(_PALETTE)
+        self.axis_stroke_width = float(axis_stroke_width)
+        self.title_style = title_style or {"font": "600 13px sans-serif"}
+
+
+@_register
+class StyleTable(Style):
+    """(reference ``table/style/StyleTable.java``)."""
+
+    def __init__(self, border_width: float = 1.0, header_color: str = "#f3f4f6",
+                 column_widths: Optional[Sequence[float]] = None,
+                 whitespace_mode: str = "normal", **kw):
+        super().__init__(**kw)
+        self.border_width = float(border_width)
+        self.header_color = header_color
+        self.column_widths = list(column_widths) if column_widths else None
+        self.whitespace_mode = whitespace_mode
+
+
+@_register
+class StyleText(Style):
+    """(reference ``text/style/StyleText.java``)."""
+
+    def __init__(self, font: str = "sans-serif", font_size: float = 13.0,
+                 underline: bool = False, color: str = "#111827", **kw):
+        super().__init__(**kw)
+        self.font = font
+        self.font_size = float(font_size)
+        self.underline = bool(underline)
+        self.color = color
+
+
+@_register
+class StyleDiv(Style):
+    """(reference ``component/style/StyleDiv.java``)."""
+
+    def __init__(self, float_value: str = "none", **kw):
+        super().__init__(**kw)
+        self.float_value = float_value
+
+
+@_register
+class StyleAccordion(Style):
+    """(reference ``decorator/style/StyleAccordion.java``)."""
+
+
+# -------------------------------------------------------------- components
+class Component:
+    """Base component; subclasses define ``_data()`` payload fields."""
+
+    def __init__(self, style: Optional[Style] = None, title: Optional[str] = None):
+        self.style = style
+        self.title = title
+
+    # wire format -------------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {"@type": type(self).__name__, "title": self.title,
+             "style": self.style.to_dict() if self.style else None}
+        d.update(self._data())
+        return d
+
+    def _data(self) -> dict:
+        return {}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_dict(d: dict) -> "Component":
+        d = dict(d)
+        name = d.pop("@type")
+        klass = _REGISTRY[name]
+        obj = klass.__new__(klass)
+        obj.style = Style.from_dict(d.pop("style", None))
+        obj.title = d.pop("title", None)
+        for k, v in d.items():
+            if k == "children":
+                v = [Component.from_dict(c) for c in v]
+            setattr(obj, k, v)
+        return obj
+
+    @staticmethod
+    def from_json(s: str) -> "Component":
+        return Component.from_dict(json.loads(s))
+
+    # rendering ---------------------------------------------------------
+    def render_html(self) -> str:
+        raise NotImplementedError
+
+    def _chart_style(self) -> StyleChart:
+        return self.style if isinstance(self.style, StyleChart) else StyleChart()
+
+
+def _svg_frame(st: Style, title: Optional[str]) -> Tuple[List[str], float, float,
+                                                         float, float]:
+    """Opens an svg, returns (parts, plot x0, y0, plot width, height)."""
+    w, h = st.width, st.height
+    parts = [
+        f'<svg viewBox="0 0 {w:g} {h:g}" width="{w:g}" height="{h:g}" '
+        f'style="background:{st.background_color};border:1px solid #e5e7eb;'
+        'border-radius:6px">'
+    ]
+    if title:
+        parts.append(
+            f'<text x="{w / 2:g}" y="18" text-anchor="middle" '
+            f'style="font:600 13px sans-serif">{_html.escape(title)}</text>'
+        )
+    px, py = st.margin_left, st.margin_top
+    pw = w - st.margin_left - st.margin_right
+    ph = h - st.margin_top - st.margin_bottom
+    return parts, px, py, pw, ph
+
+
+def _axes(parts, st: Style, px, py, pw, ph, x0, x1, y0, y1, n=5):
+    for i in range(n):
+        fy = py + ph - i / (n - 1) * ph
+        vy = y0 + i / (n - 1) * (y1 - y0)
+        parts.append(f'<line x1="{px:g}" y1="{fy:g}" x2="{px + pw:g}" y2="{fy:g}" '
+                     'stroke="#f0f0f0"/>')
+        parts.append(f'<text x="{px - 4:g}" y="{fy + 4:g}" text-anchor="end" '
+                     f'style="font:10px sans-serif">{vy:.3g}</text>')
+        fx = px + i / (n - 1) * pw
+        vx = x0 + i / (n - 1) * (x1 - x0)
+        parts.append(f'<text x="{fx:g}" y="{py + ph + 14:g}" text-anchor="middle" '
+                     f'style="font:10px sans-serif">{vx:.3g}</text>')
+    parts.append(f'<rect x="{px:g}" y="{py:g}" width="{pw:g}" height="{ph:g}" '
+                 'fill="none" stroke="#9ca3af"/>')
+
+
+def _legend(parts, st: StyleChart, names: Sequence[str], px, py, pw):
+    x = px
+    for i, name in enumerate(names):
+        c = st.series_colors[i % len(st.series_colors)]
+        parts.append(f'<rect x="{x:g}" y="{py - 16:g}" width="9" height="9" fill="{c}"/>')
+        parts.append(f'<text x="{x + 12:g}" y="{py - 8:g}" '
+                     f'style="font:10px sans-serif">{_html.escape(str(name))}</text>')
+        x += 14 + 6.2 * len(str(name))
+
+
+def _span(vals: Sequence[float]) -> Tuple[float, float]:
+    lo = min(vals) if vals else 0.0
+    hi = max(vals) if vals else 1.0
+    if hi == lo:
+        hi = lo + 1.0
+    return lo, hi
+
+
+@_register
+class ChartLine(Component):
+    """Multi-series line chart (reference ``chart/ChartLine.java``)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.series_names: List[str] = []
+        self.x: List[List[float]] = []
+        self.y: List[List[float]] = []
+
+    def add_series(self, name: str, x: Sequence[float], y: Sequence[float]):
+        if len(x) != len(y):
+            raise ValueError(f"series '{name}': len(x)={len(x)} != len(y)={len(y)}")
+        self.series_names.append(str(name))
+        self.x.append([float(v) for v in x])
+        self.y.append([float(v) for v in y])
+        return self
+
+    def _data(self):
+        return {"series_names": self.series_names, "x": self.x, "y": self.y}
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        allx = [v for s in self.x for v in s]
+        ally = [v for s in self.y for v in s if math.isfinite(v)]
+        x0, x1 = _span(allx)
+        y0, y1 = _span(ally)
+        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1)
+        for i, (xs, ys) in enumerate(zip(self.x, self.y)):
+            c = st.series_colors[i % len(st.series_colors)]
+            pts = " ".join(
+                f"{px + (x - x0) / (x1 - x0) * pw:.1f},"
+                f"{py + ph - (y - y0) / (y1 - y0) * ph:.1f}"
+                for x, y in zip(xs, ys) if math.isfinite(y)
+            )
+            parts.append(f'<polyline points="{pts}" fill="none" stroke="{c}" '
+                         f'stroke-width="{st.stroke_width:g}"/>')
+        _legend(parts, st, self.series_names, px, py, pw)
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartScatter(Component):
+    """(reference ``chart/ChartScatter.java``)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.series_names: List[str] = []
+        self.x: List[List[float]] = []
+        self.y: List[List[float]] = []
+
+    add_series = ChartLine.add_series
+    _data = ChartLine._data
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        allx = [v for s in self.x for v in s]
+        ally = [v for s in self.y for v in s if math.isfinite(v)]
+        x0, x1 = _span(allx)
+        y0, y1 = _span(ally)
+        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1)
+        for i, (xs, ys) in enumerate(zip(self.x, self.y)):
+            c = st.series_colors[i % len(st.series_colors)]
+            for x, y in zip(xs, ys):
+                if not math.isfinite(y):
+                    continue
+                fx = px + (x - x0) / (x1 - x0) * pw
+                fy = py + ph - (y - y0) / (y1 - y0) * ph
+                parts.append(f'<circle cx="{fx:.1f}" cy="{fy:.1f}" '
+                             f'r="{st.point_size:g}" fill="{c}" fill-opacity="0.7"/>')
+        _legend(parts, st, self.series_names, px, py, pw)
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartHistogram(Component):
+    """Explicit-bin histogram (reference ``chart/ChartHistogram.java``:
+    lowerBounds/upperBounds/yValues)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.lower: List[float] = []
+        self.upper: List[float] = []
+        self.counts: List[float] = []
+
+    def add_bin(self, lower: float, upper: float, count: float):
+        self.lower.append(float(lower))
+        self.upper.append(float(upper))
+        self.counts.append(float(count))
+        return self
+
+    def _data(self):
+        return {"lower": self.lower, "upper": self.upper, "counts": self.counts}
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        if not self.counts:
+            parts.append("</svg>")
+            return "".join(parts)
+        x0, x1 = min(self.lower), max(self.upper)
+        if x1 == x0:
+            x1 = x0 + 1
+        y0, y1 = 0.0, max(self.counts) or 1.0
+        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1)
+        c = st.series_colors[0]
+        for lo, hi, n in zip(self.lower, self.upper, self.counts):
+            fx = px + (lo - x0) / (x1 - x0) * pw
+            fw = max((hi - lo) / (x1 - x0) * pw - 1, 0.5)
+            fh = n / y1 * ph
+            parts.append(f'<rect x="{fx:.1f}" y="{py + ph - fh:.1f}" '
+                         f'width="{fw:.1f}" height="{fh:.1f}" fill="{c}" '
+                         'fill-opacity="0.8"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartHorizontalBar(Component):
+    """(reference ``chart/ChartHorizontalBar.java``)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.labels: List[str] = []
+        self.values: List[float] = []
+
+    def add_bar(self, label: str, value: float):
+        self.labels.append(str(label))
+        self.values.append(float(value))
+        return self
+
+    def _data(self):
+        return {"labels": self.labels, "values": self.values}
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        if not self.values:
+            parts.append("</svg>")
+            return "".join(parts)
+        v0 = min(0.0, min(self.values))
+        v1 = max(0.0, max(self.values))
+        if v1 == v0:
+            v1 = v0 + 1
+        n = len(self.values)
+        bh = ph / n
+        zero_x = px + (0 - v0) / (v1 - v0) * pw
+        for i, (lab, v) in enumerate(zip(self.labels, self.values)):
+            c = st.series_colors[i % len(st.series_colors)]
+            fx = px + (min(v, 0) - v0) / (v1 - v0) * pw
+            fw = abs(v) / (v1 - v0) * pw
+            fy = py + i * bh
+            parts.append(f'<rect x="{fx:.1f}" y="{fy + 2:.1f}" width="{fw:.1f}" '
+                         f'height="{max(bh - 4, 1):.1f}" fill="{c}" fill-opacity="0.85"/>')
+            parts.append(f'<text x="{px - 4:g}" y="{fy + bh / 2 + 4:.1f}" '
+                         f'text-anchor="end" style="font:10px sans-serif">'
+                         f'{_html.escape(lab)}</text>')
+            parts.append(f'<text x="{fx + fw + 3:.1f}" y="{fy + bh / 2 + 4:.1f}" '
+                         f'style="font:10px sans-serif">{v:.4g}</text>')
+        parts.append(f'<line x1="{zero_x:.1f}" y1="{py:g}" x2="{zero_x:.1f}" '
+                     f'y2="{py + ph:g}" stroke="#9ca3af"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartStackedArea(Component):
+    """Shared-x stacked area (reference ``chart/ChartStackedArea.java``)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.x: List[float] = []
+        self.series_names: List[str] = []
+        self.y: List[List[float]] = []
+
+    def set_x(self, x: Sequence[float]):
+        self.x = [float(v) for v in x]
+        return self
+
+    def add_series(self, name: str, y: Sequence[float]):
+        if len(y) != len(self.x):
+            raise ValueError("set_x first; series length must match x")
+        self.series_names.append(str(name))
+        self.y.append([float(v) for v in y])
+        return self
+
+    def _data(self):
+        return {"x": self.x, "series_names": self.series_names, "y": self.y}
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        if not self.x or not self.y:
+            parts.append("</svg>")
+            return "".join(parts)
+        x0, x1 = _span(self.x)
+        totals = [sum(s[i] for s in self.y) for i in range(len(self.x))]
+        y0, y1 = 0.0, (max(totals) or 1.0)
+        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1)
+        base = [0.0] * len(self.x)
+        for i, ys in enumerate(self.y):
+            c = st.series_colors[i % len(st.series_colors)]
+            top = [b + v for b, v in zip(base, ys)]
+            fwd = [
+                f"{px + (x - x0) / (x1 - x0) * pw:.1f},"
+                f"{py + ph - t / y1 * ph:.1f}"
+                for x, t in zip(self.x, top)
+            ]
+            back = [
+                f"{px + (x - x0) / (x1 - x0) * pw:.1f},"
+                f"{py + ph - b / y1 * ph:.1f}"
+                for x, b in reversed(list(zip(self.x, base)))
+            ]
+            parts.append(f'<polygon points="{" ".join(fwd + back)}" fill="{c}" '
+                         'fill-opacity="0.65"/>')
+            base = top
+        _legend(parts, st, self.series_names, px, py, pw)
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ChartTimeline(Component):
+    """Lanes of [start,end] entries (reference ``chart/ChartTimeline.java``;
+    used for per-phase distributed timing à la ``SparkTrainingStats``)."""
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(style, title)
+        self.lane_names: List[str] = []
+        self.lanes: List[List[dict]] = []
+
+    def add_lane(self, name: str, entries: Sequence[dict]):
+        """entries: [{"start": t0, "end": t1, "label": ..., "color": ...}]"""
+        self.lane_names.append(str(name))
+        self.lanes.append([dict(e) for e in entries])
+        return self
+
+    def _data(self):
+        return {"lane_names": self.lane_names, "lanes": self.lanes}
+
+    def render_html(self) -> str:
+        st = self._chart_style()
+        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        allt = [e[k] for lane in self.lanes for e in lane for k in ("start", "end")]
+        if not allt:
+            parts.append("</svg>")
+            return "".join(parts)
+        t0, t1 = _span(allt)
+        n = max(len(self.lanes), 1)
+        lh = ph / n
+        for i, (name, lane) in enumerate(zip(self.lane_names, self.lanes)):
+            fy = py + i * lh
+            parts.append(f'<text x="{px - 4:g}" y="{fy + lh / 2 + 4:.1f}" '
+                         f'text-anchor="end" style="font:10px sans-serif">'
+                         f'{_html.escape(name)}</text>')
+            for j, e in enumerate(lane):
+                c = e.get("color") or st.series_colors[j % len(st.series_colors)]
+                fx = px + (e["start"] - t0) / (t1 - t0) * pw
+                fw = max((e["end"] - e["start"]) / (t1 - t0) * pw, 0.5)
+                parts.append(f'<rect x="{fx:.1f}" y="{fy + 3:.1f}" width="{fw:.1f}" '
+                             f'height="{max(lh - 6, 2):.1f}" fill="{c}" '
+                             f'fill-opacity="0.85"><title>'
+                             f'{_html.escape(str(e.get("label", "")))}</title></rect>')
+        parts.append(f'<rect x="{px:g}" y="{py:g}" width="{pw:g}" height="{ph:g}" '
+                     'fill="none" stroke="#9ca3af"/>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+@_register
+class ComponentTable(Component):
+    """(reference ``table/ComponentTable.java``)."""
+
+    def __init__(self, header: Optional[Sequence[str]] = None,
+                 content: Optional[Sequence[Sequence[Any]]] = None,
+                 style: Optional[StyleTable] = None, title: Optional[str] = None):
+        super().__init__(style, title)
+        self.header = [str(h) for h in (header or [])]
+        self.content = [[str(c) for c in row] for row in (content or [])]
+
+    def _data(self):
+        return {"header": self.header, "content": self.content}
+
+    def render_html(self) -> str:
+        st = self.style if isinstance(self.style, StyleTable) else StyleTable()
+        out = ['<table style="border-collapse:collapse;font:12px sans-serif">']
+        if self.title:
+            out.append(f"<caption style='font:600 13px sans-serif'>"
+                       f"{_html.escape(self.title)}</caption>")
+        td = (f'style="border:{st.border_width:g}px solid #d1d5db;'
+              f'padding:4px 8px;white-space:{st.whitespace_mode}"')
+        if self.header:
+            out.append("<tr>" + "".join(
+                f'<th {td[:-1]};background:{st.header_color}">{_html.escape(h)}</th>'
+                for h in self.header) + "</tr>")
+        for row in self.content:
+            out.append("<tr>" + "".join(
+                f"<td {td}>{_html.escape(c)}</td>" for c in row) + "</tr>")
+        out.append("</table>")
+        return "".join(out)
+
+
+@_register
+class ComponentText(Component):
+    """(reference ``text/ComponentText.java``)."""
+
+    def __init__(self, text: str = "", style: Optional[StyleText] = None):
+        super().__init__(style, None)
+        self.text = str(text)
+
+    def _data(self):
+        return {"text": self.text}
+
+    def render_html(self) -> str:
+        st = self.style if isinstance(self.style, StyleText) else StyleText()
+        deco = "underline" if st.underline else "none"
+        return (f'<p style="font:{st.font_size:g}px {st.font};color:{st.color};'
+                f'text-decoration:{deco}">{_html.escape(self.text)}</p>')
+
+
+@_register
+class ComponentDiv(Component):
+    """Container (reference ``component/ComponentDiv.java``)."""
+
+    def __init__(self, style: Optional[StyleDiv] = None,
+                 children: Optional[Sequence[Component]] = None):
+        super().__init__(style, None)
+        self.children = list(children or [])
+
+    def add(self, *components: Component):
+        self.children.extend(components)
+        return self
+
+    def _data(self):
+        return {"children": [c.to_dict() for c in self.children]}
+
+    def render_html(self) -> str:
+        st = self.style if isinstance(self.style, StyleDiv) else StyleDiv()
+        inner = "\n".join(c.render_html() for c in self.children)
+        return (f'<div style="float:{st.float_value};margin:6px">{inner}</div>'
+                '<div style="clear:both"></div>')
+
+
+@_register
+class DecoratorAccordion(Component):
+    """Collapsible section (reference ``decorator/DecoratorAccordion.java``);
+    rendered as <details>/<summary> — no JS needed."""
+
+    def __init__(self, title: str = "", default_collapsed: bool = True,
+                 style: Optional[StyleAccordion] = None,
+                 children: Optional[Sequence[Component]] = None):
+        super().__init__(style, title)
+        self.default_collapsed = bool(default_collapsed)
+        self.children = list(children or [])
+
+    def add(self, *components: Component):
+        self.children.extend(components)
+        return self
+
+    def _data(self):
+        return {"default_collapsed": self.default_collapsed,
+                "children": [c.to_dict() for c in self.children]}
+
+    def render_html(self) -> str:
+        open_attr = "" if self.default_collapsed else " open"
+        inner = "\n".join(c.render_html() for c in self.children)
+        return (f"<details{open_attr} style='margin:8px 0'>"
+                f"<summary style='font:600 13px sans-serif;cursor:pointer'>"
+                f"{_html.escape(self.title)}</summary>{inner}</details>")
+
+
+# ------------------------------------------------------------------- page
+def render_page(components: Sequence[Component], title: str = "Report") -> str:
+    """Standalone HTML page from a component list (replaces the reference's
+    d3-asset rendering pipeline)."""
+    body = "\n".join(c.render_html() for c in components)
+    return (
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+        f"<title>{_html.escape(title)}</title></head>"
+        f"<body style='font-family:sans-serif;margin:18px'>"
+        f"<h2>{_html.escape(title)}</h2>\n{body}</body></html>"
+    )
+
+
+def save_page(components: Sequence[Component], path: str,
+              title: str = "Report") -> str:
+    with open(path, "w") as f:
+        f.write(render_page(components, title))
+    return path

@@ -1,0 +1,132 @@
+"""Convolutional activation visualization (reference
+``ConvolutionalIterationListener`` / ``ConvolutionalListenerModule`` in
+deeplearning4j-play: streams per-channel activation images of conv layers
+to the UI at a fixed iteration frequency).
+
+TPU-rebuild shape: a ``TrainingListener`` that, every ``frequency``
+iterations, runs the network's introspection forward pass
+(``feed_forward``) on a fixed probe batch, tiles every 4-d (NHWC)
+activation into one grayscale grid per layer, and writes PNGs plus a
+self-contained HTML index — no web server, consistent with
+``ui/dashboard.py``. PNG encoding is stdlib-only (zlib deflate of
+filter-0 scanlines).
+"""
+
+from __future__ import annotations
+
+import html as _html
+import os
+import struct
+import zlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+# --------------------------------------------------------------- PNG writer
+def write_png_gray(path: str, img: np.ndarray) -> str:
+    """8-bit grayscale PNG from a 2-d uint8 array (stdlib only)."""
+    img = np.asarray(img)
+    if img.ndim != 2:
+        raise ValueError(f"expected 2d grayscale, got {img.shape}")
+    img = img.astype(np.uint8, copy=False)
+    h, w = img.shape
+
+    def chunk(tag: bytes, payload: bytes) -> bytes:
+        return (struct.pack(">I", len(payload)) + tag + payload
+                + struct.pack(">I", zlib.crc32(tag + payload) & 0xFFFFFFFF))
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 0, 0, 0, 0)  # 8-bit grayscale
+    raw = b"".join(b"\x00" + img[r].tobytes() for r in range(h))
+    png = (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+           + chunk(b"IDAT", zlib.compress(raw, 6)) + chunk(b"IEND", b""))
+    with open(path, "wb") as f:
+        f.write(png)
+    return path
+
+
+def activation_grid(act: np.ndarray, max_channels: int = 64,
+                    pad: int = 1) -> np.ndarray:
+    """[H, W, C] activation → one uint8 grid image (channels tiled into a
+    near-square layout, each channel min-max normalized — the reference
+    scales each channel image independently)."""
+    act = np.asarray(act, dtype=np.float32)
+    if act.ndim != 3:
+        raise ValueError(f"expected [H, W, C], got {act.shape}")
+    h, w, c = act.shape
+    c = min(c, max_channels)
+    cols = int(np.ceil(np.sqrt(c)))
+    rows = int(np.ceil(c / cols))
+    grid = np.zeros((rows * (h + pad) + pad, cols * (w + pad) + pad), np.uint8)
+    for i in range(c):
+        ch = act[:, :, i]
+        lo, hi = float(ch.min()), float(ch.max())
+        ch8 = np.zeros_like(ch, np.uint8) if hi == lo else \
+            ((ch - lo) / (hi - lo) * 255.0).astype(np.uint8)
+        r, col = divmod(i, cols)
+        y0 = pad + r * (h + pad)
+        x0 = pad + col * (w + pad)
+        grid[y0:y0 + h, x0:x0 + w] = ch8
+    return grid
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    """Write activation-grid PNGs for every conv (4-d) activation at a
+    fixed iteration frequency (reference ``ConvolutionalIterationListener``
+    constructor arg ``iterations``)."""
+
+    def __init__(self, probe_input, out_dir: str, frequency: int = 10,
+                 max_channels: int = 64, example_index: int = 0):
+        self.probe = np.asarray(probe_input)
+        self.out_dir = out_dir
+        self.frequency = max(int(frequency), 1)
+        self.max_channels = int(max_channels)
+        self.example_index = int(example_index)
+        self.written: List[str] = []
+        os.makedirs(out_dir, exist_ok=True)
+
+    # ---------------------------------------------------------------- core
+    def _layer_activations(self, model):
+        """(name, [H,W,C] activation of the probe example) per conv layer."""
+        out = []
+        acts = model.feed_forward(self.probe)
+        if isinstance(acts, dict):  # ComputationGraph: name → activation
+            items = acts.items()
+        else:  # MultiLayerNetwork: list in layer order
+            items = ((f"layer{i}", a) for i, a in enumerate(acts))
+        for name, a in items:
+            a = np.asarray(a)
+            if a.ndim == 4:  # NHWC conv activation
+                out.append((str(name), a[self.example_index]))
+        return out
+
+    def capture(self, model, iteration: int) -> List[str]:
+        paths = []
+        for name, act in self._layer_activations(model):
+            grid = activation_grid(act, self.max_channels)
+            fname = f"iter{iteration:06d}_{name.replace('/', '_')}.png"
+            paths.append(write_png_gray(os.path.join(self.out_dir, fname), grid))
+        self.written.extend(paths)
+        self._write_index()
+        return paths
+
+    def _write_index(self):
+        rows = "\n".join(
+            f'<figure style="display:inline-block;margin:6px">'
+            f'<img src="{_html.escape(os.path.basename(p))}" '
+            f'style="image-rendering:pixelated;border:1px solid #ddd"/>'
+            f"<figcaption style='font:11px sans-serif'>"
+            f"{_html.escape(os.path.basename(p))}</figcaption></figure>"
+            for p in self.written
+        )
+        with open(os.path.join(self.out_dir, "index.html"), "w") as f:
+            f.write("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+                    "<title>Convolutional activations</title></head><body>"
+                    f"<h2>Convolutional activations</h2>\n{rows}</body></html>")
+
+    # ------------------------------------------------------------- listener
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        if iteration % self.frequency == 0:
+            self.capture(model, iteration)

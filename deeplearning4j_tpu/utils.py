@@ -1,0 +1,185 @@
+"""Misc utilities (reference ``deeplearning4j-util`` +
+``nn/util/TimeSeriesUtils.java``): time-series reshapes/reversal/last-step
+extraction, moving averages, moving-window matrix slicing, string grid.
+
+JVM-infrastructure classes in the reference module — ``Dl4jReflection``,
+``DL4JSubTypesScanner`` (Jackson subtype scanning), ``ThreadUtils``,
+``DiskBasedQueue``, ``UIDProvider`` — have no role here: serde subtypes
+are an explicit registry (``nn/conf/serde.py``), concurrency is the
+functional jit model, queueing is ``data/iterators.py``.
+
+Time-series layout note: the reference is NCW (``[mb, size, tsLength]``,
+``TimeSeriesUtils.java:96-123``); this framework is time-major-last-feature
+NWC (``[mb, tsLength, size]``) throughout — the TPU-friendly layout — so
+the reshape helpers here map between ``[b, t, f]`` and ``[b*t, f]``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+# ------------------------------------------------------- TimeSeriesUtils
+def moving_average(x, n: int):
+    """Trailing moving average over the last axis, first n-1 positions
+    dropped (reference ``TimeSeriesUtils.movingAverage:47`` cumsum trick)."""
+    orig_dtype = np.asarray(x).dtype
+    x = np.asarray(x, dtype=np.float64)
+    c = np.cumsum(x, axis=-1)
+    out = c[..., n - 1:].copy()
+    out[..., 1:] -= c[..., :-n]
+    return (out / n).astype(orig_dtype)
+
+
+def reshape_3d_to_2d(x):
+    """[b, t, f] → [b*t, f] (reference ``reshape3dTo2d:96``)."""
+    x = np.asarray(x)
+    if x.ndim != 3:
+        raise ValueError(f"expected 3d, got {x.shape}")
+    return x.reshape(-1, x.shape[-1])
+
+
+def reshape_2d_to_3d(x, minibatch: int):
+    """[b*t, f] → [b, t, f] (reference ``reshape2dTo3d:108``)."""
+    x = np.asarray(x)
+    if x.ndim != 2 or x.shape[0] % minibatch:
+        raise ValueError(f"cannot reshape {x.shape} into minibatch {minibatch}")
+    return x.reshape(minibatch, x.shape[0] // minibatch, x.shape[1])
+
+
+def reshape_time_series_mask_to_vector(mask):
+    """[b, t] mask → [b*t, 1] (reference ``:61``)."""
+    mask = np.asarray(mask)
+    if mask.ndim != 2:
+        raise ValueError(f"expected 2d mask, got {mask.shape}")
+    return mask.reshape(-1, 1)
+
+
+def reshape_vector_to_time_series_mask(vec, minibatch: int):
+    """[b*t, 1] (or [b*t]) → [b, t] (reference ``:77``)."""
+    vec = np.asarray(vec).reshape(-1)
+    if vec.shape[0] % minibatch:
+        raise ValueError(f"cannot reshape {vec.shape} into minibatch {minibatch}")
+    return vec.reshape(minibatch, -1)
+
+
+def reverse_time_series(x, mask=None):
+    """Reverse along time. With a mask, each sequence reverses within its
+    own (right-padded) length — padding stays at the end (reference
+    ``reverseTimeSeries:125``, which gathers by per-example lengths)."""
+    x = np.asarray(x)
+    if mask is None:
+        return x[:, ::-1].copy()
+    mask = np.asarray(mask)
+    lengths = mask.astype(bool).sum(axis=1)
+    out = np.zeros_like(x)
+    for i, ln in enumerate(lengths):
+        out[i, :ln] = x[i, :ln][::-1]
+        out[i, ln:] = x[i, ln:]
+    return out
+
+
+def reverse_time_series_mask(mask):
+    """Reverse a [b, t] mask along time (reference ``:163``)."""
+    return np.asarray(mask)[:, ::-1].copy()
+
+
+def pull_last_time_steps(x, mask=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Last *valid* step of each sequence: ([b, t, f], [b, t]) →
+    ([b, f], indices[b]) (reference ``pullLastTimeSteps:204``)."""
+    x = np.asarray(x)
+    if mask is None:
+        idx = np.full(x.shape[0], x.shape[1] - 1, dtype=np.int64)
+    else:
+        m = np.asarray(mask).astype(bool)
+        any_valid = m.any(axis=1)
+        idx = np.where(
+            any_valid, m.shape[1] - 1 - m[:, ::-1].argmax(axis=1), 0
+        ).astype(np.int64)
+    return x[np.arange(x.shape[0]), idx], idx
+
+
+# ---------------------------------------------------- MovingWindowMatrix
+class MovingWindowMatrix:
+    """Non-overlapping-stride sliding windows over a 2d matrix, optionally
+    with the three right-angle rotations of every window (reference
+    ``util/MovingWindowMatrix.java`` — classic image-patch augmentation)."""
+
+    def __init__(self, to_slice, window_rows: int = 28, window_cols: int = 28,
+                 add_rotate: bool = False):
+        self.m = np.asarray(to_slice)
+        if self.m.ndim != 2:
+            raise ValueError(f"expected 2d matrix, got {self.m.shape}")
+        self.window_rows = int(window_rows)
+        self.window_cols = int(window_cols)
+        self.add_rotate = bool(add_rotate)
+
+    def windows(self, flattened: bool = False) -> List[np.ndarray]:
+        rows, cols = self.m.shape
+        out: List[np.ndarray] = []
+        for r in range(0, rows - self.window_rows + 1, self.window_rows):
+            for c in range(0, cols - self.window_cols + 1, self.window_cols):
+                w = self.m[r:r + self.window_rows, c:c + self.window_cols]
+                out.append(w.reshape(-1) if flattened else w.copy())
+                if self.add_rotate:
+                    for k in (1, 2, 3):
+                        rot = np.rot90(w, k)
+                        out.append(rot.reshape(-1) if flattened else rot.copy())
+        return out
+
+
+# ------------------------------------------------------------ StringGrid
+class StringGrid:
+    """Row/column grid of strings with filter/dedup helpers (reference
+    ``util/StringGrid.java`` — CSV-ish data wrangling)."""
+
+    def __init__(self, sep: str = ",", rows: Optional[List[List[str]]] = None):
+        self.sep = sep
+        self.rows: List[List[str]] = [list(r) for r in (rows or [])]
+
+    @classmethod
+    def from_lines(cls, lines, sep: str = ",") -> "StringGrid":
+        g = cls(sep)
+        for line in lines:
+            line = line.rstrip("\n")
+            if line:
+                g.rows.append(line.split(sep))
+        return g
+
+    @classmethod
+    def from_file(cls, path: str, sep: str = ",") -> "StringGrid":
+        with open(path) as f:
+            return cls.from_lines(f, sep)
+
+    def get_column(self, j: int) -> List[str]:
+        return [r[j] for r in self.rows]
+
+    def get_rows_with_column_value(self, j: int, value: str) -> "StringGrid":
+        return StringGrid(self.sep, [r for r in self.rows if r[j] == value])
+
+    def filter_rows_by_column(self, j: int, keep) -> "StringGrid":
+        return StringGrid(self.sep, [r for r in self.rows if keep(r[j])])
+
+    def dedup_by_column(self, j: int) -> "StringGrid":
+        seen, out = set(), []
+        for r in self.rows:
+            if r[j] not in seen:
+                seen.add(r[j])
+                out.append(r)
+        return StringGrid(self.sep, out)
+
+    def sort_by_column(self, j: int, reverse: bool = False) -> "StringGrid":
+        return StringGrid(self.sep,
+                          sorted(self.rows, key=lambda r: r[j], reverse=reverse))
+
+    def to_lines(self) -> List[str]:
+        return [self.sep.join(r) for r in self.rows]
+
+    def write_file(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write("\n".join(self.to_lines()) + "\n")
+
+    def __len__(self):
+        return len(self.rows)

@@ -1,0 +1,232 @@
+"""Mixture-of-Experts + expert parallelism (NEW capability; SURVEY.md
+§2.5 lists EP as ABSENT in the reference — added here like TP/PP/SP).
+
+Covers: dense-dispatch routing invariants, training (aux loss plumbed
+through MLN and CG), serde round-trip, and EP-vs-single-device parity on
+the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    DenseLayer,
+    MixtureOfExpertsLayer,
+    MoETransformerBlock,
+    OutputLayer,
+    PositionalEmbeddingLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.layers.moe import _moe_dispatch
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.updaters import Adam
+
+
+def _mlp_moe_conf(n_in=8, n_experts=4, top_k=2, seed=0, cf=2.0):
+    return (
+        NeuralNetConfiguration.builder().seed(seed)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(n_in=n_in, n_out=16, activation="relu"))
+        .layer(MixtureOfExpertsLayer(n_experts=n_experts, top_k=top_k,
+                                     capacity_factor=cf))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(n_in))
+        .build()
+    )
+
+
+class TestMoEDispatch:
+    def test_dispatch_invariants(self):
+        rng = np.random.default_rng(0)
+        probs = jax.nn.softmax(jnp.asarray(rng.standard_normal((32, 4)),
+                                           jnp.float32), -1)
+        dispatch, combine, aux = _moe_dispatch(probs, capacity=32, top_k=2)
+        # every token assigned to exactly top_k expert slots (capacity ample)
+        np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 2.0)
+        # each expert slot holds at most one token
+        assert float(dispatch.sum(0).max()) <= 1.0 + 1e-6
+        # combine weights normalized per token
+        np.testing.assert_allclose(np.asarray(combine.sum((1, 2))), 1.0,
+                                   atol=1e-5)
+        # aux loss near 1 for near-uniform routing, >= 1 always
+        assert 0.9 < float(aux) < 4.0
+
+    def test_capacity_drops_overflow(self):
+        # all tokens prefer expert 0 with capacity 2: only 2 dispatched
+        probs = jnp.asarray(np.tile([0.97, 0.01, 0.01, 0.01], (10, 1)),
+                            jnp.float32)
+        dispatch, _, _ = _moe_dispatch(probs, capacity=2, top_k=1)
+        assert float(dispatch[:, 0].sum()) == 2.0
+        assert float(dispatch.sum()) == 2.0
+
+
+class TestMoELayerTraining:
+    def test_mln_trains_and_aux_loss_in_score(self):
+        conf = _mlp_moe_conf()
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[(np.abs(x[:, 0]) * 3).astype(int) % 3]
+        first = None
+        for _ in range(30):
+            net.fit(DataSet(x, y), epochs=1, batch_size=64)
+            if first is None:
+                first = float(net.score_)
+        assert np.isfinite(float(net.score_))
+        assert float(net.score_) < first, "MoE MLP failed to learn"
+
+    def test_eval_path_deterministic_no_aux(self):
+        net = MultiLayerNetwork(_mlp_moe_conf()).init()
+        x = np.random.default_rng(2).standard_normal((8, 8)).astype(np.float32)
+        o1, o2 = net.output(x), net.output(x)
+        np.testing.assert_allclose(o1, o2)
+        assert o1.shape == (8, 3)
+
+    def test_moe_transformer_block_cg_sequence(self):
+        conf = (
+            NeuralNetConfiguration.builder().seed(3)
+            .updater(Adam(1e-2))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(12, 6))
+            .add_layer("pos", PositionalEmbeddingLayer(), "in")
+            .add_layer("moe", MoETransformerBlock(n_heads=2, n_experts=4,
+                                                  capacity_factor=2.0), "pos")
+            .add_layer("out", RnnOutputLayer(n_out=5, activation="softmax",
+                                             loss="mcxent"), "moe")
+            .set_outputs("out")
+            .build()
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((8, 6, 12)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, (8, 6))]
+        ds = DataSet(x, y)
+        scores = []
+        for _ in range(15):
+            net.fit(ds, batch_size=8)
+            scores.append(float(net.score_))
+        assert np.isfinite(scores[-1]) and scores[-1] < scores[0]
+
+    def test_serde_round_trip(self):
+        conf = _mlp_moe_conf(n_experts=8, top_k=1)
+        c2 = type(conf).from_json(conf.to_json())
+        moe = c2.layers[1]
+        assert isinstance(moe, MixtureOfExpertsLayer)
+        assert moe.n_experts == 8 and moe.top_k == 1
+        net = MultiLayerNetwork(c2).init()
+        x = np.zeros((2, 8), np.float32)
+        assert net.output(x).shape == (2, 3)
+
+
+class TestExpertParallel:
+    def test_ep_matches_single_device(self):
+        """EP on a (data=4, expert=2) mesh must train bit-compatibly with
+        the unsharded step (same math, different layout)."""
+        from deeplearning4j_tpu.parallel import ExpertParallelWrapper, TrainingMesh
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+
+        ref = MultiLayerNetwork(_mlp_moe_conf(seed=9)).init()
+        for _ in range(5):
+            ref.fit(DataSet(x, y), epochs=1, batch_size=32)
+        ref_score = float(ref.score_)
+
+        ep_net = MultiLayerNetwork(_mlp_moe_conf(seed=9)).init()
+        mesh = TrainingMesh(data=4, expert=2)
+        wrap = ExpertParallelWrapper(ep_net, mesh).place()
+        for _ in range(5):
+            ep_score = wrap.fit_batch(x, y)
+
+        np.testing.assert_allclose(ep_score, ref_score, rtol=1e-4)
+        # params converged identically
+        for p_ref, p_ep in zip(ref.params_, ep_net.params_):
+            for k in p_ref:
+                np.testing.assert_allclose(
+                    np.asarray(p_ref[k]), np.asarray(p_ep[k]), rtol=2e-4,
+                    atol=1e-5, err_msg=k)
+
+    def test_expert_params_actually_sharded(self):
+        from deeplearning4j_tpu.parallel import ExpertParallelWrapper, TrainingMesh
+
+        net = MultiLayerNetwork(_mlp_moe_conf(seed=11)).init()
+        mesh = TrainingMesh(data=4, expert=2)
+        ExpertParallelWrapper(net, mesh).place()
+        w1 = net.params_[1]["W1"]
+        specs = w1.sharding.spec
+        assert specs[0] == "expert", f"W1 not expert-sharded: {specs}"
+        # gate stays replicated
+        assert net.params_[1]["Wg"].sharding.spec == ()
+
+    def test_indivisible_experts_rejected(self):
+        from deeplearning4j_tpu.parallel import ExpertParallelWrapper, TrainingMesh
+
+        net = MultiLayerNetwork(_mlp_moe_conf(n_experts=3)).init()
+        mesh = TrainingMesh(data=4, expert=2)
+        with pytest.raises(ValueError, match="not divisible"):
+            ExpertParallelWrapper(net, mesh)
+
+
+class TestMoEMasking:
+    def test_masked_tokens_take_no_capacity_and_skip_aux(self):
+        """Padding tokens must not consume expert capacity slots nor bias
+        the load-balancing statistics."""
+        rng = np.random.default_rng(7)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.standard_normal((12, 4)), jnp.float32), -1)
+        valid = jnp.asarray([1] * 6 + [0] * 6, jnp.float32)
+        dispatch, combine, aux = _moe_dispatch(probs, capacity=8, top_k=2,
+                                               valid=valid)
+        # masked tokens dispatched nowhere, combine weight zero
+        assert float(dispatch[6:].sum()) == 0.0
+        assert float(combine[6:].sum()) == 0.0
+        # valid tokens still fully routed
+        np.testing.assert_allclose(np.asarray(dispatch[:6].sum((1, 2))), 2.0)
+        # aux computed over the 6 valid tokens only: same as an unmasked
+        # call on just those tokens
+        _, _, aux_ref = _moe_dispatch(probs[:6], capacity=8, top_k=2)
+        np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
+
+
+class TestMoETbptt:
+    def test_aux_loss_included_in_tbptt_score(self):
+        """The tBPTT step must add the MoE aux loss exactly like the
+        standard step: with a huge aux_loss_weight the tBPTT score must
+        visibly exceed the pure data loss."""
+        def conf(aux_w):
+            return (
+                NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-3))
+                .list()
+                .layer(MixtureOfExpertsLayer(n_experts=4, top_k=2,
+                                             capacity_factor=2.0,
+                                             aux_loss_weight=aux_w))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .backprop_type("tbptt", fwd_length=4, back_length=4)
+                .set_input_type(InputType.recurrent(8, 8))
+                .build()
+            )
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (4, 8))]
+
+        def first_score(aux_w):
+            net = MultiLayerNetwork(conf(aux_w)).init()
+            net.fit(DataSet(x, y), batch_size=4)
+            return float(net.score_)
+
+        s_small, s_huge = first_score(1e-8), first_score(100.0)
+        # aux >= 1 by construction, so weight 100 must add ~>=100
+        assert s_huge > s_small + 50.0, (s_small, s_huge)

@@ -413,3 +413,44 @@ class TestFullModelZip:
         loaded.sv.fit_sequences([ids])
         moved = np.abs(loaded.get_word_vector("cat") - before).max()
         assert moved > 0, "restored tables did not train"
+
+
+class TestInvertedIndex:
+    """reference text/invertedindex/InvertedIndex.java."""
+
+    def _index(self):
+        from deeplearning4j_tpu.nlp import InMemoryInvertedIndex
+
+        idx = InMemoryInvertedIndex()
+        idx.add_document("the quick brown fox".split(), label="a")
+        idx.add_document("the lazy dog".split(), label="b")
+        idx.add_document("quick quick dog".split(), label="a")
+        return idx
+
+    def test_postings_and_documents(self):
+        idx = self._index()
+        assert idx.num_documents() == 3
+        assert idx.documents("quick") == [0, 2]
+        assert idx.documents("dog") == [1, 2]
+        assert idx.documents("missing") == []
+        assert idx.document(1) == ["the", "lazy", "dog"]
+        doc, label = idx.document_with_label(2)
+        assert label == "a" and doc[0] == "quick"
+
+    def test_frequencies(self):
+        idx = self._index()
+        assert idx.doc_frequency("quick") == 2
+        assert idx.term_frequency("quick") == 3
+        assert idx.doc_frequency("the") == 2
+
+    def test_conjunctive_query(self):
+        idx = self._index()
+        assert idx.documents_containing_all(["quick", "dog"]) == [2]
+        assert idx.documents_containing_all([]) == []
+
+    def test_batch_iteration(self):
+        idx = self._index()
+        batches = list(idx.batch_iter(2))
+        assert [len(b) for b in batches] == [2, 1]
+        labels = [l for _, l in idx.each_doc_with_label()]
+        assert labels == ["a", "b", "a"]

@@ -227,3 +227,128 @@ class TestProfilerListener:
         for root, _dirs, files in os.walk(log_dir):
             found.extend(files)
         assert found, "no trace files written"
+
+
+class TestUIComponents:
+    """Component DSL (reference deeplearning4j-ui-components:
+    chart/table/text/div/accordion + styles, JSON wire format)."""
+
+    def _sample_components(self):
+        from deeplearning4j_tpu.ui import (
+            ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+            ChartStackedArea, ChartTimeline, ComponentDiv, ComponentTable,
+            ComponentText, DecoratorAccordion, StyleChart, StyleText,
+        )
+        line = ChartLine("loss", StyleChart(width=400, height=200))
+        line.add_series("train", [0, 1, 2, 3], [1.0, 0.6, 0.4, 0.3])
+        line.add_series("val", [0, 1, 2, 3], [1.1, 0.8, 0.6, 0.55])
+        scatter = ChartScatter("embedding").add_series("pts", [0, 1, 2], [2, 1, 3])
+        hist = (ChartHistogram("weights").add_bin(-1, -0.5, 3)
+                .add_bin(-0.5, 0, 10).add_bin(0, 0.5, 12).add_bin(0.5, 1, 2))
+        bars = (ChartHorizontalBar("per-layer time (ms)")
+                .add_bar("conv1", 4.2).add_bar("dense", 1.1))
+        area = (ChartStackedArea("memory").set_x([0, 1, 2])
+                .add_series("params", [10, 10, 10]).add_series("acts", [5, 9, 7]))
+        tl = ChartTimeline("phases").add_lane("worker0", [
+            {"start": 0.0, "end": 1.5, "label": "etl"},
+            {"start": 1.5, "end": 4.0, "label": "fit"},
+        ])
+        table = ComponentTable(header=["layer", "params"],
+                               content=[["conv1", "9408"], ["dense", "4096"]],
+                               title="model")
+        text = ComponentText("Training report", StyleText(underline=True))
+        acc = DecoratorAccordion("details", default_collapsed=False,
+                                 children=[table])
+        div = ComponentDiv(children=[text, line])
+        return [div, scatter, hist, bars, area, tl, acc]
+
+    def test_json_round_trip_every_component(self):
+        from deeplearning4j_tpu.ui import Component
+
+        for comp in self._sample_components():
+            js = comp.to_json()
+            back = Component.from_json(js)
+            assert type(back) is type(comp)
+            assert back.to_dict() == comp.to_dict()
+
+    def test_render_page_standalone_html(self, tmp_path):
+        from deeplearning4j_tpu.ui import render_page, save_page
+
+        comps = self._sample_components()
+        html_text = render_page(comps, title="Round-trip report")
+        assert html_text.startswith("<!DOCTYPE html>")
+        assert html_text.count("<svg") >= 5
+        assert "polyline" in html_text        # line chart marks
+        assert "circle" in html_text          # scatter marks
+        assert "<table" in html_text and "conv1" in html_text
+        assert "<details open" in html_text   # expanded accordion
+        p = str(tmp_path / "report.html")
+        save_page(comps, p, title="t")
+        assert os.path.getsize(p) > 1000
+
+    def test_restored_component_renders_identically(self):
+        from deeplearning4j_tpu.ui import Component
+
+        for comp in self._sample_components():
+            back = Component.from_json(comp.to_json())
+            assert back.render_html() == comp.render_html()
+
+    def test_series_length_mismatch_raises(self):
+        from deeplearning4j_tpu.ui import ChartLine, ChartStackedArea
+
+        with pytest.raises(ValueError):
+            ChartLine("x").add_series("s", [1, 2], [1])
+        with pytest.raises(ValueError):
+            ChartStackedArea("x").set_x([1, 2]).add_series("s", [1])
+
+
+class TestConvolutionalListener:
+    """reference ConvolutionalIterationListener: activation-grid images of
+    conv layers at a fixed frequency."""
+
+    def test_png_writer_valid_signature_and_size(self, tmp_path):
+        from deeplearning4j_tpu.ui import write_png_gray
+
+        img = (np.arange(20 * 30) % 256).astype(np.uint8).reshape(20, 30)
+        p = write_png_gray(str(tmp_path / "x.png"), img)
+        data = open(p, "rb").read()
+        assert data[:8] == b"\x89PNG\r\n\x1a\n"
+        w, h = np.frombuffer(data[16:24], ">u4")
+        assert (w, h) == (30, 20)
+
+    def test_activation_grid_tiles_channels(self):
+        from deeplearning4j_tpu.ui import activation_grid
+
+        act = np.random.randn(8, 8, 9).astype(np.float32)
+        grid = activation_grid(act)
+        assert grid.dtype == np.uint8
+        # 9 channels -> 3x3 grid of 8x8 tiles + 1px padding
+        assert grid.shape == (3 * 9 + 1, 3 * 9 + 1)
+
+    def test_listener_writes_grids_during_training(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer, OutputLayer,
+        )
+        from deeplearning4j_tpu.ui import ConvolutionalIterationListener
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(7)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=3,
+                                    convolution_mode="same"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        probe = np.random.randn(2, 8, 8, 1).astype(np.float32)
+        lst = ConvolutionalIterationListener(probe, str(tmp_path), frequency=2)
+        net.listeners.append(lst)
+        x = np.random.randn(8, 8, 8, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.randint(0, 3, 8)]
+        net.fit(DataSet(x, y), epochs=2, batch_size=4)
+        pngs = [f for f in os.listdir(tmp_path) if f.endswith(".png")]
+        assert pngs, "no activation grids written"
+        idx = os.path.join(tmp_path, "index.html")
+        assert os.path.exists(idx)
+        assert "<img" in open(idx).read()

@@ -100,6 +100,33 @@ class TestZooSmallInstantiation:
         )
         assert n_convs >= 50
 
+    def test_resnet50_space_to_depth_stem(self):
+        """MLPerf-style TPU stem variant: 2x2 s2d + 4x4/1 conv replaces the
+        7x7/2 conv; identical downstream shapes, trains and predicts."""
+        net = ResNet50(num_classes=6, height=64, width=64,
+                       stem_space_to_depth=True).init()
+        assert "stem_s2d" in net.conf.vertices
+        net.fit(DataSet(_img(2, 64, 64, 3), _onehot(2, 6)), epochs=1)
+        out = net.output_single(_img(1, 64, 64, 3))
+        assert out.shape == (1, 6)
+        assert np.isfinite(float(net.score_))
+
+    def test_resnet50_remat_policy_matches_default(self):
+        """remat_policy="save_conv_outputs" must not change training math —
+        only what the backward pass stores vs recomputes."""
+        def scores(policy):
+            net = ResNet50(num_classes=4, height=32, width=32).init()
+            net.conf.global_conf.remat_policy = policy
+            ds = DataSet(_img(4, 32, 32, 3, seed=3), _onehot(4, 4, seed=3))
+            out = []
+            for _ in range(3):
+                net.fit(ds, epochs=1)
+                out.append(float(net.score_))
+            return out
+
+        a, b = scores(None), scores("save_conv_outputs")
+        np.testing.assert_allclose(a, b, rtol=2e-4)
+
     @pytest.mark.slow
     def test_googlenet_small(self):
         net = GoogLeNet(num_classes=4, height=64, width=64).init()
