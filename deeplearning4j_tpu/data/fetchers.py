@@ -70,7 +70,7 @@ class SvhnDataSetIterator(DataSetIterator):
     def next(self) -> DataSet:
         lo, hi = self._pos, min(self._pos + self.batch_size, len(self.x))
         self._pos = hi
-        return DataSet(self.x[lo:hi], self.y[lo:hi])
+        return self._pp(DataSet(self.x[lo:hi], self.y[lo:hi]))
 
     def reset(self) -> None:
         self._pos = 0
@@ -160,7 +160,7 @@ class TinyImageNetDataSetIterator(DataSetIterator):
     def next(self) -> DataSet:
         lo, hi = self._pos, min(self._pos + self.batch_size, len(self.x))
         self._pos = hi
-        return DataSet(self.x[lo:hi], self.y[lo:hi])
+        return self._pp(DataSet(self.x[lo:hi], self.y[lo:hi]))
 
     def reset(self) -> None:
         self._pos = 0
@@ -247,7 +247,7 @@ class UciSequenceDataSetIterator(DataSetIterator):
     def next(self) -> DataSet:
         lo, hi = self._pos, min(self._pos + self.batch_size, len(self.x))
         self._pos = hi
-        return DataSet(self.x[lo:hi], self.y[lo:hi])
+        return self._pp(DataSet(self.x[lo:hi], self.y[lo:hi]))
 
     def reset(self) -> None:
         self._pos = 0

@@ -30,6 +30,22 @@ class DataSetIterator:
     def next(self) -> DataSet:
         raise NotImplementedError
 
+    def set_pre_processor(self, pp) -> None:
+        """Attach a DataSet pre-processor (normalizer); applied by leaf
+        iterators to every batch (reference ``setPreProcessor``)."""
+        self.pre_processor = pp
+
+    def _pp(self, ds: DataSet) -> DataSet:
+        pp = getattr(self, "pre_processor", None)
+        if pp is None:
+            return ds
+        # normalizers rebind ds.features on the DataSet they're given; a
+        # shallow copy keeps iterators that retain/replay DataSets (replay,
+        # existing-list) from being normalized again every epoch
+        copy = DataSet(ds.features, ds.labels, ds.features_mask,
+                       ds.labels_mask)
+        return pp.pre_process(copy)
+
     def reset(self) -> None:
         raise NotImplementedError
 
@@ -80,10 +96,10 @@ class ListDataSetIterator(DataSetIterator):
         def cut(a):
             return None if a is None else a[lo:hi]
 
-        return DataSet(
+        return self._pp(DataSet(
             self._data.features[lo:hi], cut(self._data.labels),
             cut(self._data.features_mask), cut(self._data.labels_mask),
-        )
+        ))
 
     def reset(self) -> None:
         self._pos = 0
@@ -106,7 +122,7 @@ class ExistingDataSetIterator(DataSetIterator):
     def next(self):
         d = self._ds[self._pos]
         self._pos += 1
-        return d
+        return self._pp(d)
 
     def reset(self):
         self._pos = 0
@@ -131,6 +147,9 @@ class TestDataSetIterator(DataSetIterator):
         self.next_count += 1
         return self.inner.next()
 
+    def set_pre_processor(self, pp) -> None:
+        self.inner.set_pre_processor(pp)
+
     def reset(self):
         self.reset_count += 1
         self.inner.reset()
@@ -154,6 +173,9 @@ class EarlyTerminationDataSetIterator(DataSetIterator):
     def next(self):
         self._count += 1
         return self.inner.next()
+
+    def set_pre_processor(self, pp) -> None:
+        self.inner.set_pre_processor(pp)
 
     def reset(self):
         self._count = 0
@@ -186,6 +208,9 @@ class MultipleEpochsIterator(DataSetIterator):
             raise StopIteration
         return self.inner.next()
 
+    def set_pre_processor(self, pp) -> None:
+        self.inner.set_pre_processor(pp)
+
     def reset(self):
         self._epoch = 0
         self.inner.reset()
@@ -216,8 +241,9 @@ class SamplingDataSetIterator(DataSetIterator):
         def cut(a):
             return None if a is None else a[idx]
 
-        return DataSet(self._data.features[idx], cut(self._data.labels),
-                       cut(self._data.features_mask), cut(self._data.labels_mask))
+        return self._pp(DataSet(
+            self._data.features[idx], cut(self._data.labels),
+            cut(self._data.features_mask), cut(self._data.labels_mask)))
 
     def reset(self):
         self._count = 0
@@ -251,7 +277,7 @@ class BenchmarkDataSetIterator(DataSetIterator):
 
     def next(self):
         self._count += 1
-        return self._example
+        return self._pp(self._example)
 
     def reset(self):
         self._count = 0
@@ -280,6 +306,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._exc: Optional[BaseException] = None
         self._start()
 
+    def set_pre_processor(self, pp) -> None:
+        # applied on the producer thread (inner.next()), overlapping ETL
+        # with device compute like the rest of the prefetch work
+        self.inner.set_pre_processor(pp)
+
     def _start(self):
         def work():
             try:
@@ -307,7 +338,7 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration
         d = self._peek
         self._peek = None
-        return d
+        return self._pp(d)
 
     def shutdown(self):
         """Drain + join the prefetch thread WITHOUT restarting or touching
@@ -358,7 +389,7 @@ class GeneratorDataSetIterator(DataSetIterator):
             raise StopIteration
         d = self._peek
         self._peek = None
-        return d
+        return self._pp(d)
 
     def reset(self):
         self._gen = iter(self._factory())

@@ -175,7 +175,7 @@ class MnistDataSetIterator(DataSetIterator):
         lo = self._pos
         hi = min(lo + self._batch, self._ds.num_examples())
         self._pos = hi
-        return DataSet(self._ds.features[lo:hi], self._ds.labels[lo:hi])
+        return self._pp(DataSet(self._ds.features[lo:hi], self._ds.labels[lo:hi]))
 
     def reset(self):
         self._pos = 0
@@ -262,7 +262,7 @@ class IrisDataSetIterator(DataSetIterator):
     def next(self):
         lo, hi = self._pos, min(self._pos + self._batch, self._ds.num_examples())
         self._pos = hi
-        return DataSet(self._ds.features[lo:hi], self._ds.labels[lo:hi])
+        return self._pp(DataSet(self._ds.features[lo:hi], self._ds.labels[lo:hi]))
 
     def reset(self):
         self._pos = 0

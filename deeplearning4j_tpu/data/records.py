@@ -235,7 +235,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
             )
         x = np.stack(feats).astype(np.float32)
         y = np.stack(labels).astype(np.float32) if labels else None
-        return DataSet(x, y)
+        return self._pp(DataSet(x, y))
 
     def _split(self, rec: List):
         # image record: [ndarray, int label]
@@ -359,7 +359,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                 lmask[i, loff:loff + lt] = 1.0
         if self.alignment == EQUAL_LENGTH:
             fmask = lmask = None
-        return DataSet(x, y, fmask, lmask)
+        return self._pp(DataSet(x, y, fmask, lmask))
 
     def reset(self) -> None:
         self.freader.reset()

@@ -1,0 +1,15 @@
+"""Shared example bootstrap: put the repo root on sys.path and honour
+JAX_PLATFORMS=cpu even when a TPU plugin is ambient (the plugin overrides
+the env var; only the config update reliably selects the CPU backend)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def setup_platform() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

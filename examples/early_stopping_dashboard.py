@@ -1,0 +1,83 @@
+"""Early stopping + observability (reference dl4j-examples
+``EarlyStoppingMNIST`` + the UI server workflow): condition-driven
+training with best-model restore, StatsListener recording into a
+StatsStorage, and a standalone HTML dashboard rendered at the end."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+from deeplearning4j_tpu.updaters import Adam
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((512, 10)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, :3].sum(1) > 0).astype(int)]
+    train = DataSet(x[:384], y[:384])
+    val_it = ListDataSetIterator(DataSet(x[384:], y[384:]), 64)
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+        .list()
+        .layer(DenseLayer(n_out=24, activation="relu"))
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(10))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+
+    storage = InMemoryStatsStorage()
+    net.listeners.append(StatsListener(storage, reporting_frequency=1))
+
+    es_conf = (
+        EarlyStoppingConfiguration.Builder()
+        .score_calculator(DataSetLossCalculator(val_it))
+        .epoch_termination_conditions(
+            MaxEpochsTerminationCondition(60),
+            ScoreImprovementEpochTerminationCondition(8),
+        )
+        .build()
+    )
+    trainer = EarlyStoppingTrainer(
+        es_conf, net, ListDataSetIterator(train, 64)
+    )
+    result = trainer.fit()
+    print(f"terminated: {result.termination_reason} ({result.termination_details})")
+    print(f"best epoch {result.best_model_epoch}, "
+          f"best val score {result.best_model_score:.4f}")
+
+    best = result.best_model
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "dashboard.html")
+        ui = UIServer.get_instance()
+        ui.attach(storage)
+        ui.render(path)
+        size = os.path.getsize(path)
+    print(f"dashboard rendered ({size} bytes)")
+    assert best is not None and size > 2000
+    print("early_stopping_dashboard OK")
+
+
+if __name__ == "__main__":
+    main()

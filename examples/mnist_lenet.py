@@ -1,0 +1,45 @@
+"""LeNet on MNIST: the canonical first workflow (reference
+dl4j-examples ``LeNetMNIST.java``) — build → fit → evaluate →
+checkpoint → restore → predict."""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import LeNet
+from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+
+def main():
+    train_it = MnistDataSetIterator(batch_size=64, train=True, num_examples=512)
+    test_it = MnistDataSetIterator(batch_size=64, train=False, num_examples=256)
+
+    net = LeNet(num_classes=10).init()
+    net.fit(train_it, epochs=3)
+
+    ev = net.evaluate(test_it)
+    print(f"accuracy after 3 epochs: {ev.accuracy():.3f}")
+    print(ev.stats())
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "lenet.zip")
+        ModelSerializer.write_model(net, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        test_it.reset()
+        batch = test_it.next()
+        a = np.asarray(net.output(batch.features))
+        b = np.asarray(restored.output(batch.features))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+    print("checkpoint round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Data-parallel training over the device mesh (reference dl4j-examples
+``MultiGpuLenetMnistExample`` with ``ParallelWrapper``): one jitted SPMD
+train step, batch sharded over the "data" axis, XLA all-reduces the
+gradients over ICI.
+
+On CPU run with an 8-device virtual mesh:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/parallel_training.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.models.lenet import LeNet
+from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMesh
+
+
+def main():
+    n = len(jax.devices())
+    mesh = TrainingMesh(data=n)
+    print(f"mesh: {mesh.shape} over {n} {jax.devices()[0].platform} device(s)")
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16 * max(n, 1) * 4, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, x.shape[0])]
+
+    net = LeNet(num_classes=10).init()
+    wrapper = ParallelWrapper(net, mesh=mesh)
+    wrapper.fit(ListDataSetIterator(DataSet(x, y), batch_size=16 * max(n, 1)),
+                epochs=3)
+    print(f"score after 3 DP epochs: {float(net.score_):.4f}")
+    assert np.isfinite(float(net.score_))
+    print("parallel_training OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""Transfer learning (reference dl4j-examples ``EditLastLayerOthersFrozen``):
+train a base net on task A, freeze the feature layers, swap the output
+head, fine-tune on task B with far fewer steps."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration,
+    TransferLearning,
+)
+from deeplearning4j_tpu.updaters import Adam
+
+
+def blobs(n, centers, seed):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, len(centers), n)
+    x = np.stack([centers[k] for k in y]) + rng.normal(0, 0.3, (n, 4))
+    return x.astype(np.float32), np.eye(len(centers), dtype=np.float32)[y]
+
+
+def main():
+    # task A: 4 classes
+    xa, ya = blobs(256, np.eye(4) * 2.0, seed=0)
+    conf = (
+        NeuralNetConfiguration.builder().seed(1).updater(Adam(2e-2))
+        .list()
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(DenseLayer(n_out=16, activation="relu"))
+        .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    base = MultiLayerNetwork(conf).init()
+    base.fit(DataSet(xa, ya), epochs=40, batch_size=64)
+    print(f"task A accuracy: {base.evaluate(DataSet(xa, ya)).accuracy():.3f}")
+
+    # task B: 3 new classes, same input space — freeze features, new head
+    centers_b = np.array([[2, 2, 0, 0], [0, 0, 2, 2], [2, 0, 2, 0]], float)
+    xb, yb = blobs(256, centers_b, seed=2)
+    ft = (FineTuneConfiguration.Builder()
+          .updater(Adam(2e-2)).seed(3).build())
+    net_b = (
+        TransferLearning.Builder(base)
+        .fine_tune_configuration(ft)
+        .set_feature_extractor(1)          # freeze layers 0..1
+        .remove_output_layer()
+        .add_layer(OutputLayer(n_in=16, n_out=3, activation="softmax",
+                               loss="mcxent"))
+        .build()
+    )
+    net_b.fit(DataSet(xb, yb), epochs=40, batch_size=64)
+    acc_b = net_b.evaluate(DataSet(xb, yb)).accuracy()
+    print(f"task B accuracy (frozen features, new head): {acc_b:.3f}")
+
+    # frozen layers really are frozen
+    for i in (0, 1):
+        for k in base.params_[i]:
+            np.testing.assert_allclose(
+                np.asarray(base.params_[i][k]), np.asarray(net_b.params_[i][k]),
+                err_msg=f"frozen layer {i}/{k} changed")
+    assert acc_b > 0.85
+    print("transfer_learning OK")
+
+
+if __name__ == "__main__":
+    main()

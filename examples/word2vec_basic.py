@@ -1,0 +1,56 @@
+"""Word2Vec on a toy corpus (reference dl4j-examples
+``Word2VecRawTextExample``): builder → fit → similarity / nearest
+words."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator,
+    DefaultTokenizerFactory,
+    Word2Vec,
+)
+
+SENTENCES = [
+    "the king rules the castle",
+    "the queen rules the castle",
+    "the king and the queen sit on thrones",
+    "a dog chases the cat",
+    "the cat runs from the dog",
+    "dogs and cats are animals",
+    "the castle has a king and a queen inside",
+    "animals like the dog and the cat play outside",
+] * 30
+
+
+def main():
+    w2v = (
+        Word2Vec.builder()
+        .iterate(CollectionSentenceIterator(SENTENCES))
+        .tokenizer_factory(DefaultTokenizerFactory())
+        .layer_size(32)
+        .window_size(3)
+        .min_word_frequency(2)
+        .epochs(12)
+        .negative_sample(4)
+        .seed(7)
+        .build()
+        .fit()
+    )
+
+    print("vocab size:", len(w2v.vocab.words()))
+    royal = w2v.similarity("king", "queen")
+    cross = w2v.similarity("king", "cat")
+    print(f"sim(king, queen) = {royal:.3f}   sim(king, cat) = {cross:.3f}")
+    print("nearest to 'dog':", w2v.words_nearest("dog", 3))
+    assert royal > cross, "royal pair should beat cross-domain pair"
+    print("word2vec_basic OK")
+
+
+if __name__ == "__main__":
+    main()

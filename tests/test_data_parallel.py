@@ -178,7 +178,8 @@ class TestParallel:
 
     def test_mesh_shapes(self):
         mesh = TrainingMesh(data=4, model=2)
-        assert mesh.shape == {"data": 4, "model": 2, "pipe": 1, "seq": 1}
+        assert mesh.shape == {"data": 4, "model": 2, "pipe": 1, "seq": 1,
+                              "expert": 1}
         with pytest.raises(ValueError):
             TrainingMesh(data=5)
 
@@ -238,3 +239,66 @@ class TestZoo:
         net = SimpleCNN(num_classes=5, height=48, width=48, channels=3).init()
         out = net.output(np.zeros((2, 48, 48, 3), np.float32))
         assert out.shape == (2, 5)
+
+
+class TestIteratorPreProcessor:
+    """reference DataSetIterator.setPreProcessor: every iterator applies
+    the attached normalizer to each emitted batch, wrappers forward it,
+    and replayed DataSets are never normalized twice."""
+
+    def _base(self):
+        x = np.array([[-1.0], [1.0], [3.0], [5.0]], np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 0, 1, 1]]
+        return DataSet(x, y)
+
+    def test_list_iterator_applies_normalizer(self):
+        ds = self._base()
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        it = ListDataSetIterator(ds, 4)
+        it.set_pre_processor(norm)
+        out = it.next()
+        np.testing.assert_allclose(out.features.mean(), 0.0, atol=1e-6)
+        # source DataSet untouched
+        np.testing.assert_allclose(ds.features[:, 0], [-1, 1, 3, 5])
+
+    def test_no_double_normalization_across_epochs(self):
+        ds = self._base()
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        it = ExistingDataSetIterator([ds])
+        it.set_pre_processor(norm)
+        first = it.next().features.copy()
+        it.reset()
+        second = it.next().features.copy()
+        np.testing.assert_allclose(first, second)
+
+    def test_wrappers_forward_to_leaf(self):
+        ds = self._base()
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        inner = ListDataSetIterator(ds, 2)
+        it = MultipleEpochsIterator(EarlyTerminationDataSetIterator(inner, 10), 2)
+        it.set_pre_processor(norm)
+        batches = [b.features.copy() for b in it]
+        assert len(batches) == 4  # 2 epochs x 2 batches
+        np.testing.assert_allclose(batches[0], batches[2])  # epoch replays equal
+        np.testing.assert_allclose(np.concatenate(batches[:2]).mean(), 0.0,
+                                   atol=1e-6)
+
+    def test_record_reader_iterator_applies_normalizer(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+
+        p = tmp_path / "d.csv"
+        p.write_text("".join(f"{v},{k}\n" for v, k in
+                             [(-1, 0), (1, 0), (3, 1), (5, 1)]))
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)), 4,
+                                         label_index=1, num_possible_labels=2)
+        norm = NormalizerStandardize()
+        norm.fit(it)
+        it.reset()
+        it.set_pre_processor(norm)
+        out = it.next()
+        np.testing.assert_allclose(out.features.mean(), 0.0, atol=1e-6)
