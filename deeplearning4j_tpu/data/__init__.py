@@ -6,14 +6,24 @@ Reference modules: deeplearning4j-data/* (SURVEY.md §2.2).
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     AsyncDataSetIterator,
+    AsyncShieldDataSetIterator,
     BenchmarkDataSetIterator,
+    CombinedPreProcessor,
     DataSetIterator,
+    DataSetIteratorSplitter,
+    DoublesDataSetIterator,
+    DummyPreProcessor,
     EarlyTerminationDataSetIterator,
     ExistingDataSetIterator,
     ExistingMultiDataSetIterator,
+    FileDataSetIterator,
+    FloatsDataSetIterator,
+    IteratorDataSetIterator,
+    JointParallelDataSetIterator,
     ListDataSetIterator,
     MultiDataSetIterator,
     MultipleEpochsIterator,
+    ReconstructionDataSetIterator,
     SamplingDataSetIterator,
     TestDataSetIterator,
 )
@@ -48,4 +58,9 @@ __all__ = [
     "ALIGN_START", "ALIGN_END", "EQUAL_LENGTH",
     "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
     "UciSequenceDataSetIterator",
+    "IteratorDataSetIterator", "DoublesDataSetIterator",
+    "FloatsDataSetIterator", "ReconstructionDataSetIterator",
+    "AsyncShieldDataSetIterator", "DataSetIteratorSplitter",
+    "JointParallelDataSetIterator", "FileDataSetIterator",
+    "DummyPreProcessor", "CombinedPreProcessor",
 ]

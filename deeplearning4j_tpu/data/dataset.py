@@ -28,6 +28,30 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    def save(self, path: str) -> str:
+        """Persist to one file (reference ``DataSet.save``; format here is
+        npz — portable, compressed, loads anywhere numpy does)."""
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez appends it anyway; return the real path
+        arrs = {"features": self.features}
+        for k in ("labels", "features_mask", "labels_mask"):
+            v = getattr(self, k)
+            if v is not None:
+                arrs[k] = v
+        np.savez_compressed(path, **arrs)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "DataSet":
+        """(reference ``DataSet.load``)."""
+        with np.load(path) as z:
+            return DataSet(
+                z["features"],
+                z["labels"] if "labels" in z else None,
+                z["features_mask"] if "features_mask" in z else None,
+                z["labels_mask"] if "labels_mask" in z else None,
+            )
+
     def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
         def cut(a, lo, hi):
             return None if a is None else a[lo:hi]

@@ -443,3 +443,319 @@ class ExistingMultiDataSetIterator(MultiDataSetIterator):
 
     def reset(self):
         self._pos = 0
+
+
+# --------------------------------------------------------------------------
+# pre-processors (reference DummyPreProcessor / CombinedPreProcessor)
+class DummyPreProcessor:
+    """No-op pre-processor (reference ``DummyPreProcessor``)."""
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        return ds
+
+
+class CombinedPreProcessor:
+    """Chain pre-processors in order (reference ``CombinedPreProcessor`` /
+    ``CombinedMultiDataSetPreProcessor``)."""
+
+    def __init__(self, *pre_processors):
+        self.pre_processors = list(pre_processors)
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        for pp in self.pre_processors:
+            ds = pp.pre_process(ds)
+        return ds
+
+
+# --------------------------------------------------------------------------
+# remaining reference iterator combinators
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch a plain python iterable of (small) DataSets into
+    ``batch_size``-example minibatches (reference
+    ``IteratorDataSetIterator``)."""
+
+    def __init__(self, source: Iterable[DataSet], batch_size: int):
+        if callable(source):
+            self._make = source
+        else:
+            # materialize: a one-shot generator would silently yield an
+            # empty stream after the fit loop's per-epoch reset()
+            items = list(source)
+            self._make = lambda: iter(items)
+        self._batch = int(batch_size)
+        self._it = self._make()
+        self._carry: Optional[DataSet] = None
+
+    def _concat(self, parts: List[DataSet]) -> DataSet:
+        def cat(key):
+            arrs = [getattr(p, key) for p in parts]
+            if any(a is None for a in arrs):
+                return None
+            return np.concatenate(arrs, axis=0)
+
+        return DataSet(cat("features"), cat("labels"),
+                       cat("features_mask"), cat("labels_mask"))
+
+    def has_next(self) -> bool:
+        if self._carry is not None:
+            return True
+        try:
+            self._carry = next(self._it)
+            return True
+        except StopIteration:
+            return False
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        parts, n = [], 0
+        while n < self._batch and self.has_next():
+            d, self._carry = self._carry, None
+            parts.append(d)
+            n += d.num_examples()
+        return self._pp(self._concat(parts))
+
+    def reset(self) -> None:
+        self._it = self._make()
+        self._carry = None
+
+    def batch(self) -> int:
+        return self._batch
+
+
+class DoublesDataSetIterator(IteratorDataSetIterator):
+    """Build DataSets from an iterable of (features, labels) float64 pairs
+    (reference ``DoublesDataSetIterator``)."""
+
+    _dtype = np.float64
+
+    def __init__(self, pairs, batch_size: int):
+        pairs = list(pairs)
+        dt = self._dtype
+
+        def gen():
+            for f, l in pairs:
+                yield DataSet(np.asarray(f, dt)[None, :], np.asarray(l, dt)[None, :])
+
+        super().__init__(gen, batch_size)
+
+
+class FloatsDataSetIterator(DoublesDataSetIterator):
+    """(reference ``FloatsDataSetIterator``)."""
+
+    _dtype = np.float32
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """labels := features, for autoencoder/reconstruction training
+    (reference ``ReconstructionDataSetIterator``)."""
+
+    def __init__(self, inner: DataSetIterator):
+        self.inner = inner
+
+    def has_next(self):
+        return self.inner.has_next()
+
+    def next(self):
+        d = self.inner.next()
+        return self._pp(DataSet(d.features, d.features,
+                                d.features_mask, d.features_mask))
+
+    def reset(self):
+        self.inner.reset()
+
+    def batch(self):
+        return self.inner.batch()
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Pass-through that refuses async wrapping (reference
+    ``AsyncShieldDataSetIterator``: protects iterators whose ``next()`` is
+    not thread-safe from the fit loop's auto-async)."""
+
+    def __init__(self, inner: DataSetIterator):
+        self.inner = inner
+
+    def has_next(self):
+        return self.inner.has_next()
+
+    def next(self):
+        return self.inner.next()
+
+    def set_pre_processor(self, pp) -> None:
+        self.inner.set_pre_processor(pp)
+
+    def reset(self):
+        self.inner.reset()
+
+    def batch(self):
+        return self.inner.batch()
+
+    def async_supported(self) -> bool:
+        return False
+
+
+class _SplitViewIterator(DataSetIterator):
+    """A [start, start+count) batch window of a shared source iterator.
+    Each (re)start resets the source and skips the ``start``-batch head,
+    so views survive the fit/evaluate loops' per-epoch ``reset()`` without
+    leaking each other's batches. Views share the source: don't interleave
+    them mid-pass."""
+
+    def __init__(self, inner: DataSetIterator, start: int, count: int):
+        self.inner = inner
+        self.start = int(start)
+        self.count = int(count)
+        self._emitted: Optional[int] = None  # None = head not skipped yet
+
+    def _ensure_positioned(self):
+        if self._emitted is None:
+            self.inner.reset()
+            for _ in range(self.start):
+                if not self.inner.has_next():
+                    break
+                self.inner.next()
+            self._emitted = 0
+
+    def has_next(self) -> bool:
+        self._ensure_positioned()
+        return self._emitted < self.count and self.inner.has_next()
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        self._emitted += 1
+        return self.inner.next()
+
+    def set_pre_processor(self, pp) -> None:
+        self.inner.set_pre_processor(pp)
+
+    def reset(self) -> None:
+        self._emitted = None
+
+    def batch(self) -> int:
+        return self.inner.batch()
+
+
+class DataSetIteratorSplitter:
+    """Split one iterator's stream into a train head and a test tail by
+    batch count (reference ``DataSetIteratorSplitter``: ``totalBatches`` ×
+    ``ratio`` go to train, the rest to test)."""
+
+    def __init__(self, inner: DataSetIterator, total_batches: int, ratio: float):
+        if not 0.0 < ratio < 1.0:
+            raise ValueError(f"ratio must be in (0, 1), got {ratio}")
+        self.inner = inner
+        self.total = int(total_batches)
+        self.n_train = int(self.total * ratio)
+
+    def get_train_iterator(self) -> DataSetIterator:
+        return _SplitViewIterator(self.inner, 0, self.n_train)
+
+    def get_test_iterator(self) -> DataSetIterator:
+        return _SplitViewIterator(self.inner, self.n_train,
+                                  self.total - self.n_train)
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Round-robin interleave over multiple source iterators (reference
+    ``JointParallelDataSetIterator`` + ``InequalityHandling``):
+
+    - "stop_everyone": stop as soon as any source is exhausted
+    - "reset":         reset an exhausted source and keep cycling until the
+                       longest source finishes one full pass
+    - "pass":          skip exhausted sources, drain the rest
+    """
+
+    MODES = ("stop_everyone", "reset", "pass")
+
+    def __init__(self, *iterators: DataSetIterator,
+                 inequality_handling: str = "stop_everyone"):
+        if inequality_handling not in self.MODES:
+            raise ValueError(f"inequality_handling must be one of {self.MODES}")
+        if not iterators:
+            raise ValueError("need at least one source iterator")
+        self.sources = list(iterators)
+        self.mode = inequality_handling
+        self._idx = 0
+        self._done = [False] * len(self.sources)
+
+    def _advance_to_live(self) -> Optional[int]:
+        n = len(self.sources)
+        if self.mode == "reset":
+            # sweep first: a source counts as "completed a pass" the moment
+            # it drains, even if its turn hasn't come — once ALL have, the
+            # epoch ends (no spurious replays for equal-length sources);
+            # until then exhausted sources reset and keep interleaving
+            for i, src in enumerate(self.sources):
+                if not src.has_next():
+                    self._done[i] = True
+            if all(self._done):
+                return None
+            for off in range(n):
+                i = (self._idx + off) % n
+                src = self.sources[i]
+                if not src.has_next():
+                    src.reset()
+                if src.has_next():
+                    return i
+            return None
+        for off in range(n):
+            i = (self._idx + off) % n
+            if self.sources[i].has_next():
+                return i
+            if self.mode == "stop_everyone":
+                return None
+        return None
+
+    def has_next(self) -> bool:
+        return self._advance_to_live() is not None
+
+    def next(self) -> DataSet:
+        i = self._advance_to_live()
+        if i is None:
+            raise StopIteration
+        self._idx = (i + 1) % len(self.sources)
+        return self._pp(self.sources[i].next())
+
+    def reset(self) -> None:
+        for s in self.sources:
+            s.reset()
+        self._idx = 0
+        self._done = [False] * len(self.sources)
+
+    def batch(self) -> int:
+        return self.sources[0].batch()
+
+
+class FileDataSetIterator(DataSetIterator):
+    """Iterate ``DataSet.save``d files from a directory or an explicit
+    path list (reference ``FileDataSetIterator``/``BaseFileIterator``)."""
+
+    def __init__(self, path_or_paths, shuffle: bool = False, seed: int = 0):
+        import os as _os
+
+        if isinstance(path_or_paths, str):
+            paths = sorted(
+                _os.path.join(path_or_paths, f)
+                for f in _os.listdir(path_or_paths)
+                if f.endswith(".npz")
+            )
+        else:
+            paths = list(path_or_paths)
+        if shuffle:
+            rng = np.random.default_rng(seed)
+            paths = [paths[i] for i in rng.permutation(len(paths))]
+        self.paths = paths
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.paths)
+
+    def next(self) -> DataSet:
+        d = DataSet.load(self.paths[self._pos])
+        self._pos += 1
+        return self._pp(d)
+
+    def reset(self) -> None:
+        self._pos = 0

@@ -6,6 +6,7 @@ SingleMachine.java``, SURVEY.md §4.5) — here DP-vs-single-device must agree
 because SPMD all-reduce of a mean IS the single-device gradient.
 """
 
+import os
 import threading
 
 import jax
@@ -300,5 +301,131 @@ class TestIteratorPreProcessor:
         norm.fit(it)
         it.reset()
         it.set_pre_processor(norm)
+        out = it.next()
+        np.testing.assert_allclose(out.features.mean(), 0.0, atol=1e-6)
+
+
+class TestIteratorCombinatorTail:
+    """Remaining reference utility-iterators (SURVEY §2.2):
+    IteratorDataSetIterator, Doubles/Floats, Reconstruction, AsyncShield,
+    Splitter, JointParallel, FileDataSetIterator + DataSet.save/load."""
+
+    def test_iterator_rebatching(self):
+        from deeplearning4j_tpu.data import IteratorDataSetIterator
+
+        smalls = _blobs(10).batch_by(2)  # five 2-example DataSets
+        it = IteratorDataSetIterator(smalls, batch_size=4)
+        sizes = [d.num_examples() for d in it]
+        assert sizes == [4, 4, 2]
+        it.reset()
+        assert [d.num_examples() for d in it] == [4, 4, 2]
+        # one-shot generator input: reset must still replay (materialized)
+        gen_it = IteratorDataSetIterator((d for d in _blobs(8).batch_by(2)), 4)
+        assert [d.num_examples() for d in gen_it] == [4, 4]
+        gen_it.reset()
+        assert [d.num_examples() for d in gen_it] == [4, 4]
+
+    def test_doubles_floats(self):
+        from deeplearning4j_tpu.data import (
+            DoublesDataSetIterator, FloatsDataSetIterator,
+        )
+
+        pairs = [([1.0, 2.0], [1.0, 0.0]), ([3.0, 4.0], [0.0, 1.0]),
+                 ([5.0, 6.0], [1.0, 0.0])]
+        d_it = DoublesDataSetIterator(pairs, 2)
+        first = d_it.next()
+        assert first.features.dtype == np.float64
+        assert first.features.shape == (2, 2)
+        f_it = FloatsDataSetIterator(pairs, 3)
+        assert f_it.next().features.dtype == np.float32
+
+    def test_reconstruction(self):
+        from deeplearning4j_tpu.data import ReconstructionDataSetIterator
+
+        it = ReconstructionDataSetIterator(ListDataSetIterator(_blobs(8), 8))
+        d = it.next()
+        np.testing.assert_array_equal(d.features, d.labels)
+
+    def test_async_shield(self):
+        from deeplearning4j_tpu.data import AsyncShieldDataSetIterator
+
+        it = AsyncShieldDataSetIterator(ListDataSetIterator(_blobs(8), 4))
+        assert not it.async_supported()
+        assert sum(1 for _ in it) == 2
+
+    def test_splitter(self):
+        from deeplearning4j_tpu.data import DataSetIteratorSplitter
+
+        inner = ListDataSetIterator(_blobs(80), 8)  # 10 batches
+        sp = DataSetIteratorSplitter(inner, total_batches=10, ratio=0.7)
+        train_it = sp.get_train_iterator()
+        train = [d.features.copy() for d in train_it]
+        test_it = sp.get_test_iterator()
+        test = [d.features.copy() for d in test_it]
+        assert len(train) == 7 and len(test) == 3
+        # no leakage: test batches disjoint from every train batch
+        for t in test:
+            assert not any(np.array_equal(t, tr) for tr in train)
+        # views survive reset() (the fit/evaluate loops reset per epoch)
+        train_it.reset()
+        test_it.reset()
+        train2 = [d.features.copy() for d in train_it]
+        test2 = [d.features.copy() for d in test_it]
+        np.testing.assert_array_equal(train2[0], train[0])
+        np.testing.assert_array_equal(test2[0], test[0])
+        with pytest.raises(ValueError):
+            DataSetIteratorSplitter(inner, 10, 1.5)
+
+    def test_joint_parallel_modes(self):
+        from deeplearning4j_tpu.data import JointParallelDataSetIterator
+
+        def srcs(n1, n2):
+            return (ListDataSetIterator(_blobs(n1 * 4, seed=1), 4),
+                    ListDataSetIterator(_blobs(n2 * 4, seed=2), 4))
+
+        stop = JointParallelDataSetIterator(*srcs(2, 4))
+        assert sum(1 for _ in stop) == 4  # a b a b, then a's turn -> dry
+        drain = JointParallelDataSetIterator(*srcs(2, 4),
+                                             inequality_handling="pass")
+        assert sum(1 for _ in drain) == 6
+        rst = JointParallelDataSetIterator(*srcs(2, 4),
+                                           inequality_handling="reset")
+        # short source replays until the long one finishes: a b a b a b a b
+        assert sum(1 for _ in rst) == 8
+        # equal-length sources: exactly one pass each, no spurious replay
+        eq = JointParallelDataSetIterator(*srcs(2, 2),
+                                          inequality_handling="reset")
+        assert sum(1 for _ in eq) == 4
+
+    def test_dataset_save_load_and_file_iterator(self, tmp_path):
+        from deeplearning4j_tpu.data import FileDataSetIterator
+
+        batches = _blobs(12).batch_by(4)
+        for i, b in enumerate(batches):
+            # extension-less path: save() must append .npz and return the
+            # real on-disk path
+            real = b.save(str(tmp_path / f"part{i}"))
+            assert real.endswith(".npz") and os.path.exists(real)
+        it = FileDataSetIterator(str(tmp_path))
+        loaded = list(it)
+        assert len(loaded) == 3
+        np.testing.assert_array_equal(loaded[0].features, batches[0].features)
+        np.testing.assert_array_equal(loaded[0].labels, batches[0].labels)
+        # masked sequence round-trip
+        ds = DataSet(np.zeros((2, 3, 1), np.float32), np.ones((2, 3, 1), np.float32),
+                     np.ones((2, 3), np.float32), np.ones((2, 3), np.float32))
+        p = str(tmp_path / "seq.npz")
+        ds.save(p)
+        back = DataSet.load(p)
+        assert back.features_mask is not None and back.labels_mask.shape == (2, 3)
+
+    def test_combined_and_dummy_preprocessor(self):
+        from deeplearning4j_tpu.data import CombinedPreProcessor, DummyPreProcessor
+
+        ds = _blobs(16)
+        norm = NormalizerStandardize()
+        norm.fit(ds)
+        it = ListDataSetIterator(ds, 16)
+        it.set_pre_processor(CombinedPreProcessor(DummyPreProcessor(), norm))
         out = it.next()
         np.testing.assert_allclose(out.features.mean(), 0.0, atol=1e-6)
