@@ -487,14 +487,27 @@ class IteratorDataSetIterator(DataSetIterator):
         self._carry: Optional[DataSet] = None
 
     def _concat(self, parts: List[DataSet]) -> DataSet:
-        def cat(key):
+        def cat(key, ones_like_key=None):
             arrs = [getattr(p, key) for p in parts]
-            if any(a is None for a in arrs):
+            if all(a is None for a in arrs):
                 return None
+            if any(a is None for a in arrs):
+                if ones_like_key is None:
+                    return None
+                # mixed masked/unmasked parts: unmasked ones are fully
+                # valid — synthesize all-ones masks (reference
+                # DataSet.merge semantics) instead of dropping the mask
+                arrs = [
+                    a if a is not None
+                    else np.ones(getattr(p, ones_like_key).shape[:2],
+                                 np.float32)
+                    for a, p in zip(arrs, parts)
+                ]
             return np.concatenate(arrs, axis=0)
 
         return DataSet(cat("features"), cat("labels"),
-                       cat("features_mask"), cat("labels_mask"))
+                       cat("features_mask", "features"),
+                       cat("labels_mask", "labels"))
 
     def has_next(self) -> bool:
         if self._carry is not None:
@@ -625,10 +638,9 @@ class _SplitViewIterator(DataSetIterator):
         if not self.has_next():
             raise StopIteration
         self._emitted += 1
-        return self.inner.next()
-
-    def set_pre_processor(self, pp) -> None:
-        self.inner.set_pre_processor(pp)
+        # the view's OWN pre-processor (not the shared source's): train
+        # and test views commonly carry different processors
+        return self._pp(self.inner.next())
 
     def reset(self) -> None:
         self._emitted = None
@@ -700,12 +712,16 @@ class JointParallelDataSetIterator(DataSetIterator):
                 if src.has_next():
                     return i
             return None
+        if self.mode == "stop_everyone":
+            # stop as soon as ANY source is exhausted, regardless of whose
+            # turn it is
+            if any(not s.has_next() for s in self.sources):
+                return None
+            return self._idx
         for off in range(n):
             i = (self._idx + off) % n
             if self.sources[i].has_next():
                 return i
-            if self.mode == "stop_everyone":
-                return None
         return None
 
     def has_next(self) -> bool:

@@ -37,7 +37,8 @@ Array = jax.Array
 class TransformerLMConfig:
     def __init__(self, vocab_size: int, d_model: int = 256, n_heads: int = 4,
                  n_layers: int = 4, mlp_ratio: int = 4, max_length: int = 512,
-                 seed: int = 0):
+                 seed: int = 0, n_experts: int = 0, top_k: int = 2,
+                 capacity_factor: float = 1.25, aux_loss_weight: float = 1e-2):
         if d_model % n_heads:
             raise ValueError("d_model must be divisible by n_heads")
         self.vocab_size = int(vocab_size)
@@ -47,6 +48,13 @@ class TransformerLMConfig:
         self.mlp_ratio = int(mlp_ratio)
         self.max_length = int(max_length)
         self.seed = int(seed)
+        # MoE: n_experts > 0 replaces every block's dense FFN with a
+        # GShard dense-dispatch mixture (homogeneous stack keeps the
+        # scan/pipeline param layout); 0 = dense
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_loss_weight = float(aux_loss_weight)
 
     def to_dict(self):
         return dict(self.__dict__)
@@ -67,27 +75,47 @@ def init_params(cfg: TransformerLMConfig, rng: Optional[Array] = None,
     def w(key, shape, fan_in):
         return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
 
+    blocks = {
+        "ln1_g": jnp.ones((L, d), dtype), "ln1_b": jnp.zeros((L, d), dtype),
+        "Wq": w(ks[2], (L, d, d), d), "Wk": w(ks[3], (L, d, d), d),
+        "Wv": w(ks[4], (L, d, d), d), "Wo": w(ks[5], (L, d, d), d),
+        "bo": jnp.zeros((L, d), dtype),
+        "ln2_g": jnp.ones((L, d), dtype), "ln2_b": jnp.zeros((L, d), dtype),
+    }
+    if cfg.n_experts > 0:
+        E = cfg.n_experts
+        kg, k1, k2 = jax.random.split(ks[6], 3)
+        blocks.update({
+            "Wg": w(kg, (L, d, E), d),
+            "W1": w(k1, (L, E, d, h), d), "b1": jnp.zeros((L, E, h), dtype),
+            "W2": w(k2, (L, E, h, d), h), "b2": jnp.zeros((L, E, d), dtype),
+        })
+    else:
+        blocks.update({
+            "W1": w(ks[6], (L, d, h), d), "b1": jnp.zeros((L, h), dtype),
+            "W2": w(ks[7], (L, h, d), h), "b2": jnp.zeros((L, d), dtype),
+        })
     return {
         "embed": 0.02 * jax.random.normal(ks[0], (V, d), dtype),
         "pos": 0.02 * jax.random.normal(ks[1], (cfg.max_length, d), dtype),
-        "blocks": {
-            "ln1_g": jnp.ones((L, d), dtype), "ln1_b": jnp.zeros((L, d), dtype),
-            "Wq": w(ks[2], (L, d, d), d), "Wk": w(ks[3], (L, d, d), d),
-            "Wv": w(ks[4], (L, d, d), d), "Wo": w(ks[5], (L, d, d), d),
-            "bo": jnp.zeros((L, d), dtype),
-            "ln2_g": jnp.ones((L, d), dtype), "ln2_b": jnp.zeros((L, d), dtype),
-            "W1": w(ks[6], (L, d, h), d), "b1": jnp.zeros((L, h), dtype),
-            "W2": w(ks[7], (L, h, d), h), "b2": jnp.zeros((L, d), dtype),
-        },
+        "blocks": blocks,
         "lnf_g": jnp.ones((d,), dtype), "lnf_b": jnp.zeros((d,), dtype),
         "head": w(ks[8], (d, V), d),
     }
 
 
+def _moe_capacity(cfg: TransformerLMConfig, n_tokens: int) -> int:
+    from deeplearning4j_tpu.nn.conf.layers.moe import moe_capacity
+
+    return moe_capacity(n_tokens, cfg.capacity_factor, cfg.top_k,
+                        cfg.n_experts)
+
+
 def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
-                attn_fn=None) -> Array:
+                attn_fn=None):
     """One pre-LN block on (b, T, d); bp holds UNSTACKED (single-layer)
-    params. ``attn_fn`` defaults to dense attention (ring under SP)."""
+    params. ``attn_fn`` defaults to dense attention (ring under SP).
+    Dense FFN → returns x. MoE (cfg.n_experts > 0) → returns (x, aux)."""
     b, T, d = x.shape
     hn = cfg.n_heads
     a_in = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
@@ -101,32 +129,58 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     o = o.transpose(0, 2, 1, 3).reshape(b, T, d)
     x = x + o @ bp["Wo"] + bp["bo"]
     m_in = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+    if cfg.n_experts > 0:
+        from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
+
+        y2, aux = _moe_ffn(
+            {k2: bp[k2] for k2 in ("Wg", "W1", "b1", "W2", "b2")},
+            m_in.reshape(b * T, d), jax.nn.gelu,
+            _moe_capacity(cfg, b * T), cfg.top_k,
+        )
+        return x + y2.reshape(b, T, d), aux
     h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
     return x + h @ bp["W2"] + bp["b2"]
 
 
 def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
-            attn_fn=None, pos_offset: int = 0) -> Array:
-    """ids (b, T) int32 → logits (b, T, V). Single-device path: blocks via
-    lax.scan over the stacked layer axis."""
+            attn_fn=None, pos_offset: int = 0, return_aux: bool = False):
+    """ids (b, T) int32 → logits (b, T, V) [, total MoE aux loss].
+    Single-device path: blocks via lax.scan over the stacked layer axis."""
     x = params["embed"][ids] + params["pos"][pos_offset:pos_offset + ids.shape[1]][None]
 
-    def body(x, bp):
-        return block_apply(cfg, bp, x, attn_fn=attn_fn), None
+    if cfg.n_experts > 0:
+        def body(carry, bp):
+            x, aux = carry
+            x, a = block_apply(cfg, bp, x, attn_fn=attn_fn)
+            return (x, aux + a), None
 
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+        (x, aux), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["blocks"])
+    else:
+        def body(x, bp):
+            return block_apply(cfg, bp, x, attn_fn=attn_fn), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+        aux = jnp.zeros((), jnp.float32)
     x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-    return x @ params["head"]
+    logits = x @ params["head"]
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 def lm_loss(cfg: TransformerLMConfig, params, ids, targets, attn_fn=None):
-    """Mean next-token cross-entropy. targets (b, T) int32 (-1 = ignore)."""
-    logits = forward(cfg, params, ids, attn_fn=attn_fn)
+    """Mean next-token cross-entropy (+ weighted MoE aux loss when MoE).
+    targets (b, T) int32 (-1 = ignore)."""
+    logits, aux = forward(cfg, params, ids, attn_fn=attn_fn, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     valid = (targets >= 0).astype(logits.dtype)
     tgt = jnp.maximum(targets, 0)
     nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.aux_loss_weight * aux
+    return loss
 
 
 class TransformerLM(ZooModel):
@@ -137,11 +191,14 @@ class TransformerLM(ZooModel):
 
     def __init__(self, vocab_size: int = 1000, d_model: int = 256,
                  n_heads: int = 4, n_layers: int = 4, mlp_ratio: int = 4,
-                 max_length: int = 512, seed: int = 123, **kwargs):
+                 max_length: int = 512, seed: int = 123, n_experts: int = 0,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 aux_loss_weight: float = 1e-2, **kwargs):
         super().__init__(num_classes=vocab_size, seed=seed, **kwargs)
         self.cfg = TransformerLMConfig(
             vocab_size, d_model, n_heads, n_layers, mlp_ratio, max_length,
-            seed=seed,
+            seed=seed, n_experts=n_experts, top_k=top_k,
+            capacity_factor=capacity_factor, aux_loss_weight=aux_loss_weight,
         )
         self.params_: Optional[Dict] = None
         self.opt_state_: Optional[Dict] = None

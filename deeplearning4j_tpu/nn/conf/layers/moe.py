@@ -31,6 +31,14 @@ from deeplearning4j_tpu.nn.conf.layers.attention import TransformerBlock, _layer
 from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayer
 
 
+def moe_capacity(n_tokens: int, capacity_factor: float, top_k: int,
+                 n_experts: int) -> int:
+    """GShard per-expert slot count: ceil(tokens * cf * k / E), min 1 —
+    the ONE place the capacity policy lives (layers and TransformerLM
+    both route through it)."""
+    return max(1, math.ceil(n_tokens * capacity_factor * top_k / n_experts))
+
+
 def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
     """Top-k dense dispatch (GShard): returns (dispatch [S,E,C] 0/1,
     combine [S,E,C] gate-weighted, aux_loss scalar fp32).
@@ -110,8 +118,8 @@ class _MoEParamsMixin:
         }
 
     def _capacity(self, n_tokens: int) -> int:
-        return max(1, math.ceil(n_tokens * self.capacity_factor * self.top_k
-                                / self.n_experts))
+        return moe_capacity(n_tokens, self.capacity_factor, self.top_k,
+                            self.n_experts)
 
 
 @serde.register

@@ -48,18 +48,32 @@ Array = jax.Array
 def param_pspecs(cfg: TransformerLMConfig) -> Dict:
     """PartitionSpecs: blocks stack over "pipe"; TP (Megatron) over
     "model" — Wq/Wk/Wv/W1 column-parallel (output dim), Wo/W2
-    row-parallel (input dim); embeddings/head replicated."""
-    return {
-        "embed": P(), "pos": P(),
-        "blocks": {
-            "ln1_g": P("pipe"), "ln1_b": P("pipe"),
-            "Wq": P("pipe", None, "model"), "Wk": P("pipe", None, "model"),
-            "Wv": P("pipe", None, "model"),
-            "Wo": P("pipe", "model", None), "bo": P("pipe"),
-            "ln2_g": P("pipe"), "ln2_b": P("pipe"),
+    row-parallel (input dim); embeddings/head replicated. MoE FFNs
+    (cfg.n_experts > 0): expert dim over "expert" (EP), hidden dim still
+    over "model" — EP and TP compose within each expert."""
+    blocks = {
+        "ln1_g": P("pipe"), "ln1_b": P("pipe"),
+        "Wq": P("pipe", None, "model"), "Wk": P("pipe", None, "model"),
+        "Wv": P("pipe", None, "model"),
+        "Wo": P("pipe", "model", None), "bo": P("pipe"),
+        "ln2_g": P("pipe"), "ln2_b": P("pipe"),
+    }
+    if cfg.n_experts > 0:
+        blocks.update({
+            "Wg": P("pipe", None, None),
+            "W1": P("pipe", "expert", None, "model"),
+            "b1": P("pipe", "expert", "model"),
+            "W2": P("pipe", "expert", "model", None),
+            "b2": P("pipe", "expert", None),
+        })
+    else:
+        blocks.update({
             "W1": P("pipe", None, "model"), "b1": P("pipe", "model"),
             "W2": P("pipe", "model", None), "b2": P("pipe"),
-        },
+        })
+    return {
+        "embed": P(), "pos": P(),
+        "blocks": blocks,
         "lnf_g": P(), "lnf_b": P(), "head": P(),
     }
 
@@ -78,6 +92,20 @@ class DistributedLMTrainer:
             raise ValueError(
                 f"n_layers {self.cfg.n_layers} not divisible by pipe axis {pp}"
             )
+        if self.cfg.n_experts > 0:
+            ep = mesh.shape.get("expert", 1)
+            if self.cfg.n_experts % max(ep, 1):
+                raise ValueError(
+                    f"n_experts {self.cfg.n_experts} not divisible by "
+                    f"expert axis {ep}"
+                )
+            if pp > 1:
+                raise ValueError(
+                    "MoE + pipeline parallelism is not supported: the "
+                    "GPipe schedule cannot carry the per-stage aux loss; "
+                    "compose EP with data/model/seq axes instead "
+                    "(the GShard layout)"
+                )
         self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
         self._step = None
 
@@ -101,7 +129,20 @@ class DistributedLMTrainer:
                     q, k, v, axis_name="seq", causal=causal, mask=mask
                 )
 
+        moe = cfg.n_experts > 0
+
         def stack_scan(bp_local, x):
+            """Dense: x → x. MoE: x → (x, summed aux loss)."""
+            if moe:
+                def body(carry, bp):
+                    x, aux = carry
+                    x, a = block_apply(cfg, bp, x, attn_fn=attn_fn)
+                    return (x, aux + a), None
+
+                (x, aux), _ = jax.lax.scan(
+                    body, (x, jnp.zeros((), jnp.float32)), bp_local)
+                return x, aux
+
             def body(x, bp):
                 return block_apply(cfg, bp, x, attn_fn=attn_fn), None
 
@@ -112,6 +153,24 @@ class DistributedLMTrainer:
             return stack_scan
 
         if pp == 1:  # SP only: manual over seq, blocks replicated
+            if moe:
+                # each seq shard routes its own tokens (local capacity);
+                # aux is averaged over shards
+                def sp_body(bp_local, x):
+                    x, aux = stack_scan(bp_local, x)
+                    return x, jax.lax.pmean(aux, "seq")
+
+                def blocks_fn(bp, x):
+                    specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
+                    return jax.shard_map(
+                        sp_body, mesh=mesh.mesh, axis_names={"seq"},
+                        in_specs=(specs_b, P(None, "seq", None)),
+                        out_specs=(P(None, "seq", None), P()),
+                        check_vma=False,
+                    )(bp, x)
+
+                return blocks_fn
+
             def blocks_fn(bp, x):
                 specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
                 return jax.shard_map(
@@ -191,17 +250,22 @@ class DistributedLMTrainer:
     def _loss_fn(self):
         cfg = self.cfg
         blocks_fn = self._blocks_fn()
+        moe = cfg.n_experts > 0
 
         def loss(params, ids, targets):
             x = params["embed"][ids] + params["pos"][: ids.shape[1]][None]
-            x = blocks_fn(params["blocks"], x)
+            out = blocks_fn(params["blocks"], x)
+            x, aux = out if moe else (out, None)
             x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
             logits = x @ params["head"]
             logp = jax.nn.log_softmax(logits, axis=-1)
             valid = (targets >= 0).astype(logits.dtype)
             tgt = jnp.maximum(targets, 0)
             nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            return jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+            l = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+            if moe:
+                l = l + cfg.aux_loss_weight * aux
+            return l
 
         return loss
 

@@ -383,8 +383,11 @@ class TestIteratorCombinatorTail:
             return (ListDataSetIterator(_blobs(n1 * 4, seed=1), 4),
                     ListDataSetIterator(_blobs(n2 * 4, seed=2), 4))
 
+        # stop the moment ANY source drains, regardless of turn order
         stop = JointParallelDataSetIterator(*srcs(2, 4))
-        assert sum(1 for _ in stop) == 4  # a b a b, then a's turn -> dry
+        assert sum(1 for _ in stop) == 3  # a0 b0 a1 -> a dry -> stop
+        stop2 = JointParallelDataSetIterator(*srcs(4, 2))
+        assert sum(1 for _ in stop2) == 4  # a0 b0 a1 b1 -> b dry -> stop
         drain = JointParallelDataSetIterator(*srcs(2, 4),
                                              inequality_handling="pass")
         assert sum(1 for _ in drain) == 6
@@ -418,6 +421,42 @@ class TestIteratorCombinatorTail:
         ds.save(p)
         back = DataSet.load(p)
         assert back.features_mask is not None and back.labels_mask.shape == (2, 3)
+
+    def test_splitter_views_have_independent_preprocessors(self):
+        from deeplearning4j_tpu.data import DataSetIteratorSplitter
+
+        class AddOne:
+            def pre_process(self, ds):
+                ds.features = ds.features + 1.0
+                return ds
+
+        inner = ListDataSetIterator(_blobs(40), 8)  # 5 batches
+        sp = DataSetIteratorSplitter(inner, 5, 0.6)
+        tr, te = sp.get_train_iterator(), sp.get_test_iterator()
+        tr.set_pre_processor(AddOne())  # train only
+        raw_first = _blobs(40).features[:8]
+        np.testing.assert_allclose(tr.next().features, raw_first + 1.0)
+        # test view untouched by the train view's processor
+        t = list(te)
+        assert len(t) == 2
+        np.testing.assert_allclose(t[0].features,
+                                   _blobs(40).features[24:32])
+
+    def test_rebatch_mixed_mask_parts_get_all_ones(self):
+        from deeplearning4j_tpu.data import IteratorDataSetIterator
+
+        masked = DataSet(np.zeros((2, 4, 3), np.float32),
+                         np.zeros((2, 4, 2), np.float32),
+                         np.array([[1, 1, 0, 0], [1, 1, 1, 0]], np.float32),
+                         np.array([[1, 1, 0, 0], [1, 1, 1, 0]], np.float32))
+        unmasked = DataSet(np.ones((2, 4, 3), np.float32),
+                           np.ones((2, 4, 2), np.float32))
+        it = IteratorDataSetIterator([masked, unmasked], 4)
+        out = it.next()
+        assert out.features_mask is not None
+        np.testing.assert_allclose(out.features_mask[2:], 1.0)
+        np.testing.assert_allclose(out.features_mask[:2],
+                                   masked.features_mask)
 
     def test_combined_and_dummy_preprocessor(self):
         from deeplearning4j_tpu.data import CombinedPreProcessor, DummyPreProcessor
