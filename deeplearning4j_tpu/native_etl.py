@@ -39,13 +39,13 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) and os.path.exists(
-            os.path.join(_NATIVE_DIR, "Makefile")
-        ):
+        if os.path.exists(os.path.join(_NATIVE_DIR, "Makefile")):
             import warnings
 
             try:
-                # one-time build; subsequent loads hit the cached .so.
+                # make is incremental (target depends on etl.cpp), so run
+                # it unconditionally: a stale .so from an older source
+                # would otherwise silently lack newer kernels forever.
                 # Build failures are REPORTED (the numpy fallback keeps
                 # things working, but silently-slow is a debugging trap).
                 subprocess.run(
@@ -58,14 +58,16 @@ def _load() -> Optional[ctypes.CDLL]:
                     f"stderr: {e.stderr.decode(errors='replace')[-400:]}",
                     stacklevel=3,
                 )
-                return None
+                if not os.path.exists(_SO_PATH):
+                    return None
             except (OSError, subprocess.SubprocessError) as e:
                 warnings.warn(
                     f"native ETL build unavailable ({e}); using numpy "
                     "fallbacks",
                     stacklevel=3,
                 )
-                return None
+                if not os.path.exists(_SO_PATH):
+                    return None
         if not os.path.exists(_SO_PATH):
             return None
         try:
@@ -85,6 +87,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.parse_floats.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                      ctypes.c_char, c_f32p, ctypes.c_int64]
         lib.parse_floats.restype = ctypes.c_int64
+        try:  # NLP batch kernels (added after the first .so shipped —
+            # a stale build simply keeps the numpy fallbacks for these)
+            lib.skipgram_pairs_i32.argtypes = [c_i32p, ctypes.c_int64,
+                                               c_i32p, c_i32p, c_i32p]
+            lib.skipgram_pairs_i32.restype = ctypes.c_int64
+            lib.cbow_windows_i32.argtypes = [c_i32p, ctypes.c_int64, c_i32p,
+                                             ctypes.c_int64, c_i32p, c_f32p]
+        except AttributeError:
+            lib.skipgram_pairs_i32 = None
+            lib.cbow_windows_i32 = None
         _lib = lib
         return _lib
 
@@ -155,3 +167,59 @@ def parse_float_line(line: str, delim: str = ",",
         if n < max_values:
             return out[:n].copy()
         max_values *= 2
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def skipgram_pairs(ids: np.ndarray, half_windows: np.ndarray):
+    """(centers, contexts) int32 pairs with per-position window shrink —
+    the reference's native AggregateSkipGram batch-building role. Numpy/
+    Python fallback matches exactly."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    half_windows = np.ascontiguousarray(half_windows, np.int32)
+    n = ids.size
+    if n < 2:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32)
+    lib = _load()
+    if lib is not None and getattr(lib, "skipgram_pairs_i32", None) is not None:
+        cap = int(2 * n * max(int(half_windows.max()), 1))
+        cs = np.empty((cap,), np.int32)
+        xs = np.empty((cap,), np.int32)
+        k = lib.skipgram_pairs_i32(_i32ptr(ids), n, _i32ptr(half_windows),
+                                   _i32ptr(cs), _i32ptr(xs))
+        return cs[:k].copy(), xs[:k].copy()
+    cs_l, xs_l = [], []
+    for i in range(n):
+        b = int(half_windows[i])
+        lo, hi = max(0, i - b), min(n, i + b + 1)
+        for j in range(lo, hi):
+            if j != i:
+                cs_l.append(ids[i])
+                xs_l.append(ids[j])
+    return np.asarray(cs_l, np.int32), np.asarray(xs_l, np.int32)
+
+
+def cbow_windows(ids: np.ndarray, half_windows: np.ndarray, width: int):
+    """Left-packed CBOW context windows: (ctx (n, width) int32,
+    mask (n, width) float32)."""
+    ids = np.ascontiguousarray(ids, np.int32)
+    half_windows = np.ascontiguousarray(half_windows, np.int32)
+    n = ids.size
+    ctx = np.zeros((n, width), np.int32)
+    mask = np.zeros((n, width), np.float32)
+    if n < 2:
+        return ctx, mask
+    lib = _load()
+    if lib is not None and getattr(lib, "cbow_windows_i32", None) is not None:
+        lib.cbow_windows_i32(_i32ptr(ids), n, _i32ptr(half_windows), width,
+                             _i32ptr(ctx), _fptr(mask))
+        return ctx, mask
+    for i in range(n):
+        b = int(half_windows[i])
+        js = [j for j in range(max(0, i - b), min(n, i + b + 1)) if j != i]
+        js = js[:width]
+        ctx[i, :len(js)] = ids[js]
+        mask[i, :len(js)] = 1.0
+    return ctx, mask

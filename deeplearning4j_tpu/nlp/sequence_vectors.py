@@ -123,36 +123,28 @@ class SequenceVectors:
 
     def _skipgram_pairs(self, ids: np.ndarray):
         """(centers, contexts) with per-position random window shrink
-        (word2vec's b ~ U[1, window])."""
+        (word2vec's b ~ U[1, window]); the hot host loop runs in C++
+        (native_etl.skipgram_pairs, reference AggregateSkipGram role)."""
+        from deeplearning4j_tpu import native_etl
+
         n = len(ids)
-        cs, xs = [], []
         if n < 2:
             return np.zeros(0, np.int32), np.zeros(0, np.int32)
         bs = self._host_rng.integers(1, self.window + 1, n)
-        for i in range(n):
-            b = bs[i]
-            lo, hi = max(0, i - b), min(n, i + b + 1)
-            for j in range(lo, hi):
-                if j != i:
-                    cs.append(ids[i])
-                    xs.append(ids[j])
-        return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+        return native_etl.skipgram_pairs(ids, bs)
 
     def _cbow_windows(self, ids: np.ndarray):
-        """(contexts (n, 2*window), ctx_mask, targets) per position."""
+        """(contexts (n, 2*window), ctx_mask, targets) per position; C++
+        window packing via native_etl.cbow_windows."""
+        from deeplearning4j_tpu import native_etl
+
         n = len(ids)
         W = 2 * self.window
         if n < 2:
             return (np.zeros((0, W), np.int32), np.zeros((0, W), np.float32),
                     np.zeros(0, np.int32))
-        ctx = np.zeros((n, W), np.int32)
-        cm = np.zeros((n, W), np.float32)
         bs = self._host_rng.integers(1, self.window + 1, n)
-        for i in range(n):
-            b = bs[i]
-            js = [j for j in range(max(0, i - b), min(n, i + b + 1)) if j != i]
-            ctx[i, :len(js)] = ids[js]
-            cm[i, :len(js)] = 1.0
+        ctx, cm = native_etl.cbow_windows(ids, bs, W)
         return ctx, cm, np.asarray(ids, np.int32)
 
     # ------------------------------------------------------------------- fit

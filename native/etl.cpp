@@ -75,4 +75,50 @@ int64_t parse_floats(const char* buf, int64_t len, char delim,
     return count;
 }
 
+// Skip-gram (center, context) pair generation with per-position window
+// shrink b ~ U[1, window] (word2vec semantics; the reference builds these
+// batches natively via AggregateSkipGram, SURVEY.md §2.9). half_windows
+// holds the drawn b per position. Caller sizes the out buffers as
+// n * 2 * max(half_windows); returns the number of pairs written.
+int64_t skipgram_pairs_i32(const int32_t* ids, int64_t n,
+                           const int32_t* half_windows,
+                           int32_t* out_centers, int32_t* out_contexts) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t b = half_windows[i];
+        const int64_t lo = i - b < 0 ? 0 : i - b;
+        const int64_t hi = i + b + 1 > n ? n : i + b + 1;
+        const int32_t c = ids[i];
+        for (int64_t j = lo; j < hi; ++j) {
+            if (j != i) {
+                out_centers[k] = c;
+                out_contexts[k] = ids[j];
+                ++k;
+            }
+        }
+    }
+    return k;
+}
+
+// CBOW window packing: for each position i, the surrounding context ids
+// (window shrink as above) left-packed into ctx[i, 0:W] with mask 1.0 on
+// filled slots. ctx/mask are caller-zeroed (n, W) buffers.
+void cbow_windows_i32(const int32_t* ids, int64_t n,
+                      const int32_t* half_windows, int64_t W,
+                      int32_t* ctx, float* mask) {
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t b = half_windows[i];
+        const int64_t lo = i - b < 0 ? 0 : i - b;
+        const int64_t hi = i + b + 1 > n ? n : i + b + 1;
+        int64_t k = 0;
+        for (int64_t j = lo; j < hi && k < W; ++j) {
+            if (j != i) {
+                ctx[i * W + k] = ids[j];
+                mask[i * W + k] = 1.0f;
+                ++k;
+            }
+        }
+    }
+}
+
 }  // extern "C"

@@ -256,3 +256,60 @@ class TestNativeEtl:
                                    atol=1e-6)
         if not ne.available():
             pytest.skip("native ETL library not built in this environment")
+
+
+class TestNativeNlpKernels:
+    """C++ skip-gram pair / CBOW window builders (reference
+    AggregateSkipGram's native batch-building role) must match the Python
+    fallbacks exactly."""
+
+    def _fallback_pairs(self, ids, bs):
+        cs, xs = [], []
+        n = len(ids)
+        for i in range(n):
+            b = int(bs[i])
+            lo, hi = max(0, i - b), min(n, i + b + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    cs.append(ids[i])
+                    xs.append(ids[j])
+        return np.asarray(cs, np.int32), np.asarray(xs, np.int32)
+
+    def _require_native(self):
+        from deeplearning4j_tpu import native_etl
+
+        lib = native_etl._load()
+        if lib is None or getattr(lib, "skipgram_pairs_i32", None) is None:
+            pytest.skip("native NLP kernels unavailable (no toolchain)")
+        return native_etl
+
+    def test_skipgram_pairs_native_matches_python(self):
+        native_etl = self._require_native()
+
+        rng = np.random.default_rng(0)
+        for n in (2, 7, 50, 301):
+            ids = rng.integers(0, 1000, n).astype(np.int32)
+            bs = rng.integers(1, 6, n).astype(np.int32)
+            c, x = native_etl.skipgram_pairs(ids, bs)
+            c_ref, x_ref = self._fallback_pairs(ids, bs)
+            np.testing.assert_array_equal(c, c_ref)
+            np.testing.assert_array_equal(x, x_ref)
+
+    def test_cbow_windows_native_matches_python(self):
+        native_etl = self._require_native()
+
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 100, 40).astype(np.int32)
+        bs = rng.integers(1, 4, 40).astype(np.int32)
+        W = 6
+        ctx, m = native_etl.cbow_windows(ids, bs, W)
+        ctx_ref = np.zeros((40, W), np.int32)
+        m_ref = np.zeros((40, W), np.float32)
+        for i in range(40):
+            b = int(bs[i])
+            js = [j for j in range(max(0, i - b), min(40, i + b + 1))
+                  if j != i][:W]
+            ctx_ref[i, :len(js)] = ids[js]
+            m_ref[i, :len(js)] = 1.0
+        np.testing.assert_array_equal(ctx, ctx_ref)
+        np.testing.assert_array_equal(m, m_ref)
