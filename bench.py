@@ -134,6 +134,45 @@ def _bench_resnet(batch: int, compute_dtype):
     return batch * iters / dt
 
 
+def _bench_transformer(batch: int = 16, seq: int = 512):
+    """TransformerLM train throughput (tokens/sec) — the flagship
+    distributed model's single-chip number, reported in extra alongside
+    the ResNet-50 headline. GPT-2-small-ish shape (d=768, L=12, h=12)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+    model = TransformerLM(vocab_size=32000, d_model=768, n_heads=12,
+                          n_layers=12, max_length=seq).init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32000, (batch, seq)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tgt[:, -1] = -1
+
+    # drive the jitted step directly (fit_batch host-syncs every call,
+    # which would serialize dispatch through the tunnel)
+    step = model._jit_cache.setdefault("step", model._make_step())
+    ids_d = jnp.asarray(ids, jnp.int32)
+    tgt_d = jnp.asarray(tgt, jnp.int32)
+
+    def run_one():
+        model.iteration += 1
+        model.params_, model.opt_state_, model.score_ = step(
+            model.params_, model.opt_state_, ids_d, tgt_d,
+            jnp.asarray(model.iteration, jnp.int32),
+        )
+
+    run_one()  # compile
+    float(model.score_)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run_one()
+    float(model.score_)
+    dt = time.perf_counter() - t0
+    return batch * seq * iters / dt
+
+
 def _bench_allreduce(devices, mb: float = 256.0):
     """Time an all-reduce (psum) of an fp32 buffer sharded over all
     devices; returns (algo_bandwidth_GB_per_s, n_devices). Algorithmic
@@ -207,6 +246,13 @@ def main():
         100.0 * img_per_sec * flops_per_img / (peak_tflops * 1e12), 2
     )
     extra["mfu_assumed_peak_tflops"] = peak_tflops
+    if os.environ.get("BENCH_SKIP_LM", "0") != "1":
+        try:
+            extra["transformer_lm_tokens_per_sec"] = round(
+                _bench_transformer(), 1)
+            extra["transformer_lm_config"] = "d768 L12 h12 T512 b16 fp32-params"
+        except Exception as e:
+            extra["transformer_lm_error"] = f"{type(e).__name__}: {e}"
     try:
         gbps, n = _bench_allreduce(devices)
         extra["allreduce_algbw_gbps"] = gbps

@@ -411,6 +411,22 @@ class MultiDataSetIterator:
     def next(self):
         raise NotImplementedError
 
+    def set_pre_processor(self, pp) -> None:
+        """(reference ``MultiDataSetIterator.setPreProcessor``)."""
+        self.pre_processor = pp
+
+    def _pp(self, mds):
+        pp = getattr(self, "pre_processor", None)
+        if pp is None:
+            return mds
+        from deeplearning4j_tpu.data.dataset import MultiDataSet as _MDS
+
+        # shallow copy (same anti-double-normalization contract as the
+        # DataSetIterator path: retained batches stay raw)
+        copy = _MDS(list(mds.features), list(mds.labels),
+                    list(mds.features_masks), list(mds.labels_masks))
+        return pp.pre_process(copy)
+
     def reset(self) -> None:
         raise NotImplementedError
 
@@ -439,7 +455,7 @@ class ExistingMultiDataSetIterator(MultiDataSetIterator):
     def next(self):
         d = self._data[self._pos]
         self._pos += 1
-        return d
+        return self._pp(d)
 
     def reset(self):
         self._pos = 0

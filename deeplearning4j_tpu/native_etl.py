@@ -53,20 +53,25 @@ def _load() -> Optional[ctypes.CDLL]:
                     capture_output=True, timeout=60,
                 )
             except subprocess.CalledProcessError as e:
+                have_so = os.path.exists(_SO_PATH)
                 warnings.warn(
-                    "native ETL build failed; using numpy fallbacks. "
-                    f"stderr: {e.stderr.decode(errors='replace')[-400:]}",
+                    "native ETL build failed; "
+                    + ("loading the EXISTING (possibly stale) library"
+                       if have_so else "using numpy fallbacks")
+                    + f". stderr: {e.stderr.decode(errors='replace')[-400:]}",
                     stacklevel=3,
                 )
-                if not os.path.exists(_SO_PATH):
+                if not have_so:
                     return None
             except (OSError, subprocess.SubprocessError) as e:
+                have_so = os.path.exists(_SO_PATH)
                 warnings.warn(
-                    f"native ETL build unavailable ({e}); using numpy "
-                    "fallbacks",
+                    f"native ETL build unavailable ({e}); "
+                    + ("loading the EXISTING (possibly stale) library"
+                       if have_so else "using numpy fallbacks"),
                     stacklevel=3,
                 )
-                if not os.path.exists(_SO_PATH):
+                if not have_so:
                     return None
         if not os.path.exists(_SO_PATH):
             return None

@@ -468,3 +468,27 @@ class TestIteratorCombinatorTail:
         it.set_pre_processor(CombinedPreProcessor(DummyPreProcessor(), norm))
         out = it.next()
         np.testing.assert_allclose(out.features.mean(), 0.0, atol=1e-6)
+
+
+class TestMultiDataSetPreProcessor:
+    def test_existing_multi_iterator_applies_without_mutating(self):
+        from deeplearning4j_tpu.data import (
+            ExistingMultiDataSetIterator, MultiDataSet,
+        )
+
+        mds = MultiDataSet([np.ones((2, 3), np.float32)],
+                           [np.ones((2, 1), np.float32)])
+
+        class Scale:
+            def pre_process(self, m):
+                m.features = [f * 2.0 for f in m.features]
+                return m
+
+        it = ExistingMultiDataSetIterator([mds])
+        it.set_pre_processor(Scale())
+        out = it.next()
+        np.testing.assert_allclose(out.features[0], 2.0)
+        it.reset()
+        out2 = it.next()
+        np.testing.assert_allclose(out2.features[0], 2.0)  # not 4.0
+        np.testing.assert_allclose(mds.features[0], 1.0)   # source raw
