@@ -189,14 +189,15 @@ def _svg_frame(st: Style, title: Optional[str]) -> Tuple[List[str], float, float
     return parts, px, py, pw, ph
 
 
-def _axes(parts, st: Style, px, py, pw, ph, x0, x1, y0, y1, n=5):
+def _axes(parts, st: Style, px, py, pw, ph, x0, x1, y0, y1, n=5, y_fmt=None):
     for i in range(n):
         fy = py + ph - i / (n - 1) * ph
         vy = y0 + i / (n - 1) * (y1 - y0)
+        label = y_fmt(vy) if y_fmt is not None else f"{vy:.3g}"
         parts.append(f'<line x1="{px:g}" y1="{fy:g}" x2="{px + pw:g}" y2="{fy:g}" '
                      'stroke="#f0f0f0"/>')
         parts.append(f'<text x="{px - 4:g}" y="{fy + 4:g}" text-anchor="end" '
-                     f'style="font:10px sans-serif">{vy:.3g}</text>')
+                     f'style="font:10px sans-serif">{label}</text>')
         fx = px + i / (n - 1) * pw
         vx = x0 + i / (n - 1) * (x1 - x0)
         parts.append(f'<text x="{fx:g}" y="{py + ph + 14:g}" text-anchor="middle" '
@@ -206,13 +207,17 @@ def _axes(parts, st: Style, px, py, pw, ph, x0, x1, y0, y1, n=5):
 
 
 def _legend(parts, st: StyleChart, names: Sequence[str], px, py, pw):
-    x = px
+    x, row = px, 0
     for i, name in enumerate(names):
+        w_entry = 14 + 6.2 * len(str(name))
+        if x > px and x + w_entry > px + pw:  # wrap: don't clip past frame
+            x, row = px, row + 1
+        y = py - 16 + 12 * row
         c = st.series_colors[i % len(st.series_colors)]
-        parts.append(f'<rect x="{x:g}" y="{py - 16:g}" width="9" height="9" fill="{c}"/>')
-        parts.append(f'<text x="{x + 12:g}" y="{py - 8:g}" '
+        parts.append(f'<rect x="{x:g}" y="{y:g}" width="9" height="9" fill="{c}"/>')
+        parts.append(f'<text x="{x + 12:g}" y="{y + 8:g}" '
                      f'style="font:10px sans-serif">{_html.escape(str(name))}</text>')
-        x += 14 + 6.2 * len(str(name))
+        x += w_entry
 
 
 def _span(vals: Sequence[float]) -> Tuple[float, float]:
@@ -225,13 +230,17 @@ def _span(vals: Sequence[float]) -> Tuple[float, float]:
 
 @_register
 class ChartLine(Component):
-    """Multi-series line chart (reference ``chart/ChartLine.java``)."""
+    """Multi-series line chart (reference ``chart/ChartLine.java``);
+    ``log_y`` plots log10(y) with 1eN axis labels (the update:param-ratio
+    convention of the reference TrainModule)."""
 
-    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None,
+                 log_y: bool = False):
         super().__init__(style, title)
         self.series_names: List[str] = []
         self.x: List[List[float]] = []
         self.y: List[List[float]] = []
+        self.log_y = bool(log_y)
 
     def add_series(self, name: str, x: Sequence[float], y: Sequence[float]):
         if len(x) != len(y):
@@ -242,22 +251,29 @@ class ChartLine(Component):
         return self
 
     def _data(self):
-        return {"series_names": self.series_names, "x": self.x, "y": self.y}
+        return {"series_names": self.series_names, "x": self.x, "y": self.y,
+                "log_y": getattr(self, "log_y", False)}
 
     def render_html(self) -> str:
         st = self._chart_style()
         parts, px, py, pw, ph = _svg_frame(st, self.title)
+        log_y = getattr(self, "log_y", False)  # may be absent in
+        # payloads serialized before the field existed
+        ty = (lambda v: math.log10(max(v, 1e-12))) if log_y else (lambda v: v)
         allx = [v for s in self.x for v in s]
-        ally = [v for s in self.y for v in s if math.isfinite(v)]
+        ally = [ty(v) for s in self.y for v in s
+                if math.isfinite(v) and math.isfinite(ty(v))]
         x0, x1 = _span(allx)
         y0, y1 = _span(ally)
-        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1)
+        _axes(parts, st, px, py, pw, ph, x0, x1, y0, y1,
+              y_fmt=(lambda v: f"1e{v:.1f}") if log_y else None)
         for i, (xs, ys) in enumerate(zip(self.x, self.y)):
             c = st.series_colors[i % len(st.series_colors)]
             pts = " ".join(
                 f"{px + (x - x0) / (x1 - x0) * pw:.1f},"
-                f"{py + ph - (y - y0) / (y1 - y0) * ph:.1f}"
-                for x, y in zip(xs, ys) if math.isfinite(y)
+                f"{py + ph - (ty(y) - y0) / (y1 - y0) * ph:.1f}"
+                for x, y in zip(xs, ys)
+                if math.isfinite(y) and math.isfinite(ty(y))
             )
             parts.append(f'<polyline points="{pts}" fill="none" stroke="{c}" '
                          f'stroke-width="{st.stroke_width:g}"/>')
@@ -277,7 +293,9 @@ class ChartScatter(Component):
         self.y: List[List[float]] = []
 
     add_series = ChartLine.add_series
-    _data = ChartLine._data
+
+    def _data(self):
+        return {"series_names": self.series_names, "x": self.x, "y": self.y}
 
     def render_html(self) -> str:
         st = self._chart_style()

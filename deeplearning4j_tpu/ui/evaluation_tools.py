@@ -7,7 +7,7 @@ from __future__ import annotations
 import html
 from typing import Optional
 
-from deeplearning4j_tpu.ui.dashboard import _svg_line_chart
+from deeplearning4j_tpu.ui.dashboard import _line
 
 
 class EvaluationTools:
@@ -18,7 +18,7 @@ class EvaluationTools:
             f"AUC={roc.calculate_auc():.4f}": list(zip(fpr.tolist(), tpr.tolist())),
             "chance": [(0.0, 0.0), (1.0, 1.0)],
         }
-        return _svg_line_chart(series, title)
+        return _line(series, title)
 
     @staticmethod
     def export_roc_charts_to_html_file(roc, path: str,
@@ -36,7 +36,7 @@ class EvaluationTools:
                 list(zip(mean_pred.tolist(), frac_pos.tolist())),
             "perfect": [(0.0, 0.0), (1.0, 1.0)],
         }
-        return _svg_line_chart(series, title)
+        return _line(series, title)
 
     @staticmethod
     def export_calibration_to_html_file(cal, path: str, cls: int = 0,

@@ -352,3 +352,41 @@ class TestConvolutionalListener:
         idx = os.path.join(tmp_path, "index.html")
         assert os.path.exists(idx)
         assert "<img" in open(idx).read()
+
+
+class TestComponentCompat:
+    def test_chartline_pre_logy_payload_renders(self):
+        """Payloads serialized before the log_y field existed must still
+        deserialize and render."""
+        import json as _json
+
+        from deeplearning4j_tpu.ui import ChartLine, Component
+
+        d = ChartLine("t").add_series("s", [0, 1], [1.0, 2.0]).to_dict()
+        del d["log_y"]
+        back = Component.from_dict(d)
+        html_text = back.render_html()
+        assert "polyline" in html_text
+        # and round-trips again
+        assert _json.loads(back.to_json())["log_y"] is False
+
+    def test_legend_wraps_many_series(self):
+        from deeplearning4j_tpu.ui import ChartLine
+
+        c = ChartLine("many")
+        for i in range(12):
+            c.add_series(f"layer_{i}_gamma", [0, 1], [i, i + 1])
+        html_text = c.render_html()
+        # wrapped legend rows: at least one legend rect below the first row
+        import re
+
+        ys = {m.group(1) for m in
+              re.finditer(r'<rect x="[\d.]+" y="(\d+[\d.]*)" width="9"',
+                          html_text)}
+        assert len(ys) >= 2, f"legend did not wrap: rows at {ys}"
+
+    def test_dashboard_no_finite_data_placeholder(self):
+        from deeplearning4j_tpu.ui.dashboard import _line
+
+        out = _line({"score": [(0, float("nan")), (1, float("inf"))]}, "S")
+        assert "no finite data" in out
