@@ -143,7 +143,8 @@ def _bench_transformer(batch: int = 16, seq: int = 512):
     from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 
     model = TransformerLM(vocab_size=32000, d_model=768, n_heads=12,
-                          n_layers=12, max_length=seq).init()
+                          n_layers=12, max_length=seq,
+                          compute_dtype="bfloat16").init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 32000, (batch, seq)).astype(np.int32)
     tgt = np.roll(ids, -1, axis=1).astype(np.int32)
@@ -250,7 +251,8 @@ def main():
         try:
             extra["transformer_lm_tokens_per_sec"] = round(
                 _bench_transformer(), 1)
-            extra["transformer_lm_config"] = "d768 L12 h12 T512 b16 fp32-params"
+            extra["transformer_lm_config"] = ("d768 L12 h12 T512 b16 bf16 "
+                                              "(fp32 masters)")
         except Exception as e:
             extra["transformer_lm_error"] = f"{type(e).__name__}: {e}"
     try:

@@ -38,7 +38,8 @@ class TransformerLMConfig:
     def __init__(self, vocab_size: int, d_model: int = 256, n_heads: int = 4,
                  n_layers: int = 4, mlp_ratio: int = 4, max_length: int = 512,
                  seed: int = 0, n_experts: int = 0, top_k: int = 2,
-                 capacity_factor: float = 1.25, aux_loss_weight: float = 1e-2):
+                 capacity_factor: float = 1.25, aux_loss_weight: float = 1e-2,
+                 compute_dtype: Optional[str] = None):
         if d_model % n_heads:
             raise ValueError("d_model must be divisible by n_heads")
         self.vocab_size = int(vocab_size)
@@ -55,6 +56,15 @@ class TransformerLMConfig:
         self.top_k = int(top_k)
         self.capacity_factor = float(capacity_factor)
         self.aux_loss_weight = float(aux_loss_weight)
+        # mixed precision (same scheme as the layer stack's compute_dtype:
+        # fp32 master params/updater/layernorm/softmax, bf16 matmuls and
+        # carried activations). None/"float32" = uniform fp32.
+        if compute_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"compute_dtype must be None, 'float32' or 'bfloat16', got "
+                f"{compute_dtype!r}"
+            )
+        self.compute_dtype = None if compute_dtype == "float32" else compute_dtype
 
     def to_dict(self):
         return dict(self.__dict__)
@@ -111,14 +121,33 @@ def _moe_capacity(cfg: TransformerLMConfig, n_tokens: int) -> int:
                         cfg.n_experts)
 
 
+def _cdtype(cfg: TransformerLMConfig):
+    return jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else None
+
+
+def _ln(x, g, b, cd):
+    """LayerNorm with fp32 statistics under mixed precision (the same
+    exemption the layer stack's norm layers use)."""
+    if cd is None:
+        return _layer_norm(x, g, b)
+    return _layer_norm(x.astype(jnp.float32), g, b).astype(cd)
+
+
 def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
                 attn_fn=None):
     """One pre-LN block on (b, T, d); bp holds UNSTACKED (single-layer)
     params. ``attn_fn`` defaults to dense attention (ring under SP).
-    Dense FFN → returns x. MoE (cfg.n_experts > 0) → returns (x, aux)."""
+    Dense FFN → returns x. MoE (cfg.n_experts > 0) → returns (x, aux).
+    Under compute_dtype="bfloat16": matmul operands and the carried
+    activation are bf16; layernorm statistics fp32."""
     b, T, d = x.shape
     hn = cfg.n_heads
-    a_in = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+    cd = _cdtype(cfg)
+    if cd is not None:
+        x = x.astype(cd)
+        bp = {k2: (v.astype(cd) if k2[0] == "W" or k2[0] == "b" else v)
+              for k2, v in bp.items()}
+    a_in = _ln(x, bp["ln1_g"], bp["ln1_b"], cd)
 
     def heads(W):
         return (a_in @ W).reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
@@ -126,9 +155,9 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
     fn = attn_fn if attn_fn is not None else dense_attention
     o = fn(q, k, v, causal=True, mask=None)
-    o = o.transpose(0, 2, 1, 3).reshape(b, T, d)
+    o = o.transpose(0, 2, 1, 3).reshape(b, T, d).astype(x.dtype)
     x = x + o @ bp["Wo"] + bp["bo"]
-    m_in = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+    m_in = _ln(x, bp["ln2_g"], bp["ln2_b"], cd)
     if cfg.n_experts > 0:
         from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
 
@@ -137,7 +166,7 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
             m_in.reshape(b * T, d), jax.nn.gelu,
             _moe_capacity(cfg, b * T), cfg.top_k,
         )
-        return x + y2.reshape(b, T, d), aux
+        return x + y2.reshape(b, T, d).astype(x.dtype), aux
     h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
     return x + h @ bp["W2"] + bp["b2"]
 
@@ -147,6 +176,9 @@ def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
     """ids (b, T) int32 → logits (b, T, V) [, total MoE aux loss].
     Single-device path: blocks via lax.scan over the stacked layer axis."""
     x = params["embed"][ids] + params["pos"][pos_offset:pos_offset + ids.shape[1]][None]
+    cd = _cdtype(cfg)
+    if cd is not None:
+        x = x.astype(cd)  # stable scan-carry dtype; blocks keep it bf16
 
     if cfg.n_experts > 0:
         def body(carry, bp):
@@ -162,8 +194,9 @@ def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
 
         x, _ = jax.lax.scan(body, x, params["blocks"])
         aux = jnp.zeros((), jnp.float32)
-    x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-    logits = x @ params["head"]
+    x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
+    head = params["head"].astype(cd) if cd is not None else params["head"]
+    logits = (x @ head).astype(jnp.float32)  # softmax/loss in fp32
     if return_aux:
         return logits, aux
     return logits
@@ -193,12 +226,14 @@ class TransformerLM(ZooModel):
                  n_heads: int = 4, n_layers: int = 4, mlp_ratio: int = 4,
                  max_length: int = 512, seed: int = 123, n_experts: int = 0,
                  top_k: int = 2, capacity_factor: float = 1.25,
-                 aux_loss_weight: float = 1e-2, **kwargs):
+                 aux_loss_weight: float = 1e-2,
+                 compute_dtype: Optional[str] = None, **kwargs):
         super().__init__(num_classes=vocab_size, seed=seed, **kwargs)
         self.cfg = TransformerLMConfig(
             vocab_size, d_model, n_heads, n_layers, mlp_ratio, max_length,
             seed=seed, n_experts=n_experts, top_k=top_k,
             capacity_factor=capacity_factor, aux_loss_weight=aux_loss_weight,
+            compute_dtype=compute_dtype,
         )
         self.params_: Optional[Dict] = None
         self.opt_state_: Optional[Dict] = None

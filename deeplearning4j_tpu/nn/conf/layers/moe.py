@@ -93,8 +93,11 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
 
 
 def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
-    """Token-level MoE FFN: x2 [S, d] → (y [S, d], aux_loss)."""
-    probs = jax.nn.softmax(x2 @ params["Wg"], axis=-1)
+    """Token-level MoE FFN: x2 [S, d] → (y [S, d], aux_loss). Router
+    softmax runs in fp32 regardless of compute dtype (GShard convention —
+    routing decisions are precision-sensitive), then gates cast back."""
+    logits = x2 @ params["Wg"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x2.dtype)
     dispatch, combine, aux = _moe_dispatch(probs, capacity, top_k, valid)
     # [S,E,C]x[S,d] -> [E,C,d]: the tensor GSPMD all-to-alls under EP
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, x2)

@@ -248,16 +248,23 @@ class DistributedLMTrainer:
         return blocks_fn
 
     def _loss_fn(self):
+        from deeplearning4j_tpu.models.transformer_lm import _cdtype, _ln
+
         cfg = self.cfg
         blocks_fn = self._blocks_fn()
         moe = cfg.n_experts > 0
+        cd = _cdtype(cfg)
 
         def loss(params, ids, targets):
             x = params["embed"][ids] + params["pos"][: ids.shape[1]][None]
+            if cd is not None:
+                x = x.astype(cd)  # stable scan-carry dtype (block_apply
+                # keeps the carried activation bf16), as in forward()
             out = blocks_fn(params["blocks"], x)
             x, aux = out if moe else (out, None)
-            x = _layer_norm(x, params["lnf_g"], params["lnf_b"])
-            logits = x @ params["head"]
+            x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
+            head = params["head"].astype(cd) if cd is not None else params["head"]
+            logits = (x @ head).astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             valid = (targets >= 0).astype(logits.dtype)
             tgt = jnp.maximum(targets, 0)

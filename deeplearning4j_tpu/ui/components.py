@@ -169,10 +169,11 @@ class Component:
         return self.style if isinstance(self.style, StyleChart) else StyleChart()
 
 
-def _svg_frame(st: Style, title: Optional[str]) -> Tuple[List[str], float, float,
-                                                         float, float]:
-    """Opens an svg, returns (parts, plot x0, y0, plot width, height)."""
-    w, h = st.width, st.height
+def _svg_frame(st: Style, title: Optional[str], extra_h: float = 0
+               ) -> Tuple[List[str], float, float, float, float]:
+    """Opens an svg, returns (parts, plot x0, y0, plot width, height).
+    ``extra_h`` extends the canvas below the plot (wrapped legend rows)."""
+    w, h = st.width, st.height + extra_h
     parts = [
         f'<svg viewBox="0 0 {w:g} {h:g}" width="{w:g}" height="{h:g}" '
         f'style="background:{st.background_color};border:1px solid #e5e7eb;'
@@ -185,7 +186,9 @@ def _svg_frame(st: Style, title: Optional[str]) -> Tuple[List[str], float, float
         )
     px, py = st.margin_left, st.margin_top
     pw = w - st.margin_left - st.margin_right
-    ph = h - st.margin_top - st.margin_bottom
+    # plot height stays st.height-based: extra_h extends the CANVAS below
+    # the plot (legend overflow area), not the plot itself
+    ph = st.height - st.margin_top - st.margin_bottom
     return parts, px, py, pw, ph
 
 
@@ -206,18 +209,36 @@ def _axes(parts, st: Style, px, py, pw, ph, x0, x1, y0, y1, n=5, y_fmt=None):
                  'fill="none" stroke="#9ca3af"/>')
 
 
-def _legend(parts, st: StyleChart, names: Sequence[str], px, py, pw):
-    x, row = px, 0
-    for i, name in enumerate(names):
+def _legend_layout(names: Sequence[str], px, pw):
+    """Row-wrapped legend positions: [(name, x, row)], n_rows."""
+    entries, x, row = [], px, 0
+    for name in names:
         w_entry = 14 + 6.2 * len(str(name))
         if x > px and x + w_entry > px + pw:  # wrap: don't clip past frame
             x, row = px, row + 1
-        y = py - 16 + 12 * row
+        entries.append((str(name), x, row))
+        x += w_entry
+    return entries, row + 1
+
+
+def _legend_extra_h(names: Sequence[str], st: StyleChart) -> float:
+    """Canvas extension needed below the plot for wrapped legend rows
+    (row 0 lives in the header strip; rows 1+ go under the x-axis)."""
+    _, n_rows = _legend_layout(names, st.margin_left,
+                               st.width - st.margin_left - st.margin_right)
+    return 12.0 * (n_rows - 1) + (6.0 if n_rows > 1 else 0.0)
+
+
+def _legend(parts, st: StyleChart, names: Sequence[str], px, py, pw):
+    entries, _ = _legend_layout(names, px, pw)
+    for i, (name, x, row) in enumerate(entries):
+        # row 0: header strip above the plot; rows 1+: below the x-axis
+        # labels on the extended canvas (never over the plotted data)
+        y = py - 16 if row == 0 else st.height - 10 + 12 * (row - 1)
         c = st.series_colors[i % len(st.series_colors)]
         parts.append(f'<rect x="{x:g}" y="{y:g}" width="9" height="9" fill="{c}"/>')
         parts.append(f'<text x="{x + 12:g}" y="{y + 8:g}" '
-                     f'style="font:10px sans-serif">{_html.escape(str(name))}</text>')
-        x += w_entry
+                     f'style="font:10px sans-serif">{_html.escape(name)}</text>')
 
 
 def _span(vals: Sequence[float]) -> Tuple[float, float]:
@@ -256,7 +277,8 @@ class ChartLine(Component):
 
     def render_html(self) -> str:
         st = self._chart_style()
-        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        parts, px, py, pw, ph = _svg_frame(
+            st, self.title, extra_h=_legend_extra_h(self.series_names, st))
         log_y = getattr(self, "log_y", False)  # may be absent in
         # payloads serialized before the field existed
         ty = (lambda v: math.log10(max(v, 1e-12))) if log_y else (lambda v: v)
@@ -299,7 +321,8 @@ class ChartScatter(Component):
 
     def render_html(self) -> str:
         st = self._chart_style()
-        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        parts, px, py, pw, ph = _svg_frame(
+            st, self.title, extra_h=_legend_extra_h(self.series_names, st))
         allx = [v for s in self.x for v in s]
         ally = [v for s in self.y for v in s if math.isfinite(v)]
         x0, x1 = _span(allx)
@@ -436,7 +459,8 @@ class ChartStackedArea(Component):
 
     def render_html(self) -> str:
         st = self._chart_style()
-        parts, px, py, pw, ph = _svg_frame(st, self.title)
+        parts, px, py, pw, ph = _svg_frame(
+            st, self.title, extra_h=_legend_extra_h(self.series_names, st))
         if not self.x or not self.y:
             parts.append("</svg>")
             return "".join(parts)

@@ -390,3 +390,27 @@ class TestComponentCompat:
 
         out = _line({"score": [(0, float("nan")), (1, float("inf"))]}, "S")
         assert "no finite data" in out
+
+
+class TestLegendPlacement:
+    def test_wrapped_rows_land_below_plot_not_over_data(self):
+        import re
+
+        from deeplearning4j_tpu.ui import ChartLine, StyleChart
+
+        st = StyleChart(width=400, height=200)
+        c = ChartLine("many", st)
+        for i in range(10):
+            c.add_series(f"layer_{i}_gamma_param", [0, 1], [i, i + 1])
+        html_text = c.render_html()
+        plot_top = st.margin_top
+        plot_bottom = st.height - st.margin_bottom
+        rows = sorted({float(m.group(1)) for m in re.finditer(
+            r'<rect x="[\d.]+" y="(-?[\d.]+)" width="9"', html_text)})
+        assert len(rows) >= 2, "legend did not wrap"
+        for y in rows:
+            inside_plot = plot_top < y < plot_bottom
+            assert not inside_plot, f"legend row at y={y} occludes the plot"
+        # canvas extended to hold the overflow rows
+        h = float(re.search(r'viewBox="0 0 [\d.]+ ([\d.]+)"', html_text).group(1))
+        assert h > st.height
