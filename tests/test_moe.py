@@ -372,3 +372,21 @@ class TestLMMixedPrecision:
 
         with pytest.raises(ValueError, match="compute_dtype"):
             TransformerLM(vocab_size=8, compute_dtype="bf16")
+
+    def test_bf16_sp_ring_attention(self):
+        """bf16 + sequence parallelism: the ring-attention kernel gets
+        bf16 q/k/v but accumulates fp32 internally."""
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+        from deeplearning4j_tpu.parallel import TrainingMesh
+        from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+
+        m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                          max_length=8, compute_dtype="bfloat16",
+                          seed=4).init()
+        tr = DistributedLMTrainer(m, TrainingMesh(data=4, seq=2)).place()
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 32, (8, 8)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+        losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
+        assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
