@@ -49,7 +49,9 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
     padding tokens: they take no capacity slots and don't bias the
     load-balancing statistics."""
     S, E = probs.shape
-    f32 = probs.astype(jnp.float32)
+    # aux statistics at >= fp32; fp64 inputs (gradient checker) keep fp64
+    sd = jnp.float64 if probs.dtype == jnp.float64 else jnp.float32
+    f32 = probs.astype(sd)
     if valid is not None:
         valid = valid.reshape(S).astype(probs.dtype)
 
@@ -79,12 +81,12 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
     combine = sum(d * (g / denom)[:, None, None] for d, g in zip(disps, gates))
 
     # Switch aux loss on the top-1 assignment, over valid tokens only
-    top1 = jax.nn.one_hot(jnp.argmax(f32, -1), E, dtype=jnp.float32)
+    top1 = jax.nn.one_hot(jnp.argmax(f32, -1), E, dtype=sd)
     if valid is None:
         f_e = top1.mean(0)
         p_e = f32.mean(0)
     else:
-        v32 = valid.astype(jnp.float32)
+        v32 = valid.astype(sd)
         n_valid = jnp.maximum(v32.sum(), 1.0)
         f_e = (top1 * v32[:, None]).sum(0) / n_valid
         p_e = (f32 * v32[:, None]).sum(0) / n_valid
@@ -97,7 +99,11 @@ def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
     softmax runs in fp32 regardless of compute dtype (GShard convention —
     routing decisions are precision-sensitive), then gates cast back."""
     logits = x2 @ params["Wg"]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x2.dtype)
+    # router at >= fp32 (GShard convention); fp64 inputs (gradient
+    # checker) keep fp64 — only low precision is upcast
+    rd = jnp.float32 if logits.dtype in (jnp.bfloat16, jnp.float16) \
+        else logits.dtype
+    probs = jax.nn.softmax(logits.astype(rd), axis=-1).astype(x2.dtype)
     dispatch, combine, aux = _moe_dispatch(probs, capacity, top_k, valid)
     # [S,E,C]x[S,d] -> [E,C,d]: the tensor GSPMD all-to-alls under EP
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, x2)
@@ -173,7 +179,8 @@ class MixtureOfExpertsLayer(FeedForwardLayer, _MoEParamsMixin):
         y = y2.reshape(shape)
         if mask is not None and y.ndim == 3:
             y = y * mask[..., None]
-        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(jnp.float32)
+        aux_dt = aux.dtype if aux.dtype == jnp.float64 else jnp.float32
+        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(aux_dt)
                      if train else jnp.zeros((), jnp.float32)}
         return y, new_state
 
@@ -234,6 +241,7 @@ class MoETransformerBlock(TransformerBlock, _MoEParamsMixin):
         y = x + y2.reshape(b, T, d)
         if mask is not None:
             y = y * mask[..., None]
-        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(jnp.float32)
+        aux_dt = aux.dtype if aux.dtype == jnp.float64 else jnp.float32
+        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(aux_dt)
                      if train else jnp.zeros((), jnp.float32)}
         return y, new_state

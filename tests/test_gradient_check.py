@@ -171,3 +171,20 @@ class TestGradientChecks:
             InputType.feed_forward(3),
         )
         assert check_gradients(net, DataSet(x, y), print_results=True), f"{loss}/{act}"
+
+
+class TestMoEGradients:
+    def test_moe_layer_gradcheck(self):
+        """fp64 central-difference check through the dense-dispatch MoE
+        (router, experts, and the Switch aux loss all differentiable at a
+        generic point; the top-k selection is piecewise-constant)."""
+        from deeplearning4j_tpu.nn.conf.layers import MixtureOfExpertsLayer
+
+        net = _build(
+            [DenseLayer(n_out=6, activation="tanh"),
+             MixtureOfExpertsLayer(n_experts=3, top_k=2, capacity_factor=2.0,
+                                   hidden_ratio=2, aux_loss_weight=0.05),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(3),
+        )
+        assert check_gradients(net, _data(seed=11), print_results=True)
