@@ -188,3 +188,33 @@ class TestMoEGradients:
             InputType.feed_forward(3),
         )
         assert check_gradients(net, _data(seed=11), print_results=True)
+
+    def test_moe_transformer_block_graph_gradcheck(self):
+        """CG fp64 check through MoETransformerBlock (attention + router +
+        experts + aux loss in one block)."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            MoETransformerBlock, PositionalEmbeddingLayer, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.nn.gradient_check import check_gradients_graph
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .set_input_types(InputType.recurrent(6, 4))
+            .add_layer("pos", PositionalEmbeddingLayer(), "in")
+            .add_layer("moe", MoETransformerBlock(n_heads=2, n_experts=3,
+                                                  capacity_factor=2.0,
+                                                  aux_loss_weight=0.05),
+                       "pos")
+            .add_layer("out", RnnOutputLayer(n_out=2, activation="softmax",
+                                             loss="mcxent"), "moe")
+            .set_outputs("out")
+            .build()
+        )
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((3, 4, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 4))]
+        assert check_gradients_graph(net, DataSet(x, y), print_results=True)
