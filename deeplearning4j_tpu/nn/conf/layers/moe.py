@@ -96,8 +96,8 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
 
 def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
     """Token-level MoE FFN: x2 [S, d] → (y [S, d], aux_loss). Router
-    softmax runs in fp32 regardless of compute dtype (GShard convention —
-    routing decisions are precision-sensitive), then gates cast back."""
+    softmax precision floors at fp32 (GShard convention — routing is
+    precision-sensitive): bf16/f16 upcast, f32/f64 pass through."""
     logits = x2 @ params["Wg"]
     # router at >= fp32 (GShard convention); fp64 inputs (gradient
     # checker) keep fp64 — only low precision is upcast
