@@ -11,8 +11,12 @@ from deeplearning4j_tpu.train.listeners import (
     TrainingListener,
 )
 
+from deeplearning4j_tpu.train.model_serializer import ModelGuesser, ModelSerializer
+from deeplearning4j_tpu.train.orbax_serializer import OrbaxModelSerializer
+
 __all__ = [
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CollectScoresIterationListener", "EvaluativeListener", "CheckpointListener",
     "TimeIterationListener", "SleepyTrainingListener",
+    "ModelSerializer", "ModelGuesser", "OrbaxModelSerializer",
 ]

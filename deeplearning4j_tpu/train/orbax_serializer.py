@@ -1,0 +1,135 @@
+"""TPU-native checkpointing via Orbax/TensorStore (SURVEY.md §7's
+"native msgpack/tensorstore path" beside the reference-compat zip of
+``model_serializer.py``).
+
+Why a second format: the zip flattens every array to one host fp32
+vector — correct, portable, but it gathers sharded params to host and
+loses placement. The Orbax path saves the params/opt-state/layer-state
+pytrees as TensorStore arrays: sharded (TP/EP-placed) models save
+without gathering, restore onto the SAME shardings when a placed
+template is supplied, and multi-host runs write cooperatively (each
+process its own shards — the jax.distributed checkpoint story).
+
+Layout: ``<dir>/conf.json``, ``<dir>/meta.json`` + Orbax trees under
+``<dir>/params`` / ``<dir>/opt_state`` / ``<dir>/layer_state``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+class OrbaxModelSerializer:
+    @staticmethod
+    def save(model, directory: str, save_updater: bool = True,
+             overwrite: bool = False) -> str:
+        """Save a MultiLayerNetwork / ComputationGraph to ``directory``.
+
+        The directory must be absent or empty (periodic checkpointing
+        should use per-step directories, e.g. ``ckpt/step_000100``);
+        ``overwrite=True`` replaces an existing checkpoint atomically
+        enough for single-host use (rmtree then rewrite)."""
+        directory = os.path.abspath(directory)
+        if os.path.isdir(directory) and os.listdir(directory):
+            if not overwrite:
+                raise ValueError(
+                    f"checkpoint directory not empty: {directory} "
+                    "(use per-step directories, or overwrite=True)"
+                )
+            if jax.process_index() == 0:
+                shutil.rmtree(directory)
+        os.makedirs(directory, exist_ok=True)
+        # metadata from one process only; Orbax coordinates the array
+        # writes across processes itself
+        if jax.process_index() == 0:
+            with open(os.path.join(directory, "conf.json"), "w") as f:
+                f.write(model.conf.to_json())
+            with open(os.path.join(directory, "meta.json"), "w") as f:
+                json.dump({
+                    "iteration": model.iteration,
+                    "epoch": model.epoch,
+                    "model_type": type(model).__name__,
+                    "save_updater": bool(save_updater),
+                    "framework": "deeplearning4j_tpu",
+                }, f)
+        ckptr = _checkpointer()
+        try:
+            ckptr.save(os.path.join(directory, "params"), model.params_)
+            if save_updater and model.opt_state_ is not None:
+                ckptr.save(os.path.join(directory, "opt_state"),
+                           model.opt_state_)
+            if model.state_ is not None:
+                ckptr.save(os.path.join(directory, "layer_state"),
+                           model.state_)
+        finally:
+            ckptr.close()  # waits for the async commits
+        return directory
+
+    @staticmethod
+    def restore(directory: str, load_updater: bool = True,
+                template=None):
+        """Rebuild the network from ``conf.json`` and restore the pytrees.
+
+        ``template``: an initialized (optionally mesh-PLACED) network to
+        restore into — its array shardings become the restored arrays'
+        shardings (the TP/EP path). Default: a fresh single-device
+        ``init()`` of the saved configuration."""
+        directory = os.path.abspath(directory)
+        with open(os.path.join(directory, "meta.json")) as f:
+            meta = json.load(f)
+        net = template if template is not None else _build_from_conf(
+            directory, meta)
+
+        def abstract(tree):
+            return jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=getattr(a, "sharding", None)),
+                tree,
+            )
+
+        ckptr = _checkpointer()
+        try:
+            net.params_ = ckptr.restore(os.path.join(directory, "params"),
+                                        abstract(net.params_))
+            if load_updater and os.path.isdir(
+                    os.path.join(directory, "opt_state")):
+                net.opt_state_ = ckptr.restore(
+                    os.path.join(directory, "opt_state"),
+                    abstract(net.opt_state_))
+            if os.path.isdir(os.path.join(directory, "layer_state")):
+                net.state_ = ckptr.restore(
+                    os.path.join(directory, "layer_state"),
+                    abstract(net.state_))
+        finally:
+            ckptr.close()
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+        return net
+
+
+def _build_from_conf(directory: str, meta: dict):
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with open(os.path.join(directory, "conf.json")) as f:
+        conf_json = f.read()
+    if meta.get("model_type") == "ComputationGraph":
+        from deeplearning4j_tpu.nn.conf.graph_builder import (
+            ComputationGraphConfiguration,
+        )
+
+        conf = ComputationGraphConfiguration.from_json(conf_json)
+        return ComputationGraph(conf).init()
+    conf = MultiLayerConfiguration.from_json(conf_json)
+    return MultiLayerNetwork(conf).init()
