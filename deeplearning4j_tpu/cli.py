@@ -53,10 +53,16 @@ def build_dataset(name: str, batch_size: int, num_examples):
     raise SystemExit(f"Unknown dataset '{name}'")
 
 
-def build_model(name: str, num_classes: int, dataset: str):
+def build_model(name: str, num_classes: int, dataset: str,
+                compute_dtype=None, remat_policy=None):
     from deeplearning4j_tpu.models.selector import ModelSelector
 
-    kwargs = {"num_classes": num_classes}
+    global_knobs = {}
+    if compute_dtype:
+        global_knobs["compute_dtype"] = compute_dtype
+    if remat_policy:
+        global_knobs["remat_policy"] = remat_policy
+    kwargs = {"num_classes": num_classes, **global_knobs}
     shape = DATASET_SHAPES.get(dataset.lower())
     if shape is not None:
         # size the model's input to the dataset (zoo models accept
@@ -66,9 +72,10 @@ def build_model(name: str, num_classes: int, dataset: str):
     try:
         model = ModelSelector.select(name, **kwargs)
     except TypeError:
-        # model without spatial kwargs (e.g. text models): fall back and
-        # let config validation report incompatibilities
-        model = ModelSelector.select(name, num_classes=num_classes)
+        # model without spatial kwargs (e.g. text models): drop only the
+        # spatial sizing, keep the precision/remat knobs
+        model = ModelSelector.select(name, num_classes=num_classes,
+                                     **global_knobs)
     return model.init()
 
 
@@ -89,11 +96,19 @@ def main(argv=None) -> int:
     ap.add_argument("--output", default=None, help="checkpoint zip path")
     ap.add_argument("--stats", default=None, help="JSONL stats path")
     ap.add_argument("--dashboard", default=None, help="HTML dashboard path")
+    ap.add_argument("--compute-dtype", default=None,
+                    choices=["bfloat16", "float32"],
+                    help="mixed precision (bf16 compute, fp32 masters)")
+    ap.add_argument("--remat-policy", default=None,
+                    choices=["save_conv_outputs", "dots", "nothing"],
+                    help="backward rematerialization (memory knob)")
     args = ap.parse_args(argv)
 
     it, num_classes = build_dataset(args.dataset, args.batch_size,
                                     args.num_examples)
-    model = build_model(args.model, num_classes, args.dataset)
+    model = build_model(args.model, num_classes, args.dataset,
+                        compute_dtype=args.compute_dtype,
+                        remat_policy=args.remat_policy)
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
 

@@ -29,6 +29,16 @@ class ZooModel:
     def init(self):
         """Build + init the network."""
         conf = self.conf()
+        # global knobs every zoo model honors even when its conf() builder
+        # does not thread them explicitly (CLI --compute-dtype /
+        # --remat-policy reach every architecture through kwargs)
+        for knob in ("compute_dtype", "remat_policy"):
+            v = self.kwargs.get(knob)
+            if v == "float32" and knob == "compute_dtype":
+                v = None  # fp32 is the default — don't switch on the
+                # cast pipeline for no-op casts (TransformerLM convention)
+            if v is not None and getattr(conf, "global_conf", None) is not None:
+                setattr(conf.global_conf, knob, v)
         from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
 
         if isinstance(conf, MultiLayerConfiguration):

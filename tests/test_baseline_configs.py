@@ -169,3 +169,22 @@ class TestCliAndParallelEarlyStopping:
         assert result.termination_reason == "EpochTerminationCondition"
         assert result.total_epochs == 5
         assert np.isfinite(result.best_model_score)
+
+
+class TestCliPrecisionFlags:
+    def test_cli_bf16_and_remat_flags(self, tmp_path):
+        from deeplearning4j_tpu.cli import main
+
+        out = str(tmp_path / "m.zip")
+        rc = main([
+            "--model", "lenet", "--dataset", "mnist", "--epochs", "1",
+            "--batch-size", "32", "--num-examples", "64", "--output", out,
+            "--compute-dtype", "bfloat16", "--remat-policy",
+            "save_conv_outputs",
+        ])
+        assert rc == 0
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        net = ModelSerializer.restore_multi_layer_network(out)
+        assert net.conf.global_conf.compute_dtype == "bfloat16"
+        assert net.conf.global_conf.remat_policy == "save_conv_outputs"
