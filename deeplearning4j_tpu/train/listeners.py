@@ -177,9 +177,13 @@ class CheckpointListener(TrainingListener):
         keep_mode: str = "all",  # all | last | last_and_every
         keep_last: int = 1,
         keep_every: int = 0,
+        serializer: str = "zip",  # zip (reference format) | orbax
     ):
         import os
 
+        if serializer not in ("zip", "orbax"):
+            raise ValueError(f"serializer must be 'zip' or 'orbax', got "
+                             f"{serializer!r}")
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.save_every_n_epochs = save_every_n_epochs
@@ -188,37 +192,65 @@ class CheckpointListener(TrainingListener):
         self.keep_mode = keep_mode
         self.keep_last = keep_last
         self.keep_every = keep_every
+        self.serializer = serializer
         self._last_save_time = time.perf_counter()
         self.checkpoints: List[str] = []
+        self._ids: List[int] = []  # checkpoint numbers aligned with paths
         self._counter = 0
 
     def _save(self, model, iteration, epoch):
         import os
 
-        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
-
         self._counter += 1
-        path = os.path.join(
-            self.directory, f"checkpoint_{self._counter}_iter_{iteration}_epoch_{epoch}.zip"
-        )
-        ModelSerializer.write_model(model, path, save_updater=True)
+        stem = f"checkpoint_{self._counter}_iter_{iteration}_epoch_{epoch}"
+        if self.serializer == "orbax":
+            from deeplearning4j_tpu.train.orbax_serializer import (
+                OrbaxModelSerializer,
+            )
+
+            path = os.path.join(self.directory, stem)
+            # overwrite: restarted runs re-save into the same step names,
+            # matching the zip path's silent-overwrite semantics
+            OrbaxModelSerializer.save(model, path, save_updater=True,
+                                      overwrite=True)
+        else:
+            from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+            path = os.path.join(self.directory, stem + ".zip")
+            ModelSerializer.write_model(model, path, save_updater=True)
         self.checkpoints.append(path)
+        self._ids.append(self._counter)
         self._apply_retention()
 
     def _apply_retention(self):
         import os
+        import shutil
+
+        import jax
 
         if self.keep_mode == "all":
             return
         keep = set(self.checkpoints[-self.keep_last:])
         if self.keep_mode == "last_and_every" and self.keep_every > 0:
-            for i, p in enumerate(self.checkpoints, start=1):
-                if i % self.keep_every == 0:
+            # index by checkpoint NUMBER, not list position — positions
+            # drift as earlier checkpoints are removed
+            for cid, p in zip(self._ids, self.checkpoints):
+                if cid % self.keep_every == 0:
                     keep.add(p)
-        for p in list(self.checkpoints):
-            if p not in keep and os.path.exists(p):
-                os.remove(p)
-                self.checkpoints.remove(p)
+        # FS deletions from process 0 only (multi-host orbax runs share
+        # the directory); every process keeps its bookkeeping in sync
+        do_fs = jax.process_index() == 0
+        for cid, p in zip(list(self._ids), list(self.checkpoints)):
+            if p in keep:
+                continue
+            if do_fs and os.path.exists(p):
+                if os.path.isdir(p):
+                    shutil.rmtree(p)  # orbax checkpoints are directories
+                else:
+                    os.remove(p)
+            i = self.checkpoints.index(p)
+            del self.checkpoints[i]
+            del self._ids[i]
 
     def iteration_done(self, model, iteration, epoch):
         if self.save_every_n_iterations and iteration % self.save_every_n_iterations == 0:

@@ -1,3 +1,4 @@
+import os
 import numpy as np
 import pytest
 
@@ -100,3 +101,64 @@ class TestOrbaxSerializer:
         net.iteration = 42
         OrbaxModelSerializer.save(net, d, overwrite=True)
         assert OrbaxModelSerializer.restore(d).iteration == 42
+
+
+class TestOrbaxCheckpointListener:
+    def test_periodic_orbax_checkpoints_with_retention(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+        from deeplearning4j_tpu.train.orbax_serializer import (
+            OrbaxModelSerializer,
+        )
+
+        net = _net()
+        lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                 keep_mode="last", keep_last=2,
+                                 serializer="orbax")
+        net.listeners.append(lst)
+        ds = _data()
+        net.fit(ds, epochs=5, batch_size=16)  # 5 saves, keep last 2
+        assert len(lst.checkpoints) == 2
+        dirs = [d for d in os.listdir(tmp_path)
+                if os.path.isdir(tmp_path / d)]
+        assert len(dirs) == 2
+        back = OrbaxModelSerializer.restore(lst.checkpoints[-1])
+        np.testing.assert_allclose(np.asarray(back.output(ds.features)),
+                                   np.asarray(net.output(ds.features)),
+                                   atol=1e-6)
+
+    def test_bad_serializer_rejected(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        with pytest.raises(ValueError, match="serializer"):
+            CheckpointListener(str(tmp_path), serializer="msgpack")
+
+    def test_last_and_every_retention_indexes_by_checkpoint_number(self, tmp_path):
+        """keep_every must track checkpoint NUMBERS: every-2nd checkpoints
+        stay kept even after earlier ones are deleted."""
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        net = _net()
+        lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                 keep_mode="last_and_every", keep_last=1,
+                                 keep_every=2)
+        net.listeners.append(lst)
+        ds = _data()
+        net.fit(ds, epochs=5, batch_size=16)  # checkpoints 1..5
+        kept = sorted(os.path.basename(p) for p in lst.checkpoints)
+        # every-2nd (2, 4) + last (5)
+        assert any("checkpoint_2_" in p for p in kept), kept
+        assert any("checkpoint_4_" in p for p in kept), kept
+        assert any("checkpoint_5_" in p for p in kept), kept
+        assert len(kept) == 3
+
+    def test_orbax_listener_restart_overwrites(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        ds = _data()
+        for _ in range(2):  # second "run" re-saves the same step names
+            net = _net()
+            lst = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                     serializer="orbax")
+            net.listeners.append(lst)
+            net.fit(ds, epochs=1, batch_size=16)
+        assert os.path.isdir(tmp_path / "checkpoint_1_iter_1_epoch_0")
