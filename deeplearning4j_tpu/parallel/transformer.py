@@ -83,10 +83,14 @@ class DistributedLMTrainer:
     dp/tp/pp/sp shardings; ``n_micro`` microbatches feed the pipeline."""
 
     def __init__(self, model: TransformerLM, mesh: TrainingMesh,
-                 n_micro: Optional[int] = None):
+                 n_micro: Optional[int] = None,
+                 clip_norm: Optional[float] = None):
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
+        # global-norm gradient clipping (the LM-training standard; the
+        # layer stack's gradient_normalization analog for this trainer)
+        self.clip_norm = None if clip_norm is None else float(clip_norm)
         pp = mesh.shape["pipe"]
         if self.cfg.n_layers % pp:
             raise ValueError(
@@ -285,8 +289,16 @@ class DistributedLMTrainer:
         upd = self.model.updater
         loss_fn = self._loss_fn()
 
+        clip_norm = self.clip_norm
+
         def step(params, opt_state, ids, targets, t):
             loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
+            if clip_norm is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree_util.tree_leaves(grads)))
+                scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gnorm, 1e-12))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             flat_p, treedef = jax.tree_util.tree_flatten(params)
             flat_g = treedef.flatten_up_to(grads)
             flat_o = treedef.flatten_up_to(opt_state)

@@ -184,6 +184,16 @@ class CheckpointListener(TrainingListener):
         if serializer not in ("zip", "orbax"):
             raise ValueError(f"serializer must be 'zip' or 'orbax', got "
                              f"{serializer!r}")
+        if serializer == "orbax" and save_every_minutes:
+            # orbax saves are COLLECTIVE across processes; a per-process
+            # wall-clock trigger can fire on one host and not another,
+            # deadlocking the job. Iteration/epoch triggers are
+            # deterministic across processes.
+            raise ValueError(
+                "serializer='orbax' requires an iteration- or epoch-based "
+                "trigger (save_every_minutes is per-process wall clock and "
+                "would deadlock multi-host collective saves)"
+            )
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.save_every_n_epochs = save_every_n_epochs
@@ -237,9 +247,10 @@ class CheckpointListener(TrainingListener):
             for cid, p in zip(self._ids, self.checkpoints):
                 if cid % self.keep_every == 0:
                     keep.add(p)
-        # FS deletions from process 0 only (multi-host orbax runs share
-        # the directory); every process keeps its bookkeeping in sync
-        do_fs = jax.process_index() == 0
+        # orbax checkpoints live in a SHARED directory: delete from
+        # process 0 only. Zip checkpoints are written per-process (no
+        # gating in ModelSerializer), so every process cleans its own.
+        do_fs = self.serializer != "orbax" or jax.process_index() == 0
         for cid, p in zip(list(self._ids), list(self.checkpoints)):
             if p in keep:
                 continue

@@ -46,8 +46,15 @@ class OrbaxModelSerializer:
                     f"checkpoint directory not empty: {directory} "
                     "(use per-step directories, or overwrite=True)"
                 )
-            if jax.process_index() == 0:
-                shutil.rmtree(directory)
+            if jax.process_count() > 1:
+                # no cross-process barrier between the rmtree and the
+                # other processes' writes — refusing beats corrupting
+                raise ValueError(
+                    "overwrite=True is single-host only (rmtree races "
+                    "concurrent writers); multi-host restarts must save "
+                    "into fresh per-step directories"
+                )
+            shutil.rmtree(directory)
         os.makedirs(directory, exist_ok=True)
         # metadata from one process only; Orbax coordinates the array
         # writes across processes itself

@@ -162,3 +162,10 @@ class TestOrbaxCheckpointListener:
             net.listeners.append(lst)
             net.fit(ds, epochs=1, batch_size=16)
         assert os.path.isdir(tmp_path / "checkpoint_1_iter_1_epoch_0")
+
+    def test_orbax_wall_clock_trigger_rejected(self, tmp_path):
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        with pytest.raises(ValueError, match="wall clock"):
+            CheckpointListener(str(tmp_path), save_every_minutes=1,
+                               serializer="orbax")

@@ -130,3 +130,40 @@ class TestScanRolledPipeline:
         # compile is O(1) in M: 8x microbatches must not blow up compile
         # time (the unrolled schedule scaled ~linearly in M+pp)
         assert compile_s[32] < 3.0 * compile_s[4] + 2.0, compile_s
+
+
+class TestGradientClipping:
+    def test_clip_norm_bounds_update_magnitude(self):
+        """clip_norm must cap the global gradient norm: with a tiny clip
+        the first-step parameter change is proportionally tiny."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+        from deeplearning4j_tpu.parallel import TrainingMesh
+        from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 32, (8, 8)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+
+        from deeplearning4j_tpu.updaters import Sgd
+
+        def delta(clip):
+            # SGD: update magnitude proportional to the (clipped) gradient
+            # (Adam's first step is gradient-scale invariant)
+            m = TransformerLM(vocab_size=32, d_model=32, n_heads=4,
+                              n_layers=2, max_length=8, seed=6,
+                              updater=Sgd(0.1)).init()
+            before = np.asarray(m.params_["head"]).copy()
+            tr = DistributedLMTrainer(m, TrainingMesh(data=8),
+                                      clip_norm=clip).place()
+            tr.fit_batch(ids, tgt)
+            return float(np.abs(np.asarray(m.params_["head"]) - before).max())
+
+        d_unclipped = delta(None)
+        d_clipped = delta(1e-3)
+        assert d_clipped < d_unclipped / 10, (d_clipped, d_unclipped)
+        # generous clip leaves the step effectively untouched
+        d_loose = delta(1e6)
+        np.testing.assert_allclose(d_loose, d_unclipped, rtol=1e-5)
