@@ -41,6 +41,10 @@ class Schedule:
         cls = _SCHEDULES[cls_name]
         if cls is MapSchedule:
             return MapSchedule(d["schedule_type"], {int(k): v for k, v in d["values"].items()})
+        if cls is WarmupSchedule:
+            return WarmupSchedule(d["warmup_steps"],
+                                  Schedule.from_dict(d["base"]),
+                                  d.get("schedule_type", "iteration"))
         obj = cls.__new__(cls)
         obj.__dict__.update(d)
         return obj
@@ -186,6 +190,55 @@ class CycleSchedule(Schedule):
         return jnp.where(pos < up, ramp_up, ramp_dn)
 
 
+class CosineSchedule(Schedule):
+    """Cosine decay from ``initial`` to ``final`` over ``decay_steps``
+    (transformer-era standard; beyond the reference's ISchedule catalog).
+    Holds ``final`` after ``decay_steps``."""
+
+    def __init__(self, initial: float, decay_steps: int, final: float = 0.0,
+                 schedule_type: str = "iteration"):
+        self.initial = float(initial)
+        self.final = float(final)
+        self.decay_steps = int(decay_steps)
+        self.schedule_type = schedule_type
+
+    def value_at(self, iteration, epoch):
+        t = jnp.asarray(self._t(iteration, epoch), jnp.float32)
+        frac = jnp.clip(t / max(self.decay_steps, 1), 0.0, 1.0)
+        cos = 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return jnp.asarray(self.final + (self.initial - self.final) * cos,
+                           jnp.float32)
+
+
+class WarmupSchedule(Schedule):
+    """Linear warmup 0 → base over ``warmup_steps``, then the wrapped
+    ``base`` schedule evaluated with the warmup offset removed. Composes
+    with any Schedule (WarmupSchedule(1000, CosineSchedule(...)) is the
+    LM-training standard)."""
+
+    def __init__(self, warmup_steps: int, base: Union[float, "Schedule"],
+                 schedule_type: str = "iteration"):
+        self.warmup_steps = int(warmup_steps)
+        self.base = as_schedule(base)
+        self.schedule_type = schedule_type
+
+    def value_at(self, iteration, epoch):
+        t = jnp.asarray(self._t(iteration, epoch), jnp.float32)
+        shifted = jnp.maximum(t - self.warmup_steps, 0)
+        if self.schedule_type == "epoch":
+            base_val = self.base.value_at(iteration, shifted)
+        else:
+            base_val = self.base.value_at(shifted, epoch)
+        ramp = jnp.clip(t / max(self.warmup_steps, 1), 0.0, 1.0)
+        return jnp.asarray(ramp * base_val, jnp.float32)
+
+    def to_dict(self) -> dict:
+        return {"@class": "WarmupSchedule",
+                "warmup_steps": self.warmup_steps,
+                "schedule_type": self.schedule_type,
+                "base": self.base.to_dict()}
+
+
 _SCHEDULES = {
     c.__name__: c
     for c in [
@@ -197,6 +250,8 @@ _SCHEDULES = {
         StepSchedule,
         MapSchedule,
         CycleSchedule,
+        CosineSchedule,
+        WarmupSchedule,
     ]
 }
 

@@ -16,6 +16,7 @@ reporting frequency — keep ``frequency`` high for accurate TPU throughput.
 from __future__ import annotations
 
 import logging
+import re
 import time
 from typing import Callable, List, Optional
 
@@ -206,10 +207,20 @@ class CheckpointListener(TrainingListener):
         self._last_save_time = time.perf_counter()
         self.checkpoints: List[str] = []
         self._ids: List[int] = []  # checkpoint numbers aligned with paths
-        self._counter = 0
+        # resume numbering after existing checkpoints: a restarted run
+        # never collides with (or overwrites into) a prior run's
+        # directories — required for multi-host orbax, where overwriting
+        # a shared directory is refused
+        existing = [
+            int(m.group(1)) for f in os.listdir(directory)
+            for m in [re.match(r"checkpoint_(\d+)_", f)] if m
+        ]
+        self._counter = max(existing, default=0)
 
     def _save(self, model, iteration, epoch):
         import os
+
+        import jax
 
         self._counter += 1
         stem = f"checkpoint_{self._counter}_iter_{iteration}_epoch_{epoch}"
@@ -219,10 +230,13 @@ class CheckpointListener(TrainingListener):
             )
 
             path = os.path.join(self.directory, stem)
-            # overwrite: restarted runs re-save into the same step names,
-            # matching the zip path's silent-overwrite semantics
-            OrbaxModelSerializer.save(model, path, save_updater=True,
-                                      overwrite=True)
+            # counter resume (__init__) makes collisions with prior runs
+            # impossible; overwrite stays as a single-host backstop for
+            # re-saving the same step (refused on multi-host by the
+            # serializer)
+            OrbaxModelSerializer.save(
+                model, path, save_updater=True,
+                overwrite=jax.process_count() == 1)
         else:
             from deeplearning4j_tpu.train.model_serializer import ModelSerializer
 

@@ -169,3 +169,26 @@ class TestOrbaxCheckpointListener:
         with pytest.raises(ValueError, match="wall clock"):
             CheckpointListener(str(tmp_path), save_every_minutes=1,
                                serializer="orbax")
+
+    def test_listener_counter_resumes_past_existing_checkpoints(self, tmp_path):
+        """A restarted run must continue numbering after the previous
+        run's checkpoints, not collide with them."""
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        ds = _data()
+        net = _net()
+        l1 = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                serializer="orbax")
+        net.listeners.append(l1)
+        net.fit(ds, epochs=2, batch_size=16)  # checkpoints 1, 2
+
+        net2 = _net()
+        l2 = CheckpointListener(str(tmp_path), save_every_n_iterations=1,
+                                serializer="orbax")
+        assert l2._counter == 2  # resumed numbering
+        net2.listeners.append(l2)
+        net2.fit(ds, epochs=1, batch_size=16)
+        names = sorted(f for f in os.listdir(tmp_path))
+        assert any(f.startswith("checkpoint_3_") for f in names), names
+        # prior run's checkpoints untouched
+        assert any(f.startswith("checkpoint_1_") for f in names)

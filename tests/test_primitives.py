@@ -153,12 +153,41 @@ class TestSchedules:
         assert float(s.value_at(50, 0)) == pytest.approx(0.25)
         assert float(s.value_at(100, 0)) == pytest.approx(0.0)
 
+    def test_cosine(self):
+        s = schedules.CosineSchedule(1.0, decay_steps=100, final=0.1)
+        assert float(s.value_at(0, 0)) == pytest.approx(1.0)
+        assert float(s.value_at(50, 0)) == pytest.approx(0.55, abs=1e-6)
+        assert float(s.value_at(100, 0)) == pytest.approx(0.1)
+        assert float(s.value_at(500, 0)) == pytest.approx(0.1)  # holds final
+
+    def test_warmup_wraps_any_schedule(self):
+        s = schedules.WarmupSchedule(10, schedules.CosineSchedule(
+            1.0, decay_steps=100, final=0.0))
+        assert float(s.value_at(0, 0)) == pytest.approx(0.0)
+        assert float(s.value_at(5, 0)) == pytest.approx(0.5)
+        assert float(s.value_at(10, 0)) == pytest.approx(1.0)
+        # post-warmup: cosine evaluated with the warmup offset removed
+        assert float(s.value_at(60, 0)) == pytest.approx(0.5)
+        # plain-float base
+        w = schedules.WarmupSchedule(4, 0.2)
+        assert float(w.value_at(2, 0)) == pytest.approx(0.1)
+        assert float(w.value_at(100, 0)) == pytest.approx(0.2)
+
+    def test_warmup_cosine_drives_updater(self):
+        from deeplearning4j_tpu.updaters import Sgd
+
+        upd = Sgd(schedules.WarmupSchedule(5, 1.0))
+        assert float(upd.lr(0, 0)) == pytest.approx(0.0)
+        assert float(upd.lr(5, 0)) == pytest.approx(1.0)
+
     def test_serde_roundtrip(self):
         for s in [
             schedules.FixedSchedule(0.3),
             schedules.ExponentialSchedule("epoch", 1.0, 0.9),
             schedules.MapSchedule("iteration", {0: 1.0, 3: 0.5}),
             schedules.StepSchedule("iteration", 1.0, 0.5, 7),
+            schedules.CosineSchedule(1.0, 50, 0.05),
+            schedules.WarmupSchedule(8, schedules.CosineSchedule(1.0, 50)),
         ]:
             rt = schedules.Schedule.from_dict(s.to_dict())
             assert rt == s
