@@ -292,14 +292,26 @@ class TransformerLM(ZooModel):
                                                  jnp.asarray(ids, jnp.int32)))
 
     def generate(self, prompt_ids: np.ndarray, max_new: int = 20,
-                 temperature: float = 0.0, rng=None) -> np.ndarray:
+                 temperature: float = 0.0, rng=None, top_k: int = 0,
+                 top_p: float = 0.0) -> np.ndarray:
         """Greedy/temperature sampling continuation (host loop; each step
         re-runs the jitted forward on the growing prefix). Contexts longer
         than ``cfg.max_length`` are windowed to the most recent
-        ``max_length`` tokens — the positional table bounds the forward."""
+        ``max_length`` tokens — the positional table bounds the forward.
+
+        ``top_k`` > 0 restricts sampling to the k highest-probability
+        tokens; ``top_p`` in (0, 1] to the smallest nucleus whose
+        cumulative probability reaches p. Both require temperature > 0
+        and compose (top-k filter, then nucleus)."""
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
+        if (top_k or top_p) and temperature <= 0:
+            raise ValueError("top_k/top_p sampling requires temperature > 0")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        if top_p and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for _ in range(max_new):
             window = ids[:, -self.cfg.max_length:]
@@ -307,9 +319,39 @@ class TransformerLM(ZooModel):
             if temperature <= 0:
                 nxt = logits.argmax(-1).astype(np.int32)
             else:
+                logits = logits / temperature
+                if top_k and top_k < logits.shape[-1]:
+                    kth = np.sort(logits, axis=-1)[:, -top_k][:, None]
+                    logits = np.where(logits < kth, -np.inf, logits)
+                if top_p and 0.0 < top_p < 1.0:
+                    order = np.argsort(-logits, axis=-1)
+                    sorted_l = np.take_along_axis(logits, order, -1)
+                    p_sorted = np.exp(sorted_l - sorted_l.max(-1, keepdims=True))
+                    p_sorted /= p_sorted.sum(-1, keepdims=True)
+                    cum = np.cumsum(p_sorted, -1)
+                    # keep tokens up to AND including the one crossing p
+                    cut = cum - p_sorted >= top_p
+                    sorted_l = np.where(cut, -np.inf, sorted_l)
+                    inv = np.argsort(order, axis=-1)
+                    logits = np.take_along_axis(sorted_l, inv, -1)
                 rng, k = jax.random.split(rng)
                 nxt = np.asarray(
-                    jax.random.categorical(k, jnp.asarray(logits) / temperature)
+                    jax.random.categorical(k, jnp.asarray(logits))
                 ).astype(np.int32)
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         return ids
+
+    def perplexity(self, ids: np.ndarray, targets: np.ndarray) -> float:
+        """exp(mean next-token NLL) over valid targets (-1 = ignore) —
+        the LM evaluation counterpart of Evaluation.accuracy()."""
+        if "ppl" not in self._jit_cache:
+            self._jit_cache["ppl"] = jax.jit(
+                lambda p, i, t: lm_loss(
+                    TransformerLMConfig(**{**self.cfg.to_dict(),
+                                           "aux_loss_weight": 0.0}),
+                    p, i, t)
+            )
+        nll = self._jit_cache["ppl"](
+            self.params_, jnp.asarray(ids, jnp.int32),
+            jnp.asarray(targets, jnp.int32))
+        return float(np.exp(np.asarray(nll)))

@@ -390,3 +390,65 @@ class TestLMMixedPrecision:
         tgt[:, -1] = -1
         losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
         assert np.isfinite(losses[-1]) and losses[-1] < losses[0]
+
+
+class TestLMSamplingAndPerplexity:
+    def _model(self):
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+        m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                          max_length=8, seed=0).init()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 32, (8, 8)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+        for _ in range(5):
+            m.fit_batch(ids, tgt)
+        return m, ids, tgt
+
+    def test_top_k_restricts_to_k_candidates(self):
+        m, ids, _ = self._model()
+        prompt = ids[:1, :4]
+        logits = m.logits(prompt)[:, -1]
+        top2 = set(np.argsort(-logits[0])[:2].tolist())
+        out = m.generate(prompt, max_new=1, temperature=1.0, top_k=2,
+                         rng=jax.random.PRNGKey(3))
+        assert int(out[0, -1]) in top2
+
+    def test_top_p_nucleus_keeps_crossing_token(self):
+        m, ids, _ = self._model()
+        prompt = ids[:1, :4]
+        # tiny p: nucleus is exactly the argmax token -> deterministic
+        out1 = m.generate(prompt, max_new=3, temperature=1.0, top_p=1e-6,
+                          rng=jax.random.PRNGKey(0))
+        greedy = m.generate(prompt, max_new=3, temperature=0.0)
+        np.testing.assert_array_equal(out1, greedy)
+
+    def test_sampling_flags_need_temperature(self):
+        m, ids, _ = self._model()
+        with pytest.raises(ValueError, match="temperature"):
+            m.generate(ids[:1, :4], max_new=1, top_k=3)
+
+    def test_perplexity_decreases_with_training(self):
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, 16, (16, 8)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+        m = TransformerLM(vocab_size=16, d_model=32, n_heads=4, n_layers=2,
+                          max_length=8, seed=4).init()
+        before = m.perplexity(ids, tgt)
+        # untrained ppl ~ vocab size for uniform predictions
+        assert 8 < before < 40
+        for _ in range(20):
+            m.fit_batch(ids, tgt)
+        after = m.perplexity(ids, tgt)
+        assert after < before / 2
+
+    def test_out_of_range_sampling_params_rejected(self):
+        m, ids, _ = self._model()
+        with pytest.raises(ValueError, match="top_k"):
+            m.generate(ids[:1, :4], max_new=1, temperature=1.0, top_k=-2)
+        with pytest.raises(ValueError, match="top_p"):
+            m.generate(ids[:1, :4], max_new=1, temperature=1.0, top_p=1.5)
