@@ -161,7 +161,7 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     if cfg.n_experts > 0:
         from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
 
-        y2, aux = _moe_ffn(
+        y2, aux, _load = _moe_ffn(
             {k2: bp[k2] for k2 in ("Wg", "W1", "b1", "W2", "b2")},
             m_in.reshape(b * T, d), jax.nn.gelu,
             _moe_capacity(cfg, b * T), cfg.top_k,

@@ -91,7 +91,9 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
         f_e = (top1 * v32[:, None]).sum(0) / n_valid
         p_e = (f32 * v32[:, None]).sum(0) / n_valid
     aux = E * jnp.sum(f_e * p_e)
-    return dispatch, combine, aux
+    # f_e doubles as the expert-load observability signal (fraction of
+    # tokens whose top-1 choice is each expert)
+    return dispatch, combine, aux, f_e
 
 
 def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
@@ -104,14 +106,14 @@ def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
     rd = jnp.float32 if logits.dtype in (jnp.bfloat16, jnp.float16) \
         else logits.dtype
     probs = jax.nn.softmax(logits.astype(rd), axis=-1).astype(x2.dtype)
-    dispatch, combine, aux = _moe_dispatch(probs, capacity, top_k, valid)
+    dispatch, combine, aux, load = _moe_dispatch(probs, capacity, top_k, valid)
     # [S,E,C]x[S,d] -> [E,C,d]: the tensor GSPMD all-to-alls under EP
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, x2)
     h = act_fn(jnp.einsum("ecd,edh->ech", expert_in, params["W1"])
                + params["b1"][:, None, :])
     out = jnp.einsum("ech,ehd->ecd", h, params["W2"]) + params["b2"][:, None, :]
     y = jnp.einsum("sec,ecd->sd", combine, out)
-    return y, aux
+    return y, aux, load
 
 
 class _MoEParamsMixin:
@@ -129,6 +131,17 @@ class _MoEParamsMixin:
     def _capacity(self, n_tokens: int) -> int:
         return moe_capacity(n_tokens, self.capacity_factor, self.top_k,
                             self.n_experts)
+
+    def _moe_state(self, aux, load, train: bool) -> dict:
+        """Layer-state payload: weighted aux loss (fp64 preserved for the
+        gradient checker) + per-expert top-1 routing fraction (inspect
+        via net.state_ to see expert balance)."""
+        aux_dt = aux.dtype if aux.dtype == jnp.float64 else jnp.float32
+        return {
+            "aux_loss": (self.aux_loss_weight * aux).astype(aux_dt)
+            if train else jnp.zeros((), jnp.float32),
+            "expert_load": load.astype(jnp.float32),
+        }
 
 
 @serde.register
@@ -166,7 +179,8 @@ class MixtureOfExpertsLayer(FeedForwardLayer, _MoEParamsMixin):
         return self._init_moe_params(rng, self.n_in, dtype)
 
     def init_layer_state(self, input_type, dtype=jnp.float32):
-        return {"aux_loss": jnp.zeros((), jnp.float32)}
+        return {"aux_loss": jnp.zeros((), jnp.float32),
+                "expert_load": jnp.zeros((self.n_experts,), jnp.float32)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
         shape = x.shape
@@ -174,15 +188,13 @@ class MixtureOfExpertsLayer(FeedForwardLayer, _MoEParamsMixin):
         valid = None
         if mask is not None and x.ndim == 3:
             valid = mask.reshape(-1)
-        y2, aux = _moe_ffn(params, x2, self.act_fn(),
-                           self._capacity(x2.shape[0]), self.top_k, valid)
+        y2, aux, load = _moe_ffn(params, x2, self.act_fn(),
+                                 self._capacity(x2.shape[0]), self.top_k,
+                                 valid)
         y = y2.reshape(shape)
         if mask is not None and y.ndim == 3:
             y = y * mask[..., None]
-        aux_dt = aux.dtype if aux.dtype == jnp.float64 else jnp.float32
-        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(aux_dt)
-                     if train else jnp.zeros((), jnp.float32)}
-        return y, new_state
+        return y, self._moe_state(aux, load, train)
 
 
 @serde.register
@@ -214,7 +226,8 @@ class MoETransformerBlock(TransformerBlock, _MoEParamsMixin):
         return base
 
     def init_layer_state(self, input_type, dtype=jnp.float32):
-        return {"aux_loss": jnp.zeros((), jnp.float32)}
+        return {"aux_loss": jnp.zeros((), jnp.float32),
+                "expert_load": jnp.zeros((self.n_experts,), jnp.float32)}
 
     def mlp(self, params, x):
         raise NotImplementedError(
@@ -236,12 +249,9 @@ class MoETransformerBlock(TransformerBlock, _MoEParamsMixin):
         m_in = _layer_norm(x, params["ln2_g"], params["ln2_b"])
         b, T, d = m_in.shape
         valid = mask.reshape(-1) if mask is not None else None
-        y2, aux = _moe_ffn(params, m_in.reshape(-1, d), self.act_fn(),
-                           self._capacity(b * T), self.top_k, valid)
+        y2, aux, load = _moe_ffn(params, m_in.reshape(-1, d), self.act_fn(),
+                                 self._capacity(b * T), self.top_k, valid)
         y = x + y2.reshape(b, T, d)
         if mask is not None:
             y = y * mask[..., None]
-        aux_dt = aux.dtype if aux.dtype == jnp.float64 else jnp.float32
-        new_state = {"aux_loss": (self.aux_loss_weight * aux).astype(aux_dt)
-                     if train else jnp.zeros((), jnp.float32)}
-        return y, new_state
+        return y, self._moe_state(aux, load, train)

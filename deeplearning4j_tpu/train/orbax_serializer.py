@@ -114,14 +114,39 @@ class OrbaxModelSerializer:
                     os.path.join(directory, "opt_state"),
                     abstract(net.opt_state_))
             if os.path.isdir(os.path.join(directory, "layer_state")):
-                net.state_ = ckptr.restore(
-                    os.path.join(directory, "layer_state"),
-                    abstract(net.state_))
+                state_dir = os.path.join(directory, "layer_state")
+                try:
+                    net.state_ = ckptr.restore(state_dir,
+                                               abstract(net.state_))
+                except (ValueError, KeyError, TypeError):
+                    # layer-state forward compat: checkpoints written
+                    # before a layer grew a state key (e.g. MoE
+                    # expert_load) restore as-saved, with missing leaves
+                    # filled from the freshly initialized template
+                    net.state_ = _merge_state(net.state_,
+                                              ckptr.restore(state_dir))
         finally:
             ckptr.close()
         net.iteration = meta.get("iteration", 0)
         net.epoch = meta.get("epoch", 0)
         return net
+
+
+def _merge_state(template, saved):
+    """Fill ``template``'s pytree with ``saved``'s leaves where present
+    (dict keys by name, list/tuple entries by position); leaves absent
+    from the checkpoint keep their initialized values."""
+    if isinstance(template, dict):
+        if not isinstance(saved, dict):
+            return template
+        return {k: _merge_state(v, saved[k]) if k in saved else v
+                for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        if not isinstance(saved, (list, tuple)) or len(saved) != len(template):
+            return template
+        merged = [_merge_state(t, s) for t, s in zip(template, saved)]
+        return type(template)(merged) if isinstance(template, tuple) else merged
+    return saved if saved is not None else template
 
 
 def _build_from_conf(directory: str, meta: dict):

@@ -46,7 +46,7 @@ class TestMoEDispatch:
         rng = np.random.default_rng(0)
         probs = jax.nn.softmax(jnp.asarray(rng.standard_normal((32, 4)),
                                            jnp.float32), -1)
-        dispatch, combine, aux = _moe_dispatch(probs, capacity=32, top_k=2)
+        dispatch, combine, aux, load = _moe_dispatch(probs, capacity=32, top_k=2)
         # every token assigned to exactly top_k expert slots (capacity ample)
         np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 2.0)
         # each expert slot holds at most one token
@@ -61,7 +61,7 @@ class TestMoEDispatch:
         # all tokens prefer expert 0 with capacity 2: only 2 dispatched
         probs = jnp.asarray(np.tile([0.97, 0.01, 0.01, 0.01], (10, 1)),
                             jnp.float32)
-        dispatch, _, _ = _moe_dispatch(probs, capacity=2, top_k=1)
+        dispatch, _, _, _ = _moe_dispatch(probs, capacity=2, top_k=1)
         assert float(dispatch[:, 0].sum()) == 2.0
         assert float(dispatch.sum()) == 2.0
 
@@ -185,8 +185,8 @@ class TestMoEMasking:
         probs = jax.nn.softmax(
             jnp.asarray(rng.standard_normal((12, 4)), jnp.float32), -1)
         valid = jnp.asarray([1] * 6 + [0] * 6, jnp.float32)
-        dispatch, combine, aux = _moe_dispatch(probs, capacity=8, top_k=2,
-                                               valid=valid)
+        dispatch, combine, aux, _ = _moe_dispatch(probs, capacity=8, top_k=2,
+                                                  valid=valid)
         # masked tokens dispatched nowhere, combine weight zero
         assert float(dispatch[6:].sum()) == 0.0
         assert float(combine[6:].sum()) == 0.0
@@ -194,7 +194,7 @@ class TestMoEMasking:
         np.testing.assert_allclose(np.asarray(dispatch[:6].sum((1, 2))), 2.0)
         # aux computed over the 6 valid tokens only: same as an unmasked
         # call on just those tokens
-        _, _, aux_ref = _moe_dispatch(probs[:6], capacity=8, top_k=2)
+        _, _, aux_ref, _ = _moe_dispatch(probs[:6], capacity=8, top_k=2)
         np.testing.assert_allclose(float(aux), float(aux_ref), rtol=1e-6)
 
 
@@ -452,3 +452,16 @@ class TestLMSamplingAndPerplexity:
             m.generate(ids[:1, :4], max_new=1, temperature=1.0, top_k=-2)
         with pytest.raises(ValueError, match="top_p"):
             m.generate(ids[:1, :4], max_new=1, temperature=1.0, top_p=1.5)
+
+
+class TestExpertLoadObservability:
+    def test_expert_load_in_state_sums_to_one(self):
+        net = MultiLayerNetwork(_mlp_moe_conf()).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        net.fit(DataSet(x, y), epochs=1, batch_size=32)
+        load = np.asarray(net.state_[1]["expert_load"])
+        assert load.shape == (4,)
+        np.testing.assert_allclose(load.sum(), 1.0, atol=1e-5)
+        assert (load >= 0).all()

@@ -192,3 +192,37 @@ class TestOrbaxCheckpointListener:
         assert any(f.startswith("checkpoint_3_") for f in names), names
         # prior run's checkpoints untouched
         assert any(f.startswith("checkpoint_1_") for f in names)
+
+    def test_restore_fills_state_keys_added_after_save(self, tmp_path):
+        """Forward compat: a checkpoint saved before a layer grew a state
+        key must still restore, with the new key from the fresh init."""
+        import shutil
+
+        from deeplearning4j_tpu.train.orbax_serializer import (
+            OrbaxModelSerializer, _checkpointer,
+        )
+
+        net = _net(moe=True)
+        ds = _data()
+        net.fit(ds, epochs=2, batch_size=16)
+        d = str(tmp_path / "old_ckpt")
+        OrbaxModelSerializer.save(net, d)
+        # simulate an old checkpoint: rewrite layer_state WITHOUT the
+        # expert_load key
+        old_state = [dict(s) for s in net.state_]
+        del old_state[1]["expert_load"]
+        shutil.rmtree(os.path.join(d, "layer_state"))
+        ck = _checkpointer()
+        ck.save(os.path.join(d, "layer_state"), old_state)
+        ck.close()
+
+        back = OrbaxModelSerializer.restore(d)
+        # saved keys restored, missing key filled from init
+        np.testing.assert_allclose(
+            np.asarray(back.state_[1]["aux_loss"]),
+            np.asarray(net.state_[1]["aux_loss"]))
+        assert back.state_[1]["expert_load"].shape == (2,)
+        out = back.output(ds.features)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(net.output(ds.features)),
+                                   atol=1e-6)
