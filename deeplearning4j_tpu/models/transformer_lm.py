@@ -171,6 +171,174 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     return x + h @ bp["W2"] + bp["b2"]
 
 
+def _validate_sampling(temperature: float, top_k: int, top_p: float) -> None:
+    if (top_k or top_p) and temperature <= 0:
+        raise ValueError("top_k/top_p sampling requires temperature > 0")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if top_p and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def _sample_next(logits: np.ndarray, temperature: float, top_k: int,
+                 top_p: float, rng):
+    """(b, V) logits → ((b,) int32 next ids, new rng). Greedy at
+    temperature<=0; otherwise temperature + optional top-k then nucleus
+    filtering (the shared sampler behind generate/generate_cached)."""
+    if temperature <= 0:
+        return logits.argmax(-1).astype(np.int32), rng
+    logits = logits / temperature
+    if top_k and top_k < logits.shape[-1]:
+        kth = np.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = np.where(logits < kth, -np.inf, logits)
+    if top_p and 0.0 < top_p < 1.0:
+        order = np.argsort(-logits, axis=-1)
+        sorted_l = np.take_along_axis(logits, order, -1)
+        p_sorted = np.exp(sorted_l - sorted_l.max(-1, keepdims=True))
+        p_sorted /= p_sorted.sum(-1, keepdims=True)
+        cum = np.cumsum(p_sorted, -1)
+        # keep tokens up to AND including the one crossing p
+        cut = cum - p_sorted >= top_p
+        sorted_l = np.where(cut, -np.inf, sorted_l)
+        inv = np.argsort(order, axis=-1)
+        logits = np.take_along_axis(sorted_l, inv, -1)
+    rng, k = jax.random.split(rng)
+    nxt = np.asarray(
+        jax.random.categorical(k, jnp.asarray(logits))
+    ).astype(np.int32)
+    return nxt, rng
+
+
+def init_decode_cache(cfg: TransformerLMConfig, batch: int) -> Dict:
+    """Preallocated per-layer KV cache for single-token decoding: static
+    (L, b, heads, max_length, head_dim) buffers + a position counter —
+    TPU-friendly (no growing shapes; writes are dynamic_update slices)."""
+    cd = _cdtype(cfg) or jnp.float32
+    hd = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, batch, cfg.n_heads, cfg.max_length, hd)
+    return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def prefill_cache(cfg: TransformerLMConfig, params: Dict[str, Array],
+                  cache: Dict, ids: Array):
+    """Batched prompt prefill: ids (b, Tp) int32 into a fresh cache →
+    (last-position logits (b, V) fp32, cache with pos=Tp). One device
+    launch regardless of prompt length (causal attention within the
+    prompt, K/V written as one slice per layer); MoE routing competes all
+    b*Tp prompt tokens, exactly like ``forward``."""
+    cd = _cdtype(cfg)
+    b, Tp = ids.shape
+    hn = cfg.n_heads
+    d = cfg.d_model
+    x = params["embed"][ids] + params["pos"][:Tp][None]
+    if cd is not None:
+        x = x.astype(cd)
+
+    def body(x, xs):
+        bp, kc, vc = xs
+        if cd is not None:
+            bp = {k2: (v.astype(cd) if k2[0] in ("W", "b") else v)
+                  for k2, v in bp.items()}
+        a_in = _ln(x, bp["ln1_g"], bp["ln1_b"], cd)
+
+        def heads(W):
+            return (a_in @ W).reshape(b, Tp, hn, -1).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, 0, 0))
+        o = dense_attention(q, k, v, causal=True, mask=None)
+        o = o.transpose(0, 2, 1, 3).reshape(b, Tp, d).astype(x.dtype)
+        x = x + o @ bp["Wo"] + bp["bo"]
+        m_in = _ln(x, bp["ln2_g"], bp["ln2_b"], cd)
+        if cfg.n_experts > 0:
+            from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
+
+            y2, _aux, _load = _moe_ffn(
+                {k2: bp[k2] for k2 in ("Wg", "W1", "b1", "W2", "b2")},
+                m_in.reshape(b * Tp, d), jax.nn.gelu,
+                _moe_capacity(cfg, b * Tp), cfg.top_k,
+            )
+            x = x + y2.reshape(b, Tp, d).astype(x.dtype)
+        else:
+            h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
+            x = x + h @ bp["W2"] + bp["b2"]
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _ln(x[:, -1], params["lnf_g"], params["lnf_b"], cd)
+    head = params["head"].astype(cd) if cd is not None else params["head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v,
+                    "pos": jnp.asarray(Tp, jnp.int32)}
+
+
+def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
+                cache: Dict, ids_1: Array):
+    """One autoregressive step: ids_1 (b,) int32 at position cache["pos"]
+    → (logits (b, V) fp32, new cache). Attention reads the cached K/V
+    (masked to positions ≤ pos) instead of re-running the prefix — O(T)
+    decoding vs the O(T²) full-forward loop; greedy-parity tested against
+    ``forward`` in tests/test_moe.py.
+
+    MoE note: decode routes only the b current-step tokens (per-step
+    capacity), while the full forward competes all window tokens; when
+    training-time capacity BINDS (dropped tokens), cached decoding can
+    legitimately differ from ``generate`` — parity holds whenever no
+    token is dropped."""
+    cd = _cdtype(cfg)
+    pos = cache["pos"]
+    x = params["embed"][ids_1] + jnp.take(params["pos"], pos, axis=0)[None, :]
+    if cd is not None:
+        x = x.astype(cd)
+    b = x.shape[0]
+    hn = cfg.n_heads
+    d = cfg.d_model
+    scale = 1.0 / math.sqrt(d // hn)
+    valid = (jnp.arange(cfg.max_length) <= pos)  # (T,)
+
+    def body(x, xs):
+        bp, kc, vc = xs  # kc/vc: (b, hn, T, hd)
+        if cd is not None:
+            bp = {k2: (v.astype(cd) if k2[0] in ("W", "b") else v)
+                  for k2, v in bp.items()}
+        a_in = _ln(x, bp["ln1_g"], bp["ln1_b"], cd)
+
+        def head_proj(W):
+            return (a_in @ W).reshape(b, hn, -1)
+
+        q, k, v = head_proj(bp["Wq"]), head_proj(bp["Wk"]), head_proj(bp["Wv"])
+        kc = jax.lax.dynamic_update_index_in_dim(kc, k.astype(kc.dtype), pos, 2)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, v.astype(vc.dtype), pos, 2)
+        scores = jnp.einsum("bhd,bhtd->bht", q, kc).astype(jnp.float32) * scale
+        scores = jnp.where(valid[None, None, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1).astype(kc.dtype)
+        o = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(b, d).astype(x.dtype)
+        x = x + o @ bp["Wo"] + bp["bo"]
+        m_in = _ln(x, bp["ln2_g"], bp["ln2_b"], cd)
+        if cfg.n_experts > 0:
+            from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
+
+            y2, _aux, _load = _moe_ffn(
+                {k2: bp[k2] for k2 in ("Wg", "W1", "b1", "W2", "b2")},
+                m_in, jax.nn.gelu, _moe_capacity(cfg, b), cfg.top_k,
+            )
+            x = x + y2.astype(x.dtype)
+        else:
+            h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
+            x = x + h @ bp["W2"] + bp["b2"]
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
+    head = params["head"].astype(cd) if cd is not None else params["head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+
 def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
             attn_fn=None, pos_offset: int = 0, return_aux: bool = False):
     """ids (b, T) int32 → logits (b, T, V) [, total MoE aux loss].
@@ -306,39 +474,53 @@ class TransformerLM(ZooModel):
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
-        if (top_k or top_p) and temperature <= 0:
-            raise ValueError("top_k/top_p sampling requires temperature > 0")
-        if top_k < 0:
-            raise ValueError(f"top_k must be >= 0, got {top_k}")
-        if top_p and not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        _validate_sampling(temperature, top_k, top_p)
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         for _ in range(max_new):
             window = ids[:, -self.cfg.max_length:]
             logits = self.logits(window)[:, -1]
-            if temperature <= 0:
-                nxt = logits.argmax(-1).astype(np.int32)
-            else:
-                logits = logits / temperature
-                if top_k and top_k < logits.shape[-1]:
-                    kth = np.sort(logits, axis=-1)[:, -top_k][:, None]
-                    logits = np.where(logits < kth, -np.inf, logits)
-                if top_p and 0.0 < top_p < 1.0:
-                    order = np.argsort(-logits, axis=-1)
-                    sorted_l = np.take_along_axis(logits, order, -1)
-                    p_sorted = np.exp(sorted_l - sorted_l.max(-1, keepdims=True))
-                    p_sorted /= p_sorted.sum(-1, keepdims=True)
-                    cum = np.cumsum(p_sorted, -1)
-                    # keep tokens up to AND including the one crossing p
-                    cut = cum - p_sorted >= top_p
-                    sorted_l = np.where(cut, -np.inf, sorted_l)
-                    inv = np.argsort(order, axis=-1)
-                    logits = np.take_along_axis(sorted_l, inv, -1)
-                rng, k = jax.random.split(rng)
-                nxt = np.asarray(
-                    jax.random.categorical(k, jnp.asarray(logits))
-                ).astype(np.int32)
+            nxt, rng = _sample_next(logits, temperature, top_k, top_p, rng)
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        return ids
+
+    def generate_cached(self, prompt_ids: np.ndarray, max_new: int = 20,
+                        temperature: float = 0.0, rng=None, top_k: int = 0,
+                        top_p: float = 0.0) -> np.ndarray:
+        """KV-cache decoding: the prompt prefills per-layer K/V buffers,
+        then each new token is one O(T) ``decode_step`` instead of the
+        O(T²) full-forward loop of ``generate`` (identical outputs —
+        parity-tested). prompt_len + max_new must fit ``max_length``."""
+        ids = np.asarray(prompt_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        _validate_sampling(temperature, top_k, top_p)
+        if ids.shape[1] + max_new > self.cfg.max_length:
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new ({max_new}) exceeds "
+                f"max_length {self.cfg.max_length}"
+            )
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if "decode" not in self._jit_cache:
+            self._jit_cache["decode"] = jax.jit(
+                lambda p, c, t: decode_step(self.cfg, p, c, t),
+                donate_argnums=(1,),
+            )
+            # prefill compiles per distinct prompt length
+            self._jit_cache["prefill"] = jax.jit(
+                lambda p, c, i: prefill_cache(self.cfg, p, c, i),
+                donate_argnums=(1,),
+            )
+        step = self._jit_cache["decode"]
+        cache = init_decode_cache(self.cfg, ids.shape[0])
+        logits, cache = self._jit_cache["prefill"](
+            self.params_, cache, jnp.asarray(ids, jnp.int32))
+        for i in range(max_new):
+            nxt, rng = _sample_next(np.asarray(logits), temperature,
+                                    top_k, top_p, rng)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+            if i < max_new - 1:  # final logits would go unsampled
+                logits, cache = step(self.params_, cache,
+                                     jnp.asarray(nxt, jnp.int32))
         return ids
 
     def perplexity(self, ids: np.ndarray, targets: np.ndarray) -> float:

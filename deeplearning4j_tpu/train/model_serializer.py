@@ -121,6 +121,23 @@ def _flatten_state(state) -> np.ndarray:
 
 
 def _unflatten_state(net, vec: np.ndarray) -> None:
+    expected = sum(
+        int(np.prod(s[name].shape)) for s, name in _state_items(net.state_)
+    )
+    if expected != vec.size:
+        # layer-state layout changed since the checkpoint was written
+        # (e.g. a layer grew a state key): the positional vector cannot be
+        # mapped safely — keep the freshly initialized state (running
+        # stats, observability signals) rather than mis-assigning slices
+        import warnings
+
+        warnings.warn(
+            f"checkpoint layer-state size {vec.size} != current layout "
+            f"{expected}; keeping freshly initialized layer state "
+            "(params/updater are unaffected)",
+            stacklevel=3,
+        )
+        return
     off = 0
     for s, name in _state_items(net.state_):
         n = int(np.prod(s[name].shape))

@@ -146,7 +146,14 @@ def _merge_state(template, saved):
             return template
         merged = [_merge_state(t, s) for t, s in zip(template, saved)]
         return type(template)(merged) if isinstance(template, tuple) else merged
-    return saved if saved is not None else template
+    if saved is None:
+        return template
+    sharding = getattr(template, "sharding", None)
+    if sharding is not None and hasattr(sharding, "mesh"):
+        # placed templates (TP/EP restore path) keep their placement even
+        # for leaves coming through the target-less compat restore
+        return jax.device_put(jax.numpy.asarray(saved), sharding)
+    return saved
 
 
 def _build_from_conf(directory: str, meta: dict):

@@ -465,3 +465,53 @@ class TestExpertLoadObservability:
         assert load.shape == (4,)
         np.testing.assert_allclose(load.sum(), 1.0, atol=1e-5)
         assert (load >= 0).all()
+
+
+class TestKVCacheDecoding:
+    def _trained(self, **kw):
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+        m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                          max_length=16, seed=0, **kw).init()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 32, (8, 16)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+        for _ in range(5):
+            m.fit_batch(ids, tgt)
+        return m, ids
+
+    def test_greedy_parity_with_full_forward(self):
+        m, ids = self._trained()
+        prompt = ids[:2, :5]
+        full = m.generate(prompt, max_new=8)
+        cached = m.generate_cached(prompt, max_new=8)
+        np.testing.assert_array_equal(full, cached)
+
+    def test_greedy_parity_bf16(self):
+        m, ids = self._trained(compute_dtype="bfloat16")
+        prompt = ids[:2, :4]
+        np.testing.assert_array_equal(
+            m.generate(prompt, max_new=6),
+            m.generate_cached(prompt, max_new=6))
+
+    def test_greedy_parity_moe(self):
+        m, ids = self._trained(n_experts=4, capacity_factor=2.0)
+        prompt = ids[:1, :4]
+        np.testing.assert_array_equal(
+            m.generate(prompt, max_new=6),
+            m.generate_cached(prompt, max_new=6))
+
+    def test_sampled_parity_same_rng(self):
+        m, ids = self._trained()
+        prompt = ids[:1, :4]
+        a = m.generate(prompt, max_new=6, temperature=0.8, top_k=5,
+                       rng=jax.random.PRNGKey(7))
+        b = m.generate_cached(prompt, max_new=6, temperature=0.8, top_k=5,
+                              rng=jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(a, b)
+
+    def test_overflow_rejected(self):
+        m, ids = self._trained()
+        with pytest.raises(ValueError, match="max_length"):
+            m.generate_cached(ids[:1, :10], max_new=10)

@@ -226,3 +226,30 @@ class TestOrbaxCheckpointListener:
         np.testing.assert_allclose(np.asarray(out),
                                    np.asarray(net.output(ds.features)),
                                    atol=1e-6)
+
+    def test_zip_restore_with_changed_state_layout_keeps_fresh_state(self, tmp_path):
+        """Old zip checkpoints whose layer-state vector no longer matches
+        the current layout must restore (params intact) with a warning,
+        not crash."""
+        import warnings as _warnings
+        import zipfile
+
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        net = _net(moe=True)
+        ds = _data()
+        net.fit(ds, epochs=1, batch_size=16)
+        p = str(tmp_path / "m.zip")
+        ModelSerializer.write_model(net, p)
+        # simulate an old checkpoint: truncate the state entry to one fp32
+        with zipfile.ZipFile(p) as z:
+            entries = {n: z.read(n) for n in z.namelist()}
+        entries["state.bin"] = np.zeros(1, "<f4").tobytes()
+        with zipfile.ZipFile(p, "w") as z:
+            for n, b in entries.items():
+                z.writestr(n, b)
+        with _warnings.catch_warnings(record=True) as w:
+            _warnings.simplefilter("always")
+            back = ModelSerializer.restore_multi_layer_network(p)
+        assert any("layer-state size" in str(x.message) for x in w)
+        np.testing.assert_allclose(back.params_flat(), net.params_flat())
