@@ -65,7 +65,8 @@ def main():
     assert loss < first
 
     prompt = np.array([[idx[c] for c in "to be or "]], np.int32)
-    out = model.generate(prompt, max_new=20)
+    # KV-cache decoding: batched prefill + O(1)-context steps
+    out = model.generate_cached(prompt, max_new=20)
     text = "".join(chars[i] for i in out[0])
     print("sample:", repr(text))
     assert np.isfinite(loss)
