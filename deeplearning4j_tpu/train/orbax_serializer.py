@@ -29,6 +29,12 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+def _barrier(name: str) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
 class OrbaxModelSerializer:
     @staticmethod
     def save(model, directory: str, save_updater: bool = True,
@@ -40,21 +46,41 @@ class OrbaxModelSerializer:
         ``overwrite=True`` replaces an existing checkpoint atomically
         enough for single-host use (rmtree then rewrite)."""
         directory = os.path.abspath(directory)
+        multi = jax.process_count() > 1
+        # every process validates the PRE-EXISTING directory state BEFORE
+        # anyone writes (the barrier below keeps writers from racing a
+        # sibling's validation — without it, process 1 can observe
+        # process 0's fresh metadata and wrongly refuse)
+        error = None
         if os.path.isdir(directory) and os.listdir(directory):
             if not overwrite:
-                raise ValueError(
+                error = (
                     f"checkpoint directory not empty: {directory} "
                     "(use per-step directories, or overwrite=True)"
                 )
-            if jax.process_count() > 1:
-                # no cross-process barrier between the rmtree and the
-                # other processes' writes — refusing beats corrupting
-                raise ValueError(
+            elif multi:
+                # no safe cross-process rmtree — refusing beats corrupting
+                error = (
                     "overwrite=True is single-host only (rmtree races "
                     "concurrent writers); multi-host restarts must save "
                     "into fresh per-step directories"
                 )
-            shutil.rmtree(directory)
+            else:
+                shutil.rmtree(directory)
+        if multi:
+            # agree on validation BEFORE raising: a host that raised
+            # alone would leave its siblings hanging in the barrier
+            from jax.experimental import multihost_utils
+
+            import numpy as _np
+
+            oks = multihost_utils.process_allgather(
+                _np.asarray(0 if error else 1, _np.int32))
+            if int(_np.min(oks)) == 0 and error is None:
+                error = ("checkpoint directory validation failed on "
+                         "another process")
+        if error is not None:
+            raise ValueError(error)
         os.makedirs(directory, exist_ok=True)
         # metadata from one process only; Orbax coordinates the array
         # writes across processes itself
@@ -69,6 +95,9 @@ class OrbaxModelSerializer:
                     "save_updater": bool(save_updater),
                     "framework": "deeplearning4j_tpu",
                 }, f)
+        if multi:
+            _barrier("dl4jtpu_orbax_meta")  # metadata visible before the
+            # cooperative array writes begin
         ckptr = _checkpointer()
         try:
             ckptr.save(os.path.join(directory, "params"), model.params_)

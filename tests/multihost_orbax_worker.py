@@ -1,0 +1,69 @@
+"""Worker for the multi-host Orbax checkpoint test — 2 processes × 2 CPU
+devices save ONE cooperative TensorStore checkpoint from a global-mesh
+model, then restore it into a placed template and verify parameter
+equality (the jax.distributed checkpoint story OrbaxModelSerializer
+claims).
+
+Usage: python multihost_orbax_worker.py <coordinator> <num_procs> <pid> <outdir>
+"""
+
+import os
+import sys
+
+coordinator, nprocs, pid, outdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostNetwork,
+    ParameterAveragingTrainingMaster,
+    ShardedDataSetIterator,
+    initialize,
+)
+from deeplearning4j_tpu.train.orbax_serializer import (  # noqa: E402
+    OrbaxModelSerializer,
+)
+from tests.multihost_model import build_net, global_batches  # noqa: E402
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs
+
+net = build_net()
+facade = MultiHostNetwork(
+    net, ParameterAveragingTrainingMaster.Builder().build(), ctx)
+facade.fit(ShardedDataSetIterator(global_batches(), nprocs, pid), epochs=1)
+trained = np.asarray(net.params_flat())
+
+ckpt_dir = os.path.join(outdir, "orbax_mh")
+OrbaxModelSerializer.save(net, ckpt_dir)  # cooperative across processes
+
+# metadata must come from process 0 only — but exist for everyone
+assert os.path.exists(os.path.join(ckpt_dir, "meta.json"))
+
+# restore into a placed template: a fresh net trained LONGER (2 epochs)
+# so its params provably differ from the checkpoint before restore —
+# a no-op restore cannot pass the equality check below
+net2 = build_net()
+facade2 = MultiHostNetwork(
+    net2, ParameterAveragingTrainingMaster.Builder().build(), ctx)
+facade2.fit(ShardedDataSetIterator(global_batches(), nprocs, pid), epochs=2)
+pre_restore = np.asarray(net2.params_flat())
+assert not np.allclose(pre_restore, trained), "template must differ"
+restored = OrbaxModelSerializer.restore(ckpt_dir, template=net2)
+np.testing.assert_allclose(
+    np.asarray(restored.params_flat()), trained, rtol=1e-6, atol=1e-7)
+
+with open(os.path.join(outdir, f"orbax_ok_{pid}"), "w") as f:
+    f.write("ok")
+print(f"worker {pid}: orbax multi-host save/restore OK")

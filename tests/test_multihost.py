@@ -20,21 +20,20 @@ import pytest
 HERE = os.path.dirname(__file__)
 
 
-@pytest.mark.slow
-def test_two_process_training_matches_single_process():
+def _run_two_workers(script_name: str, prefix: str, extra_args=()):
+    """Launch two worker processes against a fresh coordinator; returns
+    (outdir, outputs) after asserting both exit 0."""
     from deeplearning4j_tpu.parallel.multihost import free_port
 
     port = free_port()
-    coordinator = f"127.0.0.1:{port}"
-    outdir = tempfile.mkdtemp(prefix="mh_parity_")
-
+    outdir = tempfile.mkdtemp(prefix=prefix)
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
-             coordinator, "2", str(pid), outdir],
+            [sys.executable, os.path.join(HERE, script_name),
+             f"127.0.0.1:{port}", "2", str(pid), outdir, *extra_args],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
         for pid in range(2)
@@ -45,6 +44,12 @@ def test_two_process_training_matches_single_process():
         outs.append(out.decode(errors="replace"))
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+    return outdir, outs
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single_process():
+    outdir, _ = _run_two_workers("multihost_worker.py", "mh_parity_")
 
     result = np.load(os.path.join(outdir, "multihost_result.npz"))
     assert result["iteration"] == 12  # 3 epochs × 4 global batches
@@ -81,28 +86,19 @@ def test_two_process_compressed_gradient_training():
     cross hosts via the gathered messages; both processes converge and
     END WITH IDENTICAL PARAMETERS (the decode is deterministic and
     symmetric — the reference's SharedTraining consistency property)."""
-    from deeplearning4j_tpu.parallel.multihost import free_port
-
-    port = free_port()
-    outdir = tempfile.mkdtemp(prefix="mh_shared_")
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "multihost_shared_worker.py"),
-             f"127.0.0.1:{port}", "2", str(pid), outdir],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-        )
-        for pid in range(2)
-    ]
-    outs = [p.communicate(timeout=600)[0].decode(errors="replace")
-            for p in procs]
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+    outdir, _ = _run_two_workers("multihost_shared_worker.py", "mh_shared_")
 
     r0 = np.load(os.path.join(outdir, "shared_result_0.npz"))
     r1 = np.load(os.path.join(outdir, "shared_result_1.npz"))
     assert r0["last"] < 0.6 * r0["first"], (r0["first"], r0["last"])
     # bit-identical replicas across hosts
     np.testing.assert_allclose(r0["params"], r1["params"], atol=0)
+
+
+@pytest.mark.slow
+def test_two_process_orbax_cooperative_checkpoint():
+    """Cooperative Orbax save from a 2-process global mesh + restore onto
+    a placed template (OrbaxModelSerializer's multi-host contract)."""
+    outdir, _ = _run_two_workers("multihost_orbax_worker.py", "mh_orbax_")
+    for pid in range(2):
+        assert os.path.exists(os.path.join(outdir, f"orbax_ok_{pid}"))
