@@ -63,6 +63,9 @@ def main():
             print(f"step {step:3d} loss {loss:.3f}")
     print(f"loss {first:.3f} -> {loss:.3f}")
     assert loss < first
+    ppl = model.perplexity(x[:64], y[:64])
+    print(f"perplexity: {ppl:.2f}")
+    assert ppl < 3.0  # memorized corpus
 
     prompt = np.array([[idx[c] for c in "to be or "]], np.int32)
     # KV-cache decoding: batched prefill + O(1)-context steps
