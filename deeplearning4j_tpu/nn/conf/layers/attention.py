@@ -66,12 +66,54 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
 
 
+_FLASH_PROBE_CACHE: dict = {}
+
+
+def _flash_attention_works(dtype, head_dim: int, causal: bool) -> bool:
+    """Compile-probe the Pallas flash kernel once per (dtype, head_dim,
+    causal) instantiation. The kernel is compiled server-side under the
+    axon tunnel by whatever Mosaic ships in the runtime libtpu, which can
+    lag the JAX client — e.g. bf16×bf16→f32 ``tpu.matmul`` ("Bad lhs
+    type") is rejected by older Mosaic versions, and an unusual head dim
+    or the non-causal variant lowers differently from the causal 128
+    case. A minimal (1,1,128,head_dim) instance is AOT-*compiled* (not
+    run — only compile-time Mosaic rejections are caught); on failure the
+    dense einsum path is used so a kernel/toolchain mismatch degrades to
+    XLA attention instead of failing the model."""
+    key = (jnp.dtype(dtype).name, int(head_dim), bool(causal))
+    if key in _FLASH_PROBE_CACHE:
+        return _FLASH_PROBE_CACHE[key]
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention,
+        )
+
+        # dense_attention is typically called DURING tracing of a model
+        # step, where an ordinary jit call would be traced into the
+        # caller's graph (silently "succeeding" and still embedding the
+        # pallas op). AOT lower+compile sidesteps the trace context and
+        # surfaces Mosaic compile errors without executing anything.
+        x = jax.ShapeDtypeStruct((1, 1, 128, head_dim), dtype)
+        jax.jit(lambda a: flash_attention(a, a, a, causal=causal)).lower(
+            x).compile()
+        _FLASH_PROBE_CACHE[key] = True
+    except Exception as e:  # Mosaic compile errors surface as varied types
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "Pallas flash attention unavailable for %s (%s: %s) — "
+            "falling back to dense XLA attention", key, type(e).__name__,
+            str(e).split("\n", 1)[0])
+        _FLASH_PROBE_CACHE[key] = False
+    return _FLASH_PROBE_CACHE[key]
+
+
 def _flash_attention_eligible(q, causal, mask, dropout_rate) -> bool:
     """Route to the Pallas TPU flash-attention kernel when it applies:
-    TPU backend, no padding mask / attention dropout, and block-friendly
-    shapes (T multiple of 128, head dim ≥ 128 not required — the kernel
-    pads — but tiny toy shapes stay on the einsum path). Kill switch:
-    DL4J_TPU_FLASH_ATTENTION=0."""
+    TPU backend, no padding mask / attention dropout, block-friendly
+    shapes (T multiple of 128; tiny toy shapes stay on the einsum path),
+    and the kernel compile-probes OK at this dtype (see
+    ``_flash_attention_works``). Kill switch: DL4J_TPU_FLASH_ATTENTION=0."""
     import os
 
     if os.environ.get("DL4J_TPU_FLASH_ATTENTION", "1") == "0":
@@ -86,7 +128,8 @@ def _flash_attention_eligible(q, causal, mask, dropout_rate) -> bool:
     except Exception:
         return False
     T = q.shape[2]
-    return T >= 128 and T % 128 == 0
+    return (T >= 128 and T % 128 == 0
+            and _flash_attention_works(q.dtype, q.shape[-1], causal))
 
 
 def dense_attention(q, k, v, *, causal: bool, mask=None,

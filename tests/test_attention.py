@@ -251,3 +251,48 @@ class TestFlashAttentionGate:
         assert not _flash_attention_eligible(q, True, None, 0.1)
         assert not _flash_attention_eligible(jnp.zeros((2, 4, 100, 128)),
                                              True, None, 0.0)
+
+    def test_compile_probe_failure_falls_back_and_caches(self, monkeypatch):
+        """A Mosaic/toolchain mismatch (e.g. the axon server-side libtpu
+        rejecting bf16 tpu.matmul: "Bad lhs type") must disable the flash
+        path for that instantiation instead of failing the model step.
+        The probe result is cached per (dtype, head_dim, causal)."""
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+
+        monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+        compiles = {"n": 0}
+
+        class _Boom:
+            def lower(self, *a, **k):
+                return self
+
+            def compile(self):
+                compiles["n"] += 1
+                raise RuntimeError("Mosaic failed to compile TPU kernel: "
+                                   "Bad lhs type")
+
+        monkeypatch.setattr(jax, "jit", lambda *a, **k: _Boom())
+        assert A._flash_attention_works(jnp.bfloat16, 64, True) is False
+        assert A._FLASH_PROBE_CACHE == {("bfloat16", 64, True): False}
+        # second call hits the cache: no second compile attempt
+        assert A._flash_attention_works(jnp.bfloat16, 64, True) is False
+        assert compiles["n"] == 1
+        # a different instantiation re-probes
+        assert A._flash_attention_works(jnp.bfloat16, 128, True) is False
+        assert compiles["n"] == 2
+
+    def test_compile_probe_success_enables(self, monkeypatch):
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+
+        monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+
+        class _Ok:
+            def lower(self, *a, **k):
+                return self
+
+            def compile(self):
+                return self
+
+        monkeypatch.setattr(jax, "jit", lambda *a, **k: _Ok())
+        assert A._flash_attention_works(jnp.float32, 128, False) is True
+        assert A._FLASH_PROBE_CACHE == {("float32", 128, False): True}
