@@ -19,9 +19,12 @@ from deeplearning4j_tpu.clustering.distances import (
 from deeplearning4j_tpu.clustering.vptree import VPTree, KDTree
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
+from deeplearning4j_tpu.clustering.sptree import SpTree, QuadTree
+from deeplearning4j_tpu.clustering.rptree import RPTree, RPForest
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 
 __all__ = [
     "pairwise_distance", "batched_knn", "VPTree", "KDTree",
-    "KMeansClustering", "RandomProjectionLSH", "BarnesHutTsne", "Tsne",
+    "KMeansClustering", "RandomProjectionLSH", "SpTree", "QuadTree",
+    "RPTree", "RPForest", "BarnesHutTsne", "Tsne",
 ]

@@ -1,0 +1,230 @@
+"""RPTree / RPForest — random-projection trees for approximate nearest
+neighbours (reference ``clustering/randomprojection/{RPTree,RPForest,
+RPUtils}.java``).
+
+Hybrid host/TPU design: tree *construction* is a host-side recursion of
+random-hyperplane splits (median threshold → balanced, depth log N).
+*Queries* collect candidate leaves with a best-first priority queue over
+all trees at once (annoy-style: the far side of each split is queued by
+its hyperplane margin, so the budget is spent on the most promising
+cells), then the padded candidate block is re-ranked EXACTLY on the MXU
+in one fixed-shape jitted kernel — no per-query recompilation. Recall is
+controlled by ``search_k`` (candidate budget), not by tree quality.
+"""
+
+from __future__ import annotations
+
+import functools
+import heapq
+import itertools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("hyperplane", "threshold", "left", "right", "indices")
+
+    def __init__(self):
+        self.hyperplane: Optional[np.ndarray] = None
+        self.threshold: float = 0.0
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+        self.indices: Optional[np.ndarray] = None  # set on leaves
+
+
+def _bucket(n: int, step: int = 256) -> int:
+    """Round a candidate count up to a bucket so the jitted re-rank
+    kernel sees repeating shapes (one compile per bucket, not one per
+    distinct candidate-set size)."""
+    return max(step, ((n + step - 1) // step) * step)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _rerank_kernel(qs, pts, valid, k: int):
+    """Exact top-k over per-query candidate blocks.
+    qs (Q, D); pts (Q, C, D) gathered candidates; valid (Q, C) bool.
+    Returns (distances (Q, k), positions (Q, k) into the C axis)."""
+    d2 = jnp.sum((pts - qs[:, None, :]) ** 2, -1)          # (Q, C)
+    d2 = jnp.where(valid, d2, jnp.inf)
+    neg_top, pos = jax.lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg_top, 0.0)), pos
+
+
+class RPTree:
+    """One random-projection tree (reference ``RPTree.java``:
+    ``RPTree(dim, maxSize)`` then ``buildTree(x)`` / ``query(x, k)``)."""
+
+    def __init__(self, dim: int, max_size: int = 50, seed: int = 0):
+        self.dim = int(dim)
+        self.max_size = max(1, int(max_size))
+        self._rng = np.random.default_rng(seed)
+        self._root: Optional[_Node] = None
+        self._data: Optional[np.ndarray] = None
+
+    def build_tree(self, x) -> None:
+        x = np.asarray(x, np.float32)
+        if x.ndim != 2 or x.shape[1] != self.dim:
+            raise ValueError(f"expected (N, {self.dim}); got {x.shape}")
+        self._data = x
+        self._root = self._build(np.arange(len(x)))
+
+    # reference camelCase alias
+    buildTree = build_tree
+
+    def _build(self, idx: np.ndarray) -> _Node:
+        node = _Node()
+        if len(idx) <= self.max_size:
+            node.indices = idx
+            return node
+        h = self._rng.standard_normal(self.dim).astype(np.float32)
+        h /= max(np.linalg.norm(h), 1e-12)
+        proj = self._data[idx] @ h
+        thr = float(np.median(proj))
+        left, right = idx[proj <= thr], idx[proj > thr]
+        if len(left) == 0 or len(right) == 0:  # coincident projections
+            node.indices = idx
+            return node
+        node.hyperplane, node.threshold = h, thr
+        node.left, node.right = self._build(left), self._build(right)
+        return node
+
+    def get_candidates(self, query, search_k: Optional[int] = None) -> np.ndarray:
+        """Candidate indices for one query: the best-first union of leaves
+        until ≥ ``search_k`` candidates (default: one leaf)."""
+        if self._root is None:
+            raise ValueError("call build_tree first")
+        q = np.asarray(query, np.float32).reshape(-1)
+        budget = self.max_size if search_k is None else int(search_k)
+        return _collect_candidates(q, [self._root], budget)
+
+    def query(self, query, k: int,
+              search_k: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(distances (k',), indices (k',)) nearest-first, re-ranked
+        exactly over the candidate set; k' = min(k, candidates)."""
+        cand = self.get_candidates(query, search_k)
+        q = np.asarray(query, np.float32).reshape(1, -1)
+        kk = min(k, len(cand))
+        C = _bucket(len(cand))                 # shape-bucketed: compile once
+        pts = np.zeros((1, C, self._data.shape[1]), np.float32)
+        pts[0, :len(cand)] = self._data[cand]
+        valid = np.zeros((1, C), bool)
+        valid[0, :len(cand)] = True
+        d, pos = _rerank_kernel(jnp.asarray(q), jnp.asarray(pts),
+                                jnp.asarray(valid), kk)
+        return np.asarray(d[0]), cand[np.asarray(pos[0])]
+
+    def depth(self) -> int:
+        def _d(n):
+            return 1 if n.indices is not None else 1 + max(_d(n.left), _d(n.right))
+        return 0 if self._root is None else _d(self._root)
+
+
+def _collect_candidates(q: np.ndarray, roots: List[_Node], budget: int) -> np.ndarray:
+    """Best-first leaf collection across trees (annoy-style): internal
+    nodes are expanded immediately on the near side; the far side is
+    queued by its margin |q·h − thr| and popped only while the candidate
+    budget is unmet."""
+    counter = itertools.count()          # tie-break: heapq needs orderable
+    heap = [(0.0, next(counter), r) for r in roots]
+    out: List[np.ndarray] = []
+    total = 0
+    while heap and total < budget:
+        _, _, node = heapq.heappop(heap)
+        while node.indices is None:
+            margin = float(q @ node.hyperplane) - node.threshold
+            near, far = ((node.left, node.right) if margin <= 0
+                         else (node.right, node.left))
+            heapq.heappush(heap, (abs(margin), next(counter), far))
+            node = near
+        out.append(node.indices)
+        total += len(node.indices)
+    return np.unique(np.concatenate(out)) if out else np.zeros(0, np.int64)
+
+
+class RPForest:
+    """Forest of RPTrees with exact MXU re-ranking over the candidate
+    union (reference ``RPForest.java``: ``RPForest(numTrees, maxSize,
+    similarityFunction)`` → ``fit(x)`` → ``queryAll(toQuery, k)`` /
+    ``getAllCandidates(x)``). Euclidean re-rank (the reference's RPUtils
+    default); ``search_k`` tunes the recall/latency trade-off and
+    defaults to ``4 · num_trees · max_size``."""
+
+    def __init__(self, num_trees: int = 10, max_size: int = 50,
+                 similarity_function: str = "euclidean", seed: int = 0,
+                 search_k: Optional[int] = None):
+        if similarity_function != "euclidean":
+            raise ValueError("RPForest re-rank supports euclidean only "
+                             "(reference RPUtils default); use VPTree for "
+                             "other metrics")
+        self.num_trees = int(num_trees)
+        self.max_size = int(max_size)
+        self.similarity_function = similarity_function
+        self.seed = int(seed)
+        self.search_k = (int(search_k) if search_k is not None
+                         else 4 * self.num_trees * self.max_size)
+        self._trees: List[RPTree] = []
+        self._data: Optional[np.ndarray] = None
+
+    def fit(self, x) -> "RPForest":
+        x = np.asarray(x, np.float32)
+        self._data = x
+        self._trees = []
+        for t in range(self.num_trees):
+            tree = RPTree(x.shape[1], self.max_size, seed=self.seed + t)
+            tree.build_tree(x)
+            self._trees.append(tree)
+        return self
+
+    def get_all_candidates(self, query,
+                           search_k: Optional[int] = None) -> np.ndarray:
+        """Best-first candidate union across all trees, sorted."""
+        if not self._trees:
+            raise ValueError("call fit first")
+        q = np.asarray(query, np.float32).reshape(-1)
+        return _collect_candidates(
+            q, [t._root for t in self._trees],
+            self.search_k if search_k is None else int(search_k))
+
+    def query(self, query, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(distances (k,), indices (k,)) for one query, nearest first."""
+        d, i = self.query_all(np.asarray(query, np.float32).reshape(1, -1), k)
+        return d[0], i[0]
+
+    def query_all(self, queries, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched queries → (distances (Q, k), indices (Q, k)), exact
+        over each query's candidate union. Candidate blocks are padded to
+        one shared shape so the whole batch re-ranks in ONE jitted kernel
+        call (no per-query shapes → no recompilation)."""
+        queries = np.asarray(queries, np.float32)
+        if queries.ndim == 1:
+            queries = queries[None]
+        cands = [self.get_all_candidates(q) for q in queries]
+        C = _bucket(max(max((len(c) for c in cands), default=1), k))
+        Q = len(queries)
+        block = np.zeros((Q, C), np.int64)
+        valid = np.zeros((Q, C), bool)
+        for i, c in enumerate(cands):
+            block[i, :len(c)] = c
+            valid[i, :len(c)] = True
+        d, pos = _rerank_kernel(jnp.asarray(queries),
+                                jnp.asarray(self._data[block]),
+                                jnp.asarray(valid), int(k))
+        d = np.asarray(d)
+        idx = np.take_along_axis(block, np.asarray(pos), 1)
+        # rows with < k candidates: clamp the padding tail onto the
+        # farthest real hit so callers always get genuine indices
+        short = ~np.take_along_axis(valid, np.asarray(pos), 1)
+        if short.any():
+            for i in np.flatnonzero(short.any(1)):
+                good = np.flatnonzero(~short[i])
+                last = good[-1] if len(good) else 0
+                idx[i, short[i]] = idx[i, last]
+                d[i, short[i]] = d[i, last]
+        return d, idx
+
+    # reference camelCase aliases
+    queryAll = query_all
+    getAllCandidates = get_all_candidates

@@ -1,0 +1,212 @@
+"""SpTree / QuadTree — Barnes-Hut space-partitioning trees
+(reference ``clustering/sptree/SpTree.java``, ``clustering/quadtree/
+QuadTree.java`` — the dual-tree machinery behind ``BarnesHutTsne.java``).
+
+Array-based rather than pointer-chasing: the whole tree is built once per
+point set into flat numpy arrays (center-of-mass, cumulative size, cell
+center/half-width), with children kept as per-node octant dicts keyed by
+the point's side-of-center bit pattern — so dimensionality is unbounded
+(no dense 2^d child table) and only occupied octants allocate nodes.
+Force queries (``compute_non_edge_forces``) follow the reference's
+theta-criterion traversal exactly: a cell is summarized by its center of
+mass when ``max_width / distance < theta``, else descended.
+
+On TPU the production t-SNE gradient path does NOT traverse this tree —
+tsne.py uses kNN-sparse attraction + row-chunked dense repulsion on the
+MXU (same approximation family, better accuracy; see tsne.py). The tree
+classes exist for reference API parity and for host-side callers that
+want the classic O(N log N) evaluation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SpTree:
+    """n-dimensional Barnes-Hut tree over a fixed point matrix.
+
+    Reference surface (``SpTree.java``): built from the full data matrix,
+    then ``get_center_of_mass()``, ``get_cum_size()``, ``is_correct()``,
+    ``depth()``, ``compute_non_edge_forces(point_index, theta)`` and
+    ``compute_edge_forces(rows, cols, vals)``.
+    """
+
+    def __init__(self, data, leaf_size: int = 1):
+        self.data = np.asarray(data, np.float32)
+        if self.data.ndim != 2:
+            raise ValueError(f"data must be (N, D); got {self.data.shape}")
+        n, d = self.data.shape
+        self.n, self.d = n, d
+        self.leaf_size = max(1, int(leaf_size))
+
+        cap = max(4, 4 * n)
+        self._center = np.zeros((cap, d), np.float32)      # cell center
+        self._half = np.zeros((cap, d), np.float32)        # cell half-width
+        self._com = np.zeros((cap, d), np.float64)         # center of mass
+        self._size = np.zeros(cap, np.int64)               # cumulative size
+        self._children: List[Dict[bytes, int]] = []        # occupied octants
+        self._leaf_start = np.full(cap, -1, np.int64)      # into _leaf_index
+        self._leaf_count = np.zeros(cap, np.int64)
+        self._n_nodes = 0
+        self._leaf_points: List[np.ndarray] = []
+        self._leaf_total = 0                               # running offset
+        self._depth = 0
+
+        lo = self.data.min(0) if n else np.zeros(d, np.float32)
+        hi = self.data.max(0) if n else np.ones(d, np.float32)
+        center = (lo + hi) / 2.0
+        half = np.maximum((hi - lo) / 2.0, 1e-5) * (1.0 + 1e-3)
+        root = self._alloc(center, half)
+        if n:
+            self._build(root, np.arange(n), 1)
+        self._leaf_index = (np.concatenate(self._leaf_points)
+                            if self._leaf_points else np.zeros(0, np.int64))
+
+    # -- construction -----------------------------------------------------
+    def _alloc(self, center, half) -> int:
+        i = self._n_nodes
+        if i == len(self._size):
+            grow = len(self._size)
+            self._center = np.concatenate([self._center, np.zeros((grow, self.d), np.float32)])
+            self._half = np.concatenate([self._half, np.zeros((grow, self.d), np.float32)])
+            self._com = np.concatenate([self._com, np.zeros((grow, self.d), np.float64)])
+            self._size = np.concatenate([self._size, np.zeros(grow, np.int64)])
+            self._leaf_start = np.concatenate([self._leaf_start, np.full(grow, -1, np.int64)])
+            self._leaf_count = np.concatenate([self._leaf_count, np.zeros(grow, np.int64)])
+        self._center[i], self._half[i] = center, half
+        self._children.append({})
+        self._n_nodes += 1
+        return i
+
+    def _build(self, node: int, idx: np.ndarray, depth: int) -> None:
+        pts = self.data[idx]
+        self._com[node] = pts.mean(0)
+        self._size[node] = len(idx)
+        self._depth = max(self._depth, depth)
+        # leaf: few points, or all coincident (cannot split further)
+        if len(idx) <= self.leaf_size or np.all(pts == pts[0]):
+            self._leaf_start[node] = self._leaf_total
+            self._leaf_count[node] = len(idx)
+            self._leaf_points.append(idx)
+            self._leaf_total += len(idx)
+            return
+        # octant key per point: the side-of-center bit pattern, packed to
+        # bytes so any dimensionality works (only occupied octants exist)
+        bits = pts >= self._center[node]                   # (n, d) bool
+        keys = np.packbits(bits, axis=1)                   # (n, ceil(d/8))
+        uniq, inverse = np.unique(keys, axis=0, return_inverse=True)
+        for u in range(len(uniq)):
+            sub = idx[inverse == u]
+            child_bits = np.unpackbits(uniq[u])[:self.d].astype(bool)
+            offs = np.where(child_bits, 0.5, -0.5).astype(np.float32)
+            child = self._alloc(self._center[node] + offs * self._half[node],
+                                self._half[node] / 2.0)
+            self._children[node][uniq[u].tobytes()] = child
+            self._build(child, sub, depth + 1)
+
+    # -- reference query surface ------------------------------------------
+    def get_center_of_mass(self) -> np.ndarray:
+        return self._com[0].astype(np.float32)
+
+    def get_cum_size(self) -> int:
+        return int(self._size[0])
+
+    def depth(self) -> int:
+        return self._depth
+
+    def is_correct(self) -> bool:
+        """Every point lies inside its leaf's cell (reference
+        ``SpTree.isCorrect``)."""
+        for node in range(self._n_nodes):
+            cnt = self._leaf_count[node]
+            if cnt <= 0:
+                continue
+            s = self._leaf_start[node]
+            pts = self.data[self._leaf_index[s:s + cnt]]
+            if np.any(np.abs(pts - self._center[node]) > self._half[node] + 1e-4):
+                return False
+        return True
+
+    def compute_non_edge_forces(self, point_index: int, theta: float,
+                                point: Optional[np.ndarray] = None,
+                                ) -> Tuple[np.ndarray, float]:
+        """Barnes-Hut repulsive force for one point under the Student-t
+        kernel (reference ``SpTree.computeNonEdgeForces``): returns
+        ``(neg_force (D,), sum_Q)`` where each accepted cell contributes
+        ``q = 1/(1+d²)``, force ``q²·size·(y−com)`` and ``sum_Q +=
+        q·size``. ``theta=0`` descends to leaves → exact. Cells whose
+        bounds contain the query point are always descended (self-
+        exclusion then happens in the leaf branch), so the summarization
+        error stays bounded in any dimensionality."""
+        y = self.data[point_index] if point is None else np.asarray(point, np.float64)
+        neg_f = np.zeros(self.d, np.float64)
+        sum_q = 0.0
+        stack = [0]
+        while stack:
+            node = stack.pop()
+            size = self._size[node]
+            if size == 0:
+                continue
+            diff = y - self._com[node]
+            d2 = float(diff @ diff)
+            max_width = float(self._half[node].max() * 2.0)
+            # never summarize a cell whose bounds contain the query point
+            # (in high d the theta criterion alone can accept it: cell
+            # diagonals grow like sqrt(d) while |y−com| can be large even
+            # for the root; summarizing would collapse y's own neighbours
+            # — and y itself — into one far center-of-mass term)
+            contains_y = bool(
+                np.all(np.abs(y - self._center[node]) <= self._half[node]))
+            if (not contains_y) and max_width * max_width < theta * theta * d2:
+                # summarize cell by its center of mass
+                q = 1.0 / (1.0 + d2)
+                sum_q += q * size
+                neg_f += (q * q * size) * diff
+            elif self._leaf_count[node] > 0:
+                # leaf: exact over member points (skip the query point)
+                s, c = self._leaf_start[node], self._leaf_count[node]
+                members = self._leaf_index[s:s + c]
+                if point is None:
+                    members = members[members != point_index]
+                if len(members) == 0:
+                    continue
+                dif = y - self.data[members]
+                q = 1.0 / (1.0 + np.sum(dif * dif, 1))
+                sum_q += float(q.sum())
+                neg_f += (q * q) @ dif
+            else:
+                stack.extend(self._children[node].values())
+        return neg_f.astype(np.float32), float(sum_q)
+
+    def compute_edge_forces(self, rows: np.ndarray, cols: np.ndarray,
+                            vals: np.ndarray) -> np.ndarray:
+        """Attractive forces from a sparse COO affinity matrix (reference
+        ``SpTree.computeEdgeForces``): F[i] += p_ij·q_ij·(y_i − y_j)."""
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        vals = np.asarray(vals, np.float64)
+        dif = self.data[rows].astype(np.float64) - self.data[cols]
+        q = 1.0 / (1.0 + np.sum(dif * dif, 1))
+        contrib = (vals * q)[:, None] * dif
+        out = np.zeros((self.n, self.d), np.float64)
+        np.add.at(out, rows, contrib)
+        return out.astype(np.float32)
+
+
+class QuadTree(SpTree):
+    """2-D special case (reference ``quadtree/QuadTree.java`` — the
+    original Barnes-Hut structure used by t-SNE before the n-d SpTree).
+    Same array-based engine with the 2-D API names."""
+
+    def __init__(self, data, leaf_size: int = 1):
+        data = np.asarray(data, np.float32)
+        if data.ndim != 2 or data.shape[1] != 2:
+            raise ValueError(f"QuadTree requires (N, 2) data; got {data.shape}")
+        super().__init__(data, leaf_size=leaf_size)
+
+    def get_boundary(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(center (2,), half_width (2,)) of the root cell."""
+        return self._center[0].copy(), self._half[0].copy()

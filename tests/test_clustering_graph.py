@@ -323,3 +323,191 @@ class TestNode2Vec:
             nv.similarity(i, j) for i in range(1, 6) for j in range(7, 12)
         ])
         assert within > across
+
+
+# --------------------------------------------------------------------------
+class TestSpTree:
+    """SpTree/QuadTree (reference clustering/sptree, clustering/quadtree):
+    structural invariants + Barnes-Hut force evaluation vs the exact
+    Student-t repulsion sum."""
+
+    @staticmethod
+    def exact_non_edge(data, i):
+        dif = data[i] - np.delete(data, i, 0)
+        q = 1.0 / (1.0 + np.sum(dif * dif, 1))
+        return (q * q) @ dif, float(q.sum())
+
+    def test_structure_and_com(self):
+        from deeplearning4j_tpu.clustering import SpTree
+
+        X, _ = blobs(n_per=40, centers=2, dim=3, seed=3)
+        t = SpTree(X)
+        assert t.get_cum_size() == len(X)
+        np.testing.assert_allclose(t.get_center_of_mass(), X.mean(0),
+                                   rtol=1e-5, atol=1e-5)
+        assert t.is_correct()
+        assert t.depth() >= 2
+
+    def test_theta_zero_is_exact(self):
+        from deeplearning4j_tpu.clustering import SpTree
+
+        X, _ = blobs(n_per=25, centers=2, dim=2, seed=4)
+        t = SpTree(X)
+        for i in (0, 17, 49):
+            f, z = t.compute_non_edge_forces(i, theta=0.0)
+            f_ref, z_ref = self.exact_non_edge(X, i)
+            np.testing.assert_allclose(f, f_ref, rtol=1e-4, atol=1e-5)
+            assert abs(z - z_ref) < 1e-3
+
+    def test_theta_half_approximates(self):
+        from deeplearning4j_tpu.clustering import SpTree
+
+        X, _ = blobs(n_per=60, centers=3, dim=2, seed=5, spread=0.5)
+        t = SpTree(X)
+        for i in (0, 90, 179):
+            f, z = t.compute_non_edge_forces(i, theta=0.5)
+            f_ref, z_ref = self.exact_non_edge(X, i)
+            assert abs(z - z_ref) / z_ref < 0.1
+            denom = np.linalg.norm(f_ref) + 1e-9
+            assert np.linalg.norm(f - f_ref) / denom < 0.25
+
+    def test_edge_forces_match_direct(self):
+        from deeplearning4j_tpu.clustering import SpTree
+
+        X, _ = blobs(n_per=20, centers=2, dim=2, seed=6)
+        t = SpTree(X)
+        rows = np.array([0, 0, 5, 39])
+        cols = np.array([1, 2, 9, 0])
+        vals = np.array([0.5, 0.25, 1.0, 0.125], np.float32)
+        F = t.compute_edge_forces(rows, cols, vals)
+        expected = np.zeros_like(X)
+        for r, c, v in zip(rows, cols, vals):
+            dif = X[r] - X[c]
+            expected[r] += v * dif / (1.0 + dif @ dif)
+        np.testing.assert_allclose(F, expected, rtol=1e-4, atol=1e-6)
+
+    def test_quadtree_is_2d(self):
+        from deeplearning4j_tpu.clustering import QuadTree
+
+        X, _ = blobs(n_per=30, centers=2, dim=2, seed=7)
+        q = QuadTree(X)
+        assert q.is_correct() and q.get_cum_size() == 60
+        center, half = q.get_boundary()
+        assert center.shape == (2,) and np.all(half > 0)
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((4, 3), np.float32))
+
+
+class TestRPForest:
+    def test_leaf_exact_when_forest_covers_all(self):
+        from deeplearning4j_tpu.clustering import RPTree
+
+        X, _ = blobs(n_per=30, centers=2, dim=8, seed=8)
+        t = RPTree(8, max_size=len(X))   # single leaf → exact
+        t.build_tree(X)
+        d, idx = t.query(X[7], k=5)
+        d_ref, idx_ref = brute_knn(X[7:8], X, 5)
+        np.testing.assert_array_equal(idx, idx_ref[0])
+        np.testing.assert_allclose(d, d_ref[0], rtol=1e-4, atol=1e-5)
+
+    def test_forest_recall(self):
+        from deeplearning4j_tpu.clustering import RPForest
+
+        X, _ = blobs(n_per=200, centers=4, dim=16, seed=9, spread=0.6)
+        f = RPForest(num_trees=8, max_size=40).fit(X)
+        qs = X[::37]
+        d_ref, idx_ref = brute_knn(qs, X, 10)
+        ds, idxs = f.query_all(qs, 10)
+        recall = np.mean([len(set(a) & set(b)) / 10.0
+                          for a, b in zip(idxs, idx_ref)])
+        assert recall >= 0.9, f"RPForest recall {recall}"
+        # distances are genuine euclidean distances of returned indices
+        np.testing.assert_allclose(
+            ds[0], np.linalg.norm(X[idxs[0]] - qs[0], axis=1), rtol=1e-4,
+            atol=1e-5)
+
+    def test_tree_depth_log(self):
+        from deeplearning4j_tpu.clustering import RPTree
+
+        rng = np.random.default_rng(10)
+        X = rng.standard_normal((512, 4)).astype(np.float32)
+        t = RPTree(4, max_size=16, seed=1)
+        t.build_tree(X)
+        assert 4 <= t.depth() <= 10  # balanced median splits → ~log2(512/16)+1
+
+
+class TestTsneSparseLargeN:
+    def test_sparse_path_separates_blobs(self):
+        """BarnesHutTsne beyond dense_cutoff routes to the kNN-sparse +
+        chunked-repulsion path and still separates well-separated blobs."""
+        X, y = blobs(n_per=150, centers=3, dim=10, seed=11, spread=0.4)
+        t = BarnesHutTsne(theta=0.5, dense_cutoff=100, chunk=128,
+                          max_iter=250, perplexity=20.0, seed=2)
+        Y = t.fit_transform(X)
+        assert Y.shape == (450, 2)
+        assert np.all(np.isfinite(Y))
+        assert np.isfinite(t.kl_divergence_)
+        # intra-cluster spread well under inter-cluster separation
+        cents = np.stack([Y[y == c].mean(0) for c in range(3)])
+        intra = max(np.linalg.norm(Y[y == c] - cents[c], axis=1).mean()
+                    for c in range(3))
+        inter = min(np.linalg.norm(cents[a] - cents[b])
+                    for a in range(3) for b in range(a + 1, 3))
+        assert inter > 2.0 * intra, (intra, inter)
+
+    def test_sparse_matches_dense_quality(self):
+        """On the same data, sparse-path KL should land near the dense
+        exact path's KL (same approximation family as the reference's
+        Barnes-Hut: sparse input affinities)."""
+        X, _ = blobs(n_per=80, centers=3, dim=8, seed=12, spread=0.5)
+        dense = BarnesHutTsne(theta=0.0, max_iter=200, perplexity=15.0, seed=3)
+        dense.fit(X)
+        sparse = BarnesHutTsne(theta=0.5, dense_cutoff=10, chunk=64,
+                               max_iter=200, perplexity=15.0, seed=3)
+        sparse.fit(X)
+        assert sparse.kl_divergence_ < max(2.0 * dense.kl_divergence_, 0.5), (
+            sparse.kl_divergence_, dense.kl_divergence_)
+
+    def test_high_dim_builds(self):
+        """d=30 must build without a dense 2^d child table (review
+        finding: octant dicts, not a (4N, 2**d) array)."""
+        from deeplearning4j_tpu.clustering import SpTree
+
+        rng = np.random.default_rng(13)
+        X = rng.standard_normal((200, 30)).astype(np.float32)
+        t = SpTree(X, leaf_size=8)
+        assert t.get_cum_size() == 200 and t.is_correct()
+        f, z = t.compute_non_edge_forces(3, theta=0.0)
+        f_ref, z_ref = TestSpTree.exact_non_edge(X, 3)
+        np.testing.assert_allclose(f, f_ref, rtol=1e-4, atol=1e-5)
+        assert abs(z - z_ref) < 1e-3
+
+
+class TestBarnesHutBuilderTheta:
+    def test_builder_theta_reaches_instance(self):
+        t = (BarnesHutTsne.builder().theta(0.0).dense_cutoff(50).chunk(32)
+             .set_max_iter(5).build())
+        assert t.theta == 0.0 and t.dense_cutoff == 50 and t.chunk == 32
+        t2 = BarnesHutTsne.builder().theta(0.7).build()
+        assert t2.theta == 0.7
+
+
+class TestSpTreeContainment:
+    def test_theta_never_summarizes_containing_cell(self):
+        """Review repro: two tight clusters at opposite corners in d=30 —
+        the root cell contains the query point AND passes the bare theta
+        criterion; summarizing it collapses the point's own neighbours
+        into one far center-of-mass term (sum_Q 0.13 vs exact 48.8)."""
+        from deeplearning4j_tpu.clustering import SpTree
+
+        rng = np.random.default_rng(21)
+        d = 30
+        a = rng.standard_normal((50, d)).astype(np.float32) * 0.01
+        b = 10.0 + rng.standard_normal((50, d)).astype(np.float32) * 0.01
+        X = np.concatenate([a, b])
+        t = SpTree(X, leaf_size=4)
+        f, z = t.compute_non_edge_forces(0, theta=0.5)
+        f_ref, z_ref = TestSpTree.exact_non_edge(X, 0)
+        assert abs(z - z_ref) / z_ref < 0.1, (z, z_ref)
+        denom = np.linalg.norm(f_ref) + 1e-9
+        assert np.linalg.norm(f - f_ref) / denom < 0.3
