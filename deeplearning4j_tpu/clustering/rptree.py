@@ -212,7 +212,7 @@ class RPForest:
         d, pos = _rerank_kernel(jnp.asarray(queries),
                                 jnp.asarray(self._data[block]),
                                 jnp.asarray(valid), int(k))
-        d = np.asarray(d)
+        d = np.array(d)  # writable copy: the short-row clamp writes below
         idx = np.take_along_axis(block, np.asarray(pos), 1)
         # rows with < k candidates: clamp the padding tail onto the
         # farthest real hit so callers always get genuine indices

@@ -157,13 +157,23 @@ class Deconvolution2D(BaseConvLayer):
         return p
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        kh, kw = self.kernel_size
         ph, pw = self.padding
-        pad = "SAME" if self.convolution_mode == "same" else [(ph, ph), (pw, pw)]
+        # Gradient-of-conv semantics (TF/Keras Conv2DTranspose): W is
+        # (kh, kw, n_out, n_in); read it as the FORWARD conv's HWIO
+        # kernel (whose I is this layer's n_out) with transpose_kernel —
+        # verified exact against jax.vjp of the forward conv. Explicit
+        # pad pairs are TRANSPOSED-space padding: forward padding p maps
+        # to k-1-p per side (output h = s·(h−1)+k−2p, get_output_type).
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            pad = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
         y = lax.conv_transpose(
             x, params["W"],
             strides=tuple(self.stride),
             padding=pad,
-            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
             transpose_kernel=True,
         )
         if self.has_bias:

@@ -511,3 +511,20 @@ class TestSpTreeContainment:
         assert abs(z - z_ref) / z_ref < 0.1, (z, z_ref)
         denom = np.linalg.norm(f_ref) + 1e-9
         assert np.linalg.norm(f - f_ref) / denom < 0.3
+
+
+class TestRPForestShortRows:
+    def test_query_all_with_fewer_candidates_than_k(self):
+        """Review repro: rows with < k candidates must clamp, not crash
+        writing into a read-only JAX-backed numpy view."""
+        from deeplearning4j_tpu.clustering import RPForest
+
+        rng = np.random.default_rng(30)
+        X = rng.standard_normal((30, 8)).astype(np.float32)
+        f = RPForest(num_trees=1, max_size=1, search_k=3).fit(X)
+        ds, idxs = f.query_all(X[:5], 8)
+        assert ds.shape == (5, 8) and idxs.shape == (5, 8)
+        assert np.all(np.isfinite(ds))
+        assert np.all((idxs >= 0) & (idxs < 30))
+        # clamped tail repeats the farthest real hit, monotone distances
+        assert np.all(np.diff(ds, axis=1) >= -1e-5)

@@ -218,3 +218,139 @@ class TestMoEGradients:
         x = rng.standard_normal((3, 4, 6)).astype(np.float32)
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 4))]
         assert check_gradients_graph(net, DataSet(x, y), print_results=True)
+
+
+class TestGradientChecksExtended:
+    """Widened layer sweep mirroring the reference's CNNGradientCheckTest
+    special-conv cases and attention/VAE additions."""
+
+    def test_deconvolution(self):
+        from deeplearning4j_tpu.nn.conf.layers import Deconvolution2D
+
+        net = _build(
+            [ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(2, 2)),
+             Deconvolution2D(n_out=2, kernel_size=(3, 3), stride=(2, 2)),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(8, 8, 2),
+        )
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((3, 8, 8, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_separable_conv_upsampling(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            SeparableConvolution2D,
+            Upsampling2D,
+        )
+
+        net = _build(
+            [SeparableConvolution2D(n_out=4, kernel_size=(3, 3), depth_multiplier=2),
+             Upsampling2D(size=2),
+             GlobalPoolingLayer(pooling_type="max"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(6, 6, 2),
+        )
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((3, 6, 6, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_crop_pad_space_to_depth(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Cropping2D,
+            SpaceToDepthLayer,
+            ZeroPaddingLayer,
+        )
+
+        net = _build(
+            [ZeroPaddingLayer(pad=(1, 1)),
+             Cropping2D(crop=(1, 1)),
+             SpaceToDepthLayer(block_size=2),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(4, 4, 2),
+        )
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((3, 4, 4, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_embedding_sequence_lstm(self):
+        from deeplearning4j_tpu.nn.conf.layers import EmbeddingSequenceLayer
+
+        net = _build(
+            [EmbeddingSequenceLayer(n_in=7, n_out=4),
+             LSTM(n_out=5),
+             RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
+            InputType.recurrent(1),
+        )
+        rng = np.random.default_rng(5)
+        x = rng.integers(0, 7, (2, 5, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, 5))]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_self_attention_block(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            SelfAttentionLayer,
+        )
+
+        net = _build(
+            [SelfAttentionLayer(n_heads=2, causal=True),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(6),
+        )
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 4, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 4))]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_vae_supervised(self):
+        from deeplearning4j_tpu.nn.conf.layers.variational import (
+            VariationalAutoencoder,
+        )
+
+        net = _build(
+            [VariationalAutoencoder(n_out=3, encoder_layer_sizes=(6,),
+                                    decoder_layer_sizes=(6,)),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.feed_forward(4),
+        )
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_deconv_matches_gradient_of_conv(self):
+        """Deconvolution2D forward == jax.vjp of the forward conv (the
+        TF/Keras Conv2DTranspose convention) for p=0 and p=1 — guards
+        the transpose_kernel/padding translation in conv.py (regression:
+        the layer once double-swapped I/O and shape-errored for
+        n_in != n_out)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from deeplearning4j_tpu.nn.conf.layers import Deconvolution2D
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+        rng = np.random.default_rng(0)
+        for p in (0, 1):
+            layer = Deconvolution2D(n_out=2, kernel_size=(3, 3),
+                                    stride=(2, 2), padding=(p, p),
+                                    activation="identity", has_bias=False)
+            it = InputType.convolutional(5, 5, 3)
+            layer.initialize(it)
+            params = layer.init_params(jax.random.PRNGKey(0), it)
+            x = jnp.asarray(rng.standard_normal((2, 5, 5, 3)).astype(np.float32))
+            y, _ = layer.apply(params, x)
+            H = 2 * 4 + 3 - 2 * p
+            fwd = lambda inp: lax.conv_general_dilated(
+                inp, params["W"], (2, 2), [(p, p), (p, p)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            _, vjp = jax.vjp(fwd, jnp.zeros((2, H, H, 2)))
+            ref = vjp(x)[0]
+            assert y.shape == ref.shape == (2, H, H, 2)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                       atol=1e-5)
