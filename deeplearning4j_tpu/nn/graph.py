@@ -368,18 +368,79 @@ class ComputationGraph:
         self._rng, k = jax.random.split(self._rng)
         return k
 
+    def _make_introspect_fn(self):
+        """(vertex-activation dict, gradients) for one batch — listener
+        introspection (SURVEY §7 hard-part 1); same rng as the train step
+        so the reported values match the step bit-for-bit. Output-vertex
+        activations are recomputed with the score path's weight-noise key
+        (fold_in(rng, output_index)) so they reflect the params the
+        step's loss actually used."""
+
+        def run(params, state, feats, labels, fmasks, lmasks, rng):
+            acts, _, out_inputs, _ = self._forward(
+                params, state, feats, train=True, rng=rng, fmasks=fmasks)
+            for i, name in enumerate(self.conf.network_outputs):
+                layer = self._layer(name)
+                x, m = out_inputs[name]
+                if self._compute_dtype is not None:
+                    x = x.astype(jnp.float32)
+                k = jax.random.fold_in(rng, i)
+                p_out = apply_weight_noise(layer, params[name], True, k)
+                y, _ = layer.apply(p_out, x, state=state[name], train=True,
+                                   rng=k, mask=m)
+                acts = dict(acts)
+                acts[name] = y
+
+            def loss_fn(p):
+                loss, _ = self._loss_and_new_state(
+                    p, state, feats, labels, fmasks, lmasks, rng, train=True)
+                return loss
+
+            grads = jax.grad(loss_fn)(params)
+            return acts, grads
+
+        return jax.jit(run)
+
+    def _run_introspection(self, feats, labels, fmasks, lmasks, rng):
+        from deeplearning4j_tpu.train.listeners import _hook_recipients
+
+        it_next = self.iteration + 1
+        fwd_to = _hook_recipients(self.listeners, "on_forward_pass", it_next)
+        grad_to = _hook_recipients(self.listeners, "on_gradient_calculation",
+                                   it_next)
+        if not (fwd_to or grad_to):
+            return
+        fn = self._get_jit("introspect", self._make_introspect_fn)
+        acts, grads = fn(self.params_, self.state_, feats, labels,
+                         fmasks, lmasks, rng)
+        if fwd_to:
+            acts_np = {k: np.asarray(v) for k, v in acts.items()}
+            for lst in fwd_to:
+                lst.on_forward_pass(self, acts_np)
+        if grad_to:
+            grads_np = jax.tree_util.tree_map(np.asarray, grads)
+            for lst in grad_to:
+                lst.on_gradient_calculation(self, grads_np)
+
     def _fit_batch(self, step, mds: MultiDataSet):
+        from deeplearning4j_tpu.train.listeners import _overrides
+
         feats = tuple(jnp.asarray(f) for f in mds.features)
         labels = tuple(jnp.asarray(l) for l in mds.labels)
         fmasks = tuple(None if m is None else jnp.asarray(m) for m in mds.features_masks)
         lmasks = tuple(None if m is None else jnp.asarray(m) for m in mds.labels_masks)
+        rng = self._next_rng()
+        self._run_introspection(feats, labels, fmasks, lmasks, rng)
         self.params_, self.opt_state_, self.state_, self.score_ = step(
             self.params_, self.opt_state_, self.state_, feats, labels, fmasks, lmasks,
-            self._next_rng(),
+            rng,
             jnp.asarray(self.iteration, jnp.int32),
             jnp.asarray(self.epoch, jnp.int32),
         )
         self.iteration += 1
+        if _overrides(self.listeners, "on_backward_pass"):
+            for lst in self.listeners:
+                lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -378,18 +378,79 @@ class MultiLayerNetwork:
         self._rng, k = jax.random.split(self._rng)
         return k
 
+    def _make_introspect_fn(self):
+        """(activations list, gradients) for one batch — the listener
+        introspection pass (SURVEY §7 hard-part 1). Runs with the same
+        rng the train step will consume, so reported values match the
+        step bit-for-bit and attaching a listener never changes the
+        training trajectory. The body mirrors the loss path exactly —
+        including the output layer's score-path weight noise (unsplit
+        rng, not the per-layer key a full forward would use)."""
+
+        def run(params, state, f, l, fm, lm, rng):
+            n = len(self.layers)
+            x, mask, _, _, acts = self._forward(
+                params, state, f, train=True, rng=rng, fmask=fm,
+                stop_before=n - 1, collect=True)
+            if self._compute_dtype is not None:
+                x = x.astype(jnp.float32)
+            out_layer = self._output_layer()
+            p_out = apply_weight_noise(out_layer, params[-1], True, rng)
+            y_out, _ = out_layer.apply(p_out, x, state=state[-1], train=True,
+                                       rng=rng, mask=mask)
+            acts = list(acts) + [y_out]
+
+            def loss_fn(p):
+                loss, _ = self._loss_and_new_state(
+                    p, state, f, l, fm, lm, rng, train=True)
+                return loss
+
+            grads = jax.grad(loss_fn)(params)
+            return acts, grads
+
+        return jax.jit(run)
+
+    def _run_introspection(self, features, labels, fmask, lmask, rng):
+        from deeplearning4j_tpu.train.listeners import _hook_recipients
+
+        it_next = self.iteration + 1
+        fwd_to = _hook_recipients(self.listeners, "on_forward_pass", it_next)
+        grad_to = _hook_recipients(self.listeners, "on_gradient_calculation",
+                                   it_next)
+        if not (fwd_to or grad_to):
+            return
+        fn = self._get_jit("introspect", self._make_introspect_fn)
+        acts, grads = fn(self.params_, self.state_, features, labels,
+                         fmask, lmask, rng)
+        if fwd_to:
+            acts_np = [np.asarray(a) for a in acts]
+            for lst in fwd_to:
+                lst.on_forward_pass(self, acts_np)
+        if grad_to:
+            grads_np = jax.tree_util.tree_map(np.asarray, grads)
+            for lst in grad_to:
+                lst.on_gradient_calculation(self, grads_np)
+
     def _fit_batch(self, step, ds: DataSet):
+        from deeplearning4j_tpu.train.listeners import _overrides
+
+        features = jnp.asarray(ds.features)
+        labels = None if ds.labels is None else jnp.asarray(ds.labels)
+        fmask = (None if ds.features_mask is None
+                 else jnp.asarray(ds.features_mask))
+        lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        rng = self._next_rng()
+        self._run_introspection(features, labels, fmask, lmask, rng)
         self.params_, self.opt_state_, self.state_, self.score_ = step(
             self.params_, self.opt_state_, self.state_,
-            jnp.asarray(ds.features),
-            None if ds.labels is None else jnp.asarray(ds.labels),
-            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
-            self._next_rng(),
+            features, labels, fmask, lmask, rng,
             jnp.asarray(self.iteration, jnp.int32),
             jnp.asarray(self.epoch, jnp.int32),
         )
         self.iteration += 1
+        if _overrides(self.listeners, "on_backward_pass"):
+            for lst in self.listeners:
+                lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -24,7 +24,18 @@ log = logging.getLogger(__name__)
 
 
 class TrainingListener:
-    """Base listener; all hooks are no-ops (reference ``TrainingListener``)."""
+    """Base listener; all hooks are no-ops (reference ``TrainingListener``).
+
+    Introspection hooks (``on_forward_pass`` / ``on_gradient_calculation``
+    / ``on_backward_pass`` — reference ``TrainingListener.java:23-71``,
+    SURVEY §7 hard-part 1): the functional core computes the whole train
+    step as one jitted program, so these fire only when a registered
+    listener actually OVERRIDES them; the network then runs one extra
+    jitted forward+grad pass per iteration with the SAME rng the train
+    step consumes — the reported activations/gradients are bit-identical
+    to the step's, and the training trajectory is unchanged by attaching
+    the listener. Plain fit paths only (tBPTT/pretrain steps do not
+    introspect)."""
 
     def iteration_done(self, model, iteration: int, epoch: int) -> None:  # noqa: D401
         pass
@@ -34,6 +45,55 @@ class TrainingListener:
 
     def on_epoch_end(self, model) -> None:
         pass
+
+    def on_forward_pass(self, model, activations) -> None:
+        """Per-layer (MLN: list) / per-vertex (CG: dict) activations of
+        this iteration's forward pass, as host numpy arrays."""
+        pass
+
+    def on_gradient_calculation(self, model, gradients) -> None:
+        """This iteration's gradients (same pytree structure as
+        ``model.params_``), as host numpy arrays."""
+        pass
+
+    def on_backward_pass(self, model) -> None:
+        pass
+
+    def needs_introspection(self, next_iteration: int) -> bool:
+        """Whether the introspection hooks should fire for the upcoming
+        iteration. Listeners that only sample (e.g. StatsListener at
+        reportingFrequency) override this so the extra forward+grad pass
+        is skipped on non-reporting iterations."""
+        return True
+
+
+def _has_hook(lst, name: str) -> bool:
+    """Listener provides its own implementation of ``name`` — as a class
+    override or an instance-bound attribute (StatsListener binds hooks in
+    __init__ only when collection is requested)."""
+    return (name in lst.__dict__
+            or getattr(type(lst), name, None) is not getattr(TrainingListener,
+                                                             name))
+
+
+def _overrides(listeners, name: str, next_iteration: Optional[int] = None) -> bool:
+    """True if any listener provides ``name`` (and, when
+    ``next_iteration`` is given, wants introspection for it).
+    Introspection is pay-for-use: nothing extra runs otherwise."""
+    return bool(_hook_recipients(listeners, name, next_iteration))
+
+
+def _hook_recipients(listeners, name: str,
+                     next_iteration: Optional[int] = None) -> list:
+    """The listeners that provide ``name`` AND want introspection for
+    ``next_iteration`` — hooks are delivered per listener, so a sampled
+    listener (StatsListener at reportingFrequency) never pays device→host
+    copies for iterations an always-on listener requested."""
+    return [
+        lst for lst in listeners
+        if _has_hook(lst, name)
+        and (next_iteration is None or lst.needs_introspection(next_iteration))
+    ]
 
 
 class ScoreIterationListener(TrainingListener):

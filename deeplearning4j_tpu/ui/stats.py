@@ -42,13 +42,19 @@ def _current_rss_mb() -> float:
 
 def _param_arrays(model) -> Dict[str, np.ndarray]:
     """name → array over both model types (MLN list / CG dict layout)."""
+    return _flatten_tree(model.params_)
+
+
+def _flatten_tree(tree) -> Dict[str, np.ndarray]:
+    """Flatten a params-shaped pytree (MLN: list of dicts; CG: dict of
+    dicts) to the same name → array keys as _param_arrays."""
     out = {}
-    if isinstance(model.params_, dict):  # ComputationGraph
-        for lname, p in model.params_.items():
+    if isinstance(tree, dict):
+        for lname, p in tree.items():
             for k, v in p.items():
                 out[f"{lname}_{k}"] = np.asarray(v)
-    else:  # MultiLayerNetwork
-        for i, p in enumerate(model.params_):
+    else:
+        for i, p in enumerate(tree):
             for k, v in p.items():
                 out[f"{i}_{k}"] = np.asarray(v)
     return out
@@ -77,17 +83,48 @@ def _summary(arrs: Dict[str, np.ndarray], histograms: bool,
 class StatsListener(TrainingListener):
     def __init__(self, storage: StatsStorage, reporting_frequency: int = 10,
                  session_id: Optional[str] = None, worker_id: str = "worker_0",
-                 collect_histograms: bool = True, histogram_bins: int = 20):
+                 collect_histograms: bool = True, histogram_bins: int = 20,
+                 collect_gradients: bool = False,
+                 collect_activations: bool = False):
         self.storage = storage
         self.frequency = max(int(reporting_frequency), 1)
         self.session_id = session_id or f"session_{uuid.uuid4().hex[:8]}"
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
         self.bins = histogram_bins
+        self.collect_gradients = bool(collect_gradients)
+        self.collect_activations = bool(collect_activations)
+        if collect_gradients:
+            # defining the hook only when asked keeps introspection
+            # pay-for-use: the network checks for OVERRIDDEN hooks
+            self.on_gradient_calculation = self._on_gradient_calculation
+        if collect_activations:
+            self.on_forward_pass = self._on_forward_pass
+        self._pending_grads: Optional[Dict[str, np.ndarray]] = None
+        self._pending_acts: Optional[Dict[str, np.ndarray]] = None
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
         self._last_time: Optional[float] = None
         self._last_iter_for_rate: Optional[int] = None
         self._initialized = False
+
+    # -------------------------------------------------- introspection hooks
+    # (reference BaseStatsListener gradient/activation stats, :231-268;
+    # bound as instance attributes in __init__ so the fit loop's
+    # "listener overrides the hook" check only triggers when collection
+    # was requested)
+    def needs_introspection(self, next_iteration: int) -> bool:
+        return next_iteration == 1 or next_iteration % self.frequency == 0
+
+    def _on_gradient_calculation(self, model, gradients) -> None:
+        self._pending_grads = _flatten_tree(gradients)
+
+    def _on_forward_pass(self, model, activations) -> None:
+        if isinstance(activations, dict):
+            self._pending_acts = {k: np.asarray(v)
+                                  for k, v in activations.items()}
+        else:
+            self._pending_acts = {f"layer_{i}": np.asarray(a)
+                                  for i, a in enumerate(activations)}
 
     # ------------------------------------------------------------------ init
     def _put_init(self, model):
@@ -135,6 +172,14 @@ class StatsListener(TrainingListener):
         self._last_iter_for_rate = iteration
 
         record["parameters"] = _summary(params, self.collect_histograms, self.bins)
+        if self._pending_grads is not None:
+            record["gradients"] = _summary(
+                self._pending_grads, self.collect_histograms, self.bins)
+            self._pending_grads = None
+        if self._pending_acts is not None:
+            record["activations"] = _summary(
+                self._pending_acts, self.collect_histograms, self.bins)
+            self._pending_acts = None
         if self._prev_params is not None:
             updates = {
                 k: params[k] - self._prev_params[k]

@@ -22,6 +22,7 @@ from deeplearning4j_tpu.ui import (
     UIServer,
     render_dashboard,
 )
+from deeplearning4j_tpu.train.listeners import TrainingListener
 from deeplearning4j_tpu.updaters import Adam
 
 
@@ -414,3 +415,175 @@ class TestLegendPlacement:
         # canvas extended to hold the overflow rows
         h = float(re.search(r'viewBox="0 0 [\d.]+ ([\d.]+)"', html_text).group(1))
         assert h > st.height
+
+
+class TestIntrospectionHooks:
+    """on_forward_pass / on_gradient_calculation / on_backward_pass
+    (reference TrainingListener.java:23-71; SURVEY §7 hard-part 1's
+    introspection mode)."""
+
+    class _Capture(TrainingListener):
+        def __init__(self):
+            self.acts, self.grads, self.bwd = [], [], 0
+
+        def on_forward_pass(self, model, activations):
+            self.acts.append(activations)
+
+        def on_gradient_calculation(self, model, gradients):
+            self.grads.append(gradients)
+
+        def on_backward_pass(self, model):
+            self.bwd += 1
+
+    @staticmethod
+    def _mln(listeners=()):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.updaters import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        n = MultiLayerNetwork(conf).init()
+        n.listeners = list(listeners)
+        return n
+
+    @staticmethod
+    def _data():
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        from deeplearning4j_tpu.data import DataSet
+
+        return DataSet(x, y)
+
+    def test_mln_hooks_fire_with_correct_shapes(self):
+        cap = self._Capture()
+        net = self._mln([cap])
+        net.fit(self._data(), epochs=2, batch_size=4)  # 4 iterations
+        assert len(cap.acts) == 4 and len(cap.grads) == 4 and cap.bwd == 4
+        assert len(cap.acts[0]) == 2
+        assert cap.acts[0][0].shape == (4, 6)
+        assert cap.acts[0][1].shape == (4, 3)
+        assert set(cap.grads[0][0]) == {"W", "b"}
+        assert cap.grads[0][0]["W"].shape == (4, 6)
+
+    def test_attaching_listener_does_not_change_training(self):
+        """The introspection pass reuses the step's rng — identical
+        trajectories with and without the listener."""
+        ds = self._data()
+        n1 = self._mln([self._Capture()])
+        n1.fit(ds, epochs=2, batch_size=4)
+        n2 = self._mln()
+        n2.fit(ds, epochs=2, batch_size=4)
+        for p1, p2 in zip(n1.params_, n2.params_):
+            for k in p1:
+                np.testing.assert_array_equal(np.asarray(p1[k]),
+                                              np.asarray(p2[k]))
+
+    def test_cg_hooks_fire(self):
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.updaters import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=5, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3)).build())
+        net = ComputationGraph(conf).init()
+        cap = self._Capture()
+        net.listeners = [cap]
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((6, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        net.fit(DataSet(x, y), epochs=1, batch_size=6)
+        assert len(cap.acts) == 1 and len(cap.grads) == 1
+        assert isinstance(cap.acts[0], dict) and "d" in cap.acts[0]
+        assert cap.acts[0]["d"].shape == (6, 5)
+        assert set(cap.grads[0]["d"]) == {"W", "b"}
+
+    def test_stats_listener_collects_gradients_and_activations(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, reporting_frequency=2,
+                            collect_gradients=True,
+                            collect_activations=True)
+        net = self._mln([lst])
+        net.fit(self._data(), epochs=3, batch_size=4)  # 6 iterations
+        updates = [r for r in storage.get_records(lst.session_id)
+                   if r["kind"] == "update"]
+        assert updates, "no update records"
+        with_grads = [r for r in updates if "gradients" in r]
+        assert with_grads, "no gradient stats collected"
+        g = next(iter(with_grads[0]["gradients"].values()))
+        assert {"mean", "stdev", "mean_magnitude"} <= set(g)
+        assert any("activations" in r for r in updates)
+
+    def test_frequency_gates_introspection_pass(self):
+        """needs_introspection: the extra fwd+grad pass only runs on
+        reporting iterations."""
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, reporting_frequency=3,
+                            collect_gradients=True)
+        calls = {"n": 0}
+        orig = lst._on_gradient_calculation
+
+        def counting(model, grads):
+            calls["n"] += 1
+            return orig(model, grads)
+
+        lst.on_gradient_calculation = counting
+        net = self._mln([lst])
+        net.fit(self._data(), epochs=3, batch_size=4)  # 6 iterations
+        # iterations 1..6 -> introspected at next_iteration in {1, 3, 6}
+        assert calls["n"] == 3, calls["n"]
+
+    def test_dashboard_renders_gradient_and_activation_charts(self):
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, reporting_frequency=1,
+                            collect_gradients=True,
+                            collect_activations=True)
+        net = self._mln([lst])
+        net.fit(self._data(), epochs=1, batch_size=4)
+        html_doc = render_dashboard(storage)
+        assert "Gradient mean magnitude" in html_doc
+        assert "Activation stdev" in html_doc
+
+    def test_per_listener_delivery_gating(self):
+        """An always-on introspection listener must not cause a sampled
+        StatsListener to receive (and host-copy) hooks off-frequency."""
+        storage = InMemoryStatsStorage()
+        sampled = StatsListener(storage, reporting_frequency=3,
+                                collect_gradients=True)
+        s_calls = {"n": 0}
+        orig = sampled._on_gradient_calculation
+
+        def counting(model, grads):
+            s_calls["n"] += 1
+            return orig(model, grads)
+
+        sampled.on_gradient_calculation = counting
+
+        class AlwaysOn(TrainingListener):
+            def __init__(self):
+                self.n = 0
+
+            def on_gradient_calculation(self, model, gradients):
+                self.n += 1
+
+        always = AlwaysOn()
+        net = self._mln([sampled, always])
+        net.fit(self._data(), epochs=3, batch_size=4)  # 6 iterations
+        assert always.n == 6
+        assert s_calls["n"] == 3  # {1, 3, 6} only
