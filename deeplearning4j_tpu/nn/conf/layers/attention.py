@@ -20,6 +20,7 @@ the existing catalog (EmbeddingSequenceLayer, RnnOutputLayer, ...).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional
 
@@ -69,67 +70,148 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
 _FLASH_PROBE_CACHE: dict = {}
 
 
-def _flash_attention_works(dtype, head_dim: int, causal: bool) -> bool:
-    """Compile-probe the Pallas flash kernel once per (dtype, head_dim,
-    causal) instantiation. The kernel is compiled server-side under the
-    axon tunnel by whatever Mosaic ships in the runtime libtpu, which can
-    lag the JAX client — e.g. bf16×bf16→f32 ``tpu.matmul`` ("Bad lhs
-    type") is rejected by older Mosaic versions, and an unusual head dim
-    or the non-causal variant lowers differently from the causal 128
-    case. A minimal (1,1,128,head_dim) instance is AOT-*compiled* (not
-    run — only compile-time Mosaic rejections are caught); on failure the
-    dense einsum path is used so a kernel/toolchain mismatch degrades to
-    XLA attention instead of failing the model."""
-    key = (jnp.dtype(dtype).name, int(head_dim), bool(causal))
+def _probe_compiles(fn, seq_len: int, head_dim: int, dtype,
+                    causal: bool) -> bool:
+    """Probe a minimal (1,1,T,hd) instance of ``fn(q, k, v)``: compile
+    its forward AND value-and-grad programs, EXECUTE both on three
+    independently seeded random tensors (q=k=v would hide operand-order /
+    transpose miscompiles behind the symmetry of Q·Kᵀ), and compare
+    output and all three gradients against a dense fp32 reference. A
+    server-side Mosaic that lags the JAX client can MIScompile (not just
+    reject) a kernel, and forward-only checking would let training run on
+    silently wrong gradients.
+
+    dense_attention is typically called DURING tracing of a model step,
+    where an ordinary jit call would be traced into the caller's graph
+    (silently "succeeding" and still embedding the pallas op). AOT
+    lower+compile sidesteps the trace context, and the value check calls
+    the compiled executables with concrete arrays — safe under an
+    ambient trace."""
+    shape = (1, 1, seq_len, head_dim)
+    x3 = [jax.ShapeDtypeStruct(shape, dtype)] * 3
+
+    def loss(f):
+        return lambda q, k, v: jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+
+    kernel_exe = jax.jit(fn).lower(*x3).compile()
+    kernel_vg = jax.jit(
+        jax.value_and_grad(loss(fn), argnums=(0, 1, 2))).lower(*x3).compile()
+
+    def dense_ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (head_dim ** -0.5)
+        if causal:
+            tri = jnp.tril(jnp.ones((seq_len, seq_len), bool))
+            s = jnp.where(tri, s, -1e30)
+        p = jax.nn.softmax(s, -1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    ref_exe = jax.jit(dense_ref).lower(*x3).compile()
+    ref_vg = jax.jit(jax.value_and_grad(
+        loss(dense_ref), argnums=(0, 1, 2))).lower(*x3).compile()
+
+    rng = np.random.default_rng(0)
+    qkv = [jnp.asarray(rng.standard_normal(shape).astype(np.float32)
+                       ).astype(dtype) for _ in range(3)]
+    tol = 2e-2 if jnp.dtype(dtype) == jnp.bfloat16 else 2e-4
+
+    def check(name, got, want, scale=1.0):
+        err = np.max(np.abs(np.asarray(got, dtype=np.float32)
+                            - np.asarray(want, dtype=np.float32)))
+        if not np.isfinite(err) or err > tol * scale:
+            raise RuntimeError(
+                f"flash kernel value check failed ({name}): "
+                f"max err {err:.3e} > {tol * scale}")
+
+    check("fwd", kernel_exe(*qkv), ref_exe(*qkv))
+    _, g_k = kernel_vg(*qkv)
+    _, g_r = ref_vg(*qkv)
+    for name, a, b in zip(("dq", "dk", "dv"), g_k, g_r):
+        # gradients accumulate over T terms; scale tolerance accordingly
+        check(name, a, b, scale=8.0)
+    return True
+
+
+def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
+    """Pick a flash implementation for this instantiation, compile-probing
+    once per (dtype, seq_len, head_dim, causal): the in-tree Pallas
+    kernel (nn/ops/flash_attention.py — written against the matmul forms
+    this toolchain's Mosaic accepts) first, the jax-bundled kernel
+    second, None (→ dense XLA attention) when neither compiles. The
+    server-side Mosaic under the axon tunnel can lag the JAX client —
+    e.g. it rejects the bundled kernel's accumulating bf16 ``tpu.matmul``
+    ("Bad lhs type") — and the lowering varies with sequence length
+    (block/grid choice), head dim (padding) and causality, so the probe
+    is keyed on all four."""
+    import logging
+
+    key = (jnp.dtype(dtype).name, int(seq_len), int(head_dim), bool(causal))
     if key in _FLASH_PROBE_CACHE:
         return _FLASH_PROBE_CACHE[key]
-    try:
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention,
+
+    def candidates():
+        from deeplearning4j_tpu.nn.ops.flash_attention import (
+            MAX_SEQ_LEN,
+            flash_attention as own_flash,
         )
 
-        # dense_attention is typically called DURING tracing of a model
-        # step, where an ordinary jit call would be traced into the
-        # caller's graph (silently "succeeding" and still embedding the
-        # pallas op). AOT lower+compile sidesteps the trace context and
-        # surfaces Mosaic compile errors without executing anything.
-        x = jax.ShapeDtypeStruct((1, 1, 128, head_dim), dtype)
-        jax.jit(lambda a: flash_attention(a, a, a, causal=causal)).lower(
-            x).compile()
-        _FLASH_PROBE_CACHE[key] = True
-    except Exception as e:  # Mosaic compile errors surface as varied types
-        import logging
+        if seq_len <= MAX_SEQ_LEN:
+            yield "in-tree", own_flash
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
 
+        yield "jax-bundled", jax_flash
+
+    impl = None
+    sc = head_dim ** -0.5
+    for cand_name, kernel in candidates():
+        try:
+            _probe_compiles(
+                lambda q, k, v: kernel(q, k, v, causal=causal, sm_scale=sc),
+                seq_len, head_dim, dtype, causal)
+            impl = functools.partial(_call_flash, kernel, causal)
+            break
+        except Exception as e:
+            logging.getLogger(__name__).info(
+                "%s Pallas flash unavailable for %s (%s: %s)", cand_name,
+                key, type(e).__name__, str(e).split("\n", 1)[0])
+    if impl is None:
         logging.getLogger(__name__).warning(
-            "Pallas flash attention unavailable for %s (%s: %s) — "
-            "falling back to dense XLA attention", key, type(e).__name__,
-            str(e).split("\n", 1)[0])
-        _FLASH_PROBE_CACHE[key] = False
-    return _FLASH_PROBE_CACHE[key]
+            "Pallas flash attention unavailable for %s — falling back to "
+            "dense XLA attention", key)
+    _FLASH_PROBE_CACHE[key] = impl
+    return impl
 
 
-def _flash_attention_eligible(q, causal, mask, dropout_rate) -> bool:
-    """Route to the Pallas TPU flash-attention kernel when it applies:
-    TPU backend, no padding mask / attention dropout, block-friendly
-    shapes (T multiple of 128; tiny toy shapes stay on the einsum path),
-    and the kernel compile-probes OK at this dtype (see
-    ``_flash_attention_works``). Kill switch: DL4J_TPU_FLASH_ATTENTION=0."""
+def _call_flash(kernel, causal, q, k, v, scale):
+    return kernel(q, k, v, causal=causal, sm_scale=scale)
+
+
+def _flash_attention_route(q, k, causal, mask, dropout_rate):
+    """Route to a Pallas TPU flash-attention kernel when one applies:
+    TPU backend, no padding mask / attention dropout, equal q/kv length,
+    block-friendly shapes (T multiple of 128; tiny toy shapes stay on
+    the einsum path), and a kernel that compile-probes OK at this
+    instantiation (see ``_flash_attention_impl``). Returns the chosen
+    impl or None. Kill switch: DL4J_TPU_FLASH_ATTENTION=0."""
     import os
 
     if os.environ.get("DL4J_TPU_FLASH_ATTENTION", "1") == "0":
-        return False
+        return None
     if mask is not None or dropout_rate > 0.0:
-        return False
+        return None
     try:
         import jax as _j
 
         if _j.default_backend() != "tpu":
-            return False
+            return None
     except Exception:
-        return False
+        return None
     T = q.shape[2]
-    return (T >= 128 and T % 128 == 0
-            and _flash_attention_works(q.dtype, q.shape[-1], causal))
+    if k.shape[2] != T or T < 128 or T % 128:
+        return None
+    return _flash_attention_impl(q.dtype, T, q.shape[-1], causal)
 
 
 def dense_attention(q, k, v, *, causal: bool, mask=None,
@@ -146,12 +228,9 @@ def dense_attention(q, k, v, *, causal: bool, mask=None,
     """
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
-    if _flash_attention_eligible(q, causal, mask, dropout_rate):
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention,
-        )
-
-        return flash_attention(q, k, v, causal=causal, sm_scale=scale)
+    flash_impl = _flash_attention_route(q, k, causal, mask, dropout_rate)
+    if flash_impl is not None:
+        return flash_impl(q, k, v, scale)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tri = jnp.tril(jnp.ones((T, T), bool))

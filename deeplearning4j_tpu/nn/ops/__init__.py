@@ -1,0 +1,8 @@
+"""Hand-written Pallas TPU kernels — the SURVEY §7 "Pallas for the hot
+ops" path (the reference's analog is the cuDNN helper layer, §2.4,
+absorbed elsewhere by XLA lowering; these kernels exist where XLA's
+op-boundary materialization costs real HBM traffic)."""
+
+from deeplearning4j_tpu.nn.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

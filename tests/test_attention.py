@@ -237,26 +237,30 @@ class TestFlashAttentionGate:
         unmasked shapes (parity itself is verified on real TPU hardware
         by the round's verify drive: fwd/grad err ~1e-6)."""
         from deeplearning4j_tpu.nn.conf.layers.attention import (
-            _flash_attention_eligible,
+            _flash_attention_route,
         )
 
         q = jnp.zeros((2, 4, 512, 128))
         # CPU backend in tests → never eligible
-        assert not _flash_attention_eligible(q, True, None, 0.0)
+        assert _flash_attention_route(q, q, True, None, 0.0) is None
         # kill switch + disqualifiers are independent of backend
         monkeypatch.setenv("DL4J_TPU_FLASH_ATTENTION", "0")
-        assert not _flash_attention_eligible(q, True, None, 0.0)
+        assert _flash_attention_route(q, q, True, None, 0.0) is None
         monkeypatch.delenv("DL4J_TPU_FLASH_ATTENTION")
-        assert not _flash_attention_eligible(q, True, jnp.ones((2, 512)), 0.0)
-        assert not _flash_attention_eligible(q, True, None, 0.1)
-        assert not _flash_attention_eligible(jnp.zeros((2, 4, 100, 128)),
-                                             True, None, 0.0)
+        assert _flash_attention_route(q, q, True, jnp.ones((2, 512)),
+                                      0.0) is None
+        assert _flash_attention_route(q, q, True, None, 0.1) is None
+        q_bad = jnp.zeros((2, 4, 100, 128))
+        assert _flash_attention_route(q_bad, q_bad, True, None, 0.0) is None
+        # cross-attention with mismatched kv length stays dense
+        k_short = jnp.zeros((2, 4, 256, 128))
+        assert _flash_attention_route(q, k_short, True, None, 0.0) is None
 
     def test_compile_probe_failure_falls_back_and_caches(self, monkeypatch):
         """A Mosaic/toolchain mismatch (e.g. the axon server-side libtpu
         rejecting bf16 tpu.matmul: "Bad lhs type") must disable the flash
         path for that instantiation instead of failing the model step.
-        The probe result is cached per (dtype, head_dim, causal)."""
+        The probe result is cached per (dtype, seq, head_dim, causal)."""
         import deeplearning4j_tpu.nn.conf.layers.attention as A
 
         monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
@@ -272,27 +276,65 @@ class TestFlashAttentionGate:
                                    "Bad lhs type")
 
         monkeypatch.setattr(jax, "jit", lambda *a, **k: _Boom())
-        assert A._flash_attention_works(jnp.bfloat16, 64, True) is False
-        assert A._FLASH_PROBE_CACHE == {("bfloat16", 64, True): False}
-        # second call hits the cache: no second compile attempt
-        assert A._flash_attention_works(jnp.bfloat16, 64, True) is False
-        assert compiles["n"] == 1
-        # a different instantiation re-probes
-        assert A._flash_attention_works(jnp.bfloat16, 128, True) is False
+        assert A._flash_attention_impl(jnp.bfloat16, 512, 64, True) is None
+        assert A._FLASH_PROBE_CACHE == {("bfloat16", 512, 64, True): None}
+        # both the in-tree and the jax-bundled kernel were attempted
         assert compiles["n"] == 2
+        # second call hits the cache: no further compile attempts
+        assert A._flash_attention_impl(jnp.bfloat16, 512, 64, True) is None
+        assert compiles["n"] == 2
+        # a different instantiation re-probes
+        assert A._flash_attention_impl(jnp.bfloat16, 1024, 128, True) is None
+        assert compiles["n"] == 4
 
-    def test_compile_probe_success_enables(self, monkeypatch):
+    def test_compile_probe_success_prefers_own_kernel(self, monkeypatch):
         import deeplearning4j_tpu.nn.conf.layers.attention as A
 
         monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+        monkeypatch.setattr(A, "_probe_compiles",
+                            lambda *a, **k: True)
+        impl = A._flash_attention_impl(jnp.float32, 128, 128, False)
+        assert callable(impl)
+        assert A._FLASH_PROBE_CACHE[("float32", 128, 128, False)] is impl
+        # the chosen impl is the in-tree kernel (probed first)
+        from deeplearning4j_tpu.nn.ops.flash_attention import flash_attention
+        assert impl.args[0] is flash_attention
 
-        class _Ok:
-            def lower(self, *a, **k):
-                return self
+    def test_seq_beyond_own_kernel_cap_tries_bundled(self, monkeypatch):
+        """T past the in-tree kernel's MAX_SEQ_LEN must skip it (no
+        probe) and try the jax-bundled kernel."""
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+        from deeplearning4j_tpu.nn.ops.flash_attention import MAX_SEQ_LEN
 
-            def compile(self):
-                return self
+        monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+        monkeypatch.setattr(A, "_probe_compiles",
+                            lambda *a, **k: True)
+        impl = A._flash_attention_impl(jnp.bfloat16, MAX_SEQ_LEN * 2, 128,
+                                       True)
+        assert callable(impl)
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+        assert impl.args[0] is jax_flash
 
-        monkeypatch.setattr(jax, "jit", lambda *a, **k: _Ok())
-        assert A._flash_attention_works(jnp.float32, 128, False) is True
-        assert A._FLASH_PROBE_CACHE == {("float32", 128, False): True}
+    def test_value_check_rejects_wrong_kernel(self):
+        """The probe must EXECUTE the kernel and compare against the
+        dense reference — a kernel that compiles but miscomputes (a
+        lagging Mosaic can miscompile, not just reject) is refused."""
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+
+        with pytest.raises(RuntimeError, match="value check failed"):
+            A._probe_compiles(lambda q, k, v: jnp.zeros_like(q), 128, 64,
+                              jnp.float32, False)
+
+    def test_value_check_accepts_correct_kernel(self):
+        """A numerically correct implementation passes the value check
+        (here: the in-tree Pallas kernel in interpreter mode)."""
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+        from deeplearning4j_tpu.nn.ops.flash_attention import flash_attention
+
+        assert A._probe_compiles(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            sm_scale=64 ** -0.5,
+                                            interpret=True),
+            128, 64, jnp.float32, True)

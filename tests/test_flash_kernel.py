@@ -1,0 +1,88 @@
+"""Hand-written Pallas flash-attention kernel (nn/ops/flash_attention.py)
+— parity vs dense XLA attention through the Pallas interpreter on the CPU
+mesh (the kernel itself targets TPU; real-hardware parity is driven by
+the round's verify runs)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.layers.attention import dense_attention
+from deeplearning4j_tpu.nn.ops.flash_attention import (
+    MAX_SEQ_LEN,
+    flash_attention,
+)
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFlashKernelInterpret:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dense(self, causal):
+        b, h, T, hd = 2, 3, 256, 64
+        q, k, v = (_rand((b, h, T, hd), i) for i in range(3))
+        o_f = flash_attention(q, k, v, causal=causal, interpret=True)
+        o_d = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d),
+                                   rtol=1e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dense(self, causal):
+        b, h, T, hd = 1, 2, 128, 64
+        q, k, v = (_rand((b, h, T, hd), i) for i in range(3))
+        do = _rand((b, h, T, hd), 7)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           interpret=True) * do)
+
+        def loss_d(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=causal) * do)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("qkv", gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=1e-4, atol=5e-5,
+                                       err_msg=f"d{name}")
+
+    def test_head_dim_padding(self):
+        """hd=48 (not a lane multiple) is zero-padded internally and the
+        result is identical to dense."""
+        q, k, v = (_rand((1, 2, 128, 48), i) for i in range(3))
+        o_f = flash_attention(q, k, v, causal=True, interpret=True)
+        assert o_f.shape == (1, 2, 128, 48)
+        o_d = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_sm_scale_override(self):
+        q, k, v = (_rand((1, 1, 128, 64), i) for i in range(3))
+        o_f = flash_attention(q, k, v, causal=False, sm_scale=0.25,
+                              interpret=True)
+        T = 128
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.25
+        o_d = jnp.einsum("bhqk,bhkd->bhqd",
+                         jax.nn.softmax(scores, -1), v)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d),
+                                   rtol=1e-5, atol=2e-5)
+
+    def test_shape_validation(self):
+        q = jnp.zeros((1, 1, 100, 64))
+        with pytest.raises(ValueError, match="multiple of 128"):
+            flash_attention(q, q, q, interpret=True)
+        big = jnp.zeros((1, 1, MAX_SEQ_LEN + 128, 64))
+        with pytest.raises(ValueError, match="ring attention"):
+            flash_attention(big, big, big, interpret=True)
+
+    def test_block_mixing_multiblock(self):
+        """T=384 exercises the 128-block path with 3 kv blocks and a
+        non-trivial causal loop bound."""
+        q, k, v = (_rand((1, 2, 384, 64), i) for i in range(3))
+        o_f = flash_attention(q, k, v, causal=True, interpret=True)
+        o_d = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(o_f), np.asarray(o_d),
+                                   rtol=1e-5, atol=2e-5)
