@@ -423,7 +423,7 @@ class ComputationGraph:
                 lst.on_gradient_calculation(self, grads_np)
 
     def _fit_batch(self, step, mds: MultiDataSet):
-        from deeplearning4j_tpu.train.listeners import _overrides
+        from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         feats = tuple(jnp.asarray(f) for f in mds.features)
         labels = tuple(jnp.asarray(l) for l in mds.labels)
@@ -438,9 +438,8 @@ class ComputationGraph:
             jnp.asarray(self.epoch, jnp.int32),
         )
         self.iteration += 1
-        if _overrides(self.listeners, "on_backward_pass"):
-            for lst in self.listeners:
-                lst.on_backward_pass(self)
+        for lst in _hook_recipients(self.listeners, "on_backward_pass"):
+            lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
@@ -783,6 +782,32 @@ class ComputationGraph:
         assert self.params_ is not None
         return int(sum(int(np.prod(a.shape))
                        for n in self.layer_names for a in self.params_[n].values()))
+
+    def summary(self) -> str:
+        """Vertex table — name, kind, inputs, #params (reference
+        ``ComputationGraph.summary()``)."""
+        rows = [("vertex", "kind", "inputs", "params")]
+        total = 0
+        for name in self.topo:
+            v = self.conf.vertices[name]
+            kind = (type(v.layer).__name__ if isinstance(v, LayerVertex)
+                    else type(v).__name__)
+            srcs = ", ".join(self.conf.vertex_inputs.get(name, ()))
+            n = 0
+            if (self.params_ is not None and name in self.params_
+                    and isinstance(v, LayerVertex)):
+                n = int(sum(int(np.prod(a.shape))
+                            for a in self.params_[name].values()))
+            total += n
+            rows.append((name, kind, srcs, f"{n:,}"))
+        for name in self.conf.network_inputs:
+            rows.insert(1, (name, "NetworkInput", "", "0"))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(r[c].ljust(widths[c]) for c in range(4))
+                 for r in rows]
+        lines.insert(1, "-" * (sum(widths) + 6))
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
 
     def params_flat(self) -> np.ndarray:
         """Flattened parameter vector (order: topo layer order, param name

@@ -432,7 +432,7 @@ class MultiLayerNetwork:
                 lst.on_gradient_calculation(self, grads_np)
 
     def _fit_batch(self, step, ds: DataSet):
-        from deeplearning4j_tpu.train.listeners import _overrides
+        from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         features = jnp.asarray(ds.features)
         labels = None if ds.labels is None else jnp.asarray(ds.labels)
@@ -448,9 +448,8 @@ class MultiLayerNetwork:
             jnp.asarray(self.epoch, jnp.int32),
         )
         self.iteration += 1
-        if _overrides(self.listeners, "on_backward_pass"):
-            for lst in self.listeners:
-                lst.on_backward_pass(self)
+        for lst in _hook_recipients(self.listeners, "on_backward_pass"):
+            lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
@@ -637,6 +636,20 @@ class MultiLayerNetwork:
     def rnn_clear_previous_state(self):
         self._rnn_carries = None
 
+    def rnn_get_previous_state(self):
+        """Per-layer streaming hidden state, host-side (reference
+        ``rnnGetPreviousState``); None before any rnn_time_step."""
+        if self._rnn_carries is None:
+            return None
+        return jax.tree_util.tree_map(np.asarray, self._rnn_carries)
+
+    def rnn_set_previous_state(self, carries) -> None:
+        """Restore streaming state captured by ``rnn_get_previous_state``
+        (reference ``rnnSetPreviousState``) — e.g. to resume serving
+        after a process restart."""
+        self._rnn_carries = None if carries is None else \
+            jax.tree_util.tree_map(jnp.asarray, carries)
+
     def rnn_time_step(self, x) -> np.ndarray:
         """Stateful streaming inference (reference ``rnnTimeStep``)."""
         x = jnp.asarray(x)
@@ -707,6 +720,73 @@ class MultiLayerNetwork:
 
         return self._evaluate_with(it, Evaluation(top_n=top_n))
 
+    def predict(self, x) -> np.ndarray:
+        """Predicted class index per example (reference ``predict``);
+        time-distributed outputs return (b, T) indices."""
+        return np.argmax(self.output(x), axis=-1)
+
+    def f1_score(self, ds: Union[DataSet, DataSetIterator]) -> float:
+        """Micro-averaged F1 (reference ``f1Score(DataSet)``)."""
+        return float(self.evaluate(ds).f1())
+
+    def score_examples(self, ds: DataSet,
+                       add_regularization_terms: bool = True) -> np.ndarray:
+        """Per-example loss (reference ``scoreExamples``): the unreduced
+        output-layer loss, optionally plus the (shared) l1/l2 penalty."""
+
+        def run(params, state, f, l, fm, lm):
+            n = len(self.layers)
+            x, mask, _, _, _ = self._forward(
+                params, state, f, train=False, rng=None, fmask=fm,
+                stop_before=n - 1)
+            if self._compute_dtype is not None:
+                x = x.astype(jnp.float32)
+            out_layer = self._output_layer()
+            label_mask = lm if lm is not None else mask
+            kw = {"state": state[-1]} if isinstance(
+                out_layer, CenterLossOutputLayer) else {}
+            per_ex = out_layer.compute_score(params[-1], x, l, label_mask,
+                                             **kw)
+            if add_regularization_terms:
+                per_ex = per_ex + self._reg_score(params)
+            return per_ex
+
+        fn = self._get_jit(
+            f"score_examples_reg{int(add_regularization_terms)}",
+            lambda: jax.jit(run))
+        return np.asarray(fn(
+            self.params_, self.state_, jnp.asarray(ds.features),
+            None if ds.labels is None else jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+        ))
+
+    def layer_size(self, layer_idx: int) -> int:
+        """Output size of layer ``layer_idx`` (reference ``layerSize``:
+        nOut for dense/recurrent layers, channels for convolutional,
+        0 where undefined)."""
+        types = self.conf.layer_types()
+        out = self.layers[layer_idx].get_output_type(types[layer_idx])
+        if out.kind in ("feedforward", "recurrent"):
+            return int(out.size)
+        if out.kind == "convolutional":
+            return int(out.channels)
+        return 0
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Set the learning rate on every layer's updater (reference
+        ``setLearningRate``); takes effect on the next jitted step (the
+        step closes over the updater, so the compiled fn is invalidated)."""
+        from deeplearning4j_tpu.schedules import as_schedule
+
+        for layer in self.layers:
+            upd = layer.updater
+            if upd is not None and getattr(upd, "has_learning_rate", False):
+                upd.learning_rate = as_schedule(float(lr))
+        # every cached step closed over the old schedule (train, tbptt,
+        # pretrain{i}, ...) — drop them all; they recompile on demand
+        self._jit_cache.clear()
+
     def _evaluate_with(self, it, ev):
         """Shared drive loop for the evaluate-family helpers."""
         if isinstance(it, DataSet):
@@ -738,6 +818,28 @@ class MultiLayerNetwork:
     def num_params(self) -> int:
         assert self.params_ is not None
         return int(sum(int(np.prod(a.shape)) for p in self.params_ for a in p.values()))
+
+    def summary(self) -> str:
+        """Layer table — name, input→output type, #params (reference
+        ``MultiLayerNetwork.summary():3230``)."""
+        types = self.conf.layer_types()
+        out_types = [l.get_output_type(t)
+                     for l, t in zip(self.layers, types)]
+        rows = [("idx", "layer", "input", "output", "params")]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = (int(sum(int(np.prod(a.shape))
+                         for a in self.params_[i].values()))
+                 if self.params_ is not None else 0)
+            total += n
+            rows.append((str(i), type(layer).__name__, str(types[i]),
+                         str(out_types[i]), f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(5)]
+        lines = ["  ".join(r[c].ljust(widths[c]) for c in range(5))
+                 for r in rows]
+        lines.insert(1, "-" * (sum(widths) + 8))
+        lines.append(f"Total parameters: {total:,}")
+        return "\n".join(lines)
 
     def params_flat(self) -> np.ndarray:
         """Single flattened parameter vector (reference ``params()``; order:

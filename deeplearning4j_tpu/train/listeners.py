@@ -70,10 +70,15 @@ class TrainingListener:
 def _has_hook(lst, name: str) -> bool:
     """Listener provides its own implementation of ``name`` — as a class
     override or an instance-bound attribute (StatsListener binds hooks in
-    __init__ only when collection is requested)."""
-    return (name in lst.__dict__
-            or getattr(type(lst), name, None) is not getattr(TrainingListener,
-                                                             name))
+    __init__ only when collection is requested). Duck-typed listeners
+    that don't subclass TrainingListener and don't define the hook at
+    all are NOT hook providers (the listener SPI is duck-typed
+    everywhere else — e.g. early stopping's internal condition
+    listener)."""
+    if name in lst.__dict__:
+        return True
+    impl = getattr(type(lst), name, None)
+    return impl is not None and impl is not getattr(TrainingListener, name)
 
 
 def _overrides(listeners, name: str, next_iteration: Optional[int] = None) -> bool:
@@ -89,11 +94,12 @@ def _hook_recipients(listeners, name: str,
     ``next_iteration`` — hooks are delivered per listener, so a sampled
     listener (StatsListener at reportingFrequency) never pays device→host
     copies for iterations an always-on listener requested."""
-    return [
-        lst for lst in listeners
-        if _has_hook(lst, name)
-        and (next_iteration is None or lst.needs_introspection(next_iteration))
-    ]
+    def wants(lst):
+        gate = getattr(lst, "needs_introspection", None)
+        return (next_iteration is None or gate is None
+                or gate(next_iteration))
+
+    return [lst for lst in listeners if _has_hook(lst, name) and wants(lst)]
 
 
 class ScoreIterationListener(TrainingListener):

@@ -484,3 +484,26 @@ class TestGraphSerdeOrdering:
         v = UnstackVertex(from_idx=0, stack_size=2)
         with pytest.raises(ValueError, match="not divisible"):
             v.apply([jnp.zeros((5, 3))], [None])
+
+
+class TestGraphSummary:
+    def test_summary_table(self):
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf.graph_vertices import MergeVertex
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = (NeuralNetConfiguration.builder().seed(1).graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("d1", DenseLayer(n_out=4, activation="relu"), "a")
+                .add_layer("d2", DenseLayer(n_out=4, activation="relu"), "b")
+                .add_vertex("m", MergeVertex(), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(3),
+                                 InputType.feed_forward(3)).build())
+        cg = ComputationGraph(conf).init()
+        s = cg.summary()
+        assert "NetworkInput" in s and "MergeVertex" in s
+        assert f"Total parameters: {cg.num_params():,}" in s

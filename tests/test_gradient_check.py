@@ -354,3 +354,41 @@ class TestGradientChecksExtended:
             assert y.shape == ref.shape == (2, H, H, 2)
             np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                        atol=1e-5)
+
+    def test_conv1d_pipeline(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Convolution1DLayer,
+            Subsampling1DLayer,
+            Upsampling1D,
+        )
+
+        net = _build(
+            [Convolution1DLayer(n_out=4, kernel_size=3),
+             Subsampling1DLayer(kernel_size=2, stride=2),
+             Upsampling1D(size=2),
+             RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.recurrent(3),
+        )
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((2, 8, 3)).astype(np.float32)
+        # output time length after conv1d(k=3)/pool(2)/up(2)
+        T_out = net.output(x).shape[1]
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, T_out))]
+        assert check_gradients(net, DataSet(x, y))
+
+    def test_local_response_normalization(self):
+        from deeplearning4j_tpu.nn.conf.layers import (
+            LocalResponseNormalization,
+        )
+
+        net = _build(
+            [ConvolutionLayer(n_out=4, kernel_size=(3, 3)),
+             LocalResponseNormalization(),
+             GlobalPoolingLayer(pooling_type="avg"),
+             OutputLayer(n_out=2, activation="softmax", loss="mcxent")],
+            InputType.convolutional(6, 6, 2),
+        )
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((3, 6, 6, 2)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 3)]
+        assert check_gradients(net, DataSet(x, y))

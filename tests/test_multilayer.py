@@ -433,3 +433,99 @@ class TestTopNEvaluate:
         g = ComputationGraph(gconf).init()
         g.fit(ds2, batch_size=32)
         assert 0.0 <= g.evaluate_roc(ds2).calculate_auc() <= 1.0
+
+
+class TestSummary:
+    def test_summary_table(self):
+        """reference MultiLayerNetwork.summary():3230 — layer table with
+        per-layer and total param counts."""
+        from deeplearning4j_tpu.models.lenet import LeNet
+
+        net = LeNet(num_classes=10).init()
+        s = net.summary()
+        assert "ConvolutionLayer" in s and "OutputLayer" in s
+        assert f"Total parameters: {net.num_params():,}" in s
+        assert s.count("\n") >= 7
+
+
+class TestConvenienceAPI:
+    """predict / f1_score / score_examples / layer_size /
+    rnn_get+set_previous_state / set_learning_rate (reference
+    MultiLayerNetwork public surface)."""
+
+    def _net(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def _data(self, n=12):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+        return DataSet(x, y)
+
+    def test_predict_and_f1(self):
+        net = self._net()
+        ds = self._data()
+        pred = net.predict(ds.features)
+        assert pred.shape == (12,) and pred.dtype.kind == "i"
+        assert set(pred) <= {0, 1, 2}
+        f1 = net.f1_score(ds)
+        assert 0.0 <= f1 <= 1.0
+
+    def test_score_examples_matches_mean_score(self):
+        net = self._net()
+        ds = self._data()
+        per_ex = net.score_examples(ds, add_regularization_terms=False)
+        assert per_ex.shape == (12,)
+        np.testing.assert_allclose(per_ex.mean(), net.score(ds), rtol=1e-5)
+
+    def test_layer_size(self):
+        net = self._net()
+        assert net.layer_size(0) == 6 and net.layer_size(1) == 3
+
+    def test_set_learning_rate_changes_step(self):
+        ds = self._data()
+        a, b = self._net(), self._net()
+        a.fit(ds, epochs=1, batch_size=12)
+        b.set_learning_rate(0.0)
+        # materialize to host: the jitted step donates the param buffers
+        p_before = [{k: np.asarray(v) for k, v in p.items()}
+                    for p in b.params_]
+        b.fit(ds, epochs=1, batch_size=12)
+        for p0, p1 in zip(p_before, b.params_):
+            for k in p0:
+                np.testing.assert_array_equal(np.asarray(p0[k]),
+                                              np.asarray(p1[k]))
+        # and the lr=0.1 run did move
+        assert any(
+            not np.array_equal(np.asarray(pa[k]), np.asarray(pb[k]))
+            for pa, pb in zip(a.params_, p_before) for k in pa
+        )
+
+    def test_rnn_state_roundtrip(self):
+        from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
+
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .list()
+                .layer(LSTM(n_out=5))
+                .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x1 = rng.standard_normal((2, 4, 3)).astype(np.float32)
+        x2 = rng.standard_normal((2, 4, 3)).astype(np.float32)
+        net.rnn_time_step(x1)
+        saved = net.rnn_get_previous_state()
+        out_a = net.rnn_time_step(x2)
+        # restore and replay: identical continuation
+        net.rnn_set_previous_state(saved)
+        out_b = net.rnn_time_step(x2)
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-6, atol=1e-7)
+        net.rnn_clear_previous_state()
+        assert net.rnn_get_previous_state() is None
