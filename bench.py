@@ -134,16 +134,19 @@ def _bench_resnet(batch: int, compute_dtype):
     return batch * iters / dt
 
 
-def _bench_transformer(batch: int = 16, seq: int = 512):
+def _bench_transformer(batch: int = 16, seq: int = 512, n_layers: int = 12):
     """TransformerLM train throughput (tokens/sec) — the flagship
     distributed model's single-chip number, reported in extra alongside
-    the ResNet-50 headline. GPT-2-small-ish shape (d=768, L=12, h=12)."""
+    the ResNet-50 headline. GPT-2-small-ish shape (d=768, L=12, h=12).
+    Also called at (b=4, T=2048) for the long-context variant, where the
+    flash kernel's O(T) memory matters vs dense attention's (T, T)
+    scores."""
     import jax.numpy as jnp
 
     from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 
     model = TransformerLM(vocab_size=32000, d_model=768, n_heads=12,
-                          n_layers=12, max_length=seq,
+                          n_layers=n_layers, max_length=seq,
                           compute_dtype="bfloat16").init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 32000, (batch, seq)).astype(np.int32)
@@ -253,8 +256,35 @@ def main():
                 _bench_transformer(), 1)
             extra["transformer_lm_config"] = ("d768 L12 h12 T512 b16 bf16 "
                                               "(fp32 masters)")
+            # record which attention impl the probe selected (in-tree
+            # pallas / jax-bundled pallas / dense fallback)
+            from deeplearning4j_tpu.nn.conf.layers.attention import (
+                _FLASH_PROBE_CACHE,
+            )
+
+            impls = []
+            for key, impl in _FLASH_PROBE_CACHE.items():
+                if impl is None:
+                    impls.append(f"{key}: dense-fallback")
+                else:
+                    mod = getattr(impl.args[0], "__module__", "?")
+                    impls.append(
+                        f"{key}: "
+                        + ("in-tree" if "deeplearning4j_tpu" in mod
+                           else "jax-bundled"))
+            extra["attention_impl"] = impls or ["no flash-eligible shapes"]
         except Exception as e:
             extra["transformer_lm_error"] = f"{type(e).__name__}: {e}"
+        if os.environ.get("BENCH_SKIP_LONG_CONTEXT", "0") != "1":
+            try:
+                extra["transformer_lm_long_ctx_tokens_per_sec"] = round(
+                    _bench_transformer(batch=4, seq=2048), 1)
+                extra["transformer_lm_long_ctx_config"] = (
+                    "d768 L12 h12 T2048 b4 bf16")
+            except Exception as e:
+                # dense fallback at T=2048 can exhaust HBM — record why
+                extra["transformer_lm_long_ctx_error"] = (
+                    f"{type(e).__name__}: {str(e)[:300]}")
     try:
         gbps, n = _bench_allreduce(devices)
         extra["allreduce_algbw_gbps"] = gbps

@@ -773,6 +773,40 @@ class MultiLayerNetwork:
             return int(out.channels)
         return 0
 
+    def to_computation_graph(self):
+        """Convert to an equivalent ComputationGraph (reference
+        ``toComputationGraph``): layers become a linear vertex chain
+        ("layer_0" → … → "layer_{n-1}" from input "input"), preprocessors
+        ride their layer's vertex, params/state/updater-state are copied
+        over, so outputs match exactly."""
+        import copy
+
+        from deeplearning4j_tpu.nn.conf.graph_builder import GraphBuilder
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        gb = GraphBuilder(copy.deepcopy(self.conf.global_conf))
+        gb.add_inputs("input")
+        prev = "input"
+        for i, layer in enumerate(self.layers):
+            name = f"layer_{i}"
+            gb.add_layer(name, copy.deepcopy(layer), prev,
+                         preprocessor=copy.deepcopy(
+                             self.conf.preprocessors.get(i)))
+            prev = name
+        gb.set_outputs(prev)
+        if self.conf.input_type is not None:
+            gb.set_input_types(self.conf.input_type)
+        cg = ComputationGraph(gb.build())
+        if self.params_ is not None:
+            cg.init()
+            for i in range(len(self.layers)):
+                name = f"layer_{i}"
+                cg.params_[name] = dict(self.params_[i])
+                cg.state_[name] = dict(self.state_[i])
+                cg.opt_state_[name] = copy.deepcopy(self.opt_state_[i])
+            cg.iteration, cg.epoch = self.iteration, self.epoch
+        return cg
+
     def set_learning_rate(self, lr: float) -> None:
         """Set the learning rate on every layer's updater (reference
         ``setLearningRate``); takes effect on the next jitted step (the

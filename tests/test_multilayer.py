@@ -529,3 +529,36 @@ class TestConvenienceAPI:
         np.testing.assert_allclose(out_a, out_b, rtol=1e-6, atol=1e-7)
         net.rnn_clear_previous_state()
         assert net.rnn_get_previous_state() is None
+
+
+class TestToComputationGraph:
+    def test_outputs_match_after_conversion(self):
+        """reference MultiLayerNetwork.toComputationGraph(): converted
+        graph produces identical outputs and keeps training."""
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer,
+            SubsamplingLayer,
+        )
+
+        conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.05))
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5, 8, 8, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 5)]
+        net.fit(DataSet(x, y), epochs=2, batch_size=5)
+
+        cg = net.to_computation_graph()
+        np.testing.assert_allclose(net.output(x), cg.output_single(x),
+                                   rtol=1e-5, atol=1e-6)
+        # converted graph keeps training (updater state carried over)
+        s0 = cg.score(DataSet(x, y))
+        cg.fit(DataSet(x, y), epochs=3, batch_size=5)
+        assert cg.score(DataSet(x, y)) < s0
