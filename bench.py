@@ -26,6 +26,7 @@ fp32, BASELINE.md) — the regression gate for subsequent rounds.
 """
 
 import json
+import os
 import sys
 import time
 import traceback
@@ -36,27 +37,51 @@ import numpy as np
 
 ROUND1_IMG_PER_SEC = 1292.8  # BASELINE.md 2026-07-29, fp32, batch 128
 
+# Every successful hardware measurement is persisted here so a tunnel
+# outage at snapshot time degrades to a stale-but-real number instead of
+# 0.0 (round-3 failure mode: BENCH_r03.json recorded an outage as the
+# round artifact).
+CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_cache.json")
 
-def _init_devices(max_tries: int = 5):
-    """jax.devices() with retry/backoff across axon tunnel flakes.
 
-    Guards against the silent-CPU-fallback trap: a failed axon init can
-    leave xla_bridge with only the cpu backend, and a bare retry would
-    then "succeed" on CPU and record a bogus number as the round artifact.
+def _cache_store(result: dict) -> None:
+    try:
+        record = dict(result)
+        record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime())
+        with open(CACHE_PATH + ".tmp", "w") as f:
+            json.dump(record, f)
+        os.replace(CACHE_PATH + ".tmp", CACHE_PATH)
+    except OSError:
+        pass  # caching is best-effort; never fail the live measurement
 
-    A single axon init attempt can BLOCK ~25 min before failing when the
-    tunnel is down, so retries run against a wall-clock budget
-    (BENCH_INIT_BUDGET_S, default 20 min) — a long first failure exits
-    immediately with the error JSON instead of retrying for hours.
+
+def _cache_load() -> "dict | None":
+    try:
+        with open(CACHE_PATH) as f:
+            record = json.load(f)
+        return record if record.get("value") else None
+    except (OSError, ValueError):
+        return None
+
+
+def _init_devices():
+    """jax.devices() with the silent-CPU-fallback guard: a failed axon
+    init can leave xla_bridge with only the cpu backend, and "success" on
+    CPU would record a bogus number as the round artifact.
+
+    Hang-resistance lives one level up: the whole benchmark runs in a
+    child process under the supervisor's killable deadline (see
+    _supervise), so a blocking axon init can never eat more than one
+    attempt's share of the budget.
 
     BENCH_FORCE_CPU=1 pins the virtual-CPU path for script validation
     (the axon plugin overrides the JAX_PLATFORMS env var, so only
     jax.config.update reliably selects cpu)."""
     import importlib.util
-    import os
 
     import jax
-    from jax.extend import backend as jex_backend
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         jax.config.update("jax_platforms", "cpu")
@@ -68,26 +93,70 @@ def _init_devices(max_tries: int = 5):
     want_tpu = "axon" in jp or (
         jp == "" and importlib.util.find_spec("axon") is not None
     )
-    deadline = time.monotonic() + float(os.environ.get("BENCH_INIT_BUDGET_S", "1200"))
-    delay = 5.0
-    last = None
-    for attempt in range(max_tries):
-        try:
-            devices = jax.devices()
-            if want_tpu and devices[0].platform == "cpu":
-                raise RuntimeError("axon requested but only cpu backend came up")
-            return devices
-        except Exception as e:  # tunnel errors surface as RuntimeError
-            last = e
+    devices = jax.devices()
+    if want_tpu and devices[0].platform == "cpu":
+        raise RuntimeError("axon requested but only cpu backend came up")
+    return devices
+
+
+def _supervise(argv, tries: int, budget_s: float) -> dict:
+    """Run the real benchmark (BENCH_CHILD=1 re-exec of this script) in a
+    killable subprocess and return its parsed JSON result.
+
+    Round-3 failure mode: a single in-process axon init can BLOCK ~25 min
+    when the tunnel is down, so an in-process retry loop gave up after one
+    "attempt" and the round artifact was 0.0. A subprocess in its own
+    process group can be killed at the deadline, so the budget is
+    genuinely spread over multiple attempts — and a hang ANYWHERE in the
+    benchmark (init, compile, device sync), not just in jax.devices(), is
+    bounded. Output goes to temp files, not pipes: runtime helper
+    processes that survive a group kill cannot then block us on pipe EOF."""
+    import signal
+    import subprocess
+    import tempfile
+
+    deadline = time.monotonic() + budget_s
+    last = "no attempt made"
+    for attempt in range(tries):
+        remaining = deadline - time.monotonic()
+        if remaining <= 10:
+            break
+        per_try = max(60.0, remaining / (tries - attempt))
+        env = dict(os.environ, BENCH_CHILD="1")
+        with tempfile.TemporaryFile("w+") as out_f, \
+                tempfile.TemporaryFile("w+") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable] + argv, stdout=out_f, stderr=err_f,
+                env=env, start_new_session=True,
+            )
+            timed_out = False
             try:
-                jex_backend.clear_backends()
-            except Exception:
-                pass
-            if attempt == max_tries - 1 or time.monotonic() > deadline:
-                break
-            time.sleep(delay)
-            delay = min(delay * 2, 60.0)
-    raise RuntimeError(f"backend init failed (tries={attempt + 1}): {last}")
+                code = proc.wait(timeout=per_try)
+            except subprocess.TimeoutExpired:
+                try:  # kill the whole group — axon forks runtime helpers
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                proc.wait()
+                timed_out, code = True, -9
+            out_f.seek(0)
+            lines = [ln for ln in out_f.read().splitlines() if ln.strip()]
+            # a printed result counts even if the child then hung in
+            # teardown (axon runtime-helper hang at interpreter exit) —
+            # the measurement itself completed
+            if lines and (code == 0 or timed_out):
+                try:
+                    return json.loads(lines[-1])
+                except ValueError:
+                    pass
+            if timed_out:
+                last = (f"attempt {attempt + 1} timed out after "
+                        f"{per_try:.0f}s with no result line")
+                continue
+            err_f.seek(0)
+            tail = err_f.read()[-400:].replace("\n", " | ")
+            last = f"attempt {attempt + 1} exited {code}: {tail}"
+    raise RuntimeError(f"benchmark failed (tries={tries}): {last}")
 
 
 def _bench_resnet(batch: int, compute_dtype):
@@ -295,25 +364,55 @@ def main():
     except Exception as e:
         extra["allreduce_error"] = f"{type(e).__name__}: {e}"
 
-    print(json.dumps({
+    result = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec / ROUND1_IMG_PER_SEC, 3),
         "extra": extra,
-    }))
+    }
+    # persist real-hardware measurements only — a CPU-pinned validation
+    # run must never become the stale fallback artifact
+    if extra.get("platform") != "cpu":
+        _cache_store(result)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    try:
+    if os.environ.get("BENCH_CHILD") == "1":
+        # child mode: run the real benchmark; exceptions propagate so the
+        # supervisor sees a non-zero exit and retries / falls back
         main()
+        sys.exit(0)
+    try:
+        result = _supervise(
+            [os.path.abspath(__file__)] + sys.argv[1:],
+            tries=int(os.environ.get("BENCH_TRIES", "2")),
+            budget_s=float(os.environ.get("BENCH_TOTAL_BUDGET_S", "1200")),
+        )
+        print(json.dumps(result))
     except Exception as e:
-        print(json.dumps({
-            "metric": "resnet50_train_images_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}",
-            "traceback": traceback.format_exc()[-1500:],
-        }))
+        err = f"{type(e).__name__}: {e}"
+        cached = _cache_load()
+        if cached is not None:
+            # outage fallback: the last good hardware measurement,
+            # explicitly flagged stale, with the live error attached —
+            # never a bare 0.0 as the round artifact
+            out = {k: cached[k]
+                   for k in ("metric", "value", "unit", "vs_baseline",
+                             "extra")
+                   if k in cached}
+            out["stale"] = True
+            out["measured_at"] = cached.get("measured_at")
+            out["error"] = err
+            print(json.dumps(out))
+        else:
+            print(json.dumps({
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "images/sec/chip",
+                "vs_baseline": 0.0,
+                "error": err,
+                "traceback": traceback.format_exc()[-1500:],
+            }))
         sys.exit(0)
