@@ -220,7 +220,13 @@ class RPForest:
         if short.any():
             for i in np.flatnonzero(short.any(1)):
                 good = np.flatnonzero(~short[i])
-                last = good[-1] if len(good) else 0
+                if not len(good):
+                    # only reachable when fit() saw empty data — padding
+                    # index 0 would masquerade as a genuine neighbour
+                    raise RuntimeError(
+                        f"query {i}: no candidates in any tree (empty or "
+                        "unfitted forest)")
+                last = good[-1]
                 idx[i, short[i]] = idx[i, last]
                 d[i, short[i]] = d[i, last]
         return d, idx

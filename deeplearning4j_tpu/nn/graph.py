@@ -66,7 +66,16 @@ def _as_multi(ds: Union[DataSet, MultiDataSet]) -> MultiDataSet:
 
 
 class ComputationGraph:
-    def __init__(self, conf: ComputationGraphConfiguration):
+    def __init__(self, conf: ComputationGraphConfiguration, *,
+                 copy_conf: bool = True):
+        import copy
+
+        # private conf copy — see MultiLayerNetwork.__init__: keeps
+        # set_learning_rate & co from mutating sibling networks built
+        # from the same configuration object; copy_conf=False for
+        # callers handing over a conf nothing else holds
+        if copy_conf:
+            conf = copy.deepcopy(conf)
         self.conf = conf
         self.topo = conf.topological_order
         # deterministic list of layer-vertex names (topo order) — the
@@ -876,9 +885,24 @@ class ComputationGraph:
     def add_listeners(self, *listeners) -> None:
         self.listeners.extend(listeners)
 
+    def set_learning_rate(self, lr: float) -> None:
+        """Set the learning rate on every layer vertex's updater
+        (reference ``ComputationGraph.setLearningRate``); takes effect on
+        the next jitted step. The conf is network-private (see __init__),
+        so sibling networks are unaffected."""
+        from deeplearning4j_tpu.schedules import as_schedule
+
+        for name in self.layer_names:
+            upd = self._layer(name).updater
+            if upd is not None and getattr(upd, "has_learning_rate", False):
+                upd.learning_rate = as_schedule(float(lr))
+        self._jit_cache.clear()
+
+    setLearningRate = set_learning_rate
+
     def clone(self) -> "ComputationGraph":
         conf = ComputationGraphConfiguration.from_json(self.conf.to_json())
-        net = ComputationGraph(conf)
+        net = ComputationGraph(conf, copy_conf=False)
         if self.params_ is not None:
             # deep copy, no init(): the source's train step donates its
             # buffers to XLA, so shared arrays would be deleted under it

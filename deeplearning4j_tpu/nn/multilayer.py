@@ -132,7 +132,20 @@ def _apply_layer_updates(layers, params, grads, opt_state, t, iteration, epoch):
 
 
 class MultiLayerNetwork:
-    def __init__(self, conf: MultiLayerConfiguration):
+    def __init__(self, conf: MultiLayerConfiguration, *,
+                 copy_conf: bool = True):
+        import copy
+
+        # Own a private copy of the configuration: layers (and their
+        # updaters/schedules) are mutable, and e.g. set_learning_rate
+        # must not silently retune a sibling network built from the same
+        # conf object. Within THIS network, self.layers and
+        # self.conf.layers stay the same objects so to_json() always
+        # serializes the live hyperparameters. copy_conf=False is for
+        # callers that just built a conf nothing else holds (clone()'s
+        # JSON round-trip) — skips the redundant deepcopy.
+        if copy_conf:
+            conf = copy.deepcopy(conf)
         self.conf = conf
         self.layers: List[Layer] = conf.layers
         self.params_: Optional[List[Dict[str, Array]]] = None
@@ -796,7 +809,7 @@ class MultiLayerNetwork:
         gb.set_outputs(prev)
         if self.conf.input_type is not None:
             gb.set_input_types(self.conf.input_type)
-        cg = ComputationGraph(gb.build())
+        cg = ComputationGraph(gb.build(), copy_conf=False)
         if self.params_ is not None:
             cg.init()
             for i in range(len(self.layers)):
@@ -820,6 +833,8 @@ class MultiLayerNetwork:
         # every cached step closed over the old schedule (train, tbptt,
         # pretrain{i}, ...) — drop them all; they recompile on demand
         self._jit_cache.clear()
+
+    setLearningRate = set_learning_rate
 
     def _evaluate_with(self, it, ev):
         """Shared drive loop for the evaluate-family helpers."""
@@ -949,7 +964,7 @@ class MultiLayerNetwork:
     def clone(self) -> "MultiLayerNetwork":
         """Deep copy via config JSON + param copy (reference ``clone()``)."""
         conf = MultiLayerConfiguration.from_json(self.conf.to_json())
-        net = MultiLayerNetwork(conf)
+        net = MultiLayerNetwork(conf, copy_conf=False)
         if self.params_ is not None:
             # deep copy, no init(): the source's train step donates its
             # buffers to XLA, so shared arrays would be deleted under it

@@ -280,6 +280,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
     (custom VJP, FlashAttention-2-style backward). ``interpret=True``
     runs the Pallas interpreter (CPU testing)."""
     b, h, T, hd = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"q/k/v shapes must match exactly (got q={q.shape}, "
+            f"k={k.shape}, v={v.shape}); cross-attention / differing kv "
+            "lengths are not supported by this kernel — use dense_attention")
     if T % _LANE or T > MAX_SEQ_LEN:
         raise ValueError(
             f"T={T} must be a multiple of {_LANE} and <= {MAX_SEQ_LEN} "

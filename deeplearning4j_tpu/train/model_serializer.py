@@ -57,7 +57,7 @@ class ModelSerializer:
     def _restore(path: str, conf_cls, net_cls, load_updater: bool):
         with zipfile.ZipFile(path, "r") as z:
             conf = conf_cls.from_json(z.read(CONFIG_ENTRY).decode())
-            net = net_cls(conf)
+            net = net_cls(conf, copy_conf=False)  # conf is ours alone
             net.init()
             net.set_params_flat(np.frombuffer(z.read(COEFFICIENTS_ENTRY), dtype="<f4"))
             names = z.namelist()

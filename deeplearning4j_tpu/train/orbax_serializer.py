@@ -198,6 +198,6 @@ def _build_from_conf(directory: str, meta: dict):
         )
 
         conf = ComputationGraphConfiguration.from_json(conf_json)
-        return ComputationGraph(conf).init()
+        return ComputationGraph(conf, copy_conf=False).init()
     conf = MultiLayerConfiguration.from_json(conf_json)
-    return MultiLayerNetwork(conf).init()
+    return MultiLayerNetwork(conf, copy_conf=False).init()

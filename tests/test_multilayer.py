@@ -507,6 +507,32 @@ class TestConvenienceAPI:
             for pa, pb in zip(a.params_, p_before) for k in pa
         )
 
+    def test_set_learning_rate_isolated_between_networks(self):
+        """Two networks built from ONE conf object must not share updater
+        state: set_learning_rate(0) on one leaves the other training
+        (ADVICE r3: networks held references to the conf's layer objects,
+        so retuning one silently retuned its sibling)."""
+        ds = self._data()
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        frozen = MultiLayerNetwork(conf).init()
+        live = MultiLayerNetwork(conf).init()
+        frozen.set_learning_rate(0.0)
+        # the conf's own layers are untouched too
+        conf_lrs = [float(l.updater.learning_rate.value_at(0, 0))
+                    for l in conf.layers if l.updater is not None]
+        np.testing.assert_allclose(conf_lrs, 0.1, rtol=1e-6)
+        p_before = [{k: np.asarray(v) for k, v in p.items()}
+                    for p in live.params_]
+        live.fit(ds, epochs=1, batch_size=12)
+        assert any(
+            not np.array_equal(p0[k], np.asarray(p1[k]))
+            for p0, p1 in zip(p_before, live.params_) for k in p0)
+
     def test_rnn_state_roundtrip(self):
         from deeplearning4j_tpu.nn.conf.layers import LSTM, RnnOutputLayer
 
