@@ -1,9 +1,13 @@
-"""Static HTML dashboard (replaces the reference's Play-framework
-``TrainModule`` overview/model/system pages, ``ui/play/PlayUIServer.java``):
-one self-contained file with inline SVG charts — score vs iteration,
-update:parameter ratios per layer, throughput, memory — generated from a
-StatsStorage. ``UIServer.attach(storage)`` + ``render()`` mirrors the
-reference's attach-and-browse workflow without a web server.
+"""Training dashboard: live HTTP server + static HTML export (the
+reference's Play-framework UI, ``ui/play/PlayUIServer.java`` with the
+``TrainModule`` overview/model/system pages): self-contained pages with
+inline SVG charts — score vs iteration, update:parameter ratios per
+layer, throughput, memory — generated from a StatsStorage.
+
+``UIServer.get_instance().attach(storage); .start(port)`` serves the
+dashboard while training runs (pages auto-refresh, so the browser tracks
+the run mid-training like the reference's polling UI); ``render(path)``
+writes the same page as a static file for offline viewing.
 
 Charts are built with the ui-components DSL (``ui/components.py``), the
 same layering as the reference (TrainModule renders through
@@ -13,6 +17,9 @@ deeplearning4j-ui-components).
 from __future__ import annotations
 
 import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.ui.components import ChartLine, StyleChart
@@ -37,10 +44,14 @@ def _line(series: Dict[str, List[Tuple[float, float]]], title: str,
 
 
 def render_dashboard(storage: StatsStorage, session_id: Optional[str] = None,
-                     path: Optional[str] = None) -> str:
+                     path: Optional[str] = None,
+                     auto_refresh_s: Optional[int] = None) -> str:
     """Build the HTML report; writes to ``path`` if given. Sections mirror
     the reference TrainModule: Overview (score/throughput), Model
-    (update:param ratios, per-layer stats), System (memory)."""
+    (update:param ratios, per-layer stats), System (memory).
+    ``auto_refresh_s`` adds a meta-refresh so a browser pointed at the
+    live UIServer re-polls while training runs (reference TrainModule's
+    polling behaviour)."""
     sessions = storage.list_session_ids()
     if session_id is None:
         if not sessions:
@@ -77,8 +88,11 @@ def render_dashboard(storage: StatsStorage, session_id: Optional[str] = None,
             f"{init['num_params']:,} parameters — layers: "
             f"{html.escape(', '.join(map(str, init['layer_names'])))}</p>"
         )
+    refresh_tag = (
+        f'<meta http-equiv="refresh" content="{int(auto_refresh_s)}">'
+        if auto_refresh_s else "")
     doc = f"""<!doctype html>
-<html><head><meta charset="utf-8">
+<html><head><meta charset="utf-8">{refresh_tag}
 <title>Training: {html.escape(session_id)}</title>
 <style>body{{font-family:sans-serif;max-width:1400px;margin:24px auto;
 padding:0 16px;color:#111827}} .row{{display:flex;flex-wrap:wrap;gap:16px}}
@@ -115,14 +129,32 @@ deeplearning4j_tpu</p>
 
 
 class UIServer:
-    """Workflow-parity facade (reference ``UIServer.getInstance().attach``):
-    attach storages, then ``render(path)`` the static dashboard (instead of
-    serving HTTP)."""
+    """Live training-dashboard server (reference
+    ``UIServer.getInstance().attach(statsStorage)`` +
+    ``PlayUIServer.java`` route table): attach storages, ``start(port)``,
+    then browse while training runs — pages are re-rendered from the
+    live StatsStorage on every request and auto-refresh.
+
+    Routes (mirroring PlayUIServer's):
+      ``/`` and ``/train``        latest session's train dashboard
+      ``/train/<session_id>``     specific session
+      ``/sessions``               JSON session-id list across storages
+      ``POST /stats``             remote-listener endpoint: JSON records
+                                  into the first attached storage
+                                  (reference ``enableRemoteListener``,
+                                  ``RemoteReceiverModule``)
+
+    ``render(path)`` still writes the static export for offline viewing.
+    """
 
     _instance: Optional["UIServer"] = None
 
     def __init__(self):
         self.storages: List[StatsStorage] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        self.auto_refresh_s = 3
 
     @classmethod
     def get_instance(cls) -> "UIServer":
@@ -142,3 +174,102 @@ class UIServer:
         if not self.storages:
             raise ValueError("No storage attached")
         return render_dashboard(self.storages[-1], session_id, path)
+
+    # ----------------------------------------------------------- live server
+    def _find(self, session_id: Optional[str]):
+        """(storage, session_id) for the requested — or latest — session."""
+        if session_id is not None:
+            for st in self.storages:
+                if session_id in st.list_session_ids():
+                    return st, session_id
+            raise KeyError(f"unknown session: {session_id}")
+        for st in reversed(self.storages):
+            ids = st.list_session_ids()
+            if ids:
+                return st, ids[-1]
+        raise KeyError("no sessions in any attached storage")
+
+    def _waiting_page(self) -> str:
+        return (f'<!doctype html><html><head><meta http-equiv="refresh" '
+                f'content="{self.auto_refresh_s}"></head><body>'
+                "<p>No sessions yet — waiting for training to "
+                "start…</p></body></html>")
+
+    def start(self, port: int = 9000, host: str = "127.0.0.1") -> "UIServer":
+        """Start serving (idempotent). ``port=0`` picks a free port;
+        the bound port is in ``self.port`` (reference ``getPort()``)."""
+        if self._httpd is not None:
+            return self
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet: listeners poll frequently
+                pass
+
+            def _send_html(self, body: str, code: int = 200):
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                from urllib.parse import unquote
+
+                path = self.path.split("?")[0].rstrip("/")
+                if path in ("", "/train"):
+                    try:
+                        st, sid = ui._find(None)
+                    except KeyError:
+                        # nothing attached yet: auto-refreshing holding
+                        # page until the first record lands
+                        self._send_html(ui._waiting_page())
+                        return
+                    self._send_html(render_dashboard(
+                        st, sid, auto_refresh_s=ui.auto_refresh_s))
+                elif path.startswith("/train/"):
+                    sid = unquote(path[len("/train/"):])
+                    try:
+                        st, sid = ui._find(sid)
+                    except KeyError as e:  # unknown id is an error, not
+                        self.send_error(404, str(e)[:200])  # a wait state
+                        return
+                    self._send_html(render_dashboard(
+                        st, sid, auto_refresh_s=ui.auto_refresh_s))
+                elif path == "/sessions":
+                    ids = [s for st in ui.storages
+                           for s in st.list_session_ids()]
+                    data = json.dumps(ids).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                from deeplearning4j_tpu.ui.remote import handle_stats_post
+
+                if self.path != "/stats" or not ui.storages:
+                    self.send_error(404)
+                    return
+                handle_stats_post(self, ui.storages[0])
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+            self._httpd = None
+            self._thread = None
+            self.port = None

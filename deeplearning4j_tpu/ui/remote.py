@@ -115,6 +115,23 @@ class RemoteUIStatsStorageRouter(StatsStorage):
         raise NotImplementedError("router is write-only; query the receiver")
 
 
+def handle_stats_post(handler: BaseHTTPRequestHandler,
+                      storage: StatsStorage) -> None:
+    """Shared POST /stats endpoint body: JSON record from the request →
+    ``storage.put_record``. Used by both ``RemoteStatsReceiver`` and the
+    live ``UIServer`` (reference ``RemoteReceiverModule`` — one contract,
+    one implementation)."""
+    try:
+        n = int(handler.headers.get("Content-Length", 0))
+        record = json.loads(handler.rfile.read(n))
+        storage.put_record(record)
+        handler.send_response(200)
+        handler.send_header("Content-Length", "0")
+        handler.end_headers()
+    except Exception as e:  # noqa: BLE001 — service boundary
+        handler.send_error(400, str(e)[:200])
+
+
 class RemoteStatsReceiver:
     """HTTP endpoint writing posted records into a backing StatsStorage
     (reference ``RemoteReceiverModule``). ``storage`` is then rendered
@@ -133,15 +150,7 @@ class RemoteStatsReceiver:
                 if self.path != "/stats":
                     self.send_error(404)
                     return
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    record = json.loads(self.rfile.read(n))
-                    recv.storage.put_record(record)
-                    self.send_response(200)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                except Exception as e:  # noqa: BLE001 — service boundary
-                    self.send_error(400, str(e)[:200])
+                handle_stats_post(self, recv.storage)
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_address[1]
