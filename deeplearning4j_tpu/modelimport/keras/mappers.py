@@ -48,9 +48,11 @@ from deeplearning4j_tpu.nn.conf.layers import (
     GlobalPoolingLayer,
     LastTimeStep,
     Layer,
+    LocalResponseNormalization,
     LSTM,
     SeparableConvolution2D,
     SimpleRnn,
+    SpaceToDepthLayer,
     Subsampling1DLayer,
     SubsamplingLayer,
     Upsampling1D,
@@ -235,14 +237,16 @@ def _map_conv1d(cfg: dict) -> Mapped:
         n_out=filters,
         kernel_size=int(_pair(cfg["kernel_size"])[0]),
         stride=int(_pair(cfg.get("strides", 1))[0]),
+        dilation=int(_pair(cfg.get("dilation_rate", 1))[0]),
         convolution_mode=_conv_mode(cfg),
         activation=map_activation(cfg.get("activation", "linear")),
         has_bias=cfg.get("use_bias", True),
     )
 
     def tr(w):
-        kernel = np.asarray(pick(w, "kernel"), np.float32)  # (k, in, out)
-        p = {"W": kernel[:, None, :, :]}  # → (k, 1, in, out) HWIO
+        # Keras Conv1D kernel (k, in, out) == Convolution1DLayer W layout
+        # (WIO, conv.py init_params) — identity translation
+        p = {"W": np.asarray(pick(w, "kernel"), np.float32)}
         if layer.has_bias:
             b = pick(w, "bias")
             p["b"] = (np.zeros((filters,), np.float32) if b is None
@@ -392,6 +396,65 @@ def _map_batchnorm(cfg: dict) -> Mapped:
         return params, state
 
     return Mapped(layer=layer, translator=tr)
+
+
+def _map_lrn(cfg: dict) -> Mapped:
+    """Local response normalization (reference ``KerasLRN.java`` — the
+    keras-contrib/Keras-1 ``LRN``/``LRN2D`` layer): alpha/beta/k/n map
+     1:1 onto LocalResponseNormalization; the across-channel window form
+    ``x / (k + alpha·Σx²)^beta`` matches tf.nn.local_response_normalization
+    with ``depth_radius = n//2`` (n odd)."""
+    return Mapped(layer=LocalResponseNormalization(
+        k=float(cfg.get("k", 2.0)),
+        n=float(cfg.get("n", 5.0)),
+        alpha=float(cfg.get("alpha", 1e-4)),
+        beta=float(cfg.get("beta", 0.75)),
+    ))
+
+
+def _map_space_to_depth(cfg: dict) -> Mapped:
+    """Space-to-depth / YOLO2 "reorg" (reference
+    ``KerasSpaceToDepth.java``, which hardcodes blocks=2 for the YOLO2
+    import path; the block size is honoured here when present)."""
+    _check_channels_last(cfg, cfg.get("name", "space_to_depth"))
+    block = int(cfg.get("block_size", cfg.get("blocks", 2)))
+    return Mapped(layer=SpaceToDepthLayer(block_size=block))
+
+
+def _keras1_conv_cfg(cfg: dict, rank: int) -> dict:
+    """Normalize Keras-1 conv config keys (``nb_filter``/``nb_row``/
+    ``nb_col``/``subsample``/``atrous_rate``/``border_mode``) to the
+    Keras-2 names the conv mappers read. Keras-2-style configs pass
+    through untouched (legacy class name, modern serialization)."""
+    if "filters" in cfg:
+        return cfg
+    out = dict(cfg)
+    out["filters"] = cfg["nb_filter"]
+    if rank == 1:
+        out["kernel_size"] = [int(cfg["filter_length"])]
+        out["strides"] = [int(cfg.get("subsample_length", 1))]
+        rate = cfg.get("atrous_rate", 1)
+        out["dilation_rate"] = [int(rate)]
+    else:
+        out["kernel_size"] = [int(cfg["nb_row"]), int(cfg["nb_col"])]
+        out["strides"] = _pair(cfg.get("subsample", 1))
+        out["dilation_rate"] = _pair(cfg.get("atrous_rate", 1))
+    if "border_mode" in cfg:
+        out["padding"] = cfg["border_mode"]
+    return out
+
+
+def _map_atrous_conv1d(cfg: dict) -> Mapped:
+    """Dilated conv, Keras-1 ``AtrousConvolution1D`` (reference
+    ``KerasAtrousConvolution1D.java``); Convolution1DLayer carries the
+    dilation directly."""
+    return _map_conv1d(_keras1_conv_cfg(cfg, 1))
+
+
+def _map_atrous_conv2d(cfg: dict) -> Mapped:
+    """Dilated conv, Keras-1 ``AtrousConvolution2D`` (reference
+    ``KerasAtrousConvolution2D.java``)."""
+    return _map_conv2d(_keras1_conv_cfg(cfg, 2))
 
 
 # ------------------------------------------------------------- pad / crop
@@ -592,6 +655,12 @@ MAPPERS: Dict[str, Callable[[dict], Mapped]] = {
     "GlobalMaxPooling1D": lambda cfg: _map_global_pool(cfg, "max"),
     "GlobalAveragePooling1D": lambda cfg: _map_global_pool(cfg, "avg"),
     "BatchNormalization": _map_batchnorm,
+    "LRN": _map_lrn,
+    "LRN2D": _map_lrn,
+    "LocalResponseNormalization": _map_lrn,
+    "SpaceToDepth": _map_space_to_depth,
+    "AtrousConvolution1D": _map_atrous_conv1d,
+    "AtrousConvolution2D": _map_atrous_conv2d,
     "ZeroPadding2D": _map_zeropad2d,
     "ZeroPadding1D": _map_zeropad1d,
     "Cropping2D": _map_cropping2d,
@@ -612,6 +681,9 @@ MAPPERS: Dict[str, Callable[[dict], Mapped]] = {
 
 
 def map_keras_layer(class_name: str, cfg: dict) -> Mapped:
+    # custom/contrib layers serialize as "package>ClassName" (Keras 3
+    # registered_keras_serializable) — dispatch on the bare class name
+    class_name = class_name.split(">")[-1]
     fn = MAPPERS.get(class_name)
     if fn is None:
         raise UnsupportedKerasLayer(

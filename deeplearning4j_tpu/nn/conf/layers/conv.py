@@ -434,12 +434,15 @@ class SpaceToBatchLayer(Layer):
 class Convolution1DLayer(BaseConvLayer):
     """1D conv over time (reference ``Convolution1DLayer.java``)."""
 
-    def __init__(self, kernel_size: int = 3, stride: int = 1, padding: int = 0, **kwargs):
+    def __init__(self, kernel_size: int = 3, stride: int = 1, padding: int = 0,
+                 dilation: int = 1, **kwargs):
         kwargs.setdefault("convolution_mode", "truncate")
-        super().__init__(kernel_size=(kernel_size, 1), stride=(stride, 1), padding=(padding, 1), **kwargs)
+        super().__init__(kernel_size=(kernel_size, 1), stride=(stride, 1), padding=(padding, 1),
+                         dilation=(dilation, 1), **kwargs)
         self.kernel_size = [int(kernel_size)]
         self.stride = [int(stride)]
         self.padding = [int(padding)]
+        self.dilation = [int(dilation)]
 
     def initialize(self, input_type):
         if input_type.kind != "recurrent":
@@ -451,7 +454,8 @@ class Convolution1DLayer(BaseConvLayer):
         ts = input_type.timesteps
         out_ts = None
         if ts is not None:
-            out_ts = _conv_out(ts, self.kernel_size[0], self.stride[0], self.padding[0], self.convolution_mode)
+            out_ts = _conv_out(ts, self.kernel_size[0], self.stride[0], self.padding[0],
+                               self.convolution_mode, self.dilation[0])
         return InputType.recurrent(self.n_out, out_ts)
 
     def init_params(self, rng, input_type, dtype=jnp.float32):
@@ -466,6 +470,7 @@ class Convolution1DLayer(BaseConvLayer):
         pad = "SAME" if self.convolution_mode == "same" else [(self.padding[0], self.padding[0])]
         y = lax.conv_general_dilated(
             x, params["W"], window_strides=(self.stride[0],), padding=pad,
+            rhs_dilation=(self.dilation[0],),
             dimension_numbers=("NWC", "WIO", "NWC"),
         )
         if self.has_bias:

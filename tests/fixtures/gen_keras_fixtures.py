@@ -167,6 +167,116 @@ def gen_json_weights_pair():
     print("jw pair written")
 
 
+def gen_legacy_layers():
+    """Fixtures for the legacy/contrib layer mappers (VERDICT r3 item 5:
+    KerasLRN, KerasSpaceToDepth, KerasAtrousConvolution1D/2D). Keras 3
+    has no built-in LRN/SpaceToDepth/Atrous* classes, so tiny custom
+    layers with the LEGACY class names implement the reference semantics
+    (tf.nn ops); the saved configs then carry exactly the class names +
+    keys the old model files have, and the import path is exercised end
+    to end against real TF-computed goldens."""
+    import numpy as np
+    import keras
+    import tensorflow as tf
+    from keras import layers
+
+    @keras.saving.register_keras_serializable(package="legacy")
+    class LRN(keras.layers.Layer):
+        def __init__(self, alpha=1e-4, beta=0.75, k=2.0, n=5, **kw):
+            super().__init__(**kw)
+            self.alpha, self.beta, self.k, self.n = alpha, beta, k, int(n)
+
+        def call(self, x):
+            return tf.nn.local_response_normalization(
+                x, depth_radius=self.n // 2, bias=self.k,
+                alpha=self.alpha, beta=self.beta)
+
+        def get_config(self):
+            return {**super().get_config(), "alpha": self.alpha,
+                    "beta": self.beta, "k": self.k, "n": self.n}
+
+    @keras.saving.register_keras_serializable(package="legacy")
+    class SpaceToDepth(keras.layers.Layer):
+        def __init__(self, block_size=2, **kw):
+            super().__init__(**kw)
+            self.block_size = block_size
+
+        def call(self, x):
+            return tf.nn.space_to_depth(x, self.block_size)
+
+        def get_config(self):
+            return {**super().get_config(), "block_size": self.block_size}
+
+    @keras.saving.register_keras_serializable(package="legacy")
+    class AtrousConvolution2D(layers.Conv2D):
+        pass
+
+    @keras.saving.register_keras_serializable(package="legacy")
+    class AtrousConvolution1D(layers.Conv1D):
+        pass
+
+    rng = np.random.default_rng(99)
+    keras.utils.set_random_seed(21)
+
+    def save(name, model, x):
+        path = os.path.join(OUT, f"{name}.h5")
+        model.save(path)
+        y = model.predict(x, verbose=0)
+        np.savez(os.path.join(OUT, f"{name}_golden.npz"), x=x, y=y)
+        print(f"{name}: {path} ({os.path.getsize(path)//1024} KB), out {y.shape}")
+
+    # AlexNet-flavored: conv → LRN → pool → dense
+    m = keras.Sequential([
+        keras.Input((12, 12, 3)),
+        layers.Conv2D(8, 3, activation="relu", padding="same"),
+        LRN(alpha=1e-3, beta=0.75, k=1.0, n=5),
+        layers.MaxPooling2D(2),
+        layers.Flatten(),
+        layers.Dense(4, activation="softmax"),
+    ])
+    save("lrn", m, rng.standard_normal((4, 12, 12, 3)).astype(np.float32))
+
+    # YOLO2-flavored reorg: conv → space-to-depth → 1x1 conv → head (the
+    # flatten+dense head makes channel-ORDER errors in the reorg visible
+    # in the golden while keeping the model loss-inferable)
+    m = keras.Sequential([
+        keras.Input((8, 8, 3)),
+        layers.Conv2D(4, 3, padding="same", activation="relu"),
+        SpaceToDepth(block_size=2),
+        layers.Conv2D(6, 1, padding="same"),
+        layers.Flatten(),
+        layers.Dense(5, activation="softmax"),
+    ])
+    save("space_to_depth", m, rng.standard_normal((3, 8, 8, 3)).astype(np.float32))
+
+    # dilated convs under the legacy Keras-1 class names
+    m = keras.Sequential([
+        keras.Input((14, 14, 3)),
+        AtrousConvolution2D(6, 3, dilation_rate=2, padding="same",
+                            activation="relu"),
+        AtrousConvolution2D(4, 3, dilation_rate=2, padding="valid"),
+        layers.GlobalAveragePooling2D(),
+        layers.Dense(3, activation="softmax"),
+    ])
+    save("atrous2d", m, rng.standard_normal((4, 14, 14, 3)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.Input((16, 5)),
+        AtrousConvolution1D(7, 3, dilation_rate=2, padding="same",
+                            activation="tanh"),
+        AtrousConvolution1D(4, 3, dilation_rate=3, padding="valid"),
+        layers.GlobalMaxPooling1D(),
+        layers.Dense(2, activation="softmax"),
+    ])
+    save("atrous1d", m, rng.standard_normal((4, 16, 5)).astype(np.float32))
+
+
 if __name__ == "__main__":
-    main()
-    gen_json_weights_pair()
+    import sys as _sys
+
+    if "--legacy-only" in _sys.argv:
+        gen_legacy_layers()
+    else:
+        main()
+        gen_json_weights_pair()
+        gen_legacy_layers()

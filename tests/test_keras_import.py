@@ -18,7 +18,10 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "keras")
 
-SEQUENTIAL = ["mlp", "cnn", "lstm", "mobilenet_mini", "text_bilstm"]
+SEQUENTIAL = ["mlp", "cnn", "lstm", "mobilenet_mini", "text_bilstm",
+              # legacy/contrib layer mappers (VERDICT r3 item 5):
+              # KerasLRN, KerasSpaceToDepth, KerasAtrousConvolution1D/2D
+              "lrn", "space_to_depth", "atrous2d", "atrous1d"]
 FUNCTIONAL = ["functional", "inception_mini"]
 
 
@@ -90,6 +93,38 @@ def test_missing_mapper_error_is_informative():
 
     with pytest.raises(UnsupportedKerasLayer, match="No mapper"):
         map_keras_layer("LocallyConnected2D", {})
+
+
+def test_keras1_atrous_config_keys():
+    """Keras-1 config vocabulary (nb_filter/nb_row/nb_col/subsample/
+    atrous_rate/border_mode) maps onto the same layers the Keras-2 keys
+    do (reference KerasAtrousConvolution1D/2D.java parse keras-1 files)."""
+    from deeplearning4j_tpu.modelimport.keras.mappers import map_keras_layer
+
+    m2 = map_keras_layer("AtrousConvolution2D", {
+        "nb_filter": 6, "nb_row": 3, "nb_col": 5, "subsample": [2, 1],
+        "atrous_rate": [2, 2], "border_mode": "valid",
+    })
+    l2 = m2.layer
+    assert l2.n_out == 6 and l2.kernel_size == [3, 5]
+    assert l2.stride == [2, 1] and l2.dilation == [2, 2]
+    assert l2.convolution_mode == "truncate"
+
+    m1 = map_keras_layer("AtrousConvolution1D", {
+        "nb_filter": 4, "filter_length": 3, "subsample_length": 1,
+        "atrous_rate": 2, "border_mode": "same",
+    })
+    l1 = m1.layer
+    assert l1.n_out == 4 and l1.kernel_size == [3]
+    assert l1.dilation == [2] and l1.convolution_mode == "same"
+
+
+def test_lrn_mapper_defaults():
+    """KerasLRN.java defaults: k=2, n=5, alpha=1e-4, beta=0.75."""
+    from deeplearning4j_tpu.modelimport.keras.mappers import map_keras_layer
+
+    layer = map_keras_layer("LRN2D", {}).layer
+    assert (layer.k, layer.n, layer.alpha, layer.beta) == (2.0, 5.0, 1e-4, 0.75)
 
 
 # --------------------------------------------------------------------------
