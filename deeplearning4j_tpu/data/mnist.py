@@ -158,12 +158,17 @@ class MnistDataSetIterator(DataSetIterator):
                  shuffle: bool = True, seed: int = 123):
         imgs, labels, synth = load_mnist(train, num_examples, seed)
         self.is_synthetic = synth
+        self._finish_init(batch_size, imgs, labels, 10, binarize, shuffle,
+                          seed)
+
+    def _finish_init(self, batch_size, imgs, labels, n_classes, binarize,
+                     shuffle, seed):
         if binarize:
             imgs = (imgs > 0.5).astype(np.float32)
         if shuffle:
             idx = np.random.default_rng(seed).permutation(len(imgs))
             imgs, labels = imgs[idx], labels[idx]
-        onehot = np.eye(10, dtype=np.float32)[labels]
+        onehot = np.eye(n_classes, dtype=np.float32)[labels]
         self._ds = DataSet(imgs, onehot)
         self._batch = int(batch_size)
         self._pos = 0
@@ -184,16 +189,97 @@ class MnistDataSetIterator(DataSetIterator):
         return self._batch
 
 
-class EmnistDataSetIterator(MnistDataSetIterator):
-    """EMNIST digits subset (reference ``EmnistDataSetIterator``); other
-    EMNIST splits require real data files in the cache dir."""
+# split name (reference ``EmnistDataSetIterator.Set`` enum, incl. the
+# byclass/bymerge aliases) → (file stem, class count). File naming follows
+# the official EMNIST distribution the reference's EmnistFetcher downloads:
+# emnist-<stem>-<train|test>-<images|labels>-idx?-ubyte[.gz].
+EMNIST_SPLITS = {
+    "complete": ("byclass", 62), "byclass": ("byclass", 62),
+    "merge": ("bymerge", 47), "bymerge": ("bymerge", 47),
+    "balanced": ("balanced", 47),
+    "letters": ("letters", 26),
+    "digits": ("digits", 10),
+    "mnist": ("mnist", 10),
+}
 
-    def __init__(self, batch_size: int, split: str = "digits", train: bool = True, **kw):
-        if split != "digits":
-            raise NotImplementedError(
-                f"EMNIST split '{split}' needs real EMNIST files in {CACHE_DIR}/mnist"
-            )
-        super().__init__(batch_size, train=train, **kw)
+
+def _find_emnist_files(stem: str, split: str) -> Optional[Tuple[str, str]]:
+    base = os.path.join(CACHE_DIR, "emnist")
+    names = (f"emnist-{stem}-{split}-images-idx3-ubyte",
+             f"emnist-{stem}-{split}-labels-idx1-ubyte")
+    for ext in ("", ".gz"):
+        img, lab = (os.path.join(base, n + ext) for n in names)
+        if os.path.exists(img) and os.path.exists(lab):
+            return img, lab
+    return None
+
+
+def load_emnist(split: str = "digits", train: bool = True,
+                num_examples: Optional[int] = None, seed: int = 123):
+    """(images (n,28,28,1) float32 [0,1], int labels (n,), n_classes,
+    synthetic_flag). Images keep the official files' on-disk orientation
+    (the reference reads the raw IDX bytes the same way). The letters
+    split's 1-based raw labels are shifted to 0-based."""
+    key = split.lower()
+    if key not in EMNIST_SPLITS:
+        raise ValueError(
+            f"Unknown EMNIST split '{split}' (one of {sorted(EMNIST_SPLITS)})")
+    stem, n_classes = EMNIST_SPLITS[key]
+    found = _find_emnist_files(stem, "train" if train else "test")
+    if found is not None:
+        imgs = _read_idx(found[0]).astype(np.float32) / 255.0
+        labels = _read_idx(found[1]).astype(np.int64)
+        if key == "letters":
+            labels = labels - 1
+        if labels.min() < 0 or labels.max() >= n_classes:
+            raise ValueError(
+                f"EMNIST {split}: labels outside [0, {n_classes}) in {found[1]}")
+        imgs = imgs[..., None]
+        if num_examples:
+            imgs, labels = imgs[:num_examples], labels[:num_examples]
+        return imgs, labels, n_classes, False
+    if key in ("digits", "mnist"):
+        # 10-class fallback rides the MNIST resolution chain (cached IDX
+        # files, else synthetic stroke digits)
+        imgs, labels, synth = load_mnist(train, num_examples, seed)
+        return imgs, labels, n_classes, synth
+    raise FileNotFoundError(
+        f"EMNIST split '{split}' needs emnist-{stem}-* IDX files in "
+        f"{os.path.join(CACHE_DIR, 'emnist')} (no synthetic fallback for "
+        "non-digit splits; no network egress in this environment)")
+
+
+class EmnistDataSetIterator(MnistDataSetIterator):
+    """All EMNIST splits (reference ``EmnistDataSetIterator`` with its
+    ``Set`` enum: COMPLETE/MERGE/BALANCED/LETTERS/DIGITS/MNIST). Non-digit
+    splits load the official IDX files from the cache dir; the digit
+    splits fall back to the synthetic generator offline."""
+
+    def __init__(self, batch_size: int, split: str = "digits",
+                 train: bool = True, num_examples: Optional[int] = None,
+                 binarize: bool = False, shuffle: bool = True,
+                 seed: int = 123):
+        imgs, labels, n_classes, synth = load_emnist(
+            split, train, num_examples, seed)
+        self.split = split.lower()
+        self.num_classes = n_classes
+        self.is_synthetic = synth
+        self._finish_init(batch_size, imgs, labels, n_classes, binarize,
+                          shuffle, seed)
+
+    @staticmethod
+    def num_labels(split: str) -> int:
+        """(reference ``EmnistDataSetIterator.numLabels``)"""
+        return EMNIST_SPLITS[split.lower()][1]
+
+    @staticmethod
+    def is_balanced(split: str) -> bool:
+        """Splits with equal examples per class (reference
+        ``EmnistDataSetIterator.isBalanced``)."""
+        return split.lower() in ("balanced", "letters", "digits", "mnist")
+
+    numLabels = num_labels
+    isBalanced = is_balanced
 
 
 # ---------------------------------------------------------------------------
