@@ -53,18 +53,63 @@ class ZooModel:
             ) from e
         return ComputationGraph(conf).init()
 
+    #: per-dataset sha256 hex digests; subclasses (or callers staging
+    #: weights into the cache) fill this so ``init_pretrained`` verifies
+    #: integrity like the reference's checksum gate (``ZooModel.java:40-62``)
+    pretrained_checksums: dict = {}
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        # each model class gets its OWN registry: writing
+        # LeNet.pretrained_checksums[...] must never leak a digest into
+        # ResNet50's lookups through the shared base-class dict
+        if "pretrained_checksums" not in cls.__dict__:
+            cls.pretrained_checksums = dict(cls.pretrained_checksums)
+
     def pretrained_path(self, dataset: str = "imagenet") -> str:
         return os.path.join(CACHE_DIR, "zoo", f"{self.name}_{dataset}.zip")
 
-    def init_pretrained(self, dataset: str = "imagenet"):
-        """Load pretrained weights from the local cache (reference
-        ``initPretrained``; download is impossible without egress)."""
-        path = self.pretrained_path(dataset)
+    @staticmethod
+    def _sha256(path: str) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def init_pretrained(self, dataset: str = "imagenet",
+                        path: Optional[str] = None,
+                        checksum: Optional[str] = None):
+        """Restore a pretrained checkpoint (reference ``initPretrained``
+        + its checksum verification, ``ZooModel.java:40-62``; the
+        download half is impossible without egress, so weights come from
+        ``path`` or the local cache dir).
+
+        The weight artifact is the reference zip checkpoint layout
+        (``ModelSerializer``: configuration.json + coefficients.bin [+
+        updaterState.bin]). ``checksum`` (sha256 hex) overrides the
+        per-class ``pretrained_checksums[dataset]`` entry; when either is
+        present the file hash MUST match — a corrupt/wrong artifact
+        raises instead of silently loading."""
+        path = path or self.pretrained_path(dataset)
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"No pretrained weights at {path}. This environment has no "
                 "network egress; place a checkpoint there manually."
             )
+        expect = checksum or self.pretrained_checksums.get(dataset)
+        if expect:
+            actual = self._sha256(path)
+            if actual != expect.lower():
+                raise ValueError(
+                    f"Checksum mismatch for {path}: expected {expect}, "
+                    f"got {actual} — refusing to load a corrupt/substituted "
+                    "pretrained artifact (reference ZooModel deletes and "
+                    "re-downloads; offline, re-stage the file)")
         from deeplearning4j_tpu.train.model_serializer import ModelGuesser
 
         return ModelGuesser.load_model_guess(path)
+
+    initPretrained = init_pretrained

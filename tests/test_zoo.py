@@ -7,6 +7,8 @@ architecture permits; full-size construction is covered by a conf() build
 check (shape inference walks the whole graph).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -186,3 +188,60 @@ class TestZooSmallInstantiation:
         # stateful stepping
         step = net.rnn_time_step(seq[:, 0, :])
         assert step.shape == (2, V)
+
+
+class TestPretrainedRoundTrip:
+    """ZooModel.init_pretrained with checksum verification against the
+    committed weight artifact (VERDICT r3 item 7; reference
+    ``ZooModel.java:40-62`` download+checksum — the offline half)."""
+
+    FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "zoo",
+                           "lenet_synthmnist.zip")
+    GOLDEN = os.path.join(os.path.dirname(__file__), "fixtures", "zoo",
+                          "lenet_synthmnist_golden.npz")
+    SHA256 = "8d16369d4cc18397794baad462ed3689f1b60eaf7be7377fae1c1a143a0784c5"
+
+    def test_loads_fixture_and_reproduces_golden(self):
+        from deeplearning4j_tpu.models.lenet import LeNet
+
+        net = LeNet(num_classes=10).init_pretrained(
+            path=self.FIXTURE, checksum=self.SHA256)
+        d = np.load(self.GOLDEN)
+        np.testing.assert_allclose(np.asarray(net.output(d["x"])), d["y"],
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_checksum_mismatch_refuses_to_load(self):
+        from deeplearning4j_tpu.models.lenet import LeNet
+
+        with pytest.raises(ValueError, match="Checksum mismatch"):
+            LeNet(num_classes=10).init_pretrained(
+                path=self.FIXTURE, checksum="0" * 64)
+
+    def test_class_level_checksum_registry(self, monkeypatch):
+        from deeplearning4j_tpu.models.lenet import LeNet
+
+        monkeypatch.setattr(LeNet, "pretrained_checksums",
+                            {"synthmnist": self.SHA256})
+        net = LeNet(num_classes=10).init_pretrained(
+            dataset="synthmnist", path=self.FIXTURE)
+        assert net.num_params() > 0
+
+    def test_missing_file_error_names_path(self):
+        from deeplearning4j_tpu.models.lenet import LeNet
+
+        with pytest.raises(FileNotFoundError, match="zoo"):
+            LeNet(num_classes=10).init_pretrained(dataset="nope")
+
+    def test_checksum_registry_is_per_class(self):
+        """Writing one model's digest must not leak into another class's
+        lookups through a shared base-class dict."""
+        from deeplearning4j_tpu.models.lenet import LeNet
+        from deeplearning4j_tpu.models.resnet50 import ResNet50
+        from deeplearning4j_tpu.models.zoo import ZooModel
+
+        try:
+            LeNet.pretrained_checksums["imagenet"] = "f" * 64
+            assert "imagenet" not in ResNet50.pretrained_checksums
+            assert "imagenet" not in ZooModel.pretrained_checksums
+        finally:
+            LeNet.pretrained_checksums.pop("imagenet", None)
