@@ -1,0 +1,102 @@
+"""Multi-process embedding training (the capability of the reference's
+``dl4j-spark-nlp`` module without the Spark substrate:
+``spark/models/embeddings/word2vec/Word2VecPerformer.java:1`` trains
+word2vec over RDD partitions, ``spark/text/functions/TextPipeline.java:1``
+builds the shared vocabulary once and broadcasts it).
+
+TPU-native shape of the same idea:
+- the VOCABULARY is built identically on every process from the full
+  corpus (deterministic VocabConstructor == the broadcast),
+- each process trains the jitted device kernels on ITS SHARD of the
+  sentence stream,
+- at every epoch boundary the three weight matrices are parameter-averaged
+  across processes over the jax.distributed global mesh
+  (``multihost_utils.process_allgather`` → mean), the same
+  synchronization the Spark module reaches through accumulators.
+
+Requires ``jax.distributed`` to be initialized
+(``parallel.multihost.initialize``) when ``num_processes > 1``; degrades
+to plain local training on a single process so the same script runs in
+both modes.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+
+def shard_sequences(seqs: Sequence, num_shards: int, shard_index: int) -> List:
+    """Deterministic round-robin split of the sentence stream (the RDD
+    partitioning role). Every process must pass the SAME full list."""
+    return [s for i, s in enumerate(seqs) if i % num_shards == shard_index]
+
+
+class DistributedSequenceVectors:
+    """Parameter-averaging wrapper around any :class:`SequenceVectors`
+    (Word2Vec / ParagraphVectors / DeepWalk all ride it, as their Spark
+    counterparts ride Word2VecPerformer).
+
+    ``averaging_frequency`` counts epochs between synchronizations
+    (reference ParameterAveragingTrainingMaster knob; 1 = every epoch).
+    """
+
+    def __init__(self, vectors: SequenceVectors,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 averaging_frequency: int = 1):
+        self.vectors = vectors
+        self.num_processes = (jax.process_count() if num_processes is None
+                              else int(num_processes))
+        self.process_id = (jax.process_index() if process_id is None
+                           else int(process_id))
+        self.averaging_frequency = max(int(averaging_frequency), 1)
+        self.sync_count = 0
+
+    # -------------------------------------------------------------- averaging
+    def _mean_over_processes(self, x: jnp.ndarray) -> jnp.ndarray:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(x))
+        return jnp.asarray(np.mean(gathered, axis=0, dtype=np.float32))
+
+    def synchronize(self) -> None:
+        """Average syn0/syn1/syn1neg across all processes (every replica
+        ends bit-identical — the mean is computed from the same gathered
+        operands everywhere)."""
+        if self.num_processes <= 1:
+            return
+        v = self.vectors
+        v.syn0 = self._mean_over_processes(v.syn0)
+        if v.use_hs:
+            v.syn1 = self._mean_over_processes(v.syn1)
+        if v.negative > 0:
+            v.syn1neg = self._mean_over_processes(v.syn1neg)
+        self.sync_count += 1
+
+    # -------------------------------------------------------------------- fit
+    def fit_sequences(self, all_sequences: Iterable[np.ndarray]
+                      ) -> "DistributedSequenceVectors":
+        """``all_sequences`` is the FULL corpus (identical on every
+        process — matching TextPipeline's driver-side corpus); sharding
+        happens here so all replicas agree on the split."""
+        seqs = [np.asarray(s, np.int32) for s in all_sequences]
+        local = shard_sequences(seqs, self.num_processes, self.process_id)
+        synced_at = [-1]
+
+        def on_epoch_end(_sv, epoch):
+            if (epoch + 1) % self.averaging_frequency == 0:
+                self.synchronize()
+                synced_at[0] = epoch
+
+        self.vectors.fit_sequences(local, on_epoch_end=on_epoch_end)
+        if synced_at[0] != self.vectors.epochs - 1:
+            # the run must END synchronized even when epochs isn't a
+            # multiple of averaging_frequency — replicas always agree
+            self.synchronize()
+        return self

@@ -149,15 +149,21 @@ class SequenceVectors:
 
     # ------------------------------------------------------------------- fit
     def fit_sequences(self, sequences: Iterable[np.ndarray],
-                      total_words_hint: Optional[int] = None) -> "SequenceVectors":
+                      total_words_hint: Optional[int] = None,
+                      on_epoch_end: Optional[Callable[["SequenceVectors", int],
+                                                      None]] = None,
+                      ) -> "SequenceVectors":
         """Train on an iterable of index arrays; re-iterated
-        ``epochs × iterations`` times (reference fit loop semantics)."""
+        ``epochs × iterations`` times (reference fit loop semantics).
+        ``on_epoch_end(self, epoch)`` fires after each epoch — the
+        distributed trainer synchronizes replicas there
+        (nlp/distributed.py)."""
         seqs = [np.asarray(s, np.int32) for s in sequences]
         total = total_words_hint or sum(len(s) for s in seqs)
         total_span = max(total * self.epochs * self.iterations, 1)
         processed = 0
         B = self.batch_size
-        for _ in range(self.epochs):
+        for epoch in range(self.epochs):
             for _ in range(self.iterations):
                 self._pass_losses = []
                 # buffers accumulate across sentences so every device step
@@ -203,6 +209,8 @@ class SequenceVectors:
                         self._run_cbow_padded(xx, np.concatenate(buf_m), cc, lr)
                 if self._pass_losses:
                     self.epoch_losses.append(float(np.mean(self._pass_losses)))
+            if on_epoch_end is not None:
+                on_epoch_end(self, epoch)
         return self
 
     def _lr(self, processed: int, total: int) -> float:

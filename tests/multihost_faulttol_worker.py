@@ -1,0 +1,97 @@
+"""Fault-injection worker (VERDICT r3 item 8: kill-one-process-then-
+resume-from-checkpoint — a recovery test the reference does not have,
+SURVEY §4.5). Three phases, selected by argv[5]:
+
+  full    uninterrupted reference: epoch 1 + checkpoint + epoch 2,
+          dump final params
+  crash   epoch 1 + checkpoint, then epoch 2 with slowed batches; the
+          PARENT SIGKILLs worker 1 mid-epoch — worker 0 must then die
+          too (collective peer loss), never reaching the final dump
+  resume  fresh pair restores the crash phase's checkpoint and runs
+          epoch 2; final params must equal the `full` run's bit-for-bit
+
+Usage: ... <coordinator> <nprocs> <pid> <outdir> <phase>
+"""
+
+import os
+import sys
+import time
+
+coordinator, nprocs, pid, outdir, phase = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.data.iterators import DataSetIterator  # noqa: E402
+from deeplearning4j_tpu.parallel.multihost import (  # noqa: E402
+    MultiHostNetwork,
+    ParameterAveragingTrainingMaster,
+    ShardedDataSetIterator,
+    initialize,
+)
+from tests.multihost_model import build_net, global_batches  # noqa: E402
+
+
+class SlowIterator(DataSetIterator):
+    """Per-batch sleep gives the parent a guaranteed kill window while
+    collectives are in flight."""
+
+    def __init__(self, base, delay_s: float):
+        self.base = base
+        self.delay_s = delay_s
+
+    def has_next(self):
+        return self.base.has_next()
+
+    def next(self):
+        time.sleep(self.delay_s)
+        return self.base.next()
+
+    def reset(self):
+        self.base.reset()
+
+    def batch(self):
+        return self.base.batch()
+
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+net = build_net()
+facade = MultiHostNetwork(net, ParameterAveragingTrainingMaster(), ctx)
+ckpt = os.path.join(outdir, "ft_ckpt.zip")
+
+if phase in ("full", "crash"):
+    it = ShardedDataSetIterator(global_batches(), nprocs, pid)
+    facade.fit(it, epochs=1)
+    facade.save_checkpoint(ckpt)
+    with open(os.path.join(outdir, f"saved_{pid}"), "w") as f:
+        f.write("1")
+    it.reset()
+    if phase == "crash":
+        # announce epoch 2 and slow it down so the SIGKILL lands mid-epoch
+        with open(os.path.join(outdir, f"epoch2_{pid}"), "w") as f:
+            f.write("1")
+        it = SlowIterator(it, 0.5)
+    facade.fit(it, epochs=1)
+    np.savez(os.path.join(outdir, f"final_{phase}_{pid}.npz"),
+             params=net.params_flat(), iteration=net.iteration)
+elif phase == "resume":
+    facade.restore_checkpoint(ckpt)
+    assert net.iteration > 0  # state really came from the checkpoint
+    it = ShardedDataSetIterator(global_batches(), nprocs, pid)
+    facade.fit(it, epochs=1)
+    np.savez(os.path.join(outdir, f"final_{phase}_{pid}.npz"),
+             params=net.params_flat(), iteration=net.iteration)
+else:
+    raise SystemExit(f"unknown phase {phase}")
+
+print(f"faulttol worker {pid} phase={phase}: done", flush=True)

@@ -1,0 +1,54 @@
+"""Worker for the distributed-embedding parity test (VERDICT r3 item 6;
+capability match for the reference's dl4j-spark-nlp
+``Word2VecPerformer.java``): each process builds the SAME vocabulary from
+the full corpus (TextPipeline role), trains skip-gram on its sentence
+shard, parameter-averages at epoch boundaries, and dumps the final
+embedding matrix for the parent to compare against single-process
+training.
+
+Usage: python multihost_seqvec_worker.py <coordinator> <nprocs> <pid> <outdir>
+"""
+
+import os
+import sys
+
+coordinator, nprocs, pid, outdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel.multihost import initialize  # noqa: E402
+from deeplearning4j_tpu.nlp.distributed import (  # noqa: E402
+    DistributedSequenceVectors,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors  # noqa: E402
+from tests.seqvec_corpus import build_corpus_and_vocab  # noqa: E402
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs
+
+vocab, seqs = build_corpus_and_vocab()
+sv = SequenceVectors(vocab, layer_size=24, window=3, negative=5,
+                     learning_rate=0.05, epochs=8, batch_size=256, seed=7)
+dist = DistributedSequenceVectors(sv)
+dist.fit_sequences(seqs)
+
+assert dist.sync_count >= 8, dist.sync_count
+if pid == 0:
+    np.savez(os.path.join(outdir, "seqvec_dist.npz"),
+             syn0=sv.get_word_vector_matrix(),
+             sync_count=dist.sync_count)
+else:
+    np.savez(os.path.join(outdir, f"seqvec_dist_{pid}.npz"),
+             syn0=sv.get_word_vector_matrix())
+print(f"seqvec worker {pid}: done, syncs={dist.sync_count}", flush=True)
