@@ -66,6 +66,9 @@ from deeplearning4j_tpu.nn.conf.layers.objdetect import (
     Yolo2OutputLayer,
     non_max_suppression,
 )
+from deeplearning4j_tpu.nn.conf.layers.fused_block import (
+    FusedResNetBottleneck,
+)
 from deeplearning4j_tpu.nn.conf.layers.special import (
     CenterLossOutputLayer,
     FrozenLayer,
@@ -101,6 +104,7 @@ __all__ = [
     "SelfAttentionLayer", "TransformerBlock", "LayerNormalization",
     "PositionalEmbeddingLayer",
     "MixtureOfExpertsLayer", "MoETransformerBlock",
+    "FusedResNetBottleneck",
 ]
 
 from deeplearning4j_tpu.nn.conf.dropouts import (  # noqa: E402

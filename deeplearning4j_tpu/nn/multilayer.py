@@ -67,8 +67,10 @@ def _cast_layer_params_for_compute(layer, p, cd, *, is_output: bool):
 
     if isinstance(layer, (BatchNormalization, LocalResponseNormalization)) or is_output:
         return p
+    keep = getattr(layer, "keep_fp32_params", ())
     return {
-        k: v.astype(cd) if jnp.issubdtype(v.dtype, jnp.floating) else v
+        k: v.astype(cd)
+        if jnp.issubdtype(v.dtype, jnp.floating) and k not in keep else v
         for k, v in p.items()
     }
 
