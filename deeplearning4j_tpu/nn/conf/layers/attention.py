@@ -214,6 +214,39 @@ def _flash_attention_route(q, k, causal, mask, dropout_rate):
     return _flash_attention_impl(q.dtype, T, q.shape[-1], causal)
 
 
+BLOCKED_ATTENTION_MIN_T = 1024
+
+
+def _blocked_attention(q, k, v, *, causal: bool, mask, scale: float,
+                       block_q: int):
+    """Dense attention evaluated one query block at a time under
+    ``lax.scan`` with a rematerialized body: peak live scores are
+    (b, h, block_q, T) instead of (b, h, T, T), and the backward pass
+    recomputes each block's scores rather than storing them (the
+    flash-attention memory shape without Pallas — the XLA fallback for
+    T >= BLOCKED_ATTENTION_MIN_T when the kernel can't compile on the
+    serving toolchain; VERDICT r3 item 4)."""
+    b, h, T, hd = q.shape
+    nb = T // block_q
+    qb = q.reshape(b, h, nb, block_q, hd).transpose(2, 0, 1, 3, 4)
+    kpos = jnp.arange(T)
+
+    @jax.checkpoint
+    def body(_, blk):
+        i, qblk = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qblk, k) * scale
+        if causal:
+            qpos = i * block_q + jnp.arange(block_q)
+            s = jnp.where(kpos[None, :] <= qpos[:, None], s, -1e30)
+        if mask is not None:
+            s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        return None, jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    _, out = jax.lax.scan(body, None, (jnp.arange(nb), qb))
+    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, T, hd)
+
+
 def dense_attention(q, k, v, *, causal: bool, mask=None,
                     dropout_rate: float = 0.0, dropout_rng=None):
     """Reference dense softmax attention. q,k,v: (b, h, T, hd).
@@ -224,13 +257,21 @@ def dense_attention(q, k, v, *, causal: bool, mask=None,
     On TPU with long block-aligned sequences the computation routes to
     the Pallas flash-attention kernel (O(T) memory, no (T, T) scores
     materialization) — same math, the SURVEY §7 "Pallas for the hot ops"
-    path.
+    path. When the kernel is unavailable (toolchain probe) and the
+    sequence is long, a scan-blocked formulation bounds the live score
+    memory instead.
     """
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
     flash_impl = _flash_attention_route(q, k, causal, mask, dropout_rate)
     if flash_impl is not None:
         return flash_impl(q, k, v, scale)
+    if (T >= BLOCKED_ATTENTION_MIN_T and dropout_rate == 0.0
+            and k.shape[2] == T):
+        for bq in (512, 256, 128):
+            if T % bq == 0:
+                return _blocked_attention(q, k, v, causal=causal, mask=mask,
+                                          scale=scale, block_q=bq)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tri = jnp.tril(jnp.ones((T, T), bool))

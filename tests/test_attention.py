@@ -2,6 +2,8 @@
 SURVEY.md §5 long-context mandate). Ring attention is validated against
 dense attention on the 8-device CPU mesh."""
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -47,6 +49,67 @@ class TestDenseAttention:
                                causal=False)
         np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestBlockedAttention:
+    """Long-sequence XLA fallback (VERDICT r3 item 4): the scan-blocked
+    formulation must equal the materialized dense computation exactly —
+    values AND gradients — for causal and key-masked variants."""
+
+    def _qkv(self, T=1024, hd=8):
+        rng = np.random.default_rng(3)
+        mk = lambda: jnp.asarray(rng.standard_normal((1, 2, T, hd)),
+                                 jnp.float32)
+        return mk(), mk(), mk()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_materialized_dense(self, causal):
+        from deeplearning4j_tpu.nn.conf.layers.attention import (
+            _blocked_attention,
+        )
+
+        q, k, v = self._qkv()
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+        def dense(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            if causal:
+                tri = jnp.tril(jnp.ones((q.shape[2],) * 2, bool))
+                s = jnp.where(tri, s, -1e30)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+        def blocked(q, k, v):
+            return _blocked_attention(q, k, v, causal=causal, mask=None,
+                                      scale=scale, block_q=256)
+
+        np.testing.assert_allclose(np.asarray(blocked(q, k, v)),
+                                   np.asarray(dense(q, k, v)),
+                                   rtol=2e-5, atol=2e-5)
+        loss = lambda f: lambda q, k, v: jnp.sum(f(q, k, v) ** 2)
+        gb = jax.grad(loss(blocked), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss(dense), argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gb, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4,
+                                       err_msg=f"d{name} diverged")
+
+    def test_key_mask_and_routing(self):
+        from deeplearning4j_tpu.nn.conf.layers import attention as att
+
+        q, k, v = self._qkv()
+        mask = jnp.asarray(
+            (np.arange(1024) < 700).astype(np.float32))[None, :]
+        got = att._blocked_attention(q, k, v, causal=False, mask=mask,
+                                     scale=q.shape[-1] ** -0.5, block_q=512)
+        want = att.dense_attention(q[:, :, :, :], k[:, :, :700, :],
+                                   v[:, :, :700, :], causal=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        # dense_attention routes T>=1024 through the blocked path (no
+        # (T,T) materialization); same numbers either way
+        via_router = att.dense_attention(q, k, v, causal=False, mask=mask)
+        np.testing.assert_allclose(np.asarray(via_router), np.asarray(got),
+                                   rtol=1e-6, atol=1e-6)
 
 
 class TestSelfAttentionLayer:
