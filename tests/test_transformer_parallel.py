@@ -75,6 +75,45 @@ class TestDistributedParity:
         assert last < first * 0.5, f"distributed training stalled: {first}->{last}"
 
 
+class TestAwkwardShapes:
+    """VERDICT r3 item 8: realistic-ish sharding shapes beyond the
+    toy powers of two — TP with head dims nowhere near a multiple of
+    128, and a deeper pipeline with n_micro=8."""
+
+    @pytest.mark.slow
+    def test_tp2_non_multiple_of_128_head_dim(self):
+        """d_model=40, 2 heads → head_dim=20; per-TP-shard 1 head of 20.
+        The sharding arithmetic must not assume MXU-friendly multiples —
+        parity vs single device is the proof."""
+        def model():
+            return TransformerLM(vocab_size=V, d_model=40, n_heads=2,
+                                 n_layers=2, max_length=T).init()
+
+        ids, tgt = _data()
+        ref = model()
+        ref_losses = [ref.fit_batch(ids, tgt) for _ in range(3)]
+        tr = DistributedLMTrainer(model(), TrainingMesh(data=2, model=2,
+                                  devices=jax.devices()[:4])).place()
+        losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=1e-4)
+
+    @pytest.mark.slow
+    def test_pp2_n_micro8_parity_and_bubble_fraction(self):
+        """GPipe with 8 microbatches: parity holds and the schedule
+        reports its idle fraction (pp-1)/(n_micro+pp-1)."""
+        ids, tgt = _data()
+        ref = _model()
+        ref_losses = [ref.fit_batch(ids, tgt) for _ in range(3)]
+        tr = DistributedLMTrainer(_model(), TrainingMesh(data=2, pipe=4),
+                                  n_micro=8).place()
+        assert abs(tr.bubble_fraction - 3 / 11) < 1e-9
+        losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=1e-4)
+        # no pipelining → no bubble
+        assert DistributedLMTrainer(
+            _model(), TrainingMesh(data=8)).bubble_fraction == 0.0
+
+
 class TestTransformerLMSingle:
     def test_generate_and_logits(self):
         m = _model()
