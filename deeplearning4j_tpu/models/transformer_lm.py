@@ -340,9 +340,13 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
 
 
 def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
-            attn_fn=None, pos_offset: int = 0, return_aux: bool = False):
+            attn_fn=None, pos_offset: int = 0, return_aux: bool = False,
+            cast_logits: bool = True):
     """ids (b, T) int32 → logits (b, T, V) [, total MoE aux loss].
-    Single-device path: blocks via lax.scan over the stacked layer axis."""
+    Single-device path: blocks via lax.scan over the stacked layer axis.
+    ``cast_logits=False`` keeps logits in the compute dtype — the loss
+    path's choice, so no full-vocab fp32 tensor is materialized (see
+    ``token_nll``)."""
     x = params["embed"][ids] + params["pos"][pos_offset:pos_offset + ids.shape[1]][None]
     cd = _cdtype(cfg)
     if cd is not None:
@@ -364,21 +368,39 @@ def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
         aux = jnp.zeros((), jnp.float32)
     x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
     head = params["head"].astype(cd) if cd is not None else params["head"]
-    logits = (x @ head).astype(jnp.float32)  # softmax/loss in fp32
+    logits = x @ head
+    if cast_logits:
+        logits = logits.astype(jnp.float32)  # inference APIs: fp32 logits
     if return_aux:
         return logits, aux
     return logits
 
 
+def token_nll(logits, targets):
+    """Per-token next-token NLL in the logsumexp - target-logit form:
+    ``nll = lse(logits) - logits[target]``. Unlike
+    ``log_softmax + gather``, no full-vocab log-prob tensor exists — the
+    fp32 cast feeds only reductions and a gather, which XLA fuses, so at
+    V=32k the loss head's HBM traffic drops by two full-vocab fp32
+    passes per step (the LM step's single largest activation).
+    logits (..., V) any float dtype; targets (...) int32, -1 = ignore.
+    Returns (mean_nll, valid_count)."""
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    tgt = jnp.maximum(targets, 0)
+    tgt_logit = jnp.take_along_axis(lf, tgt[..., None], axis=-1)[..., 0]
+    valid = (targets >= 0).astype(jnp.float32)
+    nll = (lse - tgt_logit) * valid
+    count = jnp.maximum(jnp.sum(valid), 1.0)
+    return jnp.sum(nll) / count, count
+
+
 def lm_loss(cfg: TransformerLMConfig, params, ids, targets, attn_fn=None):
     """Mean next-token cross-entropy (+ weighted MoE aux loss when MoE).
     targets (b, T) int32 (-1 = ignore)."""
-    logits, aux = forward(cfg, params, ids, attn_fn=attn_fn, return_aux=True)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    valid = (targets >= 0).astype(logits.dtype)
-    tgt = jnp.maximum(targets, 0)
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-    loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    logits, aux = forward(cfg, params, ids, attn_fn=attn_fn, return_aux=True,
+                          cast_logits=False)
+    loss, _ = token_nll(logits, targets)
     if cfg.n_experts > 0:
         loss = loss + cfg.aux_loss_weight * aux
     return loss

@@ -263,7 +263,11 @@ class DistributedLMTrainer:
         return blocks_fn
 
     def _loss_fn(self):
-        from deeplearning4j_tpu.models.transformer_lm import _cdtype, _ln
+        from deeplearning4j_tpu.models.transformer_lm import (
+            _cdtype,
+            _ln,
+            token_nll,
+        )
 
         cfg = self.cfg
         blocks_fn = self._blocks_fn()
@@ -279,12 +283,10 @@ class DistributedLMTrainer:
             x, aux = out if moe else (out, None)
             x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
             head = params["head"].astype(cd) if cd is not None else params["head"]
-            logits = (x @ head).astype(jnp.float32)
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            valid = (targets >= 0).astype(logits.dtype)
-            tgt = jnp.maximum(targets, 0)
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
-            l = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+            # compute-dtype logits into the lse - target-logit CE (no
+            # full-vocab fp32 log-prob tensor; see models.transformer_lm
+            # token_nll)
+            l, _ = token_nll(x @ head, targets)
             if moe:
                 l = l + cfg.aux_loss_weight * aux
             return l
