@@ -223,6 +223,63 @@ class TestFusedBottleneckBlock:
                 err_msg=f"block gradient {k} diverged")
 
 
+class TestFusedBlockPersistence:
+    def test_serde_round_trip(self):
+        """FusedResNetBottleneck survives the JSON config round trip
+        (the new layer must join the serialization-regression contract)."""
+        from deeplearning4j_tpu.nn.conf import serde
+        from deeplearning4j_tpu.nn.conf.layers import FusedResNetBottleneck
+
+        lay = FusedResNetBottleneck(width=8, stride=2, project=True,
+                                    decay=0.95, eps=2e-5)
+        back = serde.decode(serde.encode(lay))
+        assert isinstance(back, FusedResNetBottleneck)
+        assert (back.width, back.stride, back.project) == (8, 2, True)
+        assert (back.decay, back.eps) == (0.95, 2e-5)
+
+    def test_checkpoint_round_trip_fused_model(self, tmp_path):
+        """A fused ResNet saves/restores through ModelSerializer with
+        bit-equal outputs (zip layout flattens the block's param dict)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models.resnet50 import ResNet50
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        net = ResNet50(num_classes=3, height=64, width=64,
+                       fused_pallas=True).init()
+        x = RNG.standard_normal((2, 64, 64, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[RNG.integers(0, 3, 2)]
+        net.fit(DataSet(x, y), epochs=1)
+        path = str(tmp_path / "fused.zip")
+        ModelSerializer.write_model(net, path)
+        net2 = ModelSerializer.restore_computation_graph(path)
+        np.testing.assert_allclose(np.asarray(net.output_single(x)),
+                                   np.asarray(net2.output_single(x)),
+                                   atol=1e-6)
+
+    def test_mixed_precision_keeps_bn_affines_fp32(self):
+        """Under compute_dtype=bfloat16 the conv weights cast to bf16 but
+        the keep_fp32_params BN affines stay fp32 inside the compute
+        cast (matching the standalone BatchNormalization exclusion)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers import FusedResNetBottleneck
+        from deeplearning4j_tpu.nn.multilayer import (
+            _cast_layer_params_for_compute,
+        )
+
+        lay = FusedResNetBottleneck(width=4, project=True)
+        it = InputType.convolutional(8, 8, 16)
+        lay.initialize(it)
+        params = lay.init_params(jax.random.PRNGKey(0), it)
+        cast = _cast_layer_params_for_compute(lay, params, jnp.bfloat16,
+                                              is_output=False)
+        assert cast["W_a"].dtype == jnp.bfloat16
+        assert cast["W_b"].dtype == jnp.bfloat16
+        assert cast["gamma_a"].dtype == jnp.float32
+        assert cast["beta_c"].dtype == jnp.float32
+
+
 class TestResNet50Wiring:
     @pytest.mark.slow
     def test_fused_resnet50_small_trains(self):
