@@ -103,12 +103,14 @@ class DistributedLMTrainer:
                     f"n_experts {self.cfg.n_experts} not divisible by "
                     f"expert axis {ep}"
                 )
-            if pp > 1:
+            if pp > 1 and ep > 1:
                 raise ValueError(
-                    "MoE + pipeline parallelism is not supported: the "
-                    "GPipe schedule cannot carry the per-stage aux loss; "
-                    "compose EP with data/model/seq axes instead "
-                    "(the GShard layout)"
+                    "MoE with BOTH pipeline and expert axes is not "
+                    "supported: inside the pipeline's manual shard_map "
+                    "region the stacked expert dims aren't re-sharded "
+                    "over 'expert'. Use PP with replicated experts "
+                    "(pipe>1, expert=1 — the aux loss rides the ring) or "
+                    "EP composed with data/model/seq (the GShard layout)."
                 )
         self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
         self._step = None
@@ -207,7 +209,11 @@ class DistributedLMTrainer:
 
         def pipeline(bp_local, x):
             """Manual over {"pipe"} (+"seq"): bp_local has L/pp stacked
-            layers; x is the full (replicated-over-pipe) batch."""
+            layers; x is the full (replicated-over-pipe) batch. For MoE,
+            each microbatch's aux-loss scalar rides the ring beside the
+            activation, accumulating each stage's contribution — the
+            drained aux is the total over all L layers for that
+            microbatch (grad-accumulation aux semantics)."""
             stage = jax.lax.axis_index("pipe")
             B = x.shape[0]
             mb = B // M
@@ -215,13 +221,14 @@ class DistributedLMTrainer:
             perm = [(i, (i + 1) % pp) for i in range(pp)]
 
             def tick(carry, t):
-                recv, outs = carry
+                recv, recv_aux, outs, aux_outs = carry
                 # drain: from tick pp onward, recv holds a finished
                 # microbatch (wrapped around the ring from the last stage)
+                done = jnp.maximum(t - pp, 0)
                 outs = jax.lax.cond(
                     t >= pp,
                     lambda o: jax.lax.dynamic_update_index_in_dim(
-                        o, recv, jnp.maximum(t - pp, 0), 0),
+                        o, recv, done, 0),
                     lambda o: o,
                     outs,
                 )
@@ -231,32 +238,49 @@ class DistributedLMTrainer:
                     jax.lax.dynamic_index_in_dim(xs, sel, 0, keepdims=False),
                     recv,
                 )
-                y = stack_scan(bp_local, x_in)
+                if moe:  # aux scalar rides the ring beside the activation
+                    aux_outs = jnp.where(
+                        t >= pp, aux_outs.at[done].set(recv_aux), aux_outs)
+                    aux_in = jnp.where(stage == 0, 0.0, recv_aux)
+                    y, a = stack_scan(bp_local, x_in)
+                    recv_aux = jax.lax.ppermute(aux_in + a, "pipe", perm)
+                else:
+                    y = stack_scan(bp_local, x_in)
                 recv = jax.lax.ppermute(y, "pipe", perm)
-                return (recv, outs), None
+                return (recv, recv_aux, outs, aux_outs), None
 
             # M+pp-1 compute ticks; the LAST microbatch drains from recv
             # after the scan (the old unrolled loop's final store-only
             # tick) — no wasted stage compute
-            (recv, outs), _ = jax.lax.scan(
+            (recv, recv_aux, outs, aux_outs), _ = jax.lax.scan(
                 tick,
-                (jnp.zeros_like(xs[0]), jnp.zeros_like(xs)),
+                (jnp.zeros_like(xs[0]), jnp.zeros((), jnp.float32),
+                 jnp.zeros_like(xs), jnp.zeros((M,), jnp.float32)),
                 jnp.arange(M + pp - 1),
             )
             outs = outs.at[M - 1].set(recv)
             # final outputs live on stage 0; broadcast over the pipe axis
             outs = jnp.where(stage == 0, outs, 0.0)
             outs = jax.lax.psum(outs, "pipe")
-            return outs.reshape(B, *x.shape[1:])
+            outs = outs.reshape(B, *x.shape[1:])
+            if moe:
+                aux_outs = aux_outs.at[M - 1].set(recv_aux)
+                aux = jax.lax.psum(
+                    jnp.where(stage == 0, jnp.mean(aux_outs), 0.0), "pipe")
+                if sp > 1:  # each seq shard routed its own tokens
+                    aux = jax.lax.pmean(aux, "seq")
+                return outs, aux
+            return outs
 
         x_spec = P(None, "seq", None) if sp > 1 else P()
         bspec_leaf = lambda a: P("pipe", *([None] * (a.ndim - 1)))
+        out_spec = (x_spec, P()) if moe else x_spec
 
         def blocks_fn(bp, x):
             specs_b = jax.tree_util.tree_map(bspec_leaf, bp)
             return jax.shard_map(
                 pipeline, mesh=mesh.mesh, axis_names=manual,
-                in_specs=(specs_b, x_spec), out_specs=x_spec,
+                in_specs=(specs_b, x_spec), out_specs=out_spec,
                 check_vma=False,
             )(bp, x)
 

@@ -283,16 +283,57 @@ class TestMoETransformerLM:
         spec = dist.params_["blocks"]["W1"].sharding.spec
         assert "expert" in spec
 
-    def test_moe_with_pipeline_rejected(self):
+    def test_moe_with_pipeline_and_expert_axes_rejected(self):
+        """PP composes with MoE (aux rides the ring) but not with the
+        expert axis at the same time — that combination still raises."""
         from deeplearning4j_tpu.models.transformer_lm import TransformerLM
         from deeplearning4j_tpu.parallel import TrainingMesh
         from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
 
         m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=4,
                           max_length=8, n_experts=4).init()
-        mesh = TrainingMesh(data=4, pipe=2)
-        with pytest.raises(ValueError, match="pipeline"):
+        mesh = TrainingMesh(data=2, pipe=2, expert=2)
+        with pytest.raises(ValueError, match="pipeline and expert"):
             DistributedLMTrainer(m, mesh)
+
+    def test_moe_pipeline_matches_single_device(self):
+        """PP + MoE (r4): with one microbatch the routing batch equals
+        the single-device one, so losses agree exactly; the aux scalar
+        accumulates around the ring."""
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+        from deeplearning4j_tpu.parallel import TrainingMesh
+        from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+
+        ids, tgt = self._data()
+
+        def make():
+            return TransformerLM(vocab_size=32, d_model=32, n_heads=4,
+                                 n_layers=2, max_length=8, n_experts=4,
+                                 capacity_factor=2.0, seed=5).init()
+
+        ref = make()
+        ref_losses = [ref.fit_batch(ids, tgt) for _ in range(3)]
+        tr = DistributedLMTrainer(make(), TrainingMesh(data=4, pipe=2),
+                                  n_micro=1).place()
+        losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
+
+    def test_moe_pipeline_microbatched_trains(self):
+        """PP + MoE with real microbatching: per-microbatch routing and
+        aux (grad-accumulation semantics) — converges finitely."""
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+        from deeplearning4j_tpu.parallel import TrainingMesh
+        from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+
+        ids, tgt = self._data()
+        m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
+                          max_length=8, n_experts=4, capacity_factor=2.0,
+                          seed=5).init()
+        tr = DistributedLMTrainer(m, TrainingMesh(data=4, pipe=2),
+                                  n_micro=4).place()
+        losses = [tr.fit_batch(ids, tgt) for _ in range(8)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
 
     def test_moe_sp_composes(self):
         """EP + SP: ring attention over "seq" with per-shard routing."""
