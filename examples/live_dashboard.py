@@ -1,0 +1,66 @@
+"""Live training dashboard: UIServer serves /train pages re-rendered
+from the running StatsStorage while fit() is in progress (the reference
+PlayUIServer workflow: attach a storage, start the server, watch the
+browser update). This script polls its own server between epochs and
+shows the page advancing, then writes the static export.
+
+Run: python examples/live_dashboard.py
+"""
+
+import re
+import urllib.request
+
+from _common import setup_platform
+
+setup_platform()
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+from deeplearning4j_tpu.updaters import Adam
+
+
+def main():
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((256, 10)).astype(np.float32)
+    w = rng.standard_normal((10, 3)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[(x @ w).argmax(1)]
+    ds = DataSet(x, y)
+
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(0.01))
+            .weight_init("xavier").list()
+            .layer(DenseLayer(n_out=24, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    storage = InMemoryStatsStorage()
+    net.add_listeners(StatsListener(storage, session_id="live-demo"))
+    server = UIServer.get_instance()
+    server.attach(storage)
+    server.start(port=0)  # 0 → pick a free port, available as .port
+    url = f"http://127.0.0.1:{server.port}/train"
+    print(f"dashboard serving at {url}")
+
+    def records_on_page():
+        page = urllib.request.urlopen(url, timeout=10).read().decode()
+        return int(re.search(r"records: (\d+)", page).group(1))
+
+    for epoch in range(4):
+        net.fit(ds, epochs=1, batch_size=32)
+        print(f"epoch {epoch + 1}: page now shows "
+              f"{records_on_page()} records")
+
+    out = "/tmp/live_dashboard_export.html"
+    server.render(out)
+    print(f"static export written to {out}")
+    server.stop()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()
