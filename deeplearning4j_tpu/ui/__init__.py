@@ -18,7 +18,11 @@ from deeplearning4j_tpu.ui.storage import (
     StatsStorage,
 )
 from deeplearning4j_tpu.ui.stats import StatsListener
-from deeplearning4j_tpu.ui.dashboard import UIServer, render_dashboard
+from deeplearning4j_tpu.ui.dashboard import (
+    UIServer,
+    render_dashboard,
+    render_layer_page,
+)
 from deeplearning4j_tpu.ui.evaluation_tools import EvaluationTools
 from deeplearning4j_tpu.ui.remote import (
     RemoteStatsReceiver,
@@ -52,7 +56,8 @@ from deeplearning4j_tpu.ui.components import (
 
 __all__ = [
     "StatsListener", "StatsStorage", "InMemoryStatsStorage",
-    "FileStatsStorage", "UIServer", "render_dashboard", "EvaluationTools",
+    "FileStatsStorage", "UIServer", "render_dashboard", "render_layer_page",
+    "EvaluationTools",
     "RemoteUIStatsStorageRouter", "RemoteStatsReceiver",
     "Component", "ChartLine", "ChartScatter", "ChartHistogram",
     "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline",

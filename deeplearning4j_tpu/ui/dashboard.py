@@ -43,9 +43,29 @@ def _line(series: Dict[str, List[Tuple[float, float]]], title: str,
     return chart.render_html()
 
 
+
+_PAGE_CSS = """body{font-family:sans-serif;max-width:1400px;margin:24px auto;
+padding:0 16px;color:#111827} .row{display:flex;flex-wrap:wrap;gap:16px}
+h2{border-bottom:2px solid #e5e7eb;padding-bottom:4px}"""
+
+
+def _page_shell(title: str, body: str,
+                auto_refresh_s: Optional[int] = None) -> str:
+    """Shared HTML shell for every dashboard page (one place for styles
+    and the live-polling meta-refresh)."""
+    refresh_tag = (
+        f'<meta http-equiv="refresh" content="{int(auto_refresh_s)}">'
+        if auto_refresh_s else "")
+    return (f'<!doctype html>\n<html><head><meta charset="utf-8">'
+            f'{refresh_tag}\n<title>{html.escape(title)}</title>\n'
+            f'<style>{_PAGE_CSS}</style></head>\n<body>\n{body}\n'
+            f'</body></html>')
+
+
 def render_dashboard(storage: StatsStorage, session_id: Optional[str] = None,
                      path: Optional[str] = None,
-                     auto_refresh_s: Optional[int] = None) -> str:
+                     auto_refresh_s: Optional[int] = None,
+                     layer_links: bool = False) -> str:
     """Build the HTML report; writes to ``path`` if given. Sections mirror
     the reference TrainModule: Overview (score/throughput), Model
     (update:param ratios, per-layer stats), System (memory).
@@ -88,17 +108,16 @@ def render_dashboard(storage: StatsStorage, session_id: Optional[str] = None,
             f"{init['num_params']:,} parameters — layers: "
             f"{html.escape(', '.join(map(str, init['layer_names'])))}</p>"
         )
-    refresh_tag = (
-        f'<meta http-equiv="refresh" content="{int(auto_refresh_s)}">'
-        if auto_refresh_s else "")
-    doc = f"""<!doctype html>
-<html><head><meta charset="utf-8">{refresh_tag}
-<title>Training: {html.escape(session_id)}</title>
-<style>body{{font-family:sans-serif;max-width:1400px;margin:24px auto;
-padding:0 16px;color:#111827}} .row{{display:flex;flex-wrap:wrap;gap:16px}}
-h2{{border-bottom:2px solid #e5e7eb;padding-bottom:4px}}</style></head>
-<body>
-<h1>Training dashboard — {html.escape(session_id)}</h1>
+    if layer_links:
+        from urllib.parse import quote
+
+        keys = sorted({k for r in records for k in r.get("parameters", {})})
+        if keys:
+            links = " · ".join(
+                f'<a href="/train/{quote(session_id, safe="")}/layer/'
+                f'{quote(k, safe="")}">{html.escape(k)}</a>' for k in keys)
+            meta += f"<p>layer detail: {links}</p>"
+    body = f"""<h1>Training dashboard — {html.escape(session_id)}</h1>
 {meta}
 <h2>Overview</h2>
 <div class="row">
@@ -120,12 +139,81 @@ h2{{border-bottom:2px solid #e5e7eb;padding-bottom:4px}}</style></head>
 {_line(mem, "Host memory (RSS, MB)")}
 </div>
 <p style="color:#6b7280">records: {len(records)} · generated by
-deeplearning4j_tpu</p>
-</body></html>"""
+deeplearning4j_tpu</p>"""
+    doc = _page_shell(f"Training: {session_id}", body,
+                      auto_refresh_s=auto_refresh_s)
     if path is not None:
         with open(path, "w", encoding="utf-8") as f:
             f.write(doc)
     return doc
+
+
+def render_layer_page(storage: StatsStorage, session_id: str,
+                      layer_key: str,
+                      auto_refresh_s: Optional[int] = None) -> str:
+    """Per-layer drill-down (the reference TrainModule's model-tab layer
+    view): parameter mean/stdev and mean-magnitude curves, update:param
+    ratio, gradient/activation stats when collected, and the latest
+    parameter histogram. ``layer_key`` is a parameter key like ``0_W``
+    (or a layer-name prefix for activations)."""
+    records = [r for r in storage.get_records(session_id)
+               if r["kind"] == "update"]
+
+    def series(section, field):
+        pts = [(r["iteration"], r[section][layer_key][field])
+               for r in records
+               if layer_key in r.get(section, {})
+               and field in r[section][layer_key]]
+        return pts
+
+    charts = []
+    pm = {"mean": series("parameters", "mean"),
+          "stdev": series("parameters", "stdev")}
+    if any(pm.values()):
+        charts.append(_line(pm, f"{layer_key} parameter mean / stdev"))
+    mags = {"param |w|": series("parameters", "mean_magnitude"),
+            "update |dw|": series("updates", "mean_magnitude"),
+            "gradient |g|": series("gradients", "mean_magnitude")}
+    mags = {k: v for k, v in mags.items() if v}
+    if mags:
+        charts.append(_line(mags, f"{layer_key} mean magnitudes (log10)",
+                            log_y=True))
+    ratio = [(r["iteration"], r["update_param_ratio"][layer_key])
+             for r in records if layer_key in r.get("update_param_ratio", {})]
+    if ratio:
+        charts.append(_line({"ratio": ratio},
+                            f"{layer_key} update : parameter ratio (log10)",
+                            log_y=True))
+    act = {"stdev": series("activations", "stdev"),
+           "mean": series("activations", "mean")}
+    if any(act.values()):
+        charts.append(_line(act, f"{layer_key} activation mean / stdev"))
+    hist = next((r["parameters"][layer_key]["histogram"]
+                 for r in reversed(records)
+                 if "histogram" in r.get("parameters", {}).get(layer_key, {})),
+                None)
+    if hist is not None and hist["counts"]:
+        from deeplearning4j_tpu.ui.components import ChartHistogram
+
+        ch = ChartHistogram(f"{layer_key} parameter distribution (latest)",
+                            StyleChart(width=640, height=260))
+        n = len(hist["counts"])
+        width = (hist["max"] - hist["min"]) / max(n, 1)
+        for i, c in enumerate(hist["counts"]):
+            ch.add_bin(hist["min"] + i * width, hist["min"] + (i + 1) * width,
+                       c)
+        charts.append(ch.render_html())
+    if not charts:
+        charts.append(f"<p>no records for layer key "
+                      f"{html.escape(layer_key)}</p>")
+    from urllib.parse import quote
+
+    body = f"""<p><a href="/train/{quote(session_id, safe='')}">&larr;
+overview</a></p>
+<h1>{html.escape(layer_key)} — {html.escape(session_id)}</h1>
+<div class="row">{''.join(charts)}</div>"""
+    return _page_shell(f"{layer_key} — {session_id}", body,
+                       auto_refresh_s=auto_refresh_s)
 
 
 class UIServer:
@@ -227,16 +315,24 @@ class UIServer:
                         self._send_html(ui._waiting_page())
                         return
                     self._send_html(render_dashboard(
-                        st, sid, auto_refresh_s=ui.auto_refresh_s))
+                        st, sid, auto_refresh_s=ui.auto_refresh_s,
+                        layer_links=True))
                 elif path.startswith("/train/"):
-                    sid = unquote(path[len("/train/"):])
+                    rest = unquote(path[len("/train/"):])
+                    sid, _, layer = rest.partition("/layer/")
                     try:
                         st, sid = ui._find(sid)
                     except KeyError as e:  # unknown id is an error, not
                         self.send_error(404, str(e)[:200])  # a wait state
                         return
-                    self._send_html(render_dashboard(
-                        st, sid, auto_refresh_s=ui.auto_refresh_s))
+                    if layer:  # TrainModule model-tab layer drill-down
+                        self._send_html(render_layer_page(
+                            st, sid, layer,
+                            auto_refresh_s=ui.auto_refresh_s))
+                    else:
+                        self._send_html(render_dashboard(
+                            st, sid, auto_refresh_s=ui.auto_refresh_s,
+                            layer_links=True))
                 elif path == "/sessions":
                     ids = [s for st in ui.storages
                            for s in st.list_session_ids()]

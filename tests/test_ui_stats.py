@@ -156,6 +156,14 @@ class TestDashboard:
             # route table parity: /sessions JSON + per-session page
             assert json.loads(fetch("/sessions")) == ["live1"]
             assert "live1" in fetch("/train/live1")
+            # layer drill-down (TrainModule model-tab view): overview
+            # links to per-layer pages with that layer's curves
+            assert "/train/live1/layer/0_W" in page2
+            layer_page = fetch("/train/live1/layer/0_W")
+            assert "0_W parameter mean / stdev" in layer_page
+            assert "update : parameter ratio" in layer_page
+            assert "parameter distribution" in layer_page  # histogram
+            assert "<svg" in layer_page
             # remote-listener endpoint feeds the attached storage
             req = urllib.request.Request(
                 url + "/stats",
