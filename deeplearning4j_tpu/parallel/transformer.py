@@ -84,10 +84,18 @@ class DistributedLMTrainer:
 
     def __init__(self, model: TransformerLM, mesh: TrainingMesh,
                  n_micro: Optional[int] = None,
-                 clip_norm: Optional[float] = None):
+                 clip_norm: Optional[float] = None,
+                 remat_blocks: bool = False):
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
+        # remat_blocks bounds activation memory on ANY mesh shape:
+        # backward recomputes each transformer block's interior from its
+        # boundary activation instead of storing it (under the pipeline
+        # this is GPipe's per-microbatch memory cost — the 1F1B
+        # motivation — traded for ~1/3 more FLOPs via remat rather than
+        # a hand-scheduled backward)
+        self.remat_blocks = bool(remat_blocks)
         # global-norm gradient clipping (the LM-training standard; the
         # layer stack's gradient_normalization analog for this trainer)
         self.clip_norm = None if clip_norm is None else float(clip_norm)
@@ -148,12 +156,17 @@ class DistributedLMTrainer:
 
         moe = cfg.n_experts > 0
 
+        def _blk(bp, x):
+            return block_apply(cfg, bp, x, attn_fn=attn_fn)
+
+        blk = jax.checkpoint(_blk) if self.remat_blocks else _blk
+
         def stack_scan(bp_local, x):
             """Dense: x → x. MoE: x → (x, summed aux loss)."""
             if moe:
                 def body(carry, bp):
                     x, aux = carry
-                    x, a = block_apply(cfg, bp, x, attn_fn=attn_fn)
+                    x, a = blk(bp, x)
                     return (x, aux + a), None
 
                 (x, aux), _ = jax.lax.scan(
@@ -161,7 +174,7 @@ class DistributedLMTrainer:
                 return x, aux
 
             def body(x, bp):
-                return block_apply(cfg, bp, x, attn_fn=attn_fn), None
+                return blk(bp, x), None
 
             x, _ = jax.lax.scan(body, x, bp_local)
             return x

@@ -98,6 +98,24 @@ class TestAwkwardShapes:
         np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=1e-4)
 
     @pytest.mark.slow
+    @pytest.mark.parametrize("mesh_kw,n_micro", [
+        (dict(data=2, pipe=4), 4), (dict(data=8), 1)],
+        ids=["pp4", "dp-only"])
+    def test_remat_blocks_parity(self, mesh_kw, n_micro):
+        """remat_blocks recomputes block interiors in backward — same
+        math, bounded activation memory, on pipelined AND plain meshes;
+        losses must match the default exactly."""
+        ids, tgt = _data()
+        base = DistributedLMTrainer(_model(), TrainingMesh(**mesh_kw),
+                                    n_micro=n_micro).place()
+        base_losses = [base.fit_batch(ids, tgt) for _ in range(3)]
+        rem = DistributedLMTrainer(_model(), TrainingMesh(**mesh_kw),
+                                   n_micro=n_micro,
+                                   remat_blocks=True).place()
+        rem_losses = [rem.fit_batch(ids, tgt) for _ in range(3)]
+        np.testing.assert_allclose(rem_losses, base_losses, rtol=1e-6)
+
+    @pytest.mark.slow
     def test_pp2_n_micro8_parity_and_bubble_fraction(self):
         """GPipe with 8 microbatches: parity holds and the schedule
         reports its idle fraction (pp-1)/(n_micro+pp-1)."""
