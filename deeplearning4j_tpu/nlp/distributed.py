@@ -79,6 +79,38 @@ class DistributedSequenceVectors:
             v.syn1neg = self._mean_over_processes(v.syn1neg)
         self.sync_count += 1
 
+    # -------------------------------------------------------- sanity check
+    def _check_corpus_agreement(self, seqs) -> None:
+        """Every process MUST hold the identical corpus + vocabulary (the
+        TextPipeline broadcast invariant) — otherwise round-robin
+        sharding drops data and parameter averaging blends embeddings of
+        UNRELATED words. Fingerprint both and compare across processes so
+        the misuse fails loudly instead of silently corrupting."""
+        if self.num_processes <= 1 or jax.process_count() <= 1:
+            return
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        h = hashlib.sha256()
+        v = self.vectors.vocab
+        for i in range(v.num_words()):
+            vw = v.element_at_index(i)
+            h.update(f"{i}:{vw.word}:{vw.count};".encode())
+        for s in seqs:
+            h.update(np.asarray(s, np.int32).tobytes())
+        # int32: the gather runs through jax, which truncates int64
+        # when x64 is disabled
+        digest = np.frombuffer(h.digest()[:8], np.int32)
+        gathered = multihost_utils.process_allgather(digest)
+        if not np.all(np.asarray(gathered) == digest):
+            raise ValueError(
+                "DistributedSequenceVectors: processes disagree on the "
+                "corpus/vocabulary. Every process must construct the "
+                "IDENTICAL full corpus and vocab (sharding happens inside "
+                "this trainer); per-process pre-sharded data would be "
+                "silently dropped and averaged across unrelated words.")
+
     # -------------------------------------------------------------------- fit
     def fit_sequences(self, all_sequences: Iterable[np.ndarray]
                       ) -> "DistributedSequenceVectors":
@@ -86,6 +118,7 @@ class DistributedSequenceVectors:
         process — matching TextPipeline's driver-side corpus); sharding
         happens here so all replicas agree on the split."""
         seqs = [np.asarray(s, np.int32) for s in all_sequences]
+        self._check_corpus_agreement(seqs)
         local = shard_sequences(seqs, self.num_processes, self.process_id)
         synced_at = [-1]
 
@@ -94,7 +127,8 @@ class DistributedSequenceVectors:
                 self.synchronize()
                 synced_at[0] = epoch
 
-        self.vectors.fit_sequences(local, on_epoch_end=on_epoch_end)
+        self.vectors.fit_sequences(local, on_epoch_end=on_epoch_end,
+                                   distributed=False)
         if synced_at[0] != self.vectors.epochs - 1:
             # the run must END synchronized even when epochs isn't a
             # multiple of averaging_frequency — replicas always agree

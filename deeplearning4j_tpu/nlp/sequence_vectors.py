@@ -14,7 +14,7 @@ Learning-rate schedule matches word2vec: linear decay from
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, List, Optional, Sequence
+from typing import Callable, Iterable, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -152,12 +152,31 @@ class SequenceVectors:
                       total_words_hint: Optional[int] = None,
                       on_epoch_end: Optional[Callable[["SequenceVectors", int],
                                                       None]] = None,
+                      distributed: Union[str, bool] = "auto",
                       ) -> "SequenceVectors":
         """Train on an iterable of index arrays; re-iterated
         ``epochs × iterations`` times (reference fit loop semantics).
         ``on_epoch_end(self, epoch)`` fires after each epoch — the
         distributed trainer synchronizes replicas there
-        (nlp/distributed.py)."""
+        (nlp/distributed.py).
+
+        ``distributed="auto"`` (default): under a multi-process
+        jax.distributed run, route through DistributedSequenceVectors —
+        ``sequences`` must then be the FULL corpus, identical on every
+        process (checked by corpus fingerprint); sharding and
+        epoch-boundary parameter averaging happen inside. This is how
+        every facade riding this class (Word2Vec, ParagraphVectors,
+        DeepWalk) becomes multi-host without its own plumbing. Pass
+        ``distributed=False`` to force local training."""
+        if distributed == "auto":
+            distributed = jax.process_count() > 1
+        if distributed:
+            from deeplearning4j_tpu.nlp.distributed import (
+                DistributedSequenceVectors,
+            )
+
+            DistributedSequenceVectors(self).fit_sequences(sequences)
+            return self
         seqs = [np.asarray(s, np.int32) for s in sequences]
         total = total_words_hint or sum(len(s) for s in seqs)
         total_span = max(total * self.epochs * self.iterations, 1)

@@ -183,6 +183,9 @@ class Word2Vec:
             ids = np.asarray([i for i in ids if i >= 0], np.int32)
             if len(ids):
                 seqs.append(ids)
+        # under a multi-process jax.distributed run, fit_sequences
+        # auto-routes through DistributedSequenceVectors (every facade
+        # riding SequenceVectors gets the dl4j-spark-nlp capability)
         self.sv.fit_sequences(seqs)
         return self
 

@@ -44,11 +44,23 @@ dist = DistributedSequenceVectors(sv)
 dist.fit_sequences(seqs)
 
 assert dist.sync_count >= 8, dist.sync_count
+
+# the Word2Vec facade routes through the distributed trainer by itself
+# when process_count > 1 (word2vec.py fit) — user-surface proof
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec  # noqa: E402
+
+sentences = ["the quick brown fox jumps over the lazy dog",
+             "the lazy dog sleeps while the quick fox runs",
+             "brown fox and lazy dog play in the sun"] * 10
+w2v = (Word2Vec.builder().iterate(sentences).layer_size(12).window_size(2)
+       .min_word_frequency(1).epochs(2).seed(3).build().fit())
+w2v_m = w2v.get_word_vector_matrix()
+
 if pid == 0:
     np.savez(os.path.join(outdir, "seqvec_dist.npz"),
              syn0=sv.get_word_vector_matrix(),
-             sync_count=dist.sync_count)
+             sync_count=dist.sync_count, w2v=w2v_m)
 else:
     np.savez(os.path.join(outdir, f"seqvec_dist_{pid}.npz"),
-             syn0=sv.get_word_vector_matrix())
+             syn0=sv.get_word_vector_matrix(), w2v=w2v_m)
 print(f"seqvec worker {pid}: done, syncs={dist.sync_count}", flush=True)

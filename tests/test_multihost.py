@@ -201,6 +201,8 @@ def test_two_process_sequence_vectors_similarity_parity():
     d0 = np.load(os.path.join(outdir, "seqvec_dist.npz"))
     d1 = np.load(os.path.join(outdir, "seqvec_dist_1.npz"))
     np.testing.assert_allclose(d0["syn0"], d1["syn0"], atol=0)  # replicas agree
+    # the Word2Vec FACADE also ran distributed (auto-routed): replicas agree
+    np.testing.assert_allclose(d0["w2v"], d1["w2v"], atol=0)
 
     # single-process reference on the identical corpus + config
     from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
