@@ -30,8 +30,32 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compilation cache: repeat suite runs skip recompilation of
 # unchanged jitted programs (SURVEY §4 fast-tier mandate).
+#
+# The cache dir is KEYED BY A HOST-CPU FINGERPRINT: XLA:CPU AOT results
+# embed the compile machine's feature set, and executing an entry cached
+# on a different machine can raw-SIGABRT/SIGILL ("Loading XLA:CPU AOT
+# result. Target machine feature ... not supported on the host machine
+# ... could lead to execution errors such as SIGILL"). Round-4 bisect:
+# a 39 MB cache carried over from another host made the MoE EP+SP step
+# abort on every cache hit, looking like a heisenbug in whatever test
+# ran it first.
+
+
+def _host_cache_tag() -> str:
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            feat = next(l for l in f if l.startswith("flags"))
+    except (OSError, StopIteration):
+        feat = platform.processor() or platform.machine()
+    return hashlib.sha256(feat.encode()).hexdigest()[:12]
+
+
 _cache_dir = os.environ.get(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), ".jax_cache")
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), ".jax_cache", _host_cache_tag()),
 )
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -77,6 +77,10 @@ class TestFlashKernelInterpret:
         big = jnp.zeros((1, 1, MAX_SEQ_LEN + 128, 64))
         with pytest.raises(ValueError, match="ring attention"):
             flash_attention(big, big, big, interpret=True)
+        k = jnp.zeros((1, 1, 256, 64))
+        with pytest.raises(ValueError, match="match exactly"):
+            flash_attention(jnp.zeros((1, 1, 128, 64)), k, k,
+                            interpret=True)
 
     def test_block_mixing_multiblock(self):
         """T=384 exercises the 128-block path with 3 kv blocks and a
