@@ -1,0 +1,64 @@
+"""Generate the round-4 serialization-regression fixture: a trained
+ComputationGraph containing a FusedResNetBottleneck (the r4 layer with
+multi-conv params + per-BN running stats in one layer state dict), saved
+in the standard zip layout + golden outputs. COMMITTED — future rounds
+must keep loading it (reference RegressionTest pattern, SURVEY §4.3).
+
+Run once: python tests/fixtures/gen_regression_v4_fixture.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "regression")
+
+
+def main():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import (
+        FusedResNetBottleneck,
+        GlobalPoolingLayer,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+    from deeplearning4j_tpu.updaters import Adam
+
+    gb = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3))
+          .weight_init("relu").graph_builder()
+          .add_inputs("input")
+          .set_input_types(InputType.convolutional(8, 8, 16)))
+    gb.add_layer("block", FusedResNetBottleneck(width=4, project=True),
+                 "input")
+    gb.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "block")
+    gb.add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                    loss="mcxent"), "pool")
+    gb.set_outputs("out")
+    net = ComputationGraph(gb.build()).init()
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((16, 8, 8, 16)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    net.fit(DataSet(x, y), epochs=3)
+
+    path = os.path.join(OUT, "fused_block_adam_v4.zip")
+    ModelSerializer.write_model(net, path, save_updater=True)
+    out = np.asarray(net.output_single(x[:4]))
+    np.savez(os.path.join(OUT, "fused_block_adam_v4_golden.npz"),
+             x=x[:4], y=out, iteration=net.iteration)
+    print(f"wrote {path} ({os.path.getsize(path)//1024} KB), "
+          f"iteration={net.iteration}")
+
+
+if __name__ == "__main__":
+    main()

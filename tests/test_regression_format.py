@@ -64,3 +64,36 @@ class TestV1CheckpointFormat:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
         assert isinstance(m, MultiLayerNetwork)
+
+
+class TestV4CheckpointFormat:
+    """Round-4 format additions: a ComputationGraph containing a
+    FusedResNetBottleneck (multi-conv params + several BN running-stat
+    pairs in ONE layer state dict) must keep loading in every future
+    round."""
+
+    def test_fused_block_roundtrip(self):
+        net = ModelSerializer.restore_computation_graph(
+            os.path.join(FIXTURES, "fused_block_adam_v4.zip")
+        )
+        g = np.load(os.path.join(FIXTURES, "fused_block_adam_v4_golden.npz"))
+        np.testing.assert_allclose(
+            np.asarray(net.output_single(g["x"])), g["y"], atol=1e-6)
+        assert net.iteration == int(g["iteration"])
+        # the block's BN running stats restored as layer state
+        st = net.state_["block"]
+        assert "mean_c" in st and np.abs(np.asarray(st["mean_c"])).max() > 0
+
+    def test_fused_block_training_resumes(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        net = ModelSerializer.restore_computation_graph(
+            os.path.join(FIXTURES, "fused_block_adam_v4.zip")
+        )
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((8, 8, 8, 16)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        it0 = net.iteration
+        net.fit(DataSet(x, y), epochs=1, batch_size=8)
+        assert net.iteration == it0 + 1
+        assert np.isfinite(float(net.score_))
