@@ -6,6 +6,8 @@ through MLN and CG), serde round-trip, and EP-vs-single-device parity on
 the 8-device CPU mesh.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -336,20 +338,28 @@ class TestMoETransformerLM:
         assert losses[-1] < losses[0]
 
     def test_moe_sp_composes(self):
-        """EP + SP: ring attention over "seq" with per-shard routing."""
-        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
-        from deeplearning4j_tpu.parallel import TrainingMesh
-        from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+        """EP + SP: ring attention over "seq" with per-shard routing.
 
-        ids, tgt = self._data(T=8)
-        m = TransformerLM(vocab_size=32, d_model=32, n_heads=4, n_layers=2,
-                          max_length=8, n_experts=2,
-                          capacity_factor=2.0, seed=3).init()
-        mesh = TrainingMesh(data=2, seq=2, expert=2)
-        tr = DistributedLMTrainer(m, mesh).place()
-        losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
-        assert all(np.isfinite(l) for l in losses)
-        assert losses[-1] < losses[0]
+        Runs in a SUBPROCESS (tests/moe_sp_worker.py): executing this
+        seq-manual x expert-auto program after many prior programs in
+        the same process can raw-SIGABRT in the jaxlib 0.9.0 CPU
+        runtime (flaky, prior-state-dependent — the identical program
+        passes deterministically in a fresh process; r4 bisect)."""
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "moe_sp_worker.py")],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert proc.returncode == 0, (
+            f"worker failed\nstdout:\n{proc.stdout[-3000:]}\n"
+            f"stderr:\n{proc.stderr[-3000:]}")
+        assert "ALL-OK" in proc.stdout
 
 
 class TestLMMixedPrecision:
