@@ -39,8 +39,10 @@ def shard_sequences(seqs: Sequence, num_shards: int, shard_index: int) -> List:
 
 class DistributedSequenceVectors:
     """Parameter-averaging wrapper around any :class:`SequenceVectors`
-    (Word2Vec / ParagraphVectors / DeepWalk all ride it, as their Spark
-    counterparts ride Word2VecPerformer).
+    trained via ``fit_sequences`` (Word2Vec and DeepWalk route here
+    automatically; ParagraphVectors' doc-id loop drives the per-batch
+    kernels directly and is single-process — per-document rows are owned
+    by one process and must not be mean-averaged).
 
     ``averaging_frequency`` counts epochs between synchronizations
     (reference ParameterAveragingTrainingMaster knob; 1 = every epoch).

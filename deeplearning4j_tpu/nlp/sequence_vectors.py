@@ -164,9 +164,11 @@ class SequenceVectors:
         jax.distributed run, route through DistributedSequenceVectors —
         ``sequences`` must then be the FULL corpus, identical on every
         process (checked by corpus fingerprint); sharding and
-        epoch-boundary parameter averaging happen inside. This is how
-        every facade riding this class (Word2Vec, ParagraphVectors,
-        DeepWalk) becomes multi-host without its own plumbing. Pass
+        epoch-boundary parameter averaging happen inside. Facades that
+        train THROUGH this method (Word2Vec, DeepWalk — whose seeded
+        walks are process-identical) become multi-host without their own
+        plumbing; ParagraphVectors drives the per-batch kernels directly
+        for its doc-id loop and stays single-process. Pass
         ``distributed=False`` to force local training."""
         if distributed == "auto":
             distributed = jax.process_count() > 1
