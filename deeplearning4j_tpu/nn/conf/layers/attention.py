@@ -163,19 +163,27 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
 
         yield "jax-bundled", jax_flash
 
+    from deeplearning4j_tpu.nn.ops.kernel_compat import probe_with_retry
+
     impl = None
     sc = head_dim ** -0.5
     for cand_name, kernel in candidates():
-        try:
-            _probe_compiles(
-                lambda q, k, v: kernel(q, k, v, causal=causal, sm_scale=sc),
-                seq_len, head_dim, dtype, causal)
+        def on_fail(e, will_retry, cand_name=cand_name):
+            logging.getLogger(__name__).info(
+                "%s Pallas flash unavailable for %s (%s: %s)%s",
+                cand_name, key, type(e).__name__,
+                str(e).split("\n", 1)[0],
+                " — transient remote-compile crash, retrying once"
+                if will_retry else "")
+
+        if probe_with_retry(
+                lambda kernel=kernel: _probe_compiles(
+                    lambda q, k, v: kernel(q, k, v, causal=causal,
+                                           sm_scale=sc),
+                    seq_len, head_dim, dtype, causal),
+                on_fail):
             impl = functools.partial(_call_flash, kernel, causal)
             break
-        except Exception as e:
-            logging.getLogger(__name__).info(
-                "%s Pallas flash unavailable for %s (%s: %s)", cand_name,
-                key, type(e).__name__, str(e).split("\n", 1)[0])
     if impl is None:
         logging.getLogger(__name__).warning(
             "Pallas flash attention unavailable for %s — falling back to "

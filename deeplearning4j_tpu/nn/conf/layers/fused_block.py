@@ -114,15 +114,30 @@ class FusedResNetBottleneck(FeedForwardLayer):
 
     # ---------------------------------------------------------------- apply
     def _pallas_enabled(self, x) -> bool:
+        """Whether this block drives the Pallas kernels (both convs) or
+        the XLA composition (both convs) — the choice is deliberately
+        block-global.
+
+        Hardware verdict (2026-07-31, v5e via axon, batch 128, fwd+bwd
+        wall-clock): in ISOLATION the 3x3 kernel beats its XLA
+        composition mid-network (0.83x at (28,28,128), 0.71x at
+        (14,14,256)) and the pointwise kernel is parity-at-best (4.2x
+        worse at stage 1, where 64→128 channel padding idles half the
+        MXU K-dim). But mixing per-shape does NOT compose: a ResNet-50
+        step with only the winning c3 shapes on Pallas measured 885
+        img/s vs 1228 all-Pallas vs 2615 all-XLA — every Pallas custom
+        call is a fusion/layout boundary that costs XLA more than the
+        kernel saves. So: both kernels or neither, and the XLA path
+        stays the default/headline (``ResNet50(fused_pallas=True)``
+        opts in). DL4J_TPU_FUSED: "0" disables. The compile-probe
+        verdict is always consulted — a kernel that fails its value
+        check never runs."""
         import os
 
         env = os.environ.get("DL4J_TPU_FUSED")
-        if env is not None:
-            if env == "0":
-                return False
-            # "1" forces the probe's verdict to be consulted anyway —
-            # a kernel that fails its value check must never run
-        elif self.use_pallas is False:
+        if env == "0":
+            return False
+        if env is None and self.use_pallas is False:
             return False
         if x.dtype != jnp.bfloat16:
             return False

@@ -1,12 +1,15 @@
 """Flash attention as hand-written Pallas TPU kernels (fwd + bwd).
 
 Why not the jax-bundled kernel: the axon tunnel's server-side Mosaic
-(runtime libtpu) lags the JAX client and rejects the bundled kernel's
-lowering ("Bad lhs type" on an accumulating bf16 ``tpu.matmul``); probes
-show every *simple* matmul form compiles, so this kernel restricts
-itself to plain 2-D ``dot_general`` per grid cell. Design (deliberately
-simpler than the bundled op — no attention-bias / segment-id support,
-those route to dense XLA attention):
+(runtime libtpu) lags the JAX client (r3 it rejected an accumulating
+bf16 ``tpu.matmul`` with "Bad lhs type"; since fixed upstream), and the
+bundled kernel inherits the caller's matmul-precision default — under
+this package's ``jax_default_matmul_precision="highest"`` a bf16 Mosaic
+matmul crashes the remote compiler outright (PROBE_BISECT.md). This
+kernel restricts itself to plain 2-D ``dot_general`` per grid cell with
+``precision=DEFAULT`` pinned on every dot. Design (deliberately simpler
+than the bundled op — no attention-bias / segment-id support, those
+route to dense XLA attention):
 
 - grid ``(b·h, T/B)``; K and V rows for the (batch, head) live whole in
   VMEM (their BlockSpec index map is constant in the q-block dimension,
@@ -40,6 +43,7 @@ _LANE = 128
 _TRANS_B = (((1,), (1,)), ((), ()))   # x (m,k) · y (n,k) -> (m,n)
 _TRANS_A = (((0,), (0,)), ((), ()))   # x (k,m) · y (k,n) -> (m,n)
 _NEG_INF = -1e30
+from deeplearning4j_tpu.nn.ops.kernel_compat import PRECISION as _PREC
 
 
 def _pick_block(T: int) -> int:
@@ -72,7 +76,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         k = k_ref[0, pl.dslice(j * B, B), :]            # (B, hd)
         v = v_ref[0, pl.dslice(j * B, B), :]
         s = jax.lax.dot_general(q, k, _TRANS_B,
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32, precision=_PREC) * scale
         if causal:
             rows = i * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
             cols = j * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
@@ -82,7 +86,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
         alpha = jnp.exp(m - m_new)                      # (B, 1)
         l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32, precision=_PREC)
         o = o * alpha + pv
         return o, m_new, l
 
@@ -113,18 +117,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref, dq_ref, *,
         k = k_ref[0, pl.dslice(j * B, B), :]
         v = v_ref[0, pl.dslice(j * B, B), :]
         s = jax.lax.dot_general(q, k, _TRANS_B,
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32, precision=_PREC) * scale
         if causal:
             rows = i * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
             cols = j * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse)                            # (B, B)
         dp = jax.lax.dot_general(do, v, _TRANS_B,
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32, precision=_PREC)
         ds = p * (dp - dcap) * scale
         dq = dq + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=_PREC)
         return dq
 
     dq0 = jnp.zeros((B, q.shape[-1]), jnp.float32)
@@ -149,19 +153,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dcap_ref,
         lse = lse_ref[0, pl.dslice(i * B, B), :][:, 0:1]
         dcap = dcap_ref[0, pl.dslice(i * B, B), :][:, 0:1]
         s = jax.lax.dot_general(q, k, _TRANS_B,
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32, precision=_PREC) * scale
         if causal:
             rows = i * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
             cols = j * B + jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         p = jnp.exp(s - lse)                            # (B_q, B_k)
         dv = dv + jax.lax.dot_general(p.astype(do.dtype), do, _TRANS_A,
-                                      preferred_element_type=jnp.float32)
+                                      preferred_element_type=jnp.float32, precision=_PREC)
         dp = jax.lax.dot_general(do, v, _TRANS_B,
-                                 preferred_element_type=jnp.float32)
+                                 preferred_element_type=jnp.float32, precision=_PREC)
         ds = p * (dp - dcap) * scale
         dk = dk + jax.lax.dot_general(ds.astype(q.dtype), q, _TRANS_A,
-                                      preferred_element_type=jnp.float32)
+                                      preferred_element_type=jnp.float32, precision=_PREC)
         return dk, dv
 
     z = jnp.zeros((B, k.shape[-1]), jnp.float32)

@@ -48,6 +48,11 @@ from jax.experimental.pallas import tpu as pltpu
 LANE = 128          # MXU/VPU lane width
 SUBLANE_F32 = 8
 
+from deeplearning4j_tpu.nn.ops.kernel_compat import (  # noqa: E402
+    PRECISION as _PREC,
+    probe_with_retry,
+)
+
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
@@ -85,7 +90,7 @@ def _pw_fwd_kernel(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref,
     j, i = pl.program_id(0), pl.program_id(1)
     xn = _fold(x_ref[...], s_ref[0, :], t_ref[0, :], relu_in)
     acc_ref[...] = jnp.dot(xn.astype(jnp.bfloat16), w_ref[...],
-                           preferred_element_type=jnp.float32)
+                           preferred_element_type=jnp.float32, precision=_PREC)
     y = acc_ref[...]
     y_ref[...] = y.astype(jnp.bfloat16)
     # rows past m_valid are padding — keep them out of the statistics
@@ -115,7 +120,7 @@ def _pw_bwd_dx_kernel(x_ref, s_ref, t_ref, w_ref, z_ref, dz_ref, ds_ref,
     dxn = jax.lax.dot_general(
         dzeff.astype(jnp.bfloat16), w_ref[...],
         dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
     x = x_ref[...].astype(jnp.float32)
     u = x * s_ref[0, :] + t_ref[0, :]
@@ -148,7 +153,7 @@ def _pw_bwd_dw_kernel(x_ref, s_ref, t_ref, z_ref, dz_ref, ds_ref, dw_ref,
     dw_ref[...] += jax.lax.dot_general(
         xn.astype(jnp.bfloat16), dzeff.astype(jnp.bfloat16),
         dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+        preferred_element_type=jnp.float32, precision=_PREC,
     )
 
 
@@ -159,6 +164,24 @@ def _pw_shapes(x, w):
     cinp = _round_up(cin, LANE)
     coutp = _round_up(cout, LANE)
     return m, cin, cout, mp, cinp, coutp
+
+
+# Scoped-VMEM budget for choosing the M-block. The hardware limit is
+# ~16MB; at bm=512, Cin=512, Cout=2048 the dw kernel's footprint is
+# 20.9MB (measured OOM, BENCH r4) — the resident (Cin, Cout) panel plus
+# double-buffered M-blocks plus f32 intermediates. The estimate below is
+# deliberately coarse (panel + 12 bytes per M-row element covers the
+# bf16 blocks twice for pipelining and one f32 intermediate each side);
+# 12MB leaves headroom for Mosaic's own scratch.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _pw_block_m(mp: int, cinp: int, coutp: int) -> int:
+    for bm in (512, 256, 128):
+        if bm <= max(mp, 128) and (
+                4 * cinp * coutp + 12 * bm * (cinp + coutp)) <= _VMEM_BUDGET:
+            return bm
+    return 128
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -176,7 +199,7 @@ def pw_conv(x, scale, shift, w, relu_in: bool = False,
 
 def _pw_forward(x, scale, shift, w, relu_in, interpret):
     m, cin, cout, mp, cinp, coutp = _pw_shapes(x, w)
-    bm = min(mp, 512)
+    bm = _pw_block_m(mp, cinp, coutp)
     mp = _round_up(mp, bm)
     xp = _pad_axis(_pad_axis(x, 0, mp), 1, cinp)
     wp = _pad_axis(_pad_axis(w, 0, cinp), 1, coutp)
@@ -215,7 +238,7 @@ def _pw_bwd_rule(relu_in, interpret, res, cts):
     x, scale, shift, w, z = res
     dz, dst = cts
     m, cin, cout, mp, cinp, coutp = _pw_shapes(x, w)
-    bm = min(_round_up(m, LANE), 512)
+    bm = _pw_block_m(mp, cinp, coutp)
     mp = _round_up(mp, bm)
     xp = _pad_axis(_pad_axis(x, 0, mp), 1, cinp)
     zp = _pad_axis(_pad_axis(z, 0, mp), 1, coutp)
@@ -293,7 +316,7 @@ def _c3_fwd_kernel(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, xp_ref,
         for dx in range(3):
             op = xp_ref[dy:dy + h, dx:dx + wd, :].reshape(h * wd, cinp)
             acc_ref[...] += jnp.dot(op, w_ref[dy, dx],
-                                    preferred_element_type=jnp.float32)
+                                    preferred_element_type=jnp.float32, precision=_PREC)
     y = acc_ref[...]
     y_ref[0] = y.reshape(h, wd, -1).astype(jnp.bfloat16)
 
@@ -320,7 +343,7 @@ def _c3_bwd_dx_kernel(x_ref, s_ref, t_ref, w_ref, z_ref, dz_ref, ds_ref,
             g = jax.lax.dot_general(
                 dzf, w_ref[dy, dx],
                 dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=jnp.float32, precision=_PREC,
             ).reshape(h, wd, -1)
             dxp_ref[dy:dy + h, dx:dx + wd, :] += g
     x = x_ref[0].astype(jnp.float32)
@@ -361,7 +384,7 @@ def _c3_bwd_dw_kernel(x_ref, s_ref, t_ref, z_ref, dz_ref, ds_ref, dw_ref,
             dw_ref[dy, dx] += jax.lax.dot_general(
                 op, dzf,
                 dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=jnp.float32, precision=_PREC,
             )
 
 
@@ -552,14 +575,14 @@ def fused_conv_available(dtype=jnp.bfloat16) -> bool:
                     raise RuntimeError(
                         f"fused-conv probe grad mismatch: rel {err:.3e}")
 
-    try:
-        probe()
-        ok = True
-    except Exception as e:  # toolchain reject/miscompile → XLA fallback
+    def on_fail(e, will_retry):  # toolchain reject/miscompile → XLA path
         logging.getLogger(__name__).warning(
-            "Pallas fused conv unavailable for %s (%s: %s) — using the XLA "
-            "composition", key, type(e).__name__, str(e).split("\n", 1)[0])
-        ok = False
+            "Pallas fused conv unavailable for %s (%s: %s) — %s", key,
+            type(e).__name__, str(e).split("\n", 1)[0],
+            "transient remote-compile crash, retrying once" if will_retry
+            else "using the XLA composition")
+
+    ok = probe_with_retry(probe, on_fail)
     _PROBE_CACHE[key] = ok
     return ok
 
@@ -571,6 +594,8 @@ def fused_conv_available(dtype=jnp.bfloat16) -> bool:
 
 def pw_conv_reference(x, scale, shift, w, relu_in: bool = False):
     xn = _fold(x, scale, shift, relu_in).astype(x.dtype)
+    # plain XLA — inherits the package "highest" default (fp32 parity);
+    # the _PREC pin is for in-Mosaic-kernel dots only
     y = jnp.dot(xn, w, preferred_element_type=jnp.float32)
     st = jnp.stack([y.sum(0), (y * y).sum(0)])
     return y.astype(x.dtype), st

@@ -1,0 +1,58 @@
+"""Shared toolchain-compatibility bits for the in-tree Pallas kernels
+(flash attention, fused conv) — the single home for two hard-won axon
+findings (PROBE_BISECT.md):
+
+1. ``PRECISION``: every in-Mosaic-kernel dot must pin
+   ``precision=DEFAULT``. The package sets
+   ``jax_default_matmul_precision="highest"`` (fp32-means-fp32 for the
+   XLA paths); inside a Mosaic kernel that flag makes a bf16 matmul
+   request a multi-pass algorithm the axon tunnel's server-side
+   compiler CRASHES on ("tpu_compile_helper subprocess exit code 1").
+   DEFAULT loses nothing there: operands are explicitly bf16 (one MXU
+   pass is exact for them) and accumulation stays f32 via
+   ``preferred_element_type``.
+
+2. ``probe_with_retry``: the tunnel's remote-compile helper can also
+   crash TRANSIENTLY (observed while it was recovering from a
+   concurrent OOM'd compile, BENCH r4), and a one-shot compile-probe
+   would then pin the slow fallback for the whole process. Genuine
+   toolchain rejects are deterministic, so only failures matching the
+   tunnel-crash signature are retried — a plain lowering error (or any
+   failure on a non-TPU backend) still costs exactly one attempt.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+#: precision for every dot inside a Mosaic kernel (see module docstring)
+PRECISION = jax.lax.Precision.DEFAULT
+
+#: substrings identifying the axon remote-compile service falling over,
+#: as opposed to a deterministic Mosaic lowering reject
+_TRANSIENT_MARKERS = ("remote_compile", "tpu_compile_helper", "HTTP 500")
+
+
+def is_transient_compile_error(e: Exception) -> bool:
+    msg = str(e)
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def probe_with_retry(probe, on_fail, retry_delay_s: float = 2.0):
+    """Run ``probe()``; retry once (after ``retry_delay_s``) iff the
+    failure looks like a transient remote-compile crash. ``on_fail``
+    receives ``(exception, will_retry)`` for logging. Returns True when
+    a probe attempt succeeded."""
+    for attempt in range(2):
+        try:
+            probe()
+            return True
+        except Exception as e:
+            will_retry = attempt == 0 and is_transient_compile_error(e)
+            on_fail(e, will_retry)
+            if not will_retry:
+                return False
+            time.sleep(retry_delay_s)
+    return False

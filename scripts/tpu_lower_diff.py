@@ -1,0 +1,144 @@
+"""Client-side lowering diff: build the StableHLO (with embedded Mosaic
+payload) for the b2-d program (passed remote compile) and the b4-w0
+program (failed), WITHOUT compiling, and report whether the modules
+differ. If they are identical, the remote-compile failures are
+nondeterministic (server-side flake/load) and the fix is retry logic,
+not kernel rewrites.
+
+Usage: python scripts/tpu_lower_diff.py   # needs the tunnel for lowering
+"""
+
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+M, C = 256, 128
+
+
+def make_b2d():
+    def k(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref):
+        i = pl.program_id(1)
+        u = (x_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+             + t_ref[0:1, :])
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y = acc_ref[...]
+        y_ref[...] = y.astype(jnp.bfloat16)
+        rows = jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) + i * M
+        ym = jnp.where(rows < M, y, 0.0)
+
+        @pl.when(i == 0)
+        def _():
+            st_ref[...] = jnp.zeros_like(st_ref)
+
+        st_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+        st_ref[1:2, :] += jnp.sum(ym * ym, axis=0, keepdims=True)
+
+    return pl.pallas_call(
+        k, grid=(1, 1),
+        in_specs=[
+            pl.BlockSpec((M, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((C, C), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((M, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((8, C), lambda j, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((M, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((8, C), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((M, C), jnp.float32)],
+    )
+
+
+def make_b4w0():
+    bm, m_valid, mp = M, M, M
+
+    def _kernel(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref,
+                *, m_valid, bm):
+        i = pl.program_id(1)
+        u = x_ref[...].astype(jnp.float32) * s_ref[0:1, :] + t_ref[0:1, :]
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y = acc_ref[...]
+        y_ref[...] = y.astype(jnp.bfloat16)
+        rows = jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) + i * bm
+        ym = jnp.where(rows < m_valid, y, 0.0)
+
+        @pl.when(i == 0)
+        def _():
+            st_ref[...] = jnp.zeros_like(st_ref)
+
+        st_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+        st_ref[1:2, :] += jnp.sum(ym * ym, axis=0, keepdims=True)
+
+    return pl.pallas_call(
+        functools.partial(_kernel, m_valid=m_valid, bm=bm),
+        grid=(1, mp // bm),
+        in_specs=[
+            pl.BlockSpec((bm, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((C, C), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((8, C), lambda j, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((8, C), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, C), jnp.float32)],
+    )
+
+
+def lower_text(f):
+    shapes = [
+        jax.ShapeDtypeStruct((M, C), jnp.bfloat16),
+        jax.ShapeDtypeStruct((1, C), jnp.float32),
+        jax.ShapeDtypeStruct((1, C), jnp.float32),
+        jax.ShapeDtypeStruct((C, C), jnp.bfloat16),
+    ]
+    return jax.jit(f).lower(*shapes).as_text()
+
+
+def main():
+    a = lower_text(make_b2d())
+    b = lower_text(make_b4w0())
+    pa = "/tmp/lower_b2d.mlir"
+    pb = "/tmp/lower_b4w0.mlir"
+    with open(pa, "w") as f:
+        f.write(a)
+    with open(pb, "w") as f:
+        f.write(b)
+    print(f"b2d: {len(a)} chars -> {pa}")
+    print(f"b4w0: {len(b)} chars -> {pb}")
+    if a == b:
+        print("IDENTICAL lowering — remote compile failures are "
+              "nondeterministic (server-side)")
+    else:
+        import difflib
+        diff = list(difflib.unified_diff(a.splitlines(), b.splitlines(),
+                                         lineterm=""))
+        print(f"DIFFER: {len(diff)} diff lines; first 60:")
+        for line in diff[:60]:
+            print(line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Round-2 bisect: every construct probe in tpu_probe_bisect.py passes,
+yet the real fused kernels crash remote Mosaic. Strip the pointwise
+forward kernel down feature by feature to find the delta. Prime
+suspects (constructs the passing probes did NOT use):
+
+  a. 1-D vector reads: s_ref[0, :] -> (C,) value broadcast against
+     (M, C) — all passing probes kept everything 2-D
+  b. mixed-dtype multi-output (bf16 y + f32 stats in one pallas_call)
+  c. the f32 fold (x.astype(f32) * s + t, relu) feeding a bf16 matmul
+     operand via .astype(bf16)
+
+Usage:  python scripts/tpu_probe_bisect2.py     # tunnel must be up
+Appends findings to PROBE_BISECT.md.
+"""
+
+import functools
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+RESULTS = []
+
+
+def probe(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        RESULTS.append((name, "OK", "", time.time() - t0))
+        print(f"[OK]   {name}", flush=True)
+    except Exception as e:
+        first = str(e).split("\n", 1)[0][:200]
+        RESULTS.append((name, "FAIL", f"{type(e).__name__}: {first}",
+                        time.time() - t0))
+        print(f"[FAIL] {name}: {type(e).__name__}: {first}", flush=True)
+
+
+rng = np.random.default_rng(0)
+M, C = 256, 128
+X = jnp.asarray(rng.standard_normal((M, C)), jnp.bfloat16)
+S = jnp.asarray(rng.standard_normal((1, C)) * 0.2 + 1.0, jnp.float32)
+T = jnp.asarray(rng.standard_normal((1, C)) * 0.1, jnp.float32)
+W = jnp.asarray(rng.standard_normal((C, C)) * 0.05, jnp.bfloat16)
+
+
+def _ref(relu=True, vec1d=False):
+    u = np.asarray(X, np.float32) * np.asarray(S) + np.asarray(T)
+    if relu:
+        u = np.maximum(u, 0)
+    u = np.asarray(jnp.asarray(u, jnp.bfloat16), np.float32)
+    return u @ np.asarray(W, np.float32)
+
+
+def _check(y, ref, tol=1.0):
+    err = np.max(np.abs(np.asarray(y, np.float32) - ref))
+    assert np.isfinite(err) and err < tol, f"value err {err}"
+
+
+def _call(kernel, n_out, out_dtypes, scratch=True):
+    out_specs = [pl.BlockSpec((M, C), lambda j, i: (i, 0)),
+                 pl.BlockSpec((8, C), lambda j, i: (0, 0))][:n_out]
+    out_shape = [jax.ShapeDtypeStruct(s, d) for s, d in
+                 zip([(M, C), (8, C)][:n_out], out_dtypes[:n_out])]
+    if n_out == 1:
+        out_specs, out_shape = out_specs[0], out_shape[0]
+    f = pl.pallas_call(
+        kernel, grid=(1, 1),
+        in_specs=[
+            pl.BlockSpec((M, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((C, C), lambda j, i: (0, 0)),
+        ],
+        out_specs=out_specs, out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((M, C), jnp.float32)] if scratch else [],
+    )
+    args = (X, S, T, W)
+    shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args]
+    return jax.jit(f).lower(*shapes).compile()(*args)
+
+
+def a_vec1d_read():
+    # ONLY delta vs passing p05: scale/shift read as 1-D s_ref[0, :]
+    def k(x_ref, s_ref, t_ref, w_ref, y_ref, acc_ref):
+        u = x_ref[...].astype(jnp.float32) * s_ref[0, :] + t_ref[0, :]
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y_ref[...] = acc_ref[...].astype(jnp.bfloat16)
+
+    y = _call(k, 1, (jnp.bfloat16, None))
+    _check(y, _ref(), tol=4.0)
+
+
+def b_vec2d_read():
+    # same kernel, scale/shift kept 2-D (1, C) — the proposed fix
+    def k(x_ref, s_ref, t_ref, w_ref, y_ref, acc_ref):
+        u = (x_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+             + t_ref[0:1, :])
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y_ref[...] = acc_ref[...].astype(jnp.bfloat16)
+
+    y = _call(k, 1, (jnp.bfloat16, None))
+    _check(y, _ref(), tol=4.0)
+
+
+def c_mixed_dtype_two_outputs():
+    # 2-D folds + bf16 y + f32 stats (mixed-dtype multi-output)
+    def k(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref):
+        i = pl.program_id(1)
+        u = (x_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+             + t_ref[0:1, :])
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y = acc_ref[...]
+        y_ref[...] = y.astype(jnp.bfloat16)
+
+        @pl.when(i == 0)
+        def _():
+            st_ref[...] = jnp.zeros_like(st_ref)
+
+        st_ref[0:1, :] += jnp.sum(y, axis=0, keepdims=True)
+        st_ref[1:2, :] += jnp.sum(y * y, axis=0, keepdims=True)
+
+    y, st = _call(k, 2, (jnp.bfloat16, jnp.float32))
+    ref = _ref()
+    _check(y, ref, tol=4.0)
+    _check(st[0:1], ref.sum(0, keepdims=True), tol=4.0 + 0.02 * M)
+
+
+def d_iota_plus_all():
+    # c + the m_valid iota mask — everything the real kernel does
+    def k(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref):
+        i = pl.program_id(1)
+        u = (x_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+             + t_ref[0:1, :])
+        u = jnp.maximum(u, 0.0)
+        acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                               preferred_element_type=jnp.float32)
+        y = acc_ref[...]
+        y_ref[...] = y.astype(jnp.bfloat16)
+        rows = jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) + i * M
+        ym = jnp.where(rows < M, y, 0.0)
+
+        @pl.when(i == 0)
+        def _():
+            st_ref[...] = jnp.zeros_like(st_ref)
+
+        st_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+        st_ref[1:2, :] += jnp.sum(ym * ym, axis=0, keepdims=True)
+
+    y, st = _call(k, 2, (jnp.bfloat16, jnp.float32))
+    _check(y, _ref(), tol=4.0)
+
+
+def main():
+    devs = jax.devices()
+    print(f"backend: {devs[0].platform} {devs}", flush=True)
+    for name, fn in [
+        ("b2-a 1-D vector read s_ref[0, :] broadcast", a_vec1d_read),
+        ("b2-b 2-D (1,C) fold (proposed fix)", b_vec2d_read),
+        ("b2-c mixed-dtype two outputs (bf16 y + f32 st)",
+         c_mixed_dtype_two_outputs),
+        ("b2-d full pw semantics, 2-D folds", d_iota_plus_all),
+    ]:
+        probe(name, fn)
+
+    with open(os.path.join("/root/repo", "PROBE_BISECT.md"), "a") as f:
+        f.write("\nRound 2 (in-kernel deltas of the real pw kernel):\n\n")
+        f.write("| probe | result | detail |\n|---|---|---|\n")
+        for name, status, detail, dt in RESULTS:
+            f.write(f"| {name} | {status} ({dt:.1f}s) | {detail} |\n")
+    print("appended to PROBE_BISECT.md", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)

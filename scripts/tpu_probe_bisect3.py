@@ -1,0 +1,173 @@
+"""Round-3 bisect: b2-d reproduced the FULL pointwise kernel semantics
+and passed, yet the real `_pw_forward` (p12) crashes remote Mosaic. The
+remaining deltas are now tiny; this script copies `_pw_forward`
+verbatim and mutates ONE thing per probe:
+
+  v0  exact repro of p12 (expected FAIL — the control)
+  v1  drop the unused `j = pl.program_id(0)` read
+  v2  out_specs/out_shape passed as tuples instead of lists
+  v3  m=192: forces real jnp.pad around the call (padding interplay)
+  v4  m=512: grid (1, 2) so the accumulator is actually revisited
+
+Usage:  python scripts/tpu_probe_bisect3.py     # tunnel must be up
+Appends findings to PROBE_BISECT.md.
+"""
+
+import functools
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.nn.ops import fused_conv as fc
+
+RESULTS = []
+
+
+def probe(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        RESULTS.append((name, "OK", "", time.time() - t0))
+        print(f"[OK]   {name}", flush=True)
+    except Exception as e:
+        first = str(e).split("\n", 1)[0][:200]
+        RESULTS.append((name, "FAIL", f"{type(e).__name__}: {first}",
+                        time.time() - t0))
+        print(f"[FAIL] {name}: {type(e).__name__}: {first}", flush=True)
+
+
+rng = np.random.default_rng(0)
+
+
+def _kernel(read_pid0, x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref,
+            *, relu_in, m_valid, bm, fold2d=False):
+    if read_pid0:
+        j, i = pl.program_id(0), pl.program_id(1)
+    else:
+        i = pl.program_id(1)
+    if fold2d:
+        xn = (x_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+              + t_ref[0:1, :])
+        if relu_in:
+            xn = jnp.maximum(xn, 0.0)
+    else:
+        xn = fc._fold(x_ref[...], s_ref[0, :], t_ref[0, :], relu_in)
+    acc_ref[...] = jnp.dot(xn.astype(jnp.bfloat16), w_ref[...],
+                           preferred_element_type=jnp.float32)
+    y = acc_ref[...]
+    y_ref[...] = y.astype(jnp.bfloat16)
+    rows = jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) + i * bm
+    ym = jnp.where(rows < m_valid, y, 0.0)
+
+    @pl.when(i == 0)
+    def _():
+        st_ref[...] = jnp.zeros_like(st_ref)
+
+    st_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+    st_ref[1:2, :] += jnp.sum(ym * ym, axis=0, keepdims=True)
+
+
+def _forward(x, scale, shift, w, relu_in, read_pid0=True, tuples=False,
+             interp_kw=False, fold2d=False):
+    # verbatim _pw_forward with the named mutations
+    m, cin, cout, mp, cinp, coutp = fc._pw_shapes(x, w)
+    bm = min(mp, 512)
+    mp = fc._round_up(mp, bm)
+    xp = fc._pad_axis(fc._pad_axis(x, 0, mp), 1, cinp)
+    wp = fc._pad_axis(fc._pad_axis(w, 0, cinp), 1, coutp)
+    sp = fc._pad_axis(scale.reshape(1, -1), 1, cinp)
+    tp = fc._pad_axis(shift.reshape(1, -1), 1, cinp)
+    grid = (1, mp // bm)
+    in_specs = [
+        pl.BlockSpec((bm, cinp), lambda j, i: (i, 0)),
+        pl.BlockSpec((1, cinp), lambda j, i: (0, 0)),
+        pl.BlockSpec((1, cinp), lambda j, i: (0, 0)),
+        pl.BlockSpec((cinp, coutp), lambda j, i: (0, 0)),
+    ]
+    out_specs = [
+        pl.BlockSpec((bm, coutp), lambda j, i: (i, 0)),
+        pl.BlockSpec((fc.SUBLANE_F32, coutp), lambda j, i: (0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((mp, coutp), jnp.bfloat16),
+        jax.ShapeDtypeStruct((fc.SUBLANE_F32, coutp), jnp.float32),
+    ]
+    if tuples:
+        in_specs, out_specs, out_shape = (
+            tuple(in_specs), tuple(out_specs), tuple(out_shape))
+    kw = {"interpret": False} if interp_kw else {}
+    y, st = pl.pallas_call(
+        functools.partial(_kernel, read_pid0, relu_in=relu_in, m_valid=m,
+                          bm=bm, fold2d=fold2d),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((bm, coutp), jnp.float32)],
+        **kw,
+    )(xp, sp, tp, wp)
+    return y[:m, :cout], st[:2, :cout]
+
+
+def _drive(m=256, **kw):
+    x = jnp.asarray(rng.standard_normal((m, 128)), jnp.bfloat16)
+    s = jnp.asarray(rng.standard_normal(128) * 0.2 + 1.0, jnp.float32)
+    t = jnp.asarray(rng.standard_normal(128) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, jnp.bfloat16)
+    y, st = jax.jit(
+        lambda *a: _forward(*a, True, **kw)).lower(x, s, t, w).compile()(
+            x, s, t, w)
+    yr, str_ = fc.pw_conv_reference(x, s, t, w, relu_in=True)
+    err = np.max(np.abs(np.asarray(y, np.float32)
+                        - np.asarray(yr, np.float32)))
+    assert np.isfinite(err) and err < 1.0, f"value err {err}"
+    serr = np.max(np.abs(np.asarray(st) - np.asarray(str_))
+                  / (np.abs(np.asarray(str_)) + 1.0))
+    assert serr < 0.1, f"stats err {serr}"
+
+
+def main():
+    devs = jax.devices()
+    print(f"backend: {devs[0].platform} {devs}", flush=True)
+    for name, fn in [
+        ("b3-v0 exact p12 repro (control)", lambda: _drive()),
+        ("b3-v1 without unused program_id(0)",
+         lambda: _drive(read_pid0=False)),
+        ("b3-v2 tuple specs instead of lists",
+         lambda: _drive(tuples=True)),
+        ("b3-v3 m=192 (jnp.pad wrap)", lambda: _drive(m=192)),
+        ("b3-v4 m=1024 (grid (1,2), revisited st)",
+         lambda: _drive(m=1024)),
+        ("b3-v5 explicit interpret=False kwarg",
+         lambda: _drive(interp_kw=True)),
+        ("b3-v6 2-D (1,C) fold in the exact kernel",
+         lambda: _drive(fold2d=True)),
+        ("b3-v7 2-D fold at m=1024 (grid (1,2))",
+         lambda: _drive(m=1024, fold2d=True)),
+    ]:
+        probe(name, fn)
+
+    with open(os.path.join("/root/repo", "PROBE_BISECT.md"), "a") as f:
+        f.write("\nRound 3 (verbatim _pw_forward, one mutation each):\n\n")
+        f.write("| probe | result | detail |\n|---|---|---|\n")
+        for name, status, detail, dt in RESULTS:
+            f.write(f"| {name} | {status} ({dt:.1f}s) | {detail} |\n")
+    print("appended to PROBE_BISECT.md", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)

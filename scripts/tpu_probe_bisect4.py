@@ -1,0 +1,203 @@
+"""Round-4 bisect: the b2-d KERNEL passes when pallas_call is the whole
+jitted program, but the same kernel inside `_pw_forward`'s wrapper
+(b3-v6) crashes the remote compile. So the crash is provoked by the XLA
+ops AROUND the custom call, not the Mosaic kernel itself. Mutate the
+wrapper one op at a time around the known-good kernel:
+
+  w0  bare pallas_call, pre-shaped args        (b2-d repro — expect OK)
+  w1  + scale/shift passed 1-D, reshape(1,-1) inside the jit
+  w2  + output slicing y[:m, :cout], st[:2, :cout]
+  w3  + input padding path exercised (m=192 -> jnp.pad)
+  w4  everything (= _pw_forward shape) — expect FAIL (control)
+
+Usage:  python scripts/tpu_probe_bisect4.py     # tunnel must be up
+Appends findings to PROBE_BISECT.md.
+"""
+
+import functools
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.nn.ops import fused_conv as fc
+
+RESULTS = []
+
+
+def probe(name, fn):
+    t0 = time.time()
+    try:
+        fn()
+        RESULTS.append((name, "OK", "", time.time() - t0))
+        print(f"[OK]   {name}", flush=True)
+    except Exception as e:
+        first = str(e).split("\n", 1)[0][:200]
+        RESULTS.append((name, "FAIL", f"{type(e).__name__}: {first}",
+                        time.time() - t0))
+        print(f"[FAIL] {name}: {type(e).__name__}: {first}", flush=True)
+
+
+rng = np.random.default_rng(0)
+C = 128
+
+
+def _kernel(x_ref, s_ref, t_ref, w_ref, y_ref, st_ref, acc_ref,
+            *, m_valid, bm):
+    i = pl.program_id(1)
+    u = x_ref[...].astype(jnp.float32) * s_ref[0:1, :] + t_ref[0:1, :]
+    u = jnp.maximum(u, 0.0)
+    acc_ref[...] = jnp.dot(u.astype(jnp.bfloat16), w_ref[...],
+                           preferred_element_type=jnp.float32)
+    y = acc_ref[...]
+    y_ref[...] = y.astype(jnp.bfloat16)
+    rows = jax.lax.broadcasted_iota(jnp.int32, y.shape, 0) + i * bm
+    ym = jnp.where(rows < m_valid, y, 0.0)
+
+    @pl.when(i == 0)
+    def _():
+        st_ref[...] = jnp.zeros_like(st_ref)
+
+    st_ref[0:1, :] += jnp.sum(ym, axis=0, keepdims=True)
+    st_ref[1:2, :] += jnp.sum(ym * ym, axis=0, keepdims=True)
+
+
+def _pcall(m_valid, mp, bm):
+    return pl.pallas_call(
+        functools.partial(_kernel, m_valid=m_valid, bm=bm),
+        grid=(1, mp // bm),
+        in_specs=[
+            pl.BlockSpec((bm, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((1, C), lambda j, i: (0, 0)),
+            pl.BlockSpec((C, C), lambda j, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, C), lambda j, i: (i, 0)),
+            pl.BlockSpec((8, C), lambda j, i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((mp, C), jnp.bfloat16),
+            jax.ShapeDtypeStruct((8, C), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bm, C), jnp.float32)],
+    )
+
+
+def _args(m):
+    x = jnp.asarray(rng.standard_normal((m, C)), jnp.bfloat16)
+    s = jnp.asarray(rng.standard_normal(C) * 0.2 + 1.0, jnp.float32)
+    t = jnp.asarray(rng.standard_normal(C) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((C, C)) * 0.05, jnp.bfloat16)
+    return x, s, t, w
+
+
+def _verify(y, st, x, s, t, w, m):
+    yr, str_ = fc.pw_conv_reference(x, s, t, w, relu_in=True)
+    err = np.max(np.abs(np.asarray(y, np.float32)[:m]
+                        - np.asarray(yr, np.float32)))
+    assert np.isfinite(err) and err < 1.0, f"value err {err}"
+
+
+def w0_bare():
+    m = 256
+    x, s, t, w = _args(m)
+    f = _pcall(m, m, m)
+    y, st = jax.jit(f).lower(
+        jax.ShapeDtypeStruct((m, C), jnp.bfloat16),
+        jax.ShapeDtypeStruct((1, C), jnp.float32),
+        jax.ShapeDtypeStruct((1, C), jnp.float32),
+        jax.ShapeDtypeStruct((C, C), jnp.bfloat16),
+    ).compile()(x, s.reshape(1, -1), t.reshape(1, -1), w)
+    _verify(y, st, x, s, t, w, m)
+
+
+def w1_reshape_inside():
+    m = 256
+    x, s, t, w = _args(m)
+
+    def g(x, s, t, w):
+        return _pcall(m, m, m)(x, s.reshape(1, -1), t.reshape(1, -1), w)
+
+    y, st = jax.jit(g).lower(x, s, t, w).compile()(x, s, t, w)
+    _verify(y, st, x, s, t, w, m)
+
+
+def w2_output_slice():
+    m = 256
+    x, s, t, w = _args(m)
+
+    def g(x, s, t, w):
+        y, st = _pcall(m, m, m)(x, s, t, w)
+        return y[:m, :C], st[:2, :C]
+
+    y, st = jax.jit(g).lower(
+        x, jnp.asarray(s.reshape(1, -1)), jnp.asarray(t.reshape(1, -1)),
+        w).compile()(x, s.reshape(1, -1), t.reshape(1, -1), w)
+    _verify(y, st, x, s, t, w, m)
+
+
+def w3_padded_input():
+    m = 192
+    mp = 256
+    x, s, t, w = _args(m)
+
+    def g(x, s, t, w):
+        xp = fc._pad_axis(x, 0, mp)
+        return _pcall(m, mp, mp)(xp, s, t, w)
+
+    y, st = jax.jit(g).lower(
+        x, jnp.asarray(s.reshape(1, -1)), jnp.asarray(t.reshape(1, -1)),
+        w).compile()(x, s.reshape(1, -1), t.reshape(1, -1), w)
+    _verify(y, st, x, s, t, w, m)
+
+
+def w4_everything():
+    m = 192
+    mp = 256
+    x, s, t, w = _args(m)
+
+    def g(x, s, t, w):
+        xp = fc._pad_axis(x, 0, mp)
+        y, st = _pcall(m, mp, mp)(xp, s.reshape(1, -1), t.reshape(1, -1), w)
+        return y[:m, :C], st[:2, :C]
+
+    y, st = jax.jit(g).lower(x, s, t, w).compile()(x, s, t, w)
+    _verify(y, st, x, s, t, w, m)
+
+
+def main():
+    devs = jax.devices()
+    print(f"backend: {devs[0].platform} {devs}", flush=True)
+    for name, fn in [
+        ("b4-w0 bare pallas_call (b2-d repro)", w0_bare),
+        ("b4-w1 scale reshape(1,-1) inside jit", w1_reshape_inside),
+        ("b4-w2 output slicing after the call", w2_output_slice),
+        ("b4-w3 jnp.pad on the input", w3_padded_input),
+        ("b4-w4 pad + reshape + slice (full wrapper)", w4_everything),
+    ]:
+        probe(name, fn)
+
+    with open(os.path.join("/root/repo", "PROBE_BISECT.md"), "a") as f:
+        f.write("\nRound 4 (wrapper-op bisect around the passing kernel):\n\n")
+        f.write("| probe | result | detail |\n|---|---|---|\n")
+        for name, status, detail, dt in RESULTS:
+            f.write(f"| {name} | {status} ({dt:.1f}s) | {detail} |\n")
+    print("appended to PROBE_BISECT.md", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)
