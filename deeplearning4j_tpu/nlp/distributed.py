@@ -40,9 +40,10 @@ def shard_sequences(seqs: Sequence, num_shards: int, shard_index: int) -> List:
 class DistributedSequenceVectors:
     """Parameter-averaging wrapper around any :class:`SequenceVectors`
     trained via ``fit_sequences`` (Word2Vec and DeepWalk route here
-    automatically; ParagraphVectors' doc-id loop drives the per-batch
-    kernels directly and is single-process — per-document rows are owned
-    by one process and must not be mean-averaged).
+    automatically; ParagraphVectors routes through
+    :class:`DistributedParagraphVectors`, which shards DOCUMENTS and
+    combines per-document label rows by ownership instead of a plain
+    mean).
 
     ``averaging_frequency`` counts epochs between synchronizations
     (reference ParameterAveragingTrainingMaster knob; 1 = every epoch).
@@ -134,5 +135,130 @@ class DistributedSequenceVectors:
         if synced_at[0] != self.vectors.epochs - 1:
             # the run must END synchronized even when epochs isn't a
             # multiple of averaging_frequency — replicas always agree
+            self.synchronize()
+        return self
+
+
+class DistributedParagraphVectors:
+    """Multi-process doc2vec (the reference's Spark ParagraphVectors
+    capability, ``dl4j-spark-nlp`` ``.../paragraphvectors/`` — trained
+    there via map-partitions workers over a broadcast vocabulary).
+
+    Sharding unit is the DOCUMENT (round-robin over the identical
+    full-corpus list every process builds). Synchronization at epoch
+    boundaries differs from the word2vec trainer in one way that matters:
+
+    - WORD rows (``syn0[:V]``) and output embeddings (``syn1neg``) are
+      parameter-averaged — every shard trains them;
+    - LABEL rows (``syn0[V:]``) are combined by OWNERSHIP weight (how
+      many of each process's documents carry that label): a label trained
+      on exactly one process keeps that process's row bit-exactly, and a
+      plain mean would shrink it toward other replicas' untouched random
+      init. Rows nobody owns fall back to the (identical-everywhere)
+      mean.
+
+    All replicas end bit-identical after every synchronize() — the
+    combine is computed from the same gathered operands on every process.
+    """
+
+    def __init__(self, pv, num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 averaging_frequency: int = 1):
+        self.pv = pv
+        self.num_processes = (jax.process_count() if num_processes is None
+                              else int(num_processes))
+        self.process_id = (jax.process_index() if process_id is None
+                           else int(process_id))
+        self.averaging_frequency = max(int(averaging_frequency), 1)
+        self.sync_count = 0
+
+    def synchronize(self) -> None:
+        if self.num_processes <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        pv, sv = self.pv, self.pv.sv
+        V = pv._n_words
+        syn0 = np.asarray(sv.syn0, np.float32)
+        g0 = np.asarray(multihost_utils.process_allgather(syn0))  # (P,V+L,D)
+        words = np.mean(g0[:, :V], axis=0, dtype=np.float32)
+        n_labels = syn0.shape[0] - V
+        if n_labels:
+            w = np.asarray(pv._owned_label_counts, np.float32)
+            gw = np.asarray(multihost_utils.process_allgather(w))  # (P, L)
+            tot = gw.sum(axis=0)
+            weighted = np.einsum("pl,pld->ld", gw,
+                                 g0[:, V:].astype(np.float32))
+            mean_all = np.mean(g0[:, V:], axis=0, dtype=np.float32)
+            lab = np.where(tot[:, None] > 0,
+                           weighted / np.maximum(tot[:, None], 1e-9),
+                           mean_all)
+            new0 = np.concatenate([words, lab.astype(np.float32)], axis=0)
+        else:
+            new0 = words
+        sv.syn0 = jnp.asarray(new0)
+        if sv.negative > 0:
+            g1 = multihost_utils.process_allgather(
+                np.asarray(sv.syn1neg, np.float32))
+            sv.syn1neg = jnp.asarray(
+                np.mean(np.asarray(g1), axis=0, dtype=np.float32))
+        if sv.use_hs:
+            g2 = multihost_utils.process_allgather(
+                np.asarray(sv.syn1, np.float32))
+            sv.syn1 = jnp.asarray(
+                np.mean(np.asarray(g2), axis=0, dtype=np.float32))
+        self.sync_count += 1
+
+    def _check_corpus_agreement(self, docs) -> None:
+        """Same invariant as the word2vec trainer: every process must
+        hold the identical full labelled corpus (sharding happens inside
+        this trainer)."""
+        if self.num_processes <= 1 or jax.process_count() <= 1:
+            return
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        h = hashlib.sha256()
+        for content, labels in docs:
+            # length-prefixed fields: delimiter characters inside content
+            # or labels must not make distinct corpora hash equal
+            c = content.encode()
+            h.update(f"{len(c)}:".encode() + c)
+            for l in labels:
+                lb = l.encode()
+                h.update(f"{len(lb)}:".encode() + lb)
+            h.update(b"|")
+        digest = np.frombuffer(h.digest()[:8], np.int32)
+        gathered = multihost_utils.process_allgather(digest)
+        if not np.all(np.asarray(gathered) == digest):
+            raise ValueError(
+                "DistributedParagraphVectors: processes disagree on the "
+                "labelled corpus. Every process must construct the "
+                "IDENTICAL full document list (sharding happens inside "
+                "this trainer).")
+
+    def fit(self) -> "DistributedParagraphVectors":
+        pv = self.pv
+        if self.num_processes > 1:
+            docs = [(d.content, d.labels) for d in pv._b._iter]
+            self._check_corpus_agreement(docs)
+        pv._doc_shard = (self.num_processes, self.process_id)
+        synced_at = [-1]
+
+        def on_epoch_end(epoch):
+            if (epoch + 1) % self.averaging_frequency == 0:
+                self.synchronize()
+                synced_at[0] = epoch
+
+        pv._on_epoch_end = on_epoch_end
+        try:
+            # distributed=False: this wrapper IS the distributed path —
+            # pv.fit must run the (sharded) local loop, not re-route
+            pv.fit(distributed=False)
+        finally:
+            pv._on_epoch_end = None
+            pv._doc_shard = (1, 0)
+        if synced_at[0] != pv.sv.epochs - 1:
             self.synchronize()
         return self

@@ -121,11 +121,31 @@ class ParagraphVectors:
         self.sv: Optional[SequenceVectors] = None
         self.label_index: Dict[str, int] = {}
         self._n_words = 0
+        # document sharding (set by nlp.distributed.DistributedParagraphVectors;
+        # (1, 0) = train every document locally) + epoch-boundary hook for
+        # cross-process parameter synchronization
+        self._doc_shard: Tuple[int, int] = (1, 0)
+        self._on_epoch_end = None
+        self._owned_label_counts: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------- fit
-    def fit(self) -> "ParagraphVectors":
+    def fit(self, distributed="auto") -> "ParagraphVectors":
+        """``distributed="auto"`` (default): under a multi-process
+        jax.distributed run, route through
+        nlp.distributed.DistributedParagraphVectors (capability match for
+        the reference's Spark ParagraphVectors, dl4j-spark-nlp) — the
+        same auto-route Word2Vec has. Pass ``distributed=False`` to force
+        a purely local fit (each process trains its own independent
+        model)."""
         b = self._b
         assert b._iter is not None, "Builder.iterate(...) required"
+        if distributed == "auto" and jax.process_count() > 1:
+            from deeplearning4j_tpu.nlp.distributed import (
+                DistributedParagraphVectors,
+            )
+
+            DistributedParagraphVectors(self).fit()
+            return self
         docs = [(d.content, d.labels) for d in b._iter]
         streams = [self._tok.create(c).get_tokens() for c, _ in docs]
         self.vocab = VocabConstructor(
@@ -145,6 +165,17 @@ class ParagraphVectors:
         # counts for the extended table: labels never get sampled as
         # negatives (zero count ⇒ zero probability mass in the cdf)
         ext_vocab = _ExtendedVocab(self.vocab, labels)
+
+        # per-label ownership weights under document sharding: how many of
+        # THIS shard's documents carry each label. The distributed trainer
+        # combines label rows by these weights (a label's row comes from
+        # the process(es) that actually trained it; word rows are plain
+        # parameter-averaged).
+        counts = np.zeros(len(labels), np.float64)
+        for _, ls in self._shard_owned(docs):
+            for l in ls:
+                counts[self.label_index[l] - V] += 1
+        self._owned_label_counts = counts
 
         self.sv = SequenceVectors(
             ext_vocab,
@@ -174,42 +205,72 @@ class ParagraphVectors:
             out.append(np.asarray([i for i in ids if i >= 0], np.int32))
         return out
 
+    def _shard_owned(self, items):
+        """The items of ``items`` this process owns under ``_doc_shard``
+        — the ONE definition of document ownership (round-robin by
+        index, same policy as nlp.distributed.shard_sequences); the
+        label-weight computation and both fit loops must agree on it."""
+        nsh, sh = self._doc_shard
+        return [it for di, it in enumerate(items) if di % nsh == sh]
+
+    @staticmethod
+    def _doc_chunks(n_items: int, n: int = 8):
+        """Index slices splitting one document into up to ``n`` kernel
+        calls. The reference applies one SEQUENTIAL update per
+        (label, word) pair (``DBOW.java``/``DM.java`` drive SkipGram/CBOW
+        pair-at-a-time); the batched kernels' duplicate-row mean
+        (``kernels._dup_scale``) would otherwise collapse the whole
+        document — whose rows all share the label index — into ONE
+        effective step for the label row, undertraining doc vectors by a
+        factor of the document length. Up to ``n`` chunked calls restore
+        ~``n`` sequential mean-steps per pass, matching the reference's
+        learning speed to within a constant while keeping every step a
+        stable batched mean."""
+        k = max(1, min(n, n_items))
+        bounds = np.linspace(0, n_items, k + 1, dtype=int)
+        return [slice(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:])
+                if hi > lo]
+
     def _fit_dbow(self, docs, streams):
         """PV-DBOW: (doc_id → each word) skip-gram pairs (reference
         ``DBOW.java``); optionally plain word skip-gram too
         (train_words)."""
         sv = self.sv
-        id_seqs = self._doc_ids(streams)
-        total = sum(len(s) for s in id_seqs)
+        owned = self._shard_owned(list(zip(docs, self._doc_ids(streams))))
+        total = sum(len(ids) for _, ids in owned)
         total_span = max(total * sv.epochs * sv.iterations, 1)
         processed = 0
-        for _ in range(sv.epochs):
+        for epoch in range(sv.epochs):
             for _ in range(sv.iterations):
-                for (content, labels), ids in zip(docs, id_seqs):
+                for (content, labels), ids in owned:
                     if len(ids) == 0:
                         continue
                     processed += len(ids)
                     lr = sv._lr(processed, total_span)
                     for label in labels:
                         li = self.label_index[label]
-                        centers = np.full(len(ids), li, np.int32)
-                        sv._run_skipgram(centers, ids, lr)
+                        for sl in self._doc_chunks(len(ids)):
+                            seg = ids[sl]
+                            centers = np.full(len(seg), li, np.int32)
+                            sv._run_skipgram(centers, seg, lr)
                     if self._b._train_words:
                         c, x = sv._skipgram_pairs(ids)
                         if len(c):
                             sv._run_skipgram(c, x, lr)
+            if self._on_epoch_end is not None:
+                self._on_epoch_end(epoch)
 
     def _fit_dm(self, docs, streams):
         """PV-DM: CBOW windows with the doc id appended to every context
         (reference ``DM.java``)."""
         sv = self.sv
-        id_seqs = self._doc_ids(streams)
-        total = sum(len(s) for s in id_seqs)
+        owned = self._shard_owned(list(zip(docs, self._doc_ids(streams))))
+        total = sum(len(ids) for _, ids in owned)
         total_span = max(total * sv.epochs * sv.iterations, 1)
         processed = 0
-        for _ in range(sv.epochs):
+        for epoch in range(sv.epochs):
             for _ in range(sv.iterations):
-                for (content, labels), ids in zip(docs, id_seqs):
+                for (content, labels), ids in owned:
                     if len(ids) < 2:
                         continue
                     processed += len(ids)
@@ -219,11 +280,15 @@ class ParagraphVectors:
                         li = self.label_index[label]
                         lcol = np.full((ctx.shape[0], 1), li, np.int32)
                         mcol = np.ones((ctx.shape[0], 1), np.float32)
-                        sv._run_cbow_padded(
-                            np.concatenate([ctx, lcol], 1),
-                            np.concatenate([cm, mcol], 1),
-                            tg, lr,
-                        )
+                        actx = np.concatenate([ctx, lcol], 1)
+                        acm = np.concatenate([cm, mcol], 1)
+                        # chunked for the same reason as DBOW: the label
+                        # id rides EVERY window, so whole-doc batching
+                        # would mean-collapse its updates to one step
+                        for sl in self._doc_chunks(len(tg)):
+                            sv._run_cbow_padded(actx[sl], acm[sl], tg[sl], lr)
+            if self._on_epoch_end is not None:
+                self._on_epoch_end(epoch)
 
     # --------------------------------------------------------------- queries
     def get_paragraph_vector(self, label: str) -> Optional[np.ndarray]:

@@ -168,8 +168,10 @@ class SequenceVectors:
         train THROUGH this method (Word2Vec, DeepWalk — whose seeded
         walks are process-identical) become multi-host without their own
         plumbing; ParagraphVectors drives the per-batch kernels directly
-        for its doc-id loop and stays single-process. Pass
-        ``distributed=False`` to force local training."""
+        for its doc-id loop and has its own document-sharded route
+        (nlp.distributed.DistributedParagraphVectors, auto-selected by
+        ``ParagraphVectors.fit``). Pass ``distributed=False`` to force
+        local training."""
         if distributed == "auto":
             distributed = jax.process_count() > 1
         if distributed:

@@ -1,0 +1,48 @@
+"""Worker for the distributed-ParagraphVectors parity test (capability
+match for the reference's Spark ParagraphVectors,
+``dl4j-spark-nlp/.../paragraphvectors/``): each process builds the SAME
+labelled corpus, trains doc2vec on its document shard, and synchronizes
+at epoch boundaries — word rows parameter-averaged, label rows combined
+by document ownership. ``pv.fit()`` is called directly: the auto-route
+through DistributedParagraphVectors when ``jax.process_count() > 1`` is
+part of what this worker proves.
+
+Usage: python multihost_pv_worker.py <coordinator> <nprocs> <pid> <outdir>
+"""
+
+import os
+import sys
+
+coordinator, nprocs, pid, outdir = (
+    sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.parallel.multihost import initialize  # noqa: E402
+from tests.pv_corpus import build_docs, build_pv  # noqa: E402
+
+ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
+assert jax.process_count() == nprocs
+
+docs = build_docs()
+pv = build_pv(docs).fit()  # auto-routes: process_count > 1
+
+V = pv._n_words
+labels = [f"DOC_{i}" for i in range(len(docs))]
+label_vecs = np.stack([pv.get_paragraph_vector(l) for l in labels])
+syn0 = np.asarray(pv.sv.syn0)
+
+suffix = "" if pid == 0 else f"_{pid}"
+np.savez(os.path.join(outdir, f"pv_dist{suffix}.npz"),
+         syn0=syn0, label_vecs=label_vecs, n_words=V)
+print(f"pv worker {pid}: done, V={V}", flush=True)

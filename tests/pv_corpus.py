@@ -1,0 +1,57 @@
+"""Shared synthetic labelled corpus for the distributed-ParagraphVectors
+parity test: documents drawn from two disjoint word topics, so any
+correct doc2vec run embeds same-topic documents far closer than
+cross-topic ones. Deterministic — every process builds the identical
+document list (the broadcast-corpus invariant of the reference's Spark
+ParagraphVectors / TextPipeline)."""
+
+import numpy as np
+
+WORDS_A = [f"fruit{i}" for i in range(8)]
+WORDS_B = [f"metal{i}" for i in range(8)]
+N_DOCS = 24
+DOC_LEN = 60
+
+
+def build_docs():
+    rng = np.random.default_rng(7)
+    docs = []
+    for i in range(N_DOCS):
+        # parity-interleaved topics: round-robin doc sharding still hands
+        # every process a balanced mix of both topics
+        topic = WORDS_A if i % 2 == 0 else WORDS_B
+        content = " ".join(rng.choice(topic, DOC_LEN))
+        docs.append((content, [f"DOC_{i}"]))
+    return docs
+
+
+def doc_topic_separation(label_vecs: np.ndarray) -> float:
+    """mean(in-topic doc cosine) - mean(cross-topic doc cosine) where doc
+    i's topic is i % 2; strongly positive for any successful run."""
+    m = label_vecs / np.maximum(
+        np.linalg.norm(label_vecs, axis=1, keepdims=True), 1e-9)
+    sim = m @ m.T
+    a = np.arange(0, N_DOCS, 2)
+    b = np.arange(1, N_DOCS, 2)
+    in_a = sim[np.ix_(a, a)][np.triu_indices(len(a), 1)]
+    in_b = sim[np.ix_(b, b)][np.triu_indices(len(b), 1)]
+    cross = sim[np.ix_(a, b)].ravel()
+    return float(np.concatenate([in_a, in_b]).mean() - cross.mean())
+
+
+def build_pv(docs):
+    """The one PV config both the workers and the single-process
+    reference use."""
+    from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+    return (ParagraphVectors.builder()
+            .iterate(docs)
+            .layer_size(24)
+            .window_size(3)
+            .min_word_frequency(1)
+            .epochs(10)
+            .seed(11)
+            .learning_rate(0.05)
+            .negative_sample(5)
+            .train_words_vectors(True)  # word pairs bootstrap syn1neg,
+            .build())                   # which pulls the doc rows
