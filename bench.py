@@ -307,6 +307,28 @@ def _bench_transformer(batch: int = 16, seq: int = 512, n_layers: int = 12):
     return batch * seq * iters / dt, flops, batch * seq, flops_ca
 
 
+def _bench_lm_decode(batch: int = 8, prompt: int = 128, new: int = 128):
+    """KV-cache autoregressive decode throughput (generated tokens/sec)
+    — the serving-side counterpart of the train metric (the reference's
+    serving story is ParallelInference; here single-chip generation via
+    per-layer KV caches, ``TransformerLM.generate_cached``). Greedy
+    decoding; the host sampling loop and per-step dispatch are part of
+    what's measured, as they are in real serving."""
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+    model = TransformerLM(vocab_size=32000, d_model=768, n_heads=12,
+                          n_layers=12, max_length=prompt + new + 8,
+                          compute_dtype="bfloat16").init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32000, (batch, prompt)).astype(np.int32)
+    model.generate_cached(ids, max_new=4)  # compile prefill + decode step
+    t0 = time.perf_counter()
+    out = model.generate_cached(ids, max_new=new)
+    dt = time.perf_counter() - t0
+    assert out.shape[1] == prompt + new
+    return batch * new / dt
+
+
 def _bench_allreduce(devices, mb: float = 256.0):
     """Time an all-reduce (psum) of an fp32 buffer sharded over all
     devices; returns (algo_bandwidth_GB_per_s, n_devices). Algorithmic
@@ -438,6 +460,15 @@ def main():
             extra["attention_impl"] = impls or ["no flash-eligible shapes"]
         except Exception as e:
             extra["transformer_lm_error"] = f"{type(e).__name__}: {e}"
+        if os.environ.get("BENCH_SKIP_DECODE", "0") != "1":
+            try:
+                extra["transformer_lm_decode_tokens_per_sec"] = round(
+                    _bench_lm_decode(), 1)
+                extra["transformer_lm_decode_config"] = (
+                    "d768 L12 h12 b8 prompt128 new128 bf16 KV-cache greedy")
+            except Exception as e:
+                extra["transformer_lm_decode_error"] = (
+                    f"{type(e).__name__}: {str(e)[:200]}")
         if os.environ.get("BENCH_SKIP_LONG_CONTEXT", "0") != "1":
             try:
                 extra["transformer_lm_long_ctx_tokens_per_sec"] = round(

@@ -37,6 +37,22 @@ def shard_sequences(seqs: Sequence, num_shards: int, shard_index: int) -> List:
     return [s for i, s in enumerate(seqs) if i % num_shards == shard_index]
 
 
+def _assert_digest_agreement(h, error_msg: str) -> None:
+    """All-gather a corpus fingerprint and fail loudly on any mismatch
+    (shared by both distributed trainers' corpus-agreement checks).
+    ``h`` is a fully-updated hashlib object."""
+    import numpy as _np
+
+    from jax.experimental import multihost_utils
+
+    # int32: the gather runs through jax, which truncates int64 when x64
+    # is disabled
+    digest = _np.frombuffer(h.digest()[:8], _np.int32)
+    gathered = multihost_utils.process_allgather(digest)
+    if not _np.all(_np.asarray(gathered) == digest):
+        raise ValueError(error_msg)
+
+
 class DistributedSequenceVectors:
     """Parameter-averaging wrapper around any :class:`SequenceVectors`
     trained via ``fit_sequences`` (Word2Vec and DeepWalk route here
@@ -93,8 +109,6 @@ class DistributedSequenceVectors:
             return
         import hashlib
 
-        from jax.experimental import multihost_utils
-
         h = hashlib.sha256()
         v = self.vectors.vocab
         for i in range(v.num_words()):
@@ -102,17 +116,13 @@ class DistributedSequenceVectors:
             h.update(f"{i}:{vw.word}:{vw.count};".encode())
         for s in seqs:
             h.update(np.asarray(s, np.int32).tobytes())
-        # int32: the gather runs through jax, which truncates int64
-        # when x64 is disabled
-        digest = np.frombuffer(h.digest()[:8], np.int32)
-        gathered = multihost_utils.process_allgather(digest)
-        if not np.all(np.asarray(gathered) == digest):
-            raise ValueError(
-                "DistributedSequenceVectors: processes disagree on the "
-                "corpus/vocabulary. Every process must construct the "
-                "IDENTICAL full corpus and vocab (sharding happens inside "
-                "this trainer); per-process pre-sharded data would be "
-                "silently dropped and averaged across unrelated words.")
+        _assert_digest_agreement(
+            h,
+            "DistributedSequenceVectors: processes disagree on the "
+            "corpus/vocabulary. Every process must construct the "
+            "IDENTICAL full corpus and vocab (sharding happens inside "
+            "this trainer); per-process pre-sharded data would be "
+            "silently dropped and averaged across unrelated words.")
 
     # -------------------------------------------------------------------- fit
     def fit_sequences(self, all_sequences: Iterable[np.ndarray]
@@ -217,8 +227,6 @@ class DistributedParagraphVectors:
             return
         import hashlib
 
-        from jax.experimental import multihost_utils
-
         h = hashlib.sha256()
         for content, labels in docs:
             # length-prefixed fields: delimiter characters inside content
@@ -229,14 +237,12 @@ class DistributedParagraphVectors:
                 lb = l.encode()
                 h.update(f"{len(lb)}:".encode() + lb)
             h.update(b"|")
-        digest = np.frombuffer(h.digest()[:8], np.int32)
-        gathered = multihost_utils.process_allgather(digest)
-        if not np.all(np.asarray(gathered) == digest):
-            raise ValueError(
-                "DistributedParagraphVectors: processes disagree on the "
-                "labelled corpus. Every process must construct the "
-                "IDENTICAL full document list (sharding happens inside "
-                "this trainer).")
+        _assert_digest_agreement(
+            h,
+            "DistributedParagraphVectors: processes disagree on the "
+            "labelled corpus. Every process must construct the "
+            "IDENTICAL full document list (sharding happens inside "
+            "this trainer).")
 
     def fit(self) -> "DistributedParagraphVectors":
         pv = self.pv

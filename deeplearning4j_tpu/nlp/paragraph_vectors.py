@@ -134,12 +134,15 @@ class ParagraphVectors:
         jax.distributed run, route through
         nlp.distributed.DistributedParagraphVectors (capability match for
         the reference's Spark ParagraphVectors, dl4j-spark-nlp) — the
-        same auto-route Word2Vec has. Pass ``distributed=False`` to force
-        a purely local fit (each process trains its own independent
-        model)."""
+        same auto-route Word2Vec has. ``distributed=True`` forces that
+        route; ``distributed=False`` forces a purely local fit (each
+        process trains its own independent model) — the same semantics
+        as ``SequenceVectors.fit_sequences``."""
         b = self._b
         assert b._iter is not None, "Builder.iterate(...) required"
-        if distributed == "auto" and jax.process_count() > 1:
+        if distributed == "auto":
+            distributed = jax.process_count() > 1
+        if distributed:
             from deeplearning4j_tpu.nlp.distributed import (
                 DistributedParagraphVectors,
             )

@@ -1,0 +1,69 @@
+"""ParagraphVectors (doc2vec) on labelled toy documents (reference
+dl4j-examples ``ParagraphVectorsClassifierExample``): builder → fit →
+paragraph vectors, doc similarity, and inferring a vector for UNSEEN
+text. Under a multi-process ``jax.distributed`` run, ``fit()``
+auto-routes through the document-sharded distributed trainer
+(``nlp.distributed.DistributedParagraphVectors``) unchanged."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _common import setup_platform
+
+setup_platform()
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+
+FINANCE = "market stock bond yield profit trade invest bank".split()
+HEALTH = "doctor patient clinic therapy medicine nurse health care".split()
+
+
+def make_docs(n=40, words_per_doc=50, seed=5):
+    rng = np.random.default_rng(seed)
+    docs = []
+    for k in range(n):
+        topic, name = ((FINANCE, "finance") if k % 2 == 0
+                       else (HEALTH, "health"))
+        content = " ".join(rng.choice(topic, words_per_doc))
+        docs.append((content, [f"doc_{k}", name]))
+    return docs
+
+
+def main():
+    pv = (
+        ParagraphVectors.builder()
+        .iterate(make_docs())
+        .layer_size(24)
+        .min_word_frequency(1)
+        .epochs(8)
+        .learning_rate(0.05)
+        .negative_sample(5)
+        .train_words_vectors(True)
+        .seed(7)
+        .build()
+        .fit()
+    )
+
+    same = pv.similarity("doc_0", "doc_2")    # two finance docs
+    cross = pv.similarity("doc_0", "doc_1")   # finance vs health
+    print(f"sim(finance, finance) = {same:.3f}")
+    print(f"sim(finance, health)  = {cross:.3f}")
+    assert same > cross, (same, cross)
+
+    # infer a vector for text the model never saw, classify by topic label
+    probe = "profit from the stock market and bond trade"
+    near = pv.nearest_labels(probe, n=3)
+    print(f"nearest labels to unseen text: {near}")
+    # every nearest label is on the finance side (a finance doc_{even}
+    # or the shared "finance" topic label)
+    assert all(l == "finance" or (l.startswith("doc_")
+               and int(l.split("_")[1]) % 2 == 0) for l in near), near
+
+    print("doc2vec example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""TransformerLM hardware perf sweep (run on the real TPU chip).
+
+Measures train tokens/sec (and analytic MFU, same MAC=2 convention as
+bench.py) over a grid of (seq, batch, attention-impl), toggling the
+in-tree Pallas flash kernel via DL4J_TPU_FLASH_ATTENTION so the flash /
+dense(+blocked at T>=1024) paths are compared on identical shapes.
+Emits one JSON line per config plus a final summary line; safe to rerun
+(each config is an independent jitted program).
+
+Usage: python scripts/lm_perf_sweep.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+D, V, HEADS, LAYERS = 768, 32000, 12, 12
+
+
+def measure(batch, seq, flash: bool, iters=10):
+    os.environ["DL4J_TPU_FLASH_ATTENTION"] = "1" if flash else "0"
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+    model = TransformerLM(vocab_size=V, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS, max_length=seq,
+                          compute_dtype="bfloat16").init()
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, V, (batch, seq)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tgt[:, -1] = -1
+    step = model._make_step()
+    ids_d, tgt_d = jnp.asarray(ids), jnp.asarray(tgt)
+
+    def run_one(i):
+        model.params_, model.opt_state_, model.score_ = step(
+            model.params_, model.opt_state_, ids_d, tgt_d,
+            jnp.asarray(i, jnp.int32))
+
+    run_one(0)
+    float(model.score_)  # sync: compile + first step done
+    t0 = time.perf_counter()
+    for i in range(iters):
+        run_one(i + 1)
+    float(model.score_)
+    dt = time.perf_counter() - t0
+    tps = batch * seq * iters / dt
+    # analytic matmul FLOPs (see bench._bench_transformer): fwd+bwd = 3x
+    fwd = (LAYERS * (24 * batch * seq * D * D + 4 * batch * seq * seq * D)
+           + 2 * batch * seq * D * V)
+    mfu = 100.0 * 3 * fwd * tps / (batch * seq) / (PEAK_TFLOPS * 1e12)
+    return tps, mfu
+
+
+def main():
+    global D, V, HEADS, LAYERS
+    quick = "--quick" in sys.argv
+    if "--cpu-smoke" in sys.argv:  # script-logic validation off-TPU
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        D, V, HEADS, LAYERS = 64, 256, 4, 2
+        grid = [(128, 2)]
+    elif quick:
+        grid = [(512, 16), (512, 32)]
+    else:
+        grid = [
+            (512, 8), (512, 16), (512, 32), (512, 64),
+            (1024, 8), (2048, 4),
+        ]
+    results = []
+    for seq, batch in grid:
+        for flash in (True, False):
+            label = f"T{seq} b{batch} {'flash' if flash else 'dense'}"
+            try:
+                tps, mfu = measure(batch, seq, flash)
+                rec = {"config": label, "tokens_per_sec": round(tps, 1),
+                       "mfu_pct": round(mfu, 2)}
+            except Exception as e:
+                rec = {"config": label,
+                       "error": f"{type(e).__name__}: {str(e)[:200]}"}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
+    best = max((r for r in results if "tokens_per_sec" in r),
+               key=lambda r: r["mfu_pct"], default=None)
+    print(json.dumps({"summary": "lm_perf_sweep", "best": best,
+                      "n_configs": len(results)}))
+
+
+if __name__ == "__main__":
+    main()
