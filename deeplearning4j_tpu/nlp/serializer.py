@@ -241,3 +241,124 @@ WordVectorSerializer.read_word2vec_model = staticmethod(read_word2vec_model)
 # full-model zip; the reader sniffs either flavour
 WordVectorSerializer.writeWord2VecModel = staticmethod(write_word2vec_model)
 WordVectorSerializer.readWord2VecModel = staticmethod(read_word2vec_any)
+
+
+def write_paragraph_vectors(pv, path: str) -> None:
+    """Full-model ParagraphVectors zip (reference
+    ``WordVectorSerializer.writeParagraphVectors``,
+    ``models/embeddings/loader/WordVectorSerializer.java``): word vocab,
+    the ordered label index, builder config, and all weight tables —
+    doc-vector queries AND ``infer_vector`` (which needs ``syn1neg``)
+    work after load, without refitting."""
+    import io
+    import json
+    import zipfile
+
+    b, sv = pv._b, pv.sv
+    if sv is None:
+        raise ValueError("ParagraphVectors must be fit() before writing")
+    cfg = {
+        "layer_size": b._layer_size,
+        "window": b._window,
+        "min_word_frequency": b._min_word_frequency,
+        "epochs": b._epochs,
+        "iterations": b._iterations,
+        "seed": b._seed,
+        "learning_rate": b._lr,
+        "min_learning_rate": b._min_lr,
+        "negative": b._negative,
+        "batch_size": b._batch_size,
+        "sequence_learning": b._sequence_learning,
+        "train_words": b._train_words,
+        "n_words": pv._n_words,
+    }
+    vocab = [{"word": vw.word, "count": vw.count}
+             for vw in pv.vocab.vocab_words()]
+    labels = [l for l, _ in sorted(pv.label_index.items(),
+                                   key=lambda kv: kv[1])]
+
+    def npy_bytes(a):
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(a, np.float32))
+        return buf.getvalue()
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(cfg))
+        z.writestr("vocab.json", json.dumps(vocab))
+        z.writestr("labels.json", json.dumps(labels))
+        z.writestr("syn0.npy", npy_bytes(sv.syn0))
+        z.writestr("syn1.npy", npy_bytes(sv.syn1))
+        z.writestr("syn1neg.npy", npy_bytes(sv.syn1neg))
+
+
+def read_paragraph_vectors(path: str):
+    """Inverse of :func:`write_paragraph_vectors`: a ParagraphVectors
+    whose queries (get_paragraph_vector / similarity / infer_vector /
+    nearest_labels) reproduce the saved model exactly."""
+    import io
+    import json
+    import zipfile
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nlp.paragraph_vectors import (
+        ParagraphVectors,
+        _ExtendedVocab,
+    )
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    from deeplearning4j_tpu.nlp.vocab import AbstractCache, VocabWord
+
+    with zipfile.ZipFile(path, "r") as z:
+        cfg = json.loads(z.read("config.json"))
+        vocab_entries = json.loads(z.read("vocab.json"))
+        labels = json.loads(z.read("labels.json"))
+        syn0 = np.load(io.BytesIO(z.read("syn0.npy")))
+        syn1 = np.load(io.BytesIO(z.read("syn1.npy")))
+        syn1neg = np.load(io.BytesIO(z.read("syn1neg.npy")))
+
+    cache = AbstractCache()
+    for e in vocab_entries:
+        cache.add_token(VocabWord(e["word"], e["count"]))
+        cache.total_word_occurrences += e["count"]
+    cache.update_indices()
+    V = cfg["n_words"]
+
+    b = ParagraphVectors.builder()
+    (b.layer_size(cfg["layer_size"]).window_size(cfg["window"])
+     .min_word_frequency(cfg["min_word_frequency"]).epochs(cfg["epochs"])
+     .iterations(cfg["iterations"]).seed(cfg["seed"])
+     .learning_rate(cfg["learning_rate"])
+     .negative_sample(cfg["negative"]).batch_size(cfg["batch_size"])
+     .sequence_learning_algorithm(cfg["sequence_learning"])
+     .train_words_vectors(cfg["train_words"]))
+    b._min_lr = cfg["min_learning_rate"]
+    pv = ParagraphVectors(b)
+    pv.vocab = cache
+    pv._n_words = V
+    pv.label_index = {l: V + i for i, l in enumerate(labels)}
+
+    sv = SequenceVectors(
+        _ExtendedVocab(cache, labels),
+        layer_size=cfg["layer_size"], window=cfg["window"],
+        negative=cfg["negative"], use_hierarchic_softmax=False,
+        learning_rate=cfg["learning_rate"],
+        min_learning_rate=cfg["min_learning_rate"],
+        iterations=cfg["iterations"], epochs=cfg["epochs"],
+        batch_size=cfg["batch_size"], seed=cfg["seed"],
+        elements_algorithm="skipgram",
+    )
+    sv.syn0 = jnp.asarray(syn0)
+    sv.syn1 = jnp.asarray(syn1)
+    sv.syn1neg = jnp.asarray(syn1neg)
+    pv.sv = sv
+    return pv
+
+
+WordVectorSerializer.write_paragraph_vectors = staticmethod(
+    write_paragraph_vectors)
+WordVectorSerializer.read_paragraph_vectors = staticmethod(
+    read_paragraph_vectors)
+WordVectorSerializer.writeParagraphVectors = staticmethod(
+    write_paragraph_vectors)
+WordVectorSerializer.readParagraphVectors = staticmethod(
+    read_paragraph_vectors)

@@ -288,6 +288,37 @@ class TestParagraphVectors:
         labels = pv.nearest_labels("cat dog horse cow sheep cat", n=4)
         assert len(labels) == 4
 
+    def test_paragraph_vectors_zip_round_trip(self, tmp_path):
+        """reference WordVectorSerializer.writeParagraphVectors /
+        readParagraphVectors: the restored model reproduces doc-vector
+        queries exactly and infer_vector works (syn1neg restored)."""
+        from deeplearning4j_tpu.nlp.serializer import (
+            read_paragraph_vectors,
+            write_paragraph_vectors,
+        )
+
+        pv = (
+            ParagraphVectors.builder().iterate(self._docs())
+            .layer_size(16).min_word_frequency(1).epochs(3)
+            .negative_sample(5).seed(4).learning_rate(0.05)
+            .batch_size(128).build().fit()
+        )
+        p = str(tmp_path / "pv.zip")
+        write_paragraph_vectors(pv, p)
+        back = read_paragraph_vectors(p)
+
+        assert back.label_index == pv.label_index
+        for label in ("animals", "tools", "doc_0"):
+            np.testing.assert_array_equal(
+                back.get_paragraph_vector(label),
+                pv.get_paragraph_vector(label))
+        assert back.similarity("doc_0", "animals") == pytest.approx(
+            pv.similarity("doc_0", "animals"))
+        # infer_vector exercises the restored syn1neg + vocab
+        np.testing.assert_allclose(
+            back.infer_vector("cat dog horse"),
+            pv.infer_vector("cat dog horse"), atol=1e-6)
+
 
 # --------------------------------------------------------------------------
 # GloVe
