@@ -460,7 +460,11 @@ def main():
             extra["attention_impl"] = impls or ["no flash-eligible shapes"]
         except Exception as e:
             extra["transformer_lm_error"] = f"{type(e).__name__}: {e}"
-        if os.environ.get("BENCH_SKIP_DECODE", "0") != "1":
+        # decode at full d768 shape is minutes-slow on a CPU validation
+        # run — hardware (or explicit opt-in) only
+        if (os.environ.get("BENCH_SKIP_DECODE", "0") != "1"
+                and (extra.get("platform") != "cpu"
+                     or os.environ.get("BENCH_FORCE_DECODE") == "1")):
             try:
                 extra["transformer_lm_decode_tokens_per_sec"] = round(
                     _bench_lm_decode(), 1)
