@@ -9,8 +9,13 @@ from deeplearning4j_tpu.graph.walks import (
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
 from deeplearning4j_tpu.graph.node2vec import BiasedRandomWalkIterator, Node2Vec
+from deeplearning4j_tpu.graph.serializer import (
+    GraphVectorSerializer,
+    StaticGraphVectors,
+)
 
 __all__ = [
     "Graph", "RandomWalkIterator", "WeightedRandomWalkIterator",
     "DeepWalk", "GraphVectors", "Node2Vec", "BiasedRandomWalkIterator",
+    "GraphVectorSerializer", "StaticGraphVectors",
 ]

@@ -1,0 +1,70 @@
+"""Graph-embedding serialization (reference ``GraphVectorSerializer``,
+``deeplearning4j-graph/.../models/loader/GraphVectorSerializer.java:21``):
+tab-delimited text — one line per vertex, ``index\\tv0\\tv1...`` — and a
+static query object on load."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+DELIM = "\t"
+
+
+class StaticGraphVectors:
+    """Query surface over a loaded vertex-vector matrix (the reference's
+    in-memory ``GraphVectors`` returned by ``loadTxtVectors``)."""
+
+    def __init__(self, matrix: np.ndarray):
+        self.matrix = np.asarray(matrix, np.float32)
+
+    def num_vertices(self) -> int:
+        return self.matrix.shape[0]
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self.matrix[v]
+
+    def similarity(self, a: int, b: int) -> float:
+        va, vb = self.matrix[a], self.matrix[b]
+        na, nb = np.linalg.norm(va), np.linalg.norm(vb)
+        if na == 0 or nb == 0:
+            return 0.0
+        return float(va @ vb / (na * nb))
+
+    def vertices_nearest(self, v: int, n: int = 10) -> List[int]:
+        m = self.matrix
+        norms = np.linalg.norm(m, axis=1)
+        norms[norms == 0] = 1e-9
+        sims = (m @ m[v]) / (norms * max(float(norms[v]), 1e-9))
+        sims[v] = -np.inf
+        return [int(i) for i in np.argsort(-sims)[:n]]
+
+
+class GraphVectorSerializer:
+    @staticmethod
+    def write_graph_vectors(model, path: str) -> None:
+        """``model`` is anything with num_vertices()/get_vertex_vector()
+        (DeepWalk, Node2Vec, StaticGraphVectors)."""
+        with open(path, "w", encoding="utf-8") as f:
+            for i in range(model.num_vertices()):
+                vec = np.asarray(model.get_vertex_vector(i), np.float64)
+                f.write(str(i) + DELIM
+                        + DELIM.join(repr(float(x)) for x in vec) + "\n")
+
+    @staticmethod
+    def load_txt_vectors(path: str) -> StaticGraphVectors:
+        rows = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split(DELIM)
+                if len(parts) < 2:
+                    continue
+                rows.append(np.asarray(parts[1:], np.float64))
+        if not rows:
+            raise ValueError(f"no vectors in {path}")
+        return StaticGraphVectors(np.stack(rows))
+
+    # reference-parity names
+    writeGraphVectors = write_graph_vectors
+    loadTxtVectors = load_txt_vectors

@@ -241,6 +241,31 @@ class TestDeepWalk:
         assert np.isfinite(dw.sv.last_loss)
         assert dw.get_vertex_vector(0).shape == (8,)
 
+    def test_graph_vector_serializer_round_trip(self, tmp_path):
+        """reference GraphVectorSerializer.writeGraphVectors /
+        loadTxtVectors (tab-delimited text)."""
+        from deeplearning4j_tpu.graph import GraphVectorSerializer
+
+        g = TestGraphWalks()._two_cliques()
+        dw = (
+            DeepWalk.builder().vector_size(8).window_size(2).walk_length(10)
+            .walks_per_vertex(5).seed(6).epochs(1).build().fit(g)
+        )
+        p = str(tmp_path / "gv.txt")
+        GraphVectorSerializer.write_graph_vectors(dw, p)
+        back = GraphVectorSerializer.load_txt_vectors(p)
+        assert back.num_vertices() == dw.num_vertices()
+        for v in range(dw.num_vertices()):
+            np.testing.assert_allclose(
+                back.get_vertex_vector(v), dw.get_vertex_vector(v),
+                rtol=0, atol=1e-6)
+        assert back.similarity(0, 1) == pytest.approx(
+            dw.similarity(0, 1), abs=1e-5)
+        # camelCase reference-parity aliases work too
+        GraphVectorSerializer.writeGraphVectors(back, p + "2")
+        again = GraphVectorSerializer.loadTxtVectors(p + "2")
+        np.testing.assert_allclose(again.matrix, back.matrix, atol=1e-6)
+
 
 class TestKnnServer:
     def test_http_knn_roundtrip(self):
