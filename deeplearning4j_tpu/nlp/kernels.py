@@ -261,3 +261,46 @@ def dbow_infer_step(
         - jnp.sum(jnp.log(1.0 - s_neg + eps) * neg_valid, -1)
     ) / jnp.maximum(mask.sum(), 1.0)
     return doc_vec - lr * d_v, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(10,))
+def dm_infer_step(
+    doc_vec: Array,       # (D,) the trainable document vector
+    syn0: Array,          # frozen word input vectors
+    syn1neg: Array,       # frozen output vectors
+    contexts: Array,      # (B, W) int32 window word ids (0-padded)
+    ctx_mask: Array,      # (B, W) float
+    targets: Array,       # (B,) int32 center word to predict
+    mask: Array,          # (B,)
+    cdf: Array,
+    lr: Array,
+    rng: Array,
+    negative: int,
+) -> Tuple[Array, Array]:
+    """PV-DM inference (reference ``inferVector`` runs the CONFIGURED
+    learning algorithm; ``DM.java`` inference path): each window's input
+    is mean(frozen context word vectors, trainable doc vector); only the
+    doc vector receives gradient, scaled by its 1/(n_ctx+1) share of the
+    mean — the frozen-weights analogue of ``cbow_step``'s input-side
+    delta split."""
+    ctx_vecs = syn0[contexts]                               # (B, W, D)
+    n_in = ctx_mask.sum(-1, keepdims=True) + 1.0            # (B, 1)
+    h = (jnp.einsum("bwd,bw->bd", ctx_vecs, ctx_mask)
+         + doc_vec[None, :]) / n_in                         # (B, D)
+    B = targets.shape[0]
+    negs = sample_negatives(rng, cdf, (B, negative))
+    neg_valid = (negs != targets[:, None]).astype(doc_vec.dtype) * mask[:, None]
+    u_pos = syn1neg[targets]                                # (B, D)
+    u_neg = syn1neg[negs]                                   # (B, K, D)
+    s_pos = sigmoid(jnp.sum(h * u_pos, -1))
+    s_neg = sigmoid(jnp.einsum("bd,bkd->bk", h, u_neg))
+    g_pos = (s_pos - 1.0) * mask
+    g_neg = s_neg * neg_valid
+    d_h = g_pos[:, None] * u_pos + jnp.einsum("bk,bkd->bd", g_neg, u_neg)
+    d_doc = jnp.einsum("bd,b->d", d_h, mask / n_in[:, 0])
+    eps = 1e-7
+    loss = jnp.sum(
+        -jnp.log(s_pos + eps) * mask
+        - jnp.sum(jnp.log(1.0 - s_neg + eps) * neg_valid, -1)
+    ) / jnp.maximum(mask.sum(), 1.0)
+    return doc_vec - lr * d_doc, loss

@@ -308,7 +308,11 @@ class ParagraphVectors:
     def infer_vector(self, text: str, steps: int = 10,
                      lr: float = 0.025) -> np.ndarray:
         """Train a FRESH vector for unseen text against frozen word
-        weights (reference ``inferVector``)."""
+        weights, using the CONFIGURED learning algorithm — DBOW models
+        infer with the doc→word skip-gram objective, DM models with the
+        context-mean CBOW objective (reference ``inferVector`` routes
+        through the model's SequenceLearningAlgorithm,
+        ``DBOW.java``/``DM.java`` ``inferSequence``)."""
         toks = self._tok.create(text).get_tokens()
         ids = np.asarray(
             [i for i in (self.vocab.index_of(t) for t in toks) if i >= 0],
@@ -321,6 +325,12 @@ class ParagraphVectors:
         )
         if len(ids) == 0:
             return np.asarray(vec)
+        if self._b._sequence_learning == "dm" and len(ids) >= 2:
+            return self._infer_dm(vec, ids, steps, lr)
+        return self._infer_dbow(vec, ids, steps, lr)
+
+    def _infer_dbow(self, vec, ids, steps, lr):
+        sv = self.sv
         B = 256
         # chunk long documents so EVERY token contributes each step
         chunks = []
@@ -337,6 +347,37 @@ class ParagraphVectors:
                 key, k = jax.random.split(key)
                 vec, _ = dbow_infer_step(
                     vec, sv.syn1neg, tpad, mask,
+                    sv.cdf, jnp.asarray(lr * (1 - s / steps), jnp.float32), k,
+                    max(sv.negative, 1),
+                )
+        return np.asarray(vec)
+
+    def _infer_dm(self, vec, ids, steps, lr):
+        from deeplearning4j_tpu.nlp.kernels import dm_infer_step
+
+        sv = self.sv
+        ctx, cm, tg = sv._cbow_windows(ids)
+        B = 256
+        chunks = []
+        W = ctx.shape[1]
+        for lo in range(0, len(tg), B):
+            n = len(tg[lo:lo + B])
+            cpad = np.zeros((B, W), np.int32)
+            mpad = np.zeros((B, W), np.float32)
+            tpad = np.zeros((B,), np.int32)
+            mask = np.zeros((B,), np.float32)
+            cpad[:n] = ctx[lo:lo + B]
+            mpad[:n] = cm[lo:lo + B]
+            tpad[:n] = tg[lo:lo + B]
+            mask[:n] = 1.0
+            chunks.append((jnp.asarray(cpad), jnp.asarray(mpad),
+                           jnp.asarray(tpad), jnp.asarray(mask)))
+        key = jax.random.PRNGKey(7)
+        for s in range(steps):
+            for cpad, mpad, tpad, mask in chunks:
+                key, k = jax.random.split(key)
+                vec, _ = dm_infer_step(
+                    vec, sv.syn0, sv.syn1neg, cpad, mpad, tpad, mask,
                     sv.cdf, jnp.asarray(lr * (1 - s / steps), jnp.float32), k,
                     max(sv.negative, 1),
                 )

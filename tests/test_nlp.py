@@ -276,6 +276,33 @@ class TestParagraphVectors:
         )
         assert pv.get_paragraph_vector("animals") is not None
 
+    def test_dm_infer_vector_uses_dm_objective(self):
+        """reference inferVector runs the CONFIGURED algorithm: a
+        DM-trained model infers through the context-mean objective
+        (kernels.dm_infer_step), and the result lands on the right
+        topic side."""
+        pv = (
+            ParagraphVectors.builder().iterate(self._docs())
+            .layer_size(16).epochs(3).negative_sample(5).seed(4)
+            .learning_rate(0.05).sequence_learning_algorithm("DM")
+            .batch_size(128).build().fit()
+        )
+        v = pv.infer_vector("cat dog horse cow sheep cat dog")
+        assert v.shape == (16,)
+        assert np.all(np.isfinite(v))
+        assert np.abs(v).max() > 0  # moved off zero
+
+        def sim(v, label):
+            u = pv.get_paragraph_vector(label)
+            return float(v @ u / (np.linalg.norm(v) * np.linalg.norm(u)
+                                  + 1e-9))
+
+        assert sim(v, "animals") > sim(v, "tools"), (
+            sim(v, "animals"), sim(v, "tools"))
+        # single-token text (no full window) falls back to DBOW inference
+        v1 = pv.infer_vector("cat")
+        assert np.all(np.isfinite(v1))
+
     def test_infer_vector_nearest_label(self):
         pv = (
             ParagraphVectors.builder().iterate(self._docs())
