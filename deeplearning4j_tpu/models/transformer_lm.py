@@ -39,7 +39,8 @@ class TransformerLMConfig:
                  n_layers: int = 4, mlp_ratio: int = 4, max_length: int = 512,
                  seed: int = 0, n_experts: int = 0, top_k: int = 2,
                  capacity_factor: float = 1.25, aux_loss_weight: float = 1e-2,
-                 compute_dtype: Optional[str] = None):
+                 compute_dtype: Optional[str] = None,
+                 fused_qkv: bool = False):
         if d_model % n_heads:
             raise ValueError("d_model must be divisible by n_heads")
         self.vocab_size = int(vocab_size)
@@ -65,6 +66,14 @@ class TransformerLMConfig:
                 f"{compute_dtype!r}"
             )
         self.compute_dtype = None if compute_dtype == "float32" else compute_dtype
+        # fused_qkv: compute Q,K,V as ONE (d, 3d) matmul per block instead
+        # of three (d, d) dots — bitwise-identical outputs (each output
+        # column block sees only its own weight block), but the activation
+        # is read from HBM once instead of three times. Param layout is
+        # UNCHANGED (Wq/Wk/Wv stay separate; the concat happens in-step),
+        # so checkpoints, TP pspecs and the decode path are unaffected.
+        # Opt-in pending hardware measurement (scripts/lm_perf_sweep.py).
+        self.fused_qkv = bool(fused_qkv)
 
     def to_dict(self):
         return dict(self.__dict__)
@@ -152,7 +161,13 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     def heads(W):
         return (a_in @ W).reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
+    if cfg.fused_qkv:
+        qkv = a_in @ jnp.concatenate(
+            [bp["Wq"], bp["Wk"], bp["Wv"]], axis=-1)  # (b, T, 3d)
+        q, k, v = (s.reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
+                   for s in jnp.split(qkv, 3, axis=-1))
+    else:
+        q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
     fn = attn_fn if attn_fn is not None else dense_attention
     o = fn(q, k, v, causal=True, mask=None)
     o = o.transpose(0, 2, 1, 3).reshape(b, T, d).astype(x.dtype)
@@ -417,13 +432,14 @@ class TransformerLM(ZooModel):
                  max_length: int = 512, seed: int = 123, n_experts: int = 0,
                  top_k: int = 2, capacity_factor: float = 1.25,
                  aux_loss_weight: float = 1e-2,
-                 compute_dtype: Optional[str] = None, **kwargs):
+                 compute_dtype: Optional[str] = None,
+                 fused_qkv: bool = False, **kwargs):
         super().__init__(num_classes=vocab_size, seed=seed, **kwargs)
         self.cfg = TransformerLMConfig(
             vocab_size, d_model, n_heads, n_layers, mlp_ratio, max_length,
             seed=seed, n_experts=n_experts, top_k=top_k,
             capacity_factor=capacity_factor, aux_loss_weight=aux_loss_weight,
-            compute_dtype=compute_dtype,
+            compute_dtype=compute_dtype, fused_qkv=fused_qkv,
         )
         self.params_: Optional[Dict] = None
         self.opt_state_: Optional[Dict] = None

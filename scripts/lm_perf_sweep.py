@@ -23,7 +23,7 @@ PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 D, V, HEADS, LAYERS = 768, 32000, 12, 12
 
 
-def measure(batch, seq, flash: bool, iters=10):
+def measure(batch, seq, flash: bool, fused_qkv: bool = False, iters=10):
     os.environ["DL4J_TPU_FLASH_ATTENTION"] = "1" if flash else "0"
     import jax.numpy as jnp
 
@@ -31,7 +31,8 @@ def measure(batch, seq, flash: bool, iters=10):
 
     model = TransformerLM(vocab_size=V, d_model=D, n_heads=HEADS,
                           n_layers=LAYERS, max_length=seq,
-                          compute_dtype="bfloat16").init()
+                          compute_dtype="bfloat16",
+                          fused_qkv=fused_qkv).init()
     rng = np.random.default_rng(0)
     ids = rng.integers(0, V, (batch, seq)).astype(np.int32)
     tgt = np.roll(ids, -1, axis=1).astype(np.int32)
@@ -76,11 +77,13 @@ def main():
             (1024, 8), (2048, 4),
         ]
     results = []
+    variants = [(True, False), (False, False), (True, True)]
     for seq, batch in grid:
-        for flash in (True, False):
-            label = f"T{seq} b{batch} {'flash' if flash else 'dense'}"
+        for flash, fq in variants:
+            label = (f"T{seq} b{batch} {'flash' if flash else 'dense'}"
+                     + (" fused_qkv" if fq else ""))
             try:
-                tps, mfu = measure(batch, seq, flash)
+                tps, mfu = measure(batch, seq, flash, fq)
                 rec = {"config": label, "tokens_per_sec": round(tps, 1),
                        "mfu_pct": round(mfu, 2)}
             except Exception as e:

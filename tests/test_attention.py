@@ -293,6 +293,25 @@ class TestAdvisorRegressions:
         assert out.shape == (1, 14)
         assert np.all(out < 17)
 
+    @pytest.mark.parametrize("compute_dtype", [None, "bfloat16"])
+    def test_fused_qkv_bitwise_identical(self, compute_dtype):
+        """fused_qkv computes Q,K,V as one (d, 3d) dot: every output
+        column block sees only its own weight block, so logits must be
+        BITWISE identical to the three-dot layout (param layout/
+        checkpoints/TP pspecs unchanged)."""
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+        ids = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(
+            np.int32)
+        outs = []
+        for fq in (False, True):
+            m = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                              n_layers=2, max_length=32,
+                              compute_dtype=compute_dtype,
+                              fused_qkv=fq).init()
+            outs.append(np.asarray(m.logits(ids)))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
 
 class TestFlashAttentionGate:
     def test_gate_logic(self, monkeypatch):
