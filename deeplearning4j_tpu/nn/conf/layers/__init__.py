@@ -24,6 +24,8 @@ from deeplearning4j_tpu.nn.conf.layers.conv import (
     Cropping2D,
     Deconvolution2D,
     DepthwiseConvolution2D,
+    Pooling1D,
+    Pooling2D,
     SeparableConvolution2D,
     SpaceToBatchLayer,
     SpaceToDepthLayer,
@@ -89,7 +91,8 @@ __all__ = [
     "EmbeddingSequenceLayer", "ElementWiseMultiplicationLayer", "AutoEncoder",
     "ConvolutionLayer", "Convolution1DLayer", "Deconvolution2D",
     "DepthwiseConvolution2D", "SeparableConvolution2D", "SubsamplingLayer",
-    "Subsampling1DLayer", "Upsampling1D", "Upsampling2D", "ZeroPaddingLayer",
+    "Subsampling1DLayer", "Pooling1D", "Pooling2D",
+    "Upsampling1D", "Upsampling2D", "ZeroPaddingLayer",
     "ZeroPadding1DLayer", "Cropping2D", "SpaceToBatchLayer", "SpaceToDepthLayer",
     "BatchNormalization", "LocalResponseNormalization",
     "GlobalPoolingLayer", "MaskLayer",

@@ -546,3 +546,15 @@ class ZeroPadding1DLayer(Layer):
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
         return jnp.pad(x, ((0, 0), (self.pad[0], self.pad[1]), (0, 0))), state or {}
+
+
+@serde.register
+class Pooling2D(SubsamplingLayer):
+    """Name alias (reference ``Pooling2D.java`` — an empty subclass of
+    ``SubsamplingLayer``)."""
+
+
+@serde.register
+class Pooling1D(Subsampling1DLayer):
+    """Name alias (reference ``Pooling1D.java`` — an empty subclass of
+    ``Subsampling1DLayer``)."""

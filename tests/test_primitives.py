@@ -327,3 +327,24 @@ class TestRegularization:
         )
         u = UnitNormConstraint().apply(w)
         np.testing.assert_allclose(np.linalg.norm(np.asarray(u), axis=0), [1, 1], rtol=1e-4)
+
+
+class TestPoolingAliases:
+    def test_pooling_aliases_are_subsampling(self):
+        """reference Pooling1D/Pooling2D are empty subclasses of the
+        Subsampling layers (Pooling2D.java) — same here, serde-resolvable
+        under the alias names."""
+        from deeplearning4j_tpu.nn.conf import serde
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Pooling1D,
+            Pooling2D,
+            Subsampling1DLayer,
+            SubsamplingLayer,
+        )
+
+        assert issubclass(Pooling2D, SubsamplingLayer)
+        assert issubclass(Pooling1D, Subsampling1DLayer)
+        p2 = serde.decode(serde.encode(Pooling2D(kernel_size=(3, 3))))
+        assert type(p2) is Pooling2D and list(p2.kernel_size) == [3, 3]
+        p1 = serde.decode(serde.encode(Pooling1D(kernel_size=4)))
+        assert type(p1) is Pooling1D and p1.kernel_size == 4
