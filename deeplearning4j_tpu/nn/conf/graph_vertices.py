@@ -266,6 +266,24 @@ class ShiftVertex(GraphVertex):
 
 
 @serde.register
+class PoolHelperVertex(GraphVertex):
+    """Strip the first spatial row and column of a CNN activation
+    (reference ``PoolHelperVertex.java:doForward`` — a legacy helper
+    compensating Caffe-style ceil-mode pooling in imported GoogLeNet
+    models; NCHW ``[:, :, 1:, 1:]`` there, NHWC here)."""
+
+    def get_output_type(self, *input_types: InputType) -> InputType:
+        (it,) = input_types
+        if it.kind != "convolutional":
+            raise ValueError("PoolHelperVertex expects convolutional input")
+        return InputType.convolutional(it.height - 1, it.width - 1,
+                                       it.channels)
+
+    def apply(self, inputs, masks, *, train=False, rng=None):
+        return inputs[0][:, 1:, 1:, :]
+
+
+@serde.register
 class ReshapeVertex(GraphVertex):
     """Reshape to ``new_shape`` (batch dim may be -1; reference
     ``ReshapeVertex.java``)."""

@@ -239,6 +239,25 @@ class TestVertices:
         y = ReshapeVertex([-1, 3, 4]).apply([x], [None])
         assert y.shape == (2, 3, 4)
 
+    def test_pool_helper_vertex(self):
+        """reference PoolHelperVertex.doForward: strip the first spatial
+        row+column (NHWC here; NCHW [:, :, 1:, 1:] there)."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf.graph_vertices import (
+            PoolHelperVertex,
+        )
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+        v = PoolHelperVertex()
+        ot = v.get_output_type(InputType.convolutional(8, 8, 3))
+        assert (ot.height, ot.width, ot.channels) == (7, 7, 3)
+        x = jnp.arange(2.0 * 8 * 8 * 3).reshape(2, 8, 8, 3)
+        y = v.apply([x], [None])
+        assert y.shape == (2, 7, 7, 3)
+        np.testing.assert_array_equal(np.asarray(y),
+                                      np.asarray(x)[:, 1:, 1:, :])
+
     def test_reverse_timeseries_masked(self):
         import jax.numpy as jnp
 
