@@ -395,3 +395,150 @@ class ProfilerListener(TrainingListener):
             jax.profiler.stop_trace()
             self._active = False
             self.completed = True
+
+
+class ComposableIterationListener(TrainingListener):
+    """Delegate every hook to a list of listeners (reference
+    ``ComposableIterationListener.java`` — composes listeners handed
+    around as one object)."""
+
+    def __init__(self, *listeners):
+        self.listeners = list(listeners[0]) if (
+            len(listeners) == 1 and isinstance(listeners[0], (list, tuple))
+        ) else list(listeners)
+
+    def iteration_done(self, model, iteration, epoch):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, epoch)
+
+    def on_epoch_start(self, model):
+        for l in self.listeners:
+            if hasattr(l, "on_epoch_start"):
+                l.on_epoch_start(model)
+
+    def on_epoch_end(self, model):
+        for l in self.listeners:
+            if hasattr(l, "on_epoch_end"):
+                l.on_epoch_end(model)
+
+    def needs_introspection(self, next_iteration: int) -> bool:
+        return any(
+            _has_hook(l, "on_forward_pass")
+            or _has_hook(l, "on_gradient_calculation")
+            for l in self.listeners
+            if getattr(l, "needs_introspection",
+                       lambda _: True)(next_iteration)
+        )
+
+    def on_forward_pass(self, model, activations):
+        for l in _hook_recipients(self.listeners, "on_forward_pass"):
+            l.on_forward_pass(model, activations)
+
+    def on_gradient_calculation(self, model, gradients):
+        for l in _hook_recipients(self.listeners, "on_gradient_calculation"):
+            l.on_gradient_calculation(model, gradients)
+
+    def on_backward_pass(self, model):
+        for l in _hook_recipients(self.listeners, "on_backward_pass"):
+            l.on_backward_pass(model)
+
+
+def _named_leaves(tree):
+    import jax
+    import numpy as np
+
+    out = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts), np.asarray(leaf)))
+    return out
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Per-parameter statistics of params AND gradients every
+    ``iterations`` steps, tab-delimited to stdout and/or a file
+    (reference ``ParamAndGradientIterationListener.java``: printMean /
+    printMinMax / printMeanAbsValue flags, header line, delimiter).
+    Gradients arrive through the introspection hook — pay-for-use, the
+    extra fwd+grad pass runs only on reporting iterations."""
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = True,
+                 print_mean_abs_value: bool = True,
+                 output_to_console: bool = True, file: Optional[str] = None,
+                 delimiter: str = "\t"):
+        self.iterations = max(int(iterations), 1)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs_value = print_mean_abs_value
+        self.output_to_console = output_to_console
+        self.file = file
+        self.delimiter = delimiter
+        self._grads = None
+        self._header_written = False
+        if file:  # truncate once per listener lifetime
+            open(file, "w").close()
+
+    def needs_introspection(self, next_iteration: int) -> bool:
+        return next_iteration % self.iterations == 0
+
+    def on_gradient_calculation(self, model, gradients):
+        self._grads = gradients
+
+    def _stats(self, arr):
+        import numpy as np
+
+        cols = []
+        if self.print_mean:
+            cols.append(float(np.mean(arr)))
+        if self.print_min_max:
+            cols.extend([float(np.min(arr)), float(np.max(arr))])
+        if self.print_mean_abs_value:
+            cols.append(float(np.mean(np.abs(arr))))
+        return cols
+
+    def _stat_names(self):
+        names = []
+        if self.print_mean:
+            names.append("mean")
+        if self.print_min_max:
+            names.extend(["min", "max"])
+        if self.print_mean_abs_value:
+            names.append("meanAbs")
+        return names
+
+    def _emit(self, line: str):
+        if self.output_to_console:
+            print(line)
+        if self.file:
+            with open(self.file, "a") as f:
+                f.write(line + "\n")
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.iterations:
+            return
+        params = _named_leaves(model.params_)
+        grads = _named_leaves(self._grads) if self._grads is not None else []
+        if self.print_header and not self._header_written:
+            cols = ["iteration"]
+            for name, _ in params:
+                cols += [f"p_{name}_{s}" for s in self._stat_names()]
+            for name, _ in grads:
+                cols += [f"g_{name}_{s}" for s in self._stat_names()]
+            self._emit(self.delimiter.join(cols))
+            self._header_written = True
+        vals = [str(iteration)]
+        for _, a in params:
+            vals += [f"{x:.6g}" for x in self._stats(a)]
+        for _, a in grads:
+            vals += [f"{x:.6g}" for x in self._stats(a)]
+        self._emit(self.delimiter.join(vals))
+        self._grads = None

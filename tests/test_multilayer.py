@@ -143,6 +143,39 @@ class TestTraining:
         net.fit(ds.features, ds.labels, epochs=2, batch_size=32)
         assert net.iteration == 4
 
+    def test_param_and_gradient_listener(self, tmp_path):
+        """reference ParamAndGradientIterationListener: tab-delimited
+        per-parameter stats of params AND gradients (gradients via the
+        introspection hook), header + one row per reporting iteration."""
+        from deeplearning4j_tpu.train.listeners import (
+            ComposableIterationListener,
+            ParamAndGradientIterationListener,
+        )
+
+        ds = small_classification_data(n=64)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        path = str(tmp_path / "pg.tsv")
+        pg = ParamAndGradientIterationListener(
+            iterations=1, output_to_console=False, file=path)
+        collect = CollectScoresIterationListener(frequency=1)
+        net.set_listeners(ComposableIterationListener(pg, collect))
+        net.fit(ds, batch_size=32)  # 2 iterations
+        lines = open(path).read().strip().split("\n")
+        assert len(lines) == 3  # header + 2 iterations
+        header, rows = lines[0].split("\t"), lines[1:]
+        assert header[0] == "iteration"
+        assert any(c.startswith("p_") and c.endswith("_mean")
+                   for c in header)
+        # gradient columns exist => introspection hook delivered through
+        # the composable wrapper
+        assert any(c.startswith("g_") for c in header)
+        for r in rows:
+            vals = r.split("\t")
+            assert len(vals) == len(header)
+            assert all(np.isfinite(float(v)) for v in vals[1:])
+        # the composed child listener was also driven
+        assert len(collect.scores) == 2
+
     def test_output_shape_and_softmax(self):
         ds = small_classification_data(n=16)
         net = MultiLayerNetwork(mlp_conf()).init()
