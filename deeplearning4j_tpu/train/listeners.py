@@ -206,20 +206,28 @@ class SleepyTrainingListener(TrainingListener):
 
 class EvaluativeListener(TrainingListener):
     """Runs evaluation every N iterations/epochs (reference
-    ``EvaluativeListener``)."""
+    ``EvaluativeListener``). ``callback(listener, model, count, evaluation)``
+    fires after each evaluation — the reference's ``EvaluationCallback``
+    SPI (``listeners/callbacks/EvaluationCallback.java``); see
+    :func:`model_saving_callback` for the ``ModelSavingCallback``
+    counterpart."""
 
     def __init__(self, iterator, frequency: int = 1, invocation: str = "epoch_end",
-                 printer: Optional[Callable[[str], None]] = None):
+                 printer: Optional[Callable[[str], None]] = None,
+                 callback: Optional[Callable] = None):
         self.iterator = iterator
         self.frequency = max(1, int(frequency))
         self.invocation = invocation
         self.printer = printer or (lambda s: log.info(s))
+        self.callback = callback
         self.evaluations: List[object] = []
 
     def _evaluate(self, model):
         ev = model.evaluate(self.iterator)
         self.evaluations.append(ev)
         self.printer(f"Evaluation: accuracy={ev.accuracy():.4f} f1={ev.f1():.4f}")
+        if self.callback is not None:
+            self.callback(self, model, len(self.evaluations), ev)
 
     def iteration_done(self, model, iteration, epoch):
         if self.invocation == "iteration_end" and iteration % self.frequency == 0:
@@ -542,3 +550,25 @@ class ParamAndGradientIterationListener(TrainingListener):
             vals += [f"{x:.6g}" for x in self._stats(a)]
         self._emit(self.delimiter.join(vals))
         self._grads = None
+
+
+def model_saving_callback(root_folder: str, filename_template: str):
+    """EvaluationCallback that checkpoints the model after every
+    evaluation (reference ``ModelSavingCallback.java``): ``%d`` in the
+    template is replaced by the invocation count. Pass as
+    ``EvaluativeListener(callback=...)``."""
+    import os
+
+    if not os.path.isdir(root_folder):
+        raise ValueError(f"root_folder must be an existing directory: "
+                         f"{root_folder!r}")
+    if not filename_template:
+        raise ValueError("filename_template can't be empty")
+
+    def call(listener, model, count, evaluation):
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        name = filename_template.replace("%d", str(count))
+        ModelSerializer.write_model(model, os.path.join(root_folder, name))
+
+    return call

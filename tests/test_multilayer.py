@@ -176,6 +176,36 @@ class TestTraining:
         # the composed child listener was also driven
         assert len(collect.scores) == 2
 
+    def test_evaluative_listener_model_saving_callback(self, tmp_path):
+        """reference EvaluationCallback SPI + ModelSavingCallback: the
+        callback fires per evaluation and checkpoints with %d replaced
+        by the invocation count."""
+        from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+        from deeplearning4j_tpu.train.listeners import (
+            EvaluativeListener,
+            model_saving_callback,
+        )
+        from deeplearning4j_tpu.train.model_serializer import (
+            ModelSerializer,
+        )
+
+        ds = small_classification_data(n=32)
+        net = MultiLayerNetwork(mlp_conf()).init()
+        it = ListDataSetIterator(ds, batch_size=32)
+        net.set_listeners(EvaluativeListener(
+            it, frequency=1, invocation="epoch_end",
+            printer=lambda s: None,
+            callback=model_saving_callback(str(tmp_path), "model-%d.zip")))
+        net.fit(ds, batch_size=16, epochs=2)
+        import os
+
+        saved = sorted(os.listdir(tmp_path))
+        assert saved == ["model-1.zip", "model-2.zip"], saved
+        back = ModelSerializer.restore_multi_layer_network(
+            str(tmp_path / "model-2.zip"))
+        np.testing.assert_allclose(back.output(ds.features),
+                                   net.output(ds.features), atol=1e-6)
+
     def test_output_shape_and_softmax(self):
         ds = small_classification_data(n=16)
         net = MultiLayerNetwork(mlp_conf()).init()
