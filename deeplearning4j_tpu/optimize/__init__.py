@@ -5,11 +5,15 @@ from deeplearning4j_tpu.optimize.solvers import (
     ConjugateGradient,
     LBFGS,
     LineGradientDescent,
+    EpsTermination,
+    Norm2Termination,
     OptimizationAlgorithm,
     Solver,
+    ZeroDirection,
 )
 
 __all__ = [
     "Solver", "OptimizationAlgorithm", "LBFGS", "ConjugateGradient",
     "LineGradientDescent", "BackTrackLineSearch",
+    "EpsTermination", "Norm2Termination", "ZeroDirection",
 ]

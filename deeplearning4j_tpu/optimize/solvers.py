@@ -81,13 +81,50 @@ class BackTrackLineSearch:
         return 0.0, fx, x
 
 
+class EpsTermination:
+    """Relative score improvement below eps (reference
+    ``terminations/EpsTermination.java``)."""
+
+    def __init__(self, eps: float = 1e-4, tolerance: float = 1e-8):
+        self.eps, self.tolerance = float(eps), float(tolerance)
+
+    def terminate(self, old_score, new_score, gradient, direction) -> bool:
+        denom = abs(old_score) if old_score != 0 else 1.0
+        return abs(old_score - new_score) / denom < self.eps + self.tolerance
+
+
+class Norm2Termination:
+    """Gradient 2-norm below a threshold (reference
+    ``terminations/Norm2Termination.java``)."""
+
+    def __init__(self, gradient_norm_threshold: float = 1e-5):
+        self.threshold = float(gradient_norm_threshold)
+
+    def terminate(self, old_score, new_score, gradient, direction) -> bool:
+        return float(jnp.linalg.norm(gradient)) < self.threshold
+
+
+class ZeroDirection:
+    """Search direction is (numerically) zero (reference
+    ``terminations/ZeroDirection.java``)."""
+
+    def terminate(self, old_score, new_score, gradient, direction) -> bool:
+        return float(jnp.abs(direction).max()) == 0.0
+
+
 class _BaseFullBatchOptimizer:
     def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
-                 line_search: Optional[BackTrackLineSearch] = None):
+                 line_search: Optional[BackTrackLineSearch] = None,
+                 termination_conditions: Optional[List] = None):
         self.max_iterations = max_iterations
         self.tolerance = tolerance
         self.line_search = line_search or BackTrackLineSearch()
+        self.termination_conditions = list(termination_conditions or [])
         self.score_history: List[float] = []
+
+    def _terminated(self, old_score, new_score, gradient, direction) -> bool:
+        return any(tc.terminate(old_score, new_score, gradient, direction)
+                   for tc in self.termination_conditions)
 
     def optimize(self, model, ds: DataSet) -> float:
         """Minimize on the batch; writes optimized params back into the
@@ -117,6 +154,10 @@ class LineGradientDescent(_BaseFullBatchOptimizer):
             step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
             if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
                 break
+            if self._terminated(fx, float(f_new), g, direction):
+                x, fx = x_new, float(f_new)
+                self.score_history.append(fx)
+                break
             x, fx = x_new, f_new
             _, g = vg(x)
             self.score_history.append(fx)
@@ -137,6 +178,10 @@ class ConjugateGradient(_BaseFullBatchOptimizer):
             step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
             if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
                 break
+            if self._terminated(fx, float(f_new), g, direction):
+                x, fx = x_new, float(f_new)
+                self.score_history.append(fx)
+                break
             _, g_new = vg(x_new)
             # Polak-Ribière beta, restarted when non-positive or periodically
             beta = float(jnp.dot(g_new, g_new - g) / jnp.maximum(jnp.dot(g, g), 1e-20))
@@ -156,8 +201,10 @@ class LBFGS(_BaseFullBatchOptimizer):
     default history m=10)."""
 
     def __init__(self, max_iterations: int = 100, tolerance: float = 1e-5,
-                 m: int = 10, line_search: Optional[BackTrackLineSearch] = None):
-        super().__init__(max_iterations, tolerance, line_search)
+                 m: int = 10, line_search: Optional[BackTrackLineSearch] = None,
+                 termination_conditions: Optional[List] = None):
+        super().__init__(max_iterations, tolerance, line_search,
+                         termination_conditions)
         self.m = m
 
     def _run(self, vg, vloss, x, unravel, model) -> float:
@@ -185,6 +232,10 @@ class LBFGS(_BaseFullBatchOptimizer):
             step, f_new, x_new = self.line_search.optimize(vloss, x, fx, g, direction)
             if step == 0.0 or fx - f_new < self.tolerance * max(abs(fx), 1.0):
                 break
+            if self._terminated(fx, float(f_new), g, direction):
+                x, fx = x_new, float(f_new)
+                self.score_history.append(fx)
+                break
             _, g_new = vg(x_new)
             s_hist.append(x_new - x)
             y_hist.append(g_new - g)
@@ -208,6 +259,14 @@ class Solver:
             self._algo = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
             self._max_iter = 100
             self._tol = 1e-5
+            self._terminations = None
+
+        def termination_conditions(self, *tcs):
+            """Named conditions (EpsTermination / Norm2Termination /
+            ZeroDirection — reference ``optimize/terminations/*``)
+            checked each iteration in addition to the tolerance test."""
+            self._terminations = list(tcs)
+            return self
 
         def model(self, m):
             self._model = m
@@ -226,14 +285,15 @@ class Solver:
             return self
 
         def build(self) -> "Solver":
-            return Solver(self._model, self._algo, self._max_iter, self._tol)
+            return Solver(self._model, self._algo, self._max_iter, self._tol,
+                          self._terminations)
 
     @staticmethod
     def builder() -> "Solver.Builder":
         return Solver.Builder()
 
     def __init__(self, model, algorithm: str, max_iterations: int = 100,
-                 tolerance: float = 1e-5):
+                 tolerance: float = 1e-5, termination_conditions=None):
         self.model = model
         self.algorithm = algorithm
         impl = {
@@ -244,7 +304,9 @@ class Solver:
         if algorithm == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
             self.optimizer = None  # model.fit IS the SGD path
         elif algorithm in impl:
-            self.optimizer = impl[algorithm](max_iterations, tolerance)
+            self.optimizer = impl[algorithm](
+                max_iterations, tolerance,
+                termination_conditions=termination_conditions)
         else:
             raise ValueError(f"Unknown optimization algorithm {algorithm}")
 

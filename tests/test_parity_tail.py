@@ -267,6 +267,39 @@ class TestLegacySolvers:
         # params written back: model.score agrees
         assert net.score(ds) == pytest.approx(final, rel=1e-4)
 
+    def test_termination_conditions(self):
+        """reference optimize/terminations/*: named conditions stop the
+        solver early; a huge Norm2 threshold stops after one accepted
+        step, EpsTermination stops once improvement stalls."""
+        from deeplearning4j_tpu.optimize.solvers import (
+            EpsTermination,
+            LBFGS,
+            Norm2Termination,
+            OptimizationAlgorithm,
+            Solver,
+            ZeroDirection,
+        )
+
+        net, ds = self._model_and_data(seed=13)
+        opt = LBFGS(max_iterations=40,
+                    termination_conditions=[Norm2Termination(1e9)])
+        opt.optimize(net, ds)
+        # any finite gradient norm < 1e9 => stopped right after step 1
+        assert len(opt.score_history) <= 3, opt.score_history
+
+        net2, ds2 = self._model_and_data(seed=13)
+        solver = (
+            Solver.builder().model(net2)
+            .optimization_algorithm(OptimizationAlgorithm.CONJUGATE_GRADIENT)
+            .max_iterations(40)
+            .termination_conditions(EpsTermination(eps=0.5), ZeroDirection())
+            .build()
+        )
+        final = solver.optimize(ds2)
+        # 50% relative-improvement bar triggers long before 40 iterations
+        assert len(solver.optimizer.score_history) < 40
+        assert np.isfinite(final)
+
     def test_lbfgs_beats_few_sgd_steps(self):
         """On a small full-batch problem LBFGS should reach a much lower
         loss than the same number of SGD evaluations."""
