@@ -157,3 +157,99 @@ class ReshapePreprocessor(InputPreProcessor):
         import math
 
         return InputType.feed_forward(math.prod(self.shape))
+
+
+@serde.register
+class ComposableInputPreProcessor(InputPreProcessor):
+    """Apply several preprocessors in order (reference
+    ``ComposableInputPreProcessor.java``; backward composition falls out
+    of autodiff here)."""
+
+    def __init__(self, *preprocessors):
+        if len(preprocessors) == 1 and isinstance(preprocessors[0],
+                                                  (list, tuple)):
+            preprocessors = tuple(preprocessors[0])
+        self.preprocessors = list(preprocessors)
+
+    def pre_process(self, x, mask=None):
+        for p in self.preprocessors:
+            x = p.pre_process(x, mask)
+            mask = p.feed_forward_mask(mask)
+        return x
+
+    def feed_forward_mask(self, mask):
+        for p in self.preprocessors:
+            mask = p.feed_forward_mask(mask)
+        return mask
+
+    def get_output_type(self, input_type):
+        for p in self.preprocessors:
+            input_type = p.get_output_type(input_type)
+        return input_type
+
+
+@serde.register
+class ZeroMeanPrePreProcessor(InputPreProcessor):
+    """Subtract the per-column batch mean (reference
+    ``ZeroMeanPrePreProcessor.java``)."""
+
+    def pre_process(self, x, mask=None):
+        return x - x.mean(axis=0, keepdims=True)
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@serde.register
+class UnitVarianceProcessor(InputPreProcessor):
+    """Divide by the per-column batch std (reference
+    ``UnitVarianceProcessor.java``)."""
+
+    def pre_process(self, x, mask=None):
+        import jax.numpy as jnp
+
+        return x / jnp.maximum(x.std(axis=0, keepdims=True), 1e-8)
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@serde.register
+class ZeroMeanAndUnitVariancePreProcessor(InputPreProcessor):
+    """Per-column batch standardization (reference
+    ``ZeroMeanAndUnitVariancePreProcessor.java``)."""
+
+    def pre_process(self, x, mask=None):
+        import jax.numpy as jnp
+
+        c = x - x.mean(axis=0, keepdims=True)
+        return c / jnp.maximum(x.std(axis=0, keepdims=True), 1e-8)
+
+    def get_output_type(self, input_type):
+        return input_type
+
+
+@serde.register
+class BinomialSamplingPreProcessor(InputPreProcessor):
+    """Bernoulli-sample activations as probabilities (reference
+    ``BinomialSamplingPreProcessor.java`` — binary RBM-era stochastic
+    units). The key is derived from ``seed`` folded with a data-dependent
+    scalar, so samples vary across batches while remaining a pure traced
+    function (the preprocessor SPI runs inside the jitted step and has no
+    per-iteration rng plumbed through)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    def pre_process(self, x, mask=None):
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed),
+            (jnp.sum(x * 1e4)).astype(jnp.int32))
+        return jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0),
+                                    x.shape).astype(x.dtype)
+
+    def get_output_type(self, input_type):
+        return input_type

@@ -348,3 +348,36 @@ class TestPoolingAliases:
         assert type(p2) is Pooling2D and list(p2.kernel_size) == [3, 3]
         p1 = serde.decode(serde.encode(Pooling1D(kernel_size=4)))
         assert type(p1) is Pooling1D and p1.kernel_size == 4
+
+
+class TestAuxPreprocessors:
+    def test_normalizing_and_composable_preprocessors(self):
+        """reference preprocessor tail: ZeroMean / UnitVariance /
+        ZeroMeanAndUnitVariance / Composable / BinomialSampling."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf import serde
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.preprocessors import (
+            BinomialSamplingPreProcessor,
+            ComposableInputPreProcessor,
+            UnitVarianceProcessor,
+            ZeroMeanAndUnitVariancePreProcessor,
+            ZeroMeanPrePreProcessor,
+        )
+
+        x = jnp.asarray(
+            np.random.default_rng(0).random((8, 5)).astype(np.float32))
+        z = ZeroMeanAndUnitVariancePreProcessor().pre_process(x)
+        np.testing.assert_allclose(np.asarray(z).mean(0), 0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(z).std(0), 1, atol=1e-3)
+        comp = ComposableInputPreProcessor(
+            ZeroMeanPrePreProcessor(), UnitVarianceProcessor())
+        np.testing.assert_allclose(np.asarray(comp.pre_process(x)),
+                                   np.asarray(z), atol=1e-5)
+        assert comp.get_output_type(InputType.feed_forward(5)).size == 5
+        b = BinomialSamplingPreProcessor(seed=3).pre_process(x)
+        assert set(np.unique(np.asarray(b))) <= {0.0, 1.0}
+        rt = serde.decode(serde.encode(comp))
+        assert type(rt) is ComposableInputPreProcessor
+        assert len(rt.preprocessors) == 2
