@@ -114,29 +114,42 @@ class Evaluation:
         m = self._m()
         return m.sum(axis=1) - np.diag(m)
 
-    def precision(self, cls: Optional[int] = None) -> float:
+    def precision(self, cls: Optional[int] = None,
+                  averaging: str = "macro") -> float:
         tp, fp = self.true_positives(), self.false_positives()
         if cls is not None:
             d = tp[cls] + fp[cls]
             return float(tp[cls] / d) if d else 0.0
+        if averaging == "micro":  # reference EvaluationAveraging.Micro
+            d = tp.sum() + fp.sum()
+            return float(tp.sum() / d) if d else 0.0
         # macro-average over classes that appear (reference default)
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.where(tp + fp > 0, tp / (tp + fp), np.nan)
         valid = ~np.isnan(per)
         return float(np.nanmean(per)) if valid.any() else 0.0
 
-    def recall(self, cls: Optional[int] = None) -> float:
+    def recall(self, cls: Optional[int] = None,
+               averaging: str = "macro") -> float:
         tp, fn = self.true_positives(), self.false_negatives()
         if cls is not None:
             d = tp[cls] + fn[cls]
             return float(tp[cls] / d) if d else 0.0
+        if averaging == "micro":
+            d = tp.sum() + fn.sum()
+            return float(tp.sum() / d) if d else 0.0
         with np.errstate(divide="ignore", invalid="ignore"):
             per = np.where(tp + fn > 0, tp / (tp + fn), np.nan)
         valid = ~np.isnan(per)
         return float(np.nanmean(per)) if valid.any() else 0.0
 
-    def f1(self, cls: Optional[int] = None) -> float:
-        p, r = self.precision(cls), self.recall(cls)
+    def f1(self, cls: Optional[int] = None,
+           averaging: str = "macro") -> float:
+        """Macro: mean of per-class F1 is approximated (as the reference
+        does) by F1 of macro-P/macro-R; micro: F1 of micro-P/micro-R
+        (reference ``EvaluationAveraging`` Macro/Micro)."""
+        p = self.precision(cls, averaging=averaging)
+        r = self.recall(cls, averaging=averaging)
         return 2 * p * r / (p + r) if (p + r) else 0.0
 
     def merge(self, other: "Evaluation") -> None:

@@ -99,6 +99,15 @@ class ROC:
         fpr, tpr, _ = self._exact_curve()
         return fpr, tpr
 
+    def get_precision_recall_curve(self):
+        """(recall, precision) points of the exact PR curve (reference
+        ``PrecisionRecallCurve`` returned by
+        ``ROC.getPrecisionRecallCurve()``; area = calculate_auprc)."""
+        if self.threshold_steps > 0:
+            raise ValueError("curve export supported in exact mode")
+        _, tpr, prec = self._exact_curve()
+        return tpr, prec
+
     def merge(self, other: "ROC") -> None:
         if self.threshold_steps != other.threshold_steps:
             raise ValueError("Cannot merge ROC with different threshold modes")

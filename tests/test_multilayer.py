@@ -469,6 +469,40 @@ class TestTopNEvaluate:
         assert ev.top_n_total == 50
         assert ev.top_n_correct == 50  # top-2 of 2 classes always hits
 
+    def test_micro_macro_averaging_and_pr_curve(self):
+        """reference EvaluationAveraging Micro/Macro on precision/recall/
+        f1 + ROC.getPrecisionRecallCurve."""
+        from deeplearning4j_tpu.evaluation import Evaluation, ROC
+
+        labels = np.eye(3, dtype=np.float32)[[0, 0, 0, 0, 1, 1, 2, 2]]
+        preds = np.eye(3, dtype=np.float32)[[0, 0, 1, 2, 1, 1, 2, 0]]
+        ev = Evaluation()
+        ev.eval(labels, preds)
+        # micro precision == micro recall == accuracy for single-label
+        assert ev.precision(averaging="micro") == pytest.approx(
+            ev.accuracy())
+        assert ev.recall(averaging="micro") == pytest.approx(ev.accuracy())
+        assert ev.f1(averaging="micro") == pytest.approx(ev.accuracy())
+        # macro differs here (class imbalance) and stays in [0, 1]
+        assert 0.0 <= ev.precision(averaging="macro") <= 1.0
+        assert ev.precision(averaging="macro") != pytest.approx(
+            ev.precision(averaging="micro"))
+
+        rng = np.random.default_rng(3)
+        y = rng.integers(0, 2, 200)
+        s = np.clip(y * 0.6 + rng.random(200) * 0.5, 0, 1)
+        roc = ROC()
+        roc.eval(y.reshape(-1, 1).astype(np.float32),
+                 s.reshape(-1, 1).astype(np.float32))
+        rec, prec = roc.get_precision_recall_curve()
+        assert rec.shape == prec.shape and len(rec) > 10
+        assert rec.min() >= 0 and rec.max() <= 1
+        assert prec.min() >= 0 and prec.max() <= 1
+        # area under the exported points == calculate_auprc
+        from deeplearning4j_tpu.evaluation.roc import _auc
+
+        assert _auc(rec, prec) == pytest.approx(roc.calculate_auprc())
+
     def test_evaluate_roc_helpers(self):
         """evaluateROC / evaluateROCMultiClass model helpers (reference
         surface) on both model types."""
