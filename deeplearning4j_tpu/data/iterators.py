@@ -791,3 +791,210 @@ class FileDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._pos = 0
+
+
+# --------------------------------------------------------------------------
+# MultiDataSet variants of the utility combinators (reference
+# AsyncMultiDataSetIterator / AsyncShieldMultiDataSetIterator /
+# EarlyTerminationMultiDataSetIterator / SingletonMultiDataSetIterator /
+# BenchmarkMultiDataSetIterator / IteratorMultiDataSetIterator /
+# MultiDataSetIteratorSplitter / MultiDataSetIteratorAdapter). The single
+# combinators above are duck-typed over has_next/next/reset, so each multi
+# variant composes the SAME implementation around a MultiDataSetIterator
+# and only re-types the surface.
+class MultiDataSetIteratorAdapter(MultiDataSetIterator):
+    """Present a DataSetIterator as a 1-input/1-output
+    MultiDataSetIterator (reference ``MultiDataSetIteratorAdapter``) —
+    how single-input CG pipelines consume DataSet sources."""
+
+    def __init__(self, inner: DataSetIterator):
+        self.inner = inner
+
+    def has_next(self):
+        return self.inner.has_next()
+
+    def next(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        ds = self.inner.next()
+        return self._pp(MultiDataSet([ds.features], [ds.labels],
+                                     [ds.features_mask], [ds.labels_mask]))
+
+    def reset(self):
+        self.inner.reset()
+
+
+class SingletonMultiDataSetIterator(MultiDataSetIterator):
+    """One fixed MultiDataSet per epoch (reference
+    ``SingletonMultiDataSetIterator``)."""
+
+    def __init__(self, mds):
+        self._mds = mds
+        self._done = False
+
+    def has_next(self):
+        return not self._done
+
+    def next(self):
+        self._done = True
+        return self._pp(self._mds)
+
+    def reset(self):
+        self._done = False
+
+
+class _ComposedMulti(MultiDataSetIterator):
+    """Surface re-typing around a duck-typed single-style combinator."""
+
+    def __init__(self, impl):
+        self._impl = impl
+
+    def has_next(self):
+        return self._impl.has_next()
+
+    def next(self):
+        return self._impl.next()
+
+    def set_pre_processor(self, pp) -> None:
+        self._impl.set_pre_processor(pp)
+
+    def reset(self):
+        self._impl.reset()
+
+
+class EarlyTerminationMultiDataSetIterator(_ComposedMulti):
+    """(reference ``EarlyTerminationMultiDataSetIterator``)"""
+
+    def __init__(self, inner: MultiDataSetIterator, max_batches: int):
+        super().__init__(EarlyTerminationDataSetIterator(inner, max_batches))
+
+
+class AsyncMultiDataSetIterator(_ComposedMulti):
+    """Background-thread MultiDataSet prefetch (reference
+    ``AsyncMultiDataSetIterator``, the CG fit loop's auto-wrap)."""
+
+    def __init__(self, inner: MultiDataSetIterator, queue_size: int = 4):
+        super().__init__(AsyncDataSetIterator(inner, queue_size))
+
+    def shutdown(self):
+        self._impl.shutdown()
+
+
+class AsyncShieldMultiDataSetIterator(_ComposedMulti):
+    """(reference ``AsyncShieldMultiDataSetIterator``)"""
+
+    def __init__(self, inner: MultiDataSetIterator):
+        super().__init__(AsyncShieldDataSetIterator(inner))
+
+    def async_supported(self) -> bool:
+        return False
+
+
+class BenchmarkMultiDataSetIterator(MultiDataSetIterator):
+    """Replays one MultiDataSet N times (reference
+    ``BenchmarkMultiDataSetIterator``)."""
+
+    def __init__(self, example, total_batches: int):
+        self._example = example
+        self._total = int(total_batches)
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        self._count += 1
+        return self._pp(self._example)
+
+    def reset(self):
+        self._count = 0
+
+
+class IteratorMultiDataSetIterator(MultiDataSetIterator):
+    """Re-batch a stream of (possibly small) MultiDataSets into fixed
+    ``batch_size`` minibatches by concatenating along the example dim
+    (reference ``IteratorMultiDataSetIterator``). Per-slot masks must be
+    uniformly present or uniformly absent across merged pieces."""
+
+    def __init__(self, source, batch_size: int):
+        self._source = list(source)
+        self.batch_size = int(batch_size)
+        self._pos = 0
+        self._carry = None
+
+    @staticmethod
+    def _concat(pieces):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        def cat_slot(arrays):
+            present = [a is not None for a in arrays]
+            if not any(present):
+                return None
+            if not all(present):
+                raise ValueError(
+                    "IteratorMultiDataSetIterator: mask present in some "
+                    "merged pieces but not others")
+            return np.concatenate(arrays, axis=0)
+
+        n_f = len(pieces[0].features)
+        n_l = len(pieces[0].labels)
+        return MultiDataSet(
+            [np.concatenate([p.features[i] for p in pieces], 0)
+             for i in range(n_f)],
+            [np.concatenate([p.labels[i] for p in pieces], 0)
+             for i in range(n_l)],
+            [cat_slot([p.features_masks[i] for p in pieces])
+             for i in range(n_f)],
+            [cat_slot([p.labels_masks[i] for p in pieces])
+             for i in range(n_l)],
+        )
+
+    def has_next(self):
+        return self._carry is not None or self._pos < len(self._source)
+
+    def next(self):
+        pieces = [] if self._carry is None else [self._carry]
+        n = sum(p.num_examples() for p in pieces)
+        self._carry = None
+        while n < self.batch_size and self._pos < len(self._source):
+            p = self._source[self._pos]
+            self._pos += 1
+            pieces.append(p)
+            n += p.num_examples()
+        merged = self._concat(pieces)
+        if n > self.batch_size:
+            from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+            def cut(arrs, lo, hi):
+                return [None if a is None else a[lo:hi] for a in arrs]
+
+            self._carry = MultiDataSet(
+                cut(merged.features, self.batch_size, n),
+                cut(merged.labels, self.batch_size, n),
+                cut(merged.features_masks, self.batch_size, n),
+                cut(merged.labels_masks, self.batch_size, n))
+            merged = MultiDataSet(
+                cut(merged.features, 0, self.batch_size),
+                cut(merged.labels, 0, self.batch_size),
+                cut(merged.features_masks, 0, self.batch_size),
+                cut(merged.labels_masks, 0, self.batch_size))
+        return self._pp(merged)
+
+    def reset(self):
+        self._pos = 0
+        self._carry = None
+
+
+class MultiDataSetIteratorSplitter:
+    """Train/test split of a MultiDataSet stream by batch count
+    (reference ``MultiDataSetIteratorSplitter``)."""
+
+    def __init__(self, inner: MultiDataSetIterator, total_batches: int,
+                 ratio: float):
+        self._split = DataSetIteratorSplitter(inner, total_batches, ratio)
+
+    def get_train_iterator(self):
+        return _ComposedMulti(self._split.get_train_iterator())
+
+    def get_test_iterator(self):
+        return _ComposedMulti(self._split.get_test_iterator())

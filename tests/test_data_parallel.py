@@ -492,3 +492,118 @@ class TestMultiDataSetPreProcessor:
         out2 = it.next()
         np.testing.assert_allclose(out2.features[0], 2.0)  # not 4.0
         np.testing.assert_allclose(mds.features[0], 1.0)   # source raw
+
+
+class TestMultiDataSetIteratorVariants:
+    """reference Multi variants of the utility combinators:
+    Adapter/Singleton/EarlyTermination/Async(+Shield)/Benchmark/
+    Iterator-rebatch/Splitter."""
+
+    def _mds(self, n=8, seed=0):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        rng = np.random.default_rng(seed)
+        return MultiDataSet(
+            [rng.random((n, 4)).astype(np.float32)],
+            [np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]])
+
+    def test_adapter_singleton_early_benchmark(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.data.iterators import (
+            BenchmarkMultiDataSetIterator,
+            EarlyTerminationMultiDataSetIterator,
+            ExistingMultiDataSetIterator,
+            MultiDataSetIteratorAdapter,
+            SingletonMultiDataSetIterator,
+        )
+
+        ds = DataSet(np.ones((4, 3), np.float32),
+                     np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+        ad = MultiDataSetIteratorAdapter(ListDataSetIterator(ds, 4))
+        out = list(ad)
+        assert len(out) == 1 and isinstance(out[0], MultiDataSet)
+        assert out[0].features[0].shape == (4, 3)
+
+        s = SingletonMultiDataSetIterator(self._mds())
+        assert len(list(s)) == 1 and len(list(s)) == 1  # resets via iter
+
+        inner = ExistingMultiDataSetIterator([self._mds(seed=i)
+                                              for i in range(5)])
+        et = EarlyTerminationMultiDataSetIterator(inner, 3)
+        assert len(list(et)) == 3
+
+        b = BenchmarkMultiDataSetIterator(self._mds(), 7)
+        assert len(list(b)) == 7
+
+    def test_async_multi_and_shield(self):
+        from deeplearning4j_tpu.data.iterators import (
+            AsyncMultiDataSetIterator,
+            AsyncShieldMultiDataSetIterator,
+            ExistingMultiDataSetIterator,
+        )
+
+        src = [self._mds(seed=i) for i in range(6)]
+        a = AsyncMultiDataSetIterator(
+            ExistingMultiDataSetIterator(src), queue_size=2)
+        got = list(a)
+        assert len(got) == 6
+        np.testing.assert_array_equal(got[2].features[0], src[2].features[0])
+        got2 = list(a)  # reset + second epoch
+        assert len(got2) == 6
+        sh = AsyncShieldMultiDataSetIterator(
+            ExistingMultiDataSetIterator(src))
+        assert sh.async_supported() is False
+        assert len(list(sh)) == 6
+
+    def test_iterator_rebatch_and_splitter(self):
+        from deeplearning4j_tpu.data.iterators import (
+            ExistingMultiDataSetIterator,
+            IteratorMultiDataSetIterator,
+            MultiDataSetIteratorSplitter,
+        )
+
+        pieces = [self._mds(n=3, seed=i) for i in range(5)]  # 15 examples
+        it = IteratorMultiDataSetIterator(pieces, batch_size=4)
+        sizes = [m.num_examples() for m in it]
+        assert sum(sizes) == 15
+        assert all(s == 4 for s in sizes[:-1]), sizes
+        # identical content in order
+        cat = np.concatenate([m.features[0] for m in it], 0)
+        ref = np.concatenate([p.features[0] for p in pieces], 0)
+        np.testing.assert_array_equal(cat, ref)
+
+        sp = MultiDataSetIteratorSplitter(
+            ExistingMultiDataSetIterator([self._mds(seed=i)
+                                          for i in range(10)]),
+            total_batches=10, ratio=0.7)
+        assert len(list(sp.get_train_iterator())) == 7
+        assert len(list(sp.get_test_iterator())) == 3
+
+    def test_cg_fit_through_adapter_and_async(self):
+        """ComputationGraph trains from a DataSet source wrapped
+        Adapter -> AsyncMulti (the reference CG fit path shape)."""
+        from deeplearning4j_tpu.data.iterators import (
+            AsyncMultiDataSetIterator,
+            MultiDataSetIteratorAdapter,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.updaters import Adam
+
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        it = AsyncMultiDataSetIterator(MultiDataSetIteratorAdapter(
+            ListDataSetIterator(DataSet(x, y), 16)))
+        gconf = (
+            NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+            .weight_init("xavier").graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_layer("o", OutputLayer(n_out=2, activation="softmax",
+                                        loss="mcxent"), "d")
+            .set_outputs("o")
+            .set_input_types(InputType.feed_forward(4)).build()
+        )
+        g = ComputationGraph(gconf).init()
+        for _ in range(5):
+            g.fit(it)
+        assert float(g.score_) < 0.6
