@@ -368,3 +368,127 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
     def async_supported(self) -> bool:
         return False
+
+
+class RecordReaderMultiDataSetIterator:
+    """Multi-input/multi-output record bridge (reference
+    ``RecordReaderMultiDataSetIterator`` + ``.Builder``,
+    ``datasets/datavec/RecordReaderMultiDataSetIterator.java``): named
+    readers advanced in lockstep; each ``add_input`` /
+    ``add_output(_one_hot)`` spec cuts a column range of one reader's
+    record into its own array slot of the produced MultiDataSet —
+    exactly how multi-input ComputationGraphs consume tabular data.
+
+    Builder surface::
+
+        it = (RecordReaderMultiDataSetIterator.builder(batch_size)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)            # cols 0..3 inclusive
+              .add_output_one_hot("csv", 4, 3)   # col 4 -> one-hot(3)
+              .add_output("csv", 5, 6)           # regression cols
+              .build())
+    """
+
+    class Builder:
+        def __init__(self, batch_size: int):
+            self._batch = int(batch_size)
+            self._readers = {}
+            self._inputs = []
+            self._outputs = []
+
+        def add_reader(self, name: str, reader):
+            self._readers[name] = reader
+            return self
+
+        def add_input(self, reader_name: str, col_from: int = None,
+                      col_to: int = None):
+            self._inputs.append((reader_name, col_from, col_to, None))
+            return self
+
+        def add_input_one_hot(self, reader_name: str, column: int,
+                              num_classes: int):
+            self._inputs.append((reader_name, column, column, num_classes))
+            return self
+
+        def add_output(self, reader_name: str, col_from: int = None,
+                       col_to: int = None):
+            self._outputs.append((reader_name, col_from, col_to, None))
+            return self
+
+        def add_output_one_hot(self, reader_name: str, column: int,
+                               num_classes: int):
+            self._outputs.append((reader_name, column, column, num_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            if not self._readers:
+                raise ValueError("at least one add_reader(...) required")
+            for spec in self._inputs + self._outputs:
+                if spec[0] not in self._readers:
+                    raise ValueError(f"spec references unknown reader "
+                                     f"{spec[0]!r}")
+            if not self._inputs or not self._outputs:
+                raise ValueError("need at least one input and one output "
+                                 "spec")
+            return RecordReaderMultiDataSetIterator(self)
+
+    @staticmethod
+    def builder(batch_size: int) -> "RecordReaderMultiDataSetIterator.Builder":
+        return RecordReaderMultiDataSetIterator.Builder(batch_size)
+
+    def __init__(self, b: "RecordReaderMultiDataSetIterator.Builder"):
+        self.batch_size = b._batch
+        self.readers = dict(b._readers)
+        self.input_specs = list(b._inputs)
+        self.output_specs = list(b._outputs)
+        self.pre_processor = None
+
+    # ------------------------------------------------------------- protocol
+    def has_next(self) -> bool:
+        return all(r.has_next() for r in self.readers.values())
+
+    def _cut(self, values, spec):
+        _, lo, hi, one_hot = spec
+        row = np.asarray([float(v) for v in values], np.float32)
+        lo = 0 if lo is None else lo
+        hi = len(row) - 1 if hi is None else hi
+        seg = row[lo:hi + 1]
+        if one_hot is not None:
+            out = np.zeros((one_hot,), np.float32)
+            out[int(seg[0])] = 1.0
+            return out
+        return seg
+
+    def next(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        ins = [[] for _ in self.input_specs]
+        outs = [[] for _ in self.output_specs]
+        n = 0
+        while n < self.batch_size and self.has_next():
+            records = {name: r.next_record()
+                       for name, r in self.readers.items()}
+            for i, spec in enumerate(self.input_specs):
+                ins[i].append(self._cut(records[spec[0]], spec))
+            for i, spec in enumerate(self.output_specs):
+                outs[i].append(self._cut(records[spec[0]], spec))
+            n += 1
+        if n == 0:
+            raise ValueError("RecordReaderMultiDataSetIterator exhausted")
+        mds = MultiDataSet([np.stack(a) for a in ins],
+                           [np.stack(a) for a in outs])
+        if self.pre_processor is not None:
+            mds = self.pre_processor.pre_process(mds)
+        return mds
+
+    def set_pre_processor(self, pp) -> None:
+        self.pre_processor = pp
+
+    def reset(self) -> None:
+        for r in self.readers.values():
+            r.reset()
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()

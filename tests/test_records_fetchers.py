@@ -403,3 +403,60 @@ class TestNativeNlpKernels:
             m_ref[i, :len(js)] = 1.0
         np.testing.assert_array_equal(ctx, ctx_ref)
         np.testing.assert_array_equal(m, m_ref)
+
+
+class TestRecordReaderMultiDataSetIterator:
+    def test_multi_reader_multi_slot(self, csv_file, tmp_path):
+        """reference RecordReaderMultiDataSetIterator.Builder: two named
+        readers in lockstep, column-range inputs, one-hot + regression
+        outputs, each in its own MultiDataSet slot."""
+        from deeplearning4j_tpu.data.records import (
+            RecordReaderMultiDataSetIterator,
+        )
+
+        # second reader: a shifted copy of the same 40 rows
+        rows = open(csv_file).read().strip().split("\n")[1:]
+        p2 = tmp_path / "aux.csv"
+        p2.write_text("\n".join(
+            ",".join(f"{float(v) + 10:.4f}" for v in r.split(",")[:3])
+            for r in rows) + "\n")
+
+        it = (RecordReaderMultiDataSetIterator.builder(16)
+              .add_reader("main", CSVRecordReader(csv_file,
+                                                  skip_num_lines=1))
+              .add_reader("aux", CSVRecordReader(str(p2)))
+              .add_input("main", 0, 1)
+              .add_input("aux", 0, 2)
+              .add_output_one_hot("main", 3, 3)
+              .add_output("main", 2, 2)
+              .build())
+        mds = it.next()
+        assert len(mds.features) == 2 and len(mds.labels) == 2
+        assert mds.features[0].shape == (16, 2)
+        assert mds.features[1].shape == (16, 3)
+        assert mds.labels[0].shape == (16, 3)
+        assert np.all(mds.labels[0].sum(1) == 1)
+        assert mds.labels[1].shape == (16, 1)
+        # aux reader really is the +10 shifted main columns
+        np.testing.assert_allclose(mds.features[1][:, :2],
+                                   mds.features[0] + 10, atol=1e-3)
+        total = 16
+        while it.has_next():
+            total += it.next().features[0].shape[0]
+        assert total == 40
+        it.reset()
+        assert it.has_next()
+
+    def test_builder_validation(self, csv_file):
+        from deeplearning4j_tpu.data.records import (
+            RecordReaderMultiDataSetIterator,
+        )
+
+        b = RecordReaderMultiDataSetIterator.builder(8)
+        with pytest.raises(ValueError, match="add_reader"):
+            b.build()
+        b.add_reader("r", CSVRecordReader(csv_file, skip_num_lines=1))
+        b.add_input("nope", 0, 1)
+        b.add_output("r", 3, 3)
+        with pytest.raises(ValueError, match="unknown reader"):
+            b.build()
