@@ -41,6 +41,7 @@ from deeplearning4j_tpu.data.records import (
     SequenceRecordReaderDataSetIterator,
 )
 from deeplearning4j_tpu.data.fetchers import (
+    CifarDataSetIterator,
     SvhnDataSetIterator,
     TinyImageNetDataSetIterator,
     UciSequenceDataSetIterator,
@@ -56,7 +57,7 @@ __all__ = [
     "ImageRecordReader", "SequenceRecordReader",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ALIGN_START", "ALIGN_END", "EQUAL_LENGTH",
-    "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
+    "CifarDataSetIterator", "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
     "UciSequenceDataSetIterator",
     "IteratorDataSetIterator", "DoublesDataSetIterator",
     "FloatsDataSetIterator", "ReconstructionDataSetIterator",

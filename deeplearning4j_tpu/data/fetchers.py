@@ -251,3 +251,84 @@ class UciSequenceDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._pos = 0
+
+
+# --------------------------------------------------------------------------
+# CIFAR-10 / CIFAR-100 — 32×32×3 (reference ``CifarDataSetIterator``)
+# --------------------------------------------------------------------------
+def load_cifar(train: bool = True, num_examples: Optional[int] = None,
+               seed: int = 7, coarse: bool = False,
+               cifar100: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    """(x (N,32,32,3) float32 in [0,1], y one-hot). Real data: the
+    official binary batches under ``$CACHE/cifar/cifar-10-batches-bin/``
+    (``data_batch_{1..5}.bin`` / ``test_batch.bin``, 3073-byte records:
+    label + 3072 CHW pixels) or ``$CACHE/cifar/cifar-100-binary/``
+    ({train,test}.bin, 3074-byte records: coarse+fine labels). Synthetic
+    class-textured fallback otherwise (zero-egress image)."""
+    if cifar100:
+        base = os.path.join(CACHE_DIR, "cifar", "cifar-100-binary")
+        files = [os.path.join(base, "train.bin" if train else "test.bin")]
+        n_classes, rec, label_off = (20 if coarse else 100), 3074, (
+            0 if coarse else 1)
+    else:
+        base = os.path.join(CACHE_DIR, "cifar", "cifar-10-batches-bin")
+        files = ([os.path.join(base, f"data_batch_{i}.bin")
+                  for i in range(1, 6)] if train
+                 else [os.path.join(base, "test_batch.bin")])
+        n_classes, rec, label_off = 10, 3073, 0
+    if all(os.path.exists(f) for f in files):
+        xs, ys = [], []
+        for f in files:
+            raw = np.frombuffer(open(f, "rb").read(), np.uint8)
+            raw = raw.reshape(-1, rec)
+            ys.append(raw[:, label_off].astype(int))
+            px = raw[:, rec - 3072:]
+            xs.append(px.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        y = np.concatenate(ys)
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        return x, np.eye(n_classes, dtype=np.float32)[y]
+
+    n = num_examples or (2048 if train else 512)
+    n_classes = (20 if coarse else 100) if cifar100 else 10
+    rng = np.random.default_rng(seed + (0 if train else 1))
+    cls = rng.integers(0, n_classes, n)
+    # per-class color + frequency texture: linearly separable enough for
+    # smoke training, clearly not constant per class
+    xs = np.zeros((n, 32, 32, 3), np.float32)
+    ii, jj = np.meshgrid(np.arange(32), np.arange(32), indexing="ij")
+    for i, c in enumerate(cls):
+        crng = np.random.default_rng(1000 + int(c))
+        color = 0.3 + 0.7 * crng.random(3)
+        freq = 1 + (c % 5)
+        tex = 0.5 + 0.5 * np.sin(2 * np.pi * freq * (ii + jj) / 32.0)
+        xs[i] = tex[..., None] * color
+    xs += rng.standard_normal(xs.shape).astype(np.float32) * 0.05
+    xs = np.clip(xs, 0, 1)
+    return xs, np.eye(n_classes, dtype=np.float32)[cls]
+
+
+class CifarDataSetIterator(DataSetIterator):
+    """(reference ``CifarDataSetIterator``; CIFAR-10 by default,
+    ``cifar100=True`` (+``use_coarse_labels``) for CIFAR-100)."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 num_examples: Optional[int] = None, seed: int = 7,
+                 cifar100: bool = False, use_coarse_labels: bool = False):
+        self.x, self.y = load_cifar(train, num_examples, seed,
+                                    coarse=use_coarse_labels,
+                                    cifar100=cifar100)
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.x)
+
+    def next(self) -> DataSet:
+        lo, hi = self._pos, min(self._pos + self.batch_size, len(self.x))
+        self._pos = hi
+        return self._pp(DataSet(self.x[lo:hi], self.y[lo:hi]))
+
+    def reset(self) -> None:
+        self._pos = 0

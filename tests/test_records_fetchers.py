@@ -460,3 +460,44 @@ class TestRecordReaderMultiDataSetIterator:
         b.add_output("r", 3, 3)
         with pytest.raises(ValueError, match="unknown reader"):
             b.build()
+
+
+class TestCifar:
+    def test_synthetic_fallback_shapes(self):
+        from deeplearning4j_tpu.data.fetchers import CifarDataSetIterator
+
+        it = CifarDataSetIterator(32, train=True, num_examples=64)
+        ds = it.next()
+        assert ds.features.shape == (32, 32, 32, 3)
+        assert ds.labels.shape == (32, 10)
+        assert 0.0 <= ds.features.min() and ds.features.max() <= 1.0
+        c100 = CifarDataSetIterator(16, num_examples=16, cifar100=True,
+                                    use_coarse_labels=True)
+        assert c100.next().labels.shape == (16, 20)
+
+    def test_official_binary_format(self, tmp_path, monkeypatch):
+        """Write a real-format cifar-10 binary batch into a fake cache
+        dir and read it back through the official-format path."""
+        import deeplearning4j_tpu.data.fetchers as F
+
+        monkeypatch.setattr(F, "CACHE_DIR", str(tmp_path))
+        d = tmp_path / "cifar" / "cifar-10-batches-bin"
+        d.mkdir(parents=True)
+        rng = np.random.default_rng(0)
+        n = 10
+        recs = []
+        labels = rng.integers(0, 10, n).astype(np.uint8)
+        pixels = rng.integers(0, 256, (n, 3072)).astype(np.uint8)
+        for i in range(n):
+            recs.append(np.concatenate([[labels[i]], pixels[i]]))
+        blob = np.stack(recs).astype(np.uint8).tobytes()
+        for i in range(1, 6):
+            (d / f"data_batch_{i}.bin").write_bytes(blob)
+        x, y = F.load_cifar(train=True)
+        assert x.shape == (50, 32, 32, 3) and y.shape == (50, 10)
+        np.testing.assert_array_equal(y[:n].argmax(1), labels)
+        # CHW -> HWC pixel mapping: channel 0 plane comes first
+        np.testing.assert_allclose(
+            x[0, 0, 0, 0], pixels[0, 0] / 255.0, atol=1e-6)
+        np.testing.assert_allclose(
+            x[0, 0, 0, 1], pixels[0, 1024] / 255.0, atol=1e-6)
