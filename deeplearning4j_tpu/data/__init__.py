@@ -42,6 +42,7 @@ from deeplearning4j_tpu.data.records import (
 )
 from deeplearning4j_tpu.data.fetchers import (
     CifarDataSetIterator,
+    LFWDataSetIterator,
     SvhnDataSetIterator,
     TinyImageNetDataSetIterator,
     UciSequenceDataSetIterator,
@@ -57,7 +58,7 @@ __all__ = [
     "ImageRecordReader", "SequenceRecordReader",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ALIGN_START", "ALIGN_END", "EQUAL_LENGTH",
-    "CifarDataSetIterator", "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
+    "CifarDataSetIterator", "LFWDataSetIterator", "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
     "UciSequenceDataSetIterator",
     "IteratorDataSetIterator", "DoublesDataSetIterator",
     "FloatsDataSetIterator", "ReconstructionDataSetIterator",

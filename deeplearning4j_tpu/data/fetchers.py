@@ -332,3 +332,83 @@ class CifarDataSetIterator(DataSetIterator):
 
     def reset(self) -> None:
         self._pos = 0
+
+
+# --------------------------------------------------------------------------
+# LFW (Labeled Faces in the Wild) — face-identity images
+# --------------------------------------------------------------------------
+def load_lfw(num_examples: Optional[int] = None, image_size: int = 64,
+             min_images_per_person: int = 2, seed: int = 9
+             ) -> Tuple[np.ndarray, np.ndarray, list]:
+    """(x (N,H,W,3) in [0,1], y one-hot, person names). Real data: the
+    standard ``lfw/<Person_Name>/*.jpg`` layout under ``$CACHE/lfw/``
+    (people with fewer than ``min_images_per_person`` images are
+    dropped, as the reference's LFW loader filters). Synthetic
+    per-identity face-blob fallback otherwise."""
+    base = os.path.join(CACHE_DIR, "lfw", "lfw")
+    if os.path.isdir(base):
+        people = sorted(
+            p for p in os.listdir(base)
+            if os.path.isdir(os.path.join(base, p))
+            and len(os.listdir(os.path.join(base, p)))
+            >= min_images_per_person)
+        from PIL import Image
+
+        files = [(os.path.join(base, p, f), i)
+                 for i, p in enumerate(people)
+                 for f in sorted(os.listdir(os.path.join(base, p)))]
+        if num_examples:
+            files = files[:num_examples]
+        xs = np.zeros((len(files), image_size, image_size, 3), np.float32)
+        ys = np.zeros(len(files), int)
+        for k, (path, idx) in enumerate(files):
+            img = Image.open(path).convert("RGB").resize(
+                (image_size, image_size))
+            xs[k] = np.asarray(img, np.float32) / 255.0
+            ys[k] = idx
+        return xs, np.eye(len(people), dtype=np.float32)[ys], people
+
+    n_people = 16
+    n = num_examples or 256
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, n_people, n)
+    xs = np.zeros((n, image_size, image_size, 3), np.float32)
+    ii, jj = np.meshgrid(np.linspace(-1, 1, image_size),
+                         np.linspace(-1, 1, image_size), indexing="ij")
+    for i, c in enumerate(cls):
+        crng = np.random.default_rng(2000 + int(c))
+        cy, cx, rr = crng.normal(0, 0.2, 2).tolist() + [0.5 + 0.3 * crng.random()]
+        skin = 0.4 + 0.5 * crng.random(3)
+        face = np.exp(-(((ii - cy) ** 2 + (jj - cx) ** 2) / (rr ** 2)))
+        xs[i] = face[..., None] * skin + 0.1
+    xs += rng.standard_normal(xs.shape).astype(np.float32) * 0.03
+    xs = np.clip(xs, 0, 1)
+    names = [f"person_{i}" for i in range(n_people)]
+    return xs, np.eye(n_people, dtype=np.float32)[cls], names
+
+
+class LFWDataSetIterator(DataSetIterator):
+    """(reference ``LFWDataSetIterator`` — face-identity classification
+    batches; pairs-mode verification is served by the FaceNet zoo model's
+    embedding head instead.)"""
+
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 image_size: int = 64, seed: int = 9):
+        self.x, self.y, self.people = load_lfw(num_examples, image_size,
+                                               seed=seed)
+        self.batch_size = batch_size
+        self._pos = 0
+
+    def num_labels(self) -> int:
+        return len(self.people)
+
+    def has_next(self) -> bool:
+        return self._pos < len(self.x)
+
+    def next(self) -> DataSet:
+        lo, hi = self._pos, min(self._pos + self.batch_size, len(self.x))
+        self._pos = hi
+        return self._pp(DataSet(self.x[lo:hi], self.y[lo:hi]))
+
+    def reset(self) -> None:
+        self._pos = 0

@@ -501,3 +501,29 @@ class TestCifar:
             x[0, 0, 0, 0], pixels[0, 0] / 255.0, atol=1e-6)
         np.testing.assert_allclose(
             x[0, 0, 0, 1], pixels[0, 1024] / 255.0, atol=1e-6)
+
+
+class TestLfw:
+    def test_synthetic_and_real_dir(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.data import LFWDataSetIterator
+        import deeplearning4j_tpu.data.fetchers as F
+
+        it = LFWDataSetIterator(16, num_examples=32)
+        ds = it.next()
+        assert ds.features.shape == (16, 64, 64, 3)
+        assert ds.labels.shape[1] == it.num_labels() == 16
+
+        # real directory layout: person dirs with >= 2 images kept
+        from PIL import Image
+
+        monkeypatch.setattr(F, "CACHE_DIR", str(tmp_path))
+        base = tmp_path / "lfw" / "lfw"
+        for person, n in [("Ada_L", 3), ("Bob_K", 2), ("Solo_X", 1)]:
+            d = base / person
+            d.mkdir(parents=True)
+            for i in range(n):
+                Image.new("RGB", (80, 80),
+                          (10 * i, 100, 50)).save(d / f"{i}.jpg")
+        x, y, people = F.load_lfw(image_size=32)
+        assert people == ["Ada_L", "Bob_K"]  # Solo_X filtered (<2 images)
+        assert x.shape == (5, 32, 32, 3) and y.shape == (5, 2)
