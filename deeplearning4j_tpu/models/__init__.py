@@ -2,6 +2,14 @@
 programmatically, ``zoo/model/*.java``)."""
 
 from deeplearning4j_tpu.models.alexnet import AlexNet
+from deeplearning4j_tpu.models.labels import (
+    BaseLabels,
+    COCOLabels,
+    ClassPrediction,
+    DarknetLabels,
+    ImageNetLabels,
+    VOCLabels,
+)
 from deeplearning4j_tpu.models.darknet import TinyYOLO, YOLO2, Darknet19
 from deeplearning4j_tpu.models.facenet import FaceNetNN4Small2, InceptionResNetV1
 from deeplearning4j_tpu.models.googlenet import GoogLeNet
@@ -20,4 +28,6 @@ __all__ = [
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TinyYOLO", "VGG16", "VGG19", "YOLO2",
     "TransformerLM",
+    "BaseLabels", "ClassPrediction", "ImageNetLabels", "DarknetLabels",
+    "COCOLabels", "VOCLabels",
 ]

@@ -1,0 +1,132 @@
+"""Zoo prediction-label decoders (reference ``zoo/util/``:
+``Labels``/``BaseLabels``/``ClassPrediction`` SPI with
+``ImageNetLabels``, ``DarknetLabels``, ``COCOLabels``, ``VOCLabels``).
+
+``decode_predictions(probs, n)`` turns a (batch, classes) probability
+array into per-example top-n ``ClassPrediction(number, label,
+probability)`` lists. COCO-80 and VOC-20 class lists are embedded; the
+1000-class ImageNet/Darknet lists load from
+``$DL4J_TPU_CACHE/labels/{imagenet,darknet}_labels.txt`` (one label per
+line — this image has zero egress, so the standard files are cache-gated
+like the dataset fetchers) and fall back to ``class_%04d`` placeholders
+so decoding always works."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.mnist import CACHE_DIR
+
+
+class ClassPrediction:
+    """(reference ``ClassPrediction``)"""
+
+    def __init__(self, number: int, label: str, probability: float):
+        self.number = int(number)
+        self.label = label
+        self.probability = float(probability)
+
+    def __repr__(self):
+        return (f"ClassPrediction(number={self.number}, "
+                f"label={self.label!r}, probability={self.probability:.4f})")
+
+
+class BaseLabels:
+    """(reference ``BaseLabels``: label lookup + top-n decoding)"""
+
+    def __init__(self, labels: List[str]):
+        self._labels = list(labels)
+
+    def get_label(self, n: int) -> str:
+        return self._labels[n]
+
+    def num_classes(self) -> int:
+        return len(self._labels)
+
+    def decode_predictions(self, predictions: np.ndarray, n: int = 5
+                           ) -> List[List[ClassPrediction]]:
+        p = np.asarray(predictions)
+        if p.ndim == 1:
+            p = p[None]
+        if p.shape[1] != len(self._labels):
+            raise ValueError(
+                f"predictions have {p.shape[1]} classes, labels have "
+                f"{len(self._labels)}")
+        out = []
+        for row in p:
+            top = np.argsort(-row)[:n]
+            out.append([ClassPrediction(int(i), self._labels[int(i)],
+                                        float(row[int(i)]))
+                        for i in top])
+        return out
+
+
+def _cached_or_placeholder(filename: str, n: int, what: str) -> List[str]:
+    path = os.path.join(CACHE_DIR, "labels", filename)
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as f:
+            labels = [line.strip() for line in f if line.strip()]
+        if len(labels) != n:
+            raise ValueError(
+                f"{path} has {len(labels)} labels, expected {n}")
+        return labels
+    return [f"{what}_{i:04d}" for i in range(n)]
+
+
+class ImageNetLabels(BaseLabels):
+    """(reference ``ImageNetLabels`` — 1000 ILSVRC classes; real names
+    from the cache-gated labels file)"""
+
+    def __init__(self):
+        super().__init__(_cached_or_placeholder(
+            "imagenet_labels.txt", 1000, "class"))
+
+
+class DarknetLabels(BaseLabels):
+    """(reference ``DarknetLabels`` — Darknet19's 1000-class list)"""
+
+    def __init__(self):
+        super().__init__(_cached_or_placeholder(
+            "darknet_labels.txt", 1000, "class"))
+
+
+_COCO_80 = [
+    "person", "bicycle", "car", "motorbike", "aeroplane", "bus", "train",
+    "truck", "boat", "traffic light", "fire hydrant", "stop sign",
+    "parking meter", "bench", "bird", "cat", "dog", "horse", "sheep",
+    "cow", "elephant", "bear", "zebra", "giraffe", "backpack", "umbrella",
+    "handbag", "tie", "suitcase", "frisbee", "skis", "snowboard",
+    "sports ball", "kite", "baseball bat", "baseball glove", "skateboard",
+    "surfboard", "tennis racket", "bottle", "wine glass", "cup", "fork",
+    "knife", "spoon", "bowl", "banana", "apple", "sandwich", "orange",
+    "broccoli", "carrot", "hot dog", "pizza", "donut", "cake", "chair",
+    "sofa", "pottedplant", "bed", "diningtable", "toilet", "tvmonitor",
+    "laptop", "mouse", "remote", "keyboard", "cell phone", "microwave",
+    "oven", "toaster", "sink", "refrigerator", "book", "clock", "vase",
+    "scissors", "teddy bear", "hair drier", "toothbrush",
+]
+
+_VOC_20 = [
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor",
+]
+
+
+class COCOLabels(BaseLabels):
+    """(reference ``COCOLabels`` — the 80 COCO detection classes in
+    Darknet order, as YOLO2 consumes)"""
+
+    def __init__(self):
+        super().__init__(list(_COCO_80))
+
+
+class VOCLabels(BaseLabels):
+    """(reference ``VOCLabels`` — the 20 PASCAL VOC classes, as TinyYOLO
+    consumes)"""
+
+    def __init__(self):
+        super().__init__(list(_VOC_20))

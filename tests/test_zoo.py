@@ -245,3 +245,46 @@ class TestPretrainedRoundTrip:
             assert "imagenet" not in ZooModel.pretrained_checksums
         finally:
             LeNet.pretrained_checksums.pop("imagenet", None)
+
+
+class TestLabels:
+    def test_decode_predictions(self, tmp_path, monkeypatch):
+        """reference zoo/util Labels SPI: top-n ClassPrediction decoding,
+        embedded COCO/VOC lists, cache-gated ImageNet names with
+        placeholder fallback."""
+        from deeplearning4j_tpu.models import (
+            COCOLabels,
+            ImageNetLabels,
+            VOCLabels,
+        )
+
+        voc = VOCLabels()
+        assert voc.num_classes() == 20
+        assert voc.get_label(14) == "person"
+        probs = np.zeros((2, 20), np.float32)
+        probs[0, 14] = 0.9
+        probs[0, 7] = 0.1
+        probs[1, 0] = 1.0
+        decoded = voc.decode_predictions(probs, n=2)
+        assert decoded[0][0].label == "person"
+        assert decoded[0][0].probability == pytest.approx(0.9)
+        assert decoded[0][1].label == "cat"
+        assert decoded[1][0].label == "aeroplane"
+
+        assert COCOLabels().num_classes() == 80
+        inl = ImageNetLabels()  # placeholder fallback (no cache file)
+        assert inl.num_classes() == 1000
+        assert inl.get_label(3) == "class_0003"
+
+        # cache-gated real names
+        import deeplearning4j_tpu.models.labels as L
+
+        monkeypatch.setattr(L, "CACHE_DIR", str(tmp_path))
+        d = tmp_path / "labels"
+        d.mkdir()
+        (d / "imagenet_labels.txt").write_text(
+            "\n".join(f"name_{i}" for i in range(1000)))
+        assert ImageNetLabels().get_label(42) == "name_42"
+
+        with pytest.raises(ValueError, match="classes"):
+            voc.decode_predictions(np.zeros((1, 5), np.float32))
