@@ -9,6 +9,7 @@ from deeplearning4j_tpu.graph.walks import (
 )
 from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphVectors
 from deeplearning4j_tpu.graph.node2vec import BiasedRandomWalkIterator, Node2Vec
+from deeplearning4j_tpu.graph.loader import GraphLoader
 from deeplearning4j_tpu.graph.serializer import (
     GraphVectorSerializer,
     StaticGraphVectors,
@@ -17,5 +18,5 @@ from deeplearning4j_tpu.graph.serializer import (
 __all__ = [
     "Graph", "RandomWalkIterator", "WeightedRandomWalkIterator",
     "DeepWalk", "GraphVectors", "Node2Vec", "BiasedRandomWalkIterator",
-    "GraphVectorSerializer", "StaticGraphVectors",
+    "GraphVectorSerializer", "StaticGraphVectors", "GraphLoader",
 ]

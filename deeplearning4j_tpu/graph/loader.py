@@ -1,0 +1,61 @@
+"""Graph file loading (reference ``graph/data/GraphLoader.java``:
+edge-list / weighted-edge-list / adjacency-list text formats, with the
+delimiter and directed/undirected options)."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.graph.graph import Graph
+
+
+class GraphLoader:
+    @staticmethod
+    def load_undirected_graph_edge_list_file(path: str, num_vertices: int,
+                                             delim: str = ",") -> Graph:
+        """Lines ``a<delim>b`` add an undirected edge (reference
+        ``loadUndirectedGraphEdgeListFile``)."""
+        g = Graph(num_vertices)
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                a, b = line.split(delim)[:2]
+                g.add_edge(int(a), int(b), directed=False)
+        return g
+
+    @staticmethod
+    def load_weighted_edge_list_file(path: str, num_vertices: int,
+                                     delim: str = ",",
+                                     directed: bool = False) -> Graph:
+        """Lines ``a<delim>b<delim>weight`` (reference
+        ``loadWeightedEdgeListFile``)."""
+        g = Graph(num_vertices)
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                a, b, w = line.split(delim)[:3]
+                g.add_edge(int(a), int(b), weight=float(w),
+                           directed=directed)
+        return g
+
+    @staticmethod
+    def load_adjacency_list_file(path: str, num_vertices: int,
+                                 delim: str = ",") -> Graph:
+        """Each line ``v<delim>n1<delim>n2...`` lists vertex v's (directed)
+        neighbours (the reference's adjacency-list processor shape)."""
+        g = Graph(num_vertices)
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = [p for p in line.strip().split(delim) if p != ""]
+                if not parts or parts[0].startswith("#"):
+                    continue
+                v = int(parts[0])
+                for n in parts[1:]:
+                    g.add_edge(v, int(n), directed=True)
+        return g
+
+    # reference-parity names
+    loadUndirectedGraphEdgeListFile = load_undirected_graph_edge_list_file
+    loadWeightedEdgeListFile = load_weighted_edge_list_file

@@ -553,3 +553,33 @@ class TestRPForestShortRows:
         assert np.all((idxs >= 0) & (idxs < 30))
         # clamped tail repeats the farthest real hit, monotone distances
         assert np.all(np.diff(ds, axis=1) >= -1e-5)
+
+
+class TestGraphLoader:
+    def test_edge_list_formats(self, tmp_path):
+        """reference GraphLoader: edge-list / weighted / adjacency."""
+        from deeplearning4j_tpu.graph import GraphLoader
+
+        p = tmp_path / "edges.csv"
+        p.write_text("# comment\n0,1\n1,2\n2,3\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 4)
+        assert g.num_vertices() == 4
+        assert sorted(g.get_connected_vertices(1)) == [0, 2]
+
+        w = tmp_path / "weighted.csv"
+        w.write_text("0,1,0.5\n1,2,2.0\n")
+        gw = GraphLoader.load_weighted_edge_list_file(str(w), 3)
+        assert gw.get_edge_weights(1) == [0.5, 2.0]
+        gd = GraphLoader.load_weighted_edge_list_file(str(w), 3,
+                                                     directed=True)
+        assert gd.get_connected_vertices(1) == [2]  # 0->1 not reversed
+
+        a = tmp_path / "adj.txt"
+        a.write_text("0,1,2\n1,2\n2\n")
+        ga = GraphLoader.load_adjacency_list_file(str(a), 3)
+        assert sorted(ga.get_connected_vertices(0)) == [1, 2]
+        assert ga.get_connected_vertices(2) == []
+
+        # camelCase parity alias
+        g2 = GraphLoader.loadUndirectedGraphEdgeListFile(str(p), 4)
+        assert sorted(g2.get_connected_vertices(1)) == [0, 2]
