@@ -17,6 +17,12 @@ from deeplearning4j_tpu.clustering.distances import (
     batched_knn,
 )
 from deeplearning4j_tpu.clustering.vptree import VPTree, KDTree
+from deeplearning4j_tpu.clustering.strategy import (
+    BaseClusteringAlgorithm,
+    ConvergenceCondition,
+    FixedClusterCountStrategy,
+    FixedIterationCountCondition,
+)
 from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
 from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 from deeplearning4j_tpu.clustering.sptree import SpTree, QuadTree
@@ -24,6 +30,8 @@ from deeplearning4j_tpu.clustering.rptree import RPTree, RPForest
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 
 __all__ = [
+    "BaseClusteringAlgorithm", "FixedClusterCountStrategy",
+    "ConvergenceCondition", "FixedIterationCountCondition",
     "pairwise_distance", "batched_knn", "VPTree", "KDTree",
     "KMeansClustering", "RandomProjectionLSH", "SpTree", "QuadTree",
     "RPTree", "RPForest", "BarnesHutTsne", "Tsne",

@@ -1,0 +1,100 @@
+"""Clustering-strategy framework facade (reference
+``clustering/strategy/{ClusteringStrategy,FixedClusterCountStrategy,
+BaseClusteringStrategy}.java`` + ``clustering/algorithm/
+BaseClusteringAlgorithm.java`` + ``clustering/condition/*``): strategy
+objects describe WHAT to cluster toward (fixed k, distance function,
+termination conditions), ``BaseClusteringAlgorithm.setup(strategy)``
+executes it. Execution routes to the MXU-batched
+:class:`~deeplearning4j_tpu.clustering.kmeans.KMeansClustering`."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deeplearning4j_tpu.clustering.kmeans import ClusterSet, KMeansClustering
+
+
+class FixedIterationCountCondition:
+    """(reference ``condition/FixedIterationCountCondition``)"""
+
+    def __init__(self, iteration_count: int):
+        self.iteration_count = int(iteration_count)
+
+    @staticmethod
+    def iteration_count_greater_than(n: int) -> "FixedIterationCountCondition":
+        return FixedIterationCountCondition(n)
+
+
+class ConvergenceCondition:
+    """(reference ``condition/ConvergenceCondition`` — stop when the
+    point-distribution variation rate drops below the rate)"""
+
+    def __init__(self, rate: float):
+        self.rate = float(rate)
+
+    @staticmethod
+    def distribution_variation_rate_less_than(rate: float
+                                              ) -> "ConvergenceCondition":
+        return ConvergenceCondition(rate)
+
+
+class FixedClusterCountStrategy:
+    """(reference ``FixedClusterCountStrategy.setup(k, distanceFunction,
+    inverse)`` + the fluent termination setters on
+    ``BaseClusteringStrategy``)"""
+
+    def __init__(self, cluster_count: int,
+                 distance_function: str = "euclidean"):
+        self.cluster_count = int(cluster_count)
+        self.distance_function = distance_function
+        self.termination: Optional[object] = None
+        self.seed = 42
+
+    @staticmethod
+    def setup(cluster_count: int, distance_function: str = "euclidean",
+              inverse: bool = False) -> "FixedClusterCountStrategy":
+        # ``inverse`` flags similarity-style distance functions in the
+        # reference; cosine similarity is already a distance here
+        return FixedClusterCountStrategy(cluster_count, distance_function)
+
+    def end_when_iteration_count_equals(self, n: int
+                                        ) -> "FixedClusterCountStrategy":
+        self.termination = FixedIterationCountCondition(n)
+        return self
+
+    def end_when_distribution_variation_rate_less_than(
+            self, rate: float) -> "FixedClusterCountStrategy":
+        self.termination = ConvergenceCondition(rate)
+        return self
+
+    def with_seed(self, seed: int) -> "FixedClusterCountStrategy":
+        self.seed = int(seed)
+        return self
+
+
+class BaseClusteringAlgorithm:
+    """(reference ``BaseClusteringAlgorithm.setup(strategy)`` →
+    ``applyTo(points)``)"""
+
+    def __init__(self, strategy: FixedClusterCountStrategy):
+        self.strategy = strategy
+
+    @staticmethod
+    def setup(strategy: FixedClusterCountStrategy
+              ) -> "BaseClusteringAlgorithm":
+        return BaseClusteringAlgorithm(strategy)
+
+    def apply_to(self, points) -> ClusterSet:
+        s = self.strategy
+        max_iter, min_var = 100, 1e-4
+        if isinstance(s.termination, FixedIterationCountCondition):
+            max_iter = s.termination.iteration_count
+        elif isinstance(s.termination, ConvergenceCondition):
+            min_var = s.termination.rate
+        km = KMeansClustering(
+            s.cluster_count, max_iterations=max_iter,
+            distance_function=s.distance_function,
+            min_distribution_variation_rate=min_var, seed=s.seed)
+        return km.apply_to(points)
+
+    applyTo = apply_to

@@ -583,3 +583,30 @@ class TestGraphLoader:
         # camelCase parity alias
         g2 = GraphLoader.loadUndirectedGraphEdgeListFile(str(p), 4)
         assert sorted(g2.get_connected_vertices(1)) == [0, 2]
+
+
+class TestClusteringStrategy:
+    def test_strategy_facade_runs_kmeans(self):
+        """reference clustering-strategy framework: FixedClusterCount
+        strategy + conditions drive the same MXU k-means."""
+        from deeplearning4j_tpu.clustering import (
+            BaseClusteringAlgorithm,
+            ConvergenceCondition,
+            FixedClusterCountStrategy,
+        )
+
+        x, y = blobs(n_per=50, centers=3, seed=12)
+        strat = (FixedClusterCountStrategy.setup(3, "euclidean")
+                 .end_when_iteration_count_equals(40).with_seed(7))
+        cs = BaseClusteringAlgorithm.setup(strat).apply_to(x)
+        assert cs.centers.shape == (3, x.shape[1])
+        purity = sum(
+            np.max(np.bincount(cs.assignments[y == c], minlength=3))
+            for c in range(3)) / len(y)
+        assert purity > 0.95
+
+        strat2 = (FixedClusterCountStrategy.setup(3)
+                  .end_when_distribution_variation_rate_less_than(1e-3))
+        assert isinstance(strat2.termination, ConvergenceCondition)
+        cs2 = BaseClusteringAlgorithm.setup(strat2).applyTo(x)
+        assert np.all(np.isfinite(cs2.centers))
