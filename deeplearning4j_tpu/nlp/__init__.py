@@ -39,6 +39,11 @@ from deeplearning4j_tpu.nlp.bagofwords import (
     TfidfVectorizer,
 )
 from deeplearning4j_tpu.nlp.inverted_index import InMemoryInvertedIndex
+from deeplearning4j_tpu.nlp.cnn_sentence import (
+    CnnSentenceDataSetIterator,
+    CollectionLabeledSentenceProvider,
+    FileLabeledSentenceProvider,
+)
 
 __all__ = [
     "CommonPreprocessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
@@ -46,5 +51,6 @@ __all__ = [
     "StopWords", "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
     "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",
     "WordVectorSerializer", "BagOfWordsVectorizer", "TfidfVectorizer",
-    "InMemoryInvertedIndex",
+    "InMemoryInvertedIndex", "CnnSentenceDataSetIterator",
+    "CollectionLabeledSentenceProvider", "FileLabeledSentenceProvider",
 ]

@@ -1,0 +1,194 @@
+"""Sentence → CNN-input bridge (reference
+``deeplearning4j-nlp/.../iterator/CnnSentenceDataSetIterator.java`` +
+``LabeledSentenceProvider`` implementations
+``CollectionLabeledSentenceProvider``/``FileLabeledSentenceProvider``):
+the Kim-CNN text-classification workflow — tokenize labelled sentences,
+stack word vectors into image-like inputs, one-hot the labels.
+
+Layout is TPU-native NHWC: ``format="cnn2d"`` yields features
+``(batch, max_len, wv_size, 1)`` (reference emits NCHW
+``(b, 1, len, wv)``), ``format="cnn1d"`` yields ``(b, max_len, wv_size)``
+(NWC). Sentences shorter than the batch max are zero-padded with a
+``(b, max_len)`` features mask."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+
+
+class CollectionLabeledSentenceProvider:
+    """(reference ``CollectionLabeledSentenceProvider``)"""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str],
+                 seed: Optional[int] = None):
+        if len(sentences) != len(labels):
+            raise ValueError("sentences and labels must align")
+        self._data = list(zip(sentences, labels))
+        if seed is not None:
+            np.random.default_rng(seed).shuffle(self._data)
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._data)
+
+    def next_sentence(self) -> Tuple[str, str]:
+        s = self._data[self._pos]
+        self._pos += 1
+        return s
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def total_num_sentences(self) -> int:
+        return len(self._data)
+
+    def all_labels(self) -> List[str]:
+        return sorted({l for _, l in self._data})
+
+
+class FileLabeledSentenceProvider(CollectionLabeledSentenceProvider):
+    """One file per sentence, label = parent directory name (reference
+    ``FileLabeledSentenceProvider`` fed by the per-label file map)."""
+
+    def __init__(self, root: str, seed: Optional[int] = None):
+        sentences, labels = [], []
+        for label in sorted(os.listdir(root)):
+            d = os.path.join(root, label)
+            if not os.path.isdir(d):
+                continue
+            for f in sorted(os.listdir(d)):
+                with open(os.path.join(d, f), "r", encoding="utf-8") as fh:
+                    sentences.append(fh.read().strip())
+                labels.append(label)
+        super().__init__(sentences, labels, seed=seed)
+
+
+class CnnSentenceDataSetIterator(DataSetIterator):
+    """(reference ``CnnSentenceDataSetIterator.Builder``)"""
+
+    class Builder:
+        def __init__(self):
+            self._provider = None
+            self._wv = None
+            self._max_len = 64
+            self._batch = 32
+            self._format = "cnn2d"
+            self._tok = None
+            self._use_normalized = False
+            self._unknown = "remove"  # or "use_unknown"
+
+        def sentence_provider(self, p):
+            self._provider = p
+            return self
+
+        def word_vectors(self, wv):
+            """Anything with ``has_word(w)`` + ``get_word_vector(w)``
+            (Word2Vec, ParagraphVectors.sv via serializer statics, a
+            loaded ``_StaticWordVectors`` table...)."""
+            self._wv = wv
+            return self
+
+        def max_sentence_length(self, n: int):
+            self._max_len = int(n)
+            return self
+
+        def minibatch_size(self, n: int):
+            self._batch = int(n)
+            return self
+
+        def data_format(self, fmt: str):
+            if fmt.lower() not in ("cnn2d", "cnn1d"):
+                raise ValueError("format must be 'cnn2d' or 'cnn1d'")
+            self._format = fmt.lower()
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tok = tf
+            return self
+
+        def unknown_word_handling(self, mode: str):
+            if mode not in ("remove", "use_unknown"):
+                raise ValueError("mode: 'remove' | 'use_unknown'")
+            self._unknown = mode
+            return self
+
+        def build(self) -> "CnnSentenceDataSetIterator":
+            if self._provider is None or self._wv is None:
+                raise ValueError("sentence_provider and word_vectors "
+                                 "are required")
+            return CnnSentenceDataSetIterator(self)
+
+    @staticmethod
+    def builder() -> "CnnSentenceDataSetIterator.Builder":
+        return CnnSentenceDataSetIterator.Builder()
+
+    def __init__(self, b: "CnnSentenceDataSetIterator.Builder"):
+        self.provider = b._provider
+        self.wv = b._wv
+        self.max_len = b._max_len
+        self.batch_size = b._batch
+        self.format = b._format
+        self.tok = b._tok or DefaultTokenizerFactory()
+        self.unknown = b._unknown
+        self.labels = self.provider.all_labels()
+        self._label_idx = {l: i for i, l in enumerate(self.labels)}
+        # vector size probed from any in-vocab word
+        self.wv_size = None
+
+    def _vec(self, w):
+        if self.wv.has_word(w):
+            v = np.asarray(self.wv.get_word_vector(w), np.float32)
+            if self.wv_size is None:
+                self.wv_size = len(v)
+            return v
+        if self.unknown == "use_unknown":
+            if self.wv_size is None:
+                return None  # resolved once any known word fixes the size
+            return np.zeros((self.wv_size,), np.float32)
+        return None
+
+    def has_next(self) -> bool:
+        return self.provider.has_next()
+
+    def next(self) -> DataSet:
+        rows: List[np.ndarray] = []
+        ys: List[int] = []
+        n = 0
+        while self.provider.has_next() and n < self.batch_size:
+            sentence, label = self.provider.next_sentence()
+            toks = self.tok.create(sentence).get_tokens()[:self.max_len]
+            vecs = [v for v in (self._vec(t) for t in toks) if v is not None]
+            if not vecs:
+                continue
+            rows.append(np.stack(vecs))
+            ys.append(self._label_idx[label])
+            n += 1
+        if not rows:
+            raise ValueError("CnnSentenceDataSetIterator exhausted")
+        L = max(r.shape[0] for r in rows)
+        wv = rows[0].shape[1]
+        feats = np.zeros((len(rows), L, wv), np.float32)
+        mask = np.zeros((len(rows), L), np.float32)
+        for i, r in enumerate(rows):
+            feats[i, :r.shape[0]] = r
+            mask[i, :r.shape[0]] = 1.0
+        labels = np.eye(len(self.labels), dtype=np.float32)[ys]
+        if self.format == "cnn2d":
+            feats = feats[..., None]  # (b, L, wv, 1) NHWC
+        return self._pp(DataSet(feats, labels, features_mask=mask))
+
+    def reset(self) -> None:
+        self.provider.reset()
+
+    def batch(self) -> int:
+        return self.batch_size
+
+    def get_labels(self) -> List[str]:
+        return self.labels

@@ -512,3 +512,117 @@ class TestInvertedIndex:
         assert [len(b) for b in batches] == [2, 1]
         labels = [l for _, l in idx.each_doc_with_label()]
         assert labels == ["a", "b", "a"]
+
+
+# --------------------------------------------------------------------------
+# CnnSentenceDataSetIterator
+# --------------------------------------------------------------------------
+class TestCnnSentenceIterator:
+    def _wv(self):
+        from deeplearning4j_tpu.nlp.serializer import _StaticWordVectors
+
+        words = ["cat", "dog", "fish", "rock", "iron", "zinc"]
+        rng = np.random.default_rng(0)
+        return _StaticWordVectors(words,
+                                  rng.random((6, 8)).astype(np.float32))
+
+    def test_shapes_masks_and_formats(self, tmp_path):
+        """reference CnnSentenceDataSetIterator: labelled sentences ->
+        padded word-vector stacks (NHWC here), mask, one-hot labels."""
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator,
+            CollectionLabeledSentenceProvider,
+            FileLabeledSentenceProvider,
+        )
+
+        sents = ["cat dog fish", "rock iron", "dog dog cat fish",
+                 "zinc rock iron iron"]
+        labels = ["animal", "mineral", "animal", "mineral"]
+        it = (CnnSentenceDataSetIterator.builder()
+              .sentence_provider(
+                  CollectionLabeledSentenceProvider(sents, labels))
+              .word_vectors(self._wv())
+              .minibatch_size(4).build())
+        assert it.get_labels() == ["animal", "mineral"]
+        ds = it.next()
+        assert ds.features.shape == (4, 4, 8, 1)  # (b, maxlen, wv, 1)
+        assert ds.labels.shape == (4, 2)
+        np.testing.assert_array_equal(
+            ds.features_mask,
+            [[1, 1, 1, 0], [1, 1, 0, 0], [1, 1, 1, 1], [1, 1, 1, 1]])
+        # padded positions are zero vectors
+        assert np.all(ds.features[0, 3] == 0)
+        it.reset()
+        assert it.has_next()
+
+        # cnn1d format + unknown-word removal
+        it1 = (CnnSentenceDataSetIterator.builder()
+               .sentence_provider(CollectionLabeledSentenceProvider(
+                   ["cat UNKNOWNWORD dog"], ["animal"]))
+               .word_vectors(self._wv())
+               .data_format("cnn1d").build())
+        d1 = it1.next()
+        assert d1.features.shape == (1, 2, 8)  # unknown removed
+
+        # file provider: label = parent dir
+        for label, texts in [("pos", ["cat dog", "fish cat"]),
+                             ("neg", ["rock iron"])]:
+            d = tmp_path / label
+            d.mkdir()
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        fp = FileLabeledSentenceProvider(str(tmp_path))
+        assert fp.total_num_sentences() == 3
+        assert fp.all_labels() == ["neg", "pos"]
+
+    def test_trains_text_cnn(self):
+        """Kim-CNN smoke: a small Conv2D net learns to classify the
+        two-topic sentences from the iterator's output format."""
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator,
+            CollectionLabeledSentenceProvider,
+        )
+        from deeplearning4j_tpu.nn.conf.builders import (
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers import (
+            ConvolutionLayer,
+            GlobalPoolingLayer,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.updaters import Adam
+
+        rng = np.random.default_rng(1)
+        animals, minerals = ["cat", "dog", "fish"], ["rock", "iron", "zinc"]
+        sents, labels = [], []
+        for _ in range(60):
+            if rng.random() < 0.5:
+                sents.append(" ".join(rng.choice(animals, 4)))
+                labels.append("animal")
+            else:
+                sents.append(" ".join(rng.choice(minerals, 4)))
+                labels.append("mineral")
+        it = (CnnSentenceDataSetIterator.builder()
+              .sentence_provider(
+                  CollectionLabeledSentenceProvider(sents, labels))
+              .word_vectors(self._wv())
+              .max_sentence_length(4).minibatch_size(60).build())
+        ds = it.next()
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.02))
+            .weight_init("xavier").list()
+            .layer(ConvolutionLayer(n_out=8, kernel_size=(2, 8),
+                                    stride=(1, 1), activation="relu"))
+            .layer(GlobalPoolingLayer(pooling_type="max"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(4, 8, 1)).build()
+        )
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(30):
+            net.fit(ds, batch_size=60)
+        preds = net.output(ds.features).argmax(1)
+        acc = float((preds == ds.labels.argmax(1)).mean())
+        assert acc > 0.9, acc
