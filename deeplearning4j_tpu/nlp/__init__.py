@@ -14,6 +14,7 @@ batch updates (duplicate indices accumulate correctly through scatter-add).
 
 from deeplearning4j_tpu.nlp.tokenization import (
     CommonPreprocessor,
+    EndingPreProcessor,
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
 )
@@ -46,7 +47,7 @@ from deeplearning4j_tpu.nlp.cnn_sentence import (
 )
 
 __all__ = [
-    "CommonPreprocessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "CommonPreprocessor", "EndingPreProcessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
     "BasicLineIterator", "CollectionSentenceIterator", "FileSentenceIterator",
     "StopWords", "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
     "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",

@@ -34,6 +34,24 @@ class LowCasePreProcessor(TokenPreProcess):
         return token.lower()
 
 
+class EndingPreProcessor(TokenPreProcess):
+    """Strip common English endings — s/./ed/ing/ly, applied in the
+    reference's exact order (``preprocessor/EndingPreProcessor.java``)."""
+
+    def pre_process(self, token: str) -> str:
+        if token.endswith("s") and not token.endswith("ss"):
+            token = token[:-1]
+        if token.endswith("."):
+            token = token[:-1]
+        if token.endswith("ed"):
+            token = token[:-2]
+        if token.endswith("ing"):
+            token = token[:-3]
+        if token.endswith("ly"):
+            token = token[:-2]
+        return token
+
+
 class Tokenizer:
     """One sentence's token stream (reference ``Tokenizer`` interface:
     hasMoreTokens/nextToken/getTokens)."""

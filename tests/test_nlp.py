@@ -626,3 +626,17 @@ class TestCnnSentenceIterator:
         preds = net.output(ds.features).argmax(1)
         acc = float((preds == ds.labels.argmax(1)).mean())
         assert acc > 0.9, acc
+
+
+class TestEndingPreProcessor:
+    def test_reference_order(self):
+        from deeplearning4j_tpu.nlp import EndingPreProcessor
+
+        e = EndingPreProcessor()
+        assert e.pre_process("dogs") == "dog"
+        assert e.pre_process("glass") == "glass"   # ss kept
+        assert e.pre_process("walked") == "walk"
+        assert e.pre_process("quickly") == "quick"
+        # reference applies the rules in sequence, so "things" loses the
+        # "s" AND then the "ing": -> "th" (faithfully quirky)
+        assert e.pre_process("things") == "th"
