@@ -35,6 +35,22 @@ class ConfusionMatrix:
         return str(self.matrix)
 
 
+class Prediction:
+    """One recorded (actual, predicted, metadata) triple (reference
+    ``eval/meta/Prediction`` — the record-metadata error-inspection
+    surface)."""
+
+    def __init__(self, actual: int, predicted: int, record_meta_data=None):
+        self.actual = int(actual)
+        self.predicted = int(predicted)
+        self.record_meta_data = record_meta_data
+
+    def __repr__(self):
+        return (f"Prediction(actual={self.actual}, "
+                f"predicted={self.predicted}, "
+                f"meta={self.record_meta_data!r})")
+
+
 class Evaluation:
     def __init__(self, num_classes: Optional[int] = None,
                  labels: Optional[Sequence[str]] = None, top_n: int = 1):
@@ -44,6 +60,7 @@ class Evaluation:
         self.confusion: Optional[ConfusionMatrix] = None
         self.top_n_correct = 0
         self.top_n_total = 0
+        self._predictions: List[Prediction] = []
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -51,9 +68,19 @@ class Evaluation:
             self.confusion = ConfusionMatrix(self.num_classes)
 
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None) -> None:
+             mask: Optional[np.ndarray] = None,
+             record_meta_data: Optional[Sequence] = None) -> None:
+        """``record_meta_data``: optional per-example metadata (any
+        objects, e.g. source-record indices); when given, per-example
+        Predictions are recorded for the error-inspection getters
+        (reference ``eval(labels, preds, metaData)``). Not supported
+        together with time-series inputs."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
+        if record_meta_data is not None and labels.ndim == 3:
+            raise ValueError(
+                "record_meta_data is per example; time-series inputs "
+                "flatten over time")
         if labels.ndim == 3:  # (b, T, C) time series → flatten with mask
             b, t, c = labels.shape
             labels = labels.reshape(b * t, c)
@@ -64,6 +91,9 @@ class Evaluation:
         elif mask is not None:
             m = np.asarray(mask).reshape(-1).astype(bool)
             labels, predictions = labels[m], predictions[m]
+            if record_meta_data is not None:
+                record_meta_data = [r for r, keep in
+                                    zip(record_meta_data, m) if keep]
         if labels.ndim == 2 and labels.shape[1] > 1:
             actual = np.argmax(labels, axis=1)
         else:
@@ -77,6 +107,14 @@ class Evaluation:
             pred_cls = np.argmax(predictions, axis=1)
             self._ensure(predictions.shape[1])
         self.confusion.add(actual, pred_cls)
+        if record_meta_data is not None:
+            if len(record_meta_data) != len(actual):
+                raise ValueError(
+                    f"record_meta_data has {len(record_meta_data)} "
+                    f"entries for {len(actual)} (unmasked) examples")
+            self._predictions.extend(
+                Prediction(a, p, m) for a, p, m in
+                zip(actual, pred_cls, record_meta_data))
         if self.top_n > 1:
             probs = predictions
             if probs.ndim == 2 and probs.shape[1] == 1:
@@ -161,6 +199,20 @@ class Evaluation:
         self.confusion.merge(other.confusion)
         self.top_n_correct += other.top_n_correct
         self.top_n_total += other.top_n_total
+        self._predictions.extend(other._predictions)
+
+    # -- recorded-prediction getters (reference record-metadata surface) ----
+    def get_prediction_errors(self) -> List[Prediction]:
+        """Misclassified examples (reference ``getPredictionErrors`` —
+        requires eval() calls with ``record_meta_data``)."""
+        return [p for p in self._predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> List[Prediction]:
+        return [p for p in self._predictions if p.actual == int(cls)]
+
+    def get_predictions_by_predicted_class(self, cls: int
+                                           ) -> List[Prediction]:
+        return [p for p in self._predictions if p.predicted == int(cls)]
 
     def stats(self) -> str:
         m = self._m()

@@ -685,3 +685,40 @@ class TestToComputationGraph:
         s0 = cg.score(DataSet(x, y))
         cg.fit(DataSet(x, y), epochs=3, batch_size=5)
         assert cg.score(DataSet(x, y)) < s0
+
+
+class TestPredictionRecording:
+    def test_record_meta_data_error_inspection(self):
+        """reference eval/meta/Prediction surface: eval with
+        record_meta_data records per-example predictions; error and
+        per-class getters + merge carry them."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]
+        ev = Evaluation()
+        ev.eval(labels, preds, record_meta_data=["r0", "r1", "r2", "r3"])
+        errs = ev.get_prediction_errors()
+        assert [(e.actual, e.predicted, e.record_meta_data)
+                for e in errs] == [(1, 2, "r1"), (0, 1, "r3")]
+        assert [p.record_meta_data
+                for p in ev.get_predictions_by_actual_class(0)] == [
+                    "r0", "r3"]
+        assert [p.record_meta_data
+                for p in ev.get_predictions_by_predicted_class(2)] == [
+                    "r1", "r2"]
+
+        # mask filters metadata in step
+        ev2 = Evaluation()
+        mask = np.asarray([1, 0, 1, 1], np.float32)
+        ev2.eval(labels, preds, mask=mask,
+                 record_meta_data=["r0", "r1", "r2", "r3"])
+        assert [p.record_meta_data for p in ev2.get_prediction_errors()] \
+            == ["r3"]
+
+        # distributed merge carries recorded predictions
+        ev.merge(ev2)
+        assert len(ev.get_prediction_errors()) == 3
+
+        with pytest.raises(ValueError, match="entries"):
+            Evaluation().eval(labels, preds, record_meta_data=["only_one"])
