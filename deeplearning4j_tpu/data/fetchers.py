@@ -393,9 +393,11 @@ class LFWDataSetIterator(DataSetIterator):
     embedding head instead.)"""
 
     def __init__(self, batch_size: int, num_examples: Optional[int] = None,
-                 image_size: int = 64, seed: int = 9):
-        self.x, self.y, self.people = load_lfw(num_examples, image_size,
-                                               seed=seed)
+                 image_size: int = 64, min_images_per_person: int = 2,
+                 seed: int = 9):
+        self.x, self.y, self.people = load_lfw(
+            num_examples, image_size,
+            min_images_per_person=min_images_per_person, seed=seed)
         self.batch_size = batch_size
         self._pos = 0
 

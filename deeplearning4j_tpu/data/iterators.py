@@ -926,26 +926,31 @@ class IteratorMultiDataSetIterator(MultiDataSetIterator):
     def _concat(pieces):
         from deeplearning4j_tpu.data.dataset import MultiDataSet
 
-        def cat_slot(arrays):
+        def cat_slot(arrays, sizes):
             present = [a is not None for a in arrays]
             if not any(present):
                 return None
             if not all(present):
-                raise ValueError(
-                    "IteratorMultiDataSetIterator: mask present in some "
-                    "merged pieces but not others")
+                # mixed masked/unmasked pieces: unmasked ones are fully
+                # valid — synthesize all-ones masks (same reference
+                # DataSet.merge semantics as the single-DataSet rebatcher)
+                tail = next(a for a in arrays if a is not None).shape[1:]
+                arrays = [a if a is not None
+                          else np.ones((n,) + tail, np.float32)
+                          for a, n in zip(arrays, sizes)]
             return np.concatenate(arrays, axis=0)
 
         n_f = len(pieces[0].features)
         n_l = len(pieces[0].labels)
+        sizes = [p.num_examples() for p in pieces]
         return MultiDataSet(
             [np.concatenate([p.features[i] for p in pieces], 0)
              for i in range(n_f)],
             [np.concatenate([p.labels[i] for p in pieces], 0)
              for i in range(n_l)],
-            [cat_slot([p.features_masks[i] for p in pieces])
+            [cat_slot([p.features_masks[i] for p in pieces], sizes)
              for i in range(n_f)],
-            [cat_slot([p.labels_masks[i] for p in pieces])
+            [cat_slot([p.labels_masks[i] for p in pieces], sizes)
              for i in range(n_l)],
         )
 
@@ -985,6 +990,25 @@ class IteratorMultiDataSetIterator(MultiDataSetIterator):
         self._carry = None
 
 
+class _MultiSplitView(MultiDataSetIterator):
+    """MultiDataSet-typed wrapper over a split view: the pre-processor
+    lives HERE (MultiDataSetIterator._pp semantics) — the underlying
+    DataSet-typed view must never apply its DataSet-copying _pp to
+    MultiDataSet items."""
+
+    def __init__(self, view):
+        self._view = view
+
+    def has_next(self):
+        return self._view.has_next()
+
+    def next(self):
+        return self._pp(self._view.next())
+
+    def reset(self):
+        self._view.reset()
+
+
 class MultiDataSetIteratorSplitter:
     """Train/test split of a MultiDataSet stream by batch count
     (reference ``MultiDataSetIteratorSplitter``)."""
@@ -994,7 +1018,7 @@ class MultiDataSetIteratorSplitter:
         self._split = DataSetIteratorSplitter(inner, total_batches, ratio)
 
     def get_train_iterator(self):
-        return _ComposedMulti(self._split.get_train_iterator())
+        return _MultiSplitView(self._split.get_train_iterator())
 
     def get_test_iterator(self):
-        return _ComposedMulti(self._split.get_test_iterator())
+        return _MultiSplitView(self._split.get_test_iterator())

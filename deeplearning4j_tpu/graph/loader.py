@@ -59,3 +59,4 @@ class GraphLoader:
     # reference-parity names
     loadUndirectedGraphEdgeListFile = load_undirected_graph_edge_list_file
     loadWeightedEdgeListFile = load_weighted_edge_list_file
+    loadAdjacencyListFile = load_adjacency_list_file

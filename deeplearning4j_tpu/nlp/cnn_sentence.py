@@ -81,7 +81,6 @@ class CnnSentenceDataSetIterator(DataSetIterator):
             self._batch = 32
             self._format = "cnn2d"
             self._tok = None
-            self._use_normalized = False
             self._unknown = "remove"  # or "use_unknown"
 
         def sentence_provider(self, p):
@@ -139,8 +138,13 @@ class CnnSentenceDataSetIterator(DataSetIterator):
         self.unknown = b._unknown
         self.labels = self.provider.all_labels()
         self._label_idx = {l: i for i, l in enumerate(self.labels)}
-        # vector size probed from any in-vocab word
-        self.wv_size = None
+        # vector size probed EAGERLY: in use_unknown mode a lazily-probed
+        # size would make early all-OOV sentences order-dependent
+        if hasattr(self.wv, "get_word_vector_matrix"):
+            self.wv_size = int(self.wv.get_word_vector_matrix().shape[1])
+        else:
+            self.wv_size = None  # fixed on the first in-vocab lookup
+        self._pending: Optional[DataSet] = None
 
     def _vec(self, w):
         if self.wv.has_word(w):
@@ -148,16 +152,25 @@ class CnnSentenceDataSetIterator(DataSetIterator):
             if self.wv_size is None:
                 self.wv_size = len(v)
             return v
-        if self.unknown == "use_unknown":
-            if self.wv_size is None:
-                return None  # resolved once any known word fixes the size
+        if self.unknown == "use_unknown" and self.wv_size is not None:
             return np.zeros((self.wv_size,), np.float32)
         return None
 
     def has_next(self) -> bool:
-        return self.provider.has_next()
+        # lookahead: sentences that tokenize to zero known vectors are
+        # skipped, so provider.has_next() alone would promise batches
+        # next() can't deliver (contract: has_next() True => next() works)
+        if self._pending is None:
+            self._pending = self._build_batch()
+        return self._pending is not None
 
     def next(self) -> DataSet:
+        if not self.has_next():
+            raise ValueError("CnnSentenceDataSetIterator exhausted")
+        ds, self._pending = self._pending, None
+        return self._pp(ds)
+
+    def _build_batch(self) -> Optional[DataSet]:
         rows: List[np.ndarray] = []
         ys: List[int] = []
         n = 0
@@ -171,7 +184,7 @@ class CnnSentenceDataSetIterator(DataSetIterator):
             ys.append(self._label_idx[label])
             n += 1
         if not rows:
-            raise ValueError("CnnSentenceDataSetIterator exhausted")
+            return None
         L = max(r.shape[0] for r in rows)
         wv = rows[0].shape[1]
         feats = np.zeros((len(rows), L, wv), np.float32)
@@ -182,10 +195,11 @@ class CnnSentenceDataSetIterator(DataSetIterator):
         labels = np.eye(len(self.labels), dtype=np.float32)[ys]
         if self.format == "cnn2d":
             feats = feats[..., None]  # (b, L, wv, 1) NHWC
-        return self._pp(DataSet(feats, labels, features_mask=mask))
+        return DataSet(feats, labels, features_mask=mask)  # _pp in next()
 
     def reset(self) -> None:
         self.provider.reset()
+        self._pending = None
 
     def batch(self) -> int:
         return self.batch_size

@@ -607,3 +607,53 @@ class TestMultiDataSetIteratorVariants:
         for _ in range(5):
             g.fit(it)
         assert float(g.score_) < 0.6
+
+
+class TestMultiVariantReviewRegressions:
+    def _mds(self, n=4, T=None, mask=False, seed=0):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        rng = np.random.default_rng(seed)
+        if T:
+            f = rng.random((n, T, 3)).astype(np.float32)
+            fm = np.ones((n, T), np.float32) if mask else None
+            return MultiDataSet([f], [f.copy()], [fm], [fm])
+        return MultiDataSet(
+            [rng.random((n, 3)).astype(np.float32)],
+            [np.eye(2, dtype=np.float32)[rng.integers(0, 2, n)]])
+
+    def test_splitter_views_apply_multi_preprocessor(self):
+        from deeplearning4j_tpu.data.iterators import (
+            ExistingMultiDataSetIterator,
+            MultiDataSetIteratorSplitter,
+        )
+
+        class Doubler:
+            def pre_process(self, mds):
+                mds.features = [f * 2 for f in mds.features]
+                return mds
+
+        src = [self._mds(seed=i) for i in range(4)]
+        sp = MultiDataSetIteratorSplitter(
+            ExistingMultiDataSetIterator(src), total_batches=4, ratio=0.5)
+        tr = sp.get_train_iterator()
+        tr.set_pre_processor(Doubler())
+        got = list(tr)
+        assert len(got) == 2
+        np.testing.assert_allclose(got[0].features[0],
+                                   src[0].features[0] * 2)
+        # source batches stay raw (shallow-copy contract)
+        assert src[0].features[0].max() <= 1.0
+
+    def test_rebatch_mixed_mask_synthesizes_ones(self):
+        from deeplearning4j_tpu.data.iterators import (
+            IteratorMultiDataSetIterator,
+        )
+
+        pieces = [self._mds(n=2, T=5, mask=True, seed=0),
+                  self._mds(n=3, T=5, mask=False, seed=1)]
+        it = IteratorMultiDataSetIterator(pieces, batch_size=5)
+        m = it.next()
+        assert m.features_masks[0].shape == (5, 5)
+        np.testing.assert_array_equal(m.features_masks[0][2:],
+                                      np.ones((3, 5), np.float32))

@@ -640,3 +640,46 @@ class TestEndingPreProcessor:
         # reference applies the rules in sequence, so "things" loses the
         # "s" AND then the "ing": -> "th" (faithfully quirky)
         assert e.pre_process("things") == "th"
+
+
+class TestCnnSentenceReviewRegressions:
+    def test_has_next_contract_with_oov_tail(self):
+        """has_next() must stay truthful when the stream tail is all-OOV
+        (default remove mode): the epoch ends instead of crashing."""
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator,
+            CollectionLabeledSentenceProvider,
+        )
+        from deeplearning4j_tpu.nlp.serializer import _StaticWordVectors
+
+        wv = _StaticWordVectors(["cat", "dog"],
+                                np.ones((2, 4), np.float32))
+        it = (CnnSentenceDataSetIterator.builder()
+              .sentence_provider(CollectionLabeledSentenceProvider(
+                  ["cat dog", "zzz qqq", "xxx yyy"],
+                  ["a", "b", "b"]))
+              .word_vectors(wv).minibatch_size(1).build())
+        batches = list(it)  # must terminate cleanly
+        assert len(batches) == 1
+        assert batches[0].features.shape[0] == 1
+
+    def test_use_unknown_is_order_independent(self):
+        """OOV tokens become zero vectors even in the FIRST sentence —
+        the vector size is probed eagerly from the table."""
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator,
+            CollectionLabeledSentenceProvider,
+        )
+        from deeplearning4j_tpu.nlp.serializer import _StaticWordVectors
+
+        wv = _StaticWordVectors(["cat"], np.ones((1, 4), np.float32))
+        it = (CnnSentenceDataSetIterator.builder()
+              .sentence_provider(CollectionLabeledSentenceProvider(
+                  ["zzz cat"], ["a"]))
+              .word_vectors(wv)
+              .unknown_word_handling("use_unknown")
+              .data_format("cnn1d").build())
+        ds = it.next()
+        assert ds.features.shape == (1, 2, 4)  # OOV kept as zero vector
+        assert np.all(ds.features[0, 0] == 0)
+        assert np.all(ds.features[0, 1] == 1)
