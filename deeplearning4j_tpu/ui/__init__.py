@@ -45,6 +45,7 @@ from deeplearning4j_tpu.ui.components import (
     ComponentTable,
     ComponentText,
     DecoratorAccordion,
+    LengthUnit,
     StyleAccordion,
     StyleChart,
     StyleDiv,
@@ -61,7 +62,7 @@ __all__ = [
     "RemoteUIStatsStorageRouter", "RemoteStatsReceiver",
     "Component", "ChartLine", "ChartScatter", "ChartHistogram",
     "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline",
-    "ComponentTable", "ComponentText", "ComponentDiv", "DecoratorAccordion",
+    "ComponentTable", "ComponentText", "ComponentDiv", "DecoratorAccordion", "LengthUnit",
     "StyleChart", "StyleTable", "StyleText", "StyleDiv", "StyleAccordion",
     "render_page", "save_page",
     "ConvolutionalIterationListener", "activation_grid", "write_png_gray",

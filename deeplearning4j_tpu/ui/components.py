@@ -33,13 +33,33 @@ def _register(cls):
 
 
 # ------------------------------------------------------------------ styles
+class LengthUnit:
+    """(reference ``ui/api/LengthUnit``) — unit tag for style lengths;
+    the SVG renderer treats PX as user units and PERCENT relative to the
+    default canvas."""
+
+    PX = "px"
+    PERCENT = "percent"
+    CM = "cm"
+    MM = "mm"
+    IN = "in"
+
+
 class Style:
-    """Base style (reference ``ui/api/Style.java``): sizing + margins."""
+    """Base style (reference ``ui/api/Style.java``): sizing + margins.
+    ``width_unit``/``height_unit`` default to PX; PERCENT resolves
+    against the 640x260 default canvas at construction."""
 
     def __init__(self, width: float = 640, height: float = 260,
                  margin_top: float = 28, margin_bottom: float = 34,
                  margin_left: float = 46, margin_right: float = 12,
-                 background_color: str = "#ffffff"):
+                 background_color: str = "#ffffff",
+                 width_unit: str = LengthUnit.PX,
+                 height_unit: str = LengthUnit.PX):
+        if width_unit == LengthUnit.PERCENT:
+            width = 640 * width / 100.0
+        if height_unit == LengthUnit.PERCENT:
+            height = 260 * height / 100.0
         self.width = float(width)
         self.height = float(height)
         self.margin_top = float(margin_top)
