@@ -15,7 +15,15 @@ from deeplearning4j_tpu.data.iterators import (
     DummyPreProcessor,
     EarlyTerminationDataSetIterator,
     ExistingDataSetIterator,
+    AsyncMultiDataSetIterator,
+    AsyncShieldMultiDataSetIterator,
+    BenchmarkMultiDataSetIterator,
+    EarlyTerminationMultiDataSetIterator,
     ExistingMultiDataSetIterator,
+    IteratorMultiDataSetIterator,
+    MultiDataSetIteratorAdapter,
+    MultiDataSetIteratorSplitter,
+    SingletonMultiDataSetIterator,
     FileDataSetIterator,
     FloatsDataSetIterator,
     IteratorDataSetIterator,
@@ -54,6 +62,10 @@ __all__ = [
     "EarlyTerminationDataSetIterator", "MultipleEpochsIterator",
     "SamplingDataSetIterator", "TestDataSetIterator",
     "MultiDataSetIterator", "ExistingMultiDataSetIterator",
+    "MultiDataSetIteratorAdapter", "SingletonMultiDataSetIterator",
+    "AsyncMultiDataSetIterator", "AsyncShieldMultiDataSetIterator",
+    "BenchmarkMultiDataSetIterator", "EarlyTerminationMultiDataSetIterator",
+    "IteratorMultiDataSetIterator", "MultiDataSetIteratorSplitter",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "ImageRecordReader", "SequenceRecordReader",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
