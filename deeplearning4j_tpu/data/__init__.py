@@ -45,6 +45,7 @@ from deeplearning4j_tpu.data.records import (
     ImageRecordReader,
     RecordReader,
     RecordReaderDataSetIterator,
+    RecordReaderMultiDataSetIterator,
     SequenceRecordReader,
     SequenceRecordReaderDataSetIterator,
 )
@@ -68,7 +69,7 @@ __all__ = [
     "IteratorMultiDataSetIterator", "MultiDataSetIteratorSplitter",
     "RecordReader", "CollectionRecordReader", "CSVRecordReader",
     "ImageRecordReader", "SequenceRecordReader",
-    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "RecordReaderDataSetIterator", "RecordReaderMultiDataSetIterator", "SequenceRecordReaderDataSetIterator",
     "ALIGN_START", "ALIGN_END", "EQUAL_LENGTH",
     "CifarDataSetIterator", "LFWDataSetIterator", "SvhnDataSetIterator", "TinyImageNetDataSetIterator",
     "UciSequenceDataSetIterator",
