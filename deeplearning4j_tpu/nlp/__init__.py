@@ -40,6 +40,10 @@ from deeplearning4j_tpu.nlp.bagofwords import (
     TfidfVectorizer,
 )
 from deeplearning4j_tpu.nlp.inverted_index import InMemoryInvertedIndex
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    LabelAwareIterator,
+    LabelledDocument,
+)
 from deeplearning4j_tpu.nlp.cnn_sentence import (
     CnnSentenceDataSetIterator,
     CollectionLabeledSentenceProvider,
@@ -54,4 +58,5 @@ __all__ = [
     "WordVectorSerializer", "BagOfWordsVectorizer", "TfidfVectorizer",
     "InMemoryInvertedIndex", "CnnSentenceDataSetIterator",
     "CollectionLabeledSentenceProvider", "FileLabeledSentenceProvider",
+    "LabelAwareIterator", "LabelledDocument",
 ]
