@@ -84,6 +84,22 @@ def _cache_store(result: dict) -> None:
         pass  # caching is best-effort; never fail the live measurement
 
 
+def _mark_stale(out: dict) -> dict:
+    """Make a fallback record unmistakable to ANY partial parser: every
+    live-looking numeric (value, vs_baseline, extra) moves under a
+    ``stale_``-prefixed key and the live keys become None."""
+    out["metric"] = "stale_" + out.get("metric", "unknown")
+    out["stale_value"] = out.pop("value", None)
+    out["value"] = None
+    if "vs_baseline" in out:
+        out["stale_vs_baseline"] = out.pop("vs_baseline")
+    out["vs_baseline"] = None
+    if out.get("extra"):
+        out["stale_extra"] = out.pop("extra")
+    out["stale"] = True
+    return out
+
+
 def _cache_load() -> "dict | None":
     try:
         with open(CACHE_PATH) as f:
@@ -526,12 +542,14 @@ if __name__ == "__main__":
         if cached is not None:
             # outage fallback: the last good hardware measurement,
             # explicitly flagged stale, with the live error attached —
-            # never a bare 0.0 as the round artifact
+            # never a bare 0.0 as the round artifact. The headline metric
+            # name is prefixed "stale_" so a parser reading only
+            # metric/value cannot mistake this for a live capture.
             out = {k: cached[k]
                    for k in ("metric", "value", "unit", "vs_baseline",
                              "extra")
                    if k in cached}
-            out["stale"] = True
+            out = _mark_stale(out)
             out["measured_at"] = cached.get("measured_at")
             out["error"] = err
             print(json.dumps(out))
@@ -539,8 +557,7 @@ if __name__ == "__main__":
             # no cache on disk either — fall back to the last measurement
             # documented in BASELINE.md rather than reporting 0.0 for a
             # quantity that was measured on hardware this round
-            out = dict(LAST_DOCUMENTED)
-            out["stale"] = True
+            out = _mark_stale(dict(LAST_DOCUMENTED))
             out["error"] = err
             out["traceback"] = traceback.format_exc()[-1500:]
             print(json.dumps(out))

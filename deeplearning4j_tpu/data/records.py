@@ -445,7 +445,21 @@ class RecordReaderMultiDataSetIterator:
 
     # ------------------------------------------------------------- protocol
     def has_next(self) -> bool:
-        return all(r.has_next() for r in self.readers.values())
+        states = {name: r.has_next() for name, r in self.readers.items()}
+        if all(states.values()):
+            return True
+        if any(states.values()):
+            # unequal-length readers are a lockstep-alignment data bug
+            # (e.g. an aux CSV missing rows) — don't silently truncate
+            import warnings
+            exhausted = sorted(n for n, alive in states.items() if not alive)
+            alive = sorted(n for n, a in states.items() if a)
+            warnings.warn(
+                f"RecordReaderMultiDataSetIterator: reader(s) {exhausted} "
+                f"exhausted while {alive} still have records — streams are "
+                f"misaligned; truncating to the shortest reader",
+                RuntimeWarning, stacklevel=2)
+        return False
 
     def _cut(self, values, spec):
         _, lo, hi, one_hot = spec

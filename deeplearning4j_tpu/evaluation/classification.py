@@ -98,6 +98,14 @@ class Evaluation:
             actual = np.argmax(labels, axis=1)
         else:
             actual = labels.reshape(-1).astype(np.int64)
+        if record_meta_data is not None and \
+                len(record_meta_data) != len(actual):
+            # validate before ANY mutation (incl. _ensure pinning
+            # num_classes) so a failed eval() leaves the Evaluation
+            # truly unchanged
+            raise ValueError(
+                f"record_meta_data has {len(record_meta_data)} "
+                f"entries for {len(actual)} (unmasked) examples")
         if predictions.ndim == 2 and predictions.shape[1] == 1:
             # single sigmoid output: threshold at 0.5 (reference Evaluation
             # single-column handling), confusion matrix is 2x2
@@ -108,10 +116,6 @@ class Evaluation:
             self._ensure(predictions.shape[1])
         self.confusion.add(actual, pred_cls)
         if record_meta_data is not None:
-            if len(record_meta_data) != len(actual):
-                raise ValueError(
-                    f"record_meta_data has {len(record_meta_data)} "
-                    f"entries for {len(actual)} (unmasked) examples")
             self._predictions.extend(
                 Prediction(a, p, m) for a, p, m in
                 zip(actual, pred_cls, record_meta_data))
@@ -183,12 +187,32 @@ class Evaluation:
 
     def f1(self, cls: Optional[int] = None,
            averaging: str = "macro") -> float:
-        """Macro: mean of per-class F1 is approximated (as the reference
-        does) by F1 of macro-P/macro-R; micro: F1 of micro-P/micro-R
-        (reference ``EvaluationAveraging`` Macro/Micro)."""
-        p = self.precision(cls, averaging=averaging)
-        r = self.recall(cls, averaging=averaging)
-        return 2 * p * r / (p + r) if (p + r) else 0.0
+        """Macro: mean of per-class F1 over classes with defined F1,
+        with the reference's 2-class special case (binary F1 of class 1);
+        micro: F1 of micro-P/micro-R (reference ``Evaluation.fBeta``,
+        ``eval/Evaluation.java:1193-1203``)."""
+        if cls is not None:
+            p = self.precision(cls)
+            r = self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        if averaging == "micro":
+            p = self.precision(averaging="micro")
+            r = self.recall(averaging="micro")
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        n = self._m().shape[0]
+        if n == 2:  # reference special case: binary F1 of class 1
+            return self.f1(1)
+        tp = self.true_positives()
+        fp = self.false_positives()
+        fn = self.false_negatives()
+        per = []
+        for i in range(n):
+            if tp[i] + fp[i] + fn[i] == 0:
+                continue  # F1 undefined for a class that never appears
+            p_i = tp[i] / (tp[i] + fp[i]) if tp[i] + fp[i] else 0.0
+            r_i = tp[i] / (tp[i] + fn[i]) if tp[i] + fn[i] else 0.0
+            per.append(2 * p_i * r_i / (p_i + r_i) if (p_i + r_i) else 0.0)
+        return float(np.mean(per)) if per else 0.0
 
     def merge(self, other: "Evaluation") -> None:
         if other.confusion is None:

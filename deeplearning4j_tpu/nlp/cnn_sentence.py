@@ -144,6 +144,26 @@ class CnnSentenceDataSetIterator(DataSetIterator):
             self.wv_size = int(self.wv.get_word_vector_matrix().shape[1])
         else:
             self.wv_size = None  # fixed on the first in-vocab lookup
+        if self.unknown == "use_unknown" and self.wv_size is None:
+            # use_unknown must be order-independent for every provider:
+            # probe one known word now, or refuse the mode
+            for attr in ("vocab_words", "words"):
+                probe = getattr(self.wv, attr, None)
+                if probe is None:
+                    continue
+                for w in probe():
+                    w = getattr(w, "word", w)
+                    if self.wv.has_word(w):
+                        self.wv_size = len(np.asarray(
+                            self.wv.get_word_vector(w)))
+                        break
+                if self.wv_size is not None:
+                    break
+            if self.wv_size is None:
+                raise ValueError(
+                    "unknown_word_handling='use_unknown' needs a "
+                    "resolvable vector size: provider has no "
+                    "get_word_vector_matrix/vocab_words/words to probe")
         self._pending: Optional[DataSet] = None
 
     def _vec(self, w):

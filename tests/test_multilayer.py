@@ -503,6 +503,47 @@ class TestTopNEvaluate:
 
         assert _auc(rec, prec) == pytest.approx(roc.calculate_auprc())
 
+    def test_macro_f1_is_mean_of_per_class_f1(self):
+        """reference Evaluation.fBeta(Macro) semantics
+        (eval/Evaluation.java:1193-1203): macro F1 averages per-class F1
+        scores (NOT the harmonic mean of macro-P and macro-R), and the
+        2-class case returns the binary F1 of class 1."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        # imbalanced 3-class confusion where the two definitions diverge:
+        # rows actual [[8,1,1],[3,1,1],[1,0,4]] -> mean-of-F1 0.5801,
+        # harmonic-of-macro-P/R 0.6055
+        actual_cls = [0] * 10 + [1] * 5 + [2] * 5
+        pred_cls = ([0] * 8 + [1, 2] + [0, 0, 0, 1, 2] + [0, 2, 2, 2, 2])
+        labels = np.eye(3, dtype=np.float32)[actual_cls]
+        preds = np.eye(3, dtype=np.float32)[pred_cls]
+        ev = Evaluation()
+        ev.eval(labels, preds)
+        expected = np.mean([ev.f1(i) for i in range(3)])
+        assert ev.f1(averaging="macro") == pytest.approx(expected)
+        harmonic = 2 * ev.precision() * ev.recall() / (
+            ev.precision() + ev.recall())
+        assert ev.f1(averaging="macro") != pytest.approx(harmonic)
+
+        # 2-class special case: binary F1 of class 1
+        labels2 = np.eye(2, dtype=np.float32)[[0, 0, 0, 1, 1, 0]]
+        preds2 = np.eye(2, dtype=np.float32)[[0, 1, 0, 1, 0, 0]]
+        ev2 = Evaluation()
+        ev2.eval(labels2, preds2)
+        assert ev2.f1(averaging="macro") == pytest.approx(ev2.f1(1))
+
+    def test_eval_meta_mismatch_leaves_state_unchanged(self):
+        """a failed record_meta_data eval() must not partially mutate the
+        Evaluation (confusion counted, predictions dropped)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        labels = np.eye(2, dtype=np.float32)[[0, 1]]
+        preds = np.eye(2, dtype=np.float32)[[0, 1]]
+        ev = Evaluation()
+        with pytest.raises(ValueError):
+            ev.eval(labels, preds, record_meta_data=["only_one"])
+        assert ev.confusion is None or ev.confusion.matrix.sum() == 0
+
     def test_evaluate_roc_helpers(self):
         """evaluateROC / evaluateROCMultiClass model helpers (reference
         surface) on both model types."""
