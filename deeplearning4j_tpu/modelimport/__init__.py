@@ -25,5 +25,13 @@ output parity tests in tests/test_keras_import.py.
 """
 
 from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+from deeplearning4j_tpu.modelimport.dl4j import (
+    restore_java_multi_layer_network,
+    write_java_model,
+)
 
-__all__ = ["KerasModelImport"]
+__all__ = [
+    "KerasModelImport",
+    "restore_java_multi_layer_network",
+    "write_java_model",
+]

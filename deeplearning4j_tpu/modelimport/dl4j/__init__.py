@@ -1,0 +1,17 @@
+"""Interop with the Java stack's model-zip format (reference
+``util/ModelSerializer.java``): load Java-produced zips, export zips the
+Java stack can read. See ``loader.py`` for the format contract."""
+
+from deeplearning4j_tpu.modelimport.dl4j.loader import (  # noqa: F401
+    load_java_configuration,
+    restore_java_multi_layer_network,
+    write_java_model,
+)
+from deeplearning4j_tpu.modelimport.dl4j import nd4j_bin  # noqa: F401
+
+__all__ = [
+    "load_java_configuration",
+    "restore_java_multi_layer_network",
+    "write_java_model",
+    "nd4j_bin",
+]

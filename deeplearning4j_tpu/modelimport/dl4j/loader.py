@@ -1,0 +1,795 @@
+"""Cross-stack DL4J model-zip interop: load (and export) models in the
+Java stack's on-disk format.
+
+Reference layout (``deeplearning4j-nn/.../util/ModelSerializer.java:
+39-135``): a zip with ``configuration.json`` (Jackson JSON of
+MultiLayerConfiguration), ``coefficients.bin`` (``Nd4j.write`` of the
+single flattened parameter row-vector), optional ``updaterState.bin`` and
+``normalizer.bin``.
+
+Configuration JSON conventions (this is the *Java* schema, distinct from
+this package's own ``@class`` serde):
+
+- layers are Jackson WRAPPER_OBJECT polymorphic — ``{"dense": {...}}`` —
+  with type names from the ``@JsonSubTypes`` registry on
+  ``nn/conf/layers/Layer.java:54-88``;
+- ``IActivation`` / ``ILossFunction`` / ``IUpdater`` values are
+  class-name polymorphic — ``{"@class": "org.nd4j.linalg...."}`` — the
+  form ``nn/conf/serde/BaseNetConfigDeserializer.java`` post-processes;
+- enums (WeightInit, PoolingType, ConvolutionMode, BackpropType,
+  OptimizationAlgorithm) are plain strings.
+
+Parameter flattening (``coefficients.bin``) follows each layer's
+ParamInitializer view layout, concatenated in layer order:
+
+- Dense/Output/Embedding: ``W`` (nIn·nOut, **'f' order** of (nIn,nOut))
+  then ``b`` (nOut) — ``params/DefaultParamInitializer.java:104-128``,
+  gradient view ``reshape('f', nIn, nOut)``;
+- Convolution: ``b`` (nOut) FIRST, then ``W`` (**'c' order** of
+  (nOut,nIn,kH,kW)) — ``params/ConvolutionParamInitializer.java:
+  105-132,170-200`` ("c order is used specifically for the CNN weights");
+- BatchNormalization: gamma, beta, mean, var (each nOut; gamma/beta
+  absent when lockGammaBeta) — ``params/BatchNormalizationParamInitializer
+  .java:80-115``;
+- LSTM: ``W`` (nIn,4n 'f'), ``RW`` (n,4n 'f'), ``b`` (4n), gate column
+  order IFOG = [input, forget, output, modulation] —
+  ``params/LSTMParamInitializer.java:104-170`` (matches this package's
+  [i, f, o, g] packing exactly).
+
+Java updater state (``updaterState.bin``) uses the Java stack's updater
+view layout and is NOT mapped — restored models get fresh optimizer
+state, the reference's own ``loadUpdater=false`` path.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.dl4j import nd4j_bin
+
+# ----------------------------------------------------------------------
+# name maps: Java wire names <-> this package's registries
+# ----------------------------------------------------------------------
+
+_ACTIVATION_MAP = {
+    "ActivationReLU": "relu", "ActivationReLU6": "relu6",
+    "ActivationSigmoid": "sigmoid", "ActivationTanH": "tanh",
+    "ActivationSoftmax": "softmax", "ActivationIdentity": "identity",
+    "ActivationLReLU": "leakyrelu", "ActivationELU": "elu",
+    "ActivationSELU": "selu", "ActivationGELU": "gelu",
+    "ActivationSoftPlus": "softplus", "ActivationSoftSign": "softsign",
+    "ActivationHardSigmoid": "hardsigmoid",
+    "ActivationHardTanH": "hardtanh", "ActivationCube": "cube",
+    "ActivationRationalTanh": "rationaltanh",
+    "ActivationRectifiedTanh": "rectifiedtanh",
+    "ActivationSwish": "swish", "ActivationMish": "mish",
+    "ActivationThresholdedReLU": "thresholdedrelu",
+}
+_ACTIVATION_EXPORT = {v: k for k, v in _ACTIVATION_MAP.items()}
+
+_LOSS_MAP = {
+    "LossMCXENT": "mcxent", "LossNegativeLogLikelihood":
+        "negativeloglikelihood", "LossMSE": "mse", "LossBinaryXENT":
+        "xent", "LossL1": "l1", "LossL2": "l2", "LossMAE": "mae",
+    "LossMAPE": "mape", "LossMSLE": "msle", "LossHinge": "hinge",
+    "LossSquaredHinge": "squared_hinge", "LossPoisson": "poisson",
+    "LossKLD": "kld", "LossCosineProximity": "cosine_proximity",
+    "LossWasserstein": "wasserstein",
+}
+_LOSS_EXPORT = {v: k for k, v in _LOSS_MAP.items()}
+
+_ACT_PKG = "org.nd4j.linalg.activations.impl."
+_LOSS_PKG = "org.nd4j.linalg.lossfunctions.impl."
+_UPD_PKG = "org.nd4j.linalg.learning.config."
+
+
+def _map_activation(node) -> str:
+    if node is None:
+        return "identity"
+    if isinstance(node, str):  # legacy pre-IActivation string form
+        return node.lower()
+    cls = node.get("@class", "").rsplit(".", 1)[-1]
+    if cls not in _ACTIVATION_MAP:
+        raise ValueError(f"Unsupported Java activation {cls!r}")
+    return _ACTIVATION_MAP[cls]
+
+
+def _map_loss(node) -> str:
+    if isinstance(node, str):
+        return node.lower()
+    cls = node.get("@class", "").rsplit(".", 1)[-1]
+    if cls not in _LOSS_MAP:
+        raise ValueError(f"Unsupported Java loss function {cls!r}")
+    return _LOSS_MAP[cls]
+
+
+def _map_updater(node):
+    """``IUpdater`` @class JSON → this package's Updater."""
+    from deeplearning4j_tpu import updaters as U
+
+    if node is None:
+        return None
+    cls = node.get("@class", "").rsplit(".", 1)[-1]
+    lr = node.get("learningRate", 1e-3)
+    if cls == "Sgd":
+        return U.Sgd(lr)
+    if cls == "Adam":
+        return U.Adam(lr, beta1=node.get("beta1", 0.9),
+                      beta2=node.get("beta2", 0.999),
+                      epsilon=node.get("epsilon", 1e-8))
+    if cls == "AdaMax":
+        return U.AdaMax(lr, beta1=node.get("beta1", 0.9),
+                        beta2=node.get("beta2", 0.999),
+                        epsilon=node.get("epsilon", 1e-8))
+    if cls == "Nadam":
+        return U.Nadam(lr, beta1=node.get("beta1", 0.9),
+                       beta2=node.get("beta2", 0.999),
+                       epsilon=node.get("epsilon", 1e-8))
+    if cls == "AMSGrad":
+        return U.AMSGrad(lr, beta1=node.get("beta1", 0.9),
+                         beta2=node.get("beta2", 0.999),
+                         epsilon=node.get("epsilon", 1e-8))
+    if cls == "Nesterovs":
+        return U.Nesterovs(lr, momentum=node.get("momentum", 0.9))
+    if cls == "AdaGrad":
+        return U.AdaGrad(lr, epsilon=node.get("epsilon", 1e-6))
+    if cls == "AdaDelta":
+        return U.AdaDelta(rho=node.get("rho", 0.95),
+                          epsilon=node.get("epsilon", 1e-6))
+    if cls == "RmsProp":
+        return U.RmsProp(lr, rms_decay=node.get("rmsDecay", 0.95),
+                         epsilon=node.get("epsilon", 1e-8))
+    if cls == "NoOp":
+        return U.NoOp()
+    raise ValueError(f"Unsupported Java updater {cls!r}")
+
+
+def _export_updater(u) -> dict:
+    from deeplearning4j_tpu import updaters as U
+
+    def _lr(x):
+        lr = getattr(x, "learning_rate", None)
+        return float(lr) if isinstance(lr, (int, float)) else 1e-3
+
+    if isinstance(u, U.Sgd):
+        return {"@class": _UPD_PKG + "Sgd", "learningRate": _lr(u)}
+    if isinstance(u, (U.Adam, U.AdaMax, U.Nadam, U.AMSGrad)):
+        name = type(u).__name__
+        return {"@class": _UPD_PKG + name, "learningRate": _lr(u),
+                "beta1": u.beta1, "beta2": u.beta2, "epsilon": u.epsilon}
+    if isinstance(u, U.Nesterovs):
+        m = u.momentum if isinstance(u.momentum, (int, float)) else 0.9
+        return {"@class": _UPD_PKG + "Nesterovs", "learningRate": _lr(u),
+                "momentum": m}
+    if isinstance(u, U.AdaGrad):
+        return {"@class": _UPD_PKG + "AdaGrad", "learningRate": _lr(u),
+                "epsilon": u.epsilon}
+    if isinstance(u, U.AdaDelta):
+        return {"@class": _UPD_PKG + "AdaDelta", "rho": u.rho,
+                "epsilon": u.epsilon}
+    if isinstance(u, U.RmsProp):
+        return {"@class": _UPD_PKG + "RmsProp", "learningRate": _lr(u),
+                "rmsDecay": u.rms_decay, "epsilon": u.epsilon}
+    if isinstance(u, U.NoOp):
+        return {"@class": _UPD_PKG + "NoOp"}
+    raise ValueError(f"No Java export mapping for updater {type(u).__name__}")
+
+
+def _map_weight_init(name: Optional[str]) -> str:
+    if not name:
+        return "xavier"
+    return name.lower()
+
+
+def _pair(v) -> List[int]:
+    if isinstance(v, (list, tuple)):
+        return [int(v[0]), int(v[1] if len(v) > 1 else v[0])]
+    return [int(v), int(v)]
+
+
+# ----------------------------------------------------------------------
+# per-layer translation: Java JSON node -> (our Layer, param slicer)
+# ----------------------------------------------------------------------
+
+def _base_kwargs(node: dict) -> dict:
+    from deeplearning4j_tpu.regularization import RegularizationConf
+
+    kw = {}
+    if node.get("layerName"):
+        kw["name"] = node["layerName"]
+    upd = _map_updater(node.get("iUpdater"))
+    if upd is not None:
+        kw["updater"] = upd
+    l1 = float(node.get("l1") or 0.0)
+    l2 = float(node.get("l2") or 0.0)
+    if l1 or l2:
+        kw["regularization"] = RegularizationConf(
+            l1=l1, l2=l2, l1_bias=float(node.get("l1Bias") or 0.0),
+            l2_bias=float(node.get("l2Bias") or 0.0))
+    return kw
+
+
+def _ff_kwargs(node: dict) -> dict:
+    kw = _base_kwargs(node)
+    kw["n_in"] = int(node["nIn"])
+    kw["n_out"] = int(node["nOut"])
+    kw["activation"] = _map_activation(node.get("activationFn"))
+    kw["weight_init"] = _map_weight_init(node.get("weightInit"))
+    bias_init = node.get("biasInit")
+    if bias_init is not None and not _is_nan(bias_init):
+        kw["bias_init"] = float(bias_init)
+    return kw
+
+
+def _is_nan(v) -> bool:
+    try:
+        return v != v
+    except Exception:
+        return False
+
+
+def _take(flat: np.ndarray, pos: int, n: int) -> Tuple[np.ndarray, int]:
+    if pos + n > flat.size:
+        raise ValueError(
+            f"coefficients.bin too short: wanted {pos + n} values, "
+            f"have {flat.size}")
+    return flat[pos:pos + n], pos + n
+
+
+def _dense_like(cls_name: str):
+    def build(node):
+        from deeplearning4j_tpu.nn.conf import layers as L
+
+        kw = _ff_kwargs(node)
+        if cls_name in ("OutputLayer", "RnnOutputLayer", "LossLayer"):
+            kw["loss"] = _map_loss(node.get("lossFn", "mcxent"))
+        if cls_name == "LossLayer":
+            kw.pop("n_in", None), kw.pop("n_out", None)
+        layer = getattr(L, cls_name)(**kw)
+
+        def slicer(flat, pos, params, state):
+            n_in, n_out = int(node["nIn"]), int(node["nOut"])
+            w, pos = _take(flat, pos, n_in * n_out)
+            b, pos = _take(flat, pos, n_out)
+            params["W"] = w.reshape((n_in, n_out), order="F")
+            params["b"] = b
+            return pos
+
+        return layer, (None if cls_name == "LossLayer" else slicer)
+    return build
+
+
+def _build_conv(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    kw = _ff_kwargs(node)
+    kw["kernel_size"] = _pair(node["kernelSize"])
+    kw["stride"] = _pair(node.get("stride", 1))
+    kw["padding"] = _pair(node.get("padding", 0))
+    kw["convolution_mode"] = (node.get("convolutionMode")
+                              or "Truncate").lower()
+    if "dilation" in node and node["dilation"]:
+        kw["dilation"] = _pair(node["dilation"])
+    kw["has_bias"] = bool(node.get("hasBias", True))
+    layer = L.ConvolutionLayer(**kw)
+
+    def slicer(flat, pos, params, state):
+        n_in, n_out = int(node["nIn"]), int(node["nOut"])
+        kh, kw_ = kw["kernel_size"]
+        if kw["has_bias"]:
+            b, pos = _take(flat, pos, n_out)  # bias FIRST (see module doc)
+            params["b"] = b
+        w, pos = _take(flat, pos, n_out * n_in * kh * kw_)
+        # 'c'-order (nOut,nIn,kH,kW) OIHW -> our HWIO (kH,kW,nIn,nOut)
+        params["W"] = np.transpose(
+            w.reshape((n_out, n_in, kh, kw_), order="C"), (2, 3, 1, 0))
+        return pos
+
+    return layer, slicer
+
+
+def _build_subsampling(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    kw = _base_kwargs(node)
+    kw.pop("updater", None)  # no params
+    kw["pooling_type"] = (node.get("poolingType") or "MAX").lower()
+    kw["kernel_size"] = _pair(node.get("kernelSize", 2))
+    kw["stride"] = _pair(node.get("stride", 2))
+    kw["padding"] = _pair(node.get("padding", 0))
+    kw["convolution_mode"] = (node.get("convolutionMode")
+                              or "Truncate").lower()
+    if node.get("pnorm"):
+        kw["pnorm"] = int(node["pnorm"])
+    return L.SubsamplingLayer(**kw), None
+
+
+def _build_batchnorm(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    kw = _base_kwargs(node)
+    kw["decay"] = float(node.get("decay", 0.9))
+    kw["eps"] = float(node.get("eps", 1e-5))
+    kw["gamma"] = float(node.get("gamma", 1.0))
+    kw["beta"] = float(node.get("beta", 0.0))
+    lock = bool(node.get("lockGammaBeta", False))
+    kw["lock_gamma_beta"] = lock
+    layer = L.BatchNormalization(**kw)
+    n_out = int(node["nOut"])
+
+    def slicer(flat, pos, params, state):
+        if not lock:
+            g, pos = _take(flat, pos, n_out)
+            b, pos = _take(flat, pos, n_out)
+            params["gamma"] = g
+            params["beta"] = b
+        mean, pos = _take(flat, pos, n_out)
+        var, pos = _take(flat, pos, n_out)
+        state["mean"] = mean  # running stats live in layer STATE here
+        state["var"] = var
+        return pos
+
+    return layer, slicer
+
+
+def _build_lstm(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    kw = _ff_kwargs(node)
+    kw["forget_gate_bias_init"] = float(node.get("forgetGateBiasInit", 1.0))
+    if node.get("gateActivationFn") is not None:
+        kw["gate_activation"] = _map_activation(node["gateActivationFn"])
+    layer = L.LSTM(**kw)
+
+    def slicer(flat, pos, params, state):
+        n_in, n = int(node["nIn"]), int(node["nOut"])
+        w, pos = _take(flat, pos, n_in * 4 * n)
+        rw, pos = _take(flat, pos, n * 4 * n)
+        b, pos = _take(flat, pos, 4 * n)
+        # IFOG columns == our [i, f, o, g] packing: no gate permutation
+        params["Wx"] = w.reshape((n_in, 4 * n), order="F")
+        params["Wh"] = rw.reshape((n, 4 * n), order="F")
+        params["b"] = b
+        return pos
+
+    return layer, slicer
+
+
+def _build_embedding(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    kw = _ff_kwargs(node)
+    has_bias = bool(node.get("hasBias", True))
+    layer = L.EmbeddingLayer(**kw)
+
+    def slicer(flat, pos, params, state):
+        n_in, n_out = int(node["nIn"]), int(node["nOut"])
+        w, pos = _take(flat, pos, n_in * n_out)
+        params["W"] = w.reshape((n_in, n_out), order="F")
+        if has_bias:
+            b, pos = _take(flat, pos, n_out)
+            params["b"] = b
+        return pos
+
+    return layer, slicer
+
+
+def _build_activation(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    return L.ActivationLayer(
+        activation=_map_activation(node.get("activationFn"))), None
+
+
+def _build_dropout(node):
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    p = 0.5
+    drop = node.get("iDropout")
+    if isinstance(drop, dict) and "p" in drop:
+        # Java Dropout stores RETAIN probability p; ours is drop prob
+        p = 1.0 - float(drop["p"])
+    return L.DropoutLayer(dropout=p), None
+
+
+_LAYER_BUILDERS = {
+    "dense": _dense_like("DenseLayer"),
+    "output": _dense_like("OutputLayer"),
+    "rnnoutput": _dense_like("RnnOutputLayer"),
+    "loss": _dense_like("LossLayer"),
+    "convolution": _build_conv,
+    "subsampling": _build_subsampling,
+    "batchNormalization": _build_batchnorm,
+    "LSTM": _build_lstm,
+    "embedding": _build_embedding,
+    "activation": _build_activation,
+    "dropout": _build_dropout,
+}
+
+_PREPROCESSOR_BUILDERS = {
+    "cnnToFeedForward": lambda n: _pp("CnnToFeedForwardPreProcessor")(
+        height=int(n.get("inputHeight", 0)),
+        width=int(n.get("inputWidth", 0)),
+        channels=int(n.get("numChannels", 0))),
+    "feedForwardToCnn": lambda n: _pp("FeedForwardToCnnPreProcessor")(
+        height=int(n.get("inputHeight", 0)),
+        width=int(n.get("inputWidth", 0)),
+        channels=int(n.get("numChannels", 0))),
+    "rnnToFeedForward": lambda n: _pp("RnnToFeedForwardPreProcessor")(),
+    "feedForwardToRnn": lambda n: _pp("FeedForwardToRnnPreProcessor")(),
+    "cnnToRnn": lambda n: _pp("CnnToRnnPreProcessor")(),
+}
+
+
+def _pp(name):
+    from deeplearning4j_tpu.nn.conf import preprocessors as P
+
+    return getattr(P, name)
+
+
+# ----------------------------------------------------------------------
+# load
+# ----------------------------------------------------------------------
+
+def load_java_configuration(conf_json: str):
+    """Java ``MultiLayerConfiguration.toJson()`` → (our
+    MultiLayerConfiguration, param slicers, java layer nodes)."""
+    from deeplearning4j_tpu.nn.conf.builders import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.layers.base import GlobalConf
+
+    root = json.loads(conf_json)
+    confs = root.get("confs")
+    if confs is None:
+        raise ValueError(
+            "Not a Java MultiLayerConfiguration JSON (no 'confs' key); "
+            "ComputationGraph-format zips are not supported yet")
+    layers, slicers, nodes = [], [], []
+    seed = 0
+    for entry in confs:
+        seed = int(entry.get("seed", seed))
+        layer_node = entry["layer"]
+        if "@class" in layer_node:  # beta4+-era Id.CLASS layer tags
+            jclass = layer_node["@class"].rsplit(".", 1)[-1]
+            by_class = {"DenseLayer": "dense", "OutputLayer": "output",
+                        "ConvolutionLayer": "convolution",
+                        "SubsamplingLayer": "subsampling",
+                        "BatchNormalization": "batchNormalization",
+                        "LSTM": "LSTM", "EmbeddingLayer": "embedding",
+                        "RnnOutputLayer": "rnnoutput",
+                        "ActivationLayer": "activation",
+                        "DropoutLayer": "dropout", "LossLayer": "loss"}
+            if jclass not in by_class:
+                raise ValueError(f"Unsupported Java layer class {jclass!r}")
+            name, node = by_class[jclass], layer_node
+        else:  # WRAPPER_OBJECT form: {"dense": {...}}
+            (name, node), = layer_node.items()
+        if name not in _LAYER_BUILDERS:
+            raise ValueError(
+                f"Unsupported Java layer type {name!r}; supported: "
+                f"{sorted(_LAYER_BUILDERS)}")
+        layer, slicer = _LAYER_BUILDERS[name](node)
+        layers.append(layer)
+        slicers.append(slicer)
+        nodes.append(node)
+
+    preprocessors = {}
+    for k, v in (root.get("inputPreProcessors") or {}).items():
+        (pname, pnode), = v.items()
+        if pname in _PREPROCESSOR_BUILDERS:
+            preprocessors[int(k)] = _PREPROCESSOR_BUILDERS[pname](pnode)
+
+    conf = MultiLayerConfiguration(
+        global_conf=GlobalConf(seed=seed),
+        layers=layers,
+        preprocessors=preprocessors or None,
+        backprop_type=("tbptt" if root.get("backpropType") == "TruncatedBPTT"
+                       else "standard"),
+        tbptt_fwd_length=int(root.get("tbpttFwdLength", 20)),
+        tbptt_back_length=int(root.get("tbpttBackLength", 20)),
+    )
+    return conf, slicers, nodes
+
+
+def _cnn_flatten_perm(h: int, w: int, c: int) -> np.ndarray:
+    """Row permutation between the two CNN-flatten conventions at a
+    cnnToFeedForward boundary: Java's preprocessor flattens NCHW
+    (channel-major); this package flattens NHWC. ``perm[j_ours] =
+    j_java`` so ``W_ours = W_java[perm]`` makes the loaded dense layer
+    consume our flatten order while computing the Java result."""
+    idx = np.arange(h * w * c)
+    h_i = idx // (w * c)
+    w_i = (idx % (w * c)) // c
+    c_i = idx % c
+    return c_i * (h * w) + h_i * w + w_i
+
+
+def _infer_input_type(conf, nodes):
+    from deeplearning4j_tpu.nn.conf import InputType
+
+    first = conf.layers[0]
+    pp0 = conf.preprocessors.get(0)
+    if pp0 is not None and type(pp0).__name__ == "FeedForwardToCnnPreProcessor":
+        return InputType.feed_forward(pp0.height * pp0.width * pp0.channels)
+    kind = type(first).__name__
+    n_in = getattr(first, "n_in", None)
+    if kind in ("ConvolutionLayer", "SubsamplingLayer"):
+        return None  # image H/W not recorded in the Java JSON
+    if kind in ("LSTM", "GravesLSTM", "SimpleRnn", "RnnOutputLayer"):
+        return InputType.recurrent(n_in) if n_in else None
+    if n_in:
+        return InputType.feed_forward(n_in)
+    return None
+
+
+def restore_java_multi_layer_network(path: str, input_type=None):
+    """Load a model zip produced by the *Java* stack's
+    ``ModelSerializer.writeModel`` into a MultiLayerNetwork.
+
+    ``input_type``: required for CNNs whose input H/W the Java JSON does
+    not record (it resolves them into nIn at build time); inferred for
+    feed-forward / recurrent stacks.
+    """
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path, "r") as z:
+        names = set(z.namelist())
+        if "configuration.json" not in names:
+            raise ValueError(f"{path}: no configuration.json entry")
+        conf_json = z.read("configuration.json").decode("utf-8")
+        conf, slicers, nodes = load_java_configuration(conf_json)
+        if input_type is None:
+            input_type = _infer_input_type(conf, nodes)
+        if input_type is None:
+            raise ValueError(
+                "Pass input_type=InputType.convolutional(h, w, c): the "
+                "Java JSON does not record image dimensions")
+        conf.input_type = input_type
+        # the builder's build() normally runs this chain; the loader
+        # constructed MultiLayerConfiguration directly
+        for layer in conf.layers:
+            layer.inherit_defaults(conf.global_conf)
+        ct = input_type
+        for i, layer in enumerate(conf.layers):
+            if i in conf.preprocessors:
+                ct = conf.preprocessors[i].get_output_type(ct)
+            layer.initialize(ct)
+            ct = layer.get_output_type(ct)
+        net = MultiLayerNetwork(conf).init()
+
+        if "coefficients.bin" in names and "noParams.marker" not in names:
+            with z.open("coefficients.bin") as f:
+                flat = nd4j_bin.read_array(io.BytesIO(f.read()))
+            flat = np.asarray(flat, np.float32).reshape(-1)
+            pos = 0
+            for i, slicer in enumerate(slicers):
+                if slicer is None:
+                    continue
+                params: Dict[str, np.ndarray] = {}
+                state: Dict[str, np.ndarray] = {}
+                pos = slicer(flat, pos, params, state)
+                pp = conf.preprocessors.get(i)
+                if (pp is not None and "W" in params
+                        and type(pp).__name__ ==
+                        "CnnToFeedForwardPreProcessor"
+                        and params["W"].ndim == 2
+                        and params["W"].shape[0]
+                        == pp.height * pp.width * pp.channels):
+                    perm = _cnn_flatten_perm(pp.height, pp.width,
+                                             pp.channels)
+                    params["W"] = params["W"][perm]
+                import jax.numpy as jnp
+
+                for k, v in params.items():
+                    net.params_[i][k] = jnp.asarray(v, jnp.float32)
+                for k, v in state.items():
+                    net.state_[i][k] = jnp.asarray(v, jnp.float32)
+            if pos != flat.size:
+                raise ValueError(
+                    f"coefficients.bin has {flat.size} values; layer "
+                    f"layout consumed {pos} — layer/format mismatch")
+    return net
+
+
+# ----------------------------------------------------------------------
+# export (the reverse direction: write a zip the Java stack can read)
+# ----------------------------------------------------------------------
+
+def _export_layer(layer, params, state
+                  ) -> List[Tuple[str, dict, List[np.ndarray]]]:
+    """our Layer → [(java type name, java JSON node, flat param chunks in
+    the Java view order), ...]. Usually one entry; BatchNormalization
+    with a fused activation expands to TWO Java layers (BN + activation)
+    because the Java BN runtime ignores its activationFn
+    (nn/layers/normalization/BatchNormalization.java:225-226)."""
+    from deeplearning4j_tpu.nn.conf import layers as L
+
+    def act(name):
+        if name not in _ACTIVATION_EXPORT:
+            raise ValueError(f"No Java activation for {name!r}")
+        return {"@class": _ACT_PKG + _ACTIVATION_EXPORT[name]}
+
+    def base(node):
+        if layer.name:
+            node["layerName"] = layer.name
+        if getattr(layer, "updater", None) is not None:
+            try:
+                node["iUpdater"] = _export_updater(layer.updater)
+            except ValueError:
+                pass
+        reg = getattr(layer, "regularization", None)
+        if reg is not None:
+            node["l1"], node["l2"] = reg.l1, reg.l2
+            node["l1Bias"], node["l2Bias"] = reg.l1_bias, reg.l2_bias
+        return node
+
+    t = type(layer).__name__
+    if t in ("DenseLayer", "OutputLayer", "RnnOutputLayer"):
+        node = base({
+            "nIn": layer.n_in, "nOut": layer.n_out,
+            "activationFn": act(layer.activation),
+            "weightInit": str(layer.weight_init).upper()
+            if isinstance(layer.weight_init, str) else "XAVIER",
+        })
+        if t != "DenseLayer":
+            loss = getattr(layer, "loss", "mcxent")
+            if loss not in _LOSS_EXPORT:
+                raise ValueError(f"No Java loss for {loss!r}")
+            node["lossFn"] = {"@class": _LOSS_PKG + _LOSS_EXPORT[loss]}
+        w = np.asarray(params["W"], np.float32)
+        b = np.asarray(params["b"], np.float32)
+        chunks = [w.reshape(-1, order="F"), b.reshape(-1)]
+        name = {"DenseLayer": "dense", "OutputLayer": "output",
+                "RnnOutputLayer": "rnnoutput"}[t]
+        return [(name, node, chunks)]
+    if t == "ConvolutionLayer":
+        node = base({
+            "nIn": layer.n_in, "nOut": layer.n_out,
+            "activationFn": act(layer.activation),
+            "weightInit": str(layer.weight_init).upper()
+            if isinstance(layer.weight_init, str) else "XAVIER",
+            "kernelSize": list(layer.kernel_size),
+            "stride": list(layer.stride),
+            "padding": list(layer.padding),
+            "dilation": list(layer.dilation),
+            "convolutionMode": layer.convolution_mode.capitalize(),
+            "hasBias": layer.has_bias,
+        })
+        w = np.asarray(params["W"], np.float32)  # HWIO
+        w_oihw = np.transpose(w, (3, 2, 0, 1))
+        chunks = []
+        if layer.has_bias:
+            chunks.append(np.asarray(params["b"], np.float32).reshape(-1))
+        chunks.append(w_oihw.reshape(-1, order="C"))
+        return [("convolution", node, chunks)]
+    if t == "SubsamplingLayer":
+        node = base({
+            "poolingType": layer.pooling_type.upper(),
+            "kernelSize": list(layer.kernel_size),
+            "stride": list(layer.stride),
+            "padding": list(layer.padding),
+            "convolutionMode": layer.convolution_mode.capitalize(),
+            "pnorm": layer.pnorm,
+        })
+        return [("subsampling", node, [])]
+    if t == "BatchNormalization":
+        node = base({
+            "nIn": layer.n_feat, "nOut": layer.n_feat,
+            "decay": layer.decay, "eps": layer.eps,
+            "gamma": layer.gamma, "beta": layer.beta,
+            "lockGammaBeta": layer.lock_gamma_beta,
+        })
+        chunks = []
+        if not layer.lock_gamma_beta:
+            chunks.append(np.asarray(params["gamma"], np.float32))
+            chunks.append(np.asarray(params["beta"], np.float32))
+        chunks.append(np.asarray(state["mean"], np.float32))
+        chunks.append(np.asarray(state["var"], np.float32))
+        out = [("batchNormalization", node, chunks)]
+        if layer.activation not in (None, "identity"):
+            # Java BN ignores activationFn at runtime — emit an explicit
+            # activation layer so the exported model computes the same fn
+            out.append(("activation",
+                        {"activationFn": act(layer.activation)}, []))
+        return out
+    if t == "LSTM":
+        node = base({
+            "nIn": layer.n_in, "nOut": layer.n_out,
+            "activationFn": act(layer.activation),
+            "gateActivationFn": act(layer.gate_activation),
+            "forgetGateBiasInit": layer.forget_gate_bias_init,
+            "weightInit": str(layer.weight_init).upper()
+            if isinstance(layer.weight_init, str) else "XAVIER",
+        })
+        chunks = [
+            np.asarray(params["Wx"], np.float32).reshape(-1, order="F"),
+            np.asarray(params["Wh"], np.float32).reshape(-1, order="F"),
+            np.asarray(params["b"], np.float32).reshape(-1),
+        ]
+        return [("LSTM", node, chunks)]
+    if t == "ActivationLayer":
+        return [("activation",
+                 base({"activationFn": act(layer.activation)}), [])]
+    raise ValueError(f"No Java export mapping for layer {t}")
+
+
+def write_java_model(net, path: str) -> None:
+    """Export a MultiLayerNetwork as a Java-stack-format model zip
+    (``configuration.json`` Jackson schema + ``coefficients.bin``
+    ``Nd4j.write`` stream) — the reverse interop direction."""
+    confs = []
+    chunks: List[np.ndarray] = []
+    # exported index of each original layer — BN-with-activation expands
+    # to two Java layers, shifting every later index (and the
+    # inputPreProcessors keys, which are layer positions)
+    exported_index: Dict[int, int] = {}
+    for i, layer in enumerate(net.layers):
+        params = net.params_[i]
+        pp = (net.conf.preprocessors or {}).get(i)
+        if (pp is not None and "W" in params
+                and type(pp).__name__ == "CnnToFeedForwardPreProcessor"):
+            w = np.asarray(params["W"], np.float32)
+            if w.ndim == 2 and \
+                    w.shape[0] == pp.height * pp.width * pp.channels:
+                perm = _cnn_flatten_perm(pp.height, pp.width, pp.channels)
+                w_java = np.empty_like(w)
+                w_java[perm] = w  # inverse of the import permutation
+                params = dict(params)
+                params["W"] = w_java
+        exported_index[i] = len(confs)
+        for name, node, layer_chunks in _export_layer(
+                layer, params, net.state_[i]):
+            confs.append({
+                "layer": {name: node},
+                "seed": net.conf.global_conf.seed,
+                "miniBatch": True,
+                "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+                "minimize": True,
+            })
+            chunks.extend(layer_chunks)
+    pps = {}
+    for idx, pp in (net.conf.preprocessors or {}).items():
+        t = type(pp).__name__
+        jidx = str(exported_index[int(idx)])
+        if t == "CnnToFeedForwardPreProcessor":
+            pps[jidx] = {"cnnToFeedForward": {
+                "inputHeight": pp.height, "inputWidth": pp.width,
+                "numChannels": pp.channels}}
+        elif t == "FeedForwardToCnnPreProcessor":
+            pps[jidx] = {"feedForwardToCnn": {
+                "inputHeight": pp.height, "inputWidth": pp.width,
+                "numChannels": pp.channels}}
+        elif t == "RnnToFeedForwardPreProcessor":
+            pps[jidx] = {"rnnToFeedForward": {}}
+        elif t == "FeedForwardToRnnPreProcessor":
+            pps[jidx] = {"feedForwardToRnn": {}}
+        elif t == "CnnToRnnPreProcessor":
+            pps[jidx] = {"cnnToRnn": {}}
+        else:
+            raise ValueError(
+                f"No Java export mapping for preprocessor {t} at layer "
+                f"{idx} — refusing to silently drop it")
+    root = {
+        "backprop": True,
+        "backpropType": ("TruncatedBPTT"
+                         if net.conf.backprop_type == "tbptt"
+                         else "Standard"),
+        "tbpttFwdLength": net.conf.tbptt_fwd_length,
+        "tbpttBackLength": net.conf.tbptt_back_length,
+        "pretrain": False,
+        "confs": confs,
+    }
+    if pps:
+        root["inputPreProcessors"] = pps
+    flat = (np.concatenate([c.reshape(-1) for c in chunks])
+            if chunks else np.zeros((0,), np.float32))
+    buf = io.BytesIO()
+    # Java flattenedParams is a (1, N) row vector (MultiLayerNetwork.java:609)
+    nd4j_bin.write_array(buf, flat.reshape(1, -1).astype(np.float32))
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", json.dumps(root, indent=2))
+        z.writestr("coefficients.bin", buf.getvalue())

@@ -1,0 +1,151 @@
+"""ND4J binary array stream format (``Nd4j.write``/``Nd4j.read``).
+
+The Java stack's ``ModelSerializer`` stores ``coefficients.bin`` /
+``updaterState.bin`` by calling ``Nd4j.write(INDArray, DataOutputStream)``
+(reference ``deeplearning4j-nn/src/main/java/org/deeplearning4j/util/
+ModelSerializer.java:118-135``). That writes two ND4J ``DataBuffer``
+streams back to back — the shape-info buffer then the data buffer — each
+in the ``BaseDataBuffer.write`` wire layout:
+
+    writeUTF(allocationMode)   # java modified-UTF8: u16 length + bytes
+    writeInt(length)           # element count (writeLong for LONG_SHAPE /
+                               #  MIXED_DATA_TYPES era buffers)
+    writeUTF(dataType)         # "INT" | "LONG" | "FLOAT" | "DOUBLE" | "HALF"
+    <length elements, big-endian>
+
+The shape-info buffer for a rank-R array is the standard ND4J shape
+descriptor: ``[rank, *shape, *stride, offset, elementWiseStride,
+orderChar]`` (length 2R+4, order stored as the ASCII code of 'c'/'f').
+
+ND4J (the reference's tensor runtime) is a separate source tree not
+vendored here, so this module is written to the wire layout as consumed
+by ``BaseDataBuffer.read`` across the 0.9.x–1.0.0-beta era the reference
+targets: the reader below is deliberately tolerant (int- and long-length
+headers, any known allocation-mode tag), and the writer emits the
+narrow-int 0.9.x/1.0.0-alpha form that every era can read back.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import BinaryIO, Tuple
+
+import numpy as np
+
+# AllocationMode tags that have appeared in BaseDataBuffer headers.
+# LONG_SHAPE / MIXED_DATA_TYPES era headers switch the length field to i64.
+_INT_LEN_MODES = {"HEAP", "JAVACPP", "DIRECT"}
+_LONG_LEN_MODES = {"LONG_SHAPE", "MIXED_DATA_TYPES"}
+
+_DTYPES = {
+    "INT": (">i4", np.int32),
+    "LONG": (">i8", np.int64),
+    "FLOAT": (">f4", np.float32),
+    "DOUBLE": (">f8", np.float64),
+    "HALF": (">f2", np.float16),
+}
+_NP_TO_ND4J = {
+    np.dtype(np.int32): "INT",
+    np.dtype(np.int64): "LONG",
+    np.dtype(np.float32): "FLOAT",
+    np.dtype(np.float64): "DOUBLE",
+    np.dtype(np.float16): "HALF",
+}
+
+
+def _read_utf(f: BinaryIO) -> str:
+    """java.io.DataInputStream.readUTF: u16 byte-length + modified UTF-8
+    (pure-ASCII for every tag we care about)."""
+    raw = f.read(2)
+    if len(raw) < 2:
+        raise EOFError("truncated ND4J stream (UTF length)")
+    (n,) = struct.unpack(">H", raw)
+    data = f.read(n)
+    if len(data) < n:
+        raise EOFError("truncated ND4J stream (UTF body)")
+    return data.decode("utf-8")
+
+
+def _write_utf(f: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack(">H", len(b)))
+    f.write(b)
+
+
+def read_buffer(f: BinaryIO) -> Tuple[np.ndarray, str]:
+    """Read one DataBuffer; returns (1-D numpy array, allocation_mode)."""
+    mode = _read_utf(f)
+    if mode in _LONG_LEN_MODES:
+        (length,) = struct.unpack(">q", f.read(8))
+    elif mode in _INT_LEN_MODES:
+        (length,) = struct.unpack(">i", f.read(4))
+    else:
+        raise ValueError(
+            f"Unknown ND4J allocation mode {mode!r} — not an Nd4j.write "
+            f"stream, or a newer wire format than this reader understands")
+    dtype_name = _read_utf(f)
+    if dtype_name not in _DTYPES:
+        raise ValueError(f"Unknown ND4J data type {dtype_name!r}")
+    be, np_t = _DTYPES[dtype_name]
+    nbytes = length * np.dtype(be).itemsize
+    raw = f.read(nbytes)
+    if len(raw) < nbytes:
+        raise EOFError(
+            f"truncated ND4J stream: wanted {nbytes} data bytes, got "
+            f"{len(raw)}")
+    return np.frombuffer(raw, dtype=be).astype(np_t), mode
+
+
+def write_buffer(f: BinaryIO, arr: np.ndarray, mode: str = "HEAP") -> None:
+    arr = np.ascontiguousarray(arr).reshape(-1)
+    name = _NP_TO_ND4J.get(arr.dtype)
+    if name is None:
+        raise TypeError(f"No ND4J data type for numpy dtype {arr.dtype}")
+    _write_utf(f, mode)
+    f.write(struct.pack(">i", arr.size))
+    _write_utf(f, name)
+    f.write(arr.astype(_DTYPES[name][0]).tobytes())
+
+
+def read_array(f: BinaryIO) -> np.ndarray:
+    """``Nd4j.read``: shape-info buffer + data buffer → numpy array with
+    the stored shape/order applied."""
+    shape_info, _ = read_buffer(f)
+    shape_info = shape_info.astype(np.int64)
+    rank = int(shape_info[0])
+    if len(shape_info) < 2 * rank + 4:
+        raise ValueError(
+            f"shape-info buffer too short for rank {rank}: "
+            f"{len(shape_info)} elements")
+    shape = tuple(int(s) for s in shape_info[1:1 + rank])
+    order = chr(int(shape_info[2 * rank + 3]))
+    if order not in ("c", "f"):
+        raise ValueError(f"Bad order char {order!r} in shape info")
+    data, _ = read_buffer(f)
+    n = int(np.prod(shape)) if rank else 1
+    if data.size != n:
+        raise ValueError(
+            f"data buffer has {data.size} elements for shape {shape}")
+    return data.reshape(shape, order=order)
+
+
+def write_array(f: BinaryIO, arr: np.ndarray, order: str = "c") -> None:
+    """``Nd4j.write``: emit shape-info + data buffers for ``arr``."""
+    arr = np.asarray(arr)
+    rank = arr.ndim
+    shape = arr.shape
+    # strides in elements for the chosen logical order
+    strides = []
+    acc = 1
+    if order == "c":
+        for s in reversed(shape):
+            strides.insert(0, acc)
+            acc *= s
+    else:
+        for s in shape:
+            strides.append(acc)
+            acc *= s
+    info = np.asarray(
+        [rank, *shape, *strides, 0, 1, ord(order)], dtype=np.int32)
+    write_buffer(f, info)
+    write_buffer(f, np.asarray(arr).reshape(-1, order=order.upper()))

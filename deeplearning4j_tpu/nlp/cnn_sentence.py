@@ -151,7 +151,8 @@ class CnnSentenceDataSetIterator(DataSetIterator):
                 probe = getattr(self.wv, attr, None)
                 if probe is None:
                     continue
-                for w in probe():
+                words = probe() if callable(probe) else probe
+                for w in words:
                     w = getattr(w, "word", w)
                     if self.wv.has_word(w):
                         self.wv_size = len(np.asarray(

@@ -1,0 +1,276 @@
+"""Builders for Java-stack-layout model-zip fixtures.
+
+Constructs zips byte-for-byte in the Java ``ModelSerializer.writeModel``
+layout (``util/ModelSerializer.java:39-135``): Jackson-schema
+``configuration.json`` (WRAPPER_OBJECT layer names, ``@class``
+activations/losses/updaters) + ``coefficients.bin`` as an ``Nd4j.write``
+stream of the flattened param row-vector in each ParamInitializer's view
+order. There is no JVM in this environment, so the fixtures are
+hand-authored to the format contract documented in
+``deeplearning4j_tpu/modelimport/dl4j/loader.py`` — the committed-zip
+gate test (RegressionTest080-style) then locks loader behavior against
+them, and the numpy-forward oracle validates the de-flattening
+independently of the loader.
+
+All params come from a seeded RNG so tests can regenerate the exact
+arrays and compute expected outputs with plain numpy.
+"""
+
+import io
+import json
+import zipfile
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.dl4j import nd4j_bin
+
+ACT = "org.nd4j.linalg.activations.impl."
+LOSS = "org.nd4j.linalg.lossfunctions.impl."
+UPD = "org.nd4j.linalg.learning.config."
+
+
+def _zip_bytes(conf: dict, flat: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    nd4j_bin.write_array(buf, flat.reshape(1, -1).astype(np.float32))
+    out = io.BytesIO()
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", json.dumps(conf, indent=2))
+        z.writestr("coefficients.bin", buf.getvalue())
+    return out.getvalue()
+
+
+def mlp_params(seed=1234):
+    """Arrays in Java shapes for dense(4->8 relu) + output(8->3 softmax)."""
+    r = np.random.default_rng(seed)
+    return {
+        "w0": r.normal(0, 0.4, (4, 8)).astype(np.float32),
+        "b0": r.normal(0, 0.1, (8,)).astype(np.float32),
+        "w1": r.normal(0, 0.4, (8, 3)).astype(np.float32),
+        "b1": r.normal(0, 0.1, (3,)).astype(np.float32),
+    }
+
+
+def mlp_zip_bytes(seed=1234) -> bytes:
+    p = mlp_params(seed)
+    conf = {
+        "backprop": True, "backpropType": "Standard", "pretrain": False,
+        "tbpttFwdLength": 20, "tbpttBackLength": 20,
+        "confs": [
+            {"seed": 42, "miniBatch": True, "minimize": True,
+             "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+             "layer": {"dense": {
+                 "nIn": 4, "nOut": 8,
+                 "activationFn": {"@class": ACT + "ActivationReLU"},
+                 "weightInit": "XAVIER", "biasInit": 0.0,
+                 "l1": 0.0, "l2": 0.0, "l1Bias": 0.0, "l2Bias": 0.0,
+                 "iUpdater": {"@class": UPD + "Adam",
+                              "learningRate": 0.005, "beta1": 0.9,
+                              "beta2": 0.999, "epsilon": 1e-8}}}},
+            {"seed": 42, "miniBatch": True, "minimize": True,
+             "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+             "layer": {"output": {
+                 "nIn": 8, "nOut": 3,
+                 "activationFn": {"@class": ACT + "ActivationSoftmax"},
+                 "lossFn": {"@class": LOSS + "LossMCXENT"},
+                 "weightInit": "XAVIER", "biasInit": 0.0,
+                 "l1": 0.0, "l2": 0.0, "l1Bias": 0.0, "l2Bias": 0.0,
+                 "iUpdater": {"@class": UPD + "Adam",
+                              "learningRate": 0.005, "beta1": 0.9,
+                              "beta2": 0.999, "epsilon": 1e-8}}}},
+        ],
+    }
+    # DefaultParamInitializer layout: W ('f' of (nIn,nOut)) then b
+    flat = np.concatenate([
+        p["w0"].reshape(-1, order="F"), p["b0"],
+        p["w1"].reshape(-1, order="F"), p["b1"],
+    ])
+    return _zip_bytes(conf, flat)
+
+
+def mlp_forward_numpy(p, x):
+    h = np.maximum(x @ p["w0"] + p["b0"], 0.0)
+    z = h @ p["w1"] + p["b1"]
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def cnn_params(seed=77):
+    """conv(1->3, 3x3) OIHW + BN(3) + dense(48->5 softmax output);
+    input 6x6x1 image."""
+    r = np.random.default_rng(seed)
+    return {
+        "convW": r.normal(0, 0.3, (3, 1, 3, 3)).astype(np.float32),  # OIHW
+        "convB": r.normal(0, 0.1, (3,)).astype(np.float32),
+        "gamma": (1.0 + 0.1 * r.normal(size=3)).astype(np.float32),
+        "beta": (0.1 * r.normal(size=3)).astype(np.float32),
+        "mean": (0.05 * r.normal(size=3)).astype(np.float32),
+        "var": (1.0 + 0.1 * np.abs(r.normal(size=3))).astype(np.float32),
+        "wOut": r.normal(0, 0.3, (12, 5)).astype(np.float32),
+        "bOut": r.normal(0, 0.1, (5,)).astype(np.float32),
+    }
+
+
+def cnn_zip_bytes(seed=77) -> bytes:
+    p = cnn_params(seed)
+    common = {"seed": 7, "miniBatch": True, "minimize": True,
+              "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT"}
+    upd = {"@class": UPD + "Nesterovs", "learningRate": 0.01,
+           "momentum": 0.9}
+    conf = {
+        "backprop": True, "backpropType": "Standard", "pretrain": False,
+        "tbpttFwdLength": 20, "tbpttBackLength": 20,
+        "confs": [
+            {**common, "layer": {"convolution": {
+                "nIn": 1, "nOut": 3, "kernelSize": [3, 3],
+                "stride": [1, 1], "padding": [0, 0],
+                "convolutionMode": "Truncate", "hasBias": True,
+                "activationFn": {"@class": ACT + "ActivationIdentity"},
+                "weightInit": "XAVIER", "iUpdater": upd}}},
+            {**common, "layer": {"batchNormalization": {
+                "nIn": 3, "nOut": 3, "decay": 0.9, "eps": 1e-5,
+                "gamma": 1.0, "beta": 0.0, "lockGammaBeta": False,
+                "iUpdater": upd}}},
+            # Java BN does NOT apply its activationFn (nn/layers/
+            # normalization/BatchNormalization.java:225-226 activate() is
+            # just preOutput) — an explicit activation layer follows
+            {**common, "layer": {"activation": {
+                "activationFn": {"@class": ACT + "ActivationReLU"}}}},
+            {**common, "layer": {"subsampling": {
+                "poolingType": "MAX", "kernelSize": [2, 2],
+                "stride": [2, 2], "padding": [0, 0],
+                "convolutionMode": "Truncate"}}},
+            {**common, "layer": {"output": {
+                "nIn": 12, "nOut": 5,
+                "activationFn": {"@class": ACT + "ActivationSoftmax"},
+                "lossFn": {"@class": LOSS + "LossMCXENT"},
+                "weightInit": "XAVIER", "iUpdater": upd}}},
+        ],
+        "inputPreProcessors": {
+            "4": {"cnnToFeedForward": {
+                "inputHeight": 2, "inputWidth": 2, "numChannels": 3}},
+        },
+    }
+    # Conv layout: bias FIRST then 'c'-order OIHW W
+    # (ConvolutionParamInitializer.java:105-132); BN: gamma,beta,mean,var
+    flat = np.concatenate([
+        p["convB"], p["convW"].reshape(-1, order="C"),
+        p["gamma"], p["beta"], p["mean"], p["var"],
+        p["wOut"].reshape(-1, order="F"), p["bOut"],
+    ])
+    return _zip_bytes(conf, flat)
+
+
+def cnn_forward_numpy(p, x_nhwc):
+    """Plain-numpy oracle: conv valid 3x3 -> BN(inference) -> relu ->
+    maxpool 2x2 -> flatten (Java NCHW flatten order) -> softmax dense."""
+    b, h, w, _ = x_nhwc.shape
+    oh, ow = h - 2, w - 2
+    conv = np.zeros((b, oh, ow, 3), np.float32)
+    for o in range(3):
+        acc = np.zeros((b, oh, ow), np.float32)
+        for kh in range(3):
+            for kw in range(3):
+                acc += p["convW"][o, 0, kh, kw] * \
+                    x_nhwc[:, kh:kh + oh, kw:kw + ow, 0]
+        conv[..., o] = acc + p["convB"][o]
+    bn = (conv - p["mean"]) / np.sqrt(p["var"] + 1e-5) * p["gamma"] \
+        + p["beta"]
+    act = np.maximum(bn, 0.0)
+    pool = np.max(
+        act.reshape(b, oh // 2, 2, ow // 2, 2, 3), axis=(2, 4))
+    # Java CnnToFeedForwardPreProcessor flattens NCHW: channel-major
+    flatv = np.transpose(pool, (0, 3, 1, 2)).reshape(b, -1)
+    z = flatv @ p["wOut"] + p["bOut"]
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def lstm_params(seed=9):
+    r = np.random.default_rng(seed)
+    return {
+        "Wx": r.normal(0, 0.3, (5, 24)).astype(np.float32),
+        "Wh": r.normal(0, 0.3, (6, 24)).astype(np.float32),
+        "b": r.normal(0, 0.1, (24,)).astype(np.float32),
+        "wOut": r.normal(0, 0.3, (6, 2)).astype(np.float32),
+        "bOut": r.normal(0, 0.1, (2,)).astype(np.float32),
+    }
+
+
+def lstm_zip_bytes(seed=9) -> bytes:
+    p = lstm_params(seed)
+    common = {"seed": 3, "miniBatch": True, "minimize": True,
+              "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT"}
+    upd = {"@class": UPD + "Sgd", "learningRate": 0.05}
+    conf = {
+        "backprop": True, "backpropType": "Standard", "pretrain": False,
+        "tbpttFwdLength": 20, "tbpttBackLength": 20,
+        "confs": [
+            {**common, "layer": {"LSTM": {
+                "nIn": 5, "nOut": 6, "forgetGateBiasInit": 1.0,
+                "activationFn": {"@class": ACT + "ActivationTanH"},
+                "gateActivationFn": {"@class": ACT + "ActivationSigmoid"},
+                "weightInit": "XAVIER", "iUpdater": upd}}},
+            {**common, "layer": {"rnnoutput": {
+                "nIn": 6, "nOut": 2,
+                "activationFn": {"@class": ACT + "ActivationSoftmax"},
+                "lossFn": {"@class": LOSS + "LossMCXENT"},
+                "weightInit": "XAVIER", "iUpdater": upd}}},
+        ],
+    }
+    # LSTMParamInitializer layout: W ('f'), RW ('f'), b; IFOG columns
+    flat = np.concatenate([
+        p["Wx"].reshape(-1, order="F"), p["Wh"].reshape(-1, order="F"),
+        p["b"],
+        p["wOut"].reshape(-1, order="F"), p["bOut"],
+    ])
+    return _zip_bytes(conf, flat)
+
+
+def lstm_forward_numpy(p, x_btf):
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    b, t, _ = x_btf.shape
+    n = 6
+    h = np.zeros((b, n), np.float32)
+    c = np.zeros((b, n), np.float32)
+    hs = []
+    for step in range(t):
+        z = x_btf[:, step] @ p["Wx"] + h @ p["Wh"] + p["b"]
+        i = sig(z[:, :n])
+        f = sig(z[:, n:2 * n])
+        o = sig(z[:, 2 * n:3 * n])
+        g = np.tanh(z[:, 3 * n:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        hs.append(h)
+    hseq = np.stack(hs, axis=1)  # (b, t, n)
+    zz = hseq @ p["wOut"] + p["bOut"]
+    e = np.exp(zz - zz.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+FIXTURES = {
+    "java_mlp.zip": mlp_zip_bytes,
+    "java_cnn.zip": cnn_zip_bytes,
+    "java_lstm.zip": lstm_zip_bytes,
+}
+
+
+def write_fixtures(directory):
+    import os
+
+    os.makedirs(directory, exist_ok=True)
+    for name, fn in FIXTURES.items():
+        with open(os.path.join(directory, name), "wb") as f:
+            f.write(fn())
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else \
+        os.path.join(os.path.dirname(__file__), "fixtures", "java_interop")
+    write_fixtures(out)
+    print("wrote", sorted(FIXTURES), "to", out)
