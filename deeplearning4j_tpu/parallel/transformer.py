@@ -111,15 +111,13 @@ class DistributedLMTrainer:
                     f"n_experts {self.cfg.n_experts} not divisible by "
                     f"expert axis {ep}"
                 )
-            if pp > 1 and ep > 1:
-                raise ValueError(
-                    "MoE with BOTH pipeline and expert axes is not "
-                    "supported: inside the pipeline's manual shard_map "
-                    "region the stacked expert dims aren't re-sharded "
-                    "over 'expert'. Use PP with replicated experts "
-                    "(pipe>1, expert=1 — the aux loss rides the ring) or "
-                    "EP composed with data/model/seq (the GShard layout)."
-                )
+            # PP×EP composes: the pipeline shard_map is manual over
+            # {"pipe"} (+"seq") only, so the expert dim of the stacked
+            # block params stays an AUTO axis — GSPMD keeps W1/W2 et al
+            # partitioned over "expert" (from param_pspecs) inside the
+            # manual region and lowers the dispatch einsums to the
+            # token all-to-all as in the pure-EP layout. Exact-parity
+            # coverage: tests/test_moe.py (data×pipe×expert mesh).
         self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
         self._step = None
 
