@@ -1,6 +1,9 @@
-"""ZooModel base (reference ``zoo/ZooModel.java:23``; pretrained download
-+ checksum at ``:40-62`` is gated here — no egress in this environment, so
-``init_pretrained`` looks only in the local cache dir)."""
+"""ZooModel base (reference ``zoo/ZooModel.java:23``): pretrained
+weight restore with the reference's download + checksum machinery
+(``:40-62``) — URL registry per dataset, resumable atomic download into
+the cache dir, sha256 gate with delete-on-mismatch. Environments without
+egress stage artifacts into the cache (or pass ``path=``) and the same
+verification path runs."""
 
 from __future__ import annotations
 
@@ -57,14 +60,25 @@ class ZooModel:
     #: weights into the cache) fill this so ``init_pretrained`` verifies
     #: integrity like the reference's checksum gate (``ZooModel.java:40-62``)
     pretrained_checksums: dict = {}
+    #: per-dataset weight-artifact URLs (reference ``pretrainedUrl``):
+    #: fill to enable ``init_pretrained(dataset)`` with no ``path=`` —
+    #: the artifact downloads into the cache dir with resume + sha256
+    pretrained_urls: dict = {}
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
-        # each model class gets its OWN registry: writing
+        # each model class gets its OWN registries: writing
         # LeNet.pretrained_checksums[...] must never leak a digest into
         # ResNet50's lookups through the shared base-class dict
         if "pretrained_checksums" not in cls.__dict__:
             cls.pretrained_checksums = dict(cls.pretrained_checksums)
+        if "pretrained_urls" not in cls.__dict__:
+            cls.pretrained_urls = dict(cls.pretrained_urls)
+
+    def pretrained_url(self, dataset: str = "imagenet") -> Optional[str]:
+        """URL of the weight artifact for ``dataset`` (reference
+        ``ZooModel.pretrainedUrl``); None when not published."""
+        return self.pretrained_urls.get(dataset)
 
     def pretrained_path(self, dataset: str = "imagenet") -> str:
         return os.path.join(CACHE_DIR, "zoo", f"{self.name}_{dataset}.zip")
@@ -79,35 +93,96 @@ class ZooModel:
                 h.update(chunk)
         return h.hexdigest()
 
+    @staticmethod
+    def _download(url: str, dest: str, timeout: float = 60.0) -> None:
+        """Fetch ``url`` into ``dest``: partial content accumulates in a
+        ``.part`` sidecar and resumes with an HTTP Range request (the
+        reference's copyURLToFile has no resume; interrupted multi-GB
+        weight pulls motivated adding it), then moves into place
+        atomically. Egress failures raise with staging guidance rather
+        than leaving a half-written dest."""
+        import urllib.error
+        import urllib.request
+
+        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+        part = dest + ".part"
+        have = os.path.getsize(part) if os.path.exists(part) else 0
+        req = urllib.request.Request(url)
+        if have:
+            req.add_header("Range", f"bytes={have}-")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                if have and resp.status != 206:
+                    have = 0  # server ignored Range: restart from zero
+                mode = "ab" if have else "wb"
+                with open(part, mode) as f:
+                    while True:
+                        chunk = resp.read(1 << 20)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+        except urllib.error.HTTPError as e:
+            if e.code == 416 and have:
+                # Range past EOF: the .part already holds the whole file
+                # (crash between read loop and rename) — promote it; the
+                # caller's checksum gate validates the bytes
+                os.replace(part, dest)
+                return
+            raise ConnectionError(
+                f"Could not download pretrained weights from {url}: {e}. "
+                f"If this environment has no egress, stage the artifact "
+                f"at {dest} manually (partial progress kept at {part})."
+            ) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ConnectionError(
+                f"Could not download pretrained weights from {url}: {e}. "
+                f"If this environment has no egress, stage the artifact "
+                f"at {dest} manually (partial progress kept at {part})."
+            ) from e
+        os.replace(part, dest)
+
     def init_pretrained(self, dataset: str = "imagenet",
                         path: Optional[str] = None,
                         checksum: Optional[str] = None):
-        """Restore a pretrained checkpoint (reference ``initPretrained``
-        + its checksum verification, ``ZooModel.java:40-62``; the
-        download half is impossible without egress, so weights come from
-        ``path`` or the local cache dir).
+        """Restore a pretrained checkpoint (reference ``initPretrained``,
+        ``ZooModel.java:40-62``): resolve the cache path; when absent and
+        ``pretrained_urls[dataset]`` is registered, download (resumable,
+        atomic) into the cache; verify sha256; load.
 
         The weight artifact is the reference zip checkpoint layout
         (``ModelSerializer``: configuration.json + coefficients.bin [+
         updaterState.bin]). ``checksum`` (sha256 hex) overrides the
         per-class ``pretrained_checksums[dataset]`` entry; when either is
-        present the file hash MUST match — a corrupt/wrong artifact
-        raises instead of silently loading."""
+        present the file hash MUST match — like the reference, a
+        mismatched download is deleted before raising so a retry
+        re-fetches instead of re-failing on the same bytes."""
+        explicit_path = path is not None
         path = path or self.pretrained_path(dataset)
+        downloaded = False  # True ONLY when THIS call fetched the file —
+        # a user-staged cache artifact must never be deleted on mismatch
         if not os.path.exists(path):
-            raise FileNotFoundError(
-                f"No pretrained weights at {path}. This environment has no "
-                "network egress; place a checkpoint there manually."
-            )
+            url = self.pretrained_url(dataset)
+            if url is None or explicit_path:
+                raise FileNotFoundError(
+                    f"No pretrained weights at {path} and no URL "
+                    f"registered for {type(self).__name__}[{dataset!r}] "
+                    "(pretrained_urls). Stage a checkpoint there or "
+                    "register its URL.")
+            self._download(url, path)
+            downloaded = True
         expect = checksum or self.pretrained_checksums.get(dataset)
         if expect:
             actual = self._sha256(path)
             if actual != expect.lower():
+                if downloaded:
+                    os.remove(path)  # reference semantics: clean up the
+                    # bad artifact so the next call re-downloads
                 raise ValueError(
                     f"Checksum mismatch for {path}: expected {expect}, "
-                    f"got {actual} — refusing to load a corrupt/substituted "
-                    "pretrained artifact (reference ZooModel deletes and "
-                    "re-downloads; offline, re-stage the file)")
+                    f"got {actual} — refusing to load a corrupt/"
+                    "substituted pretrained artifact"
+                    + (" (deleted; retry will re-download)"
+                       if downloaded else ""))
         from deeplearning4j_tpu.train.model_serializer import ModelGuesser
 
         return ModelGuesser.load_model_guess(path)

@@ -30,8 +30,21 @@ class _Request:
 
 
 class ParallelInference:
+    """Inference modes (reference ``parallelism/inference/InferenceMode``;
+    this tree's enum is SEQUENTIAL/BATCHED — INPLACE is the later-era
+    third mode, included for full surface parity):
+
+    - ``sequential``: each request runs as-is on the caller's thread.
+    - ``batched``: concurrent requests coalesce (up to ``batch_limit``
+      examples) into one sharded XLA dispatch — the throughput mode.
+    - ``inplace``: ``workers`` model replicas served round-robin, each
+      under its own lock — concurrent callers proceed lock-free across
+      replicas and any internal model state (e.g. rnn_time_step
+      carries) is per-replica, never shared."""
+
     INFERENCE_MODE_SEQUENTIAL = "sequential"
     INFERENCE_MODE_BATCHED = "batched"
+    INFERENCE_MODE_INPLACE = "inplace"
 
     class Builder:
         def __init__(self, model):
@@ -54,9 +67,10 @@ class ParallelInference:
             return self
 
         def workers(self, n: int):
-            # accepted for reference API parity; a single sharded XLA program
-            # replaces per-device worker threads (device parallelism comes
-            # from the mesh, not from thread count) — documented no-op like
+            # INPLACE: number of model replicas. SEQUENTIAL/BATCHED: a
+            # single sharded XLA program replaces per-device worker
+            # threads (device parallelism comes from the mesh, not from
+            # thread count), so there it is a documented no-op like
             # ParallelWrapper.averaging_frequency
             self._workers = int(n)
             return self
@@ -64,7 +78,7 @@ class ParallelInference:
         def build(self) -> "ParallelInference":
             return ParallelInference(
                 self.model, mode=self._mode, batch_limit=self._batch_limit,
-                queue_limit=self._queue_limit,
+                queue_limit=self._queue_limit, workers=self._workers,
             )
 
     @staticmethod
@@ -72,22 +86,43 @@ class ParallelInference:
         return ParallelInference.Builder(model)
 
     def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
-                 queue_limit: int = 64, mesh: Optional[TrainingMesh] = None):
+                 queue_limit: int = 64, mesh: Optional[TrainingMesh] = None,
+                 workers: Optional[int] = None):
+        if mode not in (self.INFERENCE_MODE_SEQUENTIAL,
+                        self.INFERENCE_MODE_BATCHED,
+                        self.INFERENCE_MODE_INPLACE):
+            raise ValueError(f"Unknown inference mode {mode!r}")
         self.model = model
         self.mode = mode
         self.batch_limit = batch_limit
         self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
-        self._worker = threading.Thread(target=self._serve, daemon=True)
-        self._worker.start()
+        if mode == self.INFERENCE_MODE_INPLACE:
+            n = max(int(workers or 2), 1)
+            # replica 0 IS the caller's model (no copy); the rest are
+            # clones so per-replica state never aliases
+            self._replicas = [model] + [model.clone() for _ in range(n - 1)]
+            self._replica_locks = [threading.Lock() for _ in range(n)]
+            self._rr = 0
+            self._rr_lock = threading.Lock()
+            return
+        if mode == self.INFERENCE_MODE_BATCHED:
+            self._worker = threading.Thread(target=self._serve, daemon=True)
+            self._worker.start()
 
     def output(self, x, mask=None) -> np.ndarray:
         """Thread-safe blocking inference call (reference
         ``ParallelInference.output``)."""
-        if self.mode == self.INFERENCE_MODE_SEQUENTIAL:
-            return self.model.output(x, mask=mask)
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
+        if self.mode == self.INFERENCE_MODE_SEQUENTIAL:
+            return self.model.output(x, mask=mask)
+        if self.mode == self.INFERENCE_MODE_INPLACE:
+            with self._rr_lock:
+                i = self._rr
+                self._rr = (self._rr + 1) % len(self._replicas)
+            with self._replica_locks[i]:
+                return self._replicas[i].output(x, mask=mask)
         req = _Request(np.asarray(x), None if mask is None else np.asarray(mask))
         self._queue.put(req)
         req.event.wait()
@@ -141,6 +176,8 @@ class ParallelInference:
 
     def shutdown(self):
         self._shutdown = True
+        if not hasattr(self, "_worker"):
+            return  # sequential/inplace: nothing queued, no thread
         self._worker.join(timeout=2)
         # fail any requests still in flight rather than leaving callers
         # blocked forever on their event

@@ -205,6 +205,51 @@ class TestParallel:
         with pytest.raises(RuntimeError):
             pi.output(ds.features[:8])
 
+    def test_parallel_inference_modes_output_equality(self):
+        """sequential / batched / inplace must all produce the direct
+        model output (reference InferenceMode surface; INPLACE is the
+        later-era third mode)."""
+        net = _net()
+        ds = _blobs(32)
+        ref = np.asarray(net.output(ds.features))
+        for mode in ("sequential", "batched", "inplace"):
+            pi = (ParallelInference.builder(net).inference_mode(mode)
+                  .workers(3).build())
+            out = np.asarray(pi.output(ds.features))
+            np.testing.assert_allclose(out, ref, atol=1e-6)
+            pi.shutdown()
+
+    def test_parallel_inference_inplace_concurrent(self):
+        """inplace: concurrent callers round-robin over model replicas;
+        every request gets its own correct result."""
+        net = _net()
+        ds = _blobs(64)
+        pi = (ParallelInference.builder(net).inference_mode("inplace")
+              .workers(4).build())
+        assert len(pi._replicas) == 4
+        ref = np.asarray(net.output(ds.features))
+        results = {}
+
+        def call(i):
+            results[i] = pi.output(ds.features[i * 8: (i + 1) * 8])
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i in range(8):
+            np.testing.assert_allclose(results[i], ref[i * 8: (i + 1) * 8],
+                                       atol=1e-6)
+        pi.shutdown()
+        with pytest.raises(RuntimeError):
+            pi.output(ds.features[:8])
+
+    def test_parallel_inference_unknown_mode_raises(self):
+        with pytest.raises(ValueError, match="inference mode"):
+            ParallelInference(_net(), mode="spooky")
+
     def test_wrapper_tbptt_2d_data_falls_through_to_standard(self):
         # tBPTT configs are supported since round 3 (tests/test_parity_tail
         # covers the sharded chunk path); 2D batches just train normally
