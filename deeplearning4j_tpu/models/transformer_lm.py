@@ -410,9 +410,27 @@ def token_nll(logits, targets):
     return jnp.sum(nll) / count, count
 
 
-def lm_loss(cfg: TransformerLMConfig, params, ids, targets, attn_fn=None):
+def lm_loss(cfg: TransformerLMConfig, params, ids, targets, attn_fn=None,
+            segment_ids=None):
     """Mean next-token cross-entropy (+ weighted MoE aux loss when MoE).
-    targets (b, T) int32 (-1 = ignore)."""
+    targets (b, T) int32 (-1 = ignore).
+
+    ``segment_ids``: optional (b, T) int array for PACKED-sequence
+    training (multiple documents per row): attention stays within each
+    segment (dense_attention routes to the Pallas flash kernel's
+    segment path when available). Cross-segment next-token targets
+    should carry -1 so the boundary token doesn't predict into the next
+    document."""
+    if segment_ids is not None:
+        if attn_fn is not None:
+            raise ValueError("pass segment_ids OR a custom attn_fn, "
+                             "not both")
+        seg = segment_ids
+
+        def attn_fn(q, k, v, *, causal, mask=None):
+            return dense_attention(q, k, v, causal=causal, mask=mask,
+                                   segment_ids=seg)
+
     logits, aux = forward(cfg, params, ids, attn_fn=attn_fn, return_aux=True,
                           cast_logits=False)
     loss, _ = token_nll(logits, targets)
@@ -457,12 +475,13 @@ class TransformerLM(ZooModel):
         )
         return self
 
-    def _make_step(self):
+    def _make_step(self, with_seg: bool = False):
         cfg, upd = self.cfg, self.updater
 
-        def step(params, opt_state, ids, targets, t):
+        def step(params, opt_state, ids, targets, t, seg=None):
             loss, grads = jax.value_and_grad(
-                lambda p: lm_loss(cfg, p, ids, targets)
+                lambda p: lm_loss(cfg, p, ids, targets,
+                                  segment_ids=seg if with_seg else None)
             )(params)
 
             flat_p, treedef = jax.tree_util.tree_flatten(params)
@@ -478,15 +497,22 @@ class TransformerLM(ZooModel):
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
-        if "step" not in self._jit_cache:
-            self._jit_cache["step"] = self._make_step()
+    def fit_batch(self, ids: np.ndarray, targets: np.ndarray,
+                  segment_ids: Optional[np.ndarray] = None) -> float:
+        """One train step. ``segment_ids`` (b, T) int enables
+        packed-sequence training (see ``lm_loss``)."""
+        key = "step_seg" if segment_ids is not None else "step"
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_step(
+                with_seg=segment_ids is not None)
         self.iteration += 1
-        self.params_, self.opt_state_, self.score_ = self._jit_cache["step"](
-            self.params_, self.opt_state_, jnp.asarray(ids, jnp.int32),
-            jnp.asarray(targets, jnp.int32),
-            jnp.asarray(self.iteration, jnp.int32),
-        )
+        args = [self.params_, self.opt_state_, jnp.asarray(ids, jnp.int32),
+                jnp.asarray(targets, jnp.int32),
+                jnp.asarray(self.iteration, jnp.int32)]
+        if segment_ids is not None:
+            args.append(jnp.asarray(segment_ids, jnp.int32))
+        self.params_, self.opt_state_, self.score_ = \
+            self._jit_cache[key](*args)
         return float(self.score_)
 
     def logits(self, ids: np.ndarray) -> np.ndarray:

@@ -71,7 +71,7 @@ _FLASH_PROBE_CACHE: dict = {}
 
 
 def _probe_compiles(fn, seq_len: int, head_dim: int, dtype,
-                    causal: bool) -> bool:
+                    causal: bool, segment_ids=None) -> bool:
     """Probe a minimal (1,1,T,hd) instance of ``fn(q, k, v)``: compile
     its forward AND value-and-grad programs, EXECUTE both on three
     independently seeded random tensors (q=k=v would hide operand-order /
@@ -103,6 +103,10 @@ def _probe_compiles(fn, seq_len: int, head_dim: int, dtype,
         if causal:
             tri = jnp.tril(jnp.ones((seq_len, seq_len), bool))
             s = jnp.where(tri, s, -1e30)
+        if segment_ids is not None:
+            same = segment_ids[:, None, :, None] == \
+                segment_ids[:, None, None, :]
+            s = jnp.where(same, s, -1e30)
         p = jax.nn.softmax(s, -1)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
 
@@ -132,7 +136,8 @@ def _probe_compiles(fn, seq_len: int, head_dim: int, dtype,
     return True
 
 
-def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
+def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool,
+                          has_seg: bool = False):
     """Pick a flash implementation for this instantiation, compile-probing
     once per (dtype, seq_len, head_dim, causal): the in-tree Pallas
     kernel (nn/ops/flash_attention.py — written against the matmul forms
@@ -145,9 +150,19 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
     is keyed on all four."""
     import logging
 
-    key = (jnp.dtype(dtype).name, int(seq_len), int(head_dim), bool(causal))
+    key = (jnp.dtype(dtype).name, int(seq_len), int(head_dim), bool(causal),
+           bool(has_seg))
     if key in _FLASH_PROBE_CACHE:
         return _FLASH_PROBE_CACHE[key]
+
+    # probe segment pattern: two packed sequences with an off-block-
+    # boundary split so the probe exercises intra-block masking
+    probe_seg = None
+    if has_seg:
+        cut = (seq_len // 2) - (seq_len // 8)
+        probe_seg = jnp.asarray(
+            np.concatenate([np.zeros(cut, np.int32),
+                            np.ones(seq_len - cut, np.int32)])[None, :])
 
     def candidates():
         from deeplearning4j_tpu.nn.ops.flash_attention import (
@@ -157,6 +172,9 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
 
         if seq_len <= MAX_SEQ_LEN:
             yield "in-tree", own_flash
+        if has_seg:
+            return  # the bundled kernel's segment API (SegmentIds
+            # namedtuple) is not probed here; in-tree or dense
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as jax_flash,
         )
@@ -176,12 +194,17 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
                 " — transient remote-compile crash, retrying once"
                 if will_retry else "")
 
-        if probe_with_retry(
-                lambda kernel=kernel: _probe_compiles(
-                    lambda q, k, v: kernel(q, k, v, causal=causal,
-                                           sm_scale=sc),
-                    seq_len, head_dim, dtype, causal),
-                on_fail):
+        if has_seg:
+            probe_fn = (lambda kernel=kernel: _probe_compiles(
+                lambda q, k, v: kernel(q, k, v, causal=causal, sm_scale=sc,
+                                       segment_ids=probe_seg),
+                seq_len, head_dim, dtype, causal, segment_ids=probe_seg))
+        else:
+            probe_fn = (lambda kernel=kernel: _probe_compiles(
+                lambda q, k, v: kernel(q, k, v, causal=causal,
+                                       sm_scale=sc),
+                seq_len, head_dim, dtype, causal))
+        if probe_with_retry(probe_fn, on_fail):
             impl = functools.partial(_call_flash, kernel, causal)
             break
     if impl is None:
@@ -192,11 +215,15 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool):
     return impl
 
 
-def _call_flash(kernel, causal, q, k, v, scale):
-    return kernel(q, k, v, causal=causal, sm_scale=scale)
+def _call_flash(kernel, causal, q, k, v, scale, segment_ids=None):
+    if segment_ids is None:
+        return kernel(q, k, v, causal=causal, sm_scale=scale)
+    return kernel(q, k, v, causal=causal, sm_scale=scale,
+                  segment_ids=segment_ids)
 
 
-def _flash_attention_route(q, k, causal, mask, dropout_rate):
+def _flash_attention_route(q, k, causal, mask, dropout_rate,
+                           segment_ids=None):
     """Route to a Pallas TPU flash-attention kernel when one applies:
     TPU backend, no padding mask / attention dropout, equal q/kv length,
     block-friendly shapes (T multiple of 128; tiny toy shapes stay on
@@ -219,14 +246,15 @@ def _flash_attention_route(q, k, causal, mask, dropout_rate):
     T = q.shape[2]
     if k.shape[2] != T or T < 128 or T % 128:
         return None
-    return _flash_attention_impl(q.dtype, T, q.shape[-1], causal)
+    return _flash_attention_impl(q.dtype, T, q.shape[-1], causal,
+                                 has_seg=segment_ids is not None)
 
 
 BLOCKED_ATTENTION_MIN_T = 1024
 
 
 def _blocked_attention(q, k, v, *, causal: bool, mask, scale: float,
-                       block_q: int):
+                       block_q: int, segment_ids=None):
     """Dense attention evaluated one query block at a time under
     ``lax.scan`` with a rematerialized body: peak live scores are
     (b, h, block_q, T) instead of (b, h, T, T), and the backward pass
@@ -248,6 +276,12 @@ def _blocked_attention(q, k, v, *, causal: bool, mask, scale: float,
             s = jnp.where(kpos[None, :] <= qpos[:, None], s, -1e30)
         if mask is not None:
             s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+        if segment_ids is not None:
+            seg_q = jax.lax.dynamic_slice_in_dim(
+                segment_ids, i * block_q, block_q, 1)  # (b, block_q)
+            s = jnp.where(
+                seg_q[:, None, :, None] == segment_ids[:, None, None, :],
+                s, -1e30)
         p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
         return None, jnp.einsum("bhqk,bhkd->bhqd", p, v)
 
@@ -256,11 +290,17 @@ def _blocked_attention(q, k, v, *, causal: bool, mask, scale: float,
 
 
 def dense_attention(q, k, v, *, causal: bool, mask=None,
-                    dropout_rate: float = 0.0, dropout_rng=None):
+                    dropout_rate: float = 0.0, dropout_rng=None,
+                    segment_ids=None):
     """Reference dense softmax attention. q,k,v: (b, h, T, hd).
 
     ``dropout_rate`` drops entries of the softmax probability matrix
     (standard attention dropout), not the weighted sum.
+
+    ``segment_ids``: optional (b, T) int array for PACKED sequences —
+    tokens attend only within their own segment (composes with
+    ``causal``). Runs on the Pallas flash path when the kernel probes OK
+    at this instantiation, else the blocked/einsum fallbacks.
 
     On TPU with long block-aligned sequences the computation routes to
     the Pallas flash-attention kernel (O(T) memory, no (T, T) scores
@@ -271,21 +311,29 @@ def dense_attention(q, k, v, *, causal: bool, mask=None,
     """
     T = q.shape[2]
     scale = 1.0 / math.sqrt(q.shape[-1])
-    flash_impl = _flash_attention_route(q, k, causal, mask, dropout_rate)
+    if segment_ids is not None:
+        segment_ids = jnp.asarray(segment_ids, jnp.int32)
+    flash_impl = _flash_attention_route(q, k, causal, mask, dropout_rate,
+                                        segment_ids)
     if flash_impl is not None:
-        return flash_impl(q, k, v, scale)
+        return flash_impl(q, k, v, scale, segment_ids=segment_ids)
     if (T >= BLOCKED_ATTENTION_MIN_T and dropout_rate == 0.0
             and k.shape[2] == T):
         for bq in (512, 256, 128):
             if T % bq == 0:
                 return _blocked_attention(q, k, v, causal=causal, mask=mask,
-                                          scale=scale, block_q=bq)
+                                          scale=scale, block_q=bq,
+                                          segment_ids=segment_ids)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tri = jnp.tril(jnp.ones((T, T), bool))
         scores = jnp.where(tri, scores, -1e30)
     if mask is not None:  # (b, T) key padding mask
         scores = jnp.where(mask[:, None, None, :] > 0, scores, -1e30)
+    if segment_ids is not None:  # packed sequences: same-segment only
+        same = segment_ids[:, None, :, None] == \
+            segment_ids[:, None, None, :]
+        scores = jnp.where(same, scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = 1.0 - dropout_rate

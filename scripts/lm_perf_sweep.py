@@ -23,7 +23,8 @@ PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 D, V, HEADS, LAYERS = 768, 32000, 12, 12
 
 
-def measure(batch, seq, flash: bool, fused_qkv: bool = False, iters=10):
+def measure(batch, seq, flash: bool, fused_qkv: bool = False,
+            packed: bool = False, iters=10):
     os.environ["DL4J_TPU_FLASH_ATTENTION"] = "1" if flash else "0"
     import jax.numpy as jnp
 
@@ -37,13 +38,21 @@ def measure(batch, seq, flash: bool, fused_qkv: bool = False, iters=10):
     ids = rng.integers(0, V, (batch, seq)).astype(np.int32)
     tgt = np.roll(ids, -1, axis=1).astype(np.int32)
     tgt[:, -1] = -1
-    step = model._make_step()
+    seg_d = None
+    if packed:  # two documents per row, split off-center (r5 segment path)
+        seg = np.zeros((batch, seq), np.int32)
+        seg[:, seq * 3 // 8:] = 1
+        tgt[:, seq * 3 // 8 - 1] = -1
+        seg_d = jnp.asarray(seg)
+    step = model._make_step(with_seg=packed)
     ids_d, tgt_d = jnp.asarray(ids), jnp.asarray(tgt)
 
     def run_one(i):
-        model.params_, model.opt_state_, model.score_ = step(
-            model.params_, model.opt_state_, ids_d, tgt_d,
-            jnp.asarray(i, jnp.int32))
+        args = [model.params_, model.opt_state_, ids_d, tgt_d,
+                jnp.asarray(i, jnp.int32)]
+        if packed:
+            args.append(seg_d)
+        model.params_, model.opt_state_, model.score_ = step(*args)
 
     run_one(0)
     float(model.score_)  # sync: compile + first step done
@@ -77,13 +86,18 @@ def main():
             (1024, 8), (2048, 4),
         ]
     results = []
-    variants = [(True, False), (False, False), (True, True)]
+    # (flash, fused_qkv, packed): flash-vs-dense A/B, fused_qkv A/B,
+    # and the packed-sequence (segment-id) kernel path
+    variants = [(True, False, False), (False, False, False),
+                (True, True, False), (True, False, True),
+                (False, False, True)]
     for seq, batch in grid:
-        for flash, fq in variants:
+        for flash, fq, packed in variants:
             label = (f"T{seq} b{batch} {'flash' if flash else 'dense'}"
-                     + (" fused_qkv" if fq else ""))
+                     + (" fused_qkv" if fq else "")
+                     + (" packed" if packed else ""))
             try:
-                tps, mfu = measure(batch, seq, flash, fq)
+                tps, mfu = measure(batch, seq, flash, fq, packed)
                 rec = {"config": label, "tokens_per_sec": round(tps, 1),
                        "mfu_pct": round(mfu, 2)}
             except Exception as e:

@@ -359,7 +359,8 @@ class TestFlashAttentionGate:
 
         monkeypatch.setattr(jax, "jit", lambda *a, **k: _Boom())
         assert A._flash_attention_impl(jnp.bfloat16, 512, 64, True) is None
-        assert A._FLASH_PROBE_CACHE == {("bfloat16", 512, 64, True): None}
+        assert A._FLASH_PROBE_CACHE == {
+            ("bfloat16", 512, 64, True, False): None}
         # both the in-tree and the jax-bundled kernel were attempted
         assert compiles["n"] == 2
         # second call hits the cache: no further compile attempts
@@ -377,10 +378,29 @@ class TestFlashAttentionGate:
                             lambda *a, **k: True)
         impl = A._flash_attention_impl(jnp.float32, 128, 128, False)
         assert callable(impl)
-        assert A._FLASH_PROBE_CACHE[("float32", 128, 128, False)] is impl
+        assert A._FLASH_PROBE_CACHE[
+            ("float32", 128, 128, False, False)] is impl
         # the chosen impl is the in-tree kernel (probed first)
         from deeplearning4j_tpu.nn.ops.flash_attention import flash_attention
         assert impl.args[0] is flash_attention
+
+    def test_segment_probe_only_tries_in_tree_kernel(self, monkeypatch):
+        """has_seg probes cache under their own key, and the jax-bundled
+        kernel (different segment API) is never a candidate."""
+        import deeplearning4j_tpu.nn.conf.layers.attention as A
+
+        monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+        attempted = []
+        monkeypatch.setattr(
+            A, "_probe_compiles",
+            lambda fn, *a, **k: (attempted.append(fn), False)[1])
+        monkeypatch.setattr(
+            "deeplearning4j_tpu.nn.ops.kernel_compat.probe_with_retry",
+            lambda probe, on_fail: probe())
+        assert A._flash_attention_impl(jnp.float32, 256, 64, True,
+                                       has_seg=True) is None
+        assert ("float32", 256, 64, True, True) in A._FLASH_PROBE_CACHE
+        assert len(attempted) == 1  # in-tree only; bundled skipped
 
     def test_seq_beyond_own_kernel_cap_tries_bundled(self, monkeypatch):
         """T past the in-tree kernel's MAX_SEQ_LEN must skip it (no
