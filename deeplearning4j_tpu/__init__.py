@@ -36,6 +36,10 @@ if _pref != "default" and _jax.config.jax_default_matmul_precision is None:
     _jax.config.update("jax_default_matmul_precision", _pref)
 
 from deeplearning4j_tpu import activations, initializers, losses, schedules, updaters
+from deeplearning4j_tpu.estimator import (
+    NeuralNetClassifier,
+    NeuralNetRegressor,
+)
 
 __all__ = [
     "activations",
@@ -43,5 +47,7 @@ __all__ = [
     "losses",
     "schedules",
     "updaters",
+    "NeuralNetClassifier",
+    "NeuralNetRegressor",
     "__version__",
 ]

@@ -1,0 +1,165 @@
+"""Sklearn-style estimator adapters — the idiomatic analog of the
+reference's ``dl4j-spark-ml`` pipeline wrappers
+(``spark/dl4j-spark-ml/src/main/scala/org/deeplearning4j/spark/ml/impl/
+SparkDl4jNetwork.scala``: an Estimator whose ``fit`` trains the network
+and returns a Model exposing ``transform``/``predict``).
+
+Spark ML is JVM pipeline infrastructure; the Python ecosystem's
+equivalent contract is scikit-learn's estimator API, implemented here by
+duck typing (``fit`` / ``predict`` / ``predict_proba`` / ``score`` /
+``get_params`` / ``set_params`` / ``partial_fit``) — no sklearn import
+required, but the classes drop into sklearn Pipelines, GridSearchCV and
+cross_val_score unchanged because those only use the protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+try:  # optional: inherit sklearn's bases so modern Pipeline/GridSearch
+    # machinery (__sklearn_tags__, clone) recognizes these natively
+    from sklearn.base import BaseEstimator as _SkBase
+    from sklearn.base import ClassifierMixin as _SkClassifier
+    from sklearn.base import RegressorMixin as _SkRegressor
+except ImportError:  # pure duck-typed protocol without sklearn
+    _SkBase = object
+
+    class _SkClassifier:  # type: ignore[no-redef]
+        pass
+
+    class _SkRegressor:  # type: ignore[no-redef]
+        pass
+
+
+class _BaseNetEstimator(_SkBase):
+    def __init__(self, conf: Union[Callable, "object"], epochs: int = 10,
+                 batch_size: int = 32, shuffle: bool = True,
+                 seed: int = 0):
+        self.conf = conf
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.net_ = None
+
+    # -- sklearn protocol --------------------------------------------------
+    def get_params(self, deep: bool = True) -> dict:
+        return {"conf": self.conf, "epochs": self.epochs,
+                "batch_size": self.batch_size, "shuffle": self.shuffle,
+                "seed": self.seed}
+
+    def set_params(self, **params) -> "_BaseNetEstimator":
+        for k, v in params.items():
+            if k not in self.get_params():
+                raise ValueError(
+                    f"Invalid parameter {k!r} for {type(self).__name__}")
+            setattr(self, k, v)
+        return self
+
+    # -- shared machinery --------------------------------------------------
+    def _build(self):
+        from deeplearning4j_tpu.nn.conf.builders import (
+            MultiLayerConfiguration,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = self.conf() if callable(self.conf) else self.conf
+        if not isinstance(conf, MultiLayerConfiguration):
+            raise TypeError(
+                "conf must be a MultiLayerConfiguration or a zero-arg "
+                f"callable returning one, got {type(conf).__name__}")
+        return MultiLayerNetwork(conf).init()
+
+    def _epoch_batches(self, X, Y, rng):
+        n = X.shape[0]
+        order = rng.permutation(n) if self.shuffle else np.arange(n)
+        for s in range(0, n, self.batch_size):
+            idx = order[s:s + self.batch_size]
+            yield X[idx], Y[idx]
+
+    def _fit_loop(self, X, Y, epochs):
+        rng = np.random.default_rng(self.seed)
+        for _ in range(epochs):
+            for xb, yb in self._epoch_batches(X, Y, rng):
+                self.net_.fit(xb, yb)
+        return self
+
+    def _check_fitted(self):
+        if self.net_ is None:
+            raise RuntimeError(
+                f"{type(self).__name__} is not fitted yet; call fit()")
+
+
+class NeuralNetClassifier(_SkClassifier, _BaseNetEstimator):
+    """Classifier over a MultiLayerNetwork configuration.
+
+    ``conf``: a built MultiLayerConfiguration (its output layer width
+    must equal the number of classes) or a zero-arg callable returning
+    one (lets GridSearchCV clones build fresh networks). ``fit``
+    one-hot-encodes integer/string labels and records ``classes_``.
+    """
+
+    def fit(self, X, y) -> "NeuralNetClassifier":
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        self.classes_, y_idx = np.unique(y, return_inverse=True)
+        Y = np.eye(len(self.classes_), dtype=np.float32)[y_idx]
+        self.net_ = self._build()
+        return self._fit_loop(X, Y, self.epochs)
+
+    def partial_fit(self, X, y, classes=None) -> "NeuralNetClassifier":
+        """Incremental fit (one epoch over the given data). ``classes``
+        is required on the first call (sklearn's partial_fit contract)."""
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        if self.net_ is None:
+            if classes is None:
+                raise ValueError(
+                    "classes= is required on the first partial_fit call")
+            self.classes_ = np.asarray(classes)
+            self.net_ = self._build()
+        idx = np.searchsorted(self.classes_, y)
+        Y = np.eye(len(self.classes_), dtype=np.float32)[idx]
+        return self._fit_loop(X, Y, 1)
+
+    def predict_proba(self, X) -> np.ndarray:
+        self._check_fitted()
+        return np.asarray(self.net_.output(np.asarray(X, np.float32)))
+
+    def predict(self, X) -> np.ndarray:
+        proba = self.predict_proba(X)  # checks fitted first
+        return self.classes_[np.argmax(proba, axis=1)]
+
+    def score(self, X, y) -> float:
+        """Mean accuracy (sklearn classifier convention)."""
+        return float(np.mean(self.predict(X) == np.asarray(y)))
+
+
+class NeuralNetRegressor(_SkRegressor, _BaseNetEstimator):
+    """Regressor over a MultiLayerNetwork configuration (identity/linear
+    output layer with an mse-style loss)."""
+
+    def fit(self, X, y) -> "NeuralNetRegressor":
+        X = np.asarray(X, np.float32)
+        Y = np.asarray(y, np.float32)
+        if Y.ndim == 1:
+            Y = Y[:, None]
+        self._y_1d = np.asarray(y).ndim == 1
+        self.net_ = self._build()
+        return self._fit_loop(X, Y, self.epochs)
+
+    def predict(self, X) -> np.ndarray:
+        self._check_fitted()
+        out = np.asarray(self.net_.output(np.asarray(X, np.float32)))
+        return out[:, 0] if self._y_1d else out
+
+    def score(self, X, y) -> float:
+        """R² coefficient of determination (sklearn regressor
+        convention)."""
+        y = np.asarray(y, np.float32)
+        pred = self.predict(X)
+        ss_res = float(np.sum((y - pred) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+        return 1.0 - ss_res / ss_tot if ss_tot else 0.0

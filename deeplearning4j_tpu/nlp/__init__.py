@@ -18,6 +18,9 @@ from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
 )
+from deeplearning4j_tpu.nlp.tokenization_plugins import (
+    PosFilterTokenizerFactory,
+)
 from deeplearning4j_tpu.nlp.sentence_iterator import (
     BasicLineIterator,
     CollectionSentenceIterator,
@@ -52,6 +55,7 @@ from deeplearning4j_tpu.nlp.cnn_sentence import (
 
 __all__ = [
     "CommonPreprocessor", "EndingPreProcessor", "DefaultTokenizerFactory", "NGramTokenizerFactory",
+    "PosFilterTokenizerFactory",
     "BasicLineIterator", "CollectionSentenceIterator", "FileSentenceIterator",
     "StopWords", "AbstractCache", "Huffman", "VocabConstructor", "VocabWord",
     "Word2Vec", "SequenceVectors", "ParagraphVectors", "Glove",

@@ -109,3 +109,128 @@ class KoreanTokenizerFactory(TokenizerFactory):
             else:
                 toks.append(w)
         return Tokenizer(toks, self._preprocessor)
+
+
+# ----------------------------------------------------------------------
+# PoS-filtered tokenization (reference deeplearning4j-nlp-uima
+# PosUimaTokenizer.java:44-100 — the UIMA/ClearTK analysis engine is JVM
+# infrastructure; the CAPABILITY it provides to the NLP pipelines is
+# "keep only tokens whose part-of-speech is in an allowed set", rebuilt
+# here over a lexicon + suffix-heuristic English tagger)
+# ----------------------------------------------------------------------
+
+# closed-class words: the high-frequency function words whose tags a
+# suffix heuristic cannot recover
+_POS_LEXICON = {
+    **{w: "DT" for w in ("the", "a", "an", "this", "that", "these",
+                         "those", "each", "every", "some", "any", "no")},
+    **{w: "IN" for w in ("in", "on", "at", "by", "for", "with", "from",
+                         "to", "of", "about", "into", "over", "under",
+                         "after", "before", "between", "through",
+                         "during", "against", "without")},
+    **{w: "CC" for w in ("and", "or", "but", "nor", "yet", "so")},
+    **{w: "PRP" for w in ("i", "you", "he", "she", "it", "we", "they",
+                          "me", "him", "her", "us", "them")},
+    **{w: "PRP$" for w in ("my", "your", "his", "its", "our", "their")},
+    **{w: "MD" for w in ("can", "could", "will", "would", "shall",
+                         "should", "may", "might", "must")},
+    **{w: "VB" for w in ("be", "do", "have", "go", "get", "make", "take",
+                         "run", "see", "know", "think", "say", "use")},
+    **{w: "VBZ" for w in ("is", "has", "does", "goes", "says")},
+    **{w: "VBP" for w in ("am", "are")},
+    **{w: "VBD" for w in (
+        "was", "were", "did", "had", "went", "said", "made", "took",
+        "saw", "knew", "thought", "ran", "came", "got", "gave", "found",
+        "told", "became", "left", "felt", "put", "brought", "began",
+        "kept", "held", "wrote", "stood", "heard", "meant", "met",
+        "paid", "sat", "spoke", "led", "grew", "lost", "fell", "sent",
+        "built", "drew", "broke", "spent", "ate", "drank", "won",
+        "bought", "caught", "taught", "sold", "chose", "drove", "flew",
+        "threw", "rose", "wore", "spoke", "swam", "sang", "rang")},
+    **{w: "RB" for w in ("not", "very", "never", "always", "often",
+                         "here", "there", "now", "then", "too", "also")},
+    **{w: "WDT" for w in ("which", "what", "whose")},
+    **{w: "WP" for w in ("who", "whom")},
+    **{w: "EX" for w in ("there",)},
+    **{w: "UH" for w in ("oh", "ah", "wow", "hey", "ouch")},
+}
+
+_NUM = re.compile(r"^[+-]?\d+([.,]\d+)*$")
+
+
+def pos_tag(token: str, prev_tag: Optional[str] = None) -> str:
+    """Penn-Treebank-style tag for one token: lexicon first, then
+    number/suffix/capitalization heuristics (NN default). A deliberate
+    lightweight stand-in for the reference's UIMA analysis engine —
+    accurate on closed-class words and morphologically marked forms,
+    NN-biased elsewhere (which is what PoS-FILTERED vocab building
+    wants: nouns/adjectives survive)."""
+    if _NUM.match(token):
+        return "CD"
+    low = token.lower()
+    if low in _POS_LEXICON:
+        return _POS_LEXICON[low]
+    if token[:1].isupper() and low != token:  # capitalized, not ALLCAPS
+        return "NNP"
+    if low.endswith("ly"):
+        return "RB"
+    if low.endswith("ing") and len(low) > 4:
+        return "VBG"
+    if low.endswith("ed") and len(low) > 3:
+        return "VBD"
+    for suf in ("tion", "sion", "ment", "ness", "ity", "ance", "ence",
+                "ship", "hood", "ism", "er", "or", "ist"):
+        if low.endswith(suf) and len(low) > len(suf) + 2:
+            return "NN"
+    for suf in ("ous", "ful", "ive", "able", "ible", "al", "ic", "ish",
+                "less"):
+        if low.endswith(suf) and len(low) > len(suf) + 1:
+            return "JJ"
+    if low.endswith("s") and not low.endswith("ss") and len(low) > 3:
+        return "NNS"
+    return "NN"
+
+
+class PosFilterTokenizer(Tokenizer):
+    """Reference ``PosUimaTokenizer`` token-stream semantics: every
+    token whose tag is OUTSIDE the allowed set becomes the literal
+    string "NONE" (positions are preserved for windowed models), unless
+    ``strip_nones`` — then they are dropped."""
+
+    def __init__(self, tokens: List[str], allowed: Set[str],
+                 strip_nones: bool,
+                 preprocessor: Optional[TokenPreProcess] = None):
+        kept: List[str] = []
+        for t in tokens:
+            tag = pos_tag(t)
+            # an allowed entry matches exactly or as a group prefix
+            # ("NN" admits NNS/NNP; "VB" admits VBD/VBG/...)
+            ok = any(tag == a or tag.startswith(a) for a in allowed)
+            if ok:
+                kept.append(t)
+            elif not strip_nones:
+                kept.append("NONE")
+        super().__init__(kept, preprocessor)
+
+
+class PosFilterTokenizerFactory(TokenizerFactory):
+    """Tokenize then keep only allowed-PoS tokens (reference
+    ``PosUimaTokenizerFactory``). ``base`` supplies the raw split
+    (DefaultTokenizerFactory if omitted)."""
+
+    def __init__(self, allowed_pos_tags: Iterable[str],
+                 base: Optional[TokenizerFactory] = None,
+                 strip_nones: bool = False):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DefaultTokenizerFactory,
+        )
+
+        self.allowed = set(allowed_pos_tags)
+        self.base = base or DefaultTokenizerFactory()
+        self.strip_nones = bool(strip_nones)
+        self._preprocessor: Optional[TokenPreProcess] = None
+
+    def create(self, sentence: str) -> PosFilterTokenizer:
+        toks = self.base.create(sentence).get_tokens()
+        return PosFilterTokenizer(toks, self.allowed, self.strip_nones,
+                                  self._preprocessor)

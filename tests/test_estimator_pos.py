@@ -1,0 +1,167 @@
+"""Sklearn-style estimator adapters (the dl4j-spark-ml analog) and
+PoS-filtered tokenization (the nlp-uima capability analog)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.estimator import (
+    NeuralNetClassifier,
+    NeuralNetRegressor,
+)
+from deeplearning4j_tpu.nlp.tokenization_plugins import (
+    PosFilterTokenizerFactory,
+    pos_tag,
+)
+
+
+def _clf_conf(n_in=4, n_classes=3):
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.updaters import Adam
+
+    return (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _blobs(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.asarray([[2, 0, 0, 0], [0, 2, 0, 0], [0, 0, 2, 0]],
+                         np.float32)
+    y = rng.integers(0, 3, n)
+    X = centers[y] + 0.3 * rng.normal(size=(n, 4)).astype(np.float32)
+    return X, y
+
+
+class TestNeuralNetClassifier:
+    def test_fit_predict_score(self):
+        X, y = _blobs()
+        clf = NeuralNetClassifier(_clf_conf, epochs=20, batch_size=32)
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.9
+        proba = clf.predict_proba(X[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+
+    def test_string_labels(self):
+        X, y = _blobs()
+        names = np.asarray(["cat", "dog", "fish"])[y]
+        clf = NeuralNetClassifier(_clf_conf, epochs=15).fit(X, names)
+        assert set(clf.predict(X[:20])) <= {"cat", "dog", "fish"}
+        assert list(clf.classes_) == ["cat", "dog", "fish"]
+
+    def test_partial_fit_requires_classes_then_learns(self):
+        X, y = _blobs()
+        clf = NeuralNetClassifier(_clf_conf, batch_size=32)
+        with pytest.raises(ValueError, match="classes"):
+            clf.partial_fit(X, y)
+        clf.partial_fit(X, y, classes=[0, 1, 2])
+        for _ in range(15):
+            clf.partial_fit(X, y)
+        assert clf.score(X, y) > 0.9
+
+    def test_get_set_params_protocol(self):
+        clf = NeuralNetClassifier(_clf_conf, epochs=3)
+        p = clf.get_params()
+        assert p["epochs"] == 3
+        clf.set_params(epochs=5, batch_size=8)
+        assert clf.epochs == 5 and clf.batch_size == 8
+        with pytest.raises(ValueError, match="Invalid parameter"):
+            clf.set_params(bogus=1)
+
+    def test_unfitted_predict_raises(self):
+        with pytest.raises(RuntimeError, match="not fitted"):
+            NeuralNetClassifier(_clf_conf).predict(np.zeros((1, 4)))
+
+    def test_sklearn_pipeline_compat_if_available(self):
+        sklearn = pytest.importorskip("sklearn")
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        X, y = _blobs()
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("net", NeuralNetClassifier(_clf_conf, epochs=15)),
+        ])
+        pipe.fit(X, y)
+        assert pipe.score(X, y) > 0.9
+
+
+class TestNeuralNetRegressor:
+    def test_fit_and_r2(self):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.conf.layers import (DenseLayer,
+                                                       OutputLayer)
+        from deeplearning4j_tpu.updaters import Adam
+
+        def conf():
+            return (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Adam(1e-2)).list()
+                    .layer(DenseLayer(n_out=16, activation="tanh"))
+                    .layer(OutputLayer(n_out=1, activation="identity",
+                                       loss="mse"))
+                    .set_input_type(InputType.feed_forward(3)).build())
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(256, 3)).astype(np.float32)
+        y = (X @ np.asarray([1.0, -2.0, 0.5], np.float32)
+             + 0.05 * rng.normal(size=256).astype(np.float32))
+        reg = NeuralNetRegressor(conf, epochs=40, batch_size=64)
+        reg.fit(X, y)
+        assert reg.score(X, y) > 0.95
+        assert reg.predict(X[:7]).shape == (7,)
+
+
+class TestPosFilteredTokenization:
+    def test_tagger_closed_class_and_suffixes(self):
+        assert pos_tag("the") == "DT"
+        assert pos_tag("with") == "IN"
+        assert pos_tag("quickly") == "RB"
+        assert pos_tag("running") == "VBG"
+        assert pos_tag("movement") == "NN"
+        assert pos_tag("beautiful") == "JJ"
+        assert pos_tag("42") == "CD"
+        assert pos_tag("London") == "NNP"
+        assert pos_tag("dogs") == "NNS"
+
+    def test_filter_replaces_disallowed_with_none(self):
+        """reference PosUimaTokenizer: invalid tokens become the literal
+        "NONE" so window positions are preserved."""
+        tf = PosFilterTokenizerFactory(["NN", "JJ"])
+        toks = tf.create("the beautiful movement ran quickly").get_tokens()
+        assert toks == ["NONE", "beautiful", "movement", "NONE", "NONE"]
+
+    def test_strip_nones_drops_them(self):
+        tf = PosFilterTokenizerFactory(["NN"], strip_nones=True)
+        toks = tf.create("the movement of the nation").get_tokens()
+        assert toks == ["movement", "nation"]
+
+    def test_group_prefix_matching(self):
+        """an allowed "VB" admits the whole verb group."""
+        tf = PosFilterTokenizerFactory(["VB"], strip_nones=True)
+        toks = tf.create("she was running and jumped").get_tokens()
+        assert toks == ["was", "running", "jumped"]
+
+    def test_feeds_word2vec_vocab(self):
+        """end-to-end: PoS-filtered factory plugs into the Word2Vec
+        tokenization SPI like any other TokenizerFactory."""
+        from deeplearning4j_tpu.nlp.sentence_iterator import (
+            CollectionSentenceIterator,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sents = ["the movement of the nation grows",
+                 "a nation with great movement"] * 10
+        w2v = (Word2Vec.builder()
+               .iterate(CollectionSentenceIterator(sents))
+               .tokenizer_factory(
+                   PosFilterTokenizerFactory(["NN"], strip_nones=True))
+               .layer_size(16).min_word_frequency(1).epochs(1)
+               .seed(1).build())
+        w2v.fit()
+        assert w2v.has_word("movement") and w2v.has_word("nation")
+        assert not w2v.has_word("the")
