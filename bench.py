@@ -345,6 +345,28 @@ def _bench_lm_decode(batch: int = 8, prompt: int = 128, new: int = 128):
     return batch * new / dt
 
 
+def _bench_dp_sharded_update(devices, batch: int = 16, seq: int = 512,
+                             n_layers: int = 12):
+    """Data-parallel TransformerLM weight-update A/B: replicated update vs
+    the ZeRO-1 sharded update (parallel/zero.py) over all devices. Same
+    math either way — the interesting numbers are tokens/sec and the
+    measured per-replica optimizer-state bytes (sharded mode stores 1/N
+    of the Adam m/v on each replica). Returns
+    {replicated: {...}, zero1: {...}}."""
+    from deeplearning4j_tpu.parallel.zero import measure_dp_update
+
+    out = {}
+    for key, sharded in (("replicated", False), ("zero1", True)):
+        tps, opt_bytes, global_batch = measure_dp_update(
+            batch, seq, sharded=sharded, n_layers=n_layers)
+        out[key] = {
+            "tokens_per_sec": round(tps, 1),
+            "opt_state_bytes_per_replica": opt_bytes,
+            "global_batch": global_batch,
+        }
+    return out
+
+
 def _bench_allreduce(devices, mb: float = 256.0):
     """Time an all-reduce (psum) of an fp32 buffer sharded over all
     devices; returns (algo_bandwidth_GB_per_s, n_devices). Algorithmic
@@ -353,7 +375,7 @@ def _bench_allreduce(devices, mb: float = 256.0):
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from deeplearning4j_tpu.parallel.mesh import shard_map
 
     n = len(devices)
     n_elem = int(mb * 1e6 / 4)
@@ -499,6 +521,19 @@ def main():
                 # dense fallback at T=2048 can exhaust HBM — record why
                 extra["transformer_lm_long_ctx_error"] = (
                     f"{type(e).__name__}: {str(e)[:300]}")
+    # DP weight-update A/B (ZeRO-1 sharded vs replicated): needs >=2
+    # devices to be non-degenerate; skippable like the other extras
+    if (os.environ.get("BENCH_SKIP_DP_SHARDED", "0") != "1"
+            and len(devices) > 1):
+        try:
+            ab = _bench_dp_sharded_update(devices)
+            extra["dp_sharded_update"] = ab
+            extra["dp_sharded_update_config"] = (
+                f"d768 L12 h12 T512 b{ab['zero1']['global_batch']} "
+                f"bf16 dp{len(devices)}")
+        except Exception as e:
+            extra["dp_sharded_update_error"] = (
+                f"{type(e).__name__}: {str(e)[:300]}")
     try:
         gbps, n = _bench_allreduce(devices)
         extra["allreduce_algbw_gbps"] = gbps

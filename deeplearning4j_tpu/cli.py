@@ -102,6 +102,10 @@ def main(argv=None) -> int:
     ap.add_argument("--remat-policy", default=None,
                     choices=["save_conv_outputs", "dots", "nothing"],
                     help="backward rematerialization (memory knob)")
+    ap.add_argument("--sharded-update", action="store_true",
+                    help="ZeRO-1 weight update for --workers>1: updater "
+                         "state and update compute sharded 1/N over the "
+                         "data axis (numerics unchanged)")
     args = ap.parse_args(argv)
 
     it, num_classes = build_dataset(args.dataset, args.batch_size,
@@ -124,7 +128,10 @@ def main(argv=None) -> int:
     if args.workers > 1:
         from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
-        pw = ParallelWrapper.builder(model).workers(args.workers).build()
+        pw_b = ParallelWrapper.builder(model).workers(args.workers)
+        if args.sharded_update:
+            pw_b.sharded_update(True)
+        pw = pw_b.build()
         pw.fit(it, epochs=args.epochs)
     else:
         model.fit(it, epochs=args.epochs)

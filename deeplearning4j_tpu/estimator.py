@@ -118,9 +118,18 @@ class NeuralNetClassifier(_SkClassifier, _BaseNetEstimator):
             if classes is None:
                 raise ValueError(
                     "classes= is required on the first partial_fit call")
-            self.classes_ = np.asarray(classes)
+            # sorted-unique normalization: searchsorted (below) assumes a
+            # sorted classes_ array, so an unsorted classes= would
+            # silently map labels to the wrong one-hot columns
+            self.classes_ = np.unique(np.asarray(classes))
             self.net_ = self._build()
         idx = np.searchsorted(self.classes_, y)
+        known = (idx < len(self.classes_))
+        known &= self.classes_[np.minimum(idx, len(self.classes_) - 1)] == y
+        if not np.all(known):
+            raise ValueError(
+                f"y contains labels not in classes=: "
+                f"{np.unique(y[~known]).tolist()}")
         Y = np.eye(len(self.classes_), dtype=np.float32)[idx]
         return self._fit_loop(X, Y, 1)
 

@@ -195,13 +195,17 @@ class Evaluation:
             p = self.precision(cls)
             r = self.recall(cls)
             return 2 * p * r / (p + r) if (p + r) else 0.0
+        n = self._m().shape[0]
+        if n == 2:
+            # reference special case: binary problems return the F1 of
+            # class 1 REGARDLESS of averaging (Evaluation.fBeta checks
+            # binaryPositiveClass before dispatching on the averaging
+            # mode), so f1(averaging='micro') matches fBeta too
+            return self.f1(1)
         if averaging == "micro":
             p = self.precision(averaging="micro")
             r = self.recall(averaging="micro")
             return 2 * p * r / (p + r) if (p + r) else 0.0
-        n = self._m().shape[0]
-        if n == 2:  # reference special case: binary F1 of class 1
-            return self.f1(1)
         tp = self.true_positives()
         fp = self.false_positives()
         fn = self.false_negatives()

@@ -252,12 +252,19 @@ def _dense_like(cls_name: str):
             kw.pop("n_in", None), kw.pop("n_out", None)
         layer = getattr(L, cls_name)(**kw)
 
+        has_bias = bool(node.get("hasBias", True))
+
         def slicer(flat, pos, params, state):
             n_in, n_out = int(node["nIn"]), int(node["nOut"])
             w, pos = _take(flat, pos, n_in * n_out)
-            b, pos = _take(flat, pos, n_out)
             params["W"] = w.reshape((n_in, n_out), order="F")
-            params["b"] = b
+            if has_bias:
+                b, pos = _take(flat, pos, n_out)
+                params["b"] = b
+            else:
+                # hasBias=false zips store no bias values — consuming
+                # them would mis-slice every subsequent parameter
+                params["b"] = np.zeros((n_out,), flat.dtype)
             return pos
 
         return layer, (None if cls_name == "LossLayer" else slicer)

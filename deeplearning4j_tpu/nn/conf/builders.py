@@ -280,6 +280,16 @@ class NeuralNetConfiguration:
         self._g.compute_dtype = dt
         return self
 
+    def sharded_update(self, b: bool = True) -> "NeuralNetConfiguration":
+        """ZeRO-1 cross-replica sharded weight update for the data-parallel
+        runtimes (parallel/zero.py): gradients reduce-scatter over the
+        "data" axis, each replica applies the updater to its 1/N flat
+        parameter shard, updated shards all-gather back. Updater state
+        (Adam m/v, ...) is stored sharded — 1/N per replica — while the
+        math stays numerically identical to the replicated update."""
+        self._g.sharded_update = bool(b)
+        return self
+
     def remat_policy(self, policy: Optional[str]) -> "NeuralNetConfiguration":
         """Backward-pass rematerialization: "save_conv_outputs" stores only
         conv outputs for backward and recomputes BN/activation epilogues

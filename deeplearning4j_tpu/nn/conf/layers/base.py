@@ -165,6 +165,7 @@ class GlobalConf:
         max_num_line_search_iterations: int = 5,
         optimization_algo: str = "stochastic_gradient_descent",
         remat_policy: Optional[str] = None,
+        sharded_update: bool = False,
     ):
         from deeplearning4j_tpu.updaters import Sgd
 
@@ -189,6 +190,12 @@ class GlobalConf:
         # recompute from them — less HBM traffic on bandwidth-bound
         # steps); "nothing" / "dots" map to the stock jax policies.
         self.remat_policy = remat_policy
+        # ZeRO-1 cross-replica sharded weight update (arXiv 2004.13336):
+        # data-parallel runtimes (ParallelWrapper, the multi-host masters)
+        # reduce-scatter gradients, update 1/N parameter shards per
+        # replica and all-gather — updater state scales as 1/N per
+        # replica, numerics unchanged. See parallel/zero.py.
+        self.sharded_update = bool(sharded_update)
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

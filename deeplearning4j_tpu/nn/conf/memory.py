@@ -38,12 +38,23 @@ class LayerMemoryReport:
         self.updater_slots = int(updater_slots)
         self.activation_elems_per_example = int(activation_elems_per_example)
 
+    def updater_state_bytes(self, bytes_per_elem: int = 4,
+                            data_parallel_shards: int = 1) -> int:
+        """Per-replica updater-slot memory. With the ZeRO-1 sharded
+        update (``sharded_update``) each replica holds only 1/N of every
+        slot (ceil — the flat vector is padded to a multiple of N)."""
+        total = self.n_params * self.updater_slots * bytes_per_elem
+        n = max(int(data_parallel_shards), 1)
+        return -(-total // n)
+
     def total_memory_bytes(self, batch_size: int, bytes_per_elem: int = 4,
-                           training: bool = True) -> int:
+                           training: bool = True,
+                           data_parallel_shards: int = 1) -> int:
         fixed = self.n_params * bytes_per_elem
         if training:
             fixed += self.n_params * bytes_per_elem  # gradients
-            fixed += self.n_params * self.updater_slots * bytes_per_elem
+            fixed += self.updater_state_bytes(bytes_per_elem,
+                                              data_parallel_shards)
         var = self.activation_elems_per_example * batch_size * bytes_per_elem
         if training:
             var *= 2  # activations retained for backprop + grad wrt input
@@ -65,22 +76,46 @@ class NetworkMemoryReport:
         return sum(r.n_params for r in self.layer_reports)
 
     def total_memory_bytes(self, batch_size: int, training: bool = True,
-                           dtype: Optional[str] = None) -> int:
+                           dtype: Optional[str] = None,
+                           data_parallel_shards: int = 1) -> int:
+        """Per-replica bytes. ``data_parallel_shards`` > 1 models the
+        ZeRO-1 sharded update (``sharded_update``): updater state counts
+        as 1/N per replica; params, gradients and activations are
+        unchanged (they stay replicated / batch-sharded)."""
         b = _DTYPE_BYTES[dtype or self.dtype]
         return sum(
-            r.total_memory_bytes(batch_size, b, training) for r in self.layer_reports
+            r.total_memory_bytes(batch_size, b, training,
+                                 data_parallel_shards)
+            for r in self.layer_reports
         )
 
-    def to_string(self, batch_size: int = 32) -> str:
+    def updater_state_bytes(self, dtype: Optional[str] = None,
+                            data_parallel_shards: int = 1) -> int:
+        """Per-replica updater-slot memory (the quantity the ZeRO-1
+        sharded update divides by N)."""
+        b = _DTYPE_BYTES[dtype or self.dtype]
+        return sum(r.updater_state_bytes(b, data_parallel_shards)
+                   for r in self.layer_reports)
+
+    def to_string(self, batch_size: int = 32,
+                  data_parallel_shards: int = 1) -> str:
         lines = [
             f"NetworkMemoryReport: {self.model_class} ({self.model_name})",
             f"  dtype={self.dtype}  total params={self.total_params:,}",
             f"  est. training memory @ batch {batch_size}: "
-            f"{self.total_memory_bytes(batch_size, True) / 2**20:.1f} MiB",
+            f"{self.total_memory_bytes(batch_size, True, data_parallel_shards=data_parallel_shards) / 2**20:.1f} MiB",
             f"  est. inference memory @ batch {batch_size}: "
             f"{self.total_memory_bytes(batch_size, False) / 2**20:.1f} MiB",
-            "  per-layer:",
         ]
+        if data_parallel_shards > 1:
+            full = self.updater_state_bytes()
+            shard = self.updater_state_bytes(
+                data_parallel_shards=data_parallel_shards)
+            lines.append(
+                f"  sharded_update over {data_parallel_shards} replicas: "
+                f"updater state {full / 2**20:.1f} → {shard / 2**20:.1f} "
+                f"MiB/replica (saves {(full - shard) / 2**20:.1f} MiB)")
+        lines.append("  per-layer:")
         for r in self.layer_reports:
             lines.append(
                 f"    {r.layer_name:24s} {r.layer_type:28s} params={r.n_params:>12,} "

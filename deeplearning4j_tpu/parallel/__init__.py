@@ -13,6 +13,12 @@ from deeplearning4j_tpu.parallel.ring_attention import make_ring_attention
 from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
 from deeplearning4j_tpu.parallel.shared_training import SharedTrainingMaster
 from deeplearning4j_tpu.parallel.moe import ExpertParallelWrapper
+from deeplearning4j_tpu.parallel.zero import (
+    ShardedUpdateLayout,
+    apply_sharded_updates,
+    make_sharded_train_step,
+    zero1_extend_spec,
+)
 from deeplearning4j_tpu.parallel.multihost import (
     MultiHostContext,
     MultiHostNetwork,
@@ -29,5 +35,6 @@ __all__ = [
     "MultiHostContext", "MultiHostNetwork", "MultiHostDl4jMultiLayer",
     "MultiHostComputationGraph", "ParameterAveragingTrainingMaster",
     "ShardedDataSetIterator", "TrainingMaster", "SharedTrainingMaster",
-    "ExpertParallelWrapper",
+    "ExpertParallelWrapper", "ShardedUpdateLayout", "apply_sharded_updates",
+    "make_sharded_train_step", "zero1_extend_spec",
 ]

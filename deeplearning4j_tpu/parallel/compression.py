@@ -181,6 +181,8 @@ def make_compressed_allreduce(mesh, axis: str = "data",
     """
     from jax.sharding import PartitionSpec as P
 
+    from deeplearning4j_tpu.parallel.mesh import shard_map
+
     def body(grad, residual, threshold):
         # local shards arrive as (1, size)
         work = (residual + grad)[0]
@@ -190,7 +192,7 @@ def make_compressed_allreduce(mesh, axis: str = "data",
         return summed, new_residual[None, :]
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh.mesh,
             in_specs=(P(axis), P(axis), P()),
             out_specs=(P(), P(axis)),

@@ -26,6 +26,47 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    """``jax.shard_map`` across jax versions (portable-collectives
+    mandate, arXiv 2112.01075): the top-level API with its
+    check_vma/axis_names spelling landed after 0.4.x, where only
+    ``jax.experimental.shard_map`` (check_rep/auto spelling) exists.
+    ``axis_names`` is the set of MANUAL axes; the rest of the mesh stays
+    automatic."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if axis_names is None else {"axis_names": set(axis_names)}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma, **kw)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+
+    kw = {}
+    if axis_names is not None:
+        kw["auto"] = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                     check_rep=check_vma, **kw)
+
+
+def zero1_donation(*argnums) -> tuple:
+    """Buffer donation for a jit step whose ZeRO-1 weight update
+    reshards params/opt-state (repl → data-sharded → repl).
+
+    On real accelerators every device owns its memory, so donating the
+    params/opt-state inputs is the standard training-loop memory
+    optimization and stays on. The CPU backend emulates the mesh with
+    virtual devices sharing one host heap; there, donation lets the
+    all-gather of the updated shards write into a buffer other virtual
+    devices are still reading, silently corrupting results (observed
+    nondeterministically on the 8-device test mesh as garbage updater
+    slots). Replicated-update steps don't carry that aliasing pattern
+    and keep donation unconditionally; ZeRO-1 steps donate through this
+    helper: everywhere except CPU."""
+    if jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)
+
+
 class TrainingMesh:
     def __init__(
         self,

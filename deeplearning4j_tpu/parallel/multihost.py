@@ -226,6 +226,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             self._agg_depth = 2
             self._prefetch = 2
             self._collect_stats = False
+            self._sharded = False
+
+        def sharded_update(self, b: bool):
+            """ZeRO-1 weight update (parallel/zero.py): each replica
+            updates only its 1/N shard of the flat parameter vector and
+            keeps 1/N of the updater state; numerically identical to the
+            replicated update."""
+            self._sharded = bool(b)
+            return self
 
         def batch_size_per_worker(self, n: int):
             self._batch = int(n)
@@ -250,16 +259,17 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         def build(self) -> "ParameterAveragingTrainingMaster":
             return ParameterAveragingTrainingMaster(
                 self._batch, self._avg_freq, self._agg_depth,
-                self._collect_stats,
+                self._collect_stats, sharded_update=self._sharded,
             )
 
     def __init__(self, batch_size_per_worker: int = 16,
                  averaging_frequency: int = 1, aggregation_depth: int = 2,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False, sharded_update: bool = False):
         self.batch_size_per_worker = batch_size_per_worker
         self.averaging_frequency = averaging_frequency
         self.aggregation_depth = aggregation_depth
         self.collect_stats = collect_stats
+        self.sharded_update = bool(sharded_update)
         self.stats: list = []
 
     def execute_training(self, facade: "MultiHostNetwork", it: DataSetIterator,
@@ -288,6 +298,8 @@ class MultiHostNetwork:
         n = len(jax.devices())
         self.mesh = TrainingMesh(data=n, devices=jax.devices())
         self._step = None
+        self._zstep = None
+        self._zlayout = None
         self._is_graph = hasattr(model.conf, "network_inputs")
 
     # -- data plumbing ------------------------------------------------------
@@ -337,34 +349,81 @@ class MultiHostNetwork:
 
     def _fit_sharded(self, it: DataSetIterator, epochs: int = 1, stats=None):
         m = self.model
-        step = self._step or self._build_step()
-        for _ in range(epochs):
-            for lst in m.listeners:
-                if hasattr(lst, "on_epoch_start"):
-                    lst.on_epoch_start(m)
-            for ds in it:
-                t0 = time.perf_counter() if stats is not None else 0.0
-                m.params_, m.opt_state_, m.state_, m.score_ = step(
-                    m.params_, m.opt_state_, m.state_,
-                    *self._pack_batch(ds),
-                    m._next_rng(),
-                    jnp.asarray(m.iteration, jnp.int32),
-                    jnp.asarray(m.epoch, jnp.int32),
-                )
-                m.iteration += 1
-                if stats is not None:
-                    jax.block_until_ready(m.score_)
-                    stats.append({
-                        "iteration": m.iteration,
-                        "step_seconds": time.perf_counter() - t0,
-                    })
+        zopt = None
+        if getattr(self.master, "sharded_update", False) or getattr(
+                m.conf.global_conf, "sharded_update", False):
+            from deeplearning4j_tpu.parallel.zero import (
+                make_sharded_train_step,
+                shard_model_opt_state,
+                unshard_model_opt_state,
+            )
+
+            if self._zstep is None:
+                self._zstep, self._zlayout = make_sharded_train_step(
+                    m, self.mesh)
+            step = self._zstep
+            zopt = shard_model_opt_state(m, self._zlayout,
+                                         mesh=self.mesh.mesh)
+            # mid-fit serializers gather the live sharded slots through
+            # this hook (m.opt_state_ is stale until the finally below)
+            zlayout = self._zlayout
+            zref = [zopt]
+            m._opt_state_sync = (
+                lambda: unshard_model_opt_state(m, zlayout, zref[0]))
+        else:
+            step = self._step or self._build_step()
+        zopt_valid = True
+        try:
+            for _ in range(epochs):
                 for lst in m.listeners:
-                    lst.iteration_done(m, m.iteration, m.epoch)
-            it.reset()
-            m.epoch += 1
-            for lst in m.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(m)
+                    if hasattr(lst, "on_epoch_start"):
+                        lst.on_epoch_start(m)
+                for ds in it:
+                    t0 = time.perf_counter() if stats is not None else 0.0
+                    opt_in = zopt if zopt is not None else m.opt_state_
+                    batch = self._pack_batch(ds)
+                    rng = m._next_rng()
+                    # once the step is dispatched it consumes the donated
+                    # zopt; if it raises, those buffers are gone and must
+                    # not be gathered (batch packing above raising leaves
+                    # zopt intact)
+                    zopt_valid = zopt is None
+                    m.params_, new_o, m.state_, m.score_ = step(
+                        m.params_, opt_in, m.state_,
+                        *batch, rng,
+                        jnp.asarray(m.iteration, jnp.int32),
+                        jnp.asarray(m.epoch, jnp.int32),
+                    )
+                    if zopt is not None:
+                        zopt = new_o
+                        zref[0] = new_o
+                    zopt_valid = True
+                    if zopt is None:
+                        m.opt_state_ = new_o
+                    m.iteration += 1
+                    if stats is not None:
+                        jax.block_until_ready(m.score_)
+                        stats.append({
+                            "iteration": m.iteration,
+                            "step_seconds": time.perf_counter() - t0,
+                        })
+                    for lst in m.listeners:
+                        lst.iteration_done(m, m.iteration, m.epoch)
+                it.reset()
+                m.epoch += 1
+                for lst in m.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(m)
+        finally:
+            if zopt is not None:
+                m._opt_state_sync = None
+                if zopt_valid:
+                    # canonical per-layer opt state restored for the
+                    # checkpoint-restart story (save_checkpoint zips it)
+                    unshard_model_opt_state(m, self._zlayout, zopt)
+                # else: the step failed after consuming its donated zopt
+                # buffers — keep the last canonical opt state rather than
+                # masking the real error with a deleted-array gather
 
     # -- evaluation / scoring ----------------------------------------------
     def score(self) -> float:
@@ -437,6 +496,8 @@ class MultiHostNetwork:
         m.iteration = restored.iteration
         m.epoch = restored.epoch
         self._step = None  # donated-buffer jit must not reuse old avals
+        self._zstep = None
+        self._zlayout = None
 
 
 # Reference-parity aliases (the reference has one facade per model type;

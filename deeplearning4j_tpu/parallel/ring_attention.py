@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from deeplearning4j_tpu.parallel.mesh import shard_map
+
 
 def _block(carry_o, carry_m, carry_l, q, k, v, scores_mask):
     """One online-softmax accumulation step (flash-attention style).
@@ -113,7 +115,7 @@ def make_ring_attention(mesh, *, axis_name: str = "seq"):
                     q_, k_, v_, axis_name=axis_name, causal=causal, mask=None
                 )
 
-            return jax.shard_map(
+            return shard_map(
                 run, mesh=m, in_specs=(qkv_spec,) * 3, out_specs=qkv_spec,
                 check_vma=False,
             )(q, k, v)
@@ -123,7 +125,7 @@ def make_ring_attention(mesh, *, axis_name: str = "seq"):
                 q_, k_, v_, axis_name=axis_name, causal=causal, mask=mask_
             )
 
-        return jax.shard_map(
+        return shard_map(
             run, mesh=m, in_specs=(qkv_spec,) * 3 + (mask_spec,),
             out_specs=qkv_spec, check_vma=False,
         )(q, k, v, mask)

@@ -44,7 +44,7 @@ from deeplearning4j_tpu.parallel.compression import (
     gather_and_decode,
     threshold_encode,
 )
-from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh, shard_map
 
 
 class SharedTrainingMaster:
@@ -56,6 +56,7 @@ class SharedTrainingMaster:
             self._threshold = float(threshold)
             self._capacity = 16384
             self._mesh: Optional[TrainingMesh] = None
+            self._sharded = False
 
         def threshold(self, t: float):
             self._threshold = float(t)
@@ -69,22 +70,33 @@ class SharedTrainingMaster:
             self._mesh = m
             return self
 
+        def sharded_update(self, b: bool):
+            """ZeRO-1 weight update on the decoded synchronized gradient
+            (parallel/zero.py): 1/N updater state + update compute per
+            replica; the threshold-encoding wire format is unchanged."""
+            self._sharded = bool(b)
+            return self
+
         def build(self) -> "SharedTrainingMaster":
             return SharedTrainingMaster(self._threshold, self._capacity,
-                                        self._mesh)
+                                        self._mesh,
+                                        sharded_update=self._sharded)
 
     @staticmethod
     def builder(threshold: float = 1e-3) -> "Builder":
         return SharedTrainingMaster.Builder(threshold)
 
     def __init__(self, threshold: float = 1e-3, capacity: int = 16384,
-                 mesh: Optional[TrainingMesh] = None):
+                 mesh: Optional[TrainingMesh] = None,
+                 sharded_update: bool = False):
         self.threshold = threshold
         self.capacity = capacity
         self.mesh = mesh if mesh is not None else TrainingMesh(
             data=len(jax.devices())
         )
+        self.sharded_update = bool(sharded_update)
         self._step = None
+        self._layout = None
         self._residual = None
         self._n_params = None
         self._model_id = None  # step/unravel/residual are per-model
@@ -121,9 +133,16 @@ class SharedTrainingMaster:
             mean_loss = jax.lax.pmean(loss, "data")
             return mean_loss, summed, new_residual[None, :]
 
+        if self.sharded_update or getattr(
+                model.conf.global_conf, "sharded_update", False):
+            from deeplearning4j_tpu.parallel.zero import ShardedUpdateLayout
+
+            self._layout = ShardedUpdateLayout(layers, model.params_,
+                                               mesh.n_data)
+
         def step(params, opt_state, state, f, l, fm, lm, residual, rng,
                  iteration, epoch, threshold):
-            mean_loss, summed, new_residual = jax.shard_map(
+            mean_loss, summed, new_residual = shard_map(
                 sharded_part, mesh=mesh.mesh,
                 in_specs=(P(), P(), P("data"), P("data"), P("data"),
                           P("data"), P("data"), P(), P()),
@@ -132,12 +151,26 @@ class SharedTrainingMaster:
             )(params, state, f, l, fm, lm, residual, rng, threshold)
             grads_sync = unravel(summed)
             t = iteration + 1
-            new_params, new_opt = _apply_layer_updates(
-                layers, params, grads_sync, opt_state, t, iteration, epoch
-            )
+            if self._layout is not None:
+                from deeplearning4j_tpu.parallel.zero import (
+                    apply_sharded_updates,
+                )
+
+                new_params, new_opt = apply_sharded_updates(
+                    self._layout, params, grads_sync, opt_state, t,
+                    iteration, epoch, mesh=mesh.mesh)
+            else:
+                new_params, new_opt = _apply_layer_updates(
+                    layers, params, grads_sync, opt_state, t, iteration,
+                    epoch
+                )
             return new_params, new_opt, mean_loss, new_residual
 
-        return jax.jit(step, donate_argnums=(0, 1, 7))
+        from deeplearning4j_tpu.parallel.mesh import zero1_donation
+
+        return jax.jit(step, donate_argnums=(
+            zero1_donation(0, 1, 7) if self._layout is not None
+            else (0, 1, 7)))
 
     # ------------------------------------------------------------------- fit
     def _to_global(self, a, batch_like: bool = True):
@@ -179,40 +212,82 @@ class SharedTrainingMaster:
                 "(cached step/residual); build a new master per model"
             )
         step = self._step
+        zopt = None
+        if self._layout is not None:
+            from deeplearning4j_tpu.parallel.zero import (
+                shard_model_opt_state,
+                unshard_model_opt_state,
+            )
+
+            zopt = shard_model_opt_state(model, self._layout,
+                                         mesh=self.mesh.mesh)
+            # mid-fit serializers gather the live sharded slots through
+            # this hook (model.opt_state_ is stale until the finally)
+            layout = self._layout
+            zref = [zopt]
+            model._opt_state_sync = (
+                lambda: unshard_model_opt_state(model, layout, zref[0]))
         # local batch must split over this host's SHARE of the data axis
         n_local = max(self.mesh.n_data // jax.process_count(), 1)
-        for _ in range(epochs):
-            for lst in model.listeners:
-                if hasattr(lst, "on_epoch_start"):
-                    lst.on_epoch_start(model)
-            for ds in it:
-                if ds.features.shape[0] % n_local:
-                    raise ValueError(
-                        f"local batch {ds.features.shape[0]} not divisible "
-                        f"by local data-axis share {n_local}"
-                    )
-                with self.mesh.mesh:
-                    (model.params_, model.opt_state_, model.score_,
-                     self._residual) = step(
-                        model.params_, model.opt_state_, model.state_,
+        zopt_valid = True
+        try:
+            for _ in range(epochs):
+                for lst in model.listeners:
+                    if hasattr(lst, "on_epoch_start"):
+                        lst.on_epoch_start(model)
+                for ds in it:
+                    if ds.features.shape[0] % n_local:
+                        raise ValueError(
+                            f"local batch {ds.features.shape[0]} not "
+                            f"divisible by local data-axis share {n_local}"
+                        )
+                    opt_in = zopt if zopt is not None else model.opt_state_
+                    batch = (
                         self._to_global(ds.features, True),
                         self._to_global(ds.labels, True),
                         self._to_global(ds.features_mask, True),
                         self._to_global(ds.labels_mask, True),
-                        self._residual,
-                        model._next_rng(),
-                        jnp.asarray(model.iteration, jnp.int32),
-                        jnp.asarray(model.epoch, jnp.int32),
-                        jnp.asarray(self.threshold, jnp.float32),
                     )
-                model.iteration += 1
+                    rng = model._next_rng()
+                    # once the step is dispatched it consumes the donated
+                    # zopt; if it raises, those buffers are gone and must
+                    # not be gathered (batch staging above raising leaves
+                    # zopt intact)
+                    zopt_valid = zopt is None
+                    with self.mesh.mesh:
+                        (model.params_, new_o, model.score_,
+                         self._residual) = step(
+                            model.params_, opt_in, model.state_,
+                            *batch,
+                            self._residual,
+                            rng,
+                            jnp.asarray(model.iteration, jnp.int32),
+                            jnp.asarray(model.epoch, jnp.int32),
+                            jnp.asarray(self.threshold, jnp.float32),
+                        )
+                    if zopt is not None:
+                        zopt = new_o
+                        zref[0] = new_o
+                    zopt_valid = True
+                    if zopt is None:
+                        model.opt_state_ = new_o
+                    model.iteration += 1
+                    for lst in model.listeners:
+                        lst.iteration_done(model, model.iteration,
+                                           model.epoch)
+                it.reset()
+                model.epoch += 1
                 for lst in model.listeners:
-                    lst.iteration_done(model, model.iteration, model.epoch)
-            it.reset()
-            model.epoch += 1
-            for lst in model.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(model)
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(model)
+        finally:
+            if zopt is not None:
+                model._opt_state_sync = None
+                if zopt_valid:
+                    unshard_model_opt_state(model, self._layout, zopt)
+                # else: the step failed after consuming its donated zopt
+                # buffers — keep the last canonical opt state rather than
+                # masking the real error with a deleted-array gather
         return model
 
     def residual_magnitude(self) -> float:

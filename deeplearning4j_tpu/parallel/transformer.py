@@ -39,7 +39,7 @@ from deeplearning4j_tpu.models.transformer_lm import (
     block_apply,
 )
 from deeplearning4j_tpu.nn.conf.layers.attention import _layer_norm
-from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh, shard_map
 from deeplearning4j_tpu.parallel.ring_attention import ring_attention_sharded
 
 Array = jax.Array
@@ -85,10 +85,20 @@ class DistributedLMTrainer:
     def __init__(self, model: TransformerLM, mesh: TrainingMesh,
                  n_micro: Optional[int] = None,
                  clip_norm: Optional[float] = None,
-                 remat_blocks: bool = False):
+                 remat_blocks: bool = False,
+                 sharded_update: bool = False):
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
+        # ZeRO-1 over the "data" axis (arXiv 2004.13336): updater state
+        # and the weight-update compute are sharded over data-parallel
+        # replicas — per-leaf here (a flat vector would destroy the
+        # TP/PP/EP param shardings), see parallel/zero.zero1_extend_spec.
+        # Gradients feed the updater data-sharded, so GSPMD lowers the
+        # gradient sync as reduce-scatter + all-gather of the updated
+        # params instead of a plain all-reduce. Elementwise updater math
+        # makes this numerically identical to the replicated update.
+        self.sharded_update = bool(sharded_update)
         # remat_blocks bounds activation memory on ANY mesh shape:
         # backward recomputes each transformer block's interior from its
         # boundary activation instead of storing it (under the pipeline
@@ -190,7 +200,7 @@ class DistributedLMTrainer:
 
                 def blocks_fn(bp, x):
                     specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
-                    return jax.shard_map(
+                    return shard_map(
                         sp_body, mesh=mesh.mesh, axis_names={"seq"},
                         in_specs=(specs_b, P(None, "seq", None)),
                         out_specs=(P(None, "seq", None), P()),
@@ -201,7 +211,7 @@ class DistributedLMTrainer:
 
             def blocks_fn(bp, x):
                 specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
-                return jax.shard_map(
+                return shard_map(
                     stack_scan, mesh=mesh.mesh, axis_names={"seq"},
                     in_specs=(specs_b, P(None, "seq", None)),
                     out_specs=P(None, "seq", None), check_vma=False,
@@ -289,7 +299,7 @@ class DistributedLMTrainer:
 
         def blocks_fn(bp, x):
             specs_b = jax.tree_util.tree_map(bspec_leaf, bp)
-            return jax.shard_map(
+            return shard_map(
                 pipeline, mesh=mesh.mesh, axis_names=manual,
                 in_specs=(specs_b, x_spec), out_specs=out_spec,
                 check_vma=False,
@@ -329,6 +339,30 @@ class DistributedLMTrainer:
         return loss
 
     # ---------------------------------------------------------------- step
+    def _zero_shardings(self):
+        """Per-param-leaf NamedSharding for the ZeRO-1 opt-state/update
+        layout: the param's own spec extended with "data" on the first
+        free divisible dimension (falls back to the param sharding where
+        no dimension qualifies). Leaf order matches tree_flatten(params).
+        Cached: place() and build_step() must use the SAME tree — a
+        divergence would reshard every step or break donation aliasing."""
+        if getattr(self, "_z_sh", None) is not None:
+            return self._z_sh
+        from deeplearning4j_tpu.parallel.zero import zero1_extend_spec
+
+        pspecs = param_pspecs(self.cfg)
+        m = self.mesh.mesh
+        n_data = self.mesh.shape["data"]
+        flat_s, treedef = jax.tree_util.tree_flatten(
+            pspecs, is_leaf=lambda x: isinstance(x, P))
+        flat_p = treedef.flatten_up_to(self.model.params_)
+        out = []
+        for spec, arr in zip(flat_s, flat_p):
+            ext = zero1_extend_spec(spec, arr.shape, n_data)
+            out.append(NamedSharding(m, ext if ext is not None else spec))
+        self._z_sh = jax.tree_util.tree_unflatten(treedef, out)
+        return self._z_sh
+
     def build_step(self):
         if self._step is not None:
             return self._step
@@ -338,6 +372,16 @@ class DistributedLMTrainer:
         loss_fn = self._loss_fn()
 
         clip_norm = self.clip_norm
+
+        pspecs = param_pspecs(cfg)
+        m = mesh.mesh
+        sh = lambda spec: NamedSharding(m, spec)
+        p_sh = jax.tree_util.tree_map(sh, pspecs,
+                                      is_leaf=lambda x: isinstance(x, P))
+        z_sh = self._zero_shardings() if self.sharded_update else None
+        flat_psh = jax.tree_util.tree_leaves(p_sh)
+        flat_zsh = (jax.tree_util.tree_leaves(z_sh)
+                    if z_sh is not None else None)
 
         def step(params, opt_state, ids, targets, t):
             loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
@@ -351,26 +395,36 @@ class DistributedLMTrainer:
             flat_g = treedef.flatten_up_to(grads)
             flat_o = treedef.flatten_up_to(opt_state)
             new_p, new_o = [], []
-            for p, g, o in zip(flat_p, flat_g, flat_o):
+            for i, (p, g, o) in enumerate(zip(flat_p, flat_g, flat_o)):
+                if flat_zsh is not None:
+                    # consume the synced gradient data-sharded: the
+                    # updater math runs on 1/N of each leaf, and the
+                    # updated leaf all-gathers back to its param sharding
+                    g = jax.lax.with_sharding_constraint(g, flat_zsh[i])
                 delta, o2 = upd.apply(g, o, t, t, 0)
-                new_p.append(p - delta)
+                p2 = p - delta
+                if flat_zsh is not None:
+                    p2 = jax.lax.with_sharding_constraint(p2, flat_psh[i])
+                new_p.append(p2)
                 new_o.append(o2)
             return (jax.tree_util.tree_unflatten(treedef, new_p),
                     jax.tree_util.tree_unflatten(treedef, new_o), loss)
 
-        pspecs = param_pspecs(cfg)
-        m = mesh.mesh
-        sh = lambda spec: NamedSharding(m, spec)
-        p_sh = jax.tree_util.tree_map(sh, pspecs,
-                                      is_leaf=lambda x: isinstance(x, P))
         data_spec = sh(P("data", "seq")) if mesh.shape["seq"] > 1 else sh(P("data"))
-        # opt-state sharding (None) is inferred from param sharding by
-        # propagation — slot dicts mirror their param's layout
+        # opt-state sharding: the param shardings as a prefix tree (slot
+        # dicts mirror their param's layout; explicit, not inferred — a
+        # propagation choice that differs from place() would break the
+        # donated-buffer aliasing), or the explicit ZeRO-1 data-extended
+        # shardings in sharded_update mode
+        from deeplearning4j_tpu.parallel.mesh import zero1_donation
+
+        o_sh = z_sh if self.sharded_update else p_sh
         self._step = jax.jit(
             step,
-            in_shardings=(p_sh, None, data_spec, data_spec, None),
-            out_shardings=(p_sh, None, None),
-            donate_argnums=(0, 1),
+            in_shardings=(p_sh, o_sh, data_spec, data_spec, None),
+            out_shardings=(p_sh, o_sh, None),
+            donate_argnums=(zero1_donation(0, 1) if self.sharded_update
+                            else (0, 1)),
         )
         return self._step
 
@@ -392,7 +446,19 @@ class DistributedLMTrainer:
             return jax.tree_util.tree_unflatten(treedef, out)
 
         self.model.params_ = put(self.model.params_, pspecs)
-        self.model.opt_state_ = put(self.model.opt_state_, pspecs)
+        if self.sharded_update:
+            # opt state lives in the ZeRO-1 layout: each slot sharded over
+            # "data" on top of the param's TP/PP/EP placement (1/N of the
+            # Adam m/v per replica)
+            z_sh = self._zero_shardings()
+            flat_s, treedef = jax.tree_util.tree_flatten(z_sh)
+            flat_t = treedef.flatten_up_to(self.model.opt_state_)
+            out = [jax.tree_util.tree_map(
+                lambda a, s=s: jax.device_put(a, s), sub)
+                for sub, s in zip(flat_t, flat_s)]
+            self.model.opt_state_ = jax.tree_util.tree_unflatten(treedef, out)
+        else:
+            self.model.opt_state_ = put(self.model.opt_state_, pspecs)
         return self
 
     def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:

@@ -13,6 +13,15 @@ there is no staleness to amortize, and ICI bandwidth makes the collective
 ~free relative to the step (the reference's averaging frequency exists
 because its host-staged average is expensive). The builder still accepts
 averaging_frequency for API compatibility; it is a no-op, documented.
+
+``sharded_update(True)`` (or the NeuralNetConfiguration knob) switches the
+weight update to the ZeRO-1 path (parallel/zero.py): gradients are
+consumed reduce-scattered over the data axis, each replica applies the
+updater to its 1/N flat shard, and updated shards all-gather back —
+numerically identical to the replicated update, with updater-state memory
+and update compute cut to 1/N per replica. The canonical per-layer
+``model.opt_state_`` is re-sharded when fit() starts and gathered back
+when it returns, so checkpoints keep the standard format.
 """
 
 from __future__ import annotations
@@ -36,9 +45,17 @@ class ParallelWrapper:
             self._prefetch = 4
             self._avg_freq = 1
             self._report = False
+            self._sharded: Optional[bool] = None
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = int(n)
+            return self
+
+        def sharded_update(self, b: bool) -> "ParallelWrapper.Builder":
+            """ZeRO-1 weight update: reduce-scatter gradients, update 1/N
+            parameter shards per replica, all-gather (parallel/zero.py).
+            Defaults to the configuration's ``sharded_update`` knob."""
+            self._sharded = bool(b)
             return self
 
         def prefetch_buffer(self, n: int) -> "ParallelWrapper.Builder":
@@ -65,14 +82,16 @@ class ParallelWrapper:
             return self
 
         def build(self) -> "ParallelWrapper":
-            return ParallelWrapper(self.model, self._workers, self._prefetch)
+            return ParallelWrapper(self.model, self._workers, self._prefetch,
+                                   sharded_update=self._sharded)
 
     @staticmethod
     def builder(model) -> "Builder":
         return ParallelWrapper.Builder(model)
 
     def __init__(self, model, workers: Optional[int] = None, prefetch: int = 4,
-                 mesh: Optional[TrainingMesh] = None):
+                 mesh: Optional[TrainingMesh] = None,
+                 sharded_update: Optional[bool] = None):
         self.model = model
         n_dev = len(jax.devices())
         workers = workers or n_dev
@@ -82,6 +101,12 @@ class ParallelWrapper:
         self.mesh = mesh
         self.prefetch = prefetch
         self._step = None
+        if sharded_update is None:
+            sharded_update = bool(getattr(
+                model.conf.global_conf, "sharded_update", False))
+        self.sharded_update = bool(sharded_update)
+        self._zstep = None
+        self._zlayout = None
         # ComputationGraph train steps take per-input tuples; MLN takes arrays
         self._is_graph = hasattr(model.conf, "network_inputs")
 
@@ -122,38 +147,93 @@ class ParallelWrapper:
                 "ParallelWrapper tBPTT is supported for MultiLayerNetwork; "
                 "fit the ComputationGraph directly"
             )
-        step = self._step or self._build_step()
+        zopt = None
+        if self.sharded_update:
+            if use_tbptt:
+                raise NotImplementedError(
+                    "sharded_update does not support tBPTT configs; use "
+                    "the standard replicated update for tBPTT training"
+                )
+            from deeplearning4j_tpu.parallel.zero import (
+                make_sharded_train_step,
+                shard_model_opt_state,
+                unshard_model_opt_state,
+            )
+
+            if self._zstep is None:
+                self._zstep, self._zlayout = make_sharded_train_step(
+                    m, self.mesh)
+            step = self._zstep
+            zopt = shard_model_opt_state(m, self._zlayout,
+                                         mesh=self.mesh.mesh)
+            # mid-fit serializers (CheckpointListener, user code in a
+            # listener) read m.opt_state_, which is stale while the live
+            # state is the sharded zopt — they call this hook first to
+            # gather on demand (ModelSerializer/Orbax do)
+            zlayout = self._zlayout
+            zref = [zopt]
+            m._opt_state_sync = (
+                lambda: unshard_model_opt_state(m, zlayout, zref[0]))
+        else:
+            step = self._step or self._build_step()
         n_data = self.mesh.n_data
-        for _ in range(epochs):
-            for lst in m.listeners:
-                if hasattr(lst, "on_epoch_start"):
-                    lst.on_epoch_start(m)
-            async_ok = getattr(it, "async_supported", lambda: False)()
-            wrapped = AsyncDataSetIterator(it, self.prefetch) if async_ok else it
-            try:
-                with self.mesh.mesh:
-                    for ds in wrapped:
-                        if use_tbptt and ds.features.ndim == 3:
-                            self._fit_tbptt_sharded(ds, n_data)
-                            continue
-                        m.params_, m.opt_state_, m.state_, m.score_ = step(
-                            m.params_, m.opt_state_, m.state_,
-                            *self._pack_batch(ds, n_data),
-                            m._next_rng(),
-                            jnp.asarray(m.iteration, jnp.int32),
-                            jnp.asarray(m.epoch, jnp.int32),
-                        )
-                        m.iteration += 1
-                        for lst in m.listeners:
-                            lst.iteration_done(m, m.iteration, m.epoch)
-            finally:
-                if wrapped is not it:
-                    wrapped.shutdown()
-            it.reset()
-            m.epoch += 1
-            for lst in m.listeners:
-                if hasattr(lst, "on_epoch_end"):
-                    lst.on_epoch_end(m)
+        zopt_valid = True
+        try:
+            for _ in range(epochs):
+                for lst in m.listeners:
+                    if hasattr(lst, "on_epoch_start"):
+                        lst.on_epoch_start(m)
+                async_ok = getattr(it, "async_supported", lambda: False)()
+                wrapped = (AsyncDataSetIterator(it, self.prefetch)
+                           if async_ok else it)
+                try:
+                    with self.mesh.mesh:
+                        for ds in wrapped:
+                            if use_tbptt and ds.features.ndim == 3:
+                                self._fit_tbptt_sharded(ds, n_data)
+                                continue
+                            opt_in = zopt if zopt is not None else m.opt_state_
+                            batch = self._pack_batch(ds, n_data)
+                            rng = m._next_rng()
+                            # once the step is dispatched it consumes the
+                            # donated zopt; if it raises, those buffers
+                            # are gone and must not be gathered (batch
+                            # packing above raising leaves zopt intact)
+                            zopt_valid = zopt is None
+                            new_p, new_o, m.state_, m.score_ = step(
+                                m.params_, opt_in, m.state_,
+                                *batch, rng,
+                                jnp.asarray(m.iteration, jnp.int32),
+                                jnp.asarray(m.epoch, jnp.int32),
+                            )
+                            m.params_ = new_p
+                            if zopt is not None:
+                                zopt = new_o
+                                zref[0] = new_o
+                            zopt_valid = True
+                            if zopt is None:
+                                m.opt_state_ = new_o
+                            m.iteration += 1
+                            for lst in m.listeners:
+                                lst.iteration_done(m, m.iteration, m.epoch)
+                finally:
+                    if wrapped is not it:
+                        wrapped.shutdown()
+                it.reset()
+                m.epoch += 1
+                for lst in m.listeners:
+                    if hasattr(lst, "on_epoch_end"):
+                        lst.on_epoch_end(m)
+        finally:
+            if zopt is not None:
+                m._opt_state_sync = None
+                if zopt_valid:
+                    # gather the sharded slots back into the canonical
+                    # per-layer opt state (checkpoint format contract)
+                    unshard_model_opt_state(m, self._zlayout, zopt)
+                # else: the step failed after consuming its donated zopt
+                # buffers — keep the last canonical opt state rather than
+                # masking the real error with a deleted-array gather
 
     def _fit_tbptt_sharded(self, ds: DataSet, n_data: int):
         """tBPTT chunks under the mesh: batch and carries sharded over the

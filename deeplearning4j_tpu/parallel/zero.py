@@ -1,0 +1,422 @@
+"""Cross-replica sharded weight update (ZeRO-1).
+
+Implements the transform of "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv 2004.13336) for every
+data-parallel path in the tree: instead of every replica applying the
+full optimizer update to a fully replicated parameter set (N identical
+copies of the Adam/Nadam/... slots and of the update compute), the
+synchronized gradient is consumed SHARDED over the "data" axis, each
+replica updates only its 1/N shard, and the fresh shards are all-gathered
+back into the replicated parameters. Reduce-scatter + all-gather moves
+the same bytes as the all-reduce it replaces (arXiv 2112.01075's
+portability argument), while updater-state memory and weight-update
+compute drop by 1/N per replica.
+
+Mechanism — one flat-vector shard/unshard core:
+
+- Trainable parameters are grouped by (updater config, dtype) — updater
+  math is elementwise, so a single ``Updater.apply`` call can serve every
+  parameter in a group once they are flattened into one vector.
+- Each group's vector is zero-padded to a multiple of N and viewed as an
+  (N, chunk) matrix whose leading dim is sharded over the mesh "data"
+  axis (``with_sharding_constraint``). GSPMD then materializes the
+  gradient for that matrix via reduce-scatter instead of all-reduce and
+  inserts the all-gather when the updated matrix is constrained back to
+  replicated — the paper's pass, driven from sharding annotations alone.
+- Updater state lives PERSISTENTLY in the (N, chunk) sharded layout
+  (1/N per replica); ``shard_opt_state``/``unshard_opt_state`` convert
+  exactly (reshape + slice, zero padding dropped) to and from the
+  canonical per-layer slot dicts, so checkpoints keep the standard
+  gathered format: gather on save, re-shard on load, bit-identical
+  resume.
+
+Exactness: gradient normalization and l1/l2/weight-decay terms are
+applied per layer BEFORE flattening and constraints AFTER unflattening —
+the same order as ``_apply_layer_updates`` — and the updater math itself
+is elementwise, so sharded training is numerically the unsharded DP run.
+
+The TransformerLM trainer uses a per-leaf variant of the same idea
+(``zero1_extend_spec``) because its params already carry TP/PP/EP
+shardings that a flat vector would destroy.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.conf.layers.special import FrozenLayer
+from deeplearning4j_tpu.parallel.mesh import zero1_donation
+from deeplearning4j_tpu.nn.multilayer import _resolve_remat_policy
+from deeplearning4j_tpu.regularization import normalize_layer_gradients
+from deeplearning4j_tpu.updaters import NoOp, Updater
+
+Array = jax.Array
+
+
+class _Entry:
+    """One parameter's slice of a group's flat vector."""
+
+    __slots__ = ("layer", "name", "shape", "size", "offset")
+
+    def __init__(self, layer: int, name: str, shape, size: int, offset: int):
+        self.layer = layer
+        self.name = name
+        self.shape = tuple(shape)
+        self.size = int(size)
+        self.offset = int(offset)
+
+
+class _Group:
+    """Parameters sharing one updater config + dtype → one flat vector."""
+
+    def __init__(self, updater: Updater, dtype):
+        self.updater = updater
+        self.dtype = dtype
+        self.entries: List[_Entry] = []
+        self.total = 0  # unpadded element count
+
+    def finalize(self, n_shards: int) -> None:
+        self.padded = -(-self.total // n_shards) * n_shards
+        self.chunk = self.padded // n_shards
+
+
+def _updater_key(upd: Updater) -> str:
+    return json.dumps(upd.to_dict(), sort_keys=True, default=repr)
+
+
+class ShardedUpdateLayout:
+    """Flat shard layout for one network's trainable parameters.
+
+    ``layers`` is the layer list (MultiLayerNetwork order, or the
+    ComputationGraph's topological layer order) and ``params`` the
+    matching list of name→array dicts. Frozen and parameter-less layers
+    are skipped exactly as in ``_apply_layer_updates``.
+    """
+
+    def __init__(self, layers: Sequence, params: Sequence[Dict[str, Array]],
+                 n_shards: int):
+        self.layers = list(layers)
+        self.n_shards = int(n_shards)
+        self.skip: List[bool] = []
+        self.groups: List[_Group] = []
+        by_key: Dict[Tuple[str, Any], _Group] = {}
+        for i, (layer, p_i) in enumerate(zip(self.layers, params)):
+            skip = isinstance(layer, FrozenLayer) or not p_i
+            self.skip.append(skip)
+            if skip:
+                continue
+            upd = layer.updater if layer.updater is not None else NoOp()
+            for name in sorted(p_i):
+                arr = p_i[name]
+                key = (_updater_key(upd), jnp.asarray(arr).dtype)
+                grp = by_key.get(key)
+                if grp is None:
+                    grp = _Group(upd, key[1])
+                    by_key[key] = grp
+                    self.groups.append(grp)
+                size = int(np.prod(arr.shape)) if arr.shape else 1
+                grp.entries.append(_Entry(i, name, arr.shape, size, grp.total))
+                grp.total += size
+        for grp in self.groups:
+            grp.finalize(self.n_shards)
+
+    # -- flat <-> per-layer ------------------------------------------------
+    def _flatten_group(self, grp: _Group, trees: Sequence[Dict[str, Array]]
+                       ) -> Array:
+        chunks = [jnp.reshape(trees[e.layer][e.name], (-1,))
+                  for e in grp.entries]
+        flat = jnp.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+        if grp.padded != grp.total:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((grp.padded - grp.total,), flat.dtype)])
+        return flat.reshape(self.n_shards, grp.chunk)
+
+    def _scatter_group(self, grp: _Group, flat2d: Array,
+                       out: List[Dict[str, Array]]) -> None:
+        flat = flat2d.reshape(-1)
+        for e in grp.entries:
+            out[e.layer][e.name] = flat[e.offset:e.offset + e.size].reshape(
+                e.shape)
+
+    # -- opt-state conversion (exact; used at fit/checkpoint boundaries) ---
+    def shard_opt_state(self, opt_state: Sequence[Dict[str, Dict[str, Array]]],
+                        mesh=None, axis: str = "data"
+                        ) -> List[Dict[str, Array]]:
+        """Canonical per-layer slot dicts → per-group (N, chunk) slot
+        dicts, device_put sharded over ``axis`` when a mesh is given."""
+        zopt: List[Dict[str, Array]] = []
+        for grp in self.groups:
+            first = opt_state[grp.entries[0].layer][grp.entries[0].name]
+            slots: Dict[str, Array] = {}
+            for slot in sorted(first):
+                # np.array (owned copy), never np.asarray: a zero-copy
+                # view of a jax CPU buffer dangles once the fit loop
+                # donates that buffer to the train step
+                flat = [np.array(
+                    opt_state[e.layer][e.name][slot]).reshape(-1)
+                    for e in grp.entries]
+                vec = np.concatenate(flat) if len(flat) > 1 else flat[0]
+                if grp.padded != grp.total:
+                    vec = np.concatenate(
+                        [vec, np.zeros((grp.padded - grp.total,), vec.dtype)])
+                mat = vec.reshape(self.n_shards, grp.chunk)
+                if mesh is not None:
+                    slots[slot] = jax.device_put(
+                        mat, NamedSharding(mesh, P(axis, None)))
+                else:
+                    slots[slot] = jnp.asarray(mat)
+            zopt.append(slots)
+        return zopt
+
+    def unshard_opt_state(self, zopt: Sequence[Dict[str, Array]],
+                          template: Sequence[Dict[str, Dict[str, Array]]]
+                          ) -> List[Dict[str, Any]]:
+        """Inverse of shard_opt_state; ``template`` supplies the layout
+        for skipped layers (their state passes through untouched)."""
+        out: List[Dict[str, Any]] = [dict(t) for t in template]
+        for grp, slots in zip(self.groups, zopt):
+            for slot, mat in slots.items():
+                # owned copy (see shard_opt_state): the canonical state
+                # must never alias the live sharded buffers, which the
+                # train step donates on the next iteration
+                flat = np.array(mat).reshape(-1)
+                for e in grp.entries:
+                    cur = dict(out[e.layer].get(e.name, {}))
+                    cur[slot] = jnp.asarray(
+                        flat[e.offset:e.offset + e.size].reshape(e.shape))
+                    out[e.layer][e.name] = cur
+        return out
+
+    def n_padding(self) -> int:
+        """Total zero-padding elements (diagnostics/tests)."""
+        return sum(g.padded - g.total for g in self.groups)
+
+
+def apply_sharded_updates(layout: ShardedUpdateLayout,
+                          params: Sequence[Dict[str, Array]],
+                          grads: Sequence[Dict[str, Array]],
+                          zopt: Sequence[Dict[str, Array]],
+                          t, iteration, epoch,
+                          mesh=None, axis: str = "data"
+                          ) -> Tuple[List[Dict[str, Array]],
+                                     List[Dict[str, Array]]]:
+    """The sharded analog of ``_apply_layer_updates``: per-layer gradient
+    normalization → l1/l2/weight-decay → flat sharded updater → per-layer
+    constraints. Traced inside the train step."""
+    layers = layout.layers
+    adjusted: List[Optional[Dict[str, Array]]] = []
+    for i, layer in enumerate(layers):
+        if layout.skip[i]:
+            adjusted.append(None)
+            continue
+        g_i = normalize_layer_gradients(
+            grads[i], layer.gradient_normalization,
+            layer.gradient_normalization_threshold)
+        reg = layer.regularization
+        if reg is not None:
+            out = {}
+            for k, g in g_i.items():
+                term = reg.grad_term(k, params[i][k])
+                out[k] = g if term is None else g + term
+            g_i = out
+        adjusted.append(g_i)
+
+    new_params: List[Dict[str, Array]] = [dict(p) for p in params]
+    new_zopt: List[Dict[str, Array]] = []
+    shard = None if mesh is None else NamedSharding(mesh, P(axis, None))
+    repl = None if mesh is None else NamedSharding(mesh, P())
+    for grp, state in zip(layout.groups, zopt):
+        g2d = layout._flatten_group(grp, adjusted)
+        p2d = layout._flatten_group(grp, params)
+        if shard is not None:
+            g2d = jax.lax.with_sharding_constraint(g2d, shard)
+            p2d = jax.lax.with_sharding_constraint(p2d, shard)
+        delta, new_state = grp.updater.apply(g2d, state, t, iteration, epoch)
+        np2d = p2d - delta
+        if repl is not None:
+            np2d = jax.lax.with_sharding_constraint(np2d, repl)
+        layout._scatter_group(grp, np2d, new_params)
+        new_zopt.append(new_state)
+
+    for i, layer in enumerate(layers):
+        if layout.skip[i]:
+            continue
+        for c in layer.constraints:
+            for name in new_params[i]:
+                if name in c.applies_to:
+                    new_params[i][name] = c.apply(new_params[i][name])
+    return new_params, new_zopt
+
+
+# --------------------------------------------------------------------------
+# model-level helpers (MultiLayerNetwork and ComputationGraph)
+# --------------------------------------------------------------------------
+def _model_layer_view(model) -> Tuple[Optional[List[str]], List, List]:
+    """(names, layers, params) in a stable order for either model type."""
+    if hasattr(model.conf, "network_inputs"):  # ComputationGraph
+        names = list(model.layer_names)
+        return names, [model._layer(n) for n in names], \
+            [model.params_[n] for n in names]
+    return None, model.layers, model.params_
+
+
+def build_layout(model, n_shards: int) -> ShardedUpdateLayout:
+    if model.params_ is None:
+        raise ValueError("model must be init()ed before sharded training")
+    _, layers, params = _model_layer_view(model)
+    return ShardedUpdateLayout(layers, params, n_shards)
+
+
+def shard_model_opt_state(model, layout: ShardedUpdateLayout, mesh=None,
+                          axis: str = "data") -> List[Dict[str, Array]]:
+    names, _, _ = _model_layer_view(model)
+    opt = (model.opt_state_ if names is None
+           else [model.opt_state_[n] for n in names])
+    return layout.shard_opt_state(opt, mesh=mesh, axis=axis)
+
+
+def unshard_model_opt_state(model, layout: ShardedUpdateLayout,
+                            zopt: Sequence[Dict[str, Array]]) -> None:
+    """Write the gathered canonical opt state back onto the model."""
+    names, _, _ = _model_layer_view(model)
+    template = (model.opt_state_ if names is None
+                else [model.opt_state_[n] for n in names])
+    merged = layout.unshard_opt_state(zopt, template)
+    model.opt_state_ = (merged if names is None
+                        else dict(zip(names, merged)))
+
+
+def make_sharded_train_step(model, mesh):
+    """Jitted ZeRO-1 DP train step over ``mesh`` (a TrainingMesh).
+
+    Same signature as the replicated step the wrapper/multihost facade
+    jit today, except the opt-state argument/result is the SHARDED
+    per-group layout (in/out shardings P("data", None)). Returns
+    (step, layout).
+    """
+    names, layers, params = _model_layer_view(model)
+    layout = ShardedUpdateLayout(layers, params, mesh.n_data)
+    remat_policy = _resolve_remat_policy(
+        getattr(model.conf.global_conf, "remat_policy", None))
+
+    def step(params, zopt, state, features, labels, fmask, lmask, rng,
+             iteration, epoch):
+        def loss_fn(p):
+            loss, new_states = model._loss_and_new_state(
+                p, state, features, labels, fmask, lmask, rng, train=True)
+            return loss, new_states
+
+        if remat_policy is not None:
+            loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        t = iteration + 1
+        if names is not None:
+            p_list = [params[n] for n in names]
+            g_list = [grads[n] for n in names]
+        else:
+            p_list, g_list = params, grads
+        np_list, new_zopt = apply_sharded_updates(
+            layout, p_list, g_list, zopt, t, iteration, epoch,
+            mesh=mesh.mesh)
+        new_params = (dict(zip(names, np_list)) if names is not None
+                      else np_list)
+        score = loss + model._reg_score(params)
+        return new_params, new_zopt, new_states, score
+
+    repl = mesh.replicated()
+    batch = mesh.batch_sharded()
+    zshard = NamedSharding(mesh.mesh, P("data", None))
+    jitted = jax.jit(
+        step,
+        in_shardings=(repl, zshard, repl, batch, batch, batch, batch,
+                      repl, repl, repl),
+        out_shardings=(repl, zshard, repl, repl),
+        donate_argnums=zero1_donation(0, 1, 2),
+    )
+    return jitted, layout
+
+
+def measure_dp_update(batch: int, seq: int, *, sharded: bool,
+                      vocab: int = 32000, d_model: int = 768,
+                      n_heads: int = 12, n_layers: int = 12,
+                      iters: int = 10, seed: int = 0
+                      ) -> Tuple[float, int, int]:
+    """Data-parallel TransformerLM weight-update benchmark over all
+    devices, replicated (``sharded=False``) vs ZeRO-1: trains ``iters``
+    timed steps (after one warmup) and measures the per-replica
+    optimizer-state bytes from the live arrays' addressable shards on
+    device 0 (replicated leaf → full copy, ZeRO-1 leaf → its 1/N
+    slice). Shared harness for bench.py and scripts/lm_perf_sweep.py.
+    Returns (tokens_per_sec, opt_state_bytes_per_replica, global_batch)
+    — ``batch`` is rounded up to a multiple of the device count."""
+    import time
+
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+    from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+    from deeplearning4j_tpu.parallel.transformer import DistributedLMTrainer
+
+    devices = jax.devices()
+    batch = -(-batch // len(devices)) * len(devices)
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          max_length=seq, compute_dtype="bfloat16").init()
+    tr = DistributedLMTrainer(model, TrainingMesh(data=len(devices)),
+                              sharded_update=sharded).place()
+    step = tr.build_step()
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (batch, seq)).astype(np.int32)
+    tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+    tgt[:, -1] = -1
+    ids_d, tgt_d = jnp.asarray(ids), jnp.asarray(tgt)
+
+    def run_one(i):
+        model.params_, model.opt_state_, model.score_ = step(
+            model.params_, model.opt_state_, ids_d, tgt_d,
+            jnp.asarray(i + 1, jnp.int32))
+
+    run_one(0)
+    float(model.score_)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        run_one(i + 1)
+    float(model.score_)
+    dt = time.perf_counter() - t0
+    dev0 = devices[0]
+    opt_bytes = sum(
+        s.data.nbytes
+        for leaf in jax.tree_util.tree_leaves(model.opt_state_)
+        for s in leaf.addressable_shards if s.device == dev0)
+    return batch * seq * iters / dt, int(opt_bytes), batch
+
+
+# --------------------------------------------------------------------------
+# per-leaf variant (TransformerLM: params already TP/PP/EP-sharded)
+# --------------------------------------------------------------------------
+def zero1_extend_spec(spec: P, shape, n_shards: int,
+                      axis: str = "data") -> Optional[P]:
+    """Extend a param's PartitionSpec with ``axis`` on the first free
+    dimension divisible by ``n_shards`` — the opt-state / update-compute
+    sharding of the per-leaf ZeRO-1 path. Returns None when no dimension
+    qualifies (that leaf's update stays replicated)."""
+    if n_shards <= 1:
+        return None
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        if e is None:
+            continue
+        used.update(e if isinstance(e, (list, tuple)) else (e,))
+    if axis in used:
+        return None
+    for d, e in enumerate(entries):
+        if e is None and shape[d] and shape[d] % n_shards == 0:
+            entries[d] = axis
+            return P(*entries)
+    return None

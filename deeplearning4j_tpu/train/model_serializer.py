@@ -34,6 +34,12 @@ class ModelSerializer:
     def write_model(model, path: str, save_updater: bool = True, normalizer=None) -> None:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
+        # during a ZeRO-1 sharded fit the live opt state is sharded and
+        # model.opt_state_ is stale; the runtime installs this hook to
+        # gather on demand (parallel/zero.py)
+        sync = getattr(model, "_opt_state_sync", None)
+        if sync is not None:
+            sync()
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
             z.writestr(CONFIG_ENTRY, model.conf.to_json())
             z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())

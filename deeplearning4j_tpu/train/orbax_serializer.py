@@ -46,6 +46,12 @@ class OrbaxModelSerializer:
         ``overwrite=True`` replaces an existing checkpoint atomically
         enough for single-host use (rmtree then rewrite)."""
         directory = os.path.abspath(directory)
+        # during a ZeRO-1 sharded fit the live opt state is sharded and
+        # model.opt_state_ is stale; the runtime installs this hook to
+        # gather on demand (parallel/zero.py)
+        sync = getattr(model, "_opt_state_sync", None)
+        if sync is not None:
+            sync()
         multi = jax.process_count() > 1
         # every process validates the PRE-EXISTING directory state BEFORE
         # anyone writes (the barrier below keeps writers from racing a

@@ -69,6 +69,19 @@ def measure(batch, seq, flash: bool, fused_qkv: bool = False,
     return tps, mfu
 
 
+def measure_dp(batch, seq, sharded: bool, iters=10):
+    """Data-parallel (all devices) train throughput with the replicated vs
+    ZeRO-1 sharded weight update; also reports the per-replica
+    optimizer-state bytes so the memory saving is measurable next to the
+    tokens/sec A/B."""
+    from deeplearning4j_tpu.parallel.zero import measure_dp_update
+
+    tps, opt_bytes, _ = measure_dp_update(
+        batch, seq, sharded=sharded, vocab=V, d_model=D, n_heads=HEADS,
+        n_layers=LAYERS, iters=iters)
+    return tps, opt_bytes
+
+
 def main():
     global D, V, HEADS, LAYERS
     quick = "--quick" in sys.argv
@@ -105,8 +118,31 @@ def main():
                        "error": f"{type(e).__name__}: {str(e)[:200]}"}
             results.append(rec)
             print(json.dumps(rec), flush=True)
+    # DP weight-update A/B: replicated vs ZeRO-1 sharded update over all
+    # devices — same math, 1/N optimizer state per replica; record both
+    # tokens/sec and the measured per-replica opt-state bytes
+    import jax as _jax
+
+    dp_grid = grid[:1] if (quick or "--cpu-smoke" in sys.argv) else grid[:2]
+    if len(_jax.devices()) > 1:
+        n_dev = len(_jax.devices())
+        for seq, batch in dp_grid:
+            batch = -(-batch // n_dev) * n_dev  # measure_dp's rounding
+            for sharded in (False, True):
+                label = (f"T{seq} b{batch} dp{n_dev} "
+                         + ("zero1" if sharded else "replicated"))
+                try:
+                    tps, opt_bytes = measure_dp(batch, seq, sharded)
+                    rec = {"config": label,
+                           "tokens_per_sec": round(tps, 1),
+                           "opt_state_bytes_per_replica": int(opt_bytes)}
+                except Exception as e:
+                    rec = {"config": label,
+                           "error": f"{type(e).__name__}: {str(e)[:200]}"}
+                results.append(rec)
+                print(json.dumps(rec), flush=True)
     best = max((r for r in results if "tokens_per_sec" in r),
-               key=lambda r: r["mfu_pct"], default=None)
+               key=lambda r: r.get("mfu_pct", 0.0), default=None)
     print(json.dumps({"summary": "lm_perf_sweep", "best": best,
                       "n_configs": len(results)}))
 

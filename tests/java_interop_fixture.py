@@ -87,6 +87,29 @@ def mlp_zip_bytes(seed=1234) -> bytes:
     return _zip_bytes(conf, flat)
 
 
+def mlp_nobias_zip_bytes(seed=1234) -> bytes:
+    """Same MLP but the dense layer has ``hasBias: false`` — its
+    coefficients.bin holds only W, so a loader that unconditionally
+    consumes a bias mis-slices every parameter after it."""
+    p = mlp_params(seed)
+    conf = json.loads(
+        zipfile.ZipFile(io.BytesIO(mlp_zip_bytes(seed))).read(
+            "configuration.json"))
+    conf["confs"][0]["layer"]["dense"]["hasBias"] = False
+    flat = np.concatenate([
+        p["w0"].reshape(-1, order="F"),
+        p["w1"].reshape(-1, order="F"), p["b1"],
+    ])
+    return _zip_bytes(conf, flat)
+
+
+def mlp_nobias_forward_numpy(p, x):
+    h = np.maximum(x @ p["w0"], 0.0)
+    z = h @ p["w1"] + p["b1"]
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
 def mlp_forward_numpy(p, x):
     h = np.maximum(x @ p["w0"] + p["b0"], 0.0)
     z = h @ p["w1"] + p["b1"]

@@ -1,0 +1,392 @@
+"""ZeRO-1 cross-replica sharded weight update (parallel/zero.py).
+
+Parity model: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arXiv 2004.13336) is a pure optimization — the
+sharded update must be NUMERICALLY the replicated update (elementwise
+updater math on 1/N flat shards, reduce-scatter + all-gather moving the
+same bytes as the all-reduce it replaces). Every test here trains the
+same net twice, sharded vs replicated, on the virtual 8-device CPU mesh
+(conftest.py) and asserts allclose — including through a checkpoint
+save→load→resume and with bf16 compute + fp32 masters.
+
+Also carries the satellite regressions riding the same PR: binary
+micro-F1, estimator partial_fit label normalization, and hasBias=false
+dense slicing in the dl4j zip loader.
+"""
+
+import numpy as np
+import pytest
+
+import java_interop_fixture as fx
+from deeplearning4j_tpu.data import DataSet, ExistingDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel import ParallelWrapper
+from deeplearning4j_tpu.updaters import Adam
+
+N_IN, N_HID, N_OUT = 5, 7, 3
+
+
+def _net(seed=3, mixed_precision=False, updater=None):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(
+        updater if updater is not None else Adam(0.01))
+    if mixed_precision:
+        b = b.compute_dtype("bfloat16")
+    conf = (
+        b.list()
+        .layer(DenseLayer(n_out=N_HID, activation="tanh"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _blobs(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, N_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, n)]
+    return DataSet(x, y)
+
+
+def _assert_trees_close(a, b, atol=1e-6):
+    for i, (pa, pb) in enumerate(zip(a, b)):
+        for k in pa:
+            np.testing.assert_allclose(
+                np.asarray(pa[k]), np.asarray(pb[k]), atol=atol,
+                err_msg=f"layer {i} param {k}")
+
+
+def _fit_pair(mixed_precision=False, workers=4, epochs=3):
+    """The same net trained replicated vs ZeRO-1 sharded; returns both."""
+    ds = _blobs()
+    ref, zer = (_net(mixed_precision=mixed_precision) for _ in range(2))
+    ParallelWrapper.builder(ref).workers(workers).build().fit(
+        ExistingDataSetIterator([ds]), epochs=epochs)
+    pw = ParallelWrapper.builder(zer).workers(workers).sharded_update(
+        True).build()
+    pw.fit(ExistingDataSetIterator([ds]), epochs=epochs)
+    return ref, zer, pw
+
+
+class TestWrapperParity:
+    def test_adam_fp32_parity(self):
+        ref, zer, _ = _fit_pair()
+        _assert_trees_close(ref.params_, zer.params_)
+        # gathered-back opt state is the canonical per-layer format and
+        # matches the replicated run's slots (checkpoint contract)
+        for i in range(len(ref.opt_state_)):
+            for k, slots in ref.opt_state_[i].items():
+                for s in slots:
+                    np.testing.assert_allclose(
+                        np.asarray(slots[s]),
+                        np.asarray(zer.opt_state_[i][k][s]), atol=1e-6,
+                        err_msg=f"opt layer {i} {k}/{s}")
+
+    def test_mixed_precision_parity(self):
+        """bf16 compute, fp32 masters + fp32 updater math — the sharded
+        update runs on the fp32 masters, so parity stays exact."""
+        ref, zer, _ = _fit_pair(mixed_precision=True)
+        _assert_trees_close(ref.params_, zer.params_)
+        assert all(np.asarray(v).dtype == np.float32
+                   for p in zer.params_ for v in p.values())
+
+    def test_odd_param_count_pads(self):
+        """Total trainable count (5*7+7 + 7*3+3 = 66) is not divisible
+        by 4 shards — the flat vector zero-pads and parity still holds."""
+        ref, zer, pw = _fit_pair(workers=4)
+        assert pw._zlayout is not None
+        assert pw._zlayout.n_padding() > 0
+        _assert_trees_close(ref.params_, zer.params_)
+
+    def test_config_knob_enables_sharding(self):
+        """NeuralNetConfiguration.sharded_update(True) flows through the
+        builder default."""
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .sharded_update(True)
+            .list()
+            .layer(DenseLayer(n_out=N_HID, activation="tanh"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax"))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build()
+        )
+        m = MultiLayerNetwork(conf).init()
+        pw = ParallelWrapper.builder(m).workers(4).build()
+        assert pw.sharded_update
+        pw.fit(ExistingDataSetIterator([_blobs()]), epochs=1)
+        assert pw._zlayout is not None
+        # knob round-trips through conf JSON (checkpoint restore path)
+        clone = type(m.conf).from_json(m.conf.to_json())
+        assert clone.global_conf.sharded_update is True
+
+    def test_midfit_checkpoint_listener_gathers_opt_state(self, tmp_path):
+        """A CheckpointListener firing DURING a sharded fit must save the
+        canonical gathered opt state of that iteration, not the stale
+        pre-fit copy (serializers call the _opt_state_sync hook)."""
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        ds = _blobs()
+        ref = _net()
+        ParallelWrapper.builder(ref).workers(4).build().fit(
+            ExistingDataSetIterator([ds]), epochs=2)
+
+        zer = _net()
+        lst = CheckpointListener(str(tmp_path), save_every_n_iterations=2)
+        zer.listeners.append(lst)
+        ParallelWrapper.builder(zer).workers(4).sharded_update(
+            True).build().fit(ExistingDataSetIterator([ds]), epochs=4)
+        assert zer._opt_state_sync is None  # hook cleared after fit
+
+        mid = ModelSerializer.restore_multi_layer_network(lst.checkpoints[0])
+        assert mid.iteration == 2
+        np.testing.assert_allclose(mid.opt_state_flat(),
+                                   ref.opt_state_flat(), atol=1e-6)
+        np.testing.assert_allclose(mid.params_flat(), ref.params_flat(),
+                                   atol=1e-6)
+
+    def test_save_load_resume_roundtrip(self, tmp_path):
+        """2 sharded epochs → ModelSerializer save → restore → 2 more
+        sharded epochs == 4 uninterrupted replicated epochs."""
+        from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+        ds = _blobs()
+        ref = _net()
+        ParallelWrapper.builder(ref).workers(4).build().fit(
+            ExistingDataSetIterator([ds]), epochs=4)
+
+        zer = _net()
+        pw = ParallelWrapper.builder(zer).workers(4).sharded_update(
+            True).build()
+        pw.fit(ExistingDataSetIterator([ds]), epochs=2)
+        path = str(tmp_path / "ckpt.zip")
+        ModelSerializer.write_model(zer, path, save_updater=True)
+
+        resumed = ModelSerializer.restore_multi_layer_network(path)
+        assert resumed.iteration == 2 and resumed.epoch == 2
+        pw2 = ParallelWrapper.builder(resumed).workers(4).sharded_update(
+            True).build()
+        pw2.fit(ExistingDataSetIterator([ds]), epochs=2)
+        _assert_trees_close(ref.params_, resumed.params_)
+
+
+class TestSharedMasterSharded:
+    def test_threshold_encoding_parity(self):
+        """Sharded vs replicated update consuming the same
+        threshold-decoded gradient — wire format unchanged, params equal."""
+        from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+        ds = _blobs()
+        nets = []
+        for sharded in (False, True):
+            m = _net()
+            master = (SharedTrainingMaster.builder(1e-5)
+                      .sharded_update(sharded).build())
+            master.fit(m, ExistingDataSetIterator([ds]), epochs=3)
+            nets.append(m)
+        _assert_trees_close(nets[0].params_, nets[1].params_)
+
+    def test_conf_knob_enables_sharding(self):
+        """The NeuralNetConfiguration.sharded_update knob reaches a
+        default-built SharedTrainingMaster too."""
+        from deeplearning4j_tpu.parallel import SharedTrainingMaster
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).updater(Adam(0.01))
+            .sharded_update(True)
+            .list()
+            .layer(DenseLayer(n_out=N_HID, activation="tanh"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax"))
+            .set_input_type(InputType.feed_forward(N_IN))
+            .build()
+        )
+        m = MultiLayerNetwork(conf).init()
+        master = SharedTrainingMaster.builder(1e-5).build()
+        master.fit(m, ExistingDataSetIterator([_blobs()]), epochs=1)
+        assert master._layout is not None
+
+
+class TestMultiHostMasterSharded:
+    def test_parameter_averaging_master_parity(self):
+        from deeplearning4j_tpu.parallel import (
+            MultiHostNetwork,
+            ParameterAveragingTrainingMaster,
+        )
+
+        ds = _blobs()
+        nets = []
+        for sharded in (False, True):
+            m = _net()
+            master = (ParameterAveragingTrainingMaster.Builder()
+                      .batch_size_per_worker(4)
+                      .sharded_update(sharded).build())
+            MultiHostNetwork(m, master).fit(
+                ExistingDataSetIterator([ds]), epochs=3)
+            nets.append(m)
+        _assert_trees_close(nets[0].params_, nets[1].params_)
+
+
+class TestTransformerDataAxis:
+    V, T, B = 31, 16, 8
+
+    def _data(self, seed=0):
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(0, self.V, (self.B, self.T)).astype(np.int32)
+        tgt = np.roll(ids, -1, axis=1).astype(np.int32)
+        tgt[:, -1] = -1
+        return ids, tgt
+
+    def _model(self):
+        from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+        return TransformerLM(vocab_size=self.V, d_model=32, n_heads=4,
+                             n_layers=2, max_length=self.T).init()
+
+    def test_data_axis_parity_and_sharded_opt_state(self):
+        import jax
+
+        from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+        from deeplearning4j_tpu.parallel.transformer import (
+            DistributedLMTrainer,
+        )
+
+        ids, tgt = self._data()
+        runs = {}
+        for sharded in (False, True):
+            tr = DistributedLMTrainer(self._model(), TrainingMesh(data=8),
+                                      sharded_update=sharded).place()
+            losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
+            runs[sharded] = (tr, losses)
+        np.testing.assert_allclose(runs[False][1], runs[True][1],
+                                   rtol=1e-5, atol=1e-6)
+        p_ref = jax.tree_util.tree_leaves(runs[False][0].model.params_)
+        p_z = jax.tree_util.tree_leaves(runs[True][0].model.params_)
+        for a, b in zip(p_ref, p_z):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        # the ZeRO-1 run must actually hold opt state sharded over "data"
+        leaves = jax.tree_util.tree_leaves(runs[True][0].model.opt_state_)
+        specs = [getattr(l.sharding, "spec", None) for l in leaves]
+        n_data_sharded = sum(
+            1 for s in specs if s is not None and any(
+                e == "data" or (isinstance(e, (list, tuple)) and "data" in e)
+                for e in s if e is not None))
+        assert n_data_sharded > 0
+        dev0 = jax.devices()[0]
+        z_bytes = sum(s.data.nbytes
+                      for l in jax.tree_util.tree_leaves(
+                          runs[True][0].model.opt_state_)
+                      for s in l.addressable_shards if s.device == dev0)
+        r_bytes = sum(s.data.nbytes
+                      for l in jax.tree_util.tree_leaves(
+                          runs[False][0].model.opt_state_)
+                      for s in l.addressable_shards if s.device == dev0)
+        assert z_bytes < r_bytes  # measurably less opt state per replica
+
+    def test_zero1_extend_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.zero import zero1_extend_spec
+
+        def entries(spec):
+            return tuple(spec)
+
+        # first free dim divisible by n gets "data"
+        assert entries(zero1_extend_spec(P(), (16, 3), 8)) == ("data", None)
+        assert zero1_extend_spec(P(None, "model"), (7, 32), 4) is None
+        assert entries(zero1_extend_spec(P("model"), (32, 16), 8)) == (
+            "model", "data")
+        # axis already used, or no divisible dim → leaf stays as-is
+        assert zero1_extend_spec(P("data"), (16, 16), 8) is None
+        assert zero1_extend_spec(P(), (3, 5), 8) is None
+        assert zero1_extend_spec(P(), (16,), 1) is None
+
+
+class TestMemoryEstimator:
+    def test_updater_state_scales_inverse_n(self):
+        from deeplearning4j_tpu.nn.conf.memory import memory_report_mln
+
+        rep = memory_report_mln(_net().conf)
+        full = rep.updater_state_bytes()
+        shard = rep.updater_state_bytes(data_parallel_shards=8)
+        assert full > 0
+        # 1/N with per-layer ceil: never less than total/N, close to it
+        assert full / 8 <= shard <= full / 8 + 8 * 4 * len(rep.layer_reports)
+        assert (rep.total_memory_bytes(32, True)
+                - rep.total_memory_bytes(32, True, data_parallel_shards=8)
+                == full - shard)
+        # inference memory has no updater slots to shard
+        assert rep.total_memory_bytes(32, False) == rep.total_memory_bytes(
+            32, False, data_parallel_shards=8)
+
+    def test_to_string_reports_saving(self):
+        from deeplearning4j_tpu.nn.conf.memory import memory_report_mln
+
+        s = memory_report_mln(_net().conf).to_string(
+            batch_size=32, data_parallel_shards=8)
+        assert "sharded_update over 8 replicas" in s
+
+
+class TestSatelliteRegressions:
+    def test_binary_micro_f1_uses_positive_class(self):
+        """reference Evaluation.fBeta: 2-class problems return class-1 F1
+        regardless of the averaging mode, micro included."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        labels = np.eye(2, dtype=np.float32)[[0, 0, 0, 1, 1, 0, 1, 1]]
+        preds = np.eye(2, dtype=np.float32)[[0, 1, 0, 1, 0, 0, 1, 1]]
+        ev = Evaluation()
+        ev.eval(labels, preds)
+        assert ev.f1(averaging="micro") == pytest.approx(ev.f1(1))
+        assert ev.f1(averaging="macro") == pytest.approx(ev.f1(1))
+        p1, r1 = ev.precision(1), ev.recall(1)
+        assert ev.f1(1) == pytest.approx(2 * p1 * r1 / (p1 + r1))
+
+    def test_estimator_partial_fit_unsorted_classes(self):
+        from deeplearning4j_tpu.estimator import NeuralNetClassifier
+
+        def conf():
+            return (
+                NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build()
+            )
+
+        rng = np.random.default_rng(0)
+        centers = rng.standard_normal((3, 4)) * 3
+        y = rng.integers(0, 3, 48)
+        x = (centers[y] + rng.standard_normal((48, 4)) * 0.1).astype(
+            np.float32)
+
+        est = NeuralNetClassifier(conf, epochs=1)
+        # unsorted classes= must not scramble the label→column mapping
+        for _ in range(30):
+            est.partial_fit(x, y, classes=[2, 0, 1])
+        assert list(est.classes_) == [0, 1, 2]
+        assert np.mean(est.predict(x) == y) > 0.9
+
+        with pytest.raises(ValueError, match="not in classes="):
+            est.partial_fit(x, np.full_like(y, 7))
+
+    def test_loader_dense_without_bias(self, tmp_path):
+        """hasBias=false dense zips carry no bias values; consuming them
+        anyway would mis-slice every subsequent parameter."""
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_java_multi_layer_network,
+        )
+
+        p = fx.mlp_params()
+        path = str(tmp_path / "nb.zip")
+        with open(path, "wb") as f:
+            f.write(fx.mlp_nobias_zip_bytes())
+        net = restore_java_multi_layer_network(path)
+        x = np.random.default_rng(5).normal(size=(9, 4)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        want = fx.mlp_nobias_forward_numpy(p, x)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params_[0]["b"]), 0.0)
