@@ -106,13 +106,60 @@ def main(argv=None) -> int:
                     help="ZeRO-1 weight update for --workers>1: updater "
                          "state and update compute sharded 1/N over the "
                          "data axis (numerics unchanged)")
+    ap.add_argument("--skip-nonfinite", action="store_true",
+                    help="fault tolerance: skip (don't apply) any step "
+                         "whose global gradient is non-finite, and enable "
+                         "dynamic loss scaling under --compute-dtype")
+    ap.add_argument("--max-bad-steps", type=int, default=None,
+                    help="abort after this many CONSECUTIVE skipped "
+                         "non-finite steps (implies --skip-nonfinite)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="crash-safe checkpoint directory: one atomic "
+                         "checkpoint per epoch, keep-last-k retention")
+    ap.add_argument("--keep-last", type=int, default=3,
+                    help="checkpoints retained in --checkpoint-dir")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore the newest VALID checkpoint from "
+                         "--checkpoint-dir before training (corrupt/"
+                         "truncated ones are skipped)")
     args = ap.parse_args(argv)
 
     it, num_classes = build_dataset(args.dataset, args.batch_size,
                                     args.num_examples)
-    model = build_model(args.model, num_classes, args.dataset,
-                        compute_dtype=args.compute_dtype,
-                        remat_policy=args.remat_policy)
+    model = None
+    if args.resume:
+        if not args.checkpoint_dir:
+            raise SystemExit("--resume requires --checkpoint-dir")
+        import os
+
+        from deeplearning4j_tpu.train.faults import load_latest_valid
+
+        try:
+            if os.path.isdir(args.checkpoint_dir):
+                model, ckpt_path = load_latest_valid(args.checkpoint_dir)
+                print(f"resumed from {ckpt_path} (iteration "
+                      f"{model.iteration}, epoch {model.epoch}); "
+                      "--model/--compute-dtype/--remat-policy come from "
+                      "the checkpoint", flush=True)
+        except FileNotFoundError as e:
+            print(f"resume: {e}", flush=True)
+        if model is None:
+            # restart-wrapper friendly: no (valid) checkpoint yet means
+            # this IS the first launch — start fresh instead of dying
+            print(f"resume: no valid checkpoint in {args.checkpoint_dir}; "
+                  "starting fresh", flush=True)
+    if model is None:
+        model = build_model(args.model, num_classes, args.dataset,
+                            compute_dtype=args.compute_dtype,
+                            remat_policy=args.remat_policy)
+    if args.skip_nonfinite or args.max_bad_steps is not None:
+        from deeplearning4j_tpu.train.faults import FaultPolicy
+
+        model.set_fault_policy(FaultPolicy(
+            skip_nonfinite=True,
+            max_consecutive_bad_steps=args.max_bad_steps,
+            keep_last=args.keep_last,
+        ))
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
 
@@ -123,6 +170,21 @@ def main(argv=None) -> int:
         storage = (FileStatsStorage(args.stats) if args.stats
                    else InMemoryStatsStorage())
         model.add_listeners(StatsListener(storage, session_id="cli"))
+
+    if args.checkpoint_dir:
+        import os
+
+        from deeplearning4j_tpu.train.faults import prune_checkpoints
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        # directory-level retention: CheckpointListener only prunes files
+        # IT wrote, so a restart loop (--resume under a supervisor) would
+        # otherwise grow the directory by keep_last zips per incarnation
+        if os.path.isdir(args.checkpoint_dir):
+            prune_checkpoints(args.checkpoint_dir, args.keep_last)
+        model.add_listeners(CheckpointListener(
+            args.checkpoint_dir, save_every_n_epochs=1,
+            keep_mode="last", keep_last=args.keep_last))
 
     t0 = time.time()
     if args.workers > 1:
@@ -137,6 +199,9 @@ def main(argv=None) -> int:
         model.fit(it, epochs=args.epochs)
     print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
           f"final score {float(model.score_):.4f}", flush=True)
+    if args.skip_nonfinite or args.max_bad_steps is not None:
+        print(f"skipped non-finite steps: {model.bad_step_count}",
+              flush=True)
 
     if args.output:
         from deeplearning4j_tpu.train.model_serializer import ModelSerializer

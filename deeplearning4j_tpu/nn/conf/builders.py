@@ -290,6 +290,20 @@ class NeuralNetConfiguration:
         self._g.sharded_update = bool(b)
         return self
 
+    def fault_policy(self, policy) -> "NeuralNetConfiguration":
+        """Step-level fault tolerance (train/faults.FaultPolicy): fold a
+        global non-finite gradient guard into the jitted train step (bad
+        batches skip the update instead of poisoning params), dynamic
+        loss scaling for ``compute_dtype`` mixed precision, and
+        checkpoint retention. Pass a FaultPolicy, or True for the
+        defaults; None disables."""
+        from deeplearning4j_tpu.train.faults import FaultPolicy
+
+        if policy is True:
+            policy = FaultPolicy()
+        self._g.fault_policy = policy
+        return self
+
     def remat_policy(self, policy: Optional[str]) -> "NeuralNetConfiguration":
         """Backward-pass rematerialization: "save_conv_outputs" stores only
         conv outputs for backward and recomputes BN/activation epilogues

@@ -166,6 +166,7 @@ class GlobalConf:
         optimization_algo: str = "stochastic_gradient_descent",
         remat_policy: Optional[str] = None,
         sharded_update: bool = False,
+        fault_policy=None,
     ):
         from deeplearning4j_tpu.updaters import Sgd
 
@@ -196,6 +197,10 @@ class GlobalConf:
         # replica and all-gather — updater state scales as 1/N per
         # replica, numerics unchanged. See parallel/zero.py.
         self.sharded_update = bool(sharded_update)
+        # Step-level fault tolerance (train/faults.FaultPolicy or None):
+        # non-finite gradient guard + dynamic loss scaling folded into the
+        # jitted train steps, checkpoint retention for the savers.
+        self.fault_policy = fault_policy
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

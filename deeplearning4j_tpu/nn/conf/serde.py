@@ -23,6 +23,11 @@ def register(cls: type) -> type:
 
 
 def lookup(name: str) -> type:
+    if name == "FaultPolicy" and name not in _CLASSES:
+        # registers itself on import; lazy so decoding a conf JSON that
+        # carries a fault policy works without the caller having touched
+        # the train package (and without an import cycle at module load)
+        import deeplearning4j_tpu.train.faults  # noqa: F401
     if name not in _CLASSES:
         raise KeyError(f"Unknown config class '{name}'. Registered: {sorted(_CLASSES)}")
     return _CLASSES[name]

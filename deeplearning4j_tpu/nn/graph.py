@@ -46,6 +46,7 @@ from deeplearning4j_tpu.nn.conf.layers.base import (
 )
 from deeplearning4j_tpu.nn.conf.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork,
     _apply_layer_updates,
     _cast_layer_params_for_compute,
     _dtype_of,
@@ -89,12 +90,23 @@ class ComputationGraph:
         self.iteration = 0
         self.epoch = 0
         self.score_: Optional[Array] = None
+        # fault-tolerance carry (train/faults.py), as on MultiLayerNetwork
+        self.fault_state_: Optional[Dict[str, Array]] = None
         self.listeners: List[Any] = []
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._jit_cache: Dict[str, Any] = {}
         cd = getattr(conf.global_conf, "compute_dtype", None)
         self._compute_dtype = None if cd is None else _dtype_of(cd)
         self._output_layers()  # fail fast with a clear message on misconfig
+
+    # ---------------------------------------------------------- fault policy
+    # (same surface as MultiLayerNetwork; both model types feed the same
+    # data-parallel runtimes)
+    _active_fault_policy = MultiLayerNetwork._active_fault_policy
+    _ensure_fault_state = MultiLayerNetwork._ensure_fault_state
+    set_fault_policy = MultiLayerNetwork.set_fault_policy
+    bad_step_count = MultiLayerNetwork.bad_step_count
+    loss_scale = MultiLayerNetwork.loss_scale
 
     def _cast_for_compute(self, params):
         cd = self._compute_dtype
@@ -309,31 +321,83 @@ class ComputationGraph:
         remat_policy = _resolve_remat_policy(
             getattr(self.conf.global_conf, "remat_policy", None)
         )
+        policy = self._active_fault_policy()
 
-        def step(params, opt_state, state, features, labels, fmasks, lmasks, rng,
-                 iteration, epoch):
+        if policy is None:
+            def step(params, opt_state, state, features, labels, fmasks, lmasks, rng,
+                     iteration, epoch):
+                def loss_fn(p):
+                    loss, new_state = self._loss_and_new_state(
+                        p, state, features, labels, fmasks, lmasks, rng, train=True
+                    )
+                    return loss, new_state
+
+                if remat_policy is not None:
+                    loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                t = iteration + 1
+                p_list = [params[n] for n in names]
+                g_list = [grads[n] for n in names]
+                o_list = [opt_state[n] for n in names]
+                np_list, no_list = _apply_layer_updates(
+                    layers, p_list, g_list, o_list, t, iteration, epoch
+                )
+                new_params = dict(zip(names, np_list))
+                new_opt = dict(zip(names, no_list))
+                score = loss + self._reg_score(params)
+                return new_params, new_opt, new_state, score
+
+            return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+
+        # guarded variant — see MultiLayerNetwork._make_train_step for the
+        # mechanism (loss scaling, global verdict, where-skip, good_count
+        # updater clock)
+        from deeplearning4j_tpu.train import faults as _faults
+
+        scaling = policy.scaling_active(self._compute_dtype)
+        do_skip = policy.skip_nonfinite or scaling
+
+        def gstep(params, opt_state, state, fstate, features, labels, fmasks,
+                  lmasks, rng, iteration, epoch):
+            scale = fstate["loss_scale"] if scaling else None
+
             def loss_fn(p):
                 loss, new_state = self._loss_and_new_state(
                     p, state, features, labels, fmasks, lmasks, rng, train=True
                 )
+                if scaling:
+                    loss = loss * scale
                 return loss, new_state
 
             if remat_policy is not None:
                 loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
-            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            t = iteration + 1
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if scaling:
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+            grads = _faults.inject_gradient_faults(grads, iteration)
+            finite = _faults.all_finite(grads)
+            t_good = fstate["good_count"]
             p_list = [params[n] for n in names]
             g_list = [grads[n] for n in names]
             o_list = [opt_state[n] for n in names]
             np_list, no_list = _apply_layer_updates(
-                layers, p_list, g_list, o_list, t, iteration, epoch
+                layers, p_list, g_list, o_list, t_good + 1, t_good, epoch
             )
             new_params = dict(zip(names, np_list))
             new_opt = dict(zip(names, no_list))
+            if do_skip:
+                new_params = _faults.where_tree(finite, new_params, params)
+                new_opt = _faults.where_tree(finite, new_opt, opt_state)
+                new_state = _faults.where_tree(finite, new_state, state)
+            new_fstate = _faults.advance_fault_state(policy, fstate, finite)
             score = loss + self._reg_score(params)
-            return new_params, new_opt, new_state, score
+            return new_params, new_opt, new_state, new_fstate, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+        return (jax.jit(gstep, donate_argnums=_faults.guard_donation(0, 1, 2))
+                if jit else gstep)
 
     def _get_jit(self, key, maker):
         if key not in self._jit_cache:
@@ -440,13 +504,28 @@ class ComputationGraph:
         lmasks = tuple(None if m is None else jnp.asarray(m) for m in mds.labels_masks)
         rng = self._next_rng()
         self._run_introspection(feats, labels, fmasks, lmasks, rng)
-        self.params_, self.opt_state_, self.state_, self.score_ = step(
-            self.params_, self.opt_state_, self.state_, feats, labels, fmasks, lmasks,
-            rng,
-            jnp.asarray(self.iteration, jnp.int32),
-            jnp.asarray(self.epoch, jnp.int32),
-        )
+        policy = self._active_fault_policy()
+        if policy is not None:
+            fstate = self._ensure_fault_state(policy)
+            (self.params_, self.opt_state_, self.state_, self.fault_state_,
+             self.score_) = step(
+                self.params_, self.opt_state_, self.state_, fstate,
+                feats, labels, fmasks, lmasks, rng,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        else:
+            self.params_, self.opt_state_, self.state_, self.score_ = step(
+                self.params_, self.opt_state_, self.state_, feats, labels, fmasks, lmasks,
+                rng,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
         self.iteration += 1
+        if policy is not None:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            _faults.check_fault_state(policy, self.fault_state_)
         for lst in _hook_recipients(self.listeners, "on_backward_pass"):
             lst.on_backward_pass(self)
         for lst in self.listeners:
@@ -590,6 +669,19 @@ class ComputationGraph:
         ``doTruncatedBPTT`` on ComputationGraph): every 3D feature/label/
         mask is sliced by ``tbptt_fwd_length``; recurrent carries thread
         across chunks with stop_gradient at boundaries."""
+        if self._active_fault_policy() is not None:
+            # the one fit path without the guard (ARCHITECTURE.md known
+            # gap) — tell the user their configured protection is
+            # inactive here instead of silently applying poisoned updates
+            import warnings
+
+            warnings.warn(
+                "fault_policy is not applied on the ComputationGraph "
+                "tBPTT path: non-finite gradient chunks are NOT skipped "
+                "and loss scaling is off (use MultiLayerNetwork tBPTT or "
+                "standard backprop for guarded training)",
+                stacklevel=3,
+            )
         step = self._get_jit("tbptt", self._make_tbptt_step)
         T = mds.features[0].shape[1]
         L = self.conf.tbptt_fwd_length

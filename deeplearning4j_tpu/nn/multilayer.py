@@ -156,12 +156,57 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         self.score_: Optional[Array] = None  # device scalar; float(score()) syncs
+        # fault-tolerance carry (train/faults.py): bad/consecutive/good
+        # step counters + dynamic loss scale, all device scalars
+        self.fault_state_: Optional[Dict[str, Array]] = None
         self.listeners: List[Any] = []
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._rnn_carries: Optional[List[Any]] = None
         self._jit_cache: Dict[str, Any] = {}
         cd = getattr(conf.global_conf, "compute_dtype", None)
         self._compute_dtype = None if cd is None else _dtype_of(cd)
+
+    # ---------------------------------------------------------- fault policy
+    def _active_fault_policy(self):
+        """The FaultPolicy iff configured AND it has work to do for this
+        model (see train/faults.active_policy)."""
+        from deeplearning4j_tpu.train import faults
+
+        return faults.active_policy(
+            getattr(self.conf.global_conf, "fault_policy", None),
+            self._compute_dtype,
+        )
+
+    def _ensure_fault_state(self, policy):
+        from deeplearning4j_tpu.train import faults
+
+        scaling = policy.scaling_active(self._compute_dtype)
+        if (self.fault_state_ is None
+                or ("loss_scale" in self.fault_state_) != scaling):
+            self.fault_state_ = faults.init_fault_state(
+                policy, scaling, start_step=self.iteration)
+        return self.fault_state_
+
+    def set_fault_policy(self, policy) -> None:
+        """Install (or clear, with None) the training fault policy; takes
+        effect on the next step — compiled steps closed over the old
+        policy are invalidated."""
+        self.conf.global_conf.fault_policy = policy
+        self.fault_state_ = None
+        self._jit_cache.clear()
+
+    @property
+    def bad_step_count(self) -> int:
+        """Lifetime count of skipped (non-finite gradient) steps."""
+        return 0 if self.fault_state_ is None else int(
+            self.fault_state_["bad_count"])
+
+    @property
+    def loss_scale(self) -> Optional[float]:
+        """Current dynamic loss scale, or None when scaling is off."""
+        if self.fault_state_ is None or "loss_scale" not in self.fault_state_:
+            return None
+        return float(self.fault_state_["loss_scale"])
 
     def _cast_for_compute(self, params):
         cd = self._compute_dtype
@@ -322,25 +367,73 @@ class MultiLayerNetwork:
         remat_policy = _resolve_remat_policy(
             getattr(self.conf.global_conf, "remat_policy", None)
         )
+        policy = self._active_fault_policy()
 
-        def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
+        if policy is None:
+            def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
+                def loss_fn(p):
+                    loss, new_states = self._loss_and_new_state(
+                        p, state, features, labels, fmask, lmask, rng, train=True
+                    )
+                    return loss, new_states
+
+                if remat_policy is not None:
+                    loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
+                (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                t = iteration + 1  # 1-based updater step for bias correction
+                new_params, new_opt = _apply_layer_updates(
+                    layers, params, grads, opt_state, t, iteration, epoch
+                )
+                score = loss + self._reg_score(params)
+                return new_params, new_opt, new_states, score
+
+            return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+
+        # Guarded step (train/faults.py): loss scaling + global all-finite
+        # verdict + jnp.where skip, bad/good counters carried in fstate.
+        # The updater clock runs on the in-graph good_count so a skipped
+        # batch leaves the trajectory exactly as if it had been removed.
+        from deeplearning4j_tpu.train import faults as _faults
+
+        scaling = policy.scaling_active(self._compute_dtype)
+        do_skip = policy.skip_nonfinite or scaling
+
+        def gstep(params, opt_state, state, fstate, features, labels, fmask,
+                  lmask, rng, iteration, epoch):
+            scale = fstate["loss_scale"] if scaling else None
+
             def loss_fn(p):
                 loss, new_states = self._loss_and_new_state(
                     p, state, features, labels, fmask, lmask, rng, train=True
                 )
+                if scaling:
+                    loss = loss * scale
                 return loss, new_states
 
             if remat_policy is not None:
                 loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
-            (loss, new_states), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            t = iteration + 1  # 1-based updater step for bias correction
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            if scaling:
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+            grads = _faults.inject_gradient_faults(grads, iteration)
+            finite = _faults.all_finite(grads)
+            t_good = fstate["good_count"]
             new_params, new_opt = _apply_layer_updates(
-                layers, params, grads, opt_state, t, iteration, epoch
+                layers, params, grads, opt_state, t_good + 1, t_good, epoch
             )
+            if do_skip:
+                new_params = _faults.where_tree(finite, new_params, params)
+                new_opt = _faults.where_tree(finite, new_opt, opt_state)
+                new_states = _faults.where_tree(finite, new_states, state)
+            new_fstate = _faults.advance_fault_state(policy, fstate, finite)
             score = loss + self._reg_score(params)
-            return new_params, new_opt, new_states, score
+            return new_params, new_opt, new_states, new_fstate, score
 
-        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+        return (jax.jit(gstep, donate_argnums=_faults.guard_donation(0, 1, 2))
+                if jit else gstep)
 
     def _get_jit(self, key, maker):
         if key not in self._jit_cache:
@@ -456,13 +549,28 @@ class MultiLayerNetwork:
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         rng = self._next_rng()
         self._run_introspection(features, labels, fmask, lmask, rng)
-        self.params_, self.opt_state_, self.state_, self.score_ = step(
-            self.params_, self.opt_state_, self.state_,
-            features, labels, fmask, lmask, rng,
-            jnp.asarray(self.iteration, jnp.int32),
-            jnp.asarray(self.epoch, jnp.int32),
-        )
+        policy = self._active_fault_policy()
+        if policy is not None:
+            fstate = self._ensure_fault_state(policy)
+            (self.params_, self.opt_state_, self.state_, self.fault_state_,
+             self.score_) = step(
+                self.params_, self.opt_state_, self.state_, fstate,
+                features, labels, fmask, lmask, rng,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        else:
+            self.params_, self.opt_state_, self.state_, self.score_ = step(
+                self.params_, self.opt_state_, self.state_,
+                features, labels, fmask, lmask, rng,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
         self.iteration += 1
+        if policy is not None:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            _faults.check_fault_state(policy, self.fault_state_)
         for lst in _hook_recipients(self.listeners, "on_backward_pass"):
             lst.on_backward_pass(self)
         for lst in self.listeners:
@@ -479,9 +587,16 @@ class MultiLayerNetwork:
         remat_policy = _resolve_remat_policy(
             getattr(self.conf.global_conf, "remat_policy", None)
         )
+        policy = self._active_fault_policy()
+        scaling = (policy is not None
+                   and policy.scaling_active(self._compute_dtype))
+        do_skip = policy is not None and (policy.skip_nonfinite or scaling)
+        guarded = policy is not None
 
-        def step(params, opt_state, state, carries, features, labels, fmask, lmask, rng, iteration, epoch):
+        def _body(params, opt_state, state, fstate, carries, features,
+                  labels, fmask, lmask, rng, iteration, epoch):
             n = len(layers)
+            scale = fstate["loss_scale"] if scaling else None
 
             def loss_fn(p):
                 x, mask, new_states, new_carries, _ = self._forward(
@@ -501,6 +616,8 @@ class MultiLayerNetwork:
                 for st in new_states:
                     if isinstance(st, dict) and "aux_loss" in st:
                         loss = loss + st["aux_loss"]
+                if scaling:
+                    loss = loss * scale
                 return loss, (new_states, new_carries)
 
             if remat_policy is not None:
@@ -508,6 +625,20 @@ class MultiLayerNetwork:
             (loss, (new_states, new_carries)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
+            if scaling:
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+            if guarded:
+                from deeplearning4j_tpu.train import faults as _faults
+
+                grads = _faults.inject_gradient_faults(grads, iteration)
+                finite = _faults.all_finite(grads)
+            # NOTE: tBPTT applies one updater step per CHUNK but advances
+            # the host iteration once per batch (all chunks of a batch see
+            # the same ``iteration``) — the guarded variant keeps that
+            # clocking and only folds in the skip, so enabling the policy
+            # without faults does not perturb the trajectory
             t = iteration + 1
             new_params, new_opt = _apply_layer_updates(
                 layers, params, grads, opt_state, t, iteration, epoch
@@ -516,9 +647,39 @@ class MultiLayerNetwork:
             # fresh step inputs (each chunk is its own jit call), so no
             # gradient flows across the boundary (reference semantics)
             score = loss + self._reg_score(params)
-            return new_params, new_opt, new_states, new_carries, score
+            if not guarded:
+                return new_params, new_opt, new_states, new_carries, score
+            from deeplearning4j_tpu.train import faults as _faults
 
-        return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+            if do_skip:
+                new_params = _faults.where_tree(finite, new_params, params)
+                new_opt = _faults.where_tree(finite, new_opt, opt_state)
+                new_states = _faults.where_tree(finite, new_states, state)
+                new_carries = _faults.where_tree(finite, new_carries, carries)
+            new_fstate = _faults.advance_fault_state(policy, fstate, finite)
+            return (new_params, new_opt, new_states, new_fstate, new_carries,
+                    score)
+
+        if guarded:
+            def step(params, opt_state, state, fstate, carries, features,
+                     labels, fmask, lmask, rng, iteration, epoch):
+                return _body(params, opt_state, state, fstate, carries,
+                             features, labels, fmask, lmask, rng, iteration,
+                             epoch)
+        else:
+            def step(params, opt_state, state, carries, features, labels,
+                     fmask, lmask, rng, iteration, epoch):
+                return _body(params, opt_state, state, None, carries,
+                             features, labels, fmask, lmask, rng, iteration,
+                             epoch)
+
+        if not jit:
+            return step
+        if guarded:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            return jax.jit(step, donate_argnums=_faults.guard_donation(0, 1, 2))
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _init_carries(self, batch: int, dtype=jnp.float32) -> List[Any]:
         carries: List[Any] = []
@@ -543,19 +704,37 @@ class MultiLayerNetwork:
                 "standard backprop (the reference has the same requirement)."
             )
         carries = self._init_carries(ds.features.shape[0])
+        policy = self._active_fault_policy()
         for lo in range(0, T, L):
             hi = min(lo + L, T)
             f = jnp.asarray(ds.features[:, lo:hi])
             l = None if ds.labels is None else jnp.asarray(ds.labels[:, lo:hi])
             fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, lo:hi])
             lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, lo:hi])
-            (self.params_, self.opt_state_, self.state_, carries, self.score_) = step(
-                self.params_, self.opt_state_, self.state_, carries, f, l, fm, lm,
-                self._next_rng(),
-                jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
+            if policy is not None:
+                fstate = self._ensure_fault_state(policy)
+                (self.params_, self.opt_state_, self.state_,
+                 self.fault_state_, carries, self.score_) = step(
+                    self.params_, self.opt_state_, self.state_, fstate,
+                    carries, f, l, fm, lm,
+                    self._next_rng(),
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+            else:
+                (self.params_, self.opt_state_, self.state_, carries,
+                 self.score_) = step(
+                    self.params_, self.opt_state_, self.state_, carries,
+                    f, l, fm, lm,
+                    self._next_rng(),
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
         self.iteration += 1
+        if policy is not None:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            _faults.check_fault_state(policy, self.fault_state_)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

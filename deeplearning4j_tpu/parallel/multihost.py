@@ -72,7 +72,8 @@ def initialize(
     driver/executor bootstrap + Aeron shard/controller address selection
     (``SharedTrainingMaster.java:425-431``).
     """
-    if not jax.distributed.is_initialized():
+    if not _distributed_initialized():
+        _enable_cpu_collectives()
         if coordinator_address is None:
             jax.distributed.initialize()
         else:
@@ -82,6 +83,35 @@ def initialize(
                 process_id=process_id,
             )
     return MultiHostContext()
+
+
+def _enable_cpu_collectives() -> None:
+    """jax 0.4.x ships Gloo CPU collectives in jaxlib but defaults the
+    implementation to 'none', so any cross-process computation on the CPU
+    backend dies with "Multiprocess computations aren't implemented on
+    the CPU backend". Newer jax defaults to 'gloo'; opt in here (before
+    backend init) so the CPU-mesh multi-host path works on both. The
+    flag only registers once xla_bridge is imported, so attempt the
+    update directly and tolerate its absence (renamed/removed → the
+    default is already gloo there)."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
+
+
+def _distributed_initialized() -> bool:
+    """``jax.distributed.is_initialized`` across jax versions — 0.4.x has
+    no public predicate, so probe the distributed client's global state."""
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        return bool(is_init())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:  # pragma: no cover — unexpected jax layout
+        return False
 
 
 class MultiHostContext:
@@ -298,7 +328,9 @@ class MultiHostNetwork:
         n = len(jax.devices())
         self.mesh = TrainingMesh(data=n, devices=jax.devices())
         self._step = None
+        self._step_guarded = False
         self._zstep = None
+        self._zstep_guarded = False
         self._zlayout = None
         self._is_graph = hasattr(model.conf, "network_inputs")
 
@@ -326,17 +358,36 @@ class MultiHostNetwork:
             self._to_global(ds.labels_mask, True),
         )
 
-    def _build_step(self):
+    def _fault_policy(self):
+        from deeplearning4j_tpu.train import faults
+
+        return faults.active_policy(
+            getattr(self.model.conf.global_conf, "fault_policy", None),
+            self.model._compute_dtype,
+        )
+
+    def _build_step(self, guarded: bool = False):
         raw = self.model.train_step_fn()
         repl = self.mesh.replicated()
         batch = self.mesh.batch_sharded()
+        if guarded:  # extra fault-state carry after ``state`` (replicated)
+            in_sh = (repl, repl, repl, repl, batch, batch, batch, batch,
+                     repl, repl, repl)
+            out_sh = (repl, repl, repl, repl, repl)
+        else:
+            in_sh = (repl, repl, repl, batch, batch, batch, batch,
+                     repl, repl, repl)
+            out_sh = (repl, repl, repl, repl)
+        donate = (0, 1, 2)
+        if guarded:
+            from deeplearning4j_tpu.train.faults import guard_donation
+
+            donate = guard_donation(0, 1, 2)
         self._step = jax.jit(
-            raw,
-            in_shardings=(repl, repl, repl, batch, batch, batch, batch,
-                          repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl),
-            donate_argnums=(0, 1, 2),
+            raw, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=donate,
         )
+        self._step_guarded = guarded
         return self._step
 
     # -- training -----------------------------------------------------------
@@ -349,6 +400,10 @@ class MultiHostNetwork:
 
     def _fit_sharded(self, it: DataSetIterator, epochs: int = 1, stats=None):
         m = self.model
+        policy = self._fault_policy()
+        guarded = policy is not None
+        if guarded:
+            m._ensure_fault_state(policy)
         zopt = None
         if getattr(self.master, "sharded_update", False) or getattr(
                 m.conf.global_conf, "sharded_update", False):
@@ -358,9 +413,14 @@ class MultiHostNetwork:
                 unshard_model_opt_state,
             )
 
-            if self._zstep is None:
+            # key the cached step on the POLICY, not just guardedness —
+            # see ParallelWrapper.fit
+            if self._zstep is None or self._zstep_guarded != guarded \
+                    or getattr(self, "_zstep_policy", None) != policy:
                 self._zstep, self._zlayout = make_sharded_train_step(
-                    m, self.mesh)
+                    m, self.mesh, policy=policy)
+                self._zstep_guarded = guarded
+                self._zstep_policy = policy
             step = self._zstep
             zopt = shard_model_opt_state(m, self._zlayout,
                                          mesh=self.mesh.mesh)
@@ -371,7 +431,11 @@ class MultiHostNetwork:
             m._opt_state_sync = (
                 lambda: unshard_model_opt_state(m, zlayout, zref[0]))
         else:
-            step = self._step or self._build_step()
+            if self._step is None or self._step_guarded != guarded \
+                    or getattr(self, "_step_policy", None) != policy:
+                self._build_step(guarded=guarded)
+                self._step_policy = policy
+            step = self._step
         zopt_valid = True
         try:
             for _ in range(epochs):
@@ -388,12 +452,21 @@ class MultiHostNetwork:
                     # not be gathered (batch packing above raising leaves
                     # zopt intact)
                     zopt_valid = zopt is None
-                    m.params_, new_o, m.state_, m.score_ = step(
-                        m.params_, opt_in, m.state_,
-                        *batch, rng,
-                        jnp.asarray(m.iteration, jnp.int32),
-                        jnp.asarray(m.epoch, jnp.int32),
-                    )
+                    if guarded:
+                        (m.params_, new_o, m.state_, m.fault_state_,
+                         m.score_) = step(
+                            m.params_, opt_in, m.state_, m.fault_state_,
+                            *batch, rng,
+                            jnp.asarray(m.iteration, jnp.int32),
+                            jnp.asarray(m.epoch, jnp.int32),
+                        )
+                    else:
+                        m.params_, new_o, m.state_, m.score_ = step(
+                            m.params_, opt_in, m.state_,
+                            *batch, rng,
+                            jnp.asarray(m.iteration, jnp.int32),
+                            jnp.asarray(m.epoch, jnp.int32),
+                        )
                     if zopt is not None:
                         zopt = new_o
                         zref[0] = new_o
@@ -401,6 +474,10 @@ class MultiHostNetwork:
                     if zopt is None:
                         m.opt_state_ = new_o
                     m.iteration += 1
+                    if guarded:
+                        from deeplearning4j_tpu.train import faults as _faults
+
+                        _faults.check_fault_state(policy, m.fault_state_)
                     if stats is not None:
                         jax.block_until_ready(m.score_)
                         stats.append({
@@ -495,6 +572,7 @@ class MultiHostNetwork:
         m.opt_state_ = restored.opt_state_
         m.iteration = restored.iteration
         m.epoch = restored.epoch
+        m.fault_state_ = None  # re-seed good_count from restored iteration
         self._step = None  # donated-buffer jit must not reuse old avals
         self._zstep = None
         self._zlayout = None

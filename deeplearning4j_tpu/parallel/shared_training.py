@@ -140,8 +140,23 @@ class SharedTrainingMaster:
             self._layout = ShardedUpdateLayout(layers, model.params_,
                                                mesh.n_data)
 
-        def step(params, opt_state, state, f, l, fm, lm, residual, rng,
-                 iteration, epoch, threshold):
+        # Fault guard (train/faults.py): verdict on the DECODED synchronized
+        # gradient AND the residual carry — a NaN in a local gradient is
+        # never threshold-encoded (NaN comparisons are false), so it can
+        # poison the residual while the decoded update stays finite; both
+        # must be checked or the residual rots silently. Dynamic loss
+        # scaling is NOT applied on this master (the residual carries
+        # values across steps, so a changing scale would make the carry
+        # inconsistent); mixed-precision models get the skip guard only.
+        from deeplearning4j_tpu.train import faults as _faults
+
+        policy = _faults.active_policy(
+            getattr(model.conf.global_conf, "fault_policy", None), None)
+        self._policy = policy
+        do_skip = policy is not None and policy.skip_nonfinite
+
+        def _body(params, opt_state, state, fstate, f, l, fm, lm, residual,
+                  rng, iteration, epoch, threshold):
             mean_loss, summed, new_residual = shard_map(
                 sharded_part, mesh=mesh.mesh,
                 in_specs=(P(), P(), P("data"), P("data"), P("data"),
@@ -149,8 +164,16 @@ class SharedTrainingMaster:
                 out_specs=(P(), P(), P("data")),
                 check_vma=False,
             )(params, state, f, l, fm, lm, residual, rng, threshold)
+            if policy is not None:
+                summed = _faults.inject_gradient_faults(summed, iteration)
+                finite = jnp.logical_and(_faults.all_finite(summed),
+                                         _faults.all_finite(new_residual))
+                t = fstate["good_count"] + 1
+                it_upd = fstate["good_count"]
+            else:
+                t = iteration + 1
+                it_upd = iteration
             grads_sync = unravel(summed)
-            t = iteration + 1
             if self._layout is not None:
                 from deeplearning4j_tpu.parallel.zero import (
                     apply_sharded_updates,
@@ -158,19 +181,42 @@ class SharedTrainingMaster:
 
                 new_params, new_opt = apply_sharded_updates(
                     self._layout, params, grads_sync, opt_state, t,
-                    iteration, epoch, mesh=mesh.mesh)
+                    it_upd, epoch, mesh=mesh.mesh)
             else:
                 new_params, new_opt = _apply_layer_updates(
-                    layers, params, grads_sync, opt_state, t, iteration,
+                    layers, params, grads_sync, opt_state, t, it_upd,
                     epoch
                 )
-            return new_params, new_opt, mean_loss, new_residual
+            if policy is None:
+                return new_params, new_opt, mean_loss, new_residual
+            if do_skip:
+                new_params = _faults.where_tree(finite, new_params, params)
+                new_opt = _faults.where_tree(finite, new_opt, opt_state)
+                new_residual = _faults.where_tree(finite, new_residual,
+                                                  residual)
+            new_fstate = _faults.advance_fault_state(policy, fstate, finite)
+            return new_params, new_opt, mean_loss, new_residual, new_fstate
 
         from deeplearning4j_tpu.parallel.mesh import zero1_donation
 
-        return jax.jit(step, donate_argnums=(
-            zero1_donation(0, 1, 7) if self._layout is not None
-            else (0, 1, 7)))
+        if policy is None:
+            def step(params, opt_state, state, f, l, fm, lm, residual, rng,
+                     iteration, epoch, threshold):
+                return _body(params, opt_state, state, None, f, l, fm, lm,
+                             residual, rng, iteration, epoch, threshold)
+
+            return jax.jit(step, donate_argnums=(
+                zero1_donation(0, 1, 7) if self._layout is not None
+                else (0, 1, 7)))
+
+        def gstep(params, opt_state, state, fstate, f, l, fm, lm, residual,
+                  rng, iteration, epoch, threshold):
+            return _body(params, opt_state, state, fstate, f, l, fm, lm,
+                         residual, rng, iteration, epoch, threshold)
+
+        return jax.jit(gstep, donate_argnums=(
+            zero1_donation(0, 1, 8) if self._layout is not None
+            else _faults.guard_donation(0, 1, 8)))
 
     # ------------------------------------------------------------------- fit
     def _to_global(self, a, batch_like: bool = True):
@@ -211,7 +257,28 @@ class SharedTrainingMaster:
                 "This SharedTrainingMaster is bound to its first model "
                 "(cached step/residual); build a new master per model"
             )
+        else:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            # rebuild if the fault policy changed between fits (the step
+            # traces the policy's schedule constants); the residual carry
+            # survives — it is independent of the guard
+            current = _faults.active_policy(
+                getattr(model.conf.global_conf, "fault_policy", None), None)
+            if current != getattr(self, "_policy", None):
+                self._step = self._build_step(model)
         step = self._step
+        policy = getattr(self, "_policy", None)
+        if policy is not None:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            # scaling=False always: this master never applies the loss
+            # scale (see _build_step), so the state must not carry a
+            # live-looking loss_scale that nothing uses
+            if (model.fault_state_ is None
+                    or "loss_scale" in model.fault_state_):
+                model.fault_state_ = _faults.init_fault_state(
+                    policy, False, start_step=model.iteration)
         zopt = None
         if self._layout is not None:
             from deeplearning4j_tpu.parallel.zero import (
@@ -255,16 +322,29 @@ class SharedTrainingMaster:
                     # zopt intact)
                     zopt_valid = zopt is None
                     with self.mesh.mesh:
-                        (model.params_, new_o, model.score_,
-                         self._residual) = step(
-                            model.params_, opt_in, model.state_,
-                            *batch,
-                            self._residual,
-                            rng,
-                            jnp.asarray(model.iteration, jnp.int32),
-                            jnp.asarray(model.epoch, jnp.int32),
-                            jnp.asarray(self.threshold, jnp.float32),
-                        )
+                        if policy is not None:
+                            (model.params_, new_o, model.score_,
+                             self._residual, model.fault_state_) = step(
+                                model.params_, opt_in, model.state_,
+                                model.fault_state_,
+                                *batch,
+                                self._residual,
+                                rng,
+                                jnp.asarray(model.iteration, jnp.int32),
+                                jnp.asarray(model.epoch, jnp.int32),
+                                jnp.asarray(self.threshold, jnp.float32),
+                            )
+                        else:
+                            (model.params_, new_o, model.score_,
+                             self._residual) = step(
+                                model.params_, opt_in, model.state_,
+                                *batch,
+                                self._residual,
+                                rng,
+                                jnp.asarray(model.iteration, jnp.int32),
+                                jnp.asarray(model.epoch, jnp.int32),
+                                jnp.asarray(self.threshold, jnp.float32),
+                            )
                     if zopt is not None:
                         zopt = new_o
                         zref[0] = new_o
@@ -272,6 +352,10 @@ class SharedTrainingMaster:
                     if zopt is None:
                         model.opt_state_ = new_o
                     model.iteration += 1
+                    if policy is not None:
+                        from deeplearning4j_tpu.train import faults as _faults
+
+                        _faults.check_fault_state(policy, model.fault_state_)
                     for lst in model.listeners:
                         lst.iteration_done(model, model.iteration,
                                            model.epoch)

@@ -86,10 +86,22 @@ class DistributedLMTrainer:
                  n_micro: Optional[int] = None,
                  clip_norm: Optional[float] = None,
                  remat_blocks: bool = False,
-                 sharded_update: bool = False):
+                 sharded_update: bool = False,
+                 fault_policy=None):
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
+        # step-level fault tolerance (train/faults.FaultPolicy): global
+        # non-finite guard + dynamic loss scaling for bf16 compute; the
+        # verdict is computed on the gradient BEFORE the ZeRO-1 "data"
+        # sharding constraint, so all replicas agree
+        from deeplearning4j_tpu.models.transformer_lm import _cdtype
+        from deeplearning4j_tpu.train import faults as _faults
+
+        self._compute_dtype = _cdtype(model.cfg)
+        self._policy = _faults.active_policy(fault_policy,
+                                             self._compute_dtype)
+        self.fault_state_ = None
         # ZeRO-1 over the "data" axis (arXiv 2004.13336): updater state
         # and the weight-update compute are sharded over data-parallel
         # replicas — per-leaf here (a flat vector would destroy the
@@ -383,8 +395,34 @@ class DistributedLMTrainer:
         flat_zsh = (jax.tree_util.tree_leaves(z_sh)
                     if z_sh is not None else None)
 
-        def step(params, opt_state, ids, targets, t):
-            loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
+        policy = self._policy
+        from deeplearning4j_tpu.train import faults as _faults
+
+        scaling = (policy is not None
+                   and policy.scaling_active(self._compute_dtype))
+        do_skip = policy is not None and (policy.skip_nonfinite or scaling)
+
+        def _body(params, opt_state, fstate, ids, targets, t):
+            if scaling:
+                ls = fstate["loss_scale"]
+                loss, grads = jax.value_and_grad(
+                    lambda p, i, tg: loss_fn(p, i, tg) * ls)(
+                        params, ids, targets)
+                inv = 1.0 / ls
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+                loss = loss * inv
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, ids, targets)
+            if policy is not None:
+                # global (pre-scatter) verdict: grads are still in their
+                # synced param layout here — the ZeRO-1 "data" constraint
+                # below is what reduce-scatters them
+                grads = _faults.inject_gradient_faults(grads, t)
+                finite = _faults.all_finite(grads)
+                t_upd = fstate["good_count"] + 1
+            else:
+                finite = None
+                t_upd = t
             if clip_norm is not None:
                 gnorm = jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))
@@ -401,14 +439,28 @@ class DistributedLMTrainer:
                     # updater math runs on 1/N of each leaf, and the
                     # updated leaf all-gathers back to its param sharding
                     g = jax.lax.with_sharding_constraint(g, flat_zsh[i])
-                delta, o2 = upd.apply(g, o, t, t, 0)
+                delta, o2 = upd.apply(g, o, t_upd, t_upd, 0)
                 p2 = p - delta
                 if flat_zsh is not None:
                     p2 = jax.lax.with_sharding_constraint(p2, flat_psh[i])
                 new_p.append(p2)
                 new_o.append(o2)
-            return (jax.tree_util.tree_unflatten(treedef, new_p),
-                    jax.tree_util.tree_unflatten(treedef, new_o), loss)
+            out_p = jax.tree_util.tree_unflatten(treedef, new_p)
+            out_o = jax.tree_util.tree_unflatten(treedef, new_o)
+            if policy is None:
+                return out_p, out_o, loss
+            if do_skip:
+                out_p = _faults.where_tree(finite, out_p, params)
+                out_o = _faults.where_tree(finite, out_o, opt_state)
+            new_fstate = _faults.advance_fault_state(policy, fstate, finite)
+            return out_p, out_o, new_fstate, loss
+
+        if policy is None:
+            def step(params, opt_state, ids, targets, t):
+                return _body(params, opt_state, None, ids, targets, t)
+        else:
+            def step(params, opt_state, fstate, ids, targets, t):
+                return _body(params, opt_state, fstate, ids, targets, t)
 
         data_spec = sh(P("data", "seq")) if mesh.shape["seq"] > 1 else sh(P("data"))
         # opt-state sharding: the param shardings as a prefix tree (slot
@@ -419,13 +471,23 @@ class DistributedLMTrainer:
         from deeplearning4j_tpu.parallel.mesh import zero1_donation
 
         o_sh = z_sh if self.sharded_update else p_sh
-        self._step = jax.jit(
-            step,
-            in_shardings=(p_sh, o_sh, data_spec, data_spec, None),
-            out_shardings=(p_sh, o_sh, None),
-            donate_argnums=(zero1_donation(0, 1) if self.sharded_update
-                            else (0, 1)),
-        )
+        if policy is None:
+            self._step = jax.jit(
+                step,
+                in_shardings=(p_sh, o_sh, data_spec, data_spec, None),
+                out_shardings=(p_sh, o_sh, None),
+                donate_argnums=(zero1_donation(0, 1) if self.sharded_update
+                                else (0, 1)),
+            )
+        else:
+            repl = sh(P())
+            self._step = jax.jit(
+                step,
+                in_shardings=(p_sh, o_sh, repl, data_spec, data_spec, None),
+                out_shardings=(p_sh, o_sh, repl, None),
+                donate_argnums=(zero1_donation(0, 1) if self.sharded_update
+                                else _faults.guard_donation(0, 1)),
+            )
         return self._step
 
     def place(self):
@@ -461,14 +523,46 @@ class DistributedLMTrainer:
             self.model.opt_state_ = put(self.model.opt_state_, pspecs)
         return self
 
+    @property
+    def bad_step_count(self) -> int:
+        """Lifetime count of skipped (non-finite gradient) steps."""
+        return 0 if self.fault_state_ is None else int(
+            self.fault_state_["bad_count"])
+
+    @property
+    def loss_scale(self) -> Optional[float]:
+        if self.fault_state_ is None or "loss_scale" not in self.fault_state_:
+            return None
+        return float(self.fault_state_["loss_scale"])
+
     def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
+        from deeplearning4j_tpu.train import faults as _faults
+
         step = self.build_step()
         self.model.iteration += 1
-        with self.mesh.mesh:
-            (self.model.params_, self.model.opt_state_,
-             self.model.score_) = step(
-                self.model.params_, self.model.opt_state_,
-                jnp.asarray(ids, jnp.int32), jnp.asarray(targets, jnp.int32),
-                jnp.asarray(self.model.iteration, jnp.int32),
-            )
+        if self._policy is not None:
+            if self.fault_state_ is None:
+                self.fault_state_ = _faults.init_fault_state(
+                    self._policy,
+                    self._policy.scaling_active(self._compute_dtype),
+                    start_step=self.model.iteration - 1)
+            with self.mesh.mesh:
+                (self.model.params_, self.model.opt_state_,
+                 self.fault_state_, self.model.score_) = step(
+                    self.model.params_, self.model.opt_state_,
+                    self.fault_state_,
+                    jnp.asarray(ids, jnp.int32),
+                    jnp.asarray(targets, jnp.int32),
+                    jnp.asarray(self.model.iteration, jnp.int32),
+                )
+            _faults.check_fault_state(self._policy, self.fault_state_)
+        else:
+            with self.mesh.mesh:
+                (self.model.params_, self.model.opt_state_,
+                 self.model.score_) = step(
+                    self.model.params_, self.model.opt_state_,
+                    jnp.asarray(ids, jnp.int32),
+                    jnp.asarray(targets, jnp.int32),
+                    jnp.asarray(self.model.iteration, jnp.int32),
+                )
         return float(self.model.score_)

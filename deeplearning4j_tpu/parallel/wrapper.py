@@ -101,6 +101,9 @@ class ParallelWrapper:
         self.mesh = mesh
         self.prefetch = prefetch
         self._step = None
+        self._step_guarded = False
+        self._zstep_guarded = False
+        self._tbptt_guarded = False
         if sharded_update is None:
             sharded_update = bool(getattr(
                 model.conf.global_conf, "sharded_update", False))
@@ -110,30 +113,61 @@ class ParallelWrapper:
         # ComputationGraph train steps take per-input tuples; MLN takes arrays
         self._is_graph = hasattr(model.conf, "network_inputs")
 
-    def _build_step(self):
+    def _fault_policy(self):
+        from deeplearning4j_tpu.train import faults
+
+        return faults.active_policy(
+            getattr(self.model.conf.global_conf, "fault_policy", None),
+            self.model._compute_dtype,
+        )
+
+    def _build_step(self, guarded: bool = False):
         raw = self.model.train_step_fn()
         repl = self.mesh.replicated()
         batch = self.mesh.batch_sharded()
+        if guarded:  # extra fault-state carry after ``state`` (replicated)
+            in_sh = (repl, repl, repl, repl, batch, batch, batch, batch,
+                     repl, repl, repl)
+            out_sh = (repl, repl, repl, repl, repl)
+        else:
+            in_sh = (repl, repl, repl, batch, batch, batch, batch, repl,
+                     repl, repl)
+            out_sh = (repl, repl, repl, repl)
+        donate = (0, 1, 2)
+        if guarded:
+            from deeplearning4j_tpu.train.faults import guard_donation
+
+            donate = guard_donation(0, 1, 2)
         self._step = jax.jit(
-            raw,
-            in_shardings=(repl, repl, repl, batch, batch, batch, batch, repl, repl, repl),
-            out_shardings=(repl, repl, repl, repl),
-            donate_argnums=(0, 1, 2),
+            raw, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=donate,
         )
+        self._step_guarded = guarded
         return self._step
 
-    def _build_tbptt_step(self):
+    def _build_tbptt_step(self, guarded: bool = False):
         raw = self.model.tbptt_step_fn()
         repl = self.mesh.replicated()
         batch = self.mesh.batch_sharded()
-        # args: params, opt, state, carries, f, l, fm, lm, rng, it, ep
+        # args: params, opt, state, [fstate,] carries, f, l, fm, lm, rng, it, ep
+        if guarded:
+            in_sh = (repl, repl, repl, repl, batch, batch, batch, batch,
+                     batch, repl, repl, repl)
+            out_sh = (repl, repl, repl, repl, batch, repl)
+        else:
+            in_sh = (repl, repl, repl, batch, batch, batch, batch, batch,
+                     repl, repl, repl)
+            out_sh = (repl, repl, repl, batch, repl)
+        donate = (0, 1, 2)
+        if guarded:
+            from deeplearning4j_tpu.train.faults import guard_donation
+
+            donate = guard_donation(0, 1, 2)
         self._tbptt_step = jax.jit(
-            raw,
-            in_shardings=(repl, repl, repl, batch, batch, batch, batch, batch,
-                          repl, repl, repl),
-            out_shardings=(repl, repl, repl, batch, repl),
-            donate_argnums=(0, 1, 2),
+            raw, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=donate,
         )
+        self._tbptt_guarded = guarded
         return self._tbptt_step
 
     def fit(self, it: DataSetIterator, epochs: int = 1) -> None:
@@ -147,6 +181,10 @@ class ParallelWrapper:
                 "ParallelWrapper tBPTT is supported for MultiLayerNetwork; "
                 "fit the ComputationGraph directly"
             )
+        policy = self._fault_policy()
+        guarded = policy is not None
+        if guarded:
+            m._ensure_fault_state(policy)
         zopt = None
         if self.sharded_update:
             if use_tbptt:
@@ -160,9 +198,15 @@ class ParallelWrapper:
                 unshard_model_opt_state,
             )
 
-            if self._zstep is None:
+            # key the cached step on the POLICY, not just guardedness: a
+            # policy swapped between fits changes the traced schedule
+            # constants (and possibly the fstate structure)
+            if self._zstep is None or self._zstep_guarded != guarded \
+                    or getattr(self, "_zstep_policy", None) != policy:
                 self._zstep, self._zlayout = make_sharded_train_step(
-                    m, self.mesh)
+                    m, self.mesh, policy=policy)
+                self._zstep_guarded = guarded
+                self._zstep_policy = policy
             step = self._zstep
             zopt = shard_model_opt_state(m, self._zlayout,
                                          mesh=self.mesh.mesh)
@@ -175,7 +219,11 @@ class ParallelWrapper:
             m._opt_state_sync = (
                 lambda: unshard_model_opt_state(m, zlayout, zref[0]))
         else:
-            step = self._step or self._build_step()
+            if self._step is None or self._step_guarded != guarded \
+                    or getattr(self, "_step_policy", None) != policy:
+                self._build_step(guarded=guarded)
+                self._step_policy = policy
+            step = self._step
         n_data = self.mesh.n_data
         zopt_valid = True
         try:
@@ -200,12 +248,21 @@ class ParallelWrapper:
                             # are gone and must not be gathered (batch
                             # packing above raising leaves zopt intact)
                             zopt_valid = zopt is None
-                            new_p, new_o, m.state_, m.score_ = step(
-                                m.params_, opt_in, m.state_,
-                                *batch, rng,
-                                jnp.asarray(m.iteration, jnp.int32),
-                                jnp.asarray(m.epoch, jnp.int32),
-                            )
+                            if guarded:
+                                (new_p, new_o, m.state_, m.fault_state_,
+                                 m.score_) = step(
+                                    m.params_, opt_in, m.state_,
+                                    m.fault_state_, *batch, rng,
+                                    jnp.asarray(m.iteration, jnp.int32),
+                                    jnp.asarray(m.epoch, jnp.int32),
+                                )
+                            else:
+                                new_p, new_o, m.state_, m.score_ = step(
+                                    m.params_, opt_in, m.state_,
+                                    *batch, rng,
+                                    jnp.asarray(m.iteration, jnp.int32),
+                                    jnp.asarray(m.epoch, jnp.int32),
+                                )
                             m.params_ = new_p
                             if zopt is not None:
                                 zopt = new_o
@@ -214,6 +271,13 @@ class ParallelWrapper:
                             if zopt is None:
                                 m.opt_state_ = new_o
                             m.iteration += 1
+                            if guarded:
+                                from deeplearning4j_tpu.train import (
+                                    faults as _faults,
+                                )
+
+                                _faults.check_fault_state(
+                                    policy, m.fault_state_)
                             for lst in m.listeners:
                                 lst.iteration_done(m, m.iteration, m.epoch)
                 finally:
@@ -240,7 +304,16 @@ class ParallelWrapper:
         data axis, params replicated (reference ParallelWrapper trains
         tBPTT configs transparently; round-1/2 gap closed)."""
         m = self.model
-        step = getattr(self, "_tbptt_step", None) or self._build_tbptt_step()
+        policy = self._fault_policy()
+        guarded = policy is not None
+        if (getattr(self, "_tbptt_step", None) is None
+                or self._tbptt_guarded != guarded
+                or getattr(self, "_tbptt_policy", None) != policy):
+            self._build_tbptt_step(guarded=guarded)
+            self._tbptt_policy = policy
+        step = self._tbptt_step
+        if guarded:
+            m._ensure_fault_state(policy)
         if ds.features.shape[0] % n_data:
             ds = _pad_batch(ds, n_data)
         if ds.labels is not None and ds.labels.ndim != 3:
@@ -256,13 +329,27 @@ class ParallelWrapper:
             l = None if ds.labels is None else jnp.asarray(ds.labels[:, lo:hi])
             fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, lo:hi])
             lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, lo:hi])
-            (m.params_, m.opt_state_, m.state_, carries, m.score_) = step(
-                m.params_, m.opt_state_, m.state_, carries, f, l, fm, lm,
-                m._next_rng(),
-                jnp.asarray(m.iteration, jnp.int32),
-                jnp.asarray(m.epoch, jnp.int32),
-            )
+            if guarded:
+                (m.params_, m.opt_state_, m.state_, m.fault_state_, carries,
+                 m.score_) = step(
+                    m.params_, m.opt_state_, m.state_, m.fault_state_,
+                    carries, f, l, fm, lm,
+                    m._next_rng(),
+                    jnp.asarray(m.iteration, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
+            else:
+                (m.params_, m.opt_state_, m.state_, carries, m.score_) = step(
+                    m.params_, m.opt_state_, m.state_, carries, f, l, fm, lm,
+                    m._next_rng(),
+                    jnp.asarray(m.iteration, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
         m.iteration += 1
+        if guarded:
+            from deeplearning4j_tpu.train import faults as _faults
+
+            _faults.check_fault_state(policy, m.fault_state_)
         for lst in m.listeners:
             lst.iteration_done(m, m.iteration, m.epoch)
 

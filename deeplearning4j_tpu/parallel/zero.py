@@ -292,52 +292,113 @@ def unshard_model_opt_state(model, layout: ShardedUpdateLayout,
                         else dict(zip(names, merged)))
 
 
-def make_sharded_train_step(model, mesh):
+def make_sharded_train_step(model, mesh, policy=None):
     """Jitted ZeRO-1 DP train step over ``mesh`` (a TrainingMesh).
 
     Same signature as the replicated step the wrapper/multihost facade
     jit today, except the opt-state argument/result is the SHARDED
     per-group layout (in/out shardings P("data", None)). Returns
     (step, layout).
+
+    With a FaultPolicy (train/faults.py) the step takes a fault-state
+    carry after ``state`` and returns it updated: the all-finite verdict
+    is computed on the GLOBAL gradient — before the P("data", None)
+    sharding constraint that triggers the reduce-scatter — so it is a
+    replicated scalar and every replica takes the same skip/apply branch
+    (per-shard verdicts could disagree and desynchronize the replicas
+    forever). Loss scaling runs on the fp32 masters exactly as in the
+    replicated guarded step, keeping sharded-vs-replicated parity.
     """
     names, layers, params = _model_layer_view(model)
     layout = ShardedUpdateLayout(layers, params, mesh.n_data)
     remat_policy = _resolve_remat_policy(
         getattr(model.conf.global_conf, "remat_policy", None))
 
-    def step(params, zopt, state, features, labels, fmask, lmask, rng,
-             iteration, epoch):
+    from deeplearning4j_tpu.train import faults as _faults
+
+    scaling = (policy is not None
+               and policy.scaling_active(model._compute_dtype))
+    do_skip = policy is not None and (policy.skip_nonfinite or scaling)
+
+    def _body(params, zopt, state, fstate, features, labels, fmask, lmask,
+              rng, iteration, epoch):
+        scale = fstate["loss_scale"] if scaling else None
+
         def loss_fn(p):
             loss, new_states = model._loss_and_new_state(
                 p, state, features, labels, fmask, lmask, rng, train=True)
+            if scaling:
+                loss = loss * scale
             return loss, new_states
 
         if remat_policy is not None:
             loss_fn = jax.checkpoint(loss_fn, policy=remat_policy)
         (loss, new_states), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        t = iteration + 1
+        if scaling:
+            inv = 1.0 / scale
+            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            loss = loss * inv
+        if policy is not None:
+            # verdict on the global (pre-scatter) gradient: grads here are
+            # the logically synchronized values, so the reduction yields a
+            # replicated scalar all replicas agree on
+            grads = _faults.inject_gradient_faults(grads, iteration)
+            finite = _faults.all_finite(grads)
+            t = fstate["good_count"] + 1
+            it_upd = fstate["good_count"]
+        else:
+            finite = None
+            t = iteration + 1
+            it_upd = iteration
         if names is not None:
             p_list = [params[n] for n in names]
             g_list = [grads[n] for n in names]
         else:
             p_list, g_list = params, grads
         np_list, new_zopt = apply_sharded_updates(
-            layout, p_list, g_list, zopt, t, iteration, epoch,
+            layout, p_list, g_list, zopt, t, it_upd, epoch,
             mesh=mesh.mesh)
         new_params = (dict(zip(names, np_list)) if names is not None
                       else np_list)
         score = loss + model._reg_score(params)
-        return new_params, new_zopt, new_states, score
+        if policy is None:
+            return new_params, new_zopt, new_states, score
+        if do_skip:
+            new_params = _faults.where_tree(finite, new_params, params)
+            new_zopt = _faults.where_tree(finite, new_zopt, zopt)
+            new_states = _faults.where_tree(finite, new_states, state)
+        new_fstate = _faults.advance_fault_state(policy, fstate, finite)
+        return new_params, new_zopt, new_states, new_fstate, score
 
     repl = mesh.replicated()
     batch = mesh.batch_sharded()
     zshard = NamedSharding(mesh.mesh, P("data", None))
+    if policy is None:
+        def step(params, zopt, state, features, labels, fmask, lmask, rng,
+                 iteration, epoch):
+            return _body(params, zopt, state, None, features, labels, fmask,
+                         lmask, rng, iteration, epoch)
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(repl, zshard, repl, batch, batch, batch, batch,
+                          repl, repl, repl),
+            out_shardings=(repl, zshard, repl, repl),
+            donate_argnums=zero1_donation(0, 1, 2),
+        )
+        return jitted, layout
+
+    def gstep(params, zopt, state, fstate, features, labels, fmask, lmask,
+              rng, iteration, epoch):
+        return _body(params, zopt, state, fstate, features, labels, fmask,
+                     lmask, rng, iteration, epoch)
+
     jitted = jax.jit(
-        step,
-        in_shardings=(repl, zshard, repl, batch, batch, batch, batch,
+        gstep,
+        in_shardings=(repl, zshard, repl, repl, batch, batch, batch, batch,
                       repl, repl, repl),
-        out_shardings=(repl, zshard, repl, repl),
+        out_shardings=(repl, zshard, repl, repl, repl),
         donate_argnums=zero1_donation(0, 1, 2),
     )
     return jitted, layout

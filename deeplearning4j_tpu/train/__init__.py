@@ -11,6 +11,16 @@ from deeplearning4j_tpu.train.listeners import (
     TrainingListener,
 )
 
+from deeplearning4j_tpu.train.faults import (
+    FaultPolicy,
+    TrainingDivergedError,
+    fault_injection,
+    latest_valid_checkpoint,
+    load_latest_valid,
+    prune_checkpoints,
+    save_checkpoint,
+    validate_checkpoint,
+)
 from deeplearning4j_tpu.train.model_serializer import ModelGuesser, ModelSerializer
 from deeplearning4j_tpu.train.orbax_serializer import OrbaxModelSerializer
 
@@ -19,4 +29,7 @@ __all__ = [
     "CollectScoresIterationListener", "EvaluativeListener", "CheckpointListener",
     "TimeIterationListener", "SleepyTrainingListener",
     "ModelSerializer", "ModelGuesser", "OrbaxModelSerializer",
+    "FaultPolicy", "TrainingDivergedError", "fault_injection",
+    "latest_valid_checkpoint", "load_latest_valid", "prune_checkpoints",
+    "save_checkpoint", "validate_checkpoint",
 ]

@@ -304,10 +304,15 @@ class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
         self._start = None
 
     def initialize(self):
+        # EarlyStoppingTrainer.fit() calls this BEFORE the first epoch, so
+        # setup/jit-compile time ahead of iteration 1 counts against the
+        # time budget (tests/test_fault_tolerance.py pins this down)
         self._start = time.monotonic()
 
     def terminate(self, last_score):
         if self._start is None:
+            # standalone use without a trainer: fall back to first-call
+            # arming (the trainer path never hits this)
             self._start = time.monotonic()
         return time.monotonic() - self._start > self.max_time_seconds
 
@@ -579,6 +584,21 @@ class EarlyStoppingTrainer:
                     score = sc.calculate_score(self.model)
                     last_score = score
                     score_vs_epoch[epoch] = score
+                    if math.isnan(score):
+                        # a NaN epoch score can never improve on best
+                        # (NaN < best is False), so the loop would spin to
+                        # MaxEpochs without ever saving a model — surface
+                        # it as an error termination instead (reference
+                        # EarlyStoppingTrainer invalid-score semantics)
+                        reason = "Error"
+                        details = (
+                            f"Invalid (NaN) epoch score from "
+                            f"{type(sc).__name__} at epoch {epoch} — "
+                            "empty/exhausted evaluation iterator or "
+                            "diverged model"
+                        )
+                        epoch += 1
+                        break
                     improved = score < best_score if minimize else score > best_score
                     if improved:
                         best_score = score

@@ -1,0 +1,465 @@
+"""Step-level training fault tolerance.
+
+Three pillars (TensorFlow system paper, arXiv 1605.08695 §4.3, treats the
+triad as table stakes; the reference's production posture — updater state
+in the checkpoint, ``InvalidScoreIterationTerminationCondition`` — assumes
+it exists):
+
+1. **In-graph non-finite guard** — a global all-finite verdict over the
+   synchronized gradient folded into the jitted train step. The update is
+   applied through ``jnp.where`` on the scalar verdict, so a bad batch
+   skips the weight/updater-state update while params, opt state and layer
+   state pass through bit-identical — with NO per-step host sync (the
+   verdict never leaves the device unless ``max_consecutive_bad_steps``
+   is armed). Under the ZeRO-1 sharded update the verdict is computed on
+   the GLOBAL (pre-reduce-scatter) gradient so every replica agrees.
+
+2. **Dynamic loss scaling** — for ``compute_dtype`` mixed precision the
+   loss is multiplied by a scale carried in the fault state, gradients
+   are unscaled inside the step, the scale halves on overflow (the
+   overflowed step is skipped) and grows ×``scale_growth`` after
+   ``scale_growth_interval`` consecutive good steps. All in-graph.
+
+3. **Crash-safe checkpointing** — ``ModelSerializer.write_model`` stages
+   through a same-directory temp file and ``os.replace``s it into place
+   (a SIGKILL mid-write never corrupts the visible checkpoint), plus a
+   keep-last-k retention policy and ``load_latest_valid`` that detects
+   truncated/corrupt zips (CRC + required-entry check) and falls back to
+   the previous good checkpoint.
+
+The step counter subtlety: a skipped step must not advance the updater's
+bias-correction time ``t`` or the schedule iteration, otherwise "fit with
+a NaN batch skipped" diverges from "fit with that batch removed" (Adam's
+``1-beta^t`` terms would shift). The guarded steps therefore drive the
+updater from the in-graph ``good_count`` carried in the fault state, not
+from the host iteration counter (which keeps counting every batch seen,
+skipped or not, for reporting parity with the reference).
+
+Fault injection (tests/chaos drills): ``fault_injection(nan_grad_steps=…)``
+bakes a deterministic "gradients become NaN at host iteration k" fault
+into steps traced while it is active; ``truncate_file`` chops a checkpoint
+mid-zip. Both are no-ops in production paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import uuid
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when ``max_consecutive_bad_steps`` non-finite gradient steps
+    occur back to back — the run is diverging, not hitting stray bad
+    batches, and silently skipping forever would mask it."""
+
+
+# --------------------------------------------------------------------------
+# policy
+# --------------------------------------------------------------------------
+class FaultPolicy:
+    """Training fault-tolerance configuration, carried on
+    ``GlobalConf.fault_policy`` (JSON round-trips with the network conf).
+
+    - ``skip_nonfinite``: skip the weight update on a non-finite global
+      gradient instead of poisoning the parameters.
+    - ``max_consecutive_bad_steps``: raise :class:`TrainingDivergedError`
+      after this many back-to-back skipped steps (None = never; checking
+      costs one host sync per step, so it is opt-in).
+    - ``loss_scaling``: dynamic loss scaling. None (default) auto-enables
+      exactly when the model trains with a reduced ``compute_dtype``;
+      True/False force it.
+    - ``init_loss_scale`` / ``scale_growth_interval`` / ``scale_backoff``
+      / ``scale_growth`` / ``min_loss_scale`` / ``max_loss_scale``: the
+      loss-scale schedule (halve on overflow, grow after N good steps).
+    - ``keep_last``: checkpoint retention for :func:`save_checkpoint`
+      (None = keep everything).
+    """
+
+    def __init__(
+        self,
+        skip_nonfinite: bool = True,
+        max_consecutive_bad_steps: Optional[int] = None,
+        loss_scaling: Optional[bool] = None,
+        init_loss_scale: float = 2.0 ** 15,
+        scale_growth_interval: int = 200,
+        scale_backoff: float = 0.5,
+        scale_growth: float = 2.0,
+        min_loss_scale: float = 1.0,
+        max_loss_scale: float = 2.0 ** 24,
+        keep_last: Optional[int] = None,
+    ):
+        self.skip_nonfinite = bool(skip_nonfinite)
+        self.max_consecutive_bad_steps = (
+            None if max_consecutive_bad_steps is None
+            else int(max_consecutive_bad_steps))
+        self.loss_scaling = loss_scaling if loss_scaling is None else bool(
+            loss_scaling)
+        self.init_loss_scale = float(init_loss_scale)
+        self.scale_growth_interval = int(scale_growth_interval)
+        self.scale_backoff = float(scale_backoff)
+        self.scale_growth = float(scale_growth)
+        self.min_loss_scale = float(min_loss_scale)
+        self.max_loss_scale = float(max_loss_scale)
+        self.keep_last = None if keep_last is None else int(keep_last)
+
+    # -- activation ---------------------------------------------------------
+    def scaling_active(self, compute_dtype) -> bool:
+        """Loss scaling applies iff forced on, or (by default) the model
+        computes in a reduced dtype (bf16/fp16 backward can overflow)."""
+        if self.loss_scaling is not None:
+            return self.loss_scaling
+        return compute_dtype is not None
+
+    def guard_active(self, compute_dtype) -> bool:
+        return (self.skip_nonfinite
+                or self.max_consecutive_bad_steps is not None
+                or self.scaling_active(compute_dtype))
+
+    # -- serde (mirrors nn/conf/serde generic contract) ----------------------
+    def to_dict(self) -> dict:
+        return {
+            "@class": "FaultPolicy",
+            "skip_nonfinite": self.skip_nonfinite,
+            "max_consecutive_bad_steps": self.max_consecutive_bad_steps,
+            "loss_scaling": self.loss_scaling,
+            "init_loss_scale": self.init_loss_scale,
+            "scale_growth_interval": self.scale_growth_interval,
+            "scale_backoff": self.scale_backoff,
+            "scale_growth": self.scale_growth,
+            "min_loss_scale": self.min_loss_scale,
+            "max_loss_scale": self.max_loss_scale,
+            "keep_last": self.keep_last,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPolicy":
+        return cls(**{k: v for k, v in d.items() if not k.startswith("@")})
+
+    def __eq__(self, other):
+        return isinstance(other, FaultPolicy) and self.to_dict() == other.to_dict()
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.to_dict().items()
+                  if not k.startswith("@")}
+        return f"FaultPolicy({fields})"
+
+
+def _register_serde():
+    from deeplearning4j_tpu.nn.conf import serde
+
+    serde.register(FaultPolicy)
+
+
+_register_serde()
+
+
+def active_policy(policy: Optional[FaultPolicy], compute_dtype
+                  ) -> Optional[FaultPolicy]:
+    """The policy iff its guard has anything to do for this model."""
+    if policy is None or not policy.guard_active(compute_dtype):
+        return None
+    return policy
+
+
+# --------------------------------------------------------------------------
+# in-graph fault state
+# --------------------------------------------------------------------------
+def init_fault_state(policy: FaultPolicy, scaling: bool,
+                     start_step: int = 0) -> Dict[str, Array]:
+    """Device-resident scalar carry for the guarded steps. ``good_count``
+    seeds from the model's iteration counter so a checkpoint-resumed run
+    keeps its Adam bias-correction clock."""
+    st = {
+        "bad_count": jnp.zeros((), jnp.int32),
+        "consec": jnp.zeros((), jnp.int32),
+        "good_count": jnp.asarray(int(start_step), jnp.int32),
+    }
+    if scaling:
+        st["loss_scale"] = jnp.asarray(policy.init_loss_scale, jnp.float32)
+        st["scale_good"] = jnp.zeros((), jnp.int32)
+    return st
+
+
+def all_finite(tree) -> Array:
+    """Scalar bool: every element of every floating leaf is finite.
+    Traced over the logical (globally synchronized) values, so under
+    GSPMD the verdict is replicated and all shards agree by
+    construction."""
+    oks = [jnp.all(jnp.isfinite(leaf))
+           for leaf in jax.tree_util.tree_leaves(tree)
+           if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    if not oks:
+        return jnp.asarray(True)
+    return functools.reduce(jnp.logical_and, oks)
+
+
+def guard_donation(*argnums) -> tuple:
+    """Buffer donation for GUARDED train steps — every guarded step reads
+    its old params/opt-state/layer-state into a ``jnp.where(verdict, new,
+    old)`` select, so donated inputs are both read late and aliased to
+    outputs. On real accelerators XLA sequences that correctly and
+    donation stays on (the standard training-loop memory optimization).
+    XLA:CPU miscompiles this aliasing pattern under heap pressure
+    (observed as bad_alloc/segfaults once enough programs are live —
+    the same backend bug class parallel/mesh.zero1_donation documents
+    for the ZeRO-1 repl→shard→repl path), so donation is disabled
+    there."""
+    if jax.default_backend() == "cpu":
+        return ()
+    return tuple(argnums)
+
+
+def where_tree(pred, new, old):
+    """Elementwise select between two identically-structured pytrees —
+    the skip mechanism (no branch, no host sync, sharding-preserving)."""
+    return jax.tree_util.tree_map(lambda n, o: jnp.where(pred, n, o),
+                                  new, old)
+
+
+def advance_fault_state(policy: FaultPolicy, fstate: Dict[str, Array],
+                        finite: Array) -> Dict[str, Array]:
+    """Next fault-state carry given this step's verdict."""
+    fin_i = finite.astype(jnp.int32)
+    new = {
+        "bad_count": fstate["bad_count"] + (1 - fin_i),
+        "consec": jnp.where(finite, jnp.int32(0), fstate["consec"] + 1),
+        "good_count": fstate["good_count"] + fin_i,
+    }
+    if "loss_scale" in fstate:
+        scale, good = fstate["loss_scale"], fstate["scale_good"]
+        grown = (good + 1) >= policy.scale_growth_interval
+        up = jnp.minimum(scale * policy.scale_growth, policy.max_loss_scale)
+        down = jnp.maximum(scale * policy.scale_backoff,
+                           policy.min_loss_scale)
+        new["loss_scale"] = jnp.where(finite,
+                                      jnp.where(grown, up, scale), down)
+        new["scale_good"] = jnp.where(jnp.logical_and(finite, ~grown),
+                                      good + 1, jnp.int32(0))
+    return new
+
+
+def check_fault_state(policy: Optional[FaultPolicy],
+                      fstate: Optional[Dict[str, Array]]) -> None:
+    """Host-side divergence tripwire. Costs one device sync, so it only
+    runs when ``max_consecutive_bad_steps`` is armed."""
+    if (policy is None or fstate is None
+            or policy.max_consecutive_bad_steps is None):
+        return
+    consec = int(fstate["consec"])
+    if consec >= policy.max_consecutive_bad_steps:
+        raise TrainingDivergedError(
+            f"{consec} consecutive non-finite gradient steps (limit "
+            f"max_consecutive_bad_steps={policy.max_consecutive_bad_steps}) "
+            "— training is diverging; lower the learning rate, check the "
+            "data pipeline, or restore the last checkpoint"
+        )
+
+
+# --------------------------------------------------------------------------
+# deterministic fault injection (test/chaos hook)
+# --------------------------------------------------------------------------
+_INJECT_NAN_STEPS: frozenset = frozenset()
+
+
+def set_fault_injection(nan_grad_steps: Sequence[int] = ()) -> frozenset:
+    """Arm the gradient-NaN injector for steps traced from now on; returns
+    the previous setting. Steps are HOST iteration numbers (the
+    ``iteration`` argument of the train step)."""
+    global _INJECT_NAN_STEPS
+    prev = _INJECT_NAN_STEPS
+    _INJECT_NAN_STEPS = frozenset(int(s) for s in nan_grad_steps)
+    return prev
+
+
+@contextlib.contextmanager
+def fault_injection(nan_grad_steps: Sequence[int] = ()):
+    prev = set_fault_injection(nan_grad_steps)
+    try:
+        yield
+    finally:
+        global _INJECT_NAN_STEPS
+        _INJECT_NAN_STEPS = prev
+
+
+def inject_gradient_faults(grads, iteration):
+    """Replace every gradient with NaN at the armed host iterations.
+    Reads the injection registry at TRACE time — steps built outside a
+    ``fault_injection`` context compile to an identity, and a step
+    COMPILED inside one keeps its poison after the context exits (train
+    steps are cached on the model/facade). Chaos drills must therefore
+    use a fresh model (or cleared jit caches) per armed context; never
+    arm injection around a model that will keep training."""
+    if not _INJECT_NAN_STEPS:
+        return grads
+    it = jnp.asarray(iteration, jnp.int32)
+    bad = functools.reduce(
+        jnp.logical_or, [it == s for s in sorted(_INJECT_NAN_STEPS)])
+
+    def poison(g):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        return jnp.where(bad, jnp.asarray(jnp.nan, jnp.asarray(g).dtype), g)
+
+    return jax.tree_util.tree_map(poison, grads)
+
+
+def truncate_file(path: str, frac: float = 0.5) -> int:
+    """Chop a file to ``frac`` of its size (fault injection: the on-disk
+    state a crash mid-write would have left WITHOUT atomic replace).
+    Returns the new size."""
+    size = os.path.getsize(path)
+    keep = max(int(size * frac), 1)
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return keep
+
+
+# --------------------------------------------------------------------------
+# crash-safe checkpoint directory management
+# --------------------------------------------------------------------------
+_TMP_MARKER = ".tmp-"
+
+
+def atomic_tmp_path(path: str) -> str:
+    """Same-directory staging name for an atomic ``os.replace`` publish
+    (rename is only atomic within a filesystem)."""
+    return f"{path}{_TMP_MARKER}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+
+
+def validate_checkpoint(path: str) -> Tuple[bool, str]:
+    """(ok, reason). Detects truncation (zip central directory gone),
+    CRC corruption, and zips that are not model checkpoints (required
+    entries missing)."""
+    from deeplearning4j_tpu.train.model_serializer import (
+        COEFFICIENTS_ENTRY,
+        CONFIG_ENTRY,
+    )
+
+    if not os.path.isfile(path):
+        return False, "not a file"
+    try:
+        with zipfile.ZipFile(path, "r") as z:
+            names = set(z.namelist())
+            missing = {CONFIG_ENTRY, COEFFICIENTS_ENTRY} - names
+            if missing:
+                return False, (f"missing checkpoint entries {sorted(missing)}"
+                               f" (found {sorted(names)})")
+            bad = z.testzip()  # CRC over every member
+            if bad is not None:
+                return False, f"CRC mismatch in entry {bad!r}"
+    except (zipfile.BadZipFile, OSError, EOFError) as e:
+        return False, f"unreadable zip ({type(e).__name__}: {e})"
+    return True, "ok"
+
+
+def is_valid_checkpoint(path: str) -> bool:
+    return validate_checkpoint(path)[0]
+
+
+def checkpoint_files(directory: str) -> List[str]:
+    """Checkpoint candidates in ``directory``, oldest → newest
+    (mtime, then name). Staging temp files from in-flight or crashed
+    atomic writes are never candidates."""
+    out = []
+    for name in os.listdir(directory):
+        if _TMP_MARKER in name:
+            continue
+        if not name.endswith((".zip", ".bin")):
+            continue
+        p = os.path.join(directory, name)
+        try:
+            # stat now, not in the sort key: a concurrent prune may
+            # delete entries between listdir and the sort
+            mtime = os.path.getmtime(p)
+        except OSError:
+            continue
+        if os.path.isfile(p):
+            out.append((mtime, p))
+    return [p for _, p in sorted(out)]
+
+
+_TMP_SWEEP_AGE_S = 900.0  # staging files older than this are crash debris
+
+
+def prune_checkpoints(directory: str, keep_last: Optional[int]
+                      ) -> List[str]:
+    """Delete all but the newest ``keep_last`` checkpoints; returns the
+    removed paths. Staging temp files are swept only once they are
+    clearly crash debris (older than ``_TMP_SWEEP_AGE_S``) — a younger
+    one may belong to a concurrent writer about to os.replace it."""
+    import time
+
+    removed: List[str] = []
+    now = time.time()
+    for name in os.listdir(directory):
+        if _TMP_MARKER in name:
+            p = os.path.join(directory, name)
+            try:
+                if now - os.path.getmtime(p) > _TMP_SWEEP_AGE_S:
+                    os.remove(p)
+                    removed.append(p)
+            except OSError:
+                pass
+    if keep_last is None:
+        return removed
+    files = checkpoint_files(directory)
+    for p in files[: max(len(files) - int(keep_last), 0)]:
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed
+
+
+def save_checkpoint(model, directory: str, keep_last: Optional[int] = None,
+                    stem: Optional[str] = None) -> str:
+    """Atomic write of ``model`` into ``directory`` with keep-last-k
+    retention; returns the checkpoint path."""
+    from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+
+    os.makedirs(directory, exist_ok=True)
+    name = (stem or f"checkpoint_iter_{int(model.iteration):08d}") + ".zip"
+    path = os.path.join(directory, name)
+    ModelSerializer.write_model(model, path, save_updater=True)
+    prune_checkpoints(directory, keep_last)
+    return path
+
+
+def latest_valid_checkpoint(directory: str) -> str:
+    """Newest checkpoint in ``directory`` that passes validation,
+    warning about (and skipping over) corrupt/truncated newer ones.
+    Raises FileNotFoundError when no valid checkpoint exists."""
+    import warnings
+
+    candidates = checkpoint_files(directory)
+    if not candidates:
+        raise FileNotFoundError(f"no checkpoints in {directory!r}")
+    for path in reversed(candidates):
+        ok, reason = validate_checkpoint(path)
+        if ok:
+            return path
+        warnings.warn(
+            f"skipping corrupt checkpoint {path!r}: {reason}; "
+            "falling back to the previous one", stacklevel=2)
+    raise FileNotFoundError(
+        f"no VALID checkpoint in {directory!r} "
+        f"({len(candidates)} candidates, all corrupt)")
+
+
+def load_latest_valid(directory: str):
+    """Restore the newest valid checkpoint in ``directory`` (model type
+    sniffed from the zip); returns ``(model, path)``."""
+    from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+    path = latest_valid_checkpoint(directory)
+    return ModelGuesser.load_model_guess(path), path

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 from typing import Optional
 
@@ -33,6 +34,7 @@ class ModelSerializer:
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True, normalizer=None) -> None:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.train.faults import atomic_tmp_path
 
         # during a ZeRO-1 sharded fit the live opt state is sharded and
         # model.opt_state_ is stale; the runtime installs this hook to
@@ -40,28 +42,45 @@ class ModelSerializer:
         sync = getattr(model, "_opt_state_sync", None)
         if sync is not None:
             sync()
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr(CONFIG_ENTRY, model.conf.to_json())
-            z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
-            if save_updater and model.opt_state_ is not None:
-                z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
-            state_flat = _flatten_state(model.state_)
-            z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
-            z.writestr(
-                META_ENTRY,
-                json.dumps({
-                    "iteration": model.iteration,
-                    "epoch": model.epoch,
-                    "model_type": type(model).__name__,
-                    "framework": "deeplearning4j_tpu",
-                }),
-            )
-            if normalizer is not None:
-                z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
+        # crash-safe: stage into a same-directory temp file and publish
+        # with an atomic rename — a crash/SIGKILL mid-write leaves the
+        # previous checkpoint at ``path`` untouched, never a torn zip
+        tmp = atomic_tmp_path(path)
+        try:
+            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+                z.writestr(CONFIG_ENTRY, model.conf.to_json())
+                z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
+                if save_updater and model.opt_state_ is not None:
+                    z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
+                state_flat = _flatten_state(model.state_)
+                z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
+                z.writestr(
+                    META_ENTRY,
+                    json.dumps({
+                        "iteration": model.iteration,
+                        "epoch": model.epoch,
+                        "model_type": type(model).__name__,
+                        "framework": "deeplearning4j_tpu",
+                    }),
+                )
+                if normalizer is not None:
+                    z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
 
     @staticmethod
     def _restore(path: str, conf_cls, net_cls, load_updater: bool):
         with zipfile.ZipFile(path, "r") as z:
+            names = set(z.namelist())
+            missing = {CONFIG_ENTRY, COEFFICIENTS_ENTRY} - names
+            if missing:
+                raise ValueError(
+                    f"{path!r} is not a model checkpoint: required entries "
+                    f"{sorted(missing)} are missing (zip contains "
+                    f"{sorted(names)})"
+                )
             conf = conf_cls.from_json(z.read(CONFIG_ENTRY).decode())
             net = net_cls(conf, copy_conf=False)  # conf is ours alone
             net.init()
@@ -156,14 +175,23 @@ class ModelGuesser:
 
     @staticmethod
     def load_model_guess(path: str):
-        with zipfile.ZipFile(path, "r") as z:
-            names = z.namelist()
-            if CONFIG_ENTRY in names:
-                meta = {}
-                if META_ENTRY in names:
-                    meta = json.loads(z.read(META_ENTRY).decode())
-                model_type = meta.get("model_type", "MultiLayerNetwork")
-                if model_type == "ComputationGraph":
-                    return ModelSerializer.restore_computation_graph(path)
-                return ModelSerializer.restore_multi_layer_network(path)
-        raise ValueError(f"Cannot identify model format for {path}")
+        try:
+            with zipfile.ZipFile(path, "r") as z:
+                names = z.namelist()
+                meta = (json.loads(z.read(META_ENTRY).decode())
+                        if META_ENTRY in names else {})
+        except zipfile.BadZipFile as e:
+            raise ValueError(
+                f"Cannot identify model format for {path!r}: not a readable "
+                f"zip ({e})"
+            ) from e
+        if CONFIG_ENTRY in names and COEFFICIENTS_ENTRY in names:
+            model_type = meta.get("model_type", "MultiLayerNetwork")
+            if model_type == "ComputationGraph":
+                return ModelSerializer.restore_computation_graph(path)
+            return ModelSerializer.restore_multi_layer_network(path)
+        raise ValueError(
+            f"Cannot identify model format for {path!r}: expected checkpoint "
+            f"entries [{CONFIG_ENTRY!r}, {COEFFICIENTS_ENTRY!r}] but the zip "
+            f"contains {sorted(names)}"
+        )

@@ -4,11 +4,16 @@ SURVEY §4.5). Three phases, selected by argv[5]:
 
   full    uninterrupted reference: epoch 1 + checkpoint + epoch 2,
           dump final params
-  crash   epoch 1 + checkpoint, then epoch 2 with slowed batches; the
-          PARENT SIGKILLs worker 1 mid-epoch — worker 0 must then die
-          too (collective peer loss), never reaching the final dump
-  resume  fresh pair restores the crash phase's checkpoint and runs
-          epoch 2; final params must equal the `full` run's bit-for-bit
+  crash   epoch 1 + TWO checkpoints (ft_ckpt_a.zip then ft_ckpt_b.zip,
+          same state), then epoch 2 with slowed batches; the PARENT
+          SIGKILLs worker 1 mid-epoch — worker 0 must then die too
+          (collective peer loss), never reaching the final dump. The
+          parent then TRUNCATES the newest checkpoint (ft_ckpt_b.zip),
+          simulating a crash mid-write without atomic replace.
+  resume  fresh pair restores via train.faults.latest_valid_checkpoint —
+          which must skip the truncated newest zip and fall back to
+          ft_ckpt_a.zip — and runs epoch 2; final params must equal the
+          `full` run's bit-for-bit
 
 Usage: ... <coordinator> <nprocs> <pid> <outdir> <phase>
 """
@@ -64,15 +69,21 @@ class SlowIterator(DataSetIterator):
         return self.base.batch()
 
 
+from deeplearning4j_tpu.train import faults  # noqa: E402
+
 ctx = initialize(coordinator, num_processes=nprocs, process_id=pid)
 net = build_net()
 facade = MultiHostNetwork(net, ParameterAveragingTrainingMaster(), ctx)
-ckpt = os.path.join(outdir, "ft_ckpt.zip")
+ckptdir = os.path.join(outdir, "ckpts")
+os.makedirs(ckptdir, exist_ok=True)
 
 if phase in ("full", "crash"):
     it = ShardedDataSetIterator(global_batches(), nprocs, pid)
     facade.fit(it, epochs=1)
-    facade.save_checkpoint(ckpt)
+    # two identical-state checkpoints, a then b (b is the newer one the
+    # parent will truncate before the resume phase)
+    facade.save_checkpoint(os.path.join(ckptdir, "ft_ckpt_a.zip"))
+    facade.save_checkpoint(os.path.join(ckptdir, "ft_ckpt_b.zip"))
     with open(os.path.join(outdir, f"saved_{pid}"), "w") as f:
         f.write("1")
     it.reset()
@@ -85,6 +96,12 @@ if phase in ("full", "crash"):
     np.savez(os.path.join(outdir, f"final_{phase}_{pid}.npz"),
              params=net.params_flat(), iteration=net.iteration)
 elif phase == "resume":
+    # recovery path: newest checkpoint was truncated by the parent —
+    # latest_valid_checkpoint must detect it and fall back to _a
+    ckpt = faults.latest_valid_checkpoint(ckptdir)
+    assert ckpt.endswith("ft_ckpt_a.zip"), ckpt
+    assert not faults.is_valid_checkpoint(
+        os.path.join(ckptdir, "ft_ckpt_b.zip"))
     facade.restore_checkpoint(ckpt)
     assert net.iteration > 0  # state really came from the checkpoint
     it = ShardedDataSetIterator(global_batches(), nprocs, pid)
