@@ -403,6 +403,127 @@ def _bench_allreduce(devices, mb: float = 256.0):
     return round(algbw, 2), n
 
 
+def _bench_serving(n_clients: int = 8, n_requests: int = 30,
+                   max_size: int = 16, batch_limit: int = 32):
+    """Serving A/B: bucketed batching (warmup pre-compiles every bucket)
+    vs naive coalescing (one XLA program per distinct dispatched size).
+    A multi-threaded client storm with mixed request sizes drives each
+    mode through the same DynamicBatcher; per-request latency p50/p99,
+    req/s and the engine compile count are the readout. Writes the full
+    A/B to BENCH_serving.json next to this script and returns it."""
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        BucketPolicy,
+        DynamicBatcher,
+        InferenceEngine,
+    )
+    from deeplearning4j_tpu.serving.batcher import make_dispatcher
+    from deeplearning4j_tpu.updaters import Adam
+
+    d_in, d_hidden, d_out = 128, 256, 10
+
+    def fresh_engine(policy):
+        conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_out=d_hidden, activation="relu"))
+                .layer(OutputLayer(n_out=d_out, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        net = MultiLayerNetwork(conf).init()
+        return InferenceEngine(net, buckets=policy)
+
+    rng = np.random.default_rng(0)
+    # one fixed input per size: naive mode's compile set is then exactly
+    # the distinct sizes, not distinct values
+    inputs = {n: rng.standard_normal((n, d_in)).astype(np.float32)
+              for n in range(1, max_size + 1)}
+
+    def storm(engine, warm: bool) -> dict:
+        if warm:
+            warm_report = engine.warmup()
+        else:
+            warm_report = None
+        batcher = DynamicBatcher(
+            make_dispatcher(engine.infer, metrics=engine.metrics),
+            batch_limit=batch_limit, max_wait_ms=2.0, queue_limit=4096,
+            metrics=engine.metrics)
+        compiles_before_storm = engine.compile_count
+        lats = []
+        lock = threading.Lock()
+
+        def client(tid):
+            crng = np.random.default_rng(100 + tid)
+            mine = []
+            for _ in range(n_requests):
+                n = int(crng.integers(1, max_size + 1))
+                t0 = time.perf_counter()
+                batcher.submit(inputs[n]).result(timeout=120)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lats.extend(mine)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        batcher.shutdown()
+        lats.sort()
+
+        def q(p):
+            return lats[min(int(p * len(lats)), len(lats) - 1)]
+
+        return {
+            "requests": len(lats),
+            "req_per_sec": round(len(lats) / wall, 1),
+            "latency_p50_ms": round(q(0.50) * 1e3, 3),
+            "latency_p99_ms": round(q(0.99) * 1e3, 3),
+            "storm_compiles": engine.compile_count - compiles_before_storm,
+            "total_compiles": engine.compile_count,
+            "warmup": warm_report,
+        }
+
+    bucketed = storm(fresh_engine(BucketPolicy(max_batch=batch_limit)),
+                     warm=True)
+    naive = storm(fresh_engine(BucketPolicy.identity()), warm=False)
+
+    result = {
+        "metric": "serving_p99_latency_ms_bucketed",
+        "value": bucketed["latency_p99_ms"],
+        "unit": "ms",
+        "vs_baseline": (
+            round(naive["latency_p99_ms"] / bucketed["latency_p99_ms"], 2)
+            if bucketed["latency_p99_ms"] else None),
+        "extra": {
+            "bucketed": bucketed,
+            "naive_coalescing": naive,
+            "config": (f"MLP {d_in}->{d_hidden}->{d_out}, "
+                       f"{n_clients} clients x {n_requests} reqs, "
+                       f"sizes 1..{max_size}, batch_limit {batch_limit}, "
+                       "max_wait 2ms"),
+            "platform": jax.devices()[0].platform,
+            "note": ("vs_baseline = naive p99 / bucketed p99; "
+                     "storm_compiles is the acceptance signal "
+                     "(bucketed+warm must be 0)"),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_serving.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    return result
+
+
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     compute_dtype = "bfloat16"
@@ -559,6 +680,15 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        # serving A/B runs in-process (no TPU-tunnel supervisor needed:
+        # it is meaningful on any backend and writes BENCH_serving.json)
+        if os.environ.get("BENCH_FORCE_CPU") == "1":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_serving()))
+        sys.exit(0)
     if os.environ.get("BENCH_CHILD") == "1":
         # child mode: run the real benchmark; exceptions propagate so the
         # supervisor sees a non-zero exit and retries / falls back

@@ -1,11 +1,17 @@
-"""Command-line training entry point (reference
-``parallelism/main/ParallelWrapperMain.java`` — the repo's only training
-CLI: model + data + workers → fit → save).
+"""Command-line training AND serving entry point (reference
+``parallelism/main/ParallelWrapperMain.java`` — the training half; the
+``serve`` subcommand is the production-serving half the reference kept
+in ParallelInference).
 
 Usage:
     python -m deeplearning4j_tpu.cli --model lenet --dataset mnist \\
         --epochs 2 --batch-size 64 --workers 8 --output /tmp/model.zip \\
         --stats /tmp/stats.jsonl --dashboard /tmp/dash.html
+
+    python -m deeplearning4j_tpu.cli serve --model /ckpts --port 8080 \\
+        --batch-limit 32 --max-wait-ms 5
+    # --model: zoo name (fresh weights — smoke), checkpoint zip, or a
+    # checkpoint DIRECTORY (newest valid checkpoint; /reload re-polls it)
 """
 
 from __future__ import annotations
@@ -79,7 +85,139 @@ def build_model(name: str, num_classes: int, dataset: str,
     return model.init()
 
 
+def serve_main(argv) -> int:
+    """``serve`` subcommand: checkpoint/zoo model → warmed bucketed
+    engine → HTTP server (serving/ package)."""
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu serve",
+        description="Serve a model over HTTP: bucketed dynamic batching, "
+                    "compile-cache warmup, backpressure, hot reload",
+    )
+    ap.add_argument("--model", required=True,
+                    help="zoo model name (fresh weights — smoke runs), "
+                         "checkpoint zip, or checkpoint DIRECTORY "
+                         "(newest valid; also the /reload source)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="0 binds an ephemeral port (printed at startup)")
+    ap.add_argument("--batch-limit", type=int, default=32,
+                    help="max examples per device dispatch")
+    ap.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="dispatch deadline: a non-full batch waits at most "
+                         "this long for co-travelers")
+    ap.add_argument("--queue-limit", type=int, default=256,
+                    help="bounded request queue; beyond it requests are "
+                         "rejected 503 (backpressure)")
+    ap.add_argument("--buckets", default=None,
+                    help="comma-separated batch-size buckets (default: "
+                         "powers of two up to --batch-limit)")
+    ap.add_argument("--seq-buckets", default=None,
+                    help="comma-separated sequence-length buckets for "
+                         "rank-3 inputs (default: the zoo model's "
+                         "serving_seq_buckets hint, if any)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help=">1 shards each dispatched batch over that many "
+                         "devices (mesh data axis)")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="explicit /reload source (default: --model when "
+                         "it is a directory)")
+    ap.add_argument("--num-classes", type=int, default=10,
+                    help="zoo-name models only: output classes")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip bucket pre-compilation (first request per "
+                         "shape then pays the compile)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="serve ONE local request through the HTTP stack, "
+                         "print the result, shut down (CI gate)")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.models.selector import ZOO, ModelSelector
+    from deeplearning4j_tpu.serving import (
+        BucketPolicy,
+        InferenceEngine,
+        InferenceServer,
+    )
+
+    batch_buckets = (None if args.buckets is None
+                     else [int(b) for b in args.buckets.split(",")])
+    seq_buckets = (None if args.seq_buckets is None
+                   else [int(t) for t in args.seq_buckets.split(",")])
+    key = args.model.lower()
+    if key in ZOO and seq_buckets is None:
+        # zoo models carry a per-model sequence-bucket hint
+        seq_buckets = ZOO[key].serving_seq_buckets
+    buckets = BucketPolicy(batch_buckets=batch_buckets,
+                           max_batch=args.batch_limit,
+                           seq_buckets=seq_buckets)
+
+    mesh = None
+    if args.workers > 1:
+        from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+
+        mesh = TrainingMesh(data=args.workers)
+    eng_kwargs = dict(buckets=buckets, mesh=mesh)
+    if args.checkpoint_dir:
+        eng_kwargs["checkpoint_dir"] = args.checkpoint_dir
+    if key in ZOO:
+        model, origin = ModelSelector.load_or_init(
+            args.model, num_classes=args.num_classes)
+        engine = InferenceEngine(model, **eng_kwargs)
+    else:
+        # checkpoint zip/dir: from_checkpoint records the content
+        # fingerprint, so a periodic no-change /reload poll is a no-op
+        engine = InferenceEngine.from_checkpoint(args.model, **eng_kwargs)
+        origin = engine.describe()["source"]
+    print(f"serving {type(engine.model).__name__} from {origin} "
+          f"({engine.buckets!r})", flush=True)
+    if not args.no_warmup:
+        shape = engine.example_shape()
+        if shape is None:
+            print("warmup skipped: model conf declares no input type "
+                  "(first request per bucket compiles lazily)", flush=True)
+        else:
+            rep = engine.warmup()
+            print(f"warmup: {rep['shapes']} shapes, {rep['compiles']} "
+                  f"compiles, {rep['seconds']}s", flush=True)
+
+    server = InferenceServer(
+        engine, host=args.host, port=args.port,
+        batch_limit=args.batch_limit, max_wait_ms=args.max_wait_ms,
+        queue_limit=args.queue_limit)
+    print(f"listening on http://{args.host}:{server.port} "
+          "(POST /predict, /predict_npy, /reload; GET /healthz, /metrics)",
+          flush=True)
+    if args.smoke:
+        import http.client
+        import json as _json
+
+        shape = engine.example_shape() or (1,)
+        server.start()
+        conn = http.client.HTTPConnection(args.host, server.port, timeout=30)
+        x = [[0.0] * shape[-1]] if len(shape) == 1 else None
+        if x is None:
+            import numpy as _np
+
+            x = _np.zeros((1,) + tuple(shape), _np.float32).tolist()
+        conn.request("POST", "/predict", _json.dumps({"inputs": x}))
+        resp = conn.getresponse()
+        body = _json.loads(resp.read())
+        ok = resp.status == 200 and "outputs" in body
+        print(f"smoke: HTTP {resp.status} "
+              f"{'ok' if ok else body}", flush=True)
+        server.shutdown()
+        return 0 if ok else 1
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down (draining queue)", flush=True)
+        server.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["serve"]:
+        return serve_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",

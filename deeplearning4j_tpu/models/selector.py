@@ -45,3 +45,35 @@ class ModelSelector:
     @staticmethod
     def available() -> list:
         return sorted(ZOO)
+
+    @staticmethod
+    def load_or_init(source: str, **kwargs):
+        """Resolve ``source`` into an initialized network — the serving
+        CLI's single entry for "what model do I serve":
+
+        - a **zoo model name** → fresh ``init()`` (smoke/warmup runs);
+        - a **checkpoint zip** → ``ModelGuesser.load_model_guess``
+          (type sniffed from the zip);
+        - a **checkpoint directory** → the newest VALID checkpoint via
+          ``train.faults.load_latest_valid`` (corrupt/truncated newest
+          falls back to the previous good one).
+
+        Returns ``(model, origin)`` where origin is the zoo name or the
+        resolved checkpoint path."""
+        import os
+
+        key = source.lower()
+        if key in ZOO:
+            return ZOO[key](**kwargs).init(), key
+        if os.path.isdir(source):
+            from deeplearning4j_tpu.train.faults import load_latest_valid
+
+            model, path = load_latest_valid(source)
+            return model, path
+        if os.path.isfile(source):
+            from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+            return ModelGuesser.load_model_guess(source), source
+        raise ValueError(
+            f"model source {source!r} is neither a zoo model "
+            f"({sorted(ZOO)}), a checkpoint zip, nor a checkpoint directory")

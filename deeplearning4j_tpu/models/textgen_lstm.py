@@ -13,6 +13,11 @@ from deeplearning4j_tpu.updaters import RmsProp
 class TextGenerationLSTM(ZooModel):
     name = "textgenlstm"
 
+    # serving hint: char sequences arrive at arbitrary lengths; pad the
+    # time dim to these buckets (masked — padded steps are dead) so the
+    # inference engine compiles a bounded program set
+    serving_seq_buckets = (8, 16, 32, 64)
+
     def __init__(self, num_classes: int = 77, units: int = 256,
                  max_length: int = 40, **kwargs):
         # num_classes = vocabulary (character set) size

@@ -56,6 +56,31 @@ class ZooModel:
             ) from e
         return ComputationGraph(conf).init()
 
+    #: serving hint: per-model sequence-length buckets for the inference
+    #: engine's shape-bucket policy (``serving.BucketPolicy``). None for
+    #: fixed-shape models (images, tabular); sequence models (rank-3
+    #: inputs) list the time-dim pad targets so mixed-length serving
+    #: traffic compiles a bounded program set. Read by
+    #: :meth:`serving_bucket_policy` / the ``cli serve`` wiring.
+    serving_seq_buckets: Optional[tuple] = None
+
+    def serving_input_shape(self) -> Optional[tuple]:
+        """Per-example input shape for serving warmup, from the built
+        conf's input type (None when the conf declares none)."""
+        from deeplearning4j_tpu.serving.engine import conf_example_shape
+
+        return conf_example_shape(self.conf())
+
+    def serving_bucket_policy(self, max_batch: int = 32,
+                              batch_buckets: Optional[Sequence[int]] = None):
+        """The model's serving bucket policy: caller-chosen batch
+        buckets plus this model's ``serving_seq_buckets`` hint."""
+        from deeplearning4j_tpu.serving.buckets import BucketPolicy
+
+        return BucketPolicy(batch_buckets=batch_buckets,
+                            max_batch=max_batch,
+                            seq_buckets=self.serving_seq_buckets)
+
     #: per-dataset sha256 hex digests; subclasses (or callers staging
     #: weights into the cache) fill this so ``init_pretrained`` verifies
     #: integrity like the reference's checksum gate (``ZooModel.java:40-62``)

@@ -5,28 +5,33 @@ consume an observable queue; BATCHED mode coalesces concurrent requests up
 to ``batch_limit``. TPU-native version: one jitted forward sharded over the
 mesh data axis; a coalescing queue groups concurrent ``output`` calls into
 one device dispatch (microbatch coalescing on top of XLA's throughput).
+
+Since the serving subsystem landed, BATCHED mode is a thin facade over
+``deeplearning4j_tpu.serving``'s :class:`DynamicBatcher` +
+:class:`BucketPolicy`, which fixes three defects of the original loop
+for free:
+
+- dispatched batches never exceed ``batch_limit`` (the old coalesce
+  loop could overshoot by one request's rows);
+- a full queue rejects with a typed
+  :class:`serving.ServerOverloadedError` instead of blocking the caller
+  unboundedly;
+- shutdown is race-free (a request enqueued concurrently with shutdown
+  fails with the shutdown error instead of blocking its caller forever)
+  and ``output`` accepts an optional ``timeout=``;
+- coalesced batches pad up to shape buckets (powers of two up to
+  ``batch_limit`` by default), so organic traffic triggers a bounded
+  number of XLA compiles instead of one per distinct coalesced size.
 """
 
 from __future__ import annotations
 
-import queue
 import threading
-from typing import List, Optional
+from typing import Optional, Sequence, Union
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.parallel.mesh import TrainingMesh
-
-
-class _Request:
-    def __init__(self, x, mask):
-        self.x = x
-        self.mask = mask
-        self.event = threading.Event()
-        self.result: Optional[np.ndarray] = None
-        self.error: Optional[BaseException] = None
 
 
 class ParallelInference:
@@ -53,6 +58,8 @@ class ParallelInference:
             self._batch_limit = 32
             self._queue_limit = 64
             self._workers = None
+            self._max_wait_ms = 2.0
+            self._buckets: Union[bool, Sequence[int]] = True
 
         def inference_mode(self, mode: str):
             self._mode = mode
@@ -64,6 +71,23 @@ class ParallelInference:
 
         def queue_limit(self, n: int):
             self._queue_limit = int(n)
+            return self
+
+        def max_wait_ms(self, ms: float):
+            """BATCHED: how long a non-full batch waits for co-travelers
+            before dispatching (the deadline side of "dispatch at
+            batch_limit OR max_wait_ms")."""
+            self._max_wait_ms = float(ms)
+            return self
+
+        def buckets(self, buckets: Union[bool, Sequence[int]]):
+            """BATCHED shape-bucket policy: True (default) pads each
+            coalesced batch up to a power-of-two bucket ≤ batch_limit
+            (bounded XLA program count under organic traffic), False
+            disables padding (one compile per distinct coalesced size —
+            the pre-serving behavior), or an explicit ascending list of
+            batch sizes."""
+            self._buckets = buckets
             return self
 
         def workers(self, n: int):
@@ -79,6 +103,7 @@ class ParallelInference:
             return ParallelInference(
                 self.model, mode=self._mode, batch_limit=self._batch_limit,
                 queue_limit=self._queue_limit, workers=self._workers,
+                max_wait_ms=self._max_wait_ms, buckets=self._buckets,
             )
 
     @staticmethod
@@ -87,7 +112,8 @@ class ParallelInference:
 
     def __init__(self, model, mode: str = "batched", batch_limit: int = 32,
                  queue_limit: int = 64, mesh: Optional[TrainingMesh] = None,
-                 workers: Optional[int] = None):
+                 workers: Optional[int] = None, max_wait_ms: float = 2.0,
+                 buckets: Union[bool, Sequence[int]] = True):
         if mode not in (self.INFERENCE_MODE_SEQUENTIAL,
                         self.INFERENCE_MODE_BATCHED,
                         self.INFERENCE_MODE_INPLACE):
@@ -95,7 +121,6 @@ class ParallelInference:
         self.model = model
         self.mode = mode
         self.batch_limit = batch_limit
-        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
         if mode == self.INFERENCE_MODE_INPLACE:
             n = max(int(workers or 2), 1)
@@ -107,12 +132,50 @@ class ParallelInference:
             self._rr_lock = threading.Lock()
             return
         if mode == self.INFERENCE_MODE_BATCHED:
-            self._worker = threading.Thread(target=self._serve, daemon=True)
-            self._worker.start()
+            from deeplearning4j_tpu.serving.batcher import (
+                DynamicBatcher,
+                make_dispatcher,
+            )
+            from deeplearning4j_tpu.serving.buckets import BucketPolicy
+            from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
-    def output(self, x, mask=None) -> np.ndarray:
+            if buckets is True:
+                self._buckets = BucketPolicy(max_batch=batch_limit)
+            elif buckets is False or buckets is None:
+                self._buckets = BucketPolicy.identity()
+            else:
+                # batch_limit unioned in: a full coalesced batch pads to
+                # it instead of growing past the limit
+                self._buckets = BucketPolicy(batch_buckets=buckets,
+                                             max_batch=batch_limit)
+            self.metrics = ServingMetrics()
+            self._batcher = DynamicBatcher(
+                make_dispatcher(self._bucketed_infer, metrics=self.metrics),
+                batch_limit=batch_limit, max_wait_ms=max_wait_ms,
+                queue_limit=queue_limit, metrics=self.metrics)
+
+    def _bucketed_infer(self, x, mask) -> np.ndarray:
+        """One coalesced dispatch: pad to the bucket, run the model's
+        jitted forward, slice the padding back off."""
+        from deeplearning4j_tpu.serving.buckets import slice_result
+
+        x = np.asarray(x)
+        t_orig = x.shape[1] if x.ndim >= 3 else None
+        xp, mp, n = self._buckets.pad_batch(x, mask)
+        self.metrics.record_dispatch(xp.shape[0])
+        y = self.model.output(xp, mask=mp)
+        return slice_result(y, n, t_orig,
+                            xp.shape[1] if t_orig is not None else None)
+
+    def output(self, x, mask=None, timeout: Optional[float] = None
+               ) -> np.ndarray:
         """Thread-safe blocking inference call (reference
-        ``ParallelInference.output``)."""
+        ``ParallelInference.output``). ``timeout`` (seconds, BATCHED
+        mode) bounds the wait: on expiry the request is abandoned and a
+        typed ``RequestDeadlineExceeded`` (a RuntimeError/TimeoutError)
+        raises. A full request queue raises ``ServerOverloadedError``
+        immediately instead of blocking — backpressure the caller can
+        see."""
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
         if self.mode == self.INFERENCE_MODE_SEQUENTIAL:
@@ -123,69 +186,17 @@ class ParallelInference:
                 self._rr = (self._rr + 1) % len(self._replicas)
             with self._replica_locks[i]:
                 return self._replicas[i].output(x, mask=mask)
-        req = _Request(np.asarray(x), None if mask is None else np.asarray(mask))
-        self._queue.put(req)
-        req.event.wait()
-        if req.error is not None:
-            raise req.error
-        return req.result
-
-    def _serve(self):
-        while not self._shutdown:
-            try:
-                first = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
-            batch: List[_Request] = [first]
-            # coalesce whatever is queued, up to batch_limit total examples
-            total = first.x.shape[0]
-            while total < self.batch_limit:
-                try:
-                    nxt = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                batch.append(nxt)
-                total += nxt.x.shape[0]
-            try:
-                compatible = (
-                    all(r.x.shape[1:] == batch[0].x.shape[1:] for r in batch)
-                    and all((r.mask is None) == (batch[0].mask is None) for r in batch)
-                )
-                if len(batch) > 1 and compatible:
-                    x = np.concatenate([r.x for r in batch], axis=0)
-                    mask = (
-                        None if batch[0].mask is None
-                        else np.concatenate([r.mask for r in batch], axis=0)
-                    )
-                    out = self.model.output(x, mask=mask)
-                    off = 0
-                    for r in batch:
-                        n = r.x.shape[0]
-                        r.result = out[off : off + n]
-                        off += n
-                        r.event.set()
-                else:
-                    for r in batch:
-                        r.result = self.model.output(r.x, mask=r.mask)
-                        r.event.set()
-            except BaseException as e:  # propagate to callers
-                for r in batch:
-                    if not r.event.is_set():
-                        r.error = e
-                        r.event.set()
+        req = self._batcher.submit(
+            np.asarray(x), None if mask is None else np.asarray(mask),
+            timeout=timeout)
+        return req.result(timeout=timeout)
 
     def shutdown(self):
+        """Flip the shutdown flag first (so no caller can enqueue into a
+        dead queue), then drain: queued requests are served, and any
+        request that raced the drain fails with the shutdown error
+        rather than leaving its caller blocked forever."""
         self._shutdown = True
-        if not hasattr(self, "_worker"):
+        if self.mode != self.INFERENCE_MODE_BATCHED:
             return  # sequential/inplace: nothing queued, no thread
-        self._worker.join(timeout=2)
-        # fail any requests still in flight rather than leaving callers
-        # blocked forever on their event
-        while True:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if not req.event.is_set():
-                req.error = RuntimeError("ParallelInference shut down before serving request")
-                req.event.set()
+        self._batcher.shutdown(drain=True)

@@ -1,0 +1,48 @@
+"""Production inference serving subsystem.
+
+TensorFlow's production story (arXiv 1605.08695) pairs the training
+runtime with a serving layer: shape-managed batching, bounded queues,
+live model reload. This package is that half for deeplearning4j_tpu —
+the training stack produces crash-safe checkpoints
+(``train.faults.save_checkpoint``) and this layer serves them:
+
+- :mod:`buckets` — shape-bucket policy: every coalesced batch pads up to
+  a pre-compiled bucket so steady-state serving never triggers a fresh
+  XLA compile (arXiv 1810.09868: ahead-of-time-compiled fixed-shape
+  programs are the unit of TPU execution).
+- :mod:`batcher` — deadline-based dynamic batcher with bounded-queue
+  backpressure (typed :class:`ServerOverloadedError` instead of
+  unbounded blocking) and clean drain-on-shutdown.
+- :mod:`engine` — model engine: jitted sharded forward, compile-count
+  hook, ``warmup()``, atomic hot-swap reload from
+  ``faults.latest_valid_checkpoint``.
+- :mod:`server` — stdlib HTTP front-end (JSON + raw-npy predict,
+  /healthz, /reload, /metrics).
+- :mod:`metrics` — thread-safe serving counters + latency quantiles.
+"""
+
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher,
+    InferenceRequest,
+    RequestDeadlineExceeded,
+    ServerOverloadedError,
+    ServerShutdownError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.buckets import BucketPolicy
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.server import InferenceServer
+
+__all__ = [
+    "BucketPolicy",
+    "DynamicBatcher",
+    "InferenceEngine",
+    "InferenceRequest",
+    "InferenceServer",
+    "RequestDeadlineExceeded",
+    "ServerOverloadedError",
+    "ServerShutdownError",
+    "ServingError",
+    "ServingMetrics",
+]

@@ -1,0 +1,318 @@
+"""Deadline-based dynamic batcher with bounded-queue backpressure.
+
+The dispatch rule is the standard serving one (TF Serving's
+BatchScheduler): a batch launches when it reaches ``batch_limit``
+examples OR when ``max_wait_ms`` has elapsed since its first request —
+whichever comes first. Low traffic pays at most ``max_wait_ms`` extra
+latency; high traffic fills batches immediately and the wait never
+triggers.
+
+Three deliberate departures from the old ``ParallelInference`` loop:
+
+- **No overshoot**: the old loop checked ``total < batch_limit`` before
+  pulling the next request, so a dispatched batch could exceed the
+  limit by up to one request's rows. Here a request that would overflow
+  the limit stays queued (a one-slot ``pending`` carry) and opens the
+  next batch.
+- **Backpressure, not unbounded blocking**: the queue is bounded and a
+  full queue rejects with a typed :class:`ServerOverloadedError`
+  immediately — callers (and the HTTP front-end, as a 503) get a signal
+  they can act on, instead of threads silently piling up on a blocking
+  ``put``.
+- **Race-free shutdown**: ``shutdown`` flips the flag BEFORE joining,
+  the worker drains what is queued, and a submit that slips past the
+  flag check re-checks after enqueue and fails its own request — so no
+  caller can block forever on a request nobody will serve (the old
+  code's put-after-drain hang).
+
+The batcher is model-agnostic: ``dispatch(batch)`` receives the
+coalesced :class:`InferenceRequest` list on the worker thread and must
+complete each one (the engine/front-end own padding, bucketing and
+result slicing). Completion is idempotent first-wins, which makes
+caller-side timeouts and shutdown races safe by construction.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class ServingError(RuntimeError):
+    """Base of the typed serving failures."""
+
+
+class ServerOverloadedError(ServingError):
+    """Bounded request queue is full — shed load upstream (HTTP 503)."""
+
+
+class ServerShutdownError(ServingError):
+    """Request arrived at (or survived into) server shutdown."""
+
+
+class RequestDeadlineExceeded(ServingError, TimeoutError):
+    """The request's deadline passed before (or while) serving it."""
+
+
+class InferenceRequest:
+    """One submitted request: input rows + synchronization.
+
+    Completion (``finish``/``fail``) is idempotent and first-wins: a
+    late worker result after a caller-side timeout, or a shutdown
+    failure racing a drain dispatch, is a silent no-op instead of a
+    double-set/torn state.
+    """
+
+    __slots__ = ("x", "mask", "deadline", "enqueued_at", "_event", "_lock",
+                 "result_", "error_", "model_version")
+
+    def __init__(self, x, mask=None, deadline: Optional[float] = None):
+        self.x = np.asarray(x)
+        self.mask = None if mask is None else np.asarray(mask)
+        #: absolute time.monotonic() deadline, or None
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.result_: Optional[np.ndarray] = None
+        self.error_: Optional[BaseException] = None
+        #: version of the model snapshot that served this request (set by
+        #: the dispatcher when the infer callable reports one)
+        self.model_version: Optional[int] = None
+
+    @property
+    def rows(self) -> int:
+        return int(self.x.shape[0]) if self.x.ndim else 1
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def finish(self, result) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.result_ = result
+            self._event.set()
+            return True
+
+    def fail(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.error_ = error
+            self._event.set()
+            return True
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the outcome. On timeout the request is failed
+        (idempotently — a concurrent worker completion wins) and
+        :class:`RequestDeadlineExceeded` raises."""
+        if not self._event.wait(timeout):
+            self.fail(RequestDeadlineExceeded(
+                f"request not served within timeout={timeout}s"))
+            self._event.wait()  # lost the race → a result exists; reread
+        if self.error_ is not None:
+            raise self.error_
+        return self.result_
+
+
+def make_dispatcher(infer: Callable[..., np.ndarray],
+                    metrics: Optional[ServingMetrics] = None
+                    ) -> Callable[[List[InferenceRequest]], None]:
+    """Standard dispatch: group coalesced requests by compatible shape
+    (same per-row shape, same mask presence/shape), concatenate each
+    group into one ``infer(x, mask)`` call, slice the rows back out to
+    their requests. Incompatible stragglers just form their own groups —
+    never an error, only a smaller batch.
+
+    ``infer`` may return either the output rows, or ``(rows, version)``
+    (``InferenceEngine.infer_versioned``) — the version is stamped onto
+    each request before completion so callers can attribute results to
+    the exact model snapshot that computed them, even across a
+    concurrent hot reload.
+    """
+
+    def signature(r: InferenceRequest):
+        return (r.x.shape[1:], None if r.mask is None else r.mask.shape[1:])
+
+    def dispatch(batch: List[InferenceRequest]) -> None:
+        groups: dict = {}
+        for r in batch:
+            groups.setdefault(signature(r), []).append(r)
+        for reqs in groups.values():
+            if len(reqs) == 1:
+                x, mask = reqs[0].x, reqs[0].mask
+            else:
+                x = np.concatenate([r.x for r in reqs], axis=0)
+                mask = (None if reqs[0].mask is None
+                        else np.concatenate([r.mask for r in reqs], axis=0))
+            try:
+                out = infer(x, mask)
+            except BaseException as e:
+                if metrics is not None:
+                    metrics.record_error()
+                for r in reqs:
+                    r.fail(e)
+                continue
+            version = None
+            if isinstance(out, tuple):
+                out, version = out
+            off = 0
+            now = time.monotonic()
+            for r in reqs:
+                n = r.rows
+                r.model_version = version  # before finish: the waiter
+                # reads it as soon as the event fires
+                r.finish(out[off:off + n])
+                off += n
+                if metrics is not None:
+                    metrics.record_latency(now - r.enqueued_at)
+
+    return dispatch
+
+
+class DynamicBatcher:
+    def __init__(self, dispatch: Callable[[List[InferenceRequest]], None],
+                 batch_limit: int = 32, max_wait_ms: float = 5.0,
+                 queue_limit: int = 64,
+                 metrics: Optional[ServingMetrics] = None):
+        self._dispatch = dispatch
+        self.batch_limit = max(int(batch_limit), 1)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self._queue: "queue.Queue[InferenceRequest]" = queue.Queue(
+            maxsize=max(int(queue_limit), 1))
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._shutdown = False
+        self._pending: Optional[InferenceRequest] = None  # worker-only slot
+        self._worker = threading.Thread(
+            target=self._loop, daemon=True, name="dl4j-tpu-batcher")
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def submit(self, x, mask=None, timeout: Optional[float] = None
+               ) -> InferenceRequest:
+        """Enqueue a request; returns immediately (block on
+        ``req.result()``). ``timeout`` sets the request's deadline —
+        enforced both while queued (expired requests are dropped, not
+        dispatched) and by ``result``'s wait."""
+        if self._shutdown:
+            raise ServerShutdownError("server is shut down")
+        req = InferenceRequest(
+            x, mask,
+            deadline=None if timeout is None
+            else time.monotonic() + float(timeout))
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.record_reject()
+            raise ServerOverloadedError(
+                f"request queue full ({self._queue.maxsize} requests); "
+                "retry with backoff or scale out") from None
+        # shutdown may have drained the queue between the flag check and
+        # the put — fail our own request so the caller can never block
+        # on a request no worker will look at (first-wins: if the drain
+        # DID serve it, this is a no-op)
+        if self._shutdown and req.fail(
+                ServerShutdownError("server shut down while enqueuing")):
+            raise ServerShutdownError("server shut down while enqueuing")
+        self.metrics.record_request(req.rows)
+        return req
+
+    # -- worker side --------------------------------------------------------
+    def _next(self, timeout: Optional[float]) -> Optional[InferenceRequest]:
+        if self._pending is not None:
+            req, self._pending = self._pending, None
+            return req
+        try:
+            if timeout is None or timeout <= 0:
+                return self._queue.get_nowait()
+            return self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def _loop(self) -> None:
+        while True:
+            first = self._next(0.05)
+            if first is None:
+                if self._shutdown:
+                    return
+                continue
+            batch = [first]
+            total = first.rows
+            # coalesce up to batch_limit or the wait window, WITHOUT
+            # overshooting: a request that would overflow stays pending
+            window_end = time.monotonic() + self.max_wait_s
+            while total < self.batch_limit:
+                wait = window_end - time.monotonic()
+                if self._shutdown:
+                    wait = 0.0  # draining: take only what's already here
+                nxt = self._next(wait)
+                if nxt is None:
+                    break
+                if total + nxt.rows > self.batch_limit:
+                    self._pending = nxt
+                    break
+                batch.append(nxt)
+                total += nxt.rows
+            now = time.monotonic()
+            live: List[InferenceRequest] = []
+            for r in batch:
+                if r.done():
+                    continue  # timed out caller-side / failed at shutdown
+                if r.expired(now):
+                    self.metrics.record_deadline()
+                    r.fail(RequestDeadlineExceeded(
+                        "request deadline passed while queued"))
+                    continue
+                live.append(r)
+            if not live:
+                continue
+            try:
+                self._dispatch(live)
+                for r in live:
+                    if not r.done():  # dispatcher contract violation
+                        r.fail(ServingError(
+                            "dispatch returned without completing request"))
+            except BaseException as e:
+                self.metrics.record_error()
+                for r in live:
+                    r.fail(e)
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, drain: bool = True, timeout: float = 10.0) -> None:
+        """Stop accepting work, serve (``drain=True``) or fail what is
+        queued, and join the worker. Idempotent."""
+        self._shutdown = True  # BEFORE join: unblocks the worker's exit
+        if not drain:
+            self._fail_queued(ServerShutdownError(
+                "server shut down before serving request"))
+        self._worker.join(timeout=timeout)
+        # belt and braces: if the worker died or overran the join
+        # timeout, nobody will ever serve the leftovers — fail them
+        self._fail_queued(ServerShutdownError(
+            "server shut down before serving request"))
+
+    def _fail_queued(self, err: ServingError) -> None:
+        if self._pending is not None and not self._worker.is_alive():
+            self._pending.fail(err)
+            self._pending = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.fail(err)

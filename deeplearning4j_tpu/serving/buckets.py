@@ -1,0 +1,242 @@
+"""Shape-bucket policy: fixed-shape programs for dynamic traffic.
+
+A coalescing batcher emits arbitrary batch sizes, and under XLA every
+distinct input shape is a distinct compiled program — so naive
+coalescing turns organic traffic (1..N rows per request, any mix) into
+an endless stream of fresh compiles, each worth seconds of p99 latency
+on TPU (arXiv 1810.09868: TPU programs are ahead-of-time-compiled
+fixed-shape binaries; there is no partial-shape execution to fall back
+on). The fix is the classic serving one (TF Serving's
+``BatchingSession`` allowed-batch-sizes): quantize every dispatched
+batch up to one of a small set of **buckets**, pad the tail, slice the
+results back, and pre-compile every bucket once at startup
+(:meth:`BucketPolicy.warmup_shapes` drives that) so the steady state
+never compiles again.
+
+Two bucketed axes:
+
+- **batch** (axis 0): powers of two up to ``max_batch`` by default, or
+  an explicit user list (e.g. ``[1, 4, 16, 64]``).
+- **sequence length** (axis 1, opt-in per model): for recurrent /
+  transformer inputs ``(b, T, ...)`` the time dimension is padded up to
+  a per-model bucket list too. Sequence padding is only meaningful with
+  masking — :meth:`pad_batch` therefore synthesizes (or extends) the
+  feature mask so padded steps are dead, which the recurrent layers and
+  attention here already honor.
+
+Padding rows are zeros and every row of the result slice belongs to a
+real request row — forward passes are row-independent in inference mode
+(no cross-batch statistics with ``train=False``), so padding can never
+leak into real results; tests assert this bitwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _pow2_buckets(limit: int) -> List[int]:
+    out, b = [], 1
+    limit = max(int(limit), 1)
+    while b < limit:
+        out.append(b)
+        b *= 2
+    out.append(limit)
+    return out
+
+
+class BucketPolicy:
+    """Quantizes dispatched batches onto a fixed shape set.
+
+    - ``batch_buckets``: explicit ascending batch sizes, or None for
+      powers of two up to ``max_batch``. When BOTH are given,
+      ``max_batch`` (the batcher's ``batch_limit``) is unioned into the
+      list — the last bucket always covers a full coalesced batch, so a
+      loaded dispatch pads by zero instead of growing past the limit
+      into a never-warmed shape.
+    - ``seq_buckets``: optional ascending sequence-length buckets for
+      rank>=3 inputs ``(b, T, ...)``; None disables time padding.
+    - Oversized requests (more rows than the top bucket, or longer than
+      the top seq bucket) round up to the next power of two beyond the
+      list; the grown bucket is remembered so it only ever compiles
+      once. The policy never truncates data.
+    """
+
+    def __init__(self, batch_buckets: Optional[Sequence[int]] = None,
+                 max_batch: Optional[int] = None,
+                 seq_buckets: Optional[Sequence[int]] = None):
+        if batch_buckets is not None:
+            bb = sorted({int(b) for b in batch_buckets})
+            if not bb or bb[0] < 1:
+                raise ValueError(f"batch_buckets must be positive: {batch_buckets}")
+            if max_batch is not None and bb[-1] < int(max_batch):
+                bb.append(int(max_batch))
+        else:
+            bb = _pow2_buckets(32 if max_batch is None else max_batch)
+        self.batch_buckets: List[int] = bb
+        self.seq_buckets: Optional[List[int]] = (
+            None if seq_buckets is None
+            else sorted({int(t) for t in seq_buckets}))
+        if self.seq_buckets is not None and (
+                not self.seq_buckets or self.seq_buckets[0] < 1):
+            raise ValueError(f"seq_buckets must be positive: {seq_buckets}")
+
+    def copy(self) -> "BucketPolicy":
+        """Independent copy (same class, own bucket lists). The engine
+        copies the policy it is given so its mesh filtering and
+        oversize-growth never mutate a policy shared with another
+        engine."""
+        new = self.__class__.__new__(self.__class__)
+        new.batch_buckets = list(self.batch_buckets)
+        new.seq_buckets = (None if self.seq_buckets is None
+                           else list(self.seq_buckets))
+        return new
+
+    # -- identity (the naive-coalescing baseline) ---------------------------
+    @staticmethod
+    def identity() -> "IdentityBucketPolicy":
+        """A policy that never pads: every distinct size is its own
+        "bucket" (exactly the pre-bucketing behavior — kept as the A/B
+        baseline for the serving bench and as an opt-out)."""
+        return IdentityBucketPolicy()
+
+    # -- lookups ------------------------------------------------------------
+    @staticmethod
+    def _round_up(n: int, buckets: List[int]) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        # oversized: grow by powers of two past the top bucket and
+        # remember the new bucket (bounded shape count, compiles once)
+        b = buckets[-1]
+        while b < n:
+            b *= 2
+        buckets.append(b)
+        return b
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest batch bucket >= n."""
+        return self._round_up(int(n), self.batch_buckets)
+
+    def seq_bucket_for(self, t: int) -> int:
+        """Smallest sequence bucket >= t (t itself when seq bucketing is
+        off)."""
+        if self.seq_buckets is None:
+            return int(t)
+        return self._round_up(int(t), self.seq_buckets)
+
+    # -- padding ------------------------------------------------------------
+    def pad_batch(self, x: np.ndarray, mask: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, Optional[np.ndarray], int]:
+        """Pad ``x`` (and ``mask``) up to the bucketed shape.
+
+        Returns ``(x_padded, mask_padded, n_real_rows)``; the caller
+        slices results back to ``n_real_rows``. When sequence bucketing
+        applies (rank>=3 input) a mask is synthesized if absent so the
+        padded timesteps are masked out; batch-only padding leaves a
+        None mask as None (padded rows are sliced away regardless).
+        """
+        x = np.asarray(x)
+        if x.ndim < 1:
+            raise ValueError("pad_batch needs a batched array, got a scalar")
+        n = x.shape[0]
+        nb = self.bucket_for(n)
+        pad_seq = self.seq_buckets is not None and x.ndim >= 3
+        if pad_seq:
+            t = x.shape[1]
+            tb = self.seq_bucket_for(t)
+            if mask is None:
+                # synthesized even at exact fit: mask presence changes the
+                # jitted program's signature, so it must be uniform or
+                # t==bucket traffic would compile a second program set
+                mask = np.ones((n, t), np.float32)
+        else:
+            tb = x.shape[1] if x.ndim >= 2 else None
+        if nb == n and (not pad_seq or tb == x.shape[1]):
+            return x, mask, n
+        shape = list(x.shape)
+        shape[0] = nb
+        if pad_seq:
+            shape[1] = tb
+        xp = np.zeros(shape, x.dtype)
+        if pad_seq:
+            xp[:n, : x.shape[1]] = x
+        else:
+            xp[:n] = x
+        mp = mask
+        if mask is not None:
+            mask = np.asarray(mask)
+            mshape = list(mask.shape)
+            mshape[0] = nb
+            if pad_seq and mask.ndim >= 2:
+                mshape[1] = tb
+            mp = np.zeros(mshape, mask.dtype)
+            if pad_seq and mask.ndim >= 2:
+                mp[:n, : mask.shape[1]] = mask
+            else:
+                mp[:n] = mask
+        return xp, mp, n
+
+    # -- warmup enumeration -------------------------------------------------
+    def warmup_shapes(self, example_shape: Sequence[int]
+                      ) -> List[Tuple[Tuple[int, ...], bool]]:
+        """Every (input_shape, with_mask) this policy can emit for
+        per-example shape ``example_shape`` (no batch dim) — the set
+        :meth:`InferenceEngine.warmup` pre-compiles. With seq bucketing
+        the time axis (``example_shape[0]``) takes each seq bucket and
+        the mask is always present (matching :meth:`pad_batch`)."""
+        example_shape = tuple(int(d) for d in example_shape)
+        shapes: List[Tuple[Tuple[int, ...], bool]] = []
+        seq = self.seq_buckets is not None and len(example_shape) >= 2
+        for nb in list(self.batch_buckets):
+            if seq:
+                for tb in list(self.seq_buckets):
+                    shapes.append(((nb, tb) + example_shape[1:], True))
+            else:
+                shapes.append(((nb,) + example_shape, False))
+        return shapes
+
+    def __repr__(self):
+        return (f"BucketPolicy(batch={self.batch_buckets}, "
+                f"seq={self.seq_buckets})")
+
+
+def slice_result(y: np.ndarray, n: int, t_orig: Optional[int],
+                 t_padded: Optional[int]) -> np.ndarray:
+    """Undo bucket padding on a model output: always slice the batch
+    axis to ``n``; slice the time axis back to ``t_orig`` when sequence
+    padding occurred AND the output still carries that axis (per-step
+    outputs ``(b, T, ...)``; time-pooled outputs ``(b, C)`` have no
+    padded axis left — masking already kept them correct)."""
+    y = np.asarray(y)[:n]
+    if (t_orig is not None and t_padded is not None and t_padded != t_orig
+            and y.ndim >= 3 and y.shape[1] == t_padded):
+        y = y[:, :t_orig]
+    return y
+
+
+class IdentityBucketPolicy(BucketPolicy):
+    """Pass-through policy: no padding, every size its own program — the
+    naive-coalescing baseline. ``warmup_shapes`` is empty (there is no
+    finite shape set to pre-compile, which is exactly the problem)."""
+
+    def __init__(self):
+        super().__init__(batch_buckets=[1])
+
+    def bucket_for(self, n: int) -> int:
+        return int(n)
+
+    def seq_bucket_for(self, t: int) -> int:
+        return int(t)
+
+    def pad_batch(self, x, mask=None):
+        x = np.asarray(x)
+        return x, mask, x.shape[0]
+
+    def warmup_shapes(self, example_shape):
+        return []
+
+    def __repr__(self):
+        return "IdentityBucketPolicy()"

@@ -1,0 +1,379 @@
+"""Model engine: jitted forward + bucket padding + atomic hot reload.
+
+The engine owns the compiled serving surface for one model:
+
+- a **pure jitted forward** ``fn(params, state, x, mask)`` built once
+  per architecture (for :class:`MultiLayerNetwork` it closes over the
+  layer graph only — params/state flow through as arguments, which is
+  what makes zero-recompile hot reload possible);
+- a **compile-count hook**: the traced function bumps a host counter at
+  trace time, so ``engine.compile_count`` is exactly the number of
+  distinct XLA programs built — the acceptance signal for "warmup
+  pre-compiled everything, steady state never compiles";
+- ``warmup()``: runs every shape the bucket policy can emit
+  (``BucketPolicy.warmup_shapes``) through the forward at startup;
+- **atomic hot-swap reload**: a reload builds a complete replacement
+  snapshot (params, state, fn) off to the side — re-warming first if
+  the architecture changed — and installs it with one reference
+  assignment. Serving threads read the snapshot reference once per
+  batch, so a batch is always computed entirely under one model:
+  serving never observes a half-loaded or mixed model. Checkpoints come
+  from ``train.faults.latest_valid_checkpoint`` (crash-safe, falls back
+  past truncated newest) or an explicit zip path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.serving.buckets import BucketPolicy
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class _Snapshot:
+    """One immutable serving model version. All fields are set before the
+    snapshot becomes visible; after that it is only read."""
+
+    __slots__ = ("model", "params", "state", "fn", "conf_json", "version",
+                 "source", "loaded_at")
+
+    def __init__(self, model, fn, conf_json, version, source):
+        self.model = model
+        self.params = model.params_
+        self.state = model.state_
+        self.fn = fn  # None → generic model.output fallback
+        self.conf_json = conf_json
+        self.version = int(version)
+        self.source = source
+        self.loaded_at = time.time()
+
+
+def conf_example_shape(conf) -> Optional[Tuple[int, ...]]:
+    """Per-example input shape declared by a configuration's input type
+    (None when it declares none) — the one derivation shared by engine
+    warmup, reload re-warming, and ``ZooModel.serving_input_shape``."""
+    itype = getattr(conf, "input_type", None)
+    if itype is None:
+        return None
+    return tuple(itype.shape(1)[1:])
+
+
+def _checkpoint_source(source: str) -> str:
+    """Resolve a checkpoint zip from a path or directory (newest VALID
+    one via the fault-tolerance layer)."""
+    from deeplearning4j_tpu.train.faults import latest_valid_checkpoint
+
+    if os.path.isdir(source):
+        return latest_valid_checkpoint(source)
+    return source
+
+
+class InferenceEngine:
+    """Serving engine over one model + bucket policy.
+
+    ``mesh`` (a ``TrainingMesh``) shards each dispatched batch over the
+    data axis (GSPMD: replicated params, batch-sharded input); bucket
+    sizes must then be multiples of the data-axis size so shards are
+    even — the default power-of-two buckets are filtered accordingly.
+    """
+
+    def __init__(self, model, buckets: Optional[BucketPolicy] = None,
+                 mesh=None, checkpoint_dir: Optional[str] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        # own copy: mesh filtering + oversize growth must never mutate a
+        # policy object shared with another engine
+        self.buckets = (buckets if buckets is not None
+                        else BucketPolicy()).copy()
+        self.mesh = mesh
+        self.checkpoint_dir = checkpoint_dir
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._compile_count = 0
+        self._reload_lock = threading.Lock()
+        self._fingerprint: Optional[Tuple[float, int]] = None
+        self.warm = False
+        if mesh is not None and mesh.n_data > 1:
+            # shards must be even: keep only buckets divisible by the
+            # data axis (drops the small power-of-two defaults a 1-row
+            # request would otherwise pad to)
+            keep = [b for b in self.buckets.batch_buckets
+                    if b % mesh.n_data == 0]
+            dropped = [b for b in self.buckets.batch_buckets
+                       if b % mesh.n_data]
+            if not keep:
+                raise ValueError(
+                    f"no batch bucket in {self.buckets.batch_buckets} is "
+                    f"divisible by the mesh data axis ({mesh.n_data}); "
+                    "raise batch_limit or pass batch_buckets that are "
+                    "multiples of it")
+            if dropped:
+                import warnings
+
+                warnings.warn(
+                    f"dropping batch buckets {dropped}: not divisible by "
+                    f"the mesh data axis ({mesh.n_data}); serving with "
+                    f"{keep}", stacklevel=2)
+                self.buckets.batch_buckets = keep
+        self._snap = self._build_snapshot(model, version=0, source="init")
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, source: str, **kwargs) -> "InferenceEngine":
+        """Engine from a checkpoint zip or a checkpoint DIRECTORY (the
+        newest valid checkpoint; corrupt/truncated ones are skipped).
+        A directory also becomes the default ``/reload`` source."""
+        from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+        path = _checkpoint_source(source)
+        model = ModelGuesser.load_model_guess(path)
+        if os.path.isdir(source):
+            kwargs.setdefault("checkpoint_dir", source)
+        eng = cls(model, **kwargs)
+        eng._snap.source = path
+        eng._fingerprint = cls._path_fingerprint(path)
+        return eng
+
+    @staticmethod
+    def _path_fingerprint(path: str) -> Optional[Tuple[float, int]]:
+        from deeplearning4j_tpu.train.faults import checkpoint_fingerprint
+
+        try:
+            return checkpoint_fingerprint(path)
+        except OSError:
+            return None
+
+    def _build_snapshot(self, model, version: int, source) -> "_Snapshot":
+        conf = getattr(model, "conf", None)
+        conf_json = conf.to_json() if hasattr(conf, "to_json") else None
+        fn = self._build_fn(model)
+        if self.mesh is not None:
+            model.params_ = jax.device_put(model.params_,
+                                           self.mesh.replicated())
+            model.state_ = jax.device_put(model.state_,
+                                          self.mesh.replicated())
+        return _Snapshot(model, fn, conf_json, version, source)
+
+    def _build_fn(self, model):
+        """Pure jitted forward for models exposing the functional
+        ``_forward`` (MultiLayerNetwork family). Returns None for other
+        models — they serve through ``model.output`` (no compile-count
+        hook, still batched/bucketed/hot-swapped)."""
+        if not hasattr(model, "_forward"):
+            if not hasattr(model, "output"):
+                raise TypeError(
+                    f"{type(model).__name__} has neither _forward nor "
+                    "output; cannot serve it")
+            return None
+
+        def run(params, state, x, fmask):
+            # trace-time side effect: one bump per distinct input shape
+            # (= per compiled XLA program). Never executes at run time.
+            self._compile_count += 1
+            y, _, _, _, _ = model._forward(params, state, x, train=False,
+                                           rng=None, fmask=fmask)
+            return y
+
+        return jax.jit(run)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def compile_count(self) -> int:
+        """Distinct XLA programs traced by this engine (all versions)."""
+        return self._compile_count
+
+    @property
+    def compile_count_supported(self) -> bool:
+        return self._snap.fn is not None
+
+    @property
+    def model_version(self) -> int:
+        return self._snap.version
+
+    @property
+    def model(self):
+        """The live snapshot's layer graph. NOTE: after a same-arch hot
+        reload this is still the ORIGINAL model object (its layer graph
+        carries the compiled programs); the weights actually served are
+        the snapshot's params — read results through ``infer``, not
+        ``model.output``."""
+        return self._snap.model
+
+    def describe(self) -> dict:
+        snap = self._snap
+        return {
+            "model_type": type(snap.model).__name__,
+            "version": snap.version,
+            "source": str(snap.source),
+            "loaded_at": snap.loaded_at,
+            "num_params": (int(snap.model.num_params())
+                           if hasattr(snap.model, "num_params") else None),
+            "warm": self.warm,
+            "compile_count": self._compile_count,
+            "buckets": repr(self.buckets),
+        }
+
+    # -- inference ----------------------------------------------------------
+    def example_shape(self) -> Optional[Tuple[int, ...]]:
+        """Per-example input shape from the model conf's input type
+        (None when the conf does not declare one — warmup then needs an
+        explicit shape)."""
+        return conf_example_shape(getattr(self._snap.model, "conf", None))
+
+    def infer(self, x, mask=None) -> np.ndarray:
+        """One bucketed forward: pad up to the bucket, run, slice back."""
+        return self.infer_versioned(x, mask)[0]
+
+    def infer_versioned(self, x, mask=None) -> Tuple[np.ndarray, int]:
+        """:meth:`infer` plus the version of the snapshot that actually
+        computed the result. The snapshot reference is read exactly once,
+        so concurrent reloads can never mix model versions inside a call
+        — and re-reading ``model_version`` after the call would
+        misattribute results that raced a hot reload. This is the single
+        serving override point: the HTTP server and ``infer`` both route
+        through it (wrap THIS method for chaos/test tooling; warmup
+        deliberately bypasses it to reach not-yet-published snapshots)."""
+        snap = self._snap
+        return self._infer_on(snap, x, mask), snap.version
+
+    def _infer_on(self, snap: "_Snapshot", x, mask=None) -> np.ndarray:
+        x = np.asarray(x)
+        t_orig = x.shape[1] if x.ndim >= 3 else None
+        xp, mp, n = self.buckets.pad_batch(x, mask)
+        t_padded = xp.shape[1] if t_orig is not None else None
+        self.metrics.record_dispatch(xp.shape[0])
+        if snap.fn is None:
+            m = snap.model
+            if hasattr(m, "output_single"):  # ComputationGraph surface
+                y = m.output_single(xp, masks=None if mp is None else [mp])
+            else:
+                y = m.output(xp, mask=mp)
+        else:
+            xd = xp
+            md = mp
+            if self.mesh is not None:
+                xd = jax.device_put(xp, self.mesh.batch_sharded())
+                if mp is not None:
+                    md = jax.device_put(mp, self.mesh.batch_sharded())
+            y = snap.fn(snap.params, snap.state, xd, md)
+        from deeplearning4j_tpu.serving.buckets import slice_result
+
+        return slice_result(y, n, t_orig, t_padded)
+
+    # -- warmup -------------------------------------------------------------
+    def _warm_snapshot(self, snap: "_Snapshot",
+                       example_shape: Sequence[int],
+                       verbose: bool = False) -> int:
+        """Run every bucket shape through ``snap``'s forward; returns
+        the shape count. Shared by startup warmup and reload re-warming."""
+        shapes = self.buckets.warmup_shapes(tuple(example_shape))
+        for full_shape, with_mask in shapes:
+            x = np.zeros(full_shape, np.float32)
+            mask = (np.ones(full_shape[:2], np.float32)
+                    if with_mask else None)
+            self._infer_on(snap, x, mask)
+            if verbose:
+                print(f"warmup {full_shape} mask={with_mask}", flush=True)
+        return len(shapes)
+
+    def warmup(self, example_shape: Optional[Sequence[int]] = None,
+               verbose: bool = False) -> dict:
+        """Pre-compile every bucket shape so steady-state serving never
+        recompiles. Returns a report {shapes, compiles, seconds}."""
+        shape = tuple(example_shape) if example_shape is not None \
+            else self.example_shape()
+        if shape is None:
+            raise ValueError(
+                "cannot infer the per-example input shape from the model "
+                "conf; pass warmup(example_shape=...)")
+        before = self._compile_count
+        t0 = time.perf_counter()
+        n_shapes = self._warm_snapshot(self._snap, shape, verbose=verbose)
+        self.warm = True
+        return {
+            "shapes": n_shapes,
+            "compiles": self._compile_count - before,
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+
+    # -- hot reload ---------------------------------------------------------
+    def reload(self, source: Optional[str] = None, force: bool = False
+               ) -> dict:
+        """Atomically swap in a new model version.
+
+        ``source``: checkpoint zip, checkpoint directory, or None for
+        the engine's ``checkpoint_dir``. A reload that resolves to the
+        checkpoint already serving is a no-op unless ``force`` (the
+        fingerprint check makes a periodic ``/reload`` poll free).
+
+        Same architecture (identical conf JSON) keeps the compiled
+        forward — the swap is pure params/state, zero recompiles. A
+        different architecture builds and (if the engine was warmed)
+        warms a fresh forward BEFORE the swap, so serving latency never
+        absorbs the compiles.
+        """
+        from deeplearning4j_tpu.train.model_serializer import (
+            ModelGuesser,
+            ModelSerializer,
+        )
+
+        src = source or self.checkpoint_dir
+        if src is None:
+            raise ValueError("no reload source: pass a checkpoint path or "
+                             "configure checkpoint_dir")
+        with self._reload_lock:
+            path = _checkpoint_source(src)
+            fp = self._path_fingerprint(path)
+            if (not force and fp is not None and fp == self._fingerprint
+                    and str(path) == str(self._snap.source)):
+                return {"reloaded": False, "version": self._snap.version,
+                        "path": path, "reason": "unchanged"}
+            # cheap validation + provenance peek before the full restore
+            meta = ModelSerializer.checkpoint_meta(path)
+            new_model = ModelGuesser.load_model_guess(path)
+            old = self._snap
+            conf = getattr(new_model, "conf", None)
+            conf_json = conf.to_json() if hasattr(conf, "to_json") else None
+            same_arch = (conf_json is not None
+                         and conf_json == old.conf_json
+                         and old.fn is not None)
+            if same_arch:
+                # pure weight swap: reuse the old layer graph + compiled
+                # programs; only the param/state pytrees change (same
+                # shapes → jit cache hits, zero recompiles)
+                snap = _Snapshot.__new__(_Snapshot)
+                snap.model = old.model
+                snap.params = new_model.params_
+                snap.state = new_model.state_
+                snap.fn = old.fn
+                snap.conf_json = old.conf_json
+                snap.version = old.version + 1
+                snap.source = path
+                snap.loaded_at = time.time()
+                if self.mesh is not None:
+                    snap.params = jax.device_put(snap.params,
+                                                 self.mesh.replicated())
+                    snap.state = jax.device_put(snap.state,
+                                                self.mesh.replicated())
+            else:
+                snap = self._build_snapshot(new_model,
+                                            version=old.version + 1,
+                                            source=path)
+                if self.warm:
+                    # warm the NEW snapshot before exposing it (its own
+                    # input type — the architecture changed)
+                    shape = (conf_example_shape(conf)
+                             or self.example_shape())
+                    if shape is not None:
+                        self._warm_snapshot(snap, shape)
+            self._snap = snap  # the atomic publish
+            self._fingerprint = fp
+            self.metrics.record_reload()
+            return {"reloaded": True, "version": snap.version, "path": path,
+                    "same_arch": bool(same_arch),
+                    "checkpoint_iteration": meta.get("iteration"),
+                    "checkpoint_epoch": meta.get("epoch")}

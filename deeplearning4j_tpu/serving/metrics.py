@@ -1,0 +1,102 @@
+"""Thread-safe serving metrics: counters, per-bucket hits, latency
+quantiles from a fixed-size ring buffer.
+
+The ring buffer bounds memory under sustained traffic (millions of
+requests must not grow a list); quantiles are computed over the last
+``ring_size`` completed requests, which is the window that matters for
+a live /metrics endpoint. Everything here is plain Python under one
+lock — the costs are nanoseconds against a device dispatch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class ServingMetrics:
+    def __init__(self, ring_size: int = 2048):
+        self._lock = threading.Lock()
+        self._ring_size = int(ring_size)
+        self._lat = [0.0] * self._ring_size  # seconds, ring buffer
+        self._lat_n = 0  # total ever recorded (write head = n % size)
+        self.requests = 0          # requests accepted into the queue
+        self.examples = 0          # rows across accepted requests
+        self.rejects = 0           # ServerOverloadedError rejections
+        self.deadline_exceeded = 0
+        self.errors = 0            # dispatch failures propagated to callers
+        self.dispatches = 0        # device batches launched
+        self.reloads = 0
+        self.bucket_hits: Dict[int, int] = {}  # dispatched bucket size → count
+        self.started_at = time.time()
+
+    # -- recording ----------------------------------------------------------
+    def record_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.examples += int(rows)
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejects += 1
+
+    def record_deadline(self) -> None:
+        with self._lock:
+            self.deadline_exceeded += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_dispatch(self, bucket: int) -> None:
+        with self._lock:
+            self.dispatches += 1
+            self.bucket_hits[int(bucket)] = (
+                self.bucket_hits.get(int(bucket), 0) + 1)
+
+    def record_reload(self) -> None:
+        with self._lock:
+            self.reloads += 1
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat[self._lat_n % self._ring_size] = float(seconds)
+            self._lat_n += 1
+
+    # -- reading ------------------------------------------------------------
+    def latency_quantile(self, q: float) -> Optional[float]:
+        """q in [0, 1] over the ring window; None before any request."""
+        with self._lock:
+            n = min(self._lat_n, self._ring_size)
+            if n == 0:
+                return None
+            window = sorted(self._lat[:n])
+        idx = min(int(q * n), n - 1)
+        return window[idx]
+
+    def snapshot(self, queue_depth: Optional[int] = None) -> dict:
+        """One JSON-ready dict for the /metrics endpoint."""
+        with self._lock:
+            n = min(self._lat_n, self._ring_size)
+            window = sorted(self._lat[:n])
+            out = {
+                "requests": self.requests,
+                "examples": self.examples,
+                "rejects": self.rejects,
+                "deadline_exceeded": self.deadline_exceeded,
+                "errors": self.errors,
+                "dispatches": self.dispatches,
+                "reloads": self.reloads,
+                "bucket_hits": {str(k): v
+                                for k, v in sorted(self.bucket_hits.items())},
+                "uptime_s": round(time.time() - self.started_at, 3),
+                "latency_window": n,
+            }
+        for name, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
+            out[f"latency_{name}_ms"] = (
+                None if n == 0
+                else round(window[min(int(q * n), n - 1)] * 1e3, 3))
+        if queue_depth is not None:
+            out["queue_depth"] = int(queue_depth)
+        return out

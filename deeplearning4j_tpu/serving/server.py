@@ -1,0 +1,230 @@
+"""Stdlib HTTP serving front-end.
+
+``ThreadingHTTPServer`` (one thread per connection) in front of the
+dynamic batcher: handler threads submit into the bounded queue and
+block on their own request, the single batcher worker coalesces across
+them into bucketed device dispatches. No third-party web framework —
+the container ships none, and the stdlib server is enough to express
+the production contract:
+
+- ``POST /predict``        JSON ``{"inputs": [[...]], "mask": [...]?,
+                           "timeout_ms": n?}`` → ``{"outputs": [...]}``
+- ``POST /predict_npy``    raw ``.npy`` body → ``.npy`` response
+                           (zero JSON float cost for bulk clients)
+- ``GET  /healthz``        liveness + model version/warm state
+- ``POST /reload``         hot-swap to the newest valid checkpoint
+                           (optional JSON ``{"path": ...,
+                           "force": bool}``)
+- ``GET  /metrics``        counters, queue depth, per-bucket hits,
+                           latency quantiles (ring buffer)
+
+Typed failures map to transport codes: queue-full backpressure → 503
+(clients back off), request deadline → 504, malformed input → 400,
+shutdown → 503.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher,
+    RequestDeadlineExceeded,
+    ServerOverloadedError,
+    ServerShutdownError,
+    make_dispatcher,
+)
+from deeplearning4j_tpu.serving.engine import InferenceEngine
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class InferenceServer:
+    """Engine + batcher + HTTP listener. ``port=0`` binds an ephemeral
+    port (read it back from ``server.port`` — the test/CI pattern)."""
+
+    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+                 port: int = 8080, batch_limit: int = 32,
+                 max_wait_ms: float = 5.0, queue_limit: int = 256,
+                 default_timeout_s: float = 30.0):
+        self.engine = engine
+        self.metrics: ServingMetrics = engine.metrics
+        self.default_timeout_s = float(default_timeout_s)
+        # bind the socket BEFORE starting the batcher worker: a bind
+        # failure (EADDRINUSE) must raise without leaking a polling
+        # thread nobody holds a handle to
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((host, int(port)), handler)
+        self._httpd.daemon_threads = True
+        # late-bound engine lookup: hot tooling (tests, chaos drills)
+        # may wrap engine.infer after construction. infer_versioned
+        # stamps each request with the snapshot version that actually
+        # computed it (a concurrent hot reload must not mislabel
+        # responses).
+        self.batcher = DynamicBatcher(
+            make_dispatcher(
+                lambda x, mask=None: self.engine.infer_versioned(x, mask),
+                metrics=self.metrics),
+            batch_limit=batch_limit, max_wait_ms=max_wait_ms,
+            queue_limit=queue_limit, metrics=self.metrics)
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+
+    # -- lifecycle ----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "InferenceServer":
+        self._serving = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dl4j-tpu-http")
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._serving = True
+        self._httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        """Stop the listener, then drain the batcher (in-flight requests
+        finish; the bounded queue is served, not dropped)."""
+        if self._serving:  # BaseServer.shutdown deadlocks if the serve
+            self._httpd.shutdown()  # loop never ran
+        self._httpd.server_close()
+        self.batcher.shutdown(drain=True)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- request plumbing (called from handler threads) ----------------------
+    def predict(self, x: np.ndarray, mask=None,
+                timeout_s: Optional[float] = None):
+        """Returns ``(outputs, model_version)`` — the version of the
+        snapshot that actually computed them (stamped in the dispatch,
+        so a concurrent hot reload cannot mislabel the response)."""
+        timeout = self.default_timeout_s if timeout_s is None else timeout_s
+        req = self.batcher.submit(x, mask, timeout=timeout)
+        out = req.result(timeout=timeout)
+        version = req.model_version
+        return out, (self.engine.model_version if version is None
+                     else version)
+
+
+def _make_handler(server: InferenceServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        # quiet by default: per-request stderr lines are noise at load
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        # -- helpers --------------------------------------------------------
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, obj: dict) -> None:
+            self._send(code, json.dumps(obj).encode())
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            return self.rfile.read(n) if n else b""
+
+        def _error(self, e: BaseException) -> None:
+            name = type(e).__name__
+            if isinstance(e, ServerOverloadedError):
+                code = 503
+            elif isinstance(e, RequestDeadlineExceeded):
+                code = 504
+            elif isinstance(e, ServerShutdownError):
+                code = 503
+            elif isinstance(e, (ValueError, KeyError, TypeError)):
+                code = 400
+            else:
+                code = 500
+            self._send_json(code, {"error": name, "message": str(e)})
+
+        # -- routes ---------------------------------------------------------
+        def do_GET(self):  # noqa: N802
+            try:
+                if self.path == "/healthz":
+                    info = server.engine.describe()
+                    self._send_json(200, {"status": "ok", **info})
+                elif self.path == "/metrics":
+                    self._send_json(200, server.metrics.snapshot(
+                        queue_depth=server.batcher.queue_depth()))
+                else:
+                    self._send_json(404, {"error": "NotFound",
+                                          "message": self.path})
+            except BaseException as e:  # never kill the connection thread
+                self._error(e)
+
+        def do_POST(self):  # noqa: N802
+            try:
+                if self.path == "/predict":
+                    self._predict_json()
+                elif self.path == "/predict_npy":
+                    self._predict_npy()
+                elif self.path == "/reload":
+                    self._reload()
+                else:
+                    self._send_json(404, {"error": "NotFound",
+                                          "message": self.path})
+            except BaseException as e:
+                self._error(e)
+
+        def _predict_json(self) -> None:
+            try:
+                payload = json.loads(self._body() or b"{}")
+                x = np.asarray(payload["inputs"], np.float32)
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(f"bad /predict payload: {e}") from e
+            if x.ndim == 1:
+                x = x[None, :]  # single example convenience
+            mask = payload.get("mask")
+            if mask is not None:
+                mask = np.asarray(mask, np.float32)
+            timeout_ms = payload.get("timeout_ms")
+            out, version = server.predict(
+                x, mask,
+                timeout_s=None if timeout_ms is None
+                else float(timeout_ms) / 1e3)
+            self._send_json(200, {"outputs": np.asarray(out).tolist(),
+                                  "model_version": version})
+
+        def _predict_npy(self) -> None:
+            body = self._body()
+            try:
+                x = np.load(io.BytesIO(body), allow_pickle=False)
+            except (ValueError, EOFError, OSError) as e:
+                # empty/truncated bodies raise EOFError/OSError from
+                # np.load — all are the client's malformed input (400)
+                raise ValueError(f"bad /predict_npy body: {e}") from e
+            out, _ = server.predict(np.asarray(x, np.float32))
+            buf = io.BytesIO()
+            np.save(buf, np.asarray(out), allow_pickle=False)
+            self._send(200, buf.getvalue(), ctype="application/x-npy")
+
+        def _reload(self) -> None:
+            body = self._body()
+            payload = json.loads(body) if body else {}
+            try:
+                result = server.engine.reload(
+                    source=payload.get("path"),
+                    force=bool(payload.get("force", False)))
+            except FileNotFoundError as e:
+                self._send_json(409, {"error": "FileNotFoundError",
+                                      "message": str(e)})
+                return
+            self._send_json(200, result)
+
+    return Handler

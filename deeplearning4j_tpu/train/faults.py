@@ -435,6 +435,17 @@ def save_checkpoint(model, directory: str, keep_last: Optional[int] = None,
     return path
 
 
+def checkpoint_fingerprint(path: str) -> Tuple[int, int]:
+    """Cheap identity of a checkpoint's on-disk content:
+    ``(mtime_ns, size)``. The serving engine's hot-reload uses it to
+    make a periodic ``/reload`` poll free — a checkpoint whose
+    fingerprint has not changed is not re-restored. Atomic publishes
+    (``os.replace``) always change both fields together, so a torn
+    read of a half-written file can never fingerprint as current."""
+    st = os.stat(path)
+    return (st.st_mtime_ns, st.st_size)
+
+
 def latest_valid_checkpoint(directory: str) -> str:
     """Newest checkpoint in ``directory`` that passes validation,
     warning about (and skipping over) corrupt/truncated newer ones.

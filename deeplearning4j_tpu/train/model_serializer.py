@@ -117,6 +117,27 @@ class ModelSerializer:
         )
 
     @staticmethod
+    def checkpoint_meta(path: str) -> dict:
+        """Cheap peek at a checkpoint WITHOUT restoring it: the
+        ``meta.json`` contents plus ``conf_json`` (the configuration
+        entry as a string). The serving engine's hot reload compares
+        ``conf_json`` against the live model to decide between a pure
+        weight swap (same architecture — zero recompiles) and a full
+        rebuild+rewarm; /healthz reports ``model_type`` from here."""
+        with zipfile.ZipFile(path, "r") as z:
+            names = set(z.namelist())
+            missing = {CONFIG_ENTRY, COEFFICIENTS_ENTRY} - names
+            if missing:
+                raise ValueError(
+                    f"{path!r} is not a model checkpoint: required entries "
+                    f"{sorted(missing)} are missing")
+            meta = (json.loads(z.read(META_ENTRY).decode())
+                    if META_ENTRY in names else {})
+            meta["conf_json"] = z.read(CONFIG_ENTRY).decode()
+            meta["entries"] = sorted(names)
+        return meta
+
+    @staticmethod
     def restore_normalizer(path: str):
         with zipfile.ZipFile(path, "r") as z:
             if NORMALIZER_ENTRY not in z.namelist():
