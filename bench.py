@@ -524,6 +524,94 @@ def _bench_serving(n_clients: int = 8, n_requests: int = 30,
     return result
 
 
+def _bench_pipeline(ks=(1, 4, 16), n_batches=192, batch=32, d_in=64,
+                    d_hidden=64, d_out=10, epochs=3):
+    """Dispatch-amortization A/B for the pipelined training loop
+    (train/pipeline.py): train the SAME small MLP through the real fit
+    path at steps_per_call K ∈ ``ks`` and measure steady-state optimizer
+    steps/sec. On a dispatch-bound loop (small model, CPU or a fast
+    accelerator) bundling K steps into one lax.scan dispatch should
+    multiply throughput. CPU-measurable by design — this doubles as the
+    no-TPU fallback headline. Writes BENCH_pipeline.json and returns the
+    result dict."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    batches = [
+        DataSet(rng.standard_normal((batch, d_in)).astype(np.float32),
+                np.eye(d_out, dtype=np.float32)[
+                    rng.integers(0, d_out, batch)])
+        for _ in range(n_batches)
+    ]
+
+    def run(k):
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(1e-3)).steps_per_call(k).list()
+                .layer(DenseLayer(n_out=d_hidden, activation="relu"))
+                .layer(OutputLayer(n_out=d_out, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = ExistingDataSetIterator(batches)
+        net.fit(it, epochs=1)  # warmup epoch: compile both step shapes
+        float(net.score_)
+        t0 = time.perf_counter()
+        net.fit(it, epochs=epochs)
+        float(net.score_)  # drain the async dispatch queue
+        dt = time.perf_counter() - t0
+        return epochs * n_batches / dt
+
+    per_k = {f"k{k}": round(run(k), 1) for k in ks}
+    base = per_k.get("k1") or next(iter(per_k.values()))
+    top_k = max(ks)
+    top = per_k[f"k{top_k}"]
+    result = {
+        "metric": f"pipeline_steps_per_sec_k{top_k}",
+        "value": top,
+        "unit": "optimizer steps/sec",
+        "vs_baseline": round(top / base, 3) if base else None,
+        "extra": {
+            "steps_per_sec": per_k,
+            "config": (f"MLP {d_in}->{d_hidden}->{d_out}, batch {batch}, "
+                       f"{n_batches} batches x {epochs} epochs, "
+                       f"K in {list(ks)}"),
+            "platform": jax.devices()[0].platform,
+            "note": ("vs_baseline = steps/sec at the largest K over "
+                     "steps_per_call=1; the acceptance gate is >= 1.5x "
+                     "(dispatch amortization via in-graph lax.scan)"),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_pipeline.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    return result
+
+
+def _tpu_plausible() -> bool:
+    """Whether a TPU backend could come up at all in this container: the
+    axon plugin must be importable (or explicitly requested). When it
+    can't, the supervised TPU attempts would burn 2x their timeout and
+    emit a stale record — the caller falls back to the CPU-measurable
+    pipeline A/B instead (BENCH_r05 failure mode)."""
+    import importlib.util
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        return False
+    jp = os.environ.get("JAX_PLATFORMS", "")
+    if "axon" in jp:
+        return True
+    return jp == "" and importlib.util.find_spec("axon") is not None
+
+
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     compute_dtype = "bfloat16"
@@ -688,6 +776,34 @@ if __name__ == "__main__":
 
             jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_serving()))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "pipeline":
+        # pipelined-loop dispatch-amortization A/B: meaningful on any
+        # backend, writes BENCH_pipeline.json
+        if os.environ.get("BENCH_FORCE_CPU") == "1":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_pipeline()))
+        sys.exit(0)
+    if (os.environ.get("BENCH_CHILD") != "1"
+            and os.environ.get("BENCH_FORCE_SUPERVISED") != "1"
+            and not _tpu_plausible()):
+        # No TPU backend can come up in this container: skip the
+        # supervised attempts entirely (each would block for its full
+        # timeout and the run would end on a stale cached record) and
+        # measure something REAL instead — the CPU-measurable pipeline
+        # dispatch-amortization A/B. The metric name carries the
+        # cpu_fallback marker so no parser mistakes it for a TPU number.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = _bench_pipeline()
+        out["metric"] = "cpu_fallback_" + out["metric"]
+        out["extra"]["tpu_absent"] = (
+            "axon plugin not importable; supervised ResNet-50 attempts "
+            "skipped (set BENCH_FORCE_SUPERVISED=1 to override)")
+        print(json.dumps(out))
         sys.exit(0)
     if os.environ.get("BENCH_CHILD") == "1":
         # child mode: run the real benchmark; exceptions propagate so the

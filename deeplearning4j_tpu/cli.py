@@ -244,6 +244,13 @@ def main(argv=None) -> int:
                     help="ZeRO-1 weight update for --workers>1: updater "
                          "state and update compute sharded 1/N over the "
                          "data axis (numerics unchanged)")
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="pipelined training loop: bundle K optimizer "
+                         "steps into one in-graph lax.scan dispatch "
+                         "(numerics unchanged; ragged tails fall back to "
+                         "single steps)")
+    ap.add_argument("--queue-size", type=int, default=4,
+                    help="async prefetch queue depth of the fit loop")
     ap.add_argument("--skip-nonfinite", action="store_true",
                     help="fault tolerance: skip (don't apply) any step "
                          "whose global gradient is non-finite, and enable "
@@ -298,6 +305,10 @@ def main(argv=None) -> int:
             max_consecutive_bad_steps=args.max_bad_steps,
             keep_last=args.keep_last,
         ))
+    # pipelined-loop knobs: the fit paths (and ParallelWrapper) read them
+    # off the configuration each epoch
+    model.conf.global_conf.steps_per_call = args.steps_per_call
+    model.conf.global_conf.async_queue_size = args.queue_size
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
 

@@ -286,6 +286,131 @@ class BenchmarkDataSetIterator(DataSetIterator):
         return self._example.num_examples()
 
 
+class DeviceDataSet(DataSet):
+    """DataSet whose arrays may already live on device — skips the base
+    class's ``np.asarray`` coercion (which would force a device→host
+    round-trip). Produced by AsyncDataSetIterator's ``device_put`` stage
+    and by ``BatchBundle.unstack``."""
+
+    def __init__(self, features, labels=None, features_mask=None,
+                 labels_mask=None):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+
+
+def _stage_item(d, device_put: bool):
+    """AsyncDataSetIterator's producer-thread H2D stage: move a DataSet's
+    arrays onto the default device (other item types pass through)."""
+    if not (device_put and isinstance(d, DataSet)):
+        return d
+    import jax
+
+    def put(a):
+        return None if a is None else jax.device_put(np.asarray(a))
+
+    return DeviceDataSet(put(d.features), put(d.labels),
+                         put(d.features_mask), put(d.labels_mask))
+
+
+class BatchBundle:
+    """K consecutive same-layout minibatches stacked on a new leading
+    axis (features ``(K, B, ...)``) — one dispatch of the bundled
+    ``lax.scan`` train step (train/pipeline.py) consumes the whole
+    object, executing K optimizer steps. Arrays are host numpy, or
+    committed device arrays when assembled with ``device_put=True`` (the
+    producer thread then pays the H2D transfer, overlapping device
+    compute instead of serializing on the main thread)."""
+
+    __slots__ = ("features", "labels", "features_mask", "labels_mask", "k")
+
+    def __init__(self, features, labels, features_mask, labels_mask,
+                 k: int):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+        self.k = int(k)
+
+    @staticmethod
+    def compat_key(ds: DataSet) -> tuple:
+        """Batches may share a bundle iff these match: shapes, dtypes and
+        mask presence (a scan needs uniform per-step operand layouts)."""
+        def sig(a):
+            return None if a is None else (tuple(a.shape), str(a.dtype))
+
+        return (sig(ds.features), sig(ds.labels), sig(ds.features_mask),
+                sig(ds.labels_mask))
+
+    @classmethod
+    def stack(cls, datasets: List[DataSet],
+              device_put: bool = False) -> "BatchBundle":
+        def st(key):
+            arrs = [getattr(d, key) for d in datasets]
+            if arrs[0] is None:
+                return None
+            out = np.stack([np.asarray(a) for a in arrs])
+            if device_put:
+                import jax
+
+                out = jax.device_put(out)
+            return out
+
+        return cls(st("features"), st("labels"), st("features_mask"),
+                   st("labels_mask"), len(datasets))
+
+    def unstack(self) -> List[DataSet]:
+        """Back to K single batches (views) — the fallback when a
+        consumer cannot run the bundle (e.g. a data-parallel wrapper
+        that must pad this batch size)."""
+        def cut(a, j):
+            return None if a is None else a[j]
+
+        return [
+            DeviceDataSet(self.features[j], cut(self.labels, j),
+                          cut(self.features_mask, j),
+                          cut(self.labels_mask, j))
+            for j in range(self.k)
+        ]
+
+
+def iter_grouped(stream: Iterable, k: int, key: Callable) -> Iterator:
+    """Group consecutive ``key``-compatible items of ``stream`` into
+    length-``k`` lists. The ragged tail — and any run broken by a key
+    change — is yielded item by item (callers route lists to the bundled
+    path and bare items to the single-step path). Shared grouping core
+    of :func:`iter_bundled` and the ComputationGraph fit loop."""
+    buf: List = []
+    cur = None
+    for item in stream:
+        ik = key(item)
+        if buf and ik != cur:
+            for d in buf:
+                yield d
+            buf = []
+        buf.append(item)
+        cur = ik
+        if len(buf) == k:
+            yield buf
+            buf = []
+    for d in buf:
+        yield d
+
+
+def iter_bundled(stream: Iterable[DataSet], k: int,
+                 device_put: bool = False) -> Iterator:
+    """Group consecutive compatible DataSets of ``stream`` into
+    :class:`BatchBundle` objects of exactly ``k`` steps. The ragged tail
+    — and any run broken by a shape/dtype/mask-layout change — is yielded
+    as raw DataSets for the single-step path."""
+    for item in iter_grouped(stream, k, BatchBundle.compat_key):
+        if isinstance(item, list):
+            yield BatchBundle.stack(item, device_put=device_put)
+        else:
+            yield item
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference
     ``AsyncDataSetIterator.java``: the fit loop wraps iterators in this,
@@ -293,17 +418,31 @@ class AsyncDataSetIterator(DataSetIterator):
 
     Host ETL overlaps device compute: while the jitted step runs
     asynchronously on the TPU, the worker thread prepares the next batches.
+
+    Two optional producer-thread stages (train/pipeline.py pairing):
+
+    - ``device_put=True``: each item's arrays are ``jax.device_put`` on
+      the producer, so the H2D transfer overlaps device compute instead
+      of serializing on the main thread (items come back as
+      :class:`DeviceDataSet`; non-DataSet items pass through unchanged).
+    - ``bundle_size=K``: consecutive compatible batches are stacked into
+      :class:`BatchBundle` objects of K steps for the bundled train step;
+      ragged tails fall back to raw DataSets.
     """
 
     _END = object()
 
-    def __init__(self, inner: DataSetIterator, queue_size: int = 4):
+    def __init__(self, inner: DataSetIterator, queue_size: int = 4,
+                 device_put: bool = False, bundle_size: int = 1):
         self.inner = inner
         self.queue_size = int(queue_size)
+        self.device_put = bool(device_put)
+        self.bundle_size = max(1, int(bundle_size))
         self._queue: queue.Queue = queue.Queue(maxsize=self.queue_size)
         self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
         self._peek = None
-        self._exc: Optional[BaseException] = None
+        self._exc_box: list = [None]
         self._start()
 
     def set_pre_processor(self, pp) -> None:
@@ -312,14 +451,57 @@ class AsyncDataSetIterator(DataSetIterator):
         self.inner.set_pre_processor(pp)
 
     def _start(self):
+        # The worker closes over ONLY what it needs — never ``self``: the
+        # thread would otherwise keep the iterator alive, so an abandoned
+        # iterator could never be collected and its producer would stay
+        # blocked in queue.put forever holding batch buffers (observed as
+        # a live producer thread at GC time).
+        stop = threading.Event()
+        exc_box: list = [None]
+        self._stop = stop
+        self._exc_box = exc_box
+        q = self._queue
+        inner = self.inner
+        bundle_size, device_put = self.bundle_size, self.device_put
+        end = self._END
+
+        def put_item(item) -> bool:
+            # stop-aware put: a consumer that stops draining (shutdown,
+            # or the iterator simply being dropped) never strands this
+            # daemon thread
+            while True:
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    if stop.is_set():
+                        return False
+
+        def source():
+            # stop-aware source for the bundler: shutdown() actively
+            # drains the queue, so without this check the producer would
+            # run the inner iterator to exhaustion (forever, on an
+            # unbounded stream) before noticing the teardown
+            while not stop.is_set() and inner.has_next():
+                yield inner.next()
+
         def work():
             try:
-                while self.inner.has_next():
-                    self._queue.put(self.inner.next())
+                if bundle_size > 1:
+                    for item in iter_bundled(source(), bundle_size,
+                                             device_put=device_put):
+                        # bundles were staged by the stacker; ragged-tail
+                        # DataSets still need the device_put stage
+                        if not put_item(_stage_item(item, device_put)):
+                            return
+                else:
+                    for d in source():
+                        if not put_item(_stage_item(d, device_put)):
+                            return
             except BaseException as e:  # surfaced on next()
-                self._exc = e
+                exc_box[0] = e
             finally:
-                self._queue.put(self._END)
+                put_item(end)
 
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
@@ -327,9 +509,9 @@ class AsyncDataSetIterator(DataSetIterator):
     def has_next(self):
         if self._peek is None:
             self._peek = self._queue.get()
-        if self._peek is self._END and self._exc is not None:
+        if self._peek is self._END and self._exc_box[0] is not None:
             # surface worker-thread failures instead of ending the epoch early
-            exc, self._exc = self._exc, None
+            exc, self._exc_box[0] = self._exc_box[0], None
             raise exc
         return self._peek is not self._END
 
@@ -338,19 +520,34 @@ class AsyncDataSetIterator(DataSetIterator):
             raise StopIteration
         d = self._peek
         self._peek = None
-        return self._pp(d)
+        # bundles were pre-processed batch-by-batch on the producer (the
+        # pre-processor lives on ``inner``); never run a DataSet-shaped
+        # _pp over a stacked container
+        return d if isinstance(d, BatchBundle) else self._pp(d)
 
     def shutdown(self):
         """Drain + join the prefetch thread WITHOUT restarting or touching
         the inner iterator (epoch teardown; the caller owns inner.reset())."""
         if self._thread is not None:
+            self._stop.set()
             while self._peek is not self._END:
-                self._peek = self._queue.get()
+                try:
+                    self._peek = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    if not self._thread.is_alive():
+                        # producer aborted on the stop flag without
+                        # landing its END sentinel (queue was full)
+                        self._peek = self._END
             self._thread.join(timeout=5)
             self._thread = None
-        if self._exc is not None:
-            exc, self._exc = self._exc, None
+        if self._exc_box[0] is not None:
+            exc, self._exc_box[0] = self._exc_box[0], None
             raise exc
+
+    def __del__(self):
+        stop = getattr(self, "_stop", None)
+        if stop is not None:
+            stop.set()  # release an abandoned iterator's producer
 
     def reset(self):
         self.shutdown()

@@ -304,6 +304,24 @@ class NeuralNetConfiguration:
         self._g.fault_policy = policy
         return self
 
+    def steps_per_call(self, k: int) -> "NeuralNetConfiguration":
+        """Pipelined training loop (train/pipeline.py): fuse ``k``
+        optimizer steps into ONE jitted lax.scan dispatch — amortizes the
+        Python→XLA dispatch over k steps, advances iteration and the
+        fault-state pytree in-graph and returns per-step losses as a
+        stacked device array (sync-free listener path). Ragged epoch
+        tails fall back to single steps; listeners that need per-step
+        host callbacks (introspection, on_backward_pass) force k=1; tBPTT
+        configurations reject k>1."""
+        self._g.steps_per_call = int(k)
+        return self
+
+    def async_queue_size(self, n: int) -> "NeuralNetConfiguration":
+        """Prefetch queue depth for the fit loops' async data pipeline
+        (default 4)."""
+        self._g.async_queue_size = int(n)
+        return self
+
     def remat_policy(self, policy: Optional[str]) -> "NeuralNetConfiguration":
         """Backward-pass rematerialization: "save_conv_outputs" stores only
         conv outputs for backward and recomputes BN/activation epilogues

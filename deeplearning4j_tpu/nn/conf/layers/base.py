@@ -167,6 +167,8 @@ class GlobalConf:
         remat_policy: Optional[str] = None,
         sharded_update: bool = False,
         fault_policy=None,
+        steps_per_call: int = 1,
+        async_queue_size: int = 4,
     ):
         from deeplearning4j_tpu.updaters import Sgd
 
@@ -201,6 +203,14 @@ class GlobalConf:
         # non-finite gradient guard + dynamic loss scaling folded into the
         # jitted train steps, checkpoint retention for the savers.
         self.fault_policy = fault_policy
+        # Pipelined training loop (train/pipeline.py): bundle K optimizer
+        # steps into one lax.scan dispatch — iteration/fault-state advance
+        # in-graph, per-step losses return as one stacked device array.
+        # 1 = classic per-batch dispatch. Listeners needing per-step host
+        # callbacks force 1; tBPTT configs reject >1.
+        self.steps_per_call = int(steps_per_call)
+        # Prefetch queue depth of the fit loops' AsyncDataSetIterator wrap.
+        self.async_queue_size = int(async_queue_size)
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

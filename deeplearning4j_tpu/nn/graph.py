@@ -419,18 +419,42 @@ class ComputationGraph:
             self._fit_one_epoch(data)
         return self
 
+    @staticmethod
+    def _multi_compat_key(mds):
+        """MultiDataSet analog of BatchBundle.compat_key: shapes/dtypes/
+        mask presence per slot must match for batches to share a bundle."""
+        def sig(a):
+            return None if a is None else (tuple(a.shape), str(a.dtype))
+
+        return (tuple(sig(f) for f in mds.features),
+                tuple(sig(l) for l in mds.labels),
+                tuple(sig(m) for m in mds.features_masks),
+                tuple(sig(m) for m in mds.labels_masks))
+
     def _fit_one_epoch(self, it):
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
         for lst in self.listeners:
             if hasattr(lst, "on_epoch_start"):
                 lst.on_epoch_start(self)
+        k = _pipeline.resolve_steps_per_call(self)
         step = self._get_jit("train", self._make_train_step)
+        bstep = (self._get_jit("train_bundle",
+                               lambda: _pipeline.make_bundled_step(self))
+                 if k > 1 else None)
         use_tbptt = getattr(self.conf, "backprop_type", "standard") == "tbptt"
-        for ds in it:
-            mds = _as_multi(ds)
-            if use_tbptt and mds.features[0].ndim == 3:
-                self._fit_tbptt_batch(mds)
+        stream = (_as_multi(ds) for ds in it)
+        if k > 1:
+            from deeplearning4j_tpu.data.iterators import iter_grouped
+
+            stream = iter_grouped(stream, k, self._multi_compat_key)
+        for item in stream:
+            if isinstance(item, list):
+                self._fit_bundle(bstep, item)
+            elif use_tbptt and item.features[0].ndim == 3:
+                self._fit_tbptt_batch(item)
             else:
-                self._fit_batch(step, mds)
+                self._fit_batch(step, item)
         it.reset()
         self.epoch += 1
         for lst in self.listeners:
@@ -530,6 +554,54 @@ class ComputationGraph:
             lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
+
+    def _fit_bundle(self, bstep, group):
+        """K optimizer steps in one dispatch (train/pipeline.py): per-slot
+        arrays of the K MultiDataSets stack on a new leading axis and the
+        bundled lax.scan step consumes them; iteration and the fault-state
+        carry advance in-graph."""
+        from deeplearning4j_tpu.train import faults as _faults
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
+        k = len(group)
+
+        def stk(slot_arrays):
+            if slot_arrays[0] is None:
+                return None
+            return jnp.stack([jnp.asarray(a) for a in slot_arrays])
+
+        feats = tuple(stk([m.features[i] for m in group])
+                      for i in range(len(group[0].features)))
+        labels = tuple(stk([m.labels[i] for m in group])
+                       for i in range(len(group[0].labels)))
+        fmasks = tuple(stk([m.features_masks[i] for m in group])
+                       for i in range(len(group[0].features_masks)))
+        lmasks = tuple(stk([m.labels_masks[i] for m in group])
+                       for i in range(len(group[0].labels_masks)))
+        rngs = jnp.stack([self._next_rng() for _ in range(k)])
+        policy = self._active_fault_policy()
+        it0 = self.iteration
+        if policy is not None:
+            fstate = self._ensure_fault_state(policy)
+            (self.params_, self.opt_state_, self.state_, self.fault_state_,
+             scores) = bstep(
+                self.params_, self.opt_state_, self.state_, fstate,
+                feats, labels, fmasks, lmasks, rngs,
+                jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        else:
+            self.params_, self.opt_state_, self.state_, scores = bstep(
+                self.params_, self.opt_state_, self.state_,
+                feats, labels, fmasks, lmasks, rngs,
+                jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        self.iteration += k
+        self.score_ = scores[-1]
+        if policy is not None:
+            _faults.check_fault_state(policy, self.fault_state_)
+        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores)
 
     # --------------------------------------------------------------- pretrain
     def pretrain(self, it, epochs: int = 1) -> "ComputationGraph":

@@ -461,15 +461,40 @@ class MultiLayerNetwork:
         return self
 
     def _fit_one_epoch(self, it: DataSetIterator):
+        from deeplearning4j_tpu.data.iterators import BatchBundle, iter_bundled
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
         for lst in self.listeners:
             if hasattr(lst, "on_epoch_start"):
                 lst.on_epoch_start(self)
-        wrapped = AsyncDataSetIterator(it, queue_size=4) if it.async_supported() else it
+        k = _pipeline.resolve_steps_per_call(self)
+        qsize = int(getattr(self.conf.global_conf, "async_queue_size", 4)
+                    or 4)
+        if k > 1:
+            # queue depth counts SLOTS and each slot now stages K
+            # device-resident batches — keep the prefetched-batch budget
+            # (and the device memory it pins) at the k=1 level
+            qsize = max(1, qsize // k)
+        if it.async_supported():
+            # bundling + H2D both move to the producer thread: batches are
+            # stacked into K-step bundles and device_put there, so the
+            # main thread only dispatches
+            wrapped = AsyncDataSetIterator(it, queue_size=qsize,
+                                           device_put=k > 1, bundle_size=k)
+            stream = wrapped
+        else:
+            wrapped = it
+            stream = iter_bundled(it, k) if k > 1 else it
         step = self._get_jit("train", self._make_train_step)
+        bstep = (self._get_jit("train_bundle",
+                               lambda: _pipeline.make_bundled_step(self))
+                 if k > 1 else None)
         use_tbptt = self.conf.backprop_type == "tbptt"
         try:
-            for ds in wrapped:
-                if use_tbptt and ds.features.ndim == 3:
+            for ds in stream:
+                if isinstance(ds, BatchBundle):
+                    self._fit_bundle(bstep, ds)
+                elif use_tbptt and ds.features.ndim == 3:
                     self._fit_tbptt_batch(ds)
                 else:
                     self._fit_batch(step, ds)
@@ -575,6 +600,48 @@ class MultiLayerNetwork:
             lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
+
+    def _fit_bundle(self, bstep, bundle):
+        """K optimizer steps in ONE dispatch (train/pipeline.py): the
+        bundled lax.scan step consumes the stacked batches, advancing
+        iteration and the fault-state carry in-graph; the divergence
+        tripwire is checked once per bundle on the final ``consec``."""
+        from deeplearning4j_tpu.train import faults as _faults
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
+        k = bundle.k
+        features = jnp.asarray(bundle.features)
+        labels = None if bundle.labels is None else jnp.asarray(bundle.labels)
+        fmask = (None if bundle.features_mask is None
+                 else jnp.asarray(bundle.features_mask))
+        lmask = (None if bundle.labels_mask is None
+                 else jnp.asarray(bundle.labels_mask))
+        # same rng stream, same order as k single-step fits — bundled and
+        # unbundled trajectories stay bit-identical
+        rngs = jnp.stack([self._next_rng() for _ in range(k)])
+        policy = self._active_fault_policy()
+        it0 = self.iteration
+        if policy is not None:
+            fstate = self._ensure_fault_state(policy)
+            (self.params_, self.opt_state_, self.state_, self.fault_state_,
+             scores) = bstep(
+                self.params_, self.opt_state_, self.state_, fstate,
+                features, labels, fmask, lmask, rngs,
+                jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        else:
+            self.params_, self.opt_state_, self.state_, scores = bstep(
+                self.params_, self.opt_state_, self.state_,
+                features, labels, fmask, lmask, rngs,
+                jnp.asarray(it0, jnp.int32),
+                jnp.asarray(self.epoch, jnp.int32),
+            )
+        self.iteration += k
+        self.score_ = scores[-1]
+        if policy is not None:
+            _faults.check_fault_state(policy, self.fault_state_)
+        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores)
 
     # ----------------------------------------------------------------- tBPTT
     def tbptt_step_fn(self):

@@ -57,6 +57,7 @@ class SharedTrainingMaster:
             self._capacity = 16384
             self._mesh: Optional[TrainingMesh] = None
             self._sharded = False
+            self._steps: Optional[int] = None
 
         def threshold(self, t: float):
             self._threshold = float(t)
@@ -77,10 +78,19 @@ class SharedTrainingMaster:
             self._sharded = bool(b)
             return self
 
+        def steps_per_call(self, k: int):
+            """Pipelined loop (train/pipeline.py): k encode→gather→decode→
+            update steps fused into one lax.scan dispatch, residual and
+            fault-state carried in-graph. Defaults to the model
+            configuration's knob."""
+            self._steps = int(k)
+            return self
+
         def build(self) -> "SharedTrainingMaster":
             return SharedTrainingMaster(self._threshold, self._capacity,
                                         self._mesh,
-                                        sharded_update=self._sharded)
+                                        sharded_update=self._sharded,
+                                        steps_per_call=self._steps)
 
     @staticmethod
     def builder(threshold: float = 1e-3) -> "Builder":
@@ -88,21 +98,25 @@ class SharedTrainingMaster:
 
     def __init__(self, threshold: float = 1e-3, capacity: int = 16384,
                  mesh: Optional[TrainingMesh] = None,
-                 sharded_update: bool = False):
+                 sharded_update: bool = False,
+                 steps_per_call: Optional[int] = None):
         self.threshold = threshold
         self.capacity = capacity
         self.mesh = mesh if mesh is not None else TrainingMesh(
             data=len(jax.devices())
         )
         self.sharded_update = bool(sharded_update)
+        # None: fall back to the model configuration's steps_per_call
+        self.steps_per_call = steps_per_call
         self._step = None
+        self._bstep = None  # bundled (lax.scan) variant, built on demand
         self._layout = None
         self._residual = None
         self._n_params = None
         self._model_id = None  # step/unravel/residual are per-model
 
     # ------------------------------------------------------------------ step
-    def _build_step(self, model):
+    def _build_step(self, model, steps: int = 1):
         from jax.flatten_util import ravel_pytree
 
         mesh = self.mesh
@@ -199,12 +213,36 @@ class SharedTrainingMaster:
 
         from deeplearning4j_tpu.parallel.mesh import zero1_donation
 
+        K = int(steps)
         if policy is None:
             def step(params, opt_state, state, f, l, fm, lm, residual, rng,
                      iteration, epoch, threshold):
                 return _body(params, opt_state, state, None, f, l, fm, lm,
                              residual, rng, iteration, epoch, threshold)
 
+            if K > 1:
+                # bundled (train/pipeline.py): the residual rides the scan
+                # carry beside params/opt, so the K encode→decode→update
+                # rounds chain their untransmitted-mass bookkeeping
+                # in-graph exactly as K single dispatches would
+                def bstep(params, opt_state, state, f, l, fm, lm, residual,
+                          rngs, iteration, epoch, threshold):
+                    def body(carry, xs):
+                        p, o, r, it = carry
+                        f1, l1, fm1, lm1, rng = xs
+                        p, o, loss, r = _body(p, o, state, None, f1, l1,
+                                              fm1, lm1, r, rng, it, epoch,
+                                              threshold)
+                        return (p, o, r, it + 1), loss
+
+                    (p, o, r, _), scores = jax.lax.scan(
+                        body, (params, opt_state, residual, iteration),
+                        (f, l, fm, lm, rngs))
+                    return p, o, scores, r
+
+                return jax.jit(bstep, donate_argnums=(
+                    zero1_donation(0, 1, 7) if self._layout is not None
+                    else (0, 1, 7)))
             return jax.jit(step, donate_argnums=(
                 zero1_donation(0, 1, 7) if self._layout is not None
                 else (0, 1, 7)))
@@ -214,17 +252,38 @@ class SharedTrainingMaster:
             return _body(params, opt_state, state, fstate, f, l, fm, lm,
                          residual, rng, iteration, epoch, threshold)
 
+        if K > 1:
+            def gbstep(params, opt_state, state, fstate, f, l, fm, lm,
+                       residual, rngs, iteration, epoch, threshold):
+                def body(carry, xs):
+                    p, o, fs, r, it = carry
+                    f1, l1, fm1, lm1, rng = xs
+                    p, o, loss, r, fs = _body(p, o, state, fs, f1, l1, fm1,
+                                              lm1, r, rng, it, epoch,
+                                              threshold)
+                    return (p, o, fs, r, it + 1), loss
+
+                (p, o, fs, r, _), scores = jax.lax.scan(
+                    body, (params, opt_state, fstate, residual, iteration),
+                    (f, l, fm, lm, rngs))
+                return p, o, scores, r, fs
+
+            return jax.jit(gbstep, donate_argnums=(
+                zero1_donation(0, 1, 8) if self._layout is not None
+                else _faults.guard_donation(0, 1, 8)))
         return jax.jit(gstep, donate_argnums=(
             zero1_donation(0, 1, 8) if self._layout is not None
             else _faults.guard_donation(0, 1, 8)))
 
     # ------------------------------------------------------------------- fit
-    def _to_global(self, a, batch_like: bool = True):
+    def _to_global(self, a, batch_like: bool = True, stacked: bool = False):
         from deeplearning4j_tpu.parallel.multihost import host_local_to_global
 
-        return host_local_to_global(
-            a, self.mesh.mesh, P("data") if batch_like else P()
-        )
+        if stacked:  # (K, B, ...) bundle: batch dim is axis 1
+            spec = P(None, "data")
+        else:
+            spec = P("data") if batch_like else P()
+        return host_local_to_global(a, self.mesh.mesh, spec)
 
     def fit(self, model, it: DataSetIterator, epochs: int = 1):
         """Compressed-DP training; batch must divide the data axis.
@@ -267,6 +326,7 @@ class SharedTrainingMaster:
                 getattr(model.conf.global_conf, "fault_policy", None), None)
             if current != getattr(self, "_policy", None):
                 self._step = self._build_step(model)
+                self._bstep = None  # bundled variant traced the old policy
         step = self._step
         policy = getattr(self, "_policy", None)
         if policy is not None:
@@ -296,69 +356,132 @@ class SharedTrainingMaster:
                 lambda: unshard_model_opt_state(model, layout, zref[0]))
         # local batch must split over this host's SHARE of the data axis
         n_local = max(self.mesh.n_data // jax.process_count(), 1)
+        from deeplearning4j_tpu.data.iterators import BatchBundle, iter_bundled
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
+        k = _pipeline.resolve_steps_per_call(
+            model, requested=self.steps_per_call)
+        bstep = None
+        if k > 1:
+            if self._bstep is None:
+                self._bstep = self._build_step(model, steps=k)
+            bstep = self._bstep
         zopt_valid = True
+
+        def run_single(ds):
+            nonlocal zopt, zopt_valid
+            opt_in = zopt if zopt is not None else model.opt_state_
+            batch = (
+                self._to_global(ds.features, True),
+                self._to_global(ds.labels, True),
+                self._to_global(ds.features_mask, True),
+                self._to_global(ds.labels_mask, True),
+            )
+            rng = model._next_rng()
+            # once the step is dispatched it consumes the donated zopt; if
+            # it raises, those buffers are gone and must not be gathered
+            # (batch staging above raising leaves zopt intact)
+            zopt_valid = zopt is None
+            with self.mesh.mesh:
+                if policy is not None:
+                    (model.params_, new_o, model.score_,
+                     self._residual, model.fault_state_) = step(
+                        model.params_, opt_in, model.state_,
+                        model.fault_state_,
+                        *batch,
+                        self._residual,
+                        rng,
+                        jnp.asarray(model.iteration, jnp.int32),
+                        jnp.asarray(model.epoch, jnp.int32),
+                        jnp.asarray(self.threshold, jnp.float32),
+                    )
+                else:
+                    (model.params_, new_o, model.score_,
+                     self._residual) = step(
+                        model.params_, opt_in, model.state_,
+                        *batch,
+                        self._residual,
+                        rng,
+                        jnp.asarray(model.iteration, jnp.int32),
+                        jnp.asarray(model.epoch, jnp.int32),
+                        jnp.asarray(self.threshold, jnp.float32),
+                    )
+            _after_step(new_o, 1)
+            for lst in model.listeners:
+                lst.iteration_done(model, model.iteration, model.epoch)
+
+        def run_bundle(bundle):
+            nonlocal zopt, zopt_valid
+            opt_in = zopt if zopt is not None else model.opt_state_
+            batch = (
+                self._to_global(bundle.features, stacked=True),
+                self._to_global(bundle.labels, stacked=True),
+                self._to_global(bundle.features_mask, stacked=True),
+                self._to_global(bundle.labels_mask, stacked=True),
+            )
+            rngs = jnp.stack([model._next_rng() for _ in range(bundle.k)])
+            it0 = model.iteration
+            zopt_valid = zopt is None
+            with self.mesh.mesh:
+                if policy is not None:
+                    (model.params_, new_o, scores, self._residual,
+                     model.fault_state_) = bstep(
+                        model.params_, opt_in, model.state_,
+                        model.fault_state_,
+                        *batch,
+                        self._residual,
+                        rngs,
+                        jnp.asarray(it0, jnp.int32),
+                        jnp.asarray(model.epoch, jnp.int32),
+                        jnp.asarray(self.threshold, jnp.float32),
+                    )
+                else:
+                    (model.params_, new_o, scores, self._residual) = bstep(
+                        model.params_, opt_in, model.state_,
+                        *batch,
+                        self._residual,
+                        rngs,
+                        jnp.asarray(it0, jnp.int32),
+                        jnp.asarray(model.epoch, jnp.int32),
+                        jnp.asarray(self.threshold, jnp.float32),
+                    )
+            model.score_ = scores[-1]
+            _after_step(new_o, bundle.k)
+            _pipeline.dispatch_bundle_listeners(model, it0, model.epoch,
+                                                scores)
+
+        def _after_step(new_o, n_steps):
+            nonlocal zopt, zopt_valid
+            if zopt is not None:
+                zopt = new_o
+                zref[0] = new_o
+            zopt_valid = True
+            if zopt is None:
+                model.opt_state_ = new_o
+            model.iteration += n_steps
+            if policy is not None:
+                from deeplearning4j_tpu.train import faults as _faults
+
+                _faults.check_fault_state(policy, model.fault_state_)
+
         try:
             for _ in range(epochs):
                 for lst in model.listeners:
                     if hasattr(lst, "on_epoch_start"):
                         lst.on_epoch_start(model)
-                for ds in it:
-                    if ds.features.shape[0] % n_local:
+                stream = iter_bundled(it, k) if k > 1 else it
+                for ds in stream:
+                    b = (ds.features.shape[1] if isinstance(ds, BatchBundle)
+                         else ds.features.shape[0])
+                    if b % n_local:
                         raise ValueError(
-                            f"local batch {ds.features.shape[0]} not "
-                            f"divisible by local data-axis share {n_local}"
+                            f"local batch {b} not divisible by local "
+                            f"data-axis share {n_local}"
                         )
-                    opt_in = zopt if zopt is not None else model.opt_state_
-                    batch = (
-                        self._to_global(ds.features, True),
-                        self._to_global(ds.labels, True),
-                        self._to_global(ds.features_mask, True),
-                        self._to_global(ds.labels_mask, True),
-                    )
-                    rng = model._next_rng()
-                    # once the step is dispatched it consumes the donated
-                    # zopt; if it raises, those buffers are gone and must
-                    # not be gathered (batch staging above raising leaves
-                    # zopt intact)
-                    zopt_valid = zopt is None
-                    with self.mesh.mesh:
-                        if policy is not None:
-                            (model.params_, new_o, model.score_,
-                             self._residual, model.fault_state_) = step(
-                                model.params_, opt_in, model.state_,
-                                model.fault_state_,
-                                *batch,
-                                self._residual,
-                                rng,
-                                jnp.asarray(model.iteration, jnp.int32),
-                                jnp.asarray(model.epoch, jnp.int32),
-                                jnp.asarray(self.threshold, jnp.float32),
-                            )
-                        else:
-                            (model.params_, new_o, model.score_,
-                             self._residual) = step(
-                                model.params_, opt_in, model.state_,
-                                *batch,
-                                self._residual,
-                                rng,
-                                jnp.asarray(model.iteration, jnp.int32),
-                                jnp.asarray(model.epoch, jnp.int32),
-                                jnp.asarray(self.threshold, jnp.float32),
-                            )
-                    if zopt is not None:
-                        zopt = new_o
-                        zref[0] = new_o
-                    zopt_valid = True
-                    if zopt is None:
-                        model.opt_state_ = new_o
-                    model.iteration += 1
-                    if policy is not None:
-                        from deeplearning4j_tpu.train import faults as _faults
-
-                        _faults.check_fault_state(policy, model.fault_state_)
-                    for lst in model.listeners:
-                        lst.iteration_done(model, model.iteration,
-                                           model.epoch)
+                    if isinstance(ds, BatchBundle):
+                        run_bundle(ds)
+                    else:
+                        run_single(ds)
                 it.reset()
                 model.epoch += 1
                 for lst in model.listeners:

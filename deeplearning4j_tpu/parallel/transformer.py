@@ -87,7 +87,8 @@ class DistributedLMTrainer:
                  clip_norm: Optional[float] = None,
                  remat_blocks: bool = False,
                  sharded_update: bool = False,
-                 fault_policy=None):
+                 fault_policy=None,
+                 steps_per_call: int = 1):
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
@@ -141,7 +142,12 @@ class DistributedLMTrainer:
             # token all-to-all as in the pure-EP layout. Exact-parity
             # coverage: tests/test_moe.py (data×pipe×expert mesh).
         self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
+        # pipelined loop (train/pipeline.py): fit_bundle fuses K steps
+        # into one lax.scan dispatch; this is the default bundle size
+        # fit_bundle infers when handed flat (K*B, T) arrays
+        self.steps_per_call = max(1, int(steps_per_call))
         self._step = None
+        self._bstep = None
 
     @property
     def bubble_fraction(self) -> float:
@@ -375,9 +381,10 @@ class DistributedLMTrainer:
         self._z_sh = jax.tree_util.tree_unflatten(treedef, out)
         return self._z_sh
 
-    def build_step(self):
-        if self._step is not None:
-            return self._step
+    def _make_body_and_shardings(self):
+        """The per-step update body + the sharding trees, shared by the
+        single-step jit (build_step) and the bundled lax.scan jit
+        (build_bundle_step) so both trace the identical math."""
         cfg = self.cfg
         mesh = self.mesh
         upd = self.model.updater
@@ -455,40 +462,113 @@ class DistributedLMTrainer:
             new_fstate = _faults.advance_fault_state(policy, fstate, finite)
             return out_p, out_o, new_fstate, loss
 
-        if policy is None:
-            def step(params, opt_state, ids, targets, t):
-                return _body(params, opt_state, None, ids, targets, t)
-        else:
-            def step(params, opt_state, fstate, ids, targets, t):
-                return _body(params, opt_state, fstate, ids, targets, t)
-
-        data_spec = sh(P("data", "seq")) if mesh.shape["seq"] > 1 else sh(P("data"))
         # opt-state sharding: the param shardings as a prefix tree (slot
         # dicts mirror their param's layout; explicit, not inferred — a
         # propagation choice that differs from place() would break the
         # donated-buffer aliasing), or the explicit ZeRO-1 data-extended
         # shardings in sharded_update mode
-        from deeplearning4j_tpu.parallel.mesh import zero1_donation
+        seq = mesh.shape["seq"] > 1
+        shardings = {
+            "p_sh": p_sh,
+            "o_sh": z_sh if self.sharded_update else p_sh,
+            "data_spec": sh(P("data", "seq")) if seq else sh(P("data")),
+            # (K, B, T) bundles: batch/seq dims shift right by one
+            "bdata_spec": (sh(P(None, "data", "seq")) if seq
+                           else sh(P(None, "data"))),
+            "repl": sh(P()),
+        }
+        return _body, shardings
 
-        o_sh = z_sh if self.sharded_update else p_sh
+    def _donation(self):
+        from deeplearning4j_tpu.parallel.mesh import zero1_donation
+        from deeplearning4j_tpu.train import faults as _faults
+
+        if self.sharded_update:
+            return zero1_donation(0, 1)
+        if self._policy is not None:
+            return _faults.guard_donation(0, 1)
+        return (0, 1)
+
+    def build_step(self):
+        if self._step is not None:
+            return self._step
+        _body, sh = self._make_body_and_shardings()
+        policy = self._policy
+        p_sh, o_sh, data_spec, repl = (sh["p_sh"], sh["o_sh"],
+                                       sh["data_spec"], sh["repl"])
+
         if policy is None:
+            def step(params, opt_state, ids, targets, t):
+                return _body(params, opt_state, None, ids, targets, t)
+
             self._step = jax.jit(
                 step,
                 in_shardings=(p_sh, o_sh, data_spec, data_spec, None),
                 out_shardings=(p_sh, o_sh, None),
-                donate_argnums=(zero1_donation(0, 1) if self.sharded_update
-                                else (0, 1)),
+                donate_argnums=self._donation(),
             )
         else:
-            repl = sh(P())
+            def step(params, opt_state, fstate, ids, targets, t):
+                return _body(params, opt_state, fstate, ids, targets, t)
+
             self._step = jax.jit(
                 step,
                 in_shardings=(p_sh, o_sh, repl, data_spec, data_spec, None),
                 out_shardings=(p_sh, o_sh, repl, None),
-                donate_argnums=(zero1_donation(0, 1) if self.sharded_update
-                                else _faults.guard_donation(0, 1)),
+                donate_argnums=self._donation(),
             )
         return self._step
+
+    def build_bundle_step(self):
+        """Bundled (train/pipeline.py) variant of the jitted step: a
+        lax.scan over the leading K axis of stacked (K, B, T) id/target
+        arrays executes K optimizer steps per dispatch, updater clock
+        advancing in-graph; per-step losses return stacked (K,)."""
+        if self._bstep is not None:
+            return self._bstep
+        _body, sh = self._make_body_and_shardings()
+        policy = self._policy
+        p_sh, o_sh, bdata_spec, repl = (sh["p_sh"], sh["o_sh"],
+                                        sh["bdata_spec"], sh["repl"])
+
+        if policy is None:
+            def bundle(params, opt_state, ids_k, tgt_k, t0):
+                def body(carry, xs):
+                    p, o, t = carry
+                    ids, tgt = xs
+                    p, o, loss = _body(p, o, None, ids, tgt, t)
+                    return (p, o, t + 1), loss
+
+                (p, o, _), scores = jax.lax.scan(
+                    body, (params, opt_state, t0), (ids_k, tgt_k))
+                return p, o, scores
+
+            self._bstep = jax.jit(
+                bundle,
+                in_shardings=(p_sh, o_sh, bdata_spec, bdata_spec, None),
+                out_shardings=(p_sh, o_sh, None),
+                donate_argnums=self._donation(),
+            )
+        else:
+            def bundle(params, opt_state, fstate, ids_k, tgt_k, t0):
+                def body(carry, xs):
+                    p, o, fs, t = carry
+                    ids, tgt = xs
+                    p, o, fs, loss = _body(p, o, fs, ids, tgt, t)
+                    return (p, o, fs, t + 1), loss
+
+                (p, o, fs, _), scores = jax.lax.scan(
+                    body, (params, opt_state, fstate, t0), (ids_k, tgt_k))
+                return p, o, fs, scores
+
+            self._bstep = jax.jit(
+                bundle,
+                in_shardings=(p_sh, o_sh, repl, bdata_spec, bdata_spec,
+                              None),
+                out_shardings=(p_sh, o_sh, repl, None),
+                donate_argnums=self._donation(),
+            )
+        return self._bstep
 
     def place(self):
         """Device_put params/opt_state with their target shardings."""
@@ -534,6 +614,50 @@ class DistributedLMTrainer:
         if self.fault_state_ is None or "loss_scale" not in self.fault_state_:
             return None
         return float(self.fault_state_["loss_scale"])
+
+    def fit_bundle(self, ids, targets):
+        """K optimizer steps in ONE dispatch (train/pipeline.py): ``ids``
+        and ``targets`` are stacked (K, B, T) int arrays — flat (K*B, T)
+        arrays are reshaped using the trainer's ``steps_per_call``.
+        Returns the per-step losses as a (K,) device array WITHOUT a host
+        sync; ``model.score_`` holds the last step's loss (read
+        ``float(model.score_)`` to sync). Bit-identical to K sequential
+        ``fit_batch`` calls."""
+        from deeplearning4j_tpu.train import faults as _faults
+
+        ids = jnp.asarray(ids, jnp.int32)
+        targets = jnp.asarray(targets, jnp.int32)
+        if ids.ndim == 2:
+            k = self.steps_per_call
+            ids = ids.reshape(k, ids.shape[0] // k, ids.shape[1])
+            targets = targets.reshape(k, targets.shape[0] // k,
+                                      targets.shape[1])
+        k = int(ids.shape[0])
+        step = self.build_bundle_step()
+        t0 = jnp.asarray(self.model.iteration + 1, jnp.int32)
+        if self._policy is not None:
+            if self.fault_state_ is None:
+                self.fault_state_ = _faults.init_fault_state(
+                    self._policy,
+                    self._policy.scaling_active(self._compute_dtype),
+                    start_step=self.model.iteration)
+            with self.mesh.mesh:
+                (self.model.params_, self.model.opt_state_,
+                 self.fault_state_, scores) = step(
+                    self.model.params_, self.model.opt_state_,
+                    self.fault_state_, ids, targets, t0)
+            self.model.iteration += k
+            self.model.score_ = scores[-1]
+            # divergence tripwire once per bundle, on the final consec
+            _faults.check_fault_state(self._policy, self.fault_state_)
+        else:
+            with self.mesh.mesh:
+                (self.model.params_, self.model.opt_state_,
+                 scores) = step(self.model.params_, self.model.opt_state_,
+                                ids, targets, t0)
+            self.model.iteration += k
+            self.model.score_ = scores[-1]
+        return scores
 
     def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
         from deeplearning4j_tpu.train import faults as _faults

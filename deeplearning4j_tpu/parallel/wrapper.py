@@ -46,9 +46,17 @@ class ParallelWrapper:
             self._avg_freq = 1
             self._report = False
             self._sharded: Optional[bool] = None
+            self._steps: Optional[int] = None
 
         def workers(self, n: int) -> "ParallelWrapper.Builder":
             self._workers = int(n)
+            return self
+
+        def steps_per_call(self, k: int) -> "ParallelWrapper.Builder":
+            """Pipelined loop (train/pipeline.py): bundle k optimizer
+            steps into one lax.scan dispatch. Defaults to the
+            configuration's ``steps_per_call`` knob."""
+            self._steps = int(k)
             return self
 
         def sharded_update(self, b: bool) -> "ParallelWrapper.Builder":
@@ -83,7 +91,8 @@ class ParallelWrapper:
 
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self.model, self._workers, self._prefetch,
-                                   sharded_update=self._sharded)
+                                   sharded_update=self._sharded,
+                                   steps_per_call=self._steps)
 
     @staticmethod
     def builder(model) -> "Builder":
@@ -91,7 +100,8 @@ class ParallelWrapper:
 
     def __init__(self, model, workers: Optional[int] = None, prefetch: int = 4,
                  mesh: Optional[TrainingMesh] = None,
-                 sharded_update: Optional[bool] = None):
+                 sharded_update: Optional[bool] = None,
+                 steps_per_call: Optional[int] = None):
         self.model = model
         n_dev = len(jax.devices())
         workers = workers or n_dev
@@ -108,6 +118,10 @@ class ParallelWrapper:
             sharded_update = bool(getattr(
                 model.conf.global_conf, "sharded_update", False))
         self.sharded_update = bool(sharded_update)
+        # None: fall back to the configuration's steps_per_call knob
+        self.steps_per_call = steps_per_call
+        self._bstep = None
+        self._bstep_key = None
         self._zstep = None
         self._zlayout = None
         # ComputationGraph train steps take per-input tuples; MLN takes arrays
@@ -170,10 +184,53 @@ class ParallelWrapper:
         self._tbptt_guarded = guarded
         return self._tbptt_step
 
+    def _get_bundle_step(self, guarded: bool, policy, k: int):
+        """Cached K-step bundled jitted step: the model's raw step under a
+        lax.scan, shardings like the single step except batch arrays are
+        (K, B, ...) sharded over "data" on dim 1 (ZeRO-1 mode delegates
+        to zero.make_sharded_train_step's bundled variant)."""
+        key = (guarded, policy, k, self.sharded_update)
+        if self._bstep is not None and self._bstep_key == key:
+            return self._bstep
+        if self.sharded_update:
+            from deeplearning4j_tpu.parallel.zero import make_sharded_train_step
+
+            self._bstep, _ = make_sharded_train_step(
+                self.model, self.mesh, policy=policy, steps_per_call=k)
+        else:
+            from deeplearning4j_tpu.train import pipeline as _pipeline
+            from deeplearning4j_tpu.train.faults import guard_donation
+
+            raw = _pipeline.bundled_scan(self.model.train_step_fn(), guarded)
+            repl = self.mesh.replicated()
+            bb = self.mesh.spec(None, "data")
+            if guarded:
+                in_sh = (repl, repl, repl, repl, bb, bb, bb, bb, repl,
+                         repl, repl)
+                out_sh = (repl, repl, repl, repl, repl)
+                donate = guard_donation(0, 1, 2)
+            else:
+                in_sh = (repl, repl, repl, bb, bb, bb, bb, repl, repl, repl)
+                out_sh = (repl, repl, repl, repl)
+                donate = (0, 1, 2)
+            self._bstep = jax.jit(raw, in_shardings=in_sh,
+                                  out_shardings=out_sh,
+                                  donate_argnums=donate)
+        self._bstep_key = key
+        return self._bstep
+
     def fit(self, it: DataSetIterator, epochs: int = 1) -> None:
         """Data-parallel fit; final partial batches are padded with
         repeated examples whose loss contribution is zeroed by a weighted
-        label mask (gradient-exact, no repeated-example bias)."""
+        label mask (gradient-exact, no repeated-example bias).
+
+        With ``steps_per_call`` (constructor/builder knob or the
+        configuration's) > 1, K compatible consecutive batches run as ONE
+        bundled dispatch (train/pipeline.py); batches that need padding
+        and the ragged tail fall back to the single-step path."""
+        from deeplearning4j_tpu.data.iterators import BatchBundle, iter_bundled
+        from deeplearning4j_tpu.train import pipeline as _pipeline
+
         m = self.model
         use_tbptt = m.conf.backprop_type == "tbptt"
         if use_tbptt and self._is_graph:
@@ -185,6 +242,19 @@ class ParallelWrapper:
         guarded = policy is not None
         if guarded:
             m._ensure_fault_state(policy)
+        k = 1
+        if not self._is_graph:
+            # CG batches are per-input tuples; bundling covers the
+            # array-batch (MultiLayerNetwork) paths
+            k = _pipeline.resolve_steps_per_call(
+                m, requested=self.steps_per_call)
+        if k > 1:
+            b = getattr(it, "batch", lambda: 0)()
+            if b and b % self.mesh.n_data:
+                # every batch needs padding, so no assembled bundle could
+                # ever run — don't pay the stack/unstack round-trip per
+                # bundle for a fit that is single-step anyway
+                k = 1
         zopt = None
         if self.sharded_update:
             if use_tbptt:
@@ -224,62 +294,114 @@ class ParallelWrapper:
                 self._build_step(guarded=guarded)
                 self._step_policy = policy
             step = self._step
+        bstep = self._get_bundle_step(guarded, policy, k) if k > 1 else None
         n_data = self.mesh.n_data
         zopt_valid = True
+
+        def run_single(ds):
+            nonlocal zopt, zopt_valid
+            opt_in = zopt if zopt is not None else m.opt_state_
+            batch = self._pack_batch(ds, n_data)
+            rng = m._next_rng()
+            # once the step is dispatched it consumes the donated zopt; if
+            # it raises, those buffers are gone and must not be gathered
+            # (batch packing above raising leaves zopt intact)
+            zopt_valid = zopt is None
+            if guarded:
+                (new_p, new_o, m.state_, m.fault_state_, m.score_) = step(
+                    m.params_, opt_in, m.state_, m.fault_state_,
+                    *batch, rng,
+                    jnp.asarray(m.iteration, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
+            else:
+                new_p, new_o, m.state_, m.score_ = step(
+                    m.params_, opt_in, m.state_, *batch, rng,
+                    jnp.asarray(m.iteration, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
+            m.params_ = new_p
+            _after_step(new_o, 1)
+            for lst in m.listeners:
+                lst.iteration_done(m, m.iteration, m.epoch)
+
+        def run_bundle(bundle):
+            nonlocal zopt, zopt_valid
+            opt_in = zopt if zopt is not None else m.opt_state_
+            features = jnp.asarray(bundle.features)
+            labels = (None if bundle.labels is None
+                      else jnp.asarray(bundle.labels))
+            fmask = (None if bundle.features_mask is None
+                     else jnp.asarray(bundle.features_mask))
+            lmask = (None if bundle.labels_mask is None
+                     else jnp.asarray(bundle.labels_mask))
+            rngs = jnp.stack([m._next_rng() for _ in range(bundle.k)])
+            it0 = m.iteration
+            zopt_valid = zopt is None
+            if guarded:
+                (new_p, new_o, m.state_, m.fault_state_, scores) = bstep(
+                    m.params_, opt_in, m.state_, m.fault_state_,
+                    features, labels, fmask, lmask, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
+            else:
+                new_p, new_o, m.state_, scores = bstep(
+                    m.params_, opt_in, m.state_,
+                    features, labels, fmask, lmask, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(m.epoch, jnp.int32),
+                )
+            m.params_ = new_p
+            m.score_ = scores[-1]
+            _after_step(new_o, bundle.k)
+            _pipeline.dispatch_bundle_listeners(m, it0, m.epoch, scores)
+
+        def _after_step(new_o, n_steps):
+            nonlocal zopt, zopt_valid
+            if zopt is not None:
+                zopt = new_o
+                zref[0] = new_o
+            zopt_valid = True
+            if zopt is None:
+                m.opt_state_ = new_o
+            m.iteration += n_steps
+            if guarded:
+                from deeplearning4j_tpu.train import faults as _faults
+
+                _faults.check_fault_state(policy, m.fault_state_)
+
         try:
             for _ in range(epochs):
                 for lst in m.listeners:
                     if hasattr(lst, "on_epoch_start"):
                         lst.on_epoch_start(m)
                 async_ok = getattr(it, "async_supported", lambda: False)()
-                wrapped = (AsyncDataSetIterator(it, self.prefetch)
+                # each queue slot holds K batches under bundling — scale
+                # the slot count down to keep the staged-batch budget flat
+                depth = self.prefetch if k <= 1 else max(1, self.prefetch // k)
+                wrapped = (AsyncDataSetIterator(it, depth, bundle_size=k)
                            if async_ok else it)
+                stream = wrapped
+                if k > 1 and wrapped is it:
+                    stream = iter_bundled(it, k)
                 try:
                     with self.mesh.mesh:
-                        for ds in wrapped:
+                        for ds in stream:
+                            if isinstance(ds, BatchBundle):
+                                if ds.features.shape[1] % n_data:
+                                    # needs padding: the per-batch label
+                                    # mask rewrite can't ride a stacked
+                                    # bundle — single-step path
+                                    for d in ds.unstack():
+                                        run_single(d)
+                                else:
+                                    run_bundle(ds)
+                                continue
                             if use_tbptt and ds.features.ndim == 3:
                                 self._fit_tbptt_sharded(ds, n_data)
                                 continue
-                            opt_in = zopt if zopt is not None else m.opt_state_
-                            batch = self._pack_batch(ds, n_data)
-                            rng = m._next_rng()
-                            # once the step is dispatched it consumes the
-                            # donated zopt; if it raises, those buffers
-                            # are gone and must not be gathered (batch
-                            # packing above raising leaves zopt intact)
-                            zopt_valid = zopt is None
-                            if guarded:
-                                (new_p, new_o, m.state_, m.fault_state_,
-                                 m.score_) = step(
-                                    m.params_, opt_in, m.state_,
-                                    m.fault_state_, *batch, rng,
-                                    jnp.asarray(m.iteration, jnp.int32),
-                                    jnp.asarray(m.epoch, jnp.int32),
-                                )
-                            else:
-                                new_p, new_o, m.state_, m.score_ = step(
-                                    m.params_, opt_in, m.state_,
-                                    *batch, rng,
-                                    jnp.asarray(m.iteration, jnp.int32),
-                                    jnp.asarray(m.epoch, jnp.int32),
-                                )
-                            m.params_ = new_p
-                            if zopt is not None:
-                                zopt = new_o
-                                zref[0] = new_o
-                            zopt_valid = True
-                            if zopt is None:
-                                m.opt_state_ = new_o
-                            m.iteration += 1
-                            if guarded:
-                                from deeplearning4j_tpu.train import (
-                                    faults as _faults,
-                                )
-
-                                _faults.check_fault_state(
-                                    policy, m.fault_state_)
-                            for lst in m.listeners:
-                                lst.iteration_done(m, m.iteration, m.epoch)
+                            run_single(ds)
                 finally:
                     if wrapped is not it:
                         wrapped.shutdown()

@@ -292,7 +292,8 @@ def unshard_model_opt_state(model, layout: ShardedUpdateLayout,
                         else dict(zip(names, merged)))
 
 
-def make_sharded_train_step(model, mesh, policy=None):
+def make_sharded_train_step(model, mesh, policy=None,
+                            steps_per_call: int = 1):
     """Jitted ZeRO-1 DP train step over ``mesh`` (a TrainingMesh).
 
     Same signature as the replicated step the wrapper/multihost facade
@@ -308,6 +309,11 @@ def make_sharded_train_step(model, mesh, policy=None):
     (per-shard verdicts could disagree and desynchronize the replicas
     forever). Loss scaling runs on the fp32 masters exactly as in the
     replicated guarded step, keeping sharded-vs-replicated parity.
+
+    ``steps_per_call`` > 1 returns the BUNDLED variant
+    (train/pipeline.py): the same body under a lax.scan over K stacked
+    batches — batch arrays are (K, B, ...) sharded over "data" on dim 1,
+    rngs are stacked (K, key), per-step scores return as a (K,) array.
     """
     names, layers, params = _model_layer_view(model)
     layout = ShardedUpdateLayout(layers, params, mesh.n_data)
@@ -374,12 +380,26 @@ def make_sharded_train_step(model, mesh, policy=None):
     repl = mesh.replicated()
     batch = mesh.batch_sharded()
     zshard = NamedSharding(mesh.mesh, P("data", None))
+    K = int(steps_per_call)
+    if K > 1:
+        from deeplearning4j_tpu.train.pipeline import bundled_scan
+
+        bbatch = NamedSharding(mesh.mesh, P(None, "data"))
     if policy is None:
         def step(params, zopt, state, features, labels, fmask, lmask, rng,
                  iteration, epoch):
             return _body(params, zopt, state, None, features, labels, fmask,
                          lmask, rng, iteration, epoch)
 
+        if K > 1:
+            jitted = jax.jit(
+                bundled_scan(step, guarded=False),
+                in_shardings=(repl, zshard, repl, bbatch, bbatch, bbatch,
+                              bbatch, repl, repl, repl),
+                out_shardings=(repl, zshard, repl, repl),
+                donate_argnums=zero1_donation(0, 1, 2),
+            )
+            return jitted, layout
         jitted = jax.jit(
             step,
             in_shardings=(repl, zshard, repl, batch, batch, batch, batch,
@@ -394,6 +414,15 @@ def make_sharded_train_step(model, mesh, policy=None):
         return _body(params, zopt, state, fstate, features, labels, fmask,
                      lmask, rng, iteration, epoch)
 
+    if K > 1:
+        jitted = jax.jit(
+            bundled_scan(gstep, guarded=True),
+            in_shardings=(repl, zshard, repl, repl, bbatch, bbatch, bbatch,
+                          bbatch, repl, repl, repl),
+            out_shardings=(repl, zshard, repl, repl, repl),
+            donate_argnums=zero1_donation(0, 1, 2),
+        )
+        return jitted, layout
     jitted = jax.jit(
         gstep,
         in_shardings=(repl, zshard, repl, repl, batch, batch, batch, batch,

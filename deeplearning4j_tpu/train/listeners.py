@@ -104,7 +104,14 @@ def _hook_recipients(listeners, name: str,
 
 class ScoreIterationListener(TrainingListener):
     """Logs/prints the score every N iterations (reference
-    ``ScoreIterationListener``)."""
+    ``ScoreIterationListener``).
+
+    Bundle-aware (train/pipeline.py): under ``steps_per_call>1`` the
+    ``bundle_done`` hook replaces the per-step ``iteration_done`` calls —
+    the per-step losses arrive as one stacked device array whose host
+    copy is fetched at most once per bundle (and only on bundles that
+    contain a reporting iteration), never one ``model.score()`` sync per
+    hit."""
 
     def __init__(self, print_iterations: int = 10, printer: Optional[Callable[[str], None]] = None):
         self.print_iterations = max(1, int(print_iterations))
@@ -114,10 +121,24 @@ class ScoreIterationListener(TrainingListener):
         if iteration % self.print_iterations == 0:
             self.printer(f"Score at iteration {iteration} is {model.score():.6f}")
 
+    def bundle_done(self, model, it0, epoch, scores):
+        hits = [j for j in range(len(scores))
+                if (it0 + j + 1) % self.print_iterations == 0]
+        if not hits:
+            return
+        host = scores.host()  # one fetch per bundle, shared by all hits
+        for j in hits:
+            self.printer(f"Score at iteration {it0 + j + 1} is "
+                         f"{float(host[j]):.6f}")
+
 
 class CollectScoresIterationListener(TrainingListener):
     """Collects (iteration, score) pairs (reference
-    ``CollectScoresIterationListener``)."""
+    ``CollectScoresIterationListener``).
+
+    Bundle-aware: with ``steps_per_call>1`` the scores of a whole bundle
+    are recorded from ONE deferred host fetch of the stacked device
+    losses instead of a ``model.score()`` sync per sampled step."""
 
     def __init__(self, frequency: int = 1):
         self.frequency = max(1, int(frequency))
@@ -126,6 +147,15 @@ class CollectScoresIterationListener(TrainingListener):
     def iteration_done(self, model, iteration, epoch):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, model.score()))
+
+    def bundle_done(self, model, it0, epoch, scores):
+        hits = [j for j in range(len(scores))
+                if (it0 + j + 1) % self.frequency == 0]
+        if not hits:
+            return
+        host = scores.host()  # one fetch per bundle
+        for j in hits:
+            self.scores.append((it0 + j + 1, float(host[j])))
 
 
 class PerformanceListener(TrainingListener):
@@ -167,6 +197,36 @@ class PerformanceListener(TrainingListener):
             self.printer(msg)
             self._last_time = now
             self._last_iter = iteration
+            self._samples = 0
+
+    def bundle_done(self, model, it0, epoch, scores):
+        """Bundled fits time whole bundles: the per-step replay fires
+        back-to-back after the fused dispatch, so per-step wall-clock
+        deltas inside a bundle are ~0 and would report absurd rates."""
+        k = len(scores)
+        bs = getattr(model, "last_batch_size", None)
+        if bs:
+            self._samples += bs * k
+        it = it0 + k
+        if self._last_time is None:
+            self._last_time = time.perf_counter()
+            self._last_iter = it
+            self._samples = 0
+            return
+        if (it - self._last_iter) >= self.frequency:
+            now = time.perf_counter()
+            dt = now - self._last_time
+            batches = it - self._last_iter
+            self.last_batches_per_sec = batches / dt
+            msg = f"iteration {it}: {self.last_batches_per_sec:.2f} batches/sec"
+            if bs:
+                self.last_samples_per_sec = self._samples / dt
+                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
+            if self.report_score:
+                msg += f", score {float(scores.host()[-1]):.6f}"
+            self.printer(msg)
+            self._last_time = now
+            self._last_iter = it
             self._samples = 0
 
 
@@ -218,6 +278,11 @@ class EvaluativeListener(TrainingListener):
         self.iterator = iterator
         self.frequency = max(1, int(frequency))
         self.invocation = invocation
+        # per-iteration evaluations run the MODEL as of that iteration;
+        # a bundled fit's post-bundle replay only has end-of-bundle
+        # params, so iteration-end invocation forces steps_per_call=1
+        # (train/pipeline.py); epoch-end evaluation bundles fine
+        self.requires_per_step_state = invocation == "iteration_end"
         self.printer = printer or (lambda s: log.info(s))
         self.callback = callback
         self.evaluations: List[object] = []
@@ -275,6 +340,13 @@ class CheckpointListener(TrainingListener):
         self.save_every_n_iterations = save_every_n_iterations
         self.save_every_minutes = save_every_minutes
         self.keep_mode = keep_mode
+        # iteration/wall-clock-triggered saves must observe the model AT
+        # each iteration; a bundled fit (train/pipeline.py) only has
+        # end-of-bundle state when it replays iteration_done, so these
+        # triggers force steps_per_call=1 (epoch-triggered checkpoints
+        # bundle fine — on_epoch_end always sees real state)
+        self.requires_per_step_state = bool(save_every_n_iterations
+                                            or save_every_minutes)
         self.keep_last = keep_last
         self.keep_every = keep_every
         self.serializer = serializer
@@ -373,6 +445,11 @@ class ProfilerListener(TrainingListener):
     and stops after ``num_iterations``; the trace opens in TensorBoard's
     profile plugin or Perfetto."""
 
+    # the start/stop window brackets specific iterations' device work —
+    # replayed post-bundle both hooks would fire back to back around no
+    # dispatches; forces steps_per_call=1 (train/pipeline.py)
+    requires_per_step_state = True
+
     def __init__(self, log_dir: str, start_iteration: int = 5,
                  num_iterations: int = 3):
         self.log_dir = log_dir
@@ -437,6 +514,24 @@ class ComposableIterationListener(TrainingListener):
             if getattr(l, "needs_introspection",
                        lambda _: True)(next_iteration)
         )
+
+    def bundling_blockers(self):
+        """Per-step-callback needs of the COMPOSED listeners
+        (train/pipeline.py consults this instead of this class's own
+        delegating hook overrides, which would otherwise read as
+        always-blocking and silently disable bundling)."""
+        from deeplearning4j_tpu.train import pipeline
+
+        return pipeline.bundling_blockers(self.listeners)
+
+    def bundle_done(self, model, it0, epoch, scores):
+        """Bundled delivery to the composed listeners: bundle-aware
+        children share the once-per-bundle score fetch, legacy children
+        get the per-step replay (same contract as the fit loops')."""
+        from deeplearning4j_tpu.train import pipeline
+
+        pipeline.dispatch_bundle_to(self.listeners, model, it0, epoch,
+                                    scores)
 
     def on_forward_pass(self, model, activations):
         for l in _hook_recipients(self.listeners, "on_forward_pass"):

@@ -1,0 +1,239 @@
+"""Pipelined training loop: in-graph multi-step bundling.
+
+Every fit path used to pay one Python→XLA dispatch per batch plus a
+synchronous host→device transfer on the main thread. TensorFlow's system
+design argues for keeping the step loop IN the dataflow graph so dispatch
+cost amortizes over many steps (arXiv 1605.08695 §4.2), and the
+Julia-to-TPU work shows fixed-shape whole-loop compilation is exactly the
+program shape the TPU wants (arXiv 1810.09868). This module provides the
+bundling layer:
+
+- :func:`make_bundled_step` wraps a model's raw (unjitted) train step in a
+  ``lax.scan`` over K stacked batches: ONE dispatch executes K optimizer
+  steps. The host iteration counter is advanced *in-graph* as scan carry
+  (epoch is constant within a bundle — bundles never cross epoch
+  boundaries), and the fault-state pytree (train/faults.py) threads
+  through the scan so the non-finite guard / loss scaling behave
+  bit-identically to the unbundled loop.
+- The divergence tripwire (``max_consecutive_bad_steps``) is checked once
+  per bundle on the FINAL ``consec`` — K-1 fewer host syncs; a bad streak
+  that starts in one bundle and continues into the next still trips,
+  while a streak that both starts and fully recovers strictly inside one
+  bundle is not observed mid-bundle (documented trade; set
+  ``steps_per_call=1`` for per-step tripwire granularity).
+- Per-step losses come back as a stacked device array.
+  :func:`dispatch_bundle_listeners` hands it to listeners: bundle-aware
+  listeners (``bundle_done`` hook — ScoreIterationListener,
+  CollectScoresIterationListener) get a :class:`BundleScores` whose host
+  values are fetched AT MOST ONCE per bundle; legacy listeners still get
+  per-step ``iteration_done`` calls with ``model.score_`` rebound to the
+  matching device scalar slice (no sync unless the listener reads it).
+- Listeners that need per-step host callbacks — ``on_backward_pass`` and
+  the introspection hooks (``on_forward_pass`` /
+  ``on_gradient_calculation``) — force ``steps_per_call=1`` via
+  :func:`resolve_steps_per_call` (bundled steps cannot stop between
+  optimizer steps to call back into Python).
+
+Bundling is legal when: backprop is standard (tBPTT chunk loops advance
+one host iteration per *batch* across several chunk dispatches and thread
+carries outside the graph — :func:`resolve_steps_per_call` rejects it),
+the K batches share shapes/dtypes/mask layout (the batch stacker in
+data/iterators.py guarantees this; ragged tails fall back to the
+single-step path), and no attached listener needs per-step host
+callbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# test hook: total host fetches of bundled score arrays (the sync-free
+# listener regression test asserts one fetch per bundle, not per step)
+_host_fetches = 0
+
+
+class BundleScores:
+    """Per-step losses of one bundle. Stays a device array; the host copy
+    is materialized lazily and AT MOST ONCE, however many listeners (or
+    frequency hits) read it."""
+
+    def __init__(self, scores):
+        self.dev = scores
+        self._host: Optional[np.ndarray] = None
+        self.fetch_count = 0
+
+    def __len__(self) -> int:
+        return int(self.dev.shape[0])
+
+    def host(self) -> np.ndarray:
+        if self._host is None:
+            global _host_fetches
+            self._host = np.asarray(self.dev)
+            self.fetch_count += 1
+            _host_fetches += 1
+        return self._host
+
+
+# --------------------------------------------------------------------------
+# legality / resolution
+# --------------------------------------------------------------------------
+_PER_STEP_HOOKS = ("on_forward_pass", "on_gradient_calculation",
+                   "on_backward_pass")
+
+
+def bundling_blockers(listeners: Sequence[Any]) -> List[str]:
+    """Listener needs that require per-step host callbacks (and therefore
+    force ``steps_per_call=1``), as ``Type.reason`` strings: the
+    introspection/backward hooks, plus listeners declaring
+    ``requires_per_step_state`` — their ``iteration_done`` side effects
+    snapshot the MODEL (checkpoint zips, profiler trace windows), and a
+    post-bundle replay would hand every step end-of-bundle state."""
+    from deeplearning4j_tpu.train.listeners import _has_hook
+
+    out = set()
+    for lst in listeners:
+        own = getattr(lst, "bundling_blockers", None)
+        if callable(own):
+            # composites self-report their children's needs (their own
+            # delegating hook overrides would read as always-blocking)
+            out.update(own())
+            continue
+        for h in _PER_STEP_HOOKS:
+            if _has_hook(lst, h):
+                out.add(f"{type(lst).__name__}.{h}")
+        if getattr(lst, "requires_per_step_state", False):
+            out.add(f"{type(lst).__name__}.requires_per_step_state")
+    return sorted(out)
+
+
+def resolve_steps_per_call(model, requested: Optional[int] = None) -> int:
+    """Effective bundle size for a fit loop: the requested K (default:
+    ``GlobalConf.steps_per_call``), clamped to 1 when a listener needs
+    per-step host callbacks. tBPTT configurations reject bundling with a
+    ValueError rather than silently degrading — the chunk loop's
+    iteration clock (one host iteration per batch, shared by all chunk
+    dispatches) is incompatible with the scan's per-step carry."""
+    if requested is None:
+        requested = getattr(model.conf.global_conf, "steps_per_call", 1)
+    k = int(requested or 1)
+    if k <= 1:
+        return 1
+    if getattr(model.conf, "backprop_type", "standard") == "tbptt":
+        raise ValueError(
+            "steps_per_call > 1 cannot bundle tBPTT fits: chunk steps share "
+            "one host iteration and carries cross chunk boundaries outside "
+            "the graph; use steps_per_call=1 for tBPTT configurations"
+        )
+    blockers = bundling_blockers(getattr(model, "listeners", []))
+    if blockers:
+        log.info(
+            "steps_per_call=%d forced to 1: listener hooks need per-step "
+            "host callbacks (%s)", k, ", ".join(blockers))
+        return 1
+    return k
+
+
+# --------------------------------------------------------------------------
+# the bundled step
+# --------------------------------------------------------------------------
+def bundled_scan(raw_step, guarded: bool):
+    """Wrap a raw train step ``(params, opt, state, [fstate,] f, l, fm,
+    lm, rng, iteration, epoch) -> (params, opt, state, [fstate,] score)``
+    in a ``lax.scan`` over the leading K axis of the batch arrays and the
+    stacked per-step rngs. The iteration counter rides the carry (+1 per
+    step, in-graph); per-step scores are stacked into the (K,) output.
+    ``None`` masks pass through (pytree nodes with no leaves scan
+    transparently). Works for MultiLayerNetwork (array batches) and
+    ComputationGraph (per-input tuples) alike."""
+    if guarded:
+        def bundle(params, opt_state, state, fstate, features, labels,
+                   fmask, lmask, rngs, iteration, epoch):
+            def body(carry, xs):
+                p, o, s, fs, it = carry
+                f, l, fm, lm, rng = xs
+                p, o, s, fs, score = raw_step(p, o, s, fs, f, l, fm, lm,
+                                              rng, it, epoch)
+                return (p, o, s, fs, it + 1), score
+
+            (p, o, s, fs, _), scores = jax.lax.scan(
+                body, (params, opt_state, state, fstate, iteration),
+                (features, labels, fmask, lmask, rngs))
+            return p, o, s, fs, scores
+
+        return bundle
+
+    def bundle(params, opt_state, state, features, labels, fmask, lmask,
+               rngs, iteration, epoch):
+        def body(carry, xs):
+            p, o, s, it = carry
+            f, l, fm, lm, rng = xs
+            p, o, s, score = raw_step(p, o, s, f, l, fm, lm, rng, it, epoch)
+            return (p, o, s, it + 1), score
+
+        (p, o, s, _), scores = jax.lax.scan(
+            body, (params, opt_state, state, iteration),
+            (features, labels, fmask, lmask, rngs))
+        return p, o, s, scores
+
+    return bundle
+
+
+def make_bundled_step(model, jit: bool = True):
+    """K-step bundled train step for ``model`` (MultiLayerNetwork or
+    ComputationGraph): its raw train step under a ``lax.scan``. The
+    compiled program is K-invariant in code size (the scan body traces
+    once) but specialized to the stacked batch shapes, like every other
+    jitted step."""
+    from deeplearning4j_tpu.train import faults as _faults
+
+    guarded = model._active_fault_policy() is not None
+    bundle = bundled_scan(model.train_step_fn(), guarded)
+    if not jit:
+        return bundle
+    donate = _faults.guard_donation(0, 1, 2) if guarded else (0, 1, 2)
+    return jax.jit(bundle, donate_argnums=donate)
+
+
+# --------------------------------------------------------------------------
+# listener dispatch
+# --------------------------------------------------------------------------
+def dispatch_bundle_listeners(model, it0: int, epoch: int, scores) -> None:
+    """Deliver one bundle's worth of iteration events.
+
+    Bundle-aware listeners (a ``bundle_done(model, it0, epoch,
+    BundleScores)`` hook) get the whole bundle at once — their host
+    fetch, if any, happens once per bundle. Every other listener keeps
+    its exact legacy contract: ``iteration_done`` per step, in step
+    order, with ``model.score_`` rebound to that step's device scalar
+    (slicing a device array does not sync; only a listener that actually
+    reads ``model.score()`` pays the transfer)."""
+    dispatch_bundle_to(model.listeners, model, it0, epoch,
+                       BundleScores(scores))
+
+
+def dispatch_bundle_to(listeners: Sequence[Any], model, it0: int,
+                       epoch: int, bs: "BundleScores") -> None:
+    """Bundle delivery over an explicit listener list — the core of
+    :func:`dispatch_bundle_listeners`, also called by composite
+    listeners (ComposableIterationListener.bundle_done) so composed
+    Score/CollectScores children keep the once-per-bundle fetch."""
+    k = len(bs)
+    legacy = []
+    for lst in listeners:
+        if hasattr(lst, "bundle_done"):
+            lst.bundle_done(model, it0, epoch, bs)
+        else:
+            legacy.append(lst)
+    if legacy:
+        for j in range(k):
+            model.score_ = bs.dev[j]
+            for lst in legacy:
+                lst.iteration_done(model, it0 + j + 1, epoch)
+    model.score_ = bs.dev[k - 1]
