@@ -596,6 +596,111 @@ def _bench_pipeline(ks=(1, 4, 16), n_batches=192, batch=32, d_in=64,
     return result
 
 
+def _bench_tune(n_trials=8, steps=96, k=8, n_batches=24, batch=32,
+                d_in=32, d_hidden=32, d_out=5):
+    """Trials/sec A/B for the hyperparameter tuner (tune/runner.py):
+    the SAME n-trial lr/l2 study executed (a) sequentially — each trial
+    trained alone through the stock single-step fit path (the
+    TensorFlow-era tuner shape: one process per trial, one dispatch per
+    step) and (b) as ONE vmapped population with ``steps_per_call=k``
+    bundling (n trials x k steps per dispatch). Numerics are
+    bit-identical by construction (the tuner's parity tests pin that
+    down), so the ratio is pure dispatch/vectorization win — meaningful
+    on any backend, and this doubles as the no-TPU fallback artifact.
+    Writes BENCH_tune.json and returns the result dict."""
+    import functools
+
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.train.earlystopping import (
+        DataSetLossCalculator,
+        ScoreCalculatorObjective,
+    )
+    from deeplearning4j_tpu.tune import (
+        AshaScheduler,
+        ContinuousParameterSpace,
+        SearchSpace,
+        Study,
+        mlp_factory,
+    )
+
+    # Every Study builds fresh jit closures, so without a persistent
+    # compile cache the "timed" run would re-pay XLA compilation and the
+    # ratio would measure relative compile cost, not dispatch. Point the
+    # cache at a scratch dir (threshold 0: these programs compile fast)
+    # so the warmup run compiles and the timed run only re-traces.
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench_tune_jaxcache_")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except AttributeError:  # older jax: flag absent, default threshold
+        pass
+
+    rng = np.random.default_rng(7)
+    mk = lambda n: [  # noqa: E731
+        DataSet(rng.standard_normal((batch, d_in)).astype(np.float32),
+                np.eye(d_out, dtype=np.float32)[
+                    rng.integers(0, d_out, batch)])
+        for _ in range(n)]
+    train, val = mk(n_batches), mk(4)
+    space = SearchSpace(
+        functools.partial(mlp_factory, d_in, d_out, widths=(d_hidden,)),
+        {"lr": ContinuousParameterSpace(1e-3, 1e-1, scale="log"),
+         "l2": ContinuousParameterSpace(1e-5, 1e-2, scale="log")})
+
+    def objective():
+        return ScoreCalculatorObjective(
+            DataSetLossCalculator(ExistingDataSetIterator(val)))
+
+    def run(engine, spc, workers=None):
+        # single-rung ladder: both engines train every trial to `steps`
+        # (scheduler decisions would otherwise let one engine do less
+        # work and fake the ratio)
+        study = Study(space, train, objective(),
+                      scheduler=AshaScheduler(steps, steps, eta=2),
+                      num_trials=n_trials, seed=3, engine=engine,
+                      steps_per_call=spc, workers=workers)
+        study.run()  # warmup: compile both paths
+        study2 = Study(space, train, objective(),
+                       scheduler=AshaScheduler(steps, steps, eta=2),
+                       num_trials=n_trials, seed=3, engine=engine,
+                       steps_per_call=spc, workers=workers)
+        t0 = time.perf_counter()
+        study2.run()
+        dt = time.perf_counter() - t0
+        return n_trials / dt
+
+    seq = run("pool", 1, workers=1)      # sequential: one trial at a time
+    pop = run("population", k)
+    result = {
+        "metric": "tune_trials_per_sec_population",
+        "value": round(pop, 2),
+        "unit": f"trials/sec ({steps} steps each)",
+        "vs_baseline": round(pop / seq, 3) if seq else None,
+        "extra": {
+            "sequential_trials_per_sec": round(seq, 2),
+            "population_trials_per_sec": round(pop, 2),
+            "config": (f"{n_trials} trials, MLP {d_in}->{d_hidden}->"
+                       f"{d_out}, batch {batch}, {steps} steps/trial, "
+                       f"steps_per_call {k}"),
+            "platform": jax.devices()[0].platform,
+            "note": ("vs_baseline = vmapped-population trials/sec over "
+                     "sequential solo training; acceptance gate >= 2x "
+                     "(N-trial vmap + K-step scan per dispatch)"),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_tune.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    return result
+
+
 def _tpu_plausible() -> bool:
     """Whether a TPU backend could come up at all in this container: the
     axon plugin must be importable (or explicitly requested). When it
@@ -785,6 +890,22 @@ if __name__ == "__main__":
 
             jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_pipeline()))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "tune":
+        # tuner population-vs-sequential A/B: meaningful on any backend,
+        # writes BENCH_tune.json. Same _tpu_plausible gating as the
+        # supervised path: without a TPU the CPU measurement IS the
+        # round artifact (metric prefixed so parsers can tell).
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            out = _bench_tune()
+            if not _tpu_plausible():
+                out["metric"] = "cpu_fallback_" + out["metric"]
+            print(json.dumps(out))
+            sys.exit(0)
+        print(json.dumps(_bench_tune()))
         sys.exit(0)
     if (os.environ.get("BENCH_CHILD") != "1"
             and os.environ.get("BENCH_FORCE_SUPERVISED") != "1"

@@ -214,10 +214,151 @@ def serve_main(argv) -> int:
     return 0
 
 
+def tune_main(argv) -> int:
+    """``tune`` subcommand: hyperparameter search over the stock MLP
+    factory on a named dataset (tune/ package — Arbiter equivalent).
+    The space JSON maps parameter names onto the factory's keywords:
+
+        {"params": {"lr":  {"type": "continuous", "low": 1e-4,
+                            "high": 1e-1, "scale": "log"},
+                    "l2":  {"type": "continuous", "low": 1e-6,
+                            "high": 1e-2, "scale": "log"},
+                    "widths": {"type": "layer_widths",
+                               "count": {"type": "integer",
+                                         "low": 1, "high": 2},
+                               "width": {"type": "discrete",
+                                         "values": [16, 32, 64]}}}}
+
+    Trials whose samples differ only in lr/l1/l2/weight-decay/seed train
+    as ONE vmapped population program; structural samples (widths, ...)
+    fall back to the thread-pool engine automatically.
+    """
+    import functools
+    import json as _json
+
+    import numpy as np
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu tune",
+        description="Hyperparameter search: ASHA over a search space, "
+                    "vmapped population training, crash-safe resume",
+    )
+    ap.add_argument("--space", required=True,
+                    help="space JSON file (see subcommand docstring)")
+    ap.add_argument("--dataset", default="iris",
+                    help="mnist | iris | svhn | tinyimagenet")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-examples", type=int, default=None)
+    ap.add_argument("--population", type=int, default=8,
+                    help="number of trials sampled (and the vmapped "
+                         "population width when trials are stackable)")
+    ap.add_argument("--engine", default="auto",
+                    choices=["auto", "population", "pool"],
+                    help="auto: population when every trial compiles to "
+                         "the same program, else thread pool")
+    ap.add_argument("--min-budget", type=int, default=32,
+                    help="first ASHA rung, in optimizer steps")
+    ap.add_argument("--max-budget", type=int, default=256,
+                    help="final rung (total steps a surviving trial gets)")
+    ap.add_argument("--eta", type=int, default=3,
+                    help="ASHA halving rate: top 1/eta survive each rung")
+    ap.add_argument("--steps-per-call", type=int, default=8,
+                    help="population engine: batches per stacked "
+                         "lax.scan dispatch (train/pipeline.py bundling)")
+    ap.add_argument("--store", default=None,
+                    help="study directory: crash-safe JSONL trial "
+                         "journal + per-trial checkpoints")
+    ap.add_argument("--resume", action="store_true",
+                    help="replay the store, skip finished trials, resume "
+                         "in-flight ones from their newest valid "
+                         "checkpoint")
+    ap.add_argument("--keep-last", type=int, default=2,
+                    help="checkpoints retained per trial")
+    ap.add_argument("--retain-best", type=int, default=3,
+                    help="after the study: keep only the best-k trials' "
+                         "checkpoint dirs")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--grid", action="store_true",
+                    help="grid search instead of seeded random sampling")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="pool engine threads (default: #devices)")
+    ap.add_argument("--val-batches", type=int, default=4,
+                    help="batches held out of the tail of the stream for "
+                         "rung scoring")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.train.earlystopping import (
+        DataSetLossCalculator,
+        ScoreCalculatorObjective,
+    )
+    from deeplearning4j_tpu.tune import (
+        AshaScheduler,
+        SearchSpace,
+        Study,
+        mlp_factory,
+    )
+
+    if args.resume and not args.store:
+        raise SystemExit("--resume requires --store")
+    with open(args.space) as f:
+        params = SearchSpace.params_from_json(f.read())
+
+    if args.val_batches < 1:
+        raise SystemExit("--val-batches must be >= 1 (rung scoring needs "
+                         "held-out data)")
+    it, num_classes = build_dataset(args.dataset, args.batch_size,
+                                    args.num_examples)
+    batches = list(it)
+    if len(batches) <= args.val_batches:
+        raise SystemExit(
+            f"dataset yields {len(batches)} batches; need more than "
+            f"--val-batches={args.val_batches}")
+    train, val = batches[:-args.val_batches], batches[-args.val_batches:]
+    feat = np.asarray(train[0].features)
+    if feat.ndim > 2:
+        raise SystemExit(
+            "tune drives the flat MLP factory; use a dataset with flat "
+            f"features (got rank-{feat.ndim})")
+    n_in = int(feat.shape[1])
+
+    space = SearchSpace(
+        functools.partial(mlp_factory, n_in, num_classes), params)
+    objective = ScoreCalculatorObjective(
+        DataSetLossCalculator(ExistingDataSetIterator(val)))
+    study = Study(
+        space, train, objective,
+        scheduler=AshaScheduler(args.min_budget, args.max_budget,
+                                eta=args.eta),
+        num_trials=args.population, seed=args.seed, engine=args.engine,
+        store_dir=args.store, steps_per_call=args.steps_per_call,
+        keep_last=args.keep_last, retain_best=args.retain_best,
+        workers=args.workers, grid=args.grid)
+    t0 = time.time()
+    result = study.run(resume=args.resume)
+    dt = time.time() - t0
+    print(f"engine={result.engine} trials={len(result.trials)} "
+          f"rungs={study.scheduler.rungs} in {dt:.1f}s", flush=True)
+    for t in result.trials:
+        print(f"  {t.id} {t.status:<9} rung={t.rung} "
+              f"score={t.final_score} {_json.dumps(t.to_dict()['overrides'])}",
+              flush=True)
+    if result.best_trial is None:
+        print("no completed trials", flush=True)
+        return 1
+    print(f"best: {result.best_trial.id} "
+          f"score={result.best_trial.final_score} "
+          f"{_json.dumps(result.best_trial.to_dict()['overrides'])}",
+          flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv[:1] == ["serve"]:
         return serve_main(argv[1:])
+    if argv[:1] == ["tune"]:
+        return tune_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",

@@ -46,16 +46,40 @@ class _BaseNetEstimator(_SkBase):
 
     # -- sklearn protocol --------------------------------------------------
     def get_params(self, deep: bool = True) -> dict:
-        return {"conf": self.conf, "epochs": self.epochs,
-                "batch_size": self.batch_size, "shuffle": self.shuffle,
-                "seed": self.seed}
+        params = {"conf": self.conf, "epochs": self.epochs,
+                  "batch_size": self.batch_size, "shuffle": self.shuffle,
+                  "seed": self.seed}
+        if deep and hasattr(self.conf, "get_params"):
+            # conf-factory hyperparameters (tune.space.ConfFactory or any
+            # object with get_params/with_params) surface as conf__<name>,
+            # so sklearn clone/GridSearchCV and the tuner bridge can
+            # search the NETWORK's hyperparameters, not just the loop's
+            for k, v in self.conf.get_params().items():
+                if callable(v):
+                    continue  # the factory fn itself is not a hyperparameter
+                params[f"conf__{k}"] = v
+        return params
 
     def set_params(self, **params) -> "_BaseNetEstimator":
+        shallow = {"conf", "epochs", "batch_size", "shuffle", "seed"}
+        conf_updates = {}
         for k, v in params.items():
-            if k not in self.get_params():
+            if k.startswith("conf__"):
+                if not hasattr(self.conf, "with_params"):
+                    raise ValueError(
+                        f"Parameter {k!r} needs conf to be a factory with "
+                        "with_params() (e.g. tune.ConfFactory); got "
+                        f"{type(self.conf).__name__}")
+                conf_updates[k[len("conf__"):]] = v
+            elif k in shallow:
+                setattr(self, k, v)
+            else:
                 raise ValueError(
                     f"Invalid parameter {k!r} for {type(self).__name__}")
-            setattr(self, k, v)
+        if conf_updates:
+            # copy-on-write: sklearn clones share the factory object, so
+            # a grid point must never mutate a sibling clone's conf
+            self.conf = self.conf.with_params(**conf_updates)
         return self
 
     # -- shared machinery --------------------------------------------------

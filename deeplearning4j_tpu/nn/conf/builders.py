@@ -190,6 +190,39 @@ class MultiLayerConfiguration:
             and self.to_dict() == other.to_dict()
         )
 
+    def architecture_fingerprint(self) -> dict:
+        """The configuration dict with every *population-vmappable*
+        hyperparameter normalized out: fixed LEARNING RATES (only the
+        ``learning_rate`` FixedSchedule — other fixed scalar schedules
+        like Nesterovs momentum are NOT rebindable by the population
+        engine and must stay part of the fingerprint), regularization
+        coefficients, and the rng seed are zeroed. Two configurations
+        with equal fingerprints describe the SAME compiled program shape
+        — the tuner's vmapped population engine stacks such trials and
+        feeds their lr/l1/l2/seed as per-trial traced leaves
+        (tune/runner.population_compatible)."""
+        _REG_KEYS = {"l1", "l2", "l1_bias", "l2_bias", "weight_decay",
+                     "weight_decay_bias"}
+
+        def norm(node):
+            if isinstance(node, dict):
+                out = {k: norm(v) for k, v in node.items()}
+                lr = out.get("learning_rate")
+                if (isinstance(lr, dict)
+                        and lr.get("@class") == "FixedSchedule"):
+                    lr["value"] = 0.0
+                if _REG_KEYS <= set(out):
+                    for k in _REG_KEYS:
+                        out[k] = 0.0
+                if out.get("@class") == "GlobalConf" and "seed" in out:
+                    out["seed"] = 0
+                return out
+            if isinstance(node, list):
+                return [norm(v) for v in node]
+            return node
+
+        return norm(self.to_dict())
+
     # -- helpers -------------------------------------------------------------
     def layer_types(self) -> List[InputType]:
         """Input type seen by each layer (post-preprocessor), plus final output

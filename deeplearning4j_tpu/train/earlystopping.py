@@ -36,6 +36,39 @@ class ScoreCalculator:
     def calculate_score(self, model) -> float:
         raise NotImplementedError
 
+    @staticmethod
+    def _fresh(iterator) -> None:
+        """Rewind the evaluation iterator BEFORE consuming it. Every
+        calculator must score from the start of its data on every call —
+        repeat evaluation of one model has to be deterministic (the
+        early-stopping loop and the tuner's rung scoring both call the
+        same calculator many times, and a previous pass that died
+        mid-iteration, or any outside partial consumption, would
+        otherwise leave the next score computed over the tail only)."""
+        reset_ok = getattr(iterator, "reset_supported", None)
+        if callable(reset_ok) and not reset_ok():
+            return
+        reset = getattr(iterator, "reset", None)
+        if callable(reset):
+            reset()
+
+
+class ScoreCalculatorObjective:
+    """Adapter: a ScoreCalculator as a tuner objective (tune/runner.py
+    rung scoring) — callable ``model -> float`` carrying the calculator's
+    minimize/maximize direction."""
+
+    def __init__(self, calculator: ScoreCalculator):
+        self.calculator = calculator
+        self.minimize = bool(calculator.minimize_score)
+
+    def __call__(self, model) -> float:
+        return float(self.calculator.calculate_score(model))
+
+    def __repr__(self):
+        return (f"ScoreCalculatorObjective({type(self.calculator).__name__},"
+                f" minimize={self.minimize})")
+
 
 class DataSetLossCalculator(ScoreCalculator):
     """Average loss over an iterator (reference
@@ -48,6 +81,7 @@ class DataSetLossCalculator(ScoreCalculator):
         self.average = average
 
     def calculate_score(self, model) -> float:
+        self._fresh(self.iterator)
         total, count = 0.0, 0
         for ds in self.iterator:
             n = ds.num_examples()
@@ -70,6 +104,7 @@ class ClassificationScoreCalculator(ScoreCalculator):
         self.iterator = iterator
 
     def calculate_score(self, model) -> float:
+        self._fresh(self.iterator)
         ev = model.evaluate(self.iterator)
         return float(getattr(ev, self.metric)())
 
@@ -92,6 +127,7 @@ class RegressionScoreCalculator(ScoreCalculator):
     }
 
     def calculate_score(self, model) -> float:
+        self._fresh(self.iterator)
         ev = model.evaluate_regression(self.iterator)
         method = self._METRIC_METHODS.get(self.metric)
         if method is None:
@@ -114,6 +150,7 @@ class ROCScoreCalculator(ScoreCalculator):
     def calculate_score(self, model) -> float:
         from deeplearning4j_tpu.evaluation import ROC
 
+        self._fresh(self.iterator)
         roc = ROC()
         for ds in self.iterator:
             out = model.output(ds.features)
@@ -151,6 +188,7 @@ class AutoencoderScoreCalculator(ScoreCalculator):
         self.layer_index = layer_index
 
     def calculate_score(self, model) -> float:
+        self._fresh(self.iterator)
         total, count = 0.0, 0
         layer, lparams = _resolve_pretrain_layer(model, self.layer_index)
         for ds in self.iterator:
@@ -186,6 +224,7 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
         self.log_prob = log_prob
 
     def calculate_score(self, model) -> float:
+        self._fresh(self.iterator)
         total, count = 0.0, 0
         layer, lparams = _resolve_pretrain_layer(model, self.layer_index)
         for ds in self.iterator:

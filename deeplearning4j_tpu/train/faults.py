@@ -446,14 +446,21 @@ def checkpoint_fingerprint(path: str) -> Tuple[int, int]:
     return (st.st_mtime_ns, st.st_size)
 
 
-def latest_valid_checkpoint(directory: str) -> str:
+def latest_valid_checkpoint(directory: str, missing_ok: bool = False
+                            ) -> Optional[str]:
     """Newest checkpoint in ``directory`` that passes validation,
     warning about (and skipping over) corrupt/truncated newer ones.
-    Raises FileNotFoundError when no valid checkpoint exists."""
+    Raises FileNotFoundError when no valid checkpoint exists —
+    ``missing_ok=True`` returns None instead (restart-wrapper and
+    tuner-resume callers treat "nothing yet" as "start fresh", not an
+    error)."""
     import warnings
 
-    candidates = checkpoint_files(directory)
+    candidates = (checkpoint_files(directory)
+                  if os.path.isdir(directory) else [])
     if not candidates:
+        if missing_ok:
+            return None
         raise FileNotFoundError(f"no checkpoints in {directory!r}")
     for path in reversed(candidates):
         ok, reason = validate_checkpoint(path)
@@ -462,6 +469,8 @@ def latest_valid_checkpoint(directory: str) -> str:
         warnings.warn(
             f"skipping corrupt checkpoint {path!r}: {reason}; "
             "falling back to the previous one", stacklevel=2)
+    if missing_ok:
+        return None
     raise FileNotFoundError(
         f"no VALID checkpoint in {directory!r} "
         f"({len(candidates)} candidates, all corrupt)")

@@ -1,0 +1,58 @@
+"""Hyperparameter search (the reproduction's Arbiter): typed search
+spaces over conf factories, ASHA scheduling, a vmapped population
+engine that trains N same-architecture trials as one jitted program,
+and a crash-safe trial store with kill-and-resume."""
+
+from deeplearning4j_tpu.tune.runner import (
+    Objective,
+    Study,
+    StudyResult,
+    as_objective,
+    population_compatible,
+    search_estimator,
+)
+from deeplearning4j_tpu.tune.scheduler import (
+    AshaScheduler,
+    MedianStoppingRule,
+    Trial,
+    TrialStatus,
+    asha_rungs,
+)
+from deeplearning4j_tpu.tune.space import (
+    ConfFactory,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    IntegerParameterSpace,
+    LayerWidthsSpace,
+    ParameterSpace,
+    SearchSpace,
+    grid_search,
+    mlp_factory,
+    random_search,
+)
+from deeplearning4j_tpu.tune.store import TrialStore
+
+__all__ = [
+    "AshaScheduler",
+    "ConfFactory",
+    "ContinuousParameterSpace",
+    "DiscreteParameterSpace",
+    "IntegerParameterSpace",
+    "LayerWidthsSpace",
+    "MedianStoppingRule",
+    "Objective",
+    "ParameterSpace",
+    "SearchSpace",
+    "Study",
+    "StudyResult",
+    "Trial",
+    "TrialStatus",
+    "TrialStore",
+    "as_objective",
+    "asha_rungs",
+    "grid_search",
+    "mlp_factory",
+    "population_compatible",
+    "random_search",
+    "search_estimator",
+]

@@ -1,0 +1,849 @@
+"""Study execution engines: vmapped population training + thread pool.
+
+The reproduction's answer to Arbiter's ``LocalOptimizationRunner``, built
+for TPU-shaped hardware: when every trial in a cohort compiles to the
+SAME program (identical architecture, hyperparameter differences only in
+*values* — learning rate, l1/l2/weight-decay, rng seed), the whole
+cohort trains as ONE jitted program: parameters, updater slots, layer
+state and fault state are stacked on a leading trial axis, the per-trial
+hyperparameters enter as vmapped leaves, and ``steps_per_call`` batches
+run per dispatch through the same ``lax.scan`` discipline as the
+pipelined training loop (train/pipeline.py). One dispatch then advances
+N trials × K optimizer steps — the in-graph control TensorFlow-era
+tuners could not express cheaply (arXiv 1605.08695) on exactly the
+fixed-shape whole-program shape the TPU wants (arXiv 1810.09868).
+
+**Why the numerics are bit-identical to solo runs.** ``jax.vmap`` adds a
+batch dimension to every primitive; per-element math (and XLA:CPU/TPU
+batched contractions) keep each trial's reduction order, so trial ``k``
+of a population ends with the SAME BITS as that trial trained alone with
+the same seed and batch schedule (asserted by tests). The traced
+hyperparameters ride in through *cells*: the template model's updaters
+get their FixedSchedule learning rate swapped for a
+:class:`_CellSchedule` and each layer's regularization for a
+:class:`_CellRegularization`, whose values are bound to the per-trial
+traced scalars at trace time — re-traces re-bind, so the compiled
+program is never specialized on any single trial's values.
+
+Trials whose sampled overrides CHANGE the program (layer widths,
+activation, updater class, dropout rate...) fail
+:func:`population_compatible` and fall back to the **pool engine**: a
+thread pool training each trial solo, round-robin over the local
+devices, driving the ASHA stopping rule asynchronously.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import math
+import threading
+import warnings
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.regularization import RegularizationConf
+from deeplearning4j_tpu.schedules import Schedule
+from deeplearning4j_tpu.tune.scheduler import (
+    AshaScheduler,
+    MedianStoppingRule,
+    Trial,
+    TrialStatus,
+)
+from deeplearning4j_tpu.tune.space import SearchSpace
+from deeplearning4j_tpu.tune.store import TrialStore
+
+log = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------------------
+# objectives
+# --------------------------------------------------------------------------
+class Objective:
+    """A rung-scoring objective: callable ``model -> float`` with a
+    minimize/maximize direction."""
+
+    def __init__(self, fn: Callable, minimize: bool = True):
+        self.fn = fn
+        self.minimize = bool(minimize)
+
+    def __call__(self, model) -> float:
+        return float(self.fn(model))
+
+
+def as_objective(obj, minimize: Optional[bool] = None) -> Objective:
+    """Coerce a ScoreCalculator / ScoreCalculatorObjective / plain
+    callable into an :class:`Objective`."""
+    from deeplearning4j_tpu.train.earlystopping import (
+        ScoreCalculator,
+        ScoreCalculatorObjective,
+    )
+
+    if isinstance(obj, Objective):
+        return obj
+    if isinstance(obj, ScoreCalculator):
+        obj = ScoreCalculatorObjective(obj)
+    own = getattr(obj, "minimize", None)
+    if minimize is None:
+        minimize = True if own is None else bool(own)
+    return Objective(obj, minimize)
+
+
+# --------------------------------------------------------------------------
+# traced hyperparameter cells
+# --------------------------------------------------------------------------
+class _Cell:
+    """Holder for a traced per-trial hyperparameter value, rebound at
+    every trace of the population step (so re-compiles for new shapes
+    never fall back to stale constants)."""
+
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = None
+
+
+class _CellSchedule(Schedule):
+    """FixedSchedule stand-in whose value is the cell's traced scalar."""
+
+    def __init__(self, cell: _Cell):
+        self.cell = cell
+        self.schedule_type = "iteration"
+
+    def value_at(self, iteration, epoch):
+        if self.cell.v is None:
+            raise RuntimeError(
+                "population hyper cell read outside a bound trace")
+        return jnp.asarray(self.cell.v, jnp.float32)
+
+    def to_dict(self):  # template confs are never serialized
+        raise TypeError("_CellSchedule is not serializable")
+
+
+# coefficient slot order inside a trial's per-layer reg vector
+_REG_SLOTS = ("l1", "l2", "weight_decay",
+              "l1_bias", "l2_bias", "weight_decay_bias")
+
+
+class _CellRegularization(RegularizationConf):
+    """RegularizationConf whose six coefficients come from a traced
+    (6,)-vector cell. ``active`` is the STATIC union mask of slots that
+    are nonzero in at least one trial of the population — inactive slots
+    compile to nothing, exactly like the stock conf's ``if coeff:``
+    short-circuit, keeping the math bit-identical to a solo run for
+    every trial whose zero pattern matches the union. (A trial with a
+    coefficient of exactly 0.0 in a slot another trial uses computes
+    ``g + 0.0*term`` instead of skipping it — identical bits except for
+    the sign of a ±0.0 gradient, the one documented tolerance.)"""
+
+    def __init__(self, cell: _Cell, active: Sequence[bool]):
+        super().__init__()
+        self.cell = cell
+        self.active = tuple(bool(a) for a in active)
+
+    def _coeff(self, slot: int):
+        return jnp.asarray(self.cell.v[slot], jnp.float32)
+
+    def _slots_for(self, param_name: str) -> Tuple[int, int, int]:
+        if param_name.startswith("b") or "bias" in param_name.lower():
+            return 3, 4, 5
+        return 0, 1, 2
+
+    def grad_term(self, param_name, param):
+        i1, i2, iw = self._slots_for(param_name)
+        term = None
+        # same term order as RegularizationConf.grad_term: l2, l1, wd
+        if self.active[i2]:
+            term = self._coeff(i2) * param
+        if self.active[i1]:
+            t = self._coeff(i1) * jnp.sign(param)
+            term = t if term is None else term + t
+        if self.active[iw]:
+            t = self._coeff(iw) * param
+            term = t if term is None else term + t
+        return term
+
+    def score_term(self, param_name, param):
+        i1, i2, _iw = self._slots_for(param_name)
+        acc = jnp.promote_types(param.dtype, jnp.float32)
+        p = param.astype(acc)
+        s = jnp.zeros((), acc)
+        if self.active[i2]:
+            s = s + 0.5 * self._coeff(i2).astype(acc) * jnp.sum(p**2)
+        if self.active[i1]:
+            s = s + self._coeff(i1).astype(acc) * jnp.sum(jnp.abs(p))
+        return s
+
+    def to_dict(self):
+        raise TypeError("_CellRegularization is not serializable")
+
+
+def _extract_trial_hypers(conf) -> Tuple[List[float], List[List[float]]]:
+    """Per-layer (fixed lr, 6-vector reg coeffs) of one trial conf."""
+    lrs, regs = [], []
+    for layer in conf.layers:
+        u = layer.updater
+        lr = None if u is None else u.fixed_learning_rate()
+        lrs.append(0.0 if lr is None else float(lr))
+        r = layer.regularization
+        regs.append([0.0] * 6 if r is None
+                    else [float(getattr(r, slot)) for slot in _REG_SLOTS])
+    return lrs, regs
+
+
+def _install_cells(template, trial_regs: List[List[List[float]]]):
+    """Swap the template model's per-layer FixedSchedule learning rates
+    and regularization confs for cell-backed stand-ins; returns
+    ``(lr_cells, reg_cells)`` (None where the layer has no vmappable
+    slot)."""
+    lr_cells: List[Optional[_Cell]] = []
+    reg_cells: List[Optional[_Cell]] = []
+    for i, layer in enumerate(template.layers):
+        u = layer.updater
+        if u is not None and u.fixed_learning_rate() is not None:
+            cell = _Cell()
+            u2 = copy.deepcopy(u)
+            u2.learning_rate = _CellSchedule(cell)
+            layer.updater = u2
+            lr_cells.append(cell)
+        else:
+            lr_cells.append(None)
+        active = [any(regs[i][j] != 0.0 for regs in trial_regs)
+                  for j in range(6)]
+        if any(active):
+            cell = _Cell()
+            layer.regularization = _CellRegularization(cell, active)
+            reg_cells.append(cell)
+        else:
+            reg_cells.append(None)
+    return lr_cells, reg_cells
+
+
+# --------------------------------------------------------------------------
+# population legality
+# --------------------------------------------------------------------------
+def population_compatible(confs: Sequence) -> Tuple[bool, str]:
+    """Whether a set of trial configurations can train as one vmapped
+    population: identical architecture fingerprints (everything equal
+    after normalizing FixedSchedule values, regularization coefficients
+    and the seed — nn/conf/builders.architecture_fingerprint) and
+    standard backprop (the tBPTT chunk loop threads carries outside the
+    graph, same reason train/pipeline rejects bundling it)."""
+    if not confs:
+        return False, "no trials"
+    if getattr(confs[0], "backprop_type", "standard") != "standard":
+        return False, "tBPTT configurations cannot stack (host-side carries)"
+    fp0 = confs[0].architecture_fingerprint()
+    for i, c in enumerate(confs[1:], 1):
+        if c.architecture_fingerprint() != fp0:
+            return False, (
+                f"trial {i} differs from trial 0 beyond vmappable "
+                "hyperparameters (lr / l1 / l2 / weight decay / seed) — "
+                "architecture-changing overrides need the pool engine")
+    return True, "ok"
+
+
+# --------------------------------------------------------------------------
+# stacking / rng plumbing
+# --------------------------------------------------------------------------
+def _stack_trees(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _unstack_tree(tree, i: int):
+    return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+
+def _advance_key(key, n: int):
+    """Replay ``n`` consumptions of the model's sequential rng chain
+    (``_next_rng``: key -> split -> (key', sub))."""
+    for _ in range(int(n)):
+        key, _ = jax.random.split(key)
+    return key
+
+
+def _make_population_step(template, k: int, guarded: bool,
+                          lr_cells, reg_cells):
+    """The stacked cohort step: ``jax.vmap`` of the template's raw train
+    step over the leading trial axis (params/opt/state/fstate/rng/hypers
+    vmapped; the batch and iteration are shared), wrapped in a
+    ``lax.scan`` over ``k`` stacked batches exactly like
+    train/pipeline.bundled_scan. Scores come back as a (k, n) device
+    array."""
+    from deeplearning4j_tpu.train import faults as _faults
+
+    raw = template.train_step_fn()
+
+    def bind(lr_vec, reg_mat):
+        for i, c in enumerate(lr_cells):
+            if c is not None:
+                c.v = lr_vec[i]
+        for i, c in enumerate(reg_cells):
+            if c is not None:
+                c.v = reg_mat[i]
+
+    if guarded:
+        def trial_step(lr_vec, reg_mat, params, opt, state, fstate,
+                       f, l, fm, lm, rng, it, ep):
+            bind(lr_vec, reg_mat)
+            return raw(params, opt, state, fstate, f, l, fm, lm, rng,
+                       it, ep)
+
+        vstep = jax.vmap(trial_step,
+                         in_axes=(0, 0, 0, 0, 0, 0,
+                                  None, None, None, None, 0, None, None))
+
+        def bundle(lr, reg, params, opt, state, fstate, features, labels,
+                   fmask, lmask, rngs, it0, ep):
+            def body(carry, xs):
+                p, o, s, fs, it = carry
+                f, l, fm, lm, rk = xs
+                p, o, s, fs, score = vstep(lr, reg, p, o, s, fs, f, l,
+                                           fm, lm, rk, it, ep)
+                return (p, o, s, fs, it + 1), score
+
+            (p, o, s, fs, _), scores = jax.lax.scan(
+                body, (params, opt, state, fstate, it0),
+                (features, labels, fmask, lmask, rngs))
+            return p, o, s, fs, scores
+
+        donate = _faults.guard_donation(2, 3, 4, 5)
+        return jax.jit(bundle, donate_argnums=donate)
+
+    def trial_step(lr_vec, reg_mat, params, opt, state,
+                   f, l, fm, lm, rng, it, ep):
+        bind(lr_vec, reg_mat)
+        return raw(params, opt, state, f, l, fm, lm, rng, it, ep)
+
+    vstep = jax.vmap(trial_step,
+                     in_axes=(0, 0, 0, 0, 0,
+                              None, None, None, None, 0, None, None))
+
+    def bundle(lr, reg, params, opt, state, features, labels, fmask,
+               lmask, rngs, it0, ep):
+        def body(carry, xs):
+            p, o, s, it = carry
+            f, l, fm, lm, rk = xs
+            p, o, s, score = vstep(lr, reg, p, o, s, f, l, fm, lm, rk,
+                                   it, ep)
+            return (p, o, s, it + 1), score
+
+        (p, o, s, _), scores = jax.lax.scan(
+            body, (params, opt, state, it0),
+            (features, labels, fmask, lmask, rngs))
+        return p, o, s, scores
+
+    return jax.jit(bundle, donate_argnums=(2, 3, 4))
+
+
+# --------------------------------------------------------------------------
+# study
+# --------------------------------------------------------------------------
+class StudyResult:
+    def __init__(self, trials: List[Trial], best_trial: Optional[Trial],
+                 best_model, engine: str, minimize: bool):
+        self.trials = trials
+        self.best_trial = best_trial
+        self.best_model = best_model
+        self.engine = engine
+        self.minimize = minimize
+
+    def __repr__(self):
+        return (f"StudyResult(engine={self.engine}, "
+                f"best={self.best_trial}, trials={len(self.trials)})")
+
+
+class Study:
+    """One hyperparameter search: a :class:`SearchSpace`, a batch
+    schedule, an objective, and an ASHA scheduler, executed by whichever
+    engine the sampled trials are legal for.
+
+    ``train_data`` is a DataSetIterator or a list of DataSets; batches
+    are materialized once and cycled deterministically (optimizer step
+    ``s`` always consumes batch ``s % n_batches``), which is what makes
+    a population trial's batch schedule reproducible solo. Ragged-shape
+    batches (the usual epoch tail) are dropped from the schedule with a
+    warning — population stacking is fixed-shape by design.
+    """
+
+    def __init__(self, space: SearchSpace, train_data, objective, *,
+                 scheduler: AshaScheduler, num_trials: int = 8,
+                 seed: int = 0, engine: str = "auto",
+                 store_dir: Optional[str] = None,
+                 steps_per_call: int = 1, keep_last: int = 2,
+                 retain_best: Optional[int] = None,
+                 median_rule: Optional[MedianStoppingRule] = None,
+                 workers: Optional[int] = None, grid: bool = False):
+        if engine not in ("auto", "population", "pool"):
+            raise ValueError(f"engine must be auto|population|pool, "
+                             f"got {engine!r}")
+        self.space = space
+        self.train_data = train_data
+        self.objective = as_objective(objective)
+        self.scheduler = scheduler
+        # the scheduler's better-direction always follows the objective
+        self.scheduler.minimize = self.objective.minimize
+        self.num_trials = int(num_trials)
+        self.seed = int(seed)
+        self.engine = engine
+        self.store = TrialStore(store_dir) if store_dir else None
+        self.steps_per_call = max(int(steps_per_call), 1)
+        self.keep_last = max(int(keep_last), 1)
+        self.retain_best = retain_best
+        self.median_rule = median_rule
+        if median_rule is not None:
+            median_rule.minimize = self.objective.minimize
+        self.workers = workers
+        self.grid = bool(grid)
+        self.engine_used: Optional[str] = None
+        self._keys: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------ data wiring
+    def _materialize_batches(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        data = self.train_data
+        if isinstance(data, (list, tuple)):
+            batches = list(data)
+        else:
+            batches = list(data)
+            reset = getattr(data, "reset", None)
+            if callable(reset):
+                reset()
+        if not batches:
+            raise ValueError("empty training data")
+        shape = np.asarray(batches[0].features).shape
+        kept = [b for b in batches
+                if np.asarray(b.features).shape == shape]
+        if len(kept) != len(batches):
+            warnings.warn(
+                f"tune: dropping {len(batches) - len(kept)} ragged "
+                f"batch(es) from the schedule (population stacking is "
+                f"fixed-shape; lead shape {shape})", stacklevel=2)
+        return kept
+
+    def _batch_arrays(self, batches, s0: int, k: int):
+        """(features, labels, fmask, lmask) for steps s0..s0+k-1, stacked
+        on a leading K axis (None masks stay None)."""
+        n = len(batches)
+        chunk = [batches[(s0 + j) % n] for j in range(k)]
+
+        def stack(get):
+            vals = [get(b) for b in chunk]
+            if any(v is None for v in vals):
+                return None
+            return jnp.asarray(np.stack([np.asarray(v) for v in vals]))
+
+        return (stack(lambda b: b.features), stack(lambda b: b.labels),
+                stack(lambda b: b.features_mask),
+                stack(lambda b: b.labels_mask))
+
+    # ------------------------------------------------------------- trial prep
+    def _sample_trials(self) -> List[Trial]:
+        overrides = self.space.candidates(
+            num_trials=self.num_trials, seed=self.seed, grid=self.grid)
+        seeds = np.random.Generator(
+            np.random.PCG64(np.random.SeedSequence([self.seed, 1]))
+        ).integers(0, 2**31 - 1, size=len(overrides))
+        return [Trial(f"t{i:04d}", ov, int(seeds[i]))
+                for i, ov in enumerate(overrides)]
+
+    def _load_or_init_model(self, trial: Trial, conf):
+        """A trial's model, resumed from its newest valid checkpoint when
+        one exists (kill-and-resume path), else freshly initialized from
+        its conf. The dropout rng chain is fast-forwarded to the
+        checkpoint's step so a resumed trial continues the exact stream
+        a never-killed run would have used."""
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        model = None
+        if self.store is not None:
+            ckpt = self.store.latest_trial_checkpoint(trial.id)
+            if ckpt is not None:
+                from deeplearning4j_tpu.train.model_serializer import (
+                    ModelGuesser,
+                )
+
+                model = ModelGuesser.load_model_guess(ckpt)
+        if model is None:
+            model = MultiLayerNetwork(conf).init()
+        self._keys[trial.id] = _advance_key(
+            jax.random.PRNGKey(trial.seed), model.iteration)
+        # the pool engine consumes the model's own stream; align it with
+        # the fast-forwarded chain so resumed trials keep the exact
+        # dropout rng sequence an unkilled run would have used
+        model._rng = self._keys[trial.id]
+        return model
+
+    def _next_trial_rng(self, trial_id: str):
+        self._keys[trial_id], k = jax.random.split(self._keys[trial_id])
+        return k
+
+    # ------------------------------------------------------------------- run
+    def run(self, resume: bool = False) -> StudyResult:
+        batches = self._materialize_batches()
+        trials = self._resolve_trials(resume)
+        confs = {t.id: self.space.build(t.overrides, seed=t.seed)
+                 for t in trials}
+
+        active_confs = [confs[t.id] for t in trials if not t.is_terminal()]
+        engine = self.engine
+        if engine != "pool":
+            ok, reason = population_compatible(active_confs or
+                                               list(confs.values()))
+            if engine == "population" and not ok:
+                raise ValueError(f"population engine requested but "
+                                 f"trials are not stackable: {reason}")
+            if engine == "auto":
+                engine = "population" if ok else "pool"
+                if not ok:
+                    log.info("tune: falling back to pool engine (%s)",
+                             reason)
+        self.engine_used = engine
+
+        models: Dict[str, Any] = {}
+        if engine == "population":
+            self._run_population(trials, confs, batches, models)
+        else:
+            self._run_pool(trials, confs, batches, models)
+
+        best = self._best_trial(trials)
+        if self.store is not None and self.retain_best is not None:
+            ranked = self._ranked_completed(trials)
+            self.store.retain_best(
+                [t.id for t in ranked[: int(self.retain_best)]])
+        return StudyResult(trials, best,
+                           models.get(best.id) if best else None,
+                           engine, self.objective.minimize)
+
+    def _resolve_trials(self, resume: bool) -> List[Trial]:
+        sched_meta = self.scheduler.to_dict()
+        if resume:
+            if self.store is None:
+                raise ValueError("resume=True needs a store_dir")
+            known, _ = self.store.reconstruct()
+            if known:
+                meta = self.store.read_meta() or {}
+                if (meta.get("scheduler", sched_meta) != sched_meta
+                        or meta.get("seed", self.seed) != self.seed):
+                    raise ValueError(
+                        "resume: store was written by a different study "
+                        f"(meta {meta.get('scheduler')}/{meta.get('seed')}"
+                        f" vs {sched_meta}/{self.seed})")
+                trials = list(known.values())
+                # a kill during sampling can leave a partial trial list;
+                # top up from the same deterministic candidate stream
+                if len(trials) < self.num_trials:
+                    fresh = self._sample_trials()[len(trials):]
+                    for t in fresh:
+                        self.store.append({"kind": "trial", **t.to_dict()})
+                    trials.extend(fresh)
+                return trials
+        trials = self._sample_trials()
+        if self.store is not None:
+            import os as _os
+
+            if (_os.path.exists(self.store.journal_path)
+                    and _os.path.getsize(self.store.journal_path) > 0):
+                raise ValueError(
+                    f"store {self.store.directory!r} already holds a "
+                    "study journal — pass resume=True to continue it, or "
+                    "point store_dir at a fresh directory (a fresh run "
+                    "would append duplicate trial records and could load "
+                    "the old study's checkpoints)")
+            self.store.write_meta({
+                "seed": self.seed, "num_trials": self.num_trials,
+                "scheduler": sched_meta,
+                "objective_minimize": self.objective.minimize,
+                "params": {k: v.to_dict()
+                           for k, v in self.space.params.items()},
+            })
+            for t in trials:
+                self.store.append({"kind": "trial", **t.to_dict()})
+        return trials
+
+    # ----------------------------------------------------------- bookkeeping
+    def _record_rung(self, trial: Trial, rung_index: int, score: float,
+                     model) -> None:
+        trial.status = TrialStatus.RUNNING
+        trial.rung = rung_index
+        trial.scores[rung_index] = float(score)
+        if self.store is not None:
+            # checkpoint BEFORE the rung record: a rung journal line
+            # implies a checkpoint at that rung exists, so resume never
+            # trusts a score whose weights were lost
+            self.store.save_trial_checkpoint(model, trial.id, rung_index,
+                                             self.keep_last)
+            self.store.append({
+                "kind": "rung", "id": trial.id, "rung": rung_index,
+                "budget": self.scheduler.rungs[rung_index],
+                "score": float(score),
+            })
+
+    def _finish(self, trial: Trial, status: str,
+                error: Optional[str] = None) -> None:
+        trial.status = status
+        trial.error = error
+        if self.store is not None:
+            rec = {"kind": "status", "id": trial.id, "status": status}
+            if error:
+                rec["error"] = error
+            if trial.final_score is not None:
+                rec["score"] = trial.final_score
+            self.store.append(rec)
+
+    def _best_trial(self, trials) -> Optional[Trial]:
+        ranked = self._ranked_completed(trials)
+        return ranked[0] if ranked else None
+
+    def _ranked_completed(self, trials) -> List[Trial]:
+        done = [t for t in trials
+                if t.status == TrialStatus.COMPLETED
+                and t.final_score is not None
+                and math.isfinite(t.final_score)]
+        sign = 1.0 if self.objective.minimize else -1.0
+        return sorted(done, key=lambda t: (sign * t.final_score, t.id))
+
+    # --------------------------------------------------- population engine
+    def _run_population(self, trials, confs, batches, models) -> None:
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        active = [t for t in trials if not t.is_terminal()]
+        if not active:
+            return
+        # template: first active trial's conf with hyper cells installed
+        # (MultiLayerNetwork deep-copies the conf, so the cell install
+        # never leaks into the trial confs)
+        template = MultiLayerNetwork(confs[active[0].id])
+        trial_hypers = {t.id: _extract_trial_hypers(confs[t.id])
+                        for t in trials}
+        lr_cells, reg_cells = _install_cells(
+            template, [trial_hypers[t.id][1] for t in active])
+        guarded = template._active_fault_policy() is not None
+        step_cache: Dict[Tuple[int, int], Any] = {}
+
+        for t in active:
+            models[t.id] = self._load_or_init_model(t, confs[t.id])
+
+        for rung_index, budget in enumerate(self.scheduler.rungs):
+            active = [t for t in trials if not t.is_terminal()]
+            if not active:
+                break
+            work = [t for t in active if t.rung < rung_index]
+            # lockstep groups: normally one; a kill between two trials'
+            # rung records can leave cohort members one rung apart
+            groups: Dict[int, List[Trial]] = {}
+            for t in work:
+                groups.setdefault(int(models[t.id].iteration),
+                                  []).append(t)
+            for it0, group in sorted(groups.items()):
+                if it0 < budget:
+                    self._train_group(group, models, batches, it0, budget,
+                                      template, guarded, lr_cells,
+                                      reg_cells, trial_hypers, step_cache)
+                for t in group:
+                    self._score_trial(t, models[t.id], rung_index)
+            self._apply_rung_decisions(trials, rung_index)
+
+    def _train_group(self, group, models, batches, it0, budget, template,
+                     guarded, lr_cells, reg_cells, trial_hypers,
+                     step_cache) -> None:
+        n = len(group)
+        lr = jnp.asarray([trial_hypers[t.id][0] for t in group],
+                         jnp.float32)
+        reg = jnp.asarray([trial_hypers[t.id][1] for t in group],
+                          jnp.float32)
+        P = _stack_trees([models[t.id].params_ for t in group])
+        O = _stack_trees([models[t.id].opt_state_ for t in group])
+        S = _stack_trees([models[t.id].state_ for t in group])
+        F = None
+        if guarded:
+            policy = template._active_fault_policy()
+            F = _stack_trees([models[t.id]._ensure_fault_state(policy)
+                              for t in group])
+        scores = None
+        s = int(it0)
+        while s < budget:
+            k = min(self.steps_per_call, budget - s)
+            key = (n, k)
+            if key not in step_cache:
+                step_cache[key] = _make_population_step(
+                    template, k, guarded, lr_cells, reg_cells)
+            f, l, fm, lm = self._batch_arrays(batches, s, k)
+            rngs = jnp.stack([
+                jnp.stack([self._next_trial_rng(t.id) for t in group])
+                for _ in range(k)])
+            it = jnp.asarray(s, jnp.int32)
+            ep = jnp.asarray(0, jnp.int32)
+            if guarded:
+                P, O, S, F, scores = step_cache[key](
+                    lr, reg, P, O, S, F, f, l, fm, lm, rngs, it, ep)
+            else:
+                P, O, S, scores = step_cache[key](
+                    lr, reg, P, O, S, f, l, fm, lm, rngs, it, ep)
+            s += k
+        for i, t in enumerate(group):
+            m = models[t.id]
+            m.params_ = _unstack_tree(P, i)
+            m.opt_state_ = _unstack_tree(O, i)
+            m.state_ = _unstack_tree(S, i)
+            if guarded:
+                m.fault_state_ = _unstack_tree(F, i)
+            m.iteration = int(budget)
+            if scores is not None:
+                m.score_ = scores[-1, i]
+
+    def _score_trial(self, trial, model, rung_index) -> None:
+        try:
+            score = self.objective(model)
+        except Exception as e:  # noqa: BLE001 — a scoring crash fails
+            # the trial, not the study (Arbiter CandidateStatus.Failed)
+            self._finish(trial, TrialStatus.FAILED,
+                         f"{type(e).__name__}: {e}")
+            return
+        if not math.isfinite(score):
+            trial.scores[rung_index] = score
+            self._finish(trial, TrialStatus.FAILED,
+                         f"non-finite rung score {score}")
+            return
+        self._record_rung(trial, rung_index, score, model)
+
+    def _apply_rung_decisions(self, trials, rung_index) -> None:
+        # rank over EVERY trial scored at this rung — including ones a
+        # pre-crash run already stopped — so the selection is idempotent:
+        # a resumed study re-derives exactly the pre-crash survivor set
+        # instead of re-halving whoever is still active
+        scored = [t for t in trials
+                  if rung_index in t.scores
+                  and math.isfinite(t.scores[rung_index])]
+        items = [(t.id, t.scores[rung_index]) for t in scored]
+        if not items:
+            return
+        if rung_index >= len(self.scheduler.rungs) - 1:
+            self.scheduler.select_survivors(rung_index, items)
+            for t in scored:
+                if not t.is_terminal():
+                    self._finish(t, TrialStatus.COMPLETED)
+            return
+        survivors = set(
+            self.scheduler.select_survivors(rung_index, items))
+        for t in scored:
+            if not t.is_terminal() and t.id not in survivors:
+                self._finish(t, TrialStatus.STOPPED)
+
+    # --------------------------------------------------------- pool engine
+    def _run_pool(self, trials, confs, batches, models) -> None:
+        active = [t for t in trials if not t.is_terminal()]
+        if not active:
+            return
+        devices = jax.local_devices()
+        workers = self.workers or min(len(active), max(len(devices), 1))
+        lock = threading.Lock()
+
+        def run_trial(idx: int, trial: Trial) -> None:
+            with jax.default_device(devices[idx % len(devices)]):
+                try:
+                    model = self._load_or_init_model(trial, confs[trial.id])
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        self._finish(trial, TrialStatus.FAILED,
+                                     f"{type(e).__name__}: {e}")
+                    return
+                models[trial.id] = model
+                step = model._get_jit("train", model._make_train_step)
+                for rung_index in range(trial.rung + 1,
+                                        len(self.scheduler.rungs)):
+                    budget = self.scheduler.rungs[rung_index]
+                    try:
+                        nb = len(batches)
+                        while model.iteration < budget:
+                            ds = batches[model.iteration % nb]
+                            model._fit_batch(step, ds)
+                    except Exception as e:  # noqa: BLE001
+                        with lock:
+                            self._finish(trial, TrialStatus.FAILED,
+                                         f"{type(e).__name__}: {e}")
+                        return
+                    with lock:
+                        # scoring stays under the lock: the objective is
+                        # ONE shared stateful iterator (ScoreCalculator
+                        # cursor) — two threads interleaving it would
+                        # each score over partial validation data
+                        try:
+                            score = self.objective(model)
+                        except Exception as e:  # noqa: BLE001
+                            self._finish(trial, TrialStatus.FAILED,
+                                         f"{type(e).__name__}: {e}")
+                            return
+                        if not math.isfinite(score):
+                            trial.scores[rung_index] = score
+                            self._finish(trial, TrialStatus.FAILED,
+                                         f"non-finite rung score {score}")
+                            return
+                        self._record_rung(trial, rung_index, score, model)
+                        decision = "promote"
+                        if self.median_rule is not None:
+                            if self.median_rule.report(
+                                    trial.id, rung_index, score) == "stop":
+                                decision = "stop"
+                        if decision != "stop":
+                            decision = self.scheduler.report(
+                                trial.id, rung_index, score)
+                        if decision == "complete":
+                            self._finish(trial, TrialStatus.COMPLETED)
+                            return
+                        if decision == "stop":
+                            self._finish(trial, TrialStatus.STOPPED)
+                            return
+
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            futures = [pool.submit(run_trial, i, t)
+                       for i, t in enumerate(active)]
+            for fu in futures:
+                fu.result()
+
+
+# --------------------------------------------------------------------------
+# estimator bridge (satellite): a search space over a sklearn-style
+# estimator — NeuralNetClassifier/NeuralNetRegressor or anything with
+# get_params/set_params/fit/score
+# --------------------------------------------------------------------------
+def search_estimator(estimator, params: Dict[str, Any], X, y, *,
+                     num_trials: int = 8, seed: int = 0,
+                     val_fraction: float = 0.25,
+                     grid: bool = False) -> Dict[str, Any]:
+    """Random/grid search over estimator parameters (``conf__<name>``
+    keys route into the estimator's conf factory via the deep-params
+    protocol — estimator.py). Each trial clones the estimator through
+    ``get_params(deep=False)``, applies the sampled overrides with
+    ``set_params``, fits on a deterministic train split and scores on
+    the held-out split (sklearn convention: higher score is better).
+    Returns ``{"best_params", "best_score", "results"}``."""
+    from deeplearning4j_tpu.tune.space import grid_search, random_search
+
+    X = np.asarray(X)
+    y = np.asarray(y)
+    rng = np.random.Generator(np.random.PCG64(seed))
+    order = rng.permutation(len(X))
+    n_val = max(1, int(len(X) * val_fraction))
+    val_idx, train_idx = order[:n_val], order[n_val:]
+    candidates = (grid_search(params) if grid
+                  else random_search(params, seed, num_trials))
+
+    results = []
+    best_params, best_score = None, -math.inf
+    for ov in candidates:
+        est = type(estimator)(**estimator.get_params(deep=False))
+        est.set_params(**ov)
+        est.fit(X[train_idx], y[train_idx])
+        score = float(est.score(X[val_idx], y[val_idx]))
+        results.append({"params": ov, "score": score})
+        if score > best_score or (score == best_score and best_params is None):
+            best_params, best_score = ov, score
+    return {"best_params": best_params, "best_score": best_score,
+            "results": results}

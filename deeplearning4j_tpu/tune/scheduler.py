@@ -1,0 +1,212 @@
+"""Trial scheduling: ASHA successive halving + median stopping rule.
+
+Reference anchor: Arbiter's candidate lifecycle (``CandidateStatus``:
+Created/Running/Complete/Failed/Cancelled) drove a flat random/grid
+search; the scheduler here adds the budget dimension modern tuners use —
+ASHA (Li et al., "A System for Massively Parallel Hyperparameter
+Tuning") successive halving over a rung ladder, plus Google Vizier's
+median stopping rule as an orthogonal pruner.
+
+Budgets are **cumulative optimizer steps**. The rung ladder is
+``min_budget * eta^k`` capped at ``max_budget``. Two consumption modes,
+matching the two execution engines (tune/runner.py):
+
+- ``select_survivors`` — synchronous successive halving: the vmapped
+  population engine trains a whole cohort to a rung in one stacked
+  program, then keeps the top ``max(1, n // eta)`` scores. Deterministic
+  given the scores (ties broken by trial id), hand-computable.
+- ``report`` — asynchronous stopping rule for the thread-pool engine: a
+  trial reporting at a rung continues iff its score is within the top
+  ``1/eta`` quantile of all scores reported at that rung SO FAR
+  (quantile semantics: with few reporters the cutoff is permissive, so
+  early finishers are never starved — the ASHA paper's motivation).
+
+Trial lifecycle: PENDING → RUNNING → {COMPLETED | STOPPED | FAILED};
+the store (tune/store.py) journals every transition so a killed study
+replays to exactly this state machine.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# trial lifecycle
+# --------------------------------------------------------------------------
+class TrialStatus:
+    PENDING = "PENDING"        # sampled, not yet trained
+    RUNNING = "RUNNING"        # training (has at least started a rung)
+    COMPLETED = "COMPLETED"    # reached the final rung and was scored
+    STOPPED = "STOPPED"        # killed by the scheduler (not an error)
+    FAILED = "FAILED"          # non-finite score / training error
+
+    TERMINAL = (COMPLETED, STOPPED, FAILED)
+
+
+class Trial:
+    """One hyperparameter candidate's full lifecycle record."""
+
+    def __init__(self, trial_id: str, overrides: Dict[str, Any], seed: int):
+        self.id = trial_id
+        self.overrides = dict(overrides)
+        self.seed = int(seed)
+        self.status = TrialStatus.PENDING
+        # index of the last COMPLETED rung (-1 = none yet)
+        self.rung = -1
+        self.scores: Dict[int, float] = {}   # rung index -> score
+        self.error: Optional[str] = None
+
+    @property
+    def final_score(self) -> Optional[float]:
+        if not self.scores:
+            return None
+        return self.scores[max(self.scores)]
+
+    def is_terminal(self) -> bool:
+        return self.status in TrialStatus.TERMINAL
+
+    def to_dict(self) -> dict:
+        return {"id": self.id, "overrides": _jsonable(self.overrides),
+                "seed": self.seed, "status": self.status,
+                "rung": self.rung,
+                "scores": {str(k): v for k, v in self.scores.items()},
+                "error": self.error}
+
+    def __repr__(self):
+        return (f"Trial({self.id}, {self.status}, rung={self.rung}, "
+                f"score={self.final_score})")
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj
+
+
+# --------------------------------------------------------------------------
+# ASHA
+# --------------------------------------------------------------------------
+def asha_rungs(min_budget: int, max_budget: int, eta: int) -> List[int]:
+    """The cumulative-step rung ladder: min_budget * eta^k, capped at (and
+    always ending on) max_budget."""
+    if min_budget <= 0 or max_budget < min_budget:
+        raise ValueError(
+            f"need 0 < min_budget <= max_budget, got "
+            f"[{min_budget}, {max_budget}]")
+    if eta < 2:
+        raise ValueError(f"eta must be >= 2, got {eta}")
+    out, r = [], int(min_budget)
+    while r < max_budget:
+        out.append(r)
+        r *= int(eta)
+    out.append(int(max_budget))
+    return out
+
+
+class AshaScheduler:
+    """ASHA successive halving over a rung ladder (module docstring)."""
+
+    def __init__(self, min_budget: int, max_budget: int, eta: int = 3,
+                 minimize: bool = True):
+        self.eta = int(eta)
+        self.minimize = bool(minimize)
+        self.rungs = asha_rungs(min_budget, max_budget, eta)
+        # rung index -> list of (score, trial_id) in report order
+        self._reported: Dict[int, List[Tuple[float, str]]] = {}
+
+    # -- shared ---------------------------------------------------------------
+    def _better(self, a: float, b: float) -> bool:
+        return a < b if self.minimize else a > b
+
+    def record(self, trial_id: str, rung_index: int, score: float) -> None:
+        self._reported.setdefault(int(rung_index), []).append(
+            (float(score), trial_id))
+
+    # -- synchronous mode (population engine) ---------------------------------
+    def select_survivors(self, rung_index: int,
+                         scored: Sequence[Tuple[str, float]]
+                         ) -> List[str]:
+        """Classic successive halving at one rung: record every cohort
+        score and keep the top ``max(1, n // eta)`` trial ids (ties
+        broken toward the smaller trial id, so the outcome is
+        deterministic and hand-computable). The final rung keeps
+        everyone — those trials COMPLETE instead of promoting."""
+        for tid, s in scored:
+            self.record(tid, rung_index, s)
+        if rung_index >= len(self.rungs) - 1:
+            return [tid for tid, _ in scored]
+        n = len(scored)
+        keep = max(1, n // self.eta)
+        sign = 1.0 if self.minimize else -1.0
+        ranked = sorted(scored, key=lambda ts: (sign * ts[1], ts[0]))
+        return [tid for tid, _ in ranked[:keep]]
+
+    # -- asynchronous mode (pool engine) --------------------------------------
+    def report(self, trial_id: str, rung_index: int, score: float) -> str:
+        """Record one score; decide this trial's fate now (async
+        stopping-rule ASHA). Returns "complete" (final rung), "promote"
+        (within the top 1/eta quantile of scores seen at this rung so
+        far, itself included), or "stop"."""
+        if math.isnan(score):
+            return "stop"
+        self.record(trial_id, rung_index, score)
+        if rung_index >= len(self.rungs) - 1:
+            return "complete"
+        scores = [s for s, _ in self._reported[rung_index]]
+        q = 1.0 / self.eta if self.minimize else 1.0 - 1.0 / self.eta
+        cutoff = float(np.quantile(np.asarray(scores, np.float64), q))
+        ok = score <= cutoff if self.minimize else score >= cutoff
+        return "promote" if ok else "stop"
+
+    def to_dict(self) -> dict:
+        return {"kind": "asha", "eta": self.eta, "minimize": self.minimize,
+                "rungs": list(self.rungs)}
+
+    def __repr__(self):
+        return (f"AshaScheduler(rungs={self.rungs}, eta={self.eta}, "
+                f"{'min' if self.minimize else 'max'})")
+
+
+class MedianStoppingRule:
+    """Google Vizier's median stopping rule as an orthogonal pruner: a
+    trial is stopped at a rung when its score is strictly worse than the
+    median of ALL scores reported at that rung (needs >= ``min_reports``
+    peers; rungs below ``grace`` are never pruned)."""
+
+    def __init__(self, grace: int = 1, min_reports: int = 3,
+                 minimize: bool = True):
+        self.grace = int(grace)
+        self.min_reports = int(min_reports)
+        self.minimize = bool(minimize)
+        self._reported: Dict[int, List[float]] = {}
+
+    def report(self, trial_id: str, rung_index: int, score: float) -> str:
+        # a non-finite score is a diverged trial: stop it outright and
+        # never record it — one NaN in the peer list would poison every
+        # later median at this rung (NaN comparisons are all False, so
+        # the rule would silently stop pruning)
+        if not math.isfinite(score):
+            return "stop"
+        peers = self._reported.setdefault(int(rung_index), [])
+        decision = "continue"
+        if rung_index >= self.grace and len(peers) >= self.min_reports:
+            med = float(np.median(np.asarray(peers, np.float64)))
+            worse = score > med if self.minimize else score < med
+            if worse:
+                decision = "stop"
+        peers.append(float(score))
+        return decision
+
+    def to_dict(self) -> dict:
+        return {"kind": "median", "grace": self.grace,
+                "min_reports": self.min_reports, "minimize": self.minimize}

@@ -1,0 +1,321 @@
+"""Hyperparameter search spaces (Arbiter's ``ParameterSpace<T>`` layer).
+
+Reference: the DL4J stack's Arbiter module —
+``arbiter-core/.../parameter/continuous/ContinuousParameterSpace.java``,
+``discrete/DiscreteParameterSpace.java``, ``integer/IntegerParameterSpace``,
+``MultiLayerSpace`` (layer-structure spaces), and the candidate generators
+(``GridSearchCandidateGenerator``, ``RandomSearchGenerator``). Here a space
+is a typed sampler: ``sample(rng) -> value`` from a seeded
+``numpy.random.Generator`` (PCG64 — bit-reproducible across processes and
+platforms, asserted in tests), plus a deterministic ``grid(n)`` for grid
+search.
+
+A :class:`SearchSpace` binds named parameter spaces to a *conf factory* —
+a callable taking the sampled values as keyword arguments and returning a
+built ``MultiLayerConfiguration`` (the analog of Arbiter's
+``MultiLayerSpace.getValue(values)``). The tuner samples override dicts,
+builds one configuration per trial, and hands them to the execution
+engines (tune/runner.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ParameterSpace:
+    """Base typed parameter space."""
+
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid(self, n: int) -> List[Any]:
+        """Up to ``n`` deterministic grid points covering the space."""
+        raise NotImplementedError
+
+    # -- serde (space JSON for the CLI) --------------------------------------
+    def to_dict(self) -> dict:
+        d = {"type": _TYPE_NAMES[type(self)]}
+        d.update({k: v for k, v in self.__dict__.items()})
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "ParameterSpace":
+        d = dict(d)
+        kind = d.pop("type")
+        if kind not in _TYPES:
+            raise ValueError(
+                f"Unknown parameter space type {kind!r}; one of "
+                f"{sorted(_TYPES)}")
+        return _TYPES[kind]._from_fields(d)
+
+    @classmethod
+    def _from_fields(cls, d: dict) -> "ParameterSpace":
+        return cls(**d)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform over ``[low, high]`` — linearly, or uniformly in log-space
+    (``scale="log"``, the right prior for learning rates / l2)."""
+
+    def __init__(self, low: float, high: float, scale: str = "linear"):
+        if scale not in ("linear", "log"):
+            raise ValueError(f"scale must be 'linear'|'log', got {scale!r}")
+        if scale == "log" and (low <= 0 or high <= 0):
+            raise ValueError(
+                f"log scale needs positive bounds, got [{low}, {high}]")
+        if not low <= high:
+            raise ValueError(f"low {low} > high {high}")
+        self.low = float(low)
+        self.high = float(high)
+        self.scale = scale
+
+    def sample(self, rng):
+        u = float(rng.random())
+        if self.scale == "log":
+            lo, hi = math.log(self.low), math.log(self.high)
+            return float(math.exp(lo + u * (hi - lo)))
+        return float(self.low + u * (self.high - self.low))
+
+    def grid(self, n):
+        if n <= 1:
+            return [self.low]
+        if self.scale == "log":
+            lo, hi = math.log(self.low), math.log(self.high)
+            return [float(math.exp(lo + i * (hi - lo) / (n - 1)))
+                    for i in range(n)]
+        return [float(self.low + i * (self.high - self.low) / (n - 1))
+                for i in range(n)]
+
+
+class IntegerParameterSpace(ParameterSpace):
+    """Uniform integer over ``[low, high]`` inclusive."""
+
+    def __init__(self, low: int, high: int):
+        if not low <= high:
+            raise ValueError(f"low {low} > high {high}")
+        self.low = int(low)
+        self.high = int(high)
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high + 1))
+
+    def grid(self, n):
+        count = self.high - self.low + 1
+        if n >= count:
+            return list(range(self.low, self.high + 1))
+        return sorted({int(round(self.low + i * (count - 1) / (n - 1)))
+                       for i in range(n)}) if n > 1 else [self.low]
+
+
+class DiscreteParameterSpace(ParameterSpace):
+    """Uniform over an explicit value list (categoricals: activation
+    names, updater names, width tuples...)."""
+
+    def __init__(self, values: Sequence[Any]):
+        if not values:
+            raise ValueError("DiscreteParameterSpace needs >=1 value")
+        self.values = [tuple(v) if isinstance(v, list) else v
+                       for v in values]
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self, n):
+        return list(self.values[: max(n, 1)]) if n < len(self.values) \
+            else list(self.values)
+
+
+class LayerWidthsSpace(ParameterSpace):
+    """Nested structural space: a tuple of hidden-layer widths — depth
+    drawn from ``count`` (int or IntegerParameterSpace), each layer's
+    width drawn independently from ``width`` (the Arbiter
+    ``MultiLayerSpace`` nested-layer idiom). Samples are tuples so they
+    hash/compare cleanly in override dicts."""
+
+    def __init__(self, count, width):
+        self.count = (count if isinstance(count, ParameterSpace)
+                      else IntegerParameterSpace(int(count), int(count)))
+        if not isinstance(width, ParameterSpace):
+            width = DiscreteParameterSpace(list(width))
+        self.width = width
+
+    def sample(self, rng):
+        c = self.count.sample(rng)
+        return tuple(self.width.sample(rng) for _ in range(c))
+
+    def grid(self, n):
+        out: List[tuple] = []
+        for c in self.count.grid(n):
+            for combo in itertools.product(self.width.grid(n), repeat=c):
+                out.append(tuple(combo))
+                if len(out) >= n:
+                    return out
+        return out
+
+    def to_dict(self):
+        return {"type": "layer_widths", "count": self.count.to_dict(),
+                "width": self.width.to_dict()}
+
+    @classmethod
+    def _from_fields(cls, d):
+        return cls(ParameterSpace.from_dict(d["count"]),
+                   ParameterSpace.from_dict(d["width"]))
+
+
+_TYPES: Dict[str, type] = {
+    "continuous": ContinuousParameterSpace,
+    "integer": IntegerParameterSpace,
+    "discrete": DiscreteParameterSpace,
+    "layer_widths": LayerWidthsSpace,
+}
+_TYPE_NAMES = {v: k for k, v in _TYPES.items()}
+
+
+# ---------------------------------------------------------------------------
+# candidate generators (reference GridSearchCandidateGenerator /
+# RandomSearchGenerator)
+# ---------------------------------------------------------------------------
+def random_search(params: Dict[str, ParameterSpace], seed: int,
+                  n: int) -> List[Dict[str, Any]]:
+    """``n`` seeded random override dicts. Parameters are drawn in sorted
+    name order from one PCG64 stream, so the candidate list is
+    bit-reproducible across processes/platforms for a given seed
+    (asserted by a subprocess test) — a resumed study regenerates the
+    exact trial set it crashed with."""
+    rng = np.random.Generator(np.random.PCG64(int(seed)))
+    names = sorted(params)
+    return [{name: params[name].sample(rng) for name in names}
+            for _ in range(n)]
+
+
+def grid_search(params: Dict[str, ParameterSpace],
+                points_per_param: int = 3,
+                limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Cartesian product of per-parameter grids (sorted name order),
+    optionally truncated to ``limit`` candidates."""
+    names = sorted(params)
+    axes = [params[name].grid(points_per_param) for name in names]
+    out = []
+    for combo in itertools.product(*axes):
+        out.append(dict(zip(names, combo)))
+        if limit is not None and len(out) >= limit:
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# conf factory binding
+# ---------------------------------------------------------------------------
+class ConfFactory:
+    """A named-hyperparameter configuration factory: ``fn`` plus bound
+    keyword defaults. Calling it builds the conf; ``with_params`` returns
+    a NEW factory with overrides applied (copy-on-write, so sklearn
+    clones and tuner trials never mutate a shared factory). This is the
+    object the estimator layer's ``conf__<name>`` deep-param routing and
+    the tuner both drive."""
+
+    def __init__(self, fn: Callable, **hyper):
+        self.fn = fn
+        self.hyper = dict(hyper)
+
+    def __call__(self, **overrides):
+        kw = dict(self.hyper)
+        kw.update(overrides)
+        return self.fn(**kw)
+
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        # sklearn-clone compatible: clone() reconstructs via
+        # type(obj)(**obj.get_params(deep=False)), so the constructor's
+        # ``fn`` must be part of the params (the estimator layer skips
+        # callable entries when surfacing these as conf__<name>)
+        return {"fn": self.fn, **self.hyper}
+
+    def set_params(self, **params) -> "ConfFactory":
+        self.fn = params.pop("fn", self.fn)
+        self.hyper.update(params)
+        return self
+
+    def with_params(self, **overrides) -> "ConfFactory":
+        kw = dict(self.hyper)
+        kw.update(overrides)
+        return ConfFactory(self.fn, **kw)
+
+    def __repr__(self):
+        return f"ConfFactory({getattr(self.fn, '__name__', self.fn)}, {self.hyper})"
+
+
+def mlp_factory(n_in: int, n_classes: int, *, lr: float = 1e-3,
+                l2: float = 0.0, widths: Sequence[int] = (32,),
+                activation: str = "relu", dropout: float = 0.0,
+                updater: str = "adam", seed: int = 0,
+                steps_per_call: int = 1):
+    """Stock tunable MLP classifier factory (CLI ``tune`` + tests): every
+    keyword is a legal search dimension. ``lr``/``l2``/``seed`` are
+    population-vmappable; ``widths``/``activation``/``dropout``/
+    ``updater`` change the program and route trials to the pool engine."""
+    from deeplearning4j_tpu import updaters as _upd
+    from deeplearning4j_tpu.nn.conf.builders import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.layers.core import DenseLayer, OutputLayer
+
+    b = (NeuralNetConfiguration.builder()
+         .seed(int(seed))
+         .updater(_upd.get(updater).with_learning_rate(float(lr)))
+         .l2(float(l2))
+         .steps_per_call(int(steps_per_call))
+         .list())
+    for w in widths:
+        b.layer(DenseLayer(n_out=int(w), activation=activation,
+                           dropout=float(dropout)))
+    b.layer(OutputLayer(n_out=int(n_classes), activation="softmax",
+                        loss="mcxent"))
+    return b.set_input_type(InputType.feed_forward(int(n_in))).build()
+
+
+class SearchSpace:
+    """Named parameter spaces over a conf factory — the unit the tuner
+    consumes. ``factory(**overrides, seed=...)`` must return a built
+    MultiLayerConfiguration; overrides not understood by the factory are
+    a configuration error surfaced at build time."""
+
+    def __init__(self, factory: Callable, params: Dict[str, ParameterSpace]):
+        self.factory = factory
+        self.params = dict(params)
+
+    def candidates(self, *, num_trials: int, seed: int,
+                   grid: bool = False) -> List[Dict[str, Any]]:
+        if grid:
+            pts = max(2, int(round(num_trials ** (1.0 / max(len(self.params), 1)))))
+            return grid_search(self.params, pts, limit=num_trials)
+        return random_search(self.params, seed, num_trials)
+
+    def build(self, overrides: Dict[str, Any], seed: Optional[int] = None):
+        kw = dict(overrides)
+        if seed is not None:
+            kw["seed"] = int(seed)
+        conf = self.factory(**kw)
+        return conf
+
+    # -- space JSON (CLI surface) --------------------------------------------
+    def params_to_json(self) -> str:
+        return json.dumps(
+            {"params": {k: v.to_dict() for k, v in self.params.items()}},
+            indent=2, sort_keys=True)
+
+    @staticmethod
+    def params_from_json(text: str) -> Dict[str, ParameterSpace]:
+        data = json.loads(text)
+        raw = data.get("params", data)
+        return {name: ParameterSpace.from_dict(d) for name, d in raw.items()}

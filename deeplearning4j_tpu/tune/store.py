@@ -1,0 +1,181 @@
+"""Crash-safe trial store: append-only JSONL journal + per-trial
+checkpoint retention.
+
+The durability story mirrors the training stack's checkpointing
+(train/faults.py, same ``os.replace`` discipline as ``ModelSerializer``):
+
+- ``study.json`` (immutable study identity: space, scheduler ladder,
+  seed, trial count) is published atomically — staged to a same-directory
+  temp file and ``os.replace``d, so a reader never sees a torn meta file.
+- ``trials.jsonl`` is the append-only journal. Each record is one JSON
+  line written with flush+fsync, so a SIGKILL can lose AT MOST the
+  in-flight line — and a torn trailing line is detected and dropped on
+  replay (anything torn in the middle means external corruption and
+  raises). Rewriting the journal in place is never needed, which is why
+  append+fsync rather than write-temp-and-replace is the right atomic
+  discipline here.
+- Model checkpoints live under ``<dir>/trials/<trial_id>/`` and go
+  through ``faults.save_checkpoint`` (atomic zip publish, keep-last-k
+  pruning, ``latest_valid_checkpoint`` fallback past truncated ones).
+
+Replay folds the journal into the scheduler's trial state machine
+(tune/scheduler.Trial): a restarted study skips terminal trials and
+resumes in-flight ones from their newest valid checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.tune.scheduler import Trial, TrialStatus
+
+META_NAME = "study.json"
+JOURNAL_NAME = "trials.jsonl"
+TRIALS_SUBDIR = "trials"
+
+
+class TrialStore:
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.journal_path = os.path.join(directory, JOURNAL_NAME)
+        self.meta_path = os.path.join(directory, META_NAME)
+        self._lock = threading.Lock()  # pool-engine threads share one store
+
+    # ------------------------------------------------------------- study meta
+    def write_meta(self, meta: dict) -> None:
+        from deeplearning4j_tpu.train.faults import atomic_tmp_path
+
+        tmp = atomic_tmp_path(self.meta_path)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(meta, f, indent=2, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.meta_path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+
+    def read_meta(self) -> Optional[dict]:
+        if not os.path.exists(self.meta_path):
+            return None
+        with open(self.meta_path) as f:
+            return json.load(f)
+
+    # ---------------------------------------------------------------- journal
+    def append(self, record: dict) -> None:
+        line = json.dumps(record, sort_keys=True)
+        with self._lock:
+            with open(self.journal_path, "a") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def replay(self) -> List[dict]:
+        """Journal records in append order. A torn FINAL line (the one a
+        SIGKILL can leave) is dropped with a warning; a torn line with
+        records after it is external corruption and raises."""
+        if not os.path.exists(self.journal_path):
+            return []
+        out: List[dict] = []
+        torn_at: Optional[int] = None
+        with open(self.journal_path) as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    torn_at = i
+                    continue
+                if torn_at is not None:
+                    raise ValueError(
+                        f"{self.journal_path}:{torn_at + 1}: corrupt journal "
+                        "line with valid records after it — not crash "
+                        "truncation; refusing to replay")
+                out.append(rec)
+        if torn_at is not None:
+            warnings.warn(
+                f"{self.journal_path}: dropping torn trailing line "
+                f"{torn_at + 1} (crash mid-append)", stacklevel=2)
+        return out
+
+    def reconstruct(self) -> Tuple[Dict[str, Trial], List[dict]]:
+        """Fold the journal into per-trial lifecycle state: ``{trial_id:
+        Trial}`` (insertion order = sampling order) plus the raw
+        records."""
+        records = self.replay()
+        trials: Dict[str, Trial] = {}
+        for rec in records:
+            kind = rec.get("kind")
+            if kind == "trial":
+                t = Trial(rec["id"], rec.get("overrides", {}),
+                          rec.get("seed", 0))
+                trials[t.id] = t
+            elif kind == "rung":
+                t = trials.get(rec["id"])
+                if t is None:
+                    raise ValueError(
+                        f"journal rung record for unknown trial {rec['id']!r}")
+                t.status = TrialStatus.RUNNING
+                t.rung = int(rec["rung"])
+                t.scores[int(rec["rung"])] = float(rec["score"])
+            elif kind == "status":
+                t = trials.get(rec["id"])
+                if t is None:
+                    raise ValueError(
+                        f"journal status record for unknown trial "
+                        f"{rec['id']!r}")
+                t.status = rec["status"]
+                t.error = rec.get("error")
+        return trials, records
+
+    # ------------------------------------------------------------ checkpoints
+    def trial_dir(self, trial_id: str) -> str:
+        return os.path.join(self.directory, TRIALS_SUBDIR, trial_id)
+
+    def save_trial_checkpoint(self, model, trial_id: str, rung_index: int,
+                              keep_last: Optional[int]) -> str:
+        from deeplearning4j_tpu.train import faults
+
+        return faults.save_checkpoint(
+            model, self.trial_dir(trial_id), keep_last=keep_last,
+            stem=f"rung_{rung_index:04d}_iter_{int(model.iteration):08d}")
+
+    def latest_trial_checkpoint(self, trial_id: str) -> Optional[str]:
+        from deeplearning4j_tpu.train import faults
+
+        return faults.latest_valid_checkpoint(self.trial_dir(trial_id),
+                                              missing_ok=True)
+
+    def trial_checkpoints(self, trial_id: str) -> List[str]:
+        from deeplearning4j_tpu.train import faults
+
+        d = self.trial_dir(trial_id)
+        return faults.checkpoint_files(d) if os.path.isdir(d) else []
+
+    def retain_best(self, keep_ids) -> List[str]:
+        """Best-k retention at study level: delete the checkpoint
+        directories of every trial NOT in ``keep_ids`` (journal records
+        are kept — history is cheap, checkpoints are not). Returns the
+        removed directories."""
+        keep = set(keep_ids)
+        root = os.path.join(self.directory, TRIALS_SUBDIR)
+        removed = []
+        if not os.path.isdir(root):
+            return removed
+        for name in sorted(os.listdir(root)):
+            if name in keep:
+                continue
+            p = os.path.join(root, name)
+            if os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)
+                removed.append(p)
+        return removed

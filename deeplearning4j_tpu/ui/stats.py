@@ -81,6 +81,16 @@ def _summary(arrs: Dict[str, np.ndarray], histograms: bool,
 
 
 class StatsListener(TrainingListener):
+    # Bundling audit (train/pipeline.resolve_steps_per_call): stats
+    # collection is state-coupled — iteration_done snapshots the model's
+    # live parameters and differences them against the previous reporting
+    # iteration (the update:param-ratio chart). Under steps_per_call>1
+    # the post-bundle listener replay would hand every step END-OF-BUNDLE
+    # parameters: in-bundle deltas read as zero and cross-bundle deltas
+    # lump K updates together, silently corrupting the charts. Declaring
+    # the need forces K=1 whenever a StatsListener is attached.
+    requires_per_step_state = True
+
     def __init__(self, storage: StatsStorage, reporting_frequency: int = 10,
                  session_id: Optional[str] = None, worker_id: str = "worker_0",
                  collect_histograms: bool = True, histogram_bins: int = 20,

@@ -59,6 +59,27 @@ class Updater:
         assert self.learning_rate is not None
         return self.learning_rate.value_at(iteration, epoch)
 
+    def fixed_learning_rate(self) -> Optional[float]:
+        """The lr as a plain float iff it is a FixedSchedule (the only
+        schedule the tuner's vmapped population engine can rebind to a
+        traced per-trial value), else None — also None for lr-less
+        updaters (AdaDelta, NoOp)."""
+        if self.learning_rate is None or not isinstance(
+                self.learning_rate, FixedSchedule):
+            return None
+        return float(self.learning_rate.value)
+
+    def with_learning_rate(self, lr: Union[float, Schedule]) -> "Updater":
+        """Copy of this updater with the learning rate replaced (no-op
+        copy for lr-less updaters) — hyperparameter-override hook for the
+        tuner's search spaces."""
+        import copy
+
+        u = copy.deepcopy(self)
+        if u.has_learning_rate:
+            u.learning_rate = as_schedule(lr)
+        return u
+
     # -- serde ---------------------------------------------------------------
     def to_dict(self) -> dict:
         d: Dict[str, Any] = {"@class": type(self).__name__}

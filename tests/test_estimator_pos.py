@@ -90,6 +90,80 @@ class TestNeuralNetClassifier:
         assert pipe.score(X, y) > 0.9
 
 
+class TestConfFactoryDeepParams:
+    """conf-factory hyperparameters surface as conf__<name> deep params,
+    so sklearn clone/GridSearchCV and the tuner bridge can search the
+    NETWORK's hyperparameters."""
+
+    def _factory(self, **hyper):
+        import functools
+
+        from deeplearning4j_tpu.tune import ConfFactory, mlp_factory
+
+        return ConfFactory(functools.partial(mlp_factory, 4, 3),
+                           widths=(16,), **hyper)
+
+    def test_get_params_deep_exposes_factory_hypers(self):
+        clf = NeuralNetClassifier(self._factory(lr=1e-2, l2=1e-4),
+                                  epochs=3)
+        deep = clf.get_params(deep=True)
+        assert deep["conf__lr"] == 1e-2 and deep["conf__l2"] == 1e-4
+        assert "conf__lr" not in clf.get_params(deep=False)
+
+    def test_set_params_routes_conf_and_copies_on_write(self):
+        factory = self._factory(lr=1e-2)
+        a = NeuralNetClassifier(factory, epochs=2)
+        # sklearn.clone semantics: the clone receives the SAME factory
+        b = NeuralNetClassifier(**{k: v for k, v in
+                                   a.get_params(deep=False).items()})
+        b.set_params(conf__lr=5e-3)
+        assert b.get_params()["conf__lr"] == 5e-3
+        # a's factory must be untouched (grid points are independent)
+        assert a.get_params()["conf__lr"] == 1e-2
+        assert factory.get_params()["lr"] == 1e-2
+        with pytest.raises(ValueError, match="with_params"):
+            NeuralNetClassifier(_clf_conf).set_params(conf__lr=1e-3)
+
+    def test_fit_uses_routed_hyperparameters(self):
+        X, y = _blobs()
+        clf = NeuralNetClassifier(self._factory(), epochs=12,
+                                  batch_size=32)
+        clf.set_params(conf__lr=1e-2)
+        clf.fit(X, y)
+        assert clf.score(X, y) > 0.9
+        lr = clf.net_.layers[0].updater.fixed_learning_rate()
+        assert lr == pytest.approx(1e-2)
+
+    def test_gridsearchcv_over_conf_params_if_available(self):
+        pytest.importorskip("sklearn")
+        from sklearn.model_selection import GridSearchCV
+
+        X, y = _blobs(n=120)
+        gs = GridSearchCV(
+            NeuralNetClassifier(self._factory(), epochs=6, batch_size=32),
+            {"conf__lr": [1e-2, 1e-3]}, cv=2)
+        gs.fit(X, y)
+        assert gs.best_params_["conf__lr"] in (1e-2, 1e-3)
+
+    def test_estimator_tuner_bridge_smoke(self):
+        """A search space over an estimator: sampled conf__/loop params
+        route through set_params, trials score on a held-out split."""
+        from deeplearning4j_tpu.tune import (
+            ContinuousParameterSpace,
+            search_estimator,
+        )
+
+        X, y = _blobs(n=160)
+        out = search_estimator(
+            NeuralNetClassifier(self._factory(), epochs=4, batch_size=32),
+            {"conf__lr": ContinuousParameterSpace(1e-3, 3e-2,
+                                                  scale="log")},
+            X, y, num_trials=3, seed=5)
+        assert len(out["results"]) == 3
+        assert out["best_params"] in [r["params"] for r in out["results"]]
+        assert out["best_score"] == max(r["score"] for r in out["results"])
+
+
 class TestNeuralNetRegressor:
     def test_fit_and_r2(self):
         from deeplearning4j_tpu.nn.conf import (InputType,

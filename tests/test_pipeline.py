@@ -203,6 +203,22 @@ class TestBundlingLegality:
         net.set_listeners(per_epoch)
         assert pipeline.resolve_steps_per_call(net) == 4
 
+    def test_stats_listener_forces_k1(self):
+        """StatsListener differences live params between reporting
+        iterations (update:param-ratio chart) — per-step state coupling
+        the PR-4 bundling audit must catch: attaching one forces K=1
+        instead of silently recording end-of-bundle snapshots."""
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+
+        stats = StatsListener(InMemoryStatsStorage(), session_id="audit")
+        assert pipeline.bundling_blockers([stats]) == [
+            "StatsListener.requires_per_step_state"]
+        net = _mlp(4)
+        net.set_listeners(stats)
+        assert pipeline.resolve_steps_per_call(net) == 1
+        net.set_listeners()
+        assert pipeline.resolve_steps_per_call(net) == 4
+
     def test_evaluative_listener_iteration_end_forces_k1(self):
         from deeplearning4j_tpu.train.listeners import EvaluativeListener
 

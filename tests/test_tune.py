@@ -1,0 +1,576 @@
+"""Hyperparameter search subsystem (tune/): spaces, ASHA math, the
+vmapped population engine's bit-parity with solo training, the
+crash-safe trial store, and kill-and-resume."""
+
+import functools
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import (
+    ExistingDataSetIterator,
+    ListDataSetIterator,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.train.earlystopping import (
+    ClassificationScoreCalculator,
+    DataSetLossCalculator,
+    ScoreCalculatorObjective,
+)
+from deeplearning4j_tpu.tune import (
+    AshaScheduler,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    IntegerParameterSpace,
+    LayerWidthsSpace,
+    MedianStoppingRule,
+    ParameterSpace,
+    SearchSpace,
+    Study,
+    TrialStatus,
+    TrialStore,
+    asha_rungs,
+    grid_search,
+    mlp_factory,
+    population_compatible,
+    random_search,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _batches(n, batch=16, d_in=8, d_out=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(batch, d_in)).astype(np.float32),
+                    np.eye(d_out, dtype=np.float32)[
+                        rng.integers(0, d_out, batch)])
+            for _ in range(n)]
+
+
+def _space(**extra_params):
+    params = {"lr": ContinuousParameterSpace(1e-3, 1e-1, scale="log"),
+              "l2": ContinuousParameterSpace(1e-5, 1e-2, scale="log")}
+    params.update(extra_params)
+    return SearchSpace(
+        functools.partial(mlp_factory, 8, 3, widths=(16,), dropout=0.1),
+        params)
+
+
+def _objective(val):
+    return ScoreCalculatorObjective(
+        DataSetLossCalculator(ExistingDataSetIterator(val)))
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, tree))
+
+
+# ==========================================================================
+# parameter spaces + generators
+# ==========================================================================
+class TestSpaces:
+    def test_continuous_bounds_and_scales(self):
+        rng = np.random.Generator(np.random.PCG64(0))
+        lin = ContinuousParameterSpace(-1.0, 3.0)
+        logs = ContinuousParameterSpace(1e-4, 1e-1, scale="log")
+        for _ in range(200):
+            assert -1.0 <= lin.sample(rng) <= 3.0
+            assert 1e-4 <= logs.sample(rng) <= 1e-1 * (1 + 1e-9)
+        with pytest.raises(ValueError):
+            ContinuousParameterSpace(-1.0, 1.0, scale="log")
+        with pytest.raises(ValueError):
+            ContinuousParameterSpace(2.0, 1.0)
+        g = logs.grid(4)
+        assert g[0] == pytest.approx(1e-4) and g[-1] == pytest.approx(1e-1)
+        # log grid is geometric, not arithmetic
+        assert g[1] / g[0] == pytest.approx(g[2] / g[1])
+
+    def test_integer_and_discrete(self):
+        rng = np.random.Generator(np.random.PCG64(1))
+        ispace = IntegerParameterSpace(2, 5)
+        seen = {ispace.sample(rng) for _ in range(200)}
+        assert seen == {2, 3, 4, 5}
+        assert ispace.grid(10) == [2, 3, 4, 5]
+        d = DiscreteParameterSpace(["relu", "tanh"])
+        assert {d.sample(rng) for _ in range(50)} == {"relu", "tanh"}
+
+    def test_layer_widths_nested(self):
+        rng = np.random.Generator(np.random.PCG64(2))
+        s = LayerWidthsSpace(IntegerParameterSpace(1, 3),
+                             DiscreteParameterSpace([16, 32]))
+        for _ in range(50):
+            widths = s.sample(rng)
+            assert isinstance(widths, tuple)
+            assert 1 <= len(widths) <= 3
+            assert set(widths) <= {16, 32}
+
+    def test_random_search_reproducible_in_process(self):
+        params = {"lr": ContinuousParameterSpace(1e-4, 1e-1, scale="log"),
+                  "depth": IntegerParameterSpace(1, 4)}
+        a = random_search(params, seed=7, n=16)
+        b = random_search(params, seed=7, n=16)
+        assert a == b
+        assert random_search(params, seed=8, n=16) != a
+
+    def test_random_search_bit_reproducible_across_processes(self):
+        """Seeded sampling must be deterministic process-to-process —
+        a resumed study regenerates the exact candidate list."""
+        params = {"lr": ContinuousParameterSpace(1e-4, 1e-1, scale="log"),
+                  "l2": ContinuousParameterSpace(1e-6, 1e-2, scale="log"),
+                  "depth": IntegerParameterSpace(1, 4)}
+        local = random_search(params, seed=123, n=8)
+        code = (
+            "import json\n"
+            "from deeplearning4j_tpu.tune import (ContinuousParameterSpace,"
+            " IntegerParameterSpace, random_search)\n"
+            "params = {'lr': ContinuousParameterSpace(1e-4, 1e-1,"
+            " scale='log'), 'l2': ContinuousParameterSpace(1e-6, 1e-2,"
+            " scale='log'), 'depth': IntegerParameterSpace(1, 4)}\n"
+            "print(json.dumps(random_search(params, seed=123, n=8)))\n"
+        )
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        remote = json.loads(out.stdout.strip().splitlines()[-1])
+        # exact float equality — PCG64 streams are platform-stable bits
+        assert remote == json.loads(json.dumps(local))
+
+    def test_grid_search_product_order(self):
+        params = {"a": DiscreteParameterSpace([1, 2]),
+                  "b": DiscreteParameterSpace(["x", "y"])}
+        grid = grid_search(params, 2)
+        assert grid == [{"a": 1, "b": "x"}, {"a": 1, "b": "y"},
+                        {"a": 2, "b": "x"}, {"a": 2, "b": "y"}]
+
+    def test_space_json_roundtrip(self):
+        space = _space(widths=LayerWidthsSpace(
+            IntegerParameterSpace(1, 2), DiscreteParameterSpace([16, 32])))
+        params2 = SearchSpace.params_from_json(space.params_to_json())
+        assert params2 == space.params
+        with pytest.raises(ValueError):
+            ParameterSpace.from_dict({"type": "nope"})
+
+
+# ==========================================================================
+# ASHA + median rule — hand-computed brackets
+# ==========================================================================
+class TestAsha:
+    def test_rung_ladder(self):
+        assert asha_rungs(2, 16, 2) == [2, 4, 8, 16]
+        assert asha_rungs(3, 81, 3) == [3, 9, 27, 81]
+        # cap: max_budget always terminates the ladder
+        assert asha_rungs(4, 10, 2) == [4, 8, 10]
+        assert asha_rungs(5, 5, 2) == [5]
+        with pytest.raises(ValueError):
+            asha_rungs(0, 10, 2)
+        with pytest.raises(ValueError):
+            asha_rungs(2, 10, 1)
+
+    def test_select_survivors_hand_computed(self):
+        s = AshaScheduler(2, 8, eta=2, minimize=True)  # rungs [2, 4, 8]
+        scored = [("t0", 0.9), ("t1", 0.1), ("t2", 0.5), ("t3", 0.3),
+                  ("t4", 0.7), ("t5", 0.2), ("t6", 0.8), ("t7", 0.4)]
+        # n=8, eta=2 -> keep 4 best (lowest): t1 .1, t5 .2, t3 .3, t7 .4
+        assert sorted(s.select_survivors(0, scored)) == \
+            ["t1", "t3", "t5", "t7"]
+        # n=3 -> keep 1; tie broken toward the smaller trial id
+        assert s.select_survivors(1, [("b", 0.2), ("a", 0.2),
+                                      ("c", 0.5)]) == ["a"]
+        # final rung keeps everyone
+        assert s.select_survivors(2, [("a", 9.0), ("b", 1.0)]) == \
+            ["a", "b"]
+        # maximize flips the direction
+        smax = AshaScheduler(2, 8, eta=2, minimize=False)
+        assert sorted(smax.select_survivors(0, scored)) == \
+            ["t0", "t2", "t4", "t6"]
+
+    def test_async_report_quantile_rule(self):
+        s = AshaScheduler(2, 8, eta=2, minimize=True)
+        # first reporter at a rung always survives (cutoff = own score)
+        assert s.report("a", 0, 0.5) == "promote"
+        # 0.9 vs scores [0.5, 0.9]: median cutoff 0.7 -> stop
+        assert s.report("b", 0, 0.9) == "stop"
+        # 0.4 vs [0.5, 0.9, 0.4]: cutoff quantile(0.5)=0.5 -> promote
+        assert s.report("c", 0, 0.4) == "promote"
+        # final rung completes regardless of rank
+        assert s.report("a", 2, 99.0) == "complete"
+        assert s.report("d", 0, float("nan")) == "stop"
+
+    def test_median_stopping_rule(self):
+        m = MedianStoppingRule(grace=1, min_reports=3, minimize=True)
+        # rung 0 is inside the grace window: never stops
+        assert m.report("a", 0, 9.9) == "continue"
+        for tid, sc in [("a", 0.1), ("b", 0.2), ("c", 0.3)]:
+            assert m.report(tid, 1, sc) == "continue"  # building quorum
+        # median of [0.1, 0.2, 0.3] = 0.2; 0.25 is worse -> stop
+        assert m.report("d", 1, 0.25) == "stop"
+        assert m.report("e", 1, 0.15) == "continue"
+        # a NaN score stops outright and must NOT poison the rung median
+        assert m.report("f", 1, float("nan")) == "stop"
+        assert m.report("g", 1, 0.12) == "continue"
+
+
+# ==========================================================================
+# trial store
+# ==========================================================================
+class TestStore:
+    def test_append_replay_reconstruct(self, tmp_path):
+        st = TrialStore(str(tmp_path))
+        st.write_meta({"seed": 1})
+        st.append({"kind": "trial", "id": "t0", "overrides": {"lr": 0.1},
+                   "seed": 5})
+        st.append({"kind": "rung", "id": "t0", "rung": 0, "score": 1.5})
+        st.append({"kind": "status", "id": "t0", "status": "COMPLETED"})
+        assert st.read_meta() == {"seed": 1}
+        trials, records = st.reconstruct()
+        assert len(records) == 3
+        t = trials["t0"]
+        assert t.status == TrialStatus.COMPLETED
+        assert t.rung == 0 and t.scores == {0: 1.5}
+        assert t.overrides == {"lr": 0.1} and t.seed == 5
+
+    def test_torn_tail_dropped_torn_middle_raises(self, tmp_path):
+        st = TrialStore(str(tmp_path))
+        st.append({"kind": "trial", "id": "t0", "seed": 1})
+        st.append({"kind": "rung", "id": "t0", "rung": 0, "score": 2.0})
+        # crash truncation: chop the last line mid-record
+        with open(st.journal_path) as f:
+            content = f.read()
+        with open(st.journal_path, "w") as f:
+            f.write(content[: len(content) - 9])
+        with pytest.warns(UserWarning, match="torn trailing line"):
+            records = st.replay()
+        assert [r["kind"] for r in records] == ["trial"]
+        # corruption in the MIDDLE is not crash truncation: refuse
+        with open(st.journal_path, "w") as f:
+            f.write('{"kind": "trial", "id": "t0", "seed": 1}\n'
+                    '{"kind": "ru\n'
+                    '{"kind": "status", "id": "t0", "status": "STOPPED"}\n')
+        with pytest.raises(ValueError, match="corrupt journal"):
+            st.replay()
+
+
+# ==========================================================================
+# population engine: legality + bit parity with solo training
+# ==========================================================================
+class TestPopulationEngine:
+    def test_population_compatible_and_fallback_reason(self):
+        space = _space()
+        confs = [space.build(ov, seed=100 + i) for i, ov in
+                 enumerate(space.candidates(num_trials=3, seed=0))]
+        ok, reason = population_compatible(confs)
+        assert ok, reason
+        het = SearchSpace(
+            functools.partial(mlp_factory, 8, 3),
+            {"widths": DiscreteParameterSpace([(16,), (32,)]),
+             "lr": ContinuousParameterSpace(1e-3, 1e-1, scale="log")})
+        confs = [het.build({"widths": (16,), "lr": 0.01}, seed=1),
+                 het.build({"widths": (32,), "lr": 0.01}, seed=2)]
+        ok, reason = population_compatible(confs)
+        assert not ok and "pool engine" in reason
+
+    def test_momentum_difference_is_not_vmappable(self):
+        """Only the learning-rate FixedSchedule is cell-rebindable;
+        trials differing in another fixed scalar schedule (Nesterovs
+        momentum) must NOT stack — the population engine would silently
+        train every trial with trial 0's momentum."""
+        from deeplearning4j_tpu.nn.conf.builders import (
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+        from deeplearning4j_tpu.nn.conf.layers.core import (
+            DenseLayer,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.updaters import Nesterovs
+
+        def conf(momentum, lr=0.05):
+            return (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Nesterovs(lr, momentum=momentum)).list()
+                    .layer(DenseLayer(n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_out=3, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(8)).build())
+
+        ok, _ = population_compatible([conf(0.9), conf(0.5)])
+        assert not ok
+        # same momentum, different lr: still stackable
+        ok, reason = population_compatible(
+            [conf(0.9, lr=0.05), conf(0.9, lr=0.01)])
+        assert ok, reason
+
+    def test_population_bit_parity_with_solo_runs(self):
+        """Acceptance core: every trial of an N=8 vmapped population
+        (steps_per_call bundling on) ends with params AND Adam slots
+        bit-identical to training that trial alone with the same seed
+        over the same batch schedule."""
+        import jax.numpy as jnp
+
+        train = _batches(10)
+        val = _batches(3, seed=99)
+        space = _space()
+        # single-rung ladder: no trial gets stopped, all reach 13 steps
+        # (13 = 3 full K=4 bundles + a remainder chunk)
+        study = Study(space, train, _objective(val),
+                      scheduler=AshaScheduler(13, 13, eta=2),
+                      num_trials=8, seed=42, engine="population",
+                      steps_per_call=4)
+        result = study.run()
+        assert result.engine == "population"
+        assert all(t.status == TrialStatus.COMPLETED
+                   for t in result.trials)
+
+        # rebuild every trial solo through the stock fit machinery
+        pop_models = {t.id: m for t, m in
+                      zip(result.trials,
+                          [None] * len(result.trials))}
+        # population models are internal; re-run the study's own solo
+        # path: build from the same conf/seed and step through the same
+        # batch schedule one dispatch at a time
+        for trial in result.trials:
+            conf = space.build(trial.overrides, seed=trial.seed)
+            solo = MultiLayerNetwork(conf).init()
+            step = solo._get_jit("train", solo._make_train_step)
+            for s in range(13):
+                solo._fit_batch(step, train[s % len(train)])
+            # identical rung score...
+            score = _objective(val)(solo)
+            assert score == trial.scores[0], (trial.id, score,
+                                              trial.scores[0])
+            pop_models[trial.id] = solo
+        # ...and for the best trial the study exposes the trained model:
+        # bit-compare params + updater slots against its solo twin
+        best = result.best_trial
+        solo = pop_models[best.id]
+        for a, b in zip(_leaves(result.best_model.params_),
+                        _leaves(solo.params_)):
+            assert np.array_equal(a, b)
+        for a, b in zip(_leaves(result.best_model.opt_state_),
+                        _leaves(solo.opt_state_)):
+            assert np.array_equal(a, b)
+
+    def test_asha_study_lifecycle_accounting(self):
+        """eta=2, N=4, rungs [4, 8, 16]: rung 0 stops 2, rung 1 stops 1,
+        the last survivor completes — every trial in a terminal state."""
+        train = _batches(8)
+        val = _batches(2, seed=9)
+        study = Study(_space(), train, _objective(val),
+                      scheduler=AshaScheduler(4, 16, eta=2),
+                      num_trials=4, seed=7, engine="population",
+                      steps_per_call=4)
+        result = study.run()
+        statuses = sorted(t.status for t in result.trials)
+        assert statuses == [TrialStatus.COMPLETED, TrialStatus.STOPPED,
+                            TrialStatus.STOPPED, TrialStatus.STOPPED]
+        done = [t for t in result.trials
+                if t.status == TrialStatus.COMPLETED]
+        assert done[0].rung == 2 and set(done[0].scores) == {0, 1, 2}
+        assert result.best_trial is done[0]
+
+    def test_heterogeneous_space_auto_falls_back_to_pool(self):
+        train = _batches(6)
+        val = _batches(2, seed=9)
+        het = SearchSpace(
+            functools.partial(mlp_factory, 8, 3),
+            {"widths": DiscreteParameterSpace([(8,), (12,)]),
+             "lr": ContinuousParameterSpace(1e-3, 1e-1, scale="log")})
+        study = Study(het, train, _objective(val),
+                      scheduler=AshaScheduler(4, 4, eta=2),
+                      num_trials=3, seed=1, engine="auto", workers=3)
+        result = study.run()
+        assert result.engine == "pool"
+        assert all(t.status == TrialStatus.COMPLETED
+                   for t in result.trials)
+        assert result.best_trial is not None
+        # requesting the population engine outright for these is an error
+        with pytest.raises(ValueError, match="not stackable"):
+            Study(het, train, _objective(val),
+                  scheduler=AshaScheduler(4, 4, eta=2), num_trials=3,
+                  seed=1, engine="population").run()
+
+
+# ==========================================================================
+# kill-and-resume
+# ==========================================================================
+def _study_kwargs(store_dir):
+    return dict(scheduler=AshaScheduler(6, 24, eta=2), num_trials=4,
+                seed=11, engine="population", steps_per_call=2,
+                store_dir=store_dir, keep_last=2)
+
+
+class TestResume:
+    def test_completed_study_resume_is_a_noop(self, tmp_path):
+        train = _batches(8)
+        val = _batches(2, seed=9)
+        store_dir = str(tmp_path / "study")
+        r1 = Study(_space(), train, _objective(val),
+                   **_study_kwargs(store_dir)).run()
+        journal_size = os.path.getsize(
+            os.path.join(store_dir, "trials.jsonl"))
+        r2 = Study(_space(), train, _objective(val),
+                   **_study_kwargs(store_dir)).run(resume=True)
+        # nothing retrained, nothing re-journaled, same winner
+        assert os.path.getsize(
+            os.path.join(store_dir, "trials.jsonl")) == journal_size
+        assert [t.status for t in r1.trials] == \
+            [t.status for t in r2.trials]
+        assert r1.best_trial.id == r2.best_trial.id
+        assert r1.best_trial.final_score == r2.best_trial.final_score
+
+    def test_resume_rejects_foreign_store(self, tmp_path):
+        train = _batches(8)
+        val = _batches(2, seed=9)
+        store_dir = str(tmp_path / "study")
+        Study(_space(), train, _objective(val),
+              **_study_kwargs(store_dir)).run()
+        other = _study_kwargs(store_dir)
+        other["scheduler"] = AshaScheduler(5, 20, eta=2)
+        with pytest.raises(ValueError, match="different study"):
+            Study(_space(), train, _objective(val), **other).run(
+                resume=True)
+
+    def test_sigkill_mid_study_then_resume_completes(self, tmp_path):
+        """The acceptance drill: SIGKILL a study mid-flight, restart
+        with resume — it completes with every trial accounted for, no
+        duplicated trial ids, and no checkpoints beyond keep-last-k."""
+        store_dir = str(tmp_path / "study")
+        child_src = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+from deeplearning4j_tpu.train.earlystopping import (
+    DataSetLossCalculator, ScoreCalculatorObjective)
+from deeplearning4j_tpu.tune import (AshaScheduler,
+    ContinuousParameterSpace, SearchSpace, Study, mlp_factory)
+import functools, sys, time
+
+def batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(16, 8)).astype(np.float32),
+                    np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+            for _ in range(n)]
+
+space = SearchSpace(
+    functools.partial(mlp_factory, 8, 3, widths=(16,), dropout=0.1),
+    {{"lr": ContinuousParameterSpace(1e-3, 1e-1, scale="log"),
+      "l2": ContinuousParameterSpace(1e-5, 1e-2, scale="log")}})
+obj = ScoreCalculatorObjective(
+    DataSetLossCalculator(ExistingDataSetIterator(batches(2, seed=9))))
+study = Study(space, batches(8), obj,
+              scheduler=AshaScheduler(6, 24, eta=2), num_trials=4,
+              seed=11, engine="population", steps_per_call=2,
+              store_dir={store_dir!r}, keep_last=2)
+study.run()
+print("CHILD_DONE", flush=True)
+time.sleep(120)  # hold the process so the parent always gets its kill in
+"""
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen([sys.executable, "-c", child_src], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        journal = os.path.join(store_dir, "trials.jsonl")
+        try:
+            # wait for mid-study evidence: at least one rung record
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if os.path.exists(journal) and any(
+                        '"kind": "rung"' in ln
+                        for ln in open(journal)):
+                    break
+                if proc.poll() is not None:
+                    pytest.fail("child exited before first rung: "
+                                + (proc.stdout.read() or ""))
+                time.sleep(0.05)
+            else:
+                pytest.fail("no rung record before deadline")
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=60)
+
+        # resume in this process with the identical study definition
+        train = _batches(8)
+        val = _batches(2, seed=9)
+        result = Study(_space(), train, _objective(val),
+                       **_study_kwargs(store_dir)).run(resume=True)
+        assert all(t.is_terminal() for t in result.trials)
+        assert result.best_trial is not None
+
+        store = TrialStore(store_dir)
+        _, records = store.reconstruct()
+        trial_ids = [r["id"] for r in records if r["kind"] == "trial"]
+        assert len(trial_ids) == len(set(trial_ids)) == 4
+        # each trial accounted: exactly one terminal status per trial
+        finals = {}
+        for r in records:
+            if r["kind"] == "status":
+                assert r["id"] not in finals, f"double finish: {r}"
+                finals[r["id"]] = r["status"]
+        assert set(finals) == set(trial_ids)
+        # retention: no trial dir holds more than keep_last checkpoints
+        for tid in trial_ids:
+            assert len(store.trial_checkpoints(tid)) <= 2
+
+
+# ==========================================================================
+# score-calculator determinism (satellite)
+# ==========================================================================
+class TestScoreCalculatorReset:
+    def _model(self):
+        conf = mlp_factory(8, 3, lr=1e-2, widths=(8,))
+        return MultiLayerNetwork(conf).init()
+
+    def test_repeat_evaluation_is_deterministic(self):
+        model = self._model()
+        ds = _batches(1, batch=32)[0]
+        it = ListDataSetIterator(ds, 8)
+        calc = DataSetLossCalculator(it)
+        first = calc.calculate_score(model)
+        assert calc.calculate_score(model) == first
+        # even after someone leaves the shared iterator mid-stream
+        it.next()
+        assert calc.calculate_score(model) == first
+
+    def test_classification_calculator_resets_between_calls(self):
+        model = self._model()
+        ds = _batches(1, batch=32)[0]
+        it = ListDataSetIterator(ds, 8)
+        calc = ClassificationScoreCalculator("accuracy", it)
+        first = calc.calculate_score(model)
+        it.next()  # partially consume between calls
+        assert calc.calculate_score(model) == first
+
+
+# ==========================================================================
+# storms (slow tier)
+# ==========================================================================
+@pytest.mark.slow
+def test_population_storm_n16_k8():
+    """16-trial population, K=8 bundling, three-rung ASHA — the stacked
+    program at width 16 stays bit-stable (scores finite, accounting
+    closed) under a bigger cohort than the fast tests use."""
+    train = _batches(16, batch=32)
+    val = _batches(3, seed=5, batch=32)
+    study = Study(_space(), train, _objective(val),
+                  scheduler=AshaScheduler(8, 32, eta=2),
+                  num_trials=16, seed=3, engine="population",
+                  steps_per_call=8)
+    result = study.run()
+    assert all(t.is_terminal() for t in result.trials)
+    done = [t for t in result.trials if t.status == TrialStatus.COMPLETED]
+    assert done and all(np.isfinite(t.final_score) for t in done)
