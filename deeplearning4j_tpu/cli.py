@@ -155,7 +155,14 @@ def serve_main(argv) -> int:
         from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 
         mesh = TrainingMesh(data=args.workers)
-    eng_kwargs = dict(buckets=buckets, mesh=mesh)
+    # serving metrics publish into the process-wide registry, so a
+    # co-located trainer (or anything else using obs.default_registry)
+    # and this server share ONE Prometheus surface
+    from deeplearning4j_tpu.obs.metrics import default_registry
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+    eng_kwargs = dict(buckets=buckets, mesh=mesh,
+                      metrics=ServingMetrics(registry=default_registry()))
     if args.checkpoint_dir:
         eng_kwargs["checkpoint_dir"] = args.checkpoint_dir
     if key in ZOO:
@@ -392,6 +399,16 @@ def main(argv=None) -> int:
                          "single steps)")
     ap.add_argument("--queue-size", type=int, default=4,
                     help="async prefetch queue depth of the fit loop")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="in-graph training telemetry: per-step gradient/"
+                         "param global norms, update:param ratio and loss "
+                         "scale computed inside the jitted step (bit-"
+                         "identical training, at most one host fetch per "
+                         "dispatch)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="expose training metrics over HTTP on this port "
+                         "(GET /metrics: JSON, or Prometheus text via "
+                         "Accept/?format=prometheus); implies --telemetry")
     ap.add_argument("--skip-nonfinite", action="store_true",
                     help="fault tolerance: skip (don't apply) any step "
                          "whose global gradient is non-finite, and enable "
@@ -450,8 +467,23 @@ def main(argv=None) -> int:
     # off the configuration each epoch
     model.conf.global_conf.steps_per_call = args.steps_per_call
     model.conf.global_conf.async_queue_size = args.queue_size
+    if args.telemetry or args.metrics_port is not None:
+        model.conf.global_conf.telemetry = True
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
+
+    metrics_server = None
+    if args.metrics_port is not None:
+        from deeplearning4j_tpu.obs.exporter import start_metrics_server
+        from deeplearning4j_tpu.obs.metrics import MetricsListener
+
+        # MetricsListener publishes steps/samples/loss + the telemetry
+        # stream into the process-wide registry the endpoint serves
+        model.add_listeners(MetricsListener())
+        metrics_server = start_metrics_server(args.metrics_port)
+        print(f"metrics on http://127.0.0.1:{metrics_server.port}/metrics "
+              "(JSON; Prometheus text via Accept: text/plain or "
+              "?format=prometheus)", flush=True)
 
     storage = None
     if args.stats or args.dashboard:
@@ -489,6 +521,8 @@ def main(argv=None) -> int:
         model.fit(it, epochs=args.epochs)
     print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
           f"final score {float(model.score_):.4f}", flush=True)
+    if metrics_server is not None:
+        metrics_server.shutdown()
     if args.skip_nonfinite or args.max_bad_steps is not None:
         print(f"skipped non-finite steps: {model.bad_step_count}",
               flush=True)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, List, Optional
 
 import numpy as np
@@ -443,6 +444,13 @@ class AsyncDataSetIterator(DataSetIterator):
         self._stop: Optional[threading.Event] = None
         self._peek = None
         self._exc_box: list = [None]
+        # observability (obs/metrics.py): queue depth gauge + cumulative
+        # producer/consumer wait counters — the input-bound vs
+        # compute-bound signal PerformanceListener and /metrics report.
+        # Cost: two perf_counter reads per batch, nothing on the step.
+        from deeplearning4j_tpu.obs.metrics import data_pipeline_metrics
+
+        self._m_depth, self._m_pwait, self._m_cwait = data_pipeline_metrics()
         self._start()
 
     def set_pre_processor(self, pp) -> None:
@@ -464,16 +472,26 @@ class AsyncDataSetIterator(DataSetIterator):
         inner = self.inner
         bundle_size, device_put = self.bundle_size, self.device_put
         end = self._END
+        # registry counters captured directly — the worker closure must
+        # not keep ``self`` alive (see the GC note above)
+        m_pwait = self._m_pwait
 
         def put_item(item) -> bool:
             # stop-aware put: a consumer that stops draining (shutdown,
             # or the iterator simply being dropped) never strands this
             # daemon thread
+            blocked = None
             while True:
                 try:
                     q.put(item, timeout=0.1)
+                    if blocked is not None:
+                        # producer waited on a full queue: the device is
+                        # the bottleneck (compute-bound signal)
+                        m_pwait.inc(time.perf_counter() - blocked)
                     return True
                 except queue.Full:
+                    if blocked is None:
+                        blocked = time.perf_counter()
                     if stop.is_set():
                         return False
 
@@ -508,7 +526,22 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def has_next(self):
         if self._peek is None:
-            self._peek = self._queue.get()
+            try:
+                self._peek = self._queue.get_nowait()
+            except queue.Empty:
+                # fit loop waits on an empty queue: the input pipeline is
+                # the bottleneck (input-bound signal). Recorded both
+                # process-wide and per-thread — the latter is what
+                # PerformanceListener reads, so concurrent fits don't
+                # trade verdicts.
+                from deeplearning4j_tpu.obs.metrics import add_consumer_wait
+
+                t0 = time.perf_counter()
+                self._peek = self._queue.get()
+                waited = time.perf_counter() - t0
+                self._m_cwait.inc(waited)
+                add_consumer_wait(waited)
+            self._m_depth.set(self._queue.qsize())
         if self._peek is self._END and self._exc_box[0] is not None:
             # surface worker-thread failures instead of ending the epoch early
             exc, self._exc_box[0] = self._exc_box[0], None

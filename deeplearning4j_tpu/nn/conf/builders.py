@@ -355,6 +355,21 @@ class NeuralNetConfiguration:
         self._g.async_queue_size = int(n)
         return self
 
+    def telemetry(self, conf) -> "NeuralNetConfiguration":
+        """In-graph training telemetry (obs/telemetry.TelemetryConf):
+        per-step gradient/parameter global norms, update:param ratio and
+        loss scale computed INSIDE the jitted train step, stacked over
+        the steps_per_call bundle and host-fetched at most once per
+        dispatch — sync-free monitoring at any bundle size. Pass a
+        TelemetryConf, or True for all-defaults; None disables. The
+        training trajectory is bit-identical with telemetry on or off."""
+        from deeplearning4j_tpu.obs.telemetry import TelemetryConf
+
+        if conf is True:
+            conf = TelemetryConf()
+        self._g.telemetry = conf
+        return self
+
     def remat_policy(self, policy: Optional[str]) -> "NeuralNetConfiguration":
         """Backward-pass rematerialization: "save_conv_outputs" stores only
         conv outputs for backward and recomputes BN/activation epilogues

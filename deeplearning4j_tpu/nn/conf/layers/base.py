@@ -169,6 +169,7 @@ class GlobalConf:
         fault_policy=None,
         steps_per_call: int = 1,
         async_queue_size: int = 4,
+        telemetry=None,
     ):
         from deeplearning4j_tpu.updaters import Sgd
 
@@ -211,6 +212,11 @@ class GlobalConf:
         self.steps_per_call = int(steps_per_call)
         # Prefetch queue depth of the fit loops' AsyncDataSetIterator wrap.
         self.async_queue_size = int(async_queue_size)
+        # In-graph training telemetry (obs/telemetry.TelemetryConf, or
+        # True for all-defaults, or None=off): per-step gradient/param
+        # global norms, update:param ratio and loss scale computed inside
+        # the jitted train step, host-fetched at most once per bundle.
+        self.telemetry = telemetry
         self.mini_batch = bool(mini_batch)
         self.max_num_line_search_iterations = int(max_num_line_search_iterations)
         self.optimization_algo = optimization_algo

@@ -28,6 +28,9 @@ def lookup(name: str) -> type:
         # carries a fault policy works without the caller having touched
         # the train package (and without an import cycle at module load)
         import deeplearning4j_tpu.train.faults  # noqa: F401
+    if name == "TelemetryConf" and name not in _CLASSES:
+        # same lazy self-registration contract for the obs package
+        import deeplearning4j_tpu.obs.telemetry  # noqa: F401
     if name not in _CLASSES:
         raise KeyError(f"Unknown config class '{name}'. Registered: {sorted(_CLASSES)}")
     return _CLASSES[name]

@@ -310,11 +310,13 @@ class ComputationGraph:
         return s
 
     # ------------------------------------------------------------- train step
-    def train_step_fn(self):
-        """Raw (unjitted) pure train step for the data-parallel wrapper."""
-        return self._make_train_step(jit=False)
+    def train_step_fn(self, telemetry=None):
+        """Raw (unjitted) pure train step for the data-parallel wrapper.
+        ``telemetry`` (obs/telemetry.TelemetryConf) appends a per-step
+        in-graph telemetry dict to the outputs."""
+        return self._make_train_step(jit=False, telemetry=telemetry)
 
-    def _make_train_step(self, jit: bool = True):
+    def _make_train_step(self, jit: bool = True, telemetry=None):
         names = self.layer_names
         layers = [self._layer(n) for n in names]
 
@@ -322,6 +324,22 @@ class ComputationGraph:
             getattr(self.conf.global_conf, "remat_policy", None)
         )
         policy = self._active_fault_policy()
+        if telemetry is not None:
+            from deeplearning4j_tpu.obs import telemetry as _obs_telemetry
+
+        def _jit(fn):
+            from deeplearning4j_tpu.obs import trace as _trace
+            from deeplearning4j_tpu.train import faults as _faults
+
+            # telemetry's extra reads are plain dataflow; the
+            # guard_donation CPU gate stays scoped to the guarded steps'
+            # where-select aliasing pattern (the observed miscompile)
+            donate = (_faults.guard_donation(0, 1, 2)
+                      if policy is not None else (0, 1, 2))
+            return jax.jit(
+                _trace.count_retraces(f"{type(self).__name__}.train_step",
+                                      fn),
+                donate_argnums=donate)
 
         if policy is None:
             def step(params, opt_state, state, features, labels, fmasks, lmasks, rng,
@@ -345,9 +363,13 @@ class ComputationGraph:
                 new_params = dict(zip(names, np_list))
                 new_opt = dict(zip(names, no_list))
                 score = loss + self._reg_score(params)
+                if telemetry is not None:
+                    telem = _obs_telemetry.step_telemetry(
+                        telemetry, grads, params, new_params)
+                    return new_params, new_opt, new_state, score, telem
                 return new_params, new_opt, new_state, score
 
-            return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+            return _jit(step) if jit else step
 
         # guarded variant — see MultiLayerNetwork._make_train_step for the
         # mechanism (loss scaling, global verdict, where-skip, good_count
@@ -394,10 +416,15 @@ class ComputationGraph:
                 new_state = _faults.where_tree(finite, new_state, state)
             new_fstate = _faults.advance_fault_state(policy, fstate, finite)
             score = loss + self._reg_score(params)
+            if telemetry is not None:
+                telem = _obs_telemetry.step_telemetry(
+                    telemetry, grads, params, new_params, fstate=new_fstate,
+                    scale=scale)
+                return (new_params, new_opt, new_state, new_fstate, score,
+                        telem)
             return new_params, new_opt, new_state, new_fstate, score
 
-        return (jax.jit(gstep, donate_argnums=_faults.guard_donation(0, 1, 2))
-                if jit else gstep)
+        return _jit(gstep) if jit else gstep
 
     def _get_jit(self, key, maker):
         if key not in self._jit_cache:
@@ -415,8 +442,14 @@ class ComputationGraph:
             data = ListDataSetIterator(data, batch_size)
         if isinstance(data, MultiDataSet):
             data = MultiDataSetIterator.from_list([data])
-        for _ in range(epochs):
-            self._fit_one_epoch(data)
+        from deeplearning4j_tpu.train.listeners import dispatch_fit_end
+        try:
+            for _ in range(epochs):
+                self._fit_one_epoch(data)
+        finally:
+            # close listener-held resources (open profiler trace windows)
+            # even when an epoch raised
+            dispatch_fit_end(self.listeners, self)
         return self
 
     @staticmethod
@@ -438,10 +471,19 @@ class ComputationGraph:
             if hasattr(lst, "on_epoch_start"):
                 lst.on_epoch_start(self)
         k = _pipeline.resolve_steps_per_call(self)
-        step = self._get_jit("train", self._make_train_step)
-        bstep = (self._get_jit("train_bundle",
-                               lambda: _pipeline.make_bundled_step(self))
-                 if k > 1 else None)
+        from deeplearning4j_tpu.obs import telemetry as _telemetry
+
+        tconf = _telemetry.resolve(self)
+        # cache key carries the conf CONTENTS: swapping TelemetryConf
+        # fields between fits must rebuild, not reuse the old signals
+        tkey = None if tconf is None else str(sorted(tconf.to_dict().items()))
+        step = self._get_jit(
+            ("train_telem", tkey) if tconf else "train",
+            lambda: self._make_train_step(telemetry=tconf))
+        bstep = (self._get_jit(
+            ("train_bundle_telem", tkey) if tconf else "train_bundle",
+            lambda: _pipeline.make_bundled_step(self, telemetry=tconf))
+            if k > 1 else None)
         use_tbptt = getattr(self.conf, "backprop_type", "standard") == "tbptt"
         stream = (_as_multi(ds) for ds in it)
         if k > 1:
@@ -450,11 +492,11 @@ class ComputationGraph:
             stream = iter_grouped(stream, k, self._multi_compat_key)
         for item in stream:
             if isinstance(item, list):
-                self._fit_bundle(bstep, item)
+                self._fit_bundle(bstep, item, tconf)
             elif use_tbptt and item.features[0].ndim == 3:
                 self._fit_tbptt_batch(item)
             else:
-                self._fit_batch(step, item)
+                self._fit_batch(step, item, tconf)
         it.reset()
         self.epoch += 1
         for lst in self.listeners:
@@ -519,7 +561,8 @@ class ComputationGraph:
             for lst in grad_to:
                 lst.on_gradient_calculation(self, grads_np)
 
-    def _fit_batch(self, step, mds: MultiDataSet):
+    def _fit_batch(self, step, mds: MultiDataSet, tconf=None):
+        from deeplearning4j_tpu.obs import trace as _trace
         from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         feats = tuple(jnp.asarray(f) for f in mds.features)
@@ -529,37 +572,55 @@ class ComputationGraph:
         rng = self._next_rng()
         self._run_introspection(feats, labels, fmasks, lmasks, rng)
         policy = self._active_fault_policy()
-        if policy is not None:
-            fstate = self._ensure_fault_state(policy)
-            (self.params_, self.opt_state_, self.state_, self.fault_state_,
-             self.score_) = step(
-                self.params_, self.opt_state_, self.state_, fstate,
-                feats, labels, fmasks, lmasks, rng,
-                jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
-        else:
-            self.params_, self.opt_state_, self.state_, self.score_ = step(
-                self.params_, self.opt_state_, self.state_, feats, labels, fmasks, lmasks,
-                rng,
-                jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
+        telem = None
+        with _trace.step_span("train", self.iteration):
+            if policy is not None:
+                fstate = self._ensure_fault_state(policy)
+                out = step(
+                    self.params_, self.opt_state_, self.state_, fstate,
+                    feats, labels, fmasks, lmasks, rng,
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.fault_state_, self.score_) = out
+            else:
+                out = step(
+                    self.params_, self.opt_state_, self.state_, feats,
+                    labels, fmasks, lmasks, rng,
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.score_) = out
+        it0 = self.iteration
         self.iteration += 1
+        self.last_batch_size = int(feats[0].shape[0])
         if policy is not None:
             from deeplearning4j_tpu.train import faults as _faults
 
             _faults.check_fault_state(policy, self.fault_state_)
+        if telem is not None:
+            from deeplearning4j_tpu.obs import telemetry as _telemetry
+
+            _telemetry.dispatch_telemetry(
+                self.listeners, self, it0, self.epoch,
+                _telemetry.BundleTelemetry(telem, 1))
         for lst in _hook_recipients(self.listeners, "on_backward_pass"):
             lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
-    def _fit_bundle(self, bstep, group):
+    def _fit_bundle(self, bstep, group, tconf=None):
         """K optimizer steps in one dispatch (train/pipeline.py): per-slot
         arrays of the K MultiDataSets stack on a new leading axis and the
         bundled lax.scan step consumes them; iteration and the fault-state
-        carry advance in-graph."""
+        carry advance in-graph (stacked telemetry rides along when
+        ``tconf`` is set)."""
         from deeplearning4j_tpu.train import faults as _faults
         from deeplearning4j_tpu.train import pipeline as _pipeline
 
@@ -581,27 +642,39 @@ class ComputationGraph:
         rngs = jnp.stack([self._next_rng() for _ in range(k)])
         policy = self._active_fault_policy()
         it0 = self.iteration
-        if policy is not None:
-            fstate = self._ensure_fault_state(policy)
-            (self.params_, self.opt_state_, self.state_, self.fault_state_,
-             scores) = bstep(
-                self.params_, self.opt_state_, self.state_, fstate,
-                feats, labels, fmasks, lmasks, rngs,
-                jnp.asarray(it0, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
-        else:
-            self.params_, self.opt_state_, self.state_, scores = bstep(
-                self.params_, self.opt_state_, self.state_,
-                feats, labels, fmasks, lmasks, rngs,
-                jnp.asarray(it0, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
+        telem = None
+        from deeplearning4j_tpu.obs import trace as _trace
+
+        with _trace.step_span("train_bundle", it0):
+            if policy is not None:
+                fstate = self._ensure_fault_state(policy)
+                out = bstep(
+                    self.params_, self.opt_state_, self.state_, fstate,
+                    feats, labels, fmasks, lmasks, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.fault_state_, scores) = out
+            else:
+                out = bstep(
+                    self.params_, self.opt_state_, self.state_,
+                    feats, labels, fmasks, lmasks, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                self.params_, self.opt_state_, self.state_, scores = out
         self.iteration += k
         self.score_ = scores[-1]
+        self.last_batch_size = int(feats[0].shape[1])
         if policy is not None:
             _faults.check_fault_state(policy, self.fault_state_)
-        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores)
+        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores,
+                                            telem=telem)
 
     # --------------------------------------------------------------- pretrain
     def pretrain(self, it, epochs: int = 1) -> "ComputationGraph":

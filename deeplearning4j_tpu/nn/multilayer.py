@@ -356,18 +356,37 @@ class MultiLayerNetwork:
         return s
 
     # ------------------------------------------------------------- train step
-    def train_step_fn(self):
+    def train_step_fn(self, telemetry=None):
         """The raw (unjitted) pure train step — reused by the data-parallel
-        wrapper which jits it with mesh shardings (parallel/wrapper.py)."""
-        return self._make_train_step(jit=False)
+        wrapper which jits it with mesh shardings (parallel/wrapper.py).
+        ``telemetry`` (obs/telemetry.TelemetryConf) appends a per-step
+        in-graph telemetry dict to the outputs."""
+        return self._make_train_step(jit=False, telemetry=telemetry)
 
-    def _make_train_step(self, jit: bool = True):
+    def _make_train_step(self, jit: bool = True, telemetry=None):
         layers = self.layers
 
         remat_policy = _resolve_remat_policy(
             getattr(self.conf.global_conf, "remat_policy", None)
         )
         policy = self._active_fault_policy()
+        if telemetry is not None:
+            from deeplearning4j_tpu.obs import telemetry as _obs_telemetry
+
+        def _jit(fn):
+            from deeplearning4j_tpu.obs import trace as _trace
+            from deeplearning4j_tpu.train import faults as _faults
+
+            # telemetry's extra reads (update norm = new - old) are plain
+            # dataflow XLA sequences before reusing donated buffers; the
+            # guard_donation CPU gate stays scoped to the guarded steps'
+            # where-select aliasing pattern (the observed miscompile)
+            donate = (_faults.guard_donation(0, 1, 2)
+                      if policy is not None else (0, 1, 2))
+            return jax.jit(
+                _trace.count_retraces(f"{type(self).__name__}.train_step",
+                                      fn),
+                donate_argnums=donate)
 
         if policy is None:
             def step(params, opt_state, state, features, labels, fmask, lmask, rng, iteration, epoch):
@@ -385,9 +404,13 @@ class MultiLayerNetwork:
                     layers, params, grads, opt_state, t, iteration, epoch
                 )
                 score = loss + self._reg_score(params)
+                if telemetry is not None:
+                    telem = _obs_telemetry.step_telemetry(
+                        telemetry, grads, params, new_params)
+                    return new_params, new_opt, new_states, score, telem
                 return new_params, new_opt, new_states, score
 
-            return jax.jit(step, donate_argnums=(0, 1, 2)) if jit else step
+            return _jit(step) if jit else step
 
         # Guarded step (train/faults.py): loss scaling + global all-finite
         # verdict + jnp.where skip, bad/good counters carried in fstate.
@@ -430,10 +453,15 @@ class MultiLayerNetwork:
                 new_states = _faults.where_tree(finite, new_states, state)
             new_fstate = _faults.advance_fault_state(policy, fstate, finite)
             score = loss + self._reg_score(params)
+            if telemetry is not None:
+                telem = _obs_telemetry.step_telemetry(
+                    telemetry, grads, params, new_params, fstate=new_fstate,
+                    scale=scale)
+                return (new_params, new_opt, new_states, new_fstate, score,
+                        telem)
             return new_params, new_opt, new_states, new_fstate, score
 
-        return (jax.jit(gstep, donate_argnums=_faults.guard_donation(0, 1, 2))
-                if jit else gstep)
+        return _jit(gstep) if jit else gstep
 
     def _get_jit(self, key, maker):
         if key not in self._jit_cache:
@@ -456,8 +484,15 @@ class MultiLayerNetwork:
             it: DataSetIterator = ListDataSetIterator(data, batch_size)
         else:
             it = data
-        for _ in range(epochs):
-            self._fit_one_epoch(it)
+        from deeplearning4j_tpu.train.listeners import dispatch_fit_end
+        try:
+            for _ in range(epochs):
+                self._fit_one_epoch(it)
+        finally:
+            # listeners holding open resources (an active ProfilerListener
+            # trace window spanning the final partial epoch) close here —
+            # including when an epoch raised
+            dispatch_fit_end(self.listeners, self)
         return self
 
     def _fit_one_epoch(self, it: DataSetIterator):
@@ -485,19 +520,28 @@ class MultiLayerNetwork:
         else:
             wrapped = it
             stream = iter_bundled(it, k) if k > 1 else it
-        step = self._get_jit("train", self._make_train_step)
-        bstep = (self._get_jit("train_bundle",
-                               lambda: _pipeline.make_bundled_step(self))
-                 if k > 1 else None)
+        from deeplearning4j_tpu.obs import telemetry as _telemetry
+
+        tconf = _telemetry.resolve(self)
+        # cache key carries the conf CONTENTS: swapping TelemetryConf
+        # fields between fits must rebuild, not reuse the old signals
+        tkey = None if tconf is None else str(sorted(tconf.to_dict().items()))
+        step = self._get_jit(
+            ("train_telem", tkey) if tconf else "train",
+            lambda: self._make_train_step(telemetry=tconf))
+        bstep = (self._get_jit(
+            ("train_bundle_telem", tkey) if tconf else "train_bundle",
+            lambda: _pipeline.make_bundled_step(self, telemetry=tconf))
+            if k > 1 else None)
         use_tbptt = self.conf.backprop_type == "tbptt"
         try:
             for ds in stream:
                 if isinstance(ds, BatchBundle):
-                    self._fit_bundle(bstep, ds)
+                    self._fit_bundle(bstep, ds, tconf)
                 elif use_tbptt and ds.features.ndim == 3:
                     self._fit_tbptt_batch(ds)
                 else:
-                    self._fit_batch(step, ds)
+                    self._fit_batch(step, ds, tconf)
         finally:
             if wrapped is not it:
                 wrapped.shutdown()  # join prefetch thread; caller resets inner
@@ -564,7 +608,8 @@ class MultiLayerNetwork:
             for lst in grad_to:
                 lst.on_gradient_calculation(self, grads_np)
 
-    def _fit_batch(self, step, ds: DataSet):
+    def _fit_batch(self, step, ds: DataSet, tconf=None):
+        from deeplearning4j_tpu.obs import trace as _trace
         from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         features = jnp.asarray(ds.features)
@@ -575,37 +620,57 @@ class MultiLayerNetwork:
         rng = self._next_rng()
         self._run_introspection(features, labels, fmask, lmask, rng)
         policy = self._active_fault_policy()
-        if policy is not None:
-            fstate = self._ensure_fault_state(policy)
-            (self.params_, self.opt_state_, self.state_, self.fault_state_,
-             self.score_) = step(
-                self.params_, self.opt_state_, self.state_, fstate,
-                features, labels, fmask, lmask, rng,
-                jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
-        else:
-            self.params_, self.opt_state_, self.state_, self.score_ = step(
-                self.params_, self.opt_state_, self.state_,
-                features, labels, fmask, lmask, rng,
-                jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
+        telem = None
+        with _trace.step_span("train", self.iteration):
+            if policy is not None:
+                fstate = self._ensure_fault_state(policy)
+                out = step(
+                    self.params_, self.opt_state_, self.state_, fstate,
+                    features, labels, fmask, lmask, rng,
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.fault_state_, self.score_) = out
+            else:
+                out = step(
+                    self.params_, self.opt_state_, self.state_,
+                    features, labels, fmask, lmask, rng,
+                    jnp.asarray(self.iteration, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.score_) = out
+        it0 = self.iteration
         self.iteration += 1
+        self.last_batch_size = int(features.shape[0])
         if policy is not None:
             from deeplearning4j_tpu.train import faults as _faults
 
             _faults.check_fault_state(policy, self.fault_state_)
+        if telem is not None:
+            from deeplearning4j_tpu.obs import telemetry as _telemetry
+
+            _telemetry.dispatch_telemetry(
+                self.listeners, self, it0, self.epoch,
+                _telemetry.BundleTelemetry(telem, 1))
         for lst in _hook_recipients(self.listeners, "on_backward_pass"):
             lst.on_backward_pass(self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 
-    def _fit_bundle(self, bstep, bundle):
+    def _fit_bundle(self, bstep, bundle, tconf=None):
         """K optimizer steps in ONE dispatch (train/pipeline.py): the
         bundled lax.scan step consumes the stacked batches, advancing
         iteration and the fault-state carry in-graph; the divergence
-        tripwire is checked once per bundle on the final ``consec``."""
+        tripwire is checked once per bundle on the final ``consec``.
+        With telemetry the stacked per-step signals ride the same
+        dispatch and reach listeners through one deferred fetch."""
+        from deeplearning4j_tpu.obs import trace as _trace
         from deeplearning4j_tpu.train import faults as _faults
         from deeplearning4j_tpu.train import pipeline as _pipeline
 
@@ -621,27 +686,37 @@ class MultiLayerNetwork:
         rngs = jnp.stack([self._next_rng() for _ in range(k)])
         policy = self._active_fault_policy()
         it0 = self.iteration
-        if policy is not None:
-            fstate = self._ensure_fault_state(policy)
-            (self.params_, self.opt_state_, self.state_, self.fault_state_,
-             scores) = bstep(
-                self.params_, self.opt_state_, self.state_, fstate,
-                features, labels, fmask, lmask, rngs,
-                jnp.asarray(it0, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
-        else:
-            self.params_, self.opt_state_, self.state_, scores = bstep(
-                self.params_, self.opt_state_, self.state_,
-                features, labels, fmask, lmask, rngs,
-                jnp.asarray(it0, jnp.int32),
-                jnp.asarray(self.epoch, jnp.int32),
-            )
+        telem = None
+        with _trace.step_span("train_bundle", it0):
+            if policy is not None:
+                fstate = self._ensure_fault_state(policy)
+                out = bstep(
+                    self.params_, self.opt_state_, self.state_, fstate,
+                    features, labels, fmask, lmask, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                (self.params_, self.opt_state_, self.state_,
+                 self.fault_state_, scores) = out
+            else:
+                out = bstep(
+                    self.params_, self.opt_state_, self.state_,
+                    features, labels, fmask, lmask, rngs,
+                    jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(self.epoch, jnp.int32),
+                )
+                if tconf is not None:
+                    *out, telem = out
+                self.params_, self.opt_state_, self.state_, scores = out
         self.iteration += k
         self.score_ = scores[-1]
+        self.last_batch_size = int(features.shape[1])
         if policy is not None:
             _faults.check_fault_state(policy, self.fault_state_)
-        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores)
+        _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores,
+                                            telem=telem)
 
     # ----------------------------------------------------------------- tBPTT
     def tbptt_step_fn(self):

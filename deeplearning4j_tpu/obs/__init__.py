@@ -1,0 +1,45 @@
+"""Unified observability layer: metrics registry, in-graph training
+telemetry, trace spans and the jit retrace monitor.
+
+TensorFlow's production experience (arXiv 1605.08695) pairs training and
+serving under ONE monitoring surface; the fixed-shape whole-program
+rationale (arXiv 1810.09868) dictates HOW telemetry is computed here:
+inside the jitted program, host-fetched at most once per dispatch, so
+turning monitoring on never re-introduces the per-step host syncs the
+pipelined training loop (train/pipeline.py) removed.
+
+- :mod:`obs.metrics` — thread-safe :class:`MetricsRegistry` (counters,
+  gauges, bounded histograms) with Prometheus text exposition + JSON
+  snapshot; serving and training publish into the same registry type
+  (and, via the CLI, the same default registry).
+- :mod:`obs.telemetry` — opt-in :class:`TelemetryConf`: per-step
+  gradient/parameter global norms, update:param ratio and loss scale
+  computed INSIDE the jitted train step, stacked by the ``lax.scan``
+  bundle and delivered to listeners via ``telemetry_done``.
+- :mod:`obs.trace` — ``jax.profiler`` span annotations around the
+  dispatch sites, plus a registry-backed per-function jit cache-miss
+  counter so steady-state recompiles surface as a metric instead of a
+  mystery slowdown.
+- :mod:`obs.exporter` — stdlib HTTP endpoint exposing a registry
+  (content-negotiated Prometheus text / JSON) during training.
+"""
+
+from deeplearning4j_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsListener,
+    MetricsRegistry,
+    default_registry,
+)
+from deeplearning4j_tpu.obs.telemetry import (  # noqa: F401
+    BundleTelemetry,
+    TelemetryConf,
+)
+from deeplearning4j_tpu.obs.trace import (  # noqa: F401
+    RetraceMonitor,
+    count_retraces,
+    retrace_counts,
+    span,
+    step_span,
+)

@@ -1,0 +1,110 @@
+"""Stdlib HTTP exporter for a MetricsRegistry.
+
+``cli.py --metrics-port N`` starts one of these next to a training run,
+so the same Prometheus scrape config that watches the serving tier
+(serving/server.py's /metrics) watches training. Content negotiation:
+Prometheus text when the client asks for it (``Accept: text/plain`` /
+openmetrics — what prometheus scrapers send), JSON otherwise
+(``?format=prometheus|json`` overrides).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry, default_registry
+
+PROMETHEUS_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def wants_prometheus(accept_header: str, query: str = "") -> bool:
+    """Shared negotiation rule (serving/server.py uses it too): explicit
+    ``format=`` query wins; otherwise an Accept mentioning text/plain or
+    openmetrics means a Prometheus scraper. JSON stays the default so
+    existing clients of the serving /metrics endpoint are unchanged."""
+    fmt = parse_qs(query).get("format", [None])[0]
+    if fmt is not None:
+        return fmt.lower() in ("prometheus", "text")
+    accept = (accept_header or "").lower()
+    return "text/plain" in accept or "openmetrics" in accept
+
+
+class MetricsServer:
+    """Tiny threaded HTTP server: GET /metrics (negotiated), GET /healthz.
+    ``port=0`` binds an ephemeral port (read back from ``.port``)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 9464):
+        self.registry = registry if registry is not None else default_registry()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # noqa: N802
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/metrics":
+                        if wants_prometheus(self.headers.get("Accept", ""),
+                                            url.query):
+                            self._send(200,
+                                       server.registry.prometheus_text()
+                                       .encode(), PROMETHEUS_CTYPE)
+                        else:
+                            self._send(200,
+                                       server.registry.json_text().encode(),
+                                       "application/json")
+                    elif url.path == "/healthz":
+                        self._send(200, b'{"status": "ok"}',
+                                   "application/json")
+                    else:
+                        self._send(404, b'{"error": "NotFound"}',
+                                   "application/json")
+                except BaseException:  # never kill the connection thread
+                    try:
+                        self._send(500, b'{"error": "InternalError"}',
+                                   "application/json")
+                    except OSError:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="dl4j-tpu-metrics")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def start_metrics_server(port: int,
+                         registry: Optional[MetricsRegistry] = None,
+                         host: str = "127.0.0.1") -> MetricsServer:
+    """Start (and return) a metrics endpoint on ``port`` for the default
+    (or given) registry."""
+    return MetricsServer(registry=registry, host=host, port=port).start()

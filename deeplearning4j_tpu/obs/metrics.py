@@ -1,0 +1,447 @@
+"""Thread-safe metrics registry with Prometheus text exposition.
+
+One registry type for the whole stack: serving counters
+(serving/metrics.py is rebased onto this), training metrics
+(:class:`MetricsListener` publishes steps/samples/loss and the in-graph
+telemetry stream), data-pipeline gauges (AsyncDataSetIterator queue
+depth and producer/consumer wait — the input-bound vs compute-bound
+signal) and the jit retrace counters (obs/trace.py).
+
+Design constraints, in order:
+
+- **Never on the step critical path.** Everything here is plain Python
+  under one lock; the expensive part of monitoring — reading device
+  values — happens in the callers at most once per dispatch
+  (train/pipeline.py's bundle discipline).
+- **Bounded memory.** Histograms keep a fixed-size ring of recent
+  observations (the window a live /metrics endpoint cares about), never
+  an unbounded list.
+- **Get-or-create.** Re-requesting a metric returns the existing
+  instance (same name+labels), so components can declare their metrics
+  idempotently against a shared registry; re-registering a name as a
+  different TYPE is a programming error and raises.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: _LabelKey) -> str:
+    if not key:
+        return ""
+    esc = [(k, v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n"))
+           for k, v in key]
+    return "{" + ",".join(f'{k}="{v}"' for k, v in esc) + "}"
+
+
+class Counter:
+    """Monotonic float counter (Prometheus ``counter``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counters only go up (inc({n}))")
+        with self._lock:
+            self._value += n
+
+    def set_max(self, v: float) -> None:
+        """Raise the counter to ``v`` if higher (publishing a cumulative
+        device-side count, e.g. the fault-state ``bad_count``, without
+        double-counting across sampled reads)."""
+        with self._lock:
+            if v > self._value:
+                self._value = float(v)
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Settable value, or a callback read at scrape time (queue depths)."""
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._fn = fn
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def set_function(self, fn: Optional[Callable[[], float]]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:
+            return 0.0
+
+
+class Histogram:
+    """Bounded histogram: total count/sum forever, quantiles over a
+    fixed-size ring of the most recent observations. Exposed in
+    Prometheus text as a ``summary`` (quantile series + _sum/_count)."""
+
+    QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, ring_size: int = 2048):
+        self._lock = threading.Lock()
+        self._ring_size = int(ring_size)
+        self._ring = [0.0] * self._ring_size
+        self._n = 0  # total ever observed (write head = n % size)
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._ring[self._n % self._ring_size] = float(v)
+            self._n += 1
+            self._sum += float(v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def window(self) -> List[float]:
+        """Sorted copy of the current ring window."""
+        with self._lock:
+            n = min(self._n, self._ring_size)
+            return sorted(self._ring[:n])
+
+    def quantile(self, q: float) -> Optional[float]:
+        """q in [0, 1] over the ring window; None before any observation."""
+        w = self.window()
+        if not w:
+            return None
+        return w[min(int(q * len(w)), len(w) - 1)]
+
+
+class MetricsRegistry:
+    """Named metrics with optional labels; one instance per surface (or
+    the process-wide :func:`default_registry` shared by training and
+    serving when wired through the CLI)."""
+
+    _TYPES = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> (kind, help); (name, label_key) -> metric object
+        self._meta: Dict[str, Tuple[str, str]] = {}
+        self._metrics: Dict[Tuple[str, _LabelKey], object] = {}
+
+    # -- registration --------------------------------------------------------
+    def _get_or_create(self, kind: str, name: str, help: str,
+                       labels: Optional[Dict[str, str]], **kwargs):
+        key = (name, _label_key(labels))
+        with self._lock:
+            meta = self._meta.get(name)
+            if meta is not None and meta[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {meta[0]}, "
+                    f"cannot re-register as {kind}")
+            if meta is None:
+                self._meta[name] = (kind, help)
+            elif help and not meta[1]:
+                self._meta[name] = (kind, help)
+            m = self._metrics.get(key)
+            if m is None:
+                m = self._TYPES[kind](**kwargs)
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[Dict[str, str]] = None) -> Counter:
+        return self._get_or_create("counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[Dict[str, str]] = None,
+              fn: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._get_or_create("gauge", name, help, labels)
+        if fn is not None:
+            g.set_function(fn)
+        return g
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Optional[Dict[str, str]] = None,
+                  ring_size: int = 2048) -> Histogram:
+        return self._get_or_create("histogram", name, help, labels,
+                                   ring_size=ring_size)
+
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """The registered metric, or None."""
+        with self._lock:
+            return self._metrics.get((name, _label_key(labels)))
+
+    # -- reading -------------------------------------------------------------
+    def _series(self) -> Iterable[Tuple[str, str, str, _LabelKey, object]]:
+        with self._lock:
+            items = sorted(self._metrics.items())
+            meta = dict(self._meta)
+        for (name, lkey), m in items:
+            kind, help = meta[name]
+            yield name, kind, help, lkey, m
+
+    def snapshot(self) -> dict:
+        """JSON-ready view: scalar for unlabeled metrics, a
+        ``{label-string: value}`` dict for labeled families; histograms
+        expose count/sum/quantiles."""
+        out: Dict[str, object] = {}
+        for name, kind, _, lkey, m in self._series():
+            if kind == "histogram":
+                val: object = {
+                    "count": m.count, "sum": round(m.sum, 6),
+                    **{f"p{int(q * 100)}": m.quantile(q)
+                       for q in Histogram.QUANTILES},
+                }
+            else:
+                val = m.value()
+            if lkey:
+                fam = out.setdefault(name, {})
+                fam[",".join(f"{k}={v}" for k, v in lkey)] = val
+            else:
+                out[name] = val
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (0.0.4). Histograms are
+        rendered as summaries (quantile series + ``_sum``/``_count``)."""
+        lines: List[str] = []
+        seen_header = set()
+        for name, kind, help, lkey, m in self._series():
+            if name not in seen_header:
+                seen_header.add(name)
+                if help:
+                    lines.append(f"# HELP {name} {help}")
+                lines.append(
+                    f"# TYPE {name} "
+                    f"{'summary' if kind == 'histogram' else kind}")
+            if kind == "histogram":
+                for q in Histogram.QUANTILES:
+                    v = m.quantile(q)
+                    qkey = lkey + (("quantile", f"{q:g}"),)
+                    lines.append(
+                        f"{name}{_label_str(qkey)} "
+                        f"{'NaN' if v is None else repr(float(v))}")
+                lines.append(f"{name}_sum{_label_str(lkey)} "
+                             f"{repr(float(m.sum))}")
+                lines.append(f"{name}_count{_label_str(lkey)} {m.count}")
+            else:
+                v = float(m.value())
+                txt = repr(v) if v != int(v) else str(int(v))
+                lines.append(f"{name}{_label_str(lkey)} {txt}")
+        return "\n".join(lines) + "\n"
+
+    def json_text(self) -> str:
+        return json.dumps(self.snapshot(), indent=1)
+
+
+# --------------------------------------------------------------------------
+# default (process-wide) registry
+# --------------------------------------------------------------------------
+_default: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry: training listeners, the data-pipeline
+    gauges, the retrace counters and (when wired via the CLI) serving all
+    publish here, giving one Prometheus surface per process."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default
+
+
+# -- data-pipeline instrumentation (AsyncDataSetIterator hooks) -------------
+def data_pipeline_metrics(registry: Optional[MetricsRegistry] = None
+                          ) -> Tuple[Gauge, Counter, Counter]:
+    """(queue-depth gauge, producer-wait counter, consumer-wait counter).
+
+    Producer wait (queue full) means the device is the bottleneck —
+    compute-bound; consumer wait (queue empty) means the input pipeline
+    is — input-bound. PerformanceListener reports the consumer share of
+    wall time so a slow run says WHICH side to fix."""
+    reg = registry or default_registry()
+    return (
+        reg.gauge("data_queue_depth",
+                  "staged batches in the async prefetch queue"),
+        reg.counter("data_producer_wait_seconds_total",
+                    "producer blocked on a full prefetch queue "
+                    "(compute-bound)"),
+        reg.counter("data_consumer_wait_seconds_total",
+                    "fit loop blocked on an empty prefetch queue "
+                    "(input-bound)"),
+    )
+
+
+def data_wait_seconds(registry: Optional[MetricsRegistry] = None
+                      ) -> Tuple[float, float]:
+    """(producer_wait_s, consumer_wait_s) cumulative process totals."""
+    reg = registry or default_registry()
+    p = reg.get("data_producer_wait_seconds_total")
+    c = reg.get("data_consumer_wait_seconds_total")
+    return ((p.value() if p is not None else 0.0),
+            (c.value() if c is not None else 0.0))
+
+
+# Consumer waits are ALSO accumulated per thread: the fit loop and its
+# PerformanceListener run on the same thread, so the thread-local total
+# attributes waits to THIS fit even when several fits run concurrently
+# (the tuner's pool engine) — the process-wide counter above would blend
+# all of them and hand one trial another trial's input-bound verdict.
+_consumer_wait_local = threading.local()
+
+
+def add_consumer_wait(seconds: float) -> None:
+    _consumer_wait_local.total = (
+        getattr(_consumer_wait_local, "total", 0.0) + float(seconds))
+
+
+def thread_consumer_wait_seconds() -> float:
+    """Cumulative prefetch-queue wait of the CALLING thread's fit loops."""
+    return getattr(_consumer_wait_local, "total", 0.0)
+
+
+# --------------------------------------------------------------------------
+# training publisher
+# --------------------------------------------------------------------------
+class MetricsListener:
+    """Training listener publishing into a :class:`MetricsRegistry`.
+
+    Sync-free by the train/pipeline.py discipline: step/sample counters
+    advance from host-side bookkeeping every call; device values (loss,
+    the in-graph telemetry stream) are read only on ``frequency``
+    iterations, and under bundling via the shared once-per-bundle host
+    fetch (``bundle_done`` / ``telemetry_done``), never a per-step
+    ``model.score()`` sync."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 frequency: int = 10):
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self.frequency = max(1, int(frequency))
+        self._steps = reg.counter("train_steps_total",
+                                  "optimizer steps (incl. skipped)")
+        self._samples = reg.counter("train_samples_total",
+                                    "examples consumed by the fit loops")
+        self._epochs = reg.counter("train_epochs_total", "completed epochs")
+        self._loss = reg.gauge("train_loss", "last sampled training loss")
+        self._sps = reg.gauge("train_steps_per_sec",
+                              "steps/sec over the last sampling window")
+        self._samps = reg.gauge("train_samples_per_sec",
+                                "samples/sec over the last sampling window")
+        self._grad_norm = reg.gauge("train_grad_norm",
+                                    "global gradient norm (in-graph)")
+        self._param_norm = reg.gauge("train_param_norm",
+                                     "global parameter norm (in-graph)")
+        self._update_ratio = reg.gauge(
+            "train_update_ratio",
+            "update:parameter global-norm ratio (in-graph)")
+        self._loss_scale = reg.gauge("train_loss_scale",
+                                     "dynamic loss scale (mixed precision)")
+        self._bad = reg.counter("train_bad_steps_total",
+                                "skipped non-finite gradient steps")
+        self._win_t: Optional[float] = None
+        self._win_steps = 0
+        self._win_samples = 0
+        self._pending_telem = None
+
+    # -- shared accounting ---------------------------------------------------
+    def _advance(self, model, k: int) -> bool:
+        """Counters for k steps; True when this call crosses a sampling
+        boundary (device reads allowed)."""
+        bs = getattr(model, "last_batch_size", None) or 0
+        self._steps.inc(k)
+        self._samples.inc(bs * k)
+        self._win_steps += k
+        self._win_samples += bs * k
+        if self._win_steps < self.frequency:
+            return False
+        now = time.perf_counter()
+        if self._win_t is not None:
+            dt = now - self._win_t
+            if dt > 0:
+                self._sps.set(self._win_steps / dt)
+                self._samps.set(self._win_samples / dt)
+        self._win_t = now
+        self._win_steps = 0
+        self._win_samples = 0
+        return True
+
+    def _publish_telemetry(self) -> None:
+        telem, self._pending_telem = self._pending_telem, None
+        if telem is None:
+            return
+        # the fetch is shared (BundleTelemetry caches its host copy), so
+        # a StatsListener reading the same bundle costs nothing extra
+        host = telem.host()
+        for key, gauge in (("grad_norm", self._grad_norm),
+                           ("param_norm", self._param_norm),
+                           ("update_ratio", self._update_ratio),
+                           ("loss_scale", self._loss_scale)):
+            if key in host:
+                gauge.set(float(host[key][-1]))
+        if "bad_count" in host:
+            # cumulative device-side count: monotonic publish, no
+            # double-counting across sampled reads
+            self._bad.set_max(float(host["bad_count"][-1]))
+
+    # -- listener hooks ------------------------------------------------------
+    def telemetry_done(self, model, it0: int, epoch: int, telem) -> None:
+        # delivered BEFORE the score hooks (train/pipeline.py); defer the
+        # host read to the sampling decision so off-frequency bundles
+        # fetch nothing at all
+        self._pending_telem = telem
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        if not self._advance(model, 1):
+            self._pending_telem = None
+            return
+        if model.score_ is not None:
+            self._loss.set(float(model.score_))
+        self._publish_telemetry()
+
+    def bundle_done(self, model, it0: int, epoch: int, scores) -> None:
+        if not self._advance(model, len(scores)):
+            self._pending_telem = None
+            return
+        self._loss.set(float(scores.host()[-1]))
+        self._publish_telemetry()
+
+    def on_epoch_end(self, model) -> None:
+        self._epochs.inc()
+
+    def on_epoch_start(self, model) -> None:
+        pass

@@ -1,0 +1,193 @@
+"""In-graph training telemetry.
+
+The monitoring quantities the reference's listeners read on the host
+every step — gradient norm, parameter norm, update:parameter ratio, the
+loss scale — are computed here INSIDE the jitted train step (arXiv
+1810.09868's fixed-shape whole-program discipline applied to
+observability): per-step scalars ride the ``lax.scan`` bundle as a
+stacked pytree alongside the per-step losses, and the host sees them
+through ONE deferred fetch per bundle (:class:`BundleTelemetry`). That
+is what lets StatsListener monitor a ``steps_per_call=16`` fit without
+forcing it back to K=1 and throwing away the pipelining win.
+
+Telemetry is additive-only: it reads the step's existing values (grads,
+params before/after) and never feeds back into the update math, so a
+telemetry-enabled fit is BIT-identical to a telemetry-off fit
+(regression-asserted at K=4 in tests/test_obs.py, params AND Adam
+slots).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+# test hook: host fetches of stacked telemetry (the sync-free regression
+# asserts at most one per bundle, however many listeners read it)
+_host_fetches = 0
+
+
+class TelemetryConf:
+    """Which in-graph signals the train step computes. Carried on
+    ``GlobalConf.telemetry`` (also accepts plain ``True`` there →
+    all-defaults). JSON round-trips with the network conf."""
+
+    def __init__(self, grad_norm: bool = True, param_norm: bool = True,
+                 update_ratio: bool = True, loss_scale: bool = True):
+        self.grad_norm = bool(grad_norm)
+        self.param_norm = bool(param_norm)
+        self.update_ratio = bool(update_ratio)
+        self.loss_scale = bool(loss_scale)
+
+    # -- serde (mirrors nn/conf/serde generic contract) ----------------------
+    def to_dict(self) -> dict:
+        return {
+            "@class": "TelemetryConf",
+            "grad_norm": self.grad_norm,
+            "param_norm": self.param_norm,
+            "update_ratio": self.update_ratio,
+            "loss_scale": self.loss_scale,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TelemetryConf":
+        return cls(**{k: v for k, v in d.items() if not k.startswith("@")})
+
+    def __eq__(self, other):
+        return (isinstance(other, TelemetryConf)
+                and self.to_dict() == other.to_dict())
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.to_dict().items()
+                  if not k.startswith("@")}
+        return f"TelemetryConf({fields})"
+
+
+def _register_serde():
+    from deeplearning4j_tpu.nn.conf import serde
+
+    serde.register(TelemetryConf)
+
+
+_register_serde()
+
+
+def resolve(model) -> Optional[TelemetryConf]:
+    """The model's active telemetry conf, or None when off. ``True`` on
+    the configuration means all-defaults."""
+    conf = getattr(model.conf.global_conf, "telemetry", None)
+    if conf is None or conf is False:
+        return None
+    if conf is True:
+        return TelemetryConf()
+    return conf
+
+
+# --------------------------------------------------------------------------
+# in-graph computation (called from inside the traced train steps)
+# --------------------------------------------------------------------------
+def global_norm(tree):
+    """Scalar fp32 L2 norm over every floating leaf of a pytree.
+    Accumulates in fp32 regardless of compute dtype (a bf16 sum of
+    squares overflows at norms a healthy transformer hits routinely)."""
+    import jax
+    import jax.numpy as jnp
+
+    total = None
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = jnp.asarray(leaf)
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        s = jnp.sum(jnp.square(a.astype(jnp.float32)))
+        total = s if total is None else total + s
+    if total is None:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(total)
+
+
+def step_telemetry(conf: TelemetryConf, grads, params, new_params,
+                   fstate: Optional[Dict[str, Any]] = None,
+                   scale=None) -> Dict[str, Any]:
+    """The per-step telemetry dict, traced inside the train step.
+
+    ``grads`` are the UNSCALED (post loss-scale division) gradients the
+    update consumed; ``params``/``new_params`` bracket the update, so
+    ``update_norm`` reflects what was actually applied — a skipped
+    non-finite step reports 0. ``fstate`` is the POST-advance fault
+    state (cumulative ``bad_count``); ``scale`` is the loss scale that
+    multiplied THIS step's loss. All leaves are fp32/int32 scalars —
+    cheap to stack over a bundle and to fetch."""
+    import jax.numpy as jnp
+
+    t: Dict[str, Any] = {}
+    if conf.grad_norm:
+        t["grad_norm"] = global_norm(grads)
+    pn = None
+    if conf.param_norm or conf.update_ratio:
+        pn = global_norm(params)
+    if conf.param_norm:
+        t["param_norm"] = pn
+    if conf.update_ratio:
+        import jax
+
+        delta = jax.tree_util.tree_map(
+            lambda n, o: jnp.asarray(n, jnp.float32)
+            - jnp.asarray(o, jnp.float32), new_params, params)
+        un = global_norm(delta)
+        t["update_norm"] = un
+        t["update_ratio"] = un / jnp.maximum(pn, jnp.asarray(1e-12,
+                                                             jnp.float32))
+    if conf.loss_scale and scale is not None:
+        t["loss_scale"] = jnp.asarray(scale, jnp.float32)
+    if fstate is not None:
+        t["bad_count"] = fstate["bad_count"]
+    return t
+
+
+# --------------------------------------------------------------------------
+# host-side delivery
+# --------------------------------------------------------------------------
+class BundleTelemetry:
+    """One bundle's stacked telemetry. Stays on device; the host copy is
+    materialized lazily and AT MOST ONCE, however many listeners read it
+    (same contract as train/pipeline.BundleScores)."""
+
+    def __init__(self, tree: Dict[str, Any], k: int):
+        self.dev = tree
+        self.k = int(k)
+        self._host: Optional[Dict[str, np.ndarray]] = None
+        self.fetch_count = 0
+
+    def __len__(self) -> int:
+        return self.k
+
+    def keys(self):
+        return self.dev.keys()
+
+    def host(self) -> Dict[str, np.ndarray]:
+        """name → (k,) numpy array (scalars of a single-step fit come
+        back as shape (1,))."""
+        if self._host is None:
+            global _host_fetches
+            self._host = {k: np.atleast_1d(np.asarray(v))
+                          for k, v in self.dev.items()}
+            self.fetch_count += 1
+            _host_fetches += 1
+        return self._host
+
+    def step(self, j: int) -> Dict[str, float]:
+        """Step ``j``'s signals as plain floats (fetches the bundle)."""
+        return {k: float(v[j]) for k, v in self.host().items()}
+
+
+def dispatch_telemetry(listeners: Sequence[Any], model, it0: int,
+                       epoch: int, bt: BundleTelemetry) -> None:
+    """Hand the bundle's telemetry to every listener providing a
+    ``telemetry_done(model, it0, epoch, BundleTelemetry)`` hook. Runs
+    BEFORE the score hooks (``bundle_done`` / the ``iteration_done``
+    replay) so a listener can fold telemetry into the same records."""
+    for lst in listeners:
+        hook = getattr(lst, "telemetry_done", None)
+        if hook is not None:
+            hook(model, it0, epoch, bt)

@@ -1,0 +1,128 @@
+"""Trace spans and the jit retrace monitor.
+
+Two pieces:
+
+- **Spans**: thin wrappers over ``jax.profiler`` annotations —
+  :func:`step_span` (``StepTraceAnnotation``) brackets each training
+  dispatch so xprof/Perfetto traces show one box per optimizer
+  step/bundle, :func:`span` (``TraceAnnotation``) brackets serving
+  dispatches and checkpoint writes. Both are no-ops (nullcontext) when
+  the profiler API is unavailable, and cost ~a TraceMe when no trace is
+  active.
+
+- **Retrace monitor**: generalizes serving/engine.py's trace-time
+  compile-count hook into a registry-backed per-function jit cache-miss
+  counter. :func:`count_retraces` wraps a function ABOUT TO BE jitted
+  with a Python side effect that runs exactly once per trace (= once per
+  distinct XLA program), bumping ``jit_retraces_total{fn=...}`` in the
+  metrics registry. A production mesh that recompiles in steady state
+  stops being a mystery slowdown and becomes a scrapeable counter; the
+  tests arm :class:`RetraceMonitor` around a fit or a serving storm and
+  fail on any unexpected delta.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, Optional
+
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry, default_registry
+
+RETRACE_COUNTER = "jit_retraces_total"
+_RETRACE_HELP = ("distinct XLA programs traced per jitted function; "
+                 "steady-state growth means shape/dtype churn is "
+                 "defeating the jit cache")
+
+
+def count_retraces(name: str, fn: Callable,
+                   registry: Optional[MetricsRegistry] = None) -> Callable:
+    """Wrap ``fn`` (about to be ``jax.jit``-ed) so each TRACE bumps
+    ``jit_retraces_total{fn=name}``. The bump is a host side effect that
+    only runs while jax traces the function — never in the compiled
+    program — so steady-state dispatches cost nothing."""
+    import functools
+
+    counter = (registry or default_registry()).counter(
+        RETRACE_COUNTER, _RETRACE_HELP, labels={"fn": name})
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        counter.inc()
+        return fn(*args, **kwargs)
+
+    return traced
+
+
+def retrace_counts(registry: Optional[MetricsRegistry] = None
+                   ) -> Dict[str, float]:
+    """fn-label → trace count over everything instrumented so far."""
+    reg = registry or default_registry()
+    out: Dict[str, float] = {}
+    snap = reg.snapshot().get(RETRACE_COUNTER)
+    if isinstance(snap, dict):
+        for label, v in snap.items():
+            out[label.split("=", 1)[1]] = v
+    elif snap is not None:
+        out[""] = snap
+    return out
+
+
+class RetraceMonitor:
+    """Arm around a region that must not compile: records the per-function
+    retrace counters at entry; :meth:`delta` is what compiled since.
+
+        with RetraceMonitor() as mon:
+            net.fit(it, epochs=1)      # warm epoch: compiles expected
+            mon.rebaseline()
+            net.fit(it, epochs=1)      # steady state
+        assert mon.total() == 0, mon.delta()
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or default_registry()
+        self._base: Dict[str, float] = {}
+
+    def __enter__(self) -> "RetraceMonitor":
+        self.rebaseline()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+    def rebaseline(self) -> None:
+        self._base = retrace_counts(self.registry)
+
+    def delta(self) -> Dict[str, float]:
+        """fn → retraces since the last (re)baseline, zero entries
+        omitted."""
+        now = retrace_counts(self.registry)
+        return {k: v - self._base.get(k, 0.0)
+                for k, v in now.items() if v - self._base.get(k, 0.0) > 0}
+
+    def total(self) -> float:
+        return sum(self.delta().values())
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+def step_span(name: str, step: int):
+    """``jax.profiler.StepTraceAnnotation`` around one training dispatch
+    (xprof groups device work per step); nullcontext when unavailable."""
+    try:
+        import jax
+
+        return jax.profiler.StepTraceAnnotation(name, step_num=int(step))
+    except (ImportError, AttributeError):
+        return contextlib.nullcontext()
+
+
+def span(name: str, **kwargs):
+    """``jax.profiler.TraceAnnotation`` around a host-side region
+    (serving dispatch, checkpoint write); nullcontext when unavailable."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name, **kwargs)
+    except (ImportError, AttributeError):
+        return contextlib.nullcontext()

@@ -497,12 +497,14 @@ class DistributedLMTrainer:
         p_sh, o_sh, data_spec, repl = (sh["p_sh"], sh["o_sh"],
                                        sh["data_spec"], sh["repl"])
 
+        from deeplearning4j_tpu.obs import trace as _trace
+
         if policy is None:
             def step(params, opt_state, ids, targets, t):
                 return _body(params, opt_state, None, ids, targets, t)
 
             self._step = jax.jit(
-                step,
+                _trace.count_retraces("lm_trainer.train_step", step),
                 in_shardings=(p_sh, o_sh, data_spec, data_spec, None),
                 out_shardings=(p_sh, o_sh, None),
                 donate_argnums=self._donation(),
@@ -512,7 +514,7 @@ class DistributedLMTrainer:
                 return _body(params, opt_state, fstate, ids, targets, t)
 
             self._step = jax.jit(
-                step,
+                _trace.count_retraces("lm_trainer.train_step", step),
                 in_shardings=(p_sh, o_sh, repl, data_spec, data_spec, None),
                 out_shardings=(p_sh, o_sh, repl, None),
                 donate_argnums=self._donation(),
@@ -543,8 +545,10 @@ class DistributedLMTrainer:
                     body, (params, opt_state, t0), (ids_k, tgt_k))
                 return p, o, scores
 
+            from deeplearning4j_tpu.obs import trace as _trace
+
             self._bstep = jax.jit(
-                bundle,
+                _trace.count_retraces("lm_trainer.bundled_step", bundle),
                 in_shardings=(p_sh, o_sh, bdata_spec, bdata_spec, None),
                 out_shardings=(p_sh, o_sh, None),
                 donate_argnums=self._donation(),
@@ -561,8 +565,10 @@ class DistributedLMTrainer:
                     body, (params, opt_state, fstate, t0), (ids_k, tgt_k))
                 return p, o, fs, scores
 
+            from deeplearning4j_tpu.obs import trace as _trace
+
             self._bstep = jax.jit(
-                bundle,
+                _trace.count_retraces("lm_trainer.bundled_step", bundle),
                 in_shardings=(p_sh, o_sh, repl, bdata_spec, bdata_spec,
                               None),
                 out_shardings=(p_sh, o_sh, repl, None),
@@ -635,13 +641,16 @@ class DistributedLMTrainer:
         k = int(ids.shape[0])
         step = self.build_bundle_step()
         t0 = jnp.asarray(self.model.iteration + 1, jnp.int32)
+        from deeplearning4j_tpu.obs import trace as _obs_trace
+
         if self._policy is not None:
             if self.fault_state_ is None:
                 self.fault_state_ = _faults.init_fault_state(
                     self._policy,
                     self._policy.scaling_active(self._compute_dtype),
                     start_step=self.model.iteration)
-            with self.mesh.mesh:
+            with self.mesh.mesh, _obs_trace.step_span(
+                    "lm_train_bundle", self.model.iteration):
                 (self.model.params_, self.model.opt_state_,
                  self.fault_state_, scores) = step(
                     self.model.params_, self.model.opt_state_,
@@ -651,7 +660,8 @@ class DistributedLMTrainer:
             # divergence tripwire once per bundle, on the final consec
             _faults.check_fault_state(self._policy, self.fault_state_)
         else:
-            with self.mesh.mesh:
+            with self.mesh.mesh, _obs_trace.step_span(
+                    "lm_train_bundle", self.model.iteration):
                 (self.model.params_, self.model.opt_state_,
                  scores) = step(self.model.params_, self.model.opt_state_,
                                 ids, targets, t0)
@@ -660,6 +670,7 @@ class DistributedLMTrainer:
         return scores
 
     def fit_batch(self, ids: np.ndarray, targets: np.ndarray) -> float:
+        from deeplearning4j_tpu.obs import trace as _obs_trace
         from deeplearning4j_tpu.train import faults as _faults
 
         step = self.build_step()
@@ -670,7 +681,8 @@ class DistributedLMTrainer:
                     self._policy,
                     self._policy.scaling_active(self._compute_dtype),
                     start_step=self.model.iteration - 1)
-            with self.mesh.mesh:
+            with self.mesh.mesh, _obs_trace.step_span(
+                    "lm_train", self.model.iteration):
                 (self.model.params_, self.model.opt_state_,
                  self.fault_state_, self.model.score_) = step(
                     self.model.params_, self.model.opt_state_,
@@ -681,7 +693,8 @@ class DistributedLMTrainer:
                 )
             _faults.check_fault_state(self._policy, self.fault_state_)
         else:
-            with self.mesh.mesh:
+            with self.mesh.mesh, _obs_trace.step_span(
+                    "lm_train", self.model.iteration):
                 (self.model.params_, self.model.opt_state_,
                  self.model.score_) = step(
                     self.model.params_, self.model.opt_state_,

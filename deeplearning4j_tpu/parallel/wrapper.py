@@ -135,8 +135,10 @@ class ParallelWrapper:
             self.model._compute_dtype,
         )
 
-    def _build_step(self, guarded: bool = False):
-        raw = self.model.train_step_fn()
+    def _build_step(self, guarded: bool = False, telemetry=None):
+        from deeplearning4j_tpu.obs import trace as _trace
+
+        raw = self.model.train_step_fn(telemetry=telemetry)
         repl = self.mesh.replicated()
         batch = self.mesh.batch_sharded()
         if guarded:  # extra fault-state carry after ``state`` (replicated)
@@ -147,16 +149,22 @@ class ParallelWrapper:
             in_sh = (repl, repl, repl, batch, batch, batch, batch, repl,
                      repl, repl)
             out_sh = (repl, repl, repl, repl)
+        if telemetry is not None:
+            # telemetry dict of replicated scalars rides as one extra
+            # trailing output (a sharding is a pytree prefix)
+            out_sh = out_sh + (repl,)
         donate = (0, 1, 2)
         if guarded:
             from deeplearning4j_tpu.train.faults import guard_donation
 
             donate = guard_donation(0, 1, 2)
         self._step = jax.jit(
-            raw, in_shardings=in_sh, out_shardings=out_sh,
+            _trace.count_retraces("ParallelWrapper.train_step", raw),
+            in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=donate,
         )
         self._step_guarded = guarded
+        self._step_telem = telemetry
         return self._step
 
     def _build_tbptt_step(self, guarded: bool = False):
@@ -184,24 +192,31 @@ class ParallelWrapper:
         self._tbptt_guarded = guarded
         return self._tbptt_step
 
-    def _get_bundle_step(self, guarded: bool, policy, k: int):
+    def _get_bundle_step(self, guarded: bool, policy, k: int,
+                         telemetry=None):
         """Cached K-step bundled jitted step: the model's raw step under a
         lax.scan, shardings like the single step except batch arrays are
         (K, B, ...) sharded over "data" on dim 1 (ZeRO-1 mode delegates
-        to zero.make_sharded_train_step's bundled variant)."""
-        key = (guarded, policy, k, self.sharded_update)
+        to zero.make_sharded_train_step's bundled variant). Stacked
+        per-step telemetry (replicated scalars) rides as a trailing
+        output when ``telemetry`` is set."""
+        key = (guarded, policy, k, self.sharded_update, telemetry)
         if self._bstep is not None and self._bstep_key == key:
             return self._bstep
         if self.sharded_update:
             from deeplearning4j_tpu.parallel.zero import make_sharded_train_step
 
             self._bstep, _ = make_sharded_train_step(
-                self.model, self.mesh, policy=policy, steps_per_call=k)
+                self.model, self.mesh, policy=policy, steps_per_call=k,
+                telemetry=telemetry)
         else:
+            from deeplearning4j_tpu.obs import trace as _trace
             from deeplearning4j_tpu.train import pipeline as _pipeline
             from deeplearning4j_tpu.train.faults import guard_donation
 
-            raw = _pipeline.bundled_scan(self.model.train_step_fn(), guarded)
+            raw = _pipeline.bundled_scan(
+                self.model.train_step_fn(telemetry=telemetry), guarded,
+                telemetry=telemetry is not None)
             repl = self.mesh.replicated()
             bb = self.mesh.spec(None, "data")
             if guarded:
@@ -213,9 +228,12 @@ class ParallelWrapper:
                 in_sh = (repl, repl, repl, bb, bb, bb, bb, repl, repl, repl)
                 out_sh = (repl, repl, repl, repl)
                 donate = (0, 1, 2)
-            self._bstep = jax.jit(raw, in_shardings=in_sh,
-                                  out_shardings=out_sh,
-                                  donate_argnums=donate)
+            if telemetry is not None:
+                out_sh = out_sh + (repl,)
+            self._bstep = jax.jit(
+                _trace.count_retraces("ParallelWrapper.bundled_step", raw),
+                in_shardings=in_sh, out_shardings=out_sh,
+                donate_argnums=donate)
         self._bstep_key = key
         return self._bstep
 
@@ -242,6 +260,10 @@ class ParallelWrapper:
         guarded = policy is not None
         if guarded:
             m._ensure_fault_state(policy)
+        from deeplearning4j_tpu.obs import telemetry as _telemetry
+        from deeplearning4j_tpu.obs import trace as _trace
+
+        tconf = _telemetry.resolve(m)
         k = 1
         if not self._is_graph:
             # CG batches are per-input tuples; bundling covers the
@@ -272,11 +294,13 @@ class ParallelWrapper:
             # policy swapped between fits changes the traced schedule
             # constants (and possibly the fstate structure)
             if self._zstep is None or self._zstep_guarded != guarded \
-                    or getattr(self, "_zstep_policy", None) != policy:
+                    or getattr(self, "_zstep_policy", None) != policy \
+                    or getattr(self, "_zstep_telem", None) != tconf:
                 self._zstep, self._zlayout = make_sharded_train_step(
-                    m, self.mesh, policy=policy)
+                    m, self.mesh, policy=policy, telemetry=tconf)
                 self._zstep_guarded = guarded
                 self._zstep_policy = policy
+                self._zstep_telem = tconf
             step = self._zstep
             zopt = shard_model_opt_state(m, self._zlayout,
                                          mesh=self.mesh.mesh)
@@ -290,11 +314,13 @@ class ParallelWrapper:
                 lambda: unshard_model_opt_state(m, zlayout, zref[0]))
         else:
             if self._step is None or self._step_guarded != guarded \
-                    or getattr(self, "_step_policy", None) != policy:
-                self._build_step(guarded=guarded)
+                    or getattr(self, "_step_policy", None) != policy \
+                    or getattr(self, "_step_telem", None) != tconf:
+                self._build_step(guarded=guarded, telemetry=tconf)
                 self._step_policy = policy
             step = self._step
-        bstep = self._get_bundle_step(guarded, policy, k) if k > 1 else None
+        bstep = (self._get_bundle_step(guarded, policy, k, tconf)
+                 if k > 1 else None)
         n_data = self.mesh.n_data
         zopt_valid = True
 
@@ -303,25 +329,40 @@ class ParallelWrapper:
             opt_in = zopt if zopt is not None else m.opt_state_
             batch = self._pack_batch(ds, n_data)
             rng = m._next_rng()
+            it0 = m.iteration
+            telem = None
             # once the step is dispatched it consumes the donated zopt; if
             # it raises, those buffers are gone and must not be gathered
             # (batch packing above raising leaves zopt intact)
             zopt_valid = zopt is None
-            if guarded:
-                (new_p, new_o, m.state_, m.fault_state_, m.score_) = step(
-                    m.params_, opt_in, m.state_, m.fault_state_,
-                    *batch, rng,
-                    jnp.asarray(m.iteration, jnp.int32),
-                    jnp.asarray(m.epoch, jnp.int32),
-                )
-            else:
-                new_p, new_o, m.state_, m.score_ = step(
-                    m.params_, opt_in, m.state_, *batch, rng,
-                    jnp.asarray(m.iteration, jnp.int32),
-                    jnp.asarray(m.epoch, jnp.int32),
-                )
+            with _trace.step_span("train", it0):
+                if guarded:
+                    out = step(
+                        m.params_, opt_in, m.state_, m.fault_state_,
+                        *batch, rng,
+                        jnp.asarray(m.iteration, jnp.int32),
+                        jnp.asarray(m.epoch, jnp.int32),
+                    )
+                    if tconf is not None:
+                        *out, telem = out
+                    (new_p, new_o, m.state_, m.fault_state_,
+                     m.score_) = out
+                else:
+                    out = step(
+                        m.params_, opt_in, m.state_, *batch, rng,
+                        jnp.asarray(m.iteration, jnp.int32),
+                        jnp.asarray(m.epoch, jnp.int32),
+                    )
+                    if tconf is not None:
+                        *out, telem = out
+                    new_p, new_o, m.state_, m.score_ = out
             m.params_ = new_p
+            m.last_batch_size = int(ds.features.shape[0])
             _after_step(new_o, 1)
+            if telem is not None:
+                _telemetry.dispatch_telemetry(
+                    m.listeners, m, it0, m.epoch,
+                    _telemetry.BundleTelemetry(telem, 1))
             for lst in m.listeners:
                 lst.iteration_done(m, m.iteration, m.epoch)
 
@@ -337,25 +378,35 @@ class ParallelWrapper:
                      else jnp.asarray(bundle.labels_mask))
             rngs = jnp.stack([m._next_rng() for _ in range(bundle.k)])
             it0 = m.iteration
+            telem = None
             zopt_valid = zopt is None
-            if guarded:
-                (new_p, new_o, m.state_, m.fault_state_, scores) = bstep(
-                    m.params_, opt_in, m.state_, m.fault_state_,
-                    features, labels, fmask, lmask, rngs,
-                    jnp.asarray(it0, jnp.int32),
-                    jnp.asarray(m.epoch, jnp.int32),
-                )
-            else:
-                new_p, new_o, m.state_, scores = bstep(
-                    m.params_, opt_in, m.state_,
-                    features, labels, fmask, lmask, rngs,
-                    jnp.asarray(it0, jnp.int32),
-                    jnp.asarray(m.epoch, jnp.int32),
-                )
+            with _trace.step_span("train_bundle", it0):
+                if guarded:
+                    out = bstep(
+                        m.params_, opt_in, m.state_, m.fault_state_,
+                        features, labels, fmask, lmask, rngs,
+                        jnp.asarray(it0, jnp.int32),
+                        jnp.asarray(m.epoch, jnp.int32),
+                    )
+                    if tconf is not None:
+                        *out, telem = out
+                    (new_p, new_o, m.state_, m.fault_state_, scores) = out
+                else:
+                    out = bstep(
+                        m.params_, opt_in, m.state_,
+                        features, labels, fmask, lmask, rngs,
+                        jnp.asarray(it0, jnp.int32),
+                        jnp.asarray(m.epoch, jnp.int32),
+                    )
+                    if tconf is not None:
+                        *out, telem = out
+                    new_p, new_o, m.state_, scores = out
             m.params_ = new_p
             m.score_ = scores[-1]
+            m.last_batch_size = int(features.shape[1])
             _after_step(new_o, bundle.k)
-            _pipeline.dispatch_bundle_listeners(m, it0, m.epoch, scores)
+            _pipeline.dispatch_bundle_listeners(m, it0, m.epoch, scores,
+                                                telem=telem)
 
         def _after_step(new_o, n_steps):
             nonlocal zopt, zopt_valid
@@ -411,6 +462,9 @@ class ParallelWrapper:
                     if hasattr(lst, "on_epoch_end"):
                         lst.on_epoch_end(m)
         finally:
+            from deeplearning4j_tpu.train.listeners import dispatch_fit_end
+
+            dispatch_fit_end(m.listeners, m)
             if zopt is not None:
                 m._opt_state_sync = None
                 if zopt_valid:

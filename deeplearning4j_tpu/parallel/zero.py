@@ -293,7 +293,7 @@ def unshard_model_opt_state(model, layout: ShardedUpdateLayout,
 
 
 def make_sharded_train_step(model, mesh, policy=None,
-                            steps_per_call: int = 1):
+                            steps_per_call: int = 1, telemetry=None):
     """Jitted ZeRO-1 DP train step over ``mesh`` (a TrainingMesh).
 
     Same signature as the replicated step the wrapper/multihost facade
@@ -314,6 +314,11 @@ def make_sharded_train_step(model, mesh, policy=None,
     (train/pipeline.py): the same body under a lax.scan over K stacked
     batches — batch arrays are (K, B, ...) sharded over "data" on dim 1,
     rngs are stacked (K, key), per-step scores return as a (K,) array.
+
+    ``telemetry`` (obs/telemetry.TelemetryConf) appends the per-step
+    in-graph telemetry dict as a trailing (replicated) output — the
+    gradient norm is computed on the GLOBAL pre-scatter gradient, so
+    sharded and replicated training report identical telemetry.
     """
     names, layers, params = _model_layer_view(model)
     layout = ShardedUpdateLayout(layers, params, mesh.n_data)
@@ -369,17 +374,35 @@ def make_sharded_train_step(model, mesh, policy=None,
                       else np_list)
         score = loss + model._reg_score(params)
         if policy is None:
+            if telemetry is not None:
+                from deeplearning4j_tpu.obs import telemetry as _obs_telemetry
+
+                telem = _obs_telemetry.step_telemetry(
+                    telemetry, grads, params, new_params)
+                return new_params, new_zopt, new_states, score, telem
             return new_params, new_zopt, new_states, score
         if do_skip:
             new_params = _faults.where_tree(finite, new_params, params)
             new_zopt = _faults.where_tree(finite, new_zopt, zopt)
             new_states = _faults.where_tree(finite, new_states, state)
         new_fstate = _faults.advance_fault_state(policy, fstate, finite)
+        if telemetry is not None:
+            from deeplearning4j_tpu.obs import telemetry as _obs_telemetry
+
+            telem = _obs_telemetry.step_telemetry(
+                telemetry, grads, params, new_params, fstate=new_fstate,
+                scale=scale)
+            return new_params, new_zopt, new_states, new_fstate, score, telem
         return new_params, new_zopt, new_states, new_fstate, score
+
+    from deeplearning4j_tpu.obs import trace as _trace
 
     repl = mesh.replicated()
     batch = mesh.batch_sharded()
     zshard = NamedSharding(mesh.mesh, P("data", None))
+    # trailing telemetry output: a dict of replicated scalars (a
+    # sharding acts as a pytree prefix over the whole dict)
+    tel_sh = (repl,) if telemetry is not None else ()
     K = int(steps_per_call)
     if K > 1:
         from deeplearning4j_tpu.train.pipeline import bundled_scan
@@ -393,18 +416,21 @@ def make_sharded_train_step(model, mesh, policy=None,
 
         if K > 1:
             jitted = jax.jit(
-                bundled_scan(step, guarded=False),
+                _trace.count_retraces(
+                    "zero1.bundled_step",
+                    bundled_scan(step, guarded=False,
+                                 telemetry=telemetry is not None)),
                 in_shardings=(repl, zshard, repl, bbatch, bbatch, bbatch,
                               bbatch, repl, repl, repl),
-                out_shardings=(repl, zshard, repl, repl),
+                out_shardings=(repl, zshard, repl, repl) + tel_sh,
                 donate_argnums=zero1_donation(0, 1, 2),
             )
             return jitted, layout
         jitted = jax.jit(
-            step,
+            _trace.count_retraces("zero1.train_step", step),
             in_shardings=(repl, zshard, repl, batch, batch, batch, batch,
                           repl, repl, repl),
-            out_shardings=(repl, zshard, repl, repl),
+            out_shardings=(repl, zshard, repl, repl) + tel_sh,
             donate_argnums=zero1_donation(0, 1, 2),
         )
         return jitted, layout
@@ -416,18 +442,21 @@ def make_sharded_train_step(model, mesh, policy=None,
 
     if K > 1:
         jitted = jax.jit(
-            bundled_scan(gstep, guarded=True),
+            _trace.count_retraces(
+                "zero1.bundled_step",
+                bundled_scan(gstep, guarded=True,
+                             telemetry=telemetry is not None)),
             in_shardings=(repl, zshard, repl, repl, bbatch, bbatch, bbatch,
                           bbatch, repl, repl, repl),
-            out_shardings=(repl, zshard, repl, repl, repl),
+            out_shardings=(repl, zshard, repl, repl, repl) + tel_sh,
             donate_argnums=zero1_donation(0, 1, 2),
         )
         return jitted, layout
     jitted = jax.jit(
-        gstep,
+        _trace.count_retraces("zero1.train_step", gstep),
         in_shardings=(repl, zshard, repl, repl, batch, batch, batch, batch,
                       repl, repl, repl),
-        out_shardings=(repl, zshard, repl, repl, repl),
+        out_shardings=(repl, zshard, repl, repl, repl) + tel_sh,
         donate_argnums=zero1_donation(0, 1, 2),
     )
     return jitted, layout

@@ -170,10 +170,19 @@ class InferenceEngine:
                     "output; cannot serve it")
             return None
 
+        retraces = self.metrics.registry.counter(
+            "jit_retraces_total",
+            "distinct XLA programs traced per jitted function",
+            labels={"fn": "serving_forward"})
+
         def run(params, state, x, fmask):
             # trace-time side effect: one bump per distinct input shape
             # (= per compiled XLA program). Never executes at run time.
+            # Mirrored into the metrics registry (obs/trace.py retrace
+            # monitor), so steady-state serving recompiles are a
+            # scrapeable counter, not just an in-process int.
             self._compile_count += 1
+            retraces.inc()
             y, _, _, _, _ = model._forward(params, state, x, train=False,
                                            rng=None, fmask=fmask)
             return y
@@ -215,6 +224,11 @@ class InferenceEngine:
             "warm": self.warm,
             "compile_count": self._compile_count,
             "buckets": repr(self.buckets),
+            # canary/rollback tooling keys on these: WHICH on-disk
+            # checkpoint is live (content fingerprint, None for
+            # fresh-weights engines) and which snapshot generation
+            "checkpoint_fingerprint": (None if self._fingerprint is None
+                                       else list(self._fingerprint)),
         }
 
     # -- inference ----------------------------------------------------------
@@ -241,25 +255,29 @@ class InferenceEngine:
         return self._infer_on(snap, x, mask), snap.version
 
     def _infer_on(self, snap: "_Snapshot", x, mask=None) -> np.ndarray:
+        from deeplearning4j_tpu.obs import trace as _trace
+
         x = np.asarray(x)
         t_orig = x.shape[1] if x.ndim >= 3 else None
         xp, mp, n = self.buckets.pad_batch(x, mask)
         t_padded = xp.shape[1] if t_orig is not None else None
         self.metrics.record_dispatch(xp.shape[0])
-        if snap.fn is None:
-            m = snap.model
-            if hasattr(m, "output_single"):  # ComputationGraph surface
-                y = m.output_single(xp, masks=None if mp is None else [mp])
+        with _trace.span("serving_dispatch"):
+            if snap.fn is None:
+                m = snap.model
+                if hasattr(m, "output_single"):  # ComputationGraph surface
+                    y = m.output_single(xp,
+                                        masks=None if mp is None else [mp])
+                else:
+                    y = m.output(xp, mask=mp)
             else:
-                y = m.output(xp, mask=mp)
-        else:
-            xd = xp
-            md = mp
-            if self.mesh is not None:
-                xd = jax.device_put(xp, self.mesh.batch_sharded())
-                if mp is not None:
-                    md = jax.device_put(mp, self.mesh.batch_sharded())
-            y = snap.fn(snap.params, snap.state, xd, md)
+                xd = xp
+                md = mp
+                if self.mesh is not None:
+                    xd = jax.device_put(xp, self.mesh.batch_sharded())
+                    if mp is not None:
+                        md = jax.device_put(mp, self.mesh.batch_sharded())
+                y = snap.fn(snap.params, snap.state, xd, md)
         from deeplearning4j_tpu.serving.buckets import slice_result
 
         return slice_result(y, n, t_orig, t_padded)

@@ -1,102 +1,167 @@
-"""Thread-safe serving metrics: counters, per-bucket hits, latency
+"""Serving metrics, rebased onto the unified observability registry
+(obs/metrics.MetricsRegistry) — counters, per-bucket hits, latency
 quantiles from a fixed-size ring buffer.
+
+The public surface is unchanged from the original serving-only
+implementation (``record_*`` methods, attribute-style counter reads,
+``snapshot()`` with the same JSON keys for the ``/metrics`` endpoint).
+What changed underneath: every value now lives in a
+:class:`MetricsRegistry`, so (1) ``prometheus_text()`` exposes the whole
+family in Prometheus text format for scrapers, and (2) an engine handed
+the process-wide default registry (``cli.py serve`` does this) shares
+ONE metrics surface with training — the 1605.08695 train-and-serve
+pairing applied to monitoring. By default each instance owns a private
+registry, so independent engines (tests run dozens) never double-count.
 
 The ring buffer bounds memory under sustained traffic (millions of
 requests must not grow a list); quantiles are computed over the last
 ``ring_size`` completed requests, which is the window that matters for
-a live /metrics endpoint. Everything here is plain Python under one
-lock — the costs are nanoseconds against a device dispatch.
+a live /metrics endpoint. Everything here is plain Python under
+fine-grained locks — the costs are nanoseconds against a device
+dispatch.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from deeplearning4j_tpu.obs.metrics import Histogram, MetricsRegistry
+
 
 class ServingMetrics:
-    def __init__(self, ring_size: int = 2048):
-        self._lock = threading.Lock()
-        self._ring_size = int(ring_size)
-        self._lat = [0.0] * self._ring_size  # seconds, ring buffer
-        self._lat_n = 0  # total ever recorded (write head = n % size)
-        self.requests = 0          # requests accepted into the queue
-        self.examples = 0          # rows across accepted requests
-        self.rejects = 0           # ServerOverloadedError rejections
-        self.deadline_exceeded = 0
-        self.errors = 0            # dispatch failures propagated to callers
-        self.dispatches = 0        # device batches launched
-        self.reloads = 0
-        self.bucket_hits: Dict[int, int] = {}  # dispatched bucket size → count
+    def __init__(self, ring_size: int = 2048,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        reg = self.registry
+        self._requests = reg.counter(
+            "serving_requests_total", "requests accepted into the queue")
+        self._examples = reg.counter(
+            "serving_examples_total", "rows across accepted requests")
+        self._rejects = reg.counter(
+            "serving_rejects_total", "ServerOverloadedError rejections")
+        self._deadline = reg.counter(
+            "serving_deadline_exceeded_total", "requests past their deadline")
+        self._errors = reg.counter(
+            "serving_errors_total", "dispatch failures propagated to callers")
+        self._dispatches = reg.counter(
+            "serving_dispatches_total", "device batches launched")
+        self._reloads = reg.counter(
+            "serving_reloads_total", "model hot reloads")
+        self._latency = reg.histogram(
+            "serving_latency_seconds", "request latency (ring-buffer window)",
+            ring_size=ring_size)
         self.started_at = time.time()
+        reg.gauge("serving_uptime_seconds", "seconds since metrics start",
+                  fn=lambda: time.time() - self.started_at)
 
     # -- recording ----------------------------------------------------------
     def record_request(self, rows: int) -> None:
-        with self._lock:
-            self.requests += 1
-            self.examples += int(rows)
+        self._requests.inc()
+        self._examples.inc(int(rows))
 
     def record_reject(self) -> None:
-        with self._lock:
-            self.rejects += 1
+        self._rejects.inc()
 
     def record_deadline(self) -> None:
-        with self._lock:
-            self.deadline_exceeded += 1
+        self._deadline.inc()
 
     def record_error(self) -> None:
-        with self._lock:
-            self.errors += 1
+        self._errors.inc()
 
     def record_dispatch(self, bucket: int) -> None:
-        with self._lock:
-            self.dispatches += 1
-            self.bucket_hits[int(bucket)] = (
-                self.bucket_hits.get(int(bucket), 0) + 1)
+        self._dispatches.inc()
+        self.registry.counter(
+            "serving_bucket_hits_total", "dispatches per bucket size",
+            labels={"bucket": str(int(bucket))}).inc()
 
     def record_reload(self) -> None:
-        with self._lock:
-            self.reloads += 1
+        self._reloads.inc()
 
     def record_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._lat[self._lat_n % self._ring_size] = float(seconds)
-            self._lat_n += 1
+        self._latency.observe(float(seconds))
+
+    # -- attribute-style reads (original public surface) ---------------------
+    @property
+    def requests(self) -> int:
+        return int(self._requests.value())
+
+    @property
+    def examples(self) -> int:
+        return int(self._examples.value())
+
+    @property
+    def rejects(self) -> int:
+        return int(self._rejects.value())
+
+    @property
+    def deadline_exceeded(self) -> int:
+        return int(self._deadline.value())
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value())
+
+    @property
+    def dispatches(self) -> int:
+        return int(self._dispatches.value())
+
+    @property
+    def reloads(self) -> int:
+        return int(self._reloads.value())
+
+    @property
+    def bucket_hits(self) -> Dict[int, int]:
+        fam = self.registry.snapshot().get("serving_bucket_hits_total", {})
+        out: Dict[int, int] = {}
+        if isinstance(fam, dict):
+            for label, v in fam.items():
+                out[int(label.split("=", 1)[1])] = int(v)
+        return out
 
     # -- reading ------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:
         """q in [0, 1] over the ring window; None before any request."""
-        with self._lock:
-            n = min(self._lat_n, self._ring_size)
-            if n == 0:
-                return None
-            window = sorted(self._lat[:n])
-        idx = min(int(q * n), n - 1)
-        return window[idx]
+        return self._latency.quantile(q)
 
     def snapshot(self, queue_depth: Optional[int] = None) -> dict:
-        """One JSON-ready dict for the /metrics endpoint."""
-        with self._lock:
-            n = min(self._lat_n, self._ring_size)
-            window = sorted(self._lat[:n])
-            out = {
-                "requests": self.requests,
-                "examples": self.examples,
-                "rejects": self.rejects,
-                "deadline_exceeded": self.deadline_exceeded,
-                "errors": self.errors,
-                "dispatches": self.dispatches,
-                "reloads": self.reloads,
-                "bucket_hits": {str(k): v
-                                for k, v in sorted(self.bucket_hits.items())},
-                "uptime_s": round(time.time() - self.started_at, 3),
-                "latency_window": n,
-            }
+        """One JSON-ready dict for the /metrics endpoint (keys unchanged
+        from the pre-registry implementation)."""
+        window = self._latency.window()
+        n = len(window)
+        out = {
+            "requests": self.requests,
+            "examples": self.examples,
+            "rejects": self.rejects,
+            "deadline_exceeded": self.deadline_exceeded,
+            "errors": self.errors,
+            "dispatches": self.dispatches,
+            "reloads": self.reloads,
+            "bucket_hits": {str(k): v
+                            for k, v in sorted(self.bucket_hits.items())},
+            "uptime_s": round(time.time() - self.started_at, 3),
+            "latency_window": n,
+        }
         for name, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):
             out[f"latency_{name}_ms"] = (
                 None if n == 0
                 else round(window[min(int(q * n), n - 1)] * 1e3, 3))
         if queue_depth is not None:
             out["queue_depth"] = int(queue_depth)
+            self.registry.gauge("serving_queue_depth",
+                                "pending requests in the batcher queue"
+                                ).set(int(queue_depth))
         return out
+
+    def prometheus_text(self, queue_depth: Optional[int] = None) -> str:
+        """Prometheus text exposition of the backing registry."""
+        if queue_depth is not None:
+            self.registry.gauge("serving_queue_depth",
+                                "pending requests in the batcher queue"
+                                ).set(int(queue_depth))
+        return self.registry.prometheus_text()
+
+
+# re-exported for API continuity: callers that sized the ring via the
+# original module keep working
+__all__ = ["ServingMetrics", "Histogram"]

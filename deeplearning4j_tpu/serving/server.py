@@ -11,12 +11,21 @@ the production contract:
                            "timeout_ms": n?}`` → ``{"outputs": [...]}``
 - ``POST /predict_npy``    raw ``.npy`` body → ``.npy`` response
                            (zero JSON float cost for bulk clients)
-- ``GET  /healthz``        liveness + model version/warm state
+- ``GET  /healthz``        liveness + model version/warm state +
+                           checkpoint fingerprint/snapshot version/
+                           uptime (the keys canary & rollback tooling
+                           watches)
 - ``POST /reload``         hot-swap to the newest valid checkpoint
                            (optional JSON ``{"path": ...,
                            "force": bool}``)
 - ``GET  /metrics``        counters, queue depth, per-bucket hits,
-                           latency quantiles (ring buffer)
+                           latency quantiles (ring buffer). Content-
+                           negotiated: JSON by default (the original
+                           surface), Prometheus text exposition when the
+                           client Accepts ``text/plain``/openmetrics or
+                           asks ``?format=prometheus`` — one scrape
+                           config covers serving and training
+                           (obs/exporter.py)
 
 Typed failures map to transport codes: queue-full backpressure → 503
 (clients back off), request deadline → 504, malformed input → 400,
@@ -28,6 +37,7 @@ from __future__ import annotations
 import io
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -155,13 +165,30 @@ def _make_handler(server: InferenceServer):
 
         # -- routes ---------------------------------------------------------
         def do_GET(self):  # noqa: N802
+            from urllib.parse import urlparse
+
+            from deeplearning4j_tpu.obs.exporter import (
+                PROMETHEUS_CTYPE,
+                wants_prometheus,
+            )
+
             try:
-                if self.path == "/healthz":
+                url = urlparse(self.path)
+                if url.path == "/healthz":
                     info = server.engine.describe()
+                    info["snapshot_version"] = info.get("version")
+                    info["uptime_s"] = round(
+                        time.time() - server.metrics.started_at, 3)
                     self._send_json(200, {"status": "ok", **info})
-                elif self.path == "/metrics":
-                    self._send_json(200, server.metrics.snapshot(
-                        queue_depth=server.batcher.queue_depth()))
+                elif url.path == "/metrics":
+                    depth = server.batcher.queue_depth()
+                    if wants_prometheus(self.headers.get("Accept", ""),
+                                        url.query):
+                        self._send(200, server.metrics.prometheus_text(
+                            queue_depth=depth).encode(), PROMETHEUS_CTYPE)
+                    else:
+                        self._send_json(200, server.metrics.snapshot(
+                            queue_depth=depth))
                 else:
                     self._send_json(404, {"error": "NotFound",
                                           "message": self.path})

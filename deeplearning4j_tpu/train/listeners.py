@@ -59,6 +59,12 @@ class TrainingListener:
     def on_backward_pass(self, model) -> None:
         pass
 
+    def on_fit_end(self, model) -> None:
+        """Fires when ``fit()`` returns — including by exception. The
+        hook for releasing resources a mid-epoch abort would otherwise
+        leak (ProfilerListener's open trace window)."""
+        pass
+
     def needs_introspection(self, next_iteration: int) -> bool:
         """Whether the introspection hooks should fire for the upcoming
         iteration. Listeners that only sample (e.g. StatsListener at
@@ -86,6 +92,24 @@ def _overrides(listeners, name: str, next_iteration: Optional[int] = None) -> bo
     ``next_iteration`` is given, wants introspection for it).
     Introspection is pay-for-use: nothing extra runs otherwise."""
     return bool(_hook_recipients(listeners, name, next_iteration))
+
+
+def dispatch_fit_end(listeners, model) -> None:
+    """Deliver ``on_fit_end`` to every listener providing it (duck-typed
+    like the epoch hooks); called from the fit paths' ``finally`` so an
+    exception mid-epoch still releases listener-held resources. Each
+    listener's hook is exception-isolated: a failing cleanup must not
+    stop the remaining listeners' cleanup, skip the fit path's own
+    teardown (the ZeRO-1 opt-state gather), or mask the original fit
+    error raised from inside the ``finally``."""
+    for lst in listeners:
+        hook = getattr(lst, "on_fit_end", None)
+        if hook is not None:
+            try:
+                hook(model)
+            except Exception:
+                log.exception("on_fit_end failed for %s",
+                              type(lst).__name__)
 
 
 def _hook_recipients(listeners, name: str,
@@ -159,7 +183,17 @@ class CollectScoresIterationListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """samples/sec + batches/sec (reference ``PerformanceListener.java:22-87``)."""
+    """samples/sec + batches/sec (reference ``PerformanceListener.java:22-87``).
+
+    Accounting: every hook call contributes ITS batch's actual size (the
+    fit paths publish ``model.last_batch_size`` per dispatched batch/
+    bundle), accumulated across the window — variable batch sizes and
+    ragged epoch tails report true samples/sec instead of the last batch
+    size extrapolated over the whole window. When the async data
+    pipeline's wait counters are live (obs/metrics.py, populated by
+    AsyncDataSetIterator), the report appends the share of wall time the
+    fit loop spent waiting on an empty prefetch queue — the
+    input-bound vs compute-bound verdict."""
 
     def __init__(self, frequency: int = 10, report_score: bool = False,
                  printer: Optional[Callable[[str], None]] = None):
@@ -169,65 +203,70 @@ class PerformanceListener(TrainingListener):
         self._last_time: Optional[float] = None
         self._last_iter = 0
         self._samples = 0
+        self._wait0: Optional[float] = None
         self.last_samples_per_sec: Optional[float] = None
         self.last_batches_per_sec: Optional[float] = None
+        self.last_input_bound_share: Optional[float] = None
+
+    @staticmethod
+    def _consumer_wait_s() -> float:
+        # thread-local: this listener runs on its fit loop's thread, so
+        # the total is THIS fit's waits even when several fits run
+        # concurrently (tuner pool engine)
+        from deeplearning4j_tpu.obs.metrics import (
+            thread_consumer_wait_seconds,
+        )
+
+        return thread_consumer_wait_seconds()
+
+    def _window(self, it: int, samples: int, score_fn) -> None:
+        self._samples += samples
+        if self._last_time is None:
+            # window baseline: this iteration's samples belong to no
+            # open window
+            self._last_time = time.perf_counter()
+            self._last_iter = it
+            self._samples = 0
+            self._wait0 = self._consumer_wait_s()
+            return
+        if (it - self._last_iter) < self.frequency:
+            return
+        now = time.perf_counter()
+        dt = now - self._last_time
+        batches = it - self._last_iter
+        self.last_batches_per_sec = batches / dt
+        msg = f"iteration {it}: {self.last_batches_per_sec:.2f} batches/sec"
+        if self._samples:
+            self.last_samples_per_sec = self._samples / dt
+            msg += f", {self.last_samples_per_sec:.1f} samples/sec"
+        wait1 = self._consumer_wait_s()
+        if self._wait0 is not None and dt > 0:
+            share = min(max(wait1 - self._wait0, 0.0) / dt, 1.0)
+            self.last_input_bound_share = share
+            if wait1 > self._wait0:
+                msg += (f", queue-wait {share:.0%} "
+                        f"({'input' if share >= 0.5 else 'compute'}-bound)")
+        if self.report_score:
+            msg += f", score {score_fn():.6f}"
+        self.printer(msg)
+        self._last_time = now
+        self._last_iter = it
+        self._samples = 0
+        self._wait0 = wait1
 
     def iteration_done(self, model, iteration, epoch):
-        # batch size from the model's most recent fit is unknown here; use
-        # tracked sample count when provided via model attribute if any.
-        bs = getattr(model, "last_batch_size", None)
-        if bs:
-            self._samples += bs
-        if self._last_time is None:
-            self._last_time = time.perf_counter()
-            self._last_iter = iteration
-            self._samples = 0
-            return
-        if (iteration - self._last_iter) >= self.frequency:
-            now = time.perf_counter()
-            dt = now - self._last_time
-            batches = iteration - self._last_iter
-            self.last_batches_per_sec = batches / dt
-            msg = f"iteration {iteration}: {self.last_batches_per_sec:.2f} batches/sec"
-            if bs:
-                self.last_samples_per_sec = self._samples / dt
-                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
-            if self.report_score:
-                msg += f", score {model.score():.6f}"
-            self.printer(msg)
-            self._last_time = now
-            self._last_iter = iteration
-            self._samples = 0
+        bs = int(getattr(model, "last_batch_size", None) or 0)
+        self._window(iteration, bs, lambda: model.score())
 
     def bundle_done(self, model, it0, epoch, scores):
         """Bundled fits time whole bundles: the per-step replay fires
         back-to-back after the fused dispatch, so per-step wall-clock
-        deltas inside a bundle are ~0 and would report absurd rates."""
+        deltas inside a bundle are ~0 and would report absurd rates.
+        Batches within one bundle share a size by construction, so
+        ``last_batch_size * k`` is this bundle's exact sample count."""
         k = len(scores)
-        bs = getattr(model, "last_batch_size", None)
-        if bs:
-            self._samples += bs * k
-        it = it0 + k
-        if self._last_time is None:
-            self._last_time = time.perf_counter()
-            self._last_iter = it
-            self._samples = 0
-            return
-        if (it - self._last_iter) >= self.frequency:
-            now = time.perf_counter()
-            dt = now - self._last_time
-            batches = it - self._last_iter
-            self.last_batches_per_sec = batches / dt
-            msg = f"iteration {it}: {self.last_batches_per_sec:.2f} batches/sec"
-            if bs:
-                self.last_samples_per_sec = self._samples / dt
-                msg += f", {self.last_samples_per_sec:.1f} samples/sec"
-            if self.report_score:
-                msg += f", score {float(scores.host()[-1]):.6f}"
-            self.printer(msg)
-            self._last_time = now
-            self._last_iter = it
-            self._samples = 0
+        bs = int(getattr(model, "last_batch_size", None) or 0)
+        self._window(it0 + k, bs * k, lambda: float(scores.host()[-1]))
 
 
 class TimeIterationListener(TrainingListener):
@@ -473,13 +512,29 @@ class ProfilerListener(TrainingListener):
             self._active = False
             self.completed = True
 
+    def _close(self, model) -> None:
+        import jax
+
+        if model is not None and getattr(model, "score_", None) is not None:
+            try:
+                jax.block_until_ready(model.score_)
+            except Exception:
+                pass  # closing the trace matters more than draining
+        jax.profiler.stop_trace()
+        self._active = False
+        self.completed = True
+
     def on_epoch_end(self, model):
         if self._active:  # epoch ended inside the window: close cleanly
-            import jax
+            self._close(model)
 
-            jax.profiler.stop_trace()
-            self._active = False
-            self.completed = True
+    def on_fit_end(self, model):
+        """A window spanning the final partial epoch (or an epoch that
+        raised) would leak an open ``jax.profiler`` trace — the next
+        ``start_trace`` in the process then fails. fit() exit closes it
+        unconditionally."""
+        if self._active:
+            self._close(model)
 
 
 class ComposableIterationListener(TrainingListener):
@@ -505,6 +560,16 @@ class ComposableIterationListener(TrainingListener):
         for l in self.listeners:
             if hasattr(l, "on_epoch_end"):
                 l.on_epoch_end(model)
+
+    def on_fit_end(self, model):
+        dispatch_fit_end(self.listeners, model)
+
+    def telemetry_done(self, model, it0, epoch, telem):
+        """Composed children share the one BundleTelemetry (and its
+        single host fetch) exactly like top-level listeners."""
+        from deeplearning4j_tpu.obs.telemetry import dispatch_telemetry
+
+        dispatch_telemetry(self.listeners, model, it0, epoch, telem)
 
     def needs_introspection(self, next_iteration: int) -> bool:
         return any(
@@ -569,14 +634,30 @@ class ParamAndGradientIterationListener(TrainingListener):
     ``iterations`` steps, tab-delimited to stdout and/or a file
     (reference ``ParamAndGradientIterationListener.java``: printMean /
     printMinMax / printMeanAbsValue flags, header line, delimiter).
-    Gradients arrive through the introspection hook — pay-for-use, the
-    extra fwd+grad pass runs only on reporting iterations."""
+
+    ``gradients`` selects where gradient statistics come from:
+
+    - ``"per_param"`` (default, the reference behavior): the
+      introspection hook delivers the full gradient pytree per step —
+      this genuinely snapshots per-step model state, so it forces
+      ``steps_per_call=1`` (train/pipeline.py bundling audit).
+    - ``"telemetry"``: per-step GLOBAL norms (grad/param/update norm,
+      update:param ratio, loss scale) from the in-graph telemetry stream
+      (obs/telemetry.py) — exact per-step values with NO per-step host
+      callback, so bundled fits keep their K. Requires the model to
+      train with a TelemetryConf; without one no rows are emitted.
+    - ``"none"``: parameter statistics only; bundles freely.
+    """
 
     def __init__(self, iterations: int = 1, print_header: bool = True,
                  print_mean: bool = True, print_min_max: bool = True,
                  print_mean_abs_value: bool = True,
                  output_to_console: bool = True, file: Optional[str] = None,
-                 delimiter: str = "\t"):
+                 delimiter: str = "\t", gradients: str = "per_param"):
+        if gradients not in ("per_param", "telemetry", "none"):
+            raise ValueError(
+                f"gradients must be 'per_param', 'telemetry' or 'none', "
+                f"got {gradients!r}")
         self.iterations = max(int(iterations), 1)
         self.print_header = print_header
         self.print_mean = print_mean
@@ -585,7 +666,13 @@ class ParamAndGradientIterationListener(TrainingListener):
         self.output_to_console = output_to_console
         self.file = file
         self.delimiter = delimiter
+        self.gradients = gradients
+        if gradients == "per_param":
+            # instance-bound only in this mode, so the bundling audit
+            # sees the per-step hook exactly when it is really needed
+            self.on_gradient_calculation = self._on_gradient_calculation
         self._grads = None
+        self._telem = None  # (it0, BundleTelemetry) from telemetry_done
         self._header_written = False
         if file:  # truncate once per listener lifetime
             open(file, "w").close()
@@ -593,8 +680,12 @@ class ParamAndGradientIterationListener(TrainingListener):
     def needs_introspection(self, next_iteration: int) -> bool:
         return next_iteration % self.iterations == 0
 
-    def on_gradient_calculation(self, model, gradients):
+    def _on_gradient_calculation(self, model, gradients):
         self._grads = gradients
+
+    def telemetry_done(self, model, it0, epoch, telem):
+        if self.gradients == "telemetry":
+            self._telem = (it0, telem)
 
     def _stats(self, arr):
         import numpy as np
@@ -625,9 +716,45 @@ class ParamAndGradientIterationListener(TrainingListener):
             with open(self.file, "a") as f:
                 f.write(line + "\n")
 
+    # -- telemetry mode: global-norm rows, bundling-compatible ---------------
+    def _telem_rows(self, it0: int, k: int) -> None:
+        telem = None
+        if self._telem is not None and self._telem[0] == it0:
+            telem = self._telem[1]
+        self._telem = None
+        if telem is None:
+            return
+        host = telem.host()  # the shared once-per-bundle fetch
+        keys = sorted(host)
+        if self.print_header and not self._header_written:
+            self._emit(self.delimiter.join(["iteration"] + keys))
+            self._header_written = True
+        for j in range(k):
+            it = it0 + j + 1
+            if it % self.iterations:
+                continue
+            self._emit(self.delimiter.join(
+                [str(it)] + [f"{float(host[key][j]):.6g}" for key in keys]))
+
+    def bundle_done(self, model, it0, epoch, scores):
+        if self.gradients == "telemetry":
+            self._telem_rows(it0, len(scores))
+        # per_param mode never sees bundles (the bound introspection hook
+        # forces K=1); "none" mode park: per-parameter stats of
+        # END-of-bundle params at the last in-bundle reporting hit
+        elif any((it0 + j + 1) % self.iterations == 0
+                 for j in range(len(scores))):
+            self._param_row(model, it0 + len(scores))
+
     def iteration_done(self, model, iteration, epoch):
+        if self.gradients == "telemetry":
+            self._telem_rows(iteration - 1, 1)
+            return
         if iteration % self.iterations:
             return
+        self._param_row(model, iteration)
+
+    def _param_row(self, model, iteration):
         params = _named_leaves(model.params_)
         grads = _named_leaves(self._grads) if self._grads is not None else []
         if self.print_header and not self._header_written:

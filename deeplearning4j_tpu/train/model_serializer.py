@@ -34,6 +34,7 @@ class ModelSerializer:
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True, normalizer=None) -> None:
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.obs import trace as _trace
         from deeplearning4j_tpu.train.faults import atomic_tmp_path
 
         # during a ZeRO-1 sharded fit the live opt state is sharded and
@@ -47,7 +48,11 @@ class ModelSerializer:
         # previous checkpoint at ``path`` untouched, never a torn zip
         tmp = atomic_tmp_path(path)
         try:
-            with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            # span: checkpoint writes show up in profiler traces as their
+            # own box (they gather device state and hit disk — a classic
+            # hidden stall between training dispatches)
+            with _trace.span("checkpoint_write"), \
+                    zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
                 z.writestr(CONFIG_ENTRY, model.conf.to_json())
                 z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
                 if save_updater and model.opt_state_ is not None:

@@ -143,29 +143,38 @@ def resolve_steps_per_call(model, requested: Optional[int] = None) -> int:
 # --------------------------------------------------------------------------
 # the bundled step
 # --------------------------------------------------------------------------
-def bundled_scan(raw_step, guarded: bool):
+def bundled_scan(raw_step, guarded: bool, telemetry: bool = False):
     """Wrap a raw train step ``(params, opt, state, [fstate,] f, l, fm,
-    lm, rng, iteration, epoch) -> (params, opt, state, [fstate,] score)``
-    in a ``lax.scan`` over the leading K axis of the batch arrays and the
-    stacked per-step rngs. The iteration counter rides the carry (+1 per
-    step, in-graph); per-step scores are stacked into the (K,) output.
-    ``None`` masks pass through (pytree nodes with no leaves scan
-    transparently). Works for MultiLayerNetwork (array batches) and
-    ComputationGraph (per-input tuples) alike."""
+    lm, rng, iteration, epoch) -> (params, opt, state, [fstate,] score
+    [, telem])`` in a ``lax.scan`` over the leading K axis of the batch
+    arrays and the stacked per-step rngs. The iteration counter rides the
+    carry (+1 per step, in-graph); per-step scores are stacked into the
+    (K,) output — and with ``telemetry`` the per-step telemetry dict
+    (obs/telemetry.py) stacks the same way, riding the scan outputs
+    alongside the scores so ONE host fetch surfaces a whole bundle's
+    monitoring signals. ``None`` masks pass through (pytree nodes with no
+    leaves scan transparently). Works for MultiLayerNetwork (array
+    batches) and ComputationGraph (per-input tuples) alike."""
     if guarded:
         def bundle(params, opt_state, state, fstate, features, labels,
                    fmask, lmask, rngs, iteration, epoch):
             def body(carry, xs):
                 p, o, s, fs, it = carry
                 f, l, fm, lm, rng = xs
-                p, o, s, fs, score = raw_step(p, o, s, fs, f, l, fm, lm,
-                                              rng, it, epoch)
+                out = raw_step(p, o, s, fs, f, l, fm, lm, rng, it, epoch)
+                if telemetry:
+                    p, o, s, fs, score, telem = out
+                    return (p, o, s, fs, it + 1), (score, telem)
+                p, o, s, fs, score = out
                 return (p, o, s, fs, it + 1), score
 
-            (p, o, s, fs, _), scores = jax.lax.scan(
+            (p, o, s, fs, _), ys = jax.lax.scan(
                 body, (params, opt_state, state, fstate, iteration),
                 (features, labels, fmask, lmask, rngs))
-            return p, o, s, fs, scores
+            if telemetry:
+                scores, telems = ys
+                return p, o, s, fs, scores, telems
+            return p, o, s, fs, ys
 
         return bundle
 
@@ -174,29 +183,41 @@ def bundled_scan(raw_step, guarded: bool):
         def body(carry, xs):
             p, o, s, it = carry
             f, l, fm, lm, rng = xs
-            p, o, s, score = raw_step(p, o, s, f, l, fm, lm, rng, it, epoch)
+            out = raw_step(p, o, s, f, l, fm, lm, rng, it, epoch)
+            if telemetry:
+                p, o, s, score, telem = out
+                return (p, o, s, it + 1), (score, telem)
+            p, o, s, score = out
             return (p, o, s, it + 1), score
 
-        (p, o, s, _), scores = jax.lax.scan(
+        (p, o, s, _), ys = jax.lax.scan(
             body, (params, opt_state, state, iteration),
             (features, labels, fmask, lmask, rngs))
-        return p, o, s, scores
+        if telemetry:
+            scores, telems = ys
+            return p, o, s, scores, telems
+        return p, o, s, ys
 
     return bundle
 
 
-def make_bundled_step(model, jit: bool = True):
+def make_bundled_step(model, jit: bool = True, telemetry=None):
     """K-step bundled train step for ``model`` (MultiLayerNetwork or
     ComputationGraph): its raw train step under a ``lax.scan``. The
     compiled program is K-invariant in code size (the scan body traces
     once) but specialized to the stacked batch shapes, like every other
-    jitted step."""
+    jitted step. ``telemetry`` (a TelemetryConf) adds the stacked
+    per-step telemetry output."""
+    from deeplearning4j_tpu.obs import trace as _trace
     from deeplearning4j_tpu.train import faults as _faults
 
     guarded = model._active_fault_policy() is not None
-    bundle = bundled_scan(model.train_step_fn(), guarded)
+    bundle = bundled_scan(model.train_step_fn(telemetry=telemetry), guarded,
+                          telemetry=telemetry is not None)
     if not jit:
         return bundle
+    bundle = _trace.count_retraces(
+        f"{type(model).__name__}.bundled_step", bundle)
     donate = _faults.guard_donation(0, 1, 2) if guarded else (0, 1, 2)
     return jax.jit(bundle, donate_argnums=donate)
 
@@ -204,16 +225,27 @@ def make_bundled_step(model, jit: bool = True):
 # --------------------------------------------------------------------------
 # listener dispatch
 # --------------------------------------------------------------------------
-def dispatch_bundle_listeners(model, it0: int, epoch: int, scores) -> None:
+def dispatch_bundle_listeners(model, it0: int, epoch: int, scores,
+                              telem=None) -> None:
     """Deliver one bundle's worth of iteration events.
 
-    Bundle-aware listeners (a ``bundle_done(model, it0, epoch,
+    ``telem`` (the bundled step's stacked telemetry pytree, when the
+    model trains with a TelemetryConf) is delivered FIRST via
+    ``telemetry_done`` so listeners can fold the per-step in-graph
+    signals into the records they emit from the score hooks. Then
+    bundle-aware listeners (a ``bundle_done(model, it0, epoch,
     BundleScores)`` hook) get the whole bundle at once — their host
     fetch, if any, happens once per bundle. Every other listener keeps
     its exact legacy contract: ``iteration_done`` per step, in step
     order, with ``model.score_`` rebound to that step's device scalar
     (slicing a device array does not sync; only a listener that actually
     reads ``model.score()`` pays the transfer)."""
+    if telem is not None:
+        from deeplearning4j_tpu.obs import telemetry as _telemetry
+
+        _telemetry.dispatch_telemetry(
+            model.listeners, model, it0, epoch,
+            _telemetry.BundleTelemetry(telem, int(scores.shape[0])))
     dispatch_bundle_to(model.listeners, model, it0, epoch,
                        BundleScores(scores))
 

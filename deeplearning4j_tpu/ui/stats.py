@@ -81,15 +81,18 @@ def _summary(arrs: Dict[str, np.ndarray], histograms: bool,
 
 
 class StatsListener(TrainingListener):
-    # Bundling audit (train/pipeline.resolve_steps_per_call): stats
-    # collection is state-coupled — iteration_done snapshots the model's
-    # live parameters and differences them against the previous reporting
-    # iteration (the update:param-ratio chart). Under steps_per_call>1
-    # the post-bundle listener replay would hand every step END-OF-BUNDLE
-    # parameters: in-bundle deltas read as zero and cross-bundle deltas
-    # lump K updates together, silently corrupting the charts. Declaring
-    # the need forces K=1 whenever a StatsListener is attached.
-    requires_per_step_state = True
+    # Bundling (train/pipeline.py): the default config no longer forces
+    # steps_per_call=1. Per-step signals that used to need a live param
+    # snapshot every iteration — the update:param-ratio chart above all —
+    # now arrive through the in-graph telemetry stream (obs/telemetry.py:
+    # exact per-step global norms computed inside the jitted step,
+    # host-fetched once per bundle), and the remaining param summaries
+    # are taken at bundle granularity (records carry ``params_at_
+    # iteration`` so the dashboard can tell). Only the OPT-IN
+    # introspection collections (collect_gradients/collect_activations)
+    # still force K=1 — those genuinely snapshot per-step gradient/
+    # activation tensors, which is exactly the "keep it only where a
+    # hook really needs per-step state" boundary.
 
     def __init__(self, storage: StatsStorage, reporting_frequency: int = 10,
                  session_id: Optional[str] = None, worker_id: str = "worker_0",
@@ -112,7 +115,9 @@ class StatsListener(TrainingListener):
             self.on_forward_pass = self._on_forward_pass
         self._pending_grads: Optional[Dict[str, np.ndarray]] = None
         self._pending_acts: Optional[Dict[str, np.ndarray]] = None
+        self._pending_telem = None  # (it0, BundleTelemetry)
         self._prev_params: Optional[Dict[str, np.ndarray]] = None
+        self._prev_params_iter: Optional[int] = None
         self._last_time: Optional[float] = None
         self._last_iter_for_rate: Optional[int] = None
         self._initialized = False
@@ -154,10 +159,23 @@ class StatsListener(TrainingListener):
         })
         self._initialized = True
 
+    # ----------------------------------------------------------- telemetry
+    def telemetry_done(self, model, it0: int, epoch: int, telem) -> None:
+        """In-graph per-step signals (obs/telemetry.py), delivered before
+        the score hooks; folded into the records they emit."""
+        self._pending_telem = (int(it0), telem)
+
+    def _take_telem(self, it0: int):
+        pending, self._pending_telem = self._pending_telem, None
+        if pending is not None and pending[0] == int(it0):
+            return pending[1]
+        return None
+
     # ------------------------------------------------------------- iteration
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
         if not self._initialized:
             self._put_init(model)
+        telem = self._take_telem(iteration - 1)
         if iteration != 1 and iteration % self.frequency != 0:
             return
         now = time.time()
@@ -173,6 +191,8 @@ class StatsListener(TrainingListener):
             "score": float(model.score_) if model.score_ is not None else None,
             "memory_rss_mb": _current_rss_mb(),
         }
+        if telem is not None:
+            record["telemetry"] = telem.step(0)
         if self._last_time is not None and self._last_iter_for_rate is not None:
             dt = now - self._last_time
             di = iteration - self._last_iter_for_rate
@@ -205,7 +225,77 @@ class StatsListener(TrainingListener):
                 for k in updates
             }
         self._prev_params = params
+        self._prev_params_iter = int(iteration)
         self.storage.put_record(record)
+
+    # --------------------------------------------------------------- bundles
+    def bundle_done(self, model, it0: int, epoch: int, scores) -> None:
+        """Bundled fits (steps_per_call=K): one record per reporting
+        iteration inside the bundle. Scores and the in-graph telemetry
+        are EXACT per-step values from the two shared once-per-bundle
+        fetches; the per-layer parameter summaries are snapshotted at
+        bundle granularity (``params_at_iteration`` marks the snapshot
+        point, ``updates_span_steps`` how many optimizer steps the
+        per-layer delta covers) — the per-step versions of those are
+        precisely what telemetry's global norms replace."""
+        if not self._initialized:
+            self._put_init(model)
+        k = len(scores)
+        telem = self._take_telem(it0)
+        hits = [j for j in range(k)
+                if (it0 + j + 1) == 1 or (it0 + j + 1) % self.frequency == 0]
+        if not hits:
+            return
+        host = scores.host()  # one fetch per bundle, shared by all hits
+        telem_host = telem.host() if telem is not None else None
+        now = time.time()
+        rss = _current_rss_mb()
+        for j in hits:
+            it = it0 + j + 1
+            record = {
+                "kind": "update",
+                "session_id": self.session_id,
+                "worker_id": self.worker_id,
+                "timestamp": now,
+                "iteration": it,
+                "epoch": int(epoch),
+                "score": float(host[j]),
+                "memory_rss_mb": rss,
+            }
+            if telem_host is not None:
+                record["telemetry"] = {key: float(v[j])
+                                       for key, v in telem_host.items()}
+            if j == hits[-1]:
+                params = _param_arrays(model)  # end-of-bundle snapshot
+                record["params_at_iteration"] = it0 + k
+                record["parameters"] = _summary(
+                    params, self.collect_histograms, self.bins)
+                if (self._last_time is not None
+                        and self._last_iter_for_rate is not None):
+                    dt = now - self._last_time
+                    di = it - self._last_iter_for_rate
+                    if dt > 0 and di > 0:
+                        record["iterations_per_sec"] = di / dt
+                self._last_time = now
+                self._last_iter_for_rate = it
+                if self._prev_params is not None:
+                    updates = {
+                        key: params[key] - self._prev_params[key]
+                        for key in params if key in self._prev_params
+                    }
+                    record["updates"] = _summary(
+                        updates, self.collect_histograms, self.bins)
+                    record["updates_span_steps"] = (
+                        it0 + k - (self._prev_params_iter or 0))
+                    record["update_param_ratio"] = {
+                        key: (record["updates"][key]["mean_magnitude"]
+                              / max(record["parameters"][key]
+                                    ["mean_magnitude"], 1e-12))
+                        for key in updates
+                    }
+                self._prev_params = params
+                self._prev_params_iter = it0 + k
+            self.storage.put_record(record)
 
     def on_epoch_start(self, model) -> None:
         pass

@@ -203,18 +203,25 @@ class TestBundlingLegality:
         net.set_listeners(per_epoch)
         assert pipeline.resolve_steps_per_call(net) == 4
 
-    def test_stats_listener_forces_k1(self):
-        """StatsListener differences live params between reporting
-        iterations (update:param-ratio chart) — per-step state coupling
-        the PR-4 bundling audit must catch: attaching one forces K=1
-        instead of silently recording end-of-bundle snapshots."""
+    def test_stats_listener_bundles(self):
+        """StatsListener (default config) no longer forces K=1: the
+        per-step signals it used to snapshot from live params now arrive
+        through the in-graph telemetry stream (obs/telemetry.py), and
+        param summaries are taken at bundle granularity. Only the opt-in
+        introspection collections still block bundling — they genuinely
+        need per-step gradient/activation tensors."""
         from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
 
         stats = StatsListener(InMemoryStatsStorage(), session_id="audit")
-        assert pipeline.bundling_blockers([stats]) == [
-            "StatsListener.requires_per_step_state"]
+        assert pipeline.bundling_blockers([stats]) == []
         net = _mlp(4)
         net.set_listeners(stats)
+        assert pipeline.resolve_steps_per_call(net) == 4
+        grads = StatsListener(InMemoryStatsStorage(), session_id="audit2",
+                              collect_gradients=True)
+        assert pipeline.bundling_blockers([grads]) == [
+            "StatsListener.on_gradient_calculation"]
+        net.set_listeners(grads)
         assert pipeline.resolve_steps_per_call(net) == 1
         net.set_listeners()
         assert pipeline.resolve_steps_per_call(net) == 4
