@@ -683,12 +683,182 @@ def _bench_obs(k=16, n_batches=192, batch=32, d_in=64, d_hidden=64,
                      "pipelining win"),
         },
     }
+    # forensic-layer overheads ride the same artifact: request tracing
+    # under a serving storm (gate <= 5% p99) and the flight-recorder
+    # ring on the K=16 bundled fit (gate <= 2% steps/sec)
+    result["extra"]["tracing_ab"] = _bench_request_tracing()
+    result["extra"]["flight_recorder"] = _bench_flight_overhead(
+        batches, k=k, epochs=epochs)
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_obs.json")
     with open(out_path + ".tmp", "w") as f:
         json.dump(result, f, indent=1)
     os.replace(out_path + ".tmp", out_path)
     return result
+
+
+def _bench_request_tracing(n_clients: int = 4, n_requests: int = 60,
+                           max_size: int = 16, batch_limit: int = 32,
+                           rounds: int = 10):
+    """Per-request tracing A/B: the SAME warmed bucketed engine stormed
+    through two batchers — request tracing on vs off — with the
+    latencies POOLED across interleaved rounds and the quantiles taken
+    over each pooled set. On this 2-core box a storm's p99 is
+    scheduler-dominated and swings 10x round to round; interleaving
+    spreads that noise over both arms equally, and pooling ~1.4k
+    samples/arm makes the quantile stable where best-of-round was not.
+    The trace itself is ~6 monotonic reads plus a ring append per
+    request, so the p99 cost must stay <= 5% (the ISSUE 7 CI gate); the
+    padded/real row counters always run (they are the pad-waste metric,
+    not part of the tracing knob)."""
+    import threading
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        BucketPolicy,
+        DynamicBatcher,
+        InferenceEngine,
+        TraceBuffer,
+    )
+    from deeplearning4j_tpu.serving.batcher import make_dispatcher
+    from deeplearning4j_tpu.updaters import Adam
+
+    d_in, d_hidden, d_out = 128, 256, 10
+    conf = (NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=d_hidden, activation="relu"))
+            .layer(OutputLayer(n_out=d_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d_in)).build())
+    engine = InferenceEngine(MultiLayerNetwork(conf).init(),
+                             buckets=BucketPolicy(max_batch=batch_limit))
+    engine.warmup()
+    rng = np.random.default_rng(0)
+    inputs = {n: rng.standard_normal((n, d_in)).astype(np.float32)
+              for n in range(1, max_size + 1)}
+
+    def storm(tracing: bool) -> list:
+        traces = TraceBuffer(256) if tracing else None
+        batcher = DynamicBatcher(
+            make_dispatcher(engine.infer_versioned, metrics=engine.metrics,
+                            traces=traces),
+            batch_limit=batch_limit, max_wait_ms=2.0, queue_limit=4096,
+            metrics=engine.metrics, trace_requests=tracing)
+        lats = []
+        lock = threading.Lock()
+
+        def client(tid):
+            crng = np.random.default_rng(100 + tid)
+            mine = []
+            for _ in range(n_requests):
+                n = int(crng.integers(1, max_size + 1))
+                t0 = time.perf_counter()
+                batcher.submit(inputs[n]).result(timeout=120)
+                mine.append(time.perf_counter() - t0)
+            with lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        batcher.shutdown()
+        return lats
+
+    import gc
+
+    pooled = {False: [], True: []}
+    for _ in range(rounds):
+        for arm in (False, True):
+            # GC pauses on this 2-core box land on random requests and
+            # dominate an un-collected p99; collecting at round
+            # boundaries keeps the pause out of both arms' storms
+            gc.collect()
+            pooled[arm].extend(storm(arm))
+
+    def quantiles(lats: list) -> dict:
+        lats = sorted(lats)
+        n = len(lats)
+
+        def q(p):
+            return round(lats[min(int(p * n), n - 1)] * 1e3, 3)
+
+        return {"samples": n, "p50_ms": q(0.50), "p90_ms": q(0.90),
+                "p99_ms": q(0.99)}
+
+    off = quantiles(pooled[False])
+    on = quantiles(pooled[True])
+    overhead_pct = round((on["p99_ms"] / off["p99_ms"] - 1.0) * 100.0, 2)
+    return {
+        "tracing_off": off,
+        "tracing_on": on,
+        "p99_overhead_pct": overhead_pct,
+        "gate": "p99 overhead <= 5%",
+        "gate_pass": bool(overhead_pct <= 5.0),
+    }
+
+
+def _bench_flight_overhead(batches, k: int = 16, epochs: int = 3):
+    """Flight-recorder ring overhead on the K-bundled fit: the same MLP
+    trained bare vs with a FlightRecorderListener (private ring, no dump
+    directory — the claim under test is the RING, not dump IO).
+    Interleaved best-of-3; gate <= 2% steps/sec at K=16."""
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs.flight import (
+        FlightRecorder,
+        FlightRecorderListener,
+    )
+    from deeplearning4j_tpu.updaters import Adam
+
+    n_batches = len(batches)
+    d_in = batches[0].features.shape[1]
+
+    def build(flight: bool):
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(1e-3)).steps_per_call(k).list()
+                .layer(DenseLayer(n_out=64, activation="relu"))
+                .layer(OutputLayer(n_out=batches[0].labels.shape[1],
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        net = MultiLayerNetwork(conf).init()
+        if flight:
+            net.add_listeners(FlightRecorderListener(
+                recorder=FlightRecorder(capacity=2048)))
+        it = ExistingDataSetIterator(batches)
+        net.fit(it, epochs=1)  # warmup
+        float(net.score_)
+        return net, it
+
+    def timed(net, it):
+        t0 = time.perf_counter()
+        net.fit(it, epochs=epochs)
+        float(net.score_)
+        return epochs * n_batches / (time.perf_counter() - t0)
+
+    net_off, it_off = build(False)
+    net_on, it_on = build(True)
+    off_sps = on_sps = 0.0
+    for _ in range(5):  # interleaved best-of-5: the ring's real cost is
+        # well under this box's ±3% run-to-run drift, so the per-arm max
+        # needs the extra rounds to converge
+        off_sps = max(off_sps, timed(net_off, it_off))
+        on_sps = max(on_sps, timed(net_on, it_on))
+    overhead_pct = round((1.0 - on_sps / off_sps) * 100.0, 2)
+    return {
+        "steps_per_sec": {"flight_off": round(off_sps, 1),
+                          "flight_on": round(on_sps, 1)},
+        "overhead_pct": overhead_pct,
+        "k": k,
+        "gate": "steps/sec overhead <= 2% at K=16",
+        "gate_pass": bool(overhead_pct <= 2.0),
+    }
 
 
 def _bench_tune(n_trials=8, steps=96, k=8, n_batches=24, batch=32,

@@ -12,6 +12,10 @@ Usage:
         --batch-limit 32 --max-wait-ms 5
     # --model: zoo name (fresh weights — smoke), checkpoint zip, or a
     # checkpoint DIRECTORY (newest valid checkpoint; /reload re-polls it)
+
+    python -m deeplearning4j_tpu.cli flight-dump /ckpts
+    # read a flight-recorder black box (file, or the newest
+    # flight_recorder_*.json in a directory) as a human timeline
 """
 
 from __future__ import annotations
@@ -185,6 +189,14 @@ def serve_main(argv) -> int:
             rep = engine.warmup()
             print(f"warmup: {rep['shapes']} shapes, {rep['compiles']} "
                   f"compiles, {rep['seconds']}s", flush=True)
+            # hardware-efficiency gauges for the warmed forward: FLOPs/
+            # bytes/peak-memory of the top bucket + a serving MFU gauge
+            # driven by the measured request rate (obs/cost.py)
+            cost = engine.publish_cost_metrics()
+            if "error" not in cost:
+                print(f"cost: {cost.get('flops_per_example', 0):.3e} "
+                      f"FLOPs/example at bucket {cost['bucket']} "
+                      "(MFU gauge live on /metrics)", flush=True)
 
     server = InferenceServer(
         engine, host=args.host, port=args.port,
@@ -218,6 +230,43 @@ def serve_main(argv) -> int:
     except KeyboardInterrupt:
         print("shutting down (draining queue)", flush=True)
         server.shutdown()
+    return 0
+
+
+def flight_dump_main(argv) -> int:
+    """``flight-dump`` subcommand: render a flight-recorder dump
+    (obs/flight.py) as a human-readable event timeline — the postmortem
+    reader for a diverged/killed run's black box."""
+    import json as _json
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu flight-dump",
+        description="Read a flight-recorder dump: one line per event, "
+                    "newest last",
+    )
+    ap.add_argument("path",
+                    help="dump file, or a directory (e.g. the checkpoint "
+                         "dir) holding flight_recorder_*.json")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the newest N events")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON body instead of the rendered timeline")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.obs.flight import find_dump, format_dump
+
+    try:
+        path = find_dump(args.path)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    with open(path) as f:
+        body = _json.load(f)
+    if args.json:
+        print(_json.dumps(body, indent=1))
+    else:
+        print(f"{path}:")
+        print(format_dump(body, last=args.last))
     return 0
 
 
@@ -366,6 +415,8 @@ def main(argv=None) -> int:
         return serve_main(argv[1:])
     if argv[:1] == ["tune"]:
         return tune_main(argv[1:])
+    if argv[:1] == ["flight-dump"]:
+        return flight_dump_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",
@@ -408,7 +459,19 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="expose training metrics over HTTP on this port "
                          "(GET /metrics: JSON, or Prometheus text via "
-                         "Accept/?format=prometheus); implies --telemetry")
+                         "Accept/?format=prometheus, plus /debug/flight "
+                         "and /debug/profile); implies --telemetry")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight recorder black box: record training "
+                         "events into a bounded ring and dump them here "
+                         "on divergence/fatal exit/SIGTERM and every 30s "
+                         "(default: --checkpoint-dir when set; read dumps "
+                         "with the flight-dump subcommand)")
+    ap.add_argument("--cost-report", action="store_true",
+                    help="publish static FLOPs/bytes/peak-memory and MFU "
+                         "gauges for the compiled train step (implies "
+                         "--telemetry metrics accounting; pair with "
+                         "--metrics-port to scrape them)")
     ap.add_argument("--skip-nonfinite", action="store_true",
                     help="fault tolerance: skip (don't apply) any step "
                          "whose global gradient is non-finite, and enable "
@@ -467,23 +530,44 @@ def main(argv=None) -> int:
     # off the configuration each epoch
     model.conf.global_conf.steps_per_call = args.steps_per_call
     model.conf.global_conf.async_queue_size = args.queue_size
-    if args.telemetry or args.metrics_port is not None:
+    if args.telemetry or args.metrics_port is not None or args.cost_report:
         model.conf.global_conf.telemetry = True
     print(f"model={args.model} ({model.num_params():,} params) "
           f"dataset={args.dataset} epochs={args.epochs}", flush=True)
 
     metrics_server = None
-    if args.metrics_port is not None:
-        from deeplearning4j_tpu.obs.exporter import start_metrics_server
+    if args.metrics_port is not None or args.cost_report:
         from deeplearning4j_tpu.obs.metrics import MetricsListener
 
         # MetricsListener publishes steps/samples/loss + the telemetry
-        # stream into the process-wide registry the endpoint serves
+        # stream into the process-wide registry; --cost-report needs it
+        # too — its MFU gauge's throughput term is the
+        # train_steps_per_sec gauge this listener maintains
         model.add_listeners(MetricsListener())
+    if args.metrics_port is not None:
+        from deeplearning4j_tpu.obs.exporter import start_metrics_server
+
         metrics_server = start_metrics_server(args.metrics_port)
         print(f"metrics on http://127.0.0.1:{metrics_server.port}/metrics "
               "(JSON; Prometheus text via Accept: text/plain or "
               "?format=prometheus)", flush=True)
+
+    flight_dir = args.flight_dir or args.checkpoint_dir
+    if flight_dir is not None:
+        from deeplearning4j_tpu.obs.flight import (
+            FlightRecorderListener,
+            install_signal_dump,
+        )
+
+        # the black box lands next to the checkpoints: bounded event
+        # ring, dumped on divergence / fatal fit exit / SIGTERM, and
+        # every 30s so even SIGKILL leaves an at-most-30s-stale dump
+        model.add_listeners(FlightRecorderListener(directory=flight_dir))
+        try:
+            install_signal_dump()
+        except ValueError:
+            pass  # not on the main thread (embedded use); periodic +
+            # exception dumps still cover the black-box contract
 
     storage = None
     if args.stats or args.dashboard:
@@ -507,6 +591,26 @@ def main(argv=None) -> int:
         model.add_listeners(CheckpointListener(
             args.checkpoint_dir, save_every_n_epochs=1,
             keep_mode="last", keep_last=args.keep_last))
+
+    if args.cost_report:
+        from deeplearning4j_tpu.obs import cost as _cost
+
+        # static cost sheet of the compiled step (published before the
+        # fit so the MFU gauge is scrapeable for the whole run; the
+        # throughput term fills in once MetricsListener starts
+        # publishing steps/sec)
+        sample = next(iter(it))
+        it.reset()
+        rep = _cost.publish_train_cost(model, sample,
+                                       steps_per_call=args.steps_per_call)
+        if "error" in rep:
+            print(f"cost-report unavailable: {rep['error']}", flush=True)
+        else:
+            print(f"cost-report: {rep.get('flops_per_step', 0):.3e} "
+                  f"FLOPs/step, {rep.get('bytes_per_step', 0):.3e} "
+                  f"bytes/step, peak memory "
+                  f"{rep.get('peak_memory_bytes', 0):,} bytes "
+                  f"(K={rep['steps_per_call']})", flush=True)
 
     t0 = time.time()
     if args.workers > 1:

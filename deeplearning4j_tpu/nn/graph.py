@@ -603,7 +603,7 @@ class ComputationGraph:
         if policy is not None:
             from deeplearning4j_tpu.train import faults as _faults
 
-            _faults.check_fault_state(policy, self.fault_state_)
+            _faults.check_fault_state(policy, self.fault_state_, owner=self)
         if telem is not None:
             from deeplearning4j_tpu.obs import telemetry as _telemetry
 
@@ -672,7 +672,7 @@ class ComputationGraph:
         self.score_ = scores[-1]
         self.last_batch_size = int(feats[0].shape[1])
         if policy is not None:
-            _faults.check_fault_state(policy, self.fault_state_)
+            _faults.check_fault_state(policy, self.fault_state_, owner=self)
         _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores,
                                             telem=telem)
 

@@ -651,7 +651,7 @@ class MultiLayerNetwork:
         if policy is not None:
             from deeplearning4j_tpu.train import faults as _faults
 
-            _faults.check_fault_state(policy, self.fault_state_)
+            _faults.check_fault_state(policy, self.fault_state_, owner=self)
         if telem is not None:
             from deeplearning4j_tpu.obs import telemetry as _telemetry
 
@@ -714,7 +714,7 @@ class MultiLayerNetwork:
         self.score_ = scores[-1]
         self.last_batch_size = int(features.shape[1])
         if policy is not None:
-            _faults.check_fault_state(policy, self.fault_state_)
+            _faults.check_fault_state(policy, self.fault_state_, owner=self)
         _pipeline.dispatch_bundle_listeners(self, it0, self.epoch, scores,
                                             telem=telem)
 
@@ -876,7 +876,7 @@ class MultiLayerNetwork:
         if policy is not None:
             from deeplearning4j_tpu.train import faults as _faults
 
-            _faults.check_fault_state(policy, self.fault_state_)
+            _faults.check_fault_state(policy, self.fault_state_, owner=self)
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch)
 

@@ -21,7 +21,17 @@ pipelined training loop (train/pipeline.py) removed.
   counter so steady-state recompiles surface as a metric instead of a
   mystery slowdown.
 - :mod:`obs.exporter` — stdlib HTTP endpoint exposing a registry
-  (content-negotiated Prometheus text / JSON) during training.
+  (content-negotiated Prometheus text / JSON) during training, plus the
+  ``/debug/flight`` and ``/debug/profile`` forensic endpoints.
+- :mod:`obs.flight` — the forensic half: a bounded ring of structured
+  events (steps, NaN-skips, loss-scale changes, checkpoints, reloads,
+  rejections, retraces) dumped atomically to JSON on divergence, fit
+  exceptions, SIGTERM, a wall-clock cadence, or on demand.
+- :mod:`obs.cost` — hardware-efficiency profiling: static
+  FLOPs/bytes/peak-memory off the compiled steps
+  (``Compiled.cost_analysis``), model-FLOPs-utilization and bytes/sec
+  gauges against the measured throughput, and the guarded on-demand
+  ``jax.profiler`` capture.
 """
 
 from deeplearning4j_tpu.obs.metrics import (  # noqa: F401
@@ -31,6 +41,12 @@ from deeplearning4j_tpu.obs.metrics import (  # noqa: F401
     MetricsListener,
     MetricsRegistry,
     default_registry,
+)
+from deeplearning4j_tpu.obs.flight import (  # noqa: F401
+    FlightRecorder,
+    FlightRecorderListener,
+    default_flight_recorder,
+    install_signal_dump,
 )
 from deeplearning4j_tpu.obs.telemetry import (  # noqa: F401
     BundleTelemetry,

@@ -32,6 +32,35 @@ def wants_prometheus(accept_header: str, query: str = "") -> bool:
     return "text/plain" in accept or "openmetrics" in accept
 
 
+def debug_flight_response() -> tuple:
+    """``GET /debug/flight`` contract shared by this exporter and
+    serving/server.py: ``(status, json-ready body)`` — the live default
+    recorder ring, same payload a crash dump would contain."""
+    from deeplearning4j_tpu.obs.flight import default_flight_recorder
+
+    return 200, default_flight_recorder().snapshot()
+
+
+def debug_profile_response(query: str) -> tuple:
+    """``GET /debug/profile?ms=`` contract shared by this exporter and
+    serving/server.py: parse the capture window (default 1000 ms), run
+    one capture, map bad input to 400 and a concurrent capture to 409 —
+    one definition so the two surfaces cannot drift."""
+    from deeplearning4j_tpu.obs.cost import (
+        ProfilerBusyError,
+        profiler_capture,
+    )
+
+    try:
+        ms = float(parse_qs(query).get("ms", ["1000"])[0])
+    except ValueError as e:
+        return 400, {"error": "ValueError", "message": str(e)}
+    try:
+        return 200, profiler_capture(ms)
+    except ProfilerBusyError as e:
+        return 409, {"error": "ProfilerBusy", "message": str(e)}
+
+
 class MetricsServer:
     """Tiny threaded HTTP server: GET /metrics (negotiated), GET /healthz.
     ``port=0`` binds an ephemeral port (read back from ``.port``)."""
@@ -55,6 +84,8 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802
+                import json as _json
+
                 try:
                     url = urlparse(self.path)
                     if url.path == "/metrics":
@@ -70,6 +101,14 @@ class MetricsServer:
                     elif url.path == "/healthz":
                         self._send(200, b'{"status": "ok"}',
                                    "application/json")
+                    elif url.path == "/debug/flight":
+                        code, obj = debug_flight_response()
+                        self._send(code, _json.dumps(obj).encode(),
+                                   "application/json")
+                    elif url.path == "/debug/profile":
+                        code, obj = debug_profile_response(url.query)
+                        self._send(code, _json.dumps(obj).encode(),
+                                   "application/json")
                     else:
                         self._send(404, b'{"error": "NotFound"}',
                                    "application/json")
@@ -83,20 +122,32 @@ class MetricsServer:
         self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
+        self._started = False
+        self._closed = False
 
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
 
     def start(self) -> "MetricsServer":
+        self._started = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True, name="dl4j-tpu-metrics")
         self._thread.start()
         return self
 
     def shutdown(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        """Idempotent, and safe on a never-started server:
+        ``BaseServer.shutdown`` blocks until the serve loop acknowledges,
+        so calling it when ``serve_forever`` never ran would hang
+        forever — the double-close/never-started regression class this
+        guards (with tests)."""
+        if self._started:
+            self._started = False
+            self._httpd.shutdown()
+        if not self._closed:
+            self._closed = True
+            self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -1,0 +1,391 @@
+"""Flight recorder: a bounded ring of structured events, dumped on crash.
+
+Aggregate metrics (obs/metrics.py) answer "how is the run doing"; the
+flight recorder answers "what happened in the last N events before it
+stopped" — the black box TF-Serving-style production stacks (arXiv
+1605.08695) keep next to every training job. Every noteworthy host-side
+event — step/bundle completion with loss, NaN-skip, loss-scale change,
+checkpoint write/load, hot reload, overload rejection, jit retrace,
+profiler capture — is appended to a thread-safe fixed-size ring
+(:class:`FlightRecorder`), and the ring is dumped **atomically** to JSON
+when it matters:
+
+- on :class:`~deeplearning4j_tpu.train.faults.TrainingDivergedError`
+  (train/faults.py trips the dump before raising);
+- when ``fit()`` exits by exception (``FlightRecorderListener.on_fit_end``
+  runs in the fit paths' ``finally`` and sees the in-flight exception via
+  ``sys.exc_info``);
+- on SIGTERM (:func:`install_signal_dump` — the handler dumps, then
+  chains to the previously installed handler so default termination
+  still happens);
+- periodically (``dump_every_s``) so even a SIGKILL — which no handler
+  can observe — leaves a black box at most that many seconds stale;
+- on demand (``cli.py flight-dump`` reader, the ``/debug/flight``
+  endpoint on both HTTP surfaces, or :meth:`FlightRecorder.dump`).
+
+Recording is a dict append under a lock — nanoseconds against a device
+dispatch — and the ring bounds memory forever. Dumps rewrite ONE file
+per recorder (``flight_recorder_<pid>.json``) through the same
+tmp+``os.replace`` discipline as checkpoints, so a crash mid-dump never
+leaves a torn black box and repeated dumps don't grow the directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring.
+
+    Every event is a plain dict: ``seq`` (monotonic per recorder, never
+    reset — ``seq`` gaps in a dump reveal how much the ring dropped),
+    ``ts`` (unix seconds), ``kind``, plus the caller's fields. Values
+    must be JSON-serializable (the recorder coerces numpy scalars via
+    ``float``/``int`` at dump time rather than trusting every caller).
+    """
+
+    def __init__(self, capacity: int = 2048,
+                 dump_dir: Optional[str] = None):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+        # REENTRANT: the SIGTERM dump handler (install_signal_dump) runs
+        # on the main thread and records/dumps; if the signal lands while
+        # that same thread is inside record()'s critical section, a
+        # plain Lock would self-deadlock and the process would ignore
+        # SIGTERM instead of leaving its black box
+        self._lock = threading.RLock()
+        self._seq = 0
+        self.dump_dir = dump_dir
+        self.last_dump_path: Optional[str] = None
+
+    # -- recording -----------------------------------------------------------
+    def record(self, kind: str, **fields) -> None:
+        ev = {"seq": 0, "ts": time.time(), "kind": str(kind)}
+        ev.update(fields)
+        with self._lock:
+            ev["seq"] = self._seq
+            self._seq += 1
+            self._ring.append(ev)
+
+    # -- reading -------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def recorded_total(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def events(self, last: Optional[int] = None) -> List[dict]:
+        """Copy of the ring (oldest → newest); ``last`` keeps the tail."""
+        with self._lock:
+            evs = list(self._ring)
+        return evs if last is None else evs[-int(last):]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def snapshot(self, last: Optional[int] = None) -> dict:
+        """JSON-ready view (the ``/debug/flight`` payload and the dump
+        body share this shape)."""
+        evs = self.events(last)
+        total = self.recorded_total
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "pid": os.getpid(),
+            "snapshot_at": time.time(),
+            "capacity": self.capacity,
+            "recorded_total": total,
+            "dropped": max(total - len(evs), 0) if last is None else None,
+            "events": [_jsonable(ev) for ev in evs],
+        }
+
+    # -- dumping -------------------------------------------------------------
+    def dump_path(self, directory: Optional[str] = None) -> str:
+        d = directory or self.dump_dir or os.getcwd()
+        return os.path.join(d, f"flight_recorder_{os.getpid()}.json")
+
+    def dump(self, path: Optional[str] = None,
+             reason: str = "manual") -> Optional[str]:
+        """Atomic JSON dump of the ring; returns the path (None when the
+        ring is empty — an empty black box next to the checkpoints would
+        only mislead). Same-directory tmp + ``os.replace``, the
+        checkpoint discipline: a crash mid-dump never leaves a torn
+        file, and re-dumping overwrites in place (one black box per
+        process, always the freshest superset of events)."""
+        body = self.snapshot()
+        if not body["events"]:
+            return None
+        body["reason"] = str(reason)
+        body["dumped_at"] = time.time()
+        path = path or self.dump_path()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(body, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            # a failing dump must never mask the error being dumped
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        self.last_dump_path = path
+        return path
+
+
+def _jsonable(ev: dict) -> dict:
+    out = {}
+    for k, v in ev.items():
+        if isinstance(v, (str, int, bool)) or v is None:
+            out[k] = v
+        elif isinstance(v, float):
+            out[k] = v
+        else:
+            try:
+                out[k] = float(v)  # numpy / device scalars
+            except (TypeError, ValueError):
+                out[k] = str(v)
+    return out
+
+
+# --------------------------------------------------------------------------
+# default (process-wide) recorder
+# --------------------------------------------------------------------------
+_default: Optional[FlightRecorder] = None
+_default_lock = threading.Lock()
+
+
+def default_flight_recorder() -> FlightRecorder:
+    """The process-wide recorder every built-in event source feeds
+    (fault guard, batcher rejections, hot reloads, retraces, checkpoint
+    writes). One ring per process keeps the forensic timeline unified:
+    a serving overload right before a divergence trip shows up in ORDER
+    in one dump."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder()
+        return _default
+
+
+def record(kind: str, **fields) -> None:
+    """Record into the default recorder (the one-liner for event
+    sources)."""
+    default_flight_recorder().record(kind, **fields)
+
+
+# --------------------------------------------------------------------------
+# dump reader (cli flight-dump)
+# --------------------------------------------------------------------------
+def find_dump(path: str) -> str:
+    """Resolve a dump file from a path or a directory (the newest
+    ``flight_recorder_*.json``)."""
+    if os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        cands = [os.path.join(path, n) for n in os.listdir(path)
+                 if n.startswith("flight_recorder_")
+                 and n.endswith(".json")]
+        if cands:
+            return max(cands, key=os.path.getmtime)
+    raise FileNotFoundError(f"no flight-recorder dump at {path!r}")
+
+
+def format_dump(body: dict, last: Optional[int] = None) -> str:
+    """Human-readable rendering of a dump/snapshot body (one line per
+    event, newest last) — what ``cli.py flight-dump`` prints."""
+    lines = [
+        f"flight recorder dump: pid={body.get('pid')} "
+        f"reason={body.get('reason', 'snapshot')} "
+        f"events={len(body.get('events', []))} "
+        f"recorded_total={body.get('recorded_total')} "
+        f"dropped={body.get('dropped')}"
+    ]
+    evs = body.get("events", [])
+    if last is not None:
+        evs = evs[-int(last):]
+    for ev in evs:
+        ts = ev.get("ts")
+        stamp = (time.strftime("%H:%M:%S", time.localtime(ts))
+                 + f".{int((ts % 1) * 1e3):03d}") if ts else "--:--:--"
+        rest = " ".join(f"{k}={v}" for k, v in ev.items()
+                        if k not in ("seq", "ts", "kind"))
+        lines.append(f"  [{ev.get('seq'):>6}] {stamp} "
+                     f"{ev.get('kind', '?'):<18} {rest}".rstrip())
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# SIGTERM dump
+# --------------------------------------------------------------------------
+def install_signal_dump(recorder: Optional[FlightRecorder] = None,
+                        signum: int = signal.SIGTERM) -> Callable[[], None]:
+    """Dump the recorder when ``signum`` arrives, then chain to the
+    previously installed handler (so default termination — or a
+    supervisor's own handler — still runs). Returns an uninstall
+    callable restoring the previous handler. Main thread only (signal
+    module restriction)."""
+    rec = recorder if recorder is not None else default_flight_recorder()
+    prev = signal.getsignal(signum)
+
+    def handler(sig, frame):
+        rec.record("signal", signum=int(sig))
+        rec.dump(reason=f"signal_{int(sig)}")
+        if callable(prev):
+            prev(sig, frame)
+        elif prev == signal.SIG_DFL:
+            # re-deliver with the default disposition: the process still
+            # dies of SIGTERM (exit status intact for supervisors)
+            signal.signal(sig, signal.SIG_DFL)
+            os.kill(os.getpid(), sig)
+
+    signal.signal(signum, handler)
+
+    def uninstall():
+        signal.signal(signum, prev)
+
+    return uninstall
+
+
+# --------------------------------------------------------------------------
+# training listener
+# --------------------------------------------------------------------------
+class FlightRecorderListener:
+    """Feeds training progress into a :class:`FlightRecorder` and owns
+    the dump-on-exit triggers.
+
+    Sync-free by the train/pipeline.py discipline: every step/bundle is
+    recorded from host-side bookkeeping (iteration, k, epoch — no device
+    read); the loss is attached only on ``loss_frequency`` boundaries,
+    and under bundling via the shared once-per-bundle ``BundleScores``
+    host fetch. Loss-scale changes are detected from the in-graph
+    telemetry stream on the same sampled fetches (a model without a
+    TelemetryConf records everything else, just not scale changes).
+
+    ``directory`` arms the black-box behavior: it becomes the recorder's
+    ``dump_dir`` (point it at the checkpoint directory), ``on_fit_end``
+    dumps when fit exits by exception, and ``dump_every_s`` keeps an
+    at-most-that-stale dump on disk so even SIGKILL leaves evidence.
+    """
+
+    def __init__(self, recorder: Optional[FlightRecorder] = None,
+                 directory: Optional[str] = None,
+                 loss_frequency: int = 100,
+                 dump_every_s: Optional[float] = 30.0):
+        # explicit None test: an EMPTY FlightRecorder is len()==0 falsy,
+        # so `recorder or default` would silently discard a fresh ring
+        self.recorder = (recorder if recorder is not None
+                         else default_flight_recorder())
+        self.loss_frequency = max(int(loss_frequency), 1)
+        self.directory = directory
+        if directory is not None:
+            self.recorder.dump_dir = directory
+        self.dump_every_s = (None if dump_every_s is None
+                             else float(dump_every_s))
+        self._last_dump_t = time.monotonic()
+        self._last_scale: Optional[float] = None
+        self._pending_telem = None
+        # exception already in flight when the fit STARTED (a recovery
+        # fit inside an `except TrainingDivergedError:` block) — must
+        # not be mistaken for this fit dying (see on_fit_end)
+        self._ambient_exc = None
+
+    # -- periodic black box --------------------------------------------------
+    def _maybe_dump(self) -> None:
+        if self.dump_every_s is None or self.directory is None:
+            return
+        now = time.monotonic()
+        if now - self._last_dump_t >= self.dump_every_s:
+            self._last_dump_t = now
+            self.recorder.dump(reason="periodic")
+
+    def _check_scale(self, host: Dict, j: int) -> None:
+        if "loss_scale" not in host:
+            return
+        scale = float(host["loss_scale"][j])
+        if self._last_scale is not None and scale != self._last_scale:
+            self.recorder.record("loss_scale_change",
+                                 scale_from=self._last_scale,
+                                 scale_to=scale)
+        self._last_scale = scale
+
+    # -- listener hooks ------------------------------------------------------
+    def telemetry_done(self, model, it0, epoch, telem) -> None:
+        # held until the score hook decides whether this is a sampling
+        # boundary — off-frequency bundles must fetch nothing
+        self._pending_telem = telem
+
+    def iteration_done(self, model, iteration, epoch) -> None:
+        telem, self._pending_telem = self._pending_telem, None
+        ev = {"iteration": int(iteration), "epoch": int(epoch)}
+        if iteration % self.loss_frequency == 0:
+            if telem is not None:
+                self._check_scale(telem.host(), -1)
+            if getattr(model, "score_", None) is not None:
+                ev["loss"] = float(model.score_)
+        self.recorder.record("step", **ev)
+        self._maybe_dump()
+
+    def bundle_done(self, model, it0, epoch, scores) -> None:
+        telem, self._pending_telem = self._pending_telem, None
+        k = len(scores)
+        ev = {"it0": int(it0), "k": int(k), "epoch": int(epoch)}
+        hits = [j for j in range(k)
+                if (it0 + j + 1) % self.loss_frequency == 0]
+        if hits:
+            ev["loss"] = float(scores.host()[hits[-1]])
+            ev["loss_iteration"] = int(it0 + hits[-1] + 1)
+            if telem is not None:
+                self._check_scale(telem.host(), hits[-1])
+        self.recorder.record("bundle", **ev)
+        self._maybe_dump()
+
+    def on_epoch_start(self, model) -> None:
+        self._ambient_exc = sys.exc_info()[1]
+        self.recorder.record("epoch_start", epoch=int(model.epoch))
+
+    def on_epoch_end(self, model) -> None:
+        self.recorder.record("epoch_end", epoch=int(model.epoch),
+                             iteration=int(model.iteration))
+
+    def on_fit_end(self, model) -> None:
+        """Runs in the fit paths' ``finally`` (train/listeners.py
+        ``dispatch_fit_end``), so ``sys.exc_info`` still carries the
+        in-flight exception when fit is dying — the black-box moment.
+        An exception that was ALREADY in flight at epoch start (a clean
+        recovery fit running inside an ``except`` block) is ambient
+        context, not this fit failing."""
+        exc = sys.exc_info()[1]
+        if exc is self._ambient_exc:
+            exc = None
+        if exc is None:
+            self.recorder.record("fit_end",
+                                 iteration=int(model.iteration),
+                                 epoch=int(model.epoch))
+        else:
+            self.recorder.record("fit_exception",
+                                 error=type(exc).__name__,
+                                 message=str(exc)[:500],
+                                 iteration=int(model.iteration),
+                                 epoch=int(model.epoch))
+        if self.directory is not None or self.recorder.dump_dir is not None:
+            # dump on EVERY fit exit (clean or fatal): a clean run's
+            # black box is what the next incident gets diffed against,
+            # and a run SIGKILLed between fits stays covered
+            self.recorder.dump(
+                reason="fit_exception" if exc is not None else "fit_end")

@@ -203,6 +203,31 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get((name, _label_key(labels)))
 
+    def family_sum(self, name: str) -> float:
+        """Sum of a counter family's values across every label set
+        (e.g. per-bucket ``serving_real_samples_total`` → total rows).
+        Reads the counters directly — unlike ``snapshot()`` it never
+        evaluates callback gauges, so a gauge's own callback may call it
+        without recursing into itself."""
+        with self._lock:
+            members = [m for (n, _), m in self._metrics.items()
+                       if n == name]
+        return float(sum(m.value() for m in members))
+
+    def family_values(self, name: str) -> Dict[str, float]:
+        """One family's per-label-set values as ``{label-string: value}``
+        (the same label strings ``snapshot()`` uses). Like
+        :meth:`family_sum` this reads the members directly — a caller
+        after one counter family must not evaluate every callback gauge
+        (rate closures consume their scrape window as a side effect) or
+        compute every histogram's quantiles the way ``snapshot()``
+        does."""
+        with self._lock:
+            members = [(lkey, m) for (n, lkey), m in self._metrics.items()
+                       if n == name]
+        return {",".join(f"{k}={v}" for k, v in lkey): float(m.value())
+                for lkey, m in members}
+
     # -- reading -------------------------------------------------------------
     def _series(self) -> Iterable[Tuple[str, str, str, _LabelKey, object]]:
         with self._lock:

@@ -48,6 +48,12 @@ def count_retraces(name: str, fn: Callable,
     @functools.wraps(fn)
     def traced(*args, **kwargs):
         counter.inc()
+        # same trace-time-only side effect into the flight recorder: a
+        # steady-state recompile shows up in the black box ordered
+        # against the steps it stalled
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("retrace", fn=name)
         return fn(*args, **kwargs)
 
     return traced

@@ -162,7 +162,7 @@ class ParallelInference:
         x = np.asarray(x)
         t_orig = x.shape[1] if x.ndim >= 3 else None
         xp, mp, n = self._buckets.pad_batch(x, mask)
-        self.metrics.record_dispatch(xp.shape[0])
+        self.metrics.record_dispatch(xp.shape[0], real_rows=n)
         y = self.model.output(xp, mask=mp)
         return slice_result(y, n, t_orig,
                             xp.shape[1] if t_orig is not None else None)

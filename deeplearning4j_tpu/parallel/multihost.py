@@ -477,7 +477,7 @@ class MultiHostNetwork:
                     if guarded:
                         from deeplearning4j_tpu.train import faults as _faults
 
-                        _faults.check_fault_state(policy, m.fault_state_)
+                        _faults.check_fault_state(policy, m.fault_state_, owner=m)
                     if stats is not None:
                         jax.block_until_ready(m.score_)
                         stats.append({

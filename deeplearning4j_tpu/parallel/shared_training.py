@@ -462,7 +462,7 @@ class SharedTrainingMaster:
             if policy is not None:
                 from deeplearning4j_tpu.train import faults as _faults
 
-                _faults.check_fault_state(policy, model.fault_state_)
+                _faults.check_fault_state(policy, model.fault_state_, owner=model)
 
         try:
             for _ in range(epochs):

@@ -658,7 +658,8 @@ class DistributedLMTrainer:
             self.model.iteration += k
             self.model.score_ = scores[-1]
             # divergence tripwire once per bundle, on the final consec
-            _faults.check_fault_state(self._policy, self.fault_state_)
+            _faults.check_fault_state(self._policy, self.fault_state_,
+                                      owner=self)
         else:
             with self.mesh.mesh, _obs_trace.step_span(
                     "lm_train_bundle", self.model.iteration):
@@ -691,7 +692,8 @@ class DistributedLMTrainer:
                     jnp.asarray(targets, jnp.int32),
                     jnp.asarray(self.model.iteration, jnp.int32),
                 )
-            _faults.check_fault_state(self._policy, self.fault_state_)
+            _faults.check_fault_state(self._policy, self.fault_state_,
+                                      owner=self)
         else:
             with self.mesh.mesh, _obs_trace.step_span(
                     "lm_train", self.model.iteration):

@@ -420,7 +420,7 @@ class ParallelWrapper:
             if guarded:
                 from deeplearning4j_tpu.train import faults as _faults
 
-                _faults.check_fault_state(policy, m.fault_state_)
+                _faults.check_fault_state(policy, m.fault_state_, owner=m)
 
         try:
             for _ in range(epochs):
@@ -525,7 +525,7 @@ class ParallelWrapper:
         if guarded:
             from deeplearning4j_tpu.train import faults as _faults
 
-            _faults.check_fault_state(policy, m.fault_state_)
+            _faults.check_fault_state(policy, m.fault_state_, owner=m)
         for lst in m.listeners:
             lst.iteration_done(m, m.iteration, m.epoch)
 

@@ -17,8 +17,11 @@ the training stack produces crash-safe checkpoints
   hook, ``warmup()``, atomic hot-swap reload from
   ``faults.latest_valid_checkpoint``.
 - :mod:`server` — stdlib HTTP front-end (JSON + raw-npy predict,
-  /healthz, /reload, /metrics).
-- :mod:`metrics` — thread-safe serving counters + latency quantiles.
+  /healthz, /reload, /metrics, /trace, /debug/flight, /debug/profile).
+- :mod:`metrics` — thread-safe serving counters + latency quantiles +
+  per-bucket pad-waste ratios.
+- :mod:`rtrace` — per-request stage timelines (enqueue → batch →
+  dispatch → slice → respond) and the bounded /trace buffer.
 """
 
 from deeplearning4j_tpu.serving.batcher import (
@@ -32,6 +35,7 @@ from deeplearning4j_tpu.serving.batcher import (
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.rtrace import RequestTrace, TraceBuffer
 from deeplearning4j_tpu.serving.server import InferenceServer
 
 __all__ = [
@@ -41,8 +45,10 @@ __all__ = [
     "InferenceRequest",
     "InferenceServer",
     "RequestDeadlineExceeded",
+    "RequestTrace",
     "ServerOverloadedError",
     "ServerShutdownError",
     "ServingError",
     "ServingMetrics",
+    "TraceBuffer",
 ]

@@ -41,6 +41,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.serving import rtrace
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
 
@@ -70,14 +71,17 @@ class InferenceRequest:
     """
 
     __slots__ = ("x", "mask", "deadline", "enqueued_at", "_event", "_lock",
-                 "result_", "error_", "model_version")
+                 "result_", "error_", "model_version", "trace")
 
-    def __init__(self, x, mask=None, deadline: Optional[float] = None):
+    def __init__(self, x, mask=None, deadline: Optional[float] = None,
+                 trace: bool = False):
         self.x = np.asarray(x)
         self.mask = None if mask is None else np.asarray(mask)
         #: absolute time.monotonic() deadline, or None
         self.deadline = deadline
         self.enqueued_at = time.monotonic()
+        #: per-request stage timeline (serving/rtrace.py), or None
+        self.trace = rtrace.RequestTrace() if trace else None
         self._event = threading.Event()
         self._lock = threading.Lock()
         self.result_: Optional[np.ndarray] = None
@@ -128,7 +132,8 @@ class InferenceRequest:
 
 
 def make_dispatcher(infer: Callable[..., np.ndarray],
-                    metrics: Optional[ServingMetrics] = None
+                    metrics: Optional[ServingMetrics] = None,
+                    traces: Optional["rtrace.TraceBuffer"] = None
                     ) -> Callable[[List[InferenceRequest]], None]:
     """Standard dispatch: group coalesced requests by compatible shape
     (same per-row shape, same mask presence/shape), concatenate each
@@ -141,6 +146,12 @@ def make_dispatcher(infer: Callable[..., np.ndarray],
     each request before completion so callers can attribute results to
     the exact model snapshot that computed them, even across a
     concurrent hot reload.
+
+    Requests carrying a :class:`~serving.rtrace.RequestTrace` get their
+    dispatch/forward/slice marks stamped here, with bucket and
+    pad-waste facts flowing back from the engine through the rtrace
+    dispatch context; completed timelines land in ``traces`` (the
+    ``GET /trace`` window).
     """
 
     def signature(r: InferenceRequest):
@@ -157,8 +168,19 @@ def make_dispatcher(infer: Callable[..., np.ndarray],
                 x = np.concatenate([r.x for r in reqs], axis=0)
                 mask = (None if reqs[0].mask is None
                         else np.concatenate([r.mask for r in reqs], axis=0))
+            traced = [r for r in reqs if r.trace is not None]
+            info = None
+            if traced:
+                info = rtrace.begin_dispatch()
+                t_ds = time.monotonic()
+                for r in traced:
+                    r.trace.mark("dispatch_start", t_ds)
             try:
-                out = infer(x, mask)
+                try:
+                    out = infer(x, mask)
+                finally:
+                    if traced:
+                        rtrace.end_dispatch()
             except BaseException as e:
                 if metrics is not None:
                     metrics.record_error()
@@ -168,16 +190,35 @@ def make_dispatcher(infer: Callable[..., np.ndarray],
             version = None
             if isinstance(out, tuple):
                 out, version = out
+            if traced:
+                now = time.monotonic()
+                padded = info.rows_padded
+                real = info.rows_real
+                waste = (None if not padded or real is None
+                         else round((padded - real) / padded, 4))
+                for r in traced:
+                    r.trace.mark("forward_done", info.t_forward_done or now)
+                    r.trace.mark("sliced", info.t_sliced or now)
+                    r.trace.note(
+                        rows=r.rows, bucket=info.bucket,
+                        batch_rows_real=real, batch_rows_padded=padded,
+                        pad_waste=waste, model_version=version,
+                        seq_real=info.seq_real, seq_padded=info.seq_padded)
             off = 0
             now = time.monotonic()
             for r in reqs:
                 n = r.rows
                 r.model_version = version  # before finish: the waiter
                 # reads it as soon as the event fires
+                if r.trace is not None:
+                    r.trace.mark("respond")
                 r.finish(out[off:off + n])
                 off += n
                 if metrics is not None:
                     metrics.record_latency(now - r.enqueued_at)
+                if traces is not None and r.trace is not None:
+                    traces.add(r.trace)  # object ref; timeline built at
+                    # /trace read time, off the worker thread
 
     return dispatch
 
@@ -186,13 +227,18 @@ class DynamicBatcher:
     def __init__(self, dispatch: Callable[[List[InferenceRequest]], None],
                  batch_limit: int = 32, max_wait_ms: float = 5.0,
                  queue_limit: int = 64,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 trace_requests: bool = False):
         self._dispatch = dispatch
         self.batch_limit = max(int(batch_limit), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self._queue: "queue.Queue[InferenceRequest]" = queue.Queue(
             maxsize=max(int(queue_limit), 1))
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        #: default for ``submit(trace=None)``: stamp a stage timeline on
+        #: every request (the HTTP server turns this on so /trace always
+        #: has a recent window; per-request opt-in/out overrides)
+        self.trace_requests = bool(trace_requests)
         self._shutdown = False
         self._pending: Optional[InferenceRequest] = None  # worker-only slot
         self._worker = threading.Thread(
@@ -203,22 +249,28 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
-    def submit(self, x, mask=None, timeout: Optional[float] = None
-               ) -> InferenceRequest:
+    def submit(self, x, mask=None, timeout: Optional[float] = None,
+               trace: Optional[bool] = None) -> InferenceRequest:
         """Enqueue a request; returns immediately (block on
         ``req.result()``). ``timeout`` sets the request's deadline —
         enforced both while queued (expired requests are dropped, not
-        dispatched) and by ``result``'s wait."""
+        dispatched) and by ``result``'s wait. ``trace`` overrides the
+        batcher's ``trace_requests`` default for this request."""
         if self._shutdown:
             raise ServerShutdownError("server is shut down")
         req = InferenceRequest(
             x, mask,
             deadline=None if timeout is None
-            else time.monotonic() + float(timeout))
+            else time.monotonic() + float(timeout),
+            trace=self.trace_requests if trace is None else bool(trace))
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             self.metrics.record_reject()
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("overload_reject", rows=req.rows,
+                           queue_limit=self._queue.maxsize)
             raise ServerOverloadedError(
                 f"request queue full ({self._queue.maxsize} requests); "
                 "retry with backoff or scale out") from None
@@ -281,6 +333,10 @@ class DynamicBatcher:
                 live.append(r)
             if not live:
                 continue
+            t_assembled = time.monotonic()
+            for r in live:
+                if r.trace is not None:
+                    r.trace.mark("batch_assembled", t_assembled)
             try:
                 self._dispatch(live)
                 for r in live:

@@ -136,6 +136,9 @@ class InferenceEngine:
         eng = cls(model, **kwargs)
         eng._snap.source = path
         eng._fingerprint = cls._path_fingerprint(path)
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("checkpoint_load", path=str(path), surface="serving")
         return eng
 
     @staticmethod
@@ -180,9 +183,15 @@ class InferenceEngine:
             # (= per compiled XLA program). Never executes at run time.
             # Mirrored into the metrics registry (obs/trace.py retrace
             # monitor), so steady-state serving recompiles are a
-            # scrapeable counter, not just an in-process int.
+            # scrapeable counter, not just an in-process int — and into
+            # the flight recorder, so a recompile storm shows up in the
+            # black box ordered against the requests it slowed down.
             self._compile_count += 1
             retraces.inc()
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("retrace", fn="serving_forward",
+                           shape=str(tuple(x.shape)))
             y, _, _, _, _ = model._forward(params, state, x, train=False,
                                            rng=None, fmask=fmask)
             return y
@@ -255,13 +264,23 @@ class InferenceEngine:
         return self._infer_on(snap, x, mask), snap.version
 
     def _infer_on(self, snap: "_Snapshot", x, mask=None) -> np.ndarray:
+        import time as _time
+
         from deeplearning4j_tpu.obs import trace as _trace
+        from deeplearning4j_tpu.serving import rtrace as _rtrace
 
         x = np.asarray(x)
         t_orig = x.shape[1] if x.ndim >= 3 else None
         xp, mp, n = self.buckets.pad_batch(x, mask)
         t_padded = xp.shape[1] if t_orig is not None else None
-        self.metrics.record_dispatch(xp.shape[0])
+        self.metrics.record_dispatch(xp.shape[0], real_rows=n)
+        info = _rtrace.current_dispatch()
+        if info is not None:
+            info.bucket = int(xp.shape[0])
+            info.rows_real = int(n)
+            info.rows_padded = int(xp.shape[0])
+            info.seq_real = t_orig
+            info.seq_padded = t_padded
         with _trace.span("serving_dispatch"):
             if snap.fn is None:
                 m = snap.model
@@ -278,9 +297,17 @@ class InferenceEngine:
                     if mp is not None:
                         md = jax.device_put(mp, self.mesh.batch_sharded())
                 y = snap.fn(snap.params, snap.state, xd, md)
+        if info is not None:
+            # async backends return from the dispatch before the device
+            # finishes; the remaining device wait lands in the "slice"
+            # interval (the first host read below blocks on it)
+            info.t_forward_done = _time.monotonic()
         from deeplearning4j_tpu.serving.buckets import slice_result
 
-        return slice_result(y, n, t_orig, t_padded)
+        out = slice_result(y, n, t_orig, t_padded)
+        if info is not None:
+            info.t_sliced = _time.monotonic()
+        return out
 
     # -- warmup -------------------------------------------------------------
     def _warm_snapshot(self, snap: "_Snapshot",
@@ -317,6 +344,71 @@ class InferenceEngine:
             "compiles": self._compile_count - before,
             "seconds": round(time.perf_counter() - t0, 3),
         }
+
+    # -- hardware-efficiency profile ----------------------------------------
+    def publish_cost_metrics(self, example_shape: Optional[Sequence[int]]
+                             = None, bucket: Optional[int] = None
+                             ) -> dict:
+        """Static cost sheet of the serving forward (obs/cost.py):
+        lower+compile the snapshot's jitted forward at ``bucket``
+        (default: the largest batch bucket — the shape a loaded server
+        actually runs) and publish FLOPs / bytes-accessed / peak-memory
+        gauges plus a serving MFU gauge into this engine's metrics
+        registry. The MFU throughput term is the measured
+        ``serving_real_samples_total`` rate — REAL dispatched rows, so
+        bucket pad waste counts against utilization, exactly as it
+        should.
+        Call once after ``warmup()`` (re-lowering per request would
+        re-trace); returns the analysis dict."""
+        from deeplearning4j_tpu.obs import cost as _cost
+
+        snap = self._snap
+        if snap.fn is None:
+            return {"error": f"{type(snap.model).__name__} serves through "
+                             "the generic output path; no compiled "
+                             "forward to analyze"}
+        shape = (tuple(example_shape) if example_shape is not None
+                 else self.example_shape())
+        if shape is None:
+            return {"error": "cannot infer the per-example input shape; "
+                             "pass example_shape=..."}
+        b = int(bucket) if bucket is not None else self.buckets.batch_buckets[-1]
+        seq = self.buckets.seq_buckets is not None and len(shape) >= 2
+        if seq:
+            # the time axis pads to a seq bucket at dispatch — analyze
+            # the program the server actually runs, not a never-served
+            # raw-T shape (which would also compile a fresh executable
+            # right after warmup closed the shape set)
+            shape = (self.buckets.seq_bucket_for(shape[0]),) + tuple(
+                shape[1:])
+        full = (b,) + tuple(shape)
+        x = np.zeros(full, np.float32)
+        mask = np.ones(full[:2], np.float32) if seq else None
+        out = _cost.compiled_analysis(snap.fn, snap.params, snap.state,
+                                      x, mask)
+        out["bucket"] = b
+        if "error" in out:
+            return out
+        reg = self.metrics.registry
+        _cost.publish_step_cost(reg, "serving", out,
+                                labels={"bucket": str(b)})
+        flops_per_example = float(out.get("flops", 0.0)) / b
+        bytes_per_example = float(out.get("bytes_accessed", 0.0)) / b
+        out["flops_per_example"] = flops_per_example
+        _cost.publish_utilization(
+            reg, "serving",
+            flops_per_unit=flops_per_example,
+            bytes_per_unit=bytes_per_example,
+            # REAL rows dispatched (all buckets), counted by the engine
+            # itself — covers batcher traffic AND direct infer callers,
+            # and excludes padding rows from "useful FLOPs"
+            units_per_sec=_cost.family_rate_fn(
+                reg, "serving_real_samples_total"))
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("cost_published", step="serving", bucket=b,
+                       flops_per_example=flops_per_example)
+        return out
 
     # -- hot reload ---------------------------------------------------------
     def reload(self, source: Optional[str] = None, force: bool = False
@@ -391,6 +483,10 @@ class InferenceEngine:
             self._snap = snap  # the atomic publish
             self._fingerprint = fp
             self.metrics.record_reload()
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("hot_reload", version=snap.version,
+                           path=str(path), same_arch=bool(same_arch))
             return {"reloaded": True, "version": snap.version, "path": path,
                     "same_arch": bool(same_arch),
                     "checkpoint_iteration": meta.get("iteration"),

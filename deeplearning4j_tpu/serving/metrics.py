@@ -69,11 +69,28 @@ class ServingMetrics:
     def record_error(self) -> None:
         self._errors.inc()
 
-    def record_dispatch(self, bucket: int) -> None:
+    def record_dispatch(self, bucket: int,
+                        real_rows: Optional[int] = None) -> None:
+        """One device batch launched at ``bucket`` padded rows;
+        ``real_rows`` (when the caller knows it — the engine does)
+        splits the bucket's rows into real vs padding so the per-bucket
+        pad-waste ratio is a first-class metric instead of a number the
+        dispatch path computed and threw away."""
         self._dispatches.inc()
+        lbl = {"bucket": str(int(bucket))}
         self.registry.counter(
             "serving_bucket_hits_total", "dispatches per bucket size",
-            labels={"bucket": str(int(bucket))}).inc()
+            labels=lbl).inc()
+        if real_rows is not None:
+            real = min(max(int(real_rows), 0), int(bucket))
+            self.registry.counter(
+                "serving_real_samples_total",
+                "real (request) rows dispatched, per bucket",
+                labels=lbl).inc(real)
+            self.registry.counter(
+                "serving_padded_samples_total",
+                "padding rows dispatched (bucket quantization waste), "
+                "per bucket", labels=lbl).inc(int(bucket) - real)
 
     def record_reload(self) -> None:
         self._reloads.inc()
@@ -112,11 +129,27 @@ class ServingMetrics:
 
     @property
     def bucket_hits(self) -> Dict[int, int]:
-        fam = self.registry.snapshot().get("serving_bucket_hits_total", {})
-        out: Dict[int, int] = {}
-        if isinstance(fam, dict):
-            for label, v in fam.items():
-                out[int(label.split("=", 1)[1])] = int(v)
+        fam = self.registry.family_values("serving_bucket_hits_total")
+        return {int(label.split("=", 1)[1]): int(v)
+                for label, v in fam.items()}
+
+    def pad_waste(self) -> Dict[int, dict]:
+        """bucket → {real, padded, waste_ratio}: cumulative rows split
+        into request rows vs bucket-quantization padding. waste_ratio is
+        padding over total dispatched rows — the fraction of device work
+        burned on padding at that bucket (the signal that says WHICH
+        bucket list to retune)."""
+        real = self.registry.family_values("serving_real_samples_total")
+        padded = self.registry.family_values("serving_padded_samples_total")
+        out: Dict[int, dict] = {}
+        for label in set(real) | set(padded):
+            bucket = int(label.split("=", 1)[1])
+            r = int(real.get(label, 0))
+            p = int(padded.get(label, 0))
+            out[bucket] = {
+                "real": r, "padded": p,
+                "waste_ratio": round(p / (r + p), 4) if (r + p) else 0.0,
+            }
         return out
 
     # -- reading ------------------------------------------------------------
@@ -139,6 +172,8 @@ class ServingMetrics:
             "reloads": self.reloads,
             "bucket_hits": {str(k): v
                             for k, v in sorted(self.bucket_hits.items())},
+            "pad_waste": {str(k): v
+                          for k, v in sorted(self.pad_waste().items())},
             "uptime_s": round(time.time() - self.started_at, 3),
             "latency_window": n,
         }

@@ -1,0 +1,177 @@
+"""Per-request serving traces: where did this request's latency go?
+
+Aggregate quantiles (serving/metrics.py) say THAT p99 spiked; a
+per-request timeline says WHY: queued behind a full batch, padded to a
+wasteful bucket, stuck behind a slow dispatch, or slow to complete. Each
+request carries a :class:`RequestTrace` — a list of monotonic-clock
+marks at every stage boundary of its life:
+
+    enqueue → batch_assembled → dispatch_start → forward_done
+            → sliced → respond
+
+The derived timeline reports the INTERVALS between consecutive marks
+(``queue``, ``assembly``, ``forward``, ``slice``, ``respond``), which by
+construction sum exactly to the end-to-end latency — no double-counted
+or missing time. Stage marks are two machine instructions plus a
+``time.monotonic()`` call; tracing every request costs well under the
+bench's 5% p99 budget.
+
+The batcher worker and the engine run on different abstraction levels
+(the engine doesn't see requests, the batcher doesn't see buckets), so
+bucket/padding facts flow through a **dispatch context**: a
+thread-local slot the dispatcher opens around each ``infer`` call and
+the engine fills from inside (:class:`DispatchInfo`). Single-threaded
+per batcher worker by construction, and thread-local keeps concurrent
+batchers (tests run many) from crosstalking.
+
+Completed timelines are sampled into a bounded :class:`TraceBuffer`
+(newest-wins ring) that ``GET /trace`` serves — the recent-requests
+window a latency investigation actually needs, with bounded memory under
+sustained traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+#: interval names, keyed by the mark that CLOSES the interval
+STAGE_NAMES = {
+    "batch_assembled": "queue",
+    "dispatch_start": "assembly",
+    "forward_done": "forward",
+    "sliced": "slice",
+    "respond": "respond",
+}
+
+
+class RequestTrace:
+    """Stage marks + metadata for one request. Created at submit time;
+    marked by the batcher worker and the dispatcher; serialized once at
+    completion."""
+
+    __slots__ = ("marks", "meta")
+
+    def __init__(self):
+        self.marks: List[tuple] = [("enqueue", time.monotonic())]
+        self.meta: Dict[str, object] = {}
+
+    def mark(self, name: str, at: Optional[float] = None) -> None:
+        self.marks.append((name, time.monotonic() if at is None else at))
+
+    def note(self, **fields) -> None:
+        self.meta.update(fields)
+
+    def timeline(self) -> dict:
+        """JSON-ready timeline: per-interval durations (ms) between
+        consecutive marks — they sum exactly to ``total_ms`` — plus the
+        dispatch metadata (bucket, rows, pad waste, model version)."""
+        t0 = self.marks[0][1]
+        stages = []
+        prev = t0
+        for name, t in self.marks[1:]:
+            stages.append({
+                "stage": STAGE_NAMES.get(name, name),
+                "ms": round((t - prev) * 1e3, 4),
+                "at_ms": round((t - t0) * 1e3, 4),
+            })
+            prev = t
+        out = {
+            "stages": stages,
+            "total_ms": round((prev - t0) * 1e3, 4),
+            "enqueued_unix": time.time() - (time.monotonic() - t0),
+        }
+        out.update(self.meta)
+        return out
+
+
+# --------------------------------------------------------------------------
+# dispatch context (batcher worker ↔ engine)
+# --------------------------------------------------------------------------
+class DispatchInfo:
+    """What the engine learned while serving one dispatch: the bucket it
+    padded to, real vs padded rows, sequence padding, and the absolute
+    times of the forward/slice boundaries."""
+
+    __slots__ = ("bucket", "rows_real", "rows_padded", "seq_real",
+                 "seq_padded", "t_forward_done", "t_sliced")
+
+    def __init__(self):
+        self.bucket: Optional[int] = None
+        self.rows_real: Optional[int] = None
+        self.rows_padded: Optional[int] = None
+        self.seq_real: Optional[int] = None
+        self.seq_padded: Optional[int] = None
+        self.t_forward_done: Optional[float] = None
+        self.t_sliced: Optional[float] = None
+
+
+_ctx = threading.local()
+
+
+def begin_dispatch() -> DispatchInfo:
+    """Open a fresh dispatch context on this thread (the dispatcher does
+    this right before calling ``infer``)."""
+    info = DispatchInfo()
+    _ctx.info = info
+    return info
+
+
+def current_dispatch() -> Optional[DispatchInfo]:
+    """The open context, or None when nobody is tracing this dispatch
+    (direct ``engine.infer`` callers, warmup) — filling is skipped."""
+    return getattr(_ctx, "info", None)
+
+
+def end_dispatch() -> Optional[DispatchInfo]:
+    info = getattr(_ctx, "info", None)
+    _ctx.info = None
+    return info
+
+
+# --------------------------------------------------------------------------
+# bounded trace buffer (GET /trace)
+# --------------------------------------------------------------------------
+class TraceBuffer:
+    """Thread-safe newest-wins ring of completed request traces.
+
+    The ring stores :class:`RequestTrace` OBJECTS (a reference append);
+    timelines are materialized lazily at :meth:`snapshot` time — the
+    batcher's single worker thread must not spend its dispatch loop
+    building dicts for a buffer nobody may ever scrape. A trace is
+    immutable once its ``respond`` mark lands, so the read side never
+    sees a torn timeline."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._total = 0
+
+    def add(self, trace) -> None:
+        """``trace``: a completed RequestTrace (or an already-built
+        timeline dict)."""
+        with self._lock:
+            self._total += 1
+            self._ring.append(trace)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def snapshot(self, last: Optional[int] = None) -> dict:
+        with self._lock:
+            traces = list(self._ring)
+            total = self._total
+        if last is not None:
+            traces = traces[-int(last):]
+        return {"capacity": self.capacity, "recorded_total": total,
+                "traces": [t.timeline() if isinstance(t, RequestTrace)
+                           else t for t in traces]}

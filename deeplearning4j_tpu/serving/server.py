@@ -18,18 +18,29 @@ the production contract:
 - ``POST /reload``         hot-swap to the newest valid checkpoint
                            (optional JSON ``{"path": ...,
                            "force": bool}``)
-- ``GET  /metrics``        counters, queue depth, per-bucket hits,
-                           latency quantiles (ring buffer). Content-
-                           negotiated: JSON by default (the original
-                           surface), Prometheus text exposition when the
-                           client Accepts ``text/plain``/openmetrics or
-                           asks ``?format=prometheus`` — one scrape
-                           config covers serving and training
-                           (obs/exporter.py)
+- ``GET  /metrics``        counters, queue depth, per-bucket hits +
+                           pad-waste ratios, latency quantiles (ring
+                           buffer). Content-negotiated: JSON by default
+                           (the original surface), Prometheus text
+                           exposition when the client Accepts
+                           ``text/plain``/openmetrics or asks
+                           ``?format=prometheus`` — one scrape config
+                           covers serving and training (obs/exporter.py)
+- ``GET  /trace``          recent per-request timelines (bounded ring;
+                           ``?last=N`` trims) — the "where did THIS
+                           request's latency go" window. A client that
+                           wants its own timeline inline passes
+                           ``{"trace": true}`` in /predict and gets a
+                           ``trace`` key back in the response.
+- ``GET  /debug/flight``   the process flight-recorder ring
+                           (obs/flight.py) as JSON
+- ``GET  /debug/profile``  on-demand ``jax.profiler`` capture for
+                           ``?ms=`` milliseconds (409 while another
+                           capture runs)
 
 Typed failures map to transport codes: queue-full backpressure → 503
 (clients back off), request deadline → 504, malformed input → 400,
-shutdown → 503.
+shutdown → 503, concurrent profiler capture → 409.
 """
 
 from __future__ import annotations
@@ -61,10 +72,19 @@ class InferenceServer:
     def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
                  port: int = 8080, batch_limit: int = 32,
                  max_wait_ms: float = 5.0, queue_limit: int = 256,
-                 default_timeout_s: float = 30.0):
+                 default_timeout_s: float = 30.0,
+                 trace_requests: bool = True,
+                 trace_buffer_size: int = 256):
+        from deeplearning4j_tpu.serving.rtrace import TraceBuffer
+
         self.engine = engine
         self.metrics: ServingMetrics = engine.metrics
         self.default_timeout_s = float(default_timeout_s)
+        #: recent per-request timelines (GET /trace). trace_requests
+        #: stamps a timeline on EVERY request (a handful of monotonic
+        #: reads — the bench gates its p99 cost at <=5%); off, only
+        #: requests that opt in via {"trace": true} are traced.
+        self.traces = TraceBuffer(trace_buffer_size)
         # bind the socket BEFORE starting the batcher worker: a bind
         # failure (EADDRINUSE) must raise without leaking a polling
         # thread nobody holds a handle to
@@ -79,11 +99,13 @@ class InferenceServer:
         self.batcher = DynamicBatcher(
             make_dispatcher(
                 lambda x, mask=None: self.engine.infer_versioned(x, mask),
-                metrics=self.metrics),
+                metrics=self.metrics, traces=self.traces),
             batch_limit=batch_limit, max_wait_ms=max_wait_ms,
-            queue_limit=queue_limit, metrics=self.metrics)
+            queue_limit=queue_limit, metrics=self.metrics,
+            trace_requests=trace_requests)
         self._thread: Optional[threading.Thread] = None
         self._serving = False
+        self._closed = False
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -104,26 +126,45 @@ class InferenceServer:
 
     def shutdown(self) -> None:
         """Stop the listener, then drain the batcher (in-flight requests
-        finish; the bounded queue is served, not dropped)."""
+        finish; the bounded queue is served, not dropped). Idempotent —
+        a supervisor's double-shutdown (or shutdown of a server whose
+        serve loop never ran) must not hang or double-close."""
         if self._serving:  # BaseServer.shutdown deadlocks if the serve
             self._httpd.shutdown()  # loop never ran
-        self._httpd.server_close()
+            self._serving = False
+        if not self._closed:
+            self._closed = True
+            self._httpd.server_close()
         self.batcher.shutdown(drain=True)
         if self._thread is not None:
             self._thread.join(timeout=5)
+            self._thread = None
 
     # -- request plumbing (called from handler threads) ----------------------
     def predict(self, x: np.ndarray, mask=None,
-                timeout_s: Optional[float] = None):
+                timeout_s: Optional[float] = None,
+                trace: Optional[bool] = None):
         """Returns ``(outputs, model_version)`` — the version of the
         snapshot that actually computed them (stamped in the dispatch,
-        so a concurrent hot reload cannot mislabel the response)."""
+        so a concurrent hot reload cannot mislabel the response).
+        ``trace=True`` forces a stage timeline onto this request even
+        when batcher-level tracing is off; read it from
+        :meth:`predict_request`."""
+        out, version, _ = self.predict_request(x, mask, timeout_s, trace)
+        return out, version
+
+    def predict_request(self, x: np.ndarray, mask=None,
+                        timeout_s: Optional[float] = None,
+                        trace: Optional[bool] = None):
+        """Like :meth:`predict` but also returns the completed
+        :class:`~serving.batcher.InferenceRequest` (its ``trace`` holds
+        the stage timeline when tracing was on)."""
         timeout = self.default_timeout_s if timeout_s is None else timeout_s
-        req = self.batcher.submit(x, mask, timeout=timeout)
+        req = self.batcher.submit(x, mask, timeout=timeout, trace=trace)
         out = req.result(timeout=timeout)
         version = req.model_version
         return out, (self.engine.model_version if version is None
-                     else version)
+                     else version), req
 
 
 def _make_handler(server: InferenceServer):
@@ -189,6 +230,29 @@ def _make_handler(server: InferenceServer):
                     else:
                         self._send_json(200, server.metrics.snapshot(
                             queue_depth=depth))
+                elif url.path == "/trace":
+                    from urllib.parse import parse_qs
+
+                    last = parse_qs(url.query).get("last", [None])[0]
+                    body = server.traces.snapshot(
+                        last=None if last is None else int(last))
+                    body["pad_waste"] = {
+                        str(k): v
+                        for k, v in sorted(
+                            server.metrics.pad_waste().items())}
+                    self._send_json(200, body)
+                elif url.path == "/debug/flight":
+                    from deeplearning4j_tpu.obs.exporter import (
+                        debug_flight_response,
+                    )
+
+                    self._send_json(*debug_flight_response())
+                elif url.path == "/debug/profile":
+                    from deeplearning4j_tpu.obs.exporter import (
+                        debug_profile_response,
+                    )
+
+                    self._send_json(*debug_profile_response(url.query))
                 else:
                     self._send_json(404, {"error": "NotFound",
                                           "message": self.path})
@@ -221,12 +285,19 @@ def _make_handler(server: InferenceServer):
             if mask is not None:
                 mask = np.asarray(mask, np.float32)
             timeout_ms = payload.get("timeout_ms")
-            out, version = server.predict(
+            want_trace = bool(payload.get("trace", False))
+            out, version, req = server.predict_request(
                 x, mask,
                 timeout_s=None if timeout_ms is None
-                else float(timeout_ms) / 1e3)
-            self._send_json(200, {"outputs": np.asarray(out).tolist(),
-                                  "model_version": version})
+                else float(timeout_ms) / 1e3,
+                # None keeps the batcher default; True forces a
+                # timeline even when server-level tracing is off
+                trace=True if want_trace else None)
+            body = {"outputs": np.asarray(out).tolist(),
+                    "model_version": version}
+            if want_trace and req.trace is not None:
+                body["trace"] = req.trace.timeline()
+            self._send_json(200, body)
 
         def _predict_npy(self) -> None:
             body = self._body()

@@ -47,6 +47,7 @@ import contextlib
 import functools
 import os
 import uuid
+import weakref
 import zipfile
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -247,15 +248,56 @@ def advance_fault_state(policy: FaultPolicy, fstate: Dict[str, Array],
     return new
 
 
+#: model → bad_count seen at its previous check. Under bundling the
+#: tripwire only observes the END-of-bundle consec, so a mid-bundle NaN
+#: that recovers before the boundary leaves consec==0 — the delta
+#: against this map is what still makes it into the black box. Weak
+#: keys: a dropped model must not pin its entry (tuner pools churn
+#: models).
+_last_reported_bad: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
 def check_fault_state(policy: Optional[FaultPolicy],
-                      fstate: Optional[Dict[str, Array]]) -> None:
+                      fstate: Optional[Dict[str, Array]],
+                      owner=None) -> None:
     """Host-side divergence tripwire. Costs one device sync, so it only
-    runs when ``max_consecutive_bad_steps`` is armed."""
+    runs when ``max_consecutive_bad_steps`` is armed.
+
+    Flight-recorder feed: an armed tripwire already pays the host read,
+    so the black box gets the NaN-skip streak for free — and when the
+    trip fires, the divergence event is recorded AND the ring is dumped
+    BEFORE the raise, so even a caller that swallows (or never catches)
+    :class:`TrainingDivergedError` leaves the postmortem on disk.
+    ``owner`` (the model) keys the transient-skip detection: a NaN step
+    that recovers before the bundle boundary ends the check with
+    consec==0, and only the cumulative ``bad_count`` advancing since
+    this owner's previous check reveals it happened at all."""
     if (policy is None or fstate is None
             or policy.max_consecutive_bad_steps is None):
         return
-    consec = int(fstate["consec"])
+    consec, bad_count = (
+        int(v) for v in
+        jax.device_get((fstate["consec"], fstate["bad_count"])))
+    new_bad = 0
+    if owner is not None:
+        prev = _last_reported_bad.get(owner)
+        _last_reported_bad[owner] = bad_count
+        # a reset fault state (bad_count rewound below prev) starts a
+        # fresh baseline instead of masking its first skips
+        new_bad = bad_count - prev if (prev is not None
+                                       and bad_count >= prev) else bad_count
+    if consec == 0 and new_bad <= 0:
+        return
+    from deeplearning4j_tpu.obs import flight as _flight
+
+    rec = _flight.default_flight_recorder()
+    rec.record("nan_skip", consec=consec, bad_count=bad_count)
     if consec >= policy.max_consecutive_bad_steps:
+        rec.record("divergence_trip", consec=consec,
+                   limit=int(policy.max_consecutive_bad_steps),
+                   bad_count=bad_count)
+        if rec.dump_dir is not None:
+            rec.dump(reason="divergence")
         raise TrainingDivergedError(
             f"{consec} consecutive non-finite gradient steps (limit "
             f"max_consecutive_bad_steps={policy.max_consecutive_bad_steps}) "
@@ -432,6 +474,10 @@ def save_checkpoint(model, directory: str, keep_last: Optional[int] = None,
     path = os.path.join(directory, name)
     ModelSerializer.write_model(model, path, save_updater=True)
     prune_checkpoints(directory, keep_last)
+    from deeplearning4j_tpu.obs import flight as _flight
+
+    _flight.record("checkpoint_write", path=path,
+                   iteration=int(model.iteration))
     return path
 
 
@@ -479,7 +525,11 @@ def latest_valid_checkpoint(directory: str, missing_ok: bool = False
 def load_latest_valid(directory: str):
     """Restore the newest valid checkpoint in ``directory`` (model type
     sniffed from the zip); returns ``(model, path)``."""
+    from deeplearning4j_tpu.obs import flight as _flight
     from deeplearning4j_tpu.train.model_serializer import ModelGuesser
 
     path = latest_valid_checkpoint(directory)
-    return ModelGuesser.load_model_guess(path), path
+    model = ModelGuesser.load_model_guess(path)
+    _flight.record("checkpoint_load", path=path,
+                   iteration=int(getattr(model, "iteration", 0) or 0))
+    return model, path

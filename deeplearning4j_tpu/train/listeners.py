@@ -427,6 +427,10 @@ class CheckpointListener(TrainingListener):
 
             path = os.path.join(self.directory, stem + ".zip")
             ModelSerializer.write_model(model, path, save_updater=True)
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("checkpoint_write", path=path,
+                       iteration=int(iteration), epoch=int(epoch))
         self.checkpoints.append(path)
         self._ids.append(self._counter)
         self._apply_retention()
