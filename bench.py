@@ -966,6 +966,153 @@ def _bench_tune(n_trials=8, steps=96, k=8, n_batches=24, batch=32,
     return result
 
 
+def _bench_reshard(d_in=384, d_hidden=512, n_hidden=3, d_out=7,
+                   batch=16, n_from=8, n_to=2, rounds=5):
+    """Elastic N→M resharding A/B (parallel/reshard.py): move a trained
+    model's state — params + ZeRO-1 sharded Adam slots — from an
+    ``n_from``-device mesh onto an ``n_to``-device mesh two ways:
+
+    (a) **reshard-in-place** (the PR-8 engine): the flat-shard opt state
+        is re-split (N, chunk_N)→(M, chunk_M) with device ops + a
+        device_put onto the target sharding, params re-place
+        device-to-device — ``host_bytes == 0`` by construction;
+    (b) **gather-to-host-and-reload** (the legacy path): gather the
+        canonical per-layer state to host numpy, then re-shard it onto
+        the target mesh — every byte staged through host buffers.
+
+    Both paths produce bit-identical target state (asserted). The
+    transfer-size ledger is the acceptance instrument: the reshard path
+    must stage ≤ 0.5× the gather path's host bytes (it stages none).
+    Wall times are best-of-``rounds`` interleaved (sequential A/B
+    mismeasures on this box). Writes BENCH_reshard.json."""
+    import gc
+
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.parallel import reshard as _reshard
+    from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+    from deeplearning4j_tpu.parallel.zero import (
+        build_layout,
+        shard_model_opt_state,
+    )
+    from deeplearning4j_tpu.updaters import Adam
+
+    devices = jax.devices()
+    if len(devices) < n_from:
+        raise RuntimeError(f"need {n_from} devices, have {len(devices)}")
+    b = NeuralNetConfiguration.builder().seed(11).updater(Adam(1e-3)).list()
+    for _ in range(n_hidden):
+        b = b.layer(DenseLayer(n_out=d_hidden, activation="relu"))
+    conf = (b.layer(OutputLayer(n_out=d_out, activation="softmax",
+                                loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d_in)).build())
+    model = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(3)
+    ds = DataSet(rng.standard_normal((batch, d_in)).astype(np.float32),
+                 np.eye(d_out, dtype=np.float32)[
+                     rng.integers(0, d_out, batch)])
+    for _ in range(2):  # materialize non-trivial Adam slots
+        model.fit(ds)
+
+    mesh_n = TrainingMesh(data=n_from, devices=devices[:n_from])
+    mesh_m = TrainingMesh(data=n_to, devices=devices[:n_to])
+    layout_n = build_layout(model, n_from)
+    layout_m = build_layout(model, n_to)
+    z_n = shard_model_opt_state(model, layout_n, mesh=mesh_n.mesh)
+    jax.block_until_ready(z_n)
+
+    def run_reshard():
+        stats = _reshard.TransferStats()
+        z_m, stats = _reshard.reshard_zero1(z_n, layout_n, layout_m,
+                                            mesh_m, stats=stats)
+        plan = _reshard.plan_replicated(model.params_, mesh_m,
+                                        n_from=n_from)
+        p_m, stats = plan.execute(model.params_, stats)
+        jax.block_until_ready((z_m, p_m))
+        return z_m, p_m, stats
+
+    def run_gather():
+        stats = _reshard.TransferStats()
+        canonical = layout_n.unshard_opt_state(z_n, model.opt_state_)
+        # every canonical leaf is a host-materialized copy: account it
+        host_p, stats = _reshard.gather_to_host(model.params_, stats)
+        for layer in canonical:
+            for slots in layer.values():
+                for s in slots.values():
+                    stats.add(_reshard.ROUTE_HOST,
+                              np.asarray(s).nbytes)
+        z_m = layout_m.shard_opt_state(canonical, mesh=mesh_m.mesh)
+        p_m = jax.device_put(host_p, mesh_m.replicated())
+        jax.block_until_ready((z_m, p_m))
+        return z_m, p_m, stats
+
+    # parity: both paths land the same bytes on the target mesh
+    zr, pr, _ = run_reshard()
+    zg, pg, _ = run_gather()
+    for a, bslots in zip(zr, zg):
+        for k in a:
+            assert np.array_equal(np.asarray(a[k]), np.asarray(bslots[k]))
+    for pa, pb in zip(jax.tree_util.tree_leaves(pr),
+                      jax.tree_util.tree_leaves(pg)):
+        assert np.array_equal(np.asarray(pa), np.asarray(pb))
+
+    wall_r, wall_g = [], []
+    stats_r = stats_g = None
+    for _ in range(rounds):  # interleaved best-of-N
+        gc.collect()
+        t0 = time.perf_counter()
+        *_, stats_r = run_reshard()
+        wall_r.append(time.perf_counter() - t0)
+        gc.collect()
+        t0 = time.perf_counter()
+        *_, stats_g = run_gather()
+        wall_g.append(time.perf_counter() - t0)
+    wr, wg = min(wall_r), min(wall_g)
+    host_ratio = (stats_r.host_bytes / stats_g.host_bytes
+                  if stats_g.host_bytes else None)
+    result = {
+        "metric": "reshard_vs_gather_host_bytes_ratio",
+        "value": round(host_ratio, 6) if host_ratio is not None else None,
+        "unit": f"host-staged bytes, reshard/gather ({n_from}->{n_to} "
+                "devices)",
+        "vs_baseline": round(wr / wg, 3) if wg else None,
+        "extra": {
+            "reshard_host_bytes": int(stats_r.host_bytes),
+            "gather_host_bytes": int(stats_g.host_bytes),
+            "reshard_device_bytes": int(stats_r.device_bytes),
+            "reshard_wall_ms": round(wr * 1e3, 3),
+            "gather_wall_ms": round(wg * 1e3, 3),
+            "wall_ratio": round(wr / wg, 3) if wg else None,
+            "rounds": rounds,
+            "bit_identical_target_state": True,
+            "config": (f"MLP {d_in}->{n_hidden}x{d_hidden}->{d_out}, "
+                       f"ZeRO-1 Adam slots, {n_from}->{n_to} reshard"),
+            "platform": jax.devices()[0].platform,
+            "note": ("gate: reshard stages <= 0.5x the gather path's "
+                     "host bytes (it stages 0 — the no-gather-to-host "
+                     "contract of the N->M path); wall_ratio reported "
+                     "for reference, CPU virtual devices share one "
+                     "heap so wall gains are understated there"),
+        },
+    }
+    gate_ok = stats_r.host_bytes <= 0.5 * stats_g.host_bytes
+    result["extra"]["gate_host_bytes_le_half"] = bool(gate_ok)
+    if not gate_ok:
+        result["extra"]["gate_failure"] = (
+            f"reshard staged {stats_r.host_bytes} host bytes vs gather "
+            f"{stats_g.host_bytes}")
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_reshard.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
+    return result
+
+
 def _tpu_plausible() -> bool:
     """Whether a TPU backend could come up at all in this container: the
     axon plugin must be importable (or explicitly requested). When it
@@ -1164,6 +1311,19 @@ if __name__ == "__main__":
 
             jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_obs()))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "reshard":
+        # elastic N->M reshard vs gather-to-host A/B: meaningful on any
+        # backend (the ledger is the acceptance instrument), writes
+        # BENCH_reshard.json. Gate: reshard host bytes <= 0.5x gather.
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        out = _bench_reshard()
+        if not _tpu_plausible():
+            out["metric"] = "cpu_fallback_" + out["metric"]
+        print(json.dumps(out))
         sys.exit(0)
     if len(sys.argv) > 1 and sys.argv[1] == "tune":
         # tuner population-vs-sequential A/B: meaningful on any backend,

@@ -487,7 +487,24 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", action="store_true",
                     help="restore the newest VALID checkpoint from "
                          "--checkpoint-dir before training (corrupt/"
-                         "truncated ones are skipped)")
+                         "truncated ones are skipped). Checkpoints are "
+                         "device-count portable: a run checkpointed with "
+                         "--workers N resumes under any --workers M "
+                         "(parallel/reshard.py re-places the state)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="survive losing part of the mesh mid-fit: "
+                         "checkpoint every epoch's worth of steps, and on "
+                         "a mesh failure re-form a smaller mesh from the "
+                         "surviving devices, reshard the newest valid "
+                         "checkpoint onto it and resume in place "
+                         "(requires --checkpoint-dir; see the "
+                         "mesh_shrink/reshard_done/elastic_resume events "
+                         "in flight-dump)")
+    ap.add_argument("--elastic-max-retries", type=int, default=2,
+                    help="recoveries before --elastic gives up with "
+                         "ElasticRecoveryExhaustedError")
+    ap.add_argument("--elastic-min-devices", type=int, default=1,
+                    help="give up when fewer devices than this survive")
     args = ap.parse_args(argv)
 
     it, num_classes = build_dataset(args.dataset, args.batch_size,
@@ -507,6 +524,18 @@ def main(argv=None) -> int:
                       f"{model.iteration}, epoch {model.epoch}); "
                       "--model/--compute-dtype/--remat-policy come from "
                       "the checkpoint", flush=True)
+                from deeplearning4j_tpu.train.model_serializer import (
+                    ModelSerializer,
+                )
+
+                topo = (ModelSerializer.checkpoint_meta(ckpt_path)
+                        .get("topology") or {})
+                n_from = topo.get("n_devices")
+                if n_from is not None and n_from != args.workers:
+                    print(f"cross-topology resume: checkpoint written on "
+                          f"{n_from} device(s), resuming on "
+                          f"{args.workers} (state is canonical — "
+                          "parallel/reshard.py re-places it)", flush=True)
         except FileNotFoundError as e:
             print(f"resume: {e}", flush=True)
         if model is None:
@@ -588,9 +617,13 @@ def main(argv=None) -> int:
         # otherwise grow the directory by keep_last zips per incarnation
         if os.path.isdir(args.checkpoint_dir):
             prune_checkpoints(args.checkpoint_dir, args.keep_last)
-        model.add_listeners(CheckpointListener(
-            args.checkpoint_dir, save_every_n_epochs=1,
-            keep_mode="last", keep_last=args.keep_last))
+        if not args.elastic:
+            # under --elastic the driver owns checkpointing (same dir,
+            # iteration cadence) — a second epoch listener would double
+            # every write and fight the pruning
+            model.add_listeners(CheckpointListener(
+                args.checkpoint_dir, save_every_n_epochs=1,
+                keep_mode="last", keep_last=args.keep_last))
 
     if args.cost_report:
         from deeplearning4j_tpu.obs import cost as _cost
@@ -613,7 +646,34 @@ def main(argv=None) -> int:
                   f"(K={rep['steps_per_call']})", flush=True)
 
     t0 = time.time()
-    if args.workers > 1:
+    if args.elastic:
+        import jax as _jax
+
+        from deeplearning4j_tpu.train.faults import ElasticFitDriver
+
+        if not args.checkpoint_dir:
+            raise SystemExit("--elastic requires --checkpoint-dir "
+                             "(recovery resumes from its checkpoints)")
+        batches = list(it)
+        driver = ElasticFitDriver(
+            model, args.checkpoint_dir,
+            # always honor --workers: the non-elastic paths treat
+            # workers=1 as single-device, so must this one
+            devices=_jax.devices()[: args.workers],
+            max_retries=args.elastic_max_retries,
+            min_devices=args.elastic_min_devices,
+            # one epoch's worth of steps per checkpoint (what --elastic
+            # documents); batches is exactly one epoch of the iterator
+            checkpoint_every_n_iterations=max(len(batches), 1),
+            keep_last=args.keep_last,
+            sharded_update=args.sharded_update or None,
+            steps_per_call=args.steps_per_call)
+        model = driver.fit(batches, epochs=args.epochs)
+        if driver.recoveries:
+            print(f"elastic: survived {driver.recoveries} mesh "
+                  "failure(s); see flight-dump for the recovery "
+                  "timeline", flush=True)
+    elif args.workers > 1:
         from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 
         pw_b = ParallelWrapper.builder(model).workers(args.workers)

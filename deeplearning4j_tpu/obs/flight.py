@@ -6,7 +6,12 @@ stopped" — the black box TF-Serving-style production stacks (arXiv
 1605.08695) keep next to every training job. Every noteworthy host-side
 event — step/bundle completion with loss, NaN-skip, loss-scale change,
 checkpoint write/load, hot reload, overload rejection, jit retrace,
-profiler capture — is appended to a thread-safe fixed-size ring
+profiler capture, and since PR 8 the elastic-recovery lifecycle
+(``mesh_shrink`` with N→M, ``reshard_start``/``reshard_done`` with wall
+time and the device/host byte ledger, ``elastic_resume``,
+``elastic_giveup``, ``checkpoint_fallback`` — a post-dropout dump reads
+as the complete recovery timeline) — is appended to a thread-safe
+fixed-size ring
 (:class:`FlightRecorder`), and the ring is dumped **atomically** to JSON
 when it matters:
 

@@ -19,6 +19,15 @@ from deeplearning4j_tpu.parallel.zero import (
     make_sharded_train_step,
     zero1_extend_spec,
 )
+from deeplearning4j_tpu.parallel.reshard import (
+    ReshardPlan,
+    TransferStats,
+    gather_to_host,
+    place_model,
+    plan_replicated,
+    plan_tree,
+    reshard_zero1,
+)
 from deeplearning4j_tpu.parallel.multihost import (
     MultiHostContext,
     MultiHostNetwork,
@@ -37,4 +46,6 @@ __all__ = [
     "ShardedDataSetIterator", "TrainingMaster", "SharedTrainingMaster",
     "ExpertParallelWrapper", "ShardedUpdateLayout", "apply_sharded_updates",
     "make_sharded_train_step", "zero1_extend_spec",
+    "ReshardPlan", "TransferStats", "gather_to_host", "place_model",
+    "plan_replicated", "plan_tree", "reshard_zero1",
 ]

@@ -113,5 +113,26 @@ class TrainingMesh:
     def n_data(self) -> int:
         return self.shape["data"]
 
+    def devices_flat(self) -> list:
+        """This mesh's devices in mesh order (data-major)."""
+        return list(np.asarray(self.mesh.devices).reshape(-1))
+
+    def shrink(self, survivors: Sequence) -> "TrainingMesh":
+        """A data-parallel sub-mesh over ``survivors`` — the elastic
+        recovery re-formation (parallel/reshard.py / ElasticFitDriver).
+        Only pure-DP meshes shrink freely; TP/PP/SP/EP axes tile the
+        model itself, so losing a device there changes the program, not
+        just the batch split."""
+        others = {k: v for k, v in self.shape.items()
+                  if k != "data" and v != 1}
+        if others:
+            raise ValueError(
+                f"cannot shrink a mesh with non-trivial axes {others}: "
+                "elastic re-formation is data-parallel only")
+        survivors = list(survivors)
+        if not survivors:
+            raise ValueError("cannot form a mesh from zero survivors")
+        return TrainingMesh(data=len(survivors), devices=survivors)
+
     def __repr__(self):
         return f"TrainingMesh({self.shape})"

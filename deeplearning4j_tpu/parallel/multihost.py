@@ -37,7 +37,11 @@ global sharded arrays with ``multihost_utils.host_local_array_to_global_array``.
 
 Recovery is checkpoint-restart (SURVEY.md §5 failure detection): process 0
 writes the standard ModelSerializer zip; every process restores it on
-resume. This matches the reference's (absent) elasticity story.
+resume. Since PR 8 the restart is ELASTIC: checkpoints are device-count
+portable (parallel/reshard.py), so the survivors of a host loss re-form a
+smaller world (``reinitialize_for_survivors``), reload the newest valid
+checkpoint and resume in place (train/faults.py ``ElasticFitDriver``)
+instead of waiting for the lost host to be replaced.
 """
 
 from __future__ import annotations
@@ -138,6 +142,45 @@ class MultiHostContext:
             f"{len(self.local_devices)} local / {len(self.global_devices)} "
             "global devices)"
         )
+
+
+# --------------------------------------------------------------------------
+# elastic recovery (host loss): survivor roster + world re-formation
+# --------------------------------------------------------------------------
+def surviving_devices(lost_processes: Iterable[int]) -> list:
+    """The global devices NOT owned by ``lost_processes`` — the roster
+    an :class:`~deeplearning4j_tpu.train.faults.ElasticFitDriver` hands
+    to ``TrainingMesh.shrink`` after a host drops out. (Single-host
+    callers simulate host loss by dropping a device range instead; see
+    ``train.faults.host_dropout_injection``.)"""
+    lost = set(int(p) for p in lost_processes)
+    return [d for d in jax.devices()
+            if getattr(d, "process_index", 0) not in lost]
+
+
+def reinitialize_for_survivors(coordinator_address: str,
+                               num_processes: int,
+                               process_id: int) -> "MultiHostContext":
+    """Tear down the distributed runtime and re-bootstrap it as the
+    smaller surviving world. Every survivor must call this with its NEW
+    process id in the re-numbered [0, num_processes) world and the new
+    coordinator (by convention the lowest surviving old id).
+
+    This is the multihost half of elastic recovery; the state half —
+    reload ``latest_valid_checkpoint`` and reshard onto the new mesh —
+    is topology-independent (parallel/reshard.py), which is exactly why
+    the checkpoint format stays canonical. jax 0.4.x cannot shrink a
+    LIVE world (no barrier re-negotiation), so re-forming is
+    shutdown + initialize, not an in-place membership change."""
+    shutdown = getattr(jax.distributed, "shutdown", None)
+    if _distributed_initialized() and shutdown is not None:
+        try:
+            shutdown()
+        except Exception:  # noqa: BLE001 — the old world is already torn
+            pass
+    return initialize(coordinator_address=coordinator_address,
+                      num_processes=num_processes,
+                      process_id=process_id)
 
 
 def free_port() -> int:

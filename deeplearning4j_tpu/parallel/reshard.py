@@ -1,0 +1,421 @@
+"""Elastic N→M mesh resharding engine.
+
+Maps training state that lives on (or was checkpointed from) an N-device
+mesh onto an M-device mesh without a gather-to-host round trip — the
+memory-efficient array-redistribution discipline of arXiv 2112.01075
+expressed through portable collectives, completing the fault-tolerance
+triad of arXiv 1605.08695 §4.3 (checkpoint, detect, *resume on whatever
+is left*).
+
+Design: a **plan/execute split**.
+
+- :func:`plan_tree` walks a pytree once and decides, per leaf, the
+  *route* and the *target sharding*; the result (:class:`ReshardPlan`)
+  is inspectable (``summary()``) and cheap — no bytes move at plan time.
+- :meth:`ReshardPlan.execute` moves the bytes and returns the re-placed
+  tree plus a :class:`TransferStats` ledger of exactly how many bytes
+  travelled each route. The ledger is the acceptance instrument: the
+  N→M reshard path must report ``host_bytes == 0`` (bench.py ``reshard``
+  gates on it), while the legacy gather-to-host baseline reports the
+  full state size.
+
+Routes:
+
+- ``device`` — the leaf is a live ``jax.Array``: ``jax.device_put`` onto
+  the target ``NamedSharding``. On a single host this is a
+  device-to-device copy (XLA moves only the shard deltas); inside a
+  multihost mesh the same call lowers to collective permute /
+  all-gather-scatter over the existing allocation — never through host
+  memory.
+- ``host`` — the leaf is host data (a numpy array, e.g. freshly read
+  from a checkpoint zip): placed via ``jax.make_array_from_callback``,
+  which asks for each **shard's slice** separately, so a process only
+  materializes the slices its local devices own (the multihost
+  memory-efficiency story; on one host it still avoids a second staged
+  full-array device copy).
+
+The ZeRO-1 flat-shard state (parallel/zero.py) gets a dedicated path,
+:func:`reshard_zero1`: each group's ``(N, chunk_N)`` slot matrix is
+re-split to ``(M, chunk_M)`` **in-graph** — flatten, drop the N-padding
+tail, re-pad to a multiple of M, reshape — and the result is placed
+sharded over the target data axis. All index arithmetic follows the
+odd-count padding discipline of ``_Group.finalize`` exactly, so an
+N→M→N round trip is bit-identical and a fit resumed from the resharded
+state is bit-identical to an unsharded resume.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+Array = jax.Array
+
+ROUTE_DEVICE = "device"
+ROUTE_HOST = "host"
+
+
+class TransferStats:
+    """Byte ledger of one reshard execution.
+
+    ``device_bytes`` travelled device-to-device (or through in-mesh
+    collectives); ``host_bytes`` passed through host buffers. The
+    elastic N→M path must keep ``host_bytes`` at zero when the source
+    state is live on devices — that is the "no gather-to-host" contract
+    BENCH_reshard.json asserts.
+    """
+
+    __slots__ = ("device_bytes", "host_bytes", "leaves", "wall_s")
+
+    def __init__(self):
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self.leaves = 0
+        self.wall_s = 0.0
+
+    def add(self, route: str, nbytes: int) -> None:
+        if route == ROUTE_HOST:
+            self.host_bytes += int(nbytes)
+        else:
+            self.device_bytes += int(nbytes)
+        self.leaves += 1
+
+    def merge(self, other: "TransferStats") -> "TransferStats":
+        self.device_bytes += other.device_bytes
+        self.host_bytes += other.host_bytes
+        self.leaves += other.leaves
+        self.wall_s += other.wall_s
+        return self
+
+    @property
+    def total_bytes(self) -> int:
+        return self.device_bytes + self.host_bytes
+
+    def to_dict(self) -> dict:
+        return {
+            "device_bytes": int(self.device_bytes),
+            "host_bytes": int(self.host_bytes),
+            "total_bytes": int(self.total_bytes),
+            "leaves": int(self.leaves),
+            "wall_s": round(float(self.wall_s), 6),
+        }
+
+    def __repr__(self):
+        return (f"TransferStats(device={self.device_bytes}, "
+                f"host={self.host_bytes}, leaves={self.leaves})")
+
+
+def _leaf_nbytes(leaf) -> int:
+    a = np.asarray(leaf) if not isinstance(leaf, Array) else leaf
+    return int(np.prod(a.shape or (1,))) * np.dtype(a.dtype).itemsize
+
+
+def leaf_route(leaf) -> str:
+    """``device`` for live jax arrays, ``host`` for anything host-side."""
+    return ROUTE_DEVICE if isinstance(leaf, Array) else ROUTE_HOST
+
+
+def _put(leaf, sharding, route: str):
+    """Move one leaf onto ``sharding`` via its route (see module doc)."""
+    if route == ROUTE_DEVICE:
+        return jax.device_put(leaf, sharding)
+    arr = np.asarray(leaf)
+    if arr.ndim == 0:
+        # callback placement needs an indexable array; scalars are
+        # replicated trivially
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
+class _PlanEntry:
+    __slots__ = ("route", "sharding", "nbytes")
+
+    def __init__(self, route: str, sharding, nbytes: int):
+        self.route = route
+        self.sharding = sharding
+        self.nbytes = int(nbytes)
+
+
+class ReshardPlan:
+    """Per-leaf redistribution decisions for one pytree (plan half of
+    the plan/execute split). Built by :func:`plan_tree`; run with
+    :meth:`execute`."""
+
+    def __init__(self, treedef, entries: List[Optional[_PlanEntry]],
+                 n_from: Optional[int], n_to: Optional[int]):
+        self._treedef = treedef
+        self._entries = entries
+        self.n_from = n_from
+        self.n_to = n_to
+
+    def summary(self) -> dict:
+        real = [e for e in self._entries if e is not None]
+        return {
+            "n_from": self.n_from,
+            "n_to": self.n_to,
+            "leaves": len(real),
+            "bytes": sum(e.nbytes for e in real),
+            "routes": {
+                ROUTE_DEVICE: sum(1 for e in real
+                                  if e.route == ROUTE_DEVICE),
+                ROUTE_HOST: sum(1 for e in real if e.route == ROUTE_HOST),
+            },
+        }
+
+    def execute(self, tree, stats: Optional[TransferStats] = None
+                ) -> Tuple[Any, TransferStats]:
+        """Move the bytes. ``tree`` must have the structure the plan was
+        built from. Returns ``(new_tree, stats)``."""
+        stats = stats if stats is not None else TransferStats()
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"tree structure changed since planning: planned "
+                f"{self._treedef}, got {treedef}")
+        t0 = time.perf_counter()
+        out = []
+        for leaf, entry in zip(leaves, self._entries):
+            if entry is None:  # sharding_for declined this leaf
+                out.append(leaf)
+                continue
+            out.append(_put(leaf, entry.sharding, entry.route))
+            stats.add(entry.route, entry.nbytes)
+        stats.wall_s += time.perf_counter() - t0
+        return jax.tree_util.tree_unflatten(self._treedef, out), stats
+
+
+def plan_tree(tree, sharding_for: Callable[[Any], Any],
+              n_from: Optional[int] = None,
+              n_to: Optional[int] = None) -> ReshardPlan:
+    """Plan a per-leaf redistribution of ``tree``.
+
+    ``sharding_for(leaf)`` returns the target sharding for a leaf (or
+    None to leave it untouched). Routes are chosen per leaf from where
+    the data lives *now* (:func:`leaf_route`)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    entries: List[Optional[_PlanEntry]] = []
+    for leaf in leaves:
+        sh = sharding_for(leaf)
+        if sh is None:
+            entries.append(None)
+            continue
+        entries.append(_PlanEntry(leaf_route(leaf), sh, _leaf_nbytes(leaf)))
+    return ReshardPlan(treedef, entries, n_from, n_to)
+
+
+def plan_replicated(tree, mesh, n_from: Optional[int] = None) -> ReshardPlan:
+    """Every leaf replicated onto ``mesh`` (a TrainingMesh) — the
+    params/layer-state placement of every consumer (a model's params are
+    replicated over the data axis; TP/PP-sharded trees go through
+    :func:`plan_tree` with their own specs)."""
+    repl = mesh.replicated()
+    return plan_tree(tree, lambda leaf: repl, n_from=n_from,
+                     n_to=mesh.n_data)
+
+
+# --------------------------------------------------------------------------
+# ZeRO-1 flat-shard re-split (the odd-count padding discipline)
+# --------------------------------------------------------------------------
+def check_layouts_compatible(layout_from, layout_to) -> None:
+    """Two ShardedUpdateLayouts describe the same network iff their
+    groups cover the same (layer, name, size) entries in the same order
+    — only ``n_shards`` (and therefore padding/chunk) may differ."""
+    if len(layout_from.groups) != len(layout_to.groups):
+        raise ValueError(
+            f"layout group count mismatch: {len(layout_from.groups)} vs "
+            f"{len(layout_to.groups)} — these layouts describe different "
+            "networks")
+    for gi, (a, b) in enumerate(zip(layout_from.groups, layout_to.groups)):
+        ea = [(e.layer, e.name, e.size, e.offset) for e in a.entries]
+        eb = [(e.layer, e.name, e.size, e.offset) for e in b.entries]
+        if ea != eb or a.total != b.total or a.dtype != b.dtype:
+            raise ValueError(
+                f"layout group {gi} mismatch (entries/total/dtype): the "
+                "source and target layouts must be built from the same "
+                "network")
+
+
+def _resplit_flat(mat: Array, total: int, m: int, chunk_m: int) -> Array:
+    """(N, chunk_N) → (M, chunk_M) over the same logical vector: drop
+    the N-padding tail, re-pad to M's multiple, reshape. Pure device
+    ops — the all-gather implied by ``reshape(-1)`` happens inside the
+    source allocation, never through host."""
+    vec = mat.reshape(-1)
+    if vec.shape[0] != total:
+        vec = vec[:total]
+    pad = m * chunk_m - total
+    if pad:
+        vec = jnp.concatenate([vec, jnp.zeros((pad,), vec.dtype)])
+    return vec.reshape(m, chunk_m)
+
+
+def reshard_zero1(zopt: Sequence[dict], layout_from, layout_to,
+                  target_mesh, axis: str = "data",
+                  stats: Optional[TransferStats] = None
+                  ) -> Tuple[List[dict], TransferStats]:
+    """Re-split the per-group ZeRO-1 sharded opt state from
+    ``layout_from`` (N shards) to ``layout_to`` (M shards), placed
+    sharded over ``axis`` of ``target_mesh`` (a TrainingMesh, or None
+    for an unsharded single-device result).
+
+    Bit-exactness: the logical flat vector is preserved element-for-
+    element (padding zeros are dropped and re-minted, never read), so
+    ``reshard_zero1(reshard_zero1(z, A, B), B, A) == z`` and a fit
+    resumed from the result is bit-identical to an unsharded resume.
+    Returns ``(new_zopt, stats)`` — live-array sources move on the
+    device route (``host_bytes == 0``)."""
+    check_layouts_compatible(layout_from, layout_to)
+    stats = stats if stats is not None else TransferStats()
+    sh = (None if target_mesh is None
+          else NamedSharding(target_mesh.mesh, P(axis, None)))
+    t0 = time.perf_counter()
+    out: List[dict] = []
+    for grp_f, grp_t, slots in zip(layout_from.groups, layout_to.groups,
+                                   zopt):
+        new_slots = {}
+        for slot in sorted(slots):
+            mat = slots[slot]
+            route = leaf_route(mat)
+            if route == ROUTE_HOST:
+                mat = np.asarray(mat)
+                vec = mat.reshape(-1)[:grp_f.total]
+                pad = grp_t.padded - grp_t.total
+                if pad:
+                    vec = np.concatenate(
+                        [vec, np.zeros((pad,), vec.dtype)])
+                new = vec.reshape(layout_to.n_shards, grp_t.chunk)
+                placed = (_put(new, sh, ROUTE_HOST) if sh is not None
+                          else jnp.asarray(new))
+            else:
+                new = _resplit_flat(mat, grp_f.total, layout_to.n_shards,
+                                    grp_t.chunk)
+                placed = (jax.device_put(new, sh) if sh is not None
+                          else new)
+            stats.add(route, _leaf_nbytes(placed))
+            new_slots[slot] = placed
+        out.append(new_slots)
+    stats.wall_s += time.perf_counter() - t0
+    return out, stats
+
+
+# --------------------------------------------------------------------------
+# model-level placement + event recording (the consumer surface)
+# --------------------------------------------------------------------------
+def place_model(model, mesh, stats: Optional[TransferStats] = None,
+                n_from: Optional[int] = None) -> TransferStats:
+    """Place a model's params/layer-state (and, when present, its
+    device-resident fault state) replicated onto ``mesh`` — the
+    canonical-checkpoint → target-mesh half of elastic recovery and of
+    train-on-N/serve-on-M. Host-side leaves (fresh from a checkpoint
+    restore) travel the shard-sliced callback route; live arrays move
+    device-to-device."""
+    stats = stats if stats is not None else TransferStats()
+    for attr in ("params_", "state_", "fault_state_"):
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+        plan = plan_replicated(tree, mesh, n_from=n_from)
+        placed, stats = plan.execute(tree, stats)
+        setattr(model, attr, placed)
+    return stats
+
+
+def place_on_device(tree, device, stats: Optional[TransferStats] = None):
+    """Place every leaf of ``tree`` committed onto one ``device`` (the
+    tune/ trial-migration target: a pool slot is a single device).
+    Returns ``(tree, stats)``."""
+    sharding = jax.sharding.SingleDeviceSharding(device)
+    plan = plan_tree(tree, lambda leaf: sharding, n_to=1)
+    return plan.execute(tree, stats)
+
+
+def place_model_on_device(model, device,
+                          stats: Optional[TransferStats] = None
+                          ) -> TransferStats:
+    """:func:`place_model` for a single-device target pool slot."""
+    stats = stats if stats is not None else TransferStats()
+    model.params_, stats = place_on_device(model.params_, device, stats)
+    if getattr(model, "state_", None) is not None:
+        model.state_, stats = place_on_device(model.state_, device, stats)
+    if getattr(model, "opt_state_", None) is not None:
+        model.opt_state_, stats = place_on_device(model.opt_state_, device,
+                                                  stats)
+    if getattr(model, "fault_state_", None) is not None:
+        model.fault_state_, stats = place_on_device(model.fault_state_,
+                                                    device, stats)
+    return stats
+
+
+class reshard_event:
+    """Context manager recording ``reshard_start``/``reshard_done``
+    flight-recorder events around a reshard, with N→M and wall time —
+    the post-dropout black box shows exactly how long re-forming state
+    took and how many bytes moved which way.
+
+        with reshard_event(n_from=8, n_to=2, surface="elastic") as stats:
+            place_model(model, mesh, stats)
+
+    ``reshard_done.wall_ms`` is the LEDGER's wall time (what the
+    reshard ops themselves took), not the elapsed time of the wrapped
+    block — callers may build other state inside the block (the serving
+    engine constructs the whole engine there) and that must not inflate
+    the reported reshard cost. The block-elapsed clock is only reported
+    on ``reshard_failed``, where the ledger may be mid-flight.
+    """
+
+    def __init__(self, n_from: Optional[int], n_to: Optional[int],
+                 surface: str = "reshard",
+                 stats: Optional[TransferStats] = None):
+        self.n_from = n_from
+        self.n_to = n_to
+        self.surface = surface
+        self.stats = stats if stats is not None else TransferStats()
+
+    def __enter__(self) -> TransferStats:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        self._t0 = time.perf_counter()
+        _flight.record("reshard_start", n_from=self.n_from, n_to=self.n_to,
+                       surface=self.surface)
+        return self.stats
+
+    def __exit__(self, exc_type, exc, tb):
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        wall_ms = (time.perf_counter() - self._t0) * 1e3
+        if exc_type is not None:
+            _flight.record("reshard_failed", n_from=self.n_from,
+                           n_to=self.n_to, surface=self.surface,
+                           wall_ms=round(wall_ms, 3),
+                           error=exc_type.__name__)
+            return False
+        _flight.record("reshard_done", n_from=self.n_from, n_to=self.n_to,
+                       surface=self.surface,
+                       wall_ms=round(self.stats.wall_s * 1e3, 3),
+                       device_bytes=int(self.stats.device_bytes),
+                       host_bytes=int(self.stats.host_bytes))
+        return False
+
+
+def gather_to_host(tree, stats: Optional[TransferStats] = None):
+    """The legacy baseline the engine replaces: materialize every leaf
+    as a full host array (``host_bytes`` += everything). Kept as the A/B
+    comparator for bench.py ``reshard`` and for callers that genuinely
+    need host copies (checkpoint writes already have their own path)."""
+    stats = stats if stats is not None else TransferStats()
+    t0 = time.perf_counter()
+
+    def pull(leaf):
+        arr = np.asarray(leaf)
+        stats.add(ROUTE_HOST, _leaf_nbytes(arr))
+        return arr
+
+    out = jax.tree_util.tree_map(pull, tree)
+    stats.wall_s += time.perf_counter() - t0
+    return out, stats

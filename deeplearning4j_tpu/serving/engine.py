@@ -66,12 +66,42 @@ def conf_example_shape(conf) -> Optional[Tuple[int, ...]]:
 
 def _checkpoint_source(source: str) -> str:
     """Resolve a checkpoint zip from a path or directory (newest VALID
-    one via the fault-tolerance layer)."""
-    from deeplearning4j_tpu.train.faults import latest_valid_checkpoint
+    one via the fault-tolerance layer). An EXPLICIT zip path that fails
+    validation falls back to the newest valid sibling in its directory
+    instead of killing server start — a truncated newest checkpoint next
+    to keep-last-k valid older snapshots is exactly the crash the
+    retention policy exists for."""
+    from deeplearning4j_tpu.train.faults import (
+        latest_valid_checkpoint,
+        validate_checkpoint,
+    )
 
     if os.path.isdir(source):
         return latest_valid_checkpoint(source)
-    return source
+    if not os.path.exists(source):
+        # a missing path is a caller error (409 at the server), not a
+        # corrupt checkpoint to route around
+        raise FileNotFoundError(f"checkpoint {source!r} does not exist")
+    ok, reason = validate_checkpoint(source)
+    if ok:
+        return source
+    parent = os.path.dirname(os.path.abspath(source))
+    fallback = (latest_valid_checkpoint(parent, missing_ok=True)
+                if os.path.isdir(parent) else None)
+    if fallback is None:
+        raise ValueError(
+            f"checkpoint {source!r} is invalid ({reason}) and no valid "
+            f"sibling checkpoint exists in {parent!r}")
+    import warnings
+
+    warnings.warn(
+        f"checkpoint {source!r} is invalid ({reason}); serving the "
+        f"newest valid sibling {fallback!r} instead", stacklevel=3)
+    from deeplearning4j_tpu.obs import flight as _flight
+
+    _flight.record("checkpoint_fallback", requested=str(source),
+                   served=str(fallback), reason=reason)
+    return fallback
 
 
 class InferenceEngine:
@@ -94,6 +124,9 @@ class InferenceEngine:
         self.checkpoint_dir = checkpoint_dir
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self._compile_count = 0
+        #: byte ledger of the snapshot placement (parallel/reshard.py);
+        #: None for mesh-less engines (placement is implicit at dispatch)
+        self.reshard_stats = None
         self._reload_lock = threading.Lock()
         self._fingerprint: Optional[Tuple[float, int]] = None
         self.warm = False
@@ -125,15 +158,36 @@ class InferenceEngine:
     @classmethod
     def from_checkpoint(cls, source: str, **kwargs) -> "InferenceEngine":
         """Engine from a checkpoint zip or a checkpoint DIRECTORY (the
-        newest valid checkpoint; corrupt/truncated ones are skipped).
-        A directory also becomes the default ``/reload`` source."""
-        from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+        newest valid checkpoint; corrupt/truncated ones are skipped —
+        an explicit zip path that fails validation also falls back to
+        its newest valid sibling). A directory also becomes the default
+        ``/reload`` source.
+
+        Checkpoints are topology-portable: the canonical entries carry
+        no device-count assumptions, so a checkpoint written by an
+        8-device training mesh serves on 1 device (or any ``mesh``)
+        without a host-side re-gather — the train-on-N/serve-on-M leg
+        of parallel/reshard.py. The reshard is recorded as
+        ``reshard_start``/``reshard_done`` flight events with
+        N→M provenance from the checkpoint's ``meta.json``."""
+        from deeplearning4j_tpu.parallel import reshard as _reshard
+        from deeplearning4j_tpu.train.model_serializer import (
+            ModelGuesser,
+            ModelSerializer,
+        )
 
         path = _checkpoint_source(source)
+        topo = ModelSerializer.checkpoint_meta(path).get("topology") or {}
+        n_from = topo.get("n_devices")
         model = ModelGuesser.load_model_guess(path)
         if os.path.isdir(source):
             kwargs.setdefault("checkpoint_dir", source)
-        eng = cls(model, **kwargs)
+        mesh = kwargs.get("mesh")
+        n_to = mesh.n_data if mesh is not None else 1
+        with _reshard.reshard_event(n_from, n_to, surface="serving") as st:
+            eng = cls(model, **kwargs)
+            if eng.reshard_stats is not None:
+                st.merge(eng.reshard_stats)
         eng._snap.source = path
         eng._fingerprint = cls._path_fingerprint(path)
         from deeplearning4j_tpu.obs import flight as _flight
@@ -155,10 +209,14 @@ class InferenceEngine:
         conf_json = conf.to_json() if hasattr(conf, "to_json") else None
         fn = self._build_fn(model)
         if self.mesh is not None:
-            model.params_ = jax.device_put(model.params_,
-                                           self.mesh.replicated())
-            model.state_ = jax.device_put(model.state_,
-                                          self.mesh.replicated())
+            # replicated placement through the reshard planner: same
+            # device_put semantics as before, plus the byte ledger
+            # (reshard_stats) the from_checkpoint N→M event reports
+            from deeplearning4j_tpu.parallel import reshard as _reshard
+
+            stats = _reshard.TransferStats()
+            _reshard.place_model(model, self.mesh, stats)
+            self.reshard_stats = stats
         return _Snapshot(model, fn, conf_json, version, source)
 
     def _build_fn(self, model):

@@ -533,3 +533,399 @@ def load_latest_valid(directory: str):
     _flight.record("checkpoint_load", path=path,
                    iteration=int(getattr(model, "iteration", 0) or 0))
     return model, path
+
+
+# --------------------------------------------------------------------------
+# elastic recovery: survive device/host loss mid-fit
+# --------------------------------------------------------------------------
+class MeshFailureError(RuntimeError):
+    """A device or host dropped out of the training mesh mid-fit.
+    ``survivors`` (when known) is the device list still healthy; None
+    means "probe for them" (:func:`probe_devices`)."""
+
+    def __init__(self, message: str, survivors: Optional[Sequence] = None):
+        super().__init__(message)
+        self.survivors = None if survivors is None else list(survivors)
+
+
+class InjectedHostDropout(MeshFailureError):
+    """Deterministic mesh failure from :func:`host_dropout_injection`
+    — the chaos hook the elastic drill uses (a SIGKILLed host cannot be
+    staged portably on a single-host CPU mesh; dropping k virtual
+    devices at a chosen iteration exercises the identical recovery
+    path)."""
+
+
+class ElasticRecoveryExhaustedError(RuntimeError):
+    """Elastic recovery gave up: the retry budget ran out or the
+    surviving mesh fell below ``min_devices``. The newest valid
+    checkpoint is intact on disk — this error means "page a human",
+    not "state was lost"."""
+
+
+#: substrings (lowercased) that mark a runtime error as a mesh/collective
+#: failure rather than a programming error. Conservative on purpose: a
+#: NaN or shape bug must never be "recovered" by silently shrinking the
+#: mesh and replaying from the checkpoint.
+_MESH_FAILURE_MARKERS = (
+    "device unavailable",
+    "device is unavailable",
+    "failed to connect",
+    "connection reset",
+    "socket closed",
+    "heartbeat",
+    "coordination service",
+    "peer task",
+    "slice health",
+    "data transfer",
+    "network error",
+)
+
+
+def is_mesh_failure(exc: BaseException) -> bool:
+    """Does this exception look like the mesh lost a participant?
+    :class:`MeshFailureError` always qualifies; XLA/distributed runtime
+    errors qualify when their message carries a known transport/health
+    marker."""
+    if isinstance(exc, MeshFailureError):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _MESH_FAILURE_MARKERS)
+
+
+def probe_devices(devices: Sequence) -> List:
+    """The subset of ``devices`` that still completes a trivial
+    computation — the survivor roster when a failure did not name its
+    casualties. A transient failure probes all-healthy; the driver then
+    retries on the full mesh (and the attempt still counts against the
+    retry budget)."""
+    ok = []
+    for d in devices:
+        try:
+            x = jax.device_put(jnp.zeros((), jnp.float32), d)
+            (x + 1).block_until_ready()
+            ok.append(d)
+        except Exception:  # noqa: BLE001 — any failure marks it dead
+            continue
+    return ok
+
+
+# -- deterministic host-dropout injection (chaos hook) ----------------------
+_DROPOUT_INJECTION: Optional[Dict] = None
+
+
+def set_host_dropout_injection(at_iteration: Optional[int] = None,
+                               survivors: Optional[int] = None):
+    """Arm (or with None disarm) the one-shot host-dropout injector:
+    the elastic schedule raises :class:`InjectedHostDropout` with the
+    first ``survivors`` devices as the healthy roster just before host
+    iteration ``at_iteration`` dispatches. Returns the previous
+    setting."""
+    global _DROPOUT_INJECTION
+    prev = _DROPOUT_INJECTION
+    _DROPOUT_INJECTION = (
+        None if at_iteration is None
+        else {"at_iteration": int(at_iteration),
+              "survivors": int(survivors) if survivors is not None else None,
+              "fired": False})
+    return prev
+
+
+@contextlib.contextmanager
+def host_dropout_injection(at_iteration: int, survivors: int):
+    prev = set_host_dropout_injection(at_iteration, survivors)
+    try:
+        yield
+    finally:
+        global _DROPOUT_INJECTION
+        _DROPOUT_INJECTION = prev
+
+
+def check_host_dropout(iteration: int) -> None:
+    """Fire the armed injector (once) when ``iteration`` reaches it."""
+    inj = _DROPOUT_INJECTION
+    if inj is None or inj["fired"] or iteration < inj["at_iteration"]:
+        return
+    inj["fired"] = True
+    n = inj["survivors"]
+    survivors = jax.devices()[:n] if n is not None else None
+    raise InjectedHostDropout(
+        f"injected host dropout before iteration {iteration} "
+        f"({'survivors=' + str(n) if n is not None else 'survivors unknown'})",
+        survivors=survivors)
+
+
+_EPOCH_CLOCK_CLS = None
+
+
+def _epoch_clock(it0: int, e0: int, n_batches: int):
+    """Listener keeping ``model.epoch`` equal to the flattened
+    schedule's logical epoch during an elastic fit. The driver runs the
+    whole schedule as ONE ParallelWrapper epoch per recovery segment,
+    so without this every mid-run checkpoint would carry the segment's
+    entry epoch — a crash + ``--resume`` would then restore (and print,
+    and key ``save_every_n_epochs`` listeners on) the wrong epoch.
+    Attached BEFORE the driver's CheckpointListener so each checkpoint
+    serializes the epoch a plain epochs-loop fit would have recorded at
+    that iteration. Class built lazily to keep faults.py's
+    lazy-listener-import discipline."""
+    global _EPOCH_CLOCK_CLS
+    if _EPOCH_CLOCK_CLS is None:
+        from deeplearning4j_tpu.train.listeners import TrainingListener
+
+        class _EpochClockListener(TrainingListener):
+            # epoch must track every step, or a bundled segment would
+            # checkpoint end-of-bundle epochs mid-bundle
+            requires_per_step_state = True
+
+            def __init__(self, it0, e0, n_batches):
+                self.it0 = int(it0)
+                self.e0 = int(e0)
+                self.n = max(int(n_batches), 1)
+
+            def iteration_done(self, model, iteration, epoch):
+                # epoch bumps AFTER an epoch's last iteration_done
+                # (multilayer/wrapper fit paths), so the last step of
+                # logical epoch e still records e: (done-1)//n, not
+                # done//n
+                done = max(int(iteration) - self.it0, 1)
+                model.epoch = self.e0 + (done - 1) // self.n
+
+        _EPOCH_CLOCK_CLS = _EpochClockListener
+    return _EPOCH_CLOCK_CLS(it0, e0, n_batches)
+
+
+class _ElasticSchedule:
+    """DataSetIterator facade over the driver's flattened batch
+    schedule: yields batches from ``start``, checking the dropout
+    injector against the GLOBAL iteration number before each dispatch.
+    Deliberately not async (``async_supported() → False``): the
+    injection must raise on the fit thread, inside the fit loop, like a
+    real collective failure would."""
+
+    def __init__(self, schedule: Sequence, start: int, it0: int):
+        self.schedule = schedule
+        self.start = int(start)
+        self.it0 = int(it0)
+
+    def __iter__(self):
+        for i in range(self.start, len(self.schedule)):
+            check_host_dropout(self.it0 + i)
+            yield self.schedule[i]
+
+    def reset(self) -> None:
+        pass
+
+    def batch(self) -> int:
+        f = getattr(self.schedule[0], "features", None)
+        return int(f.shape[0]) if hasattr(f, "shape") else 0
+
+    def async_supported(self) -> bool:
+        return False
+
+
+class ElasticFitDriver:
+    """Fit that survives losing part of its mesh.
+
+    Wraps a data-parallel fit (ParallelWrapper over a TrainingMesh of
+    ``devices``) with the elastic recovery loop ROADMAP item 1 names:
+
+    1. checkpoint every ``checkpoint_every_n_iterations`` optimizer
+       steps (atomic, keep-last-k — the PR-2 discipline);
+    2. when the fit dies of a mesh failure (:func:`is_mesh_failure`;
+       injected drills raise :class:`InjectedHostDropout`), record
+       ``mesh_shrink``, re-form a smaller mesh from the survivors
+       (``error.survivors`` when the failure names them, else
+       :func:`probe_devices`);
+    3. reload ``latest_valid_checkpoint`` and reshard it onto the
+       survivor mesh (parallel/reshard.py — ``reshard_start/done``
+       flight events carry N→M, wall time and the byte ledger);
+    4. resume the batch schedule in place from the checkpoint's
+       iteration (``elastic_resume``) — the restored RNG chain and
+       fault state make the resumed fit bit-identical to an
+       uninterrupted fit over the same mesh sequence;
+    5. give up with :class:`ElasticRecoveryExhaustedError` (and an
+       ``elastic_giveup`` event + black-box dump) after ``max_retries``
+       recoveries or when fewer than ``min_devices`` devices survive.
+       ``backoff_s`` sleeps ``backoff_s * 2**attempt`` before each
+       recovery (a real fleet re-admits hosts; give them a moment).
+
+    The driver owns ``self.model`` — recovery replaces the dead model
+    object with the restored one (listeners carried over), and ``fit``
+    returns it.
+    """
+
+    def __init__(self, model, checkpoint_dir: str, *,
+                 devices: Optional[Sequence] = None,
+                 min_devices: int = 1,
+                 max_retries: int = 2,
+                 backoff_s: float = 0.0,
+                 checkpoint_every_n_iterations: int = 1,
+                 keep_last: Optional[int] = 3,
+                 sharded_update: Optional[bool] = None,
+                 steps_per_call: Optional[int] = None):
+        if not checkpoint_dir:
+            raise ValueError("ElasticFitDriver needs a checkpoint_dir — "
+                             "recovery resumes from its newest valid "
+                             "checkpoint")
+        self.model = model
+        self.checkpoint_dir = str(checkpoint_dir)
+        self.devices = None if devices is None else list(devices)
+        self.min_devices = max(int(min_devices), 1)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self.checkpoint_every = max(int(checkpoint_every_n_iterations), 1)
+        self.keep_last = keep_last
+        self.sharded_update = sharded_update
+        self.steps_per_call = steps_per_call
+        self.recoveries = 0
+        from deeplearning4j_tpu.train.listeners import CheckpointListener
+
+        self._ckpt_listener = CheckpointListener(
+            self.checkpoint_dir,
+            save_every_n_iterations=self.checkpoint_every,
+            keep_mode="last",
+            keep_last=int(keep_last) if keep_last else 1)
+
+    # -- internals -----------------------------------------------------------
+    def _attach(self, model, clock=None) -> None:
+        if clock is not None and clock not in model.listeners:
+            # the clock must run BEFORE the checkpointer so each
+            # checkpoint serializes the already-synced logical epoch
+            at = (model.listeners.index(self._ckpt_listener)
+                  if self._ckpt_listener in model.listeners
+                  else len(model.listeners))
+            model.listeners.insert(at, clock)
+        if self._ckpt_listener not in model.listeners:
+            model.add_listeners(self._ckpt_listener)
+
+    def _detach(self, model, clock=None) -> None:
+        if clock is not None and clock in model.listeners:
+            model.listeners.remove(clock)
+        if self._ckpt_listener in model.listeners:
+            model.listeners.remove(self._ckpt_listener)
+
+    def _giveup(self, cause: BaseException, survivors: int,
+                detail: str) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("elastic_giveup", attempts=self.recoveries,
+                       survivors=survivors,
+                       min_devices=self.min_devices,
+                       max_retries=self.max_retries)
+        rec = _flight.default_flight_recorder()
+        if rec.dump_dir is not None:
+            rec.dump(reason="elastic_giveup")
+        raise ElasticRecoveryExhaustedError(
+            f"elastic recovery exhausted after {self.recoveries} "
+            f"attempt(s): {survivors} surviving device(s), "
+            f"min_devices={self.min_devices}, "
+            f"max_retries={self.max_retries}; {detail}") from cause
+
+    def _recover(self, err: MeshFailureError, mesh,
+                 it_lo: Optional[int] = None,
+                 it_hi: Optional[int] = None):
+        import time as _time
+
+        from deeplearning4j_tpu.obs import flight as _flight
+        from deeplearning4j_tpu.parallel import reshard as _reshard
+        from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+        devices = mesh.devices_flat()
+        n_from = len(devices)
+        survivors = err.survivors
+        if survivors is None:
+            survivors = probe_devices(devices)
+        self.recoveries += 1
+        _flight.record("mesh_shrink", n_from=n_from, n_to=len(survivors),
+                       attempt=self.recoveries,
+                       error=type(err).__name__, message=str(err)[:200])
+        if (self.recoveries > self.max_retries
+                or len(survivors) < self.min_devices):
+            self._giveup(err, len(survivors),
+                         f"newest valid checkpoint is intact in "
+                         f"{self.checkpoint_dir!r}")
+        if self.backoff_s:
+            _time.sleep(self.backoff_s * (2 ** (self.recoveries - 1)))
+        try:
+            path = latest_valid_checkpoint(self.checkpoint_dir)
+        except FileNotFoundError as fnf:
+            # died before the first checkpoint landed: there is nothing
+            # to resume FROM — a typed give-up, not a raw traceback
+            self._giveup(fnf, len(survivors),
+                         f"the mesh failed before any checkpoint was "
+                         f"written to {self.checkpoint_dir!r}")
+        old = self.model
+        new_model = ModelGuesser.load_model_guess(path)
+        it = int(new_model.iteration)
+        if it_lo is not None and not (it_lo <= it <= it_hi):
+            # the newest checkpoint in the dir is from a DIFFERENT run
+            # (a stale dir, or two runs sharing one checkpoint_dir):
+            # adopting it would either declare the fit complete with a
+            # foreign model or replay the schedule from a negative
+            # offset — a typed give-up, not silent corruption
+            self._giveup(err, len(survivors),
+                         f"newest valid checkpoint {path!r} is at "
+                         f"iteration {it}, outside this fit's range "
+                         f"[{it_lo}, {it_hi}] — it belongs to a "
+                         f"different run; point checkpoint_dir at a "
+                         f"fresh directory")
+        # the dead model's listeners (incl. the driver's checkpointer)
+        # carry over — recovery is invisible to observers
+        new_model.add_listeners(*old.listeners)
+        # shrink, not a fresh mesh: its guard is what keeps elastic
+        # re-formation DP-only (model-tiling axes can't lose devices)
+        new_mesh = mesh.shrink(survivors)
+        with _reshard.reshard_event(n_from, len(survivors),
+                                    surface="elastic") as stats:
+            _reshard.place_model(new_model, new_mesh, stats, n_from=n_from)
+        self.model = new_model
+        _flight.record("elastic_resume",
+                       iteration=int(new_model.iteration),
+                       n_devices=len(survivors), checkpoint=str(path))
+        return new_mesh
+
+    # -- the fit -------------------------------------------------------------
+    def fit(self, batches, epochs: int = 1):
+        """Train ``self.model`` over ``batches`` (a finite iterable of
+        DataSets) for ``epochs`` passes, surviving mesh failures.
+        Returns the (possibly replaced) trained model."""
+        from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+        base = list(batches)
+        schedule = base * int(epochs)
+        if not schedule:
+            return self.model
+        it0 = int(self.model.iteration)
+        e0 = int(getattr(self.model, "epoch", 0))
+        clock = _epoch_clock(it0, e0, len(base))
+        devices = (list(self.devices) if self.devices is not None
+                   else list(jax.devices()))
+        mesh = TrainingMesh(data=len(devices), devices=devices)
+        try:
+            while True:
+                done = int(self.model.iteration) - it0
+                if done >= len(schedule):
+                    # the flattened schedule ran as N recovery segments
+                    # of one ParallelWrapper epoch each; restore the
+                    # caller's epoch arithmetic
+                    self.model.epoch = e0 + int(epochs)
+                    return self.model
+                self._attach(self.model, clock)
+                pw = ParallelWrapper(self.model, mesh=mesh,
+                                     sharded_update=self.sharded_update,
+                                     steps_per_call=self.steps_per_call)
+                stream = _ElasticSchedule(schedule, done, it0)
+                try:
+                    pw.fit(stream, epochs=1)
+                except MeshFailureError as e:
+                    mesh = self._recover(e, mesh, it0,
+                                         it0 + len(schedule))
+                except Exception as e:  # noqa: BLE001 — triaged below
+                    if not is_mesh_failure(e):
+                        raise
+                    mesh = self._recover(MeshFailureError(str(e)), mesh,
+                                         it0, it0 + len(schedule))
+        finally:
+            self._detach(self.model, clock)

@@ -59,15 +59,7 @@ class ModelSerializer:
                     z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
                 state_flat = _flatten_state(model.state_)
                 z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
-                z.writestr(
-                    META_ENTRY,
-                    json.dumps({
-                        "iteration": model.iteration,
-                        "epoch": model.epoch,
-                        "model_type": type(model).__name__,
-                        "framework": "deeplearning4j_tpu",
-                    }),
-                )
+                z.writestr(META_ENTRY, json.dumps(_build_meta(model)))
                 if normalizer is not None:
                     z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
             os.replace(tmp, path)
@@ -99,6 +91,7 @@ class ModelSerializer:
                 meta = json.loads(z.read(META_ENTRY).decode())
                 net.iteration = meta.get("iteration", 0)
                 net.epoch = meta.get("epoch", 0)
+                _restore_meta_state(net, meta)
         return net
 
     @staticmethod
@@ -194,6 +187,84 @@ def _unflatten_state(net, vec: np.ndarray) -> None:
         n = int(np.prod(s[name].shape))
         s[name] = jnp.asarray(vec[off : off + n].reshape(s[name].shape), s[name].dtype)
         off += n
+
+
+def _build_meta(model) -> dict:
+    """``meta.json`` body. Besides the iteration/epoch counters this
+    carries everything a device-count-portable resume needs that is not
+    derivable from the weight entries (parallel/reshard.py):
+
+    - ``rng``: the dropout-RNG chain position (the model's live PRNG
+      key), so a resumed fit consumes the exact stream an uninterrupted
+      run would have — including runs whose chain diverged from the
+      pure split-``iteration``-times derivation (NaN-skipped bundles,
+      tuner fast-forwards);
+    - ``fault_state``: the in-graph fault-guard carry (bad/consec/good
+      counters, loss scale) so Adam's ``good_count`` bias-correction
+      clock and the loss-scale schedule survive a crash exactly;
+    - ``topology``: device count + backend the checkpoint was written
+      on — provenance only (the weight entries are canonical and
+      topology-free), consumed for reshard N→M flight events.
+    """
+    import jax
+
+    # the mesh the fit ACTUALLY used, read off the params' sharding —
+    # not len(jax.devices()): a --workers 2 run on an 8-device host must
+    # record n_devices=2 or every downstream N→M provenance is wrong
+    n_devices = None
+    for leaf in jax.tree_util.tree_leaves(getattr(model, "params_", None)):
+        if isinstance(leaf, jax.Array):
+            try:
+                n_devices = len(leaf.sharding.device_set)
+            except Exception:  # noqa: BLE001 — sharding is advisory meta
+                pass
+            break
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    meta = {
+        "iteration": model.iteration,
+        "epoch": model.epoch,
+        "model_type": type(model).__name__,
+        "framework": "deeplearning4j_tpu",
+        "topology": {
+            "n_devices": n_devices,
+            "backend": jax.default_backend(),
+        },
+    }
+    rng = getattr(model, "_rng", None)
+    if rng is not None:
+        meta["rng"] = [int(v) for v in np.asarray(rng).ravel()]
+    fstate = getattr(model, "fault_state_", None)
+    if fstate is not None:
+        host = {k: np.asarray(v) for k, v in fstate.items()}
+        fs = {k: (float(v) if np.issubdtype(v.dtype, np.floating)
+                  else int(v))
+              for k, v in host.items()}
+        meta["fault_state"] = fs
+    return meta
+
+
+def _restore_meta_state(net, meta: dict) -> None:
+    """Inverse of the portable-resume half of :func:`_build_meta`
+    (missing keys — pre-PR-8 checkpoints — leave the freshly
+    initialized chain/state, the old behavior)."""
+    rng = meta.get("rng")
+    if rng is not None and hasattr(net, "_rng"):
+        net._rng = jnp.asarray(np.asarray(rng, np.uint32))
+    fs = meta.get("fault_state")
+    if fs and hasattr(net, "fault_state_"):
+        st = {
+            "bad_count": jnp.asarray(int(fs.get("bad_count", 0)), jnp.int32),
+            "consec": jnp.asarray(int(fs.get("consec", 0)), jnp.int32),
+            "good_count": jnp.asarray(
+                int(fs.get("good_count", net.iteration)), jnp.int32),
+        }
+        if "loss_scale" in fs:
+            st["loss_scale"] = jnp.asarray(float(fs["loss_scale"]),
+                                           jnp.float32)
+            st["scale_good"] = jnp.asarray(int(fs.get("scale_good", 0)),
+                                           jnp.int32)
+        net.fault_state_ = st
 
 
 class ModelGuesser:

@@ -8,6 +8,7 @@ from deeplearning4j_tpu.tune.runner import (
     Study,
     StudyResult,
     as_objective,
+    migrate_trial,
     population_compatible,
     search_estimator,
 )
@@ -51,6 +52,7 @@ __all__ = [
     "as_objective",
     "asha_rungs",
     "grid_search",
+    "migrate_trial",
     "mlp_factory",
     "population_compatible",
     "random_search",

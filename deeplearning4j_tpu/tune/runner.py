@@ -809,6 +809,42 @@ class Study:
 
 
 # --------------------------------------------------------------------------
+# trial migration between device pools (parallel/reshard.py consumer)
+# --------------------------------------------------------------------------
+def migrate_trial(store, trial_id: str, target_device=None,
+                  target_mesh=None):
+    """Move a trial's training state to a different device pool
+    mid-study: reload its newest valid checkpoint and place it onto
+    ``target_device`` (a pool slot) or ``target_mesh`` (a TrainingMesh
+    — e.g. promoting the leader to a data-parallel pool), with the
+    reshard recorded as ``reshard_start/done`` flight events and byte
+    accounting. The checkpoint's ``meta.json`` restores the dropout-RNG
+    chain and fault state, so the migrated trial continues the exact
+    stream it would have used on its old pool. Returns
+    ``(model, checkpoint_path)``."""
+    if (target_device is None) == (target_mesh is None):
+        raise ValueError("pass exactly one of target_device / target_mesh")
+    from deeplearning4j_tpu.parallel import reshard as _reshard
+    from deeplearning4j_tpu.train.model_serializer import ModelGuesser
+
+    ckpt = store.latest_trial_checkpoint(trial_id)
+    if ckpt is None:
+        raise FileNotFoundError(
+            f"trial {trial_id!r} has no valid checkpoint to migrate")
+    model = ModelGuesser.load_model_guess(ckpt)
+    n_to = target_mesh.n_data if target_mesh is not None else 1
+    with _reshard.reshard_event(None, n_to, surface="tune") as stats:
+        if target_mesh is not None:
+            _reshard.place_model(model, target_mesh, stats)
+        else:
+            _reshard.place_model_on_device(model, target_device, stats)
+    log.info("tune: migrated trial %s (iteration %s) to %s", trial_id,
+             model.iteration,
+             target_device if target_device is not None else target_mesh)
+    return model, ckpt
+
+
+# --------------------------------------------------------------------------
 # estimator bridge (satellite): a search space over a sklearn-style
 # estimator — NeuralNetClassifier/NeuralNetRegressor or anything with
 # get_params/set_params/fit/score

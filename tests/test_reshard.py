@@ -1,0 +1,625 @@
+"""Elastic mesh resharding (parallel/reshard.py) + ElasticFitDriver.
+
+What is asserted BIT-exact vs what carries a documented tolerance
+(ARCHITECTURE.md § Elastic resharding):
+
+- N→M→N flat-shard round trips, reshard-vs-unsharded-resume state, and
+  the recovery machinery itself (checkpoint → reshard → resume vs a
+  direct continuation over the SAME mesh sequence) are bit-exact —
+  params, Adam slots, fault state and the dropout-RNG chain.
+- Training the same batches on DIFFERENT device counts is NOT bit-equal
+  (float reduction order over the data axis, ~1e-7 on this mesh); that
+  is a property of data parallelism, not of the recovery path, and the
+  drill comparator therefore replays the same mesh sequence.
+"""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.data import DataSet, ExistingDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import flight
+from deeplearning4j_tpu.parallel import reshard
+from deeplearning4j_tpu.parallel.mesh import TrainingMesh
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.zero import (
+    build_layout,
+    shard_model_opt_state,
+)
+from deeplearning4j_tpu.train import faults
+from deeplearning4j_tpu.train.faults import (
+    ElasticFitDriver,
+    ElasticRecoveryExhaustedError,
+    InjectedHostDropout,
+    MeshFailureError,
+    host_dropout_injection,
+    is_mesh_failure,
+)
+from deeplearning4j_tpu.train.model_serializer import ModelSerializer
+from deeplearning4j_tpu.updaters import Adam
+
+N_IN, N_OUT = 5, 3
+
+
+@pytest.fixture(autouse=True)
+def _isolate_flight_recorder():
+    """The default flight recorder is process-global; tests here mutate
+    its dump_dir and ring — restore both so other suites' black-box
+    assertions stay isolated."""
+    rec = flight.default_flight_recorder()
+    prev_dir = rec.dump_dir
+    yield
+    rec.dump_dir = prev_dir
+    rec.clear()
+
+
+def _build(seed=7, fault_policy=True, hidden=13):
+    b = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2)))
+    if fault_policy:
+        b = b.fault_policy(True)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _batches(n, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return [DataSet(rng.normal(size=(batch, N_IN)).astype(np.float32),
+                    np.eye(N_OUT, dtype=np.float32)[
+                        rng.integers(0, N_OUT, batch)])
+            for _ in range(n)]
+
+
+def _flat_params(model):
+    return np.concatenate([np.asarray(v).ravel()
+                           for d in model.params_ for v in d.values()])
+
+
+def _flat_opt(model):
+    return np.concatenate([np.asarray(s).ravel()
+                           for d in model.opt_state_
+                           for v in d.values() for s in v.values()])
+
+
+class TestZero1Reshard:
+    def _trained(self):
+        m = _build()
+        for ds in _batches(3):
+            m.fit(ds)
+        return m
+
+    def test_roundtrip_8_2_8_bit_exact_no_host_bytes(self):
+        """The acceptance round trip: (8, chunk8) → (2, chunk2) → back,
+        bit-exact for every Adam slot, with zero bytes staged through
+        host (transfer-size accounting) — and through a layout whose
+        padding is NONZERO so the odd-count discipline is exercised
+        (hidden=11 → 102 trainable floats: pads to 104 on 8 shards,
+        exactly 102 on 2)."""
+        m = _build(hidden=11)
+        for ds in _batches(3):
+            m.fit(ds)
+        mesh8 = TrainingMesh(data=8)
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        l8, l2 = build_layout(m, 8), build_layout(m, 2)
+        assert l8.n_padding() > 0, "pick a hidden size with odd totals"
+        z8 = shard_model_opt_state(m, l8, mesh=mesh8.mesh)
+
+        z2, st_down = reshard.reshard_zero1(z8, l8, l2, mesh2)
+        z8b, st_up = reshard.reshard_zero1(z2, l2, l8, mesh8)
+        assert st_down.host_bytes == 0 and st_up.host_bytes == 0
+        assert st_down.device_bytes > 0
+        for grp8, a, b in zip(l8.groups, z8, z8b):
+            assert sorted(a) == sorted(b)
+            for k in a:
+                assert a[k].shape == (8, grp8.chunk)
+                np.testing.assert_array_equal(np.asarray(a[k]),
+                                              np.asarray(b[k]))
+        # target geometry follows the M-padding discipline exactly
+        for grp2, slots in zip(l2.groups, z2):
+            for k in slots:
+                assert slots[k].shape == (2, grp2.chunk)
+                assert "data" in str(slots[k].sharding.spec)
+
+    def test_reshard_equals_unsharded_resume(self):
+        """The tentpole numerics contract: resharding the LIVE flat
+        shards N→M lands bit-identically on what a canonical (unsharded)
+        checkpoint resume would shard onto the M mesh."""
+        m = self._trained()
+        mesh8 = TrainingMesh(data=8)
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        l8, l2 = build_layout(m, 8), build_layout(m, 2)
+        z8 = shard_model_opt_state(m, l8, mesh=mesh8.mesh)
+        z2_direct, st = reshard.reshard_zero1(z8, l8, l2, mesh2)
+        assert st.host_bytes == 0
+        # the unsharded path: canonical per-layer slots → M shards
+        z2_canonical = shard_model_opt_state(m, l2, mesh=mesh2.mesh)
+        for a, b in zip(z2_direct, z2_canonical):
+            for k in a:
+                np.testing.assert_array_equal(np.asarray(a[k]),
+                                              np.asarray(b[k]))
+
+    def test_canonical_equivalence_through_m(self):
+        m = self._trained()
+        mesh8 = TrainingMesh(data=8)
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        l8, l2 = build_layout(m, 8), build_layout(m, 2)
+        z8 = shard_model_opt_state(m, l8, mesh=mesh8.mesh)
+        z2, _ = reshard.reshard_zero1(z8, l8, l2, mesh2)
+        merged = l2.unshard_opt_state(z2, m.opt_state_)
+        for i, layer in enumerate(m.opt_state_):
+            for k, slots in layer.items():
+                for s in slots:
+                    np.testing.assert_array_equal(
+                        np.asarray(merged[i][k][s]), np.asarray(slots[s]))
+
+    def test_incompatible_layouts_raise(self):
+        m = self._trained()
+        other = _build(hidden=17)
+        with pytest.raises(ValueError, match="same network"):
+            reshard.check_layouts_compatible(build_layout(m, 8),
+                                             build_layout(other, 2))
+
+    def test_host_route_resplit(self):
+        """A host-side (numpy) flat-shard source — what elastic recovery
+        sees right after a checkpoint restore — re-splits through the
+        host route with the bytes accounted, same bits."""
+        m = self._trained()
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        l8, l2 = build_layout(m, 8), build_layout(m, 2)
+        z8_host = [{k: np.asarray(v) for k, v in slots.items()}
+                   for slots in shard_model_opt_state(m, l8)]
+        z2, st = reshard.reshard_zero1(z8_host, l8, l2, mesh2)
+        assert st.host_bytes > 0 and st.device_bytes == 0
+        z2_ref, _ = reshard.reshard_zero1(
+            shard_model_opt_state(m, l8, mesh=TrainingMesh(data=8).mesh),
+            l8, l2, mesh2)
+        for a, b in zip(z2, z2_ref):
+            for k in a:
+                np.testing.assert_array_equal(np.asarray(a[k]),
+                                              np.asarray(b[k]))
+
+
+class TestPlanExecute:
+    def test_plan_routes_and_summary(self):
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        tree = {"live": jax.numpy.ones((4, 4)),
+                "host": np.ones((8,), np.float32),
+                "skip": None}
+        plan = reshard.plan_replicated(tree, mesh2, n_from=8)
+        s = plan.summary()
+        assert s["n_from"] == 8 and s["n_to"] == 2
+        assert s["routes"][reshard.ROUTE_DEVICE] == 1
+        assert s["routes"][reshard.ROUTE_HOST] == 1
+        out, st = plan.execute(tree)
+        assert st.host_bytes == 32 and st.device_bytes == 64
+        assert out["skip"] is None
+        for k in ("live", "host"):
+            assert isinstance(out[k], jax.Array)
+            np.testing.assert_array_equal(np.asarray(out[k]),
+                                          np.asarray(tree[k]))
+
+    def test_execute_rejects_changed_structure(self):
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        plan = reshard.plan_replicated({"a": np.ones(3)}, mesh2)
+        with pytest.raises(ValueError, match="structure changed"):
+            plan.execute({"a": np.ones(3), "b": np.ones(3)})
+
+    def test_gather_to_host_accounts_everything(self):
+        tree = {"a": jax.numpy.ones((16,), jax.numpy.float32)}
+        out, st = reshard.gather_to_host(tree)
+        assert isinstance(out["a"], np.ndarray)
+        assert st.host_bytes == 64 and st.device_bytes == 0
+
+
+class TestCheckpointPortability:
+    def test_meta_carries_rng_fault_state_topology(self, tmp_path):
+        m = _build()
+        for ds in _batches(2):
+            m.fit(ds)
+        path = str(tmp_path / "ckpt.zip")
+        ModelSerializer.write_model(m, path)
+        meta = ModelSerializer.checkpoint_meta(path)
+        # topology is the mesh the fit ACTUALLY used (read off the
+        # params' sharding), not the host's device count: a plain
+        # single-device fit records 1 even on this 8-device host
+        assert meta["topology"]["n_devices"] == 1
+        assert meta["topology"]["backend"] == jax.default_backend()
+        assert meta["rng"] == [int(v) for v in np.asarray(m._rng).ravel()]
+        assert meta["fault_state"]["good_count"] == 2
+
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        np.testing.assert_array_equal(np.asarray(restored._rng),
+                                      np.asarray(m._rng))
+        for k in m.fault_state_:
+            assert np.asarray(restored.fault_state_[k]) == np.asarray(
+                m.fault_state_[k])
+
+        # ... and a ParallelWrapper fit records the wrapper's mesh size,
+        # not len(jax.devices()) — the --workers 2 case the provenance
+        # exists for
+        pw = ParallelWrapper(
+            m, mesh=TrainingMesh(data=2, devices=jax.devices()[:2]))
+        pw.fit(ExistingDataSetIterator(_batches(1)), epochs=1)
+        path2 = str(tmp_path / "ckpt2.zip")
+        ModelSerializer.write_model(m, path2)
+        assert (ModelSerializer.checkpoint_meta(path2)
+                ["topology"]["n_devices"] == 2)
+
+    def test_legacy_checkpoint_without_new_keys_loads(self, tmp_path):
+        """Pre-PR-8 checkpoints (no rng/fault_state/topology in meta)
+        keep the old semantics: fresh chain, fault state rebuilt from
+        the iteration counter at fit entry."""
+        from deeplearning4j_tpu.train.model_serializer import META_ENTRY
+
+        m = _build()
+        for ds in _batches(2):
+            m.fit(ds)
+        path = str(tmp_path / "ckpt.zip")
+        ModelSerializer.write_model(m, path)
+        legacy = str(tmp_path / "legacy.zip")
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(legacy, "w") as zout:
+            for name in zin.namelist():
+                data = zin.read(name)
+                if name == META_ENTRY:
+                    meta = json.loads(data.decode())
+                    for k in ("rng", "fault_state", "topology"):
+                        meta.pop(k, None)
+                    data = json.dumps(meta).encode()
+                zout.writestr(name, data)
+        restored = ModelSerializer.restore_multi_layer_network(legacy)
+        assert restored.iteration == 2
+        assert restored.fault_state_ is None
+        np.testing.assert_array_equal(
+            np.asarray(restored._rng),
+            np.asarray(jax.random.PRNGKey(7)))
+
+    def test_loss_scale_round_trips(self, tmp_path):
+        m = _build()
+        policy = faults.FaultPolicy(loss_scaling=True,
+                                    init_loss_scale=1024.0)
+        m.fault_state_ = faults.init_fault_state(policy, scaling=True,
+                                                 start_step=5)
+        path = str(tmp_path / "ckpt.zip")
+        ModelSerializer.write_model(m, path)
+        restored = ModelSerializer.restore_multi_layer_network(path)
+        assert float(restored.fault_state_["loss_scale"]) == 1024.0
+        assert int(restored.fault_state_["scale_good"]) == 0
+        assert int(restored.fault_state_["good_count"]) == 5
+
+
+class TestMeshFailureTaxonomy:
+    def test_is_mesh_failure_classification(self):
+        assert is_mesh_failure(MeshFailureError("x"))
+        assert is_mesh_failure(InjectedHostDropout("x"))
+        assert is_mesh_failure(RuntimeError("DEADLINE: heartbeat timeout"
+                                            .lower()))
+        assert is_mesh_failure(RuntimeError("coordination service error"))
+        assert not is_mesh_failure(ValueError("shape mismatch (4,) (8,)"))
+        assert not is_mesh_failure(RuntimeError("NaN loss"))
+
+    def test_probe_devices_all_healthy(self):
+        devs = jax.devices()
+        assert faults.probe_devices(devs) == list(devs)
+
+    def test_injection_is_one_shot(self):
+        with host_dropout_injection(at_iteration=3, survivors=4):
+            faults.check_host_dropout(2)  # below threshold: no fire
+            with pytest.raises(InjectedHostDropout) as ei:
+                faults.check_host_dropout(3)
+            assert len(ei.value.survivors) == 4
+            faults.check_host_dropout(5)  # already fired: silent
+        faults.check_host_dropout(99)  # disarmed outside the context
+
+    def test_mesh_shrink_rejects_model_axes(self):
+        mesh = TrainingMesh(data=4, model=2)
+        with pytest.raises(ValueError, match="data-parallel only"):
+            mesh.shrink(jax.devices()[:2])
+
+
+class TestElasticDrill:
+    def _comparator(self, batches, split, n_to, sharded=False):
+        """Uninterrupted fit over the SAME mesh sequence the recovery
+        produces (8-mesh before the checkpoint, survivor mesh after) —
+        the bit-exact oracle. Cross-device-count reduction order is the
+        one documented tolerance, so a pure-8 uninterrupted run is only
+        allclose-comparable, and that is asserted separately."""
+        comp = _build()
+        pw8 = ParallelWrapper(comp, mesh=TrainingMesh(data=8),
+                              sharded_update=sharded)
+        pw8.fit(ExistingDataSetIterator(batches[:split]), epochs=1)
+        comp.epoch = 0
+        pw_m = ParallelWrapper(
+            comp, mesh=TrainingMesh(data=n_to,
+                                    devices=jax.devices()[:n_to]),
+            sharded_update=sharded)
+        pw_m.fit(ExistingDataSetIterator(batches[split:]), epochs=1)
+        return comp
+
+    def test_host_dropout_recovery_bit_identical(self, tmp_path):
+        """THE acceptance drill: injected host dropout mid-fit on the
+        8-device mesh → survivors re-form a 4-device mesh → resume from
+        latest_valid_checkpoint → final params AND Adam slots
+        bit-identical to an uninterrupted run over the same batch
+        schedule (and same mesh sequence), with the full
+        mesh_shrink → reshard_start → reshard_done → elastic_resume
+        sequence in the flight-recorder dump."""
+        batches = _batches(12)
+        rec = flight.default_flight_recorder()
+        rec.clear()
+        rec.dump_dir = str(tmp_path)
+
+        drill = _build()
+        driver = ElasticFitDriver(drill, str(tmp_path / "ckpts"),
+                                  max_retries=2)
+        with host_dropout_injection(at_iteration=6, survivors=4):
+            driver.fit(batches, epochs=1)
+        drill = driver.model
+        assert driver.recoveries == 1
+        assert drill.iteration == 12 and drill.epoch == 1
+
+        comp = self._comparator(batches, split=6, n_to=4)
+        np.testing.assert_array_equal(_flat_params(drill),
+                                      _flat_params(comp))
+        np.testing.assert_array_equal(_flat_opt(drill), _flat_opt(comp))
+        np.testing.assert_array_equal(np.asarray(drill._rng),
+                                      np.asarray(comp._rng))
+        # documented tolerance vs the pure-8 uninterrupted run:
+        # reduction order across device counts, nothing else
+        pure8 = _build()
+        ParallelWrapper(pure8, mesh=TrainingMesh(data=8)).fit(
+            ExistingDataSetIterator(batches), epochs=1)
+        np.testing.assert_allclose(_flat_params(drill),
+                                   _flat_params(pure8), atol=5e-6)
+
+        # the black box shows the recovery timeline, in order
+        path = rec.dump(reason="drill")
+        with open(path) as f:
+            body = json.load(f)
+        kinds = [e["kind"] for e in body["events"]]
+        want = ["mesh_shrink", "reshard_start", "reshard_done",
+                "elastic_resume"]
+        idx = [kinds.index(k) for k in want]
+        assert idx == sorted(idx), f"bad event order: {kinds}"
+        done = body["events"][kinds.index("reshard_done")]
+        assert done["n_from"] == 8 and done["n_to"] == 4
+        assert done["wall_ms"] >= 0 and done["host_bytes"] == 0
+        # cli flight-dump renders the sequence
+        text = flight.format_dump(body)
+        for k in want:
+            assert k in text
+
+    def test_drill_zero1_sharded_update(self, tmp_path):
+        """Same drill under the ZeRO-1 sharded weight update: recovery
+        re-shards the checkpointed canonical slots onto the survivor
+        mesh and stays bit-identical to the same-mesh-sequence run."""
+        batches = _batches(8)
+        drill = _build()
+        driver = ElasticFitDriver(drill, str(tmp_path / "ckpts"),
+                                  max_retries=1, sharded_update=True)
+        with host_dropout_injection(at_iteration=4, survivors=2):
+            driver.fit(batches, epochs=1)
+        drill = driver.model
+        comp = self._comparator(batches, split=4, n_to=2, sharded=True)
+        np.testing.assert_array_equal(_flat_params(drill),
+                                      _flat_params(comp))
+        np.testing.assert_array_equal(_flat_opt(drill), _flat_opt(comp))
+
+    def test_giveup_typed_error_and_event(self, tmp_path):
+        batches = _batches(6)
+        rec = flight.default_flight_recorder()
+        rec.clear()
+        drill = _build()
+        driver = ElasticFitDriver(drill, str(tmp_path / "ckpts"),
+                                  max_retries=0)
+        with host_dropout_injection(at_iteration=3, survivors=4):
+            with pytest.raises(ElasticRecoveryExhaustedError,
+                               match="intact"):
+                driver.fit(batches, epochs=1)
+        kinds = [e["kind"] for e in rec.events()]
+        assert "elastic_giveup" in kinds
+        assert "elastic_resume" not in kinds
+        # state is NOT lost: the newest checkpoint is on disk and valid
+        assert faults.latest_valid_checkpoint(str(tmp_path / "ckpts"))
+
+    def test_foreign_checkpoint_typed_giveup(self, tmp_path):
+        """A stale/foreign checkpoint_dir is never silently adopted:
+        recovery validates the restored iteration against this fit's
+        range — a foreign newest checkpoint (here iteration 500) would
+        otherwise declare the fit complete with someone else's model."""
+        ckdir = str(tmp_path / "ckpts")
+        foreign = _build(seed=11)
+        foreign.fit(_batches(1)[0])
+        foreign.iteration = 500
+        faults.save_checkpoint(foreign, ckdir)
+        drill = _build()
+        # cadence so high this run writes no checkpoint of its own
+        driver = ElasticFitDriver(drill, ckdir, max_retries=2,
+                                  checkpoint_every_n_iterations=10**6)
+        with host_dropout_injection(at_iteration=2, survivors=4):
+            with pytest.raises(ElasticRecoveryExhaustedError,
+                               match="different run"):
+                driver.fit(_batches(6), epochs=1)
+
+    def test_midrun_checkpoints_carry_logical_epoch(self, tmp_path):
+        """The flattened schedule runs as one ParallelWrapper epoch, but
+        every checkpoint must carry the epoch a plain epochs-loop fit
+        would have recorded at that iteration — that is what a crash +
+        --resume restores, and what save_every_n_epochs listeners key
+        on."""
+        ckdir = str(tmp_path / "ckpts")
+        drill = _build()
+        driver = ElasticFitDriver(drill, ckdir, keep_last=100)
+        driver.fit(_batches(4), epochs=3)
+        assert driver.model.epoch == 3
+        metas = sorted(
+            (ModelSerializer.checkpoint_meta(os.path.join(ckdir, f))
+             for f in os.listdir(ckdir) if f.endswith(".zip")),
+            key=lambda m: m["iteration"])
+        assert [m["iteration"] for m in metas] == list(range(1, 13))
+        # iterations 1-4 are epoch 0, 5-8 epoch 1, 9-12 epoch 2 (the
+        # bump to 3 lands after the last iteration's checkpoint)
+        assert [m["epoch"] for m in metas] == [(i - 1) // 4
+                                               for i in range(1, 13)]
+
+    def test_min_devices_floor(self, tmp_path):
+        batches = _batches(6)
+        drill = _build()
+        driver = ElasticFitDriver(drill, str(tmp_path / "ckpts"),
+                                  max_retries=3, min_devices=4)
+        with host_dropout_injection(at_iteration=3, survivors=2):
+            with pytest.raises(ElasticRecoveryExhaustedError):
+                driver.fit(batches, epochs=1)
+
+    def test_non_mesh_failure_propagates(self, tmp_path):
+        """A programming error (bad shapes) must never be 'recovered' by
+        silently shrinking the mesh and replaying the checkpoint."""
+        drill = _build()
+        driver = ElasticFitDriver(drill, str(tmp_path / "ckpts"))
+        bad = _batches(3)
+        bad[1] = DataSet(bad[1].features[:, :2], bad[1].labels)  # shape bug
+        with pytest.raises(Exception) as ei:
+            driver.fit(bad, epochs=1)
+        assert not isinstance(ei.value, ElasticRecoveryExhaustedError)
+        assert driver.recoveries == 0
+
+
+class TestServingFallback:
+    def _ckpt_dir(self, tmp_path):
+        m = _build(fault_policy=False)
+        for ds in _batches(2):
+            m.fit(ds)
+        d = str(tmp_path / "ckpts")
+        p1 = faults.save_checkpoint(m, d, stem="ckpt_a")
+        m.fit(_batches(1)[0])
+        p2 = faults.save_checkpoint(m, d, stem="ckpt_b")
+        return d, p1, p2, m
+
+    def test_explicit_corrupt_path_falls_back(self, tmp_path):
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+        d, p1, p2, m = self._ckpt_dir(tmp_path)
+        faults.truncate_file(p2)
+        rec = flight.default_flight_recorder()
+        rec.clear()
+        with pytest.warns(UserWarning, match="newest valid sibling"):
+            eng = InferenceEngine.from_checkpoint(p2)
+        assert str(eng.describe()["source"]) == p1
+        kinds = [e["kind"] for e in rec.events()]
+        assert "checkpoint_fallback" in kinds
+        x = np.zeros((2, N_IN), np.float32)
+        assert eng.infer(x).shape[1] == N_OUT
+
+    def test_explicit_corrupt_path_no_sibling_raises(self, tmp_path):
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+        m = _build(fault_policy=False)
+        p = str(tmp_path / "only.zip")
+        ModelSerializer.write_model(m, p)
+        faults.truncate_file(p)
+        with pytest.raises(ValueError, match="no valid sibling"):
+            InferenceEngine.from_checkpoint(p)
+
+    def test_from_checkpoint_records_reshard_provenance(self, tmp_path):
+        """Train-on-8/serve-on-1: the checkpoint's topology provenance
+        (written on the 8-device mesh) lands in the reshard events."""
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+        m = _build(fault_policy=False)
+        ParallelWrapper(m, mesh=TrainingMesh(data=8)).fit(
+            ExistingDataSetIterator(_batches(2)), epochs=1)
+        d = str(tmp_path / "ckpts")
+        faults.save_checkpoint(m, d, stem="ckpt_a")
+        rec = flight.default_flight_recorder()
+        rec.clear()
+        eng = InferenceEngine.from_checkpoint(d)
+        evs = {e["kind"]: e for e in rec.events()}
+        assert "reshard_start" in evs and "reshard_done" in evs
+        assert evs["reshard_done"]["n_from"] == 8
+        assert evs["reshard_done"]["n_to"] == 1
+        x = np.zeros((2, N_IN), np.float32)
+        np.testing.assert_allclose(eng.infer(x), m.output(x), atol=1e-6)
+
+    def test_serve_on_submesh(self, tmp_path):
+        """Any-topology serving: an 8-device training checkpoint serves
+        on a 2-device mesh, outputs equal to the source model."""
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+
+        d, p1, p2, m = self._ckpt_dir(tmp_path)
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        eng = InferenceEngine.from_checkpoint(d, mesh=mesh2)
+        assert eng.reshard_stats is not None
+        assert eng.reshard_stats.leaves > 0
+        x = np.zeros((4, N_IN), np.float32)
+        np.testing.assert_allclose(eng.infer(x), m.output(x), atol=1e-6)
+
+
+class TestTuneMigration:
+    def test_migrate_trial_between_pools(self, tmp_path):
+        from deeplearning4j_tpu.tune import migrate_trial
+        from deeplearning4j_tpu.tune.store import TrialStore
+
+        store = TrialStore(str(tmp_path / "study"))
+        m = _build()
+        for ds in _batches(3):
+            m.fit(ds)
+        store.save_trial_checkpoint(m, "t0001", rung_index=0, keep_last=2)
+        rec = flight.default_flight_recorder()
+        rec.clear()
+
+        target = jax.devices()[3]
+        moved, ckpt = migrate_trial(store, "t0001", target_device=target)
+        assert moved.iteration == 3
+        np.testing.assert_array_equal(_flat_params(moved), _flat_params(m))
+        np.testing.assert_array_equal(_flat_opt(moved), _flat_opt(m))
+        np.testing.assert_array_equal(np.asarray(moved._rng),
+                                      np.asarray(m._rng))
+        leaf = moved.params_[0]["W"]
+        assert list(leaf.devices()) == [target]
+        kinds = [e["kind"] for e in rec.events()]
+        assert "reshard_done" in kinds
+
+        # ...and onto a data-parallel pool (mesh target)
+        mesh2 = TrainingMesh(data=2, devices=jax.devices()[:2])
+        moved2, _ = migrate_trial(store, "t0001", target_mesh=mesh2)
+        np.testing.assert_array_equal(_flat_params(moved2),
+                                      _flat_params(m))
+
+    def test_migrate_unknown_trial_raises(self, tmp_path):
+        from deeplearning4j_tpu.tune import migrate_trial
+        from deeplearning4j_tpu.tune.store import TrialStore
+
+        store = TrialStore(str(tmp_path / "study"))
+        with pytest.raises(FileNotFoundError, match="no valid checkpoint"):
+            migrate_trial(store, "nope", target_device=jax.devices()[0])
+
+    def test_migrate_requires_exactly_one_target(self, tmp_path):
+        from deeplearning4j_tpu.tune import migrate_trial
+        from deeplearning4j_tpu.tune.store import TrialStore
+
+        store = TrialStore(str(tmp_path / "study"))
+        with pytest.raises(ValueError, match="exactly one"):
+            migrate_trial(store, "t0", target_device=None, target_mesh=None)
+
+
+class TestDriverConfig:
+    def test_driver_requires_checkpoint_dir(self):
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            ElasticFitDriver(_build(), "")
+
+    def test_driver_empty_schedule_noop(self, tmp_path):
+        m = _build()
+        driver = ElasticFitDriver(m, str(tmp_path / "ckpts"))
+        assert driver.fit([], epochs=1) is m
+        assert m.iteration == 0
