@@ -130,6 +130,22 @@ def serve_main(argv) -> int:
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip bucket pre-compilation (first request per "
                          "shape then pays the compile)")
+    ap.add_argument("--gen-slots", type=int, default=0,
+                    help="enable POST /generate with this many continuous-"
+                         "batching decode slots (0 = off); the model must "
+                         "have an incremental-decode path (TransformerLM "
+                         "KV cache or a recurrent net's carried state)")
+    ap.add_argument("--gen-max-length", type=int, default=None,
+                    help="decode slab length per slot (default: the "
+                         "model's max_length / 256 for recurrent nets); "
+                         "prompt + max_new must fit it")
+    ap.add_argument("--gen-prefill-buckets", default=None,
+                    help="comma-separated prompt-length buckets for "
+                         "prefill padding (default: the model's "
+                         "serving_seq_buckets hint, else powers of two)")
+    ap.add_argument("--gen-queue-limit", type=int, default=64,
+                    help="bounded generation admission queue; beyond it "
+                         "requests are rejected 503 (backpressure)")
     ap.add_argument("--smoke", action="store_true",
                     help="serve ONE local request through the HTTP stack, "
                          "print the result, shut down (CI gate)")
@@ -198,12 +214,43 @@ def serve_main(argv) -> int:
                       f"FLOPs/example at bucket {cost['bucket']} "
                       "(MFU gauge live on /metrics)", flush=True)
 
+    generation = None
+    if args.gen_slots > 0:
+        from deeplearning4j_tpu.serving.generate import GenerationEngine
+        from deeplearning4j_tpu.serving.metrics import GenerationMetrics
+
+        gen_buckets = (None if args.gen_prefill_buckets is None
+                       else [int(t)
+                             for t in args.gen_prefill_buckets.split(",")])
+        try:
+            generation = GenerationEngine(
+                engine.model, n_slots=args.gen_slots,
+                max_length=args.gen_max_length,
+                prefill_buckets=gen_buckets,
+                queue_limit=args.gen_queue_limit,
+                metrics=GenerationMetrics(registry=default_registry()))
+        except TypeError as e:
+            print(f"generation disabled: {e}", flush=True)
+        else:
+            if not args.no_warmup:
+                rep = generation.warmup()
+                print(f"generation warmup: buckets {rep.get('buckets')}, "
+                      f"compiles {rep.get('compiles')}, "
+                      f"{rep.get('seconds')}s", flush=True)
+            print(f"generation: {generation.n_slots} slots x "
+                  f"max_length {generation.max_length} "
+                  f"({generation.backend.kind} backend, "
+                  f"{generation.memory_report['cache_bytes']:,} cache "
+                  "bytes)", flush=True)
+
     server = InferenceServer(
         engine, host=args.host, port=args.port,
         batch_limit=args.batch_limit, max_wait_ms=args.max_wait_ms,
-        queue_limit=args.queue_limit)
+        queue_limit=args.queue_limit, generation=generation)
     print(f"listening on http://{args.host}:{server.port} "
-          "(POST /predict, /predict_npy, /reload; GET /healthz, /metrics)",
+          "(POST /predict, /predict_npy"
+          + (", /generate" if generation is not None else "")
+          + ", /reload; GET /healthz, /metrics)",
           flush=True)
     if args.smoke:
         import http.client

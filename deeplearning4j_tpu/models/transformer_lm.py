@@ -186,6 +186,24 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
     return x + h @ bp["W2"] + bp["b2"]
 
 
+class ContextWindowExceeded(ValueError):
+    """prompt_len + max_new would overflow the model's fixed
+    ``max_length`` context window (the KV cache slab / positional table
+    bound). Typed so serving layers can reject with a 4xx naming the
+    limit instead of a bare ValueError; carries the numbers as
+    attributes for programmatic handling."""
+
+    def __init__(self, prompt_len: int, max_new: int, max_length: int):
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.max_length = int(max_length)
+        super().__init__(
+            f"prompt ({prompt_len}) + max_new ({max_new}) exceeds the "
+            f"model's max_length context window ({max_length}); shorten "
+            f"the prompt, reduce max_new, or use generate() (which "
+            f"windows to the most recent max_length tokens)")
+
+
 def _validate_sampling(temperature: float, top_k: int, top_p: float) -> None:
     if (top_k or top_p) and temperature <= 0:
         raise ValueError("top_k/top_p sampling requires temperature > 0")
@@ -224,24 +242,117 @@ def _sample_next(logits: np.ndarray, temperature: float, top_k: int,
     return nxt, rng
 
 
-def init_decode_cache(cfg: TransformerLMConfig, batch: int) -> Dict:
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Shared in-graph sampling filter: (b, V) fp32 logits →
+    temperature-scaled, top-k- and nucleus-filtered logits. The policy
+    knobs may be scalars (one policy for the batch — the solo fused
+    decode) or per-row (b,) arrays (the continuous-batching engine: each
+    slot its own policy); every op is row-wise either way, so a row
+    filtered among other slots is bit-identical to the same row filtered
+    alone. All policy decisions are data-dependent ``where`` selects —
+    ONE compiled program covers greedy and every knob combination."""
+    V = logits.shape[-1]
+
+    def col(x):  # scalar stays scalar; (b,) broadcasts per row
+        return x if jnp.ndim(x) == 0 else x[:, None]
+
+    t = jnp.where(temperature > 0, temperature, 1.0)
+    l = logits / col(t)
+    # top-k: keep the k highest (filter active only for 0 < k < V)
+    k_eff = jnp.clip(top_k, 1, V)
+    use_k = (top_k > 0) & (top_k < V)
+    sorted_asc = jnp.sort(l, axis=-1)
+    kth = jnp.take_along_axis(
+        sorted_asc, jnp.broadcast_to(col(V - k_eff),
+                                     (l.shape[0], 1)), axis=-1)
+    l = jnp.where(col(use_k) & (l < kth), -jnp.inf, l)
+    # nucleus: smallest prefix of descending-prob tokens reaching top_p
+    use_p = (top_p > 0.0) & (top_p < 1.0)
+    order = jnp.argsort(-l, axis=-1)
+    sl = jnp.take_along_axis(l, order, -1)
+    p_sorted = jnp.exp(sl - sl.max(-1, keepdims=True))
+    p_sorted = p_sorted / p_sorted.sum(-1, keepdims=True)
+    cum = jnp.cumsum(p_sorted, -1)
+    # keep tokens up to AND including the one crossing p (host parity)
+    cut = cum - p_sorted >= col(top_p)
+    sl = jnp.where(col(use_p) & cut, -jnp.inf, sl)
+    inv = jnp.argsort(order, axis=-1)
+    return jnp.take_along_axis(sl, inv, -1)
+
+
+def sample_next_device(logits, temperature, top_k, top_p, key):
+    """In-graph mirror of :func:`_sample_next`: (b, V) fp32 logits →
+    ((b,) int32 next ids, advanced key). One key chain for the whole
+    batch, exactly like the host sampler — the solo
+    ``generate_cached`` fused path.
+
+    Parity: greedy and temperature/top-k outputs are bit-identical to
+    the host sampler for the same key (sort/compare/divide are exact and
+    the categorical draw uses the same key chain). top-p's cumsum may
+    differ from NumPy's in reduction order, so nucleus CUTOFFS can
+    differ at ties on the boundary — tolerance documented in
+    ARCHITECTURE § Continuous batching. The key is split every call
+    (data-independent chain) even under greedy, which ignores it."""
+    l = _filter_logits(logits, temperature, top_k, top_p)
+    key, sub = jax.random.split(key)
+    sampled = jax.random.categorical(sub, l)
+    nxt = jnp.where(temperature <= 0, jnp.argmax(logits, axis=-1), sampled)
+    return nxt.astype(jnp.int32), key
+
+
+def sample_next_rows(logits, temperature, top_k, top_p, keys):
+    """Per-row variant for the continuous-batching engine: (b, V)
+    logits, per-row policy knobs (b,) and per-row keys (b, 2) → ((b,)
+    ids, advanced keys). The filter is the shared BATCHED implementation
+    (vmapping the sorts is ruinously slow on XLA:CPU); only the
+    per-key split + categorical draw are vmapped, and the draw uses a
+    (1, V) lane exactly like a solo b=1 call — so lane s is bit-
+    identical to ``sample_next_device(logits[s:s+1], ..., keys[s])``
+    (counter-based PRNG + vmap semantics), which is what makes engine
+    output ≡ solo output."""
+    l = _filter_logits(logits, temperature, top_k, top_p)
+    splits = jax.vmap(jax.random.split)(keys)  # (b, 2, 2)
+    nkeys, subs = splits[:, 0], splits[:, 1]
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row[None])[0])(subs, l)
+    nxt = jnp.where(temperature <= 0, jnp.argmax(logits, axis=-1), sampled)
+    return nxt.astype(jnp.int32), nkeys
+
+
+def init_decode_cache(cfg: TransformerLMConfig, batch: int,
+                      max_length: Optional[int] = None) -> Dict:
     """Preallocated per-layer KV cache for single-token decoding: static
     (L, b, heads, max_length, head_dim) buffers + a position counter —
-    TPU-friendly (no growing shapes; writes are dynamic_update slices)."""
+    TPU-friendly (no growing shapes; writes are dynamic_update slices).
+    ``max_length`` overrides the slab's time extent (the continuous-
+    batching engine sizes its slots independently of the model's full
+    window); default is ``cfg.max_length``."""
     cd = _cdtype(cfg) or jnp.float32
     hd = cfg.d_model // cfg.n_heads
-    shape = (cfg.n_layers, batch, cfg.n_heads, cfg.max_length, hd)
+    T = cfg.max_length if max_length is None else int(max_length)
+    shape = (cfg.n_layers, batch, cfg.n_heads, T, hd)
     return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd),
             "pos": jnp.zeros((), jnp.int32)}
 
 
 def prefill_cache(cfg: TransformerLMConfig, params: Dict[str, Array],
-                  cache: Dict, ids: Array):
+                  cache: Dict, ids: Array, length=None):
     """Batched prompt prefill: ids (b, Tp) int32 into a fresh cache →
     (last-position logits (b, V) fp32, cache with pos=Tp). One device
     launch regardless of prompt length (causal attention within the
     prompt, K/V written as one slice per layer); MoE routing competes all
-    b*Tp prompt tokens, exactly like ``forward``."""
+    b*Tp prompt tokens, exactly like ``forward``.
+
+    ``length`` (traced scalar int32, <= Tp) marks the REAL prompt length
+    when ids is right-padded up to a bucketed Tp: logits are gathered at
+    position length-1 and the cache's pos is set to length. Causal
+    attention makes end-padding exact for dense models — position i
+    attends only to <= i, so pad positions can never influence real
+    ones; their K/V is written but masked from every future decode read
+    (decode masks to <= pos) and overwritten as decoding advances. The
+    one exception is MoE (cfg.n_experts > 0), where pad tokens compete
+    for expert capacity — callers keep MoE prefill unbucketed (see
+    ``TransformerLM.generate_cached``)."""
     cd = _cdtype(cfg)
     b, Tp = ids.shape
     hn = cfg.n_heads
@@ -283,11 +394,17 @@ def prefill_cache(cfg: TransformerLMConfig, params: Dict[str, Array],
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["blocks"], cache["k"], cache["v"]))
-    x = _ln(x[:, -1], params["lnf_g"], params["lnf_b"], cd)
+    if length is None:
+        x_last = x[:, -1]
+        pos_out = jnp.asarray(Tp, jnp.int32)
+    else:
+        pos_out = jnp.asarray(length, jnp.int32)
+        x_last = jax.lax.dynamic_index_in_dim(x, pos_out - 1, axis=1,
+                                              keepdims=False)
+    x_last = _ln(x_last, params["lnf_g"], params["lnf_b"], cd)
     head = params["head"].astype(cd) if cd is not None else params["head"]
-    logits = (x @ head).astype(jnp.float32)
-    return logits, {"k": new_k, "v": new_v,
-                    "pos": jnp.asarray(Tp, jnp.int32)}
+    logits = (x_last @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": pos_out}
 
 
 def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
@@ -298,6 +415,14 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
     decoding vs the O(T²) full-forward loop; greedy-parity tested against
     ``forward`` in tests/test_moe.py.
 
+    ``cache["pos"]`` may be a scalar (every row at the same position —
+    the single-request path) or a per-row (b,) vector (the continuous-
+    batching engine: each slot carries its own position; K/V writes
+    become a per-row scatter and the attention mask is per-row). The
+    attention math is row-independent either way, so a row decoded among
+    other slots is bit-identical to the same row decoded alone
+    (parity-asserted in tests/test_generate.py).
+
     MoE note: decode routes only the b current-step tokens (per-step
     capacity), while the full forward competes all window tokens; when
     training-time capacity BINDS (dropped tokens), cached decoding can
@@ -305,14 +430,21 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
     token is dropped."""
     cd = _cdtype(cfg)
     pos = cache["pos"]
-    x = params["embed"][ids_1] + jnp.take(params["pos"], pos, axis=0)[None, :]
+    per_row = getattr(pos, "ndim", 0) == 1
+    T = cache["k"].shape[3]
+    ptab = jnp.take(params["pos"], pos, axis=0)  # clip-mode gather
+    x = params["embed"][ids_1] + (ptab if per_row else ptab[None, :])
     if cd is not None:
         x = x.astype(cd)
     b = x.shape[0]
     hn = cfg.n_heads
     d = cfg.d_model
     scale = 1.0 / math.sqrt(d // hn)
-    valid = (jnp.arange(cfg.max_length) <= pos)  # (T,)
+    if per_row:
+        valid = jnp.arange(T)[None, :] <= pos[:, None]  # (b, T)
+        wp = jnp.minimum(pos, T - 1)  # clamped per-row write index
+    else:
+        valid = (jnp.arange(T) <= pos)  # (T,)
 
     def body(x, xs):
         bp, kc, vc = xs  # kc/vc: (b, hn, T, hd)
@@ -325,10 +457,18 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
             return (a_in @ W).reshape(b, hn, -1)
 
         q, k, v = head_proj(bp["Wq"]), head_proj(bp["Wk"]), head_proj(bp["Wv"])
-        kc = jax.lax.dynamic_update_index_in_dim(kc, k.astype(kc.dtype), pos, 2)
-        vc = jax.lax.dynamic_update_index_in_dim(vc, v.astype(vc.dtype), pos, 2)
+        if per_row:
+            rows = jnp.arange(b)
+            kc = kc.at[rows, :, wp].set(k.astype(kc.dtype))
+            vc = vc.at[rows, :, wp].set(v.astype(vc.dtype))
+        else:
+            kc = jax.lax.dynamic_update_index_in_dim(
+                kc, k.astype(kc.dtype), pos, 2)
+            vc = jax.lax.dynamic_update_index_in_dim(
+                vc, v.astype(vc.dtype), pos, 2)
         scores = jnp.einsum("bhd,bhtd->bht", q, kc).astype(jnp.float32) * scale
-        scores = jnp.where(valid[None, None, :], scores, -1e30)
+        scores = jnp.where(valid[:, None, :] if per_row
+                           else valid[None, None, :], scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1).astype(kc.dtype)
         o = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(b, d).astype(x.dtype)
         x = x + o @ bp["Wo"] + bp["bo"]
@@ -352,6 +492,28 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
     head = params["head"].astype(cd) if cd is not None else params["head"]
     logits = (x @ head).astype(jnp.float32)
     return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
+
+
+def prefill_bucket_lengths(max_length: int, hint=None):
+    """Ascending prompt-length bucket list for prefill padding — the
+    ``serving_seq_buckets`` discipline applied to the decode path: every
+    prefill pads its prompt up to one of these lengths, so the jitted
+    prefill compiles a BOUNDED program set instead of one program per
+    distinct prompt length. ``hint`` (a model's ``serving_seq_buckets``)
+    is filtered to <= max_length; default is powers of two from 8. The
+    list always ends at ``max_length`` so any window-legal prompt has a
+    bucket."""
+    max_length = int(max_length)
+    if hint:
+        bs = sorted({int(t) for t in hint if 0 < int(t) <= max_length})
+    else:
+        bs, b = [], 8
+        while b < max_length:
+            bs.append(b)
+            b *= 2
+    if not bs or bs[-1] != max_length:
+        bs.append(max_length)
+    return bs
 
 
 def forward(cfg: TransformerLMConfig, params: Dict[str, Array], ids: Array,
@@ -445,6 +607,11 @@ class TransformerLM(ZooModel):
 
     name = "transformerlm"
 
+    #: prompt-length buckets for KV-cache prefill (filtered to the
+    #: instance's max_length at use; see ``prefill_bucket_lengths``) —
+    #: the generation counterpart of the forward path's seq buckets
+    serving_seq_buckets = (16, 32, 64, 128, 256, 512)
+
     def __init__(self, vocab_size: int = 1000, d_model: int = 256,
                  n_heads: int = 4, n_layers: int = 4, mlp_ratio: int = 4,
                  max_length: int = 512, seed: int = 123, n_experts: int = 0,
@@ -461,9 +628,22 @@ class TransformerLM(ZooModel):
         )
         self.params_: Optional[Dict] = None
         self.opt_state_: Optional[Dict] = None
+        #: no layer running-state (the InferenceEngine snapshot surface
+        #: reads this attribute on every served model)
+        self.state_ = None
         self._jit_cache: Dict = {}
+        #: fn-name → number of XLA programs traced (bumped at trace time
+        #: inside the jitted callables — the retrace-guard instrument,
+        #: same pattern as InferenceEngine.compile_count)
+        self.trace_counts: Dict[str, int] = {}
         self.iteration = 0
         self.score_ = None
+
+    def _bump_trace(self, key: str) -> None:
+        counts = getattr(self, "trace_counts", None)
+        if counts is None:  # models deserialized from older checkpoints
+            counts = self.trace_counts = {}
+        counts[key] = counts.get(key, 0) + 1
 
     def init(self):
         self.params_ = init_params(self.cfg)
@@ -523,6 +703,16 @@ class TransformerLM(ZooModel):
         return np.asarray(self._jit_cache["fwd"](self.params_,
                                                  jnp.asarray(ids, jnp.int32)))
 
+    def output(self, x, mask=None) -> np.ndarray:
+        """Generic serving surface (the InferenceEngine fallback path —
+        lets ``cli serve --model transformerlm`` stand up /predict next
+        to /generate): token ids (b, T) → fp32 logits (b, T, V)."""
+        return self.logits(np.asarray(x).astype(np.int32))
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params_))
+
     def generate(self, prompt_ids: np.ndarray, max_new: int = 20,
                  temperature: float = 0.0, rng=None, top_k: int = 0,
                  top_p: float = 0.0) -> np.ndarray:
@@ -547,45 +737,82 @@ class TransformerLM(ZooModel):
             ids = np.concatenate([ids, nxt[:, None]], axis=1)
         return ids
 
+    def prefill_buckets(self):
+        """The bounded prefill program set: prompt lengths pad up to
+        these (class hint filtered to this instance's max_length)."""
+        return prefill_bucket_lengths(self.cfg.max_length,
+                                      self.serving_seq_buckets)
+
     def generate_cached(self, prompt_ids: np.ndarray, max_new: int = 20,
                         temperature: float = 0.0, rng=None, top_k: int = 0,
                         top_p: float = 0.0) -> np.ndarray:
         """KV-cache decoding: the prompt prefills per-layer K/V buffers,
         then each new token is one O(T) ``decode_step`` instead of the
         O(T²) full-forward loop of ``generate`` (identical outputs —
-        parity-tested). prompt_len + max_new must fit ``max_length``."""
+        parity-tested; see ``sample_next_device`` for the one documented
+        top-p tolerance). Raises :class:`ContextWindowExceeded` (a
+        ValueError naming the limit) when prompt_len + max_new would
+        overflow ``max_length`` — ``generate``'s windowing cannot apply
+        here, the KV slab is the window.
+
+        Zero host round-trips in the decode loop: sampling is fused into
+        the jitted prefill/decode programs (``sample_next_device``), the
+        sampled token feeds the next step as a device array, and the
+        token stack is read back ONCE at the end. Prompt lengths pad up
+        to ``prefill_buckets()`` so prefill compiles a bounded program
+        set (the dense causal math is padding-exact; MoE prompts skip
+        bucketing because pad tokens would compete for expert capacity —
+        that path keeps one program per distinct prompt length).
+        ``trace_counts`` records programs traced per function — the
+        retrace-guard instrument."""
         ids = np.asarray(prompt_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None]
-        _validate_sampling(temperature, top_k, top_p)
         if ids.shape[1] + max_new > self.cfg.max_length:
-            raise ValueError(
-                f"prompt ({ids.shape[1]}) + max_new ({max_new}) exceeds "
-                f"max_length {self.cfg.max_length}"
-            )
+            raise ContextWindowExceeded(ids.shape[1], max_new,
+                                        self.cfg.max_length)
+        _validate_sampling(temperature, top_k, top_p)
+        if max_new <= 0:
+            return ids
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        if "decode" not in self._jit_cache:
-            self._jit_cache["decode"] = jax.jit(
-                lambda p, c, t: decode_step(self.cfg, p, c, t),
-                donate_argnums=(1,),
-            )
-            # prefill compiles per distinct prompt length
-            self._jit_cache["prefill"] = jax.jit(
-                lambda p, c, i: prefill_cache(self.cfg, p, c, i),
-                donate_argnums=(1,),
-            )
-        step = self._jit_cache["decode"]
-        cache = init_decode_cache(self.cfg, ids.shape[0])
-        logits, cache = self._jit_cache["prefill"](
-            self.params_, cache, jnp.asarray(ids, jnp.int32))
-        for i in range(max_new):
-            nxt, rng = _sample_next(np.asarray(logits), temperature,
-                                    top_k, top_p, rng)
-            ids = np.concatenate([ids, nxt[:, None]], axis=1)
-            if i < max_new - 1:  # final logits would go unsampled
-                logits, cache = step(self.params_, cache,
-                                     jnp.asarray(nxt, jnp.int32))
-        return ids
+        if "decode_s" not in self._jit_cache:
+            cfg = self.cfg
+
+            def _dec(p, c, tok, t, k, pp, key):
+                self._bump_trace("decode")
+                logits, c = decode_step(cfg, p, c, tok)
+                nxt, key = sample_next_device(logits, t, k, pp, key)
+                return nxt, c, key
+
+            def _pre(p, c, i, ln, t, k, pp, key):
+                self._bump_trace("prefill")
+                logits, c = prefill_cache(cfg, p, c, i, length=ln)
+                nxt, key = sample_next_device(logits, t, k, pp, key)
+                return nxt, c, key
+
+            self._jit_cache["decode_s"] = jax.jit(_dec, donate_argnums=(1,))
+            self._jit_cache["prefill_s"] = jax.jit(_pre, donate_argnums=(1,))
+        b, Tp = ids.shape
+        if self.cfg.n_experts > 0:
+            ids_in = ids  # MoE: padding would perturb routing capacity
+        else:
+            Tb = next(t for t in self.prefill_buckets() if t >= Tp)
+            ids_in = np.zeros((b, Tb), np.int32)
+            ids_in[:, :Tp] = ids
+        t_ = jnp.asarray(float(temperature), jnp.float32)
+        k_ = jnp.asarray(int(top_k), jnp.int32)
+        p_ = jnp.asarray(float(top_p), jnp.float32)
+        cache = init_decode_cache(self.cfg, b)
+        tok, cache, key = self._jit_cache["prefill_s"](
+            self.params_, cache, jnp.asarray(ids_in),
+            jnp.asarray(Tp, jnp.int32), t_, k_, p_, rng)
+        toks = [tok]
+        step = self._jit_cache["decode_s"]
+        for _ in range(max_new - 1):
+            tok, cache, key = step(self.params_, cache, tok, t_, k_, p_, key)
+            toks.append(tok)
+        gen = np.stack([np.asarray(tk) for tk in toks], axis=1)
+        return np.concatenate([ids, gen.astype(np.int32)], axis=1)
 
     def perplexity(self, ids: np.ndarray, targets: np.ndarray) -> float:
         """exp(mean next-token NLL) over valid targets (-1 = ignore) —

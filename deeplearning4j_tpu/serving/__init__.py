@@ -22,6 +22,10 @@ the training stack produces crash-safe checkpoints
   per-bucket pad-waste ratios.
 - :mod:`rtrace` — per-request stage timelines (enqueue → batch →
   dispatch → slice → respond) and the bounded /trace buffer.
+- :mod:`generate` — continuous-batching autoregressive decode engine:
+  a slotted fixed-shape KV-cache/carry slab where requests join and
+  leave the ONE in-flight jitted decode step at token granularity,
+  with in-graph sampling and streamed responses (``POST /generate``).
 """
 
 from deeplearning4j_tpu.serving.batcher import (
@@ -34,13 +38,22 @@ from deeplearning4j_tpu.serving.batcher import (
 )
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
 from deeplearning4j_tpu.serving.engine import InferenceEngine
-from deeplearning4j_tpu.serving.metrics import ServingMetrics
+from deeplearning4j_tpu.serving.generate import (
+    GenerationEngine,
+    GenerationMemoryError,
+    GenerationRequest,
+)
+from deeplearning4j_tpu.serving.metrics import GenerationMetrics, ServingMetrics
 from deeplearning4j_tpu.serving.rtrace import RequestTrace, TraceBuffer
 from deeplearning4j_tpu.serving.server import InferenceServer
 
 __all__ = [
     "BucketPolicy",
     "DynamicBatcher",
+    "GenerationEngine",
+    "GenerationMemoryError",
+    "GenerationMetrics",
+    "GenerationRequest",
     "InferenceEngine",
     "InferenceRequest",
     "InferenceServer",

@@ -1,0 +1,845 @@
+"""Continuous-batching autoregressive generation engine.
+
+The /predict path batches REQUESTS; autoregressive generation has to
+batch TOKENS. A naive serving loop decodes one request at a time (the
+device idles at batch 1) or dispatch-then-waits a fixed batch (every
+request waits for the slowest's last token). Continuous batching — the
+Orca/vLLM scheduling discipline — keeps ONE fixed-shape decode program
+in flight and lets requests join and leave it **between token steps**:
+
+- the engine owns a persistent **slot slab**: for TransformerLM an
+  ``(n_layers, n_slots, heads, max_length, head_dim)`` KV cache pair
+  (``init_decode_cache``); for recurrent nets (TextGenerationLSTM) the
+  per-layer carried (h, c) state stacked to ``(n_slots, units)``;
+- a request claims a free slot, **prefills** its prompt at a bucketed
+  length (``prefill_bucket_lengths`` — the ``serving_seq_buckets``
+  discipline, so prefill compiles a bounded program set), and joins the
+  next decode step;
+- every token step is ONE jitted dispatch for ALL active slots: the
+  per-row-position ``decode_step`` + in-graph ``sample_next_device``
+  (greedy/temperature/top-k/top-p as data, not program structure), so
+  steady-state decode never recompiles and never round-trips the host
+  per request — one small host sync per step streams every slot's new
+  token;
+- finished or deadline-expired requests free their slot **at token
+  granularity**; the freed slot is re-prefilled by the next queued
+  request while the other slots keep decoding.
+
+Zero-recompile discipline (1810.09868 fixed-shape rationale) extended
+to token granularity: the decode program's shapes are
+``(n_slots, ...)`` forever; activity is a boolean mask. Parity: a slot
+decoded among other slots is bit-identical to the same request decoded
+alone (row-independent attention math — asserted in
+tests/test_generate.py), so continuous batching is an *throughput*
+optimization, never an output change. Documented tolerances: MoE
+routing competes across co-resident slots (capacity effects — same
+caveat as ``decode_step``), and top-p nucleus cutoffs can differ from
+the host sampler at boundary ties (``sample_next_device``).
+
+Typed failures reuse the batcher vocabulary: queue-full →
+:class:`~.batcher.ServerOverloadedError` (HTTP 503), deadline →
+:class:`~.batcher.RequestDeadlineExceeded` (504), window overflow →
+:class:`~models.transformer_lm.ContextWindowExceeded` (400), slab
+memory over budget → :class:`GenerationMemoryError` at build time.
+
+Observability: flight-recorder slot lifecycle events (``slot_claim`` /
+``slot_free`` / ``decode_stall``), rtrace stage timelines
+(queue → prefill → decode → respond), and a
+:class:`~.metrics.GenerationMetrics` registry surface
+(``generation_tokens_per_sec``, slot occupancy, prefill/decode split).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.serving import rtrace
+from deeplearning4j_tpu.serving.batcher import (
+    RequestDeadlineExceeded,
+    ServerOverloadedError,
+    ServerShutdownError,
+    ServingError,
+)
+from deeplearning4j_tpu.serving.metrics import GenerationMetrics
+
+
+class GenerationMemoryError(ServingError):
+    """The requested ``n_slots × max_length`` decode slab would not fit
+    the memory budget — raised at engine BUILD time (the estimator says
+    no before the allocator does)."""
+
+
+class GenerationRequest:
+    """One generation request: prompt + sampling policy + streaming
+    output. Completion (``finish``/``fail``) is idempotent first-wins,
+    mirroring :class:`~.batcher.InferenceRequest`. Tokens stream into a
+    bounded-latency queue as they are decoded (``stream()``); callers
+    that want the whole sequence block on ``result()``."""
+
+    _END = object()
+
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
+                 "seed", "deadline", "enqueued_at", "trace", "tokens",
+                 "slot", "_event", "_lock", "_stream", "result_", "error_")
+
+    def __init__(self, prompt_ids, max_new: int, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+                 deadline: Optional[float] = None, trace: bool = False):
+        self.prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed)
+        #: absolute time.monotonic() deadline, or None
+        self.deadline = deadline
+        self.enqueued_at = time.monotonic()
+        self.trace = rtrace.RequestTrace() if trace else None
+        #: generated token ids, in order (grows as decoding proceeds)
+        self.tokens: List[int] = []
+        #: slot index while decoding, else None
+        self.slot: Optional[int] = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._stream: "queue.Queue" = queue.Queue()
+        self.result_: Optional[np.ndarray] = None
+        self.error_: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                > self.deadline)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def push_token(self, tok: int) -> None:
+        self.tokens.append(int(tok))
+        self._stream.put(int(tok))
+
+    def finish(self) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.result_ = np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)])
+            self._event.set()
+            self._stream.put(self._END)
+            return True
+
+    def fail(self, error: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.error_ = error
+            self._event.set()
+            self._stream.put(self._END)
+            return True
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token ids as they are decoded; raises the request's
+        typed error at the point of failure. ``timeout`` bounds the wait
+        for EACH token (a stalled engine raises
+        :class:`RequestDeadlineExceeded` instead of hanging the
+        consumer)."""
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise RequestDeadlineExceeded(
+                    f"no token within timeout={timeout}s") from None
+            if item is self._END:
+                if self.error_ is not None:
+                    raise self.error_
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the full sequence (prompt + generated), 1-D int32.
+        On timeout the request is failed idempotently (a concurrent
+        engine completion wins) and the typed error raises."""
+        if not self._event.wait(timeout):
+            self.fail(RequestDeadlineExceeded(
+                f"request not served within timeout={timeout}s"))
+            self._event.wait()
+        if self.error_ is not None:
+            raise self.error_
+        return self.result_
+
+
+# --------------------------------------------------------------------------
+# decode backends
+# --------------------------------------------------------------------------
+class _TransformerBackend:
+    """TransformerLM decode backend: fixed (L, S, hn, T, hd) KV slab,
+    per-slot positions, per-bucket prefill programs."""
+
+    kind = "transformer"
+
+    def __init__(self, model, n_slots: int, max_length: Optional[int],
+                 prefill_buckets: Optional[Sequence[int]], trace_hook):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            decode_step,
+            init_decode_cache,
+            prefill_bucket_lengths,
+            prefill_cache,
+            sample_next_device,
+            sample_next_rows,
+        )
+
+        self.model = model
+        cfg = model.cfg
+        self.n_slots = int(n_slots)
+        self.max_length = (cfg.max_length if max_length is None
+                           else min(int(max_length), cfg.max_length))
+        self.buckets = prefill_bucket_lengths(
+            self.max_length,
+            prefill_buckets or getattr(model, "serving_seq_buckets", None))
+        self._cfg = cfg
+        self.reset()
+        self.cache_bytes = 2 * int(np.prod(self._kc.shape)) * \
+            self._kc.dtype.itemsize
+
+        def _decode(p, kc, vc, toks, pos, active, t, k, pp, keys):
+            trace_hook("generation_decode")
+            logits, c = decode_step(cfg, p, {"k": kc, "v": vc, "pos": pos},
+                                    toks)
+            nxt, nkeys = sample_next_rows(logits, t, k, pp, keys)
+            nxt = jnp.where(active, nxt, toks)
+            nkeys = jnp.where(active[:, None], nkeys, keys)
+            return nxt, nkeys, c["k"], c["v"]
+
+        T = self.max_length
+
+        def _prefill(p, kc, vc, ids, ln, slot, t, k, pp, key):
+            trace_hook("generation_prefill")
+            tmp = init_decode_cache(cfg, 1, max_length=T)
+            logits, tmp = prefill_cache(cfg, p, tmp, ids, length=ln)
+            kc = jax.lax.dynamic_update_slice(kc, tmp["k"],
+                                              (0, slot, 0, 0, 0))
+            vc = jax.lax.dynamic_update_slice(vc, tmp["v"],
+                                              (0, slot, 0, 0, 0))
+            tok0, key = sample_next_device(logits, t, k, pp, key)
+            return tok0[0], key, kc, vc
+
+        self._decode_fn = jax.jit(_decode, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1, 2))
+
+    def reset(self) -> None:
+        """(Re)build the KV slab — at construction, and for engine
+        decode-failure recovery (the failed dispatch consumed the
+        donated buffers)."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            init_decode_cache,
+        )
+
+        slab = init_decode_cache(self._cfg, self.n_slots,
+                                 max_length=self.max_length)
+        self._kc, self._vc = slab["k"], slab["v"]
+
+    def bucket_for(self, prompt_len: int) -> int:
+        return next(t for t in self.buckets if t >= prompt_len)
+
+    def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
+                top_k: int, top_p: float, key: np.ndarray):
+        """Prefill one slot; returns (first token int, advanced key,
+        prompt bucket). One host sync per REQUEST (the first token),
+        amortized over its whole decode. MoE prompts skip bucketing —
+        pad tokens would compete for expert capacity and perturb
+        real-token logits (same exemption, and the same one-program-
+        per-distinct-length cost, as ``generate_cached``)."""
+        tp = int(prompt.shape[0])
+        tb = tp if self._cfg.n_experts > 0 else self.bucket_for(tp)
+        ids = np.zeros((1, tb), np.int32)
+        ids[0, :tp] = prompt
+        tok0, key, self._kc, self._vc = self._prefill_fn(
+            self.model.params_, self._kc, self._vc, jnp.asarray(ids),
+            jnp.asarray(tp, jnp.int32), jnp.asarray(int(slot), jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(int(top_k), jnp.int32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
+        return int(tok0), np.asarray(key), tb
+
+    def decode(self, tokens, pos, active, temperature, top_k, top_p, keys):
+        """One batched token step for all slots; returns
+        (next tokens (S,), advanced keys (S, 2)) as host arrays — the
+        single per-token host sync for the whole batch."""
+        nxt, nkeys, self._kc, self._vc = self._decode_fn(
+            self.model.params_, self._kc, self._vc,
+            jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(active),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(keys))
+        return np.asarray(nxt), np.asarray(nkeys)
+
+    def window_check(self, prompt_len: int, max_new: int) -> None:
+        from deeplearning4j_tpu.models.transformer_lm import (
+            ContextWindowExceeded,
+        )
+
+        if prompt_len + max_new > self.max_length:
+            raise ContextWindowExceeded(prompt_len, max_new,
+                                        self.max_length)
+
+
+class _RecurrentBackend:
+    """Incremental-decode backend for recurrent MultiLayerNetworks
+    (TextGenerationLSTM): per-slot carried (h, c) state stacked to
+    ``(n_slots, ...)`` leaves, threaded through ``_forward``'s carry
+    path. No KV slab — the carry IS the whole decode state, so
+    ``max_length`` only bounds the request window, not memory."""
+
+    kind = "recurrent"
+
+    def __init__(self, model, n_slots: int, max_length: Optional[int],
+                 prefill_buckets: Optional[Sequence[int]], trace_hook):
+        from deeplearning4j_tpu.models.transformer_lm import (
+            prefill_bucket_lengths,
+            sample_next_device,
+            sample_next_rows,
+        )
+
+        self.model = model
+        self.n_slots = int(n_slots)
+        self.max_length = int(max_length) if max_length else 256
+        self.buckets = prefill_bucket_lengths(
+            self.max_length,
+            prefill_buckets or getattr(model, "serving_seq_buckets", None))
+        self.vocab = int(model.layers[0].n_in)
+        self.reset()
+        self.cache_bytes = sum(
+            int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self._carries))
+        V = self.vocab
+
+        def _decode(p, st, carries, toks, active, t, k, pp, keys):
+            trace_hook("generation_decode")
+            x = jax.nn.one_hot(toks, V, dtype=jnp.float32)[:, None, :]
+            y, _, _, nc, _ = model._forward(p, st, x, train=False, rng=None,
+                                            carries=carries)
+            logits = jnp.log(jnp.clip(y[:, -1, :].astype(jnp.float32),
+                                      1e-30, None))
+            nxt, nkeys = sample_next_rows(logits, t, k, pp, keys)
+            nxt = jnp.where(active, nxt, toks)
+            nkeys = jnp.where(active[:, None], nkeys, keys)
+            nc = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    active.reshape((-1,) + (1,) * (new.ndim - 1)), new, old),
+                nc, carries)
+            return nxt, nkeys, nc
+
+        def _prefill(p, st, carries, ids, ln, slot, t, k, pp, key):
+            trace_hook("generation_prefill")
+            tb = ids.shape[0]
+            x = jax.nn.one_hot(ids, V, dtype=jnp.float32)[None]
+            mask = (jnp.arange(tb) < ln).astype(jnp.float32)[None]
+            c1 = model._init_carries(1)
+            y, _, _, nc1, _ = model._forward(p, st, x, train=False, rng=None,
+                                             fmask=mask, carries=c1)
+            y_last = jax.lax.dynamic_index_in_dim(y, ln - 1, axis=1,
+                                                  keepdims=False)
+            logits = jnp.log(jnp.clip(y_last.astype(jnp.float32),
+                                      1e-30, None))
+            tok0, key = sample_next_device(logits, t, k, pp, key)
+            carries = jax.tree_util.tree_map(
+                lambda big, row: big.at[slot].set(row[0]), carries, nc1)
+            return tok0[0], key, carries
+
+        self._decode_fn = jax.jit(_decode, donate_argnums=(2,))
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(2,))
+
+    def reset(self) -> None:
+        """(Re)build the carried state — at construction, and for
+        engine decode-failure recovery (the failed dispatch consumed
+        the donated carries)."""
+        self._carries = self.model._init_carries(self.n_slots)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        return next(t for t in self.buckets if t >= prompt_len)
+
+    def prefill(self, slot, prompt, temperature, top_k, top_p, key):
+        tp = int(prompt.shape[0])
+        tb = self.bucket_for(tp)
+        ids = np.zeros((tb,), np.int32)
+        ids[:tp] = prompt
+        tok0, key, self._carries = self._prefill_fn(
+            self.model.params_, self.model.state_, self._carries,
+            jnp.asarray(ids), jnp.asarray(tp, jnp.int32),
+            jnp.asarray(int(slot), jnp.int32),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(int(top_k), jnp.int32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
+        return int(tok0), np.asarray(key), tb
+
+    def decode(self, tokens, pos, active, temperature, top_k, top_p, keys):
+        nxt, nkeys, self._carries = self._decode_fn(
+            self.model.params_, self.model.state_, self._carries,
+            jnp.asarray(tokens), jnp.asarray(active),
+            jnp.asarray(temperature), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(keys))
+        return np.asarray(nxt), np.asarray(nkeys)
+
+    def window_check(self, prompt_len: int, max_new: int) -> None:
+        from deeplearning4j_tpu.models.transformer_lm import (
+            ContextWindowExceeded,
+        )
+
+        if prompt_len + max_new > self.max_length:
+            raise ContextWindowExceeded(prompt_len, max_new,
+                                        self.max_length)
+
+
+def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook):
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+    if isinstance(model, TransformerLM):
+        return _TransformerBackend(model, n_slots, max_length,
+                                   prefill_buckets, trace_hook)
+    layers = getattr(model, "layers", None)
+    if layers is not None:
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+            BaseRecurrentLayer,
+        )
+
+        if any(isinstance(l, BaseRecurrentLayer) for l in layers):
+            return _RecurrentBackend(model, n_slots, max_length,
+                                     prefill_buckets, trace_hook)
+    raise TypeError(
+        f"{type(model).__name__} has no incremental-decode path: expected "
+        "a TransformerLM (KV-cache slab) or a MultiLayerNetwork with "
+        "recurrent layers (carried h/c state)")
+
+
+# --------------------------------------------------------------------------
+# memory validation
+# --------------------------------------------------------------------------
+def generation_memory_report(model, n_slots: int,
+                             max_length: Optional[int] = None) -> dict:
+    """Analytic 'will the decode slab fit' answer BEFORE allocating it —
+    the nn/conf/memory.py estimator discipline applied to generation
+    state: per-slot cache bytes × n_slots + resident params."""
+    from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+
+    if isinstance(model, TransformerLM):
+        cfg = model.cfg
+        T = cfg.max_length if max_length is None else min(int(max_length),
+                                                          cfg.max_length)
+        hd = cfg.d_model // cfg.n_heads
+        itemsize = 2 if cfg.compute_dtype == "bfloat16" else 4
+        cache = 2 * cfg.n_layers * int(n_slots) * cfg.n_heads * T * hd \
+            * itemsize
+        params = sum(int(np.prod(p.shape)) * p.dtype.itemsize
+                     for p in jax.tree_util.tree_leaves(model.params_))
+    else:
+        # recurrent nets: the carry is the decode state; lean on the
+        # layer-wise estimator for params + per-slot activation state
+        from deeplearning4j_tpu.nn.conf.memory import memory_report_mln
+
+        report = memory_report_mln(model.conf)
+        params = report.total_params * 4
+        cache = report.total_memory_bytes(batch_size=int(n_slots),
+                                          training=False) - params
+        cache = max(cache, 0)
+    return {"cache_bytes": int(cache), "param_bytes": int(params),
+            "total_bytes": int(cache) + int(params),
+            "n_slots": int(n_slots), "max_length": max_length}
+
+
+def _device_bytes_limit() -> Optional[int]:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+# --------------------------------------------------------------------------
+# the engine
+# --------------------------------------------------------------------------
+class GenerationEngine:
+    """Slotted continuous-batching decode engine over one model.
+
+    One background worker owns ALL device state (slab / carries, under
+    ``_dev_lock``); callers only touch the bounded admission queue and
+    their own :class:`GenerationRequest`. Hot params reload composes:
+    the jitted programs read ``model.params_`` per dispatch, so an
+    atomic params swap (same shapes) takes effect at the next token.
+
+    ``memory_limit_bytes``: explicit budget, ``"auto"`` (device
+    ``bytes_limit`` when the backend reports one, else unchecked), or
+    None to skip the check."""
+
+    def __init__(self, model, n_slots: int = 8,
+                 max_length: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 queue_limit: int = 64, default_timeout_s: float = 120.0,
+                 metrics: Optional[GenerationMetrics] = None,
+                 memory_limit_bytes="auto", stall_ms: float = 2000.0,
+                 trace_requests: bool = True,
+                 traces: Optional["rtrace.TraceBuffer"] = None):
+        self.metrics = metrics if metrics is not None else GenerationMetrics()
+        self.trace_requests = bool(trace_requests)
+        self.traces = traces
+        self.default_timeout_s = float(default_timeout_s)
+        self.stall_ms = float(stall_ms)
+        #: fn-name → XLA programs traced (retrace-guard instrument)
+        self.trace_counts: Dict[str, int] = {}
+        self._retrace_counters = {}
+
+        def trace_hook(fn: str) -> None:
+            # trace-time side effect (never runs at dispatch time):
+            # bump the host count, the registry counter and the flight
+            # recorder — a steady-state recompile must be LOUD
+            self.trace_counts[fn] = self.trace_counts.get(fn, 0) + 1
+            if fn not in self._retrace_counters:
+                self._retrace_counters[fn] = self.metrics.registry.counter(
+                    "jit_retraces_total",
+                    "distinct XLA programs traced per jitted function",
+                    labels={"fn": fn})
+            self._retrace_counters[fn].inc()
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("retrace", fn=fn)
+
+        self.backend = _pick_backend(model, n_slots, max_length,
+                                     prefill_buckets, trace_hook)
+        self.n_slots = self.backend.n_slots
+        self.max_length = self.backend.max_length
+        self.metrics.set_slots(self.n_slots)
+
+        self.memory_report = generation_memory_report(
+            model, self.n_slots, self.backend.max_length)
+        limit = (_device_bytes_limit() if memory_limit_bytes == "auto"
+                 else memory_limit_bytes)
+        self.memory_report["limit_bytes"] = limit
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("generation_memory_check",
+                       **{k: v for k, v in self.memory_report.items()
+                          if v is not None})
+        if limit is not None and self.memory_report["total_bytes"] > limit:
+            raise GenerationMemoryError(
+                f"decode slab needs {self.memory_report['cache_bytes']:,} "
+                f"cache bytes (+{self.memory_report['param_bytes']:,} "
+                f"params) for n_slots={self.n_slots} × "
+                f"max_length={self.backend.max_length}, over the "
+                f"{limit:,}-byte budget; lower n_slots or max_length")
+
+        S = self.n_slots
+        self._queue: "queue.Queue[GenerationRequest]" = queue.Queue(
+            maxsize=max(int(queue_limit), 1))
+        self._slots: List[Optional[GenerationRequest]] = [None] * S
+        self._active = np.zeros((S,), bool)
+        self._tokens = np.zeros((S,), np.int32)
+        self._pos = np.zeros((S,), np.int32)
+        self._temp = np.zeros((S,), np.float32)
+        self._topk = np.zeros((S,), np.int32)
+        self._topp = np.zeros((S,), np.float32)
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._shutdown = False
+        self._dev_lock = threading.Lock()
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="dl4j-tpu-generate")
+        self._worker.start()
+
+    # -- client side --------------------------------------------------------
+    def submit(self, prompt_ids, max_new: int = 20, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 0.0, seed: int = 0,
+               timeout: Optional[float] = None,
+               trace: Optional[bool] = None) -> GenerationRequest:
+        """Enqueue a generation request; returns immediately (consume
+        ``req.stream()`` or block on ``req.result()``). Raises the typed
+        batcher-vocabulary failures: window overflow, queue-full
+        overload, shutdown."""
+        from deeplearning4j_tpu.models.transformer_lm import (
+            _validate_sampling,
+        )
+
+        if self._shutdown:
+            raise ServerShutdownError("generation engine is shut down")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        self.backend.window_check(prompt.size, int(max_new))
+        _validate_sampling(temperature, top_k, top_p)
+        timeout = self.default_timeout_s if timeout is None else timeout
+        req = GenerationRequest(
+            prompt, max_new, temperature, top_k, top_p, seed,
+            deadline=None if timeout is None
+            else time.monotonic() + float(timeout),
+            trace=self.trace_requests if trace is None else bool(trace))
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            self.metrics.record_reject()
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("overload_reject", surface="generate",
+                           prompt_len=int(prompt.size),
+                           queue_limit=self._queue.maxsize)
+            raise ServerOverloadedError(
+                f"generation queue full ({self._queue.maxsize} requests); "
+                "retry with backoff or add slots") from None
+        if self._shutdown and req.fail(
+                ServerShutdownError("engine shut down while enqueuing")):
+            raise ServerShutdownError("engine shut down while enqueuing")
+        self.metrics.record_request()
+        return req
+
+    def generate(self, prompt_ids, timeout: Optional[float] = None,
+                 **kwargs) -> np.ndarray:
+        """Blocking convenience: submit + result."""
+        req = self.submit(prompt_ids, timeout=timeout, **kwargs)
+        return req.result(timeout=timeout or self.default_timeout_s)
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def describe(self) -> dict:
+        return {
+            "backend": self.backend.kind,
+            "n_slots": self.n_slots,
+            "active_slots": self.active_slots,
+            "max_length": self.backend.max_length,
+            "prefill_buckets": list(self.backend.buckets),
+            "queue_depth": self.queue_depth(),
+            "trace_counts": dict(self.trace_counts),
+            "memory": dict(self.memory_report),
+        }
+
+    # -- warmup -------------------------------------------------------------
+    def warmup(self, verbose: bool = False) -> dict:
+        """Pre-compile the whole program set — one prefill per bucket +
+        the single batched decode step — so steady-state generation
+        never compiles. Runs on the caller thread under the device lock;
+        skipped (returns ``{"skipped": ...}``) while slots are active
+        (the programs are then warm by construction)."""
+        t0 = time.perf_counter()
+        before = dict(self.trace_counts)
+        with self._dev_lock:
+            if self._active.any():
+                return {"skipped": "slots active (already warm)"}
+            key = np.asarray(jax.random.PRNGKey(0))
+            for tb in self.backend.buckets:
+                # a tb-long prompt lands exactly in bucket tb (warmup
+                # bypasses the window check — no decode follows)
+                prompt = np.zeros((tb,), np.int32)
+                _tok, _key, _ = self.backend.prefill(0, prompt, 0.0, 0, 0.0,
+                                                     key)
+                if verbose:
+                    print(f"generation warmup: prefill bucket {tb}",
+                          flush=True)
+            self.backend.decode(self._tokens, self._pos,
+                                np.zeros_like(self._active), self._temp,
+                                self._topk, self._topp, self._keys)
+        compiles = {k: self.trace_counts.get(k, 0) - before.get(k, 0)
+                    for k in self.trace_counts}
+        return {"buckets": list(self.backend.buckets),
+                "compiles": compiles,
+                "seconds": round(time.perf_counter() - t0, 3)}
+
+    # -- worker -------------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.n_slots) if self._slots[i] is None]
+
+    def _admit(self, block_s: float) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        for slot in self._free_slots():
+            try:
+                req = (self._queue.get(timeout=block_s) if block_s > 0
+                       else self._queue.get_nowait())
+            except queue.Empty:
+                return
+            block_s = 0.0
+            if req.done():
+                continue  # caller-side timeout while queued
+            if req.expired():
+                self.metrics.record_deadline()
+                req.fail(RequestDeadlineExceeded(
+                    "request deadline passed while queued"))
+                continue
+            t0 = time.monotonic()
+            if req.trace is not None:
+                req.trace.mark("slot_claimed", t0)
+            try:
+                key0 = np.asarray(jax.random.PRNGKey(req.seed),
+                                  np.uint32).reshape(2)
+                tok0, key, bucket = self.backend.prefill(
+                    slot, req.prompt, req.temperature, req.top_k,
+                    req.top_p, key0)
+            except BaseException as e:  # keep the worker alive
+                self.metrics.record_error()
+                req.fail(e)
+                continue
+            dt = time.monotonic() - t0
+            self.metrics.record_prefill(dt)
+            self.metrics.record_first_token()
+            _flight.record("slot_claim", slot=slot,
+                           prompt_len=int(req.prompt.size),
+                           prompt_bucket=int(bucket),
+                           max_new=req.max_new)
+            self._slots[slot] = req
+            req.slot = slot
+            self._active[slot] = True
+            self._tokens[slot] = tok0
+            self._pos[slot] = req.prompt.size
+            self._temp[slot] = req.temperature
+            self._topk[slot] = req.top_k
+            self._topp[slot] = req.top_p
+            self._keys[slot] = key
+            if req.trace is not None:
+                req.trace.mark("prefill_done")
+                req.trace.note(slot=slot, prompt_len=int(req.prompt.size),
+                               prompt_bucket=int(bucket))
+            req.push_token(tok0)
+            if len(req.tokens) >= req.max_new:
+                self._finish_slot(slot, reason="done")
+
+    def _finish_slot(self, slot: int, reason: str,
+                     error: Optional[BaseException] = None) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._active[slot] = False
+        if req is None:
+            return
+        req.slot = None
+        if req.trace is not None:
+            req.trace.mark("decode_done")
+        if error is not None:
+            if isinstance(error, RequestDeadlineExceeded):
+                self.metrics.record_deadline()
+            else:
+                self.metrics.record_error()
+            req.fail(error)
+        else:
+            if req.trace is not None:
+                req.trace.mark("respond")
+                req.trace.note(tokens=len(req.tokens))
+            req.finish()
+            self.metrics.record_finish(time.monotonic() - req.enqueued_at)
+        if self.traces is not None and req.trace is not None:
+            self.traces.add(req.trace)
+        _flight.record("slot_free", slot=slot, reason=reason,
+                       tokens=len(req.tokens))
+
+    def _step(self) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        n_active = int(self._active.sum())
+        t0 = time.monotonic()
+        try:
+            toks, keys = self.backend.decode(
+                self._tokens, self._pos, self._active, self._temp,
+                self._topk, self._topp, self._keys)
+        except BaseException as e:  # keep the worker alive: a decode
+            # failure (bad hot-swapped params, transient device error)
+            # fails the ACTIVE requests typed instead of silently
+            # killing the loop and hanging every present and future
+            # caller. The donated slab is gone with the failed dispatch,
+            # so the slots cannot continue — but freed slots + a live
+            # worker mean the next prefill rebuilds per-slot state.
+            _flight.record("decode_error", error=type(e).__name__,
+                           active=n_active)
+            for slot in range(self.n_slots):
+                if self._slots[slot] is not None:
+                    self._finish_slot(slot, reason="decode_error", error=e)
+            self.backend.reset()
+            return
+        dt = time.monotonic() - t0
+        self.metrics.record_decode_step(dt, n_active)
+        if dt * 1e3 > self.stall_ms:
+            _flight.record("decode_stall", wall_ms=round(dt * 1e3, 1),
+                           active=n_active)
+        # copy: np.asarray on a device array is a read-only view, and
+        # the admit path writes per-slot lanes into these
+        self._tokens = np.array(toks, np.int32)
+        self._keys = np.array(keys, np.uint32)
+        self._pos[self._active] += 1
+        now = time.monotonic()
+        for slot in range(self.n_slots):
+            if not self._active[slot]:
+                continue
+            req = self._slots[slot]
+            req.push_token(int(toks[slot]))
+            if len(req.tokens) >= req.max_new:
+                self._finish_slot(slot, reason="done")
+            elif req.expired(now) or req.done():
+                # done() → the caller gave up (result timeout); either
+                # way the slot frees at token granularity
+                self._finish_slot(
+                    slot, reason="deadline",
+                    error=RequestDeadlineExceeded(
+                        "request deadline passed mid-decode"))
+
+    def _loop(self) -> None:
+        while True:
+            with self._dev_lock:
+                self._admit(block_s=0.0)
+                any_active = self._active.any()
+                if any_active:
+                    self._step()
+            self.metrics.set_active_slots(int(self._active.sum()))
+            if not any_active:
+                if self._shutdown and self._queue.empty():
+                    return
+                # idle: wait for work without holding the device lock
+                try:
+                    req = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                # put it back and admit under the lock (single admission
+                # path keeps slot bookkeeping in one place)
+                self._requeue_front(req)
+
+    def _requeue_front(self, req: GenerationRequest) -> None:
+        # queue.Queue has no putleft; a transient overflow past the
+        # bound here is acceptable (the request was already admitted
+        # once) — deque directly to preserve order
+        with self._queue.mutex:
+            self._queue.queue.appendleft(req)
+            self._queue.not_empty.notify()
+
+    # -- lifecycle ----------------------------------------------------------
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting work; ``drain=True`` finishes active and
+        queued requests first, else they fail typed. Idempotent."""
+        self._shutdown = True
+        if not drain:
+            self._fail_queued()
+            with self._dev_lock:
+                for slot in range(self.n_slots):
+                    if self._slots[slot] is not None:
+                        self._finish_slot(
+                            slot, reason="shutdown",
+                            error=ServerShutdownError(
+                                "engine shut down mid-decode"))
+        self._worker.join(timeout=timeout)
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.fail(ServerShutdownError(
+                "engine shut down before serving request"))

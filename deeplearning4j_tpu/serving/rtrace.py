@@ -37,13 +37,21 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
-#: interval names, keyed by the mark that CLOSES the interval
+#: interval names, keyed by the mark that CLOSES the interval.
+#: Two families share the table: /predict requests (enqueue →
+#: batch_assembled → dispatch_start → forward_done → sliced → respond)
+#: and /generate requests (enqueue → slot_claimed → prefill_done →
+#: decode_done → respond) — the generation engine marks slot claim,
+#: prompt prefill and the whole token-decode span per request.
 STAGE_NAMES = {
     "batch_assembled": "queue",
     "dispatch_start": "assembly",
     "forward_done": "forward",
     "sliced": "slice",
     "respond": "respond",
+    "slot_claimed": "queue",
+    "prefill_done": "prefill",
+    "decode_done": "decode",
 }
 
 

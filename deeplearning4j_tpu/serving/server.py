@@ -11,6 +11,19 @@ the production contract:
                            "timeout_ms": n?}`` → ``{"outputs": [...]}``
 - ``POST /predict_npy``    raw ``.npy`` body → ``.npy`` response
                            (zero JSON float cost for bulk clients)
+- ``POST /generate``       continuous-batching autoregressive
+                           generation (serving/generate.py; requires a
+                           ``generation=`` engine — 409 otherwise).
+                           JSON ``{"prompt": [ids], "max_new": n,
+                           "temperature": t?, "top_k": k?, "top_p": p?,
+                           "seed": s?, "timeout_ms": n?,
+                           "stream": bool?}``. ``stream=true``
+                           (default) answers with chunked
+                           newline-delimited JSON: one ``{"token": id}``
+                           line per decoded token AS IT DECODES, then a
+                           ``{"done": true, "tokens": [...], ...}``
+                           summary line; ``stream=false`` buffers and
+                           returns one JSON body.
 - ``GET  /healthz``        liveness + model version/warm state +
                            checkpoint fingerprint/snapshot version/
                            uptime (the keys canary & rollback tooling
@@ -74,10 +87,14 @@ class InferenceServer:
                  max_wait_ms: float = 5.0, queue_limit: int = 256,
                  default_timeout_s: float = 30.0,
                  trace_requests: bool = True,
-                 trace_buffer_size: int = 256):
+                 trace_buffer_size: int = 256,
+                 generation=None):
         from deeplearning4j_tpu.serving.rtrace import TraceBuffer
 
         self.engine = engine
+        #: optional serving/generate.py GenerationEngine behind
+        #: POST /generate (None → the route answers 409)
+        self.generation = generation
         self.metrics: ServingMetrics = engine.metrics
         self.default_timeout_s = float(default_timeout_s)
         #: recent per-request timelines (GET /trace). trace_requests
@@ -103,6 +120,9 @@ class InferenceServer:
             batch_limit=batch_limit, max_wait_ms=max_wait_ms,
             queue_limit=queue_limit, metrics=self.metrics,
             trace_requests=trace_requests)
+        if self.generation is not None and self.generation.traces is None:
+            # generation request timelines land in the same /trace ring
+            self.generation.traces = self.traces
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
@@ -136,6 +156,8 @@ class InferenceServer:
             self._closed = True
             self._httpd.server_close()
         self.batcher.shutdown(drain=True)
+        if self.generation is not None:
+            self.generation.shutdown(drain=True)
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -220,6 +242,8 @@ def _make_handler(server: InferenceServer):
                     info["snapshot_version"] = info.get("version")
                     info["uptime_s"] = round(
                         time.time() - server.metrics.started_at, 3)
+                    if server.generation is not None:
+                        info["generation"] = server.generation.describe()
                     self._send_json(200, {"status": "ok", **info})
                 elif url.path == "/metrics":
                     depth = server.batcher.queue_depth()
@@ -228,8 +252,11 @@ def _make_handler(server: InferenceServer):
                         self._send(200, server.metrics.prometheus_text(
                             queue_depth=depth).encode(), PROMETHEUS_CTYPE)
                     else:
-                        self._send_json(200, server.metrics.snapshot(
-                            queue_depth=depth))
+                        body = server.metrics.snapshot(queue_depth=depth)
+                        if server.generation is not None:
+                            body["generation"] = \
+                                server.generation.metrics.snapshot()
+                        self._send_json(200, body)
                 elif url.path == "/trace":
                     from urllib.parse import parse_qs
 
@@ -265,6 +292,8 @@ def _make_handler(server: InferenceServer):
                     self._predict_json()
                 elif self.path == "/predict_npy":
                     self._predict_npy()
+                elif self.path == "/generate":
+                    self._generate()
                 elif self.path == "/reload":
                     self._reload()
                 else:
@@ -298,6 +327,86 @@ def _make_handler(server: InferenceServer):
             if want_trace and req.trace is not None:
                 body["trace"] = req.trace.timeline()
             self._send_json(200, body)
+
+        def _generate(self) -> None:
+            """Continuous-batching generation. Submit errors (overload,
+            window overflow, shutdown) raise BEFORE any header is sent
+            and map to their typed transport codes; once a stream has
+            started, a mid-decode failure becomes a terminal
+            ``{"error": ...}`` chunk (the status line is already on the
+            wire)."""
+            if server.generation is None:
+                self._send_json(409, {
+                    "error": "NoGenerationEngine",
+                    "message": "server started without a generation "
+                               "engine (cli serve --gen-slots N)"})
+                return
+            try:
+                payload = json.loads(self._body() or b"{}")
+                prompt = np.asarray(payload["prompt"], np.int32).reshape(-1)
+            except (ValueError, KeyError, TypeError) as e:
+                raise ValueError(f"bad /generate payload: {e}") from e
+            timeout_ms = payload.get("timeout_ms")
+            timeout_s = (None if timeout_ms is None
+                         else float(timeout_ms) / 1e3)
+            want_trace = payload.get("trace")
+            req = server.generation.submit(
+                prompt,
+                max_new=int(payload.get("max_new", 20)),
+                temperature=float(payload.get("temperature", 0.0)),
+                top_k=int(payload.get("top_k", 0)),
+                top_p=float(payload.get("top_p", 0.0)),
+                seed=int(payload.get("seed", 0)),
+                timeout=timeout_s,
+                trace=None if want_trace is None else bool(want_trace))
+            wait_s = (server.generation.default_timeout_s
+                      if timeout_s is None else timeout_s)
+            if not payload.get("stream", True):
+                out = req.result(timeout=wait_s)
+                body = {"tokens": [int(t) for t in req.tokens],
+                        "sequence": out.tolist(),
+                        "prompt_len": int(prompt.size)}
+                if want_trace and req.trace is not None:
+                    body["trace"] = req.trace.timeline()
+                self._send_json(200, body)
+                return
+            # chunked newline-delimited JSON: tokens land on the wire
+            # as the decode loop emits them
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(obj: dict) -> None:
+                data = (json.dumps(obj) + "\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for tok in req.stream(timeout=wait_s):
+                    chunk({"token": int(tok)})
+                summary = {"done": True,
+                           "tokens": [int(t) for t in req.tokens],
+                           "prompt_len": int(prompt.size)}
+                if want_trace and req.trace is not None:
+                    summary["trace"] = req.trace.timeline()
+                chunk(summary)
+            except BaseException as e:
+                # the status line is on the wire; a decode failure
+                # becomes a terminal chunk. If writing THAT fails too
+                # (client went away mid-stream), swallow it — letting
+                # it propagate would re-enter do_POST's _error(),
+                # which injects a second status line into the chunked
+                # body on a half-writable socket.
+                try:
+                    chunk({"error": type(e).__name__, "message": str(e)})
+                except OSError:
+                    return
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
 
         def _predict_npy(self) -> None:
             body = self._body()

@@ -1,0 +1,531 @@
+"""Continuous-batching generation tests (serving/generate.py + the
+models/transformer_lm.py decode-path rework behind it).
+
+The acceptance spine: a request decoded in the slotted engine among
+other requests is BIT-IDENTICAL to the same request decoded alone
+(``generate_cached``) and to the full-prefix reference (``generate``);
+steady-state decode traces ZERO new XLA programs after warmup; slots
+free at token granularity on completion AND mid-decode deadline; the
+LSTM carried-state path matches the full-sequence forward. Plus the
+satellite contracts: fused on-device sampling parity, bucketed-prefill
+retrace guard, the typed context-window error, slab memory validation,
+and flight-recorder slot lifecycle events.
+"""
+
+import gc
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.transformer_lm import (
+    ContextWindowExceeded,
+    TransformerLM,
+    _sample_next,
+    prefill_bucket_lengths,
+    sample_next_device,
+)
+from deeplearning4j_tpu.serving import (
+    GenerationEngine,
+    GenerationMemoryError,
+    RequestDeadlineExceeded,
+    ServerOverloadedError,
+    ServerShutdownError,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """Same discipline as test_serving.py: drop this module's compiled
+    executables when done (short-lived engines on a cramped CPU host)."""
+    yield
+    gc.collect()
+    jax.clear_caches()
+
+
+_LM = {}
+
+
+def _lm() -> TransformerLM:
+    """Module-shared tiny LM (one build, one compile set)."""
+    if "m" not in _LM:
+        m = TransformerLM(vocab_size=48, d_model=32, n_heads=2, n_layers=2,
+                          max_length=48, seed=5).init()
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 48, (4, 24)).astype(np.int32)
+        tgt = np.roll(ids, -1, 1).astype(np.int32)
+        tgt[:, -1] = -1
+        for _ in range(3):
+            m.fit_batch(ids, tgt)
+        _LM["m"] = m
+    return _LM["m"]
+
+
+def _prompts(n, lens=(3, 21), seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 48, (int(rng.integers(*lens)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# in-graph sampler
+# ---------------------------------------------------------------------------
+class TestDeviceSampler:
+    def _logits(self, b=3, V=32, seed=4):
+        return np.random.default_rng(seed).standard_normal(
+            (b, V)).astype(np.float32)
+
+    def test_greedy_matches_host(self):
+        logits = self._logits()
+        host, _ = _sample_next(logits, 0.0, 0, 0.0, jax.random.PRNGKey(0))
+        dev, _ = sample_next_device(jax.numpy.asarray(logits), 0.0, 0, 0.0,
+                                    jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(host, np.asarray(dev))
+
+    def test_temperature_top_k_matches_host(self):
+        logits = self._logits()
+        for temp, k in ((0.7, 0), (1.3, 5), (0.5, 1)):
+            host, _ = _sample_next(logits.copy(), temp, k, 0.0,
+                                   jax.random.PRNGKey(9))
+            dev, _ = sample_next_device(jax.numpy.asarray(logits),
+                                        temp, k, 0.0, jax.random.PRNGKey(9))
+            np.testing.assert_array_equal(host, np.asarray(dev))
+
+    def test_key_chain_matches_host(self):
+        # the advanced key must follow the host's split(rng)[0] chain so
+        # fused decoding reproduces generate()'s sampled trajectory
+        logits = self._logits()
+        _, host_rng = _sample_next(logits, 0.8, 0, 0.0,
+                                   jax.random.PRNGKey(3))
+        _, dev_key = sample_next_device(jax.numpy.asarray(logits), 0.8, 0,
+                                        0.0, jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(
+            np.asarray(host_rng), np.asarray(dev_key))
+
+    def test_top_p_restricts_support(self):
+        # tolerance-documented vs host (cumsum order); assert the
+        # in-graph nucleus SEMANTICS: tiny p → argmax support only
+        logits = self._logits()
+        toks = set()
+        for s in range(8):
+            dev, _ = sample_next_device(jax.numpy.asarray(logits[:1]), 1.0,
+                                        0, 1e-6, jax.random.PRNGKey(s))
+            toks.add(int(np.asarray(dev)[0]))
+        assert toks == {int(logits[0].argmax())}
+
+
+# ---------------------------------------------------------------------------
+# fused generate_cached (satellites 1-3)
+# ---------------------------------------------------------------------------
+class TestGenerateCachedFused:
+    def test_greedy_parity_across_buckets(self):
+        m = _lm()
+        for tp in (3, 9, 17, 30):
+            prompt = _prompts(1, (tp, tp + 1), seed=tp)[0]
+            np.testing.assert_array_equal(
+                m.generate(prompt, max_new=6),
+                m.generate_cached(prompt, max_new=6))
+
+    def test_prefill_bucketing_bounds_program_count(self):
+        # the _jit_cache["prefill"] leak this replaces: one program per
+        # DISTINCT prompt length. Now: one per BUCKET.
+        m = TransformerLM(vocab_size=32, d_model=32, n_heads=2, n_layers=1,
+                          max_length=48, seed=1).init()
+        buckets = m.prefill_buckets()
+        assert buckets == prefill_bucket_lengths(48, m.serving_seq_buckets)
+        for tp in (3, 5, 7, 9, 11, 13):  # all land in the 16 bucket
+            m.generate_cached(np.arange(tp, dtype=np.int32), max_new=2)
+        assert m.trace_counts.get("prefill") == 1
+        assert m.trace_counts.get("decode") == 1
+        m.generate_cached(np.arange(20, dtype=np.int32), max_new=2)
+        assert m.trace_counts.get("prefill") == 2  # the 32 bucket
+        assert m.trace_counts.get("decode") == 1  # decode never re-traces
+
+    def test_context_window_typed_error(self):
+        m = _lm()
+        with pytest.raises(ContextWindowExceeded, match="max_length") as ei:
+            m.generate_cached(np.arange(40, dtype=np.int32), max_new=20)
+        assert isinstance(ei.value, ValueError)  # transport maps to 400
+        assert ei.value.prompt_len == 40
+        assert ei.value.max_new == 20
+        assert ei.value.max_length == 48
+
+    def test_window_error_raised_before_sampling_validation(self):
+        # the old ordering validated sampling args first, so an
+        # overflowing request with bad sampling args reported the wrong
+        # failure; the window is the outermost contract
+        m = _lm()
+        with pytest.raises(ContextWindowExceeded):
+            m.generate_cached(np.arange(40, dtype=np.int32), max_new=20,
+                              top_k=-3)
+
+    def test_max_new_zero_returns_prompt(self):
+        m = _lm()
+        prompt = np.arange(5, dtype=np.int32)
+        np.testing.assert_array_equal(
+            m.generate_cached(prompt, max_new=0), prompt[None])
+
+
+# ---------------------------------------------------------------------------
+# the continuous-batching engine (tentpole)
+# ---------------------------------------------------------------------------
+_ENG = {}
+
+
+def _engine() -> GenerationEngine:
+    """Module-shared engine over the shared LM, warmed once."""
+    if "e" not in _ENG:
+        e = GenerationEngine(_lm(), n_slots=3, queue_limit=32,
+                             default_timeout_s=120.0)
+        e.warmup()
+        _ENG["e"] = e
+    return _ENG["e"]
+
+
+class TestGenerationEngine:
+    def test_mixed_length_storm_three_way_parity(self):
+        # join/leave at token granularity: 8 requests with mixed prompt
+        # lengths AND mixed max_new over 3 slots — completions free
+        # slots mid-storm and queued requests join between steps. Every
+        # output must be bit-identical to solo generate_cached AND to
+        # the full-prefix generate reference.
+        m, eng = _lm(), _engine()
+        rng = np.random.default_rng(7)
+        prompts = _prompts(8, (3, 21), seed=7)
+        news = [int(rng.integers(3, 12)) for _ in prompts]
+        before = dict(eng.trace_counts)
+        reqs = [eng.submit(p, max_new=n, timeout=90)
+                for p, n in zip(prompts, news)]
+        outs = [r.result(timeout=90) for r in reqs]
+        assert eng.trace_counts == before  # zero steady-state retraces
+        for p, n, out in zip(prompts, news, outs):
+            np.testing.assert_array_equal(out, m.generate_cached(
+                p, max_new=n)[0])
+            np.testing.assert_array_equal(out, m.generate(p, max_new=n)[0])
+
+    def test_sampled_parity_with_solo_by_seed(self):
+        m, eng = _lm(), _engine()
+        prompt = _prompts(1, seed=3)[0]
+        out = eng.submit(prompt, max_new=5, temperature=0.8, top_k=4,
+                         seed=13, timeout=90).result(timeout=90)
+        solo = m.generate_cached(prompt, max_new=5, temperature=0.8,
+                                 top_k=4, rng=jax.random.PRNGKey(13))[0]
+        np.testing.assert_array_equal(out, solo)
+
+    def test_streaming_matches_result(self):
+        eng = _engine()
+        prompt = _prompts(1, seed=5)[0]
+        req = eng.submit(prompt, max_new=6, timeout=90)
+        streamed = list(req.stream(timeout=90))
+        full = req.result(timeout=5)
+        assert streamed == full[len(prompt):].tolist()
+        assert len(streamed) == 6
+
+    def test_deadline_mid_decode_frees_slot(self):
+        eng = _engine()
+        prompt = _prompts(1, seed=9)[0]
+        max_new = 48 - len(prompt)  # fill the window: a long decode
+        req = eng.submit(prompt, max_new=max_new, timeout=0.02)
+        with pytest.raises(RequestDeadlineExceeded):
+            req.result(timeout=90)
+        assert 0 < len(req.tokens) < max_new  # died mid-decode, not queued
+        deadline = time.monotonic() + 10
+        while eng.active_slots and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.active_slots == 0  # the slot came back
+        # and the freed slot serves the next request normally
+        out = eng.submit(prompt, max_new=3, timeout=90).result(timeout=90)
+        assert out.shape[0] == len(prompt) + 3
+
+    def test_window_overflow_typed_at_submit(self):
+        eng = _engine()
+        with pytest.raises(ContextWindowExceeded, match="max_length"):
+            eng.submit(np.arange(40, dtype=np.int32), max_new=20)
+
+    def test_decode_failure_fails_active_typed_and_engine_survives(self):
+        # a decode dispatch blowing up (bad hot-swapped params, device
+        # error) must fail the ACTIVE requests typed — not silently
+        # kill the worker thread — and the engine must serve the next
+        # request normally (slab rebuilt after the donated buffers died
+        # with the failed dispatch)
+        m = _lm()
+        eng = GenerationEngine(m, n_slots=2, queue_limit=8,
+                               default_timeout_s=60.0)
+        try:
+            eng.warmup()
+            real = eng.backend.decode
+            boom = {"armed": True}
+
+            def exploding(*a, **kw):
+                if boom["armed"]:
+                    boom["armed"] = False
+                    raise RuntimeError("injected decode failure")
+                return real(*a, **kw)
+
+            eng.backend.decode = exploding
+            prompt = _prompts(1, seed=41)[0]
+            with pytest.raises(RuntimeError, match="injected"):
+                eng.submit(prompt, max_new=8, timeout=60).result(timeout=60)
+            # worker alive, slot freed, slab rebuilt: next request works
+            out = eng.submit(prompt, max_new=4, timeout=60).result(timeout=60)
+            np.testing.assert_array_equal(
+                out, m.generate_cached(prompt, max_new=4)[0])
+        finally:
+            eng.shutdown()
+
+    def test_overload_typed(self):
+        # 1-slot engine with a 1-deep queue: the third concurrent
+        # request must reject typed, not block
+        m = _lm()
+        eng = GenerationEngine(m, n_slots=1, queue_limit=1,
+                               default_timeout_s=60.0)
+        try:
+            held = []
+            for i in range(2):
+                held.append(eng.submit(_prompts(1, seed=i)[0], max_new=30,
+                                       timeout=60))
+                # let the worker drain the queue into the slot before the
+                # next submit (admission capacity = slots + queue depth,
+                # but only after the pop — don't race it)
+                t_end = time.monotonic() + 10
+                while (i == 0 and eng.queue_depth()
+                       and time.monotonic() < t_end):
+                    time.sleep(0.005)
+            with pytest.raises(ServerOverloadedError):
+                for i in range(20):  # at most 1 admits before the check
+                    eng.submit(_prompts(1, seed=90 + i)[0], max_new=30,
+                               timeout=60)
+            for r in held:
+                r.result(timeout=60)
+        finally:
+            eng.shutdown()
+
+    def test_memory_limit_typed_at_build(self):
+        with pytest.raises(GenerationMemoryError, match="n_slots"):
+            GenerationEngine(_lm(), n_slots=2, memory_limit_bytes=1)
+
+    def test_memory_report_shape(self):
+        rep = _engine().memory_report
+        assert rep["cache_bytes"] > 0
+        assert rep["param_bytes"] > 0
+        assert rep["total_bytes"] == rep["cache_bytes"] + rep["param_bytes"]
+
+    def test_flight_events_slot_lifecycle(self):
+        from deeplearning4j_tpu.obs.flight import default_flight_recorder
+
+        rec = default_flight_recorder()
+        mark = rec.recorded_total
+        eng = _engine()
+        eng.submit(_prompts(1, seed=21)[0], max_new=3,
+                   timeout=90).result(timeout=90)
+        # recorded_total is the NEXT seq to assign: new events are >= it
+        new = [e for e in rec.events() if e.get("seq", 0) >= mark]
+        kinds = {e["kind"] for e in new}
+        assert "slot_claim" in kinds
+        assert "slot_free" in kinds
+        claim = next(e for e in new if e["kind"] == "slot_claim")
+        assert claim["prompt_len"] > 0 and claim["prompt_bucket"] > 0
+        free = next(e for e in new if e["kind"] == "slot_free")
+        assert free["reason"] == "done" and free["tokens"] == 3
+
+    def test_rtrace_timeline_stages(self):
+        eng = _engine()
+        req = eng.submit(_prompts(1, seed=23)[0], max_new=3, timeout=90,
+                         trace=True)
+        req.result(timeout=90)
+        tl = req.trace.timeline()
+        stages = [s["stage"] for s in tl["stages"]]
+        assert stages == ["queue", "prefill", "decode", "respond"]
+        assert tl["tokens"] == 3
+        assert tl["slot"] is not None
+        assert tl["total_ms"] == pytest.approx(
+            sum(s["ms"] for s in tl["stages"]), abs=0.1)
+
+    def test_shutdown_drains_then_rejects(self):
+        eng = GenerationEngine(_lm(), n_slots=2, queue_limit=8,
+                               default_timeout_s=60.0)
+        reqs = [eng.submit(_prompts(1, seed=31 + i)[0], max_new=4,
+                           timeout=60) for i in range(4)]
+        eng.shutdown(drain=True)
+        for r in reqs:
+            assert r.result(timeout=10).shape[0] > 0  # drained, served
+        with pytest.raises(ServerShutdownError):
+            eng.submit(_prompts(1, seed=40)[0], max_new=2)
+
+    def test_describe(self):
+        d = _engine().describe()
+        assert d["backend"] == "transformer"
+        assert d["n_slots"] == 3
+        assert d["prefill_buckets"][-1] == 48
+        assert "generation_decode" in d["trace_counts"]
+
+
+# ---------------------------------------------------------------------------
+# LSTM carried-state backend
+# ---------------------------------------------------------------------------
+class TestRecurrentGeneration:
+    @pytest.fixture(scope="class")
+    def net(self):
+        from deeplearning4j_tpu.models.textgen_lstm import TextGenerationLSTM
+
+        return TextGenerationLSTM(num_classes=12, units=16).init()
+
+    def _host_greedy(self, net, prompt, max_new):
+        """Reference: re-run the FULL sequence forward per token."""
+        seq = list(int(t) for t in prompt)
+        for _ in range(max_new):
+            x = np.zeros((1, len(seq), 12), np.float32)
+            x[0, np.arange(len(seq)), seq] = 1.0
+            y = net.output(x)
+            seq.append(int(y[0, -1].argmax()))
+        return np.asarray(seq, np.int32)
+
+    def test_carried_state_parity_vs_full_forward(self, net):
+        eng = GenerationEngine(net, n_slots=2, max_length=64,
+                               queue_limit=16, default_timeout_s=90.0)
+        try:
+            eng.warmup()
+            before = dict(eng.trace_counts)
+            rng = np.random.default_rng(2)
+            cases = []
+            for i in range(4):
+                tp = int(rng.integers(3, 14))
+                prompt = rng.integers(0, 12, (tp,)).astype(np.int32)
+                mn = int(rng.integers(3, 8))
+                cases.append((prompt, mn,
+                              eng.submit(prompt, max_new=mn, timeout=90)))
+            for prompt, mn, req in cases:
+                np.testing.assert_array_equal(
+                    req.result(timeout=90),
+                    self._host_greedy(net, prompt, mn))
+            assert eng.trace_counts == before  # recurrent path: 0 too
+            assert eng.backend.kind == "recurrent"
+        finally:
+            eng.shutdown()
+
+    def test_unsupported_model_typed(self):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=4, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(TypeError, match="incremental-decode"):
+            GenerationEngine(net, n_slots=1)
+
+
+# ---------------------------------------------------------------------------
+# HTTP front-end
+# ---------------------------------------------------------------------------
+def _http(port, method, path, body=None, timeout=90):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 None if body is None else json.dumps(body))
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp, raw
+
+
+class TestGenerateHTTP:
+    @pytest.fixture(scope="class")
+    def served(self):
+        from deeplearning4j_tpu.serving import (
+            BucketPolicy,
+            InferenceEngine,
+            InferenceServer,
+        )
+
+        m = _lm()
+        gen = _engine()
+        eng = InferenceEngine(m, buckets=BucketPolicy(batch_buckets=[1]))
+        srv = InferenceServer(eng, port=0, generation=gen).start()
+        yield srv, m
+        # detach the shared engine before server shutdown would drain it
+        srv.generation = None
+        srv.shutdown()
+
+    def test_generate_non_stream_parity(self, served):
+        srv, m = served
+        resp, raw = _http(srv.port, "POST", "/generate",
+                          {"prompt": [1, 2, 3], "max_new": 5,
+                           "stream": False})
+        assert resp.status == 200
+        body = json.loads(raw)
+        solo = m.generate_cached(np.asarray([1, 2, 3], np.int32),
+                                 max_new=5)[0]
+        assert body["sequence"] == solo.tolist()
+        assert body["tokens"] == solo[3:].tolist()
+
+    def test_generate_stream_chunks(self, served):
+        srv, m = served
+        resp, raw = _http(srv.port, "POST", "/generate",
+                          {"prompt": [4, 5, 6, 7], "max_new": 4})
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "application/x-ndjson"
+        lines = [json.loads(ln) for ln in
+                 raw.decode().strip().split("\n")]
+        toks = [ln["token"] for ln in lines[:-1]]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == toks
+        solo = m.generate_cached(np.asarray([4, 5, 6, 7], np.int32),
+                                 max_new=4)[0]
+        assert toks == solo[4:].tolist()
+
+    def test_generate_window_overflow_400(self, served):
+        srv, _ = served
+        resp, raw = _http(srv.port, "POST", "/generate",
+                          {"prompt": list(range(40)), "max_new": 20,
+                           "stream": False})
+        assert resp.status == 400
+        assert json.loads(raw)["error"] == "ContextWindowExceeded"
+
+    def test_generate_bad_payload_400(self, served):
+        srv, _ = served
+        resp, raw = _http(srv.port, "POST", "/generate", {"max_new": 3})
+        assert resp.status == 400
+
+    def test_healthz_and_metrics_expose_generation(self, served):
+        srv, _ = served
+        resp, raw = _http(srv.port, "GET", "/healthz")
+        info = json.loads(raw)["generation"]
+        assert info["backend"] == "transformer"
+        resp, raw = _http(srv.port, "GET", "/metrics")
+        gen = json.loads(raw)["generation"]
+        assert gen["tokens"] > 0
+        assert gen["slots"] == 3
+
+    def test_generate_409_without_engine(self):
+        from deeplearning4j_tpu.serving import (
+            BucketPolicy,
+            InferenceEngine,
+            InferenceServer,
+        )
+
+        eng = InferenceEngine(_lm(), buckets=BucketPolicy(batch_buckets=[1]))
+        srv = InferenceServer(eng, port=0).start()
+        try:
+            resp, raw = _http(srv.port, "POST", "/generate",
+                              {"prompt": [1], "max_new": 2})
+            assert resp.status == 409
+            assert json.loads(raw)["error"] == "NoGenerationEngine"
+        finally:
+            srv.shutdown()
+
+
+def teardown_module(module):
+    eng = _ENG.pop("e", None)
+    if eng is not None:
+        eng.shutdown()
+    _LM.clear()
