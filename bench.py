@@ -1277,6 +1277,175 @@ def _tpu_plausible() -> bool:
     return jp == "" and importlib.util.find_spec("axon") is not None
 
 
+def _bench_registry(n_tenants: int = 6, reqs_per_tenant: int = 24,
+                    canary_window_s: float = 1.5):
+    """Continuous-deployment bench (ISSUE 11): a multiplexed storm
+    across two registry models through the HTTP router — gate 1: ZERO
+    steady-state recompiles (trace-counter-asserted across ALL live
+    engines) — then a deliberately regressed publish mid-traffic —
+    gate 2: the publish→regression_trip→rollback wall time is at most
+    2× the canary window. Writes BENCH_registry.json and returns it."""
+    import http.client
+    import tempfile
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        InferenceServer,
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.train.faults import save_checkpoint
+
+    d_in, d_out = 64, 10
+
+    def fresh_net(seed, hidden):
+        conf = (NeuralNetConfiguration.builder().seed(seed).list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=d_out, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    tmp = tempfile.mkdtemp(prefix="bench_registry_")
+    reg = ModelRegistry(os.path.join(tmp, "registry"))
+    models = {"alpha": fresh_net(1, 32), "beta": fresh_net(2, 64)}
+    for name, net in models.items():
+        path = save_checkpoint(net, os.path.join(tmp, f"ck_{name}"))
+        reg.publish(name, path, score=1.0)
+
+    probe_x = np.zeros((8, d_in), np.float32)
+    bad_versions = set()
+
+    def score_probe(engine):
+        # the held-out validation re-run against the live engine: the
+        # scrambled snapshot "scores" terribly, everything else is fine
+        src = str(engine.describe()["source"])
+        return 9.0 if any(f"v{v:04d}" in src for v in bad_versions) else 1.0
+
+    router = ModelRouter(reg, batch_limit=16, max_wait_ms=2.0,
+                         queue_limit=4096, tenant_quota=None,
+                         canary_fraction=0.25,
+                         canary_window_s=canary_window_s,
+                         score_probe=score_probe,
+                         score_trip_tolerance=0.1, refresh_s=0.05)
+    for name in models:
+        router.managed(name)  # build + warm both engines up front
+    server = InferenceServer(router=router, port=0).start()
+    port = server.port
+
+    def retraces():
+        fam = router.metrics.registry.family_values("jit_retraces_total")
+        return sum(fam.values())
+
+    names = sorted(models)
+    lats, lock = [], threading.Lock()
+
+    def client(tid, stop_at=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        crng = np.random.default_rng(100 + tid)
+        mine = []
+        for i in range(reqs_per_tenant):
+            if stop_at is not None and time.perf_counter() > stop_at:
+                break
+            name = names[(tid + i) % len(names)]
+            n = int(crng.integers(1, 9))
+            x = crng.standard_normal((n, d_in)).astype(np.float32)
+            t0 = time.perf_counter()
+            conn.request("POST", f"/models/{name}/predict",
+                         json.dumps({"inputs": x.tolist()}),
+                         headers={"X-Tenant": f"tenant-{tid}"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status == 200:
+                mine.append(time.perf_counter() - t0)
+        conn.close()
+        with lock:
+            lats.extend(mine)
+
+    # phase 1: multiplexed steady-state storm, compile-count gated
+    compiles_before = retraces()
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    storm_s = time.perf_counter() - t0
+    storm_retraces = retraces() - compiles_before
+    lats.sort()
+    p50 = lats[len(lats) // 2] * 1e3 if lats else None
+    p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)] * 1e3 \
+        if lats else None
+
+    # phase 2: regressed publish mid-traffic → measure rollback latency
+    # (same arch, different weights; the score probe is what flags it)
+    bad = fresh_net(99, 32)
+    bad_path = save_checkpoint(bad, os.path.join(tmp, "ck_alpha"))
+    stop_at = time.perf_counter() + 4 * canary_window_s + 10
+    bg = [threading.Thread(target=client, args=(10 + t, stop_at))
+          for t in range(2)]
+    for t in bg:
+        t.start()
+    t_pub = time.perf_counter()
+    rec = reg.publish("alpha", bad_path, score=0.99)  # passes validation
+    bad_versions.add(rec["version"])
+    rollback_s = None
+    deadline = time.perf_counter() + 4 * canary_window_s + 10
+    while time.perf_counter() < deadline:
+        status = reg.get("alpha")["versions"][str(rec["version"])]["status"]
+        if status == "rolled_back":
+            rollback_s = time.perf_counter() - t_pub
+            break
+        time.sleep(0.02)
+    for t in bg:
+        t.join()
+    active_after = reg.get("alpha")["active_version"]
+    server.shutdown()
+
+    gate_retraces = storm_retraces == 0
+    gate_rollback = (rollback_s is not None
+                     and rollback_s <= 2.0 * canary_window_s)
+    out = {
+        "metric": "registry_bad_publish_rollback_seconds",
+        "value": None if rollback_s is None else round(rollback_s, 3),
+        "unit": "seconds",
+        "vs_baseline": None,
+        "extra": {
+            "platform": jax.default_backend(),
+            "models": len(models),
+            "storm": {
+                "tenants": n_tenants,
+                "requests": len(lats),
+                "seconds": round(storm_s, 2),
+                "req_per_sec": round(len(lats) / storm_s, 1),
+                "p50_ms": None if p50 is None else round(p50, 2),
+                "p99_ms": None if p99 is None else round(p99, 2),
+                "retraces": int(storm_retraces),
+            },
+            "canary_window_s": canary_window_s,
+            "rollback": {
+                "latency_s": None if rollback_s is None
+                else round(rollback_s, 3),
+                "active_version_after": active_after,
+                "gate": "rollback_latency <= 2x canary_window",
+            },
+            "gates": {"zero_storm_retraces": gate_retraces,
+                      "rollback_within_2x_window": gate_rollback},
+            "ok": bool(gate_retraces and gate_rollback),
+        },
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_registry.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     compute_dtype = "bfloat16"
@@ -1452,6 +1621,19 @@ if __name__ == "__main__":
 
             jax.config.update("jax_platforms", "cpu")
         out = _bench_generate()
+        if not _tpu_plausible():
+            out["metric"] = "cpu_fallback_" + out["metric"]
+        print(json.dumps(out))
+        sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "registry":
+        # continuous-deployment storm + bad-publish rollback latency:
+        # meaningful on any backend (the gates are zero retraces and
+        # rollback <= 2x the canary window), writes BENCH_registry.json
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        out = _bench_registry()
         if not _tpu_plausible():
             out["metric"] = "cpu_fallback_" + out["metric"]
         print(json.dumps(out))

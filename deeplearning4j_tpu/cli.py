@@ -97,10 +97,35 @@ def serve_main(argv) -> int:
         description="Serve a model over HTTP: bucketed dynamic batching, "
                     "compile-cache warmup, backpressure, hot reload",
     )
-    ap.add_argument("--model", required=True,
+    ap.add_argument("--model", default=None,
                     help="zoo model name (fresh weights — smoke runs), "
                          "checkpoint zip, or checkpoint DIRECTORY "
-                         "(newest valid; also the /reload source)")
+                         "(newest valid; also the /reload source). "
+                         "Optional with --registry-dir (the registry "
+                         "names the models)")
+    ap.add_argument("--registry-dir", default=None,
+                    help="serve a model REGISTRY instead of one model: "
+                         "multi-model routing (POST /models/<name>/"
+                         "predict|generate, GET /models/<name>/healthz), "
+                         "canary routing of newly published versions "
+                         "with auto-rollback, per-tenant quotas, LRU "
+                         "cold-model eviction. Pair with a trainer's "
+                         "cli fit --publish-to for the continuous "
+                         "train→serve loop")
+    ap.add_argument("--canary-fraction", type=float, default=0.1,
+                    help="share of a model's traffic routed to a newly "
+                         "validated version while its canary window runs")
+    ap.add_argument("--canary-window", type=float, default=30.0,
+                    help="canary window SECONDS: a clean window auto-"
+                         "promotes; any dispatch failure, latency blow-up "
+                         "or score regression trips auto-rollback")
+    ap.add_argument("--tenant-quota", type=int, default=None,
+                    help="max in-flight requests per tenant (X-Tenant "
+                         "header / payload key); beyond it THAT tenant "
+                         "gets typed 503s, others are unaffected")
+    ap.add_argument("--max-live-models", type=int, default=4,
+                    help="warmed engines held live; colder models are "
+                         "LRU-evicted and rewarmed on demand")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8080,
                     help="0 binds an ephemeral port (printed at startup)")
@@ -150,6 +175,8 @@ def serve_main(argv) -> int:
                     help="serve ONE local request through the HTTP stack, "
                          "print the result, shut down (CI gate)")
     args = ap.parse_args(argv)
+    if args.model is None and args.registry_dir is None:
+        ap.error("one of --model or --registry-dir is required")
 
     from deeplearning4j_tpu.models.selector import ZOO, ModelSelector
     from deeplearning4j_tpu.serving import (
@@ -157,6 +184,9 @@ def serve_main(argv) -> int:
         InferenceEngine,
         InferenceServer,
     )
+
+    if args.registry_dir is not None:
+        return _serve_registry(args)
 
     batch_buckets = (None if args.buckets is None
                      else [int(b) for b in args.buckets.split(",")])
@@ -276,6 +306,87 @@ def serve_main(argv) -> int:
         server.serve_forever()
     except KeyboardInterrupt:
         print("shutting down (draining queue)", flush=True)
+        server.shutdown()
+    return 0
+
+
+def _serve_registry(args) -> int:
+    """Registry mode of the ``serve`` subcommand: multi-model routing
+    with canary deployment (serving/registry.py)."""
+    from deeplearning4j_tpu.obs.metrics import default_registry
+    from deeplearning4j_tpu.serving import (
+        InferenceServer,
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+    registry = ModelRegistry(args.registry_dir)
+    router = ModelRouter(
+        registry, batch_limit=args.batch_limit,
+        max_wait_ms=args.max_wait_ms, queue_limit=args.queue_limit,
+        max_live_models=args.max_live_models,
+        tenant_quota=args.tenant_quota,
+        canary_fraction=args.canary_fraction,
+        canary_window_s=args.canary_window,
+        gen_slots=args.gen_slots, gen_max_length=args.gen_max_length,
+        metrics=ServingMetrics(registry=default_registry()))
+    names = registry.models()
+    print(f"registry {args.registry_dir}: models {names or '(none yet)'} "
+          f"(canary {args.canary_fraction:.0%} for "
+          f"{args.canary_window:.0f}s, "
+          f"tenant quota {args.tenant_quota})", flush=True)
+    if not args.no_warmup:
+        # admit (build + warm) up to max_live_models eagerly so the
+        # first request per model never pays the rewarm stall
+        for name in names[: args.max_live_models]:
+            try:
+                router.managed(name)
+                print(f"warmed {name} "
+                      f"(v{registry.get(name)['active_version']})",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — a model without an
+                # active version yet must not block serving the others
+                print(f"warmup skipped for {name}: {e}", flush=True)
+    server = InferenceServer(
+        router=router, host=args.host, port=args.port,
+        batch_limit=args.batch_limit, max_wait_ms=args.max_wait_ms,
+        queue_limit=args.queue_limit)
+    print(f"listening on http://{args.host}:{server.port} "
+          "(POST /models/<name>/predict|generate, /predict with a "
+          "\"model\" key; GET /models/<name>/healthz, /healthz, "
+          "/metrics)", flush=True)
+    if args.smoke:
+        import http.client
+        import json as _json
+
+        import numpy as _np
+
+        if not names:
+            print("smoke: registry holds no models", flush=True)
+            return 1
+        name = names[0]
+        mm = router.managed(name)
+        shape = mm.active.engine.example_shape() or (1,)
+        x = _np.zeros((1,) + tuple(shape), _np.float32).tolist()
+        server.start()
+        conn = http.client.HTTPConnection(args.host, server.port,
+                                          timeout=30)
+        conn.request("POST", f"/models/{name}/predict",
+                     _json.dumps({"inputs": x}),
+                     headers={"X-Tenant": "smoke"})
+        resp = conn.getresponse()
+        body = _json.loads(resp.read())
+        ok = resp.status == 200 and "outputs" in body
+        print(f"smoke: HTTP {resp.status} model={name} "
+              f"version={body.get('model_version')} "
+              f"{'ok' if ok else body}", flush=True)
+        server.shutdown()
+        return 0 if ok else 1
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down (draining queues)", flush=True)
         server.shutdown()
     return 0
 
@@ -538,6 +649,21 @@ def main(argv=None) -> int:
                          "device-count portable: a run checkpointed with "
                          "--workers N resumes under any --workers M "
                          "(parallel/reshard.py re-places the state)")
+    ap.add_argument("--publish-to", default=None,
+                    help="continuous train→serve deployment: publish "
+                         "every checkpoint this run writes to a serving "
+                         "model REGISTRY directory, each gated by a "
+                         "held-out validation step (non-finite or "
+                         "regressed snapshots are refused typed, never "
+                         "activated). Requires --checkpoint-dir; pair "
+                         "with cli serve --registry-dir for canary "
+                         "routing + auto-rollback on the serving side")
+    ap.add_argument("--publish-model", default=None,
+                    help="registry model name to publish under "
+                         "(default: --model)")
+    ap.add_argument("--publish-val-batches", type=int, default=2,
+                    help="batches held out of the dataset tail for the "
+                         "publish validation score")
     ap.add_argument("--elastic", action="store_true",
                     help="survive losing part of the mesh mid-fit: "
                          "checkpoint every epoch's worth of steps, and on "
@@ -653,6 +779,10 @@ def main(argv=None) -> int:
                    else InMemoryStatsStorage())
         model.add_listeners(StatsListener(storage, session_id="cli"))
 
+    publish_listener = None
+    if args.publish_to and not args.checkpoint_dir:
+        raise SystemExit("--publish-to requires --checkpoint-dir (the "
+                         "publish listener rides the checkpoint cadence)")
     if args.checkpoint_dir:
         import os
 
@@ -664,7 +794,47 @@ def main(argv=None) -> int:
         # otherwise grow the directory by keep_last zips per incarnation
         if os.path.isdir(args.checkpoint_dir):
             prune_checkpoints(args.checkpoint_dir, args.keep_last)
-        if not args.elastic:
+        if args.publish_to and args.elastic:
+            raise SystemExit("--publish-to cannot combine with --elastic "
+                             "yet (the elastic driver owns checkpoint "
+                             "cadence); publish from a non-elastic fit")
+        if args.publish_to:
+            from deeplearning4j_tpu.data.iterators import (
+                ExistingDataSetIterator,
+            )
+            from deeplearning4j_tpu.serving.registry import ModelRegistry
+            from deeplearning4j_tpu.train.earlystopping import (
+                DataSetLossCalculator,
+            )
+            from deeplearning4j_tpu.train.listeners import (
+                RegistryPublishListener,
+            )
+
+            # genuinely hold the validation tail OUT of training (the
+            # tune subcommand's split): a gate that scores trained-on
+            # data would miss exactly the overfit regressions it exists
+            # to catch
+            n_val = max(int(args.publish_val_batches), 1)
+            batches = list(it)
+            if len(batches) <= n_val:
+                raise SystemExit(
+                    f"dataset yields {len(batches)} batches; need more "
+                    f"than --publish-val-batches={n_val}")
+            val = batches[-n_val:]
+            it = ExistingDataSetIterator(batches[:-n_val])
+            publish_registry = ModelRegistry(args.publish_to)
+            publish_listener = RegistryPublishListener(
+                args.checkpoint_dir, publish_registry,
+                args.publish_model or args.model,
+                validator=DataSetLossCalculator(
+                    ExistingDataSetIterator(val)).calculate_score,
+                save_every_n_epochs=1, keep_mode="last",
+                keep_last=args.keep_last)
+            model.add_listeners(publish_listener)
+            print(f"publishing to registry {args.publish_to} as "
+                  f"{args.publish_model or args.model!r} "
+                  f"({n_val} held-out validation batches)", flush=True)
+        elif not args.elastic:
             # under --elastic the driver owns checkpointing (same dir,
             # iteration cadence) — a second epoch listener would double
             # every write and fight the pruning
@@ -732,6 +902,11 @@ def main(argv=None) -> int:
         model.fit(it, epochs=args.epochs)
     print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
           f"final score {float(model.score_):.4f}", flush=True)
+    if publish_listener is not None:
+        print(f"published {len(publish_listener.published)} snapshot(s) "
+              f"to {args.publish_to}, "
+              f"{len(publish_listener.refused)} refused by validation",
+              flush=True)
     if metrics_server is not None:
         metrics_server.shutdown()
     if args.skip_nonfinite or args.max_bad_steps is not None:

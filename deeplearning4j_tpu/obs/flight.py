@@ -6,12 +6,18 @@ stopped" — the black box TF-Serving-style production stacks (arXiv
 1605.08695) keep next to every training job. Every noteworthy host-side
 event — step/bundle completion with loss, NaN-skip, loss-scale change,
 checkpoint write/load, hot reload, overload rejection, jit retrace,
-profiler capture, and since PR 8 the elastic-recovery lifecycle
+profiler capture, since PR 8 the elastic-recovery lifecycle
 (``mesh_shrink`` with N→M, ``reshard_start``/``reshard_done`` with wall
 time and the device/host byte ledger, ``elastic_resume``,
 ``elastic_giveup``, ``checkpoint_fallback`` — a post-dropout dump reads
-as the complete recovery timeline) — is appended to a thread-safe
-fixed-size ring
+as the complete recovery timeline), and since PR 11 the continuous-
+deployment lifecycle (serving/registry.py: ``publish`` /
+``publish_refused`` / ``validated`` / ``canary_start`` / ``promote`` /
+``regression_trip`` / ``rollback``, plus ``model_evict`` /
+``model_rewarm`` / ``tenant_reject`` and the generation watchdog's
+escalated ``decode_stall`` — a dump reads as the ordered
+publish→canary→promote-or-rollback timeline) — is appended to a
+thread-safe fixed-size ring
 (:class:`FlightRecorder`), and the ring is dumped **atomically** to JSON
 when it matters:
 

@@ -26,6 +26,11 @@ the training stack produces crash-safe checkpoints
   a slotted fixed-shape KV-cache/carry slab where requests join and
   leave the ONE in-flight jitted decode step at token granularity,
   with in-graph sampling and streamed responses (``POST /generate``).
+- :mod:`registry` — the safe train→serve bridge: a crash-safe
+  :class:`ModelRegistry` of named models with versioned,
+  validation-gated snapshots, and the :class:`ModelRouter` serving
+  them multiplexed (canary routing with auto-rollback, per-tenant
+  queue quotas, LRU cold-model eviction/rewarm).
 """
 
 from deeplearning4j_tpu.serving.batcher import (
@@ -39,16 +44,28 @@ from deeplearning4j_tpu.serving.batcher import (
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.generate import (
+    DecodeStalledError,
     GenerationEngine,
     GenerationMemoryError,
     GenerationRequest,
 )
 from deeplearning4j_tpu.serving.metrics import GenerationMetrics, ServingMetrics
+from deeplearning4j_tpu.serving.registry import (
+    CanaryRolledBackError,
+    ModelRegistry,
+    ModelRouter,
+    RegistryError,
+    SnapshotValidationError,
+    TenantQuotaExceededError,
+    UnknownModelError,
+)
 from deeplearning4j_tpu.serving.rtrace import RequestTrace, TraceBuffer
 from deeplearning4j_tpu.serving.server import InferenceServer
 
 __all__ = [
     "BucketPolicy",
+    "CanaryRolledBackError",
+    "DecodeStalledError",
     "DynamicBatcher",
     "GenerationEngine",
     "GenerationMemoryError",
@@ -57,11 +74,17 @@ __all__ = [
     "InferenceEngine",
     "InferenceRequest",
     "InferenceServer",
+    "ModelRegistry",
+    "ModelRouter",
+    "RegistryError",
     "RequestDeadlineExceeded",
     "RequestTrace",
     "ServerOverloadedError",
     "ServerShutdownError",
     "ServingError",
     "ServingMetrics",
+    "SnapshotValidationError",
+    "TenantQuotaExceededError",
     "TraceBuffer",
+    "UnknownModelError",
 ]

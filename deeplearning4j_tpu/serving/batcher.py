@@ -50,7 +50,14 @@ class ServingError(RuntimeError):
 
 
 class ServerOverloadedError(ServingError):
-    """Bounded request queue is full — shed load upstream (HTTP 503)."""
+    """Bounded request queue is full — shed load upstream (HTTP 503).
+
+    ``retry_after_s`` (when the rejecting surface can estimate one) is
+    the backoff hint the HTTP front-end forwards as a ``Retry-After``
+    header: current queue depth × recent per-dispatch wall time, i.e.
+    roughly when the queue as it stands now will have drained."""
+
+    retry_after_s: Optional[float] = None
 
 
 class ServerShutdownError(ServingError):
@@ -240,6 +247,10 @@ class DynamicBatcher:
         #: has a recent window; per-request opt-in/out overrides)
         self.trace_requests = bool(trace_requests)
         self._shutdown = False
+        # EWMA of per-dispatch wall seconds: the Retry-After estimator's
+        # service-time term (seeded pessimistically by the first real
+        # dispatch; until then overloads suggest a 1s floor)
+        self._dispatch_ewma_s: Optional[float] = None
         self._pending: Optional[InferenceRequest] = None  # worker-only slot
         self._worker = threading.Thread(
             target=self._loop, daemon=True, name="dl4j-tpu-batcher")
@@ -248,6 +259,16 @@ class DynamicBatcher:
     # -- client side --------------------------------------------------------
     def queue_depth(self) -> int:
         return self._queue.qsize()
+
+    def retry_after_s(self) -> float:
+        """Backoff hint for overloaded clients: the current queue depth
+        × the recent per-dispatch wall time (EWMA), clamped to [1, 60]s
+        — roughly when today's queue will have drained. Served as the
+        ``Retry-After`` header on 503s so clients back off instead of
+        hammering."""
+        per_dispatch = self._dispatch_ewma_s or 0.0
+        est = self._queue.qsize() * per_dispatch
+        return min(max(est, 1.0), 60.0)
 
     def submit(self, x, mask=None, timeout: Optional[float] = None,
                trace: Optional[bool] = None) -> InferenceRequest:
@@ -271,9 +292,11 @@ class DynamicBatcher:
 
             _flight.record("overload_reject", rows=req.rows,
                            queue_limit=self._queue.maxsize)
-            raise ServerOverloadedError(
+            err = ServerOverloadedError(
                 f"request queue full ({self._queue.maxsize} requests); "
-                "retry with backoff or scale out") from None
+                "retry with backoff or scale out")
+            err.retry_after_s = self.retry_after_s()
+            raise err from None
         # shutdown may have drained the queue between the flag check and
         # the put — fail our own request so the caller can never block
         # on a request no worker will look at (first-wins: if the drain
@@ -337,6 +360,7 @@ class DynamicBatcher:
             for r in live:
                 if r.trace is not None:
                     r.trace.mark("batch_assembled", t_assembled)
+            t_dispatch = time.monotonic()
             try:
                 self._dispatch(live)
                 for r in live:
@@ -347,6 +371,10 @@ class DynamicBatcher:
                 self.metrics.record_error()
                 for r in live:
                     r.fail(e)
+            dt = time.monotonic() - t_dispatch
+            self._dispatch_ewma_s = (
+                dt if self._dispatch_ewma_s is None
+                else 0.8 * self._dispatch_ewma_s + 0.2 * dt)
 
     # -- lifecycle ----------------------------------------------------------
     def shutdown(self, drain: bool = True, timeout: float = 10.0) -> None:

@@ -64,13 +64,18 @@ def conf_example_shape(conf) -> Optional[Tuple[int, ...]]:
     return tuple(itype.shape(1)[1:])
 
 
-def _checkpoint_source(source: str) -> str:
+def resolve_checkpoint_source(source: str) -> str:
     """Resolve a checkpoint zip from a path or directory (newest VALID
     one via the fault-tolerance layer). An EXPLICIT zip path that fails
     validation falls back to the newest valid sibling in its directory
     instead of killing server start — a truncated newest checkpoint next
     to keep-last-k valid older snapshots is exactly the crash the
-    retention policy exists for."""
+    retention policy exists for. Every fallback (this explicit-path one
+    and the directory scan inside ``latest_valid_checkpoint``) emits a
+    ``checkpoint_fallback`` flight event naming the SKIPPED path and the
+    error class, so a truncated snapshot mid-publish shows up in the
+    black box. Shared by engine construction, ``/reload``, and
+    ``ModelRegistry.publish``."""
     from deeplearning4j_tpu.train.faults import (
         latest_valid_checkpoint,
         validate_checkpoint,
@@ -98,9 +103,12 @@ def _checkpoint_source(source: str) -> str:
         f"checkpoint {source!r} is invalid ({reason}); serving the "
         f"newest valid sibling {fallback!r} instead", stacklevel=3)
     from deeplearning4j_tpu.obs import flight as _flight
+    from deeplearning4j_tpu.train.faults import checkpoint_error_class
 
     _flight.record("checkpoint_fallback", requested=str(source),
-                   served=str(fallback), reason=reason)
+                   skipped=str(source), served=str(fallback),
+                   error_class=checkpoint_error_class(reason),
+                   reason=reason)
     return fallback
 
 
@@ -176,7 +184,7 @@ class InferenceEngine:
             ModelSerializer,
         )
 
-        path = _checkpoint_source(source)
+        path = resolve_checkpoint_source(source)
         topo = ModelSerializer.checkpoint_meta(path).get("topology") or {}
         n_from = topo.get("n_devices")
         model = ModelGuesser.load_model_guess(path)
@@ -494,7 +502,7 @@ class InferenceEngine:
             raise ValueError("no reload source: pass a checkpoint path or "
                              "configure checkpoint_dir")
         with self._reload_lock:
-            path = _checkpoint_source(src)
+            path = resolve_checkpoint_source(src)
             fp = self._path_fingerprint(path)
             if (not force and fp is not None and fp == self._fingerprint
                     and str(path) == str(self._snap.source)):

@@ -76,6 +76,14 @@ class GenerationMemoryError(ServingError):
     no before the allocator does)."""
 
 
+class DecodeStalledError(ServingError):
+    """A decode dispatch hung past the watchdog limit (a configurable
+    multiple of the rolling per-step time). The engine's worker thread
+    is wedged inside the dispatch; the active requests are failed typed
+    by the watchdog so their callers unblock instead of hanging with
+    it, and the slab is rebuilt when (if) the dispatch returns."""
+
+
 class GenerationRequest:
     """One generation request: prompt + sampling policy + streaming
     output. Completion (``finish``/``fail``) is idempotent first-wins,
@@ -485,12 +493,38 @@ class GenerationEngine:
                  metrics: Optional[GenerationMetrics] = None,
                  memory_limit_bytes="auto", stall_ms: float = 2000.0,
                  trace_requests: bool = True,
-                 traces: Optional["rtrace.TraceBuffer"] = None):
+                 traces: Optional["rtrace.TraceBuffer"] = None,
+                 watchdog_mult: Optional[float] = 20.0,
+                 watchdog_min_s: float = 30.0):
         self.metrics = metrics if metrics is not None else GenerationMetrics()
         self.trace_requests = bool(trace_requests)
         self.traces = traces
         self.default_timeout_s = float(default_timeout_s)
         self.stall_ms = float(stall_ms)
+        #: a decode dispatch in flight longer than
+        #: ``max(watchdog_min_s, watchdog_mult × rolling step time)``
+        #: trips the watchdog: escalated ``decode_stall`` flight event +
+        #: active requests failed typed (:class:`DecodeStalledError`) —
+        #: a HUNG dispatch must not wedge every caller the way a FAILED
+        #: one already doesn't. None disables the watchdog.
+        self.watchdog_mult = (None if watchdog_mult is None
+                              else float(watchdog_mult))
+        self.watchdog_min_s = float(watchdog_min_s)
+        self._step_ewma_s: Optional[float] = None
+        self._dispatch_t0: Optional[float] = None
+        #: dispatch generation counter + the generation a trip belongs
+        #: to: the watchdog tags its trip with the generation it
+        #: observed hung, and the worker only honors a trip for the
+        #: dispatch it actually fired on — a dispatch that completes
+        #: just past the limit must not get its trip charged to the
+        #: NEXT, healthy dispatch
+        self._dispatch_gen = 0
+        self._stall_gen = -1
+        self._stall_tripped = False
+        #: EWMA of tokens decoded per finished request — the
+        #: Retry-After estimator's occupancy term (a queued request
+        #: holds a slot for ~this many steps, not one)
+        self._req_steps_ewma: Optional[float] = None
         #: fn-name → XLA programs traced (retrace-guard instrument)
         self.trace_counts: Dict[str, int] = {}
         self._retrace_counters = {}
@@ -550,6 +584,12 @@ class GenerationEngine:
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="dl4j-tpu-generate")
         self._worker.start()
+        self._watchdog: Optional[threading.Thread] = None
+        if self.watchdog_mult is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="dl4j-tpu-generate-watchdog")
+            self._watchdog.start()
 
     # -- client side --------------------------------------------------------
     def submit(self, prompt_ids, max_new: int = 20, temperature: float = 0.0,
@@ -588,9 +628,11 @@ class GenerationEngine:
             _flight.record("overload_reject", surface="generate",
                            prompt_len=int(prompt.size),
                            queue_limit=self._queue.maxsize)
-            raise ServerOverloadedError(
+            err = ServerOverloadedError(
                 f"generation queue full ({self._queue.maxsize} requests); "
-                "retry with backoff or add slots") from None
+                "retry with backoff or add slots")
+            err.retry_after_s = self.retry_after_s()
+            raise err from None
         if self._shutdown and req.fail(
                 ServerShutdownError("engine shut down while enqueuing")):
             raise ServerShutdownError("engine shut down while enqueuing")
@@ -610,6 +652,20 @@ class GenerationEngine:
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
+
+    def retry_after_s(self) -> float:
+        """Backoff hint for overloaded clients (the ``Retry-After``
+        header on 503s), clamped to [1, 60]s. The batcher's
+        depth×per-dispatch formula is wrong for the token loop — one
+        decode dispatch retires one TOKEN for every slot, not one
+        queued request — so the occupancy term scales by the typical
+        tokens-per-request and the slot count: ``queued / n_slots ×
+        steps-per-request × step time`` ≈ when a queued request will
+        actually have drained."""
+        steps = self._req_steps_ewma or 20.0
+        waves = self._queue.qsize() / max(self.n_slots, 1)
+        est = waves * steps * (self._step_ewma_s or 0.0)
+        return min(max(est, 1.0), 60.0)
 
     def describe(self) -> dict:
         return {
@@ -724,6 +780,11 @@ class GenerationEngine:
         req.slot = None
         if req.trace is not None:
             req.trace.mark("decode_done")
+        n_tok = len(req.tokens)
+        if n_tok:
+            self._req_steps_ewma = (
+                float(n_tok) if self._req_steps_ewma is None
+                else 0.8 * self._req_steps_ewma + 0.2 * n_tok)
         if error is not None:
             if isinstance(error, RequestDeadlineExceeded):
                 self.metrics.record_deadline()
@@ -741,11 +802,65 @@ class GenerationEngine:
         _flight.record("slot_free", slot=slot, reason=reason,
                        tokens=len(req.tokens))
 
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: the decode dispatch runs on the worker
+        thread, so a HUNG device call (driver wedge, deadlocked
+        collective) freezes the worker where the except-clause recovery
+        can never run. The watchdog observes the dispatch start stamp
+        from outside, and past the limit fails the active requests
+        typed and records the escalated stall — callers unblock, the
+        blocked worker performs slab cleanup when (if) the dispatch
+        finally returns."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        while True:
+            if self._shutdown and not self._worker.is_alive():
+                return
+            poll = min(max(self.watchdog_min_s / 4.0, 0.02), 1.0)
+            time.sleep(poll)
+            gen = self._dispatch_gen
+            t0 = self._dispatch_t0
+            if t0 is None or self._stall_tripped:
+                continue
+            limit = max(self.watchdog_min_s,
+                        self.watchdog_mult * (self._step_ewma_s or 0.0))
+            elapsed = time.monotonic() - t0
+            if elapsed <= limit:
+                continue
+            if self._dispatch_gen != gen or self._dispatch_t0 is None:
+                continue  # that dispatch completed while we measured
+            self._stall_gen = gen
+            self._stall_tripped = True
+            if self._dispatch_gen != gen or self._dispatch_t0 is None:
+                # completed in the set window: withdraw the trip before
+                # failing anyone — these slots now belong to a healthy
+                # (or no) dispatch
+                self._stall_tripped = False
+                continue
+            n_active = int(self._active.sum())
+            _flight.record("decode_stall", escalated=True,
+                           wall_ms=round(elapsed * 1e3, 1),
+                           limit_ms=round(limit * 1e3, 1),
+                           active=n_active)
+            err = DecodeStalledError(
+                f"decode dispatch stuck for {elapsed:.1f}s (limit "
+                f"{limit:.1f}s = max(watchdog_min_s, watchdog_mult × "
+                "rolling step time)); active requests failed, worker "
+                "thread still wedged in the dispatch")
+            self.metrics.record_error()
+            for slot in range(self.n_slots):
+                req = self._slots[slot]
+                if req is not None:
+                    req.fail(err)
+
     def _step(self) -> None:
         from deeplearning4j_tpu.obs import flight as _flight
 
         n_active = int(self._active.sum())
         t0 = time.monotonic()
+        self._dispatch_gen += 1
+        gen = self._dispatch_gen
+        self._dispatch_t0 = t0
         try:
             toks, keys = self.backend.decode(
                 self._tokens, self._pos, self._active, self._temp,
@@ -757,6 +872,8 @@ class GenerationEngine:
             # caller. The donated slab is gone with the failed dispatch,
             # so the slots cannot continue — but freed slots + a live
             # worker mean the next prefill rebuilds per-slot state.
+            self._dispatch_t0 = None
+            self._stall_tripped = False
             _flight.record("decode_error", error=type(e).__name__,
                            active=n_active)
             for slot in range(self.n_slots):
@@ -764,7 +881,32 @@ class GenerationEngine:
                     self._finish_slot(slot, reason="decode_error", error=e)
             self.backend.reset()
             return
+        self._dispatch_t0 = None
         dt = time.monotonic() - t0
+        if self._stall_tripped:
+            self._stall_tripped = False
+            if self._stall_gen != gen:
+                # a stale trip for an earlier dispatch that completed
+                # inside the watchdog's set window — this dispatch is
+                # healthy, keep its results
+                pass
+            else:
+                # the watchdog already failed the active requests while
+                # this dispatch hung; its result is stale — free the
+                # slots and rebuild per-slot state like the
+                # decode-failure path
+                _flight.record("decode_stall_recovered",
+                               wall_ms=round(dt * 1e3, 1), active=n_active)
+                err = DecodeStalledError("decode dispatch exceeded the "
+                                         "watchdog limit")
+                for slot in range(self.n_slots):
+                    if self._slots[slot] is not None:
+                        self._finish_slot(slot, reason="decode_stall",
+                                          error=err)
+                self.backend.reset()
+                return
+        self._step_ewma_s = (dt if self._step_ewma_s is None
+                             else 0.8 * self._step_ewma_s + 0.2 * dt)
         self.metrics.record_decode_step(dt, n_active)
         if dt * 1e3 > self.stall_ms:
             _flight.record("decode_stall", wall_ms=round(dt * 1e3, 1),

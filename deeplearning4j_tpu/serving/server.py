@@ -51,9 +51,28 @@ the production contract:
                            ``?ms=`` milliseconds (409 while another
                            capture runs)
 
+Registry mode (``router=``, serving/registry.py) adds multi-model
+routing:
+
+- ``POST /models/<name>/predict``      route by model name (canary
+                                       routing + per-tenant quotas in
+                                       the router); plain ``/predict``
+                                       with a ``"model"`` payload key
+                                       routes too
+- ``POST /models/<name>/predict_npy``  raw-npy variant
+- ``POST /models/<name>/generate``     the model's continuous-batching
+                                       generation engine
+- ``GET  /models/<name>/healthz``      per-model readiness
+                                       (active/canary versions, warm
+                                       state — 503 until a version is
+                                       active)
+
 Typed failures map to transport codes: queue-full backpressure → 503
 (clients back off), request deadline → 504, malformed input → 400,
-shutdown → 503, concurrent profiler capture → 409.
+shutdown → 503, concurrent profiler capture → 409, unknown model →
+404, per-tenant quota / canary rolled back mid-request → 503. Every
+503 carries a ``Retry-After`` header derived from the rejecting
+surface's queue depth × recent per-dispatch time.
 """
 
 from __future__ import annotations
@@ -80,28 +99,53 @@ from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
 class InferenceServer:
     """Engine + batcher + HTTP listener. ``port=0`` binds an ephemeral
-    port (read it back from ``server.port`` — the test/CI pattern)."""
+    port (read it back from ``server.port`` — the test/CI pattern).
 
-    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+    Two mounting modes:
+
+    - **single-model** (``engine=...``): the original PR-3 surface —
+      one engine behind /predict, unchanged.
+    - **registry** (``router=...``, a
+      :class:`~serving.registry.ModelRouter`): multi-model serving —
+      ``POST /models/<name>/predict`` and ``POST /models/<name>/generate``
+      route by model name across the router's warmed engines (canary
+      routing, per-tenant quotas, LRU eviction all live in the router);
+      ``GET /models/<name>/healthz`` is the per-model readiness probe;
+      plain ``/predict`` also routes when the payload carries a
+      ``"model"`` key. Tenants come from the ``X-Tenant`` header or a
+      ``"tenant"`` payload key. Both modes attach a ``Retry-After``
+      header to every 503 (backpressure clients can act on).
+    """
+
+    def __init__(self, engine: Optional[InferenceEngine] = None,
+                 host: str = "127.0.0.1",
                  port: int = 8080, batch_limit: int = 32,
                  max_wait_ms: float = 5.0, queue_limit: int = 256,
                  default_timeout_s: float = 30.0,
                  trace_requests: bool = True,
                  trace_buffer_size: int = 256,
-                 generation=None):
+                 generation=None, router=None):
         from deeplearning4j_tpu.serving.rtrace import TraceBuffer
 
+        if engine is None and router is None:
+            raise ValueError("InferenceServer needs an engine (single-"
+                             "model) and/or a router (registry serving)")
         self.engine = engine
+        #: optional serving/registry.py ModelRouter behind /models/...
+        self.router = router
         #: optional serving/generate.py GenerationEngine behind
         #: POST /generate (None → the route answers 409)
         self.generation = generation
-        self.metrics: ServingMetrics = engine.metrics
+        self.metrics: ServingMetrics = (engine.metrics if engine is not None
+                                        else router.metrics)
         self.default_timeout_s = float(default_timeout_s)
         #: recent per-request timelines (GET /trace). trace_requests
         #: stamps a timeline on EVERY request (a handful of monotonic
         #: reads — the bench gates its p99 cost at <=5%); off, only
         #: requests that opt in via {"trace": true} are traced.
         self.traces = TraceBuffer(trace_buffer_size)
+        if router is not None and router.traces is None:
+            router.traces = self.traces
         # bind the socket BEFORE starting the batcher worker: a bind
         # failure (EADDRINUSE) must raise without leaking a polling
         # thread nobody holds a handle to
@@ -113,13 +157,16 @@ class InferenceServer:
         # stamps each request with the snapshot version that actually
         # computed it (a concurrent hot reload must not mislabel
         # responses).
-        self.batcher = DynamicBatcher(
-            make_dispatcher(
-                lambda x, mask=None: self.engine.infer_versioned(x, mask),
-                metrics=self.metrics, traces=self.traces),
-            batch_limit=batch_limit, max_wait_ms=max_wait_ms,
-            queue_limit=queue_limit, metrics=self.metrics,
-            trace_requests=trace_requests)
+        self.batcher = None
+        if engine is not None:
+            self.batcher = DynamicBatcher(
+                make_dispatcher(
+                    lambda x, mask=None: self.engine.infer_versioned(x,
+                                                                     mask),
+                    metrics=self.metrics, traces=self.traces),
+                batch_limit=batch_limit, max_wait_ms=max_wait_ms,
+                queue_limit=queue_limit, metrics=self.metrics,
+                trace_requests=trace_requests)
         if self.generation is not None and self.generation.traces is None:
             # generation request timelines land in the same /trace ring
             self.generation.traces = self.traces
@@ -155,9 +202,12 @@ class InferenceServer:
         if not self._closed:
             self._closed = True
             self._httpd.server_close()
-        self.batcher.shutdown(drain=True)
+        if self.batcher is not None:
+            self.batcher.shutdown(drain=True)
         if self.generation is not None:
             self.generation.shutdown(drain=True)
+        if self.router is not None:
+            self.router.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -165,23 +215,44 @@ class InferenceServer:
     # -- request plumbing (called from handler threads) ----------------------
     def predict(self, x: np.ndarray, mask=None,
                 timeout_s: Optional[float] = None,
-                trace: Optional[bool] = None):
+                trace: Optional[bool] = None, model: Optional[str] = None,
+                tenant: Optional[str] = None):
         """Returns ``(outputs, model_version)`` — the version of the
         snapshot that actually computed them (stamped in the dispatch,
         so a concurrent hot reload cannot mislabel the response).
         ``trace=True`` forces a stage timeline onto this request even
         when batcher-level tracing is off; read it from
-        :meth:`predict_request`."""
-        out, version, _ = self.predict_request(x, mask, timeout_s, trace)
+        :meth:`predict_request`. ``model`` routes through the registry
+        router (required when the server has no single-model engine);
+        ``tenant`` is the quota identity."""
+        out, version, _ = self.predict_request(x, mask, timeout_s, trace,
+                                               model=model, tenant=tenant)
         return out, version
 
     def predict_request(self, x: np.ndarray, mask=None,
                         timeout_s: Optional[float] = None,
-                        trace: Optional[bool] = None):
+                        trace: Optional[bool] = None,
+                        model: Optional[str] = None,
+                        tenant: Optional[str] = None):
         """Like :meth:`predict` but also returns the completed
         :class:`~serving.batcher.InferenceRequest` (its ``trace`` holds
         the stage timeline when tracing was on)."""
         timeout = self.default_timeout_s if timeout_s is None else timeout_s
+        if model is not None or self.batcher is None:
+            if self.router is None:
+                raise ValueError(
+                    f"request names model {model!r} but the server has no "
+                    "registry router (start with router=/--registry-dir)")
+            if model is None:
+                raise ValueError(
+                    "registry-routed server: the request must name its "
+                    'model (POST /models/<name>/predict or a "model" '
+                    "payload key)")
+            req = self.router.submit(model, x, mask, timeout=timeout,
+                                     tenant=tenant or "default",
+                                     trace=trace)
+            out = req.result(timeout=timeout)
+            return out, req.model_version, req
         req = self.batcher.submit(x, mask, timeout=timeout, trace=trace)
         out = req.result(timeout=timeout)
         version = req.model_version
@@ -198,33 +269,73 @@ def _make_handler(server: InferenceServer):
 
         # -- helpers --------------------------------------------------------
         def _send(self, code: int, body: bytes,
-                  ctype: str = "application/json") -> None:
+                  ctype: str = "application/json",
+                  headers: Optional[dict] = None) -> None:
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _send_json(self, code: int, obj: dict) -> None:
-            self._send(code, json.dumps(obj).encode())
+        def _send_json(self, code: int, obj: dict,
+                       headers: Optional[dict] = None) -> None:
+            self._send(code, json.dumps(obj).encode(), headers=headers)
 
         def _body(self) -> bytes:
             n = int(self.headers.get("Content-Length", 0) or 0)
             return self.rfile.read(n) if n else b""
 
+        def _tenant(self, payload: Optional[dict] = None) -> str:
+            t = self.headers.get("X-Tenant")
+            if not t and payload:
+                t = payload.get("tenant")
+            return str(t) if t else "default"
+
+        def _retry_after(self, e: BaseException) -> dict:
+            """503s carry a Retry-After derived from the rejecting
+            surface's queue depth × recent per-dispatch time, so
+            clients back off instead of hammering."""
+            import math as _math
+
+            hint = getattr(e, "retry_after_s", None)
+            if hint is None:
+                hint = 1.0
+            return {"Retry-After": str(max(int(_math.ceil(hint)), 1))}
+
         def _error(self, e: BaseException) -> None:
+            from deeplearning4j_tpu.serving.registry import (
+                CanaryRolledBackError,
+                UnknownModelError,
+            )
+
             name = type(e).__name__
+            headers = None
             if isinstance(e, ServerOverloadedError):
                 code = 503
+                headers = self._retry_after(e)
             elif isinstance(e, RequestDeadlineExceeded):
                 code = 504
+            elif isinstance(e, CanaryRolledBackError):
+                # the canary version rolled back under this request —
+                # retryable, the active version is serving
+                code = 503
+                headers = self._retry_after(e)
             elif isinstance(e, ServerShutdownError):
                 code = 503
+                headers = self._retry_after(e)
+            elif isinstance(e, UnknownModelError):
+                code = 404
             elif isinstance(e, (ValueError, KeyError, TypeError)):
                 code = 400
             else:
                 code = 500
-            self._send_json(code, {"error": name, "message": str(e)})
+            body = {"error": name, "message": str(e)}
+            tenant = getattr(e, "tenant", None)
+            if tenant is not None:
+                body["tenant"] = tenant
+            self._send_json(code, body, headers=headers)
 
         # -- routes ---------------------------------------------------------
         def do_GET(self):  # noqa: N802
@@ -237,16 +348,25 @@ def _make_handler(server: InferenceServer):
 
             try:
                 url = urlparse(self.path)
+                if url.path.startswith("/models/"):
+                    self._get_model_route(url)
+                    return
                 if url.path == "/healthz":
-                    info = server.engine.describe()
-                    info["snapshot_version"] = info.get("version")
+                    if server.engine is not None:
+                        info = server.engine.describe()
+                        info["snapshot_version"] = info.get("version")
+                    else:
+                        info = server.router.describe()
                     info["uptime_s"] = round(
                         time.time() - server.metrics.started_at, 3)
                     if server.generation is not None:
                         info["generation"] = server.generation.describe()
                     self._send_json(200, {"status": "ok", **info})
                 elif url.path == "/metrics":
-                    depth = server.batcher.queue_depth()
+                    depth = (server.batcher.queue_depth()
+                             if server.batcher is not None else 0)
+                    if server.router is not None:
+                        depth += server.router.queue_depth()
                     if wants_prometheus(self.headers.get("Accept", ""),
                                         url.query):
                         self._send(200, server.metrics.prometheus_text(
@@ -286,9 +406,52 @@ def _make_handler(server: InferenceServer):
             except BaseException as e:  # never kill the connection thread
                 self._error(e)
 
+        def _model_route(self, path: str):
+            """``/models/<name>/<action>`` → (name, action); None when
+            the path does not parse (404)."""
+            parts = path.split("/")
+            if len(parts) != 4 or parts[1] != "models" or not parts[2]:
+                return None
+            return parts[2], parts[3]
+
+        def _get_model_route(self, url) -> None:
+            route = self._model_route(url.path)
+            if route is None or server.router is None:
+                self._send_json(404, {"error": "NotFound",
+                                      "message": self.path})
+                return
+            name, action = route
+            if action == "healthz":
+                info = server.router.healthz(name)
+                info["uptime_s"] = round(
+                    time.time() - server.metrics.started_at, 3)
+                code = 200 if info.get("active_version") is not None else 503
+                self._send_json(code, {"status": "ok" if code == 200
+                                       else "no_active_version", **info})
+            else:
+                self._send_json(404, {"error": "NotFound",
+                                      "message": self.path})
+
         def do_POST(self):  # noqa: N802
             try:
-                if self.path == "/predict":
+                route = self._model_route(self.path)
+                if route is not None:
+                    name, action = route
+                    if server.router is None:
+                        self._send_json(409, {
+                            "error": "NoRegistryRouter",
+                            "message": "server started without a registry "
+                                       "router (cli serve --registry-dir)"})
+                    elif action == "predict":
+                        self._predict_json(model=name)
+                    elif action == "predict_npy":
+                        self._predict_npy(model=name)
+                    elif action == "generate":
+                        self._generate(model=name)
+                    else:
+                        self._send_json(404, {"error": "NotFound",
+                                              "message": self.path})
+                elif self.path == "/predict":
                     self._predict_json()
                 elif self.path == "/predict_npy":
                     self._predict_npy()
@@ -302,7 +465,7 @@ def _make_handler(server: InferenceServer):
             except BaseException as e:
                 self._error(e)
 
-        def _predict_json(self) -> None:
+        def _predict_json(self, model: Optional[str] = None) -> None:
             try:
                 payload = json.loads(self._body() or b"{}")
                 x = np.asarray(payload["inputs"], np.float32)
@@ -315,27 +478,42 @@ def _make_handler(server: InferenceServer):
                 mask = np.asarray(mask, np.float32)
             timeout_ms = payload.get("timeout_ms")
             want_trace = bool(payload.get("trace", False))
+            model = model or payload.get("model")
             out, version, req = server.predict_request(
                 x, mask,
                 timeout_s=None if timeout_ms is None
                 else float(timeout_ms) / 1e3,
                 # None keeps the batcher default; True forces a
                 # timeline even when server-level tracing is off
-                trace=True if want_trace else None)
+                trace=True if want_trace else None,
+                model=model, tenant=self._tenant(payload))
             body = {"outputs": np.asarray(out).tolist(),
                     "model_version": version}
+            if model is not None:
+                body["model"] = model
             if want_trace and req.trace is not None:
                 body["trace"] = req.trace.timeline()
             self._send_json(200, body)
 
-        def _generate(self) -> None:
+        def _generate(self, model: Optional[str] = None) -> None:
             """Continuous-batching generation. Submit errors (overload,
             window overflow, shutdown) raise BEFORE any header is sent
             and map to their typed transport codes; once a stream has
             started, a mid-decode failure becomes a terminal
             ``{"error": ...}`` chunk (the status line is already on the
             wire)."""
-            if server.generation is None:
+            gen = server.generation
+            if model is not None:
+                try:
+                    gen = server.router.generation_for(model)
+                except (TypeError, ValueError) as e:
+                    # no incremental-decode path / gen_slots=0: the
+                    # model cannot generate — a route conflict, not a
+                    # malformed request
+                    self._send_json(409, {"error": "NoGenerationEngine",
+                                          "message": str(e)})
+                    return
+            if gen is None:
                 self._send_json(409, {
                     "error": "NoGenerationEngine",
                     "message": "server started without a generation "
@@ -350,7 +528,7 @@ def _make_handler(server: InferenceServer):
             timeout_s = (None if timeout_ms is None
                          else float(timeout_ms) / 1e3)
             want_trace = payload.get("trace")
-            req = server.generation.submit(
+            req = gen.submit(
                 prompt,
                 max_new=int(payload.get("max_new", 20)),
                 temperature=float(payload.get("temperature", 0.0)),
@@ -359,7 +537,7 @@ def _make_handler(server: InferenceServer):
                 seed=int(payload.get("seed", 0)),
                 timeout=timeout_s,
                 trace=None if want_trace is None else bool(want_trace))
-            wait_s = (server.generation.default_timeout_s
+            wait_s = (gen.default_timeout_s
                       if timeout_s is None else timeout_s)
             if not payload.get("stream", True):
                 out = req.result(timeout=wait_s)
@@ -408,7 +586,7 @@ def _make_handler(server: InferenceServer):
             except OSError:
                 pass
 
-        def _predict_npy(self) -> None:
+        def _predict_npy(self, model: Optional[str] = None) -> None:
             body = self._body()
             try:
                 x = np.load(io.BytesIO(body), allow_pickle=False)
@@ -416,12 +594,20 @@ def _make_handler(server: InferenceServer):
                 # empty/truncated bodies raise EOFError/OSError from
                 # np.load — all are the client's malformed input (400)
                 raise ValueError(f"bad /predict_npy body: {e}") from e
-            out, _ = server.predict(np.asarray(x, np.float32))
+            out, _ = server.predict(np.asarray(x, np.float32), model=model,
+                                    tenant=self._tenant())
             buf = io.BytesIO()
             np.save(buf, np.asarray(out), allow_pickle=False)
             self._send(200, buf.getvalue(), ctype="application/x-npy")
 
         def _reload(self) -> None:
+            if server.engine is None:
+                self._send_json(409, {
+                    "error": "NoSingleModelEngine",
+                    "message": "registry-routed server: versions deploy "
+                               "through the registry (publish → canary → "
+                               "promote), not /reload"})
+                return
             body = self._body()
             payload = json.loads(body) if body else {}
             try:

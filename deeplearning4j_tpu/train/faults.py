@@ -492,14 +492,36 @@ def checkpoint_fingerprint(path: str) -> Tuple[int, int]:
     return (st.st_mtime_ns, st.st_size)
 
 
+def checkpoint_error_class(reason: str) -> str:
+    """Coarse class of a :func:`validate_checkpoint` failure reason —
+    the field the ``checkpoint_fallback`` flight event carries so a
+    postmortem can split CRC corruption from truncation from stray
+    files without string-matching free-form reasons."""
+    r = reason.lower()
+    if "crc" in r:
+        return "crc_mismatch"
+    if "unreadable" in r:
+        return "unreadable_zip"
+    if "missing" in r:
+        return "missing_entries"
+    if "not a file" in r:
+        return "not_a_file"
+    return "invalid"
+
+
 def latest_valid_checkpoint(directory: str, missing_ok: bool = False
                             ) -> Optional[str]:
     """Newest checkpoint in ``directory`` that passes validation,
     warning about (and skipping over) corrupt/truncated newer ones.
-    Raises FileNotFoundError when no valid checkpoint exists —
-    ``missing_ok=True`` returns None instead (restart-wrapper and
-    tuner-resume callers treat "nothing yet" as "start fresh", not an
-    error)."""
+    Every skipped checkpoint is ALSO recorded as a
+    ``checkpoint_fallback`` flight event naming the skipped path and
+    its error class — the serving engine's corrupt-newest fallback and
+    the registry publish path both resolve through here, and a
+    truncated snapshot routed around silently would be invisible in the
+    black box. Raises FileNotFoundError when no valid checkpoint
+    exists — ``missing_ok=True`` returns None instead (restart-wrapper
+    and tuner-resume callers treat "nothing yet" as "start fresh", not
+    an error)."""
     import warnings
 
     candidates = (checkpoint_files(directory)
@@ -508,13 +530,27 @@ def latest_valid_checkpoint(directory: str, missing_ok: bool = False
         if missing_ok:
             return None
         raise FileNotFoundError(f"no checkpoints in {directory!r}")
+    skipped: List[Tuple[str, str]] = []
+    chosen: Optional[str] = None
     for path in reversed(candidates):
         ok, reason = validate_checkpoint(path)
         if ok:
-            return path
+            chosen = path
+            break
+        skipped.append((path, reason))
         warnings.warn(
             f"skipping corrupt checkpoint {path!r}: {reason}; "
             "falling back to the previous one", stacklevel=2)
+    if skipped:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        for path, reason in skipped:
+            _flight.record("checkpoint_fallback", skipped=str(path),
+                           error_class=checkpoint_error_class(reason),
+                           reason=reason, served=chosen,
+                           directory=str(directory))
+    if chosen is not None:
+        return chosen
     if missing_ok:
         return None
     raise FileNotFoundError(

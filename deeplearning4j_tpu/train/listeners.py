@@ -479,6 +479,93 @@ class CheckpointListener(TrainingListener):
             self._save(model, model.iteration, model.epoch)
 
 
+class RegistryPublishListener(CheckpointListener):
+    """CheckpointListener that additionally PUBLISHES every checkpoint
+    it writes to a serving :class:`~serving.registry.ModelRegistry` —
+    the training half of the continuous train→serve loop: a long
+    ``fit()`` ships snapshots to live traffic on the checkpoint cadence,
+    each gated by a held-out validation step before any serving process
+    will canary it.
+
+    - ``validator``: callable ``model → float`` scoring the LIVE model
+      on held-out data at publish time (e.g.
+      ``DataSetLossCalculator(val_iter).calculate_score``). The registry
+      refuses non-finite or regressed scores typed — a NaN-poisoned or
+      regressed snapshot is journaled ``rejected`` and never activated,
+      and training CONTINUES (a refused publish must never kill the fit
+      that produced it; the refusal lands in ``self.refused`` and the
+      flight recorder).
+    - Transient store failures (NFS hiccup, disk pressure) retry with
+      bounded exponential backoff (``max_attempts`` × ``backoff_s·2^k``)
+      — validation refusals are typed verdicts, not transients, and are
+      never retried.
+    """
+
+    def __init__(self, directory: str, registry, model_name: str,
+                 validator: Optional[Callable] = None,
+                 max_attempts: int = 3, backoff_s: float = 0.25,
+                 **checkpoint_kwargs):
+        if checkpoint_kwargs.get("serializer", "zip") != "zip":
+            raise ValueError(
+                "RegistryPublishListener publishes zip checkpoints; "
+                "serializer='orbax' directories are not publishable")
+        super().__init__(directory, **checkpoint_kwargs)
+        self.registry = registry
+        self.model_name = str(model_name)
+        self.validator = validator
+        self.max_attempts = max(int(max_attempts), 1)
+        self.backoff_s = float(backoff_s)
+        #: version records the registry accepted, in publish order
+        self.published: List[dict] = []
+        #: (path, reason) pairs the validation gate refused
+        self.refused: List[tuple] = []
+
+    def _save(self, model, iteration, epoch):
+        super()._save(model, iteration, epoch)
+        self.publish(model, self.checkpoints[-1], iteration)
+
+    def publish(self, model, path: str, iteration: int) -> Optional[dict]:
+        from deeplearning4j_tpu.serving.registry import (
+            SnapshotValidationError,
+        )
+
+        score = None
+        if self.validator is not None:
+            try:
+                score = float(self.validator(model))
+            except Exception:  # noqa: BLE001 — a broken validator must
+                # not kill training; an unscored publish is refused by
+                # the gate below, which is the safe outcome
+                log.exception("validation step failed for %s at %s",
+                              self.model_name, path)
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            try:
+                rec = self.registry.publish(
+                    self.model_name, path, score=score,
+                    iteration=int(iteration),
+                    allow_unvalidated=self.validator is None)
+                self.published.append(rec)
+                return rec
+            except SnapshotValidationError as e:
+                # typed refusal — the gate worked; record and move on
+                self.refused.append((path, str(e)))
+                log.warning("publish refused: %s", e)
+                return None
+            except OSError as e:
+                last_err = e
+                time.sleep(self.backoff_s * (2 ** attempt))
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("publish_failed", model=self.model_name,
+                       path=str(path),
+                       error=type(last_err).__name__ if last_err else None,
+                       attempts=self.max_attempts)
+        log.error("publish of %s failed after %d attempts: %s", path,
+                  self.max_attempts, last_err)
+        return None
+
+
 class ProfilerListener(TrainingListener):
     """Captures an XLA/xprof trace for a window of training iterations
     (the TPU-native replacement for ND4J's executioner profiling modes,

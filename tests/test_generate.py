@@ -277,6 +277,49 @@ class TestGenerationEngine:
         finally:
             eng.shutdown()
 
+    def test_decode_watchdog_fails_hung_dispatch_typed(self):
+        # a decode dispatch that HANGS (vs one that raises — the case
+        # above) wedges the worker thread where the except-clause can
+        # never run; the watchdog must fail the active requests typed
+        # and record the escalated stall, instead of every caller
+        # hanging with the worker
+        from deeplearning4j_tpu.obs import flight
+        from deeplearning4j_tpu.serving import DecodeStalledError
+
+        m = _lm()
+        eng = GenerationEngine(m, n_slots=2, queue_limit=8,
+                               default_timeout_s=60.0,
+                               watchdog_mult=2.0, watchdog_min_s=0.3)
+        try:
+            eng.warmup()
+            real = eng.backend.decode
+            hang = {"armed": True}
+
+            def hung(*a, **kw):
+                if hang["armed"]:
+                    hang["armed"] = False
+                    time.sleep(1.5)  # well past the watchdog limit
+                return real(*a, **kw)
+
+            eng.backend.decode = hung
+            prompt = _prompts(1, seed=51)[0]
+            t0 = time.monotonic()
+            with pytest.raises(DecodeStalledError, match="stuck"):
+                eng.submit(prompt, max_new=8, timeout=60).result(timeout=60)
+            # the caller unblocked while the dispatch was still hung
+            assert time.monotonic() - t0 < 1.4
+            evs = flight.default_flight_recorder().events()
+            assert any(e["kind"] == "decode_stall" and e.get("escalated")
+                       for e in evs)
+            # engine recovers once the hung dispatch returns: slab
+            # rebuilt, next request decodes normally
+            out = eng.submit(prompt, max_new=4, timeout=60).result(
+                timeout=60)
+            np.testing.assert_array_equal(
+                out, m.generate_cached(prompt, max_new=4)[0])
+        finally:
+            eng.shutdown()
+
     def test_overload_typed(self):
         # 1-slot engine with a 1-deep queue: the third concurrent
         # request must reject typed, not block

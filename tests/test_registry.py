@@ -1,0 +1,840 @@
+"""Continuous train→serve deployment tests (serving/registry.py +
+RegistryPublishListener + the multi-model HTTP routes).
+
+The acceptance spine (ISSUE 11): a NaN-poisoned and a score-regressed
+snapshot published from a live fit are refused or auto-rolled back;
+serving never returns a result from the bad version after
+``regression_trip``; in-flight old-version requests all complete; and
+``cli flight-dump`` renders the ordered ``publish → canary_start →
+regression_trip → rollback`` timeline. Plus the store's crash-resume
+drill (SIGKILL between journal append and registry.json replace —
+mirror of the tune/store.py torn-line semantics), per-tenant quota
+isolation, LRU eviction/rewarm, the corrupt-snapshot publish fallback,
+and Retry-After on both 503 surfaces.
+"""
+
+import gc
+import http.client
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import flight
+from deeplearning4j_tpu.serving import (
+    CanaryRolledBackError,
+    InferenceServer,
+    ModelRegistry,
+    ModelRouter,
+    RegistryError,
+    ServerOverloadedError,
+    SnapshotValidationError,
+    TenantQuotaExceededError,
+)
+from deeplearning4j_tpu.train.earlystopping import DataSetLossCalculator
+from deeplearning4j_tpu.train.faults import save_checkpoint, truncate_file
+from deeplearning4j_tpu.train.listeners import RegistryPublishListener
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """Same discipline as test_serving.py: this module builds many
+    short-lived engines; drop their executables when done."""
+    yield
+    gc.collect()
+    jax.clear_caches()
+
+
+N_IN, N_OUT = 4, 3
+
+
+def _net(seed: int = 7, hidden: int = 8) -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed)
+        .list()
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                           loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _rows(n: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal(
+        (n, N_IN)).astype(np.float32)
+
+
+def _batches(n_batches: int = 4, bs: int = 16, seed: int = 3):
+    """Learnable synthetic task: labels from a fixed linear rule."""
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((N_IN, N_OUT))
+    out = []
+    for _ in range(n_batches):
+        x = rng.standard_normal((bs, N_IN)).astype(np.float32)
+        y = np.eye(N_OUT, dtype=np.float32)[np.argmax(x @ w, axis=1)]
+        out.append(DataSet(x, y))
+    return out
+
+
+def _publish_first(reg, name, seed=1, score=0.5, hidden=8, tmp=None):
+    path = save_checkpoint(_net(seed, hidden), str(tmp / f"ck_{name}"))
+    return reg.publish(name, path, score=score)
+
+
+def _flight_kinds(since_seq=0, kinds=None):
+    evs = flight.default_flight_recorder().events()
+    out = [(e["seq"], e["kind"], e) for e in evs if e["seq"] >= since_seq]
+    if kinds is not None:
+        out = [t for t in out if t[1] in kinds]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the store
+# ---------------------------------------------------------------------------
+class TestRegistryStore:
+    def test_publish_auto_activates_first_version(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        rec = _publish_first(reg, "m", tmp=tmp_path)
+        assert rec["version"] == 1 and rec["status"] == "active"
+        assert reg.resolve("m")["version"] == 1
+        # the registry owns its copy: deleting the trainer's checkpoint
+        # does not unpublish the version
+        assert rec["path"].startswith(str(tmp_path / "reg"))
+        assert os.path.exists(rec["path"])
+
+    def test_nan_score_refused_typed_and_journaled(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", tmp=tmp_path)
+        seq0 = flight.default_flight_recorder().recorded_total
+        path = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        with pytest.raises(SnapshotValidationError, match="non-finite"):
+            reg.publish("m", path, score=float("nan"))
+        st = reg.get("m")
+        assert st["active_version"] == 1  # untouched
+        assert st["versions"]["2"]["status"] == "rejected"
+        kinds = [k for _, k, _ in _flight_kinds(seq0)]
+        assert "publish_refused" in kinds
+
+    def test_regressed_score_refused_and_tolerance(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"),
+                            regression_tolerance=0.10)
+        _publish_first(reg, "m", score=1.0, tmp=tmp_path)
+        path = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        # within tolerance: accepted
+        rec = reg.publish("m", path, score=1.05)
+        assert rec["status"] == "validated"
+        # beyond tolerance vs BEST validated (1.0): refused
+        with pytest.raises(SnapshotValidationError, match="regressed"):
+            reg.publish("m", path, score=1.2)
+
+    def test_higher_is_better_direction(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"), higher_is_better=True)
+        _publish_first(reg, "m", score=0.8, tmp=tmp_path)
+        path = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        with pytest.raises(SnapshotValidationError, match="regressed"):
+            reg.publish("m", path, score=0.5)
+        assert reg.publish("m", path, score=0.9)["status"] == "validated"
+
+    def test_unscored_publish_refused_unless_opted_in(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        path = save_checkpoint(_net(1), str(tmp_path / "ck"))
+        with pytest.raises(SnapshotValidationError, match="no validation"):
+            reg.publish("m", path)
+        rec = reg.publish("m", path, allow_unvalidated=True)
+        assert rec["status"] == "active"  # first version bootstraps
+
+    def test_rejected_version_cannot_activate(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", tmp=tmp_path)
+        path = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        with pytest.raises(SnapshotValidationError):
+            reg.publish("m", path, score=float("inf"))
+        with pytest.raises(SnapshotValidationError):
+            reg.activate("m", 2)
+
+    def test_corrupt_newest_snapshot_publish_falls_back(self, tmp_path):
+        # the regression the ISSUE names: a snapshot TRUNCATED
+        # mid-publish (crash between the trainer's write and the
+        # publish) resolves to the newest valid sibling, with a
+        # checkpoint_fallback flight event naming the SKIPPED path and
+        # its error class
+        ckdir = tmp_path / "ck"
+        save_checkpoint(_net(1), str(ckdir), stem="older")
+        time.sleep(0.02)  # distinct mtimes: newest must be the truncated
+        newest = save_checkpoint(_net(2), str(ckdir), stem="newer")
+        truncate_file(newest, 0.4)
+        seq0 = flight.default_flight_recorder().recorded_total
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        rec = reg.publish("m", str(ckdir), score=0.5)
+        assert rec["source"].endswith("older.zip")
+        evs = [e for _, k, e in _flight_kinds(seq0, {"checkpoint_fallback"})]
+        assert evs, "no checkpoint_fallback flight event"
+        assert any(e.get("skipped", "").endswith("newer.zip")
+                   and e.get("error_class") in ("unreadable_zip",
+                                                "crc_mismatch",
+                                                "missing_entries")
+                   for e in evs)
+
+    def test_keep_last_prunes_disposable_not_active(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"), keep_last=1,
+                            regression_tolerance=10.0)
+        _publish_first(reg, "m", score=1.0, tmp=tmp_path)
+        paths = [reg.get("m")["versions"]["1"]["path"]]
+        for i in range(2, 5):
+            p = save_checkpoint(_net(i), str(tmp_path / f"ck{i}"))
+            rec = reg.publish("m", p, score=1.0)
+            paths.append(rec["path"])
+        st = reg.get("m")
+        assert st["active_version"] == 1
+        assert os.path.exists(paths[0])  # active never pruned
+        assert os.path.exists(paths[-1])  # newest validated kept
+        # middle disposables pruned beyond keep_last
+        assert not os.path.exists(paths[1])
+
+
+# ---------------------------------------------------------------------------
+# crash resume
+# ---------------------------------------------------------------------------
+class TestRegistryCrashResume:
+    def test_torn_trailing_journal_line_dropped(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", tmp=tmp_path)
+        with open(reg.journal_path, "a") as f:
+            f.write('{"kind": "activate", "na')  # SIGKILL mid-append
+        with pytest.warns(UserWarning, match="torn trailing"):
+            reg2 = ModelRegistry(str(tmp_path / "reg"))
+        assert reg2.resolve("m")["version"] == 1
+
+    def test_torn_middle_line_refuses(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", tmp=tmp_path)
+        lines = open(reg.journal_path).read().splitlines()
+        lines[0] = lines[0][: len(lines[0]) // 2]
+        with open(reg.journal_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        with pytest.raises(RegistryError, match="refusing to replay"):
+            ModelRegistry(str(tmp_path / "reg"))
+
+    def test_sigkill_between_journal_append_and_snapshot(self, tmp_path):
+        # the ISSUE's drill: the journal has the validated/activate
+        # records but registry.json is STALE (the crash landed between
+        # the fsync'd append and the atomic snapshot replace). Restart
+        # must replay the journal and resolve to the last VALIDATED
+        # version, ignoring the stale snapshot.
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", score=1.0, tmp=tmp_path)
+        stale = open(reg.snapshot_path).read()
+        p2 = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        reg.publish("m", p2, score=0.5)
+        reg.activate("m", 2)
+        # simulate the crash: restore the PRE-publish registry.json; the
+        # journal keeps the newer records
+        with open(reg.snapshot_path, "w") as f:
+            f.write(stale)
+        reg2 = ModelRegistry(str(tmp_path / "reg"))
+        assert reg2.resolve("m")["version"] == 2
+        assert reg2.get("m")["versions"]["2"]["validation"]["ok"]
+
+    def test_refresh_sees_foreign_appends_after_own_append(self, tmp_path):
+        # two registry handles over one directory (trainer + server
+        # processes): B's OWN append lands after A's un-folded lines,
+        # and must not absorb them into its folded-bytes tracking — or
+        # refresh() would skip A's publish forever and the new version
+        # would never be adopted
+        reg_a = ModelRegistry(str(tmp_path / "reg"))
+        reg_b = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg_a, "m", score=1.0, tmp=tmp_path)
+        reg_b.define_model("other")  # B appends without refreshing first
+        assert reg_b.refresh() is True
+        assert reg_b.resolve("m")["version"] == 1
+        # and A picks up B's model on ITS next refresh
+        assert reg_a.refresh() is True
+        assert "other" in reg_a.models()
+
+    def test_refused_publish_keeps_no_snapshot_bytes(self, tmp_path):
+        # a rejected snapshot can never activate; its copied zip must
+        # not accumulate (one refused multi-GB snapshot per checkpoint
+        # cadence would fill the disk)
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", score=1.0, tmp=tmp_path)
+        p = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        with pytest.raises(SnapshotValidationError):
+            reg.publish("m", p, score=9.9)
+        snaps = os.listdir(os.path.join(str(tmp_path / "reg"),
+                                        "snapshots", "m"))
+        assert snaps == ["v0001.zip"]
+
+    def test_canary_mid_window_restarts_cleanly(self, tmp_path):
+        # a canary that was mid-window when the process died: the
+        # journal holds canary_start with no promote/rollback after it —
+        # a fresh router resumes the canary (fresh counters, window
+        # restarts) instead of forgetting or half-promoting it
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "m", score=1.0, tmp=tmp_path)
+        p2 = save_checkpoint(_net(2), str(tmp_path / "ck2"))
+        reg.publish("m", p2, score=0.9)
+        reg.start_canary("m", 2, fraction=0.5, window_s=30.0)
+        assert reg.canary_state("m")["version"] == 2
+        # "restart": fresh registry + fresh router over the same dir
+        reg2 = ModelRegistry(str(tmp_path / "reg"))
+        assert reg2.canary_state("m")["version"] == 2
+        router = ModelRouter(reg2, batch_limit=8, max_wait_ms=1.0,
+                             canary_fraction=1.0, canary_window_s=30.0)
+        try:
+            mm = router.managed("m")
+            assert mm.canary is not None and mm.canary.version == 2
+            assert mm.canary.stats.requests == 0  # fresh window
+            out, v = router.predict("m", _rows(2), timeout=30)
+            assert v == 2  # fraction 1.0 → routed to the resumed canary
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+class TestRouter:
+    def _registry_two_models(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "alpha", seed=1, hidden=8, tmp=tmp_path)
+        _publish_first(reg, "beta", seed=2, hidden=16, tmp=tmp_path)
+        return reg
+
+    def test_routes_two_models_bit_exact(self, tmp_path):
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0)
+        try:
+            x = _rows(3)
+            out_a, va = router.predict("alpha", x, timeout=30)
+            out_b, vb = router.predict("beta", x, timeout=30)
+            assert va == 1 and vb == 1
+            # bit-exact vs each model's own engine forward
+            ref_a = router.managed("alpha").active.engine.infer(x)
+            ref_b = router.managed("beta").active.engine.infer(x)
+            np.testing.assert_array_equal(out_a, ref_a)
+            np.testing.assert_array_equal(out_b, ref_b)
+            assert out_a.shape == out_b.shape  # same head, different nets
+            assert not np.array_equal(out_a, out_b)
+        finally:
+            router.shutdown()
+
+    def test_unknown_model_typed(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        router = ModelRouter(reg)
+        try:
+            from deeplearning4j_tpu.serving import UnknownModelError
+
+            with pytest.raises(UnknownModelError):
+                router.predict("ghost", _rows(1), timeout=5)
+        finally:
+            router.shutdown()
+
+    def test_canary_promotes_after_clean_window(self, tmp_path):
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             canary_fraction=0.5, canary_window_s=0.3,
+                             canary_min_requests=2, refresh_s=0.01)
+        try:
+            x = _rows(2)
+            router.predict("alpha", x, timeout=30)
+            p2 = save_checkpoint(_net(11), str(tmp_path / "ck_a2"))
+            rec = reg.publish("alpha", p2, score=0.4)
+            v2 = rec["version"]
+            seen = set()
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                _, v = router.predict("alpha", x, timeout=30)
+                seen.add(v)
+                if reg.get("alpha")["active_version"] == v2:
+                    break
+                time.sleep(0.01)
+            assert reg.get("alpha")["active_version"] == v2
+            assert seen == {1, v2}  # both versions served during canary
+            # post-promote traffic serves the new version only
+            _, v = router.predict("alpha", x, timeout=30)
+            assert v == v2
+        finally:
+            router.shutdown()
+
+    def test_dispatch_failure_trips_rollback(self, tmp_path):
+        # any canary dispatch failure must trip regression_trip →
+        # rollback; the active version's in-flight requests complete and
+        # no bad-version result reaches a caller after the trip
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             canary_fraction=0.5, canary_window_s=30.0,
+                             refresh_s=0.01)
+        try:
+            x = _rows(2)
+            router.predict("alpha", x, timeout=30)
+            p2 = save_checkpoint(_net(12), str(tmp_path / "ck_a2"))
+            rec = reg.publish("alpha", p2, score=0.4)
+            v2 = rec["version"]
+            mm = router.managed("alpha")
+            # adopt the canary on the next submit, then poison it
+            seq0 = flight.default_flight_recorder().recorded_total
+            deadline = time.monotonic() + 20
+            while mm.canary is None and time.monotonic() < deadline:
+                router.predict("alpha", x, timeout=30)
+            assert mm.canary is not None
+
+            def exploding(x, mask=None):
+                raise RuntimeError("injected canary dispatch failure")
+
+            mm.canary.engine.infer_versioned = exploding
+            results = []
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                try:
+                    _, v = router.predict("alpha", x, timeout=30)
+                    results.append(v)
+                except (RuntimeError, CanaryRolledBackError):
+                    pass
+                if reg.get("alpha")["versions"][str(v2)]["status"] \
+                        == "rolled_back":
+                    break
+            st = reg.get("alpha")
+            assert st["versions"][str(v2)]["status"] == "rolled_back"
+            assert st["active_version"] == 1
+            # ordered trip → rollback in the flight ring
+            kinds = [k for _, k, _ in _flight_kinds(
+                seq0, {"regression_trip", "rollback"})]
+            assert kinds[:2] == ["regression_trip", "rollback"]
+            # nothing served by the bad version, before or after
+            assert v2 not in set(results)
+            # active version still serves after the rollback
+            _, v = router.predict("alpha", x, timeout=30)
+            assert v == 1
+        finally:
+            router.shutdown()
+
+    def test_tenant_quota_typed_others_unaffected(self, tmp_path):
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             tenant_quota=3)
+        try:
+            x = _rows(1)
+            mm = router.managed("alpha")
+            orig = mm.active.engine.infer_versioned
+
+            def slow(x, mask=None):
+                time.sleep(0.15)
+                return orig(x, mask)
+
+            mm.active.engine.infer_versioned = slow
+            held, rejects = [], 0
+            last = None
+            for _ in range(10):
+                try:
+                    held.append(router.submit("alpha", x, timeout=30,
+                                              tenant="noisy"))
+                except TenantQuotaExceededError as e:
+                    rejects += 1
+                    last = e
+            assert rejects > 0
+            assert last.tenant == "noisy"
+            assert isinstance(last, ServerOverloadedError)  # 503 family
+            assert last.retry_after_s >= 1.0
+            # the quiet tenant is admitted while noisy is rejected
+            out, _ = router.predict("alpha", x, timeout=30, tenant="quiet")
+            assert out.shape == (1, N_OUT)
+            for r in held:
+                r.result(timeout=30)
+            mm.active.engine.infer_versioned = orig
+        finally:
+            router.shutdown()
+
+    def test_lru_evict_and_rewarm_flight_events(self, tmp_path):
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             max_live_models=1)
+        try:
+            seq0 = flight.default_flight_recorder().recorded_total
+            router.predict("alpha", _rows(1), timeout=30)
+            router.predict("beta", _rows(1), timeout=30)  # evicts alpha
+            router.predict("alpha", _rows(1), timeout=30)  # rewarm alpha
+            evs = _flight_kinds(seq0, {"model_evict", "model_rewarm"})
+            kinds = [(k, e["model"]) for _, k, e in evs]
+            assert ("model_rewarm", "alpha") == kinds[0]
+            assert ("model_evict", "alpha") in kinds
+            assert ("model_rewarm", "beta") in kinds
+            # alpha rewarmed again after eviction
+            assert kinds.count(("model_rewarm", "alpha")) == 2
+        finally:
+            router.shutdown()
+
+    def test_multiplexed_storm_zero_steady_state_retraces(self, tmp_path):
+        # the ISSUE's multiplexed drill: 2 models + 1 canary version
+        # under a mixed storm, per-tenant quotas armed, and ZERO
+        # steady-state retraces across every live engine
+        reg = self._registry_two_models(tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             queue_limit=4096, tenant_quota=64,
+                             canary_fraction=0.25, canary_window_s=60.0,
+                             refresh_s=0.01)
+        try:
+            # warm both models and the canary BEFORE counting
+            router.predict("alpha", _rows(1), timeout=30)
+            router.predict("beta", _rows(1), timeout=30)
+            p2 = save_checkpoint(_net(13), str(tmp_path / "ck_a2"))
+            reg.publish("alpha", p2, score=0.4)
+            deadline = time.monotonic() + 20
+            while (router.managed("alpha").canary is None
+                   and time.monotonic() < deadline):
+                router.predict("alpha", _rows(1), timeout=30)
+            assert router.managed("alpha").canary is not None
+
+            def retraces():
+                fam = router.metrics.registry.family_values(
+                    "jit_retraces_total")
+                return sum(fam.values())
+
+            before = retraces()
+            names = ["alpha", "beta"]
+            errs = []
+
+            def client(tid):
+                rng = np.random.default_rng(tid)
+                for i in range(12):
+                    n = int(rng.integers(1, 9))
+                    try:
+                        router.predict(names[(tid + i) % 2], _rows(n, seed=i),
+                                       timeout=30, tenant=f"t{tid}")
+                    except (TenantQuotaExceededError,
+                            CanaryRolledBackError):
+                        pass  # quota sheds are part of the drill
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+            threads = [threading.Thread(target=client, args=(t,))
+                       for t in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errs, errs
+            assert retraces() - before == 0
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+def _http(port, method, path, body=None, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 None if body is None else json.dumps(body),
+                 headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, (json.loads(data) if data else {}), hdrs
+
+
+class TestRegistryHTTP:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish_first(reg, "alpha", seed=1, tmp=tmp_path)
+        _publish_first(reg, "beta", seed=2, hidden=16, tmp=tmp_path)
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             tenant_quota=3)
+        server = InferenceServer(router=router, port=0).start()
+        try:
+            yield server, router, reg
+        finally:
+            server.shutdown()
+
+    def test_models_predict_and_healthz(self, served):
+        server, router, reg = served
+        x = _rows(2).tolist()
+        st, body, _ = _http(server.port, "POST", "/models/alpha/predict",
+                            {"inputs": x})
+        assert st == 200 and body["model_version"] == 1
+        assert body["model"] == "alpha"
+        # the payload-key spelling routes too
+        st, body2, _ = _http(server.port, "POST", "/predict",
+                             {"inputs": x, "model": "beta"})
+        assert st == 200 and body2["model"] == "beta"
+        assert body["outputs"] != body2["outputs"]
+        st, hz, _ = _http(server.port, "GET", "/models/alpha/healthz")
+        assert st == 200 and hz["active_version"] == 1 and hz["ready"]
+        st, hz, _ = _http(server.port, "GET", "/healthz")
+        assert st == 200 and "alpha" in hz["models"]
+
+    def test_unknown_model_404(self, served):
+        server, _, _ = served
+        st, body, _ = _http(server.port, "POST", "/models/ghost/predict",
+                            {"inputs": _rows(1).tolist()})
+        assert st == 404 and body["error"] == "UnknownModelError"
+
+    def test_tenant_quota_503_with_retry_after(self, served):
+        server, router, _ = served
+        mm = router.managed("alpha")
+        orig = mm.active.engine.infer_versioned
+
+        def slow(x, mask=None):
+            time.sleep(0.15)
+            return orig(x, mask)
+
+        mm.active.engine.infer_versioned = slow
+        x = _rows(1).tolist()
+        got_503 = None
+        threads = []
+
+        def fire():
+            st, body, hdrs = _http(server.port, "POST",
+                                   "/models/alpha/predict",
+                                   {"inputs": x},
+                                   headers={"X-Tenant": "noisy"})
+            nonlocal got_503
+            if st == 503:
+                got_503 = (body, hdrs)
+
+        for _ in range(10):
+            t = threading.Thread(target=fire)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        mm.active.engine.infer_versioned = orig
+        assert got_503 is not None, "quota never tripped"
+        body, hdrs = got_503
+        assert body["error"] == "TenantQuotaExceededError"
+        assert body["tenant"] == "noisy"
+        assert int(hdrs["Retry-After"]) >= 1
+
+    def test_single_model_503_has_retry_after(self, tmp_path):
+        # the existing single-model surface gains the header too
+        from deeplearning4j_tpu.serving import InferenceEngine
+
+        eng = InferenceEngine(_net(5))
+        server = InferenceServer(engine=eng, port=0, batch_limit=2,
+                                 max_wait_ms=50.0, queue_limit=1).start()
+        try:
+            orig = eng.infer_versioned
+
+            def slow(x, mask=None):
+                time.sleep(0.3)
+                return orig(x, mask)
+
+            eng.infer_versioned = slow
+            x = _rows(1).tolist()
+            results = []
+            threads = []
+
+            def fire():
+                results.append(_http(server.port, "POST", "/predict",
+                                     {"inputs": x}))
+
+            for _ in range(12):
+                t = threading.Thread(target=fire)
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join()
+            rejected = [(st, b, h) for st, b, h in results if st == 503]
+            assert rejected, "queue_limit=1 never overflowed"
+            st, body, hdrs = rejected[0]
+            assert body["error"] == "ServerOverloadedError"
+            assert int(hdrs["Retry-After"]) >= 1
+        finally:
+            server.shutdown()
+
+    def test_reload_409_in_registry_mode(self, served):
+        server, _, _ = served
+        st, body, _ = _http(server.port, "POST", "/reload", {})
+        assert st == 409 and "registry" in body["message"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill
+# ---------------------------------------------------------------------------
+class TestCanaryDrill:
+    def test_live_fit_nan_and_regressed_snapshots_drill(self, tmp_path,
+                                                        capsys):
+        """ISSUE 11 acceptance: from a live fit, publish a NaN-poisoned
+        and a score-regressed snapshot. The NaN one is REFUSED by the
+        validation gate; the regressed one (slipping validation — the
+        gap canaries exist for) is canaried and AUTO-ROLLED BACK by the
+        serving-side score gate. Serving never returns a result from
+        the bad version after regression_trip, in-flight old-version
+        requests all complete, and cli flight-dump renders the ordered
+        publish → canary_start → regression_trip → rollback timeline."""
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        batches = _batches(5)
+        train, val = batches[:-1], batches[-1:]
+        model = _net(21)
+        listener = RegistryPublishListener(
+            str(tmp_path / "ck"), reg, "drill",
+            validator=DataSetLossCalculator(
+                ExistingDataSetIterator(val)).calculate_score,
+            save_every_n_epochs=1, keep_mode="last", keep_last=3)
+        model.add_listeners(listener)
+        # the live fit: 2 epochs → 2 checkpoint-cadence publishes
+        model.fit(ExistingDataSetIterator(train), epochs=2)
+        assert len(listener.published) == 2
+        good_versions = {r["version"] for r in listener.published}
+        assert reg.get("drill")["active_version"] == 1
+        # operator-promote the latest validated version before serving:
+        # otherwise the router would (correctly) canary v2 first and the
+        # bad publish below would queue behind that 30s window
+        reg.activate("drill", 2)
+
+        # NaN-poisoned snapshot from the live model: the validation
+        # step scores NaN → refused typed, journaled rejected
+        poisoned = _net(21)
+        poisoned.params_ = jax.tree_util.tree_map(
+            lambda a: np.full_like(np.asarray(a), np.nan), model.params_)
+        nan_path = save_checkpoint(poisoned, str(tmp_path / "ck_nan"))
+        rec = listener.publish(poisoned, nan_path, iteration=99)
+        assert rec is None
+        assert len(listener.refused) == 1
+        nan_version = max(int(v)
+                          for v in reg.get("drill")["versions"])
+        assert reg.get("drill")["versions"][str(nan_version)]["status"] \
+            == "rejected"
+
+        # serve the model; the regressed snapshot passes the publish
+        # gate (score marginally better — the validation-gap case) and
+        # the canary score probe is what catches it
+        bad_version = []
+
+        def probe(engine):
+            src = str(engine.describe()["source"])
+            if bad_version and f"v{bad_version[0]:04d}" in src:
+                return 9.0
+            return 0.4
+
+        router = ModelRouter(reg, batch_limit=8, max_wait_ms=1.0,
+                             canary_fraction=0.5, canary_window_s=30.0,
+                             score_probe=probe, score_trip_tolerance=0.5,
+                             refresh_s=0.01)
+        try:
+            x = _rows(2)
+            _, v0 = router.predict("drill", x, timeout=30)
+            assert v0 in good_versions
+            # in-flight old-version requests at trip time must complete:
+            # slow the ACTIVE engine and keep requests in its pipe
+            mm = router.managed("drill")
+            orig = mm.active.engine.infer_versioned
+
+            def slow(x, mask=None):
+                time.sleep(0.05)
+                return orig(x, mask)
+
+            mm.active.engine.infer_versioned = slow
+            inflight = [router.submit("drill", x, timeout=60)
+                        for _ in range(4)]
+            scrambled = _net(77)  # same arch, junk weights
+            bad_path = save_checkpoint(scrambled, str(tmp_path / "ck_bad"))
+            seq0 = flight.default_flight_recorder().recorded_total
+            best = reg.best_score("drill")
+            rec = reg.publish("drill", bad_path, score=best * 0.99)
+            bad_version.append(rec["version"])
+            served = []
+            deadline = time.monotonic() + 30
+            rolled = False
+            while time.monotonic() < deadline:
+                try:
+                    _, v = router.predict("drill", x, timeout=30)
+                    served.append(v)
+                except CanaryRolledBackError:
+                    pass
+                if (reg.get("drill")["versions"][str(rec["version"])]
+                        ["status"] == "rolled_back"):
+                    rolled = True
+                    break
+            assert rolled, "regressed canary never rolled back"
+            # serving never returned a bad-version result
+            assert rec["version"] not in set(served)
+            # the in-flight old-version requests all completed
+            for r in inflight:
+                out = r.result(timeout=60)
+                assert out.shape == (2, N_OUT)
+                assert r.model_version in good_versions
+            mm2 = router.managed("drill")
+            if mm2.active is mm.active:
+                mm.active.engine.infer_versioned = orig
+            # the ordered deployment timeline, publish first
+            tl = _flight_kinds(seq0, {"publish", "canary_start",
+                                      "regression_trip", "rollback"})
+            kinds = [k for _, k, _ in tl]
+            assert kinds == ["publish", "canary_start",
+                             "regression_trip", "rollback"], kinds
+            seqs = [s for s, _, _ in tl]
+            assert seqs == sorted(seqs)
+        finally:
+            router.shutdown()
+
+        # cli flight-dump renders the timeline from the dumped black box
+        from deeplearning4j_tpu.cli import flight_dump_main
+
+        dump_path = flight.default_flight_recorder().dump(
+            path=str(tmp_path / "flight.json"), reason="drill")
+        assert dump_path is not None
+        assert flight_dump_main([dump_path]) == 0
+        out = capsys.readouterr().out
+        order = [out.index(k) for k in ("publish", "canary_start",
+                                        "regression_trip", "rollback")]
+        assert order == sorted(order)
+
+
+# ---------------------------------------------------------------------------
+# retry-after units
+# ---------------------------------------------------------------------------
+class TestRetryAfter:
+    def test_batcher_estimate_clamped(self):
+        from deeplearning4j_tpu.serving import DynamicBatcher
+
+        gate = threading.Event()
+
+        def dispatch(reqs):
+            gate.wait(30)
+            for r in reqs:
+                r.finish(r.x)
+
+        b = DynamicBatcher(dispatch, batch_limit=1, max_wait_ms=1.0,
+                           queue_limit=8)
+        try:
+            assert b.retry_after_s() == 1.0  # no history → 1s floor
+            reqs = [b.submit(np.zeros((1, 2), np.float32))
+                    for _ in range(3)]
+            deadline = time.monotonic() + 10
+            while b.queue_depth() != 2 and time.monotonic() < deadline:
+                time.sleep(0.005)  # one in the gated dispatch, 2 queued
+            b._dispatch_ewma_s = 10.0
+            assert b.retry_after_s() == 20.0  # 2 queued × 10s
+            b._dispatch_ewma_s = 100.0
+            assert b.retry_after_s() == 60.0  # 60s cap
+            gate.set()
+            for r in reqs:
+                r.result(timeout=30)
+        finally:
+            gate.set()
+            b.shutdown()
+
+    def test_generation_overload_carries_retry_after(self):
+        # covered end-to-end in test_generate.py overload tests; here:
+        # the typed error's hint surface exists and clamps
+        from deeplearning4j_tpu.serving.generate import GenerationEngine
+
+        assert hasattr(GenerationEngine, "retry_after_s")
+
+
+def teardown_module(module):
+    gc.collect()

@@ -127,7 +127,14 @@ class TestZooSmallInstantiation:
             return out
 
         a, b = scores(None), scores("save_conv_outputs")
-        np.testing.assert_allclose(a, b, rtol=2e-4)
+        # rematerialization recomputes the conv activations in the
+        # backward pass, so XLA is free to re-associate those
+        # reductions; across 3 compounding steps of an untrained
+        # ResNet50 (scores grow to ~4e3) the drift is backend-build
+        # dependent — observed up to ~3e-4 relative on some XLA:CPU
+        # builds. 1e-3 still asserts the policy changes memory, not
+        # math (a real math change diverges by orders of magnitude).
+        np.testing.assert_allclose(a, b, rtol=1e-3)
 
     @pytest.mark.slow
     def test_googlenet_small(self):
