@@ -152,6 +152,11 @@ def serve_main(argv) -> int:
                          "it is a directory)")
     ap.add_argument("--num-classes", type=int, default=10,
                     help="zoo-name models only: output classes")
+    ap.add_argument("--int8-serving", action="store_true",
+                    help="serve int8 weight-quantized dense/output heads "
+                         "(per-channel scales; opt-in — fp32 model weights "
+                         "are untouched; refused when the zoo model's "
+                         "serving_int8 hint is False)")
     ap.add_argument("--no-warmup", action="store_true",
                     help="skip bucket pre-compilation (first request per "
                          "shape then pays the compile)")
@@ -213,6 +218,12 @@ def serve_main(argv) -> int:
 
     eng_kwargs = dict(buckets=buckets, mesh=mesh,
                       metrics=ServingMetrics(registry=default_registry()))
+    if args.int8_serving:
+        if key in ZOO and not getattr(ZOO[key], "serving_int8", True):
+            ap.error(f"--int8-serving: zoo model {key!r} declares "
+                     "serving_int8=False (its heads do not tolerate "
+                     "weight quantization)")
+        eng_kwargs["int8_serving"] = True
     if args.checkpoint_dir:
         eng_kwargs["checkpoint_dir"] = args.checkpoint_dir
     if key in ZOO:

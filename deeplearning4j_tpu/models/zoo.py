@@ -64,6 +64,14 @@ class ZooModel:
     #: :meth:`serving_bucket_policy` / the ``cli serve`` wiring.
     serving_seq_buckets: Optional[tuple] = None
 
+    #: serving hint: whether this architecture tolerates int8 weight-only
+    #: quantization of its dense/output heads (per-channel scales,
+    #: nn/ops/int8_matmul.py). Actual use is OPT-IN — ``cli serve
+    #: --int8-serving`` / ``InferenceEngine(int8_serving=True)`` — and a
+    #: model class that sets this False refuses the flag (e.g. heads
+    #: whose logit gaps sit inside the quantization error).
+    serving_int8: bool = True
+
     def serving_input_shape(self) -> Optional[tuple]:
         """Per-example input shape for serving warmup, from the built
         conf's input type (None when the conf declares none)."""

@@ -115,8 +115,11 @@ def _probe_compiles(fn, seq_len: int, head_dim: int, dtype,
         loss(dense_ref), argnums=(0, 1, 2))).lower(*x3).compile()
 
     rng = np.random.default_rng(0)
-    qkv = [jnp.asarray(rng.standard_normal(shape).astype(np.float32)
-                       ).astype(dtype) for _ in range(3)]
+    # numpy (never jnp): under an ambient trace jnp ops stage into the
+    # caller's graph and the AOT executables would be handed tracers
+    qkv = [np.asarray(rng.standard_normal(shape),
+                      np.float32).astype(jnp.dtype(dtype))
+           for _ in range(3)]
     tol = 2e-2 if jnp.dtype(dtype) == jnp.bfloat16 else 2e-4
 
     def check(name, got, want, scale=1.0):
@@ -160,9 +163,9 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool,
     probe_seg = None
     if has_seg:
         cut = (seq_len // 2) - (seq_len // 8)
-        probe_seg = jnp.asarray(
-            np.concatenate([np.zeros(cut, np.int32),
-                            np.ones(seq_len - cut, np.int32)])[None, :])
+        probe_seg = np.concatenate(  # numpy: see _probe_compiles note
+            [np.zeros(cut, np.int32),
+             np.ones(seq_len - cut, np.int32)])[None, :]
 
     def candidates():
         from deeplearning4j_tpu.nn.ops.flash_attention import (
@@ -181,19 +184,12 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool,
 
         yield "jax-bundled", jax_flash
 
-    from deeplearning4j_tpu.nn.ops.kernel_compat import probe_with_retry
+    from deeplearning4j_tpu.nn.ops.registry import default_kernel_registry
 
+    reg = default_kernel_registry()
     impl = None
     sc = head_dim ** -0.5
     for cand_name, kernel in candidates():
-        def on_fail(e, will_retry, cand_name=cand_name):
-            logging.getLogger(__name__).info(
-                "%s Pallas flash unavailable for %s (%s: %s)%s",
-                cand_name, key, type(e).__name__,
-                str(e).split("\n", 1)[0],
-                " — transient remote-compile crash, retrying once"
-                if will_retry else "")
-
         if has_seg:
             probe_fn = (lambda kernel=kernel: _probe_compiles(
                 lambda q, k, v: kernel(q, k, v, causal=causal, sm_scale=sc,
@@ -204,7 +200,7 @@ def _flash_attention_impl(dtype, seq_len: int, head_dim: int, causal: bool,
                 lambda q, k, v: kernel(q, k, v, causal=causal,
                                        sm_scale=sc),
                 seq_len, head_dim, dtype, causal))
-        if probe_with_retry(probe_fn, on_fail):
+        if reg.probe("flash_attention", key + (cand_name,), probe_fn):
             impl = functools.partial(_call_flash, kernel, causal)
             break
     if impl is None:
@@ -230,9 +226,9 @@ def _flash_attention_route(q, k, causal, mask, dropout_rate,
     the einsum path), and a kernel that compile-probes OK at this
     instantiation (see ``_flash_attention_impl``). Returns the chosen
     impl or None. Kill switch: DL4J_TPU_FLASH_ATTENTION=0."""
-    import os
+    from deeplearning4j_tpu.nn.ops.registry import default_kernel_registry
 
-    if os.environ.get("DL4J_TPU_FLASH_ATTENTION", "1") == "0":
+    if default_kernel_registry().mode("flash_attention") == "off":
         return None
     if mask is not None or dropout_rate > 0.0:
         return None

@@ -41,11 +41,15 @@ class DenseLayer(FeedForwardLayer):
         }
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
-        y = self.act_fn()(x @ params["W"] + params["b"])
+        from deeplearning4j_tpu.nn.ops.int8_matmul import serving_matmul
+
+        y = self.act_fn()(serving_matmul(params, x) + params["b"])
         return y, state or {}
 
     def pre_output(self, params, x):
-        return x @ params["W"] + params["b"]
+        from deeplearning4j_tpu.nn.ops.int8_matmul import serving_matmul
+
+        return serving_matmul(params, x) + params["b"]
 
 
 @serde.register
@@ -96,7 +100,9 @@ class BaseOutputLayer(FeedForwardLayer):
         }
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
-        y = self.act_fn()(x @ params["W"] + params["b"])
+        from deeplearning4j_tpu.nn.ops.int8_matmul import serving_matmul
+
+        y = self.act_fn()(serving_matmul(params, x) + params["b"])
         return y, state or {}
 
     def compute_score(self, params, x, labels, mask=None):

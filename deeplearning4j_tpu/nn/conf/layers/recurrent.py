@@ -69,7 +69,23 @@ def _masked_scan(step_fn, carry0, x, mask):
 
     x: (b, T, d) → scanned time-major; mask: (b, T) or None.
     step_fn(carry, x_t) -> (new_carry, y_t)
+
+    T == 1 (the generation engines' incremental-decode shape) skips the
+    ``lax.scan`` machinery entirely — one direct step call, bit-identical
+    to a length-1 scan (the scan applies the same body once), without the
+    while-loop/stacking structure in the compiled program.
     """
+    if x.shape[1] == 1:
+        x_t = x[:, 0]
+        new_carry, y_t = step_fn(carry0, x_t)
+        if mask is not None:
+            m_t = mask[:, 0][..., None]  # (b, 1)
+            new_carry = jax.tree_util.tree_map(
+                lambda new, old: m_t * new + (1.0 - m_t) * old,
+                new_carry, carry0)
+            y_t = y_t * m_t
+        return y_t[:, None, :], new_carry
+
     xt = jnp.swapaxes(x, 0, 1)  # (T, b, d)
 
     if mask is None:
@@ -131,6 +147,11 @@ class LSTM(BaseRecurrentLayer):
 
     def _step(self, params, carry, x_t):
         h, c = carry
+        cell = self._fused_cell(x_t)
+        if cell is not None:
+            h_new, c_new = cell(x_t, h, c, params["Wx"], params["Wh"],
+                                params["b"])
+            return (h_new, c_new), h_new
         act = _act.get(self.activation)
         gate = _act.get(self.gate_activation)
         z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
@@ -142,6 +163,15 @@ class LSTM(BaseRecurrentLayer):
         c_new = f * c + i * g
         h_new = o * act(c_new)
         return (h_new, c_new), h_new
+
+    def _fused_cell(self, x_t):
+        """The fused Pallas cell for this layer's instantiation, or None
+        → the reference step above (non-TPU backend, probe reject, kill
+        switch, exotic activations). Registry-cached — steady state is a
+        dict hit at trace time, nothing at run time."""
+        from deeplearning4j_tpu.nn.ops import fused_lstm
+
+        return fused_lstm.cell_for(self, x_t.dtype, batch=x_t.shape[0])
 
     def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
         return _masked_scan(lambda c, xt: self._step(params, c, xt), carry, x, mask)
@@ -161,6 +191,12 @@ class GravesLSTM(LSTM):
 
     def _step(self, params, carry, x_t):
         h, c = carry
+        cell = self._fused_cell(x_t)
+        if cell is not None:
+            h_new, c_new = cell(x_t, h, c, params["Wx"], params["Wh"],
+                                params["b"], params["pI"], params["pF"],
+                                params["pO"])
+            return (h_new, c_new), h_new
         act = _act.get(self.activation)
         gate = _act.get(self.gate_activation)
         z = x_t @ params["Wx"] + h @ params["Wh"] + params["b"]
@@ -201,14 +237,13 @@ class SimpleRnn(BaseRecurrentLayer):
     def init_carry(self, batch, dtype=jnp.float32):
         return jnp.zeros((batch, self.n_out), dtype)
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
+    def _step(self, params, carry, x_t):
         act = _act.get(self.activation)
+        h_new = act(x_t @ params["Wx"] + carry @ params["Wh"] + params["b"])
+        return h_new, h_new
 
-        def step(h, x_t):
-            h_new = act(x_t @ params["Wx"] + h @ params["Wh"] + params["b"])
-            return h_new, h_new
-
-        return _masked_scan(step, carry, x, mask)
+    def apply_with_carry(self, params, x, carry, *, mask=None, train=False, rng=None):
+        return _masked_scan(lambda c, xt: self._step(params, c, xt), carry, x, mask)
 
 
 @serde.register
@@ -362,7 +397,9 @@ class RnnOutputLayer(FeedForwardLayer):
         }
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
-        y = self.act_fn()(x @ params["W"] + params["b"])
+        from deeplearning4j_tpu.nn.ops.int8_matmul import serving_matmul
+
+        y = self.act_fn()(serving_matmul(params, x) + params["b"])
         if mask is not None:
             y = y * mask[..., None]
         return y, state or {}

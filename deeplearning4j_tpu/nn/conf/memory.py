@@ -29,7 +29,8 @@ class LayerMemoryReport:
 
     def __init__(self, layer_name: str, layer_type: str, input_type: InputType,
                  output_type: InputType, n_params: int, updater_slots: int,
-                 activation_elems_per_example: int):
+                 activation_elems_per_example: int,
+                 int8_weight_params: int = 0):
         self.layer_name = layer_name
         self.layer_type = layer_type
         self.input_type = input_type
@@ -37,6 +38,12 @@ class LayerMemoryReport:
         self.n_params = int(n_params)
         self.updater_slots = int(updater_slots)
         self.activation_elems_per_example = int(activation_elems_per_example)
+        #: weight elements eligible for int8 serving quantization
+        #: (nn/ops/int8_matmul.py: the dense/output heads' W) — each
+        #: costs 1 byte instead of bytes_per_elem under
+        #: ``total_memory_bytes(int8_weights=True)``, plus one fp32
+        #: scale per output channel
+        self.int8_weight_params = int(int8_weight_params)
 
     def updater_state_bytes(self, bytes_per_elem: int = 4,
                             data_parallel_shards: int = 1) -> int:
@@ -49,8 +56,14 @@ class LayerMemoryReport:
 
     def total_memory_bytes(self, batch_size: int, bytes_per_elem: int = 4,
                            training: bool = True,
-                           data_parallel_shards: int = 1) -> int:
+                           data_parallel_shards: int = 1,
+                           int8_weights: bool = False) -> int:
         fixed = self.n_params * bytes_per_elem
+        if not training and int8_weights and self.int8_weight_params:
+            # int8 serving: quantizable weights at 1 byte + one fp32
+            # scale per output channel; training never sees int8
+            fixed -= self.int8_weight_params * (bytes_per_elem - 1)
+            fixed += self.output_type.size * 4 if self.output_type else 0
         if training:
             fixed += self.n_params * bytes_per_elem  # gradients
             fixed += self.updater_state_bytes(bytes_per_elem,
@@ -77,15 +90,20 @@ class NetworkMemoryReport:
 
     def total_memory_bytes(self, batch_size: int, training: bool = True,
                            dtype: Optional[str] = None,
-                           data_parallel_shards: int = 1) -> int:
+                           data_parallel_shards: int = 1,
+                           int8_weights: bool = False) -> int:
         """Per-replica bytes. ``data_parallel_shards`` > 1 models the
         ZeRO-1 sharded update (``sharded_update``): updater state counts
         as 1/N per replica; params, gradients and activations are
-        unchanged (they stay replicated / batch-sharded)."""
+        unchanged (they stay replicated / batch-sharded).
+        ``int8_weights`` (inference only) models int8 weight-only
+        serving quantization: eligible head weights at 1 byte +
+        per-channel fp32 scales."""
         b = _DTYPE_BYTES[dtype or self.dtype]
         return sum(
             r.total_memory_bytes(batch_size, b, training,
-                                 data_parallel_shards)
+                                 data_parallel_shards,
+                                 int8_weights=int8_weights)
             for r in self.layer_reports
         )
 
@@ -141,10 +159,15 @@ def _updater_slot_count(layer) -> int:
 def memory_report_mln(conf, name: str = "MultiLayerNetwork") -> NetworkMemoryReport:
     """Build the report from a MultiLayerConfiguration (reference
     ``MultiLayerConfiguration.getMemoryReport``)."""
+    from deeplearning4j_tpu.nn.ops.int8_matmul import quantizable_layer
+
     types = conf.layer_types()
     reports = []
     for i, layer in enumerate(conf.layers):
         it, ot = types[i], types[i + 1]
+        int8q = 0
+        if quantizable_layer(layer) and layer.n_in and layer.n_out:
+            int8q = int(layer.n_in) * int(layer.n_out)  # the W matrix
         reports.append(
             LayerMemoryReport(
                 layer_name=layer.name or f"layer{i}",
@@ -154,6 +177,7 @@ def memory_report_mln(conf, name: str = "MultiLayerNetwork") -> NetworkMemoryRep
                 n_params=layer.n_params(it),
                 updater_slots=_updater_slot_count(layer),
                 activation_elems_per_example=ot.arity(),
+                int8_weight_params=int8q,
             )
         )
     return NetworkMemoryReport(reports, "MultiLayerNetwork", name,

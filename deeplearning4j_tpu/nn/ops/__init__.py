@@ -1,8 +1,22 @@
 """Hand-written Pallas TPU kernels — the SURVEY §7 "Pallas for the hot
 ops" path (the reference's analog is the cuDNN helper layer, §2.4,
 absorbed elsewhere by XLA lowering; these kernels exist where XLA's
-op-boundary materialization costs real HBM traffic)."""
+op-boundary materialization costs real HBM traffic).
+
+The kernel SUBSYSTEM (this package):
+
+- ``flash_attention`` / ``fused_conv`` — the attention/conv fast paths;
+- ``fused_lstm`` — the LSTM cell (training scan + engine decode);
+- ``fused_update`` — the single-pass ZeRO-1 Adam update;
+- ``int8_matmul`` — int8 weight-quantized serving matmul;
+- ``registry`` — the shared probe-once/fallback/observability contract
+  every kernel resolves through (``KernelRegistry``).
+"""
 
 from deeplearning4j_tpu.nn.ops.flash_attention import flash_attention
+from deeplearning4j_tpu.nn.ops.registry import (
+    KernelRegistry,
+    default_kernel_registry,
+)
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "KernelRegistry", "default_kernel_registry"]

@@ -50,7 +50,6 @@ SUBLANE_F32 = 8
 
 from deeplearning4j_tpu.nn.ops.kernel_compat import (  # noqa: E402
     PRECISION as _PREC,
-    probe_with_retry,
 )
 
 # ---------------------------------------------------------------------------
@@ -523,12 +522,26 @@ _PROBE_CACHE: dict = {}
 
 def fused_conv_available(dtype=jnp.bfloat16) -> bool:
     """True when the Pallas fused-conv ops compile AND compute correct
-    values/gradients on this backend. Cached per process."""
-    import logging
+    values/gradients on this backend. Verdicts live in the kernel
+    REGISTRY (probe-once-per-process, ``DL4J_TPU_FUSED_CONV=0`` kill
+    switch honored, fallbacks observable); ``_PROBE_CACHE`` mirrors them
+    for introspection only — the registry is authoritative, so
+    ``KernelRegistry.reset("fused_conv")`` genuinely re-probes. The
+    interpret mode is not supported here (the fused-block layer calls
+    the compiled kernels; tests drive ``interpret=`` explicitly)."""
+    from deeplearning4j_tpu.nn.ops.registry import default_kernel_registry
 
     key = jnp.dtype(dtype).name
-    if key in _PROBE_CACHE:
-        return _PROBE_CACHE[key]
+    reg = default_kernel_registry()
+    cached = reg.enabled("fused_conv", (key,))
+    if cached is not None:
+        _PROBE_CACHE[key] = cached
+        return cached
+    if reg.mode("fused_conv") == "off":
+        reg.disable("fused_conv", (key,),
+                    "disabled via DL4J_TPU_FUSED_CONV=0")
+        _PROBE_CACHE[key] = False
+        return False
 
     def probe():
         rng = np.random.default_rng(0)
@@ -575,14 +588,7 @@ def fused_conv_available(dtype=jnp.bfloat16) -> bool:
                     raise RuntimeError(
                         f"fused-conv probe grad mismatch: rel {err:.3e}")
 
-    def on_fail(e, will_retry):  # toolchain reject/miscompile → XLA path
-        logging.getLogger(__name__).warning(
-            "Pallas fused conv unavailable for %s (%s: %s) — %s", key,
-            type(e).__name__, str(e).split("\n", 1)[0],
-            "transient remote-compile crash, retrying once" if will_retry
-            else "using the XLA composition")
-
-    ok = probe_with_retry(probe, on_fail)
+    ok = reg.probe("fused_conv", (key,), probe)
     _PROBE_CACHE[key] = ok
     return ok
 

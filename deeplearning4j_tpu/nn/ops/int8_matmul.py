@@ -1,0 +1,233 @@
+"""Int8 weight-quantized serving matmul with per-channel scales
+(TPP-style, arXiv 2104.05755; the weight-only-quantization serving
+recipe).
+
+The big serving matmuls — dense/output heads at batch-bucket shapes —
+are memory-bandwidth-bound on TPU: the weight matrix streams from HBM
+once per dispatch while the MXU idles. Storing W as int8 with one fp32
+scale per OUTPUT channel halves-to-quarters the weight bytes:
+
+    q[:, j]  = clip(round(W[:, j] / s_j), -127, 127),   s_j = max|W[:, j]|/127
+    y        = (x @ float(q)) · s        (scale applied AFTER accumulation)
+
+The Pallas kernel streams the int8 tile HBM→VMEM (the bandwidth win),
+widens on the VPU, hits the MXU with f32 accumulation and applies the
+per-channel scale to the accumulator tile before it leaves VMEM. The
+XLA reference path (`int8_matmul_reference`) computes the SAME
+expression — it is the fallback on probe failure and the parity oracle:
+kernel vs reference carries a small documented tolerance (one MXU pass
+vs the package's "highest"-precision XLA dot); quantized-vs-f32 carries
+the quantization error itself (≈ |W|∞/254 per channel — documented, and
+bounded in tests by serving top-1 agreement on zoo models).
+
+Opt-in only: training never sees int8 — quantization happens when an
+``InferenceEngine(int8_serving=True)`` builds a serving snapshot
+(``quantize_model_params``), rewriting eligible layers' param dicts
+from ``{"W": ...}`` to ``{"W_q8": int8, "W_scale": f32}``. The layers'
+forward routes through :func:`serving_matmul`, which dispatches on the
+dict keys at trace time — fp32 params compile the exact program they
+always did. Availability via ``nn.ops.registry``
+(``DL4J_TPU_INT8_MATMUL`` = 0 | 1 | interpret).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from deeplearning4j_tpu.nn.ops.kernel_compat import PRECISION as _PREC
+
+_LANE = 128
+_SUBLANE = 8
+
+#: params-dict key suffixes of a quantized weight (serving snapshots only)
+Q_SUFFIX = "_q8"
+SCALE_SUFFIX = "_scale"
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+# --------------------------------------------------------------------------
+# quantization (host side, once per serving snapshot)
+# --------------------------------------------------------------------------
+def quantize_int8(w) -> Tuple[np.ndarray, np.ndarray]:
+    """(K, N) float weights → (int8 (K, N), fp32 per-output-channel
+    scale (N,)). Symmetric round-to-nearest; all-zero channels get a
+    tiny scale so dequantization is exact zero."""
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=0)
+    scale = np.maximum(amax / 127.0, 1e-12).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def int8_matmul_reference(x, q, scale):
+    """The XLA composition — fallback path + parity oracle. Same
+    expression as the kernel: scale AFTER the f32 accumulation."""
+    return (x @ q.astype(x.dtype)) * scale.astype(x.dtype)
+
+
+# --------------------------------------------------------------------------
+# the kernel
+# --------------------------------------------------------------------------
+def _int8_kernel(x_ref, q_ref, s_ref, o_ref):
+    x = x_ref[...]
+    w = q_ref[...].astype(x.dtype)  # widen in VMEM — int8 crossed HBM
+    y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=_PREC)
+    o_ref[...] = (y * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def int8_matmul(x, q, scale, *, interpret: bool = False):
+    """x (B, K) · q (K, N) int8, per-channel ``scale`` (N,). Serving
+    only (no VJP — quantized weights are never trained through)."""
+    B, K = x.shape
+    N = q.shape[1]
+    B_p = _round_up(B, _SUBLANE)
+    K_p = _round_up(K, _LANE)
+    N_p = _round_up(N, _LANE)
+    xp = jnp.pad(x, ((0, B_p - B), (0, K_p - K)))
+    qp = jnp.pad(q, ((0, K_p - K), (0, N_p - N)))
+    sp = jnp.pad(scale.reshape(1, -1), ((0, 0), (0, N_p - N)))
+    out = pl.pallas_call(
+        _int8_kernel,
+        out_shape=jax.ShapeDtypeStruct((B_p, N_p), x.dtype),
+        interpret=interpret,
+    )(xp, qp, sp)
+    return out[:B, :N]
+
+
+# --------------------------------------------------------------------------
+# probe + trace-time dispatch
+# --------------------------------------------------------------------------
+def _probe_int8(K: int, N: int, dtype, interpret: bool,
+                B: int = 8) -> None:
+    """``B`` is the caller's padded dispatch batch, not a toy size: the
+    un-gridded kernel holds the whole (B, K) activation tile in VMEM,
+    so an overflow at the real bucket must fail the probe, not the
+    serving dispatch's compile."""
+    rng = np.random.default_rng(0)
+    # numpy args: probes may run under an ambient trace (see fused_lstm)
+    x = np.asarray(rng.standard_normal((B, K)),
+                   np.float32).astype(jnp.dtype(dtype))
+    w = np.asarray(rng.standard_normal((K, N)) * 0.1, np.float32)
+    q, s = quantize_int8(w)
+
+    def kern(x, q, s):
+        return int8_matmul(x, q, s, interpret=interpret)
+
+    shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in (x, q, s)]
+    got = jax.jit(kern).lower(*shapes).compile()(x, q, s)
+    want = jax.jit(int8_matmul_reference).lower(*shapes).compile()(x, q, s)
+    got = np.asarray(got, np.float32)
+    want = np.asarray(want, np.float32)
+    denom = np.max(np.abs(want)) + 1e-6
+    err = np.max(np.abs(got - want)) / denom
+    tol = 2e-2 if jnp.dtype(dtype) == jnp.bfloat16 else 1e-4
+    if not np.isfinite(err) or err > tol:
+        raise RuntimeError(
+            f"int8 matmul kernel vs reference mismatch: rel {err:.3e} "
+            f"> {tol}")
+
+
+def _impl_for(K: int, N: int, dtype, batch: int = 8):
+    """Kernel impl (or the XLA reference) for this instantiation,
+    registry-cached per (K, N, dtype, padded-batch)."""
+    from deeplearning4j_tpu.nn.ops.registry import default_kernel_registry
+
+    dtype = jnp.dtype(dtype)
+    B_p = _round_up(max(int(batch), 1), _SUBLANE)
+    key = (int(K), int(N), dtype.name, B_p)
+    interpret = default_kernel_registry().resolve(
+        "int8_matmul", key,
+        lambda interp: functools.partial(
+            _probe_int8, int(K), int(N), dtype, interp, B=B_p))
+    if interpret is None:
+        return int8_matmul_reference
+    return functools.partial(int8_matmul, interpret=interpret)
+
+
+def serving_matmul(params: Dict, x, name: str = "W"):
+    """``x @ params[name]`` — or the int8 route when ``params`` carries
+    the quantized form (``name_q8``/``name_scale``). The branch is a
+    trace-time dict-key check: fp32 snapshots compile the program they
+    always did. Handles rank-2 (B, K) and rank-3 (B, T, K) activations
+    (the per-timestep heads)."""
+    q = params.get(name + Q_SUFFIX)
+    if q is None:
+        return x @ params[name]
+    scale = params[name + SCALE_SUFFIX]
+    if x.ndim == 2:
+        impl = _impl_for(q.shape[0], q.shape[1], x.dtype, x.shape[0])
+        return impl(x, q, scale)
+    lead = x.shape[:-1]
+    rows = int(np.prod(lead))
+    impl = _impl_for(q.shape[0], q.shape[1], x.dtype, rows)
+    y = impl(x.reshape((rows, x.shape[-1])), q, scale)
+    return y.reshape(lead + (q.shape[1],))
+
+
+# --------------------------------------------------------------------------
+# model-level quantization (engine snapshot build)
+# --------------------------------------------------------------------------
+def quantizable_layer(layer) -> bool:
+    """Layers whose ``W`` routes through :func:`serving_matmul`: the
+    dense/output heads. Recurrent gate matrices stay fp32 (decode runs
+    at slot-count batch — compute-bound, and the fused cell owns that
+    path)."""
+    from deeplearning4j_tpu.nn.conf.layers.core import (
+        BaseOutputLayer,
+        DenseLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
+
+    return isinstance(layer, (DenseLayer, BaseOutputLayer, RnnOutputLayer))
+
+
+def quantize_layer_params(params: Dict, name: str = "W") -> Dict:
+    """One layer's param dict with ``name`` replaced by its quantized
+    form. No-op (same dict) when the weight is absent/not 2-D."""
+    w = params.get(name)
+    if w is None or getattr(w, "ndim", 0) != 2:
+        return params
+    q, s = quantize_int8(np.asarray(w, np.float32))
+    out = {k: v for k, v in params.items() if k != name}
+    out[name + Q_SUFFIX] = jnp.asarray(q)
+    out[name + SCALE_SUFFIX] = jnp.asarray(s)
+    return out
+
+
+def quantize_model_params(model) -> Tuple[list, dict]:
+    """A COPY of ``model.params_`` with every eligible layer's W
+    int8-quantized + a byte report. The model itself is untouched —
+    this is a serving-snapshot transform, not a training mutation."""
+    layers = model.layers
+    new_params = []
+    fp32_bytes = 0
+    int8_bytes = 0
+    n_q = 0
+    for layer, p in zip(layers, model.params_):
+        if quantizable_layer(layer) and "W" in p:
+            w = p["W"]
+            qp = quantize_layer_params(p)
+            if "W" + Q_SUFFIX in qp:
+                n_q += 1
+                fp32_bytes += int(np.prod(w.shape)) * w.dtype.itemsize
+                int8_bytes += int(np.prod(w.shape)) + w.shape[1] * 4
+                new_params.append(qp)
+                continue
+        new_params.append(p)
+    return new_params, {
+        "layers_quantized": n_q,
+        "weight_bytes_fp32": fp32_bytes,
+        "weight_bytes_int8": int8_bytes,
+        "bytes_saved": fp32_bytes - int8_bytes,
+    }

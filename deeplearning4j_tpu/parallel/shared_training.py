@@ -111,6 +111,7 @@ class SharedTrainingMaster:
         self._step = None
         self._bstep = None  # bundled (lax.scan) variant, built on demand
         self._layout = None
+        self._fused_impls = None
         self._residual = None
         self._n_params = None
         self._model_id = None  # step/unravel/residual are per-model
@@ -149,10 +150,16 @@ class SharedTrainingMaster:
 
         if self.sharded_update or getattr(
                 model.conf.global_conf, "sharded_update", False):
+            from deeplearning4j_tpu.nn.ops import fused_update as _fused
             from deeplearning4j_tpu.parallel.zero import ShardedUpdateLayout
 
             self._layout = ShardedUpdateLayout(layers, model.params_,
                                                mesh.n_data)
+            # same fused-kernel resolution as make_sharded_train_step:
+            # the multihost sharded path is the configuration the fused
+            # ZeRO-1 kernel exists for
+            self._fused_impls = _fused.resolve_group_impls(
+                self._layout, mesh.mesh)
 
         # Fault guard (train/faults.py): verdict on the DECODED synchronized
         # gradient AND the residual carry — a NaN in a local gradient is
@@ -195,7 +202,8 @@ class SharedTrainingMaster:
 
                 new_params, new_opt = apply_sharded_updates(
                     self._layout, params, grads_sync, opt_state, t,
-                    it_upd, epoch, mesh=mesh.mesh)
+                    it_upd, epoch, mesh=mesh.mesh,
+                    fused_impls=self._fused_impls)
             else:
                 new_params, new_opt = _apply_layer_updates(
                     layers, params, grads_sync, opt_state, t, it_upd,

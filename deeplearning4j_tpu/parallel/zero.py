@@ -203,12 +203,21 @@ def apply_sharded_updates(layout: ShardedUpdateLayout,
                           grads: Sequence[Dict[str, Array]],
                           zopt: Sequence[Dict[str, Array]],
                           t, iteration, epoch,
-                          mesh=None, axis: str = "data"
+                          mesh=None, axis: str = "data",
+                          fused_impls: Optional[Sequence] = None
                           ) -> Tuple[List[Dict[str, Array]],
                                      List[Dict[str, Array]]]:
     """The sharded analog of ``_apply_layer_updates``: per-layer gradient
     normalization → l1/l2/weight-decay → flat sharded updater → per-layer
-    constraints. Traced inside the train step."""
+    constraints. Traced inside the train step.
+
+    ``fused_impls`` (from ``nn.ops.fused_update.resolve_group_impls``,
+    one entry per group, None → reference): replaces the per-group
+    ``updater.apply`` + subtract with the single-pass fused Pallas
+    update between the SAME sharding constraints — the reduce-scatter /
+    all-gather structure (and therefore the bytes moved) is unchanged,
+    and the fused result is bit-exact vs the reference (probe-asserted,
+    fallback on any mismatch)."""
     layers = layout.layers
     adjusted: List[Optional[Dict[str, Array]]] = []
     for i, layer in enumerate(layers):
@@ -231,14 +240,20 @@ def apply_sharded_updates(layout: ShardedUpdateLayout,
     new_zopt: List[Dict[str, Array]] = []
     shard = None if mesh is None else NamedSharding(mesh, P(axis, None))
     repl = None if mesh is None else NamedSharding(mesh, P())
-    for grp, state in zip(layout.groups, zopt):
+    for gi, (grp, state) in enumerate(zip(layout.groups, zopt)):
         g2d = layout._flatten_group(grp, adjusted)
         p2d = layout._flatten_group(grp, params)
         if shard is not None:
             g2d = jax.lax.with_sharding_constraint(g2d, shard)
             p2d = jax.lax.with_sharding_constraint(p2d, shard)
-        delta, new_state = grp.updater.apply(g2d, state, t, iteration, epoch)
-        np2d = p2d - delta
+        impl = fused_impls[gi] if fused_impls is not None else None
+        if impl is not None:
+            np2d, new_state = impl(grp.updater, p2d, g2d, state, t,
+                                   iteration, epoch)
+        else:
+            delta, new_state = grp.updater.apply(g2d, state, t, iteration,
+                                                 epoch)
+            np2d = p2d - delta
         if repl is not None:
             np2d = jax.lax.with_sharding_constraint(np2d, repl)
         layout._scatter_group(grp, np2d, new_params)
@@ -293,7 +308,8 @@ def unshard_model_opt_state(model, layout: ShardedUpdateLayout,
 
 
 def make_sharded_train_step(model, mesh, policy=None,
-                            steps_per_call: int = 1, telemetry=None):
+                            steps_per_call: int = 1, telemetry=None,
+                            fused_update: Optional[bool] = None):
     """Jitted ZeRO-1 DP train step over ``mesh`` (a TrainingMesh).
 
     Same signature as the replicated step the wrapper/multihost facade
@@ -319,9 +335,19 @@ def make_sharded_train_step(model, mesh, policy=None,
     in-graph telemetry dict as a trailing (replicated) output — the
     gradient norm is computed on the GLOBAL pre-scatter gradient, so
     sharded and replicated training report identical telemetry.
+
+    ``fused_update``: None (auto — the fused single-pass Pallas Adam
+    kernel where the probe passes, reference elsewhere; see
+    nn/ops/fused_update.py) | True (same) | False (force the reference
+    composition — the bench A/B leg). Resolved ONCE here, never at
+    trace time; bit-exact either way.
     """
     names, layers, params = _model_layer_view(model)
     layout = ShardedUpdateLayout(layers, params, mesh.n_data)
+    from deeplearning4j_tpu.nn.ops import fused_update as _fused_update
+
+    fused_impls = _fused_update.resolve_group_impls(
+        layout, mesh.mesh, enabled=fused_update)
     remat_policy = _resolve_remat_policy(
         getattr(model.conf.global_conf, "remat_policy", None))
 
@@ -369,7 +395,7 @@ def make_sharded_train_step(model, mesh, policy=None,
             p_list, g_list = params, grads
         np_list, new_zopt = apply_sharded_updates(
             layout, p_list, g_list, zopt, t, it_upd, epoch,
-            mesh=mesh.mesh)
+            mesh=mesh.mesh, fused_impls=fused_impls)
         new_params = (dict(zip(names, np_list)) if names is not None
                       else np_list)
         score = loss + model._reg_score(params)

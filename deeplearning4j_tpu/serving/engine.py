@@ -123,7 +123,8 @@ class InferenceEngine:
 
     def __init__(self, model, buckets: Optional[BucketPolicy] = None,
                  mesh=None, checkpoint_dir: Optional[str] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 int8_serving: bool = False):
         # own copy: mesh filtering + oversize growth must never mutate a
         # policy object shared with another engine
         self.buckets = (buckets if buckets is not None
@@ -131,6 +132,18 @@ class InferenceEngine:
         self.mesh = mesh
         self.checkpoint_dir = checkpoint_dir
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        #: opt-in int8 weight-only quantization of the dense/output
+        #: heads (nn/ops/int8_matmul.py): every snapshot this engine
+        #: builds — init AND hot reloads — serves int8 weights with
+        #: per-channel scales; the MODEL's params stay fp32 (training/
+        #: checkpointing never see the quantized form)
+        self.int8_serving = bool(int8_serving)
+        self.int8_report: Optional[dict] = None
+        if self.int8_serving and not hasattr(model, "layers"):
+            raise TypeError(
+                f"int8_serving needs a layered model with a functional "
+                f"forward; {type(model).__name__} serves through the "
+                "generic output path")
         self._compile_count = 0
         #: byte ledger of the snapshot placement (parallel/reshard.py);
         #: None for mesh-less engines (placement is implicit at dispatch)
@@ -225,7 +238,36 @@ class InferenceEngine:
             stats = _reshard.TransferStats()
             _reshard.place_model(model, self.mesh, stats)
             self.reshard_stats = stats
-        return _Snapshot(model, fn, conf_json, version, source)
+        snap = _Snapshot(model, fn, conf_json, version, source)
+        if self.int8_serving:
+            snap.params = self._quantize_params(model)
+        return snap
+
+    def _quantize_params(self, model):
+        """Int8-quantize a model's params for a serving snapshot (the
+        model object keeps its fp32 params). Mesh engines re-place the
+        quantized leaves replicated."""
+        if not hasattr(model, "layers"):
+            # same guard as __init__ — a hot reload can hand this engine
+            # a different-arch checkpoint that loads as a layer-less
+            # model, and that must fail typed (reload refused, old
+            # snapshot keeps serving), not AttributeError mid-swap
+            raise TypeError(
+                f"int8_serving needs a layered model with a functional "
+                f"forward; {type(model).__name__} serves through the "
+                "generic output path")
+        from deeplearning4j_tpu.nn.ops.int8_matmul import (
+            quantize_model_params,
+        )
+
+        qparams, report = quantize_model_params(model)
+        self.int8_report = report
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("int8_quantize", surface="serving", **report)
+        if self.mesh is not None:
+            qparams = jax.device_put(qparams, self.mesh.replicated())
+        return qparams
 
     def _build_fn(self, model):
         """Pure jitted forward for models exposing the functional
@@ -299,6 +341,8 @@ class InferenceEngine:
             "warm": self.warm,
             "compile_count": self._compile_count,
             "buckets": repr(self.buckets),
+            "int8_serving": self.int8_serving,
+            "int8_report": self.int8_report,
             # canary/rollback tooling keys on these: WHICH on-disk
             # checkpoint is live (content fingerprint, None for
             # fresh-weights engines) and which snapshot generation
@@ -523,7 +567,8 @@ class InferenceEngine:
                 # shapes → jit cache hits, zero recompiles)
                 snap = _Snapshot.__new__(_Snapshot)
                 snap.model = old.model
-                snap.params = new_model.params_
+                snap.params = (self._quantize_params(new_model)
+                               if self.int8_serving else new_model.params_)
                 snap.state = new_model.state_
                 snap.fn = old.fn
                 snap.conf_json = old.conf_json

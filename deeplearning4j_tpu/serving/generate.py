@@ -296,21 +296,72 @@ class _TransformerBackend:
                                         self.max_length)
 
 
+def _cell_decode_supported(model) -> bool:
+    """True when the model's layer stack can decode through the direct
+    cell path: no preprocessors, every recurrent layer exposes ``_step``
+    (the single-timestep cell the fused Pallas kernel backs), and every
+    other layer is a rank-polymorphic per-timestep head. Anything else
+    (Bidirectional, pooling wrappers, conv stacks) keeps the generic
+    ``_forward`` path."""
+    from deeplearning4j_tpu.nn.conf.layers.core import (
+        ActivationLayer,
+        DenseLayer,
+        LossLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+        BaseRecurrentLayer,
+        RnnLossLayer,
+        RnnOutputLayer,
+    )
+
+    if getattr(model.conf, "preprocessors", None):
+        return False
+    for layer in model.layers:
+        if isinstance(layer, BaseRecurrentLayer):
+            if not hasattr(layer, "_step"):
+                return False
+        elif not isinstance(layer, (RnnOutputLayer, RnnLossLayer,
+                                    DenseLayer, ActivationLayer,
+                                    LossLayer)):
+            return False
+    return True
+
+
 class _RecurrentBackend:
     """Incremental-decode backend for recurrent MultiLayerNetworks
     (TextGenerationLSTM): per-slot carried (h, c) state stacked to
-    ``(n_slots, ...)`` leaves, threaded through ``_forward``'s carry
-    path. No KV slab — the carry IS the whole decode state, so
-    ``max_length`` only bounds the request window, not memory."""
+    ``(n_slots, ...)`` leaves. No KV slab — the carry IS the whole
+    decode state, so ``max_length`` only bounds the request window, not
+    memory.
+
+    Two decode-step programs (PR 9 residue fix):
+
+    - **cell path** (default when the stack supports it): one direct
+      ``layer._step`` call per recurrent layer on rank-2 ``(S, d)``
+      activations — no ``lax.scan`` machinery, no time-axis reshapes —
+      so the per-token program is exactly the fused LSTM cell dispatches
+      (Pallas on TPU, the reference composition elsewhere) plus the
+      output head and the in-graph sampler;
+    - **legacy path** (``cell_path=False`` or unsupported stacks): the
+      generic ``_forward`` carry path over a T=1 sequence.
+
+    Both are one jitted dispatch per token for all slots, bit-identical
+    outputs (asserted in tests), zero steady-state recompiles."""
 
     kind = "recurrent"
 
     def __init__(self, model, n_slots: int, max_length: Optional[int],
-                 prefill_buckets: Optional[Sequence[int]], trace_hook):
+                 prefill_buckets: Optional[Sequence[int]], trace_hook,
+                 cell_path: Optional[bool] = None):
+        import os as _os
+
         from deeplearning4j_tpu.models.transformer_lm import (
             prefill_bucket_lengths,
             sample_next_device,
             sample_next_rows,
+        )
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+            BaseRecurrentLayer,
         )
 
         self.model = model
@@ -320,19 +371,48 @@ class _RecurrentBackend:
             self.max_length,
             prefill_buckets or getattr(model, "serving_seq_buckets", None))
         self.vocab = int(model.layers[0].n_in)
+        if cell_path is None:
+            cell_path = (_os.environ.get("DL4J_TPU_LSTM_DECODE_CELL", "1")
+                         != "0")
+        self.cell_path = bool(cell_path) and _cell_decode_supported(model)
         self.reset()
         self.cache_bytes = sum(
             int(np.prod(leaf.shape)) * leaf.dtype.itemsize
             for leaf in jax.tree_util.tree_leaves(self._carries))
         V = self.vocab
 
+        def _cell_forward(p, st, carries, x):
+            """Direct per-timestep stack: (S, V) one-hot → (S, vocab)
+            head output + updated carries. Mirrors ``_forward``'s
+            semantics for the supported layer set (train=False: no
+            dropout, no weight noise; recurrent masks are irrelevant at
+            T=1 with all-real rows)."""
+            if model._compute_dtype is not None:
+                p = model._cast_for_compute(p)
+                x = x.astype(model._compute_dtype)
+            nc = [None] * len(model.layers)
+            for idx, layer in enumerate(model.layers):
+                if isinstance(layer, BaseRecurrentLayer):
+                    c_new, x = layer._step(p[idx], carries[idx], x)
+                    nc[idx] = c_new
+                else:
+                    x, _ = layer.apply(p[idx], x, state=st[idx],
+                                       train=False)
+            return x, nc
+
         def _decode(p, st, carries, toks, active, t, k, pp, keys):
             trace_hook("generation_decode")
-            x = jax.nn.one_hot(toks, V, dtype=jnp.float32)[:, None, :]
-            y, _, _, nc, _ = model._forward(p, st, x, train=False, rng=None,
-                                            carries=carries)
-            logits = jnp.log(jnp.clip(y[:, -1, :].astype(jnp.float32),
-                                      1e-30, None))
+            if self.cell_path:
+                x = jax.nn.one_hot(toks, V, dtype=jnp.float32)
+                y, nc = _cell_forward(p, st, carries, x)
+                logits = jnp.log(jnp.clip(y.astype(jnp.float32),
+                                          1e-30, None))
+            else:
+                x = jax.nn.one_hot(toks, V, dtype=jnp.float32)[:, None, :]
+                y, _, _, nc, _ = model._forward(p, st, x, train=False,
+                                                rng=None, carries=carries)
+                logits = jnp.log(jnp.clip(y[:, -1, :].astype(jnp.float32),
+                                          1e-30, None))
             nxt, nkeys = sample_next_rows(logits, t, k, pp, keys)
             nxt = jnp.where(active, nxt, toks)
             nkeys = jnp.where(active[:, None], nkeys, keys)
@@ -403,7 +483,8 @@ class _RecurrentBackend:
                                         self.max_length)
 
 
-def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook):
+def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook,
+                  cell_path: Optional[bool] = None):
     from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 
     if isinstance(model, TransformerLM):
@@ -417,7 +498,8 @@ def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook):
 
         if any(isinstance(l, BaseRecurrentLayer) for l in layers):
             return _RecurrentBackend(model, n_slots, max_length,
-                                     prefill_buckets, trace_hook)
+                                     prefill_buckets, trace_hook,
+                                     cell_path=cell_path)
     raise TypeError(
         f"{type(model).__name__} has no incremental-decode path: expected "
         "a TransformerLM (KV-cache slab) or a MultiLayerNetwork with "
@@ -495,7 +577,8 @@ class GenerationEngine:
                  trace_requests: bool = True,
                  traces: Optional["rtrace.TraceBuffer"] = None,
                  watchdog_mult: Optional[float] = 20.0,
-                 watchdog_min_s: float = 30.0):
+                 watchdog_min_s: float = 30.0,
+                 decode_cell_path: Optional[bool] = None):
         self.metrics = metrics if metrics is not None else GenerationMetrics()
         self.trace_requests = bool(trace_requests)
         self.traces = traces
@@ -544,8 +627,13 @@ class GenerationEngine:
 
             _flight.record("retrace", fn=fn)
 
+        #: None → auto (env ``DL4J_TPU_LSTM_DECODE_CELL``, else on for
+        #: supported recurrent stacks); False forces the legacy
+        #: ``_forward``-over-T=1 decode program (the bench's reference
+        #: leg). Ignored by the transformer backend.
         self.backend = _pick_backend(model, n_slots, max_length,
-                                     prefill_buckets, trace_hook)
+                                     prefill_buckets, trace_hook,
+                                     cell_path=decode_cell_path)
         self.n_slots = self.backend.n_slots
         self.max_length = self.backend.max_length
         self.metrics.set_slots(self.n_slots)
@@ -670,6 +758,7 @@ class GenerationEngine:
     def describe(self) -> dict:
         return {
             "backend": self.backend.kind,
+            "decode_cell_path": getattr(self.backend, "cell_path", None),
             "n_slots": self.n_slots,
             "active_slots": self.active_slots,
             "max_length": self.backend.max_length,

@@ -389,18 +389,27 @@ class TestFlashAttentionGate:
         kernel (different segment API) is never a candidate."""
         import deeplearning4j_tpu.nn.conf.layers.attention as A
 
+        from deeplearning4j_tpu.nn.ops.registry import (
+            default_kernel_registry,
+        )
+
         monkeypatch.setattr(A, "_FLASH_PROBE_CACHE", {})
+        default_kernel_registry().reset("flash_attention")
         attempted = []
-        monkeypatch.setattr(
-            A, "_probe_compiles",
-            lambda fn, *a, **k: (attempted.append(fn), False)[1])
-        monkeypatch.setattr(
-            "deeplearning4j_tpu.nn.ops.kernel_compat.probe_with_retry",
-            lambda probe, on_fail: probe())
-        assert A._flash_attention_impl(jnp.float32, 256, 64, True,
-                                       has_seg=True) is None
-        assert ("float32", 256, 64, True, True) in A._FLASH_PROBE_CACHE
-        assert len(attempted) == 1  # in-tree only; bundled skipped
+
+        def probe(fn, *a, **k):
+            attempted.append(fn)
+            raise RuntimeError("probe reject")  # registry contract:
+            # a failing probe RAISES (deterministic → one attempt)
+
+        monkeypatch.setattr(A, "_probe_compiles", probe)
+        try:
+            assert A._flash_attention_impl(jnp.float32, 256, 64, True,
+                                           has_seg=True) is None
+            assert ("float32", 256, 64, True, True) in A._FLASH_PROBE_CACHE
+            assert len(attempted) == 1  # in-tree only; bundled skipped
+        finally:
+            default_kernel_registry().reset("flash_attention")
 
     def test_seq_beyond_own_kernel_cap_tries_bundled(self, monkeypatch):
         """T past the in-tree kernel's MAX_SEQ_LEN must skip it (no

@@ -569,9 +569,11 @@ class TestParallelInferenceRegressions:
     def test_overload_is_typed(self):
         rec = _ShapeRecorder(_net())
         release = threading.Event()
+        entered = threading.Event()
         real_output = rec.output
 
         def blocking(x, mask=None):
+            entered.set()
             release.wait(10)
             return real_output(x, mask=mask)
 
@@ -582,7 +584,15 @@ class TestParallelInferenceRegressions:
                 for i in range(3)]
         for t in held:
             t.start()
-        time.sleep(0.2)  # worker blocked + queue full
+        # deterministic overload state (a fixed sleep flakes under box
+        # load): the worker must be BLOCKED inside the dispatch and the
+        # queue must hold the other two requests before the probe
+        assert entered.wait(10)
+        deadline = time.monotonic() + 10
+        while (pi._batcher.queue_depth() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert pi._batcher.queue_depth() == 2
         with pytest.raises(ServerOverloadedError):
             pi.output(_rows(1))
         release.set()
