@@ -1544,6 +1544,61 @@ def _bench_kernels(n_requests: int = 12, gen_slots: int = 6,
     return result
 
 
+def _bench_chaos():
+    """The full resilience drill matrix (chaos/drills.py) — single-fault
+    AND paired-fault storms — as a scored artifact. Gates (ISSUE 13):
+    every drill green (an injected fault surfaces as a typed error or a
+    completed recovery — never a hang, a bare exception, or a corrupt
+    artifact), >= 12 drills with >= 3 paired compositions, zero
+    silent-corruption findings. Writes BENCH_chaos.json and returns the
+    headline record."""
+    import time as _time
+
+    import jax
+
+    from deeplearning4j_tpu.chaos import drills
+
+    t0 = _time.monotonic()
+    scorecard = drills.run_matrix(fast_only=False, verbose=True)
+    wall = _time.monotonic() - t0
+    recoveries = {d["drill"]: d["recovery_s"]
+                  for d in scorecard["drills"] if "recovery_s" in d}
+    gates = {
+        "all_drills_green": scorecard["ok"],
+        "matrix_floor_12": scorecard["n_drills"]
+        - scorecard["n_skipped"] >= 12,
+        "paired_floor_3": scorecard["n_paired"] >= 3,
+        "zero_silent_corruption":
+            not scorecard["silent_corruption_findings"],
+    }
+    result = {
+        "metric": "chaos_drills_green",
+        "value": scorecard["n_green"],
+        "unit": "drills",
+        "gates": gates,
+        "gates_ok": all(gates.values()),
+        "extra": {
+            "n_drills": scorecard["n_drills"],
+            "n_red": scorecard["n_red"],
+            "n_skipped": scorecard["n_skipped"],
+            "n_paired": scorecard["n_paired"],
+            "wall_s": round(wall, 1),
+            "recovery_latency_s": recoveries,
+            "verdicts": {d["drill"]: d["verdict"]
+                         for d in scorecard["drills"]},
+            "silent_corruption_findings":
+                scorecard["silent_corruption_findings"],
+            "n_devices": len(jax.devices()),
+            "platform": jax.devices()[0].platform,
+        },
+        "scorecard": scorecard,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_chaos.json"), "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
 def _tpu_plausible() -> bool:
     """Whether a TPU backend could come up at all in this container: the
     axon plugin must be importable (or explicitly requested). When it
@@ -1942,6 +1997,26 @@ if __name__ == "__main__":
             out["metric"] = "cpu_fallback_" + out["metric"]
         print(json.dumps(out))
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "chaos":
+        # resilience drill matrix: meaningful on any backend (the gates
+        # are invariants, not throughput), writes BENCH_chaos.json. The
+        # elastic drills want the 8-device topology — force it BEFORE
+        # jax initializes when no TPU can come up.
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        out = _bench_chaos()
+        if not _tpu_plausible():
+            out["metric"] = "cpu_fallback_" + out["metric"]
+        print(json.dumps({k: v for k, v in out.items()
+                          if k != "scorecard"}))
+        sys.exit(0 if out["gates_ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "pipeline":
         # pipelined-loop dispatch-amortization A/B: meaningful on any
         # backend, writes BENCH_pipeline.json

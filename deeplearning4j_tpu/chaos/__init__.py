@@ -1,0 +1,41 @@
+"""Unified chaos-engineering subsystem.
+
+- :mod:`~.hooks` — the seam fire points production code embeds (cheap,
+  stdlib-only; no-ops unless a drill arms them).
+- :mod:`~.fslayer` — the injectable filesystem layer the durable-write
+  paths route through, and the typed :class:`~.fslayer.StorageError`.
+- :mod:`~.seams` — the registry of every injectable fault point.
+- :mod:`~.plan` — declarative, seeded :class:`~.plan.ChaosPlan` (JSON).
+- :mod:`~.invariants` — the cross-cutting resilience contract as
+  executable checks.
+- :mod:`~.drills` — the seam×workload drill matrix (imported lazily:
+  it pulls in the full training/serving stack).
+
+See ARCHITECTURE.md § Chaos engineering.
+"""
+
+from deeplearning4j_tpu.chaos import hooks  # noqa: F401
+from deeplearning4j_tpu.chaos.fslayer import StorageError  # noqa: F401
+from deeplearning4j_tpu.chaos.hooks import (  # noqa: F401
+    FaultSpec,
+    InjectedFaultError,
+)
+from deeplearning4j_tpu.chaos.invariants import (  # noqa: F401
+    InvariantReport,
+)
+from deeplearning4j_tpu.chaos.plan import ChaosPlan, load_plan  # noqa: F401
+from deeplearning4j_tpu.chaos.seams import (  # noqa: F401
+    SEAMS,
+    list_seams,
+    register_hook_seam,
+    register_seam,
+)
+
+
+def __getattr__(name):
+    # drills import the whole stack — load on demand only
+    if name == "drills":
+        import importlib
+
+        return importlib.import_module("deeplearning4j_tpu.chaos.drills")
+    raise AttributeError(name)

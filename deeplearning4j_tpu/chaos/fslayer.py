@@ -1,0 +1,216 @@
+"""Injectable filesystem layer for the durable-artifact paths.
+
+Every atomic-write chokepoint in the stack — checkpoint zips
+(``ModelSerializer.write_model``), registry journal appends and
+``registry.json`` snapshots (``serving/registry.py``), tune study
+journals (``tune/store.py``) — routes its ``write``/``fsync``/
+``os.replace`` through this module, which does two things:
+
+1. **Typed failures**: any OSError out of those operations (real
+   disk-full, failed fsync, a vanished directory) is re-raised as
+   :class:`StorageError` — an OSError subclass, so existing ``except
+   OSError`` handlers keep working — carrying the operation, surface
+   and path, and recorded as a ``storage_error`` flight event. Callers'
+   existing ``finally`` blocks clean the staging temp file, so the
+   previous valid artifact is untouched and still loadable — the
+   disk-full contract drills assert.
+
+2. **Chaos seams**: each operation fires a hook point
+   (``fs.write`` / ``fs.fsync`` / ``fs.replace`` / ``fs.append``) with
+   the path and a ``surface`` tag (``checkpoint``,
+   ``registry_journal``, ``registry_snapshot``, ``registry_publish``,
+   ``tune_journal``, ``tune_meta``), so a declarative ChaosPlan can
+   inject ENOSPC on exactly the third registry-journal append without
+   touching any other I/O in the process. The ``torn`` mode on
+   ``fs.append`` writes HALF the line durably before failing — the
+   exact on-disk state a SIGKILL mid-append leaves, which the journal
+   replayers' torn-trailing-line semantics must absorb.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from deeplearning4j_tpu.chaos import hooks
+
+
+class StorageError(OSError):
+    """A durable write (stage/fsync/replace/append) could not complete.
+    The staged temp file is cleaned by the caller and the previously
+    published artifact is intact — this error means "the NEW artifact
+    did not land", never "state was corrupted"."""
+
+    def __init__(self, message: str, op: Optional[str] = None,
+                 path: Optional[str] = None,
+                 surface: Optional[str] = None):
+        super().__init__(message)
+        self.op = op
+        self.path = path
+        self.surface = surface
+
+
+def storage_error(op: str, path: str, surface: str,
+                   cause: BaseException) -> StorageError:
+    from deeplearning4j_tpu.obs import flight as _flight
+
+    _flight.record("storage_error", op=op, surface=surface, path=str(path),
+                   error=type(cause).__name__, message=str(cause)[:200])
+    return StorageError(
+        f"storage {op} failed for {surface} artifact {path!r}: "
+        f"{type(cause).__name__}: {cause}", op=op, path=str(path),
+        surface=surface)
+
+
+# --------------------------------------------------------------------------
+# the operations
+# --------------------------------------------------------------------------
+def open_for_write(path: str, mode: str = "w", surface: str = ""):
+    """Open a staging file for writing (the ``fs.write`` seam)."""
+    try:
+        hooks.fire("fs.write", path=str(path), surface=surface)
+        return open(path, mode)
+    except OSError as e:
+        raise storage_error("write", path, surface, e) from e
+
+
+def fsync_file(f, path: str = "", surface: str = "") -> None:
+    """flush+fsync an open file (the ``fs.fsync`` seam)."""
+    try:
+        hooks.fire("fs.fsync", path=str(path), surface=surface)
+        f.flush()
+        os.fsync(f.fileno())
+    except OSError as e:
+        raise storage_error("fsync", path or getattr(f, "name", "?"),
+                             surface, e) from e
+
+
+def fsync_path(path: str, surface: str = "") -> None:
+    """fsync an already-written file by path (checkpoint zips are
+    written by ``zipfile`` and must hit disk BEFORE the atomic rename —
+    an ``os.replace`` of un-synced data can publish an empty file after
+    power loss)."""
+    try:
+        hooks.fire("fs.fsync", path=str(path), surface=surface)
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError as e:
+        raise storage_error("fsync", path, surface, e) from e
+
+
+def replace(src: str, dst: str, surface: str = "") -> None:
+    """Atomic publish (the ``fs.replace`` seam)."""
+    try:
+        hooks.fire("fs.replace", path=str(dst), surface=surface)
+        os.replace(src, dst)
+    except OSError as e:
+        raise storage_error("replace", dst, surface, e) from e
+
+
+def copy_file(src: str, dst: str, surface: str = "") -> None:
+    """Stage a copy (registry publish; the ``fs.write`` seam)."""
+    try:
+        hooks.fire("fs.write", path=str(dst), surface=surface)
+        shutil.copyfile(src, dst)
+    except OSError as e:
+        raise storage_error("copy", dst, surface, e) from e
+
+
+def repair_torn_tail(path: str, surface: str = "") -> int:
+    """Truncate a torn trailing journal line (one a crashed or failed
+    append left WITHOUT its newline) back to the last complete line;
+    returns the bytes dropped. Appending after a torn tail without this
+    would merge the fragment with the next record into one unparseable
+    line — silently losing an acknowledged record on replay, or (once a
+    further record follows) tripping the torn-MIDDLE refusal and
+    bricking the journal. Safe under the journals' multi-writer
+    contract (whole fsync'd O_APPEND lines): a file not ending in a
+    newline means a dead append, never an in-flight one."""
+    try:
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            return 0
+        with open(path, "rb+") as f:
+            f.seek(-1, os.SEEK_END)
+            if f.read(1) == b"\n":
+                return 0
+            f.seek(0)
+            data = f.read()
+            keep = data.rfind(b"\n") + 1  # 0 when no complete line
+            dropped = len(data) - keep
+            f.truncate(keep)
+    except OSError as e:
+        raise storage_error("repair", path, surface, e) from e
+    if dropped:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("journal_repair", path=str(path),
+                       surface=surface, dropped_bytes=dropped)
+    return dropped
+
+
+def append_line(path: str, line: str, surface: str = "") -> None:
+    """Durable whole-line journal append: write + flush + fsync (the
+    ``fs.append`` + ``fs.fsync`` seams), after truncating any torn tail
+    a previous crashed/failed append left (:func:`repair_torn_tail`).
+    The ``torn`` mode leaves half the line durably on disk and raises —
+    the SIGKILL-mid-append state the replayers' torn-trailing-line
+    handling (and the next append's repair) exists for."""
+    torn = False
+    start = None  # set once the write is about to happen
+    try:
+        spec = hooks.fire("fs.append", path=str(path), surface=surface)
+        repair_torn_tail(path, surface=surface)
+        start = os.path.getsize(path) if os.path.exists(path) else 0
+        with open(path, "a") as f:
+            if spec is not None and spec.mode == "torn":
+                torn = True
+                f.write(line[: max(len(line) // 2, 1)])
+                f.flush()
+                os.fsync(f.fileno())
+                raise OSError(
+                    f"chaos-injected torn append at {surface or path}")
+            f.write(line)
+            f.flush()
+            hooks.fire("fs.fsync", path=str(path), surface=surface)
+            os.fsync(f.fileno())
+    except OSError as e:
+        # roll the failed append back: a failed FSYNC leaves the whole
+        # flushed line in the page cache, and without truncation it can
+        # land on disk and be REPLAYED on restart — a record the caller
+        # was told failed would resurrect (e.g. a publish whose snapshot
+        # the error path already deleted). Best-effort; skipped for the
+        # injected torn mode, which deliberately simulates a SIGKILL
+        # where no rollback code ever runs.
+        if not torn and start is not None:
+            try:
+                with open(path, "rb+") as f:
+                    f.truncate(start)
+            except OSError:
+                pass  # disk truly gone; replay's validation still holds
+        if isinstance(e, StorageError):
+            raise
+        raise storage_error("append", path, surface, e) from e
+
+
+def write_atomic(path: str, data: str, surface: str = "") -> None:
+    """Stage → fsync → atomic replace of a small text artifact
+    (registry/tune JSON snapshots), with guaranteed staging cleanup."""
+    from deeplearning4j_tpu.train.faults import atomic_tmp_path
+
+    tmp = atomic_tmp_path(path)
+    try:
+        f = open_for_write(tmp, "w", surface=surface)
+        with f:
+            f.write(data)
+            fsync_file(f, tmp, surface=surface)
+        replace(tmp, path, surface=surface)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

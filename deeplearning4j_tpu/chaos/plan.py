@@ -1,0 +1,107 @@
+"""Declarative chaos plans: which seam, when, how often — seeded.
+
+A plan is plain JSON::
+
+    {
+      "name": "enospc-mid-publish",
+      "seed": 7,
+      "faults": [
+        {"seam": "fs.replace", "mode": "enospc", "at_call": 1,
+         "match": {"surface": "registry_publish"}},
+        {"seam": "generate.decode_dispatch", "mode": "delay",
+         "delay_s": 1.5, "at_call": 4},
+        {"seam": "grad_nan", "at_iterations": [3, 4]},
+        {"seam": "on_event", "event": "mesh_shrink",
+         "action": "truncate_newest_checkpoint", "dir": "/ckpts"}
+      ]
+    }
+
+``ChaosPlan.armed()`` arms every fault process-wide for the block and
+disarms all of them (reverse order) on exit — including when the drill
+itself dies. Each fault entry gets its own deterministic RNG derived
+from the plan seed and its index, so probabilistic faults replay
+identically run to run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import random
+from typing import List, Optional
+
+from deeplearning4j_tpu.chaos import seams as _seams
+
+
+class ChaosPlan:
+    def __init__(self, faults: List[dict], name: str = "",
+                 seed: int = 0):
+        self.name = str(name)
+        self.seed = int(seed)
+        self.faults = [dict(f) for f in faults]
+        for i, f in enumerate(self.faults):
+            if "seam" not in f:
+                raise ValueError(f"fault entry {i} has no 'seam' key: {f}")
+            _seams.get_seam(f["seam"])  # fail fast on unknown seams
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {"name": self.name, "seed": self.seed,
+                "faults": [dict(f) for f in self.faults]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosPlan":
+        return cls(d.get("faults", []), name=d.get("name", ""),
+                   seed=d.get("seed", 0))
+
+    @classmethod
+    def from_json(cls, s: str) -> "ChaosPlan":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_file(cls, path: str) -> "ChaosPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # -- arming --------------------------------------------------------------
+    @contextlib.contextmanager
+    def armed(self):
+        """Arm every fault for the block; always disarm on exit."""
+        disarms = []
+        try:
+            for i, f in enumerate(self.faults):
+                spec = {k: v for k, v in f.items() if k != "seam"}
+                rng = random.Random(f"{self.seed}:{i}")
+                disarms.append(_seams.get_seam(f["seam"]).arm(spec, rng))
+            yield self
+        finally:
+            for d in reversed(disarms):
+                try:
+                    d()
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    pass
+
+    def describe(self) -> str:
+        lines = [f"chaos plan {self.name or '<unnamed>'} "
+                 f"(seed={self.seed}, {len(self.faults)} faults)"]
+        for f in self.faults:
+            rest = " ".join(f"{k}={v}" for k, v in f.items() if k != "seam")
+            lines.append(f"  - {f['seam']}: {rest}")
+        return "\n".join(lines)
+
+
+def load_plan(source) -> Optional[ChaosPlan]:
+    """Coerce a plan from a path / JSON string / dict / plan object."""
+    if source is None:
+        return None
+    if isinstance(source, ChaosPlan):
+        return source
+    if isinstance(source, dict):
+        return ChaosPlan.from_dict(source)
+    s = str(source)
+    if s.lstrip().startswith("{"):
+        return ChaosPlan.from_json(s)
+    return ChaosPlan.from_file(s)

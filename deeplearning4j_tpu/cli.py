@@ -439,6 +439,91 @@ def flight_dump_main(argv) -> int:
     return 0
 
 
+def chaos_main(argv) -> int:
+    """``chaos`` subcommand: run the invariant-checked resilience drill
+    matrix (chaos/drills.py), a subset of it, or an operator-supplied
+    declarative fault plan armed around a stock workload. Exit 0 iff
+    every selected drill is green (skips don't fail)."""
+    import json as _json
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu chaos",
+        description="Chaos drills: declarative fault plans × real "
+                    "workloads, judged by the cross-cutting resilience "
+                    "invariants (typed errors, bit-parity where "
+                    "promised, ordered forensics, no torn artifacts, "
+                    "bounded recovery)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the registered seams and drills, then exit")
+    ap.add_argument("--fast", action="store_true",
+                    help="single-fault drills only (the tier-1 subset); "
+                         "default runs paired-fault storms too")
+    ap.add_argument("--drill", action="append", default=None,
+                    help="run only this drill (repeatable)")
+    ap.add_argument("--plan", default=None,
+                    help="a ChaosPlan JSON file (or inline JSON) to arm "
+                         "around --workload instead of the named matrix")
+    ap.add_argument("--workload", default="fit",
+                    help="stock workload for --plan: fit | "
+                         "checkpoint_fit | generate | registry | tune")
+    ap.add_argument("--out", default="BENCH_chaos.json",
+                    help="scorecard JSON path ('' disables the write)")
+    ap.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                    help="force an N-device virtual CPU mesh before jax "
+                         "initializes (the elastic drills need >= 8)")
+    args = ap.parse_args(argv)
+
+    if args.cpu_mesh:
+        import os as _os
+
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{int(args.cpu_mesh)}").strip()
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.chaos import drills, list_seams, load_plan
+
+    if args.list:
+        print("seams:")
+        for s in list_seams():
+            print(f"  {s['seam']:<28} [{s['kind']}/{s['subsystem']}] "
+                  f"{s['description']}")
+        print("drills:")
+        for d in drills.DRILLS.values():
+            tag = "paired" if d.paired else "single"
+            tier = "fast" if d.fast else "slow"
+            print(f"  {d.name:<38} [{tag}/{tier}/{d.workload}] "
+                  f"{d.description}")
+        return 0
+
+    if args.plan:
+        plan = load_plan(args.plan)
+        print(plan.describe(), flush=True)
+        result = drills.run_custom(plan, args.workload)
+        scorecard = {"drills": [result.to_dict()], "n_drills": 1,
+                     "n_green": int(result.ok),
+                     "n_red": int(not result.ok), "n_skipped": 0,
+                     "n_paired": 0,
+                     "silent_corruption_findings":
+                         [c for c in result.checks if not c["ok"]],
+                     "ok": result.ok}
+    else:
+        scorecard = drills.run_matrix(fast_only=args.fast,
+                                      names=args.drill, verbose=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            _json.dump(scorecard, f, indent=1)
+        print(f"scorecard -> {args.out}", flush=True)
+    print(f"chaos: {scorecard['n_green']} green / "
+          f"{scorecard['n_red']} red / {scorecard['n_skipped']} skipped "
+          f"({scorecard['n_paired']} paired-fault)", flush=True)
+    return 0 if scorecard["ok"] else 1
+
+
 def tune_main(argv) -> int:
     """``tune`` subcommand: hyperparameter search over the stock MLP
     factory on a named dataset (tune/ package — Arbiter equivalent).
@@ -586,6 +671,8 @@ def main(argv=None) -> int:
         return tune_main(argv[1:])
     if argv[:1] == ["flight-dump"]:
         return flight_dump_main(argv[1:])
+    if argv[:1] == ["chaos"]:
+        return chaos_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",

@@ -161,9 +161,19 @@ class KernelRegistry:
                 " — transient remote-compile crash, retrying once"
                 if will_retry else "")
 
+        def probing():
+            # chaos seam: mode 'transient_compile' carries the tunnel-
+            # crash signature, so the drill exercises the REAL
+            # probe_with_retry transient-retry path (one crash, then
+            # the genuine probe runs)
+            from deeplearning4j_tpu.chaos import hooks as _chaos
+
+            _chaos.fire("kernel.probe", kernel=name)
+            probe_fn()
+
         ok = False
         try:
-            ok = probe_with_retry(probe_fn, on_fail)
+            ok = probe_with_retry(probing, on_fail)
         finally:
             with self._lock:
                 self._record(name, key, ok,

@@ -78,6 +78,28 @@ class FlightRecorder:
         self._seq = 0
         self.dump_dir = dump_dir
         self.last_dump_path: Optional[str] = None
+        #: event observers (chaos trigger seams, tests). Called AFTER the
+        #: append, outside the ring lock; exceptions are contained.
+        self._observers: List[Callable[[dict], None]] = []
+
+    # -- observers -----------------------------------------------------------
+    def add_observer(self, fn: Callable[[dict], None]) -> Callable[[], None]:
+        """Subscribe ``fn`` to every recorded event (it receives the
+        event dict). Returns the unsubscribe callable. Observers run on
+        the recording thread after the append and outside the ring
+        lock — they may record further events (the chaos ``on_event``
+        seam composes paired faults this way) but must be fast; an
+        observer exception is swallowed with a warning, never allowed
+        to fail the code path that recorded the event."""
+        with self._lock:
+            self._observers.append(fn)
+
+        def remove() -> None:
+            with self._lock:
+                if fn in self._observers:
+                    self._observers.remove(fn)
+
+        return remove
 
     # -- recording -----------------------------------------------------------
     def record(self, kind: str, **fields) -> None:
@@ -87,6 +109,17 @@ class FlightRecorder:
             ev["seq"] = self._seq
             self._seq += 1
             self._ring.append(ev)
+            observers = list(self._observers) if self._observers else None
+        if observers:
+            import warnings
+
+            for fn in observers:
+                try:
+                    fn(ev)
+                except Exception as e:  # noqa: BLE001 — an observer must
+                    # never fail the path that recorded the event
+                    warnings.warn(f"flight observer {fn!r} raised "
+                                  f"{type(e).__name__}: {e}", stacklevel=2)
 
     # -- reading -------------------------------------------------------------
     def __len__(self) -> int:

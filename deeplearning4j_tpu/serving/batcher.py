@@ -41,6 +41,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.chaos import hooks as chaos_hooks
 from deeplearning4j_tpu.serving import rtrace
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -184,6 +185,11 @@ def make_dispatcher(infer: Callable[..., np.ndarray],
                     r.trace.mark("dispatch_start", t_ds)
             try:
                 try:
+                    # chaos seam: injected error ≡ a device/dispatch
+                    # failure, injected delay ≡ a slow dispatch — both
+                    # flow through the same typed completion below
+                    chaos_hooks.fire("serving.batch_dispatch",
+                                     rows=sum(r.rows for r in reqs))
                     out = infer(x, mask)
                 finally:
                     if traced:

@@ -54,12 +54,13 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.chaos import hooks as chaos_hooks
 from deeplearning4j_tpu.serving import rtrace
 from deeplearning4j_tpu.serving.batcher import (
     RequestDeadlineExceeded,
@@ -95,7 +96,8 @@ class GenerationRequest:
 
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
                  "seed", "deadline", "enqueued_at", "trace", "tokens",
-                 "slot", "_event", "_lock", "_stream", "result_", "error_")
+                 "slot", "_event", "_lock", "_stream", "result_", "error_",
+                 "on_done")
 
     def __init__(self, prompt_ids, max_new: int, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
@@ -119,6 +121,12 @@ class GenerationRequest:
         self._stream: "queue.Queue" = queue.Queue()
         self.result_: Optional[np.ndarray] = None
         self.error_: Optional[BaseException] = None
+        #: optional completion observer ``fn(request, error_or_None)``,
+        #: invoked exactly once (first-wins with the completion) AFTER
+        #: the event is set, outside the request lock. The router's
+        #: per-version generation counters — the canary metric gate's
+        #: /generate leg — hang off this.
+        self.on_done: Optional[Callable] = None
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -140,7 +148,8 @@ class GenerationRequest:
                 [self.prompt, np.asarray(self.tokens, np.int32)])
             self._event.set()
             self._stream.put(self._END)
-            return True
+        self._notify(None)
+        return True
 
     def fail(self, error: BaseException) -> bool:
         with self._lock:
@@ -149,7 +158,18 @@ class GenerationRequest:
             self.error_ = error
             self._event.set()
             self._stream.put(self._END)
-            return True
+        self._notify(error)
+        return True
+
+    def _notify(self, error: Optional[BaseException]) -> None:
+        cb = self.on_done
+        if cb is None:
+            return
+        try:
+            cb(self, error)
+        except Exception:  # noqa: BLE001 — an observer must never fail
+            # the completion path (the caller is already unblocked)
+            pass
 
     def stream(self, timeout: Optional[float] = None):
         """Yield token ids as they are decoded; raises the request's
@@ -604,6 +624,10 @@ class GenerationEngine:
         self._dispatch_gen = 0
         self._stall_gen = -1
         self._stall_tripped = False
+        #: identity tags merged into this engine's chaos seam ctx — the
+        #: router tags canary generation engines so a drill can target
+        #: exactly the canary's decode dispatches
+        self.chaos_ctx: Dict[str, object] = {}
         #: EWMA of tokens decoded per finished request — the
         #: Retry-After estimator's occupancy term (a queued request
         #: holds a slot for ~this many steps, not one)
@@ -683,11 +707,17 @@ class GenerationEngine:
     def submit(self, prompt_ids, max_new: int = 20, temperature: float = 0.0,
                top_k: int = 0, top_p: float = 0.0, seed: int = 0,
                timeout: Optional[float] = None,
-               trace: Optional[bool] = None) -> GenerationRequest:
+               trace: Optional[bool] = None,
+               on_done: Optional[Callable] = None) -> GenerationRequest:
         """Enqueue a generation request; returns immediately (consume
         ``req.stream()`` or block on ``req.result()``). Raises the typed
         batcher-vocabulary failures: window overflow, queue-full
-        overload, shutdown."""
+        overload, shutdown. ``on_done`` (``fn(request, error_or_None)``)
+        is installed BEFORE the request is enqueued, so even a
+        completion that races the submit return (instant decode
+        failure, an already-expired deadline) is observed — the
+        router's canary metric gate depends on every completion being
+        counted."""
         from deeplearning4j_tpu.models.transformer_lm import (
             _validate_sampling,
         )
@@ -707,6 +737,7 @@ class GenerationEngine:
             deadline=None if timeout is None
             else time.monotonic() + float(timeout),
             trace=self.trace_requests if trace is None else bool(trace))
+        req.on_done = on_done
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -951,6 +982,13 @@ class GenerationEngine:
         gen = self._dispatch_gen
         self._dispatch_t0 = t0
         try:
+            # chaos seam: error ≡ decode dispatch failure (typed
+            # completion below); delay past the watchdog limit ≡ a hung
+            # dispatch — the sleep happens with _dispatch_t0 stamped, so
+            # the watchdog observes exactly what a wedged device call
+            # looks like
+            chaos_hooks.fire("generate.decode_dispatch",
+                             active=n_active, **self.chaos_ctx)
             toks, keys = self.backend.decode(
                 self._tokens, self._pos, self._active, self._temp,
                 self._topk, self._topp, self._keys)

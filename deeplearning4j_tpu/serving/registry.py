@@ -63,7 +63,6 @@ from __future__ import annotations
 import json
 import math
 import os
-import shutil
 import threading
 import time
 import warnings
@@ -72,6 +71,7 @@ from typing import Callable, Dict, List, Optional
 
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher,
+    RequestDeadlineExceeded,
     ServerOverloadedError,
     ServingError,
     make_dispatcher,
@@ -158,42 +158,55 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._models: Dict[str, dict] = {}
         self._journal_bytes = 0
+        from deeplearning4j_tpu.train.faults import sweep_stale_tmp
+
+        # orphaned staging files from a PRIOR crashed atomic write
+        # (snapshot copies, registry.json stages) are swept — and
+        # counted in a tmp_sweep flight event — on registry-dir open
+        sweep_stale_tmp(self.directory, surface="registry",
+                        recursive=True)
         self._load()
 
     # -- journal / snapshot durability --------------------------------------
     def _append(self, record: dict) -> None:
         """Journal first (fsync'd — the WAL), snapshot second (atomic
         replace). A SIGKILL between the two loses nothing: restart
-        replays the journal past the stale snapshot."""
+        replays the journal past the stale snapshot. The record is
+        folded into in-memory state only AFTER the journal append
+        durably lands — a failed append (disk full: typed StorageError
+        out of the fs layer) leaves memory and disk agreeing on the
+        pre-append state (at worst disk holds a torn trailing line,
+        which replay drops)."""
+        from deeplearning4j_tpu.chaos import fslayer as _fs
+
         with self._lock:
-            self._fold(record)
             line = json.dumps(record, sort_keys=True) + "\n"
-            with open(self.journal_path, "a") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
+            _fs.append_line(self.journal_path, line,
+                            surface="registry_journal")
+            self._fold(record)
             # track the bytes WE have folded, not the file size: the
             # file may already contain another process's un-folded
             # lines (O_APPEND interleaving), and absorbing them into
             # the counter here would make refresh() skip them forever
             self._journal_bytes += len(line.encode())
-            self._write_snapshot()
+            try:
+                self._write_snapshot()
+            except _fs.StorageError as e:
+                # the journal (the WAL) committed; registry.json is a
+                # convenience mirror — a failed rewrite degrades, never
+                # un-publishes (the next successful append refreshes it)
+                warnings.warn(f"registry snapshot write failed "
+                              f"(journal is authoritative): {e}",
+                              stacklevel=2)
 
     def _write_snapshot(self) -> None:
-        from deeplearning4j_tpu.train.faults import atomic_tmp_path
+        from deeplearning4j_tpu.chaos import fslayer as _fs
 
         body = {"schema_version": SCHEMA_VERSION, "written_at": _now(),
                 "models": self._models}
-        tmp = atomic_tmp_path(self.snapshot_path)
-        try:
-            with open(tmp, "w") as f:
-                json.dump(body, f, indent=1, sort_keys=True)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.snapshot_path)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        _fs.write_atomic(self.snapshot_path,
+                         json.dumps(body, indent=1, sort_keys=True),
+                         surface="registry_snapshot")
 
     def _replay(self) -> List[dict]:
         """Journal records in append order — the tune/store.py torn-line
@@ -452,6 +465,8 @@ class ModelRegistry:
         no baseline to canary against); later ones wait for a router to
         canary them.
         """
+        from deeplearning4j_tpu.chaos import fslayer as _fs
+        from deeplearning4j_tpu.chaos import hooks as _chaos
         from deeplearning4j_tpu.obs import flight as _flight
         from deeplearning4j_tpu.serving.engine import (
             resolve_checkpoint_source,
@@ -462,34 +477,58 @@ class ModelRegistry:
         )
 
         path = resolve_checkpoint_source(source)
+        # chaos seam: the held-out validation verdict (mode 'value'
+        # overrides the score — the NaN-poisoned-snapshot drill)
+        _score_spec = _chaos.fire("registry.validation_score", model=name)
+        if _score_spec is not None and _score_spec.mode == "value":
+            score = _score_spec.value
         # stage the copy OUTSIDE the lock: a multi-GB checkpoint copy
         # must not block every registry read (and, through refresh(),
         # every co-located serving submission) for its duration — only
-        # the version assignment and the rename need the lock
+        # the version assignment and the rename need the lock. Disk-full
+        # here (fs layer, injectable) is a typed StorageError with the
+        # staging file cleaned and the live registry untouched.
         stage_dir = os.path.join(self.directory, SNAPSHOTS_SUBDIR, name)
         os.makedirs(stage_dir, exist_ok=True)
         tmp = atomic_tmp_path(os.path.join(stage_dir, "incoming.zip"))
         try:
-            shutil.copyfile(path, tmp)
+            _fs.copy_file(path, tmp, surface="registry_publish")
         except BaseException:
             if os.path.exists(tmp):
                 os.remove(tmp)
             raise
         with self._lock:
-            m = self._model(name)
-            version = int(m["next_version"])
+            # read the next version WITHOUT creating the model entry:
+            # in-memory state must only change when the WAL append
+            # commits (a first-publish whose append fails must not
+            # leave a phantom model that a restart would not replay)
+            existing = self._models.get(name)
+            version = (int(existing["next_version"])
+                       if existing is not None else 1)
             dest = self._snapshot_dest(name, version)
             try:
-                os.replace(tmp, dest)
+                _fs.replace(tmp, dest, surface="registry_publish")
             finally:
                 if os.path.exists(tmp):
                     os.remove(tmp)
             fp = checkpoint_fingerprint(dest)
             baseline = self.best_score(name)
-            self._append({"kind": "publish", "name": name,
-                          "version": version, "path": dest,
-                          "fingerprint": list(fp), "source": str(path),
-                          "iteration": iteration, "ts": _now()})
+            try:
+                self._append({"kind": "publish", "name": name,
+                              "version": version, "path": dest,
+                              "fingerprint": list(fp), "source": str(path),
+                              "iteration": iteration, "ts": _now()})
+            except _fs.StorageError:
+                # the WAL append failed: nothing was folded, so the
+                # copied snapshot would be an orphan the journal never
+                # names — remove it and surface the typed error (the
+                # previously active version keeps serving)
+                try:
+                    os.remove(dest)
+                except OSError:
+                    pass
+                raise
+            m = self._models[name]  # created by the committed fold
             _flight.record("publish", model=name, version=version,
                            source=str(path),
                            score=None if score is None else float(score))
@@ -614,9 +653,14 @@ class ModelRegistry:
 # --------------------------------------------------------------------------
 class _VersionStats:
     """Per-version serving counters — the canary metric gate's inputs.
-    Mirrored into the shared metrics registry as labeled families."""
+    Mirrored into the shared metrics registry as labeled families.
+    Generation traffic keeps its own error/latency columns: a decode
+    request holds a slot for hundreds of tokens, so folding its wall
+    time into the /predict mean would poison the latency comparison —
+    the gate compares generation to generation."""
 
-    __slots__ = ("requests", "errors", "latency_sum", "score", "_n_scores")
+    __slots__ = ("requests", "errors", "latency_sum", "score", "_n_scores",
+                 "gen_requests", "gen_errors", "gen_latency_sum")
 
     def __init__(self):
         self.requests = 0
@@ -624,9 +668,16 @@ class _VersionStats:
         self.latency_sum = 0.0
         self.score: Optional[float] = None
         self._n_scores = 0
+        self.gen_requests = 0
+        self.gen_errors = 0
+        self.gen_latency_sum = 0.0
 
     def mean_latency(self) -> Optional[float]:
         return self.latency_sum / self.requests if self.requests else None
+
+    def mean_gen_latency(self) -> Optional[float]:
+        return (self.gen_latency_sum / self.gen_requests
+                if self.gen_requests else None)
 
     def observe_score(self, value: float) -> None:
         # running mean: scores arrive from probes / external evaluators
@@ -673,8 +724,14 @@ class _VersionedEngine:
             trace_requests=router.trace_requests)
 
     def _infer(self, x, mask=None):
+        from deeplearning4j_tpu.chaos import hooks as _chaos
+
         t0 = time.monotonic()
         try:
+            # chaos seam with deployment identity: drills target exactly
+            # the canary's dispatches via match={"role": "canary"}
+            _chaos.fire("registry.version_dispatch", model=self.name,
+                        version=self.version, role=self.role)
             out, _snap_version = self.engine.infer_versioned(x, mask)
         except BaseException as e:
             self.stats.errors += 1
@@ -730,6 +787,14 @@ class _ManagedModel:
         self.canary_counter = 0
         self.canary_inflight: deque = deque()
         self.generation = None  # lazy GenerationEngine
+        #: canary-version GenerationEngine (built lazily at the first
+        #: /generate while a canary window is open) — canary_fraction of
+        #: generation traffic decodes on the candidate weights so its
+        #: errors/latency feed the metric gate (the PR 11 residue:
+        #: generation-only regressions must still trip auto-rollback)
+        self.canary_generation = None
+        self.canary_gen_failed = False  # build failed once: don't retry
+        self.gen_counter = 0
         self.last_used = time.monotonic()
         #: set by LRU eviction. Engines are retired but the references
         #: stay valid, so a thread that grabbed this object before the
@@ -877,6 +942,11 @@ class ModelRouter:
             if mm.generation is not None:
                 gen, mm.generation = mm.generation, None
                 threading.Thread(target=gen.shutdown, daemon=True).start()
+            if mm.canary_generation is not None:
+                cgen, mm.canary_generation = mm.canary_generation, None
+                threading.Thread(target=cgen.shutdown,
+                                 kwargs={"drain": False},
+                                 daemon=True).start()
             if mm.canary is not None:
                 # eviction is capacity pressure, not a verdict: the
                 # canary record stays in the registry and resumes on
@@ -976,12 +1046,20 @@ class ModelRouter:
         out = req.result(timeout=timeout)
         return out, req.model_version
 
-    def generation_for(self, model: str):
-        """The model's continuous-batching generation engine (lazily
-        built over the ACTIVE version's model; canary routing applies to
-        /predict — generation always serves the promoted version).
-        Raises TypeError when the model has no incremental-decode path,
-        ValueError when the router was built with ``gen_slots=0``."""
+    def _build_generation(self, base_model, name: str, version: int,
+                          role: str):
+        from deeplearning4j_tpu.serving.generate import GenerationEngine
+        from deeplearning4j_tpu.serving.metrics import GenerationMetrics
+
+        gen = GenerationEngine(base_model, n_slots=self.gen_slots,
+                               max_length=self.gen_max_length,
+                               metrics=GenerationMetrics(),
+                               traces=self.traces)
+        gen.chaos_ctx = {"model": name, "version": int(version),
+                         "role": role}
+        return gen
+
+    def _managed_for_generation(self, model: str) -> _ManagedModel:
         if self.gen_slots <= 0:
             raise ValueError(
                 "router built without generation slots (gen_slots=0)")
@@ -991,21 +1069,139 @@ class ModelRouter:
                 mm = None
         if mm is None:
             mm = self.managed(model)  # raced an eviction: re-admit
-        with mm.lock:
-            if mm.generation is None:
-                from deeplearning4j_tpu.serving.generate import (
-                    GenerationEngine,
-                )
-                from deeplearning4j_tpu.serving.metrics import (
-                    GenerationMetrics,
-                )
+        return mm
 
-                mm.generation = GenerationEngine(
-                    mm.active.engine.model, n_slots=self.gen_slots,
-                    max_length=self.gen_max_length,
-                    metrics=GenerationMetrics(),
-                    traces=self.traces)
-            return mm.generation
+    def generation_for(self, model: str):
+        """The model's continuous-batching generation engine (lazily
+        built over the ACTIVE version's model). Raises TypeError when
+        the model has no incremental-decode path, ValueError when the
+        router was built with ``gen_slots=0``. Canary-aware generation
+        submission goes through :meth:`generation_submit` — this
+        accessor always returns the active-version engine."""
+        mm = self._managed_for_generation(model)
+        with mm.lock:
+            return self._ensure_generation(mm)
+
+    def _ensure_generation(self, mm: _ManagedModel):
+        # caller holds mm.lock
+        if mm.generation is None:
+            mm.generation = self._build_generation(
+                mm.active.engine.model, mm.name, mm.active.version,
+                "active")
+        return mm.generation
+
+    def _ensure_canary_generation(self, mm: _ManagedModel):
+        """The canary version's own generation engine (caller holds
+        ``mm.lock``): built+warmed lazily at the first /generate of an
+        open window — the same pay-once-at-adoption trade
+        ``_maybe_adopt`` documents for the predict engine. A model
+        whose candidate cannot decode (arch change) records the fact
+        once and serves generation from the active version only (the
+        canary then needs /predict traffic to promote)."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        if mm.canary is None or mm.canary_gen_failed:
+            return None
+        if mm.canary_generation is None:
+            try:
+                gen = self._build_generation(
+                    mm.canary.engine.model, mm.name, mm.canary.version,
+                    "canary")
+                gen.warmup()
+            except Exception as e:  # noqa: BLE001 — a candidate that
+                # cannot even build its decode slab must not take down
+                # generation serving; it simply gets no generation
+                # traffic (and no generation votes in the gate)
+                mm.canary_gen_failed = True
+                _flight.record("canary_generation_unavailable",
+                               model=mm.name, version=mm.canary.version,
+                               error=type(e).__name__,
+                               message=str(e)[:200])
+                return None
+            mm.canary_generation = gen
+        return mm.canary_generation
+
+    def generation_submit(self, model: str, prompt_ids, **kwargs):
+        """Submit one generation request with canary routing: while a
+        canary window is open, ``canary_fraction`` of the model's
+        /generate traffic decodes on the candidate version's own
+        engine, and EVERY generation completion (either version) feeds
+        the per-version ``registry_version_gen_*`` counters the metric
+        gate reads — so a snapshot that only regresses under generation
+        traffic still trips auto-rollback (the PR 11 residue). Returns
+        the :class:`~.generate.GenerationRequest`."""
+        mm = self._managed_for_generation(model)
+        with mm.lock:
+            self._maybe_adopt(mm)
+            self._maybe_promote(mm)
+            gen = self._ensure_generation(mm)
+            ve = mm.active
+            if mm.canary is not None and self.canary_fraction > 0:
+                cgen = self._ensure_canary_generation(mm)
+                if cgen is not None:
+                    mm.gen_counter += 1
+                    every = max(int(round(1.0 / self.canary_fraction)), 1)
+                    if mm.gen_counter % every == 0:
+                        gen, ve = cgen, mm.canary
+        # the observer rides in through submit so it is installed
+        # BEFORE the request is enqueued — a completion racing the
+        # submit return (instant canary decode failure, already-expired
+        # deadline) must still be counted by the metric gate
+        t0 = time.monotonic()
+        return gen.submit(prompt_ids,
+                          on_done=self._make_gen_observer(model, ve, t0),
+                          **kwargs)
+
+    def _make_gen_observer(self, name: str, ve: _VersionedEngine,
+                           t0: float):
+        from deeplearning4j_tpu.serving.batcher import (
+            ServerShutdownError,
+        )
+
+        def on_done(req, error):
+            dt = time.monotonic() - t0
+            if error is None:
+                ve.stats.gen_requests += 1
+                ve.stats.gen_latency_sum += dt
+                self._counter("registry_version_gen_requests_total",
+                              name, ve.version).inc()
+                self._counter(
+                    "registry_version_gen_latency_seconds_total",
+                    name, ve.version).inc(dt)
+                if ve.role == "canary":
+                    # off-thread: on_done runs on the decode worker
+                    # UNDER the engine's device lock, and a promotion
+                    # here does journal fsyncs + a snapshot rewrite —
+                    # disk I/O that must not stall every decode slot.
+                    # (The error-path trip below stays inline: it is
+                    # terminal for these slots anyway and must be
+                    # prompt.)
+                    threading.Thread(target=self._evaluate_canary,
+                                     args=(name,), daemon=True,
+                                     name=f"canary-eval-{name}").start()
+                return
+            if isinstance(error, (ServerShutdownError,
+                                  ServerOverloadedError,
+                                  CanaryRolledBackError)):
+                return  # admission/lifecycle, not the version's fault
+            ve.stats.gen_errors += 1
+            self._counter("registry_version_gen_errors_total",
+                          name, ve.version).inc()
+            if ve.role != "canary" or ve.dead:
+                return
+            if isinstance(error, RequestDeadlineExceeded):
+                # a caller-side deadline is ambiguous (tight client
+                # timeout vs slow canary) — count it and let the
+                # latency/score legs decide
+                self._evaluate_canary(name)
+            else:
+                # decode failure / watchdog stall on the candidate:
+                # the bad version must not get more traffic
+                self._trip(name, ve,
+                           f"generation dispatch failure: "
+                           f"{type(error).__name__}")
+
+        return on_done
 
     # -- canary state machine ------------------------------------------------
     def _maybe_adopt(self, mm: _ManagedModel) -> None:
@@ -1131,12 +1327,32 @@ class ModelRouter:
                                f"{al * 1e3:.1f}ms "
                                f"(x{self.latency_trip_mult:g} gate)")
                     return
-            # promotion: bounded window elapsed, enough canary traffic,
+            # generation latency gate — generation compares only to
+            # generation (a decode request spans hundreds of tokens;
+            # mixing it into the /predict mean would be meaningless)
+            if (active is not None
+                    and ve.stats.gen_requests
+                    >= self.latency_trip_min_samples
+                    and active.stats.gen_requests
+                    >= self.latency_trip_min_samples):
+                cl = ve.stats.mean_gen_latency()
+                al = active.stats.mean_gen_latency()
+                if cl is not None and al and cl > self.latency_trip_mult * al:
+                    self._trip(name, ve,
+                               f"generation latency regressed: canary "
+                               f"{cl * 1e3:.1f}ms vs active "
+                               f"{al * 1e3:.1f}ms "
+                               f"(x{self.latency_trip_mult:g} gate)")
+                    return
+            # promotion: bounded window elapsed, enough canary traffic
+            # (predict AND generation requests both count — a model
+            # serving only /generate must still be able to promote),
             # nothing tripped
             if (mm.canary_started is not None
                     and time.monotonic() - mm.canary_started
                     >= self.canary_window_s
-                    and ve.stats.requests >= self.canary_min_requests):
+                    and ve.stats.requests + ve.stats.gen_requests
+                    >= self.canary_min_requests):
                 self._promote(mm)
 
     def _maybe_promote(self, mm: _ManagedModel) -> None:
@@ -1160,6 +1376,7 @@ class ModelRouter:
             self.registry.promote(mm.name, ve.version)
             _flight.record("promote", model=mm.name, version=ve.version,
                            requests=ve.stats.requests,
+                           gen_requests=ve.stats.gen_requests,
                            mean_latency_ms=None
                            if ve.stats.mean_latency() is None
                            else round(ve.stats.mean_latency() * 1e3, 2))
@@ -1167,7 +1384,20 @@ class ModelRouter:
                 # drain: in-flight old-version requests all complete —
                 # the no-mixing/no-dropping guarantee under promotion
                 old.retire(drain=True)
-            self._sync_generation(mm, old)
+            if mm.canary_generation is not None:
+                # the canary's warmed decode engine IS the promoted
+                # version's engine — adopt it (already on the new
+                # weights, zero recompiles) and retire the old one
+                old_gen, mm.generation = mm.generation, mm.canary_generation
+                mm.canary_generation = None
+                mm.canary_gen_failed = False
+                mm.generation.chaos_ctx["role"] = "active"
+                if old_gen is not None:
+                    threading.Thread(target=old_gen.shutdown,
+                                     daemon=True).start()
+            else:
+                mm.canary_gen_failed = False
+                self._sync_generation(mm, old)
 
     def _sync_generation(self, mm: _ManagedModel,
                          old: Optional[_VersionedEngine]) -> None:
@@ -1208,10 +1438,21 @@ class ModelRouter:
             ve.dead = True
             mm.canary = None
             mm.canary_started = None
+            if mm.canary_generation is not None:
+                # fail the candidate's in-flight generation requests
+                # typed and tear its slab down off-thread (shutdown
+                # joins the decode worker)
+                cgen, mm.canary_generation = mm.canary_generation, None
+                threading.Thread(target=cgen.shutdown,
+                                 kwargs={"drain": False},
+                                 daemon=True).start()
+            mm.canary_gen_failed = False
             _flight.record("regression_trip", model=name,
                            version=ve.version, reason=reason,
                            canary_requests=ve.stats.requests,
-                           canary_errors=ve.stats.errors)
+                           canary_errors=ve.stats.errors,
+                           canary_gen_requests=ve.stats.gen_requests,
+                           canary_gen_errors=ve.stats.gen_errors)
             err = CanaryRolledBackError(
                 f"{name} v{ve.version} rolled back: {reason}; retry — "
                 "the active version is serving")
@@ -1288,18 +1529,26 @@ class ModelRouter:
             mm = self._live.get(name)
             if mm is None:
                 continue
+            # detach under mm.lock, tear down OUTSIDE it: shutdown
+            # joins engine workers, and a canary completion observer
+            # running ON such a worker takes mm.lock
+            # (_evaluate_canary/_trip) — joining it while holding the
+            # lock would deadlock. Synchronous drains are fine here
+            # (shutdown runs on a caller thread, never a worker).
             with mm.lock:
-                if mm.generation is not None:
-                    mm.generation.shutdown(drain=True)
-                    mm.generation = None
-                # synchronous drain here (shutdown runs on a caller
-                # thread, never a batcher worker)
-                if mm.canary is not None:
-                    mm.canary.dead = True
-                    mm.canary.batcher.shutdown(drain=True)
-                    mm.canary = None
-                if mm.active is not None:
-                    mm.active.batcher.shutdown(drain=True)
-                    mm.active = None
+                gen, mm.generation = mm.generation, None
+                cgen, mm.canary_generation = mm.canary_generation, None
+                canary, mm.canary = mm.canary, None
+                active, mm.active = mm.active, None
+                if canary is not None:
+                    canary.dead = True
+            if cgen is not None:
+                cgen.shutdown(drain=False)
+            if gen is not None:
+                gen.shutdown(drain=True)
+            if canary is not None:
+                canary.batcher.shutdown(drain=True)
+            if active is not None:
+                active.batcher.shutdown(drain=True)
         with self._lock:
             self._live.clear()

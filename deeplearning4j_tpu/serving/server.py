@@ -503,9 +503,17 @@ def _make_handler(server: InferenceServer):
             ``{"error": ...}`` chunk (the status line is already on the
             wire)."""
             gen = server.generation
+            submit = None if gen is None else gen.submit
             if model is not None:
                 try:
+                    # the active engine (timeout defaults + 409 checks);
+                    # submission routes through the router so canary
+                    # versions get their generation-traffic slice and
+                    # every completion feeds the per-version gate
                     gen = server.router.generation_for(model)
+                    submit = (lambda *a, **kw:
+                              server.router.generation_submit(model, *a,
+                                                              **kw))
                 except (TypeError, ValueError) as e:
                     # no incremental-decode path / gen_slots=0: the
                     # model cannot generate — a route conflict, not a
@@ -528,7 +536,7 @@ def _make_handler(server: InferenceServer):
             timeout_s = (None if timeout_ms is None
                          else float(timeout_ms) / 1e3)
             want_trace = payload.get("trace")
-            req = gen.submit(
+            req = submit(
                 prompt,
                 max_new=int(payload.get("max_new", 20)),
                 temperature=float(payload.get("temperature", 0.0)),

@@ -432,25 +432,56 @@ def checkpoint_files(directory: str) -> List[str]:
 _TMP_SWEEP_AGE_S = 900.0  # staging files older than this are crash debris
 
 
+def sweep_stale_tmp(directory: str,
+                    max_age_s: float = _TMP_SWEEP_AGE_S,
+                    surface: Optional[str] = None,
+                    recursive: bool = False) -> List[str]:
+    """Remove orphaned ``.tmp-`` staging files left by a PRIOR crashed
+    atomic write; returns the swept paths. Called when an artifact
+    directory is (re)opened — CheckpointListener, ModelRegistry,
+    TrialStore — and from retention pruning. Only files older than
+    ``max_age_s`` are debris: a younger one may belong to a concurrent
+    writer about to ``os.replace`` it. Sweeps are counted in a
+    ``tmp_sweep`` flight event so crash debris is visible in the black
+    box rather than silently accumulating (or silently vanishing)."""
+    import time
+
+    removed: List[str] = []
+    if not os.path.isdir(directory):
+        return removed
+    now = time.time()
+    if recursive:
+        walk = ((root, files) for root, _d, files in os.walk(directory))
+    else:
+        walk = [(directory, os.listdir(directory))]
+    for root, names in walk:
+        for name in names:
+            if _TMP_MARKER not in name:
+                continue
+            p = os.path.join(root, name)
+            try:
+                if (os.path.isfile(p)
+                        and now - os.path.getmtime(p) > max_age_s):
+                    os.remove(p)
+                    removed.append(p)
+            except OSError:
+                pass
+    if removed:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("tmp_sweep", directory=str(directory),
+                       count=len(removed),
+                       surface=surface or "checkpoint")
+    return removed
+
+
 def prune_checkpoints(directory: str, keep_last: Optional[int]
                       ) -> List[str]:
     """Delete all but the newest ``keep_last`` checkpoints; returns the
     removed paths. Staging temp files are swept only once they are
     clearly crash debris (older than ``_TMP_SWEEP_AGE_S``) — a younger
     one may belong to a concurrent writer about to os.replace it."""
-    import time
-
-    removed: List[str] = []
-    now = time.time()
-    for name in os.listdir(directory):
-        if _TMP_MARKER in name:
-            p = os.path.join(directory, name)
-            try:
-                if now - os.path.getmtime(p) > _TMP_SWEEP_AGE_S:
-                    os.remove(p)
-                    removed.append(p)
-            except OSError:
-                pass
+    removed: List[str] = list(sweep_stale_tmp(directory))
     if keep_last is None:
         return removed
     files = checkpoint_files(directory)

@@ -375,6 +375,11 @@ class CheckpointListener(TrainingListener):
             )
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        from deeplearning4j_tpu.train.faults import sweep_stale_tmp
+
+        # orphaned staging files from a PRIOR crashed atomic write are
+        # swept (and counted in a tmp_sweep flight event) on dir open
+        sweep_stale_tmp(directory, surface="checkpoint")
         self.save_every_n_epochs = save_every_n_epochs
         self.save_every_n_iterations = save_every_n_iterations
         self.save_every_minutes = save_every_minutes

@@ -33,6 +33,7 @@ NORMALIZER_ENTRY = "normalizer.bin"
 class ModelSerializer:
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True, normalizer=None) -> None:
+        from deeplearning4j_tpu.chaos import fslayer as _fs
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
         from deeplearning4j_tpu.obs import trace as _trace
         from deeplearning4j_tpu.train.faults import atomic_tmp_path
@@ -43,26 +44,41 @@ class ModelSerializer:
         sync = getattr(model, "_opt_state_sync", None)
         if sync is not None:
             sync()
-        # crash-safe: stage into a same-directory temp file and publish
-        # with an atomic rename — a crash/SIGKILL mid-write leaves the
-        # previous checkpoint at ``path`` untouched, never a torn zip
+        # crash-safe: stage into a same-directory temp file, fsync it,
+        # and publish with an atomic rename — a crash/SIGKILL mid-write
+        # leaves the previous checkpoint at ``path`` untouched, never a
+        # torn zip, and the rename never publishes un-synced bytes. A
+        # FAILED write (disk full, failed fsync/replace — injectable via
+        # the chaos fs seams) raises typed StorageError with the staging
+        # file cleaned up and the previous checkpoint still loadable.
         tmp = atomic_tmp_path(path)
         try:
             # span: checkpoint writes show up in profiler traces as their
             # own box (they gather device state and hit disk — a classic
             # hidden stall between training dispatches)
-            with _trace.span("checkpoint_write"), \
-                    zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
-                z.writestr(CONFIG_ENTRY, model.conf.to_json())
-                z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
-                if save_updater and model.opt_state_ is not None:
-                    z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
-                state_flat = _flatten_state(model.state_)
-                z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
-                z.writestr(META_ENTRY, json.dumps(_build_meta(model)))
-                if normalizer is not None:
-                    z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
-            os.replace(tmp, path)
+            try:
+                with _trace.span("checkpoint_write"), \
+                        zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+                    from deeplearning4j_tpu.chaos import hooks as _chaos
+
+                    _chaos.fire("fs.write", path=str(tmp),
+                                surface="checkpoint")
+                    z.writestr(CONFIG_ENTRY, model.conf.to_json())
+                    z.writestr(COEFFICIENTS_ENTRY, model.params_flat().astype("<f4").tobytes())
+                    if save_updater and model.opt_state_ is not None:
+                        z.writestr(UPDATER_ENTRY, model.opt_state_flat().astype("<f4").tobytes())
+                    state_flat = _flatten_state(model.state_)
+                    z.writestr(STATE_ENTRY, state_flat.astype("<f4").tobytes())
+                    z.writestr(META_ENTRY, json.dumps(_build_meta(model)))
+                    if normalizer is not None:
+                        z.writestr(NORMALIZER_ENTRY, json.dumps(normalizer.to_dict()))
+            except OSError as e:
+                if isinstance(e, _fs.StorageError):
+                    raise
+                raise _fs.storage_error("write", tmp, "checkpoint", e) \
+                    from e
+            _fs.fsync_path(tmp, surface="checkpoint")
+            _fs.replace(tmp, path, surface="checkpoint")
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)

@@ -46,21 +46,23 @@ class TrialStore:
         self.journal_path = os.path.join(directory, JOURNAL_NAME)
         self.meta_path = os.path.join(directory, META_NAME)
         self._lock = threading.Lock()  # pool-engine threads share one store
+        from deeplearning4j_tpu.train.faults import sweep_stale_tmp
+
+        # orphaned staging files from a PRIOR crashed atomic write are
+        # swept (and counted in a tmp_sweep flight event) on store open
+        sweep_stale_tmp(directory, surface="tune")
 
     # ------------------------------------------------------------- study meta
     def write_meta(self, meta: dict) -> None:
-        from deeplearning4j_tpu.train.faults import atomic_tmp_path
+        """Atomic ``study.json`` publish. Disk-full / failed fsync /
+        failed replace (injectable via the chaos fs seams) raise typed
+        :class:`~deeplearning4j_tpu.chaos.fslayer.StorageError` with the
+        staging file cleaned and any previous meta intact."""
+        from deeplearning4j_tpu.chaos import fslayer as _fs
 
-        tmp = atomic_tmp_path(self.meta_path)
-        try:
-            with open(tmp, "w") as f:
-                json.dump(meta, f, indent=2, sort_keys=True)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.meta_path)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        _fs.write_atomic(self.meta_path,
+                         json.dumps(meta, indent=2, sort_keys=True),
+                         surface="tune_meta")
 
     def read_meta(self) -> Optional[dict]:
         if not os.path.exists(self.meta_path):
@@ -70,12 +72,12 @@ class TrialStore:
 
     # ---------------------------------------------------------------- journal
     def append(self, record: dict) -> None:
+        from deeplearning4j_tpu.chaos import fslayer as _fs
+
         line = json.dumps(record, sort_keys=True)
         with self._lock:
-            with open(self.journal_path, "a") as f:
-                f.write(line + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+            _fs.append_line(self.journal_path, line + "\n",
+                            surface="tune_journal")
 
     def replay(self) -> List[dict]:
         """Journal records in append order. A torn FINAL line (the one a

@@ -582,12 +582,15 @@ class TestParallelInferenceRegressions:
               .queue_limit(2).max_wait_ms(0).build())
         held = [threading.Thread(target=lambda i=i: pi.output(_rows(1, seed=i)))
                 for i in range(3)]
-        for t in held:
-            t.start()
         # deterministic overload state (a fixed sleep flakes under box
-        # load): the worker must be BLOCKED inside the dispatch and the
-        # queue must hold the other two requests before the probe
-        assert entered.wait(10)
+        # load, and starting all three at once races the queue_limit=2
+        # bound against the worker's dequeue — under contention a SETUP
+        # thread can absorb the 503 meant for the probe): first occupy
+        # the worker, THEN fill the queue with the other two
+        held[0].start()
+        assert entered.wait(10)  # worker is BLOCKED inside the dispatch
+        for t in held[1:]:
+            t.start()
         deadline = time.monotonic() + 10
         while (pi._batcher.queue_depth() < 2
                and time.monotonic() < deadline):
