@@ -1570,6 +1570,10 @@ def _bench_chaos():
         "paired_floor_3": scorecard["n_paired"] >= 3,
         "zero_silent_corruption":
             not scorecard["silent_corruption_findings"],
+        # ISSUE 14: the lock witness rides every drill; an
+        # acquisition-order cycle anywhere in the matrix is an ABBA
+        # deadlock pattern waiting for the right schedule
+        "zero_lock_cycles": scorecard.get("lock_cycles", 0) == 0,
     }
     result = {
         "metric": "chaos_drills_green",

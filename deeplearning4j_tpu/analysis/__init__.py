@@ -1,0 +1,38 @@
+"""Static invariant analysis: the review-found defect classes as code.
+
+Every review round of PRs 6-13 hand-caught the same four defect
+classes; this package turns those hard-won rules into an AST-based
+linter (stdlib ``ast``, zero new dependencies) that gates tier-1:
+
+- ``durability-unsynced-replace`` / ``durability-bypass-fslayer`` —
+  an ``os.replace`` of un-fsynced bytes can publish an empty file
+  after power loss, and durable-surface writes in serving/train/tune
+  must route through ``chaos/fslayer.py`` (typed StorageError + chaos
+  seams).
+- ``typed-errors-bare-raise`` / ``typed-errors-broad-except`` —
+  production paths never raise bare builtin exceptions or swallow
+  broadly without re-raise/acknowledgement (the chaos invariant
+  taxonomy, enforced statically).
+- ``trace-host-sync`` / ``trace-probe-jnp`` — host-sync calls inside
+  jitted step bodies and ``jnp`` input construction inside kernel
+  probes (the PR 12 tracer bug class).
+- ``event-schema`` — every ``flight.record``/``chaos_hooks.fire``
+  name must be declared in ``obs/events.py``, from which the
+  ARCHITECTURE tables regenerate.
+
+Entry points: ``cli lint`` (human + ``--json``), ``run_lint`` (the
+library call the tier-1 gate test uses), ``LINT_BASELINE.json`` at the
+repo root (explicitly triaged pre-existing findings; stale entries
+expire loudly).
+"""
+
+from deeplearning4j_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintReport,
+    lint_paths,
+    run_lint,
+)
+from deeplearning4j_tpu.analysis.baseline import (  # noqa: F401
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,101 @@
+"""Baseline suppression: pre-existing findings, triaged explicitly.
+
+The baseline file (``LINT_BASELINE.json`` at the repo root) is the
+reviewed list of findings the tree consciously carries — each entry
+records the fingerprint, where it was when triaged, the offending line
+text, and WHY it is acceptable. Semantics:
+
+- **add**: a finding whose fingerprint appears in the baseline is
+  suppressed (reported separately, never failing the gate). New
+  entries land only through review — ``cli lint --write-baseline``
+  regenerates the file from the current findings so the diff shows
+  exactly what is being accepted.
+- **expire**: an entry that matched nothing is STALE and fails the
+  gate. Either the finding was fixed (delete the entry) or the code
+  changed enough that the fingerprint moved (re-triage). Silent rot —
+  a baseline suppressing ghosts — is exactly what review-found rule
+  lists die of.
+
+Fingerprints are line-number-independent (see ``core``), so unrelated
+edits never churn the file.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Sequence, Tuple
+
+BASELINE_NAME = "LINT_BASELINE.json"
+_VERSION = 1
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as fh:
+        body = json.load(fh)
+    if not isinstance(body, dict) or "entries" not in body:
+        raise ValueError(
+            f"baseline {path!r} is not a {{version, entries}} object")
+    version = body.get("version")
+    if version != _VERSION:
+        raise ValueError(f"baseline {path!r} has version {version!r}; "
+                         f"this analyzer reads version {_VERSION}")
+    entries = body["entries"]
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path!r} entries is not a list")
+    for e in entries:
+        if not isinstance(e, dict) or "fingerprint" not in e:
+            raise ValueError(
+                f"baseline {path!r} entry without a fingerprint: {e!r}")
+    return entries
+
+
+def apply_baseline(findings: Sequence, entries: Sequence[dict]
+                   ) -> Tuple[list, list, List[dict]]:
+    """Split findings into (active, suppressed) and report stale
+    entries. One entry suppresses exactly one finding occurrence —
+    fingerprints already carry an occurrence index, so N identical
+    lines need N reviewed entries."""
+    by_fp: Dict[str, dict] = {}
+    for e in entries:
+        by_fp[str(e["fingerprint"])] = e
+    matched = set()
+    active, suppressed = [], []
+    for f in findings:
+        if f.fingerprint in by_fp:
+            matched.add(f.fingerprint)
+            suppressed.append(f)
+        else:
+            active.append(f)
+    stale = [e for e in entries if str(e["fingerprint"]) not in matched]
+    return active, suppressed, stale
+
+
+def write_baseline(path: str, findings: Sequence,
+                   reasons: Dict[str, str] = None) -> dict:
+    """Regenerate the baseline from the given findings (the triage
+    helper behind ``cli lint --write-baseline``). ``reasons`` maps
+    fingerprints to triage notes; unmapped entries get a placeholder
+    the reviewer is expected to replace."""
+    reasons = reasons or {}
+    body = {
+        "version": _VERSION,
+        "generated": time.strftime("%Y-%m-%d"),
+        "comment": ("Explicitly triaged pre-existing lint findings. "
+                    "Entries suppress exactly one finding each; an "
+                    "entry whose finding is gone goes STALE and fails "
+                    "the gate until removed (see ARCHITECTURE "
+                    "# Static analysis)."),
+        "entries": [
+            {"fingerprint": f.fingerprint, "rule": f.rule,
+             "path": f.path, "line": f.line, "text": f.text,
+             "reason": reasons.get(f.fingerprint,
+                                   "TODO: reviewed-and-accepted because "
+                                   "<why>")}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(body, fh, indent=1)
+        fh.write("\n")
+    return body

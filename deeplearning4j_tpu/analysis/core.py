@@ -1,0 +1,248 @@
+"""Linter core: findings, the rule registry, tree walking, reports.
+
+A rule is a callable ``(ctx: FileContext) -> Iterable[Finding]``
+registered under a dotted rule id. ``lint_paths`` parses each ``.py``
+file once and hands the same AST to every rule; ``run_lint`` layers the
+baseline (suppression) semantics on top and produces the
+:class:`LintReport` the CLI, the drive script and the tier-1 gate test
+all consume.
+
+Fingerprints are deliberately line-number-independent: the SHA-1 of
+``rule : relpath : stripped-source-line : occurrence-index``. A finding
+keeps its identity when unrelated edits move it, so baselines don't rot
+with every refactor — but when the offending LINE changes or goes away,
+the baseline entry goes stale and the lint fails until the entry is
+removed (expiry is explicit, never silent).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+#: directories never walked (caches, VCS internals)
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules"}
+
+
+class Finding:
+    """One rule violation, anchored to ``path:line``."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "text",
+                 "fingerprint")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, text: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.text = text
+        self.fingerprint = ""  # assigned by lint_paths (needs occurrence)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "text": self.text, "fingerprint": self.fingerprint}
+
+    def __repr__(self):
+        return f"{self.location()}: {self.rule}: {self.message}"
+
+
+class FileContext:
+    """Everything a rule needs about one source file, parsed once."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.AST):
+        self.path = path
+        #: repo-root-relative, '/'-separated (stable across platforms,
+        #: what fingerprints and baselines store)
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        #: path segments, for scope checks ("serving" in ctx.parts)
+        self.parts = tuple(self.relpath.split("/"))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.relpath, line, col, message,
+                       text=self.line_text(line).strip())
+
+
+#: rule id -> (description, fn)
+RULES: "Dict[str, tuple]" = {}
+
+
+def register_rule(rule_id: str, description: str):
+    """Decorator registering a rule engine under ``rule_id``."""
+
+    def wrap(fn: Callable[[FileContext], Iterable[Finding]]):
+        RULES[rule_id] = (description, fn)
+        return fn
+
+    return wrap
+
+
+def _load_rules() -> None:
+    # importing the rule modules populates RULES (idempotent)
+    from deeplearning4j_tpu.analysis import (  # noqa: F401
+        rules_durability,
+        rules_events,
+        rules_trace,
+        rules_typed,
+    )
+
+
+def iter_python_files(root: str,
+                      paths: Optional[Sequence[str]] = None):
+    """Yield (abspath, relpath) for every ``.py`` under ``root`` (or
+    under the explicit ``paths``, which may be files or directories,
+    absolute or root-relative)."""
+    root = os.path.abspath(root)
+    if paths:
+        tops = [p if os.path.isabs(p) else os.path.join(root, p)
+                for p in paths]
+    else:
+        tops = [root]
+    for top in tops:
+        if os.path.isfile(top):
+            yield top, os.path.relpath(top, root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    full = os.path.join(dirpath, name)
+                    yield full, os.path.relpath(full, root)
+
+
+def _assign_fingerprints(findings: List[Finding]) -> None:
+    seen: Dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        key = (f.rule, f.path, f.text)
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        raw = f"{f.rule}:{f.path}:{f.text}:{occ}"
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+
+def lint_paths(root: str, paths: Optional[Sequence[str]] = None,
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the (selected) rules over every python file under ``root``;
+    returns fingerprinted findings sorted by location. A file that does
+    not parse is itself a finding (rule ``parse-error``) — an analyzer
+    that silently skips unparseable code would gate nothing."""
+    _load_rules()
+    chosen = RULES if rules is None else {
+        r: RULES[r] for r in rules}  # KeyError on an unknown rule id is
+    # a caller bug surfaced loudly, matching run_matrix's typed refusal
+    findings: List[Finding] = []
+    for full, rel in iter_python_files(root, paths):
+        try:
+            with open(full, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            findings.append(Finding("parse-error", rel, 1, 0,
+                                    f"unreadable: {e}"))
+            continue
+        try:
+            tree = ast.parse(source, filename=full)
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", rel,
+                                    e.lineno or 1, e.offset or 0,
+                                    f"syntax error: {e.msg}"))
+            continue
+        ctx = FileContext(full, rel, source, tree)
+        for rule_id, (_desc, fn) in chosen.items():
+            findings.extend(fn(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    _assign_fingerprints(findings)
+    return findings
+
+
+class LintReport:
+    """The gate's verdict: active findings fail; baseline-suppressed
+    ones pass; stale baseline entries (matched nothing) ALSO fail —
+    a fixed finding must be removed from the baseline, so the file
+    only ever shrinks through explicit review."""
+
+    def __init__(self, active: List[Finding], suppressed: List[Finding],
+                 stale: List[dict], root: str, baseline_path: str = ""):
+        self.active = active
+        self.suppressed = suppressed
+        self.stale = stale
+        self.root = root
+        self.baseline_path = baseline_path
+
+    @property
+    def ok(self) -> bool:
+        return not self.active and not self.stale
+
+    @property
+    def exit_code(self) -> int:
+        return 0 if self.ok else 1
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "root": self.root,
+            "baseline": self.baseline_path,
+            "active": [f.to_dict() for f in self.active],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "stale_baseline_entries": list(self.stale),
+            "counts": {"active": len(self.active),
+                       "suppressed": len(self.suppressed),
+                       "stale": len(self.stale)},
+        }
+
+    def format(self, verbose: bool = False) -> str:
+        lines: List[str] = []
+        for f in self.active:
+            lines.append(f"{f.location()}: {f.rule}: {f.message}")
+        for entry in self.stale:
+            lines.append(
+                f"{entry.get('path', '?')}: stale-baseline: entry "
+                f"{entry.get('fingerprint')} ({entry.get('rule')}) "
+                "matched nothing — the finding is gone; remove the "
+                "entry from the baseline")
+        if verbose:
+            for f in self.suppressed:
+                lines.append(f"{f.location()}: suppressed({f.rule}): "
+                             f"{f.message}")
+        lines.append(
+            f"lint: {len(self.active)} finding(s), "
+            f"{len(self.suppressed)} baseline-suppressed, "
+            f"{len(self.stale)} stale baseline entr"
+            f"{'y' if len(self.stale) == 1 else 'ies'} -> "
+            f"{'OK' if self.ok else 'FAIL'}")
+        return "\n".join(lines)
+
+
+def run_lint(root: str, paths: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             rules: Optional[Sequence[str]] = None) -> LintReport:
+    """Lint + baseline: the one call behind ``cli lint``, the drive
+    script and the tier-1 gate test."""
+    from deeplearning4j_tpu.analysis import baseline as bl
+
+    findings = lint_paths(root, paths, rules=rules)
+    if baseline_path and os.path.exists(baseline_path):
+        entries = bl.load_baseline(baseline_path)
+    else:
+        entries = []
+    active, suppressed, stale = bl.apply_baseline(findings, entries)
+    return LintReport(active, suppressed, stale, os.path.abspath(root),
+                      baseline_path or "")

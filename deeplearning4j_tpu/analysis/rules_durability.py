@@ -1,0 +1,138 @@
+"""Durability rules: atomic publishes must be of fsynced bytes, and
+durable surfaces must route through the injectable fs layer.
+
+The defect class (caught by hand in PR 13 review, now codified):
+``os.replace`` of a file whose bytes were never fsynced can publish an
+EMPTY artifact after power loss — the rename is durable before the
+data is. And any write on a durable surface (checkpoints, registry
+journals/snapshots, tune stores — everything under serving/, train/,
+tune/) that bypasses ``chaos/fslayer.py`` silently opts out of typed
+StorageError handling, the chaos seams, and the torn-tail repair
+discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from deeplearning4j_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    register_rule,
+)
+
+#: the fs layer itself and its tests legitimately touch os.replace
+_FSLAYER_SUFFIX = "chaos/fslayer.py"
+
+#: call names that count as a durability barrier for the staged bytes
+_FSYNC_NAMES = {"fsync", "fsync_file", "fsync_path", "write_atomic"}
+
+#: packages whose writes are durable surfaces (the artifacts a crash
+#: drill replays): serving registry/snapshots, train checkpoints, tune
+#: stores
+_DURABLE_DIRS = {"serving", "train", "tune"}
+
+#: modes that create/overwrite an artifact. 'r+'/'rb+' in-place
+#: patching is deliberately NOT flagged: that is the torn-tail-repair /
+#: fault-injection idiom, and fslayer.repair_torn_tail itself owns the
+#: durable cases
+_WRITE_MODES = set("wax")
+
+
+def _is_os_replace(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute)
+            and fn.attr in ("replace", "rename")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "os")
+
+
+def _calls_fsync_before(scope: ast.AST, lineno: int) -> bool:
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        if getattr(node, "lineno", 10**9) >= lineno:
+            continue
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name.lstrip("_") in _FSYNC_NAMES:
+            return True
+    return False
+
+
+def _enclosing_scopes(tree: ast.AST):
+    """Yield (function-or-module scope, node) pairs for every node,
+    innermost scope first at lookup time (computed as a parent map)."""
+    parents = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+
+    def innermost(node):
+        cur = parents.get(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            cur = parents.get(cur)
+        return cur
+
+    return innermost
+
+
+@register_rule(
+    "durability-unsynced-replace",
+    "os.replace/os.rename must be preceded by an fsync of the staged "
+    "bytes in the same function (or routed through chaos/fslayer)")
+def check_unsynced_replace(ctx: FileContext) -> Iterable[Finding]:
+    if ctx.relpath.endswith(_FSLAYER_SUFFIX):
+        return []
+    findings: List[Finding] = []
+    innermost = _enclosing_scopes(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_os_replace(node):
+            scope = innermost(node) or ctx.tree
+            if not _calls_fsync_before(scope, node.lineno):
+                findings.append(ctx.finding(
+                    "durability-unsynced-replace", node,
+                    "os.replace of bytes never fsynced in this "
+                    "function — a power loss after the rename can "
+                    "publish an empty file; fsync the staged fd "
+                    "(or use chaos/fslayer.replace after "
+                    "fsync_file/fsync_path)"))
+    return findings
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Name) and fn.id == "open"):
+        return False
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(_WRITE_MODES & set(mode.value))
+    return False
+
+
+@register_rule(
+    "durability-bypass-fslayer",
+    "write-mode open() on a durable surface (serving/train/tune) must "
+    "route through chaos/fslayer (open_for_write / append_line / "
+    "write_atomic)")
+def check_bypass_fslayer(ctx: FileContext) -> Iterable[Finding]:
+    if not (_DURABLE_DIRS & set(ctx.parts)):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _open_write_mode(node):
+            findings.append(ctx.finding(
+                "durability-bypass-fslayer", node,
+                "direct write-mode open() on a durable surface "
+                "bypasses the typed-StorageError/chaos-seam fs layer; "
+                "use chaos/fslayer.open_for_write, append_line or "
+                "write_atomic"))
+    return findings

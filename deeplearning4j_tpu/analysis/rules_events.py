@@ -1,0 +1,82 @@
+"""Event-schema rule: every flight event kind and chaos fire point is
+declared in ``obs/events.py``.
+
+The chaos invariant checker asserts event ORDER against documented
+state machines; that only works if the names are right. A typo'd
+``flight.record`` kind silently breaks a forensic subsequence check
+months later, and an undeclared kind is an event nobody documented.
+The declared schema is also what the ARCHITECTURE flight-event table
+regenerates from, so passing this rule means the docs cover the code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from deeplearning4j_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    register_rule,
+)
+
+#: receiver spellings that mean "the flight recorder" at a
+#: ``X.record("kind", ...)`` call site across the repo
+_RECORDER_NAMES = {"flight", "_flight", "rec", "recorder"}
+#: and "the chaos hooks module" at ``X.fire("point", ...)``
+_HOOKS_NAMES = {"hooks", "chaos_hooks", "_chaos", "_hooks"}
+
+
+def _literal_first_arg(call: ast.Call):
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _recv_matches(fn: ast.Attribute, names: set, attr_alias: str) -> bool:
+    v = fn.value
+    if isinstance(v, ast.Name) and v.id in names:
+        return True
+    # self.recorder.record(...) / ctx.hooks.fire(...) style
+    if isinstance(v, ast.Attribute) and v.attr == attr_alias:
+        return True
+    return False
+
+
+@register_rule(
+    "event-schema",
+    "flight.record kinds and chaos_hooks.fire points must be declared "
+    "in obs/events.py (the table ARCHITECTURE regenerates from)")
+def check_event_schema(ctx: FileContext) -> Iterable[Finding]:
+    from deeplearning4j_tpu.obs import events as schema
+
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        fn = node.func
+        if fn.attr == "record" and _recv_matches(fn, _RECORDER_NAMES,
+                                                 "recorder"):
+            kind = _literal_first_arg(node)
+            if kind is not None and not schema.is_declared_event(kind):
+                findings.append(ctx.finding(
+                    "event-schema", node,
+                    f"flight event kind {kind!r} is not declared in "
+                    "obs/events.py FLIGHT_EVENTS — declare it (one "
+                    "entry: producer + description) so the forensic "
+                    "subsequence checks and the ARCHITECTURE table "
+                    "cover it"))
+        elif fn.attr == "fire" and _recv_matches(fn, _HOOKS_NAMES,
+                                                 "hooks"):
+            point = _literal_first_arg(node)
+            if point is not None \
+                    and not schema.is_declared_hook_point(point):
+                findings.append(ctx.finding(
+                    "event-schema", node,
+                    f"chaos hook point {point!r} is not declared in "
+                    "obs/events.py HOOK_POINTS — declare it (and "
+                    "register_hook_seam it in chaos/seams.py) so "
+                    "plans can address it"))
+    return findings

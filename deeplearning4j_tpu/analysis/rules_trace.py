@@ -1,0 +1,180 @@
+"""Trace-safety rules: the PR 12 tracer bug class, codified.
+
+Two defects this catches:
+
+- **Host syncs inside jitted step bodies** (``float()``, ``.item()``,
+  ``np.asarray``, ``jax.device_get``, ``.block_until_ready``): under
+  ``jit`` these force a device round-trip per dispatch — exactly the
+  per-step syncs the pipelined training loop removed — or fail outright
+  under an ambient trace.
+- **``jnp`` input construction inside kernel probes**: a probe's inputs
+  built with ``jnp`` become TRACERS when the probe runs under an
+  ambient trace, and the AOT-compiled probe executables reject them
+  (the latent flash-attention probe bug PR 12 found and fixed). Probe
+  inputs must be numpy.
+
+Jitted bodies are found statically: defs decorated with ``jit`` /
+``jax.jit`` / ``partial(jax.jit, ...)``, plus local defs passed to a
+``jax.jit(...)`` / ``jit(...)`` call anywhere in the module (including
+through ``jax.value_and_grad`` / ``partial`` wrappers) — the repo's
+dominant idiom is ``def step(...): ...; return jax.jit(step)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from deeplearning4j_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    register_rule,
+)
+
+_NP_NAMES = {"np", "numpy", "onp"}
+_NP_SYNC_FNS = {"asarray", "array"}
+_JNP_CTORS = {"array", "asarray", "ones", "zeros", "full", "arange",
+              "linspace", "eye", "empty", "ones_like", "zeros_like",
+              "full_like"}
+
+
+def _is_jit_callable(fn: ast.AST) -> bool:
+    """`jit` / `jax.jit` / `pjit` / `jax.pjit` as an expression."""
+    if isinstance(fn, ast.Name):
+        return fn.id in ("jit", "pjit")
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("jit", "pjit")
+    return False
+
+
+def _collect_jitted_names(tree: ast.AST) -> Set[str]:
+    """Names of functions that end up under jit in this module."""
+    names: Set[str] = set()
+
+    def first_name_arg(call: ast.Call):
+        # unwrap jax.jit(X), jax.jit(partial(X,...)),
+        # jax.jit(jax.value_and_grad(X)), nested combinations
+        if not call.args:
+            return None
+        arg = call.args[0]
+        while isinstance(arg, ast.Call):
+            if not arg.args:
+                return None
+            arg = arg.args[0]
+        return arg.id if isinstance(arg, ast.Name) else None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_callable(node.func):
+            name = first_name_arg(node)
+            if name:
+                names.add(name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...) or @jax.jit(...)
+                    if (isinstance(dec.func, ast.Name)
+                            and dec.func.id == "partial" and dec.args):
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if _is_jit_callable(target):
+                    names.add(node.name)
+    return names
+
+
+def _static_shape_math(call: ast.Call) -> bool:
+    """float(x.shape[0]) / float(len(xs)) style trace-time constants."""
+    for sub in ast.walk(call):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape",
+                                                           "ndim",
+                                                           "size",
+                                                           "dtype"):
+            return True
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                and sub.func.id == "len"):
+            return True
+        if sub is not call and isinstance(sub, ast.Constant):
+            return True
+    return False
+
+
+def _host_sync_kind(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "float":
+        if node.args and not _static_shape_math(node):
+            return "float() host read"
+        return ""
+    if isinstance(fn, ast.Attribute):
+        if fn.attr == "item":
+            return ".item() host read"
+        if fn.attr == "block_until_ready":
+            return ".block_until_ready() host sync"
+        if fn.attr == "device_get":
+            return "jax.device_get host transfer"
+        if (fn.attr in _NP_SYNC_FNS and isinstance(fn.value, ast.Name)
+                and fn.value.id in _NP_NAMES):
+            return f"{fn.value.id}.{fn.attr} device->host copy"
+    return ""
+
+
+@register_rule(
+    "trace-host-sync",
+    "no host-sync calls (float()/.item()/np.asarray/device_get) inside "
+    "jitted step bodies")
+def check_host_sync(ctx: FileContext) -> Iterable[Finding]:
+    jitted = _collect_jitted_names(ctx.tree)
+    if not jitted:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in jitted):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    kind = _host_sync_kind(sub)
+                    if kind:
+                        findings.append(ctx.finding(
+                            "trace-host-sync", sub,
+                            f"{kind} inside jitted body "
+                            f"{node.name!r} forces a device "
+                            "round-trip per dispatch (or breaks "
+                            "under an ambient trace); compute it "
+                            "in-graph or outside the step"))
+    return findings
+
+
+def _is_probe_def(node: ast.AST) -> bool:
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    return (node.name.startswith("_probe") or node.name == "probe"
+            or node.name.startswith("probe_"))
+
+
+@register_rule(
+    "trace-probe-jnp",
+    "kernel probes (nn/ops) build inputs with numpy, never jnp — jnp "
+    "values become tracers under an ambient trace and AOT probe "
+    "executables reject them")
+def check_probe_jnp(ctx: FileContext) -> Iterable[Finding]:
+    if "ops" not in ctx.parts:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not _is_probe_def(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if (isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "jnp"
+                    and fn.attr in _JNP_CTORS):
+                findings.append(ctx.finding(
+                    "trace-probe-jnp", sub,
+                    f"probe input built with jnp.{fn.attr} becomes a "
+                    "TRACER under an ambient trace and the AOT probe "
+                    "executable rejects it (the PR 12 flash-probe "
+                    "bug); build probe inputs with numpy"))
+    return findings

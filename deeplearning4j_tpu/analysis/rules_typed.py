@@ -1,0 +1,152 @@
+"""Typed-error rules: the chaos invariant taxonomy, enforced statically.
+
+The dynamic half (``chaos/invariants.check_typed_errors``) asserts that
+no caller-visible error is a bare KeyError/AttributeError/… — an
+implementation detail leaking where a typed verdict belongs. These
+rules stop the leak at the ``raise`` site and catch its dual: a broad
+``except`` that swallows everything without re-raising or at least an
+explicit, reviewed acknowledgement.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from deeplearning4j_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    register_rule,
+)
+
+#: builtins that may never be raised bare in production code. ValueError
+#: / TypeError / RuntimeError / OSError / NotImplementedError stay legal:
+#: they are the documented caller-contract verdicts (see
+#: chaos/invariants.typed_error_bases) — the banned set is the
+#: implementation-detail leaks.
+_BANNED_RAISES = {
+    "Exception", "BaseException", "KeyError", "IndexError",
+    "AttributeError", "StopIteration", "StopAsyncIteration",
+    "ZeroDivisionError", "UnboundLocalError",
+}
+
+#: dunder protocols where the bare builtin IS the contract
+_PROTOCOL_FUNCS = {
+    "__getattr__": {"AttributeError"},
+    "__getattribute__": {"AttributeError"},
+    "__delattr__": {"AttributeError"},
+    "__getitem__": {"KeyError", "IndexError"},
+    "__setitem__": {"KeyError", "IndexError"},
+    "__delitem__": {"KeyError", "IndexError"},
+    "__missing__": {"KeyError"},
+    "__next__": {"StopIteration"},
+    "__anext__": {"StopAsyncIteration"},
+    # the DL4J iterator API: `def next(self)` backs `__next__`, so
+    # StopIteration there IS the protocol, not a leak
+    "next": {"StopIteration"},
+}
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _raised_name(node: ast.Raise) -> str:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    return ""
+
+
+def _is_property_def(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Name) and dec.id == "property":
+            return True
+        if isinstance(dec, ast.Attribute) and dec.attr in (
+                "setter", "getter", "deleter"):
+            return True
+    return False
+
+
+@register_rule(
+    "typed-errors-bare-raise",
+    "production code raises typed errors from the project taxonomy, "
+    "never bare builtin exceptions (KeyError/AttributeError/...)")
+def check_bare_raise(ctx: FileContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, func_stack: tuple) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func_stack = func_stack + (node,)
+        elif isinstance(node, ast.Raise):
+            name = _raised_name(node)
+            if name in _BANNED_RAISES:
+                fn = func_stack[-1] if func_stack else None
+                allowed = set()
+                if fn is not None:
+                    allowed = _PROTOCOL_FUNCS.get(fn.name, set())
+                    if _is_property_def(fn):
+                        # AttributeError from a property getter is the
+                        # hasattr() protocol
+                        allowed = allowed | {"AttributeError"}
+                if name not in allowed:
+                    findings.append(ctx.finding(
+                        "typed-errors-bare-raise", node,
+                        f"bare {name} leaks an implementation detail; "
+                        "raise a typed error (subclass "
+                        f"{name} if dict-/attr-compat matters, like "
+                        "UnknownModelError does)"))
+        for child in ast.iter_child_nodes(node):
+            visit(child, func_stack)
+
+    visit(ctx.tree, ())
+    return findings
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare `except:`
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in _BROAD for n in names)
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+@register_rule(
+    "typed-errors-broad-except",
+    "bare/broad except without re-raise must carry an explicit "
+    "trailing acknowledgement comment (e.g. '# noqa: BLE001 — why')")
+def check_broad_except(ctx: FileContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if _reraises(node):
+            continue
+        if node.type is None:
+            # bare `except:` also swallows SystemExit/KeyboardInterrupt
+            # — never acceptable, comment or not
+            findings.append(ctx.finding(
+                "typed-errors-broad-except", node,
+                "bare `except:` swallows SystemExit/KeyboardInterrupt "
+                "too; catch Exception at most, re-raise, or narrow"))
+            continue
+        if "#" in ctx.line_text(node.lineno):
+            continue  # explicit, reviewed acknowledgement on the line
+        findings.append(ctx.finding(
+            "typed-errors-broad-except", node,
+            "broad except swallows without re-raise or "
+            "acknowledgement; narrow it, re-raise typed, or annotate "
+            "the except line with a trailing comment saying why "
+            "swallowing is safe (the repo idiom: "
+            "'# noqa: BLE001 — <reason>')"))
+    return findings

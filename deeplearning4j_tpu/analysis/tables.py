@@ -1,0 +1,45 @@
+"""Doc-table regeneration from the declared schema.
+
+ARCHITECTURE.md embeds the flight-event and chaos-fire-point tables
+between marker comments; these renderers produce the exact block, and
+a test asserts the docs match — the table can only change by changing
+``obs/events.py``, which the ``event-schema`` lint rule ties to the
+actual call sites. ``cli lint --events-table`` prints the block for
+pasting.
+"""
+
+from __future__ import annotations
+
+EVENT_TABLE_BEGIN = "<!-- BEGIN generated flight-event table " \
+    "(obs/events.py; cli lint --events-table) -->"
+EVENT_TABLE_END = "<!-- END generated flight-event table -->"
+
+
+def render_event_table() -> str:
+    from deeplearning4j_tpu.obs import events
+
+    lines = [EVENT_TABLE_BEGIN, "",
+             "| event kind | producer | meaning |", "|---|---|---|"]
+    for kind, (producer, desc) in events.FLIGHT_EVENTS.items():
+        lines.append(f"| `{kind}` | `{producer}` | {desc} |")
+    lines += ["", "| chaos fire point | producer | meaning |",
+              "|---|---|---|"]
+    for point, (producer, desc) in events.HOOK_POINTS.items():
+        lines.append(f"| `{point}` | `{producer}` | {desc} |")
+    lines += ["", EVENT_TABLE_END]
+    return "\n".join(lines)
+
+
+def render_drill_table() -> str:
+    """The chaos drill matrix as markdown (from the live DRILLS
+    registry — heavier import; not used by the lint fast path)."""
+    from deeplearning4j_tpu.chaos.drills import DRILLS
+
+    lines = ["| drill | workload | seam(s) | paired | tier |",
+             "|---|---|---|---|---|"]
+    for d in DRILLS.values():
+        lines.append(
+            f"| {d.name} | {d.workload} | {', '.join(d.seams)} | "
+            f"{'yes' if d.paired else 'no'} | "
+            f"{'fast' if d.fast else 'slow'} |")
+    return "\n".join(lines)

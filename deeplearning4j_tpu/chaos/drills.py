@@ -29,6 +29,7 @@ import numpy as np
 from deeplearning4j_tpu.chaos import hooks, invariants
 from deeplearning4j_tpu.chaos.fslayer import StorageError
 from deeplearning4j_tpu.chaos.plan import ChaosPlan
+from deeplearning4j_tpu.obs import lockwitness
 
 N_IN, N_HID, N_OUT = 4, 6, 3
 
@@ -208,8 +209,15 @@ def run_drill(name: str) -> DrillResult:
     ctx = DrillContext(name)
     t0 = time.monotonic()
     error = skipped = None
+    # lock witness rides every drill in observe mode: an
+    # acquisition-order cycle anywhere under fault pressure is recorded
+    # (+ lock_cycle flight event) and fails the drill's invariants
+    # below, without turning a latent inversion into a mid-drill crash
+    # of an unrelated code path
+    cycles0 = len(lockwitness.cycles())
     try:
-        d.fn(ctx)
+        with lockwitness.armed(strict=False):
+            d.fn(ctx)
     except DrillSkipped as e:
         skipped = str(e)
     except BaseException as e:  # noqa: BLE001 — a crashed drill is RED
@@ -225,6 +233,10 @@ def run_drill(name: str) -> DrillResult:
         invariants.check_deadline(
             ctx.report, ctx.recovery_s if ctx.recovery_s is not None
             else wall, d.deadline_s)
+        new_cycles = lockwitness.cycles()[cycles0:]
+        ctx.report.add(
+            "no_lock_cycles", not new_cycles,
+            "; ".join("->".join(c["cycle"]) for c in new_cycles[:3]))
     ok = skipped is None and error is None and ctx.report.ok
     return DrillResult(name, ok, ctx.report.to_dict(), wall,
                        recovery_s=ctx.recovery_s, error=error,
@@ -247,6 +259,12 @@ def run_matrix(fast_only: bool = False,
         chosen = list(names)
     else:
         chosen = [n for n in DRILLS if not fast_only or DRILLS[n].fast]
+    # fresh witness state per matrix: the per-inversion-pair dedupe
+    # would otherwise suppress a STILL-LIVE inversion already recorded
+    # by an earlier armed run in this process, and the scorecard's
+    # delta would read a false 0
+    lockwitness.reset()
+    matrix_cycles0 = len(lockwitness.cycles())
     results = []
     for n in chosen:
         if verbose:
@@ -278,6 +296,10 @@ def run_matrix(fast_only: bool = False,
         "n_paired": sum(1 for r in results
                         if not r.skipped and DRILLS[r.name].paired),
         "silent_corruption_findings": silent,
+        #: acquisition-order cycles the lock witness saw across the
+        #: whole matrix (every drill runs under it); the bench gate and
+        #: the ISSUE 14 acceptance require 0
+        "lock_cycles": len(lockwitness.cycles()) - matrix_cycles0,
         "ok": all(r.ok or r.skipped for r in results),
     }
 
@@ -951,7 +973,16 @@ def drill_paired_watchdog_trip_during_canary(ctx: DrillContext):
         mm = router._managed_for_generation("lm")
         with mm.lock:
             router._maybe_adopt(mm)
-            cgen = router._ensure_canary_generation(mm)
+            spec = (None if mm.canary is None
+                    else (mm.canary.engine.model, mm.canary.version))
+            if spec is not None:
+                mm.canary_gen_building = True
+        # build+warm runs with NO locks held (ISSUE 14: building under
+        # mm.lock closed a lock-order cycle against the decode worker)
+        if spec is not None:
+            router._build_canary_generation(mm, *spec)
+        with mm.lock:
+            cgen = mm.canary_generation
         ctx.report.add("canary_generation_built", cgen is not None)
         if cgen is not None:
             cgen.watchdog_min_s = 0.3

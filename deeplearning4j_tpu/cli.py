@@ -439,6 +439,97 @@ def flight_dump_main(argv) -> int:
     return 0
 
 
+def lint_main(argv) -> int:
+    """``lint`` subcommand: run the invariant analyzer
+    (deeplearning4j_tpu/analysis) over the package — the static half of
+    the chaos contract. Exit 0 iff no active finding AND no stale
+    baseline entry."""
+    import json as _json
+    import os as _os
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu lint",
+        description="AST invariant linter: durability (fsync-before-"
+                    "replace, fslayer routing), typed errors, trace "
+                    "safety (host syncs in jitted bodies, jnp in "
+                    "probes), event schema",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint (default: the "
+                         "installed deeplearning4j_tpu package)")
+    ap.add_argument("--root", default=None,
+                    help="tree root findings are reported relative to "
+                         "(default: the package's parent, i.e. the "
+                         "repo root)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline suppression file (default: "
+                         "LINT_BASELINE.json next to the package; "
+                         "--no-baseline disables)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also list baseline-suppressed findings")
+    ap.add_argument("--write-baseline", default=None, metavar="PATH",
+                    help="triage helper: write the current ACTIVE "
+                         "findings as a fresh baseline to PATH (review "
+                         "the diff; reasons start as TODO)")
+    ap.add_argument("--events-table", action="store_true",
+                    help="print the generated flight-event/seam table "
+                         "(the block ARCHITECTURE.md embeds) and exit")
+    args = ap.parse_args(argv)
+
+    if args.events_table:
+        from deeplearning4j_tpu.analysis.tables import render_event_table
+
+        print(render_event_table())
+        return 0
+
+    import deeplearning4j_tpu as _pkg
+    from deeplearning4j_tpu.analysis import run_lint
+    from deeplearning4j_tpu.analysis.baseline import (
+        BASELINE_NAME,
+        write_baseline,
+    )
+
+    pkg_dir = _os.path.dirname(_os.path.abspath(_pkg.__file__))
+    root = _os.path.abspath(args.root) if args.root else \
+        _os.path.dirname(pkg_dir)
+    paths = args.paths or [pkg_dir]
+    baseline = None
+    if not args.no_baseline:
+        baseline = args.baseline or _os.path.join(root, BASELINE_NAME)
+    report = run_lint(root, paths, baseline_path=baseline)
+
+    if args.write_baseline:
+        from deeplearning4j_tpu.analysis.baseline import load_baseline
+
+        # regenerate over ALL current findings — active AND already-
+        # suppressed — carrying forward the reviewed reasons, so
+        # pointing --write-baseline at the live baseline adds the new
+        # entries instead of silently discarding the triaged ones
+        reasons = {}
+        if baseline and _os.path.exists(baseline):
+            reasons = {str(e["fingerprint"]): e["reason"]
+                       for e in load_baseline(baseline)
+                       if "reason" in e}
+        all_findings = sorted(report.active + report.suppressed,
+                              key=lambda f: (f.path, f.line, f.rule))
+        write_baseline(args.write_baseline, all_findings, reasons)
+        n_new = len(report.active)
+        print(f"wrote {len(all_findings)} entr"
+              f"{'y' if len(all_findings) == 1 else 'ies'} to "
+              f"{args.write_baseline} ({n_new} new — fill in the TODO "
+              "reasons)")
+        return 0
+    if args.json:
+        print(_json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.format(verbose=args.verbose))
+    return report.exit_code
+
+
 def chaos_main(argv) -> int:
     """``chaos`` subcommand: run the invariant-checked resilience drill
     matrix (chaos/drills.py), a subset of it, or an operator-supplied
@@ -673,6 +764,8 @@ def main(argv=None) -> int:
         return flight_dump_main(argv[1:])
     if argv[:1] == ["chaos"]:
         return chaos_main(argv[1:])
+    if argv[:1] == ["lint"]:
+        return lint_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",

@@ -229,7 +229,7 @@ def _ff_kwargs(node: dict) -> dict:
 def _is_nan(v) -> bool:
     try:
         return v != v
-    except Exception:
+    except Exception:  # noqa: BLE001 — exotic value type; not NaN
         return False
 
 

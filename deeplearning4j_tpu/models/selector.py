@@ -34,12 +34,19 @@ ZOO: Dict[str, Type[ZooModel]] = {
 }
 
 
+class UnknownZooModelError(KeyError):
+    """Requested zoo model name is not registered. Subclasses
+    ``KeyError`` for dict-style handler compat; typed so production
+    callers never see a bare builtin."""
+
+
 class ModelSelector:
     @staticmethod
     def select(name: str, **kwargs) -> ZooModel:
         key = name.lower()
         if key not in ZOO:
-            raise KeyError(f"Unknown zoo model '{name}'; available: {sorted(ZOO)}")
+            raise UnknownZooModelError(
+                f"Unknown zoo model '{name}'; available: {sorted(ZOO)}")
         return ZOO[key](**kwargs)
 
     @staticmethod

@@ -10,6 +10,17 @@ from __future__ import annotations
 import os
 from typing import Optional, Sequence
 
+
+def _fsync_path(path: str) -> None:
+    """Durability barrier for a downloaded ``.part`` before its atomic
+    promote — the ``chaos/fslayer`` discipline, local so the zoo stays
+    importable without the chaos package's flight plumbing."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
 CACHE_DIR = os.environ.get(
     "DL4J_TPU_DATA", os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu")
 )
@@ -159,6 +170,7 @@ class ZooModel:
                 # Range past EOF: the .part already holds the whole file
                 # (crash between read loop and rename) — promote it; the
                 # caller's checksum gate validates the bytes
+                _fsync_path(part)
                 os.replace(part, dest)
                 return
             raise ConnectionError(
@@ -172,6 +184,10 @@ class ZooModel:
                 f"If this environment has no egress, stage the artifact "
                 f"at {dest} manually (partial progress kept at {part})."
             ) from e
+        # fsync the downloaded bytes before the atomic publish: a power
+        # loss after the rename must never leave an empty cache entry
+        # the checksum gate would have to re-download anyway
+        _fsync_path(part)
         os.replace(part, dest)
 
     def init_pretrained(self, dataset: str = "imagenet",

@@ -107,7 +107,7 @@ class BasicLineIterator(SentenceIterator):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except Exception:  # noqa: BLE001 — __del__ must never raise
             pass
 
 

@@ -464,7 +464,7 @@ class ListBuilder:
             for l in layers:
                 try:
                     l.initialize(InputType.feed_forward(l.n_in) if getattr(l, "n_in", None) else None)  # type: ignore
-                except Exception:
+                except Exception:  # noqa: BLE001 — best-effort shape inference; build() validates for real
                     pass
         return MultiLayerConfiguration(
             global_conf=self._g,

@@ -237,7 +237,7 @@ def _flash_attention_route(q, k, causal, mask, dropout_rate,
 
         if _j.default_backend() != "tpu":
             return None
-    except Exception:
+    except Exception:  # noqa: BLE001 — no usable jax backend means no kernel
         return None
     T = q.shape[2]
     if k.shape[2] != T or T < 128 or T % 128:

@@ -152,7 +152,7 @@ def _updater_slot_count(layer) -> int:
     probe = np.zeros((1,), np.float32)
     try:
         return len(upd.init_state(probe))
-    except Exception:
+    except Exception:  # noqa: BLE001 — exotic updater; assume the Adam-like 2 slots
         return 2
 
 

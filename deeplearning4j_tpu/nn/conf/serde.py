@@ -17,6 +17,12 @@ from typing import Any, Callable, Dict
 _CLASSES: Dict[str, type] = {}
 
 
+class UnknownConfigClassError(KeyError):
+    """An ``@class`` tag resolves to no registered config class.
+    Subclasses ``KeyError`` so existing dict-style handlers keep
+    working while the typed-error taxonomy names the failure."""
+
+
 def register(cls: type) -> type:
     _CLASSES[cls.__name__] = cls
     return cls
@@ -32,7 +38,8 @@ def lookup(name: str) -> type:
         # same lazy self-registration contract for the obs package
         import deeplearning4j_tpu.obs.telemetry  # noqa: F401
     if name not in _CLASSES:
-        raise KeyError(f"Unknown config class '{name}'. Registered: {sorted(_CLASSES)}")
+        raise UnknownConfigClassError(
+            f"Unknown config class '{name}'. Registered: {sorted(_CLASSES)}")
     return _CLASSES[name]
 
 

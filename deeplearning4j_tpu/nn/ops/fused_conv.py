@@ -545,12 +545,21 @@ def fused_conv_available(dtype=jnp.bfloat16) -> bool:
 
     def probe():
         rng = np.random.default_rng(0)
-        x2 = jnp.asarray(rng.standard_normal((64, 128)), dtype)
-        s = jnp.asarray(rng.standard_normal(128) * 0.2 + 1.0, jnp.float32)
-        t = jnp.asarray(rng.standard_normal(128) * 0.1, jnp.float32)
-        w2 = jnp.asarray(rng.standard_normal((128, 128)) * 0.05, dtype)
-        x4 = jnp.asarray(rng.standard_normal((1, 8, 8, 128)), dtype)
-        w4 = jnp.asarray(rng.standard_normal((3, 3, 128, 128)) * 0.05, dtype)
+
+        def mk(shape, scale=1.0, shift=0.0, dt=dtype):
+            # numpy (never jnp): under an ambient trace jnp.asarray
+            # stages into the caller's graph and the AOT executables
+            # below would be handed tracers instead of concrete
+            # buffers — the exact latent bug the flash probe had
+            return np.asarray(rng.standard_normal(shape) * scale + shift,
+                              np.float32).astype(jnp.dtype(dt))
+
+        x2 = mk((64, 128))
+        s = mk(128, 0.2, 1.0, jnp.float32)
+        t = mk(128, 0.1, 0.0, jnp.float32)
+        w2 = mk((128, 128), 0.05)
+        x4 = mk((1, 8, 8, 128))
+        w4 = mk((3, 3, 128, 128), 0.05)
 
         def loss(fn):
             def f(x, s, t, w):

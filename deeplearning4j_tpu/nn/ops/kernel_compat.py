@@ -49,7 +49,7 @@ def probe_with_retry(probe, on_fail, retry_delay_s: float = 2.0):
         try:
             probe()
             return True
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — ANY probe failure selects the fallback, reported via on_fail
             will_retry = attempt == 0 and is_transient_compile_error(e)
             on_fail(e, will_retry)
             if not will_retry:

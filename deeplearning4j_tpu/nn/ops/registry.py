@@ -100,7 +100,7 @@ class KernelRegistry:
             return True
         try:
             backend = jax.default_backend()
-        except Exception:
+        except Exception:  # noqa: BLE001 — backend query failed: treat as non-TPU
             backend = "unknown"
         if backend != "tpu":
             self.disable(name, key,

@@ -143,11 +143,11 @@ def compiled_analysis(jitted_fn, *args, **kwargs) -> Dict[str, object]:
         return {"error": f"{type(e).__name__}: {e}"}
     try:
         out.update(_normalize_cost(compiled.cost_analysis()))
-    except Exception as e:
+    except Exception as e:  # noqa: BLE001 — absent analysis keys are reported, never fatal
         out["cost_error"] = f"{type(e).__name__}: {e}"
     try:
         mem = compiled.memory_analysis()
-    except Exception as e:
+    except Exception as e:  # noqa: BLE001 — absent analysis keys are reported, never fatal
         mem = None
         out["memory_error"] = f"{type(e).__name__}: {e}"
     if mem is not None:

@@ -1,0 +1,213 @@
+"""The declared flight-event and chaos-seam schema — one authoritative
+table.
+
+Every ``flight.record("<kind>", ...)`` call site in production code and
+every ``chaos_hooks.fire("<point>", ...)`` seam must use a name declared
+here; the static analyzer (``deeplearning4j_tpu/analysis``, rule
+``event-schema``) enforces it, the way the chaos invariant checker
+enforces the *dynamic* half (event ORDER against the documented state
+machines). An undeclared event name is either a typo that would silently
+break a forensic subsequence check, or a new event that was never
+documented — both are findings.
+
+The ARCHITECTURE.md flight-event table is REGENERATED from this module
+(``cli lint --events-table``; ``analysis.tables.render_event_table``),
+so docs can never drift from the code: a new event lands by adding one
+entry here, and the lint gate fails until it does.
+
+Stdlib-only on purpose: the analyzer imports this module without
+touching jax or any production subsystem.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: kind -> (producer module, one-line description).
+#: Grouped by subsystem in declaration order; the rendered table keeps
+#: this order.
+FLIGHT_EVENTS: Dict[str, tuple] = {
+    # -- training loop (obs/flight.py listener, train/faults.py) ----------
+    "step": ("obs/flight.py",
+             "one optimizer step completed (loss attached on "
+             "loss_frequency boundaries)"),
+    "bundle": ("obs/flight.py",
+               "one steps_per_call=K scan dispatch completed (it0, k, "
+               "sampled loss)"),
+    "epoch_start": ("obs/flight.py", "fit entered an epoch"),
+    "epoch_end": ("obs/flight.py", "fit finished an epoch"),
+    "fit_end": ("obs/flight.py", "fit() returned cleanly"),
+    "fit_exception": ("obs/flight.py",
+                      "fit() is dying by exception (recorded from the "
+                      "fit paths' finally)"),
+    "nan_skip": ("train/faults.py",
+                 "non-finite gradient step skipped (consec + cumulative "
+                 "bad count)"),
+    "divergence_trip": ("train/faults.py",
+                        "max consecutive bad steps exceeded; "
+                        "TrainingDivergedError about to raise"),
+    "loss_scale_change": ("obs/flight.py",
+                          "dynamic loss scale moved (detected from the "
+                          "sampled telemetry stream)"),
+    "signal": ("obs/flight.py",
+               "install_signal_dump caught a signal (dump follows)"),
+    # -- checkpoints / durable storage ------------------------------------
+    "checkpoint_write": ("train/faults.py",
+                         "atomic checkpoint published (path, iteration, "
+                         "wall)"),
+    "checkpoint_load": ("train/faults.py",
+                        "checkpoint restored (also serving "
+                        "from_checkpoint)"),
+    "checkpoint_fallback": ("train/faults.py",
+                            "corrupt/unreadable checkpoint SKIPPED; "
+                            "loader fell back to an older sibling"),
+    "tmp_sweep": ("train/faults.py",
+                  "orphaned .tmp- staging debris from a prior crash "
+                  "swept on directory open"),
+    "storage_error": ("chaos/fslayer.py",
+                      "a durable write (stage/fsync/replace/append) "
+                      "failed typed; previous artifact intact"),
+    "journal_repair": ("chaos/fslayer.py",
+                       "torn trailing journal line truncated before an "
+                       "append (bytes dropped)"),
+    # -- serving / batching -----------------------------------------------
+    "overload_reject": ("serving/batcher.py",
+                        "typed backpressure: request rejected at the "
+                        "queue limit (also generate surface)"),
+    "retrace": ("obs/trace.py",
+                "a jitted step function re-traced (fn label; steady "
+                "state must show none)"),
+    "hot_reload": ("serving/engine.py",
+                   "atomic snapshot swap completed (version, "
+                   "fingerprint)"),
+    "int8_quantize": ("serving/engine.py",
+                      "int8 serving snapshot built (heads quantized, "
+                      "byte ratio)"),
+    "cost_published": ("obs/cost.py",
+                       "static FLOPs/bytes/peak-memory gauges published "
+                       "for a compiled step"),
+    "profiler_capture": ("obs/cost.py",
+                         "guarded jax.profiler capture ran (ms, "
+                         "log_dir)"),
+    # -- elastic resharding (parallel/reshard.py, train/faults.py) --------
+    "mesh_shrink": ("train/faults.py",
+                    "mesh failure triaged; survivor mesh forming "
+                    "(n_from -> n_to)"),
+    "reshard_start": ("parallel/reshard.py",
+                      "reshard plan executing (n_from, n_to)"),
+    "reshard_done": ("parallel/reshard.py",
+                     "reshard complete (ledger wall time + device/host "
+                     "byte counts)"),
+    "reshard_failed": ("parallel/reshard.py",
+                       "reshard raised; ledger records the partial "
+                       "transfer"),
+    "elastic_resume": ("train/faults.py",
+                       "elastic driver resumed the flattened schedule "
+                       "on the survivor mesh"),
+    "elastic_giveup": ("train/faults.py",
+                       "retries/min-devices exhausted; "
+                       "ElasticRecoveryExhaustedError about to raise"),
+    # -- continuous deployment (serving/registry.py) ----------------------
+    "publish": ("serving/registry.py",
+                "snapshot copied + journaled into the registry"),
+    "publish_refused": ("serving/registry.py",
+                        "validation gate refused a snapshot (non-finite "
+                        "or regressed score)"),
+    "publish_failed": ("train/listeners.py",
+                       "RegistryPublishListener hit a transient store "
+                       "failure (bounded retry)"),
+    "validated": ("serving/registry.py",
+                  "snapshot passed the validation gate (score "
+                  "recorded)"),
+    "canary_start": ("serving/registry.py",
+                     "canary window opened for a validated version"),
+    "promote": ("serving/registry.py",
+                "canary promoted to active (old batcher drained)"),
+    "regression_trip": ("serving/registry.py",
+                        "canary metric gate tripped (error/latency/"
+                        "score regression)"),
+    "rollback": ("serving/registry.py",
+                 "canary torn down; active version untouched"),
+    "model_evict": ("serving/registry.py",
+                    "LRU cold-model eviction (engines retired)"),
+    "model_rewarm": ("serving/registry.py",
+                     "evicted model rebuilt + rewarmed on demand"),
+    "tenant_reject": ("serving/registry.py",
+                      "per-tenant quota exceeded; typed 503 for that "
+                      "tenant only"),
+    "canary_generation_unavailable": (
+        "serving/registry.py",
+        "candidate cannot decode; canary gets no generation votes "
+        "(recorded once)"),
+    # -- continuous batching (serving/generate.py) ------------------------
+    "slot_claim": ("serving/generate.py",
+                   "request claimed a decode slot (prefill follows)"),
+    "slot_free": ("serving/generate.py",
+                  "slot released (finished / deadline / error)"),
+    "decode_stall": ("serving/generate.py",
+                     "decode dispatch exceeded the watchdog limit "
+                     "(escalated=True when requests were failed)"),
+    "decode_stall_recovered": ("serving/generate.py",
+                               "a stalled dispatch returned; slab "
+                               "rebuilt"),
+    "decode_error": ("serving/generate.py",
+                     "decode dispatch raised; active requests failed "
+                     "typed, slab rebuilt"),
+    "generation_memory_check": ("serving/generate.py",
+                                "slab bytes validated against the "
+                                "memory estimator at engine build"),
+    # -- kernels (nn/ops/registry.py) -------------------------------------
+    "kernel_fallback": ("nn/ops/registry.py",
+                        "a Pallas kernel probe failed/was disabled; "
+                        "reference path engaged (kernel, key, reason)"),
+    # -- chaos (chaos/hooks.py, chaos/seams.py) ---------------------------
+    "chaos_inject": ("chaos/hooks.py",
+                     "an armed fault fired at a seam (point, mode, "
+                     "fire count)"),
+    # -- lock witness (obs/lockwitness.py) --------------------------------
+    "lock_cycle": ("obs/lockwitness.py",
+                   "the lock witness saw an acquisition-order cycle "
+                   "(ABBA deadlock pattern); typed "
+                   "LockOrderViolationError under strict arming"),
+}
+
+#: chaos hook-point names production code may pass to
+#: ``chaos_hooks.fire``. Keys are the seam's fire-point string; values
+#: are (producer module, description). Native/trigger seams (grad_nan,
+#: host_dropout, on_event) are plan-level entries, not fire points, so
+#: they are declared in chaos/seams.py instead.
+HOOK_POINTS: Dict[str, tuple] = {
+    "fs.write": ("chaos/fslayer.py",
+                 "staging-file open / publish copy on a durable "
+                 "surface"),
+    "fs.fsync": ("chaos/fslayer.py",
+                 "durability barrier before an atomic publish or after "
+                 "a journal append"),
+    "fs.replace": ("chaos/fslayer.py",
+                   "atomic os.replace publish of a staged artifact"),
+    "fs.append": ("chaos/fslayer.py",
+                  "durable whole-line journal append (torn mode leaves "
+                  "half the line)"),
+    "serving.batch_dispatch": ("serving/batcher.py",
+                               "one assembled batch about to dispatch"),
+    "registry.version_dispatch": ("serving/registry.py",
+                                  "a versioned engine dispatch (model/"
+                                  "version/role ctx)"),
+    "registry.validation_score": ("serving/registry.py",
+                                  "publish validation score about to be "
+                                  "gated (value-override mode)"),
+    "generate.decode_dispatch": ("serving/generate.py",
+                                 "one jitted decode step about to "
+                                 "dispatch (engine chaos_ctx tags)"),
+    "kernel.probe": ("nn/ops/registry.py",
+                     "a kernel availability probe about to compile+run "
+                     "(transient_compile mode)"),
+}
+
+
+def is_declared_event(kind: str) -> bool:
+    return kind in FLIGHT_EVENTS
+
+
+def is_declared_hook_point(point: str) -> bool:
+    return point in HOOK_POINTS

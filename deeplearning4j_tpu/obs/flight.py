@@ -73,8 +73,12 @@ class FlightRecorder:
         # on the main thread and records/dumps; if the signal lands while
         # that same thread is inside record()'s critical section, a
         # plain Lock would self-deadlock and the process would ignore
-        # SIGTERM instead of leaving its black box
-        self._lock = threading.RLock()
+        # SIGTERM instead of leaving its black box. Witnessed: the ring
+        # lock is acquired from every subsystem, so it is exactly where
+        # an ordering inversion against a subsystem lock would show up.
+        from deeplearning4j_tpu.obs.lockwitness import witnessed_rlock
+
+        self._lock = witnessed_rlock("flight.ring")
         self._seq = 0
         self.dump_dir = dump_dir
         self.last_dump_path: Optional[str] = None
@@ -180,6 +184,12 @@ class FlightRecorder:
         try:
             with open(tmp, "w") as f:
                 json.dump(body, f, indent=1)
+                # durability barrier BEFORE the atomic rename: an
+                # os.replace of un-fsynced bytes can publish an empty
+                # black box after power loss — worthless exactly when
+                # it is needed
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
         except OSError:
             # a failing dump must never mask the error being dumped

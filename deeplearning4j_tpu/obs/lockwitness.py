@@ -1,0 +1,293 @@
+"""Lock witness: the runtime half of the invariant analyzer.
+
+The PR 13 review caught an ABBA-class deadlock by hand:
+``ModelRouter.shutdown`` joined generation workers whose completion
+observers take ``mm.lock`` — a completion racing shutdown wedged the
+process. Static rules can't see that; this witness can. Production
+code creates its interacting locks through :func:`witnessed_rlock` /
+:func:`witnessed_lock`, which are plain ``threading`` locks until the
+witness is ARMED (tests, chaos drills). Armed, every acquisition
+records lockdep-style *order-class* edges — thread holds class A,
+acquires class B ⇒ edge A→B — into one process-wide directed graph;
+an acquisition whose new edge closes a cycle is the ABBA pattern, and
+the witness fails it **typed** (:class:`LockOrderViolationError`) with
+a ``lock_cycle`` flight event *before* the process can actually
+deadlock (the inverse interleaving may never fire in a test run — the
+order graph catches the pattern, not the lucky schedule).
+
+Unarmed overhead: one module-global truthiness check per acquire — the
+``chaos/hooks.py`` discipline. Edges are keyed by lock *name* (order
+class), so every ``_ManagedModel.lock`` instance shares one node; a
+reentrant acquire of the same instance records nothing, and same-name
+edges are skipped (indistinguishable from reentrancy at class
+granularity).
+
+Arming modes: ``strict=True`` raises on a cycle (the synthetic-ABBA
+drill); ``strict=False`` records the cycle + flight event and lets the
+acquisition proceed (the chaos drill matrix arms this way — its
+scorecard gates on ``lock_cycles == 0`` without turning a latent
+inversion into a mid-drill crash of an unrelated code path).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class LockOrderViolationError(RuntimeError):
+    """Acquiring this lock would close a cycle in the process-wide
+    lock-order graph — the ABBA deadlock pattern. Carries the cycle as
+    a list of lock-class names."""
+
+    def __init__(self, message: str, cycle: Optional[List[str]] = None):
+        super().__init__(message)
+        self.cycle = list(cycle or [])
+
+
+# -- process-wide witness state ---------------------------------------------
+_state_lock = threading.Lock()
+#: arming depth (nested armed() blocks compose); 0 = passthrough
+_armed_depth = 0
+_strict = True
+#: order-class graph: a -> {b: (thread_name, a_site, b_site)}
+_edges: Dict[str, Dict[str, tuple]] = {}
+#: cycles seen while armed (observe mode keeps going; strict raises)
+_cycles: List[dict] = []
+#: (held, acquiring) inversion pairs already recorded — a drill loop
+#: re-hitting the same inversion must not flood the cycle log / flight
+#: ring (strict mode still raises on every hit)
+_reported: set = set()
+_tls = threading.local()
+
+
+def _held() -> List[Tuple[int, str]]:
+    """This thread's held stack: list of [lock_id, name, count]."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def armed_() -> bool:
+    return _armed_depth > 0
+
+
+def arm(strict: bool = True) -> None:
+    """Arm process-wide (nested arms stack; the outermost strictness
+    wins so a strict test isn't downgraded by a nested observe arm)."""
+    global _armed_depth, _strict
+    with _state_lock:
+        if _armed_depth == 0:
+            _strict = bool(strict)
+        _armed_depth += 1
+
+
+def disarm() -> None:
+    global _armed_depth
+    with _state_lock:
+        _armed_depth = max(_armed_depth - 1, 0)
+
+
+class armed:
+    """``with lockwitness.armed(strict=...):`` — arm for the block."""
+
+    def __init__(self, strict: bool = True):
+        self.strict = strict
+
+    def __enter__(self):
+        arm(self.strict)
+        return self
+
+    def __exit__(self, *exc):
+        disarm()
+        return False
+
+
+def reset() -> None:
+    """Clear the order graph and cycle log (test isolation). Held
+    stacks are per-thread and clear themselves on release."""
+    with _state_lock:
+        _edges.clear()
+        _cycles.clear()
+        _reported.clear()
+
+
+def cycles() -> List[dict]:
+    with _state_lock:
+        return [dict(c) for c in _cycles]
+
+
+def edges() -> Dict[str, list]:
+    with _state_lock:
+        return {a: sorted(bs) for a, bs in _edges.items()}
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst over the edge graph (caller holds
+    _state_lock)."""
+    seen = {src}
+    stack = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_cycle(cycle: List[str], name: str, strict: bool) -> None:
+    info = {"cycle": list(cycle), "acquiring": name,
+            "thread": threading.current_thread().name,
+            "strict": strict}
+    _cycles.append(info)
+
+
+def _fire_lock_cycle_event(cycle: List[str], name: str) -> None:
+    # the flight ring's own lock is witnessed: bypass bookkeeping while
+    # recording so forensics can never recurse into the witness
+    prev = getattr(_tls, "bypass", False)
+    _tls.bypass = True
+    try:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        _flight.record("lock_cycle", acquiring=name,
+                       cycle="->".join(cycle),
+                       thread=threading.current_thread().name)
+    except Exception:  # noqa: BLE001 — forensics must not mask the cycle
+        pass
+    finally:
+        _tls.bypass = prev
+
+
+def _note_acquire(lock_id: int, name: str) -> None:
+    """Order-graph bookkeeping BEFORE a blocking acquire. Runs with the
+    bypass flag set: a signal handler interrupting the bookkeeping and
+    recording into a witnessed lock (the SIGTERM flight dump) must pass
+    straight through instead of self-deadlocking on ``_state_lock``."""
+    _tls.bypass = True
+    try:
+        _note_acquire_inner(lock_id, name)
+    finally:
+        _tls.bypass = False
+
+
+def _note_acquire_inner(lock_id: int, name: str) -> None:
+    stack = _held()
+    for ent in stack:
+        if ent[0] == lock_id:
+            return  # reentrant: no new ordering information
+    held_names = [ent[1] for ent in stack]
+    new_cycle = None
+    fresh = False
+    with _state_lock:
+        for a in held_names:
+            if a == name:
+                continue  # same order class: indistinguishable from
+                # reentrancy, skip (documented granularity limit)
+            bs = _edges.setdefault(a, {})
+            if name not in bs:
+                path = _find_path(name, a)
+                if path is not None:
+                    new_cycle = path + [name]
+                    # never add the closing edge (the graph stays
+                    # acyclic), and log each distinct inversion pair
+                    # once — a loop re-hitting the same inversion must
+                    # not flood the cycle log / flight ring
+                    if (a, name) not in _reported:
+                        _reported.add((a, name))
+                        _record_cycle(new_cycle, name, _strict)
+                        fresh = True
+                    continue
+                bs[name] = (threading.current_thread().name,)
+        strict = _strict
+    if new_cycle is not None:
+        if fresh:
+            _fire_lock_cycle_event(new_cycle, name)
+        if strict:
+            raise LockOrderViolationError(
+                f"lock-order cycle: acquiring {name!r} while holding "
+                f"{held_names!r} closes {' -> '.join(new_cycle)} — the "
+                "ABBA deadlock pattern (see obs/lockwitness.py)",
+                cycle=new_cycle)
+
+
+def _push(lock_id: int, name: str) -> None:
+    stack = _held()
+    for ent in stack:
+        if ent[0] == lock_id:
+            ent[2] += 1
+            return
+    stack.append([lock_id, name, 1])
+
+
+def _pop(lock_id: int) -> None:
+    stack = _held()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i][0] == lock_id:
+            stack[i][2] -= 1
+            if stack[i][2] == 0:
+                del stack[i]
+            return
+
+
+class WitnessedLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper carrying an
+    order-class ``name``. Context-manager and acquire/release surface
+    only (the repo's locks are used exactly that way)."""
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._lk = self._factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _armed_depth and not getattr(_tls, "bypass", False):
+            _note_acquire(id(self), self.name)
+            ok = self._lk.acquire(blocking, timeout)
+            if ok:
+                _push(id(self), self.name)
+            return ok
+        return self._lk.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        # pop BEFORE releasing: once released another thread may hold
+        # the lock while our stale entry still names it held here.
+        # Pop whenever this thread's stack is non-empty — NOT only
+        # while armed: a lock acquired during an armed block but
+        # released after disarm would otherwise leave a permanent
+        # phantom "held" entry fabricating edges (and false cycles) in
+        # every later armed run
+        if getattr(_tls, "stack", None):
+            _pop(id(self))
+        self._lk.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class WitnessedRLock(WitnessedLock):
+    _factory = staticmethod(threading.RLock)
+
+
+def witnessed_lock(name: str) -> WitnessedLock:
+    """A ``threading.Lock`` under the witness's order class ``name``."""
+    return WitnessedLock(name)
+
+
+def witnessed_rlock(name: str) -> WitnessedRLock:
+    """A ``threading.RLock`` under the witness's order class
+    ``name``."""
+    return WitnessedRLock(name)

@@ -99,7 +99,7 @@ class Gauge:
                 return self._value
         try:
             return float(fn())
-        except Exception:
+        except Exception:  # noqa: BLE001 — a dying gauge callback must not fail the scrape
             return 0.0
 
 

@@ -42,6 +42,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.chaos import hooks as chaos_hooks
+from deeplearning4j_tpu.obs.lockwitness import witnessed_lock
 from deeplearning4j_tpu.serving import rtrace
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -91,7 +92,7 @@ class InferenceRequest:
         #: per-request stage timeline (serving/rtrace.py), or None
         self.trace = rtrace.RequestTrace() if trace else None
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = witnessed_lock("serving.batcher")
         self.result_: Optional[np.ndarray] = None
         self.error_: Optional[BaseException] = None
         #: version of the model snapshot that served this request (set by
@@ -194,7 +195,7 @@ def make_dispatcher(infer: Callable[..., np.ndarray],
                 finally:
                     if traced:
                         rtrace.end_dispatch()
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 — routed to every request's typed failure path
                 if metrics is not None:
                     metrics.record_error()
                 for r in reqs:
@@ -373,7 +374,7 @@ class DynamicBatcher:
                     if not r.done():  # dispatcher contract violation
                         r.fail(ServingError(
                             "dispatch returned without completing request"))
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 — routed to every request's typed failure path
                 self.metrics.record_error()
                 for r in live:
                     r.fail(e)

@@ -32,6 +32,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from deeplearning4j_tpu.obs.lockwitness import witnessed_lock
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
@@ -148,7 +149,7 @@ class InferenceEngine:
         #: byte ledger of the snapshot placement (parallel/reshard.py);
         #: None for mesh-less engines (placement is implicit at dispatch)
         self.reshard_stats = None
-        self._reload_lock = threading.Lock()
+        self._reload_lock = witnessed_lock("serving.reload")
         self._fingerprint: Optional[Tuple[float, int]] = None
         self.warm = False
         if mesh is not None and mesh.n_data > 1:

@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.chaos import hooks as chaos_hooks
+from deeplearning4j_tpu.obs.lockwitness import witnessed_lock
 from deeplearning4j_tpu.serving import rtrace
 from deeplearning4j_tpu.serving.batcher import (
     RequestDeadlineExceeded,
@@ -117,7 +118,7 @@ class GenerationRequest:
         #: slot index while decoding, else None
         self.slot: Optional[int] = None
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = witnessed_lock("generate.request")
         self._stream: "queue.Queue" = queue.Queue()
         self.result_: Optional[np.ndarray] = None
         self.error_: Optional[BaseException] = None
@@ -564,7 +565,7 @@ def generation_memory_report(model, n_slots: int,
 def _device_bytes_limit() -> Optional[int]:
     try:
         stats = jax.local_devices()[0].memory_stats()
-    except Exception:
+    except Exception:  # noqa: BLE001 — backend without a memory_stats API
         return None
     if not stats:
         return None
@@ -692,7 +693,7 @@ class GenerationEngine:
         self._topp = np.zeros((S,), np.float32)
         self._keys = np.zeros((S, 2), np.uint32)
         self._shutdown = False
-        self._dev_lock = threading.Lock()
+        self._dev_lock = witnessed_lock("generate.device")
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="dl4j-tpu-generate")
         self._worker.start()

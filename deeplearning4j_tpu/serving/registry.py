@@ -69,6 +69,10 @@ import warnings
 from collections import OrderedDict, deque
 from typing import Callable, Dict, List, Optional
 
+from deeplearning4j_tpu.obs.lockwitness import (
+    witnessed_lock,
+    witnessed_rlock,
+)
 from deeplearning4j_tpu.serving.batcher import (
     DynamicBatcher,
     RequestDeadlineExceeded,
@@ -155,7 +159,7 @@ class ModelRegistry:
         #: snapshots retained per model beyond the referenced set
         #: (active / canary / newest validated are never pruned)
         self.keep_last = None if keep_last is None else int(keep_last)
-        self._lock = threading.RLock()
+        self._lock = witnessed_rlock("registry.store")
         self._models: Dict[str, dict] = {}
         self._journal_bytes = 0
         from deeplearning4j_tpu.train.faults import sweep_stale_tmp
@@ -780,7 +784,7 @@ class _ManagedModel:
 
     def __init__(self, name: str):
         self.name = name
-        self.lock = threading.RLock()
+        self.lock = witnessed_rlock("router.model")
         self.active: Optional[_VersionedEngine] = None
         self.canary: Optional[_VersionedEngine] = None
         self.canary_started: Optional[float] = None  # monotonic
@@ -794,6 +798,9 @@ class _ManagedModel:
         #: generation-only regressions must still trip auto-rollback)
         self.canary_generation = None
         self.canary_gen_failed = False  # build failed once: don't retry
+        #: a build+warm is in flight OFF the lock (exactly one builder;
+        #: traffic keeps routing to the active version meanwhile)
+        self.canary_gen_building = False
         self.gen_counter = 0
         self.last_used = time.monotonic()
         #: set by LRU eviction. Engines are retired but the references
@@ -857,9 +864,9 @@ class ModelRouter:
         self.trace_requests = bool(trace_requests)
         self.traces = traces
         self._live: "OrderedDict[str, _ManagedModel]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = witnessed_rlock("router")
         self._tenants: Dict[str, deque] = {}
-        self._tenant_lock = threading.Lock()
+        self._tenant_lock = witnessed_lock("router.tenants")
         self._last_refresh = time.monotonic()
         self._shutdown = False
 
@@ -1090,36 +1097,58 @@ class ModelRouter:
                 "active")
         return mm.generation
 
-    def _ensure_canary_generation(self, mm: _ManagedModel):
-        """The canary version's own generation engine (caller holds
-        ``mm.lock``): built+warmed lazily at the first /generate of an
-        open window — the same pay-once-at-adoption trade
-        ``_maybe_adopt`` documents for the predict engine. A model
-        whose candidate cannot decode (arch change) records the fact
-        once and serves generation from the active version only (the
-        canary then needs /predict traffic to promote)."""
+    def _build_canary_generation(self, mm: _ManagedModel, base_model,
+                                 version: int) -> None:
+        """Build+warm the canary's generation engine with NO locks
+        held, then install it under ``mm.lock`` — the caller set
+        ``canary_gen_building`` under the lock, so exactly one builder
+        runs. Building under ``mm.lock`` would (a) stall every
+        predict/generate for the model behind seconds of slab compiles
+        and (b) close a lock-order cycle against the decode worker,
+        which holds the engine DEVICE lock when its completion
+        observers take ``mm.lock`` — the ABBA pattern the lock witness
+        (obs/lockwitness.py) flagged the moment it armed over this
+        drill. A model whose candidate cannot decode (arch change)
+        records the fact once and serves generation from the active
+        version only (the canary then needs /predict traffic to
+        promote)."""
         from deeplearning4j_tpu.obs import flight as _flight
 
-        if mm.canary is None or mm.canary_gen_failed:
-            return None
-        if mm.canary_generation is None:
-            try:
-                gen = self._build_generation(
-                    mm.canary.engine.model, mm.name, mm.canary.version,
-                    "canary")
-                gen.warmup()
-            except Exception as e:  # noqa: BLE001 — a candidate that
-                # cannot even build its decode slab must not take down
-                # generation serving; it simply gets no generation
-                # traffic (and no generation votes in the gate)
-                mm.canary_gen_failed = True
-                _flight.record("canary_generation_unavailable",
-                               model=mm.name, version=mm.canary.version,
-                               error=type(e).__name__,
-                               message=str(e)[:200])
-                return None
-            mm.canary_generation = gen
-        return mm.canary_generation
+        gen = None
+        try:
+            gen = self._build_generation(base_model, mm.name, version,
+                                         "canary")
+            gen.warmup()
+        except Exception as e:  # noqa: BLE001 — a candidate that
+            # cannot even build its decode slab must not take down
+            # generation serving; it simply gets no generation
+            # traffic (and no generation votes in the gate)
+            with mm.lock:
+                # poison only the window we were building for: if it
+                # already tripped/promoted and a NEW canary opened,
+                # this stale failure must not cost the new candidate
+                # its generation votes
+                if (mm.canary is not None
+                        and mm.canary.version == version):
+                    mm.canary_gen_failed = True
+                mm.canary_gen_building = False
+            _flight.record("canary_generation_unavailable",
+                           model=mm.name, version=version,
+                           error=type(e).__name__,
+                           message=str(e)[:200])
+            return
+        stale = None
+        with mm.lock:
+            mm.canary_gen_building = False
+            if (mm.canary is not None and mm.canary.version == version
+                    and mm.canary_generation is None):
+                mm.canary_generation = gen
+            else:
+                # the window closed (trip/promote/evict) while we were
+                # warming: discard the engine OUTSIDE the lock
+                stale = gen
+        if stale is not None:
+            stale.shutdown(drain=False, timeout=5.0)
 
     def generation_submit(self, model: str, prompt_ids, **kwargs):
         """Submit one generation request with canary routing: while a
@@ -1131,18 +1160,33 @@ class ModelRouter:
         traffic still trips auto-rollback (the PR 11 residue). Returns
         the :class:`~.generate.GenerationRequest`."""
         mm = self._managed_for_generation(model)
+        build_spec = None
         with mm.lock:
             self._maybe_adopt(mm)
             self._maybe_promote(mm)
             gen = self._ensure_generation(mm)
             ve = mm.active
             if mm.canary is not None and self.canary_fraction > 0:
-                cgen = self._ensure_canary_generation(mm)
+                cgen = mm.canary_generation
+                if (cgen is None and not mm.canary_gen_failed
+                        and not mm.canary_gen_building):
+                    # first /generate of an open window: claim the
+                    # build under the lock, run it AFTER release (see
+                    # _build_canary_generation — lock-order + latency)
+                    mm.canary_gen_building = True
+                    build_spec = (mm.canary.engine.model,
+                                  mm.canary.version)
                 if cgen is not None:
                     mm.gen_counter += 1
                     every = max(int(round(1.0 / self.canary_fraction)), 1)
                     if mm.gen_counter % every == 0:
                         gen, ve = cgen, mm.canary
+        if build_spec is not None:
+            # this request still decodes on the active version; the
+            # canary starts taking its fraction from the NEXT submit,
+            # once the warm engine is installed (the documented
+            # lazily-built semantics)
+            self._build_canary_generation(mm, *build_spec)
         # the observer rides in through submit so it is installed
         # BEFORE the request is enqueued — a completion racing the
         # submit return (instant canary decode failure, already-expired

@@ -462,7 +462,7 @@ def _make_handler(server: InferenceServer):
                 else:
                     self._send_json(404, {"error": "NotFound",
                                           "message": self.path})
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 — mapped to the typed HTTP error response
                 self._error(e)
 
         def _predict_json(self, model: Optional[str] = None) -> None:
@@ -578,7 +578,7 @@ def _make_handler(server: InferenceServer):
                 if want_trace and req.trace is not None:
                     summary["trace"] = req.trace.timeline()
                 chunk(summary)
-            except BaseException as e:
+            except BaseException as e:  # noqa: BLE001 — terminal chunk; see below
                 # the status line is on the wire; a decode failure
                 # becomes a terminal chunk. If writing THAT fails too
                 # (client went away mid-stream), swallow it — letting

@@ -612,7 +612,7 @@ class EarlyStoppingTrainer:
                     details = f"{type(e).__name__}: {e}"
                     try:
                         self.train_iterator.reset()  # clean for retry
-                    except Exception:
+                    except Exception:  # noqa: BLE001 — best-effort reset; the original error wins
                         pass
                     break
 

@@ -107,7 +107,7 @@ def dispatch_fit_end(listeners, model) -> None:
         if hook is not None:
             try:
                 hook(model)
-            except Exception:
+            except Exception:  # noqa: BLE001 — logged; a dying listener must not mask fit's exit path
                 log.exception("on_fit_end failed for %s",
                               type(lst).__name__)
 
@@ -614,7 +614,7 @@ class ProfilerListener(TrainingListener):
         if model is not None and getattr(model, "score_", None) is not None:
             try:
                 jax.block_until_ready(model.score_)
-            except Exception:
+            except Exception:  # noqa: BLE001 — closing the trace matters more than draining
                 pass  # closing the trace matters more than draining
         jax.profiler.stop_trace()
         self._active = False

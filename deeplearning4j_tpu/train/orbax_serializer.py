@@ -91,16 +91,22 @@ class OrbaxModelSerializer:
         # metadata from one process only; Orbax coordinates the array
         # writes across processes itself
         if jax.process_index() == 0:
-            with open(os.path.join(directory, "conf.json"), "w") as f:
-                f.write(model.conf.to_json())
-            with open(os.path.join(directory, "meta.json"), "w") as f:
-                json.dump({
-                    "iteration": model.iteration,
-                    "epoch": model.epoch,
-                    "model_type": type(model).__name__,
-                    "save_updater": bool(save_updater),
-                    "framework": "deeplearning4j_tpu",
-                }, f)
+            from deeplearning4j_tpu.chaos import fslayer
+
+            # stage+fsync+atomic-replace via the injectable fs layer: a
+            # crash mid-write must never leave a torn conf/meta next to
+            # valid Orbax arrays (typed StorageError on disk-full)
+            fslayer.write_atomic(os.path.join(directory, "conf.json"),
+                                 model.conf.to_json(),
+                                 surface="checkpoint")
+            fslayer.write_atomic(os.path.join(directory, "meta.json"),
+                                 json.dumps({
+                                     "iteration": model.iteration,
+                                     "epoch": model.epoch,
+                                     "model_type": type(model).__name__,
+                                     "save_updater": bool(save_updater),
+                                     "framework": "deeplearning4j_tpu",
+                                 }), surface="checkpoint")
         if multi:
             _barrier("dl4jtpu_orbax_meta")  # metadata visible before the
             # cooperative array writes begin

@@ -32,6 +32,7 @@ import threading
 import warnings
 from typing import Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.obs.lockwitness import witnessed_lock
 from deeplearning4j_tpu.tune.scheduler import Trial, TrialStatus
 
 META_NAME = "study.json"
@@ -45,7 +46,7 @@ class TrialStore:
         os.makedirs(directory, exist_ok=True)
         self.journal_path = os.path.join(directory, JOURNAL_NAME)
         self.meta_path = os.path.join(directory, META_NAME)
-        self._lock = threading.Lock()  # pool-engine threads share one store
+        self._lock = witnessed_lock("tune.store")  # pool-engine threads share one store
         from deeplearning4j_tpu.train.faults import sweep_stale_tmp
 
         # orphaned staging files from a PRIOR crashed atomic write are

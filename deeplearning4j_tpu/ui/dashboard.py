@@ -216,6 +216,12 @@ overview</a></p>
                        auto_refresh_s=auto_refresh_s)
 
 
+class UnknownSessionError(KeyError):
+    """Requested stats session id exists in no attached storage.
+    Subclasses ``KeyError`` so the dashboard's dict-style handlers
+    keep working; typed per the error taxonomy."""
+
+
 class UIServer:
     """Live training-dashboard server (reference
     ``UIServer.getInstance().attach(statsStorage)`` +
@@ -270,12 +276,12 @@ class UIServer:
             for st in self.storages:
                 if session_id in st.list_session_ids():
                     return st, session_id
-            raise KeyError(f"unknown session: {session_id}")
+            raise UnknownSessionError(f"unknown session: {session_id}")
         for st in reversed(self.storages):
             ids = st.list_session_ids()
             if ids:
                 return st, ids[-1]
-        raise KeyError("no sessions in any attached storage")
+        raise UnknownSessionError("no sessions in any attached storage")
 
     def _waiting_page(self) -> str:
         return (f'<!doctype html><html><head><meta http-equiv="refresh" '

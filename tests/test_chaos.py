@@ -37,7 +37,7 @@ from deeplearning4j_tpu.chaos import (
 from deeplearning4j_tpu.chaos import fslayer, invariants
 from deeplearning4j_tpu.chaos import drills as chaos_drills
 from deeplearning4j_tpu.chaos.hooks import FaultSpec, InjectedFaultError
-from deeplearning4j_tpu.obs import flight
+from deeplearning4j_tpu.obs import flight, lockwitness
 
 pytestmark = pytest.mark.chaos
 
@@ -520,31 +520,41 @@ class TestGenerationCanaryGate:
             self, tmp_path):
         """Router shutdown joins generation workers whose completion
         observers take mm.lock — teardown must happen OUTSIDE the lock
-        or a completion racing shutdown deadlocks the process."""
-        reg, router, p2 = self._registry(tmp_path, window_s=60.0)
-        prompt = np.array([1, 2, 3], np.int32)
-        router.generation_submit("lm", prompt, max_new=3,
-                                 timeout=30).result(timeout=30)
-        reg.publish("lm", p2, score=0.48)
-        # open the window and put generation traffic in flight on BOTH
-        # engines, then shut down while completions are landing
-        reqs = [router.generation_submit("lm", prompt, max_new=5,
-                                         timeout=30) for _ in range(6)]
-        done = {"ok": False}
+        or a completion racing shutdown deadlocks the process. Runs
+        under the STRICT lock witness (obs/lockwitness.py): the PR 13
+        bug was exactly an acquisition-order inversion between
+        router.model and the generation engine's locks, so beyond
+        not-hanging, the order graph itself must stay acyclic."""
+        lockwitness.reset()
+        cycles0 = len(lockwitness.cycles())
+        with lockwitness.armed(strict=True):
+            reg, router, p2 = self._registry(tmp_path, window_s=60.0)
+            prompt = np.array([1, 2, 3], np.int32)
+            router.generation_submit("lm", prompt, max_new=3,
+                                     timeout=30).result(timeout=30)
+            reg.publish("lm", p2, score=0.48)
+            # open the window and put generation traffic in flight on
+            # BOTH engines, then shut down while completions are landing
+            reqs = [router.generation_submit("lm", prompt, max_new=5,
+                                             timeout=30)
+                    for _ in range(6)]
+            done = {"ok": False}
 
-        def _shutdown():
-            router.shutdown()
-            done["ok"] = True
+            def _shutdown():
+                router.shutdown()
+                done["ok"] = True
 
-        t = threading.Thread(target=_shutdown, daemon=True)
-        t.start()
-        t.join(timeout=60)
-        assert done["ok"], "router.shutdown deadlocked"
-        for r in reqs:
-            try:
-                r.result(timeout=5)  # served or failed typed — not hung
-            except Exception:
-                pass
+            t = threading.Thread(target=_shutdown, daemon=True)
+            t.start()
+            t.join(timeout=60)
+            assert done["ok"], "router.shutdown deadlocked"
+            for r in reqs:
+                try:
+                    r.result(timeout=5)  # served or failed typed
+                except Exception:
+                    pass
+        assert lockwitness.cycles()[cycles0:] == [], (
+            "shutdown path reintroduced a lock-order inversion")
 
     def test_generation_only_regression_trips_rollback(self, tmp_path):
         reg, router, p2 = self._registry(tmp_path, window_s=60.0)

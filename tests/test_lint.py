@@ -1,0 +1,544 @@
+"""Invariant-linter tests (deeplearning4j_tpu/analysis): one positive
+fixture (violation detected, correct file:line) and one negative
+fixture (idiomatic code passes) per rule engine, baseline add/expire
+semantics, the four acceptance defect-class seeds, and THE tier-1
+gate: the shipped tree is lint-clean against the shipped baseline."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.analysis import run_lint
+from deeplearning4j_tpu.analysis.baseline import (
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from deeplearning4j_tpu.analysis.core import lint_paths
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(deeplearning4j_tpu.__file__)))
+
+
+def write(tmp_path, rel, body):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def findings_for(tmp_path, rel, body, rule=None):
+    write(tmp_path, rel, body)
+    fs = lint_paths(str(tmp_path))
+    return [f for f in fs if rule is None or f.rule == rule]
+
+
+# ==========================================================================
+# rule engines: positive + negative fixtures
+# ==========================================================================
+class TestDurabilityRules:
+    def test_unsynced_replace_detected_with_line(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/writer.py", """\
+            import os
+
+            def publish(tmp, dst):
+                with open(tmp, "w") as f:
+                    f.write("x")
+                os.replace(tmp, dst)
+            """, rule="durability-unsynced-replace")
+        assert len(fs) == 1
+        assert fs[0].path == "pkg/writer.py"
+        assert fs[0].line == 6  # the os.replace line, exactly
+
+    def test_fsynced_replace_passes(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/writer.py", """\
+            import os
+
+            def publish(tmp, dst):
+                with open(tmp, "w") as f:
+                    f.write("x")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, dst)
+            """, rule="durability-unsynced-replace")
+        assert fs == []
+
+    def test_fslayer_helpers_count_as_barrier(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/writer.py", """\
+            import os
+            from deeplearning4j_tpu.chaos import fslayer
+
+            def publish(tmp, dst):
+                fslayer.fsync_path(tmp, surface="checkpoint")
+                os.replace(tmp, dst)
+            """, rule="durability-unsynced-replace")
+        assert fs == []
+
+    def test_bypass_fslayer_on_durable_surface(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/serving/store.py", """\
+            def save(path):
+                with open(path, "w") as f:
+                    f.write("x")
+            """, rule="durability-bypass-fslayer")
+        assert len(fs) == 1
+        assert fs[0].line == 2
+
+    def test_reads_and_nondurable_dirs_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/serving/loader.py", """\
+            def load(path):
+                with open(path) as f:
+                    return f.read()
+            """, rule="durability-bypass-fslayer")
+        assert fs == []
+        fs = findings_for(tmp_path, "pkg/ui/report.py", """\
+            def save(path):
+                with open(path, "w") as f:
+                    f.write("x")
+            """, rule="durability-bypass-fslayer")
+        assert fs == []
+
+
+class TestTypedErrorRules:
+    def test_bare_keyerror_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/registry.py", """\
+            def get(d, k):
+                if k not in d:
+                    raise KeyError(f"unknown {k}")
+                return d[k]
+            """, rule="typed-errors-bare-raise")
+        assert len(fs) == 1 and fs[0].line == 3
+
+    def test_subclass_and_protocol_raises_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/registry.py", """\
+            class UnknownThingError(KeyError):
+                pass
+
+            def get(d, k):
+                if k not in d:
+                    raise UnknownThingError(k)
+                return d[k]
+
+            class Proxy:
+                def __getattr__(self, name):
+                    raise AttributeError(name)
+
+                @property
+                def params(self):
+                    raise AttributeError("use total_params()")
+
+            class It:
+                def next(self):
+                    raise StopIteration
+            """, rule="typed-errors-bare-raise")
+        assert fs == []
+
+    def test_broad_except_without_ack_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/worker.py", """\
+            def run(fn):
+                try:
+                    fn()
+                except Exception:
+                    pass
+            """, rule="typed-errors-broad-except")
+        assert len(fs) == 1 and fs[0].line == 4
+
+    def test_ack_comment_reraise_and_narrow_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/worker.py", """\
+            def run(fn):
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — fn is user code
+                    pass
+                try:
+                    fn()
+                except Exception as e:
+                    raise RuntimeError("typed") from e
+                try:
+                    fn()
+                except ValueError:
+                    pass
+            """, rule="typed-errors-broad-except")
+        assert fs == []
+
+    def test_bare_except_flagged_even_with_comment(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/worker.py", """\
+            def run(fn):
+                try:
+                    fn()
+                except:  # noqa
+                    pass
+            """, rule="typed-errors-broad-except")
+        assert len(fs) == 1
+        assert "SystemExit" in fs[0].message
+
+
+class TestTraceSafetyRules:
+    def test_host_sync_in_jitted_body_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/stepper.py", """\
+            import jax
+
+            def make_step():
+                def step(params, batch):
+                    loss = compute(params, batch)
+                    print(float(loss))
+                    return loss
+                return jax.jit(step)
+            """, rule="trace-host-sync")
+        assert len(fs) == 1 and fs[0].line == 6
+
+    def test_item_in_decorated_jit_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/stepper.py", """\
+            from functools import partial
+            import jax
+
+            @partial(jax.jit, donate_argnums=(0,))
+            def step(params, batch):
+                return params * batch.loss.item()
+            """, rule="trace-host-sync")
+        assert len(fs) == 1 and fs[0].line == 6
+
+    def test_shape_math_and_unjitted_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/stepper.py", """\
+            import jax
+
+            def make_step():
+                def step(params, batch):
+                    scale = float(batch.shape[0])
+                    return params * scale
+                return jax.jit(step)
+
+            def host_helper(x):
+                return float(x)  # not jitted: host code is free
+            """, rule="trace-host-sync")
+        assert fs == []
+
+    def test_probe_jnp_inputs_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/nn/ops/kern.py", """\
+            import jax.numpy as jnp
+
+            def _probe_kern(n):
+                x = jnp.ones((n, n))
+                return x
+            """, rule="trace-probe-jnp")
+        assert len(fs) == 1 and fs[0].line == 4
+
+    def test_probe_numpy_inputs_and_non_ops_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/nn/ops/kern.py", """\
+            import numpy as np
+
+            def _probe_kern(n):
+                return np.ones((n, n), np.float32)
+            """, rule="trace-probe-jnp")
+        assert fs == []
+        fs = findings_for(tmp_path, "pkg/models/thing.py", """\
+            import jax.numpy as jnp
+
+            def probe_data(n):
+                return jnp.ones((n,))
+            """, rule="trace-probe-jnp")
+        assert fs == []
+
+
+class TestEventSchemaRule:
+    def test_undeclared_event_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/sys.py", """\
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            def work():
+                _flight.record("definitely_not_declared_xyz", a=1)
+            """, rule="event-schema")
+        assert len(fs) == 1 and fs[0].line == 4
+        assert "definitely_not_declared_xyz" in fs[0].message
+
+    def test_undeclared_fire_point_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/sys.py", """\
+            from deeplearning4j_tpu.chaos import hooks as chaos_hooks
+
+            def work():
+                chaos_hooks.fire("bogus.seam_point")
+            """, rule="event-schema")
+        assert len(fs) == 1
+
+    def test_declared_names_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/sys.py", """\
+            from deeplearning4j_tpu.chaos import hooks as chaos_hooks
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            def work():
+                _flight.record("checkpoint_write", path="p")
+                chaos_hooks.fire("fs.replace", path="p", surface="s")
+            """, rule="event-schema")
+        assert fs == []
+
+
+class TestParseError:
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/broken.py",
+                          "def broken(:\n", rule="parse-error")
+        assert len(fs) == 1
+
+
+# ==========================================================================
+# baseline add / expire semantics
+# ==========================================================================
+class TestBaseline:
+    BODY = """\
+        import os
+
+        def publish(tmp, dst):
+            os.replace(tmp, dst)
+        """
+
+    def test_add_suppresses_exactly_that_finding(self, tmp_path):
+        write(tmp_path, "pkg/w.py", self.BODY)
+        bl = str(tmp_path / "BASELINE.json")
+        fs = lint_paths(str(tmp_path))
+        write_baseline(bl, fs, {f.fingerprint: "legacy" for f in fs})
+        rep = run_lint(str(tmp_path), baseline_path=bl)
+        assert rep.ok and rep.exit_code == 0
+        assert len(rep.suppressed) == len(fs) and not rep.active
+
+        # a NEW violation is not covered by the old baseline
+        write(tmp_path, "pkg/w2.py", self.BODY)
+        rep = run_lint(str(tmp_path), baseline_path=bl)
+        assert not rep.ok
+        assert {f.path for f in rep.active} == {"pkg/w2.py"}
+
+    def test_expire_stale_entry_fails_gate(self, tmp_path):
+        write(tmp_path, "pkg/w.py", self.BODY)
+        bl = str(tmp_path / "BASELINE.json")
+        fs = lint_paths(str(tmp_path))
+        write_baseline(bl, fs, {f.fingerprint: "legacy" for f in fs})
+        # fix the violation: the baseline entry must go stale and FAIL
+        write(tmp_path, "pkg/w.py", """\
+            import os
+
+            def publish(tmp, dst):
+                os.fsync(0)
+                os.replace(tmp, dst)
+            """)
+        rep = run_lint(str(tmp_path), baseline_path=bl)
+        assert not rep.ok and rep.exit_code == 1
+        assert len(rep.stale) == 1 and not rep.active
+        assert "matched nothing" in rep.format()
+
+    def test_fingerprint_survives_line_moves(self, tmp_path):
+        write(tmp_path, "pkg/w.py", self.BODY)
+        fp0 = lint_paths(str(tmp_path))[0].fingerprint
+        # unrelated code above moves the finding down 3 lines
+        write(tmp_path, "pkg/w.py", "X = 1\nY = 2\nZ = 3\n"
+              + textwrap.dedent(self.BODY))
+        fp1 = lint_paths(str(tmp_path))[0].fingerprint
+        assert fp0 == fp1
+
+    def test_versioned_and_malformed_baseline_fail_typed(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"version": 99, "entries": []}))
+        with pytest.raises(ValueError):
+            load_baseline(str(p))
+        p.write_text(json.dumps({"entries": [{"no_fp": 1}]}))
+        with pytest.raises(ValueError):
+            load_baseline(str(p))
+
+    def test_apply_baseline_occurrence_granularity(self, tmp_path):
+        # two IDENTICAL violations: one baseline entry suppresses one
+        write(tmp_path, "pkg/w.py", """\
+            import os
+
+            def a(t, d):
+                os.replace(t, d)
+
+            def b(t, d):
+                os.replace(t, d)
+            """)
+        fs = [f for f in lint_paths(str(tmp_path))
+              if f.rule == "durability-unsynced-replace"]
+        assert len(fs) == 2
+        assert fs[0].fingerprint != fs[1].fingerprint
+        active, suppressed, stale = apply_baseline(
+            fs, [{"fingerprint": fs[0].fingerprint}])
+        assert len(active) == 1 and len(suppressed) == 1 and not stale
+
+
+# ==========================================================================
+# the acceptance seeds: each defect class flips the gate non-zero
+# ==========================================================================
+SEEDS = {
+    "durability-unsynced-replace": (
+        "pkg/train/ckpt.py", 4,
+        "import os\n\n"
+        "def publish(t, d):\n"
+        "    os.replace(t, d)\n"),
+    "typed-errors-bare-raise": (
+        "pkg/serving/router.py", 3,
+        "def pick(d, k):\n"
+        "    if k not in d:\n"
+        "        raise KeyError(k)\n"
+        "    return d[k]\n"),
+    "trace-host-sync": (
+        "pkg/train/steps.py", 5,
+        "import jax\n\n"
+        "def make():\n"
+        "    def step(p, b):\n"
+        "        return p * float(b.sum())\n"
+        "    return jax.jit(step)\n"),
+    "event-schema": (
+        "pkg/obs_bits.py", 4,
+        "from deeplearning4j_tpu.obs import flight as _flight\n\n"
+        "def w():\n"
+        "    _flight.record(\"never_declared_event_q\")\n"),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(SEEDS))
+def test_seeded_defect_flips_nonzero_with_file_line(tmp_path, rule):
+    rel, line, body = SEEDS[rule]
+    write(tmp_path, rel, body)
+    rep = run_lint(str(tmp_path))
+    assert rep.exit_code == 1
+    hits = [f for f in rep.active if f.rule == rule]
+    assert len(hits) == 1
+    assert hits[0].path == rel and hits[0].line == line
+
+
+# ==========================================================================
+# the tier-1 gate: the shipped tree is clean vs the shipped baseline
+# ==========================================================================
+def test_shipped_tree_is_lint_clean_vs_baseline():
+    """THE gate every future PR inherits: zero active findings, zero
+    stale baseline entries over deeplearning4j_tpu/ with
+    LINT_BASELINE.json. A new violation of any codified defect class
+    fails THIS test with its file:line in the message."""
+    pkg = os.path.dirname(os.path.abspath(deeplearning4j_tpu.__file__))
+    rep = run_lint(REPO_ROOT, [pkg],
+                   baseline_path=os.path.join(REPO_ROOT,
+                                              "LINT_BASELINE.json"))
+    assert rep.ok, "\n" + rep.format()
+
+
+def test_cli_lint_json_roundtrip(tmp_path, capsys):
+    from deeplearning4j_tpu import cli
+
+    rc = cli.main(["lint", "--json"])
+    out = capsys.readouterr().out
+    body = json.loads(out)
+    assert rc == 0 and body["ok"] is True
+    assert body["counts"]["active"] == 0
+
+    # seeded tree through the CLI surface: non-zero + file:line printed
+    write(tmp_path, "pkg/train/ckpt.py",
+          SEEDS["durability-unsynced-replace"][2])
+    rc = cli.main(["lint", "--root", str(tmp_path), "--no-baseline",
+                   str(tmp_path / "pkg")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "pkg/train/ckpt.py:4" in out
+
+
+def test_cli_write_baseline_preserves_suppressed_entries(tmp_path,
+                                                         capsys):
+    """Review regression: pointing --write-baseline at the live
+    baseline must carry the already-triaged entries (and their
+    reviewed reasons) forward, not discard them for the active-only
+    set."""
+    from deeplearning4j_tpu import cli
+
+    write(tmp_path, "pkg/a.py", TestBaseline.BODY)
+    bl = str(tmp_path / "BASELINE.json")
+    rc = cli.main(["lint", "--root", str(tmp_path), "--no-baseline",
+                   "--write-baseline", bl, str(tmp_path / "pkg")])
+    assert rc == 0
+    body = json.load(open(bl))
+    body["entries"][0]["reason"] = "reviewed: legacy writer"
+    (tmp_path / "BASELINE.json").write_text(json.dumps(body))
+    # a second violation appears; regenerate against the live baseline
+    write(tmp_path, "pkg/b.py", TestBaseline.BODY)
+    rc = cli.main(["lint", "--root", str(tmp_path), "--baseline", bl,
+                   "--write-baseline", bl, str(tmp_path / "pkg")])
+    capsys.readouterr()
+    assert rc == 0
+    entries = load_baseline(bl)
+    assert len(entries) == 2  # old entry kept, new one added
+    by_path = {e["path"]: e for e in entries}
+    assert by_path["pkg/a.py"]["reason"] == "reviewed: legacy writer"
+    assert "TODO" in by_path["pkg/b.py"]["reason"]
+
+
+def test_events_table_matches_architecture_doc():
+    """The ARCHITECTURE flight-event table is generated from
+    obs/events.py — the docs cannot drift from the declared schema."""
+    from deeplearning4j_tpu.analysis.tables import render_event_table
+
+    arch = open(os.path.join(REPO_ROOT, "ARCHITECTURE.md")).read()
+    assert render_event_table() in arch
+
+
+# ==========================================================================
+# regression tests for the findings this PR fixed (satellite 1)
+# ==========================================================================
+class TestFixedFindings:
+    def test_flight_dump_fsyncs_before_replace(self, tmp_path,
+                                               monkeypatch):
+        """obs/flight.py dump(): the black box must be fsynced before
+        its atomic rename (a dump that evaporates on power loss is
+        worthless exactly when it is needed)."""
+        import os as _os
+
+        from deeplearning4j_tpu.obs.flight import FlightRecorder
+
+        synced = []
+        real = _os.fsync
+        monkeypatch.setattr(_os, "fsync",
+                            lambda fd: (synced.append(fd), real(fd))[1])
+        rec = FlightRecorder(dump_dir=str(tmp_path))
+        rec.record("step", iteration=1, epoch=0)
+        path = rec.dump(reason="test")
+        assert path and _os.path.exists(path)
+        assert synced, "dump() never fsynced the staged file"
+        body = json.load(open(path))
+        assert body["events"][0]["kind"] == "step"
+
+    def test_zoo_download_promote_fsyncs(self, tmp_path, monkeypatch):
+        """models/zoo.py: the downloaded .part is fsynced before both
+        atomic promotes."""
+        import os as _os
+
+        from deeplearning4j_tpu.models import zoo
+
+        part = tmp_path / "w.bin.part"
+        part.write_bytes(b"payload")
+        synced = []
+        real = _os.fsync
+        monkeypatch.setattr(_os, "fsync",
+                            lambda fd: (synced.append(fd), real(fd))[1])
+        zoo._fsync_path(str(part))
+        assert len(synced) == 1
+
+    def test_unknown_config_class_typed(self):
+        from deeplearning4j_tpu.nn.conf import serde
+
+        with pytest.raises(serde.UnknownConfigClassError) as ei:
+            serde.lookup("NoSuchConfigClass")
+        assert isinstance(ei.value, KeyError)  # dict-compat preserved
+
+    def test_unknown_zoo_model_typed(self):
+        from deeplearning4j_tpu.models.selector import (
+            ModelSelector,
+            UnknownZooModelError,
+        )
+
+        with pytest.raises(UnknownZooModelError):
+            ModelSelector.select("no-such-model")
+
+    def test_unknown_session_typed(self):
+        from deeplearning4j_tpu.ui.dashboard import (
+            UIServer,
+            UnknownSessionError,
+        )
+
+        srv = UIServer()
+        with pytest.raises(UnknownSessionError):
+            srv._find("nope")

@@ -1,0 +1,257 @@
+"""Lock-witness tests (obs/lockwitness.py): the synthetic ABBA drill
+(typed LockOrderViolationError + lock_cycle flight event), witness
+semantics (reentrancy, same-class, observe mode, passthrough), and the
+chaos-drill integration (scorecard lock_cycles)."""
+
+import threading
+import time
+
+import pytest
+
+from deeplearning4j_tpu.obs import flight, lockwitness as lw
+from deeplearning4j_tpu.obs.lockwitness import (
+    LockOrderViolationError,
+    witnessed_lock,
+    witnessed_rlock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_witness():
+    lw.reset()
+    yield
+    lw.reset()
+
+
+def _abba(strict=True):
+    """Two threads acquire two locks in opposite orders, barrier-synced
+    so both orderings are recorded; returns the violations raised."""
+    A = witnessed_rlock("abba.A")
+    B = witnessed_rlock("abba.B")
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def forward():
+        with A:
+            barrier.wait()
+            time.sleep(0.05)
+            try:
+                with B:
+                    pass
+            except LockOrderViolationError as e:
+                errors.append(e)
+
+    def backward():
+        barrier.wait()
+        with B:
+            time.sleep(0.05)
+            try:
+                with A:
+                    pass
+            except LockOrderViolationError as e:
+                errors.append(e)
+
+    with lw.armed(strict=strict):
+        # daemon: under observe-mode arming an ABBA genuinely
+        # deadlocks (nothing raises to break it) — live threads must
+        # never block interpreter exit
+        ts = [threading.Thread(target=forward, daemon=True),
+              threading.Thread(target=backward, daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+    return errors
+
+
+class TestSyntheticABBA:
+    def test_abba_raises_typed_with_cycle_and_flight_event(self):
+        seq0 = flight.default_flight_recorder().recorded_total
+        errors = _abba(strict=True)
+        # exactly one side closes the cycle (the second ordering seen)
+        assert len(errors) == 1
+        e = errors[0]
+        assert isinstance(e, LockOrderViolationError)
+        assert isinstance(e, RuntimeError)  # typed taxonomy, not a hang
+        assert set(e.cycle) == {"abba.A", "abba.B"}
+        cyc = lw.cycles()
+        assert len(cyc) == 1 and cyc[0]["strict"] is True
+        evs = [ev for ev in flight.default_flight_recorder().events()
+               if ev["seq"] >= seq0 and ev["kind"] == "lock_cycle"]
+        assert len(evs) == 1
+        assert "abba.A" in evs[0]["cycle"] and "abba.B" in evs[0]["cycle"]
+
+    def test_observe_mode_records_without_raising(self):
+        # single-threaded inversion: under observe arming a real
+        # two-thread ABBA would genuinely deadlock (nothing raises to
+        # break it) — which is exactly why the drill matrix pairs
+        # observe mode with drill deadlines
+        A = lw.witnessed_rlock("obs.A")
+        B = lw.witnessed_rlock("obs.B")
+        with lw.armed(strict=False):
+            with A:
+                with B:
+                    pass
+            with B:
+                with A:
+                    pass
+        assert len(lw.cycles()) == 1
+        assert lw.cycles()[0]["strict"] is False
+
+    def test_cycle_reported_once_not_per_acquire(self):
+        A = witnessed_rlock("once.A")
+        B = witnessed_rlock("once.B")
+        with lw.armed(strict=False):
+            with A:
+                with B:
+                    pass
+            for _ in range(5):
+                with B:
+                    with A:
+                        pass
+        assert len(lw.cycles()) == 1
+
+
+class TestWitnessSemantics:
+    def test_reentrant_rlock_records_no_edges(self):
+        A = witnessed_rlock("re.A")
+        with lw.armed():
+            with A:
+                with A:
+                    pass
+        assert lw.edges() == {}
+
+    def test_consistent_order_passes_and_builds_graph(self):
+        A = witnessed_rlock("ord.A")
+        B = witnessed_rlock("ord.B")
+        C = witnessed_rlock("ord.C")
+        with lw.armed():
+            with A:
+                with B:
+                    with C:
+                        pass
+            with A:
+                with C:
+                    pass
+        assert lw.cycles() == []
+        assert "ord.B" in lw.edges()["ord.A"]
+        assert "ord.C" in lw.edges()["ord.B"]
+
+    def test_transitive_cycle_detected(self):
+        # A->B and B->C recorded, then C->A closes a 3-cycle
+        A = witnessed_rlock("tri.A")
+        B = witnessed_rlock("tri.B")
+        C = witnessed_rlock("tri.C")
+        with lw.armed(strict=True):
+            with A:
+                with B:
+                    pass
+            with B:
+                with C:
+                    pass
+            with pytest.raises(LockOrderViolationError) as ei:
+                with C:
+                    with A:
+                        pass
+            assert ei.value.cycle[0] == "tri.A"
+            assert ei.value.cycle[-1] == "tri.A"
+
+    def test_same_order_class_instances_skip(self):
+        # two instances sharing a class: indistinguishable from
+        # reentrancy at class granularity — documented skip
+        a1 = witnessed_rlock("mm.lock")
+        a2 = witnessed_rlock("mm.lock")
+        with lw.armed():
+            with a1:
+                with a2:
+                    pass
+        assert lw.cycles() == []
+
+    def test_unarmed_is_passthrough(self):
+        A = witnessed_rlock("pt.A")
+        B = witnessed_rlock("pt.B")
+        with A:
+            with B:
+                pass
+        with B:
+            with A:
+                pass
+        assert lw.edges() == {} and lw.cycles() == []
+
+    def test_plain_lock_is_not_reentrant(self):
+        lk = witnessed_lock("plain")
+        assert lk.acquire(blocking=False)
+        assert not lk.acquire(blocking=False)
+        lk.release()
+
+    def test_release_after_disarm_leaves_no_phantom_held(self):
+        """Review regression: a lock acquired while armed but released
+        after disarm must not leave a phantom held entry fabricating
+        edges (and false cycles) in every later armed run."""
+        A = witnessed_rlock("ph.A")
+        B = witnessed_rlock("ph.B")
+        A.acquire()
+        with lw.armed():
+            pass  # disarmed while A is (unarmed-)held: nothing pushed
+        A.release()
+        arm_a = witnessed_rlock("ph.armA")
+        with lw.armed():
+            arm_a.acquire()
+        arm_a.release()  # released AFTER disarm: must still pop
+        with lw.armed():
+            with B:
+                pass
+        assert lw.edges() == {}  # no phantom ph.A/ph.armA -> ph.B edge
+        assert lw.cycles() == []
+
+    def test_nested_arming_depth(self):
+        A = witnessed_rlock("nest.A")
+        with lw.armed():
+            with lw.armed():
+                pass
+            # still armed after the inner block exits
+            with A:
+                pass
+        assert lw.armed_() is False
+
+
+class TestChaosIntegration:
+    def test_drill_scorecard_reports_zero_lock_cycles(self):
+        from deeplearning4j_tpu.chaos import drills
+
+        card = drills.run_matrix(names=["checkpoint_enospc"])
+        assert card["ok"], card
+        assert card["lock_cycles"] == 0
+        checks = {c["name"]: c["ok"]
+                  for c in card["drills"][0]["checks"]}
+        assert checks.get("no_lock_cycles") is True
+
+    def test_injected_inversion_fails_the_drill_invariant(self):
+        """A drill whose workload contains an ABBA inversion goes RED
+        on the no_lock_cycles invariant — without crashing the drill
+        (observe-mode arming)."""
+        from deeplearning4j_tpu.chaos import drills
+
+        X = witnessed_rlock("drillbad.X")
+        Y = witnessed_rlock("drillbad.Y")
+
+        def bad(ctx):
+            with X:
+                with Y:
+                    pass
+            with Y:
+                with X:
+                    pass
+
+        name = "_test_lock_inversion"
+        drills.DRILLS[name] = drills.Drill(
+            name, bad, "test", [], paired=False, fast=True,
+            deadline_s=30.0, description="synthetic inversion")
+        try:
+            r = drills.run_drill(name)
+            assert not r.ok
+            failed = [c for c in r.checks if not c["ok"]]
+            assert [c["name"] for c in failed] == ["no_lock_cycles"]
+            assert "drillbad" in failed[0]["detail"]
+        finally:
+            drills.DRILLS.pop(name, None)
