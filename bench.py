@@ -1574,6 +1574,11 @@ def _bench_chaos():
         # acquisition-order cycle anywhere in the matrix is an ABBA
         # deadlock pattern waiting for the right schedule
         "zero_lock_cycles": scorecard.get("lock_cycles", 0) == 0,
+        # ISSUE 15: detection verified, not just recovery — >= 8 drills
+        # declare expected_alerts and every one of those alerts FIRED
+        # in the drill's detection evaluator
+        "alerts_verified_floor_8":
+            scorecard.get("alerts_verified", 0) >= 8,
     }
     result = {
         "metric": "chaos_drills_green",
@@ -1600,6 +1605,265 @@ def _bench_chaos():
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_chaos.json"), "w") as f:
         json.dump(result, f, indent=1)
+    return result
+
+
+def _bench_alerts(k=16, n_batches=192, batch=32, d_in=64, d_hidden=64,
+                  d_out=10, epochs=24, rounds=5, tick_interval_s=0.25):
+    """SLO alert-engine gates (ISSUE 15), two legs in one artifact:
+
+    1. **Evaluator overhead** — the SAME K=16-bundled MLP fit
+       (_bench_obs's shape) with the flight listener on, (a) bare and
+       (b) with a full default-pack AlertEvaluator watching the flight
+       ring and ticking at scrape cadence on a sidecar thread. Gate:
+       ≤ 1% steps/sec lost — watching must be free next to training.
+    2. **Detection latency** — inject real faults (a NaN-gradient storm
+       through the chaos grad_nan seam; disk-full on the checkpoint
+       fsync) and count evaluator ticks from fault to alert FIRING.
+       Gate: ≤ 2 ticks for every fault — the contract the chaos matrix
+       asserts drill-by-drill via expected_alerts.
+
+    CPU-measurable by design; writes BENCH_alerts.json."""
+    import threading as _threading
+
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ExistingDataSetIterator
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.obs import slo
+    from deeplearning4j_tpu.obs.alerts import AlertEvaluator
+    from deeplearning4j_tpu.obs.flight import FlightRecorderListener
+    from deeplearning4j_tpu.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    batches = [
+        DataSet(rng.standard_normal((batch, d_in)).astype(np.float32),
+                np.eye(d_out, dtype=np.float32)[
+                    rng.integers(0, d_out, batch)])
+        for _ in range(n_batches)
+    ]
+
+    from deeplearning4j_tpu.obs.flight import FlightRecorder
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(11)
+                .updater(Adam(1e-3)).steps_per_call(k).list()
+                .layer(DenseLayer(n_out=d_hidden, activation="relu"))
+                .layer(OutputLayer(n_out=d_out, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        net = MultiLayerNetwork(conf).init()
+        # each arm records flight events into its OWN ring (the ring's
+        # cost is gated separately in BENCH_obs); only the watched
+        # arm's ring gets the evaluator's observer, so the A/B delta
+        # isolates exactly the alert engine: per-event observer +
+        # scrape-cadence evaluator ticks
+        rec = FlightRecorder()
+        net.add_listeners(FlightRecorderListener(recorder=rec,
+                                                 directory=None,
+                                                 dump_every_s=None))
+        it = ExistingDataSetIterator(batches)
+        net.fit(it, epochs=1)  # warmup: compile both step shapes
+        float(net.score_)
+        return net, it, rec
+
+    def timed(net, it):
+        t0 = time.perf_counter()
+        net.fit(it, epochs=epochs)
+        float(net.score_)  # drain the async dispatch queue
+        return epochs * n_batches / (time.perf_counter() - t0)
+
+    net_off, it_off, _rec_off = build()
+    net_on, it_on, rec_on = build()
+    evaluator = slo.build_default_evaluator(recorder=rec_on,
+                                            min_tick_interval=0.0)
+    stop = _threading.Event()
+
+    def ticker():
+        while not stop.wait(tick_interval_s):
+            evaluator.tick()
+
+    events0 = rec_on.recorded_total
+    on_wall = 0.0
+    try:
+        # interleaved, order-alternated rounds: CPU frequency/allocator
+        # drift across a long process biases whichever arm runs later
+        # (the _bench_obs lesson). The sidecar ticker runs ONLY while
+        # the watched arm is timed — a ticker spanning both arms would
+        # bill the engine's tick cost to the baseline too and gate
+        # nothing.
+        ratios = []
+        off_sps = on_sps = 0.0
+        for r in range(rounds):
+            def timed_on():
+                stop.clear()
+                t = _threading.Thread(target=ticker, daemon=True,
+                                      name="alert-ticker")
+                t.start()
+                try:
+                    return timed(net_on, it_on)
+                finally:
+                    stop.set()
+                    t.join(timeout=5)
+
+            if r % 2 == 0:
+                off = timed(net_off, it_off)
+                on = timed_on()
+            else:
+                on = timed_on()
+                off = timed(net_off, it_off)
+            ratios.append(on / off)
+            off_sps = max(off_sps, off)
+            on_sps = max(on_sps, on)
+            on_wall += epochs * n_batches / on
+    finally:
+        stop.set()
+    ticks_run = evaluator.ticks
+    ab_ratio = sorted(ratios)[len(ratios) // 2]
+    ab_overhead_pct = round((1.0 - ab_ratio) * 100.0, 2)
+    events_per_sec = (rec_on.recorded_total - events0) / max(on_wall,
+                                                             1e-9)
+
+    # THE GATED NUMBER is a direct decomposition: (marginal per-event
+    # observer cost + per-tick evaluation cost) x the rates actually
+    # measured at K=16. The wall-clock A/B above stays as a sanity
+    # cross-check, but its per-round ratios swing +-3-4% on this box —
+    # a 1% gate read off it would be judging timing noise, in either
+    # direction (the first draft of this bench was caught in review
+    # gating an A/B whose two arms were identical). Microbenching the
+    # two engine costs at N=20k/2k iterations is stable to well under
+    # a microsecond; counting the sidecar ticks against the step
+    # thread is conservative (they run on their own core).
+    N_EV = 20000
+    rec_bare = FlightRecorder()
+    t0 = time.perf_counter()
+    for _ in range(N_EV):
+        rec_bare.record("bundle", it0=0, k=k, epoch=0)
+    t_rec_bare = (time.perf_counter() - t0) / N_EV
+    t0 = time.perf_counter()
+    for _ in range(N_EV):
+        rec_on.record("bundle", it0=0, k=k, epoch=0)
+    t_rec_watched = (time.perf_counter() - t0) / N_EV
+    t_event = max(t_rec_watched - t_rec_bare, 0.0)
+    N_TICK = 2000
+    t0 = time.perf_counter()
+    for _ in range(N_TICK):
+        evaluator.tick()
+    t_tick = (time.perf_counter() - t0) / N_TICK
+    evaluator.unwatch()
+    overhead_pct = round(
+        (events_per_sec * t_event + t_tick / tick_interval_s) * 100.0, 3)
+
+    # -- detection-latency leg ---------------------------------------------
+    from deeplearning4j_tpu.chaos.plan import ChaosPlan
+    from deeplearning4j_tpu.train.faults import FaultPolicy, save_checkpoint
+
+    def detect(fault_name, alert_name, plan, workload):
+        ev = AlertEvaluator(slo.default_rules(),
+                            min_tick_interval=0.0, record_events=False)
+        ev.watch_flight(None)
+        try:
+            ev.tick()  # baseline sample before the fault
+            with plan.armed():
+                try:
+                    workload()
+                except Exception:  # noqa: BLE001 — the injected fault
+                    # surfacing typed IS the workload here; detection is
+                    # what this leg measures
+                    pass
+            ticks = 0
+            for _ in range(4):
+                ticks += 1
+                ev.tick()
+                if alert_name in ev.fired_names():
+                    break
+            fired = alert_name in ev.fired_names()
+            return {"fault": fault_name, "alert": alert_name,
+                    "fired": fired,
+                    "ticks_to_fire": ticks if fired else None}
+        finally:
+            ev.unwatch()
+
+    def nan_fit():
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(Adam(1e-2))
+                .fault_policy(FaultPolicy(skip_nonfinite=True,
+                                          max_consecutive_bad_steps=100))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=d_out, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        MultiLayerNetwork(conf).init().fit(
+            ExistingDataSetIterator(batches[:4]), epochs=1)
+
+    import shutil
+    import tempfile as _tempfile
+
+    ck_dir = _tempfile.mkdtemp(prefix="bench_alerts_ck_")
+    net_ck, _it_ck, _rec_ck = build()
+
+    detections = [
+        detect("nan_gradient_storm", "nan_step_storm",
+               ChaosPlan([{"seam": "grad_nan", "at_iterations": [1]}],
+                         name="bench_nan"), nan_fit),
+        detect("checkpoint_fsync_enospc", "storage_errors",
+               ChaosPlan([{"seam": "fs.fsync", "mode": "enospc",
+                           "match": {"surface": "checkpoint"}}],
+                         name="bench_enospc"),
+               lambda: save_checkpoint(net_ck, ck_dir)),
+    ]
+    shutil.rmtree(ck_dir, ignore_errors=True)
+    worst_ticks = max((d["ticks_to_fire"] or 99) for d in detections)
+    gates = {
+        "evaluator_overhead_le_1pct": overhead_pct <= 1.0,
+        "detection_within_2_ticks":
+            all(d["fired"] for d in detections) and worst_ticks <= 2,
+    }
+    result = {
+        "metric": "alerts_evaluator_overhead_pct",
+        "value": overhead_pct,
+        "unit": "% steps/sec lost with the alert engine watching "
+                "(direct decomposition: per-event observer cost + "
+                "per-tick cost, x measured rates at K=16)",
+        "vs_baseline": round(ab_ratio, 4),
+        "gates": gates,
+        "gates_ok": all(gates.values()),
+        "extra": {
+            "steps_per_sec": {"watched": round(on_sps, 1),
+                              "bare": round(off_sps, 1)},
+            "ab_overhead_pct_cross_check": ab_overhead_pct,
+            "ab_per_round_ratios": [round(r, 4) for r in ratios],
+            "observer_cost_us_per_event": round(t_event * 1e6, 3),
+            "tick_cost_us": round(t_tick * 1e6, 2),
+            "flight_events_per_sec_at_k16": round(events_per_sec, 1),
+            "evaluator_ticks_during_ab": ticks_run,
+            "n_rules": len(slo.default_rules()),
+            "detection": detections,
+            "worst_detection_ticks": worst_ticks,
+            "config": (f"MLP {d_in}->{d_hidden}->{d_out}, batch {batch}, "
+                       f"{n_batches} batches x {epochs} epochs, K={k}, "
+                       f"sidecar tick every {tick_interval_s}s during "
+                       "the watched arm only; private flight ring per "
+                       "arm, evaluator observes only the watched one"),
+            "platform": jax.devices()[0].platform,
+            "note": ("gate 1: the watching engine costs <= 1% steps/sec "
+                     "at K=16 — gated on the direct cost decomposition; "
+                     "the wall-clock A/B rides along as a cross-check "
+                     "but its per-round noise on this 2-core box is "
+                     "+-3-4%, unusable for a 1% verdict. gate 2: fault "
+                     "-> alert FIRING within 2 evaluator ticks (the "
+                     "chaos expected_alerts contract)"),
+        },
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_alerts.json")
+    with open(out_path + ".tmp", "w") as f:
+        json.dump(result, f, indent=1)
+    os.replace(out_path + ".tmp", out_path)
     return result
 
 
@@ -2030,6 +2294,19 @@ if __name__ == "__main__":
             jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_pipeline()))
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "alerts":
+        # SLO alert-engine gates: evaluator overhead next to a K=16
+        # fit (<= 1%) + fault->firing detection latency (<= 2 ticks);
+        # meaningful on any backend, writes BENCH_alerts.json
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        out = _bench_alerts()
+        if not _tpu_plausible():
+            out["metric"] = "cpu_fallback_" + out["metric"]
+        print(json.dumps(out))
+        sys.exit(0 if out["gates_ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "obs":
         # telemetry-overhead A/B: meaningful on any backend, writes
         # BENCH_obs.json (gate: <= 5% steps/sec overhead at K=16)

@@ -80,3 +80,40 @@ def check_event_schema(ctx: FileContext) -> Iterable[Finding]:
                     "register_hook_seam it in chaos/seams.py) so "
                     "plans can address it"))
     return findings
+
+
+def _is_alert_rule_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "AlertRule":
+        return True
+    # obs.alerts.AlertRule(...) / alerts.AlertRule(...) style
+    return isinstance(fn, ast.Attribute) and fn.attr == "AlertRule"
+
+
+@register_rule(
+    "alert-schema",
+    "AlertRule names must be declared in obs/events.py ALERTS (the "
+    "set the chaos drills' expected_alerts and the ARCHITECTURE "
+    "alert-rule table are checked against)")
+def check_alert_schema(ctx: FileContext) -> Iterable[Finding]:
+    """A typo'd alert name would silently break a drill's
+    ``expected_alerts`` detection check (the drill would wait for an
+    alert that can never fire under that name), and an undeclared one
+    is an alert nobody documented — the exact failure mode the
+    flight-event half of this rule already guards."""
+    from deeplearning4j_tpu.obs import events as schema
+
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not _is_alert_rule_ctor(node):
+            continue
+        name = _literal_first_arg(node)
+        if name is not None and not schema.is_declared_alert(name):
+            findings.append(ctx.finding(
+                "alert-schema", node,
+                f"alert rule name {name!r} is not declared in "
+                "obs/events.py ALERTS — declare it (producer + "
+                "description) so expected_alerts checks and the "
+                "ARCHITECTURE alert-rule table cover it"))
+    return findings

@@ -14,6 +14,10 @@ EVENT_TABLE_BEGIN = "<!-- BEGIN generated flight-event table " \
     "(obs/events.py; cli lint --events-table) -->"
 EVENT_TABLE_END = "<!-- END generated flight-event table -->"
 
+ALERT_TABLE_BEGIN = "<!-- BEGIN generated alert-rule table " \
+    "(obs/slo.py; cli lint --alerts-table) -->"
+ALERT_TABLE_END = "<!-- END generated alert-rule table -->"
+
 
 def render_event_table() -> str:
     from deeplearning4j_tpu.obs import events
@@ -27,6 +31,44 @@ def render_event_table() -> str:
     for point, (producer, desc) in events.HOOK_POINTS.items():
         lines.append(f"| `{point}` | `{producer}` | {desc} |")
     lines += ["", EVENT_TABLE_END]
+    return "\n".join(lines)
+
+
+def render_alert_table() -> str:
+    """The SLO alert-rule table, regenerated from the live rule pack
+    (obs/slo.py default pack + the canary-gate rules at their default
+    knobs) — same byte-identical-embed contract as the flight-event
+    table, so ARCHITECTURE's alert catalog can only change by changing
+    the pack, which the ``alert-schema`` lint rule ties to the
+    declared names."""
+    from deeplearning4j_tpu.obs import slo
+
+    class _Stats:
+        requests = errors = gen_requests = 0
+        score = None
+        latency_sum = gen_latency_sum = 0.0
+
+        def mean_latency(self):
+            return None
+
+        def mean_gen_latency(self):
+            return None
+
+    class _MM:  # inert stand-in: the table needs signatures, not state
+        active = canary = None
+
+    rules = slo.default_rules() + slo.canary_gate_rules(
+        _MM(), higher_is_better=False, latency_trip_mult=5.0,
+        latency_trip_min_samples=8, score_trip_tolerance=0.0)
+    lines = [ALERT_TABLE_BEGIN, "",
+             "| alert | kind | severity | signal | condition | "
+             "meaning |", "|---|---|---|---|---|---|"]
+    for r in rules:
+        d = " ".join(r.description.split())
+        sig = r.signal_text().replace("|", "\\|")
+        lines.append(f"| `{r.name}` | {r.kind} | {r.severity} | "
+                     f"`{sig}` | {r.condition_text()} | {d} |")
+    lines += ["", ALERT_TABLE_END]
     return "\n".join(lines)
 
 

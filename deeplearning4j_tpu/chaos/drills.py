@@ -87,11 +87,22 @@ def _fit(model, batches, epochs: int = 1):
 # --------------------------------------------------------------------------
 class DrillContext:
     """Per-drill scratch state: an isolated artifact directory, the
-    invariant report, captured caller-visible errors, and a flight
-    cursor so event-order checks see only this drill's events."""
+    invariant report, captured caller-visible errors, a flight cursor
+    so event-order checks see only this drill's events, and a
+    DETECTION evaluator — the default SLO rule pack (obs/slo.py) over
+    a fresh registry, watching the flight ring from drill start on an
+    injected clock. The harness baseline-ticks it before the drill and
+    ticks it twice after (the ≤2-tick detection contract), so a drill
+    can assert the injected fault tripped exactly the alert that
+    claims to cover it (``expected_alerts``)."""
+
+    #: injected-clock tick spacing the harness uses (fake seconds —
+    #: large enough to clear every pack rule's for_s hold)
+    ALERT_TICK_S = 60.0
 
     def __init__(self, name: str):
-        from deeplearning4j_tpu.obs import flight
+        from deeplearning4j_tpu.obs import flight, slo
+        from deeplearning4j_tpu.obs.alerts import AlertEvaluator
 
         self.name = name
         self.dir = tempfile.mkdtemp(prefix=f"chaos_{name}_")
@@ -100,6 +111,20 @@ class DrillContext:
         self.recovery_s: Optional[float] = None
         self._flight = flight.default_flight_recorder()
         self._seq0 = self._flight.recorded_total
+        self._alert_now = 0.0
+        self.alerts = AlertEvaluator(slo.default_rules(),
+                                     clock=lambda: self._alert_now,
+                                     min_tick_interval=0.0)
+        self.alerts.watch_flight(self._flight)
+        self.alerts.tick()  # baseline sample: pre-fault counters
+
+    def tick_alerts(self, n: int = 1) -> List[str]:
+        """Advance the injected clock ``n`` ticks and evaluate;
+        returns the rules that have fired so far."""
+        for _ in range(int(n)):
+            self._alert_now += self.ALERT_TICK_S
+            self.alerts.tick()
+        return self.alerts.fired_names()
 
     def path(self, *parts: str) -> str:
         return os.path.join(self.dir, *parts)
@@ -133,7 +158,8 @@ class DrillContext:
 class Drill:
     def __init__(self, name: str, fn: Callable, workload: str,
                  seams: Sequence[str], paired: bool, fast: bool,
-                 deadline_s: float, description: str):
+                 deadline_s: float, description: str,
+                 expected_alerts: Sequence[str] = ()):
         self.name = name
         self.fn = fn
         self.workload = workload
@@ -142,23 +168,30 @@ class Drill:
         self.fast = fast
         self.deadline_s = float(deadline_s)
         self.description = description
+        #: alert names (obs/events.py ALERTS) that MUST fire in the
+        #: drill's detection evaluator within 2 post-drill ticks — the
+        #: detection half of the invariant contract
+        self.expected_alerts = list(expected_alerts)
 
     def describe(self) -> dict:
         return {"drill": self.name, "workload": self.workload,
                 "seams": self.seams, "paired": self.paired,
-                "fast": self.fast, "description": self.description}
+                "fast": self.fast, "description": self.description,
+                "expected_alerts": list(self.expected_alerts)}
 
 
 DRILLS: "OrderedDict[str, Drill]" = OrderedDict()
 
 
 def drill(workload: str, seams: Sequence[str], paired: bool = False,
-          fast: bool = True, deadline_s: float = 120.0):
+          fast: bool = True, deadline_s: float = 120.0,
+          expected_alerts: Sequence[str] = ()):
     def wrap(fn):
         name = fn.__name__.removeprefix("drill_")
         DRILLS[name] = Drill(name, fn, workload, seams, paired, fast,
                              deadline_s,
-                             (fn.__doc__ or "").strip().split("\n")[0])
+                             (fn.__doc__ or "").strip().split("\n")[0],
+                             expected_alerts=expected_alerts)
         return fn
 
     return wrap
@@ -168,7 +201,8 @@ class DrillResult:
     def __init__(self, name: str, ok: bool, checks: List[dict],
                  wall_s: float, recovery_s: Optional[float] = None,
                  error: Optional[str] = None,
-                 skipped: Optional[str] = None):
+                 skipped: Optional[str] = None,
+                 alerts_fired: Optional[List[str]] = None):
         self.name = name
         self.ok = ok
         self.checks = checks
@@ -176,6 +210,7 @@ class DrillResult:
         self.recovery_s = recovery_s
         self.error = error
         self.skipped = skipped
+        self.alerts_fired = list(alerts_fired or [])
 
     def to_dict(self) -> dict:
         d = DRILLS.get(self.name)
@@ -183,10 +218,12 @@ class DrillResult:
                "verdict": ("skipped" if self.skipped
                            else "green" if self.ok else "RED"),
                "ok": self.ok, "wall_s": round(self.wall_s, 3),
-               "checks": self.checks}
+               "checks": self.checks,
+               "alerts_fired": list(self.alerts_fired)}
         if d is not None:
             out.update(workload=d.workload, seams=d.seams,
-                       paired=d.paired)
+                       paired=d.paired,
+                       expected_alerts=list(d.expected_alerts))
         if self.recovery_s is not None:
             out["recovery_s"] = round(self.recovery_s, 3)
         if self.error:
@@ -229,6 +266,7 @@ def run_drill(name: str) -> DrillResult:
         hooks.disarm(None)
         shutil.rmtree(ctx.dir, ignore_errors=True)
     wall = time.monotonic() - t0
+    alerts_fired: List[str] = []
     if skipped is None and error is None:
         invariants.check_deadline(
             ctx.report, ctx.recovery_s if ctx.recovery_s is not None
@@ -237,10 +275,18 @@ def run_drill(name: str) -> DrillResult:
         ctx.report.add(
             "no_lock_cycles", not new_cycles,
             "; ".join("->".join(c["cycle"]) for c in new_cycles[:3]))
+        # detection: two post-drill evaluator ticks (the ≤2-tick
+        # contract) — every alert the drill claims covers its fault
+        # must have fired
+        alerts_fired = ctx.tick_alerts(2)
+        if d.expected_alerts:
+            invariants.check_expected_alerts(ctx.report, alerts_fired,
+                                             d.expected_alerts)
+    ctx.alerts.unwatch()
     ok = skipped is None and error is None and ctx.report.ok
     return DrillResult(name, ok, ctx.report.to_dict(), wall,
                        recovery_s=ctx.recovery_s, error=error,
-                       skipped=skipped)
+                       skipped=skipped, alerts_fired=alerts_fired)
 
 
 def run_matrix(fast_only: bool = False,
@@ -295,6 +341,13 @@ def run_matrix(fast_only: bool = False,
         "n_skipped": n_skipped,
         "n_paired": sum(1 for r in results
                         if not r.skipped and DRILLS[r.name].paired),
+        #: drills that declared expected_alerts, ran, and whose every
+        #: expected alert FIRED — detection verified, not just recovery
+        "alerts_verified": sum(
+            1 for r in results
+            if not r.skipped and DRILLS[r.name].expected_alerts
+            and set(DRILLS[r.name].expected_alerts)
+            <= set(r.alerts_fired)),
         "silent_corruption_findings": silent,
         #: acquisition-order cycles the lock witness saw across the
         #: whole matrix (every drill runs under it); the bench gate and
@@ -317,7 +370,7 @@ def _need_devices(n: int) -> list:
 # ==========================================================================
 # single-fault drills
 # ==========================================================================
-@drill("fit", ["grad_nan"])
+@drill("fit", ["grad_nan"], expected_alerts=["nan_step_storm"])
 def drill_fit_nan_skip_parity(ctx: DrillContext):
     """NaN-gradient storm mid-fit: skipped steps leave params + Adam
     slots BIT-identical to the same fit with those batches removed —
@@ -325,9 +378,13 @@ def drill_fit_nan_skip_parity(ctx: DrillContext):
     batches = _batches(4)
     plan = ChaosPlan([{"seam": "grad_nan", "at_iterations": [1]}],
                      name=ctx.name)
+    # arm the tripwire far above the storm: its host check is what
+    # records nan_skip forensics (and feeds the nan_step_storm alert)
+    # without ever tripping — the skip math itself is unchanged, so
+    # the bit-parity oracle below still holds
     with plan.armed():
-        a = _fit(_net(policy=_policy()), list(batches))
-    oracle = _fit(_net(policy=_policy()),
+        a = _fit(_net(policy=_policy(max_bad=100)), list(batches))
+    oracle = _fit(_net(policy=_policy(max_bad=100)),
                   [batches[0], batches[2], batches[3]])
     invariants.check_params_bitwise(ctx.report, a, oracle)
     ctx.report.add("bad_step_counted", a.bad_step_count == 1,
@@ -342,7 +399,8 @@ def _policy(max_bad: Optional[int] = None):
                        max_consecutive_bad_steps=max_bad)
 
 
-@drill("fit", ["grad_nan"])
+@drill("fit", ["grad_nan"],
+       expected_alerts=["nan_step_storm", "training_diverged"])
 def drill_fit_divergence_trip(ctx: DrillContext):
     """A sustained NaN storm trips the divergence tripwire: typed
     TrainingDivergedError, ordered nan_skip → divergence_trip forensics,
@@ -370,7 +428,7 @@ def drill_fit_divergence_trip(ctx: DrillContext):
     ctx.report.add("blackbox_dumped", bool(dumps), str(dumps))
 
 
-@drill("fit", ["fs.replace"])
+@drill("fit", ["fs.replace"], expected_alerts=["storage_errors"])
 def drill_checkpoint_enospc(ctx: DrillContext):
     """Disk full at the atomic checkpoint publish mid-fit: typed
     StorageError, no staging litter, the previous checkpoint still
@@ -394,7 +452,7 @@ def drill_checkpoint_enospc(ctx: DrillContext):
     invariants.check_checkpoint_loadable(ctx.report, ck)
 
 
-@drill("fit", ["fs.fsync"])
+@drill("fit", ["fs.fsync"], expected_alerts=["storage_errors"])
 def drill_checkpoint_fsync_fail(ctx: DrillContext):
     """A failed fsync of the staged checkpoint zip (EIO): typed
     StorageError, clean staging, previous checkpoint intact."""
@@ -415,7 +473,8 @@ def drill_checkpoint_fsync_fail(ctx: DrillContext):
     invariants.check_checkpoint_loadable(ctx.report, ck)
 
 
-@drill("fit", ["checkpoint_truncate"])
+@drill("fit", ["checkpoint_truncate"],
+       expected_alerts=["checkpoint_fallbacks"])
 def drill_checkpoint_torn_fallback(ctx: DrillContext):
     """A truncated newest checkpoint (crash-without-atomic-write state)
     is skipped with a checkpoint_fallback event; the previous one
@@ -442,7 +501,8 @@ def drill_checkpoint_torn_fallback(ctx: DrillContext):
                    str(evs[-1] if evs else None))
 
 
-@drill("registry_canary", ["registry.validation_score"])
+@drill("registry_canary", ["registry.validation_score"],
+       expected_alerts=["publish_refused"])
 def drill_registry_nan_publish_gate(ctx: DrillContext):
     """A NaN-poisoned snapshot is refused typed at publish: journaled
     rejected, publish_refused forensics, never activatable, registry
@@ -471,7 +531,8 @@ def drill_registry_nan_publish_gate(ctx: DrillContext):
     invariants.check_no_tmp_litter(ctx.report, ctx.path("reg"))
 
 
-@drill("registry_canary", ["fs.append"])
+@drill("registry_canary", ["fs.append"],
+       expected_alerts=["storage_errors"])
 def drill_registry_journal_enospc(ctx: DrillContext):
     """Disk full on the registry's WAL append mid-publish: typed
     StorageError, the copied snapshot bytes are not orphaned, and the
@@ -499,7 +560,8 @@ def drill_registry_journal_enospc(ctx: DrillContext):
     invariants.check_no_tmp_litter(ctx.report, ctx.path("reg"))
 
 
-@drill("registry_canary", ["registry.version_dispatch"])
+@drill("registry_canary", ["registry.version_dispatch"],
+       expected_alerts=["canary_rolled_back"])
 def drill_registry_canary_dispatch_trip(ctx: DrillContext):
     """Every canary dispatch fails (bad snapshot): the gate trips on the
     FIRST failure — ordered canary_start → regression_trip → rollback,
@@ -557,7 +619,7 @@ def drill_registry_canary_dispatch_trip(ctx: DrillContext):
         router.shutdown()
 
 
-@drill("tune_study", ["fs.append"])
+@drill("tune_study", ["fs.append"], expected_alerts=["storage_errors"])
 def drill_tune_journal_torn(ctx: DrillContext):
     """A torn tune-journal append (SIGKILL-mid-append state, injected):
     typed StorageError at the writer, and replay drops exactly the torn
@@ -583,7 +645,8 @@ def drill_tune_journal_torn(ctx: DrillContext):
                    f"{len(records)} records")
 
 
-@drill("tune_study", ["fs.replace"])
+@drill("tune_study", ["fs.replace"],
+       expected_alerts=["storage_errors"])
 def drill_tune_study_enospc(ctx: DrillContext):
     """Disk full during a LIVE tune study's store writes: the study
     fails typed (StorageError reaches the driver), and the directory
@@ -619,7 +682,8 @@ def drill_tune_study_enospc(ctx: DrillContext):
     invariants.check_no_tmp_litter(ctx.report, ctx.path("study"))
 
 
-@drill("generation_storm", ["generate.decode_dispatch"])
+@drill("generation_storm", ["generate.decode_dispatch"],
+       expected_alerts=["decode_errors"])
 def drill_generate_decode_error(ctx: DrillContext):
     """A decode-dispatch failure mid-storm fails the ACTIVE requests
     typed, leaves decode_error forensics, and the engine keeps serving
@@ -651,7 +715,7 @@ def drill_generate_decode_error(ctx: DrillContext):
 
 
 @drill("generation_storm", ["generate.decode_dispatch"],
-       deadline_s=30.0)
+       deadline_s=30.0, expected_alerts=["decode_stalled"])
 def drill_generate_watchdog_stall(ctx: DrillContext):
     """A HUNG decode dispatch (injected delay past the watchdog limit):
     callers are failed typed DecodeStalledError at the limit — never a
@@ -753,7 +817,8 @@ def drill_kernel_probe_transient(ctx: DrillContext):
     invariants.check_typed_errors(ctx.report, ctx.errors)
 
 
-@drill("generation_storm", ["generate.decode_dispatch"])
+@drill("generation_storm", ["generate.decode_dispatch"],
+       expected_alerts=["canary_rolled_back"])
 def drill_generation_canary_gate(ctx: DrillContext):
     """The PR 11 residue, drilled: a snapshot that only regresses under
     /generate traffic (its canary decode dispatches fail) still trips
@@ -808,7 +873,8 @@ def drill_generation_canary_gate(ctx: DrillContext):
         router.shutdown()
 
 
-@drill("elastic_fit", ["host_dropout"], deadline_s=180.0)
+@drill("elastic_fit", ["host_dropout"], deadline_s=180.0,
+       expected_alerts=["mesh_shrunk"])
 def drill_elastic_dropout_recovery(ctx: DrillContext):
     """Host dropout mid-fit on the 8-device mesh: survivors re-form,
     reshard, resume in place — ordered mesh_shrink → reshard_start →
@@ -847,7 +913,8 @@ def drill_elastic_dropout_recovery(ctx: DrillContext):
 # paired-fault drills — compositions no single-feature test exercises
 # ==========================================================================
 @drill("elastic_fit", ["host_dropout", "on_event"], paired=True,
-       fast=False, deadline_s=240.0)
+       fast=False, deadline_s=240.0,
+       expected_alerts=["mesh_shrunk", "checkpoint_fallbacks"])
 def drill_paired_ckpt_corrupt_during_recovery(ctx: DrillContext):
     """PAIRED: the newest checkpoint is truncated AT THE MOMENT the
     mesh fails (mesh_shrink event) — recovery must fall back to the
@@ -883,7 +950,7 @@ def drill_paired_ckpt_corrupt_during_recovery(ctx: DrillContext):
 
 
 @drill("registry_canary", ["fs.replace"], paired=True, fast=False,
-       deadline_s=120.0)
+       deadline_s=120.0, expected_alerts=["storage_errors"])
 def drill_paired_enospc_mid_publish_canary_open(ctx: DrillContext):
     """PAIRED: disk fills during a publish WHILE a canary window is
     open — the publish fails typed, the in-flight canary is unaffected
@@ -938,7 +1005,8 @@ def drill_paired_enospc_mid_publish_canary_open(ctx: DrillContext):
 
 
 @drill("generation_storm", ["generate.decode_dispatch"], paired=True,
-       fast=False, deadline_s=120.0)
+       fast=False, deadline_s=120.0,
+       expected_alerts=["decode_stalled", "canary_rolled_back"])
 def drill_paired_watchdog_trip_during_canary(ctx: DrillContext):
     """PAIRED: the decode watchdog trips on the CANARY's hung dispatch
     while its window is open — the stall surfaces typed, the gate rolls
@@ -1107,10 +1175,12 @@ def run_custom(plan: ChaosPlan, workload: str) -> DrillResult:
     finally:
         hooks.disarm(None)
         shutil.rmtree(ctx.dir, ignore_errors=True)
+    alerts_fired = ctx.tick_alerts(2) if error is None else []
+    ctx.alerts.unwatch()
     wall = time.monotonic() - t0
     ok = error is None and ctx.report.ok
     res = DrillResult(ctx.name, ok, ctx.report.to_dict(), wall,
-                      error=error)
+                      error=error, alerts_fired=alerts_fired)
     return res
 
 

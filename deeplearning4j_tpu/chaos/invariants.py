@@ -236,6 +236,23 @@ def check_tune_store_replayable(report: InvariantReport, directory: str,
     return report.add(name, True, f"{len(trials)} trials")
 
 
+def check_expected_alerts(report: InvariantReport,
+                          fired: Sequence[str],
+                          expected: Sequence[str],
+                          name: str = "expected_alerts_fired") -> bool:
+    """Every alert the drill claims covers its fault actually FIRED in
+    the drill's alert evaluator (obs/alerts.py over the flight ring) —
+    the detection half of the resilience contract: the matrix proves
+    not just that the system recovers, but that an operator would have
+    been told."""
+    missing = [a for a in expected if a not in set(fired)]
+    return report.add(
+        name, not missing,
+        "" if not missing else
+        f"expected alert(s) {missing} never fired (fired: "
+        f"{sorted(fired)})")
+
+
 def check_deadline(report: InvariantReport, elapsed_s: float,
                    limit_s: float, name: str = "recovery_deadline") -> bool:
     ok = math.isfinite(elapsed_s) and elapsed_s <= limit_s

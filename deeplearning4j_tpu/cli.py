@@ -291,7 +291,7 @@ def serve_main(argv) -> int:
     print(f"listening on http://{args.host}:{server.port} "
           "(POST /predict, /predict_npy"
           + (", /generate" if generation is not None else "")
-          + ", /reload; GET /healthz, /metrics)",
+          + ", /reload; GET /healthz, /metrics, /alerts)",
           flush=True)
     if args.smoke:
         import http.client
@@ -366,7 +366,7 @@ def _serve_registry(args) -> int:
     print(f"listening on http://{args.host}:{server.port} "
           "(POST /models/<name>/predict|generate, /predict with a "
           "\"model\" key; GET /models/<name>/healthz, /healthz, "
-          "/metrics)", flush=True)
+          "/metrics, /alerts)", flush=True)
     if args.smoke:
         import http.client
         import json as _json
@@ -403,40 +403,139 @@ def _serve_registry(args) -> int:
 
 
 def flight_dump_main(argv) -> int:
-    """``flight-dump`` subcommand: render a flight-recorder dump
+    """``flight-dump`` subcommand: render flight-recorder dumps
     (obs/flight.py) as a human-readable event timeline — the postmortem
-    reader for a diverged/killed run's black box."""
+    reader for a diverged/killed run's black box. Several files (or a
+    directory holding more than one ``flight_recorder_<pid>.json`` —
+    the trainer's and the server's rings over one deployment) merge
+    into ONE time-ordered timeline with each event's pid inline."""
     import json as _json
 
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu flight-dump",
-        description="Read a flight-recorder dump: one line per event, "
-                    "newest last",
+        description="Read flight-recorder dump(s): one line per event, "
+                    "newest last; multiple dumps (or a directory of "
+                    "them) merge into one time-ordered timeline",
     )
-    ap.add_argument("path",
-                    help="dump file, or a directory (e.g. the checkpoint "
-                         "dir) holding flight_recorder_*.json")
+    ap.add_argument("paths", nargs="+",
+                    help="dump file(s), and/or directories (e.g. the "
+                         "checkpoint dir) holding flight_recorder_*.json "
+                         "— ALL dumps found are merged by timestamp")
     ap.add_argument("--last", type=int, default=None,
                     help="only the newest N events")
     ap.add_argument("--json", action="store_true",
                     help="raw JSON body instead of the rendered timeline")
     args = ap.parse_args(argv)
 
-    from deeplearning4j_tpu.obs.flight import find_dump, format_dump
+    from deeplearning4j_tpu.obs.flight import (
+        find_dumps,
+        format_dump,
+        merge_dumps,
+    )
 
-    try:
-        path = find_dump(args.path)
-    except FileNotFoundError as e:
-        print(str(e), file=sys.stderr)
-        return 1
-    with open(path) as f:
-        body = _json.load(f)
+    files = []
+    for p in args.paths:
+        found = find_dumps(p)
+        if not found:
+            print(f"no flight-recorder dump at {p!r}", file=sys.stderr)
+            return 1
+        files.extend(f for f in found if f not in files)
+    bodies = []
+    for path in files:
+        with open(path) as f:
+            bodies.append(_json.load(f))
+    body = bodies[0] if len(bodies) == 1 else merge_dumps(bodies)
     if args.json:
         print(_json.dumps(body, indent=1))
     else:
-        print(f"{path}:")
+        print(":\n".join(files) + ":")
         print(format_dump(body, last=args.last))
     return 0
+
+
+def alerts_main(argv) -> int:
+    """``alerts`` subcommand: the operator view of a live process's
+    SLO alert engine — fetch ``GET /alerts`` from a serving or
+    training metrics endpoint and render the verdict + rule states
+    (one-shot), or ``--watch`` it. Polling IS evaluation: the engine
+    ticks on scrape, so a watched process is a monitored process.
+    Exit code (one-shot): 0 healthy/degraded, 2 critical — wire it
+    straight into rollout gates."""
+    import json as _json
+    import urllib.request
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu alerts",
+        description="Render a live process's /alerts: health verdict, "
+                    "firing/pending/ok rule states, reasons",
+    )
+    ap.add_argument("url",
+                    help="base URL of a serving or --metrics-port "
+                         "endpoint (e.g. http://127.0.0.1:8080); "
+                         "/alerts is appended unless the path already "
+                         "names it")
+    ap.add_argument("--watch", nargs="?", const=2.0, type=float,
+                    default=None, metavar="SECONDS",
+                    help="re-poll every N seconds (default 2) until "
+                         "interrupted")
+    ap.add_argument("--json", action="store_true",
+                    help="raw JSON body instead of the rendered table")
+    ap.add_argument("--firing-only", action="store_true",
+                    help="only pending/firing rules in the table")
+    args = ap.parse_args(argv)
+
+    url = args.url.rstrip("/")
+    if not url.endswith("/alerts"):
+        url += "/alerts"
+
+    def fetch() -> dict:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return _json.loads(resp.read())
+
+    def render(body: dict) -> str:
+        v = body.get("verdict", {})
+        lines = [f"verdict: {v.get('status', '?').upper()} "
+                 f"({v.get('n_firing', 0)} firing / "
+                 f"{v.get('n_rules', 0)} rules, "
+                 f"ticks={body.get('ticks')})"]
+        for st in body.get("alerts", []):
+            if args.firing_only and st.get("state") == "ok":
+                continue
+            mark = {"firing": "!!", "pending": " ~"}.get(
+                st.get("state"), "  ")
+            val = st.get("value")
+            lines.append(
+                f"{mark} {st.get('state', '?'):<8} "
+                f"{st.get('severity', '?'):<8} {st.get('name'):<38} "
+                f"{'' if val is None else f'value={val:.6g} '}"
+                f"{st.get('reason', '')}".rstrip())
+        return "\n".join(lines)
+
+    try:
+        body = fetch()
+    except OSError as e:
+        print(f"cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    if args.watch is None:
+        print(_json.dumps(body, indent=1) if args.json else render(body))
+        return 2 if body.get("verdict", {}).get("status") == "critical" \
+            else 0
+    try:
+        while True:
+            print(_json.dumps(body, indent=1) if args.json
+                  else render(body), flush=True)
+            while True:
+                time.sleep(max(float(args.watch), 0.1))
+                try:
+                    body = fetch()
+                    break
+                except OSError as e:
+                    # do NOT re-render the last good verdict: a dead
+                    # server re-printed as "HEALTHY" every interval
+                    # would mask exactly the outage being watched
+                    print(f"poll failed: {e}", file=sys.stderr)
+    except KeyboardInterrupt:
+        return 0
 
 
 def lint_main(argv) -> int:
@@ -478,12 +577,20 @@ def lint_main(argv) -> int:
     ap.add_argument("--events-table", action="store_true",
                     help="print the generated flight-event/seam table "
                          "(the block ARCHITECTURE.md embeds) and exit")
+    ap.add_argument("--alerts-table", action="store_true",
+                    help="print the generated SLO alert-rule table "
+                         "(the block ARCHITECTURE.md embeds) and exit")
     args = ap.parse_args(argv)
 
     if args.events_table:
         from deeplearning4j_tpu.analysis.tables import render_event_table
 
         print(render_event_table())
+        return 0
+    if args.alerts_table:
+        from deeplearning4j_tpu.analysis.tables import render_alert_table
+
+        print(render_alert_table())
         return 0
 
     import deeplearning4j_tpu as _pkg
@@ -762,6 +869,8 @@ def main(argv=None) -> int:
         return tune_main(argv[1:])
     if argv[:1] == ["flight-dump"]:
         return flight_dump_main(argv[1:])
+    if argv[:1] == ["alerts"]:
+        return alerts_main(argv[1:])
     if argv[:1] == ["chaos"]:
         return chaos_main(argv[1:])
     if argv[:1] == ["lint"]:
@@ -808,8 +917,10 @@ def main(argv=None) -> int:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="expose training metrics over HTTP on this port "
                          "(GET /metrics: JSON, or Prometheus text via "
-                         "Accept/?format=prometheus, plus /debug/flight "
-                         "and /debug/profile); implies --telemetry")
+                         "Accept/?format=prometheus, plus /alerts, the "
+                         "verdict-enriched /healthz, /debug/flight "
+                         "[?since_seq=N incremental] and /debug/profile); "
+                         "implies --telemetry")
     ap.add_argument("--flight-dir", default=None,
                     help="flight recorder black box: record training "
                          "events into a bounded ring and dump them here "

@@ -32,8 +32,21 @@ pipelined training loop (train/pipeline.py) removed.
   (``Compiled.cost_analysis``), model-FLOPs-utilization and bytes/sec
   gauges against the measured throughput, and the guarded on-demand
   ``jax.profiler`` capture.
+- :mod:`obs.alerts` / :mod:`obs.slo` — the detection half: declarative
+  alert rules (threshold / rate / absence / multi-window SLO burn
+  rate) with a pending→firing→resolved hysteresis machine, evaluated
+  against the registry + flight ring on injected-clock ticks; the
+  default rule pack codifies the stack's known failure smells, the
+  canary gate runs on the same engine, and ``/alerts`` + the
+  verdict-enriched ``/healthz`` expose the firing set.
 """
 
+from deeplearning4j_tpu.obs.alerts import (  # noqa: F401
+    AlertEvaluator,
+    AlertRule,
+    HealthVerdict,
+    SLOObjective,
+)
 from deeplearning4j_tpu.obs.metrics import (  # noqa: F401
     Counter,
     Gauge,

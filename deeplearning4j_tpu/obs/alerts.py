@@ -1,0 +1,632 @@
+"""Declarative alert rules + SLO burn-rate evaluation over the metrics
+registry and the flight ring — the detection half of the obs/ stack.
+
+Everything under obs/ so far *records*: the metrics registry aggregates,
+the flight ring keeps forensics, the chaos matrix proves recovery. But
+nothing *watches* — an operator only learns that ``jit_retraces_total``
+is climbing, 503s are burning the error budget, or the newest checkpoint
+is hours stale by reading ``/metrics`` themselves. This module turns
+those signals into typed, timestamped verdicts:
+
+- :class:`AlertRule` — one declarative rule: a **signal** (a metric +
+  labels, a whole counter family summed, or a callable for gates that
+  compare live object state, like the canary gate) and a **condition**
+  of one of five kinds: ``threshold`` (value vs bound), ``increase``
+  (counter delta over a trailing window), ``rate`` (delta/second over a
+  window), ``absence`` (a counter stopped advancing for ``stale_s`` —
+  the staleness/liveness alert), and ``burn_rate`` (multi-window SLO
+  error-budget burn: the classic SRE page fires only when BOTH the long
+  and the short window burn faster than ``burn × budget``, so a spike
+  that already ended cannot page).
+
+- :class:`AlertEvaluator` — evaluates a rule set against a
+  :class:`~deeplearning4j_tpu.obs.metrics.MetricsRegistry` on an
+  **injected-clock tick** (tests drive a fake clock through hold times;
+  production surfaces tick on scrape, the Prometheus model — evaluation
+  happens as often as someone is watching). Each rule runs a
+  ``pending → firing → resolved`` hysteresis state machine:
+  a condition must hold for ``for_s`` before firing (flap suppression
+  on the way up) and must stay clear for ``resolve_s`` before resolving
+  (flap suppression on the way down); a brief dip while firing neither
+  resolves nor re-fires. Transitions are recorded to the flight ring
+  (``alert_pending`` / ``alert_fired`` / ``alert_resolved`` — declared
+  in obs/events.py like every other forensic event) and mirrored as
+  ``alert_firing{alert=}`` gauges, so a dump reads fault → alert in
+  order and a scraper sees the firing set.
+
+- :class:`HealthVerdict` — the process-level aggregation ``/healthz``
+  carries: ``healthy`` (nothing firing), ``degraded`` (warnings
+  firing), ``critical`` (any critical firing), ``unknown`` (never
+  ticked).
+
+- :meth:`AlertEvaluator.watch_flight` — counts every flight event into
+  ``flight_events_total{kind=}`` counters in the evaluator's registry,
+  so rules can alert on forensic events (NaN-skips, decode stalls,
+  lock cycles, publish refusals) with the same machinery as metric
+  rules. This is how the chaos drill matrix verifies *detection*: each
+  injected fault must trip exactly the alert that claims to cover it
+  (``expected_alerts`` in chaos/drills.py).
+
+The default production rule set lives in :mod:`obs.slo`; the canary
+gate in serving/registry.py builds its per-window rules through
+:func:`~deeplearning4j_tpu.obs.slo.canary_gate_rules`, so deployment
+gating and SLO alerting are ONE evaluation mechanism.
+
+Stdlib-only on purpose (like obs/events.py): the analyzer and the CLI
+import this without touching jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+#: the counter family :meth:`AlertEvaluator.watch_flight` maintains —
+#: one labeled counter per flight-event kind, so rules alert on
+#: forensic events with the same machinery as any metric
+FLIGHT_EVENT_METRIC = "flight_events_total"
+
+_KINDS = ("threshold", "increase", "rate", "absence", "burn_rate")
+_SEVERITIES = ("warn", "critical")
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+class SLOObjective:
+    """One service-level objective: ``bad`` and ``total`` counter
+    families (names, or lists of names summed together) and the
+    ``target`` success fraction. ``budget`` is the allowed error ratio
+    (``1 - target``); a burn-rate rule fires when the observed error
+    ratio exceeds ``burn × budget`` over every one of its windows."""
+
+    def __init__(self, name: str, bad, total, target: float = 0.99):
+        self.name = str(name)
+        self.bad = [bad] if isinstance(bad, str) else list(bad)
+        self.total = [total] if isinstance(total, str) else list(total)
+        self.target = float(target)
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(f"SLO target must be in (0, 1), got {target}")
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "bad": list(self.bad),
+                "total": list(self.total), "target": self.target}
+
+
+class AlertRule:
+    """One declarative alert: signal + condition + hysteresis.
+
+    Signal (exactly one, except ``burn_rate`` which uses ``objective``):
+
+    - ``metric`` (+ optional ``labels``): one registered metric's value;
+    - ``family``: a whole counter family summed across label sets;
+    - ``fn``: a callable returning ``None`` (no data — condition is
+      false), a float, or ``(float, reason)`` — the escape hatch for
+      gates comparing live object state (the canary gate).
+
+    Condition kinds:
+
+    - ``threshold``: ``value <op> threshold``.
+    - ``increase``: the signal grew by more than ``threshold`` over the
+      trailing ``window_s`` (counters: "this event happened").
+    - ``rate``: per-second growth over ``window_s`` ``<op> threshold``.
+    - ``absence``: the signal has not CHANGED for ``stale_s`` seconds —
+      the staleness alert (checkpoints stopped landing, publishes
+      stopped). With ``require_activity=True`` (default) the rule arms
+      only after the signal moved once, so a process that never
+      checkpoints by design cannot page.
+    - ``burn_rate``: for EVERY ``(window_s, burn)`` in ``windows``, the
+      error ratio of ``objective`` over that trailing window is at
+      least ``burn × objective.budget`` (and traffic was seen).
+
+    Hysteresis: the condition must hold ``for_s`` before firing and
+    stay clear ``resolve_s`` before resolving. ``annotate(value)``
+    overrides the firing reason text.
+
+    Rule ``name``s are part of the observable schema: the static
+    analyzer (rule ``alert-schema``) requires every literal name at an
+    ``AlertRule(...)`` construction site to be declared in
+    ``obs/events.py ALERTS``, exactly like flight-event kinds.
+    """
+
+    def __init__(self, name: str, kind: str, *, severity: str = "warn",
+                 description: str = "",
+                 metric: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 family: Optional[str] = None,
+                 fn: Optional[Callable[[], object]] = None,
+                 op: str = ">", threshold: float = 0.0,
+                 window_s: float = 300.0,
+                 stale_s: Optional[float] = None,
+                 require_activity: bool = True,
+                 objective: Optional[SLOObjective] = None,
+                 windows: Optional[Sequence[Tuple[float, float]]] = None,
+                 for_s: float = 0.0, resolve_s: float = 0.0,
+                 annotate: Optional[Callable[[float], str]] = None):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown alert kind {kind!r} "
+                             f"(known: {_KINDS})")
+        if severity not in _SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r} "
+                             f"(known: {_SEVERITIES})")
+        if op not in _OPS:
+            raise ValueError(f"unknown op {op!r} (known: {sorted(_OPS)})")
+        if kind == "burn_rate":
+            if objective is None or not windows:
+                raise ValueError(
+                    f"{name}: burn_rate rules need objective= and "
+                    "windows=[(window_s, burn), ...]")
+        else:
+            sources = [s for s in (metric, family, fn) if s is not None]
+            if len(sources) != 1:
+                raise ValueError(
+                    f"{name}: exactly one of metric=/family=/fn= "
+                    f"required, got {len(sources)}")
+        if kind == "absence" and stale_s is None:
+            raise ValueError(f"{name}: absence rules need stale_s=")
+        self.name = str(name)
+        self.kind = kind
+        self.severity = severity
+        self.description = description
+        self.metric = metric
+        self.labels = dict(labels) if labels else None
+        self.family = family
+        self.fn = fn
+        self.op = op
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.stale_s = None if stale_s is None else float(stale_s)
+        self.require_activity = bool(require_activity)
+        self.objective = objective
+        self.windows = ([(float(w), float(b)) for w, b in windows]
+                        if windows else None)
+        self.for_s = float(for_s)
+        self.resolve_s = float(resolve_s)
+        self.annotate = annotate
+
+    # -- signal description (for tables / snapshots) ------------------------
+    def signal_text(self) -> str:
+        if self.kind == "burn_rate":
+            o = self.objective
+            return (f"SLO {o.name}: bad={'+'.join(o.bad)} / "
+                    f"total={'+'.join(o.total)}")
+        if self.metric is not None:
+            lbl = ("{" + ",".join(f"{k}={v}"
+                                  for k, v in sorted(self.labels.items()))
+                   + "}") if self.labels else ""
+            return f"{self.metric}{lbl}"
+        if self.family is not None:
+            return f"sum({self.family})"
+        return f"fn:{getattr(self.fn, '__name__', 'callable')}"
+
+    def condition_text(self) -> str:
+        if self.kind == "threshold":
+            return f"value {self.op} {self.threshold:g}"
+        if self.kind == "increase":
+            return (f"increase {self.op} {self.threshold:g} "
+                    f"over {self.window_s:g}s")
+        if self.kind == "rate":
+            return (f"rate/s {self.op} {self.threshold:g} "
+                    f"over {self.window_s:g}s")
+        if self.kind == "absence":
+            return f"no change for {self.stale_s:g}s"
+        budget = self.objective.budget
+        legs = " AND ".join(f"{b:g}x budget over {w:g}s"
+                            for w, b in self.windows)
+        return f"error ratio >= {legs} (budget {budget:g})"
+
+    def to_dict(self) -> dict:
+        out = {"name": self.name, "kind": self.kind,
+               "severity": self.severity, "signal": self.signal_text(),
+               "condition": self.condition_text(),
+               "for_s": self.for_s, "resolve_s": self.resolve_s,
+               "description": self.description}
+        if self.objective is not None:
+            out["objective"] = self.objective.to_dict()
+        return out
+
+
+class _RuleState:
+    """Per-rule runtime state: sample ring + the hysteresis machine."""
+
+    __slots__ = ("rule", "state", "since", "pending_since", "clear_since",
+                 "fired_at", "fire_count", "last_value", "reason",
+                 "samples", "last_change_t", "activity_seen")
+
+    def __init__(self, rule: AlertRule, now: float):
+        self.rule = rule
+        self.state = "ok"  # ok | pending | firing
+        self.since = now
+        self.pending_since: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.fired_at: Optional[float] = None
+        self.fire_count = 0
+        self.last_value: Optional[float] = None
+        self.reason = ""
+        #: (t, value) for scalar kinds; (t, bad, total) for burn_rate
+        self.samples: deque = deque(maxlen=512)
+        self.last_change_t: Optional[float] = None
+        self.activity_seen = False
+
+    def to_dict(self) -> dict:
+        return {"name": self.rule.name, "severity": self.rule.severity,
+                "kind": self.rule.kind, "state": self.state,
+                "since": self.since, "value": self.last_value,
+                "fired_at": self.fired_at, "fire_count": self.fire_count,
+                "reason": self.reason,
+                "signal": self.rule.signal_text(),
+                "condition": self.rule.condition_text(),
+                "description": self.rule.description}
+
+
+class HealthVerdict:
+    """Process-level aggregation of the firing set — what ``/healthz``
+    carries next to its liveness fields. ``critical`` when any critical
+    alert fires, ``degraded`` when only warnings fire, ``healthy`` when
+    nothing fires, ``unknown`` before the first tick."""
+
+    __slots__ = ("status", "firing", "n_rules", "ticks", "evaluated_at")
+
+    def __init__(self, status: str, firing: List[dict], n_rules: int,
+                 ticks: int, evaluated_at: Optional[float]):
+        self.status = status
+        self.firing = firing
+        self.n_rules = n_rules
+        self.ticks = ticks
+        self.evaluated_at = evaluated_at
+
+    @property
+    def healthy(self) -> bool:
+        return self.status in ("healthy", "unknown")
+
+    def to_dict(self) -> dict:
+        return {"status": self.status,
+                "firing": self.firing,
+                "n_firing": len(self.firing),
+                "n_rules": self.n_rules,
+                "ticks": self.ticks,
+                "evaluated_at": self.evaluated_at}
+
+
+class AlertEvaluator:
+    """Evaluates an :class:`AlertRule` set against a metrics registry on
+    explicit clock ticks.
+
+    ``clock`` is injectable (tests drive hold times through a fake
+    clock; everything else uses ``time.monotonic``). ``context`` fields
+    ride on every recorded alert event (the canary evaluator tags its
+    events with model/version). ``recorder=None`` uses the process
+    default flight recorder lazily; pass an explicit recorder (or
+    ``record_events=False``) to keep an isolated evaluator out of the
+    shared ring.
+    """
+
+    def __init__(self, rules: Sequence[AlertRule], registry=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder=None, context: Optional[dict] = None,
+                 min_tick_interval: float = 1.0,
+                 record_events: bool = True):
+        from deeplearning4j_tpu.obs.lockwitness import witnessed_rlock
+        from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self.clock = clock
+        self.recorder = recorder
+        self.context = dict(context or {})
+        self.min_tick_interval = float(min_tick_interval)
+        self.record_events = bool(record_events)
+        names = [r.name for r in rules]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"duplicate alert rule names: {sorted(dupes)}")
+        self._lock = witnessed_rlock("alerts.evaluator")
+        now = self.clock()
+        self._states: "Dict[str, _RuleState]" = {
+            r.name: _RuleState(r, now) for r in rules}
+        self.ticks = 0
+        self.last_tick_at: Optional[float] = None
+        self._last_tick_wall: Optional[float] = None
+        self._unwatch: Optional[Callable[[], None]] = None
+
+    # -- flight-event counting ----------------------------------------------
+    def watch_flight(self, recorder=None) -> Callable[[], None]:
+        """Count every event the flight recorder appends from now on
+        into ``flight_events_total{kind=}`` counters in this
+        evaluator's registry, so rules alert on forensic events.
+        Returns (and remembers) the unsubscribe callable."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        rec = (recorder if recorder is not None
+               else _flight.default_flight_recorder())
+        registry = self.registry
+
+        def observer(ev: dict) -> None:
+            registry.counter(
+                FLIGHT_EVENT_METRIC,
+                "flight events observed by the alert evaluator, by kind",
+                labels={"kind": str(ev.get("kind"))}).inc()
+
+        self._unwatch = rec.add_observer(observer)
+        return self._unwatch
+
+    def unwatch(self) -> None:
+        if self._unwatch is not None:
+            self._unwatch()
+            self._unwatch = None
+
+    # -- signal reads --------------------------------------------------------
+    def _read_scalar(self, rule: AlertRule):
+        """Returns (value, reason) — value None means "no data"."""
+        if rule.fn is not None:
+            out = rule.fn()
+            if out is None:
+                return None, ""
+            if isinstance(out, tuple):
+                return (None if out[0] is None else float(out[0]),
+                        str(out[1]))
+            return float(out), ""
+        if rule.family is not None:
+            return float(self.registry.family_sum(rule.family)), ""
+        m = self.registry.get(rule.metric, rule.labels)
+        if m is None:
+            return None, ""
+        return float(m.value()), ""
+
+    @staticmethod
+    def _baseline(samples, now: float, window_s: float):
+        """The newest sample at least ``window_s`` old (the window
+        edge), else the oldest available — increase/rate are measured
+        against it."""
+        base = None
+        for s in samples:
+            if s[0] <= now - window_s:
+                base = s
+            else:
+                break
+        return base if base is not None else (samples[0] if samples
+                                              else None)
+
+    # -- condition evaluation ------------------------------------------------
+    def _condition(self, st: _RuleState, now: float):
+        """Returns (cond, value, reason)."""
+        rule = st.rule
+        if rule.kind == "burn_rate":
+            bad = sum(self.registry.family_sum(f) for f in
+                      rule.objective.bad)
+            total = sum(self.registry.family_sum(f) for f in
+                        rule.objective.total)
+            st.samples.append((now, float(bad), float(total)))
+            budget = rule.objective.budget
+            worst = 0.0
+            for w_s, burn in rule.windows:
+                base = self._baseline(st.samples, now, w_s)
+                if base[0] < now - 2.0 * w_s:
+                    # the newest sample old enough to bound this window
+                    # is MORE than a window older than the edge: a
+                    # scrape gap wider than the window itself. Measuring
+                    # across the gap would fold long-dead errors into
+                    # the "burning NOW" leg (the short window exists to
+                    # prove recency) — insufficient history, no verdict.
+                    return False, worst, ""
+                d_bad = bad - base[1]
+                d_total = total - base[2]
+                if d_total <= 0:
+                    return False, worst, ""
+                ratio = d_bad / d_total
+                worst = max(worst, ratio)
+                if ratio < burn * budget:
+                    return False, ratio, ""
+            return True, worst, (
+                f"error ratio {worst:.4g} burning >= "
+                f"{rule.windows[-1][1]:g}x the {budget:g} budget "
+                f"on every window")
+        value, reason = self._read_scalar(rule)
+        if value is None:
+            if rule.kind == "threshold" or rule.fn is not None:
+                # no data is no verdict for point-in-time checks and
+                # fn signals (the canary gate's "not enough samples")
+                return False, st.last_value, reason
+            # counter kinds (increase/rate/absence): a metric that does
+            # not exist yet IS zero — the baseline tick must sample 0
+            # so the first real increment registers as an increase
+            value = 0.0
+        if rule.kind == "threshold":
+            cond = _OPS[rule.op](value, rule.threshold)
+            return cond, value, reason or (
+                f"value {value:.6g} {rule.op} {rule.threshold:g}")
+        # sampled kinds share the ring
+        prev = st.samples[-1] if st.samples else None
+        st.samples.append((now, value))
+        if prev is not None and value != prev[1]:
+            st.last_change_t = now
+            st.activity_seen = True
+        elif st.last_change_t is None:
+            st.last_change_t = now
+        if rule.kind == "absence":
+            if rule.require_activity and not st.activity_seen:
+                return False, value, ""
+            stale = now - (st.last_change_t
+                           if st.last_change_t is not None else now)
+            return stale > rule.stale_s, value, (
+                f"no change for {stale:.6g}s (limit {rule.stale_s:g}s)")
+        base = self._baseline(st.samples, now, rule.window_s)
+        if base is None or base[0] >= now:
+            return False, value, ""
+        delta = value - base[1]
+        if rule.kind == "increase":
+            cond = _OPS[rule.op](delta, rule.threshold)
+            return cond, delta, reason or (
+                f"grew by {delta:.6g} in {now - base[0]:.6g}s")
+        rate = delta / (now - base[0])
+        cond = _OPS[rule.op](rate, rule.threshold)
+        return cond, rate, reason or (
+            f"rate {rate:.6g}/s {rule.op} {rule.threshold:g}/s")
+
+    # -- the tick ------------------------------------------------------------
+    def _record(self, kind: str, st: _RuleState) -> None:
+        if not self.record_events:
+            return
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        rec = (self.recorder if self.recorder is not None
+               else _flight.default_flight_recorder())
+        rec.record(kind, alert=st.rule.name, severity=st.rule.severity,
+                   value=st.last_value, reason=st.reason, **self.context)
+
+    def _gauge_labels(self, st: _RuleState) -> Dict[str, str]:
+        # context fields (e.g. the canary evaluator's model/version)
+        # are part of the gauge identity: two windows sharing a
+        # registry must not write — or zero on shutdown — each other's
+        # alert_firing series
+        return {"alert": st.rule.name,
+                **{k: str(v) for k, v in self.context.items()}}
+
+    def _gauge(self, st: _RuleState) -> None:
+        self.registry.gauge(
+            "alert_firing", "1 while the named alert is firing",
+            labels=self._gauge_labels(st)).set(
+                1.0 if st.state == "firing" else 0.0)
+
+    def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Evaluate every rule once; returns the state dicts. Drives
+        the pending→firing→resolved machine and records transitions."""
+        with self._lock:
+            now = self.clock() if now is None else float(now)
+            self.ticks += 1
+            self.last_tick_at = now
+            self._last_tick_wall = time.monotonic()
+            for st in self._states.values():
+                cond, value, reason = self._condition(st, now)
+                if value is not None:
+                    st.last_value = value
+                if cond:
+                    st.clear_since = None
+                    if st.state == "ok":
+                        st.state = "pending"
+                        st.since = now
+                        st.pending_since = now
+                        st.reason = reason
+                        self._record("alert_pending", st)
+                    if st.state == "pending" and \
+                            now - st.pending_since >= st.rule.for_s:
+                        st.state = "firing"
+                        st.since = now
+                        st.fired_at = now
+                        st.fire_count += 1
+                        st.reason = (st.rule.annotate(value)
+                                     if st.rule.annotate is not None
+                                     and value is not None else reason)
+                        self._record("alert_fired", st)
+                        self._gauge(st)
+                    elif st.state == "firing":
+                        st.reason = (st.rule.annotate(value)
+                                     if st.rule.annotate is not None
+                                     and value is not None else reason)
+                else:
+                    if st.state == "pending":
+                        # flapped before the hold elapsed: suppressed
+                        st.state = "ok"
+                        st.since = now
+                        st.pending_since = None
+                    elif st.state == "firing":
+                        if st.clear_since is None:
+                            st.clear_since = now
+                        if now - st.clear_since >= st.rule.resolve_s:
+                            st.state = "ok"
+                            st.since = now
+                            st.pending_since = None
+                            st.clear_since = None
+                            self._record("alert_resolved", st)
+                            self._gauge(st)
+            return [st.to_dict() for st in self._states.values()]
+
+    def maybe_tick(self) -> bool:
+        """Tick unless one ran within ``min_tick_interval`` (wall
+        clock) — the scrape-driven surfaces call this so a burst of
+        /alerts requests costs one evaluation."""
+        with self._lock:
+            if (self._last_tick_wall is not None
+                    and time.monotonic() - self._last_tick_wall
+                    < self.min_tick_interval):
+                return False
+            self.tick()
+            return True
+
+    def shutdown(self) -> None:
+        """Detach from the flight recorder and zero this evaluator's
+        ``alert_firing`` gauges (a torn-down canary window must not
+        leave a stale 1 on the shared registry)."""
+        self.unwatch()
+        with self._lock:
+            for st in self._states.values():
+                g = self.registry.get("alert_firing",
+                                      self._gauge_labels(st))
+                if g is not None:
+                    g.set(0.0)
+
+    # -- reads ---------------------------------------------------------------
+    def states(self) -> List[dict]:
+        with self._lock:
+            return [st.to_dict() for st in self._states.values()]
+
+    def firing(self) -> List[dict]:
+        with self._lock:
+            return [st.to_dict() for st in self._states.values()
+                    if st.state == "firing"]
+
+    def fired_names(self) -> List[str]:
+        """Rules that have fired at least once in this evaluator's
+        lifetime (the chaos drills' detection scorecard)."""
+        with self._lock:
+            return sorted(st.rule.name for st in self._states.values()
+                          if st.fire_count > 0)
+
+    def verdict(self) -> HealthVerdict:
+        with self._lock:
+            if self.ticks == 0:
+                return HealthVerdict("unknown", [],
+                                     len(self._states), 0, None)
+            firing = [st.to_dict() for st in self._states.values()
+                      if st.state == "firing"]
+            if any(f["severity"] == "critical" for f in firing):
+                status = "critical"
+            elif firing:
+                status = "degraded"
+            else:
+                status = "healthy"
+            return HealthVerdict(status, firing, len(self._states),
+                                 self.ticks, self.last_tick_at)
+
+    def snapshot(self) -> dict:
+        """JSON-ready body shared by ``GET /alerts`` on both HTTP
+        surfaces and ``cli alerts``."""
+        with self._lock:
+            return {"verdict": self.verdict().to_dict(),
+                    "alerts": [st.to_dict()
+                               for st in self._states.values()],
+                    "ticks": self.ticks,
+                    "last_tick_at": self.last_tick_at}
+
+    def prometheus_text(self) -> str:
+        """Prometheus-style firing list (the ``ALERTS`` convention:
+        one series per pending/firing alert)."""
+        lines = ["# TYPE ALERTS gauge"]
+        with self._lock:
+            for st in self._states.values():
+                if st.state == "ok":
+                    continue
+                lines.append(
+                    f'ALERTS{{alertname="{st.rule.name}",'
+                    f'alertstate="{st.state}",'
+                    f'severity="{st.rule.severity}"}} 1')
+        return "\n".join(lines) + "\n"

@@ -169,6 +169,16 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
                    "the lock witness saw an acquisition-order cycle "
                    "(ABBA deadlock pattern); typed "
                    "LockOrderViolationError under strict arming"),
+    # -- alerting (obs/alerts.py) -----------------------------------------
+    "alert_pending": ("obs/alerts.py",
+                      "an alert rule's condition became true; the "
+                      "for_s hold is running"),
+    "alert_fired": ("obs/alerts.py",
+                    "an alert fired (hold elapsed) — name, severity, "
+                    "value and reason attached"),
+    "alert_resolved": ("obs/alerts.py",
+                       "a firing alert's condition stayed clear for "
+                       "resolve_s; back to ok"),
 }
 
 #: chaos hook-point names production code may pass to
@@ -205,9 +215,83 @@ HOOK_POINTS: Dict[str, tuple] = {
 }
 
 
+#: alert rule names the SLO engine may construct (obs/alerts.py
+#: AlertRule). Values are (producer module, description). The static
+#: analyzer (rule ``alert-schema``) requires every literal name at an
+#: ``AlertRule(...)`` site to be declared here — a typo'd name would
+#: silently break a drill's ``expected_alerts`` detection check, and an
+#: undeclared one is an alert nobody documented. The ARCHITECTURE
+#: alert-rule table regenerates from the rule pack (obs/slo.py), whose
+#: names a test asserts are exactly this set.
+ALERTS: Dict[str, tuple] = {
+    "retrace_storm": ("obs/slo.py",
+                      "jitted functions re-traced in steady state"),
+    "serving_error_budget_burn": ("obs/slo.py",
+                                  "503/error/deadline ratio burning the "
+                                  "serving SLO on long AND short "
+                                  "windows"),
+    "serving_queue_saturated": ("obs/slo.py",
+                                "request queue sustained near its "
+                                "limit"),
+    "data_queue_starved": ("obs/slo.py",
+                           "fit loop starved by the input pipeline "
+                           "(input-bound verdict)"),
+    "data_queue_saturated": ("obs/slo.py",
+                             "producer blocked on a full prefetch "
+                             "queue (compute-bound verdict)"),
+    "nan_step_storm": ("obs/slo.py",
+                       "non-finite gradient steps being skipped"),
+    "training_diverged": ("obs/slo.py",
+                          "divergence tripwire fired; fit died typed"),
+    "storage_errors": ("obs/slo.py",
+                       "durable writes failing typed (disk "
+                       "full/failing)"),
+    "checkpoint_stale": ("obs/slo.py",
+                         "checkpoints stopped landing (staleness)"),
+    "checkpoint_fallbacks": ("obs/slo.py",
+                             "corrupt checkpoints being skipped at "
+                             "load"),
+    "decode_stalled": ("obs/slo.py",
+                       "decode dispatch hung past the watchdog"),
+    "decode_errors": ("obs/slo.py", "decode dispatches raising"),
+    "overload_rejections": ("obs/slo.py",
+                            "sustained typed backpressure rejections"),
+    "publish_refused": ("obs/slo.py",
+                        "validation gate refusing snapshots"),
+    "publish_stale": ("obs/slo.py",
+                      "continuous publishing stopped (staleness)"),
+    "canary_rolled_back": ("obs/slo.py",
+                           "canary versions auto-rolling back"),
+    "mesh_shrunk": ("obs/slo.py",
+                    "running degraded on a survivor mesh"),
+    "elastic_giveup": ("obs/slo.py",
+                       "elastic recovery exhausted; human needed"),
+    "kernel_fallbacks": ("obs/slo.py",
+                         "Pallas kernels falling back to reference "
+                         "paths"),
+    "lock_cycle_detected": ("obs/slo.py",
+                            "lock witness saw an ABBA ordering cycle"),
+    # the canary gate, expressed in the same engine (serving/registry.py
+    # builds these per canary window via obs/slo.canary_gate_rules)
+    "canary_score_regressed": ("obs/slo.py",
+                               "canary quality score regressed vs "
+                               "active"),
+    "canary_latency_regressed": ("obs/slo.py",
+                                 "canary /predict latency blew the "
+                                 "trip multiplier"),
+    "canary_generation_latency_regressed": (
+        "obs/slo.py",
+        "canary /generate latency blew the trip multiplier"),
+}
+
+
 def is_declared_event(kind: str) -> bool:
     return kind in FLIGHT_EVENTS
 
 
 def is_declared_hook_point(point: str) -> bool:
     return point in HOOK_POINTS
+
+
+def is_declared_alert(name: str) -> bool:
+    return name in ALERTS

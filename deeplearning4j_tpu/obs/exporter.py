@@ -32,13 +32,43 @@ def wants_prometheus(accept_header: str, query: str = "") -> bool:
     return "text/plain" in accept or "openmetrics" in accept
 
 
-def debug_flight_response() -> tuple:
+def debug_flight_response(query: str = "") -> tuple:
     """``GET /debug/flight`` contract shared by this exporter and
     serving/server.py: ``(status, json-ready body)`` — the live default
-    recorder ring, same payload a crash dump would contain."""
+    recorder ring, same payload a crash dump would contain.
+    ``?since_seq=N`` returns only events newer than seq N (pass the
+    response's ``next_since_seq`` back on the next poll — cheap
+    external scraping of the ring instead of whole-ring downloads);
+    ``?last=N`` trims to the newest N."""
     from deeplearning4j_tpu.obs.flight import default_flight_recorder
 
-    return 200, default_flight_recorder().snapshot()
+    qs = parse_qs(query)
+    try:
+        since = qs.get("since_seq", [None])[0]
+        since = None if since is None else int(since)
+        last = qs.get("last", [None])[0]
+        last = None if last is None else int(last)
+    except ValueError as e:
+        return 400, {"error": "ValueError", "message": str(e)}
+    return 200, default_flight_recorder().snapshot(last=last,
+                                                   since_seq=since)
+
+
+def alerts_response(evaluator, accept_header: str, query: str) -> tuple:
+    """``GET /alerts`` contract shared by this exporter and
+    serving/server.py: evaluate (throttled — a scrape burst costs one
+    tick) and return ``(status, body, content-type)``. JSON by default
+    (the full rule states + the health verdict); a Prometheus-style
+    firing list (the ``ALERTS`` series convention) when the client
+    Accepts text/plain/openmetrics or asks ``?format=prometheus`` —
+    one definition so the two surfaces cannot drift."""
+    import json as _json
+
+    evaluator.maybe_tick()
+    if wants_prometheus(accept_header, query):
+        return 200, evaluator.prometheus_text().encode(), PROMETHEUS_CTYPE
+    return (200, _json.dumps(evaluator.snapshot()).encode(),
+            "application/json")
 
 
 def debug_profile_response(query: str) -> tuple:
@@ -62,12 +92,26 @@ def debug_profile_response(query: str) -> tuple:
 
 
 class MetricsServer:
-    """Tiny threaded HTTP server: GET /metrics (negotiated), GET /healthz.
-    ``port=0`` binds an ephemeral port (read back from ``.port``)."""
+    """Tiny threaded HTTP server: GET /metrics (negotiated), GET
+    /healthz (verdict-enriched), GET /alerts (negotiated), plus the
+    /debug endpoints. ``port=0`` binds an ephemeral port (read back
+    from ``.port``).
+
+    ``alerts`` is the :class:`~.alerts.AlertEvaluator` behind /alerts
+    and the /healthz verdict; by default the
+    :func:`~.slo.build_default_evaluator` rule pack over this server's
+    registry, watching the flight ring. Evaluation is scrape-driven
+    (the Prometheus model): each /alerts or /healthz hit runs at most
+    one throttled tick."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1", port: int = 9464):
+                 host: str = "127.0.0.1", port: int = 9464,
+                 alerts=None):
+        from deeplearning4j_tpu.obs.slo import build_default_evaluator
+
         self.registry = registry if registry is not None else default_registry()
+        self.alerts = (alerts if alerts is not None
+                       else build_default_evaluator(registry=self.registry))
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -99,10 +143,19 @@ class MetricsServer:
                                        server.registry.json_text().encode(),
                                        "application/json")
                     elif url.path == "/healthz":
-                        self._send(200, b'{"status": "ok"}',
-                                   "application/json")
+                        server.alerts.maybe_tick()
+                        verdict = server.alerts.verdict()
+                        self._send(200, _json.dumps(
+                            {"status": "ok",
+                             "verdict": verdict.to_dict()}).encode(),
+                            "application/json")
+                    elif url.path == "/alerts":
+                        code, body, ctype = alerts_response(
+                            server.alerts,
+                            self.headers.get("Accept", ""), url.query)
+                        self._send(code, body, ctype)
                     elif url.path == "/debug/flight":
-                        code, obj = debug_flight_response()
+                        code, obj = debug_flight_response(url.query)
                         self._send(code, _json.dumps(obj).encode(),
                                    "application/json")
                     elif url.path == "/debug/profile":
@@ -148,6 +201,9 @@ class MetricsServer:
         if not self._closed:
             self._closed = True
             self._httpd.server_close()
+            # detach the alert evaluator's flight observer so a stopped
+            # server stops counting into its registry
+            self.alerts.unwatch()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -145,18 +145,31 @@ class FlightRecorder:
         with self._lock:
             self._ring.clear()
 
-    def snapshot(self, last: Optional[int] = None) -> dict:
+    def snapshot(self, last: Optional[int] = None,
+                 since_seq: Optional[int] = None) -> dict:
         """JSON-ready view (the ``/debug/flight`` payload and the dump
-        body share this shape)."""
+        body share this shape). ``since_seq`` keeps only events with a
+        HIGHER seq — the incremental-polling contract: a scraper passes
+        the ``next_since_seq`` it got last time and receives only what
+        landed since, instead of re-downloading the whole ring."""
         evs = self.events(last)
         total = self.recorded_total
+        if since_seq is not None:
+            evs = [ev for ev in evs if ev["seq"] > int(since_seq)]
         return {
             "schema_version": SCHEMA_VERSION,
             "pid": os.getpid(),
             "snapshot_at": time.time(),
             "capacity": self.capacity,
             "recorded_total": total,
-            "dropped": max(total - len(evs), 0) if last is None else None,
+            "dropped": (max(total - len(evs), 0)
+                        if last is None and since_seq is None else None),
+            "since_seq": since_seq,
+            # pass this back as ?since_seq= on the next poll; when no
+            # new events landed it echoes the cursor unchanged
+            "next_since_seq": (evs[-1]["seq"] if evs
+                               else (int(since_seq) if since_seq is not None
+                                     else total - 1)),
             "events": [_jsonable(ev) for ev in evs],
         }
 
@@ -252,24 +265,73 @@ def find_dump(path: str) -> str:
     if os.path.isfile(path):
         return path
     if os.path.isdir(path):
-        cands = [os.path.join(path, n) for n in os.listdir(path)
-                 if n.startswith("flight_recorder_")
-                 and n.endswith(".json")]
+        cands = find_dumps(path)
         if cands:
             return max(cands, key=os.path.getmtime)
     raise FileNotFoundError(f"no flight-recorder dump at {path!r}")
 
 
+def find_dumps(path: str) -> List[str]:
+    """ALL flight-recorder dumps a path names: the file itself, or
+    every ``flight_recorder_*.json`` in a directory (sorted by name) —
+    a train+serve pair sharing a checkpoint dir leaves one per pid."""
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        return [os.path.join(path, n) for n in sorted(os.listdir(path))
+                if n.startswith("flight_recorder_")
+                and n.endswith(".json")]
+    return []
+
+
+def merge_dumps(bodies: List[dict]) -> dict:
+    """Merge several dump/snapshot bodies (one per process —
+    typically the trainer's and the server's rings over one
+    deployment) into ONE time-ordered timeline. Events gain a ``pid``
+    field so the rendering shows which process said what; ordering is
+    by wall-clock ``ts`` (the processes share a host, so their clocks
+    agree to well under event granularity), with ``(pid, seq)`` as the
+    tiebreak."""
+    events: List[dict] = []
+    sources = []
+    for body in bodies:
+        pid = body.get("pid")
+        sources.append({"pid": pid,
+                        "reason": body.get("reason", "snapshot"),
+                        "events": len(body.get("events", []))})
+        for ev in body.get("events", []):
+            ev = dict(ev)
+            ev.setdefault("pid", pid)
+            events.append(ev)
+    events.sort(key=lambda ev: (ev.get("ts") or 0.0,
+                                ev.get("pid") or 0, ev.get("seq") or 0))
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "merged": True,
+        "sources": sources,
+        "recorded_total": sum(s["events"] for s in sources),
+        "events": events,
+    }
+
+
 def format_dump(body: dict, last: Optional[int] = None) -> str:
     """Human-readable rendering of a dump/snapshot body (one line per
-    event, newest last) — what ``cli.py flight-dump`` prints."""
-    lines = [
-        f"flight recorder dump: pid={body.get('pid')} "
-        f"reason={body.get('reason', 'snapshot')} "
-        f"events={len(body.get('events', []))} "
-        f"recorded_total={body.get('recorded_total')} "
-        f"dropped={body.get('dropped')}"
-    ]
+    event, newest last) — what ``cli.py flight-dump`` prints. Merged
+    bodies (:func:`merge_dumps`) render one time-ordered timeline with
+    each event's pid inline."""
+    if body.get("merged"):
+        srcs = " ".join(f"pid={s['pid']}({s['events']} ev, "
+                        f"{s['reason']})" for s in body.get("sources", []))
+        lines = [f"flight recorder merged timeline: "
+                 f"{len(body.get('sources', []))} rings — {srcs}"]
+    else:
+        lines = [
+            f"flight recorder dump: pid={body.get('pid')} "
+            f"reason={body.get('reason', 'snapshot')} "
+            f"events={len(body.get('events', []))} "
+            f"recorded_total={body.get('recorded_total')} "
+            f"dropped={body.get('dropped')}"
+        ]
     evs = body.get("events", [])
     if last is not None:
         evs = evs[-int(last):]

@@ -1,0 +1,327 @@
+"""The default SLO/alert rule pack: every failure smell this codebase
+already knows, codified as declarative :class:`~.alerts.AlertRule`\\ s.
+
+Each rule here encodes a lesson an earlier PR learned the hard way —
+retrace storms defeating the jit cache (PR 3/5), NaN-gradient storms
+and divergence (PR 2), disk-full on a durable surface (PR 13), decode
+stalls (PR 11), stale checkpoints/publishes breaking the continuous
+train→serve loop (PR 11), lock-order cycles (PR 14), mesh shrink under
+elastic recovery (PR 8). The chaos drill matrix asserts DETECTION of
+these: each injected fault must trip exactly the alert that claims to
+cover it (``expected_alerts`` in chaos/drills.py), so this pack is
+drill-verified, not aspirational.
+
+Signal sources: aggregate metrics (the shared
+:class:`~.metrics.MetricsRegistry`) for ratios/rates, and the flight
+ring via :meth:`~.alerts.AlertEvaluator.watch_flight`'s
+``flight_events_total{kind=}`` counters for forensic events — one
+evaluation mechanism over both.
+
+The ARCHITECTURE alert-rule table is REGENERATED from this module
+(``cli lint --alerts-table``; ``analysis.tables.render_alert_table``),
+and every rule name constructed anywhere must be declared in
+``obs/events.py ALERTS`` (lint rule ``alert-schema``) — the exact
+discipline flight events already follow.
+
+Stdlib-only on purpose: the analyzer and CLI import this without jax.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from deeplearning4j_tpu.obs.alerts import (
+    FLIGHT_EVENT_METRIC,
+    AlertRule,
+    SLOObjective,
+)
+
+
+def _flight(kind: str) -> dict:
+    """Signal spec for a flight-event counter maintained by
+    ``AlertEvaluator.watch_flight``."""
+    return {"metric": FLIGHT_EVENT_METRIC, "labels": {"kind": kind}}
+
+
+def default_rules(queue_limit: int = 256,
+                  serving_slo_target: float = 0.99,
+                  checkpoint_stale_s: float = 1800.0,
+                  publish_stale_s: float = 3600.0) -> List[AlertRule]:
+    """The production rule pack. Knobs cover the deployment-specific
+    bounds (queue limit, SLO target, staleness budgets); everything
+    else is the codebase's own failure taxonomy."""
+    return [
+        # -- compile / trace discipline (PR 3/5: zero steady-state
+        #    recompiles is a core serving guarantee) -----------------------
+        AlertRule(
+            "retrace_storm", "increase",
+            family="jit_retraces_total", op=">=", threshold=3,
+            window_s=120.0, resolve_s=300.0, severity="warn",
+            description="jitted functions re-traced repeatedly — shape/"
+                        "dtype churn is defeating the jit cache (the "
+                        "steady-state-zero-recompiles guarantee is "
+                        "broken)"),
+        # -- serving availability SLO (multi-window burn rate) -------------
+        AlertRule(
+            "serving_error_budget_burn", "burn_rate",
+            severity="critical", resolve_s=60.0,
+            objective=SLOObjective(
+                "serving_availability",
+                bad=["serving_rejects_total", "serving_errors_total",
+                     "serving_deadline_exceeded_total"],
+                total=["serving_requests_total", "serving_rejects_total"],
+                target=serving_slo_target),
+            windows=[(600.0, 2.0), (60.0, 2.0)],
+            description="503/error/deadline ratio burning the serving "
+                        "error budget on BOTH the long and short window "
+                        "— sustained overload or a bad snapshot, not a "
+                        "spike that already ended"),
+        AlertRule(
+            "serving_queue_saturated", "threshold",
+            metric="serving_queue_depth", op=">=",
+            threshold=max(int(0.75 * queue_limit), 1),
+            for_s=5.0, resolve_s=30.0, severity="warn",
+            description="request queue sustained near its limit — "
+                        "backpressure rejections are imminent; scale "
+                        "out or shed load"),
+        # -- data pipeline: the input-vs-compute-bound verdict --------------
+        AlertRule(
+            "data_queue_starved", "rate",
+            family="data_consumer_wait_seconds_total",
+            op=">", threshold=0.5, window_s=60.0, resolve_s=120.0,
+            severity="warn",
+            description="fit loop blocked >50% of wall time on an empty "
+                        "prefetch queue — the run is INPUT-bound; scale "
+                        "the data pipeline, not the mesh"),
+        AlertRule(
+            "data_queue_saturated", "rate",
+            family="data_producer_wait_seconds_total",
+            op=">", threshold=0.5, window_s=60.0, resolve_s=120.0,
+            severity="warn",
+            description="producer blocked >50% of wall time on a full "
+                        "prefetch queue — the run is COMPUTE-bound "
+                        "(expected at full device utilization; a "
+                        "regression here means the step got slower)"),
+        # -- training faults -------------------------------------------------
+        AlertRule(
+            "nan_step_storm", "increase", severity="warn",
+            resolve_s=300.0, **_flight("nan_skip"),
+            description="non-finite gradient steps skipped — the "
+                        "in-graph guard is absorbing a NaN storm; check "
+                        "loss scale / data"),
+        AlertRule(
+            "training_diverged", "increase", severity="critical",
+            resolve_s=600.0, **_flight("divergence_trip"),
+            description="max consecutive bad steps exceeded; the fit "
+                        "died typed with TrainingDivergedError"),
+        # -- durable storage -------------------------------------------------
+        AlertRule(
+            "storage_errors", "increase", severity="critical",
+            resolve_s=300.0, **_flight("storage_error"),
+            description="a durable write (checkpoint/journal/snapshot) "
+                        "failed typed — disk full or failing; the "
+                        "previous artifact is intact but nothing new "
+                        "is landing"),
+        AlertRule(
+            "checkpoint_stale", "absence", severity="warn",
+            stale_s=checkpoint_stale_s, resolve_s=0.0,
+            **_flight("checkpoint_write"),
+            description="a run that was checkpointing has stopped — "
+                        "crash-recovery would replay further back with "
+                        "every passing minute"),
+        AlertRule(
+            "checkpoint_fallbacks", "increase", severity="warn",
+            resolve_s=300.0, **_flight("checkpoint_fallback"),
+            description="a corrupt/truncated checkpoint was skipped and "
+                        "an older sibling served — storage is eating "
+                        "writes"),
+        # -- generation serving ---------------------------------------------
+        AlertRule(
+            "decode_stalled", "increase", severity="critical",
+            resolve_s=120.0, **_flight("decode_stall"),
+            description="a decode dispatch exceeded the watchdog limit "
+                        "— a hung device call; requests were failed "
+                        "typed and the slab rebuilt"),
+        AlertRule(
+            "decode_errors", "increase", severity="warn",
+            resolve_s=120.0, **_flight("decode_error"),
+            description="a decode dispatch raised — active generation "
+                        "requests failed typed, slab rebuilt"),
+        AlertRule(
+            "overload_rejections", "increase", op=">=", threshold=5,
+            window_s=60.0, resolve_s=120.0, severity="warn",
+            **_flight("overload_reject"),
+            description="sustained typed backpressure rejections at "
+                        "the queue limit — clients are being shed"),
+        # -- continuous deployment -------------------------------------------
+        AlertRule(
+            "publish_refused", "increase", severity="warn",
+            resolve_s=300.0, **_flight("publish_refused"),
+            description="the validation gate refused a snapshot "
+                        "(non-finite or regressed score) — training is "
+                        "producing worse models than the baseline"),
+        AlertRule(
+            "publish_stale", "absence", severity="warn",
+            stale_s=publish_stale_s, **_flight("publish"),
+            description="a continuously-publishing trainer has stopped "
+                        "shipping snapshots — the serve side is aging"),
+        AlertRule(
+            "canary_rolled_back", "increase", severity="warn",
+            resolve_s=300.0, **_flight("rollback"),
+            description="a canary version regressed and auto-rolled "
+                        "back — the active version kept serving, but "
+                        "the deployment pipeline is shipping "
+                        "regressions"),
+        # -- elastic mesh ------------------------------------------------------
+        AlertRule(
+            "mesh_shrunk", "increase", severity="critical",
+            resolve_s=600.0, **_flight("mesh_shrink"),
+            description="a mesh failure was triaged and survivors "
+                        "re-formed — the run continues DEGRADED on "
+                        "fewer devices; replace the host"),
+        AlertRule(
+            "elastic_giveup", "increase", severity="critical",
+            resolve_s=600.0, **_flight("elastic_giveup"),
+            description="elastic recovery exhausted its retries / "
+                        "minimum device floor — the run stopped typed "
+                        "and needs a human"),
+        # -- kernels / locks ---------------------------------------------------
+        AlertRule(
+            "kernel_fallbacks", "increase", severity="warn",
+            resolve_s=600.0, **_flight("kernel_fallback"),
+            description="a Pallas kernel probe failed and the reference "
+                        "path engaged — correct but slower; the fleet "
+                        "is not getting the fused kernels"),
+        AlertRule(
+            "lock_cycle_detected", "increase", severity="critical",
+            resolve_s=600.0, **_flight("lock_cycle"),
+            description="the lock witness saw an acquisition-order "
+                        "cycle — an ABBA deadlock waiting for the "
+                        "right schedule; fix the ordering now"),
+    ]
+
+
+# --------------------------------------------------------------------------
+# the canary gate as rules (serving/registry.py builds these per window)
+# --------------------------------------------------------------------------
+def canary_gate_rules(mm, higher_is_better: bool,
+                      latency_trip_mult: float,
+                      latency_trip_min_samples: int,
+                      score_trip_tolerance: float) -> List[AlertRule]:
+    """The per-version canary checks, expressed in the same engine as
+    the SLO pack — PR 11's inline gate refactored onto ONE evaluation
+    mechanism. Each rule's signal closes over the managed model's live
+    per-version stats and returns the ORIGINAL gate's boolean (1.0 =
+    trip) plus the original reason string, so promotion/rollback
+    decisions — and the ``regression_trip`` forensics — are provably
+    unchanged; the engine contributes the state machine, the
+    ``alert_*`` forensics and the ``alert_firing`` gauges. Rule ORDER
+    is the original evaluation order (score, latency, generation
+    latency): the router trips on the first firing rule.
+
+    ``mm`` is duck-typed: anything with ``.active`` / ``.canary``
+    holding per-version ``.stats`` (requests, score, mean_latency(),
+    gen_requests, mean_gen_latency())."""
+
+    def _score():
+        ve, active = mm.canary, mm.active
+        if ve is None or active is None:
+            return None
+        cs = ve.stats.score
+        as_ = active.stats.score
+        if cs is None or as_ is None:
+            return None
+        tol = score_trip_tolerance * max(abs(as_), 1e-12)
+        worse = (cs < as_ - tol) if higher_is_better else (cs > as_ + tol)
+        return (1.0 if worse else 0.0,
+                f"score regressed: canary {cs:.6g} vs active {as_:.6g}")
+
+    def _latency():
+        ve, active = mm.canary, mm.active
+        if ve is None or active is None:
+            return None
+        if (ve.stats.requests < latency_trip_min_samples
+                or active.stats.requests < latency_trip_min_samples):
+            return None
+        cl, al = ve.stats.mean_latency(), active.stats.mean_latency()
+        if cl is None or not al:
+            return None
+        worse = cl > latency_trip_mult * al
+        return (1.0 if worse else 0.0,
+                f"latency regressed: canary {cl * 1e3:.1f}ms vs active "
+                f"{al * 1e3:.1f}ms (x{latency_trip_mult:g} gate)")
+
+    def _gen_latency():
+        ve, active = mm.canary, mm.active
+        if ve is None or active is None:
+            return None
+        if (ve.stats.gen_requests < latency_trip_min_samples
+                or active.stats.gen_requests < latency_trip_min_samples):
+            return None
+        cl = ve.stats.mean_gen_latency()
+        al = active.stats.mean_gen_latency()
+        if cl is None or not al:
+            return None
+        worse = cl > latency_trip_mult * al
+        return (1.0 if worse else 0.0,
+                f"generation latency regressed: canary {cl * 1e3:.1f}ms "
+                f"vs active {al * 1e3:.1f}ms "
+                f"(x{latency_trip_mult:g} gate)")
+
+    common = dict(kind="threshold", severity="critical", op=">",
+                  threshold=0.5, for_s=0.0, resolve_s=0.0)
+    return [
+        AlertRule("canary_score_regressed", fn=_score,
+                  description="the canary version's quality score "
+                              "(probes/external evaluators) regressed "
+                              "vs the active version beyond the "
+                              "tolerance", **common),
+        AlertRule("canary_latency_regressed", fn=_latency,
+                  description="the canary version's mean /predict "
+                              "latency blew past the active version by "
+                              "the trip multiplier (both sides past "
+                              "the sample floor)", **common),
+        AlertRule("canary_generation_latency_regressed", fn=_gen_latency,
+                  description="the canary's mean /generate latency "
+                              "blew past the active version's — "
+                              "generation compares only to generation",
+                  **common),
+    ]
+
+
+def pack_rule_names(queue_limit: int = 256) -> List[str]:
+    """Every rule name the default pack + the canary gate construct —
+    the set a test asserts is exactly ``obs/events.py ALERTS``."""
+    names = [r.name for r in default_rules(queue_limit=queue_limit)]
+    names += ["canary_score_regressed", "canary_latency_regressed",
+              "canary_generation_latency_regressed"]
+    return names
+
+
+def build_default_evaluator(registry=None, recorder=None,
+                            queue_limit: int = 256,
+                            min_tick_interval: float = 1.0,
+                            clock=None,
+                            serving_slo_target: float = 0.99,
+                            checkpoint_stale_s: float = 1800.0,
+                            publish_stale_s: float = 3600.0):
+    """An :class:`~.alerts.AlertEvaluator` armed with the default pack
+    over ``registry`` (default: the process-wide one), watching the
+    flight recorder for the event-driven rules. The one-liner both
+    HTTP surfaces and the CLI use."""
+    import time as _time
+
+    from deeplearning4j_tpu.obs.alerts import AlertEvaluator
+    from deeplearning4j_tpu.obs.metrics import default_registry
+
+    ev = AlertEvaluator(
+        default_rules(queue_limit=queue_limit,
+                      serving_slo_target=serving_slo_target,
+                      checkpoint_stale_s=checkpoint_stale_s,
+                      publish_stale_s=publish_stale_s),
+        registry=registry if registry is not None else default_registry(),
+        clock=clock if clock is not None else _time.monotonic,
+        recorder=recorder,
+        min_tick_interval=min_tick_interval)
+    ev.watch_flight(recorder)
+    return ev

@@ -17,7 +17,8 @@ the training stack produces crash-safe checkpoints
   hook, ``warmup()``, atomic hot-swap reload from
   ``faults.latest_valid_checkpoint``.
 - :mod:`server` — stdlib HTTP front-end (JSON + raw-npy predict,
-  /healthz, /reload, /metrics, /trace, /debug/flight, /debug/profile).
+  /healthz with the SLO verdict, /alerts, /reload, /metrics, /trace,
+  /debug/flight with ?since_seq incremental polling, /debug/profile).
 - :mod:`metrics` — thread-safe serving counters + latency quantiles +
   per-bucket pad-waste ratios.
 - :mod:`rtrace` — per-request stage timelines (enqueue → batch →

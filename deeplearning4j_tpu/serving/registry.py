@@ -801,6 +801,11 @@ class _ManagedModel:
         #: a build+warm is in flight OFF the lock (exactly one builder;
         #: traffic keeps routing to the active version meanwhile)
         self.canary_gen_building = False
+        #: per-window AlertEvaluator holding the canary gate's rules
+        #: (obs/slo.canary_gate_rules) — built at canary start, torn
+        #: down on trip/promote/evict; the gate decisions live in the
+        #: rules' signals, the engine owns the state machine + forensics
+        self.canary_alerts = None
         self.gen_counter = 0
         self.last_used = time.monotonic()
         #: set by LRU eviction. Engines are retired but the references
@@ -960,6 +965,9 @@ class ModelRouter:
                 # rewarm
                 mm.canary.retire(drain=True)
                 mm.canary = None
+            if mm.canary_alerts is not None:
+                mm.canary_alerts.shutdown()
+                mm.canary_alerts = None
             if mm.active is not None:
                 _flight.record("model_evict", model=name,
                                version=mm.active.version)
@@ -1287,6 +1295,23 @@ class ModelRouter:
         mm.canary_started = time.monotonic()
         mm.canary_counter = 0
         mm.canary_inflight.clear()
+        # the gate as declarative rules in the shared alert engine (ONE
+        # evaluation mechanism with the SLO pack): signals close over
+        # the live per-version stats and reproduce the PR 11 gate's
+        # comparisons and reason strings exactly; the evaluator
+        # contributes the state machine, alert_* flight forensics and
+        # alert_firing gauges
+        from deeplearning4j_tpu.obs.alerts import AlertEvaluator
+        from deeplearning4j_tpu.obs.slo import canary_gate_rules
+
+        mm.canary_alerts = AlertEvaluator(
+            canary_gate_rules(mm, self.registry.higher_is_better,
+                              self.latency_trip_mult,
+                              self.latency_trip_min_samples,
+                              self.score_trip_tolerance),
+            registry=self.metrics.registry,
+            context={"model": mm.name, "version": ve.version},
+            min_tick_interval=0.0)
         if not resumed:
             self.registry.start_canary(mm.name, ve.version,
                                        self.canary_fraction,
@@ -1336,8 +1361,11 @@ class ModelRouter:
 
     def _evaluate_canary(self, name: str) -> None:
         """The metric gate: called on canary completions, score posts,
-        and submissions. Trips on latency blow-up or score regression;
-        promotes once the window has elapsed with enough clean traffic."""
+        and submissions. One evaluator tick over the window's gate
+        rules (score / latency / generation latency, in the original
+        evaluation order — obs/slo.canary_gate_rules); the first firing
+        rule trips with its rule-rendered reason. Promotes once the
+        window has elapsed with enough clean traffic."""
         mm = self._live.get(name)
         if mm is None:
             return
@@ -1345,49 +1373,12 @@ class ModelRouter:
             ve = mm.canary
             if ve is None or ve.dead:
                 return
-            active = mm.active
-            # score gate (direction from the registry)
-            cs = ve.stats.score
-            as_ = None if active is None else active.stats.score
-            if cs is not None and as_ is not None:
-                tol = self.score_trip_tolerance * max(abs(as_), 1e-12)
-                worse = (cs < as_ - tol if self.registry.higher_is_better
-                         else cs > as_ + tol)
-                if worse:
-                    self._trip(name, ve,
-                               f"score regressed: canary {cs:.6g} vs "
-                               f"active {as_:.6g}")
-                    return
-            # latency gate (needs samples on both sides)
-            if (active is not None
-                    and ve.stats.requests >= self.latency_trip_min_samples
-                    and active.stats.requests
-                    >= self.latency_trip_min_samples):
-                cl, al = ve.stats.mean_latency(), active.stats.mean_latency()
-                if cl is not None and al and cl > self.latency_trip_mult * al:
-                    self._trip(name, ve,
-                               f"latency regressed: canary "
-                               f"{cl * 1e3:.1f}ms vs active "
-                               f"{al * 1e3:.1f}ms "
-                               f"(x{self.latency_trip_mult:g} gate)")
-                    return
-            # generation latency gate — generation compares only to
-            # generation (a decode request spans hundreds of tokens;
-            # mixing it into the /predict mean would be meaningless)
-            if (active is not None
-                    and ve.stats.gen_requests
-                    >= self.latency_trip_min_samples
-                    and active.stats.gen_requests
-                    >= self.latency_trip_min_samples):
-                cl = ve.stats.mean_gen_latency()
-                al = active.stats.mean_gen_latency()
-                if cl is not None and al and cl > self.latency_trip_mult * al:
-                    self._trip(name, ve,
-                               f"generation latency regressed: canary "
-                               f"{cl * 1e3:.1f}ms vs active "
-                               f"{al * 1e3:.1f}ms "
-                               f"(x{self.latency_trip_mult:g} gate)")
-                    return
+            ev = mm.canary_alerts
+            if ev is not None:
+                for st in ev.tick():
+                    if st["state"] == "firing":
+                        self._trip(name, ve, st["reason"])
+                        return
             # promotion: bounded window elapsed, enough canary traffic
             # (predict AND generation requests both count — a model
             # serving only /generate must still be able to promote),
@@ -1415,6 +1406,9 @@ class ModelRouter:
             mm.canary = None
             mm.canary_started = None
             mm.canary_inflight.clear()
+            if mm.canary_alerts is not None:
+                mm.canary_alerts.shutdown()
+                mm.canary_alerts = None
             mm.active = ve
             ve.role = "active"
             self.registry.promote(mm.name, ve.version)
@@ -1482,6 +1476,9 @@ class ModelRouter:
             ve.dead = True
             mm.canary = None
             mm.canary_started = None
+            if mm.canary_alerts is not None:
+                mm.canary_alerts.shutdown()
+                mm.canary_alerts = None
             if mm.canary_generation is not None:
                 # fail the candidate's in-flight generation requests
                 # typed and tear its slab down off-thread (shutdown
@@ -1586,6 +1583,9 @@ class ModelRouter:
                 active, mm.active = mm.active, None
                 if canary is not None:
                     canary.dead = True
+                if mm.canary_alerts is not None:
+                    mm.canary_alerts.shutdown()
+                    mm.canary_alerts = None
             if cgen is not None:
                 cgen.shutdown(drain=False)
             if gen is not None:

@@ -27,7 +27,17 @@ the production contract:
 - ``GET  /healthz``        liveness + model version/warm state +
                            checkpoint fingerprint/snapshot version/
                            uptime (the keys canary & rollback tooling
-                           watches)
+                           watches) + the SLO alert engine's
+                           ``verdict`` (healthy/degraded/critical —
+                           obs/alerts.py)
+- ``GET  /alerts``         the alert engine's rule states and health
+                           verdict (obs/slo.py default pack over this
+                           server's registry + the flight ring).
+                           Content-negotiated: JSON by default, a
+                           Prometheus-style ``ALERTS`` firing list via
+                           Accept/?format=prometheus. Evaluation is
+                           scrape-driven: each hit runs at most one
+                           throttled evaluator tick
 - ``POST /reload``         hot-swap to the newest valid checkpoint
                            (optional JSON ``{"path": ...,
                            "force": bool}``)
@@ -46,7 +56,10 @@ the production contract:
                            ``{"trace": true}`` in /predict and gets a
                            ``trace`` key back in the response.
 - ``GET  /debug/flight``   the process flight-recorder ring
-                           (obs/flight.py) as JSON
+                           (obs/flight.py) as JSON;
+                           ``?since_seq=N`` returns only events newer
+                           than seq N (incremental polling — pass the
+                           response's ``next_since_seq`` back)
 - ``GET  /debug/profile``  on-demand ``jax.profiler`` capture for
                            ``?ms=`` milliseconds (409 while another
                            capture runs)
@@ -124,7 +137,7 @@ class InferenceServer:
                  default_timeout_s: float = 30.0,
                  trace_requests: bool = True,
                  trace_buffer_size: int = 256,
-                 generation=None, router=None):
+                 generation=None, router=None, alerts=None):
         from deeplearning4j_tpu.serving.rtrace import TraceBuffer
 
         if engine is None and router is None:
@@ -170,6 +183,18 @@ class InferenceServer:
         if self.generation is not None and self.generation.traces is None:
             # generation request timelines land in the same /trace ring
             self.generation.traces = self.traces
+        #: the SLO alert evaluator behind GET /alerts and the /healthz
+        #: verdict (obs/alerts.py): the default rule pack over THIS
+        #: server's metrics registry, watching the flight ring.
+        #: Scrape-driven (the Prometheus model) — each /alerts or
+        #: /healthz hit runs at most one throttled tick.
+        if alerts is not None:
+            self.alerts = alerts
+        else:
+            from deeplearning4j_tpu.obs.slo import build_default_evaluator
+
+            self.alerts = build_default_evaluator(
+                registry=self.metrics.registry, queue_limit=queue_limit)
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
@@ -208,6 +233,7 @@ class InferenceServer:
             self.generation.shutdown(drain=True)
         if self.router is not None:
             self.router.shutdown()
+        self.alerts.unwatch()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
@@ -361,7 +387,18 @@ def _make_handler(server: InferenceServer):
                         time.time() - server.metrics.started_at, 3)
                     if server.generation is not None:
                         info["generation"] = server.generation.describe()
+                    server.alerts.maybe_tick()
+                    info["verdict"] = server.alerts.verdict().to_dict()
                     self._send_json(200, {"status": "ok", **info})
+                elif url.path == "/alerts":
+                    from deeplearning4j_tpu.obs.exporter import (
+                        alerts_response,
+                    )
+
+                    code, body, ctype = alerts_response(
+                        server.alerts, self.headers.get("Accept", ""),
+                        url.query)
+                    self._send(code, body, ctype)
                 elif url.path == "/metrics":
                     depth = (server.batcher.queue_depth()
                              if server.batcher is not None else 0)
@@ -393,7 +430,7 @@ def _make_handler(server: InferenceServer):
                         debug_flight_response,
                     )
 
-                    self._send_json(*debug_flight_response())
+                    self._send_json(*debug_flight_response(url.query))
                 elif url.path == "/debug/profile":
                     from deeplearning4j_tpu.obs.exporter import (
                         debug_profile_response,
@@ -425,6 +462,8 @@ def _make_handler(server: InferenceServer):
                 info = server.router.healthz(name)
                 info["uptime_s"] = round(
                     time.time() - server.metrics.started_at, 3)
+                server.alerts.maybe_tick()
+                info["verdict"] = server.alerts.verdict().to_dict()
                 code = 200 if info.get("active_version") is not None else 503
                 self._send_json(code, {"status": "ok" if code == 200
                                        else "no_active_version", **info})

@@ -770,8 +770,14 @@ class ParamAndGradientIterationListener(TrainingListener):
         self._grads = None
         self._telem = None  # (it0, BundleTelemetry) from telemetry_done
         self._header_written = False
-        if file:  # truncate once per listener lifetime
-            open(file, "w").close()
+        if file:  # truncate once per listener lifetime. Routed through
+            # the injectable fs layer (surface=diagnostics) like every
+            # other write under train/: chaos plans can target it, and
+            # a full disk surfaces as a typed StorageError instead of a
+            # bare OSError mid-fit
+            from deeplearning4j_tpu.chaos import fslayer as _fs
+
+            _fs.open_for_write(file, "w", surface="diagnostics").close()
 
     def needs_introspection(self, next_iteration: int) -> bool:
         return next_iteration % self.iterations == 0
@@ -809,7 +815,10 @@ class ParamAndGradientIterationListener(TrainingListener):
         if self.output_to_console:
             print(line)
         if self.file:
-            with open(self.file, "a") as f:
+            from deeplearning4j_tpu.chaos import fslayer as _fs
+
+            with _fs.open_for_write(self.file, "a",
+                                    surface="diagnostics") as f:
                 f.write(line + "\n")
 
     # -- telemetry mode: global-norm rows, bundling-compatible ---------------

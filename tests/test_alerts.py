@@ -1,0 +1,774 @@
+"""SLO alert engine tests (obs/alerts.py, obs/slo.py): the hysteresis
+state machine under a fake clock (pending hold, flap suppression,
+resolve hysteresis — no sleeps anywhere), per-kind window math
+(increase / rate / absence / multi-window burn rate), verdict
+aggregation, flight-event signals, the canary-gate-as-rules parity,
+content-negotiated /alerts on BOTH HTTP surfaces, incremental
+/debug/flight polling, dump merging, and the generated alert-rule
+table embed."""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.obs import events as obs_events
+from deeplearning4j_tpu.obs import slo
+from deeplearning4j_tpu.obs.alerts import (
+    FLIGHT_EVENT_METRIC,
+    AlertEvaluator,
+    AlertRule,
+    SLOObjective,
+)
+from deeplearning4j_tpu.obs.flight import (
+    FlightRecorder,
+    find_dumps,
+    format_dump,
+    merge_dumps,
+)
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(deeplearning4j_tpu.__file__)))
+
+
+class Clock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+        return self.t
+
+
+def make_eval(rules, reg=None, recorder=None, **kw):
+    clock = Clock()
+    ev = AlertEvaluator(rules, registry=reg or MetricsRegistry(),
+                        clock=clock, recorder=recorder,
+                        min_tick_interval=0.0,
+                        record_events=recorder is not None, **kw)
+    return ev, clock, ev.registry
+
+
+def states(ev):
+    return {s["name"]: s for s in ev.states()}
+
+
+# ==========================================================================
+# the hysteresis state machine (fake clock, no sleeps)
+# ==========================================================================
+class TestStateMachine:
+    def test_pending_hold_then_fire(self):
+        rec = FlightRecorder()
+        ev, clock, reg = make_eval(
+            [AlertRule("t", "threshold", metric="g", op=">", threshold=5,
+                       for_s=10, resolve_s=0)], recorder=rec)
+        g = reg.gauge("g")
+        ev.tick()
+        assert states(ev)["t"]["state"] == "ok"
+        g.set(9)
+        clock.advance(1)
+        ev.tick()
+        assert states(ev)["t"]["state"] == "pending"
+        clock.advance(5)  # 5s held < 10s
+        ev.tick()
+        assert states(ev)["t"]["state"] == "pending"
+        clock.advance(6)  # 11s held
+        ev.tick()
+        st = states(ev)["t"]
+        assert st["state"] == "firing" and st["fire_count"] == 1
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds == ["alert_pending", "alert_fired"]
+        fired = rec.events()[-1]
+        assert fired["alert"] == "t" and fired["severity"] == "warn"
+
+    def test_flap_before_hold_never_fires(self):
+        rec = FlightRecorder()
+        ev, clock, reg = make_eval(
+            [AlertRule("t", "threshold", metric="g", op=">", threshold=5,
+                       for_s=10)], recorder=rec)
+        g = reg.gauge("g")
+        ev.tick()
+        g.set(9)
+        clock.advance(1)
+        ev.tick()
+        g.set(0)  # condition clears before the hold elapses
+        clock.advance(5)
+        ev.tick()
+        assert states(ev)["t"]["state"] == "ok"
+        g.set(9)
+        clock.advance(1)
+        ev.tick()
+        # the hold RESTARTS: an earlier aborted pending must not count
+        clock.advance(9)
+        ev.tick()
+        assert states(ev)["t"]["state"] == "pending"
+        assert "alert_fired" not in [e["kind"] for e in rec.events()]
+
+    def test_resolve_hysteresis_and_no_refire_on_dip(self):
+        rec = FlightRecorder()
+        ev, clock, reg = make_eval(
+            [AlertRule("t", "threshold", metric="g", op=">", threshold=5,
+                       for_s=0, resolve_s=20)], recorder=rec)
+        g = reg.gauge("g")
+        ev.tick()
+        g.set(9)
+        clock.advance(1)
+        ev.tick()
+        assert states(ev)["t"]["state"] == "firing"
+        g.set(0)  # dip
+        clock.advance(10)  # < resolve_s
+        ev.tick()
+        assert states(ev)["t"]["state"] == "firing"
+        g.set(9)  # dip ended: still the SAME incident
+        clock.advance(1)
+        ev.tick()
+        st = states(ev)["t"]
+        assert st["state"] == "firing" and st["fire_count"] == 1
+        g.set(0)
+        clock.advance(1)
+        ev.tick()
+        clock.advance(21)  # clear >= resolve_s
+        ev.tick()
+        assert states(ev)["t"]["state"] == "ok"
+        kinds = [e["kind"] for e in rec.events()]
+        assert kinds == ["alert_pending", "alert_fired", "alert_resolved"]
+
+    def test_firing_gauge_mirrors_state(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("t", "threshold", metric="g", op=">", threshold=5)])
+        reg.gauge("g").set(9)
+        clock.advance(1)
+        ev.tick()
+        assert reg.get("alert_firing", {"alert": "t"}).value() == 1.0
+        reg.gauge("g").set(0)
+        clock.advance(1)
+        ev.tick()
+        assert reg.get("alert_firing", {"alert": "t"}).value() == 0.0
+
+    def test_shutdown_zeroes_gauges(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("t", "threshold", metric="g", op=">", threshold=5)])
+        reg.gauge("g").set(9)
+        clock.advance(1)
+        ev.tick()
+        ev.shutdown()
+        assert reg.get("alert_firing", {"alert": "t"}).value() == 0.0
+
+    def test_context_isolates_gauges_across_evaluators(self):
+        """Two evaluators sharing one registry with the SAME rule
+        names (concurrent canary windows for different models): the
+        context labels are part of the gauge identity, so one
+        window's shutdown cannot zero the other's live firing
+        gauge."""
+        reg = MetricsRegistry()
+        clocks = [Clock(), Clock()]
+        evs = []
+        for i, model in enumerate(("a", "b")):
+            ev = AlertEvaluator(
+                [AlertRule("t", "threshold", metric=f"g{model}",
+                           op=">", threshold=5)],
+                registry=reg, clock=clocks[i],
+                context={"model": model}, min_tick_interval=0.0,
+                record_events=False)
+            evs.append(ev)
+        reg.gauge("ga").set(9)
+        reg.gauge("gb").set(9)
+        for ev, clock in zip(evs, clocks):
+            clock.advance(1)
+            ev.tick()
+        la = {"alert": "t", "model": "a"}
+        lb = {"alert": "t", "model": "b"}
+        assert reg.get("alert_firing", la).value() == 1.0
+        assert reg.get("alert_firing", lb).value() == 1.0
+        evs[1].shutdown()  # model b's window ends
+        assert reg.get("alert_firing", lb).value() == 0.0
+        assert reg.get("alert_firing", la).value() == 1.0  # a untouched
+
+
+# ==========================================================================
+# rule kinds: window math
+# ==========================================================================
+class TestRuleKinds:
+    def test_increase_measured_against_window_edge(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("i", "increase", family="c_total",
+                       op=">=", threshold=3, window_s=100)])
+        c = reg.counter("c_total")
+        ev.tick()          # t=0: baseline sample 0
+        c.inc(2)
+        clock.advance(60)
+        ev.tick()          # delta 2 over 60s: below the 3 floor
+        assert states(ev)["i"]["state"] == "ok"
+        c.inc(1)
+        clock.advance(110)  # t=170: edge at 70 -> baseline is t=60 (2)
+        ev.tick()
+        # growth older than the window has aged out: delta is 1, not 3
+        st = states(ev)["i"]
+        assert st["state"] == "ok" and st["value"] == 1.0
+        c.inc(3)
+        clock.advance(5)   # t=175: baseline still t=60 -> delta 4
+        ev.tick()
+        st = states(ev)["i"]
+        assert st["state"] == "firing" and st["value"] == 4.0
+
+    def test_rate_math_exact(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("r", "rate", family="c_total", op=">",
+                       threshold=0.5, window_s=1000)])
+        c = reg.counter("c_total")
+        ev.tick()
+        c.inc(30)
+        clock.advance(100)
+        ev.tick()
+        st = states(ev)["r"]
+        assert st["state"] == "ok" and st["value"] == pytest.approx(0.3)
+        c.inc(60)
+        clock.advance(100)
+        ev.tick()
+        st = states(ev)["r"]
+        # 90 over 200s = 0.45 vs baseline at t=0 — still under
+        assert st["state"] == "ok" and st["value"] == pytest.approx(0.45)
+        c.inc(100)
+        clock.advance(100)
+        ev.tick()
+        assert states(ev)["r"]["state"] == "firing"
+
+    def test_absence_requires_activity_then_fires_and_resolves(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("a", "absence", family="c_total", stale_s=100)])
+        c = reg.counter("c_total")
+        ev.tick()
+        clock.advance(500)  # silent forever but NEVER active: no page
+        ev.tick()
+        assert states(ev)["a"]["state"] == "ok"
+        c.inc()
+        clock.advance(10)
+        ev.tick()  # activity seen
+        clock.advance(101)
+        ev.tick()
+        assert states(ev)["a"]["state"] == "firing"
+        c.inc()  # the signal moved again
+        clock.advance(1)
+        ev.tick()
+        assert states(ev)["a"]["state"] == "ok"
+
+    def test_absence_without_activity_requirement(self):
+        ev, clock, reg = make_eval(
+            [AlertRule("a", "absence", family="c_total", stale_s=100,
+                       require_activity=False)])
+        ev.tick()
+        clock.advance(101)
+        ev.tick()
+        assert states(ev)["a"]["state"] == "firing"
+
+    def test_burn_rate_requires_every_window(self):
+        obj = SLOObjective("slo", bad="bad_total", total="all_total",
+                          target=0.99)
+        ev, clock, reg = make_eval(
+            [AlertRule("b", "burn_rate", objective=obj,
+                       windows=[(600, 2.0), (60, 2.0)])])
+        bad, tot = reg.counter("bad_total"), reg.counter("all_total")
+        ev.tick()
+        # a live burst at realistic scrape cadence fires both legs
+        # (ratio 0.1 >= 2x the 0.01 budget)
+        bad.inc(10)
+        tot.inc(100)
+        clock.advance(30)
+        ev.tick()
+        assert states(ev)["b"]["state"] == "firing"
+        # ... but once the burn STOPS, the short window sees only the
+        # recent clean traffic and the page clears — even though the
+        # long window still contains the burst
+        for _ in range(10):
+            tot.inc(100)
+            clock.advance(30)
+            ev.tick()
+        assert states(ev)["b"]["state"] == "ok"
+
+    def test_burn_rate_scrape_gap_cannot_page_for_a_dead_burst(self):
+        """Scrape-driven evaluation with a gap wider than the short
+        window: the only baseline old enough is ANCIENT, and measuring
+        across the gap would page at t=600 for a burst that ended at
+        t=30 — insufficient history must mean no verdict instead."""
+        obj = SLOObjective("slo", bad="bad_total", total="all_total",
+                          target=0.99)
+        ev, clock, reg = make_eval(
+            [AlertRule("b", "burn_rate", objective=obj,
+                       windows=[(600, 2.0), (60, 2.0)])])
+        bad, tot = reg.counter("bad_total"), reg.counter("all_total")
+        ev.tick()
+        bad.inc(10)
+        tot.inc(100)  # the burst happens... and nobody scrapes
+        clock.advance(600)
+        ev.tick()
+        assert states(ev)["b"]["state"] == "ok"
+
+    def test_burn_rate_boundary_is_inclusive_and_needs_traffic(self):
+        obj = SLOObjective("slo", bad="bad_total", total="all_total",
+                          target=0.9)  # budget 0.1
+        ev, clock, reg = make_eval(
+            [AlertRule("b", "burn_rate", objective=obj,
+                       windows=[(100, 2.0)])])
+        bad, tot = reg.counter("bad_total"), reg.counter("all_total")
+        ev.tick()
+        clock.advance(10)
+        ev.tick()  # no traffic at all: no verdict
+        assert states(ev)["b"]["state"] == "ok"
+        bad.inc(20)
+        tot.inc(100)  # ratio 0.2 == 2.0 * 0.1 exactly: >= fires
+        clock.advance(10)
+        ev.tick()
+        assert states(ev)["b"]["state"] == "firing"
+
+    def test_fn_signal_none_and_reason(self):
+        out = {"v": None}
+        ev, clock, _ = make_eval(
+            [AlertRule("f", "threshold", fn=lambda: out["v"],
+                       op=">", threshold=0.5)])
+        ev.tick()
+        assert states(ev)["f"]["state"] == "ok"
+        out["v"] = (1.0, "custom reason text")
+        clock.advance(1)
+        ev.tick()
+        st = states(ev)["f"]
+        assert st["state"] == "firing" and st["reason"] == \
+            "custom reason text"
+
+    def test_missing_metric_is_zero_for_counter_kinds_only(self):
+        ev, clock, reg = make_eval([
+            AlertRule("t", "threshold", metric="nope", op="<",
+                      threshold=5),
+            AlertRule("i", "increase", metric="later_total",
+                      window_s=500),
+        ])
+        ev.tick()
+        # threshold on missing data is NO verdict, not "value 0 < 5"
+        assert states(ev)["t"]["state"] == "ok"
+        # the counter materializes after baseline: its first increments
+        # must still register as an increase from 0
+        reg.counter("later_total").inc(4)
+        clock.advance(10)
+        ev.tick()
+        assert states(ev)["i"]["state"] == "firing"
+
+
+# ==========================================================================
+# construction validation + verdict + evaluator plumbing
+# ==========================================================================
+class TestEvaluator:
+    def test_typed_construction_errors(self):
+        with pytest.raises(ValueError):
+            AlertRule("x", "nope", metric="m")
+        with pytest.raises(ValueError):
+            AlertRule("x", "threshold", metric="m", severity="page")
+        with pytest.raises(ValueError):
+            AlertRule("x", "threshold", metric="m", op="!=")
+        with pytest.raises(ValueError):
+            AlertRule("x", "threshold")  # no signal
+        with pytest.raises(ValueError):
+            AlertRule("x", "threshold", metric="m", family="f")
+        with pytest.raises(ValueError):
+            AlertRule("x", "burn_rate")  # no objective/windows
+        with pytest.raises(ValueError):
+            AlertRule("x", "absence", metric="m")  # no stale_s
+        with pytest.raises(ValueError):
+            AlertEvaluator([AlertRule("d", "threshold", metric="m"),
+                            AlertRule("d", "threshold", metric="m")],
+                           registry=MetricsRegistry())
+
+    def test_verdict_aggregation(self):
+        ev, clock, reg = make_eval([
+            AlertRule("w", "threshold", metric="g1", op=">", threshold=1,
+                      severity="warn"),
+            AlertRule("c", "threshold", metric="g2", op=">", threshold=1,
+                      severity="critical"),
+        ])
+        assert ev.verdict().status == "unknown"
+        ev.tick()
+        assert ev.verdict().status == "healthy"
+        assert ev.verdict().healthy
+        reg.gauge("g1").set(5)
+        clock.advance(1)
+        ev.tick()
+        assert ev.verdict().status == "degraded"
+        reg.gauge("g2").set(5)
+        clock.advance(1)
+        ev.tick()
+        v = ev.verdict()
+        assert v.status == "critical" and len(v.firing) == 2
+        assert not v.healthy
+
+    def test_watch_flight_counts_and_unwatch_stops(self):
+        rec = FlightRecorder()
+        ev, clock, reg = make_eval(
+            [AlertRule("n", "increase", window_s=500,
+                       metric=FLIGHT_EVENT_METRIC,
+                       labels={"kind": "nan_skip"})])
+        ev.watch_flight(rec)
+        ev.tick()
+        rec.record("nan_skip", consec=1)
+        rec.record("step", iteration=1)
+        clock.advance(10)
+        ev.tick()
+        assert states(ev)["n"]["state"] == "firing"
+        assert reg.get(FLIGHT_EVENT_METRIC,
+                       {"kind": "step"}).value() == 1.0
+        ev.unwatch()
+        rec.record("nan_skip", consec=2)
+        assert reg.get(FLIGHT_EVENT_METRIC,
+                       {"kind": "nan_skip"}).value() == 1.0
+
+    def test_maybe_tick_throttles(self):
+        ev = AlertEvaluator([AlertRule("t", "threshold", metric="g")],
+                            registry=MetricsRegistry(),
+                            min_tick_interval=3600.0,
+                            record_events=False)
+        assert ev.maybe_tick() is True
+        assert ev.maybe_tick() is False  # within the interval
+        assert ev.ticks == 1
+
+    def test_prometheus_text_lists_non_ok_only(self):
+        ev, clock, reg = make_eval([
+            AlertRule("fire", "threshold", metric="g", op=">",
+                      threshold=1, severity="critical"),
+            AlertRule("hold", "threshold", metric="g", op=">",
+                      threshold=1, for_s=100),
+            AlertRule("quiet", "threshold", metric="g", op="<",
+                      threshold=-1),
+        ])
+        reg.gauge("g").set(5)
+        clock.advance(1)
+        ev.tick()
+        txt = ev.prometheus_text()
+        assert ('ALERTS{alertname="fire",alertstate="firing",'
+                'severity="critical"} 1') in txt
+        assert 'alertname="hold",alertstate="pending"' in txt
+        assert "quiet" not in txt
+
+    def test_context_rides_on_events(self):
+        rec = FlightRecorder()
+        ev = AlertEvaluator(
+            [AlertRule("t", "threshold", metric="g", op=">",
+                       threshold=1)],
+            registry=MetricsRegistry(), clock=Clock(), recorder=rec,
+            context={"model": "m", "version": 2},
+            min_tick_interval=0.0)
+        ev.registry.gauge("g").set(5)
+        ev.tick()
+        fired = [e for e in rec.events() if e["kind"] == "alert_fired"]
+        assert fired and fired[0]["model"] == "m" \
+            and fired[0]["version"] == 2
+
+
+# ==========================================================================
+# the rule pack + the canary gate as rules
+# ==========================================================================
+class TestRulePack:
+    def test_pack_names_exactly_match_declared_alerts(self):
+        assert set(slo.pack_rule_names()) == set(obs_events.ALERTS)
+
+    def test_alert_events_declared(self):
+        for k in ("alert_pending", "alert_fired", "alert_resolved"):
+            assert obs_events.is_declared_event(k)
+
+    def test_default_pack_constructs_and_evaluates_clean(self):
+        ev, clock, _reg = make_eval(slo.default_rules())
+        ev.tick()
+        clock.advance(60)
+        ev.tick()
+        assert ev.verdict().status == "healthy"
+
+    def _mm(self):
+        class Stats:
+            def __init__(self):
+                self.requests = 0
+                self.score = None
+                self.latency_sum = 0.0
+                self.gen_requests = 0
+                self.gen_latency_sum = 0.0
+
+            def mean_latency(self):
+                return (self.latency_sum / self.requests
+                        if self.requests else None)
+
+            def mean_gen_latency(self):
+                return (self.gen_latency_sum / self.gen_requests
+                        if self.gen_requests else None)
+
+        class VE:
+            def __init__(self):
+                self.stats = Stats()
+
+        class MM:
+            active = None
+            canary = None
+
+        mm = MM()
+        mm.active, mm.canary = VE(), VE()
+        return mm
+
+    def test_canary_gate_rules_reproduce_pr11_decisions(self):
+        mm = self._mm()
+        rules = slo.canary_gate_rules(
+            mm, higher_is_better=False, latency_trip_mult=5.0,
+            latency_trip_min_samples=8, score_trip_tolerance=0.0)
+        assert [r.name for r in rules] == [
+            "canary_score_regressed", "canary_latency_regressed",
+            "canary_generation_latency_regressed"]
+        ev = AlertEvaluator(rules, registry=MetricsRegistry(),
+                            clock=Clock(), min_tick_interval=0.0,
+                            record_events=False)
+        ev.tick()
+        assert ev.firing() == []  # no scores, no samples: no verdict
+        # score regression (lower is better): canary worse -> fires
+        # with the ORIGINAL reason string
+        mm.active.stats.score = 0.5
+        mm.canary.stats.score = 0.6
+        ev.tick()
+        firing = ev.firing()
+        assert [f["name"] for f in firing] == ["canary_score_regressed"]
+        assert firing[0]["reason"] == \
+            "score regressed: canary 0.6 vs active 0.5"
+        # latency gate honors the min-sample floor exactly
+        mm.canary.stats.score = 0.5  # clear the score leg
+        mm.canary.stats.requests = 7
+        mm.canary.stats.latency_sum = 7 * 10.0
+        mm.active.stats.requests = 8
+        mm.active.stats.latency_sum = 8 * 0.001
+        ev.tick()
+        assert "canary_latency_regressed" not in \
+            [f["name"] for f in ev.firing()]
+        mm.canary.stats.requests = 8
+        mm.canary.stats.latency_sum = 8 * 10.0
+        ev.tick()
+        names = [f["name"] for f in ev.firing()]
+        assert "canary_latency_regressed" in names
+        reason = [f for f in ev.firing()
+                  if f["name"] == "canary_latency_regressed"][0]["reason"]
+        assert reason == ("latency regressed: canary 10000.0ms vs "
+                          "active 1.0ms (x5 gate)")
+
+    def test_canary_gen_latency_compares_only_generation(self):
+        mm = self._mm()
+        rules = slo.canary_gate_rules(
+            mm, higher_is_better=False, latency_trip_mult=5.0,
+            latency_trip_min_samples=2, score_trip_tolerance=0.0)
+        ev = AlertEvaluator(rules, registry=MetricsRegistry(),
+                            clock=Clock(), min_tick_interval=0.0,
+                            record_events=False)
+        mm.canary.stats.gen_requests = 2
+        mm.canary.stats.gen_latency_sum = 2 * 10.0
+        mm.active.stats.gen_requests = 2
+        mm.active.stats.gen_latency_sum = 2 * 0.1
+        ev.tick()
+        assert [f["name"] for f in ev.firing()] == \
+            ["canary_generation_latency_regressed"]
+
+
+# ==========================================================================
+# doc table embed (the flight-event-table contract, for alerts)
+# ==========================================================================
+def test_alert_table_matches_architecture_doc():
+    from deeplearning4j_tpu.analysis.tables import render_alert_table
+
+    arch = open(os.path.join(REPO_ROOT, "ARCHITECTURE.md")).read()
+    assert render_alert_table() in arch
+
+
+# ==========================================================================
+# flight ring: incremental polling + dump merging
+# ==========================================================================
+class TestFlightIncrementalAndMerge:
+    def test_snapshot_since_seq(self):
+        rec = FlightRecorder()
+        rec.record("step", iteration=1)
+        rec.record("step", iteration=2)
+        s1 = rec.snapshot()
+        assert s1["next_since_seq"] == 1
+        rec.record("nan_skip", consec=1)
+        s2 = rec.snapshot(since_seq=s1["next_since_seq"])
+        assert [e["kind"] for e in s2["events"]] == ["nan_skip"]
+        assert s2["next_since_seq"] == 2
+        # idempotent cursor: nothing new echoes the cursor back
+        s3 = rec.snapshot(since_seq=s2["next_since_seq"])
+        assert s3["events"] == [] and s3["next_since_seq"] == 2
+
+    def test_merge_dumps_time_orders_across_pids(self, tmp_path):
+        r1, r2 = FlightRecorder(), FlightRecorder()
+        r1.record("step", iteration=1)
+        r2.record("publish", model="m")
+        r1.record("fit_end", iteration=2)
+        b1, b2 = r1.snapshot(), r2.snapshot()
+        b1["pid"], b2["pid"] = 111, 222
+        merged = merge_dumps([b1, b2])
+        assert merged["merged"] and len(merged["events"]) == 3
+        ts = [e["ts"] for e in merged["events"]]
+        assert ts == sorted(ts)
+        assert {e["pid"] for e in merged["events"]} == {111, 222}
+        text = format_dump(merged)
+        assert "merged timeline" in text and "publish" in text
+
+    def test_find_dumps_and_cli_merge(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import flight_dump_main
+
+        r1, r2 = FlightRecorder(), FlightRecorder()
+        r1.record("step", iteration=1)
+        r2.record("publish", model="m")
+        p1 = str(tmp_path / "flight_recorder_1111.json")
+        p2 = str(tmp_path / "flight_recorder_2222.json")
+        assert r1.dump(path=p1) and r2.dump(path=p2)
+        assert find_dumps(str(tmp_path)) == [p1, p2]
+        assert flight_dump_main([str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "merged timeline" in out and "publish" in out \
+            and "step" in out
+        # single file keeps the classic single-ring rendering
+        assert flight_dump_main([p1]) == 0
+        out = capsys.readouterr().out
+        assert "merged timeline" not in out
+        # --json merged body round-trips
+        assert flight_dump_main([p1, p2, "--json"]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert body["merged"] and len(body["events"]) == 2
+
+    def test_cli_missing_path_fails(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import flight_dump_main
+
+        assert flight_dump_main([str(tmp_path / "nope")]) == 1
+
+
+# ==========================================================================
+# HTTP surfaces (content negotiation on both servers)
+# ==========================================================================
+def _get(url, accept=None):
+    req = urllib.request.Request(
+        url, headers={} if accept is None else {"Accept": accept})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return (resp.status, resp.headers.get("Content-Type"),
+                    resp.read())
+    except urllib.error.HTTPError as e:  # 4xx still carries the body
+        return e.code, e.headers.get("Content-Type"), e.read()
+
+
+class TestHTTPSurfaces:
+    def _evaluator(self, reg):
+        rec = FlightRecorder()
+        ev = AlertEvaluator(slo.default_rules(), registry=reg,
+                            recorder=rec, min_tick_interval=0.0)
+        ev.watch_flight(rec)
+        return ev, rec
+
+    def test_metrics_server_alerts_negotiated_and_verdict(self):
+        from deeplearning4j_tpu.obs.exporter import MetricsServer
+
+        reg = MetricsRegistry()
+        ev, rec = self._evaluator(reg)
+        srv = MetricsServer(registry=reg, port=0, alerts=ev)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            _s, _c, body = _get(base + "/alerts")
+            body = json.loads(body)
+            assert body["verdict"]["status"] == "healthy"
+            rec.record("storage_error", op="fsync", surface="checkpoint")
+            _s, _c, body = _get(base + "/alerts")
+            firing = [a["name"] for a in json.loads(body)["alerts"]
+                      if a["state"] == "firing"]
+            assert "storage_errors" in firing
+            _s, ctype, text = _get(base + "/alerts",
+                                   accept="text/plain")
+            assert ctype.startswith("text/plain")
+            assert b'alertname="storage_errors"' in text
+            _s, _c, h = _get(base + "/healthz")
+            assert json.loads(h)["verdict"]["status"] == "critical"
+        finally:
+            srv.shutdown()
+
+    def test_serving_server_alerts_and_flight_polling(self):
+        from deeplearning4j_tpu.nn.conf import (
+            InputType,
+            NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import (
+            DenseLayer,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.serving.engine import InferenceEngine
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        model = MultiLayerNetwork(conf).init()
+        srv = InferenceServer(InferenceEngine(model), port=0).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            _s, _c, body = _get(base + "/alerts")
+            body = json.loads(body)
+            assert {a["name"] for a in body["alerts"]} == \
+                set(slo.pack_rule_names()) - {
+                    "canary_score_regressed", "canary_latency_regressed",
+                    "canary_generation_latency_regressed"}
+            _s, _c, h = _get(base + "/healthz")
+            assert "verdict" in json.loads(h)
+            _s, _c, f1 = _get(base + "/debug/flight")
+            cur = json.loads(f1)["next_since_seq"]
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            _flight.record("step", iteration=123)
+            _s, _c, f2 = _get(base + f"/debug/flight?since_seq={cur}")
+            evs = json.loads(f2)["events"]
+            assert any(e["kind"] == "step" and e.get("iteration") == 123
+                       for e in evs)
+            assert all(e["seq"] > cur for e in evs)
+            _s, _c, bad = _get(base + "/debug/flight?since_seq=zzz")
+            # malformed cursor is the client's error, mapped typed
+            assert json.loads(bad).get("error") == "ValueError"
+        finally:
+            srv.shutdown()
+
+
+# ==========================================================================
+# cli alerts (one-shot rendering + exit codes)
+# ==========================================================================
+class TestCliAlerts:
+    def test_one_shot_renders_and_exit_code(self, capsys):
+        from deeplearning4j_tpu.cli import alerts_main
+        from deeplearning4j_tpu.obs.exporter import MetricsServer
+
+        reg = MetricsRegistry()
+        rec = FlightRecorder()
+        ev = AlertEvaluator(slo.default_rules(), registry=reg,
+                            recorder=rec, min_tick_interval=0.0)
+        ev.watch_flight(rec)
+        srv = MetricsServer(registry=reg, port=0, alerts=ev).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            assert alerts_main([base]) == 0
+            out = capsys.readouterr().out
+            assert "verdict: HEALTHY" in out
+            rec.record("lock_cycle", cycle="a->b->a")
+            assert alerts_main([base, "--firing-only"]) == 2  # critical
+            out = capsys.readouterr().out
+            assert "lock_cycle_detected" in out \
+                and "nan_step_storm" not in out
+        finally:
+            srv.shutdown()
+
+    def test_unreachable_url_fails_typed(self, capsys):
+        from deeplearning4j_tpu.cli import alerts_main
+
+        assert alerts_main(["http://127.0.0.1:1/alerts"]) == 1
+        assert "cannot reach" in capsys.readouterr().err

@@ -675,6 +675,55 @@ class TestDrillMatrix:
         with pytest.raises(ValueError, match="unknown drill"):
             chaos_drills.run_matrix(names=["no_such_drill"])
 
+    def test_expected_alerts_coverage_floor(self):
+        """ISSUE 15: detection is part of the matrix contract — at
+        least 8 drills declare expected_alerts, and every declared
+        name is in the obs/events.py ALERTS schema."""
+        from deeplearning4j_tpu.obs import events as obs_events
+
+        covered = [d for d in chaos_drills.DRILLS.values()
+                   if d.expected_alerts]
+        assert len(covered) >= 8, [d.name for d in covered]
+        for d in covered:
+            for a in d.expected_alerts:
+                assert obs_events.is_declared_alert(a), (d.name, a)
+
+    def test_drill_detection_rides_scorecard(self):
+        """A drill's injected fault must trip exactly the alert that
+        claims to cover it, and the scorecard must say so (per-drill
+        alerts_fired + matrix-level alerts_verified)."""
+        out = chaos_drills.run_matrix(
+            names=["checkpoint_fsync_fail", "registry_nan_publish_gate"])
+        assert out["ok"], json.dumps(out["drills"], indent=1)
+        by_name = {d["drill"]: d for d in out["drills"]}
+        assert "storage_errors" in \
+            by_name["checkpoint_fsync_fail"]["alerts_fired"]
+        assert "publish_refused" in \
+            by_name["registry_nan_publish_gate"]["alerts_fired"]
+        assert by_name["checkpoint_fsync_fail"]["expected_alerts"] == \
+            ["storage_errors"]
+        assert out["alerts_verified"] == 2
+        checks = {c["name"] for d in out["drills"]
+                  for c in d["checks"]}
+        assert "expected_alerts_fired" in checks
+
+    def test_missing_expected_alert_is_red(self):
+        """An expected alert that never fires must fail the drill —
+        the detection check cannot pass vacuously."""
+        from deeplearning4j_tpu.chaos.invariants import (
+            InvariantReport,
+            check_expected_alerts,
+        )
+
+        rep = InvariantReport()
+        assert not check_expected_alerts(
+            rep, fired=["storage_errors"],
+            expected=["storage_errors", "decode_stalled"])
+        assert "decode_stalled" in rep.failures()[0].detail
+        rep2 = InvariantReport()
+        assert check_expected_alerts(
+            rep2, fired=["a", "b"], expected=["a"])
+
     def test_explicit_names_bypass_fast_filter(self):
         """--fast --drill <paired> must RUN the paired drill, not
         silently select zero drills and exit green."""

@@ -274,6 +274,41 @@ class TestEventSchemaRule:
         assert fs == []
 
 
+class TestAlertSchemaRule:
+    def test_undeclared_alert_name_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/watch.py", """\
+            from deeplearning4j_tpu.obs.alerts import AlertRule
+
+            RULES = [
+                AlertRule("totally_made_up_alert", "threshold",
+                          metric="g"),
+            ]
+            """, rule="alert-schema")
+        assert len(fs) == 1 and fs[0].line == 4
+        assert "totally_made_up_alert" in fs[0].message
+
+    def test_declared_and_attribute_ctor_pass(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/watch.py", """\
+            from deeplearning4j_tpu.obs import alerts
+
+            RULES = [
+                alerts.AlertRule("nan_step_storm", "increase",
+                                 metric="flight_events_total",
+                                 labels={"kind": "nan_skip"}),
+            ]
+            """, rule="alert-schema")
+        assert fs == []
+
+    def test_attribute_ctor_undeclared_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/watch.py", """\
+            from deeplearning4j_tpu.obs import alerts
+
+            RULES = [alerts.AlertRule("nope_never", "threshold",
+                                      metric="g")]
+            """, rule="alert-schema")
+        assert len(fs) == 1
+
+
 class TestParseError:
     def test_unparseable_file_is_a_finding(self, tmp_path):
         fs = findings_for(tmp_path, "pkg/broken.py",
