@@ -629,10 +629,90 @@ def _bench_generate(n_clients: int = 8, reqs_per_client: int = 3,
         for k in engine.trace_counts}
     engine.shutdown()
 
+    # -- shared-prefix storm (ISSUE 16): the production shape where
+    # thousands of requests share one system prompt. Identical prompts,
+    # greedy: after one priming request (the excluded warm pass, same as
+    # every other mode) the n-gram draft predicts the continuation and
+    # every admit copies cached prefix KV instead of re-running prefill.
+    # A/B: the plain engine (PR 9 configuration) vs speculation + prefix
+    # cache on the SAME storm; outputs must stay bit-identical to solo
+    # generate_cached and steady state must trace zero new programs.
+    sp_prompt = rng.integers(0, 512, (96,)).astype(np.int32)
+    sp_mn = 128
+    sp_ref = model.generate_cached(sp_prompt, max_new=sp_mn)[0]
+    sp_total = n_clients * reqs_per_client * sp_mn
+
+    def shared_storm(**eng_kwargs):
+        eng = GenerationEngine(model, n_slots=n_slots,
+                               queue_limit=n_clients * reqs_per_client + 4,
+                               default_timeout_s=600.0, **eng_kwargs)
+        eng.warmup()
+        # priming request: learns the n-gram continuation + captures the
+        # prefix KV entry, so the timed pass measures steady state
+        eng.submit(sp_prompt, max_new=sp_mn,
+                   timeout=600).result(timeout=600)
+        before = dict(eng.trace_counts)
+        lats, fails = [], [0]
+        lk = threading.Lock()
+
+        def cl():
+            mine, bad = [], 0
+            for _ in range(reqs_per_client):
+                t1 = time.perf_counter()
+                out = eng.submit(sp_prompt, max_new=sp_mn,
+                                 timeout=600).result(timeout=600)
+                mine.append(time.perf_counter() - t1)
+                if not np.array_equal(out, sp_ref):
+                    bad += 1
+            with lk:
+                lats.extend(mine)
+                fails[0] += bad
+
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=cl) for _ in range(n_clients)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        dt = time.perf_counter() - t0
+        retr = {k: eng.trace_counts.get(k, 0) - before.get(k, 0)
+                for k in eng.trace_counts}
+        snap = eng.metrics.snapshot()
+        eng.shutdown()
+        return sp_total / dt, lats, fails[0], retr, snap
+
+    plain_tps, _, plain_fail, plain_retr, _ = shared_storm()
+    spec_tps, spec_lats, spec_fail, spec_retr, spec_snap = shared_storm(
+        spec_decode_k=8, prefix_cache_mb=16.0)
+    parity_fail += plain_fail + spec_fail
+    shared_prefix = {
+        "spec_engine_tokens_per_sec": round(spec_tps, 1),
+        "plain_engine_tokens_per_sec": round(plain_tps, 1),
+        "speedup_vs_plain_engine": (round(spec_tps / plain_tps, 2)
+                                    if plain_tps else None),
+        "draft_acceptance_rate": spec_snap.get("draft_acceptance"),
+        "prefill_flops_avoided": spec_snap.get("prefill_flops_avoided"),
+        "prefix_hits": spec_snap.get("prefix_hits"),
+        "prefix_lookups": spec_snap.get("prefix_lookups"),
+        "latency_p50_ms": None,  # filled below once q() exists
+        "requests": n_clients * reqs_per_client,
+        "tokens": sp_total,
+        "spec_decode_k": 8,
+        "prefix_cache_mb": 16.0,
+        "storm_retraces": {"plain": plain_retr, "spec": spec_retr},
+        "parity_failures": plain_fail + spec_fail,
+        "config": (f"shared prompt len 96, max_new {sp_mn}, "
+                   f"{n_clients} clients x {reqs_per_client} reqs, "
+                   "greedy, one priming request excluded"),
+        "note": ("gate: speedup_vs_plain_engine >= 2.0, parity vs solo "
+                 "generate_cached bit-identical, 0 storm retraces"),
+    }
+
     def q(lats, p):
         lats = sorted(lats)
         return round(lats[min(int(p * len(lats)), len(lats) - 1)] * 1e3, 2)
 
+    shared_prefix["latency_p50_ms"] = q(spec_lats, 0.5)
     result = {
         "metric": "generation_tokens_per_sec_continuous_batching",
         "value": round(eng_tps, 1),
@@ -652,6 +732,7 @@ def _bench_generate(n_clients: int = 8, reqs_per_client: int = 3,
             "n_slots": n_slots,
             "parity_failures": parity_fail,
             "storm_retraces": storm_retraces,
+            "shared_prefix_storm": shared_prefix,
             "warmup": warm,
             "config": ("TransformerLM d128 L4 h4 V512 maxlen256, "
                        f"{n_clients} clients x {reqs_per_client} reqs, "

@@ -760,6 +760,60 @@ def drill_generate_watchdog_stall(ctx: DrillContext):
         engine.shutdown(drain=False)
 
 
+@drill("generation_storm", ["generate.prefix_cache"],
+       expected_alerts=["prefix_hit_rate_low"])
+def drill_generate_prefix_poisoned(ctx: DrillContext):
+    """A poisoned prefix-cache entry (restore raises at the seam) is
+    dropped typed and the request falls back to a real prefill with
+    bit-identical output; the collapsing hit rate trips the SLO rule."""
+    from deeplearning4j_tpu.serving.generate import GenerationEngine
+    from deeplearning4j_tpu.serving.metrics import GenerationMetrics
+
+    # the engine's gauges land in the detection evaluator's registry so
+    # the hit-rate rule watches the drill's own engine
+    metrics = GenerationMetrics(registry=ctx.alerts.registry)
+    engine = GenerationEngine(_lstm(), n_slots=2, max_length=16,
+                              default_timeout_s=60.0, metrics=metrics,
+                              prefix_cache_mb=1.0)
+    try:
+        prompt = np.array([1, 2, 3], np.int32)
+        ref = engine.generate(prompt, max_new=4)        # miss: captured
+        hit = engine.generate(prompt, max_new=4)        # genuine hit
+        ctx.report.add("clean_hit_bit_identical",
+                       np.array_equal(ref, hit), str(hit))
+        plan = ChaosPlan([{"seam": "generate.prefix_cache",
+                           "mode": "error", "times": None}], name=ctx.name)
+        with plan.armed():
+            # every hit is poisoned: entry dropped, real prefill runs;
+            # misses re-capture, so hit/drop alternate and the hit rate
+            # collapses past the gauge's 8-lookup floor
+            outs = []
+            for _ in range(8):
+                out, err = ctx.capture(engine.generate, prompt,
+                                       max_new=4, timeout=30)
+                ctx.report.add("poisoned_fallback_no_caller_error",
+                               err is None, str(err))
+                outs.append(out)
+        ctx.report.add(
+            "poisoned_fallback_bit_identical",
+            all(o is not None and np.array_equal(ref, o) for o in outs),
+            str([None if o is None else list(o) for o in outs]))
+        invariants.check_typed_errors(ctx.report, ctx.errors)
+        invariants.check_event_order(ctx.report, ctx.events(),
+                                     ["prefix_hit", "prefix_evict"])
+        poisoned = [e for e in ctx.events(["prefix_evict"])
+                    if e.get("reason") == "poisoned"]
+        ctx.report.add("poisoned_entries_dropped", len(poisoned) >= 3,
+                       f"{len(poisoned)} poisoned evictions")
+        snap = metrics.snapshot()
+        ctx.report.add("hit_rate_collapsed",
+                       snap["prefix_lookups"] >= 8 and
+                       snap["prefix_hits"] * 5 <= snap["prefix_lookups"],
+                       f"{snap['prefix_hits']}/{snap['prefix_lookups']}")
+    finally:
+        engine.shutdown(drain=False)
+
+
 @drill("serving", ["serving.batch_dispatch"])
 def drill_serving_dispatch_error(ctx: DrillContext):
     """A batched-inference dispatch failure fails exactly that batch

@@ -137,6 +137,11 @@ register_hook_seam(
     "the one in-flight jitted decode step (error = decode failure, "
     "delay past the watchdog limit = hung dispatch)")
 register_hook_seam(
+    "generate.prefix_cache", "generation",
+    "a shared-prefix cache hit about to restore cached KV into a slot "
+    "(mode 'error' = poisoned entry: the engine must drop it and fall "
+    "back to a real prefill, bit-identically)")
+register_hook_seam(
     "registry.validation_score", "deployment",
     "the held-out validation score at publish (mode 'value': override "
     "with value=NaN for the poisoned-snapshot drill)")

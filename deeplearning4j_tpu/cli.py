@@ -176,6 +176,24 @@ def serve_main(argv) -> int:
     ap.add_argument("--gen-queue-limit", type=int, default=64,
                     help="bounded generation admission queue; beyond it "
                          "requests are rejected 503 (backpressure)")
+    ap.add_argument("--spec-decode-k", type=int, default=1,
+                    help="speculative decoding: propose up to k tokens "
+                         "per slot per dispatch and verify them in ONE "
+                         "batched step (1 = off); greedy output stays "
+                         "bit-identical to token-by-token decode")
+    ap.add_argument("--spec-draft-mode", default="ngram",
+                    choices=("ngram", "truncated"),
+                    help="draft source with --spec-decode-k > 1: 'ngram' "
+                         "(per-engine table learned from prompts and "
+                         "accepted tokens — free) or 'truncated' (half-"
+                         "depth model pass; transformers only)")
+    ap.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                    help="shared-prefix KV cache budget in MiB (0 = "
+                         "off): a request whose prompt hashes to a "
+                         "cached entry copies the prefix KV into its "
+                         "slot instead of re-running prefill; LRU-bytes "
+                         "eviction, counted against the slab memory "
+                         "estimate")
     ap.add_argument("--smoke", action="store_true",
                     help="serve ONE local request through the HTTP stack, "
                          "print the result, shut down (CI gate)")
@@ -269,6 +287,9 @@ def serve_main(argv) -> int:
                 max_length=args.gen_max_length,
                 prefill_buckets=gen_buckets,
                 queue_limit=args.gen_queue_limit,
+                spec_decode_k=args.spec_decode_k,
+                draft_mode=args.spec_draft_mode,
+                prefix_cache_mb=args.prefix_cache_mb,
                 metrics=GenerationMetrics(registry=default_registry()))
         except TypeError as e:
             print(f"generation disabled: {e}", flush=True)
@@ -278,11 +299,17 @@ def serve_main(argv) -> int:
                 print(f"generation warmup: buckets {rep.get('buckets')}, "
                       f"compiles {rep.get('compiles')}, "
                       f"{rep.get('seconds')}s", flush=True)
+            extras = ""
+            if generation.spec_decode_k > 1:
+                extras += (f", spec k={generation.spec_decode_k} "
+                           f"[{generation.draft_mode}]")
+            if args.prefix_cache_mb > 0:
+                extras += f", prefix cache {args.prefix_cache_mb:g}MiB"
             print(f"generation: {generation.n_slots} slots x "
                   f"max_length {generation.max_length} "
                   f"({generation.backend.kind} backend, "
                   f"{generation.memory_report['cache_bytes']:,} cache "
-                  "bytes)", flush=True)
+                  f"bytes{extras})", flush=True)
 
     server = InferenceServer(
         engine, host=args.host, port=args.port,
@@ -341,6 +368,9 @@ def _serve_registry(args) -> int:
         canary_fraction=args.canary_fraction,
         canary_window_s=args.canary_window,
         gen_slots=args.gen_slots, gen_max_length=args.gen_max_length,
+        gen_spec_decode_k=args.spec_decode_k,
+        gen_draft_mode=args.spec_draft_mode,
+        gen_prefix_cache_mb=args.prefix_cache_mb,
         metrics=ServingMetrics(registry=default_registry()))
     names = registry.models()
     print(f"registry {args.registry_dir}: models {names or '(none yet)'} "

@@ -494,6 +494,92 @@ def decode_step(cfg: TransformerLMConfig, params: Dict[str, Array],
     return logits, {"k": new_k, "v": new_v, "pos": pos + 1}
 
 
+def decode_steps(cfg: TransformerLMConfig, params: Dict[str, Array],
+                 cache: Dict, ids_k: Array):
+    """K-column decode for speculative verification: ids_k (b, K) int32
+    where column 0 sits at per-row position ``cache["pos"]`` (a (b,)
+    vector) and column j at pos+j → (logits (b, K, V) fp32, new cache).
+    One dispatch scores all K positions: column j's logits are the
+    model's next-token distribution AFTER consuming ids_k[:, :j+1], so a
+    draft token at column j+1 is verified against logits[:, j] — exactly
+    the distribution token-by-token decode would have produced, which is
+    what makes speculative acceptance exact.
+
+    K/V for all K columns is written (scatter at pos..pos+K-1) BEFORE
+    attention, so column j attends to columns 0..j of the current block
+    plus the prior context (mask t <= pos+j). Writes use ``mode="drop"``:
+    a column whose absolute position falls past the slab (pos+j >= T)
+    is dropped rather than clipped — clipping would land every
+    out-of-range column on T-1 and corrupt the real write when a row's
+    final token sits exactly at the slab edge. Callers must therefore
+    never ACCEPT a column at pos+j > T-1 (its logits are garbage); the
+    engine clamps draft lengths to the window.
+
+    Rejected-draft "rollback" is free: stale K/V past the accepted
+    position is masked from every later read (t <= pos') and each later
+    dispatch rewrites its columns contiguously from pos' before reading
+    them, so garbage is always overwritten before it becomes visible.
+
+    MoE is unsupported (routing would compete b*K tokens per step where
+    sequential decode competes b — acceptance would no longer be exact);
+    callers keep MoE engines at k=1."""
+    if cfg.n_experts > 0:
+        raise ValueError("decode_steps does not support MoE models "
+                         "(per-step routing capacity differs from "
+                         "sequential decode); use decode_step")
+    cd = _cdtype(cfg)
+    pos = cache["pos"]
+    T = cache["k"].shape[3]
+    b, K = ids_k.shape
+    hn = cfg.n_heads
+    d = cfg.d_model
+    scale = 1.0 / math.sqrt(d // hn)
+    cols = pos[:, None] + jnp.arange(K)[None, :]  # (b, K) absolute pos
+    ptab = jnp.take(params["pos"], cols, axis=0)  # clip-mode gather
+    x = params["embed"][ids_k] + ptab
+    if cd is not None:
+        x = x.astype(cd)
+    valid = jnp.arange(T)[None, None, :] <= cols[:, :, None]  # (b, K, T)
+    rows = jnp.arange(b)
+
+    def body(x, xs):
+        bp, kc, vc = xs  # kc/vc: (b, hn, T, hd)
+        if cd is not None:
+            bp = {k2: (v.astype(cd) if k2[0] in ("W", "b") else v)
+                  for k2, v in bp.items()}
+        a_in = _ln(x, bp["ln1_g"], bp["ln1_b"], cd)
+
+        def head_proj(W):
+            return (a_in @ W).reshape(b, K, hn, -1)  # (b, K, hn, hd)
+
+        k, v = head_proj(bp["Wk"]), head_proj(bp["Wv"])
+        q = head_proj(bp["Wq"]).transpose(0, 2, 1, 3)  # (b, hn, K, hd)
+        # advanced indices at axes 0 and 2 around the ':' slice → result
+        # dims (b, K) lead, so the (b, K, hn, hd) values scatter directly
+        kc = kc.at[rows[:, None], :, cols].set(k.astype(kc.dtype),
+                                               mode="drop")
+        vc = vc.at[rows[:, None], :, cols].set(v.astype(vc.dtype),
+                                               mode="drop")
+        scores = jnp.einsum("bhkd,bhtd->bhkt", q,
+                            kc).astype(jnp.float32) * scale
+        scores = jnp.where(valid[:, None, :, :], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1).astype(kc.dtype)
+        o = jnp.einsum("bhkt,bhtd->bhkd", p, vc)
+        o = o.transpose(0, 2, 1, 3).reshape(b, K, d).astype(x.dtype)
+        x = x + o @ bp["Wo"] + bp["bo"]
+        m_in = _ln(x, bp["ln2_g"], bp["ln2_b"], cd)
+        h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
+        x = x + h @ bp["W2"] + bp["b2"]
+        return x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = _ln(x, params["lnf_g"], params["lnf_b"], cd)
+    head = params["head"].astype(cd) if cd is not None else params["head"]
+    logits = (x @ head).astype(jnp.float32)
+    return logits, {"k": new_k, "v": new_v, "pos": pos + K}
+
+
 def prefill_bucket_lengths(max_length: int, hint=None):
     """Ascending prompt-length bucket list for prefill padding — the
     ``serving_seq_buckets`` discipline applied to the decode path: every

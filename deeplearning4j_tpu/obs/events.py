@@ -156,6 +156,18 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
     "generation_memory_check": ("serving/generate.py",
                                 "slab bytes validated against the "
                                 "memory estimator at engine build"),
+    "prefix_hit": ("serving/generate.py",
+                   "shared-prefix cache hit: prefill replaced by a KV "
+                   "block copy into the claiming slot"),
+    "prefix_evict": ("serving/generate.py",
+                     "prefix-cache entry dropped (reason: lru / "
+                     "poisoned / replaced / cleared)"),
+    "draft_accept": ("serving/generate.py",
+                     "per-request speculative-decoding summary at slot "
+                     "free (proposed, accepted, rate)"),
+    "draft_flush": ("serving/generate.py",
+                    "n-gram draft table hit its size cap and was "
+                    "cleared whole"),
     # -- kernels (nn/ops/registry.py) -------------------------------------
     "kernel_fallback": ("nn/ops/registry.py",
                         "a Pallas kernel probe failed/was disabled; "
@@ -209,6 +221,9 @@ HOOK_POINTS: Dict[str, tuple] = {
     "generate.decode_dispatch": ("serving/generate.py",
                                  "one jitted decode step about to "
                                  "dispatch (engine chaos_ctx tags)"),
+    "generate.prefix_cache": ("serving/generate.py",
+                              "a prefix-cache hit about to restore a "
+                              "cached KV block into a slot"),
     "kernel.probe": ("nn/ops/registry.py",
                      "a kernel availability probe about to compile+run "
                      "(transient_compile mode)"),
@@ -271,6 +286,9 @@ ALERTS: Dict[str, tuple] = {
                          "paths"),
     "lock_cycle_detected": ("obs/slo.py",
                             "lock witness saw an ABBA ordering cycle"),
+    "prefix_hit_rate_low": ("obs/slo.py",
+                            "shared-prefix cache hit rate collapsed "
+                            "under repeated-prompt traffic"),
     # the canary gate, expressed in the same engine (serving/registry.py
     # builds these per canary window via obs/slo.canary_gate_rules)
     "canary_score_regressed": ("obs/slo.py",

@@ -153,6 +153,16 @@ def default_rules(queue_limit: int = 256,
             **_flight("overload_reject"),
             description="sustained typed backpressure rejections at "
                         "the queue limit — clients are being shed"),
+        AlertRule(
+            "prefix_hit_rate_low", "threshold",
+            metric="generation_prefix_hit_rate", op="<=",
+            threshold=0.2, for_s=5.0, resolve_s=60.0, severity="warn",
+            description="shared-prefix cache hit rate collapsed under "
+                        "repeated-prompt traffic — prefills are being "
+                        "re-run (cache too small, entries poisoned, or "
+                        "traffic stopped sharing prefixes); the gauge "
+                        "only exists after the lookup floor, so fresh "
+                        "or prefix-less engines stay quiet"),
         # -- continuous deployment -------------------------------------------
         AlertRule(
             "publish_refused", "increase", severity="warn",

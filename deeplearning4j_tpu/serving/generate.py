@@ -51,9 +51,11 @@ Observability: flight-recorder slot lifecycle events (``slot_claim`` /
 
 from __future__ import annotations
 
+import hashlib
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -98,7 +100,7 @@ class GenerationRequest:
     __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
                  "seed", "deadline", "enqueued_at", "trace", "tokens",
                  "slot", "_event", "_lock", "_stream", "result_", "error_",
-                 "on_done")
+                 "on_done", "draft_proposed", "draft_accepted")
 
     def __init__(self, prompt_ids, max_new: int, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0, seed: int = 0,
@@ -115,6 +117,10 @@ class GenerationRequest:
         self.trace = rtrace.RequestTrace() if trace else None
         #: generated token ids, in order (grows as decoding proceeds)
         self.tokens: List[int] = []
+        #: speculative-decoding accounting: draft tokens proposed for /
+        #: accepted by this request's verify dispatches
+        self.draft_proposed = 0
+        self.draft_accepted = 0
         #: slot index while decoding, else None
         self.slot: Optional[int] = None
         self._event = threading.Event()
@@ -204,6 +210,163 @@ class GenerationRequest:
 
 
 # --------------------------------------------------------------------------
+# speculative drafting + shared-prefix KV cache
+# --------------------------------------------------------------------------
+class _NgramDraft:
+    """Per-engine order-2 n-gram draft table for self-speculative
+    decoding: ``(t[i-2], t[i-1]) → t[i]`` learned from every prompt and
+    every emitted token (last-writer-wins, so the table adapts). Drafts
+    are chained lookups from a slot's last two tokens — free to produce,
+    and on repetitive traffic (shared-prefix storms, templated output)
+    acceptance approaches 1. The table is bounded: crossing ``cap``
+    clears it whole (``draft_flush`` flight event) rather than tracking
+    per-entry LRU — n-gram stats rebuild in a few hundred tokens."""
+
+    __slots__ = ("cap", "table", "flushes")
+
+    def __init__(self, cap: int = 65536):
+        self.cap = int(cap)
+        self.table: Dict = {}
+        self.flushes = 0
+
+    def learn(self, a: int, b: int, c: int) -> None:
+        self.table[(int(a), int(b))] = int(c)
+        if len(self.table) > self.cap:
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            self.table.clear()
+            self.flushes += 1
+            _flight.record("draft_flush", entries=self.cap,
+                           flushes=self.flushes)
+
+    def learn_seq(self, toks) -> None:
+        for i in range(len(toks) - 2):
+            self.learn(toks[i], toks[i + 1], toks[i + 2])
+
+    def propose(self, a: int, b: int, n: int) -> List[int]:
+        """Up to n draft tokens continuing context (a, b); stops at the
+        first context the table has never seen."""
+        out: List[int] = []
+        a, b = int(a), int(b)
+        for _ in range(n):
+            c = self.table.get((a, b))
+            if c is None:
+                break
+            out.append(c)
+            a, b = b, c
+        return out
+
+
+class PrefixCache:
+    """LRU-bytes cache of prefilled prompt state keyed by the EXACT
+    prompt (backend kind, length, sha1 of the token bytes). A hit
+    replaces the prefill dispatch with a per-bucket KV-block copy into
+    the claiming slot plus a (1, V) sample of the STORED last-position
+    logits — prefill logits are deterministic for a given prompt, so the
+    hit path's first token and key chain are bit-identical to a real
+    prefill. Entries are backend-opaque dicts carrying ``bytes`` (device
+    memory held) and ``tb`` (the prompt's prefill bucket); eviction is
+    LRU by bytes against ``limit_bytes``.
+
+    Flight/metrics contract: every ``lookup`` counts toward the lazily
+    created ``generation_prefix_hit_rate`` gauge; ``commit_hit`` (called
+    only after the copy-in succeeded) fires ``prefix_hit``; ``drop``
+    fires ``prefix_evict`` with the reason (lru / poisoned / cleared).
+    Entries hold KV computed by the CURRENT params — a hot params
+    reload must ``clear()`` (see ``GenerationEngine.clear_prefix_cache``)."""
+
+    def __init__(self, limit_bytes: int, metrics: GenerationMetrics):
+        self.limit_bytes = int(limit_bytes)
+        self.metrics = metrics
+        self._entries: "OrderedDict" = OrderedDict()
+        self._bytes = 0
+        self.lookups = 0
+        self.hits = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def bytes(self) -> int:
+        return self._bytes
+
+    @staticmethod
+    def key_for(kind: str, prompt: np.ndarray):
+        return (kind, int(prompt.size),
+                hashlib.sha1(np.ascontiguousarray(prompt).tobytes())
+                .hexdigest())
+
+    def lookup(self, key):
+        """One admission-time probe; returns the entry or None. The hit
+        is NOT committed here — the caller commits only after the
+        copy-in succeeded (a poisoned entry must count as a miss)."""
+        self.lookups += 1
+        self.metrics.record_prefix_lookup()
+        return self._entries.get(key)
+
+    def commit_hit(self, key, prompt_len: int, slot: int,
+                   flops_avoided: int = 0) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._entries.move_to_end(key)
+        self.hits += 1
+        self.metrics.record_prefix_hit(flops_avoided)
+        _flight.record("prefix_hit", slot=int(slot),
+                       prompt_len=int(prompt_len),
+                       bucket=int(entry["tb"]) if entry else -1,
+                       flops_avoided=int(flops_avoided))
+
+    def drop(self, key, reason: str) -> None:
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return
+        self._bytes -= int(entry["bytes"])
+        self.metrics.record_prefix_evict()
+        self.metrics.set_prefix_bytes(self._bytes)
+        _flight.record("prefix_evict", reason=reason,
+                       bucket=int(entry["tb"]),
+                       bytes=int(entry["bytes"]),
+                       resident=len(self._entries))
+
+    def put(self, key, entry: dict) -> bool:
+        """Insert (replacing any stale entry for the key), evicting LRU
+        entries until the budget fits; refuses entries larger than the
+        whole budget."""
+        if int(entry["bytes"]) > self.limit_bytes:
+            return False
+        if key in self._entries:
+            self.drop(key, reason="replaced")
+        while self._bytes + int(entry["bytes"]) > self.limit_bytes \
+                and self._entries:
+            oldest = next(iter(self._entries))
+            self.drop(oldest, reason="lru")
+        self._entries[key] = entry
+        self._bytes += int(entry["bytes"])
+        self.metrics.set_prefix_bytes(self._bytes)
+        return True
+
+    def attach_completion(self, key, toks) -> None:
+        """Record the prompt's FIRST greedy completion on its entry:
+        later hits replay it as the slot's draft source. Only the first
+        one sticks (greedy is deterministic, so later ones are
+        identical anyway); a handful of host ints, not counted against
+        the byte budget."""
+        entry = self._entries.get(key)
+        if entry is not None and "completion" not in entry:
+            entry["completion"] = [int(t) for t in toks]
+
+    def clear(self, reason: str = "cleared") -> int:
+        n = len(self._entries)
+        for key in list(self._entries):
+            self.drop(key, reason=reason)
+        return n
+
+
+# --------------------------------------------------------------------------
 # decode backends
 # --------------------------------------------------------------------------
 class _TransformerBackend:
@@ -213,9 +376,11 @@ class _TransformerBackend:
     kind = "transformer"
 
     def __init__(self, model, n_slots: int, max_length: Optional[int],
-                 prefill_buckets: Optional[Sequence[int]], trace_hook):
+                 prefill_buckets: Optional[Sequence[int]], trace_hook,
+                 spec_k: int = 1, draft_layers: int = 0):
         from deeplearning4j_tpu.models.transformer_lm import (
             decode_step,
+            decode_steps,
             init_decode_cache,
             prefill_bucket_lengths,
             prefill_cache,
@@ -232,9 +397,28 @@ class _TransformerBackend:
             self.max_length,
             prefill_buckets or getattr(model, "serving_seq_buckets", None))
         self._cfg = cfg
+        #: speculation lane width K: column 0 is the current token,
+        #: columns 1..K-1 draft proposals. MoE pins K=1 — decode_steps'
+        #: routing would compete b*K tokens where sequential decode
+        #: competes b, so acceptance would no longer be exact.
+        self.spec_k = 1 if cfg.n_experts > 0 else max(1, int(spec_k))
+        #: truncated-layer draft model depth (0 = n-gram drafting only);
+        #: only meaningful with spec_k > 1 and 0 < draft_layers < L
+        self.draft_layers = (int(draft_layers)
+                             if self.spec_k > 1
+                             and 0 < int(draft_layers) < cfg.n_layers
+                             else 0)
         self.reset()
         self.cache_bytes = 2 * int(np.prod(self._kc.shape)) * \
             self._kc.dtype.itemsize
+        if self.draft_layers:
+            self.cache_bytes += 2 * int(np.prod(self._dkc.shape)) * \
+                self._dkc.dtype.itemsize
+        #: per-bucket prefix-cache copy programs (capture = slab→entry
+        #: slice-out, restore = entry→slab splice-in), compiled lazily
+        #: and pre-warmed by GenerationEngine.warmup
+        self._cap_fns: Dict[int, Callable] = {}
+        self._res_fns: Dict[int, Callable] = {}
 
         def _decode(p, kc, vc, toks, pos, active, t, k, pp, keys):
             trace_hook("generation_decode")
@@ -246,8 +430,13 @@ class _TransformerBackend:
             return nxt, nkeys, c["k"], c["v"]
 
         T = self.max_length
+        Ld = self.draft_layers
 
-        def _prefill(p, kc, vc, ids, ln, slot, t, k, pp, key):
+        def _slice_draft(p):
+            return {**p, "blocks": jax.tree_util.tree_map(
+                lambda a: a[:Ld], p["blocks"])}
+
+        def _prefill(p, kc, vc, dkc, dvc, ids, ln, slot, t, k, pp, key):
             trace_hook("generation_prefill")
             tmp = init_decode_cache(cfg, 1, max_length=T)
             logits, tmp = prefill_cache(cfg, p, tmp, ids, length=ln)
@@ -255,11 +444,89 @@ class _TransformerBackend:
                                               (0, slot, 0, 0, 0))
             vc = jax.lax.dynamic_update_slice(vc, tmp["v"],
                                               (0, slot, 0, 0, 0))
+            if Ld:
+                # the truncated draft model prefills its own (shallower)
+                # slab from the same prompt
+                dp = _slice_draft(p)
+                dtmp = {"k": jnp.zeros((Ld,) + tmp["k"].shape[1:],
+                                       tmp["k"].dtype),
+                        "v": jnp.zeros((Ld,) + tmp["v"].shape[1:],
+                                       tmp["v"].dtype),
+                        "pos": jnp.zeros((), jnp.int32)}
+                _dl, dtmp = prefill_cache(cfg, dp, dtmp, ids, length=ln)
+                dkc = jax.lax.dynamic_update_slice(dkc, dtmp["k"],
+                                                   (0, slot, 0, 0, 0))
+                dvc = jax.lax.dynamic_update_slice(dvc, dtmp["v"],
+                                                   (0, slot, 0, 0, 0))
             tok0, key = sample_next_device(logits, t, k, pp, key)
-            return tok0[0], key, kc, vc
+            return tok0[0], key, kc, vc, dkc, dvc, logits[0]
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(1, 2))
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1, 2))
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=(1, 2, 3, 4))
+
+        def _sample1(logits, t, k, pp, key):
+            trace_hook("generation_prefix_sample")
+            tok0, key = sample_next_device(logits, t, k, pp, key)
+            return tok0[0], key
+
+        self._sample1_fn = jax.jit(_sample1)
+
+        K = self.spec_k
+        if K > 1:
+            def _verify(p, kc, vc, toks, dlen, pos, active, t, k, pp,
+                        keys):
+                """One dispatch verifying K columns per slot. toks
+                (S, K): col 0 = current token, cols 1..dlen = drafts.
+                Emits s (S, K) — the tokens sequential decode WOULD have
+                produced at each column — plus e (S,) the number of
+                leading columns that are real output: e = 1 + longest
+                draft prefix where draft j == s[j-1] (the exact
+                acceptance rule: a draft survives iff the verifier
+                sampled exactly it, so the emitted stream and the key
+                chain are those of token-by-token decode)."""
+                trace_hook("generation_verify")
+                logits, c = decode_steps(
+                    cfg, p, {"k": kc, "v": vc, "pos": pos}, toks)
+                outs, kstack, ks = [], [keys], keys
+                for j in range(K):
+                    sj, ks = sample_next_rows(logits[:, j], t, k, pp, ks)
+                    outs.append(sj)
+                    kstack.append(ks)
+                s = jnp.stack(outs, axis=1)          # (S, K)
+                kst = jnp.stack(kstack, axis=1)      # (S, K+1, 2)
+                jj = jnp.arange(1, K)
+                ok = (s[:, :-1] == toks[:, 1:]) & \
+                    (jj[None, :] <= dlen[:, None])
+                e = 1 + jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(1)
+                # the key chain advanced exactly e times (once per
+                # emitted token) — select that state per row
+                nkeys = jnp.take_along_axis(
+                    kst, e[:, None, None], axis=1)[:, 0]
+                last = jnp.take_along_axis(
+                    s, (e - 1)[:, None], axis=1)[:, 0]
+                last = jnp.where(active, last, toks[:, 0])
+                nkeys = jnp.where(active[:, None], nkeys, keys)
+                e = jnp.where(active, e, 0)
+                return s, e, last, nkeys, c["k"], c["v"]
+
+            self._verify_fn = jax.jit(_verify, donate_argnums=(1, 2))
+
+        if Ld:
+            def _draft(p, dkc, dvc, toks, pos, active):
+                """K-1 greedy steps of the truncated-layer draft model —
+                one dispatch proposing drafts for every slot."""
+                trace_hook("generation_draft")
+                dp = _slice_draft(p)
+                c = {"k": dkc, "v": dvc, "pos": pos}
+                tok = toks
+                outs = []
+                for _ in range(K - 1):
+                    logits, c = decode_step(cfg, dp, c, tok)
+                    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    outs.append(tok)
+                return jnp.stack(outs, axis=1), c["k"], c["v"]
+
+            self._draft_fn = jax.jit(_draft, donate_argnums=(1, 2))
 
     def reset(self) -> None:
         """(Re)build the KV slab — at construction, and for engine
@@ -272,6 +539,13 @@ class _TransformerBackend:
         slab = init_decode_cache(self._cfg, self.n_slots,
                                  max_length=self.max_length)
         self._kc, self._vc = slab["k"], slab["v"]
+        if self.draft_layers:
+            self._dkc = self._kc[:self.draft_layers]
+            self._dvc = self._vc[:self.draft_layers]
+        else:
+            # zero-size placeholders keep the prefill signature uniform
+            self._dkc = self._kc[:0]
+            self._dvc = self._vc[:0]
 
     def bucket_for(self, prompt_len: int) -> int:
         return next(t for t in self.buckets if t >= prompt_len)
@@ -279,22 +553,28 @@ class _TransformerBackend:
     def prefill(self, slot: int, prompt: np.ndarray, temperature: float,
                 top_k: int, top_p: float, key: np.ndarray):
         """Prefill one slot; returns (first token int, advanced key,
-        prompt bucket). One host sync per REQUEST (the first token),
-        amortized over its whole decode. MoE prompts skip bucketing —
-        pad tokens would compete for expert capacity and perturb
-        real-token logits (same exemption, and the same one-program-
-        per-distinct-length cost, as ``generate_cached``)."""
+        prompt bucket, last-position logits (V,) fp32 device array —
+        the prefix cache stores these so a hit can re-sample the first
+        token bit-identically under any policy/key). One host sync per
+        REQUEST (the first token), amortized over its whole decode. MoE
+        prompts skip bucketing — pad tokens would compete for expert
+        capacity and perturb real-token logits (same exemption, and the
+        same one-program-per-distinct-length cost, as
+        ``generate_cached``)."""
         tp = int(prompt.shape[0])
         tb = tp if self._cfg.n_experts > 0 else self.bucket_for(tp)
         ids = np.zeros((1, tb), np.int32)
         ids[0, :tp] = prompt
-        tok0, key, self._kc, self._vc = self._prefill_fn(
-            self.model.params_, self._kc, self._vc, jnp.asarray(ids),
-            jnp.asarray(tp, jnp.int32), jnp.asarray(int(slot), jnp.int32),
-            jnp.asarray(temperature, jnp.float32),
-            jnp.asarray(int(top_k), jnp.int32),
-            jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
-        return int(tok0), np.asarray(key), tb
+        tok0, key, self._kc, self._vc, self._dkc, self._dvc, logits0 = \
+            self._prefill_fn(
+                self.model.params_, self._kc, self._vc, self._dkc,
+                self._dvc, jnp.asarray(ids),
+                jnp.asarray(tp, jnp.int32),
+                jnp.asarray(int(slot), jnp.int32),
+                jnp.asarray(temperature, jnp.float32),
+                jnp.asarray(int(top_k), jnp.int32),
+                jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
+        return int(tok0), np.asarray(key), tb, logits0
 
     def decode(self, tokens, pos, active, temperature, top_k, top_p, keys):
         """One batched token step for all slots; returns
@@ -306,6 +586,91 @@ class _TransformerBackend:
             jnp.asarray(temperature), jnp.asarray(top_k),
             jnp.asarray(top_p), jnp.asarray(keys))
         return np.asarray(nxt), np.asarray(nkeys)
+
+    def verify(self, toks_k, dlen, pos, active, temperature, top_k, top_p,
+               keys):
+        """One batched draft-verify step (spec_k > 1 only): toks_k
+        (S, K) proposal lane, dlen (S,) per-slot draft counts. Returns
+        host arrays (emitted (S, K), accepted counts e (S,), new current
+        token (S,), advanced keys (S, 2)) — still ONE host sync for up
+        to K tokens per slot."""
+        s, e, last, nkeys, self._kc, self._vc = self._verify_fn(
+            self.model.params_, self._kc, self._vc,
+            jnp.asarray(toks_k), jnp.asarray(dlen), jnp.asarray(pos),
+            jnp.asarray(active), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(keys))
+        return (np.asarray(s), np.asarray(e), np.asarray(last),
+                np.asarray(nkeys))
+
+    def draft(self, tokens, pos, active):
+        """Truncated-layer draft proposals: (S, K-1) greedy tokens from
+        the first ``draft_layers`` blocks, one dispatch for all slots."""
+        drafts, self._dkc, self._dvc = self._draft_fn(
+            self.model.params_, self._dkc, self._dvc,
+            jnp.asarray(tokens), jnp.asarray(pos), jnp.asarray(active))
+        return np.asarray(drafts)
+
+    # -- shared-prefix cache hooks ------------------------------------------
+    def prefix_capture(self, slot: int, tb: int, logits0) -> dict:
+        """Slice the slot's first ``tb`` KV columns (and the truncated
+        draft slab's, when speculating through it) out of the slab into
+        a self-contained cache entry. The slab is donated to every
+        decode dispatch, so the entry must be a COPY, not a view."""
+        fn = self._cap_fns.get(tb)
+        if fn is None:
+            L, _S, hn, _T, hd = self._kc.shape
+            Ld = self.draft_layers
+
+            def _cap(kc, vc, dkc, dvc, slot):
+                sl = (0, slot, 0, 0, 0)
+                out = (jax.lax.dynamic_slice(kc, sl, (L, 1, hn, tb, hd)),
+                       jax.lax.dynamic_slice(vc, sl, (L, 1, hn, tb, hd)))
+                if Ld:
+                    out += (jax.lax.dynamic_slice(dkc, sl,
+                                                  (Ld, 1, hn, tb, hd)),
+                            jax.lax.dynamic_slice(dvc, sl,
+                                                  (Ld, 1, hn, tb, hd)))
+                return out
+
+            fn = self._cap_fns[tb] = jax.jit(_cap)
+        blocks = fn(self._kc, self._vc, self._dkc, self._dvc,
+                    jnp.asarray(int(slot), jnp.int32))
+        nbytes = sum(int(b.size) * b.dtype.itemsize for b in blocks) \
+            + int(logits0.size) * 4
+        return {"blocks": blocks, "logits": logits0, "tb": int(tb),
+                "bytes": int(nbytes)}
+
+    def prefix_restore(self, slot: int, entry: dict, temperature: float,
+                       top_k: int, top_p: float, key: np.ndarray):
+        """Splice a cached KV block into ``slot`` and sample the first
+        token from the STORED prefill logits — bit-identical to the real
+        prefill this entry was captured from (same logits, same sampler
+        program shape, same key chain)."""
+        tb = int(entry["tb"])
+        fn = self._res_fns.get(tb)
+        if fn is None:
+            Ld = self.draft_layers
+
+            def _res(kc, vc, dkc, dvc, blocks, slot):
+                sl = (0, slot, 0, 0, 0)
+                kc = jax.lax.dynamic_update_slice(kc, blocks[0], sl)
+                vc = jax.lax.dynamic_update_slice(vc, blocks[1], sl)
+                if Ld:
+                    dkc = jax.lax.dynamic_update_slice(dkc, blocks[2], sl)
+                    dvc = jax.lax.dynamic_update_slice(dvc, blocks[3], sl)
+                return kc, vc, dkc, dvc
+
+            fn = self._res_fns[tb] = jax.jit(_res, donate_argnums=(0, 1,
+                                                                   2, 3))
+        self._kc, self._vc, self._dkc, self._dvc = fn(
+            self._kc, self._vc, self._dkc, self._dvc, entry["blocks"],
+            jnp.asarray(int(slot), jnp.int32))
+        tok0, key = self._sample1_fn(
+            entry["logits"][None],
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(int(top_k), jnp.int32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
+        return int(tok0), np.asarray(key)
 
     def window_check(self, prompt_len: int, max_new: int) -> None:
         from deeplearning4j_tpu.models.transformer_lm import (
@@ -458,10 +823,29 @@ class _RecurrentBackend:
             tok0, key = sample_next_device(logits, t, k, pp, key)
             carries = jax.tree_util.tree_map(
                 lambda big, row: big.at[slot].set(row[0]), carries, nc1)
-            return tok0[0], key, carries
+            return tok0[0], key, carries, logits[0]
 
         self._decode_fn = jax.jit(_decode, donate_argnums=(2,))
         self._prefill_fn = jax.jit(_prefill, donate_argnums=(2,))
+
+        def _sample1(logits, t, k, pp, key):
+            trace_hook("generation_prefix_sample")
+            tok0, key = sample_next_device(logits, t, k, pp, key)
+            return tok0[0], key
+
+        self._sample1_fn = jax.jit(_sample1)
+
+        def _cap(carries, slot):
+            trace_hook("generation_prefix_capture")
+            return jax.tree_util.tree_map(lambda a: a[slot], carries)
+
+        def _res(carries, rows, slot):
+            trace_hook("generation_prefix_restore")
+            return jax.tree_util.tree_map(
+                lambda big, row: big.at[slot].set(row), carries, rows)
+
+        self._cap_fn = jax.jit(_cap)
+        self._res_fn = jax.jit(_res, donate_argnums=(0,))
 
     def reset(self) -> None:
         """(Re)build the carried state — at construction, and for
@@ -477,14 +861,38 @@ class _RecurrentBackend:
         tb = self.bucket_for(tp)
         ids = np.zeros((tb,), np.int32)
         ids[:tp] = prompt
-        tok0, key, self._carries = self._prefill_fn(
+        tok0, key, self._carries, logits0 = self._prefill_fn(
             self.model.params_, self.model.state_, self._carries,
             jnp.asarray(ids), jnp.asarray(tp, jnp.int32),
             jnp.asarray(int(slot), jnp.int32),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(int(top_k), jnp.int32),
             jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
-        return int(tok0), np.asarray(key), tb
+        return int(tok0), np.asarray(key), tb, logits0
+
+    # -- shared-prefix cache hooks ------------------------------------------
+    def prefix_capture(self, slot, tb, logits0) -> dict:
+        """The recurrent decode state is the carry, so a prefix entry is
+        the slot's carry rows + the stored prefill logits — one gather
+        program regardless of bucket."""
+        rows = self._cap_fn(self._carries, jnp.asarray(int(slot),
+                                                       jnp.int32))
+        nbytes = sum(int(a.size) * a.dtype.itemsize
+                     for a in jax.tree_util.tree_leaves(rows)) \
+            + int(logits0.size) * 4
+        return {"rows": rows, "logits": logits0, "tb": int(tb),
+                "bytes": int(nbytes)}
+
+    def prefix_restore(self, slot, entry, temperature, top_k, top_p, key):
+        self._carries = self._res_fn(
+            self._carries, entry["rows"],
+            jnp.asarray(int(slot), jnp.int32))
+        tok0, key = self._sample1_fn(
+            entry["logits"][None],
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(int(top_k), jnp.int32),
+            jnp.asarray(top_p, jnp.float32), jnp.asarray(key))
+        return int(tok0), np.asarray(key)
 
     def decode(self, tokens, pos, active, temperature, top_k, top_p, keys):
         nxt, nkeys, self._carries = self._decode_fn(
@@ -505,12 +913,15 @@ class _RecurrentBackend:
 
 
 def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook,
-                  cell_path: Optional[bool] = None):
+                  cell_path: Optional[bool] = None, spec_k: int = 1,
+                  draft_layers: int = 0):
     from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 
     if isinstance(model, TransformerLM):
         return _TransformerBackend(model, n_slots, max_length,
-                                   prefill_buckets, trace_hook)
+                                   prefill_buckets, trace_hook,
+                                   spec_k=spec_k,
+                                   draft_layers=draft_layers)
     layers = getattr(model, "layers", None)
     if layers is not None:
         from deeplearning4j_tpu.nn.conf.layers.recurrent import (
@@ -531,10 +942,14 @@ def _pick_backend(model, n_slots, max_length, prefill_buckets, trace_hook,
 # memory validation
 # --------------------------------------------------------------------------
 def generation_memory_report(model, n_slots: int,
-                             max_length: Optional[int] = None) -> dict:
+                             max_length: Optional[int] = None,
+                             draft_layers: int = 0) -> dict:
     """Analytic 'will the decode slab fit' answer BEFORE allocating it —
     the nn/conf/memory.py estimator discipline applied to generation
-    state: per-slot cache bytes × n_slots + resident params."""
+    state: per-slot cache bytes × n_slots + resident params.
+    ``draft_layers`` > 0 adds the truncated-layer speculation slab (the
+    draft model keeps its own KV over the first ``draft_layers``
+    blocks)."""
     from deeplearning4j_tpu.models.transformer_lm import TransformerLM
 
     if isinstance(model, TransformerLM):
@@ -543,8 +958,8 @@ def generation_memory_report(model, n_slots: int,
                                                           cfg.max_length)
         hd = cfg.d_model // cfg.n_heads
         itemsize = 2 if cfg.compute_dtype == "bfloat16" else 4
-        cache = 2 * cfg.n_layers * int(n_slots) * cfg.n_heads * T * hd \
-            * itemsize
+        cache = 2 * (cfg.n_layers + int(draft_layers)) * int(n_slots) \
+            * cfg.n_heads * T * hd * itemsize
         params = sum(int(np.prod(p.shape)) * p.dtype.itemsize
                      for p in jax.tree_util.tree_leaves(model.params_))
     else:
@@ -599,7 +1014,9 @@ class GenerationEngine:
                  traces: Optional["rtrace.TraceBuffer"] = None,
                  watchdog_mult: Optional[float] = 20.0,
                  watchdog_min_s: float = 30.0,
-                 decode_cell_path: Optional[bool] = None):
+                 decode_cell_path: Optional[bool] = None,
+                 spec_decode_k: int = 1, draft_mode: str = "ngram",
+                 prefix_cache_mb: float = 0.0):
         self.metrics = metrics if metrics is not None else GenerationMetrics()
         self.trace_requests = bool(trace_requests)
         self.traces = traces
@@ -652,19 +1069,66 @@ class GenerationEngine:
 
             _flight.record("retrace", fn=fn)
 
+        if draft_mode not in ("ngram", "truncated"):
+            raise ValueError(f"draft_mode must be 'ngram' or 'truncated',"
+                             f" got {draft_mode!r}")
+        if int(spec_decode_k) < 1:
+            raise ValueError(
+                f"spec_decode_k must be >= 1, got {spec_decode_k}")
+        draft_layers = 0
+        if draft_mode == "truncated" and int(spec_decode_k) > 1:
+            draft_layers = max(
+                getattr(getattr(model, "cfg", None), "n_layers", 0) // 2,
+                0)
         #: None → auto (env ``DL4J_TPU_LSTM_DECODE_CELL``, else on for
         #: supported recurrent stacks); False forces the legacy
         #: ``_forward``-over-T=1 decode program (the bench's reference
         #: leg). Ignored by the transformer backend.
         self.backend = _pick_backend(model, n_slots, max_length,
                                      prefill_buckets, trace_hook,
-                                     cell_path=decode_cell_path)
+                                     cell_path=decode_cell_path,
+                                     spec_k=int(spec_decode_k),
+                                     draft_layers=draft_layers)
         self.n_slots = self.backend.n_slots
         self.max_length = self.backend.max_length
+        #: effective speculation width: the backend may pin K=1 (MoE,
+        #: recurrent stacks) regardless of the requested knob
+        self.spec_decode_k = getattr(self.backend, "spec_k", 1)
+        self.draft_mode = (
+            None if self.spec_decode_k <= 1
+            else ("truncated" if getattr(self.backend, "draft_layers", 0)
+                  else "ngram"))
+        self._draft = (_NgramDraft() if self.draft_mode == "ngram"
+                       else None)
+        #: per-slot (t[-2], t[-1]) context feeding the n-gram draft
+        self._ctx = np.zeros((self.n_slots, 2), np.int64)
+        self._prefix_cache = (
+            PrefixCache(int(float(prefix_cache_mb) * (1 << 20)),
+                        self.metrics)
+            if prefix_cache_mb and float(prefix_cache_mb) > 0 else None)
+        #: per-slot completion replay: a prefix-cache entry remembers
+        #: the prompt's first greedy completion, and later hits replay
+        #: it as the slot's draft source (the exact verify rule keeps
+        #: correctness — a replayed token is a PROPOSAL, never an
+        #: output). Invalidated at the first emitted token that
+        #: diverges. _slot_pk remembers the claiming request's cache
+        #: key so its finished greedy completion can be attached.
+        self._replay: List[Optional[List[int]]] = [None] * self.n_slots
+        self._slot_pk: List[Optional[tuple]] = [None] * self.n_slots
         self.metrics.set_slots(self.n_slots)
 
         self.memory_report = generation_memory_report(
-            model, self.n_slots, self.backend.max_length)
+            model, self.n_slots, self.backend.max_length,
+            draft_layers=getattr(self.backend, "draft_layers", 0))
+        self._param_count = max(
+            self.memory_report["param_bytes"] // 4, 1)
+        if self._prefix_cache is not None:
+            # the prefix cache's byte budget is device memory too —
+            # count it against the same limit the slab answers to
+            self.memory_report["prefix_cache_limit_bytes"] = \
+                self._prefix_cache.limit_bytes
+            self.memory_report["total_bytes"] += \
+                self._prefix_cache.limit_bytes
         limit = (_device_bytes_limit() if memory_limit_bytes == "auto"
                  else memory_limit_bytes)
         self.memory_report["limit_bytes"] = limit
@@ -796,9 +1260,29 @@ class GenerationEngine:
             "max_length": self.backend.max_length,
             "prefill_buckets": list(self.backend.buckets),
             "queue_depth": self.queue_depth(),
+            "spec_decode_k": self.spec_decode_k,
+            "draft_mode": self.draft_mode,
+            "prefix_cache": (None if self._prefix_cache is None else {
+                "limit_bytes": self._prefix_cache.limit_bytes,
+                "bytes": self._prefix_cache.bytes,
+                "entries": len(self._prefix_cache),
+                "lookups": self._prefix_cache.lookups,
+                "hits": self._prefix_cache.hits,
+            }),
             "trace_counts": dict(self.trace_counts),
             "memory": dict(self.memory_report),
         }
+
+    def clear_prefix_cache(self, reason: str = "cleared") -> int:
+        """Drop every cached prefix entry; returns the count dropped.
+        MUST be called after a hot params reload — entries hold KV
+        computed by the OLD weights, and serving them would silently
+        change outputs (the one staleness hazard the exact-prompt key
+        cannot see)."""
+        if self._prefix_cache is None:
+            return 0
+        with self._dev_lock:
+            return self._prefix_cache.clear(reason=reason)
 
     # -- warmup -------------------------------------------------------------
     def warmup(self, verbose: bool = False) -> dict:
@@ -817,14 +1301,33 @@ class GenerationEngine:
                 # a tb-long prompt lands exactly in bucket tb (warmup
                 # bypasses the window check — no decode follows)
                 prompt = np.zeros((tb,), np.int32)
-                _tok, _key, _ = self.backend.prefill(0, prompt, 0.0, 0, 0.0,
-                                                     key)
+                _tok, _key, _tb, logits0 = self.backend.prefill(
+                    0, prompt, 0.0, 0, 0.0, key)
+                if self._prefix_cache is not None:
+                    # compile the per-bucket capture/restore copy
+                    # programs + the stored-logits sampler (entry
+                    # discarded — warmup prompts must not spend budget)
+                    entry = self.backend.prefix_capture(0, tb, logits0)
+                    self.backend.prefix_restore(0, entry, 0.0, 0, 0.0,
+                                                key)
                 if verbose:
                     print(f"generation warmup: prefill bucket {tb}",
                           flush=True)
             self.backend.decode(self._tokens, self._pos,
                                 np.zeros_like(self._active), self._temp,
                                 self._topk, self._topp, self._keys)
+            if self.spec_decode_k > 1:
+                # the proposal-lane programs: truncated draft rollout
+                # (when that mode is on) + the batched verify
+                if self.draft_mode == "truncated":
+                    self.backend.draft(self._tokens, self._pos,
+                                       np.zeros_like(self._active))
+                K = self.spec_decode_k
+                self.backend.verify(
+                    np.zeros((self.n_slots, K), np.int32),
+                    np.zeros((self.n_slots,), np.int32), self._pos,
+                    np.zeros_like(self._active), self._temp, self._topk,
+                    self._topp, self._keys)
         compiles = {k: self.trace_counts.get(k, 0) - before.get(k, 0)
                     for k in self.trace_counts}
         return {"buckets": list(self.backend.buckets),
@@ -855,23 +1358,68 @@ class GenerationEngine:
             t0 = time.monotonic()
             if req.trace is not None:
                 req.trace.mark("slot_claimed", t0)
-            try:
-                key0 = np.asarray(jax.random.PRNGKey(req.seed),
-                                  np.uint32).reshape(2)
-                tok0, key, bucket = self.backend.prefill(
-                    slot, req.prompt, req.temperature, req.top_k,
-                    req.top_p, key0)
-            except BaseException as e:  # keep the worker alive
-                self.metrics.record_error()
-                req.fail(e)
-                continue
+            key0 = np.asarray(jax.random.PRNGKey(req.seed),
+                              np.uint32).reshape(2)
+            hit = False
+            pk = None
+            if self._prefix_cache is not None:
+                pk = PrefixCache.key_for(self.backend.kind, req.prompt)
+                entry = self._prefix_cache.lookup(pk)
+                if entry is not None:
+                    try:
+                        # chaos seam: a poisoned/stale entry fails typed
+                        # here, BEFORE any device copy — the fallback is
+                        # a real prefill with the untouched key0, so the
+                        # request's output is bit-identical either way
+                        chaos_hooks.fire("generate.prefix_cache",
+                                         op="hit", slot=slot,
+                                         prompt_len=int(req.prompt.size),
+                                         **self.chaos_ctx)
+                        tok0, key = self.backend.prefix_restore(
+                            slot, entry, req.temperature, req.top_k,
+                            req.top_p, key0)
+                    except BaseException:  # noqa: BLE001 — poisoned entry
+                        # dropped + counted; the miss path below re-runs
+                        # the REAL prefill with the untouched key0, so
+                        # the caller sees a bit-identical result, never
+                        # the cache failure
+                        self._prefix_cache.drop(pk, reason="poisoned")
+                    else:
+                        bucket = int(entry["tb"])
+                        hit = True
+                        self._prefix_cache.commit_hit(
+                            pk, prompt_len=int(req.prompt.size),
+                            slot=slot,
+                            flops_avoided=2 * self._param_count
+                            * int(req.prompt.size))
+            if not hit:
+                try:
+                    tok0, key, bucket, logits0 = self.backend.prefill(
+                        slot, req.prompt, req.temperature, req.top_k,
+                        req.top_p, key0)
+                except BaseException as e:  # keep the worker alive
+                    self.metrics.record_error()
+                    req.fail(e)
+                    continue
+                if pk is not None:
+                    self._prefix_cache.put(
+                        pk,
+                        self.backend.prefix_capture(slot, bucket,
+                                                    logits0))
             dt = time.monotonic() - t0
-            self.metrics.record_prefill(dt)
+            if not hit:
+                self.metrics.record_prefill(dt)
             self.metrics.record_first_token()
             _flight.record("slot_claim", slot=slot,
                            prompt_len=int(req.prompt.size),
                            prompt_bucket=int(bucket),
-                           max_new=req.max_new)
+                           max_new=req.max_new, prefix_hit=hit)
+            self._slot_pk[slot] = pk
+            self._replay[slot] = None
+            if hit:
+                comp = entry.get("completion")
+                if comp:
+                    self._replay[slot] = list(comp)
             self._slots[slot] = req
             req.slot = slot
             self._active[slot] = True
@@ -881,13 +1429,30 @@ class GenerationEngine:
             self._topk[slot] = req.top_k
             self._topp[slot] = req.top_p
             self._keys[slot] = key
+            if self._draft is not None:
+                # teach the n-gram table the prompt + first token; seed
+                # this slot's draft context with the last two tokens
+                self._draft.learn_seq(req.prompt.tolist() + [int(tok0)])
+            self._ctx[slot, 0] = int(req.prompt[-1])
+            self._ctx[slot, 1] = int(tok0)
             if req.trace is not None:
                 req.trace.mark("prefill_done")
                 req.trace.note(slot=slot, prompt_len=int(req.prompt.size),
-                               prompt_bucket=int(bucket))
+                               prompt_bucket=int(bucket), prefix_hit=hit)
             req.push_token(tok0)
+            self._replay_advance(slot, int(tok0), 1)
             if len(req.tokens) >= req.max_new:
                 self._finish_slot(slot, reason="done")
+
+    def _replay_advance(self, slot: int, tok: int, n: int) -> None:
+        """Invalidate the slot's completion replay at the first emitted
+        token that diverges from the recorded completion (``n`` = the
+        request's emitted-token count AFTER this token)."""
+        comp = self._replay[slot]
+        if comp is None:
+            return
+        if n > len(comp) or comp[n - 1] != tok:
+            self._replay[slot] = None
 
     def _finish_slot(self, slot: int, reason: str,
                      error: Optional[BaseException] = None) -> None:
@@ -896,6 +1461,9 @@ class GenerationEngine:
         req = self._slots[slot]
         self._slots[slot] = None
         self._active[slot] = False
+        pk = self._slot_pk[slot]
+        self._slot_pk[slot] = None
+        self._replay[slot] = None
         if req is None:
             return
         req.slot = None
@@ -920,6 +1488,18 @@ class GenerationEngine:
             self.metrics.record_finish(time.monotonic() - req.enqueued_at)
         if self.traces is not None and req.trace is not None:
             self.traces.add(req.trace)
+        if (pk is not None and self._prefix_cache is not None
+                and reason == "done" and error is None
+                and req.temperature == 0.0):
+            # greedy completion for this exact prompt — deterministic,
+            # so it doubles as the replay draft for the NEXT hit
+            self._prefix_cache.attach_completion(pk, req.tokens)
+        if req.draft_proposed:
+            _flight.record("draft_accept", slot=slot,
+                           proposed=int(req.draft_proposed),
+                           accepted=int(req.draft_accepted),
+                           rate=round(req.draft_accepted
+                                      / req.draft_proposed, 4))
         _flight.record("slot_free", slot=slot, reason=reason,
                        tokens=len(req.tokens))
 
@@ -974,10 +1554,61 @@ class GenerationEngine:
                 if req is not None:
                     req.fail(err)
 
+    def _build_drafts(self, K: int):
+        """Assemble the fixed (S, K) proposal lane: column 0 = each
+        slot's current token, columns 1..dlen[s] = draft proposals from
+        the active draft source. Draft lengths are DATA (clamped per
+        slot to the remaining token budget and the slab window — a
+        column past either must never be accepted), shapes never
+        change."""
+        S = self.n_slots
+        toks_k = np.zeros((S, K), np.int32)
+        toks_k[:, 0] = self._tokens
+        dlen = np.zeros((S,), np.int32)
+        rooms: Dict[int, int] = {}
+        for slot in range(S):
+            if not self._active[slot]:
+                continue
+            req = self._slots[slot]
+            if req is None:
+                continue
+            room = min(K - 1, req.max_new - len(req.tokens) - 1,
+                       self.max_length - 1 - int(self._pos[slot]))
+            if room > 0:
+                rooms[slot] = room
+        if not rooms:
+            return toks_k, dlen
+        if self.draft_mode == "truncated":
+            drafts = self.backend.draft(self._tokens, self._pos,
+                                        self._active)
+            for slot, room in rooms.items():
+                dlen[slot] = room
+                toks_k[slot, 1:1 + room] = drafts[slot, :room]
+        else:
+            for slot, room in rooms.items():
+                # replay first: a prefix hit carrying the prompt's
+                # recorded greedy completion predicts perfectly as long
+                # as the emitted tokens track it (invalidated on the
+                # first divergence); n-gram table is the fallback
+                ds: List[int] = []
+                comp = self._replay[slot]
+                if comp is not None:
+                    n = len(self._slots[slot].tokens)
+                    ds = comp[n:n + room]
+                if not ds:
+                    ds = self._draft.propose(self._ctx[slot, 0],
+                                             self._ctx[slot, 1], room)
+                if ds:
+                    dlen[slot] = len(ds)
+                    toks_k[slot, 1:1 + len(ds)] = ds
+        return toks_k, dlen
+
     def _step(self) -> None:
         from deeplearning4j_tpu.obs import flight as _flight
 
         n_active = int(self._active.sum())
+        K = self.spec_decode_k
+        use_spec = False
         t0 = time.monotonic()
         self._dispatch_gen += 1
         gen = self._dispatch_gen
@@ -990,9 +1621,19 @@ class GenerationEngine:
             # looks like
             chaos_hooks.fire("generate.decode_dispatch",
                              active=n_active, **self.chaos_ctx)
-            toks, keys = self.backend.decode(
-                self._tokens, self._pos, self._active, self._temp,
-                self._topk, self._topp, self._keys)
+            if K > 1:
+                # draft building may itself dispatch (truncated mode) —
+                # keep it inside the watchdog's stamped window
+                toks_k, dlen = self._build_drafts(K)
+                use_spec = bool(dlen.any())
+            if use_spec:
+                s_all, e_all, last, keys = self.backend.verify(
+                    toks_k, dlen, self._pos, self._active, self._temp,
+                    self._topk, self._topp, self._keys)
+            else:
+                toks, keys = self.backend.decode(
+                    self._tokens, self._pos, self._active, self._temp,
+                    self._topk, self._topp, self._keys)
         except BaseException as e:  # keep the worker alive: a decode
             # failure (bad hot-swapped params, transient device error)
             # fails the ACTIVE requests typed instead of silently
@@ -1035,30 +1676,66 @@ class GenerationEngine:
                 return
         self._step_ewma_s = (dt if self._step_ewma_s is None
                              else 0.8 * self._step_ewma_s + 0.2 * dt)
-        self.metrics.record_decode_step(dt, n_active)
+        if use_spec:
+            emitted = int(e_all.sum())
+            self.metrics.record_decode_step(dt, emitted)
+            self.metrics.record_draft(int(dlen[self._active].sum()),
+                                      emitted - n_active)
+        else:
+            self.metrics.record_decode_step(dt, n_active)
         if dt * 1e3 > self.stall_ms:
             _flight.record("decode_stall", wall_ms=round(dt * 1e3, 1),
                            active=n_active)
         # copy: np.asarray on a device array is a read-only view, and
         # the admit path writes per-slot lanes into these
-        self._tokens = np.array(toks, np.int32)
-        self._keys = np.array(keys, np.uint32)
-        self._pos[self._active] += 1
+        if use_spec:
+            self._tokens = np.array(last, np.int32)
+            self._keys = np.array(keys, np.uint32)
+            # accepted counts are data: each slot advances by its own e
+            # (masked to 0 on inactive rows)
+            self._pos += e_all.astype(np.int32)
+        else:
+            self._tokens = np.array(toks, np.int32)
+            self._keys = np.array(keys, np.uint32)
+            self._pos[self._active] += 1
         now = time.monotonic()
         for slot in range(self.n_slots):
             if not self._active[slot]:
                 continue
             req = self._slots[slot]
-            req.push_token(int(toks[slot]))
+            if use_spec:
+                m = int(e_all[slot])
+                req.draft_proposed += int(dlen[slot])
+                req.draft_accepted += m - 1
+                for j in range(m):
+                    tok = int(s_all[slot, j])
+                    self._learn(slot, tok)
+                    req.push_token(tok)
+                    self._replay_advance(slot, tok, len(req.tokens))
+            else:
+                tok = int(toks[slot])
+                self._learn(slot, tok)
+                req.push_token(tok)
+                self._replay_advance(slot, tok, len(req.tokens))
             if len(req.tokens) >= req.max_new:
                 self._finish_slot(slot, reason="done")
             elif req.expired(now) or req.done():
                 # done() → the caller gave up (result timeout); either
-                # way the slot frees at token granularity
+                # way the slot frees at token granularity (deadline
+                # expiry mid-verify frees it just like mid-decode — the
+                # already-accepted tokens were pushed above)
                 self._finish_slot(
                     slot, reason="deadline",
                     error=RequestDeadlineExceeded(
                         "request deadline passed mid-decode"))
+
+    def _learn(self, slot: int, tok: int) -> None:
+        """Advance the slot's 2-token draft context and teach the n-gram
+        table (ngram mode) each emitted token."""
+        if self._draft is not None:
+            self._draft.learn(self._ctx[slot, 0], self._ctx[slot, 1], tok)
+        self._ctx[slot, 0] = self._ctx[slot, 1]
+        self._ctx[slot, 1] = tok
 
     def _loop(self) -> None:
         while True:

@@ -253,6 +253,38 @@ class GenerationMetrics:
         reg.gauge("generation_tokens_per_sec",
                   "generated tokens/sec (scrape-to-scrape rate)",
                   fn=value_rate_fn(lambda: self._tokens.value()))
+        # speculative decoding: proposed vs accepted draft tokens (the
+        # acceptance ratio is the speedup knob's health signal)
+        self._draft_proposed = reg.counter(
+            "generation_draft_proposed_total",
+            "draft tokens proposed to verify dispatches")
+        self._draft_accepted = reg.counter(
+            "generation_draft_accepted_total",
+            "draft tokens accepted by verify dispatches")
+        # shared-prefix KV cache: lookup/hit/evict counters + resident
+        # bytes. The hit-rate gauge is created LAZILY once lookups cross
+        # a floor (see record_prefix_lookup) so the `prefix_hit_rate_low`
+        # alert stays inert on engines without prefix traffic — the
+        # evaluator's no-data-is-no-verdict contract does the rest.
+        self._prefix_lookups = reg.counter(
+            "generation_prefix_lookups_total",
+            "prefix-cache lookups (one per admitted request when enabled)")
+        self._prefix_hits = reg.counter(
+            "generation_prefix_hits_total",
+            "prefix-cache hits (prefill replaced by a KV block copy)")
+        self._prefix_evicts = reg.counter(
+            "generation_prefix_evictions_total",
+            "prefix-cache entries evicted (lru / poisoned / cleared)")
+        self._prefix_bytes = reg.gauge(
+            "generation_prefix_cache_bytes",
+            "resident bytes held by the shared-prefix KV cache")
+        self._flops_avoided = reg.counter(
+            "generation_prefill_flops_avoided_total",
+            "analytic prefill FLOPs avoided by prefix-cache hits")
+        self._hit_rate_gauge = None
+        #: lookups before the hit-rate gauge materializes (and the
+        #: prefix_hit_rate_low rule can fire)
+        self.prefix_gauge_floor = 8
         self.started_at = time.time()
 
     # -- recording ----------------------------------------------------------
@@ -289,6 +321,41 @@ class GenerationMetrics:
 
     def record_finish(self, latency_seconds: float) -> None:
         self._latency.observe(float(latency_seconds))
+
+    def record_draft(self, proposed: int, accepted: int) -> None:
+        if proposed:
+            self._draft_proposed.inc(int(proposed))
+        if accepted:
+            self._draft_accepted.inc(int(accepted))
+
+    def _update_hit_rate(self) -> None:
+        lookups = int(self._prefix_lookups.value())
+        if lookups < self.prefix_gauge_floor:
+            return
+        if self._hit_rate_gauge is None:
+            self._hit_rate_gauge = self.registry.gauge(
+                "generation_prefix_hit_rate",
+                "prefix-cache hits / lookups (created after the lookup "
+                "floor so the low-hit-rate alert never fires on idle "
+                "or prefix-less engines)")
+        self._hit_rate_gauge.set(
+            int(self._prefix_hits.value()) / max(lookups, 1))
+
+    def record_prefix_lookup(self) -> None:
+        self._prefix_lookups.inc()
+        self._update_hit_rate()
+
+    def record_prefix_hit(self, flops_avoided: int = 0) -> None:
+        self._prefix_hits.inc()
+        if flops_avoided:
+            self._flops_avoided.inc(int(flops_avoided))
+        self._update_hit_rate()
+
+    def record_prefix_evict(self, n: int = 1) -> None:
+        self._prefix_evicts.inc(int(n))
+
+    def set_prefix_bytes(self, n: int) -> None:
+        self._prefix_bytes.set(int(n))
 
     # -- reading ------------------------------------------------------------
     @property
@@ -328,6 +395,17 @@ class GenerationMetrics:
                 if (prefill_s + decode_s) > 0 else None),
             "slots": int(self._slots.value()),
             "active_slots": int(self._active.value()),
+            "draft_proposed": int(self._draft_proposed.value()),
+            "draft_accepted": int(self._draft_accepted.value()),
+            "draft_acceptance": (
+                round(self._draft_accepted.value()
+                      / self._draft_proposed.value(), 4)
+                if self._draft_proposed.value() > 0 else None),
+            "prefix_lookups": int(self._prefix_lookups.value()),
+            "prefix_hits": int(self._prefix_hits.value()),
+            "prefix_evictions": int(self._prefix_evicts.value()),
+            "prefix_cache_bytes": int(self._prefix_bytes.value()),
+            "prefill_flops_avoided": int(self._flops_avoided.value()),
             "latency_window": n,
         }
         for name, q in (("p50", 0.50), ("p90", 0.90), ("p99", 0.99)):

@@ -845,6 +845,8 @@ class ModelRouter:
                  score_probe: Optional[Callable] = None,
                  refresh_s: float = 2.0, mesh=None,
                  gen_slots: int = 0, gen_max_length: Optional[int] = None,
+                 gen_spec_decode_k: int = 1, gen_draft_mode: str = "ngram",
+                 gen_prefix_cache_mb: float = 0.0,
                  metrics: Optional[ServingMetrics] = None,
                  trace_requests: bool = True, traces=None):
         self.registry = registry
@@ -865,6 +867,9 @@ class ModelRouter:
         self.mesh = mesh
         self.gen_slots = int(gen_slots)
         self.gen_max_length = gen_max_length
+        self.gen_spec_decode_k = int(gen_spec_decode_k)
+        self.gen_draft_mode = str(gen_draft_mode)
+        self.gen_prefix_cache_mb = float(gen_prefix_cache_mb)
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.trace_requests = bool(trace_requests)
         self.traces = traces
@@ -1068,6 +1073,9 @@ class ModelRouter:
 
         gen = GenerationEngine(base_model, n_slots=self.gen_slots,
                                max_length=self.gen_max_length,
+                               spec_decode_k=self.gen_spec_decode_k,
+                               draft_mode=self.gen_draft_mode,
+                               prefix_cache_mb=self.gen_prefix_cache_mb,
                                metrics=GenerationMetrics(),
                                traces=self.traces)
         gen.chaos_ctx = {"model": name, "version": int(version),

@@ -665,6 +665,11 @@ def _make_handler(server: InferenceServer):
                 self._send_json(409, {"error": "FileNotFoundError",
                                       "message": str(e)})
                 return
+            if result.get("reloaded") and server.generation is not None:
+                # cached prefix KV was computed by the OLD params — a
+                # hit after the swap would resurrect them bit-exactly
+                result["prefix_entries_cleared"] = \
+                    server.generation.clear_prefix_cache(reason="reload")
             self._send_json(200, result)
 
     return Handler

@@ -408,6 +408,133 @@ class TestGenerationEngine:
 
 
 # ---------------------------------------------------------------------------
+# speculative decoding + shared-prefix KV reuse
+# ---------------------------------------------------------------------------
+_SPEC = {}
+
+
+def _spec_engine() -> GenerationEngine:
+    """Module-shared speculating engine (K=4 proposal lane + prefix
+    cache) over the shared LM, warmed once."""
+    if "e" not in _SPEC:
+        e = GenerationEngine(_lm(), n_slots=3, queue_limit=32,
+                             default_timeout_s=120.0, spec_decode_k=4,
+                             prefix_cache_mb=2.0)
+        e.warmup()
+        _SPEC["e"] = e
+    return _SPEC["e"]
+
+
+class TestSpeculativePrefix:
+    def test_four_way_greedy_parity_zero_retrace(self):
+        # the fourth parity leg: the SPECULATING engine — drafts
+        # proposed and sometimes rejected, prefix hits replacing
+        # prefills on the repeat round — must stay bit-identical to the
+        # plain engine, to solo generate_cached, and to the full-prefix
+        # reference, and trace NOTHING after warmup (verify dispatches
+        # and prefix-hit restores included)
+        m, plain, spec = _lm(), _engine(), _spec_engine()
+        prompts = _prompts(6, (3, 21), seed=16)
+        news = [9, 5, 12, 7, 4, 10]
+        before = dict(spec.trace_counts)
+        reqs = [spec.submit(p, max_new=n, timeout=90)
+                for p, n in zip(prompts, news)]
+        outs = [r.result(timeout=90) for r in reqs]
+        # resubmit the same prompts: every admission is now a prefix HIT
+        reqs2 = [spec.submit(p, max_new=n, timeout=90)
+                 for p, n in zip(prompts, news)]
+        outs2 = [r.result(timeout=90) for r in reqs2]
+        assert spec.trace_counts == before  # zero retraces, spec on
+        assert spec.describe()["prefix_cache"]["hits"] >= len(prompts)
+        for p, n, out, out2 in zip(prompts, news, outs, outs2):
+            np.testing.assert_array_equal(out, out2)
+            np.testing.assert_array_equal(
+                out,
+                plain.submit(p, max_new=n, timeout=90).result(timeout=90))
+            np.testing.assert_array_equal(
+                out, m.generate_cached(p, max_new=n)[0])
+            np.testing.assert_array_equal(out, m.generate(p, max_new=n)[0])
+
+    def test_sampled_key_chain_parity_with_rejection(self):
+        # sampled path: rejected drafts must not desync the per-slot
+        # PRNG chain — the key advances once per EMITTED token, so a
+        # seeded spec request reproduces the solo trajectory exactly
+        m, spec = _lm(), _spec_engine()
+        prompt = _prompts(1, seed=17)[0]
+        req = spec.submit(prompt, max_new=8, temperature=0.9, top_k=6,
+                          seed=23, timeout=90)
+        out = req.result(timeout=90)
+        solo = m.generate_cached(prompt, max_new=8, temperature=0.9,
+                                 top_k=6, rng=jax.random.PRNGKey(23))[0]
+        np.testing.assert_array_equal(out, solo)
+
+    def test_completion_replay_high_acceptance_on_repeat(self):
+        # a prefix hit replays the prompt's recorded first greedy
+        # completion as its draft source: near-total acceptance on the
+        # repeat, far beyond what the n-gram table manages cold
+        eng = _spec_engine()
+        prompt = _prompts(1, (10, 11), seed=77)[0]
+        first = eng.generate(prompt, max_new=16, timeout=90)
+        req = eng.submit(prompt, max_new=16, timeout=90)
+        np.testing.assert_array_equal(first, req.result(timeout=90))
+        assert req.draft_proposed > 0
+        assert req.draft_accepted >= 0.8 * req.draft_proposed
+
+    def test_prefix_hit_miss_evict_lifecycle(self):
+        from deeplearning4j_tpu.obs.flight import default_flight_recorder
+
+        m = _lm()
+        # budget fits exactly ONE bucket-32 KV block: the second
+        # distinct prompt LRU-evicts the first, re-requesting the first
+        # is a miss again
+        eng = GenerationEngine(m, n_slots=2, queue_limit=8,
+                               default_timeout_s=60.0,
+                               prefix_cache_mb=0.02)
+        try:
+            eng.warmup()
+            rec = default_flight_recorder()
+            mark = rec.recorded_total
+            p1 = _prompts(1, (20, 21), seed=61)[0]
+            p2 = _prompts(1, (20, 21), seed=62)[0]
+            a1 = eng.generate(p1, max_new=4, timeout=60)  # miss: captured
+            b1 = eng.generate(p1, max_new=4, timeout=60)  # hit
+            np.testing.assert_array_equal(a1, b1)
+            eng.generate(p2, max_new=4, timeout=60)  # miss: evicts p1
+            eng.generate(p1, max_new=4, timeout=60)  # miss again
+            d = eng.describe()["prefix_cache"]
+            assert (d["lookups"], d["hits"], d["entries"]) == (4, 1, 1)
+            assert 0 < d["bytes"] <= d["limit_bytes"]
+            new = [e for e in rec.events() if e.get("seq", 0) >= mark]
+            assert any(e["kind"] == "prefix_hit" for e in new)
+            assert any(e["kind"] == "prefix_evict"
+                       and e["reason"] == "lru" for e in new)
+            claims = [e for e in new if e["kind"] == "slot_claim"]
+            assert [c["prefix_hit"] for c in claims] == [
+                False, True, False, False]
+        finally:
+            eng.shutdown()
+
+    def test_deadline_mid_verify_frees_slot(self):
+        # deadline expiry lands between verify dispatches exactly like
+        # between plain decode steps: already-accepted tokens kept,
+        # slot freed at token granularity, engine serves the next
+        # request normally
+        eng = _spec_engine()
+        prompt = _prompts(1, seed=19)[0]
+        max_new = 48 - len(prompt)
+        req = eng.submit(prompt, max_new=max_new, timeout=0.02)
+        with pytest.raises(RequestDeadlineExceeded):
+            req.result(timeout=90)
+        assert 0 < len(req.tokens) < max_new  # died mid-decode
+        deadline = time.monotonic() + 10
+        while eng.active_slots and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.active_slots == 0
+        out = eng.submit(prompt, max_new=3, timeout=90).result(timeout=90)
+        assert out.shape[0] == len(prompt) + 3
+
+
+# ---------------------------------------------------------------------------
 # LSTM carried-state backend
 # ---------------------------------------------------------------------------
 class TestRecurrentGeneration:
