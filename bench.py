@@ -2133,6 +2133,207 @@ def _bench_registry(n_tenants: int = 6, reqs_per_tenant: int = 24,
     return out
 
 
+def _bench_cluster(dispatch_s: float = 0.06, batch_limit: int = 3,
+                   n_conns: int = 9, duration_s: float = 6.0,
+                   canary_window_s: float = 2.0):
+    """Multi-replica tier bench (ISSUE 17): capacity scaling and
+    cross-replica rollback latency. The accelerator step is modeled by
+    a fixed per-dispatch delay (chaos seam, active-role dispatches) so
+    throughput is dispatch-serialized per replica — the regime where a
+    tier scales by adding replicas, not cores. Gate 1: N=3 replicas
+    behind a session-sticky front sustain >= 2.2x the single-replica
+    storm. Gate 2: a regressed publish's cluster-wide rollback (every
+    replica's canary torn down, registry status rolled_back) lands
+    within the canary window + 2x the tightened refresh interval.
+    Writes BENCH_cluster.json and returns it."""
+    import http.client
+    import tempfile
+    import threading
+
+    import jax
+
+    from deeplearning4j_tpu.chaos import ChaosPlan
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import (
+        ClusterCoordinator,
+        InferenceServer,
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.train.faults import save_checkpoint
+
+    d_in = 16
+
+    def fresh_net(seed):
+        conf = (NeuralNetConfiguration.builder().seed(seed).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(d_in)).build())
+        return MultiLayerNetwork(conf).init()
+
+    tmp = tempfile.mkdtemp(prefix="bench_cluster_")
+    ck1 = save_checkpoint(fresh_net(1), os.path.join(tmp, "ck1"))
+    ck2 = save_checkpoint(fresh_net(2), os.path.join(tmp, "ck2"))
+    payload = json.dumps(
+        {"inputs": np.zeros((1, d_in), np.float32).tolist()})
+
+    def storm(ports, seconds):
+        """Closed-loop storm: each connection is pinned to its home
+        replica (the session-sticky front), counts 200s."""
+        counts = [0] * len(ports)
+        stop = time.perf_counter() + seconds
+        barrier = threading.Barrier(len(ports))
+
+        def client(i):
+            conn = http.client.HTTPConnection("127.0.0.1", ports[i],
+                                              timeout=120)
+            barrier.wait()
+            while time.perf_counter() < stop:
+                conn.request("POST", "/models/m/predict", payload,
+                             headers={"X-Tenant": f"t{i}"})
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 200:
+                    counts[i] += 1
+            conn.close()
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(ports))]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return sum(counts) / (time.perf_counter() - t0)
+
+    def make_tier(regdir, cluster_ids):
+        """One router+server per replica id (or one uncoordinated
+        replica when cluster_ids is empty), all sharing regdir."""
+        tier = []
+        for rid in (cluster_ids or [None]):
+            reg = ModelRegistry(regdir)
+            coord = None
+            if rid is not None:
+                coord = ClusterCoordinator(regdir, rid, heartbeat_s=0.2)
+            router = ModelRouter(reg, batch_limit=batch_limit,
+                                 max_wait_ms=20.0, queue_limit=4096,
+                                 canary_fraction=0.5,
+                                 canary_window_s=canary_window_s,
+                                 refresh_s=0.1, cluster=coord)
+            router.managed("m")
+            if coord is not None:
+                coord.start(inflight_fn=router.tenant_inflight)
+            tier.append({"coord": coord, "router": router,
+                         "server": InferenceServer(router=router,
+                                                   port=0).start()})
+        return tier
+
+    # the "accelerator step": every active-role dispatch takes
+    # dispatch_s, serialized per replica batcher — canary dispatches
+    # are left to the rollback plan below
+    delay_plan = ChaosPlan([{"seam": "registry.version_dispatch",
+                             "mode": "delay", "delay_s": dispatch_s,
+                             "match": {"role": "active"}, "times": None}],
+                           name="bench_cluster_dispatch")
+
+    with delay_plan.armed():
+        # phase 1: single replica, all connections on it
+        reg_a = ModelRegistry(os.path.join(tmp, "single"))
+        reg_a.publish("m", ck1, score=0.5)
+        single = make_tier(os.path.join(tmp, "single"), [])
+        rps_1 = storm([single[0]["server"].port] * n_conns, duration_s)
+        single[0]["server"].shutdown()
+
+        # phase 2: the 3-replica tier on a shared journal
+        regdir = os.path.join(tmp, "tier")
+        pub = ModelRegistry(regdir)
+        pub.publish("m", ck1, score=0.5)
+        tier = make_tier(regdir, ["r1", "r2", "r3"])
+        ports = [t["server"].port for t in tier]
+        rps_3 = storm([ports[i % 3] for i in range(n_conns)], duration_s)
+        ratio = rps_3 / rps_1 if rps_1 else None
+
+        # phase 3: regressed publish -> cluster-wide rollback latency.
+        # The canary's dispatches fail typed; the lease holder trips
+        # and every replica tears its window down from the WAL.
+        rollback_plan = ChaosPlan(
+            [{"seam": "registry.version_dispatch", "mode": "error",
+              "match": {"role": "canary"}, "times": None}],
+            name="bench_cluster_rollback")
+        refresh_s = max(t["coord"].canary_refresh_s for t in tier)
+        with rollback_plan.armed():
+            t_pub = time.perf_counter()
+            rec = pub.publish("m", ck2, score=0.45)
+            rollback_s = None
+            conn = [http.client.HTTPConnection("127.0.0.1", p, timeout=120)
+                    for p in ports]
+            deadline = time.perf_counter() + 4 * canary_window_s + 20
+            i = 0
+            while time.perf_counter() < deadline:
+                c = conn[i % 3]
+                i += 1
+                try:
+                    c.request("POST", "/models/m/predict", payload,
+                              headers={"X-Tenant": "probe"})
+                    c.getresponse().read()
+                except Exception:  # noqa: BLE001 — canary-slice 500s
+                    conn[(i - 1) % 3] = http.client.HTTPConnection(
+                        "127.0.0.1", ports[(i - 1) % 3], timeout=120)
+                pub.refresh(force=True)
+                status = pub.get("m")["versions"].get(
+                    str(rec["version"]), {}).get("status")
+                torn_down = all(
+                    t["router"].describe()["live"]["m"]["canary_version"]
+                    is None for t in tier)
+                if status == "rolled_back" and torn_down:
+                    rollback_s = time.perf_counter() - t_pub
+                    break
+                time.sleep(0.02)
+        active_after = pub.get("m")["active_version"]
+        for t in tier:
+            t["server"].shutdown()
+            if t["coord"] is not None:
+                t["coord"].shutdown()
+
+    rollback_bound = canary_window_s + 2.0 * refresh_s
+    gate_scaling = ratio is not None and ratio >= 2.2
+    gate_rollback = rollback_s is not None and rollback_s <= rollback_bound
+    out = {
+        "metric": "cluster_n3_throughput_ratio",
+        "value": None if ratio is None else round(ratio, 2),
+        "unit": "x_single_replica",
+        "vs_baseline": None,
+        "extra": {
+            "platform": jax.default_backend(),
+            "dispatch_s": dispatch_s,
+            "batch_limit": batch_limit,
+            "connections": n_conns,
+            "single_replica_rps": round(rps_1, 1),
+            "three_replica_rps": round(rps_3, 1),
+            "canary_window_s": canary_window_s,
+            "cluster_refresh_s": refresh_s,
+            "rollback": {
+                "latency_s": None if rollback_s is None
+                else round(rollback_s, 3),
+                "bound_s": round(rollback_bound, 3),
+                "active_version_after": active_after,
+                "gate": "cluster-wide rollback <= canary_window + "
+                        "2x refresh interval",
+            },
+            "gates": {"n3_throughput_ge_2.2x": bool(gate_scaling),
+                      "rollback_within_bound": bool(gate_rollback)},
+            "ok": bool(gate_scaling and gate_rollback),
+        },
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_cluster.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    return out
+
+
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     compute_dtype = "bfloat16"
@@ -2325,6 +2526,19 @@ if __name__ == "__main__":
             out["metric"] = "cpu_fallback_" + out["metric"]
         print(json.dumps(out))
         sys.exit(0)
+    if len(sys.argv) > 1 and sys.argv[1] == "cluster":
+        # multi-replica tier: dispatch-serialized capacity scaling
+        # (3 replicas >= 2.2x one) + cluster-wide rollback latency;
+        # meaningful on any backend, writes BENCH_cluster.json
+        if os.environ.get("BENCH_FORCE_CPU") == "1" or not _tpu_plausible():
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        out = _bench_cluster()
+        if not _tpu_plausible():
+            out["metric"] = "cpu_fallback_" + out["metric"]
+        print(json.dumps(out))
+        sys.exit(0 if out["extra"]["ok"] else 1)
     if len(sys.argv) > 1 and sys.argv[1] == "kernels":
         # fused-kernel A/Bs (LSTM decode / ZeRO-1 / int8 serving):
         # meaningful on any backend (parity + no-regression gates; the

@@ -118,21 +118,66 @@ def _open_write_mode(call: ast.Call) -> bool:
     return False
 
 
+#: os.open flag names that make the fd a write surface. O_CREAT counts
+#: even alone — creating a durable artifact IS a write
+_OS_OPEN_WRITE_FLAGS = {"O_WRONLY", "O_RDWR", "O_APPEND", "O_CREAT"}
+
+
+def _os_open_write_flags(call: ast.Call) -> bool:
+    """``os.open(path, os.O_WRONLY | ...)`` — the low-level bypass the
+    mode-string check above cannot see (how the cluster journal's
+    lease/heartbeat appends WOULD dodge fslayer if hand-rolled). The
+    flags expression is walked structurally, so ``|``-composed flags,
+    parenthesised groups and ``os.O_*`` vs bare ``O_*`` imports all
+    match."""
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "open"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "os"):
+        return False
+    flags = None
+    if len(call.args) >= 2:
+        flags = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "flags":
+            flags = kw.value
+    if flags is None:
+        return False
+    for node in ast.walk(flags):
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name in _OS_OPEN_WRITE_FLAGS:
+            return True
+    return False
+
+
 @register_rule(
     "durability-bypass-fslayer",
-    "write-mode open() on a durable surface (serving/train/tune) must "
-    "route through chaos/fslayer (open_for_write / append_line / "
-    "write_atomic)")
+    "write-mode open() / write-flag os.open() on a durable surface "
+    "(serving/train/tune) must route through chaos/fslayer "
+    "(open_for_write / append_line / write_atomic)")
 def check_bypass_fslayer(ctx: FileContext) -> Iterable[Finding]:
     if not (_DURABLE_DIRS & set(ctx.parts)):
         return []
     findings: List[Finding] = []
     for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Call) and _open_write_mode(node):
+        if not isinstance(node, ast.Call):
+            continue
+        if _open_write_mode(node):
             findings.append(ctx.finding(
                 "durability-bypass-fslayer", node,
                 "direct write-mode open() on a durable surface "
                 "bypasses the typed-StorageError/chaos-seam fs layer; "
                 "use chaos/fslayer.open_for_write, append_line or "
+                "write_atomic"))
+        elif _os_open_write_flags(node):
+            findings.append(ctx.finding(
+                "durability-bypass-fslayer", node,
+                "os.open with write flags (O_WRONLY/O_RDWR/O_APPEND/"
+                "O_CREAT) on a durable surface bypasses the typed-"
+                "StorageError/chaos-seam fs layer; use "
+                "chaos/fslayer.open_for_write, append_line or "
                 "write_atomic"))
     return findings

@@ -1141,6 +1141,298 @@ def drill_paired_watchdog_trip_during_canary(ctx: DrillContext):
 
 
 # ==========================================================================
+# cluster drills: the multi-replica tier (serving/cluster.py). All are
+# in-process multi-coordinator — to its peers, a SIGKILLed replica is
+# indistinguishable from one that stopped appending heartbeats (journal
+# silence is the ONLY failure signal); the package-boundary version
+# with real processes and real SIGKILL is scripts/drive_cluster.py.
+# ==========================================================================
+def _cluster_pair(ctx: DrillContext, ttl: float = 0.4,
+                  directory: str = "reg"):
+    from deeplearning4j_tpu.serving.cluster import ClusterCoordinator
+
+    d = ctx.path(directory)
+    os.makedirs(d, exist_ok=True)
+    a = ClusterCoordinator(d, "ra", heartbeat_s=0.05, lease_ttl_s=ttl)
+    b = ClusterCoordinator(d, "rb", heartbeat_s=0.05, lease_ttl_s=ttl)
+    a.heartbeat()
+    b.heartbeat()
+    return a, b
+
+
+@drill("cluster", ["registry.version_dispatch"], deadline_s=60.0,
+       expected_alerts=["replica_stale", "canary_rolled_back"])
+def drill_cluster_replica_loss_mid_canary(ctx: DrillContext):
+    """Replica loss mid-canary-window: the lease-holding controller
+    dies while a non-holder is watching the canary fail — the survivor
+    suspends (its inline trip is fence-refused), steals the lease after
+    the TTL, and trips the CLUSTER rollback; active version untouched."""
+    from deeplearning4j_tpu.serving.cluster import ClusterCoordinator
+    from deeplearning4j_tpu.serving.registry import (
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.train.faults import save_checkpoint
+
+    regdir = ctx.path("reg")
+    reg = ModelRegistry(regdir)
+    p1 = save_checkpoint(_net(seed=1), ctx.path("ck1"))
+    p2 = save_checkpoint(_net(seed=2), ctx.path("ck2"))
+    reg.publish("m", p1, score=0.5)
+    # replica A: a bare coordinator standing in for the peer server
+    # that owns the canary window; it beats until "SIGKILLed"
+    a = ClusterCoordinator(regdir, "ra", heartbeat_s=0.05,
+                           lease_ttl_s=0.5)
+    a.start()
+    b_coord = ClusterCoordinator(regdir, "rb", heartbeat_s=0.05,
+                                 lease_ttl_s=0.5)
+    b_coord.heartbeat()
+    router = ModelRouter(reg, canary_fraction=0.5, canary_window_s=60.0,
+                         refresh_s=0.02, max_wait_ms=1.0,
+                         cluster=b_coord)
+    try:
+        ctx.report.add("controller_lease_held_by_peer",
+                       a.ensure_lease("m"))
+        rows = np.random.default_rng(0).standard_normal(
+            (2, N_IN)).astype(np.float32)
+        router.predict("m", rows, timeout=30)
+        reg.publish("m", p2, score=0.45)
+        plan = ChaosPlan([{"seam": "registry.version_dispatch",
+                           "mode": "error",
+                           "match": {"role": "canary"}, "times": None}],
+                         name=ctx.name)
+        with plan.armed():
+            # phase 1: A alive — B's canary traffic fails, its inline
+            # trip is refused by the epoch fence, and it SUSPENDS
+            for _ in range(16):
+                ctx.capture(router.predict, "m", rows, timeout=30)
+                if ctx.events(["canary_suspend"]):
+                    break
+            suspended = bool(ctx.events(["canary_suspend"]))
+            st = reg.get("m")["versions"].get("2", {}).get("status")
+            ctx.report.add("nonholder_suspended_not_rolled_back",
+                           suspended and st == "canary",
+                           f"suspended={suspended} status={st}")
+            # phase 2: A dies mid-window (journal silence — to peers,
+            # identical to SIGKILL); B steals after the TTL and trips
+            a.shutdown(release_leases=False)
+            t0 = time.monotonic()
+            rolled = False
+            while time.monotonic() - t0 < 20.0:
+                ctx.capture(router.predict, "m", rows, timeout=30)
+                if (reg.get("m")["versions"].get("2", {}).get("status")
+                        == "rolled_back"):
+                    rolled = True
+                    break
+                time.sleep(0.05)
+            ctx.recovery_s = time.monotonic() - t0
+        ctx.report.add("takeover_rolled_back_cluster_wide", rolled,
+                       str(reg.get("m")["versions"].get("2")))
+        ctx.report.add("active_untouched",
+                       reg.get("m").get("active_version") == 1)
+        invariants.check_typed_errors(ctx.report, ctx.errors)
+        invariants.check_event_order(
+            ctx.report, ctx.events(),
+            ["lease_acquire", "canary_start", "canary_suspend",
+             "replica_lost", "lease_steal", "regression_trip",
+             "rollback"])
+        invariants.check_registry_consistent(ctx.report, regdir,
+                                             expect_active={"m": 1})
+        invariants.check_no_tmp_litter(ctx.report, regdir)
+    finally:
+        router.shutdown()
+        b_coord.shutdown(release_leases=False)
+        a.shutdown(release_leases=False)
+
+
+@drill("cluster", ["cluster.decision"],
+       expected_alerts=["replica_stale"])
+def drill_cluster_lease_expiry_paused_exholder(ctx: DrillContext):
+    """Lease expiry + takeover with a PAUSED ex-holder: the holder
+    stalls between deciding and fencing (delay on cluster.decision — a
+    GC/VM pause), the TTL expires, a peer steals the lease, and the
+    resumed holder's late decision is refused typed StaleEpochError."""
+    import threading
+
+    from deeplearning4j_tpu.serving.cluster import (
+        ClusterCoordinator,
+        StaleEpochError,
+    )
+
+    a, b = _cluster_pair(ctx, ttl=0.3)
+    ctx.report.add("initial_claim", a.ensure_lease("m"))
+    plan = ChaosPlan([{"seam": "cluster.decision", "mode": "delay",
+                       "delay_s": 0.8, "match": {"replica": "ra"}}],
+                     name=ctx.name)
+    result = {}
+
+    def late_decision():
+        _res, err = ctx.capture(a.release, "m")
+        result["err"] = err
+
+    t0 = time.monotonic()
+    with plan.armed():
+        th = threading.Thread(target=late_decision, daemon=True)
+        th.start()
+        time.sleep(0.45)  # past the TTL while A is paused at the seam
+        b.heartbeat()
+        stolen = b.ensure_lease("m")
+        th.join(timeout=15)
+    ctx.recovery_s = time.monotonic() - t0
+    ctx.report.add("lease_stolen_after_expiry", stolen,
+                   str(b.lease_state("m")))
+    ctx.expect_error(result.get("err"), StaleEpochError,
+                     name="late_decision_refused_typed")
+    invariants.check_typed_errors(ctx.report, ctx.errors)
+    invariants.check_event_order(
+        ctx.report, ctx.events(),
+        ["lease_acquire", "replica_lost", "lease_steal",
+         "stale_epoch_refused"])
+    # a replica joining AFTER the handoff replays the journal to the
+    # same holder/epoch — bounded, deterministic recovery
+    c = ClusterCoordinator(ctx.path("reg"), "rc", heartbeat_s=0.05,
+                           lease_ttl_s=0.3)
+    c.refresh()
+    lease = c.lease_state("m")
+    ctx.report.add("journal_replays_to_stolen_holder",
+                   lease["replica"] == "rb" and lease["epoch"] == 2,
+                   str(lease))
+    for coord in (a, b, c):
+        coord.shutdown(release_leases=False)
+
+
+@drill("cluster", ["cluster.decision"],
+       expected_alerts=["replica_stale"])
+def drill_cluster_clock_skew_double_claim(ctx: DrillContext):
+    """Clock-skewed double-claim: a replica whose clock runs 10s behind
+    claims the lease, looks instantly stale to a well-clocked peer, and
+    is double-claimed — the epoch fence refuses the skewed replica's
+    decisions typed; the two claims are never silently merged."""
+    from deeplearning4j_tpu.serving.cluster import (
+        ClusterCoordinator,
+        StaleEpochError,
+    )
+
+    regdir = ctx.path("reg")
+    os.makedirs(regdir, exist_ok=True)
+    skew = 10.0
+    a = ClusterCoordinator(regdir, "ra", heartbeat_s=0.05,
+                           lease_ttl_s=0.5,
+                           clock=lambda: time.time() - skew)
+    b = ClusterCoordinator(regdir, "rb", heartbeat_s=0.05,
+                           lease_ttl_s=0.5)
+    a.heartbeat()
+    b.heartbeat()
+    ctx.report.add("skewed_claim", a.ensure_lease("m"))
+    # to B, A's heartbeat timestamps are already past the TTL
+    b.refresh()
+    ctx.report.add("double_claim_steals", b.ensure_lease("m"),
+                   str(b.lease_state("m")))
+    # both replicas believed they were controller; the fence decides
+    _res, err = ctx.capture(a.fence, "m")
+    ctx.expect_error(err, StaleEpochError,
+                     name="skewed_decision_refused_typed")
+    epoch, fence_err = ctx.capture(b.fence, "m")
+    ctx.report.add("current_holder_fences_clean",
+                   fence_err is None and epoch == 2,
+                   f"epoch={epoch} err={fence_err}")
+    ctx.report.add("exactly_one_controller",
+                   b.is_owner("m") and not a.is_owner("m"))
+    invariants.check_typed_errors(ctx.report, ctx.errors)
+    # replica_lost fires as soon as ANY fold sees the skewed
+    # timestamps — before A even claims — so it is asserted by
+    # presence, not position
+    ctx.report.add("skew_judged_lost",
+                   bool(ctx.events(["replica_lost"])))
+    invariants.check_event_order(
+        ctx.report, ctx.events(),
+        ["lease_acquire", "lease_steal", "stale_epoch_refused"])
+    for coord in (a, b):
+        coord.shutdown(release_leases=False)
+
+
+@drill("cluster", ["fs.append"], deadline_s=60.0,
+       expected_alerts=["replica_stale", "lease_flap",
+                        "storage_errors"])
+def drill_cluster_split_brain_appends(ctx: DrillContext):
+    """Split-brain concurrent journal appends: two replicas claim the
+    same epoch simultaneously — journal append order is the tiebreak
+    (exactly one owner, loser refused typed); a torn heartbeat append
+    (SIGKILL mid-write) is typed, repaired, and replays clean; repeated
+    handoffs fire the lease_flap alert."""
+    import threading
+
+    from deeplearning4j_tpu.serving.cluster import (
+        ClusterCoordinator,
+        StaleEpochError,
+    )
+
+    a, b = _cluster_pair(ctx, ttl=0.25)
+    barrier = threading.Barrier(2)
+    results = {}
+
+    def claim(coord, key):
+        barrier.wait()
+        results[key] = coord.ensure_lease("m")
+
+    ta = threading.Thread(target=claim, args=(a, "a"), daemon=True)
+    tb = threading.Thread(target=claim, args=(b, "b"), daemon=True)
+    ta.start()
+    tb.start()
+    ta.join(timeout=15)
+    tb.join(timeout=15)
+    a.refresh()
+    b.refresh()
+    owners = [c for c in (a, b) if c.is_owner("m")]
+    ctx.report.add("exactly_one_owner_after_split_brain",
+                   len(owners) == 1,
+                   f"claims={results} lease={a.lease_state('m')}")
+    ctx.report.add("claim_results_agree",
+                   sorted(results.values()) == [False, True],
+                   str(results))
+    loser = b if owners and owners[0] is a else a
+    _res, err = ctx.capture(loser.fence, "m")
+    ctx.expect_error(err, StaleEpochError,
+                     name="split_brain_loser_refused_typed")
+    # torn heartbeat append (SIGKILL mid-write): typed StorageError,
+    # half the line durably on disk
+    plan = ChaosPlan([{"seam": "fs.append", "mode": "torn",
+                       "match": {"surface": "cluster_journal"},
+                       "times": 1}], name=ctx.name)
+    with plan.armed():
+        _res, err = ctx.capture(a.heartbeat)
+    ctx.expect_error(err, StorageError, name="torn_append_typed")
+    # readers leave the fragment unconsumed; the next append repairs it
+    b.refresh()
+    _res, err = ctx.capture(a.heartbeat)
+    ctx.report.add("append_after_torn_repairs", err is None
+                   and bool(ctx.events(["journal_repair"])), str(err))
+    # repeated stale→steal handoffs: the lease flapping between
+    # replicas is an alert, not silence
+    winner = owners[0] if owners else a
+    loser = b if winner is a else a
+    for _ in range(3):
+        time.sleep(0.3)  # the holder's heartbeat goes past the TTL
+        loser.heartbeat()
+        ctx.report.add("flap_steal", loser.ensure_lease("m"),
+                       str(loser.lease_state("m")))
+        winner, loser = loser, winner
+    ctx.report.add("lease_steals_recorded",
+                   len(ctx.events(["lease_steal"])) >= 3)
+    # a fresh replica replays the whole journal — torn tail, split-
+    # brain claims and all — to the same final holder
+    c = ClusterCoordinator(ctx.path("reg"), "rc", heartbeat_s=0.05,
+                           lease_ttl_s=0.25)
+    c.refresh()
+    ctx.report.add("journal_replays_final_holder",
+                   c.lease_state("m")["replica"]
+                   == winner.replica_id, str(c.lease_state("m")))
+    invariants.check_typed_errors(ctx.report, ctx.errors)
+    for coord in (a, b, c):
+        coord.shutdown(release_leases=False)
+
+
+# ==========================================================================
 # custom plans over stock workloads (cli chaos --plan)
 # ==========================================================================
 WORKLOADS = ("fit", "checkpoint_fit", "generate", "registry", "tune")

@@ -149,6 +149,11 @@ register_hook_seam(
     "kernel.probe", "kernels",
     "kernel availability probes (mode 'transient_compile' carries the "
     "tunnel-crash signature probe_with_retry retries on)")
+register_hook_seam(
+    "cluster.decision", "cluster",
+    "a canary-controller decision about to be epoch-fence checked "
+    "(mode 'delay' = the paused ex-holder: a peer steals the lease "
+    "during the pause and the late decision must be refused typed)")
 
 
 # --------------------------------------------------------------------------

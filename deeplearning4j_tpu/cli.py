@@ -197,6 +197,28 @@ def serve_main(argv) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="serve ONE local request through the HTTP stack, "
                          "print the result, shut down (CI gate)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="registry mode only: join the multi-replica "
+                         "tier coordinated through the registry dir's "
+                         "fsync'd journal — heartbeats, one epoch-fenced "
+                         "canary controller per window, cross-replica "
+                         "gate aggregation (a regression ANY replica "
+                         "sees rolls back everywhere), cluster-wide "
+                         "tenant budgets")
+    ap.add_argument("--replica-id", default=None,
+                    help="stable replica identity in the cluster journal "
+                         "(default: r<pid>)")
+    ap.add_argument("--heartbeat-s", type=float, default=1.0,
+                    help="cluster heartbeat period; liveness is judged "
+                         "against --lease-ttl-s")
+    ap.add_argument("--lease-ttl-s", type=float, default=None,
+                    help="heartbeat staleness after which a replica is "
+                         "lost and its leases stealable (default: 3x "
+                         "--heartbeat-s)")
+    ap.add_argument("--global-tenant-quota", type=int, default=None,
+                    help="cluster-WIDE max in-flight per tenant, split "
+                         "into per-replica budget shares that rebalance "
+                         "on heartbeat (idle replicas lend headroom)")
     args = ap.parse_args(argv)
     if args.model is None and args.registry_dir is None:
         ap.error("one of --model or --registry-dir is required")
@@ -360,6 +382,19 @@ def _serve_registry(args) -> int:
     from deeplearning4j_tpu.serving.metrics import ServingMetrics
 
     registry = ModelRegistry(args.registry_dir)
+    cluster = None
+    if getattr(args, "cluster", False):
+        import os as _os
+
+        from deeplearning4j_tpu.serving import ClusterCoordinator
+
+        replica_id = args.replica_id or f"r{_os.getpid()}"
+        cluster = ClusterCoordinator(
+            args.registry_dir, replica_id,
+            heartbeat_s=args.heartbeat_s,
+            lease_ttl_s=args.lease_ttl_s,
+            global_tenant_quota=args.global_tenant_quota,
+            metrics_registry=default_registry())
     router = ModelRouter(
         registry, batch_limit=args.batch_limit,
         max_wait_ms=args.max_wait_ms, queue_limit=args.queue_limit,
@@ -371,7 +406,16 @@ def _serve_registry(args) -> int:
         gen_spec_decode_k=args.spec_decode_k,
         gen_draft_mode=args.spec_draft_mode,
         gen_prefix_cache_mb=args.prefix_cache_mb,
-        metrics=ServingMetrics(registry=default_registry()))
+        metrics=ServingMetrics(registry=default_registry()),
+        cluster=cluster)
+    if cluster is not None:
+        # heartbeats carry this replica's per-tenant in-flight counts —
+        # the lend/borrow signal for cluster-wide budget shares
+        cluster.start(inflight_fn=router.tenant_inflight)
+        print(f"cluster: replica {cluster.replica_id} "
+              f"(heartbeat {cluster.heartbeat_s:g}s, lease ttl "
+              f"{cluster.lease_ttl_s:g}s, global tenant quota "
+              f"{args.global_tenant_quota})", flush=True)
     names = registry.models()
     print(f"registry {args.registry_dir}: models {names or '(none yet)'} "
           f"(canary {args.canary_fraction:.0%} for "
@@ -423,12 +467,17 @@ def _serve_registry(args) -> int:
               f"version={body.get('model_version')} "
               f"{'ok' if ok else body}", flush=True)
         server.shutdown()
+        if cluster is not None:
+            cluster.shutdown()
         return 0 if ok else 1
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         print("shutting down (draining queues)", flush=True)
         server.shutdown()
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
     return 0
 
 

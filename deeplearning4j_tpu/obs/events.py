@@ -139,6 +139,41 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
         "serving/registry.py",
         "candidate cannot decode; canary gets no generation votes "
         "(recorded once)"),
+    # -- multi-replica cluster (serving/cluster.py) -----------------------
+    "replica_up": ("serving/cluster.py",
+                   "a replica's first/returning heartbeat folded "
+                   "(rejoined=True after a loss)"),
+    "replica_lost": ("serving/cluster.py",
+                     "a replica's heartbeat went stale past the lease "
+                     "TTL; its leases are stealable"),
+    "lease_acquire": ("serving/cluster.py",
+                      "canary-controller lease claimed for a model "
+                      "(epoch bumped)"),
+    "lease_steal": ("serving/cluster.py",
+                    "lease taken from a stale/lost holder "
+                    "(stolen_from attached)"),
+    "lease_release": ("serving/cluster.py",
+                      "holder released its lease cleanly (epoch kept — "
+                      "the fence outlives the hold)"),
+    "stale_epoch_refused": ("serving/cluster.py",
+                            "an ex-holder's decision hit the epoch "
+                            "fence; StaleEpochError raised"),
+    "quota_rebalance": ("serving/cluster.py",
+                        "alive-replica count changed; per-replica "
+                        "tenant budget shares recomputed"),
+    "cluster_rollback_applied": ("serving/registry.py",
+                                 "a peer's journaled rollback applied "
+                                 "locally (no second registry write)"),
+    "cluster_promote_applied": ("serving/registry.py",
+                                "a peer's journaled promote applied "
+                                "locally (engine adopted)"),
+    "canary_suspend": ("serving/registry.py",
+                       "non-holder stopped routing to a failing canary "
+                       "(fence refused its trip; evidence journaled "
+                       "urgently)"),
+    "drain_start": ("serving/server.py",
+                    "replica entered drain mode: new requests 503 "
+                    "typed while in-flight streams finish"),
     # -- continuous batching (serving/generate.py) ------------------------
     "slot_claim": ("serving/generate.py",
                    "request claimed a decode slot (prefill follows)"),
@@ -227,6 +262,10 @@ HOOK_POINTS: Dict[str, tuple] = {
     "kernel.probe": ("nn/ops/registry.py",
                      "a kernel availability probe about to compile+run "
                      "(transient_compile mode)"),
+    "cluster.decision": ("serving/cluster.py",
+                         "a controller decision (trip/promote/release) "
+                         "about to be epoch-fence checked — delay mode "
+                         "is the paused ex-holder drill"),
 }
 
 
@@ -289,6 +328,12 @@ ALERTS: Dict[str, tuple] = {
     "prefix_hit_rate_low": ("obs/slo.py",
                             "shared-prefix cache hit rate collapsed "
                             "under repeated-prompt traffic"),
+    "replica_stale": ("obs/slo.py",
+                      "a cluster replica's heartbeat went absent past "
+                      "the lease TTL"),
+    "lease_flap": ("obs/slo.py",
+                   "a canary-controller lease changed holder "
+                   "repeatedly in a short window"),
     # the canary gate, expressed in the same engine (serving/registry.py
     # builds these per canary window via obs/slo.canary_gate_rules)
     "canary_score_regressed": ("obs/slo.py",

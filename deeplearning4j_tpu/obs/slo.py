@@ -208,6 +208,23 @@ def default_rules(queue_limit: int = 256,
             description="the lock witness saw an acquisition-order "
                         "cycle — an ABBA deadlock waiting for the "
                         "right schedule; fix the ordering now"),
+        # -- multi-replica cluster ---------------------------------------------
+        AlertRule(
+            "replica_stale", "increase", severity="critical",
+            resolve_s=300.0, **_flight("replica_lost"),
+            description="a cluster replica's heartbeat went absent "
+                        "past the lease TTL — its canary-controller "
+                        "leases are being stolen; if it is still "
+                        "serving, it is partitioned from the journal"),
+        AlertRule(
+            "lease_flap", "increase", op=">=", threshold=3,
+            window_s=120.0, resolve_s=300.0, severity="warn",
+            **_flight("lease_steal"),
+            description="a canary-controller lease changed holder "
+                        "repeatedly in a short window — replicas are "
+                        "flapping between alive and stale (heartbeat "
+                        "interval too close to the lease TTL, or the "
+                        "box is overloaded)"),
     ]
 
 

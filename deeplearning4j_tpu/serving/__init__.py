@@ -32,6 +32,13 @@ the training stack produces crash-safe checkpoints
   validation-gated snapshots, and the :class:`ModelRouter` serving
   them multiplexed (canary routing with auto-rollback, per-tenant
   queue quotas, LRU cold-model eviction/rewarm).
+- :mod:`cluster` — the multi-replica tier: heartbeat/lease/epoch
+  coordination over the registry's fsync'd journal
+  (:class:`ClusterCoordinator`) — exactly one canary controller per
+  window (epoch-fenced, stale decisions refused typed
+  :class:`StaleEpochError`), cross-replica gate-counter aggregation so
+  a regression any replica sees rolls back everywhere, and cluster-
+  wide tenant-quota budget shares.
 """
 
 from deeplearning4j_tpu.serving.batcher import (
@@ -43,6 +50,11 @@ from deeplearning4j_tpu.serving.batcher import (
     ServingError,
 )
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
+from deeplearning4j_tpu.serving.cluster import (
+    ClusterCoordinator,
+    ClusterError,
+    StaleEpochError,
+)
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.generate import (
     DecodeStalledError,
@@ -61,11 +73,16 @@ from deeplearning4j_tpu.serving.registry import (
     UnknownModelError,
 )
 from deeplearning4j_tpu.serving.rtrace import RequestTrace, TraceBuffer
-from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.serving.server import (
+    InferenceServer,
+    ServerDrainingError,
+)
 
 __all__ = [
     "BucketPolicy",
     "CanaryRolledBackError",
+    "ClusterCoordinator",
+    "ClusterError",
     "DecodeStalledError",
     "DynamicBatcher",
     "GenerationEngine",
@@ -80,11 +97,13 @@ __all__ = [
     "RegistryError",
     "RequestDeadlineExceeded",
     "RequestTrace",
+    "ServerDrainingError",
     "ServerOverloadedError",
     "ServerShutdownError",
     "ServingError",
     "ServingMetrics",
     "SnapshotValidationError",
+    "StaleEpochError",
     "TenantQuotaExceededError",
     "TraceBuffer",
     "UnknownModelError",

@@ -1237,6 +1237,12 @@ class GenerationEngine:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def inflight(self) -> int:
+        """Accepted-but-unfinished requests (decoding slots + queued):
+        what a draining replica must let run out before it can be
+        retired without dropping a stream."""
+        return self.active_slots + self._queue.qsize()
+
     def retry_after_s(self) -> float:
         """Backoff hint for overloaded clients (the ``Retry-After``
         header on 503s), clamped to [1, 60]s. The batcher's

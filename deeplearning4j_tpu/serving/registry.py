@@ -147,7 +147,8 @@ class ModelRegistry:
 
     def __init__(self, directory: str, regression_tolerance: float = 0.0,
                  higher_is_better: bool = False,
-                 keep_last: Optional[int] = None):
+                 keep_last: Optional[int] = None,
+                 refresh_min_interval_s: float = 0.0):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.journal_path = os.path.join(self.directory, JOURNAL_NAME)
@@ -159,6 +160,13 @@ class ModelRegistry:
         #: snapshots retained per model beyond the referenced set
         #: (active / canary / newest validated are never pruned)
         self.keep_last = None if keep_last is None else int(keep_last)
+        #: min seconds between :meth:`refresh` stat checks (0 = stat on
+        #: every call, the original behavior). A deployment with many
+        #: co-located readers raises it; the CLUSTER layer bypasses it
+        #: (``refresh(force=True)``) while a canary window is open —
+        #: cross-replica rollback latency is bounded by this cadence
+        self.refresh_min_interval_s = float(refresh_min_interval_s)
+        self._next_refresh_check = 0.0  # monotonic deadline
         self._lock = witnessed_rlock("registry.store")
         self._models: Dict[str, dict] = {}
         self._journal_bytes = 0
@@ -259,12 +267,19 @@ class ModelRegistry:
                                    if os.path.exists(self.journal_path)
                                    else 0)
 
-    def refresh(self) -> bool:
+    def refresh(self, force: bool = False) -> bool:
         """Fold in journal lines another process appended since the last
         load (the serving router polls this to notice a trainer's
         publishes). Returns True when state changed. Cheap when nothing
-        changed: one stat."""
+        changed: one stat — and, with ``refresh_min_interval_s`` set,
+        not even that until the throttle window elapses. ``force=True``
+        bypasses the throttle (the cluster layer's canary-window
+        tightening)."""
         with self._lock:
+            now = time.monotonic()
+            if not force and now < self._next_refresh_check:
+                return False
+            self._next_refresh_check = now + self.refresh_min_interval_s
             size = (os.path.getsize(self.journal_path)
                     if os.path.exists(self.journal_path) else 0)
             if size == self._journal_bytes:
@@ -790,6 +805,11 @@ class _ManagedModel:
         self.canary_started: Optional[float] = None  # monotonic
         self.canary_counter = 0
         self.canary_inflight: deque = deque()
+        #: cluster mode: this replica observed the canary fail but does
+        #: NOT hold the controller lease — local canary routing stops
+        #: (no more traffic to a version we saw fail) while the lease
+        #: holder's cluster-wide verdict is pending in the journal
+        self.canary_suspended = False
         self.generation = None  # lazy GenerationEngine
         #: canary-version GenerationEngine (built lazily at the first
         #: /generate while a canary window is open) — canary_fraction of
@@ -848,8 +868,16 @@ class ModelRouter:
                  gen_spec_decode_k: int = 1, gen_draft_mode: str = "ngram",
                  gen_prefix_cache_mb: float = 0.0,
                  metrics: Optional[ServingMetrics] = None,
-                 trace_requests: bool = True, traces=None):
+                 trace_requests: bool = True, traces=None,
+                 cluster=None):
         self.registry = registry
+        #: optional serving/cluster.py ClusterCoordinator. When set,
+        #: the canary state machine becomes cluster-wide: gate ticks
+        #: read CLUSTER-merged per-version stats, only the lease
+        #: holder commits trip/promote decisions (epoch-fenced — a
+        #: stale ex-holder's decision raises typed StaleEpochError),
+        #: and tenant quotas become budget shares of the global quota
+        self.cluster = cluster
         self.batch_limit = int(batch_limit)
         self.max_wait_ms = float(max_wait_ms)
         self.queue_limit = int(queue_limit)
@@ -889,9 +917,24 @@ class ModelRouter:
     # -- admission -----------------------------------------------------------
     def _maybe_refresh(self) -> None:
         now = time.monotonic()
-        if now - self._last_refresh >= self.refresh_s:
-            self._last_refresh = now
-            self.registry.refresh()
+        interval = self.refresh_s
+        canary_open = False
+        if self.cluster is not None:
+            with self._lock:
+                canary_open = any(mm.canary is not None
+                                  for mm in self._live.values())
+            if canary_open:
+                # tighten the poll while a window is open: a peer's
+                # rollback must reach THIS replica within the bench's
+                # cross-replica latency bound
+                interval = min(interval, self.cluster.canary_refresh_s)
+        if now - self._last_refresh < interval:
+            return
+        self._last_refresh = now
+        changed = self.registry.refresh(force=canary_open)
+        if self.cluster is not None:
+            self.cluster.refresh()
+            self._sync_cluster(changed)
 
     def managed(self, name: str) -> _ManagedModel:
         """The live managed model, admitting (and LRU-evicting) as
@@ -979,8 +1022,26 @@ class ModelRouter:
                 mm.active.retire(drain=True)
 
     # -- tenant quotas -------------------------------------------------------
+    def tenant_inflight(self) -> Dict[str, int]:
+        """Per-tenant in-flight request counts — what this replica's
+        cluster heartbeat reports so peers can borrow unused quota."""
+        with self._tenant_lock:
+            out = {}
+            for t, ledger in self._tenants.items():
+                n = sum(1 for r in ledger if not r.done())
+                if n:
+                    out[t] = n
+            return out
+
     def _admit_tenant(self, tenant: str, retry_after: float):
-        if self.tenant_quota is None:
+        quota = self.tenant_quota
+        if self.cluster is not None:
+            # cluster-wide quota: this replica's budget share (fair-
+            # share floor + borrow of peers' reported idle capacity)
+            budget = self.cluster.tenant_budget(tenant)
+            if budget is not None:
+                quota = budget if quota is None else min(quota, budget)
+        if quota is None:
             return None
         with self._tenant_lock:
             # bound the ledger table: tenant ids come from a
@@ -994,10 +1055,10 @@ class ModelRouter:
                 ledger.popleft()
             # opportunistic prune of the middle too (completion order is
             # not FIFO under mixed timeouts)
-            if len(ledger) >= self.tenant_quota:
+            if len(ledger) >= quota:
                 live = deque(r for r in ledger if not r.done())
                 self._tenants[tenant] = ledger = live
-            if len(ledger) >= self.tenant_quota:
+            if len(ledger) >= quota:
                 from deeplearning4j_tpu.obs import flight as _flight
 
                 self.metrics.registry.counter(
@@ -1005,10 +1066,10 @@ class ModelRouter:
                     "per-tenant quota rejections",
                     labels={"tenant": tenant}).inc()
                 _flight.record("tenant_reject", tenant=tenant,
-                               quota=self.tenant_quota)
+                               quota=quota)
                 raise TenantQuotaExceededError(
                     f"tenant {tenant!r} has {len(ledger)} requests in "
-                    f"flight (quota {self.tenant_quota}); retry with "
+                    f"flight (quota {quota}); retry with "
                     "backoff — other tenants are unaffected",
                     tenant=tenant, retry_after_s=retry_after)
             return ledger
@@ -1033,7 +1094,8 @@ class ModelRouter:
                 self._maybe_adopt(mm)
                 self._maybe_promote(mm)
                 ve = mm.active
-                if mm.canary is not None and self.canary_fraction > 0:
+                if mm.canary is not None and self.canary_fraction > 0 \
+                        and not mm.canary_suspended:
                     mm.canary_counter += 1
                     every = max(int(round(1.0 / self.canary_fraction)), 1)
                     if mm.canary_counter % every == 0:
@@ -1182,7 +1244,8 @@ class ModelRouter:
             self._maybe_promote(mm)
             gen = self._ensure_generation(mm)
             ve = mm.active
-            if mm.canary is not None and self.canary_fraction > 0:
+            if mm.canary is not None and self.canary_fraction > 0 \
+                    and not mm.canary_suspended:
                 cgen = mm.canary_generation
                 if (cgen is None and not mm.canary_gen_failed
                         and not mm.canary_gen_building):
@@ -1303,6 +1366,12 @@ class ModelRouter:
         mm.canary_started = time.monotonic()
         mm.canary_counter = 0
         mm.canary_inflight.clear()
+        mm.canary_suspended = False
+        if self.cluster is not None:
+            # bid for the window's controller lease; losing is fine —
+            # this replica then serves its canary slice, journals gate
+            # snapshots, and the lease holder decides
+            self.cluster.ensure_lease(mm.name)
         # the gate as declarative rules in the shared alert engine (ONE
         # evaluation mechanism with the SLO pack): signals close over
         # the live per-version stats and reproduce the PR 11 gate's
@@ -1312,8 +1381,15 @@ class ModelRouter:
         from deeplearning4j_tpu.obs.alerts import AlertEvaluator
         from deeplearning4j_tpu.obs.slo import canary_gate_rules
 
+        # cluster mode evaluates the SAME rules over a duck-typed view
+        # whose per-version stats are CLUSTER-merged (local live
+        # counters + peers' journaled gate snapshots): a regression any
+        # replica observes reaches the controller's tick
+        gate_subject = (mm if self.cluster is None
+                        else self.cluster.gate_view(mm))
         mm.canary_alerts = AlertEvaluator(
-            canary_gate_rules(mm, self.registry.higher_is_better,
+            canary_gate_rules(gate_subject,
+                              self.registry.higher_is_better,
                               self.latency_trip_mult,
                               self.latency_trip_min_samples,
                               self.score_trip_tolerance),
@@ -1381,6 +1457,34 @@ class ModelRouter:
             ve = mm.canary
             if ve is None or ve.dead:
                 return
+            if self.cluster is not None:
+                # fold OUT first: journal this replica's per-version
+                # observations so every peer's next tick sees them
+                self.cluster.journal_gate(name, ve.version, "canary",
+                                          ve.stats)
+                if mm.active is not None:
+                    self.cluster.journal_gate(name, mm.active.version,
+                                              "active", mm.active.stats)
+                if not self.cluster.ensure_lease(name):
+                    return  # a live peer holds the controller lease
+                if mm.canary_suspended:
+                    # this replica observed the failure while a peer
+                    # held the lease (fence refused its inline trip);
+                    # now IT is the controller — the suspended canary
+                    # trips immediately
+                    self._trip(name, ve,
+                               "canary dispatch failures observed "
+                               "while a peer held the controller lease")
+                    return
+                # a dispatch failure a PEER journaled is ground truth
+                # (its own inline trip was refused by the fence): the
+                # bad version must not get more cluster traffic
+                peer_fail = self.cluster.peer_failures(name, ve.version)
+                if peer_fail:
+                    self._trip(name, ve,
+                               f"peer-observed canary dispatch "
+                               f"failures ({peer_fail})")
+                    return
             ev = mm.canary_alerts
             if ev is not None:
                 for st in ev.tick():
@@ -1389,12 +1493,15 @@ class ModelRouter:
                         return
             # promotion: bounded window elapsed, enough canary traffic
             # (predict AND generation requests both count — a model
-            # serving only /generate must still be able to promote),
+            # serving only /generate must still be able to promote; in
+            # cluster mode the CLUSTER-wide canary traffic counts),
             # nothing tripped
+            st = (ve.stats if self.cluster is None
+                  else self.cluster.merged_stats(name, ve))
             if (mm.canary_started is not None
                     and time.monotonic() - mm.canary_started
                     >= self.canary_window_s
-                    and ve.stats.requests + ve.stats.gen_requests
+                    and st.requests + st.gen_requests
                     >= self.canary_min_requests):
                 self._promote(mm)
 
@@ -1411,6 +1518,18 @@ class ModelRouter:
             ve, old = mm.canary, mm.active
             if ve is None:
                 return
+            if self.cluster is not None:
+                from deeplearning4j_tpu.serving.cluster import (
+                    StaleEpochError,
+                )
+
+                try:
+                    # the epoch fence: a stale ex-holder (paused,
+                    # skewed) must not journal a promote the current
+                    # controller did not make
+                    self.cluster.fence(mm.name)
+                except StaleEpochError:
+                    return  # the holder's verdict arrives via the WAL
             mm.canary = None
             mm.canary_started = None
             mm.canary_inflight.clear()
@@ -1430,20 +1549,27 @@ class ModelRouter:
                 # drain: in-flight old-version requests all complete —
                 # the no-mixing/no-dropping guarantee under promotion
                 old.retire(drain=True)
-            if mm.canary_generation is not None:
-                # the canary's warmed decode engine IS the promoted
-                # version's engine — adopt it (already on the new
-                # weights, zero recompiles) and retire the old one
-                old_gen, mm.generation = mm.generation, mm.canary_generation
-                mm.canary_generation = None
-                mm.canary_gen_failed = False
-                mm.generation.chaos_ctx["role"] = "active"
-                if old_gen is not None:
-                    threading.Thread(target=old_gen.shutdown,
-                                     daemon=True).start()
-            else:
-                mm.canary_gen_failed = False
-                self._sync_generation(mm, old)
+            self._adopt_promoted_generation(mm, old)
+
+    def _adopt_promoted_generation(self, mm: _ManagedModel,
+                                   old: Optional["_VersionedEngine"]
+                                   ) -> None:
+        # caller holds mm.lock and has already made mm.active the
+        # promoted engine
+        if mm.canary_generation is not None:
+            # the canary's warmed decode engine IS the promoted
+            # version's engine — adopt it (already on the new
+            # weights, zero recompiles) and retire the old one
+            old_gen, mm.generation = mm.generation, mm.canary_generation
+            mm.canary_generation = None
+            mm.canary_gen_failed = False
+            mm.generation.chaos_ctx["role"] = "active"
+            if old_gen is not None:
+                threading.Thread(target=old_gen.shutdown,
+                                 daemon=True).start()
+        else:
+            mm.canary_gen_failed = False
+            self._sync_generation(mm, old)
 
     def _sync_generation(self, mm: _ManagedModel,
                          old: Optional[_VersionedEngine]) -> None:
@@ -1478,6 +1604,18 @@ class ModelRouter:
         mm = self._live.get(name)
         if mm is None:
             return
+        if self.cluster is not None:
+            from deeplearning4j_tpu.serving.cluster import StaleEpochError
+
+            try:
+                # same fence as promote: only the current lease holder
+                # journals a rollback. A non-holder that observed the
+                # failure suspends its local canary routing and journals
+                # the failure urgently so the holder's next tick trips.
+                self.cluster.fence(name)
+            except StaleEpochError:
+                self._suspend_canary(mm, ve, reason)
+                return
         with mm.lock:
             if mm.canary is not ve or ve.dead:
                 return  # already tripped / promoted
@@ -1513,6 +1651,129 @@ class ModelRouter:
                            active_version=None if mm.active is None
                            else mm.active.version)
             ve.retire(drain=False)
+
+    # -- cluster sync --------------------------------------------------------
+    def _suspend_canary(self, mm: _ManagedModel, ve: _VersionedEngine,
+                        reason: str) -> None:
+        """Non-holder observed a canary failure but the epoch fence
+        refused its trip: stop routing local traffic to the candidate
+        and journal the evidence urgently. The lease holder's next gate
+        tick sees the peer failures and trips the CLUSTER rollback."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        with mm.lock:
+            if mm.canary is not ve or ve.dead or mm.canary_suspended:
+                return
+            mm.canary_suspended = True
+            _flight.record("canary_suspend", model=mm.name,
+                           version=ve.version, reason=reason)
+        if self.cluster is not None:
+            self.cluster.journal_gate(mm.name, ve.version, "canary",
+                                      ve.stats, urgent=True)
+
+    def _sync_cluster(self, registry_changed: bool) -> None:
+        """Post-refresh reconciliation against the shared registry +
+        cluster journal: apply peers' rollback/promote decisions
+        locally, adopt canaries peers opened, and give the lease holder
+        its gate tick (liveness-driven — no request traffic needed to
+        steal a dead holder's lease)."""
+        with self._lock:
+            mms = list(self._live.values())
+        for mm in mms:
+            try:
+                self._sync_cluster_model(mm)
+            except (RegistryError, OSError):
+                continue  # transient — next refresh retries
+
+    def _sync_cluster_model(self, mm: _ManagedModel) -> None:
+        try:
+            reg = self.registry.get(mm.name)
+        except UnknownModelError:
+            return
+        with mm.lock:
+            if mm.evicted:
+                return
+            ve = mm.canary
+            if ve is not None and not ve.dead:
+                vr = reg.get("versions", {}).get(str(ve.version))
+                status = None if vr is None else vr.get("status")
+                if status == "rolled_back":
+                    # a peer (the lease holder) tripped: tear down the
+                    # local candidate without journaling a second
+                    # rollback
+                    self._apply_remote_rollback(mm, ve)
+                elif (status == "active"
+                      and reg.get("active_version") == ve.version):
+                    self._apply_remote_promote(mm, ve)
+            elif ve is None and not self._shutdown:
+                cand = reg.get("canary")
+                if (cand is not None
+                        and mm.active is not None
+                        and int(cand["version"]) != mm.active.version):
+                    vrec = reg.get("versions", {}).get(
+                        str(int(cand["version"])))
+                    if vrec is not None \
+                            and vrec.get("status") == "canary":
+                        # a peer opened a canary window — adopt it so
+                        # this replica's traffic share feeds the
+                        # cluster gate
+                        self._start_canary(mm, vrec, resumed=True)
+        if mm.canary is not None:
+            # the holder's poll tick: liveness/steal/peer-failure
+            # evaluation must not wait for local canary traffic
+            self._evaluate_canary(mm.name)
+
+    def _apply_remote_rollback(self, mm: _ManagedModel,
+                               ve: _VersionedEngine) -> None:
+        """Caller holds mm.lock. Mirror of _trip's teardown minus the
+        registry write and rollback event — the holder already
+        journaled both; this replica only applies the verdict."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        ve.dead = True
+        mm.canary = None
+        mm.canary_started = None
+        mm.canary_suspended = False
+        if mm.canary_alerts is not None:
+            mm.canary_alerts.shutdown()
+            mm.canary_alerts = None
+        if mm.canary_generation is not None:
+            cgen, mm.canary_generation = mm.canary_generation, None
+            threading.Thread(target=cgen.shutdown,
+                             kwargs={"drain": False},
+                             daemon=True).start()
+        mm.canary_gen_failed = False
+        _flight.record("cluster_rollback_applied", model=mm.name,
+                       version=ve.version)
+        err = CanaryRolledBackError(
+            f"{mm.name} v{ve.version} rolled back cluster-wide; retry "
+            "— the active version is serving")
+        while mm.canary_inflight:
+            req = mm.canary_inflight.popleft()
+            req.fail(err)
+        ve.retire(drain=False)
+
+    def _apply_remote_promote(self, mm: _ManagedModel,
+                              ve: _VersionedEngine) -> None:
+        """Caller holds mm.lock. Mirror of _promote minus the registry
+        write and promote event (the holder journaled them)."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        old = mm.active
+        mm.canary = None
+        mm.canary_started = None
+        mm.canary_suspended = False
+        mm.canary_inflight.clear()
+        if mm.canary_alerts is not None:
+            mm.canary_alerts.shutdown()
+            mm.canary_alerts = None
+        mm.active = ve
+        ve.role = "active"
+        _flight.record("cluster_promote_applied", model=mm.name,
+                       version=ve.version)
+        if old is not None:
+            old.retire(drain=True)
+        self._adopt_promoted_generation(mm, old)
 
     # -- introspection -------------------------------------------------------
     def healthz(self, name: str) -> dict:
@@ -1552,11 +1813,14 @@ class ModelRouter:
                 "queue_depth": 0 if mm.active is None
                 else mm.active.batcher.queue_depth(),
             } for name, mm in self._live.items()}
-        return {"models": self.registry.models(), "live": live,
-                "max_live_models": self.max_live_models,
-                "tenant_quota": self.tenant_quota,
-                "canary_fraction": self.canary_fraction,
-                "canary_window_s": self.canary_window_s}
+        out = {"models": self.registry.models(), "live": live,
+               "max_live_models": self.max_live_models,
+               "tenant_quota": self.tenant_quota,
+               "canary_fraction": self.canary_fraction,
+               "canary_window_s": self.canary_window_s}
+        if self.cluster is not None:
+            out["cluster"] = self.cluster.describe()
+        return out
 
     def queue_depth(self) -> int:
         with self._lock:

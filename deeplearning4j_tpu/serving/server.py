@@ -39,6 +39,11 @@ the production contract:
                            scrape-driven: each hit runs at most one
                            throttled evaluator tick
 - ``POST /reload``         hot-swap to the newest valid checkpoint
+- ``POST /drain``          enter drain mode: new requests are refused
+                           typed (503 + Retry-After) while in-flight
+                           work — streaming /generate included —
+                           finishes; the replica-loss/rollout front
+                           moves new sessions to live replicas
                            (optional JSON ``{"path": ...,
                            "force": bool}``)
 - ``GET  /metrics``        counters, queue depth, per-bucket hits +
@@ -108,6 +113,13 @@ from deeplearning4j_tpu.serving.batcher import (
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine
 from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+class ServerDrainingError(ServerOverloadedError):
+    """This replica is draining: new requests are refused (503 +
+    Retry-After → the front routes them to a live replica) while
+    already-accepted work — including in-flight /generate streams —
+    runs to completion."""
 
 
 class InferenceServer:
@@ -198,6 +210,10 @@ class InferenceServer:
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
+        #: drain mode (POST /drain): reject NEW requests typed while
+        #: in-flight work (streams included) finishes — the session-
+        #: sticky front moves new sessions to live replicas
+        self._draining = False
 
     # -- lifecycle ----------------------------------------------------------
     @property
@@ -215,6 +231,37 @@ class InferenceServer:
     def serve_forever(self) -> None:
         self._serving = True
         self._httpd.serve_forever()
+
+    def drain(self) -> dict:
+        """Enter drain mode: the listener stays up (in-flight streams
+        keep their connection), but every NEW request is refused with a
+        typed 503 until shutdown. Idempotent. Returns the drain state
+        rollout tooling polls."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        if not self._draining:
+            self._draining = True
+            _flight.record("drain_start",
+                           port=self.port,
+                           queue_depth=self.queue_depth())
+        out = {"draining": True, "queue_depth": self.queue_depth()}
+        if self.generation is not None:
+            out["generation_inflight"] = self.generation.inflight()
+        return out
+
+    def queue_depth(self) -> int:
+        depth = self.batcher.queue_depth() if self.batcher is not None \
+            else 0
+        if self.router is not None:
+            depth += self.router.queue_depth()
+        return depth
+
+    def _check_draining(self) -> None:
+        if self._draining:
+            err = ServerDrainingError(
+                "replica is draining; retry against another replica")
+            err.retry_after_s = 1.0
+            raise err
 
     def shutdown(self) -> None:
         """Stop the listener, then drain the batcher (in-flight requests
@@ -264,6 +311,7 @@ class InferenceServer:
         :class:`~serving.batcher.InferenceRequest` (its ``trace`` holds
         the stage timeline when tracing was on)."""
         timeout = self.default_timeout_s if timeout_s is None else timeout_s
+        self._check_draining()
         if model is not None or self.batcher is None:
             if self.router is None:
                 raise ValueError(
@@ -289,6 +337,11 @@ class InferenceServer:
 def _make_handler(server: InferenceServer):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # TCP_NODELAY: headers and body flush as separate segments, and
+        # with Nagle on, the body segment stalls behind the peer's
+        # delayed ACK — a flat ~40ms on every response on some kernels
+        disable_nagle_algorithm = True
+
         # quiet by default: per-request stderr lines are noise at load
         def log_message(self, fmt, *args):  # noqa: N802
             pass
@@ -385,6 +438,7 @@ def _make_handler(server: InferenceServer):
                         info = server.router.describe()
                     info["uptime_s"] = round(
                         time.time() - server.metrics.started_at, 3)
+                    info["draining"] = server._draining
                     if server.generation is not None:
                         info["generation"] = server.generation.describe()
                     server.alerts.maybe_tick()
@@ -498,6 +552,8 @@ def _make_handler(server: InferenceServer):
                     self._generate()
                 elif self.path == "/reload":
                     self._reload()
+                elif self.path == "/drain":
+                    self._send_json(200, server.drain())
                 else:
                     self._send_json(404, {"error": "NotFound",
                                           "message": self.path})
@@ -541,6 +597,9 @@ def _make_handler(server: InferenceServer):
             started, a mid-decode failure becomes a terminal
             ``{"error": ...}`` chunk (the status line is already on the
             wire)."""
+            # drain mode refuses NEW streams before any header is on
+            # the wire; streams already decoding keep their connection
+            server._check_draining()
             gen = server.generation
             submit = None if gen is None else gen.submit
             if model is not None:

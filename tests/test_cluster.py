@@ -1,0 +1,616 @@
+"""Multi-replica serving-tier tests (serving/cluster.py + the
+registry's cluster-mode canary state machine).
+
+The acceptance spine (ISSUE 17): exactly one canary controller per
+window — the lease/epoch state machine resolves claims, steals, and
+split-brain ties deterministically from the fsync'd cluster journal,
+and a stale ex-holder's decision raises a typed
+:class:`StaleEpochError` instead of silently merging; a regression one
+replica journals trips rollback on every replica
+(``cluster_rollback_applied``), promotion propagates the same way; the
+cluster-wide tenant quota borrows idle peers' share and floors at
+fair-share under saturation; and ``cli flight-dump`` merges three
+replicas' rings into one timeline whose order proves the handoff:
+``lease_acquire → replica_lost → lease_steal → rollback``.
+"""
+
+import gc
+import http.client
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu
+from deeplearning4j_tpu.chaos import hooks
+from deeplearning4j_tpu.chaos.hooks import FaultSpec
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import flight
+from deeplearning4j_tpu.serving import (
+    ClusterCoordinator,
+    ClusterError,
+    InferenceServer,
+    ModelRegistry,
+    ModelRouter,
+    RegistryError,
+    ServerDrainingError,
+    StaleEpochError,
+)
+from deeplearning4j_tpu.train.faults import save_checkpoint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(deeplearning4j_tpu.__file__)))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    """Same discipline as test_registry.py: the propagation tests build
+    several short-lived engines; drop their executables when done."""
+    yield
+    gc.collect()
+    jax.clear_caches()
+
+
+@pytest.fixture(autouse=True)
+def _nothing_armed():
+    hooks.reset()
+    yield
+    hooks.reset()
+
+
+N_IN, N_OUT = 4, 3
+
+
+def _net(seed: int = 7, hidden: int = 8) -> MultiLayerNetwork:
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed)
+        .list()
+        .layer(DenseLayer(n_out=hidden, activation="relu"))
+        .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                           loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _rows(n: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).standard_normal(
+        (n, N_IN)).astype(np.float32)
+
+
+def _publish(reg, name, seed=1, score=0.5, tmp=None):
+    path = save_checkpoint(_net(seed), str(tmp / f"ck_{name}_{seed}"))
+    return reg.publish(name, path, score=score)
+
+
+def _since():
+    return flight.default_flight_recorder().recorded_total
+
+
+def _kinds(seq0, kinds=None):
+    evs = [e for e in flight.default_flight_recorder().events()
+           if e["seq"] >= seq0]
+    if kinds is not None:
+        evs = [e for e in evs if e["kind"] in kinds]
+    return evs
+
+
+class _Clock:
+    """Injectable wall clock: claims, heartbeats, and staleness
+    judgment all read it, so lease-TTL expiry is a test-controlled
+    event instead of a sleep."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+class _Stats:
+    """Duck-typed per-version serving counters (the gate-record
+    protocol journal_gate / _MergedStats read)."""
+
+    def __init__(self, requests=0, errors=0, latency_sum=0.0, score=None,
+                 n_scores=0, gen_requests=0, gen_errors=0,
+                 gen_latency_sum=0.0):
+        self.requests = requests
+        self.errors = errors
+        self.latency_sum = latency_sum
+        self.score = score
+        self._n_scores = n_scores
+        self.gen_requests = gen_requests
+        self.gen_errors = gen_errors
+        self.gen_latency_sum = gen_latency_sum
+
+
+def _pair(tmp_path, clk, **kw):
+    d = str(tmp_path / "cluster")
+    a = ClusterCoordinator(d, "a", heartbeat_s=1.0, lease_ttl_s=5.0,
+                           clock=clk, **kw)
+    b = ClusterCoordinator(d, "b", heartbeat_s=1.0, lease_ttl_s=5.0,
+                           clock=clk, **kw)
+    a.heartbeat()
+    b.heartbeat()
+    a.refresh()
+    return a, b
+
+
+# ===========================================================================
+# the lease / epoch state machine
+# ===========================================================================
+class TestLeaseEpoch:
+    def test_claim_is_idempotent_and_fences_the_peer(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        seq0 = _since()
+        assert a.ensure_lease("m") is True
+        st = a.lease_state("m")
+        assert st["replica"] == "a" and st["epoch"] == 1
+        # re-ensuring while holding is a no-op, not a re-claim
+        assert a.ensure_lease("m") is True
+        assert a.lease_state("m")["epoch"] == 1
+        # a live holder cannot be displaced
+        assert b.ensure_lease("m") is False
+        with pytest.raises(StaleEpochError):
+            b.fence("m")
+        acquires = _kinds(seq0, {"lease_acquire"})
+        assert len(acquires) == 1 and acquires[0]["epoch"] == 1
+
+    def test_release_keeps_epoch_so_next_claim_fences_ex_holder(
+            self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        assert a.ensure_lease("m")
+        a.release("m")
+        st = a.lease_state("m")
+        assert st["replica"] is None and st["epoch"] == 1
+        # the next claim must use epoch+1 — the released holder is
+        # fenced out even though it stepped down cleanly
+        assert b.ensure_lease("m") is True
+        assert b.lease_state("m")["epoch"] == 2
+        with pytest.raises(StaleEpochError):
+            a.fence("m")
+        # ...and releasing a lease we no longer hold is stale too
+        with pytest.raises(StaleEpochError):
+            a.release("m")
+
+    def test_stale_holder_steal_records_and_fences(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        assert a.ensure_lease("m")
+        seq0 = _since()
+        clk.advance(6.0)  # past lease_ttl_s=5: a's heartbeat is stale
+        b.heartbeat()     # fresh beat + fold → a is judged lost
+        assert "a" in b.describe()["lost"]
+        assert b.ensure_lease("m") is True
+        assert b.lease_state("m")["epoch"] == 2
+        steals = _kinds(seq0, {"lease_steal"})
+        assert len(steals) == 1 and steals[0]["stolen_from"] == "a"
+        # the paused ex-holder's decision is REFUSED typed, never merged
+        with pytest.raises(StaleEpochError) as ei:
+            a.fence("m")
+        assert isinstance(ei.value, ClusterError)
+        assert isinstance(ei.value, RegistryError)
+        assert "stale decision refused" in str(ei.value)
+        refused = _kinds(seq0, {"stale_epoch_refused"})
+        assert len(refused) == 1
+        assert refused[0]["holder"] == "b" and refused[0]["epoch"] == 2
+
+    def test_same_epoch_tie_first_appended_wins(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        # split brain: both replicas computed "epoch 1 is free" and
+        # appended concurrently — journal append order IS the tiebreak
+        a._append({"kind": "lease_claim", "model": "m", "replica": "a",
+                   "epoch": 1, "ts": clk()})
+        b._append({"kind": "lease_claim", "model": "m", "replica": "b",
+                   "epoch": 1, "ts": clk()})
+        assert a.is_owner("m") is True
+        assert b.is_owner("m") is False
+        assert a.fence("m") == 1
+        with pytest.raises(StaleEpochError):
+            b.fence("m")
+
+
+# ===========================================================================
+# journal durability semantics
+# ===========================================================================
+class TestJournalDurability:
+    def test_torn_trailing_line_tolerated_then_repaired(self, tmp_path):
+        clk = _Clock()
+        d = str(tmp_path / "cluster")
+        a = ClusterCoordinator(d, "a", clock=clk)
+        a.heartbeat()
+        # a peer crashed mid-append: fragment with no newline
+        with open(a.journal_path, "ab") as f:
+            f.write(b'{"kind": "heartbeat", "replica": "ghost"')
+        # readers tolerate it (left un-consumed, nothing folded)
+        c = ClusterCoordinator(d, "rc", clock=clk)
+        c.refresh()
+        assert c.describe()["alive"] == ["a"]
+        # the next writer's append repairs the torn tail first
+        b = ClusterCoordinator(d, "b", clock=clk)
+        b.heartbeat()
+        assert sorted(b.describe()["alive"]) == ["a", "b"]
+        assert "ghost" not in b.describe()["alive"]
+        a.refresh()
+        assert sorted(a.describe()["alive"]) == ["a", "b"]
+
+    def test_corrupt_complete_line_refuses_typed(self, tmp_path):
+        clk = _Clock()
+        d = str(tmp_path / "cluster")
+        a = ClusterCoordinator(d, "a", clock=clk)
+        a.heartbeat()
+        # newline-terminated garbage is NOT crash truncation — it is
+        # external corruption, and folding past it would be a lie
+        with open(a.journal_path, "ab") as f:
+            f.write(b"@@not json@@\n")
+        c = ClusterCoordinator(d, "c", clock=clk)
+        with pytest.raises(ClusterError, match="corrupt cluster journal"):
+            c.refresh()
+
+
+# ===========================================================================
+# cluster-wide tenant quotas (the borrow protocol)
+# ===========================================================================
+class TestQuotaBorrow:
+    def test_borrow_idle_share_floor_under_saturation(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk, global_tenant_quota=9)
+        # peer reports 4 in flight for t: G - peer = 5 == fair share
+        b.heartbeat({"t": 4})
+        a.refresh()
+        assert a.tenant_budget("t") == 5
+        # a tenant the peer is idle on borrows the whole quota
+        assert a.tenant_budget("u") == 9
+        # peer goes idle on t → the share is borrowed back
+        b.heartbeat({})
+        a.refresh()
+        assert a.tenant_budget("t") == 9
+        # peer saturating → fair-share floor, never zero
+        b.heartbeat({"t": 9})
+        a.refresh()
+        assert a.tenant_budget("t") == 5
+
+    def test_lost_replica_share_rebalances(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk, global_tenant_quota=9)
+        b.heartbeat({"t": 4})
+        a.refresh()
+        assert a.tenant_budget("t") == 5
+        seq0 = _since()
+        clk.advance(6.0)   # b's heartbeat goes stale
+        a.heartbeat()
+        assert a.describe()["lost"] == ["b"]
+        # a lost replica's last report stops counting against us
+        assert a.tenant_budget("t") == 9
+        reb = _kinds(seq0, {"quota_rebalance"})
+        assert reb and reb[-1]["replicas"] == 1 and reb[-1]["share"] == 9
+
+
+# ===========================================================================
+# cross-replica gate aggregation
+# ===========================================================================
+class TestGateAggregation:
+    def test_merged_stats_sample_weighted_score(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        assert b.journal_gate("m", 2, "canary",
+                              _Stats(requests=10, errors=1,
+                                     latency_sum=1.0, score=0.4,
+                                     n_scores=4),
+                              urgent=True)
+        a.refresh()
+        ve = SimpleNamespace(version=2,
+                             stats=_Stats(requests=5, latency_sum=0.25,
+                                          score=0.2, n_scores=1))
+        m = a.merged_stats("m", ve)
+        assert m.requests == 15 and m.errors == 1
+        assert m.latency_sum == pytest.approx(1.25)
+        assert m.mean_latency() == pytest.approx(1.25 / 15)
+        # (0.2 * 1 + 0.4 * 4) / 5: one local observation, four remote
+        assert m.score == pytest.approx(0.36)
+        # this replica's OWN journaled record never double-counts
+        a.journal_gate("m", 2, "canary", _Stats(requests=7), urgent=True)
+        a.refresh()
+        assert a.merged_stats("m", ve).requests == 15
+
+    def test_peer_failures_are_ground_truth(self, tmp_path):
+        clk = _Clock()
+        a, b = _pair(tmp_path, clk)
+        b.journal_gate("m", 2, "canary",
+                       _Stats(requests=3, errors=1, gen_errors=2),
+                       urgent=True)
+        a.refresh()
+        assert a.peer_failures("m", 2) == 3
+        assert a.peer_failures("m", 1) == 0
+
+    def test_gate_throttle_and_urgent_bypass(self, tmp_path):
+        clk = _Clock()
+        a, _ = _pair(tmp_path, clk)
+        assert a.journal_gate("m", 1, "active", _Stats(requests=1)) is True
+        # within gate_interval_s: throttled (peers read the last record)
+        assert a.journal_gate("m", 1, "active", _Stats(requests=2)) is False
+        # an observed failure is ground truth: it bypasses the throttle
+        assert a.journal_gate("m", 1, "active", _Stats(requests=2, errors=1),
+                              urgent=True) is True
+
+
+# ===========================================================================
+# cluster-mode canary propagation (two live routers, one registry dir)
+# ===========================================================================
+def _tier(tmp_path, window_s):
+    regdir = str(tmp_path / "reg")
+    pub = ModelRegistry(regdir)
+    _publish(pub, "m", seed=1, score=0.5, tmp=tmp_path)
+    nodes = []
+    for rid in ("r1", "r2"):
+        coord = ClusterCoordinator(regdir, rid, heartbeat_s=0.1,
+                                   lease_ttl_s=5.0)
+        router = ModelRouter(ModelRegistry(regdir), batch_limit=4,
+                             max_wait_ms=1.0, canary_fraction=1.0,
+                             canary_window_s=window_s, refresh_s=0.05,
+                             cluster=coord)
+        router.managed("m")
+        coord.start(inflight_fn=router.tenant_inflight)
+        nodes.append((router, coord))
+    return pub, nodes
+
+
+def _drive(routers, seconds, done):
+    x = _rows(2)
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for r in routers:
+            try:
+                r.predict("m", x)
+            except Exception:  # noqa: BLE001 — injected canary faults
+                pass           # and rolled-back retries are the point
+        if done():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestClusterCanaryPropagation:
+    def test_rollback_propagates_with_exactly_one_journal_write(
+            self, tmp_path):
+        pub, nodes = _tier(tmp_path, window_s=60.0)
+        routers = [r for r, _ in nodes]
+        seq0 = _since()
+        try:
+            _publish(pub, "m", seed=2, score=0.45, tmp=tmp_path)
+
+            # both replicas must be serving a slice of the canary
+            # window BEFORE the regression starts — the peer's teardown
+            # path is the thing under test
+            def both_adopted():
+                return all(r.describe()["live"]["m"]["canary_version"] == 2
+                           for r in routers)
+
+            assert _drive(routers, 30.0, both_adopted), \
+                "canary window did not open on both replicas"
+
+            def rolled_back():
+                pub.refresh(force=True)
+                vr = pub.get("m")["versions"].get("2", {})
+                if vr.get("status") != "rolled_back":
+                    return False
+                return all(r.describe()["live"]["m"]["canary_version"]
+                           is None for r in routers)
+
+            spec = FaultSpec("registry.version_dispatch", mode="error",
+                             match={"role": "canary"}, times=None)
+            with hooks.armed(spec):
+                assert _drive(routers, 30.0, rolled_back), \
+                    "cluster-wide rollback did not converge"
+            for r in routers:
+                live = r.describe()["live"]["m"]
+                assert live["canary_version"] is None
+                assert live["active_version"] == 1
+            # exactly ONE replica journaled the verdict (the fenced
+            # holder); the other only applied it
+            assert len(_kinds(seq0, {"rollback"})) == 1
+            assert len(_kinds(seq0, {"cluster_rollback_applied"})) == 1
+        finally:
+            for r, c in nodes:
+                r.shutdown()
+                c.shutdown()
+
+    def test_promote_propagates_to_the_non_holder(self, tmp_path):
+        pub, nodes = _tier(tmp_path, window_s=0.6)
+        routers = [r for r, _ in nodes]
+        seq0 = _since()
+        try:
+            _publish(pub, "m", seed=2, score=0.45, tmp=tmp_path)
+
+            def promoted():
+                pub.refresh(force=True)
+                if pub.get("m").get("active_version") != 2:
+                    return False
+                return all(r.describe()["live"]["m"]["active_version"] == 2
+                           and r.describe()["live"]["m"]["canary_version"]
+                           is None for r in routers)
+
+            assert _drive(routers, 30.0, promoted), \
+                "cluster-wide promotion did not converge"
+            assert len(_kinds(seq0, {"promote"})) == 1
+            assert len(_kinds(seq0, {"cluster_promote_applied"})) == 1
+            # the new active serves on both replicas after the swap
+            for r in routers:
+                out, ver = r.predict("m", _rows(2))
+                assert ver == 2
+                assert np.asarray(out).shape == (2, N_OUT)
+        finally:
+            for r, c in nodes:
+                r.shutdown()
+                c.shutdown()
+
+
+# ===========================================================================
+# satellite: cli flight-dump merges the handoff across three rings
+# ===========================================================================
+_RING_A = textwrap.dedent("""\
+    import os, sys
+    regdir, ringdir = sys.argv[1], sys.argv[2]
+    from deeplearning4j_tpu.obs import flight
+    from deeplearning4j_tpu.serving.cluster import ClusterCoordinator
+    c = ClusterCoordinator(regdir, "ra", heartbeat_s=0.1, lease_ttl_s=0.4)
+    c.heartbeat()
+    assert c.ensure_lease("m")
+    flight.default_flight_recorder().dump(
+        path=os.path.join(ringdir, "flight_recorder_%d.json" % os.getpid()),
+        reason="drill")
+    print(os.getpid())
+    # exits WITHOUT releasing: the SIGKILL path — peers must steal
+""")
+
+_RING_B = textwrap.dedent("""\
+    import os, sys
+    regdir, ringdir = sys.argv[1], sys.argv[2]
+    from deeplearning4j_tpu.obs import flight
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.serving.cluster import ClusterCoordinator
+    c = ClusterCoordinator(regdir, "rb", heartbeat_s=0.1, lease_ttl_s=0.4)
+    c.heartbeat()                      # folds ra's stale heartbeat
+    assert "ra" in c.describe()["lost"]
+    assert c.ensure_lease("m")         # steal at epoch 2
+    assert c.lease_state("m")["epoch"] == 2
+    reg = ModelRegistry(regdir)
+    epoch = c.fence("m")               # the holder's decision, fenced
+    reg.rollback("m", 2, reason="peer-observed canary dispatch failures")
+    flight.record("rollback", model="m", version=2, active_version=1,
+                  epoch=epoch)
+    flight.default_flight_recorder().dump(
+        path=os.path.join(ringdir, "flight_recorder_%d.json" % os.getpid()),
+        reason="drill")
+    print(os.getpid())
+""")
+
+_RING_C = textwrap.dedent("""\
+    import os, sys
+    regdir, ringdir = sys.argv[1], sys.argv[2]
+    from deeplearning4j_tpu.obs import flight
+    from deeplearning4j_tpu.serving.cluster import ClusterCoordinator
+    c = ClusterCoordinator(regdir, "rc", heartbeat_s=0.1, lease_ttl_s=0.4)
+    c.heartbeat()
+    st = c.lease_state("m")
+    assert st["replica"] == "rb" and st["epoch"] == 2
+    flight.default_flight_recorder().dump(
+        path=os.path.join(ringdir, "flight_recorder_%d.json" % os.getpid()),
+        reason="drill")
+    print(os.getpid())
+""")
+
+
+class TestFlightDumpMergedHandoff:
+    def test_cli_merges_ordered_handoff_across_three_rings(
+            self, tmp_path, capsys):
+        regdir = str(tmp_path / "reg")
+        ringdir = str(tmp_path / "rings")
+        os.makedirs(ringdir)
+        pub = ModelRegistry(regdir)
+        _publish(pub, "m", seed=1, score=0.5, tmp=tmp_path)
+        _publish(pub, "m", seed=2, score=0.45, tmp=tmp_path)
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO_ROOT)
+
+        def run(script):
+            p = subprocess.run([sys.executable, "-c", script,
+                                regdir, ringdir],
+                               env=env, capture_output=True, text=True,
+                               timeout=120)
+            assert p.returncode == 0, p.stderr
+            return int(p.stdout.strip().splitlines()[-1])
+
+        pid_a = run(_RING_A)
+        time.sleep(0.6)  # > lease_ttl_s: ra's heartbeat goes stale
+        pid_b = run(_RING_B)
+        pid_c = run(_RING_C)
+        assert len({pid_a, pid_b, pid_c}) == 3
+
+        # the decision B fenced really landed in the registry
+        pub.refresh(force=True)
+        assert pub.get("m")["versions"]["2"]["status"] == "rolled_back"
+
+        from deeplearning4j_tpu.cli import main as cli_main
+
+        assert cli_main(["flight-dump", ringdir]) == 0
+        out = capsys.readouterr().out
+        assert "merged timeline: 3 rings" in out
+        for pid in (pid_a, pid_b, pid_c):
+            assert f"pid={pid}" in out
+        # the ordered handoff, across process boundaries
+        i_acq = out.index("lease_acquire")
+        i_lost = out.index("replica_lost")
+        i_steal = out.index("lease_steal")
+        i_rb = out.index("rollback")
+        assert i_acq < i_lost < i_steal < i_rb
+
+        # --json round-trips the merged body
+        assert cli_main(["flight-dump", "--json", ringdir]) == 0
+        body = json.loads(capsys.readouterr().out)
+        assert body["merged"] is True and len(body["sources"]) == 3
+        kinds = [e["kind"] for e in body["events"]]
+        for k in ("lease_acquire", "replica_lost", "lease_steal",
+                  "rollback"):
+            assert k in kinds
+
+
+# ===========================================================================
+# drain mode over HTTP (the front's re-homing signal)
+# ===========================================================================
+def _http(port, method, path, body=None, headers=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 None if body is None else json.dumps(body),
+                 headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, (json.loads(data) if data else {}), hdrs
+
+
+class TestDrainHTTP:
+    def test_drain_refuses_new_requests_typed(self, tmp_path):
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        _publish(reg, "m", tmp=tmp_path)
+        router = ModelRouter(reg, batch_limit=4, max_wait_ms=1.0)
+        server = InferenceServer(router=router, port=0).start()
+        try:
+            x = _rows(2).tolist()
+            st, body, _ = _http(server.port, "POST", "/models/m/predict",
+                                {"inputs": x})
+            assert st == 200
+            st, body, _ = _http(server.port, "POST", "/drain")
+            assert st == 200 and body["draining"] is True
+            # new work is refused typed with a Retry-After, so the
+            # front re-homes the session to a live replica
+            st, body, hdrs = _http(server.port, "POST",
+                                   "/models/m/predict", {"inputs": x})
+            assert st == 503 and body["error"] == "ServerDrainingError"
+            assert int(hdrs["Retry-After"]) >= 1
+            st, hz, _ = _http(server.port, "GET", "/healthz")
+            assert st == 200 and hz["draining"] is True
+            # idempotent: a supervisor's double-drain is harmless
+            st, body, _ = _http(server.port, "POST", "/drain")
+            assert st == 200 and body["draining"] is True
+            assert isinstance(ServerDrainingError("x"),
+                              Exception)  # exported typed surface
+        finally:
+            server.shutdown()

@@ -86,6 +86,34 @@ class TestDurabilityRules:
         assert len(fs) == 1
         assert fs[0].line == 2
 
+    def test_os_open_write_flags_on_durable_surface(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/serving/journal.py", """\
+            import os
+
+            def append(path, line):
+                fd = os.open(path, os.O_WRONLY | os.O_APPEND | os.O_CREAT)
+                try:
+                    os.write(fd, line)
+                finally:
+                    os.close(fd)
+            """, rule="durability-bypass-fslayer")
+        assert len(fs) == 1
+        assert fs[0].line == 4  # the os.open line, exactly
+        assert "os.open" in fs[0].message
+
+    def test_os_open_readonly_passes(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/serving/reader.py", """\
+            import os
+
+            def read(path):
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    return os.read(fd, 1 << 16)
+                finally:
+                    os.close(fd)
+            """, rule="durability-bypass-fslayer")
+        assert fs == []
+
     def test_reads_and_nondurable_dirs_pass(self, tmp_path):
         fs = findings_for(tmp_path, "pkg/serving/loader.py", """\
             def load(path):
