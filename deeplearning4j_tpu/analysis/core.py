@@ -98,6 +98,7 @@ def register_rule(rule_id: str, description: str):
 def _load_rules() -> None:
     # importing the rule modules populates RULES (idempotent)
     from deeplearning4j_tpu.analysis import (  # noqa: F401
+        rules_controller,
         rules_durability,
         rules_events,
         rules_trace,

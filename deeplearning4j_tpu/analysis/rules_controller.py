@@ -1,0 +1,134 @@
+"""Controller-verdict rule: every adaptive-capacity action site must
+record a flight event carrying the triggering verdict.
+
+The observe→act loop's auditability contract: when a controller turns a
+knob (deadline retune, bucket switch, slot scale, tenant demote/
+restore, model prewarm/evict), the flight ring must show *why* — the
+``controller_*`` event with a ``verdict=`` field next to the action.
+Without it, a postmortem sees the system reconfigure itself with no
+recorded cause, which is exactly the "self-driving with no black box"
+failure mode this repo's forensics discipline exists to prevent.
+
+Two checks:
+
+- A ``controller_*`` flight record without a ``verdict=`` kwarg is a
+  finding (the event exists but carries no cause).
+- A call to a controller *action method* (``set_max_wait_ms``,
+  ``retune_buckets``, ``scale_generation_slots``, ``demote_tenant``,
+  ``restore_tenant``, ``prewarm_model``, ``evict_model``) inside a
+  function that records NO verdict-carrying ``controller_*`` event is
+  a finding — unless the enclosing function *is* one of the action
+  methods (the definitions and their internal delegation are the
+  mechanism, not a policy decision) or is itself a ``controller_*``
+  helper by name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from deeplearning4j_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    register_rule,
+)
+from deeplearning4j_tpu.analysis.rules_events import (
+    _literal_first_arg,
+    _recv_matches,
+    _RECORDER_NAMES,
+)
+
+#: the controller actuation surface: calling any of these IS a capacity
+#: action, so the caller must attach its verdict
+ACTION_METHODS = frozenset({
+    "set_max_wait_ms", "retune_buckets", "scale_generation_slots",
+    "demote_tenant", "restore_tenant", "prewarm_model", "evict_model",
+})
+
+
+def _is_controller_record(call: ast.Call) -> bool:
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "record"
+            and _recv_matches(fn, _RECORDER_NAMES, "recorder")):
+        return False
+    kind = _literal_first_arg(call)
+    return kind is not None and kind.startswith("controller_")
+
+
+def _has_verdict_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg == "verdict" for kw in call.keywords
+               if kw.arg is not None)
+
+
+def _called_action(call: ast.Call):
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ACTION_METHODS:
+        return fn.attr
+    if isinstance(fn, ast.Name) and fn.id in ACTION_METHODS:
+        return fn.id
+    return None
+
+
+def _own_nodes(fn_node: ast.AST):
+    """Walk a function's OWN body: nested defs analyze as their own
+    scope (the outer loop visits them), and lambdas DEFER their call —
+    a ``lambda n: router.scale_generation_slots(model, n)`` is an
+    actuator being built, not an action being taken."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register_rule(
+    "controller-verdict-attached",
+    "adaptive-capacity action sites must record a controller_* flight "
+    "event with the triggering verdict attached (verdict= kwarg)")
+def check_controller_verdict(ctx: FileContext) -> Iterable[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # the action methods themselves (and controller_* helpers) are
+        # the mechanism — policy attribution is their CALLERS' job
+        if node.name in ACTION_METHODS \
+                or node.name.startswith("controller_"):
+            continue
+        records_verdict = False
+        bare_records: List[ast.Call] = []
+        action_calls: List[tuple] = []
+        for sub in _own_nodes(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if _is_controller_record(sub):
+                if _has_verdict_kwarg(sub):
+                    records_verdict = True
+                else:
+                    bare_records.append(sub)
+            else:
+                method = _called_action(sub)
+                if method is not None:
+                    action_calls.append((sub, method))
+        for call in bare_records:
+            kind = _literal_first_arg(call)
+            findings.append(ctx.finding(
+                "controller-verdict-attached", call,
+                f"controller flight event {kind!r} recorded without a "
+                "verdict= field — attach the triggering "
+                "HealthVerdict status so the forensics show WHY the "
+                "system reconfigured itself"))
+        if not records_verdict:
+            for call, method in action_calls:
+                findings.append(ctx.finding(
+                    "controller-verdict-attached", call,
+                    f"capacity action {method}() called in "
+                    f"{node.name}() with no verdict-carrying "
+                    "controller_* flight record in the same function "
+                    "— record the action with its triggering verdict "
+                    "(verdict=...) or route it through a controller"))
+    return findings

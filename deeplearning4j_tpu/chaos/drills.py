@@ -1433,6 +1433,131 @@ def drill_cluster_split_brain_appends(ctx: DrillContext):
 
 
 # ==========================================================================
+# loadgen drill: the observe→act loop under oscillating load
+# ==========================================================================
+@drill("serving", ["controller.act"],
+       expected_alerts=["serving_latency_slo_breach"])
+def drill_controller_oscillation(ctx: DrillContext):
+    """Load flip-flopping across the SLO hysteresis boundary: layered
+    flap suppression (alert hold-downs + controller cooldowns) bounds
+    controller actions, a demoted tenant is restored once the burn
+    stays quiet, and an injected actuator failure is contained by the
+    hub — the loop keeps ticking and the knob actuates next tick."""
+    from deeplearning4j_tpu.loadgen.controllers import (
+        ControllerHub,
+        DeadlineTuner,
+        TenantDemoter,
+    )
+    from deeplearning4j_tpu.serving.batcher import (
+        DynamicBatcher,
+        make_dispatcher,
+    )
+    from deeplearning4j_tpu.serving.engine import InferenceEngine
+    from deeplearning4j_tpu.serving.registry import (
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.train.faults import save_checkpoint
+
+    reg = ModelRegistry(ctx.path("reg"))
+    reg.publish("m", save_checkpoint(_net(), ctx.path("ck")), score=0.5)
+    router = ModelRouter(reg, refresh_s=30.0, max_wait_ms=1.0)
+    engine = InferenceEngine(_net())
+    batcher = DynamicBatcher(make_dispatcher(engine.infer),
+                             batch_limit=8, max_wait_ms=8.0)
+    # the drill drives the OBSERVED signal directly: this gauge is what
+    # the detection evaluator's latency rule reads, so flipping it IS
+    # flipping load across the hysteresis boundary, deterministically
+    p99 = ctx.alerts.registry.gauge("serving_latency_p99_ms",
+                                    "drill-driven latency signal")
+    tuner = DeadlineTuner(batcher, cooldown_s=10.0)
+    demoter = TenantDemoter(router, restore_after_s=15.0, cooldown_s=5.0)
+    hub = ControllerHub(ctx.alerts, [tuner, demoter])
+    rows = np.random.default_rng(0).standard_normal(
+        (1, N_IN)).astype(np.float32)
+    DT = 5.0  # injected-clock seconds per hub tick
+
+    def tick(p99_ms: float, spam: int = 0) -> None:
+        for _ in range(spam):
+            router.submit("m", rows, timeout=30,
+                          tenant="spammy").result(timeout=30)
+        router.submit("m", rows, timeout=30,
+                      tenant="steady").result(timeout=30)
+        p99.set(p99_ms)
+        ctx._alert_now += DT
+        hub.tick(ctx._alert_now)
+
+    try:
+        # phase 1 — sustained burn: the breach fires, the tuner sheds
+        # deadline, the demoter pins the dominating tenant
+        for _ in range(6):
+            tick(400.0, spam=3)
+        ctx.report.add("breach_fired",
+                       "serving_latency_slo_breach"
+                       in ctx.alerts.fired_names())
+        ctx.report.add("deadline_shrunk_under_breach",
+                       batcher.max_wait_s * 1e3 < tuner.initial_ms,
+                       f"max_wait_ms={batcher.max_wait_s * 1e3:.3f}")
+        ctx.report.add(
+            "abusive_tenant_demoted",
+            "spammy" in router.tenant_tiers
+            and bool(ctx.events(["controller_tenant_demote"])),
+            str(dict(router.tenant_tiers)))
+        # phase 2 — oscillation: flip the signal every tick; alert
+        # hold-downs + per-controller cooldowns must bound actions
+        for i in range(8):
+            tick(400.0 if i % 2 == 0 else 100.0, spam=3)
+        elapsed = ctx._alert_now
+        retunes = len(ctx.events(["controller_retune"]))
+        bound = int(elapsed / tuner.cooldown_s) + 1
+        ctx.report.add("flap_suppression_bounds_retunes",
+                       retunes <= bound,
+                       f"retunes={retunes} bound={bound} "
+                       f"elapsed_s={elapsed}")
+        demotes = len(ctx.events(["controller_tenant_demote"]))
+        ctx.report.add("no_demote_storm", demotes == 1,
+                       f"demotes={demotes}")
+        # phase 3 — the burn stops: the breach resolves, the demoted
+        # tenant is restored, the deadline relaxes off its floor
+        for _ in range(12):
+            tick(100.0)
+        ctx.report.add(
+            "tenant_restored_after_quiet",
+            "spammy" not in router.tenant_tiers
+            and bool(ctx.events(["controller_tenant_restore"])),
+            str(dict(router.tenant_tiers)))
+        ctx.report.add("deadline_relaxes_after_quiet",
+                       batcher.max_wait_s * 1e3 > tuner.min_wait_ms,
+                       f"max_wait_ms={batcher.max_wait_s * 1e3:.3f}")
+        # phase 4 — broken actuator: the injected failure at the
+        # actuation seam is contained; the hub keeps ticking and the
+        # SAME knob actuates on a later tick
+        errors0, actions0 = hub.errors, tuner.actions
+        plan = ChaosPlan([{"seam": "controller.act", "mode": "error",
+                           "match": {"controller": "deadline_tuner"},
+                           "times": 1}], name=ctx.name)
+        with plan.armed():
+            for _ in range(4):
+                tick(400.0, spam=3)
+        ctx.report.add("hub_contained_actuator_fault",
+                       hub.errors == errors0 + 1
+                       and bool(ctx.events(["chaos_inject"])),
+                       f"errors={hub.errors}")
+        ctx.report.add("loop_alive_after_fault",
+                       tuner.actions > actions0,
+                       f"actions={tuner.actions} before={actions0}")
+        invariants.check_typed_errors(ctx.report, ctx.errors)
+        invariants.check_event_order(
+            ctx.report, ctx.events(),
+            ["controller_retune", "controller_tenant_demote",
+             "controller_tenant_restore", "chaos_inject"])
+        p99.set(0.0)  # post-drill detection ticks see a quiet signal
+    finally:
+        batcher.shutdown(drain=False)
+        router.shutdown()
+
+
+# ==========================================================================
 # custom plans over stock workloads (cli chaos --plan)
 # ==========================================================================
 WORKLOADS = ("fit", "checkpoint_fit", "generate", "registry", "tune")

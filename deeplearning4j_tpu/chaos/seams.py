@@ -154,6 +154,11 @@ register_hook_seam(
     "a canary-controller decision about to be epoch-fence checked "
     "(mode 'delay' = the paused ex-holder: a peer steals the lease "
     "during the pause and the late decision must be refused typed)")
+register_hook_seam(
+    "controller.act", "loadgen",
+    "an adaptive-capacity controller about to actuate its knob "
+    "(controller/action ctx; mode 'error' = broken actuator — the "
+    "ControllerHub must contain it and keep ticking)")
 
 
 # --------------------------------------------------------------------------

@@ -197,6 +197,13 @@ def serve_main(argv) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="serve ONE local request through the HTTP stack, "
                          "print the result, shut down (CI gate)")
+    ap.add_argument("--controllers", action="store_true",
+                    help="with --smoke: arm the adaptive-capacity loop "
+                         "(loadgen ControllerHub + DeadlineTuner on a "
+                         "deliberately tight SLO) and replay a short "
+                         "compressed builtin load plan against the live "
+                         "server — passes only if a verdict-carrying "
+                         "controller_retune flight event fires")
     ap.add_argument("--cluster", action="store_true",
                     help="registry mode only: join the multi-replica "
                          "tier coordinated through the registry dir's "
@@ -360,6 +367,8 @@ def serve_main(argv) -> int:
         ok = resp.status == 200 and "outputs" in body
         print(f"smoke: HTTP {resp.status} "
               f"{'ok' if ok else body}", flush=True)
+        if ok and args.controllers:
+            ok = _smoke_controllers(args, server, engine, shape)
         server.shutdown()
         return 0 if ok else 1
     try:
@@ -368,6 +377,49 @@ def serve_main(argv) -> int:
         print("shutting down (draining queue)", flush=True)
         server.shutdown()
     return 0
+
+
+def _smoke_controllers(args, server, engine, shape) -> bool:
+    """``serve --smoke --controllers``: arm the observe→act loop
+    against the live server and replay a compressed builtin plan
+    through the real HTTP stack. The SLO target is deliberately tight
+    so real request latency breaches it — the DeadlineTuner must shed
+    the batcher deadline and record a verdict-carrying
+    ``controller_retune`` flight event, which is the pass criterion."""
+    from deeplearning4j_tpu.loadgen import (
+        BUILTIN_PLANS,
+        ControllerHub,
+        DeadlineTuner,
+        LoadRunner,
+        http_target,
+    )
+    from deeplearning4j_tpu.obs import flight as _flight
+    from deeplearning4j_tpu.obs.metrics import default_registry
+    from deeplearning4j_tpu.obs.slo import build_default_evaluator
+
+    stream = BUILTIN_PLANS["diurnal_flash"]().compile(duration_s=6.0)
+    evaluator = build_default_evaluator(
+        registry=default_registry(), latency_slo_ms=0.01)
+    hub = ControllerHub(evaluator, [
+        DeadlineTuner(server.batcher, engine=engine, cooldown_s=0.5)])
+    runner = LoadRunner(
+        stream, http_target(f"{args.host}:{server.port}", tuple(shape)),
+        compression=4.0, on_tick=hub.tick)
+    rec = _flight.default_flight_recorder()
+    seq0 = rec.recorded_total
+    report = runner.run()
+    retunes = [e for e in rec.events()
+               if e["seq"] >= seq0 and e["kind"] == "controller_retune"]
+    d = report.describe()
+    print(f"controllers: replayed {d['submitted']} requests "
+          f"(ok={report.ok()}, p99={d['p99_ms']}ms) -> "
+          f"{len(retunes)} retune(s), max_wait_ms="
+          f"{server.batcher.max_wait_s * 1e3:.3f}", flush=True)
+    for e in retunes[:3]:
+        print(f"  controller_retune: {e.get('action')} "
+              f"verdict={e.get('verdict')} alerts={e.get('alerts')}",
+              flush=True)
+    return report.ok() > 0 and bool(retunes)
 
 
 def _serve_registry(args) -> int:
@@ -940,6 +992,100 @@ def tune_main(argv) -> int:
     return 0
 
 
+def loadgen_main(argv) -> int:
+    """``cli loadgen``: compile a declarative load plan into its
+    deterministic request stream (identical seeds MUST replay identical
+    streams — the fingerprint printed here is the proof) and optionally
+    replay it against a live server under time compression."""
+    import json as _json
+    import textwrap
+
+    ap = argparse.ArgumentParser(
+        prog="deeplearning4j_tpu loadgen",
+        description="compile + replay declarative load plans "
+                    "(loadgen/plan.py)")
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--plan", default=None,
+                     help="load-plan JSON file (LoadPlan serde)")
+    src.add_argument("--builtin", default="diurnal_flash",
+                     help="builtin plan name (--list shows them)")
+    ap.add_argument("--list", action="store_true",
+                    help="list builtin plans and exit")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the plan's seed")
+    ap.add_argument("--duration-s", type=float, default=None,
+                    help="override the plan's simulated duration")
+    ap.add_argument("--tick-s", type=float, default=None,
+                    help="override the controller/alert tick spacing")
+    ap.add_argument("--compression", type=float, default=10.0,
+                    help="simulated seconds per wall second during "
+                         "--replay")
+    ap.add_argument("--compile-only", action="store_true",
+                    help="compile + fingerprint only, even when a "
+                         "--replay target is given (the determinism "
+                         "check in scripts/drive_loadgen.py)")
+    ap.add_argument("--replay", default=None, metavar="HOST:PORT",
+                    help="replay the stream against a live server's "
+                         "POST /predict")
+    ap.add_argument("--shape", default="4",
+                    help="comma-separated per-example feature shape "
+                         "for --replay payloads (must match the served "
+                         "model's input)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.loadgen import BUILTIN_PLANS, load_plan
+
+    if args.list:
+        for name, factory in sorted(BUILTIN_PLANS.items()):
+            print(f"--builtin {name}:")
+            print(textwrap.indent(factory().describe(), "  "))
+        return 0
+    try:
+        if args.plan is not None:
+            plan = load_plan(args.plan)
+        else:
+            if args.builtin not in BUILTIN_PLANS:
+                ap.error(f"unknown builtin {args.builtin!r} "
+                         f"(known: {sorted(BUILTIN_PLANS)})")
+            plan = BUILTIN_PLANS[args.builtin]()
+        if args.tick_s is not None:
+            plan.tick_s = float(args.tick_s)
+        stream = plan.compile(duration_s=args.duration_s, seed=args.seed)
+    except (ValueError, KeyError, OSError) as e:
+        print(f"loadgen: invalid plan: {e}", file=sys.stderr)
+        return 2
+    info = stream.describe()
+    if not args.json:
+        print(f"plan {info['plan']} seed={info['seed']}: "
+              f"{info['n_requests']} requests over "
+              f"{stream.plan.duration_s:g}s sim, tenants "
+              f"{info['tenants']}")
+        print(f"fingerprint: {info['fingerprint']}")
+    if args.replay is None or args.compile_only:
+        if args.json:
+            print(_json.dumps(info, indent=1, sort_keys=True))
+        return 0
+
+    from deeplearning4j_tpu.loadgen import LoadRunner, http_target
+
+    shape = tuple(int(s) for s in args.shape.split(","))
+    runner = LoadRunner(stream, http_target(args.replay, shape),
+                        compression=args.compression)
+    report = runner.run()
+    d = report.describe()
+    if args.json:
+        print(_json.dumps({"plan": info, "report": d}, indent=1,
+                          sort_keys=True))
+    else:
+        print(f"replayed {d['submitted']} requests in {d['wall_s']}s "
+              f"wall ({d['sim_s']}s sim): ok={report.ok()} "
+              f"p50={d['p50_ms']}ms p99={d['p99_ms']}ms")
+        print(f"outcomes: {d['outcomes']}")
+    return 0 if report.ok() > 0 else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv[:1] == ["serve"]:
@@ -954,6 +1100,8 @@ def main(argv=None) -> int:
         return chaos_main(argv[1:])
     if argv[:1] == ["lint"]:
         return lint_main(argv[1:])
+    if argv[:1] == ["loadgen"]:
+        return loadgen_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",

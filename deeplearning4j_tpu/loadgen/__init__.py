@@ -1,0 +1,59 @@
+"""Load generation + adaptive capacity: the observe→act loop.
+
+Two halves, built to close ROADMAP item 4:
+
+- **loadgen** proper: a seeded, declarative workload compiler
+  (:mod:`~.plan`) in the ChaosPlan JSON idiom — arrival processes
+  (diurnal curve, flash crowd, Poisson steady state), per-tenant
+  heavy-tail length mixes and adversarial patterns — compiled into a
+  deterministic request stream and replayed against the real serving
+  stack (:mod:`~.runner`) under time compression
+  (:mod:`~.clock`). Identical seeds replay identical streams
+  (fingerprint-asserted).
+- **adaptive capacity**: controllers (:mod:`~.controllers`) driven by
+  :class:`~deeplearning4j_tpu.obs.alerts.AlertEvaluator` verdicts that
+  retune batcher dispatch deadlines and bucket sets from observed
+  mixes, scale generation slots against the memory estimator, demote
+  abusive tenants, and pre-warm/evict registry models on predicted
+  load. Every action is a flight event carrying the triggering
+  verdict; flap suppression rides the alert engine's pending→firing→
+  resolved hysteresis plus per-controller cooldowns.
+"""
+
+from deeplearning4j_tpu.loadgen.clock import SimClock, VirtualClock
+from deeplearning4j_tpu.loadgen.controllers import (
+    CapacityController,
+    ControllerHub,
+    DeadlineTuner,
+    ModelPrewarmer,
+    SlotScaler,
+    TenantDemoter,
+)
+from deeplearning4j_tpu.loadgen.plan import (
+    BUILTIN_PLANS,
+    LoadPlan,
+    RequestStream,
+    SimRequest,
+    cluster_plan,
+    diurnal_flash_plan,
+    load_plan,
+)
+from deeplearning4j_tpu.loadgen.runner import (
+    LoadReport,
+    LoadRunner,
+    batcher_target,
+    front_target,
+    generation_target,
+    http_target,
+    router_target,
+)
+
+__all__ = [
+    "SimClock", "VirtualClock",
+    "LoadPlan", "RequestStream", "SimRequest", "load_plan",
+    "BUILTIN_PLANS", "diurnal_flash_plan", "cluster_plan",
+    "LoadRunner", "LoadReport", "batcher_target", "router_target",
+    "front_target", "generation_target", "http_target",
+    "ControllerHub", "CapacityController", "DeadlineTuner",
+    "SlotScaler", "TenantDemoter", "ModelPrewarmer",
+]

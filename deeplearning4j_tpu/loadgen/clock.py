@@ -1,0 +1,96 @@
+"""Injected clocks for time-compressed replay.
+
+The contract every consumer shares: a clock is a zero-arg callable
+returning **simulated seconds** (monotonic, starts near 0). The
+:class:`~deeplearning4j_tpu.obs.alerts.AlertEvaluator` already takes an
+injectable ``clock`` — hand it a :class:`SimClock` and every
+``window_s`` / ``for_s`` / ``resolve_s`` in the rule pack operates in
+simulated time, so a 60-second alert window elapses in one wall second
+at ``compression=60``. The :class:`~.runner.LoadRunner` paces request
+submission off the same clock: a request scheduled at sim ``t`` fires
+at wall ``t / compression``. That is how a diurnal day of traffic fits
+a bench's wall budget without changing a single rule threshold.
+
+Two implementations:
+
+- :class:`SimClock` — wall-driven: ``sim = (wall - anchor) *
+  compression``. Real replay against live servers.
+- :class:`VirtualClock` — manually advanced. Deterministic unit tests
+  and drills (the alert tests' fake-clock idiom, promoted to a class).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class VirtualClock:
+    """A clock that only moves when told to — the deterministic leg."""
+
+    def __init__(self, start_s: float = 0.0):
+        self._now = float(start_s)
+        self._lock = threading.Lock()
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    __call__ = now
+
+    def advance(self, seconds: float) -> float:
+        if seconds < 0:
+            raise ValueError(f"clocks only move forward, got {seconds}")
+        with self._lock:
+            self._now += float(seconds)
+            return self._now
+
+    def set(self, now_s: float) -> None:
+        with self._lock:
+            if now_s < self._now:
+                raise ValueError(
+                    f"clocks only move forward: {now_s} < {self._now}")
+            self._now = float(now_s)
+
+
+class SimClock:
+    """Wall-driven compressed clock: ``compression`` simulated seconds
+    elapse per wall second. ``sleep_until`` blocks the *wall* fraction
+    of the remaining simulated gap (interruptible via ``stop``), which
+    is the runner's pacing primitive."""
+
+    def __init__(self, compression: float = 1.0, start_s: float = 0.0,
+                 wall: Callable[[], float] = time.monotonic):
+        if compression <= 0:
+            raise ValueError(f"compression must be > 0, got {compression}")
+        self.compression = float(compression)
+        self.start_s = float(start_s)
+        self._wall = wall
+        self._anchor = wall()
+
+    def now(self) -> float:
+        return self.start_s + (self._wall() - self._anchor) * self.compression
+
+    __call__ = now
+
+    def wall_remaining(self, sim_t: float) -> float:
+        """Wall seconds until simulated time ``sim_t`` (<= 0 if past)."""
+        return (float(sim_t) - self.now()) / self.compression
+
+    def sleep_until(self, sim_t: float,
+                    stop: Optional[threading.Event] = None) -> bool:
+        """Block until the clock reaches simulated ``sim_t``. Returns
+        False if ``stop`` was set first (replay shutdown), else True."""
+        while True:
+            remaining = self.wall_remaining(sim_t)
+            if remaining <= 0:
+                return True
+            if stop is not None:
+                if stop.wait(min(remaining, 0.05)):
+                    return False
+            else:
+                time.sleep(min(remaining, 0.25))
+
+    def describe(self) -> dict:
+        return {"compression": self.compression, "sim_now": self.now()}

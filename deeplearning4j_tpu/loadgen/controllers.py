@@ -1,0 +1,470 @@
+"""Adaptive-capacity controllers: the *act* half of the observe→act
+loop.
+
+PR 15 gave the stack a pager — declarative alert rules with hysteresis
+feeding a :class:`~deeplearning4j_tpu.obs.alerts.HealthVerdict`. This
+module turns the pager into an autopilot. A
+:class:`ControllerHub` is pumped once per (simulated) tick: it ticks
+the evaluator, takes the verdict and the *currently firing* rule set,
+and offers both to each registered controller. Controllers own one
+knob each:
+
+=================  =========================================  =====================
+controller         knob                                       watches (defaults)
+=================  =========================================  =====================
+DeadlineTuner      batcher ``max_wait_ms`` + engine bucket    latency SLO breach,
+                   set (``retune_buckets``,                   queue saturation,
+                   pre-compile-before-switch)                 error-budget burn
+SlotScaler         generation slot count (fresh warmed slab,  overload rejections,
+                   sized against the memory estimator)        error-budget burn
+TenantDemoter      per-tenant quota tier                      burn + queue alerts
+ModelPrewarmer     registry admit/evict on *predicted* load   (forecast-driven)
+=================  =========================================  =====================
+
+Discipline shared by every controller:
+
+- **Flap suppression is layered**: the alert engine's pending→firing→
+  resolved hysteresis already debounces the *signal*; controllers add a
+  per-controller ``cooldown_s`` on *actions* and act at most once per
+  tick — a flip-flopping metric costs at most one action per cooldown
+  window, which the oscillation chaos drill asserts.
+- **Every action is a flight event carrying the triggering verdict**
+  (``verdict=`` + the watched alerts that fired). The
+  ``controller-verdict-attached`` lint rule makes this structural: an
+  action site without a verdict-carrying ``controller_*`` record fails
+  ``cli lint``.
+- **Every action crosses the ``controller.act`` chaos seam** before
+  touching the stack, so drills can inject failures exactly at the
+  actuation point; the hub contains controller exceptions (counted,
+  recorded) — a broken actuator must never take down the loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set
+
+from deeplearning4j_tpu.chaos import hooks as chaos_hooks
+from deeplearning4j_tpu.obs import flight as _flight
+
+
+class CapacityController:
+    """Base controller: cooldown bookkeeping + the actuation seam.
+
+    Subclasses implement ``tick(now, verdict, firing, hub)`` and call
+    :meth:`_act` immediately before touching their knob — it fires the
+    ``controller.act`` chaos seam (which may raise, aborting the
+    action) and stamps the cooldown. One action per tick, at most one
+    action per ``cooldown_s``."""
+
+    name = "controller"
+
+    def __init__(self, name: Optional[str] = None,
+                 cooldown_s: float = 5.0,
+                 watch: Sequence[str] = ()):
+        if name is not None:
+            self.name = str(name)
+        self.cooldown_s = float(cooldown_s)
+        self.watch: Set[str] = set(watch)
+        self.actions = 0
+        self._last_action_at: Optional[float] = None
+
+    def ready(self, now: float) -> bool:
+        return (self._last_action_at is None
+                or now - self._last_action_at >= self.cooldown_s)
+
+    def _act(self, now: float, action: str) -> None:
+        chaos_hooks.fire("controller.act", controller=self.name,
+                         action=action)
+        self._last_action_at = now
+        self.actions += 1
+
+    def tick(self, now: float, verdict, firing: Set[str],
+             hub: "ControllerHub") -> None:
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"name": self.name, "cooldown_s": self.cooldown_s,
+                "actions": self.actions, "watch": sorted(self.watch)}
+
+
+class ControllerHub:
+    """Pumps the evaluator and offers every verdict to every
+    controller. One ``tick(now)`` = one evaluator tick + one chance to
+    act per controller; wire it to the load runner's ``on_tick`` (or
+    any housekeeping cadence) and hand both the same clock the
+    evaluator uses, so alert windows and controller cooldowns share a
+    timeline under compression."""
+
+    def __init__(self, evaluator, controllers: Iterable[CapacityController],
+                 registry=None, clock: Optional[Callable[[], float]] = None):
+        self.evaluator = evaluator
+        self.controllers: List[CapacityController] = list(controllers)
+        #: obs MetricsRegistry for ``controller_actions_total``; falls
+        #: back to the evaluator's (they share one in every real wiring)
+        self.registry = (registry if registry is not None
+                         else getattr(evaluator, "registry", None))
+        self.clock = clock if clock is not None else getattr(
+            evaluator, "clock", None)
+        self.errors = 0
+        self.recent: deque = deque(maxlen=256)
+        self._lock = threading.Lock()
+
+    def note_action(self, controller: str, action: str, **fields) -> None:
+        """Controllers call this right after a successful actuation:
+        bumps the per-controller action counter (the
+        ``controller_action_storm`` alert input) and keeps a bounded
+        recent-actions log for ``describe()``/debugging."""
+        if self.registry is not None:
+            self.registry.counter(
+                "controller_actions_total",
+                "adaptive-capacity controller actions",
+                labels={"controller": controller}).inc()
+        with self._lock:
+            self.recent.append({"controller": controller,
+                                "action": action, **fields})
+
+    def tick(self, now: Optional[float] = None) -> "object":
+        if now is None and self.clock is not None:
+            now = self.clock()
+        self.evaluator.tick(now)
+        verdict = self.evaluator.verdict()
+        firing = {f["name"] for f in verdict.firing}
+        for c in self.controllers:
+            try:
+                c.tick(float(now), verdict, firing, self)
+            except Exception as e:  # noqa: BLE001 — a failed actuator
+                # (chaos-injected or real) must not break the loop;
+                # the next tick retries from fresh observations
+                self.errors += 1
+                with self._lock:
+                    self.recent.append({"controller": c.name,
+                                        "action": "error",
+                                        "error": type(e).__name__})
+        return verdict
+
+    def describe(self) -> dict:
+        with self._lock:
+            recent = list(self.recent)[-16:]
+        return {"controllers": [c.describe() for c in self.controllers],
+                "errors": self.errors, "recent": recent}
+
+
+class DeadlineTuner(CapacityController):
+    """Tunes the batcher's coalescing deadline, and — when traffic is
+    calm — learns a bucket set from the observed dispatch mix.
+
+    Breach (any watched alert firing): *shrink* ``max_wait_ms`` by
+    ``shrink`` (floor ``min_wait_ms``) — smaller batches, lower queue
+    latency, the cheapest lever under pressure. Clear: *relax* back
+    toward the configured deadline by ``relax`` per action (throughput
+    recovers once the SLO is safe). Also on clear, with at least
+    ``min_rows`` observed dispatches, compare
+    :func:`~deeplearning4j_tpu.serving.buckets.propose_buckets` over
+    the metrics rows-window against the engine's current bucket list;
+    a differing proposal goes through
+    :meth:`~deeplearning4j_tpu.serving.engine.InferenceEngine.retune_buckets`
+    — pre-compile-before-switch, so the learned bucket set lands with
+    zero steady-state retraces (bench-asserted)."""
+
+    name = "deadline_tuner"
+
+    def __init__(self, batcher, engine=None,
+                 min_wait_ms: float = 0.5, shrink: float = 0.5,
+                 relax: float = 1.5, min_rows: int = 64,
+                 cooldown_s: float = 5.0,
+                 watch: Sequence[str] = ("serving_latency_slo_breach",
+                                         "serving_queue_saturated",
+                                         "serving_error_budget_burn")):
+        super().__init__(cooldown_s=cooldown_s, watch=watch)
+        self.batcher = batcher
+        self.engine = engine
+        self.min_wait_ms = float(min_wait_ms)
+        self.shrink = float(shrink)
+        self.relax = float(relax)
+        self.min_rows = int(min_rows)
+        self.initial_ms = batcher.max_wait_s * 1e3
+
+    def _current_ms(self) -> float:
+        return self.batcher.max_wait_s * 1e3
+
+    def tick(self, now, verdict, firing, hub):
+        if not self.ready(now):
+            return
+        breached = sorted(firing & self.watch)
+        cur = self._current_ms()
+        if breached:
+            new_ms = max(cur * self.shrink, self.min_wait_ms)
+            if new_ms < cur:
+                self._act(now, "deadline_shrink")
+                applied = self.batcher.set_max_wait_ms(new_ms)
+                _flight.record("controller_retune",
+                               controller=self.name,
+                               action="deadline_shrink",
+                               max_wait_ms=round(applied, 3),
+                               previous_ms=round(cur, 3),
+                               verdict=verdict.status, alerts=breached)
+                hub.note_action(self.name, "deadline_shrink",
+                                max_wait_ms=round(applied, 3))
+            return
+        if cur < self.initial_ms:
+            new_ms = min(cur * self.relax, self.initial_ms)
+            self._act(now, "deadline_relax")
+            applied = self.batcher.set_max_wait_ms(new_ms)
+            _flight.record("controller_retune", controller=self.name,
+                           action="deadline_relax",
+                           max_wait_ms=round(applied, 3),
+                           previous_ms=round(cur, 3),
+                           verdict=verdict.status, alerts=[])
+            hub.note_action(self.name, "deadline_relax",
+                            max_wait_ms=round(applied, 3))
+            return
+        self._maybe_retune_buckets(now, verdict, hub)
+
+    def _maybe_retune_buckets(self, now, verdict, hub):
+        from deeplearning4j_tpu.serving.buckets import (
+            BucketPolicy,
+            propose_buckets,
+        )
+
+        if self.engine is None:
+            return
+        metrics = self.engine.metrics
+        rows = metrics.dispatch_rows_window()
+        if len(rows) < self.min_rows:
+            return
+        max_batch = self.engine.buckets.batch_buckets[-1]
+        proposal = propose_buckets(rows, max_batch)
+        if proposal == list(self.engine.buckets.batch_buckets):
+            return
+        self._act(now, "bucket_retune")
+        report = self.engine.retune_buckets(
+            BucketPolicy(batch_buckets=proposal,
+                         seq_buckets=self.engine.buckets.seq_buckets))
+        _flight.record("controller_retune", controller=self.name,
+                       action="bucket_retune",
+                       buckets=report["buckets"],
+                       compiles=report["compiles"],
+                       warm_s=report["seconds"],
+                       verdict=verdict.status, alerts=[])
+        hub.note_action(self.name, "bucket_retune",
+                        buckets=report["buckets"])
+
+
+class SlotScaler(CapacityController):
+    """Scales the generation slab's slot count against demand and the
+    memory estimator. Watched alerts firing ⇒ double the slots (cap
+    ``max_slots``, and only if
+    :func:`~deeplearning4j_tpu.serving.generate.generation_memory_report`
+    says the grown slab fits ``memory_limit_bytes``); watched alerts
+    quiet for ``idle_for_s`` ⇒ halve (floor ``min_slots``). The
+    ``apply`` callable does the actual resize and returns
+    ``{slots, previous, changed}`` —
+    :meth:`~deeplearning4j_tpu.serving.registry.ModelRouter.scale_generation_slots`
+    via :meth:`for_router`, or any test double."""
+
+    name = "slot_scaler"
+
+    def __init__(self, apply: Callable[[int], dict], slots: int,
+                 base_model=None, max_length: Optional[int] = None,
+                 min_slots: int = 1, max_slots: int = 16,
+                 memory_limit_bytes: Optional[int] = None,
+                 idle_for_s: float = 30.0, cooldown_s: float = 10.0,
+                 watch: Sequence[str] = ("overload_rejections",
+                                         "serving_error_budget_burn",
+                                         "serving_queue_saturated")):
+        super().__init__(cooldown_s=cooldown_s, watch=watch)
+        self.apply = apply
+        self.slots = int(slots)
+        self.base_model = base_model
+        self.max_length = max_length
+        self.min_slots = max(int(min_slots), 1)
+        self.max_slots = max(int(max_slots), self.min_slots)
+        self.memory_limit_bytes = memory_limit_bytes
+        self.idle_for_s = float(idle_for_s)
+        self._last_breach_at: Optional[float] = None
+
+    @classmethod
+    def for_router(cls, router, model: str, **kwargs) -> "SlotScaler":
+        mm_gen = router.generation_for(model)
+        kwargs.setdefault("slots", mm_gen.n_slots)
+        kwargs.setdefault("base_model", getattr(mm_gen, "model", None))
+        kwargs.setdefault("max_length", router.gen_max_length)
+        return cls(lambda n: router.scale_generation_slots(model, n),
+                   **kwargs)
+
+    def _fits(self, n_slots: int) -> bool:
+        if self.memory_limit_bytes is None or self.base_model is None:
+            return True
+        from deeplearning4j_tpu.serving.generate import (
+            generation_memory_report,
+        )
+
+        report = generation_memory_report(self.base_model, n_slots,
+                                          max_length=self.max_length)
+        return report["total_bytes"] <= self.memory_limit_bytes
+
+    def tick(self, now, verdict, firing, hub):
+        breached = sorted(firing & self.watch)
+        if breached:
+            self._last_breach_at = now
+        if not self.ready(now):
+            return
+        if breached and self.slots < self.max_slots:
+            target = min(self.slots * 2, self.max_slots)
+            if not self._fits(target):
+                return
+            self._act(now, "scale_up")
+            report = self.apply(target)
+            self.slots = int(report.get("slots", target))
+            _flight.record("controller_slot_scale", controller=self.name,
+                           action="scale_up", slots=self.slots,
+                           previous=report.get("previous"),
+                           verdict=verdict.status, alerts=breached)
+            hub.note_action(self.name, "scale_up", slots=self.slots)
+            return
+        idle = (self._last_breach_at is None
+                or now - self._last_breach_at >= self.idle_for_s)
+        if not breached and idle and self.slots > self.min_slots:
+            target = max(self.slots // 2, self.min_slots)
+            self._act(now, "scale_down")
+            report = self.apply(target)
+            self.slots = int(report.get("slots", target))
+            _flight.record("controller_slot_scale", controller=self.name,
+                           action="scale_down", slots=self.slots,
+                           previous=report.get("previous"),
+                           verdict=verdict.status, alerts=[])
+            hub.note_action(self.name, "scale_down", slots=self.slots)
+
+
+class TenantDemoter(CapacityController):
+    """Demotes the tenant dominating accepted traffic while burn-class
+    alerts fire, restores once the burn stays quiet.
+
+    Abuse signal: per-tick delta of the router's
+    ``serving_tenant_requests_total`` family. While a watched alert
+    fires and one tenant holds ≥ ``abuse_share`` of the tick's accepted
+    requests, that tenant drops to ``demoted_quota`` in-flight via
+    :meth:`~deeplearning4j_tpu.serving.registry.ModelRouter.demote_tenant`
+    (its excess turns into typed ``TenantQuotaExceededError`` — other
+    tenants' latency recovers). After ``restore_after_s`` with no
+    watched alert, demotions lift one per tick (oldest first) — the
+    drill asserts a demoted tenant comes back once the burn stops."""
+
+    name = "tenant_demoter"
+
+    def __init__(self, router, demoted_quota: int = 1,
+                 abuse_share: float = 0.5, restore_after_s: float = 30.0,
+                 cooldown_s: float = 5.0,
+                 watch: Sequence[str] = ("serving_error_budget_burn",
+                                         "serving_queue_saturated",
+                                         "serving_latency_slo_breach")):
+        super().__init__(cooldown_s=cooldown_s, watch=watch)
+        self.router = router
+        self.demoted_quota = max(int(demoted_quota), 1)
+        self.abuse_share = float(abuse_share)
+        self.restore_after_s = float(restore_after_s)
+        self.demoted: "deque[str]" = deque()
+        self._last: Dict[str, int] = {}
+        self._last_burn_at: Optional[float] = None
+
+    def _tick_counts(self) -> Dict[str, int]:
+        fam = self.router.metrics.registry.family_values(
+            "serving_tenant_requests_total")
+        counts = {label.split("=", 1)[1]: int(v)
+                  for label, v in fam.items()}
+        delta = {t: c - self._last.get(t, 0) for t, c in counts.items()
+                 if c - self._last.get(t, 0) > 0}
+        self._last = counts
+        return delta
+
+    def tick(self, now, verdict, firing, hub):
+        delta = self._tick_counts()
+        breached = sorted(firing & self.watch)
+        if breached:
+            self._last_burn_at = now
+        if not self.ready(now):
+            return
+        if breached and delta:
+            total = sum(delta.values())
+            top = max(delta, key=delta.get)
+            if (delta[top] / total >= self.abuse_share
+                    and top not in self.demoted):
+                self._act(now, "demote")
+                self.router.demote_tenant(top, self.demoted_quota)
+                self.demoted.append(top)
+                _flight.record("controller_tenant_demote",
+                               controller=self.name, tenant=top,
+                               quota=self.demoted_quota,
+                               share=round(delta[top] / total, 3),
+                               verdict=verdict.status, alerts=breached)
+                hub.note_action(self.name, "demote", tenant=top)
+            return
+        quiet = (self._last_burn_at is None
+                 or now - self._last_burn_at >= self.restore_after_s)
+        if not breached and quiet and self.demoted:
+            tenant = self.demoted.popleft()
+            self._act(now, "restore")
+            self.router.restore_tenant(tenant)
+            _flight.record("controller_tenant_restore",
+                           controller=self.name, tenant=tenant,
+                           verdict=verdict.status, alerts=[])
+            hub.note_action(self.name, "restore", tenant=tenant)
+
+
+class ModelPrewarmer(CapacityController):
+    """Acts on *predicted* (not observed) load: admit-and-warm a model
+    before its traffic lands, evict it when the forecast says idle.
+
+    ``forecast(t)`` returns model → predicted requests/sec at sim time
+    ``t`` — a plan-derived callable in the bench/drive wiring
+    (:meth:`~deeplearning4j_tpu.loadgen.plan.LoadPlan.forecast` split
+    by the plan's model list), a trend extrapolation in production.
+    Predicted ≥ ``warm_rps`` at ``now + lead_s`` and not live ⇒
+    :meth:`prewarm_model` (the first real request then hits compiled
+    buckets instead of paying the XLA warmup). Predicted < ``warm_rps``
+    AND live-idle ≥ ``evict_idle_s`` ⇒ :meth:`evict_model` (refused
+    while a canary window is open — the router decides)."""
+
+    name = "model_prewarmer"
+
+    def __init__(self, router,
+                 forecast: Callable[[float], Dict[str, float]],
+                 warm_rps: float = 1.0, lead_s: float = 5.0,
+                 evict_idle_s: float = 60.0, cooldown_s: float = 5.0,
+                 watch: Sequence[str] = ()):
+        super().__init__(cooldown_s=cooldown_s, watch=watch)
+        self.router = router
+        self.forecast = forecast
+        self.warm_rps = float(warm_rps)
+        self.lead_s = float(lead_s)
+        self.evict_idle_s = float(evict_idle_s)
+
+    def tick(self, now, verdict, firing, hub):
+        if not self.ready(now):
+            return
+        predicted = self.forecast(now + self.lead_s) or {}
+        live = set(self.router.live_models())
+        for model, rps in sorted(predicted.items()):
+            if rps >= self.warm_rps and model not in live:
+                self._act(now, "prewarm")
+                version = self.router.prewarm_model(model)
+                _flight.record("controller_prewarm", controller=self.name,
+                               model=model, version=version,
+                               predicted_rps=round(float(rps), 3),
+                               verdict=verdict.status, alerts=[])
+                hub.note_action(self.name, "prewarm", model=model)
+                return
+        for model in sorted(live):
+            idle = self.router.model_idle_s(model)
+            if (predicted.get(model, 0.0) < self.warm_rps
+                    and idle is not None and idle >= self.evict_idle_s):
+                self._act(now, "evict")
+                if self.router.evict_model(model):
+                    _flight.record("controller_evict",
+                                   controller=self.name, model=model,
+                                   idle_s=round(idle, 3),
+                                   verdict=verdict.status, alerts=[])
+                    hub.note_action(self.name, "evict", model=model)
+                return

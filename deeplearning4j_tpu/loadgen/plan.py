@@ -1,0 +1,453 @@
+"""Declarative, seeded workload plans — the ChaosPlan idiom applied to
+traffic.
+
+A plan is plain JSON::
+
+    {
+      "name": "diurnal-flash",
+      "seed": 7,
+      "duration_s": 60.0,
+      "tick_s": 1.0,
+      "arrivals": [
+        {"process": "diurnal", "rps_base": 2.0, "rps_peak": 30.0,
+         "period_s": 60.0},
+        {"process": "flash_crowd", "at_s": 30.0, "rps_peak": 120.0,
+         "ramp_s": 3.0, "hold_s": 6.0, "decay_s": 5.0},
+        {"process": "poisson", "rps": 4.0}
+      ],
+      "tenants": [
+        {"name": "interactive", "weight": 4, "kind": "predict",
+         "rows": {"dist": "lognormal", "median": 2, "sigma": 0.8,
+                  "max": 16}},
+        {"name": "chat", "weight": 2, "kind": "generate",
+         "prompt_len": {"dist": "lognormal", "median": 8, "sigma": 1.0,
+                        "max": 48},
+         "max_new": {"dist": "lognormal", "median": 6, "sigma": 0.7,
+                     "max": 32}},
+        {"name": "spam", "weight": 1, "adversarial": "one_token_spam"},
+        {"name": "flood", "weight": 1, "adversarial": "deadline_flood"}
+      ]
+    }
+
+``compile()`` turns the plan into a :class:`RequestStream`: for each
+arrival process, simulated time advances in ``tick_s`` steps, the
+process's rate curve gives the tick's expected arrivals, a Poisson draw
+gives the count, and each request gets a uniform offset inside the
+tick, a weighted tenant, and lengths sampled from that tenant's
+heavy-tail mix. Every random draw comes from a per-arrival
+``random.Random(f"{seed}:arrival:{i}")`` — the ChaosPlan per-fault RNG
+discipline — so **identical seeds compile identical streams**, byte for
+byte (:meth:`RequestStream.fingerprint` is the replay-identity oracle
+the bench asserts).
+
+Adversarial tenant patterns (the abuse the quota/controller layer must
+absorb):
+
+- ``one_token_spam``: generate requests with ``max_new=1`` — pure
+  slot-claim churn, prefill cost with no decode amortization.
+- ``deadline_flood``: requests carrying a ~1ms deadline — dead on
+  arrival under any real dispatch, designed to burn the error budget.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import random
+from typing import Callable, Dict, List, Optional, Sequence
+
+_PROCESSES = ("poisson", "diurnal", "flash_crowd")
+_ADVERSARIAL = ("one_token_spam", "deadline_flood")
+_KINDS = ("predict", "generate")
+
+
+class SimRequest:
+    """One compiled request: when, who, what shape."""
+
+    __slots__ = ("t", "rid", "tenant", "kind", "rows", "prompt_len",
+                 "max_new", "deadline_ms", "model")
+
+    def __init__(self, t: float, rid: int, tenant: str, kind: str,
+                 rows: int = 1, prompt_len: int = 1, max_new: int = 1,
+                 deadline_ms: Optional[float] = None,
+                 model: Optional[str] = None):
+        self.t = float(t)
+        self.rid = int(rid)
+        self.tenant = str(tenant)
+        self.kind = str(kind)
+        self.rows = int(rows)
+        self.prompt_len = int(prompt_len)
+        self.max_new = int(max_new)
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+        self.model = model
+
+    def key(self) -> str:
+        """Canonical identity line — what the stream fingerprint hashes."""
+        return (f"{self.t:.6f}|{self.tenant}|{self.kind}|{self.rows}|"
+                f"{self.prompt_len}|{self.max_new}|"
+                f"{'' if self.deadline_ms is None else self.deadline_ms:}|"
+                f"{self.model or ''}")
+
+    def to_dict(self) -> dict:
+        return {"t": round(self.t, 6), "rid": self.rid,
+                "tenant": self.tenant, "kind": self.kind,
+                "rows": self.rows, "prompt_len": self.prompt_len,
+                "max_new": self.max_new, "deadline_ms": self.deadline_ms,
+                "model": self.model}
+
+    def __repr__(self):
+        return f"SimRequest({self.key()})"
+
+
+class RequestStream:
+    """The compiled, time-ordered request sequence plus its identity."""
+
+    def __init__(self, plan: "LoadPlan", requests: List[SimRequest]):
+        self.plan = plan
+        self.requests = requests
+
+    def __len__(self):
+        return len(self.requests)
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def fingerprint(self) -> str:
+        """sha256 over every request's canonical line — two streams are
+        the same replay iff their fingerprints match."""
+        h = hashlib.sha256()
+        h.update(f"{self.plan.name}:{self.plan.seed}\n".encode())
+        for r in self.requests:
+            h.update(r.key().encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+    def duration_s(self) -> float:
+        return self.requests[-1].t if self.requests else 0.0
+
+    def tenant_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.requests:
+            out[r.tenant] = out.get(r.tenant, 0) + 1
+        return out
+
+    def describe(self) -> dict:
+        return {"plan": self.plan.name, "seed": self.plan.seed,
+                "n_requests": len(self.requests),
+                "duration_s": round(self.duration_s(), 3),
+                "fingerprint": self.fingerprint(),
+                "tenants": self.tenant_counts()}
+
+
+# --------------------------------------------------------------------------
+# sampling helpers (all draws go through the per-arrival rng)
+# --------------------------------------------------------------------------
+def _poisson(rng: random.Random, lam: float) -> int:
+    if lam <= 0:
+        return 0
+    if lam > 30.0:
+        # normal approximation keeps big ticks O(1) instead of O(lam)
+        return max(0, int(round(rng.gauss(lam, math.sqrt(lam)))))
+    limit = math.exp(-lam)
+    k, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return k
+        k += 1
+
+
+def _sample_len(rng: random.Random, spec: Optional[dict],
+                default: int = 1) -> int:
+    if not spec:
+        return default
+    dist = spec.get("dist", "const")
+    lo = int(spec.get("min", 1))
+    hi = int(spec.get("max", 1 << 16))
+    if dist == "const":
+        v = int(spec.get("value", default))
+    elif dist == "uniform":
+        v = rng.randint(lo, max(hi, lo))
+        return v
+    elif dist == "lognormal":
+        # heavy tail with an interpretable knob: median in units,
+        # sigma the log-space spread
+        median = float(spec.get("median", default))
+        sigma = float(spec.get("sigma", 1.0))
+        v = int(round(rng.lognormvariate(math.log(max(median, 1e-9)),
+                                         sigma)))
+    else:
+        raise ValueError(f"unknown length dist {dist!r} "
+                         "(known: const, uniform, lognormal)")
+    return min(max(v, lo), hi)
+
+
+def _rate_at(arrival: dict, t: float) -> float:
+    """The arrival process's instantaneous requests/sec at sim ``t``."""
+    p = arrival["process"]
+    if p == "poisson":
+        return float(arrival.get("rps", 1.0))
+    if p == "diurnal":
+        base = float(arrival.get("rps_base", 0.0))
+        peak = float(arrival.get("rps_peak", base))
+        period = float(arrival.get("period_s", 86400.0))
+        phase = float(arrival.get("phase_s", 0.0))
+        # smooth day curve: trough at t=0 (+phase), crest mid-period
+        frac = 0.5 * (1.0 - math.cos(2.0 * math.pi * (t + phase) / period))
+        return base + (peak - base) * frac
+    if p == "flash_crowd":
+        at = float(arrival.get("at_s", 0.0))
+        ramp = max(float(arrival.get("ramp_s", 1.0)), 1e-9)
+        hold = float(arrival.get("hold_s", 0.0))
+        decay = max(float(arrival.get("decay_s", 1.0)), 1e-9)
+        peak = float(arrival.get("rps_peak", 1.0))
+        if t < at or t > at + ramp + hold + decay:
+            return 0.0
+        if t < at + ramp:
+            return peak * (t - at) / ramp
+        if t <= at + ramp + hold:
+            return peak
+        return peak * (1.0 - (t - at - ramp - hold) / decay)
+    raise ValueError(f"unknown arrival process {p!r}")
+
+
+class LoadPlan:
+    """One declarative workload: arrivals × tenants, seeded."""
+
+    def __init__(self, arrivals: List[dict], tenants: List[dict],
+                 name: str = "", seed: int = 0,
+                 duration_s: float = 60.0, tick_s: float = 1.0,
+                 models: Optional[Sequence[str]] = None):
+        self.name = str(name)
+        self.seed = int(seed)
+        self.duration_s = float(duration_s)
+        self.tick_s = float(tick_s)
+        if self.tick_s <= 0 or self.duration_s <= 0:
+            raise ValueError("duration_s and tick_s must be > 0")
+        self.arrivals = [dict(a) for a in arrivals]
+        self.tenants = [dict(t) for t in tenants]
+        self.models = list(models) if models else []
+        if not self.arrivals:
+            raise ValueError("a plan needs at least one arrival process")
+        if not self.tenants:
+            raise ValueError("a plan needs at least one tenant")
+        for i, a in enumerate(self.arrivals):
+            if a.get("process") not in _PROCESSES:
+                raise ValueError(
+                    f"arrival {i}: unknown process {a.get('process')!r} "
+                    f"(known: {_PROCESSES})")
+        for i, t in enumerate(self.tenants):
+            if "name" not in t:
+                raise ValueError(f"tenant {i} has no 'name'")
+            if float(t.get("weight", 1.0)) <= 0:
+                raise ValueError(f"tenant {t['name']!r}: weight must be > 0")
+            adv = t.get("adversarial")
+            if adv is not None and adv not in _ADVERSARIAL:
+                raise ValueError(
+                    f"tenant {t['name']!r}: unknown adversarial pattern "
+                    f"{adv!r} (known: {_ADVERSARIAL})")
+            kind = t.get("kind", "generate" if adv == "one_token_spam"
+                         else "predict")
+            if kind not in _KINDS:
+                raise ValueError(f"tenant {t['name']!r}: unknown kind "
+                                 f"{kind!r} (known: {_KINDS})")
+            t["kind"] = kind
+
+    # -- serde (the ChaosPlan surface) --------------------------------------
+    def to_dict(self) -> dict:
+        out = {"name": self.name, "seed": self.seed,
+               "duration_s": self.duration_s, "tick_s": self.tick_s,
+               "arrivals": [dict(a) for a in self.arrivals],
+               "tenants": [dict(t) for t in self.tenants]}
+        if self.models:
+            out["models"] = list(self.models)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=1, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LoadPlan":
+        return cls(d.get("arrivals", []), d.get("tenants", []),
+                   name=d.get("name", ""), seed=d.get("seed", 0),
+                   duration_s=d.get("duration_s", 60.0),
+                   tick_s=d.get("tick_s", 1.0),
+                   models=d.get("models"))
+
+    @classmethod
+    def from_json(cls, s: str) -> "LoadPlan":
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_file(cls, path: str) -> "LoadPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # -- compilation ---------------------------------------------------------
+    def compile(self, duration_s: Optional[float] = None,
+                seed: Optional[int] = None) -> RequestStream:
+        """Deterministically expand the plan into a time-ordered
+        request stream. ``duration_s`` / ``seed`` override the plan's
+        own (the bench's same-seed / different-seed legs)."""
+        duration = self.duration_s if duration_s is None else float(
+            duration_s)
+        seed = self.seed if seed is None else int(seed)
+        weights = [float(t.get("weight", 1.0)) for t in self.tenants]
+        total_w = sum(weights)
+        cum = []
+        acc = 0.0
+        for w in weights:
+            acc += w / total_w
+            cum.append(acc)
+        requests: List[SimRequest] = []
+        for i, arrival in enumerate(self.arrivals):
+            rng = random.Random(f"{seed}:arrival:{i}")
+            t = 0.0
+            while t < duration:
+                tick = min(self.tick_s, duration - t)
+                lam = _rate_at(arrival, t + 0.5 * tick) * tick
+                for _ in range(_poisson(rng, lam)):
+                    at = t + rng.random() * tick
+                    u = rng.random()
+                    ti = next(j for j, c in enumerate(cum) if u <= c)
+                    requests.append(self._make_request(rng, at,
+                                                       self.tenants[ti]))
+                t += tick
+        requests.sort(key=lambda r: (r.t, r.tenant, r.rows, r.prompt_len))
+        for rid, r in enumerate(requests):
+            r.rid = rid
+        plan = self
+        if seed != self.seed or duration != self.duration_s:
+            # the stream's identity must carry the EFFECTIVE seed and
+            # duration — a fingerprint that mixes in the overridden
+            # plan's values would let two different replays collide
+            plan = LoadPlan(self.arrivals, self.tenants, name=self.name,
+                            seed=seed, duration_s=duration,
+                            tick_s=self.tick_s, models=self.models)
+        return RequestStream(plan, requests)
+
+    def _make_request(self, rng: random.Random, t: float,
+                      tenant: dict) -> SimRequest:
+        adv = tenant.get("adversarial")
+        model = None
+        if self.models:
+            model = self.models[rng.randrange(len(self.models))]
+        if adv == "one_token_spam":
+            return SimRequest(t, 0, tenant["name"], "generate",
+                              rows=1,
+                              prompt_len=_sample_len(
+                                  rng, tenant.get("prompt_len"), 2),
+                              max_new=1, model=model)
+        deadline = tenant.get("deadline_ms")
+        if adv == "deadline_flood":
+            deadline = float(tenant.get("deadline_ms", 1.0))
+        kind = tenant["kind"]
+        if kind == "generate":
+            return SimRequest(t, 0, tenant["name"], "generate",
+                              rows=1,
+                              prompt_len=_sample_len(
+                                  rng, tenant.get("prompt_len"), 4),
+                              max_new=_sample_len(
+                                  rng, tenant.get("max_new"), 4),
+                              deadline_ms=deadline, model=model)
+        return SimRequest(t, 0, tenant["name"], "predict",
+                          rows=_sample_len(rng, tenant.get("rows"), 1),
+                          deadline_ms=deadline, model=model)
+
+    def forecast(self, t: float) -> float:
+        """Declared (not observed) total requests/sec at sim ``t`` —
+        the predictive signal :class:`~.controllers.ModelPrewarmer`
+        can act on before the load materializes."""
+        return sum(_rate_at(a, float(t)) for a in self.arrivals)
+
+    def describe(self) -> str:
+        lines = [f"load plan {self.name or '<unnamed>'} "
+                 f"(seed={self.seed}, {self.duration_s:g}s sim, "
+                 f"{len(self.arrivals)} arrivals, "
+                 f"{len(self.tenants)} tenants)"]
+        for a in self.arrivals:
+            rest = " ".join(f"{k}={v}" for k, v in a.items()
+                            if k != "process")
+            lines.append(f"  - {a['process']}: {rest}")
+        for t in self.tenants:
+            rest = " ".join(f"{k}={v}" for k, v in t.items()
+                            if k != "name")
+            lines.append(f"  * tenant {t['name']}: {rest}")
+        return "\n".join(lines)
+
+
+def load_plan(source) -> Optional[LoadPlan]:
+    """Coerce a plan from a path / JSON string / dict / plan object —
+    the chaos ``load_plan`` contract."""
+    if source is None:
+        return None
+    if isinstance(source, LoadPlan):
+        return source
+    if isinstance(source, dict):
+        return LoadPlan.from_dict(source)
+    s = str(source)
+    if s.lstrip().startswith("{"):
+        return LoadPlan.from_json(s)
+    return LoadPlan.from_file(s)
+
+
+# --------------------------------------------------------------------------
+# builtin plans (the bench / CLI / drive-script workloads)
+# --------------------------------------------------------------------------
+def diurnal_flash_plan(duration_s: float = 60.0, seed: int = 7,
+                       base_rps: float = 4.0, peak_rps: float = 30.0,
+                       flash_rps: float = 90.0,
+                       models: Optional[Sequence[str]] = None) -> LoadPlan:
+    """The acceptance-gate workload: a compressed diurnal day with a
+    flash crowd landing just past mid-period, a heavy-tail interactive/
+    batch tenant mix and both adversarial patterns at low weight."""
+    return LoadPlan(
+        arrivals=[
+            {"process": "diurnal", "rps_base": base_rps,
+             "rps_peak": peak_rps, "period_s": duration_s},
+            {"process": "flash_crowd", "at_s": 0.55 * duration_s,
+             "rps_peak": flash_rps, "ramp_s": 0.05 * duration_s,
+             "hold_s": 0.10 * duration_s, "decay_s": 0.08 * duration_s},
+        ],
+        tenants=[
+            {"name": "interactive", "weight": 6, "kind": "predict",
+             "rows": {"dist": "lognormal", "median": 1.5, "sigma": 0.7,
+                      "max": 8}},
+            {"name": "batchy", "weight": 2, "kind": "predict",
+             "rows": {"dist": "lognormal", "median": 6, "sigma": 1.0,
+                      "max": 32}},
+            {"name": "spam", "weight": 1,
+             "adversarial": "one_token_spam"},
+            {"name": "flood", "weight": 1, "kind": "predict",
+             "adversarial": "deadline_flood", "deadline_ms": 1.0,
+             "rows": {"dist": "const", "value": 1}},
+        ],
+        name="diurnal-flash", seed=seed, duration_s=duration_s,
+        tick_s=max(duration_s / 60.0, 0.25), models=models)
+
+
+def cluster_plan(duration_s: float = 20.0, seed: int = 11,
+                 rps: float = 30.0,
+                 models: Optional[Sequence[str]] = None) -> LoadPlan:
+    """Steady Poisson traffic for the multi-replica front: enough
+    sustained rate that ejecting a replica visibly redistributes load,
+    plus the deadline flood the front must shrug off."""
+    return LoadPlan(
+        arrivals=[{"process": "poisson", "rps": rps}],
+        tenants=[
+            {"name": "steady", "weight": 8, "kind": "predict",
+             "rows": {"dist": "lognormal", "median": 2, "sigma": 0.6,
+                      "max": 8}},
+            {"name": "flood", "weight": 1, "kind": "predict",
+             "adversarial": "deadline_flood", "deadline_ms": 1.0,
+             "rows": {"dist": "const", "value": 1}},
+        ],
+        name="cluster-steady", seed=seed, duration_s=duration_s,
+        tick_s=0.5, models=models)
+
+
+BUILTIN_PLANS: Dict[str, Callable[..., LoadPlan]] = {
+    "diurnal_flash": diurnal_flash_plan,
+    "cluster": cluster_plan,
+}

@@ -1,0 +1,286 @@
+"""Replay a compiled :class:`~.plan.RequestStream` against a live
+target under time compression.
+
+The runner is target-agnostic: a *target* is ``fn(req: SimRequest) ->
+wait_callable`` — it submits the request (non-blocking, the serving
+stack's universal submit/result split) and returns a zero-arg callable
+that blocks for the outcome. Factories below adapt every tier of the
+stack: a bare :class:`~..serving.batcher.DynamicBatcher`, a
+:class:`~..serving.registry.ModelRouter` (tenant-aware), the
+:class:`~..serving.cluster.ClusterFront`, a
+:class:`~..serving.generate.GenerationEngine`, and a remote HTTP
+server.
+
+Pacing: the submit loop sleeps on the injected
+:class:`~.clock.SimClock` until each request's sim timestamp, so a
+60-simulated-second diurnal day replays in one wall second at
+``compression=60``. A pool of collector threads drains the wait
+callables so slow requests never stall the arrival process (open-loop
+load, the honest kind). ``on_tick`` fires at sim-tick boundaries —
+that is where a :class:`~.controllers.ControllerHub` gets pumped, and
+why controllers and alert windows share the runner's clock.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.loadgen.clock import SimClock
+from deeplearning4j_tpu.loadgen.plan import RequestStream, SimRequest
+from deeplearning4j_tpu.obs import flight as _flight
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class LoadReport:
+    """Outcome tally + latency quantiles for one replay."""
+
+    def __init__(self, plan_name: str, seed: int):
+        self.plan_name = plan_name
+        self.seed = seed
+        self.latencies_s: List[float] = []
+        #: (sim arrival time, latency) pairs — lets a bench quote the
+        #: steady-state quantile (same sim-time cutoff on every leg)
+        #: instead of letting the warm-in window pollute the p99
+        self.timed_latencies: List[tuple] = []
+        self.outcomes: Dict[str, int] = {}
+        self.by_tenant: Dict[str, Dict[str, int]] = {}
+        self.submitted = 0
+        self.wall_s = 0.0
+        self.sim_s = 0.0
+        self._lock = threading.Lock()
+
+    def note(self, req: SimRequest, outcome: str,
+             latency_s: Optional[float]) -> None:
+        with self._lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+            t = self.by_tenant.setdefault(req.tenant, {})
+            t[outcome] = t.get(outcome, 0) + 1
+            if latency_s is not None and outcome == "ok":
+                self.latencies_s.append(latency_s)
+                self.timed_latencies.append((req.t, latency_s))
+
+    def ok(self) -> int:
+        return self.outcomes.get("ok", 0)
+
+    def p(self, q: float) -> float:
+        with self._lock:
+            vals = sorted(self.latencies_s)
+        return _quantile(vals, q)
+
+    def p_steady(self, q: float, skip_s: float = 0.0) -> float:
+        """Latency quantile over requests arriving at sim time >=
+        ``skip_s`` — the steady-state view."""
+        with self._lock:
+            vals = sorted(l for t, l in self.timed_latencies
+                          if t >= skip_s)
+        return _quantile(vals, q)
+
+    def describe(self) -> dict:
+        with self._lock:
+            vals = sorted(self.latencies_s)
+        return {
+            "plan": self.plan_name, "seed": self.seed,
+            "submitted": self.submitted,
+            "outcomes": dict(self.outcomes),
+            "by_tenant": {k: dict(v) for k, v in self.by_tenant.items()},
+            "p50_ms": round(_quantile(vals, 0.50) * 1e3, 3),
+            "p90_ms": round(_quantile(vals, 0.90) * 1e3, 3),
+            "p99_ms": round(_quantile(vals, 0.99) * 1e3, 3),
+            "wall_s": round(self.wall_s, 3),
+            "sim_s": round(self.sim_s, 3),
+        }
+
+
+class LoadRunner:
+    """Open-loop replay: paced submission + threaded collection."""
+
+    def __init__(self, stream: RequestStream,
+                 target: Callable[[SimRequest], Callable[[], object]],
+                 clock: Optional[SimClock] = None,
+                 compression: float = 1.0,
+                 collectors: int = 16,
+                 on_tick: Optional[Callable[[float], None]] = None,
+                 tick_s: Optional[float] = None,
+                 recorder=None):
+        self.stream = stream
+        self.target = target
+        self.clock = clock or SimClock(compression=compression)
+        self.on_tick = on_tick
+        self.tick_s = float(tick_s if tick_s is not None
+                            else stream.plan.tick_s)
+        self.collectors = max(int(collectors), 1)
+        self.recorder = recorder or _flight.default_flight_recorder()
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> LoadReport:
+        report = LoadReport(self.stream.plan.name, self.stream.plan.seed)
+        self.recorder.record(
+            "loadgen_start", plan=self.stream.plan.name,
+            seed=self.stream.plan.seed, n_requests=len(self.stream),
+            fingerprint=self.stream.fingerprint()[:16],
+            compression=getattr(self.clock, "compression", 1.0))
+        pending: "queue.Queue" = queue.Queue()
+        threads = [threading.Thread(
+            target=self._collect, args=(pending, report),
+            name=f"loadgen-collect-{i}", daemon=True)
+            for i in range(self.collectors)]
+        for th in threads:
+            th.start()
+        wall_start = time.monotonic()
+        next_tick = self.tick_s
+        try:
+            for req in self.stream:
+                while self.on_tick is not None and req.t >= next_tick:
+                    if not self.clock.sleep_until(next_tick, self._stop):
+                        break
+                    self.on_tick(next_tick)
+                    next_tick += self.tick_s
+                if not self.clock.sleep_until(req.t, self._stop):
+                    break
+                report.submitted += 1
+                try:
+                    wait = self.target(req)
+                except Exception as e:  # typed rejects are an outcome
+                    report.note(req, type(e).__name__, None)
+                    continue
+                pending.put((req, wait, time.monotonic()))
+            # let trailing alert/controller windows elapse
+            if self.on_tick is not None and not self._stop.is_set():
+                end = self.stream.plan.duration_s + self.tick_s
+                while next_tick <= end:
+                    if not self.clock.sleep_until(next_tick, self._stop):
+                        break
+                    self.on_tick(next_tick)
+                    next_tick += self.tick_s
+        finally:
+            for _ in threads:
+                pending.put(None)
+            for th in threads:
+                th.join(timeout=30.0)
+            report.wall_s = time.monotonic() - wall_start
+            report.sim_s = self.clock.now()
+            self.recorder.record(
+                "loadgen_done", plan=self.stream.plan.name,
+                seed=self.stream.plan.seed, submitted=report.submitted,
+                ok=report.ok(), outcomes=dict(report.outcomes),
+                p99_ms=round(report.p(0.99) * 1e3, 3),
+                wall_s=round(report.wall_s, 3))
+        return report
+
+    def _collect(self, pending: "queue.Queue", report: LoadReport) -> None:
+        while True:
+            item = pending.get()
+            if item is None:
+                return
+            req, wait, t0 = item
+            try:
+                wait()
+            except Exception as e:  # noqa: BLE001 — the typed error
+                # CLASS is the outcome being tallied; nothing is lost
+                report.note(req, type(e).__name__, None)
+            else:
+                report.note(req, "ok", time.monotonic() - t0)
+
+
+# --------------------------------------------------------------------------
+# target factories — one per tier of the stack
+# --------------------------------------------------------------------------
+def _predict_rows(req: SimRequest, example_shape) -> np.ndarray:
+    # generate-shaped traffic against a predict-only tier degrades to a
+    # single-row predict: the arrival process still exercises the queue
+    rows = req.rows if req.kind == "predict" else 1
+    return np.zeros((max(rows, 1),) + tuple(example_shape), np.float32)
+
+
+def _deadline(req: SimRequest) -> Optional[float]:
+    return None if req.deadline_ms is None else req.deadline_ms / 1e3
+
+
+def batcher_target(batcher, example_shape) -> Callable:
+    """Replay straight into a :class:`DynamicBatcher`."""
+    def submit(req: SimRequest):
+        r = batcher.submit(_predict_rows(req, example_shape),
+                           timeout=_deadline(req))
+        return r.result
+    return submit
+
+
+def router_target(router, model: str, example_shape) -> Callable:
+    """Replay through the :class:`ModelRouter` — tenant quotas, canary
+    split and model admission all live. Requests carrying their own
+    ``model`` override the default."""
+    def submit(req: SimRequest):
+        r = router.submit(req.model or model,
+                          _predict_rows(req, example_shape),
+                          timeout=_deadline(req), tenant=req.tenant)
+        return r.result
+    return submit
+
+
+def front_target(front, example_shape) -> Callable:
+    """Replay through a :class:`ClusterFront` — health-based routing
+    and failover included."""
+    def submit(req: SimRequest):
+        r = front.submit(_predict_rows(req, example_shape),
+                         timeout=_deadline(req))
+        return r.result
+    return submit
+
+
+def generation_target(gen) -> Callable:
+    """Replay generate-shaped requests into a
+    :class:`GenerationEngine`; predict-shaped ones degrade to a 1-token
+    generation so mixed plans still run."""
+    def submit(req: SimRequest):
+        prompt = np.arange(1, max(req.prompt_len, 1) + 1, dtype=np.int32)
+        r = gen.submit(prompt, max_new=max(req.max_new, 1),
+                       timeout=_deadline(req))
+        return r.result
+    return submit
+
+
+def http_target(base_url: str, example_shape) -> Callable:
+    """Replay over the wire against a live server's ``POST /predict``.
+    One connection per in-flight request (the wait callable owns it)."""
+    import http.client
+    import json as _json
+    from urllib.parse import urlparse
+
+    u = urlparse(base_url if "//" in base_url else f"http://{base_url}")
+    host, port = u.hostname or "127.0.0.1", u.port or 80
+
+    def submit(req: SimRequest):
+        body = _json.dumps({
+            "inputs": _predict_rows(req, example_shape).tolist(),
+            "tenant": req.tenant,
+        }).encode()
+        conn = http.client.HTTPConnection(host, port, timeout=30.0)
+        conn.request("POST", "/predict", body=body,
+                     headers={"Content-Type": "application/json"})
+
+        def wait():
+            try:
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"HTTP {resp.status}: {data[:120]!r}")
+                return _json.loads(data)
+            finally:
+                conn.close()
+        return wait
+    return submit

@@ -203,6 +203,41 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
     "draft_flush": ("serving/generate.py",
                     "n-gram draft table hit its size cap and was "
                     "cleared whole"),
+    # -- load generation + adaptive capacity (loadgen/, serving/cluster.py)
+    "loadgen_start": ("loadgen/runner.py",
+                      "a compiled request stream started replaying "
+                      "(plan, seed, stream fingerprint, compression)"),
+    "loadgen_done": ("loadgen/runner.py",
+                     "replay finished (submitted, outcome tally, p99, "
+                     "wall seconds)"),
+    "controller_retune": ("loadgen/controllers.py",
+                          "DeadlineTuner acted: deadline shrink/relax "
+                          "or a pre-compiled bucket-set switch "
+                          "(verdict + firing alerts attached)"),
+    "controller_slot_scale": ("loadgen/controllers.py",
+                              "SlotScaler resized the generation slab "
+                              "(memory-estimator gated; verdict "
+                              "attached)"),
+    "controller_tenant_demote": ("loadgen/controllers.py",
+                                 "TenantDemoter capped an abusive "
+                                 "tenant's quota tier (share + verdict "
+                                 "attached)"),
+    "controller_tenant_restore": ("loadgen/controllers.py",
+                                  "a demoted tenant's quota restored "
+                                  "after the burn stayed quiet"),
+    "controller_prewarm": ("loadgen/controllers.py",
+                           "ModelPrewarmer admitted+warmed a model on "
+                           "predicted (not observed) load"),
+    "controller_evict": ("loadgen/controllers.py",
+                         "ModelPrewarmer evicted a predicted-idle "
+                         "model (refused while its canary is open)"),
+    "replica_eject": ("serving/cluster.py",
+                      "ClusterFront ejected a replica after "
+                      "eject_after consecutive critical/unreachable "
+                      "health verdicts"),
+    "replica_readmit": ("serving/cluster.py",
+                        "an ejected replica re-admitted after "
+                        "readmit_after consecutive healthy verdicts"),
     # -- kernels (nn/ops/registry.py) -------------------------------------
     "kernel_fallback": ("nn/ops/registry.py",
                         "a Pallas kernel probe failed/was disabled; "
@@ -266,6 +301,10 @@ HOOK_POINTS: Dict[str, tuple] = {
                          "a controller decision (trip/promote/release) "
                          "about to be epoch-fence checked — delay mode "
                          "is the paused ex-holder drill"),
+    "controller.act": ("loadgen/controllers.py",
+                       "an adaptive-capacity controller about to "
+                       "actuate its knob (controller + action ctx; "
+                       "error mode = broken actuator drill)"),
 }
 
 
@@ -334,6 +373,20 @@ ALERTS: Dict[str, tuple] = {
     "lease_flap": ("obs/slo.py",
                    "a canary-controller lease changed holder "
                    "repeatedly in a short window"),
+    "serving_latency_slo_breach": ("obs/slo.py",
+                                   "serving p99 latency over the SLO "
+                                   "target (the DeadlineTuner's "
+                                   "shrink trigger)"),
+    "controller_action_storm": ("obs/slo.py",
+                                "adaptive controllers acting too often "
+                                "— oscillation / flap-suppression "
+                                "failure"),
+    "tenant_demoted": ("obs/slo.py",
+                       "one or more tenants serving on a demoted "
+                       "quota tier"),
+    "replica_ejected": ("obs/slo.py",
+                        "the cluster front ejected a replica on "
+                        "health verdicts"),
     # the canary gate, expressed in the same engine (serving/registry.py
     # builds these per canary window via obs/slo.canary_gate_rules)
     "canary_score_regressed": ("obs/slo.py",

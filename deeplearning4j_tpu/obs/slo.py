@@ -46,7 +46,8 @@ def _flight(kind: str) -> dict:
 def default_rules(queue_limit: int = 256,
                   serving_slo_target: float = 0.99,
                   checkpoint_stale_s: float = 1800.0,
-                  publish_stale_s: float = 3600.0) -> List[AlertRule]:
+                  publish_stale_s: float = 3600.0,
+                  latency_slo_ms: float = 250.0) -> List[AlertRule]:
     """The production rule pack. Knobs cover the deployment-specific
     bounds (queue limit, SLO target, staleness budgets); everything
     else is the codebase's own failure taxonomy."""
@@ -225,6 +226,38 @@ def default_rules(queue_limit: int = 256,
                         "flapping between alive and stale (heartbeat "
                         "interval too close to the lease TTL, or the "
                         "box is overloaded)"),
+        # -- adaptive capacity (loadgen/controllers.py acts on these) ---------
+        AlertRule(
+            "serving_latency_slo_breach", "threshold",
+            metric="serving_latency_p99_ms", op=">",
+            threshold=float(latency_slo_ms),
+            for_s=2.0, resolve_s=10.0, severity="warn",
+            description="serving p99 latency (ring window) over the "
+                        "SLO target — the DeadlineTuner's shrink "
+                        "trigger; sustained breach with controllers "
+                        "armed means the knobs are out of room"),
+        AlertRule(
+            "controller_action_storm", "increase",
+            family="controller_actions_total", op=">=", threshold=8,
+            window_s=60.0, resolve_s=120.0, severity="warn",
+            description="adaptive controllers acting too often in a "
+                        "short window — oscillation across a "
+                        "hysteresis boundary; widen cooldowns or the "
+                        "alert resolve windows"),
+        AlertRule(
+            "tenant_demoted", "threshold",
+            metric="serving_tenants_demoted", op=">=", threshold=1,
+            for_s=0.0, resolve_s=30.0, severity="warn",
+            description="one or more tenants serving on a demoted "
+                        "quota tier — abusive traffic is being "
+                        "contained; clears when demotions lift"),
+        AlertRule(
+            "replica_ejected", "increase", severity="warn",
+            resolve_s=120.0, **_flight("replica_eject"),
+            description="the cluster front ejected a replica on "
+                        "consecutive critical/unreachable health "
+                        "verdicts — the tier is serving on fewer "
+                        "replicas"),
     ]
 
 
@@ -331,7 +364,8 @@ def build_default_evaluator(registry=None, recorder=None,
                             clock=None,
                             serving_slo_target: float = 0.99,
                             checkpoint_stale_s: float = 1800.0,
-                            publish_stale_s: float = 3600.0):
+                            publish_stale_s: float = 3600.0,
+                            latency_slo_ms: float = 250.0):
     """An :class:`~.alerts.AlertEvaluator` armed with the default pack
     over ``registry`` (default: the process-wide one), watching the
     flight recorder for the event-driven rules. The one-liner both
@@ -345,7 +379,8 @@ def build_default_evaluator(registry=None, recorder=None,
         default_rules(queue_limit=queue_limit,
                       serving_slo_target=serving_slo_target,
                       checkpoint_stale_s=checkpoint_stale_s,
-                      publish_stale_s=publish_stale_s),
+                      publish_stale_s=publish_stale_s,
+                      latency_slo_ms=latency_slo_ms),
         registry=registry if registry is not None else default_registry(),
         clock=clock if clock is not None else _time.monotonic,
         recorder=recorder,

@@ -53,6 +53,7 @@ from deeplearning4j_tpu.serving.buckets import BucketPolicy
 from deeplearning4j_tpu.serving.cluster import (
     ClusterCoordinator,
     ClusterError,
+    ClusterFront,
     StaleEpochError,
 )
 from deeplearning4j_tpu.serving.engine import InferenceEngine
@@ -83,6 +84,7 @@ __all__ = [
     "CanaryRolledBackError",
     "ClusterCoordinator",
     "ClusterError",
+    "ClusterFront",
     "DecodeStalledError",
     "DynamicBatcher",
     "GenerationEngine",

@@ -267,6 +267,16 @@ class DynamicBatcher:
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
+    def set_max_wait_ms(self, max_wait_ms: float) -> float:
+        """Retune the dispatch deadline live. The worker reads
+        ``max_wait_s`` fresh at every batch boundary, so the new
+        deadline applies from the next coalescing window — no restart,
+        no queued-request disruption. This is the adaptive-capacity
+        controllers' cheapest knob (latency-vs-throughput trade, zero
+        recompiles). Returns the applied milliseconds."""
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        return self.max_wait_s * 1e3
+
     def retry_after_s(self) -> float:
         """Backoff hint for overloaded clients: the current queue depth
         × the recent per-dispatch wall time (EWMA), clamped to [1, 60]s

@@ -203,6 +203,33 @@ class BucketPolicy:
                 f"seq={self.seq_buckets})")
 
 
+def propose_buckets(observed_rows: Sequence[int],
+                    max_batch: int) -> List[int]:
+    """Learn a batch-bucket list from an observed dispatch-size mix.
+
+    The static default (powers of two up to ``max_batch``) is the right
+    *prior*; once real traffic exists, the right buckets are the ones
+    that sit just above the mix's mass. Take the 50/90/99th percentiles
+    of the observed real-row counts, round each up to a power of two
+    (fixed-shape discipline: the shape set must stay small and stable
+    under jitter in the mix), union in ``max_batch`` so a full coalesced
+    batch still fits, and drop anything over the limit. The result is
+    the candidate an adaptive controller hands to
+    :meth:`InferenceEngine.retune_buckets` — which pre-compiles every
+    shape BEFORE switching, so adopting the proposal costs zero
+    steady-state retraces."""
+    max_batch = max(int(max_batch), 1)
+    rows = sorted(int(r) for r in observed_rows if int(r) > 0)
+    if not rows:
+        return _pow2_buckets(max_batch)
+    picks = set()
+    for q in (0.5, 0.9, 0.99):
+        v = rows[min(int(q * len(rows)), len(rows) - 1)]
+        picks.add(1 << max(v - 1, 0).bit_length())
+    picks.add(max_batch)
+    return sorted(b for b in picks if b <= max_batch)
+
+
 def slice_result(y: np.ndarray, n: int, t_orig: Optional[int],
                  t_padded: Optional[int]) -> np.ndarray:
     """Undo bucket padding on a model output: always slice the batch

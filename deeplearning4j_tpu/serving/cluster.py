@@ -624,3 +624,164 @@ class ClusterCoordinator:
                 "lease_ttl_s": self.lease_ttl_s,
                 "global_tenant_quota": self.global_tenant_quota,
             }
+
+
+class ClusterFront:
+    """Health-routing load-balancer front over N replicas — the PR 17
+    round-robin test harness promoted to a product surface.
+
+    A *replica* is registered as two callables: ``submit(*a, **kw)``
+    (the replica's request entry point — a router/batcher ``submit`` or
+    an HTTP adapter) and ``healthz()`` (its verdict ``/healthz``
+    payload: a :class:`~deeplearning4j_tpu.obs.alerts.HealthVerdict`,
+    a dict with a ``"status"`` key, or anything that raises when the
+    replica is unreachable). Routing is round-robin over the *admitted*
+    set only.
+
+    Ejection/re-admission is streak-based hysteresis on
+    :meth:`check_health` polls: ``eject_after`` consecutive
+    critical/unreachable verdicts ejects (``replica_eject`` flight
+    event, traffic stops immediately), ``readmit_after`` consecutive
+    healthy/degraded verdicts re-admits (``replica_readmit``) — one bad
+    scrape never ejects, one good one never re-admits, the same
+    flap-suppression shape as the alert engine's pending→firing
+    machine. ``submit`` additionally fails over within a single call:
+    an admitted replica answering with overload/shutdown/draining (or
+    a connection error) passes the request to the next admitted
+    replica, one full pass, then the last typed error propagates.
+
+    The front never ejects the LAST admitted replica via failover; only
+    ``check_health`` can empty the pool (at which point ``route``
+    raises a typed :class:`ClusterError` — degraded-but-serving beats
+    serving nothing, but a tier that is provably all-critical must say
+    so)."""
+
+    def __init__(self, eject_after: int = 2, readmit_after: int = 2,
+                 clock: Callable[[], float] = time.monotonic):
+        from deeplearning4j_tpu.obs.lockwitness import witnessed_lock
+
+        self.eject_after = max(int(eject_after), 1)
+        self.readmit_after = max(int(readmit_after), 1)
+        self._clock = clock
+        self._lock = witnessed_lock("cluster.front")
+        self._replicas: "OrderedDict[str, dict]" = OrderedDict()
+        self._rr = 0
+
+    def add_replica(self, name: str, submit: Callable,
+                    healthz: Callable[[], object]) -> None:
+        with self._lock:
+            self._replicas[str(name)] = {
+                "submit": submit, "healthz": healthz, "admitted": True,
+                "bad_streak": 0, "good_streak": 0, "status": "unknown",
+                "since": self._clock(),
+            }
+
+    def remove_replica(self, name: str) -> bool:
+        with self._lock:
+            return self._replicas.pop(str(name), None) is not None
+
+    def admitted(self) -> List[str]:
+        with self._lock:
+            return [n for n, r in self._replicas.items() if r["admitted"]]
+
+    def _rotation(self) -> List[Tuple[str, Callable]]:
+        """Admitted (name, submit) pairs starting at the round-robin
+        cursor; advances the cursor by one."""
+        with self._lock:
+            adm = [(n, r["submit"]) for n, r in self._replicas.items()
+                   if r["admitted"]]
+            if not adm:
+                raise ClusterError(
+                    "no admitted replicas: every registered replica is "
+                    "ejected (or none were added); check_health must "
+                    "see a healthy verdict before traffic can flow")
+            start = self._rr % len(adm)
+            self._rr += 1
+            return adm[start:] + adm[:start]
+
+    def route(self) -> str:
+        """Name of the replica the next request would go to."""
+        return self._rotation()[0][0]
+
+    def submit(self, *args, **kwargs):
+        """Submit through the front: round-robin plus single-pass
+        failover on capacity/reachability errors. Application errors
+        (bad input, deadline already spent) propagate from the first
+        replica — failing those over would just burn the tier."""
+        from deeplearning4j_tpu.serving.batcher import (
+            ServerOverloadedError,
+            ServerShutdownError,
+        )
+
+        last_err: Optional[Exception] = None
+        for _name, submit in self._rotation():
+            try:
+                return submit(*args, **kwargs)
+            except (ServerOverloadedError, ServerShutdownError,
+                    ConnectionError, OSError) as e:
+                last_err = e
+        assert last_err is not None
+        raise last_err
+
+    @staticmethod
+    def _status_of(payload) -> str:
+        status = getattr(payload, "status", None)
+        if status is None and isinstance(payload, dict):
+            status = payload.get("status")
+        return str(status) if status else "unknown"
+
+    def check_health(self) -> Dict[str, str]:
+        """Poll every replica's ``healthz`` once and run the
+        eject/readmit streak machine. Returns name → verdict status
+        (``unreachable`` when the poll raised). Call this from the
+        serving tier's housekeeping cadence (the loadgen cluster plan
+        pumps it per tick)."""
+        from deeplearning4j_tpu.obs import flight as _flight
+
+        with self._lock:
+            targets = [(n, r["healthz"]) for n, r in self._replicas.items()]
+        out: Dict[str, str] = {}
+        for name, healthz in targets:
+            try:
+                status = self._status_of(healthz())
+            except Exception:  # noqa: BLE001 — unreachable IS the signal
+                status = "unreachable"
+            out[name] = status
+            bad = status in ("critical", "unreachable")
+            event = None
+            with self._lock:
+                r = self._replicas.get(name)
+                if r is None:
+                    continue
+                r["status"] = status
+                if bad:
+                    r["bad_streak"] += 1
+                    r["good_streak"] = 0
+                    if r["admitted"] and r["bad_streak"] >= self.eject_after:
+                        r["admitted"] = False
+                        r["since"] = self._clock()
+                        event = ("replica_eject", r["bad_streak"])
+                else:
+                    r["good_streak"] += 1
+                    r["bad_streak"] = 0
+                    if (not r["admitted"]
+                            and r["good_streak"] >= self.readmit_after):
+                        r["admitted"] = True
+                        r["since"] = self._clock()
+                        event = ("replica_readmit", r["good_streak"])
+            if event is not None:
+                _flight.record(event[0], replica=name, status=status,
+                               streak=event[1])
+        return out
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "eject_after": self.eject_after,
+                "readmit_after": self.readmit_after,
+                "replicas": {
+                    n: {"admitted": r["admitted"], "status": r["status"],
+                        "bad_streak": r["bad_streak"],
+                        "good_streak": r["good_streak"]}
+                    for n, r in self._replicas.items()},
+            }

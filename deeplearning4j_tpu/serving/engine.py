@@ -393,21 +393,7 @@ class InferenceEngine:
             info.seq_real = t_orig
             info.seq_padded = t_padded
         with _trace.span("serving_dispatch"):
-            if snap.fn is None:
-                m = snap.model
-                if hasattr(m, "output_single"):  # ComputationGraph surface
-                    y = m.output_single(xp,
-                                        masks=None if mp is None else [mp])
-                else:
-                    y = m.output(xp, mask=mp)
-            else:
-                xd = xp
-                md = mp
-                if self.mesh is not None:
-                    xd = jax.device_put(xp, self.mesh.batch_sharded())
-                    if mp is not None:
-                        md = jax.device_put(mp, self.mesh.batch_sharded())
-                y = snap.fn(snap.params, snap.state, xd, md)
+            y = self._forward_raw(snap, xp, mp)
         if info is not None:
             # async backends return from the dispatch before the device
             # finishes; the remaining device wait lands in the "slice"
@@ -419,6 +405,26 @@ class InferenceEngine:
         if info is not None:
             info.t_sliced = _time.monotonic()
         return out
+
+    def _forward_raw(self, snap: "_Snapshot", xp, mp=None) -> np.ndarray:
+        """The exact-shape forward under ``snap`` — no bucket padding,
+        no dispatch metrics. The dispatch core of :meth:`_infer_on`,
+        and the primitive :meth:`retune_buckets` uses to pre-compile a
+        CANDIDATE bucket set's shapes while the current policy is still
+        the one serving traffic."""
+        if snap.fn is None:
+            m = snap.model
+            if hasattr(m, "output_single"):  # ComputationGraph surface
+                return m.output_single(xp,
+                                       masks=None if mp is None else [mp])
+            return m.output(xp, mask=mp)
+        xd = xp
+        md = mp
+        if self.mesh is not None:
+            xd = jax.device_put(xp, self.mesh.batch_sharded())
+            if mp is not None:
+                md = jax.device_put(mp, self.mesh.batch_sharded())
+        return snap.fn(snap.params, snap.state, xd, md)
 
     # -- warmup -------------------------------------------------------------
     def _warm_snapshot(self, snap: "_Snapshot",
@@ -455,6 +461,60 @@ class InferenceEngine:
             "compiles": self._compile_count - before,
             "seconds": round(time.perf_counter() - t0, 3),
         }
+
+    def retune_buckets(self, new_policy: BucketPolicy,
+                       example_shape: Optional[Sequence[int]] = None
+                       ) -> dict:
+        """Adopt a new bucket set with **zero steady-state retraces**:
+        pre-compile-before-switch.
+
+        Under the reload lock (a retune and a hot reload must not
+        interleave): copy the candidate policy, apply the same
+        mesh-divisibility filter as ``__init__``, run every shape the
+        candidate can emit through :meth:`_forward_raw` at its EXACT
+        padded shape — jit caches the new programs while ``self.buckets``
+        (the old policy) is still the one padding live traffic — then
+        atomically ref-assign the new policy. In-flight ``_infer_on``
+        calls read ``self.buckets`` once per request, so every request
+        pads entirely under one policy or the other, and the first
+        request after the swap hits an already-compiled program.
+
+        Returns ``{shapes, compiles, seconds, buckets}`` — ``compiles``
+        is the trace-counter delta during the pre-compile (the switch
+        itself adds none; the bench asserts that)."""
+        shape = tuple(example_shape) if example_shape is not None \
+            else self.example_shape()
+        if shape is None:
+            raise ValueError(
+                "cannot infer the per-example input shape from the model "
+                "conf; pass retune_buckets(..., example_shape=...)")
+        with self._reload_lock:
+            pol = new_policy.copy()
+            if self.mesh is not None and self.mesh.n_data > 1:
+                keep = [b for b in pol.batch_buckets
+                        if b % self.mesh.n_data == 0]
+                if not keep:
+                    raise ValueError(
+                        f"no batch bucket in {pol.batch_buckets} is "
+                        f"divisible by the mesh data axis "
+                        f"({self.mesh.n_data})")
+                pol.batch_buckets = keep
+            snap = self._snap
+            before = self._compile_count
+            t0 = time.perf_counter()
+            shapes = pol.warmup_shapes(shape)
+            for full_shape, with_mask in shapes:
+                x = np.zeros(full_shape, np.float32)
+                mask = (np.ones(full_shape[:2], np.float32)
+                        if with_mask else None)
+                self._forward_raw(snap, x, mask)
+            self.buckets = pol  # atomic ref swap: old policy until here
+            return {
+                "shapes": len(shapes),
+                "compiles": self._compile_count - before,
+                "seconds": round(time.perf_counter() - t0, 3),
+                "buckets": list(pol.batch_buckets),
+            }
 
     # -- hardware-efficiency profile ----------------------------------------
     def publish_cost_metrics(self, example_shape: Optional[Sequence[int]]

@@ -23,8 +23,10 @@ dispatch.
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, List, Optional
 
 from deeplearning4j_tpu.obs.metrics import Histogram, MetricsRegistry
 
@@ -54,6 +56,15 @@ class ServingMetrics:
         self.started_at = time.time()
         reg.gauge("serving_uptime_seconds", "seconds since metrics start",
                   fn=lambda: time.time() - self.started_at)
+        # real-rows-per-dispatch ring: the observed mix an adaptive
+        # bucket tuner learns from (bounded, like the latency ring)
+        self._rows_window: deque = deque(maxlen=ring_size)
+        self._rows_lock = threading.Lock()
+        reg.gauge(
+            "serving_latency_p99_ms",
+            "p99 request latency over the ring window, milliseconds "
+            "(0 before any request) — the latency-SLO alert input",
+            fn=lambda: round((self.latency_quantile(0.99) or 0.0) * 1e3, 3))
 
     # -- recording ----------------------------------------------------------
     def record_request(self, rows: int) -> None:
@@ -83,6 +94,8 @@ class ServingMetrics:
             labels=lbl).inc()
         if real_rows is not None:
             real = min(max(int(real_rows), 0), int(bucket))
+            with self._rows_lock:
+                self._rows_window.append(real)
             self.registry.counter(
                 "serving_real_samples_total",
                 "real (request) rows dispatched, per bucket",
@@ -151,6 +164,13 @@ class ServingMetrics:
                 "waste_ratio": round(p / (r + p), 4) if (r + p) else 0.0,
             }
         return out
+
+    def dispatch_rows_window(self) -> List[int]:
+        """Real rows per dispatch over the last ``ring_size`` device
+        batches — the observed mix :func:`~.buckets.propose_buckets`
+        turns into a learned bucket list."""
+        with self._rows_lock:
+            return list(self._rows_window)
 
     # -- reading ------------------------------------------------------------
     def latency_quantile(self, q: float) -> Optional[float]:

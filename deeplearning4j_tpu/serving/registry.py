@@ -905,6 +905,12 @@ class ModelRouter:
         self._lock = witnessed_rlock("router")
         self._tenants: Dict[str, deque] = {}
         self._tenant_lock = witnessed_lock("router.tenants")
+        #: per-tenant quota overrides (demotions): tenant → max
+        #: in-flight, applied as a MIN over the configured/cluster
+        #: quota in :meth:`_admit_tenant`. Written by
+        #: :meth:`demote_tenant` / :meth:`restore_tenant` (the
+        #: adaptive-capacity TenantDemoter's knob).
+        self.tenant_tiers: Dict[str, int] = {}
         self._last_refresh = time.monotonic()
         self._shutdown = False
 
@@ -1021,6 +1027,39 @@ class ModelRouter:
                                version=mm.active.version)
                 mm.active.retire(drain=True)
 
+    # -- capacity surface (the ModelPrewarmer's knobs) -----------------------
+    def live_models(self) -> List[str]:
+        """Names currently warm, LRU → MRU order."""
+        with self._lock:
+            return list(self._live)
+
+    def model_idle_s(self, name: str) -> Optional[float]:
+        """Seconds since ``name`` last served a request; None when the
+        model is not live."""
+        with self._lock:
+            mm = self._live.get(name)
+            return (None if mm is None
+                    else max(time.monotonic() - mm.last_used, 0.0))
+
+    def prewarm_model(self, name: str) -> int:
+        """Admit (build + warm) ``name`` ahead of predicted load so its
+        first real request hits a compiled engine. Returns the active
+        version. Typed UnknownModelError when the registry has no such
+        model — a forecast must not invent capacity."""
+        return self.managed(name).active.version
+
+    def evict_model(self, name: str) -> bool:
+        """Release a live model's capacity (predicted-idle eviction).
+        Refuses — returns False — while a canary window is open on the
+        model (an open verdict outranks a load forecast) or when the
+        model is not live. The LRU machinery re-admits on next use."""
+        with self._lock:
+            mm = self._live.get(name)
+            if mm is None or mm.canary is not None:
+                return False
+            self._evict(name)
+            return True
+
     # -- tenant quotas -------------------------------------------------------
     def tenant_inflight(self) -> Dict[str, int]:
         """Per-tenant in-flight request counts — what this replica's
@@ -1041,6 +1080,11 @@ class ModelRouter:
             budget = self.cluster.tenant_budget(tenant)
             if budget is not None:
                 quota = budget if quota is None else min(quota, budget)
+        tier = self.tenant_tiers.get(tenant)
+        if tier is not None:
+            # a demoted tenant's tier binds even when no global quota
+            # is configured — demotion must mean something everywhere
+            quota = tier if quota is None else min(quota, tier)
         if quota is None:
             return None
         with self._tenant_lock:
@@ -1073,6 +1117,32 @@ class ModelRouter:
                     "backoff — other tenants are unaffected",
                     tenant=tenant, retry_after_s=retry_after)
             return ledger
+
+    def demote_tenant(self, tenant: str, quota: int) -> Optional[int]:
+        """Cap ``tenant`` at ``quota`` in-flight requests (a MIN over
+        any configured/cluster quota). Returns the previous override
+        (None if the tenant was un-demoted). The caller — normally the
+        adaptive TenantDemoter — owns recording the controller flight
+        event with its triggering verdict."""
+        quota = max(int(quota), 1)
+        with self._tenant_lock:
+            prev = self.tenant_tiers.get(tenant)
+            self.tenant_tiers[tenant] = quota
+            n = len(self.tenant_tiers)
+        self.metrics.registry.gauge(
+            "serving_tenants_demoted",
+            "tenants currently on a demoted quota tier").set(n)
+        return prev
+
+    def restore_tenant(self, tenant: str) -> bool:
+        """Lift a tenant's demotion; True if one was in force."""
+        with self._tenant_lock:
+            had = self.tenant_tiers.pop(tenant, None) is not None
+            n = len(self.tenant_tiers)
+        self.metrics.registry.gauge(
+            "serving_tenants_demoted",
+            "tenants currently on a demoted quota tier").set(n)
+        return had
 
     # -- the request path ----------------------------------------------------
     def submit(self, model: str, x, mask=None,
@@ -1108,6 +1178,12 @@ class ModelRouter:
             err.retry_after_s = 1.0
             raise err
         ledger = self._admit_tenant(tenant, ve.batcher.retry_after_s())
+        # per-tenant accepted traffic: the abuse-share signal the
+        # TenantDemoter reads (rejects are counted separately above)
+        self.metrics.registry.counter(
+            "serving_tenant_requests_total",
+            "per-tenant accepted requests",
+            labels={"tenant": tenant}).inc()
         req = ve.batcher.submit(x, mask, timeout=timeout, trace=trace)
         if ledger is not None:
             with self._tenant_lock:
@@ -1129,11 +1205,13 @@ class ModelRouter:
         return out, req.model_version
 
     def _build_generation(self, base_model, name: str, version: int,
-                          role: str):
+                          role: str, n_slots: Optional[int] = None):
         from deeplearning4j_tpu.serving.generate import GenerationEngine
         from deeplearning4j_tpu.serving.metrics import GenerationMetrics
 
-        gen = GenerationEngine(base_model, n_slots=self.gen_slots,
+        gen = GenerationEngine(base_model,
+                               n_slots=(self.gen_slots if n_slots is None
+                                        else int(n_slots)),
                                max_length=self.gen_max_length,
                                spec_decode_k=self.gen_spec_decode_k,
                                draft_mode=self.gen_draft_mode,
@@ -1174,6 +1252,48 @@ class ModelRouter:
                 mm.active.engine.model, mm.name, mm.active.version,
                 "active")
         return mm.generation
+
+    def scale_generation_slots(self, model: str, n_slots: int) -> dict:
+        """Resize the model's generation slab to ``n_slots`` decode
+        slots (the SlotScaler's knob, sized against
+        ``generation_memory_report``). The slab's slot count is baked
+        into its fixed shapes, so scaling means building and warming a
+        FRESH engine — done entirely outside locks (the
+        ``_build_canary_generation`` discipline: building under
+        ``mm.lock`` would stall the model's traffic for seconds and
+        re-close the lock-order cycle the witness flagged), then
+        installed under ``mm.lock`` with the old engine drained in the
+        background. A lost race (eviction, concurrent scale) discards
+        the new engine. Returns ``{slots, previous, changed}``."""
+        n_slots = max(int(n_slots), 1)
+        mm = self._managed_for_generation(model)
+        with mm.lock:
+            old = self._ensure_generation(mm)
+            if old.n_slots == n_slots:
+                return {"slots": n_slots, "previous": n_slots,
+                        "changed": False}
+            base_model = mm.active.engine.model
+            version = mm.active.version
+        gen = self._build_generation(base_model, mm.name, version,
+                                     "active", n_slots=n_slots)
+        gen.warmup()
+        stale = None
+        with mm.lock:
+            if mm.evicted or mm.generation is not old:
+                stale = gen  # raced an eviction or another scaler: lose
+            else:
+                mm.generation = gen
+                stale = old
+        prev = old.n_slots
+        changed = stale is old
+        if stale is not None:
+            threading.Thread(
+                target=stale.shutdown,
+                kwargs={"drain": changed},  # drain the replaced engine's
+                # in-flight decodes; a discarded NEW engine has none
+                daemon=True).start()
+        return {"slots": n_slots if changed else prev,
+                "previous": prev, "changed": changed}
 
     def _build_canary_generation(self, mm: _ManagedModel, base_model,
                                  version: int) -> None:

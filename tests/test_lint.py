@@ -337,6 +337,61 @@ class TestAlertSchemaRule:
         assert len(fs) == 1
 
 
+class TestControllerVerdictRule:
+    def test_bare_action_call_detected_with_line(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/knobs.py", """\
+            def squeeze(batcher):
+                batcher.set_max_wait_ms(1.0)
+            """, rule="controller-verdict-attached")
+        assert len(fs) == 1
+        assert fs[0].line == 2
+        assert "set_max_wait_ms" in fs[0].message
+
+    def test_verdict_carrying_record_passes(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/knobs.py", """\
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            def squeeze(batcher, verdict):
+                batcher.set_max_wait_ms(1.0)
+                _flight.record("controller_retune", action="shrink",
+                               verdict=verdict.status)
+            """, rule="controller-verdict-attached")
+        assert fs == []
+
+    def test_controller_record_without_verdict_detected(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/knobs.py", """\
+            from deeplearning4j_tpu.obs import flight as _flight
+
+            def squeeze(batcher):
+                batcher.set_max_wait_ms(1.0)
+                _flight.record("controller_retune", action="shrink")
+            """, rule="controller-verdict-attached")
+        # two findings: the verdict-less record AND the action call it
+        # fails to attribute
+        assert sorted(f.line for f in fs) == [4, 5]
+        assert any("verdict=" in f.message for f in fs)
+
+    def test_lambda_defers_the_action(self, tmp_path):
+        # building an actuator is not taking an action — the deferred
+        # call is attributed where the lambda is eventually invoked
+        fs = findings_for(tmp_path, "pkg/wire.py", """\
+            def actuator(router, model):
+                return lambda n: router.scale_generation_slots(model, n)
+            """, rule="controller-verdict-attached")
+        assert fs == []
+
+    def test_action_methods_themselves_exempt(self, tmp_path):
+        fs = findings_for(tmp_path, "pkg/router.py", """\
+            class Router:
+                def demote_tenant(self, tenant, quota):
+                    self._quotas[tenant] = quota
+
+                def restore_tenant(self, tenant):
+                    self.demote_tenant(tenant, None)
+            """, rule="controller-verdict-attached")
+        assert fs == []
+
+
 class TestParseError:
     def test_unparseable_file_is_a_finding(self, tmp_path):
         fs = findings_for(tmp_path, "pkg/broken.py",
@@ -453,6 +508,10 @@ SEEDS = {
         "from deeplearning4j_tpu.obs import flight as _flight\n\n"
         "def w():\n"
         "    _flight.record(\"never_declared_event_q\")\n"),
+    "controller-verdict-attached": (
+        "pkg/loadgen/knobs.py", 2,
+        "def squeeze(batcher):\n"
+        "    batcher.set_max_wait_ms(1.0)\n"),
 }
 
 

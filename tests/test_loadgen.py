@@ -1,0 +1,542 @@
+"""Load generation + adaptive capacity tests (loadgen/ package).
+
+Plan compilation is deterministic (same seed → identical fingerprint,
+serde roundtrips preserve identity, overrides produce a stream that
+carries its EFFECTIVE seed), validation fails fast with typed
+messages, the injected clocks honor the forward-only/compression
+contracts, the runner replays a compiled stream against a real
+DynamicBatcher with typed outcomes and tick-aligned controller pumping,
+and each capacity controller closes its observe→act loop: DeadlineTuner
+shrink/relax/bucket-learning (zero steady-state retraces,
+compile-counter-asserted), SlotScaler with the memory-estimator gate,
+TenantDemoter demote + quiet-restore against a real ModelRouter (the
+``tenant_demoted`` alert fires off the gauge it sets), ModelPrewarmer
+forecast-driven prewarm/evict, and the ControllerHub containing
+actuator faults. The oscillation chaos drill itself runs in
+test_chaos.py's fast-drill matrix."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.loadgen import (
+    BUILTIN_PLANS,
+    ControllerHub,
+    DeadlineTuner,
+    LoadPlan,
+    LoadRunner,
+    ModelPrewarmer,
+    SimClock,
+    SlotScaler,
+    TenantDemoter,
+    VirtualClock,
+    batcher_target,
+    diurnal_flash_plan,
+)
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.obs import flight as _flight
+from deeplearning4j_tpu.obs.alerts import AlertEvaluator
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry
+from deeplearning4j_tpu.obs.slo import default_rules
+from deeplearning4j_tpu.serving import BucketPolicy, InferenceEngine
+from deeplearning4j_tpu.serving.batcher import (
+    DynamicBatcher,
+    make_dispatcher,
+)
+from deeplearning4j_tpu.serving.metrics import ServingMetrics
+
+
+def _steady_plan(duration_s=2.0, rps=40.0, seed=1, tick_s=0.5):
+    return LoadPlan(
+        [{"process": "poisson", "rps": rps}],
+        [{"name": "steady", "kind": "predict",
+          "rows": {"dist": "lognormal", "median": 2, "sigma": 0.5,
+                   "max": 8}}],
+        name="test-steady", seed=seed, duration_s=duration_s,
+        tick_s=tick_s)
+
+
+def _net(seed=7, n_in=4):
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _events_since(seq, kinds=None):
+    return [e for e in _flight.default_flight_recorder().events()
+            if e["seq"] >= seq and (kinds is None or e["kind"] in kinds)]
+
+
+class _Verdict:
+    def __init__(self, status="healthy", firing=()):
+        self.status = status
+        self.firing = [{"name": n} for n in firing]
+
+
+def _hub(controllers=(), registry=None):
+    return ControllerHub(AlertEvaluator([], registry=registry,
+                                        min_tick_interval=0.0),
+                         controllers)
+
+
+# ---------------------------------------------------------------------------
+# plan compilation: determinism, identity, validation
+# ---------------------------------------------------------------------------
+class TestLoadPlan:
+    def test_same_seed_identical_stream(self):
+        plan = diurnal_flash_plan(duration_s=20.0)
+        s1, s2 = plan.compile(), plan.compile()
+        assert s1.fingerprint() == s2.fingerprint()
+        assert [r.key() for r in s1] == [r.key() for r in s2]
+
+    def test_seed_override_changes_stream_and_identity(self):
+        plan = _steady_plan(seed=1)
+        base = plan.compile()
+        over = plan.compile(seed=2)
+        assert over.fingerprint() != base.fingerprint()
+        # the derived stream must CARRY the effective seed — replaying
+        # "seed 2" twice matches, and the original plan is untouched
+        assert over.plan.seed == 2 and plan.seed == 1
+        assert over.fingerprint() == plan.compile(seed=2).fingerprint()
+
+    def test_duration_override_carried(self):
+        plan = _steady_plan(duration_s=4.0)
+        short = plan.compile(duration_s=1.0)
+        assert short.plan.duration_s == 1.0
+        assert short.duration_s() <= 1.0
+        assert short.fingerprint() != plan.compile().fingerprint()
+
+    def test_serde_roundtrip_preserves_stream(self):
+        plan = diurnal_flash_plan(duration_s=15.0)
+        clone = LoadPlan.from_json(plan.to_json())
+        assert clone.compile().fingerprint() == plan.compile().fingerprint()
+
+    def test_requests_sorted_with_stable_rids(self):
+        s = _steady_plan().compile()
+        ts = [r.t for r in s]
+        assert ts == sorted(ts)
+        assert [r.rid for r in s] == list(range(len(s)))
+
+    def test_adversarial_patterns_shape_requests(self):
+        plan = LoadPlan(
+            [{"process": "poisson", "rps": 30.0}],
+            [{"name": "spam", "adversarial": "one_token_spam"},
+             {"name": "flood", "kind": "predict",
+              "adversarial": "deadline_flood", "deadline_ms": 1.0,
+              "rows": {"dist": "const", "value": 1}}],
+            name="adv", seed=3, duration_s=3.0)
+        reqs = list(plan.compile())
+        spam = [r for r in reqs if r.tenant == "spam"]
+        flood = [r for r in reqs if r.tenant == "flood"]
+        assert spam and all(r.kind == "generate" and r.max_new == 1
+                            for r in spam)
+        assert flood and all(r.deadline_ms == 1.0 for r in flood)
+
+    def test_flash_crowd_shows_in_forecast(self):
+        plan = diurnal_flash_plan(duration_s=60.0)
+        # flash lands at 0.55 * duration — the forecast must spike there
+        assert plan.forecast(33.0) > 2 * plan.forecast(5.0)
+
+    @pytest.mark.parametrize("mutation, match", [
+        ({"arrivals": [{"process": "warp"}]}, "unknown process"),
+        ({"arrivals": []}, "at least one arrival"),
+        ({"tenants": []}, "at least one tenant"),
+        ({"tenants": [{"name": "t", "kind": "teleport"}]},
+         "unknown kind"),
+        ({"duration_s": -1.0}, "must be > 0"),
+    ])
+    def test_validation_fails_fast(self, mutation, match):
+        body = {"arrivals": [{"process": "poisson", "rps": 1.0}],
+                "tenants": [{"name": "t", "kind": "predict"}],
+                "name": "bad", "duration_s": 5.0}
+        body.update(mutation)
+        with pytest.raises(ValueError, match=match):
+            LoadPlan.from_dict(body).compile()
+
+    def test_builtins_compile(self):
+        for name, factory in BUILTIN_PLANS.items():
+            s = factory(duration_s=5.0).compile()
+            assert len(s) > 0, name
+
+
+# ---------------------------------------------------------------------------
+# injected clocks
+# ---------------------------------------------------------------------------
+class TestClocks:
+    def test_virtual_clock_forward_only(self):
+        c = VirtualClock()
+        assert c() == 0.0
+        c.advance(2.5)
+        c.set(4.0)
+        assert c.now() == 4.0
+        with pytest.raises(ValueError):
+            c.advance(-1.0)
+        with pytest.raises(ValueError):
+            c.set(3.0)
+
+    def test_sim_clock_compression(self):
+        wall = [100.0]
+        c = SimClock(compression=60.0, wall=lambda: wall[0])
+        assert c.now() == 0.0
+        wall[0] += 0.5  # half a wall second = 30 simulated seconds
+        assert c.now() == pytest.approx(30.0)
+        assert c.wall_remaining(60.0) == pytest.approx(0.5)
+        assert c.sleep_until(10.0) is True  # already past: no block
+
+    def test_sim_clock_rejects_bad_compression(self):
+        with pytest.raises(ValueError):
+            SimClock(compression=0.0)
+
+
+# ---------------------------------------------------------------------------
+# runner: replay against a real batcher, typed outcomes, tick pumping
+# ---------------------------------------------------------------------------
+class TestLoadRunner:
+    def test_replay_through_real_batcher(self):
+        met = ServingMetrics()
+        batcher = DynamicBatcher(
+            make_dispatcher(lambda x, mask=None: np.asarray(x) * 2.0,
+                            metrics=met),
+            batch_limit=16, max_wait_ms=2.0, queue_limit=256,
+            metrics=met)
+        try:
+            s = _steady_plan(duration_s=2.0, rps=40.0).compile()
+            report = LoadRunner(s, batcher_target(batcher, (4,)),
+                                compression=20.0).run()
+        finally:
+            batcher.shutdown(drain=False)
+        assert report.submitted == len(s)
+        assert report.ok() > 0.9 * len(s)
+        assert report.p(0.99) > 0.0
+        assert "steady" in report.by_tenant
+
+    def test_typed_submit_rejects_become_outcomes(self):
+        class TeapotError(Exception):
+            pass
+
+        def target(req):
+            raise TeapotError("short and stout")
+
+        report = LoadRunner(_steady_plan(duration_s=1.0).compile(),
+                            target, compression=50.0).run()
+        assert report.outcomes.get("TeapotError", 0) == report.submitted
+        assert report.ok() == 0
+
+    def test_on_tick_pumped_at_tick_boundaries(self):
+        ticks = []
+        LoadRunner(_steady_plan(duration_s=2.0, tick_s=0.5).compile(),
+                   lambda req: (lambda: None), compression=50.0,
+                   on_tick=ticks.append).run()
+        assert ticks == sorted(ticks)
+        # every boundary in (0, duration + tick] observed exactly once
+        assert len(ticks) >= 4 and len(set(ticks)) == len(ticks)
+
+    def test_steady_state_quantile_skips_warm_in(self):
+        s = _steady_plan(duration_s=1.0).compile()
+        report = LoadRunner(s, lambda req: (lambda: None),
+                            compression=50.0).run()
+        report.timed_latencies = [(0.1, 9.0), (0.2, 9.0), (6.0, 0.5),
+                                  (7.0, 0.5)]
+        assert report.p_steady(0.99, skip_s=5.0) == 0.5
+
+    def test_replay_records_flight_events(self):
+        seq = _flight.default_flight_recorder().recorded_total
+        s = _steady_plan(duration_s=1.0).compile()
+        LoadRunner(s, lambda req: (lambda: None), compression=50.0).run()
+        evs = _events_since(seq, {"loadgen_start", "loadgen_done"})
+        assert [e["kind"] for e in evs] == ["loadgen_start",
+                                           "loadgen_done"]
+        assert evs[0]["fingerprint"] == s.fingerprint()[:16]
+
+
+# ---------------------------------------------------------------------------
+# DeadlineTuner: breach → shrink, clear → relax, calm → bucket learning
+# ---------------------------------------------------------------------------
+class TestDeadlineTuner:
+    def _breach_evaluator(self):
+        reg = MetricsRegistry()
+        gauge = reg.gauge("serving_latency_p99_ms", "test signal")
+        ev = AlertEvaluator(default_rules(latency_slo_ms=100.0),
+                            registry=reg, min_tick_interval=0.0)
+        return ev, gauge
+
+    def test_shrink_on_breach_relax_on_clear(self):
+        ev, gauge = self._breach_evaluator()
+        batcher = DynamicBatcher(lambda batch: None, max_wait_ms=8.0)
+        try:
+            tuner = DeadlineTuner(batcher, cooldown_s=5.0,
+                                  min_rows=10 ** 9)
+            hub = ControllerHub(ev, [tuner])
+            seq = _flight.default_flight_recorder().recorded_total
+            gauge.set(400.0)
+            hub.tick(0.0)           # pending (for_s hysteresis)
+            hub.tick(3.0)           # firing → shrink 8 → 4
+            assert batcher.max_wait_s == pytest.approx(4e-3)
+            hub.tick(4.0)           # cooldown suppresses the flap
+            assert batcher.max_wait_s == pytest.approx(4e-3)
+            hub.tick(9.0)           # still breached → 4 → 2
+            assert batcher.max_wait_s == pytest.approx(2e-3)
+            gauge.set(10.0)
+            # below threshold but resolve_s hysteresis keeps it FIRING
+            # — one more shrink, exactly the flap suppression contract
+            hub.tick(14.0)
+            assert batcher.max_wait_s == pytest.approx(1e-3)
+            hub.tick(25.0)          # resolved → relax 1 → 1.5
+            assert batcher.max_wait_s == pytest.approx(1.5e-3)
+            for now in (31.0, 37.0, 43.0, 49.0, 55.0):
+                hub.tick(now)
+            # relaxed back to the configured deadline, never past it
+            assert batcher.max_wait_s == pytest.approx(8e-3)
+            evs = _events_since(seq, {"controller_retune"})
+            assert {e["action"] for e in evs} == {"deadline_shrink",
+                                                 "deadline_relax"}
+            assert all("verdict" in e for e in evs)
+            shrinks = [e for e in evs if e["action"] == "deadline_shrink"]
+            assert shrinks[0]["alerts"] == ["serving_latency_slo_breach"]
+        finally:
+            batcher.shutdown(drain=False)
+
+    def test_calm_traffic_learns_buckets_with_zero_retraces(self):
+        met = ServingMetrics()
+        engine = InferenceEngine(
+            _net(), buckets=BucketPolicy(batch_buckets=[32],
+                                         max_batch=32), metrics=met)
+        engine.warmup()
+        # the observed mix: small dispatches a [32]-only policy wastes
+        rng = np.random.default_rng(0)
+        for _ in range(64):
+            met.record_dispatch(32, int(rng.integers(1, 5)))
+        batcher = DynamicBatcher(lambda batch: None, max_wait_ms=5.0)
+        try:
+            tuner = DeadlineTuner(batcher, engine=engine, min_rows=32,
+                                  cooldown_s=0.0)
+            hub = ControllerHub(
+                AlertEvaluator([], registry=met.registry,
+                               min_tick_interval=0.0), [tuner])
+            seq = _flight.default_flight_recorder().recorded_total
+            c0 = engine.compile_count
+            hub.tick(0.0)
+            learned = list(engine.buckets.batch_buckets)
+            assert learned != [32] and learned[-1] == 32
+            evs = _events_since(seq, {"controller_retune"})
+            assert len(evs) == 1 and evs[0]["action"] == "bucket_retune"
+            # pre-compile-before-switch: the retune paid its compiles...
+            assert engine.compile_count - c0 == evs[0]["compiles"] > 0
+            # ...so steady-state traffic at the learned buckets is free
+            c1 = engine.compile_count
+            for b in learned:
+                engine.infer(np.zeros((b, 4), np.float32))
+            assert engine.compile_count == c1
+        finally:
+            batcher.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# SlotScaler: breach doubles, quiet halves, the estimator gates growth
+# ---------------------------------------------------------------------------
+class TestSlotScaler:
+    def _scaler(self, **kw):
+        calls = []
+
+        def apply(n):
+            calls.append(n)
+            return {"slots": n, "previous": None, "changed": True}
+
+        kw.setdefault("cooldown_s", 0.0)
+        return SlotScaler(apply, slots=2, min_slots=1, max_slots=8,
+                          idle_for_s=10.0, **kw), calls
+
+    def test_breach_doubles_quiet_halves(self):
+        scaler, calls = self._scaler()
+        hub = _hub()
+        breach = _Verdict("degraded", ["overload_rejections"])
+        scaler.tick(0.0, breach, {"overload_rejections"}, hub)
+        scaler.tick(1.0, breach, {"overload_rejections"}, hub)
+        assert calls == [4, 8] and scaler.slots == 8
+        # capped at max_slots
+        scaler.tick(2.0, breach, {"overload_rejections"}, hub)
+        assert calls == [4, 8]
+        # quiet long enough → halve back down
+        scaler.tick(13.0, _Verdict(), set(), hub)
+        assert calls[-1] == 4 and scaler.slots == 4
+        # idle window re-measures from the LAST breach
+        scaler.tick(14.0, _Verdict(), set(), hub)  # cooldown_s=0, idle ok
+        assert scaler.slots == 2
+
+    def test_memory_estimator_gates_scale_up(self, monkeypatch):
+        from deeplearning4j_tpu.serving import generate as gen_mod
+
+        monkeypatch.setattr(
+            gen_mod, "generation_memory_report",
+            lambda model, n, max_length=None, draft_layers=0:
+            {"total_bytes": 10 ** 12})
+        scaler, calls = self._scaler(base_model=object(),
+                                     memory_limit_bytes=1024)
+        scaler.tick(0.0, _Verdict("degraded", ["overload_rejections"]),
+                    {"overload_rejections"}, _hub())
+        assert calls == [] and scaler.slots == 2
+
+    def test_actions_feed_storm_counter(self):
+        reg = MetricsRegistry()
+        scaler, _ = self._scaler()
+        hub = _hub(registry=reg)
+        scaler.tick(0.0, _Verdict("degraded", ["overload_rejections"]),
+                    {"overload_rejections"}, hub)
+        assert reg.family_sum("controller_actions_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# TenantDemoter against a real router; the gauge feeds the alert
+# ---------------------------------------------------------------------------
+class TestTenantDemoter:
+    def test_demote_then_restore_after_quiet(self, tmp_path):
+        from deeplearning4j_tpu.serving import ModelRegistry, ModelRouter
+        from deeplearning4j_tpu.train.faults import save_checkpoint
+
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        reg.publish("m", save_checkpoint(_net(), str(tmp_path / "ck")),
+                    score=0.5)
+        router = ModelRouter(reg, refresh_s=60.0, max_wait_ms=1.0)
+        try:
+            demoter = TenantDemoter(router, restore_after_s=10.0,
+                                    cooldown_s=0.0, abuse_share=0.5)
+            hub = _hub(registry=router.metrics.registry)
+            x = np.zeros((1, 4), np.float32)
+            for _ in range(8):
+                router.submit("m", x, tenant="spammy").result(timeout=10)
+            router.submit("m", x, tenant="steady").result(timeout=10)
+            breach = _Verdict("degraded", ["serving_latency_slo_breach"])
+            demoter.tick(0.0, breach, {"serving_latency_slo_breach"},
+                         hub)
+            assert list(demoter.demoted) == ["spammy"]
+            demoted_g = router.metrics.registry.get(
+                "serving_tenants_demoted")
+            assert demoted_g is not None and demoted_g.value() == 1
+            # the gauge the demoter set IS the tenant_demoted alert
+            # input — close the loop through the real rule pack
+            ev = AlertEvaluator(default_rules(),
+                                registry=router.metrics.registry,
+                                min_tick_interval=0.0)
+            ev.tick(0.0)
+            ev.tick(1.0)
+            assert "tenant_demoted" in ev.fired_names()
+            # a demoted tenant hits its clamped quota with typed errors
+            from deeplearning4j_tpu.serving import (
+                TenantQuotaExceededError,
+            )
+
+            reqs = [router.submit("m", x, tenant="spammy")]
+            with pytest.raises(TenantQuotaExceededError):
+                for _ in range(8):
+                    reqs.append(router.submit("m", x, tenant="spammy"))
+            for r in reqs:
+                try:
+                    r.result(timeout=10)
+                except Exception:  # noqa: BLE001 — drain; outcomes
+                    # themselves are not under test here
+                    pass
+            # still breached → no restore; quiet long enough → restored
+            demoter.tick(5.0, breach, {"serving_latency_slo_breach"},
+                         hub)
+            assert list(demoter.demoted) == ["spammy"]
+            demoter.tick(16.0, _Verdict(), set(), hub)
+            assert list(demoter.demoted) == []
+            assert demoted_g.value() == 0
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ModelPrewarmer: forecast-driven prewarm and idle eviction
+# ---------------------------------------------------------------------------
+class TestModelPrewarmer:
+    def test_prewarm_then_evict_on_idle_forecast(self, tmp_path):
+        from deeplearning4j_tpu.serving import ModelRegistry, ModelRouter
+        from deeplearning4j_tpu.train.faults import save_checkpoint
+
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        reg.publish("hot", save_checkpoint(_net(1),
+                                           str(tmp_path / "ck1")),
+                    score=0.5)
+        router = ModelRouter(reg, refresh_s=60.0, max_wait_ms=1.0)
+        try:
+            forecast = {"hot": 5.0}
+            warmer = ModelPrewarmer(router, lambda t: forecast,
+                                    warm_rps=1.0, evict_idle_s=0.0,
+                                    cooldown_s=0.0)
+            hub = _hub(registry=router.metrics.registry)
+            seq = _flight.default_flight_recorder().recorded_total
+            assert router.live_models() == []
+            warmer.tick(0.0, _Verdict(), set(), hub)
+            assert router.live_models() == ["hot"]
+            # the first real request lands on an already-warm engine
+            router.submit("hot", np.zeros((1, 4), np.float32)) \
+                  .result(timeout=10)
+            # forecast collapses → idle model evicted
+            forecast.clear()
+            time.sleep(0.05)
+            warmer.tick(1.0, _Verdict(), set(), hub)
+            assert router.live_models() == []
+            kinds = [e["kind"] for e in _events_since(
+                seq, {"controller_prewarm", "controller_evict"})]
+            assert kinds == ["controller_prewarm", "controller_evict"]
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ControllerHub: fault containment and the verdict fan-out
+# ---------------------------------------------------------------------------
+class TestControllerHub:
+    def test_actuator_fault_contained(self):
+        ticked = []
+
+        class Boom:
+            name = "boom"
+
+            def tick(self, now, verdict, firing, hub):
+                raise RuntimeError("actuator wedged")
+
+        class Counts:
+            name = "counts"
+
+            def tick(self, now, verdict, firing, hub):
+                ticked.append(now)
+
+        hub = ControllerHub(AlertEvaluator([], min_tick_interval=0.0),
+                            [Boom(), Counts()])
+        verdict = hub.tick(0.0)
+        assert hub.errors == 1
+        assert ticked == [0.0]  # the loop survived the wedged actuator
+        assert verdict.status in ("healthy", "unknown")
+        assert any(r["action"] == "error" for r in hub.recent)
+
+    def test_oscillation_drill_registered(self):
+        from deeplearning4j_tpu.chaos import drills
+
+        d = drills.DRILLS["controller_oscillation"]
+        assert d.fast  # runs in test_chaos.py's fast matrix
+        assert "serving_latency_slo_breach" in d.expected_alerts
+        assert "controller.act" in d.seams
+
+
+# ---------------------------------------------------------------------------
+# the CLI surface (in-process; subprocess coverage in drive_loadgen.py)
+# ---------------------------------------------------------------------------
+def test_cli_loadgen_compile_only_json(capsys):
+    from deeplearning4j_tpu import cli
+
+    rc = cli.main(["loadgen", "--builtin", "cluster", "--compile-only",
+                   "--json", "--duration-s", "5", "--seed", "2"])
+    body = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert body["seed"] == 2 and body["n_requests"] > 0
+    assert body["fingerprint"] == BUILTIN_PLANS["cluster"]().compile(
+        duration_s=5.0, seed=2).fingerprint()
