@@ -1557,6 +1557,85 @@ def drill_controller_oscillation(ctx: DrillContext):
         router.shutdown()
 
 
+@drill("fit", ["data.shard_read"], expected_alerts=["shard_skips"])
+def drill_data_torn_shard_skip(ctx: DrillContext):
+    """A shard torn mid-epoch: the reader raises typed TornShardError,
+    the loader skips the shard with a shard_skip forensic and the fit
+    completes on the survivors; resume replay from a mid-stream
+    data_state is bit-identical past the skipped shard."""
+    from deeplearning4j_tpu.data import (
+        ExistingDataSetIterator,
+        ShardedLoader,
+        TornShardError,
+        pack_iterator,
+        read_shard,
+    )
+
+    batches = _batches(n=12, per=8, seed=5)
+    sd = ctx.path("shards")
+    pack_iterator(ExistingDataSetIterator(list(batches)), sd,
+                  batches_per_shard=3)  # 4 shards x 3 batches
+    # tear the shard the epoch-0 plan reads SECOND — genuinely
+    # mid-epoch, after the stream has already emitted batches
+    probe = ShardedLoader(sd, num_workers=1, seed=11)
+    victim = probe.epoch_plan(0)[1]
+    victim_name = probe._names[victim]
+    # times=None: a torn file stays torn on EVERY read — each loader
+    # below must see the same damage (default budget is one injection)
+    plan = ChaosPlan([{"seam": "data.shard_read", "mode": "torn",
+                       "match": {"path_substr": victim_name},
+                       "times": None}],
+                     name=ctx.name)
+    # 1) the typed contract at the reader itself
+    with plan.armed():
+        _res, err = ctx.capture(read_shard, os.path.join(sd, victim_name))
+    ctx.expect_error(err, TornShardError)
+    # 2) the loader under the same injection: fit completes, the torn
+    # shard's 3 batches are dropped deterministically
+    model = _net(seed=4)
+    loader = ShardedLoader(sd, num_workers=2, seed=11)
+    with plan.armed():
+        _res, err = ctx.capture(model.fit, loader, epochs=1)
+    ctx.report.add("fit_completed_past_torn_shard", err is None
+                   and model.iteration == len(batches) - 3,
+                   f"iteration={model.iteration} err={err}")
+    ctx.report.add("shard_skip_forensic",
+                   bool(ctx.events(["shard_skip"])),
+                   victim_name)
+    ctx.report.add("data_state_on_model",
+                   (model._data_state or {}).get("batches")
+                   == len(batches) - 3,
+                   str(model._data_state))
+    # 3) resume replay bit-identical past the skip: consume 4 batches,
+    # capture the position, resume a FRESH loader from it — suffix
+    # streams must match bit for bit (fingerprint chain equality)
+    def run(state=None, n=None):
+        ld = ShardedLoader(sd, num_workers=2, seed=11)
+        if state is not None:
+            ld.restore_state(state)
+        taken = 0
+        while ld.has_next() and (n is None or taken < n):
+            ld.next()
+            taken += 1
+        st = ld.data_state()
+        ld.shutdown()
+        return st
+
+    with plan.armed():
+        oracle = run()
+        mid = run(n=4)
+        resumed = run(state=mid)
+    ctx.report.add("resume_bit_identical_past_skip",
+                   resumed["fingerprint"] == oracle["fingerprint"]
+                   and resumed["batches"] == oracle["batches"],
+                   f"{resumed['fingerprint'][:12]} vs "
+                   f"{oracle['fingerprint'][:12]}")
+    invariants.check_typed_errors(ctx.report, ctx.errors)
+    invariants.check_event_order(ctx.report, ctx.events(),
+                                 ["shard_torn", "shard_skip",
+                                  "data_resume"])
+
+
 # ==========================================================================
 # custom plans over stock workloads (cli chaos --plan)
 # ==========================================================================

@@ -41,6 +41,7 @@ def typed_error_bases() -> tuple:
     cheap to import."""
     from deeplearning4j_tpu.chaos.fslayer import StorageError
     from deeplearning4j_tpu.chaos.hooks import InjectedFaultError
+    from deeplearning4j_tpu.data.shards import TornShardError
     from deeplearning4j_tpu.serving.batcher import ServingError
     from deeplearning4j_tpu.serving.registry import RegistryError
     from deeplearning4j_tpu.train.faults import (
@@ -51,7 +52,7 @@ def typed_error_bases() -> tuple:
 
     return (StorageError, ServingError, RegistryError,
             TrainingDivergedError, ElasticRecoveryExhaustedError,
-            MeshFailureError, InjectedFaultError,
+            MeshFailureError, InjectedFaultError, TornShardError,
             # deliberate caller-contract errors: a missing checkpoint
             # or an invalid argument is a typed verdict, not a leak
             FileNotFoundError, ValueError)

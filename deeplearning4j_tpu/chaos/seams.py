@@ -159,6 +159,12 @@ register_hook_seam(
     "an adaptive-capacity controller about to actuate its knob "
     "(controller/action ctx; mode 'error' = broken actuator — the "
     "ControllerHub must contain it and keep ticking)")
+register_hook_seam(
+    "data.shard_read", "data",
+    "a record shard about to be opened + decoded by the input "
+    "pipeline (mode 'torn' + match={'path_substr': …} = a specific "
+    "shard torn mid-epoch — the loader must skip it typed; enospc/eio "
+    "= the data volume failing under the reader)")
 
 
 # --------------------------------------------------------------------------

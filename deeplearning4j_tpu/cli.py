@@ -1086,6 +1086,76 @@ def loadgen_main(argv) -> int:
     return 0 if report.ok() > 0 else 1
 
 
+def data_main(argv) -> int:
+    """``cli data pack|verify`` — the record-shard toolchain.
+
+    ``pack`` drains a named dataset into a shard directory (the same
+    builder the trainer uses, so a packed directory trains bit-identical
+    to the in-memory iterator); ``verify`` CRC-checks every shard a
+    manifest names and exits non-zero on any damage — the offline half
+    of the torn-shard contract (the online half is the loader's typed
+    skip-and-continue).
+    """
+    import json
+    import os
+
+    ap = argparse.ArgumentParser(prog="deeplearning4j_tpu data")
+    sub = ap.add_subparsers(dest="action", required=True)
+
+    pk = sub.add_parser("pack", help="drain a dataset into record shards")
+    pk.add_argument("--dataset", default="mnist",
+                    help="mnist | iris | svhn | tinyimagenet | uci")
+    pk.add_argument("--batch-size", type=int, default=64)
+    pk.add_argument("--num-examples", type=int, default=None)
+    pk.add_argument("--out", required=True, help="shard directory")
+    pk.add_argument("--shard-size", type=int, default=8,
+                    help="batches per shard file")
+    pk.add_argument("--seed", type=int, default=0,
+                    help="pinned into the manifest (loader shuffles "
+                         "derive from it by default)")
+
+    vf = sub.add_parser("verify", help="CRC-check every shard in a dir")
+    vf.add_argument("dir", help="shard directory (with manifest.json)")
+    vf.add_argument("--json", action="store_true",
+                    help="machine-readable per-shard report")
+
+    args = ap.parse_args(argv)
+    if args.action == "pack":
+        from deeplearning4j_tpu.data.shards import pack_iterator
+
+        it, _num_classes = build_dataset(args.dataset, args.batch_size,
+                                         args.num_examples)
+        manifest = pack_iterator(it, args.out,
+                                 batches_per_shard=args.shard_size,
+                                 seed=args.seed)
+        print(f"packed {manifest['total_batches']} batches "
+              f"(batch size {manifest['batch_size']}) into "
+              f"{manifest['num_shards']} shard(s) at {args.out}",
+              flush=True)
+        return 0
+
+    from deeplearning4j_tpu.data.shards import TornShardError, verify_dir
+
+    try:
+        report = verify_dir(args.dir)
+    except TornShardError as e:
+        if args.json:
+            print(json.dumps({"ok": False, "error": str(e)}))
+        else:
+            print(f"verify failed: {e}", flush=True)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for r in report["shards"]:
+            tag = "ok" if r["ok"] else f"BAD ({r['error']})"
+            print(f"{os.path.basename(r['path'])}: {r['records']} "
+                  f"record(s) {tag}", flush=True)
+        print(f"{report['num_shards']} shard(s), {report['bad']} bad",
+              flush=True)
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv[:1] == ["serve"]:
@@ -1102,6 +1172,8 @@ def main(argv=None) -> int:
         return lint_main(argv[1:])
     if argv[:1] == ["loadgen"]:
         return loadgen_main(argv[1:])
+    if argv[:1] == ["data"]:
+        return data_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="deeplearning4j_tpu",
         description="Train a zoo model (ParallelWrapperMain equivalent)",
@@ -1135,6 +1207,25 @@ def main(argv=None) -> int:
                          "single steps)")
     ap.add_argument("--queue-size", type=int, default=4,
                     help="async prefetch queue depth of the fit loop")
+    ap.add_argument("--data-dir", default=None,
+                    help="train from a record-shard directory (cli data "
+                         "pack) via the multi-worker ShardedLoader "
+                         "instead of the in-memory --dataset iterator; "
+                         "--dataset still sizes the model. The stream "
+                         "order is deterministic in (seed, epoch, step) "
+                         "and its position rides in checkpoints, so "
+                         "--resume replays the exact batch stream")
+    ap.add_argument("--data-workers", type=int, default=2,
+                    help="decoder threads of the sharded loader "
+                         "(any count yields the identical stream)")
+    ap.add_argument("--data-seed", type=int, default=0,
+                    help="shard/record shuffle seed of the sharded "
+                         "loader")
+    ap.add_argument("--augment", default=None,
+                    help="on-device augmentation spec fused ahead of the "
+                         "train step, e.g. "
+                         "'normalize:0.13:0.31,crop:2,noise:0.01' "
+                         "(jitted once; zero steady-state retraces)")
     ap.add_argument("--telemetry", action="store_true",
                     help="in-graph training telemetry: per-step gradient/"
                          "param global norms, update:param ratio and loss "
@@ -1209,8 +1300,33 @@ def main(argv=None) -> int:
                     help="give up when fewer devices than this survive")
     args = ap.parse_args(argv)
 
-    it, num_classes = build_dataset(args.dataset, args.batch_size,
-                                    args.num_examples)
+    if args.data_dir:
+        if args.elastic or args.publish_to:
+            raise SystemExit("--data-dir cannot combine with --elastic/"
+                             "--publish-to yet (both materialize the "
+                             "epoch as a list, which would discard the "
+                             "loader's resume position)")
+        from deeplearning4j_tpu.data.loader import ShardedLoader
+        from deeplearning4j_tpu.data.shards import load_manifest
+
+        manifest = load_manifest(args.data_dir)
+        lshape = (manifest["schema"].get("labels") or {}).get("shape")
+        if lshape:
+            # the one-hot width IS the class count; --dataset still
+            # names the input geometry for build_model
+            num_classes = int(lshape[0])
+        else:
+            _, num_classes = build_dataset(args.dataset, args.batch_size,
+                                           args.num_examples)
+        it = ShardedLoader(args.data_dir, num_workers=args.data_workers,
+                           seed=args.data_seed)
+        print(f"sharded loader: {manifest['num_shards']} shard(s), "
+              f"{manifest['total_batches']} batches/epoch, "
+              f"{args.data_workers} worker(s), seed {args.data_seed}",
+              flush=True)
+    else:
+        it, num_classes = build_dataset(args.dataset, args.batch_size,
+                                        args.num_examples)
     model = None
     if args.resume:
         if not args.checkpoint_dir:
@@ -1249,6 +1365,24 @@ def main(argv=None) -> int:
         model = build_model(args.model, num_classes, args.dataset,
                             compute_dtype=args.compute_dtype,
                             remat_policy=args.remat_policy)
+    if args.data_dir:
+        dstate = getattr(model, "_data_state", None)
+        if args.resume and dstate is not None:
+            # the checkpoint carries the data position next to the RNG
+            # chain; restoring it replays the exact batch stream the
+            # interrupted run would have consumed
+            it.restore_state(dstate)
+            print(f"data resume: epoch {dstate['epoch']} shard pos "
+                  f"{dstate['shard_pos']} record pos "
+                  f"{dstate['record_pos']} ({dstate['batches']} batches "
+                  "consumed)", flush=True)
+    if args.augment:
+        from deeplearning4j_tpu.data.augment import parse_augment_spec
+
+        stage = parse_augment_spec(args.augment, seed=args.data_seed)
+        model.set_augmentation(stage)
+        print(f"augmentation: {stage.spec()} (jitted on-device, keyed "
+              "by iteration)", flush=True)
     if args.skip_nonfinite or args.max_bad_steps is not None:
         from deeplearning4j_tpu.train.faults import FaultPolicy
 
@@ -1431,6 +1565,21 @@ def main(argv=None) -> int:
         model.fit(it, epochs=args.epochs)
     print(f"trained {model.iteration} iterations in {time.time()-t0:.1f}s, "
           f"final score {float(model.score_):.4f}", flush=True)
+    if args.data_dir:
+        # the stream's rolling fingerprint — an interrupted+resumed run
+        # must print the same hex as the uninterrupted oracle (the
+        # drive script's bit-identity gate)
+        st = it.data_state()
+        print(f"data stream fingerprint {st['fingerprint']} "
+              f"(batches={st['batches']})", flush=True)
+        it.shutdown()
+    if flight_dir is not None:
+        from deeplearning4j_tpu.obs.flight import default_flight_recorder
+
+        # final dump on CLEAN exit too: a successful run's forensics
+        # (data_resume, shard_skip, recoveries survived) are part of
+        # the black-box record, not only failures
+        default_flight_recorder().dump()
     if publish_listener is not None:
         print(f"published {len(publish_listener.published)} snapshot(s) "
               f"to {args.publish_to}, "

@@ -60,6 +60,18 @@ from deeplearning4j_tpu.data.fetchers import (
     TinyImageNetDataSetIterator,
     UciSequenceDataSetIterator,
 )
+from deeplearning4j_tpu.data.shards import (
+    TornShardError,
+    assign_host_shards,
+    load_manifest,
+    pack_iterator,
+    read_shard,
+    verify_dir,
+    verify_shard,
+    write_shard,
+)
+from deeplearning4j_tpu.data.loader import ShardedLoader
+from deeplearning4j_tpu.data.augment import AugmentStage, parse_augment_spec
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
@@ -83,4 +95,7 @@ __all__ = [
     "JointParallelDataSetIterator", "FileDataSetIterator",
     "DummyPreProcessor", "CombinedPreProcessor",
     "BatchBundle", "DeviceDataSet", "iter_bundled", "iter_grouped",
+    "TornShardError", "assign_host_shards", "load_manifest",
+    "pack_iterator", "read_shard", "verify_dir", "verify_shard",
+    "write_shard", "ShardedLoader", "AugmentStage", "parse_augment_spec",
 ]

@@ -1,0 +1,123 @@
+"""On-the-fly augmentation as a jitted device stage (1810.09868's
+move-work-into-the-compiled-graph discipline: normalize / crop / noise
+are pure ``jnp`` transforms dispatched on device ahead of the train
+step, not Python-loop preprocessing on the host).
+
+Determinism: every randomized transform derives its key by
+``fold_in(PRNGKey(seed), iteration)``, so the augmented stream is a
+pure function of ``(seed, iteration)`` — resume-from-checkpoint at
+iteration *t* replays the exact same crops and noise the uninterrupted
+run would have applied. The iteration is passed as a *traced* scalar,
+so steady-state dispatches never retrace (the stage is wrapped in
+``obs.trace.count_retraces`` and tier-1 asserts zero steady-state
+retraces).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.obs import trace as _trace
+
+
+class AugmentStage:
+    """Configurable device-side augmentation pipeline.
+
+    * ``normalize=(mean, std)`` — ``(x - mean) / std``;
+    * ``crop=k`` — random spatial shift of up to ±k px (edge-padded,
+      NHWC inputs only; non-spatial inputs pass through);
+    * ``noise=s`` — additive Gaussian noise of std ``s``.
+
+    ``apply(features, iteration)`` handles one batch;
+    ``apply_bundle(features, it0)`` a stacked ``(k, b, …)`` bundle,
+    folding ``it0 + j`` per inner step so bundled and unbundled fits
+    see identical per-iteration randomness.
+    """
+
+    def __init__(self, normalize: Optional[Tuple[float, float]] = None,
+                 crop: int = 0, noise: float = 0.0, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        if normalize is not None and float(normalize[1]) == 0.0:
+            raise ValueError("normalize std must be non-zero")
+        if crop < 0:
+            raise ValueError(f"crop must be >= 0, got {crop}")
+        self.normalize = (tuple(float(v) for v in normalize)
+                          if normalize is not None else None)
+        self.crop = int(crop)
+        self.noise = float(noise)
+        self.seed = int(seed)
+        key0 = jax.random.PRNGKey(self.seed)
+        norm, crop_px, noise_std = self.normalize, self.crop, self.noise
+
+        def _aug(x, key):
+            dtype = x.dtype
+            if norm is not None:
+                x = (x - norm[0]) / norm[1]
+            if crop_px and x.ndim == 4:
+                k_crop, key = jax.random.split(key)
+                pad = [(0, 0), (crop_px, crop_px), (crop_px, crop_px),
+                       (0, 0)]
+                padded = jnp.pad(x, pad, mode="edge")
+                oy, ox = jax.random.randint(k_crop, (2,), 0,
+                                            2 * crop_px + 1)
+                x = jax.lax.dynamic_slice(
+                    padded, (0, oy, ox, 0), x.shape)
+            if noise_std:
+                x = x + noise_std * jax.random.normal(key, x.shape,
+                                                      jnp.float32)
+            return x.astype(dtype)
+
+        def _batch(x, iteration):
+            return _aug(x, jax.random.fold_in(key0, iteration))
+
+        def _bundle(x, it0):
+            k = x.shape[0]
+            keys = jax.vmap(
+                lambda j: jax.random.fold_in(key0, it0 + j))(jnp.arange(k))
+            # vmap over the bundle axis, but crop offsets must match the
+            # unbundled path, so _aug sees one (b, …) batch per step
+            return jax.vmap(_aug)(x, keys)
+
+        self.apply = jax.jit(_trace.count_retraces("augment_batch", _batch))
+        self.apply_bundle = jax.jit(
+            _trace.count_retraces("augment_bundle", _bundle))
+
+    def spec(self) -> str:
+        parts = []
+        if self.normalize is not None:
+            parts.append(f"normalize:{self.normalize[0]}:{self.normalize[1]}")
+        if self.crop:
+            parts.append(f"crop:{self.crop}")
+        if self.noise:
+            parts.append(f"noise:{self.noise}")
+        return ",".join(parts) or "identity"
+
+    def __repr__(self):
+        return f"AugmentStage({self.spec()}, seed={self.seed})"
+
+
+def parse_augment_spec(spec: str, seed: int = 0) -> AugmentStage:
+    """``"normalize:0.13:0.31,crop:2,noise:0.01"`` → AugmentStage (the
+    CLI's ``--augment`` grammar)."""
+    normalize, crop, noise = None, 0, 0.0
+    for part in (p.strip() for p in spec.split(",") if p.strip()):
+        fields = part.split(":")
+        name = fields[0]
+        try:
+            if name == "normalize":
+                if len(fields) != 3:
+                    raise ValueError("normalize wants mean:std")
+                normalize = (float(fields[1]), float(fields[2]))
+            elif name == "crop":
+                crop = int(fields[1])
+            elif name == "noise":
+                noise = float(fields[1])
+            else:
+                raise ValueError(f"unknown transform '{name}' "
+                                 "(normalize/crop/noise)")
+        except (IndexError, ValueError) as e:
+            raise ValueError(f"bad --augment spec {part!r}: {e}") from None
+    return AugmentStage(normalize=normalize, crop=crop, noise=noise,
+                        seed=seed)

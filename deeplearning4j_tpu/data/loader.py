@@ -1,0 +1,371 @@
+"""Multi-worker prefetching shard loader with deterministic global
+order (the DataVec half's runtime: reference
+``RecordReaderDataSetIterator`` + ``AsyncDataSetIterator``, rebuilt as
+a shard-granular pipeline).
+
+Determinism contract
+--------------------
+The batch stream is a pure function of ``(seed, epoch, host)`` —
+independent of worker count, thread scheduling, and decode timing:
+
+* shard order   = seeded permutation of the host's shard set,
+  ``SeedSequence([seed, epoch, host_index])``;
+* record order  = seeded permutation within each shard,
+  ``SeedSequence([seed, epoch, shard_index])``;
+* workers decode whole shards out of order into a reassembly buffer;
+  the consumer emits strictly in plan order.
+
+``data_state()`` captures the NEXT stream position
+``(epoch, shard_pos, record_pos)`` plus a running SHA-256 fingerprint
+of every emitted batch's bytes; ``restore_state`` seeks a fresh loader
+to that position (skipping whole shards by manifest record counts, so
+resume does not re-decode consumed data). Checkpoint ``meta.json``
+carries this dict next to the RNG chain, so resume-from-checkpoint —
+SIGKILL mid-epoch included — replays the exact batch stream.
+
+Torn shards (``TornShardError``) are skipped with a ``shard_skip``
+forensic, not fatal: the fit completes on the surviving shards and the
+skip is deterministic, so resumed streams stay bit-identical past the
+damage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.data.shards import (
+    TornShardError,
+    assign_host_shards,
+    load_manifest,
+    read_shard,
+)
+from deeplearning4j_tpu.obs import flight
+from deeplearning4j_tpu.obs.metrics import (
+    add_consumer_wait,
+    data_pipeline_metrics,
+    default_registry,
+)
+
+
+def _perm(rng_words, n: int) -> np.ndarray:
+    return np.random.default_rng(np.random.SeedSequence(rng_words)).permutation(n)
+
+
+class ShardedLoader(DataSetIterator):
+    """DataSetIterator over a packed shard directory with N decode
+    workers and a bounded reassembly buffer (at most ``max_pending``
+    decoded shards in memory)."""
+
+    def __init__(self, shard_dir: str, *, num_workers: int = 2,
+                 seed: int = 0, max_pending: int = 4,
+                 host_index: int = 0, host_count: int = 1,
+                 pool: str = "shard_loader", registry=None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self.shard_dir = shard_dir
+        self.manifest = load_manifest(shard_dir)
+        self.seed = int(seed)
+        self.num_workers = int(num_workers)
+        self.max_pending = max(1, int(max_pending))
+        self.host_index = int(host_index)
+        self.host_count = int(host_count)
+        self.pool = pool
+        self._host_shards = assign_host_shards(
+            self.manifest["num_shards"], self.host_count, self.host_index)
+        self._records = [e["records"] for e in self.manifest["shards"]]
+        self._names = [e["name"] for e in self.manifest["shards"]]
+
+        reg = registry if registry is not None else default_registry()
+        labels = {"pool": pool}
+        self._depth, _prod_wait, self._cons_wait = data_pipeline_metrics(
+            reg, pool=pool)
+        self._batches_total = reg.counter(
+            "data_batches_read_total",
+            "batches emitted by sharded loaders (absence rule signal)",
+            labels=labels)
+        self._skips_total = reg.counter(
+            "data_shard_skips_total",
+            "torn/corrupt shards skipped by sharded loaders",
+            labels=labels)
+
+        self.pre_processor = None
+        self._epoch = 0
+        self._batches_emitted = 0
+        # rolling fingerprint chain over per-record digests:
+        # fp_n = sha256(fp_{n-1} || sha256(features_n || labels_n)).
+        # The inner digest is computed on the decode workers (parallel,
+        # off the consumer's critical path); the outer chain hashes 64
+        # bytes per batch. A CHAIN (not one running hash object) so
+        # restore_state can
+        # reseed it from meta.json and a resumed run's final fingerprint
+        # equals the uninterrupted run's — the drive script's
+        # bit-identity gate compares exactly these
+        self._fp = "0" * 64
+        # resume seek: applied when the epoch's workers start
+        self._start_shard_pos = 0
+        self._start_record_pos = 0
+        # per-epoch runtime (built lazily on first has_next)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._started = False
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._plan: List[int] = []          # shard ids, plan order
+        self._results: Dict[int, Optional[List]] = {}  # pos -> batches|None(torn)
+        self._next_claim = 0
+        self._slots = threading.Semaphore(self.max_pending)
+        self._pos = 0                       # consumer shard position
+        self._rec_pos = 0
+        self._current: Optional[List] = None
+        self._errors: List[BaseException] = []
+
+    # -- deterministic plan ------------------------------------------------
+
+    def epoch_plan(self, epoch: int) -> List[int]:
+        """Shard ids this host reads in ``epoch``, in emission order."""
+        order = _perm([self.seed, int(epoch), self.host_index],
+                      len(self._host_shards))
+        return [self._host_shards[i] for i in order]
+
+    def record_order(self, epoch: int, shard_idx: int) -> np.ndarray:
+        return _perm([self.seed, int(epoch), int(shard_idx)],
+                     self._records[shard_idx])
+
+    # -- worker pool -------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._stop.clear()
+        self._plan = self.epoch_plan(self._epoch)
+        self._results = {}
+        self._next_claim = self._start_shard_pos
+        self._pos = self._start_shard_pos
+        self._rec_pos = self._start_record_pos
+        self._current = None
+        self._slots = threading.Semaphore(self.max_pending)
+        self._threads = []
+        n = min(self.num_workers, max(1, len(self._plan)))
+        for w in range(n):
+            t = threading.Thread(target=_worker_loop, args=(self, w),
+                                 name=f"shard-loader-{self.pool}-{w}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _decode(self, shard_idx: int) -> Optional[List]:
+        """Decode + reorder one shard; None = torn (skipped)."""
+        path = os.path.join(self.shard_dir, self._names[shard_idx])
+        try:
+            batches = read_shard(path)
+        except TornShardError as e:
+            flight.record("shard_skip", path=self._names[shard_idx],
+                          shard_index=int(shard_idx), reason=e.reason,
+                          pool=self.pool)
+            self._skips_total.inc()
+            return None
+        order = self.record_order(self._epoch, shard_idx)
+        out = []
+        for i in order:
+            ds = batches[i]
+            # per-record content digest, computed HERE on the worker
+            # (hashlib releases the GIL on large buffers, so the batch
+            # bytes are hashed in parallel with the consumer's compute);
+            # the consumer chains over these 32-byte digests only
+            h = hashlib.sha256()
+            h.update(np.ascontiguousarray(ds.features))
+            if ds.labels is not None:
+                h.update(np.ascontiguousarray(ds.labels))
+            out.append((ds, h.digest()))
+        return out
+
+    def _shutdown_workers(self) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        for _ in self._threads:
+            self._slots.release()  # unblock slot waits
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        self._started = False
+
+    # -- DataSetIterator protocol -----------------------------------------
+
+    def _advance(self) -> bool:
+        """Position ``self._current`` on a non-exhausted shard; False at
+        end of epoch."""
+        while True:
+            if self._current is not None and self._rec_pos < len(self._current):
+                return True
+            if self._current is not None:
+                self._pos += 1
+                self._rec_pos = 0
+                self._current = None
+            if self._pos >= len(self._plan):
+                return False
+            # wait for the reassembly buffer to fill this position
+            waited = 0.0
+            t0 = time.monotonic()
+            with self._cond:
+                while self._pos not in self._results:
+                    if self._errors:
+                        raise self._errors[0]
+                    self._cond.wait(timeout=0.1)
+                got = self._results.pop(self._pos)
+                self._depth.set(len(self._results))
+            waited = time.monotonic() - t0
+            if waited > 0.001:
+                self._cons_wait.inc(waited)
+                add_consumer_wait(waited)
+            self._slots.release()
+            if got is None:  # torn shard — deterministic skip
+                self._pos += 1
+                self._rec_pos = 0
+                self._current = None
+                continue
+            self._current = got
+
+    def has_next(self) -> bool:
+        self._ensure_started()
+        return self._advance()
+
+    def next(self):
+        if not self.has_next():
+            raise StopIteration
+        ds, digest = self._current[self._rec_pos]
+        self._rec_pos += 1
+        self._batches_emitted += 1
+        self._batches_total.inc()
+        self._fp = hashlib.sha256(
+            bytes.fromhex(self._fp) + digest).hexdigest()
+        return self._pp(ds)
+
+    def reset(self) -> None:
+        """End of epoch: advance to the next epoch's plan (fresh seeded
+        shuffle). Matches ``fit``'s reset-per-epoch contract."""
+        if self._started:
+            self._shutdown_workers()
+        self._epoch += 1
+        self._start_shard_pos = 0
+        self._start_record_pos = 0
+
+    def batch(self) -> int:
+        return int(self.manifest.get("batch_size", 0))
+
+    def reset_supported(self) -> bool:
+        return True
+
+    def async_supported(self) -> bool:
+        # the loader IS the async stage — double-wrapping in
+        # AsyncDataSetIterator would re-serialize it behind one queue
+        return False
+
+    def shutdown(self) -> None:
+        if self._started:
+            self._shutdown_workers()
+
+    # -- provenance --------------------------------------------------------
+
+    def data_state(self) -> Dict[str, Any]:
+        """NEXT stream position + running fingerprint — the dict that
+        rides in checkpoint ``meta.json`` next to the RNG chain."""
+        # account for a positioned-but-unread current shard
+        pos, rec = self._pos, self._rec_pos
+        if self._current is not None and rec >= len(self._current):
+            pos, rec = pos + 1, 0
+        if not self._started:
+            pos, rec = self._start_shard_pos, self._start_record_pos
+        return {
+            "format": "sharded_loader/v1",
+            "seed": self.seed,
+            "epoch": self._epoch,
+            "shard_pos": int(pos),
+            "record_pos": int(rec),
+            "batches": int(self._batches_emitted),
+            "fingerprint": self._fp,
+            "host_index": self.host_index,
+            "host_count": self.host_count,
+            "num_shards": self.manifest["num_shards"],
+        }
+
+    # keep the generic name too — `fit` duck-types on `data_state`
+    state = data_state
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        """Seek a fresh loader to a recorded position. Whole consumed
+        shards are skipped by manifest arithmetic (never re-decoded);
+        a partially consumed shard is re-decoded and fast-forwarded."""
+        if self._started:
+            raise ValueError("restore_state on a running loader; "
+                             "call before iteration starts")
+        if state.get("num_shards") != self.manifest["num_shards"]:
+            raise ValueError(
+                f"data_state is for {state.get('num_shards')} shards, "
+                f"dir has {self.manifest['num_shards']} — repacked?")
+        if state.get("seed") != self.seed or \
+                state.get("host_index") != self.host_index or \
+                state.get("host_count") != self.host_count:
+            raise ValueError(
+                "data_state (seed/host) does not match this loader; "
+                f"state={state.get('seed')}/{state.get('host_index')}"
+                f"/{state.get('host_count')}, loader={self.seed}/"
+                f"{self.host_index}/{self.host_count}")
+        self._epoch = int(state["epoch"])
+        self._start_shard_pos = int(state["shard_pos"])
+        self._start_record_pos = int(state["record_pos"])
+        self._batches_emitted = int(state.get("batches", 0))
+        self._fp = str(state.get("fingerprint", "0" * 64))
+        flight.record("data_resume", epoch=self._epoch,
+                      shard_pos=self._start_shard_pos,
+                      record_pos=self._start_record_pos,
+                      batches=self._batches_emitted, pool=self.pool)
+
+
+def _worker_loop(loader: ShardedLoader, worker_id: int) -> None:
+    """Decode worker: claim the next plan position, decode the whole
+    shard, publish into the reassembly buffer. Runs until the plan is
+    drained or the loader stops."""
+    done = 0
+    reason = "plan_drained"
+    try:
+        while not loader._stop.is_set():
+            # slot FIRST, then claim: only slot-holders own positions, so
+            # the in-flight set is always the next max_pending plan
+            # positions in order and the consumer's head position is
+            # always being decoded (claim-then-slot can livelock — the
+            # head's worker starving on a buffer full of later shards)
+            loader._slots.acquire()
+            if loader._stop.is_set():
+                break
+            with loader._lock:
+                pos = loader._next_claim
+                if pos >= len(loader._plan):
+                    loader._slots.release()
+                    break
+                loader._next_claim = pos + 1
+                shard_idx = loader._plan[pos]
+            result = loader._decode(shard_idx)
+            with loader._cond:
+                loader._results[pos] = result
+                loader._depth.set(len(loader._results))
+                loader._cond.notify_all()
+            done += 1
+    except BaseException as e:  # surfaced on the consumer side
+        reason = f"error:{type(e).__name__}"
+        with loader._cond:
+            loader._errors.append(e)
+            loader._cond.notify_all()
+    finally:
+        if loader._stop.is_set() and reason == "plan_drained":
+            reason = "stopped"
+        flight.record("loader_worker_exit", worker=worker_id,
+                      shards_decoded=done, reason=reason, pool=loader.pool)

@@ -1,0 +1,332 @@
+"""Sharded record-file format — the DataVec half's storage layer
+(reference layer 3: ``RecordWriter``/``RecordReader`` over record
+files; 1605.08695 models the input pipeline as dataflow feeding the
+training graph, so the on-disk unit is the *batch* the graph consumes,
+not the row).
+
+One shard = one file of fixed-shape serialized batches:
+
+    header : MAGIC(8) | u32 len | header-JSON | u32 crc32(header-JSON)
+    record : u32 batch_n | u64 payload_len | u32 crc32(payload) | payload
+    footer : FOOT_MAGIC(8) | u64 n_records | u32 crc32(record-crc chain)
+
+``payload`` is ``features.tobytes() + labels.tobytes()`` with shapes and
+dtypes pinned by the header schema — decode is a pair of
+``np.frombuffer`` calls, no pickling. Every record carries its own
+CRC32 so a flipped byte is caught at the record it corrupts; the footer
+chains the record CRCs so a truncated tail (torn write, ENOSPC
+mid-stream) is caught even when the cut lands exactly on a record
+boundary. All durable writes stage through ``chaos/fslayer`` with
+``surface="data"`` — torn-shard and ENOSPC semantics are typed
+(``TornShardError`` / ``StorageError``) and drill-able via the
+``data.shard_read`` hook seam.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.chaos import fslayer, hooks
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.obs import flight
+
+MAGIC = b"DL4JSHD1"
+FOOT_MAGIC = b"DL4JEND1"
+SHARD_SUFFIX = ".dl4jshard"
+MANIFEST_NAME = "manifest.json"
+FORMAT_VERSION = 1
+
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+_REC = struct.Struct("<IQI")  # batch_n, payload_len, payload_crc
+
+
+class TornShardError(OSError):
+    """A shard failed structural validation — bad magic, CRC mismatch,
+    or a truncated tail. Subclasses ``OSError`` so it sits in the typed
+    storage taxonomy next to ``StorageError``; carries the shard path
+    and what tore."""
+
+    def __init__(self, path: str, reason: str):
+        super().__init__(f"torn shard {path}: {reason}")
+        self.path = path
+        self.reason = reason
+
+
+def shard_name(index: int, num_shards: int) -> str:
+    return f"shard-{index:05d}-of-{num_shards:05d}{SHARD_SUFFIX}"
+
+
+def _schema_of(ds: DataSet) -> Dict[str, Any]:
+    f = np.asarray(ds.features)
+    l = np.asarray(ds.labels) if ds.labels is not None else None
+    schema = {
+        "features": {"shape": list(f.shape[1:]), "dtype": str(f.dtype)},
+        "labels": ({"shape": list(l.shape[1:]), "dtype": str(l.dtype)}
+                   if l is not None else None),
+    }
+    return schema
+
+
+def _encode_record(ds: DataSet, schema: Dict[str, Any]) -> bytes:
+    f = np.ascontiguousarray(np.asarray(
+        ds.features, dtype=schema["features"]["dtype"]))
+    payload = f.tobytes()
+    if schema["labels"] is not None:
+        l = np.ascontiguousarray(np.asarray(
+            ds.labels, dtype=schema["labels"]["dtype"]))
+        if l.shape[0] != f.shape[0]:
+            raise ValueError(
+                f"features batch {f.shape[0]} != labels batch {l.shape[0]}")
+        payload += l.tobytes()
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return _REC.pack(f.shape[0], len(payload), crc) + payload
+
+
+def _decode_record(batch_n: int, payload: bytes,
+                   schema: Dict[str, Any]) -> DataSet:
+    fs = schema["features"]
+    fshape = (batch_n, *fs["shape"])
+    fbytes = int(np.prod(fshape, dtype=np.int64)) * np.dtype(fs["dtype"]).itemsize
+    feats = np.frombuffer(payload[:fbytes], dtype=fs["dtype"]).reshape(fshape)
+    labels = None
+    if schema["labels"] is not None:
+        ls = schema["labels"]
+        lshape = (batch_n, *ls["shape"])
+        labels = np.frombuffer(payload[fbytes:], dtype=ls["dtype"]).reshape(lshape)
+    return DataSet(feats.copy(), labels.copy() if labels is not None else None)
+
+
+def write_shard(path: str, batches: Sequence[DataSet], *,
+                shard_index: int = 0, num_shards: int = 1,
+                seed: int = 0) -> Dict[str, Any]:
+    """Serialize ``batches`` into one shard file. Staged write: encode
+    to a tmp sibling through fslayer (surface=data), fsync, atomic
+    rename — a crash mid-write leaves the previous artifact (or
+    nothing), never a half-shard under the final name."""
+    if not batches:
+        raise ValueError("write_shard: empty batch list")
+    from deeplearning4j_tpu.train.faults import atomic_tmp_path
+
+    schema = _schema_of(batches[0])
+    header = {
+        "version": FORMAT_VERSION,
+        "shard_index": int(shard_index),
+        "num_shards": int(num_shards),
+        "num_records": len(batches),
+        "batch_size": int(np.asarray(batches[0].features).shape[0]),
+        "seed": int(seed),
+        "schema": schema,
+    }
+    hbytes = json.dumps(header, sort_keys=True).encode("utf-8")
+    chain = 0
+    tmp = atomic_tmp_path(path)
+    n_bytes = 0
+    try:
+        f = fslayer.open_for_write(tmp, "wb", surface="data")
+        try:
+            f.write(MAGIC)
+            f.write(_U32.pack(len(hbytes)))
+            f.write(hbytes)
+            f.write(_U32.pack(zlib.crc32(hbytes) & 0xFFFFFFFF))
+            for ds in batches:
+                rec = _encode_record(ds, schema)
+                # footer chain = CRC over each record's CRC bytes (the
+                # last 4 bytes of the record prefix) — catches a tail
+                # truncated exactly on a record boundary
+                chain = zlib.crc32(rec[_REC.size - _U32.size:_REC.size],
+                                   chain) & 0xFFFFFFFF
+                f.write(rec)
+            f.write(FOOT_MAGIC)
+            f.write(_U64.pack(len(batches)))
+            f.write(_U32.pack(chain))
+            f.flush()
+            fslayer.fsync_file(f, tmp, surface="data")
+            n_bytes = f.tell()
+        finally:
+            f.close()
+        fslayer.replace(tmp, path, surface="data")
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    flight.record("shard_write", path=os.path.basename(path),
+                  shard_index=int(shard_index), records=len(batches),
+                  bytes=int(n_bytes))
+    return header
+
+
+def _torn(path: str, reason: str) -> TornShardError:
+    flight.record("shard_torn", path=os.path.basename(path), reason=reason)
+    return TornShardError(path, reason)
+
+
+def read_header(f, path: str) -> Dict[str, Any]:
+    magic = f.read(len(MAGIC))
+    if magic != MAGIC:
+        raise _torn(path, f"bad magic {magic!r}")
+    raw = f.read(_U32.size)
+    if len(raw) < _U32.size:
+        raise _torn(path, "truncated header length")
+    (hlen,) = _U32.unpack(raw)
+    hbytes = f.read(hlen)
+    crc_raw = f.read(_U32.size)
+    if len(hbytes) < hlen or len(crc_raw) < _U32.size:
+        raise _torn(path, "truncated header")
+    (hcrc,) = _U32.unpack(crc_raw)
+    if (zlib.crc32(hbytes) & 0xFFFFFFFF) != hcrc:
+        raise _torn(path, "header CRC mismatch")
+    header = json.loads(hbytes.decode("utf-8"))
+    if header.get("version") != FORMAT_VERSION:
+        raise _torn(path, f"unsupported version {header.get('version')}")
+    return header
+
+
+def read_shard(path: str) -> List[DataSet]:
+    """Decode every record of a shard, validating per-record CRCs and
+    the footer chain. Any structural damage raises ``TornShardError``
+    (typed, with a ``shard_torn`` forensic already recorded)."""
+    spec = hooks.fire("data.shard_read", path=path, surface="data")
+    if spec is not None and spec.mode == "torn":
+        raise _torn(path, "injected torn read (chaos data.shard_read)")
+    with open(path, "rb") as f:
+        header = read_header(f, path)
+        schema = header["schema"]
+        n = int(header["num_records"])
+        out: List[DataSet] = []
+        chain = 0
+        for i in range(n):
+            raw = f.read(_REC.size)
+            if len(raw) < _REC.size:
+                raise _torn(path, f"truncated at record {i}/{n}")
+            batch_n, plen, pcrc = _REC.unpack(raw)
+            payload = f.read(plen)
+            if len(payload) < plen:
+                raise _torn(path, f"truncated payload at record {i}/{n}")
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != pcrc:
+                raise _torn(path, f"CRC mismatch at record {i}/{n}")
+            chain = zlib.crc32(_U32.pack(pcrc), chain) & 0xFFFFFFFF
+            out.append(_decode_record(batch_n, payload, schema))
+        foot = f.read(len(FOOT_MAGIC) + _U64.size + _U32.size)
+        if len(foot) < len(FOOT_MAGIC) + _U64.size + _U32.size:
+            raise _torn(path, "truncated footer")
+        if foot[:len(FOOT_MAGIC)] != FOOT_MAGIC:
+            raise _torn(path, "bad footer magic")
+        (fn,) = _U64.unpack(foot[len(FOOT_MAGIC):len(FOOT_MAGIC) + _U64.size])
+        (fchain,) = _U32.unpack(foot[-_U32.size:])
+        if fn != n or fchain != chain:
+            raise _torn(path, "footer chain mismatch")
+    return out
+
+
+def verify_shard(path: str) -> Dict[str, Any]:
+    """Structural check of one shard; never raises — returns
+    ``{"path", "ok", "records", "error"}``."""
+    try:
+        batches = read_shard(path)
+        return {"path": path, "ok": True, "records": len(batches),
+                "error": None}
+    except (TornShardError, OSError) as e:
+        return {"path": path, "ok": False, "records": 0, "error": str(e)}
+
+
+def pack_iterator(it, out_dir: str, *, batches_per_shard: int = 8,
+                  seed: int = 0) -> Dict[str, Any]:
+    """Drain a ``DataSetIterator`` into a shard directory + manifest.
+    Shard boundaries fall every ``batches_per_shard`` batches in
+    iterator order; the manifest pins per-shard record counts (resume
+    skip arithmetic) and the schema (loader decode without opening a
+    shard)."""
+    os.makedirs(out_dir, exist_ok=True)
+    it.reset()
+    batches: List[DataSet] = []
+    while it.has_next():
+        batches.append(it.next())
+    if not batches:
+        raise ValueError("pack_iterator: iterator produced no batches")
+    groups = [batches[i:i + batches_per_shard]
+              for i in range(0, len(batches), batches_per_shard)]
+    num_shards = len(groups)
+    shards = []
+    for i, group in enumerate(groups):
+        name = shard_name(i, num_shards)
+        write_shard(os.path.join(out_dir, name), group, shard_index=i,
+                    num_shards=num_shards, seed=seed)
+        shards.append({"name": name, "records": len(group)})
+    manifest = {
+        "version": FORMAT_VERSION,
+        "num_shards": num_shards,
+        "batches_per_shard": int(batches_per_shard),
+        "total_batches": len(batches),
+        "seed": int(seed),
+        "schema": _schema_of(batches[0]),
+        "batch_size": int(np.asarray(batches[0].features).shape[0]),
+        "shards": shards,
+    }
+    fslayer.write_atomic(
+        os.path.join(out_dir, MANIFEST_NAME),
+        json.dumps(manifest, indent=2, sort_keys=True),
+        surface="data")
+    return manifest
+
+
+def load_manifest(shard_dir: str) -> Dict[str, Any]:
+    path = os.path.join(shard_dir, MANIFEST_NAME)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise TornShardError(path, "missing manifest (not a shard dir?)")
+    except json.JSONDecodeError as e:
+        raise TornShardError(path, f"corrupt manifest: {e}")
+
+
+def verify_dir(shard_dir: str) -> Dict[str, Any]:
+    """Verify every shard a manifest names. Missing shards count as
+    torn. Never raises on per-shard damage (the manifest itself must
+    parse)."""
+    manifest = load_manifest(shard_dir)
+    results = []
+    for entry in manifest["shards"]:
+        path = os.path.join(shard_dir, entry["name"])
+        if not os.path.exists(path):
+            results.append({"path": path, "ok": False, "records": 0,
+                            "error": "missing shard"})
+            continue
+        r = verify_shard(path)
+        if r["ok"] and r["records"] != entry["records"]:
+            r = {**r, "ok": False,
+                 "error": f"manifest says {entry['records']} records, "
+                          f"shard has {r['records']}"}
+        results.append(r)
+    n_bad = sum(1 for r in results if not r["ok"])
+    return {"num_shards": manifest["num_shards"], "bad": n_bad,
+            "ok": n_bad == 0, "shards": results}
+
+
+def assign_host_shards(num_shards: int, host_count: int,
+                       host_index: Optional[int] = None):
+    """Static disjoint round-robin shard assignment for the multihost
+    path: host ``h`` owns shards ``h, h+H, h+2H, …``. Every host
+    derives the same partition from (num_shards, host_count) alone, so
+    no coordination is needed and the global batch at step *t* is the
+    concat of each host's *t*-th batch — consistent with
+    ``make_sharded_train_step``'s per-host batch slices."""
+    if host_count < 1:
+        raise ValueError(f"host_count must be >= 1, got {host_count}")
+    assignments = [list(range(h, num_shards, host_count))
+                   for h in range(host_count)]
+    if host_index is None:
+        return assignments
+    if not 0 <= host_index < host_count:
+        raise ValueError(
+            f"host_index {host_index} out of range for {host_count} hosts")
+    return assignments[host_index]

@@ -163,6 +163,11 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.global_conf.seed)
         self._rnn_carries: Optional[List[Any]] = None
         self._jit_cache: Dict[str, Any] = {}
+        # input-pipeline provenance + device-side augmentation
+        # (data/loader.py, data/augment.py): _data_state rides in
+        # checkpoint meta.json next to the RNG chain
+        self._data_state: Optional[Dict[str, Any]] = None
+        self._augment = None
         cd = getattr(conf.global_conf, "compute_dtype", None)
         self._compute_dtype = None if cd is None else _dtype_of(cd)
 
@@ -469,6 +474,14 @@ class MultiLayerNetwork:
         return self._jit_cache[key]
 
     # ------------------------------------------------------------------- fit
+    def set_augmentation(self, stage) -> "MultiLayerNetwork":
+        """Attach an :class:`~deeplearning4j_tpu.data.augment.AugmentStage`
+        (or None to clear): a jitted device-side transform applied to
+        every batch's features ahead of the train step. Keyed by
+        iteration, so resumed fits replay the exact augmented stream."""
+        self._augment = stage
+        return self
+
     def fit(
         self,
         data: Union[DataSet, DataSetIterator, np.ndarray],
@@ -542,10 +555,16 @@ class MultiLayerNetwork:
                     self._fit_tbptt_batch(ds)
                 else:
                     self._fit_batch(step, ds, tconf)
+                # data-position provenance: the iterator's NEXT position
+                # lands on the model AFTER the step that consumed the
+                # pulled batches, so a checkpoint written between steps
+                # resumes the stream exactly where this step left it
+                _pipeline.capture_data_state(self, it)
         finally:
             if wrapped is not it:
                 wrapped.shutdown()  # join prefetch thread; caller resets inner
         it.reset()
+        _pipeline.capture_data_state(self, it)  # epoch-boundary position
         self.epoch += 1
         for lst in self.listeners:
             if hasattr(lst, "on_epoch_end"):
@@ -613,6 +632,10 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         features = jnp.asarray(ds.features)
+        if self._augment is not None:
+            # jitted device stage fused ahead of the train step —
+            # iteration passed as a dynamic scalar (no retrace per step)
+            features = self._augment.apply(features, self.iteration)
         labels = None if ds.labels is None else jnp.asarray(ds.labels)
         fmask = (None if ds.features_mask is None
                  else jnp.asarray(ds.features_mask))
@@ -676,6 +699,10 @@ class MultiLayerNetwork:
 
         k = bundle.k
         features = jnp.asarray(bundle.features)
+        if self._augment is not None:
+            # per-inner-step keys fold it0+j, so bundled and unbundled
+            # fits see identical per-iteration augmentation randomness
+            features = self._augment.apply_bundle(features, self.iteration)
         labels = None if bundle.labels is None else jnp.asarray(bundle.labels)
         fmask = (None if bundle.features_mask is None
                  else jnp.asarray(bundle.features_mask))

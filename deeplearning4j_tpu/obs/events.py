@@ -70,6 +70,22 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
     "journal_repair": ("chaos/fslayer.py",
                        "torn trailing journal line truncated before an "
                        "append (bytes dropped)"),
+    # -- input pipeline (data/shards.py, data/loader.py) ------------------
+    "shard_write": ("data/shards.py",
+                    "a record shard atomically published (path, "
+                    "records, bytes)"),
+    "shard_torn": ("data/shards.py",
+                   "shard failed structural validation (bad magic/CRC/"
+                   "truncated tail) — raised typed TornShardError"),
+    "shard_skip": ("data/loader.py",
+                   "loader skipped a torn shard and kept the epoch "
+                   "going (records dropped deterministically)"),
+    "data_resume": ("data/loader.py",
+                    "loader seeked to a checkpointed data position "
+                    "(epoch/shard/record) — resume replays the stream"),
+    "loader_worker_exit": ("data/loader.py",
+                           "a shard-decode worker exited (plan drained, "
+                           "stopped, or error — reason tagged)"),
     # -- serving / batching -----------------------------------------------
     "overload_reject": ("serving/batcher.py",
                         "typed backpressure: request rejected at the "
@@ -305,6 +321,10 @@ HOOK_POINTS: Dict[str, tuple] = {
                        "an adaptive-capacity controller about to "
                        "actuate its knob (controller + action ctx; "
                        "error mode = broken actuator drill)"),
+    "data.shard_read": ("data/shards.py",
+                        "a record shard about to be opened + decoded "
+                        "(torn mode = mid-epoch truncated-shard "
+                        "drill; enospc/eio = failing data volume)"),
 }
 
 
@@ -332,6 +352,13 @@ ALERTS: Dict[str, tuple] = {
     "data_queue_saturated": ("obs/slo.py",
                              "producer blocked on a full prefetch "
                              "queue (compute-bound verdict)"),
+    "data_loader_stalled": ("obs/slo.py",
+                            "a sharded loader that was emitting "
+                            "batches went silent (workers dead or "
+                            "wedged)"),
+    "shard_skips": ("obs/slo.py",
+                    "torn shards being skipped — records silently "
+                    "dropped from the epoch stream"),
     "nan_step_storm": ("obs/slo.py",
                        "non-finite gradient steps being skipped"),
     "training_diverged": ("obs/slo.py",

@@ -240,8 +240,12 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """JSON-ready view: scalar for unlabeled metrics, a
         ``{label-string: value}`` dict for labeled families; histograms
-        expose count/sum/quantiles."""
+        expose count/sum/quantiles. A family with BOTH an unlabeled
+        child and labeled children (e.g. the legacy async-prefetch path
+        next to pool-labeled shard loaders) renders as a dict with the
+        unlabeled child under ``""``."""
         out: Dict[str, object] = {}
+        mixed: set = set()
         for name, kind, _, lkey, m in self._series():
             if kind == "histogram":
                 val: object = {
@@ -251,8 +255,14 @@ class MetricsRegistry:
                 }
             else:
                 val = m.value()
-            if lkey:
-                fam = out.setdefault(name, {})
+            if lkey or name in mixed:
+                fam = out.get(name)
+                if not isinstance(fam, dict) or name not in mixed:
+                    # _series() sorts the unlabeled child ((), i.e. "")
+                    # first; demote its scalar into the family dict
+                    fam = {} if fam is None else {"": fam}
+                    out[name] = fam
+                    mixed.add(name)
                 fam[",".join(f"{k}={v}" for k, v in lkey)] = val
             else:
                 out[name] = val
@@ -309,36 +319,55 @@ def default_registry() -> MetricsRegistry:
         return _default
 
 
-# -- data-pipeline instrumentation (AsyncDataSetIterator hooks) -------------
-def data_pipeline_metrics(registry: Optional[MetricsRegistry] = None
+# -- data-pipeline instrumentation (AsyncDataSetIterator + ShardedLoader) ---
+def data_pipeline_metrics(registry: Optional[MetricsRegistry] = None,
+                          pool: Optional[str] = None
                           ) -> Tuple[Gauge, Counter, Counter]:
     """(queue-depth gauge, producer-wait counter, consumer-wait counter).
 
     Producer wait (queue full) means the device is the bottleneck —
     compute-bound; consumer wait (queue empty) means the input pipeline
     is — input-bound. PerformanceListener reports the consumer share of
-    wall time so a slow run says WHICH side to fix."""
+    wall time so a slow run says WHICH side to fix.
+
+    ``pool`` labels the metrics with the worker pool they instrument
+    (e.g. ``shard_loader``) — the ``data_queue_starved`` alert sums the
+    family but annotates which pool's consumer wait is moving, so the
+    page names the starving pool, not just "the data path"."""
     reg = registry or default_registry()
+    labels = {"pool": pool} if pool else None
     return (
         reg.gauge("data_queue_depth",
-                  "staged batches in the async prefetch queue"),
+                  "staged batches in the async prefetch queue",
+                  labels=labels),
         reg.counter("data_producer_wait_seconds_total",
                     "producer blocked on a full prefetch queue "
-                    "(compute-bound)"),
+                    "(compute-bound)", labels=labels),
         reg.counter("data_consumer_wait_seconds_total",
                     "fit loop blocked on an empty prefetch queue "
-                    "(input-bound)"),
+                    "(input-bound)", labels=labels),
     )
 
 
 def data_wait_seconds(registry: Optional[MetricsRegistry] = None
                       ) -> Tuple[float, float]:
-    """(producer_wait_s, consumer_wait_s) cumulative process totals."""
+    """(producer_wait_s, consumer_wait_s) cumulative process totals,
+    summed across every pool's labeled children."""
     reg = registry or default_registry()
-    p = reg.get("data_producer_wait_seconds_total")
-    c = reg.get("data_consumer_wait_seconds_total")
-    return ((p.value() if p is not None else 0.0),
-            (c.value() if c is not None else 0.0))
+    return (reg.family_sum("data_producer_wait_seconds_total"),
+            reg.family_sum("data_consumer_wait_seconds_total"))
+
+
+def starved_pools(registry: Optional[MetricsRegistry] = None
+                  ) -> Dict[str, float]:
+    """Per-pool cumulative consumer-wait seconds — the labels the
+    ``data_queue_starved`` alert annotation reads to name which worker
+    pool starved. The unlabeled child is the legacy single-producer
+    ``AsyncDataSetIterator`` path."""
+    reg = registry or default_registry()
+    vals = reg.family_values("data_consumer_wait_seconds_total")
+    return {(k if k else "async_prefetch"): v for k, v in vals.items()
+            if v > 0.0}
 
 
 # Consumer waits are ALSO accumulated per thread: the fit loop and its

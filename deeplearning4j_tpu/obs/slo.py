@@ -43,6 +43,20 @@ def _flight(kind: str) -> dict:
     return {"metric": FLIGHT_EVENT_METRIC, "labels": {"kind": kind}}
 
 
+def _starved_pool_reason(value: float) -> str:
+    """Firing-reason annotation for ``data_queue_starved``: name WHICH
+    worker pool's consumer wait is accumulating (the rule itself sums
+    the family). Reads the process-wide registry — the one the fit
+    loops publish into."""
+    from deeplearning4j_tpu.obs.metrics import starved_pools
+
+    pools = starved_pools()
+    named = ", ".join(f"{k}={v:.1f}s" for k, v in
+                      sorted(pools.items(), key=lambda kv: -kv[1]))
+    return (f"input-bound: consumer wait rate {value:.2f} "
+            f"(starved pools: {named or 'unknown'})")
+
+
 def default_rules(queue_limit: int = 256,
                   serving_slo_target: float = 0.99,
                   checkpoint_stale_s: float = 1800.0,
@@ -90,10 +104,27 @@ def default_rules(queue_limit: int = 256,
             "data_queue_starved", "rate",
             family="data_consumer_wait_seconds_total",
             op=">", threshold=0.5, window_s=60.0, resolve_s=120.0,
-            severity="warn",
+            severity="warn", annotate=_starved_pool_reason,
             description="fit loop blocked >50% of wall time on an empty "
                         "prefetch queue — the run is INPUT-bound; scale "
-                        "the data pipeline, not the mesh"),
+                        "the data pipeline, not the mesh (annotation "
+                        "names WHICH worker pool starved)"),
+        AlertRule(
+            "data_loader_stalled", "absence",
+            family="data_batches_read_total",
+            stale_s=120.0, severity="warn",
+            description="a sharded loader that was emitting batches "
+                        "went silent ≥2 min — decode workers dead or "
+                        "every read wedged; require_activity keeps "
+                        "fits without shard input quiet"),
+        AlertRule(
+            "shard_skips", "increase", **_flight("shard_skip"),
+            threshold=0.0, window_s=300.0, resolve_s=300.0,
+            severity="warn",
+            description="torn/corrupt shards being skipped by the "
+                        "loader — the fit survives but records are "
+                        "dropped from the epoch stream; verify + "
+                        "repack the shard dir"),
         AlertRule(
             "data_queue_saturated", "rate",
             family="data_producer_wait_seconds_total",

@@ -268,6 +268,31 @@ class ShardedDataSetIterator(DataSetIterator):
         return False
 
 
+def host_sharded_loader(shard_dir: str, *, host_index: Optional[int] = None,
+                        host_count: Optional[int] = None, **kwargs):
+    """This host's :class:`~deeplearning4j_tpu.data.loader.ShardedLoader`
+    over a packed shard directory — the genuinely host-partitioned
+    alternative to :class:`ShardedDataSetIterator`'s slice-the-global-
+    batch contract. Shard ownership is the static disjoint round-robin
+    of ``data.shards.assign_host_shards`` (host h owns shards h, h+H,
+    …), so every host derives the same partition with no coordination
+    and the global batch at step *t* is the concat of each host's
+    *t*-th batch, consistent with ``make_sharded_train_step``.
+
+    ``host_index``/``host_count`` default to this process's JAX
+    identity (``jax.process_index()`` / ``jax.process_count()``)."""
+    from deeplearning4j_tpu.data.loader import ShardedLoader
+
+    if host_index is None:
+        host_index = jax.process_index()
+    if host_count is None:
+        host_count = jax.process_count()
+    return ShardedLoader(shard_dir, host_index=int(host_index),
+                         host_count=int(host_count),
+                         pool=f"shard_loader_host{int(host_index)}",
+                         **kwargs)
+
+
 # --------------------------------------------------------------------------
 # TrainingMaster SPI
 # --------------------------------------------------------------------------

@@ -257,6 +257,13 @@ def _build_meta(model) -> dict:
                   else int(v))
               for k, v in host.items()}
         meta["fault_state"] = fs
+    # data-position provenance (data/loader.py ShardedLoader.data_state):
+    # the RNG chain above pins WHAT randomness resumes; this pins WHERE
+    # in the batch stream — together a SIGKILL-mid-epoch resume replays
+    # the exact stream an uninterrupted run would have consumed
+    dstate = getattr(model, "_data_state", None)
+    if dstate is not None:
+        meta["data"] = dstate
     return meta
 
 
@@ -267,6 +274,8 @@ def _restore_meta_state(net, meta: dict) -> None:
     rng = meta.get("rng")
     if rng is not None and hasattr(net, "_rng"):
         net._rng = jnp.asarray(np.asarray(rng, np.uint32))
+    if meta.get("data") is not None:
+        net._data_state = meta["data"]
     fs = meta.get("fault_state")
     if fs and hasattr(net, "fault_state_"):
         st = {

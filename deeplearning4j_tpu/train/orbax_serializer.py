@@ -99,14 +99,19 @@ class OrbaxModelSerializer:
             fslayer.write_atomic(os.path.join(directory, "conf.json"),
                                  model.conf.to_json(),
                                  surface="checkpoint")
+            meta = {
+                "iteration": model.iteration,
+                "epoch": model.epoch,
+                "model_type": type(model).__name__,
+                "save_updater": bool(save_updater),
+                "framework": "deeplearning4j_tpu",
+            }
+            # data-position provenance, same contract as the zip
+            # serializer's meta.json (model_serializer._build_meta)
+            if getattr(model, "_data_state", None) is not None:
+                meta["data"] = model._data_state
             fslayer.write_atomic(os.path.join(directory, "meta.json"),
-                                 json.dumps({
-                                     "iteration": model.iteration,
-                                     "epoch": model.epoch,
-                                     "model_type": type(model).__name__,
-                                     "save_updater": bool(save_updater),
-                                     "framework": "deeplearning4j_tpu",
-                                 }), surface="checkpoint")
+                                 json.dumps(meta), surface="checkpoint")
         if multi:
             _barrier("dl4jtpu_orbax_meta")  # metadata visible before the
             # cooperative array writes begin
@@ -170,6 +175,8 @@ class OrbaxModelSerializer:
             ckptr.close()
         net.iteration = meta.get("iteration", 0)
         net.epoch = meta.get("epoch", 0)
+        if meta.get("data") is not None:
+            net._data_state = meta["data"]
         return net
 
 

@@ -113,6 +113,20 @@ def bundling_blockers(listeners: Sequence[Any]) -> List[str]:
     return sorted(out)
 
 
+def capture_data_state(model, it) -> None:
+    """Record the iterator's stream position on the model, for
+    checkpoint ``meta.json`` provenance (model_serializer extends the
+    RNG chain with it). Duck-typed: iterators without ``data_state``
+    (the legacy async path) are a no-op — only position-aware sources
+    like ``data.loader.ShardedLoader`` participate. Called by the fit
+    loop after every dispatched step and at each epoch boundary, so any
+    checkpoint the listeners write carries the position the NEXT step
+    would read from."""
+    fn = getattr(it, "data_state", None)
+    if callable(fn):
+        model._data_state = fn()
+
+
 def resolve_steps_per_call(model, requested: Optional[int] = None) -> int:
     """Effective bundle size for a fit loop: the requested K (default:
     ``GlobalConf.steps_per_call``), clamped to 1 when a listener needs
