@@ -846,6 +846,144 @@ def drill_serving_dispatch_error(ctx: DrillContext):
         batcher.shutdown(drain=False)
 
 
+def _serving_mesh():
+    """The largest 2-D (batch, model) mesh the host's devices form —
+    (2, 4) on the 8-virtual-device test topology; degrades so the drill
+    still exercises the seam on smaller hosts."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.serving_mesh import ServingMesh
+
+    n = len(jax.devices())
+    if n >= 8:
+        return ServingMesh(batch=2, model=4,
+                           devices=jax.devices()[:8])
+    if n >= 2:
+        return ServingMesh(batch=1, model=2, devices=jax.devices()[:2])
+    return ServingMesh(batch=1, model=1)
+
+
+def _net_tp(seed: int = 3):
+    """Drill net with TP-divisible dims (hidden/out multiples of the
+    model axis)."""
+    return _net(seed=seed, hidden=8)
+
+
+@drill("serving", ["serving.sharded_dispatch"],
+       expected_alerts=["sharded_serving_fallback"])
+def drill_sharded_mesh_loss(ctx: DrillContext):
+    """A device subset dies mid-serve on the 2-D (batch, model) mesh:
+    the in-flight dispatch fails typed (ShardedMeshError), the engine
+    demotes itself to one-device solo serving, the next request gets a
+    correct answer, and the sharded_serving_fallback alert fires."""
+    from deeplearning4j_tpu.serving.sharded import (
+        ShardedInferenceEngine,
+        ShardedMeshError,
+    )
+
+    mesh = _serving_mesh()
+    engine = ShardedInferenceEngine(_net_tp(), mesh=mesh)
+    rows = np.random.default_rng(0).standard_normal(
+        (2, N_IN)).astype(np.float32)
+    healthy = engine.infer(rows)
+    plan = ChaosPlan([{"seam": "serving.sharded_dispatch",
+                       "mode": "error"}], name=ctx.name)
+    t0 = time.monotonic()
+    with plan.armed():
+        _res, err = ctx.capture(engine.infer, rows)
+    ctx.expect_error(err, ShardedMeshError)
+    ctx.report.add("fallback_armed", engine.fallback_active,
+                   "engine did not demote to solo")
+    out, err2 = ctx.capture(engine.infer, rows)
+    ctx.recovery_s = time.monotonic() - t0
+    ctx.report.add(
+        "solo_serves_correctly",
+        err2 is None and out is not None
+        and np.allclose(healthy, out, rtol=1e-5, atol=1e-6),
+        str(err2))
+    invariants.check_typed_errors(ctx.report, ctx.errors)
+    invariants.check_event_order(
+        ctx.report, ctx.events(),
+        ["mesh_build", "shard_load", "sharded_fallback"])
+
+
+@drill("registry_canary", ["registry.version_dispatch"],
+       expected_alerts=["canary_rolled_back"])
+def drill_sharded_canary_promote_rollback(ctx: DrillContext):
+    """Canary lifecycle with tensor-parallel candidates: on a 2-D
+    serving mesh a sharded v2 canary promotes cleanly (no fault), then
+    a sharded v3 canary's injected dispatch failures trip the standard
+    rollback — the canary state machine is placement-blind."""
+    from deeplearning4j_tpu.serving.registry import (
+        ModelRegistry,
+        ModelRouter,
+    )
+    from deeplearning4j_tpu.serving.sharded import ShardedInferenceEngine
+    from deeplearning4j_tpu.train.faults import save_checkpoint
+
+    mesh = _serving_mesh()
+    reg = ModelRegistry(ctx.path("reg"))
+    paths = [save_checkpoint(_net_tp(seed=s), ctx.path(f"ck{s}"))
+             for s in (1, 2, 3)]
+    reg.publish("m", paths[0], score=0.5)
+    router = ModelRouter(reg, mesh=mesh, canary_fraction=1.0,
+                         canary_window_s=0.2, canary_min_requests=1,
+                         refresh_s=0.0, max_wait_ms=1.0)
+    try:
+        rows = np.random.default_rng(0).standard_normal(
+            (2, N_IN)).astype(np.float32)
+        router.predict("m", rows, timeout=30)
+        mm = router._live.get("m")
+        ctx.report.add(
+            "active_engine_sharded",
+            isinstance(mm.active.engine, ShardedInferenceEngine),
+            type(mm.active.engine).__name__)
+        # leg 1: clean canary promotes
+        reg.publish("m", paths[1], score=0.45)
+        deadline = time.monotonic() + 30
+        promoted = False
+        while time.monotonic() < deadline and not promoted:
+            ctx.capture(router.predict, "m", rows, timeout=30)
+            time.sleep(0.05)
+            promoted = reg.get("m").get("active_version") == 2
+        ctx.report.add("sharded_canary_promoted", promoted,
+                       str(reg.get("m").get("active_version")))
+        # leg 2: failing canary rolls back
+        reg.publish("m", paths[2], score=0.4)
+        plan = ChaosPlan([{"seam": "registry.version_dispatch",
+                           "mode": "error",
+                           "match": {"role": "canary"}, "times": None}],
+                         name=ctx.name)
+        t0 = time.monotonic()
+        with plan.armed():
+            for _ in range(8):
+                ctx.capture(router.predict, "m", rows, timeout=30)
+                state = reg.get("m")
+                if (state.get("canary") is None
+                        and state["versions"].get("3", {}).get("status")
+                        == "rolled_back"):
+                    break
+        ctx.recovery_s = time.monotonic() - t0
+        state = reg.get("m")
+        ctx.report.add("sharded_canary_rolled_back",
+                       state["versions"].get("3", {}).get("status")
+                       == "rolled_back", str(state["versions"].get("3")))
+        ctx.report.add("promoted_version_untouched",
+                       state.get("active_version") == 2,
+                       f"active={state.get('active_version')}")
+        invariants.check_typed_errors(ctx.report, ctx.errors)
+        out, err = ctx.capture(router.predict, "m", rows, timeout=30)
+        ctx.report.add("active_still_serving",
+                       err is None and out is not None and out[1] == 2,
+                       str(err))
+        invariants.check_event_order(
+            ctx.report, ctx.events(),
+            ["canary_start", "promote", "canary_start",
+             "regression_trip", "rollback"])
+    finally:
+        router.shutdown()
+
+
 @drill("kernels", ["kernel.probe"])
 def drill_kernel_probe_transient(ctx: DrillContext):
     """A transient remote-compile crash during a kernel probe is

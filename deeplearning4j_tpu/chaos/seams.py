@@ -133,6 +133,11 @@ register_hook_seam(
     "a _VersionedEngine forward, with model/version/role ctx — target "
     "exactly the canary's dispatches (match={'role': 'canary'})")
 register_hook_seam(
+    "serving.sharded_dispatch", "serving",
+    "a tensor-parallel dispatch on the 2-D (batch, model) serving mesh "
+    "(error = device subset lost mid-serve; the engine must fail typed "
+    "and demote to solo)")
+register_hook_seam(
     "generate.decode_dispatch", "generation",
     "the one in-flight jitted decode step (error = decode failure, "
     "delay past the watchdog limit = hung dispatch)")

@@ -147,6 +147,26 @@ def serve_main(argv) -> int:
     ap.add_argument("--workers", type=int, default=1,
                     help=">1 shards each dispatched batch over that many "
                          "devices (mesh data axis)")
+    ap.add_argument("--mesh", default=None, metavar="BxM",
+                    help="serve TENSOR-PARALLEL on a 2-D (batch, model) "
+                         "mesh, e.g. '2x4': weights are policy-sharded "
+                         "over the model axis (no device holds the full "
+                         "model), batches over the batch axis; a bare "
+                         "'4' means 4x1 (pure batch). Checkpoints of any "
+                         "topology reshard onto the mesh at load, "
+                         "device-to-device. Supersedes --workers; "
+                         "incompatible with --int8-serving")
+    ap.add_argument("--mesh-policy", action="append", default=None,
+                    metavar="PATTERN=DIM",
+                    help="override the sharding policy for params whose "
+                         "tree path matches the regex PATTERN: DIM is "
+                         "the axis index to split over 'model', or 'r' "
+                         "to replicate (repeatable; first match wins, "
+                         "overrides are checked before the policy's own "
+                         "rules)")
+    ap.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                    help="force an N-device virtual CPU mesh before jax "
+                         "initializes (a 2x4 --mesh needs 8)")
     ap.add_argument("--checkpoint-dir", default=None,
                     help="explicit /reload source (default: --model when "
                          "it is a directory)")
@@ -229,6 +249,21 @@ def serve_main(argv) -> int:
     args = ap.parse_args(argv)
     if args.model is None and args.registry_dir is None:
         ap.error("one of --model or --registry-dir is required")
+    if args.mesh and args.int8_serving:
+        ap.error("--mesh and --int8-serving do not compose: int8 "
+                 "per-channel scales would be sharded by the TP policy")
+
+    if args.cpu_mesh:
+        import os as _os
+
+        flags = _os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            _os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{int(args.cpu_mesh)}").strip()
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
 
     from deeplearning4j_tpu.models.selector import ZOO, ModelSelector
     from deeplearning4j_tpu.serving import (
@@ -253,7 +288,16 @@ def serve_main(argv) -> int:
                            seq_buckets=seq_buckets)
 
     mesh = None
-    if args.workers > 1:
+    engine_cls = InferenceEngine
+    if args.mesh:
+        from deeplearning4j_tpu.parallel.serving_mesh import ServingMesh
+        from deeplearning4j_tpu.serving.sharded import ShardedInferenceEngine
+
+        mesh = ServingMesh.from_spec(args.mesh)
+        engine_cls = ShardedInferenceEngine
+        print(f"mesh: {mesh.n_data}x{mesh.n_model} (batch x model), "
+              f"{mesh.n_devices} devices", flush=True)
+    elif args.workers > 1:
         from deeplearning4j_tpu.parallel.mesh import TrainingMesh
 
         mesh = TrainingMesh(data=args.workers)
@@ -265,6 +309,8 @@ def serve_main(argv) -> int:
 
     eng_kwargs = dict(buckets=buckets, mesh=mesh,
                       metrics=ServingMetrics(registry=default_registry()))
+    if args.mesh and args.mesh_policy:
+        eng_kwargs["policy_overrides"] = args.mesh_policy
     if args.int8_serving:
         if key in ZOO and not getattr(ZOO[key], "serving_int8", True):
             ap.error(f"--int8-serving: zoo model {key!r} declares "
@@ -276,14 +322,22 @@ def serve_main(argv) -> int:
     if key in ZOO:
         model, origin = ModelSelector.load_or_init(
             args.model, num_classes=args.num_classes)
-        engine = InferenceEngine(model, **eng_kwargs)
+        engine = engine_cls(model, **eng_kwargs)
     else:
         # checkpoint zip/dir: from_checkpoint records the content
         # fingerprint, so a periodic no-change /reload poll is a no-op
-        engine = InferenceEngine.from_checkpoint(args.model, **eng_kwargs)
+        engine = engine_cls.from_checkpoint(args.model, **eng_kwargs)
         origin = engine.describe()["source"]
     print(f"serving {type(engine.model).__name__} from {origin} "
           f"({engine.buckets!r})", flush=True)
+    if args.mesh:
+        rep = engine.shard_report
+        print(f"sharded: policy {rep['policy']}, "
+              f"{rep['per_device_bytes']:,}/{rep['total_bytes']:,} "
+              f"bytes per device "
+              f"({rep['replicated_bytes']:,} replicated), "
+              f"reshard host bytes "
+              f"{int(engine.reshard_stats.host_bytes)}", flush=True)
     if not args.no_warmup:
         shape = engine.example_shape()
         if shape is None:
@@ -310,19 +364,36 @@ def serve_main(argv) -> int:
         gen_buckets = (None if args.gen_prefill_buckets is None
                        else [int(t)
                              for t in args.gen_prefill_buckets.split(",")])
+        gen_kwargs = dict(
+            n_slots=args.gen_slots,
+            max_length=args.gen_max_length,
+            prefill_buckets=gen_buckets,
+            queue_limit=args.gen_queue_limit,
+            spec_decode_k=args.spec_decode_k,
+            draft_mode=args.spec_draft_mode,
+            prefix_cache_mb=args.prefix_cache_mb,
+            metrics=GenerationMetrics(registry=default_registry()))
         try:
-            generation = GenerationEngine(
-                engine.model, n_slots=args.gen_slots,
-                max_length=args.gen_max_length,
-                prefill_buckets=gen_buckets,
-                queue_limit=args.gen_queue_limit,
-                spec_decode_k=args.spec_decode_k,
-                draft_mode=args.spec_draft_mode,
-                prefix_cache_mb=args.prefix_cache_mb,
-                metrics=GenerationMetrics(registry=default_registry()))
+            if args.mesh:
+                from deeplearning4j_tpu.parallel.serving_mesh import (
+                    ShardingPolicyError,
+                )
+                from deeplearning4j_tpu.serving.sharded import (
+                    sharded_generation_engine,
+                )
+
+                try:
+                    generation = sharded_generation_engine(
+                        engine.model, mesh, **gen_kwargs)
+                except ShardingPolicyError as e:
+                    # a model the mesh cannot decode (recurrent backend,
+                    # non-divisible heads) still serves /predict sharded
+                    print(f"sharded generation disabled: {e}", flush=True)
+            else:
+                generation = GenerationEngine(engine.model, **gen_kwargs)
         except TypeError as e:
             print(f"generation disabled: {e}", flush=True)
-        else:
+        if generation is not None:
             if not args.no_warmup:
                 rep = generation.warmup()
                 print(f"generation warmup: buckets {rep.get('buckets')}, "

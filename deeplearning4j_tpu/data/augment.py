@@ -26,16 +26,31 @@ class AugmentStage:
     * ``normalize=(mean, std)`` — ``(x - mean) / std``;
     * ``crop=k`` — random spatial shift of up to ±k px (edge-padded,
       NHWC inputs only; non-spatial inputs pass through);
-    * ``noise=s`` — additive Gaussian noise of std ``s``.
+    * ``noise=s`` — additive Gaussian noise of std ``s``;
+    * ``mixup=a`` — batch-crossing mixup (arXiv 1710.09412): one
+      ``lam ~ Beta(a, a)`` per batch and a random batch permutation,
+      ``x' = lam·x + (1-lam)·x[perm]`` — and the SAME lam/perm applied
+      to the labels through :meth:`apply_pair`, which the fit loop
+      routes to when ``mixes_labels`` is set. Mixing crosses examples,
+      so it runs after the per-example transforms.
 
     ``apply(features, iteration)`` handles one batch;
     ``apply_bundle(features, it0)`` a stacked ``(k, b, …)`` bundle,
     folding ``it0 + j`` per inner step so bundled and unbundled fits
     see identical per-iteration randomness.
+    ``apply_pair(features, labels, iteration)`` /
+    ``apply_pair_bundle`` are the label-consistent twins mixup needs.
+
+    Key routing is fingerprint-stable: with ``mixup=0`` the key stream
+    is byte-identical to stages built before the knob existed (the
+    mixup subkey split only happens when mixup is on), and
+    :meth:`spec` round-trips through :func:`parse_augment_spec` either
+    way.
     """
 
     def __init__(self, normalize: Optional[Tuple[float, float]] = None,
-                 crop: int = 0, noise: float = 0.0, seed: int = 0):
+                 crop: int = 0, noise: float = 0.0, mixup: float = 0.0,
+                 seed: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -43,13 +58,17 @@ class AugmentStage:
             raise ValueError("normalize std must be non-zero")
         if crop < 0:
             raise ValueError(f"crop must be >= 0, got {crop}")
+        if mixup < 0:
+            raise ValueError(f"mixup alpha must be >= 0, got {mixup}")
         self.normalize = (tuple(float(v) for v in normalize)
                           if normalize is not None else None)
         self.crop = int(crop)
         self.noise = float(noise)
+        self.mixup = float(mixup)
         self.seed = int(seed)
         key0 = jax.random.PRNGKey(self.seed)
         norm, crop_px, noise_std = self.normalize, self.crop, self.noise
+        alpha = self.mixup
 
         def _aug(x, key):
             dtype = x.dtype
@@ -69,8 +88,41 @@ class AugmentStage:
                                                       jnp.float32)
             return x.astype(dtype)
 
+        def _mix_coeffs(key, b):
+            k_lam, k_perm = jax.random.split(key)
+            lam = jax.random.beta(k_lam, alpha, alpha)
+            perm = jax.random.permutation(k_perm, b)
+            return lam, perm
+
+        def _split_mix(key):
+            # ONLY entered with mixup on — stages without it keep the
+            # pre-mixup key stream byte-identical (fingerprint
+            # stability: same seed+iteration, same crops and noise)
+            k_mix, k_aug = jax.random.split(key)
+            return k_mix, k_aug
+
+        def _mix_one(x, lam, perm):
+            dtype = x.dtype
+            xf = x.astype(jnp.float32)
+            mixed = lam * xf + (1.0 - lam) * jnp.take(xf, perm, axis=0)
+            return mixed.astype(dtype)
+
         def _batch(x, iteration):
-            return _aug(x, jax.random.fold_in(key0, iteration))
+            key = jax.random.fold_in(key0, iteration)
+            if not alpha:
+                return _aug(x, key)
+            k_mix, k_aug = _split_mix(key)
+            lam, perm = _mix_coeffs(k_mix, x.shape[0])
+            return _mix_one(_aug(x, k_aug), lam, perm)
+
+        def _pair(x, y, iteration):
+            key = jax.random.fold_in(key0, iteration)
+            if not alpha:
+                return _aug(x, key), y
+            k_mix, k_aug = _split_mix(key)
+            lam, perm = _mix_coeffs(k_mix, x.shape[0])
+            return (_mix_one(_aug(x, k_aug), lam, perm),
+                    _mix_one(y, lam, perm))
 
         def _bundle(x, it0):
             k = x.shape[0]
@@ -78,11 +130,45 @@ class AugmentStage:
                 lambda j: jax.random.fold_in(key0, it0 + j))(jnp.arange(k))
             # vmap over the bundle axis, but crop offsets must match the
             # unbundled path, so _aug sees one (b, …) batch per step
-            return jax.vmap(_aug)(x, keys)
+            if not alpha:
+                return jax.vmap(_aug)(x, keys)
+
+            def step(xj, kj):
+                k_mix, k_aug = _split_mix(kj)
+                lam, perm = _mix_coeffs(k_mix, xj.shape[0])
+                return _mix_one(_aug(xj, k_aug), lam, perm)
+
+            return jax.vmap(step)(x, keys)
+
+        def _pair_bundle(x, y, it0):
+            k = x.shape[0]
+            keys = jax.vmap(
+                lambda j: jax.random.fold_in(key0, it0 + j))(jnp.arange(k))
+            if not alpha:
+                return jax.vmap(_aug)(x, keys), y
+
+            def step(xj, yj, kj):
+                k_mix, k_aug = _split_mix(kj)
+                lam, perm = _mix_coeffs(k_mix, xj.shape[0])
+                return (_mix_one(_aug(xj, k_aug), lam, perm),
+                        _mix_one(yj, lam, perm))
+
+            return jax.vmap(step)(x, y, keys)
 
         self.apply = jax.jit(_trace.count_retraces("augment_batch", _batch))
         self.apply_bundle = jax.jit(
             _trace.count_retraces("augment_bundle", _bundle))
+        self.apply_pair = jax.jit(
+            _trace.count_retraces("augment_pair", _pair))
+        self.apply_pair_bundle = jax.jit(
+            _trace.count_retraces("augment_pair_bundle", _pair_bundle))
+
+    @property
+    def mixes_labels(self) -> bool:
+        """True when the stage crosses examples (mixup) and the fit
+        loop must route features AND labels through
+        :meth:`apply_pair`."""
+        return self.mixup > 0
 
     def spec(self) -> str:
         parts = []
@@ -92,6 +178,8 @@ class AugmentStage:
             parts.append(f"crop:{self.crop}")
         if self.noise:
             parts.append(f"noise:{self.noise}")
+        if self.mixup:
+            parts.append(f"mixup:{self.mixup}")
         return ",".join(parts) or "identity"
 
     def __repr__(self):
@@ -99,9 +187,9 @@ class AugmentStage:
 
 
 def parse_augment_spec(spec: str, seed: int = 0) -> AugmentStage:
-    """``"normalize:0.13:0.31,crop:2,noise:0.01"`` → AugmentStage (the
-    CLI's ``--augment`` grammar)."""
-    normalize, crop, noise = None, 0, 0.0
+    """``"normalize:0.13:0.31,crop:2,noise:0.01,mixup:0.2"`` →
+    AugmentStage (the CLI's ``--augment`` grammar)."""
+    normalize, crop, noise, mixup = None, 0, 0.0, 0.0
     for part in (p.strip() for p in spec.split(",") if p.strip()):
         fields = part.split(":")
         name = fields[0]
@@ -114,10 +202,12 @@ def parse_augment_spec(spec: str, seed: int = 0) -> AugmentStage:
                 crop = int(fields[1])
             elif name == "noise":
                 noise = float(fields[1])
+            elif name == "mixup":
+                mixup = float(fields[1])
             else:
                 raise ValueError(f"unknown transform '{name}' "
-                                 "(normalize/crop/noise)")
+                                 "(normalize/crop/noise/mixup)")
         except (IndexError, ValueError) as e:
             raise ValueError(f"bad --augment spec {part!r}: {e}") from None
     return AugmentStage(normalize=normalize, crop=crop, noise=noise,
-                        seed=seed)
+                        mixup=mixup, seed=seed)

@@ -143,12 +143,22 @@ def _ln(x, g, b, cd):
 
 
 def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
-                attn_fn=None):
+                attn_fn=None, tp_axis: Optional[str] = None,
+                expert_axis: Optional[str] = None):
     """One pre-LN block on (b, T, d); bp holds UNSTACKED (single-layer)
     params. ``attn_fn`` defaults to dense attention (ring under SP).
     Dense FFN → returns x. MoE (cfg.n_experts > 0) → returns (x, aux).
     Under compute_dtype="bfloat16": matmul operands and the carried
-    activation are bf16; layernorm statistics fp32."""
+    activation are bf16; layernorm statistics fp32.
+
+    ``tp_axis``/``expert_axis`` engage MANUAL tensor/expert parallelism
+    for use inside a fully-manual shard_map region (parallel/transformer
+    ``_blocks_fn``): bp arrives pre-sliced per param_pspecs — Wq/Wk/Wv/W1
+    column-sliced and Wo/W2 row-sliced over ``tp_axis`` (Megatron
+    column→row: one psum per sublayer, placed BEFORE the replicated bias
+    add), MoE expert dim sliced over ``expert_axis``. Local head count is
+    derived from the sliced Wq width, so the same code serves any tp
+    degree (a size-1 axis psum is a no-op)."""
     b, T, d = x.shape
     hn = cfg.n_heads
     cd = _cdtype(cfg)
@@ -157,21 +167,28 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
         bp = {k2: (v.astype(cd) if k2[0] == "W" or k2[0] == "b" else v)
               for k2, v in bp.items()}
     a_in = _ln(x, bp["ln1_g"], bp["ln1_b"], cd)
+    # under manual TP the head projections are column slices: this
+    # shard owns d_local/head_dim of the hn heads
+    d_local = bp["Wq"].shape[-1]
+    hn_local = hn * d_local // d
 
     def heads(W):
-        return (a_in @ W).reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
+        return (a_in @ W).reshape(b, T, hn_local, -1).transpose(0, 2, 1, 3)
 
     if cfg.fused_qkv:
         qkv = a_in @ jnp.concatenate(
-            [bp["Wq"], bp["Wk"], bp["Wv"]], axis=-1)  # (b, T, 3d)
-        q, k, v = (s.reshape(b, T, hn, -1).transpose(0, 2, 1, 3)
+            [bp["Wq"], bp["Wk"], bp["Wv"]], axis=-1)  # (b, T, 3*d_local)
+        q, k, v = (s.reshape(b, T, hn_local, -1).transpose(0, 2, 1, 3)
                    for s in jnp.split(qkv, 3, axis=-1))
     else:
         q, k, v = heads(bp["Wq"]), heads(bp["Wk"]), heads(bp["Wv"])
     fn = attn_fn if attn_fn is not None else dense_attention
     o = fn(q, k, v, causal=True, mask=None)
-    o = o.transpose(0, 2, 1, 3).reshape(b, T, d).astype(x.dtype)
-    x = x + o @ bp["Wo"] + bp["bo"]
+    o = o.transpose(0, 2, 1, 3).reshape(b, T, d_local).astype(x.dtype)
+    om = o @ bp["Wo"]
+    if tp_axis is not None:
+        om = jax.lax.psum(om, tp_axis)
+    x = x + om + bp["bo"]
     m_in = _ln(x, bp["ln2_g"], bp["ln2_b"], cd)
     if cfg.n_experts > 0:
         from deeplearning4j_tpu.nn.conf.layers.moe import _moe_ffn
@@ -180,10 +197,14 @@ def block_apply(cfg: TransformerLMConfig, bp: Dict[str, Array], x: Array,
             {k2: bp[k2] for k2 in ("Wg", "W1", "b1", "W2", "b2")},
             m_in.reshape(b * T, d), jax.nn.gelu,
             _moe_capacity(cfg, b * T), cfg.top_k,
+            expert_axis=expert_axis, tp_axis=tp_axis,
         )
         return x + y2.reshape(b, T, d).astype(x.dtype), aux
     h = jax.nn.gelu(m_in @ bp["W1"] + bp["b1"])
-    return x + h @ bp["W2"] + bp["b2"]
+    hm = h @ bp["W2"]
+    if tp_axis is not None:
+        hm = jax.lax.psum(hm, tp_axis)
+    return x + hm + bp["b2"]
 
 
 class ContextWindowExceeded(ValueError):

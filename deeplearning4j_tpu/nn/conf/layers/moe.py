@@ -96,10 +96,24 @@ def _moe_dispatch(probs, capacity: int, top_k: int, valid=None):
     return dispatch, combine, aux, f_e
 
 
-def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
+def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None,
+             expert_axis=None, tp_axis=None):
     """Token-level MoE FFN: x2 [S, d] → (y [S, d], aux_loss). Router
     softmax precision floors at fp32 (GShard convention — routing is
-    precision-sensitive): bf16/f16 upcast, f32/f64 pass through."""
+    precision-sensitive): bf16/f16 upcast, f32/f64 pass through.
+
+    ``expert_axis``/``tp_axis`` engage MANUAL expert/tensor parallelism
+    inside a fully-manual shard_map region: W1/b1/W2/b2 arrive with
+    their expert dim pre-sliced over ``expert_axis`` (and the hidden
+    dim over ``tp_axis``) while Wg stays replicated — routing,
+    dispatch/combine tensors, capacity and the aux loss are computed
+    over the GLOBAL expert count on every shard (bit-identical to the
+    single-device math), each shard runs only its local expert block
+    of the FFN einsums, and the outputs psum back: over ``tp_axis``
+    before the (expert-sliced, tp-replicated) b2 bias add, over
+    ``expert_axis`` after the combine (each token's experts live on
+    exactly one expert shard, so the psum is a sum of disjoint
+    contributions)."""
     logits = x2 @ params["Wg"]
     # router at >= fp32 (GShard convention); fp64 inputs (gradient
     # checker) keep fp64 — only low precision is upcast
@@ -107,12 +121,23 @@ def _moe_ffn(params, x2, act_fn, capacity: int, top_k: int, valid=None):
         else logits.dtype
     probs = jax.nn.softmax(logits.astype(rd), axis=-1).astype(x2.dtype)
     dispatch, combine, aux, load = _moe_dispatch(probs, capacity, top_k, valid)
+    if expert_axis is not None:
+        # slice this shard's expert block of the global dispatch/combine
+        e_local = params["W1"].shape[0]
+        e0 = jax.lax.axis_index(expert_axis) * e_local
+        dispatch = jax.lax.dynamic_slice_in_dim(dispatch, e0, e_local, 1)
+        combine = jax.lax.dynamic_slice_in_dim(combine, e0, e_local, 1)
     # [S,E,C]x[S,d] -> [E,C,d]: the tensor GSPMD all-to-alls under EP
     expert_in = jnp.einsum("sec,sd->ecd", dispatch, x2)
     h = act_fn(jnp.einsum("ecd,edh->ech", expert_in, params["W1"])
                + params["b1"][:, None, :])
-    out = jnp.einsum("ech,ehd->ecd", h, params["W2"]) + params["b2"][:, None, :]
+    out = jnp.einsum("ech,ehd->ecd", h, params["W2"])
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    out = out + params["b2"][:, None, :]
     y = jnp.einsum("sec,ecd->sd", combine, out)
+    if expert_axis is not None:
+        y = jax.lax.psum(y, expert_axis)
     return y, aux, load
 
 

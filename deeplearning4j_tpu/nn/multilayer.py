@@ -632,11 +632,18 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.train.listeners import _hook_recipients
 
         features = jnp.asarray(ds.features)
+        labels = None if ds.labels is None else jnp.asarray(ds.labels)
         if self._augment is not None:
             # jitted device stage fused ahead of the train step —
-            # iteration passed as a dynamic scalar (no retrace per step)
-            features = self._augment.apply(features, self.iteration)
-        labels = None if ds.labels is None else jnp.asarray(ds.labels)
+            # iteration passed as a dynamic scalar (no retrace per step).
+            # Batch-crossing stages (mixup) mix labels with the same
+            # lam/permutation, so they take the pair path.
+            if labels is not None and getattr(self._augment,
+                                              "mixes_labels", False):
+                features, labels = self._augment.apply_pair(
+                    features, labels, self.iteration)
+            else:
+                features = self._augment.apply(features, self.iteration)
         fmask = (None if ds.features_mask is None
                  else jnp.asarray(ds.features_mask))
         lmask = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
@@ -699,11 +706,17 @@ class MultiLayerNetwork:
 
         k = bundle.k
         features = jnp.asarray(bundle.features)
+        labels = None if bundle.labels is None else jnp.asarray(bundle.labels)
         if self._augment is not None:
             # per-inner-step keys fold it0+j, so bundled and unbundled
             # fits see identical per-iteration augmentation randomness
-            features = self._augment.apply_bundle(features, self.iteration)
-        labels = None if bundle.labels is None else jnp.asarray(bundle.labels)
+            if labels is not None and getattr(self._augment,
+                                              "mixes_labels", False):
+                features, labels = self._augment.apply_pair_bundle(
+                    features, labels, self.iteration)
+            else:
+                features = self._augment.apply_bundle(features,
+                                                      self.iteration)
         fmask = (None if bundle.features_mask is None
                  else jnp.asarray(bundle.features_mask))
         lmask = (None if bundle.labels_mask is None

@@ -123,6 +123,16 @@ FLIGHT_EVENTS: Dict[str, tuple] = {
     "elastic_giveup": ("train/faults.py",
                        "retries/min-devices exhausted; "
                        "ElasticRecoveryExhaustedError about to raise"),
+    # -- mesh-sharded serving (serving/sharded.py) -------------------------
+    "mesh_build": ("serving/sharded.py",
+                   "2-D (batch, model) serving mesh formed for an "
+                   "engine (axis sizes, policy name)"),
+    "shard_load": ("serving/sharded.py",
+                   "params placed per sharding policy (per-device/"
+                   "replicated bytes, transfer ledger)"),
+    "sharded_fallback": ("serving/sharded.py",
+                         "sharded dispatch failed; engine demoted to "
+                         "one-device solo serving (reason)"),
     # -- continuous deployment (serving/registry.py) ----------------------
     "publish": ("serving/registry.py",
                 "snapshot copied + journaled into the registry"),
@@ -325,6 +335,10 @@ HOOK_POINTS: Dict[str, tuple] = {
                         "a record shard about to be opened + decoded "
                         "(torn mode = mid-epoch truncated-shard "
                         "drill; enospc/eio = failing data volume)"),
+    "serving.sharded_dispatch": ("serving/sharded.py",
+                                 "a tensor-parallel dispatch about to "
+                                 "run on the 2-D serving mesh (error "
+                                 "mode = device-subset-lost drill)"),
 }
 
 
@@ -414,6 +428,10 @@ ALERTS: Dict[str, tuple] = {
     "replica_ejected": ("obs/slo.py",
                         "the cluster front ejected a replica on "
                         "health verdicts"),
+    "sharded_serving_fallback": ("obs/slo.py",
+                                 "a sharded engine demoted itself to "
+                                 "one-device solo serving after a mesh "
+                                 "dispatch failure"),
     # the canary gate, expressed in the same engine (serving/registry.py
     # builds these per canary window via obs/slo.canary_gate_rules)
     "canary_score_regressed": ("obs/slo.py",

@@ -227,6 +227,13 @@ def default_rules(queue_limit: int = 256,
             description="elastic recovery exhausted its retries / "
                         "minimum device floor — the run stopped typed "
                         "and needs a human"),
+        AlertRule(
+            "sharded_serving_fallback", "increase", severity="critical",
+            resolve_s=600.0, **_flight("sharded_fallback"),
+            description="a sharded serving engine lost a mesh dispatch "
+                        "and demoted itself to one-device solo serving "
+                        "— alive but slow and unsharded; reload onto a "
+                        "healthy mesh"),
         # -- kernels / locks ---------------------------------------------------
         AlertRule(
             "kernel_fallbacks", "increase", severity="warn",

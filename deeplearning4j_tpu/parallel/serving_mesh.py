@@ -1,0 +1,483 @@
+"""2-D (batch, model) serving mesh + per-layer sharding policies.
+
+Training shards over the full 5-axis mesh (parallel/mesh.py); serving
+needs exactly two of those concerns: spread concurrent requests
+("batch" — the data axis under a different name) and split the model
+itself when it does not fit one device ("model" — tensor parallel).
+:class:`ServingMesh` is that 2-D mesh with the same surface the
+engines already program against (``n_data``/``replicated()``/
+``batch_sharded()``), so a ServingMesh drops in anywhere a
+``TrainingMesh`` did.
+
+Placement is **pure-auto GSPMD**: a :class:`ShardingPolicy` maps every
+param-tree leaf to a ``PartitionSpec``, the leaves are ``device_put``
+onto the resulting ``NamedSharding``s through the reshard planner
+(parallel/reshard.py — same plan/execute split, same ``TransferStats``
+byte ledger, ``host_bytes == 0`` for live sources), and the existing
+jitted programs partition themselves via computation-follows-data. No
+shard_map, no manual collectives: the engines' forward/decode functions
+take params as *arguments*, so the sharded placement flows through jit
+untouched and steady-state dispatches never retrace.
+
+The policy grammar is an ordered rule list ``(path_regex, spec)`` —
+first match wins, unmatched leaves replicate. A spec is either a
+``PartitionSpec`` or a callable ``(leaf, mesh) -> PartitionSpec``
+(used where one name covers two shapes, e.g. dense vs MoE ``W1``). Every spec is
+validated against the leaf shape at plan time: a mesh axis that does
+not divide its dim is a typed :class:`ShardingPolicyError` refusal,
+never a silent repartition. :func:`validate_policy` then checks the
+placement against the memory model — per-device weight bytes must be
+``<= total/n_model + replicated`` (small params replicated by design
+are the epsilon) — and cross-checks the tree's total against
+``nn/conf/memory.py``'s estimator when a conf is available.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import reshard as _reshard
+
+
+class ShardingPolicyError(ValueError):
+    """A policy/mesh/params mismatch the engine must refuse typed:
+    an axis that does not divide the dim it shards, a policy applied
+    to a tree it was not written for, or a placement that fails the
+    per-device memory gate."""
+
+
+def parse_mesh_spec(spec: str) -> Tuple[int, int]:
+    """``"2x4"`` → ``(batch=2, model=4)`` (the CLI ``--mesh`` grammar);
+    a bare ``"4"`` means ``batch=4, model=1`` (pure replica serving)."""
+    s = str(spec).strip().lower()
+    try:
+        if "x" in s:
+            b, m = s.split("x")
+            batch, model = int(b), int(m)
+        else:
+            batch, model = int(s), 1
+    except ValueError:
+        raise ShardingPolicyError(
+            f"bad mesh spec {spec!r}: want 'BATCHxMODEL' (e.g. '2x4') "
+            "or a bare replica count") from None
+    if batch < 1 or model < 1:
+        raise ShardingPolicyError(
+            f"bad mesh spec {spec!r}: axis sizes must be >= 1")
+    return batch, model
+
+
+class ServingMesh:
+    """2-D device mesh over ``("batch", "model")``.
+
+    API-compatible with the slice of ``TrainingMesh`` the serving stack
+    uses: ``n_data`` is the batch-axis size (bucket divisibility),
+    ``batch_sharded()`` shards dim 0 over "batch", ``replicated()`` /
+    ``spec()`` as before. ``batch=0`` infers the batch axis from the
+    device count (``n // model``), mirroring ``TrainingMesh(data=0)``.
+    """
+
+    def __init__(self, batch: int = 0, model: int = 1,
+                 devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        model = int(model)
+        if model < 1:
+            raise ShardingPolicyError(f"model axis must be >= 1, got {model}")
+        if batch == 0:
+            if n % model:
+                raise ShardingPolicyError(
+                    f"{n} devices not divisible by model={model}")
+            batch = n // model
+        if batch * model != n:
+            raise ShardingPolicyError(
+                f"serving mesh {batch}x{model}={batch * model} != {n} "
+                "devices")
+        arr = np.asarray(devices).reshape(batch, model)
+        self.mesh = Mesh(arr, ("batch", "model"))
+        self.shape: Dict[str, int] = dict(zip(self.mesh.axis_names,
+                                              arr.shape))
+
+    @classmethod
+    def from_spec(cls, spec: str,
+                  devices: Optional[Sequence] = None) -> "ServingMesh":
+        batch, model = parse_mesh_spec(spec)
+        return cls(batch=batch, model=model, devices=devices)
+
+    # -- shardings (TrainingMesh-compatible surface) -------------------------
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharded(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P("batch"))
+
+    def spec(self, *axes) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*axes))
+
+    @property
+    def n_data(self) -> int:
+        """Batch-axis size — what bucket divisibility keys on (the
+        serving twin of ``TrainingMesh.n_data``)."""
+        return self.shape["batch"]
+
+    @property
+    def n_model(self) -> int:
+        return self.shape["model"]
+
+    @property
+    def n_devices(self) -> int:
+        return self.shape["batch"] * self.shape["model"]
+
+    def devices_flat(self) -> list:
+        return list(np.asarray(self.mesh.devices).reshape(-1))
+
+    def __repr__(self):
+        return f"ServingMesh({self.shape})"
+
+
+# --------------------------------------------------------------------------
+# policy machinery
+# --------------------------------------------------------------------------
+SpecLike = Union[P, Callable]
+
+
+def _path_str(path) -> str:
+    """Normalize a tree_flatten_with_path key path to ``a/b/c`` (dict
+    keys and sequence indices flattened alike), the string the policy
+    regexes match against."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:  # pragma: no cover — future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _axis_divisor(axes, mesh_shape: Dict[str, int]) -> int:
+    if axes is None:
+        return 1
+    if isinstance(axes, (tuple, list)):
+        d = 1
+        for a in axes:
+            d *= mesh_shape[a]
+        return d
+    return mesh_shape[axes]
+
+
+def _check_spec(path: str, leaf, spec: P,
+                mesh: ServingMesh) -> P:
+    """Validate one spec against one leaf's shape: every sharded dim
+    must exist and divide evenly, else typed refusal."""
+    shape = np.shape(leaf)
+    if len(spec) > len(shape):
+        raise ShardingPolicyError(
+            f"policy spec {spec} for {path!r} names {len(spec)} dims but "
+            f"the param has shape {shape} — this policy was not written "
+            "for this model")
+    for i, axes in enumerate(spec):
+        d = _axis_divisor(axes, mesh.shape)
+        if d == 1:
+            continue
+        if shape[i] % d:
+            raise ShardingPolicyError(
+                f"param {path!r} dim {i} (size {shape[i]}) is not "
+                f"divisible by mesh axis {axes!r} (size {d}); shrink the "
+                "model axis or override the policy for this param")
+    return spec
+
+
+class ShardingPolicy:
+    """Ordered ``(path_regex, spec)`` rules mapping param-tree leaves
+    to PartitionSpecs. First matching rule wins; unmatched leaves are
+    replicated. Specs may be callables ``(leaf, mesh) ->
+    PartitionSpec`` for shape-dependent rules."""
+
+    def __init__(self, name: str,
+                 rules: Sequence[Tuple[str, SpecLike]]):
+        self.name = str(name)
+        self.rules: List[Tuple[str, SpecLike]] = [
+            (str(pat), spec) for pat, spec in rules]
+        self._compiled = [(re.compile(pat), spec) for pat, spec in
+                          self.rules]
+
+    def spec_for(self, path: str, leaf, mesh: ServingMesh) -> P:
+        for rx, spec in self._compiled:
+            if rx.search(path):
+                if callable(spec) and not isinstance(spec, P):
+                    spec = spec(leaf, mesh)
+                return _check_spec(path, leaf, spec, mesh)
+        return P()
+
+    def sharding_tree(self, tree, mesh: ServingMesh):
+        """Same-structure tree of ``NamedSharding``s (validated)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        shardings = [NamedSharding(mesh.mesh,
+                                   self.spec_for(_path_str(p), leaf, mesh))
+                     for p, leaf in flat]
+        return jax.tree_util.tree_unflatten(treedef, shardings)
+
+    def plan(self, tree, mesh: ServingMesh,
+             n_from: Optional[int] = None) -> _reshard.ReshardPlan:
+        """A reshard plan placing ``tree`` per this policy — the
+        checkpoint-topology → serving-mesh leg rides the same
+        plan/execute split (and byte ledger) as elastic recovery."""
+        sh_tree = self.sharding_tree(tree, mesh)
+        shardings = jax.tree_util.tree_leaves(
+            sh_tree, is_leaf=lambda x: isinstance(x, NamedSharding))
+        it = iter(shardings)
+        return _reshard.plan_tree(tree, lambda leaf: next(it),
+                                  n_from=n_from, n_to=mesh.n_devices)
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "rules": [{"pattern": pat,
+                       "spec": ("<shape-dependent>" if callable(spec)
+                                and not isinstance(spec, P)
+                                else str(spec))}
+                      for pat, spec in self.rules],
+        }
+
+    def __repr__(self):
+        return f"ShardingPolicy({self.name!r}, {len(self.rules)} rules)"
+
+
+def _col(leaf, mesh=None) -> P:
+    """Column-parallel: shard the LAST dim (output features / heads)."""
+    return P(*([None] * (np.ndim(leaf) - 1) + ["model"]))
+
+
+def _row(leaf, mesh=None) -> P:
+    """Row-parallel: shard the second-to-last dim (input features) —
+    the Megatron pairing for the matmul consuming a column-split
+    activation."""
+    return P(*([None] * (np.ndim(leaf) - 2) + ["model", None]))
+
+
+def transformer_lm_policy() -> ShardingPolicy:
+    """Megatron-style TP for the stacked TransformerLM params tree.
+
+    Attention: Wq/Wk/Wv column-split (each device owns a head subset),
+    Wo row-split (the psum GSPMD inserts after it is the one all-reduce
+    per attention block). FFN: W1 column / W2 row, biases follow their
+    matmul's output sharding where it is split, replicate where the
+    all-reduce already restored full rows. MoE experts split on the
+    hidden dim the same way (expert dim stays unsharded — serving
+    meshes have no "expert" axis; the model axis cuts inside each
+    expert). Embed splits the feature dim, the output head splits the
+    vocab dim (logits shard over "model" until the final
+    argmax/softmax). Small params — layernorms, pos table, router
+    gates, row-parallel biases — replicate; they are the epsilon in the
+    per-device memory gate."""
+    return ShardingPolicy("transformer_lm", [
+        (r"blocks/(Wq|Wk|Wv)$", _col),
+        (r"blocks/Wo$", _row),
+        (r"blocks/W1$", _col),  # dense (L,d,h) and MoE (L,E,d,h) alike
+        (r"blocks/b1$", _col),
+        (r"blocks/W2$", _row),
+        (r"blocks/(b2|bo|Wg|ln1_g|ln1_b|ln2_g|ln2_b)$", P()),
+        (r"^embed$", P(None, "model")),
+        (r"^pos$", P()),
+        (r"^head$", P(None, "model")),
+        (r"^(lnf_g|lnf_b)$", P()),
+    ])
+
+
+def _auto_spec(leaf, mesh: ServingMesh) -> P:
+    """Generic TP spec for layered (MLN/zoo) params: shard the last dim
+    of every matrix-or-higher leaf (Dense/Output ``W`` (in,out) and conv
+    kernels (kh,kw,in,out) both split output features — column-parallel,
+    so the matmul runs local and GSPMD all-gathers activations, which
+    for serving-sized layers is cheaper than resharding weights). When
+    the last dim does not divide, the largest dim that does is sharded
+    instead; a leaf with NO divisible dim replicates — and if such
+    leaves dominate, :func:`validate_policy`'s per-device memory gate
+    is the loud failure (never a silent OOM). Vectors and scalars
+    (biases, norm params) always replicate."""
+    nm = mesh.shape["model"]
+    shape = np.shape(leaf)
+    if nm == 1 or len(shape) < 2:
+        return P()
+    dims = [len(shape) - 1] + sorted(
+        range(len(shape) - 1), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] and shape[d] % nm == 0:
+            axes = [None] * len(shape)
+            axes[d] = "model"
+            return P(*axes)
+    return P()
+
+
+def auto_policy() -> ShardingPolicy:
+    """Fallback policy for any layered model without a bespoke entry:
+    column-parallel matrices, replicated small params (see
+    :func:`_auto_spec` for the non-divisible fallback chain)."""
+    return ShardingPolicy("auto", [(r".", _auto_spec)])
+
+
+#: bespoke per-model policies, keyed by the zoo name / model kind; any
+#: model not listed serves under ``auto_policy``. Zoo CNN/LSTM stacks
+#: are all Dense/Conv compositions, so the auto column-parallel rule IS
+#: their policy; entries here exist for models whose trees need more
+#: than "split the last dim".
+POLICIES: Dict[str, Callable[[], ShardingPolicy]] = {
+    "transformer_lm": transformer_lm_policy,
+}
+
+
+def policy_for(model, overrides: Optional[Sequence[str]] = None
+               ) -> ShardingPolicy:
+    """The sharding policy for ``model``: bespoke registry entry when
+    one exists (TransformerLM), else the generic auto policy.
+    ``overrides`` — ``"pattern=dim"`` strings (CLI ``--mesh-policy``) —
+    prepend rules sharding dim ``dim`` of matching params on "model"
+    (``dim`` may be negative; ``pattern=r`` forces replication)."""
+    kind = getattr(model, "name", None) or type(model).__name__.lower()
+    if type(model).__name__ == "TransformerLM" or kind == "transformer_lm":
+        pol = POLICIES["transformer_lm"]()
+    else:
+        pol = auto_policy()
+    if overrides:
+        extra: List[Tuple[str, SpecLike]] = []
+        for ov in overrides:
+            if "=" not in ov:
+                raise ShardingPolicyError(
+                    f"bad policy override {ov!r}: want 'pattern=dim' or "
+                    "'pattern=r'")
+            pat, _, dim = ov.partition("=")
+            if dim.strip().lower() == "r":
+                extra.append((pat, P()))
+                continue
+            try:
+                d = int(dim)
+            except ValueError:
+                raise ShardingPolicyError(
+                    f"bad policy override {ov!r}: dim must be an int "
+                    "or 'r'") from None
+
+            def spec(leaf, mesh, _d=d):
+                nd = np.ndim(leaf)
+                i = _d if _d >= 0 else nd + _d
+                if not 0 <= i < nd:
+                    raise ShardingPolicyError(
+                        f"policy override dim {_d} out of range for "
+                        f"shape {np.shape(leaf)}")
+                axes = [None] * nd
+                axes[i] = "model"
+                return P(*axes)
+
+            extra.append((pat, spec))
+        pol = ShardingPolicy(f"{pol.name}+overrides",
+                             extra + list(pol.rules))
+    return pol
+
+
+# --------------------------------------------------------------------------
+# memory validation
+# --------------------------------------------------------------------------
+def _leaf_bytes(leaf) -> int:
+    a = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+    return int(np.prod(a.shape or (1,))) * np.dtype(a.dtype).itemsize
+
+
+def validate_policy(tree, mesh: ServingMesh, policy: ShardingPolicy,
+                    conf=None, slack_bytes: int = 4096) -> dict:
+    """Check a policy placement against the memory model and return the
+    report {total_bytes, per_device_bytes, replicated_bytes, ratio, ...}.
+
+    The gate: per-device weight bytes must be at most
+    ``total/n_model + replicated_bytes + slack`` — i.e. everything the
+    policy *shards* must actually split n_model ways; only the params
+    the policy deliberately replicates (layernorms, biases — the
+    epsilon) may exceed the 1/N share. Violations raise
+    :class:`ShardingPolicyError` (a policy that silently replicates a
+    7B weight matrix must fail loudly, not OOM a device at load).
+
+    When ``conf`` (an MLN configuration) is given, the tree's total is
+    cross-checked against ``nn/conf/memory.py``'s estimator — the same
+    model the capacity planner trusts must describe what serving
+    actually loads."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    total = 0
+    per_device = 0
+    replicated = 0
+    for path, leaf in flat:
+        nb = _leaf_bytes(leaf)
+        total += nb
+        spec = policy.spec_for(_path_str(path), leaf, mesh)
+        div = 1
+        for axes in spec:
+            div *= _axis_divisor(axes, mesh.shape)
+        if div == 1:
+            replicated += nb
+        per_device += nb // div
+    bound = total // max(mesh.n_model, 1) + replicated + int(slack_bytes)
+    report = {
+        "policy": policy.name,
+        "mesh": dict(mesh.shape),
+        "total_bytes": int(total),
+        "per_device_bytes": int(per_device),
+        "replicated_bytes": int(replicated),
+        "per_device_bound": int(bound),
+        "ratio": (per_device / total) if total else 0.0,
+    }
+    if per_device > bound:
+        raise ShardingPolicyError(
+            f"policy {policy.name!r} on mesh {mesh.shape} places "
+            f"{per_device} bytes per device, over the "
+            f"total/n_model + replicated bound {bound} "
+            f"(total={total}, replicated={replicated}): the policy "
+            "replicates large params it should shard")
+    if conf is not None:
+        try:
+            from deeplearning4j_tpu.nn.conf.memory import memory_report_mln
+
+            est_params = int(memory_report_mln(conf).total_params)
+        except Exception:  # noqa: BLE001 — confs without an estimator
+            est_params = 0
+        if est_params:
+            est_bytes = est_params * 4  # fp32 master weights
+            report["estimator_bytes"] = est_bytes
+            agreement = total / est_bytes if est_bytes else 0.0
+            report["estimator_agreement"] = round(agreement, 4)
+            if not 0.5 <= agreement <= 2.0:
+                raise ShardingPolicyError(
+                    f"params tree ({total} bytes) disagrees with the "
+                    f"memory estimator ({est_bytes} bytes) by "
+                    f"{agreement:.2f}x — the policy is validating "
+                    "against the wrong model")
+    return report
+
+
+def reshard_to_policy(model, mesh: ServingMesh, policy: ShardingPolicy,
+                      stats: Optional[_reshard.TransferStats] = None,
+                      n_from: Optional[int] = None
+                      ) -> _reshard.TransferStats:
+    """Place a model's params per ``policy`` (TP-sharded) and its layer/
+    fault state replicated — the sharded twin of
+    ``reshard.place_model``. Any checkpoint topology → this mesh: live
+    arrays move device-to-device, host leaves via per-shard callback,
+    and the returned ledger proves ``host_bytes == 0`` for live
+    sources."""
+    stats = stats if stats is not None else _reshard.TransferStats()
+    plan = policy.plan(model.params_, mesh, n_from=n_from)
+    model.params_, stats = plan.execute(model.params_, stats)
+    for attr in ("state_", "fault_state_"):
+        tree = getattr(model, attr, None)
+        if tree is None:
+            continue
+        repl = mesh.replicated()
+        plan = _reshard.plan_tree(tree, lambda leaf: repl, n_from=n_from,
+                                  n_to=mesh.n_devices)
+        placed, stats = plan.execute(tree, stats)
+        setattr(model, attr, placed)
+    return stats

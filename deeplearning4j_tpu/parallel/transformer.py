@@ -18,9 +18,14 @@ new capabilities):
   Backward pipelining falls out of autodiff (ppermute transposes to the
   reverse ring).
 
-The train step is ONE jit: auto axes (data/model) partition via
-in_shardings; manual axes (pipe/seq) run under shard_map. This is the
-scaling-book recipe: pick a mesh, annotate, let XLA place collectives.
+The train step is ONE jit. With neither PP nor SP active, all axes are
+automatic: in_shardings partition data/model/expert and GSPMD places the
+collectives. When PP or SP is on, the block stack runs inside a shard_map
+manual over the WHOLE mesh (jax-0.4.37's legacy shard_map cannot mix
+manual and auto axes in this program family — see ``_blocks_fn``) with
+explicit per-axis collectives: Megatron TP psums, manual-EP dispatch,
+ring attention, the GPipe ppermute ring, and a data axis that is either
+batch-sharded (dense: exact) or replicated (MoE: global routing parity).
 """
 
 from __future__ import annotations
@@ -134,13 +139,12 @@ class DistributedLMTrainer:
                     f"n_experts {self.cfg.n_experts} not divisible by "
                     f"expert axis {ep}"
                 )
-            # PP×EP composes: the pipeline shard_map is manual over
-            # {"pipe"} (+"seq") only, so the expert dim of the stacked
-            # block params stays an AUTO axis — GSPMD keeps W1/W2 et al
-            # partitioned over "expert" (from param_pspecs) inside the
-            # manual region and lowers the dispatch einsums to the
-            # token all-to-all as in the pure-EP layout. Exact-parity
-            # coverage: tests/test_moe.py (data×pipe×expert mesh).
+            # PP×EP composes: the pipeline shard_map is manual over the
+            # WHOLE mesh, so W1/W2 et al enter pre-sliced over "expert"
+            # (from param_pspecs) and _moe_ffn runs the manual-EP path —
+            # global routing, local expert FFN block, psum combine.
+            # Exact-parity coverage: tests/test_moe.py
+            # (data×pipe×expert mesh).
         self.n_micro = n_micro if n_micro is not None else max(2 * pp, 1) if pp > 1 else 1
         # pipelined loop (train/pipeline.py): fit_bundle fuses K steps
         # into one lax.scan dispatch; this is the default bundle size
@@ -162,16 +166,36 @@ class DistributedLMTrainer:
 
     # ------------------------------------------------------------- forward
     def _blocks_fn(self):
-        """(block_params, x (b,T,d)) → x, manual over pipe/seq as needed."""
+        """(block_params, x (b,T,d)) → x; FULLY-MANUAL shard_map over the
+        whole mesh when pipe/seq are active.
+
+        jax-0.4.37's legacy shard_map cannot mix manual and auto axes in
+        this program family (the retired tier-1 xfail set: _SpecError on
+        scalar out-specs under partial-eval, XLA ``PartitionId``
+        UNIMPLEMENTED, a spmd_partitioner CHECK crash), so the region is
+        manual over EVERY mesh axis and spells its own collectives:
+
+        - params enter pre-sliced with their param_pspecs specs (the
+          same NamedShardings the outer jit places them with — the
+          boundary is a no-op), and block_apply/_moe_ffn run manual TP
+          (Megatron column→row psum per sublayer) and manual EP (global
+          routing, local expert FFN block, psum combine);
+        - dense compute shards the batch over "data" (exact per-example
+          math) when it divides evenly; MoE replicates over "data" so
+          routing/capacity/aux stay the GLOBAL single-device math
+          (bit-parity with the unsharded step, test-asserted);
+        - "seq" stays the ring-attention axis, "pipe" the GPipe ring.
+        The pure stack_scan path (pp==sp==1) is untouched: data/model/
+        expert partition via jit in_shardings alone (GSPMD auto)."""
         cfg = self.cfg
         mesh = self.mesh
         pp = mesh.shape["pipe"]
         sp = mesh.shape["seq"]
-        manual = set()
-        if pp > 1:
-            manual.add("pipe")
-        if sp > 1:
-            manual.add("seq")
+        dp = mesh.shape["data"]
+        moe = cfg.n_experts > 0
+        manual_region = pp > 1 or sp > 1
+        tp_axis = "model" if manual_region else None
+        ep_axis = "expert" if (manual_region and moe) else None
 
         attn_fn = None
         if sp > 1:
@@ -180,10 +204,9 @@ class DistributedLMTrainer:
                     q, k, v, axis_name="seq", causal=causal, mask=mask
                 )
 
-        moe = cfg.n_experts > 0
-
         def _blk(bp, x):
-            return block_apply(cfg, bp, x, attn_fn=attn_fn)
+            return block_apply(cfg, bp, x, attn_fn=attn_fn,
+                               tp_axis=tp_axis, expert_axis=ep_axis)
 
         blk = jax.checkpoint(_blk) if self.remat_blocks else _blk
 
@@ -205,34 +228,57 @@ class DistributedLMTrainer:
             x, _ = jax.lax.scan(body, x, bp_local)
             return x
 
-        if pp == 1 and sp == 1:
+        if not manual_region:
             return stack_scan
 
-        if pp == 1:  # SP only: manual over seq, blocks replicated
+        # params enter the manual region with their jit placement specs
+        bspecs = param_pspecs(cfg)["blocks"]
+
+        def stack_scan_vec(bp_local, x):
+            """stack_scan with the MoE aux carried as a (1,) VECTOR.
+            Rank-0 values in a lax.scan carry inside a shard_map region
+            trip jax-0.4.37's shard_map partial-eval (residuals are
+            named {0: all_names}, which _check_names rejects on scalar
+            avals — the retired _SpecError xfail); one singleton dim
+            sidesteps it with identical math."""
+            def body(carry, bp):
+                x, aux = carry
+                x, a = blk(bp, x)
+                return (x, aux + jnp.reshape(a, (1,))), None
+
+            (x, aux), _ = jax.lax.scan(
+                body, (x, jnp.zeros((1,), jnp.float32)), bp_local)
+            return x, aux
+
+        if pp == 1:  # SP only
             if moe:
-                # each seq shard routes its own tokens (local capacity);
-                # aux is averaged over shards
+                # x replicated over "data" (global routing parity); each
+                # seq shard routes its own tokens (local capacity); aux
+                # is averaged over shards
+                x_spec = P(None, "seq", None)
+
                 def sp_body(bp_local, x):
-                    x, aux = stack_scan(bp_local, x)
+                    x, aux = stack_scan_vec(bp_local, x)
                     return x, jax.lax.pmean(aux, "seq")
 
                 def blocks_fn(bp, x):
-                    specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
-                    return shard_map(
-                        sp_body, mesh=mesh.mesh, axis_names={"seq"},
-                        in_specs=(specs_b, P(None, "seq", None)),
-                        out_specs=(P(None, "seq", None), P()),
+                    x, aux = shard_map(
+                        sp_body, mesh=mesh.mesh,
+                        in_specs=(bspecs, x_spec),
+                        out_specs=(x_spec, P()),
                         check_vma=False,
                     )(bp, x)
+                    return x, aux[0]
 
                 return blocks_fn
 
             def blocks_fn(bp, x):
-                specs_b = jax.tree_util.tree_map(lambda _: P(), bp)
+                bdim = "data" if x.shape[0] % dp == 0 else None
+                x_spec = P(bdim, "seq", None)
                 return shard_map(
-                    stack_scan, mesh=mesh.mesh, axis_names={"seq"},
-                    in_specs=(specs_b, P(None, "seq", None)),
-                    out_specs=P(None, "seq", None), check_vma=False,
+                    stack_scan, mesh=mesh.mesh,
+                    in_specs=(bspecs, x_spec),
+                    out_specs=x_spec, check_vma=False,
                 )(bp, x)
 
             return blocks_fn
@@ -247,12 +293,13 @@ class DistributedLMTrainer:
         M = self.n_micro
 
         def pipeline(bp_local, x):
-            """Manual over {"pipe"} (+"seq"): bp_local has L/pp stacked
-            layers; x is the full (replicated-over-pipe) batch. For MoE,
-            each microbatch's aux-loss scalar rides the ring beside the
-            activation, accumulating each stage's contribution — the
-            drained aux is the total over all L layers for that
-            microbatch (grad-accumulation aux semantics)."""
+            """Fully-manual region body: bp_local has L/pp stacked layers
+            pre-sliced over model/expert; x is the per-shard batch. For
+            MoE, each microbatch's aux loss — a (1,) vector, see
+            stack_scan_vec — rides the ring beside the activation,
+            accumulating each stage's contribution; the drained aux is
+            the total over all L layers for that microbatch
+            (grad-accumulation aux semantics)."""
             stage = jax.lax.axis_index("pipe")
             B = x.shape[0]
             mb = B // M
@@ -277,11 +324,11 @@ class DistributedLMTrainer:
                     jax.lax.dynamic_index_in_dim(xs, sel, 0, keepdims=False),
                     recv,
                 )
-                if moe:  # aux scalar rides the ring beside the activation
+                if moe:  # (1,) aux rides the ring beside the activation
                     aux_outs = jnp.where(
                         t >= pp, aux_outs.at[done].set(recv_aux), aux_outs)
                     aux_in = jnp.where(stage == 0, 0.0, recv_aux)
-                    y, a = stack_scan(bp_local, x_in)
+                    y, a = stack_scan_vec(bp_local, x_in)
                     recv_aux = jax.lax.ppermute(aux_in + a, "pipe", perm)
                 else:
                     y = stack_scan(bp_local, x_in)
@@ -293,8 +340,8 @@ class DistributedLMTrainer:
             # tick) — no wasted stage compute
             (recv, recv_aux, outs, aux_outs), _ = jax.lax.scan(
                 tick,
-                (jnp.zeros_like(xs[0]), jnp.zeros((), jnp.float32),
-                 jnp.zeros_like(xs), jnp.zeros((M,), jnp.float32)),
+                (jnp.zeros_like(xs[0]), jnp.zeros((1,), jnp.float32),
+                 jnp.zeros_like(xs), jnp.zeros((M, 1), jnp.float32)),
                 jnp.arange(M + pp - 1),
             )
             outs = outs.at[M - 1].set(recv)
@@ -305,23 +352,29 @@ class DistributedLMTrainer:
             if moe:
                 aux_outs = aux_outs.at[M - 1].set(recv_aux)
                 aux = jax.lax.psum(
-                    jnp.where(stage == 0, jnp.mean(aux_outs), 0.0), "pipe")
+                    jnp.where(stage == 0, jnp.mean(aux_outs), 0.0)[None],
+                    "pipe")
                 if sp > 1:  # each seq shard routed its own tokens
                     aux = jax.lax.pmean(aux, "seq")
                 return outs, aux
             return outs
 
-        x_spec = P(None, "seq", None) if sp > 1 else P()
-        bspec_leaf = lambda a: P("pipe", *([None] * (a.ndim - 1)))
-        out_spec = (x_spec, P()) if moe else x_spec
-
         def blocks_fn(bp, x):
-            specs_b = jax.tree_util.tree_map(bspec_leaf, bp)
-            return shard_map(
-                pipeline, mesh=mesh.mesh, axis_names=manual,
-                in_specs=(specs_b, x_spec), out_specs=out_spec,
+            # dense compute batch-shards over "data" when the per-shard
+            # batch still splits into M whole microbatches; MoE
+            # replicates over "data" (global routing semantics)
+            bdim = ("data" if not moe and x.shape[0] % (dp * M) == 0
+                    else None)
+            x_spec = P(bdim, "seq", None) if sp > 1 else P(bdim)
+            out = shard_map(
+                pipeline, mesh=mesh.mesh,
+                in_specs=(bspecs, x_spec),
+                out_specs=(x_spec, P()) if moe else x_spec,
                 check_vma=False,
             )(bp, x)
+            if moe:
+                return out[0], out[1][0]
+            return out
 
         return blocks_fn
 

@@ -27,6 +27,11 @@ the training stack produces crash-safe checkpoints
   a slotted fixed-shape KV-cache/carry slab where requests join and
   leave the ONE in-flight jitted decode step at token granularity,
   with in-graph sampling and streamed responses (``POST /generate``).
+- :mod:`sharded` — mesh-sharded serving: tensor-parallel inference and
+  generation on a 2-D (batch, model) :class:`ServingMesh` via pure-auto
+  GSPMD placement policies (parallel/serving_mesh.py), with
+  reshard-on-load from any checkpoint topology and a typed solo
+  fallback when the mesh degrades mid-serve.
 - :mod:`registry` — the safe train→serve bridge: a crash-safe
   :class:`ModelRegistry` of named models with versioned,
   validation-gated snapshots, and the :class:`ModelRouter` serving
@@ -74,6 +79,11 @@ from deeplearning4j_tpu.serving.registry import (
     UnknownModelError,
 )
 from deeplearning4j_tpu.serving.rtrace import RequestTrace, TraceBuffer
+from deeplearning4j_tpu.serving.sharded import (
+    ShardedInferenceEngine,
+    ShardedMeshError,
+    sharded_generation_engine,
+)
 from deeplearning4j_tpu.serving.server import (
     InferenceServer,
     ServerDrainingError,
@@ -104,9 +114,12 @@ __all__ = [
     "ServerShutdownError",
     "ServingError",
     "ServingMetrics",
+    "ShardedInferenceEngine",
+    "ShardedMeshError",
     "SnapshotValidationError",
     "StaleEpochError",
     "TenantQuotaExceededError",
+    "sharded_generation_engine",
     "TraceBuffer",
     "UnknownModelError",
 ]

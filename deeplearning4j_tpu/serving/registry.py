@@ -726,9 +726,21 @@ class _VersionedEngine:
         kwargs = dict(metrics=router.metrics)
         if policy is not None:
             kwargs["buckets"] = policy
+        engine_cls = InferenceEngine
         if router.mesh is not None:
             kwargs["mesh"] = router.mesh
-        self.engine = InferenceEngine.from_checkpoint(vrec["path"], **kwargs)
+            if getattr(router.mesh, "n_model", 1) > 1:
+                # a 2-D (batch, model) ServingMesh serves every version
+                # — active and canary alike — tensor-parallel; the
+                # canary state machine neither knows nor cares (a
+                # sharded candidate's dispatch failure trips the same
+                # rollback as any other)
+                from deeplearning4j_tpu.serving.sharded import (
+                    ShardedInferenceEngine,
+                )
+
+                engine_cls = ShardedInferenceEngine
+        self.engine = engine_cls.from_checkpoint(vrec["path"], **kwargs)
         shape = self.engine.example_shape()
         if shape is not None:
             # warm BEFORE any traffic: canary traffic must never absorb

@@ -420,6 +420,87 @@ class TestAugmentation:
         assert retrace_counts().get("augment_batch", 0) - before == 1
 
 
+class TestMixup:
+    def _xy(self, b=8, seed=1, classes=4):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((b, N_IN)).astype(np.float32)
+        y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, b)]
+        return x, y
+
+    def test_mixup_zero_is_fingerprint_stable(self):
+        """mixup=0 must leave the key stream byte-identical to a stage
+        built before the knob existed — same seed, same crops/noise."""
+        from deeplearning4j_tpu.data.augment import AugmentStage
+
+        x, _ = self._xy()
+        a = AugmentStage(noise=0.1, seed=3)
+        b = AugmentStage(noise=0.1, mixup=0.0, seed=3)
+        np.testing.assert_array_equal(np.asarray(a.apply(x, 5)),
+                                      np.asarray(b.apply(x, 5)))
+
+    def test_spec_roundtrip_and_mixes_labels(self):
+        from deeplearning4j_tpu.data.augment import parse_augment_spec
+
+        st = parse_augment_spec("normalize:0.0:1.0,mixup:0.4", seed=2)
+        assert st.mixup == 0.4
+        assert st.mixes_labels
+        assert "mixup:0.4" in st.spec()
+        assert not parse_augment_spec("noise:0.1").mixes_labels
+
+    def test_negative_alpha_typed(self):
+        from deeplearning4j_tpu.data.augment import AugmentStage
+
+        with pytest.raises(ValueError, match="mixup"):
+            AugmentStage(mixup=-0.1)
+
+    def test_pair_label_consistent_deterministic_one_trace(self):
+        from deeplearning4j_tpu.data.augment import AugmentStage
+        from deeplearning4j_tpu.obs.trace import retrace_counts
+
+        st = AugmentStage(mixup=0.4, seed=2)
+        x, y = self._xy()
+        before = retrace_counts().get("augment_pair", 0)
+        x1, y1 = map(np.asarray, st.apply_pair(x, y, 0))
+        x2, _y2 = map(np.asarray, st.apply_pair(x, y, 1))
+        assert retrace_counts().get("augment_pair", 0) - before == 1
+        assert not np.array_equal(x1, x2)  # iteration changes the mix
+        # mixed one-hot labels stay a distribution (same lam/perm as x)
+        assert np.allclose(y1.sum(1), 1.0, atol=1e-5)
+        x1b, y1b = map(np.asarray, st.apply_pair(x, y, 0))
+        np.testing.assert_array_equal(x1, x1b)
+        np.testing.assert_array_equal(y1, y1b)
+
+    def test_pair_bundle_matches_per_step_fold_in(self):
+        from deeplearning4j_tpu.data.augment import AugmentStage
+
+        st = AugmentStage(mixup=0.3, seed=5)
+        x, y = self._xy()
+        xb, yb = np.stack([x, x]), np.stack([y, y])
+        ox, oy = map(np.asarray, st.apply_pair_bundle(xb, yb, 10))
+        ex0, ey0 = map(np.asarray, st.apply_pair(x, y, 10))
+        ex1, ey1 = map(np.asarray, st.apply_pair(x, y, 11))
+        # same lam/perm per inner step; allclose not bit-equal — the
+        # vmapped program fuses the mix multiply-adds differently
+        np.testing.assert_allclose(ox[0], ex0, atol=1e-6)
+        np.testing.assert_allclose(oy[0], ey0, atol=1e-6)
+        np.testing.assert_allclose(ox[1], ex1, atol=1e-6)
+        np.testing.assert_allclose(oy[1], ey1, atol=1e-6)
+
+    def test_fit_with_mixup_routes_pair_and_traces_once(self):
+        from deeplearning4j_tpu.data.augment import AugmentStage
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.obs.trace import retrace_counts
+
+        x, y = self._xy(classes=N_OUT)
+        model = _net()
+        model.set_augmentation(AugmentStage(mixup=0.3, seed=0))
+        before = retrace_counts().get("augment_pair", 0)
+        for _ in range(6):
+            model.fit(DataSet(x, y))
+        assert retrace_counts().get("augment_pair", 0) - before == 1
+        assert np.isfinite(float(model.score_))
+
+
 class TestObservability:
     def test_mixed_family_snapshot(self):
         """A metric family with BOTH the legacy unlabeled child (async

@@ -29,29 +29,13 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.updaters import Adam
 
 
-# jax-0.4.37's legacy shard_map partial-auto path (manual axes ⊂ mesh
-# axes) is broken in two ways on this image, failing these compositions
-# since the seed (ROADMAP item 5; full map in ARCHITECTURE.md § Elastic
-# resharding → "partial-auto shard_map failure map"):
-#  - PP manual region composed with auto data/expert axes: the legacy
-#    shard_map out-spec replication check rejects the pipeline's scalar
-#    loss (jax.experimental.shard_map._SpecError on float32[]);
-#  - SP (seq-manual) ring attention under partial-auto SPMD: XLA lowers
-#    a PartitionId instruction the partitioner refuses
-#    ("UNIMPLEMENTED: PartitionId instruction is not supported for SPMD
-#    partitioning ... ambiguous").
-# strict=True: a jax upgrade that fixes partial-auto must surface as
-# XPASS so the markers (and ROADMAP item 5) get retired, not forgotten.
-_PARTIAL_AUTO_PP = pytest.mark.xfail(
-    strict=True,
-    reason="jax-0.4.37 legacy shard_map partial-auto: _SpecError on the "
-           "pipeline's scalar loss out-spec (PP manual x DP/EP auto); "
-           "pre-existing since the seed — ROADMAP item 5")
-_PARTIAL_AUTO_SP = pytest.mark.xfail(
-    strict=True,
-    reason="jax-0.4.37 partial-auto SPMD: XLA PartitionId UNIMPLEMENTED "
-           "under the seq-manual ring-attention region; pre-existing "
-           "since the seed — ROADMAP item 5")
+# The PP/SP compositions below ran for 20 PRs as strict xfails: jax-0.4.37's
+# legacy shard_map cannot mix manual and auto mesh axes in this program
+# family (_SpecError on scalar out-specs, XLA PartitionId UNIMPLEMENTED, a
+# spmd_partitioner CHECK crash). The manual regions are now FULLY manual over
+# every mesh axis with explicit TP/EP collectives (parallel/transformer
+# ``_blocks_fn``), so the markers are retired and every mesh shape is
+# exercised for real — including the exact-parity assertions.
 
 
 def _mlp_moe_conf(n_in=8, n_experts=4, top_k=2, seed=0, cf=2.0):
@@ -310,7 +294,6 @@ class TestMoETransformerLM:
         spec = dist.params_["blocks"]["W1"].sharding.spec
         assert "expert" in spec
 
-    @_PARTIAL_AUTO_PP
     def test_moe_pipeline_with_expert_axis_matches_single_device(self):
         """PP×EP composes (VERDICT r4 #4): expert params stay partitioned
         over 'expert' (an auto axis inside the pipeline's manual
@@ -338,7 +321,6 @@ class TestMoETransformerLM:
         spec = dist.params_["blocks"]["W1"].sharding.spec
         assert "expert" in spec and "pipe" in spec
 
-    @_PARTIAL_AUTO_PP
     def test_moe_pipeline_with_expert_axis_microbatched(self):
         """PP×EP with real microbatching (per-microbatch routing + aux
         grad-accumulation semantics) trains finitely."""
@@ -356,7 +338,6 @@ class TestMoETransformerLM:
         assert np.all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
-    @_PARTIAL_AUTO_PP
     def test_moe_pipeline_matches_single_device(self):
         """PP + MoE (r4): with one microbatch the routing batch equals
         the single-device one, so losses agree exactly; the aux scalar
@@ -379,7 +360,6 @@ class TestMoETransformerLM:
         losses = [tr.fit_batch(ids, tgt) for _ in range(3)]
         np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
 
-    @_PARTIAL_AUTO_PP
     def test_moe_pipeline_microbatched_trains(self):
         """PP + MoE with real microbatching: per-microbatch routing and
         aux (grad-accumulation semantics) — converges finitely."""
@@ -397,7 +377,6 @@ class TestMoETransformerLM:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
 
-    @_PARTIAL_AUTO_SP
     def test_moe_sp_composes(self):
         """EP + SP: ring attention over "seq" with per-shard routing.
 
@@ -485,7 +464,6 @@ class TestLMMixedPrecision:
         with pytest.raises(ValueError, match="compute_dtype"):
             TransformerLM(vocab_size=8, compute_dtype="bf16")
 
-    @_PARTIAL_AUTO_SP
     def test_bf16_sp_ring_attention(self):
         """bf16 + sequence parallelism: the ring-attention kernel gets
         bf16 q/k/v but accumulates fp32 internally."""

@@ -1,0 +1,420 @@
+"""Mesh-sharded serving tests (parallel/serving_mesh.py +
+serving/sharded.py, ISSUE 20).
+
+The acceptance spine: a tensor-parallel engine on a 2x4 (batch, model)
+mesh answers within float-reassociation tolerance of the replicated
+engine (greedy generation EXACTLY), no device holds more than the
+1/n_model + replicated share of the weights (asserted against the
+memory gate's report), steady-state dispatch retraces ZERO programs,
+and reshard-on-load moves any checkpoint topology onto any serving
+mesh with a 0-byte host ledger. Plus the satellites: typed policy
+refusals (non-divisible dims, wrong-model policies, int8 composition),
+the mesh-loss solo fallback with its flight event, and canary routing
+of sharded candidates through the registry unchanged.
+"""
+
+import gc
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.models.transformer_lm import TransformerLM
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.serving_mesh import (
+    ServingMesh,
+    ShardingPolicy,
+    ShardingPolicyError,
+    auto_policy,
+    parse_mesh_spec,
+    policy_for,
+    transformer_lm_policy,
+    validate_policy,
+)
+from deeplearning4j_tpu.serving import InferenceEngine
+from deeplearning4j_tpu.serving.batcher import ServingError
+from deeplearning4j_tpu.serving.sharded import (
+    ShardedGenerationEngine,
+    ShardedInferenceEngine,
+    ShardedMeshError,
+    sharded_generation_engine,
+)
+
+N_IN, N_HID, N_OUT = 8, 16, 4
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compiled_programs():
+    yield
+    gc.collect()
+    jax.clear_caches()
+
+
+def _mesh24() -> ServingMesh:
+    return ServingMesh(batch=2, model=4, devices=jax.devices()[:8])
+
+
+def _net(seed=7) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_out=N_HID, activation="relu"))
+            .layer(OutputLayer(n_out=N_OUT, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _rows(n=8, seed=0):
+    return np.random.default_rng(seed).standard_normal(
+        (n, N_IN)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# mesh + spec grammar
+# ---------------------------------------------------------------------------
+class TestServingMesh:
+    def test_parse_mesh_spec(self):
+        assert parse_mesh_spec("2x4") == (2, 4)
+        assert parse_mesh_spec("8X1") == (8, 1)
+        assert parse_mesh_spec("4") == (4, 1)
+
+    @pytest.mark.parametrize("bad", ["", "2x", "x4", "axb", "0x4", "2x-1"])
+    def test_parse_mesh_spec_typed_refusal(self, bad):
+        with pytest.raises(ShardingPolicyError):
+            parse_mesh_spec(bad)
+
+    def test_shape_and_axes(self):
+        m = _mesh24()
+        assert m.shape == {"batch": 2, "model": 4}
+        assert (m.n_data, m.n_model, m.n_devices) == (2, 4, 8)
+        assert len(m.devices_flat()) == 8
+
+    def test_from_spec_and_batch_inference(self):
+        m = ServingMesh.from_spec("2x4")
+        assert m.shape == {"batch": 2, "model": 4}
+        # batch=0 infers from the device count, TrainingMesh-style
+        m = ServingMesh(model=4)
+        assert m.n_data == len(jax.devices()) // 4
+
+    def test_device_count_mismatch_typed(self):
+        with pytest.raises(ShardingPolicyError, match="devices"):
+            ServingMesh(batch=3, model=4, devices=jax.devices()[:8])
+        with pytest.raises(ShardingPolicyError):
+            ServingMesh(batch=0, model=3, devices=jax.devices()[:8])
+
+    def test_trainingmesh_compatible_surface(self):
+        m = _mesh24()
+        assert m.replicated().spec == P()
+        assert m.batch_sharded().spec == P("batch")
+        assert m.spec(None, "model").spec == P(None, "model")
+
+
+# ---------------------------------------------------------------------------
+# policies
+# ---------------------------------------------------------------------------
+class TestShardingPolicy:
+    def test_policy_for_selects_bespoke_vs_auto(self):
+        lm = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                           n_layers=1, max_length=16, seed=0).init()
+        assert policy_for(lm).name == "transformer_lm"
+        assert policy_for(_net()).name == "auto"
+
+    def test_auto_policy_shards_matrices_replicates_vectors(self):
+        m = _mesh24()
+        pol = auto_policy()
+        W = np.zeros((N_IN, N_HID), np.float32)
+        assert pol.spec_for("0/W", W, m) == P(None, "model")
+        b = np.zeros((N_HID,), np.float32)
+        assert pol.spec_for("0/b", b, m) == P()
+
+    def test_auto_policy_nondivisible_falls_back(self):
+        m = _mesh24()
+        pol = auto_policy()
+        # last dim 3 not divisible by 4 -> shards the divisible dim
+        assert pol.spec_for("1/W", np.zeros((N_HID, 3), np.float32),
+                            m) == P("model", None)
+        # nothing divisible -> replicate (the memory gate is the
+        # backstop if such leaves dominate)
+        assert pol.spec_for("1/W", np.zeros((3, 5), np.float32), m) == P()
+
+    def test_transformer_policy_megatron_pairing(self):
+        m = _mesh24()
+        pol = transformer_lm_policy()
+        wq = np.zeros((2, 32, 32), np.float32)
+        assert pol.spec_for("blocks/Wq", wq, m) == P(None, None, "model")
+        wo = np.zeros((2, 32, 32), np.float32)
+        assert pol.spec_for("blocks/Wo", wo, m) == P(None, "model", None)
+        assert pol.spec_for("blocks/ln1_g", np.zeros((2, 32)), m) == P()
+        assert pol.spec_for("head", np.zeros((32, 64)), m) == P(
+            None, "model")
+
+    def test_mismatched_policy_typed_refusal(self):
+        """A policy written for another model is a typed refusal, not a
+        silent repartition: sharding a dim that does not divide."""
+        m = _mesh24()
+        pol = ShardingPolicy("wrong", [(r"W", P(None, "model"))])
+        with pytest.raises(ShardingPolicyError, match="not divisible"):
+            pol.spec_for("0/W", np.zeros((N_IN, 6), np.float32), m)
+
+    def test_overlong_spec_typed_refusal(self):
+        m = _mesh24()
+        pol = ShardingPolicy("wrong", [(r"b", P(None, "model"))])
+        with pytest.raises(ShardingPolicyError, match="not written"):
+            pol.spec_for("0/b", np.zeros((N_HID,), np.float32), m)
+
+    def test_policy_overrides(self):
+        m = _mesh24()
+        net = _net()
+        pol = policy_for(net, overrides=["0/W=r"])
+        assert pol.name == "auto+overrides"
+        assert pol.spec_for("0/W", np.zeros((N_IN, N_HID)), m) == P()
+        pol = policy_for(net, overrides=["0/W=0"])
+        assert pol.spec_for("0/W", np.zeros((N_IN, N_HID)), m) == P(
+            "model", None)
+
+    @pytest.mark.parametrize("bad", ["noequals", "p=x", "p=1.5"])
+    def test_bad_override_typed(self, bad):
+        with pytest.raises(ShardingPolicyError, match="override"):
+            policy_for(_net(), overrides=[bad])
+
+    def test_validate_policy_report_and_estimator(self):
+        net = _net()
+        m = _mesh24()
+        rep = validate_policy(net.params_, m, auto_policy(), conf=net.conf)
+        assert rep["per_device_bytes"] <= (
+            rep["total_bytes"] // m.n_model + rep["replicated_bytes"]
+            + 4096)
+        assert 0.5 <= rep["estimator_agreement"] <= 2.0
+        assert rep["mesh"] == {"batch": 2, "model": 4}
+
+    def test_validate_policy_memory_gate_fires(self):
+        """A policy that under-shards (splits only the 2-way batch axis)
+        exceeds the total/n_model + replicated bound — typed, loud."""
+        net = _net()
+        m = _mesh24()
+        lazy = ShardingPolicy("lazy", [(r"W", P("batch", None))])
+        with pytest.raises(ShardingPolicyError, match="per device"):
+            validate_policy(net.params_, m, lazy, slack_bytes=0)
+
+
+# ---------------------------------------------------------------------------
+# sharded inference engine
+# ---------------------------------------------------------------------------
+class TestShardedInference:
+    def test_needs_serving_mesh_typed(self):
+        with pytest.raises(ShardingPolicyError, match="ServingMesh"):
+            ShardedInferenceEngine(_net(), mesh=None)
+
+    def test_int8_composition_refused_typed(self):
+        with pytest.raises(ShardingPolicyError, match="int8"):
+            ShardedInferenceEngine(_net(), mesh=_mesh24(),
+                                   int8_serving=True)
+
+    def test_parity_memory_and_retraces(self):
+        from deeplearning4j_tpu.obs import flight
+
+        x = _rows()
+        solo = InferenceEngine(_net())
+        y_solo = solo.infer(x)
+        seq0 = max((e["seq"] for e in
+                    flight.default_flight_recorder().events()), default=0)
+        eng = ShardedInferenceEngine(_net(), mesh=_mesh24())
+        y_sh = eng.infer(x)
+        assert np.allclose(y_solo, y_sh, rtol=1e-5, atol=1e-6)
+
+        # no device holds the full model: the live report obeys the gate
+        rep = eng.shard_report
+        assert rep["per_device_bytes"] <= (
+            rep["total_bytes"] // 4 + rep["replicated_bytes"] + 4096)
+        assert rep["per_device_bytes"] < rep["total_bytes"]
+        # params visibly TP-sharded on the mesh
+        shardings = {str(l.sharding.spec) for l in
+                     jax.tree_util.tree_leaves(eng._snap.params)}
+        assert any("model" in s for s in shardings)
+        # reshard ledger: live placement stages zero host bytes
+        assert eng.reshard_stats.host_bytes == 0
+        # flight forensics
+        evs = [e for e in flight.default_flight_recorder().events()
+               if e["seq"] > seq0]
+        kinds = [e["kind"] for e in evs]
+        assert "mesh_build" in kinds and "shard_load" in kinds
+        # steady state: repeated same-shape dispatches compile nothing
+        c0 = eng.compile_count
+        for _ in range(4):
+            eng.infer(x)
+        assert eng.compile_count == c0
+
+    def test_describe_carries_shard_telemetry(self):
+        eng = ShardedInferenceEngine(_net(), mesh=_mesh24())
+        d = eng.describe()
+        assert d["mesh"] == {"batch": 2, "model": 4}
+        assert d["policy"]["name"] == "auto"
+        assert d["fallback_active"] is False
+        assert d["shard_report"]["total_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# reshard-on-load topology matrix
+# ---------------------------------------------------------------------------
+class TestReshardTopologyMatrix:
+    def test_checkpoint_to_any_mesh_zero_host_bytes(self, tmp_path):
+        """ck -> solo, ck -> 2x4, and a live 2x4 model -> 8x1: every leg
+        answers identically and stages zero host bytes."""
+        from deeplearning4j_tpu.train.faults import save_checkpoint
+
+        ck = save_checkpoint(_net(seed=13), str(tmp_path / "ck"))
+        x = _rows()
+        solo = InferenceEngine.from_checkpoint(ck)
+        y_ref = solo.infer(x)
+
+        eng24 = ShardedInferenceEngine.from_checkpoint(ck, mesh=_mesh24())
+        assert np.allclose(y_ref, eng24.infer(x), rtol=1e-5, atol=1e-6)
+        assert eng24.reshard_stats.host_bytes == 0
+
+        # live sharded 2x4 params -> pure-batch 8x1 mesh (the model
+        # object still carries the 2x4 placement)
+        mesh81 = ServingMesh(batch=8, model=1, devices=jax.devices()[:8])
+        eng81 = ShardedInferenceEngine(eng24.model, mesh=mesh81)
+        assert np.allclose(y_ref, eng81.infer(x), rtol=1e-5, atol=1e-6)
+        assert eng81.reshard_stats.host_bytes == 0
+
+        # degenerate 1x1 mesh: sharded serving collapses to solo
+        mesh11 = ServingMesh(batch=1, model=1, devices=jax.devices()[:1])
+        eng11 = ShardedInferenceEngine.from_checkpoint(ck, mesh=mesh11)
+        assert np.allclose(y_ref, eng11.infer(x), rtol=1e-5, atol=1e-6)
+        assert eng11.reshard_stats.host_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# sharded generation
+# ---------------------------------------------------------------------------
+class TestShardedGeneration:
+    def _lm(self, seed=9):
+        return TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, max_length=48, seed=seed).init()
+
+    def test_greedy_parity_slab_sharding_and_retraces(self):
+        from deeplearning4j_tpu.serving.generate import GenerationEngine
+
+        prompt = np.asarray([5, 9, 11, 2])
+        solo = GenerationEngine(self._lm(), n_slots=4, max_length=48)
+        try:
+            toks_solo = list(solo.submit(prompt, max_new=10,
+                                         temperature=0.0).result(
+                                             timeout=120))
+        finally:
+            solo.shutdown()
+        eng = sharded_generation_engine(self._lm(), _mesh24(), n_slots=4,
+                                        max_length=48)
+        try:
+            assert "model" in str(eng.backend._kc.sharding.spec)
+            toks = list(eng.submit(prompt, max_new=10,
+                                   temperature=0.0).result(timeout=240))
+            assert toks == toks_solo  # greedy decode is EXACT
+            tc0 = dict(eng.trace_counts)
+            toks2 = list(eng.submit(prompt, max_new=10,
+                                    temperature=0.0).result(timeout=240))
+            tc1 = dict(eng.trace_counts)
+            assert toks2 == toks
+            assert all(tc1.get(k, 0) == tc0.get(k, 0) for k in tc1
+                       if k.startswith("generation_"))
+            assert eng.shard_stats.host_bytes == 0
+        finally:
+            eng.shutdown()
+
+    def test_slab_stays_sharded_across_reset(self):
+        eng = sharded_generation_engine(self._lm(), _mesh24(), n_slots=4,
+                                        max_length=48)
+        try:
+            eng.backend.reset()
+            assert "model" in str(eng.backend._kc.sharding.spec)
+            assert "batch" in str(eng.backend._vc.sharding.spec)
+        finally:
+            eng.shutdown()
+
+    def test_recurrent_model_typed_refusal(self):
+        with pytest.raises(ShardingPolicyError, match="TransformerLM"):
+            sharded_generation_engine(_net(), _mesh24(), n_slots=4)
+
+    def test_nondivisible_slab_typed_refusal(self):
+        with pytest.raises(ShardingPolicyError, match="n_slots"):
+            sharded_generation_engine(self._lm(), _mesh24(), n_slots=3,
+                                      max_length=48)
+
+    def test_factory_class_refuses_direct_construction(self):
+        with pytest.raises(TypeError, match="sharded_generation_engine"):
+            ShardedGenerationEngine()
+
+
+# ---------------------------------------------------------------------------
+# mesh-loss fallback
+# ---------------------------------------------------------------------------
+class TestMeshLossFallback:
+    def test_error_is_typed_serving_error(self):
+        assert issubclass(ShardedMeshError, ServingError)
+
+    def test_mesh_loss_arms_solo_fallback(self):
+        from deeplearning4j_tpu.chaos import ChaosPlan
+        from deeplearning4j_tpu.chaos import hooks
+        from deeplearning4j_tpu.obs import flight
+
+        x = _rows()
+        eng = ShardedInferenceEngine(_net(seed=3), mesh=_mesh24())
+        y_healthy = eng.infer(x)
+        seq0 = max((e["seq"] for e in
+                    flight.default_flight_recorder().events()), default=0)
+        plan = ChaosPlan([{"seam": "serving.sharded_dispatch",
+                           "mode": "error"}])
+        try:
+            with plan.armed():
+                with pytest.raises(ShardedMeshError, match="solo fallback"):
+                    eng.infer(x)
+        finally:
+            hooks.reset()
+        assert eng.fallback_active
+        # the engine survives degraded: one-device serving, same answers
+        assert np.allclose(y_healthy, eng.infer(x), rtol=1e-5, atol=1e-6)
+        evs = [e for e in flight.default_flight_recorder().events()
+               if e["seq"] > seq0 and e["kind"] == "sharded_fallback"]
+        assert len(evs) == 1
+        assert evs[0]["reason"] == "InjectedFaultError"
+
+
+# ---------------------------------------------------------------------------
+# registry: canary routing of sharded candidates
+# ---------------------------------------------------------------------------
+class TestRegistryShardedCandidates:
+    def test_router_serves_and_promotes_sharded_versions(self, tmp_path):
+        from deeplearning4j_tpu.serving.registry import (
+            ModelRegistry,
+            ModelRouter,
+        )
+        from deeplearning4j_tpu.train.faults import save_checkpoint
+
+        reg = ModelRegistry(str(tmp_path / "reg"))
+        ck1 = save_checkpoint(_net(seed=1), str(tmp_path / "ck1"))
+        ck2 = save_checkpoint(_net(seed=2), str(tmp_path / "ck2"))
+        reg.publish("m", ck1, score=0.5)
+        router = ModelRouter(reg, mesh=_mesh24(), canary_fraction=1.0,
+                             canary_window_s=0.2, canary_min_requests=1,
+                             refresh_s=0.0, max_wait_ms=1.0)
+        try:
+            x = _rows(2)
+            out = router.predict("m", x, timeout=30)
+            assert out is not None
+            live = router._live.get("m")
+            assert isinstance(live.active.engine, ShardedInferenceEngine)
+            # a sharded v2 canary promotes through the stock machinery
+            reg.publish("m", ck2, score=0.45)
+            deadline = time.monotonic() + 30
+            promoted = False
+            while time.monotonic() < deadline and not promoted:
+                router.predict("m", x, timeout=30)
+                time.sleep(0.05)
+                promoted = reg.get("m").get("active_version") == 2
+            assert promoted
+        finally:
+            router.shutdown()
